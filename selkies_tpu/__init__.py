@@ -1,0 +1,18 @@
+"""selkies_tpu — a TPU-native remote-desktop streaming framework.
+
+A ground-up rebuild of the capabilities of selkies-project/selkies
+(reference: /root/reference, see SURVEY.md) designed TPU-first:
+
+- One asyncio control plane (aiohttp) serving HTTP + WebSockets on a single
+  port (reference: src/selkies/stream_server.py:390).
+- A media plane where colorspace conversion and block-based video coding
+  (RGB->YCbCr, 8x8/4x4 DCT, quantisation, reconstruction) run as JAX/Pallas
+  kernels on HBM-resident framebuffers, with host-side entropy coding
+  (Huffman for JPEG, CAVLC for H.264) in C++/numpy.
+- Multi-seat fan-out over a TPU slice via `jax.sharding.Mesh` + shard_map
+  (one seat per device; stripes within a frame map onto the Pallas grid).
+
+Layer map mirrors SURVEY.md §1; wire protocol mirrors §2.3.
+"""
+
+__version__ = "0.1.0"

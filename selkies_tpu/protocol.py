@@ -1,0 +1,207 @@
+"""Binary wire protocol + text verb surface.
+
+Implements the reference's client/server framing exactly (SURVEY.md §2.3;
+reference parse sites: addons/selkies-web-core/selkies-ws-core.js:4255-4460,
+src/selkies/selkies.py:604-621, 2504-3235):
+
+Binary frames (first byte = opcode):
+- ``0x01`` audio (server→client): ``[0x01, n_red]`` + Opus payload. When
+  ``n_red > 0`` the payload is RFC-2198 RED framed:
+  ``u32 pts + n_red*(4-byte block hdr) + 1-byte primary hdr + blocks``.
+- ``0x02`` mic (client→server): raw PCM chunk.
+- ``0x03`` JPEG stripe (server→client), 6-byte header:
+  ``[0x03, flags, u16 frame_id, u16 stripe_y]`` + JFIF bytes.
+- ``0x04`` H.264 stripe (server→client), 10-byte header:
+  ``[0x04, frame_type(0x01=IDR), u16 frame_id, u16 y_start, u16 w, u16 h]``
+  + Annex-B access unit.
+- ``0x05`` gzip-compressed control text, both directions, only for messages
+  over the compression threshold.
+
+All u16/u32 are big-endian (network order), matching the JS DataView default
+reads in the reference client.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import struct
+import zlib
+from typing import Iterable
+
+# Bounded control-message sizes (reference settings.py:37-60): text frames
+# above WS_COMPRESSION_THRESHOLD are gzip'd with opcode 0x05; inflation is
+# bounded to defeat zip bombs.
+WS_MAX_MESSAGE_BYTES = 8 * 1024 * 1024
+WS_MESSAGE_SIZE_HARD_CAP = 64 * 1024 * 1024
+WS_COMPRESSION_THRESHOLD = 512
+
+
+def inflate_gz_bounded(data: bytes, limit: int = WS_MAX_MESSAGE_BYTES) -> bytes:
+    """Gunzip ``data`` refusing to inflate beyond ``limit`` bytes.
+
+    Mirrors the reference's bounded gzip helper (settings.py:37-60): client
+    supplied gzip blobs must never balloon server memory, and both truncated
+    streams and trailing garbage are rejected.
+    """
+    out = bytearray()
+    dec = zlib.decompressobj(16 + zlib.MAX_WBITS)
+    out += dec.decompress(data, limit + 1)
+    while dec.unconsumed_tail and len(out) <= limit:
+        out += dec.decompress(dec.unconsumed_tail, limit + 1 - len(out))
+    if len(out) > limit:
+        raise ValueError(f"gzip payload inflates beyond {limit} bytes")
+    if not dec.eof:
+        raise ValueError("truncated gzip payload")
+    if dec.unused_data:
+        raise ValueError("trailing garbage after gzip payload")
+    return bytes(out)
+
+OP_AUDIO = 0x01
+OP_MIC = 0x02
+OP_JPEG = 0x03
+OP_H264 = 0x04
+OP_GZ_CONTROL = 0x05
+
+FRAME_TYPE_DELTA = 0x00
+FRAME_TYPE_IDR = 0x01
+
+# uint16 circular frame-id space for ACK distance math
+# (reference selkies.py:1590-1717).
+FRAME_ID_MOD = 1 << 16
+
+_H264_HDR = struct.Struct(">BBHHHH")
+_JPEG_HDR = struct.Struct(">BBHH")
+
+
+def pack_h264_stripe(frame_id: int, y_start: int, width: int, height: int,
+                     payload: bytes | memoryview, idr: bool) -> bytes:
+    """10-byte ``0x04`` header + Annex-B payload (selkies-ws-core.js:4338-4352)."""
+    hdr = _H264_HDR.pack(OP_H264, FRAME_TYPE_IDR if idr else FRAME_TYPE_DELTA,
+                         frame_id % FRAME_ID_MOD, y_start, width, height)
+    return hdr + bytes(payload)
+
+
+def unpack_h264_header(buf: bytes | memoryview) -> tuple[int, int, int, int, int]:
+    """→ (frame_type, frame_id, y_start, w, h). Payload begins at byte 10."""
+    try:
+        op, ftype, fid, y, w, h = _H264_HDR.unpack_from(buf, 0)
+    except struct.error as e:
+        raise ValueError(f"malformed h264 frame header: {e}") from e
+    if op != OP_H264:
+        raise ValueError(f"not an h264 frame (op={op:#x})")
+    return ftype, fid, y, w, h
+
+
+def pack_jpeg_stripe(frame_id: int, stripe_y: int, payload: bytes | memoryview,
+                     flags: int = 0) -> bytes:
+    """6-byte ``0x03`` header + JPEG bytes (selkies-ws-core.js:4317-4337)."""
+    return _JPEG_HDR.pack(OP_JPEG, flags, frame_id % FRAME_ID_MOD, stripe_y) \
+        + bytes(payload)
+
+
+def unpack_jpeg_header(buf: bytes | memoryview) -> tuple[int, int, int]:
+    """→ (flags, frame_id, stripe_y). Payload begins at byte 6."""
+    try:
+        op, flags, fid, y = _JPEG_HDR.unpack_from(buf, 0)
+    except struct.error as e:
+        raise ValueError(f"malformed jpeg frame header: {e}") from e
+    if op != OP_JPEG:
+        raise ValueError(f"not a jpeg frame (op={op:#x})")
+    return flags, fid, y
+
+
+def pack_audio(payload: bytes, n_red: int = 0) -> bytes:
+    """``[0x01, n_red]`` + Opus/RED payload (selkies-ws-core.js:36-38)."""
+    return bytes((OP_AUDIO, n_red)) + payload
+
+
+def pack_red_payload(pts_90k: int, primary: bytes,
+                     redundant: Iterable[tuple[int, bytes]]) -> bytes:
+    """RFC-2198 RED framing for Opus (reference pcmflux native framing).
+
+    ``redundant`` is oldest-first ``(ts_offset_90k, opus_frame)`` pairs.
+    Block header: 1 bit F=1, 7-bit PT, 14-bit ts offset, 10-bit length;
+    primary header: F=0 + 7-bit PT. PT is fixed 111 (dynamic Opus).
+    """
+    pt = 111
+    out = bytearray(struct.pack(">I", pts_90k & 0xFFFFFFFF))
+    red_list = list(redundant)
+    for ts_off, blk in red_list:
+        if len(blk) >= 1 << 10:
+            raise ValueError("RED block too large for 10-bit length")
+        if not 0 <= ts_off < 1 << 14:
+            raise ValueError("RED ts offset out of 14-bit range")
+        word = (1 << 31) | (pt << 24) | (ts_off << 10) | len(blk)
+        out += struct.pack(">I", word)
+    out.append(pt)  # F=0 primary header
+    for _, blk in red_list:
+        out += blk
+    out += primary
+    return bytes(out)
+
+
+def frame_id_distance(newest: int, acked: int) -> int:
+    """Forward distance in uint16 circular space (reference selkies.py:61-110)."""
+    return (newest - acked) % FRAME_ID_MOD
+
+
+def maybe_compress_text(text: str, threshold: int = WS_COMPRESSION_THRESHOLD
+                        ) -> bytes | str:
+    """Return ``0x05`` + gzip when the message is worth compressing, else the
+    original text (reference selkies.py:375, 2381-2395)."""
+    raw = text.encode("utf-8")
+    if len(raw) < threshold:
+        return text
+    return bytes((OP_GZ_CONTROL,)) + gzip.compress(raw, 6)
+
+
+def decompress_control(buf: bytes | memoryview) -> str:
+    b = bytes(buf)
+    if not b or b[0] != OP_GZ_CONTROL:
+        raise ValueError("not a 0x05 control frame")
+    return inflate_gz_bounded(b[1:]).decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# Text verbs (client→server), SURVEY §2.3. A thin parsed representation so
+# the dispatcher (server/websockets_service.py, input/handler.py) stays flat.
+# ---------------------------------------------------------------------------
+
+#: verbs a view-only client may still send (reference
+#: input_handler.py:110-128 viewer-authority prefix lists).
+VIEWER_ALLOWED_PREFIXES = (
+    "_gz", "SETTINGS", "CLIENT_FRAME_ACK", "START_VIDEO", "STOP_VIDEO",
+    "REQUEST_KEYFRAME", "START_AUDIO", "STOP_AUDIO", "pong", "_f", "_l",
+    "_stats_video", "_stats_audio", "p",
+)
+
+#: verbs that mutate the session and need input authority
+INPUT_PREFIXES = (
+    "kd", "ku", "kr", "kh", "m", "m2", "vb", "ab", "js", "r", "s",
+    "cw", "cb", "cr", "cws", "cbs", "cwd", "cbd", "cwe", "cbe", "co",
+    "REQUEST_CLIPBOARD", "SET_NATIVE_CURSOR_RENDERING", "cmd",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Verb:
+    name: str
+    args: str  # raw remainder after the first comma/space (verb-specific)
+
+    @property
+    def arg_list(self) -> list[str]:
+        return self.args.split(",") if self.args else []
+
+
+def parse_verb(text: str) -> Verb:
+    """Split a text message into verb + remainder.
+
+    The reference protocol mixes comma verbs (``kd,65``) and space verbs
+    (``CLIENT_FRAME_ACK 123``, ``SETTINGS,{json}``); we take the first
+    separator of either kind.
+    """
+    ci = text.find(",")
+    si = text.find(" ")
+    cut = min(x for x in (ci, si, len(text)) if x >= 0)
+    return Verb(name=text[:cut], args=text[cut + 1:] if cut < len(text) else "")

@@ -1,0 +1,482 @@
+"""Declarative settings system.
+
+Re-designs the reference's settings layer (src/selkies/settings.py:12-27
+precedence rules, 62-912 definitions, 914-930 sensitive-name redaction,
+1271-1398 client payload + sanitization) as a typed, testable module:
+
+- One declarative list ``SETTING_DEFINITIONS`` drives argparse flags, env
+  parsing, the client-visible settings payload, and per-setting locking.
+- Precedence: CLI flag > ``SELKIES_<NAME>`` env > fallback env names > default.
+- A string value may carry a ``|locked`` suffix to pin it against client
+  writes; numeric range settings may be locked to a sub-range with
+  ``lo-hi`` syntax (``60-60`` pins the value) — reference settings.py:12-27.
+- Sensitive names are redacted from any dump (reference settings.py:914-930).
+- ``build_client_settings_payload()`` emits the JSON the client UI consumes;
+  ``sanitize_client_setting()`` validates every client write server-side
+  (reference settings.py:1271-1398).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import sys
+from typing import Any, Mapping, Sequence
+
+
+class SType(enum.Enum):
+    BOOL = "bool"
+    INT = "int"
+    FLOAT = "float"
+    STR = "str"
+    ENUM = "enum"
+    LIST = "list"  # comma-separated list of strings
+
+
+@dataclasses.dataclass(frozen=True)
+class Setting:
+    """One declarative setting definition.
+
+    ``client=True`` settings appear in the client settings payload and may be
+    written by clients (subject to lock state and sanitisation).
+    """
+
+    name: str
+    stype: SType
+    default: Any
+    help: str = ""
+    choices: tuple[str, ...] | None = None  # ENUM only
+    vmin: float | None = None  # INT/FLOAT range
+    vmax: float | None = None
+    client: bool = False
+    sensitive: bool = False
+    fallback_env: tuple[str, ...] = ()
+
+    def env_name(self) -> str:
+        return "SELKIES_" + self.name.upper()
+
+
+def _s(name, stype, default, help="", **kw) -> Setting:
+    return Setting(name=name, stype=stype, default=default, help=help, **kw)
+
+
+# ---------------------------------------------------------------------------
+# The definitions. Grouped as in the reference (settings.py:62-912). This is
+# the single source of truth: argparse, env, client payload, docs all derive
+# from this list.
+# ---------------------------------------------------------------------------
+SETTING_DEFINITIONS: tuple[Setting, ...] = (
+    # --- process / mode -----------------------------------------------------
+    _s("mode", SType.ENUM, "websockets", "Streaming transport to start.",
+       choices=("websockets", "webrtc")),
+    _s("enable_dual_mode", SType.BOOL, False,
+       "Allow live switching between transports via /api/switch."),
+    _s("addr", SType.STR, "0.0.0.0", "Bind address for the single-port server."),
+    _s("port", SType.INT, 8080, "Bind port.", vmin=1, vmax=65535),
+    _s("debug", SType.BOOL, False, "Verbose logging."),
+    _s("app_name", SType.STR, "selkies-tpu", "Display name for the client UI."),
+    _s("app_ready_file", SType.STR, "",
+       "Optional sidecar file polled before serving (reference __main__.py:20-26)."),
+
+    # --- auth ---------------------------------------------------------------
+    _s("enable_basic_auth", SType.BOOL, False, "HTTP basic auth toggle."),
+    _s("basic_auth_user", SType.STR, "", "Basic auth username."),
+    _s("basic_auth_password", SType.STR, "", "Basic auth password.", sensitive=True),
+    _s("viewonly_password", SType.STR, "",
+       "Secondary password granting view-only access.", sensitive=True),
+    _s("master_token", SType.STR, "",
+       "Bearer token with full API access (timing-safe compare).", sensitive=True),
+    _s("enable_sharing", SType.BOOL, True, "Allow >1 concurrent client."),
+    _s("enable_collab", SType.BOOL, False,
+       "Allow non-primary clients input authority (collaborator role)."),
+    _s("secure_api", SType.BOOL, False,
+       "Secure token mode: clients must present a minted token (reference selkies.py:2147-2200)."),
+    _s("allowed_ws_origins", SType.LIST, "",
+       "Origin allow-list for WS upgrades; empty = same-host only."),
+
+    # --- TLS ----------------------------------------------------------------
+    _s("enable_https", SType.BOOL, False, "Serve TLS."),
+    _s("https_cert", SType.STR, "", "Path to TLS certificate (hot-reloaded)."),
+    _s("https_key", SType.STR, "", "Path to TLS key.", sensitive=True),
+
+    # --- video --------------------------------------------------------------
+    _s("encoder", SType.ENUM, "jpeg-tpu",
+       "Video encoder backend. *-tpu run DCT/quant as Pallas kernels.",
+       choices=("jpeg-tpu", "h264-tpu", "h264-tpu-striped", "jpeg-cpu"),
+       client=True),
+    _s("framerate", SType.INT, 60, "Target capture/encode fps.", vmin=8, vmax=240,
+       client=True),
+    _s("video_bitrate_kbps", SType.INT, 8000, "CBR target bitrate (kbps).",
+       vmin=100, vmax=1_000_000, client=True),
+    _s("video_crf", SType.INT, 25, "Constant-rate-factor quality (lower=better).",
+       vmin=5, vmax=50, client=True),
+    _s("video_min_qp", SType.INT, 10, "QP floor for rate control.", vmin=0, vmax=51),
+    _s("video_max_qp", SType.INT, 35,
+       "QP ceiling; reference measured +19dB PSNR at 2.5x bitrate with 35 "
+       "(settings.py:177-183).", vmin=0, vmax=51),
+    _s("keyframe_interval_s", SType.FLOAT, 10.0,
+       "Forced IDR cadence in seconds; <=0 disables.", vmin=-1, vmax=600),
+    _s("fullcolor", SType.BOOL, False, "4:4:4 chroma (else 4:2:0).", client=True),
+    _s("stripe_height", SType.INT, 64,
+       "Row-stripe height in px for intra-frame parallel encode "
+       "(reference striped encoding, SURVEY §2.5).", vmin=16, vmax=1088),
+    _s("use_paint_over", SType.BOOL, True,
+       "Re-encode static scenes at higher quality after damage settles "
+       "(reference settings.py:560-585)."),
+    _s("paint_over_quality", SType.INT, 90, "JPEG quality / h264 QP boost for paint-over.",
+       vmin=1, vmax=100, client=True),
+    _s("jpeg_quality", SType.INT, 60, "Baseline JPEG quality for motion frames.",
+       vmin=1, vmax=100, client=True),
+    _s("use_damage_gating", SType.BOOL, True,
+       "Only encode stripes whose content changed (device-side diff)."),
+    _s("watermark_path", SType.STR, "", "PNG burned into the framebuffer on device."),
+    _s("watermark_location", SType.INT, 6, "0-6 anchor enum (reference parity).",
+       vmin=0, vmax=6),
+
+    # --- display ------------------------------------------------------------
+    _s("display_id", SType.STR, ":0", "X display / seat identifier."),
+    _s("initial_width", SType.INT, 1920, "Initial framebuffer width.", vmin=64, vmax=16384),
+    _s("initial_height", SType.INT, 1080, "Initial framebuffer height.", vmin=64, vmax=16384),
+    _s("enable_resize", SType.BOOL, True, "Clients may resize the remote display.",
+       client=True),
+    _s("max_displays", SType.INT, 2, "Maximum concurrent displays per seat.",
+       vmin=1, vmax=4),
+    _s("dpi", SType.INT, 96, "Initial DPI.", vmin=48, vmax=384, client=True),
+    _s("cursor_size", SType.INT, 24, "Pointer size in px.", vmin=8, vmax=128),
+    _s("enable_cursors", SType.BOOL, True, "Stream cursor image updates."),
+    _s("native_cursor_rendering", SType.BOOL, True,
+       "Client renders cursor locally from cursor messages.", client=True),
+
+    # --- audio --------------------------------------------------------------
+    _s("enable_audio", SType.BOOL, True, "Capture+stream Opus audio.", client=True),
+    _s("audio_bitrate", SType.INT, 128000, "Opus bitrate (bps).",
+       vmin=6000, vmax=510000, client=True),
+    _s("audio_frame_ms", SType.FLOAT, 10.0, "Opus frame duration (ms).",
+       vmin=2.5, vmax=60.0),
+    _s("audio_channels", SType.INT, 2, "Capture channels.", vmin=1, vmax=8),
+    _s("audio_red_distance", SType.INT, 2,
+       "Opus RED (RFC 2198) redundancy depth; gated on all-clients-capable "
+       "(reference selkies.py:949-973).", vmin=0, vmax=4),
+    _s("audio_backpressure_queue", SType.INT, 120,
+       "Max queued audio chunks per client before drop (reference settings.py:899-905)."),
+    _s("enable_microphone", SType.BOOL, True, "Accept client mic and play back."),
+
+    # --- input --------------------------------------------------------------
+    _s("enable_input", SType.BOOL, True, "Inject keyboard/mouse input."),
+    _s("enable_gamepad", SType.BOOL, True, "Virtual gamepad support."),
+    _s("enable_clipboard", SType.ENUM, "both",
+       "Clipboard sync direction.", choices=("both", "in", "out", "none"),
+       client=True),
+    _s("clipboard_max_bytes", SType.INT, 64 * 1024 * 1024,
+       "Multipart clipboard transfer cap (reference parity 64MiB)."),
+    _s("enable_command_verb", SType.BOOL, False,
+       "Allow the cmd,<shell> verb (opt-in, dangerous)."),
+    _s("enable_binary_clipboard", SType.BOOL, True, "Allow image/binary clipboard."),
+
+    # --- file transfer ------------------------------------------------------
+    _s("enable_file_transfer", SType.BOOL, True, "Uploads/downloads."),
+    _s("file_transfer_dir", SType.STR, "~/Desktop",
+       "Root directory for uploads and the download index."),
+    _s("upload_chunk_bytes", SType.INT, 64 * 1024 * 1024, "Max upload slice size."),
+
+    # --- network / relays ---------------------------------------------------
+    _s("video_relay_budget_s", SType.FLOAT, 2.0,
+       "Per-client video queue budget in seconds of stream bitrate "
+       "(reference selkies.py:89-101)."),
+    _s("video_relay_floor_bytes", SType.INT, 4 * 1024 * 1024,
+       "Relay budget floor (4 MiB reference floor)."),
+    _s("ack_desync_frames", SType.INT, 30,
+       "Backpressure trigger distance in frames, scaled by measured client fps."),
+    _s("reconnect_grace_s", SType.FLOAT, 3.0,
+       "Keep capture warm across client reconnects (reference selkies.py:827-830)."),
+
+    # --- TPU ----------------------------------------------------------------
+    _s("tpu_seats", SType.INT, 1,
+       "Concurrent desktop seats encoded over the device mesh (one per device).",
+       vmin=1, vmax=256),
+    _s("tpu_stripe_devices", SType.INT, 1,
+       "Devices to shard a single frame's stripes across (sequence-parallel analog).",
+       vmin=1, vmax=64),
+    _s("tpu_precision", SType.ENUM, "int32", "Transform arithmetic precision.",
+       choices=("int32", "bf16-preview")),
+
+    # --- webrtc (opt-in transport) ------------------------------------------
+    _s("turn_host", SType.STR, "", "TURN server host."),
+    _s("turn_port", SType.INT, 3478, "TURN server port."),
+    _s("turn_username", SType.STR, "", "Legacy TURN username."),
+    _s("turn_password", SType.STR, "", "Legacy TURN password.", sensitive=True),
+    _s("turn_shared_secret", SType.STR, "", "HMAC TURN shared secret.", sensitive=True),
+    _s("turn_rest_uri", SType.STR, "", "TURN REST API endpoint."),
+    _s("rtc_config_file", SType.STR, "", "Trusted JSON ICE-server file."),
+    _s("webrtc_public_ip", SType.STR, "", "NAT1TO1 public IP substitution."),
+
+    # --- metrics ------------------------------------------------------------
+    _s("enable_metrics", SType.BOOL, True, "Prometheus /api/metrics endpoint."),
+    _s("stats_interval_s", SType.FLOAT, 5.0, "Per-client system stats cadence."),
+)
+
+_DEFS_BY_NAME: dict[str, Setting] = {d.name: d for d in SETTING_DEFINITIONS}
+
+# Names whose values must never appear in logs/dumps even beyond the
+# explicitly-sensitive flags (reference settings.py:914-930). "key" matches
+# only as a whole underscore-separated segment so e.g. keyframe_interval_s
+# is not falsely redacted.
+_SENSITIVE_SUBSTRINGS = ("password", "secret", "token")
+_SENSITIVE_SEGMENTS = ("key",)
+
+
+def is_sensitive(name: str) -> bool:
+    d = _DEFS_BY_NAME.get(name)
+    if d is not None and d.sensitive:
+        return True
+    low = name.lower()
+    if any(m in low for m in _SENSITIVE_SUBSTRINGS):
+        return True
+    return any(seg in _SENSITIVE_SEGMENTS for seg in low.split("_"))
+
+
+class SettingsError(ValueError):
+    pass
+
+
+def _parse_scalar(d: Setting, raw: str) -> Any:
+    if d.stype is SType.BOOL:
+        v = raw.strip().lower()
+        if v in ("1", "true", "yes", "on"):
+            return True
+        if v in ("0", "false", "no", "off", ""):
+            return False
+        raise SettingsError(f"{d.name}: not a boolean: {raw!r}")
+    if d.stype is SType.INT:
+        try:
+            val = int(raw)
+        except ValueError as e:
+            raise SettingsError(f"{d.name}: not an int: {raw!r}") from e
+        return val
+    if d.stype is SType.FLOAT:
+        try:
+            return float(raw)
+        except ValueError as e:
+            raise SettingsError(f"{d.name}: not a float: {raw!r}") from e
+    if d.stype is SType.ENUM:
+        if d.choices and raw not in d.choices:
+            raise SettingsError(f"{d.name}: {raw!r} not in {d.choices}")
+        return raw
+    if d.stype is SType.LIST:
+        return tuple(x.strip() for x in raw.split(",") if x.strip())
+    return raw
+
+
+def _clamp(d: Setting, val: Any) -> Any:
+    if d.stype in (SType.INT, SType.FLOAT):
+        if d.vmin is not None and val < d.vmin:
+            raise SettingsError(f"{d.name}: {val} below min {d.vmin}")
+        if d.vmax is not None and val > d.vmax:
+            raise SettingsError(f"{d.name}: {val} above max {d.vmax}")
+    return val
+
+
+@dataclasses.dataclass
+class _Resolved:
+    value: Any
+    locked: bool = False
+    # For numeric client settings: optionally restricted [lo, hi] from env
+    # "lo-hi" syntax (reference range-lock, settings.py:12-27).
+    lo: float | None = None
+    hi: float | None = None
+    source: str = "default"
+
+
+class AppSettings:
+    """Resolved settings with attribute access.
+
+    ``AppSettings.parse(argv, env)`` applies the precedence chain; the result
+    is mutable only through ``apply_client_setting`` (sanitised) or
+    ``set_server`` (trusted server-side updates).
+    """
+
+    def __init__(self, resolved: dict[str, _Resolved]):
+        self._resolved = resolved
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def parse(cls, argv: Sequence[str] | None = None,
+              env: Mapping[str, str] | None = None) -> "AppSettings":
+        argv = list(argv if argv is not None else sys.argv[1:])
+        env = dict(env if env is not None else os.environ)
+        cli: dict[str, str] = {}
+        i = 0
+        while i < len(argv):
+            a = argv[i]
+            if not a.startswith("--"):
+                raise SettingsError(f"unexpected argument {a!r}")
+            body = a[2:]
+            if "=" in body:
+                k, v = body.split("=", 1)
+            else:
+                k = body
+                if i + 1 < len(argv) and not argv[i + 1].startswith("--"):
+                    i += 1
+                    v = argv[i]
+                else:
+                    # Bare flag form is only valid for booleans; a missing
+                    # value on any other type must fail fast.
+                    d = _DEFS_BY_NAME.get(k.replace("-", "_"))
+                    if d is None or d.stype is not SType.BOOL:
+                        raise SettingsError(f"--{k} requires a value")
+                    v = "true"
+            k = k.replace("-", "_")
+            if k not in _DEFS_BY_NAME:
+                raise SettingsError(f"unknown setting --{k}")
+            cli[k] = v
+            i += 1
+
+        resolved: dict[str, _Resolved] = {}
+        for d in SETTING_DEFINITIONS:
+            raw: str | None = None
+            source = "default"
+            if d.name in cli:
+                raw, source = cli[d.name], "cli"
+            elif d.env_name() in env:
+                raw, source = env[d.env_name()], "env"
+            else:
+                for fb in d.fallback_env:
+                    if fb in env:
+                        raw, source = env[fb], "fallback_env"
+                        break
+            if raw is None:
+                resolved[d.name] = _Resolved(value=d.default)
+                continue
+            locked = False
+            if raw.endswith("|locked"):
+                locked, raw = True, raw[: -len("|locked")]
+            lo = hi = None
+            if d.stype in (SType.INT, SType.FLOAT) and d.client and _is_range(raw):
+                lo_s, hi_s = raw.split("-", 1)
+                lo, hi = float(lo_s), float(hi_s)
+                if lo > hi:
+                    raise SettingsError(f"{d.name}: inverted range {raw!r}")
+                # Value = default clamped into the restricted range.
+                val = min(max(d.default, lo), hi)
+                if d.stype is SType.INT:
+                    val = int(val)
+                val = _clamp(d, val)
+                locked = locked or (lo == hi)
+            else:
+                val = _clamp(d, _parse_scalar(d, raw))
+            resolved[d.name] = _Resolved(value=val, locked=locked, lo=lo, hi=hi,
+                                         source=source)
+        return cls(resolved)
+
+    # -- access --------------------------------------------------------------
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._resolved[name].value
+        except KeyError as e:
+            raise AttributeError(name) from e
+
+    def get(self, name: str) -> Any:
+        return self._resolved[name].value
+
+    def is_locked(self, name: str) -> bool:
+        return self._resolved[name].locked
+
+    def set_server(self, name: str, value: Any) -> None:
+        """Trusted server-side update (bypasses lock, not validation)."""
+        d = _DEFS_BY_NAME[name]
+        if d.stype in (SType.INT, SType.FLOAT):
+            value = _clamp(d, value)
+        elif d.stype is SType.ENUM and d.choices and value not in d.choices:
+            raise SettingsError(f"{name}: {value!r} not in {d.choices}")
+        self._resolved[name].value = value
+
+    # -- client-facing surface ----------------------------------------------
+    def build_client_settings_payload(self) -> dict[str, Any]:
+        """JSON payload of client-visible settings with lock/range metadata
+        (reference settings.py:1271-1313)."""
+        out: dict[str, Any] = {}
+        for d in SETTING_DEFINITIONS:
+            if not d.client:
+                continue
+            r = self._resolved[d.name]
+            entry: dict[str, Any] = {"value": r.value, "locked": r.locked}
+            if d.stype in (SType.INT, SType.FLOAT):
+                entry["min"] = r.lo if r.lo is not None else d.vmin
+                entry["max"] = r.hi if r.hi is not None else d.vmax
+            if d.stype is SType.ENUM:
+                entry["choices"] = list(d.choices or ())
+            out[d.name] = entry
+        return out
+
+    def sanitize_client_setting(self, name: str, value: Any) -> Any:
+        """Validate a client-supplied settings write; raises SettingsError on
+        anything out of contract (reference settings.py:1315-1398)."""
+        d = _DEFS_BY_NAME.get(name)
+        if d is None or not d.client:
+            raise SettingsError(f"setting {name!r} is not client-writable")
+        r = self._resolved[name]
+        if r.locked:
+            raise SettingsError(f"setting {name!r} is locked")
+        if d.stype is SType.BOOL:
+            if isinstance(value, bool):
+                return value
+            return _parse_scalar(d, str(value))
+        if d.stype in (SType.INT, SType.FLOAT):
+            try:
+                val = (int if d.stype is SType.INT else float)(value)
+            except (TypeError, ValueError) as e:
+                raise SettingsError(f"{name}: bad value {value!r}") from e
+            lo = r.lo if r.lo is not None else d.vmin
+            hi = r.hi if r.hi is not None else d.vmax
+            if lo is not None and val < lo:
+                raise SettingsError(f"{name}: {val} below {lo}")
+            if hi is not None and val > hi:
+                raise SettingsError(f"{name}: {val} above {hi}")
+            return val
+        if d.stype is SType.ENUM:
+            if not isinstance(value, str) or (d.choices and value not in d.choices):
+                raise SettingsError(f"{name}: {value!r} not in {d.choices}")
+            return value
+        if not isinstance(value, str):
+            raise SettingsError(f"{name}: expected string")
+        return value
+
+    def apply_client_setting(self, name: str, value: Any) -> Any:
+        val = self.sanitize_client_setting(name, value)
+        self._resolved[name].value = val
+        return val
+
+    # -- dumps ---------------------------------------------------------------
+    def dump(self, redact: bool = True) -> dict[str, Any]:
+        out = {}
+        for name, r in self._resolved.items():
+            out[name] = "<redacted>" if (redact and is_sensitive(name) and r.value) \
+                else r.value
+        return out
+
+    def to_json(self, redact: bool = True) -> str:
+        return json.dumps(self.dump(redact=redact), default=list)
+
+
+def _is_range(raw: str) -> bool:
+    """True when ``raw`` is 'lo-hi' (two non-negative numerics).
+
+    A leading '-' means a negative scalar, never a range — the split in
+    ``parse`` uses the same first-'-' convention, so detection and parsing
+    agree by construction.
+    """
+    if raw.startswith("-") or "-" not in raw:
+        return False
+    lo, _, hi = raw.partition("-")
+    try:
+        float(lo), float(hi)
+        return True
+    except ValueError:
+        return False
+
+
+def load(argv: Sequence[str] | None = None,
+         env: Mapping[str, str] | None = None) -> AppSettings:
+    return AppSettings.parse(argv, env)

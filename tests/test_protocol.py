@@ -1,0 +1,90 @@
+import pytest
+
+from selkies_tpu import protocol as P
+
+
+def test_h264_roundtrip():
+    payload = b"\x00\x00\x00\x01\x65rest"
+    buf = P.pack_h264_stripe(70000, 256, 1920, 64, payload, idr=True)
+    assert buf[0] == P.OP_H264
+    ftype, fid, y, w, h = P.unpack_h264_header(buf)
+    assert ftype == P.FRAME_TYPE_IDR
+    assert fid == 70000 % 65536  # wraps into u16 space
+    assert (y, w, h) == (256, 1920, 64)
+    assert buf[10:] == payload
+    # matches the byte offsets the reference server itself relies on
+    # (selkies.py:604-621): frame_type at byte 1, y_start at bytes 4:6.
+    assert buf[1] == 0x01
+    assert int.from_bytes(buf[4:6], "big") == 256
+
+
+def test_jpeg_roundtrip():
+    buf = P.pack_jpeg_stripe(5, 128, b"\xff\xd8jpeg")
+    flags, fid, y = P.unpack_jpeg_header(buf)
+    assert (flags, fid, y) == (0, 5, 128)
+    assert buf[6:] == b"\xff\xd8jpeg"
+
+
+def test_frame_id_distance_wraps():
+    assert P.frame_id_distance(5, 65534) == 7
+    assert P.frame_id_distance(100, 90) == 10
+    assert P.frame_id_distance(90, 100) == 65526  # stale ack reads as huge
+
+
+def test_audio_framing():
+    assert P.pack_audio(b"opus", 0)[:2] == bytes((0x01, 0))
+    red = P.pack_red_payload(90000, b"PRIMARY", [(1920, b"OLD")])
+    framed = P.pack_audio(red, 1)
+    assert framed[1] == 1
+    # u32 pts, one 4-byte block header, 1-byte primary header, then blocks
+    assert framed[2:6] == (90000).to_bytes(4, "big")
+    hdr = int.from_bytes(framed[6:10], "big")
+    assert hdr >> 31 == 1            # F bit
+    assert (hdr >> 24) & 0x7F == 111  # PT
+    assert (hdr >> 10) & 0x3FFF == 1920
+    assert hdr & 0x3FF == 3
+    assert framed[10] == 111          # primary header F=0
+    assert framed[11:14] == b"OLD" and framed[14:] == b"PRIMARY"
+
+
+def test_control_compression_threshold():
+    small = "pong"
+    assert P.maybe_compress_text(small) == "pong"
+    big = "SETTINGS," + "x" * 4096
+    out = P.maybe_compress_text(big)
+    assert isinstance(out, bytes) and out[0] == P.OP_GZ_CONTROL
+    assert P.decompress_control(out) == big
+
+
+def test_bounded_gzip_inflation():
+    import gzip
+    bomb = gzip.compress(b"\0" * (2 * 1024 * 1024))
+    assert P.inflate_gz_bounded(bomb, limit=4 * 1024 * 1024)
+    with pytest.raises(ValueError):
+        P.inflate_gz_bounded(bomb, limit=1024)
+    with pytest.raises(ValueError):
+        P.inflate_gz_bounded(bomb[:10])  # truncated
+    with pytest.raises(ValueError):
+        P.inflate_gz_bounded(gzip.compress(b"ok") + b"junk")  # trailing garbage
+
+
+def test_malformed_headers_raise_valueerror():
+    with pytest.raises(ValueError):
+        P.unpack_h264_header(b"\x04\x01")
+    with pytest.raises(ValueError):
+        P.unpack_jpeg_header(b"\x03")
+    with pytest.raises(ValueError):
+        P.pack_red_payload(0, b"p", [(1 << 14, b"x")])  # ts offset overflow
+
+
+def test_parse_verbs():
+    v = P.parse_verb("kd,65")
+    assert v.name == "kd" and v.args == "65"
+    v = P.parse_verb("CLIENT_FRAME_ACK 123")
+    assert v.name == "CLIENT_FRAME_ACK" and v.args == "123"
+    v = P.parse_verb("SETTINGS,{\"a\": 1}")
+    assert v.name == "SETTINGS" and v.args.startswith("{")
+    v = P.parse_verb("START_VIDEO")
+    assert v.name == "START_VIDEO" and v.args == ""
+    v = P.parse_verb("m,100,200,1,0")
+    assert v.arg_list == ["100", "200", "1", "0"]

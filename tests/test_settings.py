@@ -1,0 +1,117 @@
+import json
+
+import pytest
+
+from selkies_tpu import settings as S
+
+
+def load(argv=(), env=None):
+    return S.AppSettings.parse(argv=list(argv), env=env or {})
+
+
+def test_defaults():
+    s = load()
+    assert s.mode == "websockets"
+    assert s.port == 8080
+    assert s.framerate == 60
+    assert s.encoder == "jpeg-tpu"
+    assert s.audio_red_distance == 2
+
+
+def test_precedence_cli_over_env():
+    s = load(["--framerate", "30"], {"SELKIES_FRAMERATE": "120"})
+    assert s.framerate == 30
+
+
+def test_env_applies():
+    s = load([], {"SELKIES_PORT": "9000", "SELKIES_ENABLE_AUDIO": "false"})
+    assert s.port == 9000
+    assert s.enable_audio is False
+
+
+def test_cli_equals_form_and_bare_bool():
+    s = load(["--debug", "--port=9001"])
+    assert s.debug is True and s.port == 9001
+
+
+def test_unknown_flag_rejected():
+    with pytest.raises(S.SettingsError):
+        load(["--no-such-flag", "1"])
+
+
+def test_enum_validation():
+    with pytest.raises(S.SettingsError):
+        load(["--mode", "carrier-pigeon"])
+
+
+def test_range_clamp_rejected():
+    with pytest.raises(S.SettingsError):
+        load(["--framerate", "1000"])
+
+
+def test_locked_suffix():
+    s = load([], {"SELKIES_FRAMERATE": "60|locked"})
+    assert s.framerate == 60
+    assert s.is_locked("framerate")
+    with pytest.raises(S.SettingsError):
+        s.apply_client_setting("framerate", 30)
+
+
+def test_range_lock_pins_value():
+    # reference settings.py:12-27 — "60-60" pins a range setting
+    s = load([], {"SELKIES_FRAMERATE": "60-60"})
+    assert s.framerate == 60 and s.is_locked("framerate")
+
+
+def test_range_restriction():
+    s = load([], {"SELKIES_VIDEO_BITRATE_KBPS": "4000-20000"})
+    assert s.video_bitrate_kbps == 8000  # default inside range
+    assert s.apply_client_setting("video_bitrate_kbps", 20000) == 20000
+    with pytest.raises(S.SettingsError):
+        s.apply_client_setting("video_bitrate_kbps", 30000)
+
+
+def test_client_payload_shape():
+    s = load()
+    p = s.build_client_settings_payload()
+    assert p["framerate"]["value"] == 60
+    assert p["framerate"]["min"] == 8 and p["framerate"]["max"] == 240
+    assert "basic_auth_password" not in p  # non-client settings absent
+    assert p["encoder"]["choices"]
+    json.dumps(p)  # serialisable
+
+
+def test_sanitize_rejects_non_client():
+    s = load()
+    with pytest.raises(S.SettingsError):
+        s.sanitize_client_setting("master_token", "x")
+
+
+def test_sensitive_redaction():
+    s = load(["--basic_auth_password", "hunter2"])
+    d = s.dump()
+    assert d["basic_auth_password"] == "<redacted>"
+    assert "hunter2" not in s.to_json()
+
+
+def test_list_setting():
+    s = load([], {"SELKIES_ALLOWED_WS_ORIGINS": "https://a.example, https://b.example"})
+    assert s.allowed_ws_origins == ("https://a.example", "https://b.example")
+
+
+def test_negative_env_value_is_not_a_range():
+    # "-5-10" must fail as a bad scalar, not crash range parsing
+    with pytest.raises(S.SettingsError):
+        load([], {"SELKIES_FRAMERATE": "-5-10"})
+
+
+def test_missing_value_for_non_bool_flag():
+    with pytest.raises(S.SettingsError):
+        load(["--app_name"])
+
+
+def test_keyframe_not_redacted_but_keys_are():
+    s = load()
+    d = s.dump()
+    assert d["keyframe_interval_s"] == 10.0
+    assert S.is_sensitive("https_key") and not S.is_sensitive("keyframe_interval_s")
