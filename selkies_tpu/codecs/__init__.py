@@ -1,0 +1,6 @@
+"""Host-side bitstream codecs (entropy coding + container assembly).
+
+The serial, branchy half of video coding that is the wrong shape for TPU
+(SURVEY.md §7 'Hard parts' #1): JPEG Huffman coding, H.264 CAVLC, NAL/JFIF
+assembly. Implemented as vectorised numpy with an optional C++ fast path.
+"""

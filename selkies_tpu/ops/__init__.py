@@ -1,0 +1,6 @@
+"""Device-side media kernels (JAX/Pallas).
+
+The compute path of the framework: colorspace conversion, blockwise
+transforms, quantisation, damage detection — everything that runs on TPU.
+Host-side entropy coding lives in :mod:`selkies_tpu.codecs`.
+"""

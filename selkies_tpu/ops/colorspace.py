@@ -1,0 +1,104 @@
+"""Colorspace conversion ops (RGB -> YCbCr) as fusible JAX functions.
+
+TPU-first replacement for the CSC stage the reference performs inside the
+Rust ``pixelflux`` encoder (SURVEY.md §2.2: RGB->NV12 conversion feeding
+NVENC/VA-API/x264). Two matrices are provided:
+
+- JPEG / JFIF: BT.601 **full-range** (the only colorspace baseline JPEG
+  decoders assume).
+- H.264: BT.709 **limited-range** (what WebCodecs expects for desktop video
+  unless the VUI says otherwise).
+
+Everything is elementwise + a 3x3 contraction, so XLA fuses the whole CSC
+into neighbouring ops; the fused Pallas encode kernel reuses the same
+constants.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# BT.601 full-range (JFIF), float32. y = Kr*R + Kg*G + Kb*B, Cb/Cr centred
+# at +128.
+_CSC_601_FULL = np.array(
+    [
+        [0.299, 0.587, 0.114],
+        [-0.168735892, -0.331264108, 0.5],
+        [0.5, -0.418687589, -0.081312411],
+    ],
+    dtype=np.float32,
+)
+_CSC_601_OFFSET = np.array([0.0, 128.0, 128.0], dtype=np.float32)
+
+# BT.709 limited-range (video). Y in [16,235], C in [16,240].
+_CSC_709_LIMITED = np.array(
+    [
+        [0.2126 * 219 / 255, 0.7152 * 219 / 255, 0.0722 * 219 / 255],
+        [-0.2126 / 1.5748 * 224 / 255 / 1.0,  # derived below, replaced in init
+         0.0, 0.0],
+        [0.0, 0.0, 0.0],
+    ],
+    dtype=np.float32,
+)
+
+
+def _bt709_limited_matrix() -> np.ndarray:
+    kr, kb = 0.2126, 0.0722
+    kg = 1.0 - kr - kb
+    y = np.array([kr, kg, kb])
+    cb = (np.array([0.0, 0.0, 1.0]) - y) / (2.0 * (1.0 - kb))
+    cr = (np.array([1.0, 0.0, 0.0]) - y) / (2.0 * (1.0 - kr))
+    m = np.stack([y * (219.0 / 255.0), cb * (224.0 / 255.0),
+                  cr * (224.0 / 255.0)])
+    return m.astype(np.float32)
+
+
+_CSC_709_LIMITED = _bt709_limited_matrix()
+_CSC_709_OFFSET = np.array([16.0, 128.0, 128.0], dtype=np.float32)
+
+
+def rgb_to_ycbcr(rgb: jnp.ndarray, standard: str = "bt601-full") -> jnp.ndarray:
+    """(H, W, 3) uint8/float RGB -> (H, W, 3) float32 YCbCr (not level-shifted).
+
+    ``standard``: ``bt601-full`` (JPEG) or ``bt709-limited`` (H.264).
+    """
+    if standard == "bt601-full":
+        m, off = _CSC_601_FULL, _CSC_601_OFFSET
+    elif standard == "bt709-limited":
+        m, off = _CSC_709_LIMITED, _CSC_709_OFFSET
+    else:
+        raise ValueError(f"unknown standard {standard!r}")
+    x = rgb.astype(jnp.float32)
+    out = jnp.einsum("hwc,yc->hwy", x, jnp.asarray(m),
+                     precision=jax.lax.Precision.HIGHEST) + jnp.asarray(off)
+    return out
+
+
+def subsample_420(plane: jnp.ndarray) -> jnp.ndarray:
+    """(H, W) -> (H/2, W/2) by 2x2 mean (the standard 4:2:0 siting)."""
+    h, w = plane.shape
+    return plane.reshape(h // 2, 2, w // 2, 2).mean(axis=(1, 3))
+
+
+def split_ycbcr_420(ycbcr: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(H, W, 3) -> Y (H,W), Cb (H/2,W/2), Cr (H/2,W/2)."""
+    y = ycbcr[..., 0]
+    cb = subsample_420(ycbcr[..., 1])
+    cr = subsample_420(ycbcr[..., 2])
+    return y, cb, cr
+
+
+def ycbcr_to_rgb(ycbcr: jnp.ndarray, standard: str = "bt601-full") -> jnp.ndarray:
+    """Inverse CSC for test oracles / paint-over previews."""
+    if standard == "bt601-full":
+        m, off = _CSC_601_FULL, _CSC_601_OFFSET
+    elif standard == "bt709-limited":
+        m, off = _CSC_709_LIMITED, _CSC_709_OFFSET
+    else:
+        raise ValueError(f"unknown standard {standard!r}")
+    minv = jnp.asarray(np.linalg.inv(m).astype(np.float32))
+    x = ycbcr - jnp.asarray(off)
+    return jnp.einsum("hwy,cy->hwc", x, minv,
+                      precision=jax.lax.Precision.HIGHEST)

@@ -1,0 +1,114 @@
+"""Blockwise 8x8 DCT-II, quantisation and zigzag as MXU-shaped JAX ops.
+
+The transform heart of the JPEG path (and the 8x8 option of H.264 High
+profile later). Everything is expressed as batched small matmuls so XLA
+tiles it onto the MXU:
+
+- 2-D DCT of a block B is ``D @ B @ D.T`` with the orthonormal DCT-II
+  matrix D — two (8x8)x(8x8) matmuls per block, batched over all blocks.
+- Zigzag reordering is a 64x64 permutation **matmul** (not a gather): TPUs
+  love matmuls and hate gathers, and the permutation fuses into the quant
+  epilogue.
+
+Replaces the transform stage inside the reference's closed-source Rust
+encoder (SURVEY.md §2.2 pixelflux row).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.cache
+def dct8_matrix() -> np.ndarray:
+    """Orthonormal 8-point DCT-II matrix (float32), D @ D.T = I."""
+    k = np.arange(8)
+    n = np.arange(8)
+    m = np.cos((2 * n[None, :] + 1) * k[:, None] * np.pi / 16.0)
+    m[0, :] *= 1.0 / np.sqrt(2.0)
+    m *= 0.5
+    return m.astype(np.float32)
+
+
+@functools.cache
+def zigzag_order() -> np.ndarray:
+    """JPEG zigzag scan: zz[i] = raster index of the i-th zigzag position."""
+    # Odd anti-diagonals run top-right -> bottom-left (order by row), even
+    # ones bottom-left -> top-right (order by column).
+    order = sorted(
+        ((r, c) for r in range(8) for c in range(8)),
+        key=lambda rc: (rc[0] + rc[1],
+                        rc[0] if (rc[0] + rc[1]) % 2 else rc[1]),
+    )
+    return np.array([r * 8 + c for r, c in order], dtype=np.int32)
+
+
+@functools.cache
+def zigzag_perm_matrix() -> np.ndarray:
+    """(64, 64) float32 P with (flat_block @ P) = zigzag-ordered block."""
+    zz = zigzag_order()
+    p = np.zeros((64, 64), dtype=np.float32)
+    for out_pos, raster_idx in enumerate(zz):
+        p[raster_idx, out_pos] = 1.0
+    return p
+
+
+def to_blocks(plane: jnp.ndarray) -> jnp.ndarray:
+    """(H, W) -> (H/8 * W/8, 8, 8) raster-ordered 8x8 blocks."""
+    h, w = plane.shape
+    return (plane.reshape(h // 8, 8, w // 8, 8)
+            .transpose(0, 2, 1, 3)
+            .reshape(-1, 8, 8))
+
+
+def from_blocks(blocks: jnp.ndarray, h: int, w: int) -> jnp.ndarray:
+    """Inverse of :func:`to_blocks`."""
+    return (blocks.reshape(h // 8, w // 8, 8, 8)
+            .transpose(0, 2, 1, 3)
+            .reshape(h, w))
+
+
+# MXU matmuls default to bf16 inputs on TPU; DCT coefficients then drift by
+# whole quantisation steps. HIGHEST keeps the transforms float32-accurate.
+_PREC = jax.lax.Precision.HIGHEST
+
+
+def dct2d(blocks: jnp.ndarray) -> jnp.ndarray:
+    """(N, 8, 8) spatial -> (N, 8, 8) frequency via batched D @ B @ D.T."""
+    d = jnp.asarray(dct8_matrix())
+    return jnp.einsum("ij,njk,lk->nil", d, blocks, d,
+                      precision=_PREC, preferred_element_type=jnp.float32)
+
+
+def idct2d(coeffs: jnp.ndarray) -> jnp.ndarray:
+    """(N, 8, 8) frequency -> spatial; D.T @ C @ D."""
+    d = jnp.asarray(dct8_matrix())
+    return jnp.einsum("ji,njk,kl->nil", d, coeffs, d,
+                      precision=_PREC, preferred_element_type=jnp.float32)
+
+
+def quantize_zigzag(coeffs: jnp.ndarray, qtable_raster: jnp.ndarray
+                    ) -> jnp.ndarray:
+    """(N, 8, 8) float coeffs -> (N, 64) int16 zigzag-ordered quantised.
+
+    ``qtable_raster`` is the 64-entry table in **raster** order. Rounding is
+    round-half-away-from-zero to match libjpeg's ``DESCALE`` convention.
+    """
+    flat = coeffs.reshape(-1, 64)
+    q = flat / qtable_raster.reshape(1, 64).astype(jnp.float32)
+    rounded = jnp.trunc(q + jnp.sign(q) * 0.5)
+    zz = rounded @ jnp.asarray(zigzag_perm_matrix())
+    return zz.astype(jnp.int16)
+
+
+def dequantize_from_zigzag(zzcoeffs: jnp.ndarray, qtable_raster: jnp.ndarray
+                           ) -> jnp.ndarray:
+    """(N, 64) int zigzag -> (N, 8, 8) float dequantised raster coeffs."""
+    p = jnp.asarray(zigzag_perm_matrix())
+    raster = zzcoeffs.astype(jnp.float32) @ p.T
+    return (raster * qtable_raster.reshape(1, 64).astype(jnp.float32)
+            ).reshape(-1, 8, 8)

@@ -1,0 +1,57 @@
+"""Device-side JPEG forward pipeline: RGB -> quantised zigzag coefficients.
+
+The TPU half of the baseline-JPEG encoder (reference equivalent: the (M)JPEG
+``output_mode`` of the Rust pixelflux encoder, SURVEY.md §2.2). The host half
+(Huffman entropy coding + JFIF assembly) lives in
+:mod:`selkies_tpu.codecs.jpeg`.
+
+Everything here is jit-compatible with static shapes: one compiled executable
+per (H, W, subsampling). Quant tables are runtime inputs so quality changes
+do NOT retrigger compilation (live-tunable vs structural split — reference
+media_pipeline.py:210-320 draws the same line).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .colorspace import rgb_to_ycbcr, split_ycbcr_420
+from .dct import dct2d, quantize_zigzag, to_blocks
+
+
+def jpeg_forward_420(rgb: jnp.ndarray, qy: jnp.ndarray, qc: jnp.ndarray
+                     ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(H, W, 3) uint8 RGB -> (Ny,64), (Nc,64), (Nc,64) int16 zigzag coeffs.
+
+    H and W must be multiples of 16. Block order is plane-raster.
+    ``qy``/``qc`` are 64-entry raster-order quant tables (float32/int).
+    """
+    ycc = rgb_to_ycbcr(rgb, "bt601-full")
+    y, cb, cr = split_ycbcr_420(ycc)
+    out = []
+    for plane, q in ((y, qy), (cb, qc), (cr, qc)):
+        blocks = to_blocks(plane - 128.0)
+        out.append(quantize_zigzag(dct2d(blocks), q))
+    return tuple(out)
+
+
+def jpeg_forward_444(rgb: jnp.ndarray, qy: jnp.ndarray, qc: jnp.ndarray
+                     ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """4:4:4 variant (``fullcolor`` setting): H, W multiples of 8."""
+    ycc = rgb_to_ycbcr(rgb, "bt601-full")
+    out = []
+    for ci, q in ((0, qy), (1, qc), (2, qc)):
+        blocks = to_blocks(ycc[..., ci] - 128.0)
+        out.append(quantize_zigzag(dct2d(blocks), q))
+    return tuple(out)
+
+
+@functools.cache
+def jitted_jpeg_forward(subsampling: str = "420"):
+    """Compiled forward fn for a fixed subsampling; shapes specialise on
+    first call per (H, W)."""
+    fn = jpeg_forward_420 if subsampling == "420" else jpeg_forward_444
+    return jax.jit(fn)

@@ -1,0 +1,156 @@
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from selkies_tpu.codecs import jpeg as J
+from selkies_tpu.ops import colorspace as C
+from selkies_tpu.ops import dct as D
+from selkies_tpu.ops.jpeg_pipeline import jpeg_forward_420, jpeg_forward_444
+
+
+def _psnr(a, b):
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    mse = np.mean((a - b) ** 2)
+    return 99.0 if mse == 0 else 10 * np.log10(255.0 ** 2 / mse)
+
+
+def _test_image(h, w, seed=0):
+    """Smooth gradient + blocks + text-like edges — desktop-ish content."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:h, 0:w]
+    r = (xx * 255 / w).astype(np.uint8)
+    g = (yy * 255 / h).astype(np.uint8)
+    b = ((xx + yy) % 256).astype(np.uint8)
+    img = np.stack([r, g, b], axis=-1)
+    # hard-edged rectangles
+    for _ in range(6):
+        y0, x0 = rng.integers(0, h - 16), rng.integers(0, w - 16)
+        img[y0:y0 + 12, x0:x0 + 14] = rng.integers(0, 255, 3)
+    return img
+
+
+def test_dct_matrix_orthonormal():
+    d = D.dct8_matrix()
+    np.testing.assert_allclose(d @ d.T, np.eye(8), atol=1e-6)
+
+
+def test_dct_roundtrip():
+    rng = np.random.default_rng(1)
+    blocks = rng.uniform(-128, 127, (10, 8, 8)).astype(np.float32)
+    rec = np.asarray(D.idct2d(D.dct2d(blocks)))
+    np.testing.assert_allclose(rec, blocks, atol=1e-3)
+
+
+def test_zigzag_order_is_permutation():
+    zz = D.zigzag_order()
+    assert sorted(zz) == list(range(64))
+    # first entries of the canonical JPEG zigzag
+    assert list(zz[:10]) == [0, 1, 8, 16, 9, 2, 3, 10, 17, 24]
+
+
+def test_blocks_roundtrip():
+    rng = np.random.default_rng(2)
+    plane = rng.uniform(0, 255, (32, 48)).astype(np.float32)
+    import jax.numpy as jnp
+    rec = D.from_blocks(D.to_blocks(jnp.asarray(plane)), 32, 48)
+    np.testing.assert_allclose(np.asarray(rec), plane)
+
+
+def test_csc_roundtrip():
+    rng = np.random.default_rng(3)
+    import jax.numpy as jnp
+    rgb = jnp.asarray(rng.integers(0, 255, (16, 16, 3)), dtype=jnp.float32)
+    for std in ("bt601-full", "bt709-limited"):
+        rec = C.ycbcr_to_rgb(C.rgb_to_ycbcr(rgb, std), std)
+        np.testing.assert_allclose(np.asarray(rec), np.asarray(rgb), atol=1e-2)
+
+
+def test_csc_known_values():
+    import jax.numpy as jnp
+    # white and black in BT.601 full range
+    white = C.rgb_to_ycbcr(jnp.full((1, 1, 3), 255.0), "bt601-full")
+    np.testing.assert_allclose(np.asarray(white)[0, 0], [255, 128, 128], atol=0.01)
+    black = C.rgb_to_ycbcr(jnp.zeros((1, 1, 3)), "bt601-full")
+    np.testing.assert_allclose(np.asarray(black)[0, 0], [0, 128, 128], atol=0.01)
+
+
+@pytest.mark.parametrize("quality", [90, 60])
+def test_jpeg_pil_decodes_420(quality):
+    """Self-calibrating oracle: our TPU-pipeline JPEG must land within 1 dB
+    of PIL's own libjpeg encoder at the same quality on the same image."""
+    h, w = 64, 96
+    img = _test_image(h, w)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, "JPEG", quality=quality)
+    pil_psnr = _psnr(np.asarray(Image.open(buf).convert("RGB")), img)
+
+    qy = J.scale_qtable(J.STD_LUMA_QUANT, quality)
+    qc = J.scale_qtable(J.STD_CHROMA_QUANT, quality)
+    import jax.numpy as jnp
+    y, cb, cr = jpeg_forward_420(jnp.asarray(img), jnp.asarray(qy), jnp.asarray(qc))
+    jfif = J.encode_coeffs_to_jfif(np.asarray(y), np.asarray(cb), np.asarray(cr),
+                                   h, w, qy, qc, "420")
+    decoded = Image.open(io.BytesIO(jfif))
+    decoded.load()  # force full decode; raises on malformed streams
+    assert decoded.size == (w, h)
+    psnr = _psnr(np.asarray(decoded.convert("RGB")), img)
+    assert psnr > pil_psnr - 1.0, f"psnr {psnr:.1f} vs PIL {pil_psnr:.1f} at q{quality}"
+    # and our stream must not be grossly larger than libjpeg's
+    assert len(jfif) < buf.tell() * 1.2
+
+
+def test_jpeg_pil_decodes_444():
+    h, w = 40, 56
+    img = _test_image(h, w, seed=7)
+    qy = J.scale_qtable(J.STD_LUMA_QUANT, 85)
+    qc = J.scale_qtable(J.STD_CHROMA_QUANT, 85)
+    import jax.numpy as jnp
+    y, cb, cr = jpeg_forward_444(jnp.asarray(img), jnp.asarray(qy), jnp.asarray(qc))
+    jfif = J.encode_coeffs_to_jfif(np.asarray(y), np.asarray(cb), np.asarray(cr),
+                                   h, w, qy, qc, "444")
+    decoded = Image.open(io.BytesIO(jfif))
+    decoded.load()
+    psnr = _psnr(np.asarray(decoded.convert("RGB")), img)
+    assert psnr > 33
+
+
+def test_jpeg_flat_image_tiny():
+    """All-DC image: exercises EOB-only blocks and DC prediction chain."""
+    h, w = 32, 32
+    img = np.full((h, w, 3), 77, dtype=np.uint8)
+    qy = J.scale_qtable(J.STD_LUMA_QUANT, 75)
+    qc = J.scale_qtable(J.STD_CHROMA_QUANT, 75)
+    import jax.numpy as jnp
+    y, cb, cr = jpeg_forward_420(jnp.asarray(img), jnp.asarray(qy), jnp.asarray(qc))
+    jfif = J.encode_coeffs_to_jfif(np.asarray(y), np.asarray(cb), np.asarray(cr),
+                                   h, w, qy, qc, "420")
+    decoded = np.asarray(Image.open(io.BytesIO(jfif)).convert("RGB"))
+    assert np.abs(decoded.astype(int) - 77).max() <= 3
+    # flat image must compress tiny (headers dominate)
+    assert len(jfif) < 1200
+
+
+def test_jpeg_noise_stress():
+    """Worst-case content: every AC coefficient populated, ZRL paths hit."""
+    rng = np.random.default_rng(11)
+    h, w = 32, 48
+    img = rng.integers(0, 255, (h, w, 3)).astype(np.uint8)
+    qy = J.scale_qtable(J.STD_LUMA_QUANT, 95)
+    qc = J.scale_qtable(J.STD_CHROMA_QUANT, 95)
+    import jax.numpy as jnp
+    y, cb, cr = jpeg_forward_420(jnp.asarray(img), jnp.asarray(qy), jnp.asarray(qc))
+    jfif = J.encode_coeffs_to_jfif(np.asarray(y), np.asarray(cb), np.asarray(cr),
+                                   h, w, qy, qc, "420")
+    Image.open(io.BytesIO(jfif)).load()  # must parse cleanly
+
+
+def test_quality_scaling_monotonic():
+    t50 = J.scale_qtable(J.STD_LUMA_QUANT, 50)
+    np.testing.assert_array_equal(t50, J.STD_LUMA_QUANT)
+    t90 = J.scale_qtable(J.STD_LUMA_QUANT, 90)
+    t10 = J.scale_qtable(J.STD_LUMA_QUANT, 10)
+    assert (t90 <= t50).all() and (t10 >= t50).all()
+    assert J.scale_qtable(J.STD_LUMA_QUANT, 100).min() == 1
