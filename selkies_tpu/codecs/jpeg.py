@@ -301,6 +301,12 @@ def encode_scan(y_zz: np.ndarray, cb_zz: np.ndarray, cr_zz: np.ndarray,
     return _pack_bits(payloads[order], nbits[order])
 
 
+def stuff_ff_bytes(raw: np.ndarray) -> bytes:
+    """JPEG 0xFF byte stuffing (0xFF -> 0xFF 0x00) over a uint8 array."""
+    ff = np.flatnonzero(raw == 0xFF)
+    return (np.insert(raw, ff + 1, 0) if len(ff) else raw).tobytes()
+
+
 # --- JFIF container --------------------------------------------------------
 
 def _marker(tag: int, payload: bytes) -> bytes:

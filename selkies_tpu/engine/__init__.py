@@ -1,0 +1,13 @@
+"""tpuflux — the capture+encode engine (pixelflux-equivalent).
+
+Mirrors the runtime API surface the reference's Python layer consumes from
+the Rust ``pixelflux`` wheel (SURVEY.md §2.2): ``ScreenCapture`` with
+``start_capture(callback, CaptureSettings)`` / ``stop_capture`` /
+``update_tunables`` / ``update_video_bitrate`` / ``update_framerate`` /
+``request_idr_frame`` / ``update_capture_region`` / ``set_cursor_callback``
+/ ``is_capturing`` — but the encode plane is JAX on TPU instead of
+NVENC/VA-API/x264.
+"""
+
+from .types import CaptureSettings, EncodedChunk  # noqa: F401
+from .capture import ScreenCapture  # noqa: F401

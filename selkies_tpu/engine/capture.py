@@ -1,0 +1,206 @@
+"""ScreenCapture: the engine front door, API-compatible with the surface the
+reference's Python layer consumes from pixelflux (SURVEY.md §2.2).
+
+Threading model mirrors the reference: a native-side capture/encode thread
+invokes the Python callback per encoded chunk, and the server hops results
+onto the asyncio loop with ``call_soon_threadsafe`` (reference
+selkies.py:4208-4294). Here the "native side" is a Python thread driving the
+TPU: device work is dispatched asynchronously and readbacks are pipelined
+``PIPELINE_DEPTH`` frames deep, so the host-link RTT costs latency, never
+throughput.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .encoder import JpegEncoderSession
+from .sources import FrameSource, make_source
+from .types import CaptureSettings, EncodedChunk
+
+logger = logging.getLogger("selkies_tpu.engine.capture")
+
+#: frames in flight between device dispatch and host finalize. Deep enough
+#: to hide one host-link RTT at 60 fps; shallow enough to keep glass-to-glass
+#: latency bounded.
+PIPELINE_DEPTH = 3
+
+
+@functools.cache
+def _padder(src_h: int, src_w: int, dst_h: int, dst_w: int):
+    def pad(frame):
+        return jnp.pad(frame, ((0, dst_h - src_h), (0, dst_w - src_w), (0, 0)))
+    return jax.jit(pad)
+
+
+class ScreenCapture:
+    """One capture+encode instance per display (persistent across client
+    reconnects — the warm-encoder behaviour of reference
+    ``_persistent_capture_modules``, selkies.py:940-946)."""
+
+    def __init__(self, source_kind: str = "auto"):
+        self._source_kind = source_kind
+        self._thread: Optional[threading.Thread] = None
+        self._running = threading.Event()
+        self._settings: Optional[CaptureSettings] = None
+        self._session: Optional[JpegEncoderSession] = None
+        self._source: Optional[FrameSource] = None
+        self._callback: Optional[Callable[[EncodedChunk], None]] = None
+        self._cursor_callback = None
+        self._force_idr = threading.Event()
+        self._lock = threading.Lock()
+        self._tunables_dirty: dict = {}
+        # stats for rate control / observability
+        self.last_frame_bytes = 0
+        self.encoded_fps = 0.0
+
+    # -- reference API surface ----------------------------------------------
+    def start_capture(self, callback: Callable[[EncodedChunk], None],
+                      settings: CaptureSettings) -> None:
+        """Start (or live-reconfigure, reference media_pipeline.py:580-590)
+        the capture/encode loop."""
+        if self.is_capturing():
+            self.stop_capture()
+        self._callback = callback
+        self._settings = settings
+        self._session = JpegEncoderSession(settings)
+        self._source = make_source(self._source_kind,
+                                   settings.capture_width,
+                                   settings.capture_height,
+                                   settings.display_id)
+        self._running.set()
+        self._thread = threading.Thread(target=self._run, name="tpuflux-capture",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop_capture(self) -> None:
+        self._running.clear()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self._source is not None:
+            self._source.close()
+            self._source = None
+
+    def is_capturing(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def request_idr_frame(self) -> None:
+        """JPEG stripes are always intra; a keyframe request means 'resend
+        every stripe' (chain-gating recovery, reference selkies.py:600-627)."""
+        self._force_idr.set()
+
+    def update_framerate(self, fps: float) -> None:
+        with self._lock:
+            self._tunables_dirty["target_fps"] = float(fps)
+
+    def update_video_bitrate(self, kbps: int) -> None:
+        with self._lock:
+            self._tunables_dirty["video_bitrate_kbps"] = int(kbps)
+
+    def update_tunables(self, **kw) -> None:
+        with self._lock:
+            self._tunables_dirty.update(kw)
+
+    def update_capture_region(self, x: int, y: int, w: int, h: int) -> None:
+        # live region retarget (reference pixelflux x11 path); requires a
+        # session rebuild when the size changes.
+        assert self._settings is not None
+        self._settings.capture_x, self._settings.capture_y = x, y
+        if (w, h) != (self._settings.capture_width, self._settings.capture_height):
+            self._settings.capture_width, self._settings.capture_height = w, h
+            if self._callback is not None:
+                self.start_capture(self._callback, self._settings)
+
+    def set_cursor_callback(self, cb) -> None:
+        self._cursor_callback = cb
+
+    # -- loop ----------------------------------------------------------------
+    def _apply_tunables(self) -> None:
+        with self._lock:
+            dirty, self._tunables_dirty = self._tunables_dirty, {}
+        if not dirty or self._settings is None or self._session is None:
+            return
+        for k, v in dirty.items():
+            if hasattr(self._settings, k):
+                setattr(self._settings, k, v)
+        if "jpeg_quality" in dirty or "paint_over_quality" in dirty:
+            self._session.update_quality(self._settings.jpeg_quality,
+                                         self._settings.paint_over_quality)
+
+    def _rate_control(self, window_bytes: int, window_s: float) -> None:
+        """Crude CBR steering for the JPEG path: nudge quality toward the
+        bitrate target (the H.264 path gets true QP rate control)."""
+        s, sess = self._settings, self._session
+        if s is None or sess is None or not s.use_cbr or window_s <= 0:
+            return
+        actual_kbps = window_bytes * 8 / 1000 / window_s
+        q = s.jpeg_quality
+        if actual_kbps > s.video_bitrate_kbps * 1.15 and q > 10:
+            sess.update_quality(max(10, q - 5), s.paint_over_quality)
+        elif actual_kbps < s.video_bitrate_kbps * 0.7 and q < 90:
+            sess.update_quality(min(90, q + 5), s.paint_over_quality)
+
+    def _run(self) -> None:
+        assert self._settings and self._session and self._source
+        s, sess, src = self._settings, self._session, self._source
+        g = sess.grid
+        pad = None
+        if (src.height, src.width) != (g.height, g.width):
+            pad = _padder(src.height, src.width, g.height, g.width)
+        inflight: collections.deque = collections.deque()
+        tick = 0
+        window_bytes, window_start = 0, time.monotonic()
+        fps_frames = 0
+        try:
+            while self._running.is_set():
+                t0 = time.monotonic()
+                self._apply_tunables()
+                frame = src.get_frame(tick)
+                if pad is not None:
+                    frame = pad(frame)
+                out = sess.encode(frame)
+                out["force"] = self._force_idr.is_set()
+                if out["force"]:
+                    self._force_idr.clear()
+                inflight.append(out)
+                if len(inflight) > PIPELINE_DEPTH:
+                    window_bytes += self._deliver(inflight.popleft())
+                tick += 1
+                fps_frames += 1
+                now = time.monotonic()
+                if now - window_start >= 1.0:
+                    self._rate_control(window_bytes, now - window_start)
+                    self.encoded_fps = fps_frames / (now - window_start)
+                    window_bytes, window_start, fps_frames = 0, now, 0
+                # pace to target fps
+                period = 1.0 / max(s.target_fps, 1.0)
+                sleep = period - (time.monotonic() - t0)
+                if sleep > 0:
+                    time.sleep(sleep)
+            while inflight:  # drain
+                self._deliver(inflight.popleft())
+        except Exception:
+            logger.exception("capture loop died")
+        finally:
+            self._running.clear()
+
+    def _deliver(self, out: dict) -> int:
+        assert self._session is not None
+        chunks = self._session.finalize(out, force_all=out.get("force", False))
+        nbytes = 0
+        cb = self._callback
+        for c in chunks:
+            nbytes += len(c.payload)
+            if cb is not None:
+                cb(c)
+        self.last_frame_bytes = nbytes
+        return nbytes

@@ -1,0 +1,220 @@
+"""JPEG stripe-encoder session: the device-resident encode step + host tail.
+
+One ``JpegEncoderSession`` owns everything needed to turn device-resident
+RGB frames into wire-ready JFIF stripes:
+
+- a jitted, donated device step that (per frame, entirely on TPU):
+  stripes the frame, diffs it against the previous frame for damage gating,
+  advances the paint-over age state, selects motion vs paint-over quant
+  tables per stripe, runs CSC + DCT + quant + Huffman bit-packing
+  (ops/jpeg_pipeline + ops/jpeg_entropy), and byte-packs every stripe's
+  scan into ONE fixed-capacity output buffer (ops/stripes);
+- a host tail that slices the buffer, 0xFF-stuffs each scan, wraps JFIF
+  headers, and emits :class:`EncodedChunk`s.
+
+Damage gating and paint-over mirror the reference's knobs
+(settings.py:560-585, SURVEY.md §2.2): unchanged stripes are not sent;
+after ``paint_over_delay_frames`` static frames a stripe is re-sent once at
+``paint_over_quality``. The decision lives ON DEVICE (carried state), so the
+host never round-trips mid-frame.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..codecs import jpeg as jtab
+from ..codecs.jpeg import stuff_ff_bytes
+from ..ops.stripes import concat_stripe_bytes, words_to_bytes_device
+from .types import CaptureSettings, EncodedChunk
+
+logger = logging.getLogger("selkies_tpu.engine.encoder")
+
+
+def _round_up(v: int, m: int) -> int:
+    return (v + m - 1) // m * m
+
+
+@dataclasses.dataclass
+class _Grid:
+    width: int              # padded width
+    height: int             # padded height
+    stripe_h: int
+    n_stripes: int
+    out_w: int              # visible (unpadded) width
+    out_h: int
+
+
+def _plan_grid(s: CaptureSettings) -> _Grid:
+    block = 8 if s.fullcolor else 16
+    stripe_h = max(block, _round_up(s.stripe_height, block))
+    w = _round_up(s.capture_width, block)
+    h = _round_up(s.capture_height, stripe_h)
+    return _Grid(width=w, height=h, stripe_h=stripe_h,
+                 n_stripes=h // stripe_h,
+                 out_w=s.capture_width, out_h=s.capture_height)
+
+
+@functools.cache
+def _jitted_step(width: int, stripe_h: int, n_stripes: int, subsampling: str,
+                 e_cap: int, w_cap: int, out_cap: int, paint_delay: int,
+                 damage_gating: bool, paint_over: bool):
+    """Build the per-shape compiled encode step.
+
+    Signature: step(frame u8 (H,W,3), prev u8 (H,W,3), age i32 (S,),
+                    qy_motion/qc_motion/qy_paint/qc_paint f32 (64,))
+    -> (data u8 (out_cap,), byte_lens i32 (S,), send bool (S,),
+        is_paint bool (S,), age i32 (S,), overflow bool)
+
+    Only the internal ``age`` state is donated; ``prev`` is the caller's
+    previous frame array and sources are free to reuse their buffers.
+    """
+    from ..ops.jpeg_pipeline import jpeg_encode_device
+
+    def encode_stripe(stripe, qy, qc):
+        return jpeg_encode_device(stripe, qy, qc, subsampling=subsampling,
+                                  e_cap=e_cap, w_cap=w_cap)
+
+    def step(frame, prev, age, qy_m, qc_m, qy_p, qc_p):
+        s = n_stripes
+        stripes = frame.reshape(s, stripe_h, width, 3)
+        if damage_gating:
+            prev_s = prev.reshape(s, stripe_h, width, 3)
+            damage = jnp.any(stripes != prev_s, axis=(1, 2, 3))
+        else:
+            damage = jnp.ones((s,), bool)
+        age = jnp.where(damage, 0, age + 1)
+        if paint_over and paint_delay > 0:
+            is_paint = age == paint_delay
+        else:
+            is_paint = jnp.zeros((s,), bool)
+        send = damage | is_paint
+        qy = jnp.where(is_paint[:, None], qy_p[None, :], qy_m[None, :])
+        qc = jnp.where(is_paint[:, None], qc_p[None, :], qc_m[None, :])
+        packed = jax.vmap(encode_stripe)(stripes, qy, qc)
+        sbytes, slens = words_to_bytes_device(packed.words, packed.total_bits)
+        buf = concat_stripe_bytes(sbytes, slens, out_cap)
+        overflow = jnp.any(packed.overflow) | buf.overflow
+        return buf.data, buf.byte_lens, send, is_paint, age, overflow
+
+    return jax.jit(step, donate_argnums=(2,))
+
+
+class JpegEncoderSession:
+    """Per-display encoder session (kept warm across client reconnects, like
+    the reference's ``_persistent_capture_modules``, selkies.py:940-946)."""
+
+    def __init__(self, settings: CaptureSettings):
+        self.settings = settings
+        self.grid = _plan_grid(settings)
+        self.subsampling = "444" if settings.fullcolor else "420"
+        g = self.grid
+        stripe_px = g.stripe_h * g.width
+        # e_cap is the TRUE worst case (one event per coefficient slot:
+        # 1.5x pixels for 4:2:0, 3x for 4:4:4) so event overflow is
+        # impossible; only the word/output buffers can overflow, and those
+        # are growable. HBM is cheap; the transferred buffer is the tight one.
+        self._e_cap = stripe_px * (3 if settings.fullcolor else 2)
+        self._w_cap = stripe_px // 2
+        self._out_cap = max(256 * 1024, stripe_px * g.n_stripes // 8)
+        self._step = self._build_step()
+        self.frame_id = 0
+        self._age = jnp.zeros((g.n_stripes,), jnp.int32)
+        self._prev = jnp.zeros((g.height, g.width, 3), jnp.uint8)
+        self.update_quality(settings.jpeg_quality, settings.paint_over_quality)
+
+    def _build_step(self):
+        g, s = self.grid, self.settings
+        return _jitted_step(g.width, g.stripe_h, g.n_stripes,
+                            self.subsampling, self._e_cap, self._w_cap,
+                            self._out_cap, s.paint_over_delay_frames,
+                            s.use_damage_gating, s.use_paint_over)
+
+    @property
+    def visible_size(self) -> tuple[int, int]:
+        """(width, height) the client should display; encode dims are
+        block-padded beyond this and cropped client-side."""
+        return self.grid.out_w, self.grid.out_h
+
+    # -- live tunables ------------------------------------------------------
+    def update_quality(self, motion_q: int, paint_q: int | None = None):
+        self.settings.jpeg_quality = int(motion_q)
+        if paint_q is not None:
+            self.settings.paint_over_quality = int(paint_q)
+        self._qy_m_np = jtab.scale_qtable(jtab.STD_LUMA_QUANT, self.settings.jpeg_quality)
+        self._qc_m_np = jtab.scale_qtable(jtab.STD_CHROMA_QUANT, self.settings.jpeg_quality)
+        self._qy_p_np = jtab.scale_qtable(jtab.STD_LUMA_QUANT, self.settings.paint_over_quality)
+        self._qc_p_np = jtab.scale_qtable(jtab.STD_CHROMA_QUANT, self.settings.paint_over_quality)
+        self._qy_m = jnp.asarray(self._qy_m_np, jnp.float32)
+        self._qc_m = jnp.asarray(self._qc_m_np, jnp.float32)
+        self._qy_p = jnp.asarray(self._qy_p_np, jnp.float32)
+        self._qc_p = jnp.asarray(self._qc_p_np, jnp.float32)
+
+    # -- device step --------------------------------------------------------
+    def encode(self, frame: jnp.ndarray) -> dict[str, Any]:
+        """Dispatch one encode step (non-blocking). ``frame`` must be a
+        device array of shape (grid.height, grid.width, 3) uint8."""
+        data, lens, send, is_paint, age, overflow = self._step(
+            frame, self._prev, self._age,
+            self._qy_m, self._qc_m, self._qy_p, self._qc_p)
+        self._prev = frame
+        self._age = age
+        fid = self.frame_id
+        self.frame_id = (self.frame_id + 1) & 0xFFFF
+        # kick off async readbacks so the consumer doesn't eat the RTT
+        for arr in (data, lens, send, is_paint, overflow):
+            try:
+                arr.copy_to_host_async()
+            except Exception:  # interpret/CPU backends may not support it
+                pass
+        return {"data": data, "lens": lens, "send": send,
+                "is_paint": is_paint, "overflow": overflow, "frame_id": fid}
+
+    # -- host tail ----------------------------------------------------------
+    def _jfif_wrap(self, scan: bytes, paint: bool) -> bytes:
+        g = self.grid
+        qy = self._qy_p_np if paint else self._qy_m_np
+        qc = self._qc_p_np if paint else self._qc_m_np
+        return jtab.assemble_jfif(g.stripe_h, g.width, scan, qy, qc,
+                                  self.subsampling)
+
+    def finalize(self, out: dict[str, Any], force_all: bool = False
+                 ) -> list[EncodedChunk]:
+        """Blocks on the async readback and produces wire-ready chunks."""
+        g = self.grid
+        if bool(np.asarray(out["overflow"])):
+            logger.warning("encoder overflow at frame %d; raising capacity",
+                           out["frame_id"])
+            # Event overflow is impossible (e_cap is worst-case), so this is
+            # a word/output buffer overflow: drop the frame, double the
+            # growable buffers, recompile once.
+            self._w_cap *= 2
+            self._out_cap *= 2
+            self._step = self._build_step()
+            return []
+        data = np.asarray(out["data"])
+        lens = np.asarray(out["lens"])
+        send = np.asarray(out["send"])
+        is_paint = np.asarray(out["is_paint"])
+        starts = np.concatenate([[0], np.cumsum(lens)])
+        chunks: list[EncodedChunk] = []
+        for i in range(g.n_stripes):
+            if not (force_all or send[i]):
+                continue
+            raw = data[starts[i]:starts[i] + lens[i]]
+            scan = stuff_ff_bytes(raw)
+            chunks.append(EncodedChunk(
+                payload=self._jfif_wrap(scan, paint=bool(is_paint[i])),
+                frame_id=out["frame_id"], stripe_y=i * g.stripe_h,
+                width=g.width, height=g.stripe_h, is_idr=True,
+                output_mode="jpeg",
+                seat_index=self.settings.seat_index,
+                display_id=self.settings.display_id))
+        return chunks

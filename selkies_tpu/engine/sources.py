@@ -1,0 +1,181 @@
+"""Frame sources: where pixels come from.
+
+The reference captures X11 via XSHM/XDamage or Wayland via its own
+compositor inside the Rust pixelflux wheel (SURVEY.md §2.2). Here a source
+is anything that yields device-resident ``(H, W, 3) uint8`` frames:
+
+- :class:`SyntheticSource` — an animated test pattern generated *on device*
+  (no host->device upload at all); drives tests, the fake-encoder vertical
+  slice (SURVEY.md §7 step 2), and the benchmark.
+- :class:`ArraySource` — host numpy frames (screenshots, video files,
+  shared-memory screen grabs) uploaded via ``device_put``.
+- :class:`X11Source` — live X11 capture through libX11/XShm (ctypes; no
+  X server in CI, so it degrades to unavailable exactly like the
+  reference's degraded-import path, selkies.py:148-189).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import functools
+import logging
+from typing import Protocol
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger("selkies_tpu.engine.sources")
+
+
+class FrameSource(Protocol):
+    width: int
+    height: int
+
+    def get_frame(self, tick: int) -> jnp.ndarray:
+        """Return the current frame as a device (H, W, 3) uint8 array."""
+        ...
+
+    def close(self) -> None:
+        ...
+
+
+@functools.cache
+def _synthetic_fn(height: int, width: int):
+    """Jitted test-pattern generator: gradient + moving bars + a bouncing
+    block, all computed on device from the tick index."""
+
+    def gen(tick):
+        yy = jax.lax.broadcasted_iota(jnp.int32, (height, width), 0)
+        xx = jax.lax.broadcasted_iota(jnp.int32, (height, width), 1)
+        r = (xx * 255) // width
+        g = (yy * 255) // height
+        b = (xx + yy + tick * 3) & 0xFF
+        # moving vertical bar (hard edge -> exercises AC coding)
+        bar_x = (tick * 7) % width
+        in_bar = (xx >= bar_x) & (xx < bar_x + 32)
+        # bouncing block
+        per_h = jnp.maximum(height - 96, 1)
+        by = jnp.abs((tick * 5) % (2 * per_h) - per_h)
+        in_block = (yy >= by) & (yy < by + 96) & (xx >= 64) & (xx < 224)
+        r = jnp.where(in_bar, 255, jnp.where(in_block, 30, r))
+        g = jnp.where(in_bar, 255, jnp.where(in_block, 220, g))
+        b = jnp.where(in_bar, 255, jnp.where(in_block, 60, b))
+        return jnp.stack([r, g, b], axis=-1).astype(jnp.uint8)
+
+    return jax.jit(gen)
+
+
+class SyntheticSource:
+    """Device-generated animated pattern; ``static_after`` freezes motion to
+    exercise damage gating / paint-over."""
+
+    def __init__(self, width: int, height: int, static_after: int | None = None):
+        self.width, self.height = width, height
+        self.static_after = static_after
+        self._fn = _synthetic_fn(height, width)
+
+    def get_frame(self, tick: int) -> jnp.ndarray:
+        if self.static_after is not None:
+            tick = min(tick, self.static_after)
+        return self._fn(jnp.int32(tick))
+
+    def close(self) -> None:
+        pass
+
+
+class ArraySource:
+    """Wraps host frames; replays a list cyclically."""
+
+    def __init__(self, frames: list[np.ndarray]):
+        if not frames:
+            raise ValueError("need at least one frame")
+        self.height, self.width = frames[0].shape[:2]
+        self._frames = [jax.device_put(np.ascontiguousarray(f)) for f in frames]
+
+    def get_frame(self, tick: int) -> jnp.ndarray:
+        return self._frames[tick % len(self._frames)]
+
+    def close(self) -> None:
+        self._frames.clear()
+
+
+class X11Source:
+    """Live X11 screen capture via libX11 XGetImage (ctypes).
+
+    XSHM would avoid one copy but needs header structs; XGetImage is enough
+    for a first real-desktop path and is still far from the bottleneck (the
+    host->device upload is). Raises ``RuntimeError`` when no display is
+    reachable; callers degrade like the reference does when pixelflux is
+    missing (selkies.py:177-189).
+    """
+
+    def __init__(self, display: str = ":0", width: int | None = None,
+                 height: int | None = None, x: int = 0, y: int = 0):
+        lib = ctypes.util.find_library("X11")
+        if lib is None:
+            raise RuntimeError("libX11 not found")
+        self._x = ctypes.CDLL(lib)
+        self._x.XOpenDisplay.restype = ctypes.c_void_p
+        self._x.XGetImage.restype = ctypes.c_void_p
+        self._dpy = self._x.XOpenDisplay(display.encode())
+        if not self._dpy:
+            raise RuntimeError(f"cannot open X display {display}")
+        self._x.XDefaultRootWindow.restype = ctypes.c_ulong
+        self._root = self._x.XDefaultRootWindow(ctypes.c_void_p(self._dpy))
+        scr = self._x.XDefaultScreen(ctypes.c_void_p(self._dpy))
+        self.width = width or self._x.XDisplayWidth(ctypes.c_void_p(self._dpy), scr)
+        self.height = height or self._x.XDisplayHeight(ctypes.c_void_p(self._dpy), scr)
+        self._ox, self._oy = x, y
+
+    def get_frame(self, tick: int) -> jnp.ndarray:
+        ZPixmap = 2
+        img_p = self._x.XGetImage(
+            ctypes.c_void_p(self._dpy), ctypes.c_ulong(self._root),
+            self._ox, self._oy, self.width, self.height,
+            ctypes.c_ulong(0xFFFFFFFF), ZPixmap)
+        if not img_p:
+            raise RuntimeError("XGetImage failed")
+
+        class _XImage(ctypes.Structure):
+            _fields_ = [("width", ctypes.c_int), ("height", ctypes.c_int),
+                        ("xoffset", ctypes.c_int), ("format", ctypes.c_int),
+                        ("data", ctypes.POINTER(ctypes.c_char)),
+                        ("byte_order", ctypes.c_int),
+                        ("bitmap_unit", ctypes.c_int),
+                        ("bitmap_bit_order", ctypes.c_int),
+                        ("bitmap_pad", ctypes.c_int),
+                        ("depth", ctypes.c_int),
+                        ("bytes_per_line", ctypes.c_int),
+                        ("bits_per_pixel", ctypes.c_int)]
+
+        img = ctypes.cast(img_p, ctypes.POINTER(_XImage)).contents
+        stride = img.bytes_per_line
+        buf = ctypes.string_at(img.data, stride * img.height)
+        arr = np.frombuffer(buf, np.uint8).reshape(img.height, stride // 4, 4)
+        rgb = arr[:, :img.width, [2, 1, 0]]  # BGRX -> RGB
+        self._x.XDestroyImage(ctypes.c_void_p(img_p))
+        return jax.device_put(np.ascontiguousarray(rgb))
+
+    def close(self) -> None:
+        if self._dpy:
+            self._x.XCloseDisplay(ctypes.c_void_p(self._dpy))
+            self._dpy = None
+
+
+def make_source(kind: str, width: int, height: int, display: str = ":0"
+                ) -> FrameSource:
+    """Source factory used by ScreenCapture; 'auto' prefers a live X display
+    and falls back to the synthetic pattern."""
+    if kind == "synthetic":
+        return SyntheticSource(width, height)
+    if kind == "x11":
+        return X11Source(display, width, height)
+    if kind == "auto":
+        try:
+            return X11Source(display, width, height)
+        except (RuntimeError, OSError) as e:
+            logger.info("X11 unavailable (%s); using synthetic source", e)
+            return SyntheticSource(width, height)
+    raise ValueError(f"unknown source kind {kind!r}")

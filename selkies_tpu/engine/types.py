@@ -1,0 +1,73 @@
+"""Engine data types: capture settings and encoded output chunks.
+
+``CaptureSettings`` carries the full knob surface the reference plumbs into
+its native encoder via ``apply_common_capture_settings``
+(reference display_utils.py:1587-1680; field list SURVEY.md §2.2 pixelflux
+row). Field names follow the reference so the Python orchestration layer
+reads the same in both codebases.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class CaptureSettings:
+    # geometry
+    capture_width: int = 1920
+    capture_height: int = 1080
+    capture_x: int = 0
+    capture_y: int = 0
+    target_fps: float = 60.0
+    # output mode: "jpeg" or "h264"
+    output_mode: str = "jpeg"
+    # rate control
+    video_bitrate_kbps: int = 8000
+    video_crf: int = 25
+    use_cbr: bool = False
+    video_min_qp: int = 10
+    video_max_qp: int = 35
+    keyframe_interval_s: float = 10.0
+    # quality / color
+    jpeg_quality: int = 60
+    fullcolor: bool = False          # 4:4:4 instead of 4:2:0
+    # damage gating + paint-over (reference settings.py:560-585)
+    use_damage_gating: bool = True
+    use_paint_over: bool = True
+    paint_over_quality: int = 90
+    paint_over_delay_frames: int = 15
+    # striping (reference striped encoding, SURVEY.md §2.5)
+    stripe_height: int = 64
+    # device placement
+    seat_index: int = 0
+    display_id: str = ":0"
+    # misc parity knobs
+    watermark_path: str = ""
+    watermark_location: int = 6
+    debug_logging: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class EncodedChunk:
+    """One encoded stripe ready for wire framing.
+
+    ``payload`` is the codec bitstream (JFIF bytes for jpeg, Annex-B for
+    h264); the server layer adds the 0x03/0x04 header
+    (protocol.pack_*_stripe). Mirrors the chunk contract of the reference's
+    pixelflux callback (SURVEY.md §2.3 binary framing).
+
+    ``width``/``height`` are the ENCODED (block-padded) stripe dimensions —
+    what the client decoder needs. The visible desktop size travels in the
+    ``server_settings`` payload; the client canvas crops any padding
+    overhang on the right/bottom edges.
+    """
+    payload: bytes
+    frame_id: int
+    stripe_y: int
+    width: int
+    height: int
+    is_idr: bool            # h264: IDR; jpeg: always True (intra)
+    output_mode: str        # "jpeg" | "h264"
+    seat_index: int = 0
+    display_id: str = ":0"

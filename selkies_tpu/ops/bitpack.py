@@ -1,0 +1,150 @@
+"""Device-side variable-length bit packing (the entropy-coding back half).
+
+Classic wisdom says entropy coding is "inherently serial" and must live on
+the host (SURVEY.md §7 hard-part #1). That is true of the *per-symbol
+decision* structure of CABAC, but Huffman/CAVLC-style prefix codes are a
+pure data-parallel problem once reframed:
+
+1. every (block, slot) position independently computes its codeword
+   ``payload`` (LSB-aligned) and bit length ``nbits`` (0 = no event);
+2. stream offsets are exclusive prefix sums of ``nbits`` — a cumsum;
+3. each output 32-bit word gathers the <=17 events that overlap it
+   (every event is <=32 bits, so it spans at most 2 words).
+
+Everything is static-shaped jnp (cumsum / small argsort / searchsorted /
+gather) and runs entirely on TPU; only the final ``W_cap``-word buffer plus
+two scalars cross PCIe/ICI. This kills the 8-12 MB/frame coefficient
+readback a host entropy coder would need — the bitstream leaves the chip at
+bitrate size (~16 KB/frame at 8 Mbps).
+
+Used by the JPEG Huffman encoder (ops/jpeg_entropy.py) and the H.264 CAVLC
+encoder; the reference's equivalent work happens inside the closed-source
+Rust pixelflux wheel (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# An event is at most 27 bits (JPEG: 16-bit Huffman code + 11 value bits;
+# CAVLC codes are <=28), so one event overlaps at most 2 output words and a
+# 32-bit word overlaps at most ceil(32/min_event_bits)+1 events. The JPEG
+# minimum event is 2 bits (luma DC cat 0 would be 2; chroma EOB 2) ->
+# 16 starts + 1 spanning head = 17.
+MAX_EVENTS_PER_WORD = 17
+
+
+class PackedStream(NamedTuple):
+    words: jnp.ndarray       # (W_cap,) uint32, MSB-first bit order
+    total_bits: jnp.ndarray  # () int32
+    n_events: jnp.ndarray    # () int32
+    overflow: jnp.ndarray    # () bool — event or word capacity exceeded
+
+
+def bit_category(v: jnp.ndarray, max_cat: int = 11) -> jnp.ndarray:
+    """JPEG/JFIF 'size' of a value: bits in |v| (0 for 0), exact in ints."""
+    mag = jnp.abs(v.astype(jnp.int32))
+    cat = jnp.zeros_like(mag)
+    for b in range(max_cat):
+        cat = cat + (mag >= (1 << b)).astype(jnp.int32)
+    return cat
+
+
+def value_bits(v: jnp.ndarray, cat: jnp.ndarray) -> jnp.ndarray:
+    """Signed-magnitude value bits: v if v>=0 else v-1, masked to cat bits."""
+    raw = jnp.where(v >= 0, v, v - 1).astype(jnp.int32)
+    mask = (jnp.left_shift(jnp.int32(1), cat) - 1).astype(jnp.int32)
+    return jnp.bitwise_and(raw, mask).astype(jnp.uint32)
+
+
+def pack_slot_events(payload: jnp.ndarray, nbits: jnp.ndarray,
+                     e_cap: int, w_cap: int,
+                     max_events_per_word: int = MAX_EVENTS_PER_WORD
+                     ) -> PackedStream:
+    """Pack per-slot events into a contiguous MSB-first bitstream on device.
+
+    ``payload``: (M, S) uint32, codeword bits LSB-aligned.
+    ``nbits``:   (M, S) int32, 0..31; 0 marks an inactive slot. Slot order
+                 (row-major) IS stream order.
+    ``e_cap``:   static max active events materialised (overflow flagged).
+    ``w_cap``:   static output capacity in 32-bit words.
+    ``max_events_per_word``: ceil(32 / min event bits) + 1 — 17 for JPEG
+                 (min 2-bit codes), 33 for codes that can be 1 bit (CAVLC).
+    """
+    m, s = payload.shape
+    active = nbits > 0
+    nbits = nbits.astype(jnp.int32)
+
+    # --- per-block (row) offsets and front-packing -------------------------
+    intra_off = jnp.cumsum(nbits, axis=1) - nbits          # exclusive cumsum
+    block_bits = jnp.sum(nbits, axis=1)                    # (M,)
+    slot_idx = jax.lax.broadcasted_iota(jnp.int32, (m, s), 1)
+    order = jnp.argsort(jnp.where(active, slot_idx, s + slot_idx), axis=1)
+    pay_p = jnp.take_along_axis(payload, order, axis=1)
+    nb_p = jnp.take_along_axis(nbits, order, axis=1)
+    ioff_p = jnp.take_along_axis(intra_off, order, axis=1)
+
+    # --- global offsets ----------------------------------------------------
+    block_start_bits = jnp.cumsum(block_bits) - block_bits      # (M,)
+    total_bits = jnp.sum(block_bits).astype(jnp.int32)
+    c_b = jnp.sum(active.astype(jnp.int32), axis=1)             # events/blk
+    block_start_evt = jnp.cumsum(c_b) - c_b
+    n_events = jnp.sum(c_b).astype(jnp.int32)
+
+    # --- compaction gather: global event index -> (block, slot) ------------
+    e_idx = jnp.arange(e_cap, dtype=jnp.int32)
+    b = jnp.clip(
+        jnp.searchsorted(block_start_evt, e_idx, side="right") - 1, 0, m - 1
+    ).astype(jnp.int32)
+    slot = e_idx - block_start_evt[b]
+    in_range = (e_idx < n_events) & (slot < s)
+    slot = jnp.clip(slot, 0, s - 1)
+    pay_g = jnp.where(in_range, pay_p[b, slot], 0).astype(jnp.uint32)
+    nb_g = jnp.where(in_range, nb_p[b, slot], 0)
+    # sentinel offsets keep searchsorted monotone past the last event
+    off_g = jnp.where(in_range, block_start_bits[b] + ioff_p[b, slot],
+                      total_bits + (e_idx - n_events))
+
+    # --- word materialisation ---------------------------------------------
+    w_idx = jnp.arange(w_cap, dtype=jnp.int32)
+    ws = w_idx * 32
+    s0 = jnp.clip(jnp.searchsorted(off_g, ws, side="right") - 1, 0, e_cap - 1)
+
+    word = jnp.zeros((w_cap,), dtype=jnp.uint32)
+    for k in range(max_events_per_word):
+        e = jnp.clip(s0 + k, 0, e_cap - 1)
+        rel = off_g[e] - ws                       # event start within word
+        nb = nb_g[e]
+        end_rel = rel + nb
+        valid = (nb > 0) & (rel < 32) & (end_rel > 0)
+        sh = 32 - end_rel
+        pay = pay_g[e]
+        left = jnp.left_shift(pay, jnp.clip(sh, 0, 31).astype(jnp.uint32))
+        right = jnp.right_shift(pay, jnp.clip(-sh, 0, 31).astype(jnp.uint32))
+        contrib = jnp.where(sh >= 0, left, right)
+        word = jnp.bitwise_or(word, jnp.where(valid, contrib, 0))
+
+    overflow = (n_events > e_cap) | (total_bits > w_cap * 32)
+    return PackedStream(word, total_bits, n_events, overflow)
+
+
+def words_to_bytes(words, total_bits: int, pad_ones: bool = True) -> bytes:
+    """Host-side: trim the word buffer to the bitstream length.
+
+    ``words`` is the (W_cap,) uint32 array (host numpy). Pad bits in the
+    final byte are set to 1 (JPEG convention) unless ``pad_ones=False``
+    (H.264 rbsp_trailing uses an explicit stop bit instead).
+    """
+    import numpy as np
+
+    total_bits = int(total_bits)
+    nbytes = (total_bits + 7) // 8
+    raw = np.ascontiguousarray(np.asarray(words, dtype=np.uint32)).astype(">u4")
+    by = np.frombuffer(raw.tobytes(), dtype=np.uint8)[:nbytes].copy()
+    rem = total_bits % 8
+    if rem and pad_ones:
+        by[-1] |= (1 << (8 - rem)) - 1
+    return by.tobytes()

@@ -1,0 +1,174 @@
+"""On-device JPEG Huffman entropy coding.
+
+Turns the quantised zigzag coefficients (still in HBM) into the final
+entropy-coded scan bitstream *on the TPU*, using the slot-event reframing
+from :mod:`selkies_tpu.ops.bitpack`:
+
+Every (block, zigzag-slot) pair emits at most one codeword, decidable
+locally from per-row cumulative statistics — slot order is exactly JPEG
+stream order:
+
+- slot 0: the DC codeword (category + value bits), differential against the
+  previous same-component block via a precomputed static gather index;
+- a nonzero AC slot: the (run%16, size) codeword + value bits;
+- a zero AC slot that is the 16th/32nd/48th consecutive zero with a later
+  nonzero in the block: a ZRL (0xF0) codeword;
+- slot 63 when the last AC nonzero sits before it: the EOB codeword.
+
+The only cross-block dependency (DC prediction) is a gather; the only
+cross-event dependency (bit offsets) is a cumsum. No Python/host work
+remains on the hot path except trimming the word buffer and 0xFF-stuffing
+at bitrate-sized cost.
+
+Reference equivalent: entropy coding inside the Rust pixelflux wheel
+(SURVEY.md §2.2); the reframing itself is original to this framework.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..codecs import jpeg as jtab
+from .bitpack import PackedStream, bit_category, pack_slot_events, value_bits
+
+
+class ScanLayout(NamedTuple):
+    """Static per-(shape, subsampling) gather maps, device-resident."""
+    comp: np.ndarray        # (M,) 0=Y 1=Cb 2=Cr in scan order
+    gather: np.ndarray      # (M,) block index into the comp's plane array
+    prev_same: np.ndarray   # (M,) scan index of previous same-comp block, -1
+
+    @property
+    def m(self) -> int:
+        return len(self.comp)
+
+
+@functools.cache
+def scan_layout(blocks_h: int, blocks_w: int, subsampling: str) -> ScanLayout:
+    comp, gather, _ = jtab._mcu_block_order(blocks_h, blocks_w, subsampling)
+    prev_same = np.full(len(comp), -1, dtype=np.int32)
+    last = {0: -1, 1: -1, 2: -1}
+    for i, c in enumerate(comp):
+        prev_same[i] = last[int(c)]
+        last[int(c)] = i
+    return ScanLayout(comp, gather, prev_same)
+
+
+@functools.cache
+def _host_luts() -> dict[str, np.ndarray]:
+    """Huffman LUTs stacked [luma, chroma] (numpy; converted per-trace —
+    caching device arrays here would leak tracers across jit traces)."""
+    out = {}
+    for prefix, kinds in (("dc", ("dc_luma", "dc_chroma")),
+                          ("ac", ("ac_luma", "ac_chroma"))):
+        codes = np.stack([jtab._huff_lut(k)[0] for k in kinds])
+        lens = np.stack([jtab._huff_lut(k)[1].astype(np.int32) for k in kinds])
+        out[prefix + "_code"] = codes.astype(np.uint32)
+        out[prefix + "_len"] = lens
+    return out
+
+
+def _device_luts() -> dict[str, jnp.ndarray]:
+    return {k: jnp.asarray(v) for k, v in _host_luts().items()}
+
+
+def jpeg_entropy_device(y_zz: jnp.ndarray, cb_zz: jnp.ndarray,
+                        cr_zz: jnp.ndarray, layout: ScanLayout,
+                        e_cap: int, w_cap: int) -> PackedStream:
+    """Entropy-code an interleaved scan fully on device.
+
+    Coefficient arrays are (N, 64) int (zigzag order, plane-raster blocks).
+    ``layout`` must come from :func:`scan_layout` for the same shapes.
+    """
+    luts = _device_luts()
+    comp = jnp.asarray(layout.comp)
+    gather = jnp.asarray(layout.gather)
+    prev_same = jnp.asarray(layout.prev_same)
+    is_chroma = (comp != 0).astype(jnp.int32)            # (M,)
+
+    # --- scan-ordered coefficient rows (M, 64) -----------------------------
+    y = y_zz.astype(jnp.int32)
+    cb = cb_zz.astype(jnp.int32)
+    cr = cr_zz.astype(jnp.int32)
+    # component planes can have different lengths; gather per component then
+    # select (XLA fuses the three gathers + where-chain)
+    seq = jnp.where(
+        (comp == 0)[:, None], y[jnp.clip(gather, 0, y.shape[0] - 1)],
+        jnp.where((comp == 1)[:, None], cb[jnp.clip(gather, 0, cb.shape[0] - 1)],
+                  cr[jnp.clip(gather, 0, cr.shape[0] - 1)]))
+
+    m, s = seq.shape
+    pos = jax.lax.broadcasted_iota(jnp.int32, (m, s), 1)
+
+    # --- DC events (slot 0) -------------------------------------------------
+    dc = seq[:, 0]
+    prev_dc = jnp.where(prev_same >= 0, dc[jnp.clip(prev_same, 0, m - 1)], 0)
+    dcdiff = dc - prev_dc
+    dccat = bit_category(dcdiff, max_cat=11)
+    dccode = luts["dc_code"][is_chroma, dccat]
+    dclen = luts["dc_len"][is_chroma, dccat]
+    dcval = value_bits(dcdiff, dccat)
+    dc_payload = jnp.bitwise_or(
+        jnp.left_shift(dccode, dccat.astype(jnp.uint32)), dcval)
+    dc_nbits = dclen + dccat
+
+    # --- AC run-length statistics along the zigzag axis --------------------
+    nz = (seq != 0) & (pos > 0)
+    # last position <= j holding a nonzero AC (0 if none): inclusive cummax
+    nz_pos = jnp.where(nz, pos, 0)
+    incl_cummax = jax.lax.cummax(nz_pos, axis=1)
+    prev_nz_excl = jnp.concatenate(
+        [jnp.zeros((m, 1), jnp.int32), incl_cummax[:, :-1]], axis=1)
+    last_nz = incl_cummax[:, -1:]                         # (M, 1)
+
+    # nonzero AC slots: (run % 16, size) + value bits
+    run_total = pos - prev_nz_excl - 1
+    accat = bit_category(seq, max_cat=10)
+    acsym = jnp.bitwise_and(run_total, 15) * 16 + accat
+    accode = luts["ac_code"][is_chroma[:, None], acsym]
+    aclen = luts["ac_len"][is_chroma[:, None], acsym]
+    acval = value_bits(seq, accat)
+    ac_payload = jnp.bitwise_or(
+        jnp.left_shift(accode, accat.astype(jnp.uint32)), acval)
+    ac_nbits = aclen + accat
+
+    # ZRL slots: the 16th/32nd/48th consecutive zero with a later nonzero
+    zeros_since = pos - prev_nz_excl
+    is_zrl = (~nz) & (pos > 0) & (pos < last_nz) \
+        & (zeros_since > 0) & (jnp.bitwise_and(zeros_since, 15) == 0)
+    zrl_payload = luts["ac_code"][is_chroma, 0xF0][:, None]
+    zrl_nbits = luts["ac_len"][is_chroma, 0xF0][:, None]
+
+    # EOB at slot 63 when the block's AC tail is zero
+    is_eob = (pos == s - 1) & (last_nz < s - 1)
+    eob_payload = luts["ac_code"][is_chroma, 0x00][:, None]
+    eob_nbits = luts["ac_len"][is_chroma, 0x00][:, None]
+
+    payload = jnp.where(
+        pos == 0, dc_payload[:, None],
+        jnp.where(nz, ac_payload,
+                  jnp.where(is_zrl, zrl_payload,
+                            jnp.where(is_eob, eob_payload, 0)))
+    ).astype(jnp.uint32)
+    nbits = jnp.where(
+        pos == 0, dc_nbits[:, None],
+        jnp.where(nz, ac_nbits,
+                  jnp.where(is_zrl, zrl_nbits,
+                            jnp.where(is_eob, eob_nbits, 0))))
+
+    return pack_slot_events(payload, nbits, e_cap=e_cap, w_cap=w_cap)
+
+
+def finalize_scan_bytes(words_host: np.ndarray, total_bits: int) -> bytes:
+    """Host tail: trim, 1-pad, and 0xFF-stuff the device bitstream."""
+    from ..codecs.jpeg import stuff_ff_bytes
+    from .bitpack import words_to_bytes
+
+    by = np.frombuffer(words_to_bytes(words_host, total_bits, pad_ones=True),
+                       dtype=np.uint8)
+    return stuff_ff_bytes(by)

@@ -55,3 +55,29 @@ def jitted_jpeg_forward(subsampling: str = "420"):
     first call per (H, W)."""
     fn = jpeg_forward_420 if subsampling == "420" else jpeg_forward_444
     return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# Full on-device encode: RGB -> entropy-coded scan bitstream in HBM.
+# Only the w_cap-word buffer + two scalars leave the chip (bitrate-sized),
+# which is what makes 1080p60 feasible across a thin host link.
+# ---------------------------------------------------------------------------
+
+def jpeg_encode_device(rgb: jnp.ndarray, qy: jnp.ndarray, qc: jnp.ndarray,
+                       subsampling: str, e_cap: int, w_cap: int):
+    """RGB frame -> PackedStream (scan bits) entirely on device."""
+    from .jpeg_entropy import jpeg_entropy_device, scan_layout
+
+    h, w = rgb.shape[:2]
+    fwd = jpeg_forward_420 if subsampling == "420" else jpeg_forward_444
+    y_zz, cb_zz, cr_zz = fwd(rgb, qy, qc)
+    layout = scan_layout(h // 8, w // 8, subsampling)
+    return jpeg_entropy_device(y_zz, cb_zz, cr_zz, layout,
+                               e_cap=e_cap, w_cap=w_cap)
+
+
+@functools.cache
+def jitted_jpeg_encode(subsampling: str, e_cap: int, w_cap: int):
+    return jax.jit(functools.partial(jpeg_encode_device,
+                                     subsampling=subsampling,
+                                     e_cap=e_cap, w_cap=w_cap))

@@ -1,0 +1,65 @@
+"""Device-side stripe-stream concatenation.
+
+Striped encoding (reference SURVEY.md §2.5: each frame is split into row
+stripes encoded as independent streams) would naively mean one device->host
+readback per stripe per frame. Over a thin host link every readback pays an
+RTT, so instead the per-stripe bitstreams are byte-packed into ONE
+fixed-capacity buffer on device; the host receives a single
+``(out_cap,) uint8`` buffer plus per-stripe byte lengths and slices it.
+
+Each stripe's stream is byte-aligned (JPEG scans and H.264 access units are
+byte strings), so this is a byte-level ragged concat: a searchsorted +
+gather, the same reframing as ops/bitpack.py one level up.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class FrameBuffer(NamedTuple):
+    data: jnp.ndarray       # (out_cap,) uint8 — concatenated stripe bytes
+    byte_lens: jnp.ndarray  # (S,) int32 — per-stripe byte length
+    overflow: jnp.ndarray   # () bool
+
+
+def words_to_bytes_device(words: jnp.ndarray, total_bits: jnp.ndarray,
+                          pad_ones: bool = True) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(S, Wc) uint32 words + (S,) bit lengths -> (S, Wc*4) uint8 + (S,) byte lens.
+
+    MSB-first within each word; the final partial byte is 1-padded (JPEG
+    convention) on device.
+    """
+    s, wc = words.shape
+    shifts = jnp.asarray([24, 16, 8, 0], dtype=jnp.uint32)
+    by = jnp.right_shift(words[:, :, None], shifts[None, None, :])
+    by = jnp.bitwise_and(by, 0xFF).reshape(s, wc * 4)
+    nbytes = (total_bits + 7) // 8
+    if pad_ones:
+        rem = jnp.mod(total_bits, 8)                       # (S,)
+        pad_mask = jnp.where(rem > 0,
+                             jnp.left_shift(1, 8 - rem) - 1, 0).astype(jnp.uint32)
+        idx = jax.lax.broadcasted_iota(jnp.int32, (s, wc * 4), 1)
+        is_last = idx == (nbytes - 1)[:, None]
+        by = jnp.where(is_last, jnp.bitwise_or(by, pad_mask[:, None]), by)
+    return by.astype(jnp.uint8), nbytes.astype(jnp.int32)
+
+
+def concat_stripe_bytes(stripe_bytes: jnp.ndarray, byte_lens: jnp.ndarray,
+                        out_cap: int) -> FrameBuffer:
+    """Ragged byte concat: (S, B) uint8 + (S,) lens -> (out_cap,) uint8.
+
+    Output byte j belongs to stripe b = searchsorted(starts, j) with local
+    offset j - starts[b]; bytes past the total are zero.
+    """
+    s, b = stripe_bytes.shape
+    starts = jnp.cumsum(byte_lens) - byte_lens             # (S,) exclusive
+    total = jnp.sum(byte_lens)
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    sb = jnp.clip(jnp.searchsorted(starts, j, side="right") - 1, 0, s - 1)
+    local = jnp.clip(j - starts[sb], 0, b - 1)
+    data = jnp.where(j < total, stripe_bytes[sb, local], 0).astype(jnp.uint8)
+    return FrameBuffer(data, byte_lens.astype(jnp.int32), total > out_cap)

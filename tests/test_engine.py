@@ -1,0 +1,129 @@
+"""End-to-end engine tests: synthetic source -> ScreenCapture -> chunks.
+
+This is the fake-encoder vertical slice of SURVEY.md §7 step 2, except the
+encoder is already the real TPU-shaped one (running on CPU here).
+"""
+
+import io
+import time
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from selkies_tpu.engine import CaptureSettings, ScreenCapture
+from selkies_tpu.engine.encoder import JpegEncoderSession
+from selkies_tpu.engine.sources import SyntheticSource
+
+
+SMALL = dict(capture_width=64, capture_height=64, stripe_height=32,
+             target_fps=120.0, jpeg_quality=75)
+
+
+def test_encoder_session_roundtrip():
+    s = CaptureSettings(**SMALL)
+    sess = JpegEncoderSession(s)
+    src = SyntheticSource(s.capture_width, s.capture_height)
+    out = sess.encode(src.get_frame(0))
+    chunks = sess.finalize(out)
+    # first frame: everything damaged -> all stripes sent
+    assert len(chunks) == sess.grid.n_stripes
+    for c in chunks:
+        img = Image.open(io.BytesIO(c.payload))
+        img.load()
+        assert img.size == (sess.grid.width, sess.grid.stripe_h)
+        assert c.output_mode == "jpeg" and c.is_idr
+
+
+def test_damage_gating_skips_static_stripes():
+    s = CaptureSettings(**SMALL)
+    s.paint_over_delay_frames = 5
+    sess = JpegEncoderSession(s)
+    src = SyntheticSource(s.capture_width, s.capture_height, static_after=0)
+    outs = [sess.finalize(sess.encode(src.get_frame(t))) for t in range(4)]
+    assert len(outs[0]) == sess.grid.n_stripes   # first frame full
+    assert all(len(o) == 0 for o in outs[1:])    # static -> nothing sent
+
+
+def test_paint_over_fires_once():
+    s = CaptureSettings(**SMALL)
+    s.paint_over_delay_frames = 3
+    sess = JpegEncoderSession(s)
+    src = SyntheticSource(s.capture_width, s.capture_height, static_after=0)
+    sent = [len(sess.finalize(sess.encode(src.get_frame(t))))
+            for t in range(8)]
+    # frame 0 full; then silence; at age==3 one full-quality repaint; silence
+    assert sent[0] == sess.grid.n_stripes
+    assert sum(sent[1:]) == sess.grid.n_stripes
+    assert sent[3] == sess.grid.n_stripes  # age hits the delay on encode 3
+
+
+def test_force_idr_resends_all():
+    s = CaptureSettings(**SMALL)
+    sess = JpegEncoderSession(s)
+    src = SyntheticSource(s.capture_width, s.capture_height, static_after=0)
+    sess.finalize(sess.encode(src.get_frame(0)))
+    out = sess.encode(src.get_frame(1))
+    chunks = sess.finalize(out, force_all=True)
+    assert len(chunks) == sess.grid.n_stripes
+
+
+def test_screen_capture_thread_delivers_chunks():
+    got = []
+    cap = ScreenCapture(source_kind="synthetic")
+    cap.start_capture(got.append, CaptureSettings(**SMALL))
+    deadline = time.time() + 30
+    while time.time() < deadline and len(got) < 6:
+        time.sleep(0.05)
+    assert cap.is_capturing()
+    cap.stop_capture()
+    assert not cap.is_capturing()
+    assert len(got) >= 6
+    frame_ids = {c.frame_id for c in got}
+    assert len(frame_ids) >= 2          # multiple frames delivered
+    for c in got[:4]:
+        Image.open(io.BytesIO(c.payload)).load()
+
+
+def test_damage_gating_disabled_sends_everything():
+    s = CaptureSettings(**SMALL)
+    s.use_damage_gating = False
+    sess = JpegEncoderSession(s)
+    src = SyntheticSource(s.capture_width, s.capture_height, static_after=0)
+    outs = [sess.finalize(sess.encode(src.get_frame(t))) for t in range(3)]
+    assert all(len(o) == sess.grid.n_stripes for o in outs)
+
+
+def test_paint_over_disabled_never_repaints():
+    s = CaptureSettings(**SMALL)
+    s.use_paint_over = False
+    s.paint_over_delay_frames = 2
+    sess = JpegEncoderSession(s)
+    src = SyntheticSource(s.capture_width, s.capture_height, static_after=0)
+    sent = [len(sess.finalize(sess.encode(src.get_frame(t)))) for t in range(6)]
+    assert sent[0] == sess.grid.n_stripes and sum(sent[1:]) == 0
+
+
+def test_reencoding_same_frame_array_is_safe():
+    """Sources may hand back the same device buffer repeatedly (ArraySource
+    cycling); the session must not donate/invalidate caller frames."""
+    s = CaptureSettings(**SMALL)
+    sess = JpegEncoderSession(s)
+    src = SyntheticSource(s.capture_width, s.capture_height)
+    frame = src.get_frame(0)
+    for _ in range(3):
+        sess.finalize(sess.encode(frame), force_all=True)
+    assert frame.shape == (64, 64, 3)  # still alive and readable
+
+
+def test_live_quality_update():
+    s = CaptureSettings(**SMALL)
+    s.jpeg_quality = 90
+    sess = JpegEncoderSession(s)
+    src = SyntheticSource(s.capture_width, s.capture_height)
+    frame = src.get_frame(7)
+    big = sum(len(c.payload) for c in sess.finalize(sess.encode(frame), force_all=True))
+    sess.update_quality(10)
+    small = sum(len(c.payload) for c in
+                sess.finalize(sess.encode(frame), force_all=True))
+    assert small < big
