@@ -1,0 +1,90 @@
+"""CLI entry point: ``python -m selkies_tpu`` (reference __main__.py:20-80).
+
+Builds the settings, the single-port server, registers the transports, and
+starts the configured mode. uvloop is absent from this image; stock asyncio
+is used (the reference installs uvloop when available).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import sys
+
+from .input.backends import make_backend
+from .input.handler import InputHandler
+from .server.core import CentralizedStreamServer
+from .server.ws_service import WebSocketsService
+from .settings import AppSettings
+
+
+async def wait_for_app_ready(path: str, timeout_s: float = 60.0) -> None:
+    """Poll the sidecar ready-file before serving (reference
+    __main__.py:20-26)."""
+    if not path:
+        return
+    for _ in range(int(timeout_s / 0.5)):
+        if os.path.exists(path):
+            return
+        await asyncio.sleep(0.5)
+
+
+async def run(argv=None) -> None:
+    settings = AppSettings.parse(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if settings.debug else logging.INFO,
+        format="%(asctime)s [%(name)s] %(levelname)s: %(message)s")
+
+    await wait_for_app_ready(settings.app_ready_file)
+
+    server = CentralizedStreamServer(settings)
+
+    input_handler = None
+    if settings.enable_input:
+        input_handler = InputHandler(
+            backend=make_backend(settings.display_id),
+            enable_command_verb=settings.enable_command_verb,
+            clipboard_max_bytes=settings.clipboard_max_bytes)
+
+    audio = None
+    if settings.enable_audio:
+        try:
+            from .audio.pipeline import AudioPipeline
+            audio = AudioPipeline(settings)
+        except Exception as e:  # no libopus / no PulseAudio: degrade
+            logging.getLogger("selkies_tpu").info("audio disabled: %s", e)
+
+    ws = WebSocketsService(settings, input_handler=input_handler,
+                           audio_pipeline=audio)
+    server.register_service("websockets", ws)
+    try:
+        from .server.webrtc_service import WebRTCService
+        server.register_service("webrtc", WebRTCService(settings))
+    except ImportError:
+        pass  # WebRTC transport is opt-in and may be absent
+
+    await server.switch_to_mode(settings.mode)
+    await server.run()
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    await stop.wait()
+    await server.shutdown()
+
+
+def main() -> None:
+    try:
+        asyncio.run(run(sys.argv[1:]))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

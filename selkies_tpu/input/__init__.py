@@ -1,0 +1,9 @@
+"""Input injection layer (reference input_handler.py, SURVEY.md §2.1 row 8).
+
+A verb-protocol dispatcher shared by every transport, with pluggable OS
+backends: ctypes/XTEST against a live X display, or an event-recording null
+backend when headless (the degraded-import seam the reference also has,
+selkies.py:148-189).
+"""
+
+from .handler import InputHandler  # noqa: F401

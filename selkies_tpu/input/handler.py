@@ -1,0 +1,276 @@
+"""Input verb dispatcher shared by every transport.
+
+Fresh implementation of the reference's ``WebRTCInput`` responsibilities
+(input_handler.py:1866-4807, SURVEY.md §2.1 row 8): keyboard with
+server-side auto-repeat and stuck-key recovery, absolute/relative mouse,
+scroll, two-way clipboard with bounded multipart transfers, gamepad state,
+and the opt-in shell verb.
+
+Verb grammar (client -> server; names match the reference protocol,
+SURVEY.md §2.3):
+
+- ``kd,<keysym>`` / ``ku,<keysym>``: key down/up (X11 keysym, decimal)
+- ``kr``: release everything (panic reset)
+- ``kh,<keysym>[,<keysym>...]``: heartbeat for held keys; keys without a
+  heartbeat for ``STALE_KEY_S`` are force-released (reference
+  input_handler.py:2408-2467)
+- ``m,<x>,<y>``: absolute move; ``m2,<dx>,<dy>``: relative move
+- ``mb,<button>,<0|1>``: button event; ``ms,<dx>,<dy>``: scroll
+- ``p,<0|1>``: pointer visibility hint
+- ``cw,<b64>``: client writes text clipboard; ``cr``: client requests it;
+  ``cws``/``cwd,<b64>``/``cwe``: bounded multipart write;
+  ``cb*``: binary/image variants with a mime in ``cbs,<mime>``
+- ``js,c|b|a,...``: gamepad config/button/axis
+- ``cmd,<shell>``: opt-in command execution
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import logging
+import time
+from typing import Awaitable, Callable, Optional
+
+from .backends import InputBackend, NullBackend, make_backend
+
+logger = logging.getLogger("selkies_tpu.input.handler")
+
+MAX_PRESSED_KEYS = 1024          # kd-flood cap (reference parity)
+STALE_KEY_S = 2.0                # heartbeat-less keys get released
+REPEAT_DELAY_S = 0.5
+REPEAT_HZ = 25.0
+
+
+class GamepadState:
+    """Virtual gamepad model; the interposer socket server consumes this
+    (SURVEY.md §2.2 joystick interposer row)."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.name = "Selkies TPU Virtual Gamepad"
+        self.buttons: dict[int, float] = {}
+        self.axes: dict[int, float] = {}
+        self.connected = False
+        self.listeners: list[Callable[[str, int, float], None]] = []
+
+    def emit(self, kind: str, num: int, value: float) -> None:
+        for fn in list(self.listeners):
+            try:
+                fn(kind, num, value)
+            except Exception:
+                logger.exception("gamepad listener failed")
+
+
+class InputHandler:
+    """One per server process; transports feed verbs, clients with input
+    authority only (the service enforces viewer/collaborator rules)."""
+
+    def __init__(self, backend: Optional[InputBackend] = None,
+                 enable_command_verb: bool = False,
+                 clipboard_max_bytes: int = 64 * 1024 * 1024,
+                 send_clipboard: Optional[Callable[[bytes, str], Awaitable[None]]] = None,
+                 now: Callable[[], float] = time.monotonic):
+        self.backend = backend if backend is not None else NullBackend()
+        self.enable_command_verb = enable_command_verb
+        self.clipboard_max = clipboard_max_bytes
+        self.send_clipboard = send_clipboard
+        self._now = now  # injectable for deterministic tests
+        self.pressed: dict[int, float] = {}    # keysym -> last heartbeat
+        self.gamepads = [GamepadState(i) for i in range(4)]
+        self._multipart: Optional[dict] = None
+        self._repeat_task: Optional[asyncio.Task] = None
+        self._sweep_task: Optional[asyncio.Task] = None
+        self.pointer_visible = True
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        loop = asyncio.get_event_loop()
+        self._sweep_task = loop.create_task(self._stale_sweep())
+        self._repeat_task = loop.create_task(self._repeat_loop())
+
+    async def stop(self) -> None:
+        for t in (self._sweep_task, self._repeat_task):
+            if t:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+        self.release_all()
+        self.backend.close()
+
+    # ------------------------------------------------------------ key safety
+    def release_all(self) -> None:
+        for ks in list(self.pressed):
+            self.backend.key(ks, False)
+        self.pressed.clear()
+
+    async def _stale_sweep(self) -> None:
+        """Stuck-key recovery: client died mid-hold -> release after 2 s
+        without heartbeat (reference input_handler.py:2408-2467)."""
+        while True:
+            await asyncio.sleep(STALE_KEY_S / 2)
+            cutoff = self._now() - STALE_KEY_S
+            for ks, ts in list(self.pressed.items()):
+                if ts < cutoff:
+                    logger.info("releasing stale key %d", ks)
+                    self.backend.key(ks, False)
+                    self.pressed.pop(ks, None)
+
+    async def _repeat_loop(self) -> None:
+        """XTEST holds don't trigger X native auto-repeat; synthesise it
+        (reference input_handler.py:2468-2553)."""
+        period = 1.0 / REPEAT_HZ
+        while True:
+            await asyncio.sleep(period)
+            now = self._now()
+            for ks, first in self.pressed.items():
+                # repeat only keys held beyond the delay; re-press them
+                if now - first > REPEAT_DELAY_S and _is_repeatable(ks):
+                    self.backend.key(ks, True)
+
+    # --------------------------------------------------------------- dispatch
+    async def on_message(self, text: str) -> None:
+        verb, _, args = text.partition(",")
+        fn = getattr(self, f"_v_{verb}", None)
+        if fn is None:
+            logger.debug("unknown input verb %r", verb)
+            return
+        await fn(args)
+
+    # keyboard ---------------------------------------------------------------
+    async def _v_kd(self, args: str) -> None:
+        ks = int(args)
+        if len(self.pressed) >= MAX_PRESSED_KEYS:
+            return  # kd flood
+        if ks not in self.pressed:
+            self.pressed[ks] = self._now()
+            self.backend.key(ks, True)
+
+    async def _v_ku(self, args: str) -> None:
+        ks = int(args)
+        self.pressed.pop(ks, None)
+        self.backend.key(ks, False)
+
+    async def _v_kr(self, args: str) -> None:
+        self.release_all()
+
+    async def _v_kh(self, args: str) -> None:
+        now = self._now()
+        for part in args.split(","):
+            if part:
+                ks = int(part)
+                if ks in self.pressed:
+                    self.pressed[ks] = now
+
+    # pointer ----------------------------------------------------------------
+    async def _v_m(self, args: str) -> None:
+        x, y = (int(float(v)) for v in args.split(",")[:2])
+        self.backend.pointer_motion(x, y)
+
+    async def _v_m2(self, args: str) -> None:
+        dx, dy = (int(float(v)) for v in args.split(",")[:2])
+        self.backend.pointer_motion_rel(dx, dy)
+
+    async def _v_mb(self, args: str) -> None:
+        btn, down = args.split(",")[:2]
+        self.backend.pointer_button(int(btn), down == "1")
+
+    async def _v_ms(self, args: str) -> None:
+        dx, dy = (int(float(v)) for v in args.split(",")[:2])
+        self.backend.scroll(dx, dy)
+
+    async def _v_p(self, args: str) -> None:
+        self.pointer_visible = args.strip() == "1"
+
+    # clipboard --------------------------------------------------------------
+    async def _v_cw(self, args: str) -> None:
+        data = base64.b64decode(args)
+        if len(data) <= self.clipboard_max:
+            self.backend.set_clipboard(data, "text/plain")
+
+    async def _v_cr(self, args: str) -> None:
+        if self.send_clipboard:
+            data, mime = self.backend.get_clipboard()
+            await self.send_clipboard(data, mime)
+
+    async def _v_cws(self, args: str) -> None:
+        self._multipart = {"mime": "text/plain", "parts": [], "size": 0}
+
+    async def _v_cbs(self, args: str) -> None:
+        self._multipart = {"mime": args or "application/octet-stream",
+                           "parts": [], "size": 0}
+
+    async def _multipart_data(self, args: str) -> None:
+        if self._multipart is None:
+            return
+        chunk = base64.b64decode(args)
+        self._multipart["size"] += len(chunk)
+        if self._multipart["size"] > self.clipboard_max:
+            logger.warning("multipart clipboard exceeded cap; dropping")
+            self._multipart = None
+            return
+        self._multipart["parts"].append(chunk)
+
+    async def _v_cwd(self, args: str) -> None:
+        await self._multipart_data(args)
+
+    async def _v_cbd(self, args: str) -> None:
+        await self._multipart_data(args)
+
+    async def _multipart_end(self) -> None:
+        if self._multipart is None:
+            return
+        data = b"".join(self._multipart["parts"])
+        self.backend.set_clipboard(data, self._multipart["mime"])
+        self._multipart = None
+
+    async def _v_cwe(self, args: str) -> None:
+        await self._multipart_end()
+
+    async def _v_cbe(self, args: str) -> None:
+        await self._multipart_end()
+
+    # gamepad ----------------------------------------------------------------
+    async def _v_js(self, args: str) -> None:
+        parts = args.split(",")
+        kind = parts[0]
+        if kind == "c":               # js,c,<slot>,<name...>
+            slot = int(parts[1]) if len(parts) > 1 else 0
+            if 0 <= slot < len(self.gamepads):
+                gp = self.gamepads[slot]
+                gp.connected = True
+                if len(parts) > 2:
+                    gp.name = ",".join(parts[2:])[:255] or gp.name
+        elif kind == "d":             # js,d,<slot> disconnect
+            slot = int(parts[1]) if len(parts) > 1 else 0
+            if 0 <= slot < len(self.gamepads):
+                self.gamepads[slot].connected = False
+        elif kind == "b":             # js,b,<slot>,<button>,<0|1>
+            slot, btn, val = int(parts[1]), int(parts[2]), float(parts[3])
+            gp = self.gamepads[slot]
+            gp.buttons[btn] = val
+            gp.emit("b", btn, val)
+        elif kind == "a":             # js,a,<slot>,<axis>,<value>
+            slot, axis, val = int(parts[1]), int(parts[2]), float(parts[3])
+            gp = self.gamepads[slot]
+            gp.axes[axis] = val
+            gp.emit("a", axis, val)
+
+    # shell ------------------------------------------------------------------
+    async def _v_cmd(self, args: str) -> None:
+        if not self.enable_command_verb:
+            logger.warning("cmd verb rejected (disabled)")
+            return
+        proc = await asyncio.create_subprocess_shell(
+            args, stdout=asyncio.subprocess.DEVNULL,
+            stderr=asyncio.subprocess.DEVNULL)
+        asyncio.ensure_future(proc.wait())
+
+
+def _is_repeatable(keysym: int) -> bool:
+    """Printables, arrows, backspace/delete repeat; modifiers must not."""
+    if 0xFFE1 <= keysym <= 0xFFEE:   # modifiers
+        return False
+    return True

@@ -1,0 +1,10 @@
+"""Control plane: single-port aiohttp server + transport services.
+
+Layer 3/4 of SURVEY.md §1: one HTTP app serves static client files, the
+``/api/*`` surface, and exactly one active streaming transport (WebSockets
+by default, WebRTC opt-in), mirroring the reference's
+``CentralizedStreamServer`` architecture (stream_server.py:390) without
+porting its code.
+"""
+
+from .core import BaseStreamingService, CentralizedStreamServer  # noqa: F401

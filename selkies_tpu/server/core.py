@@ -1,0 +1,351 @@
+"""Single-port HTTP core / service supervisor.
+
+Re-implements the responsibilities of the reference's
+``CentralizedStreamServer`` (stream_server.py:390-1421) on aiohttp,
+designed fresh:
+
+- auth middleware: HTTP basic auth, a view-only password granting
+  input-less sessions, and a master bearer token — all compared
+  timing-safely (reference :689-792);
+- WebSocket Origin guard (reference :647-686);
+- static client serving from the packaged ``web/`` directory or
+  ``--web_root``;
+- ``/api/status``, ``/api/health``, ``/api/metrics``, ``/api/switch``
+  (live transport swap when ``enable_dual_mode``, reference :804-895);
+- chunked file upload with path-traversal + symlink defences and a
+  JSON/HTML download index (reference :897-1299);
+- TLS with live certificate reload (reference :552-632);
+- ``BaseStreamingService`` ABC so transports are pluggable and fakeable
+  (the testability seam SURVEY.md §4.5 calls out).
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import base64
+import hmac
+import html
+import json
+import logging
+import os
+import pathlib
+import ssl
+import time
+from typing import Optional
+from urllib.parse import urlparse
+
+from aiohttp import web
+
+from ..settings import AppSettings, is_sensitive
+
+logger = logging.getLogger("selkies_tpu.server.core")
+
+WEB_ROOT = pathlib.Path(__file__).resolve().parent.parent / "web"
+
+
+class BaseStreamingService(abc.ABC):
+    """Transport service contract (reference stream_server.py:372-387)."""
+
+    name: str = "base"
+
+    @abc.abstractmethod
+    async def start(self) -> None: ...
+
+    @abc.abstractmethod
+    async def stop(self) -> None: ...
+
+    def register_routes(self, app: web.Application) -> None:
+        """Add the service's endpoints to the shared app."""
+
+
+def _timing_safe_eq(a: str, b: str) -> bool:
+    return hmac.compare_digest(a.encode(), b.encode())
+
+
+class CentralizedStreamServer:
+    def __init__(self, settings: AppSettings):
+        self.settings = settings
+        self.services: dict[str, BaseStreamingService] = {}
+        self.active_mode: Optional[str] = None
+        self._service_task: Optional[asyncio.Task] = None
+        self.app = web.Application(middlewares=[self._auth_middleware])
+        self._runner: Optional[web.AppRunner] = None
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        self._cert_watch_task: Optional[asyncio.Task] = None
+        self.started_at = time.time()
+        self._setup_routes()
+
+    # ------------------------------------------------------------------ auth
+    def _role_for_request(self, request: web.Request) -> Optional[str]:
+        """None = reject; 'full' | 'viewonly' otherwise."""
+        s = self.settings
+        # master bearer token always wins
+        token = s.master_token
+        auth = request.headers.get("Authorization", "")
+        if token and auth.startswith("Bearer ") \
+                and _timing_safe_eq(auth[7:], token):
+            return "full"
+        if not s.enable_basic_auth:
+            return "full"
+        if auth.startswith("Basic "):
+            try:
+                user, _, pw = base64.b64decode(auth[6:]).decode().partition(":")
+            except Exception:
+                return None
+            user_ok = _timing_safe_eq(user, s.basic_auth_user or "")
+            if user_ok and _timing_safe_eq(pw, s.basic_auth_password or ""):
+                return "full"
+            if user_ok and s.viewonly_password \
+                    and _timing_safe_eq(pw, s.viewonly_password):
+                return "viewonly"
+        return None
+
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        role = self._role_for_request(request)
+        if role is None:
+            return web.Response(
+                status=401, headers={"WWW-Authenticate": 'Basic realm="selkies"'})
+        request["role"] = role
+        if request.headers.get("Upgrade", "").lower() == "websocket" \
+                and not self._is_ws_origin_allowed(request):
+            logger.warning("rejected WS upgrade from origin %s",
+                           request.headers.get("Origin"))
+            return web.Response(status=403, text="origin not allowed")
+        return await handler(request)
+
+    def _is_ws_origin_allowed(self, request: web.Request) -> bool:
+        """Same-host by default; explicit allow-list via settings
+        (reference stream_server.py:647-686)."""
+        origin = request.headers.get("Origin")
+        if not origin:
+            return True  # non-browser clients
+        allowed = self.settings.allowed_ws_origins
+        if allowed and origin in allowed:
+            return True
+        try:
+            o = urlparse(origin)
+        except ValueError:
+            return False
+        host = request.headers.get("Host", "")
+        return o.netloc == host or (o.hostname in ("localhost", "127.0.0.1"))
+
+    # ---------------------------------------------------------------- routes
+    def _setup_routes(self) -> None:
+        r = self.app.router
+        r.add_get("/api/status", self.handle_status)
+        r.add_get("/api/health", self.handle_health)
+        r.add_post("/api/switch", self.handle_switch)
+        if self.settings.enable_metrics:
+            r.add_get("/api/metrics", self.handle_metrics)
+        if self.settings.enable_file_transfer:
+            r.add_post("/api/upload", self.handle_upload)
+            r.add_get("/api/files", self.handle_file_index)
+            r.add_get("/api/files/{name:.+}", self.handle_file_download)
+
+    def register_static(self) -> None:
+        """Added last so /api/* wins; serves the packaged web client."""
+        root = WEB_ROOT
+        if root.is_dir():
+            self.app.router.add_get("/", self._index)
+            self.app.router.add_static("/", root, show_index=False)
+
+    async def _index(self, request: web.Request) -> web.StreamResponse:
+        return web.FileResponse(WEB_ROOT / "index.html")
+
+    async def handle_status(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "app": self.settings.app_name,
+            "mode": self.active_mode,
+            "uptime_s": round(time.time() - self.started_at, 1),
+            "dual_mode": self.settings.enable_dual_mode,
+            "role": request["role"],
+        })
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        svc_ok = self.active_mode in self.services
+        return web.json_response(
+            {"ok": svc_ok, "mode": self.active_mode},
+            status=200 if svc_ok else 503)
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        from .metrics import render_prometheus
+        return web.Response(text=render_prometheus(),
+                            content_type="text/plain")
+
+    async def handle_switch(self, request: web.Request) -> web.Response:
+        if request["role"] != "full":
+            return web.Response(status=403, text="view-only")
+        if not self.settings.enable_dual_mode:
+            return web.Response(status=403, text="dual mode disabled")
+        body = await request.json()
+        mode = body.get("mode")
+        if mode not in self.services:
+            return web.Response(status=400, text=f"unknown mode {mode!r}")
+        await self.switch_to_mode(mode)
+        return web.json_response({"mode": self.active_mode})
+
+    # ---------------------------------------------------------------- upload
+    def _transfer_root(self) -> pathlib.Path:
+        return pathlib.Path(
+            os.path.expanduser(self.settings.file_transfer_dir)).resolve()
+
+    def _safe_target(self, name: str) -> pathlib.Path:
+        """Reject traversal; refuse symlink targets (reference O_NOFOLLOW
+        defence, stream_server.py:947-1098)."""
+        root = self._transfer_root()
+        target = (root / name).resolve()
+        if not str(target).startswith(str(root) + os.sep) and target != root:
+            raise web.HTTPBadRequest(text="path escapes transfer dir")
+        if target.is_symlink():
+            raise web.HTTPBadRequest(text="refusing symlink target")
+        return target
+
+    async def handle_upload(self, request: web.Request) -> web.Response:
+        if request["role"] != "full":
+            return web.Response(status=403, text="view-only")
+        name = request.headers.get("X-Upload-Name")
+        if not name:
+            return web.Response(status=400, text="X-Upload-Name required")
+        try:
+            offset = int(request.headers.get("X-Upload-Offset", "0"))
+            total = int(request.headers.get("X-Upload-Total", "-1"))
+        except ValueError:
+            return web.Response(status=400, text="bad offset/total")
+        if offset < 0:
+            return web.Response(status=400, text="bad offset/total")
+        target = self._safe_target(name)
+        part = target.with_name(target.name + ".part")
+        target.parent.mkdir(parents=True, exist_ok=True)
+        mode = "r+b" if part.exists() else "wb"
+        max_slice = self.settings.upload_chunk_bytes
+        written = 0
+        # O_NOFOLLOW equivalent: refuse to write through symlinks
+        if part.is_symlink():
+            return web.Response(status=400, text="refusing symlink part")
+        with open(part, mode) as f:
+            f.seek(offset)
+            async for chunk in request.content.iter_chunked(1 << 20):
+                written += len(chunk)
+                if written > max_slice:
+                    return web.Response(status=413, text="slice too large")
+                f.write(chunk)
+        size = part.stat().st_size
+        if total >= 0 and size >= total:
+            part.replace(target)
+            return web.json_response({"complete": True, "size": size})
+        return web.json_response({"complete": False, "size": size})
+
+    async def handle_file_index(self, request: web.Request) -> web.Response:
+        root = self._transfer_root()
+        entries = []
+        if root.is_dir():
+            for p in sorted(root.iterdir()):
+                if p.name.endswith(".part") or p.is_symlink():
+                    continue
+                entries.append({"name": p.name, "dir": p.is_dir(),
+                                "size": p.stat().st_size if p.is_file() else 0})
+        if "text/html" in request.headers.get("Accept", ""):
+            rows = "".join(
+                f'<li><a href="/api/files/{html.escape(e["name"])}">'
+                f'{html.escape(e["name"])}</a> ({e["size"]} B)</li>'
+                for e in entries if not e["dir"])
+            return web.Response(
+                text=f"<html><body><h1>Downloads</h1><ul>{rows}</ul></body></html>",
+                content_type="text/html")
+        return web.json_response({"files": entries})
+
+    async def handle_file_download(self, request: web.Request) -> web.StreamResponse:
+        target = self._safe_target(request.match_info["name"])
+        if not target.is_file():
+            raise web.HTTPNotFound()
+        return web.FileResponse(target)
+
+    # -------------------------------------------------------------- services
+    def register_service(self, name: str, service: BaseStreamingService) -> None:
+        self.services[name] = service
+        service.register_routes(self.app)
+
+    async def switch_to_mode(self, mode: str) -> None:
+        """Stop the active transport, start the requested one (reference
+        stream_server.py:804-895). Service death clears active_mode."""
+        if mode == self.active_mode:
+            return
+        if self.active_mode and self.active_mode in self.services:
+            await self.services[self.active_mode].stop()
+            if self._service_task:
+                self._service_task.cancel()
+                self._service_task = None
+        svc = self.services[mode]
+        self.active_mode = mode
+
+        async def _run_service():
+            try:
+                await svc.start()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("service %s died", mode)
+                if self.active_mode == mode:
+                    self.active_mode = None
+
+        self._service_task = asyncio.create_task(_run_service())
+
+    # ------------------------------------------------------------------- tls
+    def _build_ssl(self) -> Optional[ssl.SSLContext]:
+        s = self.settings
+        if not s.enable_https:
+            return None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(s.https_cert, s.https_key)
+        return ctx
+
+    async def _watch_and_reload_certs(self) -> None:
+        """Hot-reload the cert when the file changes, never dropping the
+        listener (reference stream_server.py:552-632)."""
+        s = self.settings
+        last = None
+        while True:
+            await asyncio.sleep(5.0)
+            try:
+                stat = os.stat(s.https_cert)
+                key = (stat.st_mtime_ns, stat.st_size)
+                if last is None:
+                    last = key
+                elif key != last:
+                    last = key
+                    assert self._ssl_ctx is not None
+                    self._ssl_ctx.load_cert_chain(s.https_cert, s.https_key)
+                    logger.info("TLS certificate reloaded")
+            except FileNotFoundError:
+                continue
+            except ssl.SSLError:
+                logger.exception("cert reload failed; keeping old cert")
+
+    # ------------------------------------------------------------------- run
+    async def run(self) -> web.AppRunner:
+        self.register_static()
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        self._ssl_ctx = self._build_ssl()
+        site = web.TCPSite(self._runner, self.settings.addr,
+                           self.settings.port, ssl_context=self._ssl_ctx)
+        await site.start()
+        if self._ssl_ctx is not None:
+            self._cert_watch_task = asyncio.create_task(
+                self._watch_and_reload_certs())
+        logger.info("listening on %s:%d (%s)", self.settings.addr,
+                    self.settings.port,
+                    "https" if self._ssl_ctx else "http")
+        return self._runner
+
+    async def shutdown(self) -> None:
+        if self._cert_watch_task:
+            self._cert_watch_task.cancel()
+        if self.active_mode and self.active_mode in self.services:
+            await self.services[self.active_mode].stop()
+        if self._service_task:
+            self._service_task.cancel()
+        if self._runner:
+            await self._runner.cleanup()

@@ -1,0 +1,102 @@
+"""Observability: process-global metrics registry + Prometheus rendering.
+
+Mirrors the reference's metric surface (webrtc_utils.py:877-1259: ``fps``,
+``latency``, GPU/system gauges exposed at /api/metrics) with a tiny
+dependency-free registry: gauges and counters with optional labels,
+rendered in Prometheus text exposition format. A histogram covers the
+fps_hist parity case.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Iterable
+
+_lock = threading.Lock()
+_gauges: dict[tuple[str, tuple], float] = {}
+_counters: dict[tuple[str, tuple], float] = defaultdict(float)
+_helps: dict[str, str] = {}
+_hist_buckets = (1, 5, 10, 15, 20, 30, 45, 60, 90, 120, 240)
+_hists: dict[tuple[str, tuple], list] = {}
+
+
+def _key(name: str, labels: dict | None) -> tuple[str, tuple]:
+    return name, tuple(sorted((labels or {}).items()))
+
+
+def describe(name: str, help_text: str) -> None:
+    _helps[name] = help_text
+
+
+def set_gauge(name: str, value: float, labels: dict | None = None) -> None:
+    with _lock:
+        _gauges[_key(name, labels)] = float(value)
+
+
+def inc_counter(name: str, value: float = 1.0, labels: dict | None = None) -> None:
+    with _lock:
+        _counters[_key(name, labels)] += value
+
+
+def observe_hist(name: str, value: float, labels: dict | None = None) -> None:
+    with _lock:
+        k = _key(name, labels)
+        h = _hists.setdefault(k, [0] * (len(_hist_buckets) + 1) + [0.0, 0])
+        for i, b in enumerate(_hist_buckets):
+            if value <= b:
+                h[i] += 1
+        h[len(_hist_buckets)] += 1          # +Inf
+        h[-2] += value                      # sum
+        h[-1] += 1                          # count
+
+
+def clear() -> None:
+    with _lock:
+        _gauges.clear()
+        _counters.clear()
+        _hists.clear()
+
+
+def _fmt_labels(labels: tuple, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_prometheus() -> str:
+    out: list[str] = []
+    with _lock:
+        seen: set[str] = set()
+
+        def emit_help(name: str, mtype: str):
+            if name not in seen:
+                seen.add(name)
+                if name in _helps:
+                    out.append(f"# HELP {name} {_helps[name]}")
+                out.append(f"# TYPE {name} {mtype}")
+
+        for (name, labels), v in sorted(_gauges.items()):
+            emit_help(name, "gauge")
+            out.append(f"{name}{_fmt_labels(labels)} {v}")
+        for (name, labels), v in sorted(_counters.items()):
+            emit_help(name, "counter")
+            out.append(f"{name}{_fmt_labels(labels)} {v}")
+        for (name, labels), h in sorted(_hists.items()):
+            emit_help(name, "histogram")
+            for i, b in enumerate(_hist_buckets):
+                out.append(f"{name}_bucket{_fmt_labels(labels, f'le=\"{b}\"')} {h[i]}")
+            out.append(f"{name}_bucket{_fmt_labels(labels, 'le=\"+Inf\"')} {h[len(_hist_buckets)]}")
+            out.append(f"{name}_sum{_fmt_labels(labels)} {h[-2]}")
+            out.append(f"{name}_count{_fmt_labels(labels)} {h[-1]}")
+    return "\n".join(out) + "\n"
+
+
+describe("selkies_fps", "Encoded frames per second per display")
+describe("selkies_latency_ms", "Client-reported round-trip latency")
+describe("selkies_clients", "Connected clients")
+describe("selkies_bytes_sent_total", "Media bytes sent")
+describe("selkies_frames_encoded_total", "Frames encoded")
+describe("selkies_backpressure_events_total", "ACK backpressure activations")

@@ -1,0 +1,131 @@
+"""Per-client video relay: bounded fan-out with skip-ahead semantics.
+
+Carries the reference's hard-won flow-control rules (selkies.py:61-110,
+529-673 — the issue-#282 class of bugs) into a fresh implementation:
+
+- **Broadcast contract**: one encode feeds N clients; a slow client skips
+  ahead, it never paces the others. ``offer()`` is synchronous — no awaits
+  in the fan-out path.
+- **Byte budget** per relay = ``budget_s`` seconds of the stream bitrate
+  with a floor, so a stalled TCP connection cannot queue unbounded memory.
+- **Drop semantics**: when over budget, drop whole queued frames oldest
+  first. For H.264 delta stripes, a drop breaks the decode chain of that
+  stripe row, so the relay gates further deltas of the row until an IDR
+  for it passes, and asks the encoder for one (rate-limited).
+- **Bounded sends**: a send that exceeds ``send_timeout`` means a dead or
+  hopeless socket; a cancelled send could tear a frame mid-write, so the
+  socket is never reused afterwards (reference selkies.py:79-101).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from typing import Awaitable, Callable, Optional
+
+from ..protocol import FRAME_TYPE_IDR, OP_H264, unpack_h264_header
+
+logger = logging.getLogger("selkies_tpu.server.relay")
+
+IDR_REQUEST_MIN_INTERVAL_S = 0.5
+SEND_TIMEOUT_S = 1.0
+RELAY_FLOOR_BYTES = 4 * 1024 * 1024
+
+
+class VideoRelay:
+    """One per (client, display). Feed with ``offer()``; runs its own
+    sender task against the client's ``send_bytes``."""
+
+    def __init__(self, send_bytes: Callable[[bytes], Awaitable[None]],
+                 budget_bytes: int = RELAY_FLOOR_BYTES,
+                 request_idr: Optional[Callable[[], None]] = None,
+                 on_dead: Optional[Callable[[], None]] = None):
+        self._send = send_bytes
+        self.budget = max(budget_bytes, RELAY_FLOOR_BYTES)
+        self._request_idr = request_idr
+        self._on_dead = on_dead
+        self._q: deque[bytes] = deque()
+        self._q_bytes = 0
+        self._wake = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self.dead = False
+        self._last_idr_req = 0.0
+        # per-stripe-row H.264 chain gate: row y -> True once its IDR passed
+        self._row_open: dict[int, bool] = {}
+        self.sent_bytes = 0
+        self.dropped_frames = 0
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run())
+
+    # ------------------------------------------------------------- producers
+    def offer(self, item: bytes) -> None:
+        """Synchronous enqueue. NEVER awaits (fan-out contract)."""
+        if self.dead:
+            return
+        if item[0] == OP_H264:
+            ftype, _, y, _, _ = unpack_h264_header(item)
+            if ftype == FRAME_TYPE_IDR:
+                self._row_open[y] = True
+            elif not self._row_open.get(y, False):
+                # delta for a broken/unstarted row: useless to this client
+                self._ask_idr()
+                return
+        self._q.append(item)
+        self._q_bytes += len(item)
+        while self._q_bytes > self.budget and len(self._q) > 1:
+            victim = self._q.popleft()
+            self._q_bytes -= len(victim)
+            self.dropped_frames += 1
+            if victim and victim[0] == OP_H264:
+                _, _, y, _, _ = unpack_h264_header(victim)
+                self._row_open[y] = False   # chain broken for that row
+                self._ask_idr()
+        self._wake.set()
+
+    def _ask_idr(self) -> None:
+        now = time.monotonic()
+        if self._request_idr and now - self._last_idr_req > IDR_REQUEST_MIN_INTERVAL_S:
+            self._last_idr_req = now
+            self._request_idr()
+
+    # --------------------------------------------------------------- sender
+    async def _run(self) -> None:
+        try:
+            while not self.dead:
+                if not self._q:
+                    self._wake.clear()
+                    await self._wake.wait()
+                    continue
+                item = self._q.popleft()
+                self._q_bytes -= len(item)
+                try:
+                    await asyncio.wait_for(self._send(item), SEND_TIMEOUT_S)
+                    self.sent_bytes += len(item)
+                except (asyncio.TimeoutError, ConnectionError, OSError):
+                    # cancelled mid-send = possibly torn frame; this socket
+                    # must never carry media again.
+                    logger.info("relay send failed/stalled; marking dead")
+                    self._mark_dead()
+                    return
+        except asyncio.CancelledError:
+            pass
+
+    def _mark_dead(self) -> None:
+        self.dead = True
+        self._q.clear()
+        self._q_bytes = 0
+        if self._on_dead:
+            self._on_dead()
+
+    async def close(self) -> None:
+        self.dead = True
+        self._wake.set()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass

@@ -1,0 +1,556 @@
+"""WebSockets streaming service — the default transport.
+
+Fresh design carrying the reference's invariants (DataStreamingServer,
+selkies.py:813-4883; SURVEY.md §2.1/§3.2):
+
+- one WS endpoint ``/api/websockets``; handshake sends ``MODE websockets``,
+  cursor state, then the ``server_settings`` JSON payload;
+- per-(client, display) :class:`VideoRelay` — a slow client skips ahead and
+  never paces others; the fan-out path never awaits;
+- ACK-driven backpressure in uint16 circular frame-id space, with the
+  desync window scaled by the measured client fps and a 4 s no-ACK stall
+  trigger (reference selkies.py:1590-1717);
+- capture modules are persistent per display and stay warm across client
+  reconnects for ``reconnect_grace_s`` (reference selkies.py:827-830,
+  940-946);
+- viewer-authority verb gating (reference input_handler.py:110-128);
+- gzip (0x05) control compression negotiated via ``_gz,1``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+from aiohttp import WSMsgType, web
+
+from .. import protocol as P
+from ..engine import CaptureSettings, ScreenCapture
+from ..engine.types import EncodedChunk
+from ..settings import AppSettings, SettingsError
+from . import metrics
+from .core import BaseStreamingService
+from .relay import VideoRelay
+
+logger = logging.getLogger("selkies_tpu.server.ws")
+
+ACK_STALL_S = 4.0
+RECONNECT_DEBOUNCE_S = 0.5
+
+
+class _FpsEstimator:
+    """Client display fps from ACK cadence; ``now`` injected so tests are
+    deterministic (the reference documents the same seam,
+    selkies.py:1694-1696)."""
+
+    def __init__(self, window: int = 30):
+        self._times: list[float] = []
+        self._window = window
+
+    def tick(self, now: float) -> None:
+        self._times.append(now)
+        if len(self._times) > self._window:
+            self._times.pop(0)
+
+    def fps(self) -> float:
+        if len(self._times) < 2:
+            return 60.0
+        span = self._times[-1] - self._times[0]
+        return (len(self._times) - 1) / span if span > 0 else 60.0
+
+
+class ClientConnection:
+    _next_id = 0
+
+    def __init__(self, ws: web.WebSocketResponse, role: str, raddr: str):
+        ClientConnection._next_id += 1
+        self.id = ClientConnection._next_id
+        self.ws = ws
+        self.role = role                  # 'full' | 'viewonly'
+        self.raddr = raddr
+        self.gzip_ok = False
+        self.video_active = False
+        self.audio_active = False
+        self.relays: dict[str, VideoRelay] = {}
+        self.last_sent_id = 0
+        self.last_ack_id = 0
+        self.last_ack_time = time.monotonic()
+        self.paused = False
+        self.fps_est = _FpsEstimator()
+        self.reported_fps = 0.0
+        self.reported_latency_ms = 0.0
+
+    async def send_text_maybe_gz(self, text: str) -> None:
+        if self.gzip_ok:
+            out = P.maybe_compress_text(text)
+            if isinstance(out, bytes):
+                await self.ws.send_bytes(out)
+                return
+        await self.ws.send_str(text)
+
+
+class WebSocketsService(BaseStreamingService):
+    name = "websockets"
+
+    def __init__(self, settings: AppSettings, input_handler=None,
+                 capture_factory=None, audio_pipeline=None):
+        self.settings = settings
+        self.clients: dict[int, ClientConnection] = {}
+        self.captures: dict[str, ScreenCapture] = {}
+        self.display_geometry: dict[str, tuple[int, int]] = {}
+        self._capture_factory = capture_factory or (lambda: ScreenCapture("auto"))
+        self.input_handler = input_handler
+        self.audio = audio_pipeline
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._running = False
+        self._last_conn_by_ip: dict[str, float] = {}
+        self._grace_task: Optional[asyncio.Task] = None
+        self._stats_task: Optional[asyncio.Task] = None
+
+    # ---------------------------------------------------------------- routes
+    def register_routes(self, app: web.Application) -> None:
+        app.router.add_get("/api/websockets", self.ws_endpoint)
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_event_loop()
+        self._running = True
+        if self.input_handler is not None:
+            self.input_handler.start()
+        if self.audio is not None:
+            await self.audio.start()
+        self._stats_task = asyncio.create_task(self._stats_loop())
+        logger.info("websockets service started")
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._stats_task:
+            self._stats_task.cancel()
+        for c in list(self.clients.values()):
+            await c.ws.close()
+        for cap in self.captures.values():
+            cap.stop_capture()
+        self.captures.clear()
+        if self.audio is not None:
+            await self.audio.stop()
+        if self.input_handler is not None:
+            await self.input_handler.stop()
+
+    # -------------------------------------------------------------- settings
+    def _server_settings_payload(self) -> str:
+        payload = {
+            "type": "server_settings",
+            "app_name": self.settings.app_name,
+            "settings": self.settings.build_client_settings_payload(),
+            "displays": [
+                {"id": did, "width": w, "height": h}
+                for did, (w, h) in sorted(self.display_geometry.items())
+            ] or [{"id": self.settings.display_id,
+                   "width": self.settings.initial_width,
+                   "height": self.settings.initial_height}],
+            "features": {
+                "audio": self.audio is not None and self.settings.enable_audio,
+                "microphone": self.audio is not None and self.settings.enable_microphone,
+                "clipboard": self.settings.enable_clipboard != "none",
+                "gamepad": self.settings.enable_gamepad,
+                "file_transfer": self.settings.enable_file_transfer,
+                "resize": self.settings.enable_resize,
+            },
+        }
+        return "server_settings " + json.dumps(payload)
+
+    # --------------------------------------------------------------- capture
+    def _capture_settings(self, display_id: str) -> CaptureSettings:
+        s = self.settings
+        w, h = self.display_geometry.get(
+            display_id, (s.initial_width, s.initial_height))
+        return CaptureSettings(
+            capture_width=w, capture_height=h,
+            target_fps=float(s.framerate),
+            output_mode="jpeg" if s.encoder.startswith("jpeg") else "h264",
+            video_bitrate_kbps=s.video_bitrate_kbps,
+            video_crf=s.video_crf,
+            video_min_qp=s.video_min_qp, video_max_qp=s.video_max_qp,
+            keyframe_interval_s=s.keyframe_interval_s,
+            jpeg_quality=s.jpeg_quality,
+            fullcolor=s.fullcolor,
+            use_damage_gating=s.use_damage_gating,
+            use_paint_over=s.use_paint_over,
+            paint_over_quality=s.paint_over_quality,
+            stripe_height=s.stripe_height,
+            display_id=display_id,
+            watermark_path=s.watermark_path,
+            watermark_location=s.watermark_location,
+        )
+
+    def _ensure_capture(self, display_id: str) -> None:
+        if any(c.video_active for c in self.clients.values()):
+            cap = self.captures.get(display_id)
+            if cap is None:
+                cap = self._capture_factory()
+                self.captures[display_id] = cap
+            if not cap.is_capturing():
+                loop = self._loop
+                assert loop is not None
+
+                def cb(chunk: EncodedChunk) -> None:
+                    # thread -> loop boundary: the ONLY entry point
+                    # (reference selkies.py:4294)
+                    loop.call_soon_threadsafe(self._do_fanout, chunk)
+
+                cap.start_capture(cb, self._capture_settings(display_id))
+                logger.info("capture started for display %s", display_id)
+
+    def _maybe_stop_captures(self) -> None:
+        """Stop capture after the reconnect grace window if nobody watches
+        (reference keeps encoders warm 3 s across reloads)."""
+        if any(c.video_active for c in self.clients.values()):
+            return
+
+        async def _grace():
+            await asyncio.sleep(self.settings.reconnect_grace_s)
+            if not any(c.video_active for c in self.clients.values()):
+                for did, cap in self.captures.items():
+                    cap.stop_capture()
+                    logger.info("capture stopped for display %s", did)
+
+        if self._grace_task is None or self._grace_task.done():
+            self._grace_task = asyncio.create_task(_grace())
+
+    # ---------------------------------------------------------------- fanout
+    def _do_fanout(self, chunk: EncodedChunk) -> None:
+        """Runs on the loop; wire-frames once, offers to every relay.
+        Synchronous — no awaits (reference selkies.py:4234-4292)."""
+        if chunk.output_mode == "jpeg":
+            frame = P.pack_jpeg_stripe(chunk.frame_id, chunk.stripe_y,
+                                       chunk.payload)
+        else:
+            frame = P.pack_h264_stripe(chunk.frame_id, chunk.stripe_y,
+                                       chunk.width, chunk.height,
+                                       chunk.payload, idr=chunk.is_idr)
+        metrics.inc_counter("selkies_frames_encoded_total")
+        for c in self.clients.values():
+            if not c.video_active or c.paused:
+                continue
+            relay = c.relays.get(chunk.display_id)
+            if relay is None or relay.dead:
+                continue
+            c.last_sent_id = chunk.frame_id
+            relay.offer(frame)
+
+    # ------------------------------------------------------------- endpoint
+    async def ws_endpoint(self, request: web.Request) -> web.WebSocketResponse:
+        ws = web.WebSocketResponse(max_msg_size=P.WS_MESSAGE_SIZE_HARD_CAP,
+                                   compress=False)  # media must not deflate
+        await ws.prepare(request)
+        role = request.get("role", "full")
+        raddr = request.remote or "?"
+
+        # reconnect debounce per IP (reference selkies.py:2202-2217)
+        now = time.monotonic()
+        last = self._last_conn_by_ip.get(raddr, 0.0)
+        self._last_conn_by_ip[raddr] = now
+        if now - last < RECONNECT_DEBOUNCE_S:
+            await asyncio.sleep(RECONNECT_DEBOUNCE_S)
+
+        # sharing enforcement
+        if not self.settings.enable_sharing and self.clients:
+            await ws.close(code=4000, message=b"sharing disabled")
+            return ws
+
+        client = ClientConnection(ws, role, raddr)
+        # only the first full client gets input authority unless collab
+        if role == "full" and not self.settings.enable_collab:
+            if any(c.role == "full" for c in self.clients.values()):
+                client.role = "viewonly"
+        self.clients[client.id] = client
+        metrics.set_gauge("selkies_clients", len(self.clients))
+        logger.info("client %d connected (%s, %s)", client.id, client.role, raddr)
+
+        try:
+            await ws.send_str("MODE websockets")
+            await ws.send_str(self._server_settings_payload())
+            async for msg in ws:
+                if msg.type == WSMsgType.TEXT:
+                    await self._on_text(client, msg.data)
+                elif msg.type == WSMsgType.BINARY:
+                    await self._on_binary(client, msg.data)
+                elif msg.type == WSMsgType.ERROR:
+                    break
+        finally:
+            await self._disconnect(client)
+        return ws
+
+    async def _disconnect(self, client: ClientConnection) -> None:
+        self.clients.pop(client.id, None)
+        for relay in client.relays.values():
+            await relay.close()
+        client.relays.clear()
+        # release held keys/gamepads when the driver seat leaves
+        if client.role == "full" and self.input_handler is not None:
+            self.input_handler.release_all()
+        metrics.set_gauge("selkies_clients", len(self.clients))
+        self._maybe_stop_captures()
+        logger.info("client %d disconnected", client.id)
+
+    # -------------------------------------------------------------- messages
+    async def _on_binary(self, client: ClientConnection, data: bytes) -> None:
+        if not data:
+            return
+        if data[0] == P.OP_GZ_CONTROL:
+            try:
+                await self._on_text(client, P.decompress_control(data))
+            except ValueError as e:
+                logger.warning("bad 0x05 frame from client %d: %s", client.id, e)
+        elif data[0] == P.OP_MIC:
+            if self.audio is not None and self.settings.enable_microphone \
+                    and client.role == "full":
+                self.audio.play_mic_pcm(data[1:])
+
+    async def _on_text(self, client: ClientConnection, text: str) -> None:
+        verb = P.parse_verb(text)
+        name = verb.name
+
+        # viewer authority gate (reference input_handler.py:110-128)
+        if client.role == "viewonly" and name not in P.VIEWER_ALLOWED_PREFIXES:
+            return
+
+        handler = {
+            "_gz": self._h_gz, "SETTINGS": self._h_settings,
+            "CLIENT_FRAME_ACK": self._h_ack,
+            "START_VIDEO": self._h_start_video, "STOP_VIDEO": self._h_stop_video,
+            "REQUEST_KEYFRAME": self._h_keyframe,
+            "START_AUDIO": self._h_start_audio, "STOP_AUDIO": self._h_stop_audio,
+            "r": self._h_resize, "s": self._h_dpi,
+            "vb": self._h_video_bitrate, "ab": self._h_audio_bitrate,
+            "pong": self._h_pong, "_f": self._h_client_fps,
+            "_l": self._h_client_latency,
+            "SET_NATIVE_CURSOR_RENDERING": self._h_cursor_mode,
+        }.get(name)
+        if handler is not None:
+            await handler(client, verb.args)
+            return
+        if self.input_handler is not None and self.settings.enable_input:
+            await self.input_handler.on_message(text)
+
+    # ---- control verbs ------------------------------------------------------
+    async def _h_gz(self, client: ClientConnection, args: str) -> None:
+        client.gzip_ok = args.strip() == "1"
+
+    async def _h_settings(self, client: ClientConnection, args: str) -> None:
+        try:
+            body = json.loads(args)
+        except json.JSONDecodeError:
+            await client.ws.send_str("ERROR bad SETTINGS payload")
+            return
+        applied = {}
+        for k, v in body.items():
+            try:
+                applied[k] = self.settings.apply_client_setting(k, v)
+            except SettingsError as e:
+                logger.info("client %d setting rejected: %s", client.id, e)
+        if applied:
+            await self._apply_live_settings(applied)
+            await client.send_text_maybe_gz(
+                "settings_applied " + json.dumps(applied, default=list))
+
+    async def _apply_live_settings(self, applied: dict) -> None:
+        for cap in self.captures.values():
+            if "framerate" in applied:
+                cap.update_framerate(float(applied["framerate"]))
+            if "video_bitrate_kbps" in applied:
+                cap.update_video_bitrate(int(applied["video_bitrate_kbps"]))
+            if "jpeg_quality" in applied or "paint_over_quality" in applied:
+                cap.update_tunables(
+                    jpeg_quality=self.settings.jpeg_quality,
+                    paint_over_quality=self.settings.paint_over_quality)
+        # structural changes (encoder, fullcolor) need a capture rebuild
+        if {"encoder", "fullcolor"} & set(applied):
+            for did, cap in self.captures.items():
+                if cap.is_capturing():
+                    cap.start_capture(cap._callback, self._capture_settings(did))
+        if "audio_bitrate" in applied and self.audio is not None:
+            self.audio.update_bitrate(int(applied["audio_bitrate"]))
+
+    async def _h_ack(self, client: ClientConnection, args: str) -> None:
+        try:
+            acked = int(args)
+        except ValueError:
+            return
+        now = time.monotonic()
+        client.last_ack_id = acked
+        client.last_ack_time = now
+        client.fps_est.tick(now)
+        self._update_backpressure(client)
+
+    def _update_backpressure(self, client: ClientConnection) -> None:
+        """Desync window scales with measured client fps; RTT forgiveness is
+        capped upstream by the ACK cadence itself (reference
+        selkies.py:1590-1717)."""
+        dist = P.frame_id_distance(client.last_sent_id, client.last_ack_id)
+        window = max(10, int(client.fps_est.fps() *
+                             self.settings.ack_desync_frames / 60.0))
+        if not client.paused and dist > window:
+            client.paused = True
+            metrics.inc_counter("selkies_backpressure_events_total")
+            logger.info("client %d backpressured (dist %d > %d)",
+                        client.id, dist, window)
+        elif client.paused:
+            # Resume when the client caught up with everything queued — the
+            # relay drained (dropped frames never get ACKed, so distance to
+            # last_sent_id alone could deadlock the pause).
+            drained = all(r._q_bytes == 0 for r in client.relays.values())
+            if dist < window // 2 or drained:
+                client.paused = False
+                for cap in self.captures.values():
+                    cap.request_idr_frame()
+
+    async def _h_start_video(self, client: ClientConnection, args: str) -> None:
+        client.video_active = True
+        for did in (self.display_geometry or {self.settings.display_id: None}):
+            if did not in client.relays:
+                relay = VideoRelay(
+                    client.ws.send_bytes,
+                    budget_bytes=int(self.settings.video_relay_budget_s
+                                     * self.settings.video_bitrate_kbps * 125),
+                    request_idr=lambda d=did: self._request_idr(d))
+                relay.start()
+                client.relays[did] = relay
+            self._ensure_capture(did)
+        # fresh joiner needs a full frame
+        self._request_idr_all()
+        await client.ws.send_str("VIDEO_STARTED")
+
+    async def _h_stop_video(self, client: ClientConnection, args: str) -> None:
+        client.video_active = False
+        for relay in client.relays.values():
+            await relay.close()
+        client.relays.clear()
+        self._maybe_stop_captures()
+        await client.ws.send_str("VIDEO_STOPPED")
+
+    def _request_idr(self, display_id: str) -> None:
+        cap = self.captures.get(display_id)
+        if cap:
+            cap.request_idr_frame()
+
+    def _request_idr_all(self) -> None:
+        for cap in self.captures.values():
+            cap.request_idr_frame()
+
+    async def _h_keyframe(self, client: ClientConnection, args: str) -> None:
+        self._request_idr_all()
+
+    async def _h_start_audio(self, client: ClientConnection, args: str) -> None:
+        if self.audio is None or not self.settings.enable_audio:
+            await client.ws.send_str("AUDIO_DISABLED")
+            return
+        client.audio_active = True
+        self.audio.add_listener(client)
+
+    async def _h_stop_audio(self, client: ClientConnection, args: str) -> None:
+        client.audio_active = False
+        if self.audio is not None:
+            self.audio.remove_listener(client)
+
+    async def _h_resize(self, client: ClientConnection, args: str) -> None:
+        if not self.settings.enable_resize:
+            return
+        try:
+            w, h = (int(v) for v in args.lower().split("x"))
+        except ValueError:
+            return
+        did = self.settings.display_id
+        self.display_geometry[did] = (max(64, min(w, 16384)),
+                                      max(64, min(h, 16384)))
+        cap = self.captures.get(did)
+        if cap and cap.is_capturing():
+            cap.update_capture_region(0, 0, *self.display_geometry[did])
+        # broadcast realized geometry
+        payload = self._server_settings_payload()
+        for c in self.clients.values():
+            await c.send_text_maybe_gz(payload)
+
+    async def _h_dpi(self, client: ClientConnection, args: str) -> None:
+        try:
+            self.settings.apply_client_setting("dpi", int(args))
+        except (SettingsError, ValueError):
+            pass
+
+    async def _h_video_bitrate(self, client: ClientConnection, args: str) -> None:
+        try:
+            kbps = int(args)
+        except ValueError:
+            return
+        try:
+            self.settings.apply_client_setting("video_bitrate_kbps", kbps)
+        except SettingsError:
+            return
+        for cap in self.captures.values():
+            cap.update_video_bitrate(kbps)
+
+    async def _h_audio_bitrate(self, client: ClientConnection, args: str) -> None:
+        if self.audio is None:
+            return
+        try:
+            self.audio.update_bitrate(int(args))
+        except ValueError:
+            pass
+
+    async def _h_pong(self, client: ClientConnection, args: str) -> None:
+        pass
+
+    async def _h_client_fps(self, client: ClientConnection, args: str) -> None:
+        try:
+            client.reported_fps = float(args)
+            metrics.set_gauge("selkies_fps", client.reported_fps,
+                              {"client": str(client.id)})
+            metrics.observe_hist("selkies_fps_hist", client.reported_fps)
+        except ValueError:
+            pass
+
+    async def _h_client_latency(self, client: ClientConnection, args: str) -> None:
+        try:
+            client.reported_latency_ms = float(args)
+            metrics.set_gauge("selkies_latency_ms", client.reported_latency_ms,
+                              {"client": str(client.id)})
+        except ValueError:
+            pass
+
+    async def _h_cursor_mode(self, client: ClientConnection, args: str) -> None:
+        pass  # cursor streaming lands with the cursor monitor
+
+    # ----------------------------------------------------------------- stats
+    async def _stats_loop(self) -> None:
+        """Periodic per-client system stats (reference selkies.py:4586-4722)."""
+        import psutil
+        while self._running:
+            await asyncio.sleep(self.settings.stats_interval_s)
+            stalled = time.monotonic() - ACK_STALL_S
+            for c in list(self.clients.values()):
+                # ACK stall forces backpressure (reference 4 s rule)
+                if c.video_active and not c.paused \
+                        and c.last_sent_id != c.last_ack_id \
+                        and c.last_ack_time < stalled:
+                    c.paused = True
+                    metrics.inc_counter("selkies_backpressure_events_total")
+            try:
+                stats = {
+                    "type": "system_stats",
+                    "cpu_percent": psutil.cpu_percent(),
+                    "mem_percent": psutil.virtual_memory().percent,
+                    "clients": len(self.clients),
+                    "encoded_fps": {
+                        did: cap.encoded_fps
+                        for did, cap in self.captures.items()},
+                }
+                text = "system_stats " + json.dumps(stats)
+                for c in list(self.clients.values()):
+                    try:
+                        await c.send_text_maybe_gz(text)
+                    except (ConnectionError, RuntimeError):
+                        pass
+            except Exception:
+                logger.exception("stats loop error")

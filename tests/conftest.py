@@ -5,6 +5,8 @@ Multi-chip hardware is not available in CI; all sharding tests run against
 Must run before jax is imported anywhere.
 """
 
+import asyncio
+import inspect
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # host may pre-set axon; tests are CPU-only
@@ -13,3 +15,16 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests without pytest-asyncio (absent from this
+    image). Sync fixtures still resolve; async fixtures are not supported —
+    use async context managers inside the test instead."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {n: pyfuncitem.funcargs[n]
+                  for n in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

@@ -1,0 +1,299 @@
+"""HTTP core + WS service tests with a fake capture (protocol-level client
+simulators — the test strategy SURVEY.md §4 says the reference lacks)."""
+
+import asyncio
+import base64
+import json
+import threading
+import time
+
+import pytest
+from aiohttp import WSMsgType, web
+from aiohttp.test_utils import TestClient, TestServer
+
+from selkies_tpu import protocol as P
+from selkies_tpu.engine.types import CaptureSettings, EncodedChunk
+from selkies_tpu.input.backends import NullBackend
+from selkies_tpu.input.handler import InputHandler
+from selkies_tpu.server.core import CentralizedStreamServer
+from selkies_tpu.server.ws_service import WebSocketsService
+from selkies_tpu.settings import AppSettings
+
+
+class FakeCapture:
+    """Emits one JPEG-ish chunk per start/idr; no TPU, no threads."""
+
+    def __init__(self):
+        self._cb = None
+        self._settings = None
+        self._capturing = False
+        self.fid = 0
+        self.idr_requests = 0
+        self.encoded_fps = 42.0
+        self._callback = None
+
+    def start_capture(self, cb, settings):
+        self._cb = self._callback = cb
+        self._settings = settings
+        self._capturing = True
+        self.emit()
+
+    def stop_capture(self):
+        self._capturing = False
+
+    def is_capturing(self):
+        return self._capturing
+
+    def request_idr_frame(self):
+        self.idr_requests += 1
+        if self._capturing:
+            self.emit()
+
+    def update_framerate(self, fps): ...
+    def update_video_bitrate(self, kbps): ...
+    def update_tunables(self, **kw): ...
+    def update_capture_region(self, x, y, w, h): ...
+
+    def emit(self, n=1):
+        for _ in range(n):
+            self._cb(EncodedChunk(
+                payload=b"\xff\xd8FAKEJPEG\xff\xd9", frame_id=self.fid,
+                stripe_y=0, width=64, height=64, is_idr=True,
+                output_mode="jpeg", display_id=":0"))
+            self.fid += 1
+
+
+def make_app(env=None, **fields):
+    s = AppSettings.parse([], env or {})
+    for k, v in fields.items():
+        s.set_server(k, v)
+    fake = FakeCapture()
+    handler = InputHandler(backend=NullBackend())
+    svc = WebSocketsService(s, input_handler=handler,
+                            capture_factory=lambda: fake)
+    server = CentralizedStreamServer(s)
+    server.register_service("websockets", svc)
+    return server, svc, fake, handler
+
+
+class serve:
+    """Async context manager: starts the service + a test client."""
+
+    def __init__(self, server):
+        self.server = server
+
+    async def __aenter__(self) -> TestClient:
+        await self.server.switch_to_mode("websockets")
+        await asyncio.sleep(0)  # let the service start() task run
+        self.client = TestClient(TestServer(self.server.app))
+        await self.client.start_server()
+        return self.client
+
+    async def __aexit__(self, *exc):
+        await self.server.shutdown()
+        await self.client.close()
+
+
+async def test_status_and_health(client_factory):
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    r = await c.get("/api/status")
+    body = await r.json()
+    assert r.status == 200 and body["mode"] == "websockets"
+    r = await c.get("/api/health")
+    assert (await r.json())["ok"] is True
+
+
+async def test_basic_auth_and_viewonly(client_factory):
+    server, svc, fake, _ = make_app(
+        enable_basic_auth=True, basic_auth_user="u",
+        basic_auth_password="pw", viewonly_password="vo")
+    c = await client_factory(server)
+    assert (await c.get("/api/status")).status == 401
+    hdr = {"Authorization": "Basic " + base64.b64encode(b"u:pw").decode()}
+    r = await c.get("/api/status", headers=hdr)
+    assert r.status == 200 and (await r.json())["role"] == "full"
+    hdr = {"Authorization": "Basic " + base64.b64encode(b"u:vo").decode()}
+    r = await c.get("/api/status", headers=hdr)
+    assert (await r.json())["role"] == "viewonly"
+    hdr = {"Authorization": "Basic " + base64.b64encode(b"u:nope").decode()}
+    assert (await c.get("/api/status", headers=hdr)).status == 401
+
+
+async def test_master_token_bearer(client_factory):
+    server, *_ = make_app(enable_basic_auth=True, basic_auth_user="u",
+                          basic_auth_password="pw", master_token="tok123")
+    c = await client_factory(server)
+    r = await c.get("/api/status",
+                    headers={"Authorization": "Bearer tok123"})
+    assert r.status == 200 and (await r.json())["role"] == "full"
+    assert (await c.get(
+        "/api/status", headers={"Authorization": "Bearer bad"})).status == 401
+
+
+async def test_ws_handshake_and_video(client_factory):
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    assert (await ws.receive_str()) == "MODE websockets"
+    settings_msg = await ws.receive_str()
+    assert settings_msg.startswith("server_settings ")
+    payload = json.loads(settings_msg.split(" ", 1)[1])
+    assert payload["settings"]["framerate"]["value"] == 60
+    assert payload["features"]["resize"] is True
+
+    await ws.send_str("START_VIDEO")
+    got_binary = None
+    for _ in range(10):
+        msg = await ws.receive(timeout=5)
+        if msg.type == WSMsgType.BINARY and msg.data[0] == P.OP_JPEG:
+            got_binary = msg.data
+            break
+        if msg.type == WSMsgType.TEXT:
+            continue
+    assert got_binary is not None
+    flags, fid, y = P.unpack_jpeg_header(got_binary)
+    assert got_binary[6:8] == b"\xff\xd8"
+    await ws.close()
+
+
+async def test_keyframe_request_reaches_capture(client_factory):
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("START_VIDEO")
+    await asyncio.sleep(0.1)
+    before = fake.idr_requests
+    await ws.send_str("REQUEST_KEYFRAME")
+    await asyncio.sleep(0.1)
+    assert fake.idr_requests > before
+    await ws.close()
+
+
+async def test_input_verbs_reach_backend(client_factory):
+    server, svc, fake, handler = make_app()
+    backend = handler.backend
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("kd,65")
+    await ws.send_str("m,100,200")
+    await ws.send_str("mb,1,1")
+    await ws.send_str("ku,65")
+    await asyncio.sleep(0.2)
+    assert ("key", 65, True) in backend.events
+    assert ("motion", 100, 200) in backend.events
+    assert ("button", 1, True) in backend.events
+    assert ("key", 65, False) in backend.events
+    await ws.close()
+
+
+async def test_viewonly_client_cannot_inject(client_factory):
+    server, svc, fake, handler = make_app(
+        enable_basic_auth=True, basic_auth_user="u",
+        basic_auth_password="pw", viewonly_password="vo")
+    backend = handler.backend
+    c = await client_factory(server)
+    hdr = {"Authorization": "Basic " + base64.b64encode(b"u:vo").decode()}
+    ws = await c.ws_connect("/api/websockets", headers=hdr)
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("kd,65")
+    await ws.send_str("REQUEST_KEYFRAME")   # allowed for viewers
+    await asyncio.sleep(0.2)
+    assert ("key", 65, True) not in backend.events
+    await ws.close()
+
+
+async def test_second_full_client_demoted_without_collab(client_factory):
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws1 = await c.ws_connect("/api/websockets")
+    await ws1.receive_str(); await ws1.receive_str()
+    await asyncio.sleep(0.6)  # reconnect debounce window
+    ws2 = await c.ws_connect("/api/websockets")
+    await ws2.receive_str(); await ws2.receive_str()
+    roles = sorted(cl.role for cl in svc.clients.values())
+    assert roles == ["full", "viewonly"]
+    await ws1.close(); await ws2.close()
+
+
+async def test_settings_verb_applies_and_rejects(client_factory):
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str('SETTINGS,{"framerate": 30, "master_token": "evil", "video_crf": 999}')
+    msg = await ws.receive_str()
+    assert msg.startswith("settings_applied ")
+    applied = json.loads(msg.split(" ", 1)[1])
+    assert applied == {"framerate": 30}
+    assert svc.settings.framerate == 30
+    assert svc.settings.master_token == ""
+    await ws.close()
+
+
+async def test_resize_updates_geometry(client_factory):
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("r,2560x1440")
+    msg = await ws.receive_str()
+    payload = json.loads(msg.split(" ", 1)[1])
+    assert payload["displays"][0]["width"] == 2560
+    assert svc.display_geometry[":0"] == (2560, 1440)
+    await ws.close()
+
+
+async def test_upload_and_download(tmp_path, client_factory):
+    server, svc, fake, _ = make_app(file_transfer_dir=str(tmp_path))
+    c = await client_factory(server)
+    data1, data2 = b"A" * 1000, b"B" * 500
+    r = await c.post("/api/upload", data=data1, headers={
+        "X-Upload-Name": "test.bin", "X-Upload-Offset": "0",
+        "X-Upload-Total": str(len(data1) + len(data2))})
+    assert (await r.json())["complete"] is False
+    r = await c.post("/api/upload", data=data2, headers={
+        "X-Upload-Name": "test.bin", "X-Upload-Offset": str(len(data1)),
+        "X-Upload-Total": str(len(data1) + len(data2))})
+    assert (await r.json())["complete"] is True
+    assert (tmp_path / "test.bin").read_bytes() == data1 + data2
+    r = await c.get("/api/files")
+    assert (await r.json())["files"][0]["name"] == "test.bin"
+    r = await c.get("/api/files/test.bin")
+    assert await r.read() == data1 + data2
+
+
+async def test_upload_path_traversal_rejected(tmp_path, client_factory):
+    server, *_ = make_app(file_transfer_dir=str(tmp_path))
+    c = await client_factory(server)
+    r = await c.post("/api/upload", data=b"x", headers={
+        "X-Upload-Name": "../../etc/passwd", "X-Upload-Offset": "0"})
+    assert r.status == 400
+
+
+async def test_metrics_endpoint(client_factory):
+    server, *_ = make_app()
+    c = await client_factory(server)
+    r = await c.get("/api/metrics")
+    text = await r.text()
+    assert r.status == 200 and "# TYPE" in text
+
+
+async def test_gzip_control_roundtrip(client_factory):
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("_gz,1")
+    big = {"framerate": 24, "pad": "x" * 2000}
+    framed = P.maybe_compress_text("SETTINGS," + json.dumps(big))
+    assert isinstance(framed, bytes)
+    await ws.send_bytes(framed)
+    msg = await ws.receive()
+    # reply may itself be gzip'd now that the client negotiated _gz
+    text = (P.decompress_control(msg.data)
+            if msg.type == WSMsgType.BINARY else msg.data)
+    assert "framerate" in text and svc.settings.framerate == 24
+    await ws.close()
