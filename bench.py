@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Headline benchmark: steady-state 1080p stripe-encode on the default JAX
+backend (the driver runs this on one real TPU chip).
+
+Measures the engine exactly as the server drives it (JpegEncoderSession:
+device CSC + DCT + quant + Huffman bit-pack + stripe concat, host 0xFF
+stuffing + JFIF wrap):
+
+- **throughput**: frames/s with the capture thread's PIPELINE_DEPTH-deep
+  dispatch/finalize pipelining (host link RTT hidden, like production);
+- **latency**: unpipelined per-frame dispatch->wire-bytes time, p50/p99.
+
+North star (BASELINE.md): 1080p60, p99 < 16 ms. ``vs_baseline`` is
+throughput / 60 fps — the reference's published floor (README.md:7).
+
+Prints exactly ONE JSON line on stdout; progress goes to stderr.
+Knobs: BENCH_FRAMES, BENCH_WIDTH/BENCH_HEIGHT, BENCH_QUALITY.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    from selkies_tpu.engine.encoder import JpegEncoderSession
+    from selkies_tpu.engine.sources import SyntheticSource
+    from selkies_tpu.engine.types import CaptureSettings
+
+    backend = jax.default_backend()
+    w = int(os.environ.get("BENCH_WIDTH", "1920"))
+    h = int(os.environ.get("BENCH_HEIGHT", "1080"))
+    default_frames = 240 if backend != "cpu" else 12
+    n_frames = int(os.environ.get("BENCH_FRAMES", str(default_frames)))
+    quality = int(os.environ.get("BENCH_QUALITY", "60"))
+
+    settings = CaptureSettings(
+        capture_width=w, capture_height=h, jpeg_quality=quality,
+        stripe_height=64, use_damage_gating=True, use_paint_over=False)
+    sess = JpegEncoderSession(settings)
+    g = sess.grid
+    # generate at the padded grid size so the measured loop is pure encode
+    src = SyntheticSource(g.width, g.height)
+    log(f"backend={backend} size={w}x{h} grid={g.width}x{g.height} "
+        f"stripes={g.n_stripes} frames={n_frames}")
+
+    # -- warmup / compile ----------------------------------------------------
+    t0 = time.monotonic()
+    for t in range(3):
+        sess.finalize(sess.encode(src.get_frame(t)), force_all=True)
+    log(f"compile+warmup: {time.monotonic() - t0:.1f}s")
+
+    # -- latency: unpipelined dispatch -> wire bytes -------------------------
+    lat = []
+    n_lat = max(10, n_frames // 4)
+    total_bytes = 0
+    for t in range(n_lat):
+        f = src.get_frame(100 + t)
+        jax.block_until_ready(f)          # exclude frame synthesis
+        t0 = time.monotonic()
+        chunks = sess.finalize(sess.encode(f), force_all=True)
+        lat.append(time.monotonic() - t0)
+        total_bytes += sum(len(c.payload) for c in chunks)
+    lat.sort()
+    p50 = lat[len(lat) // 2] * 1e3
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3
+    log(f"latency p50={p50:.2f}ms p99={p99:.2f}ms "
+        f"avg_frame_bytes={total_bytes // n_lat}")
+
+    # -- throughput: pipelined like the capture thread -----------------------
+    from selkies_tpu.engine.capture import PIPELINE_DEPTH
+    import collections
+    inflight = collections.deque()
+    t0 = time.monotonic()
+    done = 0
+    for t in range(n_frames):
+        inflight.append(sess.encode(src.get_frame(1000 + t)))
+        if len(inflight) > PIPELINE_DEPTH:
+            sess.finalize(inflight.popleft(), force_all=True)
+            done += 1
+    while inflight:
+        sess.finalize(inflight.popleft(), force_all=True)
+        done += 1
+    dt = time.monotonic() - t0
+    fps = done / dt
+    log(f"throughput: {done} frames in {dt:.2f}s -> {fps:.1f} fps")
+
+    mbps = total_bytes / n_lat * fps * 8 / 1e6
+    print(json.dumps({
+        "metric": f"encode_fps_{w}x{h}_jpeg_tpu",
+        "value": round(fps, 2),
+        "unit": "fps",
+        "vs_baseline": round(fps / 60.0, 3),
+        "latency_p50_ms": round(p50, 2),
+        "latency_p99_ms": round(p99, 2),
+        "bitrate_mbps": round(mbps, 1),
+        "backend": backend,
+        "frames": n_frames,
+    }))
+
+
+if __name__ == "__main__":
+    main()

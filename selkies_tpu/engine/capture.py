@@ -57,6 +57,9 @@ class ScreenCapture:
         self._cursor_callback = None
         self._force_idr = threading.Event()
         self._lock = threading.Lock()
+        # serialises start/stop/restart/region calls: the service runs them
+        # on executor threads, so two clients' reconfigures may race
+        self._api_lock = threading.RLock()
         self._tunables_dirty: dict = {}
         # stats for rate control / observability
         self.last_frame_bytes = 0
@@ -67,28 +70,30 @@ class ScreenCapture:
                       settings: CaptureSettings) -> None:
         """Start (or live-reconfigure, reference media_pipeline.py:580-590)
         the capture/encode loop."""
-        if self.is_capturing():
-            self.stop_capture()
-        self._callback = callback
-        self._settings = settings
-        self._session = JpegEncoderSession(settings)
-        self._source = make_source(self._source_kind,
-                                   settings.capture_width,
-                                   settings.capture_height,
-                                   settings.display_id)
-        self._running.set()
-        self._thread = threading.Thread(target=self._run, name="tpuflux-capture",
-                                        daemon=True)
-        self._thread.start()
+        with self._api_lock:
+            if self.is_capturing():
+                self.stop_capture()
+            self._callback = callback
+            self._settings = settings
+            self._session = JpegEncoderSession(settings)
+            self._source = make_source(self._source_kind,
+                                       settings.capture_width,
+                                       settings.capture_height,
+                                       settings.display_id)
+            self._running.set()
+            self._thread = threading.Thread(
+                target=self._run, name="tpuflux-capture", daemon=True)
+            self._thread.start()
 
     def stop_capture(self) -> None:
-        self._running.clear()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
-        if self._source is not None:
-            self._source.close()
-            self._source = None
+        with self._api_lock:
+            self._running.clear()
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+                self._thread = None
+            if self._source is not None:
+                self._source.close()
+                self._source = None
 
     def is_capturing(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
@@ -113,12 +118,25 @@ class ScreenCapture:
     def update_capture_region(self, x: int, y: int, w: int, h: int) -> None:
         # live region retarget (reference pixelflux x11 path); requires a
         # session rebuild when the size changes.
-        assert self._settings is not None
-        self._settings.capture_x, self._settings.capture_y = x, y
-        if (w, h) != (self._settings.capture_width, self._settings.capture_height):
-            self._settings.capture_width, self._settings.capture_height = w, h
-            if self._callback is not None:
-                self.start_capture(self._callback, self._settings)
+        with self._api_lock:
+            assert self._settings is not None
+            self._settings.capture_x, self._settings.capture_y = x, y
+            if (w, h) != (self._settings.capture_width,
+                          self._settings.capture_height):
+                self._settings.capture_width = w
+                self._settings.capture_height = h
+                if self._callback is not None:
+                    self.start_capture(self._callback, self._settings)
+
+    def restart(self, settings: Optional[CaptureSettings] = None) -> None:
+        """Blocking structural restart keeping the registered callback.
+
+        Joins the capture thread — callers on an asyncio loop must run this
+        in an executor (the latency discipline SURVEY §7 hard-part #4)."""
+        with self._api_lock:
+            if self._callback is None:
+                raise RuntimeError("restart before start_capture")
+            self.start_capture(self._callback, settings or self._settings)
 
     def set_cursor_callback(self, cb) -> None:
         self._cursor_callback = cb
@@ -160,6 +178,7 @@ class ScreenCapture:
         tick = 0
         window_bytes, window_start = 0, time.monotonic()
         fps_frames = 0
+        last_full = time.monotonic()
         try:
             while self._running.is_set():
                 t0 = time.monotonic()
@@ -168,9 +187,16 @@ class ScreenCapture:
                 if pad is not None:
                     frame = pad(frame)
                 out = sess.encode(frame)
-                out["force"] = self._force_idr.is_set()
-                if out["force"]:
+                # periodic full refresh (keyframe_interval_s) on top of
+                # client-requested IDRs; <=0 disables the cadence
+                force = self._force_idr.is_set()
+                if s.keyframe_interval_s > 0 \
+                        and t0 - last_full >= s.keyframe_interval_s:
+                    force = True
+                if force:
+                    last_full = t0
                     self._force_idr.clear()
+                out["force"] = force
                 inflight.append(out)
                 if len(inflight) > PIPELINE_DEPTH:
                     window_bytes += self._deliver(inflight.popleft())

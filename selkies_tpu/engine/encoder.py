@@ -62,19 +62,23 @@ def _plan_grid(s: CaptureSettings) -> _Grid:
                  out_w=s.capture_width, out_h=s.capture_height)
 
 
-@functools.cache
-def _jitted_step(width: int, stripe_h: int, n_stripes: int, subsampling: str,
-                 e_cap: int, w_cap: int, out_cap: int, paint_delay: int,
-                 damage_gating: bool, paint_over: bool):
-    """Build the per-shape compiled encode step.
+plan_grid = _plan_grid  # public name for the parallel / h264 modules
+
+
+def build_step_fn(width: int, stripe_h: int, n_stripes: int, subsampling: str,
+                  e_cap: int, w_cap: int, out_cap: int, paint_delay: int,
+                  damage_gating: bool, paint_over: bool):
+    """Build the (unjitted) per-frame encode step.
 
     Signature: step(frame u8 (H,W,3), prev u8 (H,W,3), age i32 (S,),
                     qy_motion/qc_motion/qy_paint/qc_paint f32 (64,))
     -> (data u8 (out_cap,), byte_lens i32 (S,), send bool (S,),
         is_paint bool (S,), age i32 (S,), overflow bool)
 
-    Only the internal ``age`` state is donated; ``prev`` is the caller's
-    previous frame array and sources are free to reuse their buffers.
+    The single-seat session jits this directly; the multi-seat encoder
+    (selkies_tpu/parallel/seats.py) vmaps it and shard_maps the batch over
+    a ``Mesh('seat')`` — per-seat encode has no cross-seat data flow, so
+    the sharded step runs collective-free on ICI-connected devices.
     """
     from ..ops.jpeg_pipeline import jpeg_encode_device
 
@@ -104,7 +108,20 @@ def _jitted_step(width: int, stripe_h: int, n_stripes: int, subsampling: str,
         overflow = jnp.any(packed.overflow) | buf.overflow
         return buf.data, buf.byte_lens, send, is_paint, age, overflow
 
-    return jax.jit(step, donate_argnums=(2,))
+    return step
+
+
+@functools.cache
+def _jitted_step(width: int, stripe_h: int, n_stripes: int, subsampling: str,
+                 e_cap: int, w_cap: int, out_cap: int, paint_delay: int,
+                 damage_gating: bool, paint_over: bool):
+    """Compiled single-seat step; only the internal ``age`` state is donated
+    — ``prev`` is the caller's previous frame array and sources are free to
+    reuse their buffers."""
+    return jax.jit(build_step_fn(width, stripe_h, n_stripes, subsampling,
+                                 e_cap, w_cap, out_cap, paint_delay,
+                                 damage_gating, paint_over),
+                   donate_argnums=(2,))
 
 
 class JpegEncoderSession:
@@ -128,6 +145,9 @@ class JpegEncoderSession:
         self.frame_id = 0
         self._age = jnp.zeros((g.n_stripes,), jnp.int32)
         self._prev = jnp.zeros((g.height, g.width, 3), jnp.uint8)
+        # set after a dropped (overflowed) frame: the client never saw it, so
+        # damage diffs against it would leave stale stripes on glass forever.
+        self._force_after_drop = False
         self.update_quality(settings.jpeg_quality, settings.paint_over_quality)
 
     def _build_step(self):
@@ -174,14 +194,21 @@ class JpegEncoderSession:
                 arr.copy_to_host_async()
             except Exception:  # interpret/CPU backends may not support it
                 pass
+        # Snapshot the quant tables that were live at DISPATCH time: finalize
+        # runs PIPELINE_DEPTH frames later, and a quality change in between
+        # must not make the JFIF DQT disagree with the tables the device
+        # actually quantized with.
         return {"data": data, "lens": lens, "send": send,
-                "is_paint": is_paint, "overflow": overflow, "frame_id": fid}
+                "is_paint": is_paint, "overflow": overflow, "frame_id": fid,
+                "qtabs": (self._qy_m_np, self._qc_m_np,
+                          self._qy_p_np, self._qc_p_np)}
 
     # -- host tail ----------------------------------------------------------
-    def _jfif_wrap(self, scan: bytes, paint: bool) -> bytes:
+    def _jfif_wrap(self, scan: bytes, paint: bool, qtabs) -> bytes:
         g = self.grid
-        qy = self._qy_p_np if paint else self._qy_m_np
-        qc = self._qc_p_np if paint else self._qc_m_np
+        qy_m, qc_m, qy_p, qc_p = qtabs
+        qy = qy_p if paint else qy_m
+        qc = qc_p if paint else qc_m
         return jtab.assemble_jfif(g.stripe_h, g.width, scan, qy, qc,
                                   self.subsampling)
 
@@ -194,11 +221,18 @@ class JpegEncoderSession:
                            out["frame_id"])
             # Event overflow is impossible (e_cap is worst-case), so this is
             # a word/output buffer overflow: drop the frame, double the
-            # growable buffers, recompile once.
+            # growable buffers, recompile once. The client never saw this
+            # frame, but _prev already advanced past it — force the next
+            # delivered frame to resend every stripe so damage gating can't
+            # freeze stale content on glass.
             self._w_cap *= 2
             self._out_cap *= 2
             self._step = self._build_step()
+            self._force_after_drop = True
             return []
+        if self._force_after_drop:
+            self._force_after_drop = False
+            force_all = True
         data = np.asarray(out["data"])
         lens = np.asarray(out["lens"])
         send = np.asarray(out["send"])
@@ -211,7 +245,7 @@ class JpegEncoderSession:
             raw = data[starts[i]:starts[i] + lens[i]]
             scan = stuff_ff_bytes(raw)
             chunks.append(EncodedChunk(
-                payload=self._jfif_wrap(scan, paint=bool(is_paint[i])),
+                payload=self._jfif_wrap(scan, bool(is_paint[i]), out["qtabs"]),
                 frame_id=out["frame_id"], stripe_y=i * g.stripe_h,
                 width=g.width, height=g.stripe_h, is_idr=True,
                 output_mode="jpeg",

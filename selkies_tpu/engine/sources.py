@@ -170,6 +170,10 @@ def make_source(kind: str, width: int, height: int, display: str = ":0"
     and falls back to the synthetic pattern."""
     if kind == "synthetic":
         return SyntheticSource(width, height)
+    if kind == "synthetic-static":
+        # freezes after the first frame: exercises damage gating, paint-over
+        # and the keyframe_interval refresh without X
+        return SyntheticSource(width, height, static_after=0)
     if kind == "x11":
         return X11Source(display, width, height)
     if kind == "auto":

@@ -76,7 +76,11 @@ class InputHandler:
         self.clipboard_max = clipboard_max_bytes
         self.send_clipboard = send_clipboard
         self._now = now  # injectable for deterministic tests
-        self.pressed: dict[int, float] = {}    # keysym -> last heartbeat
+        # keysym -> (first press time, last heartbeat time). Kept separate:
+        # repeat delay is measured from the PRESS, staleness from the last
+        # heartbeat — conflating them lets a fast-heartbeating client reset
+        # the repeat delay forever and suppress auto-repeat entirely.
+        self.pressed: dict[int, tuple[float, float]] = {}
         self.gamepads = [GamepadState(i) for i in range(4)]
         self._multipart: Optional[dict] = None
         self._repeat_task: Optional[asyncio.Task] = None
@@ -106,17 +110,34 @@ class InputHandler:
             self.backend.key(ks, False)
         self.pressed.clear()
 
+    def sweep_stale_once(self) -> list[int]:
+        """One stale-key pass: release keys without a heartbeat for
+        ``STALE_KEY_S`` (reference input_handler.py:2408-2467)."""
+        cutoff = self._now() - STALE_KEY_S
+        released = []
+        for ks, (_first, hb) in list(self.pressed.items()):
+            if hb < cutoff:
+                logger.info("releasing stale key %d", ks)
+                self.backend.key(ks, False)
+                self.pressed.pop(ks, None)
+                released.append(ks)
+        return released
+
+    def repeat_once(self) -> list[int]:
+        """One auto-repeat pass: re-press repeatable keys held beyond the
+        delay (measured from PRESS time, not heartbeat time)."""
+        now = self._now()
+        repeated = []
+        for ks, (first, _hb) in self.pressed.items():
+            if now - first > REPEAT_DELAY_S and _is_repeatable(ks):
+                self.backend.key(ks, True)
+                repeated.append(ks)
+        return repeated
+
     async def _stale_sweep(self) -> None:
-        """Stuck-key recovery: client died mid-hold -> release after 2 s
-        without heartbeat (reference input_handler.py:2408-2467)."""
         while True:
             await asyncio.sleep(STALE_KEY_S / 2)
-            cutoff = self._now() - STALE_KEY_S
-            for ks, ts in list(self.pressed.items()):
-                if ts < cutoff:
-                    logger.info("releasing stale key %d", ks)
-                    self.backend.key(ks, False)
-                    self.pressed.pop(ks, None)
+            self.sweep_stale_once()
 
     async def _repeat_loop(self) -> None:
         """XTEST holds don't trigger X native auto-repeat; synthesise it
@@ -124,11 +145,7 @@ class InputHandler:
         period = 1.0 / REPEAT_HZ
         while True:
             await asyncio.sleep(period)
-            now = self._now()
-            for ks, first in self.pressed.items():
-                # repeat only keys held beyond the delay; re-press them
-                if now - first > REPEAT_DELAY_S and _is_repeatable(ks):
-                    self.backend.key(ks, True)
+            self.repeat_once()
 
     # --------------------------------------------------------------- dispatch
     async def on_message(self, text: str) -> None:
@@ -145,7 +162,8 @@ class InputHandler:
         if len(self.pressed) >= MAX_PRESSED_KEYS:
             return  # kd flood
         if ks not in self.pressed:
-            self.pressed[ks] = self._now()
+            now = self._now()
+            self.pressed[ks] = (now, now)
             self.backend.key(ks, True)
 
     async def _v_ku(self, args: str) -> None:
@@ -162,7 +180,7 @@ class InputHandler:
             if part:
                 ks = int(part)
                 if ks in self.pressed:
-                    self.pressed[ks] = now
+                    self.pressed[ks] = (self.pressed[ks][0], now)
 
     # pointer ----------------------------------------------------------------
     async def _v_m(self, args: str) -> None:

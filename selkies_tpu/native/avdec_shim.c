@@ -1,0 +1,117 @@
+/* Minimal libavcodec decode shim, driven from Python via ctypes.
+ *
+ * Test oracle for the TPU H.264 encoder: feeds Annex-B access units to the
+ * ffmpeg H.264 decoder and returns YUV420 planes. An *independent*
+ * implementation decoding our bitstream is the only honest conformance
+ * check (SURVEY.md §7 hard-part #3) — the in-tree numpy decoder shares
+ * table transcriptions with the encoder, this one shares nothing.
+ *
+ * Build: gcc -O2 -shared -fPIC -o libavdec_shim.so avdec_shim.c \
+ *            -lavcodec -lavutil
+ */
+
+#include <libavcodec/avcodec.h>
+#include <libavutil/frame.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct {
+    AVCodecContext *ctx;
+    AVFrame *frame;
+    AVPacket *pkt;
+} Dec;
+
+void *dec_open(const char *codec_name)
+{
+    const AVCodec *codec = avcodec_find_decoder_by_name(codec_name);
+    if (!codec)
+        return NULL;
+    Dec *d = calloc(1, sizeof(Dec));
+    if (!d)
+        return NULL;
+    d->ctx = avcodec_alloc_context3(codec);
+    d->frame = av_frame_alloc();
+    d->pkt = av_packet_alloc();
+    if (!d->ctx || !d->frame || !d->pkt || avcodec_open2(d->ctx, codec, NULL) < 0) {
+        free(d);
+        return NULL;
+    }
+    return d;
+}
+
+/* Decode one access unit. Returns 0 on success with a decoded frame,
+ * 1 on "needs more data", negative on error. Planes are copied into the
+ * caller-provided buffers (y: w*h, u/v: (w/2)*(h/2) for yuv420). */
+int dec_decode(void *h, const uint8_t *data, int size,
+               uint8_t *out_y, uint8_t *out_u, uint8_t *out_v,
+               int *out_w, int *out_h)
+{
+    Dec *d = (Dec *)h;
+    int ret = av_new_packet(d->pkt, size);
+    if (ret < 0)
+        return ret;
+    memcpy(d->pkt->data, data, size);
+    ret = avcodec_send_packet(d->ctx, d->pkt);
+    av_packet_unref(d->pkt);
+    if (ret < 0)
+        return ret;
+    ret = avcodec_receive_frame(d->ctx, d->frame);
+    if (ret == AVERROR(EAGAIN))
+        return 1;
+    if (ret < 0)
+        return ret;
+    int w = d->frame->width, h2 = d->frame->height;
+    *out_w = w;
+    *out_h = h2;
+    for (int r = 0; r < h2; r++)
+        memcpy(out_y + (size_t)r * w,
+               d->frame->data[0] + (size_t)r * d->frame->linesize[0], w);
+    int cw = w / 2, ch = h2 / 2;
+    for (int r = 0; r < ch; r++) {
+        memcpy(out_u + (size_t)r * cw,
+               d->frame->data[1] + (size_t)r * d->frame->linesize[1], cw);
+        memcpy(out_v + (size_t)r * cw,
+               d->frame->data[2] + (size_t)r * d->frame->linesize[2], cw);
+    }
+    av_frame_unref(d->frame);
+    return 0;
+}
+
+/* Flush the decoder so low-delay single-AU streams emit their frame. */
+int dec_flush(void *h, uint8_t *out_y, uint8_t *out_u, uint8_t *out_v,
+              int *out_w, int *out_h)
+{
+    Dec *d = (Dec *)h;
+    int ret = avcodec_send_packet(d->ctx, NULL);
+    if (ret < 0 && ret != AVERROR_EOF)
+        return ret;
+    ret = avcodec_receive_frame(d->ctx, d->frame);
+    if (ret < 0)
+        return ret;
+    int w = d->frame->width, h2 = d->frame->height;
+    *out_w = w;
+    *out_h = h2;
+    for (int r = 0; r < h2; r++)
+        memcpy(out_y + (size_t)r * w,
+               d->frame->data[0] + (size_t)r * d->frame->linesize[0], w);
+    int cw = w / 2, ch = h2 / 2;
+    for (int r = 0; r < ch; r++) {
+        memcpy(out_u + (size_t)r * cw,
+               d->frame->data[1] + (size_t)r * d->frame->linesize[1], cw);
+        memcpy(out_v + (size_t)r * cw,
+               d->frame->data[2] + (size_t)r * d->frame->linesize[2], cw);
+    }
+    av_frame_unref(d->frame);
+    return 0;
+}
+
+void dec_close(void *h)
+{
+    Dec *d = (Dec *)h;
+    if (!d)
+        return;
+    avcodec_free_context(&d->ctx);
+    av_frame_free(&d->frame);
+    av_packet_free(&d->pkt);
+    free(d);
+}

@@ -32,19 +32,8 @@ _CSC_601_FULL = np.array(
 )
 _CSC_601_OFFSET = np.array([0.0, 128.0, 128.0], dtype=np.float32)
 
-# BT.709 limited-range (video). Y in [16,235], C in [16,240].
-_CSC_709_LIMITED = np.array(
-    [
-        [0.2126 * 219 / 255, 0.7152 * 219 / 255, 0.0722 * 219 / 255],
-        [-0.2126 / 1.5748 * 224 / 255 / 1.0,  # derived below, replaced in init
-         0.0, 0.0],
-        [0.0, 0.0, 0.0],
-    ],
-    dtype=np.float32,
-)
-
-
 def _bt709_limited_matrix() -> np.ndarray:
+    """BT.709 limited-range (video): Y in [16,235], C in [16,240]."""
     kr, kb = 0.2126, 0.0722
     kg = 1.0 - kr - kb
     y = np.array([kr, kg, kb])

@@ -1,0 +1,177 @@
+"""H.264 4x4 integer transforms, quantisation and rescaling (ITU-T H.264
+§8.5) as exact int32 JAX ops.
+
+The TPU half of the h264-tpu encoder (reference equivalent: the H.264
+``output_mode`` inside the closed-source Rust pixelflux wheel, SURVEY.md
+§2.2). Encoder-side quantisation follows the JM reference formulas; the
+DECODER-side operations (rescale + inverse transforms + clipping) follow
+the spec bit-exactly — they must, because the encoder reconstructs its own
+prediction references with them and any mismatch drifts every decoder on
+the planet away from our recon.
+
+All functions are shape-polymorphic over leading batch dims: blocks are
+trailing (..., 4, 4) int32 (or (..., 2, 2) for chroma DC).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# --- tables (spec 8.5.12.1 normAdjust4x4 / JM quant_coef) -------------------
+# position classes within a 4x4 block: 0 for (0,0),(0,2),(2,0),(2,2);
+# 1 for (1,1),(1,3),(3,1),(3,3); 2 otherwise.
+_POS_CLS = np.array([[0, 2, 0, 2],
+                     [2, 1, 2, 1],
+                     [0, 2, 0, 2],
+                     [2, 1, 2, 1]], np.int32)
+
+# MF: encoder quant multipliers, rows qp%6, cols position class (JM).
+_MF = np.array([[13107, 5243, 8066],
+                [11916, 4660, 7490],
+                [10082, 4194, 6554],
+                [9362, 3647, 5825],
+                [8192, 3355, 5243],
+                [7282, 2893, 4559]], np.int32)
+
+# V: decoder rescale multipliers (normAdjust4x4), same indexing.
+_V = np.array([[10, 16, 13],
+               [11, 18, 14],
+               [13, 20, 16],
+               [14, 23, 18],
+               [16, 25, 20],
+               [18, 29, 23]], np.int32)
+
+MF4 = jnp.asarray(_MF[:, _POS_CLS])          # (6, 4, 4)
+V4 = jnp.asarray(_V[:, _POS_CLS])            # (6, 4, 4)
+
+# chroma QP mapping (spec table 8-15, chroma_qp_index_offset = 0)
+_QPC = np.concatenate([
+    np.arange(30),
+    np.array([29, 30, 31, 32, 32, 33, 34, 34, 35, 35, 36, 36, 37, 37, 37,
+              38, 38, 38, 39, 39, 39, 39])]).astype(np.int32)
+QPC_TABLE = jnp.asarray(_QPC)
+
+# zigzag scan for 4x4 blocks (spec 8.5.6)
+ZIGZAG4 = np.array([0, 1, 4, 8, 5, 2, 3, 6, 9, 12, 13, 10, 7, 11, 14, 15],
+                   np.int32)
+
+_CF = np.array([[1, 1, 1, 1],
+                [2, 1, -1, -2],
+                [1, -1, -1, 1],
+                [1, -2, 2, -1]], np.int32)
+_CF_T = _CF.T
+_H4 = np.array([[1, 1, 1, 1],
+                [1, 1, -1, -1],
+                [1, -1, -1, 1],
+                [1, -1, 1, -1]], np.int32)
+
+
+def forward4x4(x: jnp.ndarray) -> jnp.ndarray:
+    """Core forward transform W = Cf X Cf^T (exact in int32 for 8-bit
+    residuals)."""
+    cf = jnp.asarray(_CF)
+    cft = jnp.asarray(_CF_T)
+    return jnp.einsum("ij,...jk,kl->...il", cf, x.astype(jnp.int32), cft)
+
+
+def inverse4x4(d: jnp.ndarray) -> jnp.ndarray:
+    """Exact inverse core transform (spec 8.5.12.2) WITHOUT the final
+    (x+32)>>6 — callers add the DC term first, then shift."""
+    d = d.astype(jnp.int32)
+    # rows
+    e0 = d[..., 0, :] + d[..., 2, :]
+    e1 = d[..., 0, :] - d[..., 2, :]
+    e2 = (d[..., 1, :] >> 1) - d[..., 3, :]
+    e3 = d[..., 1, :] + (d[..., 3, :] >> 1)
+    f = jnp.stack([e0 + e3, e1 + e2, e1 - e2, e0 - e3], axis=-2)
+    # columns
+    g0 = f[..., :, 0] + f[..., :, 2]
+    g1 = f[..., :, 0] - f[..., :, 2]
+    g2 = (f[..., :, 1] >> 1) - f[..., :, 3]
+    g3 = f[..., :, 1] + (f[..., :, 3] >> 1)
+    return jnp.stack([g0 + g3, g1 + g2, g1 - g2, g0 - g3], axis=-1)
+
+
+def hadamard4x4(x: jnp.ndarray) -> jnp.ndarray:
+    """H X H^T (used forward on luma DC at the encoder, inverse at the
+    decoder — H is its own inverse up to scale)."""
+    h = jnp.asarray(_H4)
+    return jnp.einsum("ij,...jk,kl->...il", h, x.astype(jnp.int32), h)
+
+
+def hadamard2x2(x: jnp.ndarray) -> jnp.ndarray:
+    a = x[..., 0, 0] + x[..., 0, 1]
+    b = x[..., 0, 0] - x[..., 0, 1]
+    c = x[..., 1, 0] + x[..., 1, 1]
+    d = x[..., 1, 0] - x[..., 1, 1]
+    return jnp.stack([jnp.stack([a + c, b + d], axis=-1),
+                      jnp.stack([a - c, b - d], axis=-1)], axis=-2)
+
+
+# --- quantisation (encoder side, JM) ----------------------------------------
+
+def quant4x4(w: jnp.ndarray, qp: jnp.ndarray) -> jnp.ndarray:
+    """AC/luma-residual quant: level = sign * ((|W| * MF + f) >> qbits),
+    f = (2/3) * 2^qbits for intra."""
+    qp = jnp.asarray(qp, jnp.int32)
+    qbits = 15 + qp // 6
+    mf = MF4[qp % 6]
+    f = ((1 << qbits) // 3).astype(jnp.int32) if hasattr(
+        (1 << qbits), "astype") else (1 << qbits) // 3
+    f = (jnp.left_shift(jnp.int32(1), qbits) // 3)
+    mag = (jnp.abs(w) * mf + f) >> qbits
+    return jnp.where(w < 0, -mag, mag).astype(jnp.int32)
+
+
+def quant_dc(y: jnp.ndarray, qp: jnp.ndarray) -> jnp.ndarray:
+    """DC (luma 4x4-Hadamard or chroma 2x2-Hadamard) quant:
+    level = sign * ((|Y| * MF00 + 2f) >> (qbits + 1))."""
+    qp = jnp.asarray(qp, jnp.int32)
+    qbits = 15 + qp // 6
+    mf00 = MF4[qp % 6, 0, 0]
+    f2 = 2 * (jnp.left_shift(jnp.int32(1), qbits) // 3)
+    mag = (jnp.abs(y) * mf00 + f2) >> (qbits + 1)
+    return jnp.where(y < 0, -mag, mag).astype(jnp.int32)
+
+
+# --- rescaling (decoder side, spec-exact) -----------------------------------
+
+def dequant4x4_ac(c: jnp.ndarray, qp: jnp.ndarray) -> jnp.ndarray:
+    """Spec 8.5.12.1 with flat weightScale (=16): d = (c * 16V) << (qp/6-4)
+    for qp>=24, else (c * 16V + 2^(3-qp/6)) >> (4-qp/6). Exact for
+    negative c (arithmetic shift on two's complement)."""
+    qp = jnp.asarray(qp, jnp.int32)
+    ls = 16 * V4[qp % 6]
+    t = qp // 6
+    hi = jnp.left_shift(c * ls, jnp.maximum(t - 4, 0))
+    rnd = jnp.left_shift(jnp.int32(1), jnp.maximum(3 - t, 0))
+    lo = (c * ls + rnd) >> jnp.maximum(4 - t, 0)
+    return jnp.where(t >= 4, hi, lo).astype(jnp.int32)
+
+
+def dequant_luma_dc(f: jnp.ndarray, qp: jnp.ndarray) -> jnp.ndarray:
+    """Spec 8.5.10: input f = inverse-Hadamard of the DC levels.
+    qp>=36: (f*LS00) << (qp/6 - 6); else (f*LS00 + 2^(5-qp/6)) >> (6-qp/6)."""
+    qp = jnp.asarray(qp, jnp.int32)
+    ls00 = 16 * V4[qp % 6, 0, 0]
+    t = qp // 6
+    hi = jnp.left_shift(f * ls00, jnp.maximum(t - 6, 0))
+    rnd = jnp.left_shift(jnp.int32(1), jnp.maximum(5 - t, 0))
+    lo = (f * ls00 + rnd) >> jnp.maximum(6 - t, 0)
+    return jnp.where(t >= 6, hi, lo).astype(jnp.int32)
+
+
+def dequant_chroma_dc(f: jnp.ndarray, qpc: jnp.ndarray) -> jnp.ndarray:
+    """Spec 8.5.11 (4:2:0): ((f * LS00) << (qpc/6)) >> 5."""
+    qpc = jnp.asarray(qpc, jnp.int32)
+    ls00 = 16 * V4[qpc % 6, 0, 0]
+    return (jnp.left_shift(f * ls00, qpc // 6) >> 5).astype(jnp.int32)
+
+
+def chroma_qp(qp: jnp.ndarray) -> jnp.ndarray:
+    return QPC_TABLE[jnp.clip(qp, 0, 51)]
+
+
+def clip1(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.clip(x, 0, 255)

@@ -1,0 +1,11 @@
+"""Device-mesh parallelism: multi-seat fan-out and stripe sharding.
+
+The reference scales out by running N containers, one desktop each
+(SURVEY.md §2.5 multi-seat row); the TPU-native design instead shards N
+seats over a ``jax.sharding.Mesh`` — one encode dispatch per frame tick
+drives every seat's desktop on its own device, collective-free over ICI.
+"""
+
+from .seats import MultiSeatEncoder, seat_mesh, synthetic_seat_frames
+
+__all__ = ["MultiSeatEncoder", "seat_mesh", "synthetic_seat_frames"]

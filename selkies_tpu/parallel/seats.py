@@ -1,0 +1,222 @@
+"""Multi-seat encoding over a TPU device mesh.
+
+One *seat* = one remote desktop (framebuffer + encoder state). The
+reference scales seats by running one container per desktop
+(docs/component.md:181-187); here N seats are encoded by ONE sharded
+program over a ``Mesh('seat')``: per-seat frames, damage state and quant
+tables carry a leading seat axis sharded across devices, the per-seat step
+is ``vmap``-ed and ``shard_map``-ed, and — because seats never exchange
+data — the compiled program contains zero collectives: pure ICI-free
+SPMD, each chip encoding its seat's desktop in lockstep.
+
+Seats-per-device > 1 is allowed (the vmap runs the local batch); devices
+must divide seats.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..codecs import jpeg as jtab
+from ..codecs.jpeg import stuff_ff_bytes
+from ..engine.encoder import build_step_fn, plan_grid
+from ..engine.types import CaptureSettings, EncodedChunk
+
+try:  # jax>=0.8 top-level; older releases keep it in experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+logger = logging.getLogger("selkies_tpu.parallel.seats")
+
+
+def seat_mesh(n_seats: int, devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D ``Mesh('seat')`` using as many devices as divide ``n_seats``."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n_dev = min(len(devs), n_seats)
+    while n_seats % n_dev:
+        n_dev -= 1
+    return Mesh(np.array(devs[:n_dev]), ("seat",))
+
+
+class MultiSeatEncoder:
+    """N per-seat JPEG stripe encoders fused into one sharded device step.
+
+    API mirrors :class:`~selkies_tpu.engine.encoder.JpegEncoderSession`
+    with a leading seat axis: ``encode(frames)`` takes (S, H, W, 3) uint8,
+    ``finalize`` returns a list of per-seat chunk lists.
+    """
+
+    def __init__(self, settings: CaptureSettings, n_seats: int,
+                 devices: Optional[Sequence] = None,
+                 mesh: Optional[Mesh] = None):
+        if n_seats < 1:
+            raise ValueError("n_seats must be >= 1")
+        self.settings = settings
+        self.n_seats = n_seats
+        self.grid = plan_grid(settings)
+        self.subsampling = "444" if settings.fullcolor else "420"
+        g = self.grid
+        stripe_px = g.stripe_h * g.width
+        self._e_cap = stripe_px * (3 if settings.fullcolor else 2)
+        self._w_cap = stripe_px // 2
+        self._out_cap = max(256 * 1024, stripe_px * g.n_stripes // 8)
+
+        self.mesh = mesh if mesh is not None else seat_mesh(n_seats, devices)
+        if n_seats % self.mesh.devices.size:
+            raise ValueError(
+                f"{self.mesh.devices.size} devices do not divide "
+                f"{n_seats} seats")
+        self._spec = P("seat")
+        self._sharding = NamedSharding(self.mesh, self._spec)
+        self._step = self._build_step()
+
+        self.frame_id = 0
+        self._age = jax.device_put(
+            np.zeros((n_seats, g.n_stripes), np.int32), self._sharding)
+        self._force_after_drop = np.zeros((n_seats,), bool)
+        self.update_quality(settings.jpeg_quality,
+                            settings.paint_over_quality)
+
+    # ------------------------------------------------------------------ build
+    def _build_step(self):
+        g, s = self.grid, self.settings
+        step = build_step_fn(g.width, g.stripe_h, g.n_stripes,
+                             self.subsampling, self._e_cap, self._w_cap,
+                             self._out_cap, s.paint_over_delay_frames,
+                             s.use_damage_gating, s.use_paint_over)
+        spec = self._spec
+        sharded = shard_map(jax.vmap(step), mesh=self.mesh,
+                            in_specs=(spec,) * 7, out_specs=(spec,) * 6)
+        return jax.jit(sharded, donate_argnums=(2,))
+
+    # --------------------------------------------------------------- tunables
+    def update_quality(self, motion_q: int, paint_q: int | None = None):
+        self.settings.jpeg_quality = int(motion_q)
+        if paint_q is not None:
+            self.settings.paint_over_quality = int(paint_q)
+        s, n = self.settings, self.n_seats
+        self._qt_np = tuple(
+            jtab.scale_qtable(base, q)
+            for base, q in ((jtab.STD_LUMA_QUANT, s.jpeg_quality),
+                            (jtab.STD_CHROMA_QUANT, s.jpeg_quality),
+                            (jtab.STD_LUMA_QUANT, s.paint_over_quality),
+                            (jtab.STD_CHROMA_QUANT, s.paint_over_quality)))
+        # leading seat axis, replicated content, seat-sharded placement
+        self._qt_dev = tuple(
+            jax.device_put(np.tile(t.astype(np.float32), (n, 1)),
+                           self._sharding)
+            for t in self._qt_np)
+
+    # ------------------------------------------------------------------ state
+    @property
+    def input_sharding(self) -> NamedSharding:
+        """Sharding callers should ``device_put`` frame batches with."""
+        return self._sharding
+
+    def make_prev_buffer(self) -> jnp.ndarray:
+        g = self.grid
+        return jax.device_put(
+            np.zeros((self.n_seats, g.height, g.width, 3), np.uint8),
+            self._sharding)
+
+    # ----------------------------------------------------------------- encode
+    def encode(self, frames: jnp.ndarray,
+               prev: Optional[jnp.ndarray] = None) -> dict[str, Any]:
+        """Dispatch one multi-seat encode step (non-blocking).
+
+        ``frames``: (n_seats, grid.height, grid.width, 3) uint8, ideally
+        already placed with :attr:`input_sharding`. ``prev`` defaults to
+        the internally-tracked previous batch.
+        """
+        if prev is None:
+            prev = getattr(self, "_prev", None)
+            if prev is None:
+                prev = self.make_prev_buffer()
+        data, lens, send, is_paint, age, overflow = self._step(
+            frames, prev, self._age, *self._qt_dev)
+        self._prev = frames
+        self._age = age
+        fid = self.frame_id
+        self.frame_id = (self.frame_id + 1) & 0xFFFF
+        for arr in (data, lens, send, is_paint, overflow):
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass
+        return {"data": data, "lens": lens, "send": send,
+                "is_paint": is_paint, "overflow": overflow, "frame_id": fid,
+                "qtabs": self._qt_np}
+
+    # --------------------------------------------------------------- finalize
+    def finalize(self, out: dict[str, Any], force_all: bool = False
+                 ) -> list[list[EncodedChunk]]:
+        """Blocks on readback; returns ``chunks[seat]`` lists."""
+        g = self.grid
+        data = np.asarray(out["data"])        # (S, out_cap)
+        lens = np.asarray(out["lens"])        # (S, n_stripes)
+        send = np.asarray(out["send"])
+        is_paint = np.asarray(out["is_paint"])
+        overflow = np.asarray(out["overflow"])  # (S,)
+        qy_m, qc_m, qy_p, qc_p = out["qtabs"]
+
+        if overflow.any():
+            # same growth policy as the single-seat session: drop the
+            # overflowed seats' frames, double the growable buffers once,
+            # recompile, and force their next delivered frame to full
+            logger.warning("multi-seat overflow on seats %s; growing buffers",
+                           np.nonzero(overflow)[0].tolist())
+            self._w_cap *= 2
+            self._out_cap *= 2
+            self._step = self._build_step()
+            self._force_after_drop |= overflow
+
+        results: list[list[EncodedChunk]] = []
+        for seat in range(self.n_seats):
+            if overflow[seat]:
+                results.append([])
+                continue
+            force = force_all or self._force_after_drop[seat]
+            self._force_after_drop[seat] = False
+            starts = np.concatenate([[0], np.cumsum(lens[seat])])
+            chunks: list[EncodedChunk] = []
+            for i in range(g.n_stripes):
+                if not (force or send[seat, i]):
+                    continue
+                raw = data[seat, starts[i]:starts[i] + lens[seat, i]]
+                scan = stuff_ff_bytes(raw)
+                paint = bool(is_paint[seat, i])
+                qy = qy_p if paint else qy_m
+                qc = qc_p if paint else qc_m
+                payload = jtab.assemble_jfif(g.stripe_h, g.width, scan,
+                                             qy, qc, self.subsampling)
+                chunks.append(EncodedChunk(
+                    payload=payload, frame_id=out["frame_id"],
+                    stripe_y=i * g.stripe_h, width=g.width,
+                    height=g.stripe_h, is_idr=True, output_mode="jpeg",
+                    seat_index=seat, display_id=f"seat{seat}"))
+            results.append(chunks)
+        return results
+
+
+def synthetic_seat_frames(enc: MultiSeatEncoder, tick: int) -> jnp.ndarray:
+    """Per-seat animated test frames, generated ON the seat mesh: the
+    synthetic pattern is vmapped over a per-seat phase so every seat shows
+    distinct content (seat fan-out tests depend on that)."""
+    from ..engine.sources import _synthetic_fn
+    g = enc.grid
+    fn = _synthetic_fn(g.height, g.width)
+    phases = jax.device_put(
+        np.arange(enc.n_seats, dtype=np.int32) * 37 + tick,
+        enc.input_sharding)
+    spec = enc._spec
+    gen = jax.jit(shard_map(jax.vmap(fn), mesh=enc.mesh,
+                            in_specs=(spec,), out_specs=spec))
+    return gen(phases)

@@ -39,6 +39,7 @@ logger = logging.getLogger("selkies_tpu.server.ws")
 
 ACK_STALL_S = 4.0
 RECONNECT_DEBOUNCE_S = 0.5
+CONTROL_SEND_TIMEOUT_S = 2.0  # reference 2 s control bound (selkies.py:79-101)
 
 
 class _FpsEstimator:
@@ -240,6 +241,29 @@ class WebSocketsService(BaseStreamingService):
             c.last_sent_id = chunk.frame_id
             relay.offer(frame)
 
+    async def _broadcast_control(self, text: str) -> None:
+        """Bounded CONCURRENT broadcast: one stalled client must never pace
+        the loop or the other clients (reference bounded-send rule,
+        selkies.py:79-101) — the per-client bounds run in parallel so the
+        whole broadcast costs one timeout, not one per stalled client. A
+        send that exceeds the bound marks the socket dead and closes it —
+        a cancelled send may have torn a frame, so it is never reused."""
+        async def _one(c: ClientConnection) -> None:
+            try:
+                await asyncio.wait_for(c.send_text_maybe_gz(text),
+                                       CONTROL_SEND_TIMEOUT_S)
+            except (asyncio.TimeoutError, ConnectionError,
+                    RuntimeError, OSError):
+                logger.info("control send to client %d failed; closing", c.id)
+                for relay in c.relays.values():
+                    relay.dead = True
+                try:
+                    await c.ws.close()
+                except Exception:
+                    pass
+
+        await asyncio.gather(*(_one(c) for c in list(self.clients.values())))
+
     # ------------------------------------------------------------- endpoint
     async def ws_endpoint(self, request: web.Request) -> web.WebSocketResponse:
         ws = web.WebSocketResponse(max_msg_size=P.WS_MESSAGE_SIZE_HARD_CAP,
@@ -333,13 +357,27 @@ class WebSocketsService(BaseStreamingService):
             await handler(client, verb.args)
             return
         if self.input_handler is not None and self.settings.enable_input:
-            await self.input_handler.on_message(text)
+            try:
+                await self.input_handler.on_message(text)
+            except (ValueError, IndexError, KeyError) as e:
+                # malformed verb args must never tear down the WS connection
+                # (the reference parses tolerantly; SURVEY §2.3)
+                logger.warning("bad input verb from client %d: %r (%s)",
+                               client.id, text[:80], e)
 
     # ---- control verbs ------------------------------------------------------
     async def _h_gz(self, client: ClientConnection, args: str) -> None:
         client.gzip_ok = args.strip() == "1"
 
     async def _h_settings(self, client: ClientConnection, args: str) -> None:
+        # SETTINGS mutates SERVER state (encoder/bitrate/framerate for every
+        # client); view-only clients may send the verb (the reference client
+        # always does) but must not steer the shared stream — the reference
+        # splits per-client display prefs from server settings
+        # (selkies.py:1833-2141); here server-side knobs need input authority.
+        if client.role != "full":
+            await client.send_text_maybe_gz("settings_applied {}")
+            return
         try:
             body = json.loads(args)
         except json.JSONDecodeError:
@@ -366,11 +404,16 @@ class WebSocketsService(BaseStreamingService):
                 cap.update_tunables(
                     jpeg_quality=self.settings.jpeg_quality,
                     paint_over_quality=self.settings.paint_over_quality)
-        # structural changes (encoder, fullcolor) need a capture rebuild
+        # structural changes (encoder, fullcolor) need a capture rebuild;
+        # restart joins the capture thread, so it runs in an executor to
+        # keep the event loop responsive (SURVEY §7 hard-part #4)
         if {"encoder", "fullcolor"} & set(applied):
+            loop = asyncio.get_running_loop()
             for did, cap in self.captures.items():
                 if cap.is_capturing():
-                    cap.start_capture(cap._callback, self._capture_settings(did))
+                    new_settings = self._capture_settings(did)
+                    await loop.run_in_executor(
+                        None, lambda c=cap, s=new_settings: c.restart(s))
         if "audio_bitrate" in applied and self.audio is not None:
             self.audio.update_bitrate(int(applied["audio_bitrate"]))
 
@@ -467,11 +510,13 @@ class WebSocketsService(BaseStreamingService):
                                       max(64, min(h, 16384)))
         cap = self.captures.get(did)
         if cap and cap.is_capturing():
-            cap.update_capture_region(0, 0, *self.display_geometry[did])
-        # broadcast realized geometry
-        payload = self._server_settings_payload()
-        for c in self.clients.values():
-            await c.send_text_maybe_gz(payload)
+            # size change rebuilds the capture session (joins a thread):
+            # never on the event loop
+            geo = self.display_geometry[did]
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: cap.update_capture_region(0, 0, *geo))
+        # broadcast realized geometry (bounded sends)
+        await self._broadcast_control(self._server_settings_payload())
 
     async def _h_dpi(self, client: ClientConnection, args: str) -> None:
         try:
@@ -546,11 +591,6 @@ class WebSocketsService(BaseStreamingService):
                         did: cap.encoded_fps
                         for did, cap in self.captures.items()},
                 }
-                text = "system_stats " + json.dumps(stats)
-                for c in list(self.clients.values()):
-                    try:
-                        await c.send_text_maybe_gz(text)
-                    except (ConnectionError, RuntimeError):
-                        pass
+                await self._broadcast_control("system_stats " + json.dumps(stats))
             except Exception:
                 logger.exception("stats loop error")

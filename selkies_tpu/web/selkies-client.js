@@ -1,0 +1,516 @@
+/* Selkies-TPU WebSockets client.
+ *
+ * Fresh implementation of the reference client's WS protocol surface
+ * (reference addons/selkies-web-core/selkies-ws-core.js:4255-4460 binary
+ * demux, lib/input.js keysym capture; SURVEY.md §2.3):
+ *
+ *   server -> client binary: 0x01 audio (+RED), 0x03 JPEG stripe,
+ *                            0x04 H.264 stripe, 0x05 gzip'd control text
+ *   client -> server binary: 0x02 mic PCM, 0x05 gzip'd control text
+ *   text verbs: kd/ku/kr/kh, m/m2/mb/ms/p, r, s, vb/ab, SETTINGS,
+ *               CLIENT_FRAME_ACK, START/STOP_VIDEO, START/STOP_AUDIO,
+ *               REQUEST_KEYFRAME, _gz, _f/_l, cw*/
+
+"use strict";
+
+/* ------------------------------------------------------------------ keysyms
+ * X11 keysym mapping. Printable ASCII/Latin-1 map to their codepoint;
+ * other Unicode maps to 0x01000000 + codepoint (X11 convention); special
+ * keys use the table below (keysymdef.h values, same table the reference
+ * client carries in lib/input.js KeyTable). */
+const KEYSYM_SPECIAL = {
+  Backspace: 0xFF08, Tab: 0xFF09, Enter: 0xFF0D, Pause: 0xFF13,
+  ScrollLock: 0xFF14, Escape: 0xFF1B, Home: 0xFF50, ArrowLeft: 0xFF51,
+  ArrowUp: 0xFF52, ArrowRight: 0xFF53, ArrowDown: 0xFF54, PageUp: 0xFF55,
+  PageDown: 0xFF56, End: 0xFF57, Insert: 0xFF63, Menu: 0xFF67,
+  ContextMenu: 0xFF67, NumLock: 0xFF7F, F1: 0xFFBE, F2: 0xFFBF, F3: 0xFFC0,
+  F4: 0xFFC1, F5: 0xFFC2, F6: 0xFFC3, F7: 0xFFC4, F8: 0xFFC5, F9: 0xFFC6,
+  F10: 0xFFC7, F11: 0xFFC8, F12: 0xFFC9, Delete: 0xFFFF,
+  CapsLock: 0xFFE5, PrintScreen: 0xFF61,
+};
+const KEYSYM_BY_CODE = {           // location-dependent keys need e.code
+  ShiftLeft: 0xFFE1, ShiftRight: 0xFFE2, ControlLeft: 0xFFE3,
+  ControlRight: 0xFFE4, AltLeft: 0xFFE9, AltRight: 0xFFEA,
+  MetaLeft: 0xFFEB, MetaRight: 0xFFEC,
+  NumpadEnter: 0xFF8D, NumpadMultiply: 0xFFAA, NumpadAdd: 0xFFAB,
+  NumpadSubtract: 0xFFAD, NumpadDecimal: 0xFFAE, NumpadDivide: 0xFFAF,
+  Numpad0: 0xFFB0, Numpad1: 0xFFB1, Numpad2: 0xFFB2, Numpad3: 0xFFB3,
+  Numpad4: 0xFFB4, Numpad5: 0xFFB5, Numpad6: 0xFFB6, Numpad7: 0xFFB7,
+  Numpad8: 0xFFB8, Numpad9: 0xFFB9,
+};
+
+function keysymOf(e) {
+  if (KEYSYM_BY_CODE[e.code] !== undefined) return KEYSYM_BY_CODE[e.code];
+  const k = e.key;
+  if (k.length === 1) {
+    const cp = k.codePointAt(0);
+    if (cp >= 0x20 && cp <= 0x7E) return cp;          // ASCII printable
+    if (cp >= 0xA0 && cp <= 0xFF) return cp;          // Latin-1
+    return 0x01000000 + cp;                            // Unicode keysym
+  }
+  if (KEYSYM_SPECIAL[k] !== undefined) return KEYSYM_SPECIAL[k];
+  return null;
+}
+
+/* opcode bytes (protocol.py) */
+const OP_AUDIO = 0x01, OP_MIC = 0x02, OP_JPEG = 0x03, OP_H264 = 0x04,
+      OP_GZ = 0x05;
+
+const fidNewer = (a, b) => ((a - b + 0x10000) & 0xFFFF) < 0x8000 && a !== b;
+
+/* ------------------------------------------------------------------ client */
+class SelkiesClient {
+  constructor(canvas, hud) {
+    this.canvas = canvas;
+    this.ctx = canvas.getContext("2d", { desynchronized: true });
+    this.hud = hud;
+    this.ws = null;
+    this.gz = false;
+    this.serverSettings = null;
+    this.displayW = 0; this.displayH = 0;
+    this.videoActive = false;
+    this.lastAckFid = -1;
+    this.stripeLastFid = new Map();   // y -> last drawn frame id
+    this.held = new Set();            // held keysyms
+    this.decodeQueue = 0;             // in-flight createImageBitmap calls
+    this.framesDrawn = 0;
+    this.stripesDrawn = 0;
+    this.lastStatsT = performance.now();
+    this.pointerLocked = false;
+    this.audio = null;                // AudioPlayer
+    this.reconnectDelay = 500;
+    this.statusMsg = "connecting…";
+    this.killed = false;
+
+    this._bindInput();
+    this._bindResize();
+    this._statsTimer = setInterval(() => this._reportStats(), 2000);
+    this._hbTimer = setInterval(() => this._heartbeat(), 500);
+  }
+
+  /* ------------------------------------------------------------ transport */
+  connect() {
+    const proto = location.protocol === "https:" ? "wss:" : "ws:";
+    const url = `${proto}//${location.host}/api/websockets`;
+    this.status(`connecting to ${url}`);
+    const ws = new WebSocket(url);
+    ws.binaryType = "arraybuffer";
+    this.ws = ws;
+    ws.onopen = () => {
+      this.reconnectDelay = 500;
+      this.send("_gz,1");
+      this.gz = true;
+    };
+    ws.onmessage = (ev) => {
+      if (typeof ev.data === "string") this._onText(ev.data);
+      else this._onBinary(new Uint8Array(ev.data));
+    };
+    ws.onclose = () => {
+      this.videoActive = false;
+      if (this.killed) return;
+      this.status(`disconnected — retrying in ${this.reconnectDelay} ms`, true);
+      setTimeout(() => this.connect(), this.reconnectDelay);
+      this.reconnectDelay = Math.min(this.reconnectDelay * 2, 10000);
+    };
+  }
+
+  send(text) {
+    if (this.ws && this.ws.readyState === WebSocket.OPEN) this.ws.send(text);
+  }
+
+  async sendMaybeGz(text) {
+    // 0x05-frame large control messages (server inflates, bounded)
+    if (this.gz && text.length > 512 && typeof CompressionStream !== "undefined") {
+      const stream = new Blob([text]).stream()
+        .pipeThrough(new CompressionStream("gzip"));
+      const packed = new Uint8Array(await new Response(stream).arrayBuffer());
+      const framed = new Uint8Array(packed.length + 1);
+      framed[0] = OP_GZ; framed.set(packed, 1);
+      this.ws.send(framed);
+    } else this.send(text);
+  }
+
+  /* -------------------------------------------------------------- binary */
+  _onBinary(buf) {
+    switch (buf[0]) {
+      case OP_JPEG: this._onJpegStripe(buf); break;
+      case OP_H264: this._onH264Stripe(buf); break;
+      case OP_AUDIO: if (this.audio) this.audio.push(buf); break;
+      case OP_GZ: this._onGzControl(buf); break;
+    }
+  }
+
+  async _onGzControl(buf) {
+    if (typeof DecompressionStream === "undefined") return;
+    const stream = new Blob([buf.subarray(1)]).stream()
+      .pipeThrough(new DecompressionStream("gzip"));
+    this._onText(await new Response(stream).text());
+  }
+
+  /* 6-byte header: [0x03, flags, u16 frame_id, u16 stripe_y] + JFIF */
+  async _onJpegStripe(buf) {
+    const dv = new DataView(buf.buffer, buf.byteOffset, 6);
+    const fid = dv.getUint16(2), y = dv.getUint16(4);
+    const last = this.stripeLastFid.get(y);
+    if (last !== undefined && !fidNewer(fid, last)) return; // stale stripe
+    if (this.decodeQueue > 48) return;  // overload: drop, keyframe recovers
+    this.decodeQueue++;
+    try {
+      const blob = new Blob([buf.subarray(6)], { type: "image/jpeg" });
+      const bmp = await createImageBitmap(blob);
+      const l2 = this.stripeLastFid.get(y);
+      if (l2 === undefined || fidNewer(fid, l2) || fid === l2) {
+        this.stripeLastFid.set(y, fid);
+        this.ctx.drawImage(bmp, 0, y);   // canvas crops right/bottom padding
+        this.stripesDrawn++;
+        this._ackFrame(fid);
+      }
+      bmp.close();
+    } catch (e) {
+      console.warn("jpeg stripe decode failed", e);
+    } finally {
+      this.decodeQueue--;
+    }
+  }
+
+  _onH264Stripe(_buf) {
+    // H.264 stripes decode via WebCodecs VideoDecoder per stripe row
+    // (reference selkies-ws-core.js:4424-4460); lands with the h264 engine.
+    if (!this._h264warned) {
+      this._h264warned = true;
+      console.warn("h264 stripes not yet handled by this client build");
+    }
+  }
+
+  _ackFrame(fid) {
+    if (fid !== this.lastAckFid) {
+      this.lastAckFid = fid;
+      this.framesDrawn++;
+      this.send(`CLIENT_FRAME_ACK ${fid}`);
+    }
+  }
+
+  /* ---------------------------------------------------------------- text */
+  _onText(text) {
+    const sp = text.indexOf(" "), cm = text.indexOf(",");
+    const cut = Math.min(sp < 0 ? text.length : sp, cm < 0 ? text.length : cm);
+    const verb = text.slice(0, cut), rest = text.slice(cut + 1);
+    switch (verb) {
+      case "MODE": break;
+      case "server_settings": this._applyServerSettings(rest); break;
+      case "system_stats": this._showStats(rest); break;
+      case "cursor": this._applyCursor(rest); break;
+      case "VIDEO_STARTED": this.videoActive = true; break;
+      case "VIDEO_STOPPED": this.videoActive = false; break;
+      case "AUDIO_DISABLED": if (this.audio) { this.audio.close(); this.audio = null; } break;
+      case "settings_applied": break;
+      case "clipboard": this._applyClipboard(rest); break;
+      case "KILL":
+        this.killed = true;
+        this.status("session terminated by server", true);
+        this.ws.close();
+        break;
+      default: break;
+    }
+    this._postToDashboard({ type: "serverMessage", verb, payload: rest });
+  }
+
+  _applyServerSettings(json) {
+    let payload;
+    try { payload = JSON.parse(json); } catch { return; }
+    this.serverSettings = payload;
+    const d = (payload.displays && payload.displays[0]) || {};
+    if (d.width && (d.width !== this.displayW || d.height !== this.displayH)) {
+      this.displayW = d.width; this.displayH = d.height;
+      this.canvas.width = d.width; this.canvas.height = d.height;
+      this.stripeLastFid.clear();
+      this.send("REQUEST_KEYFRAME");
+    }
+    document.title = `${payload.app_name || "Selkies TPU"} — ${d.width}x${d.height}`;
+    if (!this.videoActive) {
+      this.send("START_VIDEO");
+      if (payload.features && payload.features.audio) {
+        if (!this.audio) this.audio = new AudioPlayer(payload);
+        this.send("START_AUDIO");
+      }
+      this._sendPreferredSize();
+    }
+    this.status(`${d.width}x${d.height} · ` +
+      `${(payload.settings?.framerate?.value ?? "?")} fps target`);
+    this._postToDashboard({ type: "serverSettings", payload });
+  }
+
+  _applyCursor(json) {
+    try {
+      const c = JSON.parse(json);
+      if (c.png_b64) {
+        this.canvas.style.cursor =
+          `url(data:image/png;base64,${c.png_b64}) ${c.xhot || 0} ${c.yhot || 0}, default`;
+      } else if (c.visible === false) this.canvas.style.cursor = "none";
+      else this.canvas.style.cursor = "default";
+    } catch { /* tolerate malformed cursor payloads */ }
+  }
+
+  async _applyClipboard(b64) {
+    try {
+      const text = atob(b64);
+      if (navigator.clipboard && document.hasFocus())
+        await navigator.clipboard.writeText(text);
+    } catch { /* clipboard permission denied: ignore */ }
+  }
+
+  _showStats(json) {
+    try {
+      const s = JSON.parse(json);
+      const enc = Object.entries(s.encoded_fps || {})
+        .map(([d, f]) => `${d}:${f.toFixed(0)}`).join(" ");
+      this.status(
+        `${this.displayW}x${this.displayH} · encode ${enc} fps · ` +
+        `draw ${this._drawFps.toFixed(0)} fps · cpu ${s.cpu_percent}%`);
+      this._postToDashboard({ type: "systemStats", payload: s });
+    } catch { /* ignore */ }
+  }
+
+  /* --------------------------------------------------------------- stats */
+  get _drawFps() { return this.__drawFps || 0; }
+
+  _reportStats() {
+    const now = performance.now();
+    const dt = (now - this.lastStatsT) / 1000;
+    this.__drawFps = this.framesDrawn / Math.max(dt, 1e-3);
+    this.framesDrawn = 0;
+    this.lastStatsT = now;
+    if (this.videoActive) this.send(`_f,${this.__drawFps.toFixed(1)}`);
+  }
+
+  /* --------------------------------------------------------------- input */
+  _bindInput() {
+    const cv = this.canvas;
+    cv.addEventListener("contextmenu", (e) => e.preventDefault());
+
+    cv.addEventListener("keydown", (e) => {
+      const ks = keysymOf(e);
+      if (ks === null) return;
+      e.preventDefault();
+      if (!e.repeat) { this.held.add(ks); this.send(`kd,${ks}`); }
+    });
+    cv.addEventListener("keyup", (e) => {
+      const ks = keysymOf(e);
+      if (ks === null) return;
+      e.preventDefault();
+      this.held.delete(ks);
+      this.send(`ku,${ks}`);
+    });
+    cv.addEventListener("blur", () => {
+      if (this.held.size) { this.held.clear(); this.send("kr,"); }
+    });
+
+    const scale = (e) => {
+      const r = cv.getBoundingClientRect();
+      const x = Math.round((e.clientX - r.left) * (cv.width / r.width));
+      const y = Math.round((e.clientY - r.top) * (cv.height / r.height));
+      return [Math.max(0, Math.min(cv.width - 1, x)),
+              Math.max(0, Math.min(cv.height - 1, y))];
+    };
+    cv.addEventListener("mousemove", (e) => {
+      if (this.pointerLocked) this.send(`m2,${e.movementX},${e.movementY}`);
+      else { const [x, y] = scale(e); this.send(`m,${x},${y}`); }
+    });
+    const btnMap = { 0: 1, 1: 2, 2: 3, 3: 8, 4: 9 };  // DOM -> X11
+    cv.addEventListener("mousedown", (e) => {
+      cv.focus();
+      const [x, y] = scale(e);
+      this.send(`m,${x},${y}`);
+      this.send(`mb,${btnMap[e.button] ?? 1},1`);
+      e.preventDefault();
+    });
+    cv.addEventListener("mouseup", (e) => {
+      this.send(`mb,${btnMap[e.button] ?? 1},0`);
+      e.preventDefault();
+    });
+    cv.addEventListener("wheel", (e) => {
+      const dy = Math.sign(e.deltaY), dx = Math.sign(e.deltaX);
+      if (dx || dy) this.send(`ms,${dx},${dy}`);
+      e.preventDefault();
+    }, { passive: false });
+
+    document.addEventListener("pointerlockchange", () => {
+      this.pointerLocked = document.pointerLockElement === cv;
+    });
+    cv.addEventListener("dblclick", () => {
+      // double-click toggles pointer lock for games needing relative mouse
+      if (!this.pointerLocked && cv.requestPointerLock) cv.requestPointerLock();
+    });
+
+    document.addEventListener("visibilitychange", () => {
+      if (!this.ws || this.ws.readyState !== WebSocket.OPEN) return;
+      if (document.hidden) this.send("STOP_VIDEO");
+      else { this.send("START_VIDEO"); this.send("REQUEST_KEYFRAME"); }
+    });
+
+    document.addEventListener("paste", async (e) => {
+      const text = e.clipboardData && e.clipboardData.getData("text");
+      if (text) this.send(`cw,${btoa(unescape(encodeURIComponent(text)))}`);
+    });
+
+    window.addEventListener("message", (e) => this._onDashboardMessage(e));
+  }
+
+  _heartbeat() {
+    if (this.held.size)
+      this.send(`kh,${Array.from(this.held).join(",")}`);
+  }
+
+  /* -------------------------------------------------------------- resize */
+  _bindResize() {
+    let timer = null;
+    window.addEventListener("resize", () => {
+      clearTimeout(timer);
+      timer = setTimeout(() => this._sendPreferredSize(), 500);
+    });
+  }
+
+  _sendPreferredSize() {
+    const s = this.serverSettings;
+    if (!s || !s.features || !s.features.resize) return;
+    const dpr = window.devicePixelRatio || 1;
+    const w = Math.round(window.innerWidth * dpr / 2) * 2;
+    const h = Math.round(window.innerHeight * dpr / 2) * 2;
+    if (w !== this.displayW || h !== this.displayH) this.send(`r,${w}x${h}`);
+  }
+
+  /* --------------------------------------------- dashboard postMessage API
+   * Same-origin embedding surface mirroring the reference dashboard
+   * protocol (reference addons/selkies-web-core/README.md:49-200). */
+  _postToDashboard(msg) {
+    if (window.parent !== window)
+      window.parent.postMessage({ selkies: true, ...msg }, location.origin);
+  }
+
+  _onDashboardMessage(e) {
+    if (e.origin !== location.origin || !e.data || e.data.selkies !== true)
+      return;
+    const d = e.data;
+    switch (d.type) {
+      case "settings":
+        this.sendMaybeGz(`SETTINGS,${JSON.stringify(d.settings || {})}`);
+        break;
+      case "pipelineControl":
+        if (d.video === false) this.send("STOP_VIDEO");
+        if (d.video === true) this.send("START_VIDEO");
+        if (d.audio === false) this.send("STOP_AUDIO");
+        if (d.audio === true) this.send("START_AUDIO");
+        if (d.keyframe) this.send("REQUEST_KEYFRAME");
+        break;
+      case "getStats":
+        this._postToDashboard({
+          type: "stats",
+          payload: { drawFps: this._drawFps, display: [this.displayW, this.displayH] },
+        });
+        break;
+      case "videoBitrate": this.send(`vb,${d.kbps | 0}`); break;
+      case "audioBitrate": this.send(`ab,${d.bps | 0}`); break;
+      default: break;
+    }
+  }
+
+  /* ----------------------------------------------------------------- hud */
+  status(msg, isErr = false) {
+    this.statusMsg = msg;
+    if (this.hud) {
+      this.hud.innerHTML = "";
+      const span = document.createElement("span");
+      span.className = isErr ? "err" : "";
+      span.textContent = msg;
+      this.hud.appendChild(span);
+    }
+  }
+}
+
+/* ---------------------------------------------------------------- audio
+ * Opus over 0x01 frames -> WebCodecs AudioDecoder -> WebAudio graph.
+ * RED (RFC 2198) redundancy is de-framed; redundant blocks are only decoded
+ * when a gap is detected (reference client extractOpusFrames,
+ * selkies-ws-core.js:36-38). */
+class AudioPlayer {
+  constructor(serverSettings) {
+    const st = serverSettings.settings || {};
+    this.sampleRate = 48000;
+    this.channels = (st.audio_channels && st.audio_channels.value) || 2;
+    this.frameMs = (st.audio_frame_ms && st.audio_frame_ms.value) || 10;
+    this.ctx = new AudioContext({ sampleRate: this.sampleRate });
+    this.playhead = 0;
+    this.tsUs = 0;
+    this.queueTarget = 5 * this.frameMs / 1000;  // ≤5 frames client buffer
+    this.dec = null;
+    this._initDecoder();
+  }
+
+  _initDecoder() {
+    if (typeof AudioDecoder === "undefined") return;
+    this.dec = new AudioDecoder({
+      output: (ad) => this._play(ad),
+      error: (e) => console.warn("audio decode", e),
+    });
+    this.dec.configure({
+      codec: "opus", sampleRate: this.sampleRate,
+      numberOfChannels: this.channels,
+    });
+  }
+
+  push(buf) {
+    if (!this.dec || this.dec.state !== "configured") return;
+    const nRed = buf[1];
+    let payload = buf.subarray(2);
+    if (nRed > 0) {
+      // RED: u32 pts + nRed*4-byte block hdrs + 1-byte primary hdr + blocks
+      let off = 4 + nRed * 4 + 1;
+      const dv = new DataView(buf.buffer, buf.byteOffset + 2);
+      let skip = 0;
+      for (let i = 0; i < nRed; i++)
+        skip += dv.getUint32(4 + i * 4) & 0x3FF;   // 10-bit block length
+      payload = payload.subarray(off + skip);       // primary block only
+    }
+    if (!payload.length) return;
+    this.dec.decode(new EncodedAudioChunk({
+      type: "key", timestamp: this.tsUs, data: payload,
+    }));
+    this.tsUs += this.frameMs * 1000;
+  }
+
+  _play(ad) {
+    const n = ad.numberOfFrames, ch = ad.numberOfChannels;
+    const buf = this.ctx.createBuffer(ch, n, ad.sampleRate);
+    for (let c = 0; c < ch; c++) {
+      const dst = buf.getChannelData(c);
+      ad.copyTo(dst, { planeIndex: c, format: "f32-planar" });
+    }
+    ad.close();
+    const now = this.ctx.currentTime;
+    if (this.playhead < now) this.playhead = now + 0.01;
+    if (this.playhead - now > this.queueTarget * 3) {
+      this.playhead = now + this.queueTarget;  // queue ran away: resync
+    }
+    const src = this.ctx.createBufferSource();
+    src.buffer = buf;
+    src.connect(this.ctx.destination);
+    src.start(this.playhead);
+    this.playhead += buf.duration;
+  }
+
+  close() {
+    if (this.dec) try { this.dec.close(); } catch { /* already closed */ }
+    this.ctx.close();
+  }
+}
+
+/* ------------------------------------------------------------------ boot */
+const canvas = document.getElementById("screen");
+const hud = document.getElementById("hud");
+const badge = document.getElementById("badge");
+const client = new SelkiesClient(canvas, document.getElementById("status"));
+badge.addEventListener("click", () => hud.classList.toggle("hidden"));
+hud.classList.remove("hidden");
+canvas.focus();
+client.connect();
+window.selkies = client;   // console / dashboard access

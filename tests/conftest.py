@@ -8,6 +8,27 @@ Must run before jax is imported anywhere.
 import asyncio
 import inspect
 import os
+import sys
+
+import pytest
+
+def pytest_configure(config):
+    """Axon escape hatch. The TPU relay is single-client; when
+    ``PALLAS_AXON_POOL_IPS`` is set, sitecustomize dials it at INTERPRETER
+    startup — before any conftest runs — and a busy/dead relay then hangs
+    every jax init, even under ``JAX_PLATFORMS=cpu``. Tests never touch the
+    TPU, so re-exec the whole pytest process with a cleaned environment.
+    Done here (not at import) so pytest's fd capture can be released first
+    — otherwise the child's output lands in the dead parent's tmpfiles."""
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    args = list(config.invocation_params.args)
+    os.execvpe(sys.executable, [sys.executable, "-m", "pytest"] + args, env)
+
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # host may pre-set axon; tests are CPU-only
 flags = os.environ.get("XLA_FLAGS", "")
@@ -20,11 +41,57 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 def pytest_pyfunc_call(pyfuncitem):
     """Run ``async def`` tests without pytest-asyncio (absent from this
     image). Sync fixtures still resolve; async fixtures are not supported —
-    use async context managers inside the test instead."""
+    fixtures that need loop-bound teardown (client_factory) register
+    cleanups that run inside the same event loop, after the test body."""
     fn = pyfuncitem.obj
     if inspect.iscoroutinefunction(fn):
         kwargs = {n: pyfuncitem.funcargs[n]
                   for n in pyfuncitem._fixtureinfo.argnames}
-        asyncio.run(fn(**kwargs))
+
+        async def _run():
+            try:
+                await fn(**kwargs)
+            finally:
+                cf = kwargs.get("client_factory")
+                if cf is not None:
+                    await cf.cleanup()
+
+        asyncio.run(_run())
         return True
     return None
+
+
+class ClientFactory:
+    """``c = await client_factory(server)``: switch the server to a mode,
+    start an in-process aiohttp TestClient against its app, and register
+    teardown to run in the test's event loop."""
+
+    def __init__(self):
+        self._cleanups = []
+
+    async def __call__(self, server, mode: str = "websockets"):
+        from aiohttp.test_utils import TestClient, TestServer
+        await server.switch_to_mode(mode)
+        await asyncio.sleep(0)  # let the service start() task run
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+
+        async def _cleanup():
+            await server.shutdown()
+            await client.close()
+
+        self._cleanups.append(_cleanup)
+        return client
+
+    async def cleanup(self):
+        for fn in reversed(self._cleanups):
+            try:
+                await fn()
+            except Exception:
+                pass
+        self._cleanups.clear()
+
+
+@pytest.fixture
+def client_factory():
+    return ClientFactory()

@@ -127,3 +127,51 @@ def test_live_quality_update():
     small = sum(len(c.payload) for c in
                 sess.finalize(sess.encode(frame), force_all=True))
     assert small < big
+
+
+def test_quality_change_between_encode_and_finalize_uses_snapshot():
+    """finalize runs pipeline-depth frames after encode; a live quality
+    change in between must not desync the JFIF DQT from the tables the
+    device quantized with (round-1 advisor finding)."""
+    s1, s2 = CaptureSettings(**SMALL), CaptureSettings(**SMALL)
+    a, b = JpegEncoderSession(s1), JpegEncoderSession(s2)
+    src = SyntheticSource(s1.capture_width, s1.capture_height)
+    frame = src.get_frame(3)
+    out = a.encode(frame)
+    a.update_quality(10)            # live change while frame is in flight
+    chunks_a = a.finalize(out, force_all=True)
+    chunks_b = b.finalize(b.encode(frame), force_all=True)
+    assert [c.payload for c in chunks_a] == [c.payload for c in chunks_b]
+
+
+def test_overflow_drop_forces_full_resend():
+    """A dropped (overflowed) frame advanced the damage baseline past what
+    the client saw; the next delivered frame must resend every stripe."""
+    s = CaptureSettings(**SMALL)
+    sess = JpegEncoderSession(s)
+    src = SyntheticSource(s.capture_width, s.capture_height, static_after=1)
+    sess.finalize(sess.encode(src.get_frame(0)))
+    out = sess.encode(src.get_frame(1))          # content changed here...
+    out["overflow"] = np.array(True)             # ...but the frame dropped
+    assert sess.finalize(out) == []
+    out2 = sess.encode(src.get_frame(2))         # static vs dropped frame
+    chunks = sess.finalize(out2)
+    assert len(chunks) == sess.grid.n_stripes    # forced full refresh
+
+
+def test_keyframe_interval_forces_periodic_refresh():
+    """keyframe_interval_s must re-send everything even for a static scene
+    (round-1 verdict: the setting was plumbed but never used)."""
+    got = []
+    s = CaptureSettings(**SMALL)
+    s.use_paint_over = False
+    s.keyframe_interval_s = 0.25
+    cap = ScreenCapture(source_kind="synthetic-static")
+    cap.start_capture(got.append, s)
+    deadline = time.time() + 30
+    n = 2 * (s.capture_height // s.stripe_height)  # two full refreshes
+    while time.time() < deadline and len(got) < n + 1:
+        time.sleep(0.05)
+    cap.stop_capture()
+    fids = {c.frame_id for c in got}
+    assert len(fids) >= 2, f"no periodic refresh: frame ids {fids}"

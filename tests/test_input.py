@@ -1,0 +1,117 @@
+"""Input handler unit tests: key lifecycle, auto-repeat vs heartbeat,
+stale-key sweep, clipboard multipart, gamepad state.
+
+Deterministic time is injected via the handler's ``now`` parameter (the
+testability seam the reference documents at selkies.py:1694-1696).
+"""
+
+import asyncio
+
+from selkies_tpu.input.backends import NullBackend
+from selkies_tpu.input.handler import (REPEAT_DELAY_S, STALE_KEY_S,
+                                       InputHandler)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_handler():
+    clock = Clock()
+    backend = NullBackend()
+    return InputHandler(backend=backend, now=clock), backend, clock
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_key_down_up_roundtrip():
+    h, b, _ = make_handler()
+    run(h.on_message("kd,65"))
+    run(h.on_message("ku,65"))
+    assert b.events == [("key", 65, True), ("key", 65, False)]
+    assert h.pressed == {}
+
+
+def test_heartbeat_does_not_reset_repeat_delay():
+    """A client heartbeating faster than REPEAT_DELAY_S must not suppress
+    auto-repeat (round-1 advisor finding: press time and heartbeat time
+    were conflated)."""
+    h, b, clock = make_handler()
+    run(h.on_message("kd,65"))
+    # heartbeat every 0.2 s well past the repeat delay
+    for i in range(1, 5):
+        clock.t = i * 0.2
+        run(h.on_message("kh,65"))
+    clock.t = REPEAT_DELAY_S + 0.2
+    assert h.repeat_once() == [65]
+    assert b.events.count(("key", 65, True)) >= 2
+
+
+def test_repeat_not_before_delay_and_not_for_modifiers():
+    h, b, clock = make_handler()
+    run(h.on_message("kd,65"))        # 'A'
+    run(h.on_message("kd,65505"))     # Shift_L (modifier)
+    clock.t = REPEAT_DELAY_S / 2
+    assert h.repeat_once() == []
+    clock.t = REPEAT_DELAY_S + 0.1
+    assert h.repeat_once() == [65]    # modifier never repeats
+
+
+def test_stale_sweep_uses_heartbeat_time():
+    h, b, clock = make_handler()
+    run(h.on_message("kd,65"))
+    clock.t = 1.0
+    run(h.on_message("kh,65"))        # heartbeat keeps it alive
+    clock.t = 1.0 + STALE_KEY_S - 0.1
+    assert h.sweep_stale_once() == []
+    clock.t = 1.0 + STALE_KEY_S + 0.1
+    assert h.sweep_stale_once() == [65]
+    assert ("key", 65, False) in b.events
+    assert h.pressed == {}
+
+
+def test_kr_releases_everything():
+    h, b, _ = make_handler()
+    run(h.on_message("kd,65"))
+    run(h.on_message("kd,66"))
+    run(h.on_message("kr,"))
+    assert h.pressed == {}
+    assert ("key", 65, False) in b.events and ("key", 66, False) in b.events
+
+
+def test_multipart_clipboard_respects_cap():
+    h, b, _ = make_handler()
+    h.clipboard_max = 16
+    run(h.on_message("cws,"))
+    run(h.on_message("cwd,QUFBQUFBQUFBQUFBQUFBQQ=="))  # 16 bytes of 'A'
+    run(h.on_message("cwd,QkJCQg=="))                  # 4 more -> over cap
+    run(h.on_message("cwe,"))
+    assert b.clipboard[0] == b""                       # dropped, not partial
+
+
+def test_multipart_clipboard_assembles():
+    h, b, _ = make_handler()
+    run(h.on_message("cws,"))
+    run(h.on_message("cwd,aGVsbG8g"))   # "hello "
+    run(h.on_message("cwd,d29ybGQ="))   # "world"
+    run(h.on_message("cwe,"))
+    assert b.clipboard == (b"hello world", "text/plain")
+
+
+def test_gamepad_config_and_events():
+    h, b, _ = make_handler()
+    seen = []
+    run(h.on_message("js,c,0,Xbox Pad"))
+    h.gamepads[0].listeners.append(lambda k, n, v: seen.append((k, n, v)))
+    run(h.on_message("js,b,0,3,1"))
+    run(h.on_message("js,a,0,1,-0.5"))
+    gp = h.gamepads[0]
+    assert gp.connected and gp.name == "Xbox Pad"
+    assert gp.buttons[3] == 1.0 and gp.axes[1] == -0.5
+    assert seen == [("b", 3, 1.0), ("a", 1, -0.5)]
