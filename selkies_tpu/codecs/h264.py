@@ -1,0 +1,501 @@
+"""Host-side H.264 bitstream assembly + golden (numpy) I16 encoder.
+
+Three jobs:
+
+1. SPS/PPS/slice-header/Annex-B assembly for the TPU encoder's streams
+   (one slice per MB row, Intra_16x16 DC-pred, CAVLC, deblocking off —
+   the design that keeps only a per-row left-neighbour scan sequential,
+   ops/h264_encode.py).
+2. A complete, slow numpy reference ENCODER (``encode_i16_frame``): the
+   golden model the device encoder must match bit-for-bit, and the
+   vehicle for auditing every CAVLC table entry against libavcodec
+   (tests/test_h264_oracle.py).
+3. Emulation prevention + NAL framing helpers shared by both.
+
+Reference parity point: the closed-source pixelflux wheel performs this
+inside its Rust H.264 encoders (SURVEY.md §2.2); the wire contract is the
+``0x04`` stripe framing (protocol.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import h264_tables as T
+from .h264_tables import (MF4_NP, QPC_NP, V4_NP, ZIGZAG4_NP, se_bits,
+                          ue_bits)
+
+
+class BitWriter:
+    def __init__(self):
+        self.bits: list[int] = []
+
+    def put(self, length: int, code: int) -> None:
+        for i in range(length - 1, -1, -1):
+            self.bits.append((code >> i) & 1)
+
+    def ue(self, v: int) -> None:
+        self.put(*ue_bits(v))
+
+    def se(self, v: int) -> None:
+        self.put(*se_bits(v))
+
+    def rbsp_trailing(self) -> None:
+        self.bits.append(1)
+        while len(self.bits) % 8:
+            self.bits.append(0)
+
+    def to_bytes(self) -> bytes:
+        assert len(self.bits) % 8 == 0
+        arr = np.array(self.bits, np.uint8)
+        return np.packbits(arr).tobytes()
+
+
+def emulation_prevent(rbsp: bytes) -> bytes:
+    """Insert 0x03 after any 00 00 followed by 00/01/02/03 (§7.4.1.1)."""
+    out = bytearray()
+    zeros = 0
+    for b in rbsp:
+        if zeros >= 2 and b <= 3:
+            out.append(3)
+            zeros = 0
+        out.append(b)
+        zeros = zeros + 1 if b == 0 else 0
+    return bytes(out)
+
+
+def nal(nal_type: int, rbsp: bytes, ref_idc: int = 3) -> bytes:
+    return b"\x00\x00\x00\x01" + bytes([(ref_idc << 5) | nal_type]) \
+        + emulation_prevent(rbsp)
+
+
+def write_sps(width: int, height: int, level_idc: int = 42) -> bytes:
+    """Constrained-Baseline SPS for a ``width``x``height`` frame (16-px
+    padded internally, cropped via frame_cropping)."""
+    w_mbs = (width + 15) // 16
+    h_mbs = (height + 15) // 16
+    crop_r = w_mbs * 16 - width
+    crop_b = h_mbs * 16 - height
+    w = BitWriter()
+    w.put(8, 66)          # profile_idc baseline
+    w.put(8, 0xC0)        # constraint_set0+1 flags
+    w.put(8, level_idc)
+    w.ue(0)               # sps_id
+    w.ue(0)               # log2_max_frame_num_minus4
+    w.ue(2)               # pic_order_cnt_type 2 (no POC syntax in slices)
+    w.ue(0)               # max_num_ref_frames
+    w.put(1, 0)           # gaps_in_frame_num_value_allowed
+    w.ue(w_mbs - 1)
+    w.ue(h_mbs - 1)
+    w.put(1, 1)           # frame_mbs_only
+    w.put(1, 1)           # direct_8x8_inference
+    if crop_r or crop_b:
+        w.put(1, 1)
+        w.ue(0); w.ue(crop_r // 2); w.ue(0); w.ue(crop_b // 2)
+    else:
+        w.put(1, 0)
+    w.put(1, 0)           # vui_parameters_present
+    w.rbsp_trailing()
+    return nal(7, w.to_bytes())
+
+
+def write_pps() -> bytes:
+    w = BitWriter()
+    w.ue(0)               # pps_id
+    w.ue(0)               # sps_id
+    w.put(1, 0)           # entropy_coding_mode = CAVLC
+    w.put(1, 0)           # bottom_field_pic_order
+    w.ue(0)               # num_slice_groups_minus1
+    w.ue(0)               # num_ref_idx_l0_default_active_minus1
+    w.ue(0)               # num_ref_idx_l1_default_active_minus1
+    w.put(1, 0)           # weighted_pred
+    w.put(2, 0)           # weighted_bipred_idc
+    w.se(0)               # pic_init_qp_minus26
+    w.se(0)               # pic_init_qs_minus26
+    w.se(0)               # chroma_qp_index_offset
+    w.put(1, 1)           # deblocking_filter_control_present
+    w.put(1, 0)           # constrained_intra_pred
+    w.put(1, 0)           # redundant_pic_cnt_present
+    w.rbsp_trailing()
+    return nal(8, w.to_bytes())
+
+
+def slice_header_bits(w: BitWriter, first_mb: int, qp: int,
+                      idr_pic_id: int = 0) -> None:
+    """IDR I-slice header matching write_sps/write_pps choices."""
+    w.ue(first_mb)
+    w.ue(7)               # slice_type I (all slices)
+    w.ue(0)               # pps_id
+    w.put(4, 0)           # frame_num (log2_max_frame_num = 4), IDR -> 0
+    w.ue(idr_pic_id)
+    # poc type 2: nothing
+    w.put(1, 0)           # no_output_of_prior_pics
+    w.put(1, 0)           # long_term_reference
+    w.se(qp - 26)         # slice_qp_delta
+    w.ue(1)               # disable_deblocking_filter_idc = 1 (off)
+
+
+# --------------------------------------------------------------------------
+# numpy transform half (golden model of ops/h264_transform.py)
+# --------------------------------------------------------------------------
+_CF = np.array([[1, 1, 1, 1], [2, 1, -1, -2],
+                [1, -1, -1, 1], [1, -2, 2, -1]], np.int64)
+_H4 = np.array([[1, 1, 1, 1], [1, 1, -1, -1],
+                [1, -1, -1, 1], [1, -1, 1, -1]], np.int64)
+
+
+def _fwd4(x):
+    return _CF @ x @ _CF.T
+
+
+def _inv4(d):
+    """Spec 8.5.12.2 — horizontal pass first; the >>1 floors make the pass
+    order normative."""
+    e0 = d[:, 0] + d[:, 2]; e1 = d[:, 0] - d[:, 2]
+    e2 = (d[:, 1] >> 1) - d[:, 3]; e3 = d[:, 1] + (d[:, 3] >> 1)
+    f = np.stack([e0 + e3, e1 + e2, e1 - e2, e0 - e3], axis=1)
+    g0 = f[0] + f[2]; g1 = f[0] - f[2]
+    g2 = (f[1] >> 1) - f[3]; g3 = f[1] + (f[3] >> 1)
+    return np.stack([g0 + g3, g1 + g2, g1 - g2, g0 - g3])
+
+
+def _quant4(wm, qp, dc_shift=0):
+    qbits = 15 + qp // 6 + dc_shift
+    mf = MF4_NP[qp % 6].astype(np.int64) if dc_shift == 0 \
+        else np.int64(MF4_NP[qp % 6, 0, 0])
+    # DC offset is 2*floor(f_intra) — parenthesisation matters: must match
+    # ops/h264_transform.quant_dc bit-for-bit (device/golden contract)
+    f = 2 * ((1 << (15 + qp // 6)) // 3) if dc_shift else ((1 << qbits) // 3)
+    mag = (np.abs(wm) * mf + f) >> qbits
+    return np.where(wm < 0, -mag, mag).astype(np.int64)
+
+
+def _dequant4_ac(c, qp):
+    ls = 16 * V4_NP[qp % 6].astype(np.int64)
+    t = qp // 6
+    if t >= 4:
+        return (c * ls) << (t - 4)
+    return (c * ls + (1 << (3 - t))) >> (4 - t)
+
+
+def _dequant_luma_dc(f, qp):
+    ls00 = 16 * int(V4_NP[qp % 6, 0, 0])
+    t = qp // 6
+    if t >= 6:
+        return (f * ls00) << (t - 6)
+    return (f * ls00 + (1 << (5 - t))) >> (6 - t)
+
+
+def _dequant_chroma_dc(f, qpc):
+    ls00 = 16 * int(V4_NP[qpc % 6, 0, 0])
+    return ((f * ls00) << (qpc // 6)) >> 5
+
+
+# decoding order of the 16 luma 4x4 blocks (§6.4.3): (row, col) in block units
+LUMA_BLK_ORDER = [(0, 0), (0, 1), (1, 0), (1, 1), (0, 2), (0, 3), (1, 2),
+                  (1, 3), (2, 0), (2, 1), (3, 0), (3, 1), (2, 2), (2, 3),
+                  (3, 2), (3, 3)]
+
+
+def _write_level_code(w: BitWriter, level_code: int, suffix_len: int) -> None:
+    """Emit one coeff_level (§9.2.2.1 inverse), incl. the prefix>=16
+    extended escapes large low-QP levels need."""
+    if suffix_len == 0:
+        if level_code < 14:
+            w.put(level_code + 1, 1)               # unary
+            return
+        if level_code < 30:
+            w.put(15, 1)                            # prefix 14
+            w.put(4, level_code - 14)
+            return
+        thresh = 30
+    else:
+        if (level_code >> suffix_len) < 15:
+            prefix = level_code >> suffix_len
+            w.put(prefix + 1, 1)
+            w.put(suffix_len, level_code & ((1 << suffix_len) - 1))
+            return
+        thresh = 15 << suffix_len
+    rem = level_code - thresh
+    if rem < 4096:
+        w.put(16, 1)                                # prefix 15, 12-bit suffix
+        w.put(12, rem)
+        return
+    # prefix p >= 16: rem = u(p-3) + (1 << (p-3)) - 4096
+    p = (rem + 4096).bit_length() + 2
+    w.put(p + 1, 1)
+    w.put(p - 3, rem + 4096 - (1 << (p - 3)))
+
+
+def _write_residual_block(w: BitWriter, coeffs: np.ndarray, nc: int,
+                          max_coeff: int) -> int:
+    """CAVLC-encode one block (coeffs in scan order). Returns TotalCoeff."""
+    nz = np.nonzero(coeffs)[0]
+    tc = len(nz)
+    # trailing ones: up to three |1| values at the scan tail
+    t1 = 0
+    for idx in nz[::-1]:
+        if abs(int(coeffs[idx])) == 1 and t1 < 3:
+            t1 += 1
+        else:
+            break
+    w.put(*T.coeff_token(nc, tc, t1))
+    if tc == 0:
+        return 0
+    # trailing one signs, highest frequency first
+    for k in range(t1):
+        w.put(1, 1 if coeffs[nz[-1 - k]] < 0 else 0)
+    # remaining levels, highest frequency first
+    suffix_len = 1 if (tc > 10 and t1 < 3) else 0
+    first = True
+    for k in range(t1, tc):
+        level = int(coeffs[nz[-1 - k]])
+        level_code = 2 * level - 2 if level > 0 else -2 * level - 1
+        if first and t1 < 3:
+            level_code -= 2
+        first = False
+        _write_level_code(w, level_code, suffix_len)
+        if suffix_len == 0:
+            suffix_len = 1
+        if abs(level) > (3 << (suffix_len - 1)) and suffix_len < 6:
+            suffix_len += 1
+    # total_zeros
+    tz = int(nz[-1]) + 1 - tc
+    if tc < max_coeff:
+        w.put(*T.total_zeros(tc, tz, chroma_dc=(nc == -1)))
+    # run_before
+    zeros_left = tz
+    prev = int(nz[-1])
+    for k in range(1, tc):
+        cur = int(nz[-1 - k])
+        run = prev - cur - 1
+        if zeros_left > 0:
+            w.put(*T.run_before(zeros_left, run))
+        zeros_left -= run
+        prev = cur
+    return tc
+
+
+class I16Encoder:
+    """Golden numpy Intra_16x16 DC-pred encoder, one slice per MB row."""
+
+    def __init__(self, width: int, height: int, qp: int = 28):
+        if not 8 <= qp <= 48:
+            raise ValueError("qp out of the supported 8..48 range")
+        self.width, self.height = width, height
+        self.qp = qp
+        self.mb_w = (width + 15) // 16
+        self.mb_h = (height + 15) // 16
+
+    def headers(self) -> bytes:
+        return write_sps(self.width, self.height) + write_pps()
+
+    def encode_frame(self, y: np.ndarray, u: np.ndarray, v: np.ndarray,
+                     idr_pic_id: int = 0) -> bytes:
+        """YUV420 (padded to MB size by caller or edge-padded here) ->
+        Annex-B slices (headers not included; call headers() first)."""
+        qp, qpc = self.qp, int(QPC_NP[self.qp])
+        H16, W16 = self.mb_h * 16, self.mb_w * 16
+        y = _pad_edge(y, H16, W16)
+        u = _pad_edge(u, H16 // 2, W16 // 2)
+        v = _pad_edge(v, H16 // 2, W16 // 2)
+        out = bytearray()
+        self.recon_y = np.zeros((H16, W16), np.uint8)
+        self.recon_u = np.zeros((H16 // 2, W16 // 2), np.uint8)
+        self.recon_v = np.zeros((H16 // 2, W16 // 2), np.uint8)
+        for row in range(self.mb_h):
+            w = BitWriter()
+            slice_header_bits(w, row * self.mb_w, qp, idr_pic_id)
+            nnz_y = np.zeros((self.mb_w, 4, 4), np.int64)
+            nnz_c = np.zeros((self.mb_w, 2, 2, 2), np.int64)
+            edge_y = None   # right edge of previous MB (16,)
+            edge_c = None   # (2, 8) for u, v
+            for k in range(self.mb_w):
+                edge_y, edge_c = self._encode_mb(
+                    w, y, u, v, row, k, qp, qpc, edge_y, edge_c,
+                    nnz_y, nnz_c)
+            w.rbsp_trailing()
+            out += nal(5, w.to_bytes())
+        return bytes(out)
+
+    # ------------------------------------------------------------------ mb
+    def _encode_mb(self, w, y, u, v, row, k, qp, qpc, edge_y, edge_c,
+                   nnz_y, nnz_c):
+        x0, y0 = k * 16, row * 16
+        src = y[y0:y0 + 16, x0:x0 + 16].astype(np.int64)
+        pred_y = 128 if edge_y is None else (int(edge_y.sum()) + 8) >> 4
+
+        # 16 4x4 forward transforms
+        wblk = np.zeros((4, 4, 4, 4), np.int64)
+        for br in range(4):
+            for bc in range(4):
+                wblk[br, bc] = _fwd4(
+                    src[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] - pred_y)
+        dc = wblk[:, :, 0, 0].copy()
+        # forward Hadamard halved (JM norm): decoder's inverse Hadamard +
+        # DC rescale expect levels at half the raw transform gain
+        hd = (_H4 @ dc @ _H4) >> 1
+        dc_lvl = _quant4(hd, qp, dc_shift=1)
+        # decode path for recon
+        f = _H4 @ dc_lvl @ _H4
+        dcY = _dequant_luma_dc(f, qp)
+
+        ac_lvl = np.zeros((4, 4, 16), np.int64)   # zigzag order incl. 0 slot
+        for br in range(4):
+            for bc in range(4):
+                q = _quant4(wblk[br, bc], qp)
+                zz = q.reshape(16)[ZIGZAG4_NP]
+                zz[0] = 0                   # DC carried separately
+                ac_lvl[br, bc] = zz
+        cbp_luma = 15 if np.any(ac_lvl) else 0
+
+        # chroma
+        csrc = []
+        cpred = []
+        for ci, plane in ((0, u), (1, v)):
+            blk = plane[row * 8:row * 8 + 8, k * 8:k * 8 + 8].astype(np.int64)
+            csrc.append(blk)
+            if edge_c is None:
+                cpred.append(np.full((8, 8), 128, np.int64))
+            else:
+                e = edge_c[ci]
+                p = np.zeros((8, 8), np.int64)
+                p[0:4] = (int(e[0:4].sum()) + 2) >> 2
+                p[4:8] = (int(e[4:8].sum()) + 2) >> 2
+                cpred.append(p)
+        cw = np.zeros((2, 2, 2, 4, 4), np.int64)
+        for ci in range(2):
+            for br in range(2):
+                for bc in range(2):
+                    cw[ci, br, bc] = _fwd4(
+                        csrc[ci][br * 4:br * 4 + 4, bc * 4:bc * 4 + 4]
+                        - cpred[ci][br * 4:br * 4 + 4, bc * 4:bc * 4 + 4])
+        cdc = cw[:, :, :, 0, 0]                   # (2, 2, 2)
+        H2 = np.array([[1, 1], [1, -1]], np.int64)
+        cdc_lvl = np.zeros((2, 2, 2), np.int64)
+        cdcq = np.zeros((2, 2, 2), np.int64)
+        for ci in range(2):
+            hd2 = H2 @ cdc[ci] @ H2
+            cdc_lvl[ci] = _quant4(hd2, qpc, dc_shift=1)
+            f2 = H2 @ cdc_lvl[ci] @ H2
+            cdcq[ci] = _dequant_chroma_dc(f2, qpc)
+        cac_lvl = np.zeros((2, 2, 2, 16), np.int64)
+        for ci in range(2):
+            for br in range(2):
+                for bc in range(2):
+                    q = _quant4(cw[ci, br, bc], qpc)
+                    zz = q.reshape(16)[ZIGZAG4_NP]
+                    zz[0] = 0
+                    cac_lvl[ci, br, bc] = zz
+        has_cac = bool(np.any(cac_lvl))
+        has_cdc = bool(np.any(cdc_lvl))
+        cbp_chroma = 2 if has_cac else (1 if has_cdc else 0)
+
+        # ---- syntax
+        mb_type = 1 + 2 + 4 * cbp_chroma + (12 if cbp_luma else 0)
+        w.ue(mb_type)
+        w.ue(0)            # intra_chroma_pred_mode DC
+        w.se(0)            # mb_qp_delta
+        # luma DC block: nC from block (0,0) neighbours
+        nc = self._nc_luma(nnz_y, k, 0, 0)
+        _write_residual_block(w, dc_lvl.reshape(16)[ZIGZAG4_NP], nc, 16)
+        # luma AC
+        if cbp_luma:
+            for br, bc in LUMA_BLK_ORDER:
+                nc = self._nc_luma(nnz_y, k, br, bc)
+                tc = _write_residual_block(w, ac_lvl[br, bc][1:], nc, 15)
+                nnz_y[k, br, bc] = tc
+        else:
+            nnz_y[k, :, :] = 0
+        # chroma DC
+        if cbp_chroma:
+            for ci in range(2):
+                scan = np.array([cdc_lvl[ci, 0, 0], cdc_lvl[ci, 0, 1],
+                                 cdc_lvl[ci, 1, 0], cdc_lvl[ci, 1, 1]])
+                _write_residual_block(w, scan, -1, 4)
+        # chroma AC
+        if cbp_chroma == 2:
+            for ci in range(2):
+                for br in range(2):
+                    for bc in range(2):
+                        nc = self._nc_chroma(nnz_c, k, ci, br, bc)
+                        tc = _write_residual_block(
+                            w, cac_lvl[ci, br, bc][1:], nc, 15)
+                        nnz_c[k, ci, br, bc] = tc
+        else:
+            nnz_c[k] = 0
+
+        # ---- reconstruction (exactly the decoder's path)
+        recon = np.zeros((16, 16), np.int64)
+        for br in range(4):
+            for bc in range(4):
+                d = np.zeros(16, np.int64)
+                d[ZIGZAG4_NP] = ac_lvl[br, bc]
+                d = _dequant4_ac(d.reshape(4, 4), qp)
+                d[0, 0] = dcY[br, bc]
+                res = (_inv4(d) + 32) >> 6
+                recon[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] = \
+                    np.clip(pred_y + res, 0, 255)
+        self.recon_y[y0:y0 + 16, x0:x0 + 16] = recon
+        crecon = np.zeros((2, 8, 8), np.int64)
+        for ci, plane in ((0, self.recon_u), (1, self.recon_v)):
+            for br in range(2):
+                for bc in range(2):
+                    d = np.zeros(16, np.int64)
+                    d[ZIGZAG4_NP] = cac_lvl[ci, br, bc]
+                    d = _dequant4_ac(d.reshape(4, 4), qpc)
+                    d[0, 0] = cdcq[ci, br, bc]
+                    res = (_inv4(d) + 32) >> 6
+                    blk = np.clip(
+                        cpred[ci][br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] + res,
+                        0, 255)
+                    crecon[ci, br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] = blk
+            plane[row * 8:row * 8 + 8, k * 8:k * 8 + 8] = crecon[ci]
+        return recon[:, 15].copy(), crecon[:, :, 7].copy()
+
+    @staticmethod
+    def _nc_luma(nnz_y, k, br, bc) -> int:
+        na = nb = None
+        if bc > 0:
+            na = nnz_y[k, br, bc - 1]
+        elif k > 0:
+            na = nnz_y[k - 1, br, 3]
+        if br > 0:
+            nb = nnz_y[k, br - 1, bc]
+        if na is not None and nb is not None:
+            return int(na + nb + 1) >> 1
+        if na is not None:
+            return int(na)
+        if nb is not None:
+            return int(nb)
+        return 0
+
+    @staticmethod
+    def _nc_chroma(nnz_c, k, ci, br, bc) -> int:
+        na = nb = None
+        if bc > 0:
+            na = nnz_c[k, ci, br, bc - 1]
+        elif k > 0:
+            na = nnz_c[k - 1, ci, br, 1]
+        if br > 0:
+            nb = nnz_c[k, ci, br - 1, bc]
+        if na is not None and nb is not None:
+            return int(na + nb + 1) >> 1
+        if na is not None:
+            return int(na)
+        if nb is not None:
+            return int(nb)
+        return 0
+
+
+def _pad_edge(p: np.ndarray, h: int, w: int) -> np.ndarray:
+    if p.shape == (h, w):
+        return p
+    return np.pad(p, ((0, h - p.shape[0]), (0, w - p.shape[1])), mode="edge")
+
+
+def encode_i16_frame(y: np.ndarray, u: np.ndarray, v: np.ndarray,
+                     qp: int = 28) -> bytes:
+    """Convenience: headers + one IDR frame."""
+    enc = I16Encoder(y.shape[1], y.shape[0], qp)
+    return enc.headers() + enc.encode_frame(y, u, v)

@@ -1,0 +1,636 @@
+"""Reference H.264 intra decoder (pure numpy, test oracle).
+
+Decodes the subset our encoder emits — CAVLC I slices, Intra_16x16 and
+chroma prediction (all four modes each, so real x264 baseline-intra
+streams decode too), no deblocking — straight from ITU-T H.264 §7-§9.
+Used two ways by the tests:
+
+1. decode x264-encoded streams and compare planes byte-exactly against
+   ffmpeg's decoder (validates the shared CAVLC tables in h264_tables.py);
+2. decode the TPU encoder's output (in-tree conformance oracle when
+   libavcodec is unavailable).
+
+Slow by construction — clarity over speed; never on the serving path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from . import h264_tables as T
+from .h264_tables import QPC_NP as _QPC
+from .h264_tables import V4_NP, ZIGZAG4_NP as ZIGZAG4
+
+
+def remove_emulation_prevention(rbsp: bytes) -> bytes:
+    out = bytearray()
+    i, n = 0, len(rbsp)
+    while i < n:
+        if i + 2 < n and rbsp[i] == 0 and rbsp[i + 1] == 0 \
+                and rbsp[i + 2] == 3:
+            out += rbsp[i:i + 2]
+            i += 3
+        else:
+            out.append(rbsp[i])
+            i += 1
+    return bytes(out)
+
+
+def split_nals(annexb: bytes) -> list[bytes]:
+    """Split an Annex-B stream into NAL payloads (header byte included)."""
+    nals = []
+    i = 0
+    data = annexb
+    while True:
+        j = data.find(b"\x00\x00\x01", i)
+        if j < 0:
+            break
+        start = j + 3
+        k = data.find(b"\x00\x00\x01", start)
+        end = k if k >= 0 else len(data)
+        # CAVLC RBSP always ends on the nonzero stop-bit byte; trailing
+        # zeros belong to the next (4-byte) start code — strip them all
+        while end > start and data[end - 1] == 0:
+            end -= 1
+        nal = data[start:end]
+        if nal:
+            nals.append(remove_emulation_prevention(nal))
+        if k < 0:
+            break
+        i = k
+    return nals
+
+
+class BitReader:
+    def __init__(self, data: bytes):
+        self.bits = np.unpackbits(np.frombuffer(data, np.uint8))
+        self.pos = 0
+
+    def u(self, n: int) -> int:
+        v = 0
+        for _ in range(n):
+            v = (v << 1) | int(self.bits[self.pos])
+            self.pos += 1
+        return v
+
+    def ue(self) -> int:
+        zeros = 0
+        while self.bits[self.pos] == 0:
+            zeros += 1
+            self.pos += 1
+            if zeros > 32:
+                raise ValueError("bad ue(v)")
+        self.pos += 1
+        return (1 << zeros) - 1 + self.u(zeros)
+
+    def se(self) -> int:
+        k = self.ue()
+        return (k + 1) // 2 if k % 2 else -(k // 2)
+
+    def more_rbsp_data(self) -> bool:
+        # true unless only the rbsp_stop_bit (+ zero padding) remains
+        rest = self.bits[self.pos:]
+        nz = np.nonzero(rest)[0]
+        return len(nz) > 0 and nz[-1] != 0 or (len(nz) > 1)
+
+
+@dataclasses.dataclass
+class SPS:
+    width: int = 0
+    height: int = 0
+    log2_max_frame_num: int = 4
+    poc_type: int = 0
+    log2_max_poc_lsb: int = 4
+    crop: tuple = (0, 0, 0, 0)
+
+
+@dataclasses.dataclass
+class PPS:
+    pic_init_qp: int = 26
+    deblocking_control: bool = False
+    chroma_qp_index_offset: int = 0
+
+
+def parse_sps(rbsp: bytes) -> SPS:
+    r = BitReader(rbsp[1:])  # skip NAL header byte
+    profile = r.u(8)
+    r.u(8)  # constraint flags + reserved
+    r.u(8)  # level
+    r.ue()  # sps id
+    if profile in (100, 110, 122, 244, 44, 83, 86, 118, 128):
+        if r.ue() == 3:  # chroma_format_idc
+            r.u(1)
+        r.ue(); r.ue(); r.u(1)
+        if r.u(1):  # seq_scaling_matrix_present
+            raise NotImplementedError("scaling matrices")
+    s = SPS()
+    s.log2_max_frame_num = r.ue() + 4
+    s.poc_type = r.ue()
+    if s.poc_type == 0:
+        s.log2_max_poc_lsb = r.ue() + 4
+    elif s.poc_type == 1:
+        raise NotImplementedError("poc type 1")
+    r.ue()  # max_num_ref_frames
+    r.u(1)  # gaps allowed
+    w_mbs = r.ue() + 1
+    h_mbs = r.ue() + 1
+    frame_mbs_only = r.u(1)
+    if not frame_mbs_only:
+        raise NotImplementedError("fields")
+    r.u(1)  # direct_8x8
+    if r.u(1):  # frame_cropping
+        s.crop = (r.ue(), r.ue(), r.ue(), r.ue())
+    s.width, s.height = w_mbs * 16, h_mbs * 16
+    return s
+
+
+def parse_pps(rbsp: bytes) -> PPS:
+    r = BitReader(rbsp[1:])
+    r.ue(); r.ue()
+    entropy = r.u(1)
+    if entropy:
+        raise NotImplementedError("CABAC")
+    r.u(1)  # bottom_field_pic_order
+    if r.ue() != 0:
+        raise NotImplementedError("slice groups")
+    r.ue(); r.ue()
+    r.u(1); r.u(2)
+    p = PPS()
+    p.pic_init_qp = 26 + r.se()
+    r.se()  # pic_init_qs
+    p.chroma_qp_index_offset = r.se()
+    p.deblocking_control = bool(r.u(1))
+    r.u(1)  # constrained_intra_pred
+    r.u(1)  # redundant_pic_cnt
+    return p
+
+
+# ---------------------------------------------------------------- residual
+
+def _decode_coeff_token(r: BitReader, nc: int) -> tuple[int, int]:
+    """-> (total_coeff, trailing_ones) by longest-prefix table match."""
+    if nc == -1:
+        lens, codes = T.CT_CDC_LEN_NP, T.CT_CDC_CODE_NP
+        max_tc = 4
+    elif nc >= 8:
+        v = r.u(6)
+        if v == 3:
+            return 0, 0
+        return (v >> 2) + 1, v & 3
+    else:
+        ctx = 0 if nc < 2 else (1 if nc < 4 else 2)
+        lens, codes = T.CT_LEN_NP[ctx], T.CT_CODE_NP[ctx]
+        max_tc = 16
+    # walk bit by bit until a unique (len, code) matches
+    v, n = 0, 0
+    for _ in range(20):
+        v = (v << 1) | r.u(1)
+        n += 1
+        for t1 in range(4):
+            for tc in range(max_tc + 1):
+                if lens[t1][tc] == n and codes[t1][tc] == v:
+                    return tc, t1
+    raise ValueError(f"coeff_token parse failed (nc={nc})")
+
+
+def _decode_vlc(r: BitReader, lens_row, codes_row, what: str) -> int:
+    v, n = 0, 0
+    for _ in range(16):
+        v = (v << 1) | r.u(1)
+        n += 1
+        for idx in range(len(lens_row)):
+            if lens_row[idx] == n and codes_row[idx] == v:
+                return idx
+    raise ValueError(f"{what} parse failed")
+
+
+def residual_block(r: BitReader, nc: int, max_coeff: int) -> np.ndarray:
+    """CAVLC-decode one block -> coefficient array in scan order
+    (length max_coeff)."""
+    coeffs = np.zeros(max_coeff, np.int32)
+    tc, t1 = _decode_coeff_token(r, nc)
+    if tc == 0:
+        return coeffs
+    levels = []
+    for i in range(t1):
+        levels.append(1 - 2 * r.u(1))
+    suffix_len = 1 if (tc > 10 and t1 < 3) else 0
+    for i in range(tc - t1):
+        # level_prefix
+        prefix = 0
+        while r.u(1) == 0:
+            prefix += 1
+            if prefix > 32:
+                raise ValueError("bad level_prefix")
+        if prefix <= 15:
+            if suffix_len == 0:
+                if prefix < 14:
+                    level_code = prefix
+                elif prefix == 14:
+                    level_code = 14 + r.u(4)
+                else:
+                    level_code = 30 + r.u(12)
+            else:
+                if prefix < 15:
+                    level_code = (prefix << suffix_len) + r.u(suffix_len)
+                else:
+                    level_code = (15 << suffix_len) + r.u(12)
+        else:  # prefix >= 16: extended escape (§9.2.2.1)
+            level_code = (15 << suffix_len) + r.u(prefix - 3) \
+                + ((1 << (prefix - 3)) - 4096)
+            if suffix_len == 0:
+                level_code += 15
+        if i == 0 and t1 < 3:
+            level_code += 2
+        level = (level_code + 2) >> 1 if level_code % 2 == 0 \
+            else -((level_code + 1) >> 1)
+        levels.append(level)
+        if suffix_len == 0:
+            suffix_len = 1
+        if abs(level) > (3 << (suffix_len - 1)) and suffix_len < 6:
+            suffix_len += 1
+    # total_zeros
+    if tc < max_coeff:
+        if nc == -1:
+            tz = _decode_vlc(r, T.TZ_CDC_LEN_NP[tc - 1],
+                             T.TZ_CDC_CODE_NP[tc - 1], "tz_cdc")
+        else:
+            tz = _decode_vlc(r, T.TZ_LEN_NP[tc - 1],
+                             T.TZ_CODE_NP[tc - 1], "tz")
+    else:
+        tz = 0
+    # runs
+    runs = []
+    zeros_left = tz
+    for i in range(tc - 1):
+        if zeros_left > 0:
+            run = _decode_vlc(r, T.RB_LEN_NP[min(zeros_left, 7) - 1],
+                              T.RB_CODE_NP[min(zeros_left, 7) - 1], "run")
+        else:
+            run = 0
+        runs.append(run)
+        zeros_left -= run
+    runs.append(zeros_left)
+    # place coefficients (levels[0] is the highest-frequency coeff)
+    pos = tc + tz - 1
+    for i, level in enumerate(levels):
+        coeffs[pos] = level
+        pos -= 1 + runs[i]
+    return coeffs
+
+
+# ------------------------------------------------------------- reconstruction
+
+def _inv4x4(d: np.ndarray) -> np.ndarray:
+    """Spec 8.5.12.2 — rows (horizontal) FIRST, then columns. The order is
+    normative: the >>1 truncations do not commute between passes."""
+    e0 = d[:, 0] + d[:, 2]; e1 = d[:, 0] - d[:, 2]
+    e2 = (d[:, 1] >> 1) - d[:, 3]; e3 = d[:, 1] + (d[:, 3] >> 1)
+    f = np.stack([e0 + e3, e1 + e2, e1 - e2, e0 - e3], axis=1)
+    g0 = f[0] + f[2]; g1 = f[0] - f[2]
+    g2 = (f[1] >> 1) - f[3]; g3 = f[1] + (f[3] >> 1)
+    return np.stack([g0 + g3, g1 + g2, g1 - g2, g0 - g3])
+
+
+def _dequant4x4_ac(c: np.ndarray, qp: int) -> np.ndarray:
+    ls = 16 * V4_NP[qp % 6]
+    t = qp // 6
+    if t >= 4:
+        return (c * ls) << (t - 4)
+    return (c * ls + (1 << (3 - t))) >> (4 - t)
+
+
+def _dequant_luma_dc(f: np.ndarray, qp: int) -> np.ndarray:
+    ls00 = 16 * int(V4_NP[qp % 6, 0, 0])
+    t = qp // 6
+    if t >= 6:
+        return (f * ls00) << (t - 6)
+    return (f * ls00 + (1 << (5 - t))) >> (6 - t)
+
+
+def _dequant_chroma_dc(f: np.ndarray, qpc: int) -> np.ndarray:
+    ls00 = 16 * int(V4_NP[qpc % 6, 0, 0])
+    return ((f * ls00) << (qpc // 6)) >> 5
+
+
+_H4 = np.array([[1, 1, 1, 1], [1, 1, -1, -1],
+                [1, -1, -1, 1], [1, -1, 1, -1]], np.int64)
+
+# raster position of the 16 luma 4x4 blocks in decoding order (§6.4.3)
+_LUMA_BLK_ORDER = [(0, 0), (0, 1), (1, 0), (1, 1), (0, 2), (0, 3), (1, 2),
+                   (1, 3), (2, 0), (2, 1), (3, 0), (3, 1), (2, 2), (2, 3),
+                   (3, 2), (3, 3)]  # (row4, col4) per blkIdx
+
+
+class Decoder:
+    """Single-picture CAVLC intra decoder."""
+
+    def __init__(self):
+        self.sps: SPS | None = None
+        self.pps: PPS | None = None
+
+    def decode(self, annexb: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        for nal in split_nals(annexb):
+            ntype = nal[0] & 0x1F
+            if ntype == 7:
+                self.sps = parse_sps(nal)
+            elif ntype == 8:
+                self.pps = parse_pps(nal)
+        assert self.sps and self.pps, "missing SPS/PPS"
+        W, H = self.sps.width, self.sps.height
+        self.Y = np.zeros((H, W), np.uint8)
+        self.U = np.zeros((H // 2, W // 2), np.uint8)
+        self.V = np.zeros((H // 2, W // 2), np.uint8)
+        self.mb_w = W // 16
+        # per-4x4-block nonzero counts for nC context
+        self.nnz_y = {}
+        self.nnz_c = {}
+        self.mb_slice = {}   # mb_addr -> slice id (availability)
+        self.mb_count = (W // 16) * (H // 16)
+        slice_id = 0
+        for nal in split_nals(annexb):
+            if nal[0] & 0x1F in (1, 5):
+                self._decode_slice(nal, slice_id)
+                slice_id += 1
+        cl, cr, ct, cb = self.sps.crop
+        y = self.Y[2 * ct:H - 2 * cb, 2 * cl:W - 2 * cr]
+        u = self.U[ct:H // 2 - cb, cl:W // 2 - cr]
+        v = self.V[ct:H // 2 - cb, cl:W // 2 - cr]
+        return y, u, v
+
+    # ------------------------------------------------------------ slice
+    def _decode_slice(self, nal: bytes, slice_id: int) -> None:
+        sps, pps = self.sps, self.pps
+        r = BitReader(nal[1:])
+        first_mb = r.ue()
+        slice_type = r.ue()
+        if slice_type % 5 != 2:
+            raise NotImplementedError("non-I slice")
+        r.ue()  # pps id
+        r.u(sps.log2_max_frame_num)
+        if (nal[0] & 0x1F) == 5:
+            r.ue()  # idr_pic_id
+        if sps.poc_type == 0:
+            r.u(sps.log2_max_poc_lsb)
+        if (nal[0] >> 5) and (nal[0] & 0x1F) == 5:
+            r.u(1); r.u(1)  # dec_ref_pic_marking for IDR
+        elif (nal[0] >> 5):
+            if r.u(1):
+                raise NotImplementedError("adaptive ref pic marking")
+        qp = pps.pic_init_qp + r.se()
+        if pps.deblocking_control:
+            idc = r.ue()
+            if idc != 1:
+                # deblocking on: the two offset fields follow; consume them
+                # to keep the parse in sync. Recon will legitimately differ
+                # from a filtering decoder — callers must encode with
+                # no-deblock for byte-exact comparisons.
+                r.se(); r.se()
+        mb_addr = first_mb
+        while True:
+            qp = self._decode_mb(r, mb_addr, qp, slice_id)  # QPy persists
+            mb_addr += 1
+            if mb_addr >= self.mb_count or not r.more_rbsp_data():
+                break
+
+    # --------------------------------------------------------------- mb
+    def _nc_luma(self, mbx, mby, blk_r, blk_c, slice_id) -> int:
+        """nC for luma 4x4 block at (blk_r, blk_c) inside MB (mbx,mby)."""
+        def count(bx, by, br, bc):
+            addr = by * self.mb_w + bx
+            if bx < 0 or by < 0 or self.mb_slice.get(addr) != slice_id:
+                return None
+            return self.nnz_y.get((bx, by, br, bc), 0)
+        if blk_c > 0:
+            na = count(mbx, mby, blk_r, blk_c - 1)
+        else:
+            na = count(mbx - 1, mby, blk_r, 3)
+        if blk_r > 0:
+            nb = count(mbx, mby, blk_r - 1, blk_c)
+        else:
+            nb = count(mbx, mby - 1, 3, blk_c)
+        if na is not None and nb is not None:
+            return (na + nb + 1) >> 1
+        if na is not None:
+            return na
+        if nb is not None:
+            return nb
+        return 0
+
+    def _nc_chroma(self, mbx, mby, comp, blk_r, blk_c, slice_id) -> int:
+        def count(bx, by, br, bc):
+            addr = by * self.mb_w + bx
+            if bx < 0 or by < 0 or self.mb_slice.get(addr) != slice_id:
+                return None
+            return self.nnz_c.get((bx, by, comp, br, bc), 0)
+        if blk_c > 0:
+            na = count(mbx, mby, blk_r, blk_c - 1)
+        else:
+            na = count(mbx - 1, mby, blk_r, 1)
+        if blk_r > 0:
+            nb = count(mbx, mby, blk_r - 1, blk_c)
+        else:
+            nb = count(mbx, mby - 1, 1, blk_c)
+        if na is not None and nb is not None:
+            return (na + nb + 1) >> 1
+        if na is not None:
+            return na
+        if nb is not None:
+            return nb
+        return 0
+
+    def _decode_mb(self, r: BitReader, mb_addr: int, qp: int,
+                   slice_id: int) -> int:
+        mbx, mby = mb_addr % self.mb_w, mb_addr // self.mb_w
+        self.mb_slice[mb_addr] = slice_id
+        mb_type = r.ue()
+        if mb_type == 25:
+            raise NotImplementedError("I_PCM")
+        if not 1 <= mb_type <= 24:
+            raise NotImplementedError(f"mb_type {mb_type} (I_4x4?)")
+        t = mb_type - 1
+        pred_mode = t % 4
+        cbp_chroma = (t // 4) % 3
+        cbp_luma = 15 if t >= 12 else 0
+        chroma_pred = r.ue()
+        qp = qp + r.se()  # mb_qp_delta
+        qpc = int(_QPC[np.clip(qp + self.pps.chroma_qp_index_offset, 0, 51)])
+
+        left_ok = mbx > 0 and self.mb_slice.get(mb_addr - 1) == slice_id
+        top_ok = mby > 0 and \
+            self.mb_slice.get(mb_addr - self.mb_w) == slice_id
+
+        # ---- luma DC block
+        nc_dc = self._nc_luma(mbx, mby, 0, 0, slice_id)
+        dc_scan = residual_block(r, nc_dc, 16)
+        dc_zz = np.zeros(16, np.int64)
+        dc_zz[ZIGZAG4] = dc_scan  # inverse zigzag
+        dc_blk = dc_zz.reshape(4, 4)
+        f = _H4 @ dc_blk @ _H4
+        dcY = _dequant_luma_dc(f, qp)  # (4,4): per 4x4-block DC values
+
+        # ---- luma AC blocks
+        ac = np.zeros((4, 4, 16), np.int64)  # [blk_r][blk_c][coeff raster]
+        for blk_idx in range(16):
+            br, bc = _LUMA_BLK_ORDER[blk_idx]
+            if cbp_luma:
+                nc = self._nc_luma(mbx, mby, br, bc, slice_id)
+                coeffs = residual_block(r, nc, 15)
+                self.nnz_y[(mbx, mby, br, bc)] = int(np.count_nonzero(coeffs))
+                zz = np.zeros(16, np.int64)
+                zz[ZIGZAG4[1:]] = coeffs
+                ac[br, bc] = zz
+            else:
+                self.nnz_y[(mbx, mby, br, bc)] = 0
+
+        # ---- chroma residual
+        cdc = np.zeros((2, 2, 2), np.int64)   # [comp]
+        cac = np.zeros((2, 2, 2, 16), np.int64)
+        if cbp_chroma:
+            for comp in range(2):
+                coeffs = residual_block(r, -1, 4)
+                blk = np.array([[coeffs[0], coeffs[1]],
+                                [coeffs[2], coeffs[3]]], np.int64)
+                f2 = np.array([[1, 1], [1, -1]], np.int64)
+                cdc[comp] = _dequant_chroma_dc(f2 @ blk @ f2, qpc)
+        if cbp_chroma == 2:
+            for comp in range(2):
+                for br in range(2):
+                    for bc in range(2):
+                        nc = self._nc_chroma(mbx, mby, comp, br, bc, slice_id)
+                        coeffs = residual_block(r, nc, 15)
+                        self.nnz_c[(mbx, mby, comp, br, bc)] = \
+                            int(np.count_nonzero(coeffs))
+                        zz = np.zeros(16, np.int64)
+                        zz[ZIGZAG4[1:]] = coeffs
+                        cac[comp, br, bc] = zz
+        else:
+            for comp in range(2):
+                for br in range(2):
+                    for bc in range(2):
+                        self.nnz_c[(mbx, mby, comp, br, bc)] = 0
+
+        # ---- luma prediction (16x16)
+        y0, x0 = mby * 16, mbx * 16
+        top = self.Y[y0 - 1, x0:x0 + 16].astype(np.int64) if top_ok else None
+        left = self.Y[y0:y0 + 16, x0 - 1].astype(np.int64) if left_ok else None
+        tl = int(self.Y[y0 - 1, x0 - 1]) if (top_ok and left_ok) else 0
+        pred = self._pred16(pred_mode, top, left, tl)
+
+        # ---- luma reconstruction
+        for br in range(4):
+            for bc in range(4):
+                d = ac[br, bc].reshape(4, 4).copy()
+                d = _dequant4x4_ac(d, qp)
+                d[0, 0] = dcY[br, bc]
+                res = (_inv4x4(d) + 32) >> 6
+                blk = pred[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] + res
+                self.Y[y0 + br * 4:y0 + br * 4 + 4,
+                       x0 + bc * 4:x0 + bc * 4 + 4] = np.clip(blk, 0, 255)
+
+        # ---- chroma prediction + reconstruction
+        cy0, cx0 = mby * 8, mbx * 8
+        for comp, plane in ((0, self.U), (1, self.V)):
+            ctop = plane[cy0 - 1, cx0:cx0 + 8].astype(np.int64) \
+                if top_ok else None
+            cleft = plane[cy0:cy0 + 8, cx0 - 1].astype(np.int64) \
+                if left_ok else None
+            ctl = int(plane[cy0 - 1, cx0 - 1]) if (top_ok and left_ok) else 0
+            cpred = self._pred_chroma(chroma_pred, ctop, cleft, ctl)
+            for br in range(2):
+                for bc in range(2):
+                    d = cac[comp, br, bc].reshape(4, 4).copy()
+                    d = _dequant4x4_ac(d, qpc)
+                    d[0, 0] = cdc[comp, br, bc]
+                    res = (_inv4x4(d) + 32) >> 6
+                    blk = cpred[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] + res
+                    plane[cy0 + br * 4:cy0 + br * 4 + 4,
+                          cx0 + bc * 4:cx0 + bc * 4 + 4] = \
+                        np.clip(blk, 0, 255)
+        return qp
+
+    @staticmethod
+    def _pred16(mode: int, top, left, tl: int = 0) -> np.ndarray:
+        if mode == 0:    # vertical
+            return np.tile(top, (16, 1))
+        if mode == 1:    # horizontal
+            return np.tile(left[:, None], (1, 16))
+        if mode == 2:    # DC
+            if top is not None and left is not None:
+                v = (int(top.sum()) + int(left.sum()) + 16) >> 5
+            elif left is not None:
+                v = (int(left.sum()) + 8) >> 4
+            elif top is not None:
+                v = (int(top.sum()) + 8) >> 4
+            else:
+                v = 128
+            return np.full((16, 16), v, np.int64)
+        # plane (§8.3.3.4): requires both neighbours + the corner
+        # (p[-1,-1] enters the sums where the index 6-x/6-y goes negative)
+        h = sum((x + 1) * (int(top[8 + x]) -
+                           (tl if 6 - x < 0 else int(top[6 - x])))
+                for x in range(8))
+        v = sum((y + 1) * (int(left[8 + y]) -
+                           (tl if 6 - y < 0 else int(left[6 - y])))
+                for y in range(8))
+        a = 16 * (int(left[15]) + int(top[15]))
+        b = (5 * h + 32) >> 6
+        c = (5 * v + 32) >> 6
+        yy, xx = np.mgrid[0:16, 0:16]
+        return np.clip((a + b * (xx - 7) + c * (yy - 7) + 16) >> 5, 0, 255)
+
+    @staticmethod
+    def _pred_chroma(mode: int, top, left, tl: int = 0) -> np.ndarray:
+        if mode == 0:    # DC, per 4x4 sub-block (§8.3.4.1)
+            out = np.zeros((8, 8), np.int64)
+            for br in range(2):
+                for bc in range(2):
+                    t = top[bc * 4:bc * 4 + 4] if top is not None else None
+                    l_ = left[br * 4:br * 4 + 4] if left is not None else None
+                    if (br, bc) == (0, 0) or (br, bc) == (1, 1):
+                        if t is not None and l_ is not None:
+                            v = (int(t.sum()) + int(l_.sum()) + 4) >> 3
+                        elif l_ is not None:
+                            v = (int(l_.sum()) + 2) >> 2
+                        elif t is not None:
+                            v = (int(t.sum()) + 2) >> 2
+                        else:
+                            v = 128
+                    elif (br, bc) == (0, 1):   # prefer top
+                        if t is not None:
+                            v = (int(t.sum()) + 2) >> 2
+                        elif l_ is not None:
+                            v = (int(l_.sum()) + 2) >> 2
+                        else:
+                            v = 128
+                    else:                       # (1,0): prefer left
+                        if l_ is not None:
+                            v = (int(l_.sum()) + 2) >> 2
+                        elif t is not None:
+                            v = (int(t.sum()) + 2) >> 2
+                        else:
+                            v = 128
+                    out[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] = v
+            return out
+        if mode == 1:    # horizontal
+            return np.tile(left[:, None], (1, 8))
+        if mode == 2:    # vertical
+            return np.tile(top, (8, 1))
+        # plane (§8.3.4.4)
+        h = sum((x + 1) * (int(top[4 + x]) -
+                           (tl if 2 - x < 0 else int(top[2 - x])))
+                for x in range(4))
+        v = sum((y + 1) * (int(left[4 + y]) -
+                           (tl if 2 - y < 0 else int(left[2 - y])))
+                for y in range(4))
+        a = 16 * (int(left[7]) + int(top[7]))
+        b = (17 * h + 16) >> 5
+        c = (17 * v + 16) >> 5
+        yy, xx = np.mgrid[0:8, 0:8]
+        return np.clip((a + b * (xx - 3) + c * (yy - 3) + 16) >> 5, 0, 255)
+
+
+def decode(annexb: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    return Decoder().decode(annexb)

@@ -115,3 +115,79 @@ void dec_close(void *h)
     av_packet_free(&d->pkt);
     free(d);
 }
+
+/* One-shot x264 CAVLC intra encode of a YUV420 frame -> Annex-B bytes.
+ * Gives the test suite real-world H.264 streams to validate the in-tree
+ * reference decoder's CAVLC tables against. Returns bitstream size or <0. */
+int x264_encode_idr(const uint8_t *y, const uint8_t *u, const uint8_t *v,
+                    int w, int h, int qp, uint8_t *out, int out_cap)
+{
+    const AVCodec *codec = avcodec_find_encoder_by_name("libx264");
+    if (!codec)
+        return -1;
+    AVCodecContext *ctx = avcodec_alloc_context3(codec);
+    if (!ctx)
+        return -2;
+    ctx->width = w;
+    ctx->height = h;
+    ctx->pix_fmt = AV_PIX_FMT_YUV420P;
+    ctx->time_base = (AVRational){1, 30};
+    ctx->gop_size = 1;
+    ctx->max_b_frames = 0;
+    AVDictionary *opts = NULL;
+    char qpbuf[16];
+    snprintf(qpbuf, sizeof qpbuf, "%d", qp);
+    av_dict_set(&opts, "profile", "baseline", 0);   /* CAVLC, no B/8x8 */
+    av_dict_set(&opts, "preset", "ultrafast", 0);
+    av_dict_set(&opts, "tune", "zerolatency", 0);
+    av_dict_set(&opts, "qp", qpbuf, 0);
+    /* CAVLC, I16-only, no deblocking: the exact subset the in-tree
+     * reference decoder implements, so planes must match byte-exactly. */
+    av_dict_set(&opts, "x264-params",
+                "annexb=1:cabac=0:analyse=none:partitions=none:no-deblock=1",
+                0);
+    int ret = avcodec_open2(ctx, codec, &opts);
+    av_dict_free(&opts);
+    if (ret < 0) {
+        avcodec_free_context(&ctx);
+        return -3;
+    }
+    AVFrame *frame = av_frame_alloc();
+    if (!frame) {
+        avcodec_free_context(&ctx);
+        return -6;
+    }
+    frame->format = AV_PIX_FMT_YUV420P;
+    frame->width = w;
+    frame->height = h;
+    if (av_frame_get_buffer(frame, 0) < 0 || !frame->data[0]) {
+        av_frame_free(&frame);
+        avcodec_free_context(&ctx);
+        return -7;
+    }
+    for (int r = 0; r < h; r++)
+        memcpy(frame->data[0] + (size_t)r * frame->linesize[0],
+               y + (size_t)r * w, w);
+    for (int r = 0; r < h / 2; r++) {
+        memcpy(frame->data[1] + (size_t)r * frame->linesize[1],
+               u + (size_t)r * (w / 2), w / 2);
+        memcpy(frame->data[2] + (size_t)r * frame->linesize[2],
+               v + (size_t)r * (w / 2), w / 2);
+    }
+    frame->pts = 0;
+    AVPacket *pkt = av_packet_alloc();
+    int size = -4;
+    if (avcodec_send_frame(ctx, frame) >= 0) {
+        avcodec_send_frame(ctx, NULL);  /* flush */
+        if (avcodec_receive_packet(ctx, pkt) >= 0) {
+            size = pkt->size <= out_cap ? pkt->size : -5;
+            if (size > 0)
+                memcpy(out, pkt->data, pkt->size);
+            av_packet_unref(pkt);
+        }
+    }
+    av_packet_free(&pkt);
+    av_frame_free(&frame);
+    avcodec_free_context(&ctx);
+    return size;
+}

@@ -1,0 +1,124 @@
+"""ctypes wrapper for the libavcodec decode/encode shim.
+
+Builds ``libavdec_shim.so`` from ``avdec_shim.c`` on first use (gcc +
+libavcodec dev headers, both in the image). Used by tests as the
+*independent* H.264 oracle: decode our TPU encoder's Annex-B output, and
+encode x264 CAVLC streams to validate the in-tree reference decoder.
+Degrades to ``available() == False`` when the toolchain is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import pathlib
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("selkies_tpu.native.avshim")
+
+_DIR = pathlib.Path(__file__).resolve().parent
+_SRC = _DIR / "avdec_shim.c"
+_SO = _DIR / "libavdec_shim.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_failed
+    try:
+        if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+            lib = ctypes.CDLL(str(_SO))
+            if hasattr(lib, "x264_encode_idr"):   # stale-binary guard
+                return lib
+        subprocess.run(
+            ["gcc", "-O2", "-shared", "-fPIC", "-o", str(_SO), str(_SRC),
+             "-lavcodec", "-lavutil"],
+            check=True, capture_output=True, timeout=120)
+        return ctypes.CDLL(str(_SO))
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.info("avshim unavailable (%s)", e)
+        _build_failed = True
+        return None
+
+
+def _get() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lock:
+        if _lib is None and not _build_failed:
+            lib = _build()
+            if lib is not None:
+                lib.dec_open.restype = ctypes.c_void_p
+                lib.dec_open.argtypes = [ctypes.c_char_p]
+                lib.dec_decode.restype = ctypes.c_int
+                lib.dec_flush.restype = ctypes.c_int
+                lib.dec_close.argtypes = [ctypes.c_void_p]
+                lib.x264_encode_idr.restype = ctypes.c_int
+            _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def decode_h264(annexb: bytes, max_w: int = 8192, max_h: int = 8192
+                ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Decode one Annex-B access unit with ffmpeg's H.264 decoder.
+
+    Returns (Y, U, V) uint8 planes (YUV420). Raises on decode failure —
+    a failure IS the test signal (our bitstream is non-conformant).
+    """
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("avshim unavailable")
+    h = lib.dec_open(b"h264")
+    if not h:
+        raise RuntimeError("h264 decoder open failed")
+    try:
+        y = np.empty(max_w * max_h, np.uint8)
+        u = np.empty(max_w * max_h // 4, np.uint8)
+        v = np.empty(max_w * max_h // 4, np.uint8)
+        w = ctypes.c_int(0)
+        hh = ctypes.c_int(0)
+        buf = (ctypes.c_ubyte * len(annexb)).from_buffer_copy(annexb)
+        args = (buf, len(annexb),
+                y.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+                u.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+                v.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+                ctypes.byref(w), ctypes.byref(hh))
+        ret = lib.dec_decode(ctypes.c_void_p(h), *args)
+        if ret == 1:  # low-delay decoder wants a flush for single AUs
+            ret = lib.dec_flush(ctypes.c_void_p(h), *args[2:])
+        if ret != 0:
+            raise ValueError(f"h264 decode failed (ret={ret})")
+        W, H = w.value, hh.value
+        return (y[:W * H].reshape(H, W).copy(),
+                u[:W * H // 4].reshape(H // 2, W // 2).copy(),
+                v[:W * H // 4].reshape(H // 2, W // 2).copy())
+    finally:
+        lib.dec_close(ctypes.c_void_p(h))
+
+
+def encode_x264_idr(y: np.ndarray, u: np.ndarray, v: np.ndarray,
+                    qp: int = 28) -> bytes:
+    """Encode one YUV420 frame as a CAVLC baseline IDR via libx264."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("avshim unavailable")
+    h, w = y.shape
+    out = np.empty(w * h * 4 + 65536, np.uint8)
+    y = np.ascontiguousarray(y, np.uint8)
+    u = np.ascontiguousarray(u, np.uint8)
+    v = np.ascontiguousarray(v, np.uint8)
+    p = ctypes.POINTER(ctypes.c_ubyte)
+    size = lib.x264_encode_idr(
+        y.ctypes.data_as(p), u.ctypes.data_as(p), v.ctypes.data_as(p),
+        w, h, qp, out.ctypes.data_as(p), out.size)
+    if size <= 0:
+        raise RuntimeError(f"x264 encode failed ({size})")
+    return out[:size].tobytes()

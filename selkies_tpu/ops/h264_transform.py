@@ -77,20 +77,22 @@ def forward4x4(x: jnp.ndarray) -> jnp.ndarray:
 
 def inverse4x4(d: jnp.ndarray) -> jnp.ndarray:
     """Exact inverse core transform (spec 8.5.12.2) WITHOUT the final
-    (x+32)>>6 — callers add the DC term first, then shift."""
+    (x+32)>>6 — callers add the DC term first, then shift. The pass order
+    (horizontal within rows FIRST, then vertical) is normative: the >>1
+    truncations do not commute."""
     d = d.astype(jnp.int32)
-    # rows
-    e0 = d[..., 0, :] + d[..., 2, :]
-    e1 = d[..., 0, :] - d[..., 2, :]
-    e2 = (d[..., 1, :] >> 1) - d[..., 3, :]
-    e3 = d[..., 1, :] + (d[..., 3, :] >> 1)
-    f = jnp.stack([e0 + e3, e1 + e2, e1 - e2, e0 - e3], axis=-2)
-    # columns
-    g0 = f[..., :, 0] + f[..., :, 2]
-    g1 = f[..., :, 0] - f[..., :, 2]
-    g2 = (f[..., :, 1] >> 1) - f[..., :, 3]
-    g3 = f[..., :, 1] + (f[..., :, 3] >> 1)
-    return jnp.stack([g0 + g3, g1 + g2, g1 - g2, g0 - g3], axis=-1)
+    # horizontal (within each row, across columns)
+    e0 = d[..., :, 0] + d[..., :, 2]
+    e1 = d[..., :, 0] - d[..., :, 2]
+    e2 = (d[..., :, 1] >> 1) - d[..., :, 3]
+    e3 = d[..., :, 1] + (d[..., :, 3] >> 1)
+    f = jnp.stack([e0 + e3, e1 + e2, e1 - e2, e0 - e3], axis=-1)
+    # vertical (within each column, across rows)
+    g0 = f[..., 0, :] + f[..., 2, :]
+    g1 = f[..., 0, :] - f[..., 2, :]
+    g2 = (f[..., 1, :] >> 1) - f[..., 3, :]
+    g3 = f[..., 1, :] + (f[..., 3, :] >> 1)
+    return jnp.stack([g0 + g3, g1 + g2, g1 - g2, g0 - g3], axis=-2)
 
 
 def hadamard4x4(x: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,133 @@
+"""H.264 conformance tests (SURVEY.md §7 hard-part #3: golden-stream
+checks from day one).
+
+Two independent oracles:
+- libavcodec (ffmpeg h264 decoder / libx264 encoder) via the native shim —
+  shares NOTHING with our code;
+- the in-tree numpy reference decoder — shares only the table module,
+  whose entries these tests pin against the external oracle.
+
+All pure-numpy (no jax import): safe to run anywhere.
+"""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.codecs import h264 as H
+from selkies_tpu.codecs import h264_ref_decoder as refdec
+from selkies_tpu.codecs.h264 import BitWriter, _write_residual_block
+from selkies_tpu.native import avshim
+
+needs_av = pytest.mark.skipif(not avshim.available(),
+                              reason="libavcodec shim unavailable")
+
+
+def _content(h=32, w=48, seed=42):
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:h, 0:w]
+    return {
+        "gradient": ((xx * 255 // w).astype(np.uint8),
+                     np.full((h // 2, w // 2), 90, np.uint8),
+                     np.full((h // 2, w // 2), 170, np.uint8)),
+        "noise": (rng.integers(0, 256, (h, w), dtype=np.uint8),
+                  rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8),
+                  rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8)),
+    }
+
+
+def test_cavlc_writer_reader_roundtrip():
+    """Our CAVLC reader must invert our writer bit-exactly on random
+    blocks across every nC context, including escape levels."""
+    rng = np.random.default_rng(1)
+    for _ in range(3000):
+        max_coeff = int(rng.choice([4, 15, 16]))
+        nc = -1 if max_coeff == 4 else int(rng.choice([0, 1, 2, 3, 4, 7, 9]))
+        tc = int(rng.integers(0, max_coeff + 1))
+        v = np.zeros(max_coeff, np.int64)
+        if tc:
+            pos = np.sort(rng.choice(max_coeff, size=tc, replace=False))
+            mag = rng.integers(1, 60, size=tc)
+            if rng.random() < 0.2:
+                mag[0] = int(rng.integers(60, 500))
+            v[pos] = mag * rng.choice([-1, 1], size=tc)
+        w = BitWriter()
+        _write_residual_block(w, v, nc, max_coeff)
+        w.rbsp_trailing()
+        r = refdec.BitReader(w.to_bytes())
+        got = refdec.residual_block(r, nc, max_coeff)
+        assert np.array_equal(got.astype(np.int64), v), (nc, max_coeff, v)
+
+
+def test_encoder_decodes_with_own_reference_decoder():
+    """In-tree closure: our encoder's stream through our decoder equals the
+    encoder's own reconstruction (works without libavcodec)."""
+    for name, (y, u, v) in _content().items():
+        for qp in (14, 30):
+            enc = H.I16Encoder(y.shape[1], y.shape[0], qp)
+            bs = enc.headers() + enc.encode_frame(y, u, v)
+            my, mu, mv = refdec.decode(bs)
+            assert np.array_equal(my, enc.recon_y), (name, qp)
+            assert np.array_equal(mu, enc.recon_u), (name, qp)
+            assert np.array_equal(mv, enc.recon_v), (name, qp)
+
+
+def test_encoder_psnr_reasonable():
+    y, u, v = _content()["gradient"]
+    enc = H.I16Encoder(y.shape[1], y.shape[0], qp=24)
+    enc.headers()
+    enc.encode_frame(y, u, v)
+    mse = np.mean((enc.recon_y.astype(float) - y) ** 2)
+    assert 10 * np.log10(255 ** 2 / max(mse, 1e-9)) > 38
+
+
+@needs_av
+def test_our_streams_decode_exactly_in_ffmpeg():
+    """THE conformance gate: ffmpeg must reconstruct our bitstream to the
+    byte-identical planes our encoder predicted."""
+    for name, (y, u, v) in _content().items():
+        for qp in (10, 24, 40):
+            enc = H.I16Encoder(y.shape[1], y.shape[0], qp)
+            bs = enc.headers() + enc.encode_frame(y, u, v)
+            ry, ru, rv = avshim.decode_h264(bs)
+            assert np.array_equal(ry, enc.recon_y), (name, qp)
+            assert np.array_equal(ru, enc.recon_u), (name, qp)
+            assert np.array_equal(rv, enc.recon_v), (name, qp)
+
+
+@needs_av
+def test_reference_decoder_matches_ffmpeg_on_x264_streams():
+    """Decode real x264 CAVLC-I16 streams with both decoders: byte-equal
+    planes pin every CAVLC table entry the streams exercise."""
+    for name, (y, u, v) in _content().items():
+        for qp in (12, 30, 44):
+            bs = avshim.encode_x264_idr(y, u, v, qp=qp)
+            ry, ru, rv = avshim.decode_h264(bs)
+            my, mu, mv = refdec.decode(bs)
+            assert np.array_equal(my, ry), (name, qp)
+            assert np.array_equal(mu, ru), (name, qp)
+            assert np.array_equal(mv, rv), (name, qp)
+
+
+@needs_av
+def test_multi_slice_per_row_streams():
+    """Our slice-per-MB-row layout (the TPU parallelism contract) is
+    conformant: a 64x48 frame = 3 row-slices must decode exactly."""
+    y, u, v = _content(48, 64)["noise"]
+    enc = H.I16Encoder(64, 48, qp=26)
+    bs = enc.headers() + enc.encode_frame(y, u, v)
+    assert bs.count(b"\x00\x00\x00\x01") == 5  # SPS + PPS + 3 slices
+    ry, ru, rv = avshim.decode_h264(bs)
+    assert np.array_equal(ry, enc.recon_y)
+
+
+@needs_av
+def test_non_mb_aligned_size_cropping():
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 256, (30, 52), dtype=np.uint8)
+    u = rng.integers(0, 256, (15, 26), dtype=np.uint8)
+    v = rng.integers(0, 256, (15, 26), dtype=np.uint8)
+    enc = H.I16Encoder(52, 30, qp=26)
+    bs = enc.headers() + enc.encode_frame(y, u, v)
+    ry, ru, rv = avshim.decode_h264(bs)
+    assert ry.shape == (30, 52) and ru.shape == (15, 26)
+    assert np.array_equal(ry, enc.recon_y[:30, :52])

@@ -1,0 +1,264 @@
+"""CAVLC table audit: emit single-MB streams with CRAFTED coefficient
+levels, decode with ffmpeg (ground truth), compare against our own
+reconstruction. A mismatch/parse error pinpoints the exact table entry
+(tc, t1, tz, runs, nC) that is wrong.
+
+Run: env -u PALLAS_AXON_POOL_IPS python tools/audit_cavlc.py [--quick]
+"""
+
+from __future__ import annotations
+
+import itertools
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from selkies_tpu.codecs import h264 as H                     # noqa: E402
+from selkies_tpu.codecs.h264 import (BitWriter, LUMA_BLK_ORDER,   # noqa: E402
+                                     _dequant4_ac, _dequant_chroma_dc,
+                                     _dequant_luma_dc, _inv4,
+                                     _write_residual_block, nal,
+                                     slice_header_bits)
+from selkies_tpu.codecs.h264_tables import QPC_NP, ZIGZAG4_NP  # noqa: E402
+from selkies_tpu.native import avshim                         # noqa: E402
+
+_H4 = np.array([[1, 1, 1, 1], [1, 1, -1, -1],
+                [1, -1, -1, 1], [1, -1, 1, -1]], np.int64)
+H2 = np.array([[1, 1], [1, -1]], np.int64)
+
+
+def build_stream(qp, dc_lvl, ac_lvl, cdc_lvl, cac_lvl, n_mbs=1):
+    """Craft an IDR with ``n_mbs`` MBs in one row, all using the SAME
+    levels (so nC contexts grow across MBs), pred DC. Returns
+    (annexb, expected_y, expected_u, expected_v)."""
+    W = 16 * n_mbs
+    qpc = int(QPC_NP[qp])
+    bs = bytearray(H.write_sps(W, 16) + H.write_pps())
+    w = BitWriter()
+    slice_header_bits(w, 0, qp)
+    exp_y = np.zeros((16, W), np.int64)
+    exp_u = np.zeros((8, W // 2), np.int64)
+    exp_v = np.zeros((8, W // 2), np.int64)
+    nnz_y = np.zeros((n_mbs, 4, 4), np.int64)
+    nnz_c = np.zeros((n_mbs, 2, 2, 2), np.int64)
+    edge_y = None
+    edge_c = None
+    for k in range(n_mbs):
+        cbp_luma = 15 if np.any(ac_lvl) else 0
+        has_cac = bool(np.any(cac_lvl))
+        has_cdc = bool(np.any(cdc_lvl))
+        cbp_chroma = 2 if has_cac else (1 if has_cdc else 0)
+        mb_type = 1 + 2 + 4 * cbp_chroma + (12 if cbp_luma else 0)
+        w.ue(mb_type)
+        w.ue(0)
+        w.se(0)
+        nc = H.I16Encoder._nc_luma(nnz_y, k, 0, 0)
+        _write_residual_block(w, dc_lvl.reshape(16)[ZIGZAG4_NP], nc, 16)
+        if cbp_luma:
+            for br, bc in LUMA_BLK_ORDER:
+                nc = H.I16Encoder._nc_luma(nnz_y, k, br, bc)
+                tc = _write_residual_block(w, ac_lvl[br, bc][1:], nc, 15)
+                nnz_y[k, br, bc] = tc
+        if cbp_chroma:
+            for ci in range(2):
+                scan = np.array([cdc_lvl[ci, 0, 0], cdc_lvl[ci, 0, 1],
+                                 cdc_lvl[ci, 1, 0], cdc_lvl[ci, 1, 1]])
+                _write_residual_block(w, scan, -1, 4)
+        if cbp_chroma == 2:
+            for ci in range(2):
+                for br in range(2):
+                    for bc in range(2):
+                        nc = H.I16Encoder._nc_chroma(nnz_c, k, ci, br, bc)
+                        tc = _write_residual_block(
+                            w, cac_lvl[ci, br, bc][1:], nc, 15)
+                        nnz_c[k, ci, br, bc] = tc
+
+        # expected recon (decode path)
+        pred_y = 128 if edge_y is None else (int(edge_y.sum()) + 8) >> 4
+        f = _H4 @ dc_lvl @ _H4
+        dcY = _dequant_luma_dc(f, qp)
+        rec = np.zeros((16, 16), np.int64)
+        for br in range(4):
+            for bc in range(4):
+                d = np.zeros(16, np.int64)
+                d[ZIGZAG4_NP] = ac_lvl[br, bc]
+                d = _dequant4_ac(d.reshape(4, 4), qp)
+                d[0, 0] = dcY[br, bc]
+                rec[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] = np.clip(
+                    pred_y + ((_inv4(d) + 32) >> 6), 0, 255)
+        exp_y[:, k * 16:k * 16 + 16] = rec
+        edge_y = rec[:, 15]
+        crec = np.zeros((2, 8, 8), np.int64)
+        for ci in range(2):
+            if edge_c is None:
+                cp = np.full((8, 8), 128, np.int64)
+            else:
+                e = edge_c[ci]
+                cp = np.zeros((8, 8), np.int64)
+                cp[0:4] = (int(e[0:4].sum()) + 2) >> 2
+                cp[4:8] = (int(e[4:8].sum()) + 2) >> 2
+            f2 = H2 @ cdc_lvl[ci] @ H2
+            cdcq = _dequant_chroma_dc(f2, qpc)
+            for br in range(2):
+                for bc in range(2):
+                    d = np.zeros(16, np.int64)
+                    d[ZIGZAG4_NP] = cac_lvl[ci, br, bc]
+                    d = _dequant4_ac(d.reshape(4, 4), qpc)
+                    d[0, 0] = cdcq[br, bc]
+                    crec[ci, br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] = np.clip(
+                        cp[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4]
+                        + ((_inv4(d) + 32) >> 6), 0, 255)
+        exp_u[:, k * 8:k * 8 + 8] = crec[0]
+        exp_v[:, k * 8:k * 8 + 8] = crec[1]
+        edge_c = crec[:, :, 7].copy()
+    w.rbsp_trailing()
+    bs += nal(5, w.to_bytes())
+    return bytes(bs), exp_y, exp_u, exp_v
+
+
+def check(qp, dc, ac, cdc, cac, n_mbs=1, tag=""):
+    bs, ey, eu, ev = build_stream(qp, dc, ac, cdc, cac, n_mbs)
+    try:
+        ry, ru, rv = avshim.decode_h264(bs)
+    except Exception as e:
+        return f"{tag}: DECODE-FAIL {e}"
+    if not (np.array_equal(ry.astype(np.int64), ey)
+            and np.array_equal(ru.astype(np.int64), eu)
+            and np.array_equal(rv.astype(np.int64), ev)):
+        yb = int((ry != ey).sum())
+        ub = int((ru != eu).sum())
+        vb = int((rv != ev).sum())
+        return f"{tag}: MISMATCH y={yb} u={ub} v={vb}"
+    return None
+
+
+def sparse_levels(rng, n_slots, tc, max_mag, t1=None):
+    """Random level vector (scan order) with exactly tc nonzeros."""
+    v = np.zeros(n_slots, np.int64)
+    pos = np.sort(rng.choice(n_slots, size=tc, replace=False))
+    mags = rng.integers(1, max_mag + 1, size=tc)
+    signs = rng.choice([-1, 1], size=tc)
+    v[pos] = mags * signs
+    if t1 is not None:
+        # force exactly t1 trailing ones at the scan tail
+        nz = np.nonzero(v)[0]
+        for i, idx in enumerate(nz[::-1]):
+            if i < t1:
+                v[idx] = rng.choice([-1, 1])
+            elif abs(v[idx]) == 1:
+                v[idx] = rng.choice([2, -2, 3])
+    return v
+
+
+def main():
+    quick = "--quick" in sys.argv
+    rng = np.random.default_rng(0)
+    fails = []
+    zero16 = np.zeros((4, 4), np.int64)
+    zac = np.zeros((4, 4, 16), np.int64)
+    zcdc = np.zeros((2, 2, 2), np.int64)
+    zcac = np.zeros((2, 2, 2, 16), np.int64)
+
+    # ---- 1. chroma DC exhaustive (levels in -2..2, 625^2 too many ->
+    # same pattern both components, all 625)
+    print("audit: chroma DC ...", flush=True)
+    vals = (-2, -1, 0, 1, 2)
+    combos = list(itertools.product(vals, repeat=4))
+    if quick:
+        combos = combos[::13]
+    for c in combos:
+        cdc = np.array([[ [c[0], c[1]], [c[2], c[3]] ]] * 2, np.int64)
+        r = check(30, zero16, zac, cdc, zcac, tag=f"cdc{c}")
+        if r:
+            fails.append(r)
+    print(f"  {len(fails)} failures so far", flush=True)
+
+    # ---- 2. luma DC: random patterns per (tc, t1)
+    print("audit: luma DC ...", flush=True)
+    for tc in range(0, 17):
+        for rep in range(2 if quick else 6):
+            scan = sparse_levels(rng, 16, tc, 4)
+            dc = np.zeros(16, np.int64)
+            dc[ZIGZAG4_NP] = scan
+            r = check(30, dc.reshape(4, 4), zac, zcdc, zcac,
+                      tag=f"ldc tc={tc} rep={rep}")
+            if r:
+                fails.append(r)
+    print(f"  {len(fails)} failures so far", flush=True)
+
+    # ---- 3. luma AC with nC growth across 4 MBs (exercises ctx 0..3)
+    print("audit: luma AC + nC contexts ...", flush=True)
+    for tc in range(1, 16):
+        for rep in range(2 if quick else 5):
+            ac = np.zeros((4, 4, 16), np.int64)
+            for br in range(4):
+                for bc in range(4):
+                    ac[br, bc, 1:] = sparse_levels(rng, 15, tc, 3)
+            r = check(30, zero16, ac, zcdc, zcac, n_mbs=4,
+                      tag=f"lac tc={tc} rep={rep}")
+            if r:
+                fails.append(r)
+    print(f"  {len(fails)} failures so far", flush=True)
+
+    # ---- 4. chroma AC with context growth
+    print("audit: chroma AC ...", flush=True)
+    for tc in range(1, 16):
+        for rep in range(1 if quick else 3):
+            cac = np.zeros((2, 2, 2, 16), np.int64)
+            for ci in range(2):
+                for br in range(2):
+                    for bc in range(2):
+                        cac[ci, br, bc, 1:] = sparse_levels(rng, 15, tc, 3)
+            r = check(30, zero16, zac, zcdc, cac, n_mbs=4,
+                      tag=f"cac tc={tc} rep={rep}")
+            if r:
+                fails.append(r)
+    print(f"  {len(fails)} failures so far", flush=True)
+
+    # ---- 5. big levels (escape paths) at low qp. Magnitudes are capped so
+    # dequantized coefficients stay inside the spec's +-2^15 conformance
+    # bound (qp=10 -> |level| <= ~500); beyond that libavcodec clamps at
+    # int16 and the comparison is meaningless.
+    print("audit: level escapes ...", flush=True)
+    for mag in (14, 15, 16, 30, 31, 100, 300, 500):
+        for tc in (1, 3, 6):
+            scan = sparse_levels(rng, 15, tc, 2)
+            nz = np.nonzero(scan)[0]
+            scan[nz[0]] = mag
+            ac = np.zeros((4, 4, 16), np.int64)
+            ac[0, 0, 1:] = scan
+            r = check(10, zero16, ac, zcdc, zcac,
+                      tag=f"esc mag={mag} tc={tc}")
+            if r:
+                fails.append(r)
+    # ---- 6. total_zeros sweep: tc nonzeros packed at controlled offset
+    print("audit: total_zeros ...", flush=True)
+    for tc in range(1, 16):
+        for tz in range(0, 16 - tc):
+            scan = np.zeros(15, np.int64)
+            # put tc coeffs with total zeros below the last one == tz
+            pos = list(range(tz, tz + tc))
+            for p in pos:
+                scan[p] = rng.choice([-2, 2, 1, -1])
+            if tc + tz <= 15:
+                r = check(30, zero16,
+                          _mk_ac(scan), zcdc, zcac,
+                          tag=f"tz tc={tc} tz={tz}")
+                if r:
+                    fails.append(r)
+    print(f"total failures: {len(fails)}")
+    for f in fails[:60]:
+        print(" ", f)
+    return 0 if not fails else 1
+
+
+def _mk_ac(scan):
+    ac = np.zeros((4, 4, 16), np.int64)
+    ac[0, 0, 1:] = scan
+    return ac
+
+
+if __name__ == "__main__":
+    sys.exit(main())
