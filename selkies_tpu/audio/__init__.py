@@ -1,0 +1,8 @@
+"""Audio plane: Opus capture/encode + mic playback (pcmflux equivalent,
+SURVEY.md §2.2). Audio is not a TPU problem — it stays native and boring:
+ctypes libopus for codec work, PulseAudio via subprocess when present,
+synthetic sources otherwise."""
+
+from .pipeline import AudioPipeline
+
+__all__ = ["AudioPipeline"]

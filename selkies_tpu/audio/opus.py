@@ -1,0 +1,123 @@
+"""Minimal ctypes bindings for libopus (encode + decode).
+
+The reference does Opus work inside the closed-source Rust pcmflux wheel
+(SURVEY.md §2.2: 2.5-60 ms frames, VBR, RED); here libopus.so.0 is bound
+directly. The decoder exists for tests (encode->decode roundtrip oracle).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+
+import numpy as np
+
+OPUS_APPLICATION_AUDIO = 2049
+OPUS_APPLICATION_RESTRICTED_LOWDELAY = 2051
+_OPUS_SET_BITRATE = 4002
+_OPUS_SET_INBAND_FEC = 4012
+_OPUS_SET_PACKET_LOSS_PERC = 4014
+
+_lib = None
+_load_failed = False
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is None and not _load_failed:
+        name = ctypes.util.find_library("opus")
+        if name is None:
+            _load_failed = True
+            return None
+        lib = ctypes.CDLL(name)
+        lib.opus_encoder_create.restype = ctypes.c_void_p
+        lib.opus_decoder_create.restype = ctypes.c_void_p
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class OpusError(RuntimeError):
+    pass
+
+
+class Encoder:
+    def __init__(self, sample_rate: int = 48000, channels: int = 2,
+                 bitrate: int = 128000, lowdelay: bool = True):
+        lib = _load()
+        if lib is None:
+            raise OpusError("libopus not found")
+        self._lib = lib
+        self.sample_rate = sample_rate
+        self.channels = channels
+        err = ctypes.c_int(0)
+        app = OPUS_APPLICATION_RESTRICTED_LOWDELAY if lowdelay \
+            else OPUS_APPLICATION_AUDIO
+        self._enc = lib.opus_encoder_create(
+            sample_rate, channels, app, ctypes.byref(err))
+        if err.value != 0 or not self._enc:
+            raise OpusError(f"opus_encoder_create failed ({err.value})")
+        self.set_bitrate(bitrate)
+
+    def set_bitrate(self, bps: int) -> None:
+        self._lib.opus_encoder_ctl(
+            ctypes.c_void_p(self._enc), _OPUS_SET_BITRATE, ctypes.c_int(bps))
+
+    def encode(self, pcm: np.ndarray) -> bytes:
+        """``pcm``: int16 interleaved, shape (frames * channels,) or
+        (frames, channels)."""
+        pcm = np.ascontiguousarray(pcm, np.int16).reshape(-1)
+        frames = pcm.size // self.channels
+        out = np.empty(4000, np.uint8)
+        n = self._lib.opus_encode(
+            ctypes.c_void_p(self._enc),
+            pcm.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+            ctypes.c_int(frames),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            ctypes.c_int(out.size))
+        if n < 0:
+            raise OpusError(f"opus_encode failed ({n})")
+        return out[:n].tobytes()
+
+    def __del__(self):
+        try:
+            if getattr(self, "_enc", None):
+                self._lib.opus_encoder_destroy(ctypes.c_void_p(self._enc))
+        except Exception:
+            pass
+
+
+class Decoder:
+    def __init__(self, sample_rate: int = 48000, channels: int = 2):
+        lib = _load()
+        if lib is None:
+            raise OpusError("libopus not found")
+        self._lib = lib
+        self.sample_rate = sample_rate
+        self.channels = channels
+        err = ctypes.c_int(0)
+        self._dec = lib.opus_decoder_create(
+            sample_rate, channels, ctypes.byref(err))
+        if err.value != 0 or not self._dec:
+            raise OpusError(f"opus_decoder_create failed ({err.value})")
+
+    def decode(self, packet: bytes, max_frames: int = 5760) -> np.ndarray:
+        out = np.empty(max_frames * self.channels, np.int16)
+        buf = (ctypes.c_ubyte * len(packet)).from_buffer_copy(packet)
+        n = self._lib.opus_decode(
+            ctypes.c_void_p(self._dec), buf, ctypes.c_int(len(packet)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+            ctypes.c_int(max_frames), ctypes.c_int(0))
+        if n < 0:
+            raise OpusError(f"opus_decode failed ({n})")
+        return out[:n * self.channels].reshape(n, self.channels)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_dec", None):
+                self._lib.opus_decoder_destroy(ctypes.c_void_p(self._dec))
+        except Exception:
+            pass

@@ -1,0 +1,252 @@
+"""Audio pipeline: capture -> Opus encode -> 0x01 fan-out (+RED), and the
+client-mic playback path.
+
+Fresh implementation of the responsibilities the reference splits between
+pcmflux and ``_start_pcmflux_pipeline``/``_pcmflux_send_audio_chunks``
+(reference selkies.py:1142-1349):
+
+- sources: PulseAudio monitor via a ``parec`` subprocess when available,
+  else a synthetic tone (tests, headless parity with the fake-frame
+  source seam);
+- per-listener bounded queues of ``audio_backpressure_queue`` chunks
+  (reference settings.py:899-905: 120): a slow listener drops OLDEST
+  audio, never paces capture or the other listeners;
+- Opus RED (RFC 2198) redundancy at ``audio_red_distance`` via
+  protocol.pack_red_payload (reference gates on all-clients-capable;
+  here the 0x01 header's n_red byte lets each client de-frame);
+- mic playback: client 0x02 PCM -> ``pacat`` subprocess when PulseAudio
+  exists, else counted and dropped.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import shutil
+import time
+from typing import Optional
+
+import numpy as np
+
+from .. import protocol as P
+from . import opus
+
+logger = logging.getLogger("selkies_tpu.audio")
+
+
+class SyntheticToneSource:
+    """Endless 440 Hz sine in int16 PCM frames; the audio analog of the
+    synthetic framebuffer source."""
+
+    def __init__(self, sample_rate: int, channels: int, frame_samples: int):
+        self.sample_rate = sample_rate
+        self.channels = channels
+        self.frame_samples = frame_samples
+        self._phase = 0
+
+    async def read_frame(self) -> np.ndarray:
+        t = (np.arange(self.frame_samples) + self._phase) / self.sample_rate
+        self._phase += self.frame_samples
+        tone = (np.sin(2 * np.pi * 440.0 * t) * 8000).astype(np.int16)
+        return np.repeat(tone[:, None], self.channels, axis=1)
+
+    async def close(self) -> None:
+        pass
+
+
+class ParecSource:
+    """PulseAudio capture through a ``parec`` subprocess (in-process PA
+    bindings segfault under churn — the reference hit the same and uses
+    subprocess pactl, media_pipeline.py:718)."""
+
+    def __init__(self, sample_rate: int, channels: int, frame_samples: int,
+                 device: str = ""):
+        self.sample_rate = sample_rate
+        self.channels = channels
+        self.frame_samples = frame_samples
+        self._device = device
+        self._proc: Optional[asyncio.subprocess.Process] = None
+
+    async def _ensure(self) -> None:
+        if self._proc is None or self._proc.returncode is not None:
+            cmd = ["parec", "--format=s16le",
+                   f"--rate={self.sample_rate}",
+                   f"--channels={self.channels}", "--latency-msec=10"]
+            if self._device:
+                cmd += ["-d", self._device]
+            self._proc = await asyncio.create_subprocess_exec(
+                *cmd, stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.DEVNULL)
+
+    async def read_frame(self) -> np.ndarray:
+        await self._ensure()
+        n = self.frame_samples * self.channels * 2
+        data = await self._proc.stdout.readexactly(n)
+        return np.frombuffer(data, np.int16).reshape(
+            self.frame_samples, self.channels)
+
+    async def close(self) -> None:
+        if self._proc and self._proc.returncode is None:
+            self._proc.kill()
+            await self._proc.wait()
+
+
+class AudioPipeline:
+    """One per server process; WS service add/remove_listener()s clients."""
+
+    def __init__(self, settings, source: Optional[object] = None):
+        if not opus.available():
+            raise RuntimeError("libopus unavailable")
+        self.settings = settings
+        self.sample_rate = 48000
+        self.channels = int(settings.audio_channels)
+        self.frame_ms = float(settings.audio_frame_ms)
+        self.frame_samples = int(self.sample_rate * self.frame_ms / 1000)
+        self.red_distance = int(settings.audio_red_distance)
+        self.queue_cap = int(settings.audio_backpressure_queue)
+        self._enc = opus.Encoder(self.sample_rate, self.channels,
+                                 int(settings.audio_bitrate))
+        self._source = source
+        self._task: Optional[asyncio.Task] = None
+        self._listeners: dict[int, tuple[object, asyncio.Queue,
+                                         asyncio.Task]] = {}
+        self._red_history: collections.deque = collections.deque(maxlen=4)
+        self._pts = 0
+        self._mic_proc: Optional[asyncio.subprocess.Process] = None
+        self.mic_bytes = 0
+        self.frames_encoded = 0
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self) -> None:
+        if self._source is None:
+            if shutil.which("parec"):
+                self._source = ParecSource(self.sample_rate, self.channels,
+                                           self.frame_samples)
+            else:
+                logger.info("no PulseAudio; synthetic tone source")
+                self._source = SyntheticToneSource(
+                    self.sample_rate, self.channels, self.frame_samples)
+        self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        for client_id in list(self._listeners):
+            self._remove_by_id(client_id)
+        if self._source is not None:
+            await self._source.close()
+        if self._mic_proc and self._mic_proc.returncode is None:
+            self._mic_proc.kill()
+
+    # ------------------------------------------------------------- listeners
+    def add_listener(self, client) -> None:
+        if client.id in self._listeners:
+            return
+        q: asyncio.Queue = asyncio.Queue(maxsize=self.queue_cap)
+
+        async def sender():
+            try:
+                while True:
+                    frame = await q.get()
+                    await asyncio.wait_for(client.ws.send_bytes(frame), 2.0)
+            except (asyncio.CancelledError, asyncio.TimeoutError,
+                    ConnectionError, RuntimeError):
+                pass
+
+        task = asyncio.create_task(sender())
+        self._listeners[client.id] = (client, q, task)
+
+    def remove_listener(self, client) -> None:
+        self._remove_by_id(client.id)
+
+    def _remove_by_id(self, client_id: int) -> None:
+        entry = self._listeners.pop(client_id, None)
+        if entry:
+            entry[2].cancel()
+
+    # ---------------------------------------------------------------- encode
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self._run_inner()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the audio task must never die silently (every client
+                # loses audio until restart); log and resume
+                logger.exception("audio pipeline error; restarting loop")
+                await asyncio.sleep(1.0)
+
+    async def _run_inner(self) -> None:
+        period = self.frame_ms / 1000.0
+        synthetic = isinstance(self._source, SyntheticToneSource)
+        next_t = time.monotonic()
+        while True:
+            try:
+                pcm = await self._source.read_frame()
+            except (asyncio.IncompleteReadError, OSError) as e:
+                logger.warning("audio source died (%s); retrying", e)
+                await asyncio.sleep(1.0)
+                continue
+            packet = self._enc.encode(pcm)
+            self.frames_encoded += 1
+            pts_step = int(self.frame_ms * 90)      # 90 kHz clock
+            # RED block lengths are 10-bit (RFC 2198): high-bitrate or
+            # long-frame packets that can't fit ship plain — degrading
+            # redundancy must never kill the capture task
+            red = [b for b in list(self._red_history)[-self.red_distance:]
+                   if len(b) < 1 << 10] if self.red_distance > 0 else []
+            if red and len(packet) < 1 << 10:
+                payload = P.pack_red_payload(
+                    self._pts, packet,
+                    [(max(1, (len(red) - i) * pts_step), blk)
+                     for i, blk in enumerate(red)])
+                frame = P.pack_audio(payload, n_red=len(red))
+            else:
+                frame = P.pack_audio(packet, n_red=0)
+            self._red_history.append(packet)
+            self._pts = (self._pts + pts_step) & 0xFFFFFFFF
+            for _, q, _t in list(self._listeners.values()):
+                if q.full():                   # drop-oldest, never block
+                    try:
+                        q.get_nowait()
+                    except asyncio.QueueEmpty:
+                        pass
+                q.put_nowait(frame)
+            if synthetic:                      # real sources pace themselves
+                next_t += period
+                delay = next_t - time.monotonic()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                else:
+                    next_t = time.monotonic()
+
+    # --------------------------------------------------------------- control
+    def update_bitrate(self, bps: int) -> None:
+        bps = int(np.clip(bps, 6000, 510000))
+        self._enc.set_bitrate(bps)
+
+    # -------------------------------------------------------------- mic path
+    def play_mic_pcm(self, pcm: bytes) -> None:
+        """Client 0x02 mic chunks: 24 kHz mono s16 (reference
+        selkies.py:2476-2502) -> PulseAudio when present."""
+        self.mic_bytes += len(pcm)
+        if self._mic_proc is None and shutil.which("pacat"):
+            async def _spawn():
+                self._mic_proc = await asyncio.create_subprocess_exec(
+                    "pacat", "--format=s16le", "--rate=24000",
+                    "--channels=1",
+                    stdin=asyncio.subprocess.PIPE,
+                    stderr=asyncio.subprocess.DEVNULL)
+            asyncio.ensure_future(_spawn())
+        if self._mic_proc and self._mic_proc.returncode is None \
+                and self._mic_proc.stdin:
+            try:
+                self._mic_proc.stdin.write(pcm)
+            except (ConnectionError, RuntimeError):
+                pass

@@ -94,7 +94,25 @@ def write_sps(width: int, height: int, level_idc: int = 42) -> bytes:
         w.ue(0); w.ue(crop_r // 2); w.ue(0); w.ue(crop_b // 2)
     else:
         w.put(1, 0)
-    w.put(1, 0)           # vui_parameters_present
+    # VUI: the encoder feeds FULL-RANGE BT.601 YCbCr (rgb_to_yuv420);
+    # without signalling it, WebCodecs assumes limited-range BT.709 and
+    # every frame renders with crushed contrast and a hue shift.
+    w.put(1, 1)           # vui_parameters_present
+    w.put(1, 0)           # aspect_ratio_info_present
+    w.put(1, 0)           # overscan_info_present
+    w.put(1, 1)           # video_signal_type_present
+    w.put(3, 5)           # video_format: unspecified
+    w.put(1, 1)           # video_full_range_flag = 1
+    w.put(1, 1)           # colour_description_present
+    w.put(8, 6)           # colour_primaries: SMPTE 170M (BT.601)
+    w.put(8, 6)           # transfer_characteristics: SMPTE 170M
+    w.put(8, 6)           # matrix_coefficients: SMPTE 170M (BT.601)
+    w.put(1, 0)           # chroma_loc_info_present
+    w.put(1, 0)           # timing_info_present
+    w.put(1, 0)           # nal_hrd_parameters_present
+    w.put(1, 0)           # vcl_hrd_parameters_present
+    w.put(1, 0)           # pic_struct_present
+    w.put(1, 0)           # bitstream_restriction
     w.rbsp_trailing()
     return nal(7, w.to_bytes())
 
@@ -120,13 +138,19 @@ def write_pps() -> bytes:
     return nal(8, w.to_bytes())
 
 
-def slice_header_bits(w: BitWriter, first_mb: int, qp: int,
-                      idr_pic_id: int = 0) -> None:
-    """IDR I-slice header matching write_sps/write_pps choices."""
+def slice_header_prefix_bits(w: BitWriter, first_mb: int) -> None:
+    """IDR I-slice header up to (excluding) idr_pic_id — the part that
+    depends only on geometry; the device emits the rest as events."""
     w.ue(first_mb)
     w.ue(7)               # slice_type I (all slices)
     w.ue(0)               # pps_id
     w.put(4, 0)           # frame_num (log2_max_frame_num = 4), IDR -> 0
+
+
+def slice_header_bits(w: BitWriter, first_mb: int, qp: int,
+                      idr_pic_id: int = 0) -> None:
+    """Full IDR I-slice header matching write_sps/write_pps choices."""
+    slice_header_prefix_bits(w, first_mb)
     w.ue(idr_pic_id)
     # poc type 2: nothing
     w.put(1, 0)           # no_output_of_prior_pics
@@ -167,6 +191,10 @@ def _quant4(wm, qp, dc_shift=0):
     # ops/h264_transform.quant_dc bit-for-bit (device/golden contract)
     f = 2 * ((1 << (15 + qp // 6)) // 3) if dc_shift else ((1 << qbits) // 3)
     mag = (np.abs(wm) * mf + f) >> qbits
+    # clamp mirrors the device encoder (ops/h264_encode.LEVEL_CLAMP): keeps
+    # level_code inside the prefix-15 escape and rescaled coefficients
+    # inside the +-2^15 conformance bound
+    mag = np.minimum(mag, 2000)
     return np.where(wm < 0, -mag, mag).astype(np.int64)
 
 
@@ -499,3 +527,32 @@ def encode_i16_frame(y: np.ndarray, u: np.ndarray, v: np.ndarray,
     """Convenience: headers + one IDR frame."""
     enc = I16Encoder(y.shape[1], y.shape[0], qp)
     return enc.headers() + enc.encode_frame(y, u, v)
+
+
+def slice_header_events(mb_w: int, n_rows: int):
+    """Per-row slice-header PREFIX bits as two (payload, nbits) device
+    events — everything up to but excluding idr_pic_id (the idr/qp/deblock
+    tail is emitted as device events, so neither per-row qp nor per-stripe
+    IDR ids ever need a host round-trip). Built through the SAME
+    slice_header_prefix_bits the golden encoder uses — one source of
+    truth, zero drift."""
+    pay = np.zeros((n_rows, 2), np.uint32)
+    nb = np.zeros((n_rows, 2), np.int32)
+    for r in range(n_rows):
+        w = BitWriter()
+        slice_header_prefix_bits(w, r * mb_w)
+        bits = w.bits
+        assert len(bits) <= 62, "slice header prefix exceeds two events"
+        for slot, chunk in enumerate((bits[:31], bits[31:])):
+            if chunk:
+                val = 0
+                for b in chunk:
+                    val = (val << 1) | b
+                pay[r, slot] = val
+                nb[r, slot] = len(chunk)
+    return pay, nb
+
+
+def assemble_annexb(row_rbsp: list[bytes]) -> bytes:
+    """Per-row slice RBSPs -> Annex-B (start codes + emulation prevention)."""
+    return b"".join(nal(5, rb) for rb in row_rbsp)

@@ -75,7 +75,11 @@ class ScreenCapture:
                 self.stop_capture()
             self._callback = callback
             self._settings = settings
-            self._session = JpegEncoderSession(settings)
+            if settings.output_mode == "h264":
+                from .h264_encoder import H264EncoderSession
+                self._session = H264EncoderSession(settings)
+            else:
+                self._session = JpegEncoderSession(settings)
             self._source = make_source(self._source_kind,
                                        settings.capture_width,
                                        settings.capture_height,
@@ -155,12 +159,25 @@ class ScreenCapture:
                                          self._settings.paint_over_quality)
 
     def _rate_control(self, window_bytes: int, window_s: float) -> None:
-        """Crude CBR steering for the JPEG path: nudge quality toward the
-        bitrate target (the H.264 path gets true QP rate control)."""
+        """CBR steering: JPEG nudges quality, H.264 nudges QP directly
+        (qp travels in the slice header, so changes are free — no restart,
+        no recompile, applied on the next frame's device step)."""
         s, sess = self._settings, self._session
         if s is None or sess is None or not s.use_cbr or window_s <= 0:
             return
         actual_kbps = window_bytes * 8 / 1000 / window_s
+        if s.output_mode == "h264":
+            qp = sess.qp
+            if actual_kbps > s.video_bitrate_kbps * 1.15 \
+                    and qp < s.video_max_qp:
+                # only ever RAISE qp here — when qp already sits above the
+                # ceiling (user picked a high crf), clamping down would
+                # increase bitrate and amplify the overshoot
+                sess.set_qp(min(qp + 2, s.video_max_qp))
+            elif actual_kbps < s.video_bitrate_kbps * 0.7 \
+                    and qp > s.video_min_qp:
+                sess.set_qp(max(qp - 1, s.video_min_qp))
+            return
         q = s.jpeg_quality
         if actual_kbps > s.video_bitrate_kbps * 1.15 and q > 10:
             sess.update_quality(max(10, q - 5), s.paint_over_quality)
@@ -186,9 +203,10 @@ class ScreenCapture:
                 frame = src.get_frame(tick)
                 if pad is not None:
                     frame = pad(frame)
-                out = sess.encode(frame)
                 # periodic full refresh (keyframe_interval_s) on top of
-                # client-requested IDRs; <=0 disables the cadence
+                # client-requested IDRs; <=0 disables the cadence. Decided
+                # BEFORE encode: the h264 session's on-device idr parity
+                # must count forced sends.
                 force = self._force_idr.is_set()
                 if s.keyframe_interval_s > 0 \
                         and t0 - last_full >= s.keyframe_interval_s:
@@ -196,6 +214,7 @@ class ScreenCapture:
                 if force:
                     last_full = t0
                     self._force_idr.clear()
+                out = sess.encode(frame, force=force)
                 out["force"] = force
                 inflight.append(out)
                 if len(inflight) > PIPELINE_DEPTH:

@@ -148,6 +148,8 @@ class JpegEncoderSession:
         # set after a dropped (overflowed) frame: the client never saw it, so
         # damage diffs against it would leave stale stripes on glass forever.
         self._force_after_drop = False
+        self._cap_gen = 0   # growth generation: pipelined frames encoded
+        #                     with stale caps must not re-grow/re-jit
         self.update_quality(settings.jpeg_quality, settings.paint_over_quality)
 
     def _build_step(self):
@@ -178,9 +180,14 @@ class JpegEncoderSession:
         self._qc_p = jnp.asarray(self._qc_p_np, jnp.float32)
 
     # -- device step --------------------------------------------------------
-    def encode(self, frame: jnp.ndarray) -> dict[str, Any]:
+    def encode(self, frame: jnp.ndarray, force: bool = False
+               ) -> dict[str, Any]:
         """Dispatch one encode step (non-blocking). ``frame`` must be a
-        device array of shape (grid.height, grid.width, 3) uint8."""
+        device array of shape (grid.height, grid.width, 3) uint8.
+        ``force`` is a finalize-time decision for JPEG (all stripes are
+        always in the buffer); accepted here for session-interface parity
+        with the H.264 session."""
+        del force
         data, lens, send, is_paint, age, overflow = self._step(
             frame, self._prev, self._age,
             self._qy_m, self._qc_m, self._qy_p, self._qc_p)
@@ -200,6 +207,7 @@ class JpegEncoderSession:
         # actually quantized with.
         return {"data": data, "lens": lens, "send": send,
                 "is_paint": is_paint, "overflow": overflow, "frame_id": fid,
+                "cap_gen": self._cap_gen,
                 "qtabs": (self._qy_m_np, self._qc_m_np,
                           self._qy_p_np, self._qc_p_np)}
 
@@ -217,17 +225,20 @@ class JpegEncoderSession:
         """Blocks on the async readback and produces wire-ready chunks."""
         g = self.grid
         if bool(np.asarray(out["overflow"])):
-            logger.warning("encoder overflow at frame %d; raising capacity",
-                           out["frame_id"])
             # Event overflow is impossible (e_cap is worst-case), so this is
             # a word/output buffer overflow: drop the frame, double the
-            # growable buffers, recompile once. The client never saw this
-            # frame, but _prev already advanced past it — force the next
-            # delivered frame to resend every stripe so damage gating can't
-            # freeze stale content on glass.
-            self._w_cap *= 2
-            self._out_cap *= 2
-            self._step = self._build_step()
+            # growable buffers, recompile ONCE per episode (pipelined frames
+            # encoded with the stale caps also overflow but must not
+            # re-double). The client never saw this frame, but _prev already
+            # advanced past it — force the next delivered frame to resend
+            # every stripe so damage gating can't freeze stale content.
+            if out.get("cap_gen", self._cap_gen) == self._cap_gen:
+                logger.warning("encoder overflow at frame %d; raising "
+                               "capacity", out["frame_id"])
+                self._w_cap *= 2
+                self._out_cap *= 2
+                self._cap_gen += 1
+                self._step = self._build_step()
             self._force_after_drop = True
             return []
         if self._force_after_drop:

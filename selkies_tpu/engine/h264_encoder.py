@@ -1,0 +1,242 @@
+"""H.264 stripe-encoder session: the ``--encoder=h264-tpu`` device path.
+
+Mirrors :class:`~selkies_tpu.engine.encoder.JpegEncoderSession`'s contract
+(encode/finalize split, damage gating + paint-over state on device, one
+output buffer per frame) with the H.264 Intra_16x16 pipeline of
+ops/h264_encode.py underneath:
+
+- every wire stripe is an INDEPENDENT H.264 stream (reference
+  ``h264enc-striped``: per-stripe decoders client-side, SURVEY.md §2.5)
+  of ``stripe_h`` rows; each MB row inside a stripe is one slice;
+- damage gating: unchanged stripes are skipped; paint-over re-sends a
+  settled stripe once at ``paint_over_qp`` — the per-row qp select runs
+  ON DEVICE, so neither rate control nor paint-over ever syncs the host;
+- every sent stripe is an IDR access unit (SPS+PPS+slices): chain gating
+  degenerates to "always safe", and a lost stripe recovers on the next
+  damage or keyframe_interval refresh.
+
+Only the byte buffer + lengths + flags leave the chip (bitrate-sized).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..codecs import h264 as hcodec
+from ..ops.h264_encode import SLOTS_MB, h264_encode_yuv, rgb_to_yuv420
+from ..ops.stripes import concat_stripe_bytes, words_to_bytes_device
+from .types import CaptureSettings, EncodedChunk
+
+logger = logging.getLogger("selkies_tpu.engine.h264")
+
+
+def _round_up(v: int, m: int) -> int:
+    return (v + m - 1) // m * m
+
+
+@dataclasses.dataclass
+class _Grid:
+    width: int
+    height: int
+    stripe_h: int
+    n_stripes: int
+    rows_per_stripe: int
+    mb_w: int
+    out_w: int
+    out_h: int
+
+
+def plan_h264_grid(s: CaptureSettings) -> _Grid:
+    if s.single_stream:
+        # one stream per display, derived from the CURRENT height so the
+        # rule survives live-resize session rebuilds
+        stripe_h = _round_up(max(16, s.capture_height), 16)
+    else:
+        stripe_h = max(16, _round_up(s.stripe_height, 16))
+    w = _round_up(s.capture_width, 16)
+    h = _round_up(s.capture_height, stripe_h)
+    return _Grid(width=w, height=h, stripe_h=stripe_h,
+                 n_stripes=h // stripe_h, rows_per_stripe=stripe_h // 16,
+                 mb_w=w // 16, out_w=s.capture_width, out_h=s.capture_height)
+
+
+@functools.cache
+def _jitted_h264_step(width: int, stripe_h: int, n_stripes: int,
+                      e_cap: int, w_cap: int, out_cap: int,
+                      paint_delay: int, damage_gating: bool,
+                      paint_over: bool):
+    """step(frame u8 (H,W,3), prev u8, age i32 (S,), qp_motion i32,
+    qp_paint i32, hdr_pay u32 (R,2), hdr_nb i32 (R,2))
+    -> (data u8 (out_cap,), row_lens i32 (R,), send bool (S,),
+        is_paint bool (S,), age i32 (S,), overflow bool)"""
+    rows_per_stripe = stripe_h // 16
+
+    def step(frame, prev, age, sent, qp_motion, qp_paint, force,
+             hdr_pay, hdr_nb):
+        s = n_stripes
+        stripes = frame.reshape(s, stripe_h, width, 3)
+        if damage_gating:
+            prev_s = prev.reshape(s, stripe_h, width, 3)
+            damage = jnp.any(stripes != prev_s, axis=(1, 2, 3))
+        else:
+            damage = jnp.ones((s,), bool)
+        age = jnp.where(damage, 0, age + 1)
+        if paint_over and paint_delay > 0:
+            is_paint = age == paint_delay
+        else:
+            is_paint = jnp.zeros((s,), bool)
+        send = damage | is_paint | force
+        qp_stripe = jnp.where(is_paint, qp_paint, qp_motion)
+        qp_rows = jnp.repeat(qp_stripe, rows_per_stripe)
+        # consecutive IDRs of one stripe stream must differ in idr_pic_id
+        # (§7.4.3); the per-stripe sent counter lives ON DEVICE so damage
+        # gating and pipelining can't desynchronise it. A 4-bit cycle (not
+        # parity) keeps the invariant even across overflow-dropped frames,
+        # which consume counter values the client never sees — a collision
+        # would need exactly 15 consecutively dropped sends.
+        idr_rows = jnp.repeat(sent & 0xF, rows_per_stripe)
+        sent = sent + send.astype(jnp.int32)
+
+        yf, uf, vf = rgb_to_yuv420(frame)
+        out = h264_encode_yuv(yf, uf, vf, qp_rows, hdr_pay, hdr_nb,
+                              e_cap, w_cap, idr_pic_id=idr_rows)
+        sbytes, row_lens = words_to_bytes_device(out.words, out.total_bits,
+                                                 pad_ones=False)
+        buf = concat_stripe_bytes(sbytes, row_lens, out_cap)
+        overflow = out.overflow | buf.overflow
+        return (buf.data, buf.byte_lens, send, is_paint, age, sent, overflow)
+
+    return jax.jit(step, donate_argnums=(2, 3))
+
+
+class H264EncoderSession:
+    """Per-display H.264 encoder session (same lifecycle contract as
+    JpegEncoderSession)."""
+
+    def __init__(self, settings: CaptureSettings):
+        self.settings = settings
+        self.grid = plan_h264_grid(settings)
+        g = self.grid
+        self.n_rows = g.n_stripes * g.rows_per_stripe
+        self._e_cap = 7 + g.mb_w * SLOTS_MB + 1
+        # bits/row worst case for desktop content; growable on overflow.
+        # _w_cap is in 32-bit WORDS; _out_cap is the BYTE capacity of the
+        # whole-frame concat buffer (4 bytes per word).
+        self._w_cap = max(2048, g.mb_w * 768 // 4)
+        self._out_cap = max(256 * 1024, self.n_rows * self._w_cap * 4)
+        self._step = self._build_step()
+        self.frame_id = 0
+        self._age = jnp.zeros((g.n_stripes,), jnp.int32)
+        self._sent = jnp.zeros((g.n_stripes,), jnp.int32)
+        self._prev = jnp.zeros((g.height, g.width, 3), jnp.uint8)
+        self._force_after_drop = False
+        self._cap_gen = 0   # buffer-growth generation (pipelined frames
+        #                     encoded with stale caps must not re-grow)
+        # per-stripe stream headers (cached; identical for every stripe)
+        self._sps_pps = hcodec.write_sps(g.width, g.stripe_h) \
+            + hcodec.write_pps()
+        # slice-header prefixes (idr_pic_id/qp are device events);
+        # every stripe restarts first_mb at 0
+        pay, nb = hcodec.slice_header_events(g.mb_w, g.rows_per_stripe)
+        self._hdr_pay = jnp.asarray(np.tile(pay, (g.n_stripes, 1)))
+        self._hdr_nb = jnp.asarray(np.tile(nb, (g.n_stripes, 1)))
+        self.qp = int(np.clip(settings.video_crf, 8, 48))
+        self.paint_qp = int(np.clip(
+            settings.video_min_qp, 8, self.qp))
+
+    def _build_step(self):
+        g, s = self.grid, self.settings
+        return _jitted_h264_step(g.width, g.stripe_h, g.n_stripes,
+                                 self._e_cap, self._w_cap, self._out_cap,
+                                 s.paint_over_delay_frames,
+                                 s.use_damage_gating, s.use_paint_over)
+
+    @property
+    def visible_size(self) -> tuple[int, int]:
+        return self.grid.out_w, self.grid.out_h
+
+    # -- live tunables ------------------------------------------------------
+    def update_quality(self, motion_q: int, paint_q: int | None = None):
+        """JPEG-session-compatible knob: quality 1-100 maps inversely onto
+        qp 48-8."""
+        self.qp = int(np.clip(48 - (motion_q * 40) // 100, 8, 48))
+        if paint_q is not None:
+            self.paint_qp = int(np.clip(48 - (paint_q * 40) // 100, 8, 48))
+
+    def set_qp(self, qp: int, paint_qp: int | None = None):
+        self.qp = int(np.clip(qp, 8, 48))
+        if paint_qp is not None:
+            self.paint_qp = int(np.clip(paint_qp, 8, 48))
+
+    # -- device step --------------------------------------------------------
+    def encode(self, frame: jnp.ndarray, force: bool = False
+               ) -> dict[str, Any]:
+        """``force`` resends every stripe; it must be decided HERE (not at
+        finalize) so the on-device idr_pic_id parity counts it."""
+        if self._force_after_drop:
+            self._force_after_drop = False
+            force = True
+        data, row_lens, send, is_paint, age, sent, overflow = self._step(
+            frame, self._prev, self._age, self._sent,
+            jnp.int32(self.qp), jnp.int32(self.paint_qp),
+            jnp.asarray(bool(force)), self._hdr_pay, self._hdr_nb)
+        self._prev = frame
+        self._age = age
+        self._sent = sent
+        fid = self.frame_id
+        self.frame_id = (self.frame_id + 1) & 0xFFFF
+        for arr in (data, row_lens, send, is_paint, overflow):
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass
+        return {"data": data, "lens": row_lens, "send": send,
+                "is_paint": is_paint, "overflow": overflow, "frame_id": fid,
+                "cap_gen": self._cap_gen}
+
+    # -- host tail ----------------------------------------------------------
+    def finalize(self, out: dict[str, Any], force_all: bool = False
+                 ) -> list[EncodedChunk]:
+        """``force_all`` is ignored — forced refreshes are an encode()-time
+        decision for this codec (idr parity lives on device)."""
+        del force_all
+        g = self.grid
+        if bool(np.asarray(out["overflow"])):
+            # grow once per episode: pipelined frames encoded with the old
+            # caps also report overflow but must not re-double/re-jit
+            if out["cap_gen"] == self._cap_gen:
+                logger.warning("h264 overflow at frame %d; growing buffers",
+                               out["frame_id"])
+                self._w_cap *= 2
+                self._out_cap *= 2
+                self._cap_gen += 1
+                self._step = self._build_step()
+            self._force_after_drop = True
+            return []
+        data = np.asarray(out["data"])
+        lens = np.asarray(out["lens"])            # (R,) per MB row
+        send = np.asarray(out["send"])
+        starts = np.concatenate([[0], np.cumsum(lens)])
+        chunks: list[EncodedChunk] = []
+        rps = g.rows_per_stripe
+        for i in range(g.n_stripes):
+            if not send[i]:
+                continue
+            rows = []
+            for r in range(i * rps, (i + 1) * rps):
+                rows.append(bytes(data[starts[r]:starts[r] + lens[r]]))
+            payload = self._sps_pps + hcodec.assemble_annexb(rows)
+            chunks.append(EncodedChunk(
+                payload=payload, frame_id=out["frame_id"],
+                stripe_y=i * g.stripe_h, width=g.width, height=g.stripe_h,
+                is_idr=True, output_mode="h264",
+                seat_index=self.settings.seat_index,
+                display_id=self.settings.display_id))
+        return chunks

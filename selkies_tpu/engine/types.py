@@ -39,6 +39,10 @@ class CaptureSettings:
     paint_over_delay_frames: int = 15
     # striping (reference striped encoding, SURVEY.md §2.5)
     stripe_height: int = 64
+    # h264-tpu (non-striped): one stream spanning the whole display;
+    # the grid planner derives stripe_height from the CURRENT height so
+    # live resizes keep the one-stream contract
+    single_stream: bool = False
     # device placement
     seat_index: int = 0
     display_id: str = ":0"

@@ -168,6 +168,7 @@ class WebSocketsService(BaseStreamingService):
         w, h = self.display_geometry.get(
             display_id, (s.initial_width, s.initial_height))
         return CaptureSettings(
+            single_stream=(s.encoder == "h264-tpu"),
             capture_width=w, capture_height=h,
             target_fps=float(s.framerate),
             output_mode="jpeg" if s.encoder.startswith("jpeg") else "h264",

@@ -103,8 +103,10 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
 
     # --- video --------------------------------------------------------------
     _s("encoder", SType.ENUM, "jpeg-tpu",
-       "Video encoder backend. *-tpu run DCT/quant as Pallas kernels.",
-       choices=("jpeg-tpu", "h264-tpu", "h264-tpu-striped", "jpeg-cpu"),
+       "Video encoder backend; all transforms + entropy coding run on the "
+       "TPU. h264-tpu = one stream per display; h264-tpu-striped = one "
+       "independent stream per stripe row (reference h264enc-striped).",
+       choices=("jpeg-tpu", "h264-tpu", "h264-tpu-striped"),
        client=True),
     _s("framerate", SType.INT, 60, "Target capture/encode fps.", vmin=8, vmax=240,
        client=True),

@@ -173,13 +173,54 @@ class SelkiesClient {
     }
   }
 
-  _onH264Stripe(_buf) {
-    // H.264 stripes decode via WebCodecs VideoDecoder per stripe row
-    // (reference selkies-ws-core.js:4424-4460); lands with the h264 engine.
-    if (!this._h264warned) {
-      this._h264warned = true;
-      console.warn("h264 stripes not yet handled by this client build");
+  /* 10-byte header: [0x04, frame_type, u16 fid, u16 y, u16 w, u16 h] +
+   * Annex-B. Every stripe row is an independent H.264 stream with its own
+   * decoder keyed by y_start (reference selkies-ws-core.js:4424-4460). */
+  _onH264Stripe(buf) {
+    if (typeof VideoDecoder === "undefined") {
+      if (!this._h264warned) {
+        this._h264warned = true;
+        this.status("WebCodecs H.264 unsupported in this browser", true);
+      }
+      return;
     }
+    const dv = new DataView(buf.buffer, buf.byteOffset, 10);
+    const fid = dv.getUint16(2), y = dv.getUint16(4);
+    if (!this.h264Decoders) this.h264Decoders = new Map();
+    let dec = this.h264Decoders.get(y);
+    if (!dec || dec.state === "closed") {
+      const yTop = y;
+      dec = new VideoDecoder({
+        output: (frame) => {
+          this.ctx.drawImage(frame, 0, yTop);
+          this.stripesDrawn++;
+          this._ackFrame(frame.timestamp & 0xFFFF);
+          frame.close();
+        },
+        error: (e) => {
+          console.warn("h264 stripe decoder error", e);
+          this.h264Decoders.delete(yTop);
+          this._requestKeyframeThrottled();
+        },
+      });
+      // Annex-B stream (no description): constrained baseline
+      dec.configure({ codec: "avc1.42c02a", optimizeForLatency: true });
+      this.h264Decoders.set(y, dec);
+    }
+    if (dec.decodeQueueSize > 16) {
+      // overload: drop the stripe, but ask for a refresh — the server's
+      // damage gating believes it was delivered and would otherwise leave
+      // this region stale until the next change. THROTTLED: an unthrottled
+      // request per dropped stripe re-forces full-frame IDRs every frame
+      // and locks the overloaded client into a feedback loop.
+      this._requestKeyframeThrottled();
+      return;
+    }
+    dec.decode(new EncodedVideoChunk({
+      type: "key",                         // every stripe is an IDR AU
+      timestamp: fid,
+      data: buf.subarray(10),
+    }));
   }
 
   _ackFrame(fid) {
@@ -187,6 +228,14 @@ class SelkiesClient {
       this.lastAckFid = fid;
       this.framesDrawn++;
       this.send(`CLIENT_FRAME_ACK ${fid}`);
+    }
+  }
+
+  _requestKeyframeThrottled() {
+    const now = performance.now();
+    if (!this._lastKfReq || now - this._lastKfReq > 1000) {
+      this._lastKfReq = now;
+      this.send("REQUEST_KEYFRAME");
     }
   }
 
@@ -224,6 +273,12 @@ class SelkiesClient {
       this.displayW = d.width; this.displayH = d.height;
       this.canvas.width = d.width; this.canvas.height = d.height;
       this.stripeLastFid.clear();
+      if (this.h264Decoders) {   // stripe geometry changed: fresh decoders
+        for (const dec of this.h264Decoders.values()) {
+          try { dec.close(); } catch { /* already closed */ }
+        }
+        this.h264Decoders.clear();
+      }
       this.send("REQUEST_KEYFRAME");
     }
     document.title = `${payload.app_name || "Selkies TPU"} — ${d.width}x${d.height}`;

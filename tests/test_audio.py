@@ -1,0 +1,147 @@
+"""Audio pipeline tests: Opus roundtrip, 0x01/RED framing, listener
+backpressure, bitrate control. All against real libopus via ctypes."""
+
+import asyncio
+import struct
+
+import numpy as np
+import pytest
+
+from selkies_tpu import protocol as P
+from selkies_tpu.audio import opus
+from selkies_tpu.audio.pipeline import AudioPipeline, SyntheticToneSource
+from selkies_tpu.settings import AppSettings
+
+pytestmark = pytest.mark.skipif(not opus.available(),
+                                reason="libopus not present")
+
+
+def test_opus_encode_decode_roundtrip():
+    enc = opus.Encoder(48000, 2, 96000)
+    dec = opus.Decoder(48000, 2)
+    t = np.arange(480) / 48000.0
+    tone = (np.sin(2 * np.pi * 440 * t) * 8000).astype(np.int16)
+    pcm = np.repeat(tone[:, None], 2, axis=1)
+    for _ in range(8):            # let the codec converge
+        pkt = enc.encode(pcm)
+    out = dec.decode(pkt)
+    for _ in range(4):
+        out = dec.decode(pkt)
+    assert out.shape == (480, 2)
+    # decoded energy is in the right ballpark of the source tone
+    assert 1000 < np.abs(out.astype(np.int64)).mean() < 12000
+
+
+class FakeWs:
+    def __init__(self):
+        self.sent = []
+
+    async def send_bytes(self, b):
+        self.sent.append(bytes(b))
+
+
+class FakeClient:
+    _n = 1000
+
+    def __init__(self):
+        FakeClient._n += 1
+        self.id = FakeClient._n
+        self.ws = FakeWs()
+
+
+def _settings(**kw):
+    s = AppSettings.parse([], {})
+    for k, v in kw.items():
+        s.set_server(k, v)
+    return s
+
+
+async def _pump(pipe, client, n_frames=6, timeout=5.0):
+    await pipe.start()
+    pipe.add_listener(client)
+    deadline = asyncio.get_event_loop().time() + timeout
+    while len(client.ws.sent) < n_frames \
+            and asyncio.get_event_loop().time() < deadline:
+        await asyncio.sleep(0.02)
+    await pipe.stop()
+
+
+def test_pipeline_delivers_decodable_opus():
+    s = _settings(audio_red_distance=0)
+    pipe = AudioPipeline(s, source=SyntheticToneSource(48000, 2, 480))
+    client = FakeClient()
+    asyncio.run(_pump(pipe, client))
+    assert len(client.ws.sent) >= 6
+    dec = opus.Decoder(48000, 2)
+    for frame in client.ws.sent[:6]:
+        assert frame[0] == P.OP_AUDIO and frame[1] == 0
+        out = dec.decode(frame[2:])
+        assert out.shape[0] == 480
+
+
+def test_pipeline_red_framing_parses():
+    s = _settings(audio_red_distance=2)
+    pipe = AudioPipeline(s, source=SyntheticToneSource(48000, 2, 480))
+    client = FakeClient()
+    asyncio.run(_pump(pipe, client, n_frames=8))
+    framed = [f for f in client.ws.sent if f[1] > 0]
+    assert framed, "RED frames expected after history warms up"
+    f = framed[-1]
+    n_red = f[1]
+    body = f[2:]
+    (pts,) = struct.unpack(">I", body[:4])
+    # block headers: F=1 + PT + 14-bit offset + 10-bit length
+    sizes = []
+    off = 4
+    for _ in range(n_red):
+        (word,) = struct.unpack(">I", body[off:off + 4])
+        assert word >> 31 == 1
+        sizes.append(word & 0x3FF)
+        off += 4
+    assert body[off] == 111          # primary header, F=0
+    off += 1
+    blocks_end = off + sum(sizes)
+    primary = body[blocks_end:]
+    dec = opus.Decoder(48000, 2)
+    assert dec.decode(primary).shape[0] == 480
+    # redundant blocks decode too
+    dec2 = opus.Decoder(48000, 2)
+    assert dec2.decode(bytes(body[off:off + sizes[0]])).shape[0] == 480
+
+
+def test_listener_queue_drops_oldest_never_blocks():
+    s = _settings(audio_backpressure_queue=4, audio_red_distance=0)
+    pipe = AudioPipeline(s, source=SyntheticToneSource(48000, 2, 480))
+
+    class StalledWs:
+        def __init__(self):
+            self.sent = []
+
+        async def send_bytes(self, b):
+            await asyncio.sleep(3600)     # never completes
+
+    client = FakeClient()
+    client.ws = StalledWs()
+
+    async def run():
+        await pipe.start()
+        pipe.add_listener(client)
+        await asyncio.sleep(0.3)          # ~30 frames at 10 ms
+        q = pipe._listeners[client.id][1]
+        assert q.qsize() <= 4             # bounded despite the stall
+        assert pipe.frames_encoded > 10   # capture never paused
+        await pipe.stop()
+
+    asyncio.run(run())
+
+
+def test_update_bitrate_changes_packet_size():
+    enc = opus.Encoder(48000, 2, 320000, lowdelay=False)
+    rng = np.random.default_rng(0)
+    pcm = rng.integers(-20000, 20000, (480, 2), dtype=np.int16)
+    for _ in range(8):
+        big = len(enc.encode(pcm))
+    enc.set_bitrate(16000)
+    for _ in range(8):
+        small = len(enc.encode(pcm))
+    assert small < big

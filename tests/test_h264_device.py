@@ -1,0 +1,96 @@
+"""Device (jax) H.264 encoder vs the numpy golden encoder: the bitstreams
+must be BIT-IDENTICAL, and the assembled Annex-B must decode in the
+independent oracles."""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.codecs import h264 as H
+from selkies_tpu.codecs import h264_ref_decoder as refdec
+from selkies_tpu.native import avshim
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from selkies_tpu.ops.bitpack import words_to_bytes  # noqa: E402
+from selkies_tpu.ops.h264_encode import (SLOTS_MB,  # noqa: E402
+                                         h264_encode_yuv)
+
+
+def _device_rows(y, u, v, qp):
+    """Run the device encoder; return per-row RBSP bytes."""
+    R = y.shape[0] // 16
+    M = y.shape[1] // 16
+    pay, nb = H.slice_header_events(M, R)
+    slots = 7 + M * SLOTS_MB + 1
+    e_cap = slots
+    w_cap = max(4096, (M * 16 * 16 * 4) // 4)   # generous bits/row
+    out = h264_encode_yuv(jnp.asarray(y), jnp.asarray(u), jnp.asarray(v),
+                          qp, jnp.asarray(pay), jnp.asarray(nb),
+                          e_cap, w_cap)
+    assert not bool(np.asarray(out.overflow))
+    words = np.asarray(out.words)
+    bits = np.asarray(out.total_bits)
+    return [words_to_bytes(words[r], int(bits[r]), pad_ones=False)
+            for r in range(R)]
+
+
+def _host_rows(y, u, v, qp):
+    """Golden encoder per-row slice RBSPs (strip NAL wrapper)."""
+    enc = H.I16Encoder(y.shape[1], y.shape[0], qp)
+    frame = enc.encode_frame(y, u, v)
+    rows = []
+    for part in frame.split(b"\x00\x00\x00\x01")[1:]:
+        rows.append(refdec.remove_emulation_prevention(part[1:]))
+    return rows, enc
+
+
+@pytest.mark.parametrize("qp", [16, 26, 38])
+def test_device_bitstream_matches_golden(qp):
+    rng = np.random.default_rng(qp)
+    h, w = 48, 64
+    y = rng.integers(0, 256, (h, w), dtype=np.uint8)
+    u = rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8)
+    v = rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8)
+    dev = _device_rows(y, u, v, qp)
+    host, _ = _host_rows(y, u, v, qp)
+    assert len(dev) == len(host) == 3
+    for r, (d, g) in enumerate(zip(dev, host)):
+        assert d == g, (
+            f"row {r}: device {len(d)}B != golden {len(g)}B; "
+            f"first diff at byte "
+            f"{next((i for i in range(min(len(d), len(g))) if d[i] != g[i]), -1)}")
+
+
+def test_device_stream_decodes_in_reference_decoder():
+    rng = np.random.default_rng(0)
+    h, w = 32, 48
+    yy, xx = np.mgrid[0:h, 0:w]
+    y = ((xx * 4 + yy * 2) % 256).astype(np.uint8)
+    u = rng.integers(100, 156, (h // 2, w // 2), dtype=np.uint8)
+    v = rng.integers(60, 200, (h // 2, w // 2), dtype=np.uint8)
+    qp = 24
+    dev = _device_rows(y, u, v, qp)
+    _, enc = _host_rows(y, u, v, qp)
+    annexb = enc.headers() + H.assemble_annexb(dev)
+    my, mu, mv = refdec.decode(annexb)
+    assert np.array_equal(my, enc.recon_y)
+    assert np.array_equal(mu, enc.recon_u)
+    assert np.array_equal(mv, enc.recon_v)
+
+
+@pytest.mark.skipif(not avshim.available(), reason="libavcodec unavailable")
+def test_device_stream_decodes_in_ffmpeg():
+    rng = np.random.default_rng(3)
+    h, w = 48, 64
+    y = rng.integers(0, 256, (h, w), dtype=np.uint8)
+    u = rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8)
+    v = rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8)
+    qp = 30
+    dev = _device_rows(y, u, v, qp)
+    _, enc = _host_rows(y, u, v, qp)
+    annexb = enc.headers() + H.assemble_annexb(dev)
+    ry, ru, rv = avshim.decode_h264(annexb)
+    assert np.array_equal(ry, enc.recon_y)
+    assert np.array_equal(ru, enc.recon_u)
+    assert np.array_equal(rv, enc.recon_v)
