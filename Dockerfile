@@ -1,0 +1,42 @@
+# Integration fixture: Xvfb + Xfce-less minimal desktop + selkies-tpu
+# server (the role the reference's addons/example container plays —
+# a full desktop to stream during manual/integration testing).
+#
+#   docker build -t selkies-tpu .
+#   docker run --rm -p 8080:8080 selkies-tpu
+#
+# Browse to http://localhost:8080/ — the web client renders the Xvfb
+# desktop (or the synthetic pattern when no X app is running).
+
+FROM python:3.12-slim-bookworm
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        xvfb x11-xserver-utils xauth x11-utils \
+        libx11-6 libxext6 libxtst6 libxfixes3 libxdamage1 libxrandr2 \
+        libopus0 libavcodec59 gcc make libavcodec-dev \
+        xterm twm \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/selkies-tpu
+COPY pyproject.toml README.md ./
+COPY selkies_tpu ./selkies_tpu
+COPY addons ./addons
+RUN pip install --no-cache-dir -e . \
+    && make -C addons/js-interposer
+
+ENV DISPLAY=:0 \
+    SELKIES_PORT=8080 \
+    SELKIES_ADDR=0.0.0.0
+
+EXPOSE 8080
+
+COPY <<'EOF' /entrypoint.sh
+#!/bin/sh
+set -e
+Xvfb :0 -screen 0 1920x1080x24 -nolisten tcp &
+sleep 1
+(twm && xterm) >/dev/null 2>&1 &
+exec selkies-tpu
+EOF
+RUN chmod +x /entrypoint.sh
+ENTRYPOINT ["/entrypoint.sh"]

@@ -1,0 +1,375 @@
+/* Selkies-TPU joystick interposer: LD_PRELOAD shim presenting the
+ * gamepad unix sockets (selkies_tpu/input/gamepad.py) as kernel joystick
+ * and evdev devices.
+ *
+ * Fresh implementation of the reference addon's role (wire contract:
+ * 1360-byte config struct on connect, then raw js_event / input_event
+ * records; device paths /dev/input/js0-3 and /dev/input/event1000-1003).
+ * Because the file descriptor handed to the app IS a unix socket,
+ * read()/poll()/select()/epoll() work natively — only path resolution
+ * (open/access/stat) and ioctl emulation need interposing.
+ *
+ * Build: gcc -O2 -shared -fPIC -o selkies_joystick_interposer.so \
+ *            selkies_joystick_interposer.c -ldl
+ * Use:   LD_PRELOAD=./selkies_joystick_interposer.so game
+ * Env:   SELKIES_JS_SOCKET_PATH (default /tmp) — socket directory.
+ */
+
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <linux/input.h>
+#include <linux/joystick.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#define NAME_MAX_LEN 255
+#define MAX_BTNS 512
+#define MAX_AXES 64
+#define NUM_SLOTS 4
+
+typedef struct {
+    char name[NAME_MAX_LEN];
+    uint16_t vendor;
+    uint16_t product;
+    uint16_t version;
+    uint16_t num_btns;
+    uint16_t num_axes;
+    uint16_t btn_map[MAX_BTNS];
+    uint8_t axes_map[MAX_AXES];
+    uint8_t pad[6];
+} js_config_t;   /* 1360 bytes, matches the python server's struct */
+
+typedef struct {
+    int in_use;
+    int is_evdev;
+    js_config_t cfg;
+} fd_state_t;
+
+#define MAX_FDS 4096
+static fd_state_t g_fds[MAX_FDS];
+
+static int (*real_open)(const char *, int, ...);
+static int (*real_open64)(const char *, int, ...);
+static int (*real_openat)(int, const char *, int, ...);
+static int (*real_ioctl)(int, unsigned long, ...);
+static int (*real_close)(int);
+static int (*real_access)(const char *, int);
+static int (*real_stat)(const char *, struct stat *);
+static int (*real_xstat)(int, const char *, struct stat *);
+
+__attribute__((constructor)) static void init(void)
+{
+    real_open = dlsym(RTLD_NEXT, "open");
+    real_open64 = dlsym(RTLD_NEXT, "open64");
+    real_openat = dlsym(RTLD_NEXT, "openat");
+    real_ioctl = dlsym(RTLD_NEXT, "ioctl");
+    real_close = dlsym(RTLD_NEXT, "close");
+    real_access = dlsym(RTLD_NEXT, "access");
+    real_stat = dlsym(RTLD_NEXT, "stat");
+    real_xstat = dlsym(RTLD_NEXT, "__xstat");
+}
+
+/* -> slot 0-3 and kind, or -1 when the path is not ours */
+static int match_device(const char *path, int *is_evdev)
+{
+    int n;
+    if (!path)
+        return -1;
+    if (sscanf(path, "/dev/input/js%d", &n) == 1 && n >= 0 && n < NUM_SLOTS) {
+        *is_evdev = 0;
+        return n;
+    }
+    if (sscanf(path, "/dev/input/event100%d", &n) == 1
+        && n >= 0 && n < NUM_SLOTS) {
+        *is_evdev = 1;
+        return n;
+    }
+    return -1;
+}
+
+static void socket_path_for(int slot, int is_evdev, char *out, size_t cap)
+{
+    const char *dir = getenv("SELKIES_JS_SOCKET_PATH");
+    if (!dir || !*dir)
+        dir = "/tmp";
+    if (is_evdev)
+        snprintf(out, cap, "%s/selkies_event100%d.sock", dir, slot);
+    else
+        snprintf(out, cap, "%s/selkies_js%d.sock", dir, slot);
+}
+
+static ssize_t read_full(int fd, void *buf, size_t n)
+{
+    size_t got = 0;
+    while (got < n) {
+        ssize_t r = read(fd, (char *)buf + got, n - got);
+        if (r <= 0) {
+            if (r < 0 && (errno == EINTR))
+                continue;
+            return -1;
+        }
+        got += (size_t)r;
+    }
+    return (ssize_t)got;
+}
+
+static int open_device(const char *path, int flags)
+{
+    int is_evdev = 0;
+    int slot = match_device(path, &is_evdev);
+    if (slot < 0)
+        return -2;    /* not ours */
+    char spath[256];
+    socket_path_for(slot, is_evdev, spath, sizeof spath);
+
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0)
+        return -1;
+    struct sockaddr_un addr;
+    memset(&addr, 0, sizeof addr);
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, spath, sizeof addr.sun_path - 1);
+    if (connect(fd, (struct sockaddr *)&addr, sizeof addr) != 0) {
+        real_close(fd);
+        errno = ENOENT;
+        return -1;
+    }
+    js_config_t cfg;
+    if (read_full(fd, &cfg, sizeof cfg) != (ssize_t)sizeof cfg) {
+        real_close(fd);
+        errno = EIO;
+        return -1;
+    }
+    if (cfg.num_btns > MAX_BTNS)
+        cfg.num_btns = MAX_BTNS;
+    if (cfg.num_axes > MAX_AXES)
+        cfg.num_axes = MAX_AXES;
+    if (flags & O_NONBLOCK) {
+        int fl = fcntl(fd, F_GETFL, 0);
+        fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    }
+    if (fd < MAX_FDS) {
+        g_fds[fd].in_use = 1;
+        g_fds[fd].is_evdev = is_evdev;
+        g_fds[fd].cfg = cfg;
+    }
+    return fd;
+}
+
+int open(const char *path, int flags, ...)
+{
+    int fd = open_device(path, flags);
+    if (fd != -2)
+        return fd;
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = va_arg(ap, mode_t);
+    va_end(ap);
+    return real_open(path, flags, mode);
+}
+
+int open64(const char *path, int flags, ...)
+{
+    int fd = open_device(path, flags);
+    if (fd != -2)
+        return fd;
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = va_arg(ap, mode_t);
+    va_end(ap);
+    return real_open64 ? real_open64(path, flags, mode)
+                       : real_open(path, flags, mode);
+}
+
+int openat(int dirfd, const char *path, int flags, ...)
+{
+    int fd = open_device(path, flags);
+    if (fd != -2)
+        return fd;
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = va_arg(ap, mode_t);
+    va_end(ap);
+    return real_openat(dirfd, path, flags, mode);
+}
+
+int access(const char *path, int mode)
+{
+    int is_evdev;
+    if (match_device(path, &is_evdev) >= 0)
+        return 0;
+    return real_access(path, mode);
+}
+
+int stat(const char *path, struct stat *st)
+{
+    int is_evdev;
+    if (match_device(path, &is_evdev) >= 0) {
+        memset(st, 0, sizeof *st);
+        st->st_mode = S_IFCHR | 0660;
+        st->st_rdev = is_evdev ? makedev(13, 64) : makedev(13, 0);
+        return 0;
+    }
+    return real_stat ? real_stat(path, st) : real_xstat(1, path, st);
+}
+
+int close(int fd)
+{
+    if (fd >= 0 && fd < MAX_FDS)
+        g_fds[fd].in_use = 0;
+    return real_close(fd);
+}
+
+/* ------------------------------------------------------------------ ioctl */
+
+static void set_bit(unsigned char *mask, int bit, int len)
+{
+    if (bit / 8 < len)
+        mask[bit / 8] |= (unsigned char)(1u << (bit % 8));
+}
+
+static int js_ioctl(fd_state_t *st, unsigned long req, void *arg)
+{
+    unsigned cmd = _IOC_NR(req);
+    unsigned len = _IOC_SIZE(req);
+    if (cmd == _IOC_NR(JSIOCGVERSION)) {
+        *(uint32_t *)arg = 0x020100;
+        return 0;
+    }
+    if (cmd == _IOC_NR(JSIOCGAXES)) {
+        *(uint8_t *)arg = (uint8_t)st->cfg.num_axes;
+        return 0;
+    }
+    if (cmd == _IOC_NR(JSIOCGBUTTONS)) {
+        *(uint8_t *)arg = (uint8_t)st->cfg.num_btns;
+        return 0;
+    }
+    if (cmd == _IOC_NR(JSIOCGNAME(0))) {
+        size_t n = strnlen(st->cfg.name, NAME_MAX_LEN);
+        if (n >= len)
+            n = len ? len - 1 : 0;
+        memcpy(arg, st->cfg.name, n);
+        ((char *)arg)[n] = 0;
+        return (int)n;
+    }
+    if (cmd == _IOC_NR(JSIOCGAXMAP)) {
+        unsigned n = st->cfg.num_axes;
+        if (n * sizeof(uint8_t) > len)
+            n = len;
+        memcpy(arg, st->cfg.axes_map, n);
+        return 0;
+    }
+    if (cmd == _IOC_NR(JSIOCGBTNMAP)) {
+        unsigned n = st->cfg.num_btns;
+        if (n * sizeof(uint16_t) > len)
+            n = len / sizeof(uint16_t);
+        memcpy(arg, st->cfg.btn_map, n * sizeof(uint16_t));
+        return 0;
+    }
+    if (cmd == _IOC_NR(JSIOCGCORR)) {
+        memset(arg, 0, len);
+        return 0;
+    }
+    if (cmd == _IOC_NR(JSIOCSCORR))
+        return 0;
+    errno = EINVAL;
+    return -1;
+}
+
+static int ev_ioctl(fd_state_t *st, unsigned long req, void *arg)
+{
+    unsigned type = _IOC_TYPE(req);
+    unsigned cmd = _IOC_NR(req);
+    unsigned len = _IOC_SIZE(req);
+    if (type != 'E') {
+        errno = EINVAL;
+        return -1;
+    }
+    if (req == EVIOCGVERSION) {
+        *(int *)arg = 0x010001;
+        return 0;
+    }
+    if (req == EVIOCGID) {
+        struct input_id *id = arg;
+        id->bustype = BUS_USB;
+        id->vendor = st->cfg.vendor;
+        id->product = st->cfg.product;
+        id->version = st->cfg.version;
+        return 0;
+    }
+    if (cmd == _IOC_NR(EVIOCGNAME(0))) {
+        size_t n = strnlen(st->cfg.name, NAME_MAX_LEN);
+        if (n >= len)
+            n = len ? len - 1 : 0;
+        memcpy(arg, st->cfg.name, n);
+        ((char *)arg)[n] = 0;
+        return (int)n;
+    }
+    if (cmd == _IOC_NR(EVIOCGPHYS(0)) || cmd == _IOC_NR(EVIOCGUNIQ(0))) {
+        if (len)
+            ((char *)arg)[0] = 0;
+        return 0;
+    }
+    if (cmd == _IOC_NR(EVIOCGPROP(0)) || cmd == _IOC_NR(EVIOCGKEY(0))
+        || cmd == _IOC_NR(EVIOCGLED(0)) || cmd == _IOC_NR(EVIOCGSND(0))
+        || cmd == _IOC_NR(EVIOCGSW(0))) {
+        memset(arg, 0, len);
+        return 0;
+    }
+    /* EVIOCGBIT(ev, len): cmd 0x20 + ev */
+    if (cmd >= 0x20 && cmd < 0x20 + EV_MAX) {
+        unsigned ev = cmd - 0x20;
+        unsigned char *mask = arg;
+        memset(mask, 0, len);
+        if (ev == 0) {
+            set_bit(mask, EV_SYN, len);
+            set_bit(mask, EV_KEY, len);
+            set_bit(mask, EV_ABS, len);
+        } else if (ev == EV_KEY) {
+            for (unsigned i = 0; i < st->cfg.num_btns; i++)
+                set_bit(mask, st->cfg.btn_map[i], len);
+        } else if (ev == EV_ABS) {
+            for (unsigned i = 0; i < st->cfg.num_axes; i++)
+                set_bit(mask, st->cfg.axes_map[i], len);
+        }
+        return 0;
+    }
+    /* EVIOCGABS(abs): cmd 0x40 + abs */
+    if (cmd >= 0x40 && cmd < 0x40 + ABS_MAX && len >= sizeof(struct input_absinfo)) {
+        struct input_absinfo *ai = arg;
+        memset(ai, 0, sizeof *ai);
+        ai->minimum = -32767;
+        ai->maximum = 32767;
+        ai->fuzz = 16;
+        ai->flat = 128;
+        return 0;
+    }
+    if (req == EVIOCGRAB || _IOC_NR(req) == _IOC_NR(EVIOCGRAB))
+        return 0;
+    errno = EINVAL;
+    return -1;
+}
+
+int ioctl(int fd, unsigned long req, ...)
+{
+    va_list ap;
+    va_start(ap, req);
+    void *arg = va_arg(ap, void *);
+    va_end(ap);
+    if (fd >= 0 && fd < MAX_FDS && g_fds[fd].in_use) {
+        fd_state_t *st = &g_fds[fd];
+        if (_IOC_TYPE(req) == 'j' && !st->is_evdev)
+            return js_ioctl(st, req, arg);
+        return ev_ioctl(st, req, arg);
+    }
+    return real_ioctl(fd, req, arg);
+}

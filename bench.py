@@ -31,6 +31,7 @@ def main() -> None:
     import jax
 
     from selkies_tpu.engine.encoder import JpegEncoderSession
+    from selkies_tpu.engine.h264_encoder import H264EncoderSession
     from selkies_tpu.engine.sources import SyntheticSource
     from selkies_tpu.engine.types import CaptureSettings
 
@@ -40,11 +41,17 @@ def main() -> None:
     default_frames = 240 if backend != "cpu" else 12
     n_frames = int(os.environ.get("BENCH_FRAMES", str(default_frames)))
     quality = int(os.environ.get("BENCH_QUALITY", "60"))
+    codec = os.environ.get("BENCH_CODEC", "h264")   # the north-star path
 
     settings = CaptureSettings(
         capture_width=w, capture_height=h, jpeg_quality=quality,
-        stripe_height=64, use_damage_gating=True, use_paint_over=False)
-    sess = JpegEncoderSession(settings)
+        output_mode="h264" if codec == "h264" else "jpeg",
+        video_crf=28, stripe_height=64,
+        use_damage_gating=True, use_paint_over=False)
+    if codec == "h264":
+        sess = H264EncoderSession(settings)
+    else:
+        sess = JpegEncoderSession(settings)
     g = sess.grid
     # generate at the padded grid size so the measured loop is pure encode
     src = SyntheticSource(g.width, g.height)
@@ -54,7 +61,7 @@ def main() -> None:
     # -- warmup / compile ----------------------------------------------------
     t0 = time.monotonic()
     for t in range(3):
-        sess.finalize(sess.encode(src.get_frame(t)), force_all=True)
+        sess.finalize(sess.encode(src.get_frame(t), force=True), force_all=True)
     log(f"compile+warmup: {time.monotonic() - t0:.1f}s")
 
     # -- latency: unpipelined dispatch -> wire bytes -------------------------
@@ -65,7 +72,7 @@ def main() -> None:
         f = src.get_frame(100 + t)
         jax.block_until_ready(f)          # exclude frame synthesis
         t0 = time.monotonic()
-        chunks = sess.finalize(sess.encode(f), force_all=True)
+        chunks = sess.finalize(sess.encode(f, force=True), force_all=True)
         lat.append(time.monotonic() - t0)
         total_bytes += sum(len(c.payload) for c in chunks)
     lat.sort()
@@ -81,7 +88,7 @@ def main() -> None:
     t0 = time.monotonic()
     done = 0
     for t in range(n_frames):
-        inflight.append(sess.encode(src.get_frame(1000 + t)))
+        inflight.append(sess.encode(src.get_frame(1000 + t), force=True))
         if len(inflight) > PIPELINE_DEPTH:
             sess.finalize(inflight.popleft(), force_all=True)
             done += 1
@@ -94,7 +101,7 @@ def main() -> None:
 
     mbps = total_bytes / n_lat * fps * 8 / 1e6
     print(json.dumps({
-        "metric": f"encode_fps_{w}x{h}_jpeg_tpu",
+        "metric": f"encode_fps_{w}x{h}_{codec}_tpu",
         "value": round(fps, 2),
         "unit": "fps",
         "vs_baseline": round(fps / 60.0, 3),

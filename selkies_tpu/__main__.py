@@ -47,6 +47,9 @@ async def run(argv=None) -> None:
             backend=make_backend(settings.display_id),
             enable_command_verb=settings.enable_command_verb,
             clipboard_max_bytes=settings.clipboard_max_bytes)
+        if settings.enable_gamepad:
+            from .input.gamepad import GamepadManager
+            input_handler.gamepad_manager = GamepadManager(input_handler)
 
     audio = None
     if settings.enable_audio:

@@ -1,0 +1,177 @@
+"""Display management: CVT-RB modelines, xrandr resize, DPI, cursor size.
+
+Fresh implementation of the responsibilities in reference
+display_utils.py:223-1076 (resize + modelines), 1391 (DPI), 1480 (cursor
+size). The modeline math is pure (tested against known ``cvt -r``
+outputs); the X-side application shells out to xrandr/xrdb exactly like
+the reference does, and degrades to a no-op when no X display exists
+(headless/synthetic mode keeps working — resize then only re-crops the
+capture, the round-1 behaviour).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import os
+import re
+import shutil
+
+logger = logging.getLogger("selkies_tpu.display")
+
+
+@dataclasses.dataclass(frozen=True)
+class Modeline:
+    name: str
+    clock_mhz: float
+    width: int
+    hsync_start: int
+    hsync_end: int
+    htotal: int
+    height: int
+    vsync_start: int
+    vsync_end: int
+    vtotal: int
+
+    def xrandr_args(self) -> list[str]:
+        return [self.name, f"{self.clock_mhz:.2f}",
+                str(self.width), str(self.hsync_start),
+                str(self.hsync_end), str(self.htotal),
+                str(self.height), str(self.vsync_start),
+                str(self.vsync_end), str(self.vtotal),
+                "+hsync", "-vsync"]
+
+
+def cvt_rb_modeline(width: int, height: int, refresh: float = 60.0
+                    ) -> Modeline:
+    """VESA CVT reduced-blanking timing (the flat-panel modeline xrandr's
+    own ``cvt -r`` computes; matches it bit-for-bit on common modes).
+
+    RB constants: h_blank 160 (48 front / 32 sync / 80 back), v_front 3,
+    v_back 6, v_sync by aspect, >=460 us vertical blank, 0.25 MHz clock
+    granularity.
+    """
+    width -= width % 2
+    h_front, h_sync, h_blank = 48, 32, 160
+    v_front, v_back = 3, 6
+    aspect = width / height
+    if abs(aspect - 4 / 3) < 0.01:
+        v_sync = 4
+    elif abs(aspect - 16 / 9) < 0.01:
+        v_sync = 5
+    elif abs(aspect - 16 / 10) < 0.01:
+        v_sync = 6
+    elif abs(aspect - 5 / 4) < 0.01 or abs(aspect - 15 / 9) < 0.01:
+        v_sync = 7
+    else:
+        v_sync = 10
+    h_period_est = ((1_000_000.0 / refresh) - 460.0) / height   # us
+    vbi = int(460.0 / h_period_est) + 1
+    min_vbi = v_front + v_sync + v_back
+    act_vbi = max(vbi, min_vbi)
+    vtotal = height + act_vbi
+    htotal = width + h_blank
+    clock = htotal * vtotal * refresh / 1e6                     # MHz
+    clock = int(clock / 0.25) * 0.25                            # floor step
+    return Modeline(
+        name=f"{width}x{height}_{refresh:.2f}",
+        clock_mhz=clock, width=width,
+        hsync_start=width + h_front,
+        hsync_end=width + h_front + h_sync,
+        htotal=htotal, height=height,
+        vsync_start=height + v_front,
+        vsync_end=height + v_front + v_sync,
+        vtotal=vtotal)
+
+
+class DisplayManager:
+    """xrandr-backed resize for a real X display; inert when headless."""
+
+    _PROBE_RETRY_S = 60.0
+
+    def __init__(self, display: str = ":0"):
+        self.display = display
+        self._output: str | None = None
+        self._probe_failed_at: float | None = None
+
+    def available(self) -> bool:
+        """xrandr exists and the display hasn't recently refused us.
+        The real probe happens in detect_output; its failure is cached so
+        headless servers don't spawn a doomed subprocess per resize."""
+        if not shutil.which("xrandr"):
+            return False
+        if self._probe_failed_at is not None:
+            import time
+            if time.monotonic() - self._probe_failed_at < self._PROBE_RETRY_S:
+                return False
+        return True
+
+    async def _run(self, *args: str) -> tuple[int, str]:
+        env = dict(os.environ, DISPLAY=self.display)
+        proc = await asyncio.create_subprocess_exec(
+            *args, env=env,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT)
+        out, _ = await proc.communicate()
+        return proc.returncode or 0, out.decode(errors="replace")
+
+    async def detect_output(self) -> str | None:
+        """First connected xrandr output; a failed probe is cached for
+        _PROBE_RETRY_S so headless servers stop paying for it."""
+        import time
+        if self._output:
+            return self._output
+        rc, out = await self._run("xrandr", "--query")
+        if rc != 0:
+            self._probe_failed_at = time.monotonic()
+            return None
+        for line in out.splitlines():
+            m = re.match(r"^(\S+) connected", line)
+            if m:
+                self._output = m.group(1)
+                return self._output
+        self._probe_failed_at = time.monotonic()
+        return None
+
+    async def resize(self, width: int, height: int,
+                     refresh: float = 60.0) -> bool:
+        """Ensure a CVT-RB mode exists and switch the output to it
+        (reference ensure_mode + resize_display, display_utils.py:223-1076).
+        Returns True when the X screen actually changed."""
+        out = await self.detect_output()
+        if out is None:
+            return False
+        ml = cvt_rb_modeline(width, height, refresh)
+        rc, text = await self._run("xrandr", "--newmode", *ml.xrandr_args())
+        if rc != 0 and "already exists" not in text:
+            logger.warning("xrandr newmode failed: %s", text.strip())
+        await self._run("xrandr", "--addmode", out, ml.name)
+        rc, text = await self._run("xrandr", "--output", out,
+                                   "--mode", ml.name)
+        if rc != 0:
+            logger.warning("xrandr mode switch failed: %s", text.strip())
+            return False
+        logger.info("display resized to %s", ml.name)
+        return True
+
+    async def set_dpi(self, dpi: int) -> None:
+        if shutil.which("xrdb"):
+            proc = await asyncio.create_subprocess_exec(
+                "xrdb", "-merge", "-",
+                env=dict(os.environ, DISPLAY=self.display),
+                stdin=asyncio.subprocess.PIPE,
+                stdout=asyncio.subprocess.DEVNULL,
+                stderr=asyncio.subprocess.DEVNULL)
+            await proc.communicate(f"Xft.dpi: {int(dpi)}\n".encode())
+
+    async def set_cursor_size(self, size: int) -> None:
+        if shutil.which("xrdb"):
+            proc = await asyncio.create_subprocess_exec(
+                "xrdb", "-merge", "-",
+                env=dict(os.environ, DISPLAY=self.display),
+                stdin=asyncio.subprocess.PIPE,
+                stdout=asyncio.subprocess.DEVNULL,
+                stderr=asyncio.subprocess.DEVNULL)
+            await proc.communicate(
+                f"Xcursor.size: {int(size)}\n".encode())

@@ -216,6 +216,16 @@ class ScreenCapture:
                     self._force_idr.clear()
                 out = sess.encode(frame, force=force)
                 out["force"] = force
+                # cursor image changes ride the same thread; the callback
+                # hops to the loop like frame chunks do
+                cb = self._cursor_callback
+                if cb is not None and hasattr(src, "poll_cursor"):
+                    try:
+                        cur = src.poll_cursor()
+                        if cur is not None:
+                            cb(cur)
+                    except Exception:
+                        logger.debug("cursor poll failed", exc_info=True)
                 inflight.append(out)
                 if len(inflight) > PIPELINE_DEPTH:
                     window_bytes += self._deliver(inflight.popleft())

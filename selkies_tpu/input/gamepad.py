@@ -1,0 +1,242 @@
+"""Virtual gamepad data plane: unix-socket servers speaking the joystick
+interposer protocol.
+
+Games inside the container open ``/dev/input/js0``.. through the
+LD_PRELOAD interposer (addons/js-interposer/), which redirects each device
+to a unix socket (``/tmp/selkies_js{N}.sock`` for the legacy joystick API,
+``/tmp/selkies_event100{N}.sock`` for evdev). This module is the server
+side of those sockets (reference ``SelkiesGamepad``,
+input_handler.py:1378-1863; wire contract: joystick_interposer.c:90-130,
+344-470):
+
+- on connect, the server sends one 1360-byte config struct
+  (name/vendor/product/version/btn+axis maps);
+- then streams 8-byte ``struct js_event`` or 24-byte ``struct
+  input_event`` records as the browser reports gamepad state.
+
+Browser side uses the W3C Standard Gamepad layout; the mapping below
+translates it onto an Xbox-360-class evdev profile, the most widely
+probed layout in game engines.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import os
+import struct
+import time
+from typing import Optional
+
+logger = logging.getLogger("selkies_tpu.input.gamepad")
+
+NAME_MAX = 255
+MAX_BTNS = 512
+MAX_AXES = 64
+JS_EVENT_BUTTON = 0x01
+JS_EVENT_AXIS = 0x02
+JS_EVENT_INIT = 0x80
+EV_SYN, EV_KEY, EV_ABS = 0x00, 0x01, 0x03
+
+# Xbox-360-class profile. Button order defines the js-protocol numbering.
+XPAD_NAME = "Microsoft X-Box 360 pad"
+XPAD_VENDOR, XPAD_PRODUCT, XPAD_VERSION = 0x045E, 0x028E, 0x0114
+XPAD_BTNS = [0x130, 0x131, 0x133, 0x134, 0x136, 0x137,   # A B X Y TL TR
+             0x13A, 0x13B, 0x13C, 0x13D, 0x13E]          # SEL STA MODE TH_L/R
+XPAD_AXES = [0x00, 0x01, 0x02, 0x03, 0x04, 0x05,         # X Y Z RX RY RZ
+             0x10, 0x11]                                  # HAT0X HAT0Y
+
+# W3C Standard Gamepad button index -> action on the xpad profile.
+# ("b", js_btn_index) | ("a", js_axis_index, pressed_val) | ("h", axis, dir)
+_W3C_BTN = {
+    0: ("b", 0), 1: ("b", 1), 2: ("b", 2), 3: ("b", 3),
+    4: ("b", 4), 5: ("b", 5),
+    6: ("a", 2),            # LT -> ABS_Z
+    7: ("a", 5),            # RT -> ABS_RZ
+    8: ("b", 6), 9: ("b", 7), 16: ("b", 8),
+    10: ("b", 9), 11: ("b", 10),
+    12: ("h", 7, -1), 13: ("h", 7, 1),    # dpad up/down -> HAT0Y
+    14: ("h", 6, -1), 15: ("h", 6, 1),    # dpad left/right -> HAT0X
+}
+# W3C axes 0..3 -> xpad axis slots (ABS_X, ABS_Y, ABS_RX, ABS_RY)
+_W3C_AXIS = {0: 0, 1: 1, 2: 3, 3: 4}
+
+
+def build_config(name: str = XPAD_NAME) -> bytes:
+    """The 1360-byte js_config_t the interposer expects on connect."""
+    btn_map = XPAD_BTNS + [0] * (MAX_BTNS - len(XPAD_BTNS))
+    axes_map = XPAD_AXES + [0] * (MAX_AXES - len(XPAD_AXES))
+    return struct.pack(
+        f"<{NAME_MAX}sx4H H{MAX_BTNS}H{MAX_AXES}B6x",
+        name.encode()[:NAME_MAX - 1],
+        XPAD_VENDOR, XPAD_PRODUCT, XPAD_VERSION, len(XPAD_BTNS),
+        len(XPAD_AXES), *btn_map, *axes_map)
+
+
+def pack_js_event(value: int, ev_type: int, number: int) -> bytes:
+    return struct.pack("<IhBB", int(time.monotonic() * 1000) & 0xFFFFFFFF,
+                       value, ev_type, number)
+
+
+def pack_input_event(ev_type: int, code: int, value: int) -> bytes:
+    now = time.time()
+    return struct.pack("<qqHHi", int(now), int((now % 1) * 1e6),
+                       ev_type, code, value)
+
+
+class GamepadSocketServer:
+    """One per gamepad slot: serves both the js and evdev sockets and
+    translates W3C Standard Gamepad reports into device events."""
+
+    def __init__(self, index: int, socket_dir: str = "/tmp",
+                 name: str = XPAD_NAME):
+        self.index = index
+        self.name = name
+        self.js_path = os.path.join(socket_dir, f"selkies_js{index}.sock")
+        self.ev_path = os.path.join(socket_dir,
+                                    f"selkies_event100{index}.sock")
+        self._servers: list[asyncio.AbstractServer] = []
+        self._js_clients: set[asyncio.StreamWriter] = set()
+        self._ev_clients: set[asyncio.StreamWriter] = set()
+        self._axis_state: dict[int, int] = {}
+
+    async def start(self) -> None:
+        for path, clients in ((self.js_path, self._js_clients),
+                              (self.ev_path, self._ev_clients)):
+            with contextlib.suppress(FileNotFoundError):
+                os.unlink(path)
+            server = await asyncio.start_unix_server(
+                self._make_handler(clients, evdev=(clients is self._ev_clients)),
+                path=path)
+            self._servers.append(server)
+        logger.info("gamepad %d serving %s + %s", self.index,
+                    self.js_path, self.ev_path)
+
+    def _make_handler(self, clients: set, evdev: bool):
+        async def handler(reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+            try:
+                writer.write(build_config(self.name))
+                await writer.drain()
+            except (ConnectionError, OSError):
+                writer.close()
+                return
+            clients.add(writer)
+            logger.info("gamepad %d: %s client connected", self.index,
+                        "evdev" if evdev else "js")
+            try:
+                while await reader.read(4096):   # drain until EOF
+                    pass
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                clients.discard(writer)
+                writer.close()
+        return handler
+
+    async def stop(self) -> None:
+        # close live client transports FIRST: wait_closed() (3.12+) waits
+        # for connection handlers, which loop until their peer EOFs
+        for w in list(self._js_clients | self._ev_clients):
+            w.close()
+        self._js_clients.clear()
+        self._ev_clients.clear()
+        for s in self._servers:
+            s.close()
+            with contextlib.suppress(Exception):
+                await asyncio.wait_for(s.wait_closed(), 2.0)
+        self._servers.clear()
+        for path in (self.js_path, self.ev_path):
+            with contextlib.suppress(FileNotFoundError):
+                os.unlink(path)
+
+    # ----------------------------------------------------------------- sends
+    def _fanout(self, js: Optional[bytes], ev: Optional[bytes]) -> None:
+        for w in list(self._js_clients):
+            if js:
+                self._write(w, js, self._js_clients)
+        for w in list(self._ev_clients):
+            if ev:
+                self._write(w, ev + pack_input_event(EV_SYN, 0, 0),
+                            self._ev_clients)
+
+    @staticmethod
+    def _write(w: asyncio.StreamWriter, data: bytes, pool: set) -> None:
+        try:
+            w.write(data)
+        except (ConnectionError, OSError, RuntimeError):
+            pool.discard(w)
+            w.close()
+
+    def _axis(self, js_axis: int, raw: int) -> None:
+        if self._axis_state.get(js_axis) == raw:
+            return
+        self._axis_state[js_axis] = raw
+        code = XPAD_AXES[js_axis]
+        self._fanout(pack_js_event(raw, JS_EVENT_AXIS, js_axis),
+                     pack_input_event(EV_ABS, code, raw))
+
+    # ------------------------------------------------------------- W3C input
+    def report_button(self, w3c_index: int, value: float) -> None:
+        act = _W3C_BTN.get(w3c_index)
+        if act is None:
+            return
+        if act[0] == "b":
+            num = act[1]
+            pressed = 1 if value > 0.5 else 0
+            self._fanout(
+                pack_js_event(pressed, JS_EVENT_BUTTON, num),
+                pack_input_event(EV_KEY, XPAD_BTNS[num], pressed))
+        elif act[0] == "a":      # analog trigger: 0..1 -> 0..32767
+            self._axis(act[1], int(max(0.0, min(1.0, value)) * 32767))
+        else:                    # hat direction
+            _, axis, direction = act
+            raw = direction * 32767 if value > 0.5 else 0
+            self._axis(axis, raw)
+
+    def report_axis(self, w3c_index: int, value: float) -> None:
+        slot = _W3C_AXIS.get(w3c_index)
+        if slot is None:
+            return
+        self._axis(slot, int(max(-1.0, min(1.0, value)) * 32767))
+
+
+class GamepadManager:
+    """Bridges InputHandler's GamepadState verbs onto socket servers,
+    creating each slot's server lazily on first ``js,c``."""
+
+    def __init__(self, input_handler, socket_dir: str = "/tmp"):
+        self._dir = socket_dir
+        self._servers: dict[int, GamepadSocketServer] = {}
+        self._handler = input_handler
+        for gp in input_handler.gamepads:
+            gp.listeners.append(
+                lambda kind, num, value, slot=gp.index:
+                self._on_event(slot, kind, num, value))
+
+    async def ensure_slot(self, slot: int, name: str) -> None:
+        if slot not in self._servers:
+            srv = GamepadSocketServer(slot, self._dir, name or XPAD_NAME)
+            await srv.start()
+            self._servers[slot] = srv
+
+    def _on_event(self, slot: int, kind: str, num: int, value: float) -> None:
+        srv = self._servers.get(slot)
+        if srv is None:
+            return
+        if kind == "b":
+            srv.report_button(num, value)
+        elif kind == "a":
+            srv.report_axis(num, value)
+
+    async def sync_slots(self) -> None:
+        """Create servers for every connected GamepadState slot."""
+        for gp in self._handler.gamepads:
+            if gp.connected:
+                await self.ensure_slot(gp.index, gp.name)
+
+    async def stop(self) -> None:
+        for srv in self._servers.values():
+            await srv.stop()
+        self._servers.clear()

@@ -72,6 +72,9 @@ class InputHandler:
                  send_clipboard: Optional[Callable[[bytes, str], Awaitable[None]]] = None,
                  now: Callable[[], float] = time.monotonic):
         self.backend = backend if backend is not None else NullBackend()
+        #: optional input.gamepad.GamepadManager — serves the interposer
+        #: unix sockets; slots spin up lazily on the first ``js,c``
+        self.gamepad_manager = None
         self.enable_command_verb = enable_command_verb
         self.clipboard_max = clipboard_max_bytes
         self.send_clipboard = send_clipboard
@@ -261,6 +264,8 @@ class InputHandler:
                 gp.connected = True
                 if len(parts) > 2:
                     gp.name = ",".join(parts[2:])[:255] or gp.name
+                if self.gamepad_manager is not None:
+                    await self.gamepad_manager.ensure_slot(slot, gp.name)
         elif kind == "d":             # js,d,<slot> disconnect
             slot = int(parts[1]) if len(parts) > 1 else 0
             if 0 <= slot < len(self.gamepads):

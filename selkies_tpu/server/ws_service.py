@@ -97,7 +97,8 @@ class WebSocketsService(BaseStreamingService):
     name = "websockets"
 
     def __init__(self, settings: AppSettings, input_handler=None,
-                 capture_factory=None, audio_pipeline=None):
+                 capture_factory=None, audio_pipeline=None,
+                 display_manager=None):
         self.settings = settings
         self.clients: dict[int, ClientConnection] = {}
         self.captures: dict[str, ScreenCapture] = {}
@@ -105,8 +106,13 @@ class WebSocketsService(BaseStreamingService):
         self._capture_factory = capture_factory or (lambda: ScreenCapture("auto"))
         self.input_handler = input_handler
         self.audio = audio_pipeline
+        if display_manager is None:
+            from ..display import DisplayManager
+            display_manager = DisplayManager(settings.display_id)
+        self.display_manager = display_manager
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._running = False
+        self._bg_tasks: set[asyncio.Task] = set()
         self._last_conn_by_ip: dict[str, float] = {}
         self._grace_task: Optional[asyncio.Task] = None
         self._stats_task: Optional[asyncio.Task] = None
@@ -202,6 +208,11 @@ class WebSocketsService(BaseStreamingService):
                     # (reference selkies.py:4294)
                     loop.call_soon_threadsafe(self._do_fanout, chunk)
 
+                def cursor_cb(cur: dict) -> None:
+                    loop.call_soon_threadsafe(self._on_cursor, cur)
+
+                if self.settings.enable_cursors:
+                    cap.set_cursor_callback(cursor_cb)
                 cap.start_capture(cb, self._capture_settings(display_id))
                 logger.info("capture started for display %s", display_id)
 
@@ -220,6 +231,34 @@ class WebSocketsService(BaseStreamingService):
 
         if self._grace_task is None or self._grace_task.done():
             self._grace_task = asyncio.create_task(_grace())
+
+    # ---------------------------------------------------------------- cursor
+    def _on_cursor(self, cur: dict) -> None:
+        """Runs on the loop: PNG-encode the XFixes cursor image and
+        broadcast a ``cursor,{json}`` message (reference
+        display_utils.py:1730, format_pixelflux_cursor)."""
+        import base64
+        import io
+
+        from PIL import Image
+        try:
+            img = Image.fromarray(cur["rgba"], "RGBA")
+            buf = io.BytesIO()
+            img.save(buf, "PNG")
+            payload = json.dumps({
+                "png_b64": base64.b64encode(buf.getvalue()).decode(),
+                "xhot": cur["xhot"], "yhot": cur["yhot"],
+                "serial": cur["serial"],
+            })
+        except Exception:
+            logger.debug("cursor encode failed", exc_info=True)
+            return
+        self._last_cursor_msg = "cursor," + payload
+        # hold a strong reference: the loop only weak-refs pending tasks
+        task = asyncio.create_task(
+            self._broadcast_control(self._last_cursor_msg))
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     # ---------------------------------------------------------------- fanout
     def _do_fanout(self, chunk: EncodedChunk) -> None:
@@ -297,6 +336,9 @@ class WebSocketsService(BaseStreamingService):
         try:
             await ws.send_str("MODE websockets")
             await ws.send_str(self._server_settings_payload())
+            # late joiners get the current cursor immediately
+            if getattr(self, "_last_cursor_msg", None):
+                await ws.send_str(self._last_cursor_msg)
             async for msg in ws:
                 if msg.type == WSMsgType.TEXT:
                     await self._on_text(client, msg.data)
@@ -509,11 +551,18 @@ class WebSocketsService(BaseStreamingService):
         did = self.settings.display_id
         self.display_geometry[did] = (max(64, min(w, 16384)),
                                       max(64, min(h, 16384)))
+        geo = self.display_geometry[did]
+        # resize the REAL X screen first (CVT-RB modeline via xrandr,
+        # reference display_utils.py:223-1076); headless setups skip this
+        # and only the capture geometry changes
+        if self.display_manager is not None \
+                and self.display_manager.available():
+            await self.display_manager.resize(*geo,
+                                              float(self.settings.framerate))
         cap = self.captures.get(did)
         if cap and cap.is_capturing():
             # size change rebuilds the capture session (joins a thread):
             # never on the event loop
-            geo = self.display_geometry[did]
             await asyncio.get_running_loop().run_in_executor(
                 None, lambda: cap.update_capture_region(0, 0, *geo))
         # broadcast realized geometry (bounded sends)
@@ -521,9 +570,12 @@ class WebSocketsService(BaseStreamingService):
 
     async def _h_dpi(self, client: ClientConnection, args: str) -> None:
         try:
-            self.settings.apply_client_setting("dpi", int(args))
+            dpi = self.settings.apply_client_setting("dpi", int(args))
         except (SettingsError, ValueError):
-            pass
+            return
+        if self.display_manager is not None \
+                and self.display_manager.available():
+            await self.display_manager.set_dpi(int(dpi))
 
     async def _h_video_bitrate(self, client: ClientConnection, args: str) -> None:
         try:

@@ -1,0 +1,49 @@
+"""Display management tests: CVT-RB modeline math against known-good
+``cvt -r`` outputs (pure functions — no X server needed)."""
+
+from selkies_tpu.display import DisplayManager, cvt_rb_modeline
+
+
+def test_cvt_rb_1080p60_matches_cvt():
+    # $ cvt -r 1920 1080 60
+    # Modeline "1920x1080R" 138.50 1920 1968 2000 2080 1080 1083 1088 1111
+    m = cvt_rb_modeline(1920, 1080, 60)
+    assert (m.clock_mhz, m.width, m.hsync_start, m.hsync_end, m.htotal) == \
+        (138.50, 1920, 1968, 2000, 2080)
+    assert (m.height, m.vsync_start, m.vsync_end, m.vtotal) == \
+        (1080, 1083, 1088, 1111)
+
+
+def test_cvt_rb_1440p60_matches_cvt():
+    # Modeline "2560x1440R" 241.50 2560 2608 2640 2720 1440 1443 1448 1481
+    m = cvt_rb_modeline(2560, 1440, 60)
+    assert m.clock_mhz == 241.50
+    assert (m.htotal, m.vtotal) == (2720, 1481)
+
+
+def test_cvt_rb_odd_width_rounded_even():
+    m = cvt_rb_modeline(1365, 768, 60)
+    assert m.width == 1364
+
+
+def test_cvt_rb_4k30():
+    m = cvt_rb_modeline(3840, 2160, 30)
+    assert m.htotal == 4000
+    assert m.vtotal > 2160
+    # pixel clock sanity: htotal*vtotal*30 within one step of clock
+    assert abs(m.clock_mhz - m.htotal * m.vtotal * 30 / 1e6) <= 0.25
+
+
+def test_xrandr_args_shape():
+    m = cvt_rb_modeline(1280, 720, 60)
+    args = m.xrandr_args()
+    assert args[0] == "1280x720_60.00"
+    assert args[-2:] == ["+hsync", "-vsync"]
+    assert len(args) == 12
+
+
+def test_manager_headless_is_inert():
+    dm = DisplayManager(":99")
+    # no xrandr or no display -> available() False on this CI image is
+    # fine either way; the contract is just "no crash"
+    assert dm.available() in (True, False)

@@ -1,0 +1,101 @@
+"""Gamepad socket-server tests: a fake interposer client (the role the
+LD_PRELOAD .so plays for real games) connects to the unix sockets and
+validates the config struct and event records — the same check the
+reference performs with js-interposer-test.py (SURVEY.md §4.3)."""
+
+import asyncio
+import struct
+
+from selkies_tpu.input.backends import NullBackend
+from selkies_tpu.input.gamepad import (EV_ABS, EV_KEY, EV_SYN,
+                                       GamepadManager, GamepadSocketServer,
+                                       JS_EVENT_AXIS, JS_EVENT_BUTTON,
+                                       XPAD_AXES, XPAD_BTNS, build_config)
+from selkies_tpu.input.handler import InputHandler
+
+
+def test_config_struct_is_exactly_1360_bytes():
+    cfg = build_config()
+    assert len(cfg) == 1360
+    name = cfg[:255].split(b"\0")[0].decode()
+    vendor, product, version, nbtn, naxes = struct.unpack_from("<5H", cfg, 256)
+    assert name == "Microsoft X-Box 360 pad"
+    assert (vendor, product) == (0x045E, 0x028E)
+    assert nbtn == len(XPAD_BTNS) and naxes == len(XPAD_AXES)
+    btn_map = struct.unpack_from(f"<{nbtn}H", cfg, 266)
+    assert list(btn_map) == XPAD_BTNS
+
+
+async def _read_exact(reader, n, timeout=5.0):
+    return await asyncio.wait_for(reader.readexactly(n), timeout)
+
+
+def test_js_and_evdev_clients_receive_events(tmp_path):
+    async def run():
+        srv = GamepadSocketServer(0, str(tmp_path))
+        await srv.start()
+        jr, _jw = await asyncio.open_unix_connection(srv.js_path)
+        er, _ew = await asyncio.open_unix_connection(srv.ev_path)
+        assert len(await _read_exact(jr, 1360)) == 1360
+        assert len(await _read_exact(er, 1360)) == 1360
+
+        srv.report_button(0, 1.0)        # W3C A -> BTN_A
+        t, val, typ, num = struct.unpack("<IhBB", await _read_exact(jr, 8))
+        assert (val, typ, num) == (1, JS_EVENT_BUTTON, 0)
+        s1 = struct.unpack("<qqHHi", await _read_exact(er, 24))
+        syn = struct.unpack("<qqHHi", await _read_exact(er, 24))
+        assert s1[2:] == (EV_KEY, XPAD_BTNS[0], 1)
+        assert syn[2] == EV_SYN
+
+        srv.report_axis(0, -0.5)         # left stick X
+        t, val, typ, num = struct.unpack("<IhBB", await _read_exact(jr, 8))
+        assert typ == JS_EVENT_AXIS and num == 0 and -16500 < val < -16000
+        ab = struct.unpack("<qqHHi", await _read_exact(er, 24))
+        assert ab[2] == EV_ABS and ab[3] == XPAD_AXES[0]
+
+        srv.report_button(12, 1.0)       # dpad up -> HAT0Y = -32767
+        t, val, typ, num = struct.unpack("<IhBB", await _read_exact(jr, 8))
+        assert typ == JS_EVENT_AXIS and num == 7 and val == -32767
+
+        srv.report_button(6, 0.5)        # LT analog -> ABS_Z ~16383
+        t, val, typ, num = struct.unpack("<IhBB", await _read_exact(jr, 8))
+        assert typ == JS_EVENT_AXIS and num == 2 and 16000 < val < 16700
+        await srv.stop()
+
+    asyncio.run(run())
+
+
+def test_manager_bridges_input_verbs_to_sockets(tmp_path):
+    async def run():
+        handler = InputHandler(backend=NullBackend())
+        mgr = GamepadManager(handler, str(tmp_path))
+        handler.gamepad_manager = mgr
+        await handler.on_message("js,c,0,My Pad")
+        srv = mgr._servers[0]
+        jr, _ = await asyncio.open_unix_connection(srv.js_path)
+        cfg = await _read_exact(jr, 1360)
+        assert cfg[:255].split(b"\0")[0].decode() == "My Pad"
+        await handler.on_message("js,b,0,1,1")       # W3C B pressed
+        t, val, typ, num = struct.unpack("<IhBB", await _read_exact(jr, 8))
+        assert (val, typ, num) == (1, JS_EVENT_BUTTON, 1)
+        await handler.on_message("js,a,0,1,0.25")    # left stick Y
+        t, val, typ, num = struct.unpack("<IhBB", await _read_exact(jr, 8))
+        assert typ == JS_EVENT_AXIS and num == 1 and 8000 < val < 8300
+        await mgr.stop()
+
+    asyncio.run(run())
+
+
+def test_slow_client_does_not_block_fanout(tmp_path):
+    async def run():
+        srv = GamepadSocketServer(1, str(tmp_path))
+        await srv.start()
+        # connect but never read: kernel buffers absorb events; fanout
+        # must stay synchronous and non-blocking regardless
+        jr, _ = await asyncio.open_unix_connection(srv.js_path)
+        await _read_exact(jr, 1360)
+        for i in range(5000):
+            srv.report_axis(0, (i % 100) / 100.0)
+        await srv.stop()
+
+    asyncio.run(run())

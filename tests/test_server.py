@@ -49,6 +49,7 @@ class FakeCapture:
     def update_video_bitrate(self, kbps): ...
     def update_tunables(self, **kw): ...
     def update_capture_region(self, x, y, w, h): ...
+    def set_cursor_callback(self, cb): self.cursor_cb = cb
 
     def emit(self, n=1):
         for _ in range(n):
@@ -321,3 +322,26 @@ async def test_static_web_client_served(client_factory):
     assert r.status == 200 and "selkies-client.js" in body
     r = await c.get("/selkies-client.js")
     assert r.status == 200 and "SelkiesClient" in await r.text()
+
+
+async def test_cursor_broadcast_and_late_joiner(client_factory):
+    """XFixes cursor updates broadcast as cursor,{json}; late joiners get
+    the current cursor at handshake."""
+    import numpy as np
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    rgba = np.zeros((8, 8, 4), np.uint8); rgba[..., 3] = 255
+    svc._on_cursor({"rgba": rgba, "xhot": 2, "yhot": 3, "serial": 9})
+    msg = await ws.receive_str()
+    assert msg.startswith("cursor,")
+    body = json.loads(msg.split(",", 1)[1])
+    assert body["xhot"] == 2 and body["png_b64"]
+    # second client sees the cursor right after server_settings
+    await asyncio.sleep(0.6)  # reconnect debounce
+    ws2 = await c.ws_connect("/api/websockets")
+    await ws2.receive_str(); await ws2.receive_str()
+    msg2 = await ws2.receive_str()
+    assert msg2.startswith("cursor,")
+    await ws.close(); await ws2.close()
