@@ -150,6 +150,8 @@ class JpegEncoderSession:
         self._force_after_drop = False
         self._cap_gen = 0   # growth generation: pipelined frames encoded
         #                     with stale caps must not re-grow/re-jit
+        from .watermark import maybe_load
+        self._watermark = maybe_load(settings, g.width, g.height)
         self.update_quality(settings.jpeg_quality, settings.paint_over_quality)
 
     def _build_step(self):
@@ -188,6 +190,8 @@ class JpegEncoderSession:
         always in the buffer); accepted here for session-interface parity
         with the H.264 session."""
         del force
+        if self._watermark is not None:
+            frame = self._watermark.apply(frame)
         data, lens, send, is_paint, age, overflow = self._step(
             frame, self._prev, self._age,
             self._qy_m, self._qc_m, self._qy_p, self._qc_p)

@@ -147,6 +147,8 @@ class H264EncoderSession:
         pay, nb = hcodec.slice_header_events(g.mb_w, g.rows_per_stripe)
         self._hdr_pay = jnp.asarray(np.tile(pay, (g.n_stripes, 1)))
         self._hdr_nb = jnp.asarray(np.tile(nb, (g.n_stripes, 1)))
+        from .watermark import maybe_load
+        self._watermark = maybe_load(settings, g.width, g.height)
         self.qp = int(np.clip(settings.video_crf, 8, 48))
         self.paint_qp = int(np.clip(
             settings.video_min_qp, 8, self.qp))
@@ -183,6 +185,8 @@ class H264EncoderSession:
         if self._force_after_drop:
             self._force_after_drop = False
             force = True
+        if self._watermark is not None:
+            frame = self._watermark.apply(frame)
         data, row_lens, send, is_paint, age, sent, overflow = self._step(
             frame, self._prev, self._age, self._sent,
             jnp.int32(self.qp), jnp.int32(self.paint_qp),

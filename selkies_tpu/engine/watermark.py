@@ -1,0 +1,79 @@
+"""Device-side watermark burn-in (reference: pixelflux burns a PNG into
+the framebuffer before encode — settings watermark_path/location,
+display_utils.py:1674-1679).
+
+The PNG loads once per session; per frame a small jitted alpha-blend
+rewrites the anchored region on device before the encode step.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger("selkies_tpu.engine.watermark")
+
+# location enum (reference parity): 0 tl, 1 tr, 2 bl, 3 br, 4 center,
+# 5 top-center, 6 bottom-right (default)
+_MARGIN = 16
+
+
+def _anchor(loc: int, fw: int, fh: int, ww: int, wh: int) -> tuple[int, int]:
+    x_left, x_mid, x_right = _MARGIN, (fw - ww) // 2, fw - ww - _MARGIN
+    y_top, y_mid, y_bot = _MARGIN, (fh - wh) // 2, fh - wh - _MARGIN
+    table = {0: (y_top, x_left), 1: (y_top, x_right),
+             2: (y_bot, x_left), 3: (y_bot, x_right),
+             4: (y_mid, x_mid), 5: (y_top, x_mid), 6: (y_bot, x_right)}
+    y0, x0 = table.get(loc, table[6])
+    return max(0, y0), max(0, x0)
+
+
+@functools.cache
+def _blender(y0: int, x0: int, wh: int, ww: int):
+    def blend(frame, wm_rgb, wm_a):
+        region = jax.lax.dynamic_slice(
+            frame, (y0, x0, 0), (wh, ww, 3)).astype(jnp.float32)
+        out = region * (1.0 - wm_a) + wm_rgb * wm_a
+        out = jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+        return jax.lax.dynamic_update_slice(frame, out, (y0, x0, 0))
+    return jax.jit(blend)
+
+
+class Watermark:
+    """Loaded watermark bound to a frame geometry; ``apply(frame)``."""
+
+    def __init__(self, path: str, location: int, frame_w: int, frame_h: int):
+        from PIL import Image
+        img = Image.open(path).convert("RGBA")
+        # shrink to fit a quarter of the frame at most
+        max_w, max_h = max(frame_w // 4, 8), max(frame_h // 4, 8)
+        if img.width > max_w or img.height > max_h:
+            img.thumbnail((max_w, max_h))
+        rgba = np.asarray(img, np.uint8)
+        self.wh, self.ww = rgba.shape[0], rgba.shape[1]
+        self._rgb = jnp.asarray(rgba[..., :3].astype(np.float32))
+        self._a = jnp.asarray(
+            (rgba[..., 3:4].astype(np.float32)) / 255.0)
+        self._y0, self._x0 = _anchor(location, frame_w, frame_h,
+                                     self.ww, self.wh)
+        self._fn = _blender(self._y0, self._x0, self.wh, self.ww)
+
+    def apply(self, frame: jnp.ndarray) -> jnp.ndarray:
+        return self._fn(frame, self._rgb, self._a)
+
+
+def maybe_load(settings, frame_w: int, frame_h: int):
+    """-> Watermark or None; load failures degrade with a log."""
+    path = getattr(settings, "watermark_path", "")
+    if not path:
+        return None
+    try:
+        return Watermark(path, int(getattr(settings, "watermark_location", 6)),
+                         frame_w, frame_h)
+    except Exception as e:
+        logger.warning("watermark %s unusable: %s", path, e)
+        return None

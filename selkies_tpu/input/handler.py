@@ -216,6 +216,9 @@ class InputHandler:
             data, mime = self.backend.get_clipboard()
             await self.send_clipboard(data, mime)
 
+    # reference clients ask with the long verb (SURVEY §2.3)
+    _v_REQUEST_CLIPBOARD = _v_cr
+
     async def _v_cws(self, args: str) -> None:
         self._multipart = {"mime": "text/plain", "parts": [], "size": 0}
 

@@ -1,0 +1,138 @@
+"""Multi-seat capture: one device-mesh encode step driving N desktop
+displays (the server-side consumer of parallel/seats.py).
+
+API-compatible with engine.capture.ScreenCapture so the WS service can
+treat it as just another capture module; emitted chunks carry
+``display_id="seat{N}"`` and the service's per-display relays route them
+(SURVEY.md §2.5 multi-seat row — the reference scales by running N
+containers; here one process + one sharded program serves N seats).
+
+Seat content is synthetic for now (one X display per seat is a deployment
+concern — each seat would own an X server in its own namespace); the
+encode/fan-out path is the real one.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ..engine.types import CaptureSettings, EncodedChunk
+from .seats import MultiSeatEncoder, synthetic_seat_frames
+
+logger = logging.getLogger("selkies_tpu.parallel.capture")
+
+
+class MultiSeatCapture:
+    """ScreenCapture-compatible facade over MultiSeatEncoder."""
+
+    def __init__(self, n_seats: int):
+        self.n_seats = n_seats
+        self._thread: Optional[threading.Thread] = None
+        self._running = threading.Event()
+        self._callback: Optional[Callable[[EncodedChunk], None]] = None
+        self._settings: Optional[CaptureSettings] = None
+        self._enc: Optional[MultiSeatEncoder] = None
+        self._force_idr = threading.Event()
+        self._cursor_callback = None
+        self._api_lock = threading.RLock()
+        self.encoded_fps = 0.0
+        self.last_frame_bytes = 0
+
+    # ----------------------------------------------------- reference surface
+    def start_capture(self, callback, settings: CaptureSettings) -> None:
+        with self._api_lock:
+            if self.is_capturing():
+                self.stop_capture()
+            self._callback = callback
+            self._settings = settings
+            self._enc = MultiSeatEncoder(settings, self.n_seats)
+            self._running.set()
+            self._thread = threading.Thread(
+                target=self._run, name="tpuflux-seats", daemon=True)
+            self._thread.start()
+
+    def stop_capture(self) -> None:
+        with self._api_lock:
+            self._running.clear()
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+                self._thread = None
+
+    def is_capturing(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def request_idr_frame(self) -> None:
+        self._force_idr.set()
+
+    def update_framerate(self, fps: float) -> None:
+        if self._settings:
+            self._settings.target_fps = float(fps)
+
+    def update_video_bitrate(self, kbps: int) -> None:
+        if self._settings:
+            self._settings.video_bitrate_kbps = int(kbps)
+
+    def update_tunables(self, **kw) -> None:
+        enc = self._enc
+        if enc and ("jpeg_quality" in kw or "paint_over_quality" in kw):
+            enc.update_quality(kw.get("jpeg_quality",
+                                      enc.settings.jpeg_quality),
+                               kw.get("paint_over_quality"))
+
+    def update_capture_region(self, x: int, y: int, w: int, h: int) -> None:
+        assert self._settings is not None
+        if (w, h) != (self._settings.capture_width,
+                      self._settings.capture_height):
+            self._settings.capture_width = w
+            self._settings.capture_height = h
+            if self._callback is not None:
+                self.start_capture(self._callback, self._settings)
+
+    def set_cursor_callback(self, cb) -> None:
+        self._cursor_callback = cb
+
+    def restart(self, settings: Optional[CaptureSettings] = None) -> None:
+        with self._api_lock:
+            if self._callback is None:
+                raise RuntimeError("restart before start_capture")
+            self.start_capture(self._callback, settings or self._settings)
+
+    # ------------------------------------------------------------------ loop
+    def _run(self) -> None:
+        assert self._settings and self._enc
+        s, enc = self._settings, self._enc
+        tick = 0
+        window_frames, window_start = 0, time.monotonic()
+        try:
+            while self._running.is_set():
+                t0 = time.monotonic()
+                frames = synthetic_seat_frames(enc, tick)
+                force = self._force_idr.is_set()
+                if force:
+                    self._force_idr.clear()
+                per_seat = enc.finalize(enc.encode(frames),
+                                        force_all=force or tick == 0)
+                cb = self._callback
+                nbytes = 0
+                for chunks in per_seat:
+                    for c in chunks:
+                        nbytes += len(c.payload)
+                        if cb is not None:
+                            cb(c)
+                self.last_frame_bytes = nbytes
+                tick += 1
+                window_frames += 1
+                now = time.monotonic()
+                if now - window_start >= 1.0:
+                    self.encoded_fps = window_frames / (now - window_start)
+                    window_frames, window_start = 0, now
+                sleep = 1.0 / max(s.target_fps, 1.0) - (time.monotonic() - t0)
+                if sleep > 0:
+                    time.sleep(sleep)
+        except Exception:
+            logger.exception("multi-seat capture loop died")
+        finally:
+            self._running.clear()

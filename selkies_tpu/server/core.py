@@ -48,6 +48,7 @@ class BaseStreamingService(abc.ABC):
     """Transport service contract (reference stream_server.py:372-387)."""
 
     name: str = "base"
+    core: "Optional[CentralizedStreamServer]" = None  # set on register
 
     @abc.abstractmethod
     async def start(self) -> None: ...
@@ -74,6 +75,8 @@ class CentralizedStreamServer:
         self._ssl_ctx: Optional[ssl.SSLContext] = None
         self._cert_watch_task: Optional[asyncio.Task] = None
         self.started_at = time.time()
+        #: secure-mode WS tokens: token -> {role, created, uses}
+        self.ws_tokens: dict[str, dict] = {}
         self._setup_routes()
 
     # ------------------------------------------------------------------ auth
@@ -137,6 +140,10 @@ class CentralizedStreamServer:
         r.add_get("/api/status", self.handle_status)
         r.add_get("/api/health", self.handle_health)
         r.add_post("/api/switch", self.handle_switch)
+        if self.settings.secure_api:
+            r.add_post("/api/tokens", self.handle_mint_token)
+            r.add_get("/api/tokens", self.handle_list_tokens)
+            r.add_delete("/api/tokens", self.handle_revoke_token)
         if self.settings.enable_metrics:
             r.add_get("/api/metrics", self.handle_metrics)
         if self.settings.enable_file_transfer:
@@ -185,6 +192,69 @@ class CentralizedStreamServer:
             return web.Response(status=400, text=f"unknown mode {mode!r}")
         await self.switch_to_mode(mode)
         return web.json_response({"mode": self.active_mode})
+
+    # ---------------------------------------------------------------- tokens
+    TOKEN_TTL_S = 24 * 3600
+    TOKEN_CAP = 512
+
+    def _prune_tokens(self) -> None:
+        cutoff = time.time() - self.TOKEN_TTL_S
+        for t in [t for t, m in self.ws_tokens.items()
+                  if m["created"] < cutoff]:
+            del self.ws_tokens[t]
+        while len(self.ws_tokens) > self.TOKEN_CAP:  # oldest-first overflow
+            self.ws_tokens.pop(next(iter(self.ws_tokens)))
+
+    async def handle_mint_token(self, request: web.Request) -> web.Response:
+        """Secure-token mode (reference /api/tokens, selkies.py:4516-4550):
+        a full-authority caller mints role-carrying WS tokens; clients
+        present them as ?token= on the WS endpoint. Tokens expire after
+        TOKEN_TTL_S and can be revoked with DELETE."""
+        if request["role"] != "full":
+            return web.Response(status=403, text="view-only")
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        role = body.get("role", "full")
+        if role not in ("full", "viewonly"):
+            return web.Response(status=400, text="role must be full|viewonly")
+        import secrets
+        self._prune_tokens()
+        token = secrets.token_urlsafe(24)
+        self.ws_tokens[token] = {"role": role,
+                                 "created": time.time(),
+                                 "uses": 0}
+        return web.json_response({"token": token, "role": role,
+                                  "ttl_s": self.TOKEN_TTL_S})
+
+    async def handle_list_tokens(self, request: web.Request) -> web.Response:
+        if request["role"] != "full":
+            return web.Response(status=403, text="view-only")
+        self._prune_tokens()
+        return web.json_response({
+            "tokens": [{"token": t[:6] + "…", "role": m["role"],
+                        "uses": m["uses"]}
+                       for t, m in self.ws_tokens.items()]})
+
+    async def handle_revoke_token(self, request: web.Request) -> web.Response:
+        if request["role"] != "full":
+            return web.Response(status=403, text="view-only")
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        revoked = self.ws_tokens.pop(body.get("token", ""), None)
+        return web.json_response({"revoked": revoked is not None})
+
+    def check_ws_token(self, token: str) -> Optional[str]:
+        """-> role for a live minted token, else None (timing-safe)."""
+        self._prune_tokens()
+        for t, meta in self.ws_tokens.items():
+            if _timing_safe_eq(t, token):
+                meta["uses"] += 1
+                return meta["role"]
+        return None
 
     # ---------------------------------------------------------------- upload
     def _transfer_root(self) -> pathlib.Path:
@@ -265,6 +335,7 @@ class CentralizedStreamServer:
     # -------------------------------------------------------------- services
     def register_service(self, name: str, service: BaseStreamingService) -> None:
         self.services[name] = service
+        service.core = self          # back-ref for token checks etc.
         service.register_routes(self.app)
 
     async def switch_to_mode(self, mode: str) -> None:

@@ -100,3 +100,56 @@ describe("selkies_clients", "Connected clients")
 describe("selkies_bytes_sent_total", "Media bytes sent")
 describe("selkies_frames_encoded_total", "Frames encoded")
 describe("selkies_backpressure_events_total", "ACK backpressure activations")
+
+
+_device_cache: list | None = None
+
+
+def device_stats() -> list[dict]:
+    """Accelerator telemetry — the TPU-era equivalent of the reference's
+    vendor-spanning gpu_stats.py (NVML/aitop/sysfs): per-device HBM
+    in-use/limit plus utilisation-proxy gauges from the JAX runtime.
+
+    BLOCKING (jax import on first call, runtime RPCs per device): callers
+    on an event loop must run it in an executor (the ws stats loop does).
+    memory_stats() issues a runtime RPC that would CONTEND with the encode
+    thread's device calls (fatal on single-client relay transports), so it
+    is only queried on the cpu backend or with SELKIES_DEVICE_MEMSTATS=1.
+    """
+    import os
+    global _device_cache
+    try:
+        import jax
+        if _device_cache is None:
+            _device_cache = list(jax.local_devices())
+        want_mem = os.environ.get("SELKIES_DEVICE_MEMSTATS") == "1"
+        out = []
+        for d in _device_cache:
+            ms = {}
+            if want_mem or d.platform == "cpu":
+                try:
+                    ms = d.memory_stats() or {}
+                except Exception:
+                    pass
+            in_use = int(ms.get("bytes_in_use", 0))
+            limit = int(ms.get("bytes_limit", 0) or ms.get("bytes_reservable_limit", 0))
+            out.append({
+                "id": d.id,
+                "platform": d.platform,
+                "kind": getattr(d, "device_kind", "?"),
+                "mem_in_use": in_use,
+                "mem_limit": limit,
+                "mem_pct": round(100.0 * in_use / limit, 1) if limit else 0.0,
+            })
+            set_gauge("selkies_device_mem_bytes", in_use,
+                      {"device": str(d.id), "platform": d.platform})
+            if limit:
+                set_gauge("selkies_device_mem_limit_bytes", limit,
+                          {"device": str(d.id), "platform": d.platform})
+        return out
+    except Exception:
+        return []
+
+
+describe("selkies_device_mem_bytes", "Accelerator memory in use")
+describe("selkies_device_mem_limit_bytes", "Accelerator memory limit")

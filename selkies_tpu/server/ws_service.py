@@ -66,11 +66,13 @@ class _FpsEstimator:
 class ClientConnection:
     _next_id = 0
 
-    def __init__(self, ws: web.WebSocketResponse, role: str, raddr: str):
+    def __init__(self, ws: web.WebSocketResponse, role: str, raddr: str,
+                 display: str = ":0"):
         ClientConnection._next_id += 1
         self.id = ClientConnection._next_id
         self.ws = ws
         self.role = role                  # 'full' | 'viewonly'
+        self.display = display            # the display this client views
         self.raddr = raddr
         self.gzip_ok = False
         self.video_active = False
@@ -103,6 +105,7 @@ class WebSocketsService(BaseStreamingService):
         self.clients: dict[int, ClientConnection] = {}
         self.captures: dict[str, ScreenCapture] = {}
         self.display_geometry: dict[str, tuple[int, int]] = {}
+        self._custom_factory = capture_factory is not None
         self._capture_factory = capture_factory or (lambda: ScreenCapture("auto"))
         self.input_handler = input_handler
         self.audio = audio_pipeline
@@ -113,6 +116,7 @@ class WebSocketsService(BaseStreamingService):
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._running = False
         self._bg_tasks: set[asyncio.Task] = set()
+        self._starting_captures: set[str] = set()
         self._last_conn_by_ip: dict[str, float] = {}
         self._grace_task: Optional[asyncio.Task] = None
         self._stats_task: Optional[asyncio.Task] = None
@@ -121,9 +125,42 @@ class WebSocketsService(BaseStreamingService):
     def register_routes(self, app: web.Application) -> None:
         app.router.add_get("/api/websockets", self.ws_endpoint)
 
+    @property
+    def _seats(self) -> int:
+        return max(1, int(getattr(self.settings, "tpu_seats", 1)))
+
+    def _default_display(self) -> str:
+        return "seat0" if self._seats > 1 else self.settings.display_id
+
     async def start(self) -> None:
         self._loop = asyncio.get_event_loop()
         self._running = True
+        if self.input_handler is not None \
+                and self.input_handler.send_clipboard is None:
+            async def _push_clipboard(data: bytes, mime: str) -> None:
+                # clipboard contents go ONLY to input-authorized clients
+                # (view-only is denied the request verb; it must not get
+                # the payload by broadcast either), and only when the
+                # server->client direction is enabled
+                if self.settings.enable_clipboard not in ("both", "out"):
+                    return
+                import base64
+                msg = "clipboard," + base64.b64encode(data).decode()
+                for c in list(self.clients.values()):
+                    if c.role != "full":
+                        continue
+                    try:
+                        await asyncio.wait_for(c.send_text_maybe_gz(msg),
+                                               CONTROL_SEND_TIMEOUT_S)
+                    except (asyncio.TimeoutError, ConnectionError,
+                            RuntimeError, OSError):
+                        pass
+            self.input_handler.send_clipboard = _push_clipboard
+        if self._seats > 1 and not self.display_geometry:
+            # multi-seat: one display entry per seat, one sharded capture
+            for i in range(self._seats):
+                self.display_geometry[f"seat{i}"] = (
+                    self.settings.initial_width, self.settings.initial_height)
         if self.input_handler is not None:
             self.input_handler.start()
         if self.audio is not None:
@@ -169,10 +206,17 @@ class WebSocketsService(BaseStreamingService):
         return "server_settings " + json.dumps(payload)
 
     # --------------------------------------------------------------- capture
+    def _capture_geometry(self, display_id: str) -> tuple[int, int]:
+        s = self.settings
+        if display_id == "__seats__":
+            # seats share one geometry; any seat entry carries it
+            display_id = "seat0"
+        return self.display_geometry.get(
+            display_id, (s.initial_width, s.initial_height))
+
     def _capture_settings(self, display_id: str) -> CaptureSettings:
         s = self.settings
-        w, h = self.display_geometry.get(
-            display_id, (s.initial_width, s.initial_height))
+        w, h = self._capture_geometry(display_id)
         return CaptureSettings(
             single_stream=(s.encoder == "h264-tpu"),
             capture_width=w, capture_height=h,
@@ -195,11 +239,19 @@ class WebSocketsService(BaseStreamingService):
 
     def _ensure_capture(self, display_id: str) -> None:
         if any(c.video_active for c in self.clients.values()):
+            # multi-seat: ONE sharded capture feeds every seat display
+            if self._seats > 1:
+                display_id = "__seats__"
             cap = self.captures.get(display_id)
             if cap is None:
-                cap = self._capture_factory()
+                if display_id == "__seats__" and not self._custom_factory:
+                    from ..parallel.capture import MultiSeatCapture
+                    cap = MultiSeatCapture(self._seats)
+                else:
+                    cap = self._capture_factory()
                 self.captures[display_id] = cap
-            if not cap.is_capturing():
+            if not cap.is_capturing() \
+                    and display_id not in self._starting_captures:
                 loop = self._loop
                 assert loop is not None
 
@@ -213,8 +265,33 @@ class WebSocketsService(BaseStreamingService):
 
                 if self.settings.enable_cursors:
                     cap.set_cursor_callback(cursor_cb)
-                cap.start_capture(cb, self._capture_settings(display_id))
-                logger.info("capture started for display %s", display_id)
+                # session construction does device transfers/mesh setup:
+                # off the loop, guarded against double-dispatch
+                self._starting_captures.add(display_id)
+                cs = self._capture_settings(display_id)
+
+                def _start():
+                    try:
+                        cap.start_capture(cb, cs)
+                        logger.info("capture started for display %s",
+                                    display_id)
+                        # a resize may have landed while the session was
+                        # constructing (is_capturing() was False then, so
+                        # _h_resize skipped it): reconcile to the CURRENT
+                        # geometry before handing the thread back
+                        cur = self._capture_geometry(display_id)
+                        if cur != (cs.capture_width, cs.capture_height):
+                            cap.update_capture_region(0, 0, *cur)
+                    except Exception:
+                        logger.exception(
+                            "capture start failed for display %s "
+                            "(clients will see no video until the next "
+                            "START_VIDEO)", display_id)
+                    finally:
+                        loop.call_soon_threadsafe(
+                            self._starting_captures.discard, display_id)
+
+                loop.run_in_executor(None, _start)
 
     def _maybe_stop_captures(self) -> None:
         """Stop capture after the reconnect grace window if nobody watches
@@ -312,6 +389,17 @@ class WebSocketsService(BaseStreamingService):
         role = request.get("role", "full")
         raddr = request.remote or "?"
 
+        # secure-token mode: the HTTP-auth role is not enough — the client
+        # must present a minted token (reference selkies.py:2147-2200)
+        if self.settings.secure_api:
+            core = getattr(self, "core", None)
+            token_role = core.check_ws_token(
+                request.query.get("token", "")) if core else None
+            if token_role is None:
+                await ws.close(code=4401, message=b"token required")
+                return ws
+            role = token_role
+
         # reconnect debounce per IP (reference selkies.py:2202-2217)
         now = time.monotonic()
         last = self._last_conn_by_ip.get(raddr, 0.0)
@@ -324,7 +412,14 @@ class WebSocketsService(BaseStreamingService):
             await ws.close(code=4000, message=b"sharing disabled")
             return ws
 
-        client = ClientConnection(ws, role, raddr)
+        # validate ?display= against KNOWN displays always — an arbitrary
+        # string must never become a capture key (it would spawn a whole
+        # extra pipeline per distinct value)
+        display = request.query.get("display") or self._default_display()
+        known = set(self.display_geometry) or {self._default_display()}
+        if display not in known:
+            display = self._default_display()
+        client = ClientConnection(ws, role, raddr, display=display)
         # only the first full client gets input authority unless collab
         if role == "full" and not self.settings.enable_collab:
             if any(c.role == "full" for c in self.clients.values()):
@@ -332,6 +427,8 @@ class WebSocketsService(BaseStreamingService):
         self.clients[client.id] = client
         metrics.set_gauge("selkies_clients", len(self.clients))
         logger.info("client %d connected (%s, %s)", client.id, client.role, raddr)
+        if len(self.clients) == 1 and self.settings.run_after_connect:
+            self._fire_hook(self.settings.run_after_connect)
 
         try:
             await ws.send_str("MODE websockets")
@@ -361,6 +458,27 @@ class WebSocketsService(BaseStreamingService):
         metrics.set_gauge("selkies_clients", len(self.clients))
         self._maybe_stop_captures()
         logger.info("client %d disconnected", client.id)
+        if not self.clients and self.settings.run_after_disconnect:
+            self._fire_hook(self.settings.run_after_disconnect)
+
+    def _fire_hook(self, cmd: str) -> None:
+        """First-connect / last-disconnect lifecycle hooks (reference
+        run_after_connect/disconnect, stream_server.py). Fire-and-forget
+        as an independent task: _disconnect runs inside a ws handler that
+        is being CANCELLED during connection teardown, so awaiting the
+        subprocess there would lose the hook to the cancellation."""
+        async def _run():
+            try:
+                proc = await asyncio.create_subprocess_shell(
+                    cmd, stdout=asyncio.subprocess.DEVNULL,
+                    stderr=asyncio.subprocess.DEVNULL)
+                await proc.wait()
+            except OSError as e:
+                logger.warning("lifecycle hook failed: %s", e)
+
+        task = asyncio.create_task(_run())
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     # -------------------------------------------------------------- messages
     async def _on_binary(self, client: ClientConnection, data: bytes) -> None:
@@ -495,16 +613,18 @@ class WebSocketsService(BaseStreamingService):
 
     async def _h_start_video(self, client: ClientConnection, args: str) -> None:
         client.video_active = True
-        for did in (self.display_geometry or {self.settings.display_id: None}):
-            if did not in client.relays:
-                relay = VideoRelay(
-                    client.ws.send_bytes,
-                    budget_bytes=int(self.settings.video_relay_budget_s
-                                     * self.settings.video_bitrate_kbps * 125),
-                    request_idr=lambda d=did: self._request_idr(d))
-                relay.start()
-                client.relays[did] = relay
-            self._ensure_capture(did)
+        # each client views ONE display (its ?display= pin); multi-seat
+        # clients on different seats share the single sharded capture
+        did = client.display
+        if did not in client.relays:
+            relay = VideoRelay(
+                client.ws.send_bytes,
+                budget_bytes=int(self.settings.video_relay_budget_s
+                                 * self.settings.video_bitrate_kbps * 125),
+                request_idr=lambda d=did: self._request_idr(d))
+            relay.start()
+            client.relays[did] = relay
+        self._ensure_capture(did)
         # fresh joiner needs a full frame
         self._request_idr_all()
         await client.ws.send_str("VIDEO_STARTED")
@@ -518,7 +638,8 @@ class WebSocketsService(BaseStreamingService):
         await client.ws.send_str("VIDEO_STOPPED")
 
     def _request_idr(self, display_id: str) -> None:
-        cap = self.captures.get(display_id)
+        cap = self.captures.get(display_id) \
+            or self.captures.get("__seats__")
         if cap:
             cap.request_idr_frame()
 
@@ -548,10 +669,17 @@ class WebSocketsService(BaseStreamingService):
             w, h = (int(v) for v in args.lower().split("x"))
         except ValueError:
             return
-        did = self.settings.display_id
-        self.display_geometry[did] = (max(64, min(w, 16384)),
-                                      max(64, min(h, 16384)))
-        geo = self.display_geometry[did]
+        # resize the CLIENT'S display, never a phantom entry; in multi-seat
+        # mode the sharded capture is shared, so every seat resizes together
+        did = client.display
+        if did not in self.display_geometry and self.display_geometry:
+            did = self._default_display()
+        geo = (max(64, min(w, 16384)), max(64, min(h, 16384)))
+        if self._seats > 1:
+            for seat_did in self.display_geometry:
+                self.display_geometry[seat_did] = geo
+        else:
+            self.display_geometry[did] = geo
         # resize the REAL X screen first (CVT-RB modeline via xrandr,
         # reference display_utils.py:223-1076); headless setups skip this
         # and only the capture geometry changes
@@ -559,7 +687,8 @@ class WebSocketsService(BaseStreamingService):
                 and self.display_manager.available():
             await self.display_manager.resize(*geo,
                                               float(self.settings.framerate))
-        cap = self.captures.get(did)
+        cap = self.captures.get(did) if self._seats == 1 \
+            else self.captures.get("__seats__")
         if cap and cap.is_capturing():
             # size change rebuilds the capture session (joins a thread):
             # never on the event loop
@@ -643,6 +772,10 @@ class WebSocketsService(BaseStreamingService):
                     "encoded_fps": {
                         did: cap.encoded_fps
                         for did, cap in self.captures.items()},
+                    # TPU/accelerator telemetry (gpu_stats.py equivalent);
+                    # executor: device queries must not stall the loop
+                    "devices": await asyncio.get_running_loop()
+                    .run_in_executor(None, metrics.device_stats),
                 }
                 await self._broadcast_control("system_stats " + json.dumps(stats))
             except Exception:

@@ -214,6 +214,13 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
     _s("rtc_config_file", SType.STR, "", "Trusted JSON ICE-server file."),
     _s("webrtc_public_ip", SType.STR, "", "NAT1TO1 public IP substitution."),
 
+    # --- lifecycle hooks ----------------------------------------------------
+    _s("run_after_connect", SType.STR, "",
+       "Shell command spawned when the FIRST client connects "
+       "(reference stream_server.py run_after_connect hook)."),
+    _s("run_after_disconnect", SType.STR, "",
+       "Shell command spawned when the LAST client disconnects."),
+
     # --- metrics ------------------------------------------------------------
     _s("enable_metrics", SType.BOOL, True, "Prometheus /api/metrics endpoint."),
     _s("stats_interval_s", SType.FLOAT, 5.0, "Per-client system stats cadence."),

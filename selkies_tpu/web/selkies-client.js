@@ -407,6 +407,12 @@ class SelkiesClient {
       const text = e.clipboardData && e.clipboardData.getData("text");
       if (text) this.send(`cw,${btoa(unescape(encodeURIComponent(text)))}`);
     });
+    document.addEventListener("copy", () => {
+      // fetch the REMOTE clipboard; delayed so the forwarded Ctrl+C
+      // keystroke reaches the remote app BEFORE the server reads its
+      // selection (otherwise the reply is the previous clipboard)
+      setTimeout(() => this.send("REQUEST_CLIPBOARD"), 150);
+    });
 
     window.addEventListener("message", (e) => this._onDashboardMessage(e));
   }

@@ -175,3 +175,32 @@ def test_keyframe_interval_forces_periodic_refresh():
     cap.stop_capture()
     fids = {c.frame_id for c in got}
     assert len(fids) >= 2, f"no periodic refresh: frame ids {fids}"
+
+
+def test_watermark_burned_into_stream(tmp_path):
+    """watermark_path burns a PNG into the encoded frames on device
+    (reference pixelflux watermark, display_utils.py:1674-1679)."""
+    from PIL import Image as PILImage
+    wm = np.zeros((16, 16, 4), np.uint8)
+    wm[..., 0] = 255          # solid red
+    wm[..., 3] = 255
+    p = tmp_path / "wm.png"
+    PILImage.fromarray(wm, "RGBA").save(p)
+
+    base = CaptureSettings(**SMALL)
+    marked = CaptureSettings(**SMALL)
+    marked.watermark_path = str(p)
+    marked.watermark_location = 0     # top-left
+    a = JpegEncoderSession(base)
+    b = JpegEncoderSession(marked)
+    src = SyntheticSource(a.grid.width, a.grid.height)
+    frame = src.get_frame(0)
+    plain = a.finalize(a.encode(frame), force_all=True)
+    stamped = b.finalize(b.encode(frame), force_all=True)
+    img_p = Image.open(io.BytesIO(plain[0].payload)); img_p.load()
+    img_s = Image.open(io.BytesIO(stamped[0].payload)); img_s.load()
+    # the anchored region must turn red-dominant in the stamped stream
+    rp = np.asarray(img_p)[16:32, 16:32]
+    rs = np.asarray(img_s)[16:32, 16:32]
+    assert not np.array_equal(rp, rs)
+    assert rs[..., 0].mean() > 200 and rs[..., 1].mean() < 80

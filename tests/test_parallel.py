@@ -95,3 +95,29 @@ def test_dryrun_multichip_entrypoint():
     fn, args = mod.entry()
     out = fn(*args)
     assert len(out) == 6
+
+
+def test_multiseat_capture_thread_serves_all_seats():
+    """The server-facing capture facade: one sharded encode loop emits
+    decodable chunks for every seat display."""
+    import time
+
+    from PIL import Image
+
+    from selkies_tpu.parallel.capture import MultiSeatCapture
+
+    got = []
+    cap = MultiSeatCapture(4)
+    cap.start_capture(
+        got.append,
+        CaptureSettings(capture_width=64, capture_height=64,
+                        stripe_height=32, target_fps=60.0))
+    deadline = time.time() + 60
+    while time.time() < deadline and \
+            len({c.display_id for c in got}) < 4:
+        time.sleep(0.1)
+    cap.stop_capture()
+    seats = {c.display_id for c in got}
+    assert seats == {"seat0", "seat1", "seat2", "seat3"}
+    for c in got[:4]:
+        Image.open(io.BytesIO(c.payload)).load()

@@ -345,3 +345,125 @@ async def test_cursor_broadcast_and_late_joiner(client_factory):
     msg2 = await ws2.receive_str()
     assert msg2.startswith("cursor,")
     await ws.close(); await ws2.close()
+
+
+async def test_secure_token_mode(client_factory):
+    """secure_api: WS requires a minted token; /api/tokens mints them
+    (reference /api/tokens + secure-mode gate, selkies.py:4516-4550)."""
+    server, svc, fake, _ = make_app(
+        secure_api=True, enable_basic_auth=True,
+        basic_auth_user="u", basic_auth_password="pw")
+    c = await client_factory(server)
+    hdr = {"Authorization": "Basic " + base64.b64encode(b"u:pw").decode()}
+    # no token -> connection refused with 4401
+    ws = await c.ws_connect("/api/websockets", headers=hdr)
+    await ws.receive()
+    assert ws.close_code == 4401
+    # mint a viewonly token and use it
+    r = await c.post("/api/tokens", json={"role": "viewonly"}, headers=hdr)
+    assert r.status == 200
+    tok = (await r.json())["token"]
+    await asyncio.sleep(0.6)   # reconnect debounce
+    ws = await c.ws_connect(f"/api/websockets?token={tok}", headers=hdr)
+    assert (await ws.receive_str()) == "MODE websockets"
+    assert [cl.role for cl in svc.clients.values()] == ["viewonly"]
+    # token list is redacted
+    r = await c.get("/api/tokens", headers=hdr)
+    body = await r.json()
+    assert body["tokens"][0]["token"].endswith("…")
+    await ws.close()
+
+
+async def test_stats_include_device_telemetry(client_factory):
+    server, svc, fake, _ = make_app(stats_interval_s=0.2)
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    import gzip as _gz
+    for _ in range(20):
+        msg = await asyncio.wait_for(ws.receive(), 5)
+        text = msg.data
+        if msg.type == WSMsgType.BINARY and msg.data[0] == P.OP_GZ_CONTROL:
+            text = _gz.decompress(msg.data[1:]).decode()
+        if isinstance(text, str) and text.startswith("system_stats"):
+            stats = json.loads(text.split(" ", 1)[1])
+            assert "devices" in stats
+            assert stats["devices"][0]["platform"] == "cpu"
+            break
+    else:
+        raise AssertionError("no system_stats seen")
+    await ws.close()
+
+
+async def test_multiseat_displays_route_per_client(client_factory):
+    """tpu_seats>1: displays seat0..N-1 are advertised; each client views
+    its ?display= pin and receives only that seat's chunks."""
+    server, svc, fake, _ = make_app(tpu_seats=2)
+    c = await client_factory(server)
+    ws0 = await c.ws_connect("/api/websockets?display=seat0")
+    await ws0.receive_str()
+    payload = json.loads((await ws0.receive_str()).split(" ", 1)[1])
+    assert [d["id"] for d in payload["displays"]] == ["seat0", "seat1"]
+    await asyncio.sleep(0.6)
+    ws1 = await c.ws_connect("/api/websockets?display=seat1")
+    await ws1.receive_str(); await ws1.receive_str()
+    await ws0.send_str("START_VIDEO")
+    await ws1.send_str("START_VIDEO")
+    await asyncio.sleep(0.1)
+    # the custom factory stands in for the sharded capture; emit per-seat
+    for seat in (0, 1):
+        fake._cb(EncodedChunk(
+            payload=b"\xff\xd8SEAT%d\xff\xd9" % seat, frame_id=seat,
+            stripe_y=0, width=64, height=64, is_idr=True,
+            output_mode="jpeg", display_id=f"seat{seat}"))
+    got0 = got1 = None
+    for _ in range(12):
+        m = await asyncio.wait_for(ws0.receive(), 3)
+        if m.type == WSMsgType.BINARY and m.data[0] == P.OP_JPEG:
+            got0 = m.data; break
+    for _ in range(12):
+        m = await asyncio.wait_for(ws1.receive(), 3)
+        if m.type == WSMsgType.BINARY and m.data[0] == P.OP_JPEG:
+            got1 = m.data; break
+    assert got0 and b"SEAT0" in got0
+    assert got1 and b"SEAT1" in got1
+    await ws0.close(); await ws1.close()
+
+
+async def test_lifecycle_hooks_fire(client_factory, tmp_path):
+    marker = tmp_path / "connected"
+    marker2 = tmp_path / "disconnected"
+    server, svc, fake, _ = make_app(
+        run_after_connect=f"touch {marker}",
+        run_after_disconnect=f"touch {marker2}")
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    for _ in range(40):
+        if marker.exists():
+            break
+        await asyncio.sleep(0.05)
+    assert marker.exists()
+    await ws.close()
+    for _ in range(40):
+        if marker2.exists():
+            break
+        await asyncio.sleep(0.05)
+    assert marker2.exists()
+
+
+async def test_request_clipboard_pushes_to_clients(client_factory):
+    server, svc, fake, handler = make_app()
+    handler.backend.clipboard = (b"remote text", "text/plain")
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("REQUEST_CLIPBOARD")
+    for _ in range(10):
+        msg = await asyncio.wait_for(ws.receive_str(), 5)
+        if msg.startswith("clipboard,"):
+            assert base64.b64decode(msg.split(",", 1)[1]) == b"remote text"
+            break
+    else:
+        raise AssertionError("no clipboard push")
+    await ws.close()

@@ -1,0 +1,143 @@
+"""WebRTC signaling + TURN chain tests (protocol-level WS simulators,
+no aiortc required)."""
+
+import asyncio
+import base64
+import hashlib
+import hmac as hmac_mod
+import json
+import os
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from selkies_tpu.server.signaling import SignalingServer
+from selkies_tpu.server.turn import (get_rtc_configuration,
+                                     hmac_turn_credential,
+                                     load_rtc_config_file)
+from selkies_tpu.settings import AppSettings
+
+
+def _settings(**kw):
+    s = AppSettings.parse([], {})
+    for k, v in kw.items():
+        s.set_server(k, v)
+    return s
+
+
+def test_hmac_turn_credential_matches_coturn_scheme():
+    user, cred = hmac_turn_credential("s3cret", "alice", ttl_s=600,
+                                      now=1_000_000)
+    assert user == "1000600:alice"
+    expect = base64.b64encode(
+        hmac_mod.new(b"s3cret", user.encode(), hashlib.sha1).digest()
+    ).decode()
+    assert cred == expect
+
+
+def test_rtc_config_chain_legacy_and_hmac():
+    async def run():
+        cfg = await get_rtc_configuration(_settings(
+            turn_host="turn.example", turn_port=3478,
+            turn_username="u", turn_password="pw"))
+        srv = cfg["iceServers"][0]
+        assert srv["username"] == "u" and srv["credential"] == "pw"
+        assert "turn:turn.example:3478?transport=udp" in srv["urls"]
+
+        cfg = await get_rtc_configuration(_settings(
+            turn_host="turn.example", turn_shared_secret="sec"))
+        srv = cfg["iceServers"][0]
+        assert ":" in srv["username"]          # expiry:user form
+
+        cfg = await get_rtc_configuration(_settings())
+        assert any("stun:" in u for s in cfg["iceServers"]
+                   for u in s["urls"])
+    asyncio.run(run())
+
+
+def test_rtc_config_file_refuses_world_writable(tmp_path):
+    p = tmp_path / "rtc.json"
+    p.write_text(json.dumps({"iceServers": [{"urls": ["stun:x:1"]}]}))
+    os.chmod(p, 0o646)
+    assert load_rtc_config_file(str(p)) is None
+    os.chmod(p, 0o600)
+    assert load_rtc_config_file(str(p))["iceServers"][0]["urls"] == ["stun:x:1"]
+
+
+async def _ws_app(sig):
+    app = web.Application()
+    app.router.add_get("/api/signaling", sig.handler)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def test_signaling_session_relay():
+    async def run():
+        sig = SignalingServer()
+        c = await _ws_app(sig)
+        # the streaming server's own peer
+        srv = await c.ws_connect("/api/signaling")
+        await srv.send_str("HELLO server")
+        assert (await srv.receive_str()) == "HELLO"
+        # a browser peer
+        br = await c.ws_connect("/api/signaling")
+        await br.send_str('HELLO client {"client_type": "controller", '
+                          '"display_id": "primary"}')
+        assert (await br.receive_str()) == "HELLO"
+        await br.send_str("SESSION server")
+        ok = await br.receive_str()
+        assert ok.startswith("SESSION_OK ")
+        start = await srv.receive_str()
+        assert start.startswith("SESSION_START ")
+        caller_uid = start.split()[1]
+        assert "controller" in start and "primary" in start
+        # browser -> server: raw SDP json arrives wrapped MSG <uid> <json>
+        sdp = json.dumps({"sdp": {"type": "offer", "sdp": "v=0..."}})
+        await br.send_str(sdp)
+        relay = await srv.receive_str()
+        assert relay == f"MSG {caller_uid} {sdp}"
+        # server -> that browser peer: answer addressed by uid
+        answer = json.dumps({"sdp": {"type": "answer", "sdp": "v=0..."}})
+        await srv.send_str(f"MSG {caller_uid} {answer}")
+        assert (await br.receive_str()) == answer
+        # teardown notifies the partner
+        await br.send_str("SESSION_END")
+        end = await srv.receive_str()
+        assert end.startswith("SESSION_END ")
+        await br.close(); await srv.close(); await c.close()
+    asyncio.run(run())
+
+
+def test_signaling_server_peer_superseded():
+    async def run():
+        sig = SignalingServer()
+        c = await _ws_app(sig)
+        old = await c.ws_connect("/api/signaling")
+        await old.send_str("HELLO server")
+        await old.receive_str()
+        new = await c.ws_connect("/api/signaling")
+        await new.send_str("HELLO server")
+        await new.receive_str()
+        msg = await old.receive()          # evicted with close 4001
+        assert old.close_code == 4001
+        assert len([p for p in sig.peers.values()
+                    if p.peer_type == "server"]) == 1
+        await new.close(); await c.close()
+    asyncio.run(run())
+
+
+def test_turn_endpoint_through_webrtc_service():
+    async def run():
+        from selkies_tpu.server.webrtc_service import WebRTCService
+        svc = WebRTCService(_settings(turn_host="t.example",
+                                      turn_shared_secret="k"))
+        app = web.Application()
+        svc.register_routes(app)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        r = await client.get("/api/turn")
+        cfg = await r.json()
+        assert cfg["iceServers"][0]["urls"][0].startswith("turn:t.example")
+        await client.close()
+    asyncio.run(run())
