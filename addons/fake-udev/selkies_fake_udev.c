@@ -1,0 +1,487 @@
+/* Selkies-TPU fake libudev: presents the interposer's virtual gamepads to
+ * applications that discover devices through udev enumeration.
+ *
+ * Games/engines (SDL, evdev backends) refuse to open /dev/input nodes
+ * that udev does not list. This library replaces libudev.so.1 (via
+ * LD_PRELOAD or LD_LIBRARY_PATH) and synthesizes, for every gamepad
+ * socket the server exposes (/tmp/selkies_js{0-3}.sock):
+ *
+ *   - an input parent  /sys/devices/virtual/input/input100N
+ *   - a joystick node  /dev/input/jsN       (sysname jsN)
+ *   - an evdev node    /dev/input/event100N (sysname event100N)
+ *
+ * with the ID_INPUT/ID_INPUT_JOYSTICK properties engines probe. A
+ * udev_monitor is backed by an inotify watch on the socket directory, so
+ * seats hot-plug when the server creates/removes sockets. Covers the
+ * enumeration + monitor surface games actually call; fresh
+ * implementation of the role of the reference fake-udev addon.
+ *
+ * Build: make  (produces libudev.so.1)
+ * Use:   LD_PRELOAD=/path/libudev.so.1 game   (or put on LD_LIBRARY_PATH)
+ * Env:   SELKIES_JS_SOCKET_PATH (default /tmp)
+ */
+
+#define _GNU_SOURCE
+#include <limits.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/inotify.h>
+#include <sys/stat.h>
+#include <sys/sysmacros.h>
+#include <unistd.h>
+
+#define NUM_SLOTS 4
+
+/* ------------------------------------------------------------------ model */
+
+struct udev {
+    int ref;
+};
+
+struct udev_list_entry {
+    char name[PATH_MAX];
+    char value[256];
+    struct udev_list_entry *next;
+};
+
+struct udev_device {
+    int ref;
+    struct udev *udev;
+    char syspath[PATH_MAX];
+    char sysname[64];
+    char devnode[64];
+    char subsystem[16];
+    char action[16];
+    dev_t devnum;
+    int slot;
+    int kind;                      /* 0 parent, 1 js, 2 event */
+    struct udev_device *parent;
+    struct udev_list_entry *props;
+};
+
+struct udev_enumerate {
+    int ref;
+    struct udev *udev;
+    int match_input;
+    char match_sysname[64];
+    struct udev_list_entry *list;
+};
+
+struct udev_monitor {
+    int ref;
+    struct udev *udev;
+    int ifd;
+    int pending_slot;              /* second event of an add/remove pair */
+    char pending_action[16];
+};
+
+struct udev_device *udev_device_unref(struct udev_device *d);
+
+static const char *sock_dir(void)
+{
+    const char *d = getenv("SELKIES_JS_SOCKET_PATH");
+    return (d && *d) ? d : "/tmp";
+}
+
+static int slot_present(int slot)
+{
+    char p[PATH_MAX];
+    snprintf(p, sizeof p, "%s/selkies_js%d.sock", sock_dir(), slot);
+    return access(p, F_OK) == 0;
+}
+
+static void add_prop(struct udev_device *d, const char *k, const char *v)
+{
+    struct udev_list_entry *e = calloc(1, sizeof *e);
+    snprintf(e->name, sizeof e->name, "%s", k);
+    snprintf(e->value, sizeof e->value, "%s", v);
+    e->next = d->props;
+    d->props = e;
+}
+
+static struct udev_device *make_device(struct udev *u, int slot, int kind)
+{
+    struct udev_device *d = calloc(1, sizeof *d);
+    d->ref = 1;
+    d->udev = u;
+    d->slot = slot;
+    d->kind = kind;
+    snprintf(d->subsystem, sizeof d->subsystem, "input");
+    if (kind == 0) {
+        snprintf(d->sysname, sizeof d->sysname, "input100%d", slot);
+        snprintf(d->syspath, sizeof d->syspath,
+                 "/sys/devices/virtual/input/input100%d", slot);
+        add_prop(d, "ID_INPUT", "1");
+        add_prop(d, "ID_INPUT_JOYSTICK", "1");
+        add_prop(d, "NAME", "\"Microsoft X-Box 360 pad\"");
+    } else if (kind == 1) {
+        snprintf(d->sysname, sizeof d->sysname, "js%d", slot);
+        snprintf(d->syspath, sizeof d->syspath,
+                 "/sys/devices/virtual/input/input100%d/js%d", slot, slot);
+        snprintf(d->devnode, sizeof d->devnode, "/dev/input/js%d", slot);
+        d->devnum = makedev(13, slot);
+        add_prop(d, "ID_INPUT", "1");
+        add_prop(d, "ID_INPUT_JOYSTICK", "1");
+        add_prop(d, "DEVNAME", d->devnode);
+    } else {
+        snprintf(d->sysname, sizeof d->sysname, "event100%d", slot);
+        snprintf(d->syspath, sizeof d->syspath,
+                 "/sys/devices/virtual/input/input100%d/event100%d",
+                 slot, slot);
+        snprintf(d->devnode, sizeof d->devnode,
+                 "/dev/input/event100%d", slot);
+        d->devnum = makedev(13, 64 + slot);
+        add_prop(d, "ID_INPUT", "1");
+        add_prop(d, "ID_INPUT_JOYSTICK", "1");
+        add_prop(d, "DEVNAME", d->devnode);
+    }
+    if (kind != 0)
+        d->parent = make_device(u, slot, 0);
+    return d;
+}
+
+static void free_device(struct udev_device *d)
+{
+    if (!d)
+        return;
+    struct udev_list_entry *e = d->props;
+    while (e) {
+        struct udev_list_entry *n = e->next;
+        free(e);
+        e = n;
+    }
+    free_device(d->parent);
+    free(d);
+}
+
+/* ------------------------------------------------------------------- udev */
+
+struct udev *udev_new(void)
+{
+    struct udev *u = calloc(1, sizeof *u);
+    u->ref = 1;
+    return u;
+}
+
+struct udev *udev_ref(struct udev *u) { if (u) u->ref++; return u; }
+
+struct udev *udev_unref(struct udev *u)
+{
+    if (u && --u->ref == 0)
+        free(u);
+    return NULL;
+}
+
+/* -------------------------------------------------------------- list API */
+
+struct udev_list_entry *
+udev_list_entry_get_next(struct udev_list_entry *e)
+{
+    return e ? e->next : NULL;
+}
+
+const char *udev_list_entry_get_name(struct udev_list_entry *e)
+{
+    return e ? e->name : NULL;
+}
+
+const char *udev_list_entry_get_value(struct udev_list_entry *e)
+{
+    return e ? e->value : NULL;
+}
+
+/* ------------------------------------------------------------- enumerate */
+
+struct udev_enumerate *udev_enumerate_new(struct udev *u)
+{
+    struct udev_enumerate *en = calloc(1, sizeof *en);
+    en->ref = 1;
+    en->udev = u;
+    return en;
+}
+
+struct udev_enumerate *udev_enumerate_ref(struct udev_enumerate *en)
+{
+    if (en) en->ref++;
+    return en;
+}
+
+struct udev_enumerate *udev_enumerate_unref(struct udev_enumerate *en)
+{
+    if (en && --en->ref == 0) {
+        struct udev_list_entry *e = en->list;
+        while (e) {
+            struct udev_list_entry *n = e->next;
+            free(e);
+            e = n;
+        }
+        free(en);
+    }
+    return NULL;
+}
+
+int udev_enumerate_add_match_subsystem(struct udev_enumerate *en,
+                                       const char *subsystem)
+{
+    if (subsystem && strcmp(subsystem, "input") == 0)
+        en->match_input = 1;
+    return 0;
+}
+
+int udev_enumerate_add_match_property(struct udev_enumerate *en,
+                                      const char *k, const char *v)
+{
+    (void)en; (void)k; (void)v;   /* our devices carry ID_INPUT* anyway */
+    return 0;
+}
+
+int udev_enumerate_add_match_sysname(struct udev_enumerate *en,
+                                     const char *sysname)
+{
+    snprintf(en->match_sysname, sizeof en->match_sysname, "%s",
+             sysname ? sysname : "");
+    return 0;
+}
+
+static void en_append(struct udev_enumerate *en, const char *syspath)
+{
+    struct udev_list_entry *e = calloc(1, sizeof *e);
+    snprintf(e->name, sizeof e->name, "%s", syspath);
+    /* append preserving discovery order */
+    if (!en->list) {
+        en->list = e;
+    } else {
+        struct udev_list_entry *t = en->list;
+        while (t->next)
+            t = t->next;
+        t->next = e;
+    }
+}
+
+int udev_enumerate_scan_devices(struct udev_enumerate *en)
+{
+    if (!en->match_input)
+        return 0;
+    for (int slot = 0; slot < NUM_SLOTS; slot++) {
+        if (!slot_present(slot))
+            continue;
+        char buf[96];
+        for (int kind = 0; kind < 3; kind++) {
+            if (kind == 0)
+                snprintf(buf, sizeof buf, "input100%d", slot);
+            else if (kind == 1)
+                snprintf(buf, sizeof buf, "js%d", slot);
+            else
+                snprintf(buf, sizeof buf, "event100%d", slot);
+            if (en->match_sysname[0]
+                && strcmp(en->match_sysname, buf) != 0)
+                continue;
+            struct udev_device *d = make_device(en->udev, slot, kind);
+            en_append(en, d->syspath);
+            udev_device_unref(d);
+        }
+    }
+    return 0;
+}
+
+struct udev_list_entry *
+udev_enumerate_get_list_entry(struct udev_enumerate *en)
+{
+    return en->list;
+}
+
+/* ---------------------------------------------------------------- device */
+
+struct udev_device *udev_device_new_from_syspath(struct udev *u,
+                                                 const char *syspath)
+{
+    if (!syspath)
+        return NULL;
+    int slot;
+    char tail[64];
+    if (sscanf(syspath, "/sys/devices/virtual/input/input100%d/%63s",
+               &slot, tail) == 2 && slot >= 0 && slot < NUM_SLOTS) {
+        if (strncmp(tail, "js", 2) == 0)
+            return make_device(u, slot, 1);
+        if (strncmp(tail, "event", 5) == 0)
+            return make_device(u, slot, 2);
+        return NULL;
+    }
+    if (sscanf(syspath, "/sys/devices/virtual/input/input100%d", &slot) == 1
+        && slot >= 0 && slot < NUM_SLOTS)
+        return make_device(u, slot, 0);
+    return NULL;
+}
+
+struct udev_device *udev_device_new_from_devnum(struct udev *u, char type,
+                                                dev_t devnum)
+{
+    (void)type;
+    for (int slot = 0; slot < NUM_SLOTS; slot++) {
+        if (devnum == makedev(13, slot))
+            return make_device(u, slot, 1);
+        if (devnum == makedev(13, 64 + slot))
+            return make_device(u, slot, 2);
+    }
+    return NULL;
+}
+
+struct udev_device *udev_device_ref(struct udev_device *d)
+{
+    if (d) d->ref++;
+    return d;
+}
+
+struct udev_device *udev_device_unref(struct udev_device *d)
+{
+    if (d && --d->ref == 0)
+        free_device(d);
+    return NULL;
+}
+
+const char *udev_device_get_syspath(struct udev_device *d)
+{ return d ? d->syspath : NULL; }
+
+const char *udev_device_get_sysname(struct udev_device *d)
+{ return d ? d->sysname : NULL; }
+
+const char *udev_device_get_devnode(struct udev_device *d)
+{ return (d && d->devnode[0]) ? d->devnode : NULL; }
+
+const char *udev_device_get_subsystem(struct udev_device *d)
+{ return d ? d->subsystem : NULL; }
+
+const char *udev_device_get_devtype(struct udev_device *d)
+{ (void)d; return NULL; }
+
+const char *udev_device_get_action(struct udev_device *d)
+{ return (d && d->action[0]) ? d->action : NULL; }
+
+dev_t udev_device_get_devnum(struct udev_device *d)
+{ return d ? d->devnum : makedev(0, 0); }
+
+int udev_device_get_is_initialized(struct udev_device *d)
+{ (void)d; return 1; }
+
+struct udev *udev_device_get_udev(struct udev_device *d)
+{ return d ? d->udev : NULL; }
+
+struct udev_device *udev_device_get_parent(struct udev_device *d)
+{ return d ? d->parent : NULL; }
+
+struct udev_device *
+udev_device_get_parent_with_subsystem_devtype(struct udev_device *d,
+                                              const char *subsystem,
+                                              const char *devtype)
+{
+    (void)devtype;
+    if (d && d->parent && subsystem
+        && strcmp(subsystem, "input") == 0)
+        return d->parent;
+    return NULL;
+}
+
+const char *udev_device_get_property_value(struct udev_device *d,
+                                           const char *key)
+{
+    if (!d || !key)
+        return NULL;
+    for (struct udev_list_entry *e = d->props; e; e = e->next)
+        if (strcmp(e->name, key) == 0)
+            return e->value;
+    return NULL;
+}
+
+struct udev_list_entry *
+udev_device_get_properties_list_entry(struct udev_device *d)
+{ return d ? d->props : NULL; }
+
+const char *udev_device_get_sysattr_value(struct udev_device *d,
+                                          const char *attr)
+{
+    if (d && attr && strcmp(attr, "name") == 0)
+        return "Microsoft X-Box 360 pad";
+    return NULL;
+}
+
+/* --------------------------------------------------------------- monitor */
+
+struct udev_monitor *udev_monitor_new_from_netlink(struct udev *u,
+                                                   const char *name)
+{
+    (void)name;
+    struct udev_monitor *m = calloc(1, sizeof *m);
+    m->ref = 1;
+    m->udev = u;
+    m->ifd = inotify_init1(IN_NONBLOCK | IN_CLOEXEC);
+    m->pending_slot = -1;
+    if (m->ifd >= 0)
+        inotify_add_watch(m->ifd, sock_dir(), IN_CREATE | IN_DELETE);
+    return m;
+}
+
+struct udev_monitor *udev_monitor_ref(struct udev_monitor *m)
+{ if (m) m->ref++; return m; }
+
+struct udev_monitor *udev_monitor_unref(struct udev_monitor *m)
+{
+    if (m && --m->ref == 0) {
+        if (m->ifd >= 0)
+            close(m->ifd);
+        free(m);
+    }
+    return NULL;
+}
+
+int udev_monitor_filter_add_match_subsystem_devtype(struct udev_monitor *m,
+                                                    const char *subsystem,
+                                                    const char *devtype)
+{ (void)m; (void)subsystem; (void)devtype; return 0; }
+
+int udev_monitor_enable_receiving(struct udev_monitor *m)
+{ (void)m; return 0; }
+
+int udev_monitor_set_receive_buffer_size(struct udev_monitor *m, int sz)
+{ (void)m; (void)sz; return 0; }
+
+int udev_monitor_get_fd(struct udev_monitor *m)
+{ return m ? m->ifd : -1; }
+
+struct udev_device *udev_monitor_receive_device(struct udev_monitor *m)
+{
+    if (!m || m->ifd < 0)
+        return NULL;
+    /* each socket change produces a js + event pair; deliver the queued
+     * second half first */
+    if (m->pending_slot >= 0) {
+        struct udev_device *d = make_device(m->udev, m->pending_slot, 2);
+        snprintf(d->action, sizeof d->action, "%s", m->pending_action);
+        m->pending_slot = -1;
+        return d;
+    }
+    char buf[4096];
+    for (;;) {
+        ssize_t n = read(m->ifd, buf, sizeof buf);
+        if (n <= 0)
+            return NULL;
+        for (char *p = buf; p < buf + n;) {
+            struct inotify_event *ev = (struct inotify_event *)p;
+            p += sizeof *ev + ev->len;
+            int slot;
+            if (ev->len
+                && sscanf(ev->name, "selkies_js%d.sock", &slot) == 1
+                && slot >= 0 && slot < NUM_SLOTS) {
+                const char *action =
+                    (ev->mask & IN_CREATE) ? "add" : "remove";
+                m->pending_slot = slot;
+                snprintf(m->pending_action, sizeof m->pending_action,
+                         "%s", action);
+                struct udev_device *d = make_device(m->udev, slot, 1);
+                snprintf(d->action, sizeof d->action, "%s", action);
+                return d;
+            }
+        }
+    }
+}

@@ -1,0 +1,95 @@
+/* Protocol-level test for the fake libudev: enumerate the virtual
+ * gamepads and watch hotplug through the monitor, asserting the exact
+ * surface SDL-class consumers use. Run by tests/test_fake_udev.py. */
+#include <assert.h>
+#include <poll.h>
+#include <stdio.h>
+#include <string.h>
+#include <stdlib.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+struct udev;
+struct udev_device;
+struct udev_enumerate;
+struct udev_list_entry;
+struct udev_monitor;
+struct udev *udev_new(void);
+struct udev_enumerate *udev_enumerate_new(struct udev *);
+int udev_enumerate_add_match_subsystem(struct udev_enumerate *, const char *);
+int udev_enumerate_scan_devices(struct udev_enumerate *);
+struct udev_list_entry *udev_enumerate_get_list_entry(struct udev_enumerate *);
+struct udev_list_entry *udev_list_entry_get_next(struct udev_list_entry *);
+const char *udev_list_entry_get_name(struct udev_list_entry *);
+struct udev_device *udev_device_new_from_syspath(struct udev *, const char *);
+const char *udev_device_get_devnode(struct udev_device *);
+const char *udev_device_get_sysname(struct udev_device *);
+const char *udev_device_get_property_value(struct udev_device *, const char *);
+const char *udev_device_get_action(struct udev_device *);
+struct udev_device *udev_device_get_parent(struct udev_device *);
+struct udev_monitor *udev_monitor_new_from_netlink(struct udev *, const char *);
+int udev_monitor_enable_receiving(struct udev_monitor *);
+int udev_monitor_get_fd(struct udev_monitor *);
+struct udev_device *udev_monitor_receive_device(struct udev_monitor *);
+
+int main(void)
+{
+    const char *dir = getenv("SELKIES_JS_SOCKET_PATH");
+    assert(dir && *dir);
+    struct udev *u = udev_new();
+
+    /* empty dir -> nothing enumerated */
+    struct udev_enumerate *en = udev_enumerate_new(u);
+    udev_enumerate_add_match_subsystem(en, "input");
+    udev_enumerate_scan_devices(en);
+    assert(udev_enumerate_get_list_entry(en) == NULL);
+    printf("EMPTY_OK\n");
+
+    /* create slot 0 -> parent + js0 + event1000 appear */
+    char p[512];
+    snprintf(p, sizeof p, "%s/selkies_js0.sock", dir);
+    FILE *f = fopen(p, "w"); fclose(f);
+    en = udev_enumerate_new(u);
+    udev_enumerate_add_match_subsystem(en, "input");
+    udev_enumerate_scan_devices(en);
+    int count = 0, saw_js = 0, saw_ev = 0;
+    for (struct udev_list_entry *e = udev_enumerate_get_list_entry(en);
+         e; e = udev_list_entry_get_next(e)) {
+        struct udev_device *d =
+            udev_device_new_from_syspath(u, udev_list_entry_get_name(e));
+        assert(d);
+        const char *node = udev_device_get_devnode(d);
+        if (node && strcmp(node, "/dev/input/js0") == 0) {
+            saw_js = 1;
+            assert(strcmp(udev_device_get_property_value(d,
+                          "ID_INPUT_JOYSTICK"), "1") == 0);
+            assert(udev_device_get_parent(d) != NULL);
+        }
+        if (node && strcmp(node, "/dev/input/event1000") == 0)
+            saw_ev = 1;
+        count++;
+    }
+    assert(count == 3 && saw_js && saw_ev);
+    printf("ENUM_OK\n");
+
+    /* monitor: create slot 1 -> add events for js1 then event1001 */
+    struct udev_monitor *m = udev_monitor_new_from_netlink(u, "udev");
+    udev_monitor_enable_receiving(m);
+    int fd = udev_monitor_get_fd(m);
+    assert(fd >= 0);
+    snprintf(p, sizeof p, "%s/selkies_js1.sock", dir);
+    f = fopen(p, "w"); fclose(f);
+    struct pollfd pfd = {fd, POLLIN, 0};
+    assert(poll(&pfd, 1, 5000) == 1);
+    struct udev_device *d1 = udev_monitor_receive_device(m);
+    assert(d1 && strcmp(udev_device_get_action(d1), "add") == 0);
+    assert(strcmp(udev_device_get_sysname(d1), "js1") == 0);
+    struct udev_device *d2 = udev_monitor_receive_device(m);
+    assert(d2 && strcmp(udev_device_get_sysname(d2), "event1001") == 0);
+    unlink(p);
+    assert(poll(&pfd, 1, 5000) == 1);
+    struct udev_device *d3 = udev_monitor_receive_device(m);
+    assert(d3 && strcmp(udev_device_get_action(d3), "remove") == 0);
+    printf("MONITOR_OK\n");
+    return 0;
+}

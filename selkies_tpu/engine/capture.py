@@ -60,6 +60,9 @@ class ScreenCapture:
         # serialises start/stop/restart/region calls: the service runs them
         # on executor threads, so two clients' reconfigures may race
         self._api_lock = threading.RLock()
+        self._shot_request = threading.Event()
+        self._shot_ready = threading.Event()
+        self._shot_result = None
         self._tunables_dirty: dict = {}
         # stats for rate control / observability
         self.last_frame_bytes = 0
@@ -145,6 +148,34 @@ class ScreenCapture:
     def set_cursor_callback(self, cb) -> None:
         self._cursor_callback = cb
 
+    def screenshot(self, timeout: float = 5.0):
+        """Latest captured frame as an (H, W, 3) uint8 numpy array (the
+        visible crop), or None when idle. The device->host readback is
+        performed BY THE CAPTURE THREAD between steps — device transports
+        that tolerate only one client (TPU relays) must never see a
+        concurrent transfer from an HTTP worker."""
+        if not self.is_capturing():
+            return None
+        self._shot_ready.clear()
+        self._shot_request.set()
+        if not self._shot_ready.wait(timeout):
+            return None
+        return self._shot_result
+
+    def _serve_screenshot(self) -> None:
+        """Runs on the capture thread when a screenshot was requested."""
+        if not self._shot_request.is_set():
+            return
+        self._shot_request.clear()
+        import numpy as np
+        sess = self._session
+        shot = None
+        if sess is not None and getattr(sess, "_prev", None) is not None:
+            w, h = sess.visible_size
+            shot = np.asarray(sess._prev)[:h, :w].copy()
+        self._shot_result = shot
+        self._shot_ready.set()
+
     # -- loop ----------------------------------------------------------------
     def _apply_tunables(self) -> None:
         with self._lock:
@@ -229,6 +260,7 @@ class ScreenCapture:
                 inflight.append(out)
                 if len(inflight) > PIPELINE_DEPTH:
                     window_bytes += self._deliver(inflight.popleft())
+                self._serve_screenshot()
                 tick += 1
                 fps_frames += 1
                 now = time.monotonic()

@@ -151,7 +151,9 @@ class JpegEncoderSession:
         self._cap_gen = 0   # growth generation: pipelined frames encoded
         #                     with stale caps must not re-grow/re-jit
         from .watermark import maybe_load
-        self._watermark = maybe_load(settings, g.width, g.height)
+        # anchor against the VISIBLE size: padded rows/cols are cropped
+        # client-side, so a bottom/right anchor must not land there
+        self._watermark = maybe_load(settings, g.out_w, g.out_h)
         self.update_quality(settings.jpeg_quality, settings.paint_over_quality)
 
     def _build_step(self):

@@ -148,7 +148,8 @@ class H264EncoderSession:
         self._hdr_pay = jnp.asarray(np.tile(pay, (g.n_stripes, 1)))
         self._hdr_nb = jnp.asarray(np.tile(nb, (g.n_stripes, 1)))
         from .watermark import maybe_load
-        self._watermark = maybe_load(settings, g.width, g.height)
+        # anchored against the VISIBLE size (padding is cropped client-side)
+        self._watermark = maybe_load(settings, g.out_w, g.out_h)
         self.qp = int(np.clip(settings.video_crf, 8, 48))
         self.paint_qp = int(np.clip(
             settings.video_min_qp, 8, self.qp))

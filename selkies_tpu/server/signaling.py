@@ -91,26 +91,46 @@ class SignalingServer:
                 meta = json.loads(toks[2])
             except json.JSONDecodeError:
                 meta = {}
+        evicted = None
         async with self.lock:
-            # newest-wins eviction for a reconnecting server peer
+            # newest-wins eviction for a reconnecting server peer; the
+            # close happens OUTSIDE the lock (it can take aiohttp's whole
+            # close-handshake timeout and must not stall other HELLOs)
             if peer_type == "server":
-                old = self.server_peer()
-                if old is not None:
-                    self.peers.pop(old.uid, None)
-                    try:
-                        await old.ws.close(code=4001, message=b"superseded")
-                    except Exception:
-                        pass
+                evicted = self.server_peer()
+                if evicted is not None:
+                    self.peers.pop(evicted.uid, None)
             uid = str(next(self._uid))
             peer = Peer(uid=uid, ws=ws, peer_type=peer_type, meta=meta)
             self.peers[uid] = peer
+        if evicted is not None:
+            await self._orphan_sessions_of(evicted)
+
+            async def _close_old(p=evicted):
+                try:
+                    await p.ws.close(code=4001, message=b"superseded")
+                except Exception:
+                    pass
+            asyncio.get_running_loop().create_task(_close_old())
         await self._safe_send(peer, "HELLO")
         logger.info("signaling peer %s registered (%s)", uid, peer_type)
         return peer
 
     async def _dispatch(self, peer: Peer, text: str) -> None:
         if text.startswith("SESSION_END"):
-            await self._end_session(peer, notify_partner=True)
+            parts = text.split(maxsplit=1)
+            if peer.peer_type == "server":
+                # the server holds many sessions: it must name the caller
+                # ("SESSION_END <uid>"); its own status only clears when no
+                # session remains
+                target = self.peers.get(parts[1]) if len(parts) > 1 else None
+                if target is not None and target.partner == peer.uid:
+                    target.status = None
+                    target.partner = None
+                    await self._safe_send(target, f"SESSION_END {peer.uid}")
+                self._refresh_server_status(peer)
+            else:
+                await self._end_session(peer, notify_partner=True)
             return
         if text.startswith("SESSION"):
             parts = text.split(maxsplit=1)
@@ -160,6 +180,12 @@ class SignalingServer:
             return
         await self._safe_send(peer, "ERROR invalid state for message")
 
+    def _refresh_server_status(self, server: Peer) -> None:
+        """The server peer stays 'session' while ANY caller still points at
+        it — ending one session must not break relay for the others."""
+        live = any(p.partner == server.uid for p in self.peers.values())
+        server.status = "session" if live else None
+
     async def _end_session(self, peer: Peer, notify_partner: bool) -> None:
         partner = self.peers.get(peer.partner or "")
         peer.status = None
@@ -169,9 +195,22 @@ class SignalingServer:
             if partner.peer_type != "server":
                 partner.status = None
                 partner.partner = None
+            else:
+                self._refresh_server_status(partner)
+
+    async def _orphan_sessions_of(self, gone: Peer) -> None:
+        """Notify and release every peer whose session pointed at ``gone``
+        (the server peer disconnected or was superseded)."""
+        for p in list(self.peers.values()):
+            if p.partner == gone.uid:
+                p.status = None
+                p.partner = None
+                await self._safe_send(p, f"SESSION_END {gone.uid}")
 
     async def _disconnect(self, peer: Peer) -> None:
         self.peers.pop(peer.uid, None)
-        if peer.status == "session":
+        if peer.peer_type == "server":
+            await self._orphan_sessions_of(peer)
+        elif peer.status == "session":
             await self._end_session(peer, notify_partner=True)
         logger.info("signaling peer %s left", peer.uid)

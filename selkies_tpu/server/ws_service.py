@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from typing import Optional
 
@@ -117,6 +118,8 @@ class WebSocketsService(BaseStreamingService):
         self._running = False
         self._bg_tasks: set[asyncio.Task] = set()
         self._starting_captures: set[str] = set()
+        self._rec_file = None
+        self._rec_buf = bytearray()
         self._last_conn_by_ip: dict[str, float] = {}
         self._grace_task: Optional[asyncio.Task] = None
         self._stats_task: Optional[asyncio.Task] = None
@@ -124,6 +127,78 @@ class WebSocketsService(BaseStreamingService):
     # ---------------------------------------------------------------- routes
     def register_routes(self, app: web.Application) -> None:
         app.router.add_get("/api/websockets", self.ws_endpoint)
+        if self.settings.enable_computer_use:
+            app.router.add_get("/api/screenshot", self.handle_screenshot)
+            app.router.add_post("/api/computer_use",
+                                self.handle_computer_use)
+
+    # ------------------------------------------------------- agent endpoints
+    async def handle_screenshot(self, request: web.Request) -> web.Response:
+        """Current framebuffer as PNG (reference start_computer_use's
+        screenshot surface)."""
+        if request.get("role") != "full":
+            return web.Response(status=403, text="view-only")
+        cap = self.captures.get(self._default_display()) \
+            or self.captures.get("__seats__") \
+            or next(iter(self.captures.values()), None)
+        if cap is None or not hasattr(cap, "screenshot"):
+            return web.Response(status=503, text="no active capture")
+        def _grab_png():
+            shot = cap.screenshot()
+            if shot is None:
+                return None
+            import io as _io
+
+            from PIL import Image
+            buf = _io.BytesIO()
+            Image.fromarray(shot, "RGB").save(buf, "PNG")
+            return buf.getvalue()
+
+        png = await asyncio.get_running_loop().run_in_executor(None, _grab_png)
+        if png is None:
+            return web.Response(status=503, text="no frame yet")
+        return web.Response(body=png, content_type="image/png")
+
+    async def handle_computer_use(self, request: web.Request) -> web.Response:
+        """Agent input injection: {"action": "move|click|type|key|scroll",
+        ...} (reference computer-use HTTP server parity)."""
+        if request.get("role") != "full":
+            return web.Response(status=403, text="view-only")
+        if self.input_handler is None or not self.settings.enable_input:
+            return web.Response(status=503, text="input disabled")
+        try:
+            body = await request.json()
+        except Exception:
+            return web.Response(status=400, text="json body required")
+        action = body.get("action")
+        h = self.input_handler
+        try:
+            if action == "move":
+                await h.on_message(f"m,{int(body['x'])},{int(body['y'])}")
+            elif action == "click":
+                btn = int(body.get("button", 1))
+                await h.on_message(f"m,{int(body['x'])},{int(body['y'])}")
+                await h.on_message(f"mb,{btn},1")
+                await h.on_message(f"mb,{btn},0")
+            elif action == "scroll":
+                await h.on_message(
+                    f"ms,{int(body.get('dx', 0))},{int(body.get('dy', 0))}")
+            elif action == "key":
+                ks = int(body["keysym"])
+                await h.on_message(f"kd,{ks}")
+                await h.on_message(f"ku,{ks}")
+            elif action == "type":
+                for ch in str(body.get("text", ""))[:4096]:
+                    cp = ord(ch)
+                    ks = cp if cp < 0x100 else 0x01000000 + cp
+                    await h.on_message(f"kd,{ks}")
+                    await h.on_message(f"ku,{ks}")
+            else:
+                return web.Response(status=400,
+                                    text=f"unknown action {action!r}")
+        except (KeyError, ValueError) as e:
+            return web.Response(status=400, text=f"bad arguments: {e}")
+        return web.json_response({"ok": True})
 
     @property
     def _seats(self) -> int:
@@ -181,6 +256,30 @@ class WebSocketsService(BaseStreamingService):
             await self.audio.stop()
         if self.input_handler is not None:
             await self.input_handler.stop()
+        if self._rec_buf:
+            buf, self._rec_buf = self._rec_buf, bytearray()
+            try:
+                self._flush_recording(buf)
+            except Exception:
+                pass
+        if self._rec_file is not None:
+            try:
+                self._rec_file.close()
+            except OSError:
+                pass
+            self._rec_file = None
+
+    def _flush_recording(self, buf: bytes) -> None:
+        """Executor-side disk append for the recording tap."""
+        try:
+            if self._rec_file is None:
+                self._rec_file = open(self.settings.recording_path, "ab")
+            self._rec_file.write(buf)
+            self._rec_file.flush()
+        except OSError as e:
+            logger.warning("recording tap failed: %s; disabling", e)
+            self.settings.set_server("recording_path", "")
+        self._rec_buf = bytearray()
 
     # -------------------------------------------------------------- settings
     def _server_settings_payload(self) -> str:
@@ -349,6 +448,13 @@ class WebSocketsService(BaseStreamingService):
                                        chunk.width, chunk.height,
                                        chunk.payload, idr=chunk.is_idr)
         metrics.inc_counter("selkies_frames_encoded_total")
+        # out-of-band recording tap: raw Annex-B / MJPEG of the primary
+        # display (reference recording socket, settings.py:640-645)
+        if self.settings.recording_path \
+                and chunk.display_id == self._default_display():
+            # buffered on the loop (cheap append), flushed to disk from an
+            # executor — a slow disk must never pace the fan-out
+            self._rec_buf += chunk.payload
         for c in self.clients.values():
             if not c.video_active or c.paused:
                 continue
@@ -778,5 +884,35 @@ class WebSocketsService(BaseStreamingService):
                     .run_in_executor(None, metrics.device_stats),
                 }
                 await self._broadcast_control("system_stats " + json.dumps(stats))
+                if self.settings.stats_csv_path:
+                    self._append_stats_csv(stats)
+                if self._rec_buf:
+                    buf, self._rec_buf = self._rec_buf, bytearray()
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._flush_recording, buf)
             except Exception:
                 logger.exception("stats loop error")
+
+    def _append_stats_csv(self, stats: dict) -> None:
+        """Schema-stable CSV stats dump (reference webrtc_utils.py:958-1259
+        role)."""
+        import csv
+        path = self.settings.stats_csv_path
+        row = {
+            "ts": round(time.time(), 3),
+            "cpu_percent": stats.get("cpu_percent"),
+            "mem_percent": stats.get("mem_percent"),
+            "clients": stats.get("clients"),
+            "encoded_fps": ";".join(
+                f"{k}={v:.1f}" for k, v in stats.get("encoded_fps", {}).items()),
+        }
+        try:
+            new = not os.path.exists(path)
+            with open(path, "a", newline="") as f:
+                w = csv.DictWriter(f, fieldnames=list(row))
+                if new:
+                    w.writeheader()
+                w.writerow(row)
+        except OSError as e:
+            logger.warning("stats csv failed: %s; disabling", e)
+            self.settings.set_server("stats_csv_path", "")

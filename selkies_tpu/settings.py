@@ -214,6 +214,18 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
     _s("rtc_config_file", SType.STR, "", "Trusted JSON ICE-server file."),
     _s("webrtc_public_ip", SType.STR, "", "NAT1TO1 public IP substitution."),
 
+    # --- recording / agent APIs ---------------------------------------------
+    _s("recording_path", SType.STR, "",
+       "Append the primary display's encoded stream here (raw Annex-B for "
+       "h264, concatenated JFIF/MJPEG for jpeg) — the out-of-band recording "
+       "tap (reference settings.py:640-645)."),
+    _s("stats_csv_path", SType.STR, "",
+       "Append periodic system/encode stats rows as CSV "
+       "(reference webrtc_utils.py:958-1259 stats dump)."),
+    _s("enable_computer_use", SType.BOOL, False,
+       "HTTP agent API: GET /api/screenshot, POST /api/computer_use "
+       "(reference pixelflux start_computer_use, __main__.py:38-43)."),
+
     # --- lifecycle hooks ----------------------------------------------------
     _s("run_after_connect", SType.STR, "",
        "Shell command spawned when the FIRST client connects "

@@ -1,0 +1,24 @@
+"""fake-udev addon: build + run the C protocol test (enumeration of the
+virtual gamepads and inotify-backed hotplug monitor)."""
+
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+
+ADDON = pathlib.Path(__file__).resolve().parent.parent / "addons" / "fake-udev"
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None, reason="no gcc")
+def test_fake_udev_enumeration_and_monitor(tmp_path):
+    subprocess.run(["make", "-C", str(ADDON), "libudev.so.1",
+                    "test_fake_udev"], check=True, capture_output=True)
+    out = subprocess.run(
+        [str(ADDON / "test_fake_udev")],
+        env={"SELKIES_JS_SOCKET_PATH": str(tmp_path), "PATH": "/usr/bin"},
+        capture_output=True, timeout=30)
+    assert out.returncode == 0, out.stderr.decode()
+    assert b"EMPTY_OK" in out.stdout
+    assert b"ENUM_OK" in out.stdout
+    assert b"MONITOR_OK" in out.stdout

@@ -467,3 +467,49 @@ async def test_request_clipboard_pushes_to_clients(client_factory):
     else:
         raise AssertionError("no clipboard push")
     await ws.close()
+
+
+async def test_recording_tap_and_stats_csv(client_factory, tmp_path):
+    rec = tmp_path / "rec.mjpeg"
+    csvp = tmp_path / "stats.csv"
+    server, svc, fake, _ = make_app(
+        recording_path=str(rec), stats_csv_path=str(csvp),
+        stats_interval_s=0.2)
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("START_VIDEO")
+    await asyncio.sleep(0.3)
+    fake.emit(3)
+    await asyncio.sleep(0.5)
+    assert rec.exists() and rec.read_bytes().startswith(b"\xff\xd8")
+    assert csvp.exists()
+    lines = csvp.read_text().splitlines()
+    assert lines[0].startswith("ts,cpu_percent")
+    assert len(lines) >= 2
+    await ws.close()
+
+
+async def test_computer_use_api(client_factory):
+    server, svc, fake, handler = make_app(enable_computer_use=True)
+    backend = handler.backend
+    c = await client_factory(server)
+    r = await c.post("/api/computer_use",
+                     json={"action": "click", "x": 10, "y": 20, "button": 1})
+    assert (await r.json())["ok"] is True
+    assert ("motion", 10, 20) in backend.events
+    assert ("button", 1, True) in backend.events
+    r = await c.post("/api/computer_use", json={"action": "type", "text": "hi"})
+    assert r.status == 200
+    assert ("key", ord("h"), True) in backend.events
+    r = await c.post("/api/computer_use", json={"action": "nope"})
+    assert r.status == 400
+    # screenshot requires an active capture with frames; FakeCapture has no
+    # screenshot() -> 503 is the honest degraded answer
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("START_VIDEO")
+    await asyncio.sleep(0.2)
+    r = await c.get("/api/screenshot")
+    assert r.status == 503
+    await ws.close()
