@@ -469,9 +469,13 @@ struct udev_device *udev_monitor_receive_device(struct udev_monitor *m)
         for (char *p = buf; p < buf + n;) {
             struct inotify_event *ev = (struct inotify_event *)p;
             p += sizeof *ev + ev->len;
-            int slot;
+            int slot, consumed = 0;
+            /* %n pins the suffix: a bare %d match would also fire on
+             * selkies_js0.tmp / selkies_js1.sock.new etc. */
             if (ev->len
-                && sscanf(ev->name, "selkies_js%d.sock", &slot) == 1
+                && sscanf(ev->name, "selkies_js%d.sock%n",
+                          &slot, &consumed) == 1
+                && consumed == (int)strlen(ev->name)
                 && slot >= 0 && slot < NUM_SLOTS) {
                 const char *action =
                     (ev->mask & IN_CREATE) ? "add" : "remove";

@@ -63,6 +63,7 @@ class ScreenCapture:
         self._shot_request = threading.Event()
         self._shot_ready = threading.Event()
         self._shot_result = None
+        self._shot_lock = threading.Lock()
         self._tunables_dirty: dict = {}
         # stats for rate control / observability
         self.last_frame_bytes = 0
@@ -156,11 +157,13 @@ class ScreenCapture:
         concurrent transfer from an HTTP worker."""
         if not self.is_capturing():
             return None
-        self._shot_ready.clear()
-        self._shot_request.set()
-        if not self._shot_ready.wait(timeout):
-            return None
-        return self._shot_result
+        # serialise concurrent callers: the event pair is single-waiter
+        with self._shot_lock:
+            self._shot_ready.clear()
+            self._shot_request.set()
+            if not self._shot_ready.wait(timeout):
+                return None
+            return self._shot_result
 
     def _serve_screenshot(self) -> None:
         """Runs on the capture thread when a screenshot was requested."""

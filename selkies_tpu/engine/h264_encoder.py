@@ -126,11 +126,13 @@ class H264EncoderSession:
         g = self.grid
         self.n_rows = g.n_stripes * g.rows_per_stripe
         self._e_cap = 7 + g.mb_w * SLOTS_MB + 1
-        # bits/row worst case for desktop content; growable on overflow.
-        # _w_cap is in 32-bit WORDS; _out_cap is the BYTE capacity of the
-        # whole-frame concat buffer (4 bytes per word).
+        # _w_cap (32-bit WORDS per row) bounds device-side buffers only;
+        # _out_cap is the BYTE capacity of the whole-frame concat buffer —
+        # the one array that crosses the host link every frame, so it is
+        # sized for realistic intra frames (~1.5 bits/px) rather than the
+        # worst case; overflow grows it (and forces a clean refresh).
         self._w_cap = max(2048, g.mb_w * 768 // 4)
-        self._out_cap = max(256 * 1024, self.n_rows * self._w_cap * 4)
+        self._out_cap = max(192 * 1024, g.width * g.height // 6)
         self._step = self._build_step()
         self.frame_id = 0
         self._age = jnp.zeros((g.n_stripes,), jnp.int32)

@@ -407,7 +407,12 @@ def h264_encode_yuv(yf: jnp.ndarray, uf: jnp.ndarray, vf: jnp.ndarray,
         ).reshape(R, 2, 8)
         return (new_edge_y, new_edge_c), (dlvl, clvl)
 
-    init = (jnp.zeros((R, 16), jnp.int32), jnp.zeros((R, 2, 8), jnp.int32))
+    # init derived from a (zeroed) slice of the input so the carry carries
+    # the same shard_map varying-axis type as the body output; XLA folds
+    # the 0* away
+    anchor = 0 * yrows[:, 0, 0].astype(jnp.int32)          # (R,)
+    init = (jnp.zeros((R, 16), jnp.int32) + anchor[:, None],
+            jnp.zeros((R, 2, 8), jnp.int32) + anchor[:, None, None])
     _, (dc_lvls, cdc_lvls) = jax.lax.scan(step, init,
                                           jnp.arange(M, dtype=jnp.int32))
     dc_lvls = jnp.moveaxis(dc_lvls, 0, 1)      # (R, M, 4, 4)

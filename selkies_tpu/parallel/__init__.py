@@ -7,5 +7,7 @@ drives every seat's desktop on its own device, collective-free over ICI.
 """
 
 from .seats import MultiSeatEncoder, seat_mesh, synthetic_seat_frames
+from .stripes import h264_encode_sharded, stripe_mesh
 
-__all__ = ["MultiSeatEncoder", "seat_mesh", "synthetic_seat_frames"]
+__all__ = ["MultiSeatEncoder", "seat_mesh", "synthetic_seat_frames",
+           "h264_encode_sharded", "stripe_mesh"]

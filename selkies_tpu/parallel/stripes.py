@@ -1,0 +1,76 @@
+"""Stripe (sequence) parallelism: ONE frame's MB rows sharded across the
+device mesh.
+
+The multi-seat axis (seats.py) is the data-parallel analog; this is the
+sequence-parallel one (SURVEY.md §2.5: the reference's striped encoding
+maps rows onto parallel encoders — here they map onto DEVICES). It works
+because the H.264 design made MB rows fully independent (slice per row,
+no cross-row prediction or CAVLC context): ``shard_map`` over the row
+axis compiles to a collective-free SPMD program, scaling single-frame
+encode latency down with device count — the path to 4K/8K single-seat
+targets (BASELINE.md stretch rows).
+
+Consumes the ``tpu_stripe_devices`` setting.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..ops.h264_encode import H264FrameOut, h264_encode_yuv
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+logger = logging.getLogger("selkies_tpu.parallel.stripes")
+
+
+def stripe_mesh(n_rows: int, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D ``Mesh('stripe')`` with the largest device count dividing
+    ``n_rows`` (MB rows)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = min(len(devs), n_rows)
+    while n_rows % n:
+        n -= 1
+    return Mesh(np.array(devs[:n]), ("stripe",))
+
+
+def h264_encode_sharded(yf: jnp.ndarray, uf: jnp.ndarray, vf: jnp.ndarray,
+                        qp, header_pay: jnp.ndarray, header_nb: jnp.ndarray,
+                        e_cap: int, w_cap: int, mesh: Mesh,
+                        idr_pic_id=0) -> H264FrameOut:
+    """Shard one frame's MB rows over ``mesh`` and encode; outputs are
+    bit-identical to the unsharded h264_encode_yuv (rows are independent
+    by construction, so the sharded program needs zero collectives)."""
+    H = yf.shape[0]
+    R = H // 16
+    n_dev = mesh.devices.size
+    assert R % n_dev == 0, f"{n_dev} devices do not divide {R} MB rows"
+    qp_rows = jnp.broadcast_to(jnp.asarray(qp, jnp.int32), (R,))
+    idr_rows = jnp.broadcast_to(jnp.asarray(idr_pic_id, jnp.int32), (R,))
+
+    def local(y, u, v, qpv, hp, hn, idr):
+        out = h264_encode_yuv(y, u, v, qpv, hp, hn, e_cap, w_cap,
+                              idr_pic_id=idr)
+        return out.words, out.total_bits, out.overflow[None]
+
+    row_band = P("stripe")                    # leading dim = rows / bands
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("stripe", None), P("stripe", None), P("stripe", None),
+                  row_band, P("stripe", None), P("stripe", None), row_band),
+        out_specs=(P("stripe", None), row_band, P("stripe")),
+    )
+    words, bits, overflow = jax.jit(fn)(
+        yf, uf, vf, qp_rows,
+        jnp.asarray(header_pay), jnp.asarray(header_nb), idr_rows)
+    return H264FrameOut(words, bits, jnp.any(overflow), R)

@@ -47,6 +47,7 @@ class SignalingServer:
         self.peers: dict[str, Peer] = {}
         self._uid = itertools.count(1)
         self.lock = asyncio.Lock()
+        self._bg_tasks: set[asyncio.Task] = set()
 
     # ------------------------------------------------------------- utilities
     def server_peer(self) -> Optional[Peer]:
@@ -111,7 +112,9 @@ class SignalingServer:
                     await p.ws.close(code=4001, message=b"superseded")
                 except Exception:
                     pass
-            asyncio.get_running_loop().create_task(_close_old())
+            task = asyncio.get_running_loop().create_task(_close_old())
+            self._bg_tasks.add(task)        # strong ref: loop weak-refs tasks
+            task.add_done_callback(self._bg_tasks.discard)
         await self._safe_send(peer, "HELLO")
         logger.info("signaling peer %s registered (%s)", uid, peer_type)
         return peer

@@ -279,7 +279,9 @@ class WebSocketsService(BaseStreamingService):
         except OSError as e:
             logger.warning("recording tap failed: %s; disabling", e)
             self.settings.set_server("recording_path", "")
-        self._rec_buf = bytearray()
+        # NOTE: callers swap self._rec_buf BEFORE dispatching here; touching
+        # it from this executor thread would drop concurrently-appended
+        # chunks
 
     # -------------------------------------------------------------- settings
     def _server_settings_payload(self) -> str:
@@ -885,7 +887,8 @@ class WebSocketsService(BaseStreamingService):
                 }
                 await self._broadcast_control("system_stats " + json.dumps(stats))
                 if self.settings.stats_csv_path:
-                    self._append_stats_csv(stats)
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._append_stats_csv, stats)
                 if self._rec_buf:
                     buf, self._rec_buf = self._rec_buf, bytearray()
                     await asyncio.get_running_loop().run_in_executor(
