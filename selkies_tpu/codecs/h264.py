@@ -83,7 +83,7 @@ def write_sps(width: int, height: int, level_idc: int = 42) -> bytes:
     w.ue(0)               # sps_id
     w.ue(0)               # log2_max_frame_num_minus4
     w.ue(2)               # pic_order_cnt_type 2 (no POC syntax in slices)
-    w.ue(0)               # max_num_ref_frames
+    w.ue(1)               # max_num_ref_frames (P references the prior picture)
     w.put(1, 0)           # gaps_in_frame_num_value_allowed
     w.ue(w_mbs - 1)
     w.ue(h_mbs - 1)
@@ -556,3 +556,220 @@ def slice_header_events(mb_w: int, n_rows: int):
 def assemble_annexb(row_rbsp: list[bytes]) -> bytes:
     """Per-row slice RBSPs -> Annex-B (start codes + emulation prevention)."""
     return b"".join(nal(5, rb) for rb in row_rbsp)
+
+
+# --------------------------------------------------------------------------
+# P-frames: zero-motion conditional replenishment (SURVEY §7 step 5).
+# P_Skip for unchanged MBs, P_L0_16x16 with mvd (0,0) + residual against
+# the previous reconstruction for changed ones. No motion search and no
+# intra prediction chain — every MB is independent, which is exactly what
+# the device implementation parallelises.
+# --------------------------------------------------------------------------
+
+def p_slice_header_bits(w: BitWriter, first_mb: int, qp: int,
+                        frame_num: int) -> None:
+    """Non-IDR P-slice header matching write_sps/write_pps choices."""
+    w.ue(first_mb)
+    w.ue(5)               # slice_type P (all slices)
+    w.ue(0)               # pps_id
+    w.put(4, frame_num & 0xF)
+    # poc type 2: nothing
+    w.put(1, 0)           # num_ref_idx_active_override_flag
+    w.put(1, 0)           # ref_pic_list_modification_flag_l0
+    w.put(1, 0)           # adaptive_ref_pic_marking_mode_flag (ref pic)
+    w.se(qp - 26)         # slice_qp_delta
+    w.ue(1)               # disable_deblocking_filter_idc = 1
+
+
+def _quant4_inter(wm, qp):
+    """Inter rounding offset is f/6 (JM) vs intra's f/3."""
+    qbits = 15 + qp // 6
+    mf = MF4_NP[qp % 6].astype(np.int64)
+    f = (1 << qbits) // 6
+    mag = (np.abs(wm) * mf + f) >> qbits
+    mag = np.minimum(mag, 2000)
+    return np.where(wm < 0, -mag, mag).astype(np.int64)
+
+
+class PFrameEncoder:
+    """Golden numpy P-frame encoder over an I16Encoder's reconstruction
+    state. One slice per MB row (same layout contract as the I path)."""
+
+    def __init__(self, base: I16Encoder):
+        self.base = base
+
+    def encode_frame(self, y: np.ndarray, u: np.ndarray, v: np.ndarray,
+                     frame_num: int) -> bytes:
+        b = self.base
+        qp, qpc = b.qp, int(QPC_NP[b.qp])
+        H16, W16 = b.mb_h * 16, b.mb_w * 16
+        y = _pad_edge(y, H16, W16)
+        u = _pad_edge(u, H16 // 2, W16 // 2)
+        v = _pad_edge(v, H16 // 2, W16 // 2)
+        out = bytearray()
+        for row in range(b.mb_h):
+            w = BitWriter()
+            p_slice_header_bits(w, row * b.mb_w, qp, frame_num)
+            nnz_y = np.zeros((b.mb_w, 4, 4), np.int64)
+            nnz_c = np.zeros((b.mb_w, 2, 2, 2), np.int64)
+            skip_run = 0
+            for k in range(b.mb_w):
+                skip_run = self._encode_mb(w, y, u, v, row, k, qp, qpc,
+                                           nnz_y, nnz_c, skip_run)
+            if skip_run:
+                w.ue(skip_run)        # trailing skips close the slice
+            w.rbsp_trailing()
+            out += nal(1, w.to_bytes(), ref_idc=2)   # non-IDR reference
+        return bytes(out)
+
+    def _encode_mb(self, w, y, u, v, row, k, qp, qpc, nnz_y, nnz_c,
+                   skip_run) -> int:
+        b = self.base
+        x0, y0 = k * 16, row * 16
+        src = y[y0:y0 + 16, x0:x0 + 16].astype(np.int64)
+        ref = b.recon_y[y0:y0 + 16, x0:x0 + 16].astype(np.int64)
+        res = src - ref
+
+        wblk = np.zeros((4, 4, 4, 4), np.int64)
+        for br in range(4):
+            for bc in range(4):
+                wblk[br, bc] = _fwd4(res[br * 4:br * 4 + 4,
+                                         bc * 4:bc * 4 + 4])
+        lvl = _quant4_inter(wblk, qp)                   # (4,4,4,4)
+        lvl_zz = np.zeros((4, 4, 16), np.int64)
+        for br in range(4):
+            for bc in range(4):
+                lvl_zz[br, bc] = lvl[br, bc].reshape(16)[ZIGZAG4_NP]
+        # cbp luma: one bit per 8x8 group
+        cbp_luma = 0
+        for g8 in range(4):
+            gr, gc = (g8 // 2) * 2, (g8 % 2) * 2
+            if np.any(lvl_zz[gr:gr + 2, gc:gc + 2]):
+                cbp_luma |= 1 << g8
+
+        csrc = []
+        cref = []
+        for ci, (plane, rplane) in ((0, (u, b.recon_u)),
+                                    (1, (v, b.recon_v))):
+            csrc.append(plane[row * 8:row * 8 + 8,
+                              k * 8:k * 8 + 8].astype(np.int64))
+            cref.append(rplane[row * 8:row * 8 + 8,
+                               k * 8:k * 8 + 8].astype(np.int64))
+        cw = np.zeros((2, 2, 2, 4, 4), np.int64)
+        for ci in range(2):
+            cres = csrc[ci] - cref[ci]
+            for br in range(2):
+                for bc in range(2):
+                    cw[ci, br, bc] = _fwd4(cres[br * 4:br * 4 + 4,
+                                                bc * 4:bc * 4 + 4])
+        H2 = np.array([[1, 1], [1, -1]], np.int64)
+        cdc = cw[:, :, :, 0, 0]
+        cdc_lvl = np.zeros((2, 2, 2), np.int64)
+        cdcq = np.zeros((2, 2, 2), np.int64)
+        for ci in range(2):
+            hd2 = H2 @ cdc[ci] @ H2
+            cdc_lvl[ci] = _quant4(hd2, qpc, dc_shift=1)
+            f2 = H2 @ cdc_lvl[ci] @ H2
+            cdcq[ci] = _dequant_chroma_dc(f2, qpc)
+        cac_lvl = np.zeros((2, 2, 2, 16), np.int64)
+        for ci in range(2):
+            for br in range(2):
+                for bc in range(2):
+                    q = _quant4_inter(cw[ci, br, bc], qpc)
+                    zz = q.reshape(16)[ZIGZAG4_NP]
+                    zz[0] = 0
+                    cac_lvl[ci, br, bc] = zz
+        has_cac = bool(np.any(cac_lvl))
+        has_cdc = bool(np.any(cdc_lvl))
+        cbp_chroma = 2 if has_cac else (1 if has_cdc else 0)
+        cbp = cbp_luma | (cbp_chroma << 4)
+
+        if cbp == 0:
+            # P_Skip: recon = reference copy (zero MV); counts stay 0
+            nnz_y[k] = 0
+            nnz_c[k] = 0
+            return skip_run + 1
+
+        # ---- syntax
+        w.ue(skip_run)
+        w.ue(0)                 # mb_type P_L0_16x16
+        w.se(0); w.se(0)        # mvd_x, mvd_y
+        w.ue(int(T.CBP_INTER_CBP2CODE[cbp]))
+        w.se(0)                 # mb_qp_delta
+        for br, bc in LUMA_BLK_ORDER:
+            g8 = (br // 2) * 2 + (bc // 2)
+            if not (cbp_luma >> g8) & 1:
+                nnz_y[k, br, bc] = 0
+                continue
+            nc = I16Encoder._nc_luma(nnz_y, k, br, bc)
+            tc = _write_residual_block(w, lvl_zz[br, bc], nc, 16)
+            nnz_y[k, br, bc] = tc
+        if cbp_chroma:
+            for ci in range(2):
+                scan = np.array([cdc_lvl[ci, 0, 0], cdc_lvl[ci, 0, 1],
+                                 cdc_lvl[ci, 1, 0], cdc_lvl[ci, 1, 1]])
+                _write_residual_block(w, scan, -1, 4)
+        if cbp_chroma == 2:
+            for ci in range(2):
+                for br in range(2):
+                    for bc in range(2):
+                        nc = I16Encoder._nc_chroma(nnz_c, k, ci, br, bc)
+                        tc = _write_residual_block(
+                            w, cac_lvl[ci, br, bc][1:], nc, 15)
+                        nnz_c[k, ci, br, bc] = tc
+        else:
+            nnz_c[k] = 0
+
+        # ---- reconstruction (decode path): zero the groups not coded
+        for br in range(4):
+            for bc in range(4):
+                g8 = (br // 2) * 2 + (bc // 2)
+                d = np.zeros(16, np.int64)
+                if (cbp_luma >> g8) & 1:
+                    d[ZIGZAG4_NP] = lvl_zz[br, bc]
+                d = _dequant4_ac(d.reshape(4, 4), qp)
+                r = (_inv4(d) + 32) >> 6
+                blk = np.clip(ref[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] + r,
+                              0, 255)
+                b.recon_y[y0 + br * 4:y0 + br * 4 + 4,
+                          x0 + bc * 4:x0 + bc * 4 + 4] = blk
+        for ci, plane in ((0, b.recon_u), (1, b.recon_v)):
+            for br in range(2):
+                for bc in range(2):
+                    d = np.zeros(16, np.int64)
+                    if cbp_chroma == 2:
+                        d[ZIGZAG4_NP] = cac_lvl[ci, br, bc]
+                    d = _dequant4_ac(d.reshape(4, 4), qpc)
+                    if cbp_chroma:
+                        d[0, 0] = cdcq[ci, br, bc]
+                    else:
+                        d[0, 0] = 0
+                    r = (_inv4(d) + 32) >> 6
+                    blk = np.clip(
+                        cref[ci][br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] + r,
+                        0, 255)
+                    plane[row * 8 + br * 4:row * 8 + br * 4 + 4,
+                          k * 8 + bc * 4:k * 8 + bc * 4 + 4] = blk
+        return 0
+
+
+def p_slice_header_events(mb_w: int, n_rows: int):
+    """Per-row P-slice header PREFIX events: ue(first_mb), ue(5 P),
+    ue(0 pps) — frame_num/flags/qp/deblock are device events."""
+    pay = np.zeros((n_rows, 2), np.uint32)
+    nb = np.zeros((n_rows, 2), np.int32)
+    for r in range(n_rows):
+        w = BitWriter()
+        w.ue(r * mb_w)
+        w.ue(5)
+        w.ue(0)
+        bits = w.bits
+        assert len(bits) <= 62
+        for slot, chunk in enumerate((bits[:31], bits[31:])):
+            if chunk:
+                val = 0
+                for b in chunk:
+                    val = (val << 1) | b
+                pay[r, slot] = val
+                nb[r, slot] = len(chunk)
+    return pay, nb

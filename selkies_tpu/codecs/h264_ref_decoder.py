@@ -366,14 +366,21 @@ class Decoder:
         r = BitReader(nal[1:])
         first_mb = r.ue()
         slice_type = r.ue()
-        if slice_type % 5 != 2:
-            raise NotImplementedError("non-I slice")
+        st = slice_type % 5
+        if st not in (0, 2):
+            raise NotImplementedError(f"slice type {slice_type}")
+        is_p = st == 0
         r.ue()  # pps id
         r.u(sps.log2_max_frame_num)
         if (nal[0] & 0x1F) == 5:
             r.ue()  # idr_pic_id
         if sps.poc_type == 0:
             r.u(sps.log2_max_poc_lsb)
+        if is_p:
+            if r.u(1):                      # num_ref_idx_active_override
+                r.ue()
+            if r.u(1):                      # ref_pic_list_modification_l0
+                raise NotImplementedError("ref list modification")
         if (nal[0] >> 5) and (nal[0] & 0x1F) == 5:
             r.u(1); r.u(1)  # dec_ref_pic_marking for IDR
         elif (nal[0] >> 5):
@@ -389,11 +396,32 @@ class Decoder:
                 # no-deblock for byte-exact comparisons.
                 r.se(); r.se()
         mb_addr = first_mb
+        last_of_slice = self.mb_count       # row-sliced streams stop at EOD
         while True:
-            qp = self._decode_mb(r, mb_addr, qp, slice_id)  # QPy persists
+            if is_p:
+                skip = r.ue()               # mb_skip_run
+                for _ in range(skip):
+                    self.mb_slice[mb_addr] = slice_id   # P_Skip: copy recon
+                    self._zero_counts(mb_addr)
+                    mb_addr += 1
+                if mb_addr >= last_of_slice or not r.more_rbsp_data():
+                    break
+                qp = self._decode_p_mb(r, mb_addr, qp, slice_id)
+            else:
+                qp = self._decode_mb(r, mb_addr, qp, slice_id)
             mb_addr += 1
-            if mb_addr >= self.mb_count or not r.more_rbsp_data():
+            if mb_addr >= last_of_slice or not r.more_rbsp_data():
                 break
+
+    def _zero_counts(self, mb_addr: int) -> None:
+        mbx, mby = mb_addr % self.mb_w, mb_addr // self.mb_w
+        for br in range(4):
+            for bc in range(4):
+                self.nnz_y[(mbx, mby, br, bc)] = 0
+        for comp in range(2):
+            for br in range(2):
+                for bc in range(2):
+                    self.nnz_c[(mbx, mby, comp, br, bc)] = 0
 
     # --------------------------------------------------------------- mb
     def _nc_luma(self, mbx, mby, blk_r, blk_c, slice_id) -> int:
@@ -440,6 +468,90 @@ class Decoder:
         if nb is not None:
             return nb
         return 0
+
+    def _decode_p_mb(self, r: BitReader, mb_addr: int, qp: int,
+                     slice_id: int) -> int:
+        """P_L0_16x16 with zero motion (the only inter mode our encoder
+        emits; anything else raises)."""
+        mbx, mby = mb_addr % self.mb_w, mb_addr // self.mb_w
+        self.mb_slice[mb_addr] = slice_id
+        mb_type = r.ue()
+        if mb_type != 0:
+            raise NotImplementedError(f"P mb_type {mb_type}")
+        mvdx, mvdy = r.se(), r.se()
+        if mvdx or mvdy:
+            raise NotImplementedError("non-zero motion")
+        cbp = int(T.CBP_INTER_CODE2CBP[r.ue()])
+        if cbp:
+            qp = qp + r.se()
+        qpc = int(_QPC[np.clip(qp + self.pps.chroma_qp_index_offset, 0, 51)])
+        cbp_luma, cbp_chroma = cbp & 0xF, cbp >> 4
+
+        luma = np.zeros((4, 4, 16), np.int64)
+        for blk_idx in range(16):
+            br, bc = _LUMA_BLK_ORDER[blk_idx]
+            g8 = (br // 2) * 2 + (bc // 2)
+            if (cbp_luma >> g8) & 1:
+                nc = self._nc_luma(mbx, mby, br, bc, slice_id)
+                coeffs = residual_block(r, nc, 16)
+                self.nnz_y[(mbx, mby, br, bc)] = \
+                    int(np.count_nonzero(coeffs))
+                zz = np.zeros(16, np.int64)
+                zz[ZIGZAG4[:16]] = coeffs
+                luma[br, bc] = zz
+            else:
+                self.nnz_y[(mbx, mby, br, bc)] = 0
+
+        cdc = np.zeros((2, 2, 2), np.int64)
+        cac = np.zeros((2, 2, 2, 16), np.int64)
+        if cbp_chroma:
+            H2 = np.array([[1, 1], [1, -1]], np.int64)
+            for comp in range(2):
+                coeffs = residual_block(r, -1, 4)
+                blk = np.array([[coeffs[0], coeffs[1]],
+                                [coeffs[2], coeffs[3]]], np.int64)
+                cdc[comp] = _dequant_chroma_dc(H2 @ blk @ H2, qpc)
+        if cbp_chroma == 2:
+            for comp in range(2):
+                for br in range(2):
+                    for bc in range(2):
+                        nc = self._nc_chroma(mbx, mby, comp, br, bc,
+                                             slice_id)
+                        coeffs = residual_block(r, nc, 15)
+                        self.nnz_c[(mbx, mby, comp, br, bc)] = \
+                            int(np.count_nonzero(coeffs))
+                        zz = np.zeros(16, np.int64)
+                        zz[ZIGZAG4[1:]] = coeffs
+                        cac[comp, br, bc] = zz
+        else:
+            for comp in range(2):
+                for br in range(2):
+                    for bc in range(2):
+                        self.nnz_c[(mbx, mby, comp, br, bc)] = 0
+
+        # recon = previous picture (zero MV) + residual; read ref FIRST
+        y0, x0 = mby * 16, mbx * 16
+        ref = self.Y[y0:y0 + 16, x0:x0 + 16].astype(np.int64).copy()
+        for br in range(4):
+            for bc in range(4):
+                d = _dequant4x4_ac(luma[br, bc].reshape(4, 4), qp)
+                res = (_inv4x4(d) + 32) >> 6
+                self.Y[y0 + br * 4:y0 + br * 4 + 4,
+                       x0 + bc * 4:x0 + bc * 4 + 4] = np.clip(
+                    ref[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] + res, 0, 255)
+        cy0, cx0 = mby * 8, mbx * 8
+        for comp, plane in ((0, self.U), (1, self.V)):
+            cref = plane[cy0:cy0 + 8, cx0:cx0 + 8].astype(np.int64).copy()
+            for br in range(2):
+                for bc in range(2):
+                    d = _dequant4x4_ac(cac[comp, br, bc].reshape(4, 4), qpc)
+                    d[0, 0] = cdc[comp, br, bc]
+                    res = (_inv4x4(d) + 32) >> 6
+                    plane[cy0 + br * 4:cy0 + br * 4 + 4,
+                          cx0 + bc * 4:cx0 + bc * 4 + 4] = np.clip(
+                        cref[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] + res,
+                        0, 255)
+        return qp
 
     def _decode_mb(self, r: BitReader, mb_addr: int, qp: int,
                    slice_id: int) -> int:

@@ -265,3 +265,26 @@ QPC_NP = np.concatenate([
               38, 38, 38, 39, 39, 39, 39])]).astype(np.int32)
 ZIGZAG4_NP = np.array([0, 1, 4, 8, 5, 2, 3, 6, 9, 12, 13, 10, 7, 11, 14, 15],
                       np.int32)
+
+
+# --------------------------------------------------------------------------
+# Table 9-4: coded_block_pattern me(v) mapping, INTER column (P slices):
+# code_num -> cbp. The encoder needs the inverse (cbp -> code_num).
+# --------------------------------------------------------------------------
+CBP_INTER_CODE2CBP = np.array([
+    0, 16, 1, 2, 4, 8, 32, 3, 5, 10, 12, 15, 47, 7, 11, 13,
+    14, 6, 9, 31, 35, 37, 42, 44, 33, 34, 36, 40, 39, 43, 45, 46,
+    17, 18, 20, 24, 19, 21, 26, 28, 23, 27, 29, 30, 22, 25, 38, 41,
+], np.int32)
+CBP_INTER_CBP2CODE = np.zeros(48, np.int32)
+for _code, _cbp in enumerate(CBP_INTER_CODE2CBP):
+    CBP_INTER_CBP2CODE[_cbp] = _code
+
+# Intra column (used when an I_16x16-less intra MB would appear in a P
+# slice — our encoder never emits those, but the decoder may meet them in
+# foreign streams).
+CBP_INTRA_CODE2CBP = np.array([
+    47, 31, 15, 0, 23, 27, 29, 30, 7, 11, 13, 14, 39, 43, 45, 46,
+    16, 3, 5, 10, 12, 19, 21, 26, 28, 35, 37, 42, 44, 1, 2, 4,
+    8, 17, 18, 20, 24, 6, 9, 22, 25, 32, 33, 34, 36, 40, 38, 41,
+], np.int32)

@@ -11,9 +11,12 @@ ops/h264_encode.py underneath:
 - damage gating: unchanged stripes are skipped; paint-over re-sends a
   settled stripe once at ``paint_over_qp`` — the per-row qp select runs
   ON DEVICE, so neither rate control nor paint-over ever syncs the host;
-- every sent stripe is an IDR access unit (SPS+PPS+slices): chain gating
-  degenerates to "always safe", and a lost stripe recovers on the next
-  damage or keyframe_interval refresh.
+- adaptive I/P: the first frame and every forced refresh are IDR access
+  units (SPS+PPS+slices); all other frames are P frames with zero-motion
+  conditional replenishment — unchanged macroblocks code as P_Skip
+  (bytes, not kilobytes), changed ones carry residual against the
+  device-resident decoder-exact reconstruction. The relay's per-stripe
+  chain gating plus keyframe recovery handle any P loss.
 
 Only the byte buffer + lengths + flags leave the chip (bitrate-sized).
 """
@@ -30,7 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..codecs import h264 as hcodec
-from ..ops.h264_encode import SLOTS_MB, h264_encode_yuv, rgb_to_yuv420
+from ..ops.h264_encode import (P_SLOTS_MB, SLOTS_MB, h264_encode_p_yuv,
+                               h264_encode_yuv, rgb_to_yuv420)
 from ..ops.stripes import concat_stripe_bytes, words_to_bytes_device
 from .types import CaptureSettings, EncodedChunk
 
@@ -68,18 +72,28 @@ def plan_h264_grid(s: CaptureSettings) -> _Grid:
 
 
 @functools.cache
-def _jitted_h264_step(width: int, stripe_h: int, n_stripes: int,
+def _jitted_h264_step(mode: str, width: int, stripe_h: int, n_stripes: int,
                       e_cap: int, w_cap: int, out_cap: int,
                       paint_delay: int, damage_gating: bool,
                       paint_over: bool):
-    """step(frame u8 (H,W,3), prev u8, age i32 (S,), qp_motion i32,
-    qp_paint i32, hdr_pay u32 (R,2), hdr_nb i32 (R,2))
-    -> (data u8 (out_cap,), row_lens i32 (R,), send bool (S,),
-        is_paint bool (S,), age i32 (S,), overflow bool)"""
+    """Compiled per-frame step for ``mode`` in {"i", "p"}.
+
+    Both modes share the damage/paint-over/stream-counter logic and
+    maintain the decoder-exact reconstruction planes on device — the P
+    mode's reference. state inputs (age, sent, fnum, ref planes) are
+    donated.
+
+    signature (I): step(frame, prev, age, sent, fnum, ref_y, ref_u, ref_v,
+                        qp_motion, qp_paint, force, hdr_pay, hdr_nb)
+    signature (P): same, ``force`` unused (P is never forced).
+    -> (data u8 (out_cap,), row_lens i32 (R,), send (S,), is_paint (S,),
+        age (S,), sent (S,), fnum (S,), recon_y, recon_u, recon_v,
+        overflow)
+    """
     rows_per_stripe = stripe_h // 16
 
-    def step(frame, prev, age, sent, qp_motion, qp_paint, force,
-             hdr_pay, hdr_nb):
+    def step(frame, prev, age, sent, fnum, ref_y, ref_u, ref_v,
+             qp_motion, qp_paint, force, hdr_pay, hdr_nb):
         s = n_stripes
         stripes = frame.reshape(s, stripe_h, width, 3)
         if damage_gating:
@@ -95,25 +109,47 @@ def _jitted_h264_step(width: int, stripe_h: int, n_stripes: int,
         send = damage | is_paint | force
         qp_stripe = jnp.where(is_paint, qp_paint, qp_motion)
         qp_rows = jnp.repeat(qp_stripe, rows_per_stripe)
-        # consecutive IDRs of one stripe stream must differ in idr_pic_id
-        # (§7.4.3); the per-stripe sent counter lives ON DEVICE so damage
-        # gating and pipelining can't desynchronise it. A 4-bit cycle (not
-        # parity) keeps the invariant even across overflow-dropped frames,
-        # which consume counter values the client never sees — a collision
-        # would need exactly 15 consecutively dropped sends.
-        idr_rows = jnp.repeat(sent & 0xF, rows_per_stripe)
-        sent = sent + send.astype(jnp.int32)
-
         yf, uf, vf = rgb_to_yuv420(frame)
-        out = h264_encode_yuv(yf, uf, vf, qp_rows, hdr_pay, hdr_nb,
-                              e_cap, w_cap, idr_pic_id=idr_rows)
+
+        if mode == "i":
+            # consecutive IDRs of one stripe stream must differ in
+            # idr_pic_id (§7.4.3); a 4-bit cycle of the device-resident
+            # sent counter keeps that even across overflow-dropped frames
+            idr_rows = jnp.repeat(sent & 0xF, rows_per_stripe)
+            sent = sent + send.astype(jnp.int32)
+            # IDR resets the stream's frame_num; next P in the stream is 1
+            fnum = jnp.where(send, 1, fnum)
+            out, recon = h264_encode_yuv(
+                yf, uf, vf, qp_rows, hdr_pay, hdr_nb, e_cap, w_cap,
+                idr_pic_id=idr_rows, want_recon=True)
+        else:
+            fn_rows = jnp.repeat(fnum, rows_per_stripe)
+            sent = sent + send.astype(jnp.int32)
+            fnum = jnp.where(send, fnum + 1, fnum)
+            out, recon = h264_encode_p_yuv(
+                yf, uf, vf, ref_y, ref_u, ref_v, qp_rows,
+                hdr_pay, hdr_nb, fn_rows, e_cap, w_cap)
+
+        # the reference only advances for DELIVERED stripes: finalize drops
+        # unsent ones, and a reference the client never saw would drift the
+        # next P slice into visible corruption
+        def gate(new, old, sh):
+            ns = new.reshape(s, sh, -1)
+            os_ = old.reshape(s, sh, -1)
+            sel = jnp.where(send[:, None, None], ns, os_)
+            return sel.reshape(new.shape)
+        new_ry = gate(recon[0], ref_y, stripe_h)
+        new_ru = gate(recon[1], ref_u, stripe_h // 2)
+        new_rv = gate(recon[2], ref_v, stripe_h // 2)
+
         sbytes, row_lens = words_to_bytes_device(out.words, out.total_bits,
                                                  pad_ones=False)
         buf = concat_stripe_bytes(sbytes, row_lens, out_cap)
         overflow = out.overflow | buf.overflow
-        return (buf.data, buf.byte_lens, send, is_paint, age, sent, overflow)
+        return (buf.data, buf.byte_lens, send, is_paint, age, sent, fnum,
+                new_ry, new_ru, new_rv, overflow)
 
-    return jax.jit(step, donate_argnums=(2, 3))
+    return jax.jit(step, donate_argnums=(2, 3, 4, 5, 6, 7))
 
 
 class H264EncoderSession:
@@ -125,7 +161,7 @@ class H264EncoderSession:
         self.grid = plan_h264_grid(settings)
         g = self.grid
         self.n_rows = g.n_stripes * g.rows_per_stripe
-        self._e_cap = 7 + g.mb_w * SLOTS_MB + 1
+        self._e_cap = 9 + g.mb_w * max(SLOTS_MB, P_SLOTS_MB) + 2
         # _w_cap (32-bit WORDS per row) bounds device-side buffers only;
         # _out_cap is the BYTE capacity of the whole-frame concat buffer —
         # the one array that crosses the host link every frame, so it is
@@ -133,11 +169,16 @@ class H264EncoderSession:
         # worst case; overflow grows it (and forces a clean refresh).
         self._w_cap = max(2048, g.mb_w * 768 // 4)
         self._out_cap = max(192 * 1024, g.width * g.height // 6)
-        self._step = self._build_step()
+        self._i_step = self._build_step("i")
+        self._p_step = self._build_step("p")
         self.frame_id = 0
         self._age = jnp.zeros((g.n_stripes,), jnp.int32)
         self._sent = jnp.zeros((g.n_stripes,), jnp.int32)
+        self._fnum = jnp.zeros((g.n_stripes,), jnp.int32)
         self._prev = jnp.zeros((g.height, g.width, 3), jnp.uint8)
+        self._ref_y = jnp.zeros((g.height, g.width), jnp.uint8)
+        self._ref_u = jnp.zeros((g.height // 2, g.width // 2), jnp.uint8)
+        self._ref_v = jnp.zeros((g.height // 2, g.width // 2), jnp.uint8)
         self._force_after_drop = False
         self._cap_gen = 0   # buffer-growth generation (pipelined frames
         #                     encoded with stale caps must not re-grow)
@@ -149,6 +190,9 @@ class H264EncoderSession:
         pay, nb = hcodec.slice_header_events(g.mb_w, g.rows_per_stripe)
         self._hdr_pay = jnp.asarray(np.tile(pay, (g.n_stripes, 1)))
         self._hdr_nb = jnp.asarray(np.tile(nb, (g.n_stripes, 1)))
+        ppay, pnb = hcodec.p_slice_header_events(g.mb_w, g.rows_per_stripe)
+        self._p_hdr_pay = jnp.asarray(np.tile(ppay, (g.n_stripes, 1)))
+        self._p_hdr_nb = jnp.asarray(np.tile(pnb, (g.n_stripes, 1)))
         from .watermark import maybe_load
         # anchored against the VISIBLE size (padding is cropped client-side)
         self._watermark = maybe_load(settings, g.out_w, g.out_h)
@@ -156,9 +200,9 @@ class H264EncoderSession:
         self.paint_qp = int(np.clip(
             settings.video_min_qp, 8, self.qp))
 
-    def _build_step(self):
+    def _build_step(self, mode: str):
         g, s = self.grid, self.settings
-        return _jitted_h264_step(g.width, g.stripe_h, g.n_stripes,
+        return _jitted_h264_step(mode, g.width, g.stripe_h, g.n_stripes,
                                  self._e_cap, self._w_cap, self._out_cap,
                                  s.paint_over_delay_frames,
                                  s.use_damage_gating, s.use_paint_over)
@@ -183,20 +227,35 @@ class H264EncoderSession:
     # -- device step --------------------------------------------------------
     def encode(self, frame: jnp.ndarray, force: bool = False
                ) -> dict[str, Any]:
-        """``force`` resends every stripe; it must be decided HERE (not at
-        finalize) so the on-device idr_pic_id parity counts it."""
+        """One adaptive I/P step. ``force`` (client keyframe request,
+        keyframe_interval, post-overflow recovery) and the very first
+        frame produce IDRs; every other frame is a P with on-device
+        P_Skip for unchanged macroblocks. The mode must be decided HERE
+        (not at finalize) so the device stream counters see it."""
         if self._force_after_drop:
             self._force_after_drop = False
             force = True
+        if self.frame_id == 0:
+            # every stripe stream must OPEN with an IDR: an undamaged
+            # stripe skipped here would otherwise debut as a P delta
+            force = True
+        intra = bool(force)
         if self._watermark is not None:
             frame = self._watermark.apply(frame)
-        data, row_lens, send, is_paint, age, sent, overflow = self._step(
-            frame, self._prev, self._age, self._sent,
+        step = self._i_step if intra else self._p_step
+        hdr_pay = self._hdr_pay if intra else self._p_hdr_pay
+        hdr_nb = self._hdr_nb if intra else self._p_hdr_nb
+        (data, row_lens, send, is_paint, age, sent, fnum,
+         ry, ru, rv, overflow) = step(
+            frame, self._prev, self._age, self._sent, self._fnum,
+            self._ref_y, self._ref_u, self._ref_v,
             jnp.int32(self.qp), jnp.int32(self.paint_qp),
-            jnp.asarray(bool(force)), self._hdr_pay, self._hdr_nb)
+            jnp.asarray(bool(force)), hdr_pay, hdr_nb)
         self._prev = frame
         self._age = age
         self._sent = sent
+        self._fnum = fnum
+        self._ref_y, self._ref_u, self._ref_v = ry, ru, rv
         fid = self.frame_id
         self.frame_id = (self.frame_id + 1) & 0xFFFF
         for arr in (data, row_lens, send, is_paint, overflow):
@@ -206,7 +265,7 @@ class H264EncoderSession:
                 pass
         return {"data": data, "lens": row_lens, "send": send,
                 "is_paint": is_paint, "overflow": overflow, "frame_id": fid,
-                "cap_gen": self._cap_gen}
+                "intra": intra, "cap_gen": self._cap_gen}
 
     # -- host tail ----------------------------------------------------------
     def finalize(self, out: dict[str, Any], force_all: bool = False
@@ -224,12 +283,14 @@ class H264EncoderSession:
                 self._w_cap *= 2
                 self._out_cap *= 2
                 self._cap_gen += 1
-                self._step = self._build_step()
+                self._i_step = self._build_step("i")
+                self._p_step = self._build_step("p")
             self._force_after_drop = True
             return []
         data = np.asarray(out["data"])
         lens = np.asarray(out["lens"])            # (R,) per MB row
         send = np.asarray(out["send"])
+        intra = out.get("intra", True)
         starts = np.concatenate([[0], np.cumsum(lens)])
         chunks: list[EncodedChunk] = []
         rps = g.rows_per_stripe
@@ -239,11 +300,15 @@ class H264EncoderSession:
             rows = []
             for r in range(i * rps, (i + 1) * rps):
                 rows.append(bytes(data[starts[r]:starts[r] + lens[r]]))
-            payload = self._sps_pps + hcodec.assemble_annexb(rows)
+            if intra:
+                payload = self._sps_pps + hcodec.assemble_annexb(rows)
+            else:
+                payload = b"".join(
+                    hcodec.nal(1, rb, ref_idc=2) for rb in rows)
             chunks.append(EncodedChunk(
                 payload=payload, frame_id=out["frame_id"],
                 stripe_y=i * g.stripe_h, width=g.width, height=g.stripe_h,
-                is_idr=True, output_mode="h264",
+                is_idr=intra, output_mode="h264",
                 seat_index=self.settings.seat_index,
                 display_id=self.settings.display_id))
         return chunks

@@ -122,3 +122,66 @@ def encode_x264_idr(y: np.ndarray, u: np.ndarray, v: np.ndarray,
     if size <= 0:
         raise RuntimeError(f"x264 encode failed ({size})")
     return out[:size].tobytes()
+
+
+class H264Session:
+    """Stateful ffmpeg H.264 decode session: feed Annex-B access units in
+    order (I then P frames reference prior pictures). The oracle for
+    multi-frame conformance."""
+
+    def __init__(self, max_w: int = 4096, max_h: int = 4096):
+        lib = _get()
+        if lib is None:
+            raise RuntimeError("avshim unavailable")
+        self._lib = lib
+        self._h = lib.dec_open(b"h264")
+        if not self._h:
+            raise RuntimeError("h264 decoder open failed")
+        self._y = np.empty(max_w * max_h, np.uint8)
+        self._u = np.empty(max_w * max_h // 4, np.uint8)
+        self._v = np.empty(max_w * max_h // 4, np.uint8)
+
+    def _planes(self, w, h):
+        return (self._y[:w * h].reshape(h, w).copy(),
+                self._u[:w * h // 4].reshape(h // 2, w // 2).copy(),
+                self._v[:w * h // 4].reshape(h // 2, w // 2).copy())
+
+    def decode(self, au: bytes):
+        """-> (Y, U, V) for the decoded picture, or None when the decoder
+        wants more data (delay)."""
+        p = ctypes.POINTER(ctypes.c_ubyte)
+        buf = (ctypes.c_ubyte * len(au)).from_buffer_copy(au)
+        w = ctypes.c_int(0)
+        h = ctypes.c_int(0)
+        ret = self._lib.dec_decode(
+            ctypes.c_void_p(self._h), buf, len(au),
+            self._y.ctypes.data_as(p), self._u.ctypes.data_as(p),
+            self._v.ctypes.data_as(p), ctypes.byref(w), ctypes.byref(h))
+        if ret == 1:
+            return None
+        if ret != 0:
+            raise ValueError(f"h264 decode failed (ret={ret})")
+        return self._planes(w.value, h.value)
+
+    def flush(self):
+        p = ctypes.POINTER(ctypes.c_ubyte)
+        w = ctypes.c_int(0)
+        h = ctypes.c_int(0)
+        ret = self._lib.dec_flush(
+            ctypes.c_void_p(self._h),
+            self._y.ctypes.data_as(p), self._u.ctypes.data_as(p),
+            self._v.ctypes.data_as(p), ctypes.byref(w), ctypes.byref(h))
+        if ret != 0:
+            return None
+        return self._planes(w.value, h.value)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.dec_close(ctypes.c_void_p(self._h))
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

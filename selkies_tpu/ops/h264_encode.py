@@ -315,7 +315,8 @@ def h264_encode_yuv(yf: jnp.ndarray, uf: jnp.ndarray, vf: jnp.ndarray,
                     qp: jnp.ndarray, header_pay: jnp.ndarray,
                     header_nb: jnp.ndarray,
                     e_cap: int, w_cap: int,
-                    idr_pic_id: jnp.ndarray | int = 0) -> H264FrameOut:
+                    idr_pic_id: jnp.ndarray | int = 0,
+                    want_recon: bool = False):
     """YUV420 int planes -> per-MB-row slice RBSP bit-streams.
 
     ``qp`` is a traced scalar or (R,) PER-ROW vector (paint-over and rate
@@ -405,7 +406,7 @@ def h264_encode_yuv(yf: jnp.ndarray, uf: jnp.ndarray, vf: jnp.ndarray,
             pred_c[..., None]
             + ((inv_c_edge[:, :, :, k, :] + dcC[..., 1:2] + 32) >> 6)
         ).reshape(R, 2, 8)
-        return (new_edge_y, new_edge_c), (dlvl, clvl)
+        return (new_edge_y, new_edge_c), (dlvl, clvl, pred_y, pred_c)
 
     # init derived from a (zeroed) slice of the input so the carry carries
     # the same shard_map varying-axis type as the body output; XLA folds
@@ -413,10 +414,40 @@ def h264_encode_yuv(yf: jnp.ndarray, uf: jnp.ndarray, vf: jnp.ndarray,
     anchor = 0 * yrows[:, 0, 0].astype(jnp.int32)          # (R,)
     init = (jnp.zeros((R, 16), jnp.int32) + anchor[:, None],
             jnp.zeros((R, 2, 8), jnp.int32) + anchor[:, None, None])
-    _, (dc_lvls, cdc_lvls) = jax.lax.scan(step, init,
-                                          jnp.arange(M, dtype=jnp.int32))
+    _, (dc_lvls, cdc_lvls, preds_y, preds_c) = jax.lax.scan(
+        step, init, jnp.arange(M, dtype=jnp.int32))
     dc_lvls = jnp.moveaxis(dc_lvls, 0, 1)      # (R, M, 4, 4)
     cdc_lvls = jnp.moveaxis(cdc_lvls, 0, 1)    # (R, M, 2, 2, 2)
+    preds_y = jnp.moveaxis(preds_y, 0, 1)      # (R, M)
+    preds_c = jnp.moveaxis(preds_c, 0, 1)      # (R, M, 2, by2)
+
+    if want_recon:
+        # decoder-exact reconstruction of the whole frame (the P path's
+        # reference). DC terms recomputed in parallel from the scan's level
+        # outputs; everything else was parallel already.
+        f_all = jnp.einsum("ij,rmjk,kl->rmil", _H4, dc_lvls, _H4)
+        dcY_all = _dequant_ldc(f_all, qp[:, None, None, None])  # (R,M,4,4)
+        inv_y_full = inverse4x4(d_y)           # (R, by, M, bx, 4, 4)
+        dcY_b = jnp.moveaxis(dcY_all, 1, 2)    # (R, by, M, bx)
+        py = preds_y[:, None, :, None, None, None]       # (R,1,M,1,1,1)
+        rec_y = clip1(py + ((inv_y_full + dcY_b[..., None, None] + 32) >> 6))
+        # (R, by, M, bx, 4, 4) -> (R*16 rows, W)
+        recon_y = rec_y.transpose(0, 1, 4, 2, 3, 5).reshape(R * 16, W)
+        f2_all = _had2(cdc_lvls)               # (R, M, 2, 2, 2)
+        dcC_all = _dequant_cdc(f2_all, qpc[:, None, None, None, None])
+        inv_c_full = inverse4x4(d_c)           # (R, 2, by2, M, bx2, 4, 4)
+        # dcC_all is (R, M, comp, by2, bx2) -> want (R, comp, by2, M, bx2)
+        dcC_b = jnp.transpose(dcC_all, (0, 2, 3, 1, 4))
+        pc = jnp.transpose(preds_c, (0, 2, 3, 1))        # (R, 2, by2, M)
+        rec_c = clip1(pc[..., None, None, None]
+                      + ((inv_c_full + dcC_b[..., None, None] + 32) >> 6))
+        # (R, 2, by2, M, bx2, 4, 4) -> (2, R*8, W//2)
+        recon_c = rec_c.transpose(1, 0, 2, 5, 3, 4, 6).reshape(
+            2, R * 8, W // 2)
+        recon = (recon_y.astype(jnp.uint8), recon_c[0].astype(jnp.uint8),
+                 recon_c[1].astype(jnp.uint8))
+    else:
+        recon = None
 
     # ---- CAVLC ------------------------------------------------------------
     # per-block tc for nC contexts: (R, M, by, bx) luma AC counts
@@ -437,44 +468,9 @@ def h264_encode_yuv(yf: jnp.ndarray, uf: jnp.ndarray, vf: jnp.ndarray,
     tc_y_eff = jnp.where(cbp_luma[..., None, None], tc_y, 0)
     tc_c_eff = jnp.where((cbp_chroma == 2)[:, None, :, None, None], tc_c, 0)
 
-    # nC gathers. left: same MB bx-1, or left MB bx=3; above: same MB by-1,
-    # or unavailable (slice boundary at MB row).
-    def nc_luma():
-        shp = tc_y.shape                           # (R, M, by, bx)
-        bx = jax.lax.broadcasted_iota(jnp.int32, shp, 3)
-        by = jax.lax.broadcasted_iota(jnp.int32, shp, 2)
-        mb = jax.lax.broadcasted_iota(jnp.int32, shp, 1)
-        left_in = jnp.pad(tc_y_eff[..., :-1], ((0, 0),) * 3 + ((1, 0),))
-        left_mb = jnp.pad(tc_y_eff[:, :-1, :, 3], ((0, 0), (1, 0), (0, 0)))
-        na = jnp.where(bx == 0, left_mb[..., None], left_in)
-        a_avail = (bx > 0) | (mb > 0)
-        up_in = jnp.pad(tc_y_eff[..., :-1, :],
-                        ((0, 0),) * 2 + ((1, 0), (0, 0)))
-        b_avail = by > 0
-        both = a_avail & b_avail
-        return jnp.where(both, (na + up_in + 1) >> 1,
-                         jnp.where(a_avail, na,
-                                   jnp.where(b_avail, up_in, 0)))
-
-    nc_y = nc_luma()
-
-    def nc_chroma():
-        shp = tc_c.shape                           # (R, 2, M, by2, bx2)
-        bx = jax.lax.broadcasted_iota(jnp.int32, shp, 4)
-        by = jax.lax.broadcasted_iota(jnp.int32, shp, 3)
-        mb = jax.lax.broadcasted_iota(jnp.int32, shp, 2)
-        left_in = jnp.pad(tc_c_eff[..., :-1], ((0,0),)*4 + ((1,0),))
-        left_mb = jnp.pad(tc_c_eff[:, :, :-1, :, 1], ((0,0),(0,0),(1,0),(0,0)))
-        na = jnp.where(bx == 0, left_mb[..., None], left_in)
-        a_avail = (bx > 0) | (mb > 0)
-        up_in = jnp.pad(tc_c_eff[..., :-1, :], ((0,0),)*3 + ((1,0),(0,0)))
-        b_avail = by > 0
-        both = a_avail & b_avail
-        return jnp.where(both, (na + up_in + 1) >> 1,
-                         jnp.where(a_avail, na,
-                                   jnp.where(b_avail, up_in, 0)))
-
-    nc_c = nc_chroma()
+    # nC contexts: shared neighbour-rule helpers (also used by the P path)
+    nc_y = _nc_from_counts(tc_y_eff)
+    nc_c = _nc_from_counts_chroma(tc_c_eff)
 
     # DC block nC = block(0,0) context
     nc_dc = nc_y[..., 0, 0]                        # (R, M)
@@ -563,6 +559,286 @@ def h264_encode_yuv(yf: jnp.ndarray, uf: jnp.ndarray, vf: jnp.ndarray,
         jnp.ones((R, 1), jnp.int32),
     ], axis=-1)
 
+    packed = jax.vmap(
+        lambda p, n: pack_slot_events(p[None, :], n[None, :], e_cap, w_cap,
+                                      max_events_per_word=33)
+    )(row_pay, row_nb)
+    out = H264FrameOut(packed.words, packed.total_bits,
+                       jnp.any(packed.overflow), R)
+    if want_recon:
+        return out, recon
+    return out
+
+
+# ---------------------------------------------------------------------------
+# P-frames: zero-motion conditional replenishment (SURVEY §7 step 5).
+# P_Skip for MBs whose quantised residual is all-zero, P_L0_16x16 with
+# mvd (0,0) + residual for the rest. NO sequential work at all: without
+# an intra prediction chain every macroblock is independent, so the whole
+# frame (transforms, quant, recon, CAVLC, skip runs, bit packing) is one
+# parallel program.
+# ---------------------------------------------------------------------------
+
+_CBP2CODE = jnp.asarray(HT.CBP_INTER_CBP2CODE)
+
+P_SLOTS_HDR = 5                       # skip_run, mb_type, mvd, cbp, qp_delta
+SLOTS_BLK16F = 1 + 3 + 16 + 1 + 15    # full 16-coeff luma block
+P_SLOTS_MB = P_SLOTS_HDR + 16 * SLOTS_BLK16F + 2 * SLOTS_BLK4 \
+    + 8 * SLOTS_BLK15
+
+
+def _quant_ac_inter(w, qp):
+    """Inter rounding offset f/6 (JM) — matches the golden encoder."""
+    qbits = 15 + qp // 6
+    mf = MF4[qp % 6]
+    f = jnp.left_shift(jnp.int32(1), qbits) // 6
+    mag = (jnp.abs(w) * mf + f[..., None, None]) >> qbits[..., None, None]
+    return jnp.clip(jnp.where(w < 0, -mag, mag), -LEVEL_CLAMP, LEVEL_CLAMP)
+
+
+def _nc_from_counts(tc_eff):
+    """nC context gather for (R, M, by, bx)-shaped per-block counts
+    (identical neighbour rules as the I path)."""
+    shp = tc_eff.shape
+    bx = jax.lax.broadcasted_iota(jnp.int32, shp, 3)
+    by = jax.lax.broadcasted_iota(jnp.int32, shp, 2)
+    mb = jax.lax.broadcasted_iota(jnp.int32, shp, 1)
+    left_in = jnp.pad(tc_eff[..., :-1], ((0, 0),) * 3 + ((1, 0),))
+    left_mb = jnp.pad(tc_eff[:, :-1, :, 3], ((0, 0), (1, 0), (0, 0)))
+    na = jnp.where(bx == 0, left_mb[..., None], left_in)
+    a_avail = (bx > 0) | (mb > 0)
+    up_in = jnp.pad(tc_eff[..., :-1, :], ((0, 0),) * 2 + ((1, 0), (0, 0)))
+    b_avail = by > 0
+    both = a_avail & b_avail
+    return jnp.where(both, (na + up_in + 1) >> 1,
+                     jnp.where(a_avail, na, jnp.where(b_avail, up_in, 0)))
+
+
+def _nc_from_counts_chroma(tc_eff):
+    """(R, comp, M, by2, bx2) chroma variant."""
+    shp = tc_eff.shape
+    bx = jax.lax.broadcasted_iota(jnp.int32, shp, 4)
+    by = jax.lax.broadcasted_iota(jnp.int32, shp, 3)
+    mb = jax.lax.broadcasted_iota(jnp.int32, shp, 2)
+    left_in = jnp.pad(tc_eff[..., :-1], ((0, 0),) * 4 + ((1, 0),))
+    left_mb = jnp.pad(tc_eff[:, :, :-1, :, 1], ((0, 0), (0, 0), (1, 0),
+                                                (0, 0)))
+    na = jnp.where(bx == 0, left_mb[..., None], left_in)
+    a_avail = (bx > 0) | (mb > 0)
+    up_in = jnp.pad(tc_eff[..., :-1, :], ((0, 0),) * 3 + ((1, 0), (0, 0)))
+    b_avail = by > 0
+    both = a_avail & b_avail
+    return jnp.where(both, (na + up_in + 1) >> 1,
+                     jnp.where(a_avail, na, jnp.where(b_avail, up_in, 0)))
+
+
+def h264_encode_p_yuv(yf, uf, vf, ref_y, ref_u, ref_v, qp,
+                      header_pay, header_nb, frame_num,
+                      e_cap: int, w_cap: int):
+    """P-frame encode against a reference reconstruction.
+
+    All of (yf, uf, vf) and (ref_*) are int32/uint8 planes; ``qp`` and
+    ``frame_num`` are scalars or (R,) vectors. Returns
+    (H264FrameOut, (recon_y, recon_u, recon_v)) — the recon is the next
+    frame's reference, decoder-exact.
+    """
+    H, W = yf.shape[0], yf.shape[1]
+    R, M = H // 16, W // 16
+    qp = jnp.broadcast_to(jnp.asarray(qp, jnp.int32), (R,))
+    qpc = QPC_TABLE[jnp.clip(qp, 0, 51)]
+    fn = jnp.broadcast_to(jnp.asarray(frame_num, jnp.int32), (R,))
+
+    y = yf.astype(jnp.int32).reshape(R, 16, W)
+    u = uf.astype(jnp.int32).reshape(R, 8, W // 2)
+    v = vf.astype(jnp.int32).reshape(R, 8, W // 2)
+    ry = ref_y.astype(jnp.int32).reshape(R, 16, W)
+    ru = ref_u.astype(jnp.int32).reshape(R, 8, W // 2)
+    rv = ref_v.astype(jnp.int32).reshape(R, 8, W // 2)
+
+    # ---- residual transforms (fully parallel)
+    yb = _blocks4(y - ry).reshape(R, 4, M, 4, 4, 4)     # (R,by,M,bx,4,4)
+    wy = forward4x4(yb)
+    ub = _blocks4(u - ru).reshape(R, 2, M, 2, 4, 4)
+    vb = _blocks4(v - rv).reshape(R, 2, M, 2, 4, 4)
+    wc = jnp.stack([forward4x4(ub), forward4x4(vb)], axis=1)
+
+    qp_b = qp[:, None, None, None]
+    qpc_b = qpc[:, None, None, None, None]
+    lvl_y = _quant_ac_inter(wy, qp_b)                    # 16-coeff blocks
+    lvl_c = _quant_ac_inter(wc, qpc_b)
+
+    def to_scan_full(q):
+        return q.reshape(*q.shape[:-2], 16)[..., _ZZ]
+    scan_y = to_scan_full(lvl_y)                         # (R,by,M,bx,16)
+    scan_c_all = to_scan_full(lvl_c)                     # (R,2,by2,M,bx2,16)
+    scan_c = scan_c_all.at[..., 0].set(0)                # AC-only (DC sep)
+
+    # chroma DC via 2x2 hadamard of the W00s (intra-style quant offset,
+    # matching the golden encoder)
+    cdcw = wc[..., 0, 0]                                 # (R,2,by2,M,bx2)
+    cdcw = jnp.moveaxis(cdcw, 3, 2)                      # (R,2,M,by2,bx2)
+    hd2 = _had2(cdcw)
+    clvl = _quant_dc(hd2, qpc[:, None, None, None, None])
+    f2 = _had2(clvl)
+    dcC = _dequant_cdc(f2, qpc[:, None, None, None, None])  # (R,2,M,2,2)
+
+    # ---- cbp per MB
+    any_blk = jnp.any(scan_y != 0, axis=-1)              # (R,by,M,bx)
+    any_blk = jnp.moveaxis(any_blk, 1, 2)                # (R,M,by,bx)
+    # 8x8 group bit g8 = (by//2)*2 + bx//2
+    g = any_blk.reshape(R, M, 2, 2, 2, 2)                # by-> (g_r, r2), bx-> (g_c, c2)
+    grp = jnp.any(g, axis=(3, 5))                        # (R,M,2,2)
+    cbp_luma = (grp[..., 0, 0].astype(jnp.int32)
+                | (grp[..., 0, 1].astype(jnp.int32) << 1)
+                | (grp[..., 1, 0].astype(jnp.int32) << 2)
+                | (grp[..., 1, 1].astype(jnp.int32) << 3))
+    any_cac = jnp.any(scan_c != 0, axis=-1)              # (R,2,by2,M,bx2)
+    hc2 = jnp.any(jnp.moveaxis(any_cac, 3, 2), axis=(1, 3, 4))  # (R,M)
+    has_cdc_m = jnp.any(clvl != 0, axis=(1, 3, 4))       # (R,M)
+    cbp_chroma = jnp.where(hc2, 2, jnp.where(has_cdc_m, 1, 0))
+    cbp = cbp_luma | (cbp_chroma << 4)                   # (R, M)
+    coded = cbp != 0
+    skip = ~coded
+
+    # ---- effective counts + nC
+    tc_y = jnp.moveaxis(jnp.sum(scan_y != 0, axis=-1), 1, 2).astype(jnp.int32)
+    g8_of = jnp.asarray(np.array([[0, 0, 1, 1]] * 2 + [[2, 2, 3, 3]] * 2))
+    grp_bit = (cbp_luma[..., None, None] >> g8_of) & 1   # (R,M,by,bx)
+    tc_y_eff = jnp.where(coded[..., None, None] & (grp_bit == 1), tc_y, 0)
+    nc_y = _nc_from_counts(tc_y_eff)
+    tc_c = jnp.moveaxis(jnp.sum(scan_c != 0, axis=-1), 3, 2).astype(jnp.int32)
+    tc_c_eff = jnp.where((cbp_chroma == 2)[:, None, :, None, None], tc_c, 0)
+    nc_c = _nc_from_counts_chroma(tc_c_eff)
+
+    # ---- recon (decoder-exact): zero out blocks in unset groups
+    lvl_y_gated = jnp.where(
+        jnp.moveaxis(grp_bit & coded[..., None, None], 2, 1)[..., None, None]
+        .astype(bool), lvl_y.reshape(R, 4, M, 4, 4, 4), 0)
+    d_y = _dequant_ac(lvl_y_gated, qp_b)
+    res_y = (inverse4x4(d_y) + 32) >> 6
+    rec_y_blocks = clip1(_blocks4(ry).reshape(R, 4, M, 4, 4, 4) + res_y)
+    recon_y = rec_y_blocks.transpose(0, 1, 4, 2, 3, 5).reshape(R * 16, W)
+
+    # rebuild chroma coeff blocks for recon: AC from lvl_c (gated on
+    # cbp_chroma == 2), DC from dcC (gated on cbp_chroma >= 1)
+    cac_gate = (cbp_chroma == 2)                          # (R,M)
+    c_blocks = jnp.where(cac_gate[:, None, None, :, None, None, None],
+                         lvl_c.reshape(R, 2, 2, M, 2, 4, 4), 0)
+    c_blocks = c_blocks.at[..., 0, 0].set(0)
+    d_c = _dequant_ac(c_blocks, qpc[:, None, None, None, None])
+    dcC_b = jnp.transpose(dcC, (0, 1, 3, 2, 4))          # (R,2,by2,M,bx2)
+    dcC_gated = jnp.where((cbp_chroma >= 1)[:, None, None, :, None],
+                          dcC_b, 0)
+    d_c = d_c.at[..., 0, 0].set(dcC_gated)
+    res_c = (inverse4x4(d_c) + 32) >> 6
+    ref_c_blocks = jnp.stack([_blocks4(ru).reshape(R, 2, M, 2, 4, 4),
+                              _blocks4(rv).reshape(R, 2, M, 2, 4, 4)], 1)
+    rec_c_blocks = clip1(ref_c_blocks + res_c)
+    recon_c = rec_c_blocks.transpose(1, 0, 2, 5, 3, 4, 6).reshape(
+        2, R * 8, W // 2)
+
+    return _assemble_p_rows(
+        R, M, qp, qpc, fn, header_pay, header_nb, cbp, coded, skip,
+        scan_y, nc_y, clvl, scan_c, nc_c, cbp_luma, cbp_chroma,
+        e_cap, w_cap,
+    ), (recon_y.astype(jnp.uint8), recon_c[0].astype(jnp.uint8),
+        recon_c[1].astype(jnp.uint8))
+
+
+def _assemble_p_rows(R, M, qp, qpc, fn, header_pay, header_nb, cbp, coded,
+                     skip, scan_y, nc_y, clvl, scan_c, nc_c,
+                     cbp_luma, cbp_chroma, e_cap, w_cap) -> H264FrameOut:
+    """Slot assembly for P rows: skip runs, MB syntax, residual events."""
+    # ---- per-MB skip-run values (count of skips since the previous coded
+    # MB in the row): prev coded index via an inclusive running max
+    idx = jax.lax.broadcasted_iota(jnp.int32, (R, M), 1)
+    marked = jnp.where(coded, idx, -1)
+    inclusive = jax.lax.associative_scan(jnp.maximum, marked, axis=1)
+    prev_excl = jnp.concatenate(
+        [jnp.full((R, 1), -1, jnp.int32), inclusive[:, :-1]], axis=1)
+    skip_run = idx - prev_excl - 1                       # valid where coded
+    last_coded = inclusive[:, -1]                        # (R,), -1 if none
+    trailing = (M - 1) - last_coded                      # skips after last
+
+    # ---- header-ish events per MB
+    sr_pay, sr_nb = _ue_event(jnp.maximum(skip_run, 0))
+    sr_nb = jnp.where(coded, sr_nb, 0)
+    mbt_pay = jnp.ones((R, M), jnp.uint32)               # ue(0) = '1'
+    mbt_nb = jnp.where(coded, 1, 0)
+    mvd_pay = jnp.full((R, M), 0b11, jnp.uint32)         # se(0) se(0)
+    mvd_nb = jnp.where(coded, 2, 0)
+    cbp_pay, cbp_nb = _ue_event(_CBP2CODE[cbp])
+    cbp_nb = jnp.where(coded, cbp_nb, 0)
+    dqp_pay = jnp.ones((R, M), jnp.uint32)               # se(0) = '1'
+    dqp_nb = jnp.where(coded, 1, 0)
+
+    # ---- residual events
+    scan_y_rm = jnp.moveaxis(scan_y, 1, 2)               # (R,M,by,bx,16)
+    ev_y = cavlc_block_events(scan_y_rm, nc_y, 16)
+    g8_of = jnp.asarray(np.array([[0, 0, 1, 1]] * 2 + [[2, 2, 3, 3]] * 2))
+    blk_on = ((cbp_luma[..., None, None] >> g8_of) & 1).astype(bool) \
+        & coded[..., None, None]
+    order = np.array(
+        [(0, 0), (0, 1), (1, 0), (1, 1), (0, 2), (0, 3), (1, 2), (1, 3),
+         (2, 0), (2, 1), (3, 0), (3, 1), (2, 2), (2, 3), (3, 2), (3, 3)])
+    oy, ox = jnp.asarray(order[:, 0]), jnp.asarray(order[:, 1])
+    y_pay = ev_y.payload[:, :, oy, ox, :]
+    y_nb = jnp.where(blk_on[:, :, oy, ox, None],
+                     ev_y.nbits[:, :, oy, ox, :], 0)
+
+    cdc_scan = jnp.moveaxis(clvl, 2, 1).reshape(R, M, 2, 4)
+    ev_cdc = cavlc_block_events(cdc_scan, jnp.zeros((), jnp.int32), 4,
+                                chroma_dc=True)
+    cdc_nb = jnp.where((cbp_chroma > 0)[..., None, None], ev_cdc.nbits, 0)
+    scan_c_rm = jnp.moveaxis(jnp.moveaxis(scan_c, 3, 2), 1, 2)
+    nc_c_rm = jnp.moveaxis(nc_c, 1, 2)
+    ev_cac = cavlc_block_events(scan_c_rm[..., 1:], nc_c_rm, 15)
+    cac_pay = ev_cac.payload.reshape(R, M, 8, SLOTS_BLK15)
+    cac_nb = jnp.where((cbp_chroma == 2)[..., None, None],
+                       ev_cac.nbits.reshape(R, M, 8, SLOTS_BLK15), 0)
+
+    mb_pay = jnp.concatenate([
+        sr_pay[..., None], mbt_pay[..., None], mvd_pay[..., None],
+        cbp_pay[..., None], dqp_pay[..., None],
+        y_pay.reshape(R, M, 16 * SLOTS_BLK16F),
+        ev_cdc.payload.reshape(R, M, 2 * SLOTS_BLK4),
+        cac_pay.reshape(R, M, 8 * SLOTS_BLK15),
+    ], axis=-1)
+    mb_nb = jnp.concatenate([
+        sr_nb[..., None], mbt_nb[..., None], mvd_nb[..., None],
+        cbp_nb[..., None], dqp_nb[..., None],
+        y_nb.reshape(R, M, 16 * SLOTS_BLK16F),
+        cdc_nb.reshape(R, M, 2 * SLOTS_BLK4),
+        cac_nb.reshape(R, M, 8 * SLOTS_BLK15),
+    ], axis=-1)
+
+    # ---- row stream: host prefix + device tail (frame_num, flags) +
+    # qp tail + MB slots + trailing skip run + stop bit
+    dqp_h = qp - 26
+    qph_pay, qph_nb = _ue_event(jnp.where(dqp_h > 0, 2 * dqp_h - 1,
+                                          -2 * dqp_h))
+    tr_pay, tr_nb = _ue_event(jnp.maximum(trailing, 0))
+    tr_nb = jnp.where(trailing > 0, tr_nb, 0)
+    row_pay = jnp.concatenate([
+        header_pay.astype(jnp.uint32),
+        (fn & 0xF).astype(jnp.uint32)[:, None],          # frame_num u(4)
+        jnp.zeros((R, 1), jnp.uint32),                   # '000' flags
+        qph_pay[:, None],
+        jnp.full((R, 1), 2, jnp.uint32),                 # ue(1) deblock off
+        mb_pay.reshape(R, M * P_SLOTS_MB),
+        tr_pay[:, None],
+        jnp.ones((R, 1), jnp.uint32),                    # rbsp stop bit
+    ], axis=-1)
+    row_nb = jnp.concatenate([
+        header_nb.astype(jnp.int32),
+        jnp.full((R, 1), 4, jnp.int32),
+        jnp.full((R, 1), 3, jnp.int32),
+        qph_nb[:, None],
+        jnp.full((R, 1), 3, jnp.int32),
+        mb_nb.reshape(R, M * P_SLOTS_MB),
+        tr_nb[:, None],
+        jnp.ones((R, 1), jnp.int32),
+    ], axis=-1)
     packed = jax.vmap(
         lambda p, n: pack_slot_events(p[None, :], n[None, :], e_cap, w_cap,
                                       max_events_per_word=33)

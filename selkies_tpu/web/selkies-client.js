@@ -217,7 +217,7 @@ class SelkiesClient {
       return;
     }
     dec.decode(new EncodedVideoChunk({
-      type: "key",                         // every stripe is an IDR AU
+      type: buf[1] === 1 ? "key" : "delta",   // frame_type from the header
       timestamp: fid,
       data: buf.subarray(10),
     }));
