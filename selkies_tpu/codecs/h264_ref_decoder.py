@@ -348,6 +348,13 @@ class Decoder:
         self.nnz_y = {}
         self.nnz_c = {}
         self.mb_slice = {}   # mb_addr -> slice id (availability)
+        self.mvs = {}        # mb_addr -> (mvx, mvy) quarter-pel (P MBs)
+        self.mbinter = {}    # mb_addr -> True for inter MBs (MV pred)
+        # previous-picture snapshot (the P reference); refreshed at each
+        # picture start (first_mb == 0)
+        self.refY = self.Y.copy()
+        self.refU = self.U.copy()
+        self.refV = self.V.copy()
         self.mb_count = (W // 16) * (H // 16)
         slice_id = 0
         for nal in split_nals(annexb):
@@ -365,6 +372,11 @@ class Decoder:
         sps, pps = self.sps, self.pps
         r = BitReader(nal[1:])
         first_mb = r.ue()
+        if first_mb == 0:
+            # new picture: what is on the planes now becomes the reference
+            self.refY = self.Y.copy()
+            self.refU = self.U.copy()
+            self.refV = self.V.copy()
         slice_type = r.ue()
         st = slice_type % 5
         if st not in (0, 2):
@@ -401,8 +413,7 @@ class Decoder:
             if is_p:
                 skip = r.ue()               # mb_skip_run
                 for _ in range(skip):
-                    self.mb_slice[mb_addr] = slice_id   # P_Skip: copy recon
-                    self._zero_counts(mb_addr)
+                    self._decode_skip_mb(mb_addr, slice_id)
                     mb_addr += 1
                 if mb_addr >= last_of_slice or not r.more_rbsp_data():
                     break
@@ -469,18 +480,104 @@ class Decoder:
             return nb
         return 0
 
+    # ------------------------------------------------- motion (P slices)
+    def _neigh_mv(self, bx, by, slice_id):
+        """((mvx, mvy), refIdx) of neighbour MB, or None if unavailable.
+        Availability requires same slice (§8.4.1.3); intra MBs are
+        available with refIdx -1."""
+        if bx < 0 or by < 0 or bx >= self.mb_w:
+            return None
+        addr = by * self.mb_w + bx
+        if self.mb_slice.get(addr) != slice_id:
+            return None
+        if not self.mbinter.get(addr, False):
+            return ((0, 0), -1)
+        return (self.mvs.get(addr, (0, 0)), 0)
+
+    def _mvp(self, mbx, mby, slice_id):
+        """Median luma MV prediction (§8.4.1.3) for a 16x16 partition with
+        refIdx 0 (the only configuration our encoder emits)."""
+        A = self._neigh_mv(mbx - 1, mby, slice_id)
+        B = self._neigh_mv(mbx, mby - 1, slice_id)
+        C = self._neigh_mv(mbx + 1, mby - 1, slice_id)
+        if C is None:
+            C = self._neigh_mv(mbx - 1, mby - 1, slice_id)  # D substitution
+        if B is None and C is None and A is not None:
+            return A[0]
+        cands = [A, B, C]
+        matches = [n for n in cands if n is not None and n[1] == 0]
+        if len(matches) == 1:
+            return matches[0][0]
+        mvs = [n[0] if n is not None else (0, 0) for n in cands]
+        return (sorted(m[0] for m in mvs)[1], sorted(m[1] for m in mvs)[1])
+
+    def _skip_mv(self, mbx, mby, slice_id):
+        """P_Skip motion (§8.4.1.1): zero unless both A and B exist and
+        neither is a zero-MV refIdx-0 MB."""
+        A = self._neigh_mv(mbx - 1, mby, slice_id)
+        B = self._neigh_mv(mbx, mby - 1, slice_id)
+        if A is None or B is None:
+            return (0, 0)
+        if A == ((0, 0), 0) or B == ((0, 0), 0):
+            return (0, 0)
+        return self._mvp(mbx, mby, slice_id)
+
+    def _mc_luma(self, mvx, mvy, x0, y0):
+        """16x16 luma prediction from the reference picture; integer-pel
+        only (our encoder's restriction), coordinates clamped per §8.4.2.2."""
+        if (mvx & 3) or (mvy & 3):
+            raise NotImplementedError("fractional luma MV")
+        dx, dy = mvx >> 2, mvy >> 2
+        H, W = self.refY.shape
+        ys = np.clip(np.arange(y0 + dy, y0 + dy + 16), 0, H - 1)
+        xs = np.clip(np.arange(x0 + dx, x0 + dx + 16), 0, W - 1)
+        return self.refY[np.ix_(ys, xs)].astype(np.int64)
+
+    def _mc_chroma(self, plane, mvx, mvy, cx0, cy0):
+        """8x8 chroma prediction: eighth-sample bilinear (§8.4.2.2.2); mv
+        is the luma quarter-pel vector == chroma eighth-pel vector."""
+        dx, dy = mvx >> 3, mvy >> 3
+        fx, fy = mvx & 7, mvy & 7
+        H, W = plane.shape
+        ys = np.clip(np.arange(cy0 + dy, cy0 + dy + 9), 0, H - 1)
+        xs = np.clip(np.arange(cx0 + dx, cx0 + dx + 9), 0, W - 1)
+        p = plane[np.ix_(ys, xs)].astype(np.int64)
+        A, B, C, D = p[:8, :8], p[:8, 1:], p[1:, :8], p[1:, 1:]
+        return ((8 - fx) * (8 - fy) * A + fx * (8 - fy) * B
+                + (8 - fx) * fy * C + fx * fy * D + 32) >> 6
+
+    def _decode_skip_mb(self, mb_addr: int, slice_id: int) -> None:
+        """P_Skip: motion-compensated copy with the skip-predicted MV."""
+        mbx, mby = mb_addr % self.mb_w, mb_addr // self.mb_w
+        mvx, mvy = self._skip_mv(mbx, mby, slice_id)
+        self.mb_slice[mb_addr] = slice_id
+        self.mvs[mb_addr] = (mvx, mvy)
+        self.mbinter[mb_addr] = True
+        self._zero_counts(mb_addr)
+        if (mvx, mvy) != (0, 0):
+            x0, y0 = mbx * 16, mby * 16
+            self.Y[y0:y0 + 16, x0:x0 + 16] = \
+                self._mc_luma(mvx, mvy, x0, y0).astype(np.uint8)
+            cx0, cy0 = mbx * 8, mby * 8
+            for plane, ref in ((self.U, self.refU), (self.V, self.refV)):
+                plane[cy0:cy0 + 8, cx0:cx0 + 8] = self._mc_chroma(
+                    ref, mvx, mvy, cx0, cy0).astype(np.uint8)
+        # zero MV: planes already hold the previous picture here
+
     def _decode_p_mb(self, r: BitReader, mb_addr: int, qp: int,
                      slice_id: int) -> int:
-        """P_L0_16x16 with zero motion (the only inter mode our encoder
-        emits; anything else raises)."""
+        """P_L0_16x16 (single ref, integer-pel MV) — the only inter mode
+        our encoder emits; anything else raises."""
         mbx, mby = mb_addr % self.mb_w, mb_addr // self.mb_w
         self.mb_slice[mb_addr] = slice_id
         mb_type = r.ue()
         if mb_type != 0:
             raise NotImplementedError(f"P mb_type {mb_type}")
         mvdx, mvdy = r.se(), r.se()
-        if mvdx or mvdy:
-            raise NotImplementedError("non-zero motion")
+        mvpx, mvpy = self._mvp(mbx, mby, slice_id)
+        mvx, mvy = mvpx + mvdx, mvpy + mvdy
+        self.mvs[mb_addr] = (mvx, mvy)
+        self.mbinter[mb_addr] = True
         cbp = int(T.CBP_INTER_CODE2CBP[r.ue()])
         if cbp:
             qp = qp + r.se()
@@ -529,9 +626,9 @@ class Decoder:
                     for bc in range(2):
                         self.nnz_c[(mbx, mby, comp, br, bc)] = 0
 
-        # recon = previous picture (zero MV) + residual; read ref FIRST
+        # recon = motion-compensated reference-picture prediction + residual
         y0, x0 = mby * 16, mbx * 16
-        ref = self.Y[y0:y0 + 16, x0:x0 + 16].astype(np.int64).copy()
+        ref = self._mc_luma(mvx, mvy, x0, y0)
         for br in range(4):
             for bc in range(4):
                 d = _dequant4x4_ac(luma[br, bc].reshape(4, 4), qp)
@@ -541,7 +638,8 @@ class Decoder:
                     ref[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] + res, 0, 255)
         cy0, cx0 = mby * 8, mbx * 8
         for comp, plane in ((0, self.U), (1, self.V)):
-            cref = plane[cy0:cy0 + 8, cx0:cx0 + 8].astype(np.int64).copy()
+            cref = self._mc_chroma(self.refU if comp == 0 else self.refV,
+                                   mvx, mvy, cx0, cy0)
             for br in range(2):
                 for bc in range(2):
                     d = _dequant4x4_ac(cac[comp, br, bc].reshape(4, 4), qpc)
@@ -557,6 +655,7 @@ class Decoder:
                    slice_id: int) -> int:
         mbx, mby = mb_addr % self.mb_w, mb_addr // self.mb_w
         self.mb_slice[mb_addr] = slice_id
+        self.mbinter[mb_addr] = False   # intra: refIdx -1 for MV pred
         mb_type = r.ue()
         if mb_type == 25:
             raise NotImplementedError("I_PCM")
