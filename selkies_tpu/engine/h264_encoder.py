@@ -34,7 +34,8 @@ import numpy as np
 
 from ..codecs import h264 as hcodec
 from ..ops.h264_encode import (P_SLOTS_MB, SLOTS_MB, h264_encode_p_yuv,
-                               h264_encode_yuv, rgb_to_yuv420)
+                               h264_encode_yuv, rgb_to_yuv420,
+                               scroll_candidates)
 from ..ops.stripes import concat_stripe_bytes, words_to_bytes_device
 from .types import CaptureSettings, EncodedChunk
 
@@ -75,7 +76,7 @@ def plan_h264_grid(s: CaptureSettings) -> _Grid:
 def _jitted_h264_step(mode: str, width: int, stripe_h: int, n_stripes: int,
                       e_cap: int, w_cap: int, out_cap: int,
                       paint_delay: int, damage_gating: bool,
-                      paint_over: bool):
+                      paint_over: bool, candidates: tuple = ((0, 0),)):
     """Compiled per-frame step for ``mode`` in {"i", "p"}.
 
     Both modes share the damage/paint-over/stream-counter logic and
@@ -128,7 +129,8 @@ def _jitted_h264_step(mode: str, width: int, stripe_h: int, n_stripes: int,
             fnum = jnp.where(send, fnum + 1, fnum)
             out, recon = h264_encode_p_yuv(
                 yf, uf, vf, ref_y, ref_u, ref_v, qp_rows,
-                hdr_pay, hdr_nb, fn_rows, e_cap, w_cap)
+                hdr_pay, hdr_nb, fn_rows, e_cap, w_cap,
+                candidates=candidates, stripe_rows=rows_per_stripe)
 
         # the reference only advances for DELIVERED stripes: finalize drops
         # unsent ones, and a reference the client never saw would drift the
@@ -202,10 +204,15 @@ class H264EncoderSession:
 
     def _build_step(self, mode: str):
         g, s = self.grid, self.settings
+        vr = max(0, int(getattr(s, "h264_motion_vrange", 0)))
+        hr = max(0, int(getattr(s, "h264_motion_hrange", 0)))
+        cands = scroll_candidates(vr, hr) if (mode == "p" and vr) \
+            else ((0, 0),)
         return _jitted_h264_step(mode, g.width, g.stripe_h, g.n_stripes,
                                  self._e_cap, self._w_cap, self._out_cap,
                                  s.paint_over_delay_frames,
-                                 s.use_damage_gating, s.use_paint_over)
+                                 s.use_damage_gating, s.use_paint_over,
+                                 candidates=cands)
 
     @property
     def visible_size(self) -> tuple[int, int]:

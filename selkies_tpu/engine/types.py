@@ -39,6 +39,13 @@ class CaptureSettings:
     paint_over_delay_frames: int = 15
     # striping (reference striped encoding, SURVEY.md §2.5)
     stripe_height: int = 64
+    # h264 inter motion search (scroll/pan candidates; 0 vrange disables).
+    # Dense vertical offsets up to vrange px; power-of-two horizontal pans
+    # up to hrange px. The encoders behind the reference's design
+    # (x264/NVENC, reference docs/design.md:33) all motion-search; this is
+    # the TPU equivalent tuned for desktop content.
+    h264_motion_vrange: int = 24
+    h264_motion_hrange: int = 8
     # h264-tpu (non-striped): one stream spanning the whole display;
     # the grid planner derives stripe_height from the CURRENT height so
     # live resizes keep the one-stream contract

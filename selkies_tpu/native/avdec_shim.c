@@ -191,3 +191,104 @@ int x264_encode_idr(const uint8_t *y, const uint8_t *u, const uint8_t *v,
     avcodec_free_context(&ctx);
     return size;
 }
+
+/* Multi-frame x264 CAVLC baseline encode (IDR then P frames) -> one
+ * concatenated Annex-B stream; per-frame sizes land in frame_sizes.
+ * subme=0/me=dia restricts motion to full-pel vectors and no-deblock
+ * keeps recon in the subset the in-tree reference decoder implements.
+ * Gives tests (a) real P/skip/MV streams to validate that decoder and
+ * (b) the size baseline the TPU encoder is compared against. */
+int x264_encode_seq(const uint8_t *frames_y, const uint8_t *frames_u,
+                    const uint8_t *frames_v, int n_frames,
+                    int w, int h, int qp,
+                    uint8_t *out, int out_cap, int *frame_sizes)
+{
+    const AVCodec *codec = avcodec_find_encoder_by_name("libx264");
+    if (!codec)
+        return -1;
+    AVCodecContext *ctx = avcodec_alloc_context3(codec);
+    if (!ctx)
+        return -2;
+    ctx->width = w;
+    ctx->height = h;
+    ctx->pix_fmt = AV_PIX_FMT_YUV420P;
+    ctx->time_base = (AVRational){1, 30};
+    ctx->gop_size = 600;            /* one IDR, the rest P */
+    ctx->max_b_frames = 0;
+    AVDictionary *opts = NULL;
+    char qpbuf[16];
+    snprintf(qpbuf, sizeof qpbuf, "%d", qp);
+    av_dict_set(&opts, "profile", "baseline", 0);
+    av_dict_set(&opts, "preset", "ultrafast", 0);
+    av_dict_set(&opts, "tune", "zerolatency", 0);
+    av_dict_set(&opts, "qp", qpbuf, 0);
+    av_dict_set(&opts, "x264-params",
+                "annexb=1:cabac=0:partitions=none:no-deblock=1:"
+                "me=dia:subme=0:ref=1:bframes=0:weightp=0:8x8dct=0:"
+                "scenecut=0:keyint=600",
+                0);
+    int ret = avcodec_open2(ctx, codec, &opts);
+    av_dict_free(&opts);
+    if (ret < 0) {
+        avcodec_free_context(&ctx);
+        return -3;
+    }
+    AVFrame *frame = av_frame_alloc();
+    AVPacket *pkt = av_packet_alloc();
+    if (!frame || !pkt) {
+        av_frame_free(&frame);
+        av_packet_free(&pkt);
+        avcodec_free_context(&ctx);
+        return -6;
+    }
+    frame->format = AV_PIX_FMT_YUV420P;
+    frame->width = w;
+    frame->height = h;
+    if (av_frame_get_buffer(frame, 0) < 0 || !frame->data[0]) {
+        av_frame_free(&frame);
+        av_packet_free(&pkt);
+        avcodec_free_context(&ctx);
+        return -7;
+    }
+    size_t ysz = (size_t)w * h, csz = (size_t)(w / 2) * (h / 2);
+    int total = 0, got = 0, rc = 0;
+    for (int f = 0; f <= n_frames && rc >= 0; f++) {
+        if (f < n_frames) {
+            if (av_frame_make_writable(frame) < 0) { rc = -8; break; }
+            for (int r = 0; r < h; r++)
+                memcpy(frame->data[0] + (size_t)r * frame->linesize[0],
+                       frames_y + ysz * f + (size_t)r * w, w);
+            for (int r = 0; r < h / 2; r++) {
+                memcpy(frame->data[1] + (size_t)r * frame->linesize[1],
+                       frames_u + csz * f + (size_t)r * (w / 2), w / 2);
+                memcpy(frame->data[2] + (size_t)r * frame->linesize[2],
+                       frames_v + csz * f + (size_t)r * (w / 2), w / 2);
+            }
+            frame->pts = f;
+            rc = avcodec_send_frame(ctx, frame);
+        } else {
+            rc = avcodec_send_frame(ctx, NULL);   /* flush */
+        }
+        while (rc >= 0 && got < n_frames) {
+            int r2 = avcodec_receive_packet(ctx, pkt);
+            if (r2 == AVERROR(EAGAIN) || r2 == AVERROR_EOF)
+                break;
+            if (r2 < 0) { rc = -9; break; }
+            if (total + pkt->size > out_cap) { rc = -5; }
+            else {
+                memcpy(out + total, pkt->data, pkt->size);
+                total += pkt->size;
+                if (frame_sizes)
+                    frame_sizes[got] = pkt->size;
+                got++;
+            }
+            av_packet_unref(pkt);
+        }
+    }
+    av_packet_free(&pkt);
+    av_frame_free(&frame);
+    avcodec_free_context(&ctx);
+    if (rc < -1)
+        return rc;
+    return got == n_frames ? total : -10;
+}

@@ -33,13 +33,16 @@ def _build() -> Optional[ctypes.CDLL]:
     try:
         if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
             lib = ctypes.CDLL(str(_SO))
-            if hasattr(lib, "x264_encode_idr"):   # stale-binary guard
+            if hasattr(lib, "x264_encode_seq"):   # stale-binary guard
                 return lib
         subprocess.run(
             ["gcc", "-O2", "-shared", "-fPIC", "-o", str(_SO), str(_SRC),
              "-lavcodec", "-lavutil"],
             check=True, capture_output=True, timeout=120)
-        return ctypes.CDLL(str(_SO))
+        lib = ctypes.CDLL(str(_SO))
+        if not hasattr(lib, "x264_encode_seq"):   # stale-binary guard
+            raise OSError("shim missing x264_encode_seq after rebuild")
+        return lib
     except (subprocess.SubprocessError, OSError) as e:
         logger.info("avshim unavailable (%s)", e)
         _build_failed = True
@@ -122,6 +125,39 @@ def encode_x264_idr(y: np.ndarray, u: np.ndarray, v: np.ndarray,
     if size <= 0:
         raise RuntimeError(f"x264 encode failed ({size})")
     return out[:size].tobytes()
+
+
+def encode_x264_seq(ys: list[np.ndarray], us: list[np.ndarray],
+                    vs: list[np.ndarray], qp: int = 28
+                    ) -> list[bytes]:
+    """Encode a YUV420 frame sequence with libx264 (CAVLC baseline, one
+    IDR then P frames, full-pel motion, deblocking off). Returns one
+    Annex-B access unit per frame — real-world P/MV streams for decoder
+    validation and the size baseline for the TPU encoder."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("avshim unavailable")
+    n = len(ys)
+    h, w = ys[0].shape
+    fy = np.ascontiguousarray(np.stack(ys), np.uint8)
+    fu = np.ascontiguousarray(np.stack(us), np.uint8)
+    fv = np.ascontiguousarray(np.stack(vs), np.uint8)
+    out = np.empty(n * (w * h * 4 + 65536), np.uint8)
+    sizes = np.zeros(n, np.int32)
+    p = ctypes.POINTER(ctypes.c_ubyte)
+    total = lib.x264_encode_seq(
+        fy.ctypes.data_as(p), fu.ctypes.data_as(p), fv.ctypes.data_as(p),
+        n, w, h, qp, out.ctypes.data_as(p), out.size,
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int)))
+    if total <= 0:
+        raise RuntimeError(f"x264 seq encode failed ({total})")
+    aus = []
+    off = 0
+    for s in sizes:
+        aus.append(out[off:off + int(s)].tobytes())
+        off += int(s)
+    assert off == total
+    return aus
 
 
 class H264Session:

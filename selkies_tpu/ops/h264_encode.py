@@ -571,20 +571,148 @@ def h264_encode_yuv(yf: jnp.ndarray, uf: jnp.ndarray, vf: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
-# P-frames: zero-motion conditional replenishment (SURVEY §7 step 5).
-# P_Skip for MBs whose quantised residual is all-zero, P_L0_16x16 with
-# mvd (0,0) + residual for the rest. NO sequential work at all: without
-# an intra prediction chain every macroblock is independent, so the whole
-# frame (transforms, quant, recon, CAVLC, skip runs, bit packing) is one
-# parallel program.
+# P-frames: motion-searched conditional replenishment (SURVEY §7 step 5).
+# P_Skip for zero-MV MBs whose quantised residual is all-zero, P_L0_16x16
+# with mvd + residual for the rest. The TPU-first decomposition stays
+# fully parallel even WITH motion:
+#
+# - the candidate set is STATIC (frame-global scroll/pan offsets), so
+#   every "shifted reference" is a constant-index gather and per-MB SAD
+#   selection is one argmin over a (K, R, M) cost tensor — no serial
+#   search loop;
+# - one slice per MB row makes the spec's MV predictor degenerate to
+#   "left neighbour" (top/topright are cross-slice, hence unavailable,
+#   §8.4.1.3), so MVD coding is a parallel shift, not a scan;
+# - the same slice layout pins the P_Skip predicted MV to (0,0)
+#   (§8.4.1.1: unavailable mbAddrB), so skip legality stays per-MB local.
+#
+# Candidates are (dy, dx) FULL-pel luma offsets; chroma uses the spec's
+# eighth-sample bilinear at the implied half-pel positions. Vertical
+# clamping happens at the STRIPE picture bound (each stripe is an
+# independent stream whose decoder clamps at its own edges).
 # ---------------------------------------------------------------------------
 
 _CBP2CODE = jnp.asarray(HT.CBP_INTER_CBP2CODE)
 
-P_SLOTS_HDR = 5                       # skip_run, mb_type, mvd, cbp, qp_delta
+P_SLOTS_HDR = 6                 # skip_run, mb_type, mvdx, mvdy, cbp, qp_delta
 SLOTS_BLK16F = 1 + 3 + 16 + 1 + 15    # full 16-coeff luma block
 P_SLOTS_MB = P_SLOTS_HDR + 16 * SLOTS_BLK16F + 2 * SLOTS_BLK4 \
     + 8 * SLOTS_BLK15
+
+# lagrangian for SAD-vs-mvd-bits mode cost, ~2^((qp-12)/6) (x264's SAD
+# lambda curve); integer so device and host selection agree exactly
+MV_LAMBDA_NP = np.round(2.0 ** ((np.arange(52) - 12) / 6.0)).astype(np.int32)
+_MV_LAMBDA = jnp.asarray(MV_LAMBDA_NP)
+
+
+def se_bits(v: int) -> int:
+    """Host-side exact bit cost of se(v)."""
+    cn = 2 * v - 1 if v > 0 else -2 * v
+    return 2 * (cn + 1).bit_length() - 1
+
+
+def _se_event(v):
+    """Signed Exp-Golomb codeword as one packer event."""
+    return _ue_event(jnp.where(v > 0, 2 * v - 1, -2 * v))
+
+
+def scroll_candidates(vrange: int = 24, hrange: int = 8) -> tuple:
+    """Static MV candidate set for desktop content: zero MV, dense
+    vertical scroll offsets (every integer up to ``vrange`` — scroll
+    amounts are arbitrary and a miss costs full residual), power-of-two
+    horizontal pans up to ``hrange``. (dy, dx) full-pel; (0, 0) first so
+    ties prefer the skip-eligible zero vector."""
+    c = [(0, 0)]
+    for d in range(1, vrange + 1):
+        c += [(d, 0), (-d, 0)]
+    d = 1
+    while d <= hrange:
+        c += [(0, d), (0, -d)]
+        d *= 2
+    return tuple(c)
+
+
+def _vshift(p, dy: int):
+    """(S, win, W): per-window vertical shift with edge clamp — the
+    decoder of a stripe stream clamps at its own picture bound."""
+    if dy == 0:
+        return p
+    idx = np.clip(np.arange(p.shape[1]) + dy, 0, p.shape[1] - 1)
+    return p[:, idx, :]
+
+
+def _hshift(p, dx: int):
+    """Horizontal shift with edge clamp (picture width is shared)."""
+    if dx == 0:
+        return p
+    idx = np.clip(np.arange(p.shape[-1]) + dx, 0, p.shape[-1] - 1)
+    return p[..., idx]
+
+
+def _shift_chroma(p, dy: int, dx: int):
+    """Chroma prediction for a full-pel luma MV: the chroma vector is
+    half-pel, realised as the spec's eighth-sample bilinear (§8.4.2.2.2
+    with xFracC/yFracC in {0, 4}): a 2- or 4-tap average."""
+    by, fy = dy >> 1, dy & 1
+    bx, fx = dx >> 1, dx & 1
+
+    def s(a, b):
+        return _hshift(_vshift(p, a), b)
+
+    if not fy and not fx:
+        return s(by, bx)
+    if fy and not fx:
+        return (s(by, bx) + s(by + 1, bx) + 1) >> 1
+    if fx and not fy:
+        return (s(by, bx) + s(by, bx + 1) + 1) >> 1
+    return (s(by, bx) + s(by + 1, bx) + s(by, bx + 1)
+            + s(by + 1, bx + 1) + 2) >> 2
+
+
+def _motion_select(cur_y, rfy, rfu, rfv, qp, candidates, win: int):
+    """Pick one candidate MV per macroblock: argmin over SAD(luma) +
+    lambda(qp) * mvd-bit-estimate. Returns MC'd prediction planes, the
+    (R, M, 2) quarter-pel (mvx, mvy) field, all decoder-exact."""
+    H, W = cur_y.shape
+    R, M = H // 16, W // 16
+    S = H // win
+    ry_w = rfy.reshape(S, win, W)
+    ru_w = rfu.reshape(S, win // 2, W // 2)
+    rv_w = rfv.reshape(S, win // 2, W // 2)
+    lam = _MV_LAMBDA[jnp.clip(qp, 0, 51)]                      # (R,)
+
+    shifted = []
+    costs = []
+    for dy, dx in candidates:
+        sh = _hshift(_vshift(ry_w, dy), dx).reshape(H, W)
+        shifted.append(sh)
+        sad = jnp.abs(cur_y - sh).reshape(R, 16, M, 16).sum(axis=(1, 3))
+        bits = se_bits(4 * dx) + se_bits(4 * dy)
+        costs.append(sad + lam[:, None] * bits)
+    sel = jnp.argmin(jnp.stack(costs), axis=0).astype(jnp.int32)   # (R, M)
+
+    sel_y = jnp.broadcast_to(sel[:, None, :, None],
+                             (R, 16, M, 16)).reshape(H, W)
+    pred_y = shifted[0]
+    for k in range(1, len(candidates)):
+        pred_y = jnp.where(sel_y == k, shifted[k], pred_y)
+
+    sel_c = jnp.broadcast_to(sel[:, None, :, None],
+                             (R, 8, M, 8)).reshape(H // 2, W // 2)
+    pred_u = _shift_chroma(ru_w, *candidates[0]).reshape(H // 2, W // 2)
+    pred_v = _shift_chroma(rv_w, *candidates[0]).reshape(H // 2, W // 2)
+    for k, (dy, dx) in enumerate(candidates[1:], 1):
+        pred_u = jnp.where(
+            sel_c == k,
+            _shift_chroma(ru_w, dy, dx).reshape(H // 2, W // 2), pred_u)
+        pred_v = jnp.where(
+            sel_c == k,
+            _shift_chroma(rv_w, dy, dx).reshape(H // 2, W // 2), pred_v)
+
+    # (mvx, mvy) quarter-pel per MB
+    cand_q = jnp.asarray(np.asarray(candidates, np.int32)[:, ::-1] * 4)
+    mv = cand_q[sel]                                           # (R, M, 2)
+    return pred_y, pred_u, pred_v, mv
 
 
 def _quant_ac_inter(w, qp):
@@ -634,13 +762,18 @@ def _nc_from_counts_chroma(tc_eff):
 
 def h264_encode_p_yuv(yf, uf, vf, ref_y, ref_u, ref_v, qp,
                       header_pay, header_nb, frame_num,
-                      e_cap: int, w_cap: int):
+                      e_cap: int, w_cap: int,
+                      candidates: tuple = ((0, 0),),
+                      stripe_rows: int | None = None):
     """P-frame encode against a reference reconstruction.
 
     All of (yf, uf, vf) and (ref_*) are int32/uint8 planes; ``qp`` and
-    ``frame_num`` are scalars or (R,) vectors. Returns
-    (H264FrameOut, (recon_y, recon_u, recon_v)) — the recon is the next
-    frame's reference, decoder-exact.
+    ``frame_num`` are scalars or (R,) vectors. ``candidates`` is the
+    static full-pel MV candidate set (see :func:`scroll_candidates`);
+    ``stripe_rows`` bounds vertical motion clamping to groups of that
+    many MB rows — the per-stripe picture bound of striped streams.
+    Returns (H264FrameOut, (recon_y, recon_u, recon_v)) — the recon is
+    the next frame's reference, decoder-exact.
     """
     H, W = yf.shape[0], yf.shape[1]
     R, M = H // 16, W // 16
@@ -648,12 +781,28 @@ def h264_encode_p_yuv(yf, uf, vf, ref_y, ref_u, ref_v, qp,
     qpc = QPC_TABLE[jnp.clip(qp, 0, 51)]
     fn = jnp.broadcast_to(jnp.asarray(frame_num, jnp.int32), (R,))
 
-    y = yf.astype(jnp.int32).reshape(R, 16, W)
-    u = uf.astype(jnp.int32).reshape(R, 8, W // 2)
-    v = vf.astype(jnp.int32).reshape(R, 8, W // 2)
-    ry = ref_y.astype(jnp.int32).reshape(R, 16, W)
-    ru = ref_u.astype(jnp.int32).reshape(R, 8, W // 2)
-    rv = ref_v.astype(jnp.int32).reshape(R, 8, W // 2)
+    cur_y = yf.astype(jnp.int32)
+    cur_u = uf.astype(jnp.int32)
+    cur_v = vf.astype(jnp.int32)
+    rfy = ref_y.astype(jnp.int32)
+    rfu = ref_u.astype(jnp.int32)
+    rfv = ref_v.astype(jnp.int32)
+
+    win = 16 * (stripe_rows if stripe_rows else R)
+    assert H % win == 0, "stripe_rows must tile the frame"
+    if len(candidates) > 1:
+        pred_y, pred_u, pred_v, mv = _motion_select(
+            cur_y, rfy, rfu, rfv, qp, candidates, win)
+    else:
+        pred_y, pred_u, pred_v = rfy, rfu, rfv
+        mv = jnp.zeros((R, M, 2), jnp.int32)
+
+    y = cur_y.reshape(R, 16, W)
+    u = cur_u.reshape(R, 8, W // 2)
+    v = cur_v.reshape(R, 8, W // 2)
+    ry = pred_y.reshape(R, 16, W)
+    ru = pred_u.reshape(R, 8, W // 2)
+    rv = pred_v.reshape(R, 8, W // 2)
 
     # ---- residual transforms (fully parallel)
     yb = _blocks4(y - ry).reshape(R, 4, M, 4, 4, 4)     # (R,by,M,bx,4,4)
@@ -697,8 +846,18 @@ def h264_encode_p_yuv(yf, uf, vf, ref_y, ref_u, ref_v, qp,
     has_cdc_m = jnp.any(clvl != 0, axis=(1, 3, 4))       # (R,M)
     cbp_chroma = jnp.where(hc2, 2, jnp.where(has_cdc_m, 1, 0))
     cbp = cbp_luma | (cbp_chroma << 4)                   # (R, M)
-    coded = cbp != 0
+    # P_Skip requires BOTH an all-zero residual and the skip-predicted MV,
+    # which our one-slice-per-row layout pins to (0,0) (§8.4.1.1)
+    mv_nz = (mv[..., 0] != 0) | (mv[..., 1] != 0)
+    coded = (cbp != 0) | mv_nz
     skip = ~coded
+
+    # MV prediction degenerates to the left neighbour (§8.4.1.3 with B/C/D
+    # cross-slice-unavailable); first MB of a row predicts (0,0). Skipped
+    # MBs carry their true (zero) MV, so one parallel shift is exact.
+    mvp = jnp.concatenate(
+        [jnp.zeros((R, 1, 2), jnp.int32), mv[:, :-1]], axis=1)
+    mvd = mv - mvp
 
     # ---- effective counts + nC
     tc_y = jnp.moveaxis(jnp.sum(scan_y != 0, axis=-1), 1, 2).astype(jnp.int32)
@@ -740,14 +899,15 @@ def h264_encode_p_yuv(yf, uf, vf, ref_y, ref_u, ref_v, qp,
     return _assemble_p_rows(
         R, M, qp, qpc, fn, header_pay, header_nb, cbp, coded, skip,
         scan_y, nc_y, clvl, scan_c, nc_c, cbp_luma, cbp_chroma,
-        e_cap, w_cap,
+        mvd, e_cap, w_cap,
     ), (recon_y.astype(jnp.uint8), recon_c[0].astype(jnp.uint8),
         recon_c[1].astype(jnp.uint8))
 
 
 def _assemble_p_rows(R, M, qp, qpc, fn, header_pay, header_nb, cbp, coded,
                      skip, scan_y, nc_y, clvl, scan_c, nc_c,
-                     cbp_luma, cbp_chroma, e_cap, w_cap) -> H264FrameOut:
+                     cbp_luma, cbp_chroma, mvd, e_cap, w_cap
+                     ) -> H264FrameOut:
     """Slot assembly for P rows: skip runs, MB syntax, residual events."""
     # ---- per-MB skip-run values (count of skips since the previous coded
     # MB in the row): prev coded index via an inclusive running max
@@ -765,8 +925,10 @@ def _assemble_p_rows(R, M, qp, qpc, fn, header_pay, header_nb, cbp, coded,
     sr_nb = jnp.where(coded, sr_nb, 0)
     mbt_pay = jnp.ones((R, M), jnp.uint32)               # ue(0) = '1'
     mbt_nb = jnp.where(coded, 1, 0)
-    mvd_pay = jnp.full((R, M), 0b11, jnp.uint32)         # se(0) se(0)
-    mvd_nb = jnp.where(coded, 2, 0)
+    mvdx_pay, mvdx_nb = _se_event(mvd[..., 0])           # mvd_l0 x then y
+    mvdx_nb = jnp.where(coded, mvdx_nb, 0)
+    mvdy_pay, mvdy_nb = _se_event(mvd[..., 1])
+    mvdy_nb = jnp.where(coded, mvdy_nb, 0)
     cbp_pay, cbp_nb = _ue_event(_CBP2CODE[cbp])
     cbp_nb = jnp.where(coded, cbp_nb, 0)
     dqp_pay = jnp.ones((R, M), jnp.uint32)               # se(0) = '1'
@@ -798,14 +960,16 @@ def _assemble_p_rows(R, M, qp, qpc, fn, header_pay, header_nb, cbp, coded,
                        ev_cac.nbits.reshape(R, M, 8, SLOTS_BLK15), 0)
 
     mb_pay = jnp.concatenate([
-        sr_pay[..., None], mbt_pay[..., None], mvd_pay[..., None],
+        sr_pay[..., None], mbt_pay[..., None],
+        mvdx_pay[..., None], mvdy_pay[..., None],
         cbp_pay[..., None], dqp_pay[..., None],
         y_pay.reshape(R, M, 16 * SLOTS_BLK16F),
         ev_cdc.payload.reshape(R, M, 2 * SLOTS_BLK4),
         cac_pay.reshape(R, M, 8 * SLOTS_BLK15),
     ], axis=-1)
     mb_nb = jnp.concatenate([
-        sr_nb[..., None], mbt_nb[..., None], mvd_nb[..., None],
+        sr_nb[..., None], mbt_nb[..., None],
+        mvdx_nb[..., None], mvdy_nb[..., None],
         cbp_nb[..., None], dqp_nb[..., None],
         y_nb.reshape(R, M, 16 * SLOTS_BLK16F),
         cdc_nb.reshape(R, M, 2 * SLOTS_BLK4),

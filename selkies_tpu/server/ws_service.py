@@ -333,6 +333,8 @@ class WebSocketsService(BaseStreamingService):
             use_paint_over=s.use_paint_over,
             paint_over_quality=s.paint_over_quality,
             stripe_height=s.stripe_height,
+            h264_motion_vrange=s.h264_motion_vrange,
+            h264_motion_hrange=s.h264_motion_hrange,
             display_id=display_id,
             watermark_path=s.watermark_path,
             watermark_location=s.watermark_location,

@@ -124,6 +124,12 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
     _s("stripe_height", SType.INT, 64,
        "Row-stripe height in px for intra-frame parallel encode "
        "(reference striped encoding, SURVEY §2.5).", vmin=16, vmax=1088),
+    _s("h264_motion_vrange", SType.INT, 24,
+       "H.264 inter motion search: dense vertical scroll candidates up to "
+       "this many px (0 disables motion search).", vmin=0, vmax=64),
+    _s("h264_motion_hrange", SType.INT, 8,
+       "H.264 inter motion search: power-of-two horizontal pan candidates "
+       "up to this many px.", vmin=0, vmax=64),
     _s("use_paint_over", SType.BOOL, True,
        "Re-encode static scenes at higher quality after damage settles "
        "(reference settings.py:560-585)."),
@@ -139,6 +145,10 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
 
     # --- display ------------------------------------------------------------
     _s("display_id", SType.STR, ":0", "X display / seat identifier."),
+    _s("webrtc_media_ip", SType.STR, "",
+       "IP advertised as the ICE-lite media candidate (empty = "
+       "auto-detect the outbound-route address; the reference's "
+       "webrtc_public_ip NAT1TO1 analog)."),
     _s("initial_width", SType.INT, 1920, "Initial framebuffer width.", vmin=64, vmax=16384),
     _s("initial_height", SType.INT, 1080, "Initial framebuffer height.", vmin=64, vmax=16384),
     _s("enable_resize", SType.BOOL, True, "Clients may resize the remote display.",

@@ -13,7 +13,10 @@ from selkies_tpu.engine.sources import SyntheticSource
 from selkies_tpu.native import avshim
 
 SMALL = dict(capture_width=64, capture_height=64, stripe_height=32,
-             target_fps=120.0, output_mode="h264", video_crf=26)
+             target_fps=120.0, output_mode="h264", video_crf=26,
+             # small candidate set: keeps per-shape jit compiles fast; the
+             # full ladder is exercised in test_h264_motion.py
+             h264_motion_vrange=2, h264_motion_hrange=1)
 
 
 def test_h264_session_stripes_decode():

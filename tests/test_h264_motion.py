@@ -1,0 +1,200 @@
+"""Motion-search P frames (reference design.md:33 — the x264/NVENC class
+encoders the reference rides all motion-search; this is the TPU analog).
+
+Validation strategy: the device stream must land byte-exact in BOTH
+independent decoders (in-tree spec decoder + ffmpeg), the in-tree decoder
+must also byte-match ffmpeg on REAL x264 P/MV streams, and the size bar
+is measured against libx264 on the same content (VERDICT round 2 item 3:
+scrolling desktop at <= 2x x264 bytes)."""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.codecs import h264 as H
+from selkies_tpu.codecs import h264_ref_decoder as refdec
+from selkies_tpu.native import avshim
+
+jnp = pytest.importorskip("jax.numpy")
+
+from selkies_tpu.ops.bitpack import words_to_bytes  # noqa: E402
+from selkies_tpu.ops.h264_encode import (P_SLOTS_MB, SLOTS_MB,  # noqa: E402
+                                         h264_encode_p_yuv, h264_encode_yuv,
+                                         scroll_candidates)
+
+needs_av = pytest.mark.skipif(not avshim.available(),
+                              reason="libavcodec unavailable")
+
+QP = 28
+
+
+def _texture(h, w, seed=1):
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:h, 0:w]
+    y = ((xx * 7 + yy * 13 + rng.integers(0, 32, (h, w))) % 256
+         ).astype(np.uint8)
+    u = rng.integers(90, 170, (h // 2, w // 2), dtype=np.uint8)
+    v = rng.integers(90, 170, (h // 2, w // 2), dtype=np.uint8)
+    return y, u, v
+
+
+def _scrolled(y, u, v, dy, seed=9):
+    """Content moves up by dy px; fresh rows appear at the bottom."""
+    rng = np.random.default_rng(seed)
+    h, w = y.shape
+    y2 = np.empty_like(y)
+    y2[:h - dy] = y[dy:]
+    y2[h - dy:] = rng.integers(0, 256, (dy, w), dtype=np.uint8)
+    cs = dy // 2
+    u2, v2 = np.empty_like(u), np.empty_like(v)
+    u2[:h // 2 - cs] = u[cs:]
+    u2[h // 2 - cs:] = 128
+    v2[:h // 2 - cs] = v[cs:]
+    v2[h // 2 - cs:] = 128
+    return y2, u2, v2
+
+
+def _encode_idr(y, u, v):
+    R, M = y.shape[0] // 16, y.shape[1] // 16
+    pay, nb = H.slice_header_events(M, R)
+    out, recon = h264_encode_yuv(
+        jnp.asarray(y, jnp.int32), jnp.asarray(u, jnp.int32),
+        jnp.asarray(v, jnp.int32), QP, jnp.asarray(pay), jnp.asarray(nb),
+        16 + M * SLOTS_MB, 16384, want_recon=True)
+    assert not bool(np.asarray(out.overflow))
+    w_, b_ = np.asarray(out.words), np.asarray(out.total_bits)
+    rows = [words_to_bytes(w_[r], int(b_[r]), pad_ones=False)
+            for r in range(R)]
+    return H.assemble_annexb(rows), recon
+
+
+def _encode_p(y, u, v, recon, cands, frame_num=1):
+    R, M = y.shape[0] // 16, y.shape[1] // 16
+    pay, nb = H.p_slice_header_events(M, R)
+    out, rec = h264_encode_p_yuv(
+        jnp.asarray(y, jnp.int32), jnp.asarray(u, jnp.int32),
+        jnp.asarray(v, jnp.int32), recon[0], recon[1], recon[2], QP,
+        jnp.asarray(pay), jnp.asarray(nb), frame_num,
+        16 + M * P_SLOTS_MB, 16384, candidates=cands)
+    assert not bool(np.asarray(out.overflow))
+    w_, b_ = np.asarray(out.words), np.asarray(out.total_bits)
+    rows = [words_to_bytes(w_[r], int(b_[r]), pad_ones=False)
+            for r in range(R)]
+    au = b"".join(H.nal(1, rb, ref_idc=2) for rb in rows)
+    return au, tuple(np.asarray(p) for p in rec)
+
+
+def _check_oracles(headers, aus, final_recon):
+    my, mu, mv = refdec.Decoder().decode(headers + b"".join(aus))
+    assert np.array_equal(my, final_recon[0]), "spec decoder luma"
+    assert np.array_equal(mu, final_recon[1]), "spec decoder U"
+    assert np.array_equal(mv, final_recon[2]), "spec decoder V"
+    if avshim.available():
+        sess = avshim.H264Session()
+        got = None
+        for au in aus:
+            got = sess.decode(headers + au if au is aus[0] else au) or got
+        got = sess.flush() or got
+        assert got is not None
+        assert np.array_equal(got[0], final_recon[0]), "ffmpeg luma"
+        assert np.array_equal(got[1], final_recon[1]), "ffmpeg U"
+        assert np.array_equal(got[2], final_recon[2]), "ffmpeg V"
+
+
+def test_vertical_scroll_motion_p():
+    """Odd vertical scroll: exercises MV selection, MVD coding and the
+    chroma half-pel path; the motion P must be much smaller than the
+    zero-MV P and decode byte-exact in both oracles."""
+    h, w = 48, 64
+    y0, u0, v0 = _texture(h, w)
+    idr, recon = _encode_idr(y0, u0, v0)
+    y1, u1, v1 = _scrolled(y0, u0, v0, 5)
+    au_zero, _ = _encode_p(y1, u1, v1, recon, ((0, 0),))
+    au_mv, rec = _encode_p(y1, u1, v1, recon, scroll_candidates(8, 4))
+    assert len(au_mv) < 0.5 * len(au_zero), \
+        f"motion {len(au_mv)}B vs zero-mv {len(au_zero)}B"
+    _check_oracles(H.write_sps(w, h) + H.write_pps(), [idr, au_mv], rec)
+
+
+def test_horizontal_pan_motion_p():
+    h, w = 48, 64
+    y0, u0, v0 = _texture(h, w, seed=3)
+    idr, recon = _encode_idr(y0, u0, v0)
+    # pan right by 4: cur(x) = prev(x-4) -> candidate dx = -4
+    y1 = np.roll(y0, 4, axis=1)
+    u1 = np.roll(u0, 2, axis=1)
+    v1 = np.roll(v0, 2, axis=1)
+    au_zero, _ = _encode_p(y1, u1, v1, recon, ((0, 0),))
+    au_mv, rec = _encode_p(y1, u1, v1, recon, scroll_candidates(4, 4))
+    assert len(au_mv) < 0.6 * len(au_zero)
+    _check_oracles(H.write_sps(w, h) + H.write_pps(), [idr, au_mv], rec)
+
+
+def test_static_content_still_skips():
+    """Unchanged content must still produce all-skip P frames (the zero
+    candidate wins every tie) — motion search must not break P_Skip."""
+    h, w = 32, 48
+    y0, u0, v0 = _texture(h, w, seed=5)
+    _, recon = _encode_idr(y0, u0, v0)
+    ry = np.asarray(recon[0])
+    ru = np.asarray(recon[1])
+    rv = np.asarray(recon[2])
+    au, _ = _encode_p(ry, ru, rv, recon, scroll_candidates(4, 2))
+    # every row: header + one trailing skip_run + stop bit -> tiny
+    assert len(au) < (h // 16) * 16, f"all-skip P should be tiny: {len(au)}B"
+
+
+@needs_av
+def test_refdec_matches_ffmpeg_on_x264_p_streams():
+    """The in-tree decoder's motion path (median MV prediction, skip MV,
+    integer-pel luma MC, eighth-pel chroma bilinear) against REAL x264
+    P/MV streams: every decoded picture must byte-match ffmpeg."""
+    h, w = 48, 64
+    y0, u0, v0 = _texture(h, w, seed=11)
+    ys, us, vs = [y0], [u0], [v0]
+    for t, dy in enumerate((3, 7)):
+        y, u, v = _scrolled(ys[-1], us[-1], vs[-1], dy, seed=20 + t)
+        ys.append(y)
+        us.append(u)
+        vs.append(v)
+    aus = avshim.encode_x264_seq(ys, us, vs, qp=QP)
+    assert len(aus) == 3
+    d = refdec.Decoder()
+    ff = avshim.H264Session()
+    stream = b""
+    for i, au in enumerate(aus):
+        stream += au
+        my, mu, mv = refdec.Decoder().decode(stream)
+        got = ff.decode(au) or ff.flush()
+        assert got is not None, f"frame {i}: ffmpeg wants more data"
+        assert np.array_equal(my, got[0]), f"frame {i} luma"
+        assert np.array_equal(mu, got[1]), f"frame {i} U"
+        assert np.array_equal(mv, got[2]), f"frame {i} V"
+    del d
+
+
+@needs_av
+def test_scrolling_desktop_size_bar_vs_x264():
+    """VERDICT round-2 item 3 'done' bar: a synthetic scrolling-desktop
+    sequence must encode at <= 2x the bytes of libx264 (same qp, same
+    content, P frames compared)."""
+    h, w = 64, 96
+    y0, u0, v0 = _texture(h, w, seed=13)
+    ys, us, vs = [y0], [u0], [v0]
+    for t in range(3):
+        y, u, v = _scrolled(ys[-1], us[-1], vs[-1], 6, seed=30 + t)
+        ys.append(y)
+        us.append(u)
+        vs.append(v)
+    x264_aus = avshim.encode_x264_seq(ys, us, vs, qp=QP)
+    x264_p_bytes = sum(len(a) for a in x264_aus[1:])
+
+    _, recon = _encode_idr(y0, u0, v0)
+    cands = scroll_candidates(8, 4)
+    ours = 0
+    for t in range(1, 4):
+        au, rec = _encode_p(ys[t], us[t], vs[t], recon, cands, frame_num=t)
+        ours += len(au)
+        recon = tuple(jnp.asarray(p) for p in rec)
+    ratio = ours / x264_p_bytes
+    assert ratio <= 2.0, \
+        f"ours {ours}B vs x264 {x264_p_bytes}B (ratio {ratio:.2f})"
