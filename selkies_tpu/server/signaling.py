@@ -42,6 +42,35 @@ class Peer:
     partner: Optional[str] = None
 
 
+class LocalServerPeer:
+    """ws-compatible shim for the in-process server peer: inbound sends
+    become callbacks; outbound messages are injected straight into the
+    dispatcher. ``on_text`` must be quick (enqueue, don't block) — it runs
+    under the signaling send timeout."""
+
+    def __init__(self, sig: "SignalingServer", on_text):
+        self._sig = sig
+        self._on_text = on_text
+        self.peer: Optional[Peer] = None
+
+    # ws interface consumed by SignalingServer
+    async def send_str(self, text: str) -> None:
+        await self._on_text(text)
+
+    async def close(self, code: int = 1000, message: bytes = b"") -> None:
+        return None
+
+    # service-facing interface
+    async def send(self, text: str) -> None:
+        if self.peer is not None:
+            await self._sig.dispatch_from(self.peer, text)
+
+    async def detach(self) -> None:
+        if self.peer is not None:
+            await self._sig.detach(self.peer)
+            self.peer = None
+
+
 class SignalingServer:
     def __init__(self):
         self.peers: dict[str, Peer] = {}
@@ -78,6 +107,18 @@ class SignalingServer:
             await self._disconnect(peer)
         return ws
 
+    async def attach_server_peer(self, on_text) -> "LocalServerPeer":
+        """Register the streaming server's own peer WITHOUT a WebSocket
+        hop (the reference runs a real client connection to its own
+        in-process signaling server — webrtc_signaling.py:114; this is
+        that loop, minus the socket): same newest-wins eviction and relay
+        semantics as a ``HELLO server`` peer. ``on_text`` receives every
+        message the signaling plane would have sent over the wire."""
+        local = LocalServerPeer(self, on_text)
+        peer = await self._register(local, "server", {})
+        local.peer = peer
+        return local
+
     async def _hello(self, ws: web.WebSocketResponse,
                      request: web.Request) -> Optional[Peer]:
         msg = await ws.receive()
@@ -92,6 +133,9 @@ class SignalingServer:
                 meta = json.loads(toks[2])
             except json.JSONDecodeError:
                 meta = {}
+        return await self._register(ws, peer_type, meta)
+
+    async def _register(self, ws, peer_type: str, meta: dict) -> Peer:
         evicted = None
         async with self.lock:
             # newest-wins eviction for a reconnecting server peer; the
@@ -118,6 +162,14 @@ class SignalingServer:
         await self._safe_send(peer, "HELLO")
         logger.info("signaling peer %s registered (%s)", uid, peer_type)
         return peer
+
+    async def dispatch_from(self, peer: Peer, text: str) -> None:
+        """Inject a message as if ``peer`` sent it over its socket (the
+        local server peer's send path)."""
+        await self._dispatch(peer, text)
+
+    async def detach(self, peer: Peer) -> None:
+        await self._disconnect(peer)
 
     async def _dispatch(self, peer: Peer, text: str) -> None:
         if text.startswith("SESSION_END"):

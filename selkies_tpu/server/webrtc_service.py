@@ -1,14 +1,19 @@
 """WebRTC transport service (opt-in, reference webrtc_mode.py:142-2029).
 
-The signaling plane (/api/signaling, SignalingServer) and the RTC
-configuration plane (/api/turn, the TURN resolution chain) are complete
-and always available — they are plain asyncio/aiohttp code. The MEDIA
-plane (RTCPeerConnection graphs feeding pre-encoded TPU H.264 into RTP,
-the reference's aiortc-fork role) requires an aiortc-compatible stack at
-runtime: when ``aiortc`` is importable the service builds per-peer
-pipelines; otherwise it serves signaling and reports the degraded state
-on /api/status-style queries, matching the reference's own
-degrade-when-wheel-missing posture (selkies.py:148-189).
+All three planes are in-house and real:
+
+- signaling (/api/signaling, SignalingServer) and the TURN resolution
+  chain (/api/turn) — plain asyncio/aiohttp;
+- the MEDIA plane — ``selkies_tpu.webrtc``: ICE-lite + DTLS (system
+  OpenSSL) + SRTP + RFC 6184 packetization of the TPU encoder's
+  PRE-ENCODED H.264 access units, the role the reference fork's
+  ``Encoder.pack()`` seam plays (rtcrtpsender.py:364-393). No aiortc.
+
+Per browser session the service answers SESSION_START with an SDP offer
+(one bundled sendonly video track on an ICE-lite host candidate), and on
+DTLS completion streams the single-stream capture. PLI/FIR from the
+browser triggers an IDR request into the engine, mirroring the
+reference's on_pli path (rtc.py:1138-1170).
 """
 
 from __future__ import annotations
@@ -16,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import socket
 from typing import Optional
 
 from aiohttp import web
@@ -28,10 +34,33 @@ from .turn import get_rtc_configuration
 logger = logging.getLogger("selkies_tpu.server.webrtc")
 
 try:
-    import aiortc  # noqa: F401
-    HAVE_AIORTC = True
-except ImportError:
-    HAVE_AIORTC = False
+    from ..webrtc import RTCPeer
+    HAVE_MEDIA = True
+except Exception as _e:                      # e.g. no usable OpenSSL
+    RTCPeer = None
+    HAVE_MEDIA = False
+    _MEDIA_ERR = str(_e)
+
+
+def _default_media_ip() -> str:
+    """The host's outbound-route IP (no traffic is sent); 127.0.0.1 when
+    isolated."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+class _Session:
+    def __init__(self, caller_uid: str, peer, display_id: str):
+        self.caller_uid = caller_uid
+        self.peer = peer
+        self.display_id = display_id
 
 
 class WebRTCService(BaseStreamingService):
@@ -45,7 +74,12 @@ class WebRTCService(BaseStreamingService):
         self._capture_factory = capture_factory
         self.audio = audio_pipeline
         self._running = False
-        self._server_peer_task: Optional[asyncio.Task] = None
+        self._local_peer = None
+        self._sessions: dict[str, _Session] = {}
+        self._sig_queue: asyncio.Queue[str] = asyncio.Queue()
+        self._sig_task: Optional[asyncio.Task] = None
+        self._capture = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     # ---------------------------------------------------------------- routes
     def register_routes(self, app: web.Application) -> None:
@@ -59,24 +93,31 @@ class WebRTCService(BaseStreamingService):
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
         self._running = True
-        if not HAVE_AIORTC:
-            logger.warning(
-                "webrtc mode: aiortc not installed — signaling + TURN are "
-                "serving, media sessions will not be established "
-                "(install aiortc for the full transport)")
-            return
+        self._loop = asyncio.get_running_loop()
         if self.input_handler is not None:
             self.input_handler.start()
-        # Media path: the server registers its own peer against the
-        # in-process signaling server and answers SESSION_STARTs with
-        # RTCPeerConnection graphs fed by the TPU encoder's pre-encoded
-        # H.264 access units. Activated only with aiortc present.
-        logger.info("webrtc media plane starting (aiortc present)")
+        if not HAVE_MEDIA:
+            logger.warning(
+                "webrtc mode: media stack unavailable (%s) — signaling + "
+                "TURN serve, sessions will not get media", _MEDIA_ERR)
+            return
+        self._local_peer = await self.signaling.attach_server_peer(
+            self._sig_queue.put)
+        self._sig_task = self._loop.create_task(self._signal_loop())
+        logger.info("webrtc media plane up (in-house ICE-lite/DTLS/SRTP)")
 
     async def stop(self) -> None:
         self._running = False
-        if self._server_peer_task:
-            self._server_peer_task.cancel()
+        if self._sig_task:
+            self._sig_task.cancel()
+            self._sig_task = None
+        for s in list(self._sessions.values()):
+            s.peer.close()
+        self._sessions.clear()
+        self._stop_capture()
+        if self._local_peer is not None:
+            await self._local_peer.detach()
+            self._local_peer = None
         for peer in list(self.signaling.peers.values()):
             try:
                 await peer.ws.close()
@@ -87,4 +128,135 @@ class WebRTCService(BaseStreamingService):
 
     @property
     def media_available(self) -> bool:
-        return HAVE_AIORTC
+        return HAVE_MEDIA
+
+    # ------------------------------------------------------------- signaling
+    async def _signal_loop(self) -> None:
+        while self._running:
+            text = await self._sig_queue.get()
+            try:
+                await self._handle_signal(text)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("webrtc signal handling failed: %.80s",
+                                 text)
+
+    async def _handle_signal(self, text: str) -> None:
+        if text.startswith("SESSION_START"):
+            parts = text.split()
+            caller = parts[1] if len(parts) > 1 else ""
+            display = parts[3] if len(parts) > 3 else "primary"
+            await self._start_session(caller, display)
+        elif text.startswith("SESSION_END"):
+            parts = text.split()
+            if len(parts) > 1:
+                self._end_session(parts[1])
+        elif text.startswith("MSG "):
+            parts = text.split(maxsplit=2)
+            if len(parts) == 3:
+                await self._handle_peer_json(parts[1], parts[2])
+
+    async def _start_session(self, caller_uid: str, display_id: str) -> None:
+        old = self._sessions.pop(caller_uid, None)
+        if old is not None:
+            old.peer.close()
+        host = getattr(self.settings, "webrtc_media_ip", "") \
+            or _default_media_ip()
+        peer = RTCPeer(host=host, on_request_keyframe=self._request_idr,
+                       with_audio=False,
+                       fullcolor=bool(self.settings.fullcolor))
+        await peer.listen()
+        self._sessions[caller_uid] = _Session(caller_uid, peer, display_id)
+        self._ensure_capture()
+        offer = peer.create_offer()
+        await self._local_peer.send("MSG {} {}".format(
+            caller_uid,
+            json.dumps({"sdp": {"type": "offer", "sdp": offer}})))
+        logger.info("webrtc session %s: offer sent (media %s:%d)",
+                    caller_uid, host, peer.port)
+
+    async def _handle_peer_json(self, caller_uid: str, payload: str) -> None:
+        sess = self._sessions.get(caller_uid)
+        if sess is None:
+            return
+        try:
+            msg = json.loads(payload)
+        except json.JSONDecodeError:
+            return
+        sdp = msg.get("sdp")
+        if isinstance(sdp, dict) and sdp.get("type") == "answer":
+            sess.peer.set_remote_answer(sdp.get("sdp", ""))
+            logger.info("webrtc session %s: answer applied", caller_uid)
+        # 'ice' messages need no action: ICE-lite answers the browser's
+        # connectivity checks directly on the advertised host candidate
+
+    def _end_session(self, caller_uid: str) -> None:
+        sess = self._sessions.pop(caller_uid, None)
+        if sess is not None:
+            sess.peer.close()
+            logger.info("webrtc session %s closed", caller_uid)
+        if not self._sessions:
+            self._stop_capture()
+
+    # ----------------------------------------------------------------- media
+    def _ensure_capture(self) -> None:
+        if self._capture is not None:
+            return
+        try:
+            if self._capture_factory is not None:
+                self._capture = self._capture_factory()
+            else:
+                from ..engine.capture import ScreenCapture
+                self._capture = ScreenCapture()
+        except Exception:
+            logger.exception("webrtc capture unavailable")
+            return
+        from ..engine.types import CaptureSettings
+        s = self.settings
+        cs = CaptureSettings(
+            capture_width=int(getattr(s, "initial_width", 1920) or 1920),
+            capture_height=int(getattr(s, "initial_height", 1080) or 1080),
+            target_fps=float(s.framerate),
+            output_mode="h264",
+            single_stream=True,        # one RTP track = one H.264 stream
+            video_crf=s.video_crf,
+            video_bitrate_kbps=s.video_bitrate_kbps,
+            keyframe_interval_s=s.keyframe_interval_s,
+            use_damage_gating=True,
+            use_paint_over=s.use_paint_over,
+            h264_motion_vrange=s.h264_motion_vrange,
+            h264_motion_hrange=s.h264_motion_hrange,
+        )
+        self._capture.start_capture(self._on_chunk, cs)
+        logger.info("webrtc capture started (single-stream h264)")
+
+    def _stop_capture(self) -> None:
+        if self._capture is not None:
+            try:
+                self._capture.stop_capture()
+            except Exception:
+                pass
+            self._capture = None
+
+    def _on_chunk(self, chunk) -> None:
+        """Capture-thread callback -> loop-side fan-out (the only
+        thread->loop entry, reference selkies.py:4294 discipline)."""
+        if self._loop is None or not self._sessions:
+            return
+        self._loop.call_soon_threadsafe(self._fanout, chunk)
+
+    def _fanout(self, chunk) -> None:
+        for sess in self._sessions.values():
+            try:
+                sess.peer.send_video_au(chunk.payload)
+            except Exception:
+                logger.exception("webrtc send failed (%s)",
+                                 sess.caller_uid)
+
+    def _request_idr(self) -> None:
+        if self._capture is not None:
+            try:
+                self._capture.request_idr_frame()
+            except Exception:
+                pass

@@ -1,0 +1,24 @@
+"""TPU-native WebRTC media plane.
+
+The reference vendors a 15.3k-LoC aiortc/aioice fork for its opt-in
+WebRTC transport (reference src/selkies/webrtc/, src/selkies/ice/ —
+SURVEY.md §2.2). This package is the from-scratch equivalent sized to
+what the product actually uses: the server is the media SENDER of
+pre-encoded access units (the reference fork's whole point was the
+``Encoder.pack()`` passthrough, rtcrtpsender.py:364-393), so it needs
+
+- an ICE-LITE responder (we are always the public, answering agent),
+- a DTLS endpoint (system OpenSSL via ctypes) with RFC 5764 SRTP key
+  export,
+- SRTP/SRTCP packet protection (RFC 3711, AES-CM-128 + HMAC-SHA1-80),
+- RFC 6184 H.264 RTP packetization (single NAL + FU-A) and Opus RTP,
+- SDP offer/answer for the browser peer,
+
+and NOT a full ICE agent, TURN client, or DTLS-client media stack.
+"""
+
+from .dtls import DtlsEndpoint, generate_certificate   # noqa: F401
+from .peer import RTCPeer                              # noqa: F401
+from .rtp import H264Packetizer, RtpPacket             # noqa: F401
+from .srtp import SrtpContext                          # noqa: F401
+from .stun import StunMessage                          # noqa: F401

@@ -1,0 +1,179 @@
+"""RTCPeer: one browser peer = one UDP socket muxing STUN + DTLS + SRTP.
+
+The reference holds an RTCPeerConnection per peer with per-display media
+graphs (reference src/selkies/rtc.py:1171-1302). Here a peer is an
+asyncio DatagramProtocol plus three tiny state machines; demux is the
+RFC 7983 first-byte rule. Media in is the engine's pre-encoded Annex-B
+access units; media out of the peer is SRTP on the wire."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Callable, Optional
+
+from .dtls import DtlsEndpoint, generate_certificate
+from .rtp import (H264Packetizer, OpusPacketizer, parse_rtcp_pli)
+from .sdp import RemoteDescription, build_offer, parse_answer
+from .srtp import SrtpContext, SrtpError
+from .stun import IceLiteResponder, is_stun, make_ice_credentials
+
+logger = logging.getLogger("selkies_tpu.webrtc.peer")
+
+
+class RTCPeer(asyncio.DatagramProtocol):
+    """Server-side peer: ICE-lite responder + DTLS server + SRTP sender.
+
+    Lifecycle: ``await peer.listen()`` -> ``peer.create_offer()`` ->
+    (signaling) -> ``peer.set_remote_answer(sdp)`` -> datagrams drive the
+    handshake -> ``peer.connected`` -> ``send_video_au()`` /
+    ``send_audio_frame()``.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 on_request_keyframe: Optional[Callable] = None,
+                 with_audio: bool = True, fullcolor: bool = False):
+        self.host = host
+        self.port = port
+        self.ufrag, self.pwd = make_ice_credentials()
+        self.ice = IceLiteResponder(self.ufrag, self.pwd)
+        self.dtls = DtlsEndpoint(server=True)
+        self.srtp: SrtpContext | None = None
+        self.video = H264Packetizer()
+        self.audio = OpusPacketizer()
+        self.remote: RemoteDescription | None = None
+        self.on_request_keyframe = on_request_keyframe
+        self.with_audio = with_audio
+        self.fullcolor = fullcolor
+        self._transport: asyncio.DatagramTransport | None = None
+        self._peer_addr: tuple[str, int] | None = None
+        self.connected = asyncio.Event()
+        self._t0 = time.monotonic()
+        self._last_sr = 0.0
+        self._closed = False
+
+    # -- socket -------------------------------------------------------------
+    async def listen(self) -> int:
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, local_addr=(self.host, self.port))
+        self.port = self._transport.get_extra_info("sockname")[1]
+        return self.port
+
+    def connection_made(self, transport):
+        self._transport = transport
+
+    def datagram_received(self, data: bytes, addr):
+        try:
+            self._demux(data, addr)
+        except Exception:
+            logger.exception("peer datagram error")
+
+    # -- demux (RFC 7983) ---------------------------------------------------
+    def _demux(self, data: bytes, addr) -> None:
+        if not data:
+            return
+        b = data[0]
+        if is_stun(data):
+            resp = self.ice.handle(data, addr)
+            if resp and self._transport:
+                self._transport.sendto(resp, addr)
+            if self.ice.nominated_addr:
+                self._peer_addr = self.ice.nominated_addr
+        elif 20 <= b <= 63:                       # DTLS
+            self._peer_addr = addr
+            self.dtls.feed(data)
+            self._flush_dtls(addr)
+            if self.dtls.handshake_complete and self.srtp is None:
+                self._on_dtls_complete()
+        elif 128 <= b <= 191 and self.srtp is not None:
+            self._on_srtp(data)
+
+    def _flush_dtls(self, addr) -> None:
+        out = self.dtls.take_outgoing()
+        if out and self._transport:
+            self._transport.sendto(out, addr)
+
+    def _on_dtls_complete(self) -> None:
+        if self.remote and self.remote.fingerprint:
+            if not self.dtls.verify_peer_fingerprint(
+                    self.remote.fingerprint):
+                logger.error("peer fingerprint mismatch; dropping")
+                self.close()
+                return
+        client_master, server_master = self.dtls.export_srtp_keys()
+        # we are the DTLS server
+        self.srtp = SrtpContext(client_master, server_master,
+                                is_client=False)
+        self.connected.set()
+        logger.info("webrtc peer connected (srtp up, addr=%s)",
+                    self._peer_addr)
+
+    def _on_srtp(self, data: bytes) -> None:
+        pt = data[1] & 0x7F
+        if 64 <= pt <= 95:                        # RTCP range (RFC 5761)
+            try:
+                rtcp = self.srtp.unprotect_rtcp(data)
+            except SrtpError:
+                return
+            if parse_rtcp_pli(rtcp) and self.on_request_keyframe:
+                self.on_request_keyframe()
+        # inbound RTP (browser mic) is handled by the service if wired
+
+    # -- signaling ----------------------------------------------------------
+    def create_offer(self) -> str:
+        _, _, fingerprint = generate_certificate()
+        return build_offer(self.host, self.port, self.ufrag, self.pwd,
+                           fingerprint, video_pt=self.video.payload_type,
+                           audio_pt=self.audio.payload_type,
+                           with_audio=self.with_audio,
+                           fullcolor=self.fullcolor)
+
+    def set_remote_answer(self, sdp: str) -> None:
+        self.remote = parse_answer(sdp)
+        self.ice.set_remote(self.remote.ice_ufrag, self.remote.ice_pwd)
+
+    # -- media --------------------------------------------------------------
+    @property
+    def can_send(self) -> bool:
+        return (self.srtp is not None and self._peer_addr is not None
+                and not self._closed)
+
+    def video_timestamp(self) -> int:
+        return int((time.monotonic() - self._t0) * 90000) & 0xFFFFFFFF
+
+    def send_video_au(self, annexb: bytes, timestamp: int | None = None
+                      ) -> int:
+        """Packetize + protect + send one pre-encoded access unit.
+        Returns packets sent (0 when not connected — drop, never block:
+        the relay/backpressure contract lives upstream)."""
+        if not self.can_send:
+            return 0
+        ts = self.video_timestamp() if timestamp is None else timestamp
+        pkts = self.video.packetize(annexb, ts)
+        for p in pkts:
+            self._transport.sendto(self.srtp.protect_rtp(p.to_bytes()),
+                                   self._peer_addr)
+        now = time.monotonic()
+        if now - self._last_sr > 1.0:
+            self._last_sr = now
+            self._transport.sendto(
+                self.srtp.protect_rtcp(self.video.sender_report(ts)),
+                self._peer_addr)
+        return len(pkts)
+
+    def send_audio_frame(self, opus: bytes, timestamp: int) -> int:
+        if not self.can_send:
+            return 0
+        p = self.audio.packetize(opus, timestamp)
+        self._transport.sendto(self.srtp.protect_rtp(p.to_bytes()),
+                               self._peer_addr)
+        return 1
+
+    def close(self) -> None:
+        self._closed = True
+        if self._transport:
+            self._transport.close()
+            self._transport = None
+        self.dtls.close()

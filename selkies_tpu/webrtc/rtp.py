@@ -1,0 +1,191 @@
+"""RTP packetization: RFC 3550 headers, RFC 6184 H.264 (single NAL +
+FU-A), RFC 7587 Opus, and the minimal RTCP the product uses (SR out,
+PLI/RR in).
+
+The reference's whole fork purpose was feeding PRE-ENCODED access units
+straight to the packetizer (``Encoder.pack()``, reference
+src/selkies/webrtc/rtcrtpsender.py:364-393 and codecs/h264.py:339-346);
+this module is that seam, built TPU-side: the engine's Annex-B output
+goes straight to packets, no re-encode, no av dependency."""
+
+from __future__ import annotations
+
+import secrets
+import struct
+import time
+
+
+class RtpPacket:
+    __slots__ = ("payload_type", "seq", "timestamp", "ssrc", "marker",
+                 "payload")
+
+    def __init__(self, payload_type: int, seq: int, timestamp: int,
+                 ssrc: int, marker: bool, payload: bytes):
+        self.payload_type = payload_type
+        self.seq = seq
+        self.timestamp = timestamp
+        self.ssrc = ssrc
+        self.marker = marker
+        self.payload = payload
+
+    def to_bytes(self) -> bytes:
+        b1 = (0x80 if self.marker else 0) | self.payload_type
+        return struct.pack("!BBHII", 0x80, b1, self.seq & 0xFFFF,
+                           self.timestamp & 0xFFFFFFFF, self.ssrc) \
+            + self.payload
+
+    @classmethod
+    def parse(cls, data: bytes) -> "RtpPacket":
+        if len(data) < 12:
+            raise ValueError("short RTP packet")
+        v_p_x_cc, m_pt, seq, ts, ssrc = struct.unpack_from("!BBHII", data, 0)
+        if v_p_x_cc >> 6 != 2:
+            raise ValueError("not RTP v2")
+        off = 12 + 4 * (v_p_x_cc & 0x0F)
+        if v_p_x_cc & 0x10:                      # extension header
+            if len(data) < off + 4:
+                raise ValueError("short RTP extension")
+            ext_len = struct.unpack_from("!H", data, off + 2)[0]
+            off += 4 + 4 * ext_len
+        payload = data[off:]
+        if v_p_x_cc & 0x20 and payload:          # padding
+            payload = payload[:-payload[-1]]
+        return cls(m_pt & 0x7F, seq, ts, ssrc, bool(m_pt & 0x80), payload)
+
+
+def split_annexb(annexb: bytes) -> list[bytes]:
+    """Annex-B byte stream -> raw NAL units (no start codes)."""
+    nals = []
+    i = 0
+    n = len(annexb)
+    while i < n:
+        if annexb[i:i + 3] == b"\x00\x00\x01":
+            start = i + 3
+        elif annexb[i:i + 4] == b"\x00\x00\x00\x01":
+            start = i + 4
+        else:
+            i += 1
+            continue
+        j = annexb.find(b"\x00\x00\x01", start)
+        end = n if j < 0 else (j - 1 if annexb[j - 1] == 0 else j)
+        nals.append(annexb[start:end])
+        i = end
+    return nals
+
+
+class H264Packetizer:
+    """RFC 6184 packetization-mode 1 (non-interleaved): single NAL units
+    when they fit, FU-A fragmentation otherwise. One call per access
+    unit; marker set on the AU's last packet."""
+
+    def __init__(self, payload_type: int = 102, ssrc: int | None = None,
+                 mtu: int = 1200):
+        self.payload_type = payload_type
+        self.ssrc = ssrc if ssrc is not None else secrets.randbits(32)
+        self.mtu = mtu
+        self.seq = secrets.randbits(16)
+        self._octets = 0
+        self._packets = 0
+
+    def packetize(self, annexb: bytes, timestamp: int) -> list[RtpPacket]:
+        packets: list[RtpPacket] = []
+        nals = [n for n in split_annexb(annexb) if n]
+        for nal in nals:
+            if len(nal) <= self.mtu:
+                packets.append(self._pkt(nal, timestamp))
+            else:
+                indicator = (nal[0] & 0xE0) | 28          # FU-A
+                header = nal[0] & 0x1F
+                rest = nal[1:]
+                first = True
+                while rest:
+                    chunk, rest = rest[:self.mtu - 2], rest[self.mtu - 2:]
+                    fu = 0x80 if first else (0x40 if not rest else 0x00)
+                    packets.append(self._pkt(
+                        bytes((indicator, fu | header)) + chunk, timestamp))
+                    first = False
+        if packets:
+            packets[-1].marker = True
+        return packets
+
+    def _pkt(self, payload: bytes, ts: int) -> RtpPacket:
+        p = RtpPacket(self.payload_type, self.seq, ts, self.ssrc, False,
+                      payload)
+        self.seq = (self.seq + 1) & 0xFFFF
+        self._octets += len(payload)
+        self._packets += 1
+        return p
+
+    def sender_report(self, timestamp: int) -> bytes:
+        """Minimal RTCP SR for lipsync/stat baselines."""
+        now = time.time() + 2208988800            # NTP epoch
+        ntp_hi = int(now)
+        ntp_lo = int((now - ntp_hi) * (1 << 32))
+        return struct.pack("!BBHIIIIII", 0x80, 200, 6, self.ssrc,
+                           ntp_hi, ntp_lo, timestamp & 0xFFFFFFFF,
+                           self._packets, self._octets)
+
+
+class OpusPacketizer:
+    """RFC 7587: one Opus frame per packet, 48 kHz RTP clock."""
+
+    def __init__(self, payload_type: int = 111, ssrc: int | None = None):
+        self.payload_type = payload_type
+        self.ssrc = ssrc if ssrc is not None else secrets.randbits(32)
+        self.seq = secrets.randbits(16)
+
+    def packetize(self, opus_frame: bytes, timestamp: int) -> RtpPacket:
+        p = RtpPacket(self.payload_type, self.seq, timestamp, self.ssrc,
+                      True, opus_frame)
+        self.seq = (self.seq + 1) & 0xFFFF
+        return p
+
+
+def depacketize_h264(packets: list[RtpPacket]) -> bytes:
+    """Client-side inverse for the loopback tests: RTP payloads of one
+    access unit -> Annex-B."""
+    out = bytearray()
+    fu: bytearray | None = None
+    for p in sorted(packets, key=lambda p: p.seq):
+        pl = p.payload
+        if not pl:
+            continue
+        ntype = pl[0] & 0x1F
+        if ntype == 28:                           # FU-A
+            start, end = pl[1] & 0x80, pl[1] & 0x40
+            if start:
+                fu = bytearray(
+                    bytes(((pl[0] & 0xE0) | (pl[1] & 0x1F),)))
+            if fu is not None:
+                fu += pl[2:]
+                if end:
+                    out += b"\x00\x00\x00\x01" + fu
+                    fu = None
+        elif ntype == 24:                         # STAP-A
+            off = 1
+            while off + 2 <= len(pl):
+                ln = struct.unpack_from("!H", pl, off)[0]
+                off += 2
+                out += b"\x00\x00\x00\x01" + pl[off:off + ln]
+                off += ln
+        else:
+            out += b"\x00\x00\x00\x01" + pl
+    return bytes(out)
+
+
+def parse_rtcp_pli(data: bytes) -> list[int]:
+    """-> media SSRCs for which the receiver asked a keyframe (PSFB/PLI,
+    RFC 4585 §6.3.1); also treats FIR (RFC 5104) as a PLI."""
+    ssrcs = []
+    off = 0
+    while off + 8 <= len(data):
+        b0, pt, length = struct.unpack_from("!BBH", data, off)
+        size = 4 * (length + 1)
+        if pt == 206:                             # PSFB
+            fmt = b0 & 0x1F
+            if fmt == 1 and off + 12 <= len(data):        # PLI
+                ssrcs.append(struct.unpack_from("!I", data, off + 8)[0])
+            elif fmt == 4 and off + 16 <= len(data):      # FIR
+                ssrcs.append(struct.unpack_from("!I", data, off + 12)[0])
+        off += max(size, 4)
+    return ssrcs

@@ -1,0 +1,94 @@
+"""SDP offer generation + answer parsing for the browser peer.
+
+Shapes match what the reference's RTC app negotiates (reference
+src/selkies/rtc.py:601-717 munge pass): H.264 packetization-mode 1,
+BUNDLE + rtcp-mux on one ICE-lite host candidate, sendonly media from
+the server. We always OFFER (the reference server initiates after
+signaling SESSION_START) and the browser answers."""
+
+from __future__ import annotations
+
+import dataclasses
+import secrets
+
+
+@dataclasses.dataclass
+class RemoteDescription:
+    ice_ufrag: str = ""
+    ice_pwd: str = ""
+    fingerprint: str = ""          # sha-256 hex:hex:...
+    setup: str = "active"
+    candidates: list = dataclasses.field(default_factory=list)
+
+
+def build_offer(host: str, port: int, ufrag: str, pwd: str,
+                fingerprint: str, video_pt: int = 102,
+                audio_pt: int = 111, with_audio: bool = True,
+                fullcolor: bool = False) -> str:
+    """One-shot SDP offer: sendonly video (+audio), ICE-lite, DTLS
+    actpass, all media bundled on one port."""
+    sid = secrets.randbits(62)
+    mids = ["0"] + (["1"] if with_audio else [])
+    lines = [
+        "v=0",
+        f"o=- {sid} 2 IN IP4 127.0.0.1",
+        "s=-",
+        "t=0 0",
+        "a=ice-lite",
+        f"a=group:BUNDLE {' '.join(mids)}",
+        "a=msid-semantic: WMS selkies",
+    ]
+    # profile f4001f enables Hi444PP for 4:4:4 streams (the reference's
+    # fullcolor munge, rtc.py:649-717); 42e01f is constrained baseline
+    profile = "f4001f" if fullcolor else "42e01f"
+    media = [
+        (f"m=video {port} UDP/TLS/RTP/SAVPF {video_pt}", [
+            f"a=rtpmap:{video_pt} H264/90000",
+            f"a=fmtp:{video_pt} level-asymmetry-allowed=1;"
+            f"packetization-mode=1;profile-level-id={profile}",
+            f"a=rtcp-fb:{video_pt} nack pli",
+            f"a=rtcp-fb:{video_pt} ccm fir",
+            f"a=rtcp-fb:{video_pt} goog-remb",
+        ]),
+    ]
+    if with_audio:
+        media.append(
+            (f"m=audio {port} UDP/TLS/RTP/SAVPF {audio_pt}", [
+                f"a=rtpmap:{audio_pt} opus/48000/2",
+                f"a=fmtp:{audio_pt} minptime=10;useinbandfec=1",
+            ]))
+    for i, (mline, extra) in enumerate(media):
+        lines.append(mline)
+        lines.append(f"c=IN IP4 {host}")
+        lines += [
+            f"a=mid:{mids[i]}",
+            "a=sendonly",
+            f"a=ice-ufrag:{ufrag}",
+            f"a=ice-pwd:{pwd}",
+            f"a=fingerprint:sha-256 {fingerprint}",
+            "a=setup:actpass",
+            "a=rtcp-mux",
+            f"a=msid:selkies selkies-{'video' if i == 0 else 'audio'}",
+        ]
+        lines += extra
+        lines.append(
+            f"a=candidate:1 1 udp 2130706431 {host} {port} typ host")
+        lines.append("a=end-of-candidates")
+    return "\r\n".join(lines) + "\r\n"
+
+
+def parse_answer(sdp: str) -> RemoteDescription:
+    r = RemoteDescription()
+    for raw in sdp.replace("\r\n", "\n").split("\n"):
+        line = raw.strip()
+        if line.startswith("a=ice-ufrag:") and not r.ice_ufrag:
+            r.ice_ufrag = line.split(":", 1)[1]
+        elif line.startswith("a=ice-pwd:") and not r.ice_pwd:
+            r.ice_pwd = line.split(":", 1)[1]
+        elif line.startswith("a=fingerprint:sha-256") and not r.fingerprint:
+            r.fingerprint = line.split()[-1]
+        elif line.startswith("a=setup:"):
+            r.setup = line.split(":", 1)[1]
+        elif line.startswith("a=candidate:"):
+            r.candidates.append(line[len("a=candidate:"):])
+    return r

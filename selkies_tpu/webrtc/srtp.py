@@ -1,0 +1,177 @@
+"""SRTP / SRTCP packet protection — RFC 3711, AES-CM-128 + HMAC-SHA1-80.
+
+The reference gets this from pylibsrtp inside its aiortc fork; here it is
+~150 lines on the ``cryptography`` AES-CTR primitive. Only the profile
+DTLS negotiates (``SRTP_AES128_CM_SHA1_80``) is implemented. Packet rate
+on this path is a few thousand per second — comfortably Python-speed.
+"""
+
+from __future__ import annotations
+
+import hmac
+import struct
+from hashlib import sha1
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+
+def _aes_ctr(key: bytes, iv16: bytes, data: bytes) -> bytes:
+    c = Cipher(algorithms.AES(key), modes.CTR(iv16)).encryptor()
+    return c.update(data) + c.finalize()
+
+
+def _kdf(master_key: bytes, master_salt: bytes, label: int,
+         n: int) -> bytes:
+    """RFC 3711 §4.3.1 AES-CM key derivation (kdr = 0).
+
+    key_id = label || r is 56 bits with the 8-bit label ABOVE the 48-bit
+    r term, XORed into the low bits of the 112-bit master salt — i.e. the
+    label lands at bit 48 (validated against the RFC 3711 B.3 vectors in
+    tests/test_webrtc_media.py)."""
+    x = int.from_bytes(master_salt, "big") ^ (label << 48)
+    iv = (x << 16).to_bytes(16, "big")
+    return _aes_ctr(master_key, iv, b"\x00" * ((n + 15) // 16 * 16))[:n]
+
+
+class ReplayWindow:
+    """RFC 3711 §3.3.2 64-entry sliding window."""
+
+    def __init__(self):
+        self._latest = -1
+        self._mask = 0
+
+    def check_and_update(self, index: int) -> bool:
+        if index > self._latest:
+            shift = index - self._latest
+            self._mask = (self._mask << shift) | 1
+            self._mask &= (1 << 64) - 1
+            self._latest = index
+            return True
+        delta = self._latest - index
+        if delta >= 64 or (self._mask >> delta) & 1:
+            return False
+        self._mask |= 1 << delta
+        return True
+
+
+class _Stream:
+    """Per-direction derived keys + rollover/replay state."""
+
+    def __init__(self, master: bytes):
+        key, salt = master[:16], master[16:30]
+        self.enc_key = _kdf(key, salt, 0, 16)
+        self.auth_key = _kdf(key, salt, 1, 20)
+        self.salt = _kdf(key, salt, 2, 14)
+        self.rtcp_enc_key = _kdf(key, salt, 3, 16)
+        self.rtcp_auth_key = _kdf(key, salt, 4, 20)
+        self.rtcp_salt = _kdf(key, salt, 5, 14)
+        self.roc: dict[int, int] = {}           # ssrc -> rollover counter
+        self.last_seq: dict[int, int] = {}
+        self.replay: dict[int, ReplayWindow] = {}
+        self.rtcp_index: dict[int, int] = {}
+
+
+def _rtp_iv(salt: bytes, ssrc: int, index: int) -> bytes:
+    x = int.from_bytes(salt, "big") ^ (ssrc << 48) ^ index
+    return (x << 16).to_bytes(16, "big")
+
+
+class SrtpError(Exception):
+    pass
+
+
+class SrtpContext:
+    """Bidirectional SRTP context from the two DTLS-exported masters.
+
+    ``is_client`` is the DTLS role: a client protects with the client
+    master and expects the server master inbound (RFC 5764 §4.2)."""
+
+    AUTH_TAG = 10
+
+    def __init__(self, client_master: bytes, server_master: bytes,
+                 is_client: bool):
+        self._tx = _Stream(client_master if is_client else server_master)
+        self._rx = _Stream(server_master if is_client else client_master)
+
+    # -- RTP ---------------------------------------------------------------
+    def protect_rtp(self, packet: bytes) -> bytes:
+        if len(packet) < 12:
+            raise SrtpError("short RTP packet")
+        seq = struct.unpack_from("!H", packet, 2)[0]
+        ssrc = struct.unpack_from("!I", packet, 8)[0]
+        st = self._tx
+        last = st.last_seq.get(ssrc)
+        roc = st.roc.get(ssrc, 0)
+        if last is not None and seq < 0x1000 and last > 0xF000:
+            roc += 1                    # sender-side wrap
+        st.roc[ssrc] = roc
+        st.last_seq[ssrc] = seq
+        index = (roc << 16) | seq
+        payload = _aes_ctr(st.enc_key, _rtp_iv(st.salt, ssrc, index),
+                           packet[12:])
+        authed = packet[:12] + payload
+        tag = hmac.new(st.auth_key,
+                       authed + struct.pack("!I", roc), sha1).digest()
+        return authed + tag[:self.AUTH_TAG]
+
+    def unprotect_rtp(self, packet: bytes) -> bytes:
+        if len(packet) < 12 + self.AUTH_TAG:
+            raise SrtpError("short SRTP packet")
+        body, tag = packet[:-self.AUTH_TAG], packet[-self.AUTH_TAG:]
+        seq = struct.unpack_from("!H", body, 2)[0]
+        ssrc = struct.unpack_from("!I", body, 8)[0]
+        st = self._rx
+        # index estimate (RFC 3711 §3.3.1)
+        roc = st.roc.get(ssrc, 0)
+        last = st.last_seq.get(ssrc)
+        guess = roc
+        if last is not None:
+            if last > 0xF000 and seq < 0x1000:
+                guess = roc + 1
+            elif last < 0x1000 and seq > 0xF000 and roc > 0:
+                guess = roc - 1
+        want = hmac.new(st.auth_key,
+                        body + struct.pack("!I", guess), sha1).digest()
+        if not hmac.compare_digest(want[:self.AUTH_TAG], tag):
+            raise SrtpError("SRTP auth failure")
+        index = (guess << 16) | seq
+        rw = st.replay.setdefault(ssrc, ReplayWindow())
+        if not rw.check_and_update(index):
+            raise SrtpError("SRTP replay")
+        if guess > roc or (last is not None and seq > last) or last is None:
+            st.roc[ssrc] = guess
+            st.last_seq[ssrc] = seq
+        return body[:12] + _aes_ctr(st.enc_key,
+                                    _rtp_iv(st.salt, ssrc, index), body[12:])
+
+    # -- RTCP (always E-bit encrypted) -------------------------------------
+    def protect_rtcp(self, packet: bytes) -> bytes:
+        if len(packet) < 8:
+            raise SrtpError("short RTCP packet")
+        ssrc = struct.unpack_from("!I", packet, 4)[0]
+        st = self._tx
+        index = st.rtcp_index.get(ssrc, 0) + 1
+        st.rtcp_index[ssrc] = index
+        iv = _rtp_iv(st.rtcp_salt, ssrc, index)
+        enc = packet[:8] + _aes_ctr(st.rtcp_enc_key, iv, packet[8:])
+        trailer = struct.pack("!I", index | 0x80000000)       # E-bit set
+        tag = hmac.new(st.rtcp_auth_key, enc + trailer, sha1).digest()
+        return enc + trailer + tag[:self.AUTH_TAG]
+
+    def unprotect_rtcp(self, packet: bytes) -> bytes:
+        if len(packet) < 8 + 4 + self.AUTH_TAG:
+            raise SrtpError("short SRTCP packet")
+        tag = packet[-self.AUTH_TAG:]
+        trailer = packet[-self.AUTH_TAG - 4:-self.AUTH_TAG]
+        body = packet[:-self.AUTH_TAG - 4]
+        st = self._rx
+        want = hmac.new(st.rtcp_auth_key, body + trailer, sha1).digest()
+        if not hmac.compare_digest(want[:self.AUTH_TAG], tag):
+            raise SrtpError("SRTCP auth failure")
+        word = struct.unpack("!I", trailer)[0]
+        if not word & 0x80000000:
+            return body                 # unencrypted SRTCP
+        index = word & 0x7FFFFFFF
+        ssrc = struct.unpack_from("!I", body, 4)[0]
+        iv = _rtp_iv(st.rtcp_salt, ssrc, index)
+        return body[:8] + _aes_ctr(st.rtcp_enc_key, iv, body[8:])

@@ -1,0 +1,193 @@
+"""STUN message codec + ICE-lite responder logic (RFC 5389 / RFC 8445).
+
+The reference vendors aioice (2.7k LoC: full agent, TURN/mDNS, check
+lists). An ICE-LITE server needs none of that — it answers Binding
+Requests on its single host candidate with MESSAGE-INTEGRITY +
+XOR-MAPPED-ADDRESS + FINGERPRINT, and notices USE-CANDIDATE nominations
+(reference src/selkies/ice/stun.py is the behavioural model for the
+codec)."""
+
+from __future__ import annotations
+
+import hmac
+import os
+import secrets
+import struct
+import zlib
+from hashlib import sha1
+
+MAGIC_COOKIE = 0x2112A442
+BINDING_REQUEST = 0x0001
+BINDING_RESPONSE = 0x0101
+BINDING_ERROR = 0x0111
+
+ATTR_USERNAME = 0x0006
+ATTR_MESSAGE_INTEGRITY = 0x0008
+ATTR_ERROR_CODE = 0x0009
+ATTR_XOR_MAPPED_ADDRESS = 0x0020
+ATTR_PRIORITY = 0x0024
+ATTR_USE_CANDIDATE = 0x0025
+ATTR_FINGERPRINT = 0x8028
+ATTR_ICE_CONTROLLING = 0x802A
+ATTR_ICE_CONTROLLED = 0x8029
+
+
+def is_stun(datagram: bytes) -> bool:
+    return (len(datagram) >= 20 and datagram[0] < 4
+            and struct.unpack_from("!I", datagram, 4)[0] == MAGIC_COOKIE)
+
+
+class StunError(Exception):
+    pass
+
+
+class StunMessage:
+    def __init__(self, msg_type: int, txid: bytes | None = None):
+        self.type = msg_type
+        self.txid = txid if txid is not None else os.urandom(12)
+        self.attrs: list[tuple[int, bytes]] = []
+
+    # -- build --------------------------------------------------------------
+    def add(self, attr: int, value: bytes) -> "StunMessage":
+        self.attrs.append((attr, value))
+        return self
+
+    def add_xor_mapped_address(self, host: str, port: int):
+        xport = port ^ (MAGIC_COOKIE >> 16)
+        ip = bytes(int(p) for p in host.split("."))
+        xip = bytes(b ^ m for b, m in
+                    zip(ip, struct.pack("!I", MAGIC_COOKIE)))
+        return self.add(ATTR_XOR_MAPPED_ADDRESS,
+                        struct.pack("!BBH", 0, 0x01, xport) + xip)
+
+    def _encode(self, attrs: list[tuple[int, bytes]],
+                length_override: int | None = None) -> bytes:
+        body = b""
+        for a, v in attrs:
+            body += struct.pack("!HH", a, len(v)) + v + b"\x00" * (-len(v) % 4)
+        length = length_override if length_override is not None else len(body)
+        return struct.pack("!HHI", self.type, length,
+                           MAGIC_COOKIE) + self.txid + body
+
+    def to_bytes(self, integrity_key: bytes | None = None,
+                 fingerprint: bool = True) -> bytes:
+        attrs = list(self.attrs)
+        if integrity_key is not None:
+            # MI covers the header with length up to and including MI
+            mi_len = sum(4 + len(v) + (-len(v) % 4) for _, v in attrs) + 24
+            data = self._encode(attrs, length_override=mi_len)
+            mac = hmac.new(integrity_key, data, sha1).digest()
+            attrs.append((ATTR_MESSAGE_INTEGRITY, mac))
+        if fingerprint:
+            fp_len = sum(4 + len(v) + (-len(v) % 4) for _, v in attrs) + 8
+            data = self._encode(attrs, length_override=fp_len)
+            crc = (zlib.crc32(data) & 0xFFFFFFFF) ^ 0x5354554E
+            attrs.append((ATTR_FINGERPRINT, struct.pack("!I", crc)))
+        return self._encode(attrs)
+
+    # -- parse --------------------------------------------------------------
+    @classmethod
+    def parse(cls, data: bytes) -> "StunMessage":
+        if len(data) < 20:
+            raise StunError("short STUN message")
+        msg_type, length, cookie = struct.unpack_from("!HHI", data, 0)
+        if cookie != MAGIC_COOKIE or len(data) < 20 + length:
+            raise StunError("bad STUN header")
+        m = cls(msg_type, data[4 + 4:20])
+        off = 20
+        end = 20 + length
+        while off + 4 <= end:
+            a, alen = struct.unpack_from("!HH", data, off)
+            off += 4
+            m.attrs.append((a, data[off:off + alen]))
+            off += alen + (-alen % 4)
+        m._raw = data
+        return m
+
+    def attr(self, attr: int) -> bytes | None:
+        for a, v in self.attrs:
+            if a == attr:
+                return v
+        return None
+
+    def check_integrity(self, key: bytes) -> bool:
+        """Validate MESSAGE-INTEGRITY over the received raw bytes."""
+        raw = getattr(self, "_raw", None)
+        mi = self.attr(ATTR_MESSAGE_INTEGRITY)
+        if raw is None or mi is None:
+            return False
+        off = 20
+        while off + 4 <= len(raw):
+            a, alen = struct.unpack_from("!HH", raw, off)
+            if a == ATTR_MESSAGE_INTEGRITY:
+                hdr = struct.pack("!HHI", self.type, off - 20 + 24,
+                                  MAGIC_COOKIE) + self.txid
+                covered = hdr + raw[20:off]
+                want = hmac.new(key, covered, sha1).digest()
+                return hmac.compare_digest(want, mi)
+            off += 4 + alen + (-alen % 4)
+        return False
+
+    def xor_mapped_address(self) -> tuple[str, int] | None:
+        v = self.attr(ATTR_XOR_MAPPED_ADDRESS)
+        if v is None or len(v) < 8 or v[1] != 0x01:
+            return None
+        port = struct.unpack_from("!H", v, 2)[0] ^ (MAGIC_COOKIE >> 16)
+        ip = bytes(b ^ m for b, m in
+                   zip(v[4:8], struct.pack("!I", MAGIC_COOKIE)))
+        return ".".join(str(b) for b in ip), port
+
+
+def make_ice_credentials() -> tuple[str, str]:
+    """-> (ufrag, pwd) with RFC 8445 lengths."""
+    return secrets.token_urlsafe(4)[:4], secrets.token_urlsafe(24)[:22]
+
+
+class IceLiteResponder:
+    """Answers authenticated Binding Requests on one host candidate;
+    reports the peer's (address, nominated) as it learns them."""
+
+    def __init__(self, local_ufrag: str, local_pwd: str):
+        self.ufrag = local_ufrag
+        self.pwd = local_pwd
+        self.remote_ufrag: str | None = None
+        self.remote_pwd: str | None = None
+        self.nominated_addr: tuple[str, int] | None = None
+
+    def set_remote(self, ufrag: str, pwd: str) -> None:
+        self.remote_ufrag, self.remote_pwd = ufrag, pwd
+
+    def handle(self, datagram: bytes, addr: tuple[str, int]
+               ) -> bytes | None:
+        """-> response datagram (or None to drop)."""
+        try:
+            msg = StunMessage.parse(datagram)
+        except StunError:
+            return None
+        if msg.type != BINDING_REQUEST:
+            return None                    # lite agents never get responses
+        if not msg.check_integrity(self.pwd.encode()):
+            err = StunMessage(BINDING_ERROR, msg.txid)
+            err.add(ATTR_ERROR_CODE, b"\x00\x00\x04\x01Unauthorized")
+            return err.to_bytes()
+        if msg.attr(ATTR_USE_CANDIDATE) is not None:
+            self.nominated_addr = addr
+        elif self.nominated_addr is None:
+            self.nominated_addr = addr     # lite: first valid pair wins
+        resp = StunMessage(BINDING_RESPONSE, msg.txid)
+        resp.add_xor_mapped_address(*addr)
+        return resp.to_bytes(integrity_key=self.pwd.encode())
+
+    def binding_request(self, dest_note: tuple[str, int] | None = None
+                        ) -> bytes:
+        """Client-side helper (tests): an authenticated Binding Request
+        toward a remote ICE-lite agent."""
+        if self.remote_pwd is None:
+            raise StunError("remote credentials not set")
+        req = StunMessage(BINDING_REQUEST)
+        req.add(ATTR_USERNAME,
+                f"{self.remote_ufrag}:{self.ufrag}".encode())
+        req.add(ATTR_ICE_CONTROLLING, os.urandom(8))
+        req.add(ATTR_USE_CANDIDATE, b"")
+        req.add(ATTR_PRIORITY, struct.pack("!I", 0x7E0000FF))
+        return req.to_bytes(integrity_key=self.remote_pwd.encode())

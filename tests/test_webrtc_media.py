@@ -1,0 +1,389 @@
+"""WebRTC media plane: unit tests for each protocol layer plus a full
+protocol-level loopback — a fake browser completes ICE + DTLS against
+RTCPeer, receives SRTP, depacketizes RFC 6184, and byte-exact-decodes an
+IDR with the spec decoder (VERDICT round-2 item 4's 'done' bar)."""
+
+import asyncio
+import json
+import secrets
+import struct
+
+import numpy as np
+
+from selkies_tpu.codecs import h264 as H
+from selkies_tpu.codecs import h264_ref_decoder as refdec
+from selkies_tpu.webrtc.dtls import DtlsEndpoint
+from selkies_tpu.webrtc.peer import RTCPeer
+from selkies_tpu.webrtc.rtp import (H264Packetizer, RtpPacket,
+                                    depacketize_h264, parse_rtcp_pli,
+                                    split_annexb)
+from selkies_tpu.webrtc.sdp import build_offer, parse_answer
+from selkies_tpu.webrtc.srtp import SrtpContext, SrtpError
+from selkies_tpu.webrtc.stun import (BINDING_REQUEST, BINDING_RESPONSE,
+                                     IceLiteResponder, StunMessage, is_stun,
+                                     make_ice_credentials)
+
+
+# --------------------------------------------------------------- STUN
+
+
+def test_stun_roundtrip_and_integrity():
+    ufrag, pwd = make_ice_credentials()
+    req = StunMessage(BINDING_REQUEST)
+    req.add(0x0006, f"srv:{ufrag}".encode())
+    wire = req.to_bytes(integrity_key=pwd.encode())
+    assert is_stun(wire)
+    parsed = StunMessage.parse(wire)
+    assert parsed.type == BINDING_REQUEST
+    assert parsed.txid == req.txid
+    assert parsed.check_integrity(pwd.encode())
+    assert not parsed.check_integrity(b"wrong-password")
+
+
+def test_ice_lite_responder_flow():
+    ufrag, pwd = make_ice_credentials()
+    srv = IceLiteResponder(ufrag, pwd)
+    cli = IceLiteResponder(*make_ice_credentials())
+    cli.set_remote(ufrag, pwd)
+    req = cli.binding_request()
+    resp = srv.handle(req, ("192.0.2.7", 4242))
+    assert resp is not None
+    msg = StunMessage.parse(resp)
+    assert msg.type == BINDING_RESPONSE
+    assert msg.check_integrity(pwd.encode())
+    assert msg.xor_mapped_address() == ("192.0.2.7", 4242)
+    assert srv.nominated_addr == ("192.0.2.7", 4242)
+    # unauthenticated request -> 401, no nomination change
+    bad = StunMessage(BINDING_REQUEST).to_bytes()
+    err = srv.handle(bad, ("203.0.113.9", 1))
+    assert StunMessage.parse(err).type == 0x0111
+    assert srv.nominated_addr == ("192.0.2.7", 4242)
+
+
+# --------------------------------------------------------------- SRTP
+
+
+def _dtls_loopback():
+    srv = DtlsEndpoint(server=True)
+    cli = DtlsEndpoint(server=False)
+    cli.handshake()
+    for _ in range(10):
+        if srv.handshake_complete and cli.handshake_complete:
+            break
+        d = cli.take_outgoing()
+        if d:
+            srv.feed(d)
+        d = srv.take_outgoing()
+        if d:
+            cli.feed(d)
+    assert srv.handshake_complete and cli.handshake_complete
+    return srv, cli
+
+
+def test_dtls_handshake_and_key_export():
+    srv, cli = _dtls_loopback()
+    assert srv.export_srtp_keys() == cli.export_srtp_keys()
+    ck, sk = srv.export_srtp_keys()
+    assert len(ck) == 30 and len(sk) == 30 and ck != sk
+    assert srv.verify_peer_fingerprint(cli.peer_fingerprint()
+                                       ) or srv.peer_fingerprint()
+    srv.close()
+    cli.close()
+
+
+def test_srtp_kdf_rfc3711_vectors():
+    """RFC 3711 Appendix B.3 key-derivation test vectors — the one bug
+    class a loopback test can never catch (both ends sharing a wrong KDF
+    still interoperate with each other, just not with libsrtp)."""
+    from selkies_tpu.webrtc.srtp import _kdf
+    mk = bytes.fromhex("E1F97A0D3E018BE0D64FA32C06DE4139")
+    ms = bytes.fromhex("0EC675AD498AFEEBB6960B3AABE6")
+    assert _kdf(mk, ms, 0, 16) == \
+        bytes.fromhex("C61E7A93744F39EE10734AFE3FF7A087")
+    assert _kdf(mk, ms, 2, 14) == \
+        bytes.fromhex("30CBBC08863D8C85D49DB34A9AE1")
+    assert _kdf(mk, ms, 1, 20) == \
+        bytes.fromhex("CEBE321F6FF7716B6FD4AB49AF256A156D38BAA4")
+
+
+def test_srtp_rtp_and_rtcp_roundtrip():
+    ck, sk = secrets.token_bytes(30), secrets.token_bytes(30)
+    sender = SrtpContext(ck, sk, is_client=False)     # protects w/ server
+    receiver = SrtpContext(ck, sk, is_client=True)    # expects server
+    pkt = RtpPacket(102, 7, 1234, 0xDEADBEEF, True, b"payload" * 20)
+    wire = sender.protect_rtp(pkt.to_bytes())
+    assert wire != pkt.to_bytes()
+    back = receiver.unprotect_rtp(wire)
+    assert back == pkt.to_bytes()
+    # replay must be rejected
+    try:
+        receiver.unprotect_rtp(wire)
+        raised = False
+    except SrtpError:
+        raised = True
+    assert raised
+    # tampered tag must fail
+    try:
+        receiver.unprotect_rtp(wire[:-1] + bytes((wire[-1] ^ 1,)))
+        raised = False
+    except SrtpError:
+        raised = True
+    assert raised
+    rtcp = struct.pack("!BBHI", 0x80, 200, 1, 0xDEADBEEF) + b"x" * 20
+    assert receiver.unprotect_rtcp(sender.protect_rtcp(rtcp)) == rtcp
+
+
+# ---------------------------------------------------------------- RTP
+
+
+def _small_idr():
+    rng = np.random.default_rng(2)
+    h, w = 32, 48
+    y = rng.integers(0, 256, (h, w), dtype=np.uint8)
+    u = rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8)
+    v = rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8)
+    enc = H.I16Encoder(w, h, 30)
+    annexb = enc.headers() + enc.encode_frame(y, u, v)
+    return annexb, enc
+
+
+def test_h264_packetize_depacketize_with_fua():
+    annexb, _ = _small_idr()
+    pk = H264Packetizer(mtu=100)          # force FU-A fragmentation
+    pkts = pk.packetize(annexb, 90000)
+    assert any(p.payload[0] & 0x1F == 28 for p in pkts), "no FU-A made"
+    assert pkts[-1].marker and not pkts[0].marker
+    rebuilt = depacketize_h264(pkts)
+    assert [n[0] & 0x1F for n in split_annexb(rebuilt)] == \
+        [n[0] & 0x1F for n in split_annexb(annexb)]
+    assert b"".join(split_annexb(rebuilt)) == b"".join(split_annexb(annexb))
+
+
+def test_rtcp_pli_parse():
+    pli = struct.pack("!BBHII", 0x81, 206, 2, 1, 0xCAFEBABE)
+    assert parse_rtcp_pli(pli) == [0xCAFEBABE]
+    sr = struct.pack("!BBHIIIIII", 0x80, 200, 6, 1, 0, 0, 0, 0, 0)
+    assert parse_rtcp_pli(sr) == []
+
+
+# ------------------------------------------------- full loopback peer
+
+
+class _Browser(asyncio.DatagramProtocol):
+    """The fake browser: collects datagrams, demuxes SRTP vs rest."""
+
+    def __init__(self):
+        self.queue = asyncio.Queue()
+        self.transport = None
+
+    def connection_made(self, transport):
+        self.transport = transport
+
+    def datagram_received(self, data, addr):
+        self.queue.put_nowait(data)
+
+
+async def _drain(q, timeout=2.0):
+    out = []
+    try:
+        while True:
+            out.append(await asyncio.wait_for(q.get(), timeout))
+            timeout = 0.25
+    except asyncio.TimeoutError:
+        return out
+
+
+async def test_full_loopback_browser_decodes_idr():
+    keyframe_requests = []
+    peer = RTCPeer(on_request_keyframe=lambda: keyframe_requests.append(1))
+    port = await peer.listen()
+    offer = peer.create_offer()
+    assert "a=ice-lite" in offer and "a=setup:actpass" in offer
+
+    # the browser side: parse the offer like a real client would
+    remote = parse_answer(offer)          # same grammar both ways
+    assert remote.ice_pwd == peer.pwd
+    cli_ice = IceLiteResponder(*make_ice_credentials())
+    cli_ice.set_remote(remote.ice_ufrag, remote.ice_pwd)
+    cli_dtls = DtlsEndpoint(server=False)
+
+    # answer SDP back to the server (fingerprint of the shared test cert)
+    answer = build_offer("127.0.0.1", 0, cli_ice.ufrag, cli_ice.pwd,
+                         remote.fingerprint).replace(
+        "a=setup:actpass", "a=setup:active")
+    peer.set_remote_answer(answer)
+
+    loop = asyncio.get_running_loop()
+    browser = _Browser()
+    transport, _ = await loop.create_datagram_endpoint(
+        lambda: browser, remote_addr=("127.0.0.1", port))
+
+    # ICE: authenticated binding request -> response
+    transport.sendto(cli_ice.binding_request())
+    resp = await asyncio.wait_for(browser.queue.get(), 2)
+    assert is_stun(resp)
+    assert StunMessage.parse(resp).type == BINDING_RESPONSE
+
+    # DTLS handshake (client drives)
+    cli_dtls.handshake()
+    transport.sendto(cli_dtls.take_outgoing())
+    for _ in range(10):
+        if cli_dtls.handshake_complete and peer.srtp is not None:
+            break
+        try:
+            d = await asyncio.wait_for(browser.queue.get(), 2)
+        except asyncio.TimeoutError:
+            d = b""
+        if d and 20 <= d[0] <= 63:
+            cli_dtls.feed(d)
+            out = cli_dtls.take_outgoing()
+            if out:
+                transport.sendto(out)
+    assert cli_dtls.handshake_complete
+    await asyncio.wait_for(peer.connected.wait(), 2)
+
+    ck, sk = cli_dtls.export_srtp_keys()
+    cli_srtp = SrtpContext(ck, sk, is_client=True)
+
+    # server streams a REAL IDR access unit (golden encoder output)
+    annexb, enc = _small_idr()
+    sent = peer.send_video_au(annexb)
+    assert sent > 0
+
+    datagrams = await _drain(browser.queue)
+    rtp_pkts = []
+    for d in datagrams:
+        if not d or not (128 <= d[0] <= 191):
+            continue
+        pt = d[1] & 0x7F
+        if 64 <= pt <= 95:
+            cli_srtp.unprotect_rtcp(d)    # SR must authenticate
+            continue
+        rtp_pkts.append(RtpPacket.parse(cli_srtp.unprotect_rtp(d)))
+    assert rtp_pkts, "no media arrived"
+    rebuilt = depacketize_h264(rtp_pkts)
+    my, mu, mv = refdec.Decoder().decode(rebuilt)
+    assert np.array_equal(my, enc.recon_y)
+    assert np.array_equal(mu, enc.recon_u)
+    assert np.array_equal(mv, enc.recon_v)
+
+    # browser asks for a keyframe: PLI through SRTCP
+    pli = struct.pack("!BBHII", 0x81, 206, 2,
+                      0xAABBCCDD, peer.video.ssrc)
+    transport.sendto(cli_srtp.protect_rtcp(pli))
+    await asyncio.sleep(0.2)
+    assert keyframe_requests, "PLI did not reach the keyframe callback"
+
+    transport.close()
+    peer.close()
+
+
+# ----------------------------------------- service end-to-end session
+
+
+async def test_webrtc_service_builds_real_sessions(client_factory):
+    """Browser simulator end-to-end THROUGH the service: signaling WS ->
+    offer -> answer -> ICE -> DTLS -> live SRTP video from the synthetic
+    TPU capture, decoded with the spec decoder."""
+    import aiohttp
+
+    from selkies_tpu.engine.capture import ScreenCapture
+    from selkies_tpu.server.core import CentralizedStreamServer
+    from selkies_tpu.settings import AppSettings
+
+    s = AppSettings.parse([], {})
+    s.set_server("mode", "webrtc")
+    s.set_server("initial_width", 64)
+    s.set_server("initial_height", 64)
+    s.set_server("webrtc_media_ip", "127.0.0.1")
+    s.set_server("h264_motion_vrange", 2)   # small jit for test speed
+    s.set_server("h264_motion_hrange", 1)
+    from selkies_tpu.server.webrtc_service import WebRTCService
+    server = CentralizedStreamServer(s)
+    svc = WebRTCService(
+        s, capture_factory=lambda: ScreenCapture(source_kind="synthetic"))
+    server.register_service("webrtc", svc)
+    client = await client_factory(server, "webrtc")
+
+    ws = await client.ws_connect("/api/signaling")
+    await ws.send_str("HELLO client {}")
+    assert (await ws.receive_str()) == "HELLO"
+    await ws.send_str("SESSION server")
+    ok = await ws.receive_str()
+    assert ok.startswith("SESSION_OK")
+
+    offer_msg = json.loads(await asyncio.wait_for(ws.receive_str(), 5))
+    offer = offer_msg["sdp"]["sdp"]
+    assert offer_msg["sdp"]["type"] == "offer"
+    remote = parse_answer(offer)
+    # media port from the offer's candidate line
+    port = int(remote.candidates[0].split()[5])
+
+    cli_ice = IceLiteResponder(*make_ice_credentials())
+    cli_ice.set_remote(remote.ice_ufrag, remote.ice_pwd)
+    cli_dtls = DtlsEndpoint(server=False)
+    answer = build_offer("127.0.0.1", 0, cli_ice.ufrag, cli_ice.pwd,
+                         remote.fingerprint).replace(
+        "a=setup:actpass", "a=setup:active")
+    await ws.send_str(json.dumps(
+        {"sdp": {"type": "answer", "sdp": answer}}))
+
+    loop = asyncio.get_running_loop()
+    browser = _Browser()
+    transport, _ = await loop.create_datagram_endpoint(
+        lambda: browser, remote_addr=("127.0.0.1", port))
+    transport.sendto(cli_ice.binding_request())
+    resp = await asyncio.wait_for(browser.queue.get(), 3)
+    assert is_stun(resp)
+
+    cli_dtls.handshake()
+    transport.sendto(cli_dtls.take_outgoing())
+    while not cli_dtls.handshake_complete:
+        d = await asyncio.wait_for(browser.queue.get(), 3)
+        if 20 <= d[0] <= 63:
+            cli_dtls.feed(d)
+            out = cli_dtls.take_outgoing()
+            if out:
+                transport.sendto(out)
+    ck, sk = cli_dtls.export_srtp_keys()
+    cli_srtp = SrtpContext(ck, sk, is_client=True)
+
+    # live capture -> SRTP media; collect one decodable access unit.
+    # The first IDR may have flown before SRTP was up (drop-don't-block),
+    # so do what a real client does: ask for a keyframe via PLI.
+    by_ts = {}
+    decoded = None
+    deadline = loop.time() + 150            # first jit compile dominates
+    last_pli = 0.0
+    media_ssrc = 0
+    while decoded is None and loop.time() < deadline:
+        if loop.time() - last_pli > 2.0:
+            last_pli = loop.time()
+            pli = struct.pack("!BBHII", 0x81, 206, 2, 0xAABBCCDD,
+                              media_ssrc)
+            transport.sendto(cli_srtp.protect_rtcp(pli))
+        try:
+            d = await asyncio.wait_for(browser.queue.get(), 2)
+        except asyncio.TimeoutError:
+            continue
+        if not d or not (128 <= d[0] <= 191):
+            continue
+        if 64 <= (d[1] & 0x7F) <= 95:
+            continue
+        try:
+            pkt = RtpPacket.parse(cli_srtp.unprotect_rtp(d))
+        except SrtpError:
+            continue
+        media_ssrc = pkt.ssrc
+        by_ts.setdefault(pkt.timestamp, []).append(pkt)
+        if pkt.marker:
+            annexb = depacketize_h264(by_ts.pop(pkt.timestamp))
+            kinds = [n[0] & 0x1F for n in split_annexb(annexb)]
+            if 7 in kinds and 5 in kinds:       # a full IDR AU
+                y, u, v = refdec.Decoder().decode(annexb)
+                decoded = y
+    assert decoded is not None, "no decodable IDR arrived from the service"
+    assert decoded.shape == (64, 64)
+
+    transport.close()
+    await ws.close()
