@@ -571,6 +571,10 @@ class Decoder:
         mbx, mby = mb_addr % self.mb_w, mb_addr // self.mb_w
         self.mb_slice[mb_addr] = slice_id
         mb_type = r.ue()
+        if mb_type >= 5:
+            # intra MB inside a P slice (§7.4.5: intra types offset by 5)
+            return self._decode_intra_mb(r, mb_addr, qp, slice_id,
+                                         mb_type - 5)
         if mb_type != 0:
             raise NotImplementedError(f"P mb_type {mb_type}")
         mvdx, mvdy = r.se(), r.se()
@@ -653,10 +657,13 @@ class Decoder:
 
     def _decode_mb(self, r: BitReader, mb_addr: int, qp: int,
                    slice_id: int) -> int:
+        return self._decode_intra_mb(r, mb_addr, qp, slice_id, r.ue())
+
+    def _decode_intra_mb(self, r: BitReader, mb_addr: int, qp: int,
+                         slice_id: int, mb_type: int) -> int:
         mbx, mby = mb_addr % self.mb_w, mb_addr // self.mb_w
         self.mb_slice[mb_addr] = slice_id
         self.mbinter[mb_addr] = False   # intra: refIdx -1 for MV pred
-        mb_type = r.ue()
         if mb_type == 25:
             raise NotImplementedError("I_PCM")
         if not 1 <= mb_type <= 24:

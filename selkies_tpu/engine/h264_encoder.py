@@ -58,6 +58,27 @@ class _Grid:
     out_h: int
 
 
+def h264_buffer_caps(g: "_Grid") -> tuple[int, int, int]:
+    """(e_cap, w_cap, out_cap) for a grid — shared by the single-seat
+    session and the seat-sharded encoder so the sizing policy cannot
+    diverge. out_cap is the one array that crosses the host link every
+    frame, sized for realistic intra frames (~1.5 bits/px); overflow
+    grows it (and forces a clean refresh)."""
+    e_cap = 9 + g.mb_w * max(SLOTS_MB, P_SLOTS_MB) + 2
+    w_cap = max(2048, g.mb_w * 768 // 4)
+    out_cap = max(192 * 1024, g.width * g.height // 6)
+    return e_cap, w_cap, out_cap
+
+
+def h264_stripe_payload(intra: bool, rows: list[bytes],
+                        sps_pps: bytes) -> bytes:
+    """Wire payload for one stripe: IDR access unit (headers + IDR
+    slices) or non-IDR reference P slices."""
+    if intra:
+        return sps_pps + hcodec.assemble_annexb(rows)
+    return b"".join(hcodec.nal(1, rb, ref_idc=2) for rb in rows)
+
+
 def plan_h264_grid(s: CaptureSettings) -> _Grid:
     if s.single_stream:
         # one stream per display, derived from the CURRENT height so the
@@ -72,12 +93,13 @@ def plan_h264_grid(s: CaptureSettings) -> _Grid:
                  mb_w=w // 16, out_w=s.capture_width, out_h=s.capture_height)
 
 
-@functools.cache
-def _jitted_h264_step(mode: str, width: int, stripe_h: int, n_stripes: int,
-                      e_cap: int, w_cap: int, out_cap: int,
-                      paint_delay: int, damage_gating: bool,
-                      paint_over: bool, candidates: tuple = ((0, 0),)):
-    """Compiled per-frame step for ``mode`` in {"i", "p"}.
+def build_h264_step_fn(mode: str, width: int, stripe_h: int, n_stripes: int,
+                       e_cap: int, w_cap: int, out_cap: int,
+                       paint_delay: int, damage_gating: bool,
+                       paint_over: bool, candidates: tuple = ((0, 0),)):
+    """Pure per-frame step for ``mode`` in {"i", "p"} — jitted by
+    :func:`_jitted_h264_step` for the single-seat session, vmapped +
+    shard_mapped by :class:`~selkies_tpu.parallel.MultiSeatH264Encoder`.
 
     Both modes share the damage/paint-over/stream-counter logic and
     maintain the decoder-exact reconstruction planes on device — the P
@@ -151,6 +173,17 @@ def _jitted_h264_step(mode: str, width: int, stripe_h: int, n_stripes: int,
         return (buf.data, buf.byte_lens, send, is_paint, age, sent, fnum,
                 new_ry, new_ru, new_rv, overflow)
 
+    return step
+
+
+@functools.cache
+def _jitted_h264_step(mode: str, width: int, stripe_h: int, n_stripes: int,
+                      e_cap: int, w_cap: int, out_cap: int,
+                      paint_delay: int, damage_gating: bool,
+                      paint_over: bool, candidates: tuple = ((0, 0),)):
+    step = build_h264_step_fn(mode, width, stripe_h, n_stripes, e_cap,
+                              w_cap, out_cap, paint_delay, damage_gating,
+                              paint_over, candidates)
     return jax.jit(step, donate_argnums=(2, 3, 4, 5, 6, 7))
 
 
@@ -163,14 +196,7 @@ class H264EncoderSession:
         self.grid = plan_h264_grid(settings)
         g = self.grid
         self.n_rows = g.n_stripes * g.rows_per_stripe
-        self._e_cap = 9 + g.mb_w * max(SLOTS_MB, P_SLOTS_MB) + 2
-        # _w_cap (32-bit WORDS per row) bounds device-side buffers only;
-        # _out_cap is the BYTE capacity of the whole-frame concat buffer —
-        # the one array that crosses the host link every frame, so it is
-        # sized for realistic intra frames (~1.5 bits/px) rather than the
-        # worst case; overflow grows it (and forces a clean refresh).
-        self._w_cap = max(2048, g.mb_w * 768 // 4)
-        self._out_cap = max(192 * 1024, g.width * g.height // 6)
+        self._e_cap, self._w_cap, self._out_cap = h264_buffer_caps(g)
         self._i_step = self._build_step("i")
         self._p_step = self._build_step("p")
         self.frame_id = 0
@@ -307,11 +333,7 @@ class H264EncoderSession:
             rows = []
             for r in range(i * rps, (i + 1) * rps):
                 rows.append(bytes(data[starts[r]:starts[r] + lens[r]]))
-            if intra:
-                payload = self._sps_pps + hcodec.assemble_annexb(rows)
-            else:
-                payload = b"".join(
-                    hcodec.nal(1, rb, ref_idc=2) for rb in rows)
+            payload = h264_stripe_payload(intra, rows, self._sps_pps)
             chunks.append(EncodedChunk(
                 payload=payload, frame_id=out["frame_id"],
                 stripe_y=i * g.stripe_h, width=g.width, height=g.stripe_h,

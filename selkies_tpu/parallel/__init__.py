@@ -6,8 +6,9 @@ seats over a ``jax.sharding.Mesh`` — one encode dispatch per frame tick
 drives every seat's desktop on its own device, collective-free over ICI.
 """
 
+from .h264_seats import MultiSeatH264Encoder
 from .seats import MultiSeatEncoder, seat_mesh, synthetic_seat_frames
 from .stripes import h264_encode_sharded, stripe_mesh
 
-__all__ = ["MultiSeatEncoder", "seat_mesh", "synthetic_seat_frames",
-           "h264_encode_sharded", "stripe_mesh"]
+__all__ = ["MultiSeatEncoder", "MultiSeatH264Encoder", "seat_mesh",
+           "synthetic_seat_frames", "h264_encode_sharded", "stripe_mesh"]

@@ -20,6 +20,7 @@ import time
 from typing import Callable, Optional
 
 from ..engine.types import CaptureSettings, EncodedChunk
+from .h264_seats import MultiSeatH264Encoder
 from .seats import MultiSeatEncoder, synthetic_seat_frames
 
 logger = logging.getLogger("selkies_tpu.parallel.capture")
@@ -48,7 +49,11 @@ class MultiSeatCapture:
                 self.stop_capture()
             self._callback = callback
             self._settings = settings
-            self._enc = MultiSeatEncoder(settings, self.n_seats)
+            # the flagship codec rides the flagship axis: honor the
+            # configured encoder instead of hard-building jpeg
+            cls = MultiSeatH264Encoder if settings.output_mode == "h264" \
+                else MultiSeatEncoder
+            self._enc = cls(settings, self.n_seats)
             self._running.set()
             self._thread = threading.Thread(
                 target=self._run, name="tpuflux-seats", daemon=True)
@@ -77,7 +82,12 @@ class MultiSeatCapture:
 
     def update_tunables(self, **kw) -> None:
         enc = self._enc
-        if enc and ("jpeg_quality" in kw or "paint_over_quality" in kw):
+        if enc is None:
+            return
+        if isinstance(enc, MultiSeatH264Encoder):
+            if "video_crf" in kw:
+                enc.qp = int(max(8, min(48, kw["video_crf"])))
+        elif "jpeg_quality" in kw or "paint_over_quality" in kw:
             enc.update_quality(kw.get("jpeg_quality",
                                       enc.settings.jpeg_quality),
                                kw.get("paint_over_quality"))
@@ -113,8 +123,11 @@ class MultiSeatCapture:
                 force = self._force_idr.is_set()
                 if force:
                     self._force_idr.clear()
-                per_seat = enc.finalize(enc.encode(frames),
-                                        force_all=force or tick == 0)
+                if isinstance(enc, MultiSeatH264Encoder):
+                    per_seat = enc.finalize(enc.encode(frames, force=force))
+                else:
+                    per_seat = enc.finalize(enc.encode(frames),
+                                            force_all=force or tick == 0)
                 cb = self._callback
                 nbytes = 0
                 for chunks in per_seat:

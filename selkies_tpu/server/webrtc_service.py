@@ -22,6 +22,7 @@ import asyncio
 import json
 import logging
 import socket
+import threading
 from typing import Optional
 
 from aiohttp import web
@@ -79,6 +80,7 @@ class WebRTCService(BaseStreamingService):
         self._sig_queue: asyncio.Queue[str] = asyncio.Queue()
         self._sig_task: Optional[asyncio.Task] = None
         self._capture = None
+        self._cap_stopper: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     # ---------------------------------------------------------------- routes
@@ -163,12 +165,14 @@ class WebRTCService(BaseStreamingService):
             old.peer.close()
         host = getattr(self.settings, "webrtc_media_ip", "") \
             or _default_media_ip()
+        # fullcolor stays False in the offer until the TPU H.264 path
+        # grows a 4:4:4 mode — advertising f4001f over a 4:2:0 stream
+        # would let a profile-strict browser reject the m-line
         peer = RTCPeer(host=host, on_request_keyframe=self._request_idr,
-                       with_audio=False,
-                       fullcolor=bool(self.settings.fullcolor))
+                       with_audio=False, fullcolor=False)
         await peer.listen()
         self._sessions[caller_uid] = _Session(caller_uid, peer, display_id)
-        self._ensure_capture()
+        await self._ensure_capture()
         offer = peer.create_offer()
         await self._local_peer.send("MSG {} {}".format(
             caller_uid,
@@ -200,44 +204,68 @@ class WebRTCService(BaseStreamingService):
             self._stop_capture()
 
     # ----------------------------------------------------------------- media
-    def _ensure_capture(self) -> None:
+    async def _ensure_capture(self) -> None:
         if self._capture is not None:
             return
+        # a previous capture may still be tearing down off-loop: wait for
+        # it so two encode threads never run concurrently (the TPU link
+        # is exclusive)
+        stopper = self._cap_stopper
+        if stopper is not None and stopper.is_alive():
+            await self._loop.run_in_executor(None, stopper.join)
+        cap = None
         try:
             if self._capture_factory is not None:
-                self._capture = self._capture_factory()
+                cap = self._capture_factory()
             else:
                 from ..engine.capture import ScreenCapture
-                self._capture = ScreenCapture()
+                cap = ScreenCapture()
+            from ..engine.types import CaptureSettings
+            s = self.settings
+            cs = CaptureSettings(
+                capture_width=int(getattr(s, "initial_width", 1920)
+                                  or 1920),
+                capture_height=int(getattr(s, "initial_height", 1080)
+                                   or 1080),
+                target_fps=float(s.framerate),
+                output_mode="h264",
+                single_stream=True,    # one RTP track = one H.264 stream
+                video_crf=s.video_crf,
+                video_bitrate_kbps=s.video_bitrate_kbps,
+                keyframe_interval_s=s.keyframe_interval_s,
+                use_damage_gating=True,
+                use_paint_over=s.use_paint_over,
+                h264_motion_vrange=s.h264_motion_vrange,
+                h264_motion_hrange=s.h264_motion_hrange,
+            )
+            cap.start_capture(self._on_chunk, cs)
         except Exception:
             logger.exception("webrtc capture unavailable")
+            if cap is not None:
+                try:
+                    cap.stop_capture()
+                except Exception:
+                    pass
             return
-        from ..engine.types import CaptureSettings
-        s = self.settings
-        cs = CaptureSettings(
-            capture_width=int(getattr(s, "initial_width", 1920) or 1920),
-            capture_height=int(getattr(s, "initial_height", 1080) or 1080),
-            target_fps=float(s.framerate),
-            output_mode="h264",
-            single_stream=True,        # one RTP track = one H.264 stream
-            video_crf=s.video_crf,
-            video_bitrate_kbps=s.video_bitrate_kbps,
-            keyframe_interval_s=s.keyframe_interval_s,
-            use_damage_gating=True,
-            use_paint_over=s.use_paint_over,
-            h264_motion_vrange=s.h264_motion_vrange,
-            h264_motion_hrange=s.h264_motion_hrange,
-        )
-        self._capture.start_capture(self._on_chunk, cs)
+        self._capture = cap
         logger.info("webrtc capture started (single-stream h264)")
 
     def _stop_capture(self) -> None:
-        if self._capture is not None:
+        """Non-blocking: the capture thread join (up to 5 s, longer mid
+        jit-compile) must never stall the event loop."""
+        cap, self._capture = self._capture, None
+        if cap is None:
+            return
+
+        def _stop():
             try:
-                self._capture.stop_capture()
+                cap.stop_capture()
             except Exception:
                 pass
-            self._capture = None
+
+        self._cap_stopper = threading.Thread(
+            target=_stop, name="webrtc-capture-stop", daemon=True)
+        self._cap_stopper.start()
 
     def _on_chunk(self, chunk) -> None:
         """Capture-thread callback -> loop-side fan-out (the only

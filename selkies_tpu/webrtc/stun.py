@@ -138,9 +138,16 @@ class StunMessage:
         return ".".join(str(b) for b in ip), port
 
 
+_ICE_CHARS = ("ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+              "abcdefghijklmnopqrstuvwxyz0123456789")
+
+
 def make_ice_credentials() -> tuple[str, str]:
-    """-> (ufrag, pwd) with RFC 8445 lengths."""
-    return secrets.token_urlsafe(4)[:4], secrets.token_urlsafe(24)[:22]
+    """-> (ufrag, pwd) with RFC 8445 lengths, restricted to the ice-char
+    grammar (ALPHA / DIGIT / '+' / '/'; base64url's '-'/'_' are NOT
+    valid and trip spec-strict parsers)."""
+    return ("".join(secrets.choice(_ICE_CHARS) for _ in range(4)),
+            "".join(secrets.choice(_ICE_CHARS) for _ in range(22)))
 
 
 class IceLiteResponder:
