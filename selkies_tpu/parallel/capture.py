@@ -87,6 +87,8 @@ class MultiSeatCapture:
         if isinstance(enc, MultiSeatH264Encoder):
             if "video_crf" in kw:
                 enc.qp = int(max(8, min(48, kw["video_crf"])))
+                # paint-over must never be WORSE than motion quality
+                enc.paint_qp = min(enc.paint_qp, enc.qp)
         elif "jpeg_quality" in kw or "paint_over_quality" in kw:
             enc.update_quality(kw.get("jpeg_quality",
                                       enc.settings.jpeg_quality),

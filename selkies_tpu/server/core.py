@@ -32,6 +32,7 @@ import os
 import pathlib
 import ssl
 import time
+import urllib.parse
 from typing import Optional
 from urllib.parse import urlparse
 
@@ -278,6 +279,9 @@ class CentralizedStreamServer:
         name = request.headers.get("X-Upload-Name")
         if not name:
             return web.Response(status=400, text="X-Upload-Name required")
+        # the client percent-encodes (headers are Latin-1 only; filenames
+        # are not); plain names pass through unquote unchanged
+        name = urllib.parse.unquote(name)
         try:
             offset = int(request.headers.get("X-Upload-Offset", "0"))
             total = int(request.headers.get("X-Upload-Total", "-1"))

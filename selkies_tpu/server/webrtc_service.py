@@ -117,6 +117,13 @@ class WebRTCService(BaseStreamingService):
             s.peer.close()
         self._sessions.clear()
         self._stop_capture()
+        # stop() IS the cross-service boundary (/api/switch): the next
+        # service may start its own capture the moment we return, so wait
+        # for the encode thread here — off-loop, bounded
+        st = self._cap_stopper
+        if st is not None and st.is_alive():
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: st.join(30))
         if self._local_peer is not None:
             await self._local_peer.detach()
             self._local_peer = None

@@ -415,6 +415,185 @@ class SelkiesClient {
     });
 
     window.addEventListener("message", (e) => this._onDashboardMessage(e));
+    this._bindGamepad();
+    this._bindTouch(cv);
+    this._bindUpload(cv);
+  }
+
+  /* ------------------------------------------------------------- gamepad
+   * navigator.getGamepads() polling -> js,c/d/b/a verbs (the server half
+   * feeds the C interposer sockets; reference lib/gamepad.js:1-229). */
+  _bindGamepad() {
+    this.padState = new Map();          // index -> {buttons:[], axes:[]}
+    window.addEventListener("gamepadconnected", (e) => {
+      const p = e.gamepad;
+      if (p.index > 3) return;
+      this.padState.set(p.index, { buttons: [], axes: [] });
+      this.send(`js,c,${p.index},${p.id.slice(0, 64)}`);
+      if (!this._padTimer) this._padTimer = setInterval(
+        () => this._pollGamepads(), 16);
+    });
+    window.addEventListener("gamepaddisconnected", (e) => {
+      if (!this.padState.delete(e.gamepad.index)) return;
+      this.send(`js,d,${e.gamepad.index}`);
+      if (this.padState.size === 0 && this._padTimer) {
+        clearInterval(this._padTimer);
+        this._padTimer = null;
+      }
+    });
+  }
+
+  _pollGamepads() {
+    const pads = navigator.getGamepads ? navigator.getGamepads() : [];
+    for (const p of pads) {
+      if (!p || !this.padState.has(p.index)) continue;
+      const st = this.padState.get(p.index);
+      p.buttons.forEach((b, i) => {
+        const v = b.pressed ? 1 : 0;
+        if (st.buttons[i] !== v) {
+          st.buttons[i] = v;
+          this.send(`js,b,${p.index},${i},${v}`);
+        }
+      });
+      p.axes.forEach((a, i) => {
+        const v = Math.round(a * 1000) / 1000;
+        if (Math.abs((st.axes[i] ?? 0) - v) > 0.009) {
+          st.axes[i] = v;
+          this.send(`js,a,${p.index},${i},${v}`);
+        }
+      });
+    }
+  }
+
+  /* --------------------------------------------------------------- touch
+   * Touch-to-mouse: one finger = absolute move + left button; two-finger
+   * vertical pan = wheel; two-finger tap = right click (reference
+   * lib/input.js touch mode). */
+  _bindTouch(cv) {
+    const scaleT = (t) => {
+      const r = cv.getBoundingClientRect();
+      const x = Math.round((t.clientX - r.left) * (cv.width / r.width));
+      const y = Math.round((t.clientY - r.top) * (cv.height / r.height));
+      return [Math.max(0, Math.min(cv.width - 1, x)),
+              Math.max(0, Math.min(cv.height - 1, y))];
+    };
+    // tap-vs-gesture disambiguation: the left press is DEFERRED 60 ms
+    // so a second finger (scroll/right-click gesture) can cancel it —
+    // otherwise every two-finger gesture starts with a phantom click
+    let twoFinger = null;               // {y, moved, t0}
+    let pendingPress = null;            // timer id
+    let pressed = false;
+    const commitPress = () => {
+      if (pendingPress !== null) {
+        clearTimeout(pendingPress);
+        pendingPress = null;
+        this.send("mb,1,1");
+        pressed = true;
+      }
+    };
+    cv.addEventListener("touchstart", (e) => {
+      e.preventDefault();
+      if (e.touches.length === 1) {
+        const [x, y] = scaleT(e.touches[0]);
+        this.send(`m,${x},${y}`);
+        pendingPress = setTimeout(commitPress, 60);
+      } else if (e.touches.length === 2) {
+        if (pendingPress !== null) {    // gesture: cancel the tap press
+          clearTimeout(pendingPress);
+          pendingPress = null;
+        } else if (pressed) {
+          this.send("mb,1,0");
+          pressed = false;
+        }
+        twoFinger = { y: e.touches[0].clientY, moved: false,
+                      t0: performance.now() };
+      }
+    }, { passive: false });
+    cv.addEventListener("touchmove", (e) => {
+      e.preventDefault();
+      if (e.touches.length === 1 && !twoFinger) {
+        commitPress();                  // moving finger = drag, press now
+        const [x, y] = scaleT(e.touches[0]);
+        this.send(`m,${x},${y}`);
+      } else if (e.touches.length === 2 && twoFinger) {
+        const dy = e.touches[0].clientY - twoFinger.y;
+        if (Math.abs(dy) > 12) {
+          this.send(`ms,0,${dy > 0 ? -1 : 1}`);
+          twoFinger.y = e.touches[0].clientY;
+          twoFinger.moved = true;
+        }
+      }
+    }, { passive: false });
+    cv.addEventListener("touchend", (e) => {
+      e.preventDefault();
+      if (twoFinger) {
+        if (!twoFinger.moved && performance.now() - twoFinger.t0 < 350) {
+          this.send("mb,3,1");          // two-finger tap = right click
+          this.send("mb,3,0");
+          twoFinger.moved = true;       // fire once, not per lifted finger
+        }
+        if (e.touches.length === 0) twoFinger = null;
+      } else if (e.touches.length === 0) {
+        if (pendingPress !== null) {    // quick tap: full click now
+          commitPress();
+        }
+        if (pressed) {
+          this.send("mb,1,0");
+          pressed = false;
+        }
+      }
+    }, { passive: false });
+  }
+
+  /* -------------------------------------------------------------- upload
+   * Drag-drop -> chunked POST /api/upload with the X-Upload-* resume
+   * protocol the server already speaks (reference lib/file-upload.js). */
+  _bindUpload(cv) {
+    const stop = (e) => { e.preventDefault(); e.stopPropagation(); };
+    ["dragenter", "dragover"].forEach((ev) =>
+      cv.addEventListener(ev, stop));
+    cv.addEventListener("drop", async (e) => {
+      stop(e);
+      const files = [...(e.dataTransfer ? e.dataTransfer.files : [])];
+      for (const f of files) {
+        try {
+          await this.uploadFile(f);
+          this._post({ type: "uploadDone", name: f.name });
+        } catch (err) {
+          this._post({ type: "uploadError", name: f.name,
+                       error: String(err) });
+        }
+      }
+    });
+  }
+
+  async uploadFile(file, chunkBytes = 1 << 20) {
+    for (let off = 0; off < file.size || off === 0; off += chunkBytes) {
+      const chunk = file.slice(off, off + chunkBytes);
+      const r = await fetch("/api/upload", {
+        method: "POST",
+        headers: {
+          // headers are Latin-1 only: percent-encode, server decodes
+          "X-Upload-Name": encodeURIComponent(file.name),
+          "X-Upload-Offset": String(off),
+          "X-Upload-Total": String(file.size),
+        },
+        body: chunk,
+        credentials: "same-origin",
+      });
+      if (!r.ok) throw new Error(`upload ${file.name}: HTTP ${r.status}`);
+      this._post({ type: "uploadProgress", name: file.name,
+                   sent: Math.min(off + chunkBytes, file.size),
+                   total: file.size });
+      if (file.size === 0) break;
+    }
+  }
+
+  _post(msg) {
+    try {
+      (window.parent || window).postMessage(
+        Object.assign({ scope: "selkies" }, msg), "*");
+    } catch (_e) { /* sandboxed parent */ }
   }
 
   _heartbeat() {

@@ -143,10 +143,11 @@ _ICE_CHARS = ("ABCDEFGHIJKLMNOPQRSTUVWXYZ"
 
 
 def make_ice_credentials() -> tuple[str, str]:
-    """-> (ufrag, pwd) with RFC 8445 lengths, restricted to the ice-char
-    grammar (ALPHA / DIGIT / '+' / '/'; base64url's '-'/'_' are NOT
-    valid and trip spec-strict parsers)."""
-    return ("".join(secrets.choice(_ICE_CHARS) for _ in range(4)),
+    """-> (ufrag, pwd) in the ice-char grammar (ALPHA / DIGIT / '+' /
+    '/'; base64url's '-'/'_' are NOT valid and trip spec-strict parsers).
+    8 alphanumeric ufrag chars ≈ 47 bits, comfortably over RFC 8445's
+    24-bit minimum; 22 pwd chars ≈ 131 bits over the required 128."""
+    return ("".join(secrets.choice(_ICE_CHARS) for _ in range(8)),
             "".join(secrets.choice(_ICE_CHARS) for _ in range(22)))
 
 

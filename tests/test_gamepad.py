@@ -99,3 +99,59 @@ def test_slow_client_does_not_block_fanout(tmp_path):
         await srv.stop()
 
     asyncio.run(run())
+
+
+async def test_ws_gamepad_verbs_reach_interposer_socket(tmp_path,
+                                                        client_factory):
+    """End-to-end through the transport: the WS verbs the web client's
+    gamepad poller emits (js,c / js,b / js,a) must surface as js-protocol
+    events on the interposer unix socket — the path a game's LD_PRELOAD
+    shim consumes (VERDICT round-2 item 5's done bar; the server half
+    alone was already covered above)."""
+    import struct
+
+    from aiohttp import WSMsgType
+
+    from tests.test_server import make_app
+
+    server, svc, fake, handler = make_app()
+    handler.gamepad_manager = GamepadManager(handler,
+                                             socket_dir=str(tmp_path))
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    # drain whatever preamble the server sends (MODE/cursor/settings)
+    while True:
+        try:
+            msg = await ws.receive(timeout=1.0)
+        except (asyncio.TimeoutError, TimeoutError):
+            break
+        if msg.type != WSMsgType.TEXT:
+            break
+
+    # exactly what selkies-client.js sends on gamepadconnected + poll
+    await ws.send_str("js,c,0,Probe Pad (Vendor: dead Product: beef)")
+    js_path = tmp_path / "selkies_js0.sock"
+    for _ in range(100):
+        if js_path.exists():
+            break
+        await asyncio.sleep(0.05)
+    assert js_path.exists(), "interposer socket never appeared"
+
+    reader, writer = await asyncio.open_unix_connection(str(js_path))
+    cfg = await asyncio.wait_for(reader.readexactly(1360), 5)
+    name = cfg.split(b"\0", 1)[0].decode()
+    assert "Selkies" in name or "Probe" in name
+
+    await ws.send_str("js,b,0,0,1")          # A pressed
+    ev = await asyncio.wait_for(reader.readexactly(8), 5)
+    _, value, ev_type, number = struct.unpack("<IhBB", ev)
+    assert (value, ev_type) == (1, 0x01)     # JS_EVENT_BUTTON
+
+    await ws.send_str("js,a,0,1,-0.5")       # left stick Y up
+    ev = await asyncio.wait_for(reader.readexactly(8), 5)
+    _, value, ev_type, number = struct.unpack("<IhBB", ev)
+    assert ev_type == 0x02 and value < -10000
+
+    writer.close()
+    await ws.close()
+    await handler.gamepad_manager.stop()

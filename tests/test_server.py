@@ -244,6 +244,21 @@ async def test_upload_and_download(tmp_path, client_factory):
     assert await r.read() == data1 + data2
 
 
+async def test_upload_unicode_filename_percent_encoded(tmp_path,
+                                                       client_factory):
+    """The JS client percent-encodes X-Upload-Name (headers are Latin-1
+    only); the server must decode it back to the real filename."""
+    import urllib.parse
+    server, svc, fake, _ = make_app(file_transfer_dir=str(tmp_path))
+    c = await client_factory(server)
+    name = "r\u00e9sum\u00e9 \u4e2d\u6587.pdf"
+    r = await c.post("/api/upload", data=b"hello", headers={
+        "X-Upload-Name": urllib.parse.quote(name),
+        "X-Upload-Offset": "0", "X-Upload-Total": "5"})
+    assert r.status == 200, await r.text()
+    assert (tmp_path / name).read_bytes() == b"hello"
+
+
 async def test_upload_path_traversal_rejected(tmp_path, client_factory):
     server, *_ = make_app(file_transfer_dir=str(tmp_path))
     c = await client_factory(server)
@@ -513,3 +528,79 @@ async def test_computer_use_api(client_factory):
     r = await c.get("/api/screenshot")
     assert r.status == 503
     await ws.close()
+
+
+def test_client_js_delimiters_balanced():
+    """No JS engine exists in this image, so guard the shipped client
+    against gross syntax damage: with strings/comments/regexes stripped,
+    every bracket must balance and nest correctly."""
+    import pathlib
+
+    raw = (pathlib.Path(__file__).parent.parent / "selkies_tpu" / "web"
+           / "selkies-client.js").read_text()
+
+    # state machine: comments, '…'/"…" strings, template literals with
+    # nested ${ code } (a regex can't do this — `//` inside a template
+    # URL must NOT count as a comment)
+    out = []
+    mode = [["code", 0]]               # stack of [kind, brace_depth]
+    i, n = 0, len(raw)
+    while i < n:
+        kind = mode[-1][0]
+        c = raw[i]
+        nxt = raw[i + 1] if i + 1 < n else ""
+        if kind == "code":
+            if c == "/" and nxt == "/":
+                j = raw.find("\n", i)
+                i = n if j < 0 else j
+                continue
+            if c == "/" and nxt == "*":
+                j = raw.find("*/", i + 2)
+                i = n if j < 0 else j + 2
+                continue
+            if c in "'\"`":
+                mode.append([c, 0])
+                i += 1
+                continue
+            if c == "{":
+                mode[-1][1] += 1
+            elif c == "}":
+                if mode[-1][1] == 0 and len(mode) > 1:
+                    mode.pop()         # end of a template ${ }
+                    i += 1
+                    continue
+                mode[-1][1] -= 1
+            out.append(c)
+            i += 1
+        else:                          # inside a string/template
+            if c == "\\":
+                i += 2
+                continue
+            if c == kind:
+                mode.pop()
+                i += 1
+                continue
+            if kind == "`" and c == "$" and nxt == "{":
+                mode.append(["code", 0])
+                i += 2
+                continue
+            i += 1
+    src = "".join(out)
+
+    pairs = {")": "(", "]": "[", "}": "{"}
+    stack = []
+    for i, ch in enumerate(src):
+        if ch in "([{":
+            stack.append((ch, i))
+        elif ch in pairs:
+            assert stack, f"unmatched {ch!r} at offset {i}"
+            top, _ = stack.pop()
+            assert top == pairs[ch], \
+                f"mismatched {ch!r} at offset {i} (open {top!r})"
+    assert not stack, f"unclosed {stack[-1]!r}"
+    # the new client features must be present
+    for needle in ("js,c,", "js,b,", "js,a,", "getGamepads",
+                   "X-Upload-Name", "touchstart"):
+        assert needle in (pathlib.Path(__file__).parent.parent /
+                          "selkies_tpu" / "web" /
+                          "selkies-client.js").read_text(), needle
