@@ -1,0 +1,70 @@
+"""TURN REST credential service (reference addons/turn-rest/app.py role).
+
+Mints time-limited HMAC credentials for coturn's ``use-auth-secret``
+mode (the same scheme `selkies_tpu.server.turn.hmac_turn_credential`
+consumes): GET /?service=turn&username=alice ->
+{"username": "<expiry>:alice", "password": base64(hmac-sha1(secret,
+username)), "ttl": ..., "uris": [...]}.
+
+Run standalone (``python app.py``) or behind the container in
+docker-compose.yml. aiohttp because the whole image already ships it —
+no Flask dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from aiohttp import web
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from selkies_tpu.server.turn import hmac_turn_credential  # noqa: E402
+
+SECRET = os.environ.get("TURN_SHARED_SECRET", "")
+TURN_HOST = os.environ.get("TURN_HOST", "localhost")
+TURN_PORT = int(os.environ.get("TURN_PORT", "3478"))
+TTL = int(os.environ.get("TURN_TTL_S", "86400"))
+PROTOCOL = os.environ.get("TURN_PROTOCOL", "udp")
+TLS = os.environ.get("TURN_TLS", "false").lower() == "true"
+
+
+def rtc_config(username: str) -> dict:
+    user, cred = hmac_turn_credential(SECRET, username, ttl_s=TTL)
+    scheme = "turns" if TLS else "turn"
+    return {
+        "lifetimeDuration": f"{TTL}s",
+        "iceServers": [
+            {"urls": [f"stun:{TURN_HOST}:{TURN_PORT}"]},
+            {"urls": [f"{scheme}:{TURN_HOST}:{TURN_PORT}"
+                      f"?transport={PROTOCOL}"],
+             "username": user, "credential": cred},
+        ],
+    }
+
+
+async def handle(request: web.Request) -> web.Response:
+    if not SECRET:
+        return web.Response(status=500,
+                            text="TURN_SHARED_SECRET not configured")
+    username = request.query.get("username") \
+        or request.headers.get("x-auth-user") or "selkies"
+    # the reference accepts service=turn only
+    if request.query.get("service", "turn") != "turn":
+        return web.Response(status=400, text="service must be 'turn'")
+    return web.json_response(rtc_config(username))
+
+
+def make_app() -> web.Application:
+    app = web.Application()
+    app.router.add_get("/", handle)
+    app.router.add_get("/api/turn", handle)
+    return app
+
+
+if __name__ == "__main__":
+    port = int(os.environ.get("PORT", "8008"))
+    print(json.dumps({"listening": port, "turn_host": TURN_HOST}))
+    web.run_app(make_app(), port=port)

@@ -64,7 +64,9 @@ async def run(argv=None) -> None:
     server.register_service("websockets", ws)
     try:
         from .server.webrtc_service import WebRTCService
-        server.register_service("webrtc", WebRTCService(settings))
+        server.register_service(
+            "webrtc", WebRTCService(settings, input_handler=input_handler,
+                                    audio_pipeline=audio))
     except ImportError:
         pass  # WebRTC transport is opt-in and may be absent
 

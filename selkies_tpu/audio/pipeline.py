@@ -116,6 +116,9 @@ class AudioPipeline:
         self._mic_proc: Optional[asyncio.subprocess.Process] = None
         self.mic_bytes = 0
         self.frames_encoded = 0
+        #: WebRTC raw tap: fn(opus_packet, rtp_ts48k) per encoded frame
+        self.on_raw_frame = None
+        self._pts48 = 0
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
@@ -195,6 +198,16 @@ class AudioPipeline:
                 continue
             packet = self._enc.encode(pcm)
             self.frames_encoded += 1
+            # raw-frame tap: the WebRTC transport packetizes UNFRAMED Opus
+            # (RFC 7587, 48 kHz RTP clock) — RED is WS-wire framing only
+            hook = self.on_raw_frame
+            if hook is not None:
+                try:
+                    hook(packet, self._pts48)
+                except Exception:
+                    logger.exception("raw audio tap failed")
+            self._pts48 = (self._pts48
+                           + int(self.frame_ms * 48)) & 0xFFFFFFFF
             pts_step = int(self.frame_ms * 90)      # 90 kHz clock
             # RED block lengths are 10-bit (RFC 2198): high-bitrate or
             # long-frame packets that can't fit ship plain — degrading

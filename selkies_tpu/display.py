@@ -175,3 +175,67 @@ class DisplayManager:
                 stderr=asyncio.subprocess.DEVNULL)
             await proc.communicate(
                 f"Xcursor.size: {int(size)}\n".encode())
+
+
+# ---------------------------------------------------------------------------
+# multi-display extended desktop (reference display_utils.py:340-835:
+# compute_dual_layout + replace_selkies_monitors logical monitors)
+# ---------------------------------------------------------------------------
+
+def compute_dual_layout(w1: int, h1: int, w2: int, h2: int,
+                        position: str = "right"
+                        ) -> tuple[int, int, tuple[int, int],
+                                   tuple[int, int]]:
+    """Placement of a secondary display relative to the primary.
+
+    -> (fb_w, fb_h, (x1, y1), (x2, y2)): the union framebuffer and each
+    display's origin. Vertical edges top-align, horizontal edges
+    left-align (the reference's clamped default, display_utils.py:340).
+    """
+    if position == "left":
+        return w1 + w2, max(h1, h2), (w2, 0), (0, 0)
+    if position == "above":
+        return max(w1, w2), h1 + h2, (0, h2), (0, 0)
+    if position == "below":
+        return max(w1, w2), h1 + h2, (0, 0), (0, h1)
+    return w1 + w2, max(h1, h2), (0, 0), (w1, 0)      # right (default)
+
+
+def _monitor_geometry(w: int, h: int, x: int, y: int) -> str:
+    """xrandr --setmonitor geometry: <w>/<mm>x<h>/<mm>+<x>+<y> at 96dpi."""
+    return f"{w}/{w * 254 // 960}x{h}/{h * 254 // 960}+{x}+{y}"
+
+
+class ExtendedDesktop:
+    """Logical-monitor layout on one X screen: the framebuffer grows to
+    the union rect and each display becomes a ``selkies-N`` monitor, so
+    window managers tile against per-display edges while captures read
+    their own sub-rects (the reference's extended-desktop model)."""
+
+    def __init__(self, manager: DisplayManager):
+        self.manager = manager
+        self._monitor_count = 0
+
+    async def apply(self, rects: list[tuple[int, int, int, int]],
+                    refresh: float = 60.0) -> bool:
+        """``rects``: per-display (x, y, w, h). Returns True when the X
+        server accepted the layout (headless -> False, capture-only)."""
+        m = self.manager
+        out = await m.detect_output()
+        if out is None:
+            return False
+        fb_w = max(x + w for x, y, w, h in rects)
+        fb_h = max(y + h for x, y, w, h in rects)
+        ok = await m.resize(fb_w, fb_h, refresh)
+        if not ok:
+            return False
+        # drop stale selkies monitors, then carve the new ones; the FIRST
+        # monitor keeps the real output so the screen stays lit
+        for i in range(self._monitor_count):
+            await m._run("xrandr", "--delmonitor", f"selkies-{i}")
+        for i, (x, y, w, h) in enumerate(rects):
+            await m._run("xrandr", "--setmonitor", f"selkies-{i}",
+                         _monitor_geometry(w, h, x, y),
+                         out if i == 0 else "none")
+        self._monitor_count = len(rects)
+        return True

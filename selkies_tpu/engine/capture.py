@@ -34,6 +34,14 @@ logger = logging.getLogger("selkies_tpu.engine.capture")
 PIPELINE_DEPTH = 3
 
 
+# Process-wide frame-turn lock. JAX's async dispatch queue is effectively
+# exclusive under saturation: one thread that always has work in flight
+# can starve other dispatching threads indefinitely. Every capture loop
+# (single-display, per-display, multi-seat) takes one frame turn at a
+# time; threads alternate fairly because each releases between frames.
+_ENCODE_TURN = threading.Lock()
+
+
 @functools.cache
 def _padder(src_h: int, src_w: int, dst_h: int, dst_w: int):
     def pad(frame):
@@ -84,6 +92,10 @@ class ScreenCapture:
                 self._session = H264EncoderSession(settings)
             else:
                 self._session = JpegEncoderSession(settings)
+            # per-frame CBR state: empty bucket, base = the session's crf
+            self._rc_fullness = 0.0
+            self._rc_qp0 = getattr(self._session, "qp",
+                                   settings.video_crf)
             self._source = make_source(self._source_kind,
                                        settings.capture_width,
                                        settings.capture_height,
@@ -192,25 +204,46 @@ class ScreenCapture:
             self._session.update_quality(self._settings.jpeg_quality,
                                          self._settings.paint_over_quality)
 
+    def _rate_control_frame(self, frame_bytes: float) -> None:
+        """Per-frame CBR for H.264: a leaky-bucket virtual buffer steers
+        qp around a slowly-adapting base (reference's measured-CBR
+        behaviour, settings.py:177-183). qp travels in the slice header,
+        so every frame can carry a different value — no restart, no
+        recompile, no host round-trip."""
+        s, sess = self._settings, self._session
+        if s is None or sess is None or not s.use_cbr \
+                or s.output_mode != "h264":
+            return
+        fps = max(s.target_fps, 1.0)
+        rate_bps8 = s.video_bitrate_kbps * 125.0      # bytes per second
+        self._rc_fullness = max(-rate_bps8, min(
+            rate_bps8, self._rc_fullness + frame_bytes - rate_bps8 / fps))
+        # bucket at +-1 s of rate maps to +-8 qp around the base
+        qp = int(round(self._rc_qp0 + self._rc_fullness / rate_bps8 * 8.0))
+        qp = max(s.video_min_qp, min(s.video_max_qp, qp))
+        if qp != sess.qp:
+            sess.set_qp(qp)
+
     def _rate_control(self, window_bytes: int, window_s: float) -> None:
-        """CBR steering: JPEG nudges quality, H.264 nudges QP directly
-        (qp travels in the slice header, so changes are free — no restart,
-        no recompile, applied on the next frame's device step)."""
+        """1 s window pass: JPEG nudges quality; H.264 re-centres the
+        per-frame controller's BASE qp when the bucket pins at a rail
+        (content that can't hit the target inside the +-8 fast range)."""
         s, sess = self._settings, self._session
         if s is None or sess is None or not s.use_cbr or window_s <= 0:
             return
         actual_kbps = window_bytes * 8 / 1000 / window_s
         if s.output_mode == "h264":
-            qp = sess.qp
-            if actual_kbps > s.video_bitrate_kbps * 1.15 \
-                    and qp < s.video_max_qp:
-                # only ever RAISE qp here — when qp already sits above the
-                # ceiling (user picked a high crf), clamping down would
-                # increase bitrate and amplify the overshoot
-                sess.set_qp(min(qp + 2, s.video_max_qp))
-            elif actual_kbps < s.video_bitrate_kbps * 0.7 \
-                    and qp > s.video_min_qp:
-                sess.set_qp(max(qp - 1, s.video_min_qp))
+            rate_bps8 = s.video_bitrate_kbps * 125.0
+            pinned = abs(self._rc_fullness) >= rate_bps8 * 0.95
+            if pinned and self._rc_fullness > 0 \
+                    and self._rc_qp0 < s.video_max_qp:
+                # adapt faster the further off target the content sits
+                step = 2 if actual_kbps > s.video_bitrate_kbps * 2 else 1
+                self._rc_qp0 = min(self._rc_qp0 + step, s.video_max_qp)
+            elif pinned and self._rc_fullness < 0 \
+                    and actual_kbps < s.video_bitrate_kbps * 0.7 \
+                    and self._rc_qp0 > s.video_min_qp:
+                self._rc_qp0 -= 1
             return
         q = s.jpeg_quality
         if actual_kbps > s.video_bitrate_kbps * 1.15 and q > 10:
@@ -221,6 +254,7 @@ class ScreenCapture:
     def _run(self) -> None:
         assert self._settings and self._session and self._source
         s, sess, src = self._settings, self._session, self._source
+        turn = _ENCODE_TURN
         g = sess.grid
         pad = None
         if (src.height, src.width) != (g.height, g.width):
@@ -248,8 +282,19 @@ class ScreenCapture:
                 if force:
                     last_full = t0
                     self._force_idr.clear()
-                out = sess.encode(frame, force=force)
-                out["force"] = force
+                # the turn lock scopes one frame's dispatch+readback: a
+                # compute-bound capture that keeps the XLA CPU queue full
+                # otherwise starves every OTHER capture thread completely
+                # (reproduced: second display froze at frame 4 while the
+                # first ran at 50 fps); uncontended cost is nanoseconds
+                with turn:
+                    out = sess.encode(frame, force=force)
+                    out["force"] = force
+                    inflight.append(out)
+                    if len(inflight) > PIPELINE_DEPTH:
+                        nb = self._deliver(inflight.popleft())
+                        window_bytes += nb
+                        self._rate_control_frame(nb)
                 # cursor image changes ride the same thread; the callback
                 # hops to the loop like frame chunks do
                 cb = self._cursor_callback
@@ -260,9 +305,6 @@ class ScreenCapture:
                             cb(cur)
                     except Exception:
                         logger.debug("cursor poll failed", exc_info=True)
-                inflight.append(out)
-                if len(inflight) > PIPELINE_DEPTH:
-                    window_bytes += self._deliver(inflight.popleft())
                 self._serve_screenshot()
                 tick += 1
                 fps_frames += 1

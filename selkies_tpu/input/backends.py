@@ -99,16 +99,83 @@ class X11Backend:
         self._dpy = self._x.XOpenDisplay(display.encode())
         if not self._dpy:
             raise RuntimeError(f"cannot open display {display}")
+        self._display_name = display
         self._lock = threading.Lock()
         self._clip: tuple[bytes, str] = (b"", "text/plain")
+        #: layout-translation overlay: keysym -> spare keycode we bound
+        #: (reference input_handler.py:760-932 spare-keycode binding)
+        self._overlay: dict[int, int] = {}
+        self._spares: list[int] = []
+        self._spares_probed = False
 
     def _flush(self):
         self._x.XFlush(ctypes.c_void_p(self._dpy))
+
+    # -- spare-keycode overlay ---------------------------------------------
+    def _probe_spares(self) -> None:
+        """Keycodes with no keysyms bound in the server layout — the pool
+        unmapped client keysyms (other layouts, exotic Unicode) get bound
+        into on demand."""
+        self._spares_probed = True
+        x = self._x
+        lo, hi = ctypes.c_int(0), ctypes.c_int(0)
+        x.XDisplayKeycodes(ctypes.c_void_p(self._dpy),
+                           ctypes.byref(lo), ctypes.byref(hi))
+        count = hi.value - lo.value + 1
+        if count <= 0:
+            return
+        per = ctypes.c_int(0)
+        x.XGetKeyboardMapping.restype = ctypes.POINTER(ctypes.c_ulong)
+        syms = x.XGetKeyboardMapping(ctypes.c_void_p(self._dpy),
+                                     ctypes.c_ubyte(lo.value), count,
+                                     ctypes.byref(per))
+        if not syms:
+            return
+        try:
+            n = per.value
+            for i in range(count):
+                if all(syms[i * n + j] == 0 for j in range(n)):
+                    self._spares.append(lo.value + i)
+        finally:
+            x.XFree(syms)
+
+    def _bind_spare(self, keysym: int) -> int:
+        """Bind ``keysym`` onto a spare keycode (evicting the oldest
+        overlay entry when the pool is dry); 0 when impossible."""
+        if not self._spares_probed:
+            self._probe_spares()
+        code = self._overlay.get(keysym, 0)
+        if code:
+            return code
+        if self._spares:
+            code = self._spares.pop(0)
+        elif self._overlay:
+            evicted_sym, code = next(iter(self._overlay.items()))
+            del self._overlay[evicted_sym]
+        else:
+            return 0
+        arr = (ctypes.c_ulong * 1)(keysym)
+        self._x.XChangeKeyboardMapping(ctypes.c_void_p(self._dpy),
+                                       ctypes.c_ubyte(code), 1, arr, 1)
+        self._x.XSync(ctypes.c_void_p(self._dpy), 0)
+        self._overlay[keysym] = code
+        return code
 
     def key(self, keysym, down):
         with self._lock:
             code = self._x.XKeysymToKeycode(ctypes.c_void_p(self._dpy),
                                             ctypes.c_ulong(keysym))
+            if not code:
+                # layout translation: canonicalise, then try the overlay
+                from .keysyms import normalize
+                alt = normalize(int(keysym))
+                if alt != keysym:
+                    code = self._x.XKeysymToKeycode(
+                        ctypes.c_void_p(self._dpy), ctypes.c_ulong(alt))
+                    keysym = alt if not code else keysym
+                if not code:
+                    code = self._overlay.get(int(keysym), 0) if not down \
+                        else self._bind_spare(int(keysym))
             if code:
                 self._xtst.XTestFakeKeyEvent(ctypes.c_void_p(self._dpy),
                                              code, down, 0)
@@ -144,11 +211,55 @@ class X11Backend:
 
     def set_clipboard(self, data, mime):
         self._clip = (data, mime)
+        mon = self._clip_monitor()
+        if mon is not None and mime.startswith("text"):
+            try:
+                mon.set_clipboard(data.decode("utf-8", "replace"))
+            except Exception:
+                logger.debug("X selection publish failed", exc_info=True)
 
     def get_clipboard(self):
         return self._clip
 
+    def set_change_listener(self, cb) -> None:
+        """``cb(data, mime)`` fires (monitor thread) when a remote X app
+        takes the CLIPBOARD selection with new content."""
+        self._clip_listener = cb
+        self._clip_monitor()        # bring the monitor up eagerly
+
+    def _clip_monitor(self):
+        """Lazily start the selection-owner monitor; None when the X
+        display has no XFixes (headless tests)."""
+        if getattr(self, "_clip_mon_failed", False):
+            return None
+        mon = getattr(self, "_clip_mon", None)
+        if mon is None:
+            try:
+                from .clipboard_x11 import X11ClipboardMonitor
+                mon = X11ClipboardMonitor(
+                    self._display_name, on_clipboard=self._on_x_clipboard)
+                mon.start()
+                self._clip_mon = mon
+            except Exception as e:
+                logger.info("X clipboard monitor unavailable (%s)", e)
+                self._clip_mon_failed = True
+                return None
+        return mon
+
+    def _on_x_clipboard(self, text: str) -> None:
+        data = text.encode()
+        if data == self._clip[0]:
+            return                  # our own write echoing back
+        self._clip = (data, "text/plain")
+        cb = getattr(self, "_clip_listener", None)
+        if cb is not None:
+            cb(data, "text/plain")
+
     def close(self):
+        mon = getattr(self, "_clip_mon", None)
+        if mon is not None:
+            mon.stop()
+            self._clip_mon = None
         if self._dpy:
             self._x.XCloseDisplay(ctypes.c_void_p(self._dpy))
             self._dpy = None

@@ -1,0 +1,237 @@
+"""X11 clipboard selection-owner monitor + provider.
+
+The reference runs a monitor thread on the X CLIPBOARD selection
+(reference src/selkies/input_handler.py:354-721 ``_X11ClipboardMonitor``):
+copy in a remote app -> server notices the new selection owner, reads the
+text, pushes ``clipboard`` messages to web clients; and the reverse —
+client clipboard writes become an owned X selection that remote apps can
+paste from (not just the cut-buffer fallback).
+
+ctypes against libX11 + libXfixes; one dedicated thread owns the display
+connection (Xlib connections are not thread-safe). Degrades to
+unavailable without an X server, like every other X surface here.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import logging
+import threading
+from typing import Callable, Optional
+
+logger = logging.getLogger("selkies_tpu.input.clipboard_x11")
+
+_XFIXES_SET_SELECTION_OWNER_NOTIFY_MASK = 1
+_SELECTION_NOTIFY = 31
+_SELECTION_REQUEST = 30
+_SELECTION_CLEAR = 29
+_PROP_MODE_REPLACE = 0
+_CURRENT_TIME = 0
+
+
+class _XSelectionRequestEvent(ctypes.Structure):
+    _fields_ = [("type", ctypes.c_int), ("serial", ctypes.c_ulong),
+                ("send_event", ctypes.c_int), ("display", ctypes.c_void_p),
+                ("owner", ctypes.c_ulong), ("requestor", ctypes.c_ulong),
+                ("selection", ctypes.c_ulong), ("target", ctypes.c_ulong),
+                ("property", ctypes.c_ulong), ("time", ctypes.c_ulong)]
+
+
+class _XSelectionEvent(ctypes.Structure):
+    _fields_ = [("type", ctypes.c_int), ("serial", ctypes.c_ulong),
+                ("send_event", ctypes.c_int), ("display", ctypes.c_void_p),
+                ("requestor", ctypes.c_ulong), ("selection", ctypes.c_ulong),
+                ("target", ctypes.c_ulong), ("property", ctypes.c_ulong),
+                ("time", ctypes.c_ulong)]
+
+
+class X11ClipboardMonitor:
+    """Watch + serve the CLIPBOARD selection on a dedicated thread.
+
+    ``on_clipboard(text)`` fires when a remote app takes the selection
+    with new text. :meth:`set_clipboard` takes ownership so X apps can
+    paste what a web client copied.
+    """
+
+    def __init__(self, display: str = ":0",
+                 on_clipboard: Optional[Callable[[str], None]] = None,
+                 max_bytes: int = 8 * 1024 * 1024):
+        x11 = ctypes.util.find_library("X11")
+        xfixes = ctypes.util.find_library("Xfixes")
+        if not x11 or not xfixes:
+            raise RuntimeError("libX11/libXfixes not found")
+        self._x = ctypes.CDLL(x11)
+        self._xf = ctypes.CDLL(xfixes)
+        self._x.XOpenDisplay.restype = ctypes.c_void_p
+        self._dpy = self._x.XOpenDisplay(display.encode())
+        if not self._dpy:
+            raise RuntimeError(f"cannot open display {display}")
+        self.on_clipboard = on_clipboard
+        self.max_bytes = max_bytes
+        self._own_text: Optional[bytes] = None
+        self._own_gen = 0           # bumped per set_clipboard request
+        self._lock = threading.Lock()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+        dpy = ctypes.c_void_p(self._dpy)
+        self._x.XInternAtom.restype = ctypes.c_ulong
+
+        def atom(name: str) -> int:
+            return self._x.XInternAtom(dpy, name.encode(), 0)
+
+        self._CLIPBOARD = atom("CLIPBOARD")
+        self._UTF8 = atom("UTF8_STRING")
+        self._TARGETS = atom("TARGETS")
+        self._PROP = atom("SELKIES_CLIP")
+        self._x.XDefaultRootWindow.restype = ctypes.c_ulong
+        root = self._x.XDefaultRootWindow(dpy)
+        self._x.XCreateSimpleWindow.restype = ctypes.c_ulong
+        self._win = self._x.XCreateSimpleWindow(
+            dpy, ctypes.c_ulong(root), 0, 0, 1, 1, 0, 0, 0)
+        ev_base = ctypes.c_int(0)
+        err_base = ctypes.c_int(0)
+        if not self._xf.XFixesQueryExtension(dpy, ctypes.byref(ev_base),
+                                             ctypes.byref(err_base)):
+            raise RuntimeError("XFixes unavailable")
+        self._xfixes_event = ev_base.value      # + XFixesSelectionNotify(0)
+        self._xf.XFixesSelectSelectionInput(
+            dpy, ctypes.c_ulong(self._win),
+            ctypes.c_ulong(self._CLIPBOARD),
+            _XFIXES_SET_SELECTION_OWNER_NOTIFY_MASK)
+        self._x.XFlush(dpy)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="x11-clipboard", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        # the event loop never blocks in XNextEvent without XPending, so
+        # clearing the flag is enough — it exits within one idle tick;
+        # no X call from this (foreign) thread (Xlib is not reentrant)
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=3.0)
+            self._thread = None
+
+    # ------------------------------------------------------------- provider
+    def set_clipboard(self, text: str) -> None:
+        """Own the CLIPBOARD selection with ``text`` (client -> X apps).
+        No X calls here — Xlib is single-threaded per connection; the
+        event thread notices the generation bump within one idle tick
+        and asserts ownership itself."""
+        with self._lock:
+            self._own_text = text.encode()[: self.max_bytes]
+            self._own_gen += 1
+
+    # ----------------------------------------------------------- event loop
+    def _loop(self) -> None:
+        dpy = ctypes.c_void_p(self._dpy)
+        ev = ctypes.create_string_buffer(256)    # > sizeof(XEvent)
+        served_gen = 0
+        while self._running:
+            # wake periodically so stop() and set_clipboard() make progress
+            while not self._x.XPending(dpy):
+                if not self._running:
+                    return
+                with self._lock:
+                    want_gen = self._own_gen
+                if want_gen != served_gen:
+                    # ONE ownership assertion per set_clipboard request —
+                    # re-asserting from current state would steal back any
+                    # newer selection a remote app just took
+                    served_gen = want_gen
+                    self._x.XSetSelectionOwner(
+                        dpy, ctypes.c_ulong(self._CLIPBOARD),
+                        ctypes.c_ulong(self._win), _CURRENT_TIME)
+                    self._x.XFlush(dpy)
+                threading.Event().wait(0.05)
+            self._x.XNextEvent(dpy, ev)
+            etype = ctypes.cast(ev, ctypes.POINTER(ctypes.c_int))[0]
+            try:
+                if etype == self._xfixes_event:      # owner changed
+                    self._on_owner_change(dpy)
+                elif etype == _SELECTION_NOTIFY:
+                    self._on_selection_ready(dpy)
+                elif etype == _SELECTION_REQUEST:
+                    self._serve_request(dpy, ev)
+                elif etype == _SELECTION_CLEAR:
+                    with self._lock:
+                        self._own_text = None
+            except Exception:
+                logger.exception("clipboard event handling failed")
+
+    def _on_owner_change(self, dpy) -> None:
+        self._x.XGetSelectionOwner.restype = ctypes.c_ulong
+        owner = self._x.XGetSelectionOwner(dpy,
+                                           ctypes.c_ulong(self._CLIPBOARD))
+        if owner in (0, self._win):
+            return                              # nobody / ourselves
+        self._x.XConvertSelection(
+            dpy, ctypes.c_ulong(self._CLIPBOARD),
+            ctypes.c_ulong(self._UTF8), ctypes.c_ulong(self._PROP),
+            ctypes.c_ulong(self._win), _CURRENT_TIME)
+        self._x.XFlush(dpy)
+
+    def _on_selection_ready(self, dpy) -> None:
+        x = self._x
+        actual_type = ctypes.c_ulong(0)
+        fmt = ctypes.c_int(0)
+        nitems = ctypes.c_ulong(0)
+        after = ctypes.c_ulong(0)
+        data = ctypes.POINTER(ctypes.c_ubyte)()
+        rc = x.XGetWindowProperty(
+            dpy, ctypes.c_ulong(self._win), ctypes.c_ulong(self._PROP),
+            0, self.max_bytes // 4, 1, ctypes.c_ulong(0),  # AnyPropertyType
+            ctypes.byref(actual_type), ctypes.byref(fmt),
+            ctypes.byref(nitems), ctypes.byref(after), ctypes.byref(data))
+        if rc != 0 or not data or fmt.value != 8:
+            return
+        try:
+            raw = ctypes.string_at(data, nitems.value)
+        finally:
+            x.XFree(data)
+        cb = self.on_clipboard
+        if cb is not None and raw:
+            try:
+                cb(raw.decode("utf-8", "replace"))
+            except Exception:
+                logger.exception("clipboard callback failed")
+
+    def _serve_request(self, dpy, ev) -> None:
+        req = ctypes.cast(ev,
+                          ctypes.POINTER(_XSelectionRequestEvent)).contents
+        with self._lock:
+            text = self._own_text
+        reply = _XSelectionEvent(
+            type=_SELECTION_NOTIFY, serial=0, send_event=1,
+            display=self._dpy, requestor=req.requestor,
+            selection=req.selection, target=req.target,
+            property=req.property or self._PROP, time=req.time)
+        ok = False
+        if text is not None:
+            if req.target == self._TARGETS:
+                atoms = (ctypes.c_ulong * 2)(self._TARGETS, self._UTF8)
+                self._x.XChangeProperty(
+                    dpy, ctypes.c_ulong(req.requestor),
+                    ctypes.c_ulong(reply.property),
+                    ctypes.c_ulong(4),          # XA_ATOM
+                    32, _PROP_MODE_REPLACE,
+                    ctypes.cast(atoms, ctypes.POINTER(ctypes.c_ubyte)), 2)
+                ok = True
+            elif req.target in (self._UTF8, 31):        # UTF8 / XA_STRING
+                self._x.XChangeProperty(
+                    dpy, ctypes.c_ulong(req.requestor),
+                    ctypes.c_ulong(reply.property),
+                    ctypes.c_ulong(req.target), 8, _PROP_MODE_REPLACE,
+                    text, len(text))
+                ok = True
+        if not ok:
+            reply.property = 0                   # refuse politely
+        self._x.XSendEvent(dpy, ctypes.c_ulong(req.requestor), 0, 0,
+                           ctypes.byref(reply))
+        self._x.XFlush(dpy)

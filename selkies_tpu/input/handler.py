@@ -95,6 +95,16 @@ class InputHandler:
         loop = asyncio.get_event_loop()
         self._sweep_task = loop.create_task(self._stale_sweep())
         self._repeat_task = loop.create_task(self._repeat_loop())
+        # X selection-owner monitor (reference _X11ClipboardMonitor,
+        # input_handler.py:354): remote copies push to clients unprompted
+        listener_hook = getattr(self.backend, "set_change_listener", None)
+        if listener_hook is not None:
+            def _changed(data: bytes, mime: str) -> None:
+                # monitor-thread -> loop boundary
+                if self.send_clipboard is not None:
+                    asyncio.run_coroutine_threadsafe(
+                        self.send_clipboard(data, mime), loop)
+            listener_hook(_changed)
 
     async def stop(self) -> None:
         for t in (self._sweep_task, self._repeat_task):

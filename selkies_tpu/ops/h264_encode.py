@@ -932,7 +932,10 @@ def _assemble_p_rows(R, M, qp, qpc, fn, header_pay, header_nb, cbp, coded,
     cbp_pay, cbp_nb = _ue_event(_CBP2CODE[cbp])
     cbp_nb = jnp.where(coded, cbp_nb, 0)
     dqp_pay = jnp.ones((R, M), jnp.uint32)               # se(0) = '1'
-    dqp_nb = jnp.where(coded, 1, 0)
+    # mb_qp_delta exists ONLY when the MB carries residual (§7.3.5: gated
+    # on CodedBlockPattern != 0 for inter) — a pure-motion MB (mv != 0,
+    # cbp == 0, the scroll fast path) must not emit it
+    dqp_nb = jnp.where(coded & (cbp != 0), 1, 0)
 
     # ---- residual events
     scan_y_rm = jnp.moveaxis(scan_y, 1, 2)               # (R,M,by,bx,16)

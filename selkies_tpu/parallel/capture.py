@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..engine.capture import _ENCODE_TURN
 from ..engine.types import CaptureSettings, EncodedChunk
 from .h264_seats import MultiSeatH264Encoder
 from .seats import MultiSeatEncoder, synthetic_seat_frames
@@ -125,11 +126,13 @@ class MultiSeatCapture:
                 force = self._force_idr.is_set()
                 if force:
                     self._force_idr.clear()
-                if isinstance(enc, MultiSeatH264Encoder):
-                    per_seat = enc.finalize(enc.encode(frames, force=force))
-                else:
-                    per_seat = enc.finalize(enc.encode(frames),
-                                            force_all=force or tick == 0)
+                with _ENCODE_TURN:
+                    if isinstance(enc, MultiSeatH264Encoder):
+                        per_seat = enc.finalize(
+                            enc.encode(frames, force=force))
+                    else:
+                        per_seat = enc.finalize(enc.encode(frames),
+                                                force_all=force or tick == 0)
                 cb = self._callback
                 nbytes = 0
                 for chunks in per_seat:

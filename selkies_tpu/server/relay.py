@@ -61,6 +61,11 @@ class VideoRelay:
         self._task = asyncio.create_task(self._run())
 
     # ------------------------------------------------------------- producers
+    def drained(self) -> bool:
+        """True when nothing is queued — the backpressure resume signal
+        (callers must not peek at queue internals)."""
+        return self._q_bytes == 0
+
     def offer(self, item: bytes) -> None:
         """Synchronous enqueue. NEVER awaits (fan-out contract)."""
         if self.dead:

@@ -103,6 +103,13 @@ class WebRTCService(BaseStreamingService):
                 "webrtc mode: media stack unavailable (%s) — signaling + "
                 "TURN serve, sessions will not get media", _MEDIA_ERR)
             return
+        if self.audio is not None \
+                and getattr(self.settings, "enable_audio", False):
+            try:
+                await self.audio.start()
+            except Exception:
+                logger.exception("webrtc audio pipeline failed to start")
+                self.audio = None
         self._local_peer = await self.signaling.attach_server_peer(
             self._sig_queue.put)
         self._sig_task = self._loop.create_task(self._signal_loop())
@@ -127,6 +134,12 @@ class WebRTCService(BaseStreamingService):
         if self._local_peer is not None:
             await self._local_peer.detach()
             self._local_peer = None
+        if self.audio is not None:
+            try:
+                self.audio.on_raw_frame = None
+                await self.audio.stop()
+            except Exception:
+                pass
         for peer in list(self.signaling.peers.values()):
             try:
                 await peer.ws.close()
@@ -175,8 +188,14 @@ class WebRTCService(BaseStreamingService):
         # fullcolor stays False in the offer until the TPU H.264 path
         # grows a 4:4:4 mode — advertising f4001f over a 4:2:0 stream
         # would let a profile-strict browser reject the m-line
+        with_audio = self.audio is not None \
+            and bool(getattr(self.settings, "enable_audio", False))
         peer = RTCPeer(host=host, on_request_keyframe=self._request_idr,
-                       with_audio=False, fullcolor=False)
+                       with_audio=with_audio, fullcolor=False,
+                       on_datachannel_message=self._on_input_verb,
+                       on_bitrate_estimate=self._on_remb)
+        if with_audio and self.audio.on_raw_frame is None:
+            self.audio.on_raw_frame = self._on_audio_frame
         await peer.listen()
         self._sessions[caller_uid] = _Session(caller_uid, peer, display_id)
         await self._ensure_capture()
@@ -241,6 +260,9 @@ class WebRTCService(BaseStreamingService):
                 video_bitrate_kbps=s.video_bitrate_kbps,
                 keyframe_interval_s=s.keyframe_interval_s,
                 use_damage_gating=True,
+                use_cbr=True,      # webrtc is CBR-steered (the reference
+                #                    congestion loop is CBR-only,
+                #                    webrtc_mode.py:1652) — REMB needs it
                 use_paint_over=s.use_paint_over,
                 h264_motion_vrange=s.h264_motion_vrange,
                 h264_motion_hrange=s.h264_motion_hrange,
@@ -295,3 +317,37 @@ class WebRTCService(BaseStreamingService):
                 self._capture.request_idr_frame()
             except Exception:
                 pass
+
+    def _on_remb(self, bps: int) -> None:
+        """Receiver bitrate estimate -> CBR target, user setting as the
+        ceiling (the reference's congestion rule, webrtc_mode.py:
+        1652-1716: estimate steers, never exceeds the configured rate)."""
+        if self._capture is None:
+            return
+        ceiling = int(self.settings.video_bitrate_kbps)
+        # floor first, ceiling LAST: the configured rate is a hard cap
+        kbps = min(ceiling, max(250, bps // 1000))
+        try:
+            self._capture.update_video_bitrate(kbps)
+        except Exception:
+            pass
+
+    def _on_audio_frame(self, opus_packet: bytes, ts48: int) -> None:
+        """Audio pipeline raw tap (loop thread): unframed Opus -> every
+        connected peer's audio track (RFC 7587)."""
+        for sess in self._sessions.values():
+            try:
+                sess.peer.send_audio_frame(opus_packet, ts48)
+            except Exception:
+                pass
+
+    def _on_input_verb(self, label: str, text) -> None:
+        """Data-channel input: same verb grammar as the WS transport
+        (the reference shares one input handler across transports,
+        input_handler.py:1866)."""
+        if self.input_handler is None or not isinstance(text, str):
+            return
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(
+                lambda: self._loop.create_task(
+                    self.input_handler.on_message(text)))

@@ -106,6 +106,9 @@ class WebSocketsService(BaseStreamingService):
         self.clients: dict[int, ClientConnection] = {}
         self.captures: dict[str, ScreenCapture] = {}
         self.display_geometry: dict[str, tuple[int, int]] = {}
+        #: extended-desktop origin of each display inside the X framebuffer
+        self.display_offsets: dict[str, tuple[int, int]] = {}
+        self._ext_desktop = None        # ExtendedDesktop, built lazily
         self._custom_factory = capture_factory is not None
         self._capture_factory = capture_factory or (lambda: ScreenCapture("auto"))
         self.input_handler = input_handler
@@ -325,6 +328,7 @@ class WebSocketsService(BaseStreamingService):
             output_mode="jpeg" if s.encoder.startswith("jpeg") else "h264",
             video_bitrate_kbps=s.video_bitrate_kbps,
             video_crf=s.video_crf,
+            use_cbr=bool(getattr(s, "use_cbr", False)),
             video_min_qp=s.video_min_qp, video_max_qp=s.video_max_qp,
             keyframe_interval_s=s.keyframe_interval_s,
             jpeg_quality=s.jpeg_quality,
@@ -335,10 +339,43 @@ class WebSocketsService(BaseStreamingService):
             stripe_height=s.stripe_height,
             h264_motion_vrange=s.h264_motion_vrange,
             h264_motion_hrange=s.h264_motion_hrange,
+            capture_x=self.display_offsets.get(display_id, (0, 0))[0],
+            capture_y=self.display_offsets.get(display_id, (0, 0))[1],
             display_id=display_id,
             watermark_path=s.watermark_path,
             watermark_location=s.watermark_location,
         )
+
+    def _apply_display_layout(self) -> None:
+        """Extended-desktop layout: primary + display2 origins inside one
+        union framebuffer (reference display_utils.py:340-835 dual-layout
+        math). Headless servers get capture offsets only; a live X server
+        additionally gets the union framebuffer and ``selkies-N`` logical
+        monitors so the WM tiles per display."""
+        prim = self._default_display()
+        self.display_offsets.setdefault(prim, (0, 0))
+        others = sorted(d for d in self.display_geometry if d != prim)
+        if not others or self._seats > 1:
+            self.display_offsets[prim] = (0, 0)
+            return
+        from ..display import ExtendedDesktop, compute_dual_layout
+        s = self.settings
+        w1, h1 = self.display_geometry.get(
+            prim, (s.initial_width, s.initial_height))
+        w2, h2 = self.display_geometry[others[0]]
+        _, _, o1, o2 = compute_dual_layout(
+            w1, h1, w2, h2, getattr(s, "display2_position", "right"))
+        self.display_offsets[prim] = o1
+        self.display_offsets[others[0]] = o2
+        if self.display_manager is not None \
+                and self.display_manager.available():
+            if self._ext_desktop is None:
+                self._ext_desktop = ExtendedDesktop(self.display_manager)
+            rects = [(o1[0], o1[1], w1, h1), (o2[0], o2[1], w2, h2)]
+            task = asyncio.get_running_loop().create_task(
+                self._ext_desktop.apply(rects, float(s.framerate)))
+            task.add_done_callback(
+                lambda t: t.cancelled() or t.exception())
 
     def _ensure_capture(self, display_id: str) -> None:
         if any(c.video_active for c in self.clients.values()):
@@ -524,11 +561,23 @@ class WebSocketsService(BaseStreamingService):
 
         # validate ?display= against KNOWN displays always — an arbitrary
         # string must never become a capture key (it would spawn a whole
-        # extra pipeline per distinct value)
+        # extra pipeline per distinct value). The ONE sanctioned new name
+        # is "display2": the reference's extended second display
+        # (display_utils.py:340-835), registered lazily up to max_displays.
         display = request.query.get("display") or self._default_display()
         known = set(self.display_geometry) or {self._default_display()}
         if display not in known:
-            display = self._default_display()
+            if (display == "display2" and self._seats == 1
+                    and self.settings.max_displays >= 2):
+                s = self.settings
+                self.display_geometry.setdefault(
+                    self._default_display(),
+                    (s.initial_width, s.initial_height))
+                self.display_geometry[display] = (s.initial_width,
+                                                  s.initial_height)
+                self._apply_display_layout()
+            else:
+                display = self._default_display()
         client = ClientConnection(ws, role, raddr, display=display)
         # only the first full client gets input authority unless collab
         if role == "full" and not self.settings.enable_collab:
@@ -687,6 +736,28 @@ class WebSocketsService(BaseStreamingService):
                         None, lambda c=cap, s=new_settings: c.restart(s))
         if "audio_bitrate" in applied and self.audio is not None:
             self.audio.update_bitrate(int(applied["audio_bitrate"]))
+        if "keyboard_layout" in applied:
+            await self._apply_keyboard_layout(str(applied["keyboard_layout"]))
+
+    async def _apply_keyboard_layout(self, layout: str) -> None:
+        """Align the X keymap with the client's detected layout
+        (reference lib/keyboard-layout.js + server XKB alignment) so
+        scancode-reading apps agree with the browser; character input is
+        already layout-independent (keysyms + spare-keycode overlay)."""
+        if not layout.isalnum() or len(layout) > 8:
+            return
+        import shutil as _shutil
+        if not _shutil.which("setxkbmap"):
+            return
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                "setxkbmap", layout,
+                env=dict(os.environ, DISPLAY=self.settings.display_id),
+                stdout=asyncio.subprocess.DEVNULL,
+                stderr=asyncio.subprocess.DEVNULL)
+            await proc.communicate()
+        except OSError:
+            pass
 
     async def _h_ack(self, client: ClientConnection, args: str) -> None:
         try:
@@ -715,7 +786,7 @@ class WebSocketsService(BaseStreamingService):
             # Resume when the client caught up with everything queued — the
             # relay drained (dropped frames never get ACKed, so distance to
             # last_sent_id alone could deadlock the pause).
-            drained = all(r._q_bytes == 0 for r in client.relays.values())
+            drained = all(r.drained() for r in client.relays.values())
             if dist < window // 2 or drained:
                 client.paused = False
                 for cap in self.captures.values():
@@ -792,18 +863,33 @@ class WebSocketsService(BaseStreamingService):
             self.display_geometry[did] = geo
         # resize the REAL X screen first (CVT-RB modeline via xrandr,
         # reference display_utils.py:223-1076); headless setups skip this
-        # and only the capture geometry changes
-        if self.display_manager is not None \
+        # and only the capture geometry changes. With an extended desktop
+        # the union layout drives the framebuffer instead of one display.
+        multi = self._seats == 1 and len(self.display_geometry) > 1
+        if multi:
+            self._apply_display_layout()
+        elif self.display_manager is not None \
                 and self.display_manager.available():
             await self.display_manager.resize(*geo,
                                               float(self.settings.framerate))
-        cap = self.captures.get(did) if self._seats == 1 \
-            else self.captures.get("__seats__")
-        if cap and cap.is_capturing():
+        # retarget EVERY display's capture: a layout pass moves the OTHER
+        # displays' origins too (their sub-rects shift when this one grows)
+        targets = [did] if not multi else list(self.display_geometry)
+        if self._seats > 1:
+            targets = ["__seats__"]
+        loop = asyncio.get_running_loop()
+        for tdid in targets:
+            cap = self.captures.get(tdid)
+            if not (cap and cap.is_capturing()):
+                continue
+            tgeo = geo if tdid in (did, "__seats__") \
+                else self.display_geometry[tdid]
+            ox, oy = self.display_offsets.get(tdid, (0, 0))
             # size change rebuilds the capture session (joins a thread):
             # never on the event loop
-            await asyncio.get_running_loop().run_in_executor(
-                None, lambda: cap.update_capture_region(0, 0, *geo))
+            await loop.run_in_executor(
+                None, lambda c=cap, o=(ox, oy), g=tgeo:
+                c.update_capture_region(o[0], o[1], *g))
         # broadcast realized geometry (bounded sends)
         await self._broadcast_control(self._server_settings_payload())
 

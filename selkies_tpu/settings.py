@@ -114,6 +114,10 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
        vmin=100, vmax=1_000_000, client=True),
     _s("video_crf", SType.INT, 25, "Constant-rate-factor quality (lower=better).",
        vmin=5, vmax=50, client=True),
+    _s("use_cbr", SType.BOOL, False,
+       "CBR rate control on the WS path: per-frame leaky-bucket qp "
+       "steering toward video_bitrate_kbps (webrtc mode is always CBR).",
+       client=True),
     _s("video_min_qp", SType.INT, 10, "QP floor for rate control.", vmin=0, vmax=51),
     _s("video_max_qp", SType.INT, 35,
        "QP ceiling; reference measured +19dB PSNR at 2.5x bitrate with 35 "
@@ -153,6 +157,13 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
     _s("initial_height", SType.INT, 1080, "Initial framebuffer height.", vmin=64, vmax=16384),
     _s("enable_resize", SType.BOOL, True, "Clients may resize the remote display.",
        client=True),
+    _s("keyboard_layout", SType.STR, "us",
+       "XKB layout aligned to the client's detected keyboard "
+       "(client-writable; applied via setxkbmap when X is live).",
+       client=True),
+    _s("display2_position", SType.STR, "right",
+       "Where display2 extends the desktop relative to the primary.",
+       choices=("right", "left", "above", "below"), client=True),
     _s("max_displays", SType.INT, 2, "Maximum concurrent displays per seat.",
        vmin=1, vmax=4),
     _s("dpi", SType.INT, 96, "Initial DPI.", vmin=48, vmax=384, client=True),

@@ -100,6 +100,10 @@ class SelkiesClient {
       this.reconnectDelay = 500;
       this.send("_gz,1");
       this.gz = true;
+      if (this._pendingLayout) {
+        this._pendingLayout();
+        this._pendingLayout = null;
+      }
     };
     ws.onmessage = (ev) => {
       if (typeof ev.data === "string") this._onText(ev.data);
@@ -418,6 +422,84 @@ class SelkiesClient {
     this._bindGamepad();
     this._bindTouch(cv);
     this._bindUpload(cv);
+    this._detectKeyboardLayout();
+  }
+
+  /* ------------------------------------------------------ layout detect
+   * Best-effort layout detection (reference lib/keyboard-layout.js):
+   * probe the physical-key layout map, fall back to the UI language, and
+   * tell the server so it can align the X keymap for scancode-reading
+   * apps (character input is already layout-independent via keysyms). */
+  async _detectKeyboardLayout() {
+    let layout = "";
+    try {
+      if (navigator.keyboard && navigator.keyboard.getLayoutMap) {
+        const map = await navigator.keyboard.getLayoutMap();
+        const probe = [map.get("KeyQ"), map.get("KeyW"), map.get("KeyZ")]
+          .join("");
+        layout = { qwz: "us", azw: "fr", qwy: "de" }[probe] || "";
+      }
+    } catch (_e) { /* permissions / unsupported */ }
+    if (!layout) {
+      const lang = (navigator.language || "en-US").toLowerCase();
+      layout = { fr: "fr", de: "de", es: "es", it: "it", pt: "pt",
+                 ru: "ru", gb: "gb" }[lang.split("-")[0]] || "us";
+    }
+    this._kbLayout = layout;
+    const sendIt = () => this.send(
+      `SETTINGS,${JSON.stringify({ keyboard_layout: layout })}`);
+    if (this.ws && this.ws.readyState === WebSocket.OPEN) sendIt();
+    else this._pendingLayout = sendIt;
+  }
+
+  /* --------------------------------------------------- on-screen keyboard
+   * Minimal OSK for touch devices (reference lib/input.js OSK): a
+   * toggleable overlay whose buttons fire the same kd/ku verbs. */
+  toggleOnScreenKeyboard() {
+    if (this._osk) {
+      this._osk.remove();
+      this._osk = null;
+      return;
+    }
+    const rows = [
+      ["Esc:65307", "1", "2", "3", "4", "5", "6", "7", "8", "9", "0",
+       "⌫:65288"],
+      ["q", "w", "e", "r", "t", "y", "u", "i", "o", "p"],
+      ["a", "s", "d", "f", "g", "h", "j", "k", "l", "⏎:65293"],
+      ["⇧:65505", "z", "x", "c", "v", "b", "n", "m", ",", "."],
+      ["Ctrl:65507", "Alt:65513", "␣:32", "←:65361", "↓:65364",
+       "↑:65362", "→:65363"],
+    ];
+    const osk = document.createElement("div");
+    osk.style.cssText =
+      "position:fixed;bottom:0;left:0;right:0;background:#222d;" +
+      "padding:6px;z-index:1000;display:flex;flex-direction:column;" +
+      "gap:4px;touch-action:none";
+    for (const row of rows) {
+      const line = document.createElement("div");
+      line.style.cssText = "display:flex;gap:4px;justify-content:center";
+      for (const keydef of row) {
+        const [label, ksStr] = keydef.includes(":")
+          ? keydef.split(":") : [keydef, null];
+        const ks = ksStr ? parseInt(ksStr, 10)
+          : label.codePointAt(0);
+        const b = document.createElement("button");
+        b.textContent = label;
+        b.style.cssText =
+          "flex:1;max-width:72px;padding:10px 4px;font-size:16px;" +
+          "background:#444;color:#eee;border:1px solid #666;" +
+          "border-radius:4px";
+        const down = (e) => { e.preventDefault(); this.send(`kd,${ks}`); };
+        const up = (e) => { e.preventDefault(); this.send(`ku,${ks}`); };
+        b.addEventListener("pointerdown", down);
+        b.addEventListener("pointerup", up);
+        b.addEventListener("pointerleave", up);
+        line.appendChild(b);
+      }
+      osk.appendChild(line);
+    }
+    document.body.appendChild(osk);
+    this._osk = osk;
   }
 
   /* ------------------------------------------------------------- gamepad
@@ -650,6 +732,7 @@ class SelkiesClient {
         break;
       case "videoBitrate": this.send(`vb,${d.kbps | 0}`); break;
       case "audioBitrate": this.send(`ab,${d.bps | 0}`); break;
+      case "toggleOsk": this.toggleOnScreenKeyboard(); break;
       default: break;
     }
   }

@@ -183,10 +183,10 @@ class DtlsEndpoint:
         self._complete = False
 
     # -- datagram pump ------------------------------------------------------
-    def feed(self, datagram: bytes) -> bytes:
+    def feed(self, datagram: bytes) -> list[bytes]:
         """Process one inbound datagram; returns decrypted application
-        bytes (rare on the media path — everything hot is SRTP, which
-        bypasses DTLS records)."""
+        RECORDS (one list entry per DTLS record — the SCTP layer needs
+        packet framing preserved, never concatenated)."""
         _BIO_write(self._rbio, datagram, len(datagram))
         return self._pump()
 
@@ -194,8 +194,17 @@ class DtlsEndpoint:
         """Kick the handshake state machine (client: emits ClientHello)."""
         self._pump()
 
-    def _pump(self) -> bytes:
-        app = b""
+    def send_app(self, data: bytes) -> None:
+        """Queue one application record (an SCTP packet); drain the wire
+        bytes with :meth:`take_outgoing`."""
+        if not self._complete:
+            raise DtlsError("handshake not complete")
+        rc = _SSL_write(self._ssl, data, len(data))
+        if rc <= 0:
+            raise DtlsError(f"SSL_write failed ({rc})")
+
+    def _pump(self) -> list[bytes]:
+        app: list[bytes] = []
         if not self._complete:
             rc = _SSL_do_handshake(self._ssl)
             if rc == 1:
@@ -205,12 +214,12 @@ class DtlsEndpoint:
                 if err != SSL_ERROR_WANT_READ:
                     raise DtlsError(f"handshake failed (ssl error {err})")
         if self._complete:
-            buf = ctypes.create_string_buffer(4096)
+            buf = ctypes.create_string_buffer(8192)
             while True:
                 n = _SSL_read(self._ssl, buf, len(buf))
                 if n <= 0:
                     break
-                app += buf.raw[:n]
+                app.append(buf.raw[:n])
         return app
 
     def take_outgoing(self) -> bytes:

@@ -14,7 +14,8 @@ import time
 from typing import Callable, Optional
 
 from .dtls import DtlsEndpoint, generate_certificate
-from .rtp import (H264Packetizer, OpusPacketizer, parse_rtcp_pli)
+from .rtp import (H264Packetizer, OpusPacketizer, parse_rtcp_pli,
+                  parse_rtcp_remb)
 from .sdp import RemoteDescription, build_offer, parse_answer
 from .srtp import SrtpContext, SrtpError
 from .stun import IceLiteResponder, is_stun, make_ice_credentials
@@ -33,7 +34,9 @@ class RTCPeer(asyncio.DatagramProtocol):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  on_request_keyframe: Optional[Callable] = None,
-                 with_audio: bool = True, fullcolor: bool = False):
+                 with_audio: bool = True, fullcolor: bool = False,
+                 on_datachannel_message: Optional[Callable] = None,
+                 on_bitrate_estimate: Optional[Callable] = None):
         self.host = host
         self.port = port
         self.ufrag, self.pwd = make_ice_credentials()
@@ -44,6 +47,9 @@ class RTCPeer(asyncio.DatagramProtocol):
         self.audio = OpusPacketizer()
         self.remote: RemoteDescription | None = None
         self.on_request_keyframe = on_request_keyframe
+        self.on_datachannel_message = on_datachannel_message
+        self.on_bitrate_estimate = on_bitrate_estimate
+        self.sctp = None                 # SctpAssociation after DTLS
         self.with_audio = with_audio
         self.fullcolor = fullcolor
         self._transport: asyncio.DatagramTransport | None = None
@@ -83,10 +89,15 @@ class RTCPeer(asyncio.DatagramProtocol):
                 self._peer_addr = self.ice.nominated_addr
         elif 20 <= b <= 63:                       # DTLS
             self._peer_addr = addr
-            self.dtls.feed(data)
+            records = self.dtls.feed(data)
             self._flush_dtls(addr)
             if self.dtls.handshake_complete and self.srtp is None:
                 self._on_dtls_complete()
+            if self.sctp is not None:
+                for rec in records:               # app data = SCTP packets
+                    self.sctp.receive(rec)
+                self.sctp.poll_timers()
+                self._flush_dtls(addr)
         elif 128 <= b <= 191 and self.srtp is not None:
             self._on_srtp(data)
 
@@ -106,9 +117,42 @@ class RTCPeer(asyncio.DatagramProtocol):
         # we are the DTLS server
         self.srtp = SrtpContext(client_master, server_master,
                                 is_client=False)
+        from .sctp import SctpAssociation
+        self.sctp = SctpAssociation(
+            self._send_sctp, server=True,
+            on_message=self._on_channel_message)
         self.connected.set()
         logger.info("webrtc peer connected (srtp up, addr=%s)",
                     self._peer_addr)
+
+    def _send_sctp(self, packet: bytes) -> None:
+        try:
+            self.dtls.send_app(packet)
+        except Exception:
+            return
+        out = self.dtls.take_outgoing()
+        if out and self._transport and self._peer_addr:
+            self._transport.sendto(out, self._peer_addr)
+
+    def _on_channel_message(self, channel, data: bytes, ppid: int) -> None:
+        if self.on_datachannel_message is not None:
+            text = data.decode("utf-8", "replace") if ppid != 53 else data
+            try:
+                self.on_datachannel_message(channel.label, text)
+            except Exception:
+                logger.exception("datachannel handler failed")
+
+    def send_channel_message(self, text: str, sid: int | None = None
+                             ) -> bool:
+        """Server -> browser control message on the first open channel."""
+        if self.sctp is None or self.sctp.state != "ESTABLISHED":
+            return False
+        if sid is None:
+            if not self.sctp.channels:
+                return False
+            sid = next(iter(self.sctp.channels))
+        self.sctp.send(sid, text.encode())
+        return True
 
     def _on_srtp(self, data: bytes) -> None:
         pt = data[1] & 0x7F
@@ -119,6 +163,9 @@ class RTCPeer(asyncio.DatagramProtocol):
                 return
             if parse_rtcp_pli(rtcp) and self.on_request_keyframe:
                 self.on_request_keyframe()
+            remb = parse_rtcp_remb(rtcp)
+            if remb is not None and self.on_bitrate_estimate:
+                self.on_bitrate_estimate(remb)
         # inbound RTP (browser mic) is handled by the service if wired
 
     # -- signaling ----------------------------------------------------------

@@ -173,6 +173,26 @@ def depacketize_h264(packets: list[RtpPacket]) -> bytes:
     return bytes(out)
 
 
+def parse_rtcp_remb(data: bytes) -> int | None:
+    """Receiver Estimated Max Bitrate (draft-alvestrand-rmcat-remb,
+    PSFB FMT 15): -> bits/s, or None. The receiver-side half of the
+    congestion loop (reference webrtc_mode.py:1652-1716 steers CBR off
+    the send-side TWCC estimate; REMB is the receiver-computed analog
+    Chrome still emits when offered goog-remb)."""
+    off = 0
+    while off + 8 <= len(data):
+        b0, pt, length = struct.unpack_from("!BBH", data, off)
+        size = 4 * (length + 1)
+        if pt == 206 and (b0 & 0x1F) == 15 and off + 20 <= len(data) \
+                and data[off + 12:off + 16] == b"REMB":
+            word = struct.unpack_from("!I", data, off + 16)[0]
+            exp = (word >> 18) & 0x3F
+            mantissa = word & 0x3FFFF
+            return mantissa << exp
+        off += max(size, 4)
+    return None
+
+
 def parse_rtcp_pli(data: bytes) -> list[int]:
     """-> media SSRCs for which the receiver asked a keyframe (PSFB/PLI,
     RFC 4585 §6.3.1); also treats FIR (RFC 5104) as a PLI."""

@@ -1,0 +1,353 @@
+"""SCTP over DTLS for WebRTC data channels (RFC 9260 subset + RFC
+8831/8832 DCEP).
+
+The reference vendors aiortc's 2.1k-line ``rtcsctptransport``; the
+product needs far less: ONE association carrying a handful of ordered
+data channels whose hot direction is browser -> server input verbs.
+Implemented: INIT/INIT-ACK with state cookie, COOKIE-ECHO/ACK, DATA
+with fragment reassembly, SACK with gap reports, DCEP open/ack,
+HEARTBEAT, ABORT/SHUTDOWN-on-close, go-back-N retransmission with a T3
+timer for the (low-rate) server -> browser direction, CRC32c framing.
+Not implemented (and not needed for input/control): multi-homing,
+unordered/partial-reliability, stream reconfig, cookie-jar hardening
+beyond HMAC.
+"""
+
+from __future__ import annotations
+
+import hmac
+import logging
+import os
+import struct
+import time
+from hashlib import sha256
+from typing import Callable, Optional
+
+logger = logging.getLogger("selkies_tpu.webrtc.sctp")
+
+# chunk types (RFC 9260 §3.2)
+DATA = 0
+INIT = 1
+INIT_ACK = 2
+SACK = 3
+HEARTBEAT = 4
+HEARTBEAT_ACK = 5
+ABORT = 6
+SHUTDOWN = 7
+ERROR = 9
+COOKIE_ECHO = 10
+COOKIE_ACK = 11
+
+PPID_DCEP = 50
+PPID_STRING = 51
+PPID_BINARY = 53
+
+DCEP_OPEN = 0x03
+DCEP_ACK = 0x02
+
+_CRC_TBL = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ (0x82F63B78 if _c & 1 else 0)
+    _CRC_TBL.append(_c)
+
+
+def crc32c(data: bytes) -> int:
+    c = 0xFFFFFFFF
+    for b in data:
+        c = (c >> 8) ^ _CRC_TBL[(c ^ b) & 0xFF]
+    return c ^ 0xFFFFFFFF
+
+
+def _tsn_gt(a: int, b: int) -> bool:
+    return ((a - b) & 0xFFFFFFFF) < 0x80000000 and a != b
+
+
+class Channel:
+    def __init__(self, stream_id: int, label: str, protocol: str = ""):
+        self.stream_id = stream_id
+        self.label = label
+        self.protocol = protocol
+        self.open = True
+
+
+class SctpAssociation:
+    """One association over a datagram transport (DTLS app data).
+
+    ``send_datagram(bytes)`` ships an SCTP packet; :meth:`receive` takes
+    inbound packets. ``on_message(channel, data, ppid)`` fires per
+    reassembled user message; ``on_channel(channel)`` on DCEP open."""
+
+    SECRET = os.urandom(32)
+
+    def __init__(self, send_datagram: Callable[[bytes], None],
+                 server: bool = True, port: int = 5000,
+                 on_message=None, on_channel=None,
+                 now: Callable[[], float] = time.monotonic):
+        self.send_datagram = send_datagram
+        self.server = server
+        self.local_port = port
+        self.remote_port = port
+        self.on_message = on_message
+        self.on_channel = on_channel
+        self.now = now
+        self.state = "CLOSED"
+        self.local_tag = struct.unpack("!I", os.urandom(4))[0] or 1
+        self.remote_tag = 0
+        self.next_tsn = struct.unpack("!I", os.urandom(4))[0]
+        self.cum_ack: Optional[int] = None       # highest in-order TSN seen
+        self.received: dict[int, tuple] = {}     # out-of-order buffer
+        self.reasm: dict[int, list] = {}         # stream -> fragments
+        self.next_ssn: dict[int, int] = {}
+        self.channels: dict[int, Channel] = {}
+        self.a_rwnd = 1 << 20
+        self._outstanding: dict[int, bytes] = {}  # tsn -> full chunk bytes
+        self._t3_deadline: Optional[float] = None
+        self._rto = 1.0
+
+    # ------------------------------------------------------------- packets
+    def _packet(self, chunks: bytes, tag: Optional[int] = None) -> bytes:
+        hdr = struct.pack("!HHII", self.local_port, self.remote_port,
+                          self.remote_tag if tag is None else tag, 0)
+        pkt = hdr + chunks
+        return pkt[:8] + struct.pack("<I", crc32c(pkt)) + pkt[12:]
+
+    def _send_chunk(self, ctype: int, flags: int, value: bytes,
+                    tag: Optional[int] = None) -> None:
+        chunk = struct.pack("!BBH", ctype, flags, 4 + len(value)) + value
+        chunk += b"\x00" * (-len(chunk) % 4)
+        self.send_datagram(self._packet(chunk, tag))
+
+    # ------------------------------------------------------------ lifecycle
+    def connect(self) -> None:
+        """Client role (tests / loopback): initiate."""
+        self.state = "COOKIE_WAIT"
+        v = struct.pack("!IIHHI", self.local_tag, self.a_rwnd, 4, 4,
+                        self.next_tsn)
+        self._send_chunk(INIT, 0, v, tag=0)
+
+    def close(self) -> None:
+        if self.state == "ESTABLISHED":
+            try:
+                self._send_chunk(SHUTDOWN, 0,
+                                 struct.pack("!I", self.cum_ack or 0))
+            except Exception:
+                pass
+        self.state = "CLOSED"
+
+    # -------------------------------------------------------------- receive
+    def receive(self, packet: bytes) -> None:
+        if len(packet) < 12:
+            return
+        src, dst, tag, _crc = struct.unpack_from("!HHII", packet, 0)
+        body = packet[:8] + b"\x00\x00\x00\x00" + packet[12:]
+        if struct.unpack_from("<I", packet, 8)[0] != crc32c(body):
+            logger.debug("sctp: bad crc32c; dropped")
+            return
+        off = 12
+        sacked = False
+        while off + 4 <= len(packet):
+            ctype, flags, length = struct.unpack_from("!BBH", packet, off)
+            if length < 4:
+                break
+            value = packet[off + 4: off + length]
+            off += length + (-length % 4)
+            sacked |= self._on_chunk(ctype, flags, value)
+        if sacked:
+            self._send_sack()
+
+    def _on_chunk(self, ctype: int, flags: int, value: bytes) -> bool:
+        if ctype == INIT and self.server:
+            (itag, rwnd, nos, nis, itsn) = struct.unpack_from("!IIHHI",
+                                                              value, 0)
+            self.remote_tag = itag
+            self.cum_ack = (itsn - 1) & 0xFFFFFFFF
+            cookie = self._make_cookie(itag, itsn)
+            v = struct.pack("!IIHHI", self.local_tag, self.a_rwnd, 16, 16,
+                            self.next_tsn)
+            v += struct.pack("!HH", 7, 4 + len(cookie)) + cookie
+            self._send_chunk(INIT_ACK, 0, v, tag=itag)
+        elif ctype == INIT_ACK and not self.server:
+            (itag, rwnd, nos, nis, itsn) = struct.unpack_from("!IIHHI",
+                                                              value, 0)
+            self.remote_tag = itag
+            self.cum_ack = (itsn - 1) & 0xFFFFFFFF
+            poff = 16
+            while poff + 4 <= len(value):
+                pt, plen = struct.unpack_from("!HH", value, poff)
+                if pt == 7:
+                    cookie = value[poff + 4: poff + plen]
+                    self._send_chunk(COOKIE_ECHO, 0, cookie)
+                    self.state = "COOKIE_ECHOED"
+                    break
+                poff += plen + (-plen % 4)
+        elif ctype == COOKIE_ECHO and self.server:
+            if self._check_cookie(value):
+                self.state = "ESTABLISHED"
+                self._send_chunk(COOKIE_ACK, 0, b"")
+        elif ctype == COOKIE_ACK and not self.server:
+            self.state = "ESTABLISHED"
+        elif ctype == DATA:
+            return self._on_data(flags, value)
+        elif ctype == SACK:
+            self._on_sack(value)
+        elif ctype == HEARTBEAT:
+            self._send_chunk(HEARTBEAT_ACK, 0, value)
+        elif ctype in (ABORT, SHUTDOWN):
+            self.state = "CLOSED"
+        return False
+
+    # --------------------------------------------------------------- cookie
+    def _make_cookie(self, peer_tag: int, peer_tsn: int) -> bytes:
+        body = struct.pack("!IIII", peer_tag, peer_tsn, self.local_tag,
+                           int(self.now()))
+        return body + hmac.new(self.SECRET, body, sha256).digest()[:16]
+
+    def _check_cookie(self, cookie: bytes) -> bool:
+        if len(cookie) < 32:
+            return False
+        body, mac = cookie[:-16], cookie[-16:]
+        want = hmac.new(self.SECRET, body, sha256).digest()[:16]
+        if not hmac.compare_digest(want, mac):
+            return False
+        peer_tag, peer_tsn, _, _ = struct.unpack_from("!IIII", body, 0)
+        self.remote_tag = peer_tag
+        self.cum_ack = (peer_tsn - 1) & 0xFFFFFFFF
+        return True
+
+    # ----------------------------------------------------------------- data
+    def _on_data(self, flags: int, value: bytes) -> bool:
+        tsn, sid, ssn, ppid = struct.unpack_from("!IHHI", value, 0)
+        payload = value[12:]
+        if self.cum_ack is not None and not _tsn_gt(tsn, self.cum_ack):
+            return True                     # duplicate
+        self.received[tsn] = (flags, sid, ssn, ppid, payload)
+        # advance the cumulative ack through contiguous TSNs
+        while self.cum_ack is not None and \
+                ((self.cum_ack + 1) & 0xFFFFFFFF) in self.received:
+            nxt = (self.cum_ack + 1) & 0xFFFFFFFF
+            self._deliver(*self.received.pop(nxt))
+            self.cum_ack = nxt
+        return True
+
+    def _deliver(self, flags: int, sid: int, ssn: int, ppid: int,
+                 payload: bytes) -> None:
+        begin, end = flags & 0x02, flags & 0x01
+        frags = self.reasm.setdefault(sid, [])
+        if begin:
+            frags.clear()
+        frags.append(payload)
+        if not end:
+            return
+        data = b"".join(frags)
+        frags.clear()
+        if ppid == PPID_DCEP:
+            self._on_dcep(sid, data)
+        else:
+            ch = self.channels.get(sid)
+            if ch is not None and self.on_message is not None:
+                try:
+                    self.on_message(ch, data, ppid)
+                except Exception:
+                    logger.exception("sctp message handler failed")
+
+    def _on_dcep(self, sid: int, data: bytes) -> None:
+        if not data:
+            return
+        if data[0] == DCEP_OPEN and len(data) >= 12:
+            (_t, _cht, _prio, _rel, llen, plen) = struct.unpack_from(
+                "!BBHIHH", data, 0)
+            label = data[12:12 + llen].decode("utf-8", "replace")
+            proto = data[12 + llen:12 + llen + plen].decode(
+                "utf-8", "replace")
+            ch = Channel(sid, label, proto)
+            self.channels[sid] = ch
+            self._send_data(sid, bytes((DCEP_ACK,)), PPID_DCEP)
+            if self.on_channel is not None:
+                try:
+                    self.on_channel(ch)
+                except Exception:
+                    logger.exception("sctp channel handler failed")
+        elif data[0] == DCEP_ACK:
+            pass                            # our open confirmed
+
+    # ----------------------------------------------------------------- send
+    def open_channel(self, sid: int, label: str) -> Channel:
+        """Negotiate a channel from our side (DCEP OPEN)."""
+        lb = label.encode()
+        msg = struct.pack("!BBHIHH", DCEP_OPEN, 0x00, 0, 0, len(lb), 0) + lb
+        self._send_data(sid, msg, PPID_DCEP)
+        ch = Channel(sid, label)
+        self.channels[sid] = ch
+        return ch
+
+    def send(self, sid: int, data: bytes, ppid: int = PPID_STRING) -> None:
+        if self.state != "ESTABLISHED":
+            raise RuntimeError("association not established")
+        self._send_data(sid, data, ppid)
+
+    def _send_data(self, sid: int, data: bytes, ppid: int,
+                   mtu: int = 1100) -> None:
+        ssn = self.next_ssn.get(sid, 0)
+        self.next_ssn[sid] = (ssn + 1) & 0xFFFF
+        chunks = [data[i:i + mtu] for i in range(0, len(data), mtu)] or [b""]
+        for i, frag in enumerate(chunks):
+            flags = (0x02 if i == 0 else 0) | \
+                    (0x01 if i == len(chunks) - 1 else 0)
+            tsn = self.next_tsn
+            self.next_tsn = (self.next_tsn + 1) & 0xFFFFFFFF
+            v = struct.pack("!IHHI", tsn, sid, ssn, ppid) + frag
+            chunk = struct.pack("!BBH", DATA, flags, 4 + len(v)) + v
+            chunk += b"\x00" * (-len(chunk) % 4)
+            self._outstanding[tsn] = chunk
+            self.send_datagram(self._packet(chunk))
+        if self._t3_deadline is None:
+            self._t3_deadline = self.now() + self._rto
+
+    def _send_sack(self) -> None:
+        if self.cum_ack is None:
+            return
+        # gap ack blocks for whatever is parked out of order
+        gaps = []
+        if self.received:
+            offs = sorted(((t - self.cum_ack) & 0xFFFFFFFF)
+                          for t in self.received)
+            start = prev = offs[0]
+            for o in offs[1:]:
+                if o != prev + 1:
+                    gaps.append((start, prev))
+                    start = o
+                prev = o
+            gaps.append((start, prev))
+        v = struct.pack("!IIHH", self.cum_ack, self.a_rwnd, len(gaps), 0)
+        for s, e in gaps[:100]:
+            v += struct.pack("!HH", s, e)
+        self._send_chunk(SACK, 0, v)
+
+    def _on_sack(self, value: bytes) -> None:
+        cum, _rwnd, ngaps, _ndups = struct.unpack_from("!IIHH", value, 0)
+        for tsn in [t for t in self._outstanding
+                    if not _tsn_gt(t, cum)]:
+            del self._outstanding[tsn]
+        acked = set()
+        for i in range(ngaps):
+            s, e = struct.unpack_from("!HH", value, 12 + 4 * i)
+            for off in range(s, e + 1):
+                acked.add((cum + off) & 0xFFFFFFFF)
+        for tsn in list(self._outstanding):
+            if tsn in acked:
+                del self._outstanding[tsn]
+        self._t3_deadline = None if not self._outstanding \
+            else self.now() + self._rto
+
+    def poll_timers(self) -> None:
+        """Call periodically (peer's heartbeat loop): go-back-N
+        retransmit of anything still outstanding past the T3 deadline."""
+        if self._t3_deadline is not None and self.now() >= self._t3_deadline:
+            for tsn in sorted(self._outstanding,
+                              key=lambda t: (t - (self.cum_ack or 0))
+                              & 0xFFFFFFFF):
+                self.send_datagram(self._packet(self._outstanding[tsn]))
+            self._rto = min(self._rto * 2, 8.0)
+            self._t3_deadline = self.now() + self._rto

@@ -24,11 +24,13 @@ class RemoteDescription:
 def build_offer(host: str, port: int, ufrag: str, pwd: str,
                 fingerprint: str, video_pt: int = 102,
                 audio_pt: int = 111, with_audio: bool = True,
-                fullcolor: bool = False) -> str:
-    """One-shot SDP offer: sendonly video (+audio), ICE-lite, DTLS
-    actpass, all media bundled on one port."""
+                fullcolor: bool = False, with_data: bool = True) -> str:
+    """One-shot SDP offer: sendonly video (+audio) + a data channel
+    m-line for input, ICE-lite, DTLS actpass, all bundled on one port."""
     sid = secrets.randbits(62)
     mids = ["0"] + (["1"] if with_audio else [])
+    if with_data:
+        mids.append(str(len(mids)))
     lines = [
         "v=0",
         f"o=- {sid} 2 IN IP4 127.0.0.1",
@@ -74,6 +76,20 @@ def build_offer(host: str, port: int, ufrag: str, pwd: str,
         lines.append(
             f"a=candidate:1 1 udp 2130706431 {host} {port} typ host")
         lines.append("a=end-of-candidates")
+    if with_data:
+        lines += [
+            f"m=application {port} UDP/DTLS/SCTP webrtc-datachannel",
+            f"c=IN IP4 {host}",
+            f"a=mid:{mids[-1]}",
+            f"a=ice-ufrag:{ufrag}",
+            f"a=ice-pwd:{pwd}",
+            f"a=fingerprint:sha-256 {fingerprint}",
+            "a=setup:actpass",
+            "a=sctp-port:5000",
+            "a=max-message-size:262144",
+            f"a=candidate:1 1 udp 2130706431 {host} {port} typ host",
+            "a=end-of-candidates",
+        ]
     return "\r\n".join(lines) + "\r\n"
 
 

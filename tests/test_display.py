@@ -1,6 +1,8 @@
 """Display management tests: CVT-RB modeline math against known-good
 ``cvt -r`` outputs (pure functions — no X server needed)."""
 
+import asyncio
+
 from selkies_tpu.display import DisplayManager, cvt_rb_modeline
 
 
@@ -47,3 +49,131 @@ def test_manager_headless_is_inert():
     # no xrandr or no display -> available() False on this CI image is
     # fine either way; the contract is just "no crash"
     assert dm.available() in (True, False)
+
+
+# ------------------------------------------------- extended desktop
+
+
+def test_compute_dual_layout_positions():
+    from selkies_tpu.display import compute_dual_layout
+    assert compute_dual_layout(1920, 1080, 1280, 720, "right") == \
+        (3200, 1080, (0, 0), (1920, 0))
+    assert compute_dual_layout(1920, 1080, 1280, 720, "left") == \
+        (3200, 1080, (1280, 0), (0, 0))
+    assert compute_dual_layout(1920, 1080, 1280, 720, "below") == \
+        (1920, 1800, (0, 0), (0, 1080))
+    assert compute_dual_layout(1920, 1080, 1280, 720, "above") == \
+        (1920, 1800, (0, 720), (0, 0))
+
+
+async def test_extended_desktop_xrandr_commands():
+    """ExtendedDesktop must grow the framebuffer to the union and carve
+    one selkies-N logical monitor per display, first bound to the real
+    output (reference replace_selkies_monitors)."""
+    from selkies_tpu.display import DisplayManager, ExtendedDesktop
+
+    calls = []
+
+    class FakeDM(DisplayManager):
+        def available(self):
+            return True
+
+        async def _run(self, *args):
+            calls.append(args)
+            if "--query" in args:
+                return 0, "HDMI-1 connected 1920x1080+0+0\n"
+            return 0, ""
+
+    ext = ExtendedDesktop(FakeDM(":77"))
+    ok = await ext.apply([(0, 0, 1920, 1080), (1920, 0, 1280, 720)])
+    assert ok
+    flat = ["|".join(c) for c in calls]
+    assert any("--newmode" in f and "3200x1080" in f for f in flat), flat
+    mon = [c for c in calls if "--setmonitor" in c]
+    assert len(mon) == 2
+    assert mon[0][2] == "selkies-0" and mon[0][4] == "HDMI-1"
+    assert mon[1][2] == "selkies-1" and mon[1][4] == "none"
+    assert "+1920+0" in mon[1][3]
+    # re-apply drops the stale monitors first
+    ok = await ext.apply([(0, 0, 800, 600)])
+    assert ok
+    dels = [c for c in calls if "--delmonitor" in c]
+    assert len(dels) == 2
+
+
+async def test_two_displays_stream_independently(client_factory):
+    """VERDICT round-2 item 7 done bar: two clients on two displays of
+    one seat stream independently (per-display captures + routing)."""
+    from aiohttp import WSMsgType
+
+    from selkies_tpu.server.core import CentralizedStreamServer
+    from selkies_tpu.server.ws_service import WebSocketsService
+    from selkies_tpu.settings import AppSettings
+    from tests.test_server import FakeCapture
+
+    s = AppSettings.parse([], {})
+    s.set_server("max_displays", 2)
+    fakes = []
+
+    def factory():
+        f = FakeCapture()
+        fakes.append(f)
+        return f
+
+    svc = WebSocketsService(s, capture_factory=factory,
+                            display_manager=None)
+    svc.display_manager = None          # headless: offsets only
+    server = CentralizedStreamServer(s)
+    server.register_service("websockets", svc)
+    c = await client_factory(server)
+
+    async def open_display(q):
+        ws = await c.ws_connect(f"/api/websockets?display={q}")
+        while True:
+            msg = await ws.receive(timeout=2)
+            if msg.type != WSMsgType.TEXT:
+                break
+            if msg.data.startswith("server_settings"):
+                break
+        await ws.send_str("START_VIDEO")
+        return ws
+
+    ws1 = await open_display(":0")
+    await asyncio.sleep(0.6)            # reconnect debounce
+    ws2 = await open_display("display2")
+    await asyncio.sleep(0.3)
+
+    assert set(svc.display_geometry) == {":0", "display2"}
+    assert svc.display_offsets["display2"][0] > 0      # extended right
+    assert len(fakes) == 2
+    dids = sorted(f._settings.display_id for f in fakes)
+    assert dids == [":0", "display2"]
+    # offsets reach the capture settings (sub-rect of the framebuffer)
+    d2 = next(f for f in fakes if f._settings.display_id == "display2")
+    assert (d2._settings.capture_x, d2._settings.capture_y) == \
+        svc.display_offsets["display2"]
+
+    async def collect(ws):
+        got = []
+        for _ in range(6):
+            try:
+                msg = await ws.receive(timeout=1.5)
+            except (asyncio.TimeoutError, TimeoutError):
+                break
+            if msg.type == WSMsgType.BINARY:
+                got.append(msg.data)
+        return got
+
+    for f in fakes:
+        f.emit(2)
+    b1, b2 = await collect(ws1), await collect(ws2)
+    assert b1 and b2, "both displays must stream"
+
+    # resizing the PRIMARY must retarget display2's capture to its moved
+    # origin (its sub-rect shifts right when the primary grows)
+    await ws1.send_str("r,1280x800")
+    await asyncio.sleep(0.3)
+    assert svc.display_geometry[":0"] == (1280, 800)
+    assert svc.display_offsets["display2"] == (1280, 0)
+    await ws1.close()
+    await ws2.close()

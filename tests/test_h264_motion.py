@@ -198,3 +198,25 @@ def test_scrolling_desktop_size_bar_vs_x264():
     ratio = ours / x264_p_bytes
     assert ratio <= 2.0, \
         f"ours {ours}B vs x264 {x264_p_bytes}B (ratio {ratio:.2f})"
+
+
+def test_pure_motion_mb_no_residual_conformance():
+    """An exact even-pel scroll at moderate qp yields coded MBs with
+    mv != 0 and cbp == 0 (pure motion copy). Spec §7.3.5 forbids
+    mb_qp_delta on those — regression for the desync this once caused
+    (refdec IndexError, ffmpeg MB concealment in live streams)."""
+    h, w = 48, 64
+    y0, u0, v0 = _texture(h, w, seed=21)
+    idr, recon = _encode_idr(y0, u0, v0)
+    # scroll by 4 px: chroma shifts exactly 2 -> zero residual everywhere
+    rng = np.random.default_rng(55)
+    y1 = np.empty_like(y0)
+    y1[:h - 4] = np.asarray(recon[0])[4:]       # recon content: exact match
+    y1[h - 4:] = rng.integers(0, 256, (4, w), dtype=np.uint8)
+    u1, v1 = np.empty_like(u0), np.empty_like(v0)
+    u1[:h // 2 - 2] = np.asarray(recon[1])[2:]
+    u1[h // 2 - 2:] = 128
+    v1[:h // 2 - 2] = np.asarray(recon[2])[2:]
+    v1[h // 2 - 2:] = 128
+    au, rec = _encode_p(y1, u1, v1, recon, scroll_candidates(8, 4))
+    _check_oracles(H.write_sps(w, h) + H.write_pps(), [idr, au], rec)

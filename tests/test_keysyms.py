@@ -1,0 +1,45 @@
+"""Server keysym map: rule-based keysym<->Unicode translation
+(the functional core of the reference's generated server_keysym_map.py)."""
+
+from selkies_tpu.input.keysyms import (char_to_keysym, is_modifier,
+                                       keysym_to_char, normalize)
+
+
+def test_latin1_identity():
+    for ch in "aZ0 ~é½ÿ":
+        ks = char_to_keysym(ch)
+        assert ks == ord(ch)
+        assert keysym_to_char(ks) == ch
+
+
+def test_unicode_rule_roundtrip():
+    for ch in "→中文🎮ßčşёλ€":
+        ks = char_to_keysym(ch)
+        assert keysym_to_char(ks) == ch
+
+
+def test_legacy_keysyms_translate():
+    assert keysym_to_char(0x01E8) == "č"        # Latin-2 ccaron
+    assert keysym_to_char(0x07E9) == "ι"        # Greek iota
+    assert keysym_to_char(0x06D7) == "в"        # Cyrillic ve
+    assert keysym_to_char(0x20AC) == "€"
+    # canonical reverse prefers the legacy page over the Unicode rule
+    assert char_to_keysym("č") == 0x01E8
+    assert char_to_keysym("ι") == 0x07E9
+
+
+def test_normalize_collapses_layout_aliases():
+    # a Czech layout's legacy keysym and the Unicode keysym for the same
+    # character normalise to the same canonical value
+    assert normalize(0x01E8) == normalize(0x01000000 | ord("č"))
+    # keypad '7' normalises to the character it types
+    assert normalize(0xFFB7) == ord("7")
+    # non-printing keys pass through untouched
+    assert normalize(0xFF1B) == 0xFF1B          # Escape
+    assert normalize(0xFFE1) == 0xFFE1          # Shift_L
+
+
+def test_nonprinting_have_no_char():
+    for ks in (0xFF1B, 0xFFE1, 0xFF51, 0xFFC8):   # Esc, Shift, Left, F11
+        assert keysym_to_char(ks) is None
+    assert is_modifier(0xFFE1) and not is_modifier(0x61)

@@ -52,11 +52,12 @@ class FakeCapture:
     def set_cursor_callback(self, cb): self.cursor_cb = cb
 
     def emit(self, n=1):
+        did = self._settings.display_id if self._settings else ":0"
         for _ in range(n):
             self._cb(EncodedChunk(
                 payload=b"\xff\xd8FAKEJPEG\xff\xd9", frame_id=self.fid,
                 stripe_y=0, width=64, height=64, is_idr=True,
-                output_mode="jpeg", display_id=":0"))
+                output_mode="jpeg", display_id=did))
             self.fid += 1
 
 

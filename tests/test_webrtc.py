@@ -141,3 +141,35 @@ def test_turn_endpoint_through_webrtc_service():
         assert cfg["iceServers"][0]["urls"][0].startswith("turn:t.example")
         await client.close()
     asyncio.run(run())
+
+
+def test_turn_rest_addon_app():
+    """addons/turn-rest mints coturn-compatible HMAC credentials through
+    the same scheme the server's resolution chain consumes."""
+    async def run():
+        import importlib.util
+        import pathlib
+        path = (pathlib.Path(__file__).parent.parent / "addons"
+                / "turn-rest" / "app.py")
+        spec = importlib.util.spec_from_file_location("turn_rest_app", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.SECRET = "s3cret"
+        mod.TURN_HOST = "turn.example"
+        app = mod.make_app()
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        r = await client.get("/?service=turn&username=alice")
+        cfg = await r.json()
+        assert r.status == 200
+        turn = cfg["iceServers"][1]
+        assert turn["urls"][0].startswith("turn:turn.example:3478")
+        user, cred = turn["username"], turn["credential"]
+        assert user.endswith(":alice")
+        expect = base64.b64encode(hmac_mod.new(
+            b"s3cret", user.encode(), hashlib.sha1).digest()).decode()
+        assert cred == expect
+        r = await client.get("/?service=smtp")
+        assert r.status == 400
+        await client.close()
+    asyncio.run(run())

@@ -387,3 +387,185 @@ async def test_webrtc_service_builds_real_sessions(client_factory):
 
     transport.close()
     await ws.close()
+
+
+# ---------------------------------------------------------------- SCTP
+
+
+def test_crc32c_vectors():
+    from selkies_tpu.webrtc.sctp import crc32c
+    # RFC 3720 appendix B.4 vectors
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc32c(b"\xff" * 32) == 0x62A8AB43
+    assert crc32c(bytes(range(32))) == 0x46DD794E
+
+
+def _sctp_pair(drop_first_data=False):
+    from selkies_tpu.webrtc.sctp import SctpAssociation
+
+    wires = {"a": [], "b": []}
+    dropped = {"n": 0}
+
+    def to_b(pkt):
+        if drop_first_data and pkt[12] == 0 and dropped["n"] == 0:
+            dropped["n"] += 1
+            return                      # lose the first DATA packet
+        wires["b"].append(pkt)
+
+    clock = {"t": 0.0}
+    a = SctpAssociation(lambda p: wires["a"].append(p), server=True,
+                        now=lambda: clock["t"])
+    b = SctpAssociation(to_b, server=False, now=lambda: clock["t"])
+
+    def pump(rounds=8):
+        for _ in range(rounds):
+            for pkt in wires["b"]:
+                a.receive(pkt)
+            wires["b"].clear()
+            for pkt in wires["a"]:
+                b.receive(pkt)
+            wires["a"].clear()
+
+    return a, b, pump, clock
+
+
+def test_sctp_handshake_channels_and_messages():
+    a, b, pump, _ = _sctp_pair()
+    b.connect()
+    pump()
+    assert a.state == b.state == "ESTABLISHED"
+
+    opened = []
+    got_a, got_b = [], []
+    a.on_channel = opened.append
+    a.on_message = lambda ch, d, p: got_a.append((ch.label, d))
+    b.on_message = lambda ch, d, p: got_b.append((ch.label, d))
+
+    b.open_channel(1, "input")
+    pump()
+    assert [c.label for c in opened] == ["input"]
+
+    b.send(1, b"kd,65")
+    b.send(1, b"ku,65")
+    pump()
+    assert got_a == [("input", b"kd,65"), ("input", b"ku,65")]
+
+    a.send(1, b"cursor,{}")              # server -> browser direction
+    pump()
+    assert got_b == [("input", b"cursor,{}")]
+
+    big = bytes(range(256)) * 20         # 5120 B: must fragment
+    b.send(1, big)
+    pump()
+    assert got_a[-1] == ("input", big)
+
+
+def test_sctp_retransmission_recovers_loss():
+    a, b, pump, clock = _sctp_pair(drop_first_data=True)
+    b.connect()
+    pump()
+    got = []
+    a.on_message = lambda ch, d, p: got.append(d)
+    b.open_channel(1, "input")
+    pump()
+    b.send(1, b"first")                  # this DATA packet is dropped
+    b.send(1, b"second")
+    pump()
+    assert got == []                     # 'second' parked out of order
+    clock["t"] += 2.0                    # T3 expires
+    b.poll_timers()
+    pump()
+    assert got == [b"first", b"second"]
+
+
+async def test_full_loopback_datachannel_input():
+    """Browser sim opens a data channel through the REAL peer (DTLS app
+    records -> SCTP) and sends input verbs; the peer surfaces them."""
+    from selkies_tpu.webrtc.sctp import SctpAssociation
+
+    verbs = []
+    peer = RTCPeer(on_datachannel_message=lambda lbl, t: verbs.append(t))
+    port = await peer.listen()
+    remote = parse_answer(peer.create_offer())
+    assert "webrtc-datachannel" in peer.create_offer()
+    cli_ice = IceLiteResponder(*make_ice_credentials())
+    cli_ice.set_remote(remote.ice_ufrag, remote.ice_pwd)
+    cli_dtls = DtlsEndpoint(server=False)
+    peer.set_remote_answer(build_offer(
+        "127.0.0.1", 0, cli_ice.ufrag, cli_ice.pwd,
+        remote.fingerprint).replace("a=setup:actpass", "a=setup:active"))
+
+    loop = asyncio.get_running_loop()
+    browser = _Browser()
+    transport, _ = await loop.create_datagram_endpoint(
+        lambda: browser, remote_addr=("127.0.0.1", port))
+    transport.sendto(cli_ice.binding_request())
+    await asyncio.wait_for(browser.queue.get(), 2)
+    cli_dtls.handshake()
+    transport.sendto(cli_dtls.take_outgoing())
+
+    app_records = []
+    async def pump_browser(timeout=0.5):
+        try:
+            while True:
+                d = await asyncio.wait_for(browser.queue.get(), timeout)
+                if d and 20 <= d[0] <= 63:
+                    app_records.extend(cli_dtls.feed(d))
+                    out = cli_dtls.take_outgoing()
+                    if out:
+                        transport.sendto(out)
+        except asyncio.TimeoutError:
+            return
+
+    for _ in range(10):
+        if cli_dtls.handshake_complete:
+            break
+        await pump_browser(1.0)
+    assert cli_dtls.handshake_complete
+    await asyncio.wait_for(peer.connected.wait(), 2)
+
+    def ship(pkt):
+        cli_dtls.send_app(pkt)
+        out = cli_dtls.take_outgoing()
+        if out:
+            transport.sendto(out)
+
+    sctp = SctpAssociation(ship, server=False)
+    sctp.connect()
+    for _ in range(10):
+        await pump_browser(0.3)
+        while app_records:
+            sctp.receive(app_records.pop(0))
+        if sctp.state == "ESTABLISHED":
+            break
+    assert sctp.state == "ESTABLISHED"
+
+    sctp.open_channel(1, "input")
+    sctp.send(1, b"kd,65")
+    sctp.send(1, b"m,10,20")
+    for _ in range(10):
+        await pump_browser(0.3)
+        while app_records:
+            sctp.receive(app_records.pop(0))
+        if len(verbs) >= 2:
+            break
+    assert verbs == ["kd,65", "m,10,20"]
+    transport.close()
+    peer.close()
+
+
+def test_rtcp_remb_parse():
+    from selkies_tpu.webrtc.rtp import parse_rtcp_remb
+    # REMB for 1.2 Mbps: mantissa/exp encoding
+    target = 1_200_000
+    exp = 0
+    mantissa = target
+    while mantissa >= (1 << 18):
+        mantissa >>= 1
+        exp += 1
+    pkt = struct.pack("!BBHII", 0x8F, 206, 5, 1, 0) + b"REMB" + \
+        struct.pack("!I", (1 << 24) | (exp << 18) | mantissa) + \
+        struct.pack("!I", 0xCAFE)
+    got = parse_rtcp_remb(pkt)
+    assert got is not None and abs(got - target) / target < 0.01
+    assert parse_rtcp_remb(struct.pack("!BBHII", 0x81, 206, 2, 1, 2)) is None

@@ -1,0 +1,218 @@
+"""Real-X11 integration: capture -> encode -> WS -> decode, plus XTEST
+injection verified through XQueryPointer (VERDICT round-2 item 8; the
+reference's grungiest surface, SURVEY §7 hard-part 5).
+
+Needs an Xvfb binary — present in the example container (Dockerfile),
+absent from the bare CI image, so everything here skips gracefully.
+Run inside the container with: ``pytest -m x11``.
+"""
+
+import asyncio
+import ctypes
+import ctypes.util
+import os
+import shutil
+import socket
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = [
+    pytest.mark.x11,
+    pytest.mark.skipif(shutil.which("Xvfb") is None,
+                       reason="Xvfb not installed (run in the container)"),
+]
+
+DISPLAY = ":99"
+W, H = 640, 480
+
+
+@pytest.fixture(scope="module")
+def xvfb():
+    proc = subprocess.Popen(
+        ["Xvfb", DISPLAY, "-screen", "0", f"{W}x{H}x24", "-nolisten", "tcp"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    sock = f"/tmp/.X11-unix/X{DISPLAY[1:]}"
+    deadline = time.time() + 10
+    while time.time() < deadline and not os.path.exists(sock):
+        time.sleep(0.1)
+    if not os.path.exists(sock):
+        proc.terminate()
+        pytest.skip("Xvfb failed to start")
+    yield DISPLAY
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+class _X:
+    """Tiny ctypes X helper for fixture drawing + pointer queries."""
+
+    def __init__(self, display):
+        self.lib = ctypes.CDLL(ctypes.util.find_library("X11"))
+        self.lib.XOpenDisplay.restype = ctypes.c_void_p
+        self.lib.XDefaultRootWindow.restype = ctypes.c_ulong
+        self.lib.XCreateGC.restype = ctypes.c_void_p
+        self.dpy = self.lib.XOpenDisplay(display.encode())
+        assert self.dpy, f"cannot open {display}"
+        self.root = self.lib.XDefaultRootWindow(ctypes.c_void_p(self.dpy))
+
+    def fill_rect(self, x, y, w, h, rgb):
+        gc = self.lib.XCreateGC(ctypes.c_void_p(self.dpy),
+                                ctypes.c_ulong(self.root), 0, None)
+        self.lib.XSetForeground(ctypes.c_void_p(self.dpy),
+                                ctypes.c_void_p(gc), ctypes.c_ulong(rgb))
+        self.lib.XFillRectangle(ctypes.c_void_p(self.dpy),
+                                ctypes.c_ulong(self.root),
+                                ctypes.c_void_p(gc), x, y, w, h)
+        self.lib.XSync(ctypes.c_void_p(self.dpy), 0)
+        self.lib.XFreeGC(ctypes.c_void_p(self.dpy), ctypes.c_void_p(gc))
+
+    def pointer_xy(self):
+        root = ctypes.c_ulong()
+        child = ctypes.c_ulong()
+        rx, ry, wx, wy = (ctypes.c_int() for _ in range(4))
+        mask = ctypes.c_uint()
+        self.lib.XQueryPointer(
+            ctypes.c_void_p(self.dpy), ctypes.c_ulong(self.root),
+            ctypes.byref(root), ctypes.byref(child),
+            ctypes.byref(rx), ctypes.byref(ry),
+            ctypes.byref(wx), ctypes.byref(wy), ctypes.byref(mask))
+        return rx.value, ry.value
+
+
+def test_x11_capture_sees_drawn_content(xvfb):
+    from selkies_tpu.engine.sources import X11Source
+
+    x = _X(xvfb)
+    x.fill_rect(0, 0, W, H, 0x202020)
+    x.fill_rect(100, 100, 200, 150, 0xFF4000)
+    src = X11Source(display=xvfb)
+    frame = np.asarray(src.get_frame(0))
+    assert frame.shape == (H, W, 3)
+    inside = frame[150, 180]
+    outside = frame[50, 500]
+    assert inside[0] > 180 and int(outside[0]) < 80, (inside, outside)
+
+
+def test_xtest_injection_moves_pointer(xvfb):
+    from selkies_tpu.input.backends import X11Backend
+
+    x = _X(xvfb)
+    be = X11Backend(display=xvfb)
+    be.pointer_motion(123, 77)
+    time.sleep(0.1)
+    assert x.pointer_xy() == (123, 77)
+    be.pointer_motion(400, 300)
+    time.sleep(0.1)
+    assert x.pointer_xy() == (400, 300)
+
+
+async def test_x11_ws_end_to_end(xvfb, client_factory):
+    """Live Xvfb content through the full server: capture -> TPU encode
+    -> WS 0x04 stripes -> spec-decoder, then a WS mouse verb lands in the
+    X server."""
+    from aiohttp import WSMsgType
+
+    from selkies_tpu.codecs import h264_ref_decoder as refdec
+    from selkies_tpu.input.backends import X11Backend
+    from selkies_tpu.input.handler import InputHandler
+    from selkies_tpu.server.core import CentralizedStreamServer
+    from selkies_tpu.server.ws_service import WebSocketsService
+    from selkies_tpu.settings import AppSettings
+
+    x = _X(xvfb)
+    x.fill_rect(0, 0, W, H, 0x3060A0)
+    s = AppSettings.parse([], {})
+    s.set_server("display_id", xvfb)
+    s.set_server("encoder", "h264-tpu-striped")
+    s.set_server("initial_width", W)
+    s.set_server("initial_height", H)
+    s.set_server("h264_motion_vrange", 2)
+    s.set_server("h264_motion_hrange", 1)
+    handler = InputHandler(backend=X11Backend(display=xvfb))
+    svc = WebSocketsService(s, input_handler=handler)
+    server = CentralizedStreamServer(s)
+    server.register_service("websockets", svc)
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    while True:
+        msg = await ws.receive(timeout=2)
+        if msg.type != WSMsgType.TEXT or \
+                msg.data.startswith("server_settings"):
+            break
+    await ws.send_str("START_VIDEO")
+    streams = {}
+    got_idr = False
+    deadline = time.time() + 180          # first jit compile dominates
+    while time.time() < deadline and not got_idr:
+        try:
+            msg = await ws.receive(timeout=5)
+        except (asyncio.TimeoutError, TimeoutError):
+            continue
+        if msg.type != WSMsgType.BINARY or msg.data[0] != 0x04:
+            continue
+        import struct
+        ftype, fid, y0, sw, sh = struct.unpack_from("!BHHHH", msg.data, 1)
+        streams.setdefault(y0, []).append(msg.data[10:])
+        await ws.send_str(f"CLIENT_FRAME_ACK {fid}")
+        if ftype == 0x01:
+            got_idr = True
+    assert got_idr, "no IDR stripe arrived from the live X capture"
+    y0 = sorted(streams)[0]
+    y, _, _ = refdec.Decoder().decode(b"".join(streams[y0]))
+    assert y.shape[1] >= W        # MB-padded width
+    assert y.mean() > 16, "decoded stripe should carry the blue fill"
+
+    await ws.send_str("m,222,111")
+    await asyncio.sleep(0.3)
+    assert x.pointer_xy() == (222, 111)
+    await ws.close()
+
+
+def test_spare_keycode_overlay_binds_unmapped_keysyms(xvfb):
+    """A Unicode keysym the server layout lacks gets bound onto a spare
+    keycode on first press (the reference's overlay binding,
+    input_handler.py:760-932) and resolves afterwards."""
+    from selkies_tpu.input.backends import X11Backend
+    from selkies_tpu.input.keysyms import char_to_keysym
+
+    be = X11Backend(display=xvfb)
+    arrow = char_to_keysym("→")              # 0x01002192
+    assert ctypes.CDLL(ctypes.util.find_library("X11")) is not None
+    be.key(arrow, True)
+    be.key(arrow, False)
+    assert arrow in be._overlay, "spare keycode was not bound"
+    code = be._x.XKeysymToKeycode(ctypes.c_void_p(be._dpy),
+                                  ctypes.c_ulong(arrow))
+    assert code == be._overlay[arrow]
+
+
+def test_clipboard_selection_owner_roundtrip(xvfb):
+    """Two X clients: one takes the CLIPBOARD selection, the monitor
+    notices and reads the text; then the reverse direction."""
+    from selkies_tpu.input.clipboard_x11 import X11ClipboardMonitor
+
+    seen = []
+    server_side = X11ClipboardMonitor(xvfb, on_clipboard=seen.append)
+    server_side.start()
+    app_side = X11ClipboardMonitor(xvfb)
+    app_side.start()
+    try:
+        app_side.set_clipboard("copied in a remote app")
+        deadline = time.time() + 10
+        while time.time() < deadline and not seen:
+            time.sleep(0.1)
+        assert seen == ["copied in a remote app"]
+
+        got = []
+        app_side.on_clipboard = got.append
+        server_side.set_clipboard("pasted from the web client")
+        deadline = time.time() + 10
+        while time.time() < deadline and not got:
+            time.sleep(0.1)
+        assert got == ["pasted from the web client"]
+    finally:
+        server_side.stop()
+        app_side.stop()
