@@ -41,10 +41,19 @@ async def run(argv=None) -> None:
 
     server = CentralizedStreamServer(settings)
 
+    # Wayland bring-up (reference stream_server.py:420-447): no in-process
+    # compositor here — an external headless compositor (labwc/sway) plays
+    # that role; mirror its socket into the env so every child reaches it
+    if settings.wayland and settings.wayland_host_display:
+        os.environ["WAYLAND_DISPLAY"] = settings.wayland_host_display
+
     input_handler = None
     if settings.enable_input:
         input_handler = InputHandler(
-            backend=make_backend(settings.display_id),
+            backend=make_backend(
+                settings.display_id, wayland=settings.wayland,
+                wayland_display=(settings.app_wayland_display
+                                 or settings.wayland_host_display or None)),
             enable_command_verb=settings.enable_command_verb,
             clipboard_max_bytes=settings.clipboard_max_bytes)
         if settings.enable_gamepad:

@@ -409,10 +409,68 @@ class X11Source:
             self._dpy = None
 
 
+class WaylandSource:
+    """Live Wayland capture: zwlr_screencopy client of an external
+    headless compositor (the reference's ``wayland_host_display`` role,
+    settings.py:636-638; SURVEY §2.2 pixelflux Wayland row).
+
+    Each ``get_frame`` runs one screencopy pass into a reused shm buffer.
+    A host-side equality check against the previous grab skips the
+    host->device upload for static desktops (the Wayland analog of the
+    X11 path's XDamage gate — screencopy has no pre-copy damage query)."""
+
+    def __init__(self, display: str | None = None,
+                 width: int | None = None, height: int | None = None,
+                 x: int = 0, y: int = 0):
+        from ..wayland import WaylandClient, WireError
+        try:
+            self._wl = WaylandClient(display)
+        except WireError as e:
+            raise RuntimeError(str(e))
+        if not self._wl.can_capture:
+            self._wl.close()
+            raise RuntimeError("compositor lacks screencopy/shm globals")
+        ow, oh = self._wl.output_size()
+        self.width = width or ow or 1920
+        self.height = height or oh or 1080
+        self._ox, self._oy = x, y
+        self._last_np: np.ndarray | None = None
+        self._cached: jnp.ndarray | None = None
+
+    def get_frame(self, tick: int) -> jnp.ndarray:
+        frame = self._wl.capture_frame()
+        if frame is None:                 # output mid-modeset: hold last
+            if self._cached is not None:
+                return self._cached
+            frame = np.zeros((self.height, self.width, 3), np.uint8)
+        # crop/pad the compositor's output to the capture sub-rect
+        h, w = frame.shape[:2]
+        y0, x0 = min(self._oy, h), min(self._ox, w)
+        sub = frame[y0:y0 + self.height, x0:x0 + self.width]
+        if sub.shape[:2] != (self.height, self.width):
+            pad = np.zeros((self.height, self.width, 3), np.uint8)
+            pad[:sub.shape[0], :sub.shape[1]] = sub
+            sub = pad
+        if self._cached is not None and self._last_np is not None \
+                and np.array_equal(sub, self._last_np):
+            return self._cached           # static: skip the device upload
+        self._last_np = sub
+        self._cached = jax.device_put(np.ascontiguousarray(sub))
+        return self._cached
+
+    def poll_cursor(self) -> dict | None:
+        # screencopy composites the cursor when overlay_cursor=1; no
+        # separate cursor plane is exposed to clients
+        return None
+
+    def close(self) -> None:
+        self._wl.close()
+
+
 def make_source(kind: str, width: int, height: int, display: str = ":0"
                 ) -> FrameSource:
-    """Source factory used by ScreenCapture; 'auto' prefers a live X display
-    and falls back to the synthetic pattern."""
+    """Source factory used by ScreenCapture; 'auto' prefers a live X
+    display, then a Wayland compositor, then the synthetic pattern."""
     if kind == "synthetic":
         return SyntheticSource(width, height)
     if kind == "synthetic-static":
@@ -421,10 +479,19 @@ def make_source(kind: str, width: int, height: int, display: str = ":0"
         return SyntheticSource(width, height, static_after=0)
     if kind == "x11":
         return X11Source(display, width, height)
+    if kind == "wayland":
+        return WaylandSource(display if display.startswith("wayland")
+                             or display.startswith("/") else None,
+                             width, height)
     if kind == "auto":
         try:
             return X11Source(display, width, height)
         except (RuntimeError, OSError) as e:
-            logger.info("X11 unavailable (%s); using synthetic source", e)
+            logger.info("X11 unavailable (%s); trying Wayland", e)
+        try:
+            return WaylandSource(None, width, height)
+        except (RuntimeError, OSError) as e:
+            logger.info("Wayland unavailable (%s); using synthetic source",
+                        e)
             return SyntheticSource(width, height)
     raise ValueError(f"unknown source kind {kind!r}")

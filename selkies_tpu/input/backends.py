@@ -265,9 +265,116 @@ class X11Backend:
             self._dpy = None
 
 
-def make_backend(display: str = ":0") -> InputBackend:
+class WaylandBackend:
+    """Wayland virtual input: zwp_virtual_keyboard + zwlr_virtual_pointer
+    against the compositor the apps run on (the reference's Wayland input
+    role, pixelflux-side; input_handler.py `_WaylandKeymapOwner` is the
+    keymap-overlay analog). Keysym->keycode is solved by OWNING the xkb
+    keymap (wayland/keymap.py) instead of hunting spare keycodes.
+
+    Clipboard: wl-copy/wl-paste when present (the reference shells out to
+    them too); otherwise the in-process cache alone."""
+
+    _BTN_BY_X11 = {1: 0x110, 2: 0x112, 3: 0x111, 8: 0x113, 9: 0x114}
+
+    def __init__(self, display: str | None = None,
+                 screen_size: tuple[int, int] | None = None):
+        from ..wayland import DynamicKeymap, WaylandClient, WireError
+        try:
+            self._wl = WaylandClient(display)
+        except WireError as e:
+            raise RuntimeError(str(e))
+        if not self._wl.can_input:
+            self._wl.close()
+            raise RuntimeError("compositor lacks virtual-input globals")
+        self._km = DynamicKeymap()
+        self._lock = threading.Lock()
+        self._extent = screen_size or self._wl.output_size() or (1920, 1080)
+        self._clip: tuple[bytes, str] = (b"", "text/plain")
+        self._display = display            # wl-copy/wl-paste must hit the
+        #                                    SAME compositor as the protocol
+
+    def key(self, keysym, down):
+        with self._lock:
+            kc, changed = self._km.keycode_for(int(keysym))
+            if changed:
+                self._wl.ensure_virtual_keyboard(self._km.text())
+            self._wl.keyboard_key(kc - 8, bool(down))
+            self._wl.flush_events()
+
+    def pointer_motion(self, x, y):
+        with self._lock:
+            ew, eh = self._extent
+            self._wl.pointer_motion_abs(int(x), int(y), ew, eh)
+
+    def pointer_motion_rel(self, dx, dy):
+        with self._lock:
+            self._wl.pointer_motion_rel(float(dx), float(dy))
+
+    def pointer_button(self, button, down):
+        with self._lock:
+            code = self._BTN_BY_X11.get(int(button))
+            if code is not None:
+                self._wl.pointer_button(code, bool(down))
+
+    def scroll(self, dx, dy):
+        with self._lock:
+            if dy:
+                self._wl.pointer_axis(0, 15.0 * int(dy))
+            if dx:
+                self._wl.pointer_axis(1, 15.0 * int(dx))
+
+    def set_screen_size(self, w: int, h: int) -> None:
+        self._extent = (w, h)
+
+    def _wl_env(self):
+        import os
+        env = dict(os.environ)
+        if self._display:
+            env["WAYLAND_DISPLAY"] = self._display
+        return env
+
+    def set_clipboard(self, data, mime):
+        self._clip = (data, mime)
+        if mime.startswith("text"):
+            try:
+                import subprocess
+                subprocess.run(["wl-copy"], input=data, timeout=2,
+                               check=False, env=self._wl_env())
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+
+    def get_clipboard(self):
+        try:
+            import subprocess
+            r = subprocess.run(["wl-paste", "--no-newline"],
+                               capture_output=True, timeout=2,
+                               env=self._wl_env())
+            if r.returncode == 0 and r.stdout:
+                return (r.stdout, "text/plain")
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+        return self._clip
+
+    def close(self):
+        self._wl.close()
+
+
+def make_backend(display: str = ":0", wayland: bool = False,
+                 wayland_display: str | None = None) -> InputBackend:
+    if wayland:
+        try:
+            return WaylandBackend(wayland_display)
+        except (RuntimeError, OSError) as e:
+            logger.info("Wayland input unavailable (%s); trying X11", e)
     try:
         return X11Backend(display)
     except (RuntimeError, OSError) as e:
+        if not wayland:
+            # X-first default still falls through to a live compositor
+            try:
+                return WaylandBackend(wayland_display)
+            except (RuntimeError, OSError) as e2:
+                logger.info("Wayland input unavailable (%s)", e2)
         logger.info("X11 input unavailable (%s); using null backend", e)
         return NullBackend()

@@ -245,7 +245,9 @@ class WebRTCService(BaseStreamingService):
                 cap = self._capture_factory()
             else:
                 from ..engine.capture import ScreenCapture
-                cap = ScreenCapture()
+                cap = ScreenCapture(
+                    "wayland" if getattr(self.settings, "wayland", False)
+                    else "auto")
             from ..engine.types import CaptureSettings
             s = self.settings
             cs = CaptureSettings(
@@ -344,10 +346,52 @@ class WebRTCService(BaseStreamingService):
     def _on_input_verb(self, label: str, text) -> None:
         """Data-channel input: same verb grammar as the WS transport
         (the reference shares one input handler across transports,
-        input_handler.py:1866)."""
-        if self.input_handler is None or not isinstance(text, str):
+        input_handler.py:1866). Control verbs the WS service would own
+        (REQUEST_KEYFRAME / vb / r) are handled here; everything else
+        forwards to the shared input handler."""
+        if not isinstance(text, str) or self._loop is None:
             return
-        if self._loop is not None:
+        verb, _, args = text.partition(",")
+        if text == "REQUEST_KEYFRAME":
+            self._loop.call_soon_threadsafe(self._request_idr)
+            return
+        if verb == "vb":
+            try:
+                kbps = int(args)
+            except ValueError:
+                return
+            self._loop.call_soon_threadsafe(self._on_remb, kbps * 1000)
+            return
+        if verb == "r" and self.settings.enable_resize:
+            try:
+                w, h = (int(v) for v in args.lower().split("x"))
+            except ValueError:
+                return
+            self._loop.call_soon_threadsafe(
+                lambda: self._loop.create_task(self._resize(w, h)))
+            return
+        if self.input_handler is not None:
             self._loop.call_soon_threadsafe(
                 lambda: self._loop.create_task(
                     self.input_handler.on_message(text)))
+
+    async def _resize(self, w: int, h: int) -> None:
+        """Data-channel resize: retarget the single-stream capture (and the
+        real X screen when one exists — reference webrtc_mode.py mirrors
+        the WS on_resize logic)."""
+        geo = (max(64, min(w, 16384)), max(64, min(h, 16384)))
+        # through the settings layer, not attribute assignment — a plain
+        # setattr would shadow _resolved and hide later settings updates
+        self.settings.set_server("initial_width", geo[0])
+        self.settings.set_server("initial_height", geo[1])
+        try:
+            from ..display import DisplayManager
+            dm = DisplayManager(self.settings.display_id or ":0")
+            if dm.available():
+                await dm.resize(*geo, float(self.settings.framerate))
+        except Exception:
+            logger.debug("webrtc resize: no real display to resize")
+        cap = self._capture
+        if cap is not None and cap.is_capturing():
+            await self._loop.run_in_executor(
+                None, lambda: cap.update_capture_region(0, 0, *geo))

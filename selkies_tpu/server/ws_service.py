@@ -110,7 +110,10 @@ class WebSocketsService(BaseStreamingService):
         self.display_offsets: dict[str, tuple[int, int]] = {}
         self._ext_desktop = None        # ExtendedDesktop, built lazily
         self._custom_factory = capture_factory is not None
-        self._capture_factory = capture_factory or (lambda: ScreenCapture("auto"))
+        default_kind = "wayland" if getattr(settings, "wayland", False) \
+            else "auto"
+        self._capture_factory = capture_factory \
+            or (lambda: ScreenCapture(default_kind))
         self.input_handler = input_handler
         self.audio = audio_pipeline
         if display_manager is None:

@@ -149,6 +149,18 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
 
     # --- display ------------------------------------------------------------
     _s("display_id", SType.STR, ":0", "X display / seat identifier."),
+    _s("wayland", SType.BOOL, False,
+       "Capture/inject via a Wayland compositor instead of X11 "
+       "(reference settings.py:615-620; needs wayland_host_display or "
+       "$WAYLAND_DISPLAY pointing at a headless compositor)."),
+    _s("wayland_host_display", SType.STR, "",
+       "Wayland socket of the EXTERNAL compositor to capture by "
+       "screencopy and inject into (reference settings.py:636-638); "
+       "empty uses $WAYLAND_DISPLAY."),
+    _s("app_wayland_display", SType.STR, "",
+       "Wayland socket where APPS run when it differs from the capture "
+       "compositor (reference settings.py:622-626); the input/clipboard "
+       "target. Empty follows wayland_host_display."),
     _s("webrtc_media_ip", SType.STR, "",
        "IP advertised as the ICE-lite media candidate (empty = "
        "auto-detect the outbound-route address; the reference's "

@@ -1,0 +1,16 @@
+"""Wayland plane: wire-protocol client, screencopy capture, virtual input.
+
+TPU-era equivalent of pixelflux's external-compositor mode (reference
+settings.py:636-638 ``wayland_host_display``): attach to a headless
+wlroots-style compositor as a client; frames by zwlr_screencopy into shm,
+input by zwp_virtual_keyboard + zwlr_virtual_pointer."""
+
+from .client import (BTN_EXTRA, BTN_LEFT, BTN_MIDDLE, BTN_RIGHT, BTN_SIDE,
+                     WaylandClient)
+from .keymap import DynamicKeymap
+from .wire import WaylandConnection, WireError
+
+__all__ = [
+    "WaylandClient", "WaylandConnection", "WireError", "DynamicKeymap",
+    "BTN_LEFT", "BTN_RIGHT", "BTN_MIDDLE", "BTN_SIDE", "BTN_EXTRA",
+]

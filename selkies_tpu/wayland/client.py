@@ -1,0 +1,388 @@
+"""Wayland compositor client: screencopy capture + virtual input.
+
+Implements the external-compositor role the reference's pixelflux plays
+when ``wayland_host_display`` is set (reference settings.py:636-638):
+frames arrive by zwlr_screencopy into client-allocated shm buffers, input
+is injected through zwp_virtual_keyboard / zwlr_virtual_pointer. The
+compositor composits; we are a plain (privileged-protocol) client.
+
+All blocking waits are bounded; a missing global degrades the feature
+(no screencopy manager -> capture unavailable; no virtual-input managers
+-> input unavailable) instead of failing the session.
+"""
+
+from __future__ import annotations
+
+import logging
+import mmap
+import os
+import struct
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .wire import (ArgReader, WaylandConnection, WireError, arg_fixed,
+                   arg_i32, arg_string, arg_u32)
+
+logger = logging.getLogger("selkies_tpu.wayland")
+
+# wl_shm / drm fourcc format codes we can convert
+FMT_ARGB8888 = 0            # little-endian memory: B G R A
+FMT_XRGB8888 = 1            # little-endian memory: B G R X
+FMT_XBGR8888 = 0x34324258   # 'XB24': R G B X
+FMT_ABGR8888 = 0x34324241   # 'AB24': R G B A
+
+_RGB_SLICES = {
+    FMT_ARGB8888: (2, 1, 0),
+    FMT_XRGB8888: (2, 1, 0),
+    FMT_XBGR8888: (0, 1, 2),
+    FMT_ABGR8888: (0, 1, 2),
+}
+
+# linux input-event codes for the buttons the input plane speaks
+BTN_LEFT, BTN_RIGHT, BTN_MIDDLE, BTN_SIDE, BTN_EXTRA = \
+    0x110, 0x111, 0x112, 0x113, 0x114
+
+
+@dataclass
+class _Global:
+    name: int
+    interface: str
+    version: int
+
+
+@dataclass
+class _Output:
+    proxy: int
+    width: int = 0
+    height: int = 0
+    done: bool = False
+
+
+@dataclass
+class _ShmBuffer:
+    pool_id: int
+    buffer_id: int
+    fd: int
+    map: mmap.mmap
+    width: int
+    height: int
+    stride: int
+    format: int
+    busy: bool = False
+
+
+@dataclass
+class _FrameState:
+    """Per-capture screencopy state machine."""
+    frame_id: int
+    format: int = -1
+    width: int = 0
+    height: int = 0
+    stride: int = 0
+    buffer_done: bool = False
+    ready: bool = False
+    failed: bool = False
+    damage: list = field(default_factory=list)
+
+
+class WaylandClient:
+    """One connection driving capture and/or input against a live
+    compositor. Single-threaded use per instance (the capture thread or
+    the input thread owns its own client)."""
+
+    def __init__(self, display: Optional[str] = None,
+                 conn: Optional[WaylandConnection] = None):
+        self.conn = conn or WaylandConnection.connect(display)
+        c = self.conn
+        self.globals: dict[str, _Global] = {}
+        self.outputs: list[_Output] = []
+        self._shm_id = 0
+        self._seat_id = 0
+        self._screencopy_id = 0
+        self._vkbd_mgr_id = 0
+        self._vptr_mgr_id = 0
+        self._vkbd_id = 0
+        self._vptr_id = 0
+        self._buffer: Optional[_ShmBuffer] = None
+        self._frame: Optional[_FrameState] = None
+        self._registry_id = c.new_id()
+        c.handlers[self._registry_id] = self._on_registry
+        c.send(c.DISPLAY_ID, 1, arg_u32(self._registry_id))  # get_registry
+        c.roundtrip()                      # collect globals
+        self._bind_core()
+        c.roundtrip()                      # collect output modes/shm formats
+
+    # ------------------------------------------------------------- registry
+    def _on_registry(self, opcode: int, r: ArgReader) -> None:
+        if opcode == 0:                                  # global
+            name, iface, ver = r.u32(), r.string(), r.u32()
+            self.globals[iface] = _Global(name, iface, ver)
+        elif opcode == 1:                                # global_remove
+            name = r.u32()
+            for k, g in list(self.globals.items()):
+                if g.name == name:
+                    del self.globals[k]
+
+    def _bind(self, iface: str, version: int) -> int:
+        g = self.globals.get(iface)
+        if g is None:
+            return 0
+        nid = self.conn.new_id()
+        v = min(version, g.version)
+        # wl_registry.bind carries a TYPED new_id: (interface, version, id)
+        self.conn.send(self._registry_id, 0,
+                       arg_u32(g.name) + arg_string(iface) + arg_u32(v)
+                       + arg_u32(nid))
+        return nid
+
+    def _bind_core(self) -> None:
+        self._shm_id = self._bind("wl_shm", 1)
+        self._seat_id = self._bind("wl_seat", 5)
+        if self._seat_id:
+            self.conn.handlers[self._seat_id] = lambda op, r: None
+        self._screencopy_id = self._bind("zwlr_screencopy_manager_v1", 3)
+        self._vkbd_mgr_id = self._bind("zwp_virtual_keyboard_manager_v1", 1)
+        self._vptr_mgr_id = self._bind("zwlr_virtual_pointer_manager_v1", 2)
+        g = self.globals.get("wl_output")
+        if g is not None:
+            oid = self._bind("wl_output", 2)
+            out = _Output(proxy=oid)
+            self.outputs.append(out)
+            self.conn.handlers[oid] = self._make_output_handler(out)
+
+    def _make_output_handler(self, out: _Output):
+        def h(opcode: int, r: ArgReader) -> None:
+            if opcode == 1:                              # mode
+                flags = r.u32()
+                w, hgt = r.i32(), r.i32()
+                if flags & 0x1:                          # current
+                    out.width, out.height = w, hgt
+            elif opcode == 2:                            # done
+                out.done = True
+        return h
+
+    # -------------------------------------------------------------- queries
+    @property
+    def can_capture(self) -> bool:
+        return bool(self._screencopy_id and self._shm_id and self.outputs)
+
+    @property
+    def can_input(self) -> bool:
+        return bool(self._seat_id
+                    and (self._vkbd_mgr_id or self._vptr_mgr_id))
+
+    def output_size(self) -> tuple[int, int]:
+        if not self.outputs:
+            return (0, 0)
+        o = self.outputs[0]
+        return (o.width, o.height)
+
+    # -------------------------------------------------------------- capture
+    def _ensure_buffer(self, fmt: int, w: int, h: int, stride: int
+                       ) -> _ShmBuffer:
+        b = self._buffer
+        if b and (b.width, b.height, b.stride, b.format) == (w, h, stride,
+                                                             fmt):
+            return b
+        if b is not None:
+            self._destroy_buffer(b)
+        size = stride * h
+        fd = os.memfd_create("selkies-shm") \
+            if hasattr(os, "memfd_create") else _tmp_fd(size)
+        os.ftruncate(fd, size)
+        m = mmap.mmap(fd, size)
+        pool_id = self.conn.new_id()
+        self.conn.send(self._shm_id, 0,
+                       arg_u32(pool_id) + arg_i32(size), fds=(fd,))
+        buf_id = self.conn.new_id()
+        self.conn.send(pool_id, 0, arg_u32(buf_id) + arg_i32(0)
+                       + arg_i32(w) + arg_i32(h) + arg_i32(stride)
+                       + arg_u32(fmt))
+        b = _ShmBuffer(pool_id=pool_id, buffer_id=buf_id, fd=fd, map=m,
+                       width=w, height=h, stride=stride, format=fmt)
+
+        def _on_buffer(opcode: int, r: ArgReader) -> None:
+            if opcode == 0:                              # release
+                b.busy = False
+        self.conn.handlers[buf_id] = _on_buffer
+        self._buffer = b
+        return b
+
+    def _destroy_buffer(self, b: _ShmBuffer) -> None:
+        try:
+            self.conn.send(b.buffer_id, 0)               # wl_buffer.destroy
+            self.conn.send(b.pool_id, 1)                 # wl_shm_pool.destroy
+        except (WireError, OSError):
+            pass
+        b.map.close()
+        os.close(b.fd)
+        if self._buffer is b:
+            self._buffer = None
+
+    def capture_frame(self, overlay_cursor: bool = True,
+                      timeout: float = 5.0) -> Optional[np.ndarray]:
+        """One screencopy pass -> (H, W, 3) uint8 RGB, or None when the
+        compositor reports failure (output gone, mid-modeset)."""
+        if not self.can_capture:
+            raise WireError("compositor lacks zwlr_screencopy/wl_shm")
+        c = self.conn
+        frame_id = c.new_id()
+        st = _FrameState(frame_id=frame_id)
+        self._frame = st
+        c.handlers[frame_id] = self._make_frame_handler(st)
+        c.send(self._screencopy_id, 0,
+               arg_u32(frame_id) + arg_i32(1 if overlay_cursor else 0)
+               + arg_u32(self.outputs[0].proxy))
+        deadline = time.monotonic() + timeout
+        try:
+            # phase 1: buffer parameters (wait for buffer_done on v3, or
+            # the first buffer event on older compositors)
+            while not (st.buffer_done or st.failed or st.format >= 0):
+                self._pump(deadline)
+            if st.failed:
+                c.send(frame_id, 1)                      # destroy
+                return None
+            b = self._ensure_buffer(st.format, st.width, st.height,
+                                    st.stride)
+            c.send(frame_id, 0, arg_u32(b.buffer_id))    # copy
+            while not (st.ready or st.failed):
+                self._pump(deadline)
+            c.send(frame_id, 1)                          # destroy
+        finally:
+            # every exit (failed / ready / timeout raise) releases the
+            # handler — a per-capture leak would grow for outage minutes
+            c.handlers.pop(frame_id, None)
+        if st.failed:
+            return None
+        flat = np.frombuffer(b.map, dtype=np.uint8,
+                             count=st.stride * st.height)
+        px = flat.reshape(st.height, st.stride // 4, 4)[:, :st.width, :]
+        r, g, bl = _RGB_SLICES.get(st.format, (2, 1, 0))
+        return np.stack([px[..., r], px[..., g], px[..., bl]], axis=-1)
+
+    def _make_frame_handler(self, st: _FrameState):
+        def h(opcode: int, r: ArgReader) -> None:
+            if opcode == 0:                              # buffer
+                st.format, st.width = r.u32(), r.u32()
+                st.height, st.stride = r.u32(), r.u32()
+            elif opcode == 1:                            # flags
+                r.u32()
+            elif opcode == 2:                            # ready
+                st.ready = True
+            elif opcode == 3:                            # failed
+                st.failed = True
+            elif opcode == 4:                            # damage
+                st.damage.append((r.u32(), r.u32(), r.u32(), r.u32()))
+            elif opcode == 6:                            # buffer_done (v3)
+                st.buffer_done = True
+        return h
+
+    def _pump(self, deadline: float) -> None:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            raise WireError("screencopy timed out")
+        self.conn.dispatch(timeout=left)
+
+    # ---------------------------------------------------------------- input
+    def ensure_virtual_keyboard(self, keymap_text: str) -> bool:
+        """Create (or re-keymap) the virtual keyboard. xkb_v1 keymaps ride
+        a sealed shm fd; size excludes the terminating NUL reader-side."""
+        if not (self._vkbd_mgr_id and self._seat_id):
+            return False
+        c = self.conn
+        if not self._vkbd_id:
+            self._vkbd_id = c.new_id()
+            c.send(self._vkbd_mgr_id, 0,
+                   arg_u32(self._seat_id) + arg_u32(self._vkbd_id))
+        raw = keymap_text.encode() + b"\x00"
+        fd = os.memfd_create("selkies-keymap") \
+            if hasattr(os, "memfd_create") else _tmp_fd(len(raw))
+        os.ftruncate(fd, len(raw))
+        with mmap.mmap(fd, len(raw)) as m:
+            m.write(raw)
+        c.send(self._vkbd_id, 0,
+               arg_u32(1) + arg_u32(len(raw)), fds=(fd,))   # keymap xkb_v1
+        os.close(fd)
+        return True
+
+    def keyboard_key(self, evdev_key: int, down: bool) -> None:
+        """key codes are EVDEV (xkb keycode - 8), per the protocol."""
+        if not self._vkbd_id:
+            return
+        self.conn.send(self._vkbd_id, 1,
+                       arg_u32(_ms()) + arg_u32(evdev_key)
+                       + arg_u32(1 if down else 0))
+
+    def keyboard_modifiers(self, depressed: int, latched: int = 0,
+                           locked: int = 0, group: int = 0) -> None:
+        if not self._vkbd_id:
+            return
+        self.conn.send(self._vkbd_id, 2,
+                       arg_u32(depressed) + arg_u32(latched)
+                       + arg_u32(locked) + arg_u32(group))
+
+    def ensure_virtual_pointer(self) -> bool:
+        if not self._vptr_mgr_id:
+            return False
+        if not self._vptr_id:
+            self._vptr_id = self.conn.new_id()
+            # seat is nullable (id 0 lets the compositor pick)
+            self.conn.send(self._vptr_mgr_id, 0,
+                           arg_u32(self._seat_id) + arg_u32(self._vptr_id))
+        return True
+
+    def pointer_motion_abs(self, x: int, y: int, ew: int, eh: int) -> None:
+        if self.ensure_virtual_pointer():
+            self.conn.send(self._vptr_id, 1,
+                           arg_u32(_ms()) + arg_u32(max(0, x))
+                           + arg_u32(max(0, y)) + arg_u32(ew) + arg_u32(eh))
+            self.conn.send(self._vptr_id, 4)             # frame
+
+    def pointer_motion_rel(self, dx: float, dy: float) -> None:
+        if self.ensure_virtual_pointer():
+            self.conn.send(self._vptr_id, 0,
+                           arg_u32(_ms()) + arg_fixed(dx) + arg_fixed(dy))
+            self.conn.send(self._vptr_id, 4)
+
+    def pointer_button(self, btn_code: int, down: bool) -> None:
+        if self.ensure_virtual_pointer():
+            self.conn.send(self._vptr_id, 2,
+                           arg_u32(_ms()) + arg_u32(btn_code)
+                           + arg_u32(1 if down else 0))
+            self.conn.send(self._vptr_id, 4)
+
+    def pointer_axis(self, axis: int, value: float) -> None:
+        """axis: 0 vertical, 1 horizontal; value in wl_pointer units
+        (one wheel notch ~ 15)."""
+        if self.ensure_virtual_pointer():
+            self.conn.send(self._vptr_id, 3,
+                           arg_u32(_ms()) + arg_u32(axis) + arg_fixed(value))
+            self.conn.send(self._vptr_id, 4)
+
+    # ------------------------------------------------------------- lifecycle
+    def flush_events(self) -> None:
+        """Drain pending compositor events (buffer releases etc.)."""
+        try:
+            self.conn.dispatch(timeout=0.0)
+        except WireError:
+            pass
+
+    def close(self) -> None:
+        if self._buffer is not None:
+            self._destroy_buffer(self._buffer)
+        self.conn.close()
+
+
+def _tmp_fd(size: int) -> int:
+    f = tempfile.TemporaryFile()
+    fd = os.dup(f.fileno())
+    f.close()
+    return fd
+
+
+def _ms() -> int:
+    return int(time.monotonic() * 1000) & 0xFFFFFFFF

@@ -1,0 +1,72 @@
+"""Dynamic xkb keymap for the virtual keyboard.
+
+The input plane hands us X11 KEYSYMS (the client's wire grammar,
+``kd,<keysym>``); Wayland's virtual-keyboard protocol wants evdev KEY
+CODES interpreted through an xkb keymap. Instead of carrying a static
+layout and hunting for spare keycodes (the X11 backend's approach,
+input/backends.py:115-162 — necessary there because the X server owns
+the map), we OWN the keymap here: every keysym that appears is assigned
+the next free keycode and the whole map is re-uploaded (virtual-keyboard
+allows re-keymapping at any time; compositors apply it to subsequent
+events). One level per key — shifted glyphs are distinct keysyms on
+their own keycodes, so no modifier state machine is needed for text.
+
+Keysyms are emitted as hexadecimal literals (``0x100041``), which
+xkbcommon's keysym parser accepts for any value — no name table needed.
+"""
+
+from __future__ import annotations
+
+# evdev code = xkb keycode - 8; usable xkb keycodes 9..255 leave
+# 247 simultaneous distinct keysyms, re-assignable LRU when exhausted
+_MIN_KEYCODE = 9
+_MAX_KEYCODE = 255
+
+
+class DynamicKeymap:
+    def __init__(self):
+        self._by_keysym: dict[int, int] = {}
+        self._order: list[int] = []            # keysyms, LRU first
+        self._dirty = True
+
+    def keycode_for(self, keysym: int) -> tuple[int, bool]:
+        """-> (xkb keycode, keymap_changed)."""
+        kc = self._by_keysym.get(keysym)
+        if kc is not None:
+            self._order.remove(keysym)
+            self._order.append(keysym)
+            return kc, self._consume_dirty()
+        if len(self._by_keysym) >= _MAX_KEYCODE - _MIN_KEYCODE + 1:
+            victim = self._order.pop(0)
+            kc = self._by_keysym.pop(victim)
+        else:
+            kc = _MIN_KEYCODE + len(self._by_keysym)
+        self._by_keysym[keysym] = kc
+        self._order.append(keysym)
+        self._dirty = True
+        return kc, self._consume_dirty()
+
+    def _consume_dirty(self) -> bool:
+        d, self._dirty = self._dirty, False
+        return d
+
+    def text(self) -> str:
+        codes = [f"        <K{kc}> = {kc};"
+                 for kc in sorted(self._by_keysym.values())]
+        syms = [f"        key <K{kc}> {{ [ {hex(ks)} ] }};"
+                for ks, kc in sorted(self._by_keysym.items(),
+                                     key=lambda kv: kv[1])]
+        return "\n".join([
+            "xkb_keymap {",
+            '    xkb_keycodes "selkies" {',
+            f"        minimum = {_MIN_KEYCODE - 1};",
+            f"        maximum = {_MAX_KEYCODE};",
+            *codes,
+            "    };",
+            '    xkb_types "selkies" { };',
+            '    xkb_compatibility "selkies" { };',
+            '    xkb_symbols "selkies" {',
+            *syms,
+            "    };",
+            "};",
+        ]) + "\n"

@@ -1,0 +1,269 @@
+"""Wayland wire-protocol codec + connection (client side).
+
+The reference's Wayland roles live inside the closed pixelflux wheel: it
+either composits its own headless output or attaches to an external
+compositor as a screencopy/virtual-input client (reference
+src/selkies/settings.py:615-638, stream_server.py:420-447). This package
+implements the latter role from the wire up — no libwayland, no
+python-wayland: messages are marshalled by hand and fds ride SCM_RIGHTS —
+so the capture/input planes work against any wlroots-style compositor
+(labwc, sway --headless, ...) and are testable against the in-tree fake
+compositor (tests/test_wayland.py).
+
+Wire format (stable since Wayland 1.0):
+
+    message := header payload
+    header  := object_id:u32  (size<<16 | opcode):u32      # LE, size incl hdr
+    args    := i32 | u32 | fixed(24.8) | string (u32 len incl NUL, pad 4)
+               | object (u32 id) | new_id (u32 id) | array (u32 len, pad 4)
+               | fd (no bytes in payload; one fd in the ancillary queue)
+
+Client object IDs allocate upward from 2 (1 is wl_display); IDs freed by
+``wl_display.delete_id`` are recycled.
+"""
+
+from __future__ import annotations
+
+import array
+import os
+import socket
+import struct
+import threading
+from typing import Callable, Optional
+
+MAX_FDS_PER_RECV = 28
+
+
+class WireError(RuntimeError):
+    pass
+
+
+# ----------------------------------------------------------------- marshal
+def arg_u32(v: int) -> bytes:
+    return struct.pack("<I", v & 0xFFFFFFFF)
+
+
+def arg_i32(v: int) -> bytes:
+    return struct.pack("<i", v)
+
+
+def arg_fixed(v: float) -> bytes:
+    """Wayland 'fixed' is signed 24.8."""
+    return struct.pack("<i", int(round(v * 256.0)))
+
+
+def arg_string(s: str) -> bytes:
+    raw = s.encode() + b"\x00"
+    pad = (-len(raw)) % 4
+    return struct.pack("<I", len(raw)) + raw + b"\x00" * pad
+
+
+def arg_array(b: bytes) -> bytes:
+    pad = (-len(b)) % 4
+    return struct.pack("<I", len(b)) + b + b"\x00" * pad
+
+
+class ArgReader:
+    """Sequential unmarshal of one event's payload; fds pop from the
+    connection-level ancillary queue in arrival order (the protocol
+    guarantees fd args are queued in message order)."""
+
+    def __init__(self, payload: bytes, fd_pop: Callable[[], int]):
+        self.b = payload
+        self.off = 0
+        self._fd_pop = fd_pop
+
+    def u32(self) -> int:
+        v, = struct.unpack_from("<I", self.b, self.off)
+        self.off += 4
+        return v
+
+    def i32(self) -> int:
+        v, = struct.unpack_from("<i", self.b, self.off)
+        self.off += 4
+        return v
+
+    def fixed(self) -> float:
+        return self.i32() / 256.0
+
+    def string(self) -> str:
+        n = self.u32()
+        raw = self.b[self.off:self.off + n]
+        self.off += n + ((-n) % 4)
+        return raw.split(b"\x00", 1)[0].decode()
+
+    def array(self) -> bytes:
+        n = self.u32()
+        raw = self.b[self.off:self.off + n]
+        self.off += n + ((-n) % 4)
+        return bytes(raw)
+
+    def fd(self) -> int:
+        return self._fd_pop()
+
+
+# -------------------------------------------------------------- connection
+class WaylandConnection:
+    """One client connection: socket IO, object-id allocation, event
+    dispatch. Thread-safety: sends are locked; dispatch runs on whichever
+    thread calls :meth:`dispatch`/:meth:`roundtrip` (one at a time)."""
+
+    DISPLAY_ID = 1
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.sock.setblocking(True)
+        self._send_lock = threading.Lock()
+        self._next_id = 2
+        self._free_ids: list[int] = []
+        self._rbuf = b""
+        self._fds: list[int] = []
+        #: object_id -> handler(opcode, ArgReader); unhandled events are
+        #: legal (a client may ignore any event)
+        self.handlers: dict[int, Callable[[int, ArgReader], None]] = {
+            self.DISPLAY_ID: self._on_display_event,
+        }
+        self.dead: Optional[str] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    @classmethod
+    def connect(cls, display: Optional[str] = None) -> "WaylandConnection":
+        name = display or os.environ.get("WAYLAND_DISPLAY", "wayland-0")
+        if not name.startswith("/"):
+            run = os.environ.get("XDG_RUNTIME_DIR")
+            if not run:
+                raise WireError("XDG_RUNTIME_DIR unset; no Wayland socket")
+            name = os.path.join(run, name)
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            s.connect(name)
+        except OSError as e:
+            s.close()
+            raise WireError(f"cannot connect to compositor at {name}: {e}")
+        return cls(s)
+
+    def close(self) -> None:
+        for fd in self._fds:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._fds.clear()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # -- ids ----------------------------------------------------------------
+    def new_id(self) -> int:
+        if self._free_ids:
+            return self._free_ids.pop()
+        nid = self._next_id
+        self._next_id += 1
+        return nid
+
+    # -- send ---------------------------------------------------------------
+    def send(self, obj_id: int, opcode: int, payload: bytes = b"",
+             fds: tuple[int, ...] = ()) -> None:
+        size = 8 + len(payload)
+        if size > 0xFFFF:
+            raise WireError(f"message too large ({size})")
+        msg = struct.pack("<II", obj_id, (size << 16) | opcode) + payload
+        with self._send_lock:
+            if fds:
+                anc = [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
+                        array.array("i", fds).tobytes())]
+                self.sock.sendmsg([msg], anc)
+            else:
+                self.sock.sendall(msg)
+
+    # -- receive / dispatch -------------------------------------------------
+    def _pop_fd(self) -> int:
+        if not self._fds:
+            raise WireError("event consumed an fd but none arrived")
+        return self._fds.pop(0)
+
+    def _fill(self, timeout: Optional[float]) -> bool:
+        """Read once from the socket (with ancillary fds); False on
+        timeout, raises on EOF."""
+        self.sock.settimeout(timeout)
+        try:
+            data, anc, _flags, _addr = self.sock.recvmsg(
+                65536, socket.CMSG_SPACE(MAX_FDS_PER_RECV * 4))
+        except (socket.timeout, BlockingIOError):
+            return False
+        finally:
+            self.sock.settimeout(None)
+        if not data:
+            raise WireError("compositor closed the connection"
+                            + (f" (error: {self.dead})" if self.dead else ""))
+        for level, ctype, cdata in anc:
+            if level == socket.SOL_SOCKET and ctype == socket.SCM_RIGHTS:
+                n = len(cdata) // 4
+                self._fds.extend(array.array("i", cdata[:n * 4]).tolist())
+        self._rbuf += data
+        return True
+
+    def dispatch(self, timeout: Optional[float] = None) -> int:
+        """Dispatch every buffered event, reading once if the buffer is
+        empty. Returns events dispatched."""
+        n = 0
+        if len(self._rbuf) < 8:
+            if not self._fill(timeout):
+                return 0
+        while len(self._rbuf) >= 8:
+            obj_id, sz_op = struct.unpack_from("<II", self._rbuf)
+            size, opcode = sz_op >> 16, sz_op & 0xFFFF
+            if size < 8:
+                raise WireError(f"bad message size {size}")
+            if len(self._rbuf) < size:
+                if not self._fill(timeout):
+                    break
+                continue
+            payload = self._rbuf[8:size]
+            self._rbuf = self._rbuf[size:]
+            handler = self.handlers.get(obj_id)
+            if handler is not None:
+                handler(opcode, ArgReader(payload, self._pop_fd))
+            n += 1
+        return n
+
+    def roundtrip(self, timeout: float = 5.0) -> None:
+        """wl_display.sync barrier: the compositor has processed every
+        prior request once the callback fires."""
+        done = threading.Event()
+        cb_id = self.new_id()
+
+        def _cb(opcode: int, r: ArgReader) -> None:
+            if opcode == 0:                         # wl_callback.done
+                done.set()
+                self.handlers.pop(cb_id, None)
+                # the id is recycled by wl_display.delete_id, NOT here —
+                # freeing twice would hand one id to two live objects
+
+        self.handlers[cb_id] = _cb
+        self.send(self.DISPLAY_ID, 0, arg_u32(cb_id))      # sync
+        deadline = _now() + timeout
+        while not done.is_set():
+            left = deadline - _now()
+            if left <= 0:
+                raise WireError("roundtrip timed out")
+            self.dispatch(timeout=left)
+            if self.dead:
+                raise WireError(f"compositor error: {self.dead}")
+
+    # -- wl_display events --------------------------------------------------
+    def _on_display_event(self, opcode: int, r: ArgReader) -> None:
+        if opcode == 0:                              # error
+            oid, code, msg = r.u32(), r.u32(), r.string()
+            self.dead = f"object {oid} code {code}: {msg}"
+            raise WireError(f"compositor error: {self.dead}")
+        elif opcode == 1:                            # delete_id
+            did = r.u32()
+            self.handlers.pop(did, None)
+            self._free_ids.append(did)
+
+
+def _now() -> float:
+    import time
+    return time.monotonic()

@@ -1,15 +1,21 @@
-/* Selkies-TPU WebSockets client.
+/* Selkies-TPU web client: WS transport + WebRTC transport.
  *
- * Fresh implementation of the reference client's WS protocol surface
+ * Fresh implementation of the reference client's protocol surface
  * (reference addons/selkies-web-core/selkies-ws-core.js:4255-4460 binary
- * demux, lib/input.js keysym capture; SURVEY.md §2.3):
+ * demux, selkies-wr-core.js + lib/signaling.js RTC path, lib/input.js
+ * keysym capture; SURVEY.md §2.3):
  *
- *   server -> client binary: 0x01 audio (+RED), 0x03 JPEG stripe,
- *                            0x04 H.264 stripe, 0x05 gzip'd control text
- *   client -> server binary: 0x02 mic PCM, 0x05 gzip'd control text
- *   text verbs: kd/ku/kr/kh, m/m2/mb/ms/p, r, s, vb/ab, SETTINGS,
+ *   WS:  server -> client binary: 0x01 audio (+RED), 0x03 JPEG stripe,
+ *                                 0x04 H.264 stripe, 0x05 gzip'd control
+ *        client -> server binary: 0x02 mic PCM, 0x05 gzip'd control text
+ *        text verbs: kd/ku/kr/kh, m/m2/mb/ms/p, r, s, vb/ab, SETTINGS,
  *               CLIENT_FRAME_ACK, START/STOP_VIDEO, START/STOP_AUDIO,
- *               REQUEST_KEYFRAME, _gz, _f/_l, cw*/
+ *               REQUEST_KEYFRAME, _gz, _f/_l, cw*
+ *   RTC: /api/signaling WS (HELLO / SESSION / JSON SDP relay, reference
+ *        signaling_server.py protocol) -> RTCPeerConnection answering the
+ *        server's ICE-lite offer; media arrives as real tracks on a
+ *        <video> sink; input rides an ordered "input" data channel
+ *        speaking the SAME text-verb grammar as the WS transport. */
 
 "use strict";
 
@@ -81,6 +87,11 @@ class SelkiesClient {
     this.reconnectDelay = 500;
     this.statusMsg = "connecting…";
     this.killed = false;
+    this.rtcMode = false;             // true once the RTC transport owns IO
+    this.pc = null;                   // RTCPeerConnection
+    this.dc = null;                   // "input" data channel
+    this.sigWs = null;                // signaling WebSocket
+    this.videoEl = null;              // RTC <video> sink
 
     this._bindInput();
     this._bindResize();
@@ -89,6 +100,18 @@ class SelkiesClient {
   }
 
   /* ------------------------------------------------------------ transport */
+  async start() {
+    // pick the transport the server is actually running (/api/status.mode)
+    let mode = "websockets";
+    try {
+      const r = await fetch("/api/status", { credentials: "same-origin" });
+      if (r.ok) mode = (await r.json()).mode || mode;
+    } catch (_e) { /* status unreachable: default to WS */ }
+    if (mode === "webrtc" && typeof RTCPeerConnection !== "undefined")
+      this.connectRTC();
+    else this.connect();
+  }
+
   connect() {
     const proto = location.protocol === "https:" ? "wss:" : "ws:";
     const url = `${proto}//${location.host}/api/websockets`;
@@ -119,7 +142,133 @@ class SelkiesClient {
   }
 
   send(text) {
+    if (this.rtcMode) {
+      if (this.dc && this.dc.readyState === "open") this.dc.send(text);
+      return;
+    }
     if (this.ws && this.ws.readyState === WebSocket.OPEN) this.ws.send(text);
+  }
+
+  /* --------------------------------------------------------- RTC transport
+   * Signaling protocol (server signaling.py): HELLO client {meta} ->
+   * "SESSION server" -> SESSION_OK <uid> -> the server peer sends
+   * {"sdp":{"type":"offer",...}}; we answer. Media flows on ICE-lite host
+   * candidates; the browser opens the "input" data channel (DCEP). */
+  connectRTC() {
+    this.rtcMode = true;
+    const proto = location.protocol === "https:" ? "wss:" : "ws:";
+    const url = `${proto}//${location.host}/api/signaling`;
+    this.status(`rtc signaling: ${url}`);
+    const ws = new WebSocket(url);
+    this.sigWs = ws;
+    const params = new URLSearchParams(location.search);
+    ws.onopen = () => {
+      this.reconnectDelay = 500;
+      ws.send("HELLO client " + JSON.stringify({
+        client_type: params.get("view_only") ? "viewer" : "controller",
+        display_id: params.get("display") || "primary",
+      }));
+    };
+    ws.onmessage = (ev) => this._onSignal(String(ev.data));
+    ws.onclose = () => {
+      if (this.killed) return;
+      this._rtcTeardown();
+      this.status(`signaling lost — retrying in ${this.reconnectDelay} ms`, true);
+      setTimeout(() => this.connectRTC(), this.reconnectDelay);
+      this.reconnectDelay = Math.min(this.reconnectDelay * 2, 10000);
+    };
+  }
+
+  async _onSignal(text) {
+    if (text === "HELLO") { this.sigWs.send("SESSION server"); return; }
+    if (text.startsWith("SESSION_OK")) { this.status("rtc: waiting for offer"); return; }
+    if (text.startsWith("SESSION_END")) { this._rtcTeardown(); return; }
+    if (text.startsWith("ERROR")) { this.status(`rtc: ${text}`, true); return; }
+    let msg;
+    try { msg = JSON.parse(text); } catch { return; }
+    if (msg.sdp && msg.sdp.type === "offer") await this._onRtcOffer(msg.sdp);
+  }
+
+  async _onRtcOffer(offer) {
+    this._rtcTeardown();
+    let iceServers = [];
+    try {
+      const r = await fetch("/api/turn", { credentials: "same-origin" });
+      if (r.ok) iceServers = (await r.json()).iceServers || [];
+    } catch (_e) { /* host-candidate-only is fine on a LAN */ }
+    const pc = new RTCPeerConnection({ iceServers });
+    this.pc = pc;
+    pc.ontrack = (e) => {
+      if (e.track.kind === "video") this._attachRtcVideo(e.streams[0] ||
+        new MediaStream([e.track]));
+      else if (this.videoEl) this.videoEl.muted = false;
+    };
+    pc.onconnectionstatechange = () => {
+      if (pc.connectionState === "connected")
+        this.status("webrtc connected");
+      else if (pc.connectionState === "failed") {
+        this.status("webrtc failed — renegotiating", true);
+        try { this.sigWs.send("SESSION_END"); } catch (_e) { /* gone */ }
+        this._rtcTeardown();
+        setTimeout(() => { try { this.sigWs.send("SESSION server"); } catch (_e) { /* retried on reconnect */ } }, 1000);
+      }
+    };
+    const dc = pc.createDataChannel("input", { ordered: true });
+    this.dc = dc;
+    dc.onopen = () => {
+      this.status("webrtc connected · input channel open");
+      this._sendPreferredSize();
+    };
+    dc.onmessage = (ev) => {
+      if (typeof ev.data === "string") this._onText(ev.data);
+    };
+    await pc.setRemoteDescription(offer);
+    const answer = await pc.createAnswer();
+    await pc.setLocalDescription(answer);
+    // ICE-lite server: no trickle needed; ship the answer as-is (the
+    // browser probes the offer's host candidate directly)
+    this.sigWs.send(JSON.stringify({ sdp: {
+      type: answer.type, sdp: pc.localDescription.sdp } }));
+  }
+
+  _attachRtcVideo(stream) {
+    if (!this.videoEl) {
+      const v = document.createElement("video");
+      v.autoplay = true; v.playsInline = true; v.muted = true;
+      v.style.cssText =
+        "max-width:100%;max-height:100%;background:#000;outline:none";
+      // canvas stays on top (transparent, input-capturing); video below
+      this.canvas.parentElement.insertBefore(v, this.canvas);
+      this.canvas.style.position = "absolute";
+      this.canvas.style.background = "transparent";
+      this.videoEl = v;
+      v.addEventListener("resize", () => this._syncRtcCanvas());
+    }
+    this.videoEl.srcObject = stream;
+    this.videoEl.play().catch(() => { /* needs a user gesture; autoplay muted */ });
+    this._syncRtcCanvas();
+  }
+
+  /* size the input-capturing canvas exactly over the displayed video and
+   * keep canvas.width/height at the STREAM size so _bindInput's coordinate
+   * scaling holds for both transports */
+  _syncRtcCanvas() {
+    const v = this.videoEl;
+    if (!v || !v.videoWidth) return;
+    this.displayW = v.videoWidth; this.displayH = v.videoHeight;
+    this.canvas.width = v.videoWidth; this.canvas.height = v.videoHeight;
+    const r = v.getBoundingClientRect();
+    this.canvas.style.left = `${r.left}px`;
+    this.canvas.style.top = `${r.top}px`;
+    this.canvas.style.width = `${r.width}px`;
+    this.canvas.style.height = `${r.height}px`;
+    document.title = `Selkies TPU — ${v.videoWidth}x${v.videoHeight}`;
+  }
+
+  _rtcTeardown() {
+    if (this.dc) { try { this.dc.close(); } catch (_e) { /* closed */ } this.dc = null; }
+    if (this.pc) { try { this.pc.close(); } catch (_e) { /* closed */ } this.pc = null; }
+    if (this.videoEl) this.videoEl.srcObject = null;
   }
 
   async sendMaybeGz(text) {
@@ -687,6 +836,8 @@ class SelkiesClient {
   _bindResize() {
     let timer = null;
     window.addEventListener("resize", () => {
+      if (this.rtcMode)                         // keep the overlay aligned
+        requestAnimationFrame(() => this._syncRtcCanvas());
       clearTimeout(timer);
       timer = setTimeout(() => this._sendPreferredSize(), 500);
     });
@@ -694,7 +845,9 @@ class SelkiesClient {
 
   _sendPreferredSize() {
     const s = this.serverSettings;
-    if (!s || !s.features || !s.features.resize) return;
+    // RTC mode gets no server_settings push; the server gates 'r' on its
+    // own enable_resize setting, so always offer the preferred size there
+    if (!this.rtcMode && (!s || !s.features || !s.features.resize)) return;
     const dpr = window.devicePixelRatio || 1;
     const w = Math.round(window.innerWidth * dpr / 2) * 2;
     const h = Math.round(window.innerHeight * dpr / 2) * 2;
@@ -835,5 +988,5 @@ const client = new SelkiesClient(canvas, document.getElementById("status"));
 badge.addEventListener("click", () => hud.classList.toggle("hidden"));
 hud.classList.remove("hidden");
 canvas.focus();
-client.connect();
+client.start();            // picks WS or WebRTC from /api/status
 window.selkies = client;   // console / dashboard access

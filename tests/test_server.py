@@ -601,7 +601,10 @@ def test_client_js_delimiters_balanced():
     assert not stack, f"unclosed {stack[-1]!r}"
     # the new client features must be present
     for needle in ("js,c,", "js,b,", "js,a,", "getGamepads",
-                   "X-Upload-Name", "touchstart"):
+                   "X-Upload-Name", "touchstart",
+                   # RTC transport path (server ICE-lite offer -> answer)
+                   "RTCPeerConnection", "HELLO client", "SESSION server",
+                   "createDataChannel", "setRemoteDescription"):
         assert needle in (pathlib.Path(__file__).parent.parent /
                           "selkies_tpu" / "web" /
                           "selkies-client.js").read_text(), needle

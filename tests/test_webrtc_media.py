@@ -569,3 +569,62 @@ def test_rtcp_remb_parse():
     got = parse_rtcp_remb(pkt)
     assert got is not None and abs(got - target) / target < 0.01
     assert parse_rtcp_remb(struct.pack("!BBHII", 0x81, 206, 2, 1, 2)) is None
+
+
+# ------------------------------------------------- data-channel control verbs
+
+
+async def test_datachannel_control_verbs():
+    """REQUEST_KEYFRAME / vb / r are service-level controls (the WS
+    transport's _h_keyframe/_h_video_bitrate/_h_resize equivalents); input
+    verbs forward to the shared input handler."""
+    from selkies_tpu.server.webrtc_service import WebRTCService
+    from selkies_tpu.settings import AppSettings
+
+    s = AppSettings.parse([], {})
+    s.set_server("video_bitrate_kbps", 8000)
+
+    class FakeCapture:
+        def __init__(self):
+            self.idr_requests = 0
+            self.bitrates = []
+            self.regions = []
+
+        def is_capturing(self):
+            return True
+
+        def request_idr_frame(self):
+            self.idr_requests += 1
+
+        def update_video_bitrate(self, kbps):
+            self.bitrates.append(kbps)
+
+        def update_capture_region(self, x, y, w, h):
+            self.regions.append((x, y, w, h))
+
+    class FakeInput:
+        def __init__(self):
+            self.msgs = []
+
+        async def on_message(self, text):
+            self.msgs.append(text)
+
+    svc = WebRTCService(s, input_handler=FakeInput())
+    svc._loop = asyncio.get_running_loop()
+    cap = FakeCapture()
+    svc._capture = cap
+
+    svc._on_input_verb("input", "REQUEST_KEYFRAME")
+    svc._on_input_verb("input", "vb,3000")
+    svc._on_input_verb("input", "vb,999999")     # ceiling-capped
+    svc._on_input_verb("input", "vb,junk")       # ignored
+    svc._on_input_verb("input", "r,800x600")
+    svc._on_input_verb("input", "r,nonsense")    # ignored
+    svc._on_input_verb("input", "kd,65")
+    for _ in range(5):
+        await asyncio.sleep(0.05)
+    assert cap.idr_requests == 1
+    assert cap.bitrates == [3000, 8000]
+    assert cap.regions == [(0, 0, 800, 600)]
+    assert (s.initial_width, s.initial_height) == (800, 600)
+    assert svc.input_handler.msgs == ["kd,65"]
