@@ -1,0 +1,8 @@
+#!/bin/sh
+# Example-container entrypoint (reference addons/example/entrypoint.sh
+# role): machine id + dbus for Xfce, then the supervised process tree.
+set -e
+[ -f /etc/machine-id ] || dbus-uuidgen > /etc/machine-id
+mkdir -p /var/run/dbus
+dbus-daemon --system --fork 2>/dev/null || true
+exec supervisord -c /etc/supervisor/supervisord.conf

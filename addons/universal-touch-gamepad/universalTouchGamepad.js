@@ -1,0 +1,224 @@
+/* Universal touch gamepad: an on-screen controller overlay that injects a
+ * virtual standard-mapping gamepad into navigator.getGamepads(), so ANY
+ * page polling the Gamepad API (the selkies client's gamepad plane
+ * included) sees it as a real pad. Fresh implementation of the role the
+ * reference addon plays (reference addons/universal-touch-gamepad/
+ * universalTouchGamepad.js; docs/component.md:159-161).
+ *
+ * Usage: <script src="universalTouchGamepad.js"></script> then
+ *   window.universalTouchGamepad.enable()  / .disable() / .toggle()
+ * or append ?touchGamepad=1 to the page URL to auto-enable.
+ *
+ * Layout (standard mapping indices): left stick (axes 0/1), right
+ * cluster A/B/X/Y (0/1/2/3), dpad (12-15), select/start (8/9),
+ * shoulders L1/R1 (4/5) and triggers L2/R2 (6/7 as digital buttons).
+ * No dependencies; DOM + pointer events only. */
+
+"use strict";
+
+(function () {
+  const PAD_ID = "Universal Touch Gamepad (selkies-tpu)";
+  const N_BUTTONS = 17;
+  const N_AXES = 4;
+
+  /* ------------------------------------------------------------ state */
+  const state = {
+    connected: false,
+    timestamp: 0,
+    axes: new Array(N_AXES).fill(0.0),
+    buttons: Array.from({ length: N_BUTTONS },
+      () => ({ pressed: false, touched: false, value: 0.0 })),
+  };
+
+  // the object handed out of getGamepads(); recreated on change so
+  // pollers comparing .timestamp see updates
+  function snapshot() {
+    return {
+      id: PAD_ID,
+      index: 3,                 // slot 3: never shadows a physical pad 0-2
+      connected: true,
+      mapping: "standard",
+      timestamp: state.timestamp,
+      axes: state.axes.slice(),
+      buttons: state.buttons.map(b => ({
+        pressed: b.pressed, touched: b.touched, value: b.value,
+      })),
+      vibrationActuator: null,
+    };
+  }
+
+  const origGetGamepads = navigator.getGamepads
+    ? navigator.getGamepads.bind(navigator) : () => [];
+  let enabled = false;
+
+  navigator.getGamepads = function () {
+    const pads = Array.from(origGetGamepads() || []);
+    if (enabled) {
+      while (pads.length < 4) pads.push(null);
+      pads[3] = snapshot();
+    }
+    return pads;
+  };
+
+  function touch() { state.timestamp = performance.now(); }
+
+  function setButton(i, down, value) {
+    const b = state.buttons[i];
+    const v = value !== undefined ? value : (down ? 1.0 : 0.0);
+    if (b.pressed !== down || b.value !== v) {
+      b.pressed = down; b.touched = down; b.value = v;
+      touch();
+    }
+  }
+
+  function setAxis(i, v) {
+    const c = Math.max(-1, Math.min(1, v));
+    if (state.axes[i] !== c) { state.axes[i] = c; touch(); }
+  }
+
+  /* --------------------------------------------------------------- UI */
+  const CSS = `
+  #utg-root { position: fixed; inset: 0; z-index: 2147483000;
+    pointer-events: none; user-select: none; -webkit-user-select: none;
+    touch-action: none; font: 600 13px system-ui, sans-serif; }
+  #utg-root .utg-el { position: absolute; pointer-events: auto;
+    display: flex; align-items: center; justify-content: center;
+    background: rgba(28, 34, 42, .55); color: #cfe3d8;
+    border: 1px solid rgba(127, 209, 168, .5); border-radius: 50%;
+    backdrop-filter: blur(2px); }
+  #utg-root .utg-el.utg-on { background: rgba(127, 209, 168, .45); }
+  #utg-root .utg-pill { border-radius: 10px; }
+  #utg-root .utg-stick { border-radius: 50%; }
+  #utg-root .utg-nub { position: absolute; width: 44%; height: 44%;
+    border-radius: 50%; background: rgba(127, 209, 168, .6);
+    left: 28%; top: 28%; }`;
+
+  // geometry: {id, type: 'btn'|'stick', index(.es), label, css}
+  const LAYOUT = [
+    { id: "lstick", type: "stick", axes: [0, 1],
+      css: "left:24px;bottom:70px;width:120px;height:120px" },
+    { id: "a", type: "btn", index: 0, label: "A",
+      css: "right:36px;bottom:64px;width:58px;height:58px" },
+    { id: "b", type: "btn", index: 1, label: "B",
+      css: "right:100px;bottom:28px;width:58px;height:58px" },
+    { id: "x", type: "btn", index: 2, label: "X",
+      css: "right:100px;bottom:104px;width:58px;height:58px" },
+    { id: "y", type: "btn", index: 3, label: "Y",
+      css: "right:164px;bottom:64px;width:58px;height:58px" },
+    { id: "up", type: "btn", index: 12, label: "▲",
+      css: "left:170px;bottom:150px;width:46px;height:46px" },
+    { id: "down", type: "btn", index: 13, label: "▼",
+      css: "left:170px;bottom:58px;width:46px;height:46px" },
+    { id: "left", type: "btn", index: 14, label: "◀",
+      css: "left:124px;bottom:104px;width:46px;height:46px" },
+    { id: "right", type: "btn", index: 15, label: "▶",
+      css: "left:216px;bottom:104px;width:46px;height:46px" },
+    { id: "select", type: "btn", index: 8, label: "SEL", pill: true,
+      css: "left:calc(50% - 72px);bottom:24px;width:60px;height:28px" },
+    { id: "start", type: "btn", index: 9, label: "START", pill: true,
+      css: "left:calc(50% + 12px);bottom:24px;width:60px;height:28px" },
+    { id: "l1", type: "btn", index: 4, label: "L1", pill: true,
+      css: "left:24px;top:24px;width:64px;height:34px" },
+    { id: "l2", type: "btn", index: 6, label: "L2", pill: true,
+      css: "left:96px;top:24px;width:64px;height:34px" },
+    { id: "r1", type: "btn", index: 5, label: "R1", pill: true,
+      css: "right:24px;top:24px;width:64px;height:34px" },
+    { id: "r2", type: "btn", index: 7, label: "R2", pill: true,
+      css: "right:96px;top:24px;width:64px;height:34px" },
+  ];
+
+  let root = null;
+
+  function buildUi() {
+    root = document.createElement("div");
+    root.id = "utg-root";
+    const style = document.createElement("style");
+    style.textContent = CSS;
+    root.appendChild(style);
+    for (const el of LAYOUT) {
+      const d = document.createElement("div");
+      d.className = "utg-el" + (el.pill ? " utg-pill" : "")
+        + (el.type === "stick" ? " utg-stick" : "");
+      d.style.cssText += el.css;
+      if (el.type === "btn") {
+        d.textContent = el.label;
+        const down = (ev) => { ev.preventDefault();
+          d.classList.add("utg-on"); setButton(el.index, true); };
+        const up = (ev) => { ev.preventDefault();
+          d.classList.remove("utg-on"); setButton(el.index, false); };
+        d.addEventListener("pointerdown", down);
+        d.addEventListener("pointerup", up);
+        d.addEventListener("pointercancel", up);
+        d.addEventListener("pointerleave", (ev) => {
+          if (state.buttons[el.index].pressed) up(ev);
+        });
+      } else {
+        const nub = document.createElement("div");
+        nub.className = "utg-nub";
+        d.appendChild(nub);
+        let pid = null;
+        const move = (ev) => {
+          const r = d.getBoundingClientRect();
+          const cx = r.left + r.width / 2, cy = r.top + r.height / 2;
+          let dx = (ev.clientX - cx) / (r.width / 2);
+          let dy = (ev.clientY - cy) / (r.height / 2);
+          const m = Math.hypot(dx, dy);
+          if (m > 1) { dx /= m; dy /= m; }
+          setAxis(el.axes[0], dx); setAxis(el.axes[1], dy);
+          nub.style.left = `${28 + dx * 28}%`;
+          nub.style.top = `${28 + dy * 28}%`;
+        };
+        d.addEventListener("pointerdown", (ev) => {
+          ev.preventDefault(); pid = ev.pointerId;
+          d.setPointerCapture(pid); move(ev);
+        });
+        d.addEventListener("pointermove", (ev) => {
+          if (pid === ev.pointerId) move(ev);
+        });
+        const end = (ev) => {
+          if (pid !== ev.pointerId) return;
+          pid = null;
+          setAxis(el.axes[0], 0); setAxis(el.axes[1], 0);
+          nub.style.left = "28%"; nub.style.top = "28%";
+        };
+        d.addEventListener("pointerup", end);
+        d.addEventListener("pointercancel", end);
+      }
+      root.appendChild(d);
+    }
+    document.body.appendChild(root);
+  }
+
+  /* ----------------------------------------------------------- control */
+  function enable() {
+    if (enabled) return;
+    enabled = true;
+    if (!root) buildUi();
+    root.style.display = "";
+    state.timestamp = performance.now();
+    window.dispatchEvent(new Event("gamepadconnected"));
+  }
+
+  function disable() {
+    if (!enabled) return;
+    enabled = false;
+    if (root) root.style.display = "none";
+    state.axes.fill(0);
+    state.buttons.forEach(b => {
+      b.pressed = false; b.touched = false; b.value = 0;
+    });
+    window.dispatchEvent(new Event("gamepaddisconnected"));
+  }
+
+  window.universalTouchGamepad = {
+    enable, disable,
+    toggle() { enabled ? disable() : enable(); },
+    get enabled() { return enabled; },
+    _state: state,             // test hook
+  };
+
+  if (new URLSearchParams(location.search).get("touchGamepad")) {
+    if (document.body) enable();
+    else document.addEventListener("DOMContentLoaded", enable);
+  }
+})();

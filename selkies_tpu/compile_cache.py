@@ -1,0 +1,28 @@
+"""Shared JAX persistent-compile-cache setup.
+
+The 1080p H.264 device program costs minutes to build over the TPU
+tunnel; every entry point that compiles it (bench, profiler, server)
+points JAX at one repo-local cache so only the first run pays."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable(jax_module=None) -> str:
+    """Configure the persistent compilation cache; returns the dir used.
+    Safe to call any time (before or after backend init)."""
+    if jax_module is None:
+        import jax as jax_module
+    cache = os.environ.get(
+        "JAX_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     os.pardir, ".jax_cache"))
+    cache = os.path.abspath(cache)
+    try:
+        jax_module.config.update("jax_compilation_cache_dir", cache)
+        jax_module.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:
+        pass
+    return cache

@@ -334,27 +334,38 @@ class WaylandBackend:
             env["WAYLAND_DISPLAY"] = self._display
         return env
 
+    # clipboard verbs arrive on the EVENT LOOP thread: both directions
+    # must return instantly — wl-copy/wl-paste run on daemon threads and
+    # only refresh the in-process cache
     def set_clipboard(self, data, mime):
         self._clip = (data, mime)
-        if mime.startswith("text"):
+        if not mime.startswith("text"):
+            return
+
+        def _push():
             try:
                 import subprocess
                 subprocess.run(["wl-copy"], input=data, timeout=2,
                                check=False, env=self._wl_env())
             except (OSError, subprocess.TimeoutExpired):
                 pass
+        threading.Thread(target=_push, daemon=True,
+                         name="wl-copy").start()
 
     def get_clipboard(self):
-        try:
-            import subprocess
-            r = subprocess.run(["wl-paste", "--no-newline"],
-                               capture_output=True, timeout=2,
-                               env=self._wl_env())
-            if r.returncode == 0 and r.stdout:
-                return (r.stdout, "text/plain")
-        except (OSError, subprocess.TimeoutExpired):
-            pass
-        return self._clip
+        def _pull():
+            try:
+                import subprocess
+                r = subprocess.run(["wl-paste", "--no-newline"],
+                                   capture_output=True, timeout=2,
+                                   env=self._wl_env())
+                if r.returncode == 0 and r.stdout:
+                    self._clip = (r.stdout, "text/plain")
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+        threading.Thread(target=_pull, daemon=True,
+                         name="wl-paste").start()
+        return self._clip         # current cache; the pull lands next read
 
     def close(self):
         self._wl.close()

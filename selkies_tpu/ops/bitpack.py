@@ -131,6 +131,77 @@ def pack_slot_events(payload: jnp.ndarray, nbits: jnp.ndarray,
     return PackedStream(word, total_bits, n_events, overflow)
 
 
+def pack_slot_events_scatter(payload: jnp.ndarray, nbits: jnp.ndarray,
+                             e_cap: int, w_cap: int,
+                             max_events_per_word: int = MAX_EVENTS_PER_WORD
+                             ) -> PackedStream:
+    """Same contract as :func:`pack_slot_events`, built for the TPU's
+    op-cost profile.
+
+    The gather formulation above pays for (a) an argsort front-pack over
+    every SLOT (a 105k-key bitonic sort per 1080p MB row) and (b)
+    ``max_events_per_word`` gather rounds per output word (33 for CAVLC's
+    1-bit codes) — the two op classes XLA:TPU executes worst. Here the
+    whole pack is two scatter-adds:
+
+    - stream offsets are still one exclusive cumsum over the slots;
+    - every slot's codeword overlaps at most 2 output words; its aligned
+      contribution to each is computed in place (no compaction, inactive
+      slots contribute 0 bits);
+    - different events occupy DISJOINT bit ranges of a word, so
+      scatter-ADD is exactly bitwise-OR — ``words.at[w].add(contrib)``.
+
+    No sort, no front-pack, no per-word event search; the slot arrays are
+    read once. Bit-exact with pack_slot_events (tests/test_device_entropy,
+    test_h264_device run both)."""
+    m, s = payload.shape
+    nbits = nbits.astype(jnp.int32)
+    active = nbits > 0
+
+    block_bits = jnp.sum(nbits, axis=1)                    # (M,)
+    block_start_bits = jnp.cumsum(block_bits) - block_bits
+    off = (jnp.cumsum(nbits, axis=1) - nbits) \
+        + block_start_bits[:, None]                        # (M, S) global
+    total_bits = jnp.sum(block_bits).astype(jnp.int32)
+    n_events = jnp.sum(active.astype(jnp.int32)).astype(jnp.int32)
+
+    pay = jnp.where(active, payload, 0).astype(jnp.uint32)
+    w0 = (off >> 5).astype(jnp.int32)
+    rel = (off & 31).astype(jnp.int32)
+    end_rel = rel + nbits
+    sh = 32 - end_rel
+    # word w0: left-shift when the event fits, right-shift for the head
+    # of a straddling event; word w0+1 gets the spilled tail
+    hi = jnp.where(sh >= 0,
+                   jnp.left_shift(pay, jnp.clip(sh, 0, 31)
+                                  .astype(jnp.uint32)),
+                   jnp.right_shift(pay, jnp.clip(-sh, 0, 31)
+                                   .astype(jnp.uint32)))
+    hi = jnp.where(active, hi, 0)
+    lo = jnp.where((sh < 0) & active,
+                   jnp.left_shift(pay, jnp.clip(32 + sh, 0, 31)
+                                  .astype(jnp.uint32)),
+                   0)
+    # inactive/overflowing slots scatter out of range -> dropped
+    w0_t = jnp.where(active, w0, w_cap).reshape(-1)
+    w1_t = jnp.where(active & (sh < 0), w0 + 1, w_cap).reshape(-1)
+    words = jnp.zeros((w_cap,), jnp.uint32)
+    words = words.at[w0_t].add(hi.reshape(-1), mode="drop")
+    words = words.at[w1_t].add(lo.reshape(-1), mode="drop")
+
+    overflow = (n_events > e_cap) | (total_bits > w_cap * 32)
+    return PackedStream(words, total_bits, n_events, overflow)
+
+
+def default_packer():
+    """Packer selection: ``SELKIES_PACKER=gather|scatter`` overrides; the
+    default is the scatter formulation (no sorts, no per-word gather
+    rounds — the profile winner on TPU and within noise on CPU)."""
+    import os
+    name = os.environ.get("SELKIES_PACKER", "scatter")
+    return pack_slot_events if name == "gather" else pack_slot_events_scatter
+
+
 def words_to_bytes(words, total_bits: int, pad_ones: bool = True) -> bytes:
     """Host-side: trim the word buffer to the bitstream length.
 

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..codecs import h264_tables as HT
-from .bitpack import pack_slot_events
+from .bitpack import default_packer
 from .colorspace import rgb_to_ycbcr
 from .h264_transform import (MF4, QPC_TABLE, V4, clip1, forward4x4,
                              inverse4x4)
@@ -560,7 +560,7 @@ def h264_encode_yuv(yf: jnp.ndarray, uf: jnp.ndarray, vf: jnp.ndarray,
     ], axis=-1)
 
     packed = jax.vmap(
-        lambda p, n: pack_slot_events(p[None, :], n[None, :], e_cap, w_cap,
+        lambda p, n: default_packer()(p[None, :], n[None, :], e_cap, w_cap,
                                       max_events_per_word=33)
     )(row_pay, row_nb)
     out = H264FrameOut(packed.words, packed.total_bits,
@@ -1007,7 +1007,7 @@ def _assemble_p_rows(R, M, qp, qpc, fn, header_pay, header_nb, cbp, coded,
         jnp.ones((R, 1), jnp.int32),
     ], axis=-1)
     packed = jax.vmap(
-        lambda p, n: pack_slot_events(p[None, :], n[None, :], e_cap, w_cap,
+        lambda p, n: default_packer()(p[None, :], n[None, :], e_cap, w_cap,
                                       max_events_per_word=33)
     )(row_pay, row_nb)
     return H264FrameOut(packed.words, packed.total_bits,

@@ -34,7 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..codecs import jpeg as jtab
-from .bitpack import PackedStream, bit_category, pack_slot_events, value_bits
+from .bitpack import (PackedStream, bit_category, default_packer,
+                      value_bits)
 
 
 class ScanLayout(NamedTuple):
@@ -161,7 +162,7 @@ def jpeg_entropy_device(y_zz: jnp.ndarray, cb_zz: jnp.ndarray,
                   jnp.where(is_zrl, zrl_nbits,
                             jnp.where(is_eob, eob_nbits, 0))))
 
-    return pack_slot_events(payload, nbits, e_cap=e_cap, w_cap=w_cap)
+    return default_packer()(payload, nbits, e_cap=e_cap, w_cap=w_cap)
 
 
 def finalize_scan_bytes(words_host: np.ndarray, total_bits: int) -> bytes:

@@ -153,8 +153,23 @@ class CentralizedStreamServer:
             r.add_get("/api/files/{name:.+}", self.handle_file_download)
 
     def register_static(self) -> None:
-        """Added last so /api/* wins; serves the packaged web client."""
+        """Added last so /api/* wins; serves the packaged web client plus
+        the optional dashboard / touch-gamepad addons when the repo layout
+        carries them (reference serves dashboards as separate addon
+        bundles, docs/component.md:163-165)."""
         root = WEB_ROOT
+        addons = root.parent.parent / "addons"
+        dash = addons / "selkies-dashboard"
+        if dash.is_dir():
+            async def _dash_index(request, d=dash):
+                return web.FileResponse(d / "index.html")
+            self.app.router.add_get("/dashboard/", _dash_index)
+            self.app.router.add_static("/dashboard/", dash,
+                                       show_index=False)
+        tg = addons / "universal-touch-gamepad"
+        if tg.is_dir():
+            self.app.router.add_static("/touch-gamepad/", tg,
+                                       show_index=False)
         if root.is_dir():
             self.app.router.add_get("/", self._index)
             self.app.router.add_static("/", root, show_index=False)

@@ -1,0 +1,193 @@
+"""Vendor-spanning GPU telemetry (reference src/selkies/gpu_stats.py:57-311).
+
+The TPU is the encode device here (server/metrics.device_stats covers it
+via the JAX runtime), but hybrid hosts still carry GPUs whose load users
+expect in the dashboard's stats feed. Resolution chain, like the
+reference's NVML -> aitop -> nvidia-smi -> DRM sysfs:
+
+1. **pynvml** when importable (NVIDIA, full fidelity);
+2. **nvidia-smi** CSV query as the no-bindings fallback;
+3. **DRM sysfs** backfill for every /sys/class/drm/card* node —
+   vendor id, amdgpu VRAM gauges, gpu_busy_percent — which covers
+   AMD/Intel without vendor libraries.
+
+Each stage fills only the devices the earlier stages missed (matched by
+PCI bus id when known). All probing is best-effort and cached-negative:
+a host with no GPUs costs one directory scan."""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import subprocess
+from typing import Optional
+
+logger = logging.getLogger("selkies_tpu.server.gpu_stats")
+
+_PCI_VENDORS = {0x10DE: "nvidia", 0x1002: "amd", 0x8086: "intel"}
+
+
+@dataclasses.dataclass
+class GPUStat:
+    index: int
+    name: str
+    vendor: str
+    load_percent: Optional[float] = None
+    memory_used_mb: Optional[float] = None
+    memory_total_mb: Optional[float] = None
+    temperature_c: Optional[float] = None
+    pci_bus: Optional[str] = None
+    source: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _nvml_gpus() -> list[GPUStat]:
+    try:
+        import pynvml
+    except ImportError:
+        return []
+    out: list[GPUStat] = []
+    try:
+        pynvml.nvmlInit()
+        for i in range(pynvml.nvmlDeviceGetCount()):
+            h = pynvml.nvmlDeviceGetHandleByIndex(i)
+            name = pynvml.nvmlDeviceGetName(h)
+            if isinstance(name, bytes):
+                name = name.decode()
+            mem = pynvml.nvmlDeviceGetMemoryInfo(h)
+            util = pynvml.nvmlDeviceGetUtilizationRates(h)
+            try:
+                temp = pynvml.nvmlDeviceGetTemperature(
+                    h, pynvml.NVML_TEMPERATURE_GPU)
+            except Exception:
+                temp = None
+            try:
+                bus = pynvml.nvmlDeviceGetPciInfo(h).busId
+                if isinstance(bus, bytes):
+                    bus = bus.decode()
+            except Exception:
+                bus = None
+            out.append(GPUStat(
+                index=i, name=name, vendor="nvidia",
+                load_percent=float(util.gpu),
+                memory_used_mb=mem.used / 2**20,
+                memory_total_mb=mem.total / 2**20,
+                temperature_c=float(temp) if temp is not None else None,
+                pci_bus=bus.lower() if bus else None, source="nvml"))
+        pynvml.nvmlShutdown()
+    except Exception:
+        logger.debug("nvml probe failed", exc_info=True)
+    return out
+
+
+def _nvidia_smi_gpus() -> list[GPUStat]:
+    try:
+        r = subprocess.run(
+            ["nvidia-smi", "--query-gpu=index,name,utilization.gpu,"
+             "memory.used,memory.total,temperature.gpu,pci.bus_id",
+             "--format=csv,noheader,nounits"],
+            capture_output=True, text=True, timeout=5)
+    except (OSError, subprocess.TimeoutExpired):
+        return []
+    if r.returncode != 0:
+        return []
+    out = []
+    for line in r.stdout.strip().splitlines():
+        try:
+            idx, name, util, used, total, temp, bus = \
+                (f.strip() for f in line.split(","))
+            out.append(GPUStat(
+                index=int(idx), name=name, vendor="nvidia",
+                load_percent=float(util), memory_used_mb=float(used),
+                memory_total_mb=float(total), temperature_c=float(temp),
+                pci_bus=bus.lower(), source="nvidia-smi"))
+        except ValueError:
+            continue
+    return out
+
+
+def _read(path: str) -> Optional[str]:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def _drm_sysfs_gpus(root: str = "/sys/class/drm",
+                    start_index: int = 0) -> list[GPUStat]:
+    """AMD/Intel (and anything else) via the DRM device nodes; the
+    amdgpu gauges (mem_info_vram_*, gpu_busy_percent) are plain sysfs
+    files, Intel exposes at least vendor/name."""
+    out: list[GPUStat] = []
+    try:
+        cards = sorted(e for e in os.listdir(root)
+                       if e.startswith("card") and "-" not in e)
+    except OSError:
+        return []
+    idx = start_index
+    for card in cards:
+        dev = os.path.join(root, card, "device")
+        vendor_raw = _read(os.path.join(dev, "vendor"))
+        if vendor_raw is None:
+            continue
+        try:
+            vid = int(vendor_raw, 16)
+        except ValueError:
+            continue
+        vendor = _PCI_VENDORS.get(vid, f"pci:{vendor_raw}")
+        busy = _read(os.path.join(dev, "gpu_busy_percent"))
+        used = _read(os.path.join(dev, "mem_info_vram_used"))
+        total = _read(os.path.join(dev, "mem_info_vram_total"))
+        # PCI bus from the device symlink target (.../0000:c1:00.0)
+        bus = None
+        try:
+            tgt = os.path.basename(os.path.realpath(dev))
+            if ":" in tgt:
+                bus = tgt.lower()
+        except OSError:
+            pass
+        name = _read(os.path.join(dev, "product_name")) or \
+            f"{vendor} {card}"
+        out.append(GPUStat(
+            index=idx, name=name, vendor=vendor,
+            load_percent=float(busy) if busy else None,
+            memory_used_mb=int(used) / 2**20 if used else None,
+            memory_total_mb=int(total) / 2**20 if total else None,
+            pci_bus=bus, source="drm-sysfs"))
+        idx += 1
+    return out
+
+
+_dead_stages: set = set()       # stages that yielded nothing: never re-probe
+#                                 (the stats loop calls every few seconds)
+
+
+def get_gpus(drm_root: str = "/sys/class/drm") -> list[GPUStat]:
+    """Full chain; later stages only add devices not already reported
+    (PCI-bus match, falling back to never-duplicating nvidia entries).
+    A stage that reports nothing is cached dead — no per-tick subprocess
+    forks on GPU-less hosts."""
+    gpus = [] if "nvml" in _dead_stages else _nvml_gpus()
+    if not gpus:
+        _dead_stages.add("nvml")
+        if "smi" not in _dead_stages:
+            gpus = _nvidia_smi_gpus()
+            if not gpus:
+                _dead_stages.add("smi")
+    seen_bus = {g.pci_bus for g in gpus if g.pci_bus}
+    have_nvidia = any(g.vendor == "nvidia" for g in gpus)
+    for g in _drm_sysfs_gpus(drm_root, start_index=len(gpus)):
+        if g.pci_bus and g.pci_bus in seen_bus:
+            continue
+        if g.vendor == "nvidia" and have_nvidia and not g.pci_bus:
+            continue
+        gpus.append(g)
+    return gpus
+
+
+def gpu_stats_payload(drm_root: str = "/sys/class/drm") -> list[dict]:
+    return [g.to_dict() for g in get_gpus(drm_root)]

@@ -977,6 +977,15 @@ class WebSocketsService(BaseStreamingService):
                     .run_in_executor(None, metrics.device_stats),
                 }
                 await self._broadcast_control("system_stats " + json.dumps(stats))
+                # vendor-spanning GPU chain (reference selkies.py:4586+
+                # gpu_stats messages); separate verb so clients with no
+                # GPU interest skip the parse
+                from . import gpu_stats as _gs
+                gpus = await asyncio.get_running_loop().run_in_executor(
+                    None, _gs.gpu_stats_payload)
+                if gpus:
+                    await self._broadcast_control(
+                        "gpu_stats " + json.dumps({"gpus": gpus}))
                 if self.settings.stats_csv_path:
                     await asyncio.get_running_loop().run_in_executor(
                         None, self._append_stats_csv, stats)

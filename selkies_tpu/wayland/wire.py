@@ -173,7 +173,11 @@ class WaylandConnection:
             if fds:
                 anc = [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
                         array.array("i", fds).tobytes())]
-                self.sock.sendmsg([msg], anc)
+                # a short write would desync the whole stream: loop until
+                # the full message is out (fds ride the FIRST segment only)
+                sent = self.sock.sendmsg([msg], anc)
+                while sent < len(msg):
+                    sent += self.sock.send(msg[sent:])
             else:
                 self.sock.sendall(msg)
 

@@ -886,6 +886,10 @@ class SelkiesClient {
       case "videoBitrate": this.send(`vb,${d.kbps | 0}`); break;
       case "audioBitrate": this.send(`ab,${d.bps | 0}`); break;
       case "toggleOsk": this.toggleOnScreenKeyboard(); break;
+      case "clipboard":
+        if (typeof d.text === "string")
+          this.send(`cw,${btoa(unescape(encodeURIComponent(d.text)))}`);
+        break;
       default: break;
     }
   }

@@ -107,3 +107,33 @@ def test_device_scan_decodes_in_pil():
     dec = Image.open(io.BytesIO(jfif))
     dec.load()
     assert dec.size == (w, h)
+
+
+def test_scatter_packer_matches_gather_packer():
+    """The two pack formulations (argsort+per-word-gather vs cumsum+
+    scatter-or) must agree bit-for-bit on adversarial event sets."""
+    rng = np.random.default_rng(7)
+    for trial in range(8):
+        m = int(rng.integers(1, 5))
+        s = int(rng.integers(1, 400))
+        nbits = rng.integers(0, 28, (m, s)).astype(np.int32)
+        nbits[rng.random((m, s)) < 0.5] = 0          # sparse
+        if trial == 0:
+            nbits[:] = 1                              # all 1-bit events
+        if trial == 1:
+            nbits[:] = 0                              # empty stream
+        payload = rng.integers(0, 1 << 28, (m, s)).astype(np.uint32)
+        payload &= (((1 << np.maximum(nbits, 1)) - 1)
+            .astype(np.uint32))
+        e_cap = int(nbits.astype(bool).sum()) + 4
+        w_cap = int(nbits.sum()) // 32 + 4
+        a = B.pack_slot_events(payload, nbits,
+                               e_cap=e_cap, w_cap=w_cap,
+                               max_events_per_word=33)
+        b = B.pack_slot_events_scatter(payload, nbits,
+                                       e_cap=e_cap, w_cap=w_cap)
+        assert int(a.total_bits) == int(b.total_bits)
+        assert int(a.n_events) == int(b.n_events)
+        assert bool(a.overflow) == bool(b.overflow)
+        assert np.array_equal(np.asarray(a.words), np.asarray(b.words)), \
+            f"trial {trial}: word mismatch"

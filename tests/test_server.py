@@ -338,6 +338,11 @@ async def test_static_web_client_served(client_factory):
     assert r.status == 200 and "selkies-client.js" in body
     r = await c.get("/selkies-client.js")
     assert r.status == 200 and "SelkiesClient" in await r.text()
+    # addon surfaces (reference addons/selkies-dashboard + touch gamepad)
+    r = await c.get("/dashboard/")
+    assert r.status == 200 and "postMessage" in await r.text()
+    r = await c.get("/touch-gamepad/universalTouchGamepad.js")
+    assert r.status == 200 and "getGamepads" in await r.text()
 
 
 async def test_cursor_broadcast_and_late_joiner(client_factory):
@@ -531,14 +536,27 @@ async def test_computer_use_api(client_factory):
     await ws.close()
 
 
-def test_client_js_delimiters_balanced():
-    """No JS engine exists in this image, so guard the shipped client
-    against gross syntax damage: with strings/comments/regexes stripped,
+import pytest as _pytest
+
+_JS_FILES = (
+    ("selkies_tpu", "web", "selkies-client.js"),
+    ("addons", "universal-touch-gamepad", "universalTouchGamepad.js"),
+    ("addons", "selkies-dashboard", "index.html"),
+)
+
+
+@_pytest.mark.parametrize("parts", _JS_FILES,
+                          ids=[p[-1] for p in _JS_FILES])
+def test_client_js_delimiters_balanced(parts):
+    """No JS engine exists in this image, so guard the shipped client and
+    JS addons against gross syntax damage: with strings/comments stripped,
     every bracket must balance and nest correctly."""
     import pathlib
 
-    raw = (pathlib.Path(__file__).parent.parent / "selkies_tpu" / "web"
-           / "selkies-client.js").read_text()
+    path = pathlib.Path(__file__).parent.parent.joinpath(*parts)
+    raw = path.read_text()
+    if path.suffix == ".html":      # check only the inline script body
+        raw = "".join(raw.split("<script>")[1:]).split("</script>")[0]
 
     # state machine: comments, '…'/"…" strings, template literals with
     # nested ${ code } (a regex can't do this — `//` inside a template
@@ -599,6 +617,8 @@ def test_client_js_delimiters_balanced():
             assert top == pairs[ch], \
                 f"mismatched {ch!r} at offset {i} (open {top!r})"
     assert not stack, f"unclosed {stack[-1]!r}"
+    if parts[-1] != "selkies-client.js":
+        return
     # the new client features must be present
     for needle in ("js,c,", "js,b,", "js,a,", "getGamepads",
                    "X-Upload-Name", "touchstart",
@@ -608,3 +628,37 @@ def test_client_js_delimiters_balanced():
         assert needle in (pathlib.Path(__file__).parent.parent /
                           "selkies_tpu" / "web" /
                           "selkies-client.js").read_text(), needle
+
+
+def test_gpu_stats_drm_sysfs_chain(tmp_path):
+    """The DRM sysfs backfill reports AMD gauges and skips devices the
+    NVML/nvidia-smi stages already covered (reference gpu_stats.py
+    chain; neither NVIDIA path exists in this image, so sysfs is the
+    live stage)."""
+    from selkies_tpu.server import gpu_stats as G
+
+    # fake /sys/class/drm with one amdgpu card and one intel card
+    card0 = tmp_path / "card0" / "device"
+    card0.mkdir(parents=True)
+    (card0 / "vendor").write_text("0x1002\n")
+    (card0 / "gpu_busy_percent").write_text("37\n")
+    (card0 / "mem_info_vram_used").write_text(str(512 * 2**20))
+    (card0 / "mem_info_vram_total").write_text(str(8192 * 2**20))
+    card1 = tmp_path / "card1" / "device"
+    card1.mkdir(parents=True)
+    (card1 / "vendor").write_text("0x8086\n")
+    # connector nodes (card0-DP-1) must be ignored
+    (tmp_path / "card0-DP-1").mkdir()
+
+    gpus = G.get_gpus(drm_root=str(tmp_path))
+    assert len(gpus) == 2
+    amd = next(g for g in gpus if g.vendor == "amd")
+    assert amd.load_percent == 37.0
+    assert amd.memory_used_mb == 512.0
+    assert amd.memory_total_mb == 8192.0
+    assert amd.source == "drm-sysfs"
+    intel = next(g for g in gpus if g.vendor == "intel")
+    assert intel.load_percent is None
+    payload = G.gpu_stats_payload(drm_root=str(tmp_path))
+    assert isinstance(payload, list) and payload[0]["vendor"] in ("amd",
+                                                                  "intel")
