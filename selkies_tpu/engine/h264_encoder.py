@@ -33,9 +33,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..codecs import h264 as hcodec
-from ..ops.h264_encode import (P_SLOTS_MB, SLOTS_MB, h264_encode_p_yuv,
-                               h264_encode_yuv, rgb_to_yuv420,
-                               scroll_candidates)
+from ..ops.h264_encode import P_SLOTS_MB, SLOTS_MB, scroll_candidates
+from ..ops.h264_planes import (h264_encode_p_yuv, h264_encode_yuv,
+                               rgb_to_yuv420)
 from ..ops.stripes import concat_stripe_bytes, words_to_bytes_device
 from .types import CaptureSettings, EncodedChunk
 

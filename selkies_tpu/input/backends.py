@@ -338,6 +338,9 @@ class WaylandBackend:
     # must return instantly — wl-copy/wl-paste run on daemon threads and
     # only refresh the in-process cache
     def set_clipboard(self, data, mime):
+        # generation guard: a wl-paste pull that started BEFORE this set
+        # must not land its (now stale) selection over the new value
+        self._clip_gen = getattr(self, "_clip_gen", 0) + 1
         self._clip = (data, mime)
         if not mime.startswith("text"):
             return
@@ -353,13 +356,16 @@ class WaylandBackend:
                          name="wl-copy").start()
 
     def get_clipboard(self):
+        gen = getattr(self, "_clip_gen", 0)
+
         def _pull():
             try:
                 import subprocess
                 r = subprocess.run(["wl-paste", "--no-newline"],
                                    capture_output=True, timeout=2,
                                    env=self._wl_env())
-                if r.returncode == 0 and r.stdout:
+                if r.returncode == 0 and r.stdout \
+                        and getattr(self, "_clip_gen", 0) == gen:
                     self._clip = (r.stdout, "text/plain")
             except (OSError, subprocess.TimeoutExpired):
                 pass

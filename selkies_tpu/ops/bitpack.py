@@ -196,7 +196,12 @@ def pack_slot_events_scatter(payload: jnp.ndarray, nbits: jnp.ndarray,
 def default_packer():
     """Packer selection: ``SELKIES_PACKER=gather|scatter`` overrides; the
     default is the scatter formulation (no sorts, no per-word gather
-    rounds — the profile winner on TPU and within noise on CPU)."""
+    rounds — the profile winner on TPU and within noise on CPU).
+
+    Scope: consumed by the JPEG entropy coder and by the reference-layout
+    H.264 module (ops/h264_encode — now the bit-exactness oracle). The
+    PRODUCTION H.264 path (ops/h264_planes) embeds the scatter
+    formulation directly in its event sink and ignores this toggle."""
     import os
     name = os.environ.get("SELKIES_PACKER", "scatter")
     return pack_slot_events if name == "gather" else pack_slot_events_scatter

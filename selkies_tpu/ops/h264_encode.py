@@ -23,6 +23,13 @@ codec; SURVEY.md §7 hard-part #1):
 The bitstream produced here is the bit-exact equal of the numpy golden
 encoder (codecs/h264.py), which is itself byte-exact under ffmpeg's
 decoder — see tests/test_h264_device.py.
+
+ROLE (since the plane rewrite): this module is the REFERENCE-LAYOUT
+implementation — the jnp-level oracle that pins ops/h264_planes (the
+production TPU-layout twin the engine and the parallel paths import) via
+tests/test_h264_planes.py, plus the home of the shared pieces both use
+(slot-budget constants, CAVLC event helpers, motion candidate set and
+_motion_select). It stays bit-for-bit equal to the golden encoder.
 """
 
 from __future__ import annotations

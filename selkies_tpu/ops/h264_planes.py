@@ -1,0 +1,1023 @@
+"""TPU-layout H.264 encode: the same bitstreams as ops/h264_encode, built
+from "coefficient planes" instead of (..., 4, 4) block tensors.
+
+Why: XLA:TPU tiles the last two dims of every array to (8, 128) vector
+registers. The original layout carries 4x4 (and 16-wide) minor dims
+everywhere, so a 1080p frame's transform tensors pad 32-64x in HBM —
+profiling on a real v5e chip put the transform+quant stage alone at
+~88 ms/frame. Here every tensor keeps LARGE minor dims:
+
+- a 4x4 block transform is 16 stride-4 plane slices and int butterflies:
+  coefficient (i, j) of every block lives in one (H/4, W/4) plane;
+- CAVLC runs per-slot over (nby, nbx) block-grid planes — the per-block
+  argsort becomes rank-select arithmetic over 16 planes, take_along_axis
+  becomes Python list indexing, and VLC tables are packed (len<<16|code)
+  single-take lookups;
+- bit offsets are exclusive sums over tiny (R, M) per-block totals, and
+  the stream is materialised by ONE pair of scatter-adds over all event
+  classes (same disjoint-bits trick as ops/bitpack.pack_slot_events_scatter).
+
+Bit-identical to ops/h264_encode.h264_encode_yuv / h264_encode_p_yuv
+(tests/test_h264_planes.py), which are themselves pinned to the numpy
+golden encoder and ffmpeg. Reference equivalent: the closed Rust
+pixelflux encoders (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..codecs import h264_tables as HT
+from .h264_encode import (H264FrameOut, LEVEL_CLAMP, P_SLOTS_HDR,
+                          SLOTS_BLK4, SLOTS_BLK15, SLOTS_BLK16,
+                          SLOTS_BLK16F, SLOTS_HDR, SLOTS_MB, P_SLOTS_MB,
+                          _motion_select, _ue_event, _se_event,
+                          _level_event, _MV_LAMBDA)
+from .colorspace import rgb_to_ycbcr
+from .h264_transform import ZIGZAG4, _MF, _POS_CLS, _QPC, _V
+
+# ---------------------------------------------------------------------------
+# tables (packed len<<16 | code so every VLC lookup is ONE take)
+# ---------------------------------------------------------------------------
+
+
+def _pack_tab(len_np, code_np):
+    return jnp.asarray((len_np.astype(np.int32) << 16)
+                       | code_np.astype(np.int32))
+
+
+_CT_PACK = _pack_tab(HT.CT_LEN_NP, HT.CT_CODE_NP).reshape(-1)      # 4*4*17
+_CDC_PACK = _pack_tab(HT.CT_CDC_LEN_NP, HT.CT_CDC_CODE_NP).reshape(-1)
+_TZ_PACK = _pack_tab(HT.TZ_LEN_NP, HT.TZ_CODE_NP).reshape(-1)      # 15*16
+_TZC_PACK = _pack_tab(HT.TZ_CDC_LEN_NP, HT.TZ_CDC_CODE_NP).reshape(-1)
+_RB_PACK = _pack_tab(HT.RB_LEN_NP, HT.RB_CODE_NP).reshape(-1)      # 7*15
+_CBP2CODE_J = jnp.asarray(HT.CBP_INTER_CBP2CODE)
+
+_MF_J = jnp.asarray(_MF)            # (6, 3) pos-class quant multipliers
+_V_J = jnp.asarray(_V)              # (6, 3) rescale multipliers
+_QPC_J = jnp.asarray(_QPC)
+_ZZ_IJ = [(int(z) // 4, int(z) % 4) for z in ZIGZAG4]   # scan pos -> (i, j)
+
+
+def _lut(packed, idx):
+    """packed (T,) int32 len<<16|code; idx any-shape int32 ->
+    (pay uint32, nb int32)."""
+    v = jnp.take(packed, idx)
+    return (v & 0xFFFF).astype(jnp.uint32), (v >> 16).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# plane transforms (stride-4 slices + butterflies; exact int32)
+# ---------------------------------------------------------------------------
+
+def fwd4_planes(x):
+    """(H, W) int32 -> 4x4 nested list of (H/4, W/4) coefficient planes:
+    out[i][j] = (Cf X Cf^T)[i, j] of every 4x4 block."""
+    x0, x1, x2, x3 = x[0::4, :], x[1::4, :], x[2::4, :], x[3::4, :]
+    s0, s1, d0, d1 = x0 + x3, x1 + x2, x0 - x3, x1 - x2
+    rows = (s0 + s1, 2 * d0 + d1, s0 - s1, d0 - 2 * d1)
+    out = [[None] * 4 for _ in range(4)]
+    for i, r in enumerate(rows):
+        c0, c1, c2, c3 = r[:, 0::4], r[:, 1::4], r[:, 2::4], r[:, 3::4]
+        s0, s1, d0, d1 = c0 + c3, c1 + c2, c0 - c3, c1 - c2
+        out[i] = [s0 + s1, 2 * d0 + d1, s0 - s1, d0 - 2 * d1]
+    return out
+
+
+def inv4_planes(d):
+    """Spec 8.5.12.2 inverse (horizontal first, >>1 truncations exact)
+    WITHOUT the final (x+32)>>6. d and result are 4x4 plane lists."""
+    f = [None] * 4
+    for i in range(4):
+        e0 = d[i][0] + d[i][2]
+        e1 = d[i][0] - d[i][2]
+        e2 = (d[i][1] >> 1) - d[i][3]
+        e3 = d[i][1] + (d[i][3] >> 1)
+        f[i] = [e0 + e3, e1 + e2, e1 - e2, e0 - e3]
+    out = [[None] * 4 for _ in range(4)]
+    for j in range(4):
+        g0 = f[0][j] + f[2][j]
+        g1 = f[0][j] - f[2][j]
+        g2 = (f[1][j] >> 1) - f[3][j]
+        g3 = f[1][j] + (f[3][j] >> 1)
+        out[0][j], out[1][j] = g0 + g3, g1 + g2
+        out[2][j], out[3][j] = g1 - g2, g0 - g3
+    return out
+
+
+def _clip1(x):
+    return jnp.clip(x, 0, 255)
+
+
+def _merge_planes(planes, bh: int, bw: int):
+    """bh x bw nested plane list (h, w) -> interleaved (h*bh, w*bw)."""
+    h, w = planes[0][0].shape
+    rows = []
+    for i in range(bh):
+        rows.append(jnp.stack(planes[i], axis=-1).reshape(h, w * bw))
+    return jnp.stack(rows, axis=1).reshape(h * bh, w * bw)
+
+
+def _grid_rm(plane, bh: int, bw: int):
+    """(h*bh, w*bw) block-grid plane -> bh x bw list of (h, w) slices."""
+    return [[plane[i::bh, j::bw] for j in range(bw)] for i in range(bh)]
+
+
+# ---------------------------------------------------------------------------
+# quant / dequant on planes (qp broadcastable to the plane shape)
+# ---------------------------------------------------------------------------
+
+def _quant_plane(w, qp, cls: int, fdiv: int):
+    """level = clamp(sign * ((|w| * MF[qp%6, cls] + (1<<qbits)//fdiv)
+    >> qbits)); fdiv=3 intra, 6 inter."""
+    qbits = 15 + qp // 6
+    mf = _MF_J[qp % 6, cls]
+    f = jnp.left_shift(jnp.int32(1), qbits) // fdiv
+    mag = (jnp.abs(w) * mf + f) >> qbits
+    return jnp.clip(jnp.where(w < 0, -mag, mag), -LEVEL_CLAMP, LEVEL_CLAMP)
+
+
+def _dequant_plane(c, qp, cls: int):
+    """Spec 8.5.12.1 AC rescale, elementwise."""
+    ls = 16 * _V_J[qp % 6, cls]
+    t = qp // 6
+    hi = jnp.left_shift(c * ls, jnp.maximum(t - 4, 0))
+    lo = (c * ls + jnp.left_shift(jnp.int32(1), jnp.maximum(3 - t, 0))) \
+        >> jnp.maximum(4 - t, 0)
+    return jnp.where(t >= 4, hi, lo)
+
+
+def _quant_dc_e(y, qp):
+    qbits = 15 + qp // 6
+    mf00 = _MF_J[qp % 6, 0]
+    f2 = 2 * (jnp.left_shift(jnp.int32(1), qbits) // 3)
+    mag = (jnp.abs(y) * mf00 + f2) >> (qbits + 1)
+    return jnp.clip(jnp.where(y < 0, -mag, mag), -LEVEL_CLAMP, LEVEL_CLAMP)
+
+
+def _dequant_ldc_e(f, qp):
+    ls00 = 16 * _V_J[qp % 6, 0]
+    t = qp // 6
+    hi = jnp.left_shift(f * ls00, jnp.maximum(t - 6, 0))
+    lo = (f * ls00 + jnp.left_shift(jnp.int32(1), jnp.maximum(5 - t, 0))) \
+        >> jnp.maximum(6 - t, 0)
+    return jnp.where(t >= 6, hi, lo)
+
+
+def _dequant_cdc_e(f, qpc):
+    ls00 = 16 * _V_J[qpc % 6, 0]
+    return jnp.left_shift(f * ls00, qpc // 6) >> 5
+
+
+# ---------------------------------------------------------------------------
+# CAVLC over block-grid planes
+# ---------------------------------------------------------------------------
+
+def cavlc_events_planes(scan, nc, chroma_dc: bool = False):
+    """``scan``: stacked (mc, ...) levels in scan order (a list of planes
+    is stacked on entry). ``nc``: context plane (ignored for chroma_dc).
+    Returns (pay (S, ...) uint32, nb (S, ...) int32, tc plane) with the
+    slot layout of ops/h264_encode.cavlc_block_events: [coeff_token,
+    3 signs, mc levels, total_zeros, mc-1 runs].
+
+    Structured for trace size as much as runtime: coding order comes from
+    one one-hot rank reduction (fused by XLA, never materialised), and
+    the two genuinely sequential slot chains (level suffix_len, run_before
+    zeros_left) are lax.scans — the whole builder traces to ~100 eqns
+    where the per-slot formulation took ~2.7k and blew compile time."""
+    if isinstance(scan, (list, tuple)):
+        scan = jnp.stack(scan)
+    mc = scan.shape[0]
+    nz = scan != 0
+    nzi = nz.astype(jnp.int32)
+    tc = nzi.sum(0)
+
+    # coding order (nonzeros by descending position) via suffix ranks:
+    # rank[k] = #nonzeros at positions > k; the coded index of a nonzero
+    # at scan position k IS rank[k]. One reverse cumsum, no sort.
+    rank = jnp.cumsum(nzi[::-1], axis=0)[::-1] - nzi
+    kidx = jnp.arange(mc, dtype=jnp.int32)
+    kb = kidx.reshape((mc,) + (1,) * (scan.ndim - 1))
+    # one-hot selection, contracted immediately (XLA fuses; nothing
+    # (mc, mc, ...) ever lands in memory)
+    oh = (rank[None] == kb[:, None]) & nz[None]      # (i, k, ...)
+    lv = jnp.sum(jnp.where(oh, scan[None], 0), axis=1)
+    pv = jnp.sum(jnp.where(oh, kb[None, :], 0), axis=1)
+
+    # trailing ones: run of initial |1| values, capped at 3
+    runmask = jnp.cumprod((jnp.abs(lv) == 1).astype(jnp.int32), axis=0)
+    t1 = jnp.minimum(jnp.sum(runmask * (kb < tc[None]), axis=0), 3)
+
+    # --- coeff_token
+    if chroma_dc:
+        ct_pay, ct_nb = _lut(_CDC_PACK, t1 * 5 + tc)
+    else:
+        ctx = jnp.where(nc < 2, 0, jnp.where(nc < 4, 1,
+                        jnp.where(nc < 8, 2, 3)))
+        ct_pay, ct_nb = _lut(_CT_PACK, (ctx * 4 + t1) * 17 + tc)
+
+    # --- trailing one signs
+    sidx = kb[:3]
+    sign_pay = (lv[:3] < 0).astype(jnp.uint32)
+    sign_nb = jnp.where(sidx < t1[None], 1, 0)
+
+    # --- levels: lax.scan over coded index j carrying suffix_len.
+    # lv[t1 + j] with t1 in 0..3 = a 4-slot dynamic window over a padded
+    # stack (old code's clip() semantics are gate-equivalent: padded
+    # reads happen only when the slot is inactive).
+    lv_pad = jnp.concatenate([lv, jnp.zeros((3,) + lv.shape[1:],
+                                            lv.dtype)], axis=0)
+
+    def lv_step(suffix_len, j):
+        win = jax.lax.dynamic_slice_in_dim(lv_pad, j, 4, axis=0)
+        level = jnp.where(t1 == 0, win[0],
+                          jnp.where(t1 == 1, win[1],
+                                    jnp.where(t1 == 2, win[2], win[3])))
+        active = (t1 + j) < tc
+        level_code = jnp.where(level > 0, 2 * level - 2, -2 * level - 1)
+        level_code = jnp.where((j == 0) & (t1 < 3), level_code - 2,
+                               level_code)
+        p, n = _level_event(level_code, suffix_len)
+        new_sl = jnp.maximum(suffix_len, 1)
+        new_sl = jnp.where(
+            (jnp.abs(level) > (3 << jnp.maximum(new_sl - 1, 0)))
+            & (new_sl < 6), new_sl + 1, new_sl)
+        suffix_len = jnp.where(active, new_sl, suffix_len)
+        return suffix_len, (jnp.where(active, p, 0).astype(jnp.uint32),
+                            jnp.where(active, n, 0))
+
+    sl0 = jnp.where((tc > 10) & (t1 < 3), 1, 0)
+    _, (lvl_pay, lvl_nb) = jax.lax.scan(lv_step, sl0, kidx)
+
+    # --- total_zeros
+    last_pos = pv[0]
+    tz = jnp.where(tc > 0, last_pos + 1 - tc, 0)
+    if chroma_dc:
+        tz_pay, tz_nb = _lut(
+            _TZC_PACK, jnp.clip(tc - 1, 0, 2) * 4 + jnp.clip(tz, 0, 3))
+    else:
+        tz_pay, tz_nb = _lut(
+            _TZ_PACK, jnp.clip(tc - 1, 0, 14) * 16 + jnp.clip(tz, 0, 15))
+    tz_active = (tc > 0) & (tc < mc)
+    tz_pay = jnp.where(tz_active, tz_pay, 0).astype(jnp.uint32)
+    tz_nb = jnp.where(tz_active, tz_nb, 0)
+
+    # --- run_before: lax.scan over coded index carrying zeros_left
+    pv_pad = jnp.concatenate([pv, jnp.zeros((1,) + pv.shape[1:],
+                                            pv.dtype)], axis=0)
+
+    def rb_step(zeros_left, i):
+        pair = jax.lax.dynamic_slice_in_dim(pv_pad, i, 2, axis=0)
+        active = (i < tc - 1) & (zeros_left > 0)
+        run = jnp.clip(pair[0] - pair[1] - 1, 0, 14)
+        zl = jnp.clip(jnp.minimum(zeros_left, 7) - 1, 0, 6)
+        rb_pay, rb_nb = _lut(_RB_PACK, zl * 15 + run)
+        out = (jnp.where(active, rb_pay, 0).astype(jnp.uint32),
+               jnp.where(active, rb_nb, 0))
+        zeros_left = jnp.where(i < tc - 1, zeros_left - run, zeros_left)
+        return zeros_left, out
+
+    _, (rb_pay, rb_nb) = jax.lax.scan(rb_step, tz, kidx[:mc - 1])
+
+    shp = tc.shape
+    pay = jnp.concatenate([
+        ct_pay[None], jnp.broadcast_to(sign_pay, (3,) + shp),
+        lvl_pay, tz_pay[None], rb_pay], axis=0)
+    nb = jnp.concatenate([
+        ct_nb[None], jnp.broadcast_to(sign_nb, (3,) + shp),
+        lvl_nb, tz_nb[None], rb_nb], axis=0)
+    return pay, nb.astype(jnp.int32), tc
+
+
+def _nc_planes(tc_eff, mb_bw: int):
+    """nC context per block on an (nby, nbx) grid where each MB spans
+    ``mb_bw`` block columns/rows. Left neighbour is simply grid col-1
+    (in-MB and left-MB cases coincide); top is grid row-1 but only WITHIN
+    the MB (one slice per MB row: cross-MB-row blocks are cross-slice,
+    hence unavailable — §8.1.3 via h264_encode._nc_from_counts)."""
+    nby, nbx = tc_eff.shape
+    col = jax.lax.broadcasted_iota(jnp.int32, (nby, nbx), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (nby, nbx), 0)
+    na = jnp.pad(tc_eff[:, :-1], ((0, 0), (1, 0)))
+    nb_ = jnp.pad(tc_eff[:-1, :], ((1, 0), (0, 0)))
+    a_avail = col > 0
+    b_avail = (row % mb_bw) > 0
+    both = a_avail & b_avail
+    return jnp.where(both, (na + nb_ + 1) >> 1,
+                     jnp.where(a_avail, na,
+                               jnp.where(b_avail, nb_, 0)))
+
+
+# ---------------------------------------------------------------------------
+# event sink: every slot class appends (row, offset, payload, nbits)
+# tensors; ONE pair of scatter-adds materialises the per-row streams
+# ---------------------------------------------------------------------------
+
+class _EventSink:
+    def __init__(self, R: int, w_cap: int):
+        self.R, self.w_cap = R, w_cap
+        self.items = []
+
+    def add(self, row, off, pay, nb):
+        """All args broadcastable to one shape; row = MB-row index per
+        element, off = bit offset WITHIN that row's stream."""
+        shp = jnp.broadcast_shapes(jnp.shape(row), jnp.shape(off),
+                                   jnp.shape(pay), jnp.shape(nb))
+        self.items.append((
+            jnp.broadcast_to(row, shp).reshape(-1),
+            jnp.broadcast_to(off, shp).reshape(-1),
+            jnp.broadcast_to(pay, shp).reshape(-1).astype(jnp.uint32),
+            jnp.broadcast_to(nb, shp).reshape(-1).astype(jnp.int32)))
+
+    def pack(self):
+        """-> (words (R, w_cap) uint32, n_events (R,) int32)."""
+        R, w_cap = self.R, self.w_cap
+        row = jnp.concatenate([i[0] for i in self.items])
+        off = jnp.concatenate([i[1] for i in self.items])
+        pay = jnp.concatenate([i[2] for i in self.items])
+        nb = jnp.concatenate([i[3] for i in self.items])
+        active = nb > 0
+        goff = row * (w_cap * 32) + off
+        w0 = (goff >> 5).astype(jnp.int32)
+        rel = (goff & 31).astype(jnp.int32)
+        sh = 32 - (rel + nb)
+        pay = jnp.where(active, pay, 0)
+        hi = jnp.where(sh >= 0,
+                       jnp.left_shift(pay, jnp.clip(sh, 0, 31)
+                                      .astype(jnp.uint32)),
+                       jnp.right_shift(pay, jnp.clip(-sh, 0, 31)
+                                       .astype(jnp.uint32)))
+        hi = jnp.where(active, hi, 0)
+        lo = jnp.where((sh < 0) & active,
+                       jnp.left_shift(pay, jnp.clip(32 + sh, 0, 31)
+                                      .astype(jnp.uint32)), 0)
+        oob = R * w_cap
+        w0_t = jnp.where(active, w0, oob)
+        w1_t = jnp.where(active & (sh < 0), w0 + 1, oob)
+        words = jnp.zeros((R * w_cap,), jnp.uint32)
+        words = words.at[w0_t].add(hi, mode="drop")
+        words = words.at[w1_t].add(lo, mode="drop")
+        n_ev = jnp.zeros((R,), jnp.int32).at[row].add(
+            active.astype(jnp.int32), mode="drop")
+        return words.reshape(R, w_cap), n_ev
+
+
+# ---------------------------------------------------------------------------
+# shared frame-level pieces
+# ---------------------------------------------------------------------------
+
+def rgb_to_yuv420(rgb):
+    H, W = rgb.shape[0], rgb.shape[1]
+    ycc = rgb_to_ycbcr(rgb, "bt601-full")
+    yf = jnp.clip(jnp.round(ycc[..., 0]), 0, 255).astype(jnp.int32)
+
+    def sub2(p):
+        return jnp.clip(jnp.round(
+            p.reshape(H // 2, 2, W // 2, 2).mean(axis=(1, 3))),
+            0, 255).astype(jnp.int32)
+    return yf, sub2(ycc[..., 1]), sub2(ycc[..., 2])
+
+
+def _had2_parts(x00, x01, x10, x11):
+    a, b = x00 + x01, x00 - x01
+    c, d = x10 + x11, x10 - x11
+    return a + c, b + d, a - c, b - d
+
+
+def _expand(p, fy: int, fx: int):
+    """(R, M)-ish plane -> block grid by repeating fy x fx."""
+    return jnp.repeat(jnp.repeat(p, fy, axis=0), fx, axis=1)
+
+
+_SCAN_ORDER = ((0, 0), (0, 1), (1, 0), (1, 1), (0, 2), (0, 3), (1, 2),
+               (1, 3), (2, 0), (2, 1), (3, 0), (3, 1), (2, 2), (2, 3),
+               (3, 2), (3, 3))
+
+
+def _row_of_blocks(nby, nbx, per_mb: int):
+    """Block-grid plane of MB-row indices."""
+    return jax.lax.broadcasted_iota(jnp.int32, (nby, nbx), 0) // per_mb
+
+
+# ---------------------------------------------------------------------------
+# I path
+# ---------------------------------------------------------------------------
+
+def h264_encode_yuv(yf, uf, vf, qp, header_pay, header_nb,
+                    e_cap: int, w_cap: int,
+                    idr_pic_id=0, want_recon: bool = False):
+    """Plane-layout twin of ops/h264_encode.h264_encode_yuv — same
+    signature, bit-identical output."""
+    H, W = yf.shape[0], yf.shape[1]
+    assert H % 16 == 0 and W % 16 == 0
+    R, M = H // 16, W // 16
+    nby, nbx = H // 4, W // 4
+    qp = jnp.broadcast_to(jnp.asarray(qp, jnp.int32), (R,))
+    qpc = _QPC_J[jnp.clip(qp, 0, 51)]
+    qp_by = jnp.repeat(qp, 4)[:, None]          # (nby, 1) luma block rows
+    qpc_by = jnp.repeat(qpc, 2)[:, None]        # (H/8, 1) chroma block rows
+
+    # ---- transforms + quant, all planes
+    wy = fwd4_planes(yf.astype(jnp.int32))
+    wu = fwd4_planes(uf.astype(jnp.int32))
+    wv = fwd4_planes(vf.astype(jnp.int32))
+
+    def quant_all(w, qp_b, fdiv):
+        return [[_quant_plane(w[i][j], qp_b, _POS_CLS[i][j], fdiv)
+                 for j in range(4)] for i in range(4)]
+    acl_y = quant_all(wy, qp_by, 3)
+    acl_u = quant_all(wu, qpc_by, 3)
+    acl_v = quant_all(wv, qpc_by, 3)
+
+    # zigzag scans with DC removed (slot 0 zeroed)
+    zero_y = jnp.zeros((nby, nbx), jnp.int32)
+    scan_y = [acl_y[i][j] if k else zero_y
+              for k, (i, j) in enumerate(_ZZ_IJ)]
+    zero_c = jnp.zeros((H // 8, W // 8), jnp.int32)
+    scan_u = [acl_u[i][j] if k else zero_c
+              for k, (i, j) in enumerate(_ZZ_IJ)]
+    scan_v = [acl_v[i][j] if k else zero_c
+              for k, (i, j) in enumerate(_ZZ_IJ)]
+
+    # ---- AC dequant + inverse right-edge contribution for the DC scan
+    def deq_all(acl, qp_b):
+        return [[_dequant_plane(
+            acl[i][j] if (i, j) != (0, 0) else jnp.zeros_like(acl[0][0]),
+            qp_b, _POS_CLS[i][j]) for j in range(4)] for i in range(4)]
+    d_y = deq_all(acl_y, qp_by)
+    d_u = deq_all(acl_u, qpc_by)
+    d_v = deq_all(acl_v, qpc_by)
+    inv_y = inv4_planes(d_y)
+    inv_u = inv4_planes(d_u)
+    inv_v = inv4_planes(d_v)
+    # luma right edge: bx=3 blocks' column 3 -> (R, by, M, 4 rows)
+    inv_y_edge = jnp.stack(
+        [inv_y[i][3][:, 3::4].reshape(R, 4, M) for i in range(4)],
+        axis=-1)                                     # (R, by, M, 4)
+    # chroma right edge: bx2=1 blocks' column 3 -> (R, comp, by2, M, 4)
+    inv_c_edge = jnp.stack([
+        jnp.stack([inv_u[i][3][:, 1::2].reshape(R, 2, M)
+                   for i in range(4)], axis=-1),
+        jnp.stack([inv_v[i][3][:, 1::2].reshape(R, 2, M)
+                   for i in range(4)], axis=-1)], axis=1)
+
+    # ---- DC values -> the (small) sequential left-edge scan, reused
+    # verbatim from the original decomposition
+    dc_y = wy[0][0].reshape(R, 4, M, 4)              # (R, by, M, bx)
+    dc_c = jnp.stack([wu[0][0].reshape(R, 2, M, 2),
+                      wv[0][0].reshape(R, 2, M, 2)], axis=1)
+    dc_lvls, cdc_lvls, preds_y, preds_c = _dc_scan(
+        R, M, dc_y, dc_c, inv_y_edge, inv_c_edge, qp, qpc)
+
+    # ---- cbp / counts / nC on the block grid
+    nz_y = sum((s != 0).astype(jnp.int32) for s in scan_y)   # = tc per blk
+    any_y_mb = jnp.any((nz_y > 0).reshape(R, 4, M, 4), axis=(1, 3))
+    cbp_luma = any_y_mb                                      # (R, M) bool
+    nz_u = sum((s != 0).astype(jnp.int32) for s in scan_u)
+    nz_v = sum((s != 0).astype(jnp.int32) for s in scan_v)
+    has_cac = jnp.any(((nz_u + nz_v) > 0).reshape(R, 2, M, 2), axis=(1, 3))
+    has_cdc = jnp.any(cdc_lvls != 0, axis=(-1, -2, -3))
+    cbp_chroma = jnp.where(has_cac, 2, jnp.where(has_cdc, 1, 0))  # (R, M)
+
+    gate_y = _expand(cbp_luma, 4, 4)
+    tc_y_eff = jnp.where(gate_y, nz_y, 0)
+    nc_y = _nc_planes(tc_y_eff, 4)
+    gate_c = _expand(cbp_chroma == 2, 2, 2)
+    nc_u = _nc_planes(jnp.where(gate_c, nz_u, 0), 2)
+    nc_v = _nc_planes(jnp.where(gate_c, nz_v, 0), 2)
+
+    # ---- events (each class one stacked (S, ...) pair)
+    dc_scan_l = [dc_lvls.reshape(R, M, 16)[..., int(z)] for z in ZIGZAG4]
+    dpay, dnb, _ = cavlc_events_planes(dc_scan_l, nc_y[0::4, 0::4])
+    ypay, ynb, _ = cavlc_events_planes(scan_y[1:], nc_y)
+    ynb = jnp.where(gate_y[None], ynb, 0)
+    cdc_u = [cdc_lvls[:, :, 0, 0, 0], cdc_lvls[:, :, 0, 0, 1],
+             cdc_lvls[:, :, 0, 1, 0], cdc_lvls[:, :, 0, 1, 1]]
+    cdc_v = [cdc_lvls[:, :, 1, 0, 0], cdc_lvls[:, :, 1, 0, 1],
+             cdc_lvls[:, :, 1, 1, 0], cdc_lvls[:, :, 1, 1, 1]]
+    cdc_gate = cbp_chroma > 0
+    upay_dc, unb_dc, _ = cavlc_events_planes(cdc_u, None, chroma_dc=True)
+    vpay_dc, vnb_dc, _ = cavlc_events_planes(cdc_v, None, chroma_dc=True)
+    unb_dc = jnp.where(cdc_gate[None], unb_dc, 0)
+    vnb_dc = jnp.where(cdc_gate[None], vnb_dc, 0)
+    upay, unb, _ = cavlc_events_planes(scan_u[1:], nc_u)
+    vpay, vnb, _ = cavlc_events_planes(scan_v[1:], nc_v)
+    unb = jnp.where(gate_c[None], unb, 0)
+    vnb = jnp.where(gate_c[None], vnb, 0)
+
+    # ---- MB header events
+    mb_type = 3 + 4 * cbp_chroma + jnp.where(cbp_luma, 12, 0)
+    h_pay0, h_nb0 = _ue_event(mb_type)
+    one_u = jnp.ones((R, M), jnp.uint32)
+    one_n = jnp.ones((R, M), jnp.int32)
+    hdr_pays = jnp.stack([h_pay0, one_u, one_u])
+    hdr_nbs = jnp.stack([h_nb0, one_n, one_n])
+
+    # ---- slice header prefix + device tail events (per row)
+    idr = jnp.broadcast_to(jnp.asarray(idr_pic_id, jnp.int32), (R,))
+    idr_pay, idr_nb = _ue_event(idr)
+    dqp = qp - 26
+    qp_pay, qp_nb = _ue_event(jnp.where(dqp > 0, 2 * dqp - 1, -2 * dqp))
+    row_pays = jnp.stack([header_pay[:, 0].astype(jnp.uint32),
+                          header_pay[:, 1].astype(jnp.uint32),
+                          idr_pay, jnp.zeros((R,), jnp.uint32), qp_pay,
+                          jnp.full((R,), 2, jnp.uint32)])
+    row_nbs = jnp.stack([header_nb[:, 0].astype(jnp.int32),
+                         header_nb[:, 1].astype(jnp.int32),
+                         idr_nb, jnp.full((R,), 2, jnp.int32), qp_nb,
+                         jnp.full((R,), 3, jnp.int32)])
+
+    out = _assemble_frame(
+        R, M, w_cap, e_cap, row_pays, row_nbs,
+        hdr_pays, hdr_nbs, dpay, dnb, ypay, ynb,
+        upay_dc, unb_dc, vpay_dc, vnb_dc, upay, unb, vpay, vnb)
+
+    if not want_recon:
+        return out
+    # ---- decoder-exact full recon (DC terms recomputed in parallel)
+    f_all = _had4_mb(dc_lvls)                        # (R, M, 4, 4)
+    dcY_all = _dequant_ldc_e(f_all, qp[:, None, None, None])
+    dcY_plane = _merge_planes(
+        [[dcY_all[:, :, i, j] for j in range(4)] for i in range(4)], 4, 4)
+    # dcY_plane rows interleave MBs: shape (4R, 4M) == (nby/... careful:
+    # merge of (R, M) planes gives (4R, 4M) = block grid. OK.
+    pred_plane = _expand(preds_y, 4, 4)
+    rec_y = [[_clip1(pred_plane
+                     + ((inv_y[i][j] + dcY_plane + 32) >> 6))
+              for j in range(4)] for i in range(4)]
+    recon_y = _merge_planes(rec_y, 4, 4)
+    # chroma: preds_c (R, M, comp, by2); DC from cdc_lvls
+    f2 = _had2_mb(cdc_lvls)                          # (R, M, 2, 2, 2)
+    dcC = _dequant_cdc_e(f2, qpc[:, None, None, None, None])
+    recon_u = _merge_pixel_chroma(inv_u, dcC, preds_c, 0, R, M)
+    recon_v = _merge_pixel_chroma(inv_v, dcC, preds_c, 1, R, M)
+    return out, (recon_y.astype(jnp.uint8), recon_u.astype(jnp.uint8),
+                 recon_v.astype(jnp.uint8))
+
+
+def _merge_pixel_chroma(inv_c, dcC, preds_c, comp, R, M):
+    """Chroma recon (H/2, W/2) from inverse planes + per-block DC +
+    per-half preds."""
+    # per-block DC plane on the (H/8, W/8) block grid
+    dcC_pl = _merge_planes(
+        [[dcC[:, :, comp, i, j] for j in range(2)] for i in range(2)], 2, 2)
+    pred_pl = _merge_planes(
+        [[preds_c[:, :, comp, i] for _ in range(2)] for i in range(2)],
+        2, 2)
+    rec = [[_clip1(pred_pl + ((inv_c[i][j] + dcC_pl + 32) >> 6))
+            for j in range(4)] for i in range(4)]
+    return _merge_planes(rec, 4, 4)
+
+
+def _had4_mb(dc_lvls):
+    """(R, M, 4, 4) -> H . X . H (tiny per-MB tensors)."""
+    h4 = jnp.asarray(np.array([[1, 1, 1, 1], [1, 1, -1, -1],
+                               [1, -1, -1, 1], [1, -1, 1, -1]], np.int32))
+    return jnp.einsum("ij,rmjk,kl->rmil", h4, dc_lvls, h4)
+
+
+def _had2_mb(cdc_lvls):
+    """(R, M, comp, 2, 2) -> H2 X H2 per MB."""
+    x00, x01 = cdc_lvls[..., 0, 0], cdc_lvls[..., 0, 1]
+    x10, x11 = cdc_lvls[..., 1, 0], cdc_lvls[..., 1, 1]
+    a, b, c, d = _had2_parts(x00, x01, x10, x11)
+    return jnp.stack([jnp.stack([a, b], -1), jnp.stack([c, d], -1)], -2)
+
+
+def _dc_scan(R, M, dc_y, dc_c, inv_y_edge, inv_c_edge, qp, qpc):
+    """The sequential DC/left-edge pipeline (identical math to
+    ops/h264_encode.h264_encode_yuv's scan; small tensors only)."""
+    h4 = jnp.asarray(np.array([[1, 1, 1, 1], [1, 1, -1, -1],
+                               [1, -1, -1, 1], [1, -1, 1, -1]], np.int32))
+
+    def step(carry, k):
+        edge_y, edge_c = carry
+        first = k == 0
+        pred_y = jnp.where(first, 128, (edge_y.sum(-1) + 8) >> 4)
+        dcm = dc_y[:, :, k, :] - 16 * pred_y[:, None, None]
+        hd = jnp.einsum("ij,rjk,kl->ril", h4, dcm, h4) >> 1
+        dlvl = _quant_dc_e(hd, qp[:, None, None])
+        f = jnp.einsum("ij,rjk,kl->ril", h4, dlvl, h4)
+        dcY = _dequant_ldc_e(f, qp[:, None, None])
+        new_edge_y = _clip1(
+            pred_y[:, None, None]
+            + ((inv_y_edge[:, :, k, :] + dcY[:, :, 3:4] + 32) >> 6)
+        ).reshape(R, 16)
+        pt = jnp.where(first, 128, (edge_c[..., 0:4].sum(-1) + 2) >> 2)
+        pb = jnp.where(first, 128, (edge_c[..., 4:8].sum(-1) + 2) >> 2)
+        pred_c = jnp.stack([pt, pb], axis=-1)
+        dcmc = dc_c[:, :, :, k, :] - 16 * pred_c[..., None]
+        a, b, c_, d = _had2_parts(dcmc[..., 0, 0], dcmc[..., 0, 1],
+                                  dcmc[..., 1, 0], dcmc[..., 1, 1])
+        hd2 = jnp.stack([jnp.stack([a, b], -1), jnp.stack([c_, d], -1)], -2)
+        qpc3 = qpc[:, None, None, None]
+        clvl = _quant_dc_e(hd2, qpc3)
+        a, b, c_, d = _had2_parts(clvl[..., 0, 0], clvl[..., 0, 1],
+                                  clvl[..., 1, 0], clvl[..., 1, 1])
+        f2 = jnp.stack([jnp.stack([a, b], -1), jnp.stack([c_, d], -1)], -2)
+        dcC = _dequant_cdc_e(f2, qpc3)
+        new_edge_c = _clip1(
+            pred_c[..., None]
+            + ((inv_c_edge[:, :, :, k, :] + dcC[..., 1:2] + 32) >> 6)
+        ).reshape(R, 2, 8)
+        return (new_edge_y, new_edge_c), (dlvl, clvl, pred_y, pred_c)
+
+    anchor = 0 * dc_y[:, 0, 0, 0]
+    init = (jnp.zeros((R, 16), jnp.int32) + anchor[:, None],
+            jnp.zeros((R, 2, 8), jnp.int32) + anchor[:, None, None])
+    _, (dc_lvls, cdc_lvls, preds_y, preds_c) = jax.lax.scan(
+        step, init, jnp.arange(M, dtype=jnp.int32))
+    return (jnp.moveaxis(dc_lvls, 0, 1), jnp.moveaxis(cdc_lvls, 0, 1),
+            jnp.moveaxis(preds_y, 0, 1), jnp.moveaxis(preds_c, 0, 1))
+
+
+# ---------------------------------------------------------------------------
+# frame assembly (shared I): offsets + sink
+# ---------------------------------------------------------------------------
+
+def _excl_cumsum0(nb):
+    """Exclusive per-slot bit offsets along the stacked slot axis."""
+    return jnp.cumsum(nb, axis=0) - nb
+
+
+def _assemble_frame(R, M, w_cap, e_cap, row_pays, row_nbs,
+                    hdr_pays, hdr_nbs, dpay, dnb, ypay, ynb,
+                    upay_dc, unb_dc, vpay_dc, vnb_dc,
+                    upay, unb, vpay, vnb):
+    """I-frame slot order: row prefix | per MB [hdr(3), lumaDC(36),
+    16 luma AC blocks in scan order (34 each), u DC(12), v DC(12),
+    8 chroma AC (34 each)] | stop bit. Every event class arrives as one
+    stacked (S, ...) pair; offsets are one cumsum per class."""
+    nby, nbx = 4 * R, 4 * M
+    cby, cbx = 2 * R, 2 * M
+
+    # per-block/per-MB bit totals
+    y_bits_blk = ynb.sum(0)                          # (nby, nbx)
+    y_bits_rm = _grid_rm(y_bits_blk, 4, 4)           # (R, M) each
+    dc_bits = dnb.sum(0)                             # (R, M)
+    hdr_bits = hdr_nbs.sum(0)
+    udc_bits = unb_dc.sum(0)
+    vdc_bits = vnb_dc.sum(0)
+    u_bits_rm = _grid_rm(unb.sum(0), 2, 2)
+    v_bits_rm = _grid_rm(vnb.sum(0), 2, 2)
+
+    y_mb = sum(y_bits_rm[i][j] for i, j in _SCAN_ORDER)
+    c_mb = (udc_bits + vdc_bits
+            + sum(u_bits_rm[i][j] for i in range(2) for j in range(2))
+            + sum(v_bits_rm[i][j] for i in range(2) for j in range(2)))
+    mb_bits = hdr_bits + dc_bits + y_mb + c_mb       # (R, M)
+
+    prefix_bits = row_nbs.sum(0)                     # (R,)
+    mb_start = prefix_bits[:, None] + jnp.cumsum(mb_bits, axis=1) - mb_bits
+    total_bits = prefix_bits + jnp.sum(mb_bits, axis=1) + 1   # + stop bit
+
+    sink = _EventSink(R, w_cap)
+    rows_r = jnp.arange(R, dtype=jnp.int32)
+    sink.add(rows_r[None], _excl_cumsum0(row_nbs), row_pays, row_nbs)
+
+    row_rm = rows_r[None, :, None]
+    sink.add(row_rm, mb_start[None] + _excl_cumsum0(hdr_nbs),
+             hdr_pays, hdr_nbs)
+    dc_base = mb_start + hdr_bits
+    sink.add(row_rm, dc_base[None] + _excl_cumsum0(dnb), dpay, dnb)
+
+    # luma AC blocks: per-(by,bx) scan-order starts on the block grid
+    starts_rm = [[None] * 4 for _ in range(4)]
+    acc = dc_base + dc_bits
+    for (i, j) in _SCAN_ORDER:
+        starts_rm[i][j] = acc
+        acc = acc + y_bits_rm[i][j]
+    start_plane = _merge_planes(starts_rm, 4, 4)     # (nby, nbx)
+    row_blk = _row_of_blocks(nby, nbx, 4)
+    sink.add(row_blk[None], start_plane[None] + _excl_cumsum0(ynb),
+             ypay, ynb)
+
+    # chroma DC blocks (u then v), then chroma AC (u raster, v raster)
+    cdc_base = acc                                   # after all luma blocks
+    sink.add(row_rm, cdc_base[None] + _excl_cumsum0(unb_dc),
+             upay_dc, unb_dc)
+    vdc_base = cdc_base + udc_bits
+    sink.add(row_rm, vdc_base[None] + _excl_cumsum0(vnb_dc),
+             vpay_dc, vnb_dc)
+
+    cac_base = vdc_base + vdc_bits
+    u_starts = [[None] * 2 for _ in range(2)]
+    acc_c = cac_base
+    for i in range(2):
+        for j in range(2):
+            u_starts[i][j] = acc_c
+            acc_c = acc_c + u_bits_rm[i][j]
+    v_starts = [[None] * 2 for _ in range(2)]
+    for i in range(2):
+        for j in range(2):
+            v_starts[i][j] = acc_c
+            acc_c = acc_c + v_bits_rm[i][j]
+    row_cblk = _row_of_blocks(cby, cbx, 2)
+    sink.add(row_cblk[None],
+             _merge_planes(u_starts, 2, 2)[None] + _excl_cumsum0(unb),
+             upay, unb)
+    sink.add(row_cblk[None],
+             _merge_planes(v_starts, 2, 2)[None] + _excl_cumsum0(vnb),
+             vpay, vnb)
+
+    # rbsp stop bit
+    sink.add(rows_r, total_bits - 1, jnp.ones((R,), jnp.uint32),
+             jnp.ones((R,), jnp.int32))
+
+    words, n_ev = sink.pack()
+    overflow = jnp.any((n_ev > e_cap) | (total_bits > w_cap * 32))
+    return H264FrameOut(words, total_bits.astype(jnp.int32), overflow, R)
+
+
+# ---------------------------------------------------------------------------
+# P path
+# ---------------------------------------------------------------------------
+
+def h264_encode_p_yuv(yf, uf, vf, ref_y, ref_u, ref_v, qp,
+                      header_pay, header_nb, frame_num,
+                      e_cap: int, w_cap: int,
+                      candidates: tuple = ((0, 0),),
+                      stripe_rows: int | None = None):
+    """Plane-layout twin of ops/h264_encode.h264_encode_p_yuv — same
+    signature, bit-identical output (P_Skip / P_L0_16x16 with motion,
+    one slice per MB row)."""
+    H, W = yf.shape[0], yf.shape[1]
+    R, M = H // 16, W // 16
+    nby, nbx = H // 4, W // 4
+    qp = jnp.broadcast_to(jnp.asarray(qp, jnp.int32), (R,))
+    qpc = _QPC_J[jnp.clip(qp, 0, 51)]
+    fn = jnp.broadcast_to(jnp.asarray(frame_num, jnp.int32), (R,))
+    qp_by = jnp.repeat(qp, 4)[:, None]
+    qpc_by = jnp.repeat(qpc, 2)[:, None]
+    qpc_rm = qpc[:, None]                            # (R, 1) for (R, M)
+
+    cur_y = yf.astype(jnp.int32)
+    cur_u = uf.astype(jnp.int32)
+    cur_v = vf.astype(jnp.int32)
+    rfy = ref_y.astype(jnp.int32)
+    rfu = ref_u.astype(jnp.int32)
+    rfv = ref_v.astype(jnp.int32)
+
+    win = 16 * (stripe_rows if stripe_rows else R)
+    assert H % win == 0, "stripe_rows must tile the frame"
+    if len(candidates) > 1:
+        pred_y, pred_u, pred_v, mv = _motion_select(
+            cur_y, rfy, rfu, rfv, qp, candidates, win)
+    else:
+        pred_y, pred_u, pred_v = rfy, rfu, rfv
+        mv = jnp.zeros((R, M, 2), jnp.int32)
+
+    # ---- residual transforms + quant (planes)
+    wy = fwd4_planes(cur_y - pred_y)
+    wu = fwd4_planes(cur_u - pred_u)
+    wv = fwd4_planes(cur_v - pred_v)
+
+    def quant_all(w, qp_b):
+        return [[_quant_plane(w[i][j], qp_b, _POS_CLS[i][j], 6)
+                 for j in range(4)] for i in range(4)]
+    acl_y = quant_all(wy, qp_by)                     # full 16, DC included
+    acl_u = quant_all(wu, qpc_by)
+    acl_v = quant_all(wv, qpc_by)
+
+    scan_y = [acl_y[i][j] for (i, j) in _ZZ_IJ]
+    zero_c = jnp.zeros((H // 8, W // 8), jnp.int32)
+    scan_u = [acl_u[i][j] if k else zero_c
+              for k, (i, j) in enumerate(_ZZ_IJ)]   # AC only (DC separate)
+    scan_v = [acl_v[i][j] if k else zero_c
+              for k, (i, j) in enumerate(_ZZ_IJ)]
+
+    # ---- chroma DC (2x2 hadamard of the W00s, intra-style quant offset)
+    def cdc_chain(w00):
+        x = [[w00[i::2, j::2] for j in range(2)] for i in range(2)]
+        a, b, c, d = _had2_parts(x[0][0], x[0][1], x[1][0], x[1][1])
+        hd = [[a, b], [c, d]]
+        cl = [[_quant_dc_e(hd[i][j], qpc_rm) for j in range(2)]
+              for i in range(2)]
+        a, b, c, d = _had2_parts(cl[0][0], cl[0][1], cl[1][0], cl[1][1])
+        f2 = [[a, b], [c, d]]
+        dc = [[_dequant_cdc_e(f2[i][j], qpc_rm) for j in range(2)]
+              for i in range(2)]
+        return cl, dc
+    clvl_u, dcC_u = cdc_chain(wu[0][0])
+    clvl_v, dcC_v = cdc_chain(wv[0][0])
+
+    # ---- cbp / coded / skip (all (R, M))
+    nz_y_blk = sum((s != 0) for s in scan_y)         # (nby, nbx) int-ish
+    nz_y_blk = nz_y_blk > 0
+    g8 = (nz_y_blk[0::2, :] | nz_y_blk[1::2, :])
+    g8 = (g8[:, 0::2] | g8[:, 1::2])                 # (2R, 2M) 8x8 groups
+    cbp_luma = (g8[0::2, 0::2].astype(jnp.int32)
+                | (g8[0::2, 1::2].astype(jnp.int32) << 1)
+                | (g8[1::2, 0::2].astype(jnp.int32) << 2)
+                | (g8[1::2, 1::2].astype(jnp.int32) << 3))
+    nz_u = sum((s != 0).astype(jnp.int32) for s in scan_u)
+    nz_v = sum((s != 0).astype(jnp.int32) for s in scan_v)
+    has_cac = jnp.any(((nz_u + nz_v) > 0).reshape(R, 2, M, 2), axis=(1, 3))
+    has_cdc = sum(jnp.abs(clvl_u[i][j]) + jnp.abs(clvl_v[i][j])
+                  for i in range(2) for j in range(2)) > 0
+    cbp_chroma = jnp.where(has_cac, 2, jnp.where(has_cdc, 1, 0))
+    cbp = cbp_luma | (cbp_chroma << 4)
+    mv_nz = (mv[..., 0] != 0) | (mv[..., 1] != 0)
+    coded = (cbp != 0) | mv_nz
+
+    # MV predictor = left neighbour (one slice per MB row, §8.4.1.3)
+    mvp = jnp.concatenate(
+        [jnp.zeros((R, 1, 2), jnp.int32), mv[:, :-1]], axis=1)
+    mvd = mv - mvp
+
+    # ---- per-block gates + nC
+    colg = jax.lax.broadcasted_iota(jnp.int32, (nby, nbx), 1)
+    rowg = jax.lax.broadcasted_iota(jnp.int32, (nby, nbx), 0)
+    g8_idx = ((rowg % 4) >> 1) * 2 + ((colg % 4) >> 1)
+    grp_bit = (jnp.right_shift(_expand(cbp_luma, 4, 4), g8_idx) & 1) == 1
+    coded_blk = _expand(coded, 4, 4)
+    blk_on = grp_bit & coded_blk
+    tc_y = sum((s != 0).astype(jnp.int32) for s in scan_y)
+    nc_y = _nc_planes(jnp.where(blk_on, tc_y, 0), 4)
+    gate_c = _expand(cbp_chroma == 2, 2, 2)
+    nc_u = _nc_planes(jnp.where(gate_c, nz_u, 0), 2)
+    nc_v = _nc_planes(jnp.where(gate_c, nz_v, 0), 2)
+
+    # ---- events (stacked classes)
+    ypay, ynb, _ = cavlc_events_planes(scan_y, nc_y)        # 16-coeff
+    ynb = jnp.where(blk_on[None], ynb, 0)
+    cdc_u_scan = [clvl_u[0][0], clvl_u[0][1], clvl_u[1][0], clvl_u[1][1]]
+    cdc_v_scan = [clvl_v[0][0], clvl_v[0][1], clvl_v[1][0], clvl_v[1][1]]
+    upay_dc, unb_dc, _ = cavlc_events_planes(cdc_u_scan, None,
+                                             chroma_dc=True)
+    vpay_dc, vnb_dc, _ = cavlc_events_planes(cdc_v_scan, None,
+                                             chroma_dc=True)
+    cdc_gate = cbp_chroma > 0
+    unb_dc = jnp.where(cdc_gate[None], unb_dc, 0)
+    vnb_dc = jnp.where(cdc_gate[None], vnb_dc, 0)
+    upay, unb, _ = cavlc_events_planes(scan_u[1:], nc_u)
+    vpay, vnb, _ = cavlc_events_planes(scan_v[1:], nc_v)
+    unb = jnp.where(gate_c[None], unb, 0)
+    vnb = jnp.where(gate_c[None], vnb, 0)
+
+    # ---- recon (decoder-exact)
+    def deq_gated(acl, qp_b, gate):
+        return [[_dequant_plane(jnp.where(gate, acl[i][j], 0), qp_b,
+                                _POS_CLS[i][j])
+                 for j in range(4)] for i in range(4)]
+    d_y = deq_gated(acl_y, qp_by, blk_on)
+    inv_y = inv4_planes(d_y)
+    pred_y_pl = [[pred_y[i::4, j::4] for j in range(4)] for i in range(4)]
+    rec_y = [[_clip1(pred_y_pl[i][j] + ((inv_y[i][j] + 32) >> 6))
+              for j in range(4)] for i in range(4)]
+    recon_y = _merge_planes(rec_y, 4, 4)
+
+    def chroma_recon(acl, dcC, pred, gate_ac, gate_dc):
+        d = [[_dequant_plane(
+            jnp.where(gate_ac, acl[i][j], 0) if (i, j) != (0, 0)
+            else jnp.zeros_like(acl[0][0]), qpc_by, _POS_CLS[i][j])
+            for j in range(4)] for i in range(4)]
+        dc_pl = _merge_planes(
+            [[jnp.where(gate_dc, dcC[i][j], 0) for j in range(2)]
+             for i in range(2)], 2, 2)
+        d[0][0] = dc_pl
+        inv = inv4_planes(d)
+        pp = [[pred[i::4, j::4] for j in range(4)] for i in range(4)]
+        rec = [[_clip1(pp[i][j] + ((inv[i][j] + 32) >> 6))
+                for j in range(4)] for i in range(4)]
+        return _merge_planes(rec, 4, 4)
+    recon_u = chroma_recon(acl_u, dcC_u, pred_u, gate_c, cbp_chroma >= 1)
+    recon_v = chroma_recon(acl_v, dcC_v, pred_v, gate_c, cbp_chroma >= 1)
+
+    out = _assemble_p_frame(
+        R, M, w_cap, e_cap, qp, fn, header_pay, header_nb,
+        cbp, coded, mvd, ypay, ynb, upay_dc, unb_dc, vpay_dc, vnb_dc,
+        upay, unb, vpay, vnb)
+    return out, (recon_y.astype(jnp.uint8), recon_u.astype(jnp.uint8),
+                 recon_v.astype(jnp.uint8))
+
+
+def _assemble_p_frame(R, M, w_cap, e_cap, qp, fn, header_pay, header_nb,
+                      cbp, coded, mvd, ypay, ynb,
+                      upay_dc, unb_dc, vpay_dc, vnb_dc,
+                      upay, unb, vpay, vnb):
+    """P slot order: row prefix [hdr(2), frame_num u(4), '000' flags,
+    qp, deblock] | per MB [skip_run, mb_type, mvd_x, mvd_y, cbp,
+    mb_qp_delta] + residual blocks | trailing skip run | stop bit."""
+    nby, nbx = 4 * R, 4 * M
+    cby, cbx = 2 * R, 2 * M
+
+    # ---- skip runs (prev coded index via inclusive running max)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (R, M), 1)
+    marked = jnp.where(coded, idx, -1)
+    inclusive = jax.lax.associative_scan(jnp.maximum, marked, axis=1)
+    prev_excl = jnp.concatenate(
+        [jnp.full((R, 1), -1, jnp.int32), inclusive[:, :-1]], axis=1)
+    skip_run = idx - prev_excl - 1
+    last_coded = inclusive[:, -1]
+    trailing = (M - 1) - last_coded
+
+    # ---- MB header events
+    sr_pay, sr_nb = _ue_event(jnp.maximum(skip_run, 0))
+    sr_nb = jnp.where(coded, sr_nb, 0)
+    mbt_pay = jnp.ones((R, M), jnp.uint32)
+    mbt_nb = jnp.where(coded, 1, 0)
+    mvdx_pay, mvdx_nb = _se_event(mvd[..., 0])
+    mvdx_nb = jnp.where(coded, mvdx_nb, 0)
+    mvdy_pay, mvdy_nb = _se_event(mvd[..., 1])
+    mvdy_nb = jnp.where(coded, mvdy_nb, 0)
+    cbp_pay, cbp_nb = _ue_event(_CBP2CODE_J[cbp])
+    cbp_nb = jnp.where(coded, cbp_nb, 0)
+    dqp_pay = jnp.ones((R, M), jnp.uint32)
+    dqp_nb = jnp.where(coded & (cbp != 0), 1, 0)     # §7.3.5 gate
+    hdr_pays = jnp.stack([sr_pay, mbt_pay, mvdx_pay, mvdy_pay, cbp_pay,
+                          dqp_pay])
+    hdr_nbs = jnp.stack([sr_nb, mbt_nb, mvdx_nb, mvdy_nb, cbp_nb,
+                         dqp_nb])
+
+    # ---- row prefix events
+    dqp_h = qp - 26
+    qph_pay, qph_nb = _ue_event(jnp.where(dqp_h > 0, 2 * dqp_h - 1,
+                                          -2 * dqp_h))
+    row_pays = jnp.stack([header_pay[:, 0].astype(jnp.uint32),
+                          header_pay[:, 1].astype(jnp.uint32),
+                          (fn & 0xF).astype(jnp.uint32),
+                          jnp.zeros((R,), jnp.uint32), qph_pay,
+                          jnp.full((R,), 2, jnp.uint32)])
+    row_nbs = jnp.stack([header_nb[:, 0].astype(jnp.int32),
+                         header_nb[:, 1].astype(jnp.int32),
+                         jnp.full((R,), 4, jnp.int32),
+                         jnp.full((R,), 3, jnp.int32), qph_nb,
+                         jnp.full((R,), 3, jnp.int32)])
+
+    # ---- bit totals
+    y_bits_rm = _grid_rm(ynb.sum(0), 4, 4)
+    hdr_bits = hdr_nbs.sum(0)
+    udc_bits = unb_dc.sum(0)
+    vdc_bits = vnb_dc.sum(0)
+    u_bits_rm = _grid_rm(unb.sum(0), 2, 2)
+    v_bits_rm = _grid_rm(vnb.sum(0), 2, 2)
+    y_mb = sum(y_bits_rm[i][j] for i, j in _SCAN_ORDER)
+    c_mb = (udc_bits + vdc_bits
+            + sum(u_bits_rm[i][j] for i in range(2) for j in range(2))
+            + sum(v_bits_rm[i][j] for i in range(2) for j in range(2)))
+    mb_bits = hdr_bits + y_mb + c_mb
+
+    tr_pay, tr_nb = _ue_event(jnp.maximum(trailing, 0))
+    tr_nb = jnp.where(trailing > 0, tr_nb, 0)
+
+    prefix_bits = row_nbs.sum(0)
+    mb_start = prefix_bits[:, None] + jnp.cumsum(mb_bits, axis=1) - mb_bits
+    body_end = prefix_bits + jnp.sum(mb_bits, axis=1)
+    total_bits = body_end + tr_nb + 1                # + stop bit
+
+    sink = _EventSink(R, w_cap)
+    rows_r = jnp.arange(R, dtype=jnp.int32)
+    sink.add(rows_r[None], _excl_cumsum0(row_nbs), row_pays, row_nbs)
+
+    row_rm = rows_r[None, :, None]
+    sink.add(row_rm, mb_start[None] + _excl_cumsum0(hdr_nbs),
+             hdr_pays, hdr_nbs)
+
+    starts_rm = [[None] * 4 for _ in range(4)]
+    acc = mb_start + hdr_bits
+    for (i, j) in _SCAN_ORDER:
+        starts_rm[i][j] = acc
+        acc = acc + y_bits_rm[i][j]
+    start_plane = _merge_planes(starts_rm, 4, 4)
+    row_blk = _row_of_blocks(nby, nbx, 4)
+    sink.add(row_blk[None], start_plane[None] + _excl_cumsum0(ynb),
+             ypay, ynb)
+
+    cdc_base = acc
+    sink.add(row_rm, cdc_base[None] + _excl_cumsum0(unb_dc),
+             upay_dc, unb_dc)
+    vdc_base = cdc_base + udc_bits
+    sink.add(row_rm, vdc_base[None] + _excl_cumsum0(vnb_dc),
+             vpay_dc, vnb_dc)
+
+    cac_base = vdc_base + vdc_bits
+    u_starts = [[None] * 2 for _ in range(2)]
+    acc_c = cac_base
+    for i in range(2):
+        for j in range(2):
+            u_starts[i][j] = acc_c
+            acc_c = acc_c + u_bits_rm[i][j]
+    v_starts = [[None] * 2 for _ in range(2)]
+    for i in range(2):
+        for j in range(2):
+            v_starts[i][j] = acc_c
+            acc_c = acc_c + v_bits_rm[i][j]
+    row_cblk = _row_of_blocks(cby, cbx, 2)
+    sink.add(row_cblk[None],
+             _merge_planes(u_starts, 2, 2)[None] + _excl_cumsum0(unb),
+             upay, unb)
+    sink.add(row_cblk[None],
+             _merge_planes(v_starts, 2, 2)[None] + _excl_cumsum0(vnb),
+             vpay, vnb)
+
+    # trailing skip run + stop bit
+    sink.add(rows_r, body_end, tr_pay, tr_nb)
+    sink.add(rows_r, total_bits - 1, jnp.ones((R,), jnp.uint32),
+             jnp.ones((R,), jnp.int32))
+
+    words, n_ev = sink.pack()
+    overflow = jnp.any((n_ev > e_cap) | (total_bits > w_cap * 32))
+    return H264FrameOut(words, total_bits.astype(jnp.int32), overflow, R)

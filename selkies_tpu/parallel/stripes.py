@@ -24,7 +24,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from ..ops.h264_encode import H264FrameOut, h264_encode_yuv
+from ..ops.h264_encode import H264FrameOut
+from ..ops.h264_planes import h264_encode_yuv
 
 try:
     from jax import shard_map

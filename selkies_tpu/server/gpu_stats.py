@@ -162,22 +162,41 @@ def _drm_sysfs_gpus(root: str = "/sys/class/drm",
     return out
 
 
-_dead_stages: set = set()       # stages that yielded nothing: never re-probe
-#                                 (the stats loop calls every few seconds)
+_dead_stages: dict = {}         # stage -> time it yielded nothing
+_DEAD_RETRY_S = 300.0           # re-probe every 5 min: a driver/device
+#                                 that comes up later (container start
+#                                 races) must not be invisible forever
+
+
+def _stage_dead(name: str) -> bool:
+    import time
+    t = _dead_stages.get(name)
+    if t is None:
+        return False
+    if time.monotonic() - t > _DEAD_RETRY_S:
+        del _dead_stages[name]
+        return False
+    return True
+
+
+def _mark_dead(name: str) -> None:
+    import time
+    _dead_stages[name] = time.monotonic()
 
 
 def get_gpus(drm_root: str = "/sys/class/drm") -> list[GPUStat]:
     """Full chain; later stages only add devices not already reported
     (PCI-bus match, falling back to never-duplicating nvidia entries).
-    A stage that reports nothing is cached dead — no per-tick subprocess
-    forks on GPU-less hosts."""
-    gpus = [] if "nvml" in _dead_stages else _nvml_gpus()
+    A stage that reports nothing is cached dead for _DEAD_RETRY_S — no
+    per-tick subprocess forks on GPU-less hosts, but late-arriving
+    drivers are still picked up."""
+    gpus = [] if _stage_dead("nvml") else _nvml_gpus()
     if not gpus:
-        _dead_stages.add("nvml")
-        if "smi" not in _dead_stages:
+        _mark_dead("nvml")
+        if not _stage_dead("smi"):
             gpus = _nvidia_smi_gpus()
             if not gpus:
-                _dead_stages.add("smi")
+                _mark_dead("smi")
     seen_bus = {g.pci_bus for g in gpus if g.pci_bus}
     have_nvidia = any(g.vendor == "nvidia" for g in gpus)
     for g in _drm_sysfs_gpus(drm_root, start_index=len(gpus)):

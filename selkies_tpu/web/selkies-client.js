@@ -75,6 +75,7 @@ class SelkiesClient {
     this.serverSettings = null;
     this.displayW = 0; this.displayH = 0;
     this.videoActive = false;
+    this.touchMode = "direct";        // or "trackpad" (postMessage API)
     this.lastAckFid = -1;
     this.stripeLastFid = new Map();   // y -> last drawn frame id
     this.held = new Set();            // held keysyms
@@ -401,6 +402,7 @@ class SelkiesClient {
       case "MODE": break;
       case "server_settings": this._applyServerSettings(rest); break;
       case "system_stats": this._showStats(rest); break;
+      case "gpu_stats": this._showGpuStats(rest); break;
       case "cursor": this._applyCursor(rest); break;
       case "VIDEO_STARTED": this.videoActive = true; break;
       case "VIDEO_STOPPED": this.videoActive = false; break;
@@ -476,6 +478,13 @@ class SelkiesClient {
         `${this.displayW}x${this.displayH} · encode ${enc} fps · ` +
         `draw ${this._drawFps.toFixed(0)} fps · cpu ${s.cpu_percent}%`);
       this._postToDashboard({ type: "systemStats", payload: s });
+    } catch { /* ignore */ }
+  }
+
+  _showGpuStats(json) {
+    try {
+      this._postToDashboard({ type: "gpuStats",
+                              payload: JSON.parse(json) });
     } catch { /* ignore */ }
   }
 
@@ -724,6 +733,10 @@ class SelkiesClient {
     };
     cv.addEventListener("touchstart", (e) => {
       e.preventDefault();
+      if (this.touchMode === "trackpad") {
+        this._trackpadStart(e);
+        return;
+      }
       if (e.touches.length === 1) {
         const [x, y] = scaleT(e.touches[0]);
         this.send(`m,${x},${y}`);
@@ -742,6 +755,10 @@ class SelkiesClient {
     }, { passive: false });
     cv.addEventListener("touchmove", (e) => {
       e.preventDefault();
+      if (this.touchMode === "trackpad") {
+        this._trackpadMove(e);
+        return;
+      }
       if (e.touches.length === 1 && !twoFinger) {
         commitPress();                  // moving finger = drag, press now
         const [x, y] = scaleT(e.touches[0]);
@@ -757,6 +774,10 @@ class SelkiesClient {
     }, { passive: false });
     cv.addEventListener("touchend", (e) => {
       e.preventDefault();
+      if (this.touchMode === "trackpad") {
+        this._trackpadEnd(e);
+        return;
+      }
       if (twoFinger) {
         if (!twoFinger.moved && performance.now() - twoFinger.t0 < 350) {
           this.send("mb,3,1");          // two-finger tap = right click
@@ -774,6 +795,75 @@ class SelkiesClient {
         }
       }
     }, { passive: false });
+  }
+
+  /* trackpad touch mode (reference lib/input.js trackpad mode): the
+   * canvas is a laptop touchpad — one finger moves the cursor
+   * RELATIVELY (m2 verbs), a quick tap left-clicks, a one-finger
+   * tap-then-drag drags, two-finger pan scrolls, two-finger tap
+   * right-clicks. Switch via postMessage {type:"touchMode"}. */
+  _trackpadStart(e) {
+    const t = e.touches;
+    const now = performance.now();
+    if (t.length === 1) {
+      const tapTap = this._tpLastTap && now - this._tpLastTap < 280;
+      this._tp = { x: t[0].clientX, y: t[0].clientY, t0: now,
+                   moved: false, drag: !!tapTap };
+      if (tapTap) this.send("mb,1,1");       // tap-drag: hold the button
+    } else if (t.length === 2) {
+      // both fingers may land in ONE touchstart (fast two-finger tap):
+      // synthesize the missing one-finger state so the gesture works
+      if (!this._tp)
+        this._tp = { x: t[0].clientX, y: t[0].clientY, t0: now,
+                     moved: false, drag: false };
+      if (this._tp.drag) { this.send("mb,1,0"); this._tp.drag = false; }
+      this._tp.two = { y: t[0].clientY, t0: now, moved: this._tp.moved };
+    }
+  }
+
+  _trackpadMove(e) {
+    const t = e.touches;
+    if (!this._tp) return;
+    if (t.length === 1 && !this._tp.two) {
+      const dx = Math.round((t[0].clientX - this._tp.x) * 1.4);
+      const dy = Math.round((t[0].clientY - this._tp.y) * 1.4);
+      if (dx || dy) {
+        this.send(`m2,${dx},${dy}`);
+        this._tp.x = t[0].clientX;
+        this._tp.y = t[0].clientY;
+        this._tp.moved = true;
+      }
+    } else if (t.length === 2 && this._tp.two) {
+      const dy = t[0].clientY - this._tp.two.y;
+      if (Math.abs(dy) > 12) {
+        this.send(`ms,0,${dy > 0 ? -1 : 1}`);
+        this._tp.two.y = t[0].clientY;
+        this._tp.two.moved = true;
+      }
+    }
+  }
+
+  _trackpadEnd(e) {
+    if (!this._tp) return;
+    const now = performance.now();
+    if (this._tp.two) {
+      if (!this._tp.two.moved && now - this._tp.two.t0 < 350) {
+        this.send("mb,3,1");
+        this.send("mb,3,0");
+        this._tp.two.moved = true;
+      }
+      if (e.touches.length === 0) this._tp = null;
+      return;
+    }
+    if (e.touches.length === 0) {
+      if (this._tp.drag) this.send("mb,1,0");
+      else if (!this._tp.moved && now - this._tp.t0 < 250) {
+        this.send("mb,1,1");
+        this.send("mb,1,0");
+        this._tpLastTap = now;
+      }
+      this._tp = null;
+    }
   }
 
   /* -------------------------------------------------------------- upload
@@ -886,6 +976,9 @@ class SelkiesClient {
       case "videoBitrate": this.send(`vb,${d.kbps | 0}`); break;
       case "audioBitrate": this.send(`ab,${d.bps | 0}`); break;
       case "toggleOsk": this.toggleOnScreenKeyboard(); break;
+      case "touchMode":
+        this.touchMode = d.mode === "trackpad" ? "trackpad" : "direct";
+        break;
       case "clipboard":
         if (typeof d.text === "string")
           this.send(`cw,${btoa(unescape(encodeURIComponent(d.text)))}`);

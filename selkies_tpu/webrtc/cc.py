@@ -1,0 +1,425 @@
+"""Send-side congestion control (GCC) from transport-wide-cc feedback.
+
+The reference runs Google Congestion Control inside its vendored webrtc
+fork — inter-arrival grouping, overuse detection and AIMD in
+src/selkies/webrtc/rate.py:56-491, TWCC feedback surfaced as
+``twcc_estimate`` (rtcrtpsender.py:336-337) and consumed by the CBR
+steering loop (webrtc_mode.py:1652-1716: loss > 10% backs off x0.7,
+clean windows recover x1.15 toward the user ceiling). This is a
+clean-room implementation of the same published algorithm (trendline
+variant) against the same wire format:
+
+- outgoing RTP carries the transport-wide sequence header extension;
+- the browser returns RTCP transport-cc feedback (RTPFB FMT 15,
+  draft-holmer-rmcat-transport-wide-cc-extensions-01);
+- per feedback batch: packets are grouped into 5 ms send bursts, the
+  inter-group delay variation feeds a least-squares trendline whose
+  slope is compared against an adaptive threshold (overuse/underuse/
+  normal), driving an AIMD rate state machine bounded by the acked
+  bitrate; a parallel loss controller applies the reference's x0.7 /
+  x1.15 policy.
+
+Everything takes explicit ``now`` timestamps — fully deterministic for
+tests (tests/test_webrtc_cc.py)."""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import struct
+
+TWCC_EXT_URI = ("http://www.ietf.org/id/"
+                "draft-holmer-rmcat-transport-wide-cc-extensions-01")
+TWCC_EXT_ID = 3
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+def twcc_extension(seq: int, ext_id: int = TWCC_EXT_ID) -> bytes:
+    """One-byte-header extension element carrying the transport-wide
+    sequence number (2 bytes)."""
+    return bytes(((ext_id << 4) | 1,)) + struct.pack("!H", seq & 0xFFFF)
+
+
+@dataclasses.dataclass
+class TwccFeedback:
+    base_seq: int
+    ref_time_us: int                 # reference time in microseconds
+    fb_count: int
+    # (seq, rx_time_us or None) — absolute within the 24-bit ref epoch
+    packets: list
+
+
+def parse_rtcp_twcc(data: bytes) -> list[TwccFeedback]:
+    """Extract transport-cc feedback messages (RTPFB FMT 15) from a
+    (possibly compound) RTCP packet."""
+    out = []
+    off = 0
+    while off + 8 <= len(data):
+        b0, pt, length = struct.unpack_from("!BBH", data, off)
+        size = 4 * (length + 1)
+        if pt == 205 and (b0 & 0x1F) == 15 and off + 16 <= len(data):
+            try:
+                fb = _parse_one_twcc(data[off + 8:off + size])
+                if fb is not None:
+                    out.append(fb)
+            except (struct.error, IndexError):
+                pass
+        off += max(size, 4)
+    return out
+
+
+def _parse_one_twcc(body: bytes) -> TwccFeedback | None:
+    if len(body) < 12:
+        return None
+    base_seq, status_count = struct.unpack_from("!HH", body, 4)
+    ref_fb = struct.unpack_from("!I", body, 8)[0]
+    ref_time = ref_fb >> 8                       # signed 24-bit, 64 ms units
+    if ref_time & 0x800000:
+        ref_time -= 1 << 24
+    fb_count = ref_fb & 0xFF
+    ref_us = ref_time * 64000
+
+    # --- status chunks -> per-packet symbols
+    symbols = []
+    off = 12
+    while len(symbols) < status_count and off + 2 <= len(body):
+        chunk = struct.unpack_from("!H", body, off)[0]
+        off += 2
+        if chunk >> 15 == 0:                     # run-length
+            sym = (chunk >> 13) & 0x3
+            run = chunk & 0x1FFF
+            symbols.extend([sym] * run)
+        elif (chunk >> 14) & 1 == 0:             # 14 x 1-bit symbols
+            for i in range(14):
+                symbols.append((chunk >> (13 - i)) & 1)
+        else:                                    # 7 x 2-bit symbols
+            for i in range(7):
+                symbols.append((chunk >> (12 - 2 * i)) & 0x3)
+    symbols = symbols[:status_count]
+
+    # --- receive deltas
+    t_us = ref_us
+    packets = []
+    for i, sym in enumerate(symbols):
+        seq = (base_seq + i) & 0xFFFF
+        if sym == 1:
+            if off + 1 > len(body):
+                break
+            t_us += body[off] * 250
+            off += 1
+            packets.append((seq, t_us))
+        elif sym == 2:
+            if off + 2 > len(body):
+                break
+            d = struct.unpack_from("!h", body, off)[0]
+            off += 2
+            t_us += d * 250
+            packets.append((seq, t_us))
+        else:
+            packets.append((seq, None))
+    return TwccFeedback(base_seq, ref_us, fb_count, packets)
+
+
+def build_rtcp_twcc(sender_ssrc: int, media_ssrc: int, base_seq: int,
+                    rx_times_us: list, fb_count: int = 0,
+                    ref_time_us: int | None = None) -> bytes:
+    """Feedback builder (the BROWSER's role) — used by loopback tests and
+    any receiving peer we drive ourselves. ``rx_times_us[i]`` is the
+    arrival time of packet base_seq+i, or None if lost."""
+    if ref_time_us is None:
+        first = next((t for t in rx_times_us if t is not None), 0)
+        ref_time_us = (first // 64000) * 64000
+    symbols = []
+    deltas = bytearray()
+    t = ref_time_us
+    for rx in rx_times_us:
+        if rx is None:
+            symbols.append(0)
+            continue
+        d = (rx - t) // 250
+        t += d * 250
+        if 0 <= d <= 0xFF:
+            symbols.append(1)
+            deltas.append(d)
+        else:
+            symbols.append(2)
+            deltas += struct.pack("!h", max(-32768, min(32767, d)))
+    chunks = bytearray()
+    for i in range(0, len(symbols), 7):          # 2-bit vector chunks
+        word = 0xC000
+        for j, s in enumerate(symbols[i:i + 7]):
+            word |= s << (12 - 2 * j)
+        chunks += struct.pack("!H", word)
+    ref_time = (ref_time_us // 64000) & 0xFFFFFF
+    body = struct.pack("!IIHHI", sender_ssrc, media_ssrc, base_seq,
+                       len(symbols),
+                       (ref_time << 8) | (fb_count & 0xFF))
+    body += bytes(chunks) + bytes(deltas)
+    while len(body) % 4:
+        body += b"\x00"
+    return struct.pack("!BBH", 0x80 | 15, 205, len(body) // 4 + 1) + body
+
+
+# ---------------------------------------------------------------------------
+# estimators
+# ---------------------------------------------------------------------------
+
+_BURST_US = 5000
+_TREND_WINDOW = 20
+_THRESHOLD_GAIN = 4.0
+_K_UP = 0.0087
+_K_DOWN = 0.039
+_OVERUSE_TIME_MS = 10.0
+
+
+class TrendlineEstimator:
+    """Inter-group delay-variation trendline + adaptive-threshold overuse
+    detector. States: 'normal' | 'overuse' | 'underuse'."""
+
+    def __init__(self):
+        self._first_group = None
+        self._prev_group = None            # (send_us, arrival_us)
+        self._cur_send = None
+        self._cur_arrival = None
+        self._acc_delay_ms = 0.0
+        self._smoothed_ms = 0.0
+        self._history = collections.deque(maxlen=_TREND_WINDOW)
+        self._num_deltas = 0
+        self._threshold = 12.5
+        self._last_update_ms = None
+        self._overuse_ms = 0.0
+        self._prev_trend = 0.0
+        self.state = "normal"
+
+    def add_packet(self, send_us: int, arrival_us: int) -> None:
+        if self._cur_send is None or send_us - self._cur_send > _BURST_US:
+            if self._cur_send is not None:
+                self._on_group_done()
+            self._cur_send = send_us
+            self._cur_arrival = arrival_us
+        else:
+            self._cur_arrival = max(self._cur_arrival, arrival_us)
+        self._last_arrival = arrival_us
+
+    def _on_group_done(self) -> None:
+        g = (self._cur_send, self._cur_arrival)
+        if self._prev_group is not None:
+            send_d = (g[0] - self._prev_group[0]) / 1000.0
+            arr_d = (g[1] - self._prev_group[1]) / 1000.0
+            delta = arr_d - send_d
+            self._num_deltas += 1
+            self._acc_delay_ms += delta
+            self._smoothed_ms = (0.9 * self._smoothed_ms
+                                 + 0.1 * self._acc_delay_ms)
+            if self._first_group is None:
+                self._first_group = g[1]
+            x = (g[1] - self._first_group) / 1000.0
+            self._history.append((x, self._smoothed_ms))
+            trend = self._slope()
+            self._detect(trend, arr_d)
+        self._prev_group = g
+
+    def flush(self) -> None:
+        """Close the open burst (call once per feedback batch)."""
+        if self._cur_send is not None:
+            self._on_group_done()
+            self._cur_send = None
+
+    def _slope(self) -> float:
+        n = len(self._history)
+        if n < 2:
+            return self._prev_trend
+        mx = sum(p[0] for p in self._history) / n
+        my = sum(p[1] for p in self._history) / n
+        num = sum((p[0] - mx) * (p[1] - my) for p in self._history)
+        den = sum((p[0] - mx) ** 2 for p in self._history)
+        if den == 0:
+            return self._prev_trend
+        return num / den
+
+    def _detect(self, trend: float, ts_delta_ms: float) -> None:
+        modified = (min(self._num_deltas, 60)
+                    * trend * _THRESHOLD_GAIN)
+        if modified > self._threshold:
+            self._overuse_ms += ts_delta_ms
+            if (self._overuse_ms > _OVERUSE_TIME_MS
+                    and trend >= self._prev_trend):
+                self.state = "overuse"
+        elif modified < -self._threshold:
+            self._overuse_ms = 0.0
+            self.state = "underuse"
+        else:
+            self._overuse_ms = 0.0
+            self.state = "normal"
+        self._prev_trend = trend
+        # adaptive threshold (clamped drift toward |modified|)
+        if self._last_update_ms is None:
+            self._last_update_ms = 0.0
+        k = _K_DOWN if abs(modified) < self._threshold else _K_UP
+        self._threshold += k * (abs(modified) - self._threshold) * 30.0
+        self._threshold = min(max(self._threshold, 6.0), 600.0)
+
+
+class AckedBitrate:
+    """Acked throughput over a sliding window."""
+
+    def __init__(self, window_us: int = 500_000):
+        self._window = window_us
+        self._samples = collections.deque()     # (rx_us, size)
+        self._bytes = 0
+
+    def add(self, rx_us: int, size: int) -> None:
+        self._samples.append((rx_us, size))
+        self._bytes += size
+        lo = rx_us - self._window
+        while self._samples and self._samples[0][0] < lo:
+            self._bytes -= self._samples.popleft()[1]
+
+    def bps(self) -> float | None:
+        if len(self._samples) < 2:
+            return None
+        span = self._samples[-1][0] - self._samples[0][0]
+        if span <= 0:
+            return None
+        return self._bytes * 8 * 1e6 / span
+
+
+class AimdRateControl:
+    """Additive-increase / multiplicative-decrease on the detector state."""
+
+    def __init__(self, start_bps: float = 2_000_000.0,
+                 min_bps: float = 150_000.0, max_bps: float = 50_000_000.0):
+        self.rate = start_bps
+        self.min_bps = min_bps
+        self.max_bps = max_bps
+        self._state = "increase"
+        self._last_decrease_bps = None
+        self._last_update_us = None
+
+    def update(self, detector_state: str, acked_bps: float | None,
+               now_us: int) -> float:
+        dt = 0.0
+        if self._last_update_us is not None:
+            dt = min((now_us - self._last_update_us) / 1e6, 1.0)
+        self._last_update_us = now_us
+
+        if detector_state == "overuse":
+            if acked_bps is not None:
+                self.rate = max(self.min_bps, 0.85 * acked_bps)
+                self._last_decrease_bps = acked_bps
+            else:
+                self.rate = max(self.min_bps, 0.85 * self.rate)
+            self._state = "hold"
+        elif detector_state == "underuse":
+            self._state = "hold"
+        else:
+            if self._state == "hold":
+                self._state = "increase"
+            elif self._state == "increase":
+                near_max = (self._last_decrease_bps is not None
+                            and self.rate > 0.95 * self._last_decrease_bps)
+                if near_max:
+                    self.rate += max(4000.0, 0.04 * self.rate) * dt
+                else:
+                    self.rate *= 1.08 ** dt
+        if acked_bps is not None:
+            self.rate = min(self.rate, 1.5 * acked_bps + 10_000)
+        self.rate = min(max(self.rate, self.min_bps), self.max_bps)
+        return self.rate
+
+
+class LossController:
+    """The reference loop's loss policy (webrtc_mode.py:1652-1716):
+    loss > 10%% over a window backs the cap off x0.7 (at most once per
+    backoff interval); loss < 2%% recovers x1.15 toward the ceiling."""
+
+    def __init__(self, ceiling_bps: float, min_bps: float = 150_000.0,
+                 backoff_interval_us: int = 300_000):
+        self.cap = ceiling_bps
+        self.ceiling = ceiling_bps
+        self.min_bps = min_bps
+        self._interval = backoff_interval_us
+        self._last_change_us = None
+
+    def update(self, loss_fraction: float, now_us: int) -> float:
+        if (self._last_change_us is not None
+                and now_us - self._last_change_us < self._interval):
+            return self.cap
+        if loss_fraction > 0.10:
+            self.cap = max(self.min_bps, self.cap * 0.7)
+            self._last_change_us = now_us
+        elif loss_fraction < 0.02:
+            self.cap = min(self.ceiling, self.cap * 1.15)
+            self._last_change_us = now_us
+        return self.cap
+
+
+# ---------------------------------------------------------------------------
+# controller
+# ---------------------------------------------------------------------------
+
+class SendSideCongestionController:
+    """Ties the pieces together for one peer (all media share one
+    transport-wide sequence space, RFC 8888 style)."""
+
+    def __init__(self, ceiling_bps: float = 20_000_000.0,
+                 start_bps: float = 2_000_000.0):
+        self._next_seq = 0
+        self._sent = collections.OrderedDict()   # seq -> (send_us, size)
+        self._trend = TrendlineEstimator()
+        self._acked = AckedBitrate()
+        self._aimd = AimdRateControl(start_bps=start_bps,
+                                     max_bps=ceiling_bps)
+        self._loss = LossController(ceiling_bps)
+        self.target_bps = start_bps
+        self.last_loss_fraction = 0.0
+
+    # -- sender side --------------------------------------------------------
+    def alloc_seq(self) -> int:
+        s = self._next_seq
+        self._next_seq = (self._next_seq + 1) & 0xFFFF
+        return s
+
+    def on_packet_sent(self, seq: int, size: int, now_us: int) -> None:
+        self._sent[seq] = (now_us, size)
+        while len(self._sent) > 4096:
+            self._sent.popitem(last=False)
+
+    # -- feedback -----------------------------------------------------------
+    def on_feedback(self, fb: TwccFeedback, now_us: int) -> float:
+        received = 0
+        lost = 0
+        for seq, rx_us in fb.packets:
+            sent = self._sent.pop(seq, None)
+            if sent is None:
+                continue
+            send_us, size = sent
+            if rx_us is None:
+                lost += 1
+                continue
+            received += 1
+            self._acked.add(rx_us, size)
+            self._trend.add_packet(send_us, rx_us)
+        self._trend.flush()
+        total = received + lost
+        if total:
+            self.last_loss_fraction = lost / total
+        delay_rate = self._aimd.update(self._trend.state,
+                                       self._acked.bps(), now_us)
+        loss_cap = self._loss.update(self.last_loss_fraction, now_us)
+        self.target_bps = max(self._aimd.min_bps,
+                              min(delay_rate, loss_cap))
+        return self.target_bps
+
+    def on_rtcp(self, rtcp: bytes, now_us: int) -> float | None:
+        """Feed a full (decrypted) RTCP packet; returns the new target
+        when it carried transport-cc feedback."""
+        fbs = parse_rtcp_twcc(rtcp)
+        if not fbs:
+            return None
+        for fb in fbs:
+            self.on_feedback(fb, now_us)
+        return self.target_bps

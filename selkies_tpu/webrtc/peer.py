@@ -13,6 +13,7 @@ import logging
 import time
 from typing import Callable, Optional
 
+from .cc import SendSideCongestionController
 from .dtls import DtlsEndpoint, generate_certificate
 from .rtp import (H264Packetizer, OpusPacketizer, parse_rtcp_pli,
                   parse_rtcp_remb)
@@ -43,8 +44,12 @@ class RTCPeer(asyncio.DatagramProtocol):
         self.ice = IceLiteResponder(self.ufrag, self.pwd)
         self.dtls = DtlsEndpoint(server=True)
         self.srtp: SrtpContext | None = None
-        self.video = H264Packetizer()
-        self.audio = OpusPacketizer()
+        # GCC send-side estimate from browser transport-cc feedback; video
+        # and audio share the transport-wide sequence space (reference:
+        # twcc_estimate in rtcrtpsender.py:336-337 feeds the CBR loop)
+        self.cc = SendSideCongestionController()
+        self.video = H264Packetizer(twcc_alloc=self.cc.alloc_seq)
+        self.audio = OpusPacketizer(twcc_alloc=self.cc.alloc_seq)
         self.remote: RemoteDescription | None = None
         self.on_request_keyframe = on_request_keyframe
         self.on_datachannel_message = on_datachannel_message
@@ -163,9 +168,17 @@ class RTCPeer(asyncio.DatagramProtocol):
                 return
             if parse_rtcp_pli(rtcp) and self.on_request_keyframe:
                 self.on_request_keyframe()
+            now_us = int(time.monotonic() * 1e6)
+            gcc = self.cc.on_rtcp(rtcp, now_us)
             remb = parse_rtcp_remb(rtcp)
-            if remb is not None and self.on_bitrate_estimate:
-                self.on_bitrate_estimate(remb)
+            if self.on_bitrate_estimate:
+                # send-side GCC is authoritative when feedback flows;
+                # REMB is the receiver-computed fallback estimate
+                if gcc is not None:
+                    self.on_bitrate_estimate(
+                        int(min(gcc, remb) if remb else gcc))
+                elif remb is not None:
+                    self.on_bitrate_estimate(remb)
         # inbound RTP (browser mic) is handled by the service if wired
 
     # -- signaling ----------------------------------------------------------
@@ -199,9 +212,12 @@ class RTCPeer(asyncio.DatagramProtocol):
             return 0
         ts = self.video_timestamp() if timestamp is None else timestamp
         pkts = self.video.packetize(annexb, ts)
+        now_us = int(time.monotonic() * 1e6)
         for p in pkts:
-            self._transport.sendto(self.srtp.protect_rtp(p.to_bytes()),
-                                   self._peer_addr)
+            wire = self.srtp.protect_rtp(p.to_bytes())
+            self._transport.sendto(wire, self._peer_addr)
+            if p.twcc_seq is not None:
+                self.cc.on_packet_sent(p.twcc_seq, len(wire), now_us)
         now = time.monotonic()
         if now - self._last_sr > 1.0:
             self._last_sr = now
@@ -214,8 +230,11 @@ class RTCPeer(asyncio.DatagramProtocol):
         if not self.can_send:
             return 0
         p = self.audio.packetize(opus, timestamp)
-        self._transport.sendto(self.srtp.protect_rtp(p.to_bytes()),
-                               self._peer_addr)
+        wire = self.srtp.protect_rtp(p.to_bytes())
+        self._transport.sendto(wire, self._peer_addr)
+        if p.twcc_seq is not None:
+            self.cc.on_packet_sent(p.twcc_seq, len(wire),
+                                   int(time.monotonic() * 1e6))
         return 1
 
     def close(self) -> None:

@@ -17,22 +17,33 @@ import time
 
 class RtpPacket:
     __slots__ = ("payload_type", "seq", "timestamp", "ssrc", "marker",
-                 "payload")
+                 "payload", "extensions", "twcc_seq")
 
     def __init__(self, payload_type: int, seq: int, timestamp: int,
-                 ssrc: int, marker: bool, payload: bytes):
+                 ssrc: int, marker: bool, payload: bytes,
+                 extensions: list | None = None):
         self.payload_type = payload_type
         self.seq = seq
         self.timestamp = timestamp
         self.ssrc = ssrc
         self.marker = marker
         self.payload = payload
+        self.extensions = extensions     # [(id, data)] one-byte-header
+        self.twcc_seq = None             # transport-wide seq when stamped
 
     def to_bytes(self) -> bytes:
+        b0 = 0x80 | (0x10 if self.extensions else 0)
         b1 = (0x80 if self.marker else 0) | self.payload_type
-        return struct.pack("!BBHII", 0x80, b1, self.seq & 0xFFFF,
-                           self.timestamp & 0xFFFFFFFF, self.ssrc) \
-            + self.payload
+        head = struct.pack("!BBHII", b0, b1, self.seq & 0xFFFF,
+                           self.timestamp & 0xFFFFFFFF, self.ssrc)
+        if self.extensions:
+            body = b"".join(
+                bytes(((eid << 4) | (len(data) - 1),)) + data
+                for eid, data in self.extensions)
+            while len(body) % 4:
+                body += b"\x00"
+            head += struct.pack("!HH", 0xBEDE, len(body) // 4) + body
+        return head + self.payload
 
     @classmethod
     def parse(cls, data: bytes) -> "RtpPacket":
@@ -79,11 +90,12 @@ class H264Packetizer:
     unit; marker set on the AU's last packet."""
 
     def __init__(self, payload_type: int = 102, ssrc: int | None = None,
-                 mtu: int = 1200):
+                 mtu: int = 1200, twcc_alloc=None):
         self.payload_type = payload_type
         self.ssrc = ssrc if ssrc is not None else secrets.randbits(32)
         self.mtu = mtu
         self.seq = secrets.randbits(16)
+        self.twcc_alloc = twcc_alloc     # () -> transport-wide seq
         self._octets = 0
         self._packets = 0
 
@@ -111,6 +123,11 @@ class H264Packetizer:
     def _pkt(self, payload: bytes, ts: int) -> RtpPacket:
         p = RtpPacket(self.payload_type, self.seq, ts, self.ssrc, False,
                       payload)
+        if self.twcc_alloc is not None:
+            from .cc import TWCC_EXT_ID
+            p.twcc_seq = self.twcc_alloc()
+            p.extensions = [(TWCC_EXT_ID,
+                             struct.pack("!H", p.twcc_seq & 0xFFFF))]
         self.seq = (self.seq + 1) & 0xFFFF
         self._octets += len(payload)
         self._packets += 1
@@ -129,14 +146,21 @@ class H264Packetizer:
 class OpusPacketizer:
     """RFC 7587: one Opus frame per packet, 48 kHz RTP clock."""
 
-    def __init__(self, payload_type: int = 111, ssrc: int | None = None):
+    def __init__(self, payload_type: int = 111, ssrc: int | None = None,
+                 twcc_alloc=None):
         self.payload_type = payload_type
         self.ssrc = ssrc if ssrc is not None else secrets.randbits(32)
         self.seq = secrets.randbits(16)
+        self.twcc_alloc = twcc_alloc
 
     def packetize(self, opus_frame: bytes, timestamp: int) -> RtpPacket:
         p = RtpPacket(self.payload_type, self.seq, timestamp, self.ssrc,
                       True, opus_frame)
+        if self.twcc_alloc is not None:
+            from .cc import TWCC_EXT_ID
+            p.twcc_seq = self.twcc_alloc()
+            p.extensions = [(TWCC_EXT_ID,
+                             struct.pack("!H", p.twcc_seq & 0xFFFF))]
         self.seq = (self.seq + 1) & 0xFFFF
         return p
 

@@ -43,6 +43,8 @@ def build_offer(host: str, port: int, ufrag: str, pwd: str,
     # profile f4001f enables Hi444PP for 4:4:4 streams (the reference's
     # fullcolor munge, rtc.py:649-717); 42e01f is constrained baseline
     profile = "f4001f" if fullcolor else "42e01f"
+    from .cc import TWCC_EXT_ID, TWCC_EXT_URI
+    extmap = f"a=extmap:{TWCC_EXT_ID} {TWCC_EXT_URI}"
     media = [
         (f"m=video {port} UDP/TLS/RTP/SAVPF {video_pt}", [
             f"a=rtpmap:{video_pt} H264/90000",
@@ -51,6 +53,8 @@ def build_offer(host: str, port: int, ufrag: str, pwd: str,
             f"a=rtcp-fb:{video_pt} nack pli",
             f"a=rtcp-fb:{video_pt} ccm fir",
             f"a=rtcp-fb:{video_pt} goog-remb",
+            f"a=rtcp-fb:{video_pt} transport-cc",
+            extmap,
         ]),
     ]
     if with_audio:
@@ -58,6 +62,8 @@ def build_offer(host: str, port: int, ufrag: str, pwd: str,
             (f"m=audio {port} UDP/TLS/RTP/SAVPF {audio_pt}", [
                 f"a=rtpmap:{audio_pt} opus/48000/2",
                 f"a=fmtp:{audio_pt} minptime=10;useinbandfec=1",
+                f"a=rtcp-fb:{audio_pt} transport-cc",
+                extmap,
             ]))
     for i, (mline, extra) in enumerate(media):
         lines.append(mline)

@@ -76,6 +76,20 @@ def _rtp_iv(salt: bytes, ssrc: int, index: int) -> bytes:
     return (x << 16).to_bytes(16, "big")
 
 
+def _rtp_header_len(pkt: bytes) -> int:
+    """RTP header length incl. CSRCs and header extension — SRTP encrypts
+    the payload ONLY (RFC 3711 §3.1: the extension stays in the clear;
+    the browser reads our transport-cc seq out of it)."""
+    off = 12 + 4 * (pkt[0] & 0x0F)
+    if pkt[0] & 0x10:
+        if len(pkt) < off + 4:
+            raise SrtpError("short RTP extension")
+        off += 4 + 4 * struct.unpack_from("!H", pkt, off + 2)[0]
+    if off > len(pkt):
+        raise SrtpError("bad RTP header length")
+    return off
+
+
 class SrtpError(Exception):
     pass
 
@@ -107,9 +121,10 @@ class SrtpContext:
         st.roc[ssrc] = roc
         st.last_seq[ssrc] = seq
         index = (roc << 16) | seq
+        hdr = _rtp_header_len(packet)
         payload = _aes_ctr(st.enc_key, _rtp_iv(st.salt, ssrc, index),
-                           packet[12:])
-        authed = packet[:12] + payload
+                           packet[hdr:])
+        authed = packet[:hdr] + payload
         tag = hmac.new(st.auth_key,
                        authed + struct.pack("!I", roc), sha1).digest()
         return authed + tag[:self.AUTH_TAG]
@@ -141,8 +156,10 @@ class SrtpContext:
         if guess > roc or (last is not None and seq > last) or last is None:
             st.roc[ssrc] = guess
             st.last_seq[ssrc] = seq
-        return body[:12] + _aes_ctr(st.enc_key,
-                                    _rtp_iv(st.salt, ssrc, index), body[12:])
+        hdr = _rtp_header_len(body)
+        return body[:hdr] + _aes_ctr(st.enc_key,
+                                     _rtp_iv(st.salt, ssrc, index),
+                                     body[hdr:])
 
     # -- RTCP (always E-bit encrypted) -------------------------------------
     def protect_rtcp(self, packet: bytes) -> bytes:

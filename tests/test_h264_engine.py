@@ -127,6 +127,11 @@ def test_screen_capture_h264_mode_delivers():
     got = []
     cap = ScreenCapture(source_kind="synthetic")
     cap.start_capture(got.append, CaptureSettings(**SMALL))
+    # first chunk pays jit compile (slow on a loaded 1-core CI box);
+    # after that, chunks must flow at frame cadence
+    first_by = time.time() + 300
+    while time.time() < first_by and not got:
+        time.sleep(0.05)
     deadline = time.time() + 30
     while time.time() < deadline and len(got) < 4:
         time.sleep(0.05)

@@ -1,0 +1,134 @@
+"""GCC send-side congestion control: TWCC wire format round-trips, the
+trendline detector's three states, AIMD behavior, and the reference loss
+policy (x0.7 backoff / x1.15 recovery, webrtc_mode.py:1652-1716)."""
+
+import struct
+
+from selkies_tpu.webrtc.cc import (AckedBitrate, AimdRateControl,
+                                   LossController,
+                                   SendSideCongestionController,
+                                   TrendlineEstimator, TWCC_EXT_ID,
+                                   build_rtcp_twcc, parse_rtcp_twcc)
+from selkies_tpu.webrtc.rtp import H264Packetizer, RtpPacket
+
+
+def test_twcc_feedback_roundtrip():
+    times = [1_000_000 + i * 2_000 for i in range(10)]
+    times[3] = None                       # lost
+    times[7] = None
+    pkt = build_rtcp_twcc(1, 2, base_seq=100, rx_times_us=times)
+    fbs = parse_rtcp_twcc(pkt)
+    assert len(fbs) == 1
+    fb = fbs[0]
+    assert fb.base_seq == 100
+    assert len(fb.packets) == 10
+    got = {seq: t for seq, t in fb.packets}
+    assert got[103] is None and got[107] is None
+    # delta quantisation is 250 us — times must round-trip exactly here
+    assert got[100] == 1_000_000
+    assert got[109] == 1_018_000
+
+
+def test_twcc_large_negative_delta():
+    times = [64_000 * 10, 64_000 * 10 - 30_000]      # re-ordered arrival
+    pkt = build_rtcp_twcc(1, 2, base_seq=5, rx_times_us=times)
+    fb = parse_rtcp_twcc(pkt)[0]
+    assert fb.packets[1][1] == 64_000 * 10 - 30_000
+
+
+def test_rtp_extension_roundtrip():
+    cc = SendSideCongestionController()
+    pk = H264Packetizer(twcc_alloc=cc.alloc_seq)
+    pkts = pk.packetize(b"\x00\x00\x00\x01\x65" + b"x" * 50, 1234)
+    assert len(pkts) == 1
+    p = pkts[0]
+    assert p.twcc_seq == 0
+    wire = p.to_bytes()
+    assert wire[0] & 0x10                            # X bit set
+    assert struct.unpack_from("!H", wire, 12)[0] == 0xBEDE
+    # parse() must skip the extension and recover the payload
+    back = RtpPacket.parse(wire)
+    assert back.payload == p.payload
+
+
+def test_trendline_normal_and_overuse():
+    t = TrendlineEstimator()
+    # constant delay: send every 10ms, arrive 5ms later -> normal
+    for i in range(30):
+        t.add_packet(i * 10_000, i * 10_000 + 5_000)
+    t.flush()
+    assert t.state == "normal"
+    # growing queue: arrival delta exceeds send delta consistently
+    t2 = TrendlineEstimator()
+    for i in range(60):
+        t2.add_packet(i * 10_000, i * 10_000 + 5_000 + i * 3_000)
+    t2.flush()
+    assert t2.state == "overuse"
+
+
+def test_aimd_decrease_on_overuse_and_recovery():
+    a = AimdRateControl(start_bps=4_000_000)
+    r1 = a.update("overuse", 3_000_000.0, 1_000_000)
+    assert r1 == 0.85 * 3_000_000.0
+    # normal periods recover (hold -> increase)
+    r2 = a.update("normal", 3_000_000.0, 2_000_000)
+    r3 = a.update("normal", 3_000_000.0, 3_000_000)
+    assert r3 >= r2 >= r1
+
+
+def test_acked_bitrate_window():
+    ab = AckedBitrate(window_us=1_000_000)
+    for i in range(11):
+        ab.add(i * 100_000, 12_500)      # 12.5 kB / 100 ms = 1 Mbps
+    bps = ab.bps()
+    assert bps is not None and 0.8e6 < bps < 1.2e6
+
+
+def test_loss_controller_reference_policy():
+    lc = LossController(ceiling_bps=10_000_000, backoff_interval_us=0)
+    c1 = lc.update(0.2, 1_000_000)
+    assert c1 == 10_000_000 * 0.7
+    c2 = lc.update(0.2, 2_000_000)
+    assert c2 == c1 * 0.7
+    c3 = lc.update(0.0, 3_000_000)
+    assert c3 == min(10_000_000, c2 * 1.15)
+    # mid-range loss holds
+    assert lc.update(0.05, 4_000_000) == c3
+
+
+def test_controller_end_to_end_backoff():
+    """Sustained queue growth reported via TWCC must pull the target
+    below its start value; clean feedback must let it climb again."""
+    cc = SendSideCongestionController(start_bps=4_000_000.0)
+    start = cc.target_bps
+    now = 0
+
+    def feed(n, queue_per_pkt_us, lost_every=0):
+        nonlocal now
+        seqs, times = [], []
+        for i in range(n):
+            s = cc.alloc_seq()
+            cc.on_packet_sent(s, 1200, now)
+            lost = lost_every and (i % lost_every == 0)
+            times.append(None if lost
+                         else now + 5_000 + i * queue_per_pkt_us)
+            seqs.append(s)
+            now += 10_000
+        fb = build_rtcp_twcc(1, 2, seqs[0], times)
+        for f in parse_rtcp_twcc(fb):
+            cc.on_feedback(f, now)
+
+    for _ in range(6):
+        feed(20, 4_000)                  # 4ms of queue per packet
+    assert cc.target_bps < start
+    low = cc.target_bps
+    for _ in range(30):
+        feed(20, 0)
+    assert cc.target_bps > low
+
+
+def test_sdp_offers_transport_cc():
+    from selkies_tpu.webrtc.sdp import build_offer
+    sdp = build_offer("127.0.0.1", 5000, "u", "p", "AA:BB")
+    assert "transport-cc" in sdp
+    assert f"a=extmap:{TWCC_EXT_ID} " in sdp

@@ -1,0 +1,145 @@
+"""Microbenchmarks steering the TPU layout redesign of the H.264 path.
+
+Answers, on the live backend:
+1. small-table lookup: jnp.take vs one-hot f32 matmul (CAVLC tables)
+2. scatter-add cost at bitstream-packer scale
+3. plane-sliced butterfly transform vs the (..., 4, 4) einsum layout
+4. motion SAD reduce cost at candidate-set scale
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from selkies_tpu.compile_cache import enable as enable_compile_cache
+
+enable_compile_cache(jax)
+
+
+def t(fn, *args, n=5, warm=2):
+    for _ in range(warm):
+        jax.block_until_ready(fn(*args))
+    t0 = time.monotonic()
+    for _ in range(n):
+        jax.block_until_ready(fn(*args))
+    return (time.monotonic() - t0) / n
+
+
+def main():
+    print("backend:", jax.default_backend(), flush=True)
+    rng = np.random.default_rng(0)
+
+    # --- 1. table lookup: (272, 480) indices into a 272-entry table ------
+    table = jnp.asarray(rng.integers(0, 1 << 20, 272, dtype=np.int32))
+    idx = jnp.asarray(rng.integers(0, 272, (272, 480), dtype=np.int32))
+
+    f_take = jax.jit(lambda ix: jnp.take(table, ix))
+    print(f"take 272-table (272,480) idx: {t(f_take, idx)*1e3:.3f} ms",
+          flush=True)
+
+    tab_f = table.astype(jnp.float32)
+
+    def onehot_lookup(ix):
+        oh = (ix[..., None] == jnp.arange(272, dtype=jnp.int32)) \
+            .astype(jnp.float32)
+        return (oh @ tab_f).astype(jnp.int32)
+    f_oh = jax.jit(onehot_lookup)
+    print(f"one-hot f32 matmul lookup:    {t(f_oh, idx)*1e3:.3f} ms",
+          flush=True)
+
+    # 30 lookups fused in one program (the per-frame reality)
+    f_take30 = jax.jit(lambda ix: sum(
+        jnp.take(table, (ix + k) % 272) for k in range(30)))
+    print(f"take x30 fused:               {t(f_take30, idx)*1e3:.3f} ms",
+          flush=True)
+
+    # --- 2. scatter-add at packer scale ---------------------------------
+    R, S, w_cap = 68, 105491, 23040
+    vals = jnp.asarray(rng.integers(0, 1 << 31, (R, S), dtype=np.int64)
+                       .astype(np.uint32))
+    # monotone per-row offsets like real bit offsets (~73 bits/MB avg)
+    offs = np.sort(rng.integers(0, w_cap, (R, S), dtype=np.int32), axis=1)
+    base = (np.arange(R, dtype=np.int32) * w_cap)[:, None]
+    flat_idx = jnp.asarray((offs + base).reshape(-1))
+    fvals = vals.reshape(-1)
+
+    def scat(ix, v):
+        return jnp.zeros((R * w_cap,), jnp.uint32).at[ix].add(
+            v, mode="drop")
+    f_scat = jax.jit(scat)
+    print(f"scatter-add {R*S/1e6:.1f}M -> {R*w_cap/1e6:.1f}M words: "
+          f"{t(f_scat, flat_idx, fvals)*1e3:.3f} ms", flush=True)
+
+    # same but 2 scatters (the real packer does hi+lo)
+    f_scat2 = jax.jit(lambda ix, v: scat(ix, v) + scat(ix, v ^ 1))
+    print(f"scatter-add x2:               "
+          f"{t(f_scat2, flat_idx, fvals)*1e3:.3f} ms", flush=True)
+
+    # --- 3. transforms: plane butterflies vs (...,4,4) einsum ------------
+    H, W = 1088, 1920
+    x = jnp.asarray(rng.integers(0, 256, (H, W), dtype=np.int32))
+
+    def fwd_planes(p):
+        x0, x1, x2, x3 = (p[0::4, :], p[1::4, :], p[2::4, :], p[3::4, :])
+        s0, s1, d0, d1 = x0 + x3, x1 + x2, x0 - x3, x1 - x2
+        rows = (s0 + s1, 2 * d0 + d1, s0 - s1, d0 - 2 * d1)
+        out = []
+        for r in rows:
+            c0, c1, c2, c3 = (r[:, 0::4], r[:, 1::4], r[:, 2::4],
+                              r[:, 3::4])
+            s0, s1, d0, d1 = c0 + c3, c1 + c2, c0 - c3, c1 - c2
+            out.extend([s0 + s1, 2 * d0 + d1, s0 - s1, d0 - 2 * d1])
+        return sum(out)          # reduce so nothing is DCE'd
+    f_pl = jax.jit(fwd_planes)
+    print(f"fwd4 plane-sliced ({H}x{W}):  {t(f_pl, x)*1e3:.3f} ms",
+          flush=True)
+
+    from selkies_tpu.ops.h264_transform import forward4x4
+
+    def fwd_einsum(p):
+        b = p.reshape(H // 4, 4, W // 4, 4).swapaxes(1, 2)
+        return forward4x4(b).sum()
+    f_es = jax.jit(fwd_einsum)
+    print(f"fwd4 einsum (...,4,4):        {t(f_es, x)*1e3:.3f} ms",
+          flush=True)
+
+    # --- 4. motion SAD at candidate scale --------------------------------
+    cur = jnp.asarray(rng.integers(0, 256, (H, W), dtype=np.int32))
+    ref = jnp.asarray(rng.integers(0, 256, (H, W), dtype=np.int32))
+    K = 57
+
+    def sad_all(c, r):
+        costs = []
+        for k in range(K):
+            sh = jnp.roll(r, k % 8 - 4, axis=0)
+            sad = jnp.abs(c - sh).reshape(68, 16, 120, 16).sum(axis=(1, 3))
+            costs.append(sad)
+        return jnp.argmin(jnp.stack(costs), axis=0)
+    f_sad = jax.jit(sad_all)
+    print(f"SAD x{K} cands + argmin:      {t(f_sad, cur, ref)*1e3:.3f} ms",
+          flush=True)
+
+    # plane-friendly SAD: reduce via (68,16,120,16) -> strided adds
+    def sad_planes(c, r):
+        costs = []
+        for k in range(K):
+            sh = jnp.roll(r, k % 8 - 4, axis=0)
+            d = jnp.abs(c - sh)
+            # sum 16x16 tiles with large-minor-dim partial sums
+            col = d.reshape(68, 16, W).sum(axis=1)          # (68, W)
+            costs.append(col.reshape(68, 120, 16).sum(axis=-1))
+        return jnp.argmin(jnp.stack(costs), axis=0)
+    f_sadp = jax.jit(sad_planes)
+    print(f"SAD x{K} plane-reduce:        {t(f_sadp, cur, ref)*1e3:.3f} ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

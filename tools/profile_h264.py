@@ -1,9 +1,19 @@
 """Decompose the 1080p H.264 frame time on the live backend.
 
-Times each stage of the device program separately (jitted in isolation):
-colorspace, transform+scan, CAVLC event build, and the bit-packer's three
-internal phases (argsort front-pack, searchsorted compaction, word
-materialisation). Run on the real TPU to find where the 4.1 s/frame goes.
+Times the PRODUCTION pipeline (ops/h264_planes — what the engine runs)
+at function granularity, calling the real module functions rather than
+restating them (so the profile can't drift from the code):
+
+  csc          rgb -> yuv420 (planes module)
+  fwd4 x3      stride-4 plane butterflies, all three components
+  cavlc y      stacked CAVLC event build, luma-shaped (15-coeff AC)
+  cavlc cac    chroma-AC-shaped
+  full I       h264_planes.h264_encode_yuv end to end
+  full P       h264_planes.h264_encode_p_yuv end to end (motion on)
+  (residual = full - parts ~= quant/dequant + offsets + scatter pack)
+
+Then the engine session steps exactly as the capture thread drives them.
+Uses the persistent compile cache (first run pays the builds once).
 """
 
 import os
@@ -19,7 +29,7 @@ import numpy as np
 
 from selkies_tpu.compile_cache import enable as enable_compile_cache
 
-enable_compile_cache(jax)   # repeat profiling must not re-pay ~5min builds
+enable_compile_cache(jax)
 
 
 def t(fn, *args, n=3, warm=1):
@@ -32,12 +42,13 @@ def t(fn, *args, n=3, warm=1):
 
 
 def main():
+    from selkies_tpu.codecs import h264 as hc
     from selkies_tpu.engine.h264_encoder import (H264EncoderSession,
                                                  h264_buffer_caps,
                                                  plan_h264_grid)
     from selkies_tpu.engine.types import CaptureSettings
     from selkies_tpu.ops import h264_encode as He
-    from selkies_tpu.ops.bitpack import pack_slot_events
+    from selkies_tpu.ops import h264_planes as Hp
 
     print("backend:", jax.default_backend(), flush=True)
     s = CaptureSettings(capture_width=1920, capture_height=1080,
@@ -45,82 +56,75 @@ def main():
                         use_paint_over=False)
     g = plan_h264_grid(s)
     e_cap, w_cap, out_cap = h264_buffer_caps(g)
-    R = g.n_stripes * g.rows_per_stripe          # MB rows
+    R = g.n_stripes * g.rows_per_stripe
     M = g.mb_w
-    print(f"grid {g.width}x{g.height} R={R} M={M} "
-          f"e_cap={e_cap} w_cap={w_cap}", flush=True)
+    H, W = g.height, g.width
+    print(f"grid {W}x{H} R={R} M={M} e_cap={e_cap} w_cap={w_cap}",
+          flush=True)
 
     rng = np.random.default_rng(0)
-    frame = jnp.asarray(rng.integers(0, 256, (g.height, g.width, 3),
-                                     dtype=np.uint8))
+    frame = jnp.asarray(rng.integers(0, 256, (H, W, 3), dtype=np.uint8))
 
-    # colorspace alone (cheap stages first: a killed run still reports)
-    f_csc = jax.jit(He.rgb_to_yuv420)
-    t_csc = t(f_csc, frame)
-    print(f"rgb_to_yuv420: {t_csc*1e3:.1f} ms", flush=True)
+    # --- stages (cheap compiles first so a killed run still reports)
+    f_csc = jax.jit(Hp.rgb_to_yuv420)
+    print(f"csc:        {t(f_csc, frame)*1e3:8.2f} ms", flush=True)
+    yf, uf, vf = [jnp.asarray(a) for a in f_csc(frame)]
 
-    # pack_slot_events standalone on synthetic events:
-    S = 9 + M * He.SLOTS_MB + 2
-    pay_r = rng.integers(0, 2**16, (R, S), dtype=np.uint32)
-    # realistic sparsity: ~25 active events per MB (73 bits/MB measured)
-    active = rng.random((R, S)) < (25.0 * M / S)
-    nb_r = np.where(active, rng.integers(1, 17, (R, S)), 0).astype(np.int32)
-    payj, nbj = jnp.asarray(pay_r), jnp.asarray(nb_r)
+    f_fwd = jax.jit(lambda y, u, v: sum(
+        p for comp in (Hp.fwd4_planes(y), Hp.fwd4_planes(u),
+                       Hp.fwd4_planes(v))
+        for row in comp for p in row))
+    print(f"fwd4 x3:    {t(f_fwd, yf, uf, vf)*1e3:8.2f} ms", flush=True)
 
-    f_pack = jax.jit(lambda p, nbts: pack_slot_events(p, nbts, e_cap,
-                                                      w_cap)[0])
-    t_pack = t(f_pack, payj, nbj)
-    print(f"pack_slot_events (R={R} x S={S}): {t_pack*1e3:.0f} ms",
+    # realistic sparsity: ~6 nonzero AC coeffs per 4x4 block at desktop QPs
+    def mk_levels(shape):
+        lv = rng.integers(-8, 9, (15,) + shape).astype(np.int32)
+        keep = rng.random((15,) + shape) < 0.4
+        return jnp.asarray(np.where(keep, lv, 0))
+    scan_y = mk_levels((H // 4, W // 4))
+    nc = jnp.zeros((H // 4, W // 4), jnp.int32)
+    f_cavlc = jax.jit(lambda sc, n: Hp.cavlc_events_planes(sc, n)[0])
+    print(f"cavlc y:    {t(f_cavlc, scan_y, nc)*1e3:8.2f} ms", flush=True)
+    scan_c = mk_levels((H // 8, W // 8))
+    nc_c = jnp.zeros((H // 8, W // 8), jnp.int32)
+    f_cavlc_c = jax.jit(lambda sc, n: Hp.cavlc_events_planes(sc, n)[0])
+    print(f"cavlc cac:  {t(f_cavlc_c, scan_c, nc_c)*1e3:8.2f} ms",
           flush=True)
 
-    # pack internals
-    def front_pack(p, nbts):
-        m_, s_ = p.shape
-        act = nbts > 0
-        slot_idx = jax.lax.broadcasted_iota(jnp.int32, (m_, s_), 1)
-        order = jnp.argsort(jnp.where(act, slot_idx, s_ + slot_idx), axis=1)
-        return jnp.take_along_axis(p, order, axis=1)
-    t_sort = t(jax.jit(front_pack), payj, nbj)
-    print(f"  argsort front-pack: {t_sort*1e3:.0f} ms", flush=True)
+    # --- full frame programs (the things that matter)
+    pay, nb = hc.slice_header_events(M, R)
+    f_i = jax.jit(lambda y, u, v: Hp.h264_encode_yuv(
+        y, u, v, 28, jnp.asarray(pay), jnp.asarray(nb), e_cap,
+        w_cap).words)
+    ti = t(f_i, yf, uf, vf)
+    print(f"full I:     {ti*1e3:8.2f} ms", flush=True)
 
-    def compact(p, nbts):
-        m_, s_ = p.shape
-        act = (nbts > 0)
-        c_b = jnp.sum(act.astype(jnp.int32), axis=1)
-        block_start_evt = jnp.cumsum(c_b) - c_b
-        e_idx = jnp.arange(e_cap, dtype=jnp.int32)
-        b = jnp.clip(jnp.searchsorted(block_start_evt, e_idx,
-                                      side="right") - 1, 0, m_ - 1)
-        slot = jnp.clip(e_idx - block_start_evt[b], 0, s_ - 1)
-        return p[b, slot]
-    t_comp = t(jax.jit(compact), payj, nbj)
-    print(f"  searchsorted+gather compaction: {t_comp*1e3:.0f} ms",
+    ppay, pnb = hc.p_slice_header_events(M, R)
+    cands = He.scroll_candidates(24, 8)
+    ry = jnp.asarray(rng.integers(0, 256, (H, W), np.uint8))
+    ru = jnp.asarray(rng.integers(0, 256, (H // 2, W // 2), np.uint8))
+    rv = jnp.asarray(rng.integers(0, 256, (H // 2, W // 2), np.uint8))
+    f_p = jax.jit(lambda y, u, v: Hp.h264_encode_p_yuv(
+        y, u, v, ry, ru, rv, 28, jnp.asarray(ppay), jnp.asarray(pnb), 1,
+        e_cap, w_cap, candidates=cands,
+        stripe_rows=g.rows_per_stripe)[0].words)
+    tp = t(f_p, yf, uf, vf)
+    print(f"full P:     {tp*1e3:8.2f} ms  (motion K={len(cands)})",
           flush=True)
+    f_p0 = jax.jit(lambda y, u, v: Hp.h264_encode_p_yuv(
+        y, u, v, ry, ru, rv, 28, jnp.asarray(ppay), jnp.asarray(pnb), 1,
+        e_cap, w_cap, candidates=((0, 0),),
+        stripe_rows=g.rows_per_stripe)[0].words)
+    print(f"full P K=1: {t(f_p0, yf, uf, vf)*1e3:8.2f} ms "
+          f"(motion cost = delta)", flush=True)
 
-    def words(p, nbts):
-        off_g = jnp.cumsum(nbts[0, :e_cap])
-        pay_g = p[0, :e_cap]
-        nb_g = nbts[0, :e_cap]
-        w_idx = jnp.arange(w_cap, dtype=jnp.int32)
-        ws = w_idx * 32
-        s0 = jnp.clip(jnp.searchsorted(off_g, ws, side="right") - 1,
-                      0, e_cap - 1)
-        word = jnp.zeros((w_cap,), dtype=jnp.uint32)
-        for k in range(33):
-            e = jnp.clip(s0 + k, 0, e_cap - 1)
-            word = word | jnp.where(nb_g[e] > 0, pay_g[e], 0)
-        return word
-    t_words = t(jax.jit(words), payj, nbj)
-    print(f"  word materialisation (1 row x33 gathers): "
-          f"{t_words*1e3:.0f} ms", flush=True)
-
-    # full session steps LAST (the big compiles); encode() threads the
-    # donated state correctly
+    # --- full session steps as the engine drives them
     sess = H264EncoderSession(s)
     t_full = t(lambda f: sess.encode(f, force=True)["data"], frame, n=2)
-    print(f"full I step (dispatch+block): {t_full*1e3:.0f} ms", flush=True)
+    print(f"session I step (dispatch+block): {t_full*1e3:.0f} ms",
+          flush=True)
     t_p = t(lambda f: sess.encode(f)["data"], frame, n=2)
-    print(f"full P step (dispatch+block): {t_p*1e3:.0f} ms", flush=True)
+    print(f"session P step (dispatch+block): {t_p*1e3:.0f} ms", flush=True)
 
 
 if __name__ == "__main__":
