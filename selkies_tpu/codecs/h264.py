@@ -69,18 +69,32 @@ def nal(nal_type: int, rbsp: bytes, ref_idc: int = 3) -> bytes:
         + emulation_prevent(rbsp)
 
 
-def write_sps(width: int, height: int, level_idc: int = 42) -> bytes:
-    """Constrained-Baseline SPS for a ``width``x``height`` frame (16-px
-    padded internally, cropped via frame_cropping)."""
+def write_sps(width: int, height: int, level_idc: int = 42,
+              chroma_format: int = 1) -> bytes:
+    """SPS for a ``width``x``height`` frame (16-px padded internally,
+    cropped via frame_cropping). ``chroma_format`` 1 = 4:2:0
+    Constrained-Baseline; 3 = 4:4:4 High 4:4:4 Predictive (profile 244,
+    the reference's ``fullcolor`` f4001f munge, rtc.py:649-717)."""
     w_mbs = (width + 15) // 16
     h_mbs = (height + 15) // 16
     crop_r = w_mbs * 16 - width
     crop_b = h_mbs * 16 - height
     w = BitWriter()
-    w.put(8, 66)          # profile_idc baseline
-    w.put(8, 0xC0)        # constraint_set0+1 flags
+    if chroma_format == 3:
+        w.put(8, 244)     # profile_idc High 4:4:4 Predictive
+        w.put(8, 0x00)
+    else:
+        w.put(8, 66)      # profile_idc baseline
+        w.put(8, 0xC0)    # constraint_set0+1 flags
     w.put(8, level_idc)
     w.ue(0)               # sps_id
+    if chroma_format == 3:
+        w.ue(3)           # chroma_format_idc 4:4:4
+        w.put(1, 0)       # separate_colour_plane_flag
+        w.ue(0)           # bit_depth_luma_minus8
+        w.ue(0)           # bit_depth_chroma_minus8
+        w.put(1, 0)       # qpprime_y_zero_transform_bypass
+        w.put(1, 0)       # seq_scaling_matrix_present
     w.ue(0)               # log2_max_frame_num_minus4
     w.ue(2)               # pic_order_cnt_type 2 (no POC syntax in slices)
     w.ue(1)               # max_num_ref_frames (P references the prior picture)
@@ -90,8 +104,10 @@ def write_sps(width: int, height: int, level_idc: int = 42) -> bytes:
     w.put(1, 1)           # frame_mbs_only
     w.put(1, 1)           # direct_8x8_inference
     if crop_r or crop_b:
+        # CropUnitX/Y = 1 for 4:4:4 and monochrome, 2 for 4:2:0 (§7.4.2.1.1)
+        cu = 1 if chroma_format == 3 else 2
         w.put(1, 1)
-        w.ue(0); w.ue(crop_r // 2); w.ue(0); w.ue(crop_b // 2)
+        w.ue(0); w.ue(crop_r // cu); w.ue(0); w.ue(crop_b // cu)
     else:
         w.put(1, 0)
     # VUI: the encoder feeds FULL-RANGE BT.601 YCbCr (rgb_to_yuv420);
@@ -773,3 +789,209 @@ def p_slice_header_events(mb_w: int, n_rows: int):
                 pay[r, slot] = val
                 nb[r, slot] = len(chunk)
     return pay, nb
+
+
+# --------------------------------------------------------------------------
+# 4:4:4 (fullcolor) Intra_16x16 — High 4:4:4 Predictive, CAVLC.
+# The reference streams 4:4:4 by negotiating profile-level-id f4001f and
+# letting its encoders emit Hi444PP (rtc.py:649-717 "fullcolor"). With
+# ChromaArrayType == 3 each chroma component is coded EXACTLY like luma
+# (§7.3.5.3 residual: Intra16x16DCLevel + 16 AC blocks per component,
+# per-component nC contexts), intra_chroma_pred_mode disappears from the
+# MB syntax, and CodedBlockPatternChroma is 0 by constraint — the single
+# I_16x16 AC flag covers all three components.
+# --------------------------------------------------------------------------
+
+class I444Encoder:
+    """Golden numpy Intra_16x16 4:4:4 encoder, one slice per MB row.
+    Same slice/DC-prediction design as I16Encoder; full-resolution
+    chroma coded through the luma process per component."""
+
+    def __init__(self, width: int, height: int, qp: int = 28):
+        if not 8 <= qp <= 48:
+            raise ValueError("qp out of the supported 8..48 range")
+        self.width, self.height = width, height
+        self.qp = qp
+        self.mb_w = (width + 15) // 16
+        self.mb_h = (height + 15) // 16
+
+    def headers(self) -> bytes:
+        return write_sps(self.width, self.height,
+                         chroma_format=3) + write_pps()
+
+    def encode_frame(self, y: np.ndarray, u: np.ndarray, v: np.ndarray,
+                     idr_pic_id: int = 0) -> bytes:
+        """Full-resolution YUV (all three planes height x width) ->
+        Annex-B slices."""
+        qp = self.qp
+        qpc = int(QPC_NP[qp])
+        H16, W16 = self.mb_h * 16, self.mb_w * 16
+        planes = [_pad_edge(p, H16, W16) for p in (y, u, v)]
+        qps = (qp, qpc, qpc)
+        self.recon = [np.zeros((H16, W16), np.uint8) for _ in range(3)]
+        out = bytearray()
+        for row in range(self.mb_h):
+            w = BitWriter()
+            slice_header_bits(w, row * self.mb_w, qp, idr_pic_id)
+            nnz = np.zeros((3, self.mb_w, 4, 4), np.int64)
+            edges = [None, None, None]       # right edge (16,) per comp
+            for k in range(self.mb_w):
+                self._encode_mb(w, planes, row, k, qps, edges, nnz)
+            w.rbsp_trailing()
+            out += nal(5, w.to_bytes())
+        return bytes(out)
+
+    def _encode_mb(self, w, planes, row, k, qps, edges, nnz):
+        x0, y0 = k * 16, row * 16
+        # per-component transform/quant (identical luma-style pipeline)
+        dc_lvl = [None] * 3
+        dcQ = [None] * 3
+        ac_lvl = [None] * 3
+        preds = [None] * 3
+        for ci in range(3):
+            src = planes[ci][y0:y0 + 16, x0:x0 + 16].astype(np.int64)
+            pred = 128 if edges[ci] is None \
+                else (int(edges[ci].sum()) + 8) >> 4
+            preds[ci] = pred
+            wblk = np.zeros((4, 4, 4, 4), np.int64)
+            for br in range(4):
+                for bc in range(4):
+                    wblk[br, bc] = _fwd4(
+                        src[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] - pred)
+            hd = (_H4 @ wblk[:, :, 0, 0] @ _H4) >> 1
+            dc_lvl[ci] = _quant4(hd, qps[ci], dc_shift=1)
+            f = _H4 @ dc_lvl[ci] @ _H4
+            dcQ[ci] = _dequant_luma_dc(f, qps[ci])
+            acs = np.zeros((4, 4, 16), np.int64)
+            for br in range(4):
+                for bc in range(4):
+                    q = _quant4(wblk[br, bc], qps[ci])
+                    zz = q.reshape(16)[ZIGZAG4_NP]
+                    zz[0] = 0
+                    acs[br, bc] = zz
+            ac_lvl[ci] = acs
+        cbp_luma = 15 if any(np.any(a) for a in ac_lvl) else 0
+
+        # ---- syntax: NO intra_chroma_pred_mode, CBPChroma == 0
+        mb_type = 1 + 2 + (12 if cbp_luma else 0)
+        w.ue(mb_type)
+        w.se(0)            # mb_qp_delta
+        for ci in range(3):
+            nc = I16Encoder._nc_luma(nnz[ci], k, 0, 0)
+            _write_residual_block(
+                w, dc_lvl[ci].reshape(16)[ZIGZAG4_NP], nc, 16)
+            if cbp_luma:
+                for br, bc in LUMA_BLK_ORDER:
+                    nc = I16Encoder._nc_luma(nnz[ci], k, br, bc)
+                    tc = _write_residual_block(
+                        w, ac_lvl[ci][br, bc][1:], nc, 15)
+                    nnz[ci, k, br, bc] = tc
+            else:
+                nnz[ci, k, :, :] = 0
+
+        # ---- reconstruction (decoder-exact), per component
+        for ci in range(3):
+            recon = np.zeros((16, 16), np.int64)
+            for br in range(4):
+                for bc in range(4):
+                    d = np.zeros(16, np.int64)
+                    d[ZIGZAG4_NP] = ac_lvl[ci][br, bc]
+                    d = _dequant4_ac(d.reshape(4, 4), qps[ci])
+                    d[0, 0] = dcQ[ci][br, bc]
+                    res = (_inv4(d) + 32) >> 6
+                    recon[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] = \
+                        np.clip(preds[ci] + res, 0, 255)
+            self.recon[ci][y0:y0 + 16, x0:x0 + 16] = recon
+            edges[ci] = recon[:, 15].copy()
+
+
+class P444Encoder:
+    """Golden numpy 4:4:4 P-frame encoder over an I444Encoder's recon
+    state: P_Skip / zero-MV P_L0_16x16 conditional replenishment, every
+    component coded luma-style (residual_luma x3, §7.3.5.3), cbp group
+    bits covering all three components, the ChromaArrayType-3 me(v)
+    mapping (h264_tables.CBP444_INTER_CBP2CODE, derived against
+    libavcodec)."""
+
+    def __init__(self, base: I444Encoder):
+        self.base = base
+
+    def encode_frame(self, y: np.ndarray, u: np.ndarray, v: np.ndarray,
+                     frame_num: int) -> bytes:
+        b = self.base
+        qp = b.qp
+        qps = (qp, int(QPC_NP[qp]), int(QPC_NP[qp]))
+        H16, W16 = b.mb_h * 16, b.mb_w * 16
+        planes = [_pad_edge(p, H16, W16) for p in (y, u, v)]
+        out = bytearray()
+        for row in range(b.mb_h):
+            w = BitWriter()
+            p_slice_header_bits(w, row * b.mb_w, qp, frame_num)
+            nnz = np.zeros((3, b.mb_w, 4, 4), np.int64)
+            skip_run = 0
+            for k in range(b.mb_w):
+                skip_run = self._encode_mb(w, planes, row, k, qps, nnz,
+                                           skip_run)
+            if skip_run:
+                w.ue(skip_run)
+            w.rbsp_trailing()
+            out += nal(1, w.to_bytes(), ref_idc=2)
+        return bytes(out)
+
+    def _encode_mb(self, w, planes, row, k, qps, nnz, skip_run) -> int:
+        b = self.base
+        x0, y0 = k * 16, row * 16
+        lvl = np.zeros((3, 4, 4, 16), np.int64)
+        refs = []
+        for ci in range(3):
+            src = planes[ci][y0:y0 + 16, x0:x0 + 16].astype(np.int64)
+            ref = b.recon[ci][y0:y0 + 16, x0:x0 + 16].astype(np.int64)
+            refs.append(ref)
+            res = src - ref
+            for br in range(4):
+                for bc in range(4):
+                    wm = _fwd4(res[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4])
+                    q = _quant4_inter(wm, qps[ci])
+                    lvl[ci, br, bc] = q.reshape(16)[ZIGZAG4_NP]
+        # cbp: group bit g covers the g-th 8x8 region of ALL components
+        cbp = 0
+        for g8 in range(4):
+            gr, gc = (g8 // 2) * 2, (g8 % 2) * 2
+            if np.any(lvl[:, gr:gr + 2, gc:gc + 2]):
+                cbp |= 1 << g8
+        if cbp == 0:
+            nnz[:, k] = 0
+            return skip_run + 1
+
+        # ---- syntax
+        w.ue(skip_run)
+        w.ue(0)                 # mb_type P_L0_16x16
+        w.se(0); w.se(0)        # mvd (zero-MV replenishment)
+        w.ue(int(T.CBP444_INTER_CBP2CODE[cbp]))
+        w.se(0)                 # mb_qp_delta (cbp != 0 here)
+        for ci in range(3):
+            for br, bc in LUMA_BLK_ORDER:
+                g8 = (br // 2) * 2 + (bc // 2)
+                if not (cbp >> g8) & 1:
+                    nnz[ci, k, br, bc] = 0
+                    continue
+                nc = I16Encoder._nc_luma(nnz[ci], k, br, bc)
+                tc = _write_residual_block(w, lvl[ci, br, bc], nc, 16)
+                nnz[ci, k, br, bc] = tc
+
+        # ---- reconstruction (decode path)
+        for ci in range(3):
+            for br in range(4):
+                for bc in range(4):
+                    g8 = (br // 2) * 2 + (bc // 2)
+                    d = np.zeros(16, np.int64)
+                    if (cbp >> g8) & 1:
+                        d[ZIGZAG4_NP] = lvl[ci, br, bc]
+                    d = _dequant4_ac(d.reshape(4, 4), qps[ci])
+                    r = (_inv4(d) + 32) >> 6
+                    blk = np.clip(
+                        refs[ci][br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] + r,
+                        0, 255)
+                    b.recon[ci][y0 + br * 4:y0 + br * 4 + 4,
+                                x0 + bc * 4:x0 + bc * 4 + 4] = blk
+        return 0

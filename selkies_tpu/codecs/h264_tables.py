@@ -288,3 +288,10 @@ CBP_INTRA_CODE2CBP = np.array([
     16, 3, 5, 10, 12, 19, 21, 26, 28, 35, 37, 42, 44, 1, 2, 4,
     8, 17, 18, 20, 24, 6, 9, 22, 25, 32, 33, 34, 36, 40, 38, 41,
 ], np.int32)
+
+# Table 9-4 me(v) mapping for ChromaArrayType 0 or 3 (monochrome /
+# 4:4:4): 16 cbp values (luma groups only; the chroma part is absent).
+# Inter column, cbp -> code_num. Derived empirically against libavcodec
+# (tools/derive_cbp444.py re-runs the derivation as a conformance check).
+CBP444_INTER_CBP2CODE = np.array(
+    [0, 1, 2, 5, 3, 6, 14, 10, 4, 15, 7, 11, 8, 12, 13, 9], np.int32)

@@ -58,15 +58,23 @@ class _Grid:
     out_h: int
 
 
-def h264_buffer_caps(g: "_Grid") -> tuple[int, int, int]:
+def h264_buffer_caps(g: "_Grid", fullcolor: bool = False
+                     ) -> tuple[int, int, int]:
     """(e_cap, w_cap, out_cap) for a grid — shared by the single-seat
     session and the seat-sharded encoder so the sizing policy cannot
     diverge. out_cap is the one array that crosses the host link every
     frame, sized for realistic intra frames (~1.5 bits/px); overflow
-    grows it (and forces a clean refresh)."""
-    e_cap = 9 + g.mb_w * max(SLOTS_MB, P_SLOTS_MB) + 2
-    w_cap = max(2048, g.mb_w * 768 // 4)
-    out_cap = max(192 * 1024, g.width * g.height // 6)
+    grows it (and forces a clean refresh). 4:4:4 carries 3 luma-style
+    components (~1.5x the slot/bit budget of 4:2:0)."""
+    if fullcolor:
+        from ..ops.h264_planes444 import P_SLOTS_MB_444, SLOTS_MB_444
+        e_cap = 9 + g.mb_w * max(SLOTS_MB_444, P_SLOTS_MB_444) + 2
+        w_cap = max(3072, g.mb_w * 1152 // 4)
+        out_cap = max(288 * 1024, g.width * g.height // 4)
+    else:
+        e_cap = 9 + g.mb_w * max(SLOTS_MB, P_SLOTS_MB) + 2
+        w_cap = max(2048, g.mb_w * 768 // 4)
+        out_cap = max(192 * 1024, g.width * g.height // 6)
     return e_cap, w_cap, out_cap
 
 
@@ -96,7 +104,8 @@ def plan_h264_grid(s: CaptureSettings) -> _Grid:
 def build_h264_step_fn(mode: str, width: int, stripe_h: int, n_stripes: int,
                        e_cap: int, w_cap: int, out_cap: int,
                        paint_delay: int, damage_gating: bool,
-                       paint_over: bool, candidates: tuple = ((0, 0),)):
+                       paint_over: bool, candidates: tuple = ((0, 0),),
+                       fullcolor: bool = False):
     """Pure per-frame step for ``mode`` in {"i", "p"} — jitted by
     :func:`_jitted_h264_step` for the single-seat session, vmapped +
     shard_mapped by :class:`~selkies_tpu.parallel.MultiSeatH264Encoder`.
@@ -132,7 +141,15 @@ def build_h264_step_fn(mode: str, width: int, stripe_h: int, n_stripes: int,
         send = damage | is_paint | force
         qp_stripe = jnp.where(is_paint, qp_paint, qp_motion)
         qp_rows = jnp.repeat(qp_stripe, rows_per_stripe)
-        yf, uf, vf = rgb_to_yuv420(frame)
+        if fullcolor:
+            from ..ops.h264_planes444 import (h264_encode_p_yuv444,
+                                              h264_encode_yuv444,
+                                              rgb_to_yuv444)
+            yf, uf, vf = rgb_to_yuv444(frame)
+            enc_i, enc_p = h264_encode_yuv444, h264_encode_p_yuv444
+        else:
+            yf, uf, vf = rgb_to_yuv420(frame)
+            enc_i, enc_p = h264_encode_yuv, h264_encode_p_yuv
 
         if mode == "i":
             # consecutive IDRs of one stripe stream must differ in
@@ -142,14 +159,14 @@ def build_h264_step_fn(mode: str, width: int, stripe_h: int, n_stripes: int,
             sent = sent + send.astype(jnp.int32)
             # IDR resets the stream's frame_num; next P in the stream is 1
             fnum = jnp.where(send, 1, fnum)
-            out, recon = h264_encode_yuv(
+            out, recon = enc_i(
                 yf, uf, vf, qp_rows, hdr_pay, hdr_nb, e_cap, w_cap,
                 idr_pic_id=idr_rows, want_recon=True)
         else:
             fn_rows = jnp.repeat(fnum, rows_per_stripe)
             sent = sent + send.astype(jnp.int32)
             fnum = jnp.where(send, fnum + 1, fnum)
-            out, recon = h264_encode_p_yuv(
+            out, recon = enc_p(
                 yf, uf, vf, ref_y, ref_u, ref_v, qp_rows,
                 hdr_pay, hdr_nb, fn_rows, e_cap, w_cap,
                 candidates=candidates, stripe_rows=rows_per_stripe)
@@ -162,9 +179,10 @@ def build_h264_step_fn(mode: str, width: int, stripe_h: int, n_stripes: int,
             os_ = old.reshape(s, sh, -1)
             sel = jnp.where(send[:, None, None], ns, os_)
             return sel.reshape(new.shape)
+        c_sh = stripe_h if fullcolor else stripe_h // 2
         new_ry = gate(recon[0], ref_y, stripe_h)
-        new_ru = gate(recon[1], ref_u, stripe_h // 2)
-        new_rv = gate(recon[2], ref_v, stripe_h // 2)
+        new_ru = gate(recon[1], ref_u, c_sh)
+        new_rv = gate(recon[2], ref_v, c_sh)
 
         sbytes, row_lens = words_to_bytes_device(out.words, out.total_bits,
                                                  pad_ones=False)
@@ -180,10 +198,11 @@ def build_h264_step_fn(mode: str, width: int, stripe_h: int, n_stripes: int,
 def _jitted_h264_step(mode: str, width: int, stripe_h: int, n_stripes: int,
                       e_cap: int, w_cap: int, out_cap: int,
                       paint_delay: int, damage_gating: bool,
-                      paint_over: bool, candidates: tuple = ((0, 0),)):
+                      paint_over: bool, candidates: tuple = ((0, 0),),
+                      fullcolor: bool = False):
     step = build_h264_step_fn(mode, width, stripe_h, n_stripes, e_cap,
                               w_cap, out_cap, paint_delay, damage_gating,
-                              paint_over, candidates)
+                              paint_over, candidates, fullcolor=fullcolor)
     return jax.jit(step, donate_argnums=(2, 3, 4, 5, 6, 7))
 
 
@@ -196,7 +215,9 @@ class H264EncoderSession:
         self.grid = plan_h264_grid(settings)
         g = self.grid
         self.n_rows = g.n_stripes * g.rows_per_stripe
-        self._e_cap, self._w_cap, self._out_cap = h264_buffer_caps(g)
+        self.fullcolor = bool(settings.fullcolor)
+        self._e_cap, self._w_cap, self._out_cap = h264_buffer_caps(
+            g, self.fullcolor)
         self._i_step = self._build_step("i")
         self._p_step = self._build_step("p")
         self.frame_id = 0
@@ -204,15 +225,19 @@ class H264EncoderSession:
         self._sent = jnp.zeros((g.n_stripes,), jnp.int32)
         self._fnum = jnp.zeros((g.n_stripes,), jnp.int32)
         self._prev = jnp.zeros((g.height, g.width, 3), jnp.uint8)
+        cdiv = 1 if self.fullcolor else 2
         self._ref_y = jnp.zeros((g.height, g.width), jnp.uint8)
-        self._ref_u = jnp.zeros((g.height // 2, g.width // 2), jnp.uint8)
-        self._ref_v = jnp.zeros((g.height // 2, g.width // 2), jnp.uint8)
+        self._ref_u = jnp.zeros((g.height // cdiv, g.width // cdiv),
+                                jnp.uint8)
+        self._ref_v = jnp.zeros((g.height // cdiv, g.width // cdiv),
+                                jnp.uint8)
         self._force_after_drop = False
         self._cap_gen = 0   # buffer-growth generation (pipelined frames
         #                     encoded with stale caps must not re-grow)
         # per-stripe stream headers (cached; identical for every stripe)
-        self._sps_pps = hcodec.write_sps(g.width, g.stripe_h) \
-            + hcodec.write_pps()
+        self._sps_pps = hcodec.write_sps(
+            g.width, g.stripe_h,
+            chroma_format=3 if self.fullcolor else 1) + hcodec.write_pps()
         # slice-header prefixes (idr_pic_id/qp are device events);
         # every stripe restarts first_mb at 0
         pay, nb = hcodec.slice_header_events(g.mb_w, g.rows_per_stripe)
@@ -238,7 +263,8 @@ class H264EncoderSession:
                                  self._e_cap, self._w_cap, self._out_cap,
                                  s.paint_over_delay_frames,
                                  s.use_damage_gating, s.use_paint_over,
-                                 candidates=cands)
+                                 candidates=cands,
+                                 fullcolor=self.fullcolor)
 
     @property
     def visible_size(self) -> tuple[int, int]:

@@ -39,12 +39,39 @@ void *dec_open(const char *codec_name)
     return d;
 }
 
+/* Copy the decoded frame's planes out; chroma_div reports the chroma
+ * subsampling divisor (2 for yuv420, 1 for yuv444 — Hi444PP streams). */
+static void copy_planes(Dec *d, uint8_t *out_y, uint8_t *out_u,
+                        uint8_t *out_v, int *out_w, int *out_h,
+                        int *out_chroma_div)
+{
+    int w = d->frame->width, h2 = d->frame->height;
+    int fmt = d->frame->format;
+    int cd = (fmt == AV_PIX_FMT_YUV444P || fmt == AV_PIX_FMT_YUVJ444P)
+        ? 1 : 2;
+    *out_w = w;
+    *out_h = h2;
+    if (out_chroma_div)
+        *out_chroma_div = cd;
+    for (int r = 0; r < h2; r++)
+        memcpy(out_y + (size_t)r * w,
+               d->frame->data[0] + (size_t)r * d->frame->linesize[0], w);
+    int cw = w / cd, ch = h2 / cd;
+    for (int r = 0; r < ch; r++) {
+        memcpy(out_u + (size_t)r * cw,
+               d->frame->data[1] + (size_t)r * d->frame->linesize[1], cw);
+        memcpy(out_v + (size_t)r * cw,
+               d->frame->data[2] + (size_t)r * d->frame->linesize[2], cw);
+    }
+    av_frame_unref(d->frame);
+}
+
 /* Decode one access unit. Returns 0 on success with a decoded frame,
  * 1 on "needs more data", negative on error. Planes are copied into the
- * caller-provided buffers (y: w*h, u/v: (w/2)*(h/2) for yuv420). */
-int dec_decode(void *h, const uint8_t *data, int size,
-               uint8_t *out_y, uint8_t *out_u, uint8_t *out_v,
-               int *out_w, int *out_h)
+ * caller-provided buffers (y: w*h; u/v sized w*h for 4:4:4 safety). */
+int dec_decode_fmt(void *h, const uint8_t *data, int size,
+                   uint8_t *out_y, uint8_t *out_u, uint8_t *out_v,
+                   int *out_w, int *out_h, int *out_chroma_div)
 {
     Dec *d = (Dec *)h;
     int ret = av_new_packet(d->pkt, size);
@@ -60,26 +87,21 @@ int dec_decode(void *h, const uint8_t *data, int size,
         return 1;
     if (ret < 0)
         return ret;
-    int w = d->frame->width, h2 = d->frame->height;
-    *out_w = w;
-    *out_h = h2;
-    for (int r = 0; r < h2; r++)
-        memcpy(out_y + (size_t)r * w,
-               d->frame->data[0] + (size_t)r * d->frame->linesize[0], w);
-    int cw = w / 2, ch = h2 / 2;
-    for (int r = 0; r < ch; r++) {
-        memcpy(out_u + (size_t)r * cw,
-               d->frame->data[1] + (size_t)r * d->frame->linesize[1], cw);
-        memcpy(out_v + (size_t)r * cw,
-               d->frame->data[2] + (size_t)r * d->frame->linesize[2], cw);
-    }
-    av_frame_unref(d->frame);
+    copy_planes(d, out_y, out_u, out_v, out_w, out_h, out_chroma_div);
     return 0;
 }
 
+int dec_decode(void *h, const uint8_t *data, int size,
+               uint8_t *out_y, uint8_t *out_u, uint8_t *out_v,
+               int *out_w, int *out_h)
+{
+    return dec_decode_fmt(h, data, size, out_y, out_u, out_v,
+                          out_w, out_h, NULL);
+}
+
 /* Flush the decoder so low-delay single-AU streams emit their frame. */
-int dec_flush(void *h, uint8_t *out_y, uint8_t *out_u, uint8_t *out_v,
-              int *out_w, int *out_h)
+int dec_flush_fmt(void *h, uint8_t *out_y, uint8_t *out_u, uint8_t *out_v,
+                  int *out_w, int *out_h, int *out_chroma_div)
 {
     Dec *d = (Dec *)h;
     int ret = avcodec_send_packet(d->ctx, NULL);
@@ -88,21 +110,14 @@ int dec_flush(void *h, uint8_t *out_y, uint8_t *out_u, uint8_t *out_v,
     ret = avcodec_receive_frame(d->ctx, d->frame);
     if (ret < 0)
         return ret;
-    int w = d->frame->width, h2 = d->frame->height;
-    *out_w = w;
-    *out_h = h2;
-    for (int r = 0; r < h2; r++)
-        memcpy(out_y + (size_t)r * w,
-               d->frame->data[0] + (size_t)r * d->frame->linesize[0], w);
-    int cw = w / 2, ch = h2 / 2;
-    for (int r = 0; r < ch; r++) {
-        memcpy(out_u + (size_t)r * cw,
-               d->frame->data[1] + (size_t)r * d->frame->linesize[1], cw);
-        memcpy(out_v + (size_t)r * cw,
-               d->frame->data[2] + (size_t)r * d->frame->linesize[2], cw);
-    }
-    av_frame_unref(d->frame);
+    copy_planes(d, out_y, out_u, out_v, out_w, out_h, out_chroma_div);
     return 0;
+}
+
+int dec_flush(void *h, uint8_t *out_y, uint8_t *out_u, uint8_t *out_v,
+              int *out_w, int *out_h)
+{
+    return dec_flush_fmt(h, out_y, out_u, out_v, out_w, out_h, NULL);
 }
 
 void dec_close(void *h)

@@ -33,15 +33,15 @@ def _build() -> Optional[ctypes.CDLL]:
     try:
         if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
             lib = ctypes.CDLL(str(_SO))
-            if hasattr(lib, "x264_encode_seq"):   # stale-binary guard
+            if hasattr(lib, "dec_decode_fmt"):    # stale-binary guard
                 return lib
         subprocess.run(
             ["gcc", "-O2", "-shared", "-fPIC", "-o", str(_SO), str(_SRC),
              "-lavcodec", "-lavutil"],
             check=True, capture_output=True, timeout=120)
         lib = ctypes.CDLL(str(_SO))
-        if not hasattr(lib, "x264_encode_seq"):   # stale-binary guard
-            raise OSError("shim missing x264_encode_seq after rebuild")
+        if not hasattr(lib, "dec_decode_fmt"):    # stale-binary guard
+            raise OSError("shim missing dec_decode_fmt after rebuild")
         return lib
     except (subprocess.SubprocessError, OSError) as e:
         logger.info("avshim unavailable (%s)", e)
@@ -59,6 +59,8 @@ def _get() -> Optional[ctypes.CDLL]:
                 lib.dec_open.argtypes = [ctypes.c_char_p]
                 lib.dec_decode.restype = ctypes.c_int
                 lib.dec_flush.restype = ctypes.c_int
+                lib.dec_decode_fmt.restype = ctypes.c_int
+                lib.dec_flush_fmt.restype = ctypes.c_int
                 lib.dec_close.argtypes = [ctypes.c_void_p]
                 lib.x264_encode_idr.restype = ctypes.c_int
             _lib = lib
@@ -73,8 +75,9 @@ def decode_h264(annexb: bytes, max_w: int = 8192, max_h: int = 8192
                 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Decode one Annex-B access unit with ffmpeg's H.264 decoder.
 
-    Returns (Y, U, V) uint8 planes (YUV420). Raises on decode failure —
-    a failure IS the test signal (our bitstream is non-conformant).
+    Returns (Y, U, V) uint8 planes — chroma at /2 for 4:2:0 streams, full
+    size for 4:4:4 (Hi444PP fullcolor). Raises on decode failure — a
+    failure IS the test signal (our bitstream is non-conformant).
     """
     lib = _get()
     if lib is None:
@@ -84,25 +87,27 @@ def decode_h264(annexb: bytes, max_w: int = 8192, max_h: int = 8192
         raise RuntimeError("h264 decoder open failed")
     try:
         y = np.empty(max_w * max_h, np.uint8)
-        u = np.empty(max_w * max_h // 4, np.uint8)
-        v = np.empty(max_w * max_h // 4, np.uint8)
+        u = np.empty(max_w * max_h, np.uint8)   # full size: 4:4:4 safe
+        v = np.empty(max_w * max_h, np.uint8)
         w = ctypes.c_int(0)
         hh = ctypes.c_int(0)
+        cd = ctypes.c_int(2)
         buf = (ctypes.c_ubyte * len(annexb)).from_buffer_copy(annexb)
         args = (buf, len(annexb),
                 y.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
                 u.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
                 v.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
-                ctypes.byref(w), ctypes.byref(hh))
-        ret = lib.dec_decode(ctypes.c_void_p(h), *args)
+                ctypes.byref(w), ctypes.byref(hh), ctypes.byref(cd))
+        ret = lib.dec_decode_fmt(ctypes.c_void_p(h), *args)
         if ret == 1:  # low-delay decoder wants a flush for single AUs
-            ret = lib.dec_flush(ctypes.c_void_p(h), *args[2:])
+            ret = lib.dec_flush_fmt(ctypes.c_void_p(h), *args[2:])
         if ret != 0:
             raise ValueError(f"h264 decode failed (ret={ret})")
-        W, H = w.value, hh.value
+        W, H, C = w.value, hh.value, cd.value
+        cw, ch = W // C, H // C
         return (y[:W * H].reshape(H, W).copy(),
-                u[:W * H // 4].reshape(H // 2, W // 2).copy(),
-                v[:W * H // 4].reshape(H // 2, W // 2).copy())
+                u[:cw * ch].reshape(ch, cw).copy(),
+                v[:cw * ch].reshape(ch, cw).copy())
     finally:
         lib.dec_close(ctypes.c_void_p(h))
 
@@ -174,13 +179,14 @@ class H264Session:
         if not self._h:
             raise RuntimeError("h264 decoder open failed")
         self._y = np.empty(max_w * max_h, np.uint8)
-        self._u = np.empty(max_w * max_h // 4, np.uint8)
-        self._v = np.empty(max_w * max_h // 4, np.uint8)
+        self._u = np.empty(max_w * max_h, np.uint8)   # full: 4:4:4 safe
+        self._v = np.empty(max_w * max_h, np.uint8)
 
-    def _planes(self, w, h):
+    def _planes(self, w, h, cd):
+        cw, ch = w // cd, h // cd
         return (self._y[:w * h].reshape(h, w).copy(),
-                self._u[:w * h // 4].reshape(h // 2, w // 2).copy(),
-                self._v[:w * h // 4].reshape(h // 2, w // 2).copy())
+                self._u[:cw * ch].reshape(ch, cw).copy(),
+                self._v[:cw * ch].reshape(ch, cw).copy())
 
     def decode(self, au: bytes):
         """-> (Y, U, V) for the decoded picture, or None when the decoder
@@ -189,27 +195,31 @@ class H264Session:
         buf = (ctypes.c_ubyte * len(au)).from_buffer_copy(au)
         w = ctypes.c_int(0)
         h = ctypes.c_int(0)
-        ret = self._lib.dec_decode(
+        cd = ctypes.c_int(2)
+        ret = self._lib.dec_decode_fmt(
             ctypes.c_void_p(self._h), buf, len(au),
             self._y.ctypes.data_as(p), self._u.ctypes.data_as(p),
-            self._v.ctypes.data_as(p), ctypes.byref(w), ctypes.byref(h))
+            self._v.ctypes.data_as(p), ctypes.byref(w), ctypes.byref(h),
+            ctypes.byref(cd))
         if ret == 1:
             return None
         if ret != 0:
             raise ValueError(f"h264 decode failed (ret={ret})")
-        return self._planes(w.value, h.value)
+        return self._planes(w.value, h.value, cd.value)
 
     def flush(self):
         p = ctypes.POINTER(ctypes.c_ubyte)
         w = ctypes.c_int(0)
         h = ctypes.c_int(0)
-        ret = self._lib.dec_flush(
+        cd = ctypes.c_int(2)
+        ret = self._lib.dec_flush_fmt(
             ctypes.c_void_p(self._h),
             self._y.ctypes.data_as(p), self._u.ctypes.data_as(p),
-            self._v.ctypes.data_as(p), ctypes.byref(w), ctypes.byref(h))
+            self._v.ctypes.data_as(p), ctypes.byref(w), ctypes.byref(h),
+            ctypes.byref(cd))
         if ret != 0:
             return None
-        return self._planes(w.value, h.value)
+        return self._planes(w.value, h.value, cd.value)
 
     def close(self):
         if getattr(self, "_h", None):

@@ -1,0 +1,443 @@
+"""Device-side 4:4:4 (fullcolor) H.264 — High 4:4:4 Predictive, CAVLC,
+in the same TPU plane layout as ops/h264_planes.
+
+The reference streams fullcolor by negotiating profile-level-id f4001f
+and letting x264/NVENC emit Hi444PP (reference src/selkies/rtc.py:649-717,
+settings.py fullcolor rows). Here the codec itself goes 4:4:4: with
+ChromaArrayType == 3 each chroma component is coded EXACTLY like luma
+(§7.3.5.3 residual_luma per component, per-component nC contexts, no
+intra_chroma_pred_mode, the single I_16x16 AC flag / inter cbp group
+bits covering all three components) — so this module is mostly the luma
+half of h264_planes instantiated three times over full-resolution
+planes, sharing its transforms, CAVLC event builder and event sink.
+
+Oracle chain: bit-exact vs codecs/h264.I444Encoder / P444Encoder
+(tests/test_h264_444.py), which are themselves byte-exact under
+libavcodec's Hi444PP decoder — including the ChromaArrayType-3 me(v)
+coded_block_pattern mapping that was derived empirically against ffmpeg
+(h264_tables.CBP444_INTER_CBP2CODE).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..codecs import h264_tables as HT
+from .colorspace import rgb_to_ycbcr
+from .h264_encode import (H264FrameOut, LEVEL_CLAMP, _se_event, _ue_event,
+                          _motion_select)
+from .h264_planes import (_EventSink, _clip1, _dequant_plane, _expand,
+                          _excl_cumsum0, _grid_rm, _merge_planes,
+                          _quant_dc_e, _dequant_ldc_e, _quant_plane,
+                          _row_of_blocks, _SCAN_ORDER, cavlc_events_planes,
+                          fwd4_planes, inv4_planes)
+from .h264_transform import _POS_CLS, _QPC, ZIGZAG4
+
+_QPC_J = jnp.asarray(_QPC)
+_ZZ_IJ = [(int(z) // 4, int(z) % 4) for z in ZIGZAG4]
+_CBP444_J = jnp.asarray(HT.CBP444_INTER_CBP2CODE)
+_H4_NP = np.array([[1, 1, 1, 1], [1, 1, -1, -1],
+                   [1, -1, -1, 1], [1, -1, 1, -1]], np.int32)
+
+# per-MB slot budget: hdr [mb_type, qp_delta] + 3 x (DC block 36 +
+# 16 AC blocks x 34); P: 6 hdr slots + 3 x 16 full blocks x 36
+SLOTS_BLK16 = 1 + 3 + 16 + 1 + 15
+SLOTS_BLK15 = 1 + 3 + 15 + 1 + 14
+SLOTS_MB_444 = 2 + 3 * (SLOTS_BLK16 + 16 * SLOTS_BLK15)
+P_SLOTS_MB_444 = 6 + 3 * 16 * SLOTS_BLK16
+
+
+def rgb_to_yuv444(rgb):
+    """(H, W, 3) uint8 -> three full-resolution int32 planes (BT.601
+    full-range, same matrix as the 4:2:0 path — fullcolor means no
+    subsampling, not a different colour space)."""
+    ycc = rgb_to_ycbcr(rgb, "bt601-full")
+    return tuple(jnp.clip(jnp.round(ycc[..., i]), 0, 255).astype(jnp.int32)
+                 for i in range(3))
+
+
+def _dc_scan_comp(R, M, dc, inv_edge, qp):
+    """Left-edge DC prediction chain for ONE luma-like component
+    (the luma half of h264_planes._dc_scan)."""
+    h4 = jnp.asarray(_H4_NP)
+
+    def step(carry, k):
+        edge = carry
+        first = k == 0
+        pred = jnp.where(first, 128, (edge.sum(-1) + 8) >> 4)
+        dcm = dc[:, :, k, :] - 16 * pred[:, None, None]
+        hd = jnp.einsum("ij,rjk,kl->ril", h4, dcm, h4) >> 1
+        dlvl = _quant_dc_e(hd, qp[:, None, None])
+        f = jnp.einsum("ij,rjk,kl->ril", h4, dlvl, h4)
+        dcQ = _dequant_ldc_e(f, qp[:, None, None])
+        new_edge = _clip1(
+            pred[:, None, None]
+            + ((inv_edge[:, :, k, :] + dcQ[:, :, 3:4] + 32) >> 6)
+        ).reshape(R, 16)
+        return new_edge, (dlvl, pred)
+
+    anchor = 0 * dc[:, 0, 0, 0]
+    init = jnp.zeros((R, 16), jnp.int32) + anchor[:, None]
+    _, (dc_lvls, preds) = jax.lax.scan(
+        step, init, jnp.arange(M, dtype=jnp.int32))
+    return jnp.moveaxis(dc_lvls, 0, 1), jnp.moveaxis(preds, 0, 1)
+
+
+def _comp_intra(plane, qp_by, qp_rows, R, M):
+    """Everything parallel for one component of the I path: transforms,
+    quant, scans, edge contributions, DC values."""
+    w = fwd4_planes(plane)
+    acl = [[_quant_plane(w[i][j], qp_by, _POS_CLS[i][j], 3)
+            for j in range(4)] for i in range(4)]
+    zero = jnp.zeros_like(acl[0][0])
+    scan = [acl[i][j] if k else zero
+            for k, (i, j) in enumerate(_ZZ_IJ)]
+    d = [[_dequant_plane(
+        acl[i][j] if (i, j) != (0, 0) else zero,
+        qp_by, _POS_CLS[i][j]) for j in range(4)] for i in range(4)]
+    inv = inv4_planes(d)
+    inv_edge = jnp.stack(
+        [inv[i][3][:, 3::4].reshape(R, 4, M) for i in range(4)], axis=-1)
+    dc = w[0][0].reshape(R, 4, M, 4)
+    dc_lvls, preds = _dc_scan_comp(R, M, dc, inv_edge, qp_rows)
+    return scan, inv, dc_lvls, preds
+
+
+def h264_encode_yuv444(yf, uf, vf, qp, header_pay, header_nb,
+                       e_cap: int, w_cap: int,
+                       idr_pic_id=0, want_recon: bool = False):
+    """Full-resolution YUV int planes -> per-MB-row Hi444PP slice RBSPs.
+    Same contract as h264_planes.h264_encode_yuv; bit-identical to the
+    golden I444Encoder."""
+    H, W = yf.shape[0], yf.shape[1]
+    assert H % 16 == 0 and W % 16 == 0
+    R, M = H // 16, W // 16
+    nby, nbx = H // 4, W // 4
+    qp = jnp.broadcast_to(jnp.asarray(qp, jnp.int32), (R,))
+    qpc = _QPC_J[jnp.clip(qp, 0, 51)]
+    qp_by = jnp.repeat(qp, 4)[:, None]
+    qpc_by = jnp.repeat(qpc, 4)[:, None]
+
+    comps = []
+    for plane, qb, qr in ((yf, qp_by, qp), (uf, qpc_by, qpc),
+                          (vf, qpc_by, qpc)):
+        comps.append(_comp_intra(plane.astype(jnp.int32), qb, qr, R, M))
+
+    # shared AC flag across all three components
+    nz = [sum((s != 0).astype(jnp.int32) for s in scan)
+          for (scan, _, _, _) in comps]
+    any_mb = [jnp.any((n > 0).reshape(R, 4, M, 4), axis=(1, 3))
+              for n in nz]
+    cbp_luma = any_mb[0] | any_mb[1] | any_mb[2]        # (R, M)
+    gate = _expand(cbp_luma, 4, 4)
+
+    # per-component events
+    from .h264_planes import _nc_planes
+    ev = []
+    for ci, (scan, _, dc_lvls, _) in enumerate(comps):
+        nc = _nc_planes(jnp.where(gate, nz[ci], 0), 4)
+        dc_scan_l = [dc_lvls.reshape(R, M, 16)[..., int(z)]
+                     for z in ZIGZAG4]
+        dpay, dnb, _ = cavlc_events_planes(dc_scan_l, nc[0::4, 0::4])
+        apay, anb, _ = cavlc_events_planes(scan[1:], nc)
+        anb = jnp.where(gate[None], anb, 0)
+        ev.append((dpay, dnb, apay, anb))
+
+    # MB header: ue(mb_type), se(0) qp_delta — NO intra_chroma_pred_mode
+    mb_type = 3 + jnp.where(cbp_luma, 12, 0)
+    h_pay0, h_nb0 = _ue_event(mb_type)
+    one_u = jnp.ones((R, M), jnp.uint32)
+    hdr_pays = jnp.stack([h_pay0, one_u])
+    hdr_nbs = jnp.stack([h_nb0, jnp.ones((R, M), jnp.int32)])
+
+    # row prefix (identical to the 4:2:0 I path)
+    idr = jnp.broadcast_to(jnp.asarray(idr_pic_id, jnp.int32), (R,))
+    idr_pay, idr_nb = _ue_event(idr)
+    dqp = qp - 26
+    qp_pay, qp_nb = _ue_event(jnp.where(dqp > 0, 2 * dqp - 1, -2 * dqp))
+    row_pays = jnp.stack([header_pay[:, 0].astype(jnp.uint32),
+                          header_pay[:, 1].astype(jnp.uint32),
+                          idr_pay, jnp.zeros((R,), jnp.uint32), qp_pay,
+                          jnp.full((R,), 2, jnp.uint32)])
+    row_nbs = jnp.stack([header_nb[:, 0].astype(jnp.int32),
+                         header_nb[:, 1].astype(jnp.int32),
+                         idr_nb, jnp.full((R,), 2, jnp.int32), qp_nb,
+                         jnp.full((R,), 3, jnp.int32)])
+
+    out = _assemble_444(R, M, w_cap, e_cap, row_pays, row_nbs,
+                        hdr_pays, hdr_nbs, ev)
+    if not want_recon:
+        return out
+
+    recons = []
+    for ci, (scan, inv, dc_lvls, preds) in enumerate(comps):
+        qr = qp if ci == 0 else qpc
+        h4 = jnp.asarray(_H4_NP)
+        f_all = jnp.einsum("ij,rmjk,kl->rmil", h4, dc_lvls, h4)
+        dcQ = _dequant_ldc_e(f_all, qr[:, None, None, None])
+        dc_pl = _merge_planes(
+            [[dcQ[:, :, i, j] for j in range(4)] for i in range(4)], 4, 4)
+        pred_pl = _expand(preds, 4, 4)
+        rec = [[_clip1(pred_pl + ((inv[i][j] + dc_pl + 32) >> 6))
+                for j in range(4)] for i in range(4)]
+        recons.append(_merge_planes(rec, 4, 4).astype(jnp.uint8))
+    return out, tuple(recons)
+
+
+def _assemble_444(R, M, w_cap, e_cap, row_pays, row_nbs,
+                  hdr_pays, hdr_nbs, ev):
+    """Slot order per MB: hdr | per comp [DC block, 16 AC blocks in scan
+    order] | ... | stop bit."""
+    nby, nbx = 4 * R, 4 * M
+    hdr_bits = hdr_nbs.sum(0)
+    comp_dc_bits = [e[1].sum(0) for e in ev]                # (R, M)
+    comp_ac_rm = [_grid_rm(e[3].sum(0), 4, 4) for e in ev]  # (R, M) grids
+    comp_ac_mb = [sum(rm[i][j] for i, j in _SCAN_ORDER)
+                  for rm in comp_ac_rm]
+    mb_bits = hdr_bits + sum(comp_dc_bits) + sum(comp_ac_mb)
+
+    prefix_bits = row_nbs.sum(0)
+    mb_start = prefix_bits[:, None] + jnp.cumsum(mb_bits, axis=1) - mb_bits
+    total_bits = prefix_bits + jnp.sum(mb_bits, axis=1) + 1
+
+    sink = _EventSink(R, w_cap)
+    rows_r = jnp.arange(R, dtype=jnp.int32)
+    sink.add(rows_r[None], _excl_cumsum0(row_nbs), row_pays, row_nbs)
+    row_rm = rows_r[None, :, None]
+    sink.add(row_rm, mb_start[None] + _excl_cumsum0(hdr_nbs),
+             hdr_pays, hdr_nbs)
+
+    row_blk = _row_of_blocks(nby, nbx, 4)
+    base = mb_start + hdr_bits
+    for ci, (dpay, dnb, apay, anb) in enumerate(ev):
+        sink.add(row_rm, base[None] + _excl_cumsum0(dnb), dpay, dnb)
+        base = base + comp_dc_bits[ci]
+        starts_rm = [[None] * 4 for _ in range(4)]
+        acc = base
+        for (i, j) in _SCAN_ORDER:
+            starts_rm[i][j] = acc
+            acc = acc + comp_ac_rm[ci][i][j]
+        start_pl = _merge_planes(starts_rm, 4, 4)
+        sink.add(row_blk[None], start_pl[None] + _excl_cumsum0(anb),
+                 apay, anb)
+        base = acc
+
+    sink.add(rows_r, total_bits - 1, jnp.ones((R,), jnp.uint32),
+             jnp.ones((R,), jnp.int32))
+    words, n_ev = sink.pack()
+    overflow = jnp.any((n_ev > e_cap) | (total_bits > w_cap * 32))
+    return H264FrameOut(words, total_bits.astype(jnp.int32), overflow, R)
+
+
+# ---------------------------------------------------------------------------
+# P path
+# ---------------------------------------------------------------------------
+
+def _motion_select444(cur_y, rfy, rfu, rfv, qp, candidates, win):
+    """Luma-SAD candidate selection as in h264_planes, but chroma rides
+    the SAME full-pel shift at full resolution (no eighth-sample
+    interpolation in 4:4:4 with full-pel luma vectors)."""
+    from .h264_encode import _MV_LAMBDA, _hshift, _vshift, se_bits
+    H, W = cur_y.shape
+    R, M = H // 16, W // 16
+    S = H // win
+    ry_w = rfy.reshape(S, win, W)
+    ru_w = rfu.reshape(S, win, W)
+    rv_w = rfv.reshape(S, win, W)
+    lam = _MV_LAMBDA[jnp.clip(qp, 0, 51)]
+
+    shifted_y, shifted_u, shifted_v, costs = [], [], [], []
+    for dy, dx in candidates:
+        shy = _hshift(_vshift(ry_w, dy), dx).reshape(H, W)
+        shifted_y.append(shy)
+        shifted_u.append(_hshift(_vshift(ru_w, dy), dx).reshape(H, W))
+        shifted_v.append(_hshift(_vshift(rv_w, dy), dx).reshape(H, W))
+        sad = jnp.abs(cur_y - shy).reshape(R, 16, M, 16).sum(axis=(1, 3))
+        bits = se_bits(4 * dx) + se_bits(4 * dy)
+        costs.append(sad + lam[:, None] * bits)
+    sel = jnp.argmin(jnp.stack(costs), axis=0).astype(jnp.int32)
+    sel_pix = jnp.broadcast_to(sel[:, None, :, None],
+                               (R, 16, M, 16)).reshape(H, W)
+    pred_y, pred_u, pred_v = shifted_y[0], shifted_u[0], shifted_v[0]
+    for k in range(1, len(candidates)):
+        pred_y = jnp.where(sel_pix == k, shifted_y[k], pred_y)
+        pred_u = jnp.where(sel_pix == k, shifted_u[k], pred_u)
+        pred_v = jnp.where(sel_pix == k, shifted_v[k], pred_v)
+    cand_q = jnp.asarray(np.asarray(candidates, np.int32)[:, ::-1] * 4)
+    return pred_y, pred_u, pred_v, cand_q[sel]
+
+
+def h264_encode_p_yuv444(yf, uf, vf, ref_y, ref_u, ref_v, qp,
+                         header_pay, header_nb, frame_num,
+                         e_cap: int, w_cap: int,
+                         candidates: tuple = ((0, 0),),
+                         stripe_rows: int | None = None):
+    """4:4:4 P frame: P_Skip / P_L0_16x16, all components luma-style,
+    shared cbp group bits, ChromaArrayType-3 me(v) mapping. Returns
+    (H264FrameOut, (recon_y, recon_u, recon_v))."""
+    H, W = yf.shape[0], yf.shape[1]
+    R, M = H // 16, W // 16
+    nby, nbx = H // 4, W // 4
+    qp = jnp.broadcast_to(jnp.asarray(qp, jnp.int32), (R,))
+    qpc = _QPC_J[jnp.clip(qp, 0, 51)]
+    fn = jnp.broadcast_to(jnp.asarray(frame_num, jnp.int32), (R,))
+    qp_by = jnp.repeat(qp, 4)[:, None]
+    qpc_by = jnp.repeat(qpc, 4)[:, None]
+
+    cur = [p.astype(jnp.int32) for p in (yf, uf, vf)]
+    rf = [p.astype(jnp.int32) for p in (ref_y, ref_u, ref_v)]
+
+    win = 16 * (stripe_rows if stripe_rows else R)
+    assert H % win == 0, "stripe_rows must tile the frame"
+    if len(candidates) > 1:
+        pred_y, pred_u, pred_v, mv = _motion_select444(
+            cur[0], rf[0], rf[1], rf[2], qp, candidates, win)
+        preds = [pred_y, pred_u, pred_v]
+    else:
+        preds = rf
+        mv = jnp.zeros((R, M, 2), jnp.int32)
+
+    # per-component residual transforms + quant (16-coeff, DC in-block)
+    acls, scans = [], []
+    for ci in range(3):
+        qb = qp_by if ci == 0 else qpc_by
+        w = fwd4_planes(cur[ci] - preds[ci])
+        acl = [[_quant_plane(w[i][j], qb, _POS_CLS[i][j], 6)
+                for j in range(4)] for i in range(4)]
+        acls.append(acl)
+        scans.append([acl[i][j] for (i, j) in _ZZ_IJ])
+
+    # cbp: group bit g covers the g-th 8x8 region of ALL components
+    nz_blk = None
+    for scan in scans:
+        nzc = sum((s != 0) for s in scan) > 0
+        nz_blk = nzc if nz_blk is None else (nz_blk | nzc)
+    g8 = (nz_blk[0::2, :] | nz_blk[1::2, :])
+    g8 = (g8[:, 0::2] | g8[:, 1::2])                 # (2R, 2M)
+    cbp = (g8[0::2, 0::2].astype(jnp.int32)
+           | (g8[0::2, 1::2].astype(jnp.int32) << 1)
+           | (g8[1::2, 0::2].astype(jnp.int32) << 2)
+           | (g8[1::2, 1::2].astype(jnp.int32) << 3))
+    mv_nz = (mv[..., 0] != 0) | (mv[..., 1] != 0)
+    coded = (cbp != 0) | mv_nz
+
+    mvp = jnp.concatenate(
+        [jnp.zeros((R, 1, 2), jnp.int32), mv[:, :-1]], axis=1)
+    mvd = mv - mvp
+
+    colg = jax.lax.broadcasted_iota(jnp.int32, (nby, nbx), 1)
+    rowg = jax.lax.broadcasted_iota(jnp.int32, (nby, nbx), 0)
+    g8_idx = ((rowg % 4) >> 1) * 2 + ((colg % 4) >> 1)
+    grp_bit = (jnp.right_shift(_expand(cbp, 4, 4), g8_idx) & 1) == 1
+    blk_on = grp_bit & _expand(coded, 4, 4)
+
+    from .h264_planes import _nc_planes
+    ev = []
+    for ci in range(3):
+        tc = sum((s != 0).astype(jnp.int32) for s in scans[ci])
+        nc = _nc_planes(jnp.where(blk_on, tc, 0), 4)
+        apay, anb, _ = cavlc_events_planes(scans[ci], nc)
+        ev.append((apay, jnp.where(blk_on[None], anb, 0)))
+
+    # recon per component
+    recons = []
+    for ci in range(3):
+        qb = qp_by if ci == 0 else qpc_by
+        d = [[_dequant_plane(jnp.where(blk_on, acls[ci][i][j], 0), qb,
+                             _POS_CLS[i][j])
+              for j in range(4)] for i in range(4)]
+        inv = inv4_planes(d)
+        pp = [[preds[ci][i::4, j::4] for j in range(4)] for i in range(4)]
+        rec = [[_clip1(pp[i][j] + ((inv[i][j] + 32) >> 6))
+                for j in range(4)] for i in range(4)]
+        recons.append(_merge_planes(rec, 4, 4).astype(jnp.uint8))
+
+    out = _assemble_p_444(R, M, w_cap, e_cap, qp, fn, header_pay,
+                          header_nb, cbp, coded, mvd, ev)
+    return out, tuple(recons)
+
+
+def _assemble_p_444(R, M, w_cap, e_cap, qp, fn, header_pay, header_nb,
+                    cbp, coded, mvd, ev):
+    nby, nbx = 4 * R, 4 * M
+
+    idx = jax.lax.broadcasted_iota(jnp.int32, (R, M), 1)
+    marked = jnp.where(coded, idx, -1)
+    inclusive = jax.lax.associative_scan(jnp.maximum, marked, axis=1)
+    prev_excl = jnp.concatenate(
+        [jnp.full((R, 1), -1, jnp.int32), inclusive[:, :-1]], axis=1)
+    skip_run = idx - prev_excl - 1
+    trailing = (M - 1) - inclusive[:, -1]
+
+    sr_pay, sr_nb = _ue_event(jnp.maximum(skip_run, 0))
+    sr_nb = jnp.where(coded, sr_nb, 0)
+    mbt_pay = jnp.ones((R, M), jnp.uint32)
+    mbt_nb = jnp.where(coded, 1, 0)
+    mvdx_pay, mvdx_nb = _se_event(mvd[..., 0])
+    mvdx_nb = jnp.where(coded, mvdx_nb, 0)
+    mvdy_pay, mvdy_nb = _se_event(mvd[..., 1])
+    mvdy_nb = jnp.where(coded, mvdy_nb, 0)
+    cbp_pay, cbp_nb = _ue_event(_CBP444_J[cbp])
+    cbp_nb = jnp.where(coded, cbp_nb, 0)
+    dqp_pay = jnp.ones((R, M), jnp.uint32)
+    dqp_nb = jnp.where(coded & (cbp != 0), 1, 0)
+    hdr_pays = jnp.stack([sr_pay, mbt_pay, mvdx_pay, mvdy_pay, cbp_pay,
+                          dqp_pay])
+    hdr_nbs = jnp.stack([sr_nb, mbt_nb, mvdx_nb, mvdy_nb, cbp_nb,
+                         dqp_nb])
+
+    dqp_h = qp - 26
+    qph_pay, qph_nb = _ue_event(jnp.where(dqp_h > 0, 2 * dqp_h - 1,
+                                          -2 * dqp_h))
+    row_pays = jnp.stack([header_pay[:, 0].astype(jnp.uint32),
+                          header_pay[:, 1].astype(jnp.uint32),
+                          (fn & 0xF).astype(jnp.uint32),
+                          jnp.zeros((R,), jnp.uint32), qph_pay,
+                          jnp.full((R,), 2, jnp.uint32)])
+    row_nbs = jnp.stack([header_nb[:, 0].astype(jnp.int32),
+                         header_nb[:, 1].astype(jnp.int32),
+                         jnp.full((R,), 4, jnp.int32),
+                         jnp.full((R,), 3, jnp.int32), qph_nb,
+                         jnp.full((R,), 3, jnp.int32)])
+
+    hdr_bits = hdr_nbs.sum(0)
+    comp_rm = [_grid_rm(anb.sum(0), 4, 4) for _, anb in ev]
+    comp_mb = [sum(rm[i][j] for i, j in _SCAN_ORDER) for rm in comp_rm]
+    mb_bits = hdr_bits + sum(comp_mb)
+
+    tr_pay, tr_nb = _ue_event(jnp.maximum(trailing, 0))
+    tr_nb = jnp.where(trailing > 0, tr_nb, 0)
+
+    prefix_bits = row_nbs.sum(0)
+    mb_start = prefix_bits[:, None] + jnp.cumsum(mb_bits, axis=1) - mb_bits
+    body_end = prefix_bits + jnp.sum(mb_bits, axis=1)
+    total_bits = body_end + tr_nb + 1
+
+    sink = _EventSink(R, w_cap)
+    rows_r = jnp.arange(R, dtype=jnp.int32)
+    sink.add(rows_r[None], _excl_cumsum0(row_nbs), row_pays, row_nbs)
+    row_rm = rows_r[None, :, None]
+    sink.add(row_rm, mb_start[None] + _excl_cumsum0(hdr_nbs),
+             hdr_pays, hdr_nbs)
+
+    row_blk = _row_of_blocks(nby, nbx, 4)
+    base = mb_start + hdr_bits
+    for ci, (apay, anb) in enumerate(ev):
+        starts_rm = [[None] * 4 for _ in range(4)]
+        acc = base
+        for (i, j) in _SCAN_ORDER:
+            starts_rm[i][j] = acc
+            acc = acc + comp_rm[ci][i][j]
+        start_pl = _merge_planes(starts_rm, 4, 4)
+        sink.add(row_blk[None], start_pl[None] + _excl_cumsum0(anb),
+                 apay, anb)
+        base = acc
+
+    sink.add(rows_r, body_end, tr_pay, tr_nb)
+    sink.add(rows_r, total_bits - 1, jnp.ones((R,), jnp.uint32),
+             jnp.ones((R,), jnp.int32))
+    words, n_ev = sink.pack()
+    overflow = jnp.any((n_ev > e_cap) | (total_bits > w_cap * 32))
+    return H264FrameOut(words, total_bits.astype(jnp.int32), overflow, R)

@@ -357,8 +357,13 @@ class SelkiesClient {
           this._requestKeyframeThrottled();
         },
       });
-      // Annex-B stream (no description): constrained baseline
-      dec.configure({ codec: "avc1.42c02a", optimizeForLatency: true });
+      // Annex-B stream (no description): constrained baseline, or
+      // Hi444PP when the server streams fullcolor 4:4:4 (the reference's
+      // f4001f profile munge)
+      const st = (this.serverSettings && this.serverSettings.settings) || {};
+      const fullcolor = !!(st.fullcolor && st.fullcolor.value);
+      dec.configure({ codec: fullcolor ? "avc1.f4002a" : "avc1.42c02a",
+                      optimizeForLatency: true });
       this.h264Decoders.set(y, dec);
     }
     if (dec.decodeQueueSize > 16) {
