@@ -40,15 +40,22 @@ void *dec_open(const char *codec_name)
 }
 
 /* Copy the decoded frame's planes out; chroma_div reports the chroma
- * subsampling divisor (2 for yuv420, 1 for yuv444 — Hi444PP streams). */
-static void copy_planes(Dec *d, uint8_t *out_y, uint8_t *out_u,
-                        uint8_t *out_v, int *out_w, int *out_h,
-                        int *out_chroma_div)
+ * subsampling divisor (2 for yuv420, 1 for yuv444 — Hi444PP streams).
+ * A NULL out_chroma_div means the caller is a legacy dec_decode/dec_flush
+ * user whose chroma buffers are sized w*h/4 — copying 4:4:4 chroma there
+ * would overflow the heap, so such frames are rejected (-100) instead. */
+static int copy_planes(Dec *d, uint8_t *out_y, uint8_t *out_u,
+                       uint8_t *out_v, int *out_w, int *out_h,
+                       int *out_chroma_div)
 {
     int w = d->frame->width, h2 = d->frame->height;
     int fmt = d->frame->format;
     int cd = (fmt == AV_PIX_FMT_YUV444P || fmt == AV_PIX_FMT_YUVJ444P)
         ? 1 : 2;
+    if (cd != 2 && !out_chroma_div) {
+        av_frame_unref(d->frame);
+        return -100;
+    }
     *out_w = w;
     *out_h = h2;
     if (out_chroma_div)
@@ -64,6 +71,7 @@ static void copy_planes(Dec *d, uint8_t *out_y, uint8_t *out_u,
                d->frame->data[2] + (size_t)r * d->frame->linesize[2], cw);
     }
     av_frame_unref(d->frame);
+    return 0;
 }
 
 /* Decode one access unit. Returns 0 on success with a decoded frame,
@@ -87,8 +95,8 @@ int dec_decode_fmt(void *h, const uint8_t *data, int size,
         return 1;
     if (ret < 0)
         return ret;
-    copy_planes(d, out_y, out_u, out_v, out_w, out_h, out_chroma_div);
-    return 0;
+    return copy_planes(d, out_y, out_u, out_v, out_w, out_h,
+                       out_chroma_div);
 }
 
 int dec_decode(void *h, const uint8_t *data, int size,
@@ -110,8 +118,8 @@ int dec_flush_fmt(void *h, uint8_t *out_y, uint8_t *out_u, uint8_t *out_v,
     ret = avcodec_receive_frame(d->ctx, d->frame);
     if (ret < 0)
         return ret;
-    copy_planes(d, out_y, out_u, out_v, out_w, out_h, out_chroma_div);
-    return 0;
+    return copy_planes(d, out_y, out_u, out_v, out_w, out_h,
+                       out_chroma_div);
 }
 
 int dec_flush(void *h, uint8_t *out_y, uint8_t *out_u, uint8_t *out_v,

@@ -239,7 +239,8 @@ def _motion_select444(cur_y, rfy, rfu, rfv, qp, candidates, win):
     """Luma-SAD candidate selection as in h264_planes, but chroma rides
     the SAME full-pel shift at full resolution (no eighth-sample
     interpolation in 4:4:4 with full-pel luma vectors)."""
-    from .h264_encode import _MV_LAMBDA, _hshift, _vshift, se_bits
+    from .h264_encode import (_MV_LAMBDA, _hshift, _sad_mb16, _vshift,
+                              se_bits)
     H, W = cur_y.shape
     R, M = H // 16, W // 16
     S = H // win
@@ -254,7 +255,7 @@ def _motion_select444(cur_y, rfy, rfu, rfv, qp, candidates, win):
         shifted_y.append(shy)
         shifted_u.append(_hshift(_vshift(ru_w, dy), dx).reshape(H, W))
         shifted_v.append(_hshift(_vshift(rv_w, dy), dx).reshape(H, W))
-        sad = jnp.abs(cur_y - shy).reshape(R, 16, M, 16).sum(axis=(1, 3))
+        sad = _sad_mb16(jnp.abs(cur_y - shy))
         bits = se_bits(4 * dx) + se_bits(4 * dy)
         costs.append(sad + lam[:, None] * bits)
     sel = jnp.argmin(jnp.stack(costs), axis=0).astype(jnp.int32)

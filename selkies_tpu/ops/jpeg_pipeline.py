@@ -52,8 +52,12 @@ def jpeg_forward_444(rgb: jnp.ndarray, qy: jnp.ndarray, qc: jnp.ndarray
 @functools.cache
 def jitted_jpeg_forward(subsampling: str = "420"):
     """Compiled forward fn for a fixed subsampling; shapes specialise on
-    first call per (H, W)."""
-    fn = jpeg_forward_420 if subsampling == "420" else jpeg_forward_444
+    first call per (H, W). Uses the TPU plane-layout transforms
+    (:mod:`.jpeg_planes`), which are verified coefficient-exact against
+    the block-layout reference above (tests/test_jpeg.py)."""
+    from . import jpeg_planes
+    fn = (jpeg_planes.jpeg_forward_420 if subsampling == "420"
+          else jpeg_planes.jpeg_forward_444)
     return jax.jit(fn)
 
 
@@ -66,10 +70,12 @@ def jitted_jpeg_forward(subsampling: str = "420"):
 def jpeg_encode_device(rgb: jnp.ndarray, qy: jnp.ndarray, qc: jnp.ndarray,
                        subsampling: str, e_cap: int, w_cap: int):
     """RGB frame -> PackedStream (scan bits) entirely on device."""
+    from . import jpeg_planes
     from .jpeg_entropy import jpeg_entropy_device, scan_layout
 
     h, w = rgb.shape[:2]
-    fwd = jpeg_forward_420 if subsampling == "420" else jpeg_forward_444
+    fwd = (jpeg_planes.jpeg_forward_420 if subsampling == "420"
+           else jpeg_planes.jpeg_forward_444)
     y_zz, cb_zz, cr_zz = fwd(rgb, qy, qc)
     layout = scan_layout(h // 8, w // 8, subsampling)
     return jpeg_entropy_device(y_zz, cb_zz, cr_zz, layout,

@@ -1,0 +1,104 @@
+"""TPU plane-layout JPEG forward transform (PERF.md lever 3).
+
+The original path (:mod:`.dct`) reshapes every plane into ``(N, 8, 8)``
+blocks — 8x8 MINOR dims, which XLA:TPU tiles into (8, 128) vector
+registers at 1/16th occupancy, so the transform stage moves ~16x the
+frame's bytes through HBM (the same layout disaster the H.264 codec had
+before :mod:`.h264_planes`). This module is the 8x8 analog of
+``fwd4_planes``: spatial position (a, b) of every 8x8 block lives in ONE
+``(H/8, W/8)`` plane (minor dims 240x135 at 1080p — full vregs), the 2-D
+DCT is 64 scalar-weighted plane FMAs per output coefficient expressed as
+two separable 8-term passes, and quantisation + zigzag happen per plane
+(zigzag = picking planes in a static order: free).
+
+Output is the same ``(N, 64)`` int16 zigzag contract the entropy stage
+consumes (:func:`.jpeg_entropy.jpeg_entropy_device`), produced by one
+(64, N) -> (N, 64) transpose of bitrate-light int16 data — the only
+layout change that still touches block-minor data, at 2 bytes/coeff
+instead of the old path's full float32 transform tensors.
+
+Reference equivalent: the transform stage inside the closed Rust
+pixelflux encoder (SURVEY.md §2.2); layout design is original.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+from .colorspace import rgb_to_ycbcr, split_ycbcr_420
+from .dct import dct8_matrix, zigzag_order
+
+
+@functools.cache
+def _zz_ij() -> list[tuple[int, int]]:
+    """Zigzag slot k -> (i, j) frequency-plane coordinates."""
+    return [(int(z) // 8, int(z) % 8) for z in zigzag_order()]
+
+
+def _dct_planes(plane: jnp.ndarray) -> list[list[jnp.ndarray]]:
+    """(H, W) centered float32 -> 8x8 list of (H/8, W/8) coefficient
+    planes: coef[i][j][y, x] = DCT(block (y, x))[i, j].
+
+    Separable: tmp[i][b] = sum_a D[i, a] * X[a][b], then
+    coef[i][j] = sum_b D[j, b] * tmp[i][b]. Every term is a scalar *
+    full-vreg plane FMA; XLA fuses each 8-term chain into one pass.
+    """
+    d = np.asarray(dct8_matrix(), np.float32)
+    xs = [[plane[a::8, b::8] for b in range(8)] for a in range(8)]
+    tmp = [[None] * 8 for _ in range(8)]
+    for i in range(8):
+        for b in range(8):
+            acc = d[i, 0] * xs[0][b]
+            for a in range(1, 8):
+                acc = acc + d[i, a] * xs[a][b]
+            tmp[i][b] = acc
+    coef = [[None] * 8 for _ in range(8)]
+    for i in range(8):
+        for j in range(8):
+            acc = d[j, 0] * tmp[i][0]
+            for b in range(1, 8):
+                acc = acc + d[j, b] * tmp[i][b]
+            coef[i][j] = acc
+    return coef
+
+
+def _quant_zigzag_planes(coef, qtable_raster: jnp.ndarray) -> jnp.ndarray:
+    """8x8 coefficient planes -> (N, 64) int16 zigzag rows (plane-raster
+    block order), matching :func:`.dct.quantize_zigzag` exactly: divide
+    by the raster-order table, round half away from zero."""
+    qt = qtable_raster.reshape(64).astype(jnp.float32)
+    cols = []
+    for k, (i, j) in enumerate(_zz_ij()):
+        q = coef[i][j] / qt[i * 8 + j]
+        cols.append(jnp.trunc(q + jnp.sign(q) * 0.5).astype(jnp.int16))
+    # (64, Hb, Wb) -> (Hb, Wb, 64) -> (N, 64): the one block-minor
+    # materialisation left, on int16 quantised data (bitrate-sized)
+    stack = jnp.stack(cols)
+    n = stack.shape[1] * stack.shape[2]
+    return jnp.moveaxis(stack, 0, -1).reshape(n, 64)
+
+
+def _forward_plane(plane: jnp.ndarray, qtable: jnp.ndarray) -> jnp.ndarray:
+    return _quant_zigzag_planes(_dct_planes(plane - 128.0), qtable)
+
+
+def jpeg_forward_420(rgb: jnp.ndarray, qy: jnp.ndarray, qc: jnp.ndarray
+                     ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(H, W, 3) uint8 RGB -> (Ny,64), (Nc,64), (Nc,64) int16 zigzag
+    coeffs — same contract as :func:`.jpeg_pipeline.jpeg_forward_420`,
+    plane-layout transforms."""
+    ycc = rgb_to_ycbcr(rgb, "bt601-full")
+    y, cb, cr = split_ycbcr_420(ycc)
+    return tuple(_forward_plane(p, q)
+                 for p, q in ((y, qy), (cb, qc), (cr, qc)))
+
+
+def jpeg_forward_444(rgb: jnp.ndarray, qy: jnp.ndarray, qc: jnp.ndarray
+                     ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """4:4:4 variant (``fullcolor`` setting): H, W multiples of 8."""
+    ycc = rgb_to_ycbcr(rgb, "bt601-full")
+    return tuple(_forward_plane(ycc[..., ci], q)
+                 for ci, q in ((0, qy), (1, qc), (2, qc)))

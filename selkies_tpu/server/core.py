@@ -288,9 +288,25 @@ class CentralizedStreamServer:
             raise web.HTTPBadRequest(text="refusing symlink target")
         return target
 
+    def _transfer_allowed(self, request: web.Request, direction: str) -> bool:
+        """Direction gating (reference stream_server.py:980,1171) plus a
+        per-role layer: view-only sessions are denied unless the
+        direction is explicitly opened to them."""
+        allowed = {d.strip() for d in
+                   str(getattr(self.settings, "file_transfers",
+                               "upload,download")).split(",")}
+        if direction not in allowed:
+            return False
+        if request.get("role") == "full":
+            return True
+        vo = {d.strip() for d in
+              str(getattr(self.settings, "viewonly_file_transfers",
+                          "")).split(",")}
+        return direction in vo
+
     async def handle_upload(self, request: web.Request) -> web.Response:
-        if request["role"] != "full":
-            return web.Response(status=403, text="view-only")
+        if not self._transfer_allowed(request, "upload"):
+            return web.Response(status=403, text="upload not allowed")
         name = request.headers.get("X-Upload-Name")
         if not name:
             return web.Response(status=400, text="X-Upload-Name required")
@@ -327,6 +343,8 @@ class CentralizedStreamServer:
         return web.json_response({"complete": False, "size": size})
 
     async def handle_file_index(self, request: web.Request) -> web.Response:
+        if not self._transfer_allowed(request, "download"):
+            raise web.HTTPForbidden(text="download not allowed")
         root = self._transfer_root()
         entries = []
         if root.is_dir():
@@ -337,7 +355,8 @@ class CentralizedStreamServer:
                                 "size": p.stat().st_size if p.is_file() else 0})
         if "text/html" in request.headers.get("Accept", ""):
             rows = "".join(
-                f'<li><a href="/api/files/{html.escape(e["name"])}">'
+                '<li><a href="/api/files/'
+                f'{urllib.parse.quote(e["name"])}">'
                 f'{html.escape(e["name"])}</a> ({e["size"]} B)</li>'
                 for e in entries if not e["dir"])
             return web.Response(
@@ -346,6 +365,8 @@ class CentralizedStreamServer:
         return web.json_response({"files": entries})
 
     async def handle_file_download(self, request: web.Request) -> web.StreamResponse:
+        if not self._transfer_allowed(request, "download"):
+            raise web.HTTPForbidden(text="download not allowed")
         target = self._safe_target(request.match_info["name"])
         if not target.is_file():
             raise web.HTTPNotFound()

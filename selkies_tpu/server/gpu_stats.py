@@ -190,13 +190,18 @@ def get_gpus(drm_root: str = "/sys/class/drm") -> list[GPUStat]:
     A stage that reports nothing is cached dead for _DEAD_RETRY_S — no
     per-tick subprocess forks on GPU-less hosts, but late-arriving
     drivers are still picked up."""
-    gpus = [] if _stage_dead("nvml") else _nvml_gpus()
-    if not gpus:
-        _mark_dead("nvml")
-        if not _stage_dead("smi"):
-            gpus = _nvidia_smi_gpus()
-            if not gpus:
-                _mark_dead("smi")
+    gpus: list[GPUStat] = []
+    if not _stage_dead("nvml"):
+        gpus = _nvml_gpus()
+        if not gpus:
+            # only a stage that actually ran this call may refresh its
+            # dead timestamp — marking on the skip path would keep the
+            # timestamp forever fresh and the stage dead forever
+            _mark_dead("nvml")
+    if not gpus and not _stage_dead("smi"):
+        gpus = _nvidia_smi_gpus()
+        if not gpus:
+            _mark_dead("smi")
     seen_bus = {g.pci_bus for g in gpus if g.pci_bus}
     have_nvidia = any(g.vendor == "nvidia" for g in gpus)
     for g in _drm_sysfs_gpus(drm_root, start_index=len(gpus)):

@@ -185,13 +185,15 @@ class WebRTCService(BaseStreamingService):
             old.peer.close()
         host = getattr(self.settings, "webrtc_media_ip", "") \
             or _default_media_ip()
-        # fullcolor stays False in the offer until the TPU H.264 path
-        # grows a 4:4:4 mode — advertising f4001f over a 4:2:0 stream
-        # would let a profile-strict browser reject the m-line
+        # fullcolor follows the user setting: the capture encodes Hi444PP
+        # (ops/h264_planes444, oracle chain tests/test_h264_444.py) and
+        # the offer advertises f4001f so the browser picks the matching
+        # decoder profile (reference rtc.py:649-717 profile munge)
+        fullcolor = bool(getattr(self.settings, "fullcolor", False))
         with_audio = self.audio is not None \
             and bool(getattr(self.settings, "enable_audio", False))
         peer = RTCPeer(host=host, on_request_keyframe=self._request_idr,
-                       with_audio=with_audio, fullcolor=False,
+                       with_audio=with_audio, fullcolor=fullcolor,
                        on_datachannel_message=self._on_input_verb,
                        on_bitrate_estimate=self._on_remb)
         if with_audio and self.audio.on_raw_frame is None:
@@ -268,6 +270,7 @@ class WebRTCService(BaseStreamingService):
                 use_paint_over=s.use_paint_over,
                 h264_motion_vrange=s.h264_motion_vrange,
                 h264_motion_hrange=s.h264_motion_hrange,
+                fullcolor=bool(getattr(s, "fullcolor", False)),
             )
             cap.start_capture(self._on_chunk, cs)
         except Exception:

@@ -212,6 +212,13 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
 
     # --- file transfer ------------------------------------------------------
     _s("enable_file_transfer", SType.BOOL, True, "Uploads/downloads."),
+    _s("file_transfers", SType.STR, "upload,download",
+       "Allowed transfer directions (comma-separated 'upload,download'; "
+       "'' or 'none' disables — reference settings.py file_transfers)."),
+    _s("viewonly_file_transfers", SType.STR, "",
+       "Transfer directions additionally allowed for the view-only role "
+       "(default: none — view-only sessions get 403 on /api/files/* "
+       "and uploads)."),
     _s("file_transfer_dir", SType.STR, "~/Desktop",
        "Root directory for uploads and the download index."),
     _s("upload_chunk_bytes", SType.INT, 64 * 1024 * 1024, "Max upload slice size."),

@@ -365,10 +365,33 @@ class SendSideCongestionController:
     """Ties the pieces together for one peer (all media share one
     transport-wide sequence space, RFC 8888 style)."""
 
+    #: how long a "not received" TWCC symbol may stay provisional before
+    #: it is finalised as a loss. Browsers routinely report a packet as
+    #: missing in one feedback and received in the next (reordering /
+    #: delayed delivery); counting it lost on first sight inflates
+    #: last_loss_fraction and triggers spurious 0.7x backoffs.
+    LOSS_GRACE_US = 300_000
+
+    #: sliding window for the loss fraction — must comfortably cover
+    #: LOSS_GRACE_US so a finalised loss is compared against the
+    #: receives of its own era rather than one feedback batch's
+    LOSS_WINDOW_US = 1_000_000
+
     def __init__(self, ceiling_bps: float = 20_000_000.0,
                  start_bps: float = 2_000_000.0):
         self._next_seq = 0
         self._sent = collections.OrderedDict()   # seq -> (send_us, size)
+        self._missing = {}                       # seq -> first-missing us
+        # per-feedback (now_us, received, lost) samples: the loss
+        # fraction is computed over a sliding window so grace-delayed
+        # loss finalisations are weighed against the receives of THEIR
+        # window, not whatever single feedback they land in
+        self._loss_window = collections.deque()
+        # newest send time already fed to the trendline: a late packet
+        # (missing in one feedback, received in a later one) must not be
+        # grouped behind newer packets — the out-of-order send time would
+        # inject a huge spurious delay-delta and a false overuse signal
+        self._max_send_fed = -1
         self._trend = TrendlineEstimator()
         self._acked = AckedBitrate()
         self._aimd = AimdRateControl(start_bps=start_bps,
@@ -386,27 +409,45 @@ class SendSideCongestionController:
     def on_packet_sent(self, seq: int, size: int, now_us: int) -> None:
         self._sent[seq] = (now_us, size)
         while len(self._sent) > 4096:
-            self._sent.popitem(last=False)
+            old_seq, _ = self._sent.popitem(last=False)
+            self._missing.pop(old_seq, None)
 
     # -- feedback -----------------------------------------------------------
     def on_feedback(self, fb: TwccFeedback, now_us: int) -> float:
         received = 0
         lost = 0
         for seq, rx_us in fb.packets:
+            if rx_us is None:
+                # provisional: a later feedback often re-reports the same
+                # seq as received — keep it in _sent for a grace window
+                if seq in self._sent:
+                    self._missing.setdefault(seq, now_us)
+                continue
             sent = self._sent.pop(seq, None)
+            self._missing.pop(seq, None)
             if sent is None:
                 continue
             send_us, size = sent
-            if rx_us is None:
-                lost += 1
-                continue
             received += 1
             self._acked.add(rx_us, size)
-            self._trend.add_packet(send_us, rx_us)
+            if send_us >= self._max_send_fed:
+                self._max_send_fed = send_us
+                self._trend.add_packet(send_us, rx_us)
+        # finalise losses whose grace window has expired
+        for seq in [s for s, t in self._missing.items()
+                    if now_us - t >= self.LOSS_GRACE_US]:
+            del self._missing[seq]
+            if self._sent.pop(seq, None) is not None:
+                lost += 1
         self._trend.flush()
-        total = received + lost
-        if total:
-            self.last_loss_fraction = lost / total
+        self._loss_window.append((now_us, received, lost))
+        lo = now_us - self.LOSS_WINDOW_US
+        while self._loss_window and self._loss_window[0][0] < lo:
+            self._loss_window.popleft()
+        w_recv = sum(s[1] for s in self._loss_window)
+        w_lost = sum(s[2] for s in self._loss_window)
+        if w_recv + w_lost:
+            self.last_loss_fraction = w_lost / (w_recv + w_lost)
         delay_rate = self._aimd.update(self._trend.state,
                                        self._acked.bps(), now_us)
         loss_cap = self._loss.update(self.last_loss_fraction, now_us)

@@ -89,6 +89,13 @@ class H264Packetizer:
     when they fit, FU-A fragmentation otherwise. One call per access
     unit; marker set on the AU's last packet."""
 
+    #: wire overhead counted against ``mtu`` (which budgets the whole
+    #: SRTP datagram, not just the H.264 payload): 12-byte RTP header +
+    #: 8-byte one-byte-header extension block when TWCC is on (4 BEDE
+    #: header + 3 element + 1 pad) + the SRTP auth tag.
+    RTP_HEADER = 12
+    TWCC_EXT_OVERHEAD = 8
+
     def __init__(self, payload_type: int = 102, ssrc: int | None = None,
                  mtu: int = 1200, twcc_alloc=None):
         self.payload_type = payload_type
@@ -99,11 +106,20 @@ class H264Packetizer:
         self._octets = 0
         self._packets = 0
 
+    @property
+    def _max_payload(self) -> int:
+        from .srtp import SrtpContext
+        over = self.RTP_HEADER + SrtpContext.AUTH_TAG
+        if self.twcc_alloc is not None:
+            over += self.TWCC_EXT_OVERHEAD
+        return max(64, self.mtu - over)
+
     def packetize(self, annexb: bytes, timestamp: int) -> list[RtpPacket]:
         packets: list[RtpPacket] = []
         nals = [n for n in split_annexb(annexb) if n]
+        budget = self._max_payload
         for nal in nals:
-            if len(nal) <= self.mtu:
+            if len(nal) <= budget:
                 packets.append(self._pkt(nal, timestamp))
             else:
                 indicator = (nal[0] & 0xE0) | 28          # FU-A
@@ -111,7 +127,7 @@ class H264Packetizer:
                 rest = nal[1:]
                 first = True
                 while rest:
-                    chunk, rest = rest[:self.mtu - 2], rest[self.mtu - 2:]
+                    chunk, rest = rest[:budget - 2], rest[budget - 2:]
                     fu = 0x80 if first else (0x40 if not rest else 0x00)
                     packets.append(self._pkt(
                         bytes((indicator, fu | header)) + chunk, timestamp))

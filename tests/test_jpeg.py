@@ -154,3 +154,23 @@ def test_quality_scaling_monotonic():
     t10 = J.scale_qtable(J.STD_LUMA_QUANT, 10)
     assert (t90 <= t50).all() and (t10 >= t50).all()
     assert J.scale_qtable(J.STD_LUMA_QUANT, 100).min() == 1
+
+
+def test_plane_layout_forward_coefficient_exact():
+    """The TPU plane-layout transform (ops/jpeg_planes, PERF.md lever 3)
+    must produce coefficient-exact output vs the block-layout reference
+    path (ops/jpeg_pipeline.jpeg_forward_*) — the plane rewrite is a pure
+    layout change, like h264_planes vs h264_encode."""
+    import jax.numpy as jnp
+
+    from selkies_tpu.ops import jpeg_pipeline as blk
+    from selkies_tpu.ops import jpeg_planes as pl
+
+    rng = np.random.default_rng(7)
+    rgb = jnp.asarray(rng.integers(0, 256, (48, 64, 3), np.uint8))
+    qy = jnp.asarray(J.scale_qtable(J.STD_LUMA_QUANT, 60))
+    qc = jnp.asarray(J.scale_qtable(J.STD_CHROMA_QUANT, 60))
+    for old_fn, new_fn in ((blk.jpeg_forward_420, pl.jpeg_forward_420),
+                           (blk.jpeg_forward_444, pl.jpeg_forward_444)):
+        for a, b in zip(old_fn(rgb, qy, qc), new_fn(rgb, qy, qc)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

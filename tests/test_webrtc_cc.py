@@ -132,3 +132,96 @@ def test_sdp_offers_transport_cc():
     sdp = build_offer("127.0.0.1", 5000, "u", "p", "AA:BB")
     assert "transport-cc" in sdp
     assert f"a=extmap:{TWCC_EXT_ID} " in sdp
+
+
+def _feedback(cc, seqs, times, now):
+    fb = build_rtcp_twcc(1, 2, seqs[0], times)
+    for f in parse_rtcp_twcc(fb):
+        cc.on_feedback(f, now)
+
+
+def test_missing_then_received_is_not_loss():
+    """TWCC routinely reports a packet 'not received' and re-reports it
+    received in the next feedback (reordering / delayed delivery). The
+    grace window must keep such packets out of the loss fraction."""
+    cc = SendSideCongestionController(start_bps=4_000_000.0)
+    now = 0
+    seqs = []
+    for i in range(10):
+        s = cc.alloc_seq()
+        cc.on_packet_sent(s, 1200, now)
+        seqs.append(s)
+        now += 10_000
+    # first feedback: seq 5 missing
+    times = [now + i * 1_000 if i != 5 else None for i in range(10)]
+    _feedback(cc, seqs, times, now)
+    assert cc.last_loss_fraction == 0.0
+    assert 5 in cc._missing
+    # second feedback (within grace): seq 5 arrived after all
+    now += 50_000
+    _feedback(cc, [seqs[5]], [now], now)
+    assert 5 not in cc._missing
+    # grace expiry with nothing outstanding: still no loss
+    now += SendSideCongestionController.LOSS_GRACE_US + 1
+    s = cc.alloc_seq()
+    cc.on_packet_sent(s, 1200, now)
+    _feedback(cc, [s], [now + 1_000], now)
+    assert cc.last_loss_fraction == 0.0
+
+
+def test_loss_finalised_after_grace_window():
+    """A packet never re-reported received must count as lost once the
+    grace window expires — weighed against the receives of the whole
+    sliding window, not just the finalising feedback."""
+    cc = SendSideCongestionController(start_bps=4_000_000.0)
+    now = 0
+    seqs = []
+    for i in range(20):
+        s = cc.alloc_seq()
+        cc.on_packet_sent(s, 1200, now)
+        seqs.append(s)
+        now += 10_000
+    times = [now + i * 1_000 if i >= 4 else None for i in range(20)]
+    _feedback(cc, seqs, times, now)
+    assert cc.last_loss_fraction == 0.0          # still provisional
+    # grace expires; the finalising feedback acks just 2 new packets
+    now += SendSideCongestionController.LOSS_GRACE_US + 1_000
+    extra = []
+    for i in range(2):
+        s = cc.alloc_seq()
+        cc.on_packet_sent(s, 1200, now)
+        extra.append(s)
+    _feedback(cc, extra, [now + 1_000, now + 2_000], now)
+    # 4 lost vs 16+2 received over the window -> ~18%, NOT 4/(4+2)=67%
+    assert abs(cc.last_loss_fraction - 4 / 22) < 1e-9
+
+
+def test_late_received_packet_does_not_poison_trendline():
+    """A packet reported missing then received later must not be grouped
+    behind newer packets — its stale send time would inject a spurious
+    delay-delta and flip the detector to overuse on a healthy link."""
+    cc = SendSideCongestionController(start_bps=4_000_000.0)
+    now = 0
+    seqs = []
+    for i in range(40):
+        s = cc.alloc_seq()
+        cc.on_packet_sent(s, 1200, now)
+        seqs.append(s)
+        now += 10_000
+    # fb1: constant 5ms delay, seq 2 missing
+    times = [i * 10_000 + 5_000 if i != 2 else None for i in range(40)]
+    _feedback(cc, seqs, times, now)
+    assert cc._trend.state == "normal"
+    # fb2: seq 2 finally arrives (re-reported received) + fresh packets
+    late = [seqs[2]]
+    late_times = [now + 1_000]
+    for i in range(20):
+        s = cc.alloc_seq()
+        cc.on_packet_sent(s, 1200, now)
+        late.append(s)
+        late_times.append(now + 5_000 + i * 10_000)
+        now += 10_000
+    _feedback(cc, late, late_times, now)
+    # healthy link: the late packet must not fabricate queue growth
+    assert cc._trend.state == "normal"
+    assert cc.last_loss_fraction == 0.0

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Empirically derive the ChromaArrayType-3 (4:4:4 / monochrome)
+coded_block_pattern me(v) mapping — Table 9-4's "0 or 3" inter column —
+against libavcodec, and check it matches the committed table
+(codecs/h264_tables.CBP444_INTER_CBP2CODE).
+
+Method (no spec table assumed): for every cbp value 0..15 we hand-write
+a one-MB Hi444PP P slice whose residual blocks cover EXACTLY the 8x8
+luma groups in ``cbp``, once per candidate code_num 0..15 written as the
+coded_block_pattern ue(v). Only the correct code_num parses: a wrong one
+makes ffmpeg derive a different cbp, desyncing the residual parse —
+decode fails or reconstructs differently. The candidate whose decode
+byte-matches our predicted reconstruction is the code for that cbp; the
+scan asserts it is unique. cbp == 0 is exercised with a coded MB that
+carries a nonzero motion vector (that is how the production encoder
+emits cbp 0: ops/h264_planes444._assemble_p_444 writes the cbp code for
+every coded MB, including pure-motion ones).
+
+The reference streams fullcolor by negotiating Hi444PP from x264/NVENC
+(reference src/selkies/rtc.py:649-717); our encoder emits the bits
+itself, so this mapping must be independently verified.
+
+Run: python tools/derive_cbp444.py   (needs the libavcodec shim)
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from selkies_tpu.codecs import h264 as H            # noqa: E402
+from selkies_tpu.codecs import h264_tables as T     # noqa: E402
+from selkies_tpu.native import avshim               # noqa: E402
+
+QP = 28
+_GROUPS = {g: [(br, bc) for br in range(4) for bc in range(4)
+               if (br // 2) * 2 + (bc // 2) == g] for g in range(4)}
+
+
+def _i444_au() -> tuple[bytes, list[np.ndarray]]:
+    """Headers + a textured I444 AU; returns the encoder's decoder-exact
+    recon planes (texture makes motion compensation observable)."""
+    rng = np.random.default_rng(444)
+    enc = H.I444Encoder(16, 16, QP)
+    planes = [rng.integers(40, 216, (16, 16)).astype(np.uint8)
+              for _ in range(3)]
+    au = enc.encode_frame(*planes)
+    return enc.headers() + au, [p.astype(np.int64) for p in enc.recon]
+
+
+def _p444_mb_au(cbp: int, code_num: int, res_y: np.ndarray,
+                mvd: tuple[int, int]) -> bytes:
+    """One-MB P slice: residual blocks written for exactly the groups in
+    ``cbp`` (luma component; chroma components carry zero coefficients in
+    the same coded groups), coded_block_pattern written as
+    ue(code_num)."""
+    lvl = np.zeros((4, 4, 16), np.int64)
+    for br in range(4):
+        for bc in range(4):
+            wm = H._fwd4(res_y[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4])
+            lvl[br, bc] = H._quant4_inter(wm, QP).reshape(16)[T.ZIGZAG4_NP]
+
+    w = H.BitWriter()
+    H.p_slice_header_bits(w, 0, QP, 1)
+    w.ue(0)                           # mb_skip_run
+    w.ue(0)                           # mb_type P_L0_16x16
+    w.se(mvd[0]); w.se(mvd[1])        # mvd (quarter-pel units)
+    w.ue(code_num)                    # coded_block_pattern me(v) candidate
+    if cbp != 0:
+        w.se(0)                       # mb_qp_delta (present iff cbp != 0)
+        nnz = np.zeros((3, 1, 4, 4), np.int64)
+        for ci in range(3):
+            for br, bc in H.LUMA_BLK_ORDER:
+                g8 = (br // 2) * 2 + (bc // 2)
+                if not (cbp >> g8) & 1:
+                    continue
+                nc = H.I16Encoder._nc_luma(nnz[ci], 0, br, bc)
+                coeffs = lvl[br, bc] if ci == 0 else np.zeros(16, np.int64)
+                nnz[ci, 0, br, bc] = H._write_residual_block(
+                    w, coeffs, nc, 16)
+    w.rbsp_trailing()
+    return H.nal(1, w.to_bytes(), ref_idc=2)
+
+
+def _mc_shift(ref: np.ndarray, dx: int, dy: int) -> np.ndarray:
+    """Full-pel MC with picture-edge extension on a one-MB picture."""
+    ys = np.clip(np.arange(16) + dy, 0, 15)
+    xs = np.clip(np.arange(16) + dx, 0, 15)
+    return ref[np.ix_(ys, xs)]
+
+
+def _predicted_recon(cbp: int, res_y: np.ndarray,
+                     refs: list[np.ndarray], dx: int, dy: int
+                     ) -> list[np.ndarray]:
+    """Decoder-exact recon for the crafted MB, all three components."""
+    preds = [_mc_shift(r, dx, dy) for r in refs]
+    out = []
+    for ci, pred in enumerate(preds):
+        rec = np.empty((16, 16), np.int64)
+        for br in range(4):
+            for bc in range(4):
+                g8 = (br // 2) * 2 + (bc // 2)
+                d = np.zeros(16, np.int64)
+                if ci == 0 and (cbp >> g8) & 1:
+                    wm = H._fwd4(res_y[br * 4:br * 4 + 4,
+                                       bc * 4:bc * 4 + 4])
+                    d[T.ZIGZAG4_NP] = \
+                        H._quant4_inter(wm, QP).reshape(16)[T.ZIGZAG4_NP]
+                d = H._dequant4_ac(d.reshape(4, 4), QP)
+                r = (H._inv4(d) + 32) >> 6
+                rec[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] = np.clip(
+                    pred[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] + r, 0, 255)
+        out.append(rec.astype(np.uint8))
+    return out
+
+
+def derive() -> np.ndarray:
+    """cbp -> code_num by exhaustive candidate scan against ffmpeg."""
+    head_au, refs = _i444_au()
+    mapping = np.full(16, -1, np.int64)
+    for cbp in range(16):
+        # cbp 0 rides a pure-motion MB (mv = 1 full pel right) so the
+        # reconstruction is distinguishable from both skip and every
+        # wrong-cbp parse; others use zero MV + group-exact residual
+        mvd = (4, 0) if cbp == 0 else (0, 0)
+        dx, dy = mvd[0] // 4, mvd[1] // 4
+        res = np.zeros((16, 16), np.int64)
+        for g in range(4):
+            if (cbp >> g) & 1:
+                for br, bc in _GROUPS[g]:
+                    res[br * 4:br * 4 + 4, bc * 4:bc * 4 + 4] = 60
+        want = _predicted_recon(cbp, res, refs, dx, dy)
+        hits = []
+        for code in range(16):
+            au = _p444_mb_au(cbp, code, res, mvd)
+            try:
+                sess = avshim.H264Session()
+                got = None
+                for chunk in (head_au, au):
+                    got = sess.decode(chunk) or got
+                got = sess.flush() or got
+                sess.close()
+            except (ValueError, RuntimeError):
+                continue
+            if got is not None and got[0].shape == (16, 16) \
+                    and all(np.array_equal(got[ci], want[ci])
+                            for ci in range(3)):
+                hits.append(code)
+        assert len(hits) == 1, \
+            f"cbp {cbp}: candidates {hits} all decode-match (want exactly 1)"
+        mapping[cbp] = hits[0]
+    return mapping.astype(np.int32)
+
+
+def main() -> int:
+    if not avshim.available():
+        print("libavcodec shim unavailable; cannot derive", file=sys.stderr)
+        return 2
+    derived = derive()
+    print("derived cbp -> code_num:", derived.tolist())
+    print("committed table:        ",
+          T.CBP444_INTER_CBP2CODE.tolist())
+    if np.array_equal(derived, T.CBP444_INTER_CBP2CODE):
+        print("MATCH: CBP444_INTER_CBP2CODE is conformant")
+        return 0
+    print("MISMATCH — the committed table is wrong", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
