@@ -27,39 +27,84 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def probe_backend() -> bool:
-    """Decide whether this process must fail over to CPU. Returns True
-    when CPU must be forced.
+#: the loopback relay's listen ports (see /root/.relay.py PORTS): a live
+#: relay accepts TCP on these; a dead one refuses instantly. Scanning is
+#: milliseconds, so the retry loop can wait minutes for a flapping relay
+#: without burning its budget on 150 s subprocess probes.
+RELAY_PORTS = (8082, 8083, 8087, 8092, 8093, 8097,
+               8102, 8103, 8107, 8112, 8113, 8117)
 
-    The TPU relay in this environment dies unpredictably; when it is dead,
-    backend init either raises (round 2: rc=1, no JSON ever printed) or
-    hangs in a connect-retry loop. A throwaway subprocess takes that risk
-    for us: if it can't report a healthy non-CPU backend within the
-    timeout, we run on CPU so the bench always produces its one JSON line.
-    NOTE the axon env hook pre-imports jax at interpreter start, so env
-    vars are advisory only here — main() applies the decision with
-    ``jax.config.update``."""
+
+def _relay_listening() -> bool:
+    import socket
+    for port in RELAY_PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return True
+        except OSError:
+            continue
+    return False
+
+
+def _subprocess_backend() -> str:
+    """Init jax in a throwaway subprocess (a dead relay hangs init in a
+    connect-retry loop; the timeout contains the damage)."""
     import subprocess
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        return True
-    backend = ""
     try:
         r = subprocess.run(
             [sys.executable, "-c",
              "import jax; print(jax.default_backend())"],
             timeout=150, capture_output=True, text=True)
-        if r.returncode == 0:
-            backend = r.stdout.strip().splitlines()[-1] if r.stdout else ""
+        if r.returncode == 0 and r.stdout:
+            return r.stdout.strip().splitlines()[-1]
     except Exception:
-        backend = ""
-    if not backend or backend == "cpu":
-        log(f"backend probe failed (got {backend!r}); forcing CPU")
+        pass
+    return ""
+
+
+def probe_backend() -> bool:
+    """Decide whether this process must fail over to CPU. Returns True
+    when CPU must be forced.
+
+    The TPU relay in this environment dies unpredictably and sometimes
+    comes back (VERDICT r3 weak 5: a flaky-but-alive relay must not cost
+    the round's one driver measurement). Strategy: retry over a several-
+    minute budget (BENCH_PROBE_BUDGET_S, default 360 s) — each attempt is
+    a millisecond TCP scan of the relay ports, escalating to the 150 s
+    subprocess init probe only when some port accepts. Only after the
+    whole budget passes with no healthy backend does the bench fall to
+    CPU, and main() then labels the JSON loudly (backend
+    "cpu-fallback-relay-dead") at UNCHANGED 1080p geometry so rounds stay
+    comparable. NOTE the axon env hook pre-imports jax at interpreter
+    start, so env vars are advisory only here — main() applies the
+    decision with ``jax.config.update``."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        os.environ["JAX_PLATFORMS"] = "cpu"
         return True
-    log(f"backend probe ok: {backend}")
-    return False
+    budget = float(os.environ.get("BENCH_PROBE_BUDGET_S", "360"))
+    deadline = time.monotonic() + budget
+    attempt = 0
+    while True:
+        attempt += 1
+        if _relay_listening():
+            backend = _subprocess_backend()
+            if backend and backend != "cpu":
+                log(f"backend probe ok: {backend} (attempt {attempt})")
+                return False
+            log(f"relay ports open but backend init failed "
+                f"(got {backend!r}); retrying")
+        else:
+            log(f"relay ports closed (attempt {attempt})")
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        time.sleep(min(30.0, remaining))
+    log(f"no healthy TPU backend after {budget:.0f}s; forcing CPU "
+        f"(backend will be reported as cpu-fallback-relay-dead)")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["BENCH_CPU_REASON"] = "relay-dead"
+    return True
 
 
 def main(force_cpu: bool = False) -> None:
@@ -80,13 +125,17 @@ def main(force_cpu: bool = False) -> None:
     from selkies_tpu.engine.types import CaptureSettings
 
     backend = jax.default_backend()
-    # full HD is the north-star config on TPU; the CPU fallback exists to
-    # always record *a* number, so keep it inside the driver's timeout
-    dw, dh = ("1920", "1080") if backend != "cpu" else ("768", "448")
-    w = int(os.environ.get("BENCH_WIDTH", dw))
-    h = int(os.environ.get("BENCH_HEIGHT", dh))
+    # full HD always — a CPU fallback at toy geometry looked like a
+    # regression and wasted round 3's driver measurement (VERDICT r3
+    # weak 5); the lat/throughput loops are time-budgeted, so CPU rounds
+    # just record fewer frames at the SAME geometry
+    w = int(os.environ.get("BENCH_WIDTH", "1920"))
+    h = int(os.environ.get("BENCH_HEIGHT", "1080"))
     default_frames = 240 if backend != "cpu" else 12
     n_frames = int(os.environ.get("BENCH_FRAMES", str(default_frames)))
+    backend_label = backend
+    if backend == "cpu" and os.environ.get("BENCH_CPU_REASON"):
+        backend_label = "cpu-fallback-" + os.environ["BENCH_CPU_REASON"]
     quality = int(os.environ.get("BENCH_QUALITY", "60"))
     codec = os.environ.get("BENCH_CODEC", "h264")   # the north-star path
 
@@ -194,7 +243,7 @@ def main(force_cpu: bool = False) -> None:
         "latency_p50_ms": round(p50, 2),
         "latency_p99_ms": round(p99, 2),
         "bitrate_mbps": round(mbps, 1),
-        "backend": backend,
+        "backend": backend_label,
         "frames": n_frames,
     }))
 
@@ -213,6 +262,7 @@ if __name__ == "__main__":
                 f"re-exec on CPU")
             os.environ.pop("PALLAS_AXON_POOL_IPS", None)
             os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ["BENCH_CPU_REASON"] = "relay-died-mid-run"
             os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
         import traceback
         traceback.print_exc(file=sys.stderr)

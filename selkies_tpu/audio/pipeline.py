@@ -114,6 +114,14 @@ class AudioPipeline:
         self._red_history: collections.deque = collections.deque(maxlen=4)
         self._pts = 0
         self._mic_proc: Optional[asyncio.subprocess.Process] = None
+        self._mic_spawning = False
+        #: chunks arriving while pacat is still spawning (bounded: ~1 s
+        #: of 24 kHz mono s16 in 20 ms frames)
+        self._mic_pending: collections.deque = collections.deque(maxlen=50)
+        #: provisioned PA virtual-mic graph (module-null-sink 'input' +
+        #: module-virtual-source SelkiesVirtualMic) so desktop apps can
+        #: RECORD the forwarded mic (reference selkies.py:229-380)
+        self.virtual_mic = None
         self.mic_bytes = 0
         self.frames_encoded = 0
         #: WebRTC raw tap: fn(opus_packet, rtp_ts48k) per encoded frame
@@ -130,6 +138,10 @@ class AudioPipeline:
                 logger.info("no PulseAudio; synthetic tone source")
                 self._source = SyntheticToneSource(
                     self.sample_rate, self.channels, self.frame_samples)
+        if getattr(self.settings, "enable_microphone", False):
+            from .virtual_mic import VirtualMicrophone
+            self.virtual_mic = VirtualMicrophone()
+            await self.virtual_mic.provision()
         self._task = asyncio.create_task(self._run())
 
     async def stop(self) -> None:
@@ -145,6 +157,9 @@ class AudioPipeline:
             await self._source.close()
         if self._mic_proc and self._mic_proc.returncode is None:
             self._mic_proc.kill()
+        if self.virtual_mic is not None:
+            await self.virtual_mic.teardown()
+            self.virtual_mic = None
 
     # ------------------------------------------------------------- listeners
     def add_listener(self, client) -> None:
@@ -247,19 +262,42 @@ class AudioPipeline:
     # -------------------------------------------------------------- mic path
     def play_mic_pcm(self, pcm: bytes) -> None:
         """Client 0x02 mic chunks: 24 kHz mono s16 (reference
-        selkies.py:2476-2502) -> PulseAudio when present."""
+        selkies.py:2476-2502) -> played into the virtual-mic 'input'
+        sink (apps record it via SelkiesVirtualMic) when provisioned,
+        else the default PA sink."""
         self.mic_bytes += len(pcm)
-        if self._mic_proc is None and shutil.which("pacat"):
+        if self._mic_proc is None and not self._mic_spawning \
+                and shutil.which("pacat"):
+            cmd = ["pacat", "--format=s16le", "--rate=24000",
+                   "--channels=1"]
+            if self.virtual_mic is not None and self.virtual_mic.available:
+                cmd += ["-d", self.virtual_mic.sink_name]
+            self._mic_spawning = True
+
             async def _spawn():
-                self._mic_proc = await asyncio.create_subprocess_exec(
-                    "pacat", "--format=s16le", "--rate=24000",
-                    "--channels=1",
-                    stdin=asyncio.subprocess.PIPE,
-                    stderr=asyncio.subprocess.DEVNULL)
-            asyncio.ensure_future(_spawn())
+                try:
+                    self._mic_proc = await asyncio.create_subprocess_exec(
+                        *cmd,
+                        stdin=asyncio.subprocess.PIPE,
+                        stderr=asyncio.subprocess.DEVNULL)
+                    # flush chunks that arrived while spawning — the
+                    # first mic burst must not be dropped
+                    while self._mic_pending:
+                        chunk = self._mic_pending.popleft()
+                        try:
+                            self._mic_proc.stdin.write(chunk)
+                        except (ConnectionError, RuntimeError):
+                            break      # daemon down: pacat died instantly
+                except OSError:
+                    pass
+                finally:
+                    self._mic_spawning = False
+            self._mic_spawn_task = asyncio.ensure_future(_spawn())
         if self._mic_proc and self._mic_proc.returncode is None \
                 and self._mic_proc.stdin:
             try:
                 self._mic_proc.stdin.write(pcm)
             except (ConnectionError, RuntimeError):
                 pass
+        elif self._mic_spawning:
+            self._mic_pending.append(pcm)

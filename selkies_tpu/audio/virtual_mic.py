@@ -1,0 +1,120 @@
+"""PulseAudio/PipeWire virtual-microphone provisioning (control plane).
+
+Desktop apps can only record the client's forwarded microphone if a
+recordable PA *source* exists that carries it. The arrangement (same
+topology as the reference's ``provision_virtual_microphone``,
+selkies.py:229-380, rebuilt on subprocess ``pactl`` — in-process PA
+bindings segfault under churn, and this framework already shells out for
+``parec``/``pacat``):
+
+- a ``module-null-sink`` named ``input``: the mic data plane plays
+  client 0x02 PCM into it (``pacat -d input``);
+- a ``module-virtual-source`` named ``SelkiesVirtualMic`` with
+  ``master=input.monitor``: turns that sink's monitor into a recordable
+  source (PipeWire may expose it as ``output.SelkiesVirtualMic``);
+- the system default source is pointed at the virtual mic so "just
+  record" apps pick it up.
+
+Idempotent: existing objects are reused; only modules THIS process
+loaded are unloaded on teardown (two transports sharing one daemon must
+never unload each other's modules).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import shutil
+from typing import Optional
+
+logger = logging.getLogger("selkies_tpu.audio.virtual_mic")
+
+SINK_NAME = "input"
+SOURCE_NAME = "SelkiesVirtualMic"
+#: PipeWire prepends "output." to virtual sources
+SOURCE_ALIASES = (SOURCE_NAME, f"output.{SOURCE_NAME}")
+
+
+async def _pactl(*args: str) -> tuple[int, str]:
+    proc = await asyncio.create_subprocess_exec(
+        "pactl", *args,
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.DEVNULL)
+    out, _ = await proc.communicate()
+    return proc.returncode or 0, out.decode(errors="replace")
+
+
+async def _short_names(kind: str) -> list[str]:
+    rc, out = await _pactl("list", "short", kind)
+    if rc != 0:
+        return []
+    return [line.split("\t")[1] for line in out.splitlines()
+            if "\t" in line]
+
+
+class VirtualMicrophone:
+    """Provision/teardown of the virtual-mic graph. ``sink_name`` is
+    where the data plane should play mic PCM (``pacat -d``)."""
+
+    def __init__(self) -> None:
+        self.sink_name = SINK_NAME
+        self.source_name: Optional[str] = None
+        self._owned_modules: list[str] = []
+        self.available = False
+
+    async def provision(self) -> bool:
+        if not shutil.which("pactl"):
+            logger.info("no pactl; virtual microphone unavailable")
+            return False
+        try:
+            return await self._provision_inner()
+        except (OSError, asyncio.TimeoutError) as e:
+            logger.warning("virtual mic provisioning failed: %s", e)
+            return False
+
+    async def _provision_inner(self) -> bool:
+        sinks = await _short_names("sinks")
+        if self.sink_name not in sinks:
+            rc, out = await _pactl("load-module", "module-null-sink",
+                                   f"sink_name={self.sink_name}")
+            if rc == 0:
+                self._owned_modules.append(out.strip())
+            if self.sink_name not in await _short_names("sinks"):
+                logger.warning("null sink %r failed to appear",
+                               self.sink_name)
+                return False
+
+        sources = await _short_names("sources")
+        existing = next((s for s in sources if s in SOURCE_ALIASES), None)
+        if existing is None:
+            rc, out = await _pactl(
+                "load-module", "module-virtual-source",
+                f"source_name={SOURCE_NAME}",
+                f"master={self.sink_name}.monitor")
+            if rc != 0:
+                logger.warning("module-virtual-source load failed")
+                return False
+            module = out.strip()
+            sources = await _short_names("sources")
+            existing = next((s for s in sources if s in SOURCE_ALIASES),
+                            None)
+            if existing is None:
+                logger.warning("virtual source did not appear; unloading")
+                await _pactl("unload-module", module)
+                return False
+            self._owned_modules.append(module)
+        self.source_name = existing
+        # best-effort: apps that record "the default source" hear the mic
+        await _pactl("set-default-source", existing)
+        self.available = True
+        logger.info("virtual microphone ready (source %s, sink %s)",
+                    existing, self.sink_name)
+        return True
+
+    async def teardown(self) -> None:
+        for module in reversed(self._owned_modules):
+            try:
+                await _pactl("unload-module", module)
+            except OSError:
+                pass
+        self._owned_modules.clear()
+        self.available = False

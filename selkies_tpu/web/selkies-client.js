@@ -150,6 +150,13 @@ class SelkiesClient {
     if (this.ws && this.ws.readyState === WebSocket.OPEN) this.ws.send(text);
   }
 
+  sendBytes(u8) {
+    /* binary frames (0x02 mic) ride the WS transport only; the SCTP
+     * data channel carries the text verb grammar */
+    if (!this.rtcMode && this.ws && this.ws.readyState === WebSocket.OPEN)
+      this.ws.send(u8);
+  }
+
   /* --------------------------------------------------------- RTC transport
    * Signaling protocol (server signaling.py): HELLO client {meta} ->
    * "SESSION server" -> SESSION_OK <uid> -> the server peer sends
@@ -970,6 +977,8 @@ class SelkiesClient {
         if (d.video === true) this.send("START_VIDEO");
         if (d.audio === false) this.send("STOP_AUDIO");
         if (d.audio === true) this.send("START_AUDIO");
+        if (d.microphone === true) this.startMic();
+        if (d.microphone === false) this.stopMic();
         if (d.keyframe) this.send("REQUEST_KEYFRAME");
         break;
       case "getStats":
@@ -990,6 +999,42 @@ class SelkiesClient {
         break;
       default: break;
     }
+  }
+
+  /* ------------------------------------------------------------ microphone
+   * getUserMedia -> AudioWorklet -> s16 24 kHz mono 0x02 frames (the
+   * server plays them into the SelkiesVirtualMic graph so desktop apps
+   * can record — reference selkies-ws-core.js:5685 / selkies.py:229). */
+  async startMic() {
+    if (this.mic) return;
+    if (this.rtcMode) {
+      /* 0x02 frames ride the WS transport only (sendBytes no-ops on
+       * RTC) — claiming success here would light the mic for nothing */
+      this.status("microphone needs the WebSockets transport", true);
+      return;
+    }
+    const feats = this.serverSettings && this.serverSettings.features;
+    if (!feats || !feats.microphone) {
+      this.status("microphone disabled by server", true);
+      return;
+    }
+    const mic = new MicSender(this);
+    try {
+      await mic.start();
+      this.mic = mic;
+      this.status("microphone forwarding on");
+      this._postToDashboard({ type: "microphone", active: true });
+    } catch (e) {
+      mic.stop();     // release any tracks/context acquired before the throw
+      this.status(`microphone unavailable: ${e.message || e}`, true);
+    }
+  }
+
+  stopMic() {
+    if (!this.mic) return;
+    this.mic.stop();
+    this.mic = null;
+    this._postToDashboard({ type: "microphone", active: false });
   }
 
   /* ----------------------------------------------------------------- hud */
@@ -1079,6 +1124,77 @@ class AudioPlayer {
   close() {
     if (this.dec) try { this.dec.close(); } catch { /* already closed */ }
     this.ctx.close();
+  }
+}
+
+/* ------------------------------------------------------------------- mic
+ * Capture path: the AudioContext resamples the getUserMedia track to
+ * 24 kHz; an AudioWorklet batches ~20 ms (480-sample) mono chunks that
+ * are sent as [0x02][s16le PCM] frames — the exact format
+ * audio/pipeline.play_mic_pcm feeds pacat. */
+class MicSender {
+  constructor(client) {
+    this.client = client;
+    this.ctx = null;
+    this.node = null;
+    this.stream = null;
+  }
+
+  async start() {
+    this.stream = await navigator.mediaDevices.getUserMedia({
+      audio: { channelCount: 1, echoCancellation: true,
+               noiseSuppression: true },
+    });
+    this.ctx = new AudioContext({ sampleRate: 24000 });
+    const src = `registerProcessor("selkies-mic",
+      class extends AudioWorkletProcessor {
+        process(inputs) {
+          const ch = inputs[0] && inputs[0][0];
+          if (ch && ch.length) this.port.postMessage(ch.slice(0));
+          return true;
+        }
+      });`;
+    const url = URL.createObjectURL(
+      new Blob([src], { type: "application/javascript" }));
+    try {
+      await this.ctx.audioWorklet.addModule(url);
+    } finally {
+      URL.revokeObjectURL(url);
+    }
+    const input = this.ctx.createMediaStreamSource(this.stream);
+    this.node = new AudioWorkletNode(this.ctx, "selkies-mic");
+    this._chunks = [];
+    this._n = 0;
+    this.node.port.onmessage = (e) => this._onChunk(e.data);
+    input.connect(this.node);
+    /* no destination connection: capture-only graph */
+  }
+
+  _onChunk(f32) {
+    this._chunks.push(f32);
+    this._n += f32.length;
+    if (this._n < 480) return;                    // ~20 ms at 24 kHz
+    const all = new Float32Array(this._n);
+    let o = 0;
+    for (const c of this._chunks) { all.set(c, o); o += c.length; }
+    this._chunks = [];
+    this._n = 0;
+    const frame = new Uint8Array(1 + all.length * 2);
+    frame[0] = OP_MIC;
+    const dv = new DataView(frame.buffer);
+    for (let i = 0; i < all.length; i++) {
+      const s = Math.max(-1, Math.min(1, all[i]));
+      dv.setInt16(1 + i * 2, s < 0 ? s * 0x8000 : s * 0x7FFF, true);
+    }
+    this.client.sendBytes(frame);
+  }
+
+  stop() {
+    if (this.node) { try { this.node.disconnect(); } catch { /* gone */ } }
+    if (this.ctx) this.ctx.close();
+    if (this.stream)
+      for (const t of this.stream.getTracks()) t.stop();
+    this.node = this.ctx = this.stream = null;
   }
 }
 

@@ -145,3 +145,140 @@ def test_update_bitrate_changes_packet_size():
     for _ in range(8):
         small = len(enc.encode(pcm))
     assert small < big
+
+
+def test_virtual_mic_provisioning_pactl_sequence(tmp_path, monkeypatch):
+    """VirtualMicrophone drives pactl correctly: creates the 'input'
+    null sink + SelkiesVirtualMic virtual source, sets the default
+    source, and tears down ONLY the modules it loaded (reference
+    provision_virtual_microphone semantics, selkies.py:229-380).
+    Validated against a scripted fake pactl on PATH."""
+    import os
+    import stat
+
+    log = tmp_path / "calls.log"
+    state = tmp_path / "state"
+    state.mkdir()
+    fake = tmp_path / "pactl"
+    fake.write_text(f"""#!/bin/bash
+echo "$@" >> {log}
+case "$1 $2 $3" in
+  "list short sinks")
+    [ -f {state}/sink ] && printf '1\\tinput\\tmodule-null-sink\\n'
+    printf '0\\tdefault\\tmodule-alsa\\n' ;;
+  "list short sources")
+    [ -f {state}/src ] && printf '2\\tSelkiesVirtualMic\\tmodule-virtual-source\\n'
+    printf '0\\tdefault.monitor\\tmodule-alsa\\n' ;;
+  "load-module module-null-sink"*) touch {state}/sink; echo 41 ;;
+  "load-module module-virtual-source"*) touch {state}/src; echo 42 ;;
+  "unload-module 41") rm -f {state}/sink ;;
+  "unload-module 42") rm -f {state}/src ;;
+esac
+exit 0
+""")
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+
+    from selkies_tpu.audio.virtual_mic import VirtualMicrophone
+
+    async def run():
+        vm = VirtualMicrophone()
+        assert await vm.provision() is True
+        assert vm.available and vm.source_name == "SelkiesVirtualMic"
+        assert vm.sink_name == "input"
+        # idempotency: a second instance REUSES, owns nothing new
+        vm2 = VirtualMicrophone()
+        assert await vm2.provision() is True
+        assert vm2._owned_modules == []
+        await vm2.teardown()                 # must not unload vm's modules
+        calls = log.read_text()
+        assert "unload-module" not in calls
+        await vm.teardown()
+        calls = log.read_text().splitlines()
+        assert "unload-module 42" in calls and "unload-module 41" in calls
+    asyncio.run(run())
+
+
+def test_mic_pcm_routed_into_virtual_sink(tmp_path, monkeypatch):
+    """play_mic_pcm must target the provisioned 'input' sink (-d) so the
+    virtual source actually carries the client mic."""
+    import os
+    import stat
+
+    log = tmp_path / "pacat.log"
+    fake = tmp_path / "pacat"
+    fake.write_text(f"#!/bin/bash\necho \"$@\" > {log}\ncat > /dev/null\n")
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+
+    s = AppSettings.parse([], {})
+    s.set_server("enable_microphone", True)
+
+    async def run():
+        p = AudioPipeline(s, source=SyntheticToneSource(48000, 2, 480))
+        from selkies_tpu.audio.virtual_mic import VirtualMicrophone
+        p.virtual_mic = VirtualMicrophone()
+        p.virtual_mic.available = True       # as if provisioned
+        p.play_mic_pcm(b"\x00\x01" * 240)
+        for _ in range(50):
+            if log.exists():
+                break
+            await asyncio.sleep(0.05)
+        assert log.exists()
+        args = log.read_text()
+        assert "-d input" in args and "--rate=24000" in args
+        if p._mic_proc:
+            p._mic_proc.kill()
+    asyncio.run(run())
+
+
+def _pa_daemon_alive() -> bool:
+    import shutil as _sh
+    import subprocess as _sp
+    if not _sh.which("pactl"):
+        return False
+    try:
+        return _sp.run(["pactl", "info"], capture_output=True,
+                       timeout=5).returncode == 0
+    except Exception:
+        return False
+
+
+@pytest.mark.x11
+def test_virtual_mic_records_injected_tone():
+    """End-to-end in the example container (live PulseAudio): client 0x02
+    PCM played through the provisioned graph must be RECORDABLE from the
+    SelkiesVirtualMic source — the property desktop apps depend on."""
+    if not _pa_daemon_alive():
+        pytest.skip("no live PulseAudio daemon")
+    import subprocess
+
+    from selkies_tpu.audio.virtual_mic import VirtualMicrophone
+
+    async def run():
+        vm = VirtualMicrophone()
+        assert await vm.provision() is True
+        try:
+            # 1 s of 440 Hz at 24 kHz mono s16 through the data plane
+            t = np.arange(24000) / 24000.0
+            tone = (np.sin(2 * np.pi * 440.0 * t) * 12000).astype(np.int16)
+            pacat = await asyncio.create_subprocess_exec(
+                "pacat", "--format=s16le", "--rate=24000", "--channels=1",
+                "-d", vm.sink_name, stdin=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.DEVNULL)
+            rec = await asyncio.create_subprocess_exec(
+                "parec", "--format=s16le", "--rate=24000", "--channels=1",
+                "-d", vm.source_name, stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.DEVNULL)
+            pacat.stdin.write(tone.tobytes())
+            await pacat.stdin.drain()
+            data = await asyncio.wait_for(
+                rec.stdout.readexactly(24000), timeout=10)
+            pacat.kill()
+            rec.kill()
+            got = np.frombuffer(data, np.int16).astype(np.float64)
+            rms = np.sqrt((got ** 2).mean())
+            assert rms > 500, f"virtual mic silent (rms {rms:.0f})"
+        finally:
+            await vm.teardown()
+    asyncio.run(run())
