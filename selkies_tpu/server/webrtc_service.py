@@ -195,7 +195,8 @@ class WebRTCService(BaseStreamingService):
         peer = RTCPeer(host=host, on_request_keyframe=self._request_idr,
                        with_audio=with_audio, fullcolor=fullcolor,
                        on_datachannel_message=self._on_input_verb,
-                       on_bitrate_estimate=self._on_remb)
+                       on_bitrate_estimate=self._on_remb,
+                       turn_config=self._turn_config())
         if with_audio and self.audio.on_raw_frame is None:
             self.audio.on_raw_frame = self._on_audio_frame
         await peer.listen()
@@ -220,8 +221,12 @@ class WebRTCService(BaseStreamingService):
         if isinstance(sdp, dict) and sdp.get("type") == "answer":
             sess.peer.set_remote_answer(sdp.get("sdp", ""))
             logger.info("webrtc session %s: answer applied", caller_uid)
-        # 'ice' messages need no action: ICE-lite answers the browser's
-        # connectivity checks directly on the advertised host candidate
+        # trickled ICE candidates: the direct path needs no action
+        # (ICE-lite answers checks on the host candidate), but the TURN
+        # relay only forwards peers we hold permissions for
+        ice = msg.get("ice")
+        if isinstance(ice, dict):
+            sess.peer.add_remote_candidate(str(ice.get("candidate", "")))
 
     def _end_session(self, caller_uid: str) -> None:
         sess = self._sessions.pop(caller_uid, None)
@@ -230,6 +235,27 @@ class WebRTCService(BaseStreamingService):
             logger.info("webrtc session %s closed", caller_uid)
         if not self._sessions:
             self._stop_capture()
+
+    def _turn_config(self) -> dict | None:
+        """Server-side TURN relay credentials from settings: static
+        user/pass or the coturn shared-secret (REST API) scheme
+        (server/turn.py, reference webrtc_utils.py:113-158). None when
+        no TURN host is configured — direct host candidate only."""
+        s = self.settings
+        host = str(getattr(s, "turn_host", "") or "")
+        if not host:
+            return None
+        port = int(getattr(s, "turn_port", 3478) or 3478)
+        secret = str(getattr(s, "turn_shared_secret", "") or "")
+        user = str(getattr(s, "turn_username", "") or "selkies")
+        password = str(getattr(s, "turn_password", "") or "")
+        if secret:
+            from .turn import hmac_turn_credential
+            user, password = hmac_turn_credential(secret, user)
+        elif not password:
+            return None
+        return {"host": host, "port": port,
+                "username": user, "password": password}
 
     # ----------------------------------------------------------------- media
     async def _ensure_capture(self) -> None:

@@ -37,7 +37,8 @@ class RTCPeer(asyncio.DatagramProtocol):
                  on_request_keyframe: Optional[Callable] = None,
                  with_audio: bool = True, fullcolor: bool = False,
                  on_datachannel_message: Optional[Callable] = None,
-                 on_bitrate_estimate: Optional[Callable] = None):
+                 on_bitrate_estimate: Optional[Callable] = None,
+                 turn_config: Optional[dict] = None):
         self.host = host
         self.port = port
         self.ufrag, self.pwd = make_ice_credentials()
@@ -63,6 +64,17 @@ class RTCPeer(asyncio.DatagramProtocol):
         self._t0 = time.monotonic()
         self._last_sr = 0.0
         self._closed = False
+        #: TURN relay (webrtc/turn.py): allocated on listen() when
+        #: configured. Replies always ride the path a datagram ARRIVED
+        #: on (forcing relay replies to direct-path checks would break
+        #: the direct candidate pair for NAT'd browsers whose mapped
+        #: address shows up on both paths); media follows the path of
+        #: the nominating check.
+        self.turn_config = turn_config
+        self.turn = None
+        self.relay_addr: tuple[str, int] | None = None
+        self._peer_via_turn = False
+        self._turn_bound: set = set()
 
     # -- socket -------------------------------------------------------------
     async def listen(self) -> int:
@@ -70,7 +82,48 @@ class RTCPeer(asyncio.DatagramProtocol):
         self._transport, _ = await loop.create_datagram_endpoint(
             lambda: self, local_addr=(self.host, self.port))
         self.port = self._transport.get_extra_info("sockname")[1]
+        if self.turn_config:
+            await self._allocate_relay()
         return self.port
+
+    async def _allocate_relay(self) -> None:
+        """Best-effort TURN allocation — a dead relay must never block
+        the direct host-candidate path."""
+        from .turn import TurnClient, TurnError
+        cfg = self.turn_config
+        try:
+            self.turn = TurnClient(
+                (cfg["host"], int(cfg.get("port", 3478))),
+                cfg.get("username", ""), cfg.get("password", ""),
+                on_data=self._on_turn_data)
+            await self.turn.connect()
+            self.relay_addr = await asyncio.wait_for(
+                self.turn.allocate(), 10.0)
+        except (TurnError, OSError, asyncio.TimeoutError, KeyError) as e:
+            logger.warning("turn allocation failed (%s); direct path only",
+                           e)
+            if self.turn is not None:
+                self.turn.close()
+                self.turn = None
+            self.relay_addr = None
+
+    def _on_turn_data(self, data: bytes, peer) -> None:
+        """Datagram a peer sent to our relayed address: same demux;
+        replies ride the relay because that is the arrival path."""
+        try:
+            self._demux(data, peer, via_turn=True)
+        except Exception:
+            logger.exception("turn-relayed datagram error")
+
+    def _sendto(self, data: bytes, addr, via_turn: bool = False) -> None:
+        if via_turn and self.turn is not None:
+            self.turn.send_to_peer(data, addr)
+        elif self._transport is not None:
+            self._transport.sendto(data, addr)
+
+    def _send_peer(self, data: bytes) -> None:
+        """Send to the nominated peer on its selected path."""
+        self._sendto(data, self._peer_addr, via_turn=self._peer_via_turn)
 
     def connection_made(self, transport):
         self._transport = transport
@@ -82,34 +135,56 @@ class RTCPeer(asyncio.DatagramProtocol):
             logger.exception("peer datagram error")
 
     # -- demux (RFC 7983) ---------------------------------------------------
-    def _demux(self, data: bytes, addr) -> None:
+    def _demux(self, data: bytes, addr, via_turn: bool = False) -> None:
         if not data:
             return
         b = data[0]
         if is_stun(data):
             resp = self.ice.handle(data, addr)
-            if resp and self._transport:
-                self._transport.sendto(resp, addr)
+            if resp:
+                self._sendto(resp, addr, via_turn=via_turn)
             if self.ice.nominated_addr:
+                # media follows the path the nominating check arrived on
+                if self.ice.nominated_addr == addr:
+                    self._peer_via_turn = via_turn
                 self._peer_addr = self.ice.nominated_addr
+                if (self._peer_via_turn and self.turn is not None
+                        and self._peer_addr not in self._turn_bound):
+                    # nominated via the relay: bind a channel (4-byte
+                    # framing instead of 36-byte Send indications)
+                    self._turn_bound.add(self._peer_addr)
+                    asyncio.ensure_future(
+                        self._bind_channel(self._peer_addr))
         elif 20 <= b <= 63:                       # DTLS
             self._peer_addr = addr
+            self._peer_via_turn = via_turn
             records = self.dtls.feed(data)
-            self._flush_dtls(addr)
+            self._flush_dtls(addr, via_turn)
             if self.dtls.handshake_complete and self.srtp is None:
                 self._on_dtls_complete()
             if self.sctp is not None:
                 for rec in records:               # app data = SCTP packets
                     self.sctp.receive(rec)
                 self.sctp.poll_timers()
-                self._flush_dtls(addr)
+                self._flush_dtls(addr, via_turn)
         elif 128 <= b <= 191 and self.srtp is not None:
             self._on_srtp(data)
 
-    def _flush_dtls(self, addr) -> None:
+    async def _bind_channel(self, peer) -> None:
+        from .turn import TurnError
+        turn = self.turn
+        if turn is None:                   # torn down before we ran
+            return
+        try:
+            await turn.channel_bind(peer)
+        except (TurnError, OSError) as e:
+            logger.warning("turn channel bind failed: %s", e)
+            self._turn_bound.discard(peer)
+
+    def _flush_dtls(self, addr, via_turn: bool = False) -> None:
         out = self.dtls.take_outgoing()
-        if out and self._transport:
-            self._transport.sendto(out, addr)
+        if out:
+            self._sendto(out, addr, via_turn=via_turn)
 
     def _on_dtls_complete(self) -> None:
         if self.remote and self.remote.fingerprint:
@@ -136,8 +211,8 @@ class RTCPeer(asyncio.DatagramProtocol):
         except Exception:
             return
         out = self.dtls.take_outgoing()
-        if out and self._transport and self._peer_addr:
-            self._transport.sendto(out, self._peer_addr)
+        if out and self._peer_addr:
+            self._send_peer(out)
 
     def _on_channel_message(self, channel, data: bytes, ppid: int) -> None:
         if self.on_datachannel_message is not None:
@@ -188,11 +263,41 @@ class RTCPeer(asyncio.DatagramProtocol):
                            fingerprint, video_pt=self.video.payload_type,
                            audio_pt=self.audio.payload_type,
                            with_audio=self.with_audio,
-                           fullcolor=self.fullcolor)
+                           fullcolor=self.fullcolor,
+                           relay=self.relay_addr)
 
     def set_remote_answer(self, sdp: str) -> None:
         self.remote = parse_answer(sdp)
         self.ice.set_remote(self.remote.ice_ufrag, self.remote.ice_pwd)
+        # relay path: the TURN server only forwards peers we hold
+        # permissions for — install one per remote candidate IP
+        for cand in self.remote.candidates:
+            self.add_remote_candidate(cand)
+
+    def add_remote_candidate(self, candidate: str) -> None:
+        """Install a TURN permission for a remote candidate (answer SDP
+        or trickled) so its checks can reach our relayed address. Only
+        literal IPv4 connection addresses are usable — mDNS ``.local``
+        hostnames (Chrome's default host candidates) and IPv6 have no
+        relay permission to install."""
+        if self.turn is None:
+            return
+        parts = candidate.split()
+        ip = parts[4] if len(parts) >= 5 else ""
+        try:
+            import socket
+            socket.inet_aton(ip)
+        except (OSError, UnicodeEncodeError):
+            return
+        turn = self.turn
+
+        async def _perm():
+            from .turn import TurnError
+            try:
+                await turn.create_permission(ip)
+            except (TurnError, OSError) as e:
+                logger.warning("turn permission for %s failed: %s", ip, e)
+        asyncio.ensure_future(_perm())
 
     # -- media --------------------------------------------------------------
     @property
@@ -215,15 +320,14 @@ class RTCPeer(asyncio.DatagramProtocol):
         now_us = int(time.monotonic() * 1e6)
         for p in pkts:
             wire = self.srtp.protect_rtp(p.to_bytes())
-            self._transport.sendto(wire, self._peer_addr)
+            self._send_peer(wire)
             if p.twcc_seq is not None:
                 self.cc.on_packet_sent(p.twcc_seq, len(wire), now_us)
         now = time.monotonic()
         if now - self._last_sr > 1.0:
             self._last_sr = now
-            self._transport.sendto(
-                self.srtp.protect_rtcp(self.video.sender_report(ts)),
-                self._peer_addr)
+            self._send_peer(
+                self.srtp.protect_rtcp(self.video.sender_report(ts)))
         return len(pkts)
 
     def send_audio_frame(self, opus: bytes, timestamp: int) -> int:
@@ -231,7 +335,7 @@ class RTCPeer(asyncio.DatagramProtocol):
             return 0
         p = self.audio.packetize(opus, timestamp)
         wire = self.srtp.protect_rtp(p.to_bytes())
-        self._transport.sendto(wire, self._peer_addr)
+        self._send_peer(wire)
         if p.twcc_seq is not None:
             self.cc.on_packet_sent(p.twcc_seq, len(wire),
                                    int(time.monotonic() * 1e6))
@@ -239,6 +343,9 @@ class RTCPeer(asyncio.DatagramProtocol):
 
     def close(self) -> None:
         self._closed = True
+        if self.turn is not None:
+            self.turn.close()
+            self.turn = None
         if self._transport:
             self._transport.close()
             self._transport = None

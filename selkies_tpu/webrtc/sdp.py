@@ -24,9 +24,12 @@ class RemoteDescription:
 def build_offer(host: str, port: int, ufrag: str, pwd: str,
                 fingerprint: str, video_pt: int = 102,
                 audio_pt: int = 111, with_audio: bool = True,
-                fullcolor: bool = False, with_data: bool = True) -> str:
+                fullcolor: bool = False, with_data: bool = True,
+                relay: "tuple[str, int] | None" = None) -> str:
     """One-shot SDP offer: sendonly video (+audio) + a data channel
-    m-line for input, ICE-lite, DTLS actpass, all bundled on one port."""
+    m-line for input, ICE-lite, DTLS actpass, all bundled on one port.
+    ``relay`` adds a TURN ``typ relay`` candidate (webrtc/turn.py
+    allocation) after the host candidate for NAT'd servers."""
     sid = secrets.randbits(62)
     mids = ["0"] + (["1"] if with_audio else [])
     if with_data:
@@ -40,6 +43,13 @@ def build_offer(host: str, port: int, ufrag: str, pwd: str,
         f"a=group:BUNDLE {' '.join(mids)}",
         "a=msid-semantic: WMS selkies",
     ]
+    cand_lines = [
+        f"a=candidate:1 1 udp 2130706431 {host} {port} typ host"]
+    if relay is not None:
+        cand_lines.append(
+            f"a=candidate:2 1 udp 16777215 {relay[0]} {relay[1]} "
+            f"typ relay raddr {host} rport {port}")
+    cand_lines.append("a=end-of-candidates")
     # profile f4001f enables Hi444PP for 4:4:4 streams (the reference's
     # fullcolor munge, rtc.py:649-717); 42e01f is constrained baseline
     profile = "f4001f" if fullcolor else "42e01f"
@@ -79,9 +89,7 @@ def build_offer(host: str, port: int, ufrag: str, pwd: str,
             f"a=msid:selkies selkies-{'video' if i == 0 else 'audio'}",
         ]
         lines += extra
-        lines.append(
-            f"a=candidate:1 1 udp 2130706431 {host} {port} typ host")
-        lines.append("a=end-of-candidates")
+        lines += cand_lines
     if with_data:
         lines += [
             f"m=application {port} UDP/DTLS/SCTP webrtc-datachannel",
@@ -93,9 +101,8 @@ def build_offer(host: str, port: int, ufrag: str, pwd: str,
             "a=setup:actpass",
             "a=sctp-port:5000",
             "a=max-message-size:262144",
-            f"a=candidate:1 1 udp 2130706431 {host} {port} typ host",
-            "a=end-of-candidates",
         ]
+        lines += cand_lines
     return "\r\n".join(lines) + "\r\n"
 
 

@@ -1,0 +1,304 @@
+"""TURN client (RFC 5766/8656 subset, UDP): relay allocation for the
+media plane.
+
+The server is ICE-lite with one host candidate; when the browser cannot
+reach it directly (server behind NAT / firewalled), the reference relays
+via its vendored TURN client (reference src/selkies/ice/turn.py,
+consumed at webrtc_mode.py:256-296). This is the TPU framework's
+equivalent: allocate a relayed transport address on the in-tree coturn
+(addons/coturn, addons/turn-rest), advertise it as an additional
+``typ relay`` candidate, and shuttle datagrams through ChannelData
+framing (Send/Data indications until the channel binds).
+
+Scope: UDP transport, long-term credentials (401 realm/nonce dance, key
+= MD5(user:realm:pass)), Allocate / Refresh / CreatePermission /
+ChannelBind / Send+Data indications, ChannelData. TCP/TLS transports
+are out of scope (the direct path plus UDP relay covers the product's
+NAT matrix; coturn terminates TLS in front of the same allocation API).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import struct
+import time
+from typing import Callable, Optional
+
+from .stun import MAGIC_COOKIE, StunError, StunMessage
+
+logger = logging.getLogger("selkies_tpu.webrtc.turn")
+
+# methods (request class; success response = | 0x0100, error = | 0x0110)
+M_ALLOCATE = 0x0003
+M_REFRESH = 0x0004
+M_SEND_IND = 0x0016
+M_DATA_IND = 0x0017
+M_CREATE_PERMISSION = 0x0008
+M_CHANNEL_BIND = 0x0009
+
+ATTR_CHANNEL_NUMBER = 0x000C
+ATTR_LIFETIME = 0x000D
+ATTR_XOR_PEER_ADDRESS = 0x0012
+ATTR_DATA = 0x0013
+ATTR_REALM = 0x0014
+ATTR_NONCE = 0x0015
+ATTR_XOR_RELAYED_ADDRESS = 0x0016
+ATTR_REQUESTED_TRANSPORT = 0x0019
+ATTR_USERNAME = 0x0006
+ATTR_ERROR_CODE = 0x0009
+
+UDP_TRANSPORT = 17
+
+
+def xor_address(host: str, port: int) -> bytes:
+    xport = port ^ (MAGIC_COOKIE >> 16)
+    ip = bytes(int(p) for p in host.split("."))
+    xip = bytes(b ^ m for b, m in zip(ip, struct.pack("!I", MAGIC_COOKIE)))
+    return struct.pack("!BBH", 0, 0x01, xport) + xip
+
+
+def unxor_address(v: bytes) -> Optional[tuple[str, int]]:
+    if len(v) < 8 or v[1] != 0x01:
+        return None
+    port = struct.unpack_from("!H", v, 2)[0] ^ (MAGIC_COOKIE >> 16)
+    ip = bytes(b ^ m for b, m in
+               zip(v[4:8], struct.pack("!I", MAGIC_COOKIE)))
+    return ".".join(str(b) for b in ip), port
+
+
+def is_channel_data(datagram: bytes) -> bool:
+    return len(datagram) >= 4 and 0x40 <= datagram[0] <= 0x7F
+
+
+def _error_code(msg: StunMessage) -> int:
+    v = msg.attr(ATTR_ERROR_CODE)
+    if v is None or len(v) < 4:
+        return 0
+    return (v[2] & 0x7) * 100 + v[3]
+
+
+class TurnError(Exception):
+    pass
+
+
+class TurnClient(asyncio.DatagramProtocol):
+    """One UDP socket to one TURN server; one allocation.
+
+    ``on_data(data, peer_addr)`` fires for every datagram a remote peer
+    sent to the relayed address (via Data indication or ChannelData).
+    """
+
+    def __init__(self, server: tuple[str, int], username: str,
+                 password: str,
+                 on_data: Optional[Callable] = None):
+        self.server = server
+        self.username = username
+        self.password = password
+        self.on_data = on_data
+        self.realm = ""
+        self.nonce = b""
+        self.relayed_addr: Optional[tuple[str, int]] = None
+        self.lifetime = 600
+        self._transport: Optional[asyncio.DatagramTransport] = None
+        self._pending: dict[bytes, asyncio.Future] = {}
+        self._channels: dict[tuple[str, int], int] = {}
+        self._channel_rev: dict[int, tuple[str, int]] = {}
+        self._next_channel = 0x4000
+        self._permissions: set[str] = set()
+        self._maint_task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    # -- socket -------------------------------------------------------------
+    async def connect(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, remote_addr=self.server)
+
+    def connection_made(self, transport):
+        self._transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        try:
+            self._on_datagram(data)
+        except Exception:
+            logger.exception("turn datagram error")
+
+    def _on_datagram(self, data: bytes) -> None:
+        if is_channel_data(data):
+            ch, length = struct.unpack_from("!HH", data, 0)
+            peer = self._channel_rev.get(ch)
+            if peer is not None and self.on_data is not None:
+                self.on_data(data[4:4 + length], peer)
+            return
+        try:
+            msg = StunMessage.parse(data)
+        except StunError:
+            return
+        if msg.type == M_DATA_IND:
+            peer = unxor_address(msg.attr(ATTR_XOR_PEER_ADDRESS) or b"")
+            payload = msg.attr(ATTR_DATA)
+            if peer and payload is not None and self.on_data is not None:
+                self.on_data(payload, peer)
+            return
+        fut = self._pending.pop(msg.txid, None)
+        if fut is not None and not fut.done():
+            fut.set_result(msg)
+
+    # -- auth ---------------------------------------------------------------
+    def _lt_key(self) -> bytes:
+        return hashlib.md5(
+            f"{self.username}:{self.realm}:{self.password}"
+            .encode()).digest()
+
+    def _auth_attrs(self, msg: StunMessage) -> StunMessage:
+        msg.add(ATTR_USERNAME, self.username.encode())
+        msg.add(ATTR_REALM, self.realm.encode())
+        msg.add(ATTR_NONCE, self.nonce)
+        return msg
+
+    async def _request(self, msg: StunMessage, authed: bool,
+                       timeout: float = 5.0) -> StunMessage:
+        if self._transport is None:
+            raise TurnError("not connected")
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg.txid] = fut
+        key = self._lt_key() if authed else None
+        self._transport.sendto(msg.to_bytes(integrity_key=key))
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(msg.txid, None)
+            raise TurnError("turn request timed out")
+
+    async def _authed_request(self, method: int,
+                              attrs: list[tuple[int, bytes]]
+                              ) -> StunMessage:
+        """Request with the long-term-credential retry dance: 401 to
+        learn realm/nonce, 438 to refresh a stale nonce."""
+        for _ in range(3):
+            msg = StunMessage(method)
+            for a, v in attrs:
+                msg.add(a, v)
+            if self.realm:
+                resp = await self._request(self._auth_attrs(msg),
+                                           authed=True)
+            else:
+                resp = await self._request(msg, authed=False)
+            if resp.type == method | 0x0100:
+                return resp
+            code = _error_code(resp)
+            if code in (401, 438):
+                realm = resp.attr(ATTR_REALM)
+                nonce = resp.attr(ATTR_NONCE)
+                if realm is None or nonce is None:
+                    raise TurnError(f"turn {code} without realm/nonce")
+                self.realm = realm.decode()
+                self.nonce = nonce
+                continue
+            raise TurnError(f"turn error {code} on method {method:#x}")
+        raise TurnError("turn auth retries exhausted")
+
+    # -- allocation lifecycle ----------------------------------------------
+    async def allocate(self, lifetime: int = 600) -> tuple[str, int]:
+        resp = await self._authed_request(M_ALLOCATE, [
+            (ATTR_REQUESTED_TRANSPORT,
+             struct.pack("!BBH", UDP_TRANSPORT, 0, 0)),
+            (ATTR_LIFETIME, struct.pack("!I", lifetime)),
+        ])
+        relayed = unxor_address(
+            resp.attr(ATTR_XOR_RELAYED_ADDRESS) or b"")
+        if relayed is None:
+            raise TurnError("allocate response lacks relayed address")
+        lt = resp.attr(ATTR_LIFETIME)
+        if lt is not None and len(lt) == 4:
+            self.lifetime = struct.unpack("!I", lt)[0]
+        self.relayed_addr = relayed
+        self._maint_task = asyncio.create_task(self._maintain())
+        logger.info("turn allocation: relay %s:%d (lifetime %ds)",
+                    relayed[0], relayed[1], self.lifetime)
+        return relayed
+
+    async def refresh(self, lifetime: Optional[int] = None) -> None:
+        await self._authed_request(M_REFRESH, [
+            (ATTR_LIFETIME,
+             struct.pack("!I", self.lifetime
+                         if lifetime is None else lifetime)),
+        ])
+
+    async def create_permission(self, peer_ip: str) -> None:
+        await self._authed_request(M_CREATE_PERMISSION, [
+            (ATTR_XOR_PEER_ADDRESS, xor_address(peer_ip, 0)),
+        ])
+        self._permissions.add(peer_ip)
+
+    async def channel_bind(self, peer: tuple[str, int]) -> int:
+        ch = self._channels.get(peer)
+        if ch is None:
+            ch = self._next_channel
+            self._next_channel += 1
+        await self._authed_request(M_CHANNEL_BIND, [
+            (ATTR_CHANNEL_NUMBER, struct.pack("!HH", ch, 0)),
+            (ATTR_XOR_PEER_ADDRESS, xor_address(*peer)),
+        ])
+        self._channels[peer] = ch
+        self._channel_rev[ch] = peer
+        self._permissions.add(peer[0])
+        return ch
+
+    async def _maintain(self) -> None:
+        """Keep the relay alive on a short poll so nothing expires:
+        allocation at 5/6 of its lifetime, permissions every 4 min (they
+        expire at 5, RFC 5766 §9), channel binds every 8 min (10-minute
+        lifetime). A single long sleep would let permissions lapse
+        mid-session — the poll must be shorter than every deadline."""
+        start = time.monotonic()
+        alloc_next = start + self.lifetime * 5 / 6
+        perm_next = start + 240
+        chan_next = start + 480
+        while not self._closed:
+            try:
+                await asyncio.sleep(30.0)
+                now = time.monotonic()
+                if now >= alloc_next:
+                    await self.refresh()
+                    alloc_next = time.monotonic() + self.lifetime * 5 / 6
+                if now >= perm_next:
+                    perm_next = now + 240
+                    for ip in list(self._permissions):
+                        await self.create_permission(ip)
+                if now >= chan_next:
+                    chan_next = now + 480
+                    for peer in list(self._channels):
+                        await self.channel_bind(peer)
+            except asyncio.CancelledError:
+                raise
+            except TurnError as e:
+                logger.warning("turn maintenance failed: %s", e)
+
+    # -- data plane ---------------------------------------------------------
+    def send_to_peer(self, data: bytes, peer: tuple[str, int]) -> None:
+        """ChannelData when bound, Send indication otherwise (the
+        indication path needs only a permission)."""
+        if self._transport is None or self._closed:
+            return
+        ch = self._channels.get(peer)
+        if ch is not None:
+            frame = struct.pack("!HH", ch, len(data)) + data
+            frame += b"\x00" * (-len(data) % 4)
+            self._transport.sendto(frame)
+            return
+        ind = StunMessage(M_SEND_IND)
+        ind.add(ATTR_XOR_PEER_ADDRESS, xor_address(*peer))
+        ind.add(ATTR_DATA, data)
+        self._transport.sendto(ind.to_bytes())
+
+    def close(self) -> None:
+        self._closed = True
+        if self._maint_task is not None:
+            self._maint_task.cancel()
+            self._maint_task = None
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
