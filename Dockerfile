@@ -21,8 +21,16 @@ WORKDIR /opt/selkies-tpu
 COPY pyproject.toml README.md ./
 COPY selkies_tpu ./selkies_tpu
 COPY addons ./addons
+COPY tools ./tools
 RUN pip install --no-cache-dir -e . \
     && make -C addons/js-interposer
+
+# pre-warm the persistent XLA compile cache for the default geometries:
+# first boot serves frames in seconds instead of paying the first
+# compile behind a black screen (tools/warm_cache.py; the TPU backend
+# re-warms its own cache entries at first boot via the entrypoint)
+RUN python tools/warm_cache.py --cpu --geometries 1920x1080 \
+        --codecs h264,jpeg || true
 
 ENV DISPLAY=:0 \
     SELKIES_PORT=8080 \
@@ -36,6 +44,14 @@ set -e
 Xvfb :0 -screen 0 1920x1080x24 -nolisten tcp &
 sleep 1
 (twm && xterm) >/dev/null 2>&1 &
+# accelerator hosts: pay the first compile ONCE, before the server owns
+# the backend (one JAX process at a time), then every session is warm.
+# SELKIES_SKIP_WARM=1 skips for instant boot at the cost of a slow
+# first frame.
+if [ -z "$SELKIES_SKIP_WARM" ]; then
+    python /opt/selkies-tpu/tools/warm_cache.py \
+        --geometries 1920x1080 --codecs h264,jpeg || true
+fi
 exec selkies-tpu
 EOF
 RUN chmod +x /entrypoint.sh

@@ -37,6 +37,16 @@ async def run(argv=None) -> None:
         level=logging.DEBUG if settings.debug else logging.INFO,
         format="%(asctime)s [%(name)s] %(levelname)s: %(message)s")
 
+    # persistent XLA compile cache: the server must READ the cache the
+    # image build / entrypoint warm step (tools/warm_cache.py) wrote, or
+    # every boot re-pays the minutes-long first compile
+    try:
+        import jax
+        from .compile_cache import enable as _enable_compile_cache
+        _enable_compile_cache(jax)
+    except Exception:      # jax-less control-plane use still works
+        pass
+
     await wait_for_app_ready(settings.app_ready_file)
 
     server = CentralizedStreamServer(settings)

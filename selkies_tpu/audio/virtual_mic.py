@@ -59,6 +59,7 @@ class VirtualMicrophone:
         self.sink_name = SINK_NAME
         self.source_name: Optional[str] = None
         self._owned_modules: list[str] = []
+        self._prior_default: Optional[str] = None
         self.available = False
 
     async def provision(self) -> bool:
@@ -85,6 +86,7 @@ class VirtualMicrophone:
 
         sources = await _short_names("sources")
         existing = next((s for s in sources if s in SOURCE_ALIASES), None)
+        created = False
         if existing is None:
             rc, out = await _pactl(
                 "load-module", "module-virtual-source",
@@ -102,15 +104,29 @@ class VirtualMicrophone:
                 await _pactl("unload-module", module)
                 return False
             self._owned_modules.append(module)
+            created = True
         self.source_name = existing
-        # best-effort: apps that record "the default source" hear the mic
-        await _pactl("set-default-source", existing)
+        # best-effort: apps that record "the default source" hear the
+        # mic. Only hijack the default for a source WE created (a
+        # pre-existing one belongs to another process), and remember the
+        # prior default so teardown can restore it (ADVICE r4).
+        if created:
+            rc, out = await _pactl("get-default-source")
+            if rc == 0 and out.strip() and out.strip() != existing:
+                self._prior_default = out.strip()
+            await _pactl("set-default-source", existing)
         self.available = True
         logger.info("virtual microphone ready (source %s, sink %s)",
                     existing, self.sink_name)
         return True
 
     async def teardown(self) -> None:
+        try:
+            if self._prior_default is not None:
+                await _pactl("set-default-source", self._prior_default)
+        except OSError:
+            pass
+        self._prior_default = None
         for module in reversed(self._owned_modules):
             try:
                 await _pactl("unload-module", module)

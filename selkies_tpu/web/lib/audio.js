@@ -1,0 +1,152 @@
+/* Audio plane: playback (0x01 Opus+RED -> AudioDecoder -> WebAudio) and
+ * microphone capture (getUserMedia -> AudioWorklet -> 0x02 s16le PCM).
+ * Reference client extractOpusFrames (selkies-ws-core.js:36-38) and mic
+ * sender (selkies-ws-core.js:5685). */
+
+import { OP_MIC } from "./protocol.js";
+
+/* Opus over 0x01 frames -> WebCodecs AudioDecoder -> WebAudio graph.
+ * RED (RFC 2198) redundancy is de-framed; only the primary block is
+ * decoded (redundant blocks cover WS message loss, which TCP prevents —
+ * they matter on the datagram transports). */
+export class AudioPlayer {
+  constructor(serverSettings) {
+    const st = serverSettings.settings || {};
+    this.sampleRate = 48000;
+    this.channels = (st.audio_channels && st.audio_channels.value) || 2;
+    this.frameMs = (st.audio_frame_ms && st.audio_frame_ms.value) || 10;
+    this.ctx = new AudioContext({ sampleRate: this.sampleRate });
+    this.playhead = 0;
+    this.tsUs = 0;
+    this.queueTarget = 5 * this.frameMs / 1000;  // ≤5 frames client buffer
+    this.dec = null;
+    this._initDecoder();
+  }
+
+  _initDecoder() {
+    if (typeof AudioDecoder === "undefined") return;
+    this.dec = new AudioDecoder({
+      output: (ad) => this._play(ad),
+      error: (e) => console.warn("audio decode", e),
+    });
+    this.dec.configure({
+      codec: "opus", sampleRate: this.sampleRate,
+      numberOfChannels: this.channels,
+    });
+  }
+
+  push(buf) {
+    if (!this.dec || this.dec.state !== "configured") return;
+    const nRed = buf[1];
+    let payload = buf.subarray(2);
+    if (nRed > 0) {
+      // RED: u32 pts + nRed*4-byte block hdrs + 1-byte primary hdr + blocks
+      let off = 4 + nRed * 4 + 1;
+      const dv = new DataView(buf.buffer, buf.byteOffset + 2);
+      let skip = 0;
+      for (let i = 0; i < nRed; i++)
+        skip += dv.getUint32(4 + i * 4) & 0x3FF;   // 10-bit block length
+      payload = payload.subarray(off + skip);       // primary block only
+    }
+    if (!payload.length) return;
+    this.dec.decode(new EncodedAudioChunk({
+      type: "key", timestamp: this.tsUs, data: payload,
+    }));
+    this.tsUs += this.frameMs * 1000;
+  }
+
+  _play(ad) {
+    const n = ad.numberOfFrames, ch = ad.numberOfChannels;
+    const buf = this.ctx.createBuffer(ch, n, ad.sampleRate);
+    for (let c = 0; c < ch; c++) {
+      const dst = buf.getChannelData(c);
+      ad.copyTo(dst, { planeIndex: c, format: "f32-planar" });
+    }
+    ad.close();
+    const now = this.ctx.currentTime;
+    if (this.playhead < now) this.playhead = now + 0.01;
+    if (this.playhead - now > this.queueTarget * 3) {
+      this.playhead = now + this.queueTarget;  // queue ran away: resync
+    }
+    const src = this.ctx.createBufferSource();
+    src.buffer = buf;
+    src.connect(this.ctx.destination);
+    src.start(this.playhead);
+    this.playhead += buf.duration;
+  }
+
+  close() {
+    if (this.dec) try { this.dec.close(); } catch { /* already closed */ }
+    this.ctx.close();
+  }
+}
+
+/* Capture path: the AudioContext resamples the getUserMedia track to
+ * 24 kHz; an AudioWorklet batches ~20 ms (480-sample) mono chunks that
+ * are sent as [0x02][s16le PCM] frames — the exact format
+ * audio/pipeline.play_mic_pcm feeds pacat. */
+export class MicSender {
+  constructor(sendBytes) {
+    this.sendBytes = sendBytes;
+    this.ctx = null;
+    this.node = null;
+    this.stream = null;
+  }
+
+  async start() {
+    this.stream = await navigator.mediaDevices.getUserMedia({
+      audio: { channelCount: 1, echoCancellation: true,
+               noiseSuppression: true },
+    });
+    this.ctx = new AudioContext({ sampleRate: 24000 });
+    const src = `registerProcessor("selkies-mic",
+      class extends AudioWorkletProcessor {
+        process(inputs) {
+          const ch = inputs[0] && inputs[0][0];
+          if (ch && ch.length) this.port.postMessage(ch.slice(0));
+          return true;
+        }
+      });`;
+    const url = URL.createObjectURL(
+      new Blob([src], { type: "application/javascript" }));
+    try {
+      await this.ctx.audioWorklet.addModule(url);
+    } finally {
+      URL.revokeObjectURL(url);
+    }
+    const input = this.ctx.createMediaStreamSource(this.stream);
+    this.node = new AudioWorkletNode(this.ctx, "selkies-mic");
+    this._chunks = [];
+    this._n = 0;
+    this.node.port.onmessage = (e) => this._onChunk(e.data);
+    input.connect(this.node);
+    /* no destination connection: capture-only graph */
+  }
+
+  _onChunk(f32) {
+    this._chunks.push(f32);
+    this._n += f32.length;
+    if (this._n < 480) return;                    // ~20 ms at 24 kHz
+    const all = new Float32Array(this._n);
+    let o = 0;
+    for (const c of this._chunks) { all.set(c, o); o += c.length; }
+    this._chunks = [];
+    this._n = 0;
+    const frame = new Uint8Array(1 + all.length * 2);
+    frame[0] = OP_MIC;
+    const dv = new DataView(frame.buffer);
+    for (let i = 0; i < all.length; i++) {
+      const s = Math.max(-1, Math.min(1, all[i]));
+      dv.setInt16(1 + i * 2, s < 0 ? s * 0x8000 : s * 0x7FFF, true);
+    }
+    this.sendBytes(frame);
+  }
+
+  stop() {
+    if (this.node) { try { this.node.disconnect(); } catch { /* gone */ } }
+    if (this.ctx) this.ctx.close();
+    if (this.stream)
+      for (const t of this.stream.getTracks()) t.stop();
+    this.node = this.ctx = this.stream = null;
+  }
+}

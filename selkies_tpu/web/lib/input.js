@@ -1,0 +1,359 @@
+/* Input capture: keyboard (X11 keysyms), mouse (absolute + pointer-lock
+ * relative), touch (direct + trackpad modes), on-screen keyboard,
+ * gamepad polling, clipboard. All events become the text-verb grammar
+ * both transports speak (reference lib/input.js, lib/gamepad.js;
+ * SURVEY.md §2.3).
+ *
+ * `io` contract: io.send(text), io.size() -> [w, h] (stream geometry —
+ * the canvas element may be an offscreen-transferred placeholder whose
+ * width attribute is stale, so coordinates always scale against the
+ * authoritative stream size). */
+
+import { keysymOf } from "./keysyms.js";
+
+export class InputManager {
+  constructor(canvas, io) {
+    this.cv = canvas;
+    this.io = io;
+    this.held = new Set();            // held keysyms
+    this.touchMode = "direct";        // or "trackpad" (postMessage API)
+    this.pointerLocked = false;
+    this._bind();
+  }
+
+  heartbeat() {
+    if (this.held.size)
+      this.io.send(`kh,${Array.from(this.held).join(",")}`);
+  }
+
+  _scaleClient(clientX, clientY) {
+    const r = this.cv.getBoundingClientRect();
+    const [w, h] = this.io.size();
+    const x = Math.round((clientX - r.left) * (w / r.width));
+    const y = Math.round((clientY - r.top) * (h / r.height));
+    return [Math.max(0, Math.min(w - 1, x)),
+            Math.max(0, Math.min(h - 1, y))];
+  }
+
+  _bind() {
+    const cv = this.cv;
+    cv.addEventListener("contextmenu", (e) => e.preventDefault());
+
+    cv.addEventListener("keydown", (e) => {
+      const ks = keysymOf(e);
+      if (ks === null) return;
+      e.preventDefault();
+      if (!e.repeat) { this.held.add(ks); this.io.send(`kd,${ks}`); }
+    });
+    cv.addEventListener("keyup", (e) => {
+      const ks = keysymOf(e);
+      if (ks === null) return;
+      e.preventDefault();
+      this.held.delete(ks);
+      this.io.send(`ku,${ks}`);
+    });
+    cv.addEventListener("blur", () => {
+      if (this.held.size) { this.held.clear(); this.io.send("kr,"); }
+    });
+
+    cv.addEventListener("mousemove", (e) => {
+      if (this.pointerLocked)
+        this.io.send(`m2,${e.movementX},${e.movementY}`);
+      else {
+        const [x, y] = this._scaleClient(e.clientX, e.clientY);
+        this.io.send(`m,${x},${y}`);
+      }
+    });
+    const btnMap = { 0: 1, 1: 2, 2: 3, 3: 8, 4: 9 };  // DOM -> X11
+    cv.addEventListener("mousedown", (e) => {
+      cv.focus();
+      const [x, y] = this._scaleClient(e.clientX, e.clientY);
+      this.io.send(`m,${x},${y}`);
+      this.io.send(`mb,${btnMap[e.button] ?? 1},1`);
+      e.preventDefault();
+    });
+    cv.addEventListener("mouseup", (e) => {
+      this.io.send(`mb,${btnMap[e.button] ?? 1},0`);
+      e.preventDefault();
+    });
+    cv.addEventListener("wheel", (e) => {
+      const dy = Math.sign(e.deltaY), dx = Math.sign(e.deltaX);
+      if (dx || dy) this.io.send(`ms,${dx},${dy}`);
+      e.preventDefault();
+    }, { passive: false });
+
+    document.addEventListener("pointerlockchange", () => {
+      this.pointerLocked = document.pointerLockElement === cv;
+    });
+    cv.addEventListener("dblclick", () => {
+      // double-click toggles pointer lock for games needing relative mouse
+      if (!this.pointerLocked && cv.requestPointerLock)
+        cv.requestPointerLock();
+    });
+
+    document.addEventListener("paste", (e) => {
+      const text = e.clipboardData && e.clipboardData.getData("text");
+      if (text)
+        this.io.send(`cw,${btoa(unescape(encodeURIComponent(text)))}`);
+    });
+    document.addEventListener("copy", () => {
+      // fetch the REMOTE clipboard; delayed so the forwarded Ctrl+C
+      // keystroke reaches the remote app BEFORE the server reads its
+      // selection (otherwise the reply is the previous clipboard)
+      setTimeout(() => this.io.send("REQUEST_CLIPBOARD"), 150);
+    });
+
+    this._bindGamepad();
+    this._bindTouch(cv);
+  }
+
+  /* ------------------------------------------------------------- gamepad
+   * navigator.getGamepads() polling -> js,c/d/b/a verbs (the server half
+   * feeds the C interposer sockets; reference lib/gamepad.js:1-229). */
+  _bindGamepad() {
+    this.padState = new Map();          // index -> {buttons:[], axes:[]}
+    window.addEventListener("gamepadconnected", (e) => {
+      const p = e.gamepad;
+      if (p.index > 3) return;
+      this.padState.set(p.index, { buttons: [], axes: [] });
+      this.io.send(`js,c,${p.index},${p.id.slice(0, 64)}`);
+      if (!this._padTimer) this._padTimer = setInterval(
+        () => this._pollGamepads(), 16);
+    });
+    window.addEventListener("gamepaddisconnected", (e) => {
+      if (!this.padState.delete(e.gamepad.index)) return;
+      this.io.send(`js,d,${e.gamepad.index}`);
+      if (this.padState.size === 0 && this._padTimer) {
+        clearInterval(this._padTimer);
+        this._padTimer = null;
+      }
+    });
+  }
+
+  _pollGamepads() {
+    const pads = navigator.getGamepads ? navigator.getGamepads() : [];
+    for (const p of pads) {
+      if (!p || !this.padState.has(p.index)) continue;
+      const st = this.padState.get(p.index);
+      p.buttons.forEach((b, i) => {
+        const v = b.pressed ? 1 : 0;
+        if (st.buttons[i] !== v) {
+          st.buttons[i] = v;
+          this.io.send(`js,b,${p.index},${i},${v}`);
+        }
+      });
+      p.axes.forEach((a, i) => {
+        const v = Math.round(a * 1000) / 1000;
+        if (Math.abs((st.axes[i] ?? 0) - v) > 0.009) {
+          st.axes[i] = v;
+          this.io.send(`js,a,${p.index},${i},${v}`);
+        }
+      });
+    }
+  }
+
+  /* --------------------------------------------------------------- touch
+   * Touch-to-mouse: one finger = absolute move + left button; two-finger
+   * vertical pan = wheel; two-finger tap = right click (reference
+   * lib/input.js touch mode). */
+  _bindTouch(cv) {
+    const scaleT = (t) => this._scaleClient(t.clientX, t.clientY);
+    // tap-vs-gesture disambiguation: the left press is DEFERRED 60 ms
+    // so a second finger (scroll/right-click gesture) can cancel it —
+    // otherwise every two-finger gesture starts with a phantom click
+    let twoFinger = null;               // {y, moved, t0}
+    let pendingPress = null;            // timer id
+    let pressed = false;
+    const commitPress = () => {
+      if (pendingPress !== null) {
+        clearTimeout(pendingPress);
+        pendingPress = null;
+        this.io.send("mb,1,1");
+        pressed = true;
+      }
+    };
+    cv.addEventListener("touchstart", (e) => {
+      e.preventDefault();
+      if (this.touchMode === "trackpad") {
+        this._trackpadStart(e);
+        return;
+      }
+      if (e.touches.length === 1) {
+        const [x, y] = scaleT(e.touches[0]);
+        this.io.send(`m,${x},${y}`);
+        pendingPress = setTimeout(commitPress, 60);
+      } else if (e.touches.length === 2) {
+        if (pendingPress !== null) {    // gesture: cancel the tap press
+          clearTimeout(pendingPress);
+          pendingPress = null;
+        } else if (pressed) {
+          this.io.send("mb,1,0");
+          pressed = false;
+        }
+        twoFinger = { y: e.touches[0].clientY, moved: false,
+                      t0: performance.now() };
+      }
+    }, { passive: false });
+    cv.addEventListener("touchmove", (e) => {
+      e.preventDefault();
+      if (this.touchMode === "trackpad") {
+        this._trackpadMove(e);
+        return;
+      }
+      if (e.touches.length === 1 && !twoFinger) {
+        commitPress();                  // moving finger = drag, press now
+        const [x, y] = scaleT(e.touches[0]);
+        this.io.send(`m,${x},${y}`);
+      } else if (e.touches.length === 2 && twoFinger) {
+        const dy = e.touches[0].clientY - twoFinger.y;
+        if (Math.abs(dy) > 12) {
+          this.io.send(`ms,0,${dy > 0 ? -1 : 1}`);
+          twoFinger.y = e.touches[0].clientY;
+          twoFinger.moved = true;
+        }
+      }
+    }, { passive: false });
+    cv.addEventListener("touchend", (e) => {
+      e.preventDefault();
+      if (this.touchMode === "trackpad") {
+        this._trackpadEnd(e);
+        return;
+      }
+      if (twoFinger) {
+        if (!twoFinger.moved && performance.now() - twoFinger.t0 < 350) {
+          this.io.send("mb,3,1");       // two-finger tap = right click
+          this.io.send("mb,3,0");
+          twoFinger.moved = true;       // fire once, not per lifted finger
+        }
+        if (e.touches.length === 0) twoFinger = null;
+      } else if (e.touches.length === 0) {
+        if (pendingPress !== null) {    // quick tap: full click now
+          commitPress();
+        }
+        if (pressed) {
+          this.io.send("mb,1,0");
+          pressed = false;
+        }
+      }
+    }, { passive: false });
+  }
+
+  /* trackpad touch mode (reference lib/input.js trackpad mode): the
+   * canvas is a laptop touchpad — one finger moves the cursor
+   * RELATIVELY (m2 verbs), a quick tap left-clicks, a one-finger
+   * tap-then-drag drags, two-finger pan scrolls, two-finger tap
+   * right-clicks. Switch via postMessage {type:"touchMode"}. */
+  _trackpadStart(e) {
+    const t = e.touches;
+    const now = performance.now();
+    if (t.length === 1) {
+      const tapTap = this._tpLastTap && now - this._tpLastTap < 280;
+      this._tp = { x: t[0].clientX, y: t[0].clientY, t0: now,
+                   moved: false, drag: !!tapTap };
+      if (tapTap) this.io.send("mb,1,1");    // tap-drag: hold the button
+    } else if (t.length === 2) {
+      // both fingers may land in ONE touchstart (fast two-finger tap):
+      // synthesize the missing one-finger state so the gesture works
+      if (!this._tp)
+        this._tp = { x: t[0].clientX, y: t[0].clientY, t0: now,
+                     moved: false, drag: false };
+      if (this._tp.drag) { this.io.send("mb,1,0"); this._tp.drag = false; }
+      this._tp.two = { y: t[0].clientY, t0: now, moved: this._tp.moved };
+    }
+  }
+
+  _trackpadMove(e) {
+    const t = e.touches;
+    if (!this._tp) return;
+    if (t.length === 1 && !this._tp.two) {
+      const dx = Math.round((t[0].clientX - this._tp.x) * 1.4);
+      const dy = Math.round((t[0].clientY - this._tp.y) * 1.4);
+      if (dx || dy) {
+        this.io.send(`m2,${dx},${dy}`);
+        this._tp.x = t[0].clientX;
+        this._tp.y = t[0].clientY;
+        this._tp.moved = true;
+      }
+    } else if (t.length === 2 && this._tp.two) {
+      const dy = t[0].clientY - this._tp.two.y;
+      if (Math.abs(dy) > 12) {
+        this.io.send(`ms,0,${dy > 0 ? -1 : 1}`);
+        this._tp.two.y = t[0].clientY;
+        this._tp.two.moved = true;
+      }
+    }
+  }
+
+  _trackpadEnd(e) {
+    if (!this._tp) return;
+    const now = performance.now();
+    if (this._tp.two) {
+      if (!this._tp.two.moved && now - this._tp.two.t0 < 350) {
+        this.io.send("mb,3,1");
+        this.io.send("mb,3,0");
+        this._tp.two.moved = true;
+      }
+      if (e.touches.length === 0) this._tp = null;
+      return;
+    }
+    if (e.touches.length === 0) {
+      if (this._tp.drag) this.io.send("mb,1,0");
+      else if (!this._tp.moved && now - this._tp.t0 < 250) {
+        this.io.send("mb,1,1");
+        this.io.send("mb,1,0");
+        this._tpLastTap = now;
+      }
+      this._tp = null;
+    }
+  }
+
+  /* --------------------------------------------------- on-screen keyboard
+   * Minimal OSK for touch devices (reference lib/input.js OSK): a
+   * toggleable overlay whose buttons fire the same kd/ku verbs. */
+  toggleOnScreenKeyboard() {
+    if (this._osk) {
+      this._osk.remove();
+      this._osk = null;
+      return;
+    }
+    const rows = [
+      ["Esc:65307", "1", "2", "3", "4", "5", "6", "7", "8", "9", "0",
+       "⌫:65288"],
+      ["q", "w", "e", "r", "t", "y", "u", "i", "o", "p"],
+      ["a", "s", "d", "f", "g", "h", "j", "k", "l", "⏎:65293"],
+      ["⇧:65505", "z", "x", "c", "v", "b", "n", "m", ",", "."],
+      ["Ctrl:65507", "Alt:65513", "␣:32", "←:65361", "↓:65364",
+       "↑:65362", "→:65363"],
+    ];
+    const osk = document.createElement("div");
+    osk.style.cssText =
+      "position:fixed;bottom:0;left:0;right:0;background:#222d;" +
+      "padding:6px;z-index:1000;display:flex;flex-direction:column;" +
+      "gap:4px;touch-action:none";
+    for (const row of rows) {
+      const line = document.createElement("div");
+      line.style.cssText = "display:flex;gap:4px;justify-content:center";
+      for (const keydef of row) {
+        const [label, ksStr] = keydef.includes(":")
+          ? keydef.split(":") : [keydef, null];
+        const ks = ksStr ? parseInt(ksStr, 10)
+          : label.codePointAt(0);
+        const b = document.createElement("button");
+        b.textContent = label;
+        b.style.cssText =
+          "flex:1;max-width:72px;padding:10px 4px;font-size:16px;" +
+          "background:#444;color:#eee;border:1px solid #666;" +
+          "border-radius:4px";
+        const down = (e) => { e.preventDefault(); this.io.send(`kd,${ks}`); };
+        const up = (e) => { e.preventDefault(); this.io.send(`ku,${ks}`); };
+        b.addEventListener("pointerdown", down);
+        b.addEventListener("pointerup", up);
+        b.addEventListener("pointerleave", up);
+        line.appendChild(b);
+      }
+      osk.appendChild(line);
+    }
+    document.body.appendChild(osk);
+    this._osk = osk;
+  }
+}

@@ -1,0 +1,63 @@
+/* X11 keysym mapping + keyboard layout detection.
+ *
+ * Printable ASCII/Latin-1 map to their codepoint; other Unicode maps to
+ * 0x01000000 + codepoint (X11 convention); special keys use the table
+ * below (keysymdef.h values, same table the reference client carries in
+ * lib/input.js KeyTable). */
+
+export const KEYSYM_SPECIAL = {
+  Backspace: 0xFF08, Tab: 0xFF09, Enter: 0xFF0D, Pause: 0xFF13,
+  ScrollLock: 0xFF14, Escape: 0xFF1B, Home: 0xFF50, ArrowLeft: 0xFF51,
+  ArrowUp: 0xFF52, ArrowRight: 0xFF53, ArrowDown: 0xFF54, PageUp: 0xFF55,
+  PageDown: 0xFF56, End: 0xFF57, Insert: 0xFF63, Menu: 0xFF67,
+  ContextMenu: 0xFF67, NumLock: 0xFF7F, F1: 0xFFBE, F2: 0xFFBF, F3: 0xFFC0,
+  F4: 0xFFC1, F5: 0xFFC2, F6: 0xFFC3, F7: 0xFFC4, F8: 0xFFC5, F9: 0xFFC6,
+  F10: 0xFFC7, F11: 0xFFC8, F12: 0xFFC9, Delete: 0xFFFF,
+  CapsLock: 0xFFE5, PrintScreen: 0xFF61,
+};
+
+export const KEYSYM_BY_CODE = {    // location-dependent keys need e.code
+  ShiftLeft: 0xFFE1, ShiftRight: 0xFFE2, ControlLeft: 0xFFE3,
+  ControlRight: 0xFFE4, AltLeft: 0xFFE9, AltRight: 0xFFEA,
+  MetaLeft: 0xFFEB, MetaRight: 0xFFEC,
+  NumpadEnter: 0xFF8D, NumpadMultiply: 0xFFAA, NumpadAdd: 0xFFAB,
+  NumpadSubtract: 0xFFAD, NumpadDecimal: 0xFFAE, NumpadDivide: 0xFFAF,
+  Numpad0: 0xFFB0, Numpad1: 0xFFB1, Numpad2: 0xFFB2, Numpad3: 0xFFB3,
+  Numpad4: 0xFFB4, Numpad5: 0xFFB5, Numpad6: 0xFFB6, Numpad7: 0xFFB7,
+  Numpad8: 0xFFB8, Numpad9: 0xFFB9,
+};
+
+export function keysymOf(e) {
+  if (KEYSYM_BY_CODE[e.code] !== undefined) return KEYSYM_BY_CODE[e.code];
+  const k = e.key;
+  if (k.length === 1) {
+    const cp = k.codePointAt(0);
+    if (cp >= 0x20 && cp <= 0x7E) return cp;          // ASCII printable
+    if (cp >= 0xA0 && cp <= 0xFF) return cp;          // Latin-1
+    return 0x01000000 + cp;                            // Unicode keysym
+  }
+  if (KEYSYM_SPECIAL[k] !== undefined) return KEYSYM_SPECIAL[k];
+  return null;
+}
+
+/* Best-effort layout detection (reference lib/keyboard-layout.js): probe
+ * the physical-key layout map, fall back to the UI language. The server
+ * aligns the X keymap for scancode-reading apps (character input is
+ * already layout-independent via keysyms). */
+export async function detectKeyboardLayout() {
+  let layout = "";
+  try {
+    if (navigator.keyboard && navigator.keyboard.getLayoutMap) {
+      const map = await navigator.keyboard.getLayoutMap();
+      const probe = [map.get("KeyQ"), map.get("KeyW"), map.get("KeyZ")]
+        .join("");
+      layout = { qwz: "us", azw: "fr", qwy: "de" }[probe] || "";
+    }
+  } catch (_e) { /* permissions / unsupported */ }
+  if (!layout) {
+    const lang = (navigator.language || "en-US").toLowerCase();
+    layout = { fr: "fr", de: "de", es: "es", it: "it", pt: "pt",
+               ru: "ru", gb: "gb" }[lang.split("-")[0]] || "us";
+  }
+  return layout;
+}

@@ -1,0 +1,11 @@
+/* Wire constants shared across modules (protocol.py opcode bytes). */
+
+export const OP_AUDIO = 0x01;
+export const OP_MIC = 0x02;
+export const OP_JPEG = 0x03;
+export const OP_H264 = 0x04;
+export const OP_GZ = 0x05;
+
+/* uint16 circular frame-id comparison (matches the server's ACK rule). */
+export const fidNewer = (a, b) =>
+  ((a - b + 0x10000) & 0xFFFF) < 0x8000 && a !== b;

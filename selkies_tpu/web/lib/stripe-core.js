@@ -1,0 +1,126 @@
+/* Shared stripe-decode core — ONE copy of the wire-format logic
+ * (headers, frame-id dedup, overload drop, decoder-per-y, fullcolor
+ * codec select) used by BOTH rendering paths:
+ *   - lib/video-worker.js  (classic worker: importScripts this file)
+ *   - lib/video.js CanvasVideoSink (main thread: index.html loads this
+ *     with a plain <script> before the module entry)
+ * Classic script on purpose: ES modules can't be importScripts'd and
+ * classic workers can't import modules, so the shared core speaks the
+ * one dialect both sides can load. Exposes `SelkiesStripeCore` on the
+ * global scope (window or worker self). */
+
+"use strict";
+
+(function (global) {
+  const OP_JPEG = 0x03, OP_H264 = 0x04;
+  const fidNewer = (a, b) =>
+    ((a - b + 0x10000) & 0xFFFF) < 0x8000 && a !== b;
+
+  /* hooks: draw(imageLike, y)  — blit one decoded stripe,
+   *        onAck(fid), onDrawn(), onKeyframeNeeded(), onStatus(msg),
+   *        fullcolor() -> bool  — read at decoder creation time. */
+  function makeStripeDecoder(hooks) {
+    const stripeLastFid = new Map();   // y -> last drawn frame id
+    const h264Decoders = new Map();    // y -> VideoDecoder
+    let jpegQueue = 0;                 // in-flight createImageBitmap
+    let h264warned = false;
+
+    /* 6-byte header: [0x03, flags, u16 frame_id, u16 stripe_y] + JFIF */
+    async function pushJpeg(buf) {
+      const dv = new DataView(buf.buffer, buf.byteOffset, 6);
+      const fid = dv.getUint16(2), y = dv.getUint16(4);
+      const last = stripeLastFid.get(y);
+      if (last !== undefined && !fidNewer(fid, last)) return; // stale
+      if (jpegQueue > 48) return;   // overload: drop, keyframe recovers
+      jpegQueue++;
+      try {
+        const blob = new Blob([buf.subarray(6)], { type: "image/jpeg" });
+        const bmp = await createImageBitmap(blob);
+        const l2 = stripeLastFid.get(y);
+        if (l2 === undefined || fidNewer(fid, l2) || fid === l2) {
+          stripeLastFid.set(y, fid);
+          hooks.draw(bmp, y);       // canvas crops right/bottom padding
+          hooks.onDrawn();
+          hooks.onAck(fid);
+        }
+        bmp.close();
+      } catch (e) {
+        console.warn("jpeg stripe decode failed", e);
+      } finally {
+        jpegQueue--;
+      }
+    }
+
+    /* 10-byte header: [0x04, frame_type, u16 fid, u16 y, u16 w, u16 h]
+     * + Annex-B. Every stripe row is an independent H.264 stream with
+     * its own decoder keyed by y_start (reference
+     * selkies-ws-core.js:4424-4460). */
+    function pushH264(buf) {
+      if (typeof VideoDecoder === "undefined") {
+        if (!h264warned) {
+          h264warned = true;
+          hooks.onStatus("WebCodecs H.264 unsupported in this browser");
+        }
+        return;
+      }
+      const dv = new DataView(buf.buffer, buf.byteOffset, 10);
+      const fid = dv.getUint16(2), y = dv.getUint16(4);
+      let dec = h264Decoders.get(y);
+      if (!dec || dec.state === "closed") {
+        const yTop = y;
+        dec = new VideoDecoder({
+          output: (frame) => {
+            hooks.draw(frame, yTop);
+            hooks.onDrawn();
+            hooks.onAck(frame.timestamp & 0xFFFF);
+            frame.close();
+          },
+          error: (e) => {
+            console.warn("h264 stripe decoder error", e);
+            h264Decoders.delete(yTop);
+            hooks.onKeyframeNeeded();
+          },
+        });
+        // Annex-B stream (no description): constrained baseline, or
+        // Hi444PP when the server streams fullcolor 4:4:4 (the
+        // reference's f4001f profile munge)
+        dec.configure({
+          codec: hooks.fullcolor() ? "avc1.f4002a" : "avc1.42c02a",
+          optimizeForLatency: true,
+        });
+        h264Decoders.set(y, dec);
+      }
+      if (dec.decodeQueueSize > 16) {
+        // overload: drop the stripe but request a refresh (throttled
+        // by the client) — the server's damage gating believes it was
+        // delivered and would otherwise leave this region stale until
+        // the next change
+        hooks.onKeyframeNeeded();
+        return;
+      }
+      dec.decode(new EncodedVideoChunk({
+        type: buf[1] === 1 ? "key" : "delta",  // frame_type from header
+        timestamp: fid,
+        data: buf.subarray(10),
+      }));
+    }
+
+    function push(u8) {
+      if (u8[0] === OP_JPEG) pushJpeg(u8);
+      else if (u8[0] === OP_H264) pushH264(u8);
+    }
+
+    function reset() {
+      stripeLastFid.clear();
+      for (const dec of h264Decoders.values()) {
+        try { dec.close(); } catch (_e) { /* already closed */ }
+      }
+      h264Decoders.clear();
+    }
+
+    return { push, reset };
+  }
+
+  global.SelkiesStripeCore = { makeStripeDecoder, fidNewer,
+                               OP_JPEG, OP_H264 };
+})(typeof self !== "undefined" ? self : window);

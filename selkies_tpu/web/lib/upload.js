@@ -1,0 +1,42 @@
+/* File upload: drag-drop -> chunked POST /api/upload with the
+ * X-Upload-* resume protocol the server speaks (reference
+ * lib/file-upload.js; server/core.py upload handler). */
+
+export function bindUpload(cv, post) {
+  const stop = (e) => { e.preventDefault(); e.stopPropagation(); };
+  ["dragenter", "dragover"].forEach((ev) => cv.addEventListener(ev, stop));
+  cv.addEventListener("drop", async (e) => {
+    stop(e);
+    const files = [...(e.dataTransfer ? e.dataTransfer.files : [])];
+    for (const f of files) {
+      try {
+        await uploadFile(f, post);
+        post({ type: "uploadDone", name: f.name });
+      } catch (err) {
+        post({ type: "uploadError", name: f.name, error: String(err) });
+      }
+    }
+  });
+}
+
+export async function uploadFile(file, post, chunkBytes = 1 << 20) {
+  for (let off = 0; off < file.size || off === 0; off += chunkBytes) {
+    const chunk = file.slice(off, off + chunkBytes);
+    const r = await fetch("/api/upload", {
+      method: "POST",
+      headers: {
+        // headers are Latin-1 only: percent-encode, server decodes
+        "X-Upload-Name": encodeURIComponent(file.name),
+        "X-Upload-Offset": String(off),
+        "X-Upload-Total": String(file.size),
+      },
+      body: chunk,
+      credentials: "same-origin",
+    });
+    if (!r.ok) throw new Error(`upload ${file.name}: HTTP ${r.status}`);
+    post({ type: "uploadProgress", name: file.name,
+           sent: Math.min(off + chunkBytes, file.size),
+           total: file.size });
+    if (file.size === 0) break;
+  }
+}

@@ -1,0 +1,160 @@
+/* Video decode worker: per-stripe decoders + frame composition OFF the
+ * main thread (reference addons/selkies-web-core/selkies-ws-core.js
+ * :4424-4460 per-stripe decoders + README "Video Rendering" worker /
+ * track-generator pipeline; fresh code).
+ *
+ * Classic worker (module workers don't load everywhere workers do);
+ * the wire-format decode logic is shared with the main-thread fallback
+ * via lib/stripe-core.js (one copy, importScripts'd here).
+ *
+ * Modes (set by the 'init' message):
+ *  - 'offscreen':  draw stripes straight into the page canvas
+ *                  (transferControlToOffscreen); zero extra copies, the
+ *                  compositor presents whatever was last drawn.
+ *  - 'compose':    draw stripes into a local OffscreenCanvas, then emit
+ *                  one VideoFrame per dirty tick into a
+ *                  MediaStreamTrackGenerator writable the main thread
+ *                  transferred in (Chrome zero-copy path).
+ *  - 'composeTrackGen': same, but the worker creates a
+ *                  VideoTrackGenerator (worker-native, Safari path) and
+ *                  transfers its .track back to the main thread.
+ *
+ * In->out protocol: see lib/video.js WorkerVideoSink.
+ */
+
+"use strict";
+
+importScripts("stripe-core.js");
+
+let mode = null;
+let canvas = null;          // OffscreenCanvas (page-linked or local)
+let ctx = null;
+let writer = null;          // WritableStreamDefaultWriter for VideoFrames
+let fullcolor = false;
+let width = 0, height = 0;
+
+let drawnBatch = 0;                 // stripes drawn since last stats post
+let dirty = false;
+let emitScheduled = false;
+let lastEmitFid = 0;
+let lastAckFid = -1;
+
+function post(msg, transfer) { self.postMessage(msg, transfer || []); }
+
+const decoder = SelkiesStripeCore.makeStripeDecoder({
+  draw: (img, y) => { ctx.drawImage(img, 0, y); scheduleEmit(); },
+  onDrawn: () => {
+    drawnBatch++;
+    if (drawnBatch >= 8) { post({ type: "drawn", n: drawnBatch }); drawnBatch = 0; }
+  },
+  onAck: (fid) => {
+    if (fid !== lastAckFid) { lastAckFid = fid; post({ type: "ack", fid }); }
+  },
+  onKeyframeNeeded: () => post({ type: "kf" }),
+  onStatus: (msg) => post({ type: "err", msg }),
+  fullcolor: () => fullcolor,
+});
+
+setInterval(() => {   // flush the stripe-stats remainder at low rates
+  if (drawnBatch) { post({ type: "drawn", n: drawnBatch }); drawnBatch = 0; }
+}, 500);
+
+/* ---------------------------------------------------------------- caps */
+function caps() {
+  return {
+    type: "caps",
+    videoDecoder: typeof VideoDecoder !== "undefined",
+    trackGen: typeof VideoTrackGenerator !== "undefined",
+    offscreen: typeof OffscreenCanvas !== "undefined",
+  };
+}
+
+/* ---------------------------------------------------------------- emit */
+function scheduleEmit() {
+  dirty = true;
+  if (emitScheduled || writer === null) return;
+  emitScheduled = true;
+  // rAF exists in dedicated workers on Chromium/Firefox; elsewhere a
+  // 60 Hz timer gives the same coalescing
+  if (typeof requestAnimationFrame === "function")
+    requestAnimationFrame(emitFrame);
+  else setTimeout(emitFrame, 16);
+}
+
+function emitFrame() {
+  emitScheduled = false;
+  if (!dirty || writer === null || canvas === null) return;
+  dirty = false;
+  let frame = null;
+  try {
+    frame = new VideoFrame(canvas, {
+      timestamp: (lastEmitFid++) * 16667,
+    });
+    // drop rather than await when the sink applies backpressure: the
+    // next dirty tick carries the newer content anyway. On rejection
+    // (track ended, writable errored) the sink never took ownership —
+    // close the frame or pool-backed frames leak until GC
+    const f = frame;
+    writer.write(f).catch(() => {
+      try { f.close(); } catch (_e) { /* already closed */ }
+    });
+  } catch (e) {
+    if (frame) try { frame.close(); } catch (_e) { /* closed */ }
+  }
+}
+
+/* --------------------------------------------------------------- state */
+function resize(w, h) {
+  width = w; height = h;
+  decoder.reset();
+  if (canvas) {
+    canvas.width = w; canvas.height = h;
+    ctx = canvas.getContext("2d", { desynchronized: true });
+  }
+}
+
+/* ------------------------------------------------------------- message */
+self.onmessage = (e) => {
+  const m = e.data;
+  switch (m.type) {
+    case "caps?":
+      post(caps());
+      break;
+    case "init":
+      mode = m.mode;
+      fullcolor = !!m.fullcolor;
+      width = m.width; height = m.height;
+      if (m.canvas) canvas = m.canvas;                  // offscreen mode
+      else canvas = new OffscreenCanvas(width || 2, height || 2);
+      if (width) { canvas.width = width; canvas.height = height; }
+      ctx = canvas.getContext("2d", { desynchronized: true });
+      if (m.writable) writer = m.writable.getWriter();  // compose mode
+      else if (mode === "composeTrackGen") {
+        try {
+          const gen = new VideoTrackGenerator();
+          writer = gen.writable.getWriter();
+          post({ type: "track", track: gen.track }, [gen.track]);
+        } catch (err) {
+          post({ type: "err", msg: "VideoTrackGenerator: " + err });
+        }
+      }
+      break;
+    case "stripe":
+      decoder.push(new Uint8Array(m.buf));
+      break;
+    case "config":
+      if (m.fullcolor !== undefined && m.fullcolor !== fullcolor) {
+        fullcolor = !!m.fullcolor;
+        decoder.reset();
+      }
+      break;
+    case "resize":
+      resize(m.width, m.height);
+      break;
+    case "reset":
+      decoder.reset();
+      break;
+    default:
+      break;
+  }
+};

@@ -1,0 +1,212 @@
+/* Video sinks: where decoded stripes become pixels.
+ *
+ * Preference order (reference selkies-web-core README "Video Rendering"):
+ *  1. WorkerVideoSink — decode + composite in a worker; present through
+ *     MediaStreamTrackGenerator (Chrome) or VideoTrackGenerator (Safari)
+ *     into a <video>, or draw directly into the page canvas via
+ *     transferControlToOffscreen. Main thread never touches pixels.
+ *  2. CanvasVideoSink — main-thread WebCodecs/createImageBitmap into a
+ *     2d canvas (works everywhere the codecs do).
+ *
+ * The wire-format decode logic itself lives ONCE in lib/stripe-core.js
+ * (classic script: the worker importScripts it, index.html loads it for
+ * this module's CanvasVideoSink).
+ *
+ * Sink interface: push(u8) / resize(w,h) / setFullcolor(b) / reset() /
+ * close() / mode (string, for the HUD).
+ *
+ * hooks: onAck(fid), onKeyframeNeeded(), onStripeDrawn(n),
+ *        onStatus(msg, isErr), attachVideo(stream) -> overlay sync.
+ */
+
+export function createVideoSink(canvas, hooks) {
+  if (typeof Worker !== "undefined" && typeof OffscreenCanvas !== "undefined")
+    return new WorkerVideoSink(canvas, hooks);
+  return new CanvasVideoSink(canvas, hooks);
+}
+
+/* ------------------------------------------------------- worker-backed */
+class WorkerVideoSink {
+  constructor(canvas, hooks) {
+    this.canvas = canvas;
+    this.hooks = hooks;
+    this.mode = "worker:negotiating";
+    this.fullcolor = false;
+    this.w = canvas.width; this.h = canvas.height;
+    this._queue = [];              // stripes buffered during negotiation
+    this._fallback = null;         // CanvasVideoSink if workers punt
+    this._ready = false;
+    try {
+      this.worker = new Worker("lib/video-worker.js");
+    } catch (e) {
+      this._toFallback(`worker spawn failed: ${e}`);
+      return;
+    }
+    this.worker.onerror = (e) => {
+      if (!this._ready) this._toFallback(`worker error: ${e.message || e}`);
+    };
+    this.worker.onmessage = (e) => this._onMessage(e.data);
+    this.worker.postMessage({ type: "caps?" });
+    // negotiation deadline: a worker that never answers caps? (CSP, file
+    // URL quirks) must not stall video forever
+    this._capsTimer = setTimeout(
+      () => this._toFallback("worker caps timeout"), 2000);
+  }
+
+  _onMessage(m) {
+    switch (m.type) {
+      case "caps": this._onCaps(m); break;
+      case "ack": this.hooks.onAck(m.fid); break;
+      case "drawn": this.hooks.onStripeDrawn(m.n); break;
+      case "kf": this.hooks.onKeyframeNeeded(); break;
+      case "track":
+        this.hooks.attachVideo(new MediaStream([m.track]));
+        break;
+      case "err":
+        this.hooks.onStatus(`video worker: ${m.msg}`, true);
+        break;
+      default: break;
+    }
+  }
+
+  _onCaps(caps) {
+    clearTimeout(this._capsTimer);
+    if (this._fallback) return;                  // timeout already fired
+    if (!caps.videoDecoder) {
+      // no WebCodecs in the worker: H.264 must decode on main (or the
+      // canvas sink surfaces the unsupported warning) — either way the
+      // worker can't carry the session
+      this._toFallback("no VideoDecoder in worker");
+      return;
+    }
+    const init = { type: "init", width: this.w, height: this.h,
+                   fullcolor: this.fullcolor };
+    if (typeof MediaStreamTrackGenerator !== "undefined") {
+      // Chrome zero-copy: generator on main, writable into the worker
+      const gen = new MediaStreamTrackGenerator({ kind: "video" });
+      init.mode = "compose";
+      init.writable = gen.writable;
+      this.worker.postMessage(init, [gen.writable]);
+      this.hooks.attachVideo(new MediaStream([gen.track]));
+      this.mode = "worker:trackgen";
+    } else if (caps.trackGen) {
+      // Safari: VideoTrackGenerator lives in the worker; the track
+      // comes back in a 'track' message
+      init.mode = "composeTrackGen";
+      this.worker.postMessage(init);
+      this.mode = "worker:trackgen-worker";
+    } else if (this.canvas.transferControlToOffscreen) {
+      const off = this.canvas.transferControlToOffscreen();
+      init.mode = "offscreen";
+      init.canvas = off;
+      this.worker.postMessage(init, [off]);
+      this.mode = "worker:offscreen";
+      this._offscreen = true;
+    } else {
+      this._toFallback("no presentation path in worker");
+      return;
+    }
+    this._ready = true;
+    for (const buf of this._queue) this._post(buf);
+    this._queue.length = 0;
+  }
+
+  _toFallback(why) {
+    clearTimeout(this._capsTimer);
+    if (this.worker) { try { this.worker.terminate(); } catch (_e) { /* */ } }
+    this.worker = null;
+    console.warn("video worker unavailable:", why);
+    this._fallback = new CanvasVideoSink(this.canvas, this.hooks);
+    this._fallback.setFullcolor(this.fullcolor);
+    if (this.w && this.h) this._fallback.resize(this.w, this.h);
+    this.mode = this._fallback.mode;
+    for (const buf of this._queue) this._fallback.push(buf);
+    this._queue.length = 0;
+  }
+
+  _post(u8) {
+    // transfer, don't copy: stripes are fresh ArrayBuffers off the WS
+    const buf = (u8.byteOffset === 0 &&
+                 u8.byteLength === u8.buffer.byteLength)
+      ? u8.buffer : u8.slice().buffer;
+    this.worker.postMessage({ type: "stripe", buf }, [buf]);
+  }
+
+  push(u8) {
+    if (this._fallback) { this._fallback.push(u8); return; }
+    if (!this._ready) {
+      if (this._queue.length < 128) this._queue.push(u8.slice());
+      return;
+    }
+    this._post(u8);
+  }
+
+  resize(w, h) {
+    this.w = w; this.h = h;
+    if (this._fallback) { this._fallback.resize(w, h); return; }
+    // in offscreen mode the worker owns canvas geometry; in compose
+    // modes the page canvas is only the input overlay and the client
+    // sizes it against the <video>
+    if (this.worker) this.worker.postMessage({ type: "resize",
+                                               width: w, height: h });
+  }
+
+  setFullcolor(b) {
+    this.fullcolor = !!b;
+    if (this._fallback) { this._fallback.setFullcolor(b); return; }
+    if (this.worker) this.worker.postMessage({ type: "config",
+                                               fullcolor: this.fullcolor });
+  }
+
+  reset() {
+    if (this._fallback) { this._fallback.reset(); return; }
+    if (this.worker) this.worker.postMessage({ type: "reset" });
+  }
+
+  close() {
+    if (this._fallback) { this._fallback.close(); return; }
+    if (this.worker) { try { this.worker.terminate(); } catch (_e) { /* */ } }
+    this.worker = null;
+  }
+}
+
+/* ------------------------------------------------------- canvas-backed
+ * Main-thread fallback: wraps the same stripe-core decoder the worker
+ * uses, drawing into the visible canvas. */
+export class CanvasVideoSink {
+  constructor(canvas, hooks) {
+    this.canvas = canvas;
+    this.hooks = hooks;
+    this.ctx = canvas.getContext("2d", { desynchronized: true });
+    this.mode = "canvas";
+    this.fullcolor = false;
+    this._core = window.SelkiesStripeCore.makeStripeDecoder({
+      draw: (img, y) => this.ctx.drawImage(img, 0, y),
+      onDrawn: () => this.hooks.onStripeDrawn(1),
+      onAck: (fid) => this.hooks.onAck(fid),
+      onKeyframeNeeded: () => this.hooks.onKeyframeNeeded(),
+      onStatus: (msg) => this.hooks.onStatus(msg, true),
+      fullcolor: () => this.fullcolor,
+    });
+  }
+
+  push(u8) { this._core.push(u8); }
+
+  resize(w, h) {
+    this.canvas.width = w;
+    this.canvas.height = h;
+    this.ctx = this.canvas.getContext("2d", { desynchronized: true });
+    this._core.reset();
+  }
+
+  setFullcolor(b) {
+    if (this.fullcolor !== !!b) {
+      this.fullcolor = !!b;
+      this._core.reset();
+    }
+  }
+
+  reset() { this._core.reset(); }
+
+  close() { this._core.reset(); }
+}

@@ -15,75 +15,38 @@
  *        signaling_server.py protocol) -> RTCPeerConnection answering the
  *        server's ICE-lite offer; media arrives as real tracks on a
  *        <video> sink; input rides an ordered "input" data channel
- *        speaking the SAME text-verb grammar as the WS transport. */
+ *        speaking the SAME text-verb grammar as the WS transport.
+ *
+ * Modules: lib/video.js (worker decode + track-generator sinks),
+ * lib/audio.js (playback + mic), lib/input.js (all input capture),
+ * lib/keysyms.js, lib/upload.js, lib/protocol.js. This file owns the
+ * transports, control-verb dispatch, and the dashboard postMessage API. */
 
 "use strict";
 
-/* ------------------------------------------------------------------ keysyms
- * X11 keysym mapping. Printable ASCII/Latin-1 map to their codepoint;
- * other Unicode maps to 0x01000000 + codepoint (X11 convention); special
- * keys use the table below (keysymdef.h values, same table the reference
- * client carries in lib/input.js KeyTable). */
-const KEYSYM_SPECIAL = {
-  Backspace: 0xFF08, Tab: 0xFF09, Enter: 0xFF0D, Pause: 0xFF13,
-  ScrollLock: 0xFF14, Escape: 0xFF1B, Home: 0xFF50, ArrowLeft: 0xFF51,
-  ArrowUp: 0xFF52, ArrowRight: 0xFF53, ArrowDown: 0xFF54, PageUp: 0xFF55,
-  PageDown: 0xFF56, End: 0xFF57, Insert: 0xFF63, Menu: 0xFF67,
-  ContextMenu: 0xFF67, NumLock: 0xFF7F, F1: 0xFFBE, F2: 0xFFBF, F3: 0xFFC0,
-  F4: 0xFFC1, F5: 0xFFC2, F6: 0xFFC3, F7: 0xFFC4, F8: 0xFFC5, F9: 0xFFC6,
-  F10: 0xFFC7, F11: 0xFFC8, F12: 0xFFC9, Delete: 0xFFFF,
-  CapsLock: 0xFFE5, PrintScreen: 0xFF61,
-};
-const KEYSYM_BY_CODE = {           // location-dependent keys need e.code
-  ShiftLeft: 0xFFE1, ShiftRight: 0xFFE2, ControlLeft: 0xFFE3,
-  ControlRight: 0xFFE4, AltLeft: 0xFFE9, AltRight: 0xFFEA,
-  MetaLeft: 0xFFEB, MetaRight: 0xFFEC,
-  NumpadEnter: 0xFF8D, NumpadMultiply: 0xFFAA, NumpadAdd: 0xFFAB,
-  NumpadSubtract: 0xFFAD, NumpadDecimal: 0xFFAE, NumpadDivide: 0xFFAF,
-  Numpad0: 0xFFB0, Numpad1: 0xFFB1, Numpad2: 0xFFB2, Numpad3: 0xFFB3,
-  Numpad4: 0xFFB4, Numpad5: 0xFFB5, Numpad6: 0xFFB6, Numpad7: 0xFFB7,
-  Numpad8: 0xFFB8, Numpad9: 0xFFB9,
-};
-
-function keysymOf(e) {
-  if (KEYSYM_BY_CODE[e.code] !== undefined) return KEYSYM_BY_CODE[e.code];
-  const k = e.key;
-  if (k.length === 1) {
-    const cp = k.codePointAt(0);
-    if (cp >= 0x20 && cp <= 0x7E) return cp;          // ASCII printable
-    if (cp >= 0xA0 && cp <= 0xFF) return cp;          // Latin-1
-    return 0x01000000 + cp;                            // Unicode keysym
-  }
-  if (KEYSYM_SPECIAL[k] !== undefined) return KEYSYM_SPECIAL[k];
-  return null;
-}
-
-/* opcode bytes (protocol.py) */
-const OP_AUDIO = 0x01, OP_MIC = 0x02, OP_JPEG = 0x03, OP_H264 = 0x04,
-      OP_GZ = 0x05;
-
-const fidNewer = (a, b) => ((a - b + 0x10000) & 0xFFFF) < 0x8000 && a !== b;
+import { detectKeyboardLayout } from "./lib/keysyms.js";
+import { OP_AUDIO, OP_JPEG, OP_H264, OP_GZ } from "./lib/protocol.js";
+import { AudioPlayer, MicSender } from "./lib/audio.js";
+import { InputManager } from "./lib/input.js";
+import { createVideoSink } from "./lib/video.js";
+import { bindUpload } from "./lib/upload.js";
 
 /* ------------------------------------------------------------------ client */
 class SelkiesClient {
   constructor(canvas, hud) {
     this.canvas = canvas;
-    this.ctx = canvas.getContext("2d", { desynchronized: true });
     this.hud = hud;
     this.ws = null;
     this.gz = false;
     this.serverSettings = null;
     this.displayW = 0; this.displayH = 0;
     this.videoActive = false;
-    this.touchMode = "direct";        // or "trackpad" (postMessage API)
     this.lastAckFid = -1;
-    this.stripeLastFid = new Map();   // y -> last drawn frame id
-    this.held = new Set();            // held keysyms
-    this.decodeQueue = 0;             // in-flight createImageBitmap calls
     this.framesDrawn = 0;
     this.stripesDrawn = 0;
+    this.everDrawn = false;
+    this.videoStartedAt = 0;
     this.lastStatsT = performance.now();
-    this.pointerLocked = false;
     this.audio = null;                // AudioPlayer
     this.reconnectDelay = 500;
     this.statusMsg = "connecting…";
@@ -92,12 +55,34 @@ class SelkiesClient {
     this.pc = null;                   // RTCPeerConnection
     this.dc = null;                   // "input" data channel
     this.sigWs = null;                // signaling WebSocket
-    this.videoEl = null;              // RTC <video> sink
+    this.videoEl = null;              // <video> sink (RTC or track-gen)
 
-    this._bindInput();
+    this.input = new InputManager(canvas, {
+      send: (t) => this.send(t),
+      size: () => [this.displayW || canvas.width || 1,
+                   this.displayH || canvas.height || 1],
+    });
+    this.sink = null;   // built lazily: RTC sessions never need one
+    bindUpload(canvas, (m) => this._post(m));
+    window.addEventListener("message", (e) => this._onDashboardMessage(e));
     this._bindResize();
+    document.addEventListener("visibilitychange", () => {
+      if (!this.ws || this.ws.readyState !== WebSocket.OPEN) return;
+      if (document.hidden) this.send("STOP_VIDEO");
+      else { this.send("START_VIDEO"); this.send("REQUEST_KEYFRAME"); }
+    });
     this._statsTimer = setInterval(() => this._reportStats(), 2000);
-    this._hbTimer = setInterval(() => this._heartbeat(), 500);
+    this._hbTimer = setInterval(() => this.input.heartbeat(), 500);
+    this._sendLayout();
+  }
+
+  async _sendLayout() {
+    const layout = await detectKeyboardLayout();
+    this._kbLayout = layout;
+    const sendIt = () => this.send(
+      `SETTINGS,${JSON.stringify({ keyboard_layout: layout })}`);
+    if (this.ws && this.ws.readyState === WebSocket.OPEN) sendIt();
+    else this._pendingLayout = sendIt;
   }
 
   /* ------------------------------------------------------------ transport */
@@ -207,7 +192,7 @@ class SelkiesClient {
     const pc = new RTCPeerConnection({ iceServers });
     this.pc = pc;
     pc.ontrack = (e) => {
-      if (e.track.kind === "video") this._attachRtcVideo(e.streams[0] ||
+      if (e.track.kind === "video") this._attachVideo(e.streams[0] ||
         new MediaStream([e.track]));
       else if (this.videoEl) this.videoEl.muted = false;
     };
@@ -239,7 +224,11 @@ class SelkiesClient {
       type: answer.type, sdp: pc.localDescription.sdp } }));
   }
 
-  _attachRtcVideo(stream) {
+  /* -------------------------------------------------------- <video> sink
+   * Shared by the RTC transport (real tracks) and the worker sink's
+   * track-generator path: the canvas floats transparently above the
+   * video as the input-capture surface. */
+  _attachVideo(stream) {
     if (!this.videoEl) {
       const v = document.createElement("video");
       v.autoplay = true; v.playsInline = true; v.muted = true;
@@ -250,27 +239,28 @@ class SelkiesClient {
       this.canvas.style.position = "absolute";
       this.canvas.style.background = "transparent";
       this.videoEl = v;
-      v.addEventListener("resize", () => this._syncRtcCanvas());
+      v.addEventListener("resize", () => this._syncOverlay());
     }
     this.videoEl.srcObject = stream;
     this.videoEl.play().catch(() => { /* needs a user gesture; autoplay muted */ });
-    this._syncRtcCanvas();
+    this._syncOverlay();
   }
 
-  /* size the input-capturing canvas exactly over the displayed video and
-   * keep canvas.width/height at the STREAM size so _bindInput's coordinate
-   * scaling holds for both transports */
-  _syncRtcCanvas() {
+  /* size the input-capturing canvas exactly over the displayed video; in
+   * RTC mode the stream size is also the authoritative display size
+   * (no server_settings push there) */
+  _syncOverlay() {
     const v = this.videoEl;
     if (!v || !v.videoWidth) return;
-    this.displayW = v.videoWidth; this.displayH = v.videoHeight;
-    this.canvas.width = v.videoWidth; this.canvas.height = v.videoHeight;
+    if (this.rtcMode) {
+      this.displayW = v.videoWidth; this.displayH = v.videoHeight;
+      document.title = `Selkies TPU — ${v.videoWidth}x${v.videoHeight}`;
+    }
     const r = v.getBoundingClientRect();
     this.canvas.style.left = `${r.left}px`;
     this.canvas.style.top = `${r.top}px`;
     this.canvas.style.width = `${r.width}px`;
     this.canvas.style.height = `${r.height}px`;
-    document.title = `Selkies TPU — ${v.videoWidth}x${v.videoHeight}`;
   }
 
   _rtcTeardown() {
@@ -292,10 +282,30 @@ class SelkiesClient {
   }
 
   /* -------------------------------------------------------------- binary */
+  /* Lazy: stripes only arrive on the WS transport, so RTC sessions never
+   * spawn a decode worker whose track-generator attachVideo could race
+   * the real RTC stream on the shared <video>. */
+  _ensureSink() {
+    if (!this.sink) {
+      this.sink = createVideoSink(this.canvas, {
+        onAck: (fid) => this._ackFrame(fid),
+        onStripeDrawn: (n) => { this.stripesDrawn += n; this.everDrawn = true; },
+        onKeyframeNeeded: () => this._requestKeyframeThrottled(),
+        onStatus: (m, isErr) => this.status(m, isErr),
+        attachVideo: (stream) => {
+          if (!this.rtcMode) this._attachVideo(stream);
+        },
+      });
+    }
+    return this.sink;
+  }
+
   _onBinary(buf) {
     switch (buf[0]) {
-      case OP_JPEG: this._onJpegStripe(buf); break;
-      case OP_H264: this._onH264Stripe(buf); break;
+      case OP_JPEG:
+      case OP_H264:
+        if (!this.rtcMode) this._ensureSink().push(buf);
+        break;
       case OP_AUDIO: if (this.audio) this.audio.push(buf); break;
       case OP_GZ: this._onGzControl(buf); break;
     }
@@ -306,87 +316,6 @@ class SelkiesClient {
     const stream = new Blob([buf.subarray(1)]).stream()
       .pipeThrough(new DecompressionStream("gzip"));
     this._onText(await new Response(stream).text());
-  }
-
-  /* 6-byte header: [0x03, flags, u16 frame_id, u16 stripe_y] + JFIF */
-  async _onJpegStripe(buf) {
-    const dv = new DataView(buf.buffer, buf.byteOffset, 6);
-    const fid = dv.getUint16(2), y = dv.getUint16(4);
-    const last = this.stripeLastFid.get(y);
-    if (last !== undefined && !fidNewer(fid, last)) return; // stale stripe
-    if (this.decodeQueue > 48) return;  // overload: drop, keyframe recovers
-    this.decodeQueue++;
-    try {
-      const blob = new Blob([buf.subarray(6)], { type: "image/jpeg" });
-      const bmp = await createImageBitmap(blob);
-      const l2 = this.stripeLastFid.get(y);
-      if (l2 === undefined || fidNewer(fid, l2) || fid === l2) {
-        this.stripeLastFid.set(y, fid);
-        this.ctx.drawImage(bmp, 0, y);   // canvas crops right/bottom padding
-        this.stripesDrawn++;
-        this._ackFrame(fid);
-      }
-      bmp.close();
-    } catch (e) {
-      console.warn("jpeg stripe decode failed", e);
-    } finally {
-      this.decodeQueue--;
-    }
-  }
-
-  /* 10-byte header: [0x04, frame_type, u16 fid, u16 y, u16 w, u16 h] +
-   * Annex-B. Every stripe row is an independent H.264 stream with its own
-   * decoder keyed by y_start (reference selkies-ws-core.js:4424-4460). */
-  _onH264Stripe(buf) {
-    if (typeof VideoDecoder === "undefined") {
-      if (!this._h264warned) {
-        this._h264warned = true;
-        this.status("WebCodecs H.264 unsupported in this browser", true);
-      }
-      return;
-    }
-    const dv = new DataView(buf.buffer, buf.byteOffset, 10);
-    const fid = dv.getUint16(2), y = dv.getUint16(4);
-    if (!this.h264Decoders) this.h264Decoders = new Map();
-    let dec = this.h264Decoders.get(y);
-    if (!dec || dec.state === "closed") {
-      const yTop = y;
-      dec = new VideoDecoder({
-        output: (frame) => {
-          this.ctx.drawImage(frame, 0, yTop);
-          this.stripesDrawn++;
-          this._ackFrame(frame.timestamp & 0xFFFF);
-          frame.close();
-        },
-        error: (e) => {
-          console.warn("h264 stripe decoder error", e);
-          this.h264Decoders.delete(yTop);
-          this._requestKeyframeThrottled();
-        },
-      });
-      // Annex-B stream (no description): constrained baseline, or
-      // Hi444PP when the server streams fullcolor 4:4:4 (the reference's
-      // f4001f profile munge)
-      const st = (this.serverSettings && this.serverSettings.settings) || {};
-      const fullcolor = !!(st.fullcolor && st.fullcolor.value);
-      dec.configure({ codec: fullcolor ? "avc1.f4002a" : "avc1.42c02a",
-                      optimizeForLatency: true });
-      this.h264Decoders.set(y, dec);
-    }
-    if (dec.decodeQueueSize > 16) {
-      // overload: drop the stripe, but ask for a refresh — the server's
-      // damage gating believes it was delivered and would otherwise leave
-      // this region stale until the next change. THROTTLED: an unthrottled
-      // request per dropped stripe re-forces full-frame IDRs every frame
-      // and locks the overloaded client into a feedback loop.
-      this._requestKeyframeThrottled();
-      return;
-    }
-    dec.decode(new EncodedVideoChunk({
-      type: buf[1] === 1 ? "key" : "delta",   // frame_type from the header
-      timestamp: fid,
-      data: buf.subarray(10),
-    }));
   }
 
   _ackFrame(fid) {
@@ -416,11 +345,15 @@ class SelkiesClient {
       case "system_stats": this._showStats(rest); break;
       case "gpu_stats": this._showGpuStats(rest); break;
       case "cursor": this._applyCursor(rest); break;
-      case "VIDEO_STARTED": this.videoActive = true; break;
+      case "VIDEO_STARTED":
+        this.videoActive = true;
+        this.videoStartedAt = performance.now();
+        break;
       case "VIDEO_STOPPED": this.videoActive = false; break;
       case "AUDIO_DISABLED": if (this.audio) { this.audio.close(); this.audio = null; } break;
       case "settings_applied": break;
       case "clipboard": this._applyClipboard(rest); break;
+      case "system_msg": this.status(rest); break;
       case "KILL":
         this.killed = true;
         this.status("session terminated by server", true);
@@ -435,22 +368,18 @@ class SelkiesClient {
     let payload;
     try { payload = JSON.parse(json); } catch { return; }
     this.serverSettings = payload;
+    const st = payload.settings || {};
+    this._ensureSink().setFullcolor(!!(st.fullcolor && st.fullcolor.value));
     const d = (payload.displays && payload.displays[0]) || {};
     if (d.width && (d.width !== this.displayW || d.height !== this.displayH)) {
       this.displayW = d.width; this.displayH = d.height;
-      this.canvas.width = d.width; this.canvas.height = d.height;
-      this.stripeLastFid.clear();
-      if (this.h264Decoders) {   // stripe geometry changed: fresh decoders
-        for (const dec of this.h264Decoders.values()) {
-          try { dec.close(); } catch { /* already closed */ }
-        }
-        this.h264Decoders.clear();
-      }
+      this.sink.resize(d.width, d.height);
       this.send("REQUEST_KEYFRAME");
     }
     document.title = `${payload.app_name || "Selkies TPU"} — ${d.width}x${d.height}`;
     if (!this.videoActive) {
       this.send("START_VIDEO");
+      this.videoStartedAt = performance.now();
       if (payload.features && payload.features.audio) {
         if (!this.audio) this.audio = new AudioPlayer(payload);
         this.send("START_AUDIO");
@@ -488,7 +417,8 @@ class SelkiesClient {
         .map(([d, f]) => `${d}:${f.toFixed(0)}`).join(" ");
       this.status(
         `${this.displayW}x${this.displayH} · encode ${enc} fps · ` +
-        `draw ${this._drawFps.toFixed(0)} fps · cpu ${s.cpu_percent}%`);
+        `draw ${this._drawFps.toFixed(0)} fps · ` +
+        `sink ${this.sink ? this.sink.mode : "rtc"} · cpu ${s.cpu_percent}%`);
       this._postToDashboard({ type: "systemStats", payload: s });
     } catch { /* ignore */ }
   }
@@ -509,437 +439,25 @@ class SelkiesClient {
     this.__drawFps = this.framesDrawn / Math.max(dt, 1e-3);
     this.framesDrawn = 0;
     this.lastStatsT = now;
-    if (this.videoActive) this.send(`_f,${this.__drawFps.toFixed(1)}`);
-  }
-
-  /* --------------------------------------------------------------- input */
-  _bindInput() {
-    const cv = this.canvas;
-    cv.addEventListener("contextmenu", (e) => e.preventDefault());
-
-    cv.addEventListener("keydown", (e) => {
-      const ks = keysymOf(e);
-      if (ks === null) return;
-      e.preventDefault();
-      if (!e.repeat) { this.held.add(ks); this.send(`kd,${ks}`); }
-    });
-    cv.addEventListener("keyup", (e) => {
-      const ks = keysymOf(e);
-      if (ks === null) return;
-      e.preventDefault();
-      this.held.delete(ks);
-      this.send(`ku,${ks}`);
-    });
-    cv.addEventListener("blur", () => {
-      if (this.held.size) { this.held.clear(); this.send("kr,"); }
-    });
-
-    const scale = (e) => {
-      const r = cv.getBoundingClientRect();
-      const x = Math.round((e.clientX - r.left) * (cv.width / r.width));
-      const y = Math.round((e.clientY - r.top) * (cv.height / r.height));
-      return [Math.max(0, Math.min(cv.width - 1, x)),
-              Math.max(0, Math.min(cv.height - 1, y))];
-    };
-    cv.addEventListener("mousemove", (e) => {
-      if (this.pointerLocked) this.send(`m2,${e.movementX},${e.movementY}`);
-      else { const [x, y] = scale(e); this.send(`m,${x},${y}`); }
-    });
-    const btnMap = { 0: 1, 1: 2, 2: 3, 3: 8, 4: 9 };  // DOM -> X11
-    cv.addEventListener("mousedown", (e) => {
-      cv.focus();
-      const [x, y] = scale(e);
-      this.send(`m,${x},${y}`);
-      this.send(`mb,${btnMap[e.button] ?? 1},1`);
-      e.preventDefault();
-    });
-    cv.addEventListener("mouseup", (e) => {
-      this.send(`mb,${btnMap[e.button] ?? 1},0`);
-      e.preventDefault();
-    });
-    cv.addEventListener("wheel", (e) => {
-      const dy = Math.sign(e.deltaY), dx = Math.sign(e.deltaX);
-      if (dx || dy) this.send(`ms,${dx},${dy}`);
-      e.preventDefault();
-    }, { passive: false });
-
-    document.addEventListener("pointerlockchange", () => {
-      this.pointerLocked = document.pointerLockElement === cv;
-    });
-    cv.addEventListener("dblclick", () => {
-      // double-click toggles pointer lock for games needing relative mouse
-      if (!this.pointerLocked && cv.requestPointerLock) cv.requestPointerLock();
-    });
-
-    document.addEventListener("visibilitychange", () => {
-      if (!this.ws || this.ws.readyState !== WebSocket.OPEN) return;
-      if (document.hidden) this.send("STOP_VIDEO");
-      else { this.send("START_VIDEO"); this.send("REQUEST_KEYFRAME"); }
-    });
-
-    document.addEventListener("paste", async (e) => {
-      const text = e.clipboardData && e.clipboardData.getData("text");
-      if (text) this.send(`cw,${btoa(unescape(encodeURIComponent(text)))}`);
-    });
-    document.addEventListener("copy", () => {
-      // fetch the REMOTE clipboard; delayed so the forwarded Ctrl+C
-      // keystroke reaches the remote app BEFORE the server reads its
-      // selection (otherwise the reply is the previous clipboard)
-      setTimeout(() => this.send("REQUEST_CLIPBOARD"), 150);
-    });
-
-    window.addEventListener("message", (e) => this._onDashboardMessage(e));
-    this._bindGamepad();
-    this._bindTouch(cv);
-    this._bindUpload(cv);
-    this._detectKeyboardLayout();
-  }
-
-  /* ------------------------------------------------------ layout detect
-   * Best-effort layout detection (reference lib/keyboard-layout.js):
-   * probe the physical-key layout map, fall back to the UI language, and
-   * tell the server so it can align the X keymap for scancode-reading
-   * apps (character input is already layout-independent via keysyms). */
-  async _detectKeyboardLayout() {
-    let layout = "";
-    try {
-      if (navigator.keyboard && navigator.keyboard.getLayoutMap) {
-        const map = await navigator.keyboard.getLayoutMap();
-        const probe = [map.get("KeyQ"), map.get("KeyW"), map.get("KeyZ")]
-          .join("");
-        layout = { qwz: "us", azw: "fr", qwy: "de" }[probe] || "";
-      }
-    } catch (_e) { /* permissions / unsupported */ }
-    if (!layout) {
-      const lang = (navigator.language || "en-US").toLowerCase();
-      layout = { fr: "fr", de: "de", es: "es", it: "it", pt: "pt",
-                 ru: "ru", gb: "gb" }[lang.split("-")[0]] || "us";
-    }
-    this._kbLayout = layout;
-    const sendIt = () => this.send(
-      `SETTINGS,${JSON.stringify({ keyboard_layout: layout })}`);
-    if (this.ws && this.ws.readyState === WebSocket.OPEN) sendIt();
-    else this._pendingLayout = sendIt;
-  }
-
-  /* --------------------------------------------------- on-screen keyboard
-   * Minimal OSK for touch devices (reference lib/input.js OSK): a
-   * toggleable overlay whose buttons fire the same kd/ku verbs. */
-  toggleOnScreenKeyboard() {
-    if (this._osk) {
-      this._osk.remove();
-      this._osk = null;
-      return;
-    }
-    const rows = [
-      ["Esc:65307", "1", "2", "3", "4", "5", "6", "7", "8", "9", "0",
-       "⌫:65288"],
-      ["q", "w", "e", "r", "t", "y", "u", "i", "o", "p"],
-      ["a", "s", "d", "f", "g", "h", "j", "k", "l", "⏎:65293"],
-      ["⇧:65505", "z", "x", "c", "v", "b", "n", "m", ",", "."],
-      ["Ctrl:65507", "Alt:65513", "␣:32", "←:65361", "↓:65364",
-       "↑:65362", "→:65363"],
-    ];
-    const osk = document.createElement("div");
-    osk.style.cssText =
-      "position:fixed;bottom:0;left:0;right:0;background:#222d;" +
-      "padding:6px;z-index:1000;display:flex;flex-direction:column;" +
-      "gap:4px;touch-action:none";
-    for (const row of rows) {
-      const line = document.createElement("div");
-      line.style.cssText = "display:flex;gap:4px;justify-content:center";
-      for (const keydef of row) {
-        const [label, ksStr] = keydef.includes(":")
-          ? keydef.split(":") : [keydef, null];
-        const ks = ksStr ? parseInt(ksStr, 10)
-          : label.codePointAt(0);
-        const b = document.createElement("button");
-        b.textContent = label;
-        b.style.cssText =
-          "flex:1;max-width:72px;padding:10px 4px;font-size:16px;" +
-          "background:#444;color:#eee;border:1px solid #666;" +
-          "border-radius:4px";
-        const down = (e) => { e.preventDefault(); this.send(`kd,${ks}`); };
-        const up = (e) => { e.preventDefault(); this.send(`ku,${ks}`); };
-        b.addEventListener("pointerdown", down);
-        b.addEventListener("pointerup", up);
-        b.addEventListener("pointerleave", up);
-        line.appendChild(b);
-      }
-      osk.appendChild(line);
-    }
-    document.body.appendChild(osk);
-    this._osk = osk;
-  }
-
-  /* ------------------------------------------------------------- gamepad
-   * navigator.getGamepads() polling -> js,c/d/b/a verbs (the server half
-   * feeds the C interposer sockets; reference lib/gamepad.js:1-229). */
-  _bindGamepad() {
-    this.padState = new Map();          // index -> {buttons:[], axes:[]}
-    window.addEventListener("gamepadconnected", (e) => {
-      const p = e.gamepad;
-      if (p.index > 3) return;
-      this.padState.set(p.index, { buttons: [], axes: [] });
-      this.send(`js,c,${p.index},${p.id.slice(0, 64)}`);
-      if (!this._padTimer) this._padTimer = setInterval(
-        () => this._pollGamepads(), 16);
-    });
-    window.addEventListener("gamepaddisconnected", (e) => {
-      if (!this.padState.delete(e.gamepad.index)) return;
-      this.send(`js,d,${e.gamepad.index}`);
-      if (this.padState.size === 0 && this._padTimer) {
-        clearInterval(this._padTimer);
-        this._padTimer = null;
-      }
-    });
-  }
-
-  _pollGamepads() {
-    const pads = navigator.getGamepads ? navigator.getGamepads() : [];
-    for (const p of pads) {
-      if (!p || !this.padState.has(p.index)) continue;
-      const st = this.padState.get(p.index);
-      p.buttons.forEach((b, i) => {
-        const v = b.pressed ? 1 : 0;
-        if (st.buttons[i] !== v) {
-          st.buttons[i] = v;
-          this.send(`js,b,${p.index},${i},${v}`);
-        }
-      });
-      p.axes.forEach((a, i) => {
-        const v = Math.round(a * 1000) / 1000;
-        if (Math.abs((st.axes[i] ?? 0) - v) > 0.009) {
-          st.axes[i] = v;
-          this.send(`js,a,${p.index},${i},${v}`);
-        }
-      });
-    }
-  }
-
-  /* --------------------------------------------------------------- touch
-   * Touch-to-mouse: one finger = absolute move + left button; two-finger
-   * vertical pan = wheel; two-finger tap = right click (reference
-   * lib/input.js touch mode). */
-  _bindTouch(cv) {
-    const scaleT = (t) => {
-      const r = cv.getBoundingClientRect();
-      const x = Math.round((t.clientX - r.left) * (cv.width / r.width));
-      const y = Math.round((t.clientY - r.top) * (cv.height / r.height));
-      return [Math.max(0, Math.min(cv.width - 1, x)),
-              Math.max(0, Math.min(cv.height - 1, y))];
-    };
-    // tap-vs-gesture disambiguation: the left press is DEFERRED 60 ms
-    // so a second finger (scroll/right-click gesture) can cancel it —
-    // otherwise every two-finger gesture starts with a phantom click
-    let twoFinger = null;               // {y, moved, t0}
-    let pendingPress = null;            // timer id
-    let pressed = false;
-    const commitPress = () => {
-      if (pendingPress !== null) {
-        clearTimeout(pendingPress);
-        pendingPress = null;
-        this.send("mb,1,1");
-        pressed = true;
-      }
-    };
-    cv.addEventListener("touchstart", (e) => {
-      e.preventDefault();
-      if (this.touchMode === "trackpad") {
-        this._trackpadStart(e);
-        return;
-      }
-      if (e.touches.length === 1) {
-        const [x, y] = scaleT(e.touches[0]);
-        this.send(`m,${x},${y}`);
-        pendingPress = setTimeout(commitPress, 60);
-      } else if (e.touches.length === 2) {
-        if (pendingPress !== null) {    // gesture: cancel the tap press
-          clearTimeout(pendingPress);
-          pendingPress = null;
-        } else if (pressed) {
-          this.send("mb,1,0");
-          pressed = false;
-        }
-        twoFinger = { y: e.touches[0].clientY, moved: false,
-                      t0: performance.now() };
-      }
-    }, { passive: false });
-    cv.addEventListener("touchmove", (e) => {
-      e.preventDefault();
-      if (this.touchMode === "trackpad") {
-        this._trackpadMove(e);
-        return;
-      }
-      if (e.touches.length === 1 && !twoFinger) {
-        commitPress();                  // moving finger = drag, press now
-        const [x, y] = scaleT(e.touches[0]);
-        this.send(`m,${x},${y}`);
-      } else if (e.touches.length === 2 && twoFinger) {
-        const dy = e.touches[0].clientY - twoFinger.y;
-        if (Math.abs(dy) > 12) {
-          this.send(`ms,0,${dy > 0 ? -1 : 1}`);
-          twoFinger.y = e.touches[0].clientY;
-          twoFinger.moved = true;
-        }
-      }
-    }, { passive: false });
-    cv.addEventListener("touchend", (e) => {
-      e.preventDefault();
-      if (this.touchMode === "trackpad") {
-        this._trackpadEnd(e);
-        return;
-      }
-      if (twoFinger) {
-        if (!twoFinger.moved && performance.now() - twoFinger.t0 < 350) {
-          this.send("mb,3,1");          // two-finger tap = right click
-          this.send("mb,3,0");
-          twoFinger.moved = true;       // fire once, not per lifted finger
-        }
-        if (e.touches.length === 0) twoFinger = null;
-      } else if (e.touches.length === 0) {
-        if (pendingPress !== null) {    // quick tap: full click now
-          commitPress();
-        }
-        if (pressed) {
-          this.send("mb,1,0");
-          pressed = false;
-        }
-      }
-    }, { passive: false });
-  }
-
-  /* trackpad touch mode (reference lib/input.js trackpad mode): the
-   * canvas is a laptop touchpad — one finger moves the cursor
-   * RELATIVELY (m2 verbs), a quick tap left-clicks, a one-finger
-   * tap-then-drag drags, two-finger pan scrolls, two-finger tap
-   * right-clicks. Switch via postMessage {type:"touchMode"}. */
-  _trackpadStart(e) {
-    const t = e.touches;
-    const now = performance.now();
-    if (t.length === 1) {
-      const tapTap = this._tpLastTap && now - this._tpLastTap < 280;
-      this._tp = { x: t[0].clientX, y: t[0].clientY, t0: now,
-                   moved: false, drag: !!tapTap };
-      if (tapTap) this.send("mb,1,1");       // tap-drag: hold the button
-    } else if (t.length === 2) {
-      // both fingers may land in ONE touchstart (fast two-finger tap):
-      // synthesize the missing one-finger state so the gesture works
-      if (!this._tp)
-        this._tp = { x: t[0].clientX, y: t[0].clientY, t0: now,
-                     moved: false, drag: false };
-      if (this._tp.drag) { this.send("mb,1,0"); this._tp.drag = false; }
-      this._tp.two = { y: t[0].clientY, t0: now, moved: this._tp.moved };
-    }
-  }
-
-  _trackpadMove(e) {
-    const t = e.touches;
-    if (!this._tp) return;
-    if (t.length === 1 && !this._tp.two) {
-      const dx = Math.round((t[0].clientX - this._tp.x) * 1.4);
-      const dy = Math.round((t[0].clientY - this._tp.y) * 1.4);
-      if (dx || dy) {
-        this.send(`m2,${dx},${dy}`);
-        this._tp.x = t[0].clientX;
-        this._tp.y = t[0].clientY;
-        this._tp.moved = true;
-      }
-    } else if (t.length === 2 && this._tp.two) {
-      const dy = t[0].clientY - this._tp.two.y;
-      if (Math.abs(dy) > 12) {
-        this.send(`ms,0,${dy > 0 ? -1 : 1}`);
-        this._tp.two.y = t[0].clientY;
-        this._tp.two.moved = true;
+    if (this.videoActive) {
+      this.send(`_f,${this.__drawFps.toFixed(1)}`);
+      // cold-start UX: the first TPU compile of a new geometry can take
+      // minutes — say so instead of leaving a silent black screen
+      if (!this.everDrawn && this.videoStartedAt &&
+          now - this.videoStartedAt > 3000 && !this.rtcMode) {
+        const s = Math.round((now - this.videoStartedAt) / 1000);
+        this.status(`server is compiling the encoder for this geometry ` +
+                    `(first run can take minutes)… ${s}s`);
       }
     }
-  }
-
-  _trackpadEnd(e) {
-    if (!this._tp) return;
-    const now = performance.now();
-    if (this._tp.two) {
-      if (!this._tp.two.moved && now - this._tp.two.t0 < 350) {
-        this.send("mb,3,1");
-        this.send("mb,3,0");
-        this._tp.two.moved = true;
-      }
-      if (e.touches.length === 0) this._tp = null;
-      return;
-    }
-    if (e.touches.length === 0) {
-      if (this._tp.drag) this.send("mb,1,0");
-      else if (!this._tp.moved && now - this._tp.t0 < 250) {
-        this.send("mb,1,1");
-        this.send("mb,1,0");
-        this._tpLastTap = now;
-      }
-      this._tp = null;
-    }
-  }
-
-  /* -------------------------------------------------------------- upload
-   * Drag-drop -> chunked POST /api/upload with the X-Upload-* resume
-   * protocol the server already speaks (reference lib/file-upload.js). */
-  _bindUpload(cv) {
-    const stop = (e) => { e.preventDefault(); e.stopPropagation(); };
-    ["dragenter", "dragover"].forEach((ev) =>
-      cv.addEventListener(ev, stop));
-    cv.addEventListener("drop", async (e) => {
-      stop(e);
-      const files = [...(e.dataTransfer ? e.dataTransfer.files : [])];
-      for (const f of files) {
-        try {
-          await this.uploadFile(f);
-          this._post({ type: "uploadDone", name: f.name });
-        } catch (err) {
-          this._post({ type: "uploadError", name: f.name,
-                       error: String(err) });
-        }
-      }
-    });
-  }
-
-  async uploadFile(file, chunkBytes = 1 << 20) {
-    for (let off = 0; off < file.size || off === 0; off += chunkBytes) {
-      const chunk = file.slice(off, off + chunkBytes);
-      const r = await fetch("/api/upload", {
-        method: "POST",
-        headers: {
-          // headers are Latin-1 only: percent-encode, server decodes
-          "X-Upload-Name": encodeURIComponent(file.name),
-          "X-Upload-Offset": String(off),
-          "X-Upload-Total": String(file.size),
-        },
-        body: chunk,
-        credentials: "same-origin",
-      });
-      if (!r.ok) throw new Error(`upload ${file.name}: HTTP ${r.status}`);
-      this._post({ type: "uploadProgress", name: file.name,
-                   sent: Math.min(off + chunkBytes, file.size),
-                   total: file.size });
-      if (file.size === 0) break;
-    }
-  }
-
-  _post(msg) {
-    try {
-      (window.parent || window).postMessage(
-        Object.assign({ scope: "selkies" }, msg), "*");
-    } catch (_e) { /* sandboxed parent */ }
-  }
-
-  _heartbeat() {
-    if (this.held.size)
-      this.send(`kh,${Array.from(this.held).join(",")}`);
   }
 
   /* -------------------------------------------------------------- resize */
   _bindResize() {
     let timer = null;
     window.addEventListener("resize", () => {
-      if (this.rtcMode)                         // keep the overlay aligned
-        requestAnimationFrame(() => this._syncRtcCanvas());
+      if (this.videoEl)                        // keep the overlay aligned
+        requestAnimationFrame(() => this._syncOverlay());
       clearTimeout(timer);
       timer = setTimeout(() => this._sendPreferredSize(), 500);
     });
@@ -964,6 +482,13 @@ class SelkiesClient {
       window.parent.postMessage({ selkies: true, ...msg }, location.origin);
   }
 
+  _post(msg) {
+    try {
+      (window.parent || window).postMessage(
+        Object.assign({ scope: "selkies" }, msg), "*");
+    } catch (_e) { /* sandboxed parent */ }
+  }
+
   _onDashboardMessage(e) {
     if (e.origin !== location.origin || !e.data || e.data.selkies !== true)
       return;
@@ -984,14 +509,16 @@ class SelkiesClient {
       case "getStats":
         this._postToDashboard({
           type: "stats",
-          payload: { drawFps: this._drawFps, display: [this.displayW, this.displayH] },
+          payload: { drawFps: this._drawFps,
+                     sink: this.sink ? this.sink.mode : "rtc",
+                     display: [this.displayW, this.displayH] },
         });
         break;
       case "videoBitrate": this.send(`vb,${d.kbps | 0}`); break;
       case "audioBitrate": this.send(`ab,${d.bps | 0}`); break;
-      case "toggleOsk": this.toggleOnScreenKeyboard(); break;
+      case "toggleOsk": this.input.toggleOnScreenKeyboard(); break;
       case "touchMode":
-        this.touchMode = d.mode === "trackpad" ? "trackpad" : "direct";
+        this.input.touchMode = d.mode === "trackpad" ? "trackpad" : "direct";
         break;
       case "clipboard":
         if (typeof d.text === "string")
@@ -1018,7 +545,7 @@ class SelkiesClient {
       this.status("microphone disabled by server", true);
       return;
     }
-    const mic = new MicSender(this);
+    const mic = new MicSender((u8) => this.sendBytes(u8));
     try {
       await mic.start();
       this.mic = mic;
@@ -1047,154 +574,6 @@ class SelkiesClient {
       span.textContent = msg;
       this.hud.appendChild(span);
     }
-  }
-}
-
-/* ---------------------------------------------------------------- audio
- * Opus over 0x01 frames -> WebCodecs AudioDecoder -> WebAudio graph.
- * RED (RFC 2198) redundancy is de-framed; redundant blocks are only decoded
- * when a gap is detected (reference client extractOpusFrames,
- * selkies-ws-core.js:36-38). */
-class AudioPlayer {
-  constructor(serverSettings) {
-    const st = serverSettings.settings || {};
-    this.sampleRate = 48000;
-    this.channels = (st.audio_channels && st.audio_channels.value) || 2;
-    this.frameMs = (st.audio_frame_ms && st.audio_frame_ms.value) || 10;
-    this.ctx = new AudioContext({ sampleRate: this.sampleRate });
-    this.playhead = 0;
-    this.tsUs = 0;
-    this.queueTarget = 5 * this.frameMs / 1000;  // ≤5 frames client buffer
-    this.dec = null;
-    this._initDecoder();
-  }
-
-  _initDecoder() {
-    if (typeof AudioDecoder === "undefined") return;
-    this.dec = new AudioDecoder({
-      output: (ad) => this._play(ad),
-      error: (e) => console.warn("audio decode", e),
-    });
-    this.dec.configure({
-      codec: "opus", sampleRate: this.sampleRate,
-      numberOfChannels: this.channels,
-    });
-  }
-
-  push(buf) {
-    if (!this.dec || this.dec.state !== "configured") return;
-    const nRed = buf[1];
-    let payload = buf.subarray(2);
-    if (nRed > 0) {
-      // RED: u32 pts + nRed*4-byte block hdrs + 1-byte primary hdr + blocks
-      let off = 4 + nRed * 4 + 1;
-      const dv = new DataView(buf.buffer, buf.byteOffset + 2);
-      let skip = 0;
-      for (let i = 0; i < nRed; i++)
-        skip += dv.getUint32(4 + i * 4) & 0x3FF;   // 10-bit block length
-      payload = payload.subarray(off + skip);       // primary block only
-    }
-    if (!payload.length) return;
-    this.dec.decode(new EncodedAudioChunk({
-      type: "key", timestamp: this.tsUs, data: payload,
-    }));
-    this.tsUs += this.frameMs * 1000;
-  }
-
-  _play(ad) {
-    const n = ad.numberOfFrames, ch = ad.numberOfChannels;
-    const buf = this.ctx.createBuffer(ch, n, ad.sampleRate);
-    for (let c = 0; c < ch; c++) {
-      const dst = buf.getChannelData(c);
-      ad.copyTo(dst, { planeIndex: c, format: "f32-planar" });
-    }
-    ad.close();
-    const now = this.ctx.currentTime;
-    if (this.playhead < now) this.playhead = now + 0.01;
-    if (this.playhead - now > this.queueTarget * 3) {
-      this.playhead = now + this.queueTarget;  // queue ran away: resync
-    }
-    const src = this.ctx.createBufferSource();
-    src.buffer = buf;
-    src.connect(this.ctx.destination);
-    src.start(this.playhead);
-    this.playhead += buf.duration;
-  }
-
-  close() {
-    if (this.dec) try { this.dec.close(); } catch { /* already closed */ }
-    this.ctx.close();
-  }
-}
-
-/* ------------------------------------------------------------------- mic
- * Capture path: the AudioContext resamples the getUserMedia track to
- * 24 kHz; an AudioWorklet batches ~20 ms (480-sample) mono chunks that
- * are sent as [0x02][s16le PCM] frames — the exact format
- * audio/pipeline.play_mic_pcm feeds pacat. */
-class MicSender {
-  constructor(client) {
-    this.client = client;
-    this.ctx = null;
-    this.node = null;
-    this.stream = null;
-  }
-
-  async start() {
-    this.stream = await navigator.mediaDevices.getUserMedia({
-      audio: { channelCount: 1, echoCancellation: true,
-               noiseSuppression: true },
-    });
-    this.ctx = new AudioContext({ sampleRate: 24000 });
-    const src = `registerProcessor("selkies-mic",
-      class extends AudioWorkletProcessor {
-        process(inputs) {
-          const ch = inputs[0] && inputs[0][0];
-          if (ch && ch.length) this.port.postMessage(ch.slice(0));
-          return true;
-        }
-      });`;
-    const url = URL.createObjectURL(
-      new Blob([src], { type: "application/javascript" }));
-    try {
-      await this.ctx.audioWorklet.addModule(url);
-    } finally {
-      URL.revokeObjectURL(url);
-    }
-    const input = this.ctx.createMediaStreamSource(this.stream);
-    this.node = new AudioWorkletNode(this.ctx, "selkies-mic");
-    this._chunks = [];
-    this._n = 0;
-    this.node.port.onmessage = (e) => this._onChunk(e.data);
-    input.connect(this.node);
-    /* no destination connection: capture-only graph */
-  }
-
-  _onChunk(f32) {
-    this._chunks.push(f32);
-    this._n += f32.length;
-    if (this._n < 480) return;                    // ~20 ms at 24 kHz
-    const all = new Float32Array(this._n);
-    let o = 0;
-    for (const c of this._chunks) { all.set(c, o); o += c.length; }
-    this._chunks = [];
-    this._n = 0;
-    const frame = new Uint8Array(1 + all.length * 2);
-    frame[0] = OP_MIC;
-    const dv = new DataView(frame.buffer);
-    for (let i = 0; i < all.length; i++) {
-      const s = Math.max(-1, Math.min(1, all[i]));
-      dv.setInt16(1 + i * 2, s < 0 ? s * 0x8000 : s * 0x7FFF, true);
-    }
-    this.client.sendBytes(frame);
-  }
-
-  stop() {
-    if (this.node) { try { this.node.disconnect(); } catch { /* gone */ } }
-    if (this.ctx) this.ctx.close();
-    if (this.stream)
-      for (const t of this.stream.getTracks()) t.stop();
-    this.node = this.ctx = this.stream = null;
   }
 }
 

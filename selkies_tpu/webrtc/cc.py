@@ -397,6 +397,7 @@ class SendSideCongestionController:
         self._aimd = AimdRateControl(start_bps=start_bps,
                                      max_bps=ceiling_bps)
         self._loss = LossController(ceiling_bps)
+        self._evicted_lost = 0
         self.target_bps = start_bps
         self.last_loss_fraction = 0.0
 
@@ -410,7 +411,13 @@ class SendSideCongestionController:
         self._sent[seq] = (now_us, size)
         while len(self._sent) > 4096:
             old_seq, _ = self._sent.popitem(last=False)
-            self._missing.pop(old_seq, None)
+            # a packet evicted while still marked missing really was
+            # lost; silently dropping it made the sliding-window loss
+            # fraction underestimate under sustained heavy loss at high
+            # send rates, so the 0.7x backoff could fail to fire
+            # (ADVICE r4)
+            if self._missing.pop(old_seq, None) is not None:
+                self._evicted_lost += 1
 
     # -- feedback -----------------------------------------------------------
     def on_feedback(self, fb: TwccFeedback, now_us: int) -> float:
@@ -440,6 +447,8 @@ class SendSideCongestionController:
             if self._sent.pop(seq, None) is not None:
                 lost += 1
         self._trend.flush()
+        lost += self._evicted_lost
+        self._evicted_lost = 0
         self._loss_window.append((now_us, received, lost))
         lo = now_us - self.LOSS_WINDOW_US
         while self._loss_window and self._loss_window[0][0] < lo:

@@ -47,6 +47,7 @@ ATTR_NONCE = 0x0015
 ATTR_XOR_RELAYED_ADDRESS = 0x0016
 ATTR_REQUESTED_TRANSPORT = 0x0019
 ATTR_USERNAME = 0x0006
+ATTR_MESSAGE_INTEGRITY = 0x0008
 ATTR_ERROR_CODE = 0x0009
 
 UDP_TRANSPORT = 17
@@ -142,9 +143,20 @@ class TurnClient(asyncio.DatagramProtocol):
             if peer and payload is not None and self.on_data is not None:
                 self.on_data(payload, peer)
             return
-        fut = self._pending.pop(msg.txid, None)
-        if fut is not None and not fut.done():
-            fut.set_result(msg)
+        fut = self._pending.get(msg.txid)
+        if fut is None or fut.done():
+            return
+        # Once the realm is known every request we send is integrity-
+        # protected, so a response that carries MESSAGE-INTEGRITY must
+        # verify against the long-term key — otherwise an off-path
+        # attacker who observed the txid could inject a bogus relayed
+        # address or nonce (ADVICE r4).
+        if self.realm and msg.attr(ATTR_MESSAGE_INTEGRITY) is not None \
+                and not msg.check_integrity(self._lt_key()):
+            logger.warning("turn response failed integrity check; dropped")
+            return
+        self._pending.pop(msg.txid, None)
+        fut.set_result(msg)
 
     # -- auth ---------------------------------------------------------------
     def _lt_key(self) -> bytes:
@@ -160,17 +172,30 @@ class TurnClient(asyncio.DatagramProtocol):
 
     async def _request(self, msg: StunMessage, authed: bool,
                        timeout: float = 5.0) -> StunMessage:
+        """Send a request, retransmitting with a doubling RTO (RFC 5389
+        §7.2.1) so a single lost datagram doesn't downgrade the session
+        to direct-path-only (ADVICE r4)."""
         if self._transport is None:
             raise TurnError("not connected")
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg.txid] = fut
         key = self._lt_key() if authed else None
-        self._transport.sendto(msg.to_bytes(integrity_key=key))
+        wire = msg.to_bytes(integrity_key=key)
+        rto = 0.5
+        remaining = timeout
         try:
-            return await asyncio.wait_for(fut, timeout)
-        except asyncio.TimeoutError:
-            self._pending.pop(msg.txid, None)
+            while remaining > 0:
+                self._transport.sendto(wire)
+                wait = min(rto, remaining)
+                try:
+                    return await asyncio.wait_for(
+                        asyncio.shield(fut), wait)
+                except asyncio.TimeoutError:
+                    remaining -= wait
+                    rto *= 2
             raise TurnError("turn request timed out")
+        finally:
+            self._pending.pop(msg.txid, None)
 
     async def _authed_request(self, method: int,
                               attrs: list[tuple[int, bytes]]

@@ -540,6 +540,14 @@ import pytest as _pytest
 
 _JS_FILES = (
     ("selkies_tpu", "web", "selkies-client.js"),
+    ("selkies_tpu", "web", "lib", "protocol.js"),
+    ("selkies_tpu", "web", "lib", "keysyms.js"),
+    ("selkies_tpu", "web", "lib", "audio.js"),
+    ("selkies_tpu", "web", "lib", "input.js"),
+    ("selkies_tpu", "web", "lib", "upload.js"),
+    ("selkies_tpu", "web", "lib", "video.js"),
+    ("selkies_tpu", "web", "lib", "video-worker.js"),
+    ("selkies_tpu", "web", "lib", "stripe-core.js"),
     ("addons", "universal-touch-gamepad", "universalTouchGamepad.js"),
     ("addons", "selkies-dashboard", "index.html"),
 )
@@ -619,15 +627,19 @@ def test_client_js_delimiters_balanced(parts):
     assert not stack, f"unclosed {stack[-1]!r}"
     if parts[-1] != "selkies-client.js":
         return
-    # the new client features must be present
+    # the client features must be present somewhere in the module graph
+    # (entry + lib/ modules; test_web_client.py checks the graph itself)
+    web = pathlib.Path(__file__).parent.parent / "selkies_tpu" / "web"
+    corpus = "".join(p.read_text() for p in sorted(web.rglob("*.js")))
     for needle in ("js,c,", "js,b,", "js,a,", "getGamepads",
                    "X-Upload-Name", "touchstart",
                    # RTC transport path (server ICE-lite offer -> answer)
                    "RTCPeerConnection", "HELLO client", "SESSION server",
-                   "createDataChannel", "setRemoteDescription"):
-        assert needle in (pathlib.Path(__file__).parent.parent /
-                          "selkies_tpu" / "web" /
-                          "selkies-client.js").read_text(), needle
+                   "createDataChannel", "setRemoteDescription",
+                   # worker-decode / track-generator rendering path
+                   "MediaStreamTrackGenerator", "VideoTrackGenerator",
+                   "transferControlToOffscreen"):
+        assert needle in corpus, needle
 
 
 def test_gpu_stats_drm_sysfs_chain(tmp_path):

@@ -1,0 +1,115 @@
+"""Web client static checks.
+
+No JS engine ships in this image, so these tests guard the ES-module
+client at the import-graph level (a typo'd module path or export name is
+a blank screen in production): every ``import { X } from "./mod.js"``
+must resolve to an existing file that actually exports ``X``, and the
+HTTP server must serve the module files (reference web client:
+addons/selkies-web-core; SURVEY.md §2.3).
+"""
+
+import re
+from pathlib import Path
+
+from selkies_tpu.input.backends import NullBackend
+from selkies_tpu.input.handler import InputHandler
+from selkies_tpu.server.core import CentralizedStreamServer
+from selkies_tpu.server.ws_service import WebSocketsService
+from selkies_tpu.settings import AppSettings
+
+WEB = Path(__file__).resolve().parent.parent / "selkies_tpu" / "web"
+
+IMPORT_RE = re.compile(
+    r'import\s*(?:\{([^}]*)\})?\s*(?:from\s*)?["\'](\./[^"\']+)["\']')
+EXPORT_RE = re.compile(
+    r'export\s+(?:async\s+)?(?:class|function|const|let|var)\s+'
+    r'([A-Za-z_$][\w$]*)')
+
+
+def _imports(path: Path):
+    for m in IMPORT_RE.finditer(path.read_text()):
+        names = [n.strip().split(" as ")[0]
+                 for n in (m.group(1) or "").split(",") if n.strip()]
+        yield m.group(2), names
+
+
+def _exports(path: Path):
+    return set(EXPORT_RE.findall(path.read_text()))
+
+
+def test_entry_module_graph_resolves():
+    entry = WEB / "selkies-client.js"
+    seen = set()
+    stack = [entry]
+    checked_any = False
+    while stack:
+        mod = stack.pop()
+        if mod in seen:
+            continue
+        seen.add(mod)
+        for rel, names in _imports(mod):
+            target = (mod.parent / rel).resolve()
+            assert target.is_file(), f"{mod.name}: import {rel} missing"
+            exported = _exports(target)
+            for n in names:
+                assert n in exported, (
+                    f"{mod.name}: imports {{{n}}} from {rel}, "
+                    f"but {target.name} exports {sorted(exported)}")
+                checked_any = True
+            stack.append(target)
+    assert checked_any, "no named imports found — regex rot?"
+
+
+def test_index_loads_client_as_module():
+    html = (WEB / "index.html").read_text()
+    assert 'type="module"' in html and "selkies-client.js" in html
+
+
+def test_worker_is_classic_with_shared_core():
+    # lib/video-worker.js is a CLASSIC worker (loads where module workers
+    # don't): ES import statements would break it at runtime; the shared
+    # decode core arrives via importScripts instead
+    text = (WEB / "lib" / "video-worker.js").read_text()
+    assert not re.search(r"^\s*import\s", text, re.M)
+    assert 'importScripts("stripe-core.js")' in text
+    assert "SelkiesStripeCore.makeStripeDecoder" in text
+    # the sink must spawn it by the path the server serves
+    video = (WEB / "lib" / "video.js").read_text()
+    assert 'new Worker("lib/video-worker.js")' in video
+    # the main-thread fallback shares the SAME core, loaded by the page
+    assert "window.SelkiesStripeCore.makeStripeDecoder" in video
+    html = (WEB / "index.html").read_text()
+    assert '<script src="lib/stripe-core.js">' in html
+
+
+def test_js_braces_balanced():
+    # crude parse check: balanced braces/parens/brackets outside strings
+    # and comments catches truncated writes and merge damage
+    for path in sorted(WEB.rglob("*.js")):
+        text = re.sub(r"//[^\n]*|/\*.*?\*/", "",
+                      path.read_text(), flags=re.S)
+        text = re.sub(r'"(?:\\.|[^"\\\n])*"'
+                      r"|'(?:\\.|[^'\\\n])*'"
+                      r"|`(?:\\.|[^`\\])*`", '""', text)
+        for o, c in ("{}", "()", "[]"):
+            assert text.count(o) == text.count(c), (
+                f"{path.name}: unbalanced {o}{c} "
+                f"({text.count(o)} vs {text.count(c)})")
+
+
+async def test_server_serves_module_assets(client_factory):
+    s = AppSettings.parse([], {})
+    svc = WebSocketsService(s, input_handler=InputHandler(
+        backend=NullBackend()), capture_factory=lambda: None)
+    server = CentralizedStreamServer(s)
+    server.register_service("websockets", svc)
+    server.register_static()     # run() does this on the real path
+    client = await client_factory(server)
+    for path in ("/lib/video.js", "/lib/video-worker.js",
+                 "/lib/stripe-core.js", "/lib/input.js", "/lib/audio.js",
+                 "/lib/keysyms.js", "/lib/protocol.js", "/lib/upload.js",
+                 "/selkies-client.js"):
+        r = await client.get(path)
+        assert r.status == 200, path
+        body = await r.text()
+        assert body.strip(), path
