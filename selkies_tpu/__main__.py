@@ -51,11 +51,22 @@ async def run(argv=None) -> None:
 
     server = CentralizedStreamServer(settings)
 
-    # Wayland bring-up (reference stream_server.py:420-447): no in-process
-    # compositor here — an external headless compositor (labwc/sway) plays
-    # that role; mirror its socket into the env so every child reaches it
-    if settings.wayland and settings.wayland_host_display:
-        os.environ["WAYLAND_DISPLAY"] = settings.wayland_host_display
+    # Wayland bring-up (reference stream_server.py:420-447
+    # ensure_wayland_display): prefer a live external compositor socket,
+    # else start our OWN headless compositor and supervise it; mirror
+    # the socket into the env so every child reaches it
+    owned_compositor = None
+    wayland_display = None
+    if settings.wayland:
+        from .wayland.compositor import ensure_wayland_display
+        wayland_display, owned_compositor = \
+            await ensure_wayland_display(settings)
+        if wayland_display:
+            os.environ["WAYLAND_DISPLAY"] = wayland_display
+        else:
+            logging.getLogger("selkies_tpu").warning(
+                "wayland requested but no compositor is reachable or "
+                "startable; capture will degrade")
 
     input_handler = None
     if settings.enable_input:
@@ -63,6 +74,7 @@ async def run(argv=None) -> None:
             backend=make_backend(
                 settings.display_id, wayland=settings.wayland,
                 wayland_display=(settings.app_wayland_display
+                                 or wayland_display
                                  or settings.wayland_host_display or None)),
             enable_command_verb=settings.enable_command_verb,
             clipboard_max_bytes=settings.clipboard_max_bytes)
@@ -101,6 +113,8 @@ async def run(argv=None) -> None:
             pass
     await stop.wait()
     await server.shutdown()
+    if owned_compositor is not None:
+        await owned_compositor.stop()
 
 
 def main() -> None:

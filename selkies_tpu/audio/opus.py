@@ -90,6 +90,127 @@ class Encoder:
             pass
 
 
+class MultistreamEncoder:
+    """Surround (>2ch) encoder via the multistream API (reference
+    pcmflux surface, SURVEY §2.2: surround capture). Uses
+    ``opus_multistream_surround_encoder_create`` (mapping family 1,
+    Vorbis channel order) so libopus computes the stream layout; the
+    resulting ``streams/coupled/mapping`` feed :func:`opus_head` for
+    decoders that need the RFC 7845 channel mapping table (browser
+    AudioDecoder takes it as ``description``)."""
+
+    def __init__(self, sample_rate: int = 48000, channels: int = 6,
+                 bitrate: int = 320000, lowdelay: bool = True):
+        lib = _load()
+        if lib is None:
+            raise OpusError("libopus not found")
+        if not hasattr(lib, "opus_multistream_surround_encoder_create"):
+            raise OpusError("libopus lacks the multistream surround API")
+        self._lib = lib
+        self.sample_rate = sample_rate
+        self.channels = channels
+        err = ctypes.c_int(0)
+        streams = ctypes.c_int(0)
+        coupled = ctypes.c_int(0)
+        mapping = (ctypes.c_ubyte * channels)()
+        app = OPUS_APPLICATION_RESTRICTED_LOWDELAY if lowdelay \
+            else OPUS_APPLICATION_AUDIO
+        lib.opus_multistream_surround_encoder_create.restype = \
+            ctypes.c_void_p
+        self._enc = lib.opus_multistream_surround_encoder_create(
+            sample_rate, channels, 1,
+            ctypes.byref(streams), ctypes.byref(coupled), mapping,
+            app, ctypes.byref(err))
+        if err.value != 0 or not self._enc:
+            raise OpusError(
+                f"surround encoder create failed ({err.value})")
+        self.streams = streams.value
+        self.coupled = coupled.value
+        self.mapping = bytes(mapping)
+        self.set_bitrate(bitrate)
+
+    def set_bitrate(self, bps: int) -> None:
+        self._lib.opus_multistream_encoder_ctl(
+            ctypes.c_void_p(self._enc), _OPUS_SET_BITRATE,
+            ctypes.c_int(bps))
+
+    def encode(self, pcm) -> bytes:
+        pcm = np.ascontiguousarray(pcm, np.int16).reshape(-1)
+        frames = pcm.size // self.channels
+        out = np.empty(4000 * max(1, self.streams), np.uint8)
+        n = self._lib.opus_multistream_encode(
+            ctypes.c_void_p(self._enc),
+            pcm.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+            ctypes.c_int(frames),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            ctypes.c_int(out.size))
+        if n < 0:
+            raise OpusError(f"opus_multistream_encode failed ({n})")
+        return out[:n].tobytes()
+
+    def __del__(self):
+        try:
+            if getattr(self, "_enc", None):
+                self._lib.opus_multistream_encoder_destroy(
+                    ctypes.c_void_p(self._enc))
+        except Exception:
+            pass
+
+
+class MultistreamDecoder:
+    """Test oracle for the surround path (encode->decode roundtrip)."""
+
+    def __init__(self, sample_rate: int, channels: int, streams: int,
+                 coupled: int, mapping: bytes):
+        lib = _load()
+        if lib is None:
+            raise OpusError("libopus not found")
+        self._lib = lib
+        self.sample_rate = sample_rate
+        self.channels = channels
+        err = ctypes.c_int(0)
+        m = (ctypes.c_ubyte * channels)(*mapping)
+        lib.opus_multistream_decoder_create.restype = ctypes.c_void_p
+        self._dec = lib.opus_multistream_decoder_create(
+            sample_rate, channels, streams, coupled, m,
+            ctypes.byref(err))
+        if err.value != 0 or not self._dec:
+            raise OpusError(
+                f"multistream decoder create failed ({err.value})")
+
+    def decode(self, packet: bytes, max_frames: int = 5760) -> np.ndarray:
+        out = np.empty(max_frames * self.channels, np.int16)
+        buf = (ctypes.c_ubyte * len(packet)).from_buffer_copy(packet)
+        n = self._lib.opus_multistream_decode(
+            ctypes.c_void_p(self._dec), buf, ctypes.c_int(len(packet)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+            ctypes.c_int(max_frames), ctypes.c_int(0))
+        if n < 0:
+            raise OpusError(f"opus_multistream_decode failed ({n})")
+        return out[:n * self.channels].reshape(n, self.channels)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_dec", None):
+                self._lib.opus_multistream_decoder_destroy(
+                    ctypes.c_void_p(self._dec))
+        except Exception:
+            pass
+
+
+def opus_head(channels: int, streams: int, coupled: int, mapping: bytes,
+              sample_rate: int = 48000, pre_skip: int = 312) -> bytes:
+    """RFC 7845 §5.1 identification header ("OpusHead"). Browsers accept
+    it as the AudioDecoder ``description`` to unlock >2ch mapping
+    family 1; mono/stereo streams don't need one."""
+    import struct
+    head = b"OpusHead" + struct.pack(
+        "<BBHIh", 1, channels, pre_skip, sample_rate, 0)
+    if channels <= 2:
+        return head + b"\x00"
+    return head + bytes([1, streams, coupled]) + mapping[:channels]
+
+
 class Decoder:
     def __init__(self, sample_rate: int = 48000, channels: int = 2):
         lib = _load()

@@ -105,8 +105,20 @@ class AudioPipeline:
         self.frame_samples = int(self.sample_rate * self.frame_ms / 1000)
         self.red_distance = int(settings.audio_red_distance)
         self.queue_cap = int(settings.audio_backpressure_queue)
-        self._enc = opus.Encoder(self.sample_rate, self.channels,
-                                 int(settings.audio_bitrate))
+        if self.channels > 2:
+            # surround: multistream (mapping family 1); the OpusHead is
+            # pushed to clients so browser AudioDecoders can configure
+            # the channel mapping (reference pcmflux surround surface)
+            self._enc = opus.MultistreamEncoder(
+                self.sample_rate, self.channels,
+                int(settings.audio_bitrate))
+            self.opus_head = opus.opus_head(
+                self.channels, self._enc.streams, self._enc.coupled,
+                self._enc.mapping, self.sample_rate)
+        else:
+            self._enc = opus.Encoder(self.sample_rate, self.channels,
+                                     int(settings.audio_bitrate))
+            self.opus_head = None
         self._source = source
         self._task: Optional[asyncio.Task] = None
         self._listeners: dict[int, tuple[object, asyncio.Queue,

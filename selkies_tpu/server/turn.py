@@ -83,6 +83,102 @@ async def fetch_rest_api(uri: str, user: str = "selkies",
         return None
 
 
+CLOUDFLARE_TURN_API = ("https://rtc.live.cloudflare.com/v1/turn/keys/"
+                       "{key_id}/credentials/generate")
+
+
+async def fetch_cloudflare(key_id: str, api_token: str,
+                           ttl_s: int = 86400,
+                           timeout_s: float = 5.0,
+                           api_url: str = "") -> Optional[dict]:
+    """Cloudflare Calls TURN credentials (reference
+    webrtc_utils.py:298-352 fetch_cloudflare_turn_config): POST the key
+    API with a bearer token; the response's iceServers entry carries
+    ephemeral username/credential for turn.cloudflare.com. ``api_url``
+    overrides the endpoint for tests."""
+    url = api_url or CLOUDFLARE_TURN_API.format(key_id=key_id)
+    try:
+        import aiohttp
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=timeout_s)) as s:
+            async with s.post(
+                    url,
+                    headers={"Authorization": f"Bearer {api_token}"},
+                    json={"ttl": ttl_s}) as r:
+                if r.status != 200 and r.status != 201:
+                    logger.info("cloudflare turn API: HTTP %d", r.status)
+                    return None
+                body = await r.json()
+    except Exception as e:
+        logger.info("cloudflare turn fetch failed: %s", e)
+        return None
+    servers = body.get("iceServers")
+    if isinstance(servers, dict):       # API returns a single object
+        servers = [servers]
+    if not servers:
+        return None
+    return {"lifetimeDuration": f"{ttl_s}s", "iceServers": servers}
+
+
+class RtcConfigMonitor:
+    """Watch the trusted RTC config file and push changes to interested
+    parties (reference RTCConfigFileMonitor, webrtc_utils.py:354-460,
+    rebuilt on an mtime poll — the watchdog package isn't in this image
+    and a 1 s poll on one file is free). ``on_change(cfg_dict)`` fires
+    from the event loop whenever the file appears or its content
+    changes AND passes ``load_rtc_config_file``'s permission checks."""
+
+    def __init__(self, path: str, on_change, poll_s: float = 1.0):
+        self.path = path
+        self.on_change = on_change
+        self.poll_s = poll_s
+        self._sig: Optional[tuple] = None
+        self._task = None
+
+    def start(self) -> None:
+        import asyncio
+        if self.path and self._task is None:
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def _signature(self) -> Optional[tuple]:
+        try:
+            st = os.stat(self.path)
+            return (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return None
+
+    async def _run(self) -> None:
+        import asyncio
+        self._sig = self._signature()
+        # fire once at startup when the file is already present
+        if self._sig is not None:
+            cfg = load_rtc_config_file(self.path)
+            if cfg:
+                self._emit(cfg)
+        while True:
+            await asyncio.sleep(self.poll_s)
+            sig = self._signature()
+            if sig == self._sig:
+                continue
+            self._sig = sig
+            if sig is None:
+                continue                   # file removed: keep last cfg
+            cfg = load_rtc_config_file(self.path)
+            if cfg:
+                self._emit(cfg)
+
+    def _emit(self, cfg: dict) -> None:
+        try:
+            self.on_change(cfg)
+        except Exception:
+            logger.exception("rtc config on_change callback failed")
+
+
 async def get_rtc_configuration(settings) -> dict:
     """Resolution chain -> {"lifetimeDuration", "iceServers": [...]}."""
     ice: list[dict] = []
@@ -97,6 +193,13 @@ async def get_rtc_configuration(settings) -> dict:
     rest = getattr(settings, "turn_rest_uri", "")
     if rest:
         cfg = await fetch_rest_api(rest)
+        if cfg and cfg.get("iceServers"):
+            return cfg
+
+    cf_key = getattr(settings, "cloudflare_turn_key_id", "")
+    cf_token = getattr(settings, "cloudflare_turn_api_token", "")
+    if cf_key and cf_token:
+        cfg = await fetch_cloudflare(cf_key, cf_token)
         if cfg and cfg.get("iceServers"):
             return cfg
 

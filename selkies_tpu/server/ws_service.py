@@ -20,6 +20,7 @@ selkies.py:813-4883; SURVEY.md §2.1/§3.2):
 from __future__ import annotations
 
 import asyncio
+import base64
 import json
 import logging
 import os
@@ -247,12 +248,29 @@ class WebSocketsService(BaseStreamingService):
         if self.audio is not None:
             await self.audio.start()
         self._stats_task = asyncio.create_task(self._stats_loop())
+        # watched RTC config file: edits reach connected clients as an
+        # rtc_config push, so ICE-server rotation needs no reconnect
+        # (reference RTCConfigFileMonitor, webrtc_utils.py:354-460)
+        cfg_path = str(getattr(self.settings, "rtc_config_file", "") or "")
+        if cfg_path:
+            from .turn import RtcConfigMonitor
+
+            def _push_cfg(cfg: dict) -> None:
+                task = asyncio.create_task(self._broadcast_control(
+                    "rtc_config," + json.dumps(cfg)))
+                self._bg_tasks.add(task)
+                task.add_done_callback(self._bg_tasks.discard)
+            self._rtc_cfg_monitor = RtcConfigMonitor(cfg_path, _push_cfg)
+            self._rtc_cfg_monitor.start()
         logger.info("websockets service started")
 
     async def stop(self) -> None:
         self._running = False
         if self._stats_task:
             self._stats_task.cancel()
+        if getattr(self, "_rtc_cfg_monitor", None) is not None:
+            await self._rtc_cfg_monitor.stop()
+            self._rtc_cfg_monitor = None
         for c in list(self.clients.values()):
             await c.ws.close()
         for cap in self.captures.values():
@@ -301,6 +319,12 @@ class WebSocketsService(BaseStreamingService):
             ] or [{"id": self.settings.display_id,
                    "width": self.settings.initial_width,
                    "height": self.settings.initial_height}],
+            # surround (>2ch) streams carry the RFC 7845 OpusHead the
+            # browser AudioDecoder needs as `description`
+            "audio_head": (base64.b64encode(self.audio.opus_head).decode()
+                           if self.audio is not None
+                           and getattr(self.audio, "opus_head", None)
+                           else None),
             "features": {
                 "audio": self.audio is not None and self.settings.enable_audio,
                 "microphone": self.audio is not None and self.settings.enable_microphone,

@@ -161,6 +161,11 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
        "Wayland socket where APPS run when it differs from the capture "
        "compositor (reference settings.py:622-626); the input/clipboard "
        "target. Empty follows wayland_host_display."),
+    _s("wayland_compositor", SType.STR, "",
+       "Command for OWN-compositor mode when no external socket is "
+       "alive (reference stream_server.py:420-447 "
+       "ensure_wayland_display); empty probes labwc/sway/cage/weston "
+       "with the wlroots headless backend."),
     _s("webrtc_media_ip", SType.STR, "",
        "IP advertised as the ICE-lite media candidate (empty = "
        "auto-detect the outbound-route address; the reference's "
@@ -251,7 +256,14 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
     _s("turn_password", SType.STR, "", "Legacy TURN password.", sensitive=True),
     _s("turn_shared_secret", SType.STR, "", "HMAC TURN shared secret.", sensitive=True),
     _s("turn_rest_uri", SType.STR, "", "TURN REST API endpoint."),
-    _s("rtc_config_file", SType.STR, "", "Trusted JSON ICE-server file."),
+    _s("rtc_config_file", SType.STR, "",
+       "Trusted JSON ICE-server file; watched for changes and pushed "
+       "to clients (reference RTCConfigFileMonitor)."),
+    _s("cloudflare_turn_key_id", SType.STR, "",
+       "Cloudflare Calls TURN key id (reference "
+       "webrtc_utils.py:298-352)."),
+    _s("cloudflare_turn_api_token", SType.STR, "",
+       "Cloudflare Calls API bearer token.", sensitive=True),
     _s("webrtc_public_ip", SType.STR, "", "NAT1TO1 public IP substitution."),
 
     # --- recording / agent APIs ---------------------------------------------

@@ -1,0 +1,191 @@
+"""Own-compositor mode: bring up a headless Wayland compositor when no
+external one is offered.
+
+The reference can attach to an existing compositor OR start its own
+headless session (reference stream_server.py:420-447
+``ensure_wayland_display``). This is the TPU framework's equivalent
+supervisor: prefer the configured external socket when it is alive,
+otherwise spawn the first available wlroots-style compositor with the
+headless backend, wait for its socket, and keep it running (restart with
+backoff) until torn down. The capture/input plane
+(:mod:`selkies_tpu.wayland.client`) then attaches by screencopy exactly
+as it does to an external compositor — the two modes differ only in who
+owns the process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import shutil
+import time
+from typing import Optional, Sequence
+
+logger = logging.getLogger("selkies_tpu.wayland.compositor")
+
+#: candidate commands, first-found wins; each must understand the
+#: wlroots headless env. ``weston --backend=headless`` speaks its own
+#: flag so it is handled specially.
+CANDIDATES: Sequence[str] = ("labwc", "sway", "cage", "weston")
+
+SOCKET_WAIT_S = 10.0
+RESTART_BACKOFF_S = (0.5, 1.0, 2.0, 5.0)
+
+
+def _runtime_dir() -> str:
+    d = os.environ.get("XDG_RUNTIME_DIR")
+    if not d:
+        d = f"/tmp/selkies-runtime-{os.getuid()}"
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        os.environ["XDG_RUNTIME_DIR"] = d
+    return d
+
+
+def socket_alive(display: str) -> bool:
+    """A Wayland socket counts as alive when something accepts on it."""
+    import socket as _socket
+    path = display if os.path.isabs(display) else \
+        os.path.join(_runtime_dir(), display)
+    if not os.path.exists(path):
+        return False
+    s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    s.settimeout(1.0)
+    try:
+        s.connect(path)
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+class HeadlessCompositor:
+    """Supervise one owned headless compositor process."""
+
+    def __init__(self, command: str = "", display: str = "selkies-wl-0",
+                 width: int = 1920, height: int = 1080):
+        self.command = command            # explicit override from settings
+        self.display = display
+        self.width = width
+        self.height = height
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self._watch: Optional[asyncio.Task] = None
+        self._closed = False
+
+    def _pick(self) -> Optional[list[str]]:
+        if self.command:
+            argv = self.command.split()
+            return argv if shutil.which(argv[0]) else None
+        for cand in CANDIDATES:
+            if shutil.which(cand):
+                if cand == "weston":
+                    return ["weston", "--backend=headless",
+                            f"--width={self.width}",
+                            f"--height={self.height}",
+                            f"--socket={self.display}"]
+                return [cand]
+        return None
+
+    def _env(self) -> dict[str, str]:
+        env = dict(os.environ)
+        env.update({
+            "WLR_BACKENDS": "headless",
+            "WLR_LIBINPUT_NO_DEVICES": "1",
+            "WLR_RENDERER": "pixman",      # no GPU in the TPU container
+            "WAYLAND_DISPLAY": self.display,
+            "XDG_RUNTIME_DIR": _runtime_dir(),
+            # size of the headless output wlroots creates
+            "WLR_HEADLESS_OUTPUTS": "1",
+        })
+        return env
+
+    async def start(self) -> bool:
+        argv = self._pick()
+        if argv is None:
+            logger.warning(
+                "no headless compositor found (tried %s); wayland "
+                "own-compositor mode unavailable",
+                self.command or ",".join(CANDIDATES))
+            return False
+        if not await self._spawn(argv):
+            return False
+        self._watch = asyncio.create_task(self._watchdog(argv))
+        return True
+
+    async def _spawn(self, argv: list[str]) -> bool:
+        logger.info("starting headless compositor: %s (socket %s)",
+                    " ".join(argv), self.display)
+        try:
+            self.proc = await asyncio.create_subprocess_exec(
+                *argv, env=self._env(),
+                stdout=asyncio.subprocess.DEVNULL,
+                stderr=asyncio.subprocess.DEVNULL)
+        except OSError as e:
+            logger.warning("compositor spawn failed: %s", e)
+            return False
+        deadline = time.monotonic() + SOCKET_WAIT_S
+        while time.monotonic() < deadline:
+            if socket_alive(self.display):
+                logger.info("compositor socket %s is up", self.display)
+                return True
+            if self.proc.returncode is not None:
+                logger.warning("compositor exited rc=%s before its "
+                               "socket appeared", self.proc.returncode)
+                return False
+            await asyncio.sleep(0.2)
+        logger.warning("compositor socket %s never appeared", self.display)
+        return False
+
+    async def _watchdog(self, argv: list[str]) -> None:
+        """Restart the compositor if it dies (capture clients reconnect
+        through their own retry loops); bounded backoff so a broken
+        install can't spin."""
+        attempt = 0
+        while not self._closed:
+            assert self.proc is not None
+            await self.proc.wait()
+            if self._closed:
+                return
+            delay = RESTART_BACKOFF_S[min(attempt,
+                                          len(RESTART_BACKOFF_S) - 1)]
+            attempt += 1
+            logger.warning("compositor died (rc=%s); restart %d in %.1fs",
+                           self.proc.returncode, attempt, delay)
+            await asyncio.sleep(delay)
+            if not await self._spawn(argv):
+                logger.error("compositor restart failed; giving up")
+                return
+            attempt = 0 if socket_alive(self.display) else attempt
+
+    async def stop(self) -> None:
+        self._closed = True
+        if self._watch is not None:
+            self._watch.cancel()
+        if self.proc is not None and self.proc.returncode is None:
+            self.proc.terminate()
+            try:
+                await asyncio.wait_for(self.proc.wait(), timeout=5)
+            except asyncio.TimeoutError:
+                self.proc.kill()
+                await self.proc.wait()
+
+
+async def ensure_wayland_display(settings) -> tuple[Optional[str],
+                                                    Optional[HeadlessCompositor]]:
+    """The reference's ``ensure_wayland_display`` contract: return a
+    usable WAYLAND_DISPLAY, starting an owned headless compositor when
+    the configured/ambient one is missing or dead. Returns
+    ``(display_name, owned_compositor_or_None)``; ``(None, None)`` when
+    nothing can be brought up."""
+    for cand in (settings.wayland_host_display,
+                 os.environ.get("WAYLAND_DISPLAY", "")):
+        if cand and socket_alive(cand):
+            logger.info("using external wayland compositor %s", cand)
+            return cand, None
+    comp = HeadlessCompositor(
+        command=getattr(settings, "wayland_compositor", ""),
+        width=settings.initial_width, height=settings.initial_height)
+    if await comp.start():
+        return comp.display, comp
+    return None, None

@@ -15,6 +15,12 @@ export class AudioPlayer {
     this.sampleRate = 48000;
     this.channels = (st.audio_channels && st.audio_channels.value) || 2;
     this.frameMs = (st.audio_frame_ms && st.audio_frame_ms.value) || 10;
+    // surround (>2ch): the server ships an RFC 7845 OpusHead whose
+    // channel-mapping table the decoder needs as `description`
+    this.head = serverSettings.audio_head
+      ? Uint8Array.from(atob(serverSettings.audio_head),
+                        (c) => c.charCodeAt(0))
+      : null;
     this.ctx = new AudioContext({ sampleRate: this.sampleRate });
     this.playhead = 0;
     this.tsUs = 0;
@@ -29,10 +35,12 @@ export class AudioPlayer {
       output: (ad) => this._play(ad),
       error: (e) => console.warn("audio decode", e),
     });
-    this.dec.configure({
+    const cfg = {
       codec: "opus", sampleRate: this.sampleRate,
       numberOfChannels: this.channels,
-    });
+    };
+    if (this.head && this.channels > 2) cfg.description = this.head;
+    this.dec.configure(cfg);
   }
 
   push(buf) {

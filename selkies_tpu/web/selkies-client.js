@@ -184,11 +184,13 @@ class SelkiesClient {
 
   async _onRtcOffer(offer) {
     this._rtcTeardown();
-    let iceServers = [];
-    try {
-      const r = await fetch("/api/turn", { credentials: "same-origin" });
-      if (r.ok) iceServers = (await r.json()).iceServers || [];
-    } catch (_e) { /* host-candidate-only is fine on a LAN */ }
+    let iceServers = (this.rtcConfig && this.rtcConfig.iceServers) || [];
+    if (!iceServers.length) {
+      try {
+        const r = await fetch("/api/turn", { credentials: "same-origin" });
+        if (r.ok) iceServers = (await r.json()).iceServers || [];
+      } catch (_e) { /* host-candidate-only is fine on a LAN */ }
+    }
     const pc = new RTCPeerConnection({ iceServers });
     this.pc = pc;
     pc.ontrack = (e) => {
@@ -354,6 +356,11 @@ class SelkiesClient {
       case "settings_applied": break;
       case "clipboard": this._applyClipboard(rest); break;
       case "system_msg": this.status(rest); break;
+      case "rtc_config":
+        // pushed by the server's RTC-config-file watchdog: preferred
+        // over /api/turn on the next RTC (re)negotiation
+        try { this.rtcConfig = JSON.parse(rest); } catch { /* ignore */ }
+        break;
       case "KILL":
         this.killed = true;
         this.status("session terminated by server", true);

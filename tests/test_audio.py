@@ -2,6 +2,7 @@
 backpressure, bitrate control. All against real libopus via ctypes."""
 
 import asyncio
+import pathlib
 import struct
 
 import numpy as np
@@ -282,3 +283,84 @@ def test_virtual_mic_records_injected_tone():
         finally:
             await vm.teardown()
     asyncio.run(run())
+
+
+# ----------------------------------------------------------- surround
+def test_multistream_surround_roundtrip():
+    """>2ch capture encodes through the multistream surround API and the
+    matching multistream decoder recovers every channel (reference
+    pcmflux surround surface, SURVEY §2.2); the OpusHead carries the
+    mapping table browsers need as AudioDecoder description."""
+    from selkies_tpu.audio import opus
+    if not opus.available():
+        pytest.skip("libopus missing")
+    try:
+        enc = opus.MultistreamEncoder(48000, 6, 320000)
+    except opus.OpusError as e:
+        pytest.skip(str(e))
+    assert enc.streams >= 1 and enc.coupled >= 0
+    assert len(enc.mapping) == 6
+
+    # distinct CONTINUOUS tone per channel (phase must not restart at
+    # packet boundaries or the spectrum smears). Family-1 order for 6ch
+    # is FL C FR RL RR LFE — the LFE stream is lowpassed, so it gets a
+    # 60 Hz tone while the full-band channels step 300..900 Hz.
+    n_pkts, frame = 8, 480
+    t = np.arange(n_pkts * frame) / 48000.0
+    freqs = [300, 450, 600, 750, 900, 60]
+    pcm = np.stack([
+        np.sin(2 * np.pi * f * t) * 12000 for f in freqs],
+        axis=1).astype(np.int16)
+    packets = [enc.encode(pcm[i * frame:(i + 1) * frame])
+               for i in range(n_pkts)]
+    assert all(len(p) > 0 for p in packets)
+
+    dec = opus.MultistreamDecoder(48000, 6, enc.streams, enc.coupled,
+                                  enc.mapping)
+    outs = [dec.decode(p) for p in packets]
+    out = np.concatenate(outs[2:])       # skip codec warmup frames
+    assert out.shape[1] == 6
+    # every channel must carry ITS tone (bin resolution = 48000/len)
+    seg = out.astype(np.float64)
+    res = 48000 / len(seg)
+    peaks = []
+    for ch in range(6):
+        spec = np.abs(np.fft.rfft(seg[:, ch] * np.hanning(len(seg))))
+        spec[:2] = 0                     # ignore DC leakage
+        peaks.append(np.argmax(spec) * res)
+    for ch in range(6):
+        assert abs(peaks[ch] - freqs[ch]) < 40, (ch, peaks)
+
+
+def test_opus_head_format():
+    from selkies_tpu.audio import opus
+    head = opus.opus_head(6, 4, 2, bytes(range(6)))
+    assert head[:8] == b"OpusHead"
+    assert head[8] == 1                  # version
+    assert head[9] == 6                  # channels
+    assert head[18] == 1                 # mapping family 1
+    assert head[19] == 4 and head[20] == 2
+    assert head[21:27] == bytes(range(6))
+    stereo = opus.opus_head(2, 1, 1, b"")
+    assert stereo[18] == 0 and len(stereo) == 19
+
+
+async def test_pipeline_surround_head_in_settings():
+    """A 6-channel pipeline exposes opus_head; the WS hello advertises it
+    (audio_head) so AudioDecoder can be configured."""
+    from selkies_tpu.audio import opus
+    from selkies_tpu.audio.pipeline import AudioPipeline
+    from selkies_tpu.settings import AppSettings
+    if not opus.available():
+        pytest.skip("libopus missing")
+    s = AppSettings.parse([], {})
+    s.set_server("audio_channels", 6)
+    try:
+        p = AudioPipeline(s)
+    except (RuntimeError, opus.OpusError) as e:
+        pytest.skip(str(e))
+    assert p.opus_head is not None and p.opus_head[:8] == b"OpusHead"
+    # client module consumes it
+    js = (pathlib.Path(__file__).parent.parent / "selkies_tpu" / "web"
+          / "lib" / "audio.js").read_text()
+    assert "audio_head" in js and "description" in js

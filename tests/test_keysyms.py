@@ -43,3 +43,25 @@ def test_nonprinting_have_no_char():
     for ks in (0xFF1B, 0xFFE1, 0xFF51, 0xFFC8):   # Esc, Shift, Left, F11
         assert keysym_to_char(ks) is None
     assert is_modifier(0xFFE1) and not is_modifier(0x61)
+
+
+def test_cyrillic_case_pairs_generated():
+    # uppercase page is generated from lowercase: both halves agree
+    assert keysym_to_char(0x06C1) == "а" and keysym_to_char(0x06E1) == "А"
+    assert char_to_keysym("А") == 0x06E1
+    # Serbian/Ukrainian extensions incl. the irregular ghe_with_upturn
+    assert keysym_to_char(0x06A1) == "ђ" and keysym_to_char(0x06B1) == "Ђ"
+    assert keysym_to_char(0x06AD) == "ґ" and keysym_to_char(0x06BD) == "Ґ"
+    assert keysym_to_char(0x06B0) == "№"
+
+
+def test_affine_pages_roundtrip():
+    # Arabic / Hebrew / Thai pages are affine (keysymdef.h is laid out
+    # in Unicode order); spot-check both directions incl. Thai digits
+    for ks, ch in ((0x05D4, "ش"), (0x0CE0, "א"),
+                   (0x0DA1, "ก"), (0x0DF5, "๕")):
+        assert keysym_to_char(ks) == ch
+        assert char_to_keysym(ch) == ks
+    # normalize collapses the legacy page onto the same canonical keysym
+    # as the Unicode-rule form a modern client would send
+    assert normalize(0x01000000 | ord("ش")) == 0x05D4

@@ -362,3 +362,96 @@ def test_wayland_backend_through_input_handler(compositor):
     assert ("abs", 10, 20, W, H) in ev
     assert ("btn", 0x110, 1) in ev and ("btn", 0x110, 0) in ev
     assert any(e[0] == "axis" for e in ev)
+
+
+# --------------------------------------------------------- own-compositor
+def _fake_compositor_script(tmp_path, name="labwc", rc=0, delay=0.0):
+    """A scripted 'compositor': creates the Wayland socket its env names
+    and sleeps (or exits rc immediately when asked)."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir(exist_ok=True)
+    script = bin_dir / name
+    script.write_text(f"""#!/bin/sh
+sleep {delay}
+if [ {rc} -ne 0 ]; then exit {rc}; fi
+python3 - <<'PY'
+import os, socket, signal, sys
+path = os.path.join(os.environ["XDG_RUNTIME_DIR"],
+                    os.environ["WAYLAND_DISPLAY"])
+s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+s.bind(path)
+s.listen(1)
+signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+while True:
+    try:
+        c, _ = s.accept()
+        c.close()
+    except OSError:
+        break
+PY
+""")
+    script.chmod(0o755)
+    return bin_dir
+
+
+async def test_own_compositor_spawns_and_stops(tmp_path, monkeypatch):
+    """ensure_wayland_display (reference stream_server.py:420-447): with
+    no external socket alive, the supervisor spawns the first candidate
+    on PATH, waits for ITS socket, and teardown kills it."""
+    from selkies_tpu.settings import AppSettings
+    from selkies_tpu.wayland import compositor as C
+
+    bin_dir = _fake_compositor_script(tmp_path)
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    monkeypatch.setenv("XDG_RUNTIME_DIR", str(tmp_path / "run"))
+    (tmp_path / "run").mkdir()
+    monkeypatch.delenv("WAYLAND_DISPLAY", raising=False)
+
+    s = AppSettings.parse([], {})
+    display, owned = await C.ensure_wayland_display(s)
+    try:
+        assert display == "selkies-wl-0"
+        assert owned is not None
+        assert C.socket_alive(display)
+        assert owned.proc is not None and owned.proc.returncode is None
+    finally:
+        if owned:
+            await owned.stop()
+    assert owned.proc.returncode is not None
+
+
+async def test_external_socket_preferred(tmp_path, monkeypatch):
+    """A live wayland_host_display socket wins: no process is spawned."""
+    import socket as _socket
+    from selkies_tpu.settings import AppSettings
+    from selkies_tpu.wayland import compositor as C
+
+    run = tmp_path / "run"
+    run.mkdir()
+    monkeypatch.setenv("XDG_RUNTIME_DIR", str(run))
+    srv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    srv.bind(str(run / "external-wl"))
+    srv.listen(1)
+    try:
+        s = AppSettings.parse([], {})
+        s.set_server("wayland_host_display", "external-wl")
+        display, owned = await C.ensure_wayland_display(s)
+        assert display == "external-wl"
+        assert owned is None
+    finally:
+        srv.close()
+
+
+async def test_own_compositor_unavailable_degrades(tmp_path, monkeypatch):
+    """No candidate on PATH -> (None, None), never an exception (the
+    server keeps running with capture degraded)."""
+    from selkies_tpu.settings import AppSettings
+    from selkies_tpu.wayland import compositor as C
+
+    monkeypatch.setenv("PATH", str(tmp_path / "empty"))
+    monkeypatch.setenv("XDG_RUNTIME_DIR", str(tmp_path / "run2"))
+    (tmp_path / "run2").mkdir()
+    monkeypatch.delenv("WAYLAND_DISPLAY", raising=False)
+    s = AppSettings.parse([], {})
+    display, owned = await C.ensure_wayland_display(s)
+    assert display is None and owned is None

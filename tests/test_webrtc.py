@@ -173,3 +173,68 @@ def test_turn_rest_addon_app():
         assert r.status == 400
         await client.close()
     asyncio.run(run())
+
+
+async def test_cloudflare_turn_resolver():
+    """Cloudflare Calls credentials (reference webrtc_utils.py:298-352):
+    POST bearer-auth'd key endpoint -> iceServers; exercised against an
+    in-test API double, including the single-object response shape."""
+    from aiohttp import web as _web
+    from selkies_tpu.server.turn import fetch_cloudflare
+
+    seen = {}
+
+    async def handler(request):
+        seen["auth"] = request.headers.get("Authorization")
+        seen["body"] = await request.json()
+        return _web.json_response({"iceServers": {
+            "urls": ["turn:turn.cloudflare.com:3478?transport=udp"],
+            "username": "u1", "credential": "c1"}}, status=201)
+
+    app = _web.Application()
+    app.router.add_post("/gen", handler)
+    runner = _web.AppRunner(app)
+    await runner.setup()
+    site = _web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = runner.addresses[0][1]
+    try:
+        cfg = await fetch_cloudflare(
+            "kid", "tok", ttl_s=120,
+            api_url=f"http://127.0.0.1:{port}/gen")
+    finally:
+        await runner.cleanup()
+    assert seen["auth"] == "Bearer tok"
+    assert seen["body"] == {"ttl": 120}
+    assert cfg["iceServers"][0]["username"] == "u1"
+    assert cfg["lifetimeDuration"] == "120s"
+
+
+async def test_rtc_config_monitor_pushes_changes(tmp_path):
+    """The watched rtc_config_file fires on appearance and on change,
+    and refuses a world-writable replacement (reference
+    RTCConfigFileMonitor, webrtc_utils.py:354-460)."""
+    import asyncio as _asyncio
+    from selkies_tpu.server.turn import RtcConfigMonitor
+
+    path = tmp_path / "rtc.json"
+    got = []
+    mon = RtcConfigMonitor(str(path), got.append, poll_s=0.05)
+    mon.start()
+    try:
+        await _asyncio.sleep(0.12)
+        assert got == []                      # no file yet
+        path.write_text(json.dumps({"iceServers": [{"urls": ["stun:a"]}]}))
+        path.chmod(0o600)
+        await _asyncio.sleep(0.2)
+        assert len(got) == 1
+        path.write_text(json.dumps({"iceServers": [{"urls": ["stun:b"]}]}))
+        await _asyncio.sleep(0.2)
+        assert len(got) == 2
+        assert got[1]["iceServers"][0]["urls"] == ["stun:b"]
+        path.chmod(0o666)                     # now tainted: no more fires
+        path.write_text(json.dumps({"iceServers": [{"urls": ["stun:c"]}]}))
+        await _asyncio.sleep(0.2)
+        assert len(got) == 2
+    finally:
+        await mon.stop()
