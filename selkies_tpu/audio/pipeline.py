@@ -140,6 +140,17 @@ class AudioPipeline:
         self.on_raw_frame = None
         self._pts48 = 0
 
+    @property
+    def multistream_params(self) -> Optional[dict]:
+        """Stream layout for surround transports (WebRTC multiopus SDP
+        fmtp); None for mono/stereo."""
+        if self.channels > 2:
+            return {"channels": self.channels,
+                    "num_streams": self._enc.streams,
+                    "coupled_streams": self._enc.coupled,
+                    "channel_mapping": list(self._enc.mapping)}
+        return None
+
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
         if self._source is None:

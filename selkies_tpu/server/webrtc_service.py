@@ -79,9 +79,12 @@ class WebRTCService(BaseStreamingService):
         self._sessions: dict[str, _Session] = {}
         self._sig_queue: asyncio.Queue[str] = asyncio.Queue()
         self._sig_task: Optional[asyncio.Task] = None
-        self._capture = None
-        self._cap_stopper: Optional[threading.Thread] = None
+        #: per-display media graphs (reference webrtc_mode.py:1193-1406):
+        #: one capture per display_id, sessions subscribe by display
+        self._captures: dict[str, object] = {}
+        self._cap_stoppers: list[threading.Thread] = []
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._mic_decoder = None          # lazy opus decoder (browser mic)
 
     # ---------------------------------------------------------------- routes
     def register_routes(self, app: web.Application) -> None:
@@ -123,14 +126,15 @@ class WebRTCService(BaseStreamingService):
         for s in list(self._sessions.values()):
             s.peer.close()
         self._sessions.clear()
-        self._stop_capture()
+        self._stop_captures()
         # stop() IS the cross-service boundary (/api/switch): the next
         # service may start its own capture the moment we return, so wait
-        # for the encode thread here — off-loop, bounded
-        st = self._cap_stopper
-        if st is not None and st.is_alive():
+        # for the encode threads here — off-loop, bounded
+        stoppers = [t for t in self._cap_stoppers if t.is_alive()]
+        if stoppers:
             await asyncio.get_running_loop().run_in_executor(
-                None, lambda: st.join(30))
+                None, lambda: [t.join(30) for t in stoppers])
+        self._cap_stoppers.clear()
         if self._local_peer is not None:
             await self._local_peer.detach()
             self._local_peer = None
@@ -192,16 +196,28 @@ class WebRTCService(BaseStreamingService):
         fullcolor = bool(getattr(self.settings, "fullcolor", False))
         with_audio = self.audio is not None \
             and bool(getattr(self.settings, "enable_audio", False))
-        peer = RTCPeer(host=host, on_request_keyframe=self._request_idr,
+        with_mic = self.audio is not None \
+            and bool(getattr(self.settings, "enable_microphone", False))
+        peer = RTCPeer(host=host,
+                       on_request_keyframe=(
+                           lambda d=display_id: self._request_idr(d)),
                        with_audio=with_audio, fullcolor=fullcolor,
                        on_datachannel_message=self._on_input_verb,
-                       on_bitrate_estimate=self._on_remb,
-                       turn_config=self._turn_config())
+                       on_bitrate_estimate=(
+                           lambda bps, d=display_id:
+                           self._on_remb(bps, d)),
+                       turn_config=self._turn_config(),
+                       with_mic=with_mic,
+                       on_audio_packet=(self._on_mic_packet
+                                        if with_mic else None),
+                       audio_params=(getattr(self.audio,
+                                             "multistream_params", None)
+                                     if with_audio else None))
         if with_audio and self.audio.on_raw_frame is None:
             self.audio.on_raw_frame = self._on_audio_frame
         await peer.listen()
         self._sessions[caller_uid] = _Session(caller_uid, peer, display_id)
-        await self._ensure_capture()
+        await self._ensure_capture(display_id)
         offer = peer.create_offer()
         await self._local_peer.send("MSG {} {}".format(
             caller_uid,
@@ -233,8 +249,10 @@ class WebRTCService(BaseStreamingService):
         if sess is not None:
             sess.peer.close()
             logger.info("webrtc session %s closed", caller_uid)
-        if not self._sessions:
-            self._stop_capture()
+        # reap captures with no remaining viewers, display by display
+        viewed = {s.display_id for s in self._sessions.values()}
+        for did in [d for d in self._captures if d not in viewed]:
+            self._stop_capture(did)
 
     def _turn_config(self) -> dict | None:
         """Server-side TURN relay credentials from settings: static
@@ -258,15 +276,16 @@ class WebRTCService(BaseStreamingService):
                 "username": user, "password": password}
 
     # ----------------------------------------------------------------- media
-    async def _ensure_capture(self) -> None:
-        if self._capture is not None:
+    async def _ensure_capture(self, display_id: str = "primary") -> None:
+        if display_id in self._captures:
             return
-        # a previous capture may still be tearing down off-loop: wait for
-        # it so two encode threads never run concurrently (the TPU link
-        # is exclusive)
-        stopper = self._cap_stopper
-        if stopper is not None and stopper.is_alive():
-            await self._loop.run_in_executor(None, stopper.join)
+        # previous captures may still be tearing down off-loop: wait so
+        # two encode threads never run concurrently (the TPU link is
+        # exclusive)
+        stoppers = [t for t in self._cap_stoppers if t.is_alive()]
+        if stoppers:
+            await self._loop.run_in_executor(
+                None, lambda: [t.join() for t in stoppers])
         cap = None
         try:
             if self._capture_factory is not None:
@@ -297,23 +316,25 @@ class WebRTCService(BaseStreamingService):
                 h264_motion_vrange=s.h264_motion_vrange,
                 h264_motion_hrange=s.h264_motion_hrange,
                 fullcolor=bool(getattr(s, "fullcolor", False)),
+                display_id=display_id,
             )
             cap.start_capture(self._on_chunk, cs)
         except Exception:
-            logger.exception("webrtc capture unavailable")
+            logger.exception("webrtc capture unavailable (%s)", display_id)
             if cap is not None:
                 try:
                     cap.stop_capture()
                 except Exception:
                     pass
             return
-        self._capture = cap
-        logger.info("webrtc capture started (single-stream h264)")
+        self._captures[display_id] = cap
+        logger.info("webrtc capture started (single-stream h264, %s)",
+                    display_id)
 
-    def _stop_capture(self) -> None:
+    def _stop_capture(self, display_id: str) -> None:
         """Non-blocking: the capture thread join (up to 5 s, longer mid
         jit-compile) must never stall the event loop."""
-        cap, self._capture = self._capture, None
+        cap = self._captures.pop(display_id, None)
         if cap is None:
             return
 
@@ -323,9 +344,15 @@ class WebRTCService(BaseStreamingService):
             except Exception:
                 pass
 
-        self._cap_stopper = threading.Thread(
-            target=_stop, name="webrtc-capture-stop", daemon=True)
-        self._cap_stopper.start()
+        t = threading.Thread(target=_stop, name="webrtc-capture-stop",
+                             daemon=True)
+        self._cap_stoppers = [x for x in self._cap_stoppers
+                              if x.is_alive()] + [t]
+        t.start()
+
+    def _stop_captures(self) -> None:
+        for did in list(self._captures):
+            self._stop_capture(did)
 
     def _on_chunk(self, chunk) -> None:
         """Capture-thread callback -> loop-side fan-out (the only
@@ -335,33 +362,69 @@ class WebRTCService(BaseStreamingService):
         self._loop.call_soon_threadsafe(self._fanout, chunk)
 
     def _fanout(self, chunk) -> None:
+        # route by the chunk's display: sessions view ONE display each
+        did = getattr(chunk, "display_id", "primary")
         for sess in self._sessions.values():
+            if sess.display_id != did and did in self._captures \
+                    and sess.display_id in self._captures:
+                continue
             try:
                 sess.peer.send_video_au(chunk.payload)
             except Exception:
                 logger.exception("webrtc send failed (%s)",
                                  sess.caller_uid)
 
-    def _request_idr(self) -> None:
-        if self._capture is not None:
+    def _request_idr(self, display_id: str = "primary") -> None:
+        cap = self._captures.get(display_id) \
+            or next(iter(self._captures.values()), None)
+        if cap is not None:
             try:
-                self._capture.request_idr_frame()
+                cap.request_idr_frame()
             except Exception:
                 pass
 
-    def _on_remb(self, bps: int) -> None:
+    def _on_remb(self, bps: int, display_id: str = "primary") -> None:
         """Receiver bitrate estimate -> CBR target, user setting as the
         ceiling (the reference's congestion rule, webrtc_mode.py:
         1652-1716: estimate steers, never exceeds the configured rate)."""
-        if self._capture is None:
+        cap = self._captures.get(display_id) \
+            or next(iter(self._captures.values()), None)
+        if cap is None:
             return
         ceiling = int(self.settings.video_bitrate_kbps)
         # floor first, ceiling LAST: the configured rate is a hard cap
         kbps = min(ceiling, max(250, bps // 1000))
         try:
-            self._capture.update_video_bitrate(kbps)
+            cap.update_video_bitrate(kbps)
         except Exception:
             pass
+
+    def _on_mic_packet(self, opus_payload: bytes, seq: int,
+                       rtp_ts: int) -> None:
+        """Browser mic over the sendrecv audio m-line (reference
+        rtc.py:1303 mic receiver): decode the Opus payload and feed the
+        SAME virtual-mic path the WS 0x02 frames use, downsampled to
+        its 24 kHz mono contract (audio/pipeline.play_mic_pcm)."""
+        if self.audio is None:
+            return
+        try:
+            if self._mic_decoder is None:
+                from ..audio import opus as _opus
+                self._mic_decoder = _opus.Decoder(48000, 1)
+            pcm = self._mic_decoder.decode(opus_payload)   # (n, 1) int16
+        except Exception:
+            logger.debug("mic opus decode failed", exc_info=True)
+            return
+        flat = pcm.reshape(-1)
+        if flat.size < 2:
+            return
+        # 48 kHz -> 24 kHz: average sample pairs (cheap anti-alias)
+        half = ((flat[0:flat.size - flat.size % 2:2].astype("int32")
+                 + flat[1::2].astype("int32")) // 2).astype("int16")
+        try:
+            self.audio.play_mic_pcm(half.tobytes())
+        except Exception:
+            logger.debug("mic playback failed", exc_info=True)
 
     def _on_audio_frame(self, opus_packet: bytes, ts48: int) -> None:
         """Audio pipeline raw tap (loop thread): unframed Opus -> every
@@ -420,7 +483,7 @@ class WebRTCService(BaseStreamingService):
                 await dm.resize(*geo, float(self.settings.framerate))
         except Exception:
             logger.debug("webrtc resize: no real display to resize")
-        cap = self._capture
-        if cap is not None and cap.is_capturing():
-            await self._loop.run_in_executor(
-                None, lambda: cap.update_capture_region(0, 0, *geo))
+        for cap in list(self._captures.values()):
+            if cap.is_capturing():
+                await self._loop.run_in_executor(
+                    None, lambda c=cap: c.update_capture_region(0, 0, *geo))

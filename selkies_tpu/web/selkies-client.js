@@ -218,6 +218,26 @@ class SelkiesClient {
       if (typeof ev.data === "string") this._onText(ev.data);
     };
     await pc.setRemoteDescription(offer);
+    // sendrecv audio m-line + mic requested: attach the mic track so the
+    // answer carries it (server decodes into its virtual-mic graph)
+    if (this._micWanted && /m=audio[^]*?a=sendrecv/.test(offer.sdp)) {
+      try {
+        const ms = await navigator.mediaDevices.getUserMedia({
+          audio: { channelCount: 1, echoCancellation: true },
+        });
+        const tx = pc.getTransceivers().find(
+          (t) => t.receiver && t.receiver.track &&
+                 t.receiver.track.kind === "audio");
+        if (tx) {
+          await tx.sender.replaceTrack(ms.getAudioTracks()[0]);
+          tx.direction = "sendrecv";
+          this._micStream = ms;
+          this._postToDashboard({ type: "microphone", active: true });
+        } else ms.getTracks().forEach((t) => t.stop());
+      } catch (e) {
+        this.status(`microphone unavailable: ${e.message || e}`, true);
+      }
+    }
     const answer = await pc.createAnswer();
     await pc.setLocalDescription(answer);
     // ICE-lite server: no trickle needed; ship the answer as-is (the
@@ -542,9 +562,16 @@ class SelkiesClient {
   async startMic() {
     if (this.mic) return;
     if (this.rtcMode) {
-      /* 0x02 frames ride the WS transport only (sendBytes no-ops on
-       * RTC) — claiming success here would light the mic for nothing */
-      this.status("microphone needs the WebSockets transport", true);
+      /* RTC transport: the mic rides the sendrecv audio m-line, which
+       * needs a renegotiation so the answer can carry the track */
+      this._micWanted = true;
+      if (this._micStream) return;           // already attached
+      this.status("microphone: renegotiating webrtc session");
+      try {
+        this.sigWs.send("SESSION_END");
+        this._rtcTeardown();
+        this.sigWs.send("SESSION server");
+      } catch (_e) { /* retried on signaling reconnect */ }
       return;
     }
     const feats = this.serverSettings && this.serverSettings.features;
@@ -565,6 +592,15 @@ class SelkiesClient {
   }
 
   stopMic() {
+    if (this.rtcMode) {
+      this._micWanted = false;
+      if (this._micStream) {
+        this._micStream.getTracks().forEach((t) => t.stop());
+        this._micStream = null;
+        this._postToDashboard({ type: "microphone", active: false });
+      }
+      return;
+    }
     if (!this.mic) return;
     this.mic.stop();
     this.mic = null;

@@ -38,7 +38,10 @@ class RTCPeer(asyncio.DatagramProtocol):
                  with_audio: bool = True, fullcolor: bool = False,
                  on_datachannel_message: Optional[Callable] = None,
                  on_bitrate_estimate: Optional[Callable] = None,
-                 turn_config: Optional[dict] = None):
+                 turn_config: Optional[dict] = None,
+                 with_mic: bool = False,
+                 on_audio_packet: Optional[Callable] = None,
+                 audio_params: Optional[dict] = None):
         self.host = host
         self.port = port
         self.ufrag, self.pwd = make_ice_credentials()
@@ -75,6 +78,14 @@ class RTCPeer(asyncio.DatagramProtocol):
         self.relay_addr: tuple[str, int] | None = None
         self._peer_via_turn = False
         self._turn_bound: set = set()
+        #: browser mic receive path (reference rtc.py:1303): sendrecv
+        #: audio m-line + a compact reorder buffer in front of
+        #: ``on_audio_packet(opus_payload, seq, rtp_ts)``
+        self.with_mic = with_mic
+        self.on_audio_packet = on_audio_packet
+        self.audio_params = audio_params   # multiopus surround layout
+        self._mic_next: int | None = None
+        self._mic_buf: dict[int, object] = {}
 
     # -- socket -------------------------------------------------------------
     async def listen(self) -> int:
@@ -254,7 +265,50 @@ class RTCPeer(asyncio.DatagramProtocol):
                         int(min(gcc, remb) if remb else gcc))
                 elif remb is not None:
                     self.on_bitrate_estimate(remb)
-        # inbound RTP (browser mic) is handled by the service if wired
+            return
+        # inbound RTP: the browser's microphone track (sendrecv audio)
+        if self.on_audio_packet is None:
+            return
+        try:
+            rtp = self.srtp.unprotect_rtp(data)
+        except SrtpError:
+            return
+        from .rtp import RtpPacket
+        try:
+            pkt = RtpPacket.parse(rtp)
+        except ValueError:
+            return
+        if pkt.payload_type != self.audio.payload_type or not pkt.payload:
+            return
+        self._deliver_mic(pkt)
+
+    def _deliver_mic(self, pkt) -> None:
+        """Tiny reorder buffer (up to 8 packets ≈ 160 ms at 20 ms
+        frames): late packets re-sequence, real gaps are skipped so a
+        single loss can't dam the stream (the reference's jitterbuffer
+        role, fork jitterbuffer.py, scoped to the mic's low rate)."""
+        seq = pkt.seq
+        if self._mic_next is None:
+            self._mic_next = seq
+        if (seq - self._mic_next) & 0xFFFF >= 0x8000:
+            return                                  # duplicate / too late
+        self._mic_buf[seq] = pkt
+        while True:
+            nxt = self._mic_buf.pop(self._mic_next, None)
+            if nxt is not None:
+                try:
+                    self.on_audio_packet(nxt.payload, nxt.seq,
+                                         nxt.timestamp)
+                except Exception:
+                    logger.exception("mic packet handler failed")
+                self._mic_next = (self._mic_next + 1) & 0xFFFF
+            elif len(self._mic_buf) > 8:
+                # gap won't fill: jump to the oldest buffered packet
+                self._mic_next = min(
+                    self._mic_buf,
+                    key=lambda s: (s - self._mic_next) & 0xFFFF)
+            else:
+                return
 
     # -- signaling ----------------------------------------------------------
     def create_offer(self) -> str:
@@ -264,7 +318,9 @@ class RTCPeer(asyncio.DatagramProtocol):
                            audio_pt=self.audio.payload_type,
                            with_audio=self.with_audio,
                            fullcolor=self.fullcolor,
-                           relay=self.relay_addr)
+                           relay=self.relay_addr,
+                           with_mic=self.with_mic,
+                           audio_params=self.audio_params)
 
     def set_remote_answer(self, sdp: str) -> None:
         self.remote = parse_answer(sdp)

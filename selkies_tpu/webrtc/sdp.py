@@ -25,11 +25,16 @@ def build_offer(host: str, port: int, ufrag: str, pwd: str,
                 fingerprint: str, video_pt: int = 102,
                 audio_pt: int = 111, with_audio: bool = True,
                 fullcolor: bool = False, with_data: bool = True,
-                relay: "tuple[str, int] | None" = None) -> str:
+                relay: "tuple[str, int] | None" = None,
+                with_mic: bool = False,
+                audio_params: "dict | None" = None) -> str:
     """One-shot SDP offer: sendonly video (+audio) + a data channel
     m-line for input, ICE-lite, DTLS actpass, all bundled on one port.
     ``relay`` adds a TURN ``typ relay`` candidate (webrtc/turn.py
-    allocation) after the host candidate for NAT'd servers."""
+    allocation) after the host candidate for NAT'd servers.
+    ``with_mic`` flips the audio m-line to sendrecv so the browser can
+    send its microphone track back (reference rtc.py:1303 mic
+    receiver)."""
     sid = secrets.randbits(62)
     mids = ["0"] + (["1"] if with_audio else [])
     if with_data:
@@ -68,19 +73,39 @@ def build_offer(host: str, port: int, ufrag: str, pwd: str,
         ]),
     ]
     if with_audio:
-        media.append(
-            (f"m=audio {port} UDP/TLS/RTP/SAVPF {audio_pt}", [
+        if audio_params and int(audio_params.get("channels", 2)) > 2:
+            # surround: Chrome's multiopus (multistream Opus whose
+            # stream layout rides the fmtp — reference
+            # webrtc_mode.py:252-254); the packets are exactly what
+            # audio/opus.MultistreamEncoder emits
+            ch = int(audio_params["channels"])
+            mapping = ",".join(
+                str(int(v)) for v in audio_params["channel_mapping"])
+            audio_lines = [
+                f"a=rtpmap:{audio_pt} multiopus/48000/{ch}",
+                f"a=fmtp:{audio_pt} minptime=10;useinbandfec=1;"
+                f"channel_mapping={mapping};"
+                f"num_streams={int(audio_params['num_streams'])};"
+                f"coupled_streams={int(audio_params['coupled_streams'])}",
+                f"a=rtcp-fb:{audio_pt} transport-cc",
+                extmap,
+            ]
+        else:
+            audio_lines = [
                 f"a=rtpmap:{audio_pt} opus/48000/2",
                 f"a=fmtp:{audio_pt} minptime=10;useinbandfec=1",
                 f"a=rtcp-fb:{audio_pt} transport-cc",
                 extmap,
-            ]))
+            ]
+        media.append(
+            (f"m=audio {port} UDP/TLS/RTP/SAVPF {audio_pt}",
+             audio_lines))
     for i, (mline, extra) in enumerate(media):
         lines.append(mline)
         lines.append(f"c=IN IP4 {host}")
         lines += [
             f"a=mid:{mids[i]}",
-            "a=sendonly",
+            "a=sendrecv" if (i == 1 and with_mic) else "a=sendonly",
             f"a=ice-ufrag:{ufrag}",
             f"a=ice-pwd:{pwd}",
             f"a=fingerprint:sha-256 {fingerprint}",

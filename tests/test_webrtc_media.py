@@ -612,7 +612,7 @@ async def test_datachannel_control_verbs():
     svc = WebRTCService(s, input_handler=FakeInput())
     svc._loop = asyncio.get_running_loop()
     cap = FakeCapture()
-    svc._capture = cap
+    svc._captures = {"primary": cap}
 
     svc._on_input_verb("input", "REQUEST_KEYFRAME")
     svc._on_input_verb("input", "vb,3000")
@@ -628,3 +628,132 @@ async def test_datachannel_control_verbs():
     assert cap.regions == [(0, 0, 800, 600)]
     assert (s.initial_width, s.initial_height) == (800, 600)
     assert svc.input_handler.msgs == ["kd,65"]
+
+
+# -------------------------------------------------- mic receive (rtc)
+def test_offer_audio_direction_follows_mic():
+    from selkies_tpu.webrtc.sdp import build_offer
+    base = dict(host="1.2.3.4", port=5, ufrag="u", pwd="p",
+                fingerprint="AA:BB")
+    sdp = build_offer(**base, with_mic=True)
+    audio = sdp.split("m=audio", 1)[1].split("m=application")[0]
+    assert "a=sendrecv" in audio
+    video = sdp.split("m=video", 1)[1].split("m=audio")[0]
+    assert "a=sendonly" in video and "a=sendrecv" not in video
+    sdp2 = build_offer(**base)
+    audio2 = sdp2.split("m=audio", 1)[1].split("m=application")[0]
+    assert "a=sendonly" in audio2
+
+
+def test_peer_mic_reorder_buffer():
+    """Out-of-order mic RTP re-sequences; a real gap is skipped after
+    the 8-deep buffer fills instead of damming the stream."""
+    from selkies_tpu.webrtc.peer import RTCPeer
+    from selkies_tpu.webrtc.rtp import RtpPacket
+
+    got = []
+    peer = RTCPeer(with_mic=True,
+                   on_audio_packet=lambda pl, seq, ts: got.append(seq))
+
+    def pkt(seq):
+        return RtpPacket(111, seq, seq * 480, 0x1234, False,
+                         bytes([seq & 0xFF]))
+
+    for seq in (10, 12, 11, 13):         # simple swap: resequenced
+        peer._deliver_mic(pkt(seq))
+    assert got == [10, 11, 12, 13]
+    got.clear()
+    peer._deliver_mic(pkt(14))
+    # seq 15 lost: 16..24 buffer up, then the stream jumps the gap
+    for seq in range(16, 26):
+        peer._deliver_mic(pkt(seq))
+    assert got[0] == 14 and 16 in got and got == sorted(got)
+    # duplicates / stale arrivals are dropped
+    n = len(got)
+    peer._deliver_mic(pkt(14))
+    assert len(got) == n
+
+
+def test_service_mic_packet_feeds_virtual_mic_path():
+    """An Opus browser-mic packet decodes and lands on play_mic_pcm as
+    24 kHz mono s16 (half the 48 kHz decode length)."""
+    from selkies_tpu.audio import opus
+    if not opus.available():
+        pytest.skip("libopus missing")
+    from selkies_tpu.server.webrtc_service import WebRTCService
+    from selkies_tpu.settings import AppSettings
+
+    s = AppSettings.parse([], {})
+    svc = WebRTCService(s)
+
+    class FakeAudio:
+        def __init__(self):
+            self.chunks = []
+
+        def play_mic_pcm(self, pcm):
+            self.chunks.append(pcm)
+
+    svc.audio = FakeAudio()
+    enc = opus.Encoder(48000, 1, 64000)
+    t = np.arange(960) / 48000.0
+    pcm = (np.sin(2 * np.pi * 440 * t) * 12000).astype(np.int16)
+    payload = enc.encode(pcm)
+    svc._on_mic_packet(payload, 0, 0)
+    svc._on_mic_packet(enc.encode(pcm), 1, 960)
+    assert len(svc.audio.chunks) == 2
+    # 20 ms at 48k mono decodes to 960 samples -> 480 samples at 24k
+    assert len(svc.audio.chunks[1]) == 480 * 2
+
+
+async def test_per_display_fanout_routing():
+    """Two sessions on two displays: chunks route by chunk.display_id
+    (reference webrtc_mode.py:1193-1406 per-display media graphs)."""
+    from selkies_tpu.server.webrtc_service import WebRTCService, _Session
+    from selkies_tpu.settings import AppSettings
+
+    s = AppSettings.parse([], {})
+    svc = WebRTCService(s)
+    svc._captures = {"primary": object(), "second": object()}
+
+    class FakePeer:
+        def __init__(self):
+            self.sent = []
+
+        def send_video_au(self, payload):
+            self.sent.append(payload)
+
+    p1, p2 = FakePeer(), FakePeer()
+    svc._sessions = {
+        "a": _Session("a", p1, "primary"),
+        "b": _Session("b", p2, "second"),
+    }
+
+    class Chunk:
+        def __init__(self, did, payload):
+            self.display_id = did
+            self.payload = payload
+
+    svc._fanout(Chunk("primary", b"P"))
+    svc._fanout(Chunk("second", b"S"))
+    assert p1.sent == [b"P"] and p2.sent == [b"S"]
+    # a chunk from a display nobody tracks still reaches everyone
+    # (single-capture factories whose chunks carry e.g. ':0')
+    svc._fanout(Chunk(":0", b"X"))
+    assert p1.sent[-1] == b"X" and p2.sent[-1] == b"X"
+
+
+def test_offer_multiopus_surround():
+    """>2ch audio advertises Chrome's multiopus with the encoder's
+    stream layout in the fmtp (reference webrtc_mode.py:252-254)."""
+    from selkies_tpu.webrtc.sdp import build_offer
+    sdp = build_offer("1.2.3.4", 5, "u", "p", "AA:BB",
+                      audio_params={"channels": 6, "num_streams": 4,
+                                    "coupled_streams": 2,
+                                    "channel_mapping": [0, 4, 1, 2, 3, 5]})
+    audio = sdp.split("m=audio", 1)[1].split("m=application")[0]
+    assert "multiopus/48000/6" in audio
+    assert "channel_mapping=0,4,1,2,3,5" in audio
+    assert "num_streams=4" in audio and "coupled_streams=2" in audio
+    # stereo keeps plain opus
+    sdp2 = build_offer("1.2.3.4", 5, "u", "p", "AA:BB")
+    assert "multiopus" not in sdp2 and "opus/48000/2" in sdp2
