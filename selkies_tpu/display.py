@@ -94,6 +94,7 @@ class DisplayManager:
         self.display = display
         self._output: str | None = None
         self._probe_failed_at: float | None = None
+        self._wm_name: str | None = None   # "" = probed, none running
 
     def available(self) -> bool:
         """xrandr exists and the display hasn't recently refused us.
@@ -155,7 +156,55 @@ class DisplayManager:
         logger.info("display resized to %s", ml.name)
         return True
 
-    async def set_dpi(self, dpi: int) -> None:
+    # -- window-manager awareness (reference display_utils.py WM detect/
+    # swap + per-DE settings chain) -------------------------------------
+    async def detect_window_manager(self) -> str | None:
+        """EWMH WM detection: _NET_SUPPORTING_WM_CHECK on the root
+        window names the WM's check window, whose _NET_WM_NAME is the
+        running WM ("Xfwm4", "Mutter", "twm"...). None when no EWMH WM
+        owns the screen (bare Xvfb)."""
+        if self._wm_name is not None:
+            return self._wm_name or None
+        if not shutil.which("xprop"):
+            return None
+        rc, out = await self._run("xprop", "-root",
+                                  "_NET_SUPPORTING_WM_CHECK")
+        m = re.search(r"window id # (0x[0-9a-fA-F]+)", out)
+        if rc != 0 or not m:
+            self._wm_name = ""
+            return None
+        rc, out = await self._run("xprop", "-id", m.group(1),
+                                  "_NET_WM_NAME")
+        m = re.search(r'=\s*"(.*)"', out)
+        self._wm_name = m.group(1) if rc == 0 and m else ""
+        return self._wm_name or None
+
+    async def swap_window_manager(self, command: str) -> bool:
+        """Replace the running WM (reference WM swap): EWMH WMs honour
+        ``--replace``; the new WM is detached so it outlives us."""
+        argv = command.split()
+        if not argv or not shutil.which(argv[0]):
+            return False
+        if "--replace" not in argv:
+            argv.append("--replace")
+        try:
+            await asyncio.create_subprocess_exec(
+                *argv, env=dict(os.environ, DISPLAY=self.display),
+                stdout=asyncio.subprocess.DEVNULL,
+                stderr=asyncio.subprocess.DEVNULL,
+                start_new_session=True)
+        except OSError as e:
+            logger.warning("wm swap failed: %s", e)
+            return False
+        self._wm_name = None            # re-detect on next ask
+        return True
+
+    async def _apply_de_chain(self, xrdb_line: str,
+                              xfconf: tuple[str, ...] | None,
+                              gsettings: tuple[str, ...] | None) -> None:
+        """xrdb always; then the desktop-environment half of the chain
+        (reference display_utils.py:1391-1480): Xfce reads xfconf, GNOME
+        reads gsettings — xrdb alone doesn't reach their scaling."""
         if shutil.which("xrdb"):
             proc = await asyncio.create_subprocess_exec(
                 "xrdb", "-merge", "-",
@@ -163,18 +212,32 @@ class DisplayManager:
                 stdin=asyncio.subprocess.PIPE,
                 stdout=asyncio.subprocess.DEVNULL,
                 stderr=asyncio.subprocess.DEVNULL)
-            await proc.communicate(f"Xft.dpi: {int(dpi)}\n".encode())
+            await proc.communicate(xrdb_line.encode())
+        wm = (await self.detect_window_manager() or "").lower()
+        if xfconf and shutil.which("xfconf-query") \
+                and ("xfwm" in wm or not wm):
+            await self._run("xfconf-query", *xfconf)
+        if gsettings and shutil.which("gsettings") \
+                and ("mutter" in wm or "gnome" in wm or not wm):
+            await self._run("gsettings", *gsettings)
+
+    async def set_dpi(self, dpi: int) -> None:
+        dpi = int(dpi)
+        await self._apply_de_chain(
+            f"Xft.dpi: {dpi}\n",
+            ("-c", "xsettings", "-p", "/Xft/DPI", "--create",
+             "-t", "int", "-s", str(dpi)),
+            ("set", "org.gnome.desktop.interface",
+             "text-scaling-factor", f"{dpi / 96.0:.4f}"))
 
     async def set_cursor_size(self, size: int) -> None:
-        if shutil.which("xrdb"):
-            proc = await asyncio.create_subprocess_exec(
-                "xrdb", "-merge", "-",
-                env=dict(os.environ, DISPLAY=self.display),
-                stdin=asyncio.subprocess.PIPE,
-                stdout=asyncio.subprocess.DEVNULL,
-                stderr=asyncio.subprocess.DEVNULL)
-            await proc.communicate(
-                f"Xcursor.size: {int(size)}\n".encode())
+        size = int(size)
+        await self._apply_de_chain(
+            f"Xcursor.size: {size}\n",
+            ("-c", "xsettings", "-p", "/Gtk/CursorThemeSize", "--create",
+             "-t", "int", "-s", str(size)),
+            ("set", "org.gnome.desktop.interface",
+             "cursor-size", str(size)))
 
 
 # ---------------------------------------------------------------------------

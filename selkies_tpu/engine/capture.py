@@ -99,7 +99,8 @@ class ScreenCapture:
             self._source = make_source(self._source_kind,
                                        settings.capture_width,
                                        settings.capture_height,
-                                       settings.display_id)
+                                       settings.x_display
+                                       or settings.display_id)
             self._running.set()
             self._thread = threading.Thread(
                 target=self._run, name="tpuflux-capture", daemon=True)

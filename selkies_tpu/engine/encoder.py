@@ -201,8 +201,10 @@ class JpegEncoderSession:
         self._age = age
         fid = self.frame_id
         self.frame_id = (self.frame_id + 1) & 0xFFFF
-        # kick off async readbacks so the consumer doesn't eat the RTT
-        for arr in (data, lens, send, is_paint, overflow):
+        # kick off async readbacks of the SMALL control arrays so the
+        # consumer doesn't eat the RTT; the stream buffer itself is
+        # fetched minimally at finalize (engine/readback.py)
+        for arr in (lens, send, is_paint, overflow):
             try:
                 arr.copy_to_host_async()
             except Exception:  # interpret/CPU backends may not support it
@@ -250,11 +252,21 @@ class JpegEncoderSession:
         if self._force_after_drop:
             self._force_after_drop = False
             force_all = True
-        data = np.asarray(out["data"])
         lens = np.asarray(out["lens"])
         send = np.asarray(out["send"])
         is_paint = np.asarray(out["is_paint"])
+        if not (force_all or send.any()):
+            return []                 # idle frame: fetch nothing at all
         starts = np.concatenate([[0], np.cumsum(lens)])
+        # minimal readback (engine/readback.py): all stripes are always
+        # in the buffer, so the used prefix is everything up to the last
+        # DELIVERED stripe — capacity padding never crosses the link
+        from .readback import fetch_stream_bytes
+        deliver = np.nonzero(send)[0] if not force_all \
+            else np.arange(g.n_stripes)
+        last = int(deliver[-1])
+        data = fetch_stream_bytes(out["data"],
+                                  int(starts[last] + lens[last]))
         chunks: list[EncodedChunk] = []
         for i in range(g.n_stripes):
             if not (force_all or send[i]):

@@ -317,7 +317,10 @@ class H264EncoderSession:
         self._ref_y, self._ref_u, self._ref_v = ry, ru, rv
         fid = self.frame_id
         self.frame_id = (self.frame_id + 1) & 0xFFFF
-        for arr in (data, row_lens, send, is_paint, overflow):
+        # async-copy only the SMALL control arrays; the stream buffer is
+        # fetched minimally at finalize (engine/readback.py) once the
+        # row lengths are known
+        for arr in (row_lens, send, is_paint, overflow):
             try:
                 arr.copy_to_host_async()
             except Exception:
@@ -346,13 +349,20 @@ class H264EncoderSession:
                 self._p_step = self._build_step("p")
             self._force_after_drop = True
             return []
-        data = np.asarray(out["data"])
         lens = np.asarray(out["lens"])            # (R,) per MB row
         send = np.asarray(out["send"])
         intra = out.get("intra", True)
+        if not send.any():
+            return []                 # idle frame: fetch nothing at all
         starts = np.concatenate([[0], np.cumsum(lens)])
-        chunks: list[EncodedChunk] = []
         rps = g.rows_per_stripe
+        # minimal readback (engine/readback.py): fetch through the last
+        # DELIVERED stripe's rows — capacity padding and trailing unsent
+        # stripes never cross the host link
+        from .readback import fetch_stream_bytes
+        last_row = (int(np.nonzero(send)[0][-1]) + 1) * rps
+        data = fetch_stream_bytes(out["data"], int(starts[last_row]))
+        chunks: list[EncodedChunk] = []
         for i in range(g.n_stripes):
             if not send[i]:
                 continue

@@ -52,7 +52,13 @@ class CaptureSettings:
     single_stream: bool = False
     # device placement
     seat_index: int = 0
+    #: LOGICAL display label stamped on chunks ("primary", "display2",
+    #: "seat0"...). NOT the X server address — see x_display.
     display_id: str = ":0"
+    #: real X/Wayland display to open for capture (":0",
+    #: "wayland-0"...); empty falls back to display_id for callers
+    #: whose logical id IS the server address (tests, single display)
+    x_display: str = ""
     # misc parity knobs
     watermark_path: str = ""
     watermark_location: int = 6

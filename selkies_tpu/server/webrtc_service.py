@@ -62,6 +62,11 @@ class _Session:
         self.caller_uid = caller_uid
         self.peer = peer
         self.display_id = display_id
+        #: per-session Opus decoder for the browser-mic stream — Opus
+        #: decode is STATEFUL (prediction/PLC carry across frames), so
+        #: two peers' interleaved packets through one decoder would
+        #: garble both streams
+        self.mic_decoder = None
 
 
 class WebRTCService(BaseStreamingService):
@@ -84,7 +89,6 @@ class WebRTCService(BaseStreamingService):
         self._captures: dict[str, object] = {}
         self._cap_stoppers: list[threading.Thread] = []
         self._loop: Optional[asyncio.AbstractEventLoop] = None
-        self._mic_decoder = None          # lazy opus decoder (browser mic)
 
     # ---------------------------------------------------------------- routes
     def register_routes(self, app: web.Application) -> None:
@@ -202,14 +206,18 @@ class WebRTCService(BaseStreamingService):
                        on_request_keyframe=(
                            lambda d=display_id: self._request_idr(d)),
                        with_audio=with_audio, fullcolor=fullcolor,
-                       on_datachannel_message=self._on_input_verb,
+                       on_datachannel_message=(
+                           lambda label, text, d=display_id:
+                           self._on_input_verb(label, text, d)),
                        on_bitrate_estimate=(
                            lambda bps, d=display_id:
                            self._on_remb(bps, d)),
                        turn_config=self._turn_config(),
                        with_mic=with_mic,
-                       on_audio_packet=(self._on_mic_packet
-                                        if with_mic else None),
+                       on_audio_packet=(
+                           (lambda pl, seq, ts, uid=caller_uid:
+                            self._on_mic_packet(uid, pl))
+                           if with_mic else None),
                        audio_params=(getattr(self.audio,
                                              "multistream_params", None)
                                      if with_audio else None))
@@ -276,12 +284,26 @@ class WebRTCService(BaseStreamingService):
                 "username": user, "password": password}
 
     # ----------------------------------------------------------------- media
+    def _display_rect(self, display_id: str) -> tuple[int, int]:
+        """Capture-origin offsets inside the X framebuffer: primary at
+        (0, 0); any secondary display reads the sub-rect to its right
+        (the WS service's dual-layout default, ws_service.py
+        _apply_display_layout)."""
+        s = self.settings
+        primary = ("primary", s.display_id, "")
+        if display_id in primary:
+            return (0, 0)
+        return (int(getattr(s, "initial_width", 1920) or 1920), 0)
+
     async def _ensure_capture(self, display_id: str = "primary") -> None:
         if display_id in self._captures:
             return
-        # previous captures may still be tearing down off-loop: wait so
-        # two encode threads never run concurrently (the TPU link is
-        # exclusive)
+        # previous captures may still be tearing down off-loop: wait for
+        # them before starting another (teardown joins the encode
+        # thread). LIVE concurrent captures are fine — each frame's
+        # dispatch+readback is serialized by the engine's global
+        # _ENCODE_TURN lock (engine/capture.py:42), the same discipline
+        # the WS multi-display path relies on.
         stoppers = [t for t in self._cap_stoppers if t.is_alive()]
         if stoppers:
             await self._loop.run_in_executor(
@@ -317,6 +339,9 @@ class WebRTCService(BaseStreamingService):
                 h264_motion_hrange=s.h264_motion_hrange,
                 fullcolor=bool(getattr(s, "fullcolor", False)),
                 display_id=display_id,
+                x_display=s.display_id,
+                capture_x=self._display_rect(display_id)[0],
+                capture_y=self._display_rect(display_id)[1],
             )
             cap.start_capture(self._on_chunk, cs)
         except Exception:
@@ -399,22 +424,38 @@ class WebRTCService(BaseStreamingService):
         except Exception:
             pass
 
-    def _on_mic_packet(self, opus_payload: bytes, seq: int,
-                       rtp_ts: int) -> None:
+    def _make_mic_decoder(self):
+        """Decoder matching what the m-line negotiated: plain mono Opus,
+        or a multistream decoder with the surround layout when the offer
+        advertised multiopus (the browser then encodes its mic with that
+        codec — a plain decoder can't parse multistream payloads)."""
+        from ..audio import opus as _opus
+        params = getattr(self.audio, "multistream_params", None)
+        if params:
+            return _opus.MultistreamDecoder(
+                48000, int(params["channels"]),
+                int(params["num_streams"]), int(params["coupled_streams"]),
+                bytes(params["channel_mapping"]))
+        return _opus.Decoder(48000, 1)
+
+    def _on_mic_packet(self, caller_uid: str, opus_payload: bytes) -> None:
         """Browser mic over the sendrecv audio m-line (reference
-        rtc.py:1303 mic receiver): decode the Opus payload and feed the
-        SAME virtual-mic path the WS 0x02 frames use, downsampled to
-        its 24 kHz mono contract (audio/pipeline.play_mic_pcm)."""
-        if self.audio is None:
+        rtc.py:1303 mic receiver): decode with the SESSION's decoder and
+        feed the same virtual-mic path the WS 0x02 frames use,
+        downmixed/downsampled to its 24 kHz mono contract
+        (audio/pipeline.play_mic_pcm)."""
+        sess = self._sessions.get(caller_uid)
+        if self.audio is None or sess is None:
             return
         try:
-            if self._mic_decoder is None:
-                from ..audio import opus as _opus
-                self._mic_decoder = _opus.Decoder(48000, 1)
-            pcm = self._mic_decoder.decode(opus_payload)   # (n, 1) int16
+            if sess.mic_decoder is None:
+                sess.mic_decoder = self._make_mic_decoder()
+            pcm = sess.mic_decoder.decode(opus_payload)    # (n, ch) int16
         except Exception:
             logger.debug("mic opus decode failed", exc_info=True)
             return
+        if pcm.shape[1] > 1:                               # downmix
+            pcm = pcm.astype("int32").mean(axis=1).astype("int16")
         flat = pcm.reshape(-1)
         if flat.size < 2:
             return
@@ -435,24 +476,27 @@ class WebRTCService(BaseStreamingService):
             except Exception:
                 pass
 
-    def _on_input_verb(self, label: str, text) -> None:
+    def _on_input_verb(self, label: str, text,
+                       display_id: str = "primary") -> None:
         """Data-channel input: same verb grammar as the WS transport
         (the reference shares one input handler across transports,
         input_handler.py:1866). Control verbs the WS service would own
-        (REQUEST_KEYFRAME / vb / r) are handled here; everything else
-        forwards to the shared input handler."""
+        (REQUEST_KEYFRAME / vb / r) are handled here — bound to the
+        SENDING session's display like the RTCP PLI/REMB paths;
+        everything else forwards to the shared input handler."""
         if not isinstance(text, str) or self._loop is None:
             return
         verb, _, args = text.partition(",")
         if text == "REQUEST_KEYFRAME":
-            self._loop.call_soon_threadsafe(self._request_idr)
+            self._loop.call_soon_threadsafe(self._request_idr, display_id)
             return
         if verb == "vb":
             try:
                 kbps = int(args)
             except ValueError:
                 return
-            self._loop.call_soon_threadsafe(self._on_remb, kbps * 1000)
+            self._loop.call_soon_threadsafe(self._on_remb, kbps * 1000,
+                                            display_id)
             return
         if verb == "r" and self.settings.enable_resize:
             try:
@@ -460,17 +504,19 @@ class WebRTCService(BaseStreamingService):
             except ValueError:
                 return
             self._loop.call_soon_threadsafe(
-                lambda: self._loop.create_task(self._resize(w, h)))
+                lambda: self._loop.create_task(
+                    self._resize(w, h, display_id)))
             return
         if self.input_handler is not None:
             self._loop.call_soon_threadsafe(
                 lambda: self._loop.create_task(
                     self.input_handler.on_message(text)))
 
-    async def _resize(self, w: int, h: int) -> None:
-        """Data-channel resize: retarget the single-stream capture (and the
-        real X screen when one exists — reference webrtc_mode.py mirrors
-        the WS on_resize logic)."""
+    async def _resize(self, w: int, h: int,
+                      display_id: str = "primary") -> None:
+        """Data-channel resize: retarget the REQUESTING display's capture
+        (and the real X screen when one exists — reference
+        webrtc_mode.py mirrors the WS on_resize logic)."""
         geo = (max(64, min(w, 16384)), max(64, min(h, 16384)))
         # through the settings layer, not attribute assignment — a plain
         # setattr would shadow _resolved and hide later settings updates
@@ -483,7 +529,8 @@ class WebRTCService(BaseStreamingService):
                 await dm.resize(*geo, float(self.settings.framerate))
         except Exception:
             logger.debug("webrtc resize: no real display to resize")
-        for cap in list(self._captures.values()):
-            if cap.is_capturing():
-                await self._loop.run_in_executor(
-                    None, lambda c=cap: c.update_capture_region(0, 0, *geo))
+        cap = self._captures.get(display_id)
+        if cap is not None and cap.is_capturing():
+            ox, oy = self._display_rect(display_id)
+            await self._loop.run_in_executor(
+                None, lambda: cap.update_capture_region(ox, oy, *geo))

@@ -371,6 +371,10 @@ class WebSocketsService(BaseStreamingService):
             capture_x=self.display_offsets.get(display_id, (0, 0))[0],
             capture_y=self.display_offsets.get(display_id, (0, 0))[1],
             display_id=display_id,
+            # the logical id ("display2") is NOT an X address: every
+            # capture opens the configured server display and reads its
+            # own sub-rect
+            x_display=s.display_id,
             watermark_path=s.watermark_path,
             watermark_location=s.watermark_location,
         )

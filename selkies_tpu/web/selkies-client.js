@@ -184,6 +184,7 @@ class SelkiesClient {
 
   async _onRtcOffer(offer) {
     this._rtcTeardown();
+    this._lastOfferSdp = offer.sdp;
     let iceServers = (this.rtcConfig && this.rtcConfig.iceServers) || [];
     if (!iceServers.length) {
       try {
@@ -564,8 +565,15 @@ class SelkiesClient {
     if (this.rtcMode) {
       /* RTC transport: the mic rides the sendrecv audio m-line, which
        * needs a renegotiation so the answer can carry the track */
-      this._micWanted = true;
       if (this._micStream) return;           // already attached
+      if (this._lastOfferSdp &&
+          !/m=audio[^]*?a=sendrecv/.test(this._lastOfferSdp)) {
+        // server offered sendonly (mic disabled there): restarting the
+        // session would interrupt video for nothing, forever
+        this.status("microphone disabled by server", true);
+        return;
+      }
+      this._micWanted = true;
       this.status("microphone: renegotiating webrtc session");
       try {
         this.sigWs.send("SESSION_END");

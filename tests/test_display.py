@@ -2,6 +2,8 @@
 ``cvt -r`` outputs (pure functions — no X server needed)."""
 
 import asyncio
+import os
+import time
 
 from selkies_tpu.display import DisplayManager, cvt_rb_modeline
 
@@ -177,3 +179,78 @@ async def test_two_displays_stream_independently(client_factory):
     assert svc.display_offsets["display2"] == (1280, 0)
     await ws1.close()
     await ws2.close()
+
+
+# ----------------------------------------------------- WM / DE chain
+def _script(bin_dir, name, body):
+    p = bin_dir / name
+    p.write_text("#!/bin/sh\n" + body)
+    p.chmod(0o755)
+    return p
+
+
+async def test_wm_detection_via_ewmh(tmp_path, monkeypatch):
+    """EWMH detection: root _NET_SUPPORTING_WM_CHECK -> check window's
+    _NET_WM_NAME (reference display_utils.py WM detect)."""
+    from selkies_tpu.display import DisplayManager
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    _script(bin_dir, "xprop", """
+case "$*" in
+  *_NET_SUPPORTING_WM_CHECK*) echo '_NET_SUPPORTING_WM_CHECK(WINDOW): window id # 0x60000a' ;;
+  *_NET_WM_NAME*) echo '_NET_WM_NAME(UTF8_STRING) = "Xfwm4"' ;;
+esac
+""")
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    dm = DisplayManager(":77")
+    assert await dm.detect_window_manager() == "Xfwm4"
+    # cached: a second call must not re-probe (remove the script)
+    (bin_dir / "xprop").unlink()
+    assert await dm.detect_window_manager() == "Xfwm4"
+
+
+async def test_dpi_chain_hits_xfconf_for_xfce(tmp_path, monkeypatch):
+    """set_dpi applies xrdb AND the matching DE tool: under Xfwm4 the
+    xfconf xsettings property is written; gsettings is NOT called
+    (reference display_utils.py:1391 DPI chain)."""
+    from selkies_tpu.display import DisplayManager
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    log = tmp_path / "calls.log"
+    _script(bin_dir, "xprop", """
+case "$*" in
+  *_NET_SUPPORTING_WM_CHECK*) echo 'window id # 0x1' ;;
+  *_NET_WM_NAME*) echo '= "Xfwm4"' ;;
+esac
+""")
+    _script(bin_dir, "xrdb", f"cat >> {log}.xrdb\n")
+    _script(bin_dir, "xfconf-query", f'echo "$@" >> {log}.xfconf\n')
+    _script(bin_dir, "gsettings", f'echo "$@" >> {log}.gsettings\n')
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    dm = DisplayManager(":77")
+    await dm.set_dpi(144)
+    assert "Xft.dpi: 144" in (tmp_path / "calls.log.xrdb").read_text()
+    xf = (tmp_path / "calls.log.xfconf").read_text()
+    assert "/Xft/DPI" in xf and "-s 144" in xf
+    assert not (tmp_path / "calls.log.gsettings").exists()
+    await dm.set_cursor_size(48)
+    assert "/Gtk/CursorThemeSize" in \
+        (tmp_path / "calls.log.xfconf").read_text()
+
+
+async def test_wm_swap_spawns_replacement(tmp_path, monkeypatch):
+    from selkies_tpu.display import DisplayManager
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    log = tmp_path / "wm.log"
+    _script(bin_dir, "openbox", f'echo "$@" > {log}\n')
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    dm = DisplayManager(":77")
+    dm._wm_name = "Xfwm4"
+    assert await dm.swap_window_manager("openbox")
+    deadline = time.time() + 5
+    while time.time() < deadline and not log.exists():
+        await asyncio.sleep(0.05)
+    assert "--replace" in log.read_text()
+    assert dm._wm_name is None           # re-detect after swap
+    assert not await dm.swap_window_manager("missing-wm")

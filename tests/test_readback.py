@@ -1,0 +1,33 @@
+"""Minimal-readback fetch (engine/readback.py, PERF.md lever 4)."""
+
+import numpy as np
+
+from selkies_tpu.engine.readback import (MIN_BUCKET, bucket_for,
+                                         fetch_stream_bytes)
+
+
+def test_bucket_ladder():
+    assert bucket_for(0) == MIN_BUCKET
+    assert bucket_for(1) == MIN_BUCKET
+    assert bucket_for(MIN_BUCKET) == MIN_BUCKET
+    assert bucket_for(MIN_BUCKET + 1) == 2 * MIN_BUCKET
+    assert bucket_for(100_000) == 131072
+
+
+def test_fetch_prefix_is_byte_identical():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(1)
+    full = rng.integers(0, 256, (4 * MIN_BUCKET,), dtype=np.uint8)
+    dev = jnp.asarray(full)
+    for total in (0, 1, 1000, MIN_BUCKET, MIN_BUCKET + 7,
+                  3 * MIN_BUCKET, 4 * MIN_BUCKET):
+        got = fetch_stream_bytes(dev, total)
+        assert len(got) >= total
+        assert np.array_equal(got[:total], full[:total]), total
+
+
+def test_small_buffer_fetches_whole():
+    import jax.numpy as jnp
+    full = np.arange(100, dtype=np.uint8)
+    got = fetch_stream_bytes(jnp.asarray(full), 50)
+    assert np.array_equal(got, full)     # buffer smaller than a bucket

@@ -676,11 +676,12 @@ def test_peer_mic_reorder_buffer():
 
 def test_service_mic_packet_feeds_virtual_mic_path():
     """An Opus browser-mic packet decodes and lands on play_mic_pcm as
-    24 kHz mono s16 (half the 48 kHz decode length)."""
+    24 kHz mono s16 (half the 48 kHz decode length); each session keeps
+    ITS OWN stateful decoder so two peers can't garble each other."""
     from selkies_tpu.audio import opus
     if not opus.available():
         pytest.skip("libopus missing")
-    from selkies_tpu.server.webrtc_service import WebRTCService
+    from selkies_tpu.server.webrtc_service import WebRTCService, _Session
     from selkies_tpu.settings import AppSettings
 
     s = AppSettings.parse([], {})
@@ -694,15 +695,25 @@ def test_service_mic_packet_feeds_virtual_mic_path():
             self.chunks.append(pcm)
 
     svc.audio = FakeAudio()
+    svc._sessions = {"a": _Session("a", object(), "primary"),
+                     "b": _Session("b", object(), "primary")}
     enc = opus.Encoder(48000, 1, 64000)
     t = np.arange(960) / 48000.0
     pcm = (np.sin(2 * np.pi * 440 * t) * 12000).astype(np.int16)
-    payload = enc.encode(pcm)
-    svc._on_mic_packet(payload, 0, 0)
-    svc._on_mic_packet(enc.encode(pcm), 1, 960)
-    assert len(svc.audio.chunks) == 2
+    svc._on_mic_packet("a", enc.encode(pcm))
+    svc._on_mic_packet("a", enc.encode(pcm))
+    svc._on_mic_packet("b", opus.Encoder(48000, 1, 64000).encode(pcm))
+    assert len(svc.audio.chunks) == 3
     # 20 ms at 48k mono decodes to 960 samples -> 480 samples at 24k
     assert len(svc.audio.chunks[1]) == 480 * 2
+    # stateful decode is per-session, never shared
+    assert svc._sessions["a"].mic_decoder is not None
+    assert svc._sessions["b"].mic_decoder is not None
+    assert svc._sessions["a"].mic_decoder is not \
+        svc._sessions["b"].mic_decoder
+    # unknown session: dropped, no decoder allocated
+    svc._on_mic_packet("ghost", enc.encode(pcm))
+    assert len(svc.audio.chunks) == 3
 
 
 async def test_per_display_fanout_routing():
