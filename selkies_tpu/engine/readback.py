@@ -25,7 +25,11 @@ MIN_BUCKET = 32768
 @functools.lru_cache(maxsize=64)
 def _slice_fn(bucket: int):
     import jax
-    return jax.jit(lambda d: d[:bucket])
+    # last-axis prefix: works for the single-seat (out_cap,) buffer AND
+    # the seat-sharded (S, out_cap) buffer — slicing the minor axis
+    # preserves the seat-axis sharding, so each device ships only its
+    # own prefix
+    return jax.jit(lambda d: d[..., :bucket])
 
 
 def bucket_for(total: int) -> int:
@@ -36,11 +40,12 @@ def bucket_for(total: int) -> int:
 
 
 def fetch_stream_bytes(data_dev, total: int) -> np.ndarray:
-    """Fetch the first ``total`` bytes of the device stream buffer,
-    rounded up to a bucket so the jit cache stays tiny."""
+    """Fetch the first ``total`` bytes (along the last axis) of the
+    device stream buffer, rounded up to a bucket so the jit cache stays
+    tiny."""
     if total <= 0:
-        return np.zeros((0,), np.uint8)
-    n = int(data_dev.shape[0])
+        return np.zeros(tuple(data_dev.shape[:-1]) + (0,), np.uint8)
+    n = int(data_dev.shape[-1])
     bucket = bucket_for(total)
     if bucket >= n:
         return np.asarray(data_dev)

@@ -145,7 +145,9 @@ class MultiSeatH264Encoder:
         self._ref_y, self._ref_u, self._ref_v = ry, ru, rv
         fid = self.frame_id
         self.frame_id = (self.frame_id + 1) & 0xFFFF
-        for arr in (data, row_lens, send, is_paint, overflow):
+        # small control arrays only; the stream buffer is fetched
+        # minimally at finalize (engine/readback.py)
+        for arr in (row_lens, send, is_paint, overflow):
             try:
                 arr.copy_to_host_async()
             except Exception:
@@ -159,10 +161,14 @@ class MultiSeatH264Encoder:
                  ) -> list[list[EncodedChunk]]:
         del force_all                       # encode()-time decision
         g = self.grid
-        data = np.asarray(out["data"])      # (S, out_cap)
         lens = np.asarray(out["lens"])      # (S, R)
         send = np.asarray(out["send"])      # (S, n_stripes)
         overflow = np.asarray(out["overflow"])   # (S,)
+        # minimal readback (engine/readback.py): the max seat total sets
+        # one shared bucket; unsent capacity never crosses the link
+        from ..engine.readback import fetch_stream_bytes
+        data = fetch_stream_bytes(out["data"],
+                                  int(lens.sum(axis=1).max()))
         intra = out["intra"]
         if overflow.any():
             if out["cap_gen"] == self._cap_gen:

@@ -146,7 +146,9 @@ class MultiSeatEncoder:
         self._age = age
         fid = self.frame_id
         self.frame_id = (self.frame_id + 1) & 0xFFFF
-        for arr in (data, lens, send, is_paint, overflow):
+        # small control arrays only; the stream buffer is fetched
+        # minimally at finalize (engine/readback.py)
+        for arr in (lens, send, is_paint, overflow):
             try:
                 arr.copy_to_host_async()
             except Exception:
@@ -160,11 +162,15 @@ class MultiSeatEncoder:
                  ) -> list[list[EncodedChunk]]:
         """Blocks on readback; returns ``chunks[seat]`` lists."""
         g = self.grid
-        data = np.asarray(out["data"])        # (S, out_cap)
         lens = np.asarray(out["lens"])        # (S, n_stripes)
         send = np.asarray(out["send"])
         is_paint = np.asarray(out["is_paint"])
         overflow = np.asarray(out["overflow"])  # (S,)
+        # minimal readback (engine/readback.py): every seat ships the
+        # same bucket — the max over seats — instead of full capacity
+        from ..engine.readback import fetch_stream_bytes
+        data = fetch_stream_bytes(out["data"],
+                                  int(lens.sum(axis=1).max()))
         qy_m, qc_m, qy_p, qc_p = out["qtabs"]
 
         if overflow.any():
