@@ -695,6 +695,15 @@ class WebSocketsService(BaseStreamingService):
             if self.audio is not None and self.settings.enable_microphone \
                     and client.role == "full":
                 self.audio.play_mic_pcm(data[1:])
+            elif not getattr(client, "mic_denied_told", False):
+                # reference parity (selkies.py MICROPHONE_DISABLED): tell
+                # the sender ONCE so its UI can stop the capture instead
+                # of streaming into a void
+                client.mic_denied_told = True
+                try:
+                    await client.ws.send_str("MICROPHONE_DISABLED")
+                except (ConnectionError, RuntimeError):
+                    pass
 
     async def _on_text(self, client: ClientConnection, text: str) -> None:
         verb = P.parse_verb(text)
@@ -781,6 +790,21 @@ class WebSocketsService(BaseStreamingService):
             self.audio.update_bitrate(int(applied["audio_bitrate"]))
         if "keyboard_layout" in applied:
             await self._apply_keyboard_layout(str(applied["keyboard_layout"]))
+        if applied.get("window_manager"):
+            # live WM swap (reference webrtc_mode WM detect/swap). A
+            # client-writable exec MUST be safelisted — otherwise any
+            # full client runs arbitrary binaries (the opt-in `cmd` verb
+            # is the sanctioned escape hatch, not this)
+            wm = str(applied["window_manager"]).strip()
+            allowed = {"xfwm4", "openbox", "mutter", "kwin_x11", "i3",
+                       "twm", "fluxbox", "icewm", "marco", "metacity"}
+            if wm in allowed:
+                from ..display import DisplayManager
+                dm = DisplayManager(self.settings.display_id)
+                await dm.swap_window_manager(wm)
+            else:
+                logger.info("window_manager %r not in the safelist; "
+                            "ignored", wm)
 
     async def _apply_keyboard_layout(self, layout: str) -> None:
         """Align the X keymap with the client's detected layout

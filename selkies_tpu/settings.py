@@ -178,6 +178,10 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
        "XKB layout aligned to the client's detected keyboard "
        "(client-writable; applied via setxkbmap when X is live).",
        client=True),
+    _s("window_manager", SType.STR, "",
+       "Live window-manager swap: command exec'd with --replace "
+       "(reference display_utils.py WM detect/swap). Empty keeps the "
+       "running WM.", client=True),
     _s("display2_position", SType.STR, "right",
        "Where display2 extends the desktop relative to the primary.",
        choices=("right", "left", "above", "below"), client=True),

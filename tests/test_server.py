@@ -750,3 +750,53 @@ async def test_keyframe_targets_requesting_display_only(client_factory):
         "other display must NOT be IDR-stormed"
     await ws1.close()
     await ws2.close()
+
+
+async def test_mic_disabled_notice_once(client_factory):
+    """0x02 frames with the mic disabled get ONE MICROPHONE_DISABLED
+    (reference parity) so the client UI can stop capturing."""
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive()                      # MODE
+    await ws.receive()                      # server_settings
+    await ws.send_bytes(b"\x02" + b"\x00" * 32)
+    await ws.send_bytes(b"\x02" + b"\x00" * 32)
+    got = []
+    try:
+        while True:
+            msg = await asyncio.wait_for(ws.receive(), timeout=1.5)
+            if msg.type == WSMsgType.TEXT and "MICROPHONE" in msg.data:
+                got.append(msg.data)
+    except asyncio.TimeoutError:
+        pass
+    assert got == ["MICROPHONE_DISABLED"]
+
+
+async def test_window_manager_swap_safelisted(client_factory, tmp_path,
+                                              monkeypatch):
+    """SETTINGS window_manager execs only safelisted WMs (a client-
+    writable exec must never run arbitrary binaries)."""
+    import os as _os
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    log = tmp_path / "wm.log"
+    s = bin_dir / "openbox"
+    s.write_text(f"#!/bin/sh\necho \"$@\" > {log}\n")
+    s.chmod(0o755)
+    evil = bin_dir / "evilbin"
+    evil.write_text(f"#!/bin/sh\necho evil > {log}.evil\n")
+    evil.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bin_dir}:{_os.environ['PATH']}")
+
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive(); await ws.receive()
+    await ws.send_str('SETTINGS,{"window_manager": "evilbin"}')
+    await ws.send_str('SETTINGS,{"window_manager": "openbox"}')
+    deadline = asyncio.get_event_loop().time() + 5
+    while asyncio.get_event_loop().time() < deadline and not log.exists():
+        await asyncio.sleep(0.05)
+    assert log.exists() and "--replace" in log.read_text()
+    assert not (tmp_path / "wm.log.evil").exists()
