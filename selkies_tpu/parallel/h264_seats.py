@@ -164,11 +164,18 @@ class MultiSeatH264Encoder:
         lens = np.asarray(out["lens"])      # (S, R)
         send = np.asarray(out["send"])      # (S, n_stripes)
         overflow = np.asarray(out["overflow"])   # (S,)
-        # minimal readback (engine/readback.py): the max seat total sets
-        # one shared bucket; unsent capacity never crosses the link
+        # minimal readback (engine/readback.py), matching the
+        # single-seat shape: per seat only rows through the last SENT
+        # stripe count; all-idle frames fetch nothing
         from ..engine.readback import fetch_stream_bytes
-        data = fetch_stream_bytes(out["data"],
-                                  int(lens.sum(axis=1).max()))
+        rps_ = g.rows_per_stripe
+        total = 0
+        for seat in range(self.n_seats):
+            if overflow[seat] or not send[seat].any():
+                continue
+            last_row = (int(np.nonzero(send[seat])[0][-1]) + 1) * rps_
+            total = max(total, int(lens[seat, :last_row].sum()))
+        data = fetch_stream_bytes(out["data"], total) if total else None
         intra = out["intra"]
         if overflow.any():
             if out["cap_gen"] == self._cap_gen:

@@ -166,11 +166,6 @@ class MultiSeatEncoder:
         send = np.asarray(out["send"])
         is_paint = np.asarray(out["is_paint"])
         overflow = np.asarray(out["overflow"])  # (S,)
-        # minimal readback (engine/readback.py): every seat ships the
-        # same bucket — the max over seats — instead of full capacity
-        from ..engine.readback import fetch_stream_bytes
-        data = fetch_stream_bytes(out["data"],
-                                  int(lens.sum(axis=1).max()))
         qy_m, qc_m, qy_p, qc_p = out["qtabs"]
 
         if overflow.any():
@@ -183,6 +178,21 @@ class MultiSeatEncoder:
             self._out_cap *= 2
             self._step = self._build_step()
             self._force_after_drop |= overflow
+
+        # minimal readback (engine/readback.py), matching the
+        # single-seat shape: per seat only bytes through the last
+        # DELIVERED stripe count; all-idle frames fetch nothing
+        from ..engine.readback import fetch_stream_bytes
+        total = 0
+        for seat in range(self.n_seats):
+            if overflow[seat]:
+                continue
+            if force_all or self._force_after_drop[seat]:
+                total = max(total, int(lens[seat].sum()))
+            elif send[seat].any():
+                last = int(np.nonzero(send[seat])[0][-1])
+                total = max(total, int(lens[seat, :last + 1].sum()))
+        data = fetch_stream_bytes(out["data"], total) if total else None
 
         results: list[list[EncodedChunk]] = []
         for seat in range(self.n_seats):

@@ -791,20 +791,13 @@ class WebSocketsService(BaseStreamingService):
         if "keyboard_layout" in applied:
             await self._apply_keyboard_layout(str(applied["keyboard_layout"]))
         if applied.get("window_manager"):
-            # live WM swap (reference webrtc_mode WM detect/swap). A
-            # client-writable exec MUST be safelisted — otherwise any
-            # full client runs arbitrary binaries (the opt-in `cmd` verb
-            # is the sanctioned escape hatch, not this)
-            wm = str(applied["window_manager"]).strip()
-            allowed = {"xfwm4", "openbox", "mutter", "kwin_x11", "i3",
-                       "twm", "fluxbox", "icewm", "marco", "metacity"}
-            if wm in allowed:
-                from ..display import DisplayManager
-                dm = DisplayManager(self.settings.display_id)
-                await dm.swap_window_manager(wm)
-            else:
-                logger.info("window_manager %r not in the safelist; "
-                            "ignored", wm)
+            # live WM swap (reference webrtc_mode WM detect/swap).
+            # Safelist enforcement lives in the setting's choices= — a
+            # rejected value never reaches here. Reuse the long-lived
+            # manager so its _wm_name cache invalidates (set_dpi's DE
+            # chain reads it) and the DI hook stays honoured.
+            await self.display_manager.swap_window_manager(
+                str(applied["window_manager"]))
 
     async def _apply_keyboard_layout(self, layout: str) -> None:
         """Align the X keymap with the client's detected layout

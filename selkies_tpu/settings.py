@@ -179,9 +179,13 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
        "(client-writable; applied via setxkbmap when X is live).",
        client=True),
     _s("window_manager", SType.STR, "",
-       "Live window-manager swap: command exec'd with --replace "
-       "(reference display_utils.py WM detect/swap). Empty keeps the "
-       "running WM.", client=True),
+       "Live window-manager swap: exec'd with --replace (reference "
+       "display_utils.py WM detect/swap). Safelisted at the settings "
+       "layer — a client-writable exec must never run arbitrary "
+       "binaries. Empty keeps the running WM.",
+       choices=("", "xfwm4", "openbox", "mutter", "kwin_x11", "i3",
+                "twm", "fluxbox", "icewm", "marco", "metacity"),
+       client=True),
     _s("display2_position", SType.STR, "right",
        "Where display2 extends the desktop relative to the primary.",
        choices=("right", "left", "above", "below"), client=True),
@@ -518,6 +522,11 @@ class AppSettings:
             return value
         if not isinstance(value, str):
             raise SettingsError(f"{name}: expected string")
+        # STR settings may carry a choices safelist too (window_manager,
+        # display2_position) — the CLI/env parser enforces it at :345,
+        # and the client path must be no laxer
+        if d.choices and value not in d.choices:
+            raise SettingsError(f"{name}: {value!r} not in {d.choices}")
         return value
 
     def apply_client_setting(self, name: str, value: Any) -> Any:
