@@ -374,6 +374,10 @@ class SelkiesClient {
         break;
       case "VIDEO_STOPPED": this.videoActive = false; break;
       case "AUDIO_DISABLED": if (this.audio) { this.audio.close(); this.audio = null; } break;
+      case "MICROPHONE_DISABLED":
+        this.stopMic();
+        this.status("microphone disabled by server", true);
+        break;
       case "settings_applied": break;
       case "clipboard": this._applyClipboard(rest); break;
       case "system_msg": this.status(rest); break;
