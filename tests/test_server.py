@@ -800,3 +800,31 @@ async def test_window_manager_swap_safelisted(client_factory, tmp_path,
         await asyncio.sleep(0.05)
     assert log.exists() and "--replace" in log.read_text()
     assert not (tmp_path / "wm.log.evil").exists()
+
+
+async def test_rtc_config_file_pushes_to_clients(client_factory, tmp_path):
+    """rtc_config_file edits reach connected clients as an rtc_config
+    push (reference RTCConfigFileMonitor end-to-end)."""
+    import os as _os
+    path = tmp_path / "rtc.json"
+    server, svc, fake, _ = make_app(rtc_config_file=str(path))
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive(); await ws.receive()
+    # monitor polls at 1 s; write after connect so the push targets us
+    path.write_text(json.dumps({"iceServers": [{"urls": ["stun:x"]}]}))
+    _os.chmod(path, 0o600)
+    got = None
+    deadline = asyncio.get_event_loop().time() + 6
+    while asyncio.get_event_loop().time() < deadline:
+        try:
+            msg = await asyncio.wait_for(ws.receive(), timeout=2)
+        except asyncio.TimeoutError:
+            continue
+        if msg.type == WSMsgType.TEXT and msg.data.startswith("rtc_config"):
+            got = msg.data
+            break
+    assert got is not None
+    cfg = json.loads(got.split(",", 1)[1])
+    assert cfg["iceServers"][0]["urls"] == ["stun:x"]
+    await ws.close()
