@@ -788,6 +788,9 @@ class WebSocketsService(BaseStreamingService):
                         None, lambda c=cap, s=new_settings: c.restart(s))
         if "audio_bitrate" in applied and self.audio is not None:
             self.audio.update_bitrate(int(applied["audio_bitrate"]))
+        if "audio_red_distance" in applied and self.audio is not None:
+            # live regate: the pipeline reads red_distance per frame
+            self.audio.red_distance = int(applied["audio_red_distance"])
         if "keyboard_layout" in applied:
             await self._apply_keyboard_layout(str(applied["keyboard_layout"]))
         if applied.get("window_manager"):

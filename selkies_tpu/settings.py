@@ -205,8 +205,10 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
        vmin=2.5, vmax=60.0),
     _s("audio_channels", SType.INT, 2, "Capture channels.", vmin=1, vmax=8),
     _s("audio_red_distance", SType.INT, 2,
-       "Opus RED (RFC 2198) redundancy depth; gated on all-clients-capable "
-       "(reference selkies.py:949-973).", vmin=0, vmax=4),
+       "Opus RED (RFC 2198) redundancy depth; client-writable so a "
+       "RED-incapable client can zero it — the all-clients-capable "
+       "regate (reference selkies.py:949-973).", vmin=0, vmax=4,
+       client=True),
     _s("audio_backpressure_queue", SType.INT, 120,
        "Max queued audio chunks per client before drop (reference settings.py:899-905)."),
     _s("enable_microphone", SType.BOOL, True, "Accept client mic and play back."),

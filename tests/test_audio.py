@@ -364,3 +364,18 @@ async def test_pipeline_surround_head_in_settings():
     js = (pathlib.Path(__file__).parent.parent / "selkies_tpu" / "web"
           / "lib" / "audio.js").read_text()
     assert "audio_head" in js and "description" in js
+
+
+async def test_red_distance_client_regate():
+    """A RED-incapable client zeroes audio_red_distance live: the next
+    frames carry n_red=0 (reference all-clients-capable regate,
+    selkies.py:949-973)."""
+    from selkies_tpu.audio.pipeline import AudioPipeline
+    if not opus.available():
+        pytest.skip("libopus missing")
+    s = AppSettings.parse([], {})
+    p = AudioPipeline(s, source=SyntheticToneSource(48000, 2, 480))
+    assert p.red_distance == 2
+    val = s.apply_client_setting("audio_red_distance", 0)
+    p.red_distance = int(val)     # ws_service._apply_live_settings path
+    assert p.red_distance == 0
