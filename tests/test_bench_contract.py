@@ -1,0 +1,35 @@
+"""bench.py driver contract: prints exactly ONE JSON line on stdout with
+the keys the driver records (BENCH_r{N}.json). Runs the real bench at a
+tiny geometry so the whole thing stays inside the CI budget."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_bench_emits_single_json_line():
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               BENCH_WIDTH="256", BENCH_HEIGHT="128",
+               BENCH_FRAMES="6", BENCH_LAT_BUDGET_S="10",
+               BENCH_TP_BUDGET_S="10", BENCH_PROBE_BUDGET_S="1")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([sys.executable, str(ROOT / "bench.py")],
+                       capture_output=True, text=True, timeout=900,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line: {lines}"
+    doc = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "backend"):
+        assert key in doc, key
+    assert doc["unit"] == "fps"
+    assert isinstance(doc["value"], (int, float))
+    # explicit fallback labelling (VERDICT r3 weak 5): never a silent
+    # CPU number
+    assert doc["backend"].startswith(("cpu-fallback", "cpu", "tpu",
+                                      "axon"))
