@@ -168,8 +168,16 @@ def test_keyframe_interval_forces_periodic_refresh():
     s.keyframe_interval_s = 0.25
     cap = ScreenCapture(source_kind="synthetic-static")
     cap.start_capture(got.append, s)
-    deadline = time.time() + 30
+    # two-phase deadline (PERF.md rules): phase 1 absorbs the XLA
+    # compile (this box has ONE core — a cold jit under suite load can
+    # eat most of a flat 30 s window and flake the cadence assertion);
+    # phase 2 times only the refresh cadence from the first delivery
+    deadline = time.time() + 120
+    while time.time() < deadline and not got:
+        time.sleep(0.05)
+    assert got, "no first frame within the compile window"
     n = 2 * (s.capture_height // s.stripe_height)  # two full refreshes
+    deadline = time.time() + 30
     while time.time() < deadline and len(got) < n + 1:
         time.sleep(0.05)
     cap.stop_capture()
