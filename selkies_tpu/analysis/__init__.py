@@ -1,0 +1,21 @@
+"""graftlint — AST-based static analysis for the selkies-tpu codebase.
+
+Two defect families dominate this stack's post-mortems (ADVICE.md r5,
+VERDICT.md): silent device->host syncs / recompilation hazards in the
+per-frame JAX hot path, and asyncio hygiene bugs in the server plane.
+graftlint catches both at review time with a repo-local rule set:
+
+- ``rules_jax``     — host syncs, tracer branches, static-arg and
+                      donation hazards inside jit/pmap-traced code.
+- ``rules_asyncio`` — orphaned tasks, blocking calls in coroutines,
+                      swallowed exceptions in the server/webrtc planes.
+
+The CLI (``python -m selkies_tpu.analysis``) ratchets against
+``tools/graftlint_baseline.json``: pre-existing violations are
+tolerated, any *new* one fails CI.  Inline suppression:
+``# graftlint: disable=RULE-ID`` on the offending line or the line
+above it.
+"""
+from .core import Analyzer, Finding, Rule, Severity, default_rules
+
+__all__ = ["Analyzer", "Finding", "Rule", "Severity", "default_rules"]

@@ -1,0 +1,118 @@
+"""graftlint CLI.
+
+Usage:
+    python -m selkies_tpu.analysis [options] PATH [PATH ...]
+
+    --baseline FILE        ratchet: tolerate findings recorded in FILE,
+                           fail only on new ones
+    --write-baseline FILE  record the current findings as the new
+                           tolerated set and exit 0
+    --json                 machine-readable output (schema documented
+                           in README.md §graftlint)
+    --severity RULE=LEVEL  per-rule severity override (info|warning|
+                           error); info findings never gate
+    --list-rules           print the rule catalog and exit
+
+Exit codes: 0 clean (or everything baselined), 1 new gating findings,
+2 usage/parse error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .core import (Analyzer, Severity, default_rules, gating,
+                   load_baseline, make_baseline, new_findings)
+
+
+def _parse_severities(pairs: list[str]) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for p in pairs:
+        rule, sep, level = p.partition("=")
+        if not sep or level not in Severity.ALL:
+            raise ValueError(
+                f"bad --severity {p!r} (want RULE=LEVEL, LEVEL one of "
+                f"{'|'.join(Severity.ALL)})")
+        out[rule.strip().upper()] = level
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m selkies_tpu.analysis",
+        description="graftlint: JAX hot-path + asyncio-safety analyzer")
+    ap.add_argument("paths", nargs="*", help="files or directories")
+    ap.add_argument("--baseline", metavar="FILE")
+    ap.add_argument("--write-baseline", metavar="FILE")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--severity", action="append", default=[],
+                    metavar="RULE=LEVEL")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in default_rules():
+            print(f"{rule.rule_id:22s} [{rule.default_severity:7s}] "
+                  f"{rule.description}")
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        return 2
+
+    try:
+        overrides = _parse_severities(args.severity)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    analyzer = Analyzer(severity_overrides=overrides)
+    findings = analyzer.run(args.paths)
+    if analyzer.parse_errors:
+        for err in analyzer.parse_errors:
+            print(f"graftlint: {err}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        Path(args.write_baseline).write_text(
+            json.dumps(make_baseline(findings), indent=1) + "\n",
+            encoding="utf-8")
+        print(f"graftlint: wrote {len(findings)} entries to "
+              f"{args.write_baseline}")
+        return 0
+
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"graftlint: cannot load baseline: {e}", file=sys.stderr)
+            return 2
+    fresh = new_findings(findings, baseline)
+    gate = gating(fresh)
+
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "findings": [f.to_json() for f in findings],
+            "new": [f.to_json() for f in fresh],
+            "summary": {
+                "total": len(findings),
+                "baselined": len(findings) - len(fresh),
+                "new": len(fresh),
+                "gating": len(gate),
+            },
+        }, indent=1))
+    else:
+        for f in fresh:
+            tag = "" if f.severity != Severity.INFO else " (non-gating)"
+            print(f.render() + tag)
+        known = len(findings) - len(fresh)
+        print(f"graftlint: {len(findings)} finding(s), {known} "
+              f"baselined, {len(fresh)} new, {len(gate)} gating")
+    return 1 if gate else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

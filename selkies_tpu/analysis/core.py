@@ -1,0 +1,276 @@
+"""graftlint core: Rule / Finding / Analyzer plus the baseline ratchet.
+
+Design notes
+------------
+- Pure stdlib (``ast`` + ``json``): the analyzer must run in CI images
+  that have no jax wheel installed, so nothing here imports the
+  package's runtime modules.
+- A ``Rule`` sees one parsed module at a time (``ModuleInfo``) and
+  yields ``Finding``s.  Cross-module inference is deliberately out of
+  scope — module-local reachability already covers the per-frame encode
+  path, and anything subtler gets an inline suppression instead of a
+  cleverness arms race.
+- Baseline entries are keyed on (path, rule, normalized source text),
+  NOT line numbers, so unrelated edits that shift lines don't churn the
+  ratchet.  Duplicate identical lines are counted: a file may contain N
+  tolerated copies of a violation; the N+1-th is new.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field, replace
+from pathlib import Path
+from typing import Iterable, Iterator
+
+
+class Severity:
+    """String constants, ordered: info never gates CI, warning and
+    error do (a per-rule override can promote/demote any rule)."""
+    INFO = "info"
+    WARNING = "warning"
+    ERROR = "error"
+    ALL = (INFO, WARNING, ERROR)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule_id: str
+    path: str                 # posix-style, relative to the scan root
+    line: int                 # 1-based
+    col: int                  # 0-based, as reported by ast
+    message: str
+    severity: str
+    source: str = ""          # stripped text of the offending line
+    end_line: int = 0         # last physical line of the statement
+
+    def key(self) -> tuple[str, str, str]:
+        """Baseline identity: stable across line-number drift."""
+        return (self.path, self.rule_id, _normalize(self.source))
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule_id} [{self.severity}] {self.message}")
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule_id, "path": self.path, "line": self.line,
+            "col": self.col, "severity": self.severity,
+            "message": self.message, "source": self.source,
+        }
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file handed to every rule."""
+    path: str                 # posix-style relative path
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+class Rule:
+    """Base class.  Subclasses set the class attributes and implement
+    ``check``.  ``path_filter`` (regex, matched against the relative
+    posix path) scopes a rule to a subtree, e.g. the server plane."""
+    rule_id: str = ""
+    description: str = ""
+    default_severity: str = Severity.WARNING
+    path_filter: str | None = None
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: ModuleInfo, node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule_id=self.rule_id, path=module.path, line=line,
+            col=getattr(node, "col_offset", 0), message=message,
+            severity=self.default_severity,
+            source=module.line_text(line),
+            end_line=getattr(node, "end_lineno", None) or line)
+
+
+# -- suppression pragmas -----------------------------------------------------
+
+_PRAGMA = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+def _pragma_ids(text: str) -> set[str]:
+    m = _PRAGMA.search(text)
+    if not m:
+        return set()
+    return {p.strip().upper() for p in m.group(1).split(",") if p.strip()}
+
+
+def is_suppressed(module: ModuleInfo, finding: Finding) -> bool:
+    """``# graftlint: disable=RULE-ID`` (or ``disable=all``) on the
+    offending statement's first or last physical line, or ALONE on the
+    line directly above it (a trailing pragma on the previous statement
+    must not leak onto this one)."""
+    for lineno in (finding.line, finding.end_line or finding.line):
+        ids = _pragma_ids(module.line_text(lineno))
+        if "ALL" in ids or finding.rule_id.upper() in ids:
+            return True
+    above = module.line_text(finding.line - 1)
+    if above.startswith("#"):
+        ids = _pragma_ids(above)
+        if "ALL" in ids or finding.rule_id.upper() in ids:
+            return True
+    return False
+
+
+def _normalize(source_line: str) -> str:
+    """Collapse whitespace so reformatting doesn't invalidate baseline
+    entries."""
+    return re.sub(r"\s+", " ", source_line).strip()
+
+
+# -- analyzer ----------------------------------------------------------------
+
+class Analyzer:
+    def __init__(self, rules: Iterable[Rule] | None = None,
+                 severity_overrides: dict[str, str] | None = None):
+        self.rules: list[Rule] = list(rules) if rules is not None \
+            else default_rules()
+        self.severity_overrides = dict(severity_overrides or {})
+        self.parse_errors: list[str] = []
+
+    # file discovery ---------------------------------------------------------
+    def iter_files(self, paths: Iterable[str | Path]) -> Iterator[Path]:
+        seen: set[Path] = set()
+        for p in paths:
+            p = Path(p)
+            candidates = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+            for f in candidates:
+                f = f.resolve()
+                if f not in seen and f.suffix == ".py":
+                    seen.add(f)
+                    yield f
+
+    # entry points -----------------------------------------------------------
+    def run(self, paths: Iterable[str | Path],
+            root: str | Path | None = None) -> list[Finding]:
+        root = Path(root) if root is not None else Path.cwd()
+        findings: list[Finding] = []
+        for p in paths:
+            # a typo'd or renamed path must error, not report "clean" —
+            # a silently-empty scan would disable the CI gate forever
+            if not Path(p).exists():
+                self.parse_errors.append(f"{p}: no such file or directory")
+        for f in self.iter_files(paths):
+            try:
+                rel = f.relative_to(root.resolve()).as_posix()
+            except ValueError:
+                rel = f.as_posix()
+            try:
+                source = f.read_text(encoding="utf-8")
+            except OSError as e:
+                self.parse_errors.append(f"{rel}: unreadable: {e}")
+                continue
+            findings.extend(self.run_source(source, rel))
+        return sorted(findings,
+                      key=lambda x: (x.path, x.line, x.col, x.rule_id))
+
+    def run_source(self, source: str, path: str = "<string>"
+                   ) -> list[Finding]:
+        """Analyze one source string — also the test-fixture entry
+        point, so fixtures never need temp files."""
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.parse_errors.append(f"{path}: syntax error: {e}")
+            return []
+        module = ModuleInfo(path=path, source=source, tree=tree,
+                            lines=source.splitlines())
+        out: list[Finding] = []
+        seen: set[tuple[str, int, int]] = set()
+        for rule in self.rules:
+            if rule.path_filter and not re.search(rule.path_filter, path):
+                continue
+            for finding in rule.check(module):
+                # a nested def reachable two ways (lexically inside a
+                # hot body AND via the call-graph closure) must report
+                # once
+                k = (finding.rule_id, finding.line, finding.col)
+                if k in seen:
+                    continue
+                seen.add(k)
+                if is_suppressed(module, finding):
+                    continue
+                sev = self.severity_overrides.get(finding.rule_id)
+                if sev and sev != finding.severity:
+                    finding = replace(finding, severity=sev)
+                out.append(finding)
+        return out
+
+
+# -- baseline ratchet --------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def make_baseline(findings: Iterable[Finding]) -> dict:
+    """Serialize the current findings as the tolerated set.  Entries
+    carry the line number for human orientation only — matching uses
+    (path, rule, normalized source text) with multiplicity."""
+    entries = [
+        {"path": f.path, "rule": f.rule_id, "line": f.line,
+         "source": _normalize(f.source)}
+        for f in sorted(findings, key=lambda x: (x.path, x.line, x.rule_id))
+    ]
+    return {"version": BASELINE_VERSION, "entries": entries}
+
+
+def load_baseline(path: str | Path) -> dict:
+    data = json.loads(Path(path).read_text(encoding="utf-8"))
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')!r}")
+    entries = data.get("entries")
+    if not isinstance(entries, list):
+        raise ValueError(f"baseline {path}: 'entries' must be a list")
+    for i, e in enumerate(entries):
+        if not (isinstance(e, dict) and isinstance(e.get("path"), str)
+                and isinstance(e.get("rule"), str)):
+            raise ValueError(
+                f"baseline {path}: entry {i} needs string 'path' and "
+                "'rule' fields")
+    return data
+
+
+def new_findings(findings: Iterable[Finding],
+                 baseline: dict | None) -> list[Finding]:
+    """The ratchet: return findings NOT covered by the baseline.
+    Multiplicity-aware — a baseline entry absorbs exactly one matching
+    finding, so adding a second identical violation in the same file
+    still fails."""
+    budget: dict[tuple[str, str, str], int] = {}
+    for e in (baseline or {}).get("entries", []):
+        k = (e["path"], e["rule"], _normalize(e.get("source", "")))
+        budget[k] = budget.get(k, 0) + 1
+    fresh: list[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            fresh.append(f)
+    return fresh
+
+
+def gating(findings: Iterable[Finding]) -> list[Finding]:
+    """Findings that fail the build (info never gates)."""
+    return [f for f in findings if f.severity != Severity.INFO]
+
+
+def default_rules() -> list[Rule]:
+    from . import rules_asyncio, rules_jax
+    return [*rules_jax.RULES, *rules_asyncio.RULES]

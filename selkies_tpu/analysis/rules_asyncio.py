@@ -1,0 +1,173 @@
+"""asyncio safety rules for the server plane.
+
+The control plane is one event loop shared by every seat: a task whose
+only reference is the ``ensure_future`` return value can be collected
+mid-flight (CPython only keeps a weak reference — the exact bug
+ADVICE.md r5 flagged at ws_service.py:450), a single blocking call
+stalls every connected client, and ``except Exception: pass`` in the
+server/webrtc planes has repeatedly hidden real teardown bugs.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import Finding, ModuleInfo, Rule, Severity
+
+_SPAWN_NAMES = {"ensure_future", "create_task"}
+# module-qualified blocking calls; builtins handled separately
+_BLOCKING_CALLS = {
+    ("time", "sleep"): "time.sleep() blocks the event loop — use "
+                       "await asyncio.sleep()",
+    ("subprocess", "run"): "subprocess.run() blocks the event loop — "
+                           "use asyncio.create_subprocess_exec()",
+    ("subprocess", "call"): "subprocess.call() blocks the event loop — "
+                            "use asyncio.create_subprocess_exec()",
+    ("subprocess", "check_call"): "subprocess.check_call() blocks the "
+                                  "event loop — use "
+                                  "asyncio.create_subprocess_exec()",
+    ("subprocess", "check_output"): "subprocess.check_output() blocks "
+                                    "the event loop — use "
+                                    "asyncio.create_subprocess_exec()",
+    ("os", "system"): "os.system() blocks the event loop — use "
+                      "asyncio.create_subprocess_shell()",
+}
+
+
+def _is_spawn_call(node: ast.Call) -> bool:
+    """asyncio.ensure_future / asyncio.create_task / loop.create_task /
+    bare ensure_future."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in _SPAWN_NAMES
+    if isinstance(f, ast.Attribute):
+        return f.attr in _SPAWN_NAMES
+    return False
+
+
+def _taskgroup_names(module: ModuleInfo) -> set[str]:
+    """Names bound by ``async with [asyncio.]TaskGroup() as tg`` —
+    their create_task results are retained by the group itself."""
+    names: set[str] = set()
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.AsyncWith, ast.With)):
+            continue
+        for item in node.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Call):
+                f = ctx.func
+                is_tg = (isinstance(f, ast.Name) and
+                         f.id == "TaskGroup") or \
+                        (isinstance(f, ast.Attribute) and
+                         f.attr == "TaskGroup")
+                if is_tg and isinstance(item.optional_vars, ast.Name):
+                    names.add(item.optional_vars.id)
+    return names
+
+
+class AsyncOrphanTaskRule(Rule):
+    rule_id = "ASYNC-ORPHAN-TASK"
+    description = ("ensure_future()/create_task() whose result is "
+                   "discarded — the loop holds only a weak reference, "
+                   "so the task can be garbage-collected before it "
+                   "runs; retain it (e.g. in a task set with a "
+                   "done-callback discard)")
+    default_severity = Severity.ERROR
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        groups = _taskgroup_names(module)
+        for node in ast.walk(module.tree):
+            # a spawn as a bare expression statement is the discard
+            # pattern; assignment / await / return / argument position
+            # all retain a reference
+            if isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_spawn_call(node.value):
+                f = node.value.func
+                # a TaskGroup retains its children: tg.create_task()
+                # with the result discarded is the documented idiom
+                if isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in groups:
+                    continue
+                name = f.attr if isinstance(f, ast.Attribute) else f.id
+                yield self.finding(
+                    module, node.value,
+                    f"{name}() result is discarded — the task may be "
+                    "garbage-collected before running; store it and "
+                    "add a done-callback")
+
+
+class AsyncBlockingCallRule(Rule):
+    rule_id = "ASYNC-BLOCKING-CALL"
+    description = ("blocking call (time.sleep / subprocess.run / "
+                   "open()) lexically inside an async def stalls the "
+                   "whole event loop")
+    default_severity = Severity.ERROR
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                yield from self._scan(module, node)
+
+    def _scan(self, module: ModuleInfo,
+              fn: ast.AsyncFunctionDef) -> Iterator[Finding]:
+        """Walk the coroutine body but stop at nested *sync* defs and
+        lambdas — those are typically executor thunks and run
+        off-loop."""
+        stack: list[ast.AST] = list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                hit = self._blocking(node)
+                if hit:
+                    yield self.finding(
+                        module, node,
+                        f"{hit} (inside 'async def {fn.name}')")
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _blocking(node: ast.Call) -> str | None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            return _BLOCKING_CALLS.get((f.value.id, f.attr))
+        if isinstance(f, ast.Name) and f.id == "open":
+            return ("open() does synchronous file I/O on the event "
+                    "loop — read/write in an executor")
+        return None
+
+
+class AsyncSwallowedExcRule(Rule):
+    rule_id = "ASYNC-SWALLOWED-EXC"
+    description = ("'except Exception: pass' in the server/webrtc "
+                   "planes hides teardown bugs — log it or narrow the "
+                   "exception type")
+    default_severity = Severity.WARNING
+    path_filter = r"(^|/)selkies_tpu/(server|webrtc)/"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not all(isinstance(s, ast.Pass) for s in node.body):
+                continue
+            t = node.type
+            broad = t is None or (
+                isinstance(t, ast.Name) and
+                t.id in ("Exception", "BaseException")) or (
+                isinstance(t, ast.Attribute) and
+                t.attr in ("Exception", "BaseException"))
+            if broad:
+                label = "bare except" if t is None else \
+                    f"except {ast.unparse(t)}"
+                yield self.finding(
+                    module, node,
+                    f"{label}: pass swallows every error — log at "
+                    "debug level or narrow the exception type")
+
+
+RULES: list[Rule] = [
+    AsyncOrphanTaskRule(), AsyncBlockingCallRule(), AsyncSwallowedExcRule(),
+]

@@ -1,0 +1,532 @@
+"""JAX hot-path rules.
+
+The per-frame encode path must stay on-device: a single stray
+``np.asarray`` / ``.item()`` inside traced code forces a device->host
+round-trip every frame, and an untraced Python branch or a varying
+Python scalar argument re-triggers XLA compilation (minutes on a cold
+TPU geometry — see compile_cache.py).  These rules do *module-local*
+reachability: a function is "hot" when it is decorated with
+``jax.jit``/``jax.pmap`` (directly or via ``partial``), wrapped by a
+``jax.jit(fn, ...)`` call, or called (by name, same module) from a hot
+body.  Cross-module flows get an inline suppression instead of a
+whole-program analysis.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from .core import Finding, ModuleInfo, Rule, Severity
+
+_JIT_NAMES = {"jit", "pmap"}
+# jax transforms whose function-valued arguments get traced
+_TRANSFORMS = {"jit", "pmap", "vmap", "shard_map", "scan", "cond",
+               "switch", "while_loop", "fori_loop", "checkpoint",
+               "remat", "grad", "value_and_grad", "custom_vjp", "map"}
+_NP_MODULES = {"np", "numpy", "onp"}
+# attribute reads on a tracer that are static at trace time — branching
+# on these is fine
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+# callee -> positional arg indices that must be concrete Python values
+# (None = every argument). reshape is special-cased in the rule: the
+# method form x.reshape(*shape) takes all-shape args, the functional
+# jnp.reshape(x, shape) takes the array first.
+_SHAPE_SLOTS: dict[str, tuple[int, ...] | None] = {
+    "range": None, "reshape": None, "arange": None,
+    "zeros": (0,), "ones": (0,), "empty": (0,), "full": (0,),
+    "broadcast_to": (1,), "tile": (1,),
+}
+
+
+def _is_jit_name(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    return False
+
+
+def _jit_decorator(dec: ast.AST) -> tuple[bool, dict[str, ast.AST]]:
+    """(is_jit, jit keyword args) for ``@jit``, ``@jax.jit``,
+    ``@jax.jit(...)`` and ``@[functools.]partial(jax.jit, ...)``."""
+    if _is_jit_name(dec):
+        return True, {}
+    if isinstance(dec, ast.Call):
+        if _is_jit_name(dec.func):
+            return True, {kw.arg: kw.value for kw in dec.keywords if kw.arg}
+        f = dec.func
+        is_partial = (isinstance(f, ast.Name) and f.id == "partial") or \
+                     (isinstance(f, ast.Attribute) and f.attr == "partial")
+        if is_partial and dec.args and _is_jit_name(dec.args[0]):
+            return True, {kw.arg: kw.value for kw in dec.keywords if kw.arg}
+    return False, {}
+
+
+def _literal_ints(node: ast.AST | None) -> list[int]:
+    if node is None:
+        return []
+    try:
+        v = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return []
+    if isinstance(v, int):
+        return [v]
+    if isinstance(v, (tuple, list)):
+        return [i for i in v if isinstance(i, int)]
+    return []
+
+
+def _literal_strs(node: ast.AST | None) -> list[str]:
+    if node is None:
+        return []
+    try:
+        v = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return []
+    if isinstance(v, str):
+        return [v]
+    if isinstance(v, (tuple, list)):
+        return [s for s in v if isinstance(s, str)]
+    return []
+
+
+@dataclass
+class HotFn:
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    direct: bool                       # directly jitted vs reached from one
+    static_names: set[str] = field(default_factory=set)
+    has_donate: bool = False
+
+
+def _param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def _resolve_statics(fn: ast.FunctionDef | ast.AsyncFunctionDef,
+                     kwargs: dict[str, ast.AST]) -> set[str]:
+    params = _param_names(fn)
+    names = set(_literal_strs(kwargs.get("static_argnames")))
+    for i in _literal_ints(kwargs.get("static_argnums")):
+        if 0 <= i < len(params):
+            names.add(params[i])
+    return names
+
+
+def _wrapped_fn_name(node: ast.AST) -> tuple[str, int, set[str]] | None:
+    """For ``jax.jit(f)`` or ``jax.jit([functools.]partial(f, ...))``:
+    (function name, count of partial-bound positionals, partial-bound
+    keyword names).  Partial-bound parameters are concrete Python
+    values at trace time, i.e. effectively static."""
+    if isinstance(node, ast.Name):
+        return node.id, 0, set()
+    if isinstance(node, ast.Call):
+        f = node.func
+        is_partial = (isinstance(f, ast.Name) and f.id == "partial") or \
+                     (isinstance(f, ast.Attribute) and f.attr == "partial")
+        if is_partial and node.args and isinstance(node.args[0], ast.Name):
+            return (node.args[0].id, len(node.args) - 1,
+                    {kw.arg for kw in node.keywords if kw.arg})
+    return None
+
+
+def collect_hot_functions(module: ModuleInfo) -> dict[ast.AST, HotFn]:
+    """Map def-node -> HotFn for every function the tracer can reach.
+    Memoized on the ModuleInfo: all four JAX rules share one walk."""
+    cached = getattr(module, "_hot_fns", None)
+    if cached is not None:
+        return cached
+    defs_by_name: dict[str, list[ast.FunctionDef | ast.AsyncFunctionDef]] = {}
+    hot: dict[ast.AST, HotFn] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                is_jit, kwargs = _jit_decorator(dec)
+                if is_jit:
+                    hot[node] = HotFn(
+                        node=node, direct=True,
+                        static_names=_resolve_statics(node, kwargs),
+                        has_donate=any(k.startswith("donate")
+                                       for k in kwargs))
+                    break
+    # wrapper forms: encode = jax.jit(_encode, static_argnums=(1,))
+    # and jax.jit(functools.partial(_encode, ...))
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call) and _is_jit_name(node.func)
+                and node.args):
+            continue
+        wrapped = _wrapped_fn_name(node.args[0])
+        if wrapped is None:
+            continue
+        name, n_bound, bound_kw = wrapped
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        for fn in defs_by_name.get(name, []):
+            statics = _resolve_statics(fn, kwargs) | bound_kw | \
+                set(_param_names(fn)[:n_bound])
+            hot.setdefault(fn, HotFn(
+                node=fn, direct=True, static_names=statics,
+                has_donate=any(k.startswith("donate") for k in kwargs)))
+    # factory form: jax.jit(build_step_fn(...)) — the closure(s) the
+    # factory returns are what actually get traced
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call) and _is_jit_name(node.func)
+                and node.args and isinstance(node.args[0], ast.Call)
+                and isinstance(node.args[0].func, ast.Name)):
+            continue
+        for factory in defs_by_name.get(node.args[0].func.id, []):
+            for ret in ast.walk(factory):
+                if isinstance(ret, ast.Return) and \
+                        isinstance(ret.value, ast.Name):
+                    for fn in defs_by_name.get(ret.value.id, []):
+                        hot.setdefault(fn, HotFn(node=fn, direct=True))
+    # module-local transitive closure: helpers called from hot bodies
+    # are traced too (f(x) inlines f; vmap(f)/lax.cond(.., f, ..) trace
+    # their function-valued arguments)
+    frontier = list(hot.values())
+    while frontier:
+        hf = frontier.pop()
+        callees: set[str] = set()
+        for sub in ast.walk(hf.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Name):
+                callees.add(sub.func.id)
+            elif isinstance(sub.func, ast.Attribute):
+                if isinstance(sub.func.value, ast.Name) and \
+                        sub.func.value.id in ("self", "cls"):
+                    callees.add(sub.func.attr)
+                if sub.func.attr in _TRANSFORMS:
+                    callees |= {a.id for a in sub.args
+                                if isinstance(a, ast.Name)}
+            if isinstance(sub.func, ast.Name) and \
+                    sub.func.id in _TRANSFORMS:
+                callees |= {a.id for a in sub.args
+                            if isinstance(a, ast.Name)}
+        for callee in callees:
+            for fn in defs_by_name.get(callee, []):
+                if fn not in hot:
+                    hot[fn] = HotFn(node=fn, direct=False)
+                    frontier.append(hot[fn])
+    module._hot_fns = hot
+    return hot
+
+
+def _walk_body(fn: ast.FunctionDef | ast.AsyncFunctionDef
+               ) -> Iterator[ast.AST]:
+    """Walk a hot body including nested defs (they are traced when
+    called) but not the decorator list / signature defaults."""
+    for stmt in fn.body:
+        yield from ast.walk(stmt)
+
+
+def _module_scope_names(module: ModuleInfo) -> set[str]:
+    """Names bound at module scope — imports and module-level
+    assignments.  These are concrete Python values at trace time
+    (quant tables, math constants, module aliases), never tracers.
+    Memoized on the ModuleInfo."""
+    cached = getattr(module, "_mod_names", None)
+    if cached is not None:
+        return cached
+    names: set[str] = set()
+    for node in module.tree.body:
+        if isinstance(node, ast.Import):
+            names |= {(a.asname or a.name).split(".")[0]
+                      for a in node.names}
+        elif isinstance(node, ast.ImportFrom):
+            names |= {a.asname or a.name for a in node.names}
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                names |= {e.id for e in elts if isinstance(e, ast.Name)}
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+    module._mod_names = names
+    return names
+
+
+# builtins whose results are static when their inputs are — their NAME
+# appearing in an expression must not mark it dynamic
+_PY_BUILTINS = frozenset({
+    "range", "len", "min", "max", "sum", "abs", "enumerate", "zip",
+    "int", "float", "bool", "str", "tuple", "list", "dict", "set",
+    "sorted", "reversed", "round", "divmod", "isinstance"})
+
+
+def _static_locals(fn: ast.FunctionDef | ast.AsyncFunctionDef,
+                   const: set[str]) -> set[str]:
+    """Locals that are trace-time constants: every assignment to the
+    name has an all-static right-hand side (``n = x.shape[0]`` is
+    static; ``n = x + 1`` is not).  Small fixpoint so chains like
+    ``m = n * 2`` resolve."""
+    assigns: list[tuple[set[str], ast.AST]] = []
+
+    def bind(targets: list[ast.AST], value: ast.AST | None) -> None:
+        names: set[str] = set()
+        for t in targets:
+            elts = t.elts if isinstance(t, ast.Tuple) else [t]
+            names |= {e.id for e in elts if isinstance(e, ast.Name)}
+        if names and value is not None:
+            assigns.append((names, value))
+
+    for node in _walk_body(fn):
+        if isinstance(node, ast.Assign):
+            bind(node.targets, node.value)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            bind([node.target], node.value)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            # `for i in range(4)` unrolls at trace time: i is static
+            # when the iterable is
+            bind([node.target], node.iter)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                bind([gen.target], gen.iter)
+    # optimistic fixpoint: start with every assigned name static and
+    # strike out names with any non-static assignment, so that
+    # self-referential accumulators (acc = acc + <static>) converge
+    static: set[str] = set()
+    for names, _v in assigns:
+        static |= names
+    for _ in range(len(assigns) + 1):
+        known = const | static | _PY_BUILTINS
+        dynamic = set()
+        for names, value in assigns:
+            if _dynamic_uses(value, None) - known:
+                dynamic |= names
+        if not dynamic & static:
+            break
+        static -= dynamic
+    return static
+
+
+class JaxHostSyncRule(Rule):
+    rule_id = "JAX-HOST-SYNC"
+    description = ("np.asarray/np.array/.item()/float()/int() inside "
+                   "jit- or pmap-traced code forces a device->host sync "
+                   "(or a trace error) on the per-frame path")
+    default_severity = Severity.ERROR
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for hf in collect_hot_functions(module).values():
+            # trace-time constants: static params, self/cls,
+            # module-scope names (imports, quant tables, math.pi), and
+            # locals derived purely from static expressions
+            const = _module_scope_names(module) | hf.static_names | \
+                {"self", "cls"}
+            const |= _static_locals(hf.node, const)
+            for node in _walk_body(hf.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in _NP_MODULES and \
+                        f.attr in ("asarray", "array") and \
+                        any(_dynamic_uses(a, None) - const
+                            for a in node.args):
+                    # np.array(LITERAL) is a legal trace-time constant;
+                    # only materializing a runtime value syncs
+                    yield self.finding(
+                        module, node,
+                        f"{f.value.id}.{f.attr}() inside jit-traced "
+                        f"'{hf.node.name}' forces a device->host sync "
+                        "every call")
+                elif isinstance(f, ast.Attribute) and f.attr == "item" \
+                        and not node.args and not node.keywords and \
+                        not (isinstance(f.value, ast.Name) and
+                             f.value.id in const):
+                    # static_param.item() / MODULE_CONST.item() are
+                    # trace-time constants, same as the float() branch
+                    yield self.finding(
+                        module, node,
+                        f".item() inside jit-traced '{hf.node.name}' "
+                        "forces a device->host sync every call")
+                elif isinstance(f, ast.Name) and \
+                        f.id in ("float", "int", "bool") and \
+                        len(node.args) == 1 and not node.keywords and \
+                        not isinstance(node.args[0], ast.Constant) and \
+                        _dynamic_uses(node.args[0], None) - const:
+                    # int(x.shape[0]) / int(len(x)) / float(static_arg)
+                    # / float(math.pi) are trace-static — only flag
+                    # real tracer concretizations
+                    yield self.finding(
+                        module, node,
+                        f"{f.id}() on a non-literal inside jit-traced "
+                        f"'{hf.node.name}' concretizes a tracer "
+                        "(host sync or ConcretizationTypeError)")
+
+
+def _dynamic_uses(expr: ast.AST, tracers: set[str] | None) -> set[str]:
+    """Names in ``expr`` whose runtime value the tracer can't know,
+    skipping trace-time-static contexts (.shape/.ndim/.dtype/len()/
+    isinstance()/``is None`` checks — including inside and/or chains).
+    ``tracers=None`` means every name counts."""
+    hits: set[str] = set()
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return                      # identity check: static
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return                      # x.shape etc: static under trace
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in ("len", "isinstance"):
+                return
+        if isinstance(node, ast.Name) and \
+                (tracers is None or node.id in tracers):
+            hits.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return hits
+
+
+class JaxTracerBranchRule(Rule):
+    rule_id = "JAX-TRACER-BRANCH"
+    description = ("Python if/while on a traced argument inside a "
+                   "jit/pmap function — use lax.cond/lax.select, or "
+                   "declare the argument static")
+    default_severity = Severity.ERROR
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for hf in collect_hot_functions(module).values():
+            if not hf.direct:
+                continue                # helper params may be static
+            tracers = set(_param_names(hf.node)) - hf.static_names - \
+                {"self", "cls"}
+            for node in _walk_body(hf.node):
+                if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                    continue
+                hits = _dynamic_uses(node.test, tracers)
+                if hits:
+                    kind = {ast.If: "if", ast.While: "while",
+                            ast.IfExp: "conditional expression"}
+                    yield self.finding(
+                        module, node,
+                        f"Python {kind[type(node)]} on traced argument(s) "
+                        f"{', '.join(sorted(hits))} of "
+                        f"'{hf.node.name}' — use lax.cond/lax.select or "
+                        "mark the argument static")
+
+
+_NP_LIKE_MODULES = {"jnp", "np", "numpy", "lax"}
+
+
+def _is_functional_reshape(func: ast.AST) -> bool:
+    """jnp.reshape / numpy.reshape / jax.numpy.reshape / bare imported
+    reshape — as opposed to the x.reshape(*shape) method form."""
+    if isinstance(func, ast.Name):
+        return True
+    if isinstance(func, ast.Attribute):
+        v = func.value
+        if isinstance(v, ast.Name) and v.id in _NP_LIKE_MODULES:
+            return True
+        if isinstance(v, ast.Attribute) and v.attr == "numpy":
+            return True                 # jax.numpy.reshape
+    return False
+
+
+def _concrete_uses(node: ast.AST, tracers: set[str]) -> set[str]:
+    """Tracer params used as bare names (``x.shape[0]``-style attribute
+    reads are static at trace time and skipped)."""
+    hits: set[str] = set()
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute):
+            return
+        if isinstance(n, ast.Name) and n.id in tracers:
+            hits.add(n.id)
+        for c in ast.iter_child_nodes(n):
+            visit(c)
+
+    visit(node)
+    return hits
+
+
+class JaxStaticArgRule(Rule):
+    rule_id = "JAX-STATIC-ARG"
+    description = ("a jit/pmap parameter is consumed as a concrete "
+                   "Python value (range()/shape slot) without being in "
+                   "static_argnums — recompiles or fails per distinct "
+                   "value")
+    default_severity = Severity.WARNING
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for hf in collect_hot_functions(module).values():
+            if not hf.direct:
+                continue
+            tracers = set(_param_names(hf.node)) - hf.static_names - \
+                {"self", "cls"}
+            for node in _walk_body(hf.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                callee = f.id if isinstance(f, ast.Name) else (
+                    f.attr if isinstance(f, ast.Attribute) else None)
+                if callee not in _SHAPE_SLOTS:
+                    continue
+                slots = _SHAPE_SLOTS[callee]
+                args = node.args if slots is None else \
+                    [node.args[i] for i in slots if i < len(node.args)]
+                if callee == "reshape" and _is_functional_reshape(f):
+                    # functional jnp.reshape(x, shape): arg0 is the
+                    # array, not a shape
+                    args = node.args[1:]
+                for arg in args:
+                    hits = _concrete_uses(arg, tracers)
+                    if hits:
+                        yield self.finding(
+                            module, node,
+                            f"parameter '{sorted(hits)[0]}' of jit-traced "
+                            f"'{hf.node.name}' feeds {callee}() — "
+                            "declare it in static_argnums")
+                        break
+
+
+class JaxDonateHintRule(Rule):
+    rule_id = "JAX-DONATE-HINT"
+    description = ("a buffer is re-fed to the jitted function that "
+                   "produced it; donate_argnums would reuse the device "
+                   "allocation (informational)")
+    default_severity = Severity.INFO
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        hot = collect_hot_functions(module)
+        no_donate = {hf.node.name for hf in hot.values()
+                     if hf.direct and not hf.has_donate}
+        if not no_donate:
+            return
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Assign) and
+                    isinstance(node.value, ast.Call)):
+                continue
+            f = node.value.func
+            callee = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if callee not in no_donate:
+                continue
+            targets: set[str] = set()
+            for t in node.targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                targets |= {e.id for e in elts if isinstance(e, ast.Name)}
+            refed = [a.id for a in node.value.args
+                     if isinstance(a, ast.Name) and a.id in targets]
+            if refed:
+                yield self.finding(
+                    module, node,
+                    f"'{refed[0]}' is fed back into jit-traced "
+                    f"'{callee}' — donate_argnums would let XLA reuse "
+                    "the device buffer")
+
+
+RULES: list[Rule] = [
+    JaxHostSyncRule(), JaxTracerBranchRule(),
+    JaxStaticArgRule(), JaxDonateHintRule(),
+]

@@ -315,6 +315,9 @@ class AudioPipeline:
                     pass
                 finally:
                     self._mic_spawning = False
+            # graftlint audit: retained — the instance attribute keeps a
+            # strong reference for the pipeline's lifetime (the loop only
+            # holds a weak one), so this is not an ASYNC-ORPHAN-TASK
             self._mic_spawn_task = asyncio.ensure_future(_spawn())
         if self._mic_proc and self._mic_proc.returncode is None \
                 and self._mic_proc.stdin:

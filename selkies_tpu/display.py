@@ -95,6 +95,9 @@ class DisplayManager:
         self._output: str | None = None
         self._probe_failed_at: float | None = None
         self._wm_name: str | None = None   # "" = probed, none running
+        #: how long a freshly-spawned WM must survive before the swap
+        #: counts as successful (tests shrink this)
+        self.wm_grace_s: float = 1.0
 
     def available(self) -> bool:
         """xrandr exists and the display hasn't recently refused us.
@@ -179,22 +182,44 @@ class DisplayManager:
         self._wm_name = m.group(1) if rc == 0 and m else ""
         return self._wm_name or None
 
+    # WM -> its replace-takeover flag (EWMH takeover; fluxbox spells it
+    # with a single dash). Anything else (i3, twm, fvwm...) treats the
+    # flag as an unknown option and dies on startup, so it gets none.
+    _REPLACE_FLAGS = {
+        "xfwm4": "--replace", "openbox": "--replace",
+        "mutter": "--replace", "metacity": "--replace",
+        "marco": "--replace", "muffin": "--replace",
+        "kwin": "--replace", "kwin_x11": "--replace",
+        "compiz": "--replace", "awesome": "--replace",
+        "icewm": "--replace", "fluxbox": "-replace"}
+
     async def swap_window_manager(self, command: str) -> bool:
         """Replace the running WM (reference WM swap): EWMH WMs honour
-        ``--replace``; the new WM is detached so it outlives us."""
+        ``--replace``; the new WM is detached so it outlives us.  A WM
+        that dies within ``wm_grace_s`` (unknown flag, screen already
+        owned, bad DISPLAY) is reported as a failed swap."""
         argv = command.split()
         if not argv or not shutil.which(argv[0]):
             return False
-        if "--replace" not in argv:
-            argv.append("--replace")
+        flag = self._REPLACE_FLAGS.get(os.path.basename(argv[0]))
+        if flag and flag not in argv:
+            argv.append(flag)
         try:
-            await asyncio.create_subprocess_exec(
+            proc = await asyncio.create_subprocess_exec(
                 *argv, env=dict(os.environ, DISPLAY=self.display),
                 stdout=asyncio.subprocess.DEVNULL,
                 stderr=asyncio.subprocess.DEVNULL,
                 start_new_session=True)
         except OSError as e:
             logger.warning("wm swap failed: %s", e)
+            return False
+        try:
+            await asyncio.wait_for(proc.wait(), timeout=self.wm_grace_s)
+        except asyncio.TimeoutError:
+            pass                        # still alive past the grace: good
+        else:
+            logger.warning("wm %s died within %.1fs of spawn (rc=%s)",
+                           argv[0], self.wm_grace_s, proc.returncode)
             return False
         self._wm_name = None            # re-detect on next ask
         return True

@@ -32,6 +32,7 @@ import logging
 import time
 from typing import Awaitable, Callable, Optional
 
+from ..taskutil import spawn_retained
 from .backends import InputBackend, NullBackend, make_backend
 
 logger = logging.getLogger("selkies_tpu.input.handler")
@@ -88,6 +89,9 @@ class InputHandler:
         self._multipart: Optional[dict] = None
         self._repeat_task: Optional[asyncio.Task] = None
         self._sweep_task: Optional[asyncio.Task] = None
+        # strong refs to fire-and-forget tasks (subprocess reaps): the
+        # loop only holds weak references
+        self._bg_tasks: set = set()
         self.pointer_visible = True
 
     # ------------------------------------------------------------- lifecycle
@@ -107,7 +111,8 @@ class InputHandler:
             listener_hook(_changed)
 
     async def stop(self) -> None:
-        for t in (self._sweep_task, self._repeat_task):
+        for t in (self._sweep_task, self._repeat_task,
+                  *list(self._bg_tasks)):
             if t:
                 t.cancel()
                 try:
@@ -302,7 +307,9 @@ class InputHandler:
         proc = await asyncio.create_subprocess_shell(
             args, stdout=asyncio.subprocess.DEVNULL,
             stderr=asyncio.subprocess.DEVNULL)
-        asyncio.ensure_future(proc.wait())
+        # reap the child without blocking the verb; retained so the
+        # task can't be garbage-collected before the process exits
+        spawn_retained(self._bg_tasks, proc.wait())
 
 
 def _is_repeatable(keysym: int) -> bool:

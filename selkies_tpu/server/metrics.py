@@ -87,8 +87,11 @@ def render_prometheus() -> str:
         for (name, labels), h in sorted(_hists.items()):
             emit_help(name, "histogram")
             for i, b in enumerate(_hist_buckets):
-                out.append(f"{name}_bucket{_fmt_labels(labels, f'le=\"{b}\"')} {h[i]}")
-            out.append(f"{name}_bucket{_fmt_labels(labels, 'le=\"+Inf\"')} {h[len(_hist_buckets)]}")
+                le = f'le="{b}"'
+                out.append(f"{name}_bucket{_fmt_labels(labels, le)} {h[i]}")
+            inf = 'le="+Inf"'
+            out.append(f"{name}_bucket{_fmt_labels(labels, inf)} "
+                       f"{h[len(_hist_buckets)]}")
             out.append(f"{name}_sum{_fmt_labels(labels)} {h[-2]}")
             out.append(f"{name}_count{_fmt_labels(labels)} {h[-1]}")
     return "\n".join(out) + "\n"

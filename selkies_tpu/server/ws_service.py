@@ -33,6 +33,7 @@ from .. import protocol as P
 from ..engine import CaptureSettings, ScreenCapture
 from ..engine.types import EncodedChunk
 from ..settings import AppSettings, SettingsError
+from ..taskutil import spawn_retained
 from . import metrics
 from .core import BaseStreamingService
 from .relay import VideoRelay
@@ -256,16 +257,26 @@ class WebSocketsService(BaseStreamingService):
             from .turn import RtcConfigMonitor
 
             def _push_cfg(cfg: dict) -> None:
-                task = asyncio.create_task(self._broadcast_control(
+                self._spawn_retained(self._broadcast_control(
                     "rtc_config," + json.dumps(cfg)))
-                self._bg_tasks.add(task)
-                task.add_done_callback(self._bg_tasks.discard)
             self._rtc_cfg_monitor = RtcConfigMonitor(cfg_path, _push_cfg)
             self._rtc_cfg_monitor.start()
         logger.info("websockets service started")
 
+    def _spawn_retained(self, coro) -> asyncio.Task:
+        """Background task retained on the service; cancelled in
+        stop()."""
+        return spawn_retained(self._bg_tasks, coro)
+
     async def stop(self) -> None:
         self._running = False
+        bg = list(self._bg_tasks)
+        for task in bg:
+            task.cancel()
+        if bg:
+            # deliver the CancelledError so finally-blocks run before
+            # the loop can be closed
+            await asyncio.gather(*bg, return_exceptions=True)
         if self._stats_task:
             self._stats_task.cancel()
         if getattr(self, "_rtc_cfg_monitor", None) is not None:
@@ -447,11 +458,11 @@ class WebSocketsService(BaseStreamingService):
                 # viewers instead of leaving a silent black screen
                 # (VERDICT r3 weak 4); the client clears the message when
                 # the first stripe draws
-                asyncio.ensure_future(self._broadcast_control(
+                self._spawn_retained(self._broadcast_control(
                     "system_msg,preparing encoder for "
-                    f"{cs.capture_width}x{cs.capture_height} (first start "
-                    "on a new geometry compiles; warm caches take "
-                    "seconds)"))
+                    f"{cs.capture_width}x{cs.capture_height} (first "
+                    "start on a new geometry compiles; warm caches "
+                    "take seconds)"))
 
                 def _start():
                     try:
@@ -514,11 +525,7 @@ class WebSocketsService(BaseStreamingService):
             logger.debug("cursor encode failed", exc_info=True)
             return
         self._last_cursor_msg = "cursor," + payload
-        # hold a strong reference: the loop only weak-refs pending tasks
-        task = asyncio.create_task(
-            self._broadcast_control(self._last_cursor_msg))
-        self._bg_tasks.add(task)
-        task.add_done_callback(self._bg_tasks.discard)
+        self._spawn_retained(self._broadcast_control(self._last_cursor_msg))
 
     # ---------------------------------------------------------------- fanout
     def _do_fanout(self, chunk: EncodedChunk) -> None:
@@ -678,9 +685,7 @@ class WebSocketsService(BaseStreamingService):
             except OSError as e:
                 logger.warning("lifecycle hook failed: %s", e)
 
-        task = asyncio.create_task(_run())
-        self._bg_tasks.add(task)
-        task.add_done_callback(self._bg_tasks.discard)
+        self._spawn_retained(_run())
 
     # -------------------------------------------------------------- messages
     async def _on_binary(self, client: ClientConnection, data: bytes) -> None:

@@ -1,0 +1,22 @@
+"""Shared asyncio task-retention helper.
+
+The event loop holds only a WEAK reference to pending tasks: a bare
+``asyncio.ensure_future(coro)`` whose return value is discarded can be
+garbage-collected before it ever runs (ADVICE r5; enforced repo-wide by
+graftlint's ASYNC-ORPHAN-TASK rule).  Every fire-and-forget spawn goes
+through here so the retain idiom lives in exactly one place.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Coroutine
+
+
+def spawn_retained(tasks: set, coro: Coroutine) -> asyncio.Task:
+    """Schedule ``coro`` and hold a strong reference in ``tasks`` until
+    it completes.  Callers that need cancellation on shutdown iterate
+    their own set (e.g. ``for t in tasks: t.cancel()``)."""
+    task = asyncio.ensure_future(coro)
+    tasks.add(task)
+    task.add_done_callback(tasks.discard)
+    return task

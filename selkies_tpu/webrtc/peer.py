@@ -13,6 +13,7 @@ import logging
 import time
 from typing import Callable, Optional
 
+from ..taskutil import spawn_retained
 from .cc import SendSideCongestionController
 from .dtls import DtlsEndpoint, generate_certificate
 from .rtp import (H264Packetizer, OpusPacketizer, parse_rtcp_pli,
@@ -78,6 +79,10 @@ class RTCPeer(asyncio.DatagramProtocol):
         self.relay_addr: tuple[str, int] | None = None
         self._peer_via_turn = False
         self._turn_bound: set = set()
+        # strong refs to fire-and-forget tasks (TURN binds/permissions):
+        # the loop only holds weak references, so a bare ensure_future
+        # can be collected before it runs
+        self._bg_tasks: set = set()
         #: browser mic receive path (reference rtc.py:1303): sendrecv
         #: audio m-line + a compact reorder buffer in front of
         #: ``on_audio_packet(opus_payload, seq, rtp_ts)``
@@ -164,7 +169,7 @@ class RTCPeer(asyncio.DatagramProtocol):
                     # nominated via the relay: bind a channel (4-byte
                     # framing instead of 36-byte Send indications)
                     self._turn_bound.add(self._peer_addr)
-                    asyncio.ensure_future(
+                    self._spawn_retained(
                         self._bind_channel(self._peer_addr))
         elif 20 <= b <= 63:                       # DTLS
             self._peer_addr = addr
@@ -353,7 +358,7 @@ class RTCPeer(asyncio.DatagramProtocol):
                 await turn.create_permission(ip)
             except (TurnError, OSError) as e:
                 logger.warning("turn permission for %s failed: %s", ip, e)
-        asyncio.ensure_future(_perm())
+        self._spawn_retained(_perm())
 
     # -- media --------------------------------------------------------------
     @property
@@ -397,8 +402,15 @@ class RTCPeer(asyncio.DatagramProtocol):
                                    int(time.monotonic() * 1e6))
         return 1
 
+    def _spawn_retained(self, coro) -> asyncio.Task:
+        """Background task retained on the peer; cancelled on
+        close()."""
+        return spawn_retained(self._bg_tasks, coro)
+
     def close(self) -> None:
         self._closed = True
+        for task in list(self._bg_tasks):
+            task.cancel()
         if self.turn is not None:
             self.turn.close()
             self.turn = None

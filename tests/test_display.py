@@ -243,9 +243,12 @@ async def test_wm_swap_spawns_replacement(tmp_path, monkeypatch):
     bin_dir = tmp_path / "bin"
     bin_dir.mkdir()
     log = tmp_path / "wm.log"
-    _script(bin_dir, "openbox", f'echo "$@" > {log}\n')
+    # the fake WM must outlive the swap grace period: a WM that exits
+    # immediately now counts as a failed swap
+    _script(bin_dir, "openbox", f'echo "$@" > {log}\nsleep 5\n')
     monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
     dm = DisplayManager(":77")
+    dm.wm_grace_s = 0.2
     dm._wm_name = "Xfwm4"
     assert await dm.swap_window_manager("openbox")
     deadline = time.time() + 5
@@ -254,3 +257,54 @@ async def test_wm_swap_spawns_replacement(tmp_path, monkeypatch):
     assert "--replace" in log.read_text()
     assert dm._wm_name is None           # re-detect after swap
     assert not await dm.swap_window_manager("missing-wm")
+
+
+async def test_wm_swap_no_replace_for_unknown_wm(tmp_path, monkeypatch):
+    """--replace is only passed to WMs on the allowlist; i3 and friends
+    treat it as an unknown flag and die."""
+    from selkies_tpu.display import DisplayManager
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    log = tmp_path / "wm.log"
+    _script(bin_dir, "i3", f'echo "args:$@" > {log}\nsleep 5\n')
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    dm = DisplayManager(":77")
+    dm.wm_grace_s = 0.2
+    assert await dm.swap_window_manager("i3")
+    deadline = time.time() + 5
+    while time.time() < deadline and not log.exists():
+        await asyncio.sleep(0.05)
+    assert "--replace" not in log.read_text()
+
+
+async def test_wm_swap_fluxbox_single_dash_replace(tmp_path, monkeypatch):
+    """fluxbox spells the takeover flag -replace (single dash)."""
+    from selkies_tpu.display import DisplayManager
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    log = tmp_path / "wm.log"
+    _script(bin_dir, "fluxbox", f'echo "args:$@" > {log}\nsleep 5\n')
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    dm = DisplayManager(":77")
+    dm.wm_grace_s = 0.2
+    assert await dm.swap_window_manager("fluxbox")
+    deadline = time.time() + 5
+    while time.time() < deadline and not log.exists():
+        await asyncio.sleep(0.05)
+    text = log.read_text()
+    assert "-replace" in text and "--replace" not in text
+
+
+async def test_wm_swap_detects_instant_death(tmp_path, monkeypatch):
+    """A WM that exits within the grace period is a failed swap, and
+    the cached WM name is kept (nothing actually changed)."""
+    from selkies_tpu.display import DisplayManager
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    _script(bin_dir, "openbox", "exit 1\n")
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    dm = DisplayManager(":77")
+    dm.wm_grace_s = 0.2
+    dm._wm_name = "Xfwm4"
+    assert not await dm.swap_window_manager("openbox")
+    assert dm._wm_name == "Xfwm4"

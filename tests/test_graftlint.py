@@ -1,0 +1,609 @@
+"""graftlint (selkies_tpu/analysis/): per-rule firing + non-firing
+fixtures, suppression pragmas, the baseline ratchet, CLI contract, and
+the repo-wide invariant that current findings ⊆ the checked-in
+baseline (i.e. the tree is lint-clean modulo tolerated debt)."""
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from selkies_tpu.analysis import Analyzer, Severity
+from selkies_tpu.analysis.__main__ import main as graftlint_main
+from selkies_tpu.analysis.core import make_baseline, new_findings
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run(src: str, path: str = "mod.py", **kw) -> list:
+    return Analyzer(**kw).run_source(textwrap.dedent(src), path)
+
+
+def rule_ids(findings) -> list[str]:
+    return [f.rule_id for f in findings]
+
+
+# -- JAX-HOST-SYNC -----------------------------------------------------------
+
+def test_host_sync_fires_in_jitted_fn():
+    f = run("""
+        import jax, numpy as np
+        @jax.jit
+        def step(frame):
+            return np.asarray(frame)
+        """)
+    assert rule_ids(f) == ["JAX-HOST-SYNC"]
+    assert f[0].line == 5 and "np.asarray" in f[0].message
+
+
+def test_host_sync_item_and_float_fire():
+    f = run("""
+        import jax
+        @jax.jit
+        def step(x):
+            a = x.item()
+            b = float(x)
+            return a + b
+        """)
+    assert rule_ids(f) == ["JAX-HOST-SYNC", "JAX-HOST-SYNC"]
+
+
+def test_host_sync_int_of_shape_is_fine():
+    """int(x.shape[0]) / int(len(x)) are trace-static — no host sync."""
+    assert run("""
+        import jax
+        @jax.jit
+        def step(x):
+            n = int(x.shape[0])
+            m = int(len(x))
+            return n + m
+        """) == []
+
+
+def test_host_sync_float_of_static_param_is_fine():
+    """float(scale) where scale is in static_argnames is a concrete
+    Python value at trace time — no sync, no finding."""
+    assert run("""
+        import functools, jax
+        @functools.partial(jax.jit, static_argnames=("scale",))
+        def step(x, scale):
+            return x * float(scale)
+        """) == []
+
+
+def test_host_sync_item_on_static_is_fine():
+    """static_param.item() and MODULE_CONST.item() are concrete at
+    trace time — only tracer .item() syncs."""
+    assert run("""
+        import functools, jax, numpy as np
+        K = np.float32(2.0)
+        @functools.partial(jax.jit, static_argnames=("q",))
+        def step(x, q):
+            return x * q.item() * K.item()
+        """) == []
+
+
+def test_host_sync_trace_time_constants_are_fine():
+    """np.array(LITERAL) quant tables, float(math.pi), float(self.k):
+    all concrete at trace time — no sync, no finding."""
+    assert run("""
+        import math
+        import jax, numpy as np
+        QUANT = [[16, 11], [12, 12]]
+        @jax.jit
+        def step(x):
+            q = np.array([[16, 11], [12, 12]])
+            r = np.asarray(QUANT)
+            return x * q * r * float(math.pi)
+        """) == []
+
+
+def test_host_sync_static_shape_local_is_fine():
+    """Binding a static shape to a local before converting is the same
+    as the inline form: n = x.shape[0]; float(n) — no sync."""
+    assert run("""
+        import jax
+        @jax.jit
+        def f(x):
+            n = x.shape[0]
+            m = n * 2
+            return x * float(n) * int(m)
+        """) == []
+    f = run("""
+        import jax
+        @jax.jit
+        def f(x):
+            n = x + 1
+            return float(n)
+        """)
+    assert rule_ids(f) == ["JAX-HOST-SYNC"]
+
+
+def test_host_sync_static_loop_vars_are_fine():
+    """`for i in range(4)` unrolls at trace time: float(i) syncs
+    nothing.  Loops over a traced value stay flagged."""
+    assert run("""
+        import jax
+        @jax.jit
+        def f(x):
+            acc = 0.0
+            for i in range(4):
+                acc = acc + float(i)
+            ys = [float(i) for i in range(3)]
+            return x * acc * sum(ys)
+        """) == []
+    f = run("""
+        import jax
+        @jax.jit
+        def f(x):
+            for v in x:
+                y = float(v)
+            return y
+        """)
+    assert rule_ids(f) == ["JAX-HOST-SYNC"]
+
+
+def test_host_sync_silent_outside_hot_code():
+    assert run("""
+        import numpy as np
+        def host_side(frame):
+            return np.asarray(frame).item()
+        """) == []
+
+
+def test_host_sync_reaches_module_local_helpers():
+    """f called from a jitted body is traced too."""
+    f = run("""
+        import jax, numpy as np
+        def helper(x):
+            return np.array(x)
+        @jax.jit
+        def step(frame):
+            return helper(frame)
+        """)
+    assert "JAX-HOST-SYNC" in rule_ids(f)
+
+
+def test_host_sync_factory_closure_detected():
+    """The repo idiom: jax.jit(build_fn(...)) traces the returned
+    closure (engine/encoder.py:121)."""
+    f = run("""
+        import jax, numpy as np
+        def build_fn(w):
+            def step(frame):
+                return np.asarray(frame)
+            return step
+        compiled = jax.jit(build_fn(64))
+        """)
+    assert rule_ids(f) == ["JAX-HOST-SYNC"]
+
+
+# -- JAX-TRACER-BRANCH -------------------------------------------------------
+
+def test_tracer_branch_fires():
+    f = run("""
+        import jax
+        @jax.jit
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+        """)
+    assert rule_ids(f) == ["JAX-TRACER-BRANCH"]
+
+
+def test_tracer_branch_static_arg_is_fine():
+    assert run("""
+        import functools, jax
+        @functools.partial(jax.jit, static_argnames=("mode",))
+        def step(x, mode):
+            if mode:
+                return x
+            return -x
+        """) == []
+
+
+def test_tracer_branch_compound_static_guard_is_fine():
+    """`x is not None and x.shape[0] > 4` — both legs are trace-static,
+    including inside and/or chains."""
+    assert run("""
+        import jax
+        @jax.jit
+        def step(x):
+            if x is not None and x.shape[0] > 4:
+                return x
+            return -x
+        """) == []
+
+
+def test_tracer_branch_shape_and_none_checks_are_fine():
+    """x.shape / len(x) / `is None` are static at trace time."""
+    assert run("""
+        import jax
+        @jax.jit
+        def step(x, y):
+            if x.shape[0] > 8:
+                return x
+            if y is None:
+                return x
+            if len(x) > 2:
+                return x
+            return x
+        """) == []
+
+
+def test_partial_bound_params_are_static():
+    """jax.jit(partial(f, mode=...)) binds mode to a concrete value
+    (ops/jpeg_pipeline.py idiom) — branching on it is fine."""
+    assert run("""
+        import functools, jax
+        def encode(x, mode):
+            if mode == "420":
+                return x
+            return -x
+        def make(mode):
+            return jax.jit(functools.partial(encode, mode=mode))
+        """) == []
+
+
+# -- JAX-STATIC-ARG ----------------------------------------------------------
+
+def test_static_arg_fires_on_shape_slot():
+    f = run("""
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def tile(n):
+            return jnp.zeros(n)
+        """)
+    assert rule_ids(f) == ["JAX-STATIC-ARG"]
+    assert "'n'" in f[0].message
+
+
+def test_static_arg_fires_on_range():
+    f = run("""
+        import jax
+        @jax.jit
+        def loop(x, n):
+            for _ in range(n):
+                x = x + 1
+            return x
+        """)
+    assert rule_ids(f) == ["JAX-STATIC-ARG"]
+
+
+def test_static_arg_declared_static_is_fine():
+    assert run("""
+        import functools, jax
+        import jax.numpy as jnp
+        @functools.partial(jax.jit, static_argnums=(0,))
+        def tile(n):
+            return jnp.zeros(n)
+        """) == []
+
+
+def test_static_arg_functional_reshape_array_arg_is_fine():
+    """jnp.reshape(x, shape): arg 0 is the traced array, not a shape —
+    only the method form x.reshape(*shape) treats every arg as shape."""
+    assert run("""
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def flat(x):
+            return jnp.reshape(x, (4, -1))
+        """) == []
+    f = run("""
+        import jax
+        @jax.jit
+        def flat(x, n):
+            return x.reshape(n, -1)
+        """)
+    assert rule_ids(f) == ["JAX-STATIC-ARG"]
+
+
+def test_static_arg_shape_attr_is_fine():
+    """jnp.zeros(x.shape[0]) is static — no finding."""
+    assert run("""
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def like(x):
+            return jnp.zeros(x.shape[0])
+        """) == []
+
+
+# -- JAX-DONATE-HINT ---------------------------------------------------------
+
+def test_donate_hint_fires_and_is_info():
+    f = run("""
+        import jax
+        @jax.jit
+        def step(state, delta):
+            return state + delta
+        def loop(state, d):
+            state = step(state, d)
+            return state
+        """)
+    assert rule_ids(f) == ["JAX-DONATE-HINT"]
+    assert f[0].severity == Severity.INFO
+
+
+def test_donate_hint_silent_with_donation():
+    assert run("""
+        import functools, jax
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, delta):
+            return state + delta
+        def loop(state, d):
+            state = step(state, d)
+            return state
+        """) == []
+
+
+# -- ASYNC-ORPHAN-TASK -------------------------------------------------------
+
+def test_orphan_task_fires():
+    f = run("""
+        import asyncio
+        def kick(coro):
+            asyncio.ensure_future(coro)
+        """)
+    assert rule_ids(f) == ["ASYNC-ORPHAN-TASK"]
+    assert f[0].line == 4
+
+
+def test_orphan_loop_create_task_fires():
+    f = run("""
+        import asyncio
+        def kick(loop, coro):
+            loop.create_task(coro)
+        """)
+    assert rule_ids(f) == ["ASYNC-ORPHAN-TASK"]
+
+
+def test_taskgroup_create_task_is_fine():
+    """asyncio.TaskGroup retains its children — the discard pattern is
+    the documented structured-concurrency idiom there."""
+    assert run("""
+        import asyncio
+        async def fan_out(coros):
+            async with asyncio.TaskGroup() as tg:
+                for c in coros:
+                    tg.create_task(c)
+        """) == []
+
+
+def test_retained_task_is_fine():
+    assert run("""
+        import asyncio
+        def kick(tasks, coro):
+            t = asyncio.create_task(coro)
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
+        async def kick2(coro):
+            return await asyncio.ensure_future(coro)
+        """) == []
+
+
+# -- ASYNC-BLOCKING-CALL -----------------------------------------------------
+
+def test_blocking_call_fires():
+    f = run("""
+        import time, subprocess
+        async def handler():
+            time.sleep(1)
+            subprocess.run(["ls"])
+            open("/tmp/x").read()
+        """)
+    assert sorted(rule_ids(f)) == ["ASYNC-BLOCKING-CALL"] * 3
+
+
+def test_blocking_in_executor_thunk_is_fine():
+    """A nested sync def or lambda inside a coroutine is (by
+    convention) an executor thunk and runs off-loop —
+    ws_service._start pattern."""
+    assert run("""
+        import asyncio, time
+        async def handler(loop):
+            def _work():
+                time.sleep(1)
+            await loop.run_in_executor(None, _work)
+            await loop.run_in_executor(None, lambda: time.sleep(1))
+            await asyncio.sleep(0.1)
+        """) == []
+
+
+# -- ASYNC-SWALLOWED-EXC -----------------------------------------------------
+
+def test_swallowed_exc_fires_in_server_plane():
+    f = run("""
+        def teardown(sock):
+            try:
+                sock.close()
+            except Exception:
+                pass
+        """, path="selkies_tpu/server/x.py")
+    assert rule_ids(f) == ["ASYNC-SWALLOWED-EXC"]
+
+
+def test_swallowed_exc_scoped_to_server_and_webrtc():
+    src = """
+        def teardown(sock):
+            try:
+                sock.close()
+            except Exception:
+                pass
+        """
+    assert run(src, path="selkies_tpu/engine/x.py") == []
+    assert rule_ids(run(src, path="selkies_tpu/webrtc/x.py")) == \
+        ["ASYNC-SWALLOWED-EXC"]
+
+
+def test_logged_or_narrowed_exc_is_fine():
+    assert run("""
+        import logging
+        def teardown(sock):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except Exception:
+                logging.debug("close failed")
+        """, path="selkies_tpu/server/x.py") == []
+
+
+# -- suppression + severity config -------------------------------------------
+
+def test_inline_suppression_same_line_and_line_above():
+    assert run("""
+        import asyncio
+        def kick(a, b):
+            asyncio.ensure_future(a)  # graftlint: disable=ASYNC-ORPHAN-TASK
+            # graftlint: disable=all
+            asyncio.ensure_future(b)
+        """) == []
+
+
+def test_suppression_on_last_line_of_multiline_statement():
+    """Formatters keep trailing comments on the closing line — the
+    pragma works anywhere on the statement's first or last line."""
+    assert run("""
+        import asyncio
+        def kick(a):
+            asyncio.ensure_future(
+                a)  # graftlint: disable=ASYNC-ORPHAN-TASK
+        """) == []
+
+
+def test_trailing_pragma_does_not_leak_to_next_line():
+    """A pragma trailing statement N must not suppress a fresh
+    violation on statement N+1 — only a comment-ONLY line above
+    suppresses downward."""
+    f = run("""
+        import asyncio
+        def kick(a, b):
+            asyncio.ensure_future(a)  # graftlint: disable=ASYNC-ORPHAN-TASK
+            asyncio.ensure_future(b)
+        """)
+    assert rule_ids(f) == ["ASYNC-ORPHAN-TASK"] and f[0].line == 5
+
+
+def test_suppression_is_per_rule():
+    f = run("""
+        import asyncio
+        def kick(a):
+            asyncio.ensure_future(a)  # graftlint: disable=OTHER-RULE
+        """)
+    assert rule_ids(f) == ["ASYNC-ORPHAN-TASK"]
+
+
+def test_severity_override_demotes_to_non_gating():
+    from selkies_tpu.analysis.core import gating
+    f = run("""
+        import asyncio
+        def kick(a):
+            asyncio.ensure_future(a)
+        """, severity_overrides={"ASYNC-ORPHAN-TASK": "info"})
+    assert f and f[0].severity == Severity.INFO
+    assert gating(f) == []
+
+
+# -- baseline ratchet --------------------------------------------------------
+
+def test_baseline_absorbs_known_and_catches_new():
+    src_v1 = """
+        import asyncio
+        def kick(a):
+            asyncio.ensure_future(a)
+        """
+    base = make_baseline(run(src_v1))
+    assert new_findings(run(src_v1), base) == []
+    # same file gains a SECOND identical violation: multiplicity-aware
+    src_v2 = src_v1 + "    asyncio.ensure_future(a)\n"
+    fresh = new_findings(run(src_v2), base)
+    assert len(fresh) == 1 and fresh[0].rule_id == "ASYNC-ORPHAN-TASK"
+
+
+def test_baseline_survives_line_drift():
+    src = """
+        import asyncio
+        def kick(a):
+            asyncio.ensure_future(a)
+        """
+    base = make_baseline(run(src))
+    drifted = "# a new leading comment\n" + textwrap.dedent(src)
+    assert new_findings(Analyzer().run_source(drifted, "mod.py"), base) == []
+
+
+# -- CLI contract -------------------------------------------------------------
+
+def _write_pkg(tmp_path: Path, body: str) -> Path:
+    d = tmp_path / "pkg"
+    d.mkdir(exist_ok=True)
+    (d / "m.py").write_text(textwrap.dedent(body))
+    return d
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    pkg = _write_pkg(tmp_path, """
+        import asyncio
+        def kick(a):
+            asyncio.ensure_future(a)
+        """)
+    assert graftlint_main([str(pkg), "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["summary"] == {"total": 1, "baselined": 0, "new": 1,
+                              "gating": 1}
+    (f,) = out["findings"]
+    assert f["rule"] == "ASYNC-ORPHAN-TASK" and f["line"] == 4 \
+        and f["path"] == "pkg/m.py" and f["severity"] == "error"
+
+    # ratchet: write baseline -> clean; inject a fresh violation -> 1
+    base = tmp_path / "base.json"
+    assert graftlint_main([str(pkg), "--write-baseline", str(base)]) == 0
+    assert graftlint_main([str(pkg), "--baseline", str(base)]) == 0
+    with (pkg / "m.py").open("a") as fh:
+        fh.write("async def h():\n    import time\n    time.sleep(1)\n")
+    capsys.readouterr()
+    assert graftlint_main([str(pkg), "--baseline", str(base)]) == 1
+    text = capsys.readouterr().out
+    assert "pkg/m.py" in text and "ASYNC-BLOCKING-CALL" in text
+
+
+def test_cli_usage_and_parse_errors(tmp_path, capsys):
+    assert graftlint_main([]) == 2
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    assert graftlint_main([str(bad)]) == 2
+    assert graftlint_main(["--list-rules"]) == 0
+    assert "ASYNC-ORPHAN-TASK" in capsys.readouterr().out
+    # a typo'd path must be a usage error (2), NOT a clean exit 0 —
+    # otherwise a package rename silently disables the CI gate
+    assert graftlint_main([str(tmp_path / "no_such_pkg")]) == 2
+    # bad --severity is a usage error (2), not a lint failure (1)
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    assert graftlint_main([str(ok), "--severity", "FOO"]) == 2
+    assert graftlint_main([str(ok), "--severity", "FOO=banana"]) == 2
+    # a malformed baseline is a usage error too, not a crash
+    bad_base = tmp_path / "bad_base.json"
+    bad_base.write_text(json.dumps(
+        {"version": 1, "entries": [{"path": "x.py"}]}))
+    assert graftlint_main([str(ok), "--baseline", str(bad_base)]) == 2
+
+
+# -- repo-wide invariant ------------------------------------------------------
+
+def test_repo_findings_subset_of_baseline():
+    """The tree stays lint-clean modulo the checked-in baseline: any
+    new violation must be fixed, suppressed, or consciously
+    baselined."""
+    baseline = json.loads(
+        (REPO / "tools" / "graftlint_baseline.json").read_text())
+    findings = Analyzer().run([REPO / "selkies_tpu"], root=REPO)
+    fresh = new_findings(findings, baseline)
+    assert fresh == [], "new graftlint findings:\n" + "\n".join(
+        f.render() for f in fresh)
