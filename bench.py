@@ -185,7 +185,16 @@ def main(force_cpu: bool = False) -> None:
 
     # -- latency: unpipelined dispatch -> wire bytes (forced IDR: the
     # worst-case glass-to-glass component). TIME-BUDGETED: at today's
-    # frame times a fixed count could blow the driver's timeout ----------
+    # frame times a fixed count could blow the driver's timeout.
+    # Span-traced (selkies_tpu/trace): the per-stage breakdown printed
+    # next to the fps/latency line is what attributes every future
+    # BENCH_r*.json regression to capture/convert/dispatch/readback/
+    # packetize instead of one opaque number -----------------------------
+    from selkies_tpu.trace import STAGES
+    from selkies_tpu.trace import tracer as _tracer
+    from selkies_tpu.trace.summary import render_table, summarize_timelines
+    bench_display = sess.settings.display_id
+    _tracer.enable(capacity=1024)
     lat = []
     n_lat = 0
     lat_budget = float(os.environ.get("BENCH_LAT_BUDGET_S", "45"))
@@ -195,17 +204,39 @@ def main(force_cpu: bool = False) -> None:
         f = src.get_frame(100 + t)
         jax.block_until_ready(f)          # exclude frame synthesis
         t0 = time.monotonic()
-        chunks = sess.finalize(sess.encode(f, force=True), force_all=True)
+        tl = _tracer.frame_begin(bench_display)
+        out = sess.encode(f, force=True)
+        _tracer.bind(tl, out["frame_id"])
+        chunks = sess.finalize(out, force_all=True)
+        _tracer.frame_end(bench_display, out["frame_id"])
         lat.append(time.monotonic() - t0)
         total_bytes += sum(len(c.payload) for c in chunks)
         n_lat += 1
         if n_lat >= 5 and time.monotonic() - t_loop > lat_budget:
             break
+    _tracer.disable()
     lat.sort()
     p50 = lat[len(lat) // 2] * 1e3
     p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3
     log(f"latency(IDR) p50={p50:.2f}ms p99={p99:.2f}ms "
         f"avg_frame_bytes={total_bytes // n_lat}")
+
+    # per-stage attribution: mean ms/frame per stage; the stage sum must
+    # land within ~20% of the measured e2e latency or the instrumentation
+    # has a hole (the ISSUE 2 acceptance bar). Normalise by the frames
+    # that SURVIVED the ring (a fast encoder can outrun the tracer
+    # capacity; dividing by n_lat would then under-count every stage)
+    timelines = _tracer.snapshot()
+    stage_summary = summarize_timelines(timelines)
+    lat_mean_ms = sum(lat) / len(lat) * 1e3
+    n_traced = max(1, sum(1 for t in timelines if t.done))
+    stages_ms = {s: round(stage_summary.get(s, {}).get("total_ms", 0.0)
+                          / n_traced, 3) for s in STAGES}
+    stage_sum_ms = round(sum(stages_ms.values()), 3)
+    log("per-stage breakdown (ms/frame, IDR latency loop):")
+    log(render_table(stage_summary))
+    log(f"stage_sum={stage_sum_ms:.2f}ms vs e2e_mean={lat_mean_ms:.2f}ms "
+        f"(coverage {stage_sum_ms / lat_mean_ms:.0%})")
 
     # -- throughput: pipelined like the capture thread, SERVING MIX (first
     # frame IDR, then P deltas on fully-animated content — the worst case
@@ -242,6 +273,9 @@ def main(force_cpu: bool = False) -> None:
         "vs_baseline": round(fps / 60.0, 3),
         "latency_p50_ms": round(p50, 2),
         "latency_p99_ms": round(p99, 2),
+        "latency_mean_ms": round(lat_mean_ms, 2),
+        "stages_ms": stages_ms,
+        "stage_sum_ms": stage_sum_ms,
         "bitrate_mbps": round(mbps, 1),
         "backend": backend_label,
         "frames": n_frames,

@@ -49,6 +49,10 @@ async def run(argv=None) -> None:
 
     await wait_for_app_ready(settings.app_ready_file)
 
+    if settings.enable_trace:
+        from .trace import tracer
+        tracer.enable()
+
     server = CentralizedStreamServer(settings)
 
     # Wayland bring-up (reference stream_server.py:420-447

@@ -2,14 +2,59 @@
 
 The 1080p H.264 device program costs minutes to build over the TPU
 tunnel; every entry point that compiles it (bench, profiler, server)
-points JAX at one repo-local cache so only the first run pays."""
+points JAX at one repo-local cache so only the first run pays.
+
+The cache directory is keyed by a **host fingerprint** (platform triple +
+CPU-feature hash): XLA compiles with the build machine's CPU features,
+and reusing a cache across heterogeneous hosts produces "compile machine
+features don't match host" warnings and a SIGILL risk (seen in the r05
+bench tail against the shared ``.jax_cache``). Two identical machines
+still share; a different microarchitecture gets its own subtree.
+"""
 
 from __future__ import annotations
 
+import functools
+import hashlib
 import os
+import platform
 
 
-def enable(jax_module=None) -> str:
+@functools.lru_cache(maxsize=1)
+def _cpu_features() -> str:
+    """Stable digest of the host CPU's feature set. x86/arm Linux expose
+    it in /proc/cpuinfo ('flags' / 'Features'); elsewhere fall back to
+    the processor string — coarser, but never wrong-way sharing."""
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                key = line.split(":", 1)[0].strip().lower()
+                if key in ("flags", "features"):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    return hashlib.sha1(feats.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    fallback = platform.processor() or platform.machine()
+    return hashlib.sha1(fallback.encode()).hexdigest()[:12]
+
+
+def host_fingerprint(device_kind: str | None = None) -> str:
+    """Filesystem-safe fingerprint of this host's compile environment.
+    ``device_kind`` (e.g. ``jax.devices()[0].device_kind``) may be mixed
+    in by callers that already initialised a backend; it is OPTIONAL —
+    computing the fingerprint must never force (or hang on) backend init,
+    and XLA's own cache keys already cover the accelerator target."""
+    machine = platform.machine() or "unknown"
+    system = platform.system().lower() or "unknown"
+    fp = f"{system}-{machine}-{_cpu_features()}"
+    if device_kind:
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in device_kind)
+        fp += f"-{safe}"
+    return fp
+
+
+def enable(jax_module=None, device_kind: str | None = None) -> str:
     """Configure the persistent compilation cache; returns the dir used.
     Safe to call any time (before or after backend init)."""
     if jax_module is None:
@@ -18,7 +63,8 @@ def enable(jax_module=None) -> str:
         "JAX_CACHE_DIR",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      os.pardir, ".jax_cache"))
-    cache = os.path.abspath(cache)
+    cache = os.path.join(os.path.abspath(cache),
+                         host_fingerprint(device_kind))
     try:
         jax_module.config.update("jax_compilation_cache_dir", cache)
         jax_module.config.update(

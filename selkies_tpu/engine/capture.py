@@ -22,6 +22,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from ..trace import tracer as _tracer
 from .encoder import JpegEncoderSession
 from .sources import FrameSource, make_source
 from .types import CaptureSettings, EncodedChunk
@@ -269,9 +270,15 @@ class ScreenCapture:
             while self._running.is_set():
                 t0 = time.monotonic()
                 self._apply_tunables()
-                frame = src.get_frame(tick)
-                if pad is not None:
-                    frame = pad(frame)
+                # span tracing (selkies_tpu/trace): one timeline per frame,
+                # begun here, bound to the encoder's frame id after
+                # dispatch, ended at delivery PIPELINE_DEPTH turns later
+                tl = _tracer.frame_begin(s.display_id)
+                with _tracer.span("capture", tl):
+                    frame = src.get_frame(tick)
+                with _tracer.span("convert", tl):
+                    if pad is not None:
+                        frame = pad(frame)
                 # periodic full refresh (keyframe_interval_s) on top of
                 # client-requested IDRs; <=0 disables the cadence. Decided
                 # BEFORE encode: the h264 session's on-device idr parity
@@ -291,6 +298,7 @@ class ScreenCapture:
                 with turn:
                     out = sess.encode(frame, force=force)
                     out["force"] = force
+                    _tracer.bind(tl, out["frame_id"])
                     inflight.append(out)
                     if len(inflight) > PIPELINE_DEPTH:
                         nb = self._deliver(inflight.popleft())
@@ -336,4 +344,8 @@ class ScreenCapture:
             if cb is not None:
                 cb(c)
         self.last_frame_bytes = nbytes
+        if self._settings is not None:
+            # chunks are now queued toward the loop; ws send/ACK spans
+            # attach later by frame id while the timeline sits in the ring
+            _tracer.frame_end(self._settings.display_id, out["frame_id"])
         return nbytes

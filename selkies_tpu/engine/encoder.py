@@ -32,6 +32,7 @@ import numpy as np
 
 from ..codecs import jpeg as jtab
 from ..codecs.jpeg import stuff_ff_bytes
+from ..trace import tracer as _tracer
 from ..ops.stripes import concat_stripe_bytes, words_to_bytes_device
 from .types import CaptureSettings, EncodedChunk
 
@@ -194,21 +195,27 @@ class JpegEncoderSession:
         del force
         if self._watermark is not None:
             frame = self._watermark.apply(frame)
-        data, lens, send, is_paint, age, overflow = self._step(
-            frame, self._prev, self._age,
-            self._qy_m, self._qc_m, self._qy_p, self._qc_p)
-        self._prev = frame
-        self._age = age
-        fid = self.frame_id
-        self.frame_id = (self.frame_id + 1) & 0xFFFF
-        # kick off async readbacks of the SMALL control arrays so the
-        # consumer doesn't eat the RTT; the stream buffer itself is
-        # fetched minimally at finalize (engine/readback.py)
-        for arr in (lens, send, is_paint, overflow):
-            try:
-                arr.copy_to_host_async()
-            except Exception:  # interpret/CPU backends may not support it
-                pass
+        # the dispatch span covers the step call AND the async-copy kicks:
+        # on TPU both are enqueue-cost only and the device compute lands
+        # in finalize's encode.readback stall, while backends whose copy
+        # kick synchronizes (CPU) show the compute here — either way the
+        # host-visible wait is attributed, never lost between spans
+        with _tracer.span("encode.dispatch"):
+            data, lens, send, is_paint, age, overflow = self._step(
+                frame, self._prev, self._age,
+                self._qy_m, self._qc_m, self._qy_p, self._qc_p)
+            self._prev = frame
+            self._age = age
+            fid = self.frame_id
+            self.frame_id = (self.frame_id + 1) & 0xFFFF
+            # kick off async readbacks of the SMALL control arrays so the
+            # consumer doesn't eat the RTT; the stream buffer itself is
+            # fetched minimally at finalize (engine/readback.py)
+            for arr in (lens, send, is_paint, overflow):
+                try:
+                    arr.copy_to_host_async()
+                except Exception:  # interpret/CPU may not support it
+                    pass
         # Snapshot the quant tables that were live at DISPATCH time: finalize
         # runs PIPELINE_DEPTH frames later, and a quality change in between
         # must not make the JFIF DQT disagree with the tables the device
@@ -232,7 +239,38 @@ class JpegEncoderSession:
                  ) -> list[EncodedChunk]:
         """Blocks on the async readback and produces wire-ready chunks."""
         g = self.grid
-        if bool(np.asarray(out["overflow"])):
+        # trace target: THIS frame's timeline, by id — never the current
+        # dispatch context, which is PIPELINE_DEPTH frames ahead. ONE
+        # readback span per frame: the overflow flag is the device-sync
+        # point (absorbs the step's compute stall) and the stream fetch
+        # is the link cost — two fragments would double the stage count
+        # and skew its percentiles
+        tl = _tracer.lookup(self.settings.display_id, out["frame_id"])
+        idle = False
+        data = None
+        with _tracer.span("encode.readback", tl):
+            overflowed = bool(np.asarray(out["overflow"]))
+            if not overflowed:
+                if self._force_after_drop:
+                    self._force_after_drop = False
+                    force_all = True
+                lens = np.asarray(out["lens"])
+                send = np.asarray(out["send"])
+                is_paint = np.asarray(out["is_paint"])
+                idle = not (force_all or send.any())
+                if not idle:
+                    starts = np.concatenate([[0], np.cumsum(lens)])
+                    # minimal readback (engine/readback.py): all stripes
+                    # are always in the buffer, so the used prefix is
+                    # everything up to the last DELIVERED stripe —
+                    # capacity padding never crosses the link
+                    from .readback import fetch_stream_bytes
+                    deliver = np.nonzero(send)[0] if not force_all \
+                        else np.arange(g.n_stripes)
+                    last = int(deliver[-1])
+                    data = fetch_stream_bytes(out["data"],
+                                              int(starts[last] + lens[last]))
+        if overflowed:
             # Event overflow is impossible (e_cap is worst-case), so this is
             # a word/output buffer overflow: drop the frame, double the
             # growable buffers, recompile ONCE per episode (pipelined frames
@@ -249,35 +287,21 @@ class JpegEncoderSession:
                 self._step = self._build_step()
             self._force_after_drop = True
             return []
-        if self._force_after_drop:
-            self._force_after_drop = False
-            force_all = True
-        lens = np.asarray(out["lens"])
-        send = np.asarray(out["send"])
-        is_paint = np.asarray(out["is_paint"])
-        if not (force_all or send.any()):
-            return []                 # idle frame: fetch nothing at all
-        starts = np.concatenate([[0], np.cumsum(lens)])
-        # minimal readback (engine/readback.py): all stripes are always
-        # in the buffer, so the used prefix is everything up to the last
-        # DELIVERED stripe — capacity padding never crosses the link
-        from .readback import fetch_stream_bytes
-        deliver = np.nonzero(send)[0] if not force_all \
-            else np.arange(g.n_stripes)
-        last = int(deliver[-1])
-        data = fetch_stream_bytes(out["data"],
-                                  int(starts[last] + lens[last]))
-        chunks: list[EncodedChunk] = []
-        for i in range(g.n_stripes):
-            if not (force_all or send[i]):
-                continue
-            raw = data[starts[i]:starts[i] + lens[i]]
-            scan = stuff_ff_bytes(raw)
-            chunks.append(EncodedChunk(
-                payload=self._jfif_wrap(scan, bool(is_paint[i]), out["qtabs"]),
-                frame_id=out["frame_id"], stripe_y=i * g.stripe_h,
-                width=g.width, height=g.stripe_h, is_idr=True,
-                output_mode="jpeg",
-                seat_index=self.settings.seat_index,
-                display_id=self.settings.display_id))
+        if idle:
+            return []                 # idle frame: fetched nothing at all
+        with _tracer.span("packetize", tl):
+            chunks: list[EncodedChunk] = []
+            for i in range(g.n_stripes):
+                if not (force_all or send[i]):
+                    continue
+                raw = data[starts[i]:starts[i] + lens[i]]
+                scan = stuff_ff_bytes(raw)
+                chunks.append(EncodedChunk(
+                    payload=self._jfif_wrap(scan, bool(is_paint[i]),
+                                            out["qtabs"]),
+                    frame_id=out["frame_id"], stripe_y=i * g.stripe_h,
+                    width=g.width, height=g.stripe_h, is_idr=True,
+                    output_mode="jpeg",
+                    seat_index=self.settings.seat_index,
+                    display_id=self.settings.display_id))
         return chunks

@@ -37,6 +37,7 @@ from ..ops.h264_encode import P_SLOTS_MB, SLOTS_MB, scroll_candidates
 from ..ops.h264_planes import (h264_encode_p_yuv, h264_encode_yuv,
                                rgb_to_yuv420)
 from ..ops.stripes import concat_stripe_bytes, words_to_bytes_device
+from ..trace import tracer as _tracer
 from .types import CaptureSettings, EncodedChunk
 
 logger = logging.getLogger("selkies_tpu.engine.h264")
@@ -304,27 +305,33 @@ class H264EncoderSession:
         step = self._i_step if intra else self._p_step
         hdr_pay = self._hdr_pay if intra else self._p_hdr_pay
         hdr_nb = self._hdr_nb if intra else self._p_hdr_nb
-        (data, row_lens, send, is_paint, age, sent, fnum,
-         ry, ru, rv, overflow) = step(
-            frame, self._prev, self._age, self._sent, self._fnum,
-            self._ref_y, self._ref_u, self._ref_v,
-            jnp.int32(self.qp), jnp.int32(self.paint_qp),
-            jnp.asarray(bool(force)), hdr_pay, hdr_nb)
-        self._prev = frame
-        self._age = age
-        self._sent = sent
-        self._fnum = fnum
-        self._ref_y, self._ref_u, self._ref_v = ry, ru, rv
-        fid = self.frame_id
-        self.frame_id = (self.frame_id + 1) & 0xFFFF
-        # async-copy only the SMALL control arrays; the stream buffer is
-        # fetched minimally at finalize (engine/readback.py) once the
-        # row lengths are known
-        for arr in (row_lens, send, is_paint, overflow):
-            try:
-                arr.copy_to_host_async()
-            except Exception:
-                pass
+        # the dispatch span covers the step call AND the async-copy kicks:
+        # on TPU both are enqueue-cost only and the device compute lands
+        # in finalize's encode.readback stall, while backends whose copy
+        # kick synchronizes (CPU) show the compute here — either way the
+        # host-visible wait is attributed, never lost between spans
+        with _tracer.span("encode.dispatch"):
+            (data, row_lens, send, is_paint, age, sent, fnum,
+             ry, ru, rv, overflow) = step(
+                frame, self._prev, self._age, self._sent, self._fnum,
+                self._ref_y, self._ref_u, self._ref_v,
+                jnp.int32(self.qp), jnp.int32(self.paint_qp),
+                jnp.asarray(bool(force)), hdr_pay, hdr_nb)
+            self._prev = frame
+            self._age = age
+            self._sent = sent
+            self._fnum = fnum
+            self._ref_y, self._ref_u, self._ref_v = ry, ru, rv
+            fid = self.frame_id
+            self.frame_id = (self.frame_id + 1) & 0xFFFF
+            # async-copy only the SMALL control arrays; the stream buffer
+            # is fetched minimally at finalize (engine/readback.py) once
+            # the row lengths are known
+            for arr in (row_lens, send, is_paint, overflow):
+                try:
+                    arr.copy_to_host_async()
+                except Exception:
+                    pass
         return {"data": data, "lens": row_lens, "send": send,
                 "is_paint": is_paint, "overflow": overflow, "frame_id": fid,
                 "intra": intra, "cap_gen": self._cap_gen}
@@ -336,7 +343,30 @@ class H264EncoderSession:
         decision for this codec (idr parity lives on device)."""
         del force_all
         g = self.grid
-        if bool(np.asarray(out["overflow"])):
+        # ONE readback span per frame: the overflow flag is the
+        # device-sync point and the stream fetch the link cost — two
+        # fragments would double the stage count and skew percentiles
+        tl = _tracer.lookup(self.settings.display_id, out["frame_id"])
+        idle = False
+        data = None
+        with _tracer.span("encode.readback", tl):
+            overflowed = bool(np.asarray(out["overflow"]))
+            if not overflowed:
+                lens = np.asarray(out["lens"])    # (R,) per MB row
+                send = np.asarray(out["send"])
+                intra = out.get("intra", True)
+                idle = not send.any()
+                if not idle:
+                    starts = np.concatenate([[0], np.cumsum(lens)])
+                    rps = g.rows_per_stripe
+                    # minimal readback (engine/readback.py): fetch through
+                    # the last DELIVERED stripe's rows — capacity padding
+                    # and trailing unsent stripes never cross the host link
+                    from .readback import fetch_stream_bytes
+                    last_row = (int(np.nonzero(send)[0][-1]) + 1) * rps
+                    data = fetch_stream_bytes(out["data"],
+                                              int(starts[last_row]))
+        if overflowed:
             # grow once per episode: pipelined frames encoded with the old
             # caps also report overflow but must not re-double/re-jit
             if out["cap_gen"] == self._cap_gen:
@@ -349,31 +379,21 @@ class H264EncoderSession:
                 self._p_step = self._build_step("p")
             self._force_after_drop = True
             return []
-        lens = np.asarray(out["lens"])            # (R,) per MB row
-        send = np.asarray(out["send"])
-        intra = out.get("intra", True)
-        if not send.any():
-            return []                 # idle frame: fetch nothing at all
-        starts = np.concatenate([[0], np.cumsum(lens)])
-        rps = g.rows_per_stripe
-        # minimal readback (engine/readback.py): fetch through the last
-        # DELIVERED stripe's rows — capacity padding and trailing unsent
-        # stripes never cross the host link
-        from .readback import fetch_stream_bytes
-        last_row = (int(np.nonzero(send)[0][-1]) + 1) * rps
-        data = fetch_stream_bytes(out["data"], int(starts[last_row]))
-        chunks: list[EncodedChunk] = []
-        for i in range(g.n_stripes):
-            if not send[i]:
-                continue
-            rows = []
-            for r in range(i * rps, (i + 1) * rps):
-                rows.append(bytes(data[starts[r]:starts[r] + lens[r]]))
-            payload = h264_stripe_payload(intra, rows, self._sps_pps)
-            chunks.append(EncodedChunk(
-                payload=payload, frame_id=out["frame_id"],
-                stripe_y=i * g.stripe_h, width=g.width, height=g.stripe_h,
-                is_idr=intra, output_mode="h264",
-                seat_index=self.settings.seat_index,
-                display_id=self.settings.display_id))
+        if idle:
+            return []                 # idle frame: fetched nothing at all
+        with _tracer.span("packetize", tl):
+            chunks: list[EncodedChunk] = []
+            for i in range(g.n_stripes):
+                if not send[i]:
+                    continue
+                rows = []
+                for r in range(i * rps, (i + 1) * rps):
+                    rows.append(bytes(data[starts[r]:starts[r] + lens[r]]))
+                payload = h264_stripe_payload(intra, rows, self._sps_pps)
+                chunks.append(EncodedChunk(
+                    payload=payload, frame_id=out["frame_id"],
+                    stripe_y=i * g.stripe_h, width=g.width,
+                    height=g.stripe_h, is_idr=intra, output_mode="h264",
+                    seat_index=self.settings.seat_index,
+                    display_id=self.settings.display_id))
         return chunks

@@ -21,6 +21,7 @@ from typing import Callable, Optional
 
 from ..engine.capture import _ENCODE_TURN
 from ..engine.types import CaptureSettings, EncodedChunk
+from ..trace import tracer as _tracer
 from .h264_seats import MultiSeatH264Encoder
 from .seats import MultiSeatEncoder, synthetic_seat_frames
 
@@ -119,20 +120,30 @@ class MultiSeatCapture:
         s, enc = self._settings, self._enc
         tick = 0
         window_frames, window_start = 0, time.monotonic()
+        # one timeline covers all seats per tick; alias keys route the
+        # per-seat relay send/ACK spans onto it
+        seat_aliases = tuple(f"seat{i}" for i in range(self.n_seats))
         try:
             while self._running.is_set():
                 t0 = time.monotonic()
-                frames = synthetic_seat_frames(enc, tick)
+                tl = _tracer.frame_begin(s.display_id)
+                with _tracer.span("capture", tl):
+                    frames = synthetic_seat_frames(enc, tick)
                 force = self._force_idr.is_set()
                 if force:
                     self._force_idr.clear()
                 with _ENCODE_TURN:
                     if isinstance(enc, MultiSeatH264Encoder):
-                        per_seat = enc.finalize(
-                            enc.encode(frames, force=force))
+                        out = enc.encode(frames, force=force)
+                        _tracer.bind(tl, out["frame_id"],
+                                     aliases=seat_aliases)
+                        per_seat = enc.finalize(out)
                     else:
-                        per_seat = enc.finalize(enc.encode(frames),
-                                                force_all=force or tick == 0)
+                        out = enc.encode(frames)
+                        _tracer.bind(tl, out["frame_id"],
+                                     aliases=seat_aliases)
+                        per_seat = enc.finalize(
+                            out, force_all=force or tick == 0)
                 cb = self._callback
                 nbytes = 0
                 for chunks in per_seat:
@@ -141,6 +152,7 @@ class MultiSeatCapture:
                         if cb is not None:
                             cb(c)
                 self.last_frame_bytes = nbytes
+                _tracer.frame_end(s.display_id, out["frame_id"])
                 tick += 1
                 window_frames += 1
                 now = time.monotonic()

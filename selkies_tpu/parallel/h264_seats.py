@@ -31,6 +31,7 @@ from ..engine.h264_encoder import (build_h264_step_fn, h264_buffer_caps,
                                    h264_stripe_payload, plan_h264_grid)
 from ..engine.types import CaptureSettings, EncodedChunk
 from ..ops.h264_encode import scroll_candidates
+from ..trace import tracer as _tracer
 from .seats import seat_mesh
 
 try:  # jax>=0.8 top-level; older releases keep it in experimental
@@ -133,25 +134,28 @@ class MultiSeatH264Encoder:
                              self._sharding)
         forces = jax.device_put(np.full((n,), bool(force)),
                                 self._sharding)
-        (data, row_lens, send, is_paint, age, sent, fnum,
-         ry, ru, rv, overflow) = step(
-            frames, self._prev, self._age, self._sent, self._fnum,
-            self._ref_y, self._ref_u, self._ref_v,
-            qp, pqp, forces, hdr_pay, hdr_nb)
-        self._prev = frames
-        self._age = age
-        self._sent = sent
-        self._fnum = fnum
-        self._ref_y, self._ref_u, self._ref_v = ry, ru, rv
-        fid = self.frame_id
-        self.frame_id = (self.frame_id + 1) & 0xFFFF
-        # small control arrays only; the stream buffer is fetched
-        # minimally at finalize (engine/readback.py)
-        for arr in (row_lens, send, is_paint, overflow):
-            try:
-                arr.copy_to_host_async()
-            except Exception:
-                pass
+        # covers the step AND the async-copy kicks so backends whose copy
+        # kick synchronizes (CPU) still attribute the compute wait here
+        with _tracer.span("encode.dispatch"):
+            (data, row_lens, send, is_paint, age, sent, fnum,
+             ry, ru, rv, overflow) = step(
+                frames, self._prev, self._age, self._sent, self._fnum,
+                self._ref_y, self._ref_u, self._ref_v,
+                qp, pqp, forces, hdr_pay, hdr_nb)
+            self._prev = frames
+            self._age = age
+            self._sent = sent
+            self._fnum = fnum
+            self._ref_y, self._ref_u, self._ref_v = ry, ru, rv
+            fid = self.frame_id
+            self.frame_id = (self.frame_id + 1) & 0xFFFF
+            # small control arrays only; the stream buffer is fetched
+            # minimally at finalize (engine/readback.py)
+            for arr in (row_lens, send, is_paint, overflow):
+                try:
+                    arr.copy_to_host_async()
+                except Exception:
+                    pass
         return {"data": data, "lens": row_lens, "send": send,
                 "overflow": overflow, "frame_id": fid, "intra": intra,
                 "cap_gen": self._cap_gen}
@@ -161,21 +165,23 @@ class MultiSeatH264Encoder:
                  ) -> list[list[EncodedChunk]]:
         del force_all                       # encode()-time decision
         g = self.grid
-        lens = np.asarray(out["lens"])      # (S, R)
-        send = np.asarray(out["send"])      # (S, n_stripes)
-        overflow = np.asarray(out["overflow"])   # (S,)
-        # minimal readback (engine/readback.py), matching the
-        # single-seat shape: per seat only rows through the last SENT
-        # stripe count; all-idle frames fetch nothing
-        from ..engine.readback import fetch_stream_bytes
-        rps_ = g.rows_per_stripe
-        total = 0
-        for seat in range(self.n_seats):
-            if overflow[seat] or not send[seat].any():
-                continue
-            last_row = (int(np.nonzero(send[seat])[0][-1]) + 1) * rps_
-            total = max(total, int(lens[seat, :last_row].sum()))
-        data = fetch_stream_bytes(out["data"], total) if total else None
+        tl = _tracer.lookup(self.settings.display_id, out["frame_id"])
+        with _tracer.span("encode.readback", tl):
+            lens = np.asarray(out["lens"])      # (S, R)
+            send = np.asarray(out["send"])      # (S, n_stripes)
+            overflow = np.asarray(out["overflow"])   # (S,)
+            # minimal readback (engine/readback.py), matching the
+            # single-seat shape: per seat only rows through the last SENT
+            # stripe count; all-idle frames fetch nothing
+            from ..engine.readback import fetch_stream_bytes
+            rps_ = g.rows_per_stripe
+            total = 0
+            for seat in range(self.n_seats):
+                if overflow[seat] or not send[seat].any():
+                    continue
+                last_row = (int(np.nonzero(send[seat])[0][-1]) + 1) * rps_
+                total = max(total, int(lens[seat, :last_row].sum()))
+            data = fetch_stream_bytes(out["data"], total) if total else None
         intra = out["intra"]
         if overflow.any():
             if out["cap_gen"] == self._cap_gen:
@@ -194,19 +200,23 @@ class MultiSeatH264Encoder:
             if overflow[seat]:
                 results.append([])
                 continue
-            starts = np.concatenate([[0], np.cumsum(lens[seat])])
-            chunks: list[EncodedChunk] = []
-            for i in range(g.n_stripes):
-                if not send[seat, i]:
-                    continue
-                rows = [bytes(data[seat, starts[r]:starts[r]
-                                   + lens[seat, r]])
-                        for r in range(i * rps, (i + 1) * rps)]
-                payload = h264_stripe_payload(intra, rows, self._sps_pps)
-                chunks.append(EncodedChunk(
-                    payload=payload, frame_id=out["frame_id"],
-                    stripe_y=i * g.stripe_h, width=g.width,
-                    height=g.stripe_h, is_idr=intra, output_mode="h264",
-                    seat_index=seat, display_id=f"seat{seat}"))
+            # per-seat lane: each seat gets its own Perfetto track
+            with _tracer.span("packetize", tl, lane=f"seat{seat}"):
+                starts = np.concatenate([[0], np.cumsum(lens[seat])])
+                chunks: list[EncodedChunk] = []
+                for i in range(g.n_stripes):
+                    if not send[seat, i]:
+                        continue
+                    rows = [bytes(data[seat, starts[r]:starts[r]
+                                       + lens[seat, r]])
+                            for r in range(i * rps, (i + 1) * rps)]
+                    payload = h264_stripe_payload(intra, rows,
+                                                  self._sps_pps)
+                    chunks.append(EncodedChunk(
+                        payload=payload, frame_id=out["frame_id"],
+                        stripe_y=i * g.stripe_h, width=g.width,
+                        height=g.stripe_h, is_idr=intra,
+                        output_mode="h264", seat_index=seat,
+                        display_id=f"seat{seat}"))
             results.append(chunks)
         return results

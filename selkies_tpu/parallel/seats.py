@@ -28,6 +28,7 @@ from ..codecs import jpeg as jtab
 from ..codecs.jpeg import stuff_ff_bytes
 from ..engine.encoder import build_step_fn, plan_grid
 from ..engine.types import CaptureSettings, EncodedChunk
+from ..trace import tracer as _tracer
 
 try:  # jax>=0.8 top-level; older releases keep it in experimental
     from jax import shard_map
@@ -140,19 +141,22 @@ class MultiSeatEncoder:
             prev = getattr(self, "_prev", None)
             if prev is None:
                 prev = self.make_prev_buffer()
-        data, lens, send, is_paint, age, overflow = self._step(
-            frames, prev, self._age, *self._qt_dev)
-        self._prev = frames
-        self._age = age
-        fid = self.frame_id
-        self.frame_id = (self.frame_id + 1) & 0xFFFF
-        # small control arrays only; the stream buffer is fetched
-        # minimally at finalize (engine/readback.py)
-        for arr in (lens, send, is_paint, overflow):
-            try:
-                arr.copy_to_host_async()
-            except Exception:
-                pass
+        # covers the step AND the async-copy kicks so backends whose copy
+        # kick synchronizes (CPU) still attribute the compute wait here
+        with _tracer.span("encode.dispatch"):
+            data, lens, send, is_paint, age, overflow = self._step(
+                frames, prev, self._age, *self._qt_dev)
+            self._prev = frames
+            self._age = age
+            fid = self.frame_id
+            self.frame_id = (self.frame_id + 1) & 0xFFFF
+            # small control arrays only; the stream buffer is fetched
+            # minimally at finalize (engine/readback.py)
+            for arr in (lens, send, is_paint, overflow):
+                try:
+                    arr.copy_to_host_async()
+                except Exception:
+                    pass
         return {"data": data, "lens": lens, "send": send,
                 "is_paint": is_paint, "overflow": overflow, "frame_id": fid,
                 "qtabs": self._qt_np}
@@ -162,10 +166,30 @@ class MultiSeatEncoder:
                  ) -> list[list[EncodedChunk]]:
         """Blocks on readback; returns ``chunks[seat]`` lists."""
         g = self.grid
-        lens = np.asarray(out["lens"])        # (S, n_stripes)
-        send = np.asarray(out["send"])
-        is_paint = np.asarray(out["is_paint"])
-        overflow = np.asarray(out["overflow"])  # (S,)
+        # ONE readback span per frame (control-array sync + stream
+        # fetch); fragments would double the stage count
+        tl = _tracer.lookup(self.settings.display_id, out["frame_id"])
+        with _tracer.span("encode.readback", tl):
+            lens = np.asarray(out["lens"])        # (S, n_stripes)
+            send = np.asarray(out["send"])
+            is_paint = np.asarray(out["is_paint"])
+            overflow = np.asarray(out["overflow"])  # (S,)
+            # minimal readback (engine/readback.py), matching the
+            # single-seat shape: per seat only bytes through the last
+            # DELIVERED stripe count; all-idle frames fetch nothing.
+            # Overflowed seats are skipped here, so the growth pass below
+            # (which only flags THOSE seats) can run after the fetch.
+            from ..engine.readback import fetch_stream_bytes
+            total = 0
+            for seat in range(self.n_seats):
+                if overflow[seat]:
+                    continue
+                if force_all or self._force_after_drop[seat]:
+                    total = max(total, int(lens[seat].sum()))
+                elif send[seat].any():
+                    last = int(np.nonzero(send[seat])[0][-1])
+                    total = max(total, int(lens[seat, :last + 1].sum()))
+            data = fetch_stream_bytes(out["data"], total) if total else None
         qy_m, qc_m, qy_p, qc_p = out["qtabs"]
 
         if overflow.any():
@@ -179,21 +203,6 @@ class MultiSeatEncoder:
             self._step = self._build_step()
             self._force_after_drop |= overflow
 
-        # minimal readback (engine/readback.py), matching the
-        # single-seat shape: per seat only bytes through the last
-        # DELIVERED stripe count; all-idle frames fetch nothing
-        from ..engine.readback import fetch_stream_bytes
-        total = 0
-        for seat in range(self.n_seats):
-            if overflow[seat]:
-                continue
-            if force_all or self._force_after_drop[seat]:
-                total = max(total, int(lens[seat].sum()))
-            elif send[seat].any():
-                last = int(np.nonzero(send[seat])[0][-1])
-                total = max(total, int(lens[seat, :last + 1].sum()))
-        data = fetch_stream_bytes(out["data"], total) if total else None
-
         results: list[list[EncodedChunk]] = []
         for seat in range(self.n_seats):
             if overflow[seat]:
@@ -201,23 +210,25 @@ class MultiSeatEncoder:
                 continue
             force = force_all or self._force_after_drop[seat]
             self._force_after_drop[seat] = False
-            starts = np.concatenate([[0], np.cumsum(lens[seat])])
-            chunks: list[EncodedChunk] = []
-            for i in range(g.n_stripes):
-                if not (force or send[seat, i]):
-                    continue
-                raw = data[seat, starts[i]:starts[i] + lens[seat, i]]
-                scan = stuff_ff_bytes(raw)
-                paint = bool(is_paint[seat, i])
-                qy = qy_p if paint else qy_m
-                qc = qc_p if paint else qc_m
-                payload = jtab.assemble_jfif(g.stripe_h, g.width, scan,
-                                             qy, qc, self.subsampling)
-                chunks.append(EncodedChunk(
-                    payload=payload, frame_id=out["frame_id"],
-                    stripe_y=i * g.stripe_h, width=g.width,
-                    height=g.stripe_h, is_idr=True, output_mode="jpeg",
-                    seat_index=seat, display_id=f"seat{seat}"))
+            # per-seat lane: each seat gets its own Perfetto track
+            with _tracer.span("packetize", tl, lane=f"seat{seat}"):
+                starts = np.concatenate([[0], np.cumsum(lens[seat])])
+                chunks: list[EncodedChunk] = []
+                for i in range(g.n_stripes):
+                    if not (force or send[seat, i]):
+                        continue
+                    raw = data[seat, starts[i]:starts[i] + lens[seat, i]]
+                    scan = stuff_ff_bytes(raw)
+                    paint = bool(is_paint[seat, i])
+                    qy = qy_p if paint else qy_m
+                    qc = qc_p if paint else qc_m
+                    payload = jtab.assemble_jfif(g.stripe_h, g.width, scan,
+                                                 qy, qc, self.subsampling)
+                    chunks.append(EncodedChunk(
+                        payload=payload, frame_id=out["frame_id"],
+                        stripe_y=i * g.stripe_h, width=g.width,
+                        height=g.stripe_h, is_idr=True, output_mode="jpeg",
+                        seat_index=seat, display_id=f"seat{seat}"))
             results.append(chunks)
         return results
 

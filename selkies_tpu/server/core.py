@@ -141,6 +141,8 @@ class CentralizedStreamServer:
         r.add_get("/api/status", self.handle_status)
         r.add_get("/api/health", self.handle_health)
         r.add_post("/api/switch", self.handle_switch)
+        r.add_get("/api/trace", self.handle_trace)
+        r.add_post("/api/trace", self.handle_trace_control)
         if self.settings.secure_api:
             r.add_post("/api/tokens", self.handle_mint_token)
             r.add_get("/api/tokens", self.handle_list_tokens)
@@ -196,6 +198,50 @@ class CentralizedStreamServer:
         from .metrics import render_prometheus
         return web.Response(text=render_prometheus(),
                             content_type="text/plain")
+
+    async def handle_trace(self, request: web.Request) -> web.Response:
+        """Current frame timelines as Chrome trace-event JSON — save the
+        body and load it in Perfetto / chrome://tracing. ``otherData``
+        carries the tracer state so dashboards can poll one endpoint."""
+        from ..trace import tracer
+        from ..trace.export import to_trace_events
+        snap = tracer.snapshot()
+        doc = to_trace_events(snap, process_name=self.settings.app_name)
+        doc["otherData"] = tracer.stats(frames=len(snap))
+        return web.json_response(doc)
+
+    async def handle_trace_control(self, request: web.Request) -> web.Response:
+        """POST {"action": "start"|"stop"|"clear"[, "capacity": N]}."""
+        if request["role"] != "full":
+            return web.Response(status=403, text="view-only")
+        from ..trace import tracer
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            return web.Response(status=400, text="JSON object body required")
+        action = body.get("action")
+        if action == "start":
+            cap = body.get("capacity")
+            if cap is not None:
+                try:
+                    cap = int(cap)
+                except (TypeError, ValueError):
+                    cap = 0
+                if cap <= 0:
+                    return web.Response(
+                        status=400, text="capacity must be a positive int")
+            tracer.enable(cap)
+        elif action == "stop":
+            tracer.disable()
+        elif action == "clear":
+            tracer.clear()
+        else:
+            return web.Response(
+                status=400, text=f"unknown action {action!r} "
+                "(want start|stop|clear)")
+        return web.json_response(tracer.stats())
 
     async def handle_switch(self, request: web.Request) -> web.Response:
         if request["role"] != "full":

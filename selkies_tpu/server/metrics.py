@@ -59,8 +59,16 @@ def clear() -> None:
         _hists.clear()
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus text exposition escaping: backslash, double-quote and
+    newline must be escaped inside label values (spec 'Text format
+    details'); anything else passes through verbatim."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
 def _fmt_labels(labels: tuple, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in labels]
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in labels]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""

@@ -21,17 +21,49 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from collections import deque
 from typing import Awaitable, Callable, Optional
 
-from ..protocol import FRAME_TYPE_IDR, OP_H264, unpack_h264_header
+from ..protocol import (FRAME_TYPE_IDR, OP_H264, OP_JPEG,
+                        unpack_h264_header, unpack_jpeg_header)
+from ..trace import tracer as _tracer
+from . import metrics
 
 logger = logging.getLogger("selkies_tpu.server.relay")
 
 IDR_REQUEST_MIN_INTERVAL_S = 0.5
 SEND_TIMEOUT_S = 1.0
 RELAY_FLOOR_BYTES = 4 * 1024 * 1024
+
+metrics.describe("selkies_relay_deaths_total",
+                 "Relays marked dead (stalled/failed media sends)")
+metrics.describe("selkies_relay_alive", "Currently-alive video relays")
+
+# alive-relay accounting: counted at start(), released exactly once at
+# death or close (whichever comes first)
+_alive_lock = threading.Lock()
+_alive_count = 0
+
+
+def _alive_delta(d: int) -> None:
+    global _alive_count
+    with _alive_lock:
+        _alive_count = max(0, _alive_count + d)
+        metrics.set_gauge("selkies_relay_alive", _alive_count)
+
+
+def _wire_frame_id(item: bytes) -> Optional[int]:
+    """frame id from a packed media frame (trace correlation only)."""
+    try:
+        if item[0] == OP_H264:
+            return unpack_h264_header(item)[1]
+        if item[0] == OP_JPEG:
+            return unpack_jpeg_header(item)[1]
+    except (ValueError, IndexError):
+        pass
+    return None
 
 
 class VideoRelay:
@@ -41,11 +73,15 @@ class VideoRelay:
     def __init__(self, send_bytes: Callable[[bytes], Awaitable[None]],
                  budget_bytes: int = RELAY_FLOOR_BYTES,
                  request_idr: Optional[Callable[[], None]] = None,
-                 on_dead: Optional[Callable[[], None]] = None):
+                 on_dead: Optional[Callable[[], None]] = None,
+                 display: Optional[str] = None):
         self._send = send_bytes
         self.budget = max(budget_bytes, RELAY_FLOOR_BYTES)
         self._request_idr = request_idr
         self._on_dead = on_dead
+        #: display this relay serves — trace correlation key for send spans
+        self.display = display
+        self._counted_alive = False
         self._q: deque[bytes] = deque()
         self._q_bytes = 0
         self._wake = asyncio.Event()
@@ -59,6 +95,8 @@ class VideoRelay:
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._run())
+        self._counted_alive = True
+        _alive_delta(+1)
 
     # ------------------------------------------------------------- producers
     def drained(self) -> bool:
@@ -106,8 +144,16 @@ class VideoRelay:
                     continue
                 item = self._q.popleft()
                 self._q_bytes -= len(item)
+                traced = _tracer.enabled and self.display is not None
                 try:
+                    t0 = time.perf_counter_ns() if traced else 0
                     await asyncio.wait_for(self._send(item), SEND_TIMEOUT_S)
+                    if traced:
+                        fid = _wire_frame_id(item)
+                        if fid is not None:
+                            _tracer.attach_span(
+                                self.display, fid, "ws.send", t0,
+                                time.perf_counter_ns() - t0, lane="ws")
                     self.sent_bytes += len(item)
                 except (asyncio.TimeoutError, ConnectionError, OSError):
                     # cancelled mid-send = possibly torn frame; this socket
@@ -119,14 +165,34 @@ class VideoRelay:
             pass
 
     def _mark_dead(self) -> None:
+        """A send stalled/failed: this socket never carries media again.
+        Surfaced at /api/metrics (ISSUE 2 satellite: relay death must be
+        visible beyond the bench's fallback string). Idempotent — a
+        control-path death verdict and the sender task's own failure can
+        both land on the same relay."""
+        if self.dead:
+            return
         self.dead = True
         self._q.clear()
         self._q_bytes = 0
+        metrics.inc_counter("selkies_relay_deaths_total")
+        if self._counted_alive:
+            self._counted_alive = False
+            _alive_delta(-1)
         if self._on_dead:
             self._on_dead()
 
+    def mark_dead(self) -> None:
+        """External death verdict (e.g. a control send to the same socket
+        timed out) — same accounting as an in-relay send failure."""
+        self._mark_dead()
+        self._wake.set()
+
     async def close(self) -> None:
         self.dead = True
+        if self._counted_alive:
+            self._counted_alive = False
+            _alive_delta(-1)
         self._wake.set()
         if self._task:
             self._task.cancel()

@@ -34,6 +34,7 @@ from ..engine import CaptureSettings, ScreenCapture
 from ..engine.types import EncodedChunk
 from ..settings import AppSettings, SettingsError
 from ..taskutil import spawn_retained
+from ..trace import tracer as _tracer
 from . import metrics
 from .core import BaseStreamingService
 from .relay import VideoRelay
@@ -531,13 +532,16 @@ class WebSocketsService(BaseStreamingService):
     def _do_fanout(self, chunk: EncodedChunk) -> None:
         """Runs on the loop; wire-frames once, offers to every relay.
         Synchronous — no awaits (reference selkies.py:4234-4292)."""
-        if chunk.output_mode == "jpeg":
-            frame = P.pack_jpeg_stripe(chunk.frame_id, chunk.stripe_y,
-                                       chunk.payload)
-        else:
-            frame = P.pack_h264_stripe(chunk.frame_id, chunk.stripe_y,
-                                       chunk.width, chunk.height,
-                                       chunk.payload, idr=chunk.is_idr)
+        with _tracer.span("fanout",
+                          _tracer.lookup(chunk.display_id, chunk.frame_id),
+                          lane="loop"):
+            if chunk.output_mode == "jpeg":
+                frame = P.pack_jpeg_stripe(chunk.frame_id, chunk.stripe_y,
+                                           chunk.payload)
+            else:
+                frame = P.pack_h264_stripe(chunk.frame_id, chunk.stripe_y,
+                                           chunk.width, chunk.height,
+                                           chunk.payload, idr=chunk.is_idr)
         metrics.inc_counter("selkies_frames_encoded_total")
         # out-of-band recording tap: raw Annex-B / MJPEG of the primary
         # display (reference recording socket, settings.py:640-645)
@@ -570,7 +574,7 @@ class WebSocketsService(BaseStreamingService):
                     RuntimeError, OSError):
                 logger.info("control send to client %d failed; closing", c.id)
                 for relay in c.relays.values():
-                    relay.dead = True
+                    relay.mark_dead()
                 try:
                     await c.ws.close()
                 except Exception:
@@ -836,6 +840,9 @@ class WebSocketsService(BaseStreamingService):
         client.last_ack_id = acked
         client.last_ack_time = now
         client.fps_est.tick(now)
+        if _tracer.enabled:
+            # close the glass-to-glass loop on the frame's timeline
+            _tracer.instant(client.display, acked, "ack", lane="ws")
         self._update_backpressure(client)
 
     def _update_backpressure(self, client: ClientConnection) -> None:
@@ -871,7 +878,8 @@ class WebSocketsService(BaseStreamingService):
                 client.ws.send_bytes,
                 budget_bytes=int(self.settings.video_relay_budget_s
                                  * self.settings.video_bitrate_kbps * 125),
-                request_idr=lambda d=did: self._request_idr(d))
+                request_idr=lambda d=did: self._request_idr(d),
+                display=did)
             relay.start()
             client.relays[did] = relay
         self._ensure_capture(did)

@@ -297,6 +297,10 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
 
     # --- metrics ------------------------------------------------------------
     _s("enable_metrics", SType.BOOL, True, "Prometheus /api/metrics endpoint."),
+    _s("enable_trace", SType.BOOL, False,
+       "Per-frame span tracing from boot (selkies_tpu/trace): stage "
+       "latency attribution at /api/trace as Perfetto-loadable trace-event "
+       "JSON. Also togglable live via POST /api/trace."),
     _s("stats_interval_s", SType.FLOAT, 5.0, "Per-client system stats cadence."),
 )
 

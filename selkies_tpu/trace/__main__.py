@@ -1,0 +1,95 @@
+"""Offline trace CLI.
+
+``python -m selkies_tpu.trace summarize <trace.json>`` — per-stage
+p50/p99 table (``--json`` for machine-readable) over a saved /api/trace
+snapshot or any Chrome trace-event file.
+
+``python -m selkies_tpu.trace selftest [out.json]`` — emit a synthetic
+timeline through the real tracer + exporter (the CI smoke path) and
+summarize it; exits non-zero when the round-trip drops a stage.
+
+Stdlib-only: runs in the lint CI image with no jax/aiohttp installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import STAGES
+from .core import FrameTracer
+from .export import events_from_document, to_trace_events
+from .summary import render_table, summarize_events
+
+
+def _cmd_summarize(args: argparse.Namespace) -> int:
+    try:
+        with open(args.file, encoding="utf-8") as f:
+            doc = json.load(f)
+        events = events_from_document(doc)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {args.file}: {e}", file=sys.stderr)
+        return 2
+    summary = summarize_events(events)
+    if args.json:
+        print(json.dumps({"version": 1, "file": args.file,
+                          "stages": summary}))
+    else:
+        if not summary:
+            print("no complete spans in trace", file=sys.stderr)
+        print(render_table(summary))
+    return 0
+
+
+def _cmd_selftest(args: argparse.Namespace) -> int:
+    tr = FrameTracer(capacity=16)
+    tr.enable()
+    import time
+    for fid in range(4):
+        tl = tr.frame_begin("selftest")
+        tr.bind(tl, fid)
+        for stage in STAGES:
+            with tr.span(stage, tl):
+                time.sleep(0.001)
+        tr.frame_end("selftest", fid)
+        tr.instant("selftest", fid, "ack")
+    doc = to_trace_events(tr.snapshot())
+    out = args.out or "-"
+    text = json.dumps(doc)
+    if out == "-":
+        print(text)
+    else:
+        with open(out, "w", encoding="utf-8") as f:
+            f.write(text)
+    summary = summarize_events(events_from_document(json.loads(text)))
+    missing = [s for s in STAGES if s not in summary]
+    if missing:
+        print(f"selftest FAILED: stages lost in round-trip: {missing}",
+              file=sys.stderr)
+        return 1
+    print(render_table(summary), file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m selkies_tpu.trace",
+                                description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser("summarize",
+                        help="per-stage p50/p99 over a trace-event file")
+    ps.add_argument("file")
+    ps.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ps.set_defaults(fn=_cmd_summarize)
+    pt = sub.add_parser("selftest",
+                        help="synthetic timeline through tracer+exporter")
+    pt.add_argument("out", nargs="?", default="",
+                    help="write the trace JSON here ('-' or empty: stdout)")
+    pt.set_defaults(fn=_cmd_selftest)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Chrome trace-event JSON export (the Perfetto / chrome://tracing format).
+
+Emits the JSON Object Format: ``{"traceEvents": [...], "displayTimeUnit":
+"ms"}`` with complete (``ph: "X"``) events for spans, instant (``ph:
+"i"``) events for zero-duration markers, and ``M`` metadata events naming
+the process and one thread track per lane. Timestamps are microseconds of
+``perf_counter`` — relative, monotonic, exactly what the viewers expect.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Union
+
+from .core import FrameTimeline
+
+PID = 1
+#: tid reserved for the per-frame envelope track
+FRAME_TID = 0
+
+
+def _as_dict(tl: Union[FrameTimeline, dict]) -> dict:
+    return tl if isinstance(tl, dict) else tl.to_dict()
+
+
+def to_trace_events(timelines: Iterable[Union[FrameTimeline, dict]],
+                    process_name: str = "selkies-tpu") -> dict:
+    """Render timelines to a Chrome trace-event document (plain dict,
+    ``json.dumps``-ready)."""
+    events: list[dict] = [{
+        "ph": "M", "pid": PID, "tid": FRAME_TID, "name": "process_name",
+        "args": {"name": process_name},
+    }, {
+        "ph": "M", "pid": PID, "tid": FRAME_TID, "name": "thread_name",
+        "args": {"name": "frames"},
+    }]
+    lanes: dict[str, int] = {}
+
+    def tid_for(lane: str) -> int:
+        tid = lanes.get(lane)
+        if tid is None:
+            tid = lanes[lane] = len(lanes) + 1
+            events.append({"ph": "M", "pid": PID, "tid": tid,
+                           "name": "thread_name", "args": {"name": lane}})
+        return tid
+
+    for tl in timelines:
+        d = _as_dict(tl)
+        fid = d.get("frame_id")
+        frame_args = {"frame_id": fid, "display": d.get("display_id")}
+        if d.get("t1_ns") is not None:
+            events.append({
+                "name": f"frame {fid}", "ph": "X", "pid": PID,
+                "tid": FRAME_TID, "ts": d["t0_ns"] / 1e3,
+                "dur": (d["t1_ns"] - d["t0_ns"]) / 1e3, "args": frame_args,
+            })
+        for s in d.get("spans", []):
+            tid = tid_for(s["lane"])
+            if s["dur_ns"] <= 0:
+                events.append({
+                    "name": s["name"], "ph": "i", "s": "t", "pid": PID,
+                    "tid": tid, "ts": s["t0_ns"] / 1e3, "args": frame_args,
+                })
+            else:
+                events.append({
+                    "name": s["name"], "ph": "X", "pid": PID, "tid": tid,
+                    "ts": s["t0_ns"] / 1e3, "dur": s["dur_ns"] / 1e3,
+                    "args": frame_args,
+                })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def events_from_document(doc) -> list[dict]:
+    """Accept either the object form ({"traceEvents": [...]}) or the bare
+    JSON-array form — both are valid on the import side of the viewers."""
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents", [])
+    elif isinstance(doc, list):
+        events = doc
+    else:
+        raise ValueError("not a trace-event document")
+    if not isinstance(events, list):
+        raise ValueError("traceEvents must be a list")
+    return [e for e in events if isinstance(e, dict)]

@@ -1,0 +1,88 @@
+"""Per-stage latency summarizer: p50/p99 per span name.
+
+Consumes either live :class:`~.core.FrameTimeline`s or the exported
+Chrome trace-event JSON (the offline CLI path), so a BENCH_r*.json
+breakdown and a saved /api/trace snapshot summarize identically.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Union
+
+from .core import FrameTimeline
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile on an ascending list (the same convention
+    bench.py uses for its p50/p99 line)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(len(sorted_vals) * q))
+    return sorted_vals[i]
+
+
+def summarize_durations(by_stage: dict[str, list[float]]) -> dict[str, dict]:
+    """{stage: [ms, ...]} -> {stage: {count, p50_ms, p99_ms, mean_ms,
+    total_ms}}, stages sorted by total time descending."""
+    out: dict[str, dict] = {}
+    for name, vals in by_stage.items():
+        vals = sorted(vals)
+        total = sum(vals)
+        out[name] = {
+            "count": len(vals),
+            "p50_ms": round(_pct(vals, 0.50), 3),
+            "p99_ms": round(_pct(vals, 0.99), 3),
+            "mean_ms": round(total / len(vals), 3) if vals else 0.0,
+            "total_ms": round(total, 3),
+        }
+    return dict(sorted(out.items(),
+                       key=lambda kv: -kv[1]["total_ms"]))
+
+
+def summarize_timelines(timelines: Iterable[Union[FrameTimeline, dict]]
+                        ) -> dict[str, dict]:
+    by_stage: dict[str, list[float]] = {}
+    for tl in timelines:
+        d = tl if isinstance(tl, dict) else tl.to_dict()
+        for s in d.get("spans", []):
+            if s["dur_ns"] > 0:
+                by_stage.setdefault(s["name"], []).append(s["dur_ns"] / 1e6)
+    return summarize_durations(by_stage)
+
+
+def summarize_events(events: Iterable[dict]) -> dict[str, dict]:
+    """Summarize exported trace events: complete (``X``) spans only; the
+    per-frame envelope track ('frame N' names) is excluded so stage sums
+    aren't double-counted."""
+    by_stage: dict[str, list[float]] = {}
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        name = str(e.get("name", "?"))
+        if name.startswith("frame "):
+            continue
+        by_stage.setdefault(name, []).append(float(e["dur"]) / 1e3)
+    return summarize_durations(by_stage)
+
+
+def frame_latency_ms(timelines: Iterable[Union[FrameTimeline, dict]]
+                     ) -> list[float]:
+    """Completed frames' begin->end wall times (the e2e the stage sum is
+    validated against)."""
+    out = []
+    for tl in timelines:
+        d = tl if isinstance(tl, dict) else tl.to_dict()
+        if d.get("t1_ns") is not None:
+            out.append((d["t1_ns"] - d["t0_ns"]) / 1e6)
+    return out
+
+
+def render_table(summary: dict[str, dict]) -> str:
+    """Fixed-width human table for the CLI / bench stderr."""
+    lines = [f"{'stage':<18} {'count':>6} {'p50_ms':>9} {'p99_ms':>9} "
+             f"{'mean_ms':>9} {'total_ms':>10}"]
+    for name, s in summary.items():
+        lines.append(f"{name:<18} {s['count']:>6} {s['p50_ms']:>9.3f} "
+                     f"{s['p99_ms']:>9.3f} {s['mean_ms']:>9.3f} "
+                     f"{s['total_ms']:>10.3f}")
+    return "\n".join(lines)

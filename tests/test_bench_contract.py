@@ -33,3 +33,12 @@ def test_bench_emits_single_json_line():
     # CPU number
     assert doc["backend"].startswith(("cpu-fallback", "cpu", "tpu",
                                       "axon"))
+    # per-stage latency attribution (ISSUE 2): every stage key present,
+    # and the stage sum within 20% of the measured e2e frame latency
+    from selkies_tpu.trace import STAGES
+    assert set(doc["stages_ms"]) == set(STAGES)
+    stage_sum = doc["stage_sum_ms"]
+    e2e = doc["latency_mean_ms"]
+    assert stage_sum == round(sum(doc["stages_ms"].values()), 3)
+    assert abs(stage_sum - e2e) <= 0.2 * e2e, \
+        f"stage sum {stage_sum}ms vs e2e {e2e}ms: uninstrumented stall"

@@ -850,3 +850,113 @@ async def test_cold_start_system_msg(client_factory):
             got = msg.data
     assert got is not None and "preparing encoder" in got
     await ws.close()
+
+
+def test_prometheus_label_escaping():
+    """Satellite (ISSUE 2): '"' and '\\' (and newlines) in label values
+    must be escaped per the Prometheus text exposition spec, or the
+    /api/metrics output is unparseable."""
+    from selkies_tpu.server import metrics
+    metrics.clear()
+    metrics.set_gauge("esc_test_gauge", 1.0,
+                      {"path": 'C:\\tmp "quoted"\nnext'})
+    text = metrics.render_prometheus()
+    assert ('esc_test_gauge{path="C:\\\\tmp \\"quoted\\"\\nnext"} 1.0'
+            in text)
+    # escaped output stays one physical line per sample
+    sample = [ln for ln in text.splitlines() if "esc_test_gauge{" in ln]
+    assert len(sample) == 1
+    metrics.clear()
+
+
+async def test_relay_death_metrics():
+    """Satellite (ISSUE 2): relay death must be visible at /api/metrics
+    (counter + alive gauge), not only as a bench fallback string."""
+    from selkies_tpu.server import metrics
+    from selkies_tpu.server.relay import VideoRelay
+
+    def _gauge(text, name):
+        for ln in text.splitlines():
+            if ln.startswith(name + " ") or ln.startswith(name + "{"):
+                return float(ln.rsplit(" ", 1)[1])
+        return None
+
+    def _counter(text):
+        return _gauge(text, "selkies_relay_deaths_total") or 0.0
+
+    async def _failing_send(data):
+        raise ConnectionError("peer gone")
+
+    deaths_before = _counter(metrics.render_prometheus())
+    relay = VideoRelay(_failing_send, display=":0")
+    relay.start()
+    alive_started = _gauge(metrics.render_prometheus(),
+                           "selkies_relay_alive")
+    relay.offer(P.pack_jpeg_stripe(1, 0, b"\xff\xd8payload\xff\xd9"))
+    for _ in range(50):
+        await asyncio.sleep(0.01)
+        if relay.dead:
+            break
+    assert relay.dead
+    text = metrics.render_prometheus()
+    assert _counter(text) == deaths_before + 1
+    assert _gauge(text, "selkies_relay_alive") == alive_started - 1
+    # a second death verdict on the same relay (control path + sender
+    # task can both conclude it) must not double-count
+    relay.mark_dead()
+    assert _counter(metrics.render_prometheus()) == deaths_before + 1
+    # close() of an already-dead relay must not double-release
+    await relay.close()
+    assert _gauge(metrics.render_prometheus(),
+                  "selkies_relay_alive") == alive_started - 1
+
+
+async def test_relay_clean_close_is_not_a_death():
+    from selkies_tpu.server import metrics
+    from selkies_tpu.server.relay import VideoRelay
+
+    def _counter(text):
+        for ln in text.splitlines():
+            if ln.startswith("selkies_relay_deaths_total "):
+                return float(ln.rsplit(" ", 1)[1])
+        return 0.0
+
+    sent = []
+
+    async def _send(data):
+        sent.append(data)
+
+    before = _counter(metrics.render_prometheus())
+    relay = VideoRelay(_send, display=":0")
+    relay.start()
+    relay.offer(P.pack_jpeg_stripe(2, 0, b"\xff\xd8ok\xff\xd9"))
+    await asyncio.sleep(0.05)
+    await relay.close()
+    assert sent
+    assert _counter(metrics.render_prometheus()) == before
+
+
+async def test_relay_send_span_attaches_to_frame_timeline():
+    """The ws.send stage lands on the frame's trace timeline by id."""
+    from selkies_tpu.server.relay import VideoRelay
+    from selkies_tpu.trace import tracer
+
+    async def _send(data):
+        await asyncio.sleep(0)
+
+    tracer.enable(capacity=16)
+    try:
+        tl = tracer.frame_begin(":0")
+        tracer.bind(tl, 42)
+        relay = VideoRelay(_send, display=":0")
+        relay.start()
+        relay.offer(P.pack_jpeg_stripe(42, 0, b"\xff\xd8x\xff\xd9"))
+        for _ in range(50):
+            await asyncio.sleep(0.01)
+            if any(s[0] == "ws.send" for s in tl.spans):
+                break
+        await relay.close()
+        assert any(s[0] == "ws.send" for s in tl.spans)
+    finally:
+        tracer.disable()
+        tracer.clear()
