@@ -15,6 +15,14 @@ throughput / 60 fps — the reference's published floor (README.md:7).
 
 Prints exactly ONE JSON line on stdout; progress goes to stderr.
 Knobs: BENCH_FRAMES, BENCH_WIDTH/BENCH_HEIGHT, BENCH_QUALITY.
+
+Device telemetry (selkies_tpu/obs, ISSUE 3): every run emits
+``hbm_peak_mb``, ``compile_count``, ``compile_total_s``, cache
+hit/miss counts, and a ``backend_health`` verdict — a dead-relay CPU
+fallback is a ``failed`` verdict, never a plausible-looking fps number.
+``--profile`` (or BENCH_PROFILE=1) wraps the steady-state throughput
+loop in a jax.profiler capture (dir: BENCH_PROFILE_DIR or a fresh
+tempdir, reported as ``profile_dir``).
 """
 
 import json
@@ -118,6 +126,14 @@ def main(force_cpu: bool = False) -> None:
     # h264 build into seconds, keeping the bench inside the driver timeout
     from selkies_tpu.compile_cache import enable as enable_compile_cache
     enable_compile_cache(jax)
+
+    # device telemetry: compile/cache listeners BEFORE any session build
+    # so warmup compiles are counted too; HBM is sampled after the timed
+    # loops (memory_stats is an RPC — never inside a measurement)
+    from selkies_tpu.obs import monitor as _devmon
+    _devmon.attach_jax(jax)
+    want_profile = "--profile" in sys.argv[1:] \
+        or os.environ.get("BENCH_PROFILE") == "1"
 
     from selkies_tpu.engine.encoder import JpegEncoderSession
     from selkies_tpu.engine.h264_encoder import H264EncoderSession
@@ -245,6 +261,14 @@ def main(force_cpu: bool = False) -> None:
     import collections
     inflight = collections.deque()
     tp_budget = float(os.environ.get("BENCH_TP_BUDGET_S", "60"))
+    profile_dir = None
+    if want_profile:
+        # steady-state frames only: warmup/compile would drown the
+        # capture in XLA build noise
+        from selkies_tpu.obs import profiler as _prof
+        res = _prof.start(os.environ.get("BENCH_PROFILE_DIR") or None)
+        profile_dir = res.get("trace_dir")
+        log(f"jax profiler capture: {res}")
     t0 = time.monotonic()
     done = 0
     p_bytes = 0
@@ -264,6 +288,22 @@ def main(force_cpu: bool = False) -> None:
     fps = done / dt
     log(f"throughput: {done} frames in {dt:.2f}s -> {fps:.1f} fps "
         f"({p_bytes // max(done, 1)} B/frame delta)")
+    if want_profile:
+        log(f"jax profiler capture stopped: {_prof.stop()}")
+
+    # device telemetry for the JSON line: HBM peak (forced sample — the
+    # timed loops are over, the RPC can't skew anything now), compile
+    # accounting, and the backend health verdict (the contract test's
+    # dead-relay bar: BENCH_CPU_REASON => failed)
+    _devmon.sample(force=True)
+    compile_stats = _devmon.compile_stats()
+    _devmon.platform = backend
+    verdict = _devmon.backend_verdict()
+    log(f"hbm_peak={_devmon.hbm_peak_mb()}MB "
+        f"compiles={compile_stats['count']} "
+        f"({compile_stats['total_s']}s, cache "
+        f"{compile_stats['cache_hits']}h/{compile_stats['cache_misses']}m) "
+        f"backend verdict: {verdict.status} ({verdict.reason})")
 
     mbps = total_bytes / n_lat * fps * 8 / 1e6
     print(json.dumps({
@@ -278,6 +318,14 @@ def main(force_cpu: bool = False) -> None:
         "stage_sum_ms": stage_sum_ms,
         "bitrate_mbps": round(mbps, 1),
         "backend": backend_label,
+        "backend_health": {"status": verdict.status,
+                           "reason": verdict.reason},
+        "hbm_peak_mb": _devmon.hbm_peak_mb(),
+        "compile_count": compile_stats["count"],
+        "compile_total_s": compile_stats["total_s"],
+        "compile_cache_hits": compile_stats["cache_hits"],
+        "compile_cache_misses": compile_stats["cache_misses"],
+        **({"profile_dir": profile_dir} if profile_dir else {}),
         "frames": n_frames,
     }))
 
@@ -297,12 +345,16 @@ if __name__ == "__main__":
             os.environ.pop("PALLAS_AXON_POOL_IPS", None)
             os.environ["JAX_PLATFORMS"] = "cpu"
             os.environ["BENCH_CPU_REASON"] = "relay-died-mid-run"
-            os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
+            os.execv(sys.executable, [sys.executable,
+                                      os.path.abspath(__file__),
+                                      *sys.argv[1:]])
         import traceback
         traceback.print_exc(file=sys.stderr)
         print(json.dumps({
             "metric": "encode_fps_unavailable",
             "value": 0.0, "unit": "fps", "vs_baseline": 0.0,
             "backend": "none",
+            "backend_health": {"status": "failed",
+                               "reason": f"{type(e).__name__}: {e}"[:200]},
             "error": f"{type(e).__name__}: {e}"[:300],
         }))

@@ -53,6 +53,19 @@ async def run(argv=None) -> None:
         from .trace import tracer
         tracer.enable()
 
+    # device telemetry plane (selkies_tpu/obs): HBM sampler thread +
+    # jax.monitoring compile listeners + backend/hbm health checks.
+    # Dormant in jax-less control-plane images.
+    from .obs import health as _health
+    from .obs import monitor as _devmon
+    # the sampling policy must hold even when the monitor thread never
+    # starts — the ws stats loop's device_stats() reads it too
+    _devmon.sampling = settings.device_hbm_sampling
+    _devmon.interval_s = max(0.5, settings.device_monitor_interval_s)
+    if settings.enable_device_monitor and _devmon.attach_jax():
+        _devmon.start()
+        _devmon.register_health_checks()
+
     server = CentralizedStreamServer(settings)
 
     # Wayland bring-up (reference stream_server.py:420-447
@@ -87,7 +100,11 @@ async def run(argv=None) -> None:
             input_handler.gamepad_manager = GamepadManager(input_handler)
 
     audio = None
-    if settings.enable_audio:
+    if settings.enable_audio or settings.enable_microphone:
+        # enable_microphone without enable_audio still needs the
+        # pipeline: mic playback (WS 0x02 frames / the WebRTC recvonly
+        # audio m-line) routes through play_mic_pcm + the virtual-mic
+        # graph; the services start it mic-only so no encode loop runs
         try:
             from .audio.pipeline import AudioPipeline
             audio = AudioPipeline(settings)
@@ -116,6 +133,15 @@ async def run(argv=None) -> None:
         except NotImplementedError:
             pass
     await stop.wait()
+    # flight-recorder dump (SIGTERM/SIGINT): the structured incident
+    # trail (relay deaths, compile storms, watchdog trips) must outlive
+    # the container so a postmortem is not a journald grep
+    incidents = _health.engine.recorder
+    if incidents.total:
+        logging.getLogger("selkies_tpu.obs").warning(
+            "flight recorder at shutdown (%d incidents, %d dropped):\n%s",
+            incidents.total, incidents.dropped, incidents.dump_text())
+    _devmon.stop()
     await server.shutdown()
     if owned_compositor is not None:
         await owned_compositor.stop()

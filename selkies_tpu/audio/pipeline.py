@@ -139,6 +139,10 @@ class AudioPipeline:
         #: WebRTC raw tap: fn(opus_packet, rtp_ts48k) per encoded frame
         self.on_raw_frame = None
         self._pts48 = 0
+        #: True when start(mic_only=True) skipped the encode loop
+        self.mic_only = False
+        #: None = mic not requested; else provision() result
+        self.mic_ok: Optional[bool] = None
 
     @property
     def multistream_params(self) -> Optional[dict]:
@@ -151,8 +155,35 @@ class AudioPipeline:
                     "channel_mapping": list(self._enc.mapping)}
         return None
 
+    @property
+    def alive(self) -> bool:
+        """Encode-task liveness for the health plane: True while the
+        capture/encode loop runs. In mic-only mode (no loop to die) it
+        reflects whether the virtual-mic graph actually provisioned —
+        provision() degrades by RETURNING False, so ignoring it would
+        recreate the silent-mic mode the health check exists to catch."""
+        if self.mic_only:
+            return bool(self.mic_ok)
+        return self._task is not None and not self._task.done()
+
     # ------------------------------------------------------------- lifecycle
-    async def start(self) -> None:
+    async def start(self, mic_only: bool = False) -> None:
+        """``mic_only`` provisions the virtual-mic graph and playback
+        path WITHOUT the capture/encode loop — the enable_microphone
+        and not enable_audio configuration (ADVICE r5: mic-over-RTC
+        silently could not work because nothing built this half)."""
+        self.mic_only = bool(mic_only)
+        if getattr(self.settings, "enable_microphone", False):
+            from .virtual_mic import VirtualMicrophone
+            self.virtual_mic = VirtualMicrophone()
+            self.mic_ok = await self.virtual_mic.provision()
+            if not self.mic_ok:
+                logger.warning(
+                    "virtual microphone provisioning failed (no "
+                    "PulseAudio?) — client mic input will not reach "
+                    "desktop apps")
+        if self.mic_only:
+            return
         if self._source is None:
             if shutil.which("parec"):
                 self._source = ParecSource(self.sample_rate, self.channels,
@@ -161,10 +192,6 @@ class AudioPipeline:
                 logger.info("no PulseAudio; synthetic tone source")
                 self._source = SyntheticToneSource(
                     self.sample_rate, self.channels, self.frame_samples)
-        if getattr(self.settings, "enable_microphone", False):
-            from .virtual_mic import VirtualMicrophone
-            self.virtual_mic = VirtualMicrophone()
-            await self.virtual_mic.provision()
         self._task = asyncio.create_task(self._run())
 
     async def stop(self) -> None:

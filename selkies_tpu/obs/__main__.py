@@ -1,0 +1,130 @@
+"""Offline obs CLI.
+
+``python -m selkies_tpu.obs selftest`` — drive the real health engine,
+flight recorder, and device monitor with synthetic inputs and verify
+the full verdict pipeline round-trips (the CI lint smoke, mirroring
+``python -m selkies_tpu.trace selftest``). Exits non-zero on any
+contract break.
+
+``python -m selkies_tpu.obs health`` — evaluate the process-wide engine
+and print the verbose report as JSON (mostly useful under a debugger or
+in a REPL-less container).
+
+Stdlib-only: runs in the lint CI image with no jax/aiohttp installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .device_monitor import DeviceMonitor
+from .health import DEGRADED, FAILED, OK, HealthEngine, degraded, failed, ok
+
+
+def _fail(msg: str) -> int:
+    print(f"selftest FAILED: {msg}", file=sys.stderr)
+    return 1
+
+
+def _cmd_selftest(args: argparse.Namespace) -> int:
+    eng = HealthEngine()
+    state = {"fps": 60.0}
+
+    def fps_check():
+        if state["fps"] <= 0:
+            return failed("capture produced 0 fps")
+        if state["fps"] < 30:
+            return degraded(f"{state['fps']:.0f} fps below target")
+        return ok(f"{state['fps']:.0f} fps")
+
+    eng.register("capture_fps", fps_check)
+    eng.register("service", lambda: ok("active"), liveness=True)
+    eng.register("crashy", lambda: 1 / 0)  # must become a failed verdict
+
+    # healthy -> degraded -> failed transitions
+    rep = eng.report(verbose=True)
+    if rep["checks"]["capture_fps"]["status"] != OK:
+        return _fail("fps check should start ok")
+    if rep["checks"]["crashy"]["status"] != FAILED:
+        return _fail("crashing check must yield a failed verdict")
+    if rep["live"] is not True:
+        return _fail("liveness must ignore readiness-scope failures")
+    if rep["ready"] is not False:
+        return _fail("a failed check must fail readiness")
+    state["fps"] = 12.0
+    if eng.run()["capture_fps"].status != DEGRADED:
+        return _fail("fps below target must degrade")
+    state["fps"] = 0.0
+    if eng.run()["capture_fps"].status != FAILED:
+        return _fail("0 fps must fail")
+    eng.unregister("crashy")
+    state["fps"] = 60.0
+    rep = eng.report(verbose=True)
+    if not (rep["ok"] and rep["ready"] and rep["status"] == OK):
+        return _fail(f"engine should be green again: {rep}")
+
+    # flight recorder: bounded, drop-counted, JSON-dumpable
+    for i in range(eng.recorder.capacity + 10):
+        eng.recorder.record("relay_death", display=f":{i}")
+    snap = eng.recorder.snapshot()
+    if len(snap) != eng.recorder.capacity:
+        return _fail("recorder must stay bounded")
+    if eng.recorder.dropped != 10 or eng.recorder.total != \
+            eng.recorder.capacity + 10:
+        return _fail("recorder drop accounting broken")
+    for line in eng.recorder.dump_text().splitlines():
+        json.loads(line)
+
+    # device monitor: synthetic jax.monitoring events, no jax needed
+    mon = DeviceMonitor(recorder=eng.recorder)
+    mon.on_event("/jax/compilation_cache/cache_hits")
+    mon.on_event("/jax/compilation_cache/cache_misses")
+    mon.on_event_duration(
+        "/jax/core/compile/backend_compile_duration_sec", 1.5)
+    mon.on_event_duration(
+        "/jax/core/compile/backend_compile_duration_sec", 0.5)
+    cs = mon.compile_stats()
+    if cs["count"] != 2 or abs(cs["total_s"] - 2.0) > 1e-6:
+        return _fail(f"compile accounting broken: {cs}")
+    if cs["cache_hits"] != 1 or cs["cache_misses"] != 1:
+        return _fail(f"cache accounting broken: {cs}")
+    ev = mon.trace_events()
+    if len(ev) != 3 or ev[0]["ph"] != "M" \
+            or any(e["ph"] != "X" for e in ev[1:]):
+        return _fail(f"trace overlay shape broken: {ev}")
+    if mon.backend_verdict().status not in (OK, FAILED):
+        return _fail("backend verdict must always resolve")
+
+    doc = {"health": eng.report(verbose=True), "monitor": mon.snapshot()}
+    text = json.dumps(doc)
+    json.loads(text)                       # the payload must round-trip
+    print(text if args.json else "selftest OK "
+          f"({len(text)} bytes of verdict payload)")
+    return 0
+
+
+def _cmd_health(args: argparse.Namespace) -> int:
+    from .health import engine
+    print(json.dumps(engine.report(verbose=True), default=str))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m selkies_tpu.obs",
+                                description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser("selftest",
+                        help="drive engine+recorder+monitor synthetically")
+    ps.add_argument("--json", action="store_true",
+                    help="print the selftest verdict payload")
+    ps.set_defaults(fn=_cmd_selftest)
+    ph = sub.add_parser("health", help="verbose report of the live engine")
+    ph.set_defaults(fn=_cmd_health)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,480 @@
+"""Device telemetry: HBM occupancy sampling + JAX compile-event accounting.
+
+Two feeds, both OFF the hot path:
+
+- a background daemon thread samples ``Device.memory_stats()`` (HBM
+  in-use / peak / limit per device) on an interval. ``memory_stats()``
+  issues a runtime RPC that can CONTEND with the encode thread's device
+  calls on single-client TPU relay transports (the reason
+  ``server/metrics.device_stats`` gates it), so the sampler honours the
+  same policy: ``auto`` samples only on the cpu backend unless
+  ``SELKIES_DEVICE_MEMSTATS=1``; ``on``/``off`` force it either way.
+- :mod:`jax.monitoring` listeners count compilations, total compile
+  seconds, and persistent-cache hits/misses as they happen. Listener
+  callbacks run inside jax's compile path — they only bump counters
+  under a lock and append to a bounded ring, never touch the device.
+
+Everything is exported as ``selkies_device_*`` / ``selkies_compile_*``
+metrics, and compile events are kept as (t0, dur) so the trace endpoint
+can overlay "recompile happened HERE" on the frame timeline — the
+attribution a Perfetto view needs to separate a capture stall from an
+XLA recompile.
+
+jax is imported lazily and every touch point is guarded: the module
+must import (and the selftest must run) in images with no jax at all.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from .health import Verdict, degraded, failed, ok
+
+logger = logging.getLogger("selkies_tpu.obs.devmon")
+
+_now_ns = time.perf_counter_ns
+
+_METRICS_UNSET = object()
+_metrics_mod = _METRICS_UNSET
+
+
+def _metrics():
+    """The server metrics registry, or None in images without the server
+    plane's dependencies (aiohttp is absent from the lint CI image; the
+    selftest must still run there)."""
+    global _metrics_mod
+    if _metrics_mod is _METRICS_UNSET:
+        try:
+            from ..server import metrics as _m
+            _metrics_mod = _m
+        except Exception:
+            _metrics_mod = None
+    return _metrics_mod
+
+#: compile events kept for the trace overlay (each ~4 small fields)
+EVENT_RING_CAPACITY = 256
+
+#: a "compile storm" = this many compiles inside the window AFTER the
+#: warmup grace — steady-state recompiles mean a shape/dtype is unstable
+#: and every one stalls the frame path for seconds
+STORM_WINDOW_S = 60.0
+STORM_THRESHOLD = 5
+WARMUP_GRACE_S = 120.0
+
+
+def _is_cache_hit(name: str) -> bool:
+    return "cache" in name and "hit" in name
+
+
+def _is_cache_miss(name: str) -> bool:
+    return "cache" in name and "miss" in name
+
+
+def _is_compile_duration(name: str) -> bool:
+    """A timer that plausibly measures an XLA build. Excludes the
+    cache's own bookkeeping and jax's cheap per-call phases
+    (jaxpr_trace_duration fires per TRACE, mlir lowering per call) —
+    counting those as compiles would report a healthy warm-cache run as
+    compile-heavy."""
+    if "compil" not in name or "cache" in name:
+        return False
+    return not any(x in name for x in ("jaxpr", "mlir", "trace_duration"))
+
+
+def _is_backend_compile(name: str) -> bool:
+    """The one-per-XLA-build signal. jax also times cheap per-call
+    phases under /jax/core/compile/ (jaxpr_trace_duration fires per
+    TRACE, hundreds of times a minute on a live server) — those must
+    feed neither the Perfetto overlay nor storm detection, or every
+    steady-state jit call reads as a recompile."""
+    return "backend_compile" in name
+
+
+class DeviceMonitor:
+    """Process-wide device/compile telemetry. One instance
+    (:data:`monitor`) serves the server plane and bench; tests build
+    their own and drive :meth:`on_event` / :meth:`on_event_duration`
+    with synthetic events and fake device objects."""
+
+    def __init__(self, recorder=None):
+        self._lock = threading.Lock()
+        #: jax.monitoring duration accounting per event name
+        self._durations: dict[str, list] = {}   # name -> [count, total_s]
+        self._events: dict[str, int] = collections.defaultdict(int)
+        self.cache_hits = 0
+        self.cache_misses = 0
+        #: (t0_ns, dur_ns, name) of compile duration events, bounded
+        self._compile_ring: collections.deque = \
+            collections.deque(maxlen=EVENT_RING_CAPACITY)
+        self._storm_times: collections.deque = collections.deque(maxlen=64)
+        self._storm_reported = 0.0
+        self._started_at = time.monotonic()
+        self._attached = False
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.interval_s = 5.0
+        self.sampling = "auto"          # auto | on | off
+        self.platform: Optional[str] = None
+        #: last memory sample per device id, and the process-lifetime peak
+        self.devices: list[dict] = []
+        self.hbm_peak_bytes = 0
+        self._sampled_once = False
+        self._recorder = recorder
+
+    # ------------------------------------------------------------ lifecycle
+    def attach_jax(self, jax_module=None) -> bool:
+        """Register the jax.monitoring listeners (idempotent). Safe to
+        call in jax-less images — returns False and stays dormant.
+
+        Deliberately does NOT probe the backend: ``default_backend()``
+        forces PJRT initialisation, and on a hung TPU relay that blocks
+        forever — on the server's startup path it would keep /api/health
+        (the endpoint built to diagnose exactly that state) from ever
+        binding. ``self.platform`` is discovered by the first
+        :meth:`sample` on the daemon thread instead; bench sets it
+        explicitly after its own jax init."""
+        if self._attached:
+            return True
+        try:
+            jax = jax_module
+            if jax is None:
+                import jax  # noqa: PLC0415 - lazy by design
+            from jax import monitoring as jmon
+            jmon.register_event_listener(self._jax_event)
+            jmon.register_event_duration_secs_listener(self._jax_duration)
+            self._attached = True
+            return True
+        except Exception as e:
+            logger.debug("jax.monitoring unavailable: %s", e)
+            return False
+
+    def start(self, interval_s: Optional[float] = None,
+              sampling: Optional[str] = None) -> None:
+        """Start the background HBM sampler thread (daemon). The
+        listeners fire regardless; the thread only does memory_stats."""
+        if interval_s is not None:
+            self.interval_s = max(0.5, float(interval_s))
+        if sampling is not None:
+            self.sampling = sampling
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="obs-devmon")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample()
+            except Exception:
+                logger.exception("device sample failed")
+
+    # ------------------------------------------------------- event listeners
+    def _jax_event(self, name: str, **kw) -> None:
+        try:
+            self.on_event(str(name))
+        except Exception:       # listener runs inside jax's compile path
+            logger.debug("event accounting failed", exc_info=True)
+
+    def _jax_duration(self, name: str, duration: float, **kw) -> None:
+        try:
+            self.on_event_duration(str(name), float(duration))
+        except Exception:
+            logger.debug("duration accounting failed", exc_info=True)
+
+    def on_event(self, name: str) -> None:
+        """Counter-style jax.monitoring event (cache hits/misses live
+        here). Public so tests can feed synthetic events."""
+        metrics = _metrics()
+        with self._lock:
+            self._events[name] += 1
+            if _is_cache_hit(name):
+                self.cache_hits += 1
+                if metrics:
+                    metrics.inc_counter("selkies_compile_cache_hits_total")
+            elif _is_cache_miss(name):
+                self.cache_misses += 1
+                if metrics:
+                    metrics.inc_counter("selkies_compile_cache_misses_total")
+
+    def on_event_duration(self, name: str, duration_s: float) -> None:
+        """Duration-style jax.monitoring event. Every name is accounted
+        per-event; only the backend_compile signal (one per XLA build)
+        lands in the trace ring — t0 back-dated by the duration, the
+        listener fires when the compile ENDS — and feeds storm
+        detection and the selkies_compile_* counters."""
+        with self._lock:
+            acc = self._durations.setdefault(name, [0, 0.0])
+            acc[0] += 1
+            acc[1] += duration_s
+            if not _is_backend_compile(name):
+                return
+            dur_ns = int(duration_s * 1e9)
+            t0 = _now_ns() - dur_ns
+            self._compile_ring.append((t0, dur_ns, name))
+            storm = self._note_compile_locked()
+        metrics = _metrics()
+        if metrics:
+            metrics.inc_counter("selkies_compile_events_total")
+            metrics.inc_counter("selkies_compile_seconds_total", duration_s)
+        if storm is not None:
+            self._record_incident("compile_storm", count=storm[0],
+                                  window_s=storm[1], event=name)
+
+    def _note_compile_locked(self) -> Optional[tuple]:
+        """Storm detection (lock held). Returns (count, window) when a
+        NEW storm should be reported, else None."""
+        now = time.monotonic()
+        self._storm_times.append(now)
+        if now - self._started_at < WARMUP_GRACE_S:
+            return None             # cold-start compiles are expected
+        recent = [t for t in self._storm_times if now - t <= STORM_WINDOW_S]
+        if len(recent) >= STORM_THRESHOLD \
+                and now - self._storm_reported > STORM_WINDOW_S:
+            self._storm_reported = now
+            return (len(recent), STORM_WINDOW_S)
+        return None
+
+    def _record_incident(self, kind: str, **fields) -> None:
+        rec = self._recorder
+        if rec is None:
+            from .health import engine
+            rec = engine.recorder
+        try:
+            rec.record(kind, **fields)
+        except Exception:
+            logger.debug("incident record failed", exc_info=True)
+
+    # -------------------------------------------------------------- sampling
+    def _should_sample_mem(self, platform: str) -> bool:
+        if self.sampling == "on":
+            return True
+        if self.sampling == "off":
+            return False
+        return platform == "cpu" \
+            or os.environ.get("SELKIES_DEVICE_MEMSTATS") == "1"
+
+    def sample(self, force: bool = False) -> list[dict]:
+        """One memory_stats pass over local devices. BLOCKING (runtime
+        RPC per device): call from the monitor thread, an executor, or
+        bench code that owns the process — never the event loop."""
+        metrics = _metrics()
+        try:
+            import jax
+            devices = list(jax.local_devices())
+        except Exception:
+            return []
+        out: list[dict] = []
+        peak_seen = 0
+        for d in devices:
+            platform = getattr(d, "platform", "?")
+            self.platform = self.platform or platform
+            ms = {}
+            if force or self._should_sample_mem(platform):
+                try:
+                    ms = d.memory_stats() or {}
+                except Exception:
+                    ms = {}
+            in_use = int(ms.get("bytes_in_use", 0))
+            peak = int(ms.get("peak_bytes_in_use", 0) or in_use)
+            limit = int(ms.get("bytes_limit", 0)
+                        or ms.get("bytes_reservable_limit", 0))
+            peak_seen = max(peak_seen, peak)
+            labels = {"device": str(getattr(d, "id", len(out))),
+                      "platform": platform}
+            entry = {"id": getattr(d, "id", len(out)),
+                     "platform": platform,
+                     "kind": getattr(d, "device_kind", "?"),
+                     "hbm_in_use": in_use, "hbm_peak": peak,
+                     "hbm_limit": limit,
+                     "hbm_pct": round(100.0 * in_use / limit, 1)
+                     if limit else 0.0}
+            out.append(entry)
+            if ms and metrics:
+                metrics.set_gauge("selkies_device_hbm_bytes", in_use, labels)
+                metrics.set_gauge("selkies_device_hbm_peak_bytes", peak,
+                                  labels)
+                if limit:
+                    metrics.set_gauge("selkies_device_hbm_limit_bytes",
+                                      limit, labels)
+        with self._lock:
+            self.devices = out
+            self.hbm_peak_bytes = max(self.hbm_peak_bytes, peak_seen)
+            self._sampled_once = True
+        return out
+
+    @property
+    def sampler_active(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def cached_sample(self) -> list[dict]:
+        """Last sample when the background thread owns the cadence —
+        callers (the ws stats loop) must not add a SECOND memory_stats
+        RPC pass on top of the sampler's, doubling exactly the
+        encode-thread contention the gating exists to avoid. Samples
+        inline only when no thread runs (tests, bench)."""
+        if self.sampler_active:
+            with self._lock:
+                if self._sampled_once:
+                    return list(self.devices)
+        return self.sample()
+
+    # -------------------------------------------------------------- snapshot
+    def compile_stats(self) -> dict:
+        """{count, total_s, cache_hits, cache_misses, by_event}. Count
+        and total come from the busiest compile-duration event name so
+        session- and backend-level timers for the same compile are never
+        double-counted."""
+        with self._lock:
+            compile_names = {n: v for n, v in self._durations.items()
+                             if _is_compile_duration(n)}
+            count = total = 0
+            if compile_names:
+                # prefer the backend_compile timer when present — it is
+                # the one-per-XLA-build signal
+                backend = {n: v for n, v in compile_names.items()
+                           if "backend_compile" in n}
+                pool = backend or compile_names
+                best = max(pool.values(), key=lambda v: v[0])
+                count, total = best[0], best[1]
+            return {
+                "count": int(count),
+                "total_s": round(float(total), 3),
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+                "by_event": {n: {"count": v[0],
+                                 "total_s": round(v[1], 3)}
+                             for n, v in sorted(self._durations.items())},
+            }
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            devices = list(self.devices)
+            peak = self.hbm_peak_bytes
+        return {"platform": self.platform, "devices": devices,
+                "hbm_peak_bytes": peak,
+                "hbm_peak_mb": round(peak / (1024 * 1024), 1),
+                "compile": self.compile_stats()}
+
+    def hbm_peak_mb(self) -> float:
+        with self._lock:
+            return round(self.hbm_peak_bytes / (1024 * 1024), 1)
+
+    def trace_events(self, pid: int = 1, tid: int = 99) -> list[dict]:
+        """Compile events as Chrome trace-event dicts on a ``device``
+        lane, mergeable into :func:`..trace.export.to_trace_events`
+        output (same perf_counter µs timebase)."""
+        with self._lock:
+            ring = list(self._compile_ring)
+        if not ring:
+            return []
+        events: list[dict] = [{
+            "ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+            "args": {"name": "device"},
+        }]
+        for t0, dur, name in ring:
+            events.append({
+                "name": f"compile:{name.rsplit('/', 1)[-1]}",
+                "ph": "X", "pid": pid, "tid": tid,
+                "ts": t0 / 1e3, "dur": max(dur, 1) / 1e3,
+                "args": {"event": name},
+            })
+        return events
+
+    # --------------------------------------------------------------- health
+    def backend_verdict(self) -> Verdict:
+        """Real-device vs cpu-fallback (the r04/r05 silent-failure
+        mode). An explicit fallback reason (bench probe, mid-run
+        re-exec) is always ``failed``; an intended accelerator that came
+        up as cpu is ``failed``; an explicitly-requested cpu backend is
+        honest ``ok``."""
+        reason = os.environ.get("BENCH_CPU_REASON") \
+            or os.environ.get("SELKIES_CPU_FALLBACK_REASON")
+        if reason:
+            return failed(f"cpu fallback: {reason}",
+                          platform=self.platform or "cpu")
+        platform = self.platform
+        if platform is None:
+            return ok("backend not probed yet (no device telemetry)")
+        if platform != "cpu":
+            return ok(platform, platform=platform)
+        wanted = os.environ.get("JAX_PLATFORMS", "")
+        if wanted and "cpu" not in wanted.split(","):
+            return failed(f"backend is cpu but JAX_PLATFORMS={wanted!r}",
+                          platform="cpu")
+        if not wanted and os.environ.get("PALLAS_AXON_POOL_IPS"):
+            return failed("backend is cpu but a TPU relay pool is "
+                          "configured (relay dead?)", platform="cpu")
+        if wanted:
+            return ok("cpu (explicitly requested)", platform="cpu")
+        return ok("cpu (no accelerator requested)", platform="cpu")
+
+    def hbm_verdict(self, degraded_pct: float = 90.0,
+                    failed_pct: float = 98.0) -> Verdict:
+        """HBM headroom from the last sample; honest ``ok`` when memory
+        telemetry is gated off (better no verdict than a stale one)."""
+        with self._lock:
+            devices = list(self.devices)
+        worst_pct, worst_dev = 0.0, None
+        for d in devices:
+            if d["hbm_limit"] and d["hbm_pct"] >= worst_pct:
+                worst_pct, worst_dev = d["hbm_pct"], d
+        if worst_dev is None:
+            return ok("no device memory telemetry")
+        msg = (f"device {worst_dev['id']} ({worst_dev['platform']}) at "
+               f"{worst_pct:.1f}% of "
+               f"{worst_dev['hbm_limit'] // (1024 * 1024)} MiB")
+        if worst_pct >= failed_pct:
+            return failed(msg, pct=worst_pct)
+        if worst_pct >= degraded_pct:
+            return degraded(msg, pct=worst_pct)
+        return ok(msg, pct=worst_pct)
+
+    def register_health_checks(self, health_engine=None) -> None:
+        eng = health_engine
+        if eng is None:
+            from .health import engine as eng
+        eng.register("backend", self.backend_verdict)
+        eng.register("hbm", self.hbm_verdict)
+
+
+# metric help strings (the registry renders them on first scrape)
+def _describe() -> None:
+    metrics = _metrics()
+    if metrics is None:
+        return
+    metrics.describe("selkies_device_hbm_bytes",
+                     "Accelerator memory in use (memory_stats)")
+    metrics.describe("selkies_device_hbm_peak_bytes",
+                     "Peak accelerator memory in use")
+    metrics.describe("selkies_device_hbm_limit_bytes",
+                     "Accelerator memory limit")
+    metrics.describe("selkies_compile_events_total",
+                     "XLA compilations observed via jax.monitoring")
+    metrics.describe("selkies_compile_seconds_total",
+                     "Total seconds spent in XLA compilation")
+    metrics.describe("selkies_compile_cache_hits_total",
+                     "Persistent compile-cache hits")
+    metrics.describe("selkies_compile_cache_misses_total",
+                     "Persistent compile-cache misses")
+
+
+_describe()
+
+#: the process-wide monitor (attach_jax + start happen in __main__ /
+#: bench; until then it is inert and costs nothing)
+monitor = DeviceMonitor()

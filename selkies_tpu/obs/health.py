@@ -1,0 +1,269 @@
+"""Health-verdict engine + flight recorder.
+
+The r04/r05 failure mode this plane exists for: the TPU relay died, jax
+silently initialised on CPU, and two whole bench rounds recorded
+plausible-looking fps numbers before a human noticed. A two-field
+``{"ok": bool}`` health endpoint cannot express that — it was green the
+entire time. The engine replaces it with NAMED checks, each returning
+``ok | degraded | failed`` plus a reason string a human (or the bench
+driver) can act on, split into liveness (restart me) and readiness
+(route traffic to me) scopes for container orchestration.
+
+Design constraints:
+
+- **Dependency-free.** Verdicts must be computable in images without
+  jax/aiohttp (the CI lint smoke runs ``python -m selkies_tpu.obs
+  selftest`` there). Metrics export is lazy and optional, the same
+  pattern :mod:`..trace.core` uses for its stage sink.
+- **Checks never raise out.** A crashing check IS a failed verdict —
+  the health endpoint answering 500 because a probe threw would be the
+  observability plane reproducing the bug it exists to catch.
+- **Bounded memory.** The flight recorder is a fixed ring; incident
+  floods (relay flap, compile storm) overwrite the oldest entries and
+  bump a drop counter instead of growing.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["OK", "DEGRADED", "FAILED", "Verdict", "ok", "degraded",
+           "failed", "HealthEngine", "FlightRecorder", "engine"]
+
+OK = "ok"
+DEGRADED = "degraded"
+FAILED = "failed"
+
+#: severity order for aggregation: the overall status is the worst check
+_RANK = {OK: 0, DEGRADED: 1, FAILED: 2}
+
+
+class Verdict:
+    """One check's outcome. ``data`` carries structured evidence (the
+    numbers the reason string was derived from) for dashboards."""
+
+    __slots__ = ("status", "reason", "data")
+
+    def __init__(self, status: str, reason: str = "",
+                 data: Optional[dict] = None):
+        if status not in _RANK:
+            raise ValueError(f"bad status {status!r}")
+        self.status = status
+        self.reason = reason
+        self.data = data or {}
+
+    def to_dict(self) -> dict:
+        out = {"status": self.status, "reason": self.reason}
+        if self.data:
+            out["data"] = self.data
+        return out
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Verdict({self.status!r}, {self.reason!r})"
+
+
+def ok(reason: str = "", **data) -> Verdict:
+    return Verdict(OK, reason, data)
+
+
+def degraded(reason: str, **data) -> Verdict:
+    return Verdict(DEGRADED, reason, data)
+
+
+def failed(reason: str, **data) -> Verdict:
+    return Verdict(FAILED, reason, data)
+
+
+def worst(statuses) -> str:
+    """Aggregate: the most severe status present (ok when empty)."""
+    rank = 0
+    for s in statuses:
+        rank = max(rank, _RANK.get(s, 2))
+    return [OK, DEGRADED, FAILED][rank]
+
+
+class FlightRecorder:
+    """Bounded ring of structured incidents (relay death, compile storm,
+    ACK-stall watchdog trips…), dumped on SIGTERM so a postmortem can
+    see WHAT went wrong before the container vanished — the reference
+    repo's answer to this is grepping journald."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=self.capacity)
+        self.dropped = 0
+        self.total = 0
+
+    def record(self, kind: str, **fields) -> dict:
+        entry = {"ts": round(time.time(), 3), "kind": str(kind), **fields}
+        with self._lock:
+            if len(self._ring) == self.capacity:
+                self.dropped += 1
+            self._ring.append(entry)
+            self.total += 1
+        _metrics_incident(kind)
+        return entry
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+            self.total = 0
+
+    def dump_text(self) -> str:
+        """One JSON line per incident (journald/stderr friendly)."""
+        return "\n".join(json.dumps(e) for e in self.snapshot())
+
+
+class _Check:
+    __slots__ = ("name", "fn", "liveness")
+
+    def __init__(self, name: str, fn: Callable[[], Verdict],
+                 liveness: bool):
+        self.name = name
+        self.fn = fn
+        self.liveness = liveness
+
+
+class HealthEngine:
+    """Named health checks -> verdict set.
+
+    ``liveness=True`` marks a check whose failure means the PROCESS is
+    broken and a restart could help (service supervisor dead, event
+    loop wedged). Everything else is readiness-scope: a failed relay or
+    cpu-fallback backend makes the pod unfit for traffic but restarts
+    won't resurrect a dead TPU relay, so the liveness probe must keep
+    passing (k8s would otherwise crash-loop the pod against an external
+    fault — the exact anti-pattern the probes split exists to avoid).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._checks: dict[str, _Check] = {}
+        self.recorder = FlightRecorder()
+
+    # -- registration --------------------------------------------------------
+    def register(self, name: str, fn: Callable[[], Verdict],
+                 liveness: bool = False) -> None:
+        """Idempotent: re-registering a name replaces the check (service
+        restarts re-register their closures)."""
+        with self._lock:
+            self._checks[name] = _Check(str(name), fn, bool(liveness))
+
+    def unregister(self, name: str, fn: Optional[Callable] = None) -> None:
+        """Remove a check. Pass the registered ``fn`` to make teardown
+        owner-safe: register() replaces on name, so a torn-down
+        instance's cleanup must not remove a NEWER instance's check."""
+        with self._lock:
+            c = self._checks.get(name)
+            if c is not None and (fn is None or c.fn == fn):
+                self._checks.pop(name, None)
+
+    def check_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._checks)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._checks.clear()
+        self.recorder.clear()
+
+    # -- evaluation ----------------------------------------------------------
+    def run(self, liveness_only: bool = False) -> dict[str, Verdict]:
+        """Evaluate every check (or only the liveness-scope ones). A
+        check that raises becomes a failed verdict carrying the
+        exception — never propagates. Liveness probes must evaluate
+        ONLY liveness checks: running readiness closures on the
+        liveness path would let a wedged readiness check time the probe
+        out and crash-loop the pod over an external fault."""
+        with self._lock:
+            checks = [c for c in self._checks.values()
+                      if c.liveness or not liveness_only]
+        out: dict[str, Verdict] = {}
+        for c in checks:
+            try:
+                v = c.fn()
+                if not isinstance(v, Verdict):
+                    v = failed(f"check returned {type(v).__name__}, "
+                               "not a Verdict")
+            except Exception as e:
+                v = failed(f"check crashed: {type(e).__name__}: {e}")
+            out[c.name] = v
+            _metrics_status(c.name, v.status)
+        return out
+
+    def _liveness_names(self) -> set[str]:
+        with self._lock:
+            return {n for n, c in self._checks.items() if c.liveness}
+
+    def liveness(self) -> dict:
+        """The livenessProbe answer: liveness-scope checks only."""
+        verdicts = self.run(liveness_only=True)
+        live = worst(v.status for v in verdicts.values()) != FAILED
+        return {"ok": live, "live": live,
+                "failing": sorted(n for n, v in verdicts.items()
+                                  if v.status == FAILED)}
+
+    def report(self, verbose: bool = False) -> dict:
+        """The /api/health payload. Always carries ``ok`` (readiness
+        bool, backward compatible), ``status`` (worst verdict), ``live``
+        and ``ready``; ``verbose`` adds the per-check verdicts and the
+        flight-recorder tail."""
+        verdicts = self.run()
+        live_names = self._liveness_names()
+        status = worst(v.status for v in verdicts.values())
+        live = worst(verdicts[n].status
+                     for n in verdicts if n in live_names) != FAILED
+        ready = status != FAILED
+        doc: dict = {
+            "ok": ready,
+            "status": status,
+            "live": live,
+            "ready": ready,
+            "failing": sorted(n for n, v in verdicts.items()
+                              if v.status == FAILED),
+        }
+        if verbose:
+            doc["checks"] = {n: v.to_dict()
+                             for n, v in sorted(verdicts.items())}
+            doc["incidents"] = self.recorder.snapshot()
+            doc["incidents_dropped"] = self.recorder.dropped
+            doc["incidents_total"] = self.recorder.total
+        return doc
+
+
+# -- optional metrics bridge (lazy; lint image has no server deps) ----------
+
+def _metrics_status(name: str, status: str) -> None:
+    try:
+        from ..server import metrics
+    except Exception:
+        return
+    metrics.describe("selkies_health_status",
+                     "Health check status (0=ok 1=degraded 2=failed)")
+    metrics.set_gauge("selkies_health_status", _RANK[status],
+                      {"check": name})
+
+
+def _metrics_incident(kind: str) -> None:
+    try:
+        from ..server import metrics
+    except Exception:
+        return
+    metrics.describe("selkies_incidents_total",
+                     "Flight-recorder incidents by kind")
+    metrics.inc_counter("selkies_incidents_total", labels={"kind": kind})
+
+
+#: the process-wide engine every plane registers against (same singleton
+#: pattern as :data:`..trace.core.tracer`); tests build their own.
+engine = HealthEngine()

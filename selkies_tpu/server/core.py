@@ -10,8 +10,12 @@ designed fresh:
 - WebSocket Origin guard (reference :647-686);
 - static client serving from the packaged ``web/`` directory or
   ``--web_root``;
-- ``/api/status``, ``/api/health``, ``/api/metrics``, ``/api/switch``
-  (live transport swap when ``enable_dual_mode``, reference :804-895);
+- ``/api/status``, ``/api/health`` (named verdicts via
+  ``selkies_tpu.obs``: ``?verbose=1`` for the full check set and the
+  incident flight recorder, ``?probe=live|ready`` for container
+  orchestration), ``/api/metrics``, ``/api/switch`` (live transport
+  swap when ``enable_dual_mode``, reference :804-895), ``/api/profile``
+  (on-demand jax.profiler capture, full-role gated);
 - chunked file upload with path-traversal + symlink defences and a
   JSON/HTML download index (reference :897-1299);
 - TLS with live certificate reload (reference :552-632);
@@ -38,6 +42,7 @@ from urllib.parse import urlparse
 
 from aiohttp import web
 
+from ..obs import health as _health
 from ..settings import AppSettings, is_sensitive
 
 logger = logging.getLogger("selkies_tpu.server.core")
@@ -78,6 +83,11 @@ class CentralizedStreamServer:
         self.started_at = time.time()
         #: secure-mode WS tokens: token -> {role, created, uses}
         self.ws_tokens: dict[str, dict] = {}
+        #: the process-wide health engine; services register their
+        #: checks against it in start() (tests may swap it out)
+        self.health = _health.engine
+        self.health.register("service", self._check_service, liveness=True)
+        self.health.register("stage_latency", self._check_stage_latency)
         self._setup_routes()
 
     # ------------------------------------------------------------------ auth
@@ -143,6 +153,7 @@ class CentralizedStreamServer:
         r.add_post("/api/switch", self.handle_switch)
         r.add_get("/api/trace", self.handle_trace)
         r.add_post("/api/trace", self.handle_trace_control)
+        r.add_post("/api/profile", self.handle_profile)
         if self.settings.secure_api:
             r.add_post("/api/tokens", self.handle_mint_token)
             r.add_get("/api/tokens", self.handle_list_tokens)
@@ -188,11 +199,87 @@ class CentralizedStreamServer:
             "role": request["role"],
         })
 
+    # ---------------------------------------------------------------- health
+    def _check_service(self) -> "_health.Verdict":
+        """Liveness-scope: the transport supervisor itself. A dead
+        active service means a restart can actually help."""
+        if self.active_mode in self.services:
+            return _health.ok(f"mode {self.active_mode}")
+        return _health.failed(
+            f"active mode {self.active_mode!r} is not a running service")
+
+    def _check_stage_latency(self) -> "_health.Verdict":
+        """Stage p99 vs budget from the trace summarizer (PR-2). Honest
+        ok when tracing is off — a missing verdict must not read as a
+        healthy pipeline, so the reason says WHY there is no number."""
+        from ..trace import tracer
+        from ..trace.summary import summarize_timelines
+        if not tracer.enabled:
+            return _health.ok("tracing disabled (enable via /api/trace)")
+        summary = summarize_timelines(
+            t for t in tracer.snapshot() if t.done)
+        if not summary:
+            return _health.ok("tracing on, no completed frames yet")
+        budget = float(getattr(self.settings, "health_stage_budget_ms",
+                               50.0))
+        name, stat = max(summary.items(), key=lambda kv: kv[1]["p99_ms"])
+        msg = f"worst stage {name} p99={stat['p99_ms']}ms " \
+              f"(budget {budget}ms)"
+        if stat["p99_ms"] > 2 * budget:
+            return _health.failed(msg, stage=name, p99_ms=stat["p99_ms"])
+        if stat["p99_ms"] > budget:
+            return _health.degraded(msg, stage=name, p99_ms=stat["p99_ms"])
+        return _health.ok(msg, stage=name, p99_ms=stat["p99_ms"])
+
     async def handle_health(self, request: web.Request) -> web.Response:
-        svc_ok = self.active_mode in self.services
-        return web.json_response(
-            {"ok": svc_ok, "mode": self.active_mode},
-            status=200 if svc_ok else 503)
+        """Named verdicts (selkies_tpu/obs). Default payload keeps the
+        legacy ``ok``/``mode`` fields; ``?verbose=1`` adds every check's
+        verdict + the incident ring; ``?probe=live`` answers only the
+        liveness scope (k8s livenessProbe must not crash-loop a pod over
+        a dead external relay — that is readiness's job)."""
+        if request.query.get("probe") == "live":
+            # liveness-scope checks ONLY — a wedged readiness closure
+            # must not be able to time this probe out
+            report = self.health.liveness()
+            report["mode"] = self.active_mode
+            return web.json_response(
+                report, status=200 if report["live"] else 503)
+        report = self.health.report(
+            verbose=request.query.get("verbose") in ("1", "true"))
+        report["mode"] = self.active_mode
+        return web.json_response(report,
+                                 status=200 if report["ready"] else 503)
+
+    async def handle_profile(self, request: web.Request) -> web.Response:
+        """POST {"action": "start"|"stop"|"status"[, "dir": path]} —
+        on-demand jax.profiler capture (full-role gated; start/stop do
+        file I/O inside jax, so they run in an executor)."""
+        if request["role"] != "full":
+            return web.Response(status=403, text="view-only")
+        from ..obs import profiler
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            return web.Response(status=400, text="JSON object body required")
+        action = body.get("action")
+        loop = asyncio.get_running_loop()
+        if action == "start":
+            trace_dir = body.get("dir") \
+                or (self.settings.profile_dir or None)
+            res = await loop.run_in_executor(
+                None, lambda: profiler.start(trace_dir))
+        elif action == "stop":
+            res = await loop.run_in_executor(None, profiler.stop)
+        elif action == "status":
+            res = profiler.status()
+        else:
+            return web.Response(
+                status=400,
+                text=f"unknown action {action!r} (want start|stop|status)")
+        return web.json_response(res,
+                                 status=200 if res.get("ok", True) else 409)
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         from .metrics import render_prometheus
@@ -203,11 +290,17 @@ class CentralizedStreamServer:
         """Current frame timelines as Chrome trace-event JSON — save the
         body and load it in Perfetto / chrome://tracing. ``otherData``
         carries the tracer state so dashboards can poll one endpoint."""
+        from ..obs import monitor
         from ..trace import tracer
         from ..trace.export import to_trace_events
         snap = tracer.snapshot()
         doc = to_trace_events(snap, process_name=self.settings.app_name)
+        # device-lane overlay: XLA compile events from jax.monitoring,
+        # so a Perfetto view shows "recompile happened HERE" against the
+        # frame timeline (same perf_counter timebase)
+        doc["traceEvents"].extend(monitor.trace_events())
         doc["otherData"] = tracer.stats(frames=len(snap))
+        doc["otherData"]["compile"] = monitor.compile_stats()
         return web.json_response(doc)
 
     async def handle_trace_control(self, request: web.Request) -> web.Response:
@@ -498,6 +591,10 @@ class CentralizedStreamServer:
         return self._runner
 
     async def shutdown(self) -> None:
+        # owner-matched: a newer in-process server may have replaced
+        # these names; only OUR closures are removed
+        self.health.unregister("service", self._check_service)
+        self.health.unregister("stage_latency", self._check_stage_latency)
         if self._cert_watch_task:
             self._cert_watch_task.cancel()
         if self.active_mode and self.active_mode in self.services:

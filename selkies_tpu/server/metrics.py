@@ -113,50 +113,39 @@ describe("selkies_frames_encoded_total", "Frames encoded")
 describe("selkies_backpressure_events_total", "ACK backpressure activations")
 
 
-_device_cache: list | None = None
-
-
 def device_stats() -> list[dict]:
     """Accelerator telemetry — the TPU-era equivalent of the reference's
     vendor-spanning gpu_stats.py (NVML/aitop/sysfs): per-device HBM
-    in-use/limit plus utilisation-proxy gauges from the JAX runtime.
+    in-use/limit for the per-client system_stats payload.
 
-    BLOCKING (jax import on first call, runtime RPCs per device): callers
-    on an event loop must run it in an executor (the ws stats loop does).
-    memory_stats() issues a runtime RPC that would CONTEND with the encode
-    thread's device calls (fatal on single-client relay transports), so it
-    is only queried on the cpu backend or with SELKIES_DEVICE_MEMSTATS=1.
-    """
-    import os
-    global _device_cache
+    Delegates to the obs device monitor, which owns the sampling policy
+    (memory_stats() is a runtime RPC that would CONTEND with the encode
+    thread's device calls — fatal on single-client relay transports —
+    so ``auto`` queries only the cpu backend unless
+    SELKIES_DEVICE_MEMSTATS=1; the ``device_hbm_sampling`` setting
+    forces it). BLOCKING (jax import on first call, RPC per device):
+    callers on an event loop must run it in an executor (the ws stats
+    loop does)."""
     try:
-        import jax
-        if _device_cache is None:
-            _device_cache = list(jax.local_devices())
-        want_mem = os.environ.get("SELKIES_DEVICE_MEMSTATS") == "1"
+        from ..obs import monitor
         out = []
-        for d in _device_cache:
-            ms = {}
-            if want_mem or d.platform == "cpu":
-                try:
-                    ms = d.memory_stats() or {}
-                except Exception:
-                    pass
-            in_use = int(ms.get("bytes_in_use", 0))
-            limit = int(ms.get("bytes_limit", 0) or ms.get("bytes_reservable_limit", 0))
+        for d in monitor.cached_sample():
             out.append({
-                "id": d.id,
-                "platform": d.platform,
-                "kind": getattr(d, "device_kind", "?"),
-                "mem_in_use": in_use,
-                "mem_limit": limit,
-                "mem_pct": round(100.0 * in_use / limit, 1) if limit else 0.0,
+                "id": d["id"],
+                "platform": d["platform"],
+                "kind": d["kind"],
+                "mem_in_use": d["hbm_in_use"],
+                "mem_limit": d["hbm_limit"],
+                "mem_pct": d["hbm_pct"],
             })
-            set_gauge("selkies_device_mem_bytes", in_use,
-                      {"device": str(d.id), "platform": d.platform})
-            if limit:
-                set_gauge("selkies_device_mem_limit_bytes", limit,
-                          {"device": str(d.id), "platform": d.platform})
+            # legacy gauge names kept for existing dashboards; the
+            # monitor exports the selkies_device_hbm_* family itself
+            set_gauge("selkies_device_mem_bytes", d["hbm_in_use"],
+                      {"device": str(d["id"]), "platform": d["platform"]})
+            if d["hbm_limit"]:
+                set_gauge("selkies_device_mem_limit_bytes", d["hbm_limit"],
+                          {"device": str(d["id"]),
+                           "platform": d["platform"]})
         return out
     except Exception:
         return []

@@ -26,6 +26,7 @@ import time
 from collections import deque
 from typing import Awaitable, Callable, Optional
 
+from ..obs import health as _health
 from ..protocol import (FRAME_TYPE_IDR, OP_H264, OP_JPEG,
                         unpack_h264_header, unpack_jpeg_header)
 from ..trace import tracer as _tracer
@@ -176,6 +177,9 @@ class VideoRelay:
         self._q.clear()
         self._q_bytes = 0
         metrics.inc_counter("selkies_relay_deaths_total")
+        _health.engine.recorder.record(
+            "relay_death", display=self.display,
+            sent_bytes=self.sent_bytes, dropped_frames=self.dropped_frames)
         if self._counted_alive:
             self._counted_alive = False
             _alive_delta(-1)

@@ -111,12 +111,24 @@ class WebRTCService(BaseStreamingService):
                 "TURN serve, sessions will not get media", _MEDIA_ERR)
             return
         if self.audio is not None \
-                and getattr(self.settings, "enable_audio", False):
+                and (getattr(self.settings, "enable_audio", False)
+                     or getattr(self.settings, "enable_microphone", False)):
             try:
-                await self.audio.start()
+                # mic-only: provision mic playback without the encode
+                # loop; the offer then carries a recvonly audio m-line
+                await self.audio.start(mic_only=not getattr(
+                    self.settings, "enable_audio", False))
             except Exception:
                 logger.exception("webrtc audio pipeline failed to start")
                 self.audio = None
+        if getattr(self.settings, "enable_microphone", False) \
+                and self.audio is None:
+            # operator-facing: the setting promises a mic but no
+            # pipeline exists to play it back (ADVICE r5 silent mode)
+            logger.warning(
+                "enable_microphone=True but no audio pipeline is "
+                "available (libopus/PulseAudio missing?) — client mic "
+                "input will be discarded")
         self._local_peer = await self.signaling.attach_server_peer(
             self._sig_queue.put)
         self._sig_task = self._loop.create_task(self._signal_loop())
@@ -285,15 +297,21 @@ class WebRTCService(BaseStreamingService):
 
     # ----------------------------------------------------------------- media
     def _display_rect(self, display_id: str) -> tuple[int, int]:
-        """Capture-origin offsets inside the X framebuffer: primary at
-        (0, 0); any secondary display reads the sub-rect to its right
-        (the WS service's dual-layout default, ws_service.py
-        _apply_display_layout)."""
+        """Capture-origin offsets inside the X framebuffer, honouring
+        ``display2_position`` with the same dual-layout math the WS
+        service uses (ws_service.py _apply_display_layout) — a
+        left/above secondary also MOVES the primary's origin, so both
+        sides come from compute_dual_layout (ADVICE r5: secondaries were
+        pinned to (initial_width, 0) regardless of the setting)."""
+        from ..display import compute_dual_layout
         s = self.settings
+        w = int(getattr(s, "initial_width", 1920) or 1920)
+        h = int(getattr(s, "initial_height", 1080) or 1080)
+        # both displays share the service's single geometry setting
+        _, _, o1, o2 = compute_dual_layout(
+            w, h, w, h, str(getattr(s, "display2_position", "right")))
         primary = ("primary", s.display_id, "")
-        if display_id in primary:
-            return (0, 0)
-        return (int(getattr(s, "initial_width", 1920) or 1920), 0)
+        return o1 if display_id in primary else o2
 
     async def _ensure_capture(self, display_id: str = "primary") -> None:
         if display_id in self._captures:
@@ -529,8 +547,15 @@ class WebRTCService(BaseStreamingService):
                 await dm.resize(*geo, float(self.settings.framerate))
         except Exception:
             logger.debug("webrtc resize: no real display to resize")
-        cap = self._captures.get(display_id)
-        if cap is not None and cap.is_capturing():
-            ox, oy = self._display_rect(display_id)
+        # retarget EVERY live capture, not just the requester's: a
+        # primary resize shifts the secondary's origin (and with
+        # left/above layouts, vice versa), so a live secondary keeping
+        # its stale sub-rect would capture the wrong framebuffer region
+        # (ADVICE r5)
+        for did, cap in list(self._captures.items()):
+            if not cap.is_capturing():
+                continue
+            ox, oy = self._display_rect(did)
             await self._loop.run_in_executor(
-                None, lambda: cap.update_capture_region(ox, oy, *geo))
+                None, lambda c=cap, o=(ox, oy): c.update_capture_region(
+                    o[0], o[1], *geo))

@@ -32,6 +32,7 @@ from aiohttp import WSMsgType, web
 from .. import protocol as P
 from ..engine import CaptureSettings, ScreenCapture
 from ..engine.types import EncodedChunk
+from ..obs import health as _health
 from ..settings import AppSettings, SettingsError
 from ..taskutil import spawn_retained
 from ..trace import tracer as _tracer
@@ -248,7 +249,10 @@ class WebSocketsService(BaseStreamingService):
         if self.input_handler is not None:
             self.input_handler.start()
         if self.audio is not None:
-            await self.audio.start()
+            # enable_microphone without enable_audio: mic playback only,
+            # no capture/encode loop (ADVICE r5)
+            await self.audio.start(
+                mic_only=not self.settings.enable_audio)
         self._stats_task = asyncio.create_task(self._stats_loop())
         # watched RTC config file: edits reach connected clients as an
         # rtc_config push, so ICE-server rotation needs no reconnect
@@ -262,6 +266,7 @@ class WebSocketsService(BaseStreamingService):
                     "rtc_config," + json.dumps(cfg)))
             self._rtc_cfg_monitor = RtcConfigMonitor(cfg_path, _push_cfg)
             self._rtc_cfg_monitor.start()
+        self._register_health_checks()
         logger.info("websockets service started")
 
     def _spawn_retained(self, coro) -> asyncio.Task:
@@ -269,8 +274,80 @@ class WebSocketsService(BaseStreamingService):
         stop()."""
         return spawn_retained(self._bg_tasks, coro)
 
+    # --------------------------------------------------------------- health
+    def _register_health_checks(self) -> None:
+        """Transport-scope checks on the process-wide engine (replaced on
+        every service (re)start so the closures track THIS instance)."""
+        _health.engine.register("relay", self._check_relays)
+        _health.engine.register("capture_fps", self._check_capture_fps)
+        _health.engine.register("audio", self._check_audio)
+
+    def _check_relays(self) -> _health.Verdict:
+        """Relay alive vs deaths: the r04/r05 class of failure where
+        media sends stall and every viewer silently goes dark."""
+        active = [c for c in self.clients.values() if c.video_active]
+        if not active:
+            return _health.ok("no active viewers")
+        total = dead = 0
+        for c in active:
+            for r in c.relays.values():
+                total += 1
+                dead += r.dead
+        if total and dead == total:
+            return _health.failed(
+                f"all {total} video relays dead", dead=dead, total=total)
+        if dead:
+            return _health.degraded(
+                f"{dead}/{total} video relays dead", dead=dead, total=total)
+        return _health.ok(f"{total} relays alive", total=total)
+
+    def _check_capture_fps(self) -> _health.Verdict:
+        active = [c for c in self.clients.values() if c.video_active]
+        if not active:
+            return _health.ok("no active viewers")
+        caps = {d: c for d, c in self.captures.items() if c.is_capturing()}
+        if not caps:
+            if self._starting_captures:
+                return _health.degraded(
+                    "capture starting (first compile on a new geometry "
+                    "can take minutes)")
+            return _health.failed("viewers active but no capture running")
+        target = float(self.settings.framerate)
+        ratio = float(getattr(self.settings, "health_fps_degraded_ratio",
+                              0.5))
+        worst_did, worst_fps = min(
+            ((d, float(getattr(c, "encoded_fps", 0.0)))
+             for d, c in caps.items()), key=lambda kv: kv[1])
+        msg = f"{worst_did}: {worst_fps:.1f} fps vs target {target:.0f}"
+        if worst_fps < target * ratio:
+            return _health.degraded(msg, fps=worst_fps, target=target)
+        return _health.ok(msg, fps=worst_fps, target=target)
+
+    def _check_audio(self) -> _health.Verdict:
+        s = self.settings
+        if not s.enable_audio and not s.enable_microphone:
+            return _health.ok("audio disabled")
+        if self.audio is None:
+            want = "audio" if s.enable_audio else "microphone"
+            return _health.degraded(
+                f"{want} enabled but the pipeline failed to start "
+                "(no libopus/PulseAudio?)")
+        if s.enable_audio and not getattr(self.audio, "alive", True):
+            return _health.failed("audio encode task is dead")
+        if s.enable_microphone \
+                and getattr(self.audio, "mic_ok", None) is False:
+            return _health.degraded(
+                "virtual mic provisioning failed (no PulseAudio?) — "
+                "client mic input will not reach desktop apps")
+        return _health.ok("mic-only pipeline" if not s.enable_audio
+                          else "audio pipeline running")
+
     async def stop(self) -> None:
         self._running = False
+        for name, fn in (("relay", self._check_relays),
+                         ("capture_fps", self._check_capture_fps),
+                         ("audio", self._check_audio)):
+            _health.engine.unregister(name, fn)
         bg = list(self._bg_tasks)
         for task in bg:
             task.cancel()
@@ -1035,6 +1112,9 @@ class WebSocketsService(BaseStreamingService):
                         and c.last_ack_time < stalled:
                     c.paused = True
                     metrics.inc_counter("selkies_backpressure_events_total")
+                    _health.engine.recorder.record(
+                        "ack_stall", client=c.id, display=c.display,
+                        last_sent=c.last_sent_id, last_ack=c.last_ack_id)
             try:
                 stats = {
                     "type": "system_stats",

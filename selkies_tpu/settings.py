@@ -302,6 +302,27 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
        "latency attribution at /api/trace as Perfetto-loadable trace-event "
        "JSON. Also togglable live via POST /api/trace."),
     _s("stats_interval_s", SType.FLOAT, 5.0, "Per-client system stats cadence."),
+
+    # --- observability (selkies_tpu/obs) ------------------------------------
+    _s("enable_device_monitor", SType.BOOL, True,
+       "Background device telemetry: HBM sampling + jax.monitoring "
+       "compile accounting (selkies_device_*/selkies_compile_* metrics)."),
+    _s("device_monitor_interval_s", SType.FLOAT, 5.0,
+       "HBM sampler cadence.", vmin=0.5, vmax=300),
+    _s("device_hbm_sampling", SType.ENUM, "auto",
+       "memory_stats() policy: 'auto' samples only on the cpu backend "
+       "(the runtime RPC contends with encode-thread device calls on "
+       "single-client TPU relays; SELKIES_DEVICE_MEMSTATS=1 overrides), "
+       "'on'/'off' force it.", choices=("auto", "on", "off")),
+    _s("health_stage_budget_ms", SType.FLOAT, 50.0,
+       "Per-stage p99 budget for the stage_latency health check "
+       "(degraded above 1x, failed above 2x).", vmin=1, vmax=60000),
+    _s("health_fps_degraded_ratio", SType.FLOAT, 0.5,
+       "capture_fps health check degrades below ratio*framerate.",
+       vmin=0.05, vmax=1.0),
+    _s("profile_dir", SType.STR, "",
+       "Default output dir for POST /api/profile jax.profiler captures "
+       "(empty: a fresh selkies-profile-* tempdir per capture)."),
 )
 
 _DEFS_BY_NAME: dict[str, Setting] = {d.name: d for d in SETTING_DEFINITIONS}

@@ -34,9 +34,13 @@ def build_offer(host: str, port: int, ufrag: str, pwd: str,
     allocation) after the host candidate for NAT'd servers.
     ``with_mic`` flips the audio m-line to sendrecv so the browser can
     send its microphone track back (reference rtc.py:1303 mic
-    receiver)."""
+    receiver). With ``with_mic`` and NOT ``with_audio`` the m-line is
+    still emitted, as recvonly — a mic-only configuration
+    (enable_microphone without enable_audio) must not silently lose the
+    browser's track for want of an m-line (ADVICE r5)."""
     sid = secrets.randbits(62)
-    mids = ["0"] + (["1"] if with_audio else [])
+    audio_mline = with_audio or with_mic
+    mids = ["0"] + (["1"] if audio_mline else [])
     if with_data:
         mids.append(str(len(mids)))
     lines = [
@@ -72,7 +76,7 @@ def build_offer(host: str, port: int, ufrag: str, pwd: str,
             extmap,
         ]),
     ]
-    if with_audio:
+    if audio_mline:
         if audio_params and int(audio_params.get("channels", 2)) > 2:
             # surround: Chrome's multiopus (multistream Opus whose
             # stream layout rides the fmtp — reference
@@ -105,7 +109,8 @@ def build_offer(host: str, port: int, ufrag: str, pwd: str,
         lines.append(f"c=IN IP4 {host}")
         lines += [
             f"a=mid:{mids[i]}",
-            "a=sendrecv" if (i == 1 and with_mic) else "a=sendonly",
+            ("a=sendonly" if i == 0 or not with_mic
+             else ("a=sendrecv" if with_audio else "a=recvonly")),
             f"a=ice-ufrag:{ufrag}",
             f"a=ice-pwd:{pwd}",
             f"a=fingerprint:sha-256 {fingerprint}",

@@ -147,14 +147,27 @@ class TurnClient(asyncio.DatagramProtocol):
         if fut is None or fut.done():
             return
         # Once the realm is known every request we send is integrity-
-        # protected, so a response that carries MESSAGE-INTEGRITY must
-        # verify against the long-term key — otherwise an off-path
-        # attacker who observed the txid could inject a bogus relayed
-        # address or nonce (ADVICE r4).
-        if self.realm and msg.attr(ATTR_MESSAGE_INTEGRITY) is not None \
-                and not msg.check_integrity(self._lt_key()):
-            logger.warning("turn response failed integrity check; dropped")
-            return
+        # protected, so success responses MUST carry a verifying
+        # MESSAGE-INTEGRITY (RFC 5389 §10.2.3) — validating MI only when
+        # the attribute happens to be present lets an off-path attacker
+        # who observed the txid inject an MI-less success carrying a
+        # bogus relayed address (ADVICE r5). Error responses are the
+        # exception: 401/438 are sent BEFORE auth to (re)issue
+        # realm/nonce and legitimately lack MI; any other MI-less error
+        # is dropped too (forged errors only cost a retransmit).
+        if self.realm:
+            has_mi = msg.attr(ATTR_MESSAGE_INTEGRITY) is not None
+            is_success = (msg.type & 0x0110) == 0x0100
+            if has_mi:
+                if not msg.check_integrity(self._lt_key()):
+                    logger.warning(
+                        "turn response failed integrity check; dropped")
+                    return
+            elif is_success or _error_code(msg) not in (401, 438):
+                logger.warning(
+                    "turn %s response lacks MESSAGE-INTEGRITY; dropped",
+                    "success" if is_success else "error")
+                return
         self._pending.pop(msg.txid, None)
         fut.set_result(msg)
 

@@ -1,0 +1,499 @@
+"""Device telemetry + health-verdict plane (selkies_tpu/obs, ISSUE 3):
+engine transitions, liveness/readiness split, flight recorder, the
+compile/HBM monitor against synthetic jax.monitoring events and fake
+devices, and the HTTP surface (/api/health?verbose=1, /api/profile,
+device-lane trace overlay)."""
+
+import asyncio
+import json
+
+from selkies_tpu.obs import (DEGRADED, FAILED, OK, DeviceMonitor,
+                             FlightRecorder, HealthEngine, degraded,
+                             failed, ok)
+from selkies_tpu.obs import health as health_mod
+from tests.test_server import make_app
+
+
+# ------------------------------------------------------------------ engine
+def test_health_check_transitions():
+    eng = HealthEngine()
+    state = {"v": ok("fine")}
+    eng.register("x", lambda: state["v"])
+    assert eng.run()["x"].status == OK
+    state["v"] = degraded("slow")
+    assert eng.run()["x"].status == DEGRADED
+    state["v"] = failed("dead")
+    v = eng.run()["x"]
+    assert v.status == FAILED and v.reason == "dead"
+
+
+def test_health_crashing_check_is_failed_not_500():
+    eng = HealthEngine()
+    eng.register("boom", lambda: 1 / 0)
+    v = eng.run()["boom"]
+    assert v.status == FAILED and "ZeroDivisionError" in v.reason
+
+
+def test_health_non_verdict_return_is_failed():
+    eng = HealthEngine()
+    eng.register("wrong", lambda: "ok")
+    assert eng.run()["wrong"].status == FAILED
+
+
+def test_health_liveness_readiness_split():
+    """A readiness-scope failure (dead relay) must NOT fail liveness —
+    k8s would otherwise crash-loop the pod against an external fault."""
+    eng = HealthEngine()
+    eng.register("service", lambda: ok("up"), liveness=True)
+    eng.register("relay", lambda: failed("all relays dead"))
+    rep = eng.report()
+    assert rep["live"] is True
+    assert rep["ready"] is False and rep["ok"] is False
+    assert rep["status"] == FAILED and rep["failing"] == ["relay"]
+    # liveness-scope failure fails both probes
+    eng.register("service", lambda: failed("supervisor dead"),
+                 liveness=True)
+    rep = eng.report()
+    assert rep["live"] is False and rep["ready"] is False
+
+
+def test_health_degraded_keeps_ready():
+    eng = HealthEngine()
+    eng.register("fps", lambda: degraded("20 fps vs 60"))
+    rep = eng.report()
+    assert rep["ready"] is True and rep["status"] == DEGRADED
+
+
+def test_health_verbose_payload_shape():
+    eng = HealthEngine()
+    eng.register("a", lambda: ok("fine", n=3))
+    eng.recorder.record("relay_death", display=":0")
+    rep = eng.report(verbose=True)
+    assert rep["checks"]["a"] == {"status": "ok", "reason": "fine",
+                                 "data": {"n": 3}}
+    assert rep["incidents"][0]["kind"] == "relay_death"
+    assert rep["incidents_total"] == 1
+    json.dumps(rep)                       # must be JSON-serializable
+    # non-verbose: no check bodies, no incident ring
+    rep = eng.report()
+    assert "checks" not in rep and "incidents" not in rep
+
+
+def test_health_reregister_replaces_and_unregister():
+    eng = HealthEngine()
+    eng.register("x", lambda: failed("old"))
+    eng.register("x", lambda: ok("new"))
+    assert eng.run()["x"].status == OK
+    eng.unregister("x")
+    assert eng.run() == {}
+
+
+# ---------------------------------------------------------- flight recorder
+def test_flight_recorder_bounded_with_drop_accounting():
+    rec = FlightRecorder(capacity=8)
+    for i in range(11):
+        rec.record("k", i=i)
+    snap = rec.snapshot()
+    assert len(snap) == 8 and snap[0]["i"] == 3 and snap[-1]["i"] == 10
+    assert rec.dropped == 3 and rec.total == 11
+    for line in rec.dump_text().splitlines():
+        json.loads(line)
+
+
+def test_relay_death_lands_in_flight_recorder():
+    from selkies_tpu import protocol as P
+    from selkies_tpu.server.relay import VideoRelay
+
+    async def run():
+        rec = health_mod.engine.recorder
+        before = rec.total
+
+        async def _failing_send(data):
+            raise ConnectionError("gone")
+
+        relay = VideoRelay(_failing_send, display=":7")
+        relay.start()
+        relay.offer(P.pack_jpeg_stripe(1, 0, b"\xff\xd8x\xff\xd9"))
+        for _ in range(50):
+            await asyncio.sleep(0.01)
+            if relay.dead:
+                break
+        assert relay.dead
+        incidents = [e for e in rec.snapshot()
+                     if e["kind"] == "relay_death" and e["display"] == ":7"]
+        assert rec.total == before + 1 and incidents
+        await relay.close()
+    asyncio.run(run())
+
+
+# ----------------------------------------------------------- device monitor
+def test_monitor_compile_accounting_synthetic_events():
+    mon = DeviceMonitor(recorder=FlightRecorder())
+    mon.on_event("/jax/compilation_cache/cache_hits")
+    mon.on_event("/jax/compilation_cache/cache_hits")
+    mon.on_event("/jax/compilation_cache/cache_misses")
+    mon.on_event_duration(
+        "/jax/core/compile/backend_compile_duration_sec", 2.0)
+    mon.on_event_duration(
+        "/jax/core/compile/backend_compile_duration_sec", 0.25)
+    # the cache's own retrieval timer must NOT count as a compile
+    mon.on_event_duration(
+        "/jax/compilation_cache/cache_retrieval_time_sec", 9.0)
+    cs = mon.compile_stats()
+    assert cs["count"] == 2
+    assert abs(cs["total_s"] - 2.25) < 1e-6
+    assert cs["cache_hits"] == 2 and cs["cache_misses"] == 1
+
+
+def test_monitor_prefers_backend_compile_timer():
+    """Session- and backend-level timers for the same compile must not
+    double-count."""
+    mon = DeviceMonitor(recorder=FlightRecorder())
+    for _ in range(3):
+        mon.on_event_duration("/jax/compile/session_duration_sec", 5.0)
+        mon.on_event_duration(
+            "/jax/core/compile/backend_compile_duration_sec", 4.0)
+    cs = mon.compile_stats()
+    assert cs["count"] == 3 and abs(cs["total_s"] - 12.0) < 1e-6
+
+
+def test_monitor_trace_overlay_events():
+    mon = DeviceMonitor(recorder=FlightRecorder())
+    mon.on_event_duration(
+        "/jax/core/compile/backend_compile_duration_sec", 1.0)
+    ev = mon.trace_events()
+    assert ev[0]["ph"] == "M" and ev[0]["args"]["name"] == "device"
+    span = ev[1]
+    assert span["ph"] == "X" and span["dur"] >= 1e6 / 1e3  # >= 1s in µs
+    assert span["name"].startswith("compile:")
+
+
+def test_monitor_compile_storm_incident():
+    from selkies_tpu.obs import device_monitor as dm
+    rec = FlightRecorder()
+    mon = DeviceMonitor(recorder=rec)
+    mon._started_at -= dm.WARMUP_GRACE_S + 1   # past the cold-start grace
+    for _ in range(dm.STORM_THRESHOLD):
+        mon.on_event_duration(
+            "/jax/core/compile/backend_compile_duration_sec", 0.5)
+    storms = [e for e in rec.snapshot() if e["kind"] == "compile_storm"]
+    assert len(storms) == 1                    # rate-limited per window
+    assert storms[0]["count"] >= dm.STORM_THRESHOLD
+
+
+def test_monitor_no_storm_during_warmup():
+    from selkies_tpu.obs import device_monitor as dm
+    rec = FlightRecorder()
+    mon = DeviceMonitor(recorder=rec)       # fresh: inside warmup grace
+    for _ in range(dm.STORM_THRESHOLD * 2):
+        mon.on_event_duration(
+            "/jax/core/compile/backend_compile_duration_sec", 0.5)
+    assert not [e for e in rec.snapshot() if e["kind"] == "compile_storm"]
+
+
+class _FakeDevice:
+    def __init__(self, id, platform="tpu", stats=None):
+        self.id = id
+        self.platform = platform
+        self.device_kind = "FakeTPU v9"
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def test_monitor_samples_fake_devices(monkeypatch):
+    import jax
+    gib = 1024 ** 3
+    monkeypatch.setattr(jax, "local_devices", lambda: [
+        _FakeDevice(0, stats={"bytes_in_use": 2 * gib,
+                              "peak_bytes_in_use": 3 * gib,
+                              "bytes_limit": 16 * gib}),
+        _FakeDevice(1, stats={"bytes_in_use": 15 * gib,
+                              "peak_bytes_in_use": 15 * gib,
+                              "bytes_limit": 16 * gib}),
+    ])
+    mon = DeviceMonitor(recorder=FlightRecorder())
+    out = mon.sample(force=True)
+    assert [d["hbm_in_use"] for d in out] == [2 * gib, 15 * gib]
+    assert out[0]["hbm_pct"] == 12.5
+    assert mon.hbm_peak_mb() == 15 * 1024.0
+    # exported gauges
+    from selkies_tpu.server import metrics
+    text = metrics.render_prometheus()
+    assert 'selkies_device_hbm_bytes{device="0",platform="tpu"}' in text
+    # headroom verdicts: device 1 at 93.8% -> degraded
+    v = mon.hbm_verdict()
+    assert v.status == DEGRADED and "device 1" in v.reason
+    monkeypatch.setattr(jax, "local_devices", lambda: [
+        _FakeDevice(0, stats={"bytes_in_use": 159 * gib // 10,
+                              "bytes_limit": 16 * gib})])
+    mon.sample(force=True)
+    assert mon.hbm_verdict().status == FAILED
+
+
+def test_monitor_hbm_verdict_honest_without_data():
+    mon = DeviceMonitor(recorder=FlightRecorder())
+    v = mon.hbm_verdict()
+    assert v.status == OK and "no device memory telemetry" in v.reason
+
+
+def test_monitor_sampling_policy(monkeypatch):
+    import jax
+    calls = []
+
+    class _CountingDevice(_FakeDevice):
+        def memory_stats(self):
+            calls.append(self.id)
+            return {"bytes_in_use": 1}
+
+    monkeypatch.setattr(jax, "local_devices",
+                        lambda: [_CountingDevice(0, platform="tpu")])
+    monkeypatch.delenv("SELKIES_DEVICE_MEMSTATS", raising=False)
+    mon = DeviceMonitor(recorder=FlightRecorder())
+    mon.sampling = "auto"
+    mon.sample()                       # tpu + auto + no env: RPC skipped
+    assert calls == []
+    mon.sampling = "on"
+    mon.sample()
+    assert calls == [0]
+    mon.sampling = "off"
+    mon.sample(force=True)             # force overrides even 'off'
+    assert calls == [0, 0]
+
+
+def test_backend_verdict_modes(monkeypatch):
+    mon = DeviceMonitor(recorder=FlightRecorder())
+    monkeypatch.delenv("BENCH_CPU_REASON", raising=False)
+    monkeypatch.delenv("SELKIES_CPU_FALLBACK_REASON", raising=False)
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    mon.platform = "cpu"
+    assert mon.backend_verdict().status == OK          # explicit cpu
+    mon.platform = "tpu"
+    assert mon.backend_verdict().status == OK          # real device
+    # intended accelerator, got cpu: the r04/r05 silent-failure mode
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    mon.platform = "cpu"
+    assert mon.backend_verdict().status == FAILED
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    assert mon.backend_verdict().status == FAILED
+    # an explicit fallback reason always fails, whatever the platform
+    monkeypatch.setenv("BENCH_CPU_REASON", "relay-dead")
+    mon.platform = "tpu"
+    v = mon.backend_verdict()
+    assert v.status == FAILED and "relay-dead" in v.reason
+
+
+# ------------------------------------------------------------- HTTP surface
+async def test_health_endpoint_basic_and_verbose(client_factory):
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    r = await c.get("/api/health")
+    body = await r.json()
+    assert r.status == 200
+    assert body["ok"] is True and body["mode"] == "websockets"
+    assert body["status"] in ("ok", "degraded")
+    assert body["live"] is True and body["ready"] is True
+    assert "checks" not in body
+    r = await c.get("/api/health?verbose=1")
+    body = await r.json()
+    for name in ("service", "stage_latency", "relay", "capture_fps",
+                 "audio"):
+        assert name in body["checks"], name
+    assert body["checks"]["service"]["status"] == "ok"
+    assert "incidents" in body
+
+
+async def test_health_probe_split_over_http(client_factory):
+    """Dead relays fail readiness but not liveness at the HTTP layer."""
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("START_VIDEO")
+    await asyncio.sleep(0.1)
+    for cl in svc.clients.values():
+        for relay in cl.relays.values():
+            relay.mark_dead()
+    r = await c.get("/api/health")
+    body = await r.json()
+    assert r.status == 503 and body["ready"] is False
+    assert "relay" in body["failing"]
+    r = await c.get("/api/health?probe=live")
+    assert r.status == 200 and (await r.json())["live"] is True
+    await ws.close()
+
+
+async def test_capture_fps_check_degrades(client_factory):
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("START_VIDEO")
+    await asyncio.sleep(0.1)
+    assert svc._check_capture_fps().status == OK      # 42 vs 60 * 0.5
+    fake.encoded_fps = 10.0                           # below 30 -> degraded
+    v = svc._check_capture_fps()
+    assert v.status == DEGRADED and "10.0 fps" in v.reason
+    await ws.close()
+
+
+async def test_audio_check_reports_missing_pipeline(client_factory):
+    server, svc, fake, _ = make_app()          # make_app passes no audio
+    c = await client_factory(server)
+    v = svc._check_audio()
+    assert v.status == DEGRADED and "pipeline failed to start" in v.reason
+    server2, svc2, *_ = make_app(enable_audio=False,
+                                 enable_microphone=False)
+    await client_factory(server2)
+    assert svc2._check_audio().status == OK
+
+
+async def test_profile_endpoint_role_gated_and_status(client_factory):
+    import base64
+    server, svc, fake, _ = make_app(
+        enable_basic_auth=True, basic_auth_user="u",
+        basic_auth_password="pw", viewonly_password="vo")
+    c = await client_factory(server)
+    vo = {"Authorization": "Basic " + base64.b64encode(b"u:vo").decode()}
+    full = {"Authorization": "Basic " + base64.b64encode(b"u:pw").decode()}
+    r = await c.post("/api/profile", json={"action": "status"}, headers=vo)
+    assert r.status == 403
+    r = await c.post("/api/profile", json={"action": "status"},
+                     headers=full)
+    body = await r.json()
+    assert r.status == 200 and body["active"] is False
+    r = await c.post("/api/profile", json={"action": "nope"}, headers=full)
+    assert r.status == 400
+    # stop without start: structured 409, not a 500
+    r = await c.post("/api/profile", json={"action": "stop"}, headers=full)
+    assert r.status == 409 and "no capture" in (await r.json())["error"]
+
+
+async def test_profile_capture_roundtrip(client_factory, tmp_path):
+    """Full start->stop cycle writes a jax.profiler trace dir."""
+    server, *_ = make_app()
+    c = await client_factory(server)
+    target = str(tmp_path / "cap")
+    r = await c.post("/api/profile",
+                     json={"action": "start", "dir": target})
+    body = await r.json()
+    assert r.status == 200 and body["ok"] is True, body
+    # double-start is refused while active
+    r = await c.post("/api/profile", json={"action": "start"})
+    assert r.status == 409
+    import jax
+    import jax.numpy as jnp
+    jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    r = await c.post("/api/profile", json={"action": "stop"})
+    body = await r.json()
+    assert r.status == 200 and body["trace_dir"] == target, body
+    assert (tmp_path / "cap").is_dir()
+
+
+async def test_trace_endpoint_carries_device_lane(client_factory):
+    from selkies_tpu.obs import monitor as global_monitor
+    server, *_ = make_app()
+    c = await client_factory(server)
+    global_monitor.on_event_duration(
+        "/jax/core/compile/backend_compile_duration_sec", 0.75)
+    try:
+        r = await c.get("/api/trace")
+        doc = await r.json()
+        lanes = [e for e in doc["traceEvents"]
+                 if e.get("ph") == "M"
+                 and e["args"].get("name") == "device"]
+        spans = [e for e in doc["traceEvents"]
+                 if str(e.get("name", "")).startswith("compile:")]
+        assert lanes and spans
+        assert doc["otherData"]["compile"]["count"] >= 1
+    finally:
+        global_monitor._compile_ring.clear()
+
+
+def test_obs_selftest_cli():
+    """The CI lint smoke: must pass in-process too."""
+    from selkies_tpu.obs.__main__ import main
+    assert main(["selftest"]) == 0
+
+
+def test_monitor_cached_sample_avoids_second_rpc_pass(monkeypatch):
+    """While the background sampler owns the cadence, device_stats()
+    callers must get the cached sample — a second memory_stats pass
+    would double the encode-thread RPC contention the gating exists to
+    avoid."""
+    import jax
+    calls = []
+
+    class _Dev(_FakeDevice):
+        def memory_stats(self):
+            calls.append(1)
+            return {"bytes_in_use": 7}
+
+    monkeypatch.setattr(jax, "local_devices",
+                        lambda: [_Dev(0, platform="cpu")])
+    mon = DeviceMonitor(recorder=FlightRecorder())
+    mon.interval_s = 60.0                  # thread sleeps; we drive it
+    mon.start()
+    try:
+        mon.sample()                       # the sampler's own pass
+        assert calls == [1]
+        assert mon.cached_sample()[0]["hbm_in_use"] == 7
+        assert calls == [1]                # served from cache, no RPC
+    finally:
+        mon.stop()
+    mon2 = DeviceMonitor(recorder=FlightRecorder())
+    assert mon2.cached_sample()[0]["hbm_in_use"] == 7
+    assert len(calls) == 2                 # no thread: inline sample
+
+
+def test_liveness_probe_runs_only_liveness_checks():
+    """The liveness path must not EVALUATE readiness closures — a
+    wedged one would time the probe out and crash-loop the pod."""
+    eng = HealthEngine()
+    ran = []
+    eng.register("service", lambda: (ran.append("live"), ok("up"))[1],
+                 liveness=True)
+    eng.register("relay", lambda: (ran.append("ready"), failed("dead"))[1])
+    out = eng.liveness()
+    assert out["ok"] is True and out["live"] is True
+    assert ran == ["live"]          # the readiness closure never ran
+
+
+def test_unregister_is_owner_matched():
+    eng = HealthEngine()
+
+    def old():
+        return failed("old instance")
+
+    def new():
+        return ok("new instance")
+
+    eng.register("service", old)
+    eng.register("service", new)      # newer instance replaces
+    eng.unregister("service", old)    # stale teardown: must be a no-op
+    assert eng.run()["service"].status == OK
+    eng.unregister("service", new)
+    assert eng.run() == {}
+
+
+async def test_audio_check_degrades_on_failed_mic_provision(
+        client_factory):
+    """Satellite (ADVICE r5): a mic that silently cannot work must
+    show up as a degraded verdict, not a green health endpoint."""
+
+    class _FakeAudio:
+        mic_only = True
+        mic_ok = False
+        alive = False
+
+    server, svc, fake, _ = make_app(enable_audio=False,
+                                    enable_microphone=True)
+    await client_factory(server)
+    svc.audio = _FakeAudio()
+    v = svc._check_audio()
+    assert v.status == DEGRADED and "mic provisioning failed" in v.reason
+    svc.audio.mic_ok = True
+    assert svc._check_audio().status == OK

@@ -238,3 +238,48 @@ async def test_rtc_config_monitor_pushes_changes(tmp_path):
         assert len(got) == 2
     finally:
         await mon.stop()
+
+
+def test_display_rect_honours_display2_position():
+    """Satellite (ISSUE 3 / ADVICE r5): secondary captures must follow
+    display2_position instead of being pinned to (initial_width, 0) —
+    and left/above layouts move the PRIMARY's origin too."""
+    from selkies_tpu.server.webrtc_service import WebRTCService
+    w, h = 1920, 1080
+    for pos, o1, o2 in (
+            ("right", (0, 0), (w, 0)),
+            ("left", (w, 0), (0, 0)),
+            ("above", (0, h), (0, 0)),
+            ("below", (0, 0), (0, h))):
+        svc = WebRTCService(_settings(display2_position=pos))
+        assert svc._display_rect("primary") == o1, pos
+        assert svc._display_rect(":0") == o1, pos      # x-display alias
+        assert svc._display_rect("display2") == o2, pos
+
+
+def test_webrtc_resize_retargets_all_live_captures():
+    """Satellite: a resize must push update_capture_region to EVERY live
+    capture — with left/above layouts the other display's origin shifts
+    when the geometry changes."""
+    from selkies_tpu.server.webrtc_service import WebRTCService
+
+    class _Cap:
+        def __init__(self):
+            self.regions = []
+
+        def is_capturing(self):
+            return True
+
+        def update_capture_region(self, x, y, w, h):
+            self.regions.append((x, y, w, h))
+
+    async def run():
+        svc = WebRTCService(_settings(display2_position="left"))
+        svc._loop = asyncio.get_running_loop()
+        svc._captures = {"primary": _Cap(), "display2": _Cap()}
+        await svc._resize(1280, 720, "primary")
+        # both captures retargeted; primary's origin follows the NEW
+        # width of the left-placed secondary
+        assert svc._captures["primary"].regions == [(1280, 0, 1280, 720)]
+        assert svc._captures["display2"].regions == [(0, 0, 1280, 720)]
+    asyncio.run(run())

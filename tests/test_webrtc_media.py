@@ -768,3 +768,26 @@ def test_offer_multiopus_surround():
     # stereo keeps plain opus
     sdp2 = build_offer("1.2.3.4", 5, "u", "p", "AA:BB")
     assert "multiopus" not in sdp2 and "opus/48000/2" in sdp2
+
+
+def test_offer_mic_only_emits_recvonly_audio_mline():
+    """Satellite (ADVICE r5): enable_microphone without enable_audio
+    must still produce an audio m-line (recvonly) or the browser has
+    nowhere to attach its mic track."""
+    from selkies_tpu.webrtc.sdp import build_offer
+    o = build_offer("1.2.3.4", 9, "uf", "pw", "FP",
+                    with_audio=False, with_mic=True)
+    assert "m=audio" in o and "a=recvonly" in o
+    assert o.count("a=sendonly") == 1          # the video m-line only
+    assert "a=group:BUNDLE 0 1 2" in o         # audio keeps its mid
+    # sendrecv when BOTH directions are on; sendonly when mic is off
+    o = build_offer("1.2.3.4", 9, "uf", "pw", "FP",
+                    with_audio=True, with_mic=True)
+    assert "a=sendrecv" in o and "a=recvonly" not in o
+    o = build_offer("1.2.3.4", 9, "uf", "pw", "FP",
+                    with_audio=True, with_mic=False)
+    assert o.count("a=sendonly") == 2 and "a=sendrecv" not in o
+    # no audio at all: no m-line, bundle shrinks
+    o = build_offer("1.2.3.4", 9, "uf", "pw", "FP",
+                    with_audio=False, with_mic=False)
+    assert "m=audio" not in o and "a=group:BUNDLE 0 1\r\n" in o

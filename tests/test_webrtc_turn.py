@@ -103,7 +103,10 @@ class MiniTurnServer(asyncio.DatagramProtocol):
             alloc["channels"][ch] = peer
             alloc["chan_rev"][peer] = ch
             alloc["perms"].add(peer[0])
-        self.transport.sendto(resp.to_bytes(), addr)
+        # success responses to authed requests are integrity-protected
+        # (RFC 5389 §10.2.3) — the client now REQUIRES this once it
+        # knows the realm (ADVICE r5 satellite)
+        self.transport.sendto(resp.to_bytes(integrity_key=key), addr)
 
     def _from_peer(self, client_addr, data, peer):
         alloc = self.allocs.get(client_addr)
@@ -282,3 +285,81 @@ async def test_media_flows_with_host_candidate_firewalled():
     assert np.array_equal(mu, enc.recon_u)
     assert np.array_equal(mv, enc.recon_v)
     peer.close()
+
+
+# ---------------------------------------------------------------- MI gating
+def _mi_client():
+    """TurnClient with realm/nonce learned, plus a pending request whose
+    future exposes whether a response was accepted."""
+    cli = T.TurnClient(("127.0.0.1", 1), USER, PASSWORD)
+    cli.realm = REALM
+    cli.nonce = NONCE
+    req = StunMessage(T.M_ALLOCATE)
+    fut = asyncio.get_running_loop().create_future()
+    cli._pending[req.txid] = fut
+    return cli, req, fut
+
+
+def _lt_key():
+    return hashlib.md5(f"{USER}:{REALM}:{PASSWORD}".encode()).digest()
+
+
+async def test_mi_less_success_response_dropped():
+    """Satellite (ADVICE r5): once the realm is known, a success
+    response WITHOUT MESSAGE-INTEGRITY must be dropped — an off-path
+    attacker who observed the txid could otherwise inject a bogus
+    relayed address."""
+    cli, req, fut = _mi_client()
+    forged = StunMessage(T.M_ALLOCATE | 0x0100, req.txid)
+    forged.add(T.ATTR_XOR_RELAYED_ADDRESS, T.xor_address("6.6.6.6", 666))
+    cli._on_datagram(forged.to_bytes())
+    assert not fut.done(), "unsigned success must not resolve the request"
+    # the genuine, signed response still lands afterwards
+    real = StunMessage(T.M_ALLOCATE | 0x0100, req.txid)
+    real.add(T.ATTR_XOR_RELAYED_ADDRESS, T.xor_address("127.0.0.1", 5))
+    cli._on_datagram(real.to_bytes(integrity_key=_lt_key()))
+    assert fut.done()
+
+
+async def test_mi_bad_signature_dropped():
+    cli, req, fut = _mi_client()
+    forged = StunMessage(T.M_ALLOCATE | 0x0100, req.txid)
+    cli._on_datagram(forged.to_bytes(integrity_key=b"\x00" * 16))
+    assert not fut.done()
+
+
+async def test_mi_less_reauth_errors_still_accepted():
+    """401/438 are sent BEFORE auth to (re)issue realm/nonce — they
+    legitimately lack MI and must keep working or nonce refresh dies."""
+    for code_bytes in (b"\x00\x00\x04\x01Unauthorized",
+                       b"\x00\x00\x04\x26Stale"):
+        cli, req, fut = _mi_client()
+        err = StunMessage(T.M_ALLOCATE | 0x0110, req.txid)
+        err.add(T.ATTR_ERROR_CODE, code_bytes)
+        err.add(T.ATTR_REALM, REALM.encode())
+        err.add(T.ATTR_NONCE, b"nonce-2")
+        cli._on_datagram(err.to_bytes())
+        assert fut.done(), code_bytes
+
+
+async def test_mi_less_other_error_dropped():
+    cli, req, fut = _mi_client()
+    err = StunMessage(T.M_ALLOCATE | 0x0110, req.txid)
+    err.add(T.ATTR_ERROR_CODE, b"\x00\x00\x04\x03Forbidden")
+    cli._on_datagram(err.to_bytes())
+    assert not fut.done()
+
+
+async def test_mi_not_required_before_realm_known():
+    """The FIRST 401 arrives before any credentials exist — requiring MI
+    there would deadlock the auth dance."""
+    cli = T.TurnClient(("127.0.0.1", 1), USER, PASSWORD)
+    req = StunMessage(T.M_ALLOCATE)
+    fut = asyncio.get_running_loop().create_future()
+    cli._pending[req.txid] = fut
+    err = StunMessage(T.M_ALLOCATE | 0x0110, req.txid)
+    err.add(T.ATTR_ERROR_CODE, b"\x00\x00\x04\x01U")
+    err.add(T.ATTR_REALM, REALM.encode())
+    err.add(T.ATTR_NONCE, NONCE)
+    cli._on_datagram(err.to_bytes())
+    assert fut.done()
