@@ -23,6 +23,12 @@ fallback is a ``failed`` verdict, never a plausible-looking fps number.
 ``--profile`` (or BENCH_PROFILE=1) wraps the steady-state throughput
 loop in a jax.profiler capture (dir: BENCH_PROFILE_DIR or a fresh
 tempdir, reported as ``profile_dir``).
+
+Session QoE (selkies_tpu/obs/qoe, ISSUE 4): the latency loop doubles
+as a loopback QoE session, so the JSON line carries a ``qoe`` block —
+``ack_rtt_p50_ms``/``ack_rtt_p99_ms``, ``drop_rate``, and the
+composite ``score`` computed with the same documented formula
+``GET /api/sessions`` uses.
 """
 
 import json
@@ -206,11 +212,16 @@ def main(force_cpu: bool = False) -> None:
     # next to the fps/latency line is what attributes every future
     # BENCH_r*.json regression to capture/convert/dispatch/readback/
     # packetize instead of one opaque number -----------------------------
+    from selkies_tpu.obs import qoe as _qoe
     from selkies_tpu.trace import STAGES
     from selkies_tpu.trace import tracer as _tracer
     from selkies_tpu.trace.summary import render_table, summarize_timelines
     bench_display = sess.settings.display_id
     _tracer.enable(capacity=1024)
+    # loopback QoE session: the bench acts as its own client — each
+    # frame is "sent" at dispatch and "ACKed" at wire bytes, so the
+    # ack-RTT percentiles measure the same path a LAN viewer would see
+    qsess = _qoe.SessionStats(0, "bench", bench_display)
     lat = []
     n_lat = 0
     lat_budget = float(os.environ.get("BENCH_LAT_BUDGET_S", "45"))
@@ -221,10 +232,12 @@ def main(force_cpu: bool = False) -> None:
         jax.block_until_ready(f)          # exclude frame synthesis
         t0 = time.monotonic()
         tl = _tracer.frame_begin(bench_display)
+        qsess.note_sent(t, t0)
         out = sess.encode(f, force=True)
         _tracer.bind(tl, out["frame_id"])
         chunks = sess.finalize(out, force_all=True)
         _tracer.frame_end(bench_display, out["frame_id"])
+        qsess.note_ack(t, time.monotonic())
         lat.append(time.monotonic() - t0)
         total_bytes += sum(len(c.payload) for c in chunks)
         n_lat += 1
@@ -305,6 +318,20 @@ def main(force_cpu: bool = False) -> None:
         f"{compile_stats['cache_hits']}h/{compile_stats['cache_misses']}m) "
         f"backend verdict: {verdict.status} ({verdict.reason})")
 
+    # session QoE block (ISSUE 4): ack RTT percentiles from the
+    # loopback session, drop rate (0 — nothing relays in a bench), and
+    # the composite score against the 60 fps baseline floor, computed
+    # with the same documented formula /api/sessions uses
+    ack_pcts = qsess.ack.percentiles()
+    qoe_doc = {
+        "ack_rtt_p50_ms": ack_pcts["p50_ms"],
+        "ack_rtt_p99_ms": ack_pcts["p99_ms"],
+        "drop_rate": 0.0,
+        "score": _qoe.qoe_score(fps, 60.0, ack_pcts["p50_ms"] or 0.0, 0.0),
+    }
+    log(f"qoe: rtt_p50={qoe_doc['ack_rtt_p50_ms']}ms "
+        f"rtt_p99={qoe_doc['ack_rtt_p99_ms']}ms score={qoe_doc['score']}")
+
     mbps = total_bytes / n_lat * fps * 8 / 1e6
     print(json.dumps({
         "metric": f"encode_fps_{w}x{h}_{codec}_tpu",
@@ -325,6 +352,7 @@ def main(force_cpu: bool = False) -> None:
         "compile_total_s": compile_stats["total_s"],
         "compile_cache_hits": compile_stats["cache_hits"],
         "compile_cache_misses": compile_stats["cache_misses"],
+        "qoe": qoe_doc,
         **({"profile_dir": profile_dir} if profile_dir else {}),
         "frames": n_frames,
     }))

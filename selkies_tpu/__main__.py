@@ -35,7 +35,12 @@ async def run(argv=None) -> None:
     settings = AppSettings.parse(argv)
     logging.basicConfig(
         level=logging.DEBUG if settings.debug else logging.INFO,
-        format="%(asctime)s [%(name)s] %(levelname)s: %(message)s")
+        format="%(asctime)s [%(name)s] %(levelname)s:"
+               "%(session_tag)s %(message)s")
+    # session/seat log correlation (+ --log_format=json): the filter
+    # also defaults session_tag to "" for records outside a session
+    from .obs import logctx as _logctx
+    _logctx.install(json_format=settings.log_format == "json")
 
     # persistent XLA compile cache: the server must READ the cache the
     # image build / entrypoint warm step (tools/warm_cache.py) wrote, or

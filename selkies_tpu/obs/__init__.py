@@ -18,6 +18,12 @@ that class of failure self-diagnosing:
   metrics and overlaid on the trace timeline;
 - :mod:`.profiler` — on-demand ``jax.profiler`` capture behind
   ``POST /api/profile`` and ``bench.py --profile``;
+- :mod:`.qoe` — per-session wire QoE: ACK-RTT estimation, client fps,
+  backpressure windows, relay/congestion-controller counters, the
+  composite QoE score behind ``GET /api/sessions``, the ``qoe`` health
+  check and the bounded-cardinality Prometheus export;
+- :mod:`.logctx` — contextvars session/seat log correlation and the
+  ``--log_format=json`` structured formatter;
 - :mod:`.__main__` — ``python -m selkies_tpu.obs selftest``: the CI
   smoke, runnable with neither jax nor aiohttp installed.
 
@@ -29,3 +35,6 @@ from .device_monitor import DeviceMonitor, monitor  # noqa: F401
 from .health import (DEGRADED, FAILED, OK, FlightRecorder,  # noqa: F401
                      HealthEngine, Verdict, degraded, engine, failed, ok)
 from .profiler import ProfilerSession, profiler  # noqa: F401
+from .qoe import (AckRttEstimator, QoERegistry,  # noqa: F401
+                  SessionStats, qoe_score)
+from .qoe import registry as qoe_registry  # noqa: F401

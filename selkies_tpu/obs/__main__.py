@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 from .device_monitor import DeviceMonitor
 from .health import DEGRADED, FAILED, OK, HealthEngine, degraded, failed, ok
@@ -97,7 +98,54 @@ def _cmd_selftest(args: argparse.Namespace) -> int:
     if mon.backend_verdict().status not in (OK, FAILED):
         return _fail("backend verdict must always resolve")
 
-    doc = {"health": eng.report(verbose=True), "monitor": mon.snapshot()}
+    # qoe plane (ISSUE 4): registry round-trip + verdict emission.
+    # Clocks are injected where the API allows; the stall case uses
+    # real-monotonic-relative times so health_check()'s internal clock
+    # agrees.
+    from .qoe import QoERegistry
+    reg = QoERegistry()
+    reg.recorder = eng.recorder
+    st = reg.register("ws", "seat0", 1, now=0.0)
+    st.video_active = True
+    st.target_fps = lambda: 60.0
+    st.reported_fps = 60.0
+    st.relay_provider = lambda: {"sent_bytes": 100_000,
+                                 "dropped_frames": 0,
+                                 "queue_depth": 0, "queued_bytes": 0}
+    t = time.monotonic()
+    for fid in range(20):
+        st.note_sent(fid, t - 1.0 + fid * 0.01)
+        st.note_ack(fid, t - 1.0 + fid * 0.01 + 0.005)
+    if reg.health_check().status != OK:
+        return _fail("healthy 60fps/5ms session must verdict ok")
+    pcts = st.ack.percentiles()
+    if pcts["n"] != 20 or not (4.0 <= pcts["p50_ms"] <= 6.0):
+        return _fail(f"ack rtt percentiles broken: {pcts}")
+    doc0 = reg.report(verbose=True)
+    json.loads(json.dumps(doc0))           # /api/sessions must round-trip
+    if doc0["count"] != 1 or doc0["sessions"][0]["qoe_score"] < 90:
+        return _fail(f"healthy session must score high: {doc0}")
+    # stall: frames sent 5 s ago, never ACKed -> failed + qoe_collapse
+    for fid in range(100, 110):
+        st.note_sent(fid, t - 5.0)
+    v = reg.health_check()
+    if v.status != FAILED:
+        return _fail(f"5s ACK stall must fail the qoe check: {v}")
+    def _collapses():
+        return sum(e["kind"] == "qoe_collapse"
+                   for e in eng.recorder.snapshot())
+
+    n_collapse = _collapses()
+    if not n_collapse:
+        return _fail("qoe collapse must hit the flight recorder")
+    if reg.health_check().status != FAILED or _collapses() != n_collapse:
+        return _fail("qoe_collapse must be edge-triggered, not per-check")
+    reg.unregister(st)
+    if reg.health_check().status != OK:
+        return _fail("empty registry must verdict ok")
+
+    doc = {"health": eng.report(verbose=True), "monitor": mon.snapshot(),
+           "qoe": doc0}
     text = json.dumps(doc)
     json.loads(text)                       # the payload must round-trip
     print(text if args.json else "selftest OK "

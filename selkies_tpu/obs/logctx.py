@@ -1,0 +1,101 @@
+"""Log correlation: session/seat context on ``selkies_tpu.*`` records.
+
+A multi-seat fan-out interleaves every session's log lines; without a
+correlation id, "client 7 backpressured" and the relay death two lines
+later cannot be tied to the same seat. This module carries the active
+session through a :mod:`contextvars` variable (set by the transport at
+accept, inherited by everything awaited under that connection's
+handler) and injects it into log records via a logging filter, so both
+the plain formatter and the ``--log_format=json`` structured output can
+carry it without any call-site changes.
+
+Stdlib-only, import-safe everywhere (same contract as the rest of
+:mod:`selkies_tpu.obs`).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import time
+from typing import Optional
+
+__all__ = ["bind", "clear", "current", "SessionContextFilter",
+           "JsonFormatter", "install"]
+
+#: (session_id, seat) of the connection being handled, or None
+_session_ctx: contextvars.ContextVar[Optional[tuple]] = \
+    contextvars.ContextVar("selkies_log_session", default=None)
+
+
+def bind(sid, seat) -> contextvars.Token:
+    """Attach the current task/thread's log records to a session."""
+    return _session_ctx.set((sid, str(seat)))
+
+
+def clear(token: Optional[contextvars.Token] = None) -> None:
+    if token is not None:
+        _session_ctx.reset(token)
+    else:
+        _session_ctx.set(None)
+
+
+def current() -> Optional[tuple]:
+    return _session_ctx.get()
+
+
+class SessionContextFilter(logging.Filter):
+    """Injects ``record.session`` / ``record.seat`` (empty strings when
+    no session is bound) plus ``record.session_tag`` — a pre-formatted
+    `` [seat#sid]`` suffix the plain format string can use directly.
+    Attached to HANDLERS (filters on a logger do not propagate to
+    children), so every ``selkies_tpu.*`` record passing through gets
+    stamped; it never rejects a record."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        ctx = _session_ctx.get()
+        if ctx is not None:
+            record.session = str(ctx[0])
+            record.seat = ctx[1]
+            record.session_tag = f" [{ctx[1]}#{ctx[0]}]"
+        else:
+            record.session = ""
+            record.seat = ""
+            record.session_tag = ""
+        return True
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line: ``ts``, ``level``, ``logger``, ``msg``,
+    plus ``session``/``seat`` when bound and ``exc`` for tracebacks —
+    the ``--log_format=json`` structured option."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {
+            "ts": round(time.time(), 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        session = getattr(record, "session", "")
+        if session:
+            doc["session"] = session
+            doc["seat"] = getattr(record, "seat", "")
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        return json.dumps(doc, default=str)
+
+
+def install(json_format: bool = False,
+            logger: Optional[logging.Logger] = None) -> None:
+    """Attach the correlation filter (and optionally the JSON
+    formatter) to the given logger's handlers — call after
+    ``logging.basicConfig`` so the root handler exists."""
+    root = logger if logger is not None else logging.getLogger()
+    filt = SessionContextFilter()
+    for h in root.handlers:
+        if not any(isinstance(f, SessionContextFilter) for f in h.filters):
+            h.addFilter(filt)
+        if json_format:
+            h.setFormatter(JsonFormatter())

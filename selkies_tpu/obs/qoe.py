@@ -1,0 +1,606 @@
+"""Per-session QoE stats registry — WebRTC ``getStats()`` in spirit.
+
+PR 2 attributed server-side latency and PR 3 surfaced device health,
+but the wire stayed dark: ACK RTT, client fps, backpressure windows,
+relay drops and the congestion controller's state were all computed and
+thrown away. For a multi-seat fan-out, per-session QoE is the signal
+that says WHICH seat is suffering and WHY. This module is that plane:
+
+- :class:`AckRttEstimator` — frame-id send-timestamp ring matched
+  against ``CLIENT_FRAME_ACK``; EWMA plus a windowed p50/p99. The ACK
+  protocol acknowledges the latest *displayed* frame, so an ACK also
+  retires every older outstanding entry (relay-dropped frames are never
+  ACKed and must not read as a stall).
+- :class:`SessionStats` — one per WS client / WebRTC peer: the ACK
+  estimator, client fps, backpressure-window accounting, and pull-based
+  providers for relay counters (``sent_bytes``/``dropped_frames``/queue
+  depth) and congestion-controller internals
+  (:meth:`~..webrtc.cc.SendSideCongestionController.stats`).
+- :class:`QoERegistry` — the process-wide session set behind
+  ``GET /api/sessions``, the bounded-cardinality Prometheus export, the
+  ``qoe`` health check (``qoe_collapse`` incidents into the PR-3 flight
+  recorder) and the ``qoe`` trace lane (backpressure windows overlaid
+  on ``/api/trace``).
+
+**QoE score** (documented contract, also used by ``bench.py``)::
+
+    score     = 100 × fps_term × rtt_term × (1 − drop_rate)
+    fps_term  = clamp(client_fps / target_fps, 0, 1)   (1 when unknown)
+    rtt_term  = 1 / (1 + rtt_ms / 250)
+    rtt_ms    = max(EWMA ack RTT, oldest-unACKed frame age)  [ws]
+                TWCC smoothed RTT                            [webrtc]
+    drop_rate = relay dropped / offered                      [ws]
+                TWCC loss fraction                           [webrtc]
+
+100 is a perfect session; ``degraded`` below
+:data:`DEGRADED_SCORE` (50), ``failed`` below :data:`FAILED_SCORE`
+(15). A 4 s ACK stall alone scores ~6 — failed, as it should.
+
+Dependency-free (stdlib only): the CI lint smoke runs
+``python -m selkies_tpu.obs selftest`` in an image with neither jax nor
+aiohttp; metrics touch points are lazy and guarded, the same contract
+:mod:`.health` keeps. Clocks are injected (``now``) everywhere tests
+need determinism.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Optional
+
+from . import health as _health
+
+__all__ = ["AckRttEstimator", "SessionStats", "QoERegistry", "qoe_score",
+           "registry", "DEGRADED_SCORE", "FAILED_SCORE"]
+
+#: score thresholds for the ``qoe`` health check (registry-configurable
+#: via the ``qoe_degraded_score`` / ``qoe_failed_score`` settings)
+DEGRADED_SCORE = 50.0
+FAILED_SCORE = 15.0
+
+#: rtt_term halves every this many ms of round-trip
+_RTT_HALF_MS = 250.0
+
+#: per-session Prometheus series cap (``qoe_seat_label_cap`` setting);
+#: sessions beyond it roll up into the ``seat="_overflow"`` aggregate
+DEFAULT_SEAT_LABEL_CAP = 8
+
+
+def qoe_score(client_fps: Optional[float], target_fps: float,
+              rtt_ms: float, drop_rate: float) -> float:
+    """The composite score — see the module docstring for the formula.
+    ``client_fps=None`` means unknown (scored as on-target rather than
+    punishing a session that simply never reported)."""
+    if client_fps is None or target_fps <= 0:
+        fps_term = 1.0
+    else:
+        fps_term = min(1.0, max(0.0, client_fps / target_fps))
+    rtt_term = 1.0 / (1.0 + max(0.0, rtt_ms) / _RTT_HALF_MS)
+    drop_term = 1.0 - min(1.0, max(0.0, drop_rate))
+    return round(100.0 * fps_term * rtt_term * drop_term, 1)
+
+
+class AckRttEstimator:
+    """ACK round-trip estimator over the uint16 circular frame-id space.
+
+    ``note_sent`` is on the fan-out hot path: one bounded dict insert,
+    no clock read of its own (the caller passes ``now`` once per
+    fan-out). ``note_ack`` retires the matched entry AND everything
+    sent before it — the client ACKs the latest displayed frame, so
+    older outstanding ids are either delivered-unACKed or
+    relay-dropped, and neither may masquerade as a stall."""
+
+    def __init__(self, ring: int = 512, window: int = 128,
+                 alpha: float = 0.125):
+        #: frame_id -> send time (monotonic s), insertion == send order
+        self._sent: "collections.OrderedDict[int, float]" = \
+            collections.OrderedDict()
+        self._ring = int(ring)
+        self._samples: collections.deque = collections.deque(maxlen=window)
+        self._alpha = float(alpha)
+        self.ewma_ms: Optional[float] = None
+        self.acked = 0
+
+    def note_sent(self, frame_id: int, now: float) -> None:
+        fid = int(frame_id) & 0xFFFF
+        self._sent[fid] = now
+        self._sent.move_to_end(fid)
+        while len(self._sent) > self._ring:
+            self._sent.popitem(last=False)
+
+    def note_ack(self, frame_id: int, now: float) -> Optional[float]:
+        """-> this ACK's RTT in ms, or None for an unmatched id."""
+        t = self._sent.pop(int(frame_id) & 0xFFFF, None)
+        if t is None:
+            return None
+        # retire everything sent at or before the acked frame
+        stale = [k for k, v in self._sent.items() if v <= t]
+        for k in stale:
+            del self._sent[k]
+        rtt_ms = max(0.0, (now - t) * 1000.0)
+        self.acked += 1
+        self._samples.append(rtt_ms)
+        if self.ewma_ms is None:
+            self.ewma_ms = rtt_ms
+        else:
+            self.ewma_ms += self._alpha * (rtt_ms - self.ewma_ms)
+        return rtt_ms
+
+    def oldest_pending_ms(self, now: float) -> float:
+        """Age of the oldest un-ACKed frame — the stall signal an EWMA
+        of *completed* round-trips can never show. Scans timestamps
+        (bounded by ``ring``) rather than trusting insertion order."""
+        if not self._sent:
+            return 0.0
+        return max(0.0, (now - min(self._sent.values())) * 1000.0)
+
+    def effective_rtt_ms(self, now: float) -> float:
+        """RTT for scoring: the EWMA, floored by the oldest pending age
+        (a stalled client has a beautiful EWMA and a terrible queue)."""
+        return max(self.ewma_ms or 0.0, self.oldest_pending_ms(now))
+
+    def percentiles(self) -> dict:
+        vals = sorted(self._samples)
+        if not vals:
+            return {"n": 0, "p50_ms": None, "p99_ms": None}
+
+        def _pct(q: float) -> float:
+            return round(vals[min(len(vals) - 1, int(len(vals) * q))], 3)
+
+        return {"n": len(vals), "p50_ms": _pct(0.50), "p99_ms": _pct(0.99)}
+
+    @property
+    def pending(self) -> int:
+        return len(self._sent)
+
+
+class SessionStats:
+    """One streaming session's wire-side stats. Counters are written by
+    the owning service (``note_sent``/``note_ack``/backpressure edges);
+    relay and congestion-controller state is *pulled* at snapshot time
+    through provider callables so the numbers are always current."""
+
+    def __init__(self, sid, kind: str, seat: str, raddr: str = "",
+                 now: Optional[float] = None,
+                 registry: "Optional[QoERegistry]" = None):
+        self.sid = sid                        # int (ws) or peer uid str
+        self.kind = str(kind)                 # 'ws' | 'webrtc' | 'bench'
+        self.seat = str(seat)
+        self.raddr = str(raddr)
+        self.created = time.monotonic() if now is None else now
+        self._registry = registry
+        self.ack = AckRttEstimator()
+        self.video_active = False
+        #: distinct frames offered to this session's wire
+        self.frames_sent = 0
+        #: chunks offered (striped encoders emit several per frame) —
+        #: the drop-rate denominator, same unit as the relay's
+        #: dropped_frames counter (queue items)
+        self.chunks_sent = 0
+        self._last_sent_fid: Optional[int] = None
+        self.stalls = 0
+        #: client-reported display fps (the ``_f`` verb); None = unknown
+        self.reported_fps: Optional[float] = None
+        #: fallback fps estimate (ACK cadence), provided by the service
+        self.fps_provider: Optional[Callable[[], float]] = None
+        #: -> {"sent_bytes", "dropped_frames", "queue_depth",
+        #:     "queued_bytes", "relays", "dead"} for the WS relay set
+        self.relay_provider: Optional[Callable[[], dict]] = None
+        #: -> SendSideCongestionController.stats() for WebRTC peers
+        self.cc_provider: Optional[Callable[[], dict]] = None
+        #: -> target fps for the score's fps_term
+        self.target_fps: Optional[Callable[[], float]] = None
+        # backpressure-window accounting
+        self.bp_windows = 0
+        self.bp_total_s = 0.0
+        self._bp_since: Optional[float] = None
+        self._bp_since_ns: Optional[int] = None
+        # qoe_collapse edge detector (one incident per collapse, not
+        # one per health-check evaluation)
+        self._collapsed = False
+
+    # -- hot-path writers ---------------------------------------------------
+    def note_sent(self, frame_id: int, now: float) -> None:
+        """Called once per offered chunk; consecutive chunks of one
+        striped frame share a frame_id and count as ONE frame."""
+        self.chunks_sent += 1
+        fid = int(frame_id) & 0xFFFF
+        if fid != self._last_sent_fid:
+            self._last_sent_fid = fid
+            self.frames_sent += 1
+        self.ack.note_sent(frame_id, now)
+
+    def note_ack(self, frame_id: int, now: float) -> Optional[float]:
+        rtt = self.ack.note_ack(frame_id, now)
+        if rtt is not None:
+            _metrics_rtt(rtt)
+        return rtt
+
+    def note_stall(self) -> None:
+        self.stalls += 1
+
+    def backpressure_begin(self, now: float) -> None:
+        if self._bp_since is None:
+            self._bp_since = now
+            self._bp_since_ns = time.perf_counter_ns()
+            self.bp_windows += 1
+
+    def backpressure_end(self, now: float) -> Optional[float]:
+        """-> the closed window's duration in seconds (None when no
+        window was open). Feeds the registry's ``qoe`` trace lane."""
+        if self._bp_since is None:
+            return None
+        dur_s = max(0.0, now - self._bp_since)
+        self.bp_total_s += dur_s
+        if self._registry is not None and self._bp_since_ns is not None:
+            self._registry._note_bp_window(
+                self.seat, self.sid, self._bp_since_ns,
+                time.perf_counter_ns() - self._bp_since_ns)
+        self._bp_since = None
+        self._bp_since_ns = None
+        return dur_s
+
+    # -- derived state ------------------------------------------------------
+    def _pull(self, provider: Optional[Callable[[], dict]]) -> dict:
+        if provider is None:
+            return {}
+        try:
+            return dict(provider() or {})
+        except Exception:
+            return {}
+
+    def client_fps(self) -> Optional[float]:
+        if self.reported_fps is not None:
+            return self.reported_fps
+        if self.fps_provider is not None:
+            try:
+                return float(self.fps_provider())
+            except Exception:
+                return None
+        return None
+
+    def drop_rate(self, relay: Optional[dict] = None,
+                  cc: Optional[dict] = None) -> float:
+        if self.kind == "webrtc":
+            cc = cc if cc is not None else self._pull(self.cc_provider)
+            return float(cc.get("loss_fraction", 0.0) or 0.0)
+        relay = relay if relay is not None else self._pull(self.relay_provider)
+        dropped = float(relay.get("dropped_frames", 0) or 0)
+        # chunks, not frames: the relay's dropped counter is per queued
+        # item, so the denominator must be the same unit
+        return min(1.0, dropped / max(1.0, float(self.chunks_sent)))
+
+    def rtt_ms(self, now: float, cc: Optional[dict] = None) -> float:
+        if self.kind == "webrtc" and self.ack.acked == 0 \
+                and not self.ack.pending:
+            cc = cc if cc is not None else self._pull(self.cc_provider)
+            return float(cc.get("rtt_ms", 0.0) or 0.0)
+        return self.ack.effective_rtt_ms(now)
+
+    def score(self, now: Optional[float] = None) -> Optional[float]:
+        """None while the session has no media flowing (a fresh viewer
+        must not drag the fleet verdict either way)."""
+        now = time.monotonic() if now is None else now
+        cc = self._pull(self.cc_provider) if self.kind == "webrtc" else None
+        if self.kind == "webrtc":
+            if not cc:
+                return None
+        elif not (self.video_active and self.frames_sent):
+            return None
+        target = 0.0
+        if self.target_fps is not None:
+            try:
+                target = float(self.target_fps())
+            except Exception:
+                target = 0.0
+        return qoe_score(self.client_fps(), target,
+                         self.rtt_ms(now, cc=cc),
+                         self.drop_rate(cc=cc))
+
+    # -- export -------------------------------------------------------------
+    def snapshot(self, now: Optional[float] = None,
+                 verbose: bool = False) -> dict:
+        now = time.monotonic() if now is None else now
+        relay = self._pull(self.relay_provider)
+        cc = self._pull(self.cc_provider)
+        doc: dict = {
+            "sid": self.sid,
+            "kind": self.kind,
+            "seat": self.seat,
+            "age_s": round(max(0.0, now - self.created), 1),
+            "video_active": self.video_active,
+            "client_fps": self.client_fps(),
+            "ack_rtt_ms": round(self.ack.effective_rtt_ms(now), 3),
+            "frames_sent": self.frames_sent,
+            "dropped_frames": int(relay.get("dropped_frames", 0) or 0),
+            "drop_rate": round(self.drop_rate(relay=relay, cc=cc), 4),
+            "qoe_score": self.score(now),
+        }
+        if verbose:
+            doc["raddr"] = self.raddr
+            doc["ack"] = {**self.ack.percentiles(),
+                          "ewma_ms": (round(self.ack.ewma_ms, 3)
+                                      if self.ack.ewma_ms is not None
+                                      else None),
+                          "pending": self.ack.pending,
+                          "oldest_pending_ms": round(
+                              self.ack.oldest_pending_ms(now), 1),
+                          "acked": self.ack.acked}
+            doc["chunks_sent"] = self.chunks_sent
+            doc["backpressure"] = {
+                "windows": self.bp_windows,
+                "total_s": round(self.bp_total_s, 3),
+                "active": self._bp_since is not None,
+            }
+            doc["stalls"] = self.stalls
+            if relay:
+                doc["relay"] = relay
+            if cc:
+                doc["cc"] = cc
+        elif self.kind == "webrtc" and cc:
+            doc["cc"] = {k: cc.get(k) for k in
+                         ("target_bps", "acked_bps", "detector_state",
+                          "loss_fraction", "rtt_ms")}
+        return doc
+
+
+class QoERegistry:
+    """Process-wide per-session stats set (the ``/api/sessions``
+    backend). Same singleton pattern as :data:`.health.engine` — one
+    instance (:data:`registry`) serves every transport; tests build
+    their own."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sessions: "collections.OrderedDict[tuple, SessionStats]" = \
+            collections.OrderedDict()
+        self.seat_label_cap = DEFAULT_SEAT_LABEL_CAP
+        self.degraded_score = DEGRADED_SCORE
+        self.failed_score = FAILED_SCORE
+        #: closed backpressure windows for the trace overlay:
+        #: (seat, sid, t0_ns, dur_ns), bounded
+        self._bp_ring: collections.deque = collections.deque(maxlen=256)
+        self._collector_hooked = False
+        #: qoe_collapse incident sink; None = the process engine's
+        #: flight recorder (tests/selftests inject their own)
+        self.recorder: Optional[_health.FlightRecorder] = None
+
+    def configure(self, seat_label_cap: Optional[int] = None,
+                  degraded_score: Optional[float] = None,
+                  failed_score: Optional[float] = None) -> None:
+        if seat_label_cap is not None:
+            self.seat_label_cap = max(0, int(seat_label_cap))
+        if degraded_score is not None:
+            self.degraded_score = float(degraded_score)
+        if failed_score is not None:
+            self.failed_score = float(failed_score)
+
+    # -- membership ---------------------------------------------------------
+    def register(self, kind: str, seat: str, sid, raddr: str = "",
+                 now: Optional[float] = None) -> SessionStats:
+        st = SessionStats(sid, kind, seat, raddr=raddr, now=now,
+                          registry=self)
+        with self._lock:
+            self._sessions[(st.kind, st.sid)] = st
+        self._hook_collector()
+        return st
+
+    def unregister(self, st: Optional[SessionStats]) -> None:
+        if st is None:
+            return
+        with self._lock:
+            self._sessions.pop((st.kind, st.sid), None)
+
+    def sessions(self) -> list[SessionStats]:
+        with self._lock:
+            return list(self._sessions.values())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._sessions.clear()
+            self._bp_ring.clear()
+
+    # -- reporting ----------------------------------------------------------
+    def report(self, verbose: bool = False,
+               now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        snaps = [st.snapshot(now=now, verbose=verbose)
+                 for st in self.sessions()]
+        scores = [s["qoe_score"] for s in snaps
+                  if s.get("qoe_score") is not None]
+        return {
+            "count": len(snaps),
+            "worst_score": min(scores) if scores else None,
+            "sessions": snaps,
+        }
+
+    def health_check(self) -> "_health.Verdict":
+        """The ``qoe`` check: worst live session score vs thresholds.
+        A session crossing below ``failed_score`` records ONE
+        ``qoe_collapse`` incident (edge-triggered; it re-arms once the
+        session recovers above ``degraded_score``)."""
+        now = time.monotonic()
+        scored = [(st, st.score(now)) for st in self.sessions()]
+        scored = [(st, s) for st, s in scored if s is not None]
+        if not scored:
+            return _health.ok("no active sessions")
+        rec = self.recorder if self.recorder is not None \
+            else _health.engine.recorder
+        for st, s in scored:
+            if s < self.failed_score and not st._collapsed:
+                st._collapsed = True
+                rec.record(
+                    "qoe_collapse", transport=st.kind, sid=st.sid,
+                    seat=st.seat,
+                    score=s, rtt_ms=round(st.rtt_ms(now), 1),
+                    drop_rate=round(st.drop_rate(), 4),
+                    client_fps=st.client_fps())
+            elif s >= self.degraded_score:
+                st._collapsed = False
+        worst_st, worst = min(scored, key=lambda kv: kv[1])
+        msg = (f"worst session {worst_st.seat}#{worst_st.sid} "
+               f"({worst_st.kind}): score {worst}")
+        data = {"worst_score": worst, "sessions": len(scored),
+                "seat": worst_st.seat, "sid": worst_st.sid}
+        if worst < self.failed_score:
+            return _health.failed(msg, **data)
+        if worst < self.degraded_score:
+            return _health.degraded(msg, **data)
+        return _health.ok(msg, **data)
+
+    # -- trace overlay ------------------------------------------------------
+    def _note_bp_window(self, seat: str, sid: int, t0_ns: int,
+                        dur_ns: int) -> None:
+        self._bp_ring.append((seat, sid, t0_ns, dur_ns))
+
+    def trace_events(self, pid: int = 1, tid: int = 98) -> list[dict]:
+        """Backpressure windows as Chrome trace events on a ``qoe``
+        lane, mergeable into the ``/api/trace`` document (same
+        perf_counter µs timebase as the frame and device lanes)."""
+        ring = list(self._bp_ring)
+        if not ring:
+            return []
+        events: list[dict] = [{
+            "ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+            "args": {"name": "qoe"},
+        }]
+        for seat, sid, t0_ns, dur_ns in ring:
+            events.append({
+                "name": f"backpressure {seat}#{sid}",
+                "ph": "X", "pid": pid, "tid": tid,
+                "ts": t0_ns / 1e3, "dur": max(dur_ns, 1) / 1e3,
+                "args": {"seat": seat, "sid": sid},
+            })
+        return events
+
+    # -- metrics (lazy; lint image has no server plane) ----------------------
+    def _hook_collector(self) -> None:
+        if self._collector_hooked or self is not globals().get("registry"):
+            # only the process-wide singleton exports to Prometheus —
+            # throwaway test registries must not pile up collectors
+            return
+        try:
+            from ..server import metrics
+        except Exception:
+            return
+        self._collector_hooked = True
+        metrics.describe("selkies_session_qoe_score",
+                         "Per-session composite QoE score (0-100)")
+        metrics.describe("selkies_session_ack_rtt_ewma_ms",
+                         "Per-session EWMA ACK round-trip (ms)")
+        metrics.describe("selkies_session_client_fps",
+                         "Per-session client-reported display fps")
+        metrics.describe("selkies_session_sent_bytes_total",
+                         "Per-session media bytes handed to the wire")
+        metrics.describe("selkies_session_dropped_frames_total",
+                         "Per-session frames dropped by the relay budget")
+        metrics.describe("selkies_session_backpressure_seconds_total",
+                         "Per-session time spent backpressured")
+        metrics.describe("selkies_sessions",
+                         "Live streaming sessions by transport kind")
+        metrics.describe("selkies_qoe_worst_score",
+                         "Worst live session QoE score")
+        metrics.register_collector(self._export_metrics)
+
+    def _export_metrics(self) -> None:
+        """Scrape-time collector: re-exports the per-session series
+        fresh (stale sessions vanish instead of flat-lining) with
+        **bounded cardinality** — the first ``seat_label_cap`` sessions
+        (oldest first, stable across scrapes) get their own
+        ``{seat,sid}`` series; the rest aggregate into
+        ``{seat="_overflow",sid="_"}``."""
+        try:
+            from ..server import metrics
+        except Exception:
+            return
+        sessions = self.sessions()
+        now = time.monotonic()
+        per_metric = ("selkies_session_qoe_score",
+                      "selkies_session_ack_rtt_ewma_ms",
+                      "selkies_session_client_fps",
+                      "selkies_session_sent_bytes_total",
+                      "selkies_session_dropped_frames_total",
+                      "selkies_session_backpressure_seconds_total")
+        for name in per_metric:
+            metrics.clear_metric(name)
+        by_kind: dict[str, int] = {}
+        worst: Optional[float] = None
+        overflow = {"sent_bytes": 0.0, "dropped": 0.0, "bp_s": 0.0,
+                    "count": 0}
+        for i, st in enumerate(sessions):
+            by_kind[st.kind] = by_kind.get(st.kind, 0) + 1
+            relay = st._pull(st.relay_provider)
+            score = st.score(now)
+            if score is not None:
+                worst = score if worst is None else min(worst, score)
+            if i < self.seat_label_cap:
+                labels = {"seat": st.seat, "sid": str(st.sid)}
+                if score is not None:
+                    metrics.set_gauge("selkies_session_qoe_score", score,
+                                      labels)
+                if st.ack.ewma_ms is not None:
+                    metrics.set_gauge("selkies_session_ack_rtt_ewma_ms",
+                                      round(st.ack.ewma_ms, 3), labels)
+                fps = st.client_fps()
+                if fps is not None:
+                    metrics.set_gauge("selkies_session_client_fps", fps,
+                                      labels)
+                metrics.set_gauge("selkies_session_sent_bytes_total",
+                                  float(relay.get("sent_bytes", 0) or 0),
+                                  labels)
+                metrics.set_gauge("selkies_session_dropped_frames_total",
+                                  float(relay.get("dropped_frames", 0)
+                                        or 0), labels)
+                metrics.set_gauge(
+                    "selkies_session_backpressure_seconds_total",
+                    round(st.bp_total_s, 3), labels)
+            else:
+                overflow["count"] += 1
+                overflow["sent_bytes"] += float(
+                    relay.get("sent_bytes", 0) or 0)
+                overflow["dropped"] += float(
+                    relay.get("dropped_frames", 0) or 0)
+                overflow["bp_s"] += st.bp_total_s
+        if overflow["count"]:
+            labels = {"seat": "_overflow", "sid": "_"}
+            metrics.set_gauge("selkies_session_sent_bytes_total",
+                              overflow["sent_bytes"], labels)
+            metrics.set_gauge("selkies_session_dropped_frames_total",
+                              overflow["dropped"], labels)
+            metrics.set_gauge(
+                "selkies_session_backpressure_seconds_total",
+                round(overflow["bp_s"], 3), labels)
+        metrics.clear_metric("selkies_sessions")
+        for kind, n in by_kind.items():
+            metrics.set_gauge("selkies_sessions", n, {"kind": kind})
+        if worst is not None:
+            metrics.set_gauge("selkies_qoe_worst_score", worst)
+        else:
+            metrics.clear_metric("selkies_qoe_worst_score")
+
+
+_rtt_hist_described = False
+
+
+def _metrics_rtt(rtt_ms: float) -> None:
+    """ACK RTT histogram (per-ack). Lazy + guarded like the health
+    bridge; declares the sub-ms..seconds bucket ladder the default
+    1..240 fps/ms ladder would collapse — once, this runs per ACK."""
+    global _rtt_hist_described
+    try:
+        from ..server import metrics
+    except Exception:
+        return
+    if not _rtt_hist_described:
+        _rtt_hist_described = True
+        metrics.describe("selkies_session_ack_rtt_ms",
+                         "ACK round-trip time across sessions (ms)",
+                         buckets=(0.5, 1, 2, 5, 10, 20, 50, 100, 250, 500,
+                                  1000, 2000, 5000))
+    metrics.observe_hist("selkies_session_ack_rtt_ms", rtt_ms)
+
+
+#: the process-wide registry every transport registers sessions against
+registry = QoERegistry()

@@ -43,6 +43,7 @@ from urllib.parse import urlparse
 from aiohttp import web
 
 from ..obs import health as _health
+from ..obs import qoe as _qoe
 from ..settings import AppSettings, is_sensitive
 
 logger = logging.getLogger("selkies_tpu.server.core")
@@ -88,6 +89,17 @@ class CentralizedStreamServer:
         self.health = _health.engine
         self.health.register("service", self._check_service, liveness=True)
         self.health.register("stage_latency", self._check_stage_latency)
+        # per-session wire QoE (obs.qoe): registered here — not in a
+        # transport — so the check exists whichever mode is active.
+        # Per-instance wrapper: bound methods of the registry singleton
+        # compare equal across server instances, which would defeat the
+        # owner-matched unregister in shutdown()
+        _qoe.registry.configure(
+            seat_label_cap=getattr(settings, "qoe_seat_label_cap", None),
+            degraded_score=getattr(settings, "qoe_degraded_score", None),
+            failed_score=getattr(settings, "qoe_failed_score", None))
+        self._check_qoe = lambda: _qoe.registry.health_check()
+        self.health.register("qoe", self._check_qoe)
         self._setup_routes()
 
     # ------------------------------------------------------------------ auth
@@ -153,6 +165,7 @@ class CentralizedStreamServer:
         r.add_post("/api/switch", self.handle_switch)
         r.add_get("/api/trace", self.handle_trace)
         r.add_post("/api/trace", self.handle_trace_control)
+        r.add_get("/api/sessions", self.handle_sessions)
         r.add_post("/api/profile", self.handle_profile)
         if self.settings.secure_api:
             r.add_post("/api/tokens", self.handle_mint_token)
@@ -281,6 +294,17 @@ class CentralizedStreamServer:
         return web.json_response(res,
                                  status=200 if res.get("ok", True) else 409)
 
+    async def handle_sessions(self, request: web.Request) -> web.Response:
+        """Per-session wire QoE (the ``getStats()`` analog): summary
+        list by default, ``?verbose=1`` for the full per-session detail
+        (ACK percentiles, backpressure windows, relay counters, CC
+        internals). Full-role gated like the other operator surfaces —
+        it carries peer addresses and per-client wire state."""
+        if request["role"] != "full":
+            return web.Response(status=403, text="view-only")
+        verbose = request.query.get("verbose") in ("1", "true")
+        return web.json_response(_qoe.registry.report(verbose=verbose))
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
         from .metrics import render_prometheus
         return web.Response(text=render_prometheus(),
@@ -299,6 +323,9 @@ class CentralizedStreamServer:
         # so a Perfetto view shows "recompile happened HERE" against the
         # frame timeline (same perf_counter timebase)
         doc["traceEvents"].extend(monitor.trace_events())
+        # qoe-lane overlay: backpressure windows against the frame
+        # timeline, so a Perfetto view shows WHEN a seat was paused
+        doc["traceEvents"].extend(_qoe.registry.trace_events())
         doc["otherData"] = tracer.stats(frames=len(snap))
         doc["otherData"]["compile"] = monitor.compile_stats()
         return web.json_response(doc)
@@ -595,6 +622,7 @@ class CentralizedStreamServer:
         # these names; only OUR closures are removed
         self.health.unregister("service", self._check_service)
         self.health.unregister("stage_latency", self._check_stage_latency)
+        self.health.unregister("qoe", self._check_qoe)
         if self._cert_watch_task:
             self._cert_watch_task.cancel()
         if self.active_mode and self.active_mode in self.services:

@@ -9,10 +9,13 @@ fps_hist parity case.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import defaultdict
 from typing import Iterable
+
+logger = logging.getLogger("selkies_tpu.server.metrics")
 
 _lock = threading.Lock()
 _gauges: dict[tuple[str, tuple], float] = {}
@@ -20,14 +23,42 @@ _counters: dict[tuple[str, tuple], float] = defaultdict(float)
 _helps: dict[str, str] = {}
 _hist_buckets = (1, 5, 10, 15, 20, 30, 45, 60, 90, 120, 240)
 _hists: dict[tuple[str, tuple], list] = {}
+#: per-metric bucket ladders: declared via ``describe(buckets=...)``,
+#: pinned per metric at first observation (a ladder change mid-series
+#: would corrupt the cumulative rendering contract)
+_bucket_overrides: dict[str, tuple] = {}
+_hist_ladders: dict[str, tuple] = {}
+#: scrape-time collectors: called (outside the lock) by
+#: :func:`render_prometheus` so pull-model planes (per-session QoE)
+#: export fresh gauges at scrape time without owning a write cadence
+_collectors: list = []
 
 
 def _key(name: str, labels: dict | None) -> tuple[str, tuple]:
     return name, tuple(sorted((labels or {}).items()))
 
 
-def describe(name: str, help_text: str) -> None:
+def describe(name: str, help_text: str,
+             buckets: Iterable | None = None) -> None:
+    """Register help text; ``buckets`` optionally overrides the global
+    histogram ladder for this metric (must be declared before the first
+    ``observe_hist`` — the ladder pins then and stays pinned)."""
     _helps[name] = help_text
+    if buckets is not None:
+        _bucket_overrides[name] = tuple(sorted(float(b) for b in buckets))
+
+
+def register_collector(fn) -> None:
+    """Add a zero-arg callable run at every render (idempotent)."""
+    if fn not in _collectors:
+        _collectors.append(fn)
+
+
+def unregister_collector(fn) -> None:
+    try:
+        _collectors.remove(fn)
+    except ValueError:
+        pass
 
 
 def set_gauge(name: str, value: float, labels: dict | None = None) -> None:
@@ -35,19 +66,39 @@ def set_gauge(name: str, value: float, labels: dict | None = None) -> None:
         _gauges[_key(name, labels)] = float(value)
 
 
+def clear_metric(name: str) -> None:
+    """Drop every sample of one metric (all label sets). Collectors use
+    this to re-export live-membership gauges so departed sessions
+    vanish instead of flat-lining at their last value."""
+    with _lock:
+        for store in (_gauges, _counters, _hists):
+            for k in [k for k in store if k[0] == name]:
+                del store[k]
+        _hist_ladders.pop(name, None)
+
+
 def inc_counter(name: str, value: float = 1.0, labels: dict | None = None) -> None:
     with _lock:
         _counters[_key(name, labels)] += value
 
 
+def _ladder(name: str) -> tuple:
+    lad = _hist_ladders.get(name)
+    if lad is None:
+        lad = _hist_ladders[name] = _bucket_overrides.get(name,
+                                                         _hist_buckets)
+    return lad
+
+
 def observe_hist(name: str, value: float, labels: dict | None = None) -> None:
     with _lock:
+        buckets = _ladder(name)
         k = _key(name, labels)
-        h = _hists.setdefault(k, [0] * (len(_hist_buckets) + 1) + [0.0, 0])
-        for i, b in enumerate(_hist_buckets):
+        h = _hists.setdefault(k, [0] * (len(buckets) + 1) + [0.0, 0])
+        for i, b in enumerate(buckets):
             if value <= b:
                 h[i] += 1
-        h[len(_hist_buckets)] += 1          # +Inf
+        h[len(buckets)] += 1                # +Inf
         h[-2] += value                      # sum
         h[-1] += 1                          # count
 
@@ -57,6 +108,7 @@ def clear() -> None:
         _gauges.clear()
         _counters.clear()
         _hists.clear()
+        _hist_ladders.clear()
 
 
 def _escape_label_value(v) -> str:
@@ -75,6 +127,13 @@ def _fmt_labels(labels: tuple, extra: str = "") -> str:
 
 
 def render_prometheus() -> str:
+    # collectors first, OUTSIDE the lock (they call set_gauge themselves);
+    # a crashing collector must never take the scrape down with it
+    for fn in list(_collectors):
+        try:
+            fn()
+        except Exception:
+            logger.debug("metrics collector %r failed", fn, exc_info=True)
     out: list[str] = []
     with _lock:
         seen: set[str] = set()
@@ -94,12 +153,14 @@ def render_prometheus() -> str:
             out.append(f"{name}{_fmt_labels(labels)} {v}")
         for (name, labels), h in sorted(_hists.items()):
             emit_help(name, "histogram")
-            for i, b in enumerate(_hist_buckets):
-                le = f'le="{b}"'
+            buckets = _ladder(name)
+            for i, b in enumerate(buckets):
+                b_txt = int(b) if float(b).is_integer() else b
+                le = f'le="{b_txt}"'
                 out.append(f"{name}_bucket{_fmt_labels(labels, le)} {h[i]}")
             inf = 'le="+Inf"'
             out.append(f"{name}_bucket{_fmt_labels(labels, inf)} "
-                       f"{h[len(_hist_buckets)]}")
+                       f"{h[len(buckets)]}")
             out.append(f"{name}_sum{_fmt_labels(labels)} {h[-2]}")
             out.append(f"{name}_count{_fmt_labels(labels)} {h[-1]}")
     return "\n".join(out) + "\n"

@@ -41,6 +41,10 @@ RELAY_FLOOR_BYTES = 4 * 1024 * 1024
 metrics.describe("selkies_relay_deaths_total",
                  "Relays marked dead (stalled/failed media sends)")
 metrics.describe("selkies_relay_alive", "Currently-alive video relays")
+metrics.describe("selkies_relay_sent_bytes_total",
+                 "Media bytes sent per display across relays")
+metrics.describe("selkies_relay_dropped_frames_total",
+                 "Frames dropped by relay byte budgets per display")
 
 # alive-relay accounting: counted at start(), released exactly once at
 # death or close (whichever comes first)
@@ -105,6 +109,23 @@ class VideoRelay:
         (callers must not peek at queue internals)."""
         return self._q_bytes == 0
 
+    @property
+    def queue_depth(self) -> int:
+        return len(self._q)
+
+    @property
+    def queued_bytes(self) -> int:
+        return self._q_bytes
+
+    def counters(self) -> dict:
+        """Wire counters for the per-session QoE snapshot (the numbers
+        the debug snapshot used to keep to itself)."""
+        return {"sent_bytes": self.sent_bytes,
+                "dropped_frames": self.dropped_frames,
+                "queue_depth": len(self._q),
+                "queued_bytes": self._q_bytes,
+                "dead": self.dead}
+
     def offer(self, item: bytes) -> None:
         """Synchronous enqueue. NEVER awaits (fan-out contract)."""
         if self.dead:
@@ -123,6 +144,8 @@ class VideoRelay:
             victim = self._q.popleft()
             self._q_bytes -= len(victim)
             self.dropped_frames += 1
+            metrics.inc_counter("selkies_relay_dropped_frames_total",
+                                labels={"display": self.display or "?"})
             if victim and victim[0] == OP_H264:
                 _, _, y, _, _ = unpack_h264_header(victim)
                 self._row_open[y] = False   # chain broken for that row
@@ -156,6 +179,10 @@ class VideoRelay:
                                 self.display, fid, "ws.send", t0,
                                 time.perf_counter_ns() - t0, lane="ws")
                     self.sent_bytes += len(item)
+                    metrics.inc_counter("selkies_relay_sent_bytes_total",
+                                        len(item),
+                                        labels={"display":
+                                                self.display or "?"})
                 except (asyncio.TimeoutError, ConnectionError, OSError):
                     # cancelled mid-send = possibly torn frame; this socket
                     # must never carry media again.

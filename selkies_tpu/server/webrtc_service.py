@@ -27,6 +27,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from ..obs import qoe as _qoe
 from ..settings import AppSettings
 from .core import BaseStreamingService
 from .signaling import SignalingServer
@@ -62,6 +63,8 @@ class _Session:
         self.caller_uid = caller_uid
         self.peer = peer
         self.display_id = display_id
+        #: per-session QoE stats (obs.qoe), set at session start
+        self.qoe = None
         #: per-session Opus decoder for the browser-mic stream — Opus
         #: decode is STATEFUL (prediction/PLC carry across frames), so
         #: two peers' interleaved packets through one decoder would
@@ -141,6 +144,7 @@ class WebRTCService(BaseStreamingService):
             self._sig_task = None
         for s in list(self._sessions.values()):
             s.peer.close()
+            _qoe.registry.unregister(s.qoe)
         self._sessions.clear()
         self._stop_captures()
         # stop() IS the cross-service boundary (/api/switch): the next
@@ -236,7 +240,13 @@ class WebRTCService(BaseStreamingService):
         if with_audio and self.audio.on_raw_frame is None:
             self.audio.on_raw_frame = self._on_audio_frame
         await peer.listen()
-        self._sessions[caller_uid] = _Session(caller_uid, peer, display_id)
+        sess = _Session(caller_uid, peer, display_id)
+        # wire QoE: the peer's stats() snapshots the congestion
+        # controller + packetizer counters (GET /api/sessions)
+        sess.qoe = _qoe.registry.register("webrtc", display_id, caller_uid)
+        sess.qoe.cc_provider = peer.stats
+        sess.qoe.target_fps = lambda: float(self.settings.framerate)
+        self._sessions[caller_uid] = sess
         await self._ensure_capture(display_id)
         offer = peer.create_offer()
         await self._local_peer.send("MSG {} {}".format(
@@ -268,6 +278,7 @@ class WebRTCService(BaseStreamingService):
         sess = self._sessions.pop(caller_uid, None)
         if sess is not None:
             sess.peer.close()
+            _qoe.registry.unregister(sess.qoe)
             logger.info("webrtc session %s closed", caller_uid)
         # reap captures with no remaining viewers, display by display
         viewed = {s.display_id for s in self._sessions.values()}

@@ -33,6 +33,8 @@ from .. import protocol as P
 from ..engine import CaptureSettings, ScreenCapture
 from ..engine.types import EncodedChunk
 from ..obs import health as _health
+from ..obs import logctx as _logctx
+from ..obs import qoe as _qoe
 from ..settings import AppSettings, SettingsError
 from ..taskutil import spawn_retained
 from ..trace import tracer as _tracer
@@ -45,6 +47,10 @@ logger = logging.getLogger("selkies_tpu.server.ws")
 ACK_STALL_S = 4.0
 RECONNECT_DEBOUNCE_S = 0.5
 CONTROL_SEND_TIMEOUT_S = 2.0  # reference 2 s control bound (selkies.py:79-101)
+#: backpressure logging: one INFO line per window, and windows that
+#: start within this many seconds of the last logged one are summarised
+#: (count carried on the next INFO line) instead of flooding the log
+BACKPRESSURE_LOG_EVERY_S = 5.0
 
 
 class _FpsEstimator:
@@ -66,6 +72,27 @@ class _FpsEstimator:
             return 60.0
         span = self._times[-1] - self._times[0]
         return (len(self._times) - 1) / span if span > 0 else 60.0
+
+    @property
+    def has_samples(self) -> bool:
+        """True once the estimate is measured rather than the 60 fps
+        default (the QoE snapshot must not report a guess as data)."""
+        return len(self._times) >= 2
+
+
+def _relay_counters(relays: dict) -> dict:
+    """Summed wire counters across a client's relays — the QoE
+    session's pull-based relay provider."""
+    out = {"sent_bytes": 0, "dropped_frames": 0, "queue_depth": 0,
+           "queued_bytes": 0, "relays": len(relays), "dead": 0}
+    for r in relays.values():
+        c = r.counters()
+        out["sent_bytes"] += c["sent_bytes"]
+        out["dropped_frames"] += c["dropped_frames"]
+        out["queue_depth"] += c["queue_depth"]
+        out["queued_bytes"] += c["queued_bytes"]
+        out["dead"] += c["dead"]
+    return out
 
 
 class ClientConnection:
@@ -90,6 +117,12 @@ class ClientConnection:
         self.fps_est = _FpsEstimator()
         self.reported_fps = 0.0
         self.reported_latency_ms = 0.0
+        #: per-session QoE stats (obs.qoe), set by the service at accept
+        self.qoe = None
+        # backpressure log rate limiting (one INFO per window, flapping
+        # windows summarised)
+        self._bp_last_log = 0.0
+        self._bp_suppressed = 0
 
     async def send_text_maybe_gz(self, text: str) -> None:
         if self.gzip_ok:
@@ -627,6 +660,7 @@ class WebSocketsService(BaseStreamingService):
             # buffered on the loop (cheap append), flushed to disk from an
             # executor — a slow disk must never pace the fan-out
             self._rec_buf += chunk.payload
+        now_m = time.monotonic()
         for c in self.clients.values():
             if not c.video_active or c.paused:
                 continue
@@ -635,6 +669,8 @@ class WebSocketsService(BaseStreamingService):
                 continue
             c.last_sent_id = chunk.frame_id
             relay.offer(frame)
+            if c.qoe is not None:
+                c.qoe.note_sent(chunk.frame_id, now_m)
 
     async def _broadcast_control(self, text: str) -> None:
         """Bounded CONCURRENT broadcast: one stalled client must never pace
@@ -715,6 +751,19 @@ class WebSocketsService(BaseStreamingService):
             if any(c.role == "full" for c in self.clients.values()):
                 client.role = "viewonly"
         self.clients[client.id] = client
+        # per-session QoE stats: wire counters pull from the client's
+        # live relays, fps prefers the client's own report
+        client.qoe = _qoe.registry.register("ws", client.display,
+                                            client.id, raddr=raddr)
+        client.qoe.fps_provider = (
+            lambda c=client: c.fps_est.fps() if c.fps_est.has_samples
+            else None)
+        client.qoe.target_fps = lambda: float(self.settings.framerate)
+        client.qoe.relay_provider = \
+            lambda c=client: _relay_counters(c.relays)
+        # log correlation: selkies_tpu.* records emitted while handling
+        # this connection carry its session/seat id (obs.logctx filter)
+        _logctx.bind(client.id, client.display)
         metrics.set_gauge("selkies_clients", len(self.clients))
         logger.info("client %d connected (%s, %s)", client.id, client.role, raddr)
         if len(self.clients) == 1 and self.settings.run_after_connect:
@@ -739,6 +788,7 @@ class WebSocketsService(BaseStreamingService):
 
     async def _disconnect(self, client: ClientConnection) -> None:
         self.clients.pop(client.id, None)
+        _qoe.registry.unregister(client.qoe)
         for relay in client.relays.values():
             await relay.close()
         client.relays.clear()
@@ -917,6 +967,8 @@ class WebSocketsService(BaseStreamingService):
         client.last_ack_id = acked
         client.last_ack_time = now
         client.fps_est.tick(now)
+        if client.qoe is not None:
+            client.qoe.note_ack(acked, now)
         if _tracer.enabled:
             # close the glass-to-glass loop on the frame's timeline
             _tracer.instant(client.display, acked, "ack", lane="ws")
@@ -932,8 +984,22 @@ class WebSocketsService(BaseStreamingService):
         if not client.paused and dist > window:
             client.paused = True
             metrics.inc_counter("selkies_backpressure_events_total")
-            logger.info("client %d backpressured (dist %d > %d)",
-                        client.id, dist, window)
+            now = time.monotonic()
+            if client.qoe is not None:
+                client.qoe.backpressure_begin(now)
+            # one INFO line per window; flapping windows within the
+            # rate-limit interval are summarised, never one-per-frame
+            if now - client._bp_last_log >= BACKPRESSURE_LOG_EVERY_S:
+                suffix = (f" ({client._bp_suppressed} windows suppressed)"
+                          if client._bp_suppressed else "")
+                logger.info("client %d backpressured (dist %d > %d)%s",
+                            client.id, dist, window, suffix)
+                client._bp_last_log = now
+                client._bp_suppressed = 0
+            else:
+                client._bp_suppressed += 1
+                logger.debug("client %d backpressured (dist %d > %d)",
+                             client.id, dist, window)
         elif client.paused:
             # Resume when the client caught up with everything queued — the
             # relay drained (dropped frames never get ACKed, so distance to
@@ -941,12 +1007,19 @@ class WebSocketsService(BaseStreamingService):
             drained = all(r.drained() for r in client.relays.values())
             if dist < window // 2 or drained:
                 client.paused = False
+                if client.qoe is not None:
+                    dur = client.qoe.backpressure_end(time.monotonic())
+                    if dur is not None:
+                        logger.debug("client %d backpressure window "
+                                     "closed after %.3fs", client.id, dur)
                 # refresh only the displays this client actually views
                 for did in client.relays:
                     self._request_idr(did)
 
     async def _h_start_video(self, client: ClientConnection, args: str) -> None:
         client.video_active = True
+        if client.qoe is not None:
+            client.qoe.video_active = True
         # each client views ONE display (its ?display= pin); multi-seat
         # clients on different seats share the single sharded capture
         did = client.display
@@ -967,6 +1040,8 @@ class WebSocketsService(BaseStreamingService):
 
     async def _h_stop_video(self, client: ClientConnection, args: str) -> None:
         client.video_active = False
+        if client.qoe is not None:
+            client.qoe.video_active = False
         for relay in client.relays.values():
             await relay.close()
         client.relays.clear()
@@ -1081,6 +1156,8 @@ class WebSocketsService(BaseStreamingService):
     async def _h_client_fps(self, client: ClientConnection, args: str) -> None:
         try:
             client.reported_fps = float(args)
+            if client.qoe is not None:
+                client.qoe.reported_fps = client.reported_fps
             metrics.set_gauge("selkies_fps", client.reported_fps,
                               {"client": str(client.id)})
             metrics.observe_hist("selkies_fps_hist", client.reported_fps)
@@ -1112,6 +1189,9 @@ class WebSocketsService(BaseStreamingService):
                         and c.last_ack_time < stalled:
                     c.paused = True
                     metrics.inc_counter("selkies_backpressure_events_total")
+                    if c.qoe is not None:
+                        c.qoe.note_stall()
+                        c.qoe.backpressure_begin(time.monotonic())
                     _health.engine.recorder.record(
                         "ack_stall", client=c.id, display=c.display,
                         last_sent=c.last_sent_id, last_ack=c.last_ack_id)

@@ -323,6 +323,20 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
     _s("profile_dir", SType.STR, "",
        "Default output dir for POST /api/profile jax.profiler captures "
        "(empty: a fresh selkies-profile-* tempdir per capture)."),
+    _s("qoe_seat_label_cap", SType.INT, 8,
+       "Per-session Prometheus series cap (selkies_session_*): the first "
+       "N sessions keep their own {seat,sid} labels, the rest roll up "
+       "into the seat=\"_overflow\" aggregate.", vmin=0, vmax=256),
+    _s("qoe_degraded_score", SType.FLOAT, 50.0,
+       "The qoe health check degrades when any session's composite score "
+       "falls below this.", vmin=0, vmax=100),
+    _s("qoe_failed_score", SType.FLOAT, 15.0,
+       "The qoe health check fails below this score and records a "
+       "qoe_collapse incident in the flight recorder.", vmin=0, vmax=100),
+    _s("log_format", SType.ENUM, "plain",
+       "Log output: 'plain' (human) or 'json' (one structured object per "
+       "line, carrying the session/seat correlation fields).",
+       choices=("plain", "json")),
 )
 
 _DEFS_BY_NAME: dict[str, Setting] = {d.name: d for d in SETTING_DEFINITIONS}

@@ -194,6 +194,11 @@ class TrendlineEstimator:
         self._prev_trend = 0.0
         self.state = "normal"
 
+    @property
+    def threshold(self) -> float:
+        """Current adaptive overuse threshold (stats surface)."""
+        return self._threshold
+
     def add_packet(self, send_us: int, arrival_us: int) -> None:
         if self._cur_send is None or send_us - self._cur_send > _BURST_US:
             if self._cur_send is not None:
@@ -299,6 +304,11 @@ class AimdRateControl:
         self._last_decrease_bps = None
         self._last_update_us = None
 
+    @property
+    def state(self) -> str:
+        """'increase' | 'hold' (stats surface)."""
+        return self._state
+
     def update(self, detector_state: str, acked_bps: float | None,
                now_us: int) -> float:
         dt = 0.0
@@ -400,6 +410,11 @@ class SendSideCongestionController:
         self._evicted_lost = 0
         self.target_bps = start_bps
         self.last_loss_fraction = 0.0
+        #: TWCC round-trip: feedback arrival minus the newest acked
+        #: packet's send time (the standard send-side RTT proxy —
+        #: older packets in the batch include feedback batching delay)
+        self.last_rtt_ms: float | None = None
+        self.srtt_ms: float | None = None     # RFC6298-style 1/8 EWMA
 
     # -- sender side --------------------------------------------------------
     def alloc_seq(self) -> int:
@@ -423,6 +438,7 @@ class SendSideCongestionController:
     def on_feedback(self, fb: TwccFeedback, now_us: int) -> float:
         received = 0
         lost = 0
+        newest_send_us = None
         for seq, rx_us in fb.packets:
             if rx_us is None:
                 # provisional: a later feedback often re-reports the same
@@ -436,6 +452,8 @@ class SendSideCongestionController:
                 continue
             send_us, size = sent
             received += 1
+            if newest_send_us is None or send_us > newest_send_us:
+                newest_send_us = send_us
             self._acked.add(rx_us, size)
             if send_us >= self._max_send_fed:
                 self._max_send_fed = send_us
@@ -457,12 +475,40 @@ class SendSideCongestionController:
         w_lost = sum(s[2] for s in self._loss_window)
         if w_recv + w_lost:
             self.last_loss_fraction = w_lost / (w_recv + w_lost)
+        if newest_send_us is not None and now_us >= newest_send_us:
+            rtt = (now_us - newest_send_us) / 1000.0
+            self.last_rtt_ms = rtt
+            self.srtt_ms = rtt if self.srtt_ms is None \
+                else self.srtt_ms + 0.125 * (rtt - self.srtt_ms)
         delay_rate = self._aimd.update(self._trend.state,
                                        self._acked.bps(), now_us)
         loss_cap = self._loss.update(self.last_loss_fraction, now_us)
         self.target_bps = max(self._aimd.min_bps,
                               min(delay_rate, loss_cap))
         return self.target_bps
+
+    def stats(self) -> dict:
+        """Coherent snapshot of the controller's internals — the
+        ``getStats()`` surface the per-session QoE plane
+        (:mod:`...obs.qoe`) and ``GET /api/sessions`` expose. Plain
+        data, safe to call from any thread between feedback batches."""
+        return {
+            "target_bps": round(self.target_bps, 1),
+            "acked_bps": (round(b, 1)
+                          if (b := self._acked.bps()) is not None else None),
+            "detector_state": self._trend.state,
+            "trend_threshold": round(self._trend.threshold, 3),
+            "aimd_state": self._aimd.state,
+            "aimd_rate_bps": round(self._aimd.rate, 1),
+            "loss_fraction": round(self.last_loss_fraction, 4),
+            "loss_cap_bps": round(self._loss.cap, 1),
+            "rtt_ms": (round(self.srtt_ms, 3)
+                       if self.srtt_ms is not None else None),
+            "last_rtt_ms": (round(self.last_rtt_ms, 3)
+                            if self.last_rtt_ms is not None else None),
+            "in_flight": len(self._sent),
+            "provisional_missing": len(self._missing),
+        }
 
     def on_rtcp(self, rtcp: bytes, now_us: int) -> float | None:
         """Feed a full (decrypted) RTCP packet; returns the new target

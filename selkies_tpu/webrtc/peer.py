@@ -402,6 +402,17 @@ class RTCPeer(asyncio.DatagramProtocol):
                                    int(time.monotonic() * 1e6))
         return 1
 
+    def stats(self) -> dict:
+        """Wire-side snapshot for the per-session QoE plane: congestion
+        controller internals (:meth:`~.cc.SendSideCongestionController.
+        stats`) plus packetizer counters and connection state."""
+        d = self.cc.stats()
+        d["connected"] = self.connected.is_set()
+        d["via_turn"] = self._peer_via_turn
+        d["video"] = self.video.stats()
+        d["audio"] = self.audio.stats()
+        return d
+
     def _spawn_retained(self, coro) -> asyncio.Task:
         """Background task retained on the peer; cancelled on
         close()."""

@@ -149,6 +149,10 @@ class H264Packetizer:
         self._packets += 1
         return p
 
+    def stats(self) -> dict:
+        """Lifetime packet/octet counters (the QoE snapshot surface)."""
+        return {"packets": self._packets, "octets": self._octets}
+
     def sender_report(self, timestamp: int) -> bytes:
         """Minimal RTCP SR for lipsync/stat baselines."""
         now = time.time() + 2208988800            # NTP epoch
@@ -168,6 +172,8 @@ class OpusPacketizer:
         self.ssrc = ssrc if ssrc is not None else secrets.randbits(32)
         self.seq = secrets.randbits(16)
         self.twcc_alloc = twcc_alloc
+        self._octets = 0
+        self._packets = 0
 
     def packetize(self, opus_frame: bytes, timestamp: int) -> RtpPacket:
         p = RtpPacket(self.payload_type, self.seq, timestamp, self.ssrc,
@@ -178,7 +184,13 @@ class OpusPacketizer:
             p.extensions = [(TWCC_EXT_ID,
                              struct.pack("!H", p.twcc_seq & 0xFFFF))]
         self.seq = (self.seq + 1) & 0xFFFF
+        self._octets += len(opus_frame)
+        self._packets += 1
         return p
+
+    def stats(self) -> dict:
+        """Lifetime packet/octet counters (the QoE snapshot surface)."""
+        return {"packets": self._packets, "octets": self._octets}
 
 
 def depacketize_h264(packets: list[RtpPacket]) -> bytes:

@@ -75,6 +75,22 @@ def test_bench_device_telemetry_keys():
     assert doc["backend_health"]["status"] in ("ok", "degraded", "failed")
 
 
+def test_bench_qoe_block():
+    """ISSUE 4: a qoe block (ack RTT percentiles, drop rate, composite
+    score) rides next to the fps line, computed with the same formula
+    /api/sessions documents."""
+    from selkies_tpu.obs.qoe import qoe_score
+    doc = _bench_doc()
+    q = doc["qoe"]
+    assert isinstance(q["ack_rtt_p50_ms"], (int, float))
+    assert isinstance(q["ack_rtt_p99_ms"], (int, float))
+    assert q["ack_rtt_p99_ms"] >= q["ack_rtt_p50_ms"] > 0
+    assert q["drop_rate"] == 0.0
+    assert 0.0 <= q["score"] <= 100.0
+    assert q["score"] == qoe_score(doc["value"], 60.0,
+                                   q["ack_rtt_p50_ms"], 0.0)
+
+
 def test_bench_dead_relay_reports_failed_backend_verdict():
     """The ISSUE 3 acceptance bar (the r04/r05 silent-failure mode):
     a run that fell back from a dead relay is loudly labelled AND

@@ -1,0 +1,350 @@
+"""Session QoE plane (ISSUE 4): ACK-RTT estimator (injected clock),
+the documented score formula, registry verdicts + qoe_collapse edge
+triggering, bounded-cardinality metrics export, per-metric histogram
+bucket overrides, the qoe trace lane, and log correlation."""
+
+import json
+import logging
+
+from selkies_tpu.obs import health as H
+from selkies_tpu.obs import logctx, qoe
+from selkies_tpu.server import metrics
+
+
+# --------------------------------------------------------------- estimator
+def test_ack_rtt_estimator_injected_clock():
+    est = qoe.AckRttEstimator()
+    t = 1000.0
+    for fid in range(10):
+        est.note_sent(fid, t + fid * 0.016)
+    # ack frame 9 at +20ms: matched RTT, and every OLDER outstanding
+    # entry retires with it (the client acks the latest displayed frame;
+    # relay-dropped frames never ack and must not read as a stall)
+    rtt = est.note_ack(9, t + 9 * 0.016 + 0.020)
+    assert abs(rtt - 20.0) < 1e-6
+    assert est.pending == 0
+    assert est.oldest_pending_ms(t + 10) == 0.0
+    assert abs(est.ewma_ms - 20.0) < 1e-6
+    # EWMA converges toward the new level at alpha=1/8
+    est.note_sent(20, t + 1.0)
+    est.note_ack(20, t + 1.0 + 0.100)
+    assert 20.0 < est.ewma_ms < 100.0
+    p = est.percentiles()
+    # nearest-rank (bench.py's convention): n=2 puts p50 on the 2nd value
+    assert p["n"] == 2 and p["p50_ms"] == 100.0 and p["p99_ms"] == 100.0
+    # unmatched ack: ignored
+    assert est.note_ack(555, t + 2.0) is None
+
+
+def test_ack_rtt_stall_floors_effective_rtt():
+    est = qoe.AckRttEstimator()
+    t = 0.0
+    est.note_sent(1, t)
+    est.note_ack(1, t + 0.005)
+    est.note_sent(2, t + 0.01)
+    # 4 s later frame 2 still unACKed: the EWMA says 5ms, the queue
+    # says stall — effective RTT must follow the queue
+    assert est.effective_rtt_ms(t + 4.01) >= 4000.0
+
+
+def test_ack_rtt_ring_bounded():
+    est = qoe.AckRttEstimator(ring=16)
+    for fid in range(100):
+        est.note_sent(fid, float(fid))
+    assert est.pending == 16
+
+
+def test_frame_id_wraps_uint16():
+    est = qoe.AckRttEstimator()
+    est.note_sent(0x1FFFF, 1.0)           # wraps to 0xFFFF
+    assert est.note_ack(0xFFFF, 1.010) is not None
+
+
+# ------------------------------------------------------------------- score
+def test_qoe_score_formula():
+    # perfect session
+    assert qoe.qoe_score(60.0, 60.0, 0.0, 0.0) == 100.0
+    # documented terms: fps shortfall x rtt x drops
+    assert qoe.qoe_score(30.0, 60.0, 0.0, 0.0) == 50.0
+    assert qoe.qoe_score(60.0, 60.0, 250.0, 0.0) == 50.0
+    assert qoe.qoe_score(60.0, 60.0, 0.0, 0.5) == 50.0
+    # unknown fps scores as on-target, never as zero
+    assert qoe.qoe_score(None, 60.0, 0.0, 0.0) == 100.0
+    # 4s ACK stall alone is a failed session
+    assert qoe.qoe_score(60.0, 60.0, 4000.0, 0.0) < qoe.FAILED_SCORE
+
+
+# ---------------------------------------------------------------- sessions
+def _healthy_session(reg, now=0.0):
+    st = reg.register("ws", "seat0", 1, raddr="10.0.0.9", now=now)
+    st.video_active = True
+    st.target_fps = lambda: 60.0
+    st.reported_fps = 60.0
+    st.relay_provider = lambda: {"sent_bytes": 1_000_000,
+                                 "dropped_frames": 0,
+                                 "queue_depth": 0, "queued_bytes": 0,
+                                 "relays": 1, "dead": 0}
+    for fid in range(30):
+        st.note_sent(fid, now + fid * 0.016)
+        st.note_ack(fid, now + fid * 0.016 + 0.008)
+    return st
+
+
+def test_session_snapshot_and_report_roundtrip():
+    reg = qoe.QoERegistry()
+    st = _healthy_session(reg)
+    doc = reg.report(verbose=True, now=0.6)
+    json.loads(json.dumps(doc))            # /api/sessions JSON contract
+    assert doc["count"] == 1
+    s = doc["sessions"][0]
+    assert s["sid"] == 1 and s["kind"] == "ws" and s["seat"] == "seat0"
+    assert s["client_fps"] == 60.0
+    assert 7.0 < s["ack_rtt_ms"] < 9.0
+    assert s["qoe_score"] > 90
+    assert s["ack"]["n"] == 30 and 7.0 < s["ack"]["p50_ms"] < 9.0
+    assert s["raddr"] == "10.0.0.9"
+    # summary omits the verbose detail
+    s2 = reg.report(now=0.6)["sessions"][0]
+    assert "ack" not in s2 and "raddr" not in s2
+    assert doc["worst_score"] == s["qoe_score"]
+    reg.unregister(st)
+    assert reg.report()["count"] == 0
+
+
+def test_drop_rate_from_relay_counters():
+    reg = qoe.QoERegistry()
+    st = _healthy_session(reg)
+    st.relay_provider = lambda: {"sent_bytes": 1, "dropped_frames": 15,
+                                 "queue_depth": 3, "queued_bytes": 9}
+    assert abs(st.drop_rate() - 0.5) < 1e-9          # 15/30 offered
+    assert st.score(0.6) < 60
+
+
+def test_striped_frames_count_once():
+    """note_sent runs per chunk; a striped frame's chunks share one
+    frame_id and must count as ONE frame (drop rate stays in chunk
+    units to match the relay's per-item dropped counter)."""
+    reg = qoe.QoERegistry()
+    st = reg.register("ws", "seat0", 3)
+    st.video_active = True
+    for fid in (7, 7, 7, 8, 8, 8):         # two frames x three stripes
+        st.note_sent(fid, 0.1)
+    assert st.frames_sent == 2
+    assert st.chunks_sent == 6
+    st.relay_provider = lambda: {"dropped_frames": 3}
+    assert abs(st.drop_rate() - 0.5) < 1e-9
+
+
+def test_inactive_session_has_no_score():
+    reg = qoe.QoERegistry()
+    st = reg.register("ws", "seat0", 2)
+    assert st.score(1.0) is None
+    assert reg.health_check().status == H.OK
+
+
+def test_qoe_health_check_degrades_fails_and_records_collapse():
+    reg = qoe.QoERegistry()
+    rec = H.FlightRecorder()
+    reg.recorder = rec
+    st = _healthy_session(reg)
+    assert reg.health_check().status == H.OK
+    # moderate drop rate -> degraded
+    st.relay_provider = lambda: {"dropped_frames": 18, "sent_bytes": 1}
+    v = reg.health_check()
+    assert v.status == H.DEGRADED and "seat0#1" in v.reason
+    assert not rec.snapshot()
+    # heavy drops -> failed + ONE qoe_collapse incident (edge-triggered)
+    st.relay_provider = lambda: {"dropped_frames": 27, "sent_bytes": 1}
+    assert reg.health_check().status == H.FAILED
+    assert reg.health_check().status == H.FAILED
+    kinds = [e["kind"] for e in rec.snapshot()]
+    assert kinds == ["qoe_collapse"]
+    inc = rec.snapshot()[0]
+    assert inc["seat"] == "seat0" and inc["transport"] == "ws"
+    # recovery re-arms the edge
+    st.relay_provider = lambda: {"dropped_frames": 0, "sent_bytes": 1}
+    assert reg.health_check().status == H.OK
+    st.relay_provider = lambda: {"dropped_frames": 27, "sent_bytes": 1}
+    reg.health_check()
+    assert [e["kind"] for e in rec.snapshot()] == ["qoe_collapse"] * 2
+
+
+def test_webrtc_session_scores_from_cc_stats():
+    reg = qoe.QoERegistry()
+    st = reg.register("webrtc", "primary", "peer-1")
+    cc = {"target_bps": 2e6, "acked_bps": 1.5e6,
+          "detector_state": "normal", "loss_fraction": 0.0,
+          "rtt_ms": 12.0, "in_flight": 4}
+    st.cc_provider = lambda: cc
+    st.target_fps = lambda: 60.0
+    assert st.score(1.0) > 90
+    cc = dict(cc, loss_fraction=0.5, rtt_ms=400.0)
+    assert st.score(1.0) < qoe.DEGRADED_SCORE
+    snap = st.snapshot(now=1.0)
+    assert snap["cc"]["detector_state"] == "normal"
+    assert snap["drop_rate"] == 0.5
+
+
+# ------------------------------------------------------------ backpressure
+def test_backpressure_windows_and_trace_lane():
+    reg = qoe.QoERegistry()
+    st = _healthy_session(reg)
+    st.backpressure_begin(10.0)
+    st.backpressure_begin(10.5)            # idempotent while open
+    assert st.bp_windows == 1
+    dur = st.backpressure_end(12.0)
+    assert abs(dur - 2.0) < 1e-9
+    assert st.backpressure_end(13.0) is None
+    assert abs(st.bp_total_s - 2.0) < 1e-9
+    ev = reg.trace_events()
+    assert ev[0]["ph"] == "M" and ev[0]["args"]["name"] == "qoe"
+    assert len(ev) == 2 and ev[1]["ph"] == "X"
+    assert ev[1]["name"] == "backpressure seat0#1"
+    snap = st.snapshot(now=13.0, verbose=True)
+    assert snap["backpressure"]["windows"] == 1
+    assert snap["backpressure"]["total_s"] == 2.0
+
+
+# ----------------------------------------------------------------- metrics
+def test_metrics_export_bounded_cardinality():
+    metrics.clear()
+    # detach the process singleton's scrape collector (hooked by any
+    # earlier server test): it would clear-and-re-export the same
+    # metric names at render time, wiping this registry's series
+    was_hooked = qoe.registry._collector_hooked
+    metrics.unregister_collector(qoe.registry._export_metrics)
+    reg = qoe.QoERegistry()
+    reg.configure(seat_label_cap=2)
+    for i in range(4):
+        st = reg.register("ws", f"seat{i}", i)
+        st.video_active = True
+        st.target_fps = lambda: 60.0
+        st.note_sent(1, 0.0)
+        st.note_ack(1, 0.010)
+        st.relay_provider = lambda i=i: {"sent_bytes": 100 * (i + 1),
+                                         "dropped_frames": i}
+    reg._export_metrics()
+    text = metrics.render_prometheus()
+    # first cap sessions keep their own series...
+    assert 'selkies_session_qoe_score{seat="seat0",sid="0"}' in text
+    assert 'selkies_session_qoe_score{seat="seat1",sid="1"}' in text
+    # ...the rest roll up into the overflow aggregate, never their own
+    assert 'seat="seat2"' not in text and 'seat="seat3"' not in text
+    assert ('selkies_session_sent_bytes_total{seat="_overflow",sid="_"} '
+            '700.0') in text
+    assert 'selkies_sessions{kind="ws"} 4.0' in text
+    assert "selkies_qoe_worst_score" in text
+    # departed sessions vanish on the next export (no flat-lining)
+    for st in reg.sessions():
+        reg.unregister(st)
+    reg._export_metrics()
+    text = metrics.render_prometheus()
+    assert "selkies_session_qoe_score{" not in text
+    if was_hooked:
+        metrics.register_collector(qoe.registry._export_metrics)
+    metrics.clear()
+
+
+def test_histogram_bucket_override_via_describe():
+    metrics.clear()
+    metrics.describe("qoe_test_rtt_ms", "test rtt",
+                     buckets=(0.5, 5, 500))
+    metrics.observe_hist("qoe_test_rtt_ms", 0.3)
+    metrics.observe_hist("qoe_test_rtt_ms", 42.0)
+    text = metrics.render_prometheus()
+    assert 'qoe_test_rtt_ms_bucket{le="0.5"} 1' in text
+    assert 'qoe_test_rtt_ms_bucket{le="5"} 1' in text
+    assert 'qoe_test_rtt_ms_bucket{le="500"} 2' in text
+    assert 'qoe_test_rtt_ms_bucket{le="+Inf"} 2' in text
+    assert "qoe_test_rtt_ms_count 2" in text
+    # the default ladder still renders for undescribed histograms
+    metrics.observe_hist("qoe_test_default_hist", 3.0)
+    text = metrics.render_prometheus()
+    assert 'qoe_test_default_hist_bucket{le="1"} 0' in text
+    assert 'qoe_test_default_hist_bucket{le="240"} 1' in text
+    metrics.clear()
+
+
+def test_ack_rtt_histogram_uses_wide_ladder():
+    metrics.clear()
+    reg = qoe.QoERegistry()
+    st = reg.register("ws", "seat0", 7)
+    st.note_sent(1, 0.0)
+    st.note_ack(1, 0.0008)                 # 0.8 ms
+    text = metrics.render_prometheus()
+    assert 'selkies_session_ack_rtt_ms_bucket{le="0.5"} 0' in text
+    assert 'selkies_session_ack_rtt_ms_bucket{le="1"} 1' in text
+    assert 'selkies_session_ack_rtt_ms_bucket{le="5000"} 1' in text
+    metrics.clear()
+
+
+def test_render_prometheus_survives_crashing_collector():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise RuntimeError("boom")
+
+    metrics.register_collector(bad)
+    try:
+        text = metrics.render_prometheus()
+        assert calls and isinstance(text, str)
+    finally:
+        metrics.unregister_collector(bad)
+
+
+# ------------------------------------------------------------------- logs
+def test_logctx_filter_and_json_formatter():
+    records = []
+
+    class Sink(logging.Handler):
+        def emit(self, record):
+            records.append(self.format(record))
+
+    log = logging.getLogger("selkies_tpu.test.qoe")
+    log.propagate = False
+    h = Sink()
+    h.addFilter(logctx.SessionContextFilter())
+    h.setFormatter(logctx.JsonFormatter())
+    log.addHandler(h)
+    try:
+        tok = logctx.bind(7, "seat1")
+        log.warning("client %d backpressured", 7)
+        logctx.clear(tok)
+        log.warning("no session here")
+    finally:
+        log.removeHandler(h)
+        log.propagate = True
+    doc = json.loads(records[0])
+    assert doc["session"] == "7" and doc["seat"] == "seat1"
+    assert doc["msg"] == "client 7 backpressured"
+    assert doc["level"] == "WARNING"
+    doc2 = json.loads(records[1])
+    assert "session" not in doc2
+
+
+def test_logctx_plain_session_tag():
+    records = []
+
+    class Sink(logging.Handler):
+        def emit(self, record):
+            records.append(self.format(record))
+
+    log = logging.getLogger("selkies_tpu.test.qoe2")
+    log.propagate = False
+    log.setLevel(logging.INFO)
+    h = Sink()
+    h.addFilter(logctx.SessionContextFilter())
+    h.setFormatter(logging.Formatter("%(levelname)s:%(session_tag)s "
+                                     "%(message)s"))
+    log.addHandler(h)
+    try:
+        tok = logctx.bind(3, ":0")
+        log.info("hello")
+        logctx.clear(tok)
+        log.info("bye")
+    finally:
+        log.removeHandler(h)
+        log.propagate = True
+    assert records[0] == "INFO: [:0#3] hello"
+    assert records[1] == "INFO: bye"

@@ -936,6 +936,164 @@ async def test_relay_clean_close_is_not_a_death():
     assert _counter(metrics.render_prometheus()) == before
 
 
+async def test_sessions_endpoint_live_stats(client_factory):
+    """ISSUE 4 acceptance: GET /api/sessions returns live per-session
+    ACK RTT, client fps, and drop counts for a streaming WS client."""
+    import time as _time
+
+    from selkies_tpu.obs import qoe as _qoe
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("START_VIDEO")
+    got = None
+    for _ in range(10):
+        msg = await asyncio.wait_for(ws.receive(), 5)
+        if msg.type == WSMsgType.BINARY and msg.data[0] == P.OP_JPEG:
+            got = msg.data
+            break
+    assert got is not None
+    _, fid, _ = P.unpack_jpeg_header(got)
+    await ws.send_str(f"CLIENT_FRAME_ACK,{fid}")
+    await ws.send_str("_f,58.5")
+    await asyncio.sleep(0.2)
+
+    r = await c.get("/api/sessions")
+    assert r.status == 200
+    doc = await r.json()
+    assert doc["count"] == 1
+    s = doc["sessions"][0]
+    assert s["kind"] == "ws" and s["seat"] == ":0"
+    assert s["video_active"] is True
+    assert s["frames_sent"] >= 1
+    assert s["client_fps"] == 58.5
+    assert s["ack_rtt_ms"] >= 0.0
+    assert s["dropped_frames"] == 0 and s["drop_rate"] == 0.0
+    assert s["qoe_score"] is not None and s["qoe_score"] > 50
+
+    r = await c.get("/api/sessions?verbose=1")
+    v = (await r.json())["sessions"][0]
+    assert v["ack"]["acked"] >= 1 and v["ack"]["p50_ms"] is not None
+    assert v["relay"]["sent_bytes"] > 0
+    assert "backpressure" in v and v["raddr"]
+
+    # the session disappears from the registry on disconnect
+    await ws.close()
+    await asyncio.sleep(0.2)
+    assert all(st.kind != "ws"
+               for st in _qoe.registry.sessions()), "session leaked"
+    _ = _time  # silence unused in case of skip paths
+
+
+async def test_sessions_endpoint_role_gated(client_factory):
+    server, *_ = make_app(
+        enable_basic_auth=True, basic_auth_user="u",
+        basic_auth_password="pw", viewonly_password="vo")
+    c = await client_factory(server)
+    vo = {"Authorization": "Basic " + base64.b64encode(b"u:vo").decode()}
+    assert (await c.get("/api/sessions", headers=vo)).status == 403
+    full = {"Authorization": "Basic " + base64.b64encode(b"u:pw").decode()}
+    assert (await c.get("/api/sessions", headers=full)).status == 200
+
+
+async def test_stalled_client_fails_qoe_check_and_records_collapse(
+        client_factory):
+    """ISSUE 4 acceptance: a stalled client (frames sent, never ACKed)
+    drives the qoe health check to failed and a qoe_collapse incident
+    into the flight recorder."""
+    import time as _time
+
+    from selkies_tpu.obs import health as _health
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("START_VIDEO")
+    await asyncio.sleep(0.2)
+    client = next(iter(svc.clients.values()))
+    assert client.qoe is not None and client.qoe.frames_sent >= 1
+    # simulate the stall: a frame sent 10 s ago with no ACK since
+    client.qoe.note_sent(4242, _time.monotonic() - 10.0)
+    before = [e["kind"] for e in _health.engine.recorder.snapshot()]
+    r = await c.get("/api/health?verbose=1")
+    body = await r.json()
+    assert body["checks"]["qoe"]["status"] == "failed"
+    assert "qoe" in body["failing"] and body["ready"] is False
+    kinds = [e["kind"] for e in body["incidents"]]
+    assert kinds.count("qoe_collapse") == before.count("qoe_collapse") + 1
+    await ws.close()
+
+
+async def test_relay_sent_and_dropped_metrics_per_display():
+    """Satellite (ISSUE 4): FrameRelay sent_bytes/dropped_frames reach
+    /api/metrics as per-display counters, not just the debug
+    snapshot."""
+    from selkies_tpu.server import metrics
+    from selkies_tpu.server.relay import VideoRelay
+
+    def _counter(text, name, display):
+        needle = f'{name}{{display="{display}"}} '
+        for ln in text.splitlines():
+            if ln.startswith(needle):
+                return float(ln.rsplit(" ", 1)[1])
+        return 0.0
+
+    gate = asyncio.Event()
+
+    async def _send(data):
+        await gate.wait()
+
+    text0 = metrics.render_prometheus()
+    sent0 = _counter(text0, "selkies_relay_sent_bytes_total", ":qoet")
+    drop0 = _counter(text0, "selkies_relay_dropped_frames_total", ":qoet")
+    relay = VideoRelay(_send, display=":qoet")
+    relay.start()
+    big = P.pack_jpeg_stripe(1, 0, b"\xff\xd8" + b"x" * (3 << 20))
+    relay.offer(big)                      # picked up by the blocked sender
+    await asyncio.sleep(0.05)
+    relay.offer(big)                      # queued: 3 MiB
+    relay.offer(big)                      # 6 MiB > 4 MiB floor -> drop
+    assert relay.dropped_frames == 1
+    gate.set()
+    for _ in range(100):
+        await asyncio.sleep(0.01)
+        if relay.sent_bytes >= 2 * len(big):
+            break
+    await relay.close()
+    text = metrics.render_prometheus()
+    assert _counter(text, "selkies_relay_sent_bytes_total", ":qoet") \
+        == sent0 + 2 * len(big)
+    assert _counter(text, "selkies_relay_dropped_frames_total", ":qoet") \
+        == drop0 + 1
+
+
+async def test_trace_endpoint_carries_qoe_lane(client_factory):
+    """Backpressure windows overlay the /api/trace timeline as a qoe
+    lane (the PR-2 Perfetto view shows WHEN a seat was paused)."""
+    from selkies_tpu.obs import qoe as _qoe
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("START_VIDEO")
+    await asyncio.sleep(0.2)
+    client = next(iter(svc.clients.values()))
+    import time as _time
+    client.qoe.backpressure_begin(_time.monotonic() - 0.5)
+    client.qoe.backpressure_end(_time.monotonic())
+    r = await c.get("/api/trace")
+    doc = await r.json()
+    lanes = [e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "thread_name"]
+    assert "qoe" in lanes
+    assert any(e.get("ph") == "X"
+               and str(e.get("name", "")).startswith("backpressure")
+               for e in doc["traceEvents"])
+    _ = _qoe
+    await ws.close()
+
+
 async def test_relay_send_span_attaches_to_frame_timeline():
     """The ws.send stage lands on the frame's trace timeline by id."""
     from selkies_tpu.server.relay import VideoRelay

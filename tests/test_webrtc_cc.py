@@ -196,6 +196,92 @@ def test_loss_finalised_after_grace_window():
     assert abs(cc.last_loss_fraction - 4 / 22) < 1e-9
 
 
+def test_cc_stats_snapshot_coherent_mid_stream():
+    """ISSUE 4 satellite: stats() is coherent after synthetic TWCC
+    feedback — acked bps > 0, detector state is a valid state, the
+    AIMD/loss internals mirror the live controller, and the snapshot is
+    JSON-serialisable for /api/sessions."""
+    import json as _json
+
+    cc = SendSideCongestionController(start_bps=4_000_000.0)
+    now = 0
+    for _ in range(5):
+        seqs, times = [], []
+        for i in range(20):
+            s = cc.alloc_seq()
+            cc.on_packet_sent(s, 1200, now)
+            times.append(now + 5_000)
+            seqs.append(s)
+            now += 10_000
+        _feedback(cc, seqs, times, now)
+    st = cc.stats()
+    assert st["acked_bps"] is not None and st["acked_bps"] > 0
+    assert st["detector_state"] in ("normal", "overuse", "underuse")
+    assert st["aimd_state"] in ("increase", "hold")
+    assert st["target_bps"] == round(cc.target_bps, 1)
+    assert st["loss_fraction"] == 0.0
+    assert st["loss_cap_bps"] > 0
+    assert st["trend_threshold"] >= 6.0
+    assert st["in_flight"] == len(cc._sent)
+    assert st["provisional_missing"] == 0
+    _json.loads(_json.dumps(st))
+
+
+def test_cc_stats_loss_fraction_roundtrips_from_rtcp():
+    """The loss fraction surfaced by stats() equals what the RTCP
+    feedback (grace-finalised) actually reported."""
+    cc = SendSideCongestionController(start_bps=4_000_000.0)
+    now = 0
+    seqs = []
+    for i in range(20):
+        s = cc.alloc_seq()
+        cc.on_packet_sent(s, 1200, now)
+        seqs.append(s)
+        now += 10_000
+    times = [now + i * 1_000 if i >= 4 else None for i in range(20)]
+    _feedback(cc, seqs, times, now)
+    now += SendSideCongestionController.LOSS_GRACE_US + 1_000
+    extra = []
+    for i in range(2):
+        s = cc.alloc_seq()
+        cc.on_packet_sent(s, 1200, now)
+        extra.append(s)
+    _feedback(cc, extra, [now + 1_000, now + 2_000], now)
+    st = cc.stats()
+    assert abs(st["loss_fraction"] - round(4 / 22, 4)) < 1e-9
+    assert st["loss_fraction"] == round(cc.last_loss_fraction, 4)
+
+
+def test_cc_rtt_from_twcc_feedback_timing():
+    """TWCC RTT: feedback arrival minus the newest acked packet's send
+    time, EWMA-smoothed into srtt_ms."""
+    cc = SendSideCongestionController()
+    s0 = cc.alloc_seq()
+    cc.on_packet_sent(s0, 1200, 0)
+    _feedback(cc, [s0], [10_000], 50_000)      # feedback 50ms after send
+    assert abs(cc.last_rtt_ms - 50.0) < 1e-6
+    assert abs(cc.srtt_ms - 50.0) < 1e-6
+    s1 = cc.alloc_seq()
+    cc.on_packet_sent(s1, 1200, 100_000)
+    _feedback(cc, [s1], [110_000], 100_000 + 90_000)   # 90ms
+    assert abs(cc.last_rtt_ms - 90.0) < 1e-6
+    assert 50.0 < cc.srtt_ms < 90.0                    # 1/8 EWMA
+    assert cc.stats()["rtt_ms"] == round(cc.srtt_ms, 3)
+    assert cc.stats()["last_rtt_ms"] == 90.0
+
+
+def test_packetizer_counters_for_qoe():
+    cc = SendSideCongestionController()
+    pk = H264Packetizer(twcc_alloc=cc.alloc_seq)
+    pk.packetize(b"\x00\x00\x00\x01\x65" + b"x" * 50, 1234)
+    st = pk.stats()
+    assert st["packets"] == 1 and st["octets"] > 50
+    from selkies_tpu.webrtc.rtp import OpusPacketizer
+    op = OpusPacketizer(twcc_alloc=cc.alloc_seq)
+    op.packetize(b"opus-frame", 960)
+    assert op.stats() == {"packets": 1, "octets": 10}
+
+
 def test_late_received_packet_does_not_poison_trendline():
     """A packet reported missing then received later must not be grouped
     behind newer packets — its stale send time would inject a spurious
