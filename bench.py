@@ -29,6 +29,14 @@ as a loopback QoE session, so the JSON line carries a ``qoe`` block —
 ``ack_rtt_p50_ms``/``ack_rtt_p99_ms``, ``drop_rate``, and the
 composite ``score`` computed with the same documented formula
 ``GET /api/sessions`` uses.
+
+Chaos mode (selkies_tpu/resilience, ISSUE 5): ``--chaos`` runs a
+seeded fault script — relay-kill, capture-source crash, encoder
+device-error — against a live capture->relay loopback pipeline under
+full supervision, and the JSON line carries a ``chaos`` block proving
+every injected fault was recovered (supervisor restarts, final health,
+QoE score back above the degraded threshold). Knobs:
+BENCH_CHAOS_SEED, BENCH_CHAOS_BUDGET_S, BENCH_CHAOS_WIDTH/HEIGHT.
 """
 
 import json
@@ -358,10 +366,211 @@ def main(force_cpu: bool = False) -> None:
     }))
 
 
+async def _chaos_run(target_fps: float, w: int, h: int) -> dict:
+    """The supervised loopback pipeline under a seeded fault script.
+    Returns the ``chaos`` result block (recovery proof + forensics)."""
+    import asyncio
+
+    from selkies_tpu import protocol as P
+    from selkies_tpu.engine.capture import ScreenCapture
+    from selkies_tpu.engine.types import CaptureSettings
+    from selkies_tpu.obs import health as _health
+    from selkies_tpu.obs import qoe as _qoe
+    from selkies_tpu.resilience import faults as _faults
+    from selkies_tpu.resilience.ladder import DegradationLadder
+    from selkies_tpu.resilience.supervisor import RestartPolicy, Supervisor
+    from selkies_tpu.server.relay import VideoRelay
+
+    loop = asyncio.get_running_loop()
+    eng = _health.engine
+    eng.recorder.clear()
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
+    # the script: capture crash ~1s in, relay kill ~2s in (send-hit
+    # counted, stripes multiply per frame), device error ~4s in
+    script = ("capture.source:raise:after=30,count=1;"
+              "relay.send:error:after=120,count=1;"
+              "encoder.dispatch:device_error:after=120,count=1")
+    _faults.registry.disarm()
+    _faults.registry.arm(script, seed=seed)
+    n_faults = len(_faults.registry.active())
+
+    sup = Supervisor(
+        recorder=eng.recorder,
+        policy_factory=lambda: RestartPolicy(
+            max_restarts=20, window_s=300.0, base_backoff_s=0.2,
+            max_backoff_s=2.0, min_uptime_s=1.0, seed=seed))
+
+    qreg = _qoe.QoERegistry()
+    qreg.recorder = eng.recorder
+    qsess = qreg.register("ws", "chaos0", 1)
+    qsess.video_active = True
+    qsess.target_fps = lambda: target_fps
+    ack_times: list = []
+
+    async def client_send(item: bytes) -> None:
+        # loopback viewer: every delivered media frame is an instant ACK
+        if item and item[0] == P.OP_JPEG:
+            fid = P.unpack_jpeg_header(item)[1]
+            now = time.monotonic()
+            qsess.note_ack(fid, now)
+            ack_times.append(now)
+
+    cap = ScreenCapture("synthetic")
+    relay_box: dict = {}
+
+    def make_relay() -> None:
+        def on_dead():
+            sup.report_death("relay:chaos0", "media send stalled/failed")
+        r = VideoRelay(client_send, request_idr=cap.request_idr_frame,
+                       on_dead=on_dead, display="chaos0")
+        r.start()
+        relay_box["r"] = r
+
+    def reoffer_relay():
+        old = relay_box.get("r")
+        if old is not None and not old.dead:
+            return
+        make_relay()
+        cap.request_idr_frame()
+
+    sup.adopt("relay:chaos0", reoffer_relay)
+    make_relay()
+
+    sup.adopt("capture:chaos0",
+              lambda: loop.run_in_executor(None, cap.restart))
+    cap.on_death = lambda exc: loop.call_soon_threadsafe(
+        sup.report_death, "capture:chaos0",
+        f"{type(exc).__name__}: {exc}")
+
+    def offer(chunk) -> None:
+        frame = P.pack_jpeg_stripe(chunk.frame_id, chunk.stripe_y,
+                                   chunk.payload)
+        qsess.note_sent(chunk.frame_id, time.monotonic())
+        r = relay_box["r"]
+        if not r.dead:
+            r.offer(frame)
+
+    # the degradation ladder rides the same run: qoe failure (the relay
+    # outage stalls every ACK) sheds fps, sustained-ok steps back up
+    ladder = DegradationLadder(down_after_s=0.5, hold_s=1.0,
+                               ok_window_s=3.0, recorder=eng.recorder)
+    ladder.bind_controls({
+        "fps": (lambda: cap.update_framerate(target_fps / 2),
+                lambda: cap.update_framerate(target_fps)),
+        "quality": (lambda: cap.update_tunables(jpeg_quality=20),
+                    lambda: cap.update_tunables(jpeg_quality=40)),
+    })
+
+    settings = CaptureSettings(
+        capture_width=w, capture_height=h, output_mode="jpeg",
+        jpeg_quality=40, target_fps=target_fps, display_id="chaos0",
+        stripe_height=64, use_damage_gating=True, use_paint_over=False)
+    await loop.run_in_executor(
+        None, lambda: cap.start_capture(
+            lambda c: loop.call_soon_threadsafe(offer, c), settings))
+
+    budget = float(os.environ.get("BENCH_CHAOS_BUDGET_S", "120"))
+    deadline = time.monotonic() + budget
+    ok_streak = 0
+    final_qoe = None
+    while time.monotonic() < deadline:
+        await asyncio.sleep(0.5)
+        now = time.monotonic()
+        # loopback client fps from the ACK stream (1 ACK per stripe;
+        # normalise by stripes per frame)
+        ack_times[:] = [t for t in ack_times if now - t <= 2.0]
+        stripes = max(1, (h + 63) // 64)
+        qsess.reported_fps = len(ack_times) / 2.0 / stripes
+        v = qreg.health_check()
+        ladder.observe({"qoe": v})
+        final_qoe = qsess.score(now)
+        recovered = (
+            _faults.registry.remaining() == 0
+            and cap.is_capturing()
+            and not relay_box["r"].dead
+            and sup.health_check().status == _health.OK
+            and final_qoe is not None
+            and final_qoe >= _qoe.DEGRADED_SCORE)
+        ok_streak = ok_streak + 1 if recovered else 0
+        if ok_streak >= 4:      # 2 s of sustained recovery
+            break
+    await loop.run_in_executor(None, cap.stop_capture)
+    await relay_box["r"].close()
+    sup.close()
+
+    kinds: dict = {}
+    for e in eng.recorder.snapshot():
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    return {
+        "seed": seed,
+        "script": script,
+        "faults_armed": n_faults,
+        "faults_fired": len(_faults.registry.fired_log),
+        "faults_remaining": _faults.registry.remaining(),
+        "recovered": ok_streak >= 4,
+        "supervisor_restarts": sup.total_restarts,
+        "supervision": sup.health_check().status,
+        "ladder_transitions": ladder.transitions,
+        "ladder_level": ladder.level,
+        "incidents": kinds,
+        "qoe_score": final_qoe,
+    }
+
+
+def chaos_main(force_cpu: bool = False) -> None:
+    """``--chaos``: prove the resilience plane recovers every injected
+    fault. Prints ONE JSON line (same contract as the headline bench)."""
+    import asyncio
+
+    import jax
+    if force_cpu:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    from selkies_tpu.compile_cache import enable as enable_compile_cache
+    enable_compile_cache(jax)
+    from selkies_tpu.obs import monitor as _devmon
+    _devmon.attach_jax(jax)
+
+    backend = jax.default_backend()
+    # small geometry: chaos proves recovery, not throughput — CPU CI
+    # must compile the session in seconds
+    w = int(os.environ.get("BENCH_CHAOS_WIDTH", "256"))
+    h = int(os.environ.get("BENCH_CHAOS_HEIGHT", "128"))
+    target_fps = 30.0
+    log(f"chaos: backend={backend} size={w}x{h} fps={target_fps}")
+
+    t0 = time.monotonic()
+    chaos = asyncio.run(_chaos_run(target_fps, w, h))
+    dt = time.monotonic() - t0
+
+    _devmon.platform = backend
+    verdict = _devmon.backend_verdict()
+    backend_label = backend
+    if backend == "cpu" and os.environ.get("BENCH_CPU_REASON"):
+        backend_label = "cpu-fallback-" + os.environ["BENCH_CPU_REASON"]
+    log(f"chaos done in {dt:.1f}s: recovered={chaos['recovered']} "
+        f"restarts={chaos['supervisor_restarts']} "
+        f"qoe={chaos['qoe_score']} incidents={chaos['incidents']}")
+    print(json.dumps({
+        "metric": "chaos_recovery",
+        "value": 1.0 if chaos["recovered"] else 0.0,
+        "unit": "recovered",
+        "vs_baseline": 1.0 if chaos["recovered"] else 0.0,
+        "duration_s": round(dt, 1),
+        "backend": backend_label,
+        "backend_health": {"status": verdict.status,
+                           "reason": verdict.reason},
+        "chaos": chaos,
+    }))
+
+
 if __name__ == "__main__":
     _force_cpu = probe_backend()
+    _chaos = "--chaos" in sys.argv[1:]
     try:
-        main(_force_cpu)
+        (chaos_main if _chaos else main)(_force_cpu)
     except BaseException as e:   # noqa: BLE001 — the JSON line must happen
         if isinstance(e, KeyboardInterrupt):
             raise
@@ -379,8 +588,11 @@ if __name__ == "__main__":
         import traceback
         traceback.print_exc(file=sys.stderr)
         print(json.dumps({
-            "metric": "encode_fps_unavailable",
-            "value": 0.0, "unit": "fps", "vs_baseline": 0.0,
+            "metric": "chaos_recovery" if _chaos
+            else "encode_fps_unavailable",
+            "value": 0.0,
+            "unit": "recovered" if _chaos else "fps",
+            "vs_baseline": 0.0,
             "backend": "none",
             "backend_health": {"status": "failed",
                                "reason": f"{type(e).__name__}: {e}"[:200]},

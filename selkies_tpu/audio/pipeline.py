@@ -143,6 +143,11 @@ class AudioPipeline:
         self.mic_only = False
         #: None = mic not requested; else provision() result
         self.mic_ok: Optional[bool] = None
+        #: supervision hook (selkies_tpu/resilience): when set, an
+        #: encode-loop death reports here and the restart-policy engine
+        #: owns the retry (backoff, budget, incidents) instead of the
+        #: legacy fixed 1 s self-retry
+        self.on_death = None
 
     @property
     def multistream_params(self) -> Optional[dict]:
@@ -244,11 +249,31 @@ class AudioPipeline:
                 await self._run_inner()
             except asyncio.CancelledError:
                 raise
-            except Exception:
+            except Exception as e:
                 # the audio task must never die silently (every client
-                # loses audio until restart); log and resume
+                # loses audio until restart)
+                hook = self.on_death
+                if hook is not None:
+                    # supervised: hand the retry decision to the
+                    # restart-policy engine and end this task
+                    logger.exception("audio pipeline died; reporting "
+                                     "to supervisor")
+                    try:
+                        hook(e)
+                    except Exception:
+                        logger.exception("audio on_death hook failed")
+                    return
                 logger.exception("audio pipeline error; restarting loop")
                 await asyncio.sleep(1.0)
+
+    def restart_encode_loop(self) -> None:
+        """Supervisor restart target: respawn the encode task (no-op in
+        mic-only mode, where there is no loop to die)."""
+        if self.mic_only:
+            return
+        if self._task is not None and not self._task.done():
+            return
+        self._task = asyncio.create_task(self._run())
 
     async def _run_inner(self) -> None:
         period = self.frame_ms / 1000.0

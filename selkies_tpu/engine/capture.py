@@ -22,12 +22,19 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from ..obs import health as _health
+from ..resilience import faults as _faults
 from ..trace import tracer as _tracer
 from .encoder import JpegEncoderSession
 from .sources import FrameSource, make_source
 from .types import CaptureSettings, EncodedChunk
 
 logger = logging.getLogger("selkies_tpu.engine.capture")
+
+#: bound on joining the capture thread at stop/restart — a hung source
+#: (dead X connection, wedged device transport) must not wedge the
+#: executor thread that called restart() forever
+JOIN_TIMEOUT_S = 5.0
 
 #: frames in flight between device dispatch and host finalize. Deep enough
 #: to hide one host-link RTT at 60 fps; shallow enough to keep glass-to-glass
@@ -77,6 +84,14 @@ class ScreenCapture:
         # stats for rate control / observability
         self.last_frame_bytes = 0
         self.encoded_fps = 0.0
+        #: supervision hook: called with the exception when the capture
+        #: loop DIES (not on deliberate stop). Callers on another thread
+        #: hop to their loop themselves (``call_soon_threadsafe``).
+        self.on_death: Optional[Callable[[BaseException], None]] = None
+        #: threads abandoned by a timed-out join (each one is a leaked
+        #: OS thread + source — counted, never silent)
+        self.abandoned_threads = 0
+        self.join_timeout_s = JOIN_TIMEOUT_S
 
     # -- reference API surface ----------------------------------------------
     def start_capture(self, callback: Callable[[EncodedChunk], None],
@@ -84,8 +99,10 @@ class ScreenCapture:
         """Start (or live-reconfigure, reference media_pipeline.py:580-590)
         the capture/encode loop."""
         with self._api_lock:
-            if self.is_capturing():
-                self.stop_capture()
+            # unconditional: a DEAD loop (thread exited on an exception)
+            # still holds an open source that must be closed before the
+            # new one replaces it — the supervised-restart path
+            self.stop_capture()
             self._callback = callback
             self._settings = settings
             if settings.output_mode == "h264":
@@ -102,6 +119,10 @@ class ScreenCapture:
                                        settings.capture_height,
                                        settings.x_display
                                        or settings.display_id)
+            # fresh Event per run: an ABANDONED thread (timed-out join)
+            # still waits on the old one — re-setting a shared event
+            # would resurrect it into a second concurrent capture loop
+            self._running = threading.Event()
             self._running.set()
             self._thread = threading.Thread(
                 target=self._run, name="tpuflux-capture", daemon=True)
@@ -110,11 +131,33 @@ class ScreenCapture:
     def stop_capture(self) -> None:
         with self._api_lock:
             self._running.clear()
+            wedged = False
             if self._thread is not None:
-                self._thread.join(timeout=5.0)
+                self._thread.join(timeout=self.join_timeout_s)
+                if self._thread.is_alive():
+                    # bounded-join escalation: a hung source must not
+                    # wedge the caller (often an executor thread running
+                    # restart()) forever. The thread and its source are
+                    # ABANDONED — deliberately leaked, because closing a
+                    # source a live thread still reads is a crash.
+                    wedged = True
+                    self.abandoned_threads += 1
+                    logger.error(
+                        "capture thread for %s did not stop within %.1fs; "
+                        "abandoning it (%d abandoned so far)",
+                        self._settings.display_id if self._settings
+                        else "?", self.join_timeout_s,
+                        self.abandoned_threads)
+                    _health.engine.recorder.record(
+                        "capture_thread_wedged",
+                        display=self._settings.display_id
+                        if self._settings else None,
+                        abandoned=self.abandoned_threads)
+                    _metrics_abandoned()
                 self._thread = None
             if self._source is not None:
-                self._source.close()
+                if not wedged:
+                    self._source.close()
                 self._source = None
 
     def is_capturing(self) -> bool:
@@ -256,6 +299,10 @@ class ScreenCapture:
     def _run(self) -> None:
         assert self._settings and self._session and self._source
         s, sess, src = self._settings, self._session, self._source
+        # THIS run's lifetime flag: self._running is replaced by the
+        # next start_capture, and this thread must only ever observe
+        # (and clear) its own
+        running = self._running
         turn = _ENCODE_TURN
         g = sess.grid
         pad = None
@@ -267,7 +314,7 @@ class ScreenCapture:
         fps_frames = 0
         last_full = time.monotonic()
         try:
-            while self._running.is_set():
+            while running.is_set():
                 t0 = time.monotonic()
                 self._apply_tunables()
                 # span tracing (selkies_tpu/trace): one timeline per frame,
@@ -275,6 +322,9 @@ class ScreenCapture:
                 # dispatch, ended at delivery PIPELINE_DEPTH turns later
                 tl = _tracer.frame_begin(s.display_id)
                 with _tracer.span("capture", tl):
+                    # fault point: a raise kills the loop (exercising
+                    # the supervised-restart path), a freeze stalls it
+                    _faults.registry.perturb("capture.source")
                     frame = src.get_frame(tick)
                 with _tracer.span("convert", tl):
                     if pad is not None:
@@ -329,10 +379,22 @@ class ScreenCapture:
                     time.sleep(sleep)
             while inflight:  # drain
                 self._deliver(inflight.popleft())
-        except Exception:
+        except Exception as e:
             logger.exception("capture loop died")
+            _health.engine.recorder.record(
+                "capture_death", display=s.display_id,
+                error=f"{type(e).__name__}: {e}"[:200])
+            running.clear()
+            # supervision hook AFTER state is consistent: the supervisor
+            # may restart us from another thread immediately
+            hook = self.on_death
+            if hook is not None:
+                try:
+                    hook(e)
+                except Exception:
+                    logger.exception("capture on_death hook failed")
         finally:
-            self._running.clear()
+            running.clear()
 
     def _deliver(self, out: dict) -> int:
         assert self._session is not None
@@ -349,3 +411,15 @@ class ScreenCapture:
             # attach later by frame id while the timeline sits in the ring
             _tracer.frame_end(self._settings.display_id, out["frame_id"])
         return nbytes
+
+
+# -- optional metrics bridge (lazy; mirrors obs.health's pattern) ----------
+
+def _metrics_abandoned() -> None:
+    try:
+        from ..server import metrics
+    except Exception:
+        return
+    metrics.describe("selkies_capture_abandoned_threads_total",
+                     "Capture threads abandoned after a timed-out join")
+    metrics.inc_counter("selkies_capture_abandoned_threads_total")

@@ -32,6 +32,7 @@ import numpy as np
 
 from ..codecs import jpeg as jtab
 from ..codecs.jpeg import stuff_ff_bytes
+from ..resilience import faults as _faults
 from ..trace import tracer as _tracer
 from ..ops.stripes import concat_stripe_bytes, words_to_bytes_device
 from .types import CaptureSettings, EncodedChunk
@@ -193,6 +194,9 @@ class JpegEncoderSession:
         always in the buffer); accepted here for session-interface parity
         with the H.264 session."""
         del force
+        # fault point: device_error raises (the XLA-runtime-died class),
+        # slow stalls the dispatch (compile-storm / saturated-queue class)
+        _faults.registry.perturb("encoder.dispatch")
         if self._watermark is not None:
             frame = self._watermark.apply(frame)
         # the dispatch span covers the step call AND the async-copy kicks:

@@ -37,6 +37,7 @@ from ..ops.h264_encode import P_SLOTS_MB, SLOTS_MB, scroll_candidates
 from ..ops.h264_planes import (h264_encode_p_yuv, h264_encode_yuv,
                                rgb_to_yuv420)
 from ..ops.stripes import concat_stripe_bytes, words_to_bytes_device
+from ..resilience import faults as _faults
 from ..trace import tracer as _tracer
 from .types import CaptureSettings, EncodedChunk
 
@@ -292,6 +293,9 @@ class H264EncoderSession:
         frame produce IDRs; every other frame is a P with on-device
         P_Skip for unchanged macroblocks. The mode must be decided HERE
         (not at finalize) so the device stream counters see it."""
+        # fault point: device_error raises (the XLA-runtime-died class),
+        # slow stalls the dispatch (compile-storm / saturated-queue class)
+        _faults.registry.perturb("encoder.dispatch")
         if self._force_after_drop:
             self._force_after_drop = False
             force = True

@@ -21,6 +21,8 @@ from typing import Callable, Optional
 
 from ..engine.capture import _ENCODE_TURN
 from ..engine.types import CaptureSettings, EncodedChunk
+from ..obs import health as _health
+from ..resilience import faults as _faults
 from ..trace import tracer as _tracer
 from .h264_seats import MultiSeatH264Encoder
 from .seats import MultiSeatEncoder, synthetic_seat_frames
@@ -43,6 +45,9 @@ class MultiSeatCapture:
         self._api_lock = threading.RLock()
         self.encoded_fps = 0.0
         self.last_frame_bytes = 0
+        #: supervision hook (same contract as ScreenCapture.on_death):
+        #: called with the exception when the loop DIES, never on stop
+        self.on_death: Optional[Callable[[BaseException], None]] = None
 
     # ----------------------------------------------------- reference surface
     def start_capture(self, callback, settings: CaptureSettings) -> None:
@@ -56,6 +61,10 @@ class MultiSeatCapture:
             cls = MultiSeatH264Encoder if settings.output_mode == "h264" \
                 else MultiSeatEncoder
             self._enc = cls(settings, self.n_seats)
+            # fresh Event per run (same rationale as ScreenCapture): a
+            # thread abandoned by a timed-out join must never observe a
+            # later run's flag and resurrect
+            self._running = threading.Event()
             self._running.set()
             self._thread = threading.Thread(
                 target=self._run, name="tpuflux-seats", daemon=True)
@@ -118,16 +127,18 @@ class MultiSeatCapture:
     def _run(self) -> None:
         assert self._settings and self._enc
         s, enc = self._settings, self._enc
+        running = self._running     # THIS run's flag only
         tick = 0
         window_frames, window_start = 0, time.monotonic()
         # one timeline covers all seats per tick; alias keys route the
         # per-seat relay send/ACK spans onto it
         seat_aliases = tuple(f"seat{i}" for i in range(self.n_seats))
         try:
-            while self._running.is_set():
+            while running.is_set():
                 t0 = time.monotonic()
                 tl = _tracer.frame_begin(s.display_id)
                 with _tracer.span("capture", tl):
+                    _faults.registry.perturb("capture.source")
                     frames = synthetic_seat_frames(enc, tick)
                 force = self._force_idr.is_set()
                 if force:
@@ -162,7 +173,17 @@ class MultiSeatCapture:
                 sleep = 1.0 / max(s.target_fps, 1.0) - (time.monotonic() - t0)
                 if sleep > 0:
                     time.sleep(sleep)
-        except Exception:
+        except Exception as e:
             logger.exception("multi-seat capture loop died")
+            _health.engine.recorder.record(
+                "capture_death", display=s.display_id, seats=self.n_seats,
+                error=f"{type(e).__name__}: {e}"[:200])
+            running.clear()
+            hook = self.on_death
+            if hook is not None:
+                try:
+                    hook(e)
+                except Exception:
+                    logger.exception("multi-seat on_death hook failed")
         finally:
-            self._running.clear()
+            running.clear()

@@ -1,0 +1,254 @@
+"""Offline resilience CLI.
+
+``python -m selkies_tpu.resilience selftest`` — drive the real restart
+policy, supervisor, degradation ladder, and fault registry with
+injected clocks/schedulers and verify the contracts (the CI lint smoke,
+mirroring ``python -m selkies_tpu.trace selftest`` and ``python -m
+selkies_tpu.obs selftest``). Exits non-zero on any contract break.
+
+Stdlib-only: runs in the lint CI image with no jax/aiohttp installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..obs.health import DEGRADED, FAILED, OK, HealthEngine
+from .faults import FaultError, FaultRegistry, parse_spec
+from .ladder import DegradationLadder
+from .supervisor import RestartPolicy, Supervisor
+
+
+def _fail(msg: str) -> int:
+    print(f"selftest FAILED: {msg}", file=sys.stderr)
+    return 1
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class _Sched:
+    """Manual scheduler: collects (delay, cb); fire() runs them."""
+
+    class _Handle:
+        def __init__(self, sched, entry):
+            self._sched, self._entry = sched, entry
+
+        def cancel(self):
+            if self._entry in self._sched.pending:
+                self._sched.pending.remove(self._entry)
+
+    def __init__(self):
+        self.pending: list = []
+
+    def __call__(self, delay, cb):
+        entry = (delay, cb)
+        self.pending.append(entry)
+        return self._Handle(self, entry)
+
+    def fire(self) -> int:
+        pending, self.pending = self.pending, []
+        for _, cb in pending:
+            cb()
+        return len(pending)
+
+
+def _cmd_selftest(args: argparse.Namespace) -> int:
+    import logging
+    logging.getLogger("selkies_tpu.resilience").setLevel(logging.CRITICAL)
+    # -- restart policy: exact backoff sequence under an injected clock --
+    clk = _Clock()
+    pol = RestartPolicy(max_restarts=3, window_s=100.0, base_backoff_s=1.0,
+                        max_backoff_s=8.0, jitter=0.0, min_uptime_s=5.0,
+                        clock=clk)
+    pol.record_started()
+    clk.t = 10.0                        # healthy 10 s: streak resets
+    if pol.next_backoff() != 1.0:
+        return _fail("first backoff after healthy uptime must be base")
+    pol.record_started()
+    clk.t = 10.5                        # died in 0.5 s: fast death
+    if pol.crash_looping:
+        return _fail("one fast death must not flag crash loop yet")
+    if pol.next_backoff() != 2.0:
+        return _fail("second backoff must double")
+    pol.record_started()
+    clk.t = 11.0                        # 3rd consecutive fast death
+    b = pol.next_backoff()
+    if not pol.crash_looping:
+        return _fail("3 sub-min_uptime deaths must flag crash loop")
+    if b != 4.0:
+        return _fail(f"third backoff must ramp 2^n (got {b})")
+    pol.record_started()
+    clk.t = 11.5
+    if pol.next_backoff() is not None:
+        return _fail("4th death inside the window must exhaust the budget")
+    # jitter determinism: same seed -> same sequence
+    seq = []
+    for _ in range(2):
+        c2 = _Clock()
+        p2 = RestartPolicy(base_backoff_s=1.0, jitter=0.25, seed=42,
+                           min_uptime_s=0.0, clock=c2)
+        p2.record_started()
+        seq.append([p2.next_backoff() for _ in range(3)])
+    if seq[0] != seq[1]:
+        return _fail(f"seeded jitter must be deterministic: {seq}")
+    if any(not (1.0 <= b) for b in seq[0][:1]):
+        return _fail(f"jitter must only add: {seq[0]}")
+
+    # -- supervisor: restart scheduling, give-up, health verdicts --------
+    eng = HealthEngine()
+    sched = _Sched()
+    state = {"restarts": 0, "gave_up": False}
+    sup = Supervisor(recorder=eng.recorder, schedule=sched,
+                     policy_factory=lambda: RestartPolicy(
+                         max_restarts=2, window_s=100.0, base_backoff_s=1.0,
+                         jitter=0.0, min_uptime_s=0.0, clock=clk))
+    sup.adopt("capture::0",
+              lambda: state.__setitem__("restarts", state["restarts"] + 1),
+              on_give_up=lambda: state.__setitem__("gave_up", True))
+    eng.register("supervision", sup.health_check)
+    if eng.run()["supervision"].status != OK:
+        return _fail("idle supervisor must verdict ok")
+    sup.report_death("capture::0", "injected")
+    if eng.run()["supervision"].status != DEGRADED:
+        return _fail("backing-off component must degrade supervision")
+    sup.report_death("capture::0", "coalesce me")   # pending: must coalesce
+    if len(sched.pending) != 1:
+        return _fail("a pending restart must coalesce repeat deaths")
+    sched.fire()
+    if state["restarts"] != 1:
+        return _fail("firing the schedule must run the restart fn")
+    if eng.run()["supervision"].status != OK:
+        return _fail("restarted component must return supervision to ok")
+    sup.report_death("capture::0", "again")
+    sched.fire()
+    sup.report_death("capture::0", "third death: budget is 2")
+    if not state["gave_up"]:
+        return _fail("budget exhaustion must call the give-up hook")
+    if eng.run()["supervision"].status != FAILED:
+        return _fail("budget exhaustion must fail supervision")
+    kinds = [e["kind"] for e in eng.recorder.snapshot()]
+    if kinds.count("supervisor_restart") != 2 or "crash_loop" not in kinds:
+        return _fail(f"incident trail wrong: {kinds}")
+
+    # -- ladder: hysteresis down, hold, sustained-ok up ------------------
+    lclk = _Clock()
+    calls: list[str] = []
+    lad = DegradationLadder(down_after_s=4.0, hold_s=10.0, ok_window_s=30.0,
+                            clock=lclk, recorder=eng.recorder)
+    lad.bind_controls({
+        "fps": (lambda: calls.append("fps-"), lambda: calls.append("fps+")),
+        "quality": (lambda: calls.append("q-"), lambda: calls.append("q+")),
+    })
+    bad = {"qoe": FAILED}
+    lad.observe(bad, now=0.0)
+    if lad.level != 0:
+        return _fail("a transient trigger must not downshift immediately")
+    lad.observe(bad, now=4.0)
+    if lad.level != 1 or calls != ["fps-"]:
+        return _fail(f"4s persistent trigger must downshift: "
+                     f"{lad.level} {calls}")
+    lad.observe(bad, now=8.0)
+    if lad.level != 1:
+        return _fail("hold_s must block back-to-back downshifts")
+    lad.observe(bad, now=15.0)
+    if lad.level != 2 or calls[-1] != "q-":
+        return _fail("persistent trigger past hold must step again")
+    ok_v = {"qoe": OK}
+    lad.observe(ok_v, now=16.0)
+    lad.observe(ok_v, now=40.0)
+    if lad.level != 2:
+        return _fail("ok shorter than ok_window_s must not step up")
+    lad.observe(ok_v, now=46.5)
+    if lad.level != 1 or calls[-1] != "q+":
+        return _fail(f"sustained ok must step up: {lad.level} {calls}")
+    lad.observe(bad, now=47.0)
+    lad.observe(bad, now=51.5)
+    if lad.level != 1:
+        return _fail("hold after a step-up must block an instant downshift")
+    ev = lad.trace_events()
+    if not ev or ev[0]["ph"] != "M" or len(ev) != 1 + lad.transitions:
+        return _fail(f"trace overlay shape broken: {len(ev)} events "
+                     f"for {lad.transitions} transitions")
+    snap = lad.snapshot()
+    json.loads(json.dumps(snap))
+    if snap["level"] != 1 or snap["step"] != "fps":
+        return _fail(f"snapshot wrong: {snap}")
+
+    # -- faults: grammar round-trip, schedule exactness, determinism -----
+    text = ("relay.send:error;capture.source:raise:after=2,count=1;"
+            "encoder.dispatch:slow:delay_s=0.5,count=3;"
+            "ws.accept:close:prob=0.5")
+    specs = parse_spec(text)
+    round_tripped = parse_spec(";".join(s.to_spec() for s in specs))
+    if [s.to_dict() for s in specs] != [s.to_dict() for s in round_tripped]:
+        return _fail("fault spec must round-trip through to_spec()")
+    for bad_spec in ("nope:error", "relay.send:bogus", "relay.send",
+                     "relay.send:error:count=x", "relay.send:error:zzz=1"):
+        try:
+            parse_spec(bad_spec)
+            return _fail(f"bad spec {bad_spec!r} must raise")
+        except ValueError:
+            pass
+    reg = FaultRegistry(seed=7)
+    reg.arm("capture.source:raise:after=2,count=1")
+    reg.pull("relay.send")              # wrong point: no hit consumed
+    for i in range(2):
+        if reg.pull("capture.source") is not None:
+            return _fail(f"after=2 must skip hit {i + 1}")
+    try:
+        reg.perturb("capture.source")
+        return _fail("3rd hit must fire the raise fault")
+    except FaultError as e:
+        if (e.point, e.mode) != ("capture.source", "raise"):
+            return _fail(f"FaultError carries wrong identity: {e}")
+    if reg.pull("capture.source") is not None:
+        return _fail("count=1 must exhaust after one fire")
+    if reg.remaining() != 0 or len(reg.fired_log) != 1:
+        return _fail("remaining/fired accounting broken")
+    # seeded prob: identical draw sequence across registries
+    fires = []
+    for _ in range(2):
+        r = FaultRegistry(seed=1234)
+        r.arm("relay.send:error:prob=0.5,count=100")
+        fires.append([r.pull("relay.send") is not None for _ in range(20)])
+    if fires[0] != fires[1]:
+        return _fail("seeded prob faults must replay identically")
+    if not any(fires[0]) or all(fires[0]):
+        return _fail(f"prob=0.5 over 20 draws should mix: {fires[0]}")
+    reg.disarm()
+    if reg.active():
+        return _fail("disarm must clear the registry")
+
+    doc = {"supervisor": sup.components(), "ladder": snap,
+           "incidents": eng.recorder.snapshot()[-4:]}
+    text = json.dumps(doc)
+    json.loads(text)
+    print(text if args.json
+          else f"selftest OK ({len(text)} bytes of resilience state)")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m selkies_tpu.resilience",
+        description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser("selftest", help="drive policy+supervisor+ladder+"
+                                         "faults with injected clocks")
+    ps.add_argument("--json", action="store_true",
+                    help="print the selftest state payload")
+    ps.set_defaults(fn=_cmd_selftest)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

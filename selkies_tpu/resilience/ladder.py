@@ -1,0 +1,226 @@
+"""Verdict-driven degradation ladder.
+
+The split-frame V-PCC streaming work sheds load when the encoder
+saturates, and the vehicular 8K60 NVENC study holds sustained real-time
+by trading fidelity down *before* the pipeline collapses. This module is
+that discipline for selkies-tpu: a controller that consumes the health
+verdicts the PR-3/PR-4 planes already compute (``qoe`` failed,
+``hbm_headroom`` degraded, ``stage_latency`` over budget) and walks a
+configurable ladder of fidelity concessions —
+
+    level 0  full fidelity
+    level 1  target fps halved (floor: ``min_fps``)
+    level 2  quality/rate cut (JPEG quality down, H.264 bitrate down)
+    level 3  capture downscale
+
+— with **hysteresis** in both directions: a trigger must persist
+``down_after_s`` before the first downshift, ``hold_s`` must elapse
+between any two transitions (no flapping), and a step *up* requires a
+sustained all-ok window of ``ok_window_s``. Every transition is recorded
+as a ``degradation_step`` / ``degradation_recover`` incident, exported
+as the ``selkies_degradation_level`` gauge, and kept in a bounded event
+ring that ``/api/trace`` overlays as a ``resilience`` lane.
+
+The ladder itself is pure state machine (injected clock, no asyncio, no
+deps): transports bind concrete ``down``/``up`` callables per step via
+:meth:`bind_controls`; with nothing bound the ladder still tracks and
+reports level transitions (the verdict trail stays honest even when no
+actuator exists, e.g. webrtc mode before its controls land).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Callable, Mapping, Optional
+
+from ..obs import health as _health
+
+logger = logging.getLogger("selkies_tpu.resilience.ladder")
+
+__all__ = ["DegradationLadder", "DEFAULT_TRIGGERS", "DEFAULT_STEPS"]
+
+#: verdict name -> statuses that count as a degradation trigger.
+#: qoe only on failed (degraded QoE is what the ladder CAUSES while
+#: shedding — reacting to it would latch the bottom rung).
+DEFAULT_TRIGGERS: dict[str, frozenset] = {
+    "qoe": frozenset({_health.FAILED}),
+    "hbm_headroom": frozenset({_health.DEGRADED, _health.FAILED}),
+    "hbm": frozenset({_health.DEGRADED, _health.FAILED}),
+    "stage_latency": frozenset({_health.DEGRADED, _health.FAILED}),
+}
+
+#: rung names above level 0, in downshift order
+DEFAULT_STEPS = ("fps", "quality", "downscale")
+
+_EVENT_CAP = 64
+
+
+class DegradationLadder:
+    def __init__(self, *,
+                 steps: tuple = DEFAULT_STEPS,
+                 triggers: Optional[Mapping] = None,
+                 down_after_s: float = 4.0,
+                 hold_s: float = 10.0,
+                 ok_window_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 recorder: Optional[_health.FlightRecorder] = None):
+        self.steps = tuple(steps)
+        self.triggers = dict(triggers if triggers is not None
+                             else DEFAULT_TRIGGERS)
+        self.down_after_s = float(down_after_s)
+        self.hold_s = float(hold_s)
+        self.ok_window_s = float(ok_window_s)
+        self._clock = clock
+        self.recorder = recorder if recorder is not None \
+            else _health.engine.recorder
+        self._lock = threading.Lock()
+        self.level = 0
+        self.transitions = 0
+        self._bad_since: Optional[float] = None
+        self._ok_since: Optional[float] = None
+        self._last_change: Optional[float] = None
+        self._last_reasons: list[str] = []
+        #: step name -> (down_fn, up_fn); bound by the active transport
+        self._controls: dict[str, tuple[Callable, Callable]] = {}
+        #: (name, perf_ns, level, reasons) ring for the trace overlay
+        self._events: collections.deque = collections.deque(
+            maxlen=_EVENT_CAP)
+
+    # -- controls ------------------------------------------------------------
+    def bind_controls(self, controls: Mapping[str, tuple]) -> None:
+        """``{step: (down_fn, up_fn)}`` from the active transport. Steps
+        with no control still transition (tracked + recorded), they just
+        actuate nothing."""
+        with self._lock:
+            self._controls.update(controls)
+
+    def unbind_controls(self) -> None:
+        with self._lock:
+            self._controls.clear()
+
+    # -- state machine -------------------------------------------------------
+    def _trigger_reasons(self, verdicts: Mapping) -> list[str]:
+        reasons = []
+        for name, bad in self.triggers.items():
+            v = verdicts.get(name)
+            status = getattr(v, "status", v)
+            if status in bad:
+                reasons.append(f"{name}={status}")
+        return sorted(reasons)
+
+    def observe(self, verdicts: Mapping, now: Optional[float] = None) -> None:
+        """One controller tick against the current verdict set (values
+        may be Verdict objects or bare status strings)."""
+        if now is None:
+            now = self._clock()
+        reasons = self._trigger_reasons(verdicts)
+        if reasons:
+            self._ok_since = None
+            if self._bad_since is None:
+                self._bad_since = now
+            self._last_reasons = reasons
+            if self.level >= len(self.steps):
+                return
+            if now - self._bad_since < self.down_after_s:
+                return
+            if self._last_change is not None \
+                    and now - self._last_change < self.hold_s:
+                return
+            self._shift(now, +1, reasons)
+            # a further downshift needs the trigger to PERSIST past the
+            # hold from this new level, not re-accumulate from zero
+            self._bad_since = now
+        else:
+            self._bad_since = None
+            if self._ok_since is None:
+                self._ok_since = now
+            if self.level == 0:
+                return
+            if now - self._ok_since < self.ok_window_s:
+                return
+            if self._last_change is not None \
+                    and now - self._last_change < self.hold_s:
+                return
+            self._shift(now, -1, ["sustained-ok "
+                                  f"{self.ok_window_s:g}s"])
+
+    def _shift(self, now: float, direction: int, reasons: list[str]) -> None:
+        if direction > 0:
+            step = self.steps[self.level]
+            self.level += 1
+            fn_idx, kind = 0, "degradation_step"
+        else:
+            self.level -= 1
+            step = self.steps[self.level]
+            fn_idx, kind = 1, "degradation_recover"
+        self.transitions += 1
+        self._last_change = now
+        with self._lock:
+            ctl = self._controls.get(step)
+        applied = False
+        if ctl is not None:
+            try:
+                # a control returning the explicit sentinel False says
+                # "nothing to shed/restore here" (e.g. fps already at
+                # the floor) — the incident must not claim otherwise
+                applied = ctl[fn_idx]() is not False
+            except Exception:
+                logger.exception("ladder %s control for step %s failed",
+                                 "down" if direction > 0 else "up", step)
+        self.recorder.record(kind, step=step, level=self.level,
+                             reasons=reasons, applied=applied)
+        self._events.append((kind, time.perf_counter_ns(), self.level,
+                             step, reasons))
+        _metrics_level(self.level)
+        logger.warning("degradation ladder %s -> level %d (%s: %s)%s",
+                       "down" if direction > 0 else "up", self.level,
+                       step, ", ".join(reasons),
+                       "" if applied else " [no control bound]")
+
+    # -- reporting -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {
+            "level": self.level,
+            "step": self.steps[self.level - 1] if self.level else None,
+            "steps": list(self.steps),
+            "transitions": self.transitions,
+            "active_triggers": list(self._last_reasons)
+            if self._bad_since is not None else [],
+            "controls_bound": sorted(self._controls),
+        }
+
+    def trace_events(self, pid: int = 1, tid: int = 97) -> list[dict]:
+        """Ladder transitions as Chrome trace instants on a
+        ``resilience`` lane (same perf_counter µs timebase as the frame,
+        device and qoe lanes at ``/api/trace``)."""
+        events = list(self._events)
+        if not events:
+            return []
+        out: list[dict] = [{
+            "ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+            "args": {"name": "resilience"},
+        }]
+        for kind, t_ns, level, step, reasons in events:
+            out.append({
+                "name": f"{kind} L{level} ({step})",
+                "ph": "i", "s": "g", "pid": pid, "tid": tid,
+                "ts": t_ns / 1e3,
+                "args": {"level": level, "step": step,
+                         "reasons": list(reasons)},
+            })
+        return out
+
+
+# -- optional metrics bridge (lazy; lint image has no server deps) ----------
+
+def _metrics_level(level: int) -> None:
+    try:
+        from ..server import metrics
+    except Exception:
+        return
+    metrics.describe("selkies_degradation_level",
+                     "Current degradation-ladder level (0 = full fidelity)")
+    metrics.set_gauge("selkies_degradation_level", level)

@@ -1,0 +1,368 @@
+"""Restart-policy engine: the recovery half of the observability loop.
+
+PRs 2-4 made every failure mode visible — relay deaths, capture-loop
+crashes, dead transport services, QoE collapse — and none of them
+*recoverable*: the capture thread logged "capture loop died" and went
+dark, ``switch_to_mode`` cleared ``active_mode`` and waited for a human.
+This module owns the decision that was missing: **when a component dies,
+restart it — but never in a tight loop, never forever, and always
+visibly.**
+
+Pieces:
+
+- :class:`RestartPolicy` — pure backoff math, fully injectable clock +
+  seeded jitter so tests and the selftest assert exact sequences:
+  exponential backoff (``base * 2^n`` capped at ``max``), deterministic
+  jitter, a restart budget inside a sliding window, and crash-loop
+  detection (deaths faster than ``min_uptime_s`` escalate straight to
+  the backoff cap).
+- :class:`Supervisor` — component registry: ``adopt()`` a name +
+  restart callable, ``report_death()`` when it dies. Scheduling is an
+  injectable ``schedule(delay, cb) -> handle`` seam (default: the
+  running asyncio loop's ``call_later``) so recovery tests never sleep
+  wall-clock. Each restart emits a ``supervisor_restart`` incident and
+  ``selkies_supervisor_restarts_total{component}``; budget exhaustion
+  emits ``crash_loop`` and parks the component in ``failed``.
+- :meth:`Supervisor.health_check` — the ``supervision`` health verdict:
+  ``degraded`` while any component is backing off, ``failed`` once any
+  exhausted its budget.
+
+Stdlib-only (asyncio used lazily): the CI lint image drives the selftest
+with neither jax nor aiohttp installed.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from ..obs import health as _health
+
+logger = logging.getLogger("selkies_tpu.resilience.supervisor")
+
+__all__ = ["RestartPolicy", "SupervisedComponent", "Supervisor"]
+
+#: component states
+RUNNING = "running"
+BACKING_OFF = "backing_off"
+FAILED = "failed"
+
+
+class RestartPolicy:
+    """Backoff/budget math for one supervised component.
+
+    Deterministic by construction: the clock is injected and jitter
+    draws from a seeded RNG, so ``next_backoff()`` sequences are exact
+    in tests. A fresh policy instance is made per component (it carries
+    death-history state).
+    """
+
+    #: consecutive fast deaths before the crash-loop escalation flags
+    CRASH_LOOP_AFTER = 3
+
+    def __init__(self, max_restarts: int = 5, window_s: float = 300.0,
+                 base_backoff_s: float = 0.5, max_backoff_s: float = 30.0,
+                 jitter: float = 0.1, min_uptime_s: float = 5.0,
+                 seed: int = 0, clock: Callable[[], float] = time.monotonic):
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self.base_backoff_s = float(base_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.jitter = float(jitter)
+        self.min_uptime_s = float(min_uptime_s)
+        self._rng = random.Random(seed)
+        self._clock = clock
+        self._deaths: list[float] = []     # death times inside the window
+        self._streak = 0                   # consecutive fast deaths
+        self._last_restart: Optional[float] = None
+
+    def record_started(self) -> None:
+        """The component is (re)started; uptime measurement begins."""
+        self._last_restart = self._clock()
+
+    @property
+    def crash_looping(self) -> bool:
+        """True once ``CRASH_LOOP_AFTER`` consecutive deaths arrived
+        faster than ``min_uptime_s`` each — the escalation flag carried
+        on incidents (and the point where the exponential ramp has
+        already driven the backoff to its cap region)."""
+        return self._streak >= self.CRASH_LOOP_AFTER
+
+    def restarts_in_window(self) -> int:
+        now = self._clock()
+        self._deaths = [t for t in self._deaths if now - t <= self.window_s]
+        return len(self._deaths)
+
+    def next_backoff(self) -> Optional[float]:
+        """Record a death; -> backoff seconds before the next restart,
+        or None when the budget inside the window is exhausted."""
+        now = self._clock()
+        uptime = None if self._last_restart is None \
+            else now - self._last_restart
+        if uptime is not None and uptime >= self.min_uptime_s:
+            self._streak = 0
+        self._streak += 1
+        self._deaths.append(now)
+        if self.restarts_in_window() > self.max_restarts:
+            return None
+        # consecutive fast deaths ramp 2^n toward the cap; a healthy
+        # stretch (>= min_uptime_s) resets the ramp to the base
+        backoff = min(self.max_backoff_s,
+                      self.base_backoff_s * (2 ** (self._streak - 1)))
+        if self.jitter > 0:
+            backoff += backoff * self.jitter * self._rng.random()
+        return backoff
+
+
+class SupervisedComponent:
+    __slots__ = ("name", "restart_fn", "policy", "state", "restarts",
+                 "last_error", "on_give_up", "_handle", "_task",
+                 "_pending_death")
+
+    def __init__(self, name: str, restart_fn: Callable, policy: RestartPolicy,
+                 on_give_up: Optional[Callable[[], None]] = None):
+        self.name = name
+        self.restart_fn = restart_fn
+        self.policy = policy
+        self.state = RUNNING
+        self.restarts = 0
+        self.last_error = ""
+        self.on_give_up = on_give_up
+        self._handle = None         # pending backoff-timer handle
+        self._task = None           # in-flight async restart (strong ref)
+        self._pending_death = None  # death queued behind that restart
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "state": self.state,
+                "restarts": self.restarts, "last_error": self.last_error,
+                "crash_looping": self.policy.crash_looping}
+
+
+def _default_schedule(delay: float, cb: Callable[[], None]):
+    """Default scheduler: the running asyncio loop. Imported lazily so
+    the policy math stays usable in loop-less contexts (selftest)."""
+    import asyncio
+    return asyncio.get_running_loop().call_later(delay, cb)
+
+
+class Supervisor:
+    """Component registry + restart driver.
+
+    ``schedule`` is the injection seam: ``schedule(delay_s, cb)`` must
+    return a handle with ``.cancel()``. The default uses the running
+    asyncio loop; deterministic tests pass a manual scheduler and fire
+    callbacks by hand. ``report_death`` is loop-thread affine in the
+    default configuration (capture threads hop via
+    ``call_soon_threadsafe`` at the wiring site).
+    """
+
+    def __init__(self, recorder: Optional[_health.FlightRecorder] = None,
+                 policy_factory: Optional[Callable[[], RestartPolicy]] = None,
+                 schedule: Callable = _default_schedule):
+        self._components: dict[str, SupervisedComponent] = {}
+        self._lock = threading.Lock()
+        self.recorder = recorder if recorder is not None \
+            else _health.engine.recorder
+        self.policy_factory = policy_factory or RestartPolicy
+        self.schedule = schedule
+        self.total_restarts = 0
+        self._closed = False
+
+    # -- registry ------------------------------------------------------------
+    def adopt(self, name: str, restart_fn: Callable,
+              policy: Optional[RestartPolicy] = None,
+              on_give_up: Optional[Callable[[], None]] = None
+              ) -> SupervisedComponent:
+        """Register (or re-register) a component. Re-adoption keeps the
+        existing policy state — a service that re-registers its closure
+        on every (re)start must not reset its own crash accounting.
+
+        Re-adopting a FAILED component un-parks it: adoption happens on
+        deliberate (re)starts (operator switch, client START_VIDEO), so
+        the next death must be SUPERVISED again, not silently ignored.
+        The policy's sliding-window death history is kept, so a death
+        arriving before the old ones age out immediately re-exhausts the
+        budget — visibly, with a fresh ``crash_loop`` incident."""
+        with self._lock:
+            comp = self._components.get(name)
+            if comp is None:
+                comp = SupervisedComponent(
+                    name, restart_fn, policy or self.policy_factory(),
+                    on_give_up)
+                comp.policy.record_started()
+                self._components[name] = comp
+            else:
+                comp.restart_fn = restart_fn
+                if on_give_up is not None:
+                    comp.on_give_up = on_give_up
+                if policy is not None:
+                    comp.policy = policy
+                if comp.state == FAILED:
+                    comp.state = RUNNING
+                    comp.policy.record_started()
+            return comp
+
+    def drop(self, name: str) -> None:
+        """Deliberate teardown (client left, service stopping): cancel
+        any pending restart and forget the component."""
+        with self._lock:
+            comp = self._components.pop(name, None)
+        if comp is not None:
+            for h in (comp._handle, comp._task):
+                if h is not None:
+                    try:
+                        h.cancel()
+                    except Exception:
+                        pass
+
+    def get(self, name: str) -> Optional[SupervisedComponent]:
+        with self._lock:
+            return self._components.get(name)
+
+    def components(self) -> list[dict]:
+        with self._lock:
+            comps = list(self._components.values())
+        return [c.to_dict() for c in comps]
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            comps = list(self._components.values())
+            self._components.clear()
+        for c in comps:
+            for h in (c._handle, c._task):
+                if h is not None:
+                    try:
+                        h.cancel()
+                    except Exception:
+                        pass
+
+    # -- death handling ------------------------------------------------------
+    def report_death(self, name: str, reason: str = "") -> None:
+        """A supervised component died. Decide: restart after backoff,
+        or give up (budget exhausted / crash loop past budget)."""
+        if self._closed:
+            return
+        comp = self.get(name)
+        if comp is None or comp.state == FAILED:
+            return
+        if comp.state == BACKING_OFF:
+            return      # a restart is already pending; coalesce
+        if comp._task is not None:
+            # an async restart is still in flight: a second schedule now
+            # would run two restarts concurrently. QUEUE the death — the
+            # restart may well succeed (e.g. the new capture thread
+            # started, then crashed before the executor future resolved)
+            # and dropping this report would abandon the component with
+            # supervision reading ok.
+            comp._pending_death = str(reason)[:200]
+            return
+        comp.last_error = str(reason)[:200]
+        backoff = comp.policy.next_backoff()
+        if backoff is None:
+            comp.state = FAILED
+            self.recorder.record(
+                "crash_loop", component=name, reason=comp.last_error,
+                restarts=comp.restarts)
+            logger.error("component %s exhausted its restart budget "
+                         "(%d restarts); giving up", name, comp.restarts)
+            if comp.on_give_up is not None:
+                try:
+                    comp.on_give_up()
+                except Exception:
+                    logger.exception("give-up hook for %s failed", name)
+            return
+        comp.state = BACKING_OFF
+        comp.restarts += 1
+        self.total_restarts += 1
+        self.recorder.record(
+            "supervisor_restart", component=name, reason=comp.last_error,
+            backoff_s=round(backoff, 3), restart=comp.restarts,
+            crash_looping=comp.policy.crash_looping)
+        _metrics_restart(name)
+        logger.warning("component %s died (%s); restart %d in %.2fs%s",
+                       name, comp.last_error or "no reason", comp.restarts,
+                       backoff, " [crash-looping]"
+                       if comp.policy.crash_looping else "")
+        comp._handle = self.schedule(backoff, lambda: self._fire(name))
+
+    def _fire(self, name: str) -> None:
+        """Backoff elapsed: run the restart callable. A sync callable
+        that raises (or an awaitable that fails) counts as another
+        death, feeding the policy again."""
+        comp = self.get(name)
+        if comp is None or self._closed:
+            return
+        comp._handle = None
+        comp.state = RUNNING
+        comp.policy.record_started()
+        try:
+            res = comp.restart_fn()
+        except Exception as e:
+            logger.exception("restart of %s failed", name)
+            self.report_death(name, f"restart failed: "
+                              f"{type(e).__name__}: {e}")
+            return
+        if res is not None and hasattr(res, "__await__"):
+            import asyncio
+            task = asyncio.ensure_future(res)
+            # strong-ref the in-flight restart on its OWN slot (the
+            # timer handle slot gets reused by the next death report;
+            # sharing would drop this task's only strong reference)
+            comp._task = task
+
+            def _done(t, name=name):
+                c = self.get(name)
+                pending = None
+                if c is not None:
+                    if c._task is t:
+                        c._task = None
+                    # always consume the queued death: a stale one must
+                    # not replay against a LATER restart's completion
+                    pending, c._pending_death = c._pending_death, None
+                if t.cancelled():
+                    return
+                exc = t.exception()
+                if exc is not None:
+                    self.report_death(name, f"restart failed: "
+                                      f"{type(exc).__name__}: {exc}")
+                elif pending is not None:
+                    # the restart succeeded but the component died again
+                    # while it was in flight: replay the queued death
+                    self.report_death(name, pending)
+            task.add_done_callback(_done)
+
+    # -- health --------------------------------------------------------------
+    def health_check(self) -> _health.Verdict:
+        """The ``supervision`` check: failed once any component
+        exhausted its budget, degraded while any is backing off."""
+        comps = self.components()
+        dead = [c["name"] for c in comps if c["state"] == FAILED]
+        if dead:
+            return _health.failed(
+                f"restart budget exhausted: {', '.join(sorted(dead))}",
+                components=dead)
+        waiting = [c["name"] for c in comps if c["state"] == BACKING_OFF]
+        if waiting:
+            return _health.degraded(
+                f"backing off before restart: {', '.join(sorted(waiting))}",
+                components=waiting)
+        n = sum(c["restarts"] for c in comps)
+        return _health.ok(f"{len(comps)} supervised, {n} restarts",
+                          supervised=len(comps), restarts=n)
+
+
+# -- optional metrics bridge (lazy; lint image has no server deps) ----------
+
+def _metrics_restart(component: str) -> None:
+    try:
+        from ..server import metrics
+    except Exception:
+        return
+    metrics.describe("selkies_supervisor_restarts_total",
+                     "Supervised component restarts by component")
+    metrics.inc_counter("selkies_supervisor_restarts_total",
+                        labels={"component": component})

@@ -44,6 +44,9 @@ from aiohttp import web
 
 from ..obs import health as _health
 from ..obs import qoe as _qoe
+from ..resilience import faults as _faults
+from ..resilience.ladder import DegradationLadder
+from ..resilience.supervisor import RestartPolicy, Supervisor
 from ..settings import AppSettings, is_sensitive
 
 logger = logging.getLogger("selkies_tpu.server.core")
@@ -100,6 +103,39 @@ class CentralizedStreamServer:
             failed_score=getattr(settings, "qoe_failed_score", None))
         self._check_qoe = lambda: _qoe.registry.health_check()
         self.health.register("qoe", self._check_qoe)
+        # resilience plane (selkies_tpu/resilience): the supervisor owns
+        # every restart decision (transport service here; captures,
+        # relays and audio adopt through it from the services), the
+        # ladder sheds fidelity on bad verdicts. Policy knobs from
+        # settings; per-component policies share the factory.
+        self.supervisor = Supervisor(
+            recorder=self.health.recorder,
+            policy_factory=lambda: RestartPolicy(
+                max_restarts=int(getattr(
+                    settings, "supervisor_max_restarts", 5)),
+                window_s=float(getattr(
+                    settings, "supervisor_window_s", 300.0)),
+                base_backoff_s=float(getattr(
+                    settings, "supervisor_backoff_base_s", 0.5)),
+                max_backoff_s=float(getattr(
+                    settings, "supervisor_backoff_max_s", 30.0))))
+        self._check_supervision = self.supervisor.health_check
+        self.health.register("supervision", self._check_supervision)
+        self.ladder: Optional[DegradationLadder] = None
+        if getattr(settings, "enable_degradation_ladder", True):
+            self.ladder = DegradationLadder(
+                down_after_s=float(getattr(
+                    settings, "ladder_down_after_s", 4.0)),
+                hold_s=float(getattr(settings, "ladder_hold_s", 10.0)),
+                ok_window_s=float(getattr(
+                    settings, "ladder_ok_window_s", 30.0)),
+                recorder=self.health.recorder)
+        self._ladder_task: Optional[asyncio.Task] = None
+        #: serialises switch_to_mode: two overlapping switches must not
+        #: interleave stop/start and strand a service
+        self._switch_lock = asyncio.Lock()
+        if getattr(settings, "fault_inject", ""):
+            _faults.registry.arm(settings.fault_inject)
         self._setup_routes()
 
     # ------------------------------------------------------------------ auth
@@ -167,6 +203,9 @@ class CentralizedStreamServer:
         r.add_post("/api/trace", self.handle_trace_control)
         r.add_get("/api/sessions", self.handle_sessions)
         r.add_post("/api/profile", self.handle_profile)
+        r.add_get("/api/faults", self.handle_faults)
+        r.add_post("/api/faults", self.handle_faults_control)
+        r.add_get("/api/resilience", self.handle_resilience)
         if self.settings.secure_api:
             r.add_post("/api/tokens", self.handle_mint_token)
             r.add_get("/api/tokens", self.handle_list_tokens)
@@ -305,6 +344,60 @@ class CentralizedStreamServer:
         verbose = request.query.get("verbose") in ("1", "true")
         return web.json_response(_qoe.registry.report(verbose=verbose))
 
+    async def handle_faults(self, request: web.Request) -> web.Response:
+        """Armed fault-injection state (full-role: fault specs reveal —
+        and steer — failure behaviour)."""
+        if request["role"] != "full":
+            return web.Response(status=403, text="view-only")
+        return web.json_response({
+            "active": _faults.registry.active(),
+            "remaining": _faults.registry.remaining(),
+            "fired": list(_faults.registry.fired_log),
+            "seed": _faults.registry.seed,
+        })
+
+    async def handle_faults_control(self, request: web.Request
+                                    ) -> web.Response:
+        """POST {"action": "arm", "spec": "point:mode[:k=v,...];..."
+        [, "seed": N]} | {"action": "disarm"[, "point": p]}."""
+        if request["role"] != "full":
+            return web.Response(status=403, text="view-only")
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            return web.Response(status=400, text="JSON object body required")
+        action = body.get("action", "arm")
+        if action == "arm":
+            spec = body.get("spec", "")
+            try:
+                armed = _faults.registry.arm(spec, seed=body.get("seed"))
+            except (ValueError, TypeError) as e:
+                return web.Response(status=400, text=f"bad fault spec: {e}")
+            if not armed:
+                return web.Response(status=400, text="empty fault spec")
+            return web.json_response({"armed": [s.to_dict() for s in armed]})
+        if action == "disarm":
+            removed = _faults.registry.disarm(body.get("point"))
+            return web.json_response({"removed": removed})
+        return web.Response(
+            status=400, text=f"unknown action {action!r} (want arm|disarm)")
+
+    async def handle_resilience(self, request: web.Request) -> web.Response:
+        """Supervisor + ladder + faults in one operator snapshot."""
+        if request["role"] != "full":
+            return web.Response(status=403, text="view-only")
+        return web.json_response({
+            "supervisor": {
+                "components": self.supervisor.components(),
+                "total_restarts": self.supervisor.total_restarts,
+            },
+            "ladder": self.ladder.snapshot() if self.ladder else None,
+            "faults": {"active": _faults.registry.active(),
+                       "fired": len(_faults.registry.fired_log)},
+        })
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
         from .metrics import render_prometheus
         return web.Response(text=render_prometheus(),
@@ -326,6 +419,10 @@ class CentralizedStreamServer:
         # qoe-lane overlay: backpressure windows against the frame
         # timeline, so a Perfetto view shows WHEN a seat was paused
         doc["traceEvents"].extend(_qoe.registry.trace_events())
+        # resilience-lane overlay: ladder transitions, so a Perfetto
+        # view shows WHERE fidelity was shed against the frame timeline
+        if self.ladder is not None:
+            doc["traceEvents"].extend(self.ladder.trace_events())
         doc["otherData"] = tracer.stats(frames=len(snap))
         doc["otherData"]["compile"] = monitor.compile_stats()
         return web.json_response(doc)
@@ -546,26 +643,65 @@ class CentralizedStreamServer:
 
     async def switch_to_mode(self, mode: str) -> None:
         """Stop the active transport, start the requested one (reference
-        stream_server.py:804-895). Service death clears active_mode."""
-        if mode == self.active_mode:
-            return
-        if self.active_mode and self.active_mode in self.services:
-            await self.services[self.active_mode].stop()
-            if self._service_task:
-                self._service_task.cancel()
-                self._service_task = None
-        svc = self.services[mode]
-        self.active_mode = mode
+        stream_server.py:804-895). Serialised: two overlapping switches
+        used to interleave stop/start and strand a service. Service
+        death is SUPERVISED — restarts with backoff, and only a
+        crash-loop past the budget clears active_mode."""
+        async with self._switch_lock:
+            if mode == self.active_mode:
+                return
+            old = self.active_mode
+            if old and old in self.services:
+                await self.services[old].stop()
+                self.supervisor.drop(f"service:{old}")
+                if self._service_task:
+                    # await the cancelled task: its finally-blocks must
+                    # finish before the next service starts, or the two
+                    # lifetimes interleave
+                    self._service_task.cancel()
+                    try:
+                        await self._service_task
+                    except asyncio.CancelledError:
+                        pass
+                    except Exception:
+                        logger.exception("service %s teardown error", old)
+                    self._service_task = None
+            svc = self.services[mode]
+            self.active_mode = mode
 
+            async def _restart_service(mode=mode, svc=svc):
+                # same lock as switch_to_mode: a supervised restart must
+                # not interleave with an operator-driven switch
+                async with self._switch_lock:
+                    if self.active_mode != mode:
+                        return
+                    try:
+                        await svc.stop()    # clear half-started state
+                    except Exception:
+                        logger.exception("pre-restart stop of %s failed",
+                                         mode)
+                    self._start_service_task(mode, svc)
+
+            def _give_up(mode=mode):
+                if self.active_mode == mode:
+                    self.active_mode = None
+
+            self.supervisor.adopt(f"service:{mode}", _restart_service,
+                                  on_give_up=_give_up)
+            self._start_service_task(mode, svc)
+
+    def _start_service_task(self, mode: str, svc: BaseStreamingService
+                            ) -> None:
         async def _run_service():
             try:
                 await svc.start()
             except asyncio.CancelledError:
                 raise
-            except Exception:
+            except Exception as e:
                 logger.exception("service %s died", mode)
                 if self.active_mode == mode:
-                    self.active_mode = None
+                    self.supervisor.report_death(
+                        f"service:{mode}", f"{type(e).__name__}: {e}")
 
         self._service_task = asyncio.create_task(_run_service())
 
@@ -612,10 +748,24 @@ class CentralizedStreamServer:
         if self._ssl_ctx is not None:
             self._cert_watch_task = asyncio.create_task(
                 self._watch_and_reload_certs())
+        if self.ladder is not None:
+            self._ladder_task = asyncio.create_task(self._ladder_loop())
         logger.info("listening on %s:%d (%s)", self.settings.addr,
                     self.settings.port,
                     "https" if self._ssl_ctx else "http")
         return self._runner
+
+    async def _ladder_loop(self) -> None:
+        """Degradation-controller driver: evaluate the health checks on
+        a cadence and feed the verdict set to the ladder."""
+        assert self.ladder is not None
+        interval = float(getattr(self.settings, "ladder_interval_s", 2.0))
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self.ladder.observe(self.health.run())
+            except Exception:
+                logger.exception("degradation ladder tick failed")
 
     async def shutdown(self) -> None:
         # owner-matched: a newer in-process server may have replaced
@@ -623,11 +773,21 @@ class CentralizedStreamServer:
         self.health.unregister("service", self._check_service)
         self.health.unregister("stage_latency", self._check_stage_latency)
         self.health.unregister("qoe", self._check_qoe)
+        self.health.unregister("supervision", self._check_supervision)
+        self.supervisor.close()
+        if self._ladder_task:
+            self._ladder_task.cancel()
         if self._cert_watch_task:
             self._cert_watch_task.cancel()
         if self.active_mode and self.active_mode in self.services:
             await self.services[self.active_mode].stop()
         if self._service_task:
             self._service_task.cancel()
+            try:
+                await self._service_task
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                logger.exception("service task teardown error")
         if self._runner:
             await self._runner.cleanup()

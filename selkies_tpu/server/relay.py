@@ -29,6 +29,7 @@ from typing import Awaitable, Callable, Optional
 from ..obs import health as _health
 from ..protocol import (FRAME_TYPE_IDR, OP_H264, OP_JPEG,
                         unpack_h264_header, unpack_jpeg_header)
+from ..resilience import faults as _faults
 from ..trace import tracer as _tracer
 from . import metrics
 
@@ -79,8 +80,10 @@ class VideoRelay:
                  budget_bytes: int = RELAY_FLOOR_BYTES,
                  request_idr: Optional[Callable[[], None]] = None,
                  on_dead: Optional[Callable[[], None]] = None,
-                 display: Optional[str] = None):
+                 display: Optional[str] = None,
+                 send_timeout_s: float = SEND_TIMEOUT_S):
         self._send = send_bytes
+        self.send_timeout_s = float(send_timeout_s)
         self.budget = max(budget_bytes, RELAY_FLOOR_BYTES)
         self._request_idr = request_idr
         self._on_dead = on_dead
@@ -171,7 +174,8 @@ class VideoRelay:
                 traced = _tracer.enabled and self.display is not None
                 try:
                     t0 = time.perf_counter_ns() if traced else 0
-                    await asyncio.wait_for(self._send(item), SEND_TIMEOUT_S)
+                    await asyncio.wait_for(self._guarded_send(item),
+                                           self.send_timeout_s)
                     if traced:
                         fid = _wire_frame_id(item)
                         if fid is not None:
@@ -183,7 +187,8 @@ class VideoRelay:
                                         len(item),
                                         labels={"display":
                                                 self.display or "?"})
-                except (asyncio.TimeoutError, ConnectionError, OSError):
+                except (asyncio.TimeoutError, ConnectionError, OSError,
+                        _faults.FaultError):
                     # cancelled mid-send = possibly torn frame; this socket
                     # must never carry media again.
                     logger.info("relay send failed/stalled; marking dead")
@@ -191,6 +196,13 @@ class VideoRelay:
                     return
         except asyncio.CancelledError:
             pass
+
+    async def _guarded_send(self, item: bytes) -> None:
+        """The media send plus its fault point (``relay.send``: a
+        ``stall`` sleeps past the send bound so wait_for trips exactly
+        like a wedged TCP socket; an ``error`` raises)."""
+        await _faults.registry.perturb_async("relay.send")
+        await self._send(item)
 
     def _mark_dead(self) -> None:
         """A send stalled/failed: this socket never carries media again.

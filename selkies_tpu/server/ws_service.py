@@ -35,6 +35,7 @@ from ..engine.types import EncodedChunk
 from ..obs import health as _health
 from ..obs import logctx as _logctx
 from ..obs import qoe as _qoe
+from ..resilience import faults as _faults
 from ..settings import AppSettings, SettingsError
 from ..taskutil import spawn_retained
 from ..trace import tracer as _tracer
@@ -166,6 +167,9 @@ class WebSocketsService(BaseStreamingService):
         self._last_conn_by_ip: dict[str, float] = {}
         self._grace_task: Optional[asyncio.Task] = None
         self._stats_task: Optional[asyncio.Task] = None
+        #: pre-degradation values the ladder's step-up rungs restore
+        self._pre_degrade: dict = {}
+        self._ladder_bound = False
 
     # ---------------------------------------------------------------- routes
     def register_routes(self, app: web.Application) -> None:
@@ -286,6 +290,16 @@ class WebSocketsService(BaseStreamingService):
             # no capture/encode loop (ADVICE r5)
             await self.audio.start(
                 mic_only=not self.settings.enable_audio)
+            sup = self._supervisor()
+            if sup is not None \
+                    and hasattr(self.audio, "restart_encode_loop"):
+                # supervised audio: the pipeline reports its encode-loop
+                # death instead of self-retrying on a fixed 1 s beat
+                sup.adopt("audio", self.audio.restart_encode_loop)
+                self.audio.on_death = \
+                    lambda exc: sup.report_death(
+                        "audio", f"{type(exc).__name__}: {exc}")
+        self._bind_ladder()
         self._stats_task = asyncio.create_task(self._stats_loop())
         # watched RTC config file: edits reach connected clients as an
         # rtc_config push, so ICE-server rotation needs no reconnect
@@ -302,10 +316,17 @@ class WebSocketsService(BaseStreamingService):
         self._register_health_checks()
         logger.info("websockets service started")
 
-    def _spawn_retained(self, coro) -> asyncio.Task:
+    def _spawn_retained(self, coro, component: str = "ws_service"
+                        ) -> asyncio.Task:
         """Background task retained on the service; cancelled in
         stop()."""
-        return spawn_retained(self._bg_tasks, coro)
+        return spawn_retained(self._bg_tasks, coro, component)
+
+    def _supervisor(self):
+        """The core's restart-policy engine; None when the service runs
+        without a core (some unit tests) — wiring then degrades to the
+        pre-PR-5 unsupervised behaviour."""
+        return getattr(getattr(self, "core", None), "supervisor", None)
 
     # --------------------------------------------------------------- health
     def _register_health_checks(self) -> None:
@@ -375,12 +396,168 @@ class WebSocketsService(BaseStreamingService):
         return _health.ok("mic-only pipeline" if not s.enable_audio
                           else "audio pipeline running")
 
+    # ----------------------------------------------------- degradation ladder
+    def _bind_ladder(self) -> None:
+        """Bind concrete actuators to the core's degradation ladder:
+        rung 1 halves target fps (floor ``ladder_min_fps``), rung 2 cuts
+        JPEG quality / H.264 bitrate, rung 3 downscales the capture.
+        Step-up restores the values captured at downshift time."""
+        ladder = getattr(getattr(self, "core", None), "ladder", None)
+        if ladder is None:
+            return
+        ladder.bind_controls({
+            "fps": (self._ladder_fps_down, self._ladder_fps_up),
+            "quality": (self._ladder_quality_down, self._ladder_quality_up),
+            "downscale": (self._ladder_scale_down, self._ladder_scale_up),
+        })
+        self._ladder_bound = True
+
+    def _ladder_restore(self, key: str, current) -> "Optional[int]":
+        """Pop a (original, what_we_set) pre-degradation record; -> the
+        original to restore, or None when the operator/client changed
+        the value since the downshift — their choice wins, the ladder
+        must not clobber it on step-up."""
+        rec = self._pre_degrade.pop(key, None)
+        if rec is None:
+            return None
+        orig, set_to = rec
+        if current != set_to:
+            logger.info("ladder: %s changed to %s while degraded; "
+                        "not restoring %s", key, current, orig)
+            return None
+        return orig
+
+    def _ladder_fps_down(self):
+        s = self.settings
+        cur = int(s.framerate)
+        new = int(max(float(getattr(s, "ladder_min_fps", 15.0)), cur / 2))
+        if new >= cur:
+            return False            # already at the floor: not applied
+        self._pre_degrade.setdefault("framerate", (cur, new))
+        s.set_server("framerate", new)
+        for cap in self.captures.values():
+            cap.update_framerate(float(new))
+        logger.warning("ladder: target fps %d -> %d", cur, new)
+
+    def _ladder_fps_up(self):
+        old = self._ladder_restore("framerate", int(self.settings.framerate))
+        if old is None:
+            return False            # nothing to restore: not applied
+        self.settings.set_server("framerate", int(old))
+        for cap in self.captures.values():
+            cap.update_framerate(float(old))
+        logger.info("ladder: target fps restored to %d", old)
+
+    def _ladder_quality_down(self) -> None:
+        s = self.settings
+        q, kbps = int(s.jpeg_quality), int(s.video_bitrate_kbps)
+        new_q = max(15, q - 25)
+        new_kbps = max(500, kbps // 2)
+        self._pre_degrade.setdefault("jpeg_quality", (q, new_q))
+        self._pre_degrade.setdefault("video_bitrate_kbps", (kbps, new_kbps))
+        s.set_server("jpeg_quality", new_q)
+        s.set_server("video_bitrate_kbps", new_kbps)
+        for cap in self.captures.values():
+            cap.update_tunables(jpeg_quality=new_q,
+                                paint_over_quality=s.paint_over_quality)
+            cap.update_video_bitrate(new_kbps)
+        logger.warning("ladder: quality %d -> %d, bitrate %d -> %d kbps",
+                       q, new_q, kbps, new_kbps)
+
+    def _ladder_quality_up(self):
+        s = self.settings
+        q = self._ladder_restore("jpeg_quality", int(s.jpeg_quality))
+        kbps = self._ladder_restore("video_bitrate_kbps",
+                                    int(s.video_bitrate_kbps))
+        if q is None and kbps is None:
+            return False            # nothing to restore: not applied
+        if q is not None:
+            s.set_server("jpeg_quality", int(q))
+        if kbps is not None:
+            s.set_server("video_bitrate_kbps", int(kbps))
+        for cap in self.captures.values():
+            if q is not None:
+                cap.update_tunables(jpeg_quality=int(q),
+                                    paint_over_quality=s.paint_over_quality)
+            if kbps is not None:
+                cap.update_video_bitrate(int(kbps))
+        logger.info("ladder: quality/bitrate restored")
+
+    def _ladder_scale_down(self) -> None:
+        # geometry work joins capture threads: retained background task
+        self._spawn_retained(self._apply_ladder_scale(2), "ladder-scale")
+
+    def _ladder_scale_up(self) -> None:
+        self._spawn_retained(self._apply_ladder_scale(None), "ladder-scale")
+
+    async def _apply_ladder_scale(self, factor) -> None:
+        """``factor=N`` divides every display geometry by N (capture
+        downscale — on a live X server the screen itself resizes so it
+        is a true scale, headless captures shrink their grab);
+        ``factor=None`` restores the pre-degradation geometry."""
+        if factor is not None:
+            scaled = {did: (max(64, w // factor), max(64, h // factor))
+                      for did, (w, h) in self.display_geometry.items()}
+            self._pre_degrade.setdefault(
+                "geometry", (dict(self.display_geometry), dict(scaled)))
+            new_geo = scaled
+        else:
+            rec = self._pre_degrade.pop("geometry", None)
+            if not rec:
+                return
+            orig_geo, set_geo = rec
+            if self.display_geometry != set_geo:
+                # a client resized while degraded: its geometry wins
+                logger.info("ladder: geometry changed while degraded; "
+                            "not restoring %s", orig_geo)
+                return
+            new_geo = orig_geo
+        self.display_geometry.update(new_geo)
+        if self.display_manager is not None \
+                and self.display_manager.available() \
+                and len(new_geo) == 1:
+            did, geo = next(iter(new_geo.items()))
+            await self.display_manager.resize(
+                *geo, float(self.settings.framerate))
+        loop = asyncio.get_running_loop()
+        targets = ["__seats__"] if self._seats > 1 \
+            else list(self.display_geometry)
+        for tdid in targets:
+            cap = self.captures.get(tdid)
+            if not (cap and cap.is_capturing()):
+                continue
+            geo = self._capture_geometry(tdid)
+            ox, oy = self.display_offsets.get(tdid, (0, 0))
+            await loop.run_in_executor(
+                None, lambda c=cap, o=(ox, oy), g=geo:
+                c.update_capture_region(o[0], o[1], *g))
+        await self._broadcast_control(self._server_settings_payload())
+        logger.warning("ladder: capture geometry %s",
+                       "downscaled /%d" % factor if factor else "restored")
+
     async def stop(self) -> None:
         self._running = False
         for name, fn in (("relay", self._check_relays),
                          ("capture_fps", self._check_capture_fps),
                          ("audio", self._check_audio)):
             _health.engine.unregister(name, fn)
+        sup = self._supervisor()
+        if sup is not None:
+            # deliberate teardown: pending restarts must not resurrect
+            # captures/relays into a stopping service
+            for did in list(self.captures):
+                sup.drop(f"capture:{did}")
+            for c in self.clients.values():
+                for did in c.relays:
+                    sup.drop(f"relay:{c.id}:{did}")
+            sup.drop("audio")
+        if self.audio is not None:
+            self.audio.on_death = None
+        if self._ladder_bound:
+            ladder = getattr(getattr(self, "core", None), "ladder", None)
+            if ladder is not None:
+                ladder.unbind_controls()
+            self._ladder_bound = False
         bg = list(self._bg_tasks)
         for task in bg:
             task.cancel()
@@ -545,6 +722,7 @@ class WebSocketsService(BaseStreamingService):
                 else:
                     cap = self._capture_factory()
                 self.captures[display_id] = cap
+            self._adopt_capture(display_id, cap)
             if not cap.is_capturing() \
                     and display_id not in self._starting_captures:
                 loop = self._loop
@@ -597,6 +775,26 @@ class WebSocketsService(BaseStreamingService):
                             self._starting_captures.discard, display_id)
 
                 loop.run_in_executor(None, _start)
+
+    def _adopt_capture(self, display_id: str, cap) -> None:
+        """Supervise the capture thread: a loop death (source raise,
+        device error mid-encode) reports to the restart-policy engine
+        instead of logging and going dark. The restart joins the old
+        thread and rebuilds the session — executor-side, never on the
+        loop."""
+        sup = self._supervisor()
+        loop = self._loop
+        if sup is None or loop is None or not hasattr(cap, "restart"):
+            return
+        comp = f"capture:{display_id}"
+
+        def _restart(cap=cap):
+            return loop.run_in_executor(None, cap.restart)
+
+        sup.adopt(comp, _restart)
+        # capture-thread -> loop hop: report_death is loop-affine
+        cap.on_death = lambda exc, c=comp: loop.call_soon_threadsafe(
+            sup.report_death, c, f"{type(exc).__name__}: {exc}")
 
     def _maybe_stop_captures(self) -> None:
         """Stop capture after the reconnect grace window if nobody watches
@@ -700,6 +898,13 @@ class WebSocketsService(BaseStreamingService):
         ws = web.WebSocketResponse(max_msg_size=P.WS_MESSAGE_SIZE_HARD_CAP,
                                    compress=False)  # media must not deflate
         await ws.prepare(request)
+        # fault point: an injected accept failure closes the fresh
+        # socket (1013 Try Again Later) — the client reconnect path
+        try:
+            await _faults.registry.perturb_async("ws.accept")
+        except _faults.FaultError:
+            await ws.close(code=1013, message=b"fault injected")
+            return ws
         role = request.get("role", "full")
         raddr = request.remote or "?"
 
@@ -789,6 +994,7 @@ class WebSocketsService(BaseStreamingService):
     async def _disconnect(self, client: ClientConnection) -> None:
         self.clients.pop(client.id, None)
         _qoe.registry.unregister(client.qoe)
+        self._drop_relay_supervision(client)
         for relay in client.relays.values():
             await relay.close()
         client.relays.clear()
@@ -1024,14 +1230,7 @@ class WebSocketsService(BaseStreamingService):
         # clients on different seats share the single sharded capture
         did = client.display
         if did not in client.relays:
-            relay = VideoRelay(
-                client.ws.send_bytes,
-                budget_bytes=int(self.settings.video_relay_budget_s
-                                 * self.settings.video_bitrate_kbps * 125),
-                request_idr=lambda d=did: self._request_idr(d),
-                display=did)
-            relay.start()
-            client.relays[did] = relay
+            self._make_relay(client, did)
         self._ensure_capture(did)
         # fresh joiner needs a full frame — of ITS display only (an IDR
         # on every capture would storm unrelated displays/seats)
@@ -1042,11 +1241,62 @@ class WebSocketsService(BaseStreamingService):
         client.video_active = False
         if client.qoe is not None:
             client.qoe.video_active = False
+        self._drop_relay_supervision(client)
         for relay in client.relays.values():
             await relay.close()
         client.relays.clear()
         self._maybe_stop_captures()
         await client.ws.send_str("VIDEO_STOPPED")
+
+    def _make_relay(self, client: ClientConnection, did: str) -> None:
+        """Build (or rebuild) the client's video relay, supervised: a
+        relay death (stalled/failed media send) reports to the restart
+        engine, which re-offers a FRESH relay on the same client after
+        backoff — with an IDR request so every stripe row's decode chain
+        restarts clean. The dead relay's socket contract holds: the ws
+        itself is only reused because the chain gate + IDR resync make a
+        torn frame recoverable at the codec layer; a socket the CLIENT
+        side tore down just fails the first send and feeds the policy
+        until the budget parks it (or the client reconnects)."""
+        sup = self._supervisor()
+        on_dead = None
+        if sup is not None:
+            comp = f"relay:{client.id}:{did}"
+
+            def _reoffer(c=client, d=did, comp=comp):
+                if c.id not in self.clients or not c.video_active:
+                    sup.drop(comp)
+                    return
+                old = c.relays.get(d)
+                if old is not None and not old.dead:
+                    return
+                self._make_relay(c, d)
+                # the fresh relay starts every H.264 row gated shut; a
+                # keyframe reopens them (and repaints JPEG viewers)
+                self._request_idr(d)
+                logger.info("relay for client %d display %s re-offered",
+                            c.id, d)
+
+            sup.adopt(comp, _reoffer)
+
+            def on_dead(comp=comp):
+                sup.report_death(comp, "media send stalled/failed")
+
+        relay = VideoRelay(
+            client.ws.send_bytes,
+            budget_bytes=int(self.settings.video_relay_budget_s
+                             * self.settings.video_bitrate_kbps * 125),
+            request_idr=lambda d=did: self._request_idr(d),
+            on_dead=on_dead,
+            display=did)
+        relay.start()
+        client.relays[did] = relay
+
+    def _drop_relay_supervision(self, client: ClientConnection) -> None:
+        sup = self._supervisor()
+        if sup is not None:
+            for did in client.relays:
+                sup.drop(f"relay:{client.id}:{did}")
 
     def _request_idr(self, display_id: str) -> None:
         cap = self.captures.get(display_id) \

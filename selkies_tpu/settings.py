@@ -337,6 +337,43 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
        "Log output: 'plain' (human) or 'json' (one structured object per "
        "line, carrying the session/seat correlation fields).",
        choices=("plain", "json")),
+
+    # --- resilience (selkies_tpu/resilience) --------------------------------
+    _s("fault_inject", SType.STR, "",
+       "Arm deterministic fault injection at boot: "
+       "'point:mode[:k=v,...];...' clauses (points: relay.send, "
+       "capture.source, encoder.dispatch, ws.accept; see resilience/"
+       "faults.py). Also armable live via POST /api/faults."),
+    _s("supervisor_max_restarts", SType.INT, 5,
+       "Restart budget per supervised component inside "
+       "supervisor_window_s; the component parks as failed (and the "
+       "supervision health check fails) once exhausted.",
+       vmin=0, vmax=1000),
+    _s("supervisor_window_s", SType.FLOAT, 300.0,
+       "Sliding window for the restart budget.", vmin=1, vmax=86400),
+    _s("supervisor_backoff_base_s", SType.FLOAT, 0.5,
+       "First-restart backoff; consecutive fast deaths double it.",
+       vmin=0.01, vmax=300),
+    _s("supervisor_backoff_max_s", SType.FLOAT, 30.0,
+       "Backoff ceiling for crash-looping components.",
+       vmin=0.01, vmax=3600),
+    _s("enable_degradation_ladder", SType.BOOL, True,
+       "Verdict-driven fidelity shedding: qoe/hbm/stage-latency "
+       "verdicts walk fps -> quality -> downscale down (and back up "
+       "after a sustained-ok window)."),
+    _s("ladder_interval_s", SType.FLOAT, 2.0,
+       "Degradation-controller tick cadence.", vmin=0.1, vmax=300),
+    _s("ladder_down_after_s", SType.FLOAT, 4.0,
+       "A trigger verdict must persist this long before the first "
+       "downshift (hysteresis).", vmin=0, vmax=3600),
+    _s("ladder_hold_s", SType.FLOAT, 10.0,
+       "Minimum dwell between any two ladder transitions (no "
+       "flapping).", vmin=0, vmax=3600),
+    _s("ladder_ok_window_s", SType.FLOAT, 30.0,
+       "Sustained all-ok window required before stepping fidelity back "
+       "up.", vmin=1, vmax=86400),
+    _s("ladder_min_fps", SType.FLOAT, 15.0,
+       "Floor for the ladder's fps rung.", vmin=1, vmax=240),
 )
 
 _DEFS_BY_NAME: dict[str, Setting] = {d.name: d for d in SETTING_DEFINITIONS}

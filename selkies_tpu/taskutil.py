@@ -4,19 +4,45 @@ The event loop holds only a WEAK reference to pending tasks: a bare
 ``asyncio.ensure_future(coro)`` whose return value is discarded can be
 garbage-collected before it ever runs (ADVICE r5; enforced repo-wide by
 graftlint's ASYNC-ORPHAN-TASK rule).  Every fire-and-forget spawn goes
-through here so the retain idiom lives in exactly one place.
+through here so the retain idiom lives in exactly one place — and so no
+spawned task can die silently: an uncaught exception used to surface
+only as GC-time "Task exception was never retrieved" noise, long after
+the failure, with no component attribution.
 """
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import Coroutine
 
+logger = logging.getLogger("selkies_tpu.taskutil")
 
-def spawn_retained(tasks: set, coro: Coroutine) -> asyncio.Task:
+
+def spawn_retained(tasks: set, coro: Coroutine,
+                   component: str = "") -> asyncio.Task:
     """Schedule ``coro`` and hold a strong reference in ``tasks`` until
     it completes.  Callers that need cancellation on shutdown iterate
-    their own set (e.g. ``for t in tasks: t.cancel()``)."""
+    their own set (e.g. ``for t in tasks: t.cancel()``).
+
+    The done-callback retrieves the task's exception: an uncaught
+    failure is logged AT completion time with ``component`` (or the
+    coroutine's name) attached, instead of leaking into the garbage
+    collector's "exception was never retrieved" warning minutes later.
+    """
     task = asyncio.ensure_future(coro)
     tasks.add(task)
-    task.add_done_callback(tasks.discard)
+    label = component or getattr(coro, "__qualname__", None) \
+        or type(coro).__name__
+
+    def _done(t: asyncio.Task) -> None:
+        tasks.discard(t)
+        if t.cancelled():
+            return
+        exc = t.exception()        # marks the exception retrieved
+        if exc is not None:
+            logger.error("background task %r died: %s: %s",
+                         label, type(exc).__name__, exc,
+                         exc_info=exc)
+
+    task.add_done_callback(_done)
     return task

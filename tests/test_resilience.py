@@ -1,0 +1,883 @@
+"""Resilience plane tests (ISSUE 5): restart-policy math, supervisor
+scheduling, degradation-ladder hysteresis, fault-spec grammar, and —
+through the real injection points — every fault-driven recovery path:
+relay death -> supervised re-offer, capture-source raise -> supervised
+restart, encoder device-error, ws-accept rejection, and the qoe-failed
+-> downshift -> sustained-ok -> step-up ladder walk.
+
+Deterministic by construction: policies and ladders take injected
+clocks, the supervisor takes a manual scheduler, and the asyncio-level
+recovery tests poll bounded *conditions* (never fixed wall-clock
+sleeps) with millisecond backoffs configured through settings.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from selkies_tpu import protocol as P
+from selkies_tpu.obs import health as _health
+from selkies_tpu.resilience import faults as _faults
+from selkies_tpu.resilience.ladder import DegradationLadder
+from selkies_tpu.resilience.supervisor import (BACKING_OFF, FAILED,
+                                               RestartPolicy, Supervisor)
+from tests.test_server import FakeCapture, make_app
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """The process-wide fault registry must never leak between tests."""
+    _faults.registry.disarm()
+    old_sleep = _faults.registry.sleep
+    old_sleep_async = _faults.registry.sleep_async
+    yield
+    _faults.registry.disarm()
+    _faults.registry.sleep = old_sleep
+    _faults.registry.sleep_async = old_sleep_async
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class ManualSched:
+    """Deterministic supervisor scheduler: collect, fire by hand."""
+
+    class H:
+        def __init__(self, sched, entry):
+            self.sched, self.entry = sched, entry
+
+        def cancel(self):
+            if self.entry in self.sched.pending:
+                self.sched.pending.remove(self.entry)
+
+    def __init__(self):
+        self.pending = []
+
+    def __call__(self, delay, cb):
+        entry = (delay, cb)
+        self.pending.append(entry)
+        return self.H(self, entry)
+
+    def fire(self):
+        pending, self.pending = self.pending, []
+        for _, cb in pending:
+            cb()
+
+
+async def _until(cond, timeout=10.0, interval=0.02):
+    """Await a condition with a hard bound (the no-wall-clock-sleeps
+    discipline: waits END as soon as the condition holds)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return cond()
+
+
+# ---------------------------------------------------------------- policy
+
+def test_backoff_ramps_and_caps():
+    clk = Clock()
+    p = RestartPolicy(base_backoff_s=1.0, max_backoff_s=4.0, jitter=0.0,
+                      min_uptime_s=5.0, max_restarts=100, clock=clk)
+    p.record_started()
+    seq = []
+    for i in range(4):
+        clk.t += 0.1                       # consecutive fast deaths
+        seq.append(p.next_backoff())
+        p.record_started()
+    assert seq == [1.0, 2.0, 4.0, 4.0]     # 2^n ramp, capped
+
+
+def test_healthy_uptime_resets_ramp():
+    clk = Clock()
+    p = RestartPolicy(base_backoff_s=1.0, jitter=0.0, min_uptime_s=5.0,
+                      max_restarts=100, clock=clk)
+    p.record_started()
+    clk.t = 0.1
+    assert p.next_backoff() == 1.0
+    p.record_started()
+    clk.t = 0.2
+    assert p.next_backoff() == 2.0
+    p.record_started()
+    clk.t = 20.0                           # ran healthy for ~20 s
+    assert p.next_backoff() == 1.0         # ramp reset to base
+    assert not p.crash_looping
+
+
+def test_crash_loop_flag_and_budget():
+    clk = Clock()
+    p = RestartPolicy(base_backoff_s=1.0, jitter=0.0, min_uptime_s=5.0,
+                      max_restarts=3, window_s=100.0, clock=clk)
+    p.record_started()
+    for _ in range(2):
+        clk.t += 0.1
+        assert p.next_backoff() is not None
+        p.record_started()
+    assert not p.crash_looping
+    clk.t += 0.1
+    assert p.next_backoff() is not None    # 3rd restart: budget edge
+    assert p.crash_looping
+    p.record_started()
+    clk.t += 0.1
+    assert p.next_backoff() is None        # 4th in window: exhausted
+
+
+def test_budget_window_slides():
+    clk = Clock()
+    p = RestartPolicy(base_backoff_s=1.0, jitter=0.0, min_uptime_s=0.0,
+                      max_restarts=2, window_s=10.0, clock=clk)
+    p.record_started()
+    for t in (1.0, 2.0):
+        clk.t = t
+        assert p.next_backoff() is not None
+        p.record_started()
+    clk.t = 20.0                           # old deaths aged out
+    assert p.next_backoff() is not None
+
+
+def test_jitter_is_seeded_and_additive():
+    def run(seed):
+        clk = Clock()
+        p = RestartPolicy(base_backoff_s=1.0, jitter=0.5, seed=seed,
+                          min_uptime_s=0.0, max_restarts=100, clock=clk)
+        p.record_started()
+        return [p.next_backoff() for _ in range(4)]
+
+    a, b = run(7), run(7)
+    assert a == b                          # deterministic replay
+    assert all(x >= 1.0 for x in a[:1])    # jitter only adds
+    assert run(7) != run(8)                # and actually varies by seed
+
+
+# ------------------------------------------------------------- supervisor
+
+def test_supervisor_restart_coalesce_giveup():
+    eng = _health.HealthEngine()
+    sched = ManualSched()
+    clk = Clock()
+    calls = {"restarts": 0, "gave_up": False}
+    sup = Supervisor(recorder=eng.recorder, schedule=sched,
+                     policy_factory=lambda: RestartPolicy(
+                         max_restarts=1, jitter=0.0, min_uptime_s=0.0,
+                         clock=clk))
+    sup.adopt("c", lambda: calls.__setitem__("restarts",
+                                             calls["restarts"] + 1),
+              on_give_up=lambda: calls.__setitem__("gave_up", True))
+    assert sup.health_check().status == _health.OK
+    sup.report_death("c", "boom")
+    assert sup.get("c").state == BACKING_OFF
+    assert sup.health_check().status == _health.DEGRADED
+    sup.report_death("c", "dup")           # pending restart: coalesced
+    assert len(sched.pending) == 1
+    sched.fire()
+    assert calls["restarts"] == 1
+    assert sup.health_check().status == _health.OK
+    sup.report_death("c", "boom2")         # budget 1: exhausted
+    assert calls["gave_up"]
+    assert sup.get("c").state == FAILED
+    assert sup.health_check().status == _health.FAILED
+    kinds = [e["kind"] for e in eng.recorder.snapshot()]
+    assert kinds.count("supervisor_restart") == 1
+    assert "crash_loop" in kinds
+
+
+def test_supervisor_drop_cancels_pending_restart():
+    sched = ManualSched()
+    eng = _health.HealthEngine()
+    fired = []
+    sup = Supervisor(recorder=eng.recorder, schedule=sched,
+                     policy_factory=lambda: RestartPolicy(jitter=0.0))
+    sup.adopt("gone", lambda: fired.append(1))
+    sup.report_death("gone", "x")
+    sup.drop("gone")
+    sched.fire()
+    assert not fired and sup.get("gone") is None
+
+
+def test_adopt_unparks_failed_component():
+    """Re-adoption (a deliberate restart: operator switch, START_VIDEO)
+    must un-park a FAILED component so its next death is supervised
+    again — while the sliding-window death history keeps an immediate
+    re-crash from burning fresh budget."""
+    clk = Clock()
+    sched = ManualSched()
+    eng = _health.HealthEngine()
+    sup = Supervisor(recorder=eng.recorder, schedule=sched,
+                     policy_factory=lambda: RestartPolicy(
+                         max_restarts=1, jitter=0.0, min_uptime_s=0.0,
+                         window_s=10.0, clock=clk))
+    sup.adopt("svc", lambda: None)
+    sup.report_death("svc", "boom")
+    sched.fire()                           # one restart: budget spent
+    clk.t = 1.0
+    sup.report_death("svc", "boom again")  # 2nd in window: parks
+    assert sup.get("svc").state == FAILED
+    sup.report_death("svc", "parked: ignored")
+    assert sup.get("svc").state == FAILED
+    sup.adopt("svc", lambda: None)         # deliberate re-start
+    assert sup.get("svc").state == "running"
+    clk.t = 50.0                           # old deaths aged out
+    sup.report_death("svc", "supervised again")
+    assert sup.get("svc").state == BACKING_OFF
+
+
+async def test_supervisor_inflight_async_restart_not_clobbered():
+    """A death reported while an async restart is still in flight must
+    coalesce — not drop the task's strong ref or run a second restart
+    concurrently; the task's own failure callback feeds the policy."""
+    sched = ManualSched()
+    eng = _health.HealthEngine()
+    fut = asyncio.get_running_loop().create_future()
+    restarts = []
+
+    def restart_fn():
+        restarts.append(1)
+        return fut
+
+    sup = Supervisor(recorder=eng.recorder, schedule=sched,
+                     policy_factory=lambda: RestartPolicy(
+                         max_restarts=10, jitter=0.0, min_uptime_s=0.0))
+    sup.adopt("c", restart_fn)
+    sup.report_death("c", "one")
+    sched.fire()
+    await asyncio.sleep(0)
+    comp = sup.get("c")
+    assert comp._task is not None and len(restarts) == 1
+    sup.report_death("c", "while restart in flight")   # coalesced
+    assert not sched.pending
+    fut.set_exception(RuntimeError("restart failed"))
+    await asyncio.sleep(0)
+    await asyncio.sleep(0)
+    assert comp._task is None
+    assert comp.state == BACKING_OFF       # failure fed back via callback
+    assert len(sched.pending) == 1
+    sup.close()
+
+
+async def test_death_during_successful_restart_is_replayed():
+    """A death reported while an in-flight restart is about to SUCCEED
+    must be queued and replayed at completion — not swallowed (which
+    would abandon a fast-crashing component with supervision ok)."""
+    sched = ManualSched()
+    eng = _health.HealthEngine()
+    fut = asyncio.get_running_loop().create_future()
+    sup = Supervisor(recorder=eng.recorder, schedule=sched,
+                     policy_factory=lambda: RestartPolicy(
+                         max_restarts=10, jitter=0.0, min_uptime_s=0.0))
+    sup.adopt("c", lambda: fut)
+    sup.report_death("c", "first")
+    sched.fire()
+    await asyncio.sleep(0)
+    comp = sup.get("c")
+    assert comp._task is not None
+    # the restarted instance crashes BEFORE the restart future resolves
+    sup.report_death("c", "crashed during restart")
+    assert comp._pending_death == "crashed during restart"
+    fut.set_result(None)                   # ...and the restart succeeds
+    await asyncio.sleep(0)
+    await asyncio.sleep(0)
+    assert comp.state == BACKING_OFF       # queued death replayed
+    assert comp._pending_death is None
+    assert len(sched.pending) == 1
+    sup.close()
+
+
+def test_supervisor_failing_restart_feeds_policy():
+    sched = ManualSched()
+    eng = _health.HealthEngine()
+    clk = Clock()
+    sup = Supervisor(recorder=eng.recorder, schedule=sched,
+                     policy_factory=lambda: RestartPolicy(
+                         max_restarts=5, jitter=0.0, min_uptime_s=0.0,
+                         clock=clk))
+
+    def bad_restart():
+        raise RuntimeError("still broken")
+
+    sup.adopt("flappy", bad_restart)
+    sup.report_death("flappy", "first")
+    sched.fire()                           # restart raises -> new death
+    assert sup.get("flappy").state == BACKING_OFF
+    assert sup.get("flappy").restarts == 2
+    assert "restart failed" in sup.get("flappy").last_error
+
+
+# ----------------------------------------------------------------- ladder
+
+def test_ladder_full_walk_with_injected_clock():
+    eng = _health.HealthEngine()
+    calls = []
+    lad = DegradationLadder(down_after_s=4.0, hold_s=10.0, ok_window_s=30.0,
+                            recorder=eng.recorder)
+    lad.bind_controls({
+        "fps": (lambda: calls.append("fps-"), lambda: calls.append("fps+")),
+        "quality": (lambda: calls.append("q-"), lambda: calls.append("q+")),
+        "downscale": (lambda: calls.append("s-"),
+                      lambda: calls.append("s+")),
+    })
+    bad = {"qoe": _health.failed("stall")}
+    ok = {"qoe": _health.ok()}
+    lad.observe(bad, now=0.0)
+    assert lad.level == 0                  # hysteresis: not yet
+    lad.observe(bad, now=4.0)
+    assert lad.level == 1 and calls == ["fps-"]
+    lad.observe(bad, now=5.0)
+    assert lad.level == 1                  # hold_s blocks
+    lad.observe(bad, now=15.0)
+    assert lad.level == 2 and calls[-1] == "q-"
+    lad.observe(bad, now=26.0)
+    assert lad.level == 3 and calls[-1] == "s-"
+    lad.observe(bad, now=40.0)
+    assert lad.level == 3                  # bottom rung holds
+    # recovery: sustained-ok window then one rung per hold
+    lad.observe(ok, now=41.0)
+    lad.observe(ok, now=60.0)
+    assert lad.level == 3                  # 19 s ok < 30 s window
+    lad.observe(ok, now=71.5)
+    assert lad.level == 2 and calls[-1] == "s+"
+    lad.observe(ok, now=101.5)
+    assert lad.level == 1 and calls[-1] == "q+"
+    kinds = [e["kind"] for e in eng.recorder.snapshot()]
+    assert kinds.count("degradation_step") == 3
+    assert kinds.count("degradation_recover") == 2
+    ev = lad.trace_events()
+    assert ev[0]["args"]["name"] == "resilience"
+    assert len(ev) == 1 + lad.transitions
+
+
+def test_ladder_ignores_qoe_degraded():
+    # degraded qoe is what shedding CAUSES; only failed triggers
+    lad = DegradationLadder(down_after_s=0.0, hold_s=0.0,
+                            recorder=_health.HealthEngine().recorder)
+    lad.observe({"qoe": _health.degraded("meh")}, now=0.0)
+    lad.observe({"qoe": _health.degraded("meh")}, now=10.0)
+    assert lad.level == 0
+    lad.observe({"hbm_headroom": _health.degraded("hot")}, now=20.0)
+    assert lad.level == 1                  # hbm degraded DOES trigger
+
+
+# ----------------------------------------------------------------- faults
+
+def test_fault_spec_grammar_round_trip():
+    text = ("relay.send:stall:delay_s=0.25;capture.source:raise:"
+            "after=3,count=2;ws.accept:close;"
+            "encoder.dispatch:device_error:prob=0.5")
+    specs = _faults.parse_spec(text)
+    again = _faults.parse_spec(";".join(s.to_spec() for s in specs))
+    assert [s.to_dict() for s in specs] == [s.to_dict() for s in again]
+    for bad in ("bogus:raise", "relay.send:nope", "relay.send",
+                "relay.send:error:count=-1", "relay.send:error:k=v"):
+        with pytest.raises(ValueError):
+            _faults.parse_spec(bad)
+
+
+def test_fault_schedule_is_exact_and_seeded():
+    reg = _faults.FaultRegistry(seed=5)
+    reg.arm("encoder.dispatch:device_error:after=2,count=2")
+    assert reg.pull("relay.send") is None          # other points untouched
+    assert reg.pull("encoder.dispatch") is None    # hit 1: skipped
+    assert reg.pull("encoder.dispatch") is None    # hit 2: skipped
+    with pytest.raises(_faults.FaultError) as ei:
+        reg.perturb("encoder.dispatch")            # hit 3: fires
+    assert (ei.value.point, ei.value.mode) == ("encoder.dispatch",
+                                               "device_error")
+    with pytest.raises(_faults.FaultError):
+        reg.perturb("encoder.dispatch")            # hit 4: fires (count 2)
+    reg.perturb("encoder.dispatch")                # exhausted: no-op
+    assert reg.remaining() == 0 and len(reg.fired_log) == 2
+
+    draws = []
+    for _ in range(2):
+        r = _faults.FaultRegistry(seed=99)
+        r.arm("relay.send:error:prob=0.5,count=50")
+        draws.append([r.pull("relay.send") is not None for _ in range(16)])
+    assert draws[0] == draws[1]
+
+
+async def test_sleeping_fault_modes_use_injected_sleep():
+    reg = _faults.FaultRegistry()
+    slept = []
+    reg.sleep = slept.append
+    reg.arm("encoder.dispatch:slow:delay_s=0.25;"
+            "capture.source:freeze:delay_s=1.5")
+    reg.perturb("encoder.dispatch")
+    reg.perturb("capture.source")
+    assert slept == [0.25, 1.5]
+    async_sleeps = []
+
+    async def fake_sleep(d):
+        async_sleeps.append(d)
+
+    reg2 = _faults.FaultRegistry()
+    reg2.sleep_async = fake_sleep
+    reg2.arm("relay.send:stall:delay_s=0.4")
+    await reg2.perturb_async("relay.send")
+    assert async_sleeps == [0.4]
+
+
+async def test_relay_stall_trips_send_bound_and_marks_dead():
+    """The stall mode sleeps past the (injectable) send bound, so the
+    relay hits exactly the wedged-TCP timeout path and dies."""
+    from selkies_tpu.server.relay import VideoRelay
+    sent = []
+
+    async def send(item):
+        sent.append(item)
+
+    relay = VideoRelay(send, send_timeout_s=0.05, display="d0")
+    relay.start()
+    _faults.registry.arm("relay.send:stall:delay_s=30,count=1")
+    relay.offer(P.pack_jpeg_stripe(1, 0, b"\xff\xd8xx\xff\xd9"))
+    assert await _until(lambda: relay.dead, timeout=5.0)
+    assert not sent                        # the stalled send never landed
+    await relay.close()
+
+
+# ------------------------------------------------- recovery: relay re-offer
+
+async def test_relay_fault_supervised_reoffer(client_factory):
+    """Injected relay send error -> relay dead -> supervisor re-offers a
+    fresh relay (+ IDR) and the restarts metric increments."""
+    server, svc, fake, _ = make_app(
+        supervisor_backoff_base_s=0.01, supervisor_backoff_max_s=0.05)
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str()                         # MODE
+    await ws.receive_str()                         # server_settings
+    await ws.send_str("START_VIDEO")
+    assert await _until(lambda: svc.clients
+                        and next(iter(svc.clients.values())).relays)
+    client = next(iter(svc.clients.values()))
+    first_relay = client.relays[client.display]
+
+    _faults.registry.arm("relay.send:error:count=1")
+    fake.emit()                                    # next send dies
+    assert await _until(lambda: first_relay.dead)
+    # supervised re-offer: a FRESH relay object replaces the dead one
+    assert await _until(
+        lambda: client.relays.get(client.display) is not None
+        and client.relays[client.display] is not first_relay
+        and not client.relays[client.display].dead)
+    comp = f"relay:{client.id}:{client.display}"
+    assert server.supervisor.get(comp).restarts >= 1
+    idr_before = fake.idr_requests
+    assert idr_before >= 1                         # re-offer asked for IDR
+    # the new relay actually carries media again
+    fake.emit()
+    got = False
+    for _ in range(20):
+        msg = await ws.receive(timeout=5)
+        if msg.type.name == "BINARY" and msg.data[0] == P.OP_JPEG:
+            got = True
+            break
+    assert got
+    r = await c.get("/api/metrics")
+    text = await r.text()
+    assert "selkies_supervisor_restarts_total" in text
+    assert f'component="{comp}"' in text
+    # incident trail: relay_death AND supervisor_restart both present
+    r = await c.get("/api/health?verbose=1")
+    incidents = (await r.json())["incidents"]
+    kinds = [e["kind"] for e in incidents]
+    assert "relay_death" in kinds and "supervisor_restart" in kinds
+    await ws.close()
+
+
+# --------------------------------------------- recovery: capture restart
+
+class SupervisedFakeCapture(FakeCapture):
+    """FakeCapture + the restart/on_death surface ScreenCapture grew."""
+
+    def __init__(self):
+        super().__init__()
+        self.on_death = None
+        self.restarts = 0
+
+    def restart(self, settings=None):
+        self.restarts += 1
+        self._capturing = True
+        self.emit()
+
+    def die(self, exc):
+        self._capturing = False
+        hook = self.on_death
+        if hook is not None:
+            hook(exc)
+
+
+async def test_capture_death_supervised_restart_in_health(client_factory):
+    server, svc, fake, _ = make_app(
+        capture_cls=SupervisedFakeCapture,
+        supervisor_backoff_base_s=0.01, supervisor_backoff_max_s=0.05)
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str()
+    await ws.receive_str()
+    await ws.send_str("START_VIDEO")
+    assert await _until(lambda: fake.is_capturing())
+    fake.die(RuntimeError("injected source death"))
+    assert await _until(lambda: fake.restarts >= 1)
+    assert fake.is_capturing()
+    comp = f"capture:{svc._default_display()}"
+    assert server.supervisor.get(comp).restarts >= 1
+    r = await c.get("/api/health?verbose=1")
+    body = await r.json()
+    assert body["checks"]["supervision"]["status"] == "ok"
+    restart_incidents = [e for e in body["incidents"]
+                         if e["kind"] == "supervisor_restart"
+                         and e.get("component") == comp]
+    assert restart_incidents
+    await ws.close()
+
+
+# ------------------------------------------------- recovery: ws.accept
+
+async def test_ws_accept_fault_rejects_then_recovers(client_factory):
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    _faults.registry.arm("ws.accept:close:count=1")
+    ws = await c.ws_connect("/api/websockets")
+    msg = await ws.receive(timeout=5)
+    assert msg.type.name in ("CLOSE", "CLOSING", "CLOSED")
+    assert not svc.clients                          # never admitted
+    await ws.close()
+    ws2 = await c.ws_connect("/api/websockets")     # fault exhausted
+    assert (await ws2.receive_str()) == "MODE websockets"
+    await ws2.close()
+
+
+# --------------------------------------------------- engine-level faults
+
+def _tiny_settings():
+    from selkies_tpu.engine.types import CaptureSettings
+    return CaptureSettings(capture_width=64, capture_height=64,
+                           output_mode="jpeg", jpeg_quality=40,
+                           target_fps=60.0, display_id=":t",
+                           stripe_height=64, use_damage_gating=True,
+                           use_paint_over=False)
+
+
+def test_encoder_dispatch_fault_raises_before_device_work():
+    from selkies_tpu.engine.encoder import JpegEncoderSession
+    sess = JpegEncoderSession(_tiny_settings())
+    _faults.registry.arm("encoder.dispatch:device_error:count=1")
+    with pytest.raises(_faults.FaultError):
+        sess.encode(None)          # fires before the frame is touched
+    _faults.registry.disarm()
+
+
+def test_capture_source_fault_kills_loop_and_restart_recovers():
+    """The real injection point: capture.source:raise kills the real
+    capture thread, on_death fires, the incident lands, and restart()
+    brings frames back."""
+    from selkies_tpu.engine.capture import ScreenCapture
+    died = threading.Event()
+    chunks = []
+    cap = ScreenCapture("synthetic")
+    cap.on_death = lambda exc: died.set()
+    _health.engine.recorder.clear()
+    _faults.registry.arm("capture.source:raise:after=1,count=1")
+    cap.start_capture(chunks.append, _tiny_settings())
+    # bound covers the first-frame XLA compile on a loaded 1-core box
+    assert died.wait(120.0)
+    # loop dead, thread exits; deliberate-stop path was NOT taken
+    deadline = time.monotonic() + 10.0
+    while cap.is_capturing() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not cap.is_capturing()
+    kinds = [e["kind"] for e in _health.engine.recorder.snapshot()]
+    assert "capture_death" in kinds and "fault_injected" in kinds
+    # supervised-restart contract: restart() (the supervisor's target)
+    # rebuilds the session and frames flow again
+    n0 = len(chunks)
+    cap.restart()
+    deadline = time.monotonic() + 120.0
+    while len(chunks) <= n0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert len(chunks) > n0
+    cap.stop_capture()
+
+
+def test_restart_after_death_closes_source_and_uses_fresh_flag(monkeypatch):
+    """The supervised-restart path must not leak the dead loop's source
+    (it was left open when the thread died) and must hand the new thread
+    its OWN run flag (a shared Event could resurrect an abandoned one)."""
+    from selkies_tpu.engine import capture as capture_mod
+    sources = []
+
+    class DyingSource:
+        width = height = 64
+
+        def __init__(self):
+            self.closed = False
+            sources.append(self)
+
+        def get_frame(self, tick):
+            raise RuntimeError("dead source")
+
+        def close(self):
+            self.closed = True
+
+    monkeypatch.setattr(capture_mod, "make_source",
+                        lambda *a, **k: DyingSource())
+    died = threading.Event()
+    cap = capture_mod.ScreenCapture("synthetic")
+    cap.on_death = lambda exc: died.set()
+    cap.start_capture(lambda c: None, _tiny_settings())
+    flag1 = cap._running
+    assert died.wait(10.0)
+    died.clear()
+    cap.restart()                          # the supervisor's target
+    assert cap._running is not flag1       # fresh per-run flag
+    assert sources[0].closed               # dead loop's source closed
+    assert died.wait(10.0)                 # new loop ran (and died too)
+    cap.stop_capture()
+    assert sources[1].closed
+
+
+def test_stop_capture_bounded_join_escalates(monkeypatch):
+    """A wedged source must not hang stop/restart forever: the join
+    times out, escalates (log + incident + abandoned accounting), and
+    the capture object stays restartable."""
+    from selkies_tpu.engine import capture as capture_mod
+    gate = threading.Event()
+    entered = threading.Event()
+
+    class WedgeSource:
+        width = height = 64
+
+        def get_frame(self, tick):
+            entered.set()
+            gate.wait(30.0)
+            raise RuntimeError("released")   # die fast once released
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(capture_mod, "make_source",
+                        lambda *a, **k: WedgeSource())
+    _health.engine.recorder.clear()
+    cap = capture_mod.ScreenCapture("synthetic")
+    cap.join_timeout_s = 0.2
+    cap.start_capture(lambda c: None, _tiny_settings())
+    assert entered.wait(10.0)
+    t0 = time.monotonic()
+    cap.stop_capture()                       # wedged: bounded join
+    assert time.monotonic() - t0 < 5.0
+    assert cap.abandoned_threads == 1
+    assert not cap.is_capturing()
+    kinds = [e["kind"] for e in _health.engine.recorder.snapshot()]
+    assert "capture_thread_wedged" in kinds
+    gate.set()                               # let the leaked thread exit
+
+
+# ------------------------------------------------------- switch_to_mode
+
+async def test_overlapping_switches_serialize():
+    from selkies_tpu.server.core import (BaseStreamingService,
+                                         CentralizedStreamServer)
+    from selkies_tpu.settings import AppSettings
+
+    events = []
+
+    class SlowService(BaseStreamingService):
+        def __init__(self, name):
+            self.name = name
+
+        async def start(self):
+            events.append(f"start:{self.name}")
+            await asyncio.sleep(3600)        # long-lived service task
+
+        async def stop(self):
+            events.append(f"stop-begin:{self.name}")
+            await asyncio.sleep(0)           # yield: invite interleaving
+            events.append(f"stop-end:{self.name}")
+
+    s = AppSettings.parse([], {})
+    s.set_server("enable_dual_mode", True)
+    server = CentralizedStreamServer(s)
+    server.register_service("a", SlowService("a"))
+    server.register_service("b", SlowService("b"))
+    await server.switch_to_mode("a")
+    await asyncio.sleep(0)
+    # two overlapping switches: without the lock these interleave the
+    # stop/start pairs and can strand a service
+    await asyncio.gather(server.switch_to_mode("b"),
+                         server.switch_to_mode("a"))
+    await asyncio.sleep(0)
+    assert server.active_mode in server.services
+    # every stop ran to completion before the next start began
+    for i, e in enumerate(events):
+        if e.startswith("stop-begin:"):
+            name = e.split(":")[1]
+            assert events[i + 1] == f"stop-end:{name}"
+    assert events[-1].startswith("start:")
+    await server.shutdown()
+
+
+async def test_service_death_is_supervised():
+    from selkies_tpu.server.core import (BaseStreamingService,
+                                         CentralizedStreamServer)
+    from selkies_tpu.settings import AppSettings
+
+    class DyingService(BaseStreamingService):
+        name = "dying"
+
+        def __init__(self):
+            self.starts = 0
+
+        async def start(self):
+            self.starts += 1
+            if self.starts == 1:
+                raise RuntimeError("first boot dies")
+            await asyncio.sleep(3600)
+
+        async def stop(self):
+            pass
+
+    s = AppSettings.parse([], {})
+    s.set_server("supervisor_backoff_base_s", 0.01)
+    s.set_server("supervisor_backoff_max_s", 0.05)
+    server = CentralizedStreamServer(s)
+    svc = DyingService()
+    server.register_service("dying", svc)
+    await server.switch_to_mode("dying")
+    assert await _until(lambda: svc.starts >= 2)
+    assert server.active_mode == "dying"     # recovered, not cleared
+    assert server.supervisor.get("service:dying").restarts == 1
+    await server.shutdown()
+
+
+# --------------------------------------------------------- HTTP surface
+
+async def test_faults_api_arm_fire_disarm(client_factory):
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    r = await c.post("/api/faults", json={
+        "action": "arm", "spec": "ws.accept:close:count=1", "seed": 3})
+    assert r.status == 200
+    body = await (await c.get("/api/faults")).json()
+    assert body["remaining"] == 1 and body["seed"] == 3
+    assert body["active"][0]["point"] == "ws.accept"
+    r = await c.post("/api/faults", json={"action": "arm", "spec": "x:y"})
+    assert r.status == 400
+    r = await c.post("/api/faults", json={"action": "disarm"})
+    assert (await r.json())["removed"] == 1
+    assert (await (await c.get("/api/faults")).json())["active"] == []
+
+
+async def test_faults_api_view_only_forbidden(client_factory):
+    import base64
+    server, svc, fake, _ = make_app(
+        enable_basic_auth=True, basic_auth_user="u",
+        basic_auth_password="pw", viewonly_password="vo")
+    c = await client_factory(server)
+    hdr = {"Authorization": "Basic " + base64.b64encode(b"u:vo").decode()}
+    assert (await c.get("/api/faults", headers=hdr)).status == 403
+    assert (await c.post("/api/faults", headers=hdr,
+                         json={"spec": "ws.accept:close"})).status == 403
+    assert (await c.get("/api/resilience", headers=hdr)).status == 403
+
+
+async def test_resilience_endpoint_snapshot(client_factory):
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    body = await (await c.get("/api/resilience")).json()
+    assert "components" in body["supervisor"]
+    assert body["ladder"]["level"] == 0
+    assert body["ladder"]["controls_bound"]    # ws service bound its rungs
+    assert body["faults"]["active"] == []
+
+
+# ------------------------------------------------------ ladder wiring
+
+async def test_ladder_downshift_and_stepup_through_ws_controls(
+        client_factory):
+    """qoe-failed verdicts walk the REAL ws controls down (fps halves,
+    then quality/bitrate shed) and a sustained-ok window walks them
+    back up — driven through injected `now`, no wall clock."""
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ladder = server.ladder
+    assert ladder is not None
+    s = svc.settings
+    fps0, q0, kbps0 = s.framerate, s.jpeg_quality, s.video_bitrate_kbps
+    bad = {"qoe": _health.failed("ack stall")}
+    ok = {"qoe": _health.ok()}
+    ladder.observe(bad, now=0.0)
+    ladder.observe(bad, now=4.0)
+    assert ladder.level == 1 and s.framerate == fps0 // 2
+    ladder.observe(bad, now=15.0)
+    assert ladder.level == 2
+    assert s.jpeg_quality < q0 and s.video_bitrate_kbps == kbps0 // 2
+    ladder.observe(ok, now=16.0)
+    ladder.observe(ok, now=46.5)
+    assert ladder.level == 1 and s.jpeg_quality == q0 \
+        and s.video_bitrate_kbps == kbps0
+    ladder.observe(ok, now=80.0)
+    assert ladder.level == 0 and s.framerate == fps0
+    kinds = [e["kind"] for e in _health.engine.recorder.snapshot()]
+    assert "degradation_step" in kinds and "degradation_recover" in kinds
+
+
+async def test_ladder_stepup_respects_operator_changes(client_factory):
+    """A setting the operator changed while degraded must NOT be
+    clobbered by the ladder's step-up restore."""
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    s = svc.settings
+    fps0 = int(s.framerate)
+    svc._ladder_fps_down()
+    assert int(s.framerate) == fps0 // 2
+    s.set_server("framerate", 24)          # operator takes over
+    assert svc._ladder_fps_up() is False   # restore declined
+    assert int(s.framerate) == 24
+    # untouched values DO restore
+    q0 = int(s.jpeg_quality)
+    svc._ladder_quality_down()
+    svc._ladder_quality_up()
+    assert int(s.jpeg_quality) == q0
+
+
+async def test_ladder_fps_floor_reports_not_applied(client_factory):
+    """At the fps floor the rung has nothing to shed: the transition
+    still happens but the incident must record applied=False."""
+    server, svc, fake, _ = make_app(framerate=15)
+    c = await client_factory(server)
+    assert svc._ladder_fps_down() is False
+    assert svc.settings.framerate == 15    # unchanged
+    ladder = server.ladder
+    ladder.observe({"qoe": _health.failed("x")}, now=0.0)
+    ladder.observe({"qoe": _health.failed("x")}, now=4.0)
+    steps = [e for e in _health.engine.recorder.snapshot()
+             if e["kind"] == "degradation_step"]
+    assert steps and steps[-1]["applied"] is False
+
+
+# --------------------------------------------------------------- taskutil
+
+async def test_spawn_retained_logs_uncaught_exceptions(caplog):
+    import logging
+
+    from selkies_tpu.taskutil import spawn_retained
+
+    async def boom():
+        raise ValueError("kaput")
+
+    tasks: set = set()
+    with caplog.at_level(logging.ERROR, logger="selkies_tpu.taskutil"):
+        t = spawn_retained(tasks, boom(), component="test-component")
+        await asyncio.gather(t, return_exceptions=True)
+        await asyncio.sleep(0)             # let the done-callback run
+    assert not tasks
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("test-component" in m and "kaput" in m for m in msgs)

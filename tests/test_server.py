@@ -61,11 +61,11 @@ class FakeCapture:
             self.fid += 1
 
 
-def make_app(env=None, **fields):
+def make_app(env=None, capture_cls=FakeCapture, **fields):
     s = AppSettings.parse([], env or {})
     for k, v in fields.items():
         s.set_server(k, v)
-    fake = FakeCapture()
+    fake = capture_cls()
     handler = InputHandler(backend=NullBackend())
     svc = WebSocketsService(s, input_handler=handler,
                             capture_factory=lambda: fake)
