@@ -37,6 +37,17 @@ full supervision, and the JSON line carries a ``chaos`` block proving
 every injected fault was recovered (supervisor restarts, final health,
 QoE score back above the degraded threshold). Knobs:
 BENCH_CHAOS_SEED, BENCH_CHAOS_BUDGET_S, BENCH_CHAOS_WIDTH/HEIGHT.
+
+Perf observability (selkies_tpu/obs/perf, ISSUE 6): the JSON line
+carries a ``perf`` block (per compiled step: flops, HBM bytes accessed,
+roofline-ms at ~800 GB/s, recorded at compile time — plus the parsed
+device-time table when ``--profile`` captured one) and an ``occupancy``
+block (overlap fraction, bubble share, per-stage critical-path share
+from the trace timelines). Every run auto-appends to the perf ledger
+(``PERF_LEDGER.jsonl``, see tools/perf_ledger.py; ``--no_ledger`` or
+PERF_LEDGER_PATH to opt out / redirect) keyed by host/backend/geometry
+with its ``backend_health`` verdict, so a silent CPU fallback can never
+become a baseline.
 """
 
 import json
@@ -47,6 +58,24 @@ import time
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def ledger_append(doc: dict) -> None:
+    """Auto-append this run to the perf ledger (ISSUE 6): the durable
+    trajectory tools/perf_ledger.py gates against. Opt out with
+    --no_ledger; redirect with PERF_LEDGER_PATH. Never fatal — a
+    read-only checkout must not turn a good bench run into an error."""
+    if "--no_ledger" in sys.argv[1:]:
+        return
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools.perf_ledger import (DEFAULT_LEDGER, append_entry,
+                                       entry_from_bench)
+        path = os.environ.get("PERF_LEDGER_PATH", DEFAULT_LEDGER)
+        append_entry(path, entry_from_bench(doc))
+        log(f"ledger: appended {doc.get('metric')} -> {path}")
+    except Exception as e:
+        log(f"ledger append failed ({type(e).__name__}: {e})")
 
 
 #: the loopback relay's listen ports (see /root/.relay.py PORTS): a live
@@ -223,7 +252,9 @@ def main(force_cpu: bool = False) -> None:
     from selkies_tpu.obs import qoe as _qoe
     from selkies_tpu.trace import STAGES
     from selkies_tpu.trace import tracer as _tracer
-    from selkies_tpu.trace.summary import render_table, summarize_timelines
+    from selkies_tpu.trace.summary import (occupancy_report,
+                                           render_occupancy, render_table,
+                                           summarize_timelines)
     bench_display = sess.settings.display_id
     _tracer.enable(capacity=1024)
     # loopback QoE session: the bench acts as its own client — each
@@ -275,6 +306,21 @@ def main(force_cpu: bool = False) -> None:
     log(f"stage_sum={stage_sum_ms:.2f}ms vs e2e_mean={lat_mean_ms:.2f}ms "
         f"(coverage {stage_sum_ms / lat_mean_ms:.0%})")
 
+    # occupancy / critical path (ISSUE 6): which stage actually BOUNDED
+    # e2e. This loop is frame-serial, so overlap should read ~0 — the
+    # deep-pipeline rework (ROADMAP 2) is accepted the day this block
+    # shows real overlap while p99 tracks the slowest stage, not the sum
+    occ = occupancy_report(timelines)
+    occupancy_doc = {
+        "frames": occ["frames"],
+        "overlap_fraction": occ["overlap_fraction"],
+        "bubble_share": occ["bubble_share"],
+        "critical_path_share": {k: v["share"]
+                                for k, v in occ["critical_path"].items()},
+    }
+    log("occupancy / critical path (IDR latency loop):")
+    log(render_occupancy(occ))
+
     # -- throughput: pipelined like the capture thread, SERVING MIX (first
     # frame IDR, then P deltas on fully-animated content — the worst case
     # for the P path) --------------------------------------------------------
@@ -312,6 +358,26 @@ def main(force_cpu: bool = False) -> None:
     if want_profile:
         log(f"jax profiler capture stopped: {_prof.stop()}")
 
+    # perf block (ISSUE 6): static cost attribution recorded when the
+    # steps compiled (wrap_step in the engine) — flops, HBM bytes,
+    # roofline-ms — plus the parsed device-time table when a profiler
+    # capture just happened. This is the lever-ranking instrument that
+    # works with the relay down.
+    from selkies_tpu.obs import perf as _perf
+    perf_doc = _perf.registry.report()
+    for s in perf_doc["steps"][:4]:
+        if not s.get("error"):
+            log(f"perf: {s['name']}: {s['flops'] / 1e9:.2f} GFLOP, "
+                f"{s['bytes_accessed'] / 1e6:.1f} MB accessed, "
+                f"roofline {s['roofline_ms']:.2f}ms "
+                f"@ {perf_doc['hbm_gbps']:.0f}GB/s")
+    if profile_dir:
+        prof_table = _perf.parse_profile_dir(profile_dir)
+        perf_doc["profile"] = prof_table
+        log(f"device-time attribution: {prof_table['trace_files']} trace "
+            f"file(s), device={prof_table['device']}, "
+            f"steps={list(prof_table['steps'])}")
+
     # device telemetry for the JSON line: HBM peak (forced sample — the
     # timed loops are over, the RPC can't skew anything now), compile
     # accounting, and the backend health verdict (the contract test's
@@ -335,13 +401,16 @@ def main(force_cpu: bool = False) -> None:
         "ack_rtt_p50_ms": ack_pcts["p50_ms"],
         "ack_rtt_p99_ms": ack_pcts["p99_ms"],
         "drop_rate": 0.0,
-        "score": _qoe.qoe_score(fps, 60.0, ack_pcts["p50_ms"] or 0.0, 0.0),
+        # score from the same rounded fps the JSON line carries, so the
+        # contract test can recompute it exactly from the document alone
+        "score": _qoe.qoe_score(round(fps, 2), 60.0,
+                                ack_pcts["p50_ms"] or 0.0, 0.0),
     }
     log(f"qoe: rtt_p50={qoe_doc['ack_rtt_p50_ms']}ms "
         f"rtt_p99={qoe_doc['ack_rtt_p99_ms']}ms score={qoe_doc['score']}")
 
     mbps = total_bytes / n_lat * fps * 8 / 1e6
-    print(json.dumps({
+    doc = {
         "metric": f"encode_fps_{w}x{h}_{codec}_tpu",
         "value": round(fps, 2),
         "unit": "fps",
@@ -361,9 +430,13 @@ def main(force_cpu: bool = False) -> None:
         "compile_cache_hits": compile_stats["cache_hits"],
         "compile_cache_misses": compile_stats["cache_misses"],
         "qoe": qoe_doc,
+        "perf": perf_doc,
+        "occupancy": occupancy_doc,
         **({"profile_dir": profile_dir} if profile_dir else {}),
         "frames": n_frames,
-    }))
+    }
+    print(json.dumps(doc))
+    ledger_append(doc)
 
 
 async def _chaos_run(target_fps: float, w: int, h: int) -> dict:
@@ -553,7 +626,7 @@ def chaos_main(force_cpu: bool = False) -> None:
     log(f"chaos done in {dt:.1f}s: recovered={chaos['recovered']} "
         f"restarts={chaos['supervisor_restarts']} "
         f"qoe={chaos['qoe_score']} incidents={chaos['incidents']}")
-    print(json.dumps({
+    doc = {
         "metric": "chaos_recovery",
         "value": 1.0 if chaos["recovered"] else 0.0,
         "unit": "recovered",
@@ -563,7 +636,9 @@ def chaos_main(force_cpu: bool = False) -> None:
         "backend_health": {"status": verdict.status,
                            "reason": verdict.reason},
         "chaos": chaos,
-    }))
+    }
+    print(json.dumps(doc))
+    ledger_append(doc)
 
 
 if __name__ == "__main__":

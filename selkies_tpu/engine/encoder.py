@@ -32,6 +32,7 @@ import numpy as np
 
 from ..codecs import jpeg as jtab
 from ..codecs.jpeg import stuff_ff_bytes
+from ..obs import perf as _perf
 from ..resilience import faults as _faults
 from ..trace import tracer as _tracer
 from ..ops.stripes import concat_stripe_bytes, words_to_bytes_device
@@ -110,6 +111,10 @@ def build_step_fn(width: int, stripe_h: int, n_stripes: int, subsampling: str,
         overflow = jnp.any(packed.overflow) | buf.overflow
         return buf.data, buf.byte_lens, send, is_paint, age, overflow
 
+    # the XLA module compiles as jit_jpeg_step: what a jax.profiler
+    # capture's device lane shows, and what obs.perf's capture parser
+    # matches step attribution against
+    step.__name__ = "jpeg_step"
     return step
 
 
@@ -119,11 +124,14 @@ def _jitted_step(width: int, stripe_h: int, n_stripes: int, subsampling: str,
                  damage_gating: bool, paint_over: bool):
     """Compiled single-seat step; only the internal ``age`` state is donated
     — ``prev`` is the caller's previous frame array and sources are free to
-    reuse their buffers."""
-    return jax.jit(build_step_fn(width, stripe_h, n_stripes, subsampling,
-                                 e_cap, w_cap, out_cap, paint_delay,
-                                 damage_gating, paint_over),
-                   donate_argnums=(2,))
+    reuse their buffers. Wrapped for static cost attribution (obs.perf):
+    flops / HBM bytes / roofline-ms are recorded at compile time."""
+    return _perf.wrap_step(
+        f"jpeg.step[{width}x{stripe_h * n_stripes}@{subsampling}]",
+        jax.jit(build_step_fn(width, stripe_h, n_stripes, subsampling,
+                              e_cap, w_cap, out_cap, paint_delay,
+                              damage_gating, paint_over),
+                donate_argnums=(2,)))
 
 
 class JpegEncoderSession:

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..codecs import h264 as hcodec
+from ..obs import perf as _perf
 from ..ops.h264_encode import P_SLOTS_MB, SLOTS_MB, scroll_candidates
 from ..ops.h264_planes import (h264_encode_p_yuv, h264_encode_yuv,
                                rgb_to_yuv420)
@@ -193,6 +194,10 @@ def build_h264_step_fn(mode: str, width: int, stripe_h: int, n_stripes: int,
         return (buf.data, buf.byte_lens, send, is_paint, age, sent, fnum,
                 new_ry, new_ru, new_rv, overflow)
 
+    # the XLA module compiles as jit_h264_{i,p}_step: the name a
+    # jax.profiler capture's device lane carries, and the stem obs.perf's
+    # capture parser matches step attribution against
+    step.__name__ = f"h264_{mode}_step"
     return step
 
 
@@ -205,7 +210,12 @@ def _jitted_h264_step(mode: str, width: int, stripe_h: int, n_stripes: int,
     step = build_h264_step_fn(mode, width, stripe_h, n_stripes, e_cap,
                               w_cap, out_cap, paint_delay, damage_gating,
                               paint_over, candidates, fullcolor=fullcolor)
-    return jax.jit(step, donate_argnums=(2, 3, 4, 5, 6, 7))
+    # static cost attribution (obs.perf): flops / HBM bytes / roofline-ms
+    # recorded at compile time, so levers rank with the relay down
+    return _perf.wrap_step(
+        f"h264.{mode}_step[{width}x{stripe_h * n_stripes}"
+        f"{'@444' if fullcolor else ''}]",
+        jax.jit(step, donate_argnums=(2, 3, 4, 5, 6, 7)))
 
 
 class H264EncoderSession:

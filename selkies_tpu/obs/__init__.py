@@ -18,6 +18,12 @@ that class of failure self-diagnosing:
   metrics and overlaid on the trace timeline;
 - :mod:`.profiler` — on-demand ``jax.profiler`` capture behind
   ``POST /api/profile`` and ``bench.py --profile``;
+- :mod:`.perf` — performance observability: static
+  ``cost_analysis``/``memory_analysis`` per compiled engine step with a
+  derived roofline-ms (rank levers with the relay down), plus the
+  profiler-capture parser that turns one ``bench.py --profile`` run
+  into a per-step device-time table, behind ``GET /api/perf`` and the
+  bench ``perf`` block;
 - :mod:`.qoe` — per-session wire QoE: ACK-RTT estimation, client fps,
   backpressure windows, relay/congestion-controller counters, the
   composite QoE score behind ``GET /api/sessions``, the ``qoe`` health
@@ -34,6 +40,9 @@ are lazy and guarded (the same contract :mod:`..trace` keeps).
 from .device_monitor import DeviceMonitor, monitor  # noqa: F401
 from .health import (DEGRADED, FAILED, OK, FlightRecorder,  # noqa: F401
                      HealthEngine, Verdict, degraded, engine, failed, ok)
+from .perf import (PerfRegistry, parse_profile_dir,  # noqa: F401
+                   roofline_ms, wrap_step)
+from .perf import registry as perf_registry  # noqa: F401
 from .profiler import ProfilerSession, profiler  # noqa: F401
 from .qoe import (AckRttEstimator, QoERegistry,  # noqa: F401
                   SessionStats, qoe_score)

@@ -1,10 +1,11 @@
 """Offline obs CLI.
 
 ``python -m selkies_tpu.obs selftest`` — drive the real health engine,
-flight recorder, and device monitor with synthetic inputs and verify
-the full verdict pipeline round-trips (the CI lint smoke, mirroring
-``python -m selkies_tpu.trace selftest``). Exits non-zero on any
-contract break.
+flight recorder, device monitor, QoE registry, and perf plane (cost
+registry, roofline math, profiler-capture parser, critical-path
+attribution) with synthetic inputs and verify the full verdict pipeline
+round-trips (the CI lint smoke, mirroring ``python -m selkies_tpu.trace
+selftest``). Exits non-zero on any contract break.
 
 ``python -m selkies_tpu.obs health`` — evaluate the process-wide engine
 and print the verbose report as JSON (mostly useful under a debugger or
@@ -144,8 +145,80 @@ def _cmd_selftest(args: argparse.Namespace) -> int:
     if reg.health_check().status != OK:
         return _fail("empty registry must verdict ok")
 
+    # perf plane (ISSUE 6): registry round-trip, roofline math, and the
+    # profiler-capture parser — all jax-free (synthetic analyses and a
+    # synthetic trace.json.gz capture dir)
+    from . import perf as perf_mod
+    preg = perf_mod.PerfRegistry()
+    e = preg.record_analysis(
+        "h264.i_step[selftest]",
+        cost=[{"flops": 1e9, "bytes accessed": 8e8}],
+        memory={"argument_size_in_bytes": 100,
+                "output_size_in_bytes": 50,
+                "temp_size_in_bytes": 25},
+        backend="cpu", compile_s=1.25)
+    if abs(e["roofline_ms"] - 1.0) > 1e-9:     # 8e8 B at 800 GB/s = 1 ms
+        return _fail(f"roofline math broken: {e}")
+    if e["peak_bytes"] != 175 or e["flops"] != 1e9:
+        return _fail(f"cost/memory normalisation broken: {e}")
+    prep = preg.report()
+    json.loads(json.dumps(prep))           # /api/perf must round-trip
+    if prep["count"] != 1 or prep["steps"][0]["name"] != \
+            "h264.i_step[selftest]":
+        return _fail(f"perf report shape broken: {prep}")
+
+    import gzip
+    import os
+    import tempfile
+    d = tempfile.mkdtemp(prefix="selkies-perf-selftest-")
+    run = os.path.join(d, "plugins", "profile", "run1")
+    os.makedirs(run)
+    cap_events = [
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 0.0, "dur": 5000.0,
+         "name": "jit_h264_i_step"},
+        {"ph": "X", "pid": 7, "tid": 2, "ts": 0.0, "dur": 3000.0,
+         "name": "fusion.123"},
+        # host-side event with a matching name: must NOT be counted
+        # once a device lane exists
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 9000.0,
+         "name": "jit_h264_i_step"},
+    ]
+    with gzip.open(os.path.join(run, "host.trace.json.gz"), "wt") as f:
+        json.dump({"traceEvents": cap_events}, f)
+    table = perf_mod.parse_profile_dir(
+        d, step_names=["h264.i_step[64x32]"])
+    if not table["device"] or table["trace_files"] != 1:
+        return _fail(f"capture discovery broken: {table}")
+    step = table["steps"].get("h264.i_step[64x32]")
+    if step is None or abs(step["total_ms"] - 5.0) > 1e-9:
+        return _fail(f"device-time step attribution broken: {table}")
+    if abs(table["total_ms"] - 8.0) > 1e-9:
+        return _fail(f"host events leaked into device total: {table}")
+
+    # occupancy / critical path (the trace-side half of the perf plane):
+    # a constructed overlapped timeline must attribute the gating stage
+    from ..trace.summary import frame_critical_path
+    cp = frame_critical_path({
+        "display_id": "x", "frame_id": 1,
+        "t0_ns": 0, "t1_ns": 12_000_000,
+        "spans": [
+            {"name": "a", "lane": "l1", "t0_ns": 0,
+             "dur_ns": 10_000_000},
+            {"name": "b", "lane": "l2", "t0_ns": 2_000_000,
+             "dur_ns": 10_000_000},
+        ]})
+    if cp is None or abs(cp["stages"]["a"] - 2.0) > 1e-9 \
+            or abs(cp["stages"]["b"] - 10.0) > 1e-9:
+        return _fail(f"critical-path attribution broken: {cp}")
+    if abs(cp["overlap_fraction"] - 0.4) > 1e-9 or cp["bubble_ms"] != 0.0:
+        return _fail(f"overlap/bubble math broken: {cp}")
+
     doc = {"health": eng.report(verbose=True), "monitor": mon.snapshot(),
-           "qoe": doc0}
+           "qoe": doc0, "perf": prep, "device_time": table}
     text = json.dumps(doc)
     json.loads(text)                       # the payload must round-trip
     print(text if args.json else "selftest OK "

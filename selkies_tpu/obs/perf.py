@@ -1,0 +1,416 @@
+"""Performance observability: static cost attribution per compiled step
++ device-time attribution from jax.profiler captures.
+
+Why this exists (ISSUE 6): every perf lever since round 3 was ranked
+blind — the one real-TPU stage attribution (PERF.md, r3) was hand-run,
+died mid-profile, and predates the plane-layout rewrite, and with the
+TPU relay down there was NO instrument that could rank levers at all.
+This module supplies two instruments that work in that state:
+
+- **Static cost attribution** (:class:`PerfRegistry` + :func:`wrap_step`):
+  every engine step compiled through :func:`wrap_step` is lowered and
+  compiled ahead-of-time (the SAME single XLA build jit would do — the
+  wrapper executes the AOT ``Compiled`` object, it never double-builds),
+  and ``Lowered.cost_analysis()`` / ``Compiled.memory_analysis()`` are
+  recorded at compile time: flops, HBM bytes accessed, argument/output/
+  temp bytes, and a derived **roofline-ms** floor at :data:`HBM_GBPS`
+  (~800 GB/s, the v5e-class HBM figure PERF.md's layout analysis used).
+  Static numbers rank levers like the hierarchical bit-merge packer
+  *with the relay down*: bytes-moved deltas don't need a live chip.
+
+- **Device-time attribution** (:func:`parse_profile_dir`): parse the
+  ``*.trace.json.gz`` files a PR-3 ``jax.profiler`` capture writes into
+  a per-step device-time table (module-level ``jit_<step>`` events on
+  the device lanes, plus a top-ops table), so ONE ``bench.py --profile``
+  run on the real chip auto-produces the stage attribution ROADMAP
+  item 1 needs — no hand-driven cumulative-prefix session required.
+
+Import contract: stdlib-only at import time (the lint CI image has no
+jax); every jax touch point is lazy and guarded, and a wrapped step that
+cannot be analysed falls back to the plain jitted callable — analysis
+must never be able to break encode.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+logger = logging.getLogger("selkies_tpu.obs.perf")
+
+#: roofline bandwidth denominator: v5e-class HBM, the figure the PERF.md
+#: layout analysis reasoned with ("hundreds of MB/frame at ~800 GB/s")
+HBM_GBPS = 800.0
+
+
+def roofline_ms(bytes_accessed: float, gbps: float = HBM_GBPS) -> float:
+    """Memory-roofline floor for one step execution: the time the HBM
+    traffic alone costs at ``gbps``. A measured step time far above its
+    roofline-ms means the step is compute- or latency-bound (or the
+    layout pads, the r3 failure mode); at ~1x it is bandwidth-bound and
+    only moving fewer bytes can help."""
+    if bytes_accessed <= 0 or gbps <= 0:
+        return 0.0
+    return bytes_accessed / (gbps * 1e9) * 1e3
+
+
+def _norm_cost(cost: Any) -> dict:
+    """Normalise a jax cost_analysis result: 0.4.x ``Compiled`` returns a
+    one-element list of dicts, ``Lowered`` a plain dict."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return cost if isinstance(cost, dict) else {}
+
+
+def _norm_memory(mem: Any) -> dict:
+    """CompiledMemoryStats -> plain ints (also accepts a dict for
+    synthetic selftest input)."""
+    if mem is None:
+        return {}
+    if isinstance(mem, dict):
+        src = mem.get
+    else:
+        src = lambda k, d=0: getattr(mem, k, d)   # noqa: E731
+    out = {}
+    for k in ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "alias_size_in_bytes",
+              "generated_code_size_in_bytes"):
+        try:
+            out[k] = int(src(k, 0) or 0)
+        except (TypeError, ValueError):
+            out[k] = 0
+    return out
+
+
+class PerfRegistry:
+    """Process-wide table of per-step static cost analyses. One instance
+    (:data:`registry`) serves the engine compile sites, ``/api/perf``
+    and bench; tests build their own and feed synthetic analyses."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._steps: dict[str, dict] = {}
+
+    def record_analysis(self, name: str, cost: Any = None,
+                        memory: Any = None, *,
+                        backend: Optional[str] = None,
+                        compile_s: Optional[float] = None,
+                        signature: Optional[str] = None,
+                        error: Optional[str] = None) -> dict:
+        """Record (or overwrite — recompiles after buffer growth replace
+        the stale entry) one compiled step's static analysis."""
+        cost = _norm_cost(cost)
+        mem = _norm_memory(memory)
+        flops = float(cost.get("flops", 0.0) or 0.0)
+        bytes_accessed = float(cost.get("bytes accessed", 0.0) or 0.0)
+        peak_bytes = (mem.get("argument_size_in_bytes", 0)
+                      + mem.get("output_size_in_bytes", 0)
+                      + mem.get("temp_size_in_bytes", 0)
+                      + mem.get("alias_size_in_bytes", 0))
+        entry = {
+            "name": name,
+            "backend": backend,
+            "flops": flops,
+            "bytes_accessed": bytes_accessed,
+            "roofline_ms": round(roofline_ms(bytes_accessed), 4),
+            "arg_bytes": mem.get("argument_size_in_bytes", 0),
+            "out_bytes": mem.get("output_size_in_bytes", 0),
+            "temp_bytes": mem.get("temp_size_in_bytes", 0),
+            "peak_bytes": peak_bytes,
+            "generated_code_bytes": mem.get(
+                "generated_code_size_in_bytes", 0),
+            "compile_s": round(compile_s, 3)
+            if compile_s is not None else None,
+            "signature": signature,
+            "error": error,
+            "recorded_at": time.time(),
+        }
+        with self._lock:
+            self._steps[name] = entry
+        return entry
+
+    def clear(self) -> None:
+        with self._lock:
+            self._steps.clear()
+
+    def report(self) -> dict:
+        """``/api/perf`` / bench ``perf`` block payload: every recorded
+        step, bandwidth-heaviest first, plus the roofline assumptions so
+        a reader can re-derive the numbers."""
+        with self._lock:
+            steps = sorted(self._steps.values(),
+                           key=lambda e: -e["bytes_accessed"])
+        return {
+            "hbm_gbps": HBM_GBPS,
+            "steps": steps,
+            "count": len(steps),
+        }
+
+
+#: the process-wide registry every wrap_step call records into
+registry = PerfRegistry()
+
+
+def _aval_signature(args: tuple) -> tuple:
+    """Hashable per-call signature: (shape, dtype, weak) per array leaf,
+    a type tag otherwise. Distinct signatures get distinct compiles —
+    exactly jit's cache key semantics for the arguments we pass."""
+    sig = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(shape), str(dtype),
+                        bool(getattr(a, "weak_type", False))))
+        else:
+            sig.append(("py", type(a).__name__))
+    return tuple(sig)
+
+
+class _WrappedStep:
+    """AOT-instrumented jitted step. First call per argument signature
+    lowers + compiles (ONE XLA build, same persistent-cache key jit
+    would use) and records the static cost analysis; subsequent calls
+    execute the AOT ``Compiled`` directly. Any failure — lowering,
+    compile, analysis, or an executable call — permanently falls back
+    to the plain jitted callable for that signature."""
+
+    __slots__ = ("name", "_jitted", "_registry", "_cache", "_lock")
+
+    #: sentinel: this signature must use the plain jitted path
+    _FALLBACK = object()
+
+    def __init__(self, name: str, jitted: Callable,
+                 registry_: Optional[PerfRegistry] = None):
+        self.name = name
+        self._jitted = jitted
+        self._registry = registry_ or registry
+        self._cache: dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, *args):
+        try:
+            key = _aval_signature(args)
+            entry = self._cache.get(key)
+        except Exception:
+            return self._jitted(*args)
+        if entry is None:
+            entry = self._prepare(key, args)
+        if entry is self._FALLBACK:
+            return self._jitted(*args)
+        try:
+            return entry(*args)
+        except Exception:
+            # e.g. a sharding/layout mismatch the jit dispatch would have
+            # absorbed with a transfer: stop trying for this signature
+            logger.exception("perf-instrumented step %s failed; "
+                             "falling back to jit dispatch", self.name)
+            with self._lock:
+                self._cache[key] = self._FALLBACK
+            for a in args:
+                deleted = getattr(a, "is_deleted", None)
+                if callable(deleted) and deleted():
+                    # the executable died mid-run AFTER consuming donated
+                    # inputs (reference planes, age counters): a retry
+                    # would mask the real device error with "Array has
+                    # been deleted" against already-lost session state
+                    raise
+            return self._jitted(*args)
+
+    def _prepare(self, key: tuple, args: tuple):
+        """Lower + compile + analyse under the lock (first frame only —
+        the same compile barrier jit dispatch would impose)."""
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is not None:
+                return entry
+            if os.environ.get("SELKIES_PERF_ANALYSIS") == "0":
+                self._cache[key] = self._FALLBACK
+                return self._FALLBACK
+            t0 = time.monotonic()
+            try:
+                lowered = self._jitted.lower(*args)
+                cost = None
+                try:
+                    cost = lowered.cost_analysis()
+                except Exception:
+                    pass
+                compiled = lowered.compile()
+                compile_s = time.monotonic() - t0
+                try:
+                    # post-optimisation traffic when available: what the
+                    # executable actually moves, not what the jaxpr says
+                    cost = compiled.cost_analysis() or cost
+                except Exception:
+                    pass
+                mem = None
+                try:
+                    mem = compiled.memory_analysis()
+                except Exception:
+                    pass
+                backend = None
+                try:
+                    import jax
+                    backend = jax.default_backend()
+                except Exception:
+                    pass
+                self._registry.record_analysis(
+                    self.name, cost, mem, backend=backend,
+                    compile_s=compile_s, signature=_sig_str(key))
+                self._cache[key] = compiled
+                return compiled
+            except Exception as e:
+                logger.warning("perf analysis of step %s unavailable "
+                               "(%s: %s); using jit dispatch",
+                               self.name, type(e).__name__, e)
+                self._registry.record_analysis(
+                    self.name, signature=_sig_str(key),
+                    error=f"{type(e).__name__}: {e}"[:200])
+                self._cache[key] = self._FALLBACK
+                return self._FALLBACK
+
+
+def _sig_str(key: tuple) -> str:
+    parts = []
+    for leaf in key:
+        if leaf and leaf[0] == "py":
+            parts.append(leaf[1])
+        else:
+            shape, dtype = leaf[0], leaf[1]
+            parts.append(f"{dtype}[{','.join(map(str, shape))}]")
+    return f"({', '.join(parts)})"
+
+
+def wrap_step(name: str, jitted: Callable) -> Callable:
+    """Instrument a ``jax.jit`` product for static cost attribution.
+    Returns a callable with the jitted function's calling convention
+    (donation included — the AOT path preserves ``donate_argnums``)."""
+    return _WrappedStep(name, jitted)
+
+
+# --------------------------------------------------------------- profiles
+def _load_trace_events(path: str) -> list[dict]:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        doc = json.loads(f.read().decode("utf-8", "replace"))
+    events = doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
+    return [e for e in events if isinstance(e, dict)] \
+        if isinstance(events, list) else []
+
+
+def parse_profile_dir(trace_dir: str,
+                      step_names: Optional[list[str]] = None) -> dict:
+    """Per-step device-time table from a ``jax.profiler`` capture.
+
+    Finds every ``*.trace.json[.gz]`` under ``trace_dir`` (the
+    TensorBoard layout: ``plugins/profile/<run>/<host>.trace.json.gz``),
+    keeps complete-event (``X``) durations on **device** processes
+    (process_name containing ``/device:``; host processes only when no
+    device lane exists — the CPU-backend case), and attributes them:
+
+    - ``steps``: total/count/mean ms per registered step name (from
+      :data:`registry` unless ``step_names`` is given), matched by
+      substring against event names — XLA module-level events are named
+      ``jit_<step_fn_name>``, which is why the engine names its step
+      functions (``h264_i_step`` etc.);
+    - ``top_ops``: the heaviest individual event names, the
+      "which fusion actually eats the frame" view.
+    """
+    files = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                  recursive=True)
+        + glob.glob(os.path.join(trace_dir, "**", "*.trace.json"),
+                    recursive=True))
+    if step_names is None:
+        step_names = [e["name"] for e in registry.report()["steps"]]
+    # profiler-friendly aliases: "h264.i_step[...]" matches events via
+    # its function-name stem ("h264_i_step"). Two registry entries can
+    # share a stem — the same program compiled at two geometries (e.g.
+    # after a ladder downscale rebuilt the session); XLA names both
+    # modules identically, so the capture cannot tell them apart
+    by_stem: dict[str, list[str]] = {}
+    for name in step_names:
+        stem = name.split("[", 1)[0].replace(".", "_")
+        by_stem.setdefault(stem, []).append(name)
+    out: dict = {"trace_dir": trace_dir, "trace_files": len(files),
+                 "device": False, "total_ms": 0.0, "n_events": 0,
+                 "steps": {}, "top_ops": []}
+    if not files:
+        return out
+    # per-file streaming: a real TPU capture decompresses to hundreds of
+    # MB of events — aggregate each file into small {name: [count, ms]}
+    # dicts and drop its event list before the next file. Each trace
+    # file carries its own process metadata, so device-lane filtering is
+    # decidable per file; the device/host FALLBACK (a CPU capture has no
+    # device lane at all) is resolved once every file has been seen.
+    by_name_device: dict[str, list] = {}
+    by_name_all: dict[str, list] = {}
+    n_device = n_all = 0
+    for path in files:
+        try:
+            evs = _load_trace_events(path)
+        except (OSError, ValueError):
+            continue
+        device_pids = {
+            e.get("pid") for e in evs
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+            and "/device:" in str((e.get("args") or {}).get("name", ""))}
+        for e in evs:
+            if e.get("ph") != "X" or "dur" not in e:
+                continue
+            name = str(e.get("name", "?"))
+            ms = float(e["dur"]) / 1e3       # µs -> ms
+            if e.get("pid") in device_pids:
+                acc = by_name_device.setdefault(name, [0, 0.0])
+                n_device += 1
+            elif n_device == 0:
+                # host events matter only for the no-device-lane-at-all
+                # fallback (CPU captures); once any device event exists,
+                # stop growing — XLA op names are high-cardinality and a
+                # real capture would balloon this dict for nothing
+                acc = by_name_all.setdefault(name, [0, 0.0])
+                n_all += 1
+            else:
+                continue
+            acc[0] += 1
+            acc[1] += ms
+        if n_device and by_name_all:
+            by_name_all.clear()
+    out["device"] = n_device > 0
+    by_name = by_name_device if out["device"] else by_name_all
+    out["n_events"] = n_device if out["device"] else n_all
+    # each event name is claimed by at most ONE stem (most-specific
+    # first). A stem shared by several registry entries gets one MERGED
+    # row listing its claimants — crediting all the time to whichever
+    # geometry sorts first would be a silently-wrong attribution
+    steps: dict[str, dict] = {}
+    claimed: set[str] = set()
+    for stem, names in sorted(by_stem.items(),
+                              key=lambda kv: (-len(kv[0]), kv[0])):
+        total = count = 0
+        for ev_name, (c, ms) in by_name.items():
+            if stem and stem in ev_name and ev_name not in claimed:
+                total, count = total + ms, count + c
+                claimed.add(ev_name)
+        if count:
+            row = {"count": count, "total_ms": round(total, 3),
+                   "mean_ms": round(total / count, 3)}
+            if len(names) > 1:
+                row["ambiguous"] = sorted(names)
+                steps[names[0].split("[", 1)[0] + "[*]"] = row
+            else:
+                steps[names[0]] = row
+    out["steps"] = dict(sorted(steps.items(),
+                               key=lambda kv: -kv[1]["total_ms"]))
+    out["total_ms"] = round(sum(ms for _, ms in by_name.values()), 3)
+    out["top_ops"] = [
+        {"name": n, "count": c, "total_ms": round(ms, 3)}
+        for n, (c, ms) in sorted(by_name.items(),
+                                 key=lambda kv: -kv[1][1])[:12]]
+    return out

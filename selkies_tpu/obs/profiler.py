@@ -35,6 +35,9 @@ class ProfilerSession:
         self.trace_dir: Optional[str] = None
         self.started_at: Optional[float] = None
         self.captures = 0
+        #: dir of the last COMPLETED capture — what GET /api/perf
+        #: parses for device-time attribution without a path parameter
+        self.last_trace_dir: Optional[str] = None
         #: a jax start/stop call is in flight (outside the lock); a new
         #: start must not race a still-serialising stop
         self._busy = False
@@ -100,6 +103,7 @@ class ProfilerSession:
                 self._busy = False
         with self._lock:
             self.captures += 1
+            self.last_trace_dir = target
         dur = round(time.monotonic() - t0, 3) if t0 else None
         logger.info("jax profiler capture stopped (%.1fs) -> %s",
                     dur or 0.0, target)
@@ -110,6 +114,7 @@ class ProfilerSession:
         with self._lock:
             return {"active": self.trace_dir is not None,
                     "trace_dir": self.trace_dir,
+                    "last_trace_dir": self.last_trace_dir,
                     "captures": self.captures}
 
 

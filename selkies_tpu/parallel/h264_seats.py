@@ -105,7 +105,15 @@ class MultiSeatH264Encoder:
         sharded = shard_map(jax.vmap(step), mesh=self.mesh,
                             in_specs=(spec,) * 13,
                             out_specs=(spec,) * 11)
-        return jax.jit(sharded, donate_argnums=(2, 3, 4, 5, 6, 7))
+        # compile as jit_h264_seatsN_{i,p}_step so a profiler capture
+        # attributes multi-seat device time to the seats row, distinct
+        # from the single-seat h264_{i,p}_step stem
+        sharded.__name__ = f"h264_seats{self.n_seats}_{mode}_step"
+        from ..obs import perf as _perf
+        return _perf.wrap_step(
+            f"h264.seats{self.n_seats}_{mode}_step"
+            f"[{g.width}x{g.height}]",
+            jax.jit(sharded, donate_argnums=(2, 3, 4, 5, 6, 7)))
 
     # ------------------------------------------------------------------ state
     @property

@@ -96,7 +96,16 @@ class MultiSeatEncoder:
         spec = self._spec
         sharded = shard_map(jax.vmap(step), mesh=self.mesh,
                             in_specs=(spec,) * 7, out_specs=(spec,) * 6)
-        return jax.jit(sharded, donate_argnums=(2,))
+        # the XLA module must compile as jit_jpeg_seatsN_step (NOT the
+        # inner jpeg_step) so a profiler capture attributes multi-seat
+        # device time to the seats row, and the single-seat stem
+        # ("jpeg_step") can't claim these events
+        sharded.__name__ = f"jpeg_seats{self.n_seats}_step"
+        from ..obs import perf as _perf
+        return _perf.wrap_step(
+            f"jpeg.seats{self.n_seats}_step[{g.width}x{g.height}"
+            f"@{self.subsampling}]",
+            jax.jit(sharded, donate_argnums=(2,)))
 
     # --------------------------------------------------------------- tunables
     def update_quality(self, motion_q: int, paint_q: int | None = None):

@@ -15,7 +15,8 @@ designed fresh:
   incident flight recorder, ``?probe=live|ready`` for container
   orchestration), ``/api/metrics``, ``/api/switch`` (live transport
   swap when ``enable_dual_mode``, reference :804-895), ``/api/profile``
-  (on-demand jax.profiler capture, full-role gated);
+  (on-demand jax.profiler capture, full-role gated), ``/api/perf``
+  (static step cost attribution + pipeline occupancy, ISSUE 6);
 - chunked file upload with path-traversal + symlink defences and a
   JSON/HTML download index (reference :897-1299);
 - TLS with live certificate reload (reference :552-632);
@@ -201,6 +202,7 @@ class CentralizedStreamServer:
         r.add_post("/api/switch", self.handle_switch)
         r.add_get("/api/trace", self.handle_trace)
         r.add_post("/api/trace", self.handle_trace_control)
+        r.add_get("/api/perf", self.handle_perf)
         r.add_get("/api/sessions", self.handle_sessions)
         r.add_post("/api/profile", self.handle_profile)
         r.add_get("/api/faults", self.handle_faults)
@@ -332,6 +334,35 @@ class CentralizedStreamServer:
                 text=f"unknown action {action!r} (want start|stop|status)")
         return web.json_response(res,
                                  status=200 if res.get("ok", True) else 409)
+
+    async def handle_perf(self, request: web.Request) -> web.Response:
+        """Performance observability (obs.perf, ISSUE 6): static
+        per-step cost analysis (flops / HBM bytes / roofline-ms recorded
+        at compile time) plus occupancy / critical-path analysis over
+        the live trace ring. ``?profile=1`` additionally parses the last
+        completed jax.profiler capture into a per-step device-time table
+        (full-role: it reads capture files off disk)."""
+        from ..obs import perf as _perf
+        from ..obs import profiler
+        from ..trace import tracer
+        from ..trace.summary import occupancy_report
+        doc = {
+            "perf": _perf.registry.report(),
+            "occupancy": occupancy_report(
+                t for t in tracer.snapshot() if t.done),
+            "tracing": tracer.enabled,
+        }
+        if request.query.get("profile") in ("1", "true"):
+            if request["role"] != "full":
+                return web.Response(status=403, text="view-only")
+            last = profiler.status().get("last_trace_dir")
+            if last:
+                loop = asyncio.get_running_loop()
+                doc["profile"] = await loop.run_in_executor(
+                    None, lambda: _perf.parse_profile_dir(last))
+            else:
+                doc["profile"] = None
+        return web.json_response(doc)
 
     async def handle_sessions(self, request: web.Request) -> web.Response:
         """Per-session wire QoE (the ``getStats()`` analog): summary

@@ -19,8 +19,10 @@ import sys
 
 from . import STAGES
 from .core import FrameTracer
-from .export import events_from_document, to_trace_events
-from .summary import render_table, summarize_events
+from .export import (events_from_document, timelines_from_events,
+                     to_trace_events)
+from .summary import (occupancy_report, render_occupancy, render_table,
+                      summarize_events)
 
 
 def _cmd_summarize(args: argparse.Namespace) -> int:
@@ -32,13 +34,19 @@ def _cmd_summarize(args: argparse.Namespace) -> int:
         print(f"error: {args.file}: {e}", file=sys.stderr)
         return 2
     summary = summarize_events(events)
+    occ = occupancy_report(timelines_from_events(events)) \
+        if args.occupancy else None
     if args.json:
-        print(json.dumps({"version": 1, "file": args.file,
-                          "stages": summary}))
+        doc_out = {"version": 1, "file": args.file, "stages": summary}
+        if occ is not None:
+            doc_out["occupancy"] = occ
+        print(json.dumps(doc_out))
     else:
         if not summary:
             print("no complete spans in trace", file=sys.stderr)
         print(render_table(summary))
+        if occ is not None:
+            print(render_occupancy(occ))
     return 0
 
 
@@ -68,7 +76,22 @@ def _cmd_selftest(args: argparse.Namespace) -> int:
         print(f"selftest FAILED: stages lost in round-trip: {missing}",
               file=sys.stderr)
         return 1
+    # occupancy must round-trip through the exported form too: the
+    # synthetic timeline is fully serial, so no overlap may be detected
+    # and the critical path may only name real stages (or bubble)
+    occ = occupancy_report(
+        timelines_from_events(events_from_document(json.loads(text))))
+    if occ["frames"] != 4 or occ["overlap_fraction"] > 0.05:
+        print(f"selftest FAILED: serial timeline misread as overlapped: "
+              f"{occ}", file=sys.stderr)
+        return 1
+    from .summary import BUBBLE
+    if not set(occ["critical_path"]) <= set(STAGES) | {BUBBLE}:
+        print(f"selftest FAILED: critical path names unknown stages: "
+              f"{sorted(occ['critical_path'])}", file=sys.stderr)
+        return 1
     print(render_table(summary), file=sys.stderr)
+    print(render_occupancy(occ), file=sys.stderr)
     return 0
 
 
@@ -81,6 +104,9 @@ def main(argv=None) -> int:
     ps.add_argument("file")
     ps.add_argument("--json", action="store_true",
                     help="machine-readable output")
+    ps.add_argument("--occupancy", action="store_true",
+                    help="add overlap/critical-path/lane-occupancy "
+                         "analysis (completed frames only)")
     ps.set_defaults(fn=_cmd_summarize)
     pt = sub.add_parser("selftest",
                         help="synthetic timeline through tracer+exporter")

@@ -69,6 +69,54 @@ def to_trace_events(timelines: Iterable[Union[FrameTimeline, dict]],
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+def timelines_from_events(events: Iterable[dict]) -> list[dict]:
+    """Inverse of :func:`to_trace_events` for COMPLETED frames: rebuild
+    timeline dicts (t0_ns/t1_ns/spans) from an exported document so the
+    occupancy analyzer runs identically on a saved /api/trace snapshot.
+    Spans re-attach by ``args.frame_id``+``args.display``; lanes come
+    from the thread_name metadata. Frames whose envelope event was never
+    exported (still open at export time) are dropped — interval math
+    needs a closed window."""
+    thread_names: dict[tuple, str] = {}
+    frames: dict[tuple, dict] = {}
+    spans: list[tuple[tuple, dict, object]] = []
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            thread_names[(e.get("pid"), e.get("tid"))] = str(
+                (e.get("args") or {}).get("name", ""))
+            continue
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        fid = args.get("frame_id")
+        if fid is None:
+            continue
+        key = (args.get("display"), fid)
+        name = str(e.get("name", "?"))
+        if name.startswith("frame "):
+            frames[key] = {
+                "display_id": args.get("display"), "frame_id": fid,
+                "t0_ns": int(float(e["ts"]) * 1e3),
+                "t1_ns": int((float(e["ts"]) + float(e["dur"])) * 1e3),
+                "spans": [],
+            }
+        else:
+            spans.append((key, e, (e.get("pid"), e.get("tid"))))
+    for key, e, tkey in spans:
+        tl = frames.get(key)
+        if tl is None:
+            continue
+        tl["spans"].append({
+            "name": str(e.get("name", "?")),
+            "lane": thread_names.get(tkey) or str(tkey[1]),
+            "t0_ns": int(float(e["ts"]) * 1e3),
+            "dur_ns": int(float(e.get("dur", 0)) * 1e3),
+        })
+    return [frames[k] for k in sorted(frames, key=lambda k: frames[k]["t0_ns"])]
+
+
 def events_from_document(doc) -> list[dict]:
     """Accept either the object form ({"traceEvents": [...]}) or the bare
     JSON-array form — both are valid on the import side of the viewers."""

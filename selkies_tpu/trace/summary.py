@@ -1,4 +1,20 @@
-"""Per-stage latency summarizer: p50/p99 per span name.
+"""Per-stage latency summarizer + pipeline occupancy analyzer.
+
+Two instruments over the same frame timelines:
+
+- **per-stage percentiles** (:func:`summarize_timelines`): p50/p99/mean
+  per span name — the BENCH_r*.json breakdown and the
+  ``selkies_stage_ms`` histogram feed;
+- **occupancy / critical path** (:func:`occupancy_report`): which stage
+  actually *bounded* each frame's end-to-end time. Stage-sum coverage
+  (the PR-2 20% contract) stops being meaningful the moment stages
+  overlap — a deep pipeline's stage sum exceeds e2e by design — so the
+  acceptance instrument for the pipeline rework is interval math:
+  per-frame critical-path attribution (each instant of the frame window
+  is charged to the covering span that ends last — the stage still
+  gating completion — or to ``bubble`` when nothing runs), an overlap
+  fraction (0 for a fully-serial pipeline), and per-lane occupancy /
+  largest-gap detection over the whole timeline window.
 
 Consumes either live :class:`~.core.FrameTimeline`s or the exported
 Chrome trace-event JSON (the offline CLI path), so a BENCH_r*.json
@@ -7,7 +23,7 @@ breakdown and a saved /api/trace snapshot summarize identically.
 
 from __future__ import annotations
 
-from typing import Iterable, Union
+from typing import Iterable, Optional, Union
 
 from .core import FrameTimeline
 
@@ -75,6 +91,181 @@ def frame_latency_ms(timelines: Iterable[Union[FrameTimeline, dict]]
         if d.get("t1_ns") is not None:
             out.append((d["t1_ns"] - d["t0_ns"]) / 1e6)
     return out
+
+
+#: pseudo-stage charged with frame-window time no span covers (host
+#: gaps, scheduling stalls, untraced work)
+BUBBLE = "bubble"
+
+
+def frame_critical_path(tl: Union[FrameTimeline, dict]) -> Optional[dict]:
+    """Interval attribution for ONE completed frame.
+
+    Every instant of ``[t0, t1]`` is charged to exactly one account:
+    the covering span that ends last (several stages running at once —
+    the one finishing last is the one gating completion), or
+    :data:`BUBBLE` when no span covers it. By construction
+    ``sum(stages) + bubble == e2e`` exactly; for a fully-serial pipeline
+    each stage's charge equals its duration, so the critical path
+    equals the stage sum.
+
+    ``overlap_fraction`` = 1 - union/stage-sum: 0.0 when no two spans
+    ever overlap, approaching 1.0 as everything runs concurrently.
+    Returns None for open frames or frames with no positive spans.
+    """
+    d = tl if isinstance(tl, dict) else tl.to_dict()
+    if d.get("t1_ns") is None:
+        return None
+    t0f, t1f = d["t0_ns"], d["t1_ns"]
+    ivs: list[tuple[int, int, str]] = []
+    for s in d.get("spans", []):
+        if s["dur_ns"] <= 0:
+            continue
+        a = max(s["t0_ns"], t0f)
+        b = min(s["t0_ns"] + s["dur_ns"], t1f)
+        if b > a:
+            ivs.append((a, b, s["name"]))
+    if not ivs:
+        return None
+    points = sorted({t0f, t1f, *(a for a, _, _ in ivs),
+                     *(b for _, b, _ in ivs)})
+    stages: dict[str, float] = {}
+    bubble_ns = 0
+    for p, q in zip(points, points[1:]):
+        cover = [iv for iv in ivs if iv[0] <= p and iv[1] >= q]
+        if not cover:
+            bubble_ns += q - p
+            continue
+        # the gating span: latest end, then latest start for stability
+        _, _, name = max(cover, key=lambda iv: (iv[1], iv[0], iv[2]))
+        stages[name] = stages.get(name, 0.0) + (q - p)
+    e2e_ns = t1f - t0f
+    sum_ns = sum(b - a for a, b, _ in ivs)
+    union_ns = e2e_ns - bubble_ns
+    return {
+        "e2e_ms": e2e_ns / 1e6,
+        "bubble_ms": bubble_ns / 1e6,
+        "stage_sum_ms": sum_ns / 1e6,
+        "overlap_fraction": max(0.0, 1.0 - union_ns / sum_ns)
+        if sum_ns > 0 else 0.0,
+        "stages": {n: v / 1e6 for n, v in stages.items()},
+    }
+
+
+def _merge_intervals(ivs: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    merged: list[list[int]] = []
+    for a, b in sorted(ivs):
+        if merged and a <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], b)
+        else:
+            merged.append([a, b])
+    return [(a, b) for a, b in merged]
+
+
+def lane_occupancy(timelines: Iterable[Union[FrameTimeline, dict]]
+                   ) -> dict[str, dict]:
+    """Per-lane busy fraction over the whole observed window — the
+    deep-pipeline acceptance view: after the rework every lane should
+    stay busy (occupancy -> 1 for the bottleneck lane) instead of the
+    frame-serial pattern where every lane idles while one works.
+    ``largest_gap_ms`` is the worst bubble inside the window."""
+    by_lane: dict[str, list[tuple[int, int]]] = {}
+    w0: Optional[int] = None
+    w1: Optional[int] = None
+    for tl in timelines:
+        d = tl if isinstance(tl, dict) else tl.to_dict()
+        if d.get("t1_ns") is None:
+            continue
+        w0 = d["t0_ns"] if w0 is None else min(w0, d["t0_ns"])
+        w1 = d["t1_ns"] if w1 is None else max(w1, d["t1_ns"])
+        for s in d.get("spans", []):
+            if s["dur_ns"] > 0:
+                by_lane.setdefault(s.get("lane") or "?", []).append(
+                    (s["t0_ns"], s["t0_ns"] + s["dur_ns"]))
+    if w0 is None or w1 is None or w1 <= w0:
+        return {}
+    window_ns = w1 - w0
+    out: dict[str, dict] = {}
+    for lane, ivs in by_lane.items():
+        # clip to the frame-envelope window (a ws.send span adopted by
+        # frame-id can outlive its frame's t1): busy must never exceed
+        # the denominator, or occupancy reads > 100%
+        clipped = [(max(a, w0), min(b, w1)) for a, b in ivs
+                   if min(b, w1) > max(a, w0)]
+        merged = _merge_intervals(clipped)
+        busy = sum(b - a for a, b in merged)
+        gaps = []
+        prev = w0
+        for a, b in merged:
+            gaps.append(a - prev)
+            prev = max(prev, b)
+        gaps.append(w1 - prev)
+        out[lane] = {
+            "busy_ms": round(busy / 1e6, 3),
+            "window_ms": round(window_ns / 1e6, 3),
+            "occupancy": round(busy / window_ns, 4),
+            "largest_gap_ms": round(max(0, *gaps) / 1e6, 3),
+        }
+    return dict(sorted(out.items(), key=lambda kv: -kv[1]["occupancy"]))
+
+
+def occupancy_report(timelines: Iterable[Union[FrameTimeline, dict]]
+                     ) -> dict:
+    """Aggregate occupancy / critical-path analysis over completed
+    frames. Aggregate ``overlap_fraction`` and the per-stage
+    ``critical_path`` shares come from the per-frame totals (not a mean
+    of ratios), so long frames weigh what they should."""
+    dicts = [tl if isinstance(tl, dict) else tl.to_dict()
+             for tl in timelines]
+    per = [cp for cp in (frame_critical_path(d) for d in dicts)
+           if cp is not None]
+    if not per:
+        return {"frames": 0, "overlap_fraction": 0.0, "bubble_share": 0.0,
+                "critical_path": {}, "e2e_ms": {}, "lanes": {}}
+    e2e = sorted(cp["e2e_ms"] for cp in per)
+    e2e_total = sum(e2e)
+    sum_total = sum(cp["stage_sum_ms"] for cp in per)
+    bubble_total = sum(cp["bubble_ms"] for cp in per)
+    union_total = e2e_total - bubble_total
+    stage_tot: dict[str, float] = {}
+    for cp in per:
+        for name, ms in cp["stages"].items():
+            stage_tot[name] = stage_tot.get(name, 0.0) + ms
+    critical = {
+        name: {"ms": round(tot / len(per), 3),
+               "share": round(tot / e2e_total, 4) if e2e_total else 0.0}
+        for name, tot in sorted(stage_tot.items(), key=lambda kv: -kv[1])}
+    return {
+        "frames": len(per),
+        "overlap_fraction": round(max(0.0, 1.0 - union_total / sum_total), 4)
+        if sum_total > 0 else 0.0,
+        "bubble_share": round(bubble_total / e2e_total, 4)
+        if e2e_total else 0.0,
+        "critical_path": critical,
+        "e2e_ms": {"mean": round(e2e_total / len(e2e), 3),
+                   "p50": round(_pct(e2e, 0.50), 3),
+                   "p99": round(_pct(e2e, 0.99), 3)},
+        "lanes": lane_occupancy(dicts),
+    }
+
+
+def render_occupancy(report: dict) -> str:
+    """Human table for the CLI / bench stderr."""
+    lines = [f"frames={report['frames']} "
+             f"overlap={report['overlap_fraction']:.1%} "
+             f"bubble={report['bubble_share']:.1%} "
+             f"e2e_p50={report['e2e_ms'].get('p50', 0.0)}ms"]
+    lines.append(f"{'critical path':<18} {'mean_ms':>9} {'share':>7}")
+    for name, s in report["critical_path"].items():
+        lines.append(f"{name:<18} {s['ms']:>9.3f} {s['share']:>6.1%}")
+    if report["lanes"]:
+        lines.append(f"{'lane':<18} {'busy_ms':>9} {'occup':>7} "
+                     f"{'max_gap_ms':>11}")
+        for lane, s in report["lanes"].items():
+            lines.append(f"{lane:<18} {s['busy_ms']:>9.3f} "
+                         f"{s['occupancy']:>6.1%} "
+                         f"{s['largest_gap_ms']:>11.3f}")
+    return "\n".join(lines)
 
 
 def render_table(summary: dict[str, dict]) -> str:

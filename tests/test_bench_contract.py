@@ -16,11 +16,17 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
 
 _cache = {}
+
+#: the run's auto-appended ledger (ISSUE 6) — redirected to a tempdir
+#: so the contract run never pollutes the repo's committed trajectory
+_LEDGER = os.path.join(tempfile.mkdtemp(prefix="selkies-bench-contract-"),
+                       "ledger.jsonl")
 
 
 def _bench_doc() -> dict:
@@ -30,7 +36,8 @@ def _bench_doc() -> dict:
                JAX_PLATFORMS="cpu", BENCH_CPU_REASON="relay-dead",
                BENCH_WIDTH="256", BENCH_HEIGHT="128",
                BENCH_FRAMES="6", BENCH_LAT_BUDGET_S="10",
-               BENCH_TP_BUDGET_S="10", BENCH_PROBE_BUDGET_S="1")
+               BENCH_TP_BUDGET_S="10", BENCH_PROBE_BUDGET_S="1",
+               PERF_LEDGER_PATH=_LEDGER)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     r = subprocess.run([sys.executable, str(ROOT / "bench.py")],
                        capture_output=True, text=True, timeout=900,
@@ -89,6 +96,59 @@ def test_bench_qoe_block():
     assert 0.0 <= q["score"] <= 100.0
     assert q["score"] == qoe_score(doc["value"], 60.0,
                                    q["ack_rtt_p50_ms"], 0.0)
+
+
+def test_bench_perf_block():
+    """ISSUE 6: static per-step cost analysis (flops, HBM bytes,
+    roofline-ms) recorded at compile time rides the JSON line."""
+    doc = _bench_doc()
+    p = doc["perf"]
+    assert p["hbm_gbps"] == 800.0
+    good = [s for s in p["steps"] if not s.get("error")]
+    assert good, f"no analysable steps: {p['steps']}"
+    names = {s["name"] for s in good}
+    assert any(n.startswith(("h264.", "jpeg.")) for n in names), names
+    for s in good:
+        assert s["flops"] > 0 and s["bytes_accessed"] > 0
+        assert s["roofline_ms"] >= 0
+        assert s["compile_s"] is None or s["compile_s"] >= 0
+
+
+def test_bench_occupancy_block():
+    """ISSUE 6: overlap fraction + per-stage critical-path share. The
+    bench latency loop is frame-serial, so overlap must read ~0 and the
+    shares (+bubble) must account for the whole frame window."""
+    from selkies_tpu.trace import STAGES
+    from selkies_tpu.trace.summary import BUBBLE
+    doc = _bench_doc()
+    occ = doc["occupancy"]
+    assert occ["frames"] > 0
+    assert 0.0 <= occ["overlap_fraction"] <= 0.3
+    shares = occ["critical_path_share"]
+    assert set(shares) <= set(STAGES) | {BUBBLE}
+    assert abs(sum(shares.values()) + occ["bubble_share"] - 1.0) < 0.05
+
+
+def test_bench_ledger_autorecord():
+    """ISSUE 6: every run auto-appends to the perf ledger, and a
+    dead-relay fallback records as NOT baseline-eligible — the r4/r5
+    silent number can never become the number to beat."""
+    _bench_doc()
+    sys.path.insert(0, str(ROOT))
+    from tools import perf_ledger
+    entries = perf_ledger.read_ledger(_LEDGER)
+    assert len(entries) == 1, entries
+    e = entries[0]
+    assert e["backend"] == "cpu-fallback-relay-dead"
+    assert e["backend_class"] == "cpu"
+    assert e["backend_health"] == "failed"
+    assert e["baseline_eligible"] is False
+    assert e["resolution"] == "256x128"
+    # and check refuses to gate on it: rc 3 = "no gateable number"
+    # (0 under --warn-only), so a hard gate can't go green on it
+    assert perf_ledger.main(["--ledger", _LEDGER, "check"]) == 3
+    assert perf_ledger.main(
+        ["--ledger", _LEDGER, "check", "--warn-only"]) == 0
 
 
 def test_bench_dead_relay_reports_failed_backend_verdict():

@@ -1094,6 +1094,45 @@ async def test_trace_endpoint_carries_qoe_lane(client_factory):
     await ws.close()
 
 
+async def test_perf_endpoint_reports_steps_and_occupancy(client_factory):
+    """GET /api/perf (ISSUE 6): static step cost table + occupancy over
+    the live trace ring, JSON-round-trippable; ?profile=1 is full-role
+    gated and answers null with no capture on disk."""
+    from selkies_tpu.obs import perf as _perf
+    from selkies_tpu.trace import tracer
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    _perf.registry.record_analysis(
+        "h264.i_step[srvtest]",
+        cost=[{"flops": 1e6, "bytes accessed": 8e6}],
+        memory={"argument_size_in_bytes": 1, "output_size_in_bytes": 2,
+                "temp_size_in_bytes": 3}, backend="cpu")
+    tracer.enable(capacity=16)
+    try:
+        tl = tracer.frame_begin(":perft")
+        tracer.bind(tl, 5)
+        with tracer.span("packetize", tl):
+            await asyncio.sleep(0.002)
+        tracer.frame_end(":perft", 5)
+        r = await c.get("/api/perf")
+        assert r.status == 200
+        doc = await r.json()
+        names = [s["name"] for s in doc["perf"]["steps"]]
+        assert "h264.i_step[srvtest]" in names
+        step = doc["perf"]["steps"][names.index("h264.i_step[srvtest]")]
+        assert step["roofline_ms"] == 0.01          # 8e6 B @ 800 GB/s
+        assert doc["occupancy"]["frames"] >= 1
+        assert "packetize" in doc["occupancy"]["critical_path"]
+        assert doc["tracing"] is True
+        r = await c.get("/api/perf?profile=1")
+        assert r.status == 200
+        assert (await r.json())["profile"] is None  # no capture yet
+    finally:
+        tracer.disable()
+        tracer.clear()
+        _perf.registry.clear()
+
+
 async def test_relay_send_span_attaches_to_frame_timeline():
     """The ws.send stage lands on the frame's trace timeline by id."""
     from selkies_tpu.server.relay import VideoRelay

@@ -1,0 +1,388 @@
+#!/usr/bin/env python
+"""Perf ledger: the durable efficiency trajectory `bench.py` runs feed.
+
+Every bench run used to be a throwaway JSON line — the driver recorded
+one number per round and the trajectory between rounds (did the
+plane-layout codec help? did the readback lever regress p99?) lived
+nowhere. This tool keeps an **append-only JSONL ledger** of bench runs,
+keyed by (git rev, host fingerprint, backend class, resolution, codec,
+backend_health), and turns it into a regression gate:
+
+  record   append a bench JSON document (file or stdin) to the ledger
+  check    compare a candidate run against the last ACCEPTED baseline
+           for the same key within a noise band; exits non-zero on a
+           regression beyond the band (unless --warn-only)
+  report   render the fps / p99 / top-stage trajectory per key
+
+Baseline rules (the r4/r5 lesson — a silent CPU fallback must never
+become the number to beat):
+
+- only runs whose ``backend_health.status == "ok"`` are
+  baseline-eligible; a ``cpu-fallback-*`` run records with
+  ``baseline_eligible: false`` and can never be compared against, and a
+  non-ok-health candidate is never *compared* — it exits 3 ("no
+  gateable number", 0 under --warn-only) so a regression that also
+  breaks health cannot slip through a hard-fail gate;
+- the comparison key includes the backend CLASS (``cpu`` vs ``tpu`` …),
+  so a CPU run is never judged against a TPU baseline even when both
+  are healthy;
+- the key includes the host fingerprint (same digest the compile cache
+  uses) so a laptop run never gates a CI runner; ``--ignore-host``
+  relaxes that for fleet-style gates that accept cross-host noise.
+
+Stdlib-only (the CI lint image runs it); the host fingerprint comes
+from selkies_tpu.compile_cache, which is itself stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+from typing import Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from selkies_tpu.compile_cache import host_fingerprint  # noqa: E402
+
+#: default append-only ledger, committed so the trajectory survives
+#: across rounds/sessions (PERF.md points here)
+DEFAULT_LEDGER = os.path.join(_REPO, "PERF_LEDGER.jsonl")
+
+#: relative noise band for check: a metric may move this much against
+#: the baseline before it counts as a regression (CPU CI runners are
+#: noisy; the TPU bench is steadier but shares the band for now)
+DEFAULT_BAND = 0.15
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _git_rev() -> str:
+    try:
+        r = subprocess.run(["git", "rev-parse", "HEAD"], cwd=_REPO,
+                           capture_output=True, text=True, timeout=10)
+        if r.returncode == 0:
+            return r.stdout.strip()
+    except Exception:
+        pass
+    return "unknown"
+
+
+def backend_class(backend: str) -> str:
+    """'cpu-fallback-relay-dead' -> 'cpu'; 'tpu'/'axon'/'cuda' pass
+    through. The class — not the full label — keys baseline matching."""
+    b = (backend or "unknown").lower()
+    if b.startswith("cpu"):
+        return "cpu"
+    return b.split("-", 1)[0]
+
+
+def entry_from_bench(doc: dict, *, git_rev: Optional[str] = None,
+                     host: Optional[str] = None,
+                     accept: Optional[bool] = None) -> dict:
+    """Curate one bench JSON document into a ledger entry. Keeps the
+    trajectory fields (fps, latency percentiles, per-stage ms, perf /
+    occupancy summaries) and the key fields; drops the rest."""
+    metric = str(doc.get("metric", ""))
+    res = "unknown"
+    codec = "unknown"
+    # encode_fps_1920x1080_h264_tpu -> resolution + codec
+    parts = metric.split("_")
+    for p in parts:
+        if "x" in p and p.replace("x", "").isdigit():
+            res = p
+    if len(parts) >= 2 and parts[0] == "encode" and len(parts) >= 4:
+        codec = parts[3]
+    health = doc.get("backend_health") or {}
+    status = health.get("status", "unknown")
+    eligible = status == "ok" if accept is None else bool(accept)
+    return {
+        "v": 1,
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "git_rev": git_rev or _git_rev(),
+        "host": host or host_fingerprint(),
+        "metric": metric,
+        "backend": doc.get("backend", "unknown"),
+        "backend_class": backend_class(doc.get("backend", "unknown")),
+        "resolution": res,
+        "codec": codec,
+        "backend_health": status,
+        "baseline_eligible": eligible,
+        "fps": doc.get("value"),
+        "vs_baseline": doc.get("vs_baseline"),
+        "latency_p50_ms": doc.get("latency_p50_ms"),
+        "latency_p99_ms": doc.get("latency_p99_ms"),
+        "stages_ms": doc.get("stages_ms"),
+        "stage_sum_ms": doc.get("stage_sum_ms"),
+        "qoe_score": (doc.get("qoe") or {}).get("score"),
+        "occupancy": doc.get("occupancy"),
+        "perf_steps": {
+            s["name"]: {"roofline_ms": s["roofline_ms"],
+                        "bytes_accessed": s["bytes_accessed"],
+                        "flops": s["flops"]}
+            for s in (doc.get("perf") or {}).get("steps", [])
+            if not s.get("error")
+        } or None,
+        "hbm_peak_mb": doc.get("hbm_peak_mb"),
+        "compile_total_s": doc.get("compile_total_s"),
+    }
+
+
+def read_ledger(path: str) -> list[dict]:
+    entries: list[dict] = []
+    if not os.path.exists(path):
+        return entries
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except json.JSONDecodeError:
+                log(f"warning: {path}:{i + 1}: unparseable line skipped")
+                continue
+            if isinstance(e, dict):
+                entries.append(e)
+    return entries
+
+
+def append_entry(path: str, entry: dict) -> None:
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+
+
+def entry_key(e: dict, ignore_host: bool = False) -> tuple:
+    key = (e.get("backend_class", "unknown"), e.get("resolution"),
+           e.get("codec"))
+    if not ignore_host:
+        key = (e.get("host"),) + key
+    return key
+
+
+def _same_run(a: dict, b: dict) -> bool:
+    """Heuristic identity for 'this ledger entry IS the candidate run':
+    bench auto-appends every run, so `check --candidate out.json` would
+    otherwise match the candidate against its own ledger copy (same
+    rev, same numbers) and always pass."""
+    return (a.get("git_rev") == b.get("git_rev")
+            and a.get("fps") == b.get("fps")
+            and a.get("latency_p99_ms") == b.get("latency_p99_ms"))
+
+
+def find_baseline(entries: list[dict], candidate: dict,
+                  ignore_host: bool = False) -> Optional[dict]:
+    """Most recent baseline-eligible entry with the candidate's key.
+    The class key is what guarantees a cpu-fallback candidate (class
+    ``cpu``) can never be measured against a TPU baseline."""
+    want = entry_key(candidate, ignore_host)
+    for e in reversed(entries):
+        if e is candidate or _same_run(e, candidate):
+            continue
+        if not e.get("baseline_eligible"):
+            continue
+        if not str(e.get("metric", "")).startswith("encode_fps"):
+            continue
+        if entry_key(e, ignore_host) == want:
+            return e
+    return None
+
+
+def compare(candidate: dict, baseline: dict,
+            band: float = DEFAULT_BAND) -> list[str]:
+    """-> list of regression descriptions beyond the noise band (empty
+    = within band). fps gates downward moves, p99 upward ones."""
+    # epsilon keeps the band edge out of float-rounding territory: a
+    # move of EXACTLY band is tolerated, band+delta is not
+    eps = 1e-9
+    problems: list[str] = []
+    fps_new, fps_old = candidate.get("fps"), baseline.get("fps")
+    if isinstance(fps_new, (int, float)) and isinstance(
+            fps_old, (int, float)) and fps_old > 0:
+        if 1.0 - fps_new / fps_old > band + eps:
+            problems.append(
+                f"fps {fps_new} vs baseline {fps_old} "
+                f"({fps_new / fps_old - 1.0:+.1%}, band ±{band:.0%})")
+    p99_new = candidate.get("latency_p99_ms")
+    p99_old = baseline.get("latency_p99_ms")
+    if isinstance(p99_new, (int, float)) and isinstance(
+            p99_old, (int, float)) and p99_old > 0:
+        if p99_new / p99_old - 1.0 > band + eps:
+            problems.append(
+                f"latency_p99 {p99_new}ms vs baseline {p99_old}ms "
+                f"({p99_new / p99_old - 1.0:+.1%}, band ±{band:.0%})")
+    return problems
+
+
+def _load_candidate(args: argparse.Namespace,
+                    entries: list[dict]) -> Optional[dict]:
+    """The run under test: an explicit bench JSON (``--candidate``,
+    '-' = stdin) or the newest encode_fps entry already in the ledger."""
+    if args.candidate:
+        raw = sys.stdin.read() if args.candidate == "-" else \
+            open(args.candidate, encoding="utf-8").read()
+        doc = json.loads(raw)
+        if "baseline_eligible" in doc:     # already a ledger entry
+            return doc
+        return entry_from_bench(doc)
+    for e in reversed(entries):
+        if str(e.get("metric", "")).startswith("encode_fps"):
+            return e
+    return None
+
+
+def cmd_record(args: argparse.Namespace) -> int:
+    raw = sys.stdin.read() if args.file == "-" else \
+        open(args.file, encoding="utf-8").read()
+    doc = json.loads(raw)
+    accept = True if args.accept else (False if args.reject else None)
+    entry = entry_from_bench(doc, accept=accept)
+    append_entry(args.ledger, entry)
+    log(f"recorded {entry['metric']} fps={entry['fps']} "
+        f"backend={entry['backend']} eligible={entry['baseline_eligible']} "
+        f"-> {args.ledger}")
+    print(json.dumps(entry, sort_keys=True))
+    return 0
+
+
+def cmd_check(args: argparse.Namespace) -> int:
+    entries = read_ledger(args.ledger)
+    candidate = _load_candidate(args, entries)
+    if candidate is None:
+        log("check: no candidate run (empty ledger, no --candidate)")
+        return 0 if args.warn_only else 2
+    status = candidate.get("backend_health")
+    if status not in ("ok", "degraded", "failed"):
+        # schema drift or the wrong file: a gate that silently stops
+        # gating is the r4/r5 failure all over again — fail loudly
+        log(f"check: candidate has no recognisable backend_health "
+            f"({status!r}) — malformed candidate?")
+        return 0 if args.warn_only else 2
+    if status != "ok":
+        # never *compare* an unhealthy number — but never let it slide
+        # through a hard-fail gate either: a regression that also tips
+        # health to degraded/failed must not read as green. Distinct rc
+        # so CI can tell "no gateable number" from "within band".
+        log(f"check: candidate backend_health={status!r} "
+            f"(backend {candidate.get('backend')!r}) — not a gating "
+            f"number, skipping comparison")
+        return 0 if args.warn_only else 3
+    baseline = find_baseline(entries, candidate,
+                             ignore_host=args.ignore_host)
+    if baseline is None:
+        log(f"check: no accepted baseline for key "
+            f"{entry_key(candidate, args.ignore_host)} — nothing to "
+            f"compare (this run becomes the baseline once recorded)")
+        return 0
+    problems = compare(candidate, baseline, band=args.band)
+    log(f"check: candidate {candidate.get('git_rev', '?')[:7]} "
+        f"fps={candidate.get('fps')} p99={candidate.get('latency_p99_ms')} "
+        f"vs baseline {baseline.get('git_rev', '?')[:7]} "
+        f"fps={baseline.get('fps')} p99={baseline.get('latency_p99_ms')}")
+    if not problems:
+        log("check: within noise band — OK")
+        return 0
+    for p in problems:
+        log(f"REGRESSION: {p}")
+    if args.warn_only:
+        log("check: --warn-only set; not failing")
+        return 0
+    return 1
+
+
+def _top_stage(e: dict) -> str:
+    stages = e.get("stages_ms") or {}
+    if not stages:
+        return "-"
+    name, ms = max(stages.items(), key=lambda kv: kv[1] or 0.0)
+    return f"{name}={ms}ms"
+
+
+def cmd_report(args: argparse.Namespace) -> int:
+    entries = [e for e in read_ledger(args.ledger)
+               if str(e.get("metric", "")).startswith("encode_fps")]
+    if not entries:
+        log("report: ledger is empty")
+        return 0
+    by_key: dict[tuple, list[dict]] = {}
+    for e in entries:
+        by_key.setdefault(entry_key(e, args.ignore_host), []).append(e)
+    out_doc: dict = {"keys": []}
+    for key, runs in sorted(by_key.items(), key=lambda kv: str(kv[0])):
+        print(f"== {' / '.join(str(k) for k in key)} ({len(runs)} runs)")
+        print(f"   {'date':<20} {'rev':<8} {'backend':<24} {'fps':>7} "
+              f"{'p50_ms':>9} {'p99_ms':>9} {'ok':>3}  top stage")
+        for e in runs:
+            print(f"   {str(e.get('ts', ''))[:19]:<20} "
+                  f"{str(e.get('git_rev', ''))[:7]:<8} "
+                  f"{str(e.get('backend', ''))[:24]:<24} "
+                  f"{e.get('fps') if e.get('fps') is not None else '-':>7} "
+                  f"{e.get('latency_p50_ms') or '-':>9} "
+                  f"{e.get('latency_p99_ms') or '-':>9} "
+                  f"{'y' if e.get('baseline_eligible') else 'n':>3}  "
+                  f"{_top_stage(e)}")
+        out_doc["keys"].append({
+            "key": list(key),
+            "runs": [{k: e.get(k) for k in
+                      ("ts", "git_rev", "backend", "fps",
+                       "latency_p50_ms", "latency_p99_ms",
+                       "baseline_eligible", "stages_ms")}
+                     for e in runs]})
+    if args.json:
+        print(json.dumps(out_doc, sort_keys=True))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools/perf_ledger.py",
+        description=__doc__.splitlines()[0])
+    p.add_argument("--ledger", default=os.environ.get(
+        "PERF_LEDGER_PATH", DEFAULT_LEDGER),
+        help=f"JSONL ledger path (default {DEFAULT_LEDGER}, "
+             f"env PERF_LEDGER_PATH)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pr = sub.add_parser("record", help="append a bench JSON to the ledger")
+    pr.add_argument("file", nargs="?", default="-",
+                    help="bench JSON file ('-' or omitted: stdin)")
+    pr.add_argument("--accept", action="store_true",
+                    help="force baseline eligibility")
+    pr.add_argument("--reject", action="store_true",
+                    help="force ineligibility")
+    pr.set_defaults(fn=cmd_record)
+
+    pc = sub.add_parser("check",
+                        help="gate a candidate against the last baseline")
+    pc.add_argument("--candidate",
+                    help="bench JSON or ledger-entry file ('-': stdin); "
+                         "default: newest ledger entry")
+    pc.add_argument("--band", type=float, default=DEFAULT_BAND,
+                    help=f"relative noise band (default {DEFAULT_BAND})")
+    pc.add_argument("--warn-only", action="store_true",
+                    help="report regressions but exit 0 (CI ratchet "
+                         "stage 1, like graftlint's baseline)")
+    pc.add_argument("--ignore-host", action="store_true",
+                    help="match baselines across host fingerprints")
+    pc.set_defaults(fn=cmd_check)
+
+    pp = sub.add_parser("report", help="render the perf trajectory")
+    pp.add_argument("--json", action="store_true",
+                    help="machine-readable output after the table")
+    pp.add_argument("--ignore-host", action="store_true",
+                    help="group across host fingerprints")
+    pp.set_defaults(fn=cmd_report)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,8 +14,18 @@ restating them (so the profile can't drift from the code):
 
 Then the engine session steps exactly as the capture thread drives them.
 Uses the persistent compile cache (first run pays the builds once).
+
+Crash-resilient output (ISSUE 6 — the r3 profile died mid-run and lost
+everything after "+ DC lax.scan"): results are written to ``--out``
+(default: PROFILE_H264.json in the repo root) INCREMENTALLY after
+every stage, so a relay death keeps every completed measurement with
+``"complete": false`` recording how far it got. ``--json`` prints the
+same document as one machine-readable line on stdout at the end
+(progress moves to stderr).
 """
 
+import argparse
+import json
 import os
 import sys
 import time
@@ -31,6 +41,44 @@ from selkies_tpu.compile_cache import enable as enable_compile_cache
 
 enable_compile_cache(jax)
 
+ARGS = argparse.Namespace(json=False, out=None)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr if ARGS.json else sys.stdout, flush=True)
+
+
+class ProfileWriter:
+    """Incremental stage-result sink. ``add()`` after every measurement
+    rewrites the whole (small) JSON document atomically, so the file on
+    disk is always valid and always carries every completed stage —
+    the property the r3 run lacked when the relay died mid-profile."""
+
+    def __init__(self, path, meta=None):
+        self.path = path
+        self.doc = {"version": 1, "complete": False,
+                    "stages": {}, **(meta or {})}
+
+    def add(self, stage: str, ms: float, **extra) -> None:
+        self.doc["stages"][stage] = {"ms": round(ms, 3), **extra}
+        self._flush()
+
+    def meta(self, **fields) -> None:
+        self.doc.update(fields)
+        self._flush()
+
+    def finish(self) -> None:
+        self.doc["complete"] = True
+        self._flush()
+
+    def _flush(self) -> None:
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, self.path)
+
 
 def t(fn, *args, n=3, warm=1):
     for _ in range(warm):
@@ -42,6 +90,17 @@ def t(fn, *args, n=3, warm=1):
 
 
 def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable stdout (one JSON line at the "
+                        "end; progress goes to stderr)")
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir,
+        "PROFILE_H264.json"),
+        help="incremental result file (written after EVERY stage; "
+             "'' disables)")
+    p.parse_args(namespace=ARGS)
+
     from selkies_tpu.codecs import h264 as hc
     from selkies_tpu.engine.h264_encoder import (H264EncoderSession,
                                                  h264_buffer_caps,
@@ -50,7 +109,12 @@ def main():
     from selkies_tpu.ops import h264_encode as He
     from selkies_tpu.ops import h264_planes as Hp
 
-    print("backend:", jax.default_backend(), flush=True)
+    out_path = os.path.abspath(ARGS.out) if ARGS.out else None
+    backend = jax.default_backend()
+    w = ProfileWriter(out_path, meta={"backend": backend})
+    log(f"backend: {backend}")
+    if out_path:
+        log(f"incremental results -> {out_path}")
     s = CaptureSettings(capture_width=1920, capture_height=1080,
                         stripe_height=64, output_mode="h264", video_crf=28,
                         use_paint_over=False)
@@ -59,22 +123,26 @@ def main():
     R = g.n_stripes * g.rows_per_stripe
     M = g.mb_w
     H, W = g.height, g.width
-    print(f"grid {W}x{H} R={R} M={M} e_cap={e_cap} w_cap={w_cap}",
-          flush=True)
+    w.meta(grid=f"{W}x{H}", R=R, M=M, e_cap=e_cap, w_cap=w_cap)
+    log(f"grid {W}x{H} R={R} M={M} e_cap={e_cap} w_cap={w_cap}")
 
     rng = np.random.default_rng(0)
     frame = jnp.asarray(rng.integers(0, 256, (H, W, 3), dtype=np.uint8))
 
     # --- stages (cheap compiles first so a killed run still reports)
     f_csc = jax.jit(Hp.rgb_to_yuv420)
-    print(f"csc:        {t(f_csc, frame)*1e3:8.2f} ms", flush=True)
+    ms = t(f_csc, frame) * 1e3
+    w.add("csc", ms)
+    log(f"csc:        {ms:8.2f} ms")
     yf, uf, vf = [jnp.asarray(a) for a in f_csc(frame)]
 
     f_fwd = jax.jit(lambda y, u, v: sum(
         p for comp in (Hp.fwd4_planes(y), Hp.fwd4_planes(u),
                        Hp.fwd4_planes(v))
         for row in comp for p in row))
-    print(f"fwd4 x3:    {t(f_fwd, yf, uf, vf)*1e3:8.2f} ms", flush=True)
+    ms = t(f_fwd, yf, uf, vf) * 1e3
+    w.add("fwd4_x3", ms)
+    log(f"fwd4 x3:    {ms:8.2f} ms")
 
     # realistic sparsity: ~6 nonzero AC coeffs per 4x4 block at desktop QPs
     def mk_levels(shape):
@@ -84,12 +152,15 @@ def main():
     scan_y = mk_levels((H // 4, W // 4))
     nc = jnp.zeros((H // 4, W // 4), jnp.int32)
     f_cavlc = jax.jit(lambda sc, n: Hp.cavlc_events_planes(sc, n)[0])
-    print(f"cavlc y:    {t(f_cavlc, scan_y, nc)*1e3:8.2f} ms", flush=True)
+    ms = t(f_cavlc, scan_y, nc) * 1e3
+    w.add("cavlc_y", ms)
+    log(f"cavlc y:    {ms:8.2f} ms")
     scan_c = mk_levels((H // 8, W // 8))
     nc_c = jnp.zeros((H // 8, W // 8), jnp.int32)
     f_cavlc_c = jax.jit(lambda sc, n: Hp.cavlc_events_planes(sc, n)[0])
-    print(f"cavlc cac:  {t(f_cavlc_c, scan_c, nc_c)*1e3:8.2f} ms",
-          flush=True)
+    ms = t(f_cavlc_c, scan_c, nc_c) * 1e3
+    w.add("cavlc_cac", ms)
+    log(f"cavlc cac:  {ms:8.2f} ms")
 
     # --- full frame programs (the things that matter)
     pay, nb = hc.slice_header_events(M, R)
@@ -97,7 +168,8 @@ def main():
         y, u, v, 28, jnp.asarray(pay), jnp.asarray(nb), e_cap,
         w_cap).words)
     ti = t(f_i, yf, uf, vf)
-    print(f"full I:     {ti*1e3:8.2f} ms", flush=True)
+    w.add("full_i", ti * 1e3)
+    log(f"full I:     {ti * 1e3:8.2f} ms")
 
     ppay, pnb = hc.p_slice_header_events(M, R)
     cands = He.scroll_candidates(24, 8)
@@ -109,22 +181,31 @@ def main():
         e_cap, w_cap, candidates=cands,
         stripe_rows=g.rows_per_stripe)[0].words)
     tp = t(f_p, yf, uf, vf)
-    print(f"full P:     {tp*1e3:8.2f} ms  (motion K={len(cands)})",
-          flush=True)
+    w.add("full_p", tp * 1e3, motion_k=len(cands))
+    log(f"full P:     {tp * 1e3:8.2f} ms  (motion K={len(cands)})")
     f_p0 = jax.jit(lambda y, u, v: Hp.h264_encode_p_yuv(
         y, u, v, ry, ru, rv, 28, jnp.asarray(ppay), jnp.asarray(pnb), 1,
         e_cap, w_cap, candidates=((0, 0),),
         stripe_rows=g.rows_per_stripe)[0].words)
-    print(f"full P K=1: {t(f_p0, yf, uf, vf)*1e3:8.2f} ms "
-          f"(motion cost = delta)", flush=True)
+    ms = t(f_p0, yf, uf, vf) * 1e3
+    w.add("full_p_k1", ms)
+    log(f"full P K=1: {ms:8.2f} ms (motion cost = delta)")
 
-    # --- full session steps as the engine drives them
+    # --- full session steps as the engine drives them (the obs.perf
+    # wrap records the static cost analysis as a side effect; include
+    # it so the saved profile carries roofline context)
     sess = H264EncoderSession(s)
     t_full = t(lambda f: sess.encode(f, force=True)["data"], frame, n=2)
-    print(f"session I step (dispatch+block): {t_full*1e3:.0f} ms",
-          flush=True)
+    w.add("session_i", t_full * 1e3)
+    log(f"session I step (dispatch+block): {t_full * 1e3:.0f} ms")
     t_p = t(lambda f: sess.encode(f)["data"], frame, n=2)
-    print(f"session P step (dispatch+block): {t_p*1e3:.0f} ms", flush=True)
+    w.add("session_p", t_p * 1e3)
+    log(f"session P step (dispatch+block): {t_p * 1e3:.0f} ms")
+    from selkies_tpu.obs import perf as _perf
+    w.meta(perf=_perf.registry.report())
+    w.finish()
+    if ARGS.json:
+        print(json.dumps(w.doc, sort_keys=True))
 
 
 if __name__ == "__main__":
