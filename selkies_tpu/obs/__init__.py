@@ -28,6 +28,12 @@ that class of failure self-diagnosing:
   backpressure windows, relay/congestion-controller counters, the
   composite QoE score behind ``GET /api/sessions``, the ``qoe`` health
   check and the bounded-cardinality Prometheus export;
+- :mod:`.clocksync` — NTP-style client↔server clock mapping (min-RTT
+  filtered, drift-aware, step-detecting) so client frame timestamps land
+  on the server timebase with a quantified error bound;
+- :mod:`.slo` — declarative SLOs over g2g / fps / QoE event streams
+  with error budgets, multi-window burn rates, ``GET /api/slo``, the
+  ``slo`` health check and ``slo_burn`` incidents;
 - :mod:`.logctx` — contextvars session/seat log correlation and the
   ``--log_format=json`` structured formatter;
 - :mod:`.__main__` — ``python -m selkies_tpu.obs selftest``: the CI
@@ -37,6 +43,7 @@ Everything imports without jax/aiohttp; device and metrics touch points
 are lazy and guarded (the same contract :mod:`..trace` keeps).
 """
 
+from .clocksync import ClockSyncEstimator  # noqa: F401
 from .device_monitor import DeviceMonitor, monitor  # noqa: F401
 from .health import (DEGRADED, FAILED, OK, FlightRecorder,  # noqa: F401
                      HealthEngine, Verdict, degraded, engine, failed, ok)
@@ -47,3 +54,5 @@ from .profiler import ProfilerSession, profiler  # noqa: F401
 from .qoe import (AckRttEstimator, QoERegistry,  # noqa: F401
                   SessionStats, qoe_score)
 from .qoe import registry as qoe_registry  # noqa: F401
+from .slo import Slo, SloEngine  # noqa: F401
+from .slo import engine as slo_engine  # noqa: F401

@@ -1,11 +1,14 @@
 """Offline obs CLI.
 
 ``python -m selkies_tpu.obs selftest`` — drive the real health engine,
-flight recorder, device monitor, QoE registry, and perf plane (cost
+flight recorder, device monitor, QoE registry, perf plane (cost
 registry, roofline math, profiler-capture parser, critical-path
-attribution) with synthetic inputs and verify the full verdict pipeline
-round-trips (the CI lint smoke, mirroring ``python -m selkies_tpu.trace
-selftest``). Exits non-zero on any contract break.
+attribution), clock-sync estimator (injected drift/step timelines) and
+SLO burn-rate engine (multi-window verdicts, edge-triggered incidents,
+recovery — injected clocks, zero sleeps) with synthetic inputs and
+verify the full verdict pipeline round-trips (the CI lint smoke,
+mirroring ``python -m selkies_tpu.trace selftest``). Exits non-zero on
+any contract break.
 
 ``python -m selkies_tpu.obs health`` — evaluate the process-wide engine
 and print the verbose report as JSON (mostly useful under a debugger or
@@ -217,8 +220,86 @@ def _cmd_selftest(args: argparse.Namespace) -> int:
     if abs(cp["overlap_fraction"] - 0.4) > 1e-9 or cp["bubble_ms"] != 0.0:
         return _fail(f"overlap/bubble math broken: {cp}")
 
+    # clock sync (ISSUE 7): the NTP-style estimator under injected
+    # clocks — constant offset + 50 ppm drift + symmetric 4 ms wire.
+    # client_of(s) = (s - base) * (1 + drift) + C, so the fit's slope
+    # (offset per client ms) must read ≈ -drift.
+    from .clocksync import ClockSyncEstimator
+    cs = ClockSyncEstimator()
+    drift = 50e-6
+
+    def client_of(s: float) -> float:
+        return (s - 1000.0) * (1.0 + drift) + 5000.0
+
+    for i in range(20):
+        s = 1000.0 + i * 500.0             # a ping every 500 ms
+        cs.add_sample(client_of(s), s + 2.0, s + 2.1,
+                      client_of(s + 4.1))
+    if not cs.synced or cs.drift_ppm is None:
+        return _fail("estimator must sync on clean samples")
+    if abs(cs.drift_ppm + 50.0) > 10.0:
+        return _fail(f"50ppm injected drift misread: {cs.drift_ppm}")
+    s_probe = 1000.0 + 21 * 500.0          # extrapolate past the window
+    mapped = cs.to_server_ms(client_of(s_probe))
+    if mapped is None or abs(mapped - s_probe) > 2.0 + cs.error_bound_ms():
+        return _fail(f"mapping error too large: {mapped} vs {s_probe}")
+    if cs.add_sample(10.0, 0.0, 0.0, 5.0) is not None:
+        return _fail("negative-RTT sample must be rejected")
+    n_before = cs.steps
+    s_step = 1000.0 + 22 * 500.0           # suspend/resume: clock jumps
+    cs.add_sample(client_of(s_step) + 10_000.0, s_step + 2.0,
+                  s_step + 2.1, client_of(s_step + 4.1) + 10_000.0)
+    if cs.steps != n_before + 1:
+        return _fail(f"10s clock step must reset the window: {cs.steps}")
+    json.loads(json.dumps(cs.quality()))   # export must round-trip
+
+    # SLO burn-rate engine (ISSUE 7): multi-window verdicts, edge-
+    # triggered slo_burn incidents, recovery — all on injected clocks.
+    from .slo import Slo, SloEngine
+    slo_eng = SloEngine()
+    slo_eng.recorder = eng.recorder
+    slo = slo_eng.register(Slo("g2g", "selftest objective",
+                               objective=0.99, burn_threshold=10.0))
+    now0 = 50_000.0
+    for i in range(100):
+        slo.record(True, now=now0 + i)
+    rep = slo_eng.report(now=now0 + 100)
+    if rep["status"] != OK:
+        return _fail(f"clean slo must verdict ok: {rep}")
+    for i in range(60):                    # 37% bad = burn 37x > 10x
+        slo.record(False, now=now0 + 100 + i)
+    rep = slo_eng.report(now=now0 + 160)
+    if rep["status"] != FAILED:
+        return _fail(f"double-window burn must fail: {rep}")
+    if slo.budget_remaining(now=now0 + 160) != 0.0:
+        return _fail("37% bad vs 1% budget must exhaust the budget")
+
+    def _burns():
+        return sum(e["kind"] == "slo_burn"
+                   for e in eng.recorder.snapshot())
+
+    n_burn = _burns()
+    if not n_burn:
+        return _fail("slo burn must hit the flight recorder")
+    slo_eng.report(now=now0 + 161)
+    if _burns() != n_burn:
+        return _fail("slo_burn must be edge-triggered, not per-report")
+    rep = slo_eng.report(now=now0 + 8000.0)   # both windows drained
+    if rep["status"] != OK:
+        return _fail(f"slo must recover once the windows drain: {rep}")
+    slo.record(False, n=60, now=now0 + 8000.0)
+    slo.record(True, n=40, now=now0 + 8000.0)
+    if slo_eng.report(now=now0 + 8001.0)["status"] != FAILED \
+            or _burns() != n_burn + 1:
+        return _fail("a fresh excursion must re-arm the slo_burn edge")
+    if slo_eng.record("nonexistent", True):
+        return _fail("events against undeclared objectives must drop")
+    json.loads(json.dumps(slo_eng.report(now=now0 + 8002.0)))
+
     doc = {"health": eng.report(verbose=True), "monitor": mon.snapshot(),
-           "qoe": doc0, "perf": prep, "device_time": table}
+           "qoe": doc0, "perf": prep, "device_time": table,
+           "clock": cs.quality(),
+           "slo": slo_eng.report(now=now0 + 8002.0)}
     text = json.dumps(doc)
     json.loads(text)                       # the payload must round-trip
     print(text if args.json else "selftest OK "

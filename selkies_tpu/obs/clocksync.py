@@ -1,0 +1,212 @@
+"""Client↔server clock synchronisation — the glass-to-glass enabler.
+
+Every latency number before this module ended at ``ws.send`` (trace
+spans, PR 2) or the ACK-RTT proxy (QoE, PR 4): network transit, client
+decode and presentation were invisible because client timestamps
+(``performance.now()``) live on a clock the server cannot read. This
+module maps them onto the server monotonic timebase with a *quantified*
+error bound, so a client-reported "presented at C ms" becomes a server
+"presented at S ms" a glass-to-glass percentile can be built from.
+
+The exchange is NTP's four-timestamp dance over the text protocol:
+
+- client sends ``CLIENT_CLOCK ping,<seq>,<t0>`` (t0 = client clock, ms);
+- server replies ``server_clock <seq>,<t0>,<t1>,<t2>`` (t1 = receive,
+  t2 = transmit, both server monotonic ms);
+- client echoes ``CLIENT_CLOCK sample,<seq>,<t0>,<t1>,<t2>,<t3>``
+  (t3 = client receive) — the server, not the browser, owns estimation.
+
+Per sample::
+
+    offset = ((t1 - t0) + (t2 - t3)) / 2     # server − client, ms
+    rtt    = (t3 - t0) - (t2 - t1)           # wire round-trip, ms
+
+The classic error model: a sample's offset is wrong by at most
+``rtt / 2`` (asymmetric paths). So the estimator is **min-RTT
+filtered** — only samples whose RTT sits within a band of the observed
+minimum vote — and **drift-aware**: browser and server monotonic clocks
+tick at slightly different rates (crystal tolerance is ±50 ppm; a
+50 ppm drift is 3 ms of skew per minute, which would dwarf a 16 ms
+glass-to-glass budget within seconds of a stale offset), so the filtered
+samples feed a least-squares linear fit ``offset(t) = a + b·t`` whose
+slope is the drift and whose extrapolation keeps the mapping fresh
+between pings. A sample that lands far off the fit *with a credible
+(near-min) RTT* is a clock step — suspend/resume, NTP slew on the
+server — and resets the window rather than polluting the fit.
+
+Stdlib-only and clock-injected throughout (``now`` is always a caller
+argument), the same contract the rest of :mod:`selkies_tpu.obs` keeps.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+__all__ = ["ClockSyncEstimator"]
+
+#: a sample votes only when its RTT is within this band of the window
+#: minimum: ``rtt <= rtt_min + max(RTT_BAND_MS, rtt_min * RTT_BAND_FRAC)``
+RTT_BAND_MS = 2.0
+RTT_BAND_FRAC = 0.5
+
+#: offset residual (vs the current fit) beyond which a near-min-RTT
+#: sample is treated as a clock STEP and the window resets
+DEFAULT_STEP_MS = 100.0
+
+#: fit slope is distrusted until this many filtered samples agree
+MIN_FIT_SAMPLES = 3
+
+#: ...and until the filtered window spans this much client time: real
+#: crystal skew is tens of ppm, so any slope inferred from a sub-second
+#: burst of pings (connection open) is measurement jitter amplified by
+#: a short lever arm, not drift — extrapolating it would inject ms-level
+#: errors into every mapped timestamp. Below the span the estimator runs
+#: slope-0 from the best (min-RTT) sample.
+MIN_FIT_SPAN_MS = 1000.0
+
+
+class ClockSyncEstimator:
+    """Maps one client's ``performance.now()`` timebase onto server
+    monotonic milliseconds. One instance per session, fed by the
+    transport; read by the glass-to-glass plumbing.
+
+    All timestamps are milliseconds: t0/t3 on the client clock, t1/t2 on
+    the server clock (``time.monotonic() * 1e3`` at the call sites).
+    """
+
+    def __init__(self, window: int = 64, step_ms: float = DEFAULT_STEP_MS):
+        #: (t_client, offset_ms, rtt_ms) per accepted sample, send-ordered
+        self._samples: collections.deque = collections.deque(maxlen=window)
+        self.step_ms = float(step_ms)
+        self.samples_total = 0
+        self.rejected = 0
+        self.steps = 0
+        # fit cache: recomputed on every accepted sample (the window is
+        # tiny; a 64-point least squares is microseconds)
+        self._fit: Optional[tuple[float, float, float, float]] = None
+        # (intercept_ms, slope, t_ref_ms, residual_rms_ms)
+
+    # -- ingest --------------------------------------------------------------
+    def add_sample(self, t0: float, t1: float, t2: float,
+                   t3: float) -> Optional[dict]:
+        """Feed one 4-timestamp exchange. Returns the derived sample
+        (``offset_ms``/``rtt_ms``/``step``) or None when rejected
+        (negative RTT = reordered/forged timestamps)."""
+        t0, t1, t2, t3 = float(t0), float(t1), float(t2), float(t3)
+        rtt = (t3 - t0) - (t2 - t1)
+        if rtt < 0.0 or (t3 - t0) < 0.0:
+            self.rejected += 1
+            return None
+        offset = ((t1 - t0) + (t2 - t3)) / 2.0
+        step = False
+        if self._fit is not None and self._credible_rtt(rtt):
+            predicted = self.offset_at(t3)
+            if predicted is not None \
+                    and abs(offset - predicted) > self.step_ms:
+                # a believable sample violently off the fit: the clock
+                # itself moved (suspend/resume). History is now lies.
+                self._samples.clear()
+                self._fit = None
+                self.steps += 1
+                step = True
+        self._samples.append((t3, offset, rtt))
+        self.samples_total += 1
+        self._refit()
+        return {"offset_ms": offset, "rtt_ms": rtt, "step": step}
+
+    def _credible_rtt(self, rtt: float) -> bool:
+        rtt_min = self.rtt_min_ms
+        if rtt_min is None:
+            return True
+        return rtt <= rtt_min + max(RTT_BAND_MS, rtt_min * RTT_BAND_FRAC)
+
+    def _refit(self) -> None:
+        """Least squares over the min-RTT-filtered window. Falls back to
+        the single best sample (slope 0) below MIN_FIT_SAMPLES."""
+        if not self._samples:
+            self._fit = None
+            return
+        rtt_min = min(s[2] for s in self._samples)
+        band = rtt_min + max(RTT_BAND_MS, rtt_min * RTT_BAND_FRAC)
+        pts = [(t, off) for t, off, rtt in self._samples if rtt <= band]
+        t_ref = pts[-1][0]
+        if len(pts) < MIN_FIT_SAMPLES \
+                or pts[-1][0] - pts[0][0] < MIN_FIT_SPAN_MS:
+            best = min((s for s in self._samples), key=lambda s: s[2])
+            self._fit = (best[1], 0.0, best[0], 0.0)
+            return
+        n = float(len(pts))
+        xs = [t - t_ref for t, _ in pts]
+        ys = [off for _, off in pts]
+        mx = sum(xs) / n
+        my = sum(ys) / n
+        sxx = sum((x - mx) ** 2 for x in xs)
+        if sxx <= 0.0:
+            self._fit = (my, 0.0, t_ref, 0.0)
+            return
+        slope = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / sxx
+        intercept = my - slope * mx
+        resid = [y - (intercept + slope * x) for x, y in zip(xs, ys)]
+        rms = (sum(r * r for r in resid) / n) ** 0.5
+        self._fit = (intercept, slope, t_ref, rms)
+
+    # -- read ----------------------------------------------------------------
+    @property
+    def synced(self) -> bool:
+        return self._fit is not None
+
+    @property
+    def rtt_min_ms(self) -> Optional[float]:
+        if not self._samples:
+            return None
+        return min(s[2] for s in self._samples)
+
+    @property
+    def drift_ppm(self) -> Optional[float]:
+        """Client-vs-server rate skew in parts per million (slope of the
+        offset fit: ms of extra offset per ms of client time)."""
+        if self._fit is None:
+            return None
+        return self._fit[1] * 1e6
+
+    def offset_at(self, t_client_ms: float) -> Optional[float]:
+        """Predicted ``server − client`` offset at a client timestamp."""
+        if self._fit is None:
+            return None
+        intercept, slope, t_ref, _ = self._fit
+        return intercept + slope * (float(t_client_ms) - t_ref)
+
+    def to_server_ms(self, t_client_ms: float) -> Optional[float]:
+        off = self.offset_at(t_client_ms)
+        if off is None:
+            return None
+        return float(t_client_ms) + off
+
+    def error_bound_ms(self) -> Optional[float]:
+        """Honest mapping uncertainty: half the best observed RTT (path
+        asymmetry can hide that much) plus the fit's residual RMS
+        (jitter the filter let through)."""
+        if self._fit is None:
+            return None
+        rtt_min = self.rtt_min_ms or 0.0
+        return rtt_min / 2.0 + self._fit[3]
+
+    def quality(self) -> dict:
+        """The export block (``/api/sessions`` verbose, bench JSON)."""
+        off = self.offset_at(self._samples[-1][0]) if self._samples else None
+        return {
+            "synced": self.synced,
+            "samples": len(self._samples),
+            "samples_total": self.samples_total,
+            "rejected": self.rejected,
+            "steps": self.steps,
+            "offset_ms": round(off, 3) if off is not None else None,
+            "drift_ppm": (round(self.drift_ppm, 1)
+                          if self.drift_ppm is not None else None),
+            "rtt_min_ms": (round(self.rtt_min_ms, 3)
+                           if self.rtt_min_ms is not None else None),
+            "error_bound_ms": (round(self.error_bound_ms(), 3)
+                               if self.error_bound_ms() is not None
+                               else None),
+        }

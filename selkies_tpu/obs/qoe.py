@@ -22,6 +22,17 @@ that says WHICH seat is suffering and WHY. This module is that plane:
   recorder) and the ``qoe`` trace lane (backpressure windows overlaid
   on ``/api/trace``).
 
+Glass-to-glass (ISSUE 7): each session carries a
+:class:`~.clocksync.ClockSyncEstimator` fed by the ``CLIENT_CLOCK``
+exchange, so ``CLIENT_FRAME_TIMING`` reports (client receive / decode /
+present timestamps) map onto the server ``perf_counter`` timebase —
+:meth:`SessionStats.note_frame_timing` turns them into per-session
+``g2g`` percentiles, the ``selkies_session_g2g_ms`` histogram (0.5 ms–
+5 s ladder), and the mapped span boundaries the transport joins onto
+``/api/trace`` as a ``client`` lane. ``CLIENT_STATS`` (decoder queue
+depth, dropped decodes) lands in the verbose session snapshot as the
+client-side overload signal.
+
 **QoE score** (documented contract, also used by ``bench.py``)::
 
     score     = 100 × fps_term × rtt_term × (1 − drop_rate)
@@ -51,6 +62,7 @@ import time
 from typing import Callable, Optional
 
 from . import health as _health
+from .clocksync import ClockSyncEstimator
 
 __all__ = ["AckRttEstimator", "SessionStats", "QoERegistry", "qoe_score",
            "registry", "DEGRADED_SCORE", "FAILED_SCORE"]
@@ -66,6 +78,19 @@ _RTT_HALF_MS = 250.0
 #: per-session Prometheus series cap (``qoe_seat_label_cap`` setting);
 #: sessions beyond it roll up into the ``seat="_overflow"`` aggregate
 DEFAULT_SEAT_LABEL_CAP = 8
+
+
+def _percentiles(samples) -> dict:
+    """Nearest-rank p50/p99 over a sample window (ACK-RTT and g2g share
+    this so the two exports can never diverge)."""
+    vals = sorted(samples)
+    if not vals:
+        return {"n": 0, "p50_ms": None, "p99_ms": None}
+
+    def _pct(q: float) -> float:
+        return round(vals[min(len(vals) - 1, int(len(vals) * q))], 3)
+
+    return {"n": len(vals), "p50_ms": _pct(0.50), "p99_ms": _pct(0.99)}
 
 
 def qoe_score(client_fps: Optional[float], target_fps: float,
@@ -142,14 +167,7 @@ class AckRttEstimator:
         return max(self.ewma_ms or 0.0, self.oldest_pending_ms(now))
 
     def percentiles(self) -> dict:
-        vals = sorted(self._samples)
-        if not vals:
-            return {"n": 0, "p50_ms": None, "p99_ms": None}
-
-        def _pct(q: float) -> float:
-            return round(vals[min(len(vals) - 1, int(len(vals) * q))], 3)
-
-        return {"n": len(vals), "p50_ms": _pct(0.50), "p99_ms": _pct(0.99)}
+        return _percentiles(self._samples)
 
     @property
     def pending(self) -> int:
@@ -200,17 +218,113 @@ class SessionStats:
         # qoe_collapse edge detector (one incident per collapse, not
         # one per health-check evaluation)
         self._collapsed = False
+        # glass-to-glass plane (ISSUE 7): the per-session clock mapping
+        # plus first-send perf_counter timestamps so a client's
+        # CLIENT_FRAME_TIMING report becomes a server-timebase g2g sample
+        self.clock = ClockSyncEstimator()
+        #: frame_id -> first-chunk send time, perf_counter ms (the
+        #: tracer's timebase, NOT the monotonic seconds the ACK ring
+        #: keeps — client spans must land on /api/trace coordinates)
+        self._send_pc: "collections.OrderedDict[int, float]" = \
+            collections.OrderedDict()
+        self._g2g: collections.deque = collections.deque(maxlen=256)
+        self.frames_timed = 0
+        self.timing_rejected = 0
+        self.last_timing: Optional[dict] = None
+        #: latest CLIENT_STATS payload (decoder queue depth, dropped
+        #: decodes, draw fps) — the client-side overload signal
+        self.client_stats: Optional[dict] = None
+        #: monotonic time of the last chunk offered to this client; None
+        #: until the first send. The SLO feed gates on it: a damage-gated
+        #: idle session legitimately delivers nothing, and an fps/qoe
+        #: "bad" event for it would burn the error budget while healthy.
+        self.last_send_mono: Optional[float] = None
 
     # -- hot-path writers ---------------------------------------------------
     def note_sent(self, frame_id: int, now: float) -> None:
         """Called once per offered chunk; consecutive chunks of one
         striped frame share a frame_id and count as ONE frame."""
         self.chunks_sent += 1
+        self.last_send_mono = now
         fid = int(frame_id) & 0xFFFF
         if fid != self._last_sent_fid:
             self._last_sent_fid = fid
             self.frames_sent += 1
+            # one perf_counter read per FRAME (not per chunk): the g2g
+            # anchor a later CLIENT_FRAME_TIMING report measures against
+            self._send_pc[fid] = time.perf_counter_ns() / 1e6
+            while len(self._send_pc) > 512:
+                self._send_pc.popitem(last=False)
         self.ack.note_sent(frame_id, now)
+
+    def note_frame_timing(self, frame_id: int, recv_c: float,
+                          decode_c: float, present_c: float
+                          ) -> Optional[dict]:
+        """One client timing report (client-clock ms) mapped onto the
+        server perf_counter timebase. Returns the mapped sample —
+        ``send_ms``/``recv_ms``/``decode_ms``/``present_ms`` (server ms;
+        send may be None for an unknown frame id) plus the derived
+        ``g2g_ms`` — or None while the clock is unsynced (a mapping
+        without an offset estimate would be fiction)."""
+        if self.clock.offset_at(present_c) is None:
+            return None
+        # map each timestamp at ITS OWN instant (drift-aware), then
+        # clamp to monotone order: jitter the fit let through must not
+        # produce a negative decode or present span
+        recv_s = self.clock.to_server_ms(recv_c)
+        decode_s = max(recv_s, self.clock.to_server_ms(decode_c))
+        present_s = max(decode_s, self.clock.to_server_ms(present_c))
+        # plausibility gate: the payload is client-controlled, and a
+        # finite-but-absurd timestamp passes the parser. A report about
+        # a frame presented in the FUTURE (beyond the mapping's own
+        # error bound) or in the distant past would poison the g2g
+        # percentiles, the shared histogram, the g2g SLO, and the
+        # extended trace envelope — drop it and count the drop.
+        now_ms = time.perf_counter_ns() / 1e6
+        slack = (self.clock.error_bound_ms() or 0.0) + 50.0
+        if present_s > now_ms + slack or present_s < now_ms - 60_000.0:
+            self.timing_rejected += 1
+            return None
+        fid = int(frame_id) & 0xFFFF
+        send_ms = self._send_pc.pop(fid, None)
+        g2g = None
+        if send_ms is not None:
+            if present_s >= send_ms:
+                g2g = present_s - send_ms
+                self._g2g.append(g2g)
+                _metrics_g2g(g2g)
+            else:
+                # mapped present predates the send anchor: clock-sync
+                # bias (up to rtt/2) clips the fastest frames first, so
+                # a silent skip would bias the percentiles upward with
+                # nothing in /api/sessions explaining why
+                self.timing_rejected += 1
+        self.frames_timed += 1
+        self.last_timing = {
+            "frame_id": fid,
+            "send_ms": send_ms,
+            "recv_ms": recv_s,
+            "decode_ms": decode_s,
+            "present_ms": present_s,
+            "g2g_ms": g2g,
+            "error_bound_ms": self.clock.error_bound_ms(),
+        }
+        return self.last_timing
+
+    def note_client_stats(self, body: dict) -> None:
+        """Sanitised CLIENT_STATS ingest: known numeric fields only (the
+        payload is client-controlled; it must not become an unbounded
+        attacker-shaped blob in /api/sessions)."""
+        clean: dict = {}
+        for key in ("decode_queue", "dropped_decodes", "draw_fps"):
+            v = body.get(key)
+            if isinstance(v, (int, float)) and -1e12 < v < 1e12:
+                clean[key] = round(float(v), 3)
+        if clean:
+            self.client_stats = clean
+
+    def g2g_percentiles(self) -> dict:
+        return _percentiles(self._g2g)
 
     def note_ack(self, frame_id: int, now: float) -> Optional[float]:
         rtt = self.ack.note_ack(frame_id, now)
@@ -318,8 +432,17 @@ class SessionStats:
             "drop_rate": round(self.drop_rate(relay=relay, cc=cc), 4),
             "qoe_score": self.score(now),
         }
+        g2g = self.g2g_percentiles()
+        doc["g2g_p99_ms"] = g2g["p99_ms"]
         if verbose:
             doc["raddr"] = self.raddr
+            doc["clock"] = self.clock.quality()
+            doc["g2g"] = {**g2g,
+                          "frames_timed": self.frames_timed,
+                          "rejected": self.timing_rejected,
+                          "last": self.last_timing}
+            if self.client_stats is not None:
+                doc["client"] = dict(self.client_stats)
             doc["ack"] = {**self.ack.percentiles(),
                           "ewma_ms": (round(self.ack.ewma_ms, 3)
                                       if self.ack.ewma_ms is not None
@@ -498,6 +621,12 @@ class QoERegistry:
                          "Per-session frames dropped by the relay budget")
         metrics.describe("selkies_session_backpressure_seconds_total",
                          "Per-session time spent backpressured")
+        metrics.describe("selkies_session_clock_offset_ms",
+                         "Per-session client clock offset (server-client)")
+        metrics.describe("selkies_session_clock_drift_ppm",
+                         "Per-session client clock drift vs server (ppm)")
+        metrics.describe("selkies_session_clock_rtt_min_ms",
+                         "Per-session minimum clock-exchange RTT (ms)")
         metrics.describe("selkies_sessions",
                          "Live streaming sessions by transport kind")
         metrics.describe("selkies_qoe_worst_score",
@@ -522,7 +651,10 @@ class QoERegistry:
                       "selkies_session_client_fps",
                       "selkies_session_sent_bytes_total",
                       "selkies_session_dropped_frames_total",
-                      "selkies_session_backpressure_seconds_total")
+                      "selkies_session_backpressure_seconds_total",
+                      "selkies_session_clock_offset_ms",
+                      "selkies_session_clock_drift_ppm",
+                      "selkies_session_clock_rtt_min_ms")
         for name in per_metric:
             metrics.clear_metric(name)
         by_kind: dict[str, int] = {}
@@ -556,6 +688,17 @@ class QoERegistry:
                 metrics.set_gauge(
                     "selkies_session_backpressure_seconds_total",
                     round(st.bp_total_s, 3), labels)
+                # clock-sync quality (ISSUE 7) — same cardinality cap
+                q = st.clock.quality()
+                if q["offset_ms"] is not None:
+                    metrics.set_gauge("selkies_session_clock_offset_ms",
+                                      q["offset_ms"], labels)
+                if q["drift_ppm"] is not None:
+                    metrics.set_gauge("selkies_session_clock_drift_ppm",
+                                      q["drift_ppm"], labels)
+                if q["rtt_min_ms"] is not None:
+                    metrics.set_gauge("selkies_session_clock_rtt_min_ms",
+                                      q["rtt_min_ms"], labels)
             else:
                 overflow["count"] += 1
                 overflow["sent_bytes"] += float(
@@ -582,6 +725,29 @@ class QoERegistry:
 
 
 _rtt_hist_described = False
+_g2g_hist_described = False
+
+#: the sub-ms..seconds ladder the ACK-RTT and glass-to-glass histograms
+#: share (the default 1..240 fps/ms ladder would collapse both)
+_WIRE_MS_BUCKETS = (0.5, 1, 2, 5, 10, 20, 50, 100, 250, 500,
+                    1000, 2000, 5000)
+
+
+def _metrics_g2g(g2g_ms: float) -> None:
+    """Glass-to-glass latency histogram (per timed frame). Lazy +
+    guarded like the RTT bridge; shares its 0.5 ms–5 s ladder."""
+    global _g2g_hist_described
+    try:
+        from ..server import metrics
+    except Exception:
+        return
+    if not _g2g_hist_described:
+        _g2g_hist_described = True
+        metrics.describe("selkies_session_g2g_ms",
+                         "Frame glass-to-glass latency across sessions "
+                         "(send -> client present, ms)",
+                         buckets=_WIRE_MS_BUCKETS)
+    metrics.observe_hist("selkies_session_g2g_ms", g2g_ms)
 
 
 def _metrics_rtt(rtt_ms: float) -> None:
@@ -597,8 +763,7 @@ def _metrics_rtt(rtt_ms: float) -> None:
         _rtt_hist_described = True
         metrics.describe("selkies_session_ack_rtt_ms",
                          "ACK round-trip time across sessions (ms)",
-                         buckets=(0.5, 1, 2, 5, 10, 20, 50, 100, 250, 500,
-                                  1000, 2000, 5000))
+                         buckets=_WIRE_MS_BUCKETS)
     metrics.observe_hist("selkies_session_ack_rtt_ms", rtt_ms)
 
 

@@ -1,0 +1,357 @@
+"""Declarative SLOs with error budgets and multi-window burn rates.
+
+The observability planes (PR 2–6) produce *numbers*; nothing turns them
+into a **commitment**. The north star is a latency SLO — 1080p60 at
+glass-to-glass p99 < 16 ms — and ROADMAP items 2 and 5 are judged
+against it, so this module is the judging instrument: declarative
+objectives over boolean event streams ("this frame met the g2g budget",
+"this tick the session held its fps target", "this tick QoE was above
+water") with Google-SRE-style error budgets and **multi-window
+burn-rate** evaluation.
+
+Mechanics (the SRE workbook's alerting chapter, condensed):
+
+- an :class:`Slo` promises a good-event fraction (``objective``, e.g.
+  0.99 → a 1% **error budget**);
+- ``burn_rate(window) = bad_fraction(window) / error_budget`` — burn 1.0
+  consumes exactly the budget, 14.4 torches a 30-day budget in 2 days;
+- the verdict is **two-window**: a fast window (5 m) trips instantly on
+  a real regression but flaps on noise, a slow window (1 h) is stable
+  but late — alert (``failed``) only when BOTH burn past the threshold,
+  warn (``degraded``) when the fast window alone burns. Budget
+  exhaustion over the slow window (bad fraction ≥ budget, i.e. slow
+  burn ≥ 1 with the fast window still burning) also fails: a slow leak
+  that ate the whole budget is an incident even if it never spiked.
+
+Events land in fixed-width time buckets (a ring bounded by the slow
+window), so memory is constant and evaluation is O(buckets). Clocks are
+injected everywhere (``now=``) — burn-rate tests run on synthetic
+timelines with zero sleeps, the same discipline the rest of
+:mod:`selkies_tpu.obs` keeps. Stdlib-only by the obs contract.
+
+Surfaces: ``GET /api/slo``, the ``slo`` health check, edge-triggered
+``slo_burn`` flight-recorder incidents, and ``selkies_slo_*`` gauges.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from . import health as _health
+
+__all__ = ["Slo", "SloEngine", "engine", "DEFAULT_FAST_WINDOW_S",
+           "DEFAULT_SLOW_WINDOW_S", "DEFAULT_BURN_THRESHOLD"]
+
+DEFAULT_FAST_WINDOW_S = 300.0      # 5 m: catches a regression quickly
+DEFAULT_SLOW_WINDOW_S = 3600.0     # 1 h: confirms it is not a blip
+#: both windows must burn this fast to page (SRE workbook's 14.4 = a
+#: 30-day budget consumed in 2 days)
+DEFAULT_BURN_THRESHOLD = 14.4
+#: bucket width for the event ring; fine enough that the fast window
+#: sees fresh data, coarse enough that an hour is 360 buckets
+BUCKET_S = 10.0
+
+
+class Slo:
+    """One objective over a good/bad event stream. Thread-safe writers
+    (frame events arrive from the loop, evaluation from a health check
+    on any thread)."""
+
+    def __init__(self, name: str, description: str = "",
+                 objective: float = 0.99,
+                 fast_window_s: float = DEFAULT_FAST_WINDOW_S,
+                 slow_window_s: float = DEFAULT_SLOW_WINDOW_S,
+                 burn_threshold: float = DEFAULT_BURN_THRESHOLD,
+                 bucket_s: float = BUCKET_S):
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"objective must be in (0,1), got {objective}")
+        self.name = str(name)
+        self.description = str(description)
+        self.objective = float(objective)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.burn_threshold = float(burn_threshold)
+        self.bucket_s = float(bucket_s)
+        self._lock = threading.Lock()
+        #: bucket_start -> [good, bad]; insertion-ordered by time
+        self._buckets: dict[float, list] = {}
+        self.good_total = 0
+        self.bad_total = 0
+        #: edge detector for the slo_burn incident (re-arms on ok)
+        self.alerting = False
+
+    @property
+    def error_budget(self) -> float:
+        return 1.0 - self.objective
+
+    # -- ingest --------------------------------------------------------------
+    def record(self, good: bool, n: int = 1,
+               now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        b = now - (now % self.bucket_s)
+        with self._lock:
+            cell = self._buckets.get(b)
+            if cell is None:
+                cell = self._buckets[b] = [0, 0]
+                self._gc(now)
+            cell[1 if not good else 0] += int(n)
+            if good:
+                self.good_total += int(n)
+            else:
+                self.bad_total += int(n)
+
+    def _gc(self, now: float) -> None:
+        horizon = now - self.slow_window_s - self.bucket_s
+        for b in [b for b in self._buckets if b < horizon]:
+            del self._buckets[b]
+
+    # -- math ----------------------------------------------------------------
+    def _window_counts(self, window_s: float, now: float) -> tuple[int, int]:
+        lo = now - window_s
+        good = bad = 0
+        with self._lock:
+            for b, (g, x) in self._buckets.items():
+                if b + self.bucket_s > lo and b <= now:
+                    good += g
+                    bad += x
+        return good, bad
+
+    def burn_rate(self, window_s: float,
+                  now: Optional[float] = None) -> Optional[float]:
+        """bad_fraction / budget over the window; None with no events."""
+        now = time.monotonic() if now is None else now
+        good, bad = self._window_counts(window_s, now)
+        total = good + bad
+        if total == 0:
+            return None
+        return (bad / total) / self.error_budget
+
+    def budget_remaining(self, now: Optional[float] = None
+                         ) -> Optional[float]:
+        """Fraction of the slow window's error budget still unspent
+        (1.0 = clean, 0.0 = exhausted)."""
+        burn = self.burn_rate(self.slow_window_s, now=now)
+        if burn is None:
+            return None
+        return max(0.0, 1.0 - burn)
+
+    # -- verdict -------------------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        fast = self.burn_rate(self.fast_window_s, now=now)
+        slow = self.burn_rate(self.slow_window_s, now=now)
+        # budget_remaining(), inlined against the slow burn already in
+        # hand — it would re-lock and re-scan the bucket ring
+        remaining = None if slow is None else max(0.0, 1.0 - slow)
+        if fast is None and slow is None:
+            status = _health.OK
+            reason = "no events yet"
+        else:
+            fast_burning = fast is not None and fast > self.burn_threshold
+            slow_burning = slow is not None and slow > self.burn_threshold
+            exhausted = remaining == 0.0
+            if fast_burning and (slow_burning or exhausted):
+                status = _health.FAILED
+                # label the windows like the branches below — the
+                # widths are configurable, "(5m)/(1h)" would lie
+                reason = (f"burn {fast:.1f}x (fast) / "
+                          f"{slow:.1f}x (slow) vs {self.burn_threshold}x"
+                          if not exhausted or slow_burning else
+                          f"error budget exhausted (burn {fast:.1f}x fast)")
+            elif fast_burning:
+                status = _health.DEGRADED
+                reason = (f"fast-window burn {fast:.1f}x > "
+                          f"{self.burn_threshold}x (slow window "
+                          f"{'%.1f' % slow if slow is not None else '?'}x)")
+            else:
+                status = _health.OK
+                reason = (f"burn {fast:.2f}x (fast) / "
+                          f"{'%.2f' % slow if slow is not None else '?'}x "
+                          f"(slow)" if fast is not None else "within budget")
+        return {
+            "name": self.name,
+            "description": self.description,
+            "objective": self.objective,
+            "status": status,
+            "reason": reason,
+            "burn_fast": round(fast, 3) if fast is not None else None,
+            "burn_slow": round(slow, 3) if slow is not None else None,
+            "budget_remaining": (round(remaining, 4)
+                                 if remaining is not None else None),
+            "windows_s": [self.fast_window_s, self.slow_window_s],
+            "burn_threshold": self.burn_threshold,
+            "events": {"good": self.good_total, "bad": self.bad_total},
+        }
+
+    def set_alerting(self, value: bool) -> bool:
+        """Flip the incident edge detector under the lock; True iff the
+        value changed (concurrent report() calls race the read-modify-
+        write otherwise and double-record the same excursion)."""
+        with self._lock:
+            changed = self.alerting != value
+            self.alerting = value
+            return changed
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buckets.clear()
+            self.good_total = 0
+            self.bad_total = 0
+            self.alerting = False
+
+
+class SloEngine:
+    """The objective set behind ``GET /api/slo`` and the ``slo`` health
+    check. Same singleton pattern as :data:`.health.engine` — one
+    process-wide instance (:data:`engine`); tests build their own."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slos: dict[str, Slo] = {}
+        #: slo_burn incident sink; None = the process health engine's
+        #: flight recorder (tests/selftests inject their own)
+        self.recorder: Optional[_health.FlightRecorder] = None
+
+    # -- registration --------------------------------------------------------
+    def register(self, slo: Slo) -> Slo:
+        with self._lock:
+            self._slos[slo.name] = slo
+        return slo
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._slos.pop(name, None)
+
+    def get(self, name: str) -> Optional[Slo]:
+        with self._lock:
+            return self._slos.get(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._slos)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._slos.clear()
+
+    def configure_defaults(self, settings=None) -> None:
+        """(Re)declare the stock objectives from settings — called by the
+        server core so the SLO set exists whichever transport runs.
+        Idempotent: re-configuring replaces the objective definitions
+        but keeps nothing stale around."""
+        g2g_ms = float(getattr(settings, "slo_g2g_ms", 250.0))
+        objective = float(getattr(settings, "slo_objective", 0.99))
+        burn = float(getattr(settings, "slo_burn_threshold",
+                             DEFAULT_BURN_THRESHOLD))
+        fast = float(getattr(settings, "slo_fast_window_s",
+                             DEFAULT_FAST_WINDOW_S))
+        slow = float(getattr(settings, "slo_slow_window_s",
+                             DEFAULT_SLOW_WINDOW_S))
+        for name, desc in (
+            ("g2g", f"frame glass-to-glass latency <= {g2g_ms:g} ms"),
+            ("fps", "session delivered fps >= half the target"),
+            ("qoe", "session QoE score above the degraded threshold"),
+        ):
+            self.register(Slo(name, desc, objective=objective,
+                              fast_window_s=fast, slow_window_s=slow,
+                              burn_threshold=burn))
+
+    # -- ingest --------------------------------------------------------------
+    def record(self, name: str, good: bool, n: int = 1,
+               now: Optional[float] = None) -> bool:
+        """Record an event against a named objective. Unknown names are
+        dropped (transports record unconditionally; whether an objective
+        is declared is the core's policy decision)."""
+        slo = self.get(name)
+        if slo is None:
+            return False
+        slo.record(good, n=n, now=now)
+        return True
+
+    # -- verdict / export ----------------------------------------------------
+    def report(self, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        docs = [s.evaluate(now=now) for s in
+                (self.get(n) for n in self.names()) if s is not None]
+        worst = _health.worst(d["status"] for d in docs)
+        self._edge_incidents(docs)
+        self._export_metrics(docs)
+        return {"status": worst, "slos": docs}
+
+    def health_check(self) -> "_health.Verdict":
+        """The ``slo`` check: worst objective verdict, with the burning
+        objective named so the ladder/operator can see WHICH promise is
+        being broken."""
+        rep = self.report()
+        burning = [d for d in rep["slos"]
+                   if d["status"] != _health.OK]
+        if not burning:
+            n = len(rep["slos"])
+            return _health.ok(f"{n} objective(s) within budget" if n
+                              else "no objectives declared")
+        worst = max(burning,
+                    key=lambda d: 0 if d["status"] == _health.DEGRADED
+                    else 1)
+        msg = f"slo {worst['name']}: {worst['reason']}"
+        data = {"slo": worst["name"],
+                "burn_fast": worst["burn_fast"],
+                "burn_slow": worst["burn_slow"],
+                "budget_remaining": worst["budget_remaining"]}
+        if rep["status"] == _health.FAILED:
+            return _health.failed(msg, **data)
+        return _health.degraded(msg, **data)
+
+    def _edge_incidents(self, docs: list[dict]) -> None:
+        """One ``slo_burn`` incident per excursion into failed; re-arms
+        once the objective returns to ok (not merely degraded — a
+        flapping fast window must not machine-gun the recorder)."""
+        rec = self.recorder if self.recorder is not None \
+            else _health.engine.recorder
+        for d in docs:
+            slo = self.get(d["name"])
+            if slo is None:
+                continue
+            if d["status"] == _health.FAILED:
+                if slo.set_alerting(True):
+                    rec.record("slo_burn", slo=d["name"],
+                               burn_fast=d["burn_fast"],
+                               burn_slow=d["burn_slow"],
+                               budget_remaining=d["budget_remaining"],
+                               reason=d["reason"])
+            elif d["status"] == _health.OK:
+                slo.set_alerting(False)
+
+    def _export_metrics(self, docs: list[dict]) -> None:
+        """``selkies_slo_*`` gauges (lazy + guarded like every obs
+        metrics bridge: the lint image has no server plane)."""
+        try:
+            from ..server import metrics
+        except Exception:
+            return
+        metrics.describe("selkies_slo_burn_rate",
+                         "SLO error-budget burn rate per window")
+        metrics.describe("selkies_slo_budget_remaining",
+                         "Fraction of the slow-window error budget left")
+        metrics.describe("selkies_slo_status",
+                         "SLO verdict (0=ok 1=degraded 2=failed)")
+        rank = {_health.OK: 0, _health.DEGRADED: 1, _health.FAILED: 2}
+        for d in docs:
+            if d["burn_fast"] is not None:
+                metrics.set_gauge("selkies_slo_burn_rate", d["burn_fast"],
+                                  {"slo": d["name"], "window": "fast"})
+            if d["burn_slow"] is not None:
+                metrics.set_gauge("selkies_slo_burn_rate", d["burn_slow"],
+                                  {"slo": d["name"], "window": "slow"})
+            if d["budget_remaining"] is not None:
+                metrics.set_gauge("selkies_slo_budget_remaining",
+                                  d["budget_remaining"],
+                                  {"slo": d["name"]})
+            metrics.set_gauge("selkies_slo_status",
+                              rank.get(d["status"], 2), {"slo": d["name"]})
+
+
+#: the process-wide engine every transport records against (the server
+#: core declares the default objectives); tests build their own.
+engine = SloEngine()

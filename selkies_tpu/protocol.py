@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import gzip
+import math
 import struct
 import zlib
 from typing import Iterable
@@ -171,7 +172,8 @@ def decompress_control(buf: bytes | memoryview) -> str:
 #: verbs a view-only client may still send (reference
 #: input_handler.py:110-128 viewer-authority prefix lists).
 VIEWER_ALLOWED_PREFIXES = (
-    "_gz", "SETTINGS", "CLIENT_FRAME_ACK", "START_VIDEO", "STOP_VIDEO",
+    "_gz", "SETTINGS", "CLIENT_FRAME_ACK", "CLIENT_FRAME_TIMING",
+    "CLIENT_CLOCK", "CLIENT_STATS", "START_VIDEO", "STOP_VIDEO",
     "REQUEST_KEYFRAME", "START_AUDIO", "STOP_AUDIO", "pong", "_f", "_l",
     "_stats_video", "_stats_audio", "p",
 )
@@ -205,3 +207,75 @@ def parse_verb(text: str) -> Verb:
     si = text.find(" ")
     cut = min(x for x in (ci, si, len(text)) if x >= 0)
     return Verb(name=text[:cut], args=text[cut + 1:] if cut < len(text) else "")
+
+
+# ---------------------------------------------------------------------------
+# Client timing protocol (ISSUE 7): glass-to-glass frame timing and the
+# NTP-style clock exchange. Parsers are STRICT — a malformed token raises
+# ValueError and the transport drops the message (counting it in
+# ``selkies_protocol_errors_total{kind}``) instead of crashing the
+# receive loop. All timestamps are client-clock milliseconds
+# (``performance.now()``) except the server_clock reply's t1/t2, which
+# are server ``perf_counter`` milliseconds.
+# ---------------------------------------------------------------------------
+
+#: batch cap for ``CLIENT_FRAME_TIMING``: the client flushes every 16
+#: entries / 250 ms, so anything past this is a malformed (or hostile)
+#: batch, not backlog
+FRAME_TIMING_MAX_BATCH = 64
+
+
+def _finite(v: float) -> float:
+    """float() that rejects nan/inf (both parse, neither is a time)."""
+    f = float(v)
+    if math.isnan(f) or math.isinf(f):
+        raise ValueError(f"non-finite timestamp {v!r}")
+    return f
+
+
+def parse_frame_timing(args: str,
+                       max_entries: int = FRAME_TIMING_MAX_BATCH
+                       ) -> list[tuple[int, float, float, float]]:
+    """Parse a ``CLIENT_FRAME_TIMING`` batch:
+    ``fid:recv:decode:present[;fid:recv:decode:present...]`` →
+    ``[(frame_id, recv_ms, decode_ms, present_ms), ...]`` (client clock).
+
+    Raises ValueError on an empty batch, a truncated token, a
+    non-integer frame id, or a non-finite timestamp."""
+    body = args.strip()
+    if not body:
+        raise ValueError("empty timing batch")
+    entries: list[tuple[int, float, float, float]] = []
+    for tok in body.split(";"):
+        parts = tok.split(":")
+        if len(parts) != 4:
+            raise ValueError(
+                f"timing token needs fid:recv:decode:present, got {tok!r}")
+        fid = int(parts[0])
+        recv, decode, present = (_finite(p) for p in parts[1:])
+        entries.append((fid % FRAME_ID_MOD, recv, decode, present))
+        if len(entries) > max_entries:
+            raise ValueError(f"timing batch exceeds {max_entries} entries")
+    return entries
+
+
+def parse_client_clock(args: str) -> tuple[str, int, tuple[float, ...]]:
+    """Parse a ``CLIENT_CLOCK`` message → ``(kind, seq, timestamps)``:
+
+    - ``ping,<seq>,<t0>`` → ``("ping", seq, (t0,))`` — the server replies
+      ``server_clock <seq>,<t0>,<t1>,<t2>``;
+    - ``sample,<seq>,<t0>,<t1>,<t2>,<t3>`` → the full 4-timestamp
+      exchange for the estimator.
+    """
+    parts = args.split(",")
+    kind = parts[0]
+    if kind == "ping":
+        if len(parts) != 3:
+            raise ValueError(f"ping wants seq,t0 ({len(parts) - 1} fields)")
+        return kind, int(parts[1]), (_finite(parts[2]),)
+    if kind == "sample":
+        if len(parts) != 6:
+            raise ValueError(
+                f"sample wants seq,t0..t3 ({len(parts) - 1} fields)")
+        return kind, int(parts[1]), tuple(_finite(p) for p in parts[2:6])
+    raise ValueError(f"unknown CLIENT_CLOCK kind {kind!r}")

@@ -16,7 +16,8 @@ designed fresh:
   orchestration), ``/api/metrics``, ``/api/switch`` (live transport
   swap when ``enable_dual_mode``, reference :804-895), ``/api/profile``
   (on-demand jax.profiler capture, full-role gated), ``/api/perf``
-  (static step cost attribution + pipeline occupancy, ISSUE 6);
+  (static step cost attribution + pipeline occupancy, ISSUE 6),
+  ``/api/slo`` (error-budget burn-rate verdicts, ISSUE 7);
 - chunked file upload with path-traversal + symlink defences and a
   JSON/HTML download index (reference :897-1299);
 - TLS with live certificate reload (reference :552-632);
@@ -45,6 +46,7 @@ from aiohttp import web
 
 from ..obs import health as _health
 from ..obs import qoe as _qoe
+from ..obs import slo as _slo
 from ..resilience import faults as _faults
 from ..resilience.ladder import DegradationLadder
 from ..resilience.supervisor import RestartPolicy, Supervisor
@@ -104,6 +106,13 @@ class CentralizedStreamServer:
             failed_score=getattr(settings, "qoe_failed_score", None))
         self._check_qoe = lambda: _qoe.registry.health_check()
         self.health.register("qoe", self._check_qoe)
+        # SLO burn-rate engine (obs.slo): the stock objectives (g2g /
+        # fps / qoe) are declared HERE — not in a transport — so the
+        # promise set exists whichever mode is active; transports just
+        # record events against the named objectives.
+        _slo.engine.configure_defaults(settings)
+        self._check_slo = lambda: _slo.engine.health_check()
+        self.health.register("slo", self._check_slo)
         # resilience plane (selkies_tpu/resilience): the supervisor owns
         # every restart decision (transport service here; captures,
         # relays and audio adopt through it from the services), the
@@ -204,6 +213,7 @@ class CentralizedStreamServer:
         r.add_post("/api/trace", self.handle_trace_control)
         r.add_get("/api/perf", self.handle_perf)
         r.add_get("/api/sessions", self.handle_sessions)
+        r.add_get("/api/slo", self.handle_slo)
         r.add_post("/api/profile", self.handle_profile)
         r.add_get("/api/faults", self.handle_faults)
         r.add_post("/api/faults", self.handle_faults_control)
@@ -363,6 +373,13 @@ class CentralizedStreamServer:
             else:
                 doc["profile"] = None
         return web.json_response(doc)
+
+    async def handle_slo(self, request: web.Request) -> web.Response:
+        """Declarative SLO verdicts (obs.slo): per-objective fast/slow
+        burn rates, remaining error budget, and the multi-window
+        alerting verdict. Ungated like /api/health — the burn-rate
+        panel is the first thing an on-call dashboard polls."""
+        return web.json_response(_slo.engine.report())
 
     async def handle_sessions(self, request: web.Request) -> web.Response:
         """Per-session wire QoE (the ``getStats()`` analog): summary
@@ -804,6 +821,7 @@ class CentralizedStreamServer:
         self.health.unregister("service", self._check_service)
         self.health.unregister("stage_latency", self._check_stage_latency)
         self.health.unregister("qoe", self._check_qoe)
+        self.health.unregister("slo", self._check_slo)
         self.health.unregister("supervision", self._check_supervision)
         self.supervisor.close()
         if self._ladder_task:

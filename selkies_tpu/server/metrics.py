@@ -82,6 +82,12 @@ def inc_counter(name: str, value: float = 1.0, labels: dict | None = None) -> No
         _counters[_key(name, labels)] += value
 
 
+def counter_value(name: str, labels: dict | None = None) -> float:
+    """Current value of one counter sample (tests / diagnostics)."""
+    with _lock:
+        return _counters.get(_key(name, labels), 0.0)
+
+
 def _ladder(name: str) -> tuple:
     lad = _hist_ladders.get(name)
     if lad is None:

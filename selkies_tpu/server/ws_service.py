@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import collections
 import json
 import logging
 import os
@@ -35,6 +36,7 @@ from ..engine.types import EncodedChunk
 from ..obs import health as _health
 from ..obs import logctx as _logctx
 from ..obs import qoe as _qoe
+from ..obs import slo as _slo
 from ..resilience import faults as _faults
 from ..settings import AppSettings, SettingsError
 from ..taskutil import spawn_retained
@@ -52,6 +54,10 @@ CONTROL_SEND_TIMEOUT_S = 2.0  # reference 2 s control bound (selkies.py:79-101)
 #: start within this many seconds of the last logged one are summarised
 #: (count carried on the next INFO line) instead of flooding the log
 BACKPRESSURE_LOG_EVERY_S = 5.0
+
+metrics.describe("selkies_protocol_errors_total",
+                 "Malformed client text-protocol messages dropped, by "
+                 "message kind")
 
 
 class _FpsEstimator:
@@ -120,6 +126,10 @@ class ClientConnection:
         self.reported_latency_ms = 0.0
         #: per-session QoE stats (obs.qoe), set by the service at accept
         self.qoe = None
+        #: outstanding CLIENT_CLOCK pings (seq -> the t0/t1/t2 we stamped
+        #: into the server_clock reply): a sample must echo one of these
+        #: or the estimator would trust fully client-fabricated tuples
+        self.clock_pings: collections.OrderedDict = collections.OrderedDict()
         # backpressure log rate limiting (one INFO per window, flapping
         # windows summarised)
         self._bp_last_log = 0.0
@@ -1058,6 +1068,9 @@ class WebSocketsService(BaseStreamingService):
         handler = {
             "_gz": self._h_gz, "SETTINGS": self._h_settings,
             "CLIENT_FRAME_ACK": self._h_ack,
+            "CLIENT_FRAME_TIMING": self._h_frame_timing,
+            "CLIENT_CLOCK": self._h_client_clock,
+            "CLIENT_STATS": self._h_client_stats,
             "START_VIDEO": self._h_start_video, "STOP_VIDEO": self._h_stop_video,
             "REQUEST_KEYFRAME": self._h_keyframe,
             "START_AUDIO": self._h_start_audio, "STOP_AUDIO": self._h_stop_audio,
@@ -1164,10 +1177,22 @@ class WebSocketsService(BaseStreamingService):
         except OSError:
             pass
 
+    def _protocol_error(self, client: ClientConnection, kind: str,
+                        text: str, exc: Exception) -> None:
+        """Malformed client message: count it (by kind) and drop it —
+        the receive loop must survive any byte sequence a client can
+        produce (ISSUE 7 satellite; the input-verb path already parses
+        tolerantly)."""
+        metrics.inc_counter("selkies_protocol_errors_total",
+                            labels={"kind": kind})
+        logger.debug("malformed %s from client %d: %r (%s)",
+                     kind, client.id, text[:80], exc)
+
     async def _h_ack(self, client: ClientConnection, args: str) -> None:
         try:
             acked = int(args)
-        except ValueError:
+        except ValueError as e:
+            self._protocol_error(client, "client_frame_ack", args, e)
             return
         now = time.monotonic()
         client.last_ack_id = acked
@@ -1179,6 +1204,110 @@ class WebSocketsService(BaseStreamingService):
             # close the glass-to-glass loop on the frame's timeline
             _tracer.instant(client.display, acked, "ack", lane="ws")
         self._update_backpressure(client)
+
+    async def _h_client_clock(self, client: ClientConnection,
+                              args: str) -> None:
+        """NTP-style clock exchange (obs.clocksync): ``ping`` gets a
+        ``server_clock`` reply stamped with two perf_counter reads;
+        ``sample`` feeds the session's offset/drift estimator. The
+        server — not the browser — owns estimation."""
+        try:
+            kind, seq, ts = P.parse_client_clock(args)
+        except (ValueError, IndexError) as e:
+            self._protocol_error(client, "client_clock", args, e)
+            return
+        if kind == "ping":
+            t1 = time.perf_counter_ns() / 1e6
+            head = f"server_clock {seq},{ts[0]:.3f},{t1:.3f},"
+            t2 = time.perf_counter_ns() / 1e6   # just before the send
+            # remember what we stamped: the eventual sample must echo it
+            client.clock_pings[seq] = (float(f"{ts[0]:.3f}"),
+                                       float(f"{t1:.3f}"),
+                                       float(f"{t2:.3f}"))
+            while len(client.clock_pings) > 32:
+                client.clock_pings.popitem(last=False)
+            try:
+                await client.ws.send_str(head + f"{t2:.3f}")
+            except (ConnectionError, RuntimeError, OSError):
+                pass
+        elif client.qoe is not None:
+            # t1/t2 are OUR perf_counter stamps: accept them only from a
+            # sample that echoes an outstanding ping, or a client could
+            # fabricate self-consistent tuples, steer its clock fit to an
+            # arbitrary offset, and poison the shared g2g histogram/SLO
+            # with fictitious multi-second frames. (t0/t3 stay client-
+            # asserted by design — the client owns its own clock.)
+            expect = client.clock_pings.pop(seq, None)
+            if expect is None or any(abs(a - b) > 0.002
+                                     for a, b in zip(expect, ts[:3])):
+                self._protocol_error(
+                    client, "client_clock", args,
+                    ValueError("sample does not echo an outstanding ping"))
+                return
+            client.qoe.clock.add_sample(*ts)
+
+    async def _h_frame_timing(self, client: ClientConnection,
+                              args: str) -> None:
+        """Batched per-frame client timing (receive / decode-complete /
+        present, client-clock ms): mapped onto the server timebase by
+        the session's clock estimator, each report becomes a g2g sample
+        (qoe + selkies_session_g2g_ms), a g2g SLO event, and — when
+        tracing — a ``client`` lane on the frame's /api/trace timeline
+        with the frame envelope extended to true glass-to-glass."""
+        try:
+            entries = P.parse_frame_timing(args)
+        except ValueError as e:
+            self._protocol_error(client, "client_frame_timing", args, e)
+            return
+        if client.qoe is None:
+            return
+        budget_ms = float(getattr(self.settings, "slo_g2g_ms", 250.0))
+        for fid, recv_c, decode_c, present_c in entries:
+            m = client.qoe.note_frame_timing(fid, recv_c, decode_c,
+                                             present_c)
+            if m is None:
+                continue            # clock not synced yet
+            if m["g2g_ms"] is not None:
+                _slo.engine.record("g2g", good=m["g2g_ms"] <= budget_ms)
+            if _tracer.enabled:
+                self._attach_client_spans(client.display, fid, m)
+
+    @staticmethod
+    def _attach_client_spans(display: str, fid: int, m: dict) -> None:
+        """Join one mapped timing report onto the frame timeline:
+        ``net`` (send -> client receive), ``client.decode``,
+        ``client.present`` — the lanes that turn a post-readback bubble
+        into attributable stages."""
+        def ns(ms: float) -> int:
+            return int(ms * 1e6)
+
+        spans = []
+        if m["send_ms"] is not None and m["recv_ms"] >= m["send_ms"]:
+            spans.append(("net", m["send_ms"], m["recv_ms"]))
+        spans.append(("client.decode", m["recv_ms"], m["decode_ms"]))
+        spans.append(("client.present", m["decode_ms"], m["present_ms"]))
+        for name, a, b in spans:
+            _tracer.attach_span(display, fid, name, ns(a),
+                                max(0, ns(b) - ns(a)),
+                                lane="client", extend_frame=True)
+
+    async def _h_client_stats(self, client: ClientConnection,
+                              args: str) -> None:
+        """Periodic client-side decoder stats (queue depth, dropped
+        decodes, draw fps) — surfaced per session in
+        ``/api/sessions?verbose=1`` as the client overload signal."""
+        try:
+            body = json.loads(args)
+            if not isinstance(body, dict):
+                raise ValueError("object body required")
+        except (ValueError, RecursionError) as e:
+            # RecursionError: json.loads on a deeply nested payload
+            # ('['*100000) is NOT a ValueError and would tear down the
+            # receive loop — exactly what the hardening contract forbids
+            self._protocol_error(client, "client_stats", args, e)
+            return
+        if client.qoe is not None:
+            client.qoe.note_client_stats(body)
 
     def _update_backpressure(self, client: ClientConnection) -> None:
         """Desync window scales with measured client fps; RTT forgiveness is
@@ -1445,6 +1574,30 @@ class WebSocketsService(BaseStreamingService):
                     _health.engine.recorder.record(
                         "ack_stall", client=c.id, display=c.display,
                         last_sent=c.last_sent_id, last_ack=c.last_ack_id)
+            # SLO event feed (obs.slo): one fps + one qoe good/bad event
+            # per active session per tick. g2g events arrive per frame
+            # from _h_frame_timing; these two close the objective set.
+            target = float(self.settings.framerate)
+            now_m = time.monotonic()
+            idle_after = 2.0 * float(self.settings.stats_interval_s)
+            for c in list(self.clients.values()):
+                if not c.video_active or c.qoe is None:
+                    continue
+                # idle gate: damage gating means a static desktop
+                # legitimately delivers no frames — fps 0 / score 0 on a
+                # session we offered nothing is not a broken promise,
+                # and recording it bad would burn the budget while
+                # perfectly healthy
+                last = c.qoe.last_send_mono
+                if last is None or now_m - last > idle_after:
+                    continue
+                fps = c.qoe.client_fps()
+                if fps is not None and target > 0:
+                    _slo.engine.record("fps", good=fps >= target * 0.5)
+                score = c.qoe.score()
+                if score is not None:
+                    _slo.engine.record(
+                        "qoe", good=score >= _qoe.registry.degraded_score)
             try:
                 stats = {
                     "type": "system_stats",

@@ -337,6 +337,26 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
        "Log output: 'plain' (human) or 'json' (one structured object per "
        "line, carrying the session/seat correlation fields).",
        choices=("plain", "json")),
+    _s("slo_g2g_ms", SType.FLOAT, 250.0,
+       "Glass-to-glass frame budget for the g2g SLO: a timed frame "
+       "whose send->client-present latency exceeds this is a bad event "
+       "against the g2g error budget (the 16 ms north star is the "
+       "eventual value; 250 ms is today's honest bar).",
+       vmin=1, vmax=60000),
+    _s("slo_objective", SType.FLOAT, 0.99,
+       "Good-event fraction every stock SLO promises (0.99 = a 1% "
+       "error budget).", vmin=0.5, vmax=0.99999),
+    _s("slo_burn_threshold", SType.FLOAT, 14.4,
+       "Burn-rate multiple both windows must exceed before the slo "
+       "check fails (SRE workbook's 14.4 = a 30-day budget torched in "
+       "2 days).", vmin=1, vmax=1000),
+    _s("slo_fast_window_s", SType.FLOAT, 300.0,
+       "Fast burn-rate window: trips quickly on a real regression.",
+       vmin=10, vmax=3600),
+    _s("slo_slow_window_s", SType.FLOAT, 3600.0,
+       "Slow burn-rate window: confirms the fast window is not a "
+       "blip; also bounds the SLO event ring's memory.",
+       vmin=60, vmax=86400),
 
     # --- resilience (selkies_tpu/resilience) --------------------------------
     _s("fault_inject", SType.STR, "",

@@ -225,10 +225,17 @@ class FrameTracer:
 
     def attach_span(self, display_id: str, frame_id: int, name: str,
                     t0_ns: int, dur_ns: int,
-                    lane: Optional[str] = None) -> bool:
+                    lane: Optional[str] = None,
+                    extend_frame: bool = False) -> bool:
         """Record a span measured elsewhere (the relay's send, timed on
         the loop) onto the frame's timeline by id. Returns False when the
-        frame already left the ring."""
+        frame already left the ring.
+
+        ``extend_frame`` stretches a CLOSED frame's envelope to cover the
+        span: client-side spans (net / decode / present, ISSUE 7) land
+        after ``frame_end`` by construction, and without the extension
+        the occupancy analyzer would clip them out — e2e must become
+        glass-to-glass, not stay at ws.send."""
         if not self._enabled:
             return False
         with self._lock:
@@ -236,6 +243,8 @@ class FrameTracer:
         if tl is None:
             return False
         self._record(tl, name, lane, t0_ns, dur_ns)
+        if extend_frame and tl.t1_ns is not None:
+            tl.t1_ns = max(tl.t1_ns, t0_ns + max(0, dur_ns))
         return True
 
     def instant(self, display_id: str, frame_id: int, name: str,

@@ -24,6 +24,7 @@
     const h264Decoders = new Map();    // y -> VideoDecoder
     let jpegQueue = 0;                 // in-flight createImageBitmap
     let h264warned = false;
+    let droppedDecodes = 0;            // overload drops (CLIENT_STATS)
 
     /* 6-byte header: [0x03, flags, u16 frame_id, u16 stripe_y] + JFIF */
     async function pushJpeg(buf) {
@@ -31,7 +32,10 @@
       const fid = dv.getUint16(2), y = dv.getUint16(4);
       const last = stripeLastFid.get(y);
       if (last !== undefined && !fidNewer(fid, last)) return; // stale
-      if (jpegQueue > 48) return;   // overload: drop, keyframe recovers
+      if (jpegQueue > 48) {         // overload: drop, keyframe recovers
+        droppedDecodes++;
+        return;
+      }
       jpegQueue++;
       try {
         const blob = new Blob([buf.subarray(6)], { type: "image/jpeg" });
@@ -95,6 +99,7 @@
         // by the client) — the server's damage gating believes it was
         // delivered and would otherwise leave this region stale until
         // the next change
+        droppedDecodes++;
         hooks.onKeyframeNeeded();
         return;
       }
@@ -118,7 +123,17 @@
       h264Decoders.clear();
     }
 
-    return { push, reset };
+    /* decoder-side load for CLIENT_STATS: current queued work across
+     * every stripe decoder plus the cumulative overload-drop count */
+    function stats() {
+      let queue = jpegQueue;
+      for (const dec of h264Decoders.values()) {
+        if (dec.state !== "closed") queue += dec.decodeQueueSize || 0;
+      }
+      return { queue, dropped: droppedDecodes };
+    }
+
+    return { push, reset, stats };
   }
 
   global.SelkiesStripeCore = { makeStripeDecoder, fidNewer,

@@ -57,6 +57,8 @@ const decoder = SelkiesStripeCore.makeStripeDecoder({
 
 setInterval(() => {   // flush the stripe-stats remainder at low rates
   if (drawnBatch) { post({ type: "drawn", n: drawnBatch }); drawnBatch = 0; }
+  // decoder load for CLIENT_STATS (queue depth, overload drops)
+  post({ type: "cstats", stats: decoder.stats() });
 }, 500);
 
 /* ---------------------------------------------------------------- caps */

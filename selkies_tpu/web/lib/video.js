@@ -58,6 +58,7 @@ class WorkerVideoSink {
       case "caps": this._onCaps(m); break;
       case "ack": this.hooks.onAck(m.fid); break;
       case "drawn": this.hooks.onStripeDrawn(m.n); break;
+      case "cstats": this._clientStats = m.stats; break;
       case "kf": this.hooks.onKeyframeNeeded(); break;
       case "track":
         this.hooks.attachVideo(new MediaStream([m.track]));
@@ -163,6 +164,13 @@ class WorkerVideoSink {
     if (this.worker) this.worker.postMessage({ type: "reset" });
   }
 
+  /* last decoder-load report from the worker (pushed every 500 ms);
+   * null until the first report lands */
+  clientStats() {
+    if (this._fallback) return this._fallback.clientStats();
+    return this._clientStats || null;
+  }
+
   close() {
     if (this._fallback) { this._fallback.close(); return; }
     if (this.worker) { try { this.worker.terminate(); } catch (_e) { /* */ } }
@@ -207,6 +215,8 @@ export class CanvasVideoSink {
   }
 
   reset() { this._core.reset(); }
+
+  clientStats() { return this._core.stats(); }
 
   close() { this._core.reset(); }
 }

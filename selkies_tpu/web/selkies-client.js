@@ -73,6 +73,14 @@ class SelkiesClient {
     });
     this._statsTimer = setInterval(() => this._reportStats(), 2000);
     this._hbTimer = setInterval(() => this.input.heartbeat(), 500);
+    /* glass-to-glass timing plane: NTP-style clock pings (the server
+     * runs the offset/drift estimator) + per-frame receive/decode/
+     * present timestamps batched into CLIENT_FRAME_TIMING */
+    this._clockSeq = 0;
+    this._frameTiming = new Map();    // fid -> {recv, decode}
+    this._timingQueue = [];
+    this._timingLastFlush = 0;
+    this._clockTimer = setInterval(() => this._clockPing(), 2000);
     this._sendLayout();
   }
 
@@ -109,6 +117,7 @@ class SelkiesClient {
       this.reconnectDelay = 500;
       this.send("_gz,1");
       this.gz = true;
+      this._clockPing();      // first sync sample without the 2 s wait
       if (this._pendingLayout) {
         this._pendingLayout();
         this._pendingLayout = null;
@@ -327,11 +336,70 @@ class SelkiesClient {
     switch (buf[0]) {
       case OP_JPEG:
       case OP_H264:
-        if (!this.rtcMode) this._ensureSink().push(buf);
+        if (!this.rtcMode) {
+          this._noteFrameReceived(buf);
+          this._ensureSink().push(buf);
+        }
         break;
       case OP_AUDIO: if (this.audio) this.audio.push(buf); break;
       case OP_GZ: this._onGzControl(buf); break;
     }
+  }
+
+  /* --------------------------------------------- glass-to-glass timing
+   * Three client-side timestamps per frame, all performance.now():
+   * receive (first stripe off the wire), decode-complete (the sink's
+   * ack — every stripe decoded+drawn), present (requestVideoFrameCallback
+   * when a <video> sink carries the session, else the next rAF).
+   * Batched as CLIENT_FRAME_TIMING fid:recv:decode:present;... and
+   * mapped onto the server timebase by the CLIENT_CLOCK estimator. */
+  _clockPing() {
+    if (this.rtcMode || !this.ws || this.ws.readyState !== WebSocket.OPEN)
+      return;
+    this.send(`CLIENT_CLOCK ping,${++this._clockSeq},` +
+              performance.now().toFixed(3));
+  }
+
+  _noteFrameReceived(buf) {
+    const fid = (buf[2] << 8) | buf[3];   // u16 frame_id, both headers
+    if (this._frameTiming.has(fid)) return;   // later stripe, same frame
+    if (this._frameTiming.size > 128) {       // never-acked backlog
+      this._frameTiming.delete(this._frameTiming.keys().next().value);
+    }
+    this._frameTiming.set(fid, { recv: performance.now() });
+  }
+
+  _noteFrameDecoded(fid) {
+    const e = this._frameTiming.get(fid);
+    if (!e || e.decode !== undefined) return;
+    e.decode = performance.now();
+    const finish = (t) => this._noteFramePresented(fid, t);
+    const v = this.videoEl;
+    if (v && typeof v.requestVideoFrameCallback === "function")
+      v.requestVideoFrameCallback((now) => finish(now));
+    else if (typeof requestAnimationFrame === "function")
+      requestAnimationFrame((t) => finish(t));
+    else finish(performance.now());
+  }
+
+  _noteFramePresented(fid, t) {
+    const e = this._frameTiming.get(fid);
+    if (!e || e.decode === undefined) return;
+    this._frameTiming.delete(fid);
+    const present = Math.max(t || performance.now(), e.decode);
+    this._timingQueue.push(`${fid}:${e.recv.toFixed(2)}:` +
+                           `${e.decode.toFixed(2)}:${present.toFixed(2)}`);
+    const now = performance.now();
+    if (this._timingQueue.length >= 16 ||
+        now - this._timingLastFlush > 250) this._flushTiming(now);
+  }
+
+  _flushTiming(now) {
+    if (this.rtcMode) { this._timingQueue.length = 0; return; }
+    if (!this._timingQueue.length) return;
+    this._timingLastFlush = now;
+    this.send(`CLIENT_FRAME_TIMING ${this._timingQueue.join(";")}`);
+    this._timingQueue.length = 0;
   }
 
   async _onGzControl(buf) {
@@ -346,6 +414,7 @@ class SelkiesClient {
       this.lastAckFid = fid;
       this.framesDrawn++;
       this.send(`CLIENT_FRAME_ACK ${fid}`);
+      this._noteFrameDecoded(fid);
     }
   }
 
@@ -364,6 +433,12 @@ class SelkiesClient {
     const verb = text.slice(0, cut), rest = text.slice(cut + 1);
     switch (verb) {
       case "MODE": break;
+      case "server_clock": {
+        // echo the 4th timestamp back; the server owns estimation
+        this.send(`CLIENT_CLOCK sample,${rest},` +
+                  performance.now().toFixed(3));
+        break;
+      }
       case "server_settings": this._applyServerSettings(rest); break;
       case "system_stats": this._showStats(rest); break;
       case "gpu_stats": this._showGpuStats(rest); break;
@@ -471,8 +546,18 @@ class SelkiesClient {
     this.__drawFps = this.framesDrawn / Math.max(dt, 1e-3);
     this.framesDrawn = 0;
     this.lastStatsT = now;
+    this._flushTiming(now);       // timing remainder at low frame rates
     if (this.videoActive) {
       this.send(`_f,${this.__drawFps.toFixed(1)}`);
+      if (!this.rtcMode && this.sink && this.sink.clientStats) {
+        // decoder-side load: the server's client-overload signal
+        const cs = this.sink.clientStats();
+        if (cs) this.send(`CLIENT_STATS ${JSON.stringify({
+          decode_queue: cs.queue | 0,
+          dropped_decodes: cs.dropped | 0,
+          draw_fps: +this.__drawFps.toFixed(1),
+        })}`);
+      }
       // cold-start UX: the first TPU compile of a new geometry can take
       // minutes — say so instead of leaving a silent black screen
       if (!this.everDrawn && this.videoStartedAt &&

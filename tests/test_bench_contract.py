@@ -151,6 +151,33 @@ def test_bench_ledger_autorecord():
         ["--ledger", _LEDGER, "check", "--warn-only"]) == 0
 
 
+def test_bench_glass_to_glass_block():
+    """ISSUE 7 acceptance: a glass_to_glass block (p50/p99, clock-sync
+    quality) rides the JSON line, and g2g >= server-side e2e for EVERY
+    frame — min_margin_ms is the per-frame floor of (g2g - e2e), so one
+    assertion pins the whole run."""
+    doc = _bench_doc()
+    g = doc["glass_to_glass"]
+    assert g["frames"] > 0
+    assert g["p99_ms"] >= g["p50_ms"] > 0
+    assert g["mean_ms"] > 0
+    # the pin: glass-to-glass can never read better than the server
+    # path it contains
+    assert g["min_margin_ms"] >= 0.0, g
+    # clock-sync quality from the REAL estimator, not a constant
+    clock = g["clock"]
+    assert clock["synced"] is True
+    assert clock["samples"] >= 3 and clock["rejected"] == 0
+    assert clock["error_bound_ms"] is not None \
+        and clock["error_bound_ms"] < 5.0
+    # and the ledger entry carries the g2g trajectory column
+    sys.path.insert(0, str(ROOT))
+    from tools import perf_ledger
+    e = perf_ledger.read_ledger(_LEDGER)[0]
+    assert e["g2g_p99_ms"] == g["p99_ms"]
+    assert e["g2g_p50_ms"] == g["p50_ms"]
+
+
 def test_bench_dead_relay_reports_failed_backend_verdict():
     """The ISSUE 3 acceptance bar (the r04/r05 silent-failure mode):
     a run that fell back from a dead relay is loudly labelled AND

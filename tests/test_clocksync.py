@@ -1,0 +1,137 @@
+"""Clock-sync estimator (obs.clocksync) under injected timelines.
+
+Every test constructs the 4-timestamp exchanges itself — no wall clock,
+no sleeps. The ground truth is an explicit client↔server mapping
+``client_of(server)``; the estimator only ever sees the exchange
+tuples, and the assertions check what it recovered against the truth.
+"""
+
+import json
+
+import pytest
+
+from selkies_tpu.obs.clocksync import (MIN_FIT_SPAN_MS,
+                                       ClockSyncEstimator)
+
+
+def run_exchanges(cs, client_of, t_start=1000.0, n=20, spacing_ms=500.0,
+                  wire_ms=2.0, server_turn_ms=0.1):
+    """Feed n clean pings: client sends at server instant s, the server
+    stamps s+wire and s+wire+turn, the reply lands wire later."""
+    for i in range(n):
+        s = t_start + i * spacing_ms
+        cs.add_sample(client_of(s), s + wire_ms, s + wire_ms + server_turn_ms,
+                      client_of(s + 2 * wire_ms + server_turn_ms))
+
+
+def test_constant_offset_recovered():
+    cs = ClockSyncEstimator()
+    run_exchanges(cs, lambda s: s + 7_500.0)
+    assert cs.synced
+    # offset = server - client = -7500, symmetric wire => near-exact
+    probe = 42_000.0
+    assert cs.to_server_ms(probe + 7_500.0) == pytest.approx(probe, abs=0.1)
+    assert cs.offset_at(probe) == pytest.approx(-7_500.0, abs=0.1)
+    assert cs.drift_ppm == pytest.approx(0.0, abs=5.0)
+
+
+def test_drift_recovered_and_extrapolated():
+    drift = 50e-6      # client crystal runs 50 ppm fast
+
+    def client_of(s):
+        return (s - 1000.0) * (1 + drift) + 3_000.0
+
+    cs = ClockSyncEstimator()
+    run_exchanges(cs, client_of, n=40)
+    # offset(client) slope == -drift/(1+drift) ~ -50 ppm
+    assert cs.drift_ppm == pytest.approx(-50.0, abs=10.0)
+    # extrapolate 10 s past the last sample: a slope-less estimator
+    # would be ~0.5 ms off by now; the fit must stay tight
+    s_future = 1000.0 + 40 * 500.0 + 10_000.0
+    mapped = cs.to_server_ms(client_of(s_future))
+    assert mapped == pytest.approx(s_future, abs=1.0)
+
+
+def test_short_burst_never_invents_drift():
+    """A connection-open burst of pings spans milliseconds; the fit must
+    run slope-0 there instead of amplifying read jitter into phantom
+    ppm (the failure mode that broke the bench margin)."""
+    cs = ClockSyncEstimator()
+    for i in range(8):
+        s = 1000.0 + i * 0.01          # 10 us apart
+        jitter = 0.001 * (-1) ** i
+        cs.add_sample(s + 500.0 + jitter, s + 0.001, s + 0.002,
+                      s + 500.0 + 0.003)
+    assert cs.synced
+    assert cs.drift_ppm == 0.0         # slope-0 below MIN_FIT_SPAN_MS
+    assert 8 * 0.01 < MIN_FIT_SPAN_MS  # the premise of this test
+    mapped = cs.to_server_ms(1000.0 + 60_000.0 + 500.0)
+    assert mapped == pytest.approx(1000.0 + 60_000.0, abs=0.1)
+
+
+def test_min_rtt_filter_rejects_congested_samples():
+    """Congested exchanges carry large, asymmetric RTTs whose offsets
+    are wrong by up to rtt/2; only near-min-RTT samples may vote."""
+    cs = ClockSyncEstimator()
+    run_exchanges(cs, lambda s: s + 100.0, n=10)
+    clean = cs.offset_at(6_000.0)
+    # now a burst of congested samples: 80 ms extra on the return path
+    # only, which skews each sample's offset by -40 ms
+    for i in range(10):
+        s = 20_000.0 + i * 500.0
+        cs.add_sample(s + 100.0, s + 2.0, s + 2.1, s + 100.0 + 84.1)
+    skewed = cs.offset_at(26_000.0)
+    assert skewed == pytest.approx(clean, abs=1.0), \
+        "high-RTT samples must not drag the fit"
+    assert cs.rtt_min_ms == pytest.approx(4.1, abs=0.2)
+
+
+def test_clock_step_resets_window():
+    """Suspend/resume: a credible-RTT sample violently off the fit is a
+    step — history is discarded and the mapping re-converges on the new
+    timebase instead of averaging two incompatible clocks."""
+    cs = ClockSyncEstimator()
+    run_exchanges(cs, lambda s: s + 1_000.0, n=10)
+    assert cs.steps == 0
+    jumped = lambda s: s + 1_000.0 + 30_000.0    # noqa: E731
+    run_exchanges(cs, jumped, t_start=20_000.0, n=5)
+    assert cs.steps == 1
+    probe = 30_000.0
+    assert cs.to_server_ms(jumped(probe)) == pytest.approx(probe, abs=0.5)
+
+
+def test_small_residual_is_not_a_step():
+    cs = ClockSyncEstimator()
+    run_exchanges(cs, lambda s: s + 1_000.0, n=10)
+    s = 20_000.0
+    cs.add_sample(s + 1_000.0 + 5.0, s + 2.0, s + 2.1, s + 1_000.0 + 9.1)
+    assert cs.steps == 0               # 5 ms residual < step_ms
+
+
+def test_negative_rtt_rejected():
+    cs = ClockSyncEstimator()
+    assert cs.add_sample(100.0, 50.0, 60.0, 101.0) is None  # rtt < 0
+    assert cs.add_sample(100.0, 50.0, 50.1, 99.0) is None   # t3 < t0
+    assert cs.rejected == 2
+    assert not cs.synced
+    assert cs.to_server_ms(123.0) is None
+
+
+def test_error_bound_and_quality_export():
+    cs = ClockSyncEstimator()
+    assert cs.error_bound_ms() is None
+    run_exchanges(cs, lambda s: s + 250.0, wire_ms=3.0)
+    b = cs.error_bound_ms()
+    # bound >= rtt_min/2: 6 ms symmetric exchange -> ~3 ms
+    assert b == pytest.approx(3.0, abs=0.01)
+    q = cs.quality()
+    assert q["synced"] is True and q["samples"] == 20
+    assert q["rejected"] == 0 and q["steps"] == 0
+    json.loads(json.dumps(q))          # /api/sessions must round-trip
+
+
+def test_window_is_bounded():
+    cs = ClockSyncEstimator(window=16)
+    run_exchanges(cs, lambda s: s + 10.0, n=100)
+    assert cs.samples_total == 100
+    assert cs.quality()["samples"] == 16

@@ -6,6 +6,8 @@ bucket overrides, the qoe trace lane, and log correlation."""
 import json
 import logging
 
+import pytest
+
 from selkies_tpu.obs import health as H
 from selkies_tpu.obs import logctx, qoe
 from selkies_tpu.server import metrics
@@ -348,3 +350,118 @@ def test_logctx_plain_session_tag():
         log.propagate = True
     assert records[0] == "INFO: [:0#3] hello"
     assert records[1] == "INFO: bye"
+
+
+# ---------------------------------------------------------------- g2g plane
+def _synced_session(offset_ms=500.0):
+    """Session whose clock estimator learned `client = server + offset`
+    from injected exchanges (server instants are plain floats here —
+    nothing reads the wall clock)."""
+    st = qoe.SessionStats(1, "ws", "seat0", now=0.0)
+    for i in range(5):
+        s = 1000.0 + i * 500.0
+        st.clock.add_sample(s + offset_ms, s + 1.0, s + 1.1,
+                            s + offset_ms + 2.1)
+    return st
+
+
+def test_note_frame_timing_requires_sync():
+    st = qoe.SessionStats(1, "ws", "seat0", now=0.0)
+    assert st.note_frame_timing(1, 10.0, 11.0, 12.0) is None
+    assert st.g2g_percentiles()["n"] == 0
+
+
+def test_note_frame_timing_maps_and_builds_g2g(monkeypatch):
+    import time as _time
+    st = _synced_session(offset_ms=500.0)
+    # pin the send-side perf_counter read so g2g is exact
+    monkeypatch.setattr(_time, "perf_counter_ns",
+                        lambda: int(5000.0 * 1e6))
+    st.note_sent(7, 123.0)                  # records send at 5000.0 ms
+    # client saw the frame at server 5010/5012/5016 (client = s + 500)
+    m = st.note_frame_timing(7, 5510.0, 5512.0, 5516.0)
+    assert m is not None
+    assert m["send_ms"] == 5000.0
+    assert m["recv_ms"] == pytest.approx(5010.0, abs=1.0)
+    assert m["present_ms"] == pytest.approx(5016.0, abs=1.0)
+    assert m["g2g_ms"] == pytest.approx(16.0, abs=1.0)
+    p = st.g2g_percentiles()
+    assert p["n"] == 1 and p["p99_ms"] == pytest.approx(16.0, abs=1.0)
+    snap = st.snapshot(now=1.0, verbose=True)
+    assert snap["g2g_p99_ms"] == p["p99_ms"]
+    assert snap["g2g"]["frames_timed"] == 1
+    assert snap["clock"]["synced"] is True
+
+
+def test_note_frame_timing_unknown_fid_has_no_g2g(monkeypatch):
+    import time as _time
+    st = _synced_session(offset_ms=500.0)
+    monkeypatch.setattr(_time, "perf_counter_ns",
+                        lambda: int(6010.0 * 1e6))   # plausibility anchor
+    m = st.note_frame_timing(999, 6500.0, 6501.0, 6502.0)
+    assert m is not None and m["send_ms"] is None and m["g2g_ms"] is None
+    assert st.g2g_percentiles()["n"] == 0
+    assert st.frames_timed == 1
+
+
+def test_note_frame_timing_clamps_monotone(monkeypatch):
+    """Mapping jitter must never produce a negative decode/present
+    span: out-of-order client stamps clamp to monotone."""
+    import time as _time
+    st = _synced_session(offset_ms=500.0)
+    monkeypatch.setattr(_time, "perf_counter_ns",
+                        lambda: int(5520.0 * 1e6))
+    m = st.note_frame_timing(1, 6010.0, 6005.0, 6000.0)
+    assert m["recv_ms"] <= m["decode_ms"] <= m["present_ms"]
+
+
+def test_client_stats_sanitised():
+    st = qoe.SessionStats(1, "ws", "seat0", now=0.0)
+    st.note_client_stats({"decode_queue": 3, "dropped_decodes": 1.0,
+                          "draw_fps": 59.94, "evil": {"a": 1},
+                          "huge": 1e300})
+    assert st.client_stats == {"decode_queue": 3.0, "dropped_decodes": 1.0,
+                               "draw_fps": 59.94}
+    st.note_client_stats({"nothing": "useful"})
+    assert st.client_stats["decode_queue"] == 3.0   # last good kept
+
+
+def test_note_frame_timing_counts_present_before_send(monkeypatch):
+    """Clock-sync bias (up to rtt/2) can map a fast frame's present
+    BEFORE its send anchor. The drop must be counted, not silent —
+    selectively losing the fastest frames biases p50 upward with
+    nothing in /api/sessions explaining why."""
+    import time as _time
+    st = _synced_session(offset_ms=500.0)
+    monkeypatch.setattr(_time, "perf_counter_ns",
+                        lambda: int(5000.0 * 1e6))
+    st.note_sent(7, 123.0)                  # send anchor at 5000.0 ms
+    # client claims present at server 4995 ms — 5 ms before the send
+    m = st.note_frame_timing(7, 5493.0, 5494.0, 5495.0)
+    assert m is not None and m["g2g_ms"] is None
+    assert st.g2g_percentiles()["n"] == 0
+    assert st.timing_rejected == 1
+    assert st.frames_timed == 1
+
+
+def test_note_frame_timing_rejects_implausible_timestamps(monkeypatch):
+    """A finite-but-absurd client timestamp passes the parser; the
+    plausibility gate must drop it before it poisons percentiles, the
+    shared histogram, the g2g SLO, or the trace envelope."""
+    import time as _time
+    st = _synced_session(offset_ms=0.0)
+    now_ms = 10_000.0
+    monkeypatch.setattr(_time, "perf_counter_ns",
+                        lambda: int(now_ms * 1e6))
+    st.note_sent(7, 0.0)
+    # presented "years in the future"
+    assert st.note_frame_timing(7, 9_000.0, 9_001.0, 1e11) is None
+    # ...and in the distant past
+    assert st.note_frame_timing(7, -1e11, -1e11, -1e11) is None
+    assert st.timing_rejected == 2
+    assert st.g2g_percentiles()["n"] == 0
+    snap = st.snapshot(now=1.0, verbose=True)
+    assert snap["g2g"]["rejected"] == 2
+    # a plausible report for the same fid still lands
+    m = st.note_frame_timing(7, 9_990.0, 9_995.0, 10_000.0)
+    assert m is not None and st.g2g_percentiles()["n"] == 1

@@ -1157,3 +1157,241 @@ async def test_relay_send_span_attaches_to_frame_timeline():
     finally:
         tracer.disable()
         tracer.clear()
+
+
+# --------------------------------------------------------------------------
+# Glass-to-glass plane (ISSUE 7): clock exchange, frame-timing join, SLO
+# surface, and the malformed-command hardening.
+# --------------------------------------------------------------------------
+
+def _pc_ms():
+    import time as _time
+    return _time.perf_counter_ns() / 1e6
+
+
+async def _sync_clock(ws, n=3):
+    """Run n CLIENT_CLOCK exchanges; the test process IS the client, so
+    its 'client clock' is the server's perf_counter (offset ~0) and
+    mapped timestamps can be asserted against perf_counter directly."""
+    for i in range(n):
+        await ws.send_str(f"CLIENT_CLOCK ping,{i},{_pc_ms():.3f}")
+        reply = await asyncio.wait_for(ws.receive_str(), 5)
+        assert reply.startswith("server_clock ")
+        await ws.send_str(
+            f"CLIENT_CLOCK sample,{reply.split(' ', 1)[1]},{_pc_ms():.3f}")
+    await asyncio.sleep(0.05)
+
+
+async def test_clock_sync_exchange_and_sessions_export(client_factory):
+    """CLIENT_CLOCK ping -> server_clock reply -> sample feeds the
+    session's estimator; quality lands in /api/sessions?verbose=1."""
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await _sync_clock(ws)
+    r = await c.get("/api/sessions?verbose=1")
+    v = (await r.json())["sessions"][0]
+    assert v["clock"]["synced"] is True
+    assert v["clock"]["samples"] == 3
+    # same process, same physical clock: offset must read ~0
+    assert abs(v["clock"]["offset_ms"]) < 50.0
+    assert v["clock"]["rtt_min_ms"] is not None
+    await ws.close()
+
+
+async def test_frame_timing_joins_g2g_trace_and_slo(client_factory):
+    """The tentpole round-trip: a timed frame becomes a per-session g2g
+    sample, client-lane spans on /api/trace (with the frame envelope
+    extended past ws.send), and a g2g SLO event."""
+    from selkies_tpu.obs import slo as _slo
+    from selkies_tpu.trace import tracer
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await _sync_clock(ws)
+    g2g_before = _slo.engine.get("g2g").good_total
+
+    tracer.enable(capacity=32)
+    try:
+        await ws.send_str("START_VIDEO")
+        got = None
+        for _ in range(10):
+            msg = await asyncio.wait_for(ws.receive(), 5)
+            if msg.type == WSMsgType.BINARY and msg.data[0] == P.OP_JPEG:
+                got = msg.data
+                break
+        assert got is not None
+        _, fid, _ = P.unpack_jpeg_header(got)
+        recv = _pc_ms()
+        # the fake capture emitted before tracing was on for this frame;
+        # give the frame a closed timeline the client spans can join
+        tl = tracer.frame_begin(":0")
+        tracer.bind(tl, fid)
+        tracer.frame_end(":0", fid)
+        t1_closed = tl.t1_ns
+        await ws.send_str(
+            f"CLIENT_FRAME_TIMING {fid}:{recv:.2f}:{recv + 2.5:.2f}:"
+            f"{recv + 4.0:.2f}")
+        await asyncio.sleep(0.1)
+
+        # g2g sample in the session snapshot
+        r = await c.get("/api/sessions?verbose=1")
+        v = (await r.json())["sessions"][0]
+        assert v["g2g"]["n"] == 1 and v["g2g"]["p99_ms"] > 0
+        assert v["g2g_p99_ms"] == v["g2g"]["p99_ms"]
+
+        # client lane on the trace doc, envelope extended to present
+        r = await c.get("/api/trace")
+        doc = await r.json()
+        lanes = [e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"]
+        assert "client" in lanes
+        names = {e["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert {"net", "client.decode", "client.present"} <= names
+        assert tl.t1_ns > t1_closed, "frame envelope must extend to present"
+
+        # one g2g SLO event recorded (well under the 250 ms budget)
+        assert _slo.engine.get("g2g").good_total == g2g_before + 1
+        r = await c.get("/api/slo")
+        slo_doc = await r.json()
+        assert slo_doc["status"] == "ok"
+        assert {d["name"] for d in slo_doc["slos"]} == {"fps", "g2g", "qoe"}
+    finally:
+        tracer.disable()
+        tracer.clear()
+    await ws.close()
+
+
+async def test_slo_feed_skips_idle_sessions(client_factory):
+    """Damage gating means a static desktop legitimately delivers no
+    frames; an fps/qoe bad event per tick for such a session would burn
+    the error budget — and page — on a perfectly healthy system."""
+    import time as _time
+
+    from selkies_tpu.obs import slo as _slo
+    server, svc, fake, _ = make_app(stats_interval_s=0.1)
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str("START_VIDEO")
+    for _ in range(10):
+        msg = await asyncio.wait_for(ws.receive(), 5)
+        if msg.type == WSMsgType.BINARY and msg.data[0] == P.OP_JPEG:
+            break
+    await ws.send_str("_f,1.0")            # a terrible client fps
+    await asyncio.sleep(0.05)
+    cc = next(iter(svc.clients.values()))
+    fps_slo = _slo.engine.get("fps")
+    # age the delivery stamp past the idle horizon: nobody is painting
+    cc.qoe.last_send_mono = _time.monotonic() - 10.0
+    before = (fps_slo.good_total, fps_slo.bad_total)
+    await asyncio.sleep(0.35)              # >= 2 stats ticks
+    assert (fps_slo.good_total, fps_slo.bad_total) == before
+    # fresh delivery re-enables the feed (and records the bad fps)
+    fake.emit()
+    await asyncio.sleep(0.35)
+    assert fps_slo.bad_total > before[1]
+    await ws.close()
+
+
+async def test_api_slo_flips_under_g2g_regression(client_factory):
+    """ISSUE 7 acceptance: the burn-rate verdict flips failed under an
+    injected g2g regression — injected event stamps, zero sleeps."""
+    import time as _time
+
+    from selkies_tpu.obs import slo as _slo
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    r = await c.get("/api/slo")
+    doc = await r.json()
+    assert doc["status"] == "ok"
+    g2g = next(d for d in doc["slos"] if d["name"] == "g2g")
+    assert g2g["burn_fast"] is None        # no events yet
+
+    now = _time.monotonic()
+    _slo.engine.record("g2g", good=True, n=50, now=now - 10.0)
+    _slo.engine.record("g2g", good=False, n=450, now=now)
+    r = await c.get("/api/slo")
+    doc = await r.json()
+    assert doc["status"] == "failed"
+    g2g = next(d for d in doc["slos"] if d["name"] == "g2g")
+    assert g2g["status"] == "failed"
+    assert g2g["burn_fast"] > g2g["burn_threshold"]
+    assert g2g["budget_remaining"] == 0.0
+    # the slo health check carries the verdict + a slo_burn incident
+    r = await c.get("/api/health?verbose=1")
+    body = await r.json()
+    assert body["checks"]["slo"]["status"] == "failed"
+    assert "g2g" in body["checks"]["slo"]["reason"]
+    assert any(e["kind"] == "slo_burn" for e in body["incidents"])
+
+
+async def test_malformed_protocol_messages_counted_and_dropped(
+        client_factory):
+    """ISSUE 7 satellite: any malformed ACK/timing/clock/stats token
+    increments selkies_protocol_errors_total{kind} and is dropped; the
+    receive loop survives and keeps answering."""
+    from selkies_tpu.server import metrics
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+
+    cases = [
+        ("CLIENT_FRAME_ACK,notanint", "client_frame_ack"),
+        ("CLIENT_FRAME_TIMING abc:1:2:3", "client_frame_timing"),
+        ("CLIENT_FRAME_TIMING 1:2:3", "client_frame_timing"),
+        ("CLIENT_FRAME_TIMING ", "client_frame_timing"),
+        ("CLIENT_FRAME_TIMING 1:nan:2:3", "client_frame_timing"),
+        ("CLIENT_FRAME_TIMING 7:1:2:3;8:9", "client_frame_timing"),
+        ("CLIENT_CLOCK ping,1", "client_clock"),
+        ("CLIENT_CLOCK sample,1,2,3", "client_clock"),
+        ("CLIENT_CLOCK bogus,1,2,3", "client_clock"),
+        ("CLIENT_CLOCK ping,1,inf", "client_clock"),
+        ("CLIENT_STATS notjson", "client_stats"),
+        ("CLIENT_STATS [1,2]", "client_stats"),
+        # deep nesting raises RecursionError, not ValueError — it must
+        # be counted+dropped, not tear down the receive loop
+        ("CLIENT_STATS " + "[" * 100_000, "client_stats"),
+        # a well-formed sample that echoes no outstanding ping: the
+        # estimator must not trust client-fabricated server stamps
+        ("CLIENT_CLOCK sample,77,1.0,2.0,3.0,4.0", "client_clock"),
+    ]
+    before = {k: metrics.counter_value("selkies_protocol_errors_total",
+                                       {"kind": k})
+              for _, k in cases}
+    for text, _kind in cases:
+        await ws.send_str(text)
+    # a valid exchange after the garbage proves the loop survived
+    await ws.send_str(f"CLIENT_CLOCK ping,99,{_pc_ms():.3f}")
+    reply = await asyncio.wait_for(ws.receive_str(), 5)
+    assert reply.startswith("server_clock 99,")
+
+    from collections import Counter
+    want = Counter(k for _, k in cases)
+    for kind, n in want.items():
+        got = metrics.counter_value("selkies_protocol_errors_total",
+                                    {"kind": kind})
+        assert got == before[kind] + n, (kind, got, before[kind], n)
+    await ws.close()
+
+
+async def test_client_stats_surface_in_sessions(client_factory):
+    """CLIENT_STATS (decoder queue depth, dropped decodes) lands in the
+    verbose session snapshot — and hostile fields do not."""
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str(); await ws.receive_str()
+    await ws.send_str('CLIENT_STATS {"decode_queue": 7, '
+                      '"dropped_decodes": 3, "draw_fps": 58.5, '
+                      '"evil": "x", "huge": 1e300}')
+    await asyncio.sleep(0.1)
+    r = await c.get("/api/sessions?verbose=1")
+    v = (await r.json())["sessions"][0]
+    assert v["client"] == {"decode_queue": 7.0, "dropped_decodes": 3.0,
+                           "draw_fps": 58.5}
+    await ws.close()

@@ -97,6 +97,58 @@ def test_js_braces_balanced():
                 f"({text.count(o)} vs {text.count(c)})")
 
 
+def test_timing_batch_format_round_trips():
+    """ISSUE 7: the batch format the JS emits
+    (``fid:recv:decode:present;...``, toFixed(2) floats) parses through
+    protocol.parse_frame_timing — built here exactly as the client
+    builds it, so a format drift on either side breaks this test."""
+    from selkies_tpu import protocol as P
+
+    # mirror selkies-client.js _noteFramePresented: per-entry template
+    # `${fid}:${recv.toFixed(2)}:${decode.toFixed(2)}:${present.toFixed(2)}`
+    entries = [(17, 1001.5, 1003.25, 1011.0),
+               (18, 1017.33, 1018.0, 1019.99)]
+    batch = ";".join(f"{fid}:{r:.2f}:{d:.2f}:{p:.2f}"
+                     for fid, r, d, p in entries)
+    parsed = P.parse_frame_timing(batch)
+    assert parsed == [(17, 1001.5, 1003.25, 1011.0),
+                      (18, 1017.33, 1018.0, 1019.99)]
+    # and the JS really does emit that shape
+    js = (WEB / "selkies-client.js").read_text()
+    assert "CLIENT_FRAME_TIMING ${this._timingQueue.join(\";\")}" in js
+    assert re.search(
+        r"\$\{fid\}:\$\{e\.recv\.toFixed\(2\)\}", js), \
+        "timing entry template drifted from fid:recv:decode:present"
+
+
+def test_timing_parser_rejects_malformed_batches():
+    import pytest
+
+    from selkies_tpu import protocol as P
+    for bad in ("", "abc:1:2:3", "1:2:3", "1:nan:2:3", "1:inf:2:3",
+                "5:1:2:3;6:7", ";".join("1:2:3:4" for _ in range(65))):
+        with pytest.raises(ValueError):
+            P.parse_frame_timing(bad)
+
+
+def test_client_speaks_the_glass_to_glass_protocol():
+    """Static wiring checks: clock ping loop, server_clock echo, frame
+    receive/decode/present capture, CLIENT_STATS from the sink."""
+    js = (WEB / "selkies-client.js").read_text()
+    assert "CLIENT_CLOCK ping," in js
+    assert "CLIENT_CLOCK sample," in js
+    assert '"server_clock"' in js
+    assert "requestVideoFrameCallback" in js
+    assert "CLIENT_STATS" in js and "clientStats" in js
+    # the decoder-load counters the stats ride on
+    core = (WEB / "lib" / "stripe-core.js").read_text()
+    assert "droppedDecodes" in core and "function stats()" in core
+    worker = (WEB / "lib" / "video-worker.js").read_text()
+    assert '"cstats"' in worker
+    video = (WEB / "lib" / "video.js").read_text()
+    assert video.count("clientStats()") >= 2   # worker sink + fallback
+
+
 async def test_server_serves_module_assets(client_factory):
     s = AppSettings.parse([], {})
     svc = WebSocketsService(s, input_handler=InputHandler(

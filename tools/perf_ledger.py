@@ -122,6 +122,8 @@ def entry_from_bench(doc: dict, *, git_rev: Optional[str] = None,
         "stages_ms": doc.get("stages_ms"),
         "stage_sum_ms": doc.get("stage_sum_ms"),
         "qoe_score": (doc.get("qoe") or {}).get("score"),
+        "g2g_p50_ms": (doc.get("glass_to_glass") or {}).get("p50_ms"),
+        "g2g_p99_ms": (doc.get("glass_to_glass") or {}).get("p99_ms"),
         "occupancy": doc.get("occupancy"),
         "perf_steps": {
             s["name"]: {"roofline_ms": s["roofline_ms"],
@@ -318,7 +320,8 @@ def cmd_report(args: argparse.Namespace) -> int:
     for key, runs in sorted(by_key.items(), key=lambda kv: str(kv[0])):
         print(f"== {' / '.join(str(k) for k in key)} ({len(runs)} runs)")
         print(f"   {'date':<20} {'rev':<8} {'backend':<24} {'fps':>7} "
-              f"{'p50_ms':>9} {'p99_ms':>9} {'ok':>3}  top stage")
+              f"{'p50_ms':>9} {'p99_ms':>9} {'g2g_p99':>9} {'ok':>3}  "
+              f"top stage")
         for e in runs:
             print(f"   {str(e.get('ts', ''))[:19]:<20} "
                   f"{str(e.get('git_rev', ''))[:7]:<8} "
@@ -326,13 +329,14 @@ def cmd_report(args: argparse.Namespace) -> int:
                   f"{e.get('fps') if e.get('fps') is not None else '-':>7} "
                   f"{e.get('latency_p50_ms') or '-':>9} "
                   f"{e.get('latency_p99_ms') or '-':>9} "
+                  f"{e.get('g2g_p99_ms') or '-':>9} "
                   f"{'y' if e.get('baseline_eligible') else 'n':>3}  "
                   f"{_top_stage(e)}")
         out_doc["keys"].append({
             "key": list(key),
             "runs": [{k: e.get(k) for k in
                       ("ts", "git_rev", "backend", "fps",
-                       "latency_p50_ms", "latency_p99_ms",
+                       "latency_p50_ms", "latency_p99_ms", "g2g_p99_ms",
                        "baseline_eligible", "stages_ms")}
                      for e in runs]})
     if args.json:
