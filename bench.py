@@ -46,6 +46,15 @@ clock and reports per-frame timing the same way a browser's
 (g2g − server e2e) as ``min_margin_ms`` (contract: ≥ 0), and the
 clock-sync quality (offset, drift, error bound).
 
+Compile plane (selkies_tpu/prewarm, ISSUE 8): the JSON line carries a
+``prewarm`` block (ladder-reachable lattice size, programs warm after
+this run, deferred transitions), and ``--chaos`` grows a
+``compile_storm`` phase proving a ladder downscale under an injected
+20 s compile (``encoder.compile:slow``) defers instead of freezing the
+frame loop and lands compile-free once the background warm finishes
+(knobs: BENCH_CHAOS_COMPILE_DELAY_S, BENCH_CHAOS_STORM_BUDGET_S,
+BENCH_CHAOS_STORM=0 to skip).
+
 Perf observability (selkies_tpu/obs/perf, ISSUE 6): the JSON line
 carries a ``perf`` block (per compiled step: flops, HBM bytes accessed,
 roofline-ms at ~800 GB/s, recorded at compile time — plus the parsed
@@ -422,6 +431,33 @@ def main(force_cpu: bool = False) -> None:
             f"file(s), device={prof_table['device']}, "
             f"steps={list(prof_table['steps'])}")
 
+    # prewarm block (ISSUE 8): the compile-plane view of this run — the
+    # ladder-reachable program lattice for this operating point, and how
+    # much of it THIS process already compiled (adopted from the perf
+    # registry: the engine steps the run built are warm by definition).
+    # No ladder runs in the headline bench, so deferred_transitions is 0
+    # here; the chaos compile-storm scenario carries the real count.
+    import types as _types
+
+    from selkies_tpu.prewarm import plan as _pplan
+    from selkies_tpu.prewarm.lattice import lattice_from_settings
+    from selkies_tpu.prewarm.worker import PrewarmWorker
+    _lat = lattice_from_settings(_types.SimpleNamespace(
+        encoder=("h264-tpu-striped" if codec == "h264" else "jpeg-tpu"),
+        initial_width=w, initial_height=h, tpu_seats=1,
+        fullcolor=False, stripe_height=64, use_damage_gating=True,
+        use_paint_over=False))
+    _pworker = PrewarmWorker(_lat)
+    _pworker.mark_warm_from_names(
+        {s["name"] for s in perf_doc["steps"] if not s.get("error")},
+        _pplan.program_names)
+    _pc = _pworker.counts()
+    prewarm_doc = {"lattice_size": _pc["lattice_size"],
+                   "warmed": _pc["warmed"],
+                   "deferred_transitions": 0}
+    log(f"prewarm: {_pc['warmed']}/{_pc['lattice_size']} lattice "
+        f"programs warm after this run")
+
     # device telemetry for the JSON line: HBM peak (forced sample — the
     # timed loops are over, the RPC can't skew anything now), compile
     # accounting, and the backend health verdict (the contract test's
@@ -493,6 +529,7 @@ def main(force_cpu: bool = False) -> None:
         "compile_cache_misses": compile_stats["cache_misses"],
         "qoe": qoe_doc,
         "glass_to_glass": g2g_doc,
+        "prewarm": prewarm_doc,
         "perf": perf_doc,
         "occupancy": occupancy_doc,
         **({"profile_dir": profile_dir} if profile_dir else {}),
@@ -653,6 +690,149 @@ async def _chaos_run(target_fps: float, w: int, h: int) -> dict:
     }
 
 
+async def _chaos_compile_storm(w: int, h: int) -> dict:
+    """Compile-plane contract (ISSUE 8): under an injected slow compile
+    (``encoder.compile:slow``, default 20 s — the real 1080p build
+    cost), a ladder downscale transition must never block the frame
+    loop on a compile. The pre-warm worker eats the slow build in the
+    BACKGROUND while the ladder defers (``transition_deferred``
+    incident, session keeps encoding at the current rung); once warm,
+    the switch lands and the rebuilt session's first frame dispatches a
+    ready executable — zero foreground compiles across the switch
+    window, and the frame loop's worst inter-chunk gap stays far below
+    the injected compile cost."""
+    import asyncio
+    import types as _types
+
+    from selkies_tpu.engine.capture import ScreenCapture
+    from selkies_tpu.engine.types import CaptureSettings
+    from selkies_tpu.obs import health as _health
+    from selkies_tpu.obs import monitor as _devmon
+    from selkies_tpu.prewarm.lattice import lattice_from_settings
+    from selkies_tpu.prewarm.worker import PrewarmGate, PrewarmWorker
+    from selkies_tpu.resilience import faults as _faults
+    from selkies_tpu.resilience.ladder import DegradationLadder
+
+    loop = asyncio.get_running_loop()
+    eng = _health.engine
+    delay_s = float(os.environ.get("BENCH_CHAOS_COMPILE_DELAY_S", "20"))
+    budget = float(os.environ.get("BENCH_CHAOS_STORM_BUDGET_S", "90"))
+    target_fps = 30.0
+    tw, th = max(64, w // 2), max(64, h // 2)
+
+    _faults.registry.disarm()
+    _faults.registry.arm(
+        f"encoder.compile:slow:delay_s={delay_s:g},count=100")
+
+    lat = lattice_from_settings(_types.SimpleNamespace(
+        encoder="jpeg-tpu", initial_width=w, initial_height=h,
+        tpu_seats=1, fullcolor=False, stripe_height=64,
+        use_damage_gating=True, use_paint_over=False),
+        steps=("downscale",))
+    worker = PrewarmWorker(lat, recorder=eng.recorder,
+                           storm_check=_devmon.storm_recent)
+    worker.note_operating_point(w, h)
+    gate = PrewarmGate(worker, lat.rung_targets)
+
+    # the live frame loop whose liveness is the whole point: gaps are
+    # measured over the DEFERRAL window (old session encoding while the
+    # injected slow build runs in the background) — a foreground compile
+    # would show up here as a delay_s-sized hole
+    gaps: list = []
+    state: dict = {"last": None, "switched_at": None,
+                   "switched_wall": None, "landed_at": None}
+
+    def on_chunk(chunk) -> None:
+        now = time.monotonic()
+        if state["switched_at"] is None and state["last"] is not None:
+            gaps.append(now - state["last"])
+        state["last"] = now
+        if state["switched_at"] is not None \
+                and state["landed_at"] is None and chunk.width < w:
+            # first chunk from the rebuilt (downscaled) session
+            state["landed_at"] = now
+
+    cap = ScreenCapture("synthetic")
+    settings = CaptureSettings(
+        capture_width=w, capture_height=h, output_mode="jpeg",
+        jpeg_quality=40, target_fps=target_fps, display_id="storm0",
+        stripe_height=64, use_damage_gating=True, use_paint_over=False)
+    await loop.run_in_executor(
+        None, lambda: cap.start_capture(on_chunk, settings))
+
+    def scale_down():
+        state["switched_at"] = time.monotonic()
+        state["switched_wall"] = time.time()
+        # off-loop like the ws actuator: the session rebuild joins the
+        # capture thread
+        loop.run_in_executor(
+            None, lambda: cap.update_capture_region(0, 0, tw, th))
+
+    ladder = DegradationLadder(
+        steps=("downscale",), down_after_s=0.3, hold_s=0.5,
+        ok_window_s=600.0, gate=gate, defer_deadline_s=1.0,
+        recorder=eng.recorder)
+    ladder.bind_controls({"downscale": (scale_down, lambda: None)})
+
+    # background pre-warm starts AFTER the frame loop is live so the
+    # injected slow build demonstrably overlaps real encoding
+    t0 = time.monotonic()
+    worker.start()
+    deadline = t0 + budget
+    while time.monotonic() < deadline:
+        await asyncio.sleep(0.2)
+        ladder.observe({"qoe": _health.FAILED})
+        if state["landed_at"] is not None \
+                and time.monotonic() - state["landed_at"] > 1.0:
+            break
+    warm_wait_s = None
+    snap = worker.snapshot()
+    for e in snap["entries"]:
+        if e["geometry"] == f"{tw}x{th}" and e["seconds"] is not None:
+            warm_wait_s = e["seconds"]
+    await loop.run_in_executor(None, cap.stop_capture)
+    worker.stop()
+    _faults.registry.disarm()
+
+    landed = state["landed_at"] is not None
+    # foreground compiles = lattice programs whose static analysis
+    # (recorded at compile time by obs.perf) did NOT exist before the
+    # switch — the synthetic source's tiny frame-generator jit is not a
+    # lattice program and must not read as a foreground encoder compile
+    foreground = None
+    if landed:
+        from selkies_tpu.obs import perf as _perf
+        from selkies_tpu.prewarm import plan as _pplan
+        target = next(s for s in lat.signatures
+                      if (s.width, s.height) == (tw, th))
+        entries = {e["name"]: e
+                   for e in _perf.registry.report()["steps"]}
+        foreground = sum(
+            1 for n in _pplan.program_names(target)
+            if n not in entries or entries[n].get("error")
+            or entries[n]["recorded_at"] >= state["switched_wall"])
+    doc = {
+        "delay_s": delay_s,
+        "deferred_transitions": ladder.deferred_transitions,
+        "landed": landed,
+        "ladder_level": ladder.level,
+        "background_compile_s": warm_wait_s,
+        "switch_ms": round((state["landed_at"] - state["switched_at"])
+                           * 1e3, 1) if landed else None,
+        "foreground_compiles": foreground,
+        "frame_gap_max_ms": round(max(gaps) * 1e3, 1) if gaps else None,
+        "prewarm": {k: snap[k] for k in ("lattice_size", "warmed",
+                                         "pending", "failed")},
+    }
+    log(f"compile-storm: deferred={doc['deferred_transitions']} "
+        f"landed={landed} switch={doc['switch_ms']}ms "
+        f"foreground_compiles={doc['foreground_compiles']} "
+        f"max_frame_gap={doc['frame_gap_max_ms']}ms "
+        f"(injected compile {delay_s:g}s, background "
+        f"{warm_wait_s}s)")
+    return doc
+
+
 def chaos_main(force_cpu: bool = False) -> None:
     """``--chaos``: prove the resilience plane recovers every injected
     fault. Prints ONE JSON line (same contract as the headline bench)."""
@@ -679,6 +859,11 @@ def chaos_main(force_cpu: bool = False) -> None:
 
     t0 = time.monotonic()
     chaos = asyncio.run(_chaos_run(target_fps, w, h))
+    # phase 2 (ISSUE 8): the compile-plane contract — a ladder downscale
+    # under an injected 20 s compile defers instead of freezing the
+    # frame loop, and lands compile-free once the background warm is in
+    if os.environ.get("BENCH_CHAOS_STORM", "1") != "0":
+        chaos["compile_storm"] = asyncio.run(_chaos_compile_storm(w, h))
     dt = time.monotonic() - t0
 
     _devmon.platform = backend

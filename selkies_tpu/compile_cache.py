@@ -54,21 +54,46 @@ def host_fingerprint(device_kind: str | None = None) -> str:
     return fp
 
 
+def cache_root() -> str:
+    """The un-fingerprinted cache root (``JAX_CACHE_DIR`` or the
+    repo-local ``.jax_cache``) — the directory warm-cache artifacts
+    (selkies_tpu/prewarm/artifact.py) unpack fingerprint subtrees
+    into."""
+    return os.path.abspath(os.environ.get(
+        "JAX_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     os.pardir, ".jax_cache")))
+
+
+def cache_dir(device_kind: str | None = None) -> str:
+    """This host's fingerprint-keyed cache directory (what ``enable``
+    points jax at, and what ``warm_cache.py pack`` tars up)."""
+    return os.path.join(cache_root(), host_fingerprint(device_kind))
+
+
 def enable(jax_module=None, device_kind: str | None = None) -> str:
     """Configure the persistent compilation cache; returns the dir used.
     Safe to call any time (before or after backend init)."""
     if jax_module is None:
         import jax as jax_module
-    cache = os.environ.get(
-        "JAX_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     os.pardir, ".jax_cache"))
-    cache = os.path.join(os.path.abspath(cache),
-                         host_fingerprint(device_kind))
+    cache = cache_dir(device_kind)
     try:
         jax_module.config.update("jax_compilation_cache_dir", cache)
         jax_module.config.update(
             "jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:
+        pass
+    try:
+        # With the persistent cache on, jax embeds ABSOLUTE paths under
+        # the cache dir (xla_gpu_kernel_cache_file /
+        # xla_gpu_per_fusion_autotune_cache_dir) into the compile
+        # options that feed the cache KEY — so entries only ever hit
+        # from the exact same directory path, and a warm-cache artifact
+        # (selkies_tpu/prewarm/artifact.py) unpacked anywhere else
+        # misses 100%. These are GPU-only side caches; disable them so
+        # keys are relocatable across hosts and checkout paths.
+        jax_module.config.update(
+            "jax_persistent_cache_enable_xla_caches", "")
     except Exception:
         pass
     return cache

@@ -68,6 +68,22 @@ def _plan_grid(s: CaptureSettings) -> _Grid:
 plan_grid = _plan_grid  # public name for the parallel / h264 modules
 
 
+def jpeg_buffer_caps(g: _Grid, fullcolor: bool) -> tuple[int, int, int]:
+    """(e_cap, w_cap, out_cap) for a grid — shared by the single-seat
+    session, the seat-sharded encoder and the pre-warm planner
+    (selkies_tpu/prewarm/plan.py) so the sizing policy cannot diverge:
+    a pre-warm that sized its buffers differently would compile a
+    program no session ever calls. e_cap is the TRUE worst case (one
+    event per coefficient slot: 1.5x pixels for 4:2:0, 3x for 4:4:4) so
+    event overflow is impossible; only the word/output buffers can
+    overflow, and those are growable."""
+    stripe_px = g.stripe_h * g.width
+    e_cap = stripe_px * (3 if fullcolor else 2)
+    w_cap = stripe_px // 2
+    out_cap = max(256 * 1024, stripe_px * g.n_stripes // 8)
+    return e_cap, w_cap, out_cap
+
+
 def build_step_fn(width: int, stripe_h: int, n_stripes: int, subsampling: str,
                   e_cap: int, w_cap: int, out_cap: int, paint_delay: int,
                   damage_gating: bool, paint_over: bool):
@@ -118,14 +134,22 @@ def build_step_fn(width: int, stripe_h: int, n_stripes: int, subsampling: str,
     return step
 
 
-@functools.cache
+@functools.lru_cache(maxsize=32)
 def _jitted_step(width: int, stripe_h: int, n_stripes: int, subsampling: str,
                  e_cap: int, w_cap: int, out_cap: int, paint_delay: int,
                  damage_gating: bool, paint_over: bool):
     """Compiled single-seat step; only the internal ``age`` state is donated
     — ``prev`` is the caller's previous frame array and sources are free to
     reuse their buffers. Wrapped for static cost attribution (obs.perf):
-    flops / HBM bytes / roofline-ms are recorded at compile time."""
+    flops / HBM bytes / roofline-ms are recorded at compile time.
+
+    Bounded LRU (not ``functools.cache``): runtime geometry retargeting
+    (ladder downscale, resizes, overflow growth) mints a fresh factory
+    key per visit — an unbounded cache would pin every dead geometry's
+    compiled executable forever. Live sessions hold their own reference;
+    a re-built evicted geometry re-compiles through the persistent
+    cache. The pre-warm planner (selkies_tpu/prewarm/plan.py) calls this
+    SAME factory, so a warmed step is the object a later session gets."""
     return _perf.wrap_step(
         f"jpeg.step[{width}x{stripe_h * n_stripes}@{subsampling}]",
         jax.jit(build_step_fn(width, stripe_h, n_stripes, subsampling,
@@ -143,14 +167,9 @@ class JpegEncoderSession:
         self.grid = _plan_grid(settings)
         self.subsampling = "444" if settings.fullcolor else "420"
         g = self.grid
-        stripe_px = g.stripe_h * g.width
-        # e_cap is the TRUE worst case (one event per coefficient slot:
-        # 1.5x pixels for 4:2:0, 3x for 4:4:4) so event overflow is
-        # impossible; only the word/output buffers can overflow, and those
-        # are growable. HBM is cheap; the transferred buffer is the tight one.
-        self._e_cap = stripe_px * (3 if settings.fullcolor else 2)
-        self._w_cap = stripe_px // 2
-        self._out_cap = max(256 * 1024, stripe_px * g.n_stripes // 8)
+        # HBM is cheap; the transferred buffer is the tight one.
+        self._e_cap, self._w_cap, self._out_cap = jpeg_buffer_caps(
+            g, settings.fullcolor)
         self._step = self._build_step()
         self.frame_id = 0
         self._age = jnp.zeros((g.n_stripes,), jnp.int32)

@@ -201,7 +201,9 @@ def build_h264_step_fn(mode: str, width: int, stripe_h: int, n_stripes: int,
     return step
 
 
-@functools.cache
+# bounded LRU (see engine/encoder.py:_jitted_step): geometry retargeting
+# mints fresh keys; the pre-warm planner shares this factory cache
+@functools.lru_cache(maxsize=32)
 def _jitted_h264_step(mode: str, width: int, stripe_h: int, n_stripes: int,
                       e_cap: int, w_cap: int, out_cap: int,
                       paint_delay: int, damage_gating: bool,

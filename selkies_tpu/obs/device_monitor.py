@@ -258,6 +258,15 @@ class DeviceMonitor:
         except Exception:
             logger.debug("incident record failed", exc_info=True)
 
+    def storm_recent(self, within_s: float = STORM_WINDOW_S) -> bool:
+        """True while a reported compile storm is fresh — the pre-warm
+        worker (selkies_tpu/prewarm) pauses its background builds then:
+        when the frame path is already compile-bound, speculative
+        lattice compiles would pile onto the same XLA queue."""
+        with self._lock:
+            t = self._storm_reported
+        return bool(t) and time.monotonic() - t <= within_s
+
     # -------------------------------------------------------------- sampling
     def _should_sample_mem(self, platform: str) -> bool:
         if self.sampling == "on":

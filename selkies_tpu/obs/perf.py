@@ -33,6 +33,7 @@ must never be able to break encode.
 
 from __future__ import annotations
 
+import collections
 import glob
 import gzip
 import json
@@ -91,11 +92,23 @@ def _norm_memory(mem: Any) -> dict:
 class PerfRegistry:
     """Process-wide table of per-step static cost analyses. One instance
     (:data:`registry`) serves the engine compile sites, ``/api/perf``
-    and bench; tests build their own and feed synthetic analyses."""
+    and bench; tests build their own and feed synthetic analyses.
 
-    def __init__(self):
+    Bounded: runtime geometry retargeting (the degradation ladder's
+    downscale rung, client resizes, overflow buffer growth) mints a
+    fresh step name per visit, so a long-lived flapping session would
+    otherwise grow this table without limit. Past ``max_steps`` the
+    oldest-recorded entries are evicted (the live operating points are
+    always the newest)."""
+
+    #: analysis entries kept; oldest-recorded evicted beyond this
+    max_steps = 64
+
+    def __init__(self, max_steps: Optional[int] = None):
         self._lock = threading.Lock()
         self._steps: dict[str, dict] = {}
+        if max_steps is not None:
+            self.max_steps = int(max_steps)
 
     def record_analysis(self, name: str, cost: Any = None,
                         memory: Any = None, *,
@@ -133,6 +146,12 @@ class PerfRegistry:
         }
         with self._lock:
             self._steps[name] = entry
+            while len(self._steps) > self.max_steps:
+                oldest = min(self._steps,
+                             key=lambda k: self._steps[k]["recorded_at"])
+                if oldest == name:
+                    break
+                del self._steps[oldest]
         return entry
 
     def clear(self) -> None:
@@ -179,25 +198,64 @@ class _WrappedStep:
     would use) and records the static cost analysis; subsequent calls
     execute the AOT ``Compiled`` directly. Any failure — lowering,
     compile, analysis, or an executable call — permanently falls back
-    to the plain jitted callable for that signature."""
+    to the plain jitted callable for that signature.
+
+    The per-signature cache is a small LRU (``_CACHE_CAP``): signatures
+    are minted by shape/dtype, and a pathological caller cycling
+    argument shapes must not pin an unbounded set of compiled
+    executables in memory. Eviction only costs a re-prepare (persistent
+    compile cache absorbs the rebuild).
+
+    :meth:`warm` is the pre-warm hook (selkies_tpu/prewarm): AOT
+    lower+compile for an aval signature WITHOUT executing, so the first
+    real frame on that signature dispatches a ready executable."""
 
     __slots__ = ("name", "_jitted", "_registry", "_cache", "_lock")
 
     #: sentinel: this signature must use the plain jitted path
     _FALLBACK = object()
+    #: compiled signatures kept per step (LRU beyond this)
+    _CACHE_CAP = 8
 
     def __init__(self, name: str, jitted: Callable,
                  registry_: Optional[PerfRegistry] = None):
         self.name = name
         self._jitted = jitted
         self._registry = registry_ or registry
-        self._cache: dict[tuple, Any] = {}
+        self._cache: "collections.OrderedDict[tuple, Any]" = \
+            collections.OrderedDict()
         self._lock = threading.Lock()
+
+    def _cache_get(self, key: tuple):
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is not None:
+                self._cache.move_to_end(key)
+            return entry
+
+    def _cache_put(self, key: tuple, entry) -> None:
+        """Caller holds no lock; bounded LRU insert."""
+        with self._lock:
+            self._cache_set_locked(key, entry)
+
+    def warm(self, args: tuple) -> bool:
+        """Pre-compile for this argument signature (``args`` may be
+        ``jax.ShapeDtypeStruct`` avals — nothing executes). True when
+        the signature ends up warm (freshly compiled or already
+        cached); False when it fell back to plain jit dispatch."""
+        try:
+            key = _aval_signature(args)
+        except Exception:
+            return False
+        entry = self._cache_get(key)
+        if entry is None:
+            entry = self._prepare(key, args)
+        return entry is not self._FALLBACK
 
     def __call__(self, *args):
         try:
             key = _aval_signature(args)
-            entry = self._cache.get(key)
+            entry = self._cache_get(key)
         except Exception:
             return self._jitted(*args)
         if entry is None:
@@ -211,8 +269,7 @@ class _WrappedStep:
             # absorbed with a transfer: stop trying for this signature
             logger.exception("perf-instrumented step %s failed; "
                              "falling back to jit dispatch", self.name)
-            with self._lock:
-                self._cache[key] = self._FALLBACK
+            self._cache_put(key, self._FALLBACK)
             for a in args:
                 deleted = getattr(a, "is_deleted", None)
                 if callable(deleted) and deleted():
@@ -223,6 +280,12 @@ class _WrappedStep:
                     raise
             return self._jitted(*args)
 
+    def _cache_set_locked(self, key: tuple, entry) -> None:
+        self._cache[key] = entry
+        self._cache.move_to_end(key)
+        while len(self._cache) > self._CACHE_CAP:
+            self._cache.popitem(last=False)
+
     def _prepare(self, key: tuple, args: tuple):
         """Lower + compile + analyse under the lock (first frame only —
         the same compile barrier jit dispatch would impose)."""
@@ -231,11 +294,21 @@ class _WrappedStep:
             if entry is not None:
                 return entry
             if os.environ.get("SELKIES_PERF_ANALYSIS") == "0":
-                self._cache[key] = self._FALLBACK
+                self._cache_set_locked(key, self._FALLBACK)
                 return self._FALLBACK
             t0 = time.monotonic()
+            # fault point encoder.compile:slow — THE compile site every
+            # engine step builds through, so an injected 20 s "compile"
+            # lands exactly where a real XLA build would stall. Sleeping
+            # mode only; lazy import keeps this module stdlib-importable
+            try:
+                from ..resilience import faults as _faults
+            except Exception:
+                _faults = None
             try:
                 lowered = self._jitted.lower(*args)
+                if _faults is not None:
+                    _faults.registry.perturb("encoder.compile")
                 cost = None
                 try:
                     cost = lowered.cost_analysis()
@@ -263,7 +336,7 @@ class _WrappedStep:
                 self._registry.record_analysis(
                     self.name, cost, mem, backend=backend,
                     compile_s=compile_s, signature=_sig_str(key))
-                self._cache[key] = compiled
+                self._cache_set_locked(key, compiled)
                 return compiled
             except Exception as e:
                 logger.warning("perf analysis of step %s unavailable "
@@ -272,7 +345,7 @@ class _WrappedStep:
                 self._registry.record_analysis(
                     self.name, signature=_sig_str(key),
                     error=f"{type(e).__name__}: {e}"[:200])
-                self._cache[key] = self._FALLBACK
+                self._cache_set_locked(key, self._FALLBACK)
                 return self._FALLBACK
 
 

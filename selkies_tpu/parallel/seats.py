@@ -26,7 +26,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..codecs import jpeg as jtab
 from ..codecs.jpeg import stuff_ff_bytes
-from ..engine.encoder import build_step_fn, plan_grid
+from ..engine.encoder import build_step_fn, jpeg_buffer_caps, plan_grid
 from ..engine.types import CaptureSettings, EncodedChunk
 from ..trace import tracer as _tracer
 
@@ -65,10 +65,10 @@ class MultiSeatEncoder:
         self.grid = plan_grid(settings)
         self.subsampling = "444" if settings.fullcolor else "420"
         g = self.grid
-        stripe_px = g.stripe_h * g.width
-        self._e_cap = stripe_px * (3 if settings.fullcolor else 2)
-        self._w_cap = stripe_px // 2
-        self._out_cap = max(256 * 1024, stripe_px * g.n_stripes // 8)
+        # shared sizing policy (engine/encoder.py): the pre-warm planner
+        # must compile with the exact caps a live encoder builds with
+        self._e_cap, self._w_cap, self._out_cap = jpeg_buffer_caps(
+            g, settings.fullcolor)
 
         self.mesh = mesh if mesh is not None else seat_mesh(n_seats, devices)
         if n_seats % self.mesh.devices.size:

@@ -1,0 +1,42 @@
+"""Compile-plane robustness: AOT pre-warm of the ladder lattice.
+
+A 1080p H.264 program costs ~22 s to build (PERF.md), yet the
+degradation ladder (PR 5) retargets geometry at runtime — before this
+package, every geometry-changing rung risked a foreground XLA compile
+that froze the session the downshift was meant to save, and the compile
+monitor (PR 3) could only watch it happen. Three cooperating parts make
+encoder reconfiguration a pre-provisioned, never-inline operation (the
+discipline the split-frame V-PCC streaming work applies to encoder
+reconfig):
+
+- :mod:`.lattice` — enumerate the reachable (resolution x codec x
+  quality-tier x seat-count) signature lattice from settings plus the
+  ladder's rung table, deduplicated down to distinct compiled programs
+  (quality tiers share a program: quant tables travel as runtime
+  arguments);
+- :mod:`.worker` — a supervised background worker that compiles the
+  lattice current-operating-point-first then rung order, pausing while
+  the device monitor's compile-storm detector is firing, with progress
+  on ``GET /api/prewarm``, ``selkies_prewarm_*`` metrics and a
+  ``prewarm`` health check; plus :class:`~.worker.PrewarmGate`, the
+  transition gate the degradation ladder consults so a cold rung is
+  *deferred* (top-priority enqueued) instead of compiled inline;
+- :mod:`.plan` — the jax side: maps a signature onto the exact
+  ``wrap_step`` programs the live engine sessions build (same
+  ``functools`` factory cache keys), AOT lower+compile via
+  ``ShapeDtypeStruct`` avals so nothing executes on the device;
+- :mod:`.artifact` — distributable warm-cache artifacts: pack the
+  host-fingerprint-keyed persistent XLA cache (PR 2) into a
+  manifest-carrying tarball, refuse unpacking on a fingerprint or jax
+  version mismatch (the cross-machine SIGILL hazard), so new hosts boot
+  hot from a CI-built artifact.
+
+Import contract: this module, :mod:`.lattice`, :mod:`.worker` and
+:mod:`.artifact` are stdlib-only (``python -m selkies_tpu.prewarm
+selftest`` runs in the lint CI image with neither jax nor aiohttp);
+every jax touch point lives in :mod:`.plan` and is imported lazily.
+"""
+
+from .lattice import (LatticePlan, Signature,  # noqa: F401
+                      enumerate_lattice, lattice_from_settings)
+from .worker import PrewarmGate, PrewarmWorker  # noqa: F401

@@ -1,0 +1,241 @@
+"""Offline compile-plane CLI.
+
+``python -m selkies_tpu.prewarm selftest`` — drive lattice enumeration,
+the pre-warm worker (fake compiler), the ladder's deferred-transition
+gate, and the warm-cache artifact pack/unpack/refusal contracts, all
+stdlib-only (the CI lint smoke, mirroring ``python -m
+selkies_tpu.resilience selftest``). Exits non-zero on any contract
+break.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+from ..obs.health import FAILED, OK, HealthEngine
+from ..resilience.ladder import DegradationLadder
+from . import artifact as _artifact
+from .lattice import Signature, enumerate_lattice, lattice_from_settings
+from .worker import PrewarmGate, PrewarmWorker
+
+
+def _fail(msg: str) -> int:
+    print(f"selftest FAILED: {msg}", file=sys.stderr)
+    return 1
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class _NS:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def _cmd_selftest(args: argparse.Namespace) -> int:
+    import logging
+    logging.getLogger("selkies_tpu.prewarm").setLevel(logging.CRITICAL)
+    logging.getLogger("selkies_tpu.resilience").setLevel(logging.CRITICAL)
+
+    # -- lattice: dedup, order, floor, seat variants ---------------------
+    plan = lattice_from_settings(_NS(encoder="h264-tpu-striped",
+                                     initial_width=1920,
+                                     initial_height=1080, tpu_seats=1))
+    if len(plan.signatures) != 2:
+        return _fail(f"default ladder lattice must dedup to 2 programs "
+                     f"(base + downscale), got {len(plan.signatures)}")
+    if plan.signatures[0].program_key != plan.base.program_key:
+        return _fail("base operating point must enumerate first")
+    if (plan.signatures[1].width, plan.signatures[1].height) != (960, 540):
+        return _fail(f"downscale rung must halve geometry: "
+                     f"{plan.signatures[1]}")
+    if plan.rung_targets["quality"]["down"] \
+            or plan.rung_targets["fps"]["down"]:
+        return _fail("fps/quality rungs must be compile-free")
+    if plan.rung_targets["downscale"]["down"] \
+            != [plan.signatures[1].program_key]:
+        return _fail("downscale rung must target the scaled program")
+    tiny = enumerate_lattice(Signature(64, 64, "jpeg"),
+                             steps=("downscale",))
+    if len(tiny.signatures) != 1 or tiny.rung_targets["downscale"]["down"]:
+        return _fail("a floor-clamped downscale must be a no-op rung")
+    seats = lattice_from_settings(_NS(encoder="jpeg-tpu",
+                                      initial_width=640,
+                                      initial_height=480, tpu_seats=4))
+    if any(s.seats != 4 for s in seats.signatures):
+        return _fail("seat-count variants must carry the seat axis")
+    if seats.signatures[0].program_key \
+            == plan.signatures[0].program_key:
+        return _fail("seat programs must be distinct compile identities")
+    multi = enumerate_lattice(Signature(1024, 768, "jpeg"),
+                              steps=("downscale", "downscale4"))
+    if [(s.width, s.height) for s in multi.signatures] \
+            != [(1024, 768), (512, 384), (128, 96)]:
+        return _fail(f"downscaleN rungs must stack cumulatively: "
+                     f"{[(s.width, s.height) for s in multi.signatures]}")
+
+    # -- worker: order, request, pause, failure, health ------------------
+    clk = _Clock()
+    compiled: list = []
+    storm = {"on": False}
+
+    def fake_compiler(sig):
+        compiled.append(sig.program_key)
+        if sig.width == 13:
+            raise RuntimeError("boom")
+        return {"programs": [f"fake[{sig.width}x{sig.height}]"]}
+
+    w = PrewarmWorker(multi, compiler=fake_compiler, clock=clk,
+                      storm_check=lambda: storm["on"])
+    w.note_operating_point(512, 384)   # mid-rung operating point first
+    w.run_pending_sync()
+    if compiled != [multi.signatures[1].program_key,
+                    multi.signatures[0].program_key,
+                    multi.signatures[2].program_key]:
+        return _fail(f"compile order must be operating-point-first then "
+                     f"lattice order: {compiled}")
+    if w.query(multi.program_keys) != "warm":
+        return _fail("fully-compiled lattice must query warm")
+    if w.query(["nonexistent"]) != "cold":
+        return _fail("unknown program keys must query cold")
+    if w.health_check().status != OK:
+        return _fail("warm lattice must verdict ok")
+    bad_key = w.ensure(Signature(13, 13, "jpeg"))
+    w.run_pending_sync()
+    if w.states()[bad_key] != "failed" \
+            or w.health_check().status != FAILED:
+        return _fail("a failed program must fail the prewarm verdict")
+    w2 = PrewarmWorker(tiny, compiler=fake_compiler, clock=clk,
+                       storm_check=lambda: storm["on"])
+    storm["on"] = True
+    if w2._storming() is not True:
+        return _fail("storm_check must hold the worker")
+    storm["on"] = False
+
+    # -- gate + ladder: defer, request, land, deadline force -------------
+    eng = HealthEngine()
+    worker = PrewarmWorker(multi, compiler=fake_compiler, clock=clk)
+    gate = PrewarmGate(worker, multi.rung_targets)
+    lad = DegradationLadder(steps=("downscale", "downscale4"),
+                            down_after_s=1.0, hold_s=1.0,
+                            ok_window_s=10.0, gate=gate,
+                            defer_deadline_s=5.0, clock=clk,
+                            recorder=eng.recorder)
+    bad = {"qoe": FAILED}
+    lad.observe(bad, now=0.0)
+    lad.observe(bad, now=1.5)
+    if lad.level != 0 or lad.deferred_transitions != 1:
+        return _fail(f"cold rung must defer: level={lad.level} "
+                     f"deferred={lad.deferred_transitions}")
+    kinds = [e["kind"] for e in eng.recorder.snapshot()]
+    if "transition_deferred" not in kinds:
+        return _fail(f"deferral must record an incident: {kinds}")
+    # the deferral promoted the target: next sync compiles it FIRST
+    worker.run_pending_sync()
+    lad.observe(bad, now=2.0)
+    if lad.level != 1:
+        return _fail("a warmed rung must land on the next tick")
+    # deadline-forced nearest warm rung: re-cool /4? use a fresh ladder
+    w3 = PrewarmWorker(multi, compiler=fake_compiler, clock=clk)
+    # warm ONLY the /4 program; /2 stays cold
+    w3.request([multi.signatures[2].program_key])
+    w3._compile_one(multi.signatures[2].program_key)
+    g3 = PrewarmGate(w3, multi.rung_targets)
+    lad3 = DegradationLadder(steps=("downscale", "downscale4"),
+                             down_after_s=1.0, hold_s=1.0,
+                             ok_window_s=10.0, gate=g3,
+                             defer_deadline_s=2.0, clock=clk,
+                             recorder=eng.recorder)
+    lad3.observe(bad, now=0.0)
+    lad3.observe(bad, now=1.5)      # defers (downscale cold)
+    lad3.observe(bad, now=4.0)      # deadline passed -> force downscale4
+    if lad3.level != 2:
+        return _fail(f"deadline must force the nearest warm rung: "
+                     f"level={lad3.level}")
+    last_step = [e for e in eng.recorder.snapshot()
+                 if e["kind"] == "degradation_step"][-1]
+    if last_step.get("skipped") != ["downscale"]:
+        return _fail(f"forced shift must name skipped cold rungs: "
+                     f"{last_step}")
+
+    # -- artifact: round-trip, refusal, traversal guard ------------------
+    with tempfile.TemporaryDirectory() as tmp:
+        cache = os.path.join(tmp, "cache")
+        os.makedirs(os.path.join(cache, "sub"))
+        for rel in ("a.bin", os.path.join("sub", "b.bin")):
+            with open(os.path.join(cache, rel), "wb") as f:
+                f.write(rel.encode() * 7)
+        art = os.path.join(tmp, "warm.tgz")
+        manifest = _artifact.pack(art, cache_dir=cache,
+                                  fingerprint="fpA", jax_ver="1.2.3")
+        if manifest["files"] != 2:
+            return _fail(f"pack must record 2 files: {manifest}")
+        _artifact.verify(art, fingerprint="fpA", jax_ver="1.2.3")
+        try:
+            _artifact.unpack(art, root=os.path.join(tmp, "out"),
+                             fingerprint="fpB", jax_ver="1.2.3")
+            return _fail("fingerprint mismatch must refuse unpack")
+        except _artifact.FingerprintMismatch as e:
+            if e.field != "fingerprint":
+                return _fail(f"wrong mismatch field: {e.field}")
+        try:
+            _artifact.unpack(art, root=os.path.join(tmp, "out"),
+                             fingerprint="fpA", jax_ver="9.9.9")
+            return _fail("jax-version mismatch must refuse unpack")
+        except _artifact.FingerprintMismatch:
+            pass
+        res = _artifact.unpack(art, root=os.path.join(tmp, "out"),
+                               fingerprint="fpA", jax_ver="1.2.3")
+        got = os.path.join(res["dir"], "sub", "b.bin")
+        with open(got, "rb") as f:
+            if f.read() != os.path.join("sub", "b.bin").encode() * 7:
+                return _fail("unpack must restore file contents")
+        if _artifact._safe_member("cache/ok") != "cache/ok":
+            return _fail("safe member normalisation broken")
+        for evil in ("/abs/path", "../up", "cache/../../up"):
+            try:
+                _artifact._safe_member(evil)
+                return _fail(f"unsafe member {evil!r} must be rejected")
+            except _artifact.ArtifactError:
+                pass
+        status = _artifact.unpack_if_configured(
+            _NS(warm_cache_artifact=os.path.join(tmp, "nope.tgz")))
+        if status["status"] != "missing":
+            return _fail(f"missing artifact must report missing: {status}")
+
+    doc = {"lattice": multi.to_dict(), "worker": w.snapshot(),
+           "ladder": lad3.snapshot(),
+           "incidents": eng.recorder.snapshot()[-4:]}
+    text = json.dumps(doc)
+    json.loads(text)
+    print(text if args.json
+          else f"selftest OK ({len(text)} bytes of compile-plane state)")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m selkies_tpu.prewarm",
+        description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser("selftest",
+                        help="drive lattice+worker+gate+artifact "
+                             "contracts with fakes")
+    ps.add_argument("--json", action="store_true",
+                    help="print the selftest state payload")
+    ps.set_defaults(fn=_cmd_selftest)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

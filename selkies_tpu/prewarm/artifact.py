@@ -1,0 +1,320 @@
+"""Distributable warm-cache artifacts.
+
+The persistent XLA compile cache (PR 2) is keyed by a host fingerprint
+precisely because reusing compiled code across heterogeneous machines
+produces "compile machine features don't match host" warnings and a
+SIGILL risk (seen live in the r05 bench tail). That makes the cache
+*shippable* — build it once per microarchitecture fingerprint in CI,
+distribute the tarball, and every new host of that microarch boots hot —
+as long as unpacking ENFORCES the key. This module owns that contract:
+
+- :func:`pack` tars a fingerprint-keyed cache subtree together with a
+  ``manifest.json`` (fingerprint, jax version, per-file sha256);
+- :func:`verify` checks a tarball's integrity and its compatibility
+  with THIS host, raising :class:`FingerprintMismatch` on the hazard;
+- :func:`unpack` refuses a fingerprint mismatch outright (there is no
+  force flag for it: shipping wrong-microarch machine code is the bug
+  class this exists to prevent), refuses a jax-version mismatch unless
+  forced (serialized executables are not stable across jax releases),
+  and extracts with path-traversal guards;
+- :func:`unpack_if_configured` is the server-boot hook: unpack the
+  ``warm_cache_artifact`` setting's tarball before the first compile so
+  the first session build cache-hits.
+
+Stdlib-only; jax is touched only to read ``jax.__version__`` when the
+caller doesn't supply one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import os
+import tarfile
+import time
+from typing import Optional
+
+from ..compile_cache import cache_root, host_fingerprint
+
+logger = logging.getLogger("selkies_tpu.prewarm.artifact")
+
+__all__ = ["ArtifactError", "FingerprintMismatch", "pack", "verify",
+           "unpack", "unpack_if_configured", "MANIFEST_NAME"]
+
+MANIFEST_NAME = "manifest.json"
+ARTIFACT_KIND = "selkies-warm-cache"
+ARTIFACT_VERSION = 1
+#: archive member prefix for cache files
+_PREFIX = "cache/"
+
+
+class ArtifactError(RuntimeError):
+    """Malformed / unreadable / unsafe artifact."""
+
+
+class FingerprintMismatch(ArtifactError):
+    """The artifact was built for a different host fingerprint (or jax
+    version): unpacking it risks SIGILL (or deserialize failures) on
+    this machine."""
+
+    def __init__(self, field: str, want: str, got: str):
+        super().__init__(
+            f"warm-cache artifact {field} mismatch: artifact is for "
+            f"{want!r}, this host is {got!r}")
+        self.field = field
+        self.want = want
+        self.got = got
+
+
+def jax_version() -> str:
+    try:
+        import jax
+        return str(jax.__version__)
+    except Exception:
+        return "unknown"
+
+
+def _walk(cache_dir: str):
+    for root, _dirs, files in os.walk(cache_dir):
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            if os.path.islink(full):
+                continue
+            yield os.path.relpath(full, cache_dir), full
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _safe_member(name: str) -> str:
+    """Reject absolute / traversal member names before extraction."""
+    norm = os.path.normpath(name)
+    if norm.startswith(("/", "..")) or os.path.isabs(norm) \
+            or ".." in norm.split(os.sep):
+        raise ArtifactError(f"unsafe archive member {name!r}")
+    return norm
+
+
+def pack(out_path: str, cache_dir: Optional[str] = None, *,
+         fingerprint: Optional[str] = None,
+         jax_ver: Optional[str] = None) -> dict:
+    """Tar the fingerprint-keyed cache subtree + manifest; -> manifest.
+    An empty cache dir is an error — shipping a hollow artifact would
+    read as "warm" while every host still compiles cold."""
+    fingerprint = fingerprint or host_fingerprint()
+    if cache_dir is None:
+        cache_dir = os.path.join(cache_root(), fingerprint)
+    if not os.path.isdir(cache_dir):
+        raise ArtifactError(f"cache dir {cache_dir} does not exist "
+                            "(warm something first)")
+    files = []
+    total = 0
+    for rel, full in _walk(cache_dir):
+        size = os.path.getsize(full)
+        files.append({"path": rel, "bytes": size,
+                      "sha256": _sha256(full)})
+        total += size
+    if not files:
+        raise ArtifactError(f"cache dir {cache_dir} is empty "
+                            "(warm something first)")
+    manifest = {
+        "kind": ARTIFACT_KIND, "version": ARTIFACT_VERSION,
+        "fingerprint": fingerprint,
+        "jax_version": jax_ver if jax_ver is not None else jax_version(),
+        "created": round(time.time(), 3),
+        "files": len(files), "bytes": total,
+        "entries": files,
+    }
+    blob = json.dumps(manifest, indent=1).encode()
+    with tarfile.open(out_path, "w:gz") as tar:
+        info = tarfile.TarInfo(MANIFEST_NAME)
+        info.size = len(blob)
+        info.mtime = int(time.time())
+        tar.addfile(info, io.BytesIO(blob))
+        for entry in files:
+            tar.add(os.path.join(cache_dir, entry["path"]),
+                    arcname=_PREFIX + entry["path"], recursive=False)
+    logger.info("packed %d cache files (%.1f MB) for %s -> %s",
+                len(files), total / 1e6, fingerprint, out_path)
+    return manifest
+
+
+def read_manifest(path: str) -> dict:
+    # KeyError: tarfile.extractfile raises it for a missing member —
+    # "any tarball that is not an artifact" must be ArtifactError, not
+    # a stray exception that aborts the boot hook / CLI contract
+    try:
+        with tarfile.open(path, "r:*") as tar:
+            member = tar.extractfile(MANIFEST_NAME)
+            if member is None:
+                raise ArtifactError(f"{path}: no {MANIFEST_NAME}")
+            manifest = json.loads(member.read().decode())
+    except (OSError, tarfile.TarError, KeyError, ValueError) as e:
+        raise ArtifactError(f"{path}: unreadable artifact "
+                            f"({type(e).__name__}: {e})") from e
+    if not isinstance(manifest, dict) \
+            or manifest.get("kind") != ARTIFACT_KIND:
+        raise ArtifactError(f"{path}: not a {ARTIFACT_KIND} artifact")
+    if int(manifest.get("version", 0)) > ARTIFACT_VERSION:
+        raise ArtifactError(
+            f"{path}: artifact version {manifest.get('version')} is "
+            f"newer than this reader ({ARTIFACT_VERSION})")
+    return manifest
+
+
+def verify(path: str, *, fingerprint: Optional[str] = None,
+           jax_ver: Optional[str] = None,
+           check_host: bool = True) -> dict:
+    """Integrity + compatibility check. Raises :class:`ArtifactError`
+    (malformed) or :class:`FingerprintMismatch` (wrong host/jax);
+    returns the manifest with a ``verified`` summary on success."""
+    manifest = read_manifest(path)
+    try:
+        want = {e["path"]: e for e in manifest.get("entries", [])}
+    except (TypeError, KeyError) as e:
+        raise ArtifactError(f"{path}: malformed manifest entries") from e
+    seen = set()
+    try:
+        with tarfile.open(path, "r:*") as tar:
+            for member in tar.getmembers():
+                if member.name == MANIFEST_NAME:
+                    continue
+                name = _safe_member(member.name)
+                if not name.startswith(_PREFIX):
+                    raise ArtifactError(
+                        f"{path}: unexpected member {member.name!r}")
+                if not member.isfile():
+                    raise ArtifactError(
+                        f"{path}: non-file member {member.name!r}")
+                rel = name[len(_PREFIX):]
+                entry = want.get(rel)
+                if entry is None:
+                    raise ArtifactError(
+                        f"{path}: member {rel!r} missing from manifest")
+                f = tar.extractfile(member)
+                h = hashlib.sha256()
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+                if h.hexdigest() != entry.get("sha256"):
+                    raise ArtifactError(f"{path}: {rel} sha256 mismatch")
+                seen.add(rel)
+    except (OSError, tarfile.TarError, KeyError) as e:
+        # a tarball truncated PAST the manifest still fails as a
+        # malformed artifact, never as a stray traceback
+        raise ArtifactError(f"{path}: unreadable artifact body "
+                            f"({type(e).__name__}: {e})") from e
+    missing = sorted(set(want) - seen)
+    if missing:
+        raise ArtifactError(
+            f"{path}: manifest entries missing from archive: "
+            f"{missing[:3]}")
+    if check_host:
+        fp = fingerprint or host_fingerprint()
+        if manifest.get("fingerprint") != fp:
+            raise FingerprintMismatch("fingerprint",
+                                      str(manifest.get("fingerprint")),
+                                      fp)
+        jv = jax_ver if jax_ver is not None else jax_version()
+        if manifest.get("jax_version") not in (jv, "unknown") \
+                and jv != "unknown":
+            raise FingerprintMismatch("jax_version",
+                                      str(manifest.get("jax_version")),
+                                      jv)
+    manifest["verified"] = {"files": len(seen), "host_checked": check_host}
+    return manifest
+
+
+def unpack(path: str, root: Optional[str] = None, *,
+           fingerprint: Optional[str] = None,
+           jax_ver: Optional[str] = None,
+           force_version: bool = False) -> dict:
+    """Verify then extract into ``root/<fingerprint>/``. A fingerprint
+    mismatch is ALWAYS refused (the SIGILL hazard has no override); a
+    jax-version mismatch is refused unless ``force_version``."""
+    try:
+        manifest = verify(path, fingerprint=fingerprint, jax_ver=jax_ver)
+    except FingerprintMismatch as e:
+        if e.field == "jax_version" and force_version:
+            manifest = verify(path, fingerprint=fingerprint,
+                              jax_ver=jax_ver, check_host=False)
+            fp = fingerprint or host_fingerprint()
+            if manifest.get("fingerprint") != fp:
+                raise FingerprintMismatch(
+                    "fingerprint", str(manifest.get("fingerprint")),
+                    fp) from e
+            logger.warning("unpacking despite jax-version mismatch "
+                           "(%s); deserialize failures fall back to a "
+                           "cold compile", e)
+        else:
+            raise
+    root = root or cache_root()
+    dest = os.path.join(root, manifest["fingerprint"])
+    os.makedirs(dest, exist_ok=True)
+    extracted = 0
+    try:
+        with tarfile.open(path, "r:*") as tar:
+            for member in tar.getmembers():
+                if member.name == MANIFEST_NAME or not member.isfile():
+                    continue
+                rel = _safe_member(member.name)[len(_PREFIX):]
+                target = os.path.join(dest, rel)
+                os.makedirs(os.path.dirname(target) or dest,
+                            exist_ok=True)
+                src = tar.extractfile(member)
+                with open(target, "wb") as out:
+                    for chunk in iter(lambda: src.read(1 << 20), b""):
+                        out.write(chunk)
+                extracted += 1
+    except (OSError, tarfile.TarError, KeyError) as e:
+        raise ArtifactError(f"{path}: extraction failed "
+                            f"({type(e).__name__}: {e})") from e
+    logger.info("unpacked %d warm-cache files into %s", extracted, dest)
+    return {"dir": dest, "files": extracted,
+            "bytes": manifest.get("bytes"),
+            "fingerprint": manifest["fingerprint"],
+            "jax_version": manifest.get("jax_version")}
+
+
+def unpack_if_configured(settings, recorder=None) -> Optional[dict]:
+    """Server-boot hook: unpack ``settings.warm_cache_artifact`` before
+    the first compile. Refusals and errors are reported (incident +
+    log) but never fatal — a mismatched artifact means a cold boot, not
+    no boot."""
+    path = str(getattr(settings, "warm_cache_artifact", "") or "")
+    if not path:
+        return None
+    def _incident(kind, **fields):
+        if recorder is not None:
+            try:
+                recorder.record(kind, **fields)
+            except Exception:
+                logger.debug("incident record failed", exc_info=True)
+    if not os.path.exists(path):
+        logger.warning("warm_cache_artifact %s not found; booting cold",
+                       path)
+        return {"status": "missing", "path": path}
+    try:
+        res = unpack(path)
+        _incident("warm_cache_unpacked", path=path,
+                  files=res["files"], fingerprint=res["fingerprint"])
+        return {"status": "unpacked", "path": path, **res}
+    except FingerprintMismatch as e:
+        logger.error("REFUSING warm-cache artifact %s: %s "
+                     "(cross-machine reuse risks SIGILL); booting cold",
+                     path, e)
+        _incident("warm_cache_refused", path=path, field=e.field,
+                  want=e.want, got=e.got)
+        return {"status": "refused", "path": path, "field": e.field,
+                "error": str(e)}
+    except ArtifactError as e:
+        logger.error("warm-cache artifact %s unusable: %s; booting cold",
+                     path, e)
+        _incident("warm_cache_error", path=path, error=str(e)[:200])
+        return {"status": "error", "path": path, "error": str(e)[:200]}

@@ -1,0 +1,217 @@
+"""Reachable-signature lattice enumeration.
+
+The degradation ladder's rung table plus the configured operating point
+determine every (resolution x codec x quality-tier x seat-count)
+combination a running server can be asked to encode. This module derives
+that set AHEAD of time so the pre-warm worker can compile it before the
+ladder needs it.
+
+Two identities matter and they are not the same:
+
+- a :class:`Signature` is one ladder-reachable operating point
+  (geometry, codec, quality tier, seats, and the session knobs that
+  change the compiled program);
+- its :attr:`~Signature.program_key` is the *compile* identity — the
+  quality tier is excluded because quant tables / qp travel as runtime
+  arguments, so the "base" and "degraded" tiers of one geometry share a
+  compiled program. Lattice dedup happens on program_key: the lattice
+  for the default ladder (fps -> quality -> downscale) collapses to two
+  programs per codec (full geometry + downscaled geometry), not six.
+
+Stdlib-only: the lint CI image enumerates lattices with no jax
+installed; the jax mapping from a signature onto actual compiled
+programs lives in :mod:`.plan`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+__all__ = ["Signature", "LatticePlan", "enumerate_lattice",
+           "lattice_from_settings", "downscale_factor",
+           "GEOMETRY_FLOOR_PX"]
+
+#: the ladder's capture-downscale floor (mirrors
+#: ``ws_service._apply_ladder_scale``: ``max(64, dim // factor)``)
+GEOMETRY_FLOOR_PX = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class Signature:
+    """One reachable operating point. Fields beyond ``quality_tier``
+    all change the compiled XLA program (geometry/striping feed the
+    grid planner, gating/paint/motion knobs are trace-time constants,
+    seats select the sharded program)."""
+
+    width: int
+    height: int
+    codec: str                      # "jpeg" | "h264"
+    quality_tier: str = "base"      # metadata only: NOT compile identity
+    seats: int = 1
+    fullcolor: bool = False
+    stripe_height: int = 64
+    single_stream: bool = False
+    use_damage_gating: bool = True
+    use_paint_over: bool = True
+    paint_over_delay_frames: int = 15
+    h264_motion_vrange: int = 24
+    h264_motion_hrange: int = 8
+
+    @property
+    def program_key(self) -> str:
+        """Compile identity: every field except the quality tier."""
+        s = self
+        parts = [f"{s.width}x{s.height}", s.codec, f"seats{s.seats}",
+                 f"stripe{s.stripe_height}"]
+        if s.fullcolor:
+            parts.append("444")
+        if s.single_stream:
+            parts.append("single")
+        if not s.use_damage_gating:
+            parts.append("nogate")
+        if not s.use_paint_over:
+            parts.append("nopaint")
+        else:
+            parts.append(f"paint{s.paint_over_delay_frames}")
+        if s.codec == "h264":
+            parts.append(f"mv{s.h264_motion_vrange}"
+                         f"h{s.h264_motion_hrange}")
+        return "/".join(parts)
+
+    def scaled(self, factor: int) -> "Signature":
+        """The capture-downscale rung's target geometry (same floor
+        math as the ws actuator)."""
+        return dataclasses.replace(
+            self,
+            width=max(GEOMETRY_FLOOR_PX, self.width // factor),
+            height=max(GEOMETRY_FLOOR_PX, self.height // factor))
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["program_key"] = self.program_key
+        return d
+
+
+def downscale_factor(step: str) -> Optional[int]:
+    """Downscale rungs carry their divisor in the name: ``downscale``
+    (the stock rung, /2) or ``downscaleN``. None for non-geometry
+    rungs."""
+    if not step.startswith("downscale"):
+        return None
+    suffix = step[len("downscale"):]
+    if not suffix:
+        return 2
+    try:
+        f = int(suffix)
+    except ValueError:
+        return None
+    return f if f >= 2 else None
+
+
+@dataclasses.dataclass
+class LatticePlan:
+    """Enumeration result: the ordered, program-deduped signature list
+    (base operating point first, then rung order — the worker's default
+    compile order) plus the per-rung transition targets the ladder gate
+    queries (program_keys needed by a down / up shift of each rung)."""
+
+    base: Signature
+    signatures: list
+    #: step name -> {"down": [program_key...], "up": [program_key...]}
+    rung_targets: dict
+
+    @property
+    def program_keys(self) -> list:
+        return [s.program_key for s in self.signatures]
+
+    def to_dict(self) -> dict:
+        return {"base": self.base.to_dict(),
+                "signatures": [s.to_dict() for s in self.signatures],
+                "rung_targets": self.rung_targets}
+
+
+def enumerate_lattice(base: Signature,
+                      steps: Sequence[str] = ("fps", "quality",
+                                              "downscale")) -> LatticePlan:
+    """Walk the rung table cumulatively from ``base`` (the way the
+    ladder actually degrades: each rung applies on top of the previous
+    one) and collect every distinct compiled program along the way.
+
+    - ``fps`` rungs never change a program (frame pacing is host-side);
+    - ``quality`` rungs mint a "degraded" tier signature that DEDUPS
+      onto the same program (quant/qp are runtime args) — enumerated so
+      the lattice is honest about reachable operating points, deduped
+      so the worker never compiles twice;
+    - ``downscale[N]`` rungs mint a genuinely new program at the scaled
+      geometry (the only rung class that can go cold).
+    """
+    signatures: list = []
+    seen: set = set()
+
+    def add(sig: Signature) -> None:
+        if sig.program_key not in seen:
+            seen.add(sig.program_key)
+            signatures.append(sig)
+
+    add(base)
+    rung_targets: dict = {}
+    current = base
+    for step in steps:
+        factor = downscale_factor(step)
+        if factor is not None:
+            nxt = current.scaled(factor)
+            if nxt.program_key == current.program_key:
+                # already at the geometry floor: rung is a no-op
+                rung_targets[step] = {"down": [], "up": []}
+                continue
+            rung_targets[step] = {"down": [nxt.program_key],
+                                  "up": [current.program_key]}
+            add(nxt)
+            current = nxt
+        elif step == "quality":
+            nxt = dataclasses.replace(current, quality_tier="degraded")
+            # same program by construction — compile-free either way
+            rung_targets[step] = {"down": [], "up": []}
+            add(nxt)
+            current = nxt
+        else:
+            # fps (and any unknown host-side rung): compile-free
+            rung_targets[step] = {"down": [], "up": []}
+    return LatticePlan(base=base, signatures=signatures,
+                       rung_targets=rung_targets)
+
+
+def lattice_from_settings(settings,
+                          steps: Sequence[str] = ("fps", "quality",
+                                                  "downscale"),
+                          ) -> LatticePlan:
+    """Base signature from an AppSettings-shaped object (any object with
+    the attribute names; missing ones fall back to the engine defaults,
+    so bench and tools can pass a plain namespace)."""
+    def g(name, default):
+        return getattr(settings, name, default)
+
+    encoder = str(g("encoder", "jpeg-tpu"))
+    base = Signature(
+        width=int(g("initial_width", 1920)),
+        height=int(g("initial_height", 1080)),
+        codec="jpeg" if encoder.startswith("jpeg") else "h264",
+        seats=max(1, int(g("tpu_seats", 1))),
+        fullcolor=bool(g("fullcolor", False)),
+        stripe_height=int(g("stripe_height", 64)),
+        single_stream=(encoder == "h264-tpu"),
+        use_damage_gating=bool(g("use_damage_gating", True)),
+        use_paint_over=bool(g("use_paint_over", True)),
+        paint_over_delay_frames=int(g("paint_over_delay_frames", 15)),
+        h264_motion_vrange=int(g("h264_motion_vrange", 24)),
+        h264_motion_hrange=int(g("h264_motion_hrange", 8)),
+    )
+    return enumerate_lattice(base, steps)
+
+
+def rung_targets_from(plan_or_mapping) -> Mapping:
+    """Accept a LatticePlan or a bare mapping (test fakes)."""
+    if isinstance(plan_or_mapping, LatticePlan):
+        return plan_or_mapping.rung_targets
+    return plan_or_mapping

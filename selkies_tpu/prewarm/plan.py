@@ -1,0 +1,233 @@
+"""Signature -> compiled-program mapping (the jax side of pre-warm).
+
+A warm is only useful if it lands on the EXACT program the live session
+will ask for, so this module goes through the same factory functions the
+engine sessions use — :func:`engine.encoder._jitted_step` and
+:func:`engine.h264_encoder._jitted_h264_step` are ``functools``-cached
+on their build parameters, which means the pre-warmed
+:class:`~..obs.perf._WrappedStep` IS the object a later session gets
+back: its per-signature AOT cache already holds the compiled executable
+and the first frame never compiles. Grid and buffer-capacity math is
+imported from the engine (never duplicated) for the same reason: a
+one-off divergence would warm a program nobody runs.
+
+Compilation is AOT (``lower(...).compile()`` over ``ShapeDtypeStruct``
+avals): nothing executes on the device, so a background warm never
+steals a device slot from the encoder. The handful of small REAL arrays
+a step signature needs (scalar qp/force, slice-header event tables) are
+allocated under the engine's frame-turn lock so even those allocations
+serialize against live capture threads. Multi-seat programs additionally
+need a mesh + shardings; those warms build a throwaway encoder instance
+(state arrays, no compile) and AOT-compile through its wrapped step —
+the executable lands in the persistent compile cache (PR 2), which is
+what a later real encoder's first call hits.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from .lattice import Signature
+
+logger = logging.getLogger("selkies_tpu.prewarm.plan")
+
+__all__ = ["capture_settings_for", "program_names", "warm_signature"]
+
+#: seat-program keys already AOT-compiled this process (their wrapped
+#: steps are per-encoder-instance, so without this a re-warm would
+#: rebuild mesh state for a program the persistent cache already holds)
+_seat_warmed: set = set()
+_seat_lock = threading.Lock()
+
+
+def capture_settings_for(sig: Signature):
+    """The CaptureSettings a live session would be built from at this
+    operating point (quality knobs are runtime-only and irrelevant to
+    the compiled program — defaults are fine)."""
+    from ..engine.types import CaptureSettings
+    return CaptureSettings(
+        capture_width=sig.width, capture_height=sig.height,
+        output_mode=sig.codec, fullcolor=sig.fullcolor,
+        stripe_height=sig.stripe_height, single_stream=sig.single_stream,
+        use_damage_gating=sig.use_damage_gating,
+        use_paint_over=sig.use_paint_over,
+        paint_over_delay_frames=sig.paint_over_delay_frames,
+        h264_motion_vrange=sig.h264_motion_vrange,
+        h264_motion_hrange=sig.h264_motion_hrange)
+
+
+def program_names(sig: Signature) -> list:
+    """The ``obs.perf`` registry names this signature's programs carry
+    (what ``wrap_step`` stamps at the engine compile sites)."""
+    cs = capture_settings_for(sig)
+    if sig.codec == "jpeg":
+        from ..engine.encoder import _plan_grid
+        g = _plan_grid(cs)
+        sub = "444" if sig.fullcolor else "420"
+        if sig.seats > 1:
+            return [f"jpeg.seats{sig.seats}_step"
+                    f"[{g.width}x{g.height}@{sub}]"]
+        return [f"jpeg.step[{g.width}x{g.stripe_h * g.n_stripes}@{sub}]"]
+    from ..engine.h264_encoder import plan_h264_grid
+    g = plan_h264_grid(cs)
+    if sig.seats > 1:
+        return [f"h264.seats{sig.seats}_{m}_step[{g.width}x{g.height}]"
+                for m in ("i", "p")]
+    tag = "@444" if sig.fullcolor else ""
+    return [f"h264.{m}_step[{g.width}x{g.stripe_h * g.n_stripes}{tag}]"
+            for m in ("i", "p")]
+
+
+def _aval(shape, dtype):
+    import jax
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _warm_jpeg(sig: Signature) -> list:
+    import jax.numpy as jnp
+
+    from ..engine import encoder as _enc
+    cs = capture_settings_for(sig)
+    g = _enc.plan_grid(cs)
+    sub = "444" if sig.fullcolor else "420"
+    e_cap, w_cap, out_cap = _enc.jpeg_buffer_caps(g, sig.fullcolor)
+    step = _enc._jitted_step(
+        g.width, g.stripe_h, g.n_stripes, sub, e_cap, w_cap, out_cap,
+        cs.paint_over_delay_frames, cs.use_damage_gating,
+        cs.use_paint_over)
+    frame = _aval((g.height, g.width, 3), jnp.uint8)
+    age = _aval((g.n_stripes,), jnp.int32)
+    qt = _aval((64,), jnp.float32)
+    if not step.warm((frame, frame, age, qt, qt, qt, qt)):
+        raise RuntimeError("jpeg step warm failed (see obs.perf log)")
+    return [step.name]
+
+
+def _h264_headers(g, n_stripes: int):
+    """Slice-header event tables, shaped exactly as the session builds
+    them (small device arrays: allocated under the frame-turn lock by
+    the caller)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..codecs import h264 as hcodec
+    pay, nb = hcodec.slice_header_events(g.mb_w, g.rows_per_stripe)
+    ppay, pnb = hcodec.p_slice_header_events(g.mb_w, g.rows_per_stripe)
+    return (jnp.asarray(np.tile(pay, (n_stripes, 1))),
+            jnp.asarray(np.tile(nb, (n_stripes, 1))),
+            jnp.asarray(np.tile(ppay, (n_stripes, 1))),
+            jnp.asarray(np.tile(pnb, (n_stripes, 1))))
+
+
+def _warm_h264(sig: Signature) -> list:
+    import jax.numpy as jnp
+
+    from ..engine import h264_encoder as _h
+    from ..engine.capture import _ENCODE_TURN
+    from ..ops.h264_encode import scroll_candidates
+    cs = capture_settings_for(sig)
+    g = _h.plan_h264_grid(cs)
+    e_cap, w_cap, out_cap = _h.h264_buffer_caps(g, sig.fullcolor)
+    vr, hr = max(0, sig.h264_motion_vrange), max(0, sig.h264_motion_hrange)
+    cdiv = 1 if sig.fullcolor else 2
+    frame = _aval((g.height, g.width, 3), jnp.uint8)
+    svec = _aval((g.n_stripes,), jnp.int32)
+    ref_y = _aval((g.height, g.width), jnp.uint8)
+    ref_c = _aval((g.height // cdiv, g.width // cdiv), jnp.uint8)
+    with _ENCODE_TURN:      # small real allocations: serialize vs encode
+        hdr_pay, hdr_nb, p_hdr_pay, p_hdr_nb = _h264_headers(
+            g, g.n_stripes)
+        qp = jnp.int32(0)
+        force = jnp.asarray(True)
+    names = []
+    for mode in ("i", "p"):
+        cands = scroll_candidates(vr, hr) if (mode == "p" and vr) \
+            else ((0, 0),)
+        step = _h._jitted_h264_step(
+            mode, g.width, g.stripe_h, g.n_stripes, e_cap, w_cap,
+            out_cap, cs.paint_over_delay_frames, cs.use_damage_gating,
+            cs.use_paint_over, candidates=cands,
+            fullcolor=sig.fullcolor)
+        pay, nb = (hdr_pay, hdr_nb) if mode == "i" \
+            else (p_hdr_pay, p_hdr_nb)
+        if not step.warm((frame, frame, svec, svec, svec,
+                          ref_y, ref_c, ref_c, qp, qp, force, pay, nb)):
+            raise RuntimeError(f"h264 {mode} step warm failed "
+                               "(see obs.perf log)")
+        names.append(step.name)
+    return names
+
+
+def _warm_jpeg_seats(sig: Signature) -> list:
+    import jax.numpy as jnp
+
+    from ..engine.capture import _ENCODE_TURN
+    from ..parallel.seats import MultiSeatEncoder
+    cs = capture_settings_for(sig)
+    with _ENCODE_TURN:      # constructor device_puts: serialize
+        enc = MultiSeatEncoder(cs, sig.seats)
+    g = enc.grid
+    frames = jnp.ShapeDtypeStruct(
+        (sig.seats, g.height, g.width, 3), jnp.uint8,
+        sharding=enc.input_sharding)
+    if not enc._step.warm((frames, frames, enc._age, *enc._qt_dev)):
+        raise RuntimeError("multi-seat jpeg step warm failed")
+    return [enc._step.name]
+
+
+def _warm_h264_seats(sig: Signature) -> list:
+    import jax.numpy as jnp
+    import numpy as np
+    import jax
+
+    from ..engine.capture import _ENCODE_TURN
+    from ..parallel.h264_seats import MultiSeatH264Encoder
+    cs = capture_settings_for(sig)
+    with _ENCODE_TURN:
+        enc = MultiSeatH264Encoder(cs, sig.seats)
+        n = sig.seats
+        qp = jax.device_put(np.zeros((n,), np.int32), enc.input_sharding)
+        forces = jax.device_put(np.ones((n,), bool), enc.input_sharding)
+    g = enc.grid
+    frames = jnp.ShapeDtypeStruct(
+        (n, g.height, g.width, 3), jnp.uint8, sharding=enc.input_sharding)
+    names = []
+    for mode, step, pay, nb in (("i", enc._i_step, enc._hdr_pay,
+                                 enc._hdr_nb),
+                                ("p", enc._p_step, enc._p_hdr_pay,
+                                 enc._p_hdr_nb)):
+        if not step.warm((frames, frames, enc._age, enc._sent, enc._fnum,
+                          enc._ref_y, enc._ref_u, enc._ref_v,
+                          qp, qp, forces, pay, nb)):
+            raise RuntimeError(f"multi-seat h264 {mode} step warm failed")
+        names.append(step.name)
+    return names
+
+
+def warm_signature(sig: Signature) -> dict:
+    """AOT-compile every program behind ``sig``; -> {"programs": [names]}.
+    Raises on any program that cannot be built (the worker records the
+    signature as failed — the ladder then never routes through it).
+
+    ``SELKIES_PERF_ANALYSIS=0`` (the obs.perf kill switch) disables the
+    AOT path entirely — every signature dispatches through plain jit —
+    so there is nothing to pre-warm: report ``disabled`` (the worker
+    marks the entry skipped and the ladder gate FAILS OPEN, restoring
+    the pre-compile-plane behaviour) instead of reading the fallback as
+    a compile failure that would flip /api/health to failed."""
+    import os
+    if os.environ.get("SELKIES_PERF_ANALYSIS") == "0":
+        return {"programs": [], "disabled": "SELKIES_PERF_ANALYSIS=0"}
+    if sig.seats > 1:
+        key = sig.program_key
+        with _seat_lock:
+            if key in _seat_warmed:
+                return {"programs": program_names(sig), "cached": True}
+        names = _warm_jpeg_seats(sig) if sig.codec == "jpeg" \
+            else _warm_h264_seats(sig)
+        with _seat_lock:
+            _seat_warmed.add(key)
+        return {"programs": names}
+    names = _warm_jpeg(sig) if sig.codec == "jpeg" else _warm_h264(sig)
+    return {"programs": names}

@@ -1,0 +1,471 @@
+"""Background pre-warm worker + the ladder's transition gate.
+
+The worker owns a registry of lattice signatures and drives each one
+``pending -> compiling -> warm`` (or ``failed``) on a dedicated thread:
+
+- **order**: the current operating point's programs first (the rung the
+  ladder would visit next under load is a neighbour of where the server
+  IS, so the live geometry's neighbourhood warms before speculative
+  corners), then lattice order — which :func:`..lattice.enumerate_lattice`
+  emits lowest-rung-first. :meth:`request` promotes keys to the front
+  (the ladder's deferred-transition path);
+- **pacing**: the worker pauses while ``storm_check()`` reports the
+  device monitor's compile-storm detector firing — when the frame path
+  is already compile-bound, speculative background builds would pile
+  onto the same XLA queue. Compilation itself is host-side AOT
+  (:mod:`.plan` lowers ``ShapeDtypeStruct`` avals — nothing executes on
+  the device), so a warm never steals a device slot from the encoder;
+- **supervision**: the thread reports its own death through
+  :attr:`on_death` (the PR-5 supervisor adopts :meth:`restart`), and
+  :meth:`health_check` is the ``prewarm`` verdict: failed when any
+  program failed to build, degraded when the worker died with work
+  pending, ok otherwise (warming is progress, not degradation).
+
+:class:`PrewarmGate` adapts the worker to the degradation ladder's gate
+protocol: ``query(step, direction)`` answers warm/cold from the rung's
+target programs, ``request`` promotes them. Rungs with no compiled
+target (fps, quality) are warm by construction.
+
+Stdlib-only: the injectable ``compiler`` seam keeps jax out of this
+module (the default lazily imports :mod:`.plan`); the selftest and unit
+tests drive everything with fakes.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from .lattice import LatticePlan, Signature
+
+logger = logging.getLogger("selkies_tpu.prewarm.worker")
+
+__all__ = ["PrewarmWorker", "PrewarmGate",
+           "PENDING", "COMPILING", "WARM", "FAILED", "SKIPPED"]
+
+PENDING = "pending"
+COMPILING = "compiling"
+WARM = "warm"
+FAILED = "failed"
+#: pre-warm is disabled for this program (perf-analysis kill switch):
+#: not warm, not failed — the gate fails OPEN for skipped programs
+SKIPPED = "skipped"
+
+#: how often the paused/idle loop re-checks for work or storm clearance
+_POLL_S = 1.0
+
+
+def _default_compiler(sig: Signature) -> dict:
+    """AOT-compile every program behind ``sig`` (jax side, lazy)."""
+    from . import plan
+    return plan.warm_signature(sig)
+
+
+class PrewarmWorker:
+    """Lattice compile driver. One instance per server (``core`` owns
+    it); bench and tests build their own with fake compilers."""
+
+    def __init__(self, plan_: Optional[LatticePlan] = None, *,
+                 compiler: Optional[Callable[[Signature], dict]] = None,
+                 storm_check: Optional[Callable[[], bool]] = None,
+                 recorder=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 poll_s: float = _POLL_S):
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.compiler = compiler or _default_compiler
+        self.storm_check = storm_check
+        self.recorder = recorder
+        self._clock = clock
+        self.poll_s = float(poll_s)
+        self.paused = False             # storm (or manual) hold
+        self._manual_pause = False
+        self.started_at: Optional[float] = None
+        self.on_death: Optional[Callable[[BaseException], None]] = None
+        #: program_key -> entry dict (insertion order == compile order)
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self._order: list = []          # pending keys, priority order
+        self.current_op: Optional[tuple] = None
+        self.compile_seconds_total = 0.0
+        if plan_ is not None:
+            for sig in plan_.signatures:
+                self.ensure(sig)
+
+    # -- registry ------------------------------------------------------------
+    def ensure(self, sig: Signature, front: bool = False) -> str:
+        """Track a signature (idempotent); -> its program_key."""
+        key = sig.program_key
+        with self._lock:
+            if key not in self._entries:
+                self._entries[key] = {
+                    "sig": sig, "state": PENDING, "seconds": None,
+                    "error": None, "programs": [], "attempts": 0,
+                }
+                if front:
+                    self._order.insert(0, key)
+                else:
+                    self._order.append(key)
+        self._wake.set()
+        return key
+
+    def request(self, keys) -> int:
+        """Promote ``keys`` to the front of the queue (deferred ladder
+        transitions land here); -> how many were still pending."""
+        promoted = 0
+        with self._lock:
+            for key in reversed(list(keys)):
+                if key in self._order:
+                    self._order.remove(key)
+                    self._order.insert(0, key)
+                    promoted += 1
+        if promoted:
+            self._wake.set()
+        return promoted
+
+    def note_operating_point(self, width: int, height: int) -> None:
+        """The live engine's current geometry: its programs compile
+        first, then the rest of the lattice in rung order."""
+        with self._lock:
+            self.current_op = (int(width), int(height))
+            front = [k for k in self._order
+                     if (self._entries[k]["sig"].width,
+                         self._entries[k]["sig"].height)
+                     == self.current_op]
+            rest = [k for k in self._order if k not in front]
+            self._order = front + rest
+        if front:
+            self._wake.set()
+
+    def query(self, keys) -> str:
+        """'warm' when every key's program is compiled, else 'cold'
+        (unknown keys are cold — a rung outside the tracked lattice
+        must defer, not sail into a foreground compile). SKIPPED
+        programs answer warm: pre-warm is disabled there, and the gate
+        failing open restores the pre-compile-plane behaviour instead
+        of deferring a transition nothing will ever warm."""
+        with self._lock:
+            for key in keys:
+                e = self._entries.get(key)
+                if e is None or e["state"] not in (WARM, SKIPPED):
+                    return "cold"
+        return "warm"
+
+    def states(self) -> dict:
+        with self._lock:
+            return {k: e["state"] for k, e in self._entries.items()}
+
+    def mark_warm_from_names(self, warm_names,
+                             names_fn: Callable[[Signature], list]) -> int:
+        """Adopt already-compiled programs (e.g. the perf registry's
+        record of what this process built): an entry whose every program
+        name is in ``warm_names`` is warm without recompiling."""
+        warm_names = set(warm_names)
+        adopted = 0
+        with self._lock:
+            entries = list(self._entries.items())
+        for key, e in entries:
+            if e["state"] == WARM:
+                continue
+            try:
+                names = list(names_fn(e["sig"]))
+            except Exception:
+                continue
+            if names and all(n in warm_names for n in names):
+                with self._lock:
+                    e["state"] = WARM
+                    e["programs"] = names
+                    if key in self._order:
+                        self._order.remove(key)
+                adopted += 1
+        if adopted:
+            self._update_metrics()
+        return adopted
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self) -> None:
+        if self.alive:
+            return
+        self._stop.clear()
+        self.started_at = self._clock()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="prewarm")
+        self._thread.start()
+
+    def restart(self) -> None:
+        """Supervisor restart callable: join the dead thread, start a
+        fresh one over the same registry (compiled entries stay warm)."""
+        self.stop(join_s=2.0)
+        with self._lock:
+            # a death mid-compile leaves a stale 'compiling' entry
+            for key, e in self._entries.items():
+                if e["state"] == COMPILING:
+                    e["state"] = PENDING
+                    if key not in self._order:
+                        self._order.insert(0, key)
+        self.start()
+
+    def stop(self, join_s: float = 5.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=join_s)
+        self._thread = None
+
+    def pause(self) -> None:
+        self._manual_pause = True
+
+    def resume(self) -> None:
+        self._manual_pause = False
+        self._wake.set()
+
+    # -- compile loop --------------------------------------------------------
+    def _next_pending(self) -> Optional[str]:
+        with self._lock:
+            while self._order:
+                key = self._order[0]
+                e = self._entries.get(key)
+                if e is None or e["state"] not in (PENDING,):
+                    self._order.pop(0)
+                    continue
+                return key
+        return None
+
+    def _storming(self) -> bool:
+        if self._manual_pause:
+            return True
+        if self.storm_check is None:
+            return False
+        try:
+            return bool(self.storm_check())
+        except Exception:
+            return False
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                key = self._next_pending()
+                if key is None:
+                    self._update_metrics()
+                    self._wake.clear()
+                    self._wake.wait(self.poll_s * 10)
+                    continue
+                if self._storming():
+                    if not self.paused:
+                        self.paused = True
+                        logger.warning("prewarm paused: compile storm "
+                                       "active on the frame path")
+                        self._update_metrics()
+                    self._stop.wait(self.poll_s)
+                    continue
+                if self.paused:
+                    self.paused = False
+                    logger.info("prewarm resumed")
+                self._compile_one(key)
+        except BaseException as e:   # noqa: BLE001 — supervision hook
+            if not self._stop.is_set():
+                logger.exception("prewarm worker died")
+                hook = self.on_death
+                if hook is not None:
+                    try:
+                        hook(e)
+                    except Exception:
+                        logger.exception("prewarm on_death hook failed")
+            if not isinstance(e, Exception):
+                raise
+
+    def run_pending_sync(self, budget_s: Optional[float] = None) -> int:
+        """Compile everything pending on the CALLER's thread (tools /
+        image-build warm where no background thread makes sense).
+        -> number of programs that reached warm."""
+        done = 0
+        deadline = None if budget_s is None else self._clock() + budget_s
+        while True:
+            if deadline is not None and self._clock() >= deadline:
+                break
+            key = self._next_pending()
+            if key is None:
+                break
+            if self._compile_one(key):
+                done += 1
+        return done
+
+    def _compile_one(self, key: str) -> bool:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e["state"] != PENDING:
+                return False
+            e["state"] = COMPILING
+            e["attempts"] += 1
+            if key in self._order:
+                self._order.remove(key)
+        self._update_metrics()
+        sig = e["sig"]
+        t0 = self._clock()
+        try:
+            result = self.compiler(sig) or {}
+            seconds = round(self._clock() - t0, 3)
+            disabled = result.get("disabled")
+            with self._lock:
+                e["state"] = SKIPPED if disabled else WARM
+                e["seconds"] = seconds
+                e["programs"] = list(result.get("programs", []))
+                if disabled:
+                    e["error"] = f"prewarm disabled: {disabled}"
+                self.compile_seconds_total += seconds
+            if disabled:
+                logger.info("prewarm: %s skipped (%s)", key, disabled)
+            else:
+                logger.info("prewarm: %s warm in %.1fs", key, seconds)
+                self._record("prewarm_compiled", key=key,
+                             seconds=seconds)
+            self._update_metrics()
+            return True
+        except Exception as exc:
+            seconds = round(self._clock() - t0, 3)
+            with self._lock:
+                e["state"] = FAILED
+                e["seconds"] = seconds
+                e["error"] = f"{type(exc).__name__}: {exc}"[:200]
+            logger.exception("prewarm: %s failed after %.1fs", key, seconds)
+            self._record("prewarm_failed", key=key, error=e["error"])
+            self._update_metrics()
+            return False
+
+    def _record(self, kind: str, **fields) -> None:
+        rec = self.recorder
+        if rec is None:
+            return
+        try:
+            rec.record(kind, **fields)
+        except Exception:
+            logger.debug("prewarm incident record failed", exc_info=True)
+
+    # -- reporting -----------------------------------------------------------
+    def counts(self) -> dict:
+        with self._lock:
+            c = collections.Counter(e["state"]
+                                    for e in self._entries.values())
+        return {"lattice_size": sum(c.values()), "warmed": c[WARM],
+                "pending": c[PENDING], "compiling": c[COMPILING],
+                "failed": c[FAILED], "skipped": c[SKIPPED]}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            entries = [{
+                "key": k, "state": e["state"], "seconds": e["seconds"],
+                "error": e["error"], "programs": list(e["programs"]),
+                "attempts": e["attempts"],
+                "geometry": f'{e["sig"].width}x{e["sig"].height}',
+                "codec": e["sig"].codec, "seats": e["sig"].seats,
+                "quality_tier": e["sig"].quality_tier,
+            } for k, e in self._entries.items()]
+            current_op = self.current_op
+        doc = self.counts()
+        doc.update({
+            "alive": self.alive, "paused": self.paused,
+            "current_op": (f"{current_op[0]}x{current_op[1]}"
+                           if current_op else None),
+            "compile_seconds_total": round(self.compile_seconds_total, 3),
+            "entries": entries,
+        })
+        return doc
+
+    def health_check(self):
+        """The ``prewarm`` verdict. Warming is not a degradation (the
+        live session keeps encoding while the lattice fills); a FAILED
+        program is — that rung would defer forever."""
+        from ..obs import health as _health
+        c = self.counts()
+        if c["failed"]:
+            with self._lock:
+                bad = sorted(k for k, e in self._entries.items()
+                             if e["state"] == FAILED)
+            return _health.failed(
+                f"{c['failed']}/{c['lattice_size']} lattice programs "
+                f"failed to warm: {', '.join(bad[:3])}", **c)
+        backlog = c["pending"] + c["compiling"]
+        if backlog and self.started_at is not None and not self.alive:
+            return _health.degraded(
+                f"prewarm worker not running with {backlog} programs "
+                "cold", **c)
+        if self.paused and backlog:
+            return _health.degraded(
+                f"prewarm paused (compile storm) with {backlog} "
+                "programs cold", **c)
+        if backlog:
+            return _health.ok(
+                f"warming: {c['warmed']}/{c['lattice_size']} warm", **c)
+        if c["skipped"]:
+            return _health.ok(
+                f"prewarm disabled for {c['skipped']} programs "
+                "(perf-analysis kill switch); gate fails open", **c)
+        return _health.ok(
+            f"lattice warm ({c['warmed']} programs)", **c)
+
+    def _update_metrics(self) -> None:
+        try:
+            from ..server import metrics
+        except Exception:
+            return
+        c = self.counts()
+        metrics.describe("selkies_prewarm_lattice_size",
+                         "Reachable signature-lattice programs tracked")
+        metrics.describe("selkies_prewarm_warmed",
+                         "Lattice programs compiled and ready")
+        metrics.describe("selkies_prewarm_pending",
+                         "Lattice programs still cold")
+        metrics.describe("selkies_prewarm_failed",
+                         "Lattice programs that failed to compile")
+        metrics.describe("selkies_prewarm_paused",
+                         "1 while the worker is holding for a compile "
+                         "storm")
+        metrics.set_gauge("selkies_prewarm_lattice_size",
+                          c["lattice_size"])
+        metrics.set_gauge("selkies_prewarm_warmed", c["warmed"])
+        metrics.set_gauge("selkies_prewarm_pending",
+                          c["pending"] + c["compiling"])
+        metrics.set_gauge("selkies_prewarm_failed", c["failed"])
+        metrics.set_gauge("selkies_prewarm_paused",
+                          1 if self.paused else 0)
+
+
+class PrewarmGate:
+    """The degradation ladder's transition gate over a worker.
+
+    ``rung_targets`` is the lattice plan's ``{step: {"down": [keys],
+    "up": [keys]}}`` mapping. A rung with no mapped programs (fps,
+    quality — or any rung the lattice never heard of) is warm by
+    construction: only geometry/signature-changing rungs can defer.
+    """
+
+    def __init__(self, worker: PrewarmWorker, rung_targets: dict):
+        self.worker = worker
+        self.rung_targets = dict(rung_targets)
+
+    def _keys(self, step: str, direction: int) -> list:
+        t = self.rung_targets.get(step)
+        if not t:
+            return []
+        return list(t.get("down" if direction > 0 else "up", []))
+
+    def query(self, step: str, direction: int) -> str:
+        keys = self._keys(step, direction)
+        if not keys:
+            return "warm"
+        return self.worker.query(keys)
+
+    def request(self, step: str, direction: int) -> None:
+        keys = self._keys(step, direction)
+        if keys:
+            self.worker.request(keys)

@@ -32,6 +32,9 @@ Injection points and their modes:
                           (source blocks ``delay_s``)
 ``encoder.dispatch``      ``slow`` (sleep ``delay_s``),
                           ``device_error`` (fake XLA runtime error)
+``encoder.compile``       ``slow`` (sleep ``delay_s`` inside the step
+                          compile site — the injected 20 s XLA build
+                          the compile-plane contract defends against)
 ``ws.accept``             ``close`` / ``error`` (upgrade rejected)
 ========================  =======================================
 
@@ -62,6 +65,7 @@ POINTS: dict[str, tuple[str, ...]] = {
     "relay.send": ("stall", "error"),
     "capture.source": ("raise", "freeze"),
     "encoder.dispatch": ("slow", "device_error"),
+    "encoder.compile": ("slow",),
     "ws.accept": ("close", "error"),
 }
 

@@ -21,11 +21,28 @@ as a ``degradation_step`` / ``degradation_recover`` incident, exported
 as the ``selkies_degradation_level`` gauge, and kept in a bounded event
 ring that ``/api/trace`` overlays as a ``resilience`` lane.
 
+**Compile-free-or-deferred transitions** (ISSUE 8): a signature-changing
+rung (capture downscale rebuilds the encoder session at a new geometry)
+risks a ~22 s foreground XLA compile — a downshift that freezes the
+session it was meant to save. When a ``gate`` is injected (the pre-warm
+plane's :class:`~selkies_tpu.prewarm.worker.PrewarmGate`), the ladder
+consults it before actuating ANY rung: a ``warm`` answer switches
+immediately; a ``cold`` one is enqueued at top priority via
+``gate.request`` and the shift is *deferred* — the ladder holds at its
+current (compiled) rung, records a ``transition_deferred`` incident, and
+re-queries every tick. Past ``defer_deadline_s`` it forces the nearest
+warm rung further down the table instead (skipped cold rungs are named
+in the incident); with nothing warm it keeps holding, renewing the
+deadline. No gate (or a crashing gate) fails OPEN — shedding fidelity
+must never be blocked by the machinery meant to make it cheap.
+
 The ladder itself is pure state machine (injected clock, no asyncio, no
-deps): transports bind concrete ``down``/``up`` callables per step via
-:meth:`bind_controls`; with nothing bound the ladder still tracks and
-reports level transitions (the verdict trail stays honest even when no
-actuator exists, e.g. webrtc mode before its controls land).
+deps; the gate is duck-typed ``query(step, direction) -> "warm"|"cold"``
+/ ``request(step, direction)``): transports bind concrete ``down``/
+``up`` callables per step via :meth:`bind_controls`; with nothing bound
+the ladder still tracks and reports level transitions (the verdict
+trail stays honest even when no actuator exists, e.g. webrtc mode
+before its controls land).
 """
 
 from __future__ import annotations
@@ -65,6 +82,8 @@ class DegradationLadder:
                  down_after_s: float = 4.0,
                  hold_s: float = 10.0,
                  ok_window_s: float = 30.0,
+                 gate=None,
+                 defer_deadline_s: float = 30.0,
                  clock: Callable[[], float] = time.monotonic,
                  recorder: Optional[_health.FlightRecorder] = None):
         self.steps = tuple(steps)
@@ -73,6 +92,12 @@ class DegradationLadder:
         self.down_after_s = float(down_after_s)
         self.hold_s = float(hold_s)
         self.ok_window_s = float(ok_window_s)
+        #: transition gate (prewarm plane); None = every rung is warm
+        self.gate = gate
+        self.defer_deadline_s = float(defer_deadline_s)
+        self.deferred_transitions = 0
+        #: the in-flight deferral: {step, direction, since, deadline}
+        self._deferral: Optional[dict] = None
         self._clock = clock
         self.recorder = recorder if recorder is not None \
             else _health.engine.recorder
@@ -119,6 +144,10 @@ class DegradationLadder:
         reasons = self._trigger_reasons(verdicts)
         if reasons:
             self._ok_since = None
+            # the trigger is back: a pending step-UP deferral is moot
+            if self._deferral is not None \
+                    and self._deferral["direction"] < 0:
+                self._deferral = None
             if self._bad_since is None:
                 self._bad_since = now
             self._last_reasons = reasons
@@ -129,12 +158,17 @@ class DegradationLadder:
             if self._last_change is not None \
                     and now - self._last_change < self.hold_s:
                 return
-            self._shift(now, +1, reasons)
-            # a further downshift needs the trigger to PERSIST past the
-            # hold from this new level, not re-accumulate from zero
-            self._bad_since = now
+            if self._attempt_shift(now, +1, reasons):
+                # a further downshift needs the trigger to PERSIST past
+                # the hold from this new level, not re-accumulate
+                self._bad_since = now
         else:
             self._bad_since = None
+            # recovered before the deferred DOWNshift's program warmed:
+            # cancel it — shedding is no longer wanted
+            if self._deferral is not None \
+                    and self._deferral["direction"] > 0:
+                self._deferral = None
             if self._ok_since is None:
                 self._ok_since = now
             if self.level == 0:
@@ -144,17 +178,94 @@ class DegradationLadder:
             if self._last_change is not None \
                     and now - self._last_change < self.hold_s:
                 return
-            self._shift(now, -1, ["sustained-ok "
-                                  f"{self.ok_window_s:g}s"])
+            self._attempt_shift(now, -1, ["sustained-ok "
+                                          f"{self.ok_window_s:g}s"])
 
-    def _shift(self, now: float, direction: int, reasons: list[str]) -> None:
+    # -- compile-free-or-deferred gating -------------------------------------
+    def _gate_query(self, step: str, direction: int) -> str:
+        if self.gate is None:
+            return "warm"
+        try:
+            return str(self.gate.query(step, direction))
+        except Exception:
+            # fail OPEN: a broken gate must not block fidelity shedding
+            logger.exception("transition gate query failed; failing open")
+            return "warm"
+
+    def _gate_request(self, step: str, direction: int) -> None:
+        if self.gate is None:
+            return
+        try:
+            self.gate.request(step, direction)
+        except Exception:
+            logger.exception("transition gate request failed")
+
+    def _attempt_shift(self, now: float, direction: int,
+                       reasons: list[str]) -> bool:
+        """Gate-checked shift. True when a transition actually happened
+        (warm target, or a deadline-forced warm alternative)."""
+        step = self.steps[self.level] if direction > 0 \
+            else self.steps[self.level - 1]
+        if self._gate_query(step, direction) != "cold":
+            self._deferral = None
+            self._shift(now, direction, reasons)
+            return True
+        d = self._deferral
+        if d is None or d["step"] != step \
+                or d["direction"] != direction:
+            # new deferral episode: top-priority enqueue, hold in place
+            self._deferral = {"step": step, "direction": direction,
+                              "since": now,
+                              "deadline": now + self.defer_deadline_s}
+            self.deferred_transitions += 1
+            self._gate_request(step, direction)
+            self.recorder.record(
+                "transition_deferred", step=step,
+                direction="down" if direction > 0 else "up",
+                level=self.level, reasons=reasons,
+                deadline_s=self.defer_deadline_s)
+            self._events.append(("transition_deferred",
+                                 time.perf_counter_ns(), self.level,
+                                 step, reasons))
+            logger.warning(
+                "ladder %s to rung %s deferred: program cold; holding "
+                "at level %d while it pre-warms (deadline %gs)",
+                "down" if direction > 0 else "up", step, self.level,
+                self.defer_deadline_s)
+            return False
+        if now < d["deadline"]:
+            return False
         if direction > 0:
-            step = self.steps[self.level]
-            self.level += 1
+            # deadline passed: force the nearest warm rung further down
+            # the table — shedding LESS precisely beats not shedding
+            for j in range(self.level + 1, len(self.steps)):
+                alt = self.steps[j]
+                if self._gate_query(alt, +1) == "cold":
+                    continue
+                skipped = list(self.steps[self.level:j])
+                self._deferral = None
+                logger.warning(
+                    "ladder deferral deadline passed: forcing warm rung "
+                    "%s (skipping cold %s)", alt, ", ".join(skipped))
+                self._shift(now, +1, reasons + [f"forced-warm:{alt}"],
+                            step=alt, to_level=j + 1, skipped=skipped)
+                return True
+        # nothing warm to force (or an up-shift): keep holding, renew
+        d["deadline"] = now + self.defer_deadline_s
+        self._gate_request(step, direction)
+        return False
+
+    def _shift(self, now: float, direction: int, reasons: list[str], *,
+               step: Optional[str] = None, to_level: Optional[int] = None,
+               skipped: Optional[list] = None) -> None:
+        if direction > 0:
+            step = step if step is not None else self.steps[self.level]
+            self.level = to_level if to_level is not None \
+                else self.level + 1
             fn_idx, kind = 0, "degradation_step"
         else:
             self.level -= 1
-            step = self.steps[self.level]
+            step = step if step is not None else self.steps[self.level]
             fn_idx, kind = 1, "degradation_recover"
         self.transitions += 1
         self._last_change = now
@@ -170,8 +281,9 @@ class DegradationLadder:
             except Exception:
                 logger.exception("ladder %s control for step %s failed",
                                  "down" if direction > 0 else "up", step)
+        extra = {"skipped": skipped} if skipped else {}
         self.recorder.record(kind, step=step, level=self.level,
-                             reasons=reasons, applied=applied)
+                             reasons=reasons, applied=applied, **extra)
         self._events.append((kind, time.perf_counter_ns(), self.level,
                              step, reasons))
         _metrics_level(self.level)
@@ -182,6 +294,7 @@ class DegradationLadder:
 
     # -- reporting -----------------------------------------------------------
     def snapshot(self) -> dict:
+        d = self._deferral
         return {
             "level": self.level,
             "step": self.steps[self.level - 1] if self.level else None,
@@ -190,6 +303,13 @@ class DegradationLadder:
             "active_triggers": list(self._last_reasons)
             if self._bad_since is not None else [],
             "controls_bound": sorted(self._controls),
+            "gated": self.gate is not None,
+            "deferred_transitions": self.deferred_transitions,
+            "deferred": ({"step": d["step"],
+                          "direction": "down" if d["direction"] > 0
+                          else "up",
+                          "since": d["since"], "deadline": d["deadline"]}
+                         if d else None),
         }
 
     def trace_events(self, pid: int = 1, tid: int = 97) -> list[dict]:

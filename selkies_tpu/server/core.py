@@ -139,8 +139,33 @@ class CentralizedStreamServer:
                 hold_s=float(getattr(settings, "ladder_hold_s", 10.0)),
                 ok_window_s=float(getattr(
                     settings, "ladder_ok_window_s", 30.0)),
+                defer_deadline_s=float(getattr(
+                    settings, "prewarm_defer_deadline_s", 30.0)),
                 recorder=self.health.recorder)
         self._ladder_task: Optional[asyncio.Task] = None
+        # compile plane (selkies_tpu/prewarm, ISSUE 8): enumerate the
+        # ladder-reachable signature lattice and gate every ladder
+        # transition on it — a cold rung defers instead of compiling in
+        # the foreground. The worker THREAD starts in run() (unit tests
+        # build servers without ever wanting background XLA builds).
+        self.prewarm = None
+        self._prewarm_artifact: Optional[dict] = None
+        if getattr(settings, "enable_prewarm", True):
+            from ..obs import monitor as _devmon
+            from ..prewarm.lattice import lattice_from_settings
+            from ..prewarm.worker import PrewarmGate, PrewarmWorker
+            plan = lattice_from_settings(
+                settings,
+                steps=self.ladder.steps if self.ladder is not None
+                else ("fps", "quality", "downscale"))
+            self.prewarm = PrewarmWorker(
+                plan, storm_check=_devmon.storm_recent,
+                recorder=self.health.recorder)
+            self._check_prewarm = self.prewarm.health_check
+            self.health.register("prewarm", self._check_prewarm)
+            if self.ladder is not None:
+                self.ladder.gate = PrewarmGate(self.prewarm,
+                                               plan.rung_targets)
         #: serialises switch_to_mode: two overlapping switches must not
         #: interleave stop/start and strand a service
         self._switch_lock = asyncio.Lock()
@@ -218,6 +243,7 @@ class CentralizedStreamServer:
         r.add_get("/api/faults", self.handle_faults)
         r.add_post("/api/faults", self.handle_faults_control)
         r.add_get("/api/resilience", self.handle_resilience)
+        r.add_get("/api/prewarm", self.handle_prewarm)
         if self.settings.secure_api:
             r.add_post("/api/tokens", self.handle_mint_token)
             r.add_get("/api/tokens", self.handle_list_tokens)
@@ -431,6 +457,25 @@ class CentralizedStreamServer:
             return web.json_response({"removed": removed})
         return web.Response(
             status=400, text=f"unknown action {action!r} (want arm|disarm)")
+
+    async def handle_prewarm(self, request: web.Request) -> web.Response:
+        """Compile-plane state (selkies_tpu/prewarm): lattice progress,
+        per-program states, pause/storm status, the startup warm-cache
+        artifact outcome, and the ladder's deferred-transition state.
+        Ungated like /api/health — it is the first panel an operator
+        checks when a quality downshift is 'taking a while'."""
+        ladder = None
+        if self.ladder is not None:
+            snap = self.ladder.snapshot()
+            ladder = {"deferred": snap["deferred"],
+                      "deferred_transitions": snap["deferred_transitions"],
+                      "gated": snap["gated"], "level": snap["level"]}
+        return web.json_response({
+            "enabled": self.prewarm is not None,
+            "worker": self.prewarm.snapshot() if self.prewarm else None,
+            "artifact": self._prewarm_artifact,
+            "ladder": ladder,
+        })
 
     async def handle_resilience(self, request: web.Request) -> web.Response:
         """Supervisor + ladder + faults in one operator snapshot."""
@@ -786,6 +831,29 @@ class CentralizedStreamServer:
 
     # ------------------------------------------------------------------- run
     async def run(self) -> web.AppRunner:
+        # warm-cache artifact (prewarm plane): unpack BEFORE anything
+        # can compile so the first session build cache-hits; a
+        # fingerprint mismatch is refused (incident recorded) and the
+        # server boots cold instead. Executor-side: it is tar+disk I/O.
+        if getattr(self.settings, "warm_cache_artifact", ""):
+            from ..prewarm import artifact as _artifact
+            loop = asyncio.get_running_loop()
+            self._prewarm_artifact = await loop.run_in_executor(
+                None, lambda: _artifact.unpack_if_configured(
+                    self.settings, recorder=self.health.recorder))
+        if self.prewarm is not None:
+            loop = asyncio.get_running_loop()
+            # supervised: a dead worker thread restarts with backoff,
+            # budget exhaustion parks it (prewarm check goes degraded)
+            self.prewarm.on_death = \
+                lambda exc, loop=loop: loop.call_soon_threadsafe(
+                    self.supervisor.report_death, "prewarm",
+                    f"{type(exc).__name__}: {exc}")
+            self.supervisor.adopt("prewarm", self.prewarm.restart)
+            self.prewarm.note_operating_point(
+                int(self.settings.initial_width),
+                int(self.settings.initial_height))
+            self.prewarm.start()
         self.register_static()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
@@ -823,6 +891,9 @@ class CentralizedStreamServer:
         self.health.unregister("qoe", self._check_qoe)
         self.health.unregister("slo", self._check_slo)
         self.health.unregister("supervision", self._check_supervision)
+        if self.prewarm is not None:
+            self.health.unregister("prewarm", self._check_prewarm)
+            self.prewarm.stop(join_s=2.0)
         self.supervisor.close()
         if self._ladder_task:
             self._ladder_task.cancel()

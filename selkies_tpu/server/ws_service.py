@@ -406,6 +406,23 @@ class WebSocketsService(BaseStreamingService):
         return _health.ok("mic-only pipeline" if not s.enable_audio
                           else "audio pipeline running")
 
+    # --------------------------------------------------------- compile plane
+    def _note_prewarm(self, display_id: str) -> None:
+        """Tell the pre-warm worker (selkies_tpu/prewarm) the CURRENT
+        operating point, so the live geometry's ladder neighbourhood
+        compiles before speculative lattice corners — the rung the
+        ladder would visit next under load is a neighbour of where the
+        server IS."""
+        worker = getattr(getattr(self, "core", None), "prewarm", None)
+        if worker is None:
+            return
+        try:
+            w, h = self._capture_geometry(display_id)
+            worker.note_operating_point(w, h)
+        except Exception:
+            logger.debug("prewarm operating-point note failed",
+                         exc_info=True)
+
     # ----------------------------------------------------- degradation ladder
     def _bind_ladder(self) -> None:
         """Bind concrete actuators to the core's degradation ladder:
@@ -542,6 +559,9 @@ class WebSocketsService(BaseStreamingService):
                 None, lambda c=cap, o=(ox, oy), g=geo:
                 c.update_capture_region(o[0], o[1], *g))
         await self._broadcast_control(self._server_settings_payload())
+        # re-anchor the pre-warm order on the NEW operating point (the
+        # restore geometry's neighbourhood is now the speculative one)
+        self._note_prewarm(self._default_display())
         logger.warning("ladder: capture geometry %s",
                        "downscaled /%d" % factor if factor else "restored")
 
@@ -752,6 +772,7 @@ class WebSocketsService(BaseStreamingService):
                 # off the loop, guarded against double-dispatch
                 self._starting_captures.add(display_id)
                 cs = self._capture_settings(display_id)
+                self._note_prewarm(display_id)
                 # cold-start UX: session construction may trigger a
                 # minutes-long first XLA compile of this geometry — tell
                 # viewers instead of leaving a silent black screen

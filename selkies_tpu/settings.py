@@ -394,6 +394,23 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
        "up.", vmin=1, vmax=86400),
     _s("ladder_min_fps", SType.FLOAT, 15.0,
        "Floor for the ladder's fps rung.", vmin=1, vmax=240),
+
+    # --- compile plane (selkies_tpu/prewarm) --------------------------------
+    _s("enable_prewarm", SType.BOOL, True,
+       "Background AOT pre-warm of the reachable (resolution x codec x "
+       "seat-count) program lattice the degradation ladder can visit, so "
+       "geometry-changing rungs switch compile-free (progress at "
+       "GET /api/prewarm; pauses during compile storms)."),
+    _s("prewarm_defer_deadline_s", SType.FLOAT, 30.0,
+       "How long a ladder transition to a cold (uncompiled) rung stays "
+       "deferred — holding at a compiled rung while the target "
+       "pre-warms — before the nearest warm rung is forced instead.",
+       vmin=0.1, vmax=3600),
+    _s("warm_cache_artifact", SType.STR, "",
+       "Path to a warm-cache artifact (tools/warm_cache.py pack) "
+       "unpacked at startup before the first compile so new hosts boot "
+       "hot; REFUSED on a host-fingerprint mismatch (the cross-machine "
+       "SIGILL hazard)."),
 )
 
 _DEFS_BY_NAME: dict[str, Setting] = {d.name: d for d in SETTING_DEFINITIONS}

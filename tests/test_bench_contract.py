@@ -186,3 +186,57 @@ def test_bench_dead_relay_reports_failed_backend_verdict():
     assert doc["backend"] == "cpu-fallback-relay-dead"
     assert doc["backend_health"]["status"] == "failed"
     assert "relay-dead" in doc["backend_health"]["reason"]
+
+
+def test_bench_prewarm_block():
+    """ISSUE 8: the compile-plane view rides the JSON line — the
+    ladder-reachable lattice for the bench operating point, with the
+    programs this run compiled adopted as warm."""
+    doc = _bench_doc()
+    p = doc["prewarm"]
+    assert p["lattice_size"] >= 2          # base + downscale target
+    assert 1 <= p["warmed"] <= p["lattice_size"]
+    assert p["deferred_transitions"] == 0  # no ladder runs in main()
+
+
+def _chaos_doc() -> dict:
+    if "chaos" in _cache:
+        return _cache["chaos"]
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu", BENCH_PROBE_BUDGET_S="1",
+               BENCH_CHAOS_WIDTH="128", BENCH_CHAOS_HEIGHT="64",
+               BENCH_CHAOS_BUDGET_S="90",
+               BENCH_CHAOS_COMPILE_DELAY_S="2",
+               BENCH_CHAOS_STORM_BUDGET_S="240",
+               PERF_LEDGER_PATH=_LEDGER)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([sys.executable, str(ROOT / "bench.py"),
+                        "--chaos"],
+                       capture_output=True, text=True, timeout=800,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line: {lines}"
+    _cache["chaos"] = json.loads(lines[0])
+    return _cache["chaos"]
+
+
+def test_chaos_compile_storm_transitions_stay_compile_free():
+    """ISSUE 8 acceptance: with an injected slow compiler
+    (encoder.compile:slow), a ladder downscale transition never blocks
+    the frame loop on a compile — it defers with a transition_deferred
+    incident while the pre-warm worker eats the build in the
+    background, then lands with ZERO foreground compiles, and the
+    chaos run as a whole still recovers."""
+    doc = _chaos_doc()
+    assert doc["chaos"]["recovered"] is True
+    storm = doc["chaos"]["compile_storm"]
+    assert storm["deferred_transitions"] >= 1
+    assert storm["landed"] is True and storm["ladder_level"] == 1
+    assert storm["foreground_compiles"] == 0
+    # the switch itself is session rebuild cost, never a compile: far
+    # below the injected compile delay
+    assert storm["switch_ms"] < storm["delay_s"] * 1000
+    # the background warm demonstrably ate the injected delay
+    assert storm["background_compile_s"] >= storm["delay_s"]
+    assert storm["prewarm"]["failed"] == 0

@@ -1,0 +1,617 @@
+"""Compile-plane tests (ISSUE 8): lattice enumeration, the pre-warm
+worker, the ladder's deferred-transition gate, warm-cache artifacts,
+and the cross-process cache-hit acceptance bar.
+
+Fast paths are stdlib-only (fake compilers, injected clocks, tmp
+artifact dirs). The one real-jax test — pack on "host A", refuse a
+mismatched fingerprint, matched unpack makes the first session build
+cache-hit — runs tiny-geometry subprocesses so the persistent-cache
+counters (PR 3) are observed from a COLD process, the way a new fleet
+host would see them.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+import pytest
+
+from selkies_tpu.obs.health import FAILED, OK, HealthEngine
+from selkies_tpu.prewarm import artifact as art
+from selkies_tpu.prewarm.lattice import (Signature, downscale_factor,
+                                         enumerate_lattice,
+                                         lattice_from_settings)
+from selkies_tpu.prewarm.worker import PrewarmGate, PrewarmWorker
+from selkies_tpu.resilience.ladder import DegradationLadder
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+class _NS:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+# ----------------------------------------------------------------- lattice
+
+def test_lattice_dedups_quality_tier_onto_one_program():
+    plan = lattice_from_settings(_NS(encoder="h264-tpu-striped",
+                                     initial_width=1920,
+                                     initial_height=1080))
+    # fps + quality rungs share the base program; only downscale mints
+    # a new compile identity
+    assert len(plan.signatures) == 2
+    assert plan.signatures[0] is plan.base
+    assert (plan.signatures[1].width, plan.signatures[1].height) \
+        == (960, 540)
+    assert plan.signatures[1].quality_tier == "degraded"
+    base = Signature(1920, 1080, "h264")
+    degraded = Signature(1920, 1080, "h264", quality_tier="degraded")
+    assert base.program_key == degraded.program_key
+
+
+def test_lattice_rung_targets_point_at_programs():
+    plan = lattice_from_settings(_NS(encoder="jpeg-tpu",
+                                     initial_width=1280,
+                                     initial_height=720))
+    assert plan.rung_targets["fps"] == {"down": [], "up": []}
+    assert plan.rung_targets["quality"] == {"down": [], "up": []}
+    down = plan.rung_targets["downscale"]["down"]
+    up = plan.rung_targets["downscale"]["up"]
+    assert down == [plan.signatures[1].program_key]
+    assert up == [plan.base.program_key]
+
+
+def test_lattice_downscale_floor_and_stacking():
+    multi = enumerate_lattice(Signature(1024, 768, "jpeg"),
+                              steps=("downscale", "downscale4"))
+    assert [(s.width, s.height) for s in multi.signatures] \
+        == [(1024, 768), (512, 384), (128, 96)]
+    # at the floor the rung is a no-op, not a duplicate program
+    tiny = enumerate_lattice(Signature(64, 64, "jpeg"),
+                             steps=("downscale",))
+    assert len(tiny.signatures) == 1
+    assert tiny.rung_targets["downscale"] == {"down": [], "up": []}
+    assert downscale_factor("downscale") == 2
+    assert downscale_factor("downscale4") == 4
+    assert downscale_factor("quality") is None
+    assert downscale_factor("downscaleX") is None
+
+
+def test_lattice_seat_count_variants_are_distinct_programs():
+    one = lattice_from_settings(_NS(encoder="jpeg-tpu",
+                                    initial_width=640,
+                                    initial_height=480, tpu_seats=1))
+    four = lattice_from_settings(_NS(encoder="jpeg-tpu",
+                                     initial_width=640,
+                                     initial_height=480, tpu_seats=4))
+    assert all(s.seats == 4 for s in four.signatures)
+    assert one.base.program_key != four.base.program_key
+
+
+def test_lattice_respects_session_knobs_in_program_key():
+    a = Signature(640, 480, "jpeg")
+    assert a.program_key != Signature(640, 480, "jpeg",
+                                      fullcolor=True).program_key
+    assert a.program_key != Signature(640, 480, "jpeg",
+                                      stripe_height=32).program_key
+    assert a.program_key != Signature(
+        640, 480, "jpeg", use_damage_gating=False).program_key
+    h = Signature(640, 480, "h264")
+    assert h.program_key != Signature(
+        640, 480, "h264", h264_motion_vrange=0).program_key
+
+
+# ------------------------------------------------------------------ worker
+
+def _fake_compiler(log):
+    def compiler(sig):
+        log.append(sig.program_key)
+        if sig.width == 13:
+            raise RuntimeError("synthetic compile failure")
+        return {"programs": [f"fake[{sig.width}x{sig.height}]"]}
+    return compiler
+
+
+def test_worker_compiles_operating_point_first_then_rung_order():
+    plan = enumerate_lattice(Signature(1024, 768, "jpeg"),
+                             steps=("downscale", "downscale4"))
+    log = []
+    w = PrewarmWorker(plan, compiler=_fake_compiler(log))
+    w.note_operating_point(512, 384)
+    w.run_pending_sync()
+    assert log == [plan.signatures[1].program_key,
+                   plan.signatures[0].program_key,
+                   plan.signatures[2].program_key]
+    assert w.query(plan.program_keys) == "warm"
+    assert w.counts()["warmed"] == 3
+
+
+def test_worker_request_promotes_and_query_cold_for_unknown():
+    plan = enumerate_lattice(Signature(1024, 768, "jpeg"),
+                             steps=("downscale", "downscale4"))
+    log = []
+    w = PrewarmWorker(plan, compiler=_fake_compiler(log))
+    target = plan.signatures[2].program_key
+    assert w.query([target]) == "cold"
+    assert w.query(["never-heard-of-it"]) == "cold"
+    w.request([target])
+    w._compile_one(w._order[0])
+    assert log == [target]
+    assert w.query([target]) == "warm"
+
+
+def test_worker_failure_fails_health_and_records_incident():
+    eng = HealthEngine()
+    log = []
+    w = PrewarmWorker(compiler=_fake_compiler(log), recorder=eng.recorder)
+    good = w.ensure(Signature(640, 480, "jpeg"))
+    bad = w.ensure(Signature(13, 13, "jpeg"))
+    assert w.health_check().status == OK     # cold-but-warming is ok
+    w.run_pending_sync()
+    assert w.states() == {good: "warm", bad: "failed"}
+    v = w.health_check()
+    assert v.status == FAILED and "failed to warm" in v.reason
+    kinds = [e["kind"] for e in eng.recorder.snapshot()]
+    assert "prewarm_compiled" in kinds and "prewarm_failed" in kinds
+
+
+def test_worker_thread_pauses_on_storm_and_resumes():
+    import threading
+    storm = {"on": True}
+    gate_open = threading.Event()
+    compiled = threading.Event()
+
+    def compiler(sig):
+        compiled.set()
+        return {"programs": ["p"]}
+
+    w = PrewarmWorker(compiler=compiler, storm_check=lambda: storm["on"],
+                      poll_s=0.02)
+    w.ensure(Signature(640, 480, "jpeg"))
+    w.start()
+    try:
+        assert not compiled.wait(0.3)     # held by the storm
+        assert w.paused
+        storm["on"] = False
+        assert compiled.wait(2.0)         # resumes once the storm clears
+        deadline = 50
+        while w.counts()["warmed"] != 1 and deadline:
+            deadline -= 1
+            import time
+            time.sleep(0.02)
+        assert w.counts()["warmed"] == 1
+    finally:
+        w.stop()
+    del gate_open
+
+
+def test_worker_restart_requeues_interrupted_compile():
+    plan = enumerate_lattice(Signature(640, 480, "jpeg"),
+                             steps=("downscale",))
+    log = []
+    w = PrewarmWorker(plan, compiler=_fake_compiler(log))
+    key = plan.base.program_key
+    with w._lock:
+        w._entries[key]["state"] = "compiling"   # died mid-compile
+        w._order.remove(key)
+    w.restart()
+    try:
+        import time
+        for _ in range(100):
+            if w.counts()["warmed"] == len(plan.signatures):
+                break
+            time.sleep(0.02)
+        assert w.counts()["warmed"] == len(plan.signatures)
+    finally:
+        w.stop()
+
+
+def test_worker_mark_warm_from_names_adopts_registry_programs():
+    plan = enumerate_lattice(Signature(640, 480, "jpeg"),
+                             steps=("downscale",))
+    w = PrewarmWorker(plan, compiler=_fake_compiler([]))
+    names_fn = lambda sig: [f"n[{sig.width}]"]     # noqa: E731
+    assert w.mark_warm_from_names({"n[640]"}, names_fn) == 1
+    assert w.states()[plan.base.program_key] == "warm"
+    assert w.counts()["pending"] == 1              # the downscale target
+
+
+# ----------------------------------------------------- ladder gate deferral
+
+class _FakeGate:
+    def __init__(self, state):
+        self.state = dict(state)
+        self.requests = []
+
+    def query(self, step, direction):
+        return self.state.get(step, "warm")
+
+    def request(self, step, direction):
+        self.requests.append((step, "down" if direction > 0 else "up"))
+
+
+def test_ladder_defers_cold_rung_with_incident_and_request():
+    eng = HealthEngine()
+    gate = _FakeGate({"downscale": "cold"})
+    lad = DegradationLadder(steps=("downscale",), down_after_s=1.0,
+                            hold_s=1.0, ok_window_s=10.0, gate=gate,
+                            defer_deadline_s=30.0, recorder=eng.recorder)
+    bad = {"qoe": FAILED}
+    lad.observe(bad, now=0.0)
+    lad.observe(bad, now=1.5)
+    assert lad.level == 0
+    assert lad.deferred_transitions == 1
+    assert gate.requests == [("downscale", "down")]
+    kinds = [e["kind"] for e in eng.recorder.snapshot()]
+    assert kinds == ["transition_deferred"]
+    snap = lad.snapshot()
+    assert snap["deferred"]["step"] == "downscale"
+    assert snap["deferred"]["direction"] == "down"
+    # deferral episode does not re-record every tick
+    lad.observe(bad, now=2.0)
+    assert lad.deferred_transitions == 1
+    # program warms -> the held shift lands on the next tick
+    gate.state["downscale"] = "warm"
+    lad.observe(bad, now=3.0)
+    assert lad.level == 1
+    assert lad.snapshot()["deferred"] is None
+
+
+def test_ladder_deadline_forces_nearest_warm_rung():
+    eng = HealthEngine()
+    gate = _FakeGate({"downscale": "cold", "downscale4": "warm"})
+    lad = DegradationLadder(steps=("downscale", "downscale4"),
+                            down_after_s=1.0, hold_s=1.0,
+                            ok_window_s=10.0, gate=gate,
+                            defer_deadline_s=3.0, recorder=eng.recorder)
+    bad = {"qoe": FAILED}
+    lad.observe(bad, now=0.0)
+    lad.observe(bad, now=1.5)       # defers
+    lad.observe(bad, now=2.0)       # still deferred
+    assert lad.level == 0
+    lad.observe(bad, now=5.0)       # deadline passed -> force /4
+    assert lad.level == 2           # jumped past the cold rung
+    step = [e for e in eng.recorder.snapshot()
+            if e["kind"] == "degradation_step"][-1]
+    assert step["step"] == "downscale4"
+    assert step["skipped"] == ["downscale"]
+
+
+def test_ladder_holds_when_nothing_is_warm_and_renews_deadline():
+    gate = _FakeGate({"downscale": "cold"})
+    lad = DegradationLadder(steps=("downscale",), down_after_s=1.0,
+                            hold_s=1.0, ok_window_s=10.0, gate=gate,
+                            defer_deadline_s=2.0,
+                            recorder=HealthEngine().recorder)
+    bad = {"qoe": FAILED}
+    lad.observe(bad, now=0.0)
+    lad.observe(bad, now=1.5)       # defer (deadline 3.5)
+    lad.observe(bad, now=4.0)       # deadline passed, nothing warm
+    assert lad.level == 0
+    assert lad.snapshot()["deferred"]["deadline"] == 6.0   # renewed
+    assert len(gate.requests) == 2  # re-requested at renewal
+
+
+def test_ladder_recovery_cancels_down_deferral():
+    gate = _FakeGate({"downscale": "cold"})
+    lad = DegradationLadder(steps=("downscale",), down_after_s=1.0,
+                            hold_s=1.0, ok_window_s=5.0, gate=gate,
+                            defer_deadline_s=30.0,
+                            recorder=HealthEngine().recorder)
+    lad.observe({"qoe": FAILED}, now=0.0)
+    lad.observe({"qoe": FAILED}, now=1.5)
+    assert lad.snapshot()["deferred"] is not None
+    lad.observe({"qoe": OK}, now=2.0)
+    assert lad.snapshot()["deferred"] is None
+    assert lad.level == 0
+
+
+def test_ladder_gate_failures_fail_open():
+    class _Boom:
+        def query(self, step, direction):
+            raise RuntimeError("gate crashed")
+
+        def request(self, step, direction):
+            raise RuntimeError("gate crashed")
+
+    lad = DegradationLadder(steps=("downscale",), down_after_s=1.0,
+                            hold_s=1.0, ok_window_s=10.0, gate=_Boom(),
+                            recorder=HealthEngine().recorder)
+    lad.observe({"qoe": FAILED}, now=0.0)
+    lad.observe({"qoe": FAILED}, now=1.5)
+    assert lad.level == 1           # shedding must not be blocked
+
+
+def test_prewarm_gate_over_worker():
+    plan = enumerate_lattice(Signature(1024, 768, "jpeg"),
+                             steps=("downscale",))
+    w = PrewarmWorker(plan, compiler=_fake_compiler([]))
+    gate = PrewarmGate(w, plan.rung_targets)
+    assert gate.query("fps", +1) == "warm"       # compile-free rung
+    assert gate.query("downscale", +1) == "cold"
+    gate.request("downscale", +1)
+    assert w._order[0] == plan.signatures[1].program_key
+    w.run_pending_sync()
+    assert gate.query("downscale", +1) == "warm"
+    assert gate.query("downscale", -1) == "warm"
+
+
+# ---------------------------------------------------------------- artifact
+
+def _make_cache(tmp_path) -> str:
+    cache = tmp_path / "cache"
+    (cache / "sub").mkdir(parents=True)
+    (cache / "a.bin").write_bytes(b"xla" * 100)
+    (cache / "sub" / "b.bin").write_bytes(b"exe" * 50)
+    return str(cache)
+
+
+def test_artifact_roundtrip_and_fingerprint_refusal(tmp_path):
+    cache = _make_cache(tmp_path)
+    out = str(tmp_path / "warm.tgz")
+    manifest = art.pack(out, cache_dir=cache, fingerprint="fpA",
+                        jax_ver="1.2.3")
+    assert manifest["files"] == 2 and manifest["fingerprint"] == "fpA"
+    v = art.verify(out, fingerprint="fpA", jax_ver="1.2.3")
+    assert v["verified"]["files"] == 2
+    with pytest.raises(art.FingerprintMismatch) as ei:
+        art.unpack(out, root=str(tmp_path / "o"), fingerprint="fpB",
+                   jax_ver="1.2.3")
+    assert ei.value.field == "fingerprint"
+    res = art.unpack(out, root=str(tmp_path / "o"), fingerprint="fpA",
+                     jax_ver="1.2.3")
+    assert res["files"] == 2
+    assert (Path(res["dir"]) / "sub" / "b.bin").read_bytes() \
+        == b"exe" * 50
+
+
+def test_artifact_jax_version_mismatch_refused_unless_forced(tmp_path):
+    out = str(tmp_path / "warm.tgz")
+    art.pack(out, cache_dir=_make_cache(tmp_path), fingerprint="fpA",
+             jax_ver="9.9.9")
+    with pytest.raises(art.FingerprintMismatch) as ei:
+        art.unpack(out, root=str(tmp_path / "o"), fingerprint="fpA",
+                   jax_ver="1.0.0")
+    assert ei.value.field == "jax_version"
+    res = art.unpack(out, root=str(tmp_path / "o"), fingerprint="fpA",
+                     jax_ver="1.0.0", force_version=True)
+    assert res["files"] == 2
+    # force NEVER overrides the fingerprint (the SIGILL hazard)
+    with pytest.raises(art.FingerprintMismatch):
+        art.unpack(out, root=str(tmp_path / "o2"), fingerprint="fpB",
+                   jax_ver="9.9.9", force_version=True)
+
+
+def test_artifact_tamper_and_traversal_rejected(tmp_path):
+    import tarfile
+    out = str(tmp_path / "warm.tgz")
+    art.pack(out, cache_dir=_make_cache(tmp_path), fingerprint="fpA",
+             jax_ver="1")
+    # corrupt a member: sha mismatch must fail verify
+    evil = str(tmp_path / "evil.tgz")
+    with tarfile.open(out, "r:gz") as src, \
+            tarfile.open(evil, "w:gz") as dst:
+        for m in src.getmembers():
+            data = src.extractfile(m).read()
+            if m.name.endswith("a.bin"):
+                data = b"tampered" + data[8:]
+            import io
+            mi = tarfile.TarInfo(m.name)
+            mi.size = len(data)
+            dst.addfile(mi, io.BytesIO(data))
+    with pytest.raises(art.ArtifactError, match="sha256"):
+        art.verify(evil, fingerprint="fpA", jax_ver="1")
+    for name in ("/abs", "../up", "cache/../../x"):
+        with pytest.raises(art.ArtifactError):
+            art._safe_member(name)
+    with pytest.raises(art.ArtifactError):
+        art.read_manifest(str(tmp_path / "nope.tgz"))
+
+
+def test_artifact_unpack_if_configured_statuses(tmp_path):
+    eng = HealthEngine()
+    assert art.unpack_if_configured(_NS(warm_cache_artifact="")) is None
+    missing = art.unpack_if_configured(
+        _NS(warm_cache_artifact=str(tmp_path / "nope.tgz")),
+        recorder=eng.recorder)
+    assert missing["status"] == "missing"
+    out = str(tmp_path / "warm.tgz")
+    art.pack(out, cache_dir=_make_cache(tmp_path), fingerprint="other",
+             jax_ver="1")
+    refused = art.unpack_if_configured(
+        _NS(warm_cache_artifact=out), recorder=eng.recorder)
+    assert refused["status"] == "refused"
+    kinds = [e["kind"] for e in eng.recorder.snapshot()]
+    assert "warm_cache_refused" in kinds
+
+
+def test_warm_cache_cli_exit_codes(tmp_path):
+    """pack -> verify ok; mismatched unpack exits the DISTINCT code 4."""
+    cache = _make_cache(tmp_path)
+    out = str(tmp_path / "cli.tgz")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, str(ROOT / "tools" / "warm_cache.py"),
+             *args], capture_output=True, text=True, cwd=ROOT, env=env,
+            timeout=120)
+
+    r = run("pack", "--cache-dir", cache, "--out", out, "--json")
+    assert r.returncode == 0, r.stderr[-500:]
+    doc = json.loads(r.stdout)
+    assert doc["ok"] and doc["manifest"]["files"] == 2
+    assert run("verify", out).returncode == 0
+    # rewrite the manifest fingerprint so THIS host mismatches
+    foreign = str(tmp_path / "foreign.tgz")
+    art.pack(foreign, cache_dir=cache, fingerprint="some-other-host",
+             jax_ver=doc["manifest"]["jax_version"])
+    r = run("unpack", foreign, "--root", str(tmp_path / "o"), "--json")
+    assert r.returncode == 4, (r.returncode, r.stderr[-500:])
+    assert json.loads(r.stdout)["refused"]
+    r = run("verify", foreign)
+    assert r.returncode == 4
+    # malformed artifact: a distinct (non-refusal) failure code
+    bad = tmp_path / "bad.tgz"
+    bad.write_bytes(b"not a tarball")
+    assert run("verify", str(bad)).returncode == 3
+
+
+# ------------------------------------------------- perf warm() unit seams
+
+def test_wrap_step_warm_with_avals_then_real_call():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from selkies_tpu.obs import perf as perf_mod
+    reg = perf_mod.PerfRegistry()
+    jitted = jax.jit(lambda x: (x.astype(jnp.float32) * 2).sum())
+    wrapped = perf_mod._WrappedStep("warm.step", jitted, reg)
+    aval = jax.ShapeDtypeStruct((16,), jnp.int32)
+    assert wrapped.warm((aval,)) is True
+    assert reg.report()["count"] == 1
+    x = jnp.arange(16, dtype=jnp.int32)
+    assert float(wrapped(x)) == float(jitted(x))
+    # the real call hit the warmed executable: still ONE analysis
+    assert reg.report()["count"] == 1
+    assert wrapped.warm((aval,)) is True      # idempotent
+
+
+def test_wrap_step_signature_cache_is_bounded_lru():
+    import numpy as np
+
+    from selkies_tpu.obs import perf as perf_mod
+
+    class _Jit:
+        def __call__(self, x):
+            return x
+
+        def lower(self, *a):
+            raise RuntimeError("force fallback entries")
+
+    wrapped = perf_mod._WrappedStep("lru.step", _Jit(),
+                                    perf_mod.PerfRegistry())
+    for n in range(perf_mod._WrappedStep._CACHE_CAP + 4):
+        wrapped(np.zeros((n + 1,)))
+    assert len(wrapped._cache) == perf_mod._WrappedStep._CACHE_CAP
+
+
+def test_perf_registry_is_bounded():
+    from selkies_tpu.obs import perf as perf_mod
+    reg = perf_mod.PerfRegistry(max_steps=5)
+    for n in range(12):
+        reg.record_analysis(f"step{n}")
+    rep = reg.report()
+    assert rep["count"] == 5
+    names = {e["name"] for e in rep["steps"]}
+    assert "step11" in names and "step0" not in names
+
+
+def test_encoder_compile_fault_point_parses():
+    from selkies_tpu.resilience.faults import FaultRegistry, parse_spec
+    specs = parse_spec("encoder.compile:slow:delay_s=0.01")
+    assert specs[0].point == "encoder.compile"
+    reg = FaultRegistry()
+    reg.arm(specs)
+    reg.perturb("encoder.compile")     # sleeping mode: must not raise
+    assert reg.fired_log
+
+
+# -------------------------------------------- acceptance: cross-host cache
+
+_WARM_SNIPPET = """
+import json, sys, time
+import jax
+from selkies_tpu.compile_cache import enable, host_fingerprint
+cache_dir = enable(jax)
+from selkies_tpu.obs import monitor
+monitor.attach_jax(jax)
+from selkies_tpu.prewarm.lattice import Signature
+from selkies_tpu.prewarm import plan
+sig = Signature(48, 32, "jpeg", stripe_height=16, use_paint_over=False)
+t0 = time.monotonic()
+plan.warm_signature(sig)
+print(json.dumps({
+    "cache_dir": cache_dir, "fingerprint": host_fingerprint(),
+    "seconds": round(time.monotonic() - t0, 2),
+    "cache_hits": monitor.cache_hits,
+    "cache_misses": monitor.cache_misses,
+}))
+"""
+
+
+def _run_warm_subprocess(cache_root: str) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               JAX_CACHE_DIR=cache_root)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([sys.executable, "-c", _WARM_SNIPPET],
+                       capture_output=True, text=True, timeout=600,
+                       cwd=ROOT, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return json.loads(r.stdout.splitlines()[-1])
+
+
+def test_warm_cache_artifact_makes_first_build_cache_hit():
+    """The ISSUE 8 acceptance bar: pack on host A -> matched-fingerprint
+    unpack on a fresh cache root -> the first session build in a COLD
+    process is a persistent-cache hit (selkies_compile_cache_* counters
+    via the PR-3 monitor), while a mismatched fingerprint is refused
+    with the distinct exit code (covered in
+    test_warm_cache_cli_exit_codes)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        root_a = os.path.join(tmp, "hostA")
+        root_b = os.path.join(tmp, "hostB")
+        # host A pays the cold compile and populates its cache
+        a = _run_warm_subprocess(root_a)
+        assert os.path.isdir(a["cache_dir"])
+        assert os.listdir(a["cache_dir"]), "cold warm wrote no cache"
+        # pack A's cache, unpack into B's EMPTY root (same fingerprint:
+        # same machine — the mismatch path is refused in the CLI test)
+        artifact_path = os.path.join(tmp, "warm.tgz")
+        art.pack(artifact_path, cache_dir=a["cache_dir"])
+        res = art.unpack(artifact_path, root=root_b)
+        assert res["files"] >= 1
+        # a cold process on "host B" builds the same program: cache HIT
+        b = _run_warm_subprocess(root_b)
+        assert b["cache_hits"] >= 1, b
+        assert b["seconds"] < max(5.0, a["seconds"] / 3), (a, b)
+
+
+def test_perf_kill_switch_skips_instead_of_failing(monkeypatch):
+    """SELKIES_PERF_ANALYSIS=0 disables the AOT path entirely: the
+    worker must mark programs skipped (gate fails OPEN, /api/health
+    stays ok) — never failed."""
+    monkeypatch.setenv("SELKIES_PERF_ANALYSIS", "0")
+    from selkies_tpu.prewarm import plan as _plan
+    p = enumerate_lattice(Signature(640, 480, "jpeg"),
+                          steps=("downscale",))
+    w = PrewarmWorker(p, compiler=_plan.warm_signature)
+    w.run_pending_sync()
+    c = w.counts()
+    assert c["skipped"] == c["lattice_size"] and c["failed"] == 0
+    assert w.health_check().status == OK
+    gate = PrewarmGate(w, p.rung_targets)
+    assert gate.query("downscale", +1) == "warm"   # fail open
+
+
+def test_artifact_garbage_tarballs_stay_in_contract(tmp_path):
+    """Any unreadable/alien tarball must surface as ArtifactError (the
+    boot hook's 'cold boot, not no boot' contract) — not KeyError or
+    TarError leaking out of verify/unpack."""
+    import tarfile as _tar
+    # a valid tar that simply is not an artifact (no manifest)
+    alien = tmp_path / "alien.tgz"
+    (tmp_path / "x.txt").write_text("hi")
+    with _tar.open(alien, "w:gz") as t:
+        t.add(tmp_path / "x.txt", arcname="x.txt")
+    for fn in (art.read_manifest, art.verify, art.unpack):
+        with pytest.raises(art.ArtifactError):
+            fn(str(alien))
+    status = art.unpack_if_configured(
+        _NS(warm_cache_artifact=str(alien)))
+    assert status["status"] == "error"

@@ -1395,3 +1395,39 @@ async def test_client_stats_surface_in_sessions(client_factory):
     assert v["client"] == {"decode_queue": 7.0, "dropped_decodes": 3.0,
                            "draw_fps": 58.5}
     await ws.close()
+
+
+# --------------------------------------------------------- compile plane
+
+async def test_prewarm_endpoint_reports_lattice_and_gate(client_factory):
+    """GET /api/prewarm (ISSUE 8): the worker's lattice snapshot, the
+    ladder's deferral state, and the artifact outcome in one panel —
+    and the ladder is actually gated on the worker."""
+    server, svc, fake, _ = make_app()
+    c = await client_factory(server)
+    r = await c.get("/api/prewarm")
+    body = await r.json()
+    assert r.status == 200 and body["enabled"] is True
+    w = body["worker"]
+    assert w["lattice_size"] >= 2          # base + downscale target
+    assert w["pending"] + w["warmed"] == w["lattice_size"]
+    geoms = {e["geometry"] for e in w["entries"]}
+    assert "1920x1080" in geoms and "960x540" in geoms
+    assert body["ladder"] == {"deferred": None,
+                              "deferred_transitions": 0,
+                              "gated": True, "level": 0}
+    # the gate is the worker's: a cold downscale rung defers
+    assert server.ladder.gate.query("downscale", +1) == "cold"
+    assert server.ladder.gate.query("fps", +1) == "warm"
+    # prewarm health check registered (ok while warming)
+    r = await c.get("/api/health?verbose=1")
+    checks = (await r.json())["checks"]
+    assert checks["prewarm"]["status"] == "ok"
+
+
+async def test_prewarm_disabled_by_setting(client_factory):
+    server, svc, fake, _ = make_app(enable_prewarm=False)
+    c = await client_factory(server)
+    body = await (await c.get("/api/prewarm")).json()
+    assert body["enabled"] is False and body["worker"] is None
+    assert server.ladder.gate is None
