@@ -55,6 +55,18 @@ frame loop and lands compile-free once the background warm finishes
 (knobs: BENCH_CHAOS_COMPILE_DELAY_S, BENCH_CHAOS_STORM_BUDGET_S,
 BENCH_CHAOS_STORM=0 to skip).
 
+Deep pipeline (selkies_tpu/engine/pipeline, ROADMAP 2 / ISSUE 10): a
+paced phase drives the engine at BENCH_PIPELINE_DEPTH (default 2)
+frames in flight with stripe-granular streaming, frames arriving on a
+fixed schedule at 0.8x the serial processing mean — the offered load a
+frame-serial engine cannot sustain. The ``glass_to_glass`` block is
+measured from the SCHEDULED capture tick of this phase (queueing counts
+against the engine), ``occupancy.overlap_fraction`` is its cross-frame
+span overlap, and ``pipeline_depth``/``pipeline`` record the
+configuration so two runs (depth 1 vs 2, same geometry) compare in the
+ledger. Knobs: BENCH_PIPELINE_DEPTH, BENCH_STRIPE_STREAMING=0,
+BENCH_PIPE_BUDGET_S.
+
 Perf observability (selkies_tpu/obs/perf, ISSUE 6): the JSON line
 carries a ``perf`` block (per compiled step: flops, HBM bytes accessed,
 roofline-ms at ~800 GB/s, recorded at compile time — plus the parsed
@@ -215,11 +227,13 @@ def main(force_cpu: bool = False) -> None:
     quality = int(os.environ.get("BENCH_QUALITY", "60"))
     codec = os.environ.get("BENCH_CODEC", "h264")   # the north-star path
 
+    stripe_h = int(os.environ.get("BENCH_STRIPE_H", "64"))
+
     def build(codec_name):
         settings = CaptureSettings(
             capture_width=w, capture_height=h, jpeg_quality=quality,
             output_mode="h264" if codec_name == "h264" else "jpeg",
-            video_crf=28, stripe_height=64,
+            video_crf=28, stripe_height=stripe_h,
             use_damage_gating=True, use_paint_over=False)
         if codec_name == "h264":
             return H264EncoderSession(settings)
@@ -286,6 +300,8 @@ def main(force_cpu: bool = False) -> None:
     # a live session uses. Wire transit is zero on loopback, so the
     # client models fixed decode+present costs; the margin over server
     # e2e is therefore structural and the contract test pins it >= 0.
+    # Since the deep-pipeline rework (ROADMAP 2) the g2g block is
+    # measured by the PACED pipeline phase below, not this serial loop.
     from selkies_tpu.obs.clocksync import ClockSyncEstimator
     G2G_CLIENT_OFFSET_MS = 86_400_000.0   # client clock = server + 24 h
     G2G_DECODE_MS = 0.02                  # modelled client decode cost
@@ -301,8 +317,6 @@ def main(force_cpu: bool = False) -> None:
     for _ in range(8):
         g2g_clock.add_sample(_client_now(), _pc_ms(), _pc_ms(),
                              _client_now())
-    g2g_ms: list = []
-    g2g_margin_ms: list = []
 
     lat = []
     n_lat = 0
@@ -313,7 +327,6 @@ def main(force_cpu: bool = False) -> None:
         f = src.get_frame(100 + t)
         jax.block_until_ready(f)          # exclude frame synthesis
         t0 = time.monotonic()
-        t0_pc = _pc_ms()
         tl = _tracer.frame_begin(bench_display)
         qsess.note_sent(t, t0)
         out = sess.encode(f, force=True)
@@ -322,15 +335,6 @@ def main(force_cpu: bool = False) -> None:
         _tracer.frame_end(bench_display, out["frame_id"])
         qsess.note_ack(t, time.monotonic())
         lat.append(time.monotonic() - t0)
-        e2e_pc = _pc_ms() - t0_pc
-        # the loopback client "receives" the wire bytes now, then pays
-        # its modelled decode+present costs; the timing report maps back
-        # through the estimator exactly as CLIENT_FRAME_TIMING does
-        recv_c = _client_now()
-        present_c = recv_c + G2G_DECODE_MS + G2G_PRESENT_MS
-        frame_g2g = g2g_clock.to_server_ms(present_c) - t0_pc
-        g2g_ms.append(frame_g2g)
-        g2g_margin_ms.append(frame_g2g - e2e_pc)
         total_bytes += sum(len(c.payload) for c in chunks)
         n_lat += 1
         if n_lat >= 5 and time.monotonic() - t_loop > lat_budget:
@@ -359,11 +363,95 @@ def main(force_cpu: bool = False) -> None:
     log(f"stage_sum={stage_sum_ms:.2f}ms vs e2e_mean={lat_mean_ms:.2f}ms "
         f"(coverage {stage_sum_ms / lat_mean_ms:.0%})")
 
-    # occupancy / critical path (ISSUE 6): which stage actually BOUNDED
-    # e2e. This loop is frame-serial, so overlap should read ~0 — the
-    # deep-pipeline rework (ROADMAP 2) is accepted the day this block
-    # shows real overlap while p99 tracks the slowest stage, not the sum
-    occ = occupancy_report(timelines)
+    # occupancy / critical path (ISSUE 6) over the SERIAL loop: overlap
+    # reads ~0 here by construction; the pipeline phase below is where
+    # real overlap shows (ROADMAP 2 landed)
+    occ_serial = occupancy_report(timelines)
+    log("occupancy / critical path (IDR latency loop, serial):")
+    log(render_occupancy(occ_serial))
+
+    # -- deep-pipeline phase (ROADMAP 2): glass-to-glass under offered
+    # load, at the configured depth. Frames arrive on a FIXED SCHEDULE
+    # at 0.8x the serial processing mean — a rate the frame-serial
+    # engine cannot sustain (its queue grows, per-frame g2g inflates
+    # with wait time) while a depth-2 pipeline absorbs it by overlapping
+    # frame N+1's device step with frame N's readback/packetize. g2g is
+    # measured from the SCHEDULED capture tick (the glass event), so
+    # queueing honestly counts against the engine. Run once with
+    # BENCH_PIPELINE_DEPTH=1 and once =2 at the same geometry: the
+    # ledger records overlap_fraction + pipeline_depth per run, and the
+    # acceptance bar is overlap > 0.25 with depth-2 g2g p99 strictly
+    # below the serial run's. -------------------------------------------
+    import threading as _threading
+
+    from selkies_tpu.engine.pipeline import PipelineRing
+    pipe_depth = max(1, int(os.environ.get("BENCH_PIPELINE_DEPTH", "2")))
+    stripe_streaming = os.environ.get("BENCH_STRIPE_STREAMING", "1") != "0"
+    # BENCH_PIPE_PACE_MS pins the schedule across runs (the serial-vs-
+    # depth-2 acceptance pair must see IDENTICAL offered load; deriving
+    # from each run's own serial mean would let phase-1 noise skew the
+    # comparison). Unset: 0.8x this run's serial processing mean.
+    pace_env = os.environ.get("BENCH_PIPE_PACE_MS")
+    period_s = float(pace_env) / 1e3 if pace_env \
+        else max(0.0005, 0.8 * lat_mean_ms / 1e3)
+    pipe_budget = float(os.environ.get("BENCH_PIPE_BUDGET_S", "45"))
+    pipe_frames = max(12, min(240, n_frames))
+    g2g_ms: list = []
+    g2g_margin_ms: list = []
+    pipe_done = [0]
+    pipe_lock = _threading.Lock()
+    _tracer.enable(capacity=1024)
+    _tracer.clear()
+
+    def _pipe_finalize(out: dict) -> None:
+        if stripe_streaming and hasattr(sess, "finalize_stream"):
+            for _c in sess.finalize_stream(out, force_all=True):
+                pass
+        else:
+            sess.finalize(out, force_all=True)
+        _tracer.frame_end(bench_display, out["frame_id"])
+        now_pc = _pc_ms()
+        e2e_pc = now_pc - out["t0_pc"]
+        recv_c = _client_now()
+        present_c = recv_c + G2G_DECODE_MS + G2G_PRESENT_MS
+        frame_g2g = g2g_clock.to_server_ms(present_c) - out["t0_pc"]
+        with pipe_lock:
+            g2g_ms.append(frame_g2g)
+            g2g_margin_ms.append(frame_g2g - e2e_pc)
+            pipe_done[0] += 1
+
+    ring = PipelineRing(_pipe_finalize, depth=pipe_depth,
+                        name="bench-pipe") if pipe_depth > 1 else None
+    start_m = time.monotonic()
+    start_pc = _pc_ms()
+    submitted = 0
+    for t in range(pipe_frames):
+        sched_m = start_m + t * period_s
+        wait = sched_m - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        t0_pc = start_pc + t * period_s * 1e3   # scheduled tick = glass
+        tl = _tracer.frame_begin(bench_display)
+        with _tracer.span("capture", tl):
+            f = src.get_frame(2000 + t)
+        out = sess.encode(f, force=True)
+        out["t0_pc"] = t0_pc
+        _tracer.bind(tl, out["frame_id"])
+        if ring is not None:
+            ring.submit(out)
+        else:
+            out["slot"] = 0
+            _pipe_finalize(out)
+        submitted += 1
+        if submitted >= 12 and time.monotonic() - start_m > pipe_budget:
+            break       # time-budgeted: stay inside the driver's timeout
+    if ring is not None:
+        ring.drain()
+        ring.close(drain=True)
+    pipe_wall_s = time.monotonic() - start_m
+    pipe_timelines = _tracer.snapshot()
+    _tracer.disable()
+    occ = occupancy_report(pipe_timelines)
     occupancy_doc = {
         "frames": occ["frames"],
         "overlap_fraction": occ["overlap_fraction"],
@@ -371,7 +459,16 @@ def main(force_cpu: bool = False) -> None:
         "critical_path_share": {k: v["share"]
                                 for k, v in occ["critical_path"].items()},
     }
-    log("occupancy / critical path (IDR latency loop):")
+    pipeline_doc = {
+        "depth": pipe_depth,
+        "stripe_streaming": stripe_streaming,
+        "period_ms": round(period_s * 1e3, 3),
+        "frames": pipe_done[0],
+        "sustained_fps": round(pipe_done[0] / pipe_wall_s, 2)
+        if pipe_wall_s > 0 else 0.0,
+    }
+    log(f"deep pipeline: depth={pipe_depth} period={period_s * 1e3:.2f}ms "
+        f"frames={pipe_done[0]} overlap={occ['overlap_fraction']:.1%}")
     log(render_occupancy(occ))
 
     # -- throughput: pipelined like the capture thread, SERVING MIX (first
@@ -489,11 +586,12 @@ def main(force_cpu: bool = False) -> None:
     log(f"qoe: rtt_p50={qoe_doc['ack_rtt_p50_ms']}ms "
         f"rtt_p99={qoe_doc['ack_rtt_p99_ms']}ms score={qoe_doc['score']}")
 
-    # glass-to-glass block (ISSUE 7): dispatch -> modelled client
-    # present, mapped through the real clock-sync estimator. min_margin
-    # is the per-frame floor of (g2g - server e2e): the contract test
-    # pins it >= 0 — glass-to-glass can never read better than the
-    # server-side path it contains.
+    # glass-to-glass block (ISSUE 7, re-anchored by ROADMAP 2): from the
+    # SCHEDULED capture tick of the paced pipeline phase -> modelled
+    # client present, mapped through the real clock-sync estimator.
+    # min_margin is the per-frame floor of (g2g - server e2e): the
+    # contract test pins it >= 0 — glass-to-glass can never read better
+    # than the server-side path it contains.
     g2g_pcts = _qoe._percentiles(g2g_ms)
     g2g_doc = {
         "frames": g2g_pcts["n"],
@@ -529,6 +627,8 @@ def main(force_cpu: bool = False) -> None:
         "compile_cache_misses": compile_stats["cache_misses"],
         "qoe": qoe_doc,
         "glass_to_glass": g2g_doc,
+        "pipeline_depth": pipe_depth,
+        "pipeline": pipeline_doc,
         "prewarm": prewarm_doc,
         "perf": perf_doc,
         "occupancy": occupancy_doc,
@@ -559,10 +659,14 @@ async def _chaos_run(target_fps: float, w: int, h: int) -> dict:
     eng.recorder.clear()
     seed = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
     # the script: capture crash ~1s in, relay kill ~2s in (send-hit
-    # counted, stripes multiply per frame), device error ~4s in
+    # counted, stripes multiply per frame), device error ~4s in, then a
+    # MID-PIPELINE readback death (fetch-hit counted: stripe streaming
+    # fetches per stripe) — the depth-2 ring must drain its in-flight
+    # slots through the supervised restart + IDR resync, never wedge
     script = ("capture.source:raise:after=30,count=1;"
               "relay.send:error:after=120,count=1;"
-              "encoder.dispatch:device_error:after=120,count=1")
+              "encoder.dispatch:device_error:after=120,count=1;"
+              "readback.fetch:error:after=240,count=1")
     _faults.registry.disarm()
     _faults.registry.arm(script, seed=seed)
     n_faults = len(_faults.registry.active())
@@ -628,6 +732,8 @@ async def _chaos_run(target_fps: float, w: int, h: int) -> dict:
     ladder = DegradationLadder(down_after_s=0.5, hold_s=1.0,
                                ok_window_s=3.0, recorder=eng.recorder)
     ladder.bind_controls({
+        "pipeline": (lambda: cap.set_pipeline_clamp(1),
+                     lambda: cap.set_pipeline_clamp(None)),
         "fps": (lambda: cap.update_framerate(target_fps / 2),
                 lambda: cap.update_framerate(target_fps)),
         "quality": (lambda: cap.update_tunables(jpeg_quality=20),
@@ -637,7 +743,8 @@ async def _chaos_run(target_fps: float, w: int, h: int) -> dict:
     settings = CaptureSettings(
         capture_width=w, capture_height=h, output_mode="jpeg",
         jpeg_quality=40, target_fps=target_fps, display_id="chaos0",
-        stripe_height=64, use_damage_gating=True, use_paint_over=False)
+        stripe_height=64, use_damage_gating=True, use_paint_over=False,
+        pipeline_depth=2, stripe_streaming=True)
     await loop.run_in_executor(
         None, lambda: cap.start_capture(
             lambda c: loop.call_soon_threadsafe(offer, c), settings))
@@ -677,6 +784,7 @@ async def _chaos_run(target_fps: float, w: int, h: int) -> dict:
     return {
         "seed": seed,
         "script": script,
+        "pipeline_depth": 2,
         "faults_armed": n_faults,
         "faults_fired": len(_faults.registry.fired_log),
         "faults_remaining": _faults.registry.remaining(),

@@ -4,15 +4,20 @@ reference's Python layer consumes from pixelflux (SURVEY.md §2.2).
 Threading model mirrors the reference: a native-side capture/encode thread
 invokes the Python callback per encoded chunk, and the server hops results
 onto the asyncio loop with ``call_soon_threadsafe`` (reference
-selkies.py:4208-4294). Here the "native side" is a Python thread driving the
-TPU: device work is dispatched asynchronously and readbacks are pipelined
-``PIPELINE_DEPTH`` frames deep, so the host-link RTT costs latency, never
-throughput.
+selkies.py:4208-4294). Here the "native side" is a Python thread driving
+the TPU through a depth-N software pipeline (ROADMAP 2,
+engine/pipeline.py): the capture thread dispatches frame N+1's jitted
+step while a finalizer thread still owns frame N's readback/packetize,
+with up to ``settings.pipeline_depth`` frames in flight (default
+:data:`PIPELINE_DEPTH`). Depth 1 is the frame-serial engine; the relay
+backpressure clamp (:meth:`ScreenCapture.set_pipeline_clamp`) and the
+degradation ladder's rung-0 "pipeline" action force it at runtime.
+Delivery is in order per display, always — pipelining is never
+observable in the byte stream.
 """
 
 from __future__ import annotations
 
-import collections
 import functools
 import logging
 import threading
@@ -26,6 +31,7 @@ from ..obs import health as _health
 from ..resilience import faults as _faults
 from ..trace import tracer as _tracer
 from .encoder import JpegEncoderSession
+from .pipeline import PipelineRing, cause_of, retarget
 from .sources import FrameSource, make_source
 from .types import CaptureSettings, EncodedChunk
 
@@ -36,10 +42,12 @@ logger = logging.getLogger("selkies_tpu.engine.capture")
 #: executor thread that called restart() forever
 JOIN_TIMEOUT_S = 5.0
 
-#: frames in flight between device dispatch and host finalize. Deep enough
-#: to hide one host-link RTT at 60 fps; shallow enough to keep glass-to-glass
-#: latency bounded.
-PIPELINE_DEPTH = 3
+#: default frames in flight between device dispatch and delivery (the
+#: ``pipeline_depth`` setting's default). Deep enough to hide one
+#: host-link RTT at 60 fps and overlap the host packetize tail with the
+#: next frame's device step; shallow enough to keep glass-to-glass
+#: latency bounded. 1 = frame-serial.
+PIPELINE_DEPTH = 2
 
 
 # Process-wide frame-turn lock. JAX's async dispatch queue is effectively
@@ -92,6 +100,15 @@ class ScreenCapture:
         #: OS thread + source — counted, never silent)
         self.abandoned_threads = 0
         self.join_timeout_s = JOIN_TIMEOUT_S
+        #: runtime clamp on frames in flight (relay backpressure: a
+        #: paused client clamps to 1 so the engine stops racing ahead
+        #: of a stalled wire); None = unclamped. Read per tick.
+        self._pipeline_clamp: Optional[int] = None
+        #: delivered-frame byte counts pending rate-control accounting
+        #: (finalizer thread appends, capture thread drains — rate
+        #: control always runs on the capture thread)
+        self._delivered_pending: list = []
+        self._delivered_lock = threading.Lock()
 
     # -- reference API surface ----------------------------------------------
     def start_capture(self, callback: Callable[[EncodedChunk], None],
@@ -179,6 +196,19 @@ class ScreenCapture:
     def update_tunables(self, **kw) -> None:
         with self._lock:
             self._tunables_dirty.update(kw)
+
+    def set_pipeline_clamp(self, depth: Optional[int]) -> None:
+        """Clamp frames in flight (relay backpressure window / ladder):
+        the effective depth becomes ``min(settings.pipeline_depth,
+        depth)``. ``None`` lifts the clamp. Takes effect within one
+        frame turn; no session rebuild."""
+        self._pipeline_clamp = None if depth is None else max(1, int(depth))
+
+    def effective_pipeline_depth(self) -> int:
+        """The depth the loop is currently allowed to run at."""
+        from .pipeline import effective_depth
+        return effective_depth(self._settings, self._pipeline_clamp,
+                               PIPELINE_DEPTH)
 
     def update_capture_region(self, x: int, y: int, w: int, h: int) -> None:
         # live region retarget (reference pixelflux x11 path); requires a
@@ -308,7 +338,10 @@ class ScreenCapture:
         pad = None
         if (src.height, src.width) != (g.height, g.width):
             pad = _padder(src.height, src.width, g.height, g.width)
-        inflight: collections.deque = collections.deque()
+        # depth-N pipeline (engine/pipeline.py): dispatch here, finalize
+        # on the ring's thread. Depth 1 (serial) finalizes inline — the
+        # pre-pipeline engine, byte-identical by test contract.
+        ring: Optional[PipelineRing] = None
         tick = 0
         window_bytes, window_start = 0, time.monotonic()
         fps_frames = 0
@@ -317,9 +350,14 @@ class ScreenCapture:
             while running.is_set():
                 t0 = time.monotonic()
                 self._apply_tunables()
+                # live depth retarget (pipeline_depth tunable, ladder
+                # rung-0, backpressure clamp): rebuild/resize the ring
+                # between frames, never mid-slot
+                ring = retarget(ring, self.effective_pipeline_depth(),
+                                self._deliver, f"cap-{s.display_id}")
                 # span tracing (selkies_tpu/trace): one timeline per frame,
                 # begun here, bound to the encoder's frame id after
-                # dispatch, ended at delivery PIPELINE_DEPTH turns later
+                # dispatch, ended at delivery up to depth turns later
                 tl = _tracer.frame_begin(s.display_id)
                 with _tracer.span("capture", tl):
                     # fault point: a raise kills the loop (exercising
@@ -328,7 +366,10 @@ class ScreenCapture:
                     frame = src.get_frame(tick)
                 with _tracer.span("convert", tl):
                     if pad is not None:
-                        frame = pad(frame)
+                        # pad COPIES (output is larger) and its input is
+                        # often a source-cached static frame — donating
+                        # it would delete the cache under the source
+                        frame = pad(frame)  # graftlint: disable=JAX-DONATE-HINT
                 # periodic full refresh (keyframe_interval_s) on top of
                 # client-requested IDRs; <=0 disables the cadence. Decided
                 # BEFORE encode: the h264 session's on-device idr parity
@@ -340,20 +381,31 @@ class ScreenCapture:
                 if force:
                     last_full = t0
                     self._force_idr.clear()
-                # the turn lock scopes one frame's dispatch+readback: a
+                # the turn lock scopes one frame's dispatch: a
                 # compute-bound capture that keeps the XLA CPU queue full
                 # otherwise starves every OTHER capture thread completely
                 # (reproduced: second display froze at frame 4 while the
-                # first ran at 50 fps); uncontended cost is nanoseconds
+                # first ran at 50 fps); uncontended cost is nanoseconds.
+                # The finalizer thread fetches OUTSIDE the turn — that
+                # overlap is the point of the pipeline.
                 with turn:
                     out = sess.encode(frame, force=force)
                     out["force"] = force
                     _tracer.bind(tl, out["frame_id"])
-                    inflight.append(out)
-                    if len(inflight) > PIPELINE_DEPTH:
-                        nb = self._deliver(inflight.popleft())
-                        window_bytes += nb
-                        self._rate_control_frame(nb)
+                if ring is not None:
+                    # blocks while `depth` frames are in flight — the
+                    # engine's own backpressure; raises PipelineError
+                    # if a previous slot's finalize died
+                    ring.submit(out)
+                else:
+                    out["slot"] = 0
+                    self._deliver(out)
+                # rate control runs HERE (capture thread) on delivery
+                # accounting the finalizer queued — session quant/qp
+                # mutations must never race the dispatch path
+                for nb in self._drain_delivered():
+                    window_bytes += nb
+                    self._rate_control_frame(nb)
                 # cursor image changes ride the same thread; the callback
                 # hops to the loop like frame chunks do
                 cb = self._cursor_callback
@@ -377,39 +429,70 @@ class ScreenCapture:
                 sleep = period - (time.monotonic() - t0)
                 if sleep > 0:
                     time.sleep(sleep)
-            while inflight:  # drain
-                self._deliver(inflight.popleft())
+            if ring is not None:        # clean stop: drain in flight
+                ring.close(drain=True)
+                ring = None
         except Exception as e:
+            # a PipelineError wraps the finalizer's death — report the
+            # root cause, not the messenger
+            cause = cause_of(e)
             logger.exception("capture loop died")
             _health.engine.recorder.record(
                 "capture_death", display=s.display_id,
-                error=f"{type(e).__name__}: {e}"[:200])
+                error=f"{type(cause).__name__}: {cause}"[:200])
             running.clear()
             # supervision hook AFTER state is consistent: the supervisor
             # may restart us from another thread immediately
             hook = self.on_death
             if hook is not None:
                 try:
-                    hook(e)
+                    hook(cause)
                 except Exception:
                     logger.exception("capture on_death hook failed")
         finally:
             running.clear()
+            if ring is not None:
+                # death path: discard in-flight slots (the supervisor
+                # rebuilds the session and forces an IDR) — the ring
+                # must never wedge the restart
+                ring.close(drain=False)
+
+    def _drain_delivered(self) -> list:
+        with self._delivered_lock:
+            out, self._delivered_pending = self._delivered_pending, []
+        return out
 
     def _deliver(self, out: dict) -> int:
-        assert self._session is not None
-        chunks = self._session.finalize(out, force_all=out.get("force", False))
+        """Finalize + hand chunks to the callback. Runs on the ring's
+        finalizer thread at depth >= 2, inline at depth 1; either way
+        strictly in submission order. With ``stripe_streaming`` each
+        stripe ships AS ITS BYTES LAND (per-stripe fetch) instead of
+        after the frame barrier."""
+        sess = self._session
+        assert sess is not None
+        s = self._settings
         nbytes = 0
         cb = self._callback
-        for c in chunks:
-            nbytes += len(c.payload)
-            if cb is not None:
-                cb(c)
+        stream = getattr(sess, "finalize_stream", None) \
+            if (s is not None and s.stripe_streaming) else None
+        if stream is not None:
+            for c in stream(out, force_all=out.get("force", False)):
+                nbytes += len(c.payload)
+                if cb is not None:
+                    cb(c)
+        else:
+            chunks = sess.finalize(out, force_all=out.get("force", False))
+            for c in chunks:
+                nbytes += len(c.payload)
+                if cb is not None:
+                    cb(c)
         self.last_frame_bytes = nbytes
-        if self._settings is not None:
+        with self._delivered_lock:
+            self._delivered_pending.append(nbytes)
+        if s is not None:
             # chunks are now queued toward the loop; ws send/ACK spans
             # attach later by frame id while the timeline sits in the ring
-            _tracer.frame_end(self._settings.display_id, out["frame_id"])
+            _tracer.frame_end(s.display_id, out["frame_id"])
         return nbytes
 
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import time
 from typing import Any, Sequence
 
 import jax
@@ -43,6 +44,20 @@ logger = logging.getLogger("selkies_tpu.engine.encoder")
 
 def _round_up(v: int, m: int) -> int:
     return (v + m - 1) // m * m
+
+
+def donate_argnums_for_backend(nums: tuple) -> tuple:
+    """Buffer donation is a DEVICE-memory optimization: on HBM backends
+    it lets N in-flight pipeline slots reuse the framebuffer/state
+    allocations instead of multiplying them. On the host (cpu) backend
+    XLA cannot alias these buffers (it warns 'Some donated buffers were
+    not usable') AND the donation path forces SYNCHRONOUS dispatch —
+    measured: a donated step call blocks for the full compute while the
+    undonated call returns in ~0.1 ms — which would serialize the deep
+    pipeline the donation is meant to serve. Donate only where HBM
+    exists."""
+    import jax
+    return nums if jax.default_backend() != "cpu" else ()
 
 
 @dataclasses.dataclass
@@ -92,7 +107,16 @@ def build_step_fn(width: int, stripe_h: int, n_stripes: int, subsampling: str,
     Signature: step(frame u8 (H,W,3), prev u8 (H,W,3), age i32 (S,),
                     qy_motion/qc_motion/qy_paint/qc_paint f32 (64,))
     -> (data u8 (out_cap,), byte_lens i32 (S,), send bool (S,),
-        is_paint bool (S,), age i32 (S,), overflow bool)
+        is_paint bool (S,), age i32 (S,), prev_out u8 (H,W,3),
+        overflow bool)
+
+    ``prev`` and ``age`` are DONATED (deep-pipeline HBM discipline:
+    in-flight slots reuse the previous generation's buffers instead of
+    doubling HBM). The next frame's reference leaves the step as
+    ``prev_out`` — a materialized copy of ``frame``, NOT the caller's
+    array — so sources stay free to cache/reuse their frame buffers
+    (static X11 grabs hand the same device array back every tick; a
+    donated caller buffer would be deleted under them).
 
     The single-seat session jits this directly; the multi-seat encoder
     (selkies_tpu/parallel/seats.py) vmaps it and shard_maps the batch over
@@ -125,7 +149,13 @@ def build_step_fn(width: int, stripe_h: int, n_stripes: int, subsampling: str,
         sbytes, slens = words_to_bytes_device(packed.words, packed.total_bits)
         buf = concat_stripe_bytes(sbytes, slens, out_cap)
         overflow = jnp.any(packed.overflow) | buf.overflow
-        return buf.data, buf.byte_lens, send, is_paint, age, overflow
+        # the next frame's reference MUST materialize (a plain `frame`
+        # here would jaxpr-forward the caller's buffer out and donation
+        # of prev next step would delete a source-cached array); XLA
+        # reuses the donated prev allocation for it — zero HBM growth
+        prev_out = jnp.bitwise_or(frame, jnp.uint8(0))
+        return buf.data, buf.byte_lens, send, is_paint, age, prev_out, \
+            overflow
 
     # the XLA module compiles as jit_jpeg_step: what a jax.profiler
     # capture's device lane shows, and what obs.perf's capture parser
@@ -138,9 +168,13 @@ def build_step_fn(width: int, stripe_h: int, n_stripes: int, subsampling: str,
 def _jitted_step(width: int, stripe_h: int, n_stripes: int, subsampling: str,
                  e_cap: int, w_cap: int, out_cap: int, paint_delay: int,
                  damage_gating: bool, paint_over: bool):
-    """Compiled single-seat step; only the internal ``age`` state is donated
-    — ``prev`` is the caller's previous frame array and sources are free to
-    reuse their buffers. Wrapped for static cost attribution (obs.perf):
+    """Compiled single-seat step; the HBM-resident ``prev`` framebuffer
+    and ``age`` state are donated (graftlint donate-hint, consumed by the
+    deep-pipeline rework): both are session-owned step outputs of the
+    previous frame, so XLA reuses their allocations for this frame's
+    outputs instead of doubling HBM per in-flight slot. Caller frame
+    arrays are never donated — sources stay free to reuse their buffers.
+    Wrapped for static cost attribution (obs.perf):
     flops / HBM bytes / roofline-ms are recorded at compile time.
 
     Bounded LRU (not ``functools.cache``): runtime geometry retargeting
@@ -155,7 +189,7 @@ def _jitted_step(width: int, stripe_h: int, n_stripes: int, subsampling: str,
         jax.jit(build_step_fn(width, stripe_h, n_stripes, subsampling,
                               e_cap, w_cap, out_cap, paint_delay,
                               damage_gating, paint_over),
-                donate_argnums=(2,)))
+                donate_argnums=donate_argnums_for_backend((1, 2))))
 
 
 class JpegEncoderSession:
@@ -224,6 +258,11 @@ class JpegEncoderSession:
         # fault point: device_error raises (the XLA-runtime-died class),
         # slow stalls the dispatch (compile-storm / saturated-queue class)
         _faults.registry.perturb("encoder.dispatch")
+        # generation BEFORE step: the finalizer thread's overflow-growth
+        # swaps step-then-gen, so the only possible tear is (old gen,
+        # new step) — a benign stale-gen tag — never a new-gen tag on a
+        # frame encoded with the old caps (which would re-double)
+        cap_gen = self._cap_gen
         if self._watermark is not None:
             frame = self._watermark.apply(frame)
         # the dispatch span covers the step call AND the async-copy kicks:
@@ -232,10 +271,12 @@ class JpegEncoderSession:
         # kick synchronizes (CPU) show the compute here — either way the
         # host-visible wait is attributed, never lost between spans
         with _tracer.span("encode.dispatch"):
-            data, lens, send, is_paint, age, overflow = self._step(
-                frame, self._prev, self._age,
-                self._qy_m, self._qc_m, self._qy_p, self._qc_p)
-            self._prev = frame
+            data, lens, send, is_paint, age, prev_out, overflow = \
+                self._step(frame, self._prev, self._age,
+                           self._qy_m, self._qc_m, self._qy_p, self._qc_p)
+            # prev/age were DONATED: the session's reference is the
+            # step's output, never the caller's frame array
+            self._prev = prev_out
             self._age = age
             fid = self.frame_id
             self.frame_id = (self.frame_id + 1) & 0xFFFF
@@ -253,7 +294,7 @@ class JpegEncoderSession:
         # actually quantized with.
         return {"data": data, "lens": lens, "send": send,
                 "is_paint": is_paint, "overflow": overflow, "frame_id": fid,
-                "cap_gen": self._cap_gen,
+                "cap_gen": cap_gen,
                 "qtabs": (self._qy_m_np, self._qc_m_np,
                           self._qy_p_np, self._qc_p_np)}
 
@@ -271,68 +312,125 @@ class JpegEncoderSession:
         """Blocks on the async readback and produces wire-ready chunks."""
         g = self.grid
         # trace target: THIS frame's timeline, by id — never the current
-        # dispatch context, which is PIPELINE_DEPTH frames ahead. ONE
-        # readback span per frame: the overflow flag is the device-sync
-        # point (absorbs the step's compute stall) and the stream fetch
-        # is the link cost — two fragments would double the stage count
-        # and skew its percentiles
+        # dispatch context, which is up to pipeline_depth frames ahead.
+        # ONE readback span per frame on this (batch) path: overflow
+        # sync (absorbs the step's compute stall) + the stream fetch;
+        # the streaming path (finalize_stream) intentionally fragments
+        # per stripe instead — totals stay identical either way.
         tl = _tracer.lookup(self.settings.display_id, out["frame_id"])
-        idle = False
+        # per-slot lane (deep pipeline): occupancy attribution must see
+        # WHICH in-flight slot ran, not just "the finalizer thread"
+        lane = f"slot{out['slot']}" if "slot" in out else None
+        # readback span epoch: a pipelined slot's time-to-bytes starts
+        # at its SUBMIT instant (in-flight time is readback time, not
+        # bubble); serial calls start here
+        rb_t0 = out.get("submitted_ns") or time.perf_counter_ns()
+        overflowed, idle, force_all, lens, send, is_paint = \
+            self._sync_control(out, force_all)
         data = None
-        with _tracer.span("encode.readback", tl):
-            overflowed = bool(np.asarray(out["overflow"]))
-            if not overflowed:
-                if self._force_after_drop:
-                    self._force_after_drop = False
-                    force_all = True
-                lens = np.asarray(out["lens"])
-                send = np.asarray(out["send"])
-                is_paint = np.asarray(out["is_paint"])
-                idle = not (force_all or send.any())
-                if not idle:
-                    starts = np.concatenate([[0], np.cumsum(lens)])
-                    # minimal readback (engine/readback.py): all stripes
-                    # are always in the buffer, so the used prefix is
-                    # everything up to the last DELIVERED stripe —
-                    # capacity padding never crosses the link
-                    from .readback import fetch_stream_bytes
-                    deliver = np.nonzero(send)[0] if not force_all \
-                        else np.arange(g.n_stripes)
-                    last = int(deliver[-1])
-                    data = fetch_stream_bytes(out["data"],
-                                              int(starts[last] + lens[last]))
+        if not overflowed and not idle:
+            starts = np.concatenate([[0], np.cumsum(lens)])
+            # minimal readback (engine/readback.py): all stripes
+            # are always in the buffer, so the used prefix is
+            # everything up to the last DELIVERED stripe —
+            # capacity padding never crosses the link
+            from .readback import fetch_stream_bytes
+            deliver = np.nonzero(send)[0] if not force_all \
+                else np.arange(g.n_stripes)
+            last = int(deliver[-1])
+            data = fetch_stream_bytes(out["data"],
+                                      int(starts[last] + lens[last]))
+        _tracer.record_span(tl, "encode.readback", rb_t0, lane=lane)
         if overflowed:
-            # Event overflow is impossible (e_cap is worst-case), so this is
-            # a word/output buffer overflow: drop the frame, double the
-            # growable buffers, recompile ONCE per episode (pipelined frames
-            # encoded with the stale caps also overflow but must not
-            # re-double). The client never saw this frame, but _prev already
-            # advanced past it — force the next delivered frame to resend
-            # every stripe so damage gating can't freeze stale content.
-            if out.get("cap_gen", self._cap_gen) == self._cap_gen:
-                logger.warning("encoder overflow at frame %d; raising "
-                               "capacity", out["frame_id"])
-                self._w_cap *= 2
-                self._out_cap *= 2
-                self._cap_gen += 1
-                self._step = self._build_step()
-            self._force_after_drop = True
+            self._handle_overflow(out)
             return []
         if idle:
             return []                 # idle frame: fetched nothing at all
-        with _tracer.span("packetize", tl):
+        with _tracer.span("packetize", tl, lane=lane):
             chunks: list[EncodedChunk] = []
             for i in range(g.n_stripes):
                 if not (force_all or send[i]):
                     continue
                 raw = data[starts[i]:starts[i] + lens[i]]
-                scan = stuff_ff_bytes(raw)
-                chunks.append(EncodedChunk(
-                    payload=self._jfif_wrap(scan, bool(is_paint[i]),
-                                            out["qtabs"]),
-                    frame_id=out["frame_id"], stripe_y=i * g.stripe_h,
-                    width=g.width, height=g.stripe_h, is_idr=True,
-                    output_mode="jpeg",
-                    seat_index=self.settings.seat_index,
-                    display_id=self.settings.display_id))
+                chunks.append(self._chunk(out, i, raw, bool(is_paint[i])))
         return chunks
+
+    def finalize_stream(self, out: dict[str, Any], force_all: bool = False):
+        """Stripe-granular finalize (deep pipeline, ROADMAP 2): yields
+        each stripe's wire-ready chunk AS ITS BYTES LAND — per-stripe
+        device fetches (engine/readback.fetch_stripe_bytes) instead of
+        one frame-barrier prefix fetch, so the fanout ships the first
+        stripe while later stripes are still crossing the host link.
+        Byte-identical to :meth:`finalize` (same buffer, same slices;
+        tests pin it for both codecs)."""
+        g = self.grid
+        tl = _tracer.lookup(self.settings.display_id, out["frame_id"])
+        lane = f"slot{out['slot']}" if "slot" in out else None
+        rb_t0 = out.get("submitted_ns") or time.perf_counter_ns()
+        overflowed, idle, force_all, lens, send, is_paint = \
+            self._sync_control(out, force_all)
+        _tracer.record_span(tl, "encode.readback", rb_t0, lane=lane)
+        if overflowed:
+            self._handle_overflow(out)
+            return
+        if idle:
+            return
+        from .readback import fetch_stripe_bytes
+        starts = np.concatenate([[0], np.cumsum(lens)])
+        for i in range(g.n_stripes):
+            if not (force_all or send[i]):
+                continue
+            with _tracer.span("encode.readback", tl, lane=lane):
+                raw = fetch_stripe_bytes(out["data"], int(starts[i]),
+                                         int(lens[i]))
+            with _tracer.span("packetize", tl, lane=lane):
+                chunk = self._chunk(out, i, raw, bool(is_paint[i]))
+            yield chunk
+
+    def _sync_control(self, out: dict[str, Any], force_all: bool):
+        """Control-array sync shared by finalize and finalize_stream —
+        the one device-sync point (absorbs the step's compute stall) and
+        the force-after-drop promotion. -> (overflowed, idle, force_all,
+        lens, send, is_paint)."""
+        if bool(np.asarray(out["overflow"])):
+            return True, True, force_all, None, None, None
+        if self._force_after_drop:
+            self._force_after_drop = False
+            force_all = True
+        lens = np.asarray(out["lens"])
+        send = np.asarray(out["send"])
+        is_paint = np.asarray(out["is_paint"])
+        idle = not (force_all or send.any())
+        return False, idle, force_all, lens, send, is_paint
+
+    def _chunk(self, out: dict[str, Any], i: int, raw: np.ndarray,
+               paint: bool) -> EncodedChunk:
+        g = self.grid
+        scan = stuff_ff_bytes(raw)
+        return EncodedChunk(
+            payload=self._jfif_wrap(scan, paint, out["qtabs"]),
+            frame_id=out["frame_id"], stripe_y=i * g.stripe_h,
+            width=g.width, height=g.stripe_h, is_idr=True,
+            output_mode="jpeg",
+            seat_index=self.settings.seat_index,
+            display_id=self.settings.display_id)
+
+    def _handle_overflow(self, out: dict[str, Any]) -> None:
+        """Event overflow is impossible (e_cap is worst-case), so this is
+        a word/output buffer overflow: drop the frame, double the
+        growable buffers, recompile ONCE per episode (pipelined frames
+        encoded with the stale caps also overflow but must not
+        re-double). The client never saw this frame, but _prev already
+        advanced past it — force the next delivered frame to resend
+        every stripe so damage gating can't freeze stale content."""
+        if out.get("cap_gen", self._cap_gen) == self._cap_gen:
+            logger.warning("encoder overflow at frame %d; raising "
+                           "capacity", out["frame_id"])
+            self._w_cap *= 2
+            self._out_cap *= 2
+            # step BEFORE gen (see encode()'s read order): a concurrent
+            # encode must never observe the new generation with the old
+            # step still in hand
+            self._step = self._build_step()
+            self._cap_gen += 1
+        self._force_after_drop = True

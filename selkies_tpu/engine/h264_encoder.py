@@ -26,6 +26,8 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import threading
+import time
 from typing import Any
 
 import jax
@@ -115,15 +117,18 @@ def build_h264_step_fn(mode: str, width: int, stripe_h: int, n_stripes: int,
 
     Both modes share the damage/paint-over/stream-counter logic and
     maintain the decoder-exact reconstruction planes on device — the P
-    mode's reference. state inputs (age, sent, fnum, ref planes) are
-    donated.
+    mode's reference. HBM-resident state inputs (prev framebuffer, age,
+    sent, fnum, ref planes) are donated (deep-pipeline HBM discipline);
+    the next frame's damage reference leaves as ``prev_out``, a
+    materialized copy of ``frame`` — never the caller's array, so
+    sources stay free to cache/reuse their frame buffers.
 
     signature (I): step(frame, prev, age, sent, fnum, ref_y, ref_u, ref_v,
                         qp_motion, qp_paint, force, hdr_pay, hdr_nb)
     signature (P): same, ``force`` unused (P is never forced).
     -> (data u8 (out_cap,), row_lens i32 (R,), send (S,), is_paint (S,),
         age (S,), sent (S,), fnum (S,), recon_y, recon_u, recon_v,
-        overflow)
+        prev_out, overflow)
     """
     rows_per_stripe = stripe_h // 16
 
@@ -191,8 +196,11 @@ def build_h264_step_fn(mode: str, width: int, stripe_h: int, n_stripes: int,
                                                  pad_ones=False)
         buf = concat_stripe_bytes(sbytes, row_lens, out_cap)
         overflow = out.overflow | buf.overflow
+        # materialized (bitwise_or defeats jaxpr input-forwarding): the
+        # donated prev allocation is reused for it — zero HBM growth
+        prev_out = jnp.bitwise_or(frame, jnp.uint8(0))
         return (buf.data, buf.byte_lens, send, is_paint, age, sent, fnum,
-                new_ry, new_ru, new_rv, overflow)
+                new_ry, new_ru, new_rv, prev_out, overflow)
 
     # the XLA module compiles as jit_h264_{i,p}_step: the name a
     # jax.profiler capture's device lane carries, and the stem obs.perf's
@@ -214,10 +222,12 @@ def _jitted_h264_step(mode: str, width: int, stripe_h: int, n_stripes: int,
                               paint_over, candidates, fullcolor=fullcolor)
     # static cost attribution (obs.perf): flops / HBM bytes / roofline-ms
     # recorded at compile time, so levers rank with the relay down
+    from .encoder import donate_argnums_for_backend
     return _perf.wrap_step(
         f"h264.{mode}_step[{width}x{stripe_h * n_stripes}"
         f"{'@444' if fullcolor else ''}]",
-        jax.jit(step, donate_argnums=(2, 3, 4, 5, 6, 7)))
+        jax.jit(step, donate_argnums=donate_argnums_for_backend(
+            (1, 2, 3, 4, 5, 6, 7))))
 
 
 class H264EncoderSession:
@@ -246,6 +256,10 @@ class H264EncoderSession:
         self._ref_v = jnp.zeros((g.height // cdiv, g.width // cdiv),
                                 jnp.uint8)
         self._force_after_drop = False
+        # deep pipeline: encode() (capture thread) tests-and-clears the
+        # flag while finalize (finalizer thread) sets it on overflow —
+        # the lock keeps a concurrent set from being lost to the clear
+        self._drop_lock = threading.Lock()
         self._cap_gen = 0   # buffer-growth generation (pipelined frames
         #                     encoded with stale caps must not re-grow)
         # per-stripe stream headers (cached; identical for every stripe)
@@ -308,9 +322,14 @@ class H264EncoderSession:
         # fault point: device_error raises (the XLA-runtime-died class),
         # slow stalls the dispatch (compile-storm / saturated-queue class)
         _faults.registry.perturb("encoder.dispatch")
-        if self._force_after_drop:
-            self._force_after_drop = False
-            force = True
+        # generation BEFORE the step refs (growth swaps steps-then-gen,
+        # so the only possible tear is a benign stale-gen tag — never a
+        # new-gen tag on a frame encoded with the old caps)
+        cap_gen = self._cap_gen
+        with self._drop_lock:
+            if self._force_after_drop:
+                self._force_after_drop = False
+                force = True
         if self.frame_id == 0:
             # every stripe stream must OPEN with an IDR: an undamaged
             # stripe skipped here would otherwise debut as a P delta
@@ -328,12 +347,14 @@ class H264EncoderSession:
         # host-visible wait is attributed, never lost between spans
         with _tracer.span("encode.dispatch"):
             (data, row_lens, send, is_paint, age, sent, fnum,
-             ry, ru, rv, overflow) = step(
+             ry, ru, rv, prev_out, overflow) = step(
                 frame, self._prev, self._age, self._sent, self._fnum,
                 self._ref_y, self._ref_u, self._ref_v,
                 jnp.int32(self.qp), jnp.int32(self.paint_qp),
                 jnp.asarray(bool(force)), hdr_pay, hdr_nb)
-            self._prev = frame
+            # prev (and the rest of the state) was DONATED: the session's
+            # reference is the step's output, never the caller's array
+            self._prev = prev_out
             self._age = age
             self._sent = sent
             self._fnum = fnum
@@ -350,7 +371,7 @@ class H264EncoderSession:
                     pass
         return {"data": data, "lens": row_lens, "send": send,
                 "is_paint": is_paint, "overflow": overflow, "frame_id": fid,
-                "intra": intra, "cap_gen": self._cap_gen}
+                "intra": intra, "cap_gen": cap_gen}
 
     # -- host tail ----------------------------------------------------------
     def finalize(self, out: dict[str, Any], force_all: bool = False
@@ -363,53 +384,110 @@ class H264EncoderSession:
         # device-sync point and the stream fetch the link cost — two
         # fragments would double the stage count and skew percentiles
         tl = _tracer.lookup(self.settings.display_id, out["frame_id"])
-        idle = False
+        # per-slot lane (deep pipeline): occupancy attribution must see
+        # WHICH in-flight slot ran, not just "the finalizer thread"
+        lane = f"slot{out['slot']}" if "slot" in out else None
+        # readback epoch: a pipelined slot's in-flight time IS readback
+        rb_t0 = out.get("submitted_ns") or time.perf_counter_ns()
+        overflowed, idle, lens, send, intra = self._sync_control(out)
         data = None
-        with _tracer.span("encode.readback", tl):
-            overflowed = bool(np.asarray(out["overflow"]))
-            if not overflowed:
-                lens = np.asarray(out["lens"])    # (R,) per MB row
-                send = np.asarray(out["send"])
-                intra = out.get("intra", True)
-                idle = not send.any()
-                if not idle:
-                    starts = np.concatenate([[0], np.cumsum(lens)])
-                    rps = g.rows_per_stripe
-                    # minimal readback (engine/readback.py): fetch through
-                    # the last DELIVERED stripe's rows — capacity padding
-                    # and trailing unsent stripes never cross the host link
-                    from .readback import fetch_stream_bytes
-                    last_row = (int(np.nonzero(send)[0][-1]) + 1) * rps
-                    data = fetch_stream_bytes(out["data"],
-                                              int(starts[last_row]))
+        if not overflowed and not idle:
+            starts = np.concatenate([[0], np.cumsum(lens)])
+            rps = g.rows_per_stripe
+            # minimal readback (engine/readback.py): fetch through
+            # the last DELIVERED stripe's rows — capacity padding
+            # and trailing unsent stripes never cross the host link
+            from .readback import fetch_stream_bytes
+            last_row = (int(np.nonzero(send)[0][-1]) + 1) * rps
+            data = fetch_stream_bytes(out["data"],
+                                      int(starts[last_row]))
+        _tracer.record_span(tl, "encode.readback", rb_t0, lane=lane)
         if overflowed:
-            # grow once per episode: pipelined frames encoded with the old
-            # caps also report overflow but must not re-double/re-jit
-            if out["cap_gen"] == self._cap_gen:
-                logger.warning("h264 overflow at frame %d; growing buffers",
-                               out["frame_id"])
-                self._w_cap *= 2
-                self._out_cap *= 2
-                self._cap_gen += 1
-                self._i_step = self._build_step("i")
-                self._p_step = self._build_step("p")
-            self._force_after_drop = True
+            self._handle_overflow(out)
             return []
         if idle:
             return []                 # idle frame: fetched nothing at all
-        with _tracer.span("packetize", tl):
+        with _tracer.span("packetize", tl, lane=lane):
             chunks: list[EncodedChunk] = []
             for i in range(g.n_stripes):
                 if not send[i]:
                     continue
-                rows = []
-                for r in range(i * rps, (i + 1) * rps):
-                    rows.append(bytes(data[starts[r]:starts[r] + lens[r]]))
-                payload = h264_stripe_payload(intra, rows, self._sps_pps)
-                chunks.append(EncodedChunk(
-                    payload=payload, frame_id=out["frame_id"],
-                    stripe_y=i * g.stripe_h, width=g.width,
-                    height=g.stripe_h, is_idr=intra, output_mode="h264",
-                    seat_index=self.settings.seat_index,
-                    display_id=self.settings.display_id))
+                rows = [bytes(data[starts[r]:starts[r] + lens[r]])
+                        for r in range(i * rps, (i + 1) * rps)]
+                chunks.append(self._chunk(out, i, rows, intra))
         return chunks
+
+    def finalize_stream(self, out: dict[str, Any], force_all: bool = False):
+        """Stripe-granular finalize (deep pipeline, ROADMAP 2): yields
+        each stripe's access unit AS ITS ROWS' BYTES LAND — per-stripe
+        device fetches instead of the frame-barrier prefix fetch.
+        Byte-identical to :meth:`finalize`; chain-gating semantics are
+        untouched (chunks still carry is_idr per stripe and flow through
+        the same relay row gates)."""
+        del force_all
+        g = self.grid
+        tl = _tracer.lookup(self.settings.display_id, out["frame_id"])
+        lane = f"slot{out['slot']}" if "slot" in out else None
+        rb_t0 = out.get("submitted_ns") or time.perf_counter_ns()
+        overflowed, idle, lens, send, intra = self._sync_control(out)
+        _tracer.record_span(tl, "encode.readback", rb_t0, lane=lane)
+        if overflowed:
+            self._handle_overflow(out)
+            return
+        if idle:
+            return
+        from .readback import fetch_stripe_bytes
+        starts = np.concatenate([[0], np.cumsum(lens)])
+        rps = g.rows_per_stripe
+        for i in range(g.n_stripes):
+            if not send[i]:
+                continue
+            r0, r1 = i * rps, (i + 1) * rps
+            with _tracer.span("encode.readback", tl, lane=lane):
+                raw = fetch_stripe_bytes(
+                    out["data"], int(starts[r0]),
+                    int(starts[r1] - starts[r0]))
+            with _tracer.span("packetize", tl, lane=lane):
+                base = int(starts[r0])
+                rows = [bytes(raw[starts[r] - base:starts[r + 1] - base])
+                        for r in range(r0, r1)]
+                chunk = self._chunk(out, i, rows, intra)
+            yield chunk
+
+    def _sync_control(self, out: dict[str, Any]):
+        """Control-array sync shared by finalize and finalize_stream —
+        the one device-sync point. -> (overflowed, idle, lens, send,
+        intra)."""
+        if bool(np.asarray(out["overflow"])):
+            return True, True, None, None, True
+        lens = np.asarray(out["lens"])    # (R,) per MB row
+        send = np.asarray(out["send"])
+        intra = out.get("intra", True)
+        idle = not send.any()
+        return False, idle, lens, send, intra
+
+    def _chunk(self, out: dict[str, Any], i: int, rows: list,
+               intra: bool) -> EncodedChunk:
+        g = self.grid
+        return EncodedChunk(
+            payload=h264_stripe_payload(intra, rows, self._sps_pps),
+            frame_id=out["frame_id"], stripe_y=i * g.stripe_h,
+            width=g.width, height=g.stripe_h, is_idr=intra,
+            output_mode="h264",
+            seat_index=self.settings.seat_index,
+            display_id=self.settings.display_id)
+
+    def _handle_overflow(self, out: dict[str, Any]) -> None:
+        # grow once per episode: pipelined frames encoded with the old
+        # caps also report overflow but must not re-double/re-jit
+        if out["cap_gen"] == self._cap_gen:
+            logger.warning("h264 overflow at frame %d; growing buffers",
+                           out["frame_id"])
+            self._w_cap *= 2
+            self._out_cap *= 2
+            # steps BEFORE gen (see encode()'s read order)
+            self._i_step = self._build_step("i")
+            self._p_step = self._build_step("p")
+            self._cap_gen += 1
+        with self._drop_lock:
+            self._force_after_drop = True

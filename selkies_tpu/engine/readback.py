@@ -10,6 +10,18 @@ readback is ~0.5 MB/frame over an RTT-bound tunnel; a typical P frame
 fits in 32-64 KB, and an idle frame (no stripes sent) now fetches
 nothing at all. Byte-identical to fetching everything — the slice is a
 prefix; tests cover both encoders bit-exactly.
+
+Stripe-granular path (ROADMAP 2): :func:`fetch_stripe_bytes` slices an
+ARBITRARY byte range on device (``dynamic_slice`` with a bucketed
+static length, so the jit cache stays one-per-bucket) — the deep
+pipeline's streaming finalize ships each stripe's bytes as they land
+instead of waiting on the frame barrier. Stripe fetches use a smaller
+bucket floor than the whole-frame prefix: a stripe is latency-bound,
+not bandwidth-bound.
+
+Both fetch paths carry the ``readback.fetch`` fault point
+(``slow``/``error``): an injected mid-pipeline readback death exercises
+the ring-drain recovery path (``bench.py --chaos``).
 """
 
 from __future__ import annotations
@@ -18,8 +30,29 @@ import functools
 
 import numpy as np
 
-#: smallest fetch; below this the dispatch RTT dominates the bytes
+from ..resilience import faults as _faults
+
+#: smallest whole-frame fetch; below this the dispatch RTT dominates
 MIN_BUCKET = 32768
+
+#: smallest per-stripe fetch (stripe streaming is latency-bound)
+MIN_STRIPE_BUCKET = 4096
+
+
+def _on_host(arr) -> bool:
+    """True when the buffer already lives in host memory (cpu backend).
+    Minimal readback exists to save the HOST LINK; on the cpu backend
+    there is no link, and routing the fetch through a jitted slice
+    would enqueue compute on the XLA stream — a pipelined fetch then
+    serializes behind the next frame's step. ``np.asarray`` on a ready
+    host buffer waits only for ITS producing computation, never the
+    queue, so the deep pipeline's finalizer never contends with the
+    capture thread's dispatches."""
+    try:
+        devs = arr.devices()
+        return all(d.platform == "cpu" for d in devs)
+    except Exception:
+        return True     # plain numpy / unknown: host semantics
 
 
 @functools.lru_cache(maxsize=64)
@@ -32,8 +65,19 @@ def _slice_fn(bucket: int):
     return jax.jit(lambda d: d[..., :bucket])
 
 
-def bucket_for(total: int) -> int:
-    b = MIN_BUCKET
+@functools.lru_cache(maxsize=64)
+def _stripe_slice_fn(bucket: int):
+    import jax
+    from jax import lax
+    # traced start, static bucket length: one compile per bucket covers
+    # every stripe offset (dynamic_slice clamps start so start+bucket
+    # stays in range — the host caller compensates, see fetch_stripe)
+    return jax.jit(lambda d, s: lax.dynamic_slice_in_dim(
+        d, s, bucket, axis=d.ndim - 1))
+
+
+def bucket_for(total: int, floor: int = MIN_BUCKET) -> int:
+    b = floor
     while b < total:
         b *= 2
     return b
@@ -43,10 +87,37 @@ def fetch_stream_bytes(data_dev, total: int) -> np.ndarray:
     """Fetch the first ``total`` bytes (along the last axis) of the
     device stream buffer, rounded up to a bucket so the jit cache stays
     tiny."""
+    _faults.registry.perturb("readback.fetch")
     if total <= 0:
         return np.zeros(tuple(data_dev.shape[:-1]) + (0,), np.uint8)
     n = int(data_dev.shape[-1])
+    if _on_host(data_dev):
+        return np.asarray(data_dev)[..., :min(total, n)]
     bucket = bucket_for(total)
     if bucket >= n:
         return np.asarray(data_dev)
     return np.asarray(_slice_fn(bucket)(data_dev))
+
+
+def fetch_stripe_bytes(data_dev, start: int, length: int) -> np.ndarray:
+    """Fetch ``length`` bytes at ``start`` (along the last axis) — the
+    stripe-streaming fetch. Byte-identical to the same range of a
+    whole-prefix fetch; the bucketed device slice may over-fetch up to
+    one bucket, never under."""
+    _faults.registry.perturb("readback.fetch")
+    if length <= 0:
+        return np.zeros(tuple(data_dev.shape[:-1]) + (0,), np.uint8)
+    n = int(data_dev.shape[-1])
+    start = max(0, int(start))
+    length = min(int(length), n - start)
+    if _on_host(data_dev):
+        return np.asarray(data_dev)[..., start:start + length]
+    bucket = bucket_for(length, MIN_STRIPE_BUCKET)
+    if bucket >= n:
+        return np.asarray(data_dev)[..., start:start + length]
+    # dynamic_slice clamps start to n - bucket: fetch the clamped
+    # window and re-offset on the host so the caller's range is exact
+    eff = min(start, n - bucket)
+    raw = np.asarray(_stripe_slice_fn(bucket)(data_dev, eff))
+    off = start - eff
+    return raw[..., off:off + length]

@@ -39,6 +39,16 @@ class CaptureSettings:
     paint_over_delay_frames: int = 15
     # striping (reference striped encoding, SURVEY.md §2.5)
     stripe_height: int = 64
+    # deep pipeline (ROADMAP 2): frames in flight between dispatch and
+    # delivery. 1 = frame-serial (the pre-pipeline engine); >=2 runs a
+    # finalizer thread so frame N+1 dispatches while N reads back. The
+    # relay backpressure clamp and the degradation ladder's rung-0
+    # "pipeline" action can force 1 at runtime without a session rebuild.
+    pipeline_depth: int = 2
+    # ship each stripe's bytes as its readback lands (per-stripe fetch,
+    # engine/readback.py) instead of waiting on the frame barrier —
+    # client first-stripe receive decouples from frame-complete
+    stripe_streaming: bool = True
     # h264 inter motion search (scroll/pan candidates; 0 vrange disables).
     # Dense vertical offsets up to vrange px; power-of-two horizontal pans
     # up to hrange px. The encoders behind the reference's design

@@ -19,7 +19,8 @@ import threading
 import time
 from typing import Callable, Optional
 
-from ..engine.capture import _ENCODE_TURN
+from ..engine.capture import _ENCODE_TURN, PIPELINE_DEPTH
+from ..engine.pipeline import PipelineRing, cause_of, retarget
 from ..engine.types import CaptureSettings, EncodedChunk
 from ..obs import health as _health
 from ..resilience import faults as _faults
@@ -48,6 +49,9 @@ class MultiSeatCapture:
         #: supervision hook (same contract as ScreenCapture.on_death):
         #: called with the exception when the loop DIES, never on stop
         self.on_death: Optional[Callable[[BaseException], None]] = None
+        #: runtime frames-in-flight clamp (same contract as
+        #: ScreenCapture.set_pipeline_clamp)
+        self._pipeline_clamp: Optional[int] = None
 
     # ----------------------------------------------------- reference surface
     def start_capture(self, callback, settings: CaptureSettings) -> None:
@@ -92,6 +96,14 @@ class MultiSeatCapture:
             self._settings.video_bitrate_kbps = int(kbps)
 
     def update_tunables(self, **kw) -> None:
+        # the ladder's rung-0 actuator and any settings-shaped tunable
+        # must land on the loop's settings object (the ScreenCapture
+        # contract) — the fps/quality paths below additionally reach
+        # into the encoder
+        if self._settings is not None:
+            for k, v in kw.items():
+                if hasattr(self._settings, k):
+                    setattr(self._settings, k, v)
         enc = self._enc
         if enc is None:
             return
@@ -117,6 +129,14 @@ class MultiSeatCapture:
     def set_cursor_callback(self, cb) -> None:
         self._cursor_callback = cb
 
+    def set_pipeline_clamp(self, depth: Optional[int]) -> None:
+        self._pipeline_clamp = None if depth is None else max(1, int(depth))
+
+    def effective_pipeline_depth(self) -> int:
+        from ..engine.pipeline import effective_depth
+        return effective_depth(self._settings, self._pipeline_clamp,
+                               PIPELINE_DEPTH)
+
     def restart(self, settings: Optional[CaptureSettings] = None) -> None:
         with self._api_lock:
             if self._callback is None:
@@ -124,6 +144,28 @@ class MultiSeatCapture:
             self.start_capture(self._callback, settings or self._settings)
 
     # ------------------------------------------------------------------ loop
+    def _deliver(self, out: dict) -> None:
+        """Finalize one multi-seat slot + fan per-seat chunks out. Runs
+        on the ring's finalizer thread at depth >= 2, inline at depth 1;
+        in submission order either way, so per-seat delivery stays in
+        order (the seat axis shares ONE slot per tick)."""
+        enc = self._enc
+        assert enc is not None
+        if isinstance(enc, MultiSeatH264Encoder):
+            per_seat = enc.finalize(out)
+        else:
+            per_seat = enc.finalize(out, force_all=out.get("force", False))
+        cb = self._callback
+        nbytes = 0
+        for chunks in per_seat:
+            for c in chunks:
+                nbytes += len(c.payload)
+                if cb is not None:
+                    cb(c)
+        self.last_frame_bytes = nbytes
+        if self._settings is not None:
+            _tracer.frame_end(self._settings.display_id, out["frame_id"])
+
     def _run(self) -> None:
         assert self._settings and self._enc
         s, enc = self._settings, self._enc
@@ -133,9 +175,15 @@ class MultiSeatCapture:
         # one timeline covers all seats per tick; alias keys route the
         # per-seat relay send/ACK spans onto it
         seat_aliases = tuple(f"seat{i}" for i in range(self.n_seats))
+        # same depth-N pipeline as ScreenCapture (engine/pipeline.py):
+        # dispatch the sharded step for tick N+1 while tick N's seats
+        # are still being read back / packetized
+        ring: Optional[PipelineRing] = None
         try:
             while running.is_set():
                 t0 = time.monotonic()
+                ring = retarget(ring, self.effective_pipeline_depth(),
+                                self._deliver, "seats")
                 tl = _tracer.frame_begin(s.display_id)
                 with _tracer.span("capture", tl):
                     _faults.registry.perturb("capture.source")
@@ -146,24 +194,16 @@ class MultiSeatCapture:
                 with _ENCODE_TURN:
                     if isinstance(enc, MultiSeatH264Encoder):
                         out = enc.encode(frames, force=force)
-                        _tracer.bind(tl, out["frame_id"],
-                                     aliases=seat_aliases)
-                        per_seat = enc.finalize(out)
                     else:
                         out = enc.encode(frames)
-                        _tracer.bind(tl, out["frame_id"],
-                                     aliases=seat_aliases)
-                        per_seat = enc.finalize(
-                            out, force_all=force or tick == 0)
-                cb = self._callback
-                nbytes = 0
-                for chunks in per_seat:
-                    for c in chunks:
-                        nbytes += len(c.payload)
-                        if cb is not None:
-                            cb(c)
-                self.last_frame_bytes = nbytes
-                _tracer.frame_end(s.display_id, out["frame_id"])
+                        out["force"] = force or tick == 0
+                    _tracer.bind(tl, out["frame_id"],
+                                 aliases=seat_aliases)
+                if ring is not None:
+                    ring.submit(out)
+                else:
+                    out["slot"] = 0
+                    self._deliver(out)
                 tick += 1
                 window_frames += 1
                 now = time.monotonic()
@@ -173,17 +213,23 @@ class MultiSeatCapture:
                 sleep = 1.0 / max(s.target_fps, 1.0) - (time.monotonic() - t0)
                 if sleep > 0:
                     time.sleep(sleep)
+            if ring is not None:
+                ring.close(drain=True)
+                ring = None
         except Exception as e:
+            cause = cause_of(e)
             logger.exception("multi-seat capture loop died")
             _health.engine.recorder.record(
                 "capture_death", display=s.display_id, seats=self.n_seats,
-                error=f"{type(e).__name__}: {e}"[:200])
+                error=f"{type(cause).__name__}: {cause}"[:200])
             running.clear()
             hook = self.on_death
             if hook is not None:
                 try:
-                    hook(e)
+                    hook(cause)
                 except Exception:
                     logger.exception("multi-seat on_death hook failed")
         finally:
             running.clear()
+            if ring is not None:
+                ring.close(drain=False)

@@ -18,6 +18,7 @@ refreshes cheap.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Optional, Sequence
 
 import jax
@@ -104,16 +105,20 @@ class MultiSeatH264Encoder:
         spec = self._spec
         sharded = shard_map(jax.vmap(step), mesh=self.mesh,
                             in_specs=(spec,) * 13,
-                            out_specs=(spec,) * 11)
+                            out_specs=(spec,) * 12)
         # compile as jit_h264_seatsN_{i,p}_step so a profiler capture
         # attributes multi-seat device time to the seats row, distinct
         # from the single-seat h264_{i,p}_step stem
         sharded.__name__ = f"h264_seats{self.n_seats}_{mode}_step"
         from ..obs import perf as _perf
+        # prev + codec state donated (deep-pipeline HBM discipline):
+        # all are session-owned outputs of the previous step
+        from ..engine.encoder import donate_argnums_for_backend
         return _perf.wrap_step(
             f"h264.seats{self.n_seats}_{mode}_step"
             f"[{g.width}x{g.height}]",
-            jax.jit(sharded, donate_argnums=(2, 3, 4, 5, 6, 7)))
+            jax.jit(sharded, donate_argnums=donate_argnums_for_backend(
+                (1, 2, 3, 4, 5, 6, 7))))
 
     # ------------------------------------------------------------------ state
     @property
@@ -126,6 +131,9 @@ class MultiSeatH264Encoder:
         """One sharded I/P step over all seats. ``force`` (or the first
         frame, or a post-overflow recovery on ANY seat) runs the IDR
         step batch-wide."""
+        # generation BEFORE the step refs (growth swaps steps-then-gen;
+        # the only possible tear is a benign stale-gen tag)
+        cap_gen = self._cap_gen
         if self._force_after_drop.any():
             self._force_after_drop[:] = False
             force = True
@@ -146,11 +154,12 @@ class MultiSeatH264Encoder:
         # kick synchronizes (CPU) still attribute the compute wait here
         with _tracer.span("encode.dispatch"):
             (data, row_lens, send, is_paint, age, sent, fnum,
-             ry, ru, rv, overflow) = step(
+             ry, ru, rv, prev_out, overflow) = step(
                 frames, self._prev, self._age, self._sent, self._fnum,
                 self._ref_y, self._ref_u, self._ref_v,
                 qp, pqp, forces, hdr_pay, hdr_nb)
-            self._prev = frames
+            # prev (and codec state) donated: keep the step's output
+            self._prev = prev_out
             self._age = age
             self._sent = sent
             self._fnum = fnum
@@ -166,7 +175,7 @@ class MultiSeatH264Encoder:
                     pass
         return {"data": data, "lens": row_lens, "send": send,
                 "overflow": overflow, "frame_id": fid, "intra": intra,
-                "cap_gen": self._cap_gen}
+                "cap_gen": cap_gen}
 
     # --------------------------------------------------------------- finalize
     def finalize(self, out: dict[str, Any], force_all: bool = False
@@ -174,22 +183,23 @@ class MultiSeatH264Encoder:
         del force_all                       # encode()-time decision
         g = self.grid
         tl = _tracer.lookup(self.settings.display_id, out["frame_id"])
-        with _tracer.span("encode.readback", tl):
-            lens = np.asarray(out["lens"])      # (S, R)
-            send = np.asarray(out["send"])      # (S, n_stripes)
-            overflow = np.asarray(out["overflow"])   # (S,)
-            # minimal readback (engine/readback.py), matching the
-            # single-seat shape: per seat only rows through the last SENT
-            # stripe count; all-idle frames fetch nothing
-            from ..engine.readback import fetch_stream_bytes
-            rps_ = g.rows_per_stripe
-            total = 0
-            for seat in range(self.n_seats):
-                if overflow[seat] or not send[seat].any():
-                    continue
-                last_row = (int(np.nonzero(send[seat])[0][-1]) + 1) * rps_
-                total = max(total, int(lens[seat, :last_row].sum()))
-            data = fetch_stream_bytes(out["data"], total) if total else None
+        rb_t0 = out.get("submitted_ns") or time.perf_counter_ns()
+        lens = np.asarray(out["lens"])      # (S, R)
+        send = np.asarray(out["send"])      # (S, n_stripes)
+        overflow = np.asarray(out["overflow"])   # (S,)
+        # minimal readback (engine/readback.py), matching the
+        # single-seat shape: per seat only rows through the last SENT
+        # stripe count; all-idle frames fetch nothing
+        from ..engine.readback import fetch_stream_bytes
+        rps_ = g.rows_per_stripe
+        total = 0
+        for seat in range(self.n_seats):
+            if overflow[seat] or not send[seat].any():
+                continue
+            last_row = (int(np.nonzero(send[seat])[0][-1]) + 1) * rps_
+            total = max(total, int(lens[seat, :last_row].sum()))
+        data = fetch_stream_bytes(out["data"], total) if total else None
+        _tracer.record_span(tl, "encode.readback", rb_t0)
         intra = out["intra"]
         if overflow.any():
             if out["cap_gen"] == self._cap_gen:
@@ -198,9 +208,10 @@ class MultiSeatH264Encoder:
                     np.nonzero(overflow)[0].tolist())
                 self._w_cap *= 2
                 self._out_cap *= 2
-                self._cap_gen += 1
+                # steps BEFORE gen (see encode()'s read order)
                 self._i_step = self._build("i")
                 self._p_step = self._build("p")
+                self._cap_gen += 1
             self._force_after_drop |= overflow
         results: list[list[EncodedChunk]] = []
         rps = g.rows_per_stripe

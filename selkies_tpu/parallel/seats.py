@@ -16,6 +16,7 @@ must divide seats.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Optional, Sequence
 
 import jax
@@ -83,6 +84,8 @@ class MultiSeatEncoder:
         self._age = jax.device_put(
             np.zeros((n_seats, g.n_stripes), np.int32), self._sharding)
         self._force_after_drop = np.zeros((n_seats,), bool)
+        self._cap_gen = 0   # growth generation: pipelined frames encoded
+        #                     with stale caps must not re-grow/re-jit
         self.update_quality(settings.jpeg_quality,
                             settings.paint_over_quality)
 
@@ -95,17 +98,22 @@ class MultiSeatEncoder:
                              s.use_damage_gating, s.use_paint_over)
         spec = self._spec
         sharded = shard_map(jax.vmap(step), mesh=self.mesh,
-                            in_specs=(spec,) * 7, out_specs=(spec,) * 6)
+                            in_specs=(spec,) * 7, out_specs=(spec,) * 7)
         # the XLA module must compile as jit_jpeg_seatsN_step (NOT the
         # inner jpeg_step) so a profiler capture attributes multi-seat
         # device time to the seats row, and the single-seat stem
         # ("jpeg_step") can't claim these events
         sharded.__name__ = f"jpeg_seats{self.n_seats}_step"
         from ..obs import perf as _perf
+        # prev + age donated (deep-pipeline HBM discipline): both are
+        # session-owned outputs of the previous step, so N in-flight
+        # slots reuse the same per-seat framebuffer allocations
+        from ..engine.encoder import donate_argnums_for_backend
         return _perf.wrap_step(
             f"jpeg.seats{self.n_seats}_step[{g.width}x{g.height}"
             f"@{self.subsampling}]",
-            jax.jit(sharded, donate_argnums=(2,)))
+            jax.jit(sharded,
+                    donate_argnums=donate_argnums_for_backend((1, 2))))
 
     # --------------------------------------------------------------- tunables
     def update_quality(self, motion_q: int, paint_q: int | None = None):
@@ -144,18 +152,24 @@ class MultiSeatEncoder:
 
         ``frames``: (n_seats, grid.height, grid.width, 3) uint8, ideally
         already placed with :attr:`input_sharding`. ``prev`` defaults to
-        the internally-tracked previous batch.
+        the internally-tracked previous batch; an explicitly-passed
+        ``prev`` is DONATED to the step (its buffer is consumed).
         """
         if prev is None:
             prev = getattr(self, "_prev", None)
             if prev is None:
                 prev = self.make_prev_buffer()
+        # generation BEFORE step (growth swaps step-then-gen; the only
+        # possible tear is a benign stale-gen tag)
+        cap_gen = self._cap_gen
         # covers the step AND the async-copy kicks so backends whose copy
         # kick synchronizes (CPU) still attribute the compute wait here
         with _tracer.span("encode.dispatch"):
-            data, lens, send, is_paint, age, overflow = self._step(
-                frames, prev, self._age, *self._qt_dev)
-            self._prev = frames
+            data, lens, send, is_paint, age, prev_out, overflow = \
+                self._step(frames, prev, self._age, *self._qt_dev)
+            # prev/age were donated: the session's reference is the
+            # step's materialized output, never the caller's batch
+            self._prev = prev_out
             self._age = age
             fid = self.frame_id
             self.frame_id = (self.frame_id + 1) & 0xFFFF
@@ -168,7 +182,7 @@ class MultiSeatEncoder:
                     pass
         return {"data": data, "lens": lens, "send": send,
                 "is_paint": is_paint, "overflow": overflow, "frame_id": fid,
-                "qtabs": self._qt_np}
+                "cap_gen": cap_gen, "qtabs": self._qt_np}
 
     # --------------------------------------------------------------- finalize
     def finalize(self, out: dict[str, Any], force_all: bool = False
@@ -176,40 +190,48 @@ class MultiSeatEncoder:
         """Blocks on readback; returns ``chunks[seat]`` lists."""
         g = self.grid
         # ONE readback span per frame (control-array sync + stream
-        # fetch); fragments would double the stage count
+        # fetch); fragments would double the stage count. Epoch: a
+        # pipelined slot's in-flight time (submit -> bytes) IS readback.
         tl = _tracer.lookup(self.settings.display_id, out["frame_id"])
-        with _tracer.span("encode.readback", tl):
-            lens = np.asarray(out["lens"])        # (S, n_stripes)
-            send = np.asarray(out["send"])
-            is_paint = np.asarray(out["is_paint"])
-            overflow = np.asarray(out["overflow"])  # (S,)
-            # minimal readback (engine/readback.py), matching the
-            # single-seat shape: per seat only bytes through the last
-            # DELIVERED stripe count; all-idle frames fetch nothing.
-            # Overflowed seats are skipped here, so the growth pass below
-            # (which only flags THOSE seats) can run after the fetch.
-            from ..engine.readback import fetch_stream_bytes
-            total = 0
-            for seat in range(self.n_seats):
-                if overflow[seat]:
-                    continue
-                if force_all or self._force_after_drop[seat]:
-                    total = max(total, int(lens[seat].sum()))
-                elif send[seat].any():
-                    last = int(np.nonzero(send[seat])[0][-1])
-                    total = max(total, int(lens[seat, :last + 1].sum()))
-            data = fetch_stream_bytes(out["data"], total) if total else None
+        rb_t0 = out.get("submitted_ns") or time.perf_counter_ns()
+        lens = np.asarray(out["lens"])        # (S, n_stripes)
+        send = np.asarray(out["send"])
+        is_paint = np.asarray(out["is_paint"])
+        overflow = np.asarray(out["overflow"])  # (S,)
+        # minimal readback (engine/readback.py), matching the
+        # single-seat shape: per seat only bytes through the last
+        # DELIVERED stripe count; all-idle frames fetch nothing.
+        # Overflowed seats are skipped here, so the growth pass below
+        # (which only flags THOSE seats) can run after the fetch.
+        from ..engine.readback import fetch_stream_bytes
+        total = 0
+        for seat in range(self.n_seats):
+            if overflow[seat]:
+                continue
+            if force_all or self._force_after_drop[seat]:
+                total = max(total, int(lens[seat].sum()))
+            elif send[seat].any():
+                last = int(np.nonzero(send[seat])[0][-1])
+                total = max(total, int(lens[seat, :last + 1].sum()))
+        data = fetch_stream_bytes(out["data"], total) if total else None
+        _tracer.record_span(tl, "encode.readback", rb_t0)
         qy_m, qc_m, qy_p, qc_p = out["qtabs"]
 
         if overflow.any():
             # same growth policy as the single-seat session: drop the
-            # overflowed seats' frames, double the growable buffers once,
-            # recompile, and force their next delivered frame to full
-            logger.warning("multi-seat overflow on seats %s; growing buffers",
-                           np.nonzero(overflow)[0].tolist())
-            self._w_cap *= 2
-            self._out_cap *= 2
-            self._step = self._build_step()
+            # overflowed seats' frames, double the growable buffers ONCE
+            # per episode (pipelined frames encoded with stale caps also
+            # overflow but must not re-double), recompile, and force
+            # their next delivered frame to full
+            if out.get("cap_gen", self._cap_gen) == self._cap_gen:
+                logger.warning(
+                    "multi-seat overflow on seats %s; growing buffers",
+                    np.nonzero(overflow)[0].tolist())
+                self._w_cap *= 2
+                self._out_cap *= 2
+                # step BEFORE gen (see encode()'s read order)
+                self._step = self._build_step()
+                self._cap_gen += 1
             self._force_after_drop |= overflow
 
         results: list[list[EncodedChunk]] = []

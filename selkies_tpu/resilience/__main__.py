@@ -141,7 +141,11 @@ def _cmd_selftest(args: argparse.Namespace) -> int:
     # -- ladder: hysteresis down, hold, sustained-ok up ------------------
     lclk = _Clock()
     calls: list[str] = []
-    lad = DegradationLadder(down_after_s=4.0, hold_s=10.0, ok_window_s=30.0,
+    # explicit two-rung table: this block tests the hysteresis state
+    # machine, not the default rung walk (which now opens with the
+    # deep-pipeline rung — covered by tests/test_resilience.py)
+    lad = DegradationLadder(steps=("fps", "quality"),
+                            down_after_s=4.0, hold_s=10.0, ok_window_s=30.0,
                             clock=lclk, recorder=eng.recorder)
     lad.bind_controls({
         "fps": (lambda: calls.append("fps-"), lambda: calls.append("fps+")),

@@ -28,6 +28,10 @@ Injection points and their modes:
 ========================  =======================================
 ``relay.send``            ``stall`` (sleep past the send bound),
                           ``error`` (ConnectionError)
+``relay.stripe``          ``reorder`` (swap the two newest queued
+                          stripes in a relay — the out-of-order wire
+                          delivery the per-row chain gate + IDR
+                          resync must absorb)
 ``capture.source``        ``raise`` (source throws), ``freeze``
                           (source blocks ``delay_s``)
 ``encoder.dispatch``      ``slow`` (sleep ``delay_s``),
@@ -35,6 +39,9 @@ Injection points and their modes:
 ``encoder.compile``       ``slow`` (sleep ``delay_s`` inside the step
                           compile site — the injected 20 s XLA build
                           the compile-plane contract defends against)
+``readback.fetch``        ``slow`` (sleep ``delay_s``), ``error``
+                          (mid-pipeline readback death: the ring must
+                          drain, never wedge — bench --chaos proves it)
 ``ws.accept``             ``close`` / ``error`` (upgrade rejected)
 ========================  =======================================
 
@@ -63,9 +70,11 @@ __all__ = ["FaultError", "FaultSpec", "FaultRegistry", "parse_spec",
 #: so a typo'd spec fails at arm time, never silently no-ops in a run.
 POINTS: dict[str, tuple[str, ...]] = {
     "relay.send": ("stall", "error"),
+    "relay.stripe": ("reorder",),
     "capture.source": ("raise", "freeze"),
     "encoder.dispatch": ("slow", "device_error"),
     "encoder.compile": ("slow",),
+    "readback.fetch": ("slow", "error"),
     "ws.accept": ("close", "error"),
 }
 

@@ -9,9 +9,12 @@ verdicts the PR-3/PR-4 planes already compute (``qoe`` failed,
 configurable ladder of fidelity concessions —
 
     level 0  full fidelity
-    level 1  target fps halved (floor: ``min_fps``)
-    level 2  quality/rate cut (JPEG quality down, H.264 bitrate down)
-    level 3  capture downscale
+    level 1  pipeline depth -> 1 (frame-serial: sheds the in-flight
+             frames' worth of latency/HBM before touching fidelity —
+             the deep-pipeline rung, ROADMAP 2)
+    level 2  target fps halved (floor: ``min_fps``)
+    level 3  quality/rate cut (JPEG quality down, H.264 bitrate down)
+    level 4  capture downscale
 
 — with **hysteresis** in both directions: a trigger must persist
 ``down_after_s`` before the first downshift, ``hold_s`` must elapse
@@ -69,8 +72,10 @@ DEFAULT_TRIGGERS: dict[str, frozenset] = {
     "stage_latency": frozenset({_health.DEGRADED, _health.FAILED}),
 }
 
-#: rung names above level 0, in downshift order
-DEFAULT_STEPS = ("fps", "quality", "downscale")
+#: rung names above level 0, in downshift order. "pipeline" (drop the
+#: engine to frame-serial, depth 1) sheds latency without costing any
+#: fidelity, so it is the first thing to give up and the first restored.
+DEFAULT_STEPS = ("pipeline", "fps", "quality", "downscale")
 
 _EVENT_CAP = 64
 

@@ -143,6 +143,20 @@ class VideoRelay:
                 return
         self._q.append(item)
         self._q_bytes += len(item)
+        # fault point relay.stripe:reorder — swap the two newest queued
+        # stripes so the wire delivers them out of order (stripe
+        # streaming makes per-stripe sends the common case; the decode
+        # contract must survive reordering: JPEG stripes are
+        # independent, H.264 rows re-sync through the chain gate + IDR).
+        # Queue-depth check FIRST: a clause must not be consumed (and
+        # counted as fired) on an offer that cannot inject anything.
+        if len(self._q) >= 2 \
+                and _faults.registry.pull("relay.stripe") is not None:
+            self._q[-1], self._q[-2] = self._q[-2], self._q[-1]
+            if item[0] == OP_H264:
+                # a swapped delta may now precede its row's reference:
+                # treat it like a break and ask for a clean restart
+                self._ask_idr()
         while self._q_bytes > self.budget and len(self._q) > 1:
             victim = self._q.popleft()
             self._q_bytes -= len(victim)

@@ -433,6 +433,8 @@ class WebSocketsService(BaseStreamingService):
         if ladder is None:
             return
         ladder.bind_controls({
+            "pipeline": (self._ladder_pipeline_down,
+                         self._ladder_pipeline_up),
             "fps": (self._ladder_fps_down, self._ladder_fps_up),
             "quality": (self._ladder_quality_down, self._ladder_quality_up),
             "downscale": (self._ladder_scale_down, self._ladder_scale_up),
@@ -453,6 +455,31 @@ class WebSocketsService(BaseStreamingService):
                         "not restoring %s", key, current, orig)
             return None
         return orig
+
+    def _ladder_pipeline_down(self):
+        """Rung 0 (deep pipeline): drop to frame-serial. Sheds the
+        in-flight frames' worth of queueing latency and HBM without
+        costing any fidelity — always the first concession."""
+        s = self.settings
+        cur = int(getattr(s, "pipeline_depth", 2))
+        if cur <= 1:
+            return False            # already serial: not applied
+        self._pre_degrade.setdefault("pipeline_depth", (cur, 1))
+        s.set_server("pipeline_depth", 1)
+        for cap in self.captures.values():
+            cap.update_tunables(pipeline_depth=1)
+        logger.warning("ladder: pipeline depth %d -> 1 (serial)", cur)
+
+    def _ladder_pipeline_up(self):
+        old = self._ladder_restore(
+            "pipeline_depth", int(getattr(self.settings,
+                                          "pipeline_depth", 2)))
+        if old is None:
+            return False            # nothing to restore: not applied
+        self.settings.set_server("pipeline_depth", int(old))
+        for cap in self.captures.values():
+            cap.update_tunables(pipeline_depth=int(old))
+        logger.info("ladder: pipeline depth restored to %d", old)
 
     def _ladder_fps_down(self):
         s = self.settings
@@ -613,8 +640,13 @@ class WebSocketsService(BaseStreamingService):
             buf, self._rec_buf = self._rec_buf, bytearray()
             try:
                 self._flush_recording(buf)
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                # final flush on teardown: losing the recording tail is
+                # acceptable, losing the stop path is not — but say so.
+                # ValueError is the live class here: a write against a
+                # file another teardown path already closed.
+                logger.warning("final recording flush failed",
+                               exc_info=True)
         if self._rec_file is not None:
             try:
                 self._rec_file.close()
@@ -695,6 +727,8 @@ class WebSocketsService(BaseStreamingService):
             use_paint_over=s.use_paint_over,
             paint_over_quality=s.paint_over_quality,
             stripe_height=s.stripe_height,
+            pipeline_depth=int(getattr(s, "pipeline_depth", 2)),
+            stripe_streaming=bool(getattr(s, "stripe_streaming", True)),
             h264_motion_vrange=s.h264_motion_vrange,
             h264_motion_hrange=s.h264_motion_hrange,
             capture_x=self.display_offsets.get(display_id, (0, 0))[0],
@@ -919,8 +953,8 @@ class WebSocketsService(BaseStreamingService):
                     relay.mark_dead()
                 try:
                     await c.ws.close()
-                except Exception:
-                    pass
+                except (ConnectionError, RuntimeError, OSError):
+                    pass  # already torn down by the peer
 
         await asyncio.gather(*(_one(c) for c in list(self.clients.values())))
 
@@ -1024,6 +1058,9 @@ class WebSocketsService(BaseStreamingService):
 
     async def _disconnect(self, client: ClientConnection) -> None:
         self.clients.pop(client.id, None)
+        # a paused client leaving must not strand the depth clamp
+        if client.paused:
+            self._apply_pipeline_clamp()
         _qoe.registry.unregister(client.qoe)
         self._drop_relay_supervision(client)
         for relay in client.relays.values():
@@ -1330,6 +1367,20 @@ class WebSocketsService(BaseStreamingService):
         if client.qoe is not None:
             client.qoe.note_client_stats(body)
 
+    def _apply_pipeline_clamp(self) -> None:
+        """Relay-backpressure clamp on the deep pipeline (ROADMAP 2):
+        while any client of a display is paused, its capture runs at
+        depth 1 — frames in flight would just age in the relay queue of
+        a stalled wire, costing glass-to-glass latency and HBM for
+        nothing. Lifted the moment no viewer is paused."""
+        paused = {c.display for c in self.clients.values() if c.paused}
+        for did, cap in self.captures.items():
+            clamp_fn = getattr(cap, "set_pipeline_clamp", None)
+            if clamp_fn is None:
+                continue
+            clamped = did in paused or (did == "__seats__" and paused)
+            clamp_fn(1 if clamped else None)
+
     def _update_backpressure(self, client: ClientConnection) -> None:
         """Desync window scales with measured client fps; RTT forgiveness is
         capped upstream by the ACK cadence itself (reference
@@ -1339,6 +1390,7 @@ class WebSocketsService(BaseStreamingService):
                              self.settings.ack_desync_frames / 60.0))
         if not client.paused and dist > window:
             client.paused = True
+            self._apply_pipeline_clamp()
             metrics.inc_counter("selkies_backpressure_events_total")
             now = time.monotonic()
             if client.qoe is not None:
@@ -1363,6 +1415,7 @@ class WebSocketsService(BaseStreamingService):
             drained = all(r.drained() for r in client.relays.values())
             if dist < window // 2 or drained:
                 client.paused = False
+                self._apply_pipeline_clamp()
                 if client.qoe is not None:
                     dur = client.qoe.backpressure_end(time.monotonic())
                     if dur is not None:
@@ -1588,6 +1641,7 @@ class WebSocketsService(BaseStreamingService):
                         and c.last_sent_id != c.last_ack_id \
                         and c.last_ack_time < stalled:
                     c.paused = True
+                    self._apply_pipeline_clamp()
                     metrics.inc_counter("selkies_backpressure_events_total")
                     if c.qoe is not None:
                         c.qoe.note_stall()

@@ -128,6 +128,17 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
     _s("stripe_height", SType.INT, 64,
        "Row-stripe height in px for intra-frame parallel encode "
        "(reference striped encoding, SURVEY §2.5).", vmin=16, vmax=1088),
+    _s("pipeline_depth", SType.INT, 2,
+       "Frames in flight between device dispatch and delivery (deep "
+       "pipeline, ROADMAP 2). 1 = frame-serial; >=2 overlaps frame N+1's "
+       "jitted step with frame N's readback/packetize on a finalizer "
+       "thread. Clamped to 1 at runtime while a client is backpressured, "
+       "and by the degradation ladder's rung-0 'pipeline' action.",
+       vmin=1, vmax=8),
+    _s("stripe_streaming", SType.BOOL, True,
+       "Ship each stripe's bytes as its readback lands (per-stripe "
+       "device fetch) instead of waiting on the frame barrier — client "
+       "first-stripe receive decouples from frame-complete."),
     _s("h264_motion_vrange", SType.INT, 24,
        "H.264 inter motion search: dense vertical scroll candidates up to "
        "this many px (0 disables motion search).", vmin=0, vmax=64),

@@ -247,6 +247,16 @@ class FrameTracer:
             tl.t1_ns = max(tl.t1_ns, t0_ns + max(0, dur_ns))
         return True
 
+    def record_span(self, tl: Optional[FrameTimeline], name: str,
+                    t0_ns: int, lane: Optional[str] = None) -> None:
+        """Record a span with an EXPLICIT start ending now — the deep
+        pipeline's readback span starts at the slot's submit instant
+        (frames-in-flight time is readback time, not bubble), which no
+        context manager entered on this thread can know."""
+        if tl is None or not self._enabled:
+            return
+        self._record(tl, name, lane, t0_ns, max(0, _now_ns() - t0_ns))
+
     def instant(self, display_id: str, frame_id: int, name: str,
                 lane: Optional[str] = None) -> bool:
         """Zero-duration marker (exported as a trace-event instant)."""

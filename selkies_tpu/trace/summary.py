@@ -209,12 +209,39 @@ def lane_occupancy(timelines: Iterable[Union[FrameTimeline, dict]]
     return dict(sorted(out.items(), key=lambda kv: -kv[1]["occupancy"]))
 
 
+def window_overlap_fraction(dicts: list) -> float:
+    """Cross-frame span overlap over the whole observed window:
+    ``1 - union(all spans)/sum(all spans)`` across every completed
+    frame's spans together. A frame-serial engine reads ~0 (consecutive
+    frames' spans never coexist); a depth-N pipeline reads the fraction
+    of span time that genuinely ran concurrently — frame N+1's
+    ``encode.dispatch`` under frame N's readback/packetize. This is THE
+    deep-pipeline acceptance number (ROADMAP 2): per-frame stages of a
+    pipelined engine still run in sequence *within* each frame, so only
+    the window view can see the overlap."""
+    ivs: list[tuple[int, int]] = []
+    total = 0
+    for d in dicts:
+        if d.get("t1_ns") is None:
+            continue
+        for s in d.get("spans", []):
+            if s["dur_ns"] > 0:
+                ivs.append((s["t0_ns"], s["t0_ns"] + s["dur_ns"]))
+                total += s["dur_ns"]
+    if total <= 0:
+        return 0.0
+    union = sum(b - a for a, b in _merge_intervals(ivs))
+    return max(0.0, 1.0 - union / total)
+
+
 def occupancy_report(timelines: Iterable[Union[FrameTimeline, dict]]
                      ) -> dict:
     """Aggregate occupancy / critical-path analysis over completed
-    frames. Aggregate ``overlap_fraction`` and the per-stage
-    ``critical_path`` shares come from the per-frame totals (not a mean
-    of ratios), so long frames weigh what they should."""
+    frames. ``overlap_fraction`` is the WINDOW-level cross-frame overlap
+    (:func:`window_overlap_fraction`); the per-frame identity
+    ``stages + bubble == e2e`` still holds exactly per frame, and the
+    per-stage ``critical_path`` shares come from the per-frame totals
+    (not a mean of ratios), so long frames weigh what they should."""
     dicts = [tl if isinstance(tl, dict) else tl.to_dict()
              for tl in timelines]
     per = [cp for cp in (frame_critical_path(d) for d in dicts)
@@ -224,9 +251,7 @@ def occupancy_report(timelines: Iterable[Union[FrameTimeline, dict]]
                 "critical_path": {}, "e2e_ms": {}, "lanes": {}}
     e2e = sorted(cp["e2e_ms"] for cp in per)
     e2e_total = sum(e2e)
-    sum_total = sum(cp["stage_sum_ms"] for cp in per)
     bubble_total = sum(cp["bubble_ms"] for cp in per)
-    union_total = e2e_total - bubble_total
     stage_tot: dict[str, float] = {}
     for cp in per:
         for name, ms in cp["stages"].items():
@@ -237,8 +262,7 @@ def occupancy_report(timelines: Iterable[Union[FrameTimeline, dict]]
         for name, tot in sorted(stage_tot.items(), key=lambda kv: -kv[1])}
     return {
         "frames": len(per),
-        "overlap_fraction": round(max(0.0, 1.0 - union_total / sum_total), 4)
-        if sum_total > 0 else 0.0,
+        "overlap_fraction": round(window_overlap_fraction(dicts), 4),
         "bubble_share": round(bubble_total / e2e_total, 4)
         if e2e_total else 0.0,
         "critical_path": critical,

@@ -36,7 +36,8 @@ def _bench_doc() -> dict:
                JAX_PLATFORMS="cpu", BENCH_CPU_REASON="relay-dead",
                BENCH_WIDTH="256", BENCH_HEIGHT="128",
                BENCH_FRAMES="6", BENCH_LAT_BUDGET_S="10",
-               BENCH_TP_BUDGET_S="10", BENCH_PROBE_BUDGET_S="1",
+               BENCH_TP_BUDGET_S="10", BENCH_PIPE_BUDGET_S="15",
+               BENCH_PROBE_BUDGET_S="1",
                PERF_LEDGER_PATH=_LEDGER)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     r = subprocess.run([sys.executable, str(ROOT / "bench.py")],
@@ -59,8 +60,14 @@ def test_bench_emits_single_json_line():
     # CPU number
     assert doc["backend"].startswith(("cpu-fallback", "cpu", "tpu",
                                       "axon"))
-    # per-stage latency attribution (ISSUE 2): every stage key present,
-    # and the stage sum within 20% of the measured e2e frame latency
+    # per-stage latency attribution (ISSUE 2, re-scoped by ISSUE 10):
+    # every stage key present. The ±20% stage-sum-vs-e2e coverage
+    # contract is only meaningful FRAME-SERIALLY — and bench.py's stage
+    # table + latency_mean_ms come from the ALWAYS-serial IDR loop
+    # regardless of BENCH_PIPELINE_DEPTH, so the contract holds (and is
+    # asserted) at every depth. The PIPELINED phase is covered by the
+    # occupancy identity instead (test_bench_occupancy_block), where
+    # stage sum exceeding e2e is the point.
     from selkies_tpu.trace import STAGES
     assert set(doc["stages_ms"]) == set(STAGES)
     stage_sum = doc["stage_sum_ms"]
@@ -115,18 +122,32 @@ def test_bench_perf_block():
 
 
 def test_bench_occupancy_block():
-    """ISSUE 6: overlap fraction + per-stage critical-path share. The
-    bench latency loop is frame-serial, so overlap must read ~0 and the
-    shares (+bubble) must account for the whole frame window."""
+    """ISSUE 6 + 10: the occupancy block now measures the PIPELINE
+    phase. The occupancy identity must hold at every depth: per-frame
+    critical-path shares + bubble account for the whole frame window
+    (stages + bubble == e2e), i.e. the critical path never exceeds the
+    stage sum; overlap is the cross-frame window fraction."""
     from selkies_tpu.trace import STAGES
     from selkies_tpu.trace.summary import BUBBLE
     doc = _bench_doc()
     occ = doc["occupancy"]
     assert occ["frames"] > 0
-    assert 0.0 <= occ["overlap_fraction"] <= 0.3
+    assert 0.0 <= occ["overlap_fraction"] < 1.0
     shares = occ["critical_path_share"]
     assert set(shares) <= set(STAGES) | {BUBBLE}
     assert abs(sum(shares.values()) + occ["bubble_share"] - 1.0) < 0.05
+
+
+def test_bench_pipeline_block():
+    """ISSUE 10: the deep-pipeline phase documents its configuration —
+    depth, pacing period, streaming — so a serial and a depth-2 run at
+    the same geometry compare honestly in the ledger."""
+    doc = _bench_doc()
+    assert doc["pipeline_depth"] == 2          # the default
+    p = doc["pipeline"]
+    assert p["depth"] == 2 and p["stripe_streaming"] is True
+    assert p["period_ms"] > 0 and p["frames"] >= 12
+    assert p["sustained_fps"] > 0
 
 
 def test_bench_ledger_autorecord():
@@ -144,6 +165,9 @@ def test_bench_ledger_autorecord():
     assert e["backend_health"] == "failed"
     assert e["baseline_eligible"] is False
     assert e["resolution"] == "256x128"
+    # ISSUE 10: the depth/overlap acceptance pair rides every entry
+    assert e["pipeline_depth"] == 2
+    assert isinstance(e["overlap_fraction"], (int, float))
     # and check refuses to gate on it: rc 3 = "no gateable number"
     # (0 under --warn-only), so a hard gate can't go green on it
     assert perf_ledger.main(["--ledger", _LEDGER, "check"]) == 3

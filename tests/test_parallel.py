@@ -95,8 +95,8 @@ def test_dryrun_multichip_entrypoint():
     fn, args = mod.entry()
     out = fn(*args)
     # h264 I-step: (data, row_lens, send, is_paint, age, sent, fnum,
-    #               recon_y, recon_u, recon_v, overflow)
-    assert len(out) == 11
+    #               recon_y, recon_u, recon_v, prev_out, overflow)
+    assert len(out) == 12
 
 
 def test_multiseat_capture_thread_serves_all_seats():

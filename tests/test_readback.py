@@ -1,9 +1,11 @@
-"""Minimal-readback fetch (engine/readback.py, PERF.md lever 4)."""
+"""Minimal-readback fetch (engine/readback.py, PERF.md lever 4) and the
+stripe-granular fetch path (deep pipeline, ROADMAP 2)."""
 
 import numpy as np
 
-from selkies_tpu.engine.readback import (MIN_BUCKET, bucket_for,
-                                         fetch_stream_bytes)
+from selkies_tpu.engine.readback import (MIN_BUCKET, MIN_STRIPE_BUCKET,
+                                         bucket_for, fetch_stream_bytes,
+                                         fetch_stripe_bytes)
 
 
 def test_bucket_ladder():
@@ -26,8 +28,46 @@ def test_fetch_prefix_is_byte_identical():
         assert np.array_equal(got[:total], full[:total]), total
 
 
-def test_small_buffer_fetches_whole():
+def test_small_buffer_fetch_covers_request():
     import jax.numpy as jnp
     full = np.arange(100, dtype=np.uint8)
     got = fetch_stream_bytes(jnp.asarray(full), 50)
-    assert np.array_equal(got, full)     # buffer smaller than a bucket
+    # contract: AT LEAST the requested prefix, byte-identical (the host
+    # path returns exactly the prefix; the device path rounds up)
+    assert len(got) >= 50
+    assert np.array_equal(got[:50], full[:50])
+
+
+def test_fetch_stripe_arbitrary_ranges_byte_identical():
+    """The stripe-streaming fetch: any (start, length) range equals the
+    same slice of the full buffer — including ranges that straddle the
+    bucketed device slice's clamp at the buffer tail."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(7)
+    n = 4 * MIN_STRIPE_BUCKET
+    full = rng.integers(0, 256, (n,), dtype=np.uint8)
+    dev = jnp.asarray(full)
+    cases = [(0, 0), (0, 1), (0, MIN_STRIPE_BUCKET), (17, 1000),
+             (MIN_STRIPE_BUCKET - 1, 2), (n - 100, 100),
+             (n - 1, 1), (n - MIN_STRIPE_BUCKET - 3, MIN_STRIPE_BUCKET),
+             (1000, 3 * MIN_STRIPE_BUCKET)]
+    for start, length in cases:
+        got = fetch_stripe_bytes(dev, start, length)
+        assert np.array_equal(got, full[start:start + length]), \
+            (start, length)
+
+
+def test_fetch_stripe_clamps_overlong_range():
+    import jax.numpy as jnp
+    full = np.arange(256, dtype=np.uint8)
+    got = fetch_stripe_bytes(jnp.asarray(full), 200, 1000)
+    assert np.array_equal(got, full[200:])
+
+
+def test_fetch_stripe_seat_axis_preserved():
+    """Multi-seat (S, out_cap) buffers slice along the minor axis."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(3)
+    full = rng.integers(0, 256, (2, 2 * MIN_STRIPE_BUCKET), dtype=np.uint8)
+    got = fetch_stripe_bytes(jnp.asarray(full), 123, 456)
+    assert np.array_equal(got, full[:, 123:123 + 456])

@@ -319,6 +319,8 @@ def test_ladder_full_walk_with_injected_clock():
     lad = DegradationLadder(down_after_s=4.0, hold_s=10.0, ok_window_s=30.0,
                             recorder=eng.recorder)
     lad.bind_controls({
+        "pipeline": (lambda: calls.append("p-"),
+                     lambda: calls.append("p+")),
         "fps": (lambda: calls.append("fps-"), lambda: calls.append("fps+")),
         "quality": (lambda: calls.append("q-"), lambda: calls.append("q+")),
         "downscale": (lambda: calls.append("s-"),
@@ -329,25 +331,28 @@ def test_ladder_full_walk_with_injected_clock():
     lad.observe(bad, now=0.0)
     assert lad.level == 0                  # hysteresis: not yet
     lad.observe(bad, now=4.0)
-    assert lad.level == 1 and calls == ["fps-"]
+    # rung 0 of the deep pipeline era: depth -> 1 before fidelity is cut
+    assert lad.level == 1 and calls == ["p-"]
     lad.observe(bad, now=5.0)
     assert lad.level == 1                  # hold_s blocks
     lad.observe(bad, now=15.0)
-    assert lad.level == 2 and calls[-1] == "q-"
+    assert lad.level == 2 and calls[-1] == "fps-"
     lad.observe(bad, now=26.0)
-    assert lad.level == 3 and calls[-1] == "s-"
+    assert lad.level == 3 and calls[-1] == "q-"
     lad.observe(bad, now=40.0)
-    assert lad.level == 3                  # bottom rung holds
+    assert lad.level == 4 and calls[-1] == "s-"
+    lad.observe(bad, now=55.0)
+    assert lad.level == 4                  # bottom rung holds
     # recovery: sustained-ok window then one rung per hold
-    lad.observe(ok, now=41.0)
-    lad.observe(ok, now=60.0)
-    assert lad.level == 3                  # 19 s ok < 30 s window
-    lad.observe(ok, now=71.5)
-    assert lad.level == 2 and calls[-1] == "s+"
-    lad.observe(ok, now=101.5)
-    assert lad.level == 1 and calls[-1] == "q+"
+    lad.observe(ok, now=56.0)
+    lad.observe(ok, now=75.0)
+    assert lad.level == 4                  # 19 s ok < 30 s window
+    lad.observe(ok, now=86.5)
+    assert lad.level == 3 and calls[-1] == "s+"
+    lad.observe(ok, now=116.5)
+    assert lad.level == 2 and calls[-1] == "q+"
     kinds = [e["kind"] for e in eng.recorder.snapshot()]
-    assert kinds.count("degradation_step") == 3
+    assert kinds.count("degradation_step") == 4
     assert kinds.count("degradation_recover") == 2
     ev = lad.trace_events()
     assert ev[0]["args"]["name"] == "resilience"
@@ -802,29 +807,39 @@ async def test_resilience_endpoint_snapshot(client_factory):
 
 async def test_ladder_downshift_and_stepup_through_ws_controls(
         client_factory):
-    """qoe-failed verdicts walk the REAL ws controls down (fps halves,
-    then quality/bitrate shed) and a sustained-ok window walks them
-    back up — driven through injected `now`, no wall clock."""
+    """qoe-failed verdicts walk the REAL ws controls down (pipeline to
+    serial first, then fps halves, then quality/bitrate shed) and a
+    sustained-ok window walks them back up — driven through injected
+    `now`, no wall clock."""
     server, svc, fake, _ = make_app()
     c = await client_factory(server)
     ladder = server.ladder
     assert ladder is not None
     s = svc.settings
     fps0, q0, kbps0 = s.framerate, s.jpeg_quality, s.video_bitrate_kbps
+    pd0 = int(s.pipeline_depth)
+    assert pd0 >= 2
     bad = {"qoe": _health.failed("ack stall")}
     ok = {"qoe": _health.ok()}
     ladder.observe(bad, now=0.0)
     ladder.observe(bad, now=4.0)
-    assert ladder.level == 1 and s.framerate == fps0 // 2
+    # rung 0 of the deep-pipeline era: depth drops to serial, fidelity
+    # untouched
+    assert ladder.level == 1 and int(s.pipeline_depth) == 1
+    assert s.framerate == fps0
     ladder.observe(bad, now=15.0)
-    assert ladder.level == 2
+    assert ladder.level == 2 and s.framerate == fps0 // 2
+    ladder.observe(bad, now=26.0)
+    assert ladder.level == 3
     assert s.jpeg_quality < q0 and s.video_bitrate_kbps == kbps0 // 2
-    ladder.observe(ok, now=16.0)
-    ladder.observe(ok, now=46.5)
-    assert ladder.level == 1 and s.jpeg_quality == q0 \
+    ladder.observe(ok, now=27.0)
+    ladder.observe(ok, now=57.5)
+    assert ladder.level == 2 and s.jpeg_quality == q0 \
         and s.video_bitrate_kbps == kbps0
-    ladder.observe(ok, now=80.0)
-    assert ladder.level == 0 and s.framerate == fps0
+    ladder.observe(ok, now=91.0)
+    assert ladder.level == 1 and s.framerate == fps0
+    ladder.observe(ok, now=125.0)
+    assert ladder.level == 0 and int(s.pipeline_depth) == pd0
     kinds = [e["kind"] for e in _health.engine.recorder.snapshot()]
     assert "degradation_step" in kinds and "degradation_recover" in kinds
 
@@ -856,11 +871,14 @@ async def test_ladder_fps_floor_reports_not_applied(client_factory):
     assert svc._ladder_fps_down() is False
     assert svc.settings.framerate == 15    # unchanged
     ladder = server.ladder
-    ladder.observe({"qoe": _health.failed("x")}, now=0.0)
-    ladder.observe({"qoe": _health.failed("x")}, now=4.0)
+    bad = {"qoe": _health.failed("x")}
+    ladder.observe(bad, now=0.0)
+    ladder.observe(bad, now=4.0)       # rung 0: pipeline (applies)
+    ladder.observe(bad, now=15.0)      # rung 1: fps — at the floor
     steps = [e for e in _health.engine.recorder.snapshot()
              if e["kind"] == "degradation_step"]
-    assert steps and steps[-1]["applied"] is False
+    assert steps and steps[-1]["step"] == "fps"
+    assert steps[-1]["applied"] is False
 
 
 # --------------------------------------------------------------- taskutil

@@ -124,6 +124,12 @@ def entry_from_bench(doc: dict, *, git_rev: Optional[str] = None,
         "qoe_score": (doc.get("qoe") or {}).get("score"),
         "g2g_p50_ms": (doc.get("glass_to_glass") or {}).get("p50_ms"),
         "g2g_p99_ms": (doc.get("glass_to_glass") or {}).get("p99_ms"),
+        # deep pipeline (ROADMAP 2): the depth the run was configured
+        # for and the cross-frame overlap it actually achieved — the
+        # serial-vs-pipelined acceptance pair lives in these two columns
+        "pipeline_depth": doc.get("pipeline_depth"),
+        "overlap_fraction": (doc.get("occupancy") or {})
+        .get("overlap_fraction"),
         "occupancy": doc.get("occupancy"),
         "perf_steps": {
             s["name"]: {"roofline_ms": s["roofline_ms"],
@@ -320,9 +326,10 @@ def cmd_report(args: argparse.Namespace) -> int:
     for key, runs in sorted(by_key.items(), key=lambda kv: str(kv[0])):
         print(f"== {' / '.join(str(k) for k in key)} ({len(runs)} runs)")
         print(f"   {'date':<20} {'rev':<8} {'backend':<24} {'fps':>7} "
-              f"{'p50_ms':>9} {'p99_ms':>9} {'g2g_p99':>9} {'ok':>3}  "
-              f"top stage")
+              f"{'p50_ms':>9} {'p99_ms':>9} {'g2g_p99':>9} {'pd':>3} "
+              f"{'overlap':>8} {'ok':>3}  top stage")
         for e in runs:
+            ov = e.get("overlap_fraction")
             print(f"   {str(e.get('ts', ''))[:19]:<20} "
                   f"{str(e.get('git_rev', ''))[:7]:<8} "
                   f"{str(e.get('backend', ''))[:24]:<24} "
@@ -330,6 +337,8 @@ def cmd_report(args: argparse.Namespace) -> int:
                   f"{e.get('latency_p50_ms') or '-':>9} "
                   f"{e.get('latency_p99_ms') or '-':>9} "
                   f"{e.get('g2g_p99_ms') or '-':>9} "
+                  f"{e.get('pipeline_depth') or '-':>3} "
+                  f"{(format(ov, '.1%') if isinstance(ov, (int, float)) else '-'):>8} "
                   f"{'y' if e.get('baseline_eligible') else 'n':>3}  "
                   f"{_top_stage(e)}")
         out_doc["keys"].append({
@@ -337,6 +346,7 @@ def cmd_report(args: argparse.Namespace) -> int:
             "runs": [{k: e.get(k) for k in
                       ("ts", "git_rev", "backend", "fps",
                        "latency_p50_ms", "latency_p99_ms", "g2g_p99_ms",
+                       "pipeline_depth", "overlap_fraction",
                        "baseline_eligible", "stages_ms")}
                      for e in runs]})
     if args.json:
