@@ -67,6 +67,17 @@ configuration so two runs (depth 1 vs 2, same geometry) compare in the
 ledger. Knobs: BENCH_PIPELINE_DEPTH, BENCH_STRIPE_STREAMING=0,
 BENCH_PIPE_BUDGET_S.
 
+Fleet mode (selkies_tpu/fleet, ISSUE 11): ``--fleet`` runs N simulated
+engine hosts IN-PROCESS on an injected clock (no jax, no sleeps) and
+contract-proves the serving architecture: sessions bin-pack within
+per-host HBM/pixel budgets, a cold host receives nothing until its
+(simulated) prewarm readiness passes, draining a host migrates every
+seat with an IDR resync and zero wedged or dropped sessions, and
+killing a host re-places its seats within the reconnect grace. The
+JSON line carries a ``fleet`` block with each contract's verdict.
+Knobs: BENCH_FLEET_HOSTS (default 3), BENCH_FLEET_SESSIONS (default
+8), BENCH_FLEET_SEED.
+
 Perf observability (selkies_tpu/obs/perf, ISSUE 6): the JSON line
 carries a ``perf`` block (per compiled step: flops, HBM bytes accessed,
 roofline-ms at ~800 GB/s, recorded at compile time — plus the parsed
@@ -941,6 +952,188 @@ async def _chaos_compile_storm(w: int, h: int) -> dict:
     return doc
 
 
+def fleet_main() -> None:
+    """``--fleet``: contract-prove the fleet plane (ISSUE 11) against N
+    simulated in-process hosts on an injected clock. No jax, no
+    sleeps — the whole run is deterministic placement/migration math
+    plus the real heartbeat wire parser, so it runs in milliseconds on
+    the CPU CI runner. Prints ONE JSON line (same contract as the
+    headline bench)."""
+    import random
+
+    from selkies_tpu.fleet import (MigrationCoordinator, SeatScheduler,
+                                   SessionSpec, SimFleet, SimHost)
+    from selkies_tpu.obs.health import FlightRecorder
+
+    seed = int(os.environ.get("BENCH_FLEET_SEED", "1234"))
+    # floor of 3: the scenario needs a warm host, a drain target AND a
+    # failover survivor — at 2 the kill phase has nowhere left to land
+    n_hosts = max(3, int(os.environ.get("BENCH_FLEET_HOSTS", "3")))
+    n_sessions = max(2, int(os.environ.get("BENCH_FLEET_SESSIONS", "8")))
+    rng = random.Random(seed)
+    t0 = time.monotonic()
+
+    clock_box = [0.0]
+    clock = lambda: clock_box[0]  # noqa: E731
+    recorder = FlightRecorder(capacity=1024)
+    sched = SeatScheduler(clock=clock, recorder=recorder,
+                          host_timeout_s=2.0, evict_confirm=3,
+                          evict_hold_s=10.0)
+    coord = MigrationCoordinator(sched, clock=clock, recorder=recorder,
+                                 grace_s=3.0)
+    fleet = SimFleet(sched, coord, clock_box=clock_box)
+
+    # host-0/1 boot warm-ish; the LAST host stays cold for 3 s — the
+    # readiness-gate proof rides on nothing landing there before then
+    geometries = ["1920x1080", "1280x720", "640x360"]
+    warm_after = [0.0, 0.5] + [3.0] * (n_hosts - 2)
+    for i in range(n_hosts):
+        fleet.add_host(SimHost(
+            f"host-{i}", clock=clock, devices=2, seat_slots=4,
+            hbm_limit_mb=4096.0,
+            pixel_budget=3 * 1920 * 1080,
+            warm_after_s=warm_after[i],
+            warm_geometries=geometries if i == 0 else geometries[1:],
+            grace_s=3.0, recorder=recorder))
+    cold_host = f"host-{n_hosts - 1}"
+    fleet.tick(1.0)     # host-0/1 ready, cold host still warming
+
+    # -- phase 1: placement under the readiness gate ------------------------
+    specs = []
+    for i in range(n_sessions):
+        geo = geometries[i % len(geometries)] if i >= 2 else "1920x1080"
+        w, h = (int(x) for x in geo.split("x"))
+        specs.append(SessionSpec(f"s{i}", w, h,
+                                 rng.choice(["h264", "jpeg"])))
+    placed_hot = 0
+    for spec in specs:
+        if sched.place(spec) is not None:
+            placed_hot += 1
+    cold_early = sum(1 for p in sched.placements.values()
+                     if p.host_id == cold_host)
+    queued_during_cold = len(sched.pending)
+    # warm the cold host; queued sessions must land
+    fleet.run_until(lambda: not sched.pending, dt=0.5, budget_s=10.0)
+    placements = {sid: p for sid, p in sched.placements.items()}
+
+    def budgets_ok() -> bool:
+        for host in fleet.hosts.values():
+            for dev in host.devices:
+                seats = [s for s in host.sessions.values()
+                         if s["placement"].device == dev.id]
+                if len(seats) > dev.seat_slots:
+                    return False
+                if sum(s["spec"].budget_mb()
+                       for s in seats) > dev.hbm_limit_mb:
+                    return False
+                if sum(s["spec"].pixels
+                       for s in seats) > dev.pixel_budget:
+                    return False
+        return True
+
+    placement_doc = {
+        "sessions": n_sessions,
+        "placed_before_cold_ready": placed_hot,
+        "queued_while_cold": queued_during_cold,
+        "cold_host_placements_before_ready": cold_early,
+        "placed": len(placements),
+        "pending": len(sched.pending),
+        "bin_pack_ok": budgets_ok(),
+    }
+    log(f"fleet placement: {placement_doc}")
+
+    # -- phase 2: planned drain of host-0 -----------------------------------
+    drain_seats = len(sched.placements_on("host-0"))
+    resyncs_before = sum(h.idr_resyncs for h in fleet.hosts.values())
+    report = coord.evacuate("host-0")
+    fleet.tick(0.5)
+    resyncs_after = sum(h.idr_resyncs for h in fleet.hosts.values())
+    wedged = sum(1 for sid in placements
+                 if sched.get(sid) is None
+                 and not any(sid == s2.sid for s2, _ in sched.pending))
+    drain_doc = {
+        "host": "host-0",
+        "seats": drain_seats,
+        "migrated": report["migrated"],
+        "queued": report["queued"],
+        "dropped": report["dropped"],
+        "idr_resyncs": resyncs_after - resyncs_before,
+        "drained": report["drained"],
+        "wedged": wedged,
+        "still_on_source": len(sched.placements_on("host-0")),
+    }
+    log(f"fleet drain: {drain_doc}")
+
+    # -- phase 3: unplanned host loss ---------------------------------------
+    victim = "host-1"
+    victim_seats = len(sched.placements_on(victim))
+    fleet.hosts[victim].kill()
+    failover_doc = {"host": victim, "seats": victim_seats,
+                    "replaced": 0, "within_grace": 0, "queued": 0}
+    # tick past the heartbeat timeout: expire -> failover, inside grace
+    fleet.run_until(
+        lambda: not any(p.host_id == victim
+                        for p in sched.placements.values())
+        and not sched.pending, dt=0.5, budget_s=10.0)
+    for e in recorder.snapshot():
+        if e["kind"] == "host_failover" and e.get("host_id") == victim:
+            failover_doc["replaced"] = e["replaced"]
+            failover_doc["within_grace"] = e["within_grace"]
+    failover_doc["queued"] = len(sched.pending)
+    failover_doc["final_pending"] = len(sched.pending)
+    log(f"fleet failover: {failover_doc}")
+
+    contract_ok = (
+        placement_doc["cold_host_placements_before_ready"] == 0
+        and placement_doc["bin_pack_ok"]
+        and placement_doc["placed"] == n_sessions
+        and placement_doc["pending"] == 0
+        and drain_doc["dropped"] == 0
+        and drain_doc["wedged"] == 0
+        and drain_doc["still_on_source"] == 0
+        and drain_doc["drained"] is True
+        and drain_doc["idr_resyncs"] >= drain_doc["migrated"]
+        and failover_doc["replaced"] == victim_seats
+        and failover_doc["within_grace"] == victim_seats
+        and fleet.heartbeats_rejected == 0)
+
+    kinds: dict = {}
+    for e in recorder.snapshot():
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    dt = time.monotonic() - t0
+    doc = {
+        "metric": "fleet_contract",
+        "value": 1.0 if contract_ok else 0.0,
+        "unit": "contract_ok",
+        "vs_baseline": 1.0 if contract_ok else 0.0,
+        "backend": "sim",
+        "backend_health": {"status": "ok" if contract_ok else "failed",
+                           "reason": "fleet contract "
+                           + ("held" if contract_ok else "BROKEN")},
+        "duration_s": round(dt, 3),
+        "fleet": {
+            "seed": seed,
+            "hosts": n_hosts,
+            "sim_clock_s": round(clock(), 1),
+            "placement": placement_doc,
+            "drain": drain_doc,
+            "failover": failover_doc,
+            "migrations_total": coord.total_migrations,
+            "heartbeats": {"sent": fleet.heartbeats_sent,
+                           "rejected": fleet.heartbeats_rejected},
+            "incidents": kinds,
+            "contract_ok": contract_ok,
+        },
+    }
+    log(f"fleet done in {dt:.2f}s (sim clock {clock():.1f}s): "
+        f"contract_ok={contract_ok} "
+        f"migrations={coord.total_migrations} incidents={kinds}")
+    print(json.dumps(doc))
+    ledger_append(doc)
+    if not contract_ok:
+        sys.exit(1)
+
+
 def chaos_main(force_cpu: bool = False) -> None:
     """``--chaos``: prove the resilience plane recovers every injected
     fault. Prints ONE JSON line (same contract as the headline bench)."""
@@ -998,6 +1191,29 @@ def chaos_main(force_cpu: bool = False) -> None:
 
 
 if __name__ == "__main__":
+    if "--fleet" in sys.argv[1:]:
+        # fleet mode never touches jax (simulated hosts, injected
+        # clock) — no backend probe, no CPU fallback dance
+        try:
+            fleet_main()
+        except SystemExit:
+            raise
+        except BaseException as e:   # noqa: BLE001 — JSON line contract
+            if isinstance(e, KeyboardInterrupt):
+                raise
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "fleet_contract", "value": 0.0,
+                "unit": "contract_ok", "vs_baseline": 0.0,
+                "backend": "sim",
+                "backend_health": {
+                    "status": "failed",
+                    "reason": f"{type(e).__name__}: {e}"[:200]},
+                "error": f"{type(e).__name__}: {e}"[:300],
+            }))
+            sys.exit(1)
+        sys.exit(0)
     _force_cpu = probe_backend()
     _chaos = "--chaos" in sys.argv[1:]
     try:

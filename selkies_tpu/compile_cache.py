@@ -18,6 +18,7 @@ import functools
 import hashlib
 import os
 import platform
+import socket
 
 
 @functools.lru_cache(maxsize=1)
@@ -52,6 +53,26 @@ def host_fingerprint(device_kind: str | None = None) -> str:
                        for c in device_kind)
         fp += f"-{safe}"
     return fp
+
+
+@functools.lru_cache(maxsize=1)
+def host_id() -> str:
+    """Stable short host identity for joining multi-host records
+    (flight-recorder incidents, PERF_LEDGER entries, structured logs,
+    fleet heartbeats). The compile-environment fingerprint alone is NOT
+    unique across a homogeneous fleet — identical machines share it by
+    design — so the id mixes in the hostname and keeps the fingerprint
+    as a readable prefix. ``SELKIES_HOST_ID`` overrides for
+    orchestrators that already name their hosts (k8s pod name)."""
+    env = os.environ.get("SELKIES_HOST_ID", "").strip()
+    if env:
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in env)
+        return safe[:64]
+    fp = host_fingerprint()
+    digest = hashlib.sha1(
+        f"{fp}/{socket.gethostname()}".encode()).hexdigest()[:8]
+    return f"{fp.split('-')[-1][:6]}-{digest}"
 
 
 def cache_root() -> str:

@@ -1,0 +1,37 @@
+"""Fleet plane: seat scheduler, multi-host placement, live migration.
+
+ROADMAP item 3's serving architecture: everything before this package
+serves ONE engine host; this is the layer that turns N of them into a
+fleet shaped for the millions-of-users traffic profile.
+
+- :mod:`.protocol` — the control vocabulary: host heartbeats carrying
+  capacity (HBM + pixel budgets per device, from the PR-3
+  DeviceMonitor), health, SLO burn (PR 7), warm geometries (PR 8) and
+  per-seat sessions; placement specs; the client ``migrate,`` command.
+  Strictly parsed — a heartbeat is a trust boundary;
+- :mod:`.scheduler` — sessions -> (host, device, seat-slot)
+  bin-packing on the two budget axes, warm-host-preferring scoring,
+  refusal-is-queueing (``placement_pending`` incidents, never drops),
+  and hysteresis-gated SLO eviction;
+- :mod:`.migrate` — drain/failover/cross-host relay re-offer: the PR-5
+  dead-relay re-offer + supervisor drain generalised across hosts,
+  with IDR resync on every handoff and reconnect-grace warm capture;
+- :mod:`.sim` — in-process simulated hosts on an injected clock: the
+  rig ``bench.py --fleet`` and ``tests/test_fleet.py`` chaos-test the
+  contracts on (CPU, no sleeps);
+- :mod:`.gateway` — the one aiohttp module (NOT imported here): the
+  stateless auth + WS-affinity tier in front of the engine hosts;
+- :mod:`.__main__` — ``python -m selkies_tpu.fleet selftest``: the CI
+  lint smoke, stdlib-only like the rest of the offline CLIs.
+
+Everything except :mod:`.gateway` imports with neither jax nor aiohttp
+installed (same contract as :mod:`..obs` / :mod:`..resilience`).
+"""
+
+from .migrate import MigrationCoordinator  # noqa: F401
+from .protocol import (FleetProtocolError, Heartbeat,  # noqa: F401
+                       SessionSpec, estimate_hbm_mb, heartbeat_from_core,
+                       migrate_command, parse_heartbeat,
+                       parse_session_spec)
+from .scheduler import Placement, SeatScheduler  # noqa: F401
+from .sim import SimFleet, SimHost  # noqa: F401

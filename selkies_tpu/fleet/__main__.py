@@ -1,0 +1,176 @@
+"""Offline fleet CLI.
+
+``python -m selkies_tpu.fleet selftest`` — drive the real protocol
+parser, seat scheduler, migration coordinator and simulated hosts with
+an injected clock and verify the fleet contracts (the CI lint smoke,
+mirroring the trace/obs/resilience/prewarm selftests). Exits non-zero
+on any contract break.
+
+``python -m selkies_tpu.fleet gateway`` — run the aiohttp gateway tier
+(lazily imported; requires aiohttp).
+
+Stdlib-only for ``selftest``: runs in the lint CI image with no
+jax/aiohttp installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..obs.health import FlightRecorder
+from .migrate import MigrationCoordinator
+from .protocol import (FleetProtocolError, SessionSpec, parse_heartbeat,
+                       parse_session_spec)
+from .scheduler import SeatScheduler
+from .sim import SimFleet, SimHost
+
+
+def _fail(msg: str) -> int:
+    print(f"selftest FAILED: {msg}", file=sys.stderr)
+    return 1
+
+
+def _cmd_selftest(args: argparse.Namespace) -> int:
+    clock_box = [0.0]
+
+    def clock() -> float:
+        return clock_box[0]
+
+    recorder = FlightRecorder()
+    sched = SeatScheduler(clock=clock, recorder=recorder,
+                          host_timeout_s=3.0, evict_confirm=3,
+                          evict_hold_s=5.0)
+    coord = MigrationCoordinator(sched, clock=clock, recorder=recorder,
+                                 grace_s=3.0)
+    fleet = SimFleet(sched, coord, clock_box=clock_box)
+    a = fleet.add_host(SimHost("host-a", clock=clock, devices=1,
+                               seat_slots=2, hbm_limit_mb=600.0,
+                               warm_after_s=0.0,
+                               warm_geometries=("640x360",)))
+    b = fleet.add_host(SimHost("host-b", clock=clock, devices=1,
+                               seat_slots=4, hbm_limit_mb=600.0,
+                               warm_after_s=2.0))
+    fleet.tick(0.5)
+
+    # 1. protocol: malformed heartbeats must be rejected, good ones parse
+    try:
+        parse_heartbeat({"kind": "heartbeat"})
+        return _fail("heartbeat without host_id parsed")
+    except FleetProtocolError:
+        pass
+    try:
+        parse_heartbeat(
+            {"v": 1, "kind": "heartbeat", "host_id": "x",
+             "devices": [{"hbm_limit_mb": float("nan")}]})
+        return _fail("NaN hbm_limit_mb parsed")
+    except FleetProtocolError:
+        pass
+    hb = a.heartbeat()
+    assert hb is not None
+    if parse_heartbeat(hb.to_json()).host_id != "host-a":
+        return _fail("heartbeat round-trip lost host_id")
+
+    # 2. warm preference + cold-host gate: host-b is still cold (its
+    # simulated prewarm needs 2 s) -> every placement lands on host-a
+    s1 = parse_session_spec({"v": 1, "kind": "place", "sid": "s1",
+                             "width": 640, "height": 360,
+                             "codec": "jpeg"})
+    p1 = sched.place(s1)
+    if p1 is None or p1.host_id != "host-a":
+        return _fail(f"expected s1 on warm host-a, got {p1}")
+
+    # 3. refusal queues (never drops): host-a is the only ready host
+    # and fits one more seat; the third session must queue pending
+    p2 = sched.place(SessionSpec("s2", 640, 360, "jpeg"))
+    if p2 is None or p2.host_id != "host-a":
+        return _fail("s2 should fit on host-a")
+    p3 = sched.place(SessionSpec("s3", 640, 360, "jpeg"))
+    if p3 is not None:
+        return _fail("s3 placed with no ready capacity anywhere")
+    kinds = [e["kind"] for e in recorder.snapshot()]
+    if "placement_pending" not in kinds:
+        return _fail("no placement_pending incident for queued s3")
+
+    # 4. readiness flip: once host-b's prewarm window passes, the
+    # queued session lands there on the next heartbeat
+    fleet.tick(2.0)
+    if sched.get("s3") is None:
+        return _fail("queued s3 did not place after host-b warmed")
+    if sched.get("s3").host_id != "host-b":
+        return _fail("s3 landed on the full host")
+
+    # 5. planned drain: every host-a seat migrates with an IDR resync,
+    # zero dropped, and the supervisor drain completes
+    before = b.idr_resyncs
+    report = coord.evacuate("host-a")
+    if report["migrated"] != 2 or report["dropped"] != 0:
+        return _fail(f"drain migrated {report['migrated']}/2, "
+                     f"dropped {report['dropped']}")
+    if report["drained"] is not True:
+        return _fail("supervisor drain did not complete")
+    if b.idr_resyncs < before + 2:
+        return _fail("migrated seats did not IDR-resync on the target")
+
+    # 6. failover: kill host-b mid-flight; after the heartbeat timeout
+    # its seats re-place (host-a is draining/gone, so they queue —
+    # still never dropped)
+    b.kill()
+    fleet.tick(4.0)
+    lost = sched.hosts["host-b"].lost
+    if not lost:
+        return _fail("killed host-b not expired")
+    if any(p.host_id == "host-b"
+           for p in sched.placements.values()):
+        return _fail("sessions still placed on the lost host")
+
+    # 7. drain handle is awaitable-shaped
+    h = a.supervisor.drain()
+    if not (h.done and h.wait(0) and hasattr(h, "__await__")):
+        return _fail("drain handle contract broken")
+
+    state = {
+        "scheduler": sched.snapshot(),
+        "incidents": [e["kind"] for e in recorder.snapshot()],
+        "heartbeats": {"sent": fleet.heartbeats_sent,
+                       "rejected": fleet.heartbeats_rejected},
+    }
+    text = json.dumps(state, sort_keys=True)
+    print(text if args.json
+          else f"selftest OK ({len(text)} bytes of fleet state)")
+    return 0
+
+
+def _cmd_gateway(args: argparse.Namespace) -> int:
+    from aiohttp import web
+
+    from .gateway import FleetGateway
+    gw = FleetGateway(token=args.token)
+    app = gw.make_app()
+    web.run_app(app, host=args.addr, port=args.port)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m selkies_tpu.fleet",
+                                description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser("selftest",
+                        help="drive protocol+scheduler+migration+sim "
+                             "contracts with an injected clock")
+    ps.add_argument("--json", action="store_true",
+                    help="print the selftest state payload")
+    ps.set_defaults(fn=_cmd_selftest)
+    pg = sub.add_parser("gateway", help="run the aiohttp gateway tier")
+    pg.add_argument("--addr", default="0.0.0.0")
+    pg.add_argument("--port", type=int, default=8100)
+    pg.add_argument("--token", default="",
+                    help="fleet bearer token (empty: open)")
+    pg.set_defaults(fn=_cmd_gateway)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

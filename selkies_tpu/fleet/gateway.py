@@ -1,0 +1,369 @@
+"""Stateless gateway tier: auth + WS session affinity over N hosts.
+
+The one aiohttp-dependent fleet module (everything the scheduler needs
+is stdlib; keep it importable only where a server already runs). The
+gateway holds NO durable state — scheduler placements and host state
+rebuild from the next heartbeat round after a gateway restart, which is
+what makes the tier horizontally scalable and restartable at will.
+
+Surfaces:
+
+- ``POST /fleet/heartbeat`` — engine hosts push their capacity/health
+  snapshots (strict-parsed; malformed documents are rejected and
+  counted, never folded into scheduler state);
+- ``POST /fleet/place`` / ``POST /fleet/release`` — explicit placement
+  API for LBs that terminate WS themselves and only need the routing
+  decision;
+- ``GET /fleet/route/{sid}`` — the affinity answer (where does this
+  session live);
+- ``GET /fleet/ws`` — full WS proxy: authenticate, place (or find) the
+  session, open a client WS to the engine host and pipe bytes both
+  ways — the browser speaks to one address while seats migrate behind
+  it;
+- ``GET /fleet/hosts`` — operator panel (scheduler snapshot);
+- ``POST /fleet/drain/{host_id}`` — operator-driven evacuation.
+
+Auth: a single bearer token (``--fleet_token``) compared timing-safely,
+covering hosts and operators alike; empty token = open (dev rigs,
+tests). Per-user auth stays on the engine hosts — the gateway proxies
+the Authorization header through untouched.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hmac
+import json
+import logging
+import time
+import urllib.parse
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from .migrate import MigrationCoordinator
+from .protocol import (FleetProtocolError, parse_heartbeat,
+                       parse_session_spec)
+from .scheduler import SeatScheduler
+
+logger = logging.getLogger("selkies_tpu.fleet.gateway")
+
+__all__ = ["FleetGateway"]
+
+
+class FleetGateway:
+    def __init__(self, *, token: str = "",
+                 scheduler: Optional[SeatScheduler] = None,
+                 coordinator: Optional[MigrationCoordinator] = None,
+                 clock=time.monotonic,
+                 sweep_interval_s: float = 2.0):
+        from ..obs import health as _health
+        self.token = str(token or "")
+        self.recorder = _health.engine.recorder
+        self.scheduler = scheduler if scheduler is not None else \
+            SeatScheduler(clock=clock, recorder=self.recorder)
+        self.coordinator = coordinator if coordinator is not None else \
+            MigrationCoordinator(self.scheduler, clock=clock,
+                                 recorder=self.recorder)
+        self.sweep_interval_s = float(sweep_interval_s)
+        self.heartbeats_ok = 0
+        self.heartbeats_rejected = 0
+        self._sweep_task: Optional[asyncio.Task] = None
+        #: one gateway-lifetime HTTP/WS client session: per-connection
+        #: sessions would pay connector setup per viewer and never
+        #: reuse a connection to the engine hosts
+        self._client: Optional[aiohttp.ClientSession] = None
+        #: sid -> live proxied WS connections; a seat frees only when
+        #: the LAST connection for its sid closes (a migration overlaps
+        #: the old and new connection on one sid — the old one closing
+        #: must not tear down the seat the new one is using)
+        self._ws_conns: dict[str, int] = {}
+        #: sid -> pending deferred-release timer (reconnect grace)
+        self._release_timers: dict = {}
+        #: how long a seat survives its last WS closing — mirrors the
+        #: engine's reconnect_grace_s: the engine holds the capture
+        #: warm for exactly this pattern (tab reload, network blip,
+        #: non-overlapping migrate reconnect), and an instant release
+        #: here would tear the placement down under it
+        self.release_grace_s = 3.0
+
+    # ------------------------------------------------------------------ auth
+    def _authed(self, request: web.Request) -> bool:
+        if not self.token:
+            return True
+        auth = request.headers.get("Authorization", "")
+        return auth.startswith("Bearer ") and hmac.compare_digest(
+            auth[7:].encode(), self.token.encode())
+
+    # ---------------------------------------------------------------- routes
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        r = app.router
+        r.add_post("/fleet/heartbeat", self.handle_heartbeat)
+        r.add_post("/fleet/place", self.handle_place)
+        r.add_post("/fleet/release", self.handle_release)
+        r.add_get("/fleet/route/{sid}", self.handle_route)
+        r.add_get("/fleet/hosts", self.handle_hosts)
+        r.add_post("/fleet/drain/{host_id}", self.handle_drain)
+        r.add_get("/fleet/ws", self.handle_ws)
+        app.on_startup.append(self._start_sweep)
+        app.on_cleanup.append(self._stop_sweep)
+        return app
+
+    async def _start_sweep(self, app) -> None:
+        self._client = aiohttp.ClientSession()
+        self._sweep_task = asyncio.create_task(self._sweep_loop())
+
+    async def _stop_sweep(self, app) -> None:
+        for t in self._release_timers.values():
+            t.cancel()
+        self._release_timers.clear()
+        if self._sweep_task is not None:
+            self._sweep_task.cancel()
+            try:
+                await self._sweep_task
+            except asyncio.CancelledError:
+                pass
+            self._sweep_task = None
+        if self._client is not None:
+            await self._client.close()
+            self._client = None
+
+    def _http(self) -> aiohttp.ClientSession:
+        if self._client is None:   # app started without on_startup
+            self._client = aiohttp.ClientSession()
+        return self._client
+
+    async def _sweep_loop(self) -> None:
+        """Periodic: expire silent hosts -> failover, apply the
+        hysteresis-filtered SLO evictions."""
+        while True:
+            await asyncio.sleep(self.sweep_interval_s)
+            try:
+                self.coordinator.check_lost_hosts()
+                self.coordinator.rebalance()
+            except Exception:
+                logger.exception("fleet sweep failed")
+
+    async def handle_heartbeat(self, request: web.Request) -> web.Response:
+        if not self._authed(request):
+            return web.Response(status=401, text="bad fleet token")
+        try:
+            raw = await request.read()
+            hb = parse_heartbeat(raw)
+        except FleetProtocolError as e:
+            self.heartbeats_rejected += 1
+            return web.Response(status=400, text=f"bad heartbeat: {e}")
+        self.scheduler.observe(hb)
+        self.heartbeats_ok += 1
+        return web.json_response({"ok": True, "seq": hb.seq})
+
+    async def handle_place(self, request: web.Request) -> web.Response:
+        if not self._authed(request):
+            return web.Response(status=401, text="bad fleet token")
+        try:
+            spec = parse_session_spec(await request.read())
+        except FleetProtocolError as e:
+            return web.Response(status=400, text=f"bad spec: {e}")
+        p = self.scheduler.place(spec)
+        if p is None:
+            # queued — 202, not an error: the session is held pending
+            return web.json_response(
+                {"placed": False, "queued": True, "sid": spec.sid},
+                status=202)
+        host = self.scheduler.hosts.get(p.host_id)
+        return web.json_response({
+            "placed": True, "sid": p.sid, "host_id": p.host_id,
+            "url": host.url if host else "",
+            "device": p.device, "seat": p.seat})
+
+    async def handle_release(self, request: web.Request) -> web.Response:
+        if not self._authed(request):
+            return web.Response(status=401, text="bad fleet token")
+        try:
+            body = json.loads(await request.read() or b"{}")
+        except json.JSONDecodeError:
+            return web.Response(status=400, text="bad json")
+        sid = str(body.get("sid", ""))
+        released = self.scheduler.release(sid)
+        return web.json_response({"released": released is not None})
+
+    async def handle_route(self, request: web.Request) -> web.Response:
+        if not self._authed(request):
+            return web.Response(status=401, text="bad fleet token")
+        sid = request.match_info["sid"]
+        p = self.scheduler.get(sid)
+        if p is None:
+            return web.json_response({"found": False}, status=404)
+        host = self.scheduler.hosts.get(p.host_id)
+        return web.json_response({
+            "found": True, "sid": sid, "host_id": p.host_id,
+            "url": host.url if host else ""})
+
+    async def handle_hosts(self, request: web.Request) -> web.Response:
+        if not self._authed(request):
+            return web.Response(status=401, text="bad fleet token")
+        doc = self.scheduler.snapshot()
+        doc["heartbeats_ok"] = self.heartbeats_ok
+        doc["heartbeats_rejected"] = self.heartbeats_rejected
+        return web.json_response(doc)
+
+    async def handle_drain(self, request: web.Request) -> web.Response:
+        """Operator evacuation. For REMOTE hosts (no in-process handle)
+        the engine must hear about its own drain, or its connected
+        clients keep streaming while the scheduler's books claim they
+        migrated: best-effort POST the host's /api/drain first (the
+        engine flips its readiness gate and sends every client its
+        ``migrate`` command), forwarding the caller's Authorization
+        header — engine auth is the operator's, not the fleet token.
+        Body: {"target_url": url clients should reconnect to}."""
+        if not self._authed(request):
+            return web.Response(status=401, text="bad fleet token")
+        host_id = request.match_info["host_id"]
+        host = self.scheduler.hosts.get(host_id)
+        if host is None:
+            return web.Response(status=404,
+                                text=f"unknown host {host_id!r}")
+        try:
+            body = json.loads(await request.read() or b"{}")
+        except json.JSONDecodeError:
+            body = {}
+        engine_notified = None
+        if host_id not in self.coordinator.handles \
+                and host.url.startswith(("http://", "https://")):
+            headers = {}
+            if "Authorization" in request.headers:
+                headers["Authorization"] = \
+                    request.headers["Authorization"]
+            try:
+                async with self._http().post(
+                        host.url.rstrip("/") + "/api/drain",
+                        json={"target_url":
+                              str(body.get("target_url", ""))},
+                        headers=headers,
+                        timeout=aiohttp.ClientTimeout(total=10)) as r:
+                    engine_notified = r.status == 200
+            except aiohttp.ClientError as e:
+                logger.warning("fleet drain: engine %s unreachable: %s",
+                               host_id, e)
+                engine_notified = False
+        report = self.coordinator.evacuate(host_id)
+        report["engine_notified"] = engine_notified
+        handle = report.pop("drain_handle", None)
+        if handle is not None and not handle.done:
+            # bounded wait for the source supervisor's drain; report
+            # honestly either way
+            try:
+                await asyncio.wait_for(_await_handle(handle), 10.0)
+                report["drained"] = True
+            except asyncio.TimeoutError:
+                report["drained"] = False
+        return web.json_response(report)
+
+    # ------------------------------------------------------------- WS proxy
+    async def handle_ws(self, request: web.Request) -> web.StreamResponse:
+        """Session-affine WS proxy. ``?sid=`` names the session (a
+        reconnect after migration reuses it and lands on the new host);
+        ``?w=&h=&codec=`` size a fresh placement."""
+        if not self._authed(request):
+            return web.Response(status=401, text="bad fleet token")
+        q = request.query
+        # anonymous sids must be collision-proof: a truncated id()
+        # could alias two concurrent viewers onto ONE seat (the second
+        # would silently attach to the first's desktop stream)
+        import secrets
+        sid = q.get("sid") or f"ws-{secrets.token_urlsafe(9)}"
+        p = self.scheduler.get(sid)
+        if p is None:
+            try:
+                spec = parse_session_spec({
+                    "v": 1, "kind": "place", "sid": sid,
+                    "width": int(q.get("w", 1280)),
+                    "height": int(q.get("h", 720)),
+                    "codec": q.get("codec", "h264")})
+            except (FleetProtocolError, ValueError) as e:
+                return web.Response(status=400, text=f"bad spec: {e}")
+            p = self.scheduler.place(spec)
+            if p is None:
+                # no capacity: withdraw the queued spec — this
+                # connection is about to go away, and a later retry
+                # would otherwise place a ghost seat nothing releases
+                self.scheduler.cancel_pending(sid)
+                return web.Response(status=503,
+                                    text="no host has capacity; retry")
+        host = self.scheduler.hosts.get(p.host_id)
+        if host is None or not host.url.startswith(("http://",
+                                                    "https://",
+                                                    "ws://", "wss://")):
+            return web.Response(status=502,
+                                text="placed host has no routable url")
+        # the engine host learns the GATEWAY's session id (?fleet_sid=)
+        # so a drain's migrate command carries the affinity key the
+        # reconnect needs — the engine-local client id means nothing
+        # out here
+        target = host.url.replace("http://", "ws://") \
+            .replace("https://", "wss://").rstrip("/") \
+            + "/api/websockets?fleet_sid=" + urllib.parse.quote(sid)
+        ws_client = web.WebSocketResponse()
+        await ws_client.prepare(request)
+        headers = {}
+        if "Authorization" in request.headers:
+            headers["Authorization"] = request.headers["Authorization"]
+        self._ws_conns[sid] = self._ws_conns.get(sid, 0) + 1
+        timer = self._release_timers.pop(sid, None)
+        if timer is not None:
+            timer.cancel()        # reconnect inside the grace: keep it
+        try:
+            async with self._http().ws_connect(
+                    target, headers=headers) as ws_host:
+                await _pipe(ws_client, ws_host)
+        except aiohttp.ClientError as e:
+            logger.warning("fleet ws proxy to %s failed: %s", target, e)
+            await ws_client.close(code=1013, message=b"host unreachable")
+        finally:
+            # the seat frees AFTER the reconnect grace once the LAST
+            # viewer on this sid leaves — without release every visit
+            # leaks a placement; releasing instantly would tear down
+            # the seat under the normal close-then-reconnect pattern
+            # (migrate command, tab reload, network blip) the engine
+            # holds its capture warm for.
+            left = self._ws_conns.get(sid, 1) - 1
+            if left <= 0:
+                self._ws_conns.pop(sid, None)
+                self._release_timers[sid] = \
+                    asyncio.get_running_loop().call_later(
+                        self.release_grace_s,
+                        self._release_if_idle, sid)
+            else:
+                self._ws_conns[sid] = left
+        return ws_client
+
+    def _release_if_idle(self, sid: str) -> None:
+        self._release_timers.pop(sid, None)
+        if self._ws_conns.get(sid, 0) == 0:
+            self.scheduler.release(sid)
+
+
+async def _await_handle(handle) -> None:
+    await handle
+
+
+async def _pipe(a: web.WebSocketResponse, b) -> None:
+    """Bidirectional byte pump until either side closes."""
+
+    async def one_way(src, dst):
+        async for msg in src:
+            if msg.type == aiohttp.WSMsgType.TEXT:
+                await dst.send_str(msg.data)
+            elif msg.type == aiohttp.WSMsgType.BINARY:
+                await dst.send_bytes(msg.data)
+            else:
+                break
+        try:
+            await dst.close()
+        except Exception:
+            pass
+
+    await asyncio.gather(one_way(a, b), one_way(b, a),
+                         return_exceptions=True)

@@ -1,0 +1,301 @@
+"""Live session migration: drain, failover, and cross-host re-offer.
+
+PR 5 built the single-host recovery loop: a dead relay is re-offered
+fresh on the SAME host with an IDR resync, and a draining supervisor
+stops restarting. This module is that mechanism generalised across
+hosts — the three moves a fleet needs:
+
+- **evacuate** (planned drain): every seat on the source host is
+  re-placed through the scheduler, the target host accepts it with an
+  IDR resync (the new encoder's first frame is a clean decoder entry
+  point — the client never sees a mid-GOP seam), the source keeps its
+  capture warm through the reconnect grace so a slow client reconnect
+  still finds a frame, and the source's supervisor ``drain()``
+  (ISSUE 11 satellite) is awaited so "evacuated" MEANS stopped;
+- **failover** (unplanned loss): heartbeats went silent, the
+  scheduler expired the host, and its sessions re-place within the
+  reconnect grace window — the same warm-capture reconnect path a
+  single-host relay death already exercises, pointed at a new host;
+- **relay re-offer** (fleet-wide dead relay): the PR-5 re-offer, but
+  when the session's OWN host reports the relay unrecoverable the seat
+  moves to another host instead of retrying in place.
+
+Host handles are duck-typed (``accept_session`` / ``release_session``
+/ ``drain``): the bench's in-process simulated hosts and a future
+remote-host adapter speak the same three verbs. Synchronous with an
+injected clock, like the scheduler — contract tests never sleep.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional
+
+from .protocol import SessionSpec
+from .scheduler import Placement, SeatScheduler
+
+logger = logging.getLogger("selkies_tpu.fleet.migrate")
+
+__all__ = ["MigrationCoordinator"]
+
+
+class MigrationCoordinator:
+    """Moves placements between registered host handles."""
+
+    #: default reconnect grace. Deliberately ABOVE the scheduler's
+    #: default host_timeout_s: failover starts only after heartbeat
+    #: silence passes the timeout, so a grace at or below it would make
+    #: "re-placed within the grace" structurally impossible with stock
+    #: settings.
+    DEFAULT_GRACE_S = 15.0
+
+    def __init__(self, scheduler: SeatScheduler, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 recorder=None,
+                 grace_s: float = DEFAULT_GRACE_S):
+        self.scheduler = scheduler
+        self._clock = clock
+        self.recorder = recorder if recorder is not None \
+            else scheduler.recorder
+        self.grace_s = float(grace_s)
+        self.handles: dict[str, object] = {}
+        self.total_migrations = 0
+        self.total_failovers = 0
+        # the coordinator owns seat DELIVERY: every successful
+        # scheduler placement (first placement, queue retry, migration)
+        # is offered to the target host's handle with an IDR resync;
+        # a refusal rolls the placement back into the queue
+        scheduler.on_place = self._deliver
+        scheduler.on_release = self._undeliver
+
+    def _undeliver(self, placement: Placement) -> None:
+        """Plain session end (client left, operator release): the host
+        tears the seat down too — without this the host's next
+        heartbeat keeps charging it and the capacity never frees."""
+        handle = self.handles.get(placement.host_id)
+        if handle is None:
+            return
+        try:
+            handle.release_session(placement.sid, keep_warm=False)
+        except Exception:
+            logger.exception("fleet: host %s release of %s failed",
+                             placement.host_id, placement.sid)
+
+    def _deliver(self, placement: Placement) -> bool:
+        handle = self.handles.get(placement.host_id)
+        if handle is None:
+            # no in-process handle (remote host behind the gateway):
+            # the placement answer itself is the offer
+            return True
+        try:
+            return bool(handle.accept_session(placement, resync=True))
+        except Exception:
+            logger.exception("fleet: host %s refused seat %s",
+                             placement.host_id, placement.sid)
+            return False
+
+    def register_host(self, host_id: str, handle) -> None:
+        self.handles[host_id] = handle
+
+    def unregister_host(self, host_id: str) -> None:
+        self.handles.pop(host_id, None)
+
+    # -- one seat ------------------------------------------------------------
+    def _move(self, placement: Placement, *, kind: str,
+              exclude=(), source_alive: bool = True,
+              keep_on_failure: bool = False) -> dict:
+        """Re-place one seat and re-offer it on the target; -> a result
+        doc. The target always starts with an IDR resync; the source
+        (when still reachable) releases with its capture kept warm for
+        the reconnect grace — teardown happens when the grace expires,
+        never at handoff."""
+        sid = placement.sid
+        spec: SessionSpec = placement.spec
+        source = placement.host_id
+        if keep_on_failure and not self.scheduler.feasible(
+                spec, exclude_hosts=set(exclude) | {source}):
+            # evict with nowhere better to go: stay put UNTOUCHED — no
+            # release (a pending session would steal the freed seat),
+            # no re-accept, no gratuitous IDR. The burn streak keeps
+            # accruing; the next sweep re-asks.
+            return {"sid": sid, "moved": False, "queued": False,
+                    "from": source, "to": source}
+        self.scheduler.release(sid, notify=False)
+        new = self.scheduler.place(
+            spec, exclude_hosts=set(exclude) | {source})
+        if new is None:
+            if keep_on_failure:
+                # an evict with nowhere better to go stays put: a
+                # burning host is still strictly better than no seat
+                kept = self.scheduler.place(spec)
+                if kept is None or kept.host_id != source:
+                    # the seat left the source after all (queued, or a
+                    # pending session stole the slot and we landed
+                    # elsewhere): the source must stop running it or
+                    # its heartbeats charge a ghost seat forever
+                    self._release_source(source, sid, source_alive)
+                return {"sid": sid, "moved": False,
+                        "queued": kept is None,
+                        "from": source,
+                        "to": kept.host_id if kept else None}
+            # queued, NOT dropped: the scheduler holds it pending and
+            # retries on every capacity change; the client meanwhile
+            # rides the reconnect grace — but the SOURCE seat ends now
+            # (when it later lands, delivery goes to the new host; two
+            # live seats for one sid must never exist)
+            self._release_source(source, sid, source_alive)
+            return {"sid": sid, "moved": False, "queued": True,
+                    "from": source, "to": None}
+        new.migrations = placement.migrations + 1
+        self._release_source(source, sid, source_alive)
+        self.scheduler.note_migration(source)
+        self.scheduler.note_migration(new.host_id)
+        self.total_migrations += 1
+        self._record("seat_migrated", sid=sid, migration_kind=kind,
+                     from_host=source, to_host=new.host_id,
+                     device=new.device, seat=new.seat, idr_resync=True)
+        self._metrics_migration(kind)
+        return {"sid": sid, "moved": True, "queued": False,
+                "from": source, "to": new.host_id,
+                "idr_resync": True}
+
+    def _release_source(self, source: str, sid: str,
+                        source_alive: bool) -> None:
+        """End the seat on the source host, capture kept warm for the
+        reconnect grace (teardown happens at grace expiry, never at
+        handoff)."""
+        if not source_alive:
+            return
+        src_handle = self.handles.get(source)
+        if src_handle is None:
+            return
+        try:
+            src_handle.release_session(sid, keep_warm=True)
+        except Exception:
+            logger.exception("fleet: source %s release of %s failed",
+                             source, sid)
+
+    # -- planned drain -------------------------------------------------------
+    def evacuate(self, host_id: str) -> dict:
+        """Planned evacuation: mark draining (no new placements), move
+        every seat, then drain the source's supervisor and report. The
+        returned doc carries ``drain_handle`` so async callers can
+        await actual stop; in-process hosts complete it synchronously."""
+        t0 = self._clock()
+        placements = self.scheduler.mark_draining(host_id)
+        self._record("migration_start", host_id=host_id,
+                     seats=len(placements))
+        results = [self._move(p, kind="drain") for p in placements]
+        moved = sum(1 for r in results if r["moved"])
+        queued = sum(1 for r in results if r["queued"])
+        handle = self.handles.get(host_id)
+        drain_handle = None
+        if handle is not None and hasattr(handle, "drain"):
+            try:
+                drain_handle = handle.drain()
+            except Exception:
+                logger.exception("fleet: drain of %s failed", host_id)
+        report = {
+            "host_id": host_id,
+            "seats": len(placements),
+            "migrated": moved,
+            "queued": queued,
+            "dropped": len(placements) - moved - queued,
+            "duration_s": round(self._clock() - t0, 3),
+            "drained": bool(drain_handle.done) if drain_handle
+            is not None else None,
+            "results": results,
+        }
+        report["drain_handle"] = drain_handle
+        self._record("migration_complete", host_id=host_id,
+                     migrated=moved, queued=queued,
+                     drained=report["drained"])
+        logger.info("fleet: evacuated %s: %d migrated, %d queued",
+                    host_id, moved, queued)
+        return report
+
+    # -- unplanned loss ------------------------------------------------------
+    def handle_host_loss(self, host_id: str) -> dict:
+        """Failover after heartbeat silence: re-place the lost host's
+        seats. ``within_grace`` is per-seat honesty — a re-place that
+        lands after the client's reconnect grace expired still lands,
+        but the report says the client saw a teardown."""
+        host = self.scheduler.hosts.get(host_id)
+        last_seen = host.last_seen if host is not None else None
+        placements = self.scheduler.placements_on(host_id)
+        results = []
+        for p in placements:
+            r = self._move(p, kind="failover", source_alive=False)
+            now = self._clock()
+            r["within_grace"] = (last_seen is not None
+                                 and now - last_seen <= self.grace_s)
+            results.append(r)
+        moved = sum(1 for r in results if r["moved"])
+        self.total_failovers += 1
+        report = {
+            "host_id": host_id,
+            "seats": len(placements),
+            "replaced": moved,
+            "queued": sum(1 for r in results if r["queued"]),
+            "within_grace": sum(1 for r in results
+                                if r["moved"] and r["within_grace"]),
+            "results": results,
+        }
+        self._record("host_failover", host_id=host_id,
+                     replaced=moved, seats=len(placements),
+                     within_grace=report["within_grace"])
+        logger.warning("fleet: host %s failover: %d/%d seats re-placed",
+                       host_id, moved, len(placements))
+        return report
+
+    def check_lost_hosts(self) -> list[dict]:
+        """Periodic sweep: expire silent hosts, fail each one over."""
+        return [self.handle_host_loss(hid)
+                for hid in self.scheduler.expire()]
+
+    # -- fleet-wide dead relay ----------------------------------------------
+    def handle_relay_death(self, sid: str) -> Optional[dict]:
+        """The PR-5 dead-relay re-offer made fleet-wide: the session's
+        host declared its relay unrecoverable (local supervision parked
+        it), so offer the seat on a DIFFERENT host with an IDR resync."""
+        placement = self.scheduler.get(sid)
+        if placement is None:
+            return None
+        self._record("relay_reoffer_cross_host", sid=sid,
+                     from_host=placement.host_id)
+        return self._move(placement, kind="relay")
+
+    # -- evict-driven rebalance ----------------------------------------------
+    def rebalance(self) -> list[dict]:
+        """Apply the scheduler's hysteresis-filtered evictions (SLO
+        burn sustained on a host) — at most one move per burning host
+        per call."""
+        out = []
+        for p in self.scheduler.evictions():
+            r = self._move(p, kind="evict", keep_on_failure=True)
+            if r["moved"]:
+                self.scheduler.note_evicted(p)
+            out.append(r)
+        return out
+
+    # -- plumbing ------------------------------------------------------------
+    def _record(self, kind: str, **fields) -> None:
+        rec = self.recorder
+        if rec is None:
+            return
+        try:
+            rec.record(kind, **fields)
+        except Exception:
+            logger.debug("fleet incident record failed", exc_info=True)
+
+    def _metrics_migration(self, kind: str) -> None:
+        try:
+            from ..server import metrics
+        except Exception:
+            return
+        metrics.describe("selkies_fleet_migrations_total",
+                         "Seat migrations by kind "
+                         "(drain/failover/evict/relay)")
+        metrics.inc_counter("selkies_fleet_migrations_total",
+                            labels={"kind": kind})

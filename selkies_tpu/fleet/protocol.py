@@ -1,0 +1,437 @@
+"""Fleet control protocol: host heartbeats, placement specs, migration.
+
+One small, versioned, JSON-shaped vocabulary connects the three fleet
+parts: engine hosts emit **heartbeats** (capacity / health / SLO / warm
+state), the gateway/scheduler consumes them to make **placements**
+(session -> host/device/seat), and the migration coordinator moves
+placements between hosts with **migrate** commands that reach the
+client as a control message.
+
+Parsing is STRICT, in the PR-7 tradition (``selkies_tpu/protocol.py``
+hardening): a heartbeat crosses a trust boundary — any host that can
+reach the gateway's heartbeat endpoint steers placement — so malformed
+or absurd documents raise :class:`FleetProtocolError` and are counted
+by the caller, never folded into scheduler state. Every number is
+range-checked; unknown fields are ignored (forward compatibility);
+missing required fields are an error, not a default.
+
+Stdlib-only: the lint-image selftest round-trips heartbeats with
+neither jax nor aiohttp installed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Optional
+
+__all__ = ["PROTOCOL_VERSION", "FleetProtocolError", "DeviceCapacity",
+           "SeatSession", "Heartbeat", "SessionSpec", "parse_heartbeat",
+           "parse_session_spec", "estimate_hbm_mb", "migrate_command",
+           "heartbeat_from_core"]
+
+PROTOCOL_VERSION = 1
+
+#: sanity ceilings for range checks — far above anything real, low
+#: enough that an absurd document cannot poison capacity math
+_MAX_DEVICES = 4096
+_MAX_SEATS = 4096
+_MAX_DIM_PX = 16_384
+_MAX_HBM_MB = 16 * 1024 * 1024    # 16 TiB, in MB
+_MAX_SESSIONS = 65_536
+
+_HEALTH_STATES = ("ok", "degraded", "failed")
+
+
+class FleetProtocolError(ValueError):
+    """A fleet control document failed validation."""
+
+
+def _need(doc: dict, key: str):
+    if key not in doc:
+        raise FleetProtocolError(f"missing required field {key!r}")
+    return doc[key]
+
+
+def _num(value, name: str, lo: float, hi: float) -> float:
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        raise FleetProtocolError(f"{name} must be a number, "
+                                 f"got {type(value).__name__}")
+    v = float(value)
+    if not (lo <= v <= hi):    # NaN fails both comparisons -> rejected
+        raise FleetProtocolError(f"{name}={value!r} outside [{lo}, {hi}]")
+    return v
+
+
+def _ident(value, name: str, maxlen: int = 128) -> str:
+    if not isinstance(value, str) or not value or len(value) > maxlen:
+        raise FleetProtocolError(
+            f"{name} must be a non-empty string <= {maxlen} chars")
+    return value
+
+
+@dataclasses.dataclass
+class DeviceCapacity:
+    """One accelerator's budget axes. ``hbm_limit_mb`` comes from the
+    PR-3 DeviceMonitor (``memory_stats().bytes_limit``); ``pixel_budget``
+    is the resolution axis — the sum of placed sessions' ``w*h`` a
+    device is allowed to carry (the NVENC longitudinal study's point:
+    operating points, not uniform slots, are the capacity unit)."""
+
+    id: int
+    hbm_limit_mb: float
+    hbm_used_mb: float = 0.0
+    seat_slots: int = 1
+    seats_used: int = 0
+    pixel_budget: int = 2 * 1920 * 1080
+    pixels_used: int = 0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class SeatSession:
+    """A session as a heartbeat reports it: enough to re-place it
+    (geometry, codec, budget) plus the load/evict signal (g2g p99)."""
+
+    sid: str
+    device: int = 0
+    seat: int = 0
+    width: int = 1280
+    height: int = 720
+    codec: str = "h264"
+    hbm_mb: float = 0.0
+    g2g_p99_ms: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Heartbeat:
+    """One engine host's capacity/health snapshot."""
+
+    host_id: str
+    url: str = ""
+    fingerprint: str = ""
+    seq: int = 0
+    ts: float = 0.0
+    #: when this host PROCESS started (epoch seconds): the restart
+    #: signal — a higher started_at than previously seen means the
+    #: host rebooted, whatever order its heartbeats arrive in
+    started_at: float = 0.0
+    ready: bool = False
+    draining: bool = False
+    health: str = "ok"
+    slo_status: str = "ok"
+    slo_fast_burn: Optional[float] = None
+    devices: list = dataclasses.field(default_factory=list)
+    sessions: list = dataclasses.field(default_factory=list)
+    warm_geometries: list = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "v": PROTOCOL_VERSION, "kind": "heartbeat",
+            "host_id": self.host_id, "url": self.url,
+            "fingerprint": self.fingerprint, "seq": self.seq,
+            "ts": self.ts, "started_at": self.started_at,
+            "ready": self.ready,
+            "draining": self.draining, "health": self.health,
+            "slo": {"status": self.slo_status,
+                    "fast_burn": self.slo_fast_burn},
+            "devices": [d.to_dict() for d in self.devices],
+            "sessions": [s.to_dict() for s in self.sessions],
+            "warm_geometries": list(self.warm_geometries),
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionSpec:
+    """A placement request: what the gateway knows about a session
+    before any host has seen it."""
+
+    sid: str
+    width: int = 1280
+    height: int = 720
+    codec: str = "h264"
+    hbm_mb: float = 0.0          # 0 => estimate_hbm_mb(w, h, codec)
+
+    @property
+    def pixels(self) -> int:
+        return self.width * self.height
+
+    def budget_mb(self) -> float:
+        return self.hbm_mb or estimate_hbm_mb(self.width, self.height,
+                                              self.codec)
+
+    def to_dict(self) -> dict:
+        return {"v": PROTOCOL_VERSION, "kind": "place",
+                "sid": self.sid, "width": self.width,
+                "height": self.height, "codec": self.codec,
+                "hbm_mb": self.hbm_mb}
+
+
+def estimate_hbm_mb(width: int, height: int, codec: str = "h264") -> float:
+    """Per-session HBM budget estimate for bin-packing, derived from
+    the engine's buffer shapes: current+previous RGB frames, the YUV
+    working planes, and the codec state (H.264 holds a reference frame
+    + per-MB event stacks; JPEG holds quantised blocks). Deliberately
+    conservative (~2x the minimum) — the scheduler's job is never to
+    place a session the device cannot hold, and the heartbeat's
+    measured ``hbm_used_mb`` corrects the estimate once real."""
+    px = max(1, int(width)) * max(1, int(height))
+    base = px * (3 + 3 + 4.5) / (1024 * 1024)      # RGB x2 + YUV444 f32-ish
+    codec_state = px * (4.0 if codec == "h264" else 2.0) / (1024 * 1024)
+    return round(2.0 * (base + codec_state), 1)
+
+
+def parse_heartbeat(doc) -> Heartbeat:
+    """Validate an untrusted heartbeat document -> :class:`Heartbeat`.
+    Raises :class:`FleetProtocolError` on anything malformed."""
+    if isinstance(doc, (str, bytes)):
+        try:
+            doc = json.loads(doc)
+        except (json.JSONDecodeError, RecursionError) as e:
+            raise FleetProtocolError(f"unparseable heartbeat: {e}") from e
+    if not isinstance(doc, dict):
+        raise FleetProtocolError("heartbeat must be a JSON object")
+    if doc.get("kind") != "heartbeat":
+        raise FleetProtocolError(f"kind={doc.get('kind')!r} is not "
+                                 "'heartbeat'")
+    v = _num(_need(doc, "v"), "v", 1, 1_000)
+    if int(v) > PROTOCOL_VERSION:
+        raise FleetProtocolError(f"protocol version {int(v)} is newer "
+                                 f"than mine ({PROTOCOL_VERSION})")
+    hb = Heartbeat(
+        host_id=_ident(_need(doc, "host_id"), "host_id"),
+        url=str(doc.get("url", ""))[:512],
+        fingerprint=str(doc.get("fingerprint", ""))[:128],
+        seq=int(_num(doc.get("seq", 0), "seq", 0, 2**53)),
+        ts=_num(doc.get("ts", 0.0), "ts", 0, 2**53),
+        started_at=_num(doc.get("started_at", 0.0), "started_at",
+                        0, 2**53),
+        ready=bool(doc.get("ready", False)),
+        draining=bool(doc.get("draining", False)),
+    )
+    health = doc.get("health", "ok")
+    if health not in _HEALTH_STATES:
+        raise FleetProtocolError(f"health={health!r} not in "
+                                 f"{_HEALTH_STATES}")
+    hb.health = health
+    slo = doc.get("slo") or {}
+    if not isinstance(slo, dict):
+        raise FleetProtocolError("slo must be an object")
+    slo_status = slo.get("status", "ok")
+    if slo_status not in _HEALTH_STATES:
+        raise FleetProtocolError(f"slo.status={slo_status!r} not in "
+                                 f"{_HEALTH_STATES}")
+    hb.slo_status = slo_status
+    fast = slo.get("fast_burn")
+    hb.slo_fast_burn = None if fast is None else \
+        _num(fast, "slo.fast_burn", 0, 1e9)
+
+    devices = doc.get("devices", [])
+    if not isinstance(devices, list) or len(devices) > _MAX_DEVICES:
+        raise FleetProtocolError("devices must be a list "
+                                 f"(<= {_MAX_DEVICES})")
+    for i, d in enumerate(devices):
+        if not isinstance(d, dict):
+            raise FleetProtocolError(f"devices[{i}] must be an object")
+        hb.devices.append(DeviceCapacity(
+            id=int(_num(d.get("id", i), f"devices[{i}].id",
+                        0, _MAX_DEVICES)),
+            hbm_limit_mb=_num(_need(d, "hbm_limit_mb"),
+                              f"devices[{i}].hbm_limit_mb",
+                              0, _MAX_HBM_MB),
+            hbm_used_mb=_num(d.get("hbm_used_mb", 0.0),
+                             f"devices[{i}].hbm_used_mb",
+                             0, _MAX_HBM_MB),
+            seat_slots=int(_num(d.get("seat_slots", 1),
+                                f"devices[{i}].seat_slots",
+                                0, _MAX_SEATS)),
+            seats_used=int(_num(d.get("seats_used", 0),
+                                f"devices[{i}].seats_used",
+                                0, _MAX_SEATS)),
+            pixel_budget=int(_num(
+                d.get("pixel_budget", 2 * 1920 * 1080),
+                f"devices[{i}].pixel_budget", 0,
+                _MAX_DIM_PX * _MAX_DIM_PX)),
+            pixels_used=int(_num(
+                d.get("pixels_used", 0),
+                f"devices[{i}].pixels_used", 0,
+                _MAX_DIM_PX * _MAX_DIM_PX)),
+        ))
+
+    sessions = doc.get("sessions", [])
+    if not isinstance(sessions, list) or len(sessions) > _MAX_SESSIONS:
+        raise FleetProtocolError("sessions must be a list "
+                                 f"(<= {_MAX_SESSIONS})")
+    for i, s in enumerate(sessions):
+        if not isinstance(s, dict):
+            raise FleetProtocolError(f"sessions[{i}] must be an object")
+        g2g = s.get("g2g_p99_ms")
+        hb.sessions.append(SeatSession(
+            sid=_ident(_need(s, "sid"), f"sessions[{i}].sid"),
+            device=int(_num(s.get("device", 0),
+                            f"sessions[{i}].device", 0, _MAX_DEVICES)),
+            seat=int(_num(s.get("seat", 0),
+                          f"sessions[{i}].seat", 0, _MAX_SEATS)),
+            width=int(_num(s.get("width", 1280),
+                           f"sessions[{i}].width", 1, _MAX_DIM_PX)),
+            height=int(_num(s.get("height", 720),
+                            f"sessions[{i}].height", 1, _MAX_DIM_PX)),
+            codec=str(s.get("codec", "h264"))[:16],
+            hbm_mb=_num(s.get("hbm_mb", 0.0),
+                        f"sessions[{i}].hbm_mb", 0, _MAX_HBM_MB),
+            g2g_p99_ms=None if g2g is None else
+            _num(g2g, f"sessions[{i}].g2g_p99_ms", 0, 1e9),
+        ))
+
+    warm = doc.get("warm_geometries", [])
+    if not isinstance(warm, list) or len(warm) > 4096:
+        raise FleetProtocolError("warm_geometries must be a list")
+    for w in warm:
+        if not isinstance(w, str) or "x" not in w:
+            raise FleetProtocolError(f"warm geometry {w!r} is not 'WxH'")
+        a, _, b = w.partition("x")
+        if not (a.isdigit() and b.isdigit()):
+            raise FleetProtocolError(f"warm geometry {w!r} is not 'WxH'")
+        hb.warm_geometries.append(w)
+    return hb
+
+
+def parse_session_spec(doc) -> SessionSpec:
+    """Validate an untrusted placement request -> :class:`SessionSpec`."""
+    if isinstance(doc, (str, bytes)):
+        try:
+            doc = json.loads(doc)
+        except (json.JSONDecodeError, RecursionError) as e:
+            raise FleetProtocolError(f"unparseable spec: {e}") from e
+    if not isinstance(doc, dict):
+        raise FleetProtocolError("session spec must be a JSON object")
+    return SessionSpec(
+        sid=_ident(_need(doc, "sid"), "sid"),
+        width=int(_num(doc.get("width", 1280), "width", 1, _MAX_DIM_PX)),
+        height=int(_num(doc.get("height", 720), "height", 1,
+                        _MAX_DIM_PX)),
+        codec=str(doc.get("codec", "h264"))[:16],
+        hbm_mb=_num(doc.get("hbm_mb", 0.0), "hbm_mb", 0, _MAX_HBM_MB),
+    )
+
+
+def migrate_command(target_url: str, sid: str,
+                    resync: bool = True) -> str:
+    """The client-facing control message: ``migrate,{json}``. The web
+    client reconnects to ``url`` (carrying its sid so the gateway's
+    affinity map routes it to the new host) inside the reconnect grace
+    window; the target host answers the fresh ``START_VIDEO`` with an
+    IDR, so the decoder never sees a mid-GOP seam."""
+    return "migrate," + json.dumps(
+        {"url": str(target_url), "sid": str(sid),
+         "resync": bool(resync)}, sort_keys=True)
+
+
+def heartbeat_from_core(core, url: str = "", seq: int = 0) -> Heartbeat:
+    """Assemble this engine host's heartbeat from the live server core.
+
+    Duck-typed against the core's attributes (health engine, prewarm
+    worker, device monitor, QoE registry, settings) with every touch
+    guarded — a heartbeat must degrade to "host exists, not ready"
+    rather than raise, because the gateway treats heartbeat silence as
+    host death."""
+    from ..compile_cache import host_fingerprint, host_id
+
+    hb = Heartbeat(host_id=host_id(), url=url,
+                   fingerprint=host_fingerprint(), seq=seq,
+                   ts=time.time(),
+                   started_at=float(getattr(core, "started_at", 0.0)))
+    try:
+        # ONE evaluation of the check suite serves both answers: the
+        # process-health status (routing gates excluded) and the
+        # readiness bit (gates included) — heartbeats are periodic and
+        # running every check closure twice per beat adds up
+        from ..obs.health import FAILED as _F
+        from ..obs.health import worst as _worst
+        verdicts = core.health.run(include_gates=True)
+        gates = core.health.gate_names()
+        hb.health = _worst(v.status for n, v in verdicts.items()
+                           if n not in gates)
+        hb.ready = _worst(v.status
+                          for v in verdicts.values()) != _F
+    except Exception:
+        hb.health = "failed"
+        hb.ready = False
+    hb.draining = bool(getattr(core, "draining", False))
+    if hb.draining:
+        hb.ready = False
+
+    # SLO burn snapshot (PR 7): the scheduler's evict signal
+    try:
+        from ..obs import slo as _slo
+        rep = _slo.engine.report()
+        hb.slo_status = rep.get("status", "ok")
+        burns = [d.get("burn_fast") for d in rep.get("slos", [])
+                 if isinstance(d.get("burn_fast"), (int, float))]
+        hb.slo_fast_burn = max(burns) if burns else None
+    except Exception:
+        pass
+
+    # device capacity (PR-3 DeviceMonitor). tpu_seats is the HOST-wide
+    # seat count (parallel/seats.py shards one seat-group across the
+    # devices), so it is DISTRIBUTED over the devices — advertising it
+    # per device would overcommit the host by the device count
+    try:
+        from ..obs import monitor as _devmon
+        seats = max(1, int(getattr(core.settings, "tpu_seats", 1)))
+        devs = _devmon.snapshot().get("devices", [])
+        n = max(1, len(devs))
+        for i, d in enumerate(devs):
+            hb.devices.append(DeviceCapacity(
+                id=int(d.get("id", len(hb.devices))),
+                hbm_limit_mb=round(
+                    (d.get("hbm_limit") or 0) / (1024 * 1024), 1),
+                hbm_used_mb=round(
+                    (d.get("hbm_in_use") or 0) / (1024 * 1024), 1),
+                seat_slots=seats // n + (1 if i < seats % n else 0),
+            ))
+    except Exception:
+        pass
+
+    # warm geometries + per-session g2g (PR 8 + PR 7)
+    try:
+        if getattr(core, "prewarm", None) is not None:
+            hb.warm_geometries = core.prewarm.warm_geometries()
+    except Exception:
+        pass
+    try:
+        from ..obs import qoe as _qoe
+        w = int(getattr(core.settings, "initial_width", 1280))
+        h = int(getattr(core.settings, "initial_height", 720))
+        codec = "jpeg" if str(getattr(core.settings, "encoder", "")
+                              ).startswith("jpeg") else "h264"
+        for s in _qoe.registry.report().get("sessions", []):
+            hb.sessions.append(SeatSession(
+                sid=str(s.get("sid", s.get("seat", "?"))),
+                width=w, height=h, codec=codec,
+                hbm_mb=estimate_hbm_mb(w, h, codec),
+                g2g_p99_ms=s.get("g2g_p99_ms")))
+        # occupancy floor for a scheduler that did NOT place these
+        # sessions (operator-started seats, or a gateway rebuilding
+        # after a restart): charge them onto device 0 — the engine
+        # host doesn't expose a per-seat device map yet, and an
+        # over-conservative floor on one device beats seats that take
+        # no space at all
+        if hb.devices and hb.sessions:
+            hb.devices[0].seats_used = max(
+                hb.devices[0].seats_used, len(hb.sessions))
+            hb.devices[0].pixels_used = max(
+                hb.devices[0].pixels_used,
+                sum(s.width * s.height for s in hb.sessions))
+    except Exception:
+        pass
+    return hb

@@ -1,0 +1,554 @@
+"""Seat scheduler: sessions -> (host, device, seat-slot) bin-packing.
+
+The placement layer ROADMAP item 3 names. Capacity is NOT uniform
+slots: each device carries two budget axes — HBM megabytes (fed by the
+PR-3 DeviceMonitor via heartbeats) and a pixel budget (the resolution
+axis; a device that can hold eight 480p seats cannot hold eight 4K
+ones) — and a session consumes both. The scheduler bin-packs against
+the budgets, scores feasible targets, and owns three behaviours the
+fleet contract tests pin:
+
+- **refusal is queueing, not dropping**: when no host has headroom the
+  session parks in a bounded pending queue with a ``placement_pending``
+  incident; every capacity change (heartbeat, release, new host)
+  retries the queue in arrival order;
+- **warm-host preference**: a host whose prewarm lattice already
+  compiled the session's geometry (heartbeat ``warm_geometries``)
+  scores above a cold-but-feasible one — placing there costs zero
+  foreground compiles (PR 8's whole point);
+- **evict hysteresis**: the SLO burn signal (PR 7) must persist for
+  ``evict_confirm`` consecutive heartbeats before any session moves,
+  and a host that just received/lost a migration holds for
+  ``evict_hold_s`` — one burn blip must never thrash placements.
+
+The scheduler is deliberately synchronous with an injected clock: the
+gateway's async tier and the bench's simulated fleet both drive it, and
+the contract tests never sleep.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from .protocol import DeviceCapacity, Heartbeat, SessionSpec
+
+logger = logging.getLogger("selkies_tpu.fleet.scheduler")
+
+__all__ = ["Placement", "HostState", "SeatScheduler"]
+
+#: a host whose heartbeats stopped this long ago is lost (its sessions
+#: enter the failover path with the reconnect grace clock ticking)
+DEFAULT_HOST_TIMEOUT_S = 10.0
+
+#: two-window burn-rate alert threshold (obs.slo uses 14.4 for the
+#: fast window); heartbeats at/above it count toward the evict streak
+DEFAULT_EVICT_BURN = 14.4
+
+
+@dataclasses.dataclass
+class Placement:
+    sid: str
+    host_id: str
+    device: int
+    seat: int
+    spec: SessionSpec
+    placed_at: float = 0.0
+    migrations: int = 0
+
+    def to_dict(self) -> dict:
+        return {"sid": self.sid, "host_id": self.host_id,
+                "device": self.device, "seat": self.seat,
+                "width": self.spec.width, "height": self.spec.height,
+                "codec": self.spec.codec,
+                "hbm_mb": self.spec.budget_mb(),
+                "migrations": self.migrations}
+
+
+class HostState:
+    """The scheduler's view of one engine host, refreshed per
+    heartbeat. Capacity accounting is scheduler-authoritative: the
+    scheduler's OWN placements charge seats/HBM/pixels immediately (a
+    heartbeat lags a placement by up to one period — double-placing
+    into that window is the classic scheduler race)."""
+
+    def __init__(self, hb: Heartbeat, now: float):
+        self.host_id = hb.host_id
+        self.url = hb.url
+        self.heartbeat = hb
+        self.first_seen = now
+        self.last_seen = now
+        self.lost = False
+        self.draining = hb.draining
+        self.burn_streak = 0
+        self.last_migration_at: Optional[float] = None
+
+    @property
+    def ready(self) -> bool:
+        return (not self.lost and not self.draining
+                and self.heartbeat.ready
+                and self.heartbeat.health != "failed")
+
+    def update(self, hb: Heartbeat, now: float,
+               burn_threshold: float) -> None:
+        restarted = (hb.started_at > self.heartbeat.started_at
+                     if hb.started_at and self.heartbeat.started_at
+                     # hosts not reporting started_at: fall back to the
+                     # heartbeat counter resetting to exactly 1 (merely
+                     # lower would mistake a reordered in-flight
+                     # heartbeat for a reboot)
+                     else hb.seq == 1 and self.heartbeat.seq > 1)
+        if restarted and not hb.draining:
+            # the host PROCESS restarted: a drained-then-rebooted host
+            # rejoins the feasible set (the sticky drain flag otherwise
+            # shrinks the fleet one evacuation at a time). started_at
+            # is reorder-proof — every heartbeat of one process carries
+            # the same value, and a poller bumping /api/fleet's seq
+            # cannot mask a reboot.
+            self.draining = False
+            self.burn_streak = 0
+        self.heartbeat = hb
+        self.url = hb.url or self.url
+        self.last_seen = now
+        self.lost = False
+        self.draining = self.draining or hb.draining
+        burning = hb.slo_status == "failed" or (
+            hb.slo_fast_burn is not None
+            and hb.slo_fast_burn >= burn_threshold)
+        self.burn_streak = self.burn_streak + 1 if burning else 0
+
+    def to_dict(self) -> dict:
+        return {"host_id": self.host_id, "url": self.url,
+                "ready": self.ready, "lost": self.lost,
+                "draining": self.draining,
+                "health": self.heartbeat.health,
+                "slo_status": self.heartbeat.slo_status,
+                "burn_streak": self.burn_streak,
+                "warm_geometries": list(self.heartbeat.warm_geometries),
+                "devices": [d.to_dict()
+                            for d in self.heartbeat.devices]}
+
+
+class SeatScheduler:
+    """Placement engine over heartbeat-fed host state."""
+
+    def __init__(self, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 recorder=None,
+                 host_timeout_s: float = DEFAULT_HOST_TIMEOUT_S,
+                 evict_burn_threshold: float = DEFAULT_EVICT_BURN,
+                 evict_confirm: int = 3,
+                 evict_hold_s: float = 30.0,
+                 warm_bonus: float = 1.0,
+                 pack_weight: float = 0.5,
+                 burn_penalty: float = 2.0,
+                 pending_cap: int = 1024):
+        self._clock = clock
+        self.recorder = recorder
+        self.host_timeout_s = float(host_timeout_s)
+        self.evict_burn_threshold = float(evict_burn_threshold)
+        self.evict_confirm = int(evict_confirm)
+        self.evict_hold_s = float(evict_hold_s)
+        self.warm_bonus = float(warm_bonus)
+        self.pack_weight = float(pack_weight)
+        self.burn_penalty = float(burn_penalty)
+        self.pending_cap = int(pending_cap)
+        self._lock = threading.Lock()
+        self.hosts: dict[str, HostState] = {}
+        self.placements: dict[str, Placement] = {}
+        self.pending: collections.deque = collections.deque()
+        self.total_placements = 0
+        self.total_queued = 0
+        self.total_evictions = 0
+        #: delivery hook: called with each successful Placement (the
+        #: migration coordinator offers the seat on the host handle);
+        #: returning False refuses the placement — it is rolled back
+        #: and queued instead of half-placed
+        self.on_place: Optional[Callable[[Placement], bool]] = None
+        #: the symmetric teardown hook: a released placement must also
+        #: END on its host, or the host's next heartbeat keeps charging
+        #: the seat and the freed capacity never really frees
+        self.on_release: Optional[Callable[[Placement], None]] = None
+
+    # -- heartbeat intake ----------------------------------------------------
+    def observe(self, hb: Heartbeat) -> HostState:
+        """Fold one validated heartbeat into host state, then retry the
+        pending queue (capacity may just have appeared)."""
+        now = self._clock()
+        with self._lock:
+            host = self.hosts.get(hb.host_id)
+            if host is None:
+                host = HostState(hb, now)
+                host.update(hb, now, self.evict_burn_threshold)
+                self.hosts[hb.host_id] = host
+                logger.info("fleet: host %s joined (%d device(s), "
+                            "ready=%s)", hb.host_id, len(hb.devices),
+                            host.ready)
+            else:
+                host.update(hb, now, self.evict_burn_threshold)
+        self.retry_pending()
+        self._update_metrics()
+        return host
+
+    def expire(self) -> list[str]:
+        """Mark hosts whose heartbeats went silent as lost; -> the
+        newly-lost host ids (the coordinator starts failover for their
+        placements — the reconnect grace clock is already ticking from
+        ``last_seen``)."""
+        now = self._clock()
+        lost: list[str] = []
+        with self._lock:
+            for host in self.hosts.values():
+                if not host.lost \
+                        and now - host.last_seen > self.host_timeout_s:
+                    host.lost = True
+                    lost.append(host.host_id)
+        for hid in lost:
+            self._record("host_lost", host_id=hid,
+                         silent_s=round(self.host_timeout_s, 1))
+            logger.warning("fleet: host %s lost (no heartbeat for "
+                           ">%.1fs)", hid, self.host_timeout_s)
+        if lost:
+            self._update_metrics()
+        return lost
+
+    # -- capacity math -------------------------------------------------------
+    def _load_map(self) -> dict:
+        """(host_id, device) -> [seats, hbm_mb, pixels] charged by
+        scheduler placements — ONE scan, shared across every candidate
+        device in a placement/feasibility pass (per-device rescans made
+        a heartbeat round O(hosts x devices x placements))."""
+        loads: dict = {}
+        for p in self.placements.values():
+            entry = loads.setdefault((p.host_id, p.device),
+                                     [0, 0.0, 0])
+            entry[0] += 1
+            entry[1] += p.spec.budget_mb()
+            entry[2] += p.spec.pixels
+        return loads
+
+    def _fits(self, host: HostState, dev: DeviceCapacity,
+              spec: SessionSpec, loads: dict) -> Optional[float]:
+        """None when infeasible; else the post-placement fill fraction
+        (the bin-packing signal: fuller is better)."""
+        seats, hbm, px = loads.get((host.host_id, dev.id),
+                                   (0, 0.0, 0))
+        # the heartbeat's own numbers floor the local view: sessions the
+        # scheduler never placed (operator-started) still take space
+        seats = max(seats, dev.seats_used)
+        hbm = max(hbm, dev.hbm_used_mb)
+        px = max(px, dev.pixels_used)
+        if dev.seat_slots <= 0 or seats >= dev.seat_slots:
+            return None
+        if dev.hbm_limit_mb > 0 \
+                and hbm + spec.budget_mb() > dev.hbm_limit_mb:
+            return None
+        if dev.pixel_budget > 0 \
+                and px + spec.pixels > dev.pixel_budget:
+            return None
+        fills = [(seats + 1) / dev.seat_slots]
+        if dev.hbm_limit_mb > 0:
+            fills.append((hbm + spec.budget_mb()) / dev.hbm_limit_mb)
+        if dev.pixel_budget > 0:
+            fills.append((px + spec.pixels) / dev.pixel_budget)
+        return max(fills)
+
+    def _free_seat(self, host: HostState, device_id: int,
+                   slots: int) -> int:
+        used = {p.seat for p in self.placements.values()
+                if p.host_id == host.host_id
+                and p.device == device_id}
+        # seats the HOST reports that the scheduler never placed
+        # (operator-started sessions) are just as occupied
+        used |= {s.seat for s in host.heartbeat.sessions
+                 if s.device == device_id}
+        for i in range(max(1, slots)):
+            if i not in used:
+                return i
+        return len(used)
+
+    def _score(self, host: HostState, fill: float,
+               spec: SessionSpec) -> float:
+        score = self.pack_weight * fill
+        geo = f"{spec.width}x{spec.height}"
+        if geo in host.heartbeat.warm_geometries:
+            score += self.warm_bonus
+        if host.heartbeat.health == "degraded":
+            score -= self.burn_penalty / 2
+        if host.burn_streak > 0:
+            score -= self.burn_penalty
+        return score
+
+    # -- placement -----------------------------------------------------------
+    def place(self, spec: SessionSpec, exclude_hosts=(),
+              queue_on_fail: bool = True) -> Optional[Placement]:
+        """Bin-pack one session. None => queued (never dropped): the
+        caller holds the session in reconnect grace and the queue
+        retries on every capacity change. ``queue_on_fail=False`` is
+        the retry path's probe — the caller already owns the queue
+        entry and re-fronts it itself (re-queueing here would rotate
+        the head to the tail and break FIFO fairness)."""
+        exclude = set(exclude_hosts)
+        with self._lock:
+            if spec.sid in self.placements:
+                return self.placements[spec.sid]
+            best = None       # (score, host, dev, fill)
+            loads = self._load_map()
+            for host in self.hosts.values():
+                if host.host_id in exclude or not host.ready:
+                    continue
+                for dev in host.heartbeat.devices:
+                    fill = self._fits(host, dev, spec, loads)
+                    if fill is None:
+                        continue
+                    score = self._score(host, fill, spec)
+                    if best is None or score > best[0]:
+                        best = (score, host, dev, fill)
+            if best is None:
+                if queue_on_fail:
+                    self._queue(spec)
+                return None
+            _, host, dev, _ = best
+            seat = self._free_seat(host, dev.id, dev.seat_slots)
+            p = Placement(sid=spec.sid, host_id=host.host_id,
+                          device=dev.id, seat=seat, spec=spec,
+                          placed_at=self._clock())
+            self.placements[spec.sid] = p
+            self.total_placements += 1
+        cb = self.on_place
+        if cb is not None:
+            delivered = False
+            try:
+                delivered = bool(cb(p))
+            except Exception:
+                logger.exception("placement delivery hook failed")
+            if not delivered:
+                # the host refused the seat (died between heartbeat and
+                # offer): roll back and queue — never half-placed
+                with self._lock:
+                    self.placements.pop(spec.sid, None)
+                    if queue_on_fail:
+                        self._queue(spec)
+                self._record("placement_refused", sid=spec.sid,
+                             host_id=p.host_id)
+                return None
+        self._record("seat_placed", sid=spec.sid, host_id=p.host_id,
+                     device=p.device, seat=p.seat,
+                     geometry=f"{spec.width}x{spec.height}")
+        self._update_metrics()
+        return p
+
+    def feasible(self, spec: SessionSpec, exclude_hosts=()) -> bool:
+        """Read-only probe: would ``place`` land this spec right now?
+        The evict path asks BEFORE releasing a seat — tearing a session
+        off a burning host with nowhere better to go would trade a slow
+        seat for no seat (and an IDR storm of failed re-offers)."""
+        exclude = set(exclude_hosts)
+        with self._lock:
+            loads = self._load_map()
+            for host in self.hosts.values():
+                if host.host_id in exclude or not host.ready:
+                    continue
+                for dev in host.heartbeat.devices:
+                    if self._fits(host, dev, spec, loads) is not None:
+                        return True
+        return False
+
+    def _queue(self, spec: SessionSpec) -> None:
+        """Caller holds the lock. Bounded: past the cap the OLDEST
+        pending request drops with an incident (explicitly visible —
+        never a silent loss) to keep memory bounded under a flood."""
+        if any(s.sid == spec.sid for s, _ in self.pending):
+            return
+        if len(self.pending) >= self.pending_cap:
+            old_spec, _ = self.pending.popleft()
+            self._record("placement_dropped", sid=old_spec.sid,
+                         reason="pending queue full")
+        self.pending.append((spec, self._clock()))
+        self.total_queued += 1
+        self._record("placement_pending", sid=spec.sid,
+                     geometry=f"{spec.width}x{spec.height}",
+                     hbm_mb=spec.budget_mb(),
+                     queue_depth=len(self.pending))
+        logger.warning("fleet: no host has headroom for %s "
+                       "(%dx%d, %.0f MB); queued at depth %d",
+                       spec.sid, spec.width, spec.height,
+                       spec.budget_mb(), len(self.pending))
+
+    def retry_pending(self) -> int:
+        """Re-place queued sessions in arrival order; -> how many
+        landed. Stops at the first refusal: if the head of the queue
+        still does not fit, nothing behind it may jump it into the same
+        capacity (FIFO fairness keeps the math predictable)."""
+        placed = 0
+        while True:
+            with self._lock:
+                if not self.pending:
+                    break
+                spec, queued_at = self.pending.popleft()
+            p = self.place(spec, queue_on_fail=False)
+            if p is None:
+                with self._lock:
+                    # back in FRONT with its original timestamp: FIFO
+                    # fairness holds and queued_s stays honest
+                    self.pending.appendleft((spec, queued_at))
+                break
+            placed += 1
+        return placed
+
+    def cancel_pending(self, sid: str) -> bool:
+        """Withdraw a queued (never-placed) request — the gateway's
+        abandoned-WS path: a 503'd connection whose spec stayed pending
+        would otherwise place a ghost seat when capacity frees, with no
+        connection left to ever release it."""
+        with self._lock:
+            for i, (s, _) in enumerate(self.pending):
+                if s.sid == sid:
+                    del self.pending[i]
+                    return True
+        return False
+
+    def release(self, sid: str, notify: bool = True
+                ) -> Optional[Placement]:
+        """Session ended (or migrated away): free its seat, then retry
+        the queue into the freed capacity. ``notify=False`` is the
+        migration path — the coordinator manages the source handle
+        itself (keep-warm semantics differ from a plain session end)."""
+        with self._lock:
+            p = self.placements.pop(sid, None)
+        if p is not None:
+            if notify and self.on_release is not None:
+                try:
+                    self.on_release(p)
+                except Exception:
+                    logger.exception("placement release hook failed")
+            self.retry_pending()
+            self._update_metrics()
+        return p
+
+    def get(self, sid: str) -> Optional[Placement]:
+        with self._lock:
+            return self.placements.get(sid)
+
+    def placements_on(self, host_id: str) -> list[Placement]:
+        with self._lock:
+            return [p for p in self.placements.values()
+                    if p.host_id == host_id]
+
+    # -- drain / evict -------------------------------------------------------
+    def mark_draining(self, host_id: str) -> list[Placement]:
+        """No further placements land on the host; -> its current
+        placements (the migration coordinator's work list)."""
+        with self._lock:
+            host = self.hosts.get(host_id)
+            if host is not None:
+                host.draining = True
+        self._record("host_draining", host_id=host_id)
+        return self.placements_on(host_id)
+
+    def note_migration(self, host_id: str) -> None:
+        """Start the post-migration hold on a host (both the source and
+        the target of a move count: re-evicting either while the fleet
+        is still settling is the thrash the hysteresis exists to
+        stop)."""
+        with self._lock:
+            host = self.hosts.get(host_id)
+            if host is not None:
+                host.last_migration_at = self._clock()
+                host.burn_streak = 0
+
+    def evictions(self) -> list[Placement]:
+        """Sessions that SHOULD move off SLO-burning hosts — pure
+        selection, at most one per burning host per call (move,
+        observe, only then move again). Hysteresis: ``evict_confirm``
+        consecutive burning heartbeats AND no migration inside
+        ``evict_hold_s``. Incident/counter recording belongs to the
+        coordinator's rebalance — a sustained burn with nowhere to
+        move would otherwise flood the bounded flight recorder with
+        one ``seat_evict`` per sweep for moves that never happened."""
+        now = self._clock()
+        out: list[Placement] = []
+        with self._lock:
+            for host in self.hosts.values():
+                if host.lost or host.draining:
+                    continue
+                if host.burn_streak < self.evict_confirm:
+                    continue
+                if host.last_migration_at is not None \
+                        and now - host.last_migration_at \
+                        < self.evict_hold_s:
+                    continue
+                victims = [p for p in self.placements.values()
+                           if p.host_id == host.host_id]
+                if not victims:
+                    continue
+                by_sid = {s.sid: s.g2g_p99_ms
+                          for s in host.heartbeat.sessions}
+                victims.sort(key=lambda p: by_sid.get(p.sid) or 0.0,
+                             reverse=True)
+                out.append(victims[0])
+        return out
+
+    def note_evicted(self, placement: Placement) -> None:
+        """A selected eviction actually MOVED (coordinator callback):
+        count it and make it visible."""
+        self.total_evictions += 1
+        self._record("seat_evict", sid=placement.sid,
+                     host_id=placement.host_id,
+                     reason="slo burn sustained")
+
+    # -- reporting -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "hosts": {h.host_id: h.to_dict()
+                          for h in self.hosts.values()},
+                "placements": [p.to_dict()
+                               for p in self.placements.values()],
+                "pending": [{"sid": s.sid,
+                             "geometry": f"{s.width}x{s.height}",
+                             "queued_s": round(self._clock() - t, 3)}
+                            for s, t in self.pending],
+                "totals": {"placements": self.total_placements,
+                           "queued": self.total_queued,
+                           "evictions": self.total_evictions},
+            }
+
+    def _record(self, kind: str, **fields) -> None:
+        rec = self.recorder
+        if rec is None:
+            return
+        try:
+            rec.record(kind, **fields)
+        except Exception:
+            logger.debug("fleet incident record failed", exc_info=True)
+
+    def _update_metrics(self) -> None:
+        try:
+            from ..server import metrics
+        except Exception:
+            return
+        with self._lock:
+            ready = sum(1 for h in self.hosts.values() if h.ready)
+            lost = sum(1 for h in self.hosts.values() if h.lost)
+            n_hosts = len(self.hosts)
+            n_place = len(self.placements)
+            n_pend = len(self.pending)
+        metrics.describe("selkies_fleet_hosts",
+                         "Known fleet hosts by state")
+        metrics.describe("selkies_fleet_placements",
+                         "Sessions currently placed on a seat")
+        metrics.describe("selkies_fleet_pending",
+                         "Sessions queued with no feasible placement")
+        metrics.set_gauge("selkies_fleet_hosts", n_hosts,
+                          {"state": "known"})
+        metrics.set_gauge("selkies_fleet_hosts", ready,
+                          {"state": "ready"})
+        metrics.set_gauge("selkies_fleet_hosts", lost,
+                          {"state": "lost"})
+        metrics.set_gauge("selkies_fleet_placements", n_place)
+        metrics.set_gauge("selkies_fleet_pending", n_pend)

@@ -1,0 +1,315 @@
+"""In-process simulated engine hosts: the fleet's CPU contract rig.
+
+``bench.py --chaos`` proves single-host recovery against a live
+pipeline; the fleet plane's behaviours (bin-packing, drain, failover,
+cross-host re-offer) are HOST-count properties, not encoder properties
+— so the rig simulates the host boundary and keeps everything inside
+one process with one injected clock. Each :class:`SimHost`:
+
+- carries real :class:`..protocol.DeviceCapacity` budgets and emits
+  real heartbeats (the bench round-trips them through
+  ``to_dict`` -> ``parse_heartbeat``, so the wire contract is
+  exercised, not bypassed);
+- supervises its seats with the REAL PR-5 :class:`Supervisor` (manual
+  time-ordered scheduler, injected clock) so ``drain()`` is the real
+  ISSUE-11 drain awaitable, not a sim shortcut;
+- models the prewarm plane's readiness: cold for ``warm_after_s``
+  after start (readiness gate holds placements off), then warm for its
+  configured geometries (the scheduler's warm-host bonus);
+- counts IDR resyncs and warm-capture handoffs so the migration
+  contract ("clients never see a teardown") is assertable.
+
+No sleeps anywhere: time only moves when the driver moves the clock.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from ..resilience.supervisor import RestartPolicy, Supervisor
+from .protocol import DeviceCapacity, Heartbeat, SeatSession
+
+logger = logging.getLogger("selkies_tpu.fleet.sim")
+
+__all__ = ["ManualScheduler", "SimHost", "SimFleet"]
+
+
+class ManualScheduler:
+    """Supervisor ``schedule`` seam on the injected clock: callbacks
+    fire when the driver's clock passes their deadline (pump())."""
+
+    class _Handle:
+        def __init__(self, sched, entry):
+            self._sched, self._entry = sched, entry
+
+        def cancel(self):
+            if self._entry in self._sched.pending:
+                self._sched.pending.remove(self._entry)
+
+    def __init__(self, clock: Callable[[], float]):
+        self._clock = clock
+        self.pending: list = []
+
+    def __call__(self, delay: float, cb: Callable[[], None]):
+        entry = [self._clock() + delay, cb]
+        self.pending.append(entry)
+        return self._Handle(self, entry)
+
+    def pump(self) -> int:
+        now = self._clock()
+        due = [e for e in self.pending if e[0] <= now]
+        for e in due:
+            self.pending.remove(e)
+            e[1]()
+        return len(due)
+
+
+class SimHost:
+    """One simulated engine host behind the heartbeat protocol."""
+
+    def __init__(self, host_id: str, *,
+                 clock: Callable[[], float],
+                 devices: int = 1,
+                 seat_slots: int = 4,
+                 hbm_limit_mb: float = 8192.0,
+                 pixel_budget: int = 2 * 1920 * 1080,
+                 warm_after_s: float = 2.0,
+                 warm_geometries=(),
+                 grace_s: float = 3.0,
+                 recorder=None):
+        self.host_id = host_id
+        self.url = f"sim://{host_id}"
+        self._clock = clock
+        self.alive = True
+        self.started_at = clock()
+        self.warm_after_s = float(warm_after_s)
+        self.grace_s = float(grace_s)
+        self._warm_geometries = set(warm_geometries)
+        self.devices = [DeviceCapacity(
+            id=i, hbm_limit_mb=float(hbm_limit_mb),
+            seat_slots=int(seat_slots),
+            pixel_budget=int(pixel_budget)) for i in range(devices)]
+        #: sid -> {"placement", "spec", "idr_resyncs", "relay_dead"}
+        self.sessions: dict[str, dict] = {}
+        #: sid -> warm-capture expiry (the reconnect-grace handoff
+        #: window: a released seat keeps its capture until then)
+        self.warm_captures: dict[str, float] = {}
+        self.idr_resyncs = 0
+        self.teardowns_seen = 0        # handoffs where NO warm capture
+        self.seq = 0
+        self.slo_burning = False
+        self.slo_fast_burn: Optional[float] = None
+        self.on_relay_unrecoverable: Optional[Callable[[str], None]] = None
+        self.sched = ManualScheduler(clock)
+        self.supervisor = Supervisor(
+            recorder=recorder,
+            policy_factory=lambda: RestartPolicy(
+                max_restarts=2, window_s=60.0, base_backoff_s=0.1,
+                max_backoff_s=0.5, min_uptime_s=0.5, seed=0,
+                clock=clock),
+            schedule=self.sched)
+
+    # -- prewarm / readiness -------------------------------------------------
+    @property
+    def ready(self) -> bool:
+        return (self.alive
+                and self._clock() - self.started_at >= self.warm_after_s)
+
+    def warm_geometry(self, geo: str) -> None:
+        self._warm_geometries.add(geo)
+
+    def warm_geometries(self) -> list:
+        # nothing is warm before the (simulated) prewarm worker finished
+        return sorted(self._warm_geometries) if self.ready else []
+
+    # -- seat lifecycle (the migrate.py host-handle verbs) -------------------
+    def accept_session(self, placement, resync: bool = True) -> bool:
+        if not self.alive:
+            return False
+        sid = placement.sid
+        self.sessions[sid] = {"placement": placement,
+                              "spec": placement.spec,
+                              "idr_resyncs": 0, "relay_dead": False}
+        if resync:
+            self.idr_resyncs += 1
+            self.sessions[sid]["idr_resyncs"] += 1
+        # same-host re-place (aborted drain, evict bounce-back): the
+        # warm capture is claimed by the fresh seat. Cross-host warm
+        # captures live on the SOURCE; ``teardowns_seen`` counts the
+        # source-side releases that were NOT kept warm (the only
+        # teardown this host can observe)
+        self.warm_captures.pop(sid, None)
+        self.supervisor.adopt(
+            f"relay:{sid}", lambda s=sid: self._restart_relay(s))
+        return True
+
+    def release_session(self, sid: str, keep_warm: bool = True) -> None:
+        self.sessions.pop(sid, None)
+        self.supervisor.drop(f"relay:{sid}")
+        if keep_warm and self.alive:
+            self.warm_captures[sid] = self._clock() + self.grace_s
+        elif not keep_warm:
+            self.teardowns_seen += 1
+
+    def expire_warm_captures(self) -> int:
+        now = self._clock()
+        expired = [s for s, t in self.warm_captures.items() if now > t]
+        for s in expired:
+            self.warm_captures.pop(s, None)
+        return len(expired)
+
+    def drain(self):
+        """The real supervisor drain: stop restarting, then stop every
+        remaining seat deliberately (queued/unmoved seats ride the
+        reconnect grace — their captures stay warm) and return the
+        completion handle."""
+        handle = self.supervisor.drain()
+        for sid in list(self.sessions):
+            self.release_session(sid, keep_warm=True)
+        return handle
+
+    # -- failure injection ---------------------------------------------------
+    def _restart_relay(self, sid: str) -> None:
+        sess = self.sessions.get(sid)
+        if sess is None:
+            return
+        if sess["relay_dead"]:
+            # the fault persists: the restarted relay dies again
+            # immediately (the crash-loop path the policy budget parks)
+            raise RuntimeError("relay still dead")
+        sess["idr_resyncs"] += 1
+        self.idr_resyncs += 1
+
+    def kill_relay(self, sid: str, unrecoverable: bool = True) -> None:
+        """Inject a dead relay on a seat. Recoverable deaths restart in
+        place (PR-5 behaviour); an unrecoverable one exhausts the local
+        budget and escalates to the fleet re-offer hook."""
+        sess = self.sessions.get(sid)
+        if sess is None:
+            return
+        sess["relay_dead"] = unrecoverable
+
+        comp = f"relay:{sid}"
+
+        def _give_up(s=sid):
+            hook = self.on_relay_unrecoverable
+            if hook is not None:
+                hook(s)
+
+        c = self.supervisor.get(comp)
+        if c is not None:
+            c.on_give_up = _give_up
+        self.supervisor.report_death(comp, "media send stalled/failed")
+
+    def pump(self) -> None:
+        """Fire due supervisor backoff timers (call after each clock
+        advance)."""
+        # repeatedly: a fired restart may schedule the next death's
+        # backoff inside the same pump window
+        for _ in range(16):
+            if not self.sched.pump():
+                break
+
+    def kill(self) -> None:
+        """Unplanned death: heartbeats stop mid-flight; nothing is
+        released cleanly."""
+        self.alive = False
+
+    # -- heartbeat -----------------------------------------------------------
+    def heartbeat(self) -> Optional[Heartbeat]:
+        if not self.alive:
+            return None
+        self.seq += 1
+        devices = []
+        for d in self.devices:
+            seats = sum(1 for s in self.sessions.values()
+                        if s["placement"].device == d.id)
+            hbm = sum(s["spec"].budget_mb()
+                      for s in self.sessions.values()
+                      if s["placement"].device == d.id)
+            px = sum(s["spec"].pixels for s in self.sessions.values()
+                     if s["placement"].device == d.id)
+            devices.append(DeviceCapacity(
+                id=d.id, hbm_limit_mb=d.hbm_limit_mb,
+                hbm_used_mb=round(hbm, 1),
+                seat_slots=d.seat_slots, seats_used=seats,
+                pixel_budget=d.pixel_budget, pixels_used=px))
+        hb = Heartbeat(
+            host_id=self.host_id, url=self.url,
+            fingerprint=f"sim-{self.host_id}",
+            seq=self.seq, ts=self._clock(),
+            started_at=self.started_at,
+            ready=self.ready, draining=self.supervisor.draining,
+            health="ok" if self.ready else "degraded",
+            slo_status="failed" if self.slo_burning else "ok",
+            slo_fast_burn=self.slo_fast_burn
+            if self.slo_fast_burn is not None
+            else (20.0 if self.slo_burning else 0.0),
+            devices=devices,
+            sessions=[SeatSession(
+                sid=sid, device=s["placement"].device,
+                seat=s["placement"].seat, width=s["spec"].width,
+                height=s["spec"].height, codec=s["spec"].codec,
+                hbm_mb=s["spec"].budget_mb(),
+                g2g_p99_ms=250.0 if self.slo_burning else 40.0)
+                for sid, s in self.sessions.items()],
+            warm_geometries=self.warm_geometries(),
+        )
+        return hb
+
+
+class SimFleet:
+    """N simulated hosts + the real scheduler/coordinator on one
+    injected clock — the rig bench ``--fleet`` and the contract tests
+    drive. ``tick()`` advances time and pumps heartbeats through the
+    REAL wire parser."""
+
+    def __init__(self, scheduler, coordinator, *,
+                 clock_box: Optional[list] = None):
+        from .protocol import parse_heartbeat
+        self._parse = parse_heartbeat
+        self.scheduler = scheduler
+        self.coordinator = coordinator
+        self.hosts: dict[str, SimHost] = {}
+        self.clock_box = clock_box if clock_box is not None else [0.0]
+        self.heartbeats_sent = 0
+        self.heartbeats_rejected = 0
+
+    def clock(self) -> float:
+        return self.clock_box[0]
+
+    def add_host(self, host: SimHost) -> SimHost:
+        self.hosts[host.host_id] = host
+        self.coordinator.register_host(host.host_id, host)
+        host.on_relay_unrecoverable = \
+            self.coordinator.handle_relay_death
+        return host
+
+    def tick(self, dt: float = 0.0, heartbeat: bool = True) -> None:
+        self.clock_box[0] += dt
+        for host in self.hosts.values():
+            host.pump()
+            host.expire_warm_captures()
+            if not heartbeat:
+                continue
+            hb = host.heartbeat()
+            if hb is None:
+                continue
+            # the real wire contract: serialize -> strict parse
+            try:
+                self.scheduler.observe(self._parse(hb.to_dict()))
+                self.heartbeats_sent += 1
+            except Exception:
+                self.heartbeats_rejected += 1
+                logger.exception("sim heartbeat rejected")
+        self.coordinator.check_lost_hosts()
+
+    def run_until(self, pred: Callable[[], bool], *, dt: float = 0.5,
+                  budget_s: float = 60.0) -> bool:
+        deadline = self.clock() + budget_s
+        while self.clock() < deadline:
+            if pred():
+                return True
+            self.tick(dt)
+        return pred()

@@ -46,14 +46,18 @@ _metrics_mod = _METRICS_UNSET
 def _metrics():
     """The server metrics registry, or None in images without the server
     plane's dependencies (aiohttp is absent from the lint CI image; the
-    selftest must still run there)."""
+    selftest must still run there). Only SUCCESS is cached: the first
+    call can land inside a circular-import window (importing obs before
+    server pulls server.core back into the half-initialized obs
+    package), and caching that transient failure silently dropped every
+    ``selkies_device_*`` gauge for the life of the process."""
     global _metrics_mod
-    if _metrics_mod is _METRICS_UNSET:
+    if _metrics_mod is _METRICS_UNSET or _metrics_mod is None:
         try:
             from ..server import metrics as _m
             _metrics_mod = _m
         except Exception:
-            _metrics_mod = None
+            return None
     return _metrics_mod
 
 #: compile events kept for the trace overlay (each ~4 small fields)

@@ -86,6 +86,24 @@ def worst(statuses) -> str:
     return [OK, DEGRADED, FAILED][rank]
 
 
+def _host_id() -> str:
+    """Stable host id stamped onto every incident so multi-host records
+    join after the fact (fleet postmortems grep one id across hosts).
+    Lazy + cached: compile_cache is stdlib-only, but a broken /proc read
+    must never take the recorder down with it."""
+    global _HOST_ID
+    if _HOST_ID is None:
+        try:
+            from ..compile_cache import host_id
+            _HOST_ID = host_id()
+        except Exception:
+            _HOST_ID = "unknown"
+    return _HOST_ID
+
+
+_HOST_ID: Optional[str] = None
+
+
 class FlightRecorder:
     """Bounded ring of structured incidents (relay death, compile storm,
     ACK-stall watchdog trips…), dumped on SIGTERM so a postmortem can
@@ -100,7 +118,8 @@ class FlightRecorder:
         self.total = 0
 
     def record(self, kind: str, **fields) -> dict:
-        entry = {"ts": round(time.time(), 3), "kind": str(kind), **fields}
+        entry = {"ts": round(time.time(), 3), "kind": str(kind),
+                 "host": _host_id(), **fields}
         with self._lock:
             if len(self._ring) == self.capacity:
                 self.dropped += 1
@@ -125,13 +144,14 @@ class FlightRecorder:
 
 
 class _Check:
-    __slots__ = ("name", "fn", "liveness")
+    __slots__ = ("name", "fn", "liveness", "gate")
 
     def __init__(self, name: str, fn: Callable[[], Verdict],
-                 liveness: bool):
+                 liveness: bool, gate: bool = False):
         self.name = name
         self.fn = fn
         self.liveness = liveness
+        self.gate = gate
 
 
 class HealthEngine:
@@ -153,11 +173,19 @@ class HealthEngine:
 
     # -- registration --------------------------------------------------------
     def register(self, name: str, fn: Callable[[], Verdict],
-                 liveness: bool = False) -> None:
+                 liveness: bool = False, gate: bool = False) -> None:
         """Idempotent: re-registering a name replaces the check (service
-        restarts re-register their closures)."""
+        restarts re-register their closures).
+
+        ``gate=True`` marks a *routing gate*: evaluated only by the
+        ``?probe=ready`` readiness probe (the load-balancer surface),
+        never by the default ``/api/health`` report. The prewarm-complete
+        gate is the canonical case — a cold host must answer the LB
+        "don't route to me yet" without the operator panel reading the
+        whole process as failed while the lattice warms."""
         with self._lock:
-            self._checks[name] = _Check(str(name), fn, bool(liveness))
+            self._checks[name] = _Check(str(name), fn, bool(liveness),
+                                        bool(gate))
 
     def unregister(self, name: str, fn: Optional[Callable] = None) -> None:
         """Remove a check. Pass the registered ``fn`` to make teardown
@@ -178,16 +206,20 @@ class HealthEngine:
         self.recorder.clear()
 
     # -- evaluation ----------------------------------------------------------
-    def run(self, liveness_only: bool = False) -> dict[str, Verdict]:
+    def run(self, liveness_only: bool = False,
+            include_gates: bool = False) -> dict[str, Verdict]:
         """Evaluate every check (or only the liveness-scope ones). A
         check that raises becomes a failed verdict carrying the
         exception — never propagates. Liveness probes must evaluate
         ONLY liveness checks: running readiness closures on the
         liveness path would let a wedged readiness check time the probe
-        out and crash-loop the pod over an external fault."""
+        out and crash-loop the pod over an external fault. Gate-scope
+        checks (prewarm-complete) join only when ``include_gates`` —
+        the readiness-probe path."""
         with self._lock:
             checks = [c for c in self._checks.values()
-                      if c.liveness or not liveness_only]
+                      if (c.liveness or not liveness_only)
+                      and (include_gates or not c.gate)]
         out: dict[str, Verdict] = {}
         for c in checks:
             try:
@@ -205,11 +237,32 @@ class HealthEngine:
         with self._lock:
             return {n for n, c in self._checks.items() if c.liveness}
 
+    def gate_names(self) -> set[str]:
+        """Names of the routing-gate checks — lets a caller evaluate
+        everything ONCE (``run(include_gates=True)``) and still derive
+        both the process-health status (gates excluded) and the
+        readiness answer (gates included) from one verdict map."""
+        with self._lock:
+            return {n for n, c in self._checks.items() if c.gate}
+
     def liveness(self) -> dict:
         """The livenessProbe answer: liveness-scope checks only."""
         verdicts = self.run(liveness_only=True)
         live = worst(v.status for v in verdicts.values()) != FAILED
         return {"ok": live, "live": live,
+                "failing": sorted(n for n, v in verdicts.items()
+                                  if v.status == FAILED)}
+
+    def readiness(self) -> dict:
+        """The readinessProbe / load-balancer answer: every readiness
+        check PLUS the routing gates. A cold host (prewarm gate failed)
+        answers not-ready here while the default report stays honest
+        about the rest of the process — route-ability and process
+        health are different questions."""
+        verdicts = self.run(include_gates=True)
+        ready = worst(v.status for v in verdicts.values()) != FAILED
+        return {"ok": ready, "ready": ready,
+                "status": worst(v.status for v in verdicts.values()),
                 "failing": sorted(n for n, v in verdicts.items()
                                   if v.status == FAILED)}
 

@@ -28,6 +28,12 @@ __all__ = ["bind", "clear", "current", "SessionContextFilter",
 _session_ctx: contextvars.ContextVar[Optional[tuple]] = \
     contextvars.ContextVar("selkies_log_session", default=None)
 
+#: the stable host id (compile_cache.host_id) stamped on every record
+#: so interleaved multi-host log streams join on one key — the SAME
+#: exception-safe cached wrapper the flight recorder stamps incidents
+#: with (one definition; obs.health is dependency-free)
+from .health import _host_id  # noqa: E402
+
 
 def bind(sid, seat) -> contextvars.Token:
     """Attach the current task/thread's log records to a session."""
@@ -54,6 +60,7 @@ class SessionContextFilter(logging.Filter):
     stamped; it never rejects a record."""
 
     def filter(self, record: logging.LogRecord) -> bool:
+        record.host_id = _host_id()
         ctx = _session_ctx.get()
         if ctx is not None:
             record.session = str(ctx[0])
@@ -77,6 +84,7 @@ class JsonFormatter(logging.Formatter):
             "level": record.levelname,
             "logger": record.name,
             "msg": record.getMessage(),
+            "host": getattr(record, "host_id", "") or _host_id(),
         }
         session = getattr(record, "session", "")
         if session:

@@ -413,6 +413,60 @@ class PrewarmWorker:
         return _health.ok(
             f"lattice warm ({c['warmed']} programs)", **c)
 
+    def warm_geometries(self) -> list:
+        """Sorted ``"WxH"`` strings whose every tracked program is warm
+        (or skipped) — the fleet heartbeat's warm-host signal: the
+        scheduler scores a host up when a session's geometry appears
+        here (placing there costs no foreground compile)."""
+        by_geo: dict = {}
+        with self._lock:
+            for e in self._entries.values():
+                geo = (e["sig"].width, e["sig"].height)
+                ok_ = e["state"] in (WARM, SKIPPED)
+                by_geo[geo] = by_geo.get(geo, True) and ok_
+        return sorted(f"{w}x{h}" for (w, h), ok_ in by_geo.items()
+                      if ok_)
+
+    def current_op_ready(self):
+        """The ``prewarm_ready`` routing-gate verdict (ISSUE 11 /
+        ROADMAP 3): FAILED until every program behind the CURRENT
+        operating point is warm — the load balancer's "don't route to a
+        cold host" answer. This is deliberately a gate, not a health
+        check: a warming host is healthy, it is just not routable yet.
+
+        Fail-open cases: no tracked lattice (prewarm disabled upstream)
+        and an operating point outside the lattice (nothing will ever
+        warm it — deferring forever would blackhole the host) both
+        answer ok."""
+        from ..obs import health as _health
+        with self._lock:
+            if not self._entries:
+                return _health.ok("no lattice tracked; gate open")
+            op = self.current_op
+            if op is None:
+                return _health.failed(
+                    "no operating point recorded yet (cold boot)")
+            entries = [e for e in self._entries.values()
+                       if (e["sig"].width, e["sig"].height) == op]
+            if not entries:
+                return _health.ok(
+                    f"operating point {op[0]}x{op[1]} outside the "
+                    "lattice; gate fails open")
+            cold = [e["sig"].program_key for e in entries
+                    if e["state"] not in (WARM, SKIPPED)]
+            bad = [e["sig"].program_key for e in entries
+                   if e["state"] == FAILED]
+        if bad:
+            return _health.failed(
+                f"operating-point program(s) failed to warm: "
+                f"{', '.join(sorted(bad)[:3])}")
+        if cold:
+            return _health.failed(
+                f"warming {op[0]}x{op[1]}: {len(cold)} program(s) "
+                f"cold ({', '.join(sorted(cold)[:3])})")
+        return _health.ok(
+            f"operating point {op[0]}x{op[1]} warm")
+
     def _update_metrics(self) -> None:
         try:
             from ..server import metrics

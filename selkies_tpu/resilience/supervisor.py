@@ -43,12 +43,71 @@ from ..obs import health as _health
 
 logger = logging.getLogger("selkies_tpu.resilience.supervisor")
 
-__all__ = ["RestartPolicy", "SupervisedComponent", "Supervisor"]
+__all__ = ["RestartPolicy", "SupervisedComponent", "Supervisor",
+           "DrainHandle"]
 
 #: component states
 RUNNING = "running"
 BACKING_OFF = "backing_off"
 FAILED = "failed"
+#: terminal drain state: the component died (or its pending restart was
+#: cancelled) while the supervisor was draining — deliberately NOT
+#: restarted, counted as stopped for drain completion
+STOPPED = "stopped"
+
+
+class DrainHandle:
+    """Completion signal for :meth:`Supervisor.drain` — usable from
+    both worlds the supervisor straddles: thread-side callers ``wait()``
+    on the embedded event, asyncio callers ``await`` the handle (the
+    bridge hops through ``call_soon_threadsafe``, so completion may be
+    signalled from any thread). ``add_done_callback`` fires immediately
+    when already done."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._cbs: list = []
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def add_done_callback(self, cb: Callable[[], None]) -> None:
+        with self._lock:
+            if not self._event.is_set():
+                self._cbs.append(cb)
+                return
+        cb()
+
+    def _fire(self) -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._event.set()
+            cbs, self._cbs = self._cbs, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:
+                logger.exception("drain-done callback failed")
+
+    def __await__(self):
+        import asyncio
+        if self._event.is_set():
+            return None
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def _signal():
+            loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result(None))
+
+        self.add_done_callback(_signal)
+        return (yield from fut.__await__())
 
 
 class RestartPolicy:
@@ -170,6 +229,8 @@ class Supervisor:
         self.schedule = schedule
         self.total_restarts = 0
         self._closed = False
+        self._draining = False
+        self._drain_handle: Optional[DrainHandle] = None
 
     # -- registry ------------------------------------------------------------
     def adopt(self, name: str, restart_fn: Callable,
@@ -217,6 +278,7 @@ class Supervisor:
                         h.cancel()
                     except Exception:
                         pass
+        self._check_drained()
 
     def get(self, name: str) -> Optional[SupervisedComponent]:
         with self._lock:
@@ -239,6 +301,65 @@ class Supervisor:
                         h.cancel()
                     except Exception:
                         pass
+        self._check_drained()
+
+    # -- drain ---------------------------------------------------------------
+    def drain(self) -> DrainHandle:
+        """Stop restarting and answer WHEN everything has stopped.
+
+        From this call on, the supervisor's job inverts: a component
+        death is no longer a fault to recover but a step toward done —
+        it is marked ``stopped`` instead of rescheduled, pending backoff
+        timers are cancelled (those components already died; they count
+        as stopped now), and the returned :class:`DrainHandle` fires
+        once every supervised component is terminal (``stopped`` /
+        ``failed``) or dropped. Callers that poll component state to
+        know when a host is evacuated (the old migration shape) race
+        the restart engine; awaiting the handle cannot.
+
+        Idempotent: repeat calls return the same handle. ``drop()`` of
+        still-running components (the services' deliberate-teardown
+        path) advances the same completion check."""
+        first = False
+        with self._lock:
+            if self._drain_handle is not None:
+                handle = self._drain_handle
+                comps = []
+            else:
+                first = True
+                self._draining = True
+                handle = self._drain_handle = DrainHandle()
+                comps = list(self._components.values())
+        if first:
+            self.recorder.record("supervisor_drain",
+                                 components=len(comps))
+        for c in comps:
+            if c.state == BACKING_OFF:
+                # the component is already dead; cancelling the pending
+                # restart IS its stop
+                if c._handle is not None:
+                    try:
+                        c._handle.cancel()
+                    except Exception:
+                        pass
+                    c._handle = None
+                c.state = STOPPED
+        self._check_drained()
+        return handle
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def _check_drained(self) -> None:
+        handle = self._drain_handle
+        if handle is None or handle.done:
+            return
+        with self._lock:
+            pending = [c.name for c in self._components.values()
+                       if c.state not in (STOPPED, FAILED)]
+        if not pending:
+            handle._fire()
 
     # -- death handling ------------------------------------------------------
     def report_death(self, name: str, reason: str = "") -> None:
@@ -247,7 +368,14 @@ class Supervisor:
         if self._closed:
             return
         comp = self.get(name)
-        if comp is None or comp.state == FAILED:
+        if comp is None or comp.state in (FAILED, STOPPED):
+            return
+        if self._draining:
+            # the drain inversion: a death while draining is the
+            # component stopping, not a fault to recover
+            comp.last_error = str(reason)[:200]
+            comp.state = STOPPED
+            self._check_drained()
             return
         if comp.state == BACKING_OFF:
             return      # a restart is already pending; coalesce
@@ -294,7 +422,7 @@ class Supervisor:
         that raises (or an awaitable that fails) counts as another
         death, feeding the policy again."""
         comp = self.get(name)
-        if comp is None or self._closed:
+        if comp is None or self._closed or self._draining:
             return
         comp._handle = None
         comp.state = RUNNING

@@ -90,6 +90,12 @@ class CentralizedStreamServer:
         self.started_at = time.time()
         #: secure-mode WS tokens: token -> {role, created, uses}
         self.ws_tokens: dict[str, dict] = {}
+        #: fleet drain state (POST /api/drain): while True the
+        #: readiness probe fails (gateway routes nothing new here) and
+        #: the fleet heartbeat carries draining=true
+        self.draining = False
+        self._drain_handle = None
+        self._fleet_seq = 0
         #: the process-wide health engine; services register their
         #: checks against it in start() (tests may swap it out)
         self.health = _health.engine
@@ -163,9 +169,25 @@ class CentralizedStreamServer:
                 recorder=self.health.recorder)
             self._check_prewarm = self.prewarm.health_check
             self.health.register("prewarm", self._check_prewarm)
+            # the prewarm-complete ROUTING GATE (ISSUE 11 / ROADMAP 3):
+            # ?probe=ready answers failed until the current operating
+            # point's programs are warm, so a load balancer never
+            # routes onto a cold host. Gate-scope: the default
+            # /api/health report stays about process health — a
+            # warming host is healthy, just not routable yet.
+            self._check_prewarm_ready = self.prewarm.current_op_ready
+            self.health.register("prewarm_ready",
+                                 self._check_prewarm_ready, gate=True)
             if self.ladder is not None:
                 self.ladder.gate = PrewarmGate(self.prewarm,
                                                plan.rung_targets)
+        # drain gate: readiness fails the moment an evacuation starts,
+        # whatever else is healthy (a draining host must drop out of
+        # the gateway's feasible set before its seats start moving)
+        self._check_draining = lambda: (
+            _health.failed("host draining (evacuation in progress)")
+            if self.draining else _health.ok("not draining"))
+        self.health.register("draining", self._check_draining, gate=True)
         #: serialises switch_to_mode: two overlapping switches must not
         #: interleave stop/start and strand a service
         self._switch_lock = asyncio.Lock()
@@ -244,6 +266,8 @@ class CentralizedStreamServer:
         r.add_post("/api/faults", self.handle_faults_control)
         r.add_get("/api/resilience", self.handle_resilience)
         r.add_get("/api/prewarm", self.handle_prewarm)
+        r.add_get("/api/fleet", self.handle_fleet)
+        r.add_post("/api/drain", self.handle_drain)
         if self.settings.secure_api:
             r.add_post("/api/tokens", self.handle_mint_token)
             r.add_get("/api/tokens", self.handle_list_tokens)
@@ -334,6 +358,16 @@ class CentralizedStreamServer:
             report["mode"] = self.active_mode
             return web.json_response(
                 report, status=200 if report["live"] else 503)
+        if request.query.get("probe") == "ready":
+            # readiness + routing gates (prewarm-complete, draining):
+            # the load balancer's answer — failed until the current
+            # operating point is warm, so traffic never lands on a
+            # cold host mid-first-compile (ROADMAP 3's /api/prewarm
+            # probe, folded into the probe the LB already polls)
+            report = self.health.readiness()
+            report["mode"] = self.active_mode
+            return web.json_response(
+                report, status=200 if report["ready"] else 503)
         report = self.health.report(
             verbose=request.query.get("verbose") in ("1", "true"))
         report["mode"] = self.active_mode
@@ -475,6 +509,69 @@ class CentralizedStreamServer:
             "worker": self.prewarm.snapshot() if self.prewarm else None,
             "artifact": self._prewarm_artifact,
             "ladder": ladder,
+        })
+
+    async def handle_fleet(self, request: web.Request) -> web.Response:
+        """This engine host's fleet heartbeat document (ISSUE 11): the
+        capacity/health/SLO/warm snapshot the gateway's scheduler bins
+        on. Full-role gated — it enumerates sessions and capacity, the
+        same sensitivity as /api/sessions. A push deployment POSTs this
+        same document to the gateway's /fleet/heartbeat; a pull
+        deployment lets the gateway poll here."""
+        if request["role"] != "full":
+            return web.Response(status=403, text="view-only")
+        from ..fleet.protocol import heartbeat_from_core
+        self._fleet_seq += 1
+        s = self.settings
+        # advertise a ROUTABLE url: the bind address is 0.0.0.0 by
+        # default, which the gateway would dutifully proxy to itself
+        url = str(getattr(s, "fleet_url", "") or "")
+        if not url:
+            import socket as _socket
+            host = s.addr if s.addr not in ("0.0.0.0", "::", "") \
+                else _socket.gethostname()
+            scheme = "https" if s.enable_https else "http"
+            url = f"{scheme}://{host}:{s.port}"
+        hb = heartbeat_from_core(self, url=url, seq=self._fleet_seq)
+        doc = hb.to_dict()
+        if self._drain_handle is not None:
+            doc["drain"] = {"done": self._drain_handle.done}
+        return web.json_response(doc)
+
+    async def handle_drain(self, request: web.Request) -> web.Response:
+        """POST {"target_url": optional} — start evacuating this host:
+        readiness flips failed immediately (the gateway stops routing
+        here), connected clients get the ``migrate,{json}`` control
+        message (they reconnect through the gateway, landing on their
+        re-placed seat with an IDR resync), and the supervisor's drain
+        handle starts tracking when every supervised component has
+        actually stopped (poll /api/fleet for ``drain.done``)."""
+        if request["role"] != "full":
+            return web.Response(status=403, text="view-only")
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            return web.Response(status=400, text="JSON object body required")
+        target_url = str(body.get("target_url", ""))
+        first = not self.draining
+        self.draining = True
+        if first:
+            self.health.recorder.record(
+                "host_drain_requested", target_url=target_url)
+        self._drain_handle = self.supervisor.drain()
+        svc = self.services.get(self.active_mode or "")
+        notified = 0
+        if svc is not None and hasattr(svc, "announce_migration"):
+            try:
+                notified = await svc.announce_migration(target_url)
+            except Exception:
+                logger.exception("migration announce failed")
+        return web.json_response({
+            "draining": True,
+            "clients_notified": notified,
+            "drain_done": self._drain_handle.done,
         })
 
     async def handle_resilience(self, request: web.Request) -> web.Response:
@@ -891,8 +988,11 @@ class CentralizedStreamServer:
         self.health.unregister("qoe", self._check_qoe)
         self.health.unregister("slo", self._check_slo)
         self.health.unregister("supervision", self._check_supervision)
+        self.health.unregister("draining", self._check_draining)
         if self.prewarm is not None:
             self.health.unregister("prewarm", self._check_prewarm)
+            self.health.unregister("prewarm_ready",
+                                   self._check_prewarm_ready)
             self.prewarm.stop(join_s=2.0)
         self.supervisor.close()
         if self._ladder_task:

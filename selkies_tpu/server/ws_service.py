@@ -114,6 +114,9 @@ class ClientConnection:
         self.display = display            # the display this client views
         self.raddr = raddr
         self.gzip_ok = False
+        #: gateway-side session id (?fleet_sid=): the affinity key a
+        #: migrate command must carry; empty for direct connections
+        self.fleet_sid = ""
         self.video_active = False
         self.audio_active = False
         self.relays: dict[str, VideoRelay] = {}
@@ -935,6 +938,40 @@ class WebSocketsService(BaseStreamingService):
             if c.qoe is not None:
                 c.qoe.note_sent(chunk.frame_id, now_m)
 
+    async def announce_migration(self, target_url: str,
+                                 resync: bool = True) -> int:
+        """Fleet drain (ISSUE 11): tell every connected client to
+        reconnect elsewhere. Each client gets its OWN ``migrate,{json}``
+        (the sid rides along so the gateway's affinity map routes the
+        reconnect to the re-placed seat); captures stay warm — the
+        normal reconnect-grace machinery holds them when the clients
+        drop, so a client that bounces straight back (aborted drain)
+        still finds a frame. -> clients notified."""
+        from ..fleet.protocol import migrate_command
+
+        async def _one(c: ClientConnection) -> int:
+            try:
+                await asyncio.wait_for(
+                    c.send_text_maybe_gz(
+                        migrate_command(target_url,
+                                        c.fleet_sid or str(c.id),
+                                        resync=resync)),
+                    CONTROL_SEND_TIMEOUT_S)
+                return 1
+            except (asyncio.TimeoutError, ConnectionError,
+                    RuntimeError, OSError):
+                logger.info("migrate notify to client %d failed", c.id)
+                return 0
+
+        # concurrent like _broadcast_control: a drain of N clients with
+        # stalled sockets must cost ONE control timeout, not N of them
+        notified = sum(await asyncio.gather(
+            *(_one(c) for c in list(self.clients.values()))))
+        if notified:
+            logger.warning("fleet drain: told %d client(s) to migrate "
+                           "to %s", notified, target_url or "(gateway)")
+        return notified
+
     async def _broadcast_control(self, text: str) -> None:
         """Bounded CONCURRENT broadcast: one stalled client must never pace
         the loop or the other clients (reference bounded-send rule,
@@ -1016,6 +1053,14 @@ class WebSocketsService(BaseStreamingService):
             else:
                 display = self._default_display()
         client = ClientConnection(ws, role, raddr, display=display)
+        # fleet affinity (ISSUE 11): the gateway's WS proxy forwards the
+        # session id it placed under (?fleet_sid=); a drain's migrate
+        # command must carry THAT id — the engine-local client id means
+        # nothing to the gateway's affinity map. Bounded+sanitised: it
+        # goes back out on the wire in the migrate command.
+        fleet_sid = request.query.get("fleet_sid", "")[:128]
+        client.fleet_sid = "".join(
+            c for c in fleet_sid if c.isalnum() or c in "._:-")
         # only the first full client gets input authority unless collab
         if role == "full" and not self.settings.enable_collab:
             if any(c.role == "full" for c in self.clients.values()):

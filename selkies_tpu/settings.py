@@ -75,6 +75,11 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
        "Allow live switching between transports via /api/switch."),
     _s("addr", SType.STR, "0.0.0.0", "Bind address for the single-port server."),
     _s("port", SType.INT, 8080, "Bind port.", vmin=1, vmax=65535),
+    _s("fleet_url", SType.STR, "",
+       "Routable base URL this host advertises in fleet heartbeats "
+       "(/api/fleet). Empty: derived from addr:port, falling back to "
+       "the hostname when bound to 0.0.0.0 — set explicitly behind "
+       "NAT or when the gateway reaches hosts on another network."),
     _s("debug", SType.BOOL, False, "Verbose logging."),
     _s("app_name", SType.STR, "selkies-tpu", "Display name for the client UI."),
     _s("app_ready_file", SType.STR, "",

@@ -264,3 +264,54 @@ def test_chaos_compile_storm_transitions_stay_compile_free():
     # the background warm demonstrably ate the injected delay
     assert storm["background_compile_s"] >= storm["delay_s"]
     assert storm["prewarm"]["failed"] == 0
+
+
+def _fleet_doc() -> dict:
+    """bench --fleet is pure simulated-host math (no jax): its own
+    subprocess costs well under a second, so no caching gymnastics."""
+    if "fleet" in _cache:
+        return _cache["fleet"]
+    env = dict(os.environ, PERF_LEDGER_PATH=_LEDGER)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([sys.executable, str(ROOT / "bench.py"),
+                        "--fleet"],
+                       capture_output=True, text=True, timeout=120,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line: {lines}"
+    _cache["fleet"] = json.loads(lines[0])
+    return _cache["fleet"]
+
+
+def test_bench_fleet_contract_block():
+    """ISSUE 11 acceptance: bench --fleet (3 simulated in-process
+    hosts, seeded, injected clock) emits a ``fleet`` block proving the
+    serving-architecture contracts: bin-packing stays within per-host
+    HBM/pixel budgets, the cold host receives nothing before its
+    readiness probe passes, draining a host migrates every seat with
+    an IDR resync and zero wedged or dropped sessions, and a killed
+    host's seats re-place within the reconnect grace."""
+    doc = _fleet_doc()
+    assert doc["metric"] == "fleet_contract"
+    assert doc["value"] == 1.0
+    assert doc["backend_health"]["status"] == "ok"
+    f = doc["fleet"]
+    assert f["contract_ok"] is True
+    assert f["hosts"] == 3
+    p = f["placement"]
+    assert p["bin_pack_ok"] is True
+    assert p["cold_host_placements_before_ready"] == 0
+    assert p["placed"] == p["sessions"] and p["pending"] == 0
+    d = f["drain"]
+    assert d["dropped"] == 0 and d["wedged"] == 0
+    assert d["still_on_source"] == 0
+    assert d["migrated"] == d["seats"]
+    assert d["idr_resyncs"] >= d["migrated"]
+    assert d["drained"] is True
+    fo = f["failover"]
+    assert fo["replaced"] == fo["seats"]
+    assert fo["within_grace"] == fo["seats"]
+    # every simulated heartbeat crossed the strict wire parser
+    assert f["heartbeats"]["rejected"] == 0
+    assert f["heartbeats"]["sent"] > 0

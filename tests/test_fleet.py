@@ -1,0 +1,711 @@
+"""Fleet plane contracts (ISSUE 11): protocol hardening, bin-packing
+refusal/queueing, warm/cold scoring, evict hysteresis, drain/failover
+migration with IDR resync, the cross-host dead-relay re-offer, the
+supervisor drain awaitable, and the prewarm readiness gate — all on
+injected clocks, no sleeps."""
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from selkies_tpu.fleet.migrate import MigrationCoordinator
+from selkies_tpu.fleet.protocol import (DeviceCapacity,
+                                        FleetProtocolError, Heartbeat,
+                                        SessionSpec, estimate_hbm_mb,
+                                        migrate_command, parse_heartbeat,
+                                        parse_session_spec)
+from selkies_tpu.fleet.scheduler import SeatScheduler
+from selkies_tpu.fleet.sim import SimFleet, SimHost
+from selkies_tpu.obs.health import FlightRecorder
+from selkies_tpu.resilience.supervisor import (RestartPolicy, Supervisor)
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_rig(*, host_timeout_s=3.0, evict_confirm=3, evict_hold_s=10.0,
+             grace_s=3.0):
+    clock_box = [0.0]
+    rec = FlightRecorder()
+    sched = SeatScheduler(clock=lambda: clock_box[0], recorder=rec,
+                          host_timeout_s=host_timeout_s,
+                          evict_confirm=evict_confirm,
+                          evict_hold_s=evict_hold_s)
+    coord = MigrationCoordinator(sched, clock=lambda: clock_box[0],
+                                 recorder=rec, grace_s=grace_s)
+    fleet = SimFleet(sched, coord, clock_box=clock_box)
+    return fleet, sched, coord, rec
+
+
+def add_host(fleet, name, *, seat_slots=4, hbm_limit_mb=1000.0,
+             warm_after_s=0.0, warm_geometries=(), devices=1,
+             pixel_budget=3 * 1920 * 1080):
+    return fleet.add_host(SimHost(
+        name, clock=fleet.clock, devices=devices, seat_slots=seat_slots,
+        hbm_limit_mb=hbm_limit_mb, pixel_budget=pixel_budget,
+        warm_after_s=warm_after_s, warm_geometries=warm_geometries,
+        grace_s=3.0, recorder=fleet.scheduler.recorder))
+
+
+def incident_kinds(rec):
+    return [e["kind"] for e in rec.snapshot()]
+
+
+# ---------------------------------------------------------------- protocol
+
+def test_heartbeat_round_trips_through_wire_parser():
+    fleet, sched, coord, rec = make_rig()
+    h = add_host(fleet, "h0", warm_geometries=("640x360",))
+    fleet.tick(0.5)
+    sched.place(SessionSpec("s1", 640, 360, "jpeg"))
+    hb = h.heartbeat()
+    back = parse_heartbeat(hb.to_json())
+    assert back.host_id == "h0" and back.ready
+    assert back.devices[0].seat_slots == 4
+    assert back.sessions[0].sid == "s1"
+    assert back.warm_geometries == ["640x360"]
+
+
+@pytest.mark.parametrize("doc", [
+    "not json {",
+    [],
+    {"kind": "heartbeat"},                            # no version
+    {"v": 1, "kind": "nope", "host_id": "x"},
+    {"v": 99, "kind": "heartbeat", "host_id": "x"},   # future version
+    {"v": 1, "kind": "heartbeat", "host_id": ""},
+    {"v": 1, "kind": "heartbeat", "host_id": "x", "health": "great"},
+    {"v": 1, "kind": "heartbeat", "host_id": "x",
+     "devices": [{"hbm_limit_mb": float("nan")}]},
+    {"v": 1, "kind": "heartbeat", "host_id": "x",
+     "devices": [{"hbm_limit_mb": -5}]},
+    {"v": 1, "kind": "heartbeat", "host_id": "x",
+     "devices": "lots"},
+    {"v": 1, "kind": "heartbeat", "host_id": "x",
+     "sessions": [{"width": 640}]},                   # session no sid
+    {"v": 1, "kind": "heartbeat", "host_id": "x",
+     "warm_geometries": ["640by360"]},
+    {"v": 1, "kind": "heartbeat", "host_id": "x",
+     "slo": {"status": "ok", "fast_burn": float("inf")}},
+])
+def test_malformed_heartbeats_rejected(doc):
+    with pytest.raises(FleetProtocolError):
+        parse_heartbeat(doc)
+
+
+def test_session_spec_and_estimate():
+    spec = parse_session_spec(json.dumps(
+        {"v": 1, "kind": "place", "sid": "a", "width": 1920,
+         "height": 1080, "codec": "h264"}))
+    assert spec.budget_mb() == estimate_hbm_mb(1920, 1080, "h264")
+    # monotonic in pixels, codec state makes h264 dearer than jpeg
+    assert estimate_hbm_mb(1920, 1080) > estimate_hbm_mb(640, 360)
+    assert estimate_hbm_mb(640, 360, "h264") > \
+        estimate_hbm_mb(640, 360, "jpeg")
+    with pytest.raises(FleetProtocolError):
+        parse_session_spec({"width": 640})
+    with pytest.raises(FleetProtocolError):
+        parse_session_spec({"sid": "a", "width": 10 ** 9})
+
+
+def test_migrate_command_shape():
+    cmd = migrate_command("wss://gw.example/fleet/ws", "s7")
+    assert cmd.startswith("migrate,")
+    body = json.loads(cmd.split(",", 1)[1])
+    assert body == {"resync": True, "sid": "s7",
+                    "url": "wss://gw.example/fleet/ws"}
+
+
+# --------------------------------------------------------------- scheduler
+
+def test_hbm_refusal_queues_with_incident_not_dropped():
+    fleet, sched, coord, rec = make_rig()
+    # one host, big seat count, tiny HBM: the SECOND 1080p cannot fit
+    add_host(fleet, "h0", seat_slots=8,
+             hbm_limit_mb=1.5 * estimate_hbm_mb(1920, 1080))
+    fleet.tick(0.5)
+    p1 = sched.place(SessionSpec("s1", 1920, 1080))
+    assert p1 is not None
+    p2 = sched.place(SessionSpec("s2", 1920, 1080))
+    assert p2 is None
+    assert "placement_pending" in incident_kinds(rec)
+    assert len(sched.pending) == 1           # queued, not dropped
+    # freeing s1 retries the queue: s2 lands in the freed budget
+    sched.release("s1")
+    assert sched.get("s2") is not None
+    assert not sched.pending
+
+
+def test_pixel_budget_is_a_real_axis():
+    fleet, sched, coord, rec = make_rig()
+    # plenty of HBM and seats, pixel budget for ONE 1080p only
+    add_host(fleet, "h0", seat_slots=8, hbm_limit_mb=100000.0,
+             pixel_budget=1920 * 1080)
+    fleet.tick(0.5)
+    assert sched.place(SessionSpec("a", 1920, 1080)) is not None
+    assert sched.place(SessionSpec("b", 1280, 720)) is None
+    assert len(sched.pending) == 1
+
+
+def test_cold_host_receives_no_placements_until_ready():
+    fleet, sched, coord, rec = make_rig()
+    add_host(fleet, "cold", warm_after_s=5.0)
+    fleet.tick(1.0)
+    assert not sched.hosts["cold"].ready
+    assert sched.place(SessionSpec("s1", 640, 360)) is None
+    assert len(sched.pending) == 1
+    # readiness flips after the simulated prewarm completes; the next
+    # heartbeat retries the queue
+    fleet.tick(5.0)
+    assert sched.hosts["cold"].ready
+    p = sched.get("s1")
+    assert p is not None and p.host_id == "cold"
+
+
+def test_warm_host_preferred_over_cold_cache():
+    fleet, sched, coord, rec = make_rig()
+    add_host(fleet, "warmhost", warm_geometries=("1280x720",))
+    add_host(fleet, "coldcache")
+    fleet.tick(0.5)
+    for i in range(4):
+        p = sched.place(SessionSpec(f"s{i}", 1280, 720))
+        assert p is not None and p.host_id == "warmhost", \
+            f"s{i} landed on {p.host_id}"
+
+
+def test_evict_hysteresis_one_blip_never_moves():
+    fleet, sched, coord, rec = make_rig(evict_confirm=3,
+                                        evict_hold_s=10.0)
+    burner = add_host(fleet, "burner")
+    add_host(fleet, "calm")
+    fleet.tick(0.5)
+    p = sched.place(SessionSpec("s1", 640, 360))
+    assert p.host_id in ("burner", "calm")
+    victim_host = fleet.hosts[p.host_id]
+    # ONE burning heartbeat: no eviction
+    victim_host.slo_burning = True
+    fleet.tick(0.5)
+    assert sched.evictions() == []
+    victim_host.slo_burning = False
+    fleet.tick(0.5)      # healthy heartbeat resets the streak
+    victim_host.slo_burning = True
+    fleet.tick(0.5)
+    fleet.tick(0.5)
+    assert sched.evictions() == []           # streak 2 < confirm 3
+    fleet.tick(0.5)
+    evs = sched.evictions()
+    assert [e.sid for e in evs] == ["s1"]    # sustained burn selects
+    assert "seat_evict" not in incident_kinds(rec)  # selection is pure
+    moves = coord.rebalance()                # the MOVE records it
+    assert moves and moves[0]["moved"]
+    assert "seat_evict" in incident_kinds(rec)
+    assert sched.total_evictions == 1
+    # the move starts the hold: still burning, but no re-evict inside it
+    sched.note_migration(p.host_id)
+    fleet.tick(0.5)
+    fleet.tick(0.5)
+    fleet.tick(0.5)
+    fleet.tick(0.5)
+    assert sched.evictions() == []
+    assert burner is not None
+
+
+def test_pending_queue_is_fifo_and_incidents_dont_inflate():
+    """A big session at the head must not be rotated behind smaller
+    ones on every heartbeat retry, and retries must not re-emit
+    placement_pending per sweep."""
+    fleet, sched, coord, rec = make_rig()
+    add_host(fleet, "h0", seat_slots=8,
+             hbm_limit_mb=1.05 * estimate_hbm_mb(1920, 1080))
+    fleet.tick(0.5)
+    assert sched.place(SessionSpec("big0", 1920, 1080)) is not None
+    assert sched.place(SessionSpec("big1", 1920, 1080)) is None
+    assert sched.place(SessionSpec("small", 640, 360)) is None
+    assert [s.sid for s, _ in sched.pending] == ["big1", "small"]
+    before = incident_kinds(rec).count("placement_pending")
+    for _ in range(5):
+        fleet.tick(0.5)       # retries with no new capacity
+    assert [s.sid for s, _ in sched.pending] == ["big1", "small"]
+    assert incident_kinds(rec).count("placement_pending") == before
+    # capacity frees (host teardown lands on the next heartbeat): the
+    # HEAD places first even though 'small' would fit too
+    sched.release("big0")
+    fleet.tick(0.5)
+    assert sched.get("big1") is not None
+
+
+def test_evict_with_no_feasible_target_stays_put_untouched():
+    fleet, sched, coord, rec = make_rig(evict_confirm=2)
+    only = add_host(fleet, "only")
+    fleet.tick(0.5)
+    sched.place(SessionSpec("s1", 640, 360))
+    resyncs = only.idr_resyncs
+    only.slo_burning = True
+    fleet.tick(0.5)
+    fleet.tick(0.5)
+    moves = coord.rebalance()
+    assert moves and not moves[0]["moved"] and not moves[0]["queued"]
+    assert moves[0]["to"] == "only"          # stayed
+    p = sched.get("s1")
+    assert p is not None and p.host_id == "only"
+    # no release/re-accept cycle: no gratuitous IDR storm
+    assert only.idr_resyncs == resyncs
+
+
+def test_drained_host_rejoins_after_restart():
+    fleet, sched, coord, rec = make_rig()
+    h = add_host(fleet, "h0")
+    fleet.tick(0.5)
+    fleet.tick(0.5)      # seq advances past the fresh process's first
+    sched.mark_draining("h0")
+    assert sched.place(SessionSpec("s1", 640, 360)) is None
+    # the host process restarts: fresh supervisor, seq counter resets
+    fleet.hosts["h0"] = SimHost("h0", clock=fleet.clock, devices=1,
+                                seat_slots=4, hbm_limit_mb=1000.0,
+                                warm_after_s=0.0, grace_s=3.0,
+                                recorder=rec)
+    coord.register_host("h0", fleet.hosts["h0"])
+    fleet.tick(0.5)
+    assert not sched.hosts["h0"].draining
+    assert sched.get("s1") is not None       # queued session lands
+    assert h is not None
+
+
+def test_rebalance_moves_burning_hosts_session():
+    fleet, sched, coord, rec = make_rig(evict_confirm=2)
+    a = add_host(fleet, "a", warm_geometries=("640x360",))
+    add_host(fleet, "b")
+    fleet.tick(0.5)
+    p = sched.place(SessionSpec("s1", 640, 360))
+    assert p.host_id == "a"                  # warm bonus
+    a.slo_burning = True
+    fleet.tick(0.5)
+    fleet.tick(0.5)
+    moves = coord.rebalance()
+    assert len(moves) == 1 and moves[0]["moved"]
+    assert sched.get("s1").host_id == "b"
+    assert fleet.hosts["b"].idr_resyncs >= 1
+
+
+def test_host_expiry_marks_lost():
+    fleet, sched, coord, rec = make_rig(host_timeout_s=2.0)
+    h = add_host(fleet, "h0")
+    fleet.tick(0.5)
+    h.kill()
+    fleet.tick(3.0)
+    assert sched.hosts["h0"].lost
+    assert "host_lost" in incident_kinds(rec)
+
+
+# --------------------------------------------------------------- migration
+
+def test_drain_migrates_every_seat_with_idr_resync():
+    fleet, sched, coord, rec = make_rig()
+    src = add_host(fleet, "src")
+    dst = add_host(fleet, "dst")
+    fleet.tick(0.5)
+    for i in range(3):
+        sched.place(SessionSpec(f"s{i}", 640, 360))
+    on_src = sched.placements_on("src")
+    report = coord.evacuate("src")
+    assert report["seats"] == len(on_src)
+    assert report["migrated"] == len(on_src)
+    assert report["dropped"] == 0 and report["queued"] == 0
+    assert report["drained"] is True         # supervisor drain awaited
+    assert not sched.placements_on("src")
+    assert dst.idr_resyncs >= len(on_src)    # every handoff resynced
+    # source kept the handed-off captures warm through the grace
+    assert not src.teardowns_seen
+    # a drained host takes no NEW placements
+    fleet.tick(0.5)
+    p = sched.place(SessionSpec("late", 640, 360))
+    assert p is not None and p.host_id == "dst"
+
+
+def test_drain_with_no_capacity_queues_never_drops():
+    fleet, sched, coord, rec = make_rig()
+    add_host(fleet, "solo", seat_slots=2)
+    fleet.tick(0.5)
+    sched.place(SessionSpec("s1", 640, 360))
+    sched.place(SessionSpec("s2", 640, 360))
+    report = coord.evacuate("solo")
+    assert report["migrated"] == 0
+    assert report["queued"] == 2 and report["dropped"] == 0
+    assert len(sched.pending) == 2
+    # a fresh host appears: the queue lands on its first heartbeat
+    add_host(fleet, "rescue")
+    fleet.tick(0.5)
+    assert not sched.pending
+    assert {p.host_id for p in sched.placements.values()} == {"rescue"}
+
+
+def test_failover_replaces_within_reconnect_grace():
+    fleet, sched, coord, rec = make_rig(host_timeout_s=2.0,
+                                        grace_s=3.0)
+    doomed = add_host(fleet, "doomed")
+    add_host(fleet, "survivor")
+    fleet.tick(0.5)
+    sids = [f"s{i}" for i in range(3)]
+    for sid in sids:
+        sched.place(SessionSpec(sid, 640, 360))
+    on_doomed = [p.sid for p in sched.placements_on("doomed")]
+    doomed.kill()
+    # heartbeat silence passes the timeout inside the grace window
+    fleet.tick(2.5)
+    for sid in on_doomed:
+        p = sched.get(sid)
+        assert p is not None and p.host_id == "survivor"
+    fo = [e for e in rec.snapshot() if e["kind"] == "host_failover"]
+    assert fo and fo[0]["replaced"] == len(on_doomed)
+    assert fo[0]["within_grace"] == len(on_doomed)
+
+
+def test_cross_host_dead_relay_reoffer_round_trip():
+    """The PR-5 dead-relay re-offer made fleet-wide: local supervision
+    exhausts its restart budget against a persistently-dead relay, the
+    give-up hook escalates to the coordinator, and the seat re-offers
+    on ANOTHER host with an IDR resync."""
+    fleet, sched, coord, rec = make_rig()
+    a = add_host(fleet, "a", warm_geometries=("640x360",))
+    b = add_host(fleet, "b")
+    fleet.tick(0.5)
+    p = sched.place(SessionSpec("s1", 640, 360))
+    assert p.host_id == "a"
+    a.kill_relay("s1", unrecoverable=True)
+    # pump the injected clock until the local budget parks the relay
+    # and the fleet re-offer lands (policy: base 0.1 s, 2 restarts)
+    ok = fleet.run_until(
+        lambda: sched.get("s1") is not None
+        and sched.get("s1").host_id == "b", dt=0.5, budget_s=30.0)
+    assert ok, "seat never re-offered cross-host"
+    assert b.idr_resyncs >= 1
+    kinds = incident_kinds(rec)
+    assert "relay_reoffer_cross_host" in kinds
+    assert "crash_loop" in kinds             # the local park is visible
+    assert "s1" in b.sessions and "s1" not in a.sessions
+
+
+# ------------------------------------------------------- supervisor drain
+
+def test_supervisor_drain_completes_when_components_drop():
+    clock = Clock()
+    sched_seam = []
+    sup = Supervisor(recorder=FlightRecorder(),
+                     policy_factory=lambda: RestartPolicy(clock=clock),
+                     schedule=lambda d, cb: sched_seam.append((d, cb))
+                     or _Handle(sched_seam))
+    sup.adopt("a", lambda: None)
+    sup.adopt("b", lambda: None)
+    handle = sup.drain()
+    assert not handle.done
+    sup.drop("a")
+    assert not handle.done
+    sup.drop("b")
+    assert handle.done and handle.wait(0)
+    # idempotent: same handle back
+    assert sup.drain() is handle
+
+
+class _Handle:
+    def __init__(self, seam):
+        self._seam = seam
+
+    def cancel(self):
+        pass
+
+
+def test_supervisor_drain_stops_restarting_and_counts_deaths():
+    clock = Clock()
+    pending = []
+
+    class H:
+        def __init__(self, entry):
+            self.entry = entry
+
+        def cancel(self):
+            if self.entry in pending:
+                pending.remove(self.entry)
+
+    def schedule(delay, cb):
+        entry = (delay, cb)
+        pending.append(entry)
+        return H(entry)
+
+    sup = Supervisor(recorder=FlightRecorder(),
+                     policy_factory=lambda: RestartPolicy(clock=clock),
+                     schedule=schedule)
+    sup.adopt("backing", lambda: None)
+    sup.adopt("running", lambda: None)
+    sup.report_death("backing", "died pre-drain")
+    assert len(pending) == 1                 # restart scheduled
+    handle = sup.drain()
+    # the pending restart was cancelled and the dead component counts
+    # as stopped; only 'running' holds the drain open
+    assert not pending
+    assert not handle.done
+    sup.report_death("running", "died during drain")
+    assert handle.done
+    # a death during drain never schedules a restart
+    assert not pending
+    assert sup.get("running").state == "stopped"
+
+
+async def test_supervisor_drain_handle_is_awaitable():
+    sup = Supervisor(recorder=FlightRecorder(),
+                     schedule=lambda d, cb: _Handle(None))
+    sup.adopt("x", lambda: None)
+    handle = sup.drain()
+
+    async def _finish():
+        await asyncio.sleep(0)
+        # completion signalled from another thread, like a capture join
+        t = threading.Thread(target=lambda: sup.drop("x"))
+        t.start()
+        t.join()
+
+    waiter = asyncio.ensure_future(asyncio.wait_for(_await(handle), 5.0))
+    await _finish()
+    await waiter
+    assert handle.done
+
+
+async def _await(handle):
+    await handle
+
+
+# ----------------------------------------------------- prewarm ready gate
+
+def test_worker_current_op_ready_lifecycle():
+    import types
+
+    from selkies_tpu.prewarm.lattice import lattice_from_settings
+    from selkies_tpu.prewarm.worker import PrewarmWorker
+    plan = lattice_from_settings(types.SimpleNamespace(
+        encoder="jpeg-tpu", initial_width=640, initial_height=360,
+        tpu_seats=1, fullcolor=False, stripe_height=64,
+        use_damage_gating=True, use_paint_over=False))
+    w = PrewarmWorker(plan, compiler=lambda sig: {"programs": []})
+    # cold boot: no operating point recorded yet -> failed
+    assert w.current_op_ready().status == "failed"
+    w.note_operating_point(640, 360)
+    v = w.current_op_ready()
+    assert v.status == "failed" and "cold" in v.reason
+    assert "640x360" not in w.warm_geometries()
+    w.run_pending_sync()
+    assert w.current_op_ready().status == "ok"
+    assert "640x360" in w.warm_geometries()
+    # an operating point outside the lattice fails OPEN
+    w.note_operating_point(123, 77)
+    assert w.current_op_ready().status == "ok"
+
+
+def test_empty_worker_gate_opens():
+    from selkies_tpu.prewarm.worker import PrewarmWorker
+    w = PrewarmWorker()
+    assert w.current_op_ready().status == "ok"
+
+
+# ------------------------------------------------------------ sim heartbeat
+
+def test_sim_heartbeats_flow_through_strict_parse():
+    fleet, sched, coord, rec = make_rig()
+    add_host(fleet, "h0")
+    add_host(fleet, "h1", warm_after_s=1.0)
+    for _ in range(5):
+        fleet.tick(0.5)
+    assert fleet.heartbeats_rejected == 0
+    assert fleet.heartbeats_sent >= 9
+    assert set(sched.hosts) == {"h0", "h1"}
+
+
+def test_incidents_carry_host_id():
+    rec = FlightRecorder()
+    e = rec.record("test_kind", detail=1)
+    assert isinstance(e["host"], str) and e["host"]
+    from selkies_tpu.compile_cache import host_id
+    assert e["host"] == host_id()
+
+
+# ------------------------------------------------- server contract (HTTP)
+
+def _make_server(**fields):
+    from test_server import make_app
+    return make_app(**fields)
+
+
+async def test_probe_ready_gates_on_prewarm(client_factory):
+    """ISSUE 11 satellite + acceptance: ?probe=ready answers failed
+    until the prewarm worker warmed the CURRENT operating point — a
+    load balancer never routes onto a cold host — while the default
+    /api/health report stays about process health."""
+    server, svc, fake, _ = _make_server()
+    c = await client_factory(server)
+    # default health: fine (the gate is probe-scope only)
+    r = await c.get("/api/health")
+    assert r.status == 200 and (await r.json())["ok"] is True
+    # readiness probe: cold boot -> failed (no op recorded yet)
+    r = await c.get("/api/health?probe=ready")
+    body = await r.json()
+    assert r.status == 503 and body["ready"] is False
+    assert "prewarm_ready" in body["failing"]
+    # operating point known but still cold -> still failed
+    server.prewarm.note_operating_point(
+        server.settings.initial_width, server.settings.initial_height)
+    r = await c.get("/api/health?probe=ready")
+    assert r.status == 503
+    # warm the lattice (fake compiler, synchronously) -> ready
+    server.prewarm.compiler = lambda sig: {"programs": []}
+    server.prewarm.run_pending_sync()
+    r = await c.get("/api/health?probe=ready")
+    body = await r.json()
+    assert r.status == 200 and body["ready"] is True
+    # liveness never saw the gate
+    r = await c.get("/api/health?probe=live")
+    assert r.status == 200
+
+
+async def test_probe_ready_without_prewarm_passes(client_factory):
+    server, svc, fake, _ = _make_server(enable_prewarm=False)
+    c = await client_factory(server)
+    r = await c.get("/api/health?probe=ready")
+    assert r.status == 200 and (await r.json())["ready"] is True
+
+
+async def test_api_fleet_emits_parseable_heartbeat(client_factory):
+    server, svc, fake, _ = _make_server()
+    c = await client_factory(server)
+    r = await c.get("/api/fleet")
+    assert r.status == 200
+    doc = await r.json()
+    hb = parse_heartbeat(doc)          # the REAL wire parser
+    assert hb.ready is False           # cold host (prewarm not run)
+    assert hb.draining is False
+    assert hb.fingerprint
+    # warming flips the heartbeat's ready bit too
+    server.prewarm.note_operating_point(
+        server.settings.initial_width, server.settings.initial_height)
+    server.prewarm.compiler = lambda sig: {"programs": []}
+    server.prewarm.run_pending_sync()
+    hb2 = parse_heartbeat(await (await c.get("/api/fleet")).json())
+    assert hb2.ready is True
+    assert hb2.seq > hb.seq
+    geo = f"{server.settings.initial_width}" \
+          f"x{server.settings.initial_height}"
+    assert geo in hb2.warm_geometries
+
+
+async def test_drain_flips_readiness_and_notifies_clients(client_factory):
+    server, svc, fake, _ = _make_server()
+    c = await client_factory(server)
+    # a connected viewer that must hear about the migration
+    ws = await c.ws_connect("/api/websockets")
+    assert (await ws.receive_str()) == "MODE websockets"
+    r = await c.post("/api/drain",
+                     json={"target_url": "wss://gw.example/fleet/ws"})
+    body = await r.json()
+    assert r.status == 200 and body["draining"] is True
+    assert body["clients_notified"] == 1
+    # readiness fails immediately; liveness and default health hold
+    r = await c.get("/api/health?probe=ready")
+    assert r.status == 503
+    assert "draining" in (await r.json())["failing"]
+    assert (await c.get("/api/health?probe=live")).status == 200
+    # the client got its personal migrate command
+    saw = None
+    for _ in range(8):
+        msg = await asyncio.wait_for(ws.receive_str(), 5.0)
+        if msg.startswith("migrate,"):
+            saw = json.loads(msg.split(",", 1)[1])
+            break
+    assert saw is not None
+    assert saw["url"] == "wss://gw.example/fleet/ws"
+    assert saw["resync"] is True
+    # heartbeat now says draining (gateway-side: drops from feasible)
+    hb = parse_heartbeat(await (await c.get("/api/fleet")).json())
+    assert hb.draining is True and hb.ready is False
+    await ws.close()
+
+
+# ---------------------------------------------------- gateway contract
+
+async def _gateway_client(gw):
+    from aiohttp.test_utils import TestClient, TestServer
+    client = TestClient(TestServer(gw.make_app()))
+    await client.start_server()
+    return client
+
+
+async def test_gateway_cold_host_gets_no_placements():
+    """Acceptance: a cold host behind the gateway receives no
+    placements until its readiness probe passes."""
+    from selkies_tpu.fleet.gateway import FleetGateway
+    clock = Clock()
+    gw = FleetGateway(clock=clock, sweep_interval_s=3600.0)
+    c = await _gateway_client(gw)
+    try:
+        cold = Heartbeat(host_id="cold-1", url="http://cold:8080",
+                         ready=False)
+        cold.devices.append(DeviceCapacity(
+            id=0, hbm_limit_mb=8192.0, seat_slots=4))
+        r = await c.post("/fleet/heartbeat", data=cold.to_json())
+        assert r.status == 200
+        r = await c.post("/fleet/place", json={
+            "v": 1, "kind": "place", "sid": "s1",
+            "width": 640, "height": 360})
+        assert r.status == 202            # queued: only host is cold
+        assert (await r.json())["queued"] is True
+        # readiness passes -> the queued session lands on it
+        cold.ready = True
+        cold.seq = 2
+        r = await c.post("/fleet/heartbeat", data=cold.to_json())
+        assert r.status == 200
+        r = await c.get("/fleet/route/s1")
+        assert r.status == 200
+        body = await r.json()
+        assert body["host_id"] == "cold-1"
+        assert body["url"] == "http://cold:8080"
+    finally:
+        await c.close()
+
+
+async def test_gateway_auth_and_malformed_heartbeat():
+    from selkies_tpu.fleet.gateway import FleetGateway
+    gw = FleetGateway(token="sekrit", sweep_interval_s=3600.0)
+    c = await _gateway_client(gw)
+    try:
+        r = await c.post("/fleet/heartbeat", data="{}")
+        assert r.status == 401            # no token
+        hdr = {"Authorization": "Bearer sekrit"}
+        r = await c.post("/fleet/heartbeat", data="not json {",
+                         headers=hdr)
+        assert r.status == 400
+        assert gw.heartbeats_rejected == 1
+        r = await c.get("/fleet/hosts", headers=hdr)
+        assert r.status == 200
+        assert (await r.json())["hosts"] == {}
+        assert (await c.get("/fleet/hosts",
+                            headers={"Authorization": "Bearer nope"})
+                ).status == 401
+    finally:
+        await c.close()
+
+
+# ------------------------------------------------------------ perf ledger
+
+def test_perf_ledger_entries_carry_host_id():
+    import sys as _sys
+    _sys.path.insert(0, str(__import__("pathlib").Path(
+        __file__).resolve().parent.parent))
+    from tools.perf_ledger import entry_from_bench
+
+    from selkies_tpu.compile_cache import host_id
+    e = entry_from_bench({"metric": "encode_fps_640x360_jpeg_tpu",
+                          "value": 1.0,
+                          "backend_health": {"status": "ok"}})
+    assert e["host_id"] == host_id()

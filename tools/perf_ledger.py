@@ -47,7 +47,7 @@ from typing import Optional
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-from selkies_tpu.compile_cache import host_fingerprint  # noqa: E402
+from selkies_tpu.compile_cache import host_fingerprint, host_id  # noqa: E402
 
 #: default append-only ledger, committed so the trajectory survives
 #: across rounds/sessions (PERF.md points here)
@@ -108,6 +108,10 @@ def entry_from_bench(doc: dict, *, git_rev: Optional[str] = None,
             timespec="seconds"),
         "git_rev": git_rev or _git_rev(),
         "host": host or host_fingerprint(),
+        # stable per-machine id (fingerprint is shared across identical
+        # fleet hosts by design); joins ledger rows with flight-recorder
+        # incidents and structured logs after the fact
+        "host_id": host_id(),
         "metric": metric,
         "backend": doc.get("backend", "unknown"),
         "backend_class": backend_class(doc.get("backend", "unknown")),
