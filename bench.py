@@ -650,6 +650,160 @@ def main(force_cpu: bool = False) -> None:
     ledger_append(doc)
 
 
+def stripes_main(force_cpu: bool) -> None:
+    """``--stripes``: split-frame device parallelism acceptance
+    (ROADMAP 2 / ISSUE 12). One session's frame is sharded across the
+    stripe mesh and proven two ways, per shard count (default 1, 2, 4):
+
+    - **byte identity**: every chunk the sharded session emits — IDR and
+      P, damage-gated, streamed — equals the unsharded session's on the
+      same frames (sharding is a distribution axis, never a value
+      change);
+    - **scaling**: per-frame encode device-time (the named, PR-6-wrapped
+      step, measured dispatch→ready) decreases monotonically with the
+      shard count. On CPU the mesh comes from
+      ``--xla_force_host_platform_device_count`` (the same trick
+      tests/test_parallel.py uses; the dispatch block self-arms it).
+
+    The JSON line carries a ``stripes`` block plus the top-level
+    ``stripe_devices`` column the perf ledger records — the CHOSEN
+    (post-degradation) count, so a degraded mesh can't masquerade as a
+    scaling result. Exits 1 on any identity or monotonicity break.
+
+    Knobs: BENCH_STRIPES_WIDTH/HEIGHT (256), BENCH_STRIPES_STRIPE_H
+    (32), BENCH_STRIPES_COUNTS ("1,2,4"), BENCH_STRIPES_FRAMES (4),
+    BENCH_STRIPES_REPS (3), BENCH_STRIPES_8K=1 for the 8K-geometry
+    synthetic capture stretch workload (7680x4320 — the 'Sustainable
+    8K60' paper's shape; no single-chip budget reaches it)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    if force_cpu:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    from selkies_tpu.compile_cache import enable as enable_compile_cache
+    enable_compile_cache(jax)
+    from selkies_tpu.obs import monitor as _devmon
+    _devmon.attach_jax(jax)
+    from selkies_tpu.engine.h264_encoder import (H264EncoderSession,
+                                                 StripeShardedH264Session)
+    from selkies_tpu.engine.types import CaptureSettings
+
+    backend = jax.default_backend()
+    backend_label = backend
+    if backend == "cpu" and os.environ.get("BENCH_CPU_REASON"):
+        backend_label = "cpu-fallback-" + os.environ["BENCH_CPU_REASON"]
+    if os.environ.get("BENCH_STRIPES_8K") == "1":
+        w, h, stripe_h = 7680, 4320, 540     # grid planner MB-aligns
+    else:
+        w = int(os.environ.get("BENCH_STRIPES_WIDTH", "256"))
+        h = int(os.environ.get("BENCH_STRIPES_HEIGHT", "256"))
+        stripe_h = int(os.environ.get("BENCH_STRIPES_STRIPE_H", "32"))
+    counts = [int(c) for c in os.environ.get(
+        "BENCH_STRIPES_COUNTS", "1,2,4").split(",") if c.strip()]
+    n_frames = max(2, int(os.environ.get("BENCH_STRIPES_FRAMES", "4")))
+    reps = max(1, int(os.environ.get("BENCH_STRIPES_REPS", "3")))
+    n_dev = len(jax.devices())
+    log(f"stripes: backend={backend} devices={n_dev} "
+        f"geometry={w}x{h}/{stripe_h} counts={counts}")
+
+    kw = dict(capture_width=w, capture_height=h, stripe_height=stripe_h,
+              output_mode="h264", video_crf=28, use_paint_over=False,
+              h264_motion_vrange=8, h264_motion_hrange=2)
+    rng = np.random.default_rng(int(os.environ.get("BENCH_STRIPES_SEED",
+                                                   "5")))
+    f0 = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+    frames = [jnp.asarray(np.roll(f0, 7 * t, axis=0))
+              for t in range(2 + n_frames)]
+
+    def chunk_keys(sess, fs):
+        out = []
+        for t, f in enumerate(fs):
+            chunks = sess.finalize(sess.encode(f, force=(t == 0)))
+            out.append([(c.stripe_y, c.is_idr, c.payload)
+                        for c in chunks])
+        return out
+
+    ref = H264EncoderSession(CaptureSettings(**kw))
+    ref_keys = chunk_keys(ref, frames)
+
+    results = []
+    all_identical = True
+    for want in counts:
+        if want <= 1:
+            sess = H264EncoderSession(CaptureSettings(**kw))
+            chosen = 1
+        else:
+            sess = StripeShardedH264Session(
+                CaptureSettings(**kw, stripe_devices=want))
+            chosen = sess.stripe_devices
+        identical = chunk_keys(sess, frames) == ref_keys
+        all_identical = all_identical and identical
+        # timed P frames (the steady-state path), min-of-reps mean,
+        # dispatch -> ready on the full output surface
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for f in frames[2:]:
+                out = sess.encode(f)
+                jax.block_until_ready((out["data"], out["lens"]))
+            times.append((time.perf_counter() - t0) / len(frames[2:]))
+        ms = round(min(times) * 1e3, 3)
+        results.append({"requested": want, "devices": chosen,
+                        "encode_ms": ms,
+                        "fps_equiv": round(1e3 / ms, 2) if ms else None,
+                        "byte_identical": identical})
+        log(f"stripes x{chosen} (requested {want}): {ms} ms/frame "
+            f"identical={identical}")
+
+    ms_by_count = [r["encode_ms"] for r in results]
+    monotonic = all(b < a for a, b in zip(ms_by_count, ms_by_count[1:]))
+    speedup = round(ms_by_count[0] / ms_by_count[-1], 3) \
+        if ms_by_count[-1] else 0.0
+
+    # PR-6 static attribution for the named sharded steps (flops / HBM
+    # bytes / roofline) — the lever-ranking view that works relay-down
+    from selkies_tpu.obs import perf as _perf
+    perf_steps = [
+        {k: s.get(k) for k in ("name", "flops", "bytes_accessed",
+                               "roofline_ms")}
+        for s in _perf.registry.report()["steps"]
+        if not s.get("error") and "h264" in s.get("name", "")]
+
+    _devmon.sample(force=True)
+    _devmon.platform = backend
+    verdict = _devmon.backend_verdict()
+    ok = all_identical and monotonic
+    doc = {
+        "metric": f"stripe_scaling_{w}x{h}_h264",
+        "value": speedup,
+        "unit": "speedup",
+        "vs_baseline": speedup,
+        "backend": backend_label,
+        "backend_health": {"status": verdict.status,
+                           "reason": verdict.reason},
+        "stripe_devices": results[-1]["devices"],
+        "stripes": {
+            "geometry": f"{w}x{h}/{stripe_h}",
+            "counts": results,
+            "byte_identical": all_identical,
+            "monotonic": monotonic,
+            "speedup": speedup,
+            "perf_steps": perf_steps,
+        },
+        "frames": n_frames,
+    }
+    print(json.dumps(doc))
+    ledger_append(doc)
+    if not ok:
+        log(f"stripes: CONTRACT BREAK identical={all_identical} "
+            f"monotonic={monotonic}")
+        sys.exit(1)
+
+
 async def _chaos_run(target_fps: float, w: int, h: int) -> dict:
     """The supervised loopback pipeline under a seeded fault script.
     Returns the ``chaos`` result block (recovery proof + forensics)."""
@@ -1191,6 +1345,43 @@ def chaos_main(force_cpu: bool = False) -> None:
 
 
 if __name__ == "__main__":
+    if "--stripes" in sys.argv[1:]:
+        _force_cpu = probe_backend()
+        if (_force_cpu or os.environ.get("JAX_PLATFORMS") == "cpu") and \
+                "xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            # the CPU mesh needs forced host devices BEFORE jax inits:
+            # re-exec with the flag armed (the same trick the test
+            # suite's conftest uses)
+            _counts = [int(c) for c in os.environ.get(
+                "BENCH_STRIPES_COUNTS", "1,2,4").split(",") if c.strip()]
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count="
+                  f"{max(_counts)}").strip()
+            os.execv(sys.executable, [sys.executable,
+                                      os.path.abspath(__file__),
+                                      *sys.argv[1:]])
+        try:
+            stripes_main(_force_cpu)
+        except SystemExit:
+            raise
+        except BaseException as e:   # noqa: BLE001 — JSON line contract
+            if isinstance(e, KeyboardInterrupt):
+                raise
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "stripe_scaling_unavailable", "value": 0.0,
+                "unit": "speedup", "vs_baseline": 0.0,
+                "backend": "none",
+                "backend_health": {
+                    "status": "failed",
+                    "reason": f"{type(e).__name__}: {e}"[:200]},
+                "error": f"{type(e).__name__}: {e}"[:300],
+            }))
+            sys.exit(1)
+        sys.exit(0)
     if "--fleet" in sys.argv[1:]:
         # fleet mode never touches jax (simulated hosts, injected
         # clock) — no backend probe, no CPU fallback dance

@@ -123,8 +123,14 @@ class ScreenCapture:
             self._callback = callback
             self._settings = settings
             if settings.output_mode == "h264":
-                from .h264_encoder import H264EncoderSession
-                self._session = H264EncoderSession(settings)
+                if int(getattr(settings, "stripe_devices", 1)) > 1:
+                    # split-frame device parallelism (ROADMAP 2): one
+                    # frame's stripes sharded across the mesh
+                    from .h264_encoder import StripeShardedH264Session
+                    self._session = StripeShardedH264Session(settings)
+                else:
+                    from .h264_encoder import H264EncoderSession
+                    self._session = H264EncoderSession(settings)
             else:
                 self._session = JpegEncoderSession(settings)
             # per-frame CBR state: empty bucket, base = the session's crf

@@ -392,15 +392,15 @@ class H264EncoderSession:
         overflowed, idle, lens, send, intra = self._sync_control(out)
         data = None
         if not overflowed and not idle:
-            starts = np.concatenate([[0], np.cumsum(lens)])
+            starts = self._row_starts(out, lens)
             rps = g.rows_per_stripe
             # minimal readback (engine/readback.py): fetch through
             # the last DELIVERED stripe's rows — capacity padding
             # and trailing unsent stripes never cross the host link
             from .readback import fetch_stream_bytes
-            last_row = (int(np.nonzero(send)[0][-1]) + 1) * rps
-            data = fetch_stream_bytes(out["data"],
-                                      int(starts[last_row]))
+            last_row = (int(np.nonzero(send)[0][-1]) + 1) * rps - 1
+            data = fetch_stream_bytes(
+                out["data"], int(starts[last_row] + lens[last_row]))
         _tracer.record_span(tl, "encode.readback", rb_t0, lane=lane)
         if overflowed:
             self._handle_overflow(out)
@@ -437,7 +437,7 @@ class H264EncoderSession:
         if idle:
             return
         from .readback import fetch_stripe_bytes
-        starts = np.concatenate([[0], np.cumsum(lens)])
+        starts = self._row_starts(out, lens)
         rps = g.rows_per_stripe
         for i in range(g.n_stripes):
             if not send[i]:
@@ -446,13 +446,22 @@ class H264EncoderSession:
             with _tracer.span("encode.readback", tl, lane=lane):
                 raw = fetch_stripe_bytes(
                     out["data"], int(starts[r0]),
-                    int(starts[r1] - starts[r0]))
+                    int(starts[r1 - 1] + lens[r1 - 1] - starts[r0]))
             with _tracer.span("packetize", tl, lane=lane):
                 base = int(starts[r0])
-                rows = [bytes(raw[starts[r] - base:starts[r + 1] - base])
+                rows = [bytes(raw[starts[r] - base:
+                                  starts[r] - base + lens[r]])
                         for r in range(r0, r1)]
                 chunk = self._chunk(out, i, rows, intra)
             yield chunk
+
+    def _row_starts(self, out: dict[str, Any], lens: np.ndarray
+                    ) -> np.ndarray:
+        """Absolute byte offset of each MB row inside ``out['data']``.
+        Single-device sessions pack rows contiguously; the stripe-sharded
+        session overrides this with per-shard byte regions."""
+        del out
+        return np.concatenate([[0], np.cumsum(lens[:-1])]).astype(np.int64)
 
     def _sync_control(self, out: dict[str, Any]):
         """Control-array sync shared by finalize and finalize_stream —
@@ -491,3 +500,144 @@ class H264EncoderSession:
             self._cap_gen += 1
         with self._drop_lock:
             self._force_after_drop = True
+
+
+# ---------------------------------------------------------------------------
+# split-frame device parallelism (ROADMAP 2): one session's frame sharded
+# across the mesh
+# ---------------------------------------------------------------------------
+
+# bounded LRU like _jitted_h264_step: stripe-device retargeting mints
+# fresh keys; the pre-warm planner shares this factory cache
+@functools.lru_cache(maxsize=32)
+def _jitted_h264_sharded_step(mode: str, width: int, stripe_h: int,
+                              n_stripes: int, e_cap: int, w_cap: int,
+                              out_cap_local: int, paint_delay: int,
+                              damage_gating: bool, paint_over: bool,
+                              candidates: tuple = ((0, 0),),
+                              fullcolor: bool = False, n_dev: int = 1,
+                              device_ids: tuple = ()):
+    """The single-seat step, shard_mapped over WHOLE stripes: each device
+    runs the full damage-gated adaptive I/P step on its own band of
+    ``n_stripes // n_dev`` stripes — per-stripe state, per-row slices,
+    per-shard byte buffer. Stripes are independent streams and motion
+    windows are stripe-bounded, so the compiled per-shard program is
+    collective-free; the only cross-device structure is the stacked
+    output layout the session's ``_row_starts`` understands."""
+    import numpy as _np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    if n_stripes % n_dev:
+        raise ValueError(
+            f"{n_dev} stripe devices do not divide {n_stripes} stripes")
+    local = build_h264_step_fn(
+        mode, width, stripe_h, n_stripes // n_dev, e_cap, w_cap,
+        out_cap_local, paint_delay, damage_gating, paint_over,
+        candidates, fullcolor=fullcolor)
+    # device_ids pins the mesh to the CALLER's device subset (part of
+    # the cache key: sessions carved onto disjoint subsets must never
+    # share a compiled step bound to devices 0..n-1)
+    if device_ids:
+        by_id = {d.id: d for d in jax.devices()}
+        devs = [by_id[i] for i in device_ids]
+    else:
+        devs = jax.devices()[:n_dev]
+    mesh = Mesh(_np.array(devs), ("stripe",))
+
+    def local_wrapped(*args):
+        outs = local(*args)
+        return outs[:11] + (outs[11][None],)   # overflow gains a mesh dim
+
+    s1 = P("stripe")
+    p2 = P("stripe", None)
+    p3 = P("stripe", None, None)
+    sharded = shard_map(
+        local_wrapped, mesh=mesh,
+        in_specs=(p3, p3, s1, s1, s1, p2, p2, p2, P(), P(), P(), p2, p2),
+        out_specs=(s1, s1, s1, s1, s1, s1, s1, p2, p2, p2, p3, s1))
+
+    def step(*args):
+        outs = sharded(*args)
+        return outs[:11] + (jnp.any(outs[11]),)
+
+    # profiler attribution: the stripes row, never the single-seat stem
+    step.__name__ = f"h264_stripes{n_dev}_{mode}_step"
+    from .encoder import donate_argnums_for_backend
+    return _perf.wrap_step(
+        f"h264.stripes{n_dev}.{mode}_step[{width}x{stripe_h * n_stripes}"
+        f"{'@444' if fullcolor else ''}]",
+        jax.jit(step, donate_argnums=donate_argnums_for_backend(
+            (1, 2, 3, 4, 5, 6, 7))))
+
+
+class StripeShardedH264Session(H264EncoderSession):
+    """H.264 session with ONE frame's stripes sharded over
+    ``settings.stripe_devices`` devices (split-frame device parallelism,
+    ROADMAP 2 — the sequence-parallel inversion of the seats axis).
+
+    Same lifecycle/finalize contract as :class:`H264EncoderSession` and
+    BYTE-IDENTICAL chunk payloads (tests/test_stripes.py): sharding is a
+    pure distribution axis. Each device's rows land in that shard's
+    region of the output buffer, so ``finalize_stream`` ships a shard's
+    stripes as soon as that shard's readback lands — composing with the
+    PR-10 PipelineRing and stripe-streaming fetch unchanged."""
+
+    def __init__(self, settings: CaptureSettings, devices=None):
+        g = plan_h264_grid(settings)
+        requested = max(1, int(getattr(settings, "stripe_devices", 1)))
+        from ..parallel.stripes import stripe_mesh
+        mesh = stripe_mesh(g.n_stripes, devices=devices,
+                           requested=requested)
+        #: the CHOSEN shard count (may be < requested — logged + gauged
+        #: by stripe_mesh; bench records it in the ledger row)
+        self.stripe_devices = int(mesh.devices.size)
+        ids = tuple(int(d.id) for d in mesh.devices.flat)
+        default = tuple(int(d.id)
+                        for d in jax.devices()[:self.stripe_devices])
+        # () = the default device prefix, so a default-device session
+        # shares the factory cache entry the pre-warm planner built
+        self._stripe_device_ids = () if ids == default else ids
+        super().__init__(settings)
+
+    def _build_step(self, mode: str):
+        if self.stripe_devices <= 1:
+            return super()._build_step(mode)
+        g, s = self.grid, self.settings
+        vr = max(0, int(getattr(s, "h264_motion_vrange", 0)))
+        hr = max(0, int(getattr(s, "h264_motion_hrange", 0)))
+        cands = scroll_candidates(vr, hr) if (mode == "p" and vr) \
+            else ((0, 0),)
+        return _jitted_h264_sharded_step(
+            mode, g.width, g.stripe_h, g.n_stripes, self._e_cap,
+            self._w_cap, self._out_cap_local, s.paint_over_delay_frames,
+            s.use_damage_gating, s.use_paint_over, candidates=cands,
+            fullcolor=self.fullcolor, n_dev=self.stripe_devices,
+            device_ids=self._stripe_device_ids)
+
+    @property
+    def _out_cap_local(self) -> int:
+        """Per-shard byte-buffer capacity (grows with _out_cap on
+        overflow; ceil so n_dev * local >= out_cap)."""
+        n = self.stripe_devices
+        return -(-self._out_cap // n)
+
+    def _row_starts(self, out, lens: np.ndarray) -> np.ndarray:
+        n = self.stripe_devices
+        if n <= 1:
+            return super()._row_starts(out, lens)
+        # data is the stacked per-shard buffers; derive the local cap
+        # from the ARRAY (pipelined frames may predate a growth episode)
+        local_cap = int(out["data"].shape[0]) // n
+        R = int(lens.shape[0])
+        rl = R // n
+        starts = np.zeros(R, np.int64)
+        for d in range(n):
+            seg = lens[d * rl:(d + 1) * rl]
+            starts[d * rl:(d + 1) * rl] = d * local_cap + np.concatenate(
+                [[0], np.cumsum(seg[:-1])])
+        return starts

@@ -39,6 +39,13 @@ class CaptureSettings:
     paint_over_delay_frames: int = 15
     # striping (reference striped encoding, SURVEY.md §2.5)
     stripe_height: int = 64
+    # split-frame device parallelism (ROADMAP 2): shard ONE frame's
+    # stripes across this many devices (sequence-parallel analog of
+    # tpu_seats). 1 = single-device session; >1 builds the
+    # shard_map-wrapped step (StripeShardedH264Session). The mesh
+    # silently-but-loudly degrades to the largest dividing count
+    # (parallel/stripes.stripe_mesh logs + gauges the chosen value).
+    stripe_devices: int = 1
     # deep pipeline (ROADMAP 2): frames in flight between dispatch and
     # delivery. 1 = frame-serial (the pre-pipeline engine); >=2 runs a
     # finalizer thread so frame N+1 dispatches while N reads back. The

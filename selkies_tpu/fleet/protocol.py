@@ -298,9 +298,17 @@ def parse_heartbeat(doc) -> Heartbeat:
     for w in warm:
         if not isinstance(w, str) or "x" not in w:
             raise FleetProtocolError(f"warm geometry {w!r} is not 'WxH'")
-        a, _, b = w.partition("x")
+        # "WxH" (single-device) or "WxH@sN" (split-frame sharded
+        # operating point, ROADMAP 2) — still strictly validated:
+        # heartbeats steer placement, so junk never folds in
+        geo, at, sfx = w.partition("@")
+        a, _, b = geo.partition("x")
         if not (a.isdigit() and b.isdigit()):
             raise FleetProtocolError(f"warm geometry {w!r} is not 'WxH'")
+        if at and not (sfx.startswith("s") and sfx[1:].isdigit()
+                       and 0 < int(sfx[1:]) <= _MAX_DEVICES):
+            raise FleetProtocolError(
+                f"warm geometry {w!r} has a malformed stripe suffix")
         hb.warm_geometries.append(w)
     return hb
 

@@ -275,7 +275,11 @@ class SeatScheduler:
                spec: SessionSpec) -> float:
         score = self.pack_weight * fill
         geo = f"{spec.width}x{spec.height}"
-        if geo in host.heartbeat.warm_geometries:
+        # a warm entry matches on its geometry part: "WxH" plain hosts
+        # and "WxH@sN" split-frame-sharded operating points (ROADMAP 2)
+        # are both compile-free placements for a WxH session
+        if any(w == geo or w.partition("@")[0] == geo
+               for w in host.heartbeat.warm_geometries):
             score += self.warm_bonus
         if host.heartbeat.health == "degraded":
             score -= self.burn_penalty / 2

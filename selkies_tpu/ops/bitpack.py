@@ -193,18 +193,149 @@ def pack_slot_events_scatter(payload: jnp.ndarray, nbits: jnp.ndarray,
     return PackedStream(words, total_bits, n_events, overflow)
 
 
-def default_packer():
-    """Packer selection: ``SELKIES_PACKER=gather|scatter`` overrides; the
-    default is the scatter formulation (no sorts, no per-word gather
-    rounds — the profile winner on TPU and within noise on CPU).
+# ---------------------------------------------------------------------------
+# hierarchical bit-merge (PERF.md lever 2, landed with the per-MB-relative
+# offsets refactor): bitstream assembly as log2(S) rounds of pairwise DENSE
+# stack merges instead of one global scatter-add. A "stack" is a partial
+# MSB-first bitstream: (..., cap) uint32 words + a per-stack bit length,
+# with every bit past the length ZERO (the invariant that makes merge a
+# pure shift-and-OR). The same primitive merges per-event stacks into a
+# stream (pack_slot_events_bitmerge), per-MB stacks into a row
+# (h264_planes._EventSink), and per-shard row groups at the split-frame
+# seam (parallel/stripes) — one formulation, three consumers.
+# ---------------------------------------------------------------------------
 
-    Scope: consumed by the JPEG entropy coder and by the reference-layout
-    H.264 module (ops/h264_encode — now the bit-exactness oracle). The
-    PRODUCTION H.264 path (ops/h264_planes) embeds the scatter
-    formulation directly in its event sink and ignores this toggle."""
+def merge_bit_stacks(wa: jnp.ndarray, ba: jnp.ndarray,
+                     wb: jnp.ndarray, bb: jnp.ndarray,
+                     cap_out: int) -> tuple:
+    """Append stream ``b`` to stream ``a`` at bit position ``ba``.
+
+    ``wa``: (..., ca) uint32 MSB-first words; ``ba``: (...,) int32 bits
+    used (bits past ``ba`` must be zero — the stack invariant). Same for
+    ``wb``/``bb``. Returns ``(words (..., cap_out), bits (...,))``. Bits
+    that would land past ``cap_out * 32`` are dropped (the caller's
+    overflow accounting flags them). Entirely dense: one pad, two
+    gathers, two shifts, two ORs — no sort, no scatter."""
+    ca = wa.shape[-1]
+    cb = wb.shape[-1]
+    q = (ba >> 5)[..., None]                     # word offset of the seam
+    r = (ba & 31)[..., None]                     # bit offset within it
+    idx = jnp.arange(cap_out, dtype=jnp.int32)
+    idx = jnp.broadcast_to(idx, ba.shape + (cap_out,))
+    if cap_out >= ca:
+        a_part = jnp.concatenate(
+            [wa, jnp.zeros(wa.shape[:-1] + (cap_out - ca,), jnp.uint32)],
+            axis=-1)
+    else:
+        a_part = wa[..., :cap_out]
+    j = idx - q                                  # source word in b (>> r)
+    bj = jnp.where((j >= 0) & (j < cb),
+                   jnp.take_along_axis(wb, jnp.clip(j, 0, cb - 1), axis=-1),
+                   0)
+    j1 = j - 1                                   # spill word in b (<< 32-r)
+    bj1 = jnp.where((j1 >= 0) & (j1 < cb),
+                    jnp.take_along_axis(wb, jnp.clip(j1, 0, cb - 1),
+                                        axis=-1),
+                    0)
+    r_u = r.astype(jnp.uint32)
+    hi = jnp.right_shift(bj, r_u)
+    lo = jnp.where(r > 0,
+                   jnp.left_shift(bj1, (jnp.uint32(32) - r_u)
+                                  & jnp.uint32(31)),
+                   0)
+    return a_part | hi | lo, ba + bb
+
+
+def hierarchical_merge(words: jnp.ndarray, bits: jnp.ndarray,
+                       out_cap: int) -> tuple:
+    """Reduce a stack axis by pairwise merges: ``words`` (..., N, c) +
+    ``bits`` (..., N) -> ``(stream (..., out_cap), total_bits (...,))``
+    in ceil(log2(N)) dense rounds. Stream order is stack order along the
+    reduced axis; N is padded to a power of two with empty stacks."""
+    n = words.shape[-2]
+    c = words.shape[-1]
+    npad = 1 if n <= 1 else 1 << (n - 1).bit_length()
+    if npad != n:
+        words = jnp.concatenate(
+            [words, jnp.zeros(words.shape[:-2] + (npad - n, c),
+                              jnp.uint32)], axis=-2)
+        bits = jnp.concatenate(
+            [bits, jnp.zeros(bits.shape[:-1] + (npad - n,), bits.dtype)],
+            axis=-1)
+    n = npad
+    while n > 1:
+        wa, ba = words[..., 0::2, :], bits[..., 0::2]
+        wb, bb = words[..., 1::2, :], bits[..., 1::2]
+        c = min(2 * c, out_cap) if n > 2 else out_cap
+        words, bits = merge_bit_stacks(wa, ba, wb, bb, c)
+        n //= 2
+    if words.shape[-1] < out_cap:
+        words = jnp.concatenate(
+            [words, jnp.zeros(words.shape[:-1]
+                              + (out_cap - words.shape[-1],), jnp.uint32)],
+            axis=-1)
+    return words[..., 0, :], bits[..., 0]
+
+
+def event_stacks(payload: jnp.ndarray, nbits: jnp.ndarray) -> jnp.ndarray:
+    """Each event as its own 1-word stack (MSB-aligned): the leaves of
+    the hierarchical merge. ``payload`` LSB-aligned uint32, ``nbits``
+    0..32 (0 = empty stack)."""
+    nb = nbits.astype(jnp.int32)
+    pay = jnp.where(nb > 0, payload, 0).astype(jnp.uint32)
+    sh = ((jnp.int32(32) - nb) & 31).astype(jnp.uint32)
+    return jnp.where(nb > 0, jnp.left_shift(pay, sh), 0)[..., None]
+
+
+def pack_slot_events_bitmerge(payload: jnp.ndarray, nbits: jnp.ndarray,
+                              e_cap: int, w_cap: int,
+                              max_events_per_word: int = MAX_EVENTS_PER_WORD
+                              ) -> PackedStream:
+    """Same contract as :func:`pack_slot_events_scatter`, built as a
+    hierarchical bit-merge: every slot is a 1-word leaf stack, merged
+    pairwise in stream order over ceil(log2(M*S)) dense rounds. No
+    cumsum-derived global offsets, no scatter, no sort — the op classes
+    the scatter/gather formulations pay for. Bit-exact with both
+    (tests/test_stripes.py randomized equivalence)."""
+    del max_events_per_word
+    m, s = payload.shape
+    nb = nbits.astype(jnp.int32)
+    active = nb > 0
+    total_bits = jnp.sum(nb).astype(jnp.int32)
+    n_events = jnp.sum(active.astype(jnp.int32)).astype(jnp.int32)
+    leaves = event_stacks(payload.reshape(-1), nb.reshape(-1))
+    words, _ = hierarchical_merge(leaves, nb.reshape(-1), w_cap)
+    overflow = (n_events > e_cap) | (total_bits > w_cap * 32)
+    return PackedStream(words, total_bits, n_events, overflow)
+
+
+def packer_name() -> str:
+    """The selected packer strategy: ``SELKIES_PACKER`` in
+    {"gather", "scatter", "bitmerge"}; default scatter."""
     import os
     name = os.environ.get("SELKIES_PACKER", "scatter")
-    return pack_slot_events if name == "gather" else pack_slot_events_scatter
+    return name if name in ("gather", "scatter", "bitmerge") else "scatter"
+
+
+def default_packer():
+    """Packer selection: ``SELKIES_PACKER=gather|scatter|bitmerge``
+    overrides; the default is the scatter formulation (no sorts, no
+    per-word gather rounds — the profile winner on TPU and within noise
+    on CPU). ``bitmerge`` selects the hierarchical bit-merge
+    (:func:`pack_slot_events_bitmerge`).
+
+    Scope: consumed by the JPEG entropy coder, by the reference-layout
+    H.264 module (ops/h264_encode — the bit-exactness oracle, which now
+    feeds the packer per-MB event blocks), and — for the scatter vs
+    bitmerge choice — by the production event sink
+    (ops/h264_planes._EventSink), whose per-MB-relative offsets make the
+    merge formulation applicable there too."""
+    name = packer_name()
+    if name == "gather":
+        return pack_slot_events
+    if name == "bitmerge":
+        return pack_slot_events_bitmerge
+    return pack_slot_events_scatter
 
 
 def words_to_bytes(words, total_bits: int, pad_ones: bool = True) -> bytes:

@@ -294,6 +294,39 @@ class H264FrameOut(NamedTuple):
     mb_rows: int
 
 
+def _pack_rows_mb_blocked(prefix_pay, prefix_nb, mb_pay, mb_nb,
+                          tail_pay, tail_nb, e_cap: int, w_cap: int):
+    """Pack per-row streams from PER-MB event blocks.
+
+    ``prefix_*`` (R, Kp) row-prefix events, ``mb_*`` (R, M, S) per-MB
+    slot events, ``tail_*`` (R, Kt) post-body events (trailing skip run,
+    stop bit). Slot order — prefix, MBs in order, tail, inactive slots
+    skipped — is identical to the old flat row stream, so the packed
+    bits are unchanged; but the packer now sees one block per MB whose
+    offsets are block-RELATIVE (the hierarchical bit-merge packer's
+    input shape, PERF.md lever 2 — and the seam the split-frame sharded
+    path merges at)."""
+    R, M, S = mb_pay.shape
+
+    def block(p, n):
+        k = p.shape[-1]
+        return (jnp.concatenate(
+            [p.astype(jnp.uint32)[:, None, :],
+             jnp.zeros((R, 1, S - k), jnp.uint32)], axis=-1),
+            jnp.concatenate(
+            [n.astype(jnp.int32)[:, None, :],
+             jnp.zeros((R, 1, S - k), jnp.int32)], axis=-1))
+
+    ppay, pnb = block(prefix_pay, prefix_nb)
+    tpay, tnb = block(tail_pay, tail_nb)
+    pay = jnp.concatenate([ppay, mb_pay.astype(jnp.uint32), tpay], axis=1)
+    nb = jnp.concatenate([pnb, mb_nb.astype(jnp.int32), tnb], axis=1)
+    return jax.vmap(
+        lambda p, n: default_packer()(p, n, e_cap, w_cap,
+                                      max_events_per_word=33)
+    )(pay, nb)
+
+
 def rgb_to_yuv420(rgb: jnp.ndarray):
     """(H, W, 3) uint8 -> int32 Y (H, W), U, V (H/2, W/2). BT.601
     full-range (parity with the JPEG path; VUI-less H.264 is
@@ -547,29 +580,25 @@ def h264_encode_yuv(yf: jnp.ndarray, uf: jnp.ndarray, vf: jnp.ndarray,
     idr_pay, idr_nb = _ue_event(idr)
     dqp = qp - 26
     qp_pay, qp_nb = _ue_event(jnp.where(dqp > 0, 2 * dqp - 1, -2 * dqp))
-    row_pay = jnp.concatenate([
+    prefix_pay = jnp.concatenate([
         header_pay.astype(jnp.uint32),
         idr_pay[:, None],
         jnp.zeros((R, 1), jnp.uint32),             # '00' marking flags
         qp_pay[:, None],
         jnp.full((R, 1), 2, jnp.uint32),           # ue(1) = '010'
-        mb_pay.reshape(R, M * SLOTS_MB),
-        jnp.ones((R, 1), jnp.uint32),              # rbsp stop bit
     ], axis=-1)
-    row_nb = jnp.concatenate([
+    prefix_nb = jnp.concatenate([
         header_nb.astype(jnp.int32),
         idr_nb[:, None],
         jnp.full((R, 1), 2, jnp.int32),
         qp_nb[:, None],
         jnp.full((R, 1), 3, jnp.int32),
-        mb_nb.reshape(R, M * SLOTS_MB),
-        jnp.ones((R, 1), jnp.int32),
     ], axis=-1)
 
-    packed = jax.vmap(
-        lambda p, n: default_packer()(p[None, :], n[None, :], e_cap, w_cap,
-                                      max_events_per_word=33)
-    )(row_pay, row_nb)
+    packed = _pack_rows_mb_blocked(
+        prefix_pay, prefix_nb, mb_pay, mb_nb,
+        jnp.ones((R, 1), jnp.uint32),              # rbsp stop bit
+        jnp.ones((R, 1), jnp.int32), e_cap, w_cap)
     out = H264FrameOut(packed.words, packed.total_bits,
                        jnp.any(packed.overflow), R)
     if want_recon:
@@ -1002,35 +1031,35 @@ def _assemble_p_rows(R, M, qp, qpc, fn, header_pay, header_nb, cbp, coded,
     ], axis=-1)
 
     # ---- row stream: host prefix + device tail (frame_num, flags) +
-    # qp tail + MB slots + trailing skip run + stop bit
+    # qp tail + per-MB slot blocks + trailing skip run + stop bit
     dqp_h = qp - 26
     qph_pay, qph_nb = _ue_event(jnp.where(dqp_h > 0, 2 * dqp_h - 1,
                                           -2 * dqp_h))
     tr_pay, tr_nb = _ue_event(jnp.maximum(trailing, 0))
     tr_nb = jnp.where(trailing > 0, tr_nb, 0)
-    row_pay = jnp.concatenate([
+    prefix_pay = jnp.concatenate([
         header_pay.astype(jnp.uint32),
         (fn & 0xF).astype(jnp.uint32)[:, None],          # frame_num u(4)
         jnp.zeros((R, 1), jnp.uint32),                   # '000' flags
         qph_pay[:, None],
         jnp.full((R, 1), 2, jnp.uint32),                 # ue(1) deblock off
-        mb_pay.reshape(R, M * P_SLOTS_MB),
-        tr_pay[:, None],
-        jnp.ones((R, 1), jnp.uint32),                    # rbsp stop bit
     ], axis=-1)
-    row_nb = jnp.concatenate([
+    prefix_nb = jnp.concatenate([
         header_nb.astype(jnp.int32),
         jnp.full((R, 1), 4, jnp.int32),
         jnp.full((R, 1), 3, jnp.int32),
         qph_nb[:, None],
         jnp.full((R, 1), 3, jnp.int32),
-        mb_nb.reshape(R, M * P_SLOTS_MB),
+    ], axis=-1)
+    tail_pay = jnp.concatenate([
+        tr_pay[:, None],
+        jnp.ones((R, 1), jnp.uint32),                    # rbsp stop bit
+    ], axis=-1)
+    tail_nb = jnp.concatenate([
         tr_nb[:, None],
         jnp.ones((R, 1), jnp.int32),
     ], axis=-1)
-    packed = jax.vmap(
-        lambda p, n: default_packer()(p[None, :], n[None, :], e_cap, w_cap,
-                                      max_events_per_word=33)
-    )(row_pay, row_nb)
+    packed = _pack_rows_mb_blocked(prefix_pay, prefix_nb, mb_pay, mb_nb,
+                                   tail_pay, tail_nb, e_cap, w_cap)
     return H264FrameOut(packed.words, packed.total_bits,
                         jnp.any(packed.overflow), R)

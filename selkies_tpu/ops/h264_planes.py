@@ -311,37 +311,63 @@ def _nc_planes(tc_eff, mb_bw: int):
 
 
 # ---------------------------------------------------------------------------
-# event sink: every slot class appends (row, offset, payload, nbits)
-# tensors; ONE pair of scatter-adds materialises the per-row streams
+# event sink: every slot class appends (row, [mb,] offset, payload, nbits)
+# tensors with PER-MB-RELATIVE bit offsets (prefix events are relative to
+# the row start, tail events to the MB body end). Placement against the
+# row layout happens inside pack(): either ONE pair of scatter-adds
+# (default) or the hierarchical bit-merge (SELKIES_PACKER=bitmerge,
+# PERF.md lever 2) — per-MB word stacks merged over log2(M) dense rounds.
+# The relative-offset restructure is exactly what lets the split-frame
+# sharded path pack each shard's rows locally and join at the seam.
 # ---------------------------------------------------------------------------
 
 class _EventSink:
-    def __init__(self, R: int, w_cap: int):
-        self.R, self.w_cap = R, w_cap
-        self.items = []
+    def __init__(self, R: int, M: int, w_cap: int):
+        self.R, self.M, self.w_cap = R, M, w_cap
+        self.prefix_items = []   # (row, off-in-row, pay, nb)
+        self.mb_items = []       # (row, mb, off-in-mb, pay, nb)
+        self.tail_items = []     # (row, off-past-body, pay, nb)
+        self._prefix_bits = None
+        self._mb_bits = None
+        self._tail_bits = None
 
-    def add(self, row, off, pay, nb):
-        """All args broadcastable to one shape; row = MB-row index per
-        element, off = bit offset WITHIN that row's stream."""
-        shp = jnp.broadcast_shapes(jnp.shape(row), jnp.shape(off),
-                                   jnp.shape(pay), jnp.shape(nb))
-        self.items.append((
-            jnp.broadcast_to(row, shp).reshape(-1),
-            jnp.broadcast_to(off, shp).reshape(-1),
-            jnp.broadcast_to(pay, shp).reshape(-1).astype(jnp.uint32),
-            jnp.broadcast_to(nb, shp).reshape(-1).astype(jnp.int32)))
+    @staticmethod
+    def _flat(*args):
+        shp = jnp.broadcast_shapes(*(jnp.shape(a) for a in args))
+        return [jnp.broadcast_to(a, shp).reshape(-1) for a in args]
 
-    def pack(self):
-        """-> (words (R, w_cap) uint32, n_events (R,) int32)."""
-        R, w_cap = self.R, self.w_cap
-        row = jnp.concatenate([i[0] for i in self.items])
-        off = jnp.concatenate([i[1] for i in self.items])
-        pay = jnp.concatenate([i[2] for i in self.items])
-        nb = jnp.concatenate([i[3] for i in self.items])
+    def add_prefix(self, row, off, pay, nb):
+        """Row-prefix events; ``off`` is relative to the ROW start."""
+        r, o, p, n = self._flat(row, off, pay, nb)
+        self.prefix_items.append((r, o, p.astype(jnp.uint32),
+                                  n.astype(jnp.int32)))
+
+    def add_mb(self, row, mb, off, pay, nb):
+        """MB-body events; ``off`` is relative to THAT MB's start."""
+        r, m, o, p, n = self._flat(row, mb, off, pay, nb)
+        self.mb_items.append((r, m, o, p.astype(jnp.uint32),
+                              n.astype(jnp.int32)))
+
+    def add_tail(self, row, off, pay, nb):
+        """Row-tail events; ``off`` is relative to the MB body END."""
+        r, o, p, n = self._flat(row, off, pay, nb)
+        self.tail_items.append((r, o, p.astype(jnp.uint32),
+                                n.astype(jnp.int32)))
+
+    def set_layout(self, prefix_bits, mb_bits, tail_bits):
+        """Per-row prefix bits (R,), per-MB body bits (R, M), per-row
+        tail bits (R,) — the only global knowledge pack() needs."""
+        self._prefix_bits = prefix_bits
+        self._mb_bits = mb_bits
+        self._tail_bits = tail_bits
+
+    # -- strategy helpers ---------------------------------------------------
+    @staticmethod
+    def _contribs(off, pay, nb):
+        """(hi, lo, straddles) word contributions of events at ``off``
+        relative to some word-aligned base."""
         active = nb > 0
-        goff = row * (w_cap * 32) + off
-        w0 = (goff >> 5).astype(jnp.int32)
-        rel = (goff & 31).astype(jnp.int32)
+        rel = (off & 31).astype(jnp.int32)
         sh = 32 - (rel + nb)
         pay = jnp.where(active, pay, 0)
         hi = jnp.where(sh >= 0,
@@ -353,15 +379,112 @@ class _EventSink:
         lo = jnp.where((sh < 0) & active,
                        jnp.left_shift(pay, jnp.clip(32 + sh, 0, 31)
                                       .astype(jnp.uint32)), 0)
-        oob = R * w_cap
+        return hi, lo, sh < 0
+
+    @staticmethod
+    def _scatter(n_words, w0, straddle, hi, lo, active):
+        oob = n_words
         w0_t = jnp.where(active, w0, oob)
-        w1_t = jnp.where(active & (sh < 0), w0 + 1, oob)
-        words = jnp.zeros((R * w_cap,), jnp.uint32)
+        w1_t = jnp.where(active & straddle, w0 + 1, oob)
+        words = jnp.zeros((n_words,), jnp.uint32)
         words = words.at[w0_t].add(hi, mode="drop")
         words = words.at[w1_t].add(lo, mode="drop")
-        n_ev = jnp.zeros((R,), jnp.int32).at[row].add(
-            active.astype(jnp.int32), mode="drop")
-        return words.reshape(R, w_cap), n_ev
+        return words
+
+    def _resolved(self, mb_start, body_end):
+        """Every item as (row, absolute-off-in-row, pay, nb)."""
+        out = [(r, o, p, n) for (r, o, p, n) in self.prefix_items]
+        for (r, m, o, p, n) in self.mb_items:
+            out.append((r, mb_start[r, m] + o, p, n))
+        for (r, o, p, n) in self.tail_items:
+            out.append((r, body_end[r] + o, p, n))
+        return out
+
+    def _pack_scatter(self, mb_start, body_end):
+        R, w_cap = self.R, self.w_cap
+        items = self._resolved(mb_start, body_end)
+        row = jnp.concatenate([i[0] for i in items])
+        off = jnp.concatenate([i[1] for i in items])
+        pay = jnp.concatenate([i[2] for i in items])
+        nb = jnp.concatenate([i[3] for i in items])
+        goff = row * (w_cap * 32) + off
+        hi, lo, straddle = self._contribs(goff, pay, nb)
+        words = self._scatter(R * w_cap, (goff >> 5).astype(jnp.int32),
+                              straddle, hi, lo, nb > 0)
+        return words.reshape(R, w_cap)
+
+    def _pack_bitmerge(self):
+        """Hierarchical bit-merge materialisation: per-MB word stacks
+        built from the MB-RELATIVE offsets (locality-bounded scatter),
+        then log2(M) pairwise dense merges per row, then the prefix and
+        tail stacks joined at the seams. Bit-exact with the scatter
+        strategy."""
+        from .bitpack import hierarchical_merge, merge_bit_stacks
+        R, M, w_cap = self.R, self.M, self.w_cap
+
+        def stack_cap(items, groups):
+            slots = sum(int(i[-1].size) for i in items) // groups
+            return max(1, slots)
+
+        # per-MB stacks: offsets are MB-relative, so the scatter index of
+        # every event is bounded inside its own mb_cap-word stack
+        mb_cap = stack_cap(self.mb_items, R * M)
+        row = jnp.concatenate([i[0] for i in self.mb_items])
+        mb = jnp.concatenate([i[1] for i in self.mb_items])
+        off = jnp.concatenate([i[2] for i in self.mb_items])
+        pay = jnp.concatenate([i[3] for i in self.mb_items])
+        nb = jnp.concatenate([i[4] for i in self.mb_items])
+        hi, lo, straddle = self._contribs(off, pay, nb)
+        w0 = (row * M + mb) * mb_cap + (off >> 5).astype(jnp.int32)
+        stacks = self._scatter(R * M * mb_cap, w0, straddle, hi, lo,
+                               nb > 0).reshape(R, M, mb_cap)
+        body, body_bits = hierarchical_merge(stacks, self._mb_bits, w_cap)
+
+        def edge_stack(items, bits):
+            cap = stack_cap(items, R)
+            row = jnp.concatenate([i[0] for i in items])
+            off = jnp.concatenate([i[1] for i in items])
+            pay = jnp.concatenate([i[2] for i in items])
+            nb = jnp.concatenate([i[3] for i in items])
+            hi, lo, straddle = self._contribs(off, pay, nb)
+            w0 = row * cap + (off >> 5).astype(jnp.int32)
+            return self._scatter(R * cap, w0, straddle, hi, lo,
+                                 nb > 0).reshape(R, cap), bits
+
+        pre, pre_bits = edge_stack(self.prefix_items, self._prefix_bits)
+        words, bits = merge_bit_stacks(pre, pre_bits, body, body_bits,
+                                       w_cap)
+        tail, tail_bits = edge_stack(self.tail_items, self._tail_bits)
+        words, _ = merge_bit_stacks(words, bits, tail, tail_bits, w_cap)
+        return words
+
+    def pack(self):
+        """-> (words (R, w_cap) uint32, n_events (R,) int32,
+        total_bits (R,) int32)."""
+        assert self._mb_bits is not None, "set_layout() before pack()"
+        R = self.R
+        prefix_bits = self._prefix_bits
+        mb_bits = self._mb_bits
+        mb_start = prefix_bits[:, None] \
+            + jnp.cumsum(mb_bits, axis=1) - mb_bits
+        body_end = prefix_bits + jnp.sum(mb_bits, axis=1)
+        total_bits = body_end + self._tail_bits
+
+        from .bitpack import packer_name
+        if packer_name() == "bitmerge":
+            words = self._pack_bitmerge()
+        else:
+            words = self._pack_scatter(mb_start, body_end)
+
+        n_ev = jnp.zeros((R,), jnp.int32)
+        for items in (self.prefix_items, self.tail_items):
+            for it in items:
+                n_ev = n_ev.at[it[0]].add(
+                    (it[-1] > 0).astype(jnp.int32), mode="drop")
+        for it in self.mb_items:
+            n_ev = n_ev.at[it[0]].add(
+                (it[-1] > 0).astype(jnp.int32), mode="drop")
+        return words, n_ev, total_bits.astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -399,6 +522,16 @@ _SCAN_ORDER = ((0, 0), (0, 1), (1, 0), (1, 1), (0, 2), (0, 3), (1, 2),
 def _row_of_blocks(nby, nbx, per_mb: int):
     """Block-grid plane of MB-row indices."""
     return jax.lax.broadcasted_iota(jnp.int32, (nby, nbx), 0) // per_mb
+
+
+def _col_of_blocks(nby, nbx, per_mb: int):
+    """Block-grid plane of MB-column indices (the sink's mb axis)."""
+    return jax.lax.broadcasted_iota(jnp.int32, (nby, nbx), 1) // per_mb
+
+
+def _mb_cols(R, M):
+    """(1, R, M)-broadcastable MB-column index plane."""
+    return jnp.arange(M, dtype=jnp.int32)[None, None, :]
 
 
 # ---------------------------------------------------------------------------
@@ -649,7 +782,8 @@ def _assemble_frame(R, M, w_cap, e_cap, row_pays, row_nbs,
     """I-frame slot order: row prefix | per MB [hdr(3), lumaDC(36),
     16 luma AC blocks in scan order (34 each), u DC(12), v DC(12),
     8 chroma AC (34 each)] | stop bit. Every event class arrives as one
-    stacked (S, ...) pair; offsets are one cumsum per class."""
+    stacked (S, ...) pair; offsets are one MB-RELATIVE cumsum per class
+    (the sink resolves or merges placement — never this function)."""
     nby, nbx = 4 * R, 4 * M
     cby, cbx = 2 * R, 2 * M
 
@@ -670,18 +804,18 @@ def _assemble_frame(R, M, w_cap, e_cap, row_pays, row_nbs,
     mb_bits = hdr_bits + dc_bits + y_mb + c_mb       # (R, M)
 
     prefix_bits = row_nbs.sum(0)                     # (R,)
-    mb_start = prefix_bits[:, None] + jnp.cumsum(mb_bits, axis=1) - mb_bits
-    total_bits = prefix_bits + jnp.sum(mb_bits, axis=1) + 1   # + stop bit
 
-    sink = _EventSink(R, w_cap)
+    sink = _EventSink(R, M, w_cap)
     rows_r = jnp.arange(R, dtype=jnp.int32)
-    sink.add(rows_r[None], _excl_cumsum0(row_nbs), row_pays, row_nbs)
+    sink.add_prefix(rows_r[None], _excl_cumsum0(row_nbs),
+                    row_pays, row_nbs)
 
     row_rm = rows_r[None, :, None]
-    sink.add(row_rm, mb_start[None] + _excl_cumsum0(hdr_nbs),
-             hdr_pays, hdr_nbs)
-    dc_base = mb_start + hdr_bits
-    sink.add(row_rm, dc_base[None] + _excl_cumsum0(dnb), dpay, dnb)
+    mb_rm = _mb_cols(R, M)
+    sink.add_mb(row_rm, mb_rm, _excl_cumsum0(hdr_nbs), hdr_pays, hdr_nbs)
+    dc_base = hdr_bits                               # MB-relative
+    sink.add_mb(row_rm, mb_rm, dc_base[None] + _excl_cumsum0(dnb),
+                dpay, dnb)
 
     # luma AC blocks: per-(by,bx) scan-order starts on the block grid
     starts_rm = [[None] * 4 for _ in range(4)]
@@ -691,16 +825,17 @@ def _assemble_frame(R, M, w_cap, e_cap, row_pays, row_nbs,
         acc = acc + y_bits_rm[i][j]
     start_plane = _merge_planes(starts_rm, 4, 4)     # (nby, nbx)
     row_blk = _row_of_blocks(nby, nbx, 4)
-    sink.add(row_blk[None], start_plane[None] + _excl_cumsum0(ynb),
-             ypay, ynb)
+    col_blk = _col_of_blocks(nby, nbx, 4)
+    sink.add_mb(row_blk[None], col_blk[None],
+                start_plane[None] + _excl_cumsum0(ynb), ypay, ynb)
 
     # chroma DC blocks (u then v), then chroma AC (u raster, v raster)
     cdc_base = acc                                   # after all luma blocks
-    sink.add(row_rm, cdc_base[None] + _excl_cumsum0(unb_dc),
-             upay_dc, unb_dc)
+    sink.add_mb(row_rm, mb_rm, cdc_base[None] + _excl_cumsum0(unb_dc),
+                upay_dc, unb_dc)
     vdc_base = cdc_base + udc_bits
-    sink.add(row_rm, vdc_base[None] + _excl_cumsum0(vnb_dc),
-             vpay_dc, vnb_dc)
+    sink.add_mb(row_rm, mb_rm, vdc_base[None] + _excl_cumsum0(vnb_dc),
+                vpay_dc, vnb_dc)
 
     cac_base = vdc_base + vdc_bits
     u_starts = [[None] * 2 for _ in range(2)]
@@ -715,20 +850,22 @@ def _assemble_frame(R, M, w_cap, e_cap, row_pays, row_nbs,
             v_starts[i][j] = acc_c
             acc_c = acc_c + v_bits_rm[i][j]
     row_cblk = _row_of_blocks(cby, cbx, 2)
-    sink.add(row_cblk[None],
-             _merge_planes(u_starts, 2, 2)[None] + _excl_cumsum0(unb),
-             upay, unb)
-    sink.add(row_cblk[None],
-             _merge_planes(v_starts, 2, 2)[None] + _excl_cumsum0(vnb),
-             vpay, vnb)
+    col_cblk = _col_of_blocks(cby, cbx, 2)
+    sink.add_mb(row_cblk[None], col_cblk[None],
+                _merge_planes(u_starts, 2, 2)[None] + _excl_cumsum0(unb),
+                upay, unb)
+    sink.add_mb(row_cblk[None], col_cblk[None],
+                _merge_planes(v_starts, 2, 2)[None] + _excl_cumsum0(vnb),
+                vpay, vnb)
 
-    # rbsp stop bit
-    sink.add(rows_r, total_bits - 1, jnp.ones((R,), jnp.uint32),
-             jnp.ones((R,), jnp.int32))
+    # rbsp stop bit (tail-relative offset 0)
+    sink.add_tail(rows_r, jnp.zeros((R,), jnp.int32),
+                  jnp.ones((R,), jnp.uint32), jnp.ones((R,), jnp.int32))
 
-    words, n_ev = sink.pack()
+    sink.set_layout(prefix_bits, mb_bits, jnp.ones((R,), jnp.int32))
+    words, n_ev, total_bits = sink.pack()
     overflow = jnp.any((n_ev > e_cap) | (total_bits > w_cap * 32))
-    return H264FrameOut(words, total_bits.astype(jnp.int32), overflow, R)
+    return H264FrameOut(words, total_bits, overflow, R)
 
 
 # ---------------------------------------------------------------------------
@@ -739,10 +876,14 @@ def h264_encode_p_yuv(yf, uf, vf, ref_y, ref_u, ref_v, qp,
                       header_pay, header_nb, frame_num,
                       e_cap: int, w_cap: int,
                       candidates: tuple = ((0, 0),),
-                      stripe_rows: int | None = None):
+                      stripe_rows: int | None = None,
+                      precomputed_motion=None):
     """Plane-layout twin of ops/h264_encode.h264_encode_p_yuv — same
     signature, bit-identical output (P_Skip / P_L0_16x16 with motion,
-    one slice per MB row)."""
+    one slice per MB row). ``precomputed_motion`` =
+    (pred_y, pred_u, pred_v, mv) skips the in-function motion search —
+    the split-frame sharded path selects motion against HALO rows first
+    (parallel/stripes) and feeds the residual coder here."""
     H, W = yf.shape[0], yf.shape[1]
     R, M = H // 16, W // 16
     nby, nbx = H // 4, W // 4
@@ -760,9 +901,14 @@ def h264_encode_p_yuv(yf, uf, vf, ref_y, ref_u, ref_v, qp,
     rfu = ref_u.astype(jnp.int32)
     rfv = ref_v.astype(jnp.int32)
 
-    win = 16 * (stripe_rows if stripe_rows else R)
-    assert H % win == 0, "stripe_rows must tile the frame"
-    if len(candidates) > 1:
+    if precomputed_motion is not None:
+        pred_y, pred_u, pred_v, mv = precomputed_motion
+        pred_y = pred_y.astype(jnp.int32)
+        pred_u = pred_u.astype(jnp.int32)
+        pred_v = pred_v.astype(jnp.int32)
+    elif len(candidates) > 1:
+        win = 16 * (stripe_rows if stripe_rows else R)
+        assert H % win == 0, "stripe_rows must tile the frame"
         pred_y, pred_u, pred_v, mv = _motion_select(
             cur_y, rfy, rfu, rfv, qp, candidates, win)
     else:
@@ -964,34 +1110,33 @@ def _assemble_p_frame(R, M, w_cap, e_cap, qp, fn, header_pay, header_nb,
     tr_nb = jnp.where(trailing > 0, tr_nb, 0)
 
     prefix_bits = row_nbs.sum(0)
-    mb_start = prefix_bits[:, None] + jnp.cumsum(mb_bits, axis=1) - mb_bits
-    body_end = prefix_bits + jnp.sum(mb_bits, axis=1)
-    total_bits = body_end + tr_nb + 1                # + stop bit
 
-    sink = _EventSink(R, w_cap)
+    sink = _EventSink(R, M, w_cap)
     rows_r = jnp.arange(R, dtype=jnp.int32)
-    sink.add(rows_r[None], _excl_cumsum0(row_nbs), row_pays, row_nbs)
+    sink.add_prefix(rows_r[None], _excl_cumsum0(row_nbs),
+                    row_pays, row_nbs)
 
     row_rm = rows_r[None, :, None]
-    sink.add(row_rm, mb_start[None] + _excl_cumsum0(hdr_nbs),
-             hdr_pays, hdr_nbs)
+    mb_rm = _mb_cols(R, M)
+    sink.add_mb(row_rm, mb_rm, _excl_cumsum0(hdr_nbs), hdr_pays, hdr_nbs)
 
     starts_rm = [[None] * 4 for _ in range(4)]
-    acc = mb_start + hdr_bits
+    acc = hdr_bits                                   # MB-relative base
     for (i, j) in _SCAN_ORDER:
         starts_rm[i][j] = acc
         acc = acc + y_bits_rm[i][j]
     start_plane = _merge_planes(starts_rm, 4, 4)
     row_blk = _row_of_blocks(nby, nbx, 4)
-    sink.add(row_blk[None], start_plane[None] + _excl_cumsum0(ynb),
-             ypay, ynb)
+    col_blk = _col_of_blocks(nby, nbx, 4)
+    sink.add_mb(row_blk[None], col_blk[None],
+                start_plane[None] + _excl_cumsum0(ynb), ypay, ynb)
 
     cdc_base = acc
-    sink.add(row_rm, cdc_base[None] + _excl_cumsum0(unb_dc),
-             upay_dc, unb_dc)
+    sink.add_mb(row_rm, mb_rm, cdc_base[None] + _excl_cumsum0(unb_dc),
+                upay_dc, unb_dc)
     vdc_base = cdc_base + udc_bits
-    sink.add(row_rm, vdc_base[None] + _excl_cumsum0(vnb_dc),
-             vpay_dc, vnb_dc)
+    sink.add_mb(row_rm, mb_rm, vdc_base[None] + _excl_cumsum0(vnb_dc),
+                vpay_dc, vnb_dc)
 
     cac_base = vdc_base + vdc_bits
     u_starts = [[None] * 2 for _ in range(2)]
@@ -1006,18 +1151,20 @@ def _assemble_p_frame(R, M, w_cap, e_cap, qp, fn, header_pay, header_nb,
             v_starts[i][j] = acc_c
             acc_c = acc_c + v_bits_rm[i][j]
     row_cblk = _row_of_blocks(cby, cbx, 2)
-    sink.add(row_cblk[None],
-             _merge_planes(u_starts, 2, 2)[None] + _excl_cumsum0(unb),
-             upay, unb)
-    sink.add(row_cblk[None],
-             _merge_planes(v_starts, 2, 2)[None] + _excl_cumsum0(vnb),
-             vpay, vnb)
+    col_cblk = _col_of_blocks(cby, cbx, 2)
+    sink.add_mb(row_cblk[None], col_cblk[None],
+                _merge_planes(u_starts, 2, 2)[None] + _excl_cumsum0(unb),
+                upay, unb)
+    sink.add_mb(row_cblk[None], col_cblk[None],
+                _merge_planes(v_starts, 2, 2)[None] + _excl_cumsum0(vnb),
+                vpay, vnb)
 
-    # trailing skip run + stop bit
-    sink.add(rows_r, body_end, tr_pay, tr_nb)
-    sink.add(rows_r, total_bits - 1, jnp.ones((R,), jnp.uint32),
-             jnp.ones((R,), jnp.int32))
+    # trailing skip run at tail offset 0, stop bit right after it
+    sink.add_tail(rows_r, jnp.zeros((R,), jnp.int32), tr_pay, tr_nb)
+    sink.add_tail(rows_r, tr_nb, jnp.ones((R,), jnp.uint32),
+                  jnp.ones((R,), jnp.int32))
 
-    words, n_ev = sink.pack()
+    sink.set_layout(prefix_bits, mb_bits, tr_nb + 1)
+    words, n_ev, total_bits = sink.pack()
     overflow = jnp.any((n_ev > e_cap) | (total_bits > w_cap * 32))
-    return H264FrameOut(words, total_bits.astype(jnp.int32), overflow, R)
+    return H264FrameOut(words, total_bits, overflow, R)

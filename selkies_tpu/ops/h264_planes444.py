@@ -28,8 +28,9 @@ from ..codecs import h264_tables as HT
 from .colorspace import rgb_to_ycbcr
 from .h264_encode import (H264FrameOut, LEVEL_CLAMP, _se_event, _ue_event,
                           _motion_select)
-from .h264_planes import (_EventSink, _clip1, _dequant_plane, _expand,
-                          _excl_cumsum0, _grid_rm, _merge_planes,
+from .h264_planes import (_EventSink, _clip1, _col_of_blocks,
+                          _dequant_plane, _expand,
+                          _excl_cumsum0, _grid_rm, _mb_cols, _merge_planes,
                           _quant_dc_e, _dequant_ldc_e, _quant_plane,
                           _row_of_blocks, _SCAN_ORDER, cavlc_events_planes,
                           fwd4_planes, inv4_planes)
@@ -189,7 +190,8 @@ def h264_encode_yuv444(yf, uf, vf, qp, header_pay, header_nb,
 def _assemble_444(R, M, w_cap, e_cap, row_pays, row_nbs,
                   hdr_pays, hdr_nbs, ev):
     """Slot order per MB: hdr | per comp [DC block, 16 AC blocks in scan
-    order] | ... | stop bit."""
+    order] | ... | stop bit. Offsets are MB-relative (per-MB-relative
+    restructure, PERF.md lever 2); the sink resolves placement."""
     nby, nbx = 4 * R, 4 * M
     hdr_bits = hdr_nbs.sum(0)
     comp_dc_bits = [e[1].sum(0) for e in ev]                # (R, M)
@@ -199,20 +201,21 @@ def _assemble_444(R, M, w_cap, e_cap, row_pays, row_nbs,
     mb_bits = hdr_bits + sum(comp_dc_bits) + sum(comp_ac_mb)
 
     prefix_bits = row_nbs.sum(0)
-    mb_start = prefix_bits[:, None] + jnp.cumsum(mb_bits, axis=1) - mb_bits
-    total_bits = prefix_bits + jnp.sum(mb_bits, axis=1) + 1
 
-    sink = _EventSink(R, w_cap)
+    sink = _EventSink(R, M, w_cap)
     rows_r = jnp.arange(R, dtype=jnp.int32)
-    sink.add(rows_r[None], _excl_cumsum0(row_nbs), row_pays, row_nbs)
+    sink.add_prefix(rows_r[None], _excl_cumsum0(row_nbs),
+                    row_pays, row_nbs)
     row_rm = rows_r[None, :, None]
-    sink.add(row_rm, mb_start[None] + _excl_cumsum0(hdr_nbs),
-             hdr_pays, hdr_nbs)
+    mb_rm = _mb_cols(R, M)
+    sink.add_mb(row_rm, mb_rm, _excl_cumsum0(hdr_nbs), hdr_pays, hdr_nbs)
 
     row_blk = _row_of_blocks(nby, nbx, 4)
-    base = mb_start + hdr_bits
+    col_blk = _col_of_blocks(nby, nbx, 4)
+    base = hdr_bits
     for ci, (dpay, dnb, apay, anb) in enumerate(ev):
-        sink.add(row_rm, base[None] + _excl_cumsum0(dnb), dpay, dnb)
+        sink.add_mb(row_rm, mb_rm, base[None] + _excl_cumsum0(dnb),
+                    dpay, dnb)
         base = base + comp_dc_bits[ci]
         starts_rm = [[None] * 4 for _ in range(4)]
         acc = base
@@ -220,15 +223,16 @@ def _assemble_444(R, M, w_cap, e_cap, row_pays, row_nbs,
             starts_rm[i][j] = acc
             acc = acc + comp_ac_rm[ci][i][j]
         start_pl = _merge_planes(starts_rm, 4, 4)
-        sink.add(row_blk[None], start_pl[None] + _excl_cumsum0(anb),
-                 apay, anb)
+        sink.add_mb(row_blk[None], col_blk[None],
+                    start_pl[None] + _excl_cumsum0(anb), apay, anb)
         base = acc
 
-    sink.add(rows_r, total_bits - 1, jnp.ones((R,), jnp.uint32),
-             jnp.ones((R,), jnp.int32))
-    words, n_ev = sink.pack()
+    sink.add_tail(rows_r, jnp.zeros((R,), jnp.int32),
+                  jnp.ones((R,), jnp.uint32), jnp.ones((R,), jnp.int32))
+    sink.set_layout(prefix_bits, mb_bits, jnp.ones((R,), jnp.int32))
+    words, n_ev, total_bits = sink.pack()
     overflow = jnp.any((n_ev > e_cap) | (total_bits > w_cap * 32))
-    return H264FrameOut(words, total_bits.astype(jnp.int32), overflow, R)
+    return H264FrameOut(words, total_bits, overflow, R)
 
 
 # ---------------------------------------------------------------------------
@@ -274,10 +278,13 @@ def h264_encode_p_yuv444(yf, uf, vf, ref_y, ref_u, ref_v, qp,
                          header_pay, header_nb, frame_num,
                          e_cap: int, w_cap: int,
                          candidates: tuple = ((0, 0),),
-                         stripe_rows: int | None = None):
+                         stripe_rows: int | None = None,
+                         precomputed_motion=None):
     """4:4:4 P frame: P_Skip / P_L0_16x16, all components luma-style,
     shared cbp group bits, ChromaArrayType-3 me(v) mapping. Returns
-    (H264FrameOut, (recon_y, recon_u, recon_v))."""
+    (H264FrameOut, (recon_y, recon_u, recon_v)). ``precomputed_motion``
+    = (pred_y, pred_u, pred_v, mv) skips the in-function search (the
+    sharded halo path)."""
     H, W = yf.shape[0], yf.shape[1]
     R, M = H // 16, W // 16
     nby, nbx = H // 4, W // 4
@@ -290,9 +297,12 @@ def h264_encode_p_yuv444(yf, uf, vf, ref_y, ref_u, ref_v, qp,
     cur = [p.astype(jnp.int32) for p in (yf, uf, vf)]
     rf = [p.astype(jnp.int32) for p in (ref_y, ref_u, ref_v)]
 
-    win = 16 * (stripe_rows if stripe_rows else R)
-    assert H % win == 0, "stripe_rows must tile the frame"
-    if len(candidates) > 1:
+    if precomputed_motion is not None:
+        pred_y, pred_u, pred_v, mv = precomputed_motion
+        preds = [p.astype(jnp.int32) for p in (pred_y, pred_u, pred_v)]
+    elif len(candidates) > 1:
+        win = 16 * (stripe_rows if stripe_rows else R)
+        assert H % win == 0, "stripe_rows must tile the frame"
         pred_y, pred_u, pred_v, mv = _motion_select444(
             cur[0], rf[0], rf[1], rf[2], qp, candidates, win)
         preds = [pred_y, pred_u, pred_v]
@@ -412,19 +422,18 @@ def _assemble_p_444(R, M, w_cap, e_cap, qp, fn, header_pay, header_nb,
     tr_nb = jnp.where(trailing > 0, tr_nb, 0)
 
     prefix_bits = row_nbs.sum(0)
-    mb_start = prefix_bits[:, None] + jnp.cumsum(mb_bits, axis=1) - mb_bits
-    body_end = prefix_bits + jnp.sum(mb_bits, axis=1)
-    total_bits = body_end + tr_nb + 1
 
-    sink = _EventSink(R, w_cap)
+    sink = _EventSink(R, M, w_cap)
     rows_r = jnp.arange(R, dtype=jnp.int32)
-    sink.add(rows_r[None], _excl_cumsum0(row_nbs), row_pays, row_nbs)
+    sink.add_prefix(rows_r[None], _excl_cumsum0(row_nbs),
+                    row_pays, row_nbs)
     row_rm = rows_r[None, :, None]
-    sink.add(row_rm, mb_start[None] + _excl_cumsum0(hdr_nbs),
-             hdr_pays, hdr_nbs)
+    mb_rm = _mb_cols(R, M)
+    sink.add_mb(row_rm, mb_rm, _excl_cumsum0(hdr_nbs), hdr_pays, hdr_nbs)
 
     row_blk = _row_of_blocks(nby, nbx, 4)
-    base = mb_start + hdr_bits
+    col_blk = _col_of_blocks(nby, nbx, 4)
+    base = hdr_bits
     for ci, (apay, anb) in enumerate(ev):
         starts_rm = [[None] * 4 for _ in range(4)]
         acc = base
@@ -432,13 +441,14 @@ def _assemble_p_444(R, M, w_cap, e_cap, qp, fn, header_pay, header_nb,
             starts_rm[i][j] = acc
             acc = acc + comp_rm[ci][i][j]
         start_pl = _merge_planes(starts_rm, 4, 4)
-        sink.add(row_blk[None], start_pl[None] + _excl_cumsum0(anb),
-                 apay, anb)
+        sink.add_mb(row_blk[None], col_blk[None],
+                    start_pl[None] + _excl_cumsum0(anb), apay, anb)
         base = acc
 
-    sink.add(rows_r, body_end, tr_pay, tr_nb)
-    sink.add(rows_r, total_bits - 1, jnp.ones((R,), jnp.uint32),
-             jnp.ones((R,), jnp.int32))
-    words, n_ev = sink.pack()
+    sink.add_tail(rows_r, jnp.zeros((R,), jnp.int32), tr_pay, tr_nb)
+    sink.add_tail(rows_r, tr_nb, jnp.ones((R,), jnp.uint32),
+                  jnp.ones((R,), jnp.int32))
+    sink.set_layout(prefix_bits, mb_bits, tr_nb + 1)
+    words, n_ev, total_bits = sink.pack()
     overflow = jnp.any((n_ev > e_cap) | (total_bits > w_cap * 32))
-    return H264FrameOut(words, total_bits.astype(jnp.int32), overflow, R)
+    return H264FrameOut(words, total_bits, overflow, R)

@@ -7,8 +7,31 @@ maps rows onto parallel encoders — here they map onto DEVICES). It works
 because the H.264 design made MB rows fully independent (slice per row,
 no cross-row prediction or CAVLC context): ``shard_map`` over the row
 axis compiles to a collective-free SPMD program, scaling single-frame
-encode latency down with device count — the path to 4K/8K single-seat
-targets (BASELINE.md stretch rows).
+encode latency down with device count — the path to 1080p60 on a slice
+no single chip reaches, and to the 8K/multi-monitor stretch workloads
+(BASELINE.md stretch rows; ROADMAP item 2).
+
+Three encode entry points:
+
+- :func:`h264_encode_sharded` — I frames (4:2:0 and 4:4:4). Rows are
+  independent; zero collectives.
+- :func:`h264_encode_p_sharded` — P frames. When the motion window (the
+  per-stripe picture bound) nests inside a shard, the program stays
+  collective-free. When a stripe SPANS shards (``single_stream``-style
+  whole-frame windows), the reference planes are exchanged as HALO row
+  bands ahead of the per-shard program and motion is selected against
+  them with the window clamps re-derived from global row indices —
+  bit-identical to the unsharded search (tests/test_stripes.py).
+- the engine's :class:`~selkies_tpu.engine.h264_encoder.
+  StripeShardedH264Session` — the serving path: the full damage-gated
+  adaptive I/P step shard_mapped over whole stripes, each device's rows
+  finalized to the wire as that shard lands.
+
+The per-shard bitstreams meet at the packer seam: each MB row is an
+independent byte-aligned slice NAL, so the shard merge is the degenerate
+(word-aligned) case of the hierarchical bit-merge the packer itself uses
+within a row (ops/bitpack.merge_bit_stacks, PERF.md lever 2 — the same
+per-MB-relative offsets restructure powers both).
 
 Consumes the ``tpu_stripe_devices`` setting.
 """
@@ -21,11 +44,12 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
-from ..ops.h264_encode import H264FrameOut
-from ..ops.h264_planes import h264_encode_yuv
+from ..ops import h264_planes as _planes
+from ..ops.h264_encode import (H264FrameOut, _MV_LAMBDA, _hshift,
+                               _sad_mb16, se_bits)
 
 try:
     from jax import shard_map
@@ -35,43 +59,372 @@ except ImportError:  # pragma: no cover
 logger = logging.getLogger("selkies_tpu.parallel.stripes")
 
 
-def stripe_mesh(n_rows: int, devices: Optional[Sequence] = None) -> Mesh:
-    """1-D ``Mesh('stripe')`` with the largest device count dividing
-    ``n_rows`` (MB rows)."""
-    devs = list(devices) if devices is not None else list(jax.devices())
-    n = min(len(devs), n_rows)
+def _set_stripe_gauge(n: int) -> None:
+    """Export the CHOSEN shard count: a silently degraded mesh (fewer
+    devices than asked — even 1) must be visible on the metrics plane,
+    not just in a log line."""
+    try:
+        from ..server import metrics as _metrics
+        _metrics.set_gauge("selkies_stripe_devices", float(n))
+    except Exception:  # pragma: no cover - metrics plane optional
+        pass
+
+
+def resolved_stripe_devices(n_rows: int, requested: int,
+                            n_avail: Optional[int] = None) -> int:
+    """The shard count :func:`stripe_mesh` would choose — shared with
+    the pre-warm planner so warmed program names always match the live
+    session's (a divergence would warm a program nobody runs)."""
+    if n_avail is None:
+        n_avail = len(jax.devices())
+    want = max(1, min(int(requested), n_avail))
+    n = max(1, min(want, int(n_rows)))
     while n_rows % n:
         n -= 1
+    return n
+
+
+def stripe_mesh(n_rows: int, devices: Optional[Sequence] = None,
+                requested: Optional[int] = None) -> Mesh:
+    """1-D ``Mesh('stripe')`` with the largest device count dividing
+    ``n_rows`` (MB rows), capped at ``requested`` when given.
+
+    Degrading to fewer devices than requested/available is allowed but
+    never silent: the chosen count is logged, exported as the
+    ``selkies_stripe_devices`` gauge, and (via the bench) recorded in
+    the perf-ledger row — a degraded mesh cannot masquerade as a
+    scaling result."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    avail = len(devs)
+    if avail < 1:
+        raise ValueError("stripe_mesh needs at least one device")
+    want = avail if requested is None else max(1, min(int(requested), avail))
+    n = resolved_stripe_devices(n_rows, want, avail)
+    if n < want:
+        logger.warning(
+            "stripe_mesh degraded to %d device(s): %d MB rows not "
+            "divisible by %d (available %d)", n, n_rows, want, avail)
+    else:
+        logger.info("stripe_mesh: %d device(s) over %d MB rows", n, n_rows)
+    _set_stripe_gauge(n)
     return Mesh(np.array(devs[:n]), ("stripe",))
 
+
+# ---------------------------------------------------------------------------
+# geometry validation + row padding
+# ---------------------------------------------------------------------------
+
+def _check_frame(yf: jnp.ndarray, mesh: Mesh) -> tuple:
+    """-> (R, n_dev, pad_rows). Raises ValueError (never a bare assert —
+    asserts vanish under ``python -O``) for geometry the shard layout
+    cannot represent; rounds the MB-row count UP with throwaway pad rows
+    where it can."""
+    H, W = int(yf.shape[0]), int(yf.shape[1])
+    if H % 16 or W % 16:
+        raise ValueError(f"frame {W}x{H} is not macroblock-aligned")
+    n_dev = int(mesh.devices.size)
+    if n_dev < 1:
+        raise ValueError("empty stripe mesh")
+    R = H // 16
+    if n_dev > R:
+        raise ValueError(
+            f"{n_dev} devices over {R} MB rows: more shards than rows")
+    pad_rows = (-R) % n_dev
+    return R, n_dev, pad_rows
+
+
+def _pad0(arr: jnp.ndarray, pad: int) -> jnp.ndarray:
+    """Append ``pad`` zero entries along axis 0 (pixel rows, MB rows or
+    per-row vectors — the unit lives at the call site)."""
+    if pad == 0:
+        return arr
+    return jnp.concatenate(
+        [arr, jnp.zeros((pad,) + arr.shape[1:], arr.dtype)], axis=0)
+
+
+def _enc_mods(fullcolor: bool):
+    if fullcolor:
+        from ..ops import h264_planes444 as _p444
+        return _p444.h264_encode_yuv444, _p444.h264_encode_p_yuv444
+    return _planes.h264_encode_yuv, _planes.h264_encode_p_yuv
+
+
+# ---------------------------------------------------------------------------
+# I frames
+# ---------------------------------------------------------------------------
 
 def h264_encode_sharded(yf: jnp.ndarray, uf: jnp.ndarray, vf: jnp.ndarray,
                         qp, header_pay: jnp.ndarray, header_nb: jnp.ndarray,
                         e_cap: int, w_cap: int, mesh: Mesh,
-                        idr_pic_id=0) -> H264FrameOut:
-    """Shard one frame's MB rows over ``mesh`` and encode; outputs are
-    bit-identical to the unsharded h264_encode_yuv (rows are independent
-    by construction, so the sharded program needs zero collectives)."""
-    H = yf.shape[0]
-    R = H // 16
-    n_dev = mesh.devices.size
-    assert R % n_dev == 0, f"{n_dev} devices do not divide {R} MB rows"
+                        idr_pic_id=0, fullcolor: bool = False,
+                        want_recon: bool = False):
+    """Shard one frame's MB rows over ``mesh`` and I-encode; outputs are
+    bit-identical to the unsharded encoder (rows are independent by
+    construction, so the sharded program needs zero collectives). Row
+    counts that don't divide the mesh are padded with throwaway rows and
+    trimmed from the output."""
+    R, n_dev, pad_rows = _check_frame(yf, mesh)
+    cdiv = 1 if fullcolor else 2
     qp_rows = jnp.broadcast_to(jnp.asarray(qp, jnp.int32), (R,))
     idr_rows = jnp.broadcast_to(jnp.asarray(idr_pic_id, jnp.int32), (R,))
+    hp = jnp.asarray(header_pay)
+    hn = jnp.asarray(header_nb)
+    if hp.shape[0] != R:
+        raise ValueError(
+            f"header events carry {hp.shape[0]} rows, frame has {R}")
+    if pad_rows:
+        yf = _pad0(yf, pad_rows * 16)
+        uf = _pad0(uf, pad_rows * 16 // cdiv)
+        vf = _pad0(vf, pad_rows * 16 // cdiv)
+        qp_rows = _pad0(qp_rows, pad_rows)
+        idr_rows = _pad0(idr_rows, pad_rows)
+        hp = _pad0(hp, pad_rows)
+        hn = _pad0(hn, pad_rows)
+    enc_i, _ = _enc_mods(fullcolor)
 
-    def local(y, u, v, qpv, hp, hn, idr):
-        out = h264_encode_yuv(y, u, v, qpv, hp, hn, e_cap, w_cap,
-                              idr_pic_id=idr)
+    def local(y, u, v, qpv, hpv, hnv, idr):
+        if want_recon:
+            out, rec = enc_i(y, u, v, qpv, hpv, hnv, e_cap, w_cap,
+                             idr_pic_id=idr, want_recon=True)
+            return (out.words, out.total_bits, out.overflow[None],
+                    rec[0], rec[1], rec[2])
+        out = enc_i(y, u, v, qpv, hpv, hnv, e_cap, w_cap, idr_pic_id=idr)
         return out.words, out.total_bits, out.overflow[None]
 
-    row_band = P("stripe")                    # leading dim = rows / bands
+    row_band = P("stripe")
+    plane2 = P("stripe", None)
+    out_specs = (plane2, row_band, row_band)
+    if want_recon:
+        out_specs = out_specs + (plane2, plane2, plane2)
     fn = shard_map(
         local, mesh=mesh,
-        in_specs=(P("stripe", None), P("stripe", None), P("stripe", None),
-                  row_band, P("stripe", None), P("stripe", None), row_band),
-        out_specs=(P("stripe", None), row_band, P("stripe")),
-    )
-    words, bits, overflow = jax.jit(fn)(
-        yf, uf, vf, qp_rows,
-        jnp.asarray(header_pay), jnp.asarray(header_nb), idr_rows)
-    return H264FrameOut(words, bits, jnp.any(overflow), R)
+        in_specs=(plane2, plane2, plane2, row_band, plane2, plane2,
+                  row_band),
+        out_specs=out_specs)
+    outs = jax.jit(fn)(yf, uf, vf, qp_rows, hp, hn, idr_rows)
+    words, bits, ovf = outs[:3]
+    out = H264FrameOut(words[:R], bits[:R], jnp.any(ovf), R)
+    if want_recon:
+        rec = (outs[3][:R * 16], outs[4][:R * 16 // cdiv],
+               outs[5][:R * 16 // cdiv])
+        return out, rec
+    return out
+
+
+# ---------------------------------------------------------------------------
+# P frames: halo-row exchange for motion at shard boundaries
+# ---------------------------------------------------------------------------
+
+def _halo_bands(plane, band_px: int, halo_px: int) -> jnp.ndarray:
+    """(H', W) reference plane -> (n_shards, band + 2*halo, W) bands with
+    ``halo_px`` rows of neighbour context on each side (edge-clamped at
+    the frame bound; the per-candidate STRIPE clamp happens inside the
+    shard and never reads the frame-edge copies). This gather is the
+    halo-row exchange: it runs ahead of the per-shard program, so the
+    program itself stays collective-free."""
+    p = jnp.asarray(plane)
+    Hp = int(p.shape[0])
+    n = Hp // band_px
+    idx = np.clip(np.arange(n)[:, None] * band_px
+                  + np.arange(-halo_px, band_px + halo_px)[None, :],
+                  0, Hp - 1)
+    return jnp.take(p, jnp.asarray(idx), axis=0)
+
+
+def _motion_select_halo(cur_y, hy, hu, hv, qp_rows, candidates,
+                        win: int, row0, halo_y: int, halo_c: int,
+                        fullcolor: bool):
+    """Per-shard motion selection against halo'd reference bands.
+
+    Identical integer math to ops.h264_encode._motion_select — SAD +
+    lambda*mvd-bits argmin, first-candidate tie break — with the
+    vertical clamp re-derived from GLOBAL row indices: a row's shifted
+    source is ``clip(g + dy, window_base, window_base + win - 1)``,
+    which always lands within ``halo`` rows of the shard band, so the
+    gather never leaves the exchanged halo. Bit-exact vs the unsharded
+    search (tests/test_stripes.py halo fixture)."""
+    B, W = cur_y.shape
+    R_l, M = B // 16, W // 16
+    lam = _MV_LAMBDA[jnp.clip(qp_rows, 0, 51)]
+
+    gp = row0 + jnp.arange(B, dtype=jnp.int32)
+    wb = (gp // win) * win
+
+    def vshift_y(dy: int):
+        src = jnp.clip(gp + dy, wb, wb + win - 1)
+        return jnp.take(hy, src - (row0 - halo_y), axis=0)
+
+    if fullcolor:
+        def shift_chroma(p, dy: int, dx: int):
+            src = jnp.clip(gp + dy, wb, wb + win - 1)
+            return _hshift(jnp.take(p, src - (row0 - halo_c), axis=0), dx)
+    else:
+        winc = win // 2
+        c_row0 = row0 // 2
+        gpc = c_row0 + jnp.arange(B // 2, dtype=jnp.int32)
+        wbc = (gpc // winc) * winc
+
+        def s_c(p, a: int, b: int):
+            src = jnp.clip(gpc + a, wbc, wbc + winc - 1)
+            return _hshift(jnp.take(p, src - (c_row0 - halo_c), axis=0), b)
+
+        def shift_chroma(p, dy: int, dx: int):
+            by, fy = dy >> 1, dy & 1
+            bx, fx = dx >> 1, dx & 1
+            if not fy and not fx:
+                return s_c(p, by, bx)
+            if fy and not fx:
+                return (s_c(p, by, bx) + s_c(p, by + 1, bx) + 1) >> 1
+            if fx and not fy:
+                return (s_c(p, by, bx) + s_c(p, by, bx + 1) + 1) >> 1
+            return (s_c(p, by, bx) + s_c(p, by + 1, bx)
+                    + s_c(p, by, bx + 1) + s_c(p, by + 1, bx + 1) + 2) >> 2
+
+    shifted = []
+    costs = []
+    for dy, dx in candidates:
+        sh = _hshift(vshift_y(dy), dx)
+        shifted.append(sh)
+        sad = _sad_mb16(jnp.abs(cur_y - sh))
+        bits = se_bits(4 * dx) + se_bits(4 * dy)
+        costs.append(sad + lam[:, None] * bits)
+    sel = jnp.argmin(jnp.stack(costs), axis=0).astype(jnp.int32)
+
+    sel_y = jnp.broadcast_to(sel[:, None, :, None],
+                             (R_l, 16, M, 16)).reshape(B, W)
+    pred_y = shifted[0]
+    for k in range(1, len(candidates)):
+        pred_y = jnp.where(sel_y == k, shifted[k], pred_y)
+
+    cw = W if fullcolor else W // 2
+    ch = B if fullcolor else B // 2
+    blk = 16 if fullcolor else 8
+    sel_c = jnp.broadcast_to(sel[:, None, :, None],
+                             (R_l, blk, M, blk)).reshape(ch, cw)
+    pred_u = shift_chroma(hu, *candidates[0])
+    pred_v = shift_chroma(hv, *candidates[0])
+    for k, (dy, dx) in enumerate(candidates[1:], 1):
+        pred_u = jnp.where(sel_c == k, shift_chroma(hu, dy, dx), pred_u)
+        pred_v = jnp.where(sel_c == k, shift_chroma(hv, dy, dx), pred_v)
+
+    cand_q = jnp.asarray(np.asarray(candidates, np.int32)[:, ::-1] * 4)
+    return pred_y, pred_u, pred_v, cand_q[sel]
+
+
+def h264_encode_p_sharded(yf, uf, vf, ref_y, ref_u, ref_v, qp,
+                          header_pay, header_nb, frame_num,
+                          e_cap: int, w_cap: int, mesh: Mesh,
+                          candidates: tuple = ((0, 0),),
+                          stripe_rows: int | None = None,
+                          fullcolor: bool = False):
+    """P-frame encode with the frame's MB rows sharded over ``mesh``.
+
+    Bit-identical to the unsharded ``h264_encode_p_yuv[444]`` with the
+    same ``stripe_rows``. Collective-free when each shard holds whole
+    motion windows; when a stripe window spans shards the reference
+    planes are exchanged as halo row bands ahead of the per-shard
+    program (see :func:`_motion_select_halo`). Returns
+    ``(H264FrameOut, (recon_y, recon_u, recon_v))``."""
+    R, n_dev, pad_rows = _check_frame(yf, mesh)
+    cdiv = 1 if fullcolor else 2
+    win_rows = int(stripe_rows) if stripe_rows else R
+    if R % win_rows:
+        raise ValueError(f"stripe_rows={win_rows} does not tile {R} rows")
+    qp_rows = jnp.broadcast_to(jnp.asarray(qp, jnp.int32), (R,))
+    fn_rows = jnp.broadcast_to(jnp.asarray(frame_num, jnp.int32), (R,))
+    hp = jnp.asarray(header_pay)
+    hn = jnp.asarray(header_nb)
+    if hp.shape[0] != R:
+        raise ValueError(
+            f"header events carry {hp.shape[0]} rows, frame has {R}")
+
+    rows_per_shard = (R + pad_rows) // n_dev
+    motion = len(candidates) > 1
+    aligned = rows_per_shard % win_rows == 0
+    need_halo = motion and not aligned
+    if need_halo and pad_rows:
+        raise ValueError(
+            f"{n_dev} devices do not divide {R} MB rows and the motion "
+            f"window ({win_rows} rows) spans shards: no pad geometry "
+            "exists — choose a dividing device count")
+    if pad_rows:
+        yf = _pad0(yf, pad_rows * 16)
+        uf = _pad0(uf, pad_rows * 16 // cdiv)
+        vf = _pad0(vf, pad_rows * 16 // cdiv)
+        ref_y = _pad0(jnp.asarray(ref_y), pad_rows * 16)
+        ref_u = _pad0(jnp.asarray(ref_u), pad_rows * 16 // cdiv)
+        ref_v = _pad0(jnp.asarray(ref_v), pad_rows * 16 // cdiv)
+        qp_rows = _pad0(qp_rows, pad_rows)
+        fn_rows = _pad0(fn_rows, pad_rows)
+        hp = _pad0(hp, pad_rows)
+        hn = _pad0(hn, pad_rows)
+    _, enc_p = _enc_mods(fullcolor)
+    row_band = P("stripe")
+    plane2 = P("stripe", None)
+
+    if not need_halo:
+        # whole windows per shard: pure SPMD, no exchanged rows at all
+        local_stripe_rows = win_rows if motion else None
+
+        def local(y, u, v, ry, ru, rv, qpv, hpv, hnv, fnv):
+            out, rec = enc_p(y, u, v, ry, ru, rv, qpv, hpv, hnv, fnv,
+                             e_cap, w_cap, candidates=candidates,
+                             stripe_rows=local_stripe_rows)
+            return (out.words, out.total_bits, out.overflow[None],
+                    rec[0], rec[1], rec[2])
+
+        fn = shard_map(
+            local, mesh=mesh,
+            in_specs=(plane2,) * 6 + (row_band, plane2, plane2, row_band),
+            out_specs=(plane2, row_band, row_band, plane2, plane2,
+                       plane2))
+        outs = jax.jit(fn)(yf, uf, vf, jnp.asarray(ref_y),
+                           jnp.asarray(ref_u), jnp.asarray(ref_v),
+                           qp_rows, hp, hn, fn_rows)
+    else:
+        band = rows_per_shard * 16
+        band_c = band // cdiv
+        vmax = max(abs(dy) for dy, _ in candidates)
+        halo_y = max(1, vmax)
+        halo_c = halo_y if fullcolor else (vmax // 2 + 1)
+        hy = _halo_bands(jnp.asarray(ref_y).astype(jnp.int32), band,
+                         halo_y)
+        hu = _halo_bands(jnp.asarray(ref_u).astype(jnp.int32), band_c,
+                         halo_c)
+        hv = _halo_bands(jnp.asarray(ref_v).astype(jnp.int32), band_c,
+                         halo_c)
+        win = win_rows * 16
+
+        def local(y, u, v, hy_l, hu_l, hv_l, qpv, hpv, hnv, fnv):
+            hy_l, hu_l, hv_l = hy_l[0], hu_l[0], hv_l[0]
+            row0 = jax.lax.axis_index("stripe").astype(jnp.int32) * band
+            pre = _motion_select_halo(
+                y.astype(jnp.int32), hy_l, hu_l, hv_l, qpv, candidates,
+                win, row0, halo_y, halo_c, fullcolor)
+            # the ref args are unused with precomputed motion; the halo
+            # band centres have the right shapes and keep XLA from
+            # carrying a second copy of the reference
+            ry = hy_l[halo_y:halo_y + band]
+            ru = hu_l[halo_c:halo_c + band_c]
+            rv = hv_l[halo_c:halo_c + band_c]
+            out, rec = enc_p(y, u, v, ry, ru, rv, qpv, hpv, hnv, fnv,
+                             e_cap, w_cap, candidates=candidates,
+                             precomputed_motion=pre)
+            return (out.words, out.total_bits, out.overflow[None],
+                    rec[0], rec[1], rec[2])
+
+        plane3 = P("stripe", None, None)
+        fn = shard_map(
+            local, mesh=mesh,
+            in_specs=(plane2, plane2, plane2, plane3, plane3, plane3,
+                      row_band, plane2, plane2, row_band),
+            out_specs=(plane2, row_band, row_band, plane2, plane2,
+                       plane2))
+        outs = jax.jit(fn)(yf, uf, vf, hy, hu, hv, qp_rows, hp, hn,
+                           fn_rows)
+
+    words, bits, ovf = outs[:3]
+    out = H264FrameOut(words[:R], bits[:R], jnp.any(ovf), R)
+    rec = (outs[3][:R * 16], outs[4][:R * 16 // cdiv],
+           outs[5][:R * 16 // cdiv])
+    return out, rec

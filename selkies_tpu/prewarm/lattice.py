@@ -49,6 +49,10 @@ class Signature:
     codec: str                      # "jpeg" | "h264"
     quality_tier: str = "base"      # metadata only: NOT compile identity
     seats: int = 1
+    #: split-frame device parallelism (ROADMAP 2): stripes of ONE
+    #: session's frame sharded over this many devices. >1 selects the
+    #: shard_map-wrapped step — a distinct compiled program
+    stripe_devices: int = 1
     fullcolor: bool = False
     stripe_height: int = 64
     single_stream: bool = False
@@ -64,6 +68,8 @@ class Signature:
         s = self
         parts = [f"{s.width}x{s.height}", s.codec, f"seats{s.seats}",
                  f"stripe{s.stripe_height}"]
+        if s.stripe_devices > 1:
+            parts.append(f"stripes{s.stripe_devices}")
         if s.fullcolor:
             parts.append("444")
         if s.single_stream:
@@ -198,6 +204,8 @@ def lattice_from_settings(settings,
         height=int(g("initial_height", 1080)),
         codec="jpeg" if encoder.startswith("jpeg") else "h264",
         seats=max(1, int(g("tpu_seats", 1))),
+        stripe_devices=max(1, int(g("tpu_stripe_devices", 1)))
+        if not encoder.startswith("jpeg") else 1,
         fullcolor=bool(g("fullcolor", False)),
         stripe_height=int(g("stripe_height", 64)),
         single_stream=(encoder == "h264-tpu"),

@@ -49,6 +49,7 @@ def capture_settings_for(sig: Signature):
     return CaptureSettings(
         capture_width=sig.width, capture_height=sig.height,
         output_mode=sig.codec, fullcolor=sig.fullcolor,
+        stripe_devices=max(1, int(getattr(sig, "stripe_devices", 1))),
         stripe_height=sig.stripe_height, single_stream=sig.single_stream,
         use_damage_gating=sig.use_damage_gating,
         use_paint_over=sig.use_paint_over,
@@ -75,6 +76,15 @@ def program_names(sig: Signature) -> list:
         return [f"h264.seats{sig.seats}_{m}_step[{g.width}x{g.height}]"
                 for m in ("i", "p")]
     tag = "@444" if sig.fullcolor else ""
+    if getattr(sig, "stripe_devices", 1) > 1:
+        # the live session DEGRADES to the largest dividing count; the
+        # warm must predict the same choice or it warms a ghost program
+        from ..parallel.stripes import resolved_stripe_devices
+        n = resolved_stripe_devices(g.n_stripes, sig.stripe_devices)
+        if n > 1:
+            return [f"h264.stripes{n}.{m}_step"
+                    f"[{g.width}x{g.stripe_h * g.n_stripes}{tag}]"
+                    for m in ("i", "p")]
     return [f"h264.{m}_step[{g.width}x{g.stripe_h * g.n_stripes}{tag}]"
             for m in ("i", "p")]
 
@@ -159,6 +169,49 @@ def _warm_h264(sig: Signature) -> list:
     return names
 
 
+def _warm_h264_stripes(sig: Signature, n_dev: int) -> list:
+    """AOT-compile the split-frame sharded i/p steps (ROADMAP 2): same
+    aval surface as the single-device warm, through the SAME
+    ``_jitted_h264_sharded_step`` factory the live session uses."""
+    import jax.numpy as jnp
+
+    from ..engine import h264_encoder as _h
+    from ..engine.capture import _ENCODE_TURN
+    from ..ops.h264_encode import scroll_candidates
+    cs = capture_settings_for(sig)
+    g = _h.plan_h264_grid(cs)
+    e_cap, w_cap, out_cap = _h.h264_buffer_caps(g, sig.fullcolor)
+    out_cap_local = -(-out_cap // n_dev)
+    vr, hr = max(0, sig.h264_motion_vrange), max(0, sig.h264_motion_hrange)
+    cdiv = 1 if sig.fullcolor else 2
+    frame = _aval((g.height, g.width, 3), jnp.uint8)
+    svec = _aval((g.n_stripes,), jnp.int32)
+    ref_y = _aval((g.height, g.width), jnp.uint8)
+    ref_c = _aval((g.height // cdiv, g.width // cdiv), jnp.uint8)
+    with _ENCODE_TURN:
+        hdr_pay, hdr_nb, p_hdr_pay, p_hdr_nb = _h264_headers(
+            g, g.n_stripes)
+        qp = jnp.int32(0)
+        force = jnp.asarray(True)
+    names = []
+    for mode in ("i", "p"):
+        cands = scroll_candidates(vr, hr) if (mode == "p" and vr) \
+            else ((0, 0),)
+        step = _h._jitted_h264_sharded_step(
+            mode, g.width, g.stripe_h, g.n_stripes, e_cap, w_cap,
+            out_cap_local, cs.paint_over_delay_frames,
+            cs.use_damage_gating, cs.use_paint_over, candidates=cands,
+            fullcolor=sig.fullcolor, n_dev=n_dev)
+        pay, nb = (hdr_pay, hdr_nb) if mode == "i" \
+            else (p_hdr_pay, p_hdr_nb)
+        if not step.warm((frame, frame, svec, svec, svec,
+                          ref_y, ref_c, ref_c, qp, qp, force, pay, nb)):
+            raise RuntimeError(f"h264 sharded {mode} step warm failed "
+                               "(see obs.perf log)")
+        names.append(step.name)
+    return names
+
+
 def _warm_jpeg_seats(sig: Signature) -> list:
     import jax.numpy as jnp
 
@@ -229,5 +282,14 @@ def warm_signature(sig: Signature) -> dict:
         with _seat_lock:
             _seat_warmed.add(key)
         return {"programs": names}
+    if sig.codec != "jpeg" and getattr(sig, "stripe_devices", 1) > 1:
+        from ..engine.h264_encoder import plan_h264_grid
+        from ..parallel.stripes import resolved_stripe_devices
+        g = plan_h264_grid(capture_settings_for(sig))
+        n = resolved_stripe_devices(g.n_stripes, sig.stripe_devices)
+        if n > 1:
+            return {"programs": _warm_h264_stripes(sig, n)}
+        # degraded all the way to one device: the plain program IS the
+        # operating point — fall through to the single-device warm
     names = _warm_jpeg(sig) if sig.codec == "jpeg" else _warm_h264(sig)
     return {"programs": names}

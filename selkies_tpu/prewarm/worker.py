@@ -417,15 +417,23 @@ class PrewarmWorker:
         """Sorted ``"WxH"`` strings whose every tracked program is warm
         (or skipped) — the fleet heartbeat's warm-host signal: the
         scheduler scores a host up when a session's geometry appears
-        here (placing there costs no foreground compile)."""
+        here (placing there costs no foreground compile).
+
+        Split-frame sharded operating points (ROADMAP 2) advertise as
+        ``"WxH@sN"`` entries so a stripe-sharded warm is schedulable
+        capacity in its own right and never masquerades as (or hides)
+        the single-device program at the same geometry."""
         by_geo: dict = {}
         with self._lock:
             for e in self._entries.values():
-                geo = (e["sig"].width, e["sig"].height)
+                sig = e["sig"]
+                geo = (sig.width, sig.height,
+                       max(1, int(getattr(sig, "stripe_devices", 1))))
                 ok_ = e["state"] in (WARM, SKIPPED)
                 by_geo[geo] = by_geo.get(geo, True) and ok_
-        return sorted(f"{w}x{h}" for (w, h), ok_ in by_geo.items()
-                      if ok_)
+        return sorted(
+            (f"{w}x{h}" if sd <= 1 else f"{w}x{h}@s{sd}")
+            for (w, h, sd), ok_ in by_geo.items() if ok_)
 
     def current_op_ready(self):
         """The ``prewarm_ready`` routing-gate verdict (ISSUE 11 /

@@ -730,6 +730,7 @@ class WebSocketsService(BaseStreamingService):
             use_paint_over=s.use_paint_over,
             paint_over_quality=s.paint_over_quality,
             stripe_height=s.stripe_height,
+            stripe_devices=int(getattr(s, "tpu_stripe_devices", 1)),
             pipeline_depth=int(getattr(s, "pipeline_depth", 2)),
             stripe_streaming=bool(getattr(s, "stripe_streaming", True)),
             h264_motion_vrange=s.h264_motion_vrange,

@@ -1,0 +1,485 @@
+"""Split-frame device parallelism (ROADMAP 2 / ISSUE 12): one session's
+frame sharded across the virtual 8-device CPU mesh, merged by the
+hierarchical bit-merge packer.
+
+Covers the three layers the tentpole touches:
+
+- ops/bitpack: the bit-merge packer's equivalence with the scatter (and
+  gather) formulations on randomized event stacks;
+- parallel/stripes: sharded-vs-unsharded BYTE identity for I and P
+  frames (incl. the 4:4:4 path) across 1/2/4 devices, the
+  halo-correctness fixture with motion AT a shard boundary, mesh
+  degradation (logged, gauged, never silent), and the
+  ValueError/padding contract;
+- engine: the StripeShardedH264Session emits byte-identical chunks on
+  both finalize paths, and the fleet heartbeat advertises stripe-sharded
+  warm geometries.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from selkies_tpu.codecs import h264 as H
+from selkies_tpu.ops import h264_planes as PL
+from selkies_tpu.ops.bitpack import (pack_slot_events,
+                                     pack_slot_events_bitmerge,
+                                     pack_slot_events_scatter,
+                                     words_to_bytes)
+from selkies_tpu.ops.h264_encode import (P_SLOTS_MB, SLOTS_MB,
+                                         scroll_candidates)
+from selkies_tpu.parallel.stripes import (h264_encode_p_sharded,
+                                          h264_encode_sharded,
+                                          resolved_stripe_devices,
+                                          stripe_mesh)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical bit-merge packer vs scatter/gather
+# ---------------------------------------------------------------------------
+
+def _random_events(rng, m, s, max_bits=28, sparsity=0.4):
+    nb = rng.integers(0, max_bits + 1, (m, s)).astype(np.int32)
+    nb[rng.random((m, s)) < sparsity] = 0
+    pay = np.zeros((m, s), np.uint32)
+    mask = nb > 0
+    vals = rng.integers(0, 1 << 30, int(mask.sum())).astype(np.uint32)
+    pay[mask] = vals & ((np.uint32(1) << nb[mask].astype(np.uint32))
+                        - np.uint32(1))
+    return pay, nb
+
+
+@pytest.mark.parametrize("m,s", [(1, 7), (3, 33), (8, 61), (120, 16)])
+def test_bitmerge_packer_equals_scatter_and_gather(m, s):
+    rng = np.random.default_rng(m * 1000 + s)
+    pay, nb = _random_events(rng, m, s)
+    e_cap = m * s + 4
+    w_cap = max(8, (int(nb.sum()) + 31) // 32 + 2)
+    outs = [f(jnp.asarray(pay), jnp.asarray(nb), e_cap, w_cap, 33)
+            for f in (pack_slot_events_scatter, pack_slot_events_bitmerge,
+                      pack_slot_events)]
+    ref = outs[0]
+    for o in outs[1:]:
+        assert int(o.total_bits) == int(ref.total_bits)
+        assert int(o.n_events) == int(ref.n_events)
+        assert bool(o.overflow) == bool(ref.overflow)
+        assert np.array_equal(np.asarray(o.words), np.asarray(ref.words))
+
+
+def test_bitmerge_packer_one_bit_codes_and_overflow():
+    # all-ones 1-bit events: the worst straddle density; then a word cap
+    # too small must flag overflow on both formulations identically
+    m, s = 2, 70
+    pay = np.ones((m, s), np.uint32)
+    nb = np.ones((m, s), np.int32)
+    a = pack_slot_events_scatter(jnp.asarray(pay), jnp.asarray(nb),
+                                 m * s, 8, 33)
+    b = pack_slot_events_bitmerge(jnp.asarray(pay), jnp.asarray(nb),
+                                  m * s, 8, 33)
+    assert not bool(a.overflow) and not bool(b.overflow)
+    assert np.array_equal(np.asarray(a.words), np.asarray(b.words))
+    a2 = pack_slot_events_scatter(jnp.asarray(pay), jnp.asarray(nb),
+                                  m * s, 2, 33)
+    b2 = pack_slot_events_bitmerge(jnp.asarray(pay), jnp.asarray(nb),
+                                   m * s, 2, 33)
+    assert bool(a2.overflow) and bool(b2.overflow)
+
+
+def _sink_strategy_frames(monkeypatch, with_p: bool):
+    """Encode the same content under both sink strategies; -> list of
+    (bitmerge, scatter) H264FrameOut pairs."""
+    rng = np.random.default_rng(21)
+    h, w = 32, 32
+    R, M = h // 16, w // 16
+    y = rng.integers(0, 256, (h, w)).astype(np.int32)
+    u = rng.integers(0, 256, (h // 2, w // 2)).astype(np.int32)
+    v = rng.integers(0, 256, (h // 2, w // 2)).astype(np.int32)
+    pay, nb = H.slice_header_events(M, R)
+    ppay, pnb = H.p_slice_header_events(M, R)
+    e_cap = 9 + M * max(SLOTS_MB, P_SLOTS_MB) + 2
+    w_cap = 4096
+
+    def run():
+        out, rec = PL.h264_encode_yuv(
+            jnp.asarray(y), jnp.asarray(u), jnp.asarray(v), 26,
+            jnp.asarray(pay), jnp.asarray(nb), e_cap, w_cap,
+            want_recon=True)
+        if not with_p:
+            return [out]
+        y1 = np.roll(y, 2, axis=0)
+        pout, _ = PL.h264_encode_p_yuv(
+            jnp.asarray(y1), jnp.asarray(u), jnp.asarray(v),
+            rec[0], rec[1], rec[2], 26, jnp.asarray(ppay),
+            jnp.asarray(pnb), 1, e_cap, w_cap,
+            candidates=((0, 0), (2, 0)), stripe_rows=1)
+        return [out, pout]
+
+    monkeypatch.setenv("SELKIES_PACKER", "bitmerge")
+    bm = run()
+    monkeypatch.setenv("SELKIES_PACKER", "scatter")
+    sc = run()
+    return list(zip(bm, sc))
+
+
+def _assert_same_out(pairs):
+    for a, b in pairs:
+        assert np.array_equal(np.asarray(a.total_bits),
+                              np.asarray(b.total_bits))
+        assert np.array_equal(np.asarray(a.words), np.asarray(b.words))
+
+
+def test_event_sink_bitmerge_strategy_bit_identical_i(monkeypatch):
+    """The production event sink's bitmerge strategy (per-MB stacks,
+    log2(M) merges) must produce the scatter strategy's exact words."""
+    _assert_same_out(_sink_strategy_frames(monkeypatch, with_p=False))
+
+
+@pytest.mark.slow
+def test_event_sink_bitmerge_strategy_bit_identical_p(monkeypatch):
+    """P variant (tail events: trailing skip run + stop bit)."""
+    _assert_same_out(_sink_strategy_frames(monkeypatch, with_p=True)[1:])
+
+
+# ---------------------------------------------------------------------------
+# sharded-vs-unsharded byte identity (ops layer)
+# ---------------------------------------------------------------------------
+
+def _rows_bytes(out):
+    w = np.asarray(out.words)
+    b = np.asarray(out.total_bits)
+    return [words_to_bytes(w[r], int(b[r]), pad_ones=False)
+            for r in range(w.shape[0])]
+
+
+def _yuv420(rng, h, w):
+    return (rng.integers(0, 256, (h, w)).astype(np.int32),
+            rng.integers(0, 256, (h // 2, w // 2)).astype(np.int32),
+            rng.integers(0, 256, (h // 2, w // 2)).astype(np.int32))
+
+
+@pytest.fixture(scope="module")
+def i_fixture():
+    """Shared eager (un-jitted) I reference for the 1/2/4-device
+    parametrization — computed once."""
+    rng = np.random.default_rng(11)
+    h, w = 64, 48
+    R, M = h // 16, w // 16
+    y, u, v = _yuv420(rng, h, w)
+    pay, nb = H.slice_header_events(M, R)
+    e_cap = 7 + M * SLOTS_MB + 1
+    w_cap = 4096
+    ref = PL.h264_encode_yuv(jnp.asarray(y), jnp.asarray(u),
+                             jnp.asarray(v), 26, jnp.asarray(pay),
+                             jnp.asarray(nb), e_cap, w_cap)
+    return dict(R=R, y=y, u=u, v=v, pay=pay, nb=nb, e_cap=e_cap,
+                w_cap=w_cap, ref_bytes=_rows_bytes(ref),
+                ref_bits=np.asarray(ref.total_bits))
+
+
+@pytest.mark.parametrize(
+    "ndev", [pytest.param(1, marks=pytest.mark.slow), 2, 4])
+def test_i_frame_sharded_byte_identity(i_fixture, ndev):
+    fx = i_fixture
+    mesh = stripe_mesh(fx["R"], devices=jax.devices()[:ndev])
+    assert mesh.devices.size == ndev
+    out = h264_encode_sharded(jnp.asarray(fx["y"]), jnp.asarray(fx["u"]),
+                              jnp.asarray(fx["v"]), 26, fx["pay"],
+                              fx["nb"], fx["e_cap"], fx["w_cap"], mesh)
+    assert np.array_equal(fx["ref_bits"], np.asarray(out.total_bits))
+    assert fx["ref_bytes"] == _rows_bytes(out)
+
+
+@pytest.fixture(scope="module")
+def p_fixture():
+    """Shared I-frame recon + scrolled next frame for the P tests; the
+    scroll amount (3 px) crosses the 2-shard boundary of a 4-row frame,
+    so motion at the boundary only resolves through halo rows."""
+    rng = np.random.default_rng(7)
+    h, w = 64, 48
+    R, M = h // 16, w // 16
+    y0, u0, v0 = _yuv420(rng, h, w)
+    pay, nb = H.slice_header_events(M, R)
+    ppay, pnb = H.p_slice_header_events(M, R)
+    e_cap = 9 + M * max(SLOTS_MB, P_SLOTS_MB) + 2
+    w_cap = 4096
+    _, rec = PL.h264_encode_yuv(jnp.asarray(y0), jnp.asarray(u0),
+                                jnp.asarray(v0), 26, jnp.asarray(pay),
+                                jnp.asarray(nb), e_cap, w_cap,
+                                want_recon=True)
+    y1 = np.roll(y0, 3, axis=0)
+    u1 = np.roll(u0, 1, axis=0)
+    v1 = np.roll(v0, 1, axis=0)
+    # dy=3 matches the roll AND reaches across the 2-shard boundary;
+    # kept small — candidate count scales the unrolled motion graph
+    cands = ((0, 0), (3, 0), (-1, 0), (0, 1))
+    return dict(R=R, M=M, rec=rec, y1=y1, u1=u1, v1=v1, ppay=ppay,
+                pnb=pnb, e_cap=e_cap, w_cap=w_cap, cands=cands)
+
+
+def _p_ref(fx, stripe_rows):
+    out, rec = PL.h264_encode_p_yuv(
+        jnp.asarray(fx["y1"]), jnp.asarray(fx["u1"]),
+        jnp.asarray(fx["v1"]), fx["rec"][0], fx["rec"][1], fx["rec"][2],
+        26, jnp.asarray(fx["ppay"]), jnp.asarray(fx["pnb"]), 1,
+        fx["e_cap"], fx["w_cap"], candidates=fx["cands"],
+        stripe_rows=stripe_rows)
+    return out, rec
+
+
+def test_p_frame_sharded_aligned_byte_identity(p_fixture):
+    """Whole motion windows per shard: collective-free, no halo."""
+    fx = p_fixture
+    ref, ref_rec = _p_ref(fx, stripe_rows=2)
+    mesh = stripe_mesh(fx["R"], devices=jax.devices()[:2])
+    out, rec = h264_encode_p_sharded(
+        jnp.asarray(fx["y1"]), jnp.asarray(fx["u1"]),
+        jnp.asarray(fx["v1"]), fx["rec"][0], fx["rec"][1], fx["rec"][2],
+        26, fx["ppay"], fx["pnb"], 1, fx["e_cap"], fx["w_cap"], mesh,
+        candidates=fx["cands"], stripe_rows=2)
+    assert _rows_bytes(ref) == _rows_bytes(out)
+    for a, b in zip(ref_rec, rec):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_p_frame_sharded_halo_byte_identity(p_fixture):
+    """Halo-correctness: the motion window is the WHOLE frame
+    (stripe_rows=4), so the 2-shard boundary cuts the window and the
+    vertical scroll's best candidate reaches across it — resolvable
+    only through the exchanged halo rows. Output must still be
+    byte-identical to the unsharded search."""
+    fx = p_fixture
+    ref, _ = _p_ref(fx, stripe_rows=4)
+    mesh = stripe_mesh(fx["R"], devices=jax.devices()[:2])
+    out, _ = h264_encode_p_sharded(
+        jnp.asarray(fx["y1"]), jnp.asarray(fx["u1"]),
+        jnp.asarray(fx["v1"]), fx["rec"][0], fx["rec"][1], fx["rec"][2],
+        26, fx["ppay"], fx["pnb"], 1, fx["e_cap"], fx["w_cap"], mesh,
+        candidates=fx["cands"], stripe_rows=4)
+    assert np.array_equal(np.asarray(ref.total_bits),
+                          np.asarray(out.total_bits))
+    assert _rows_bytes(ref) == _rows_bytes(out)
+    # the halo actually mattered: without motion candidates the same
+    # frame costs far more bits (the scroll is only cheap via MVs,
+    # whose search reaches across the shard boundary) — eager unsharded
+    # run, no extra compile
+    no_mv, _ = PL.h264_encode_p_yuv(
+        jnp.asarray(fx["y1"]), jnp.asarray(fx["u1"]),
+        jnp.asarray(fx["v1"]), fx["rec"][0], fx["rec"][1], fx["rec"][2],
+        26, jnp.asarray(fx["ppay"]), jnp.asarray(fx["pnb"]), 1,
+        fx["e_cap"], fx["w_cap"], candidates=((0, 0),))
+    assert int(np.asarray(out.total_bits).sum()) < \
+        int(np.asarray(no_mv.total_bits).sum())
+
+
+@pytest.mark.slow
+def test_444_sharded_i_and_p_byte_identity():
+    from selkies_tpu.ops.h264_planes444 import (P_SLOTS_MB_444,
+                                                SLOTS_MB_444,
+                                                h264_encode_p_yuv444,
+                                                h264_encode_yuv444)
+    rng = np.random.default_rng(9)
+    h, w = 64, 32
+    R, M = h // 16, w // 16
+    y = rng.integers(0, 256, (h, w)).astype(np.int32)
+    u = rng.integers(0, 256, (h, w)).astype(np.int32)
+    v = rng.integers(0, 256, (h, w)).astype(np.int32)
+    pay, nb = H.slice_header_events(M, R)
+    ppay, pnb = H.p_slice_header_events(M, R)
+    e_cap = 9 + M * max(SLOTS_MB_444, P_SLOTS_MB_444) + 2
+    w_cap = 6144
+    ref, rec = h264_encode_yuv444(
+        jnp.asarray(y), jnp.asarray(u), jnp.asarray(v), 26,
+        jnp.asarray(pay), jnp.asarray(nb), e_cap, w_cap, want_recon=True)
+    mesh = stripe_mesh(R, devices=jax.devices()[:4])
+    out, rec_sh = h264_encode_sharded(
+        jnp.asarray(y), jnp.asarray(u), jnp.asarray(v), 26, pay, nb,
+        e_cap, w_cap, mesh, fullcolor=True, want_recon=True)
+    assert _rows_bytes(ref) == _rows_bytes(out)
+    for a, b in zip(rec, rec_sh):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # P with whole-frame window over 4 shards: the 4:4:4 halo path
+    y1 = np.roll(y, 2, axis=0)
+    u1 = np.roll(u, 2, axis=0)
+    v1 = np.roll(v, 2, axis=0)
+    cands = ((0, 0), (2, 0), (0, 1))
+    p_ref, _ = h264_encode_p_yuv444(
+        jnp.asarray(y1), jnp.asarray(u1), jnp.asarray(v1),
+        rec[0], rec[1], rec[2], 26, jnp.asarray(ppay), jnp.asarray(pnb),
+        1, e_cap, w_cap, candidates=cands, stripe_rows=4)
+    p_sh, _ = h264_encode_p_sharded(
+        jnp.asarray(y1), jnp.asarray(u1), jnp.asarray(v1),
+        rec[0], rec[1], rec[2], 26, ppay, pnb, 1, e_cap, w_cap, mesh,
+        candidates=cands, stripe_rows=4, fullcolor=True)
+    assert _rows_bytes(p_ref) == _rows_bytes(p_sh)
+
+
+# ---------------------------------------------------------------------------
+# mesh degradation / ValueError / padding
+# ---------------------------------------------------------------------------
+
+def test_stripe_mesh_degrades_loudly(caplog):
+    import logging
+    from selkies_tpu.server import metrics
+    with caplog.at_level(logging.WARNING,
+                         logger="selkies_tpu.parallel.stripes"):
+        mesh = stripe_mesh(5, requested=4)     # 5 rows: only 1 divides
+    assert mesh.devices.size == 1
+    assert any("degraded" in r.message for r in caplog.records)
+    # the chosen count is a gauge, never only a log line
+    assert metrics._gauges.get(("selkies_stripe_devices", ())) == 1.0
+    assert resolved_stripe_devices(5, 4) == 1
+    assert resolved_stripe_devices(6, 4) == 3
+    assert resolved_stripe_devices(8, 4) == 4
+
+
+def test_sharded_geometry_value_errors():
+    rng = np.random.default_rng(0)
+    mesh = stripe_mesh(4, devices=jax.devices()[:2])
+    y = rng.integers(0, 256, (40, 48)).astype(np.int32)   # not MB-aligned
+    u = rng.integers(0, 256, (20, 24)).astype(np.int32)
+    with pytest.raises(ValueError, match="macroblock"):
+        h264_encode_sharded(jnp.asarray(y), jnp.asarray(u),
+                            jnp.asarray(u), 26, np.zeros((2, 2)),
+                            np.zeros((2, 2)), 64, 64, mesh)
+    y4, u4, v4 = _yuv420(rng, 64, 48)
+    bad_hdr = np.zeros((2, 2), np.uint32)     # 4 rows need 4 header rows
+    with pytest.raises(ValueError, match="header"):
+        h264_encode_sharded(jnp.asarray(y4), jnp.asarray(u4),
+                            jnp.asarray(v4), 26, bad_hdr, bad_hdr,
+                            64, 64, mesh)
+    mesh8 = stripe_mesh(1)
+    y1, u1, v1 = _yuv420(rng, 16, 16)
+    from jax.sharding import Mesh
+    too_many = Mesh(np.array(jax.devices()[:2]), ("stripe",))
+    with pytest.raises(ValueError, match="more shards than rows"):
+        h264_encode_sharded(jnp.asarray(y1), jnp.asarray(u1),
+                            jnp.asarray(v1), 26, np.zeros((1, 2)),
+                            np.zeros((1, 2)), 64, 64, too_many)
+    del mesh8
+
+
+@pytest.mark.slow
+def test_sharded_pads_non_dividing_rows():
+    """3 MB rows over 2 devices: padded to 4, output trimmed, bytes
+    identical to the unsharded encode. (The pad-count math and the
+    ValueError surface stay in the fast suite —
+    test_sharded_geometry_value_errors; this compiles the padded
+    program end to end.)"""
+    rng = np.random.default_rng(13)
+    h, w = 48, 32
+    R, M = h // 16, w // 16
+    y, u, v = _yuv420(rng, h, w)
+    pay, nb = H.slice_header_events(M, R)
+    e_cap = 9 + M * SLOTS_MB + 2
+    ref = PL.h264_encode_yuv(jnp.asarray(y), jnp.asarray(u),
+                             jnp.asarray(v), 26, jnp.asarray(pay),
+                             jnp.asarray(nb), e_cap, 4096)
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:2]), ("stripe",))
+    out = h264_encode_sharded(jnp.asarray(y), jnp.asarray(u),
+                              jnp.asarray(v), 26, pay, nb, e_cap, 4096,
+                              mesh)
+    assert out.words.shape[0] == R
+    assert _rows_bytes(ref) == _rows_bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# engine session
+# ---------------------------------------------------------------------------
+
+def _session_frames(n, w, h, seed=0):
+    rng = np.random.default_rng(seed)
+    f0 = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+    out = [f0]
+    for _ in range(1, n):
+        f = np.roll(out[-1], 5, axis=0)
+        f[:5] = rng.integers(0, 256, (5, w, 3), dtype=np.uint8)
+        out.append(f)
+    return out
+
+
+@pytest.mark.slow
+def test_stripe_sharded_session_byte_identity():
+    """The serving path: sharded session == plain session, chunk for
+    chunk, on an IDR + P sequence with damage, on BOTH finalize paths
+    (the stripe-streaming one composes with the PR-10 pipeline).
+
+    ``slow`` (4 session-scale XLA builds): the stripe-bench CI job runs
+    this same session-level byte-identity contract at 1 vs 4 shards on
+    every push via ``bench.py --stripes``."""
+    from selkies_tpu.engine.h264_encoder import (H264EncoderSession,
+                                                 StripeShardedH264Session)
+    from selkies_tpu.engine.types import CaptureSettings
+    kw = dict(capture_width=64, capture_height=64, stripe_height=16,
+              output_mode="h264", video_crf=28, use_paint_over=False,
+              h264_motion_vrange=2, h264_motion_hrange=1)
+    ref = H264EncoderSession(CaptureSettings(**kw))
+    sh = StripeShardedH264Session(
+        CaptureSettings(**kw, stripe_devices=4))
+    assert sh.stripe_devices == 4
+    for t, f in enumerate(_session_frames(3, 64, 64)):
+        a = ref.finalize(ref.encode(jnp.asarray(f)))
+        b = list(sh.finalize_stream(sh.encode(jnp.asarray(f))))
+        assert [(c.stripe_y, c.is_idr, c.payload) for c in a] == \
+            [(c.stripe_y, c.is_idr, c.payload) for c in b], f"frame {t}"
+
+
+def test_stripe_sharded_session_degrades_to_dividing_count():
+    from selkies_tpu.engine.h264_encoder import StripeShardedH264Session
+    from selkies_tpu.engine.types import CaptureSettings
+    # 96 px / 32 px stripes = 3 stripes: requested 4 -> chosen 3
+    sess = StripeShardedH264Session(CaptureSettings(
+        capture_width=48, capture_height=96, stripe_height=32,
+        output_mode="h264", video_crf=28, use_paint_over=False,
+        stripe_devices=4))
+    assert sess.stripe_devices == 3
+
+
+# ---------------------------------------------------------------------------
+# fleet / prewarm surface
+# ---------------------------------------------------------------------------
+
+def test_warm_geometry_stripe_suffix_roundtrip():
+    import json
+    from selkies_tpu.fleet.protocol import (FleetProtocolError,
+                                            parse_heartbeat)
+    hb = {"v": 1, "kind": "heartbeat", "host_id": "h1", "url": "u",
+          "fingerprint": "f", "seq": 1, "ts": 1.0, "started_at": 1.0,
+          "ready": True, "draining": False, "health": "ok",
+          "slo": {"status": "ok", "fast_burn": None}, "devices": [],
+          "sessions": [],
+          "warm_geometries": ["1920x1080", "1920x1080@s4"]}
+    p = parse_heartbeat(json.dumps(hb))
+    assert p.warm_geometries == ["1920x1080", "1920x1080@s4"]
+    for bad in ("1920x1080@sx", "1920x1080@4", "1920x1080@s0"):
+        hb["warm_geometries"] = [bad]
+        with pytest.raises(FleetProtocolError):
+            parse_heartbeat(json.dumps(hb))
+
+
+def test_lattice_stripe_axis_and_program_names():
+    import types
+    from selkies_tpu.prewarm.lattice import lattice_from_settings
+    from selkies_tpu.prewarm.plan import program_names
+    lat = lattice_from_settings(types.SimpleNamespace(
+        encoder="h264-tpu-striped", initial_width=128, initial_height=128,
+        tpu_seats=1, tpu_stripe_devices=4, fullcolor=False,
+        stripe_height=32, use_damage_gating=True, use_paint_over=False))
+    assert all("stripes4" in s.program_key for s in lat.signatures)
+    names = program_names(lat.base)
+    assert names == ["h264.stripes4.i_step[128x128]",
+                     "h264.stripes4.p_step[128x128]"]
+
+
+def test_worker_warm_geometries_advertise_stripe_points():
+    from selkies_tpu.prewarm.lattice import Signature, enumerate_lattice
+    from selkies_tpu.prewarm.worker import PrewarmWorker
+    plan = enumerate_lattice(Signature(width=128, height=128,
+                                       codec="h264", stripe_devices=4),
+                             steps=("fps",))
+    w = PrewarmWorker(plan)
+    for e in w._entries.values():
+        e["state"] = "warm"
+    assert w.warm_geometries() == ["128x128@s4"]

@@ -99,6 +99,8 @@ def entry_from_bench(doc: dict, *, git_rev: Optional[str] = None,
             res = p
     if len(parts) >= 2 and parts[0] == "encode" and len(parts) >= 4:
         codec = parts[3]
+    elif parts and parts[-1] in ("h264", "jpeg"):
+        codec = parts[-1]          # stripe_scaling_WxH_h264 style metrics
     health = doc.get("backend_health") or {}
     status = health.get("status", "unknown")
     eligible = status == "ok" if accept is None else bool(accept)
@@ -132,6 +134,13 @@ def entry_from_bench(doc: dict, *, git_rev: Optional[str] = None,
         # for and the cross-frame overlap it actually achieved — the
         # serial-vs-pipelined acceptance pair lives in these two columns
         "pipeline_depth": doc.get("pipeline_depth"),
+        # split-frame device parallelism (ROADMAP 2): the CHOSEN shard
+        # count (post-degradation — parallel/stripes.stripe_mesh), so a
+        # silently degraded mesh can never masquerade as a scaling
+        # result, plus the bench's sharded-scaling summary when the
+        # --stripes phase ran
+        "stripe_devices": doc.get("stripe_devices", 1),
+        "stripes": doc.get("stripes"),
         "overlap_fraction": (doc.get("occupancy") or {})
         .get("overlap_fraction"),
         "occupancy": doc.get("occupancy"),
@@ -331,7 +340,7 @@ def cmd_report(args: argparse.Namespace) -> int:
         print(f"== {' / '.join(str(k) for k in key)} ({len(runs)} runs)")
         print(f"   {'date':<20} {'rev':<8} {'backend':<24} {'fps':>7} "
               f"{'p50_ms':>9} {'p99_ms':>9} {'g2g_p99':>9} {'pd':>3} "
-              f"{'overlap':>8} {'ok':>3}  top stage")
+              f"{'sd':>3} {'overlap':>8} {'ok':>3}  top stage")
         for e in runs:
             ov = e.get("overlap_fraction")
             print(f"   {str(e.get('ts', ''))[:19]:<20} "
@@ -342,6 +351,7 @@ def cmd_report(args: argparse.Namespace) -> int:
                   f"{e.get('latency_p99_ms') or '-':>9} "
                   f"{e.get('g2g_p99_ms') or '-':>9} "
                   f"{e.get('pipeline_depth') or '-':>3} "
+                  f"{e.get('stripe_devices') or 1:>3} "
                   f"{(format(ov, '.1%') if isinstance(ov, (int, float)) else '-'):>8} "
                   f"{'y' if e.get('baseline_eligible') else 'n':>3}  "
                   f"{_top_stage(e)}")
@@ -350,7 +360,8 @@ def cmd_report(args: argparse.Namespace) -> int:
             "runs": [{k: e.get(k) for k in
                       ("ts", "git_rev", "backend", "fps",
                        "latency_p50_ms", "latency_p99_ms", "g2g_p99_ms",
-                       "pipeline_depth", "overlap_fraction",
+                       "pipeline_depth", "stripe_devices",
+                       "overlap_fraction",
                        "baseline_eligible", "stages_ms")}
                      for e in runs]})
     if args.json:
