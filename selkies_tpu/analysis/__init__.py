@@ -1,20 +1,30 @@
 """graftlint — AST-based static analysis for the selkies-tpu codebase.
 
-Two defect families dominate this stack's post-mortems (ADVICE.md r5,
+Three defect families dominate this stack's post-mortems (ADVICE.md r5,
 VERDICT.md): silent device->host syncs / recompilation hazards in the
-per-frame JAX hot path, and asyncio hygiene bugs in the server plane.
-graftlint catches both at review time with a repo-local rule set:
+per-frame JAX hot path, asyncio hygiene bugs in the server plane, and —
+now that the hot path is genuinely concurrent (capture threads, the
+PipelineRing finalizer, supervisor/prewarm background threads, the
+asyncio loop) — cross-thread ordering bugs.  graftlint catches all
+three at review time with a repo-local, *interprocedural-within-module*
+rule set:
 
-- ``rules_jax``     — host syncs, tracer branches, static-arg and
-                      donation hazards inside jit/pmap-traced code.
+- ``rules_jax``     — host syncs, tracer branches, static-arg hazards,
+                      use-after-donate, and shard_map discipline inside
+                      traced code.
 - ``rules_asyncio`` — orphaned tasks, blocking calls in coroutines,
                       swallowed exceptions in the server/webrtc planes.
+- ``rules_threads`` — thread-context inference (``callgraph``/
+                      ``contexts``): unlocked cross-context mutations,
+                      loop-only asyncio calls from threads, lock-order
+                      cycles.
 
 The CLI (``python -m selkies_tpu.analysis``) ratchets against
 ``tools/graftlint_baseline.json``: pre-existing violations are
-tolerated, any *new* one fails CI.  Inline suppression:
-``# graftlint: disable=RULE-ID`` on the offending line or the line
-above it.
+tolerated, any *new* one fails CI.  ``--format=sarif`` emits CI
+annotations; ``selftest`` runs the embedded per-rule fixtures
+(stdlib-only).  Inline suppression: ``# graftlint: disable=RULE-ID`` on
+the offending line or the line above it (unknown rule ids warn).
 """
 from .core import Analyzer, Finding, Rule, Severity, default_rules
 

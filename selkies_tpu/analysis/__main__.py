@@ -2,19 +2,28 @@
 
 Usage:
     python -m selkies_tpu.analysis [options] PATH [PATH ...]
+    python -m selkies_tpu.analysis selftest [--json]
 
     --baseline FILE        ratchet: tolerate findings recorded in FILE,
                            fail only on new ones
     --write-baseline FILE  record the current findings as the new
                            tolerated set and exit 0
-    --json                 machine-readable output (schema documented
+    --format MODE          output format: text (default), json, or
+                           sarif (SARIF 2.1.0 for CI annotations —
+                           carries the NEW findings)
+    --json                 alias for --format=json (schema documented
                            in README.md §graftlint)
     --severity RULE=LEVEL  per-rule severity override (info|warning|
                            error); info findings never gate
     --list-rules           print the rule catalog and exit
 
+``selftest`` runs the embedded per-rule fixtures (stdlib-only, no repo
+checkout needed) — the lint-image smoke the other planes also ship.
+
 Exit codes: 0 clean (or everything baselined), 1 new gating findings,
-2 usage/parse error.
+2 usage/parse/INTERNAL error.  A crashing rule is an internal error
+(2), never a lint failure (1): CI must be able to tell "the gate found
+something" from "the gate itself broke".
 """
 from __future__ import annotations
 
@@ -24,7 +33,7 @@ import sys
 from pathlib import Path
 
 from .core import (Analyzer, Severity, default_rules, gating,
-                   load_baseline, make_baseline, new_findings)
+                   load_baseline, make_baseline, new_findings, to_sarif)
 
 
 def _parse_severities(pairs: list[str]) -> dict[str, str]:
@@ -40,21 +49,33 @@ def _parse_severities(pairs: list[str]) -> dict[str, str]:
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "selftest":
+        from .selftest import run_selftest
+        return run_selftest(argv[1:])
+
     ap = argparse.ArgumentParser(
         prog="python -m selkies_tpu.analysis",
-        description="graftlint: JAX hot-path + asyncio-safety analyzer")
+        description="graftlint: JAX hot-path + asyncio-safety + "
+                    "thread-context race analyzer")
     ap.add_argument("paths", nargs="*", help="files or directories")
     ap.add_argument("--baseline", metavar="FILE")
     ap.add_argument("--write-baseline", metavar="FILE")
-    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--format", dest="fmt", default="text",
+                    choices=("text", "json", "sarif"))
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="alias for --format=json")
     ap.add_argument("--severity", action="append", default=[],
                     metavar="RULE=LEVEL")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
+    if args.as_json:
+        args.fmt = "json"
 
     if args.list_rules:
         for rule in default_rules():
-            print(f"{rule.rule_id:22s} [{rule.default_severity:7s}] "
+            print(f"{rule.rule_id:24s} [{rule.default_severity:7s}] "
                   f"{rule.description}")
         return 0
     if not args.paths:
@@ -68,7 +89,18 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     analyzer = Analyzer(severity_overrides=overrides)
-    findings = analyzer.run(args.paths)
+    try:
+        findings = analyzer.run(args.paths)
+    except Exception as e:  # any analyzer crash is internal, exit 2
+        print(f"graftlint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    for warn in analyzer.pragma_warnings:
+        print(f"graftlint: warning: {warn}", file=sys.stderr)
+    if analyzer.internal_errors:
+        for err in analyzer.internal_errors:
+            print(f"graftlint: internal error: {err}", file=sys.stderr)
+        return 2
     if analyzer.parse_errors:
         for err in analyzer.parse_errors:
             print(f"graftlint: {err}", file=sys.stderr)
@@ -92,7 +124,9 @@ def main(argv: list[str] | None = None) -> int:
     fresh = new_findings(findings, baseline)
     gate = gating(fresh)
 
-    if args.as_json:
+    if args.fmt == "sarif":
+        print(json.dumps(to_sarif(fresh, analyzer.rules), indent=1))
+    elif args.fmt == "json":
         print(json.dumps({
             "version": 1,
             "findings": [f.to_json() for f in findings],

@@ -1,0 +1,324 @@
+"""Module-local call graph + lockset approximation.
+
+The v2 rules are *interprocedural within one module*: thread contexts
+and held-lock sets propagate along call edges so a mutation buried two
+helpers deep under ``with self._lock:`` still carries the lock, and a
+helper only ever reached from the capture thread still carries the
+thread context.  Cross-module flows stay out of scope (the same
+deliberate line the v1 JAX rules drew) — the engine's concurrency
+seams (capture loop, PipelineRing, supervisor, asyncio hops) are all
+visible module-locally, and anything subtler gets a pragma with a
+justification instead of a whole-program points-to analysis.
+
+What this module computes, per :class:`~.core.ModuleInfo` (memoized on
+the ModuleInfo so every rule shares one walk):
+
+- **defs**: every function/method with its enclosing class.
+- **call sites**: bare-name calls, ``self.m()``/``cls.m()`` calls
+  (resolved within the enclosing class first), and ``obj.m()`` calls
+  resolved by method name only when exactly one method in the module
+  matches (ambiguity would bleed contexts between unrelated classes).
+- **locksets**: ``with <lock>:`` blocks where the context expression is
+  a plain name/attribute (``with self._lock:``, ``with _ENCODE_TURN:``)
+  count as lock acquisitions; call expressions (``with tracer.span():``,
+  ``with open():``) do not.  Single-assignment local aliases resolve
+  (``turn = _ENCODE_TURN; with turn:`` acquires ``_ENCODE_TURN``).
+  ``self.<attr>`` keys are scoped by class name so two classes' private
+  locks never unify.
+- **entry locksets**: a fixpoint intersection over call sites — the set
+  of locks *guaranteed* held whenever a function is entered.  Functions
+  with no module-local caller (public API, context roots) are entered
+  lock-free.  ``Condition.wait()`` releasing its lock is a documented
+  false-negative class.
+
+Known approximations (documented in README §static-analysis): mutation
+via method calls (``list.append``) is not a tracked write; lexically
+nested defs run lock-free (a closure invoked inline under a ``with``
+loses the lock); two instances of the SAME thread target are one
+context.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from .core import ModuleInfo
+
+__all__ = ["CallSite", "FuncInfo", "ModuleGraph", "graph_of"]
+
+
+@dataclass
+class CallSite:
+    node: ast.Call
+    held: frozenset        # lock keys lexically held at the call
+    kind: str              # 'name' | 'self' | 'attr'
+    callee: str            # simple callee name
+
+
+@dataclass
+class LockSite:
+    node: ast.AST          # the `with` statement
+    key: str               # lock key being acquired
+    held: frozenset        # lock keys held just before acquiring
+
+
+@dataclass
+class MutationSite:
+    node: ast.AST          # the Assign/AugAssign/Delete statement
+    attr: str              # the self.<attr> being written
+    held: frozenset        # lock keys lexically held at the write
+
+
+@dataclass
+class FuncInfo:
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    name: str
+    cls: Optional[str]                 # enclosing class, None for functions
+    is_async: bool
+    calls: list[CallSite] = field(default_factory=list)
+    locks: list[LockSite] = field(default_factory=list)
+    mutations: list[MutationSite] = field(default_factory=list)
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+def _name_or_attr_text(node: ast.AST) -> Optional[str]:
+    """Source text for a plain Name/Attribute chain, else None (calls,
+    subscripts etc. are not lock-shaped)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _name_or_attr_text(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+class ModuleGraph:
+    def __init__(self, module: ModuleInfo):
+        self.module = module
+        self.funcs: dict[ast.AST, FuncInfo] = {}
+        self.by_name: dict[str, list[FuncInfo]] = {}
+        self._methods: dict[str, list[FuncInfo]] = {}
+        #: simple-name -> RHS expr for single-target assignments, used by
+        #: rules to resolve `step = self._i_step`-style indirections
+        self.assigns: dict[str, list[ast.expr]] = {}
+        self._entry: Optional[dict[ast.AST, frozenset]] = None
+        self._collect(module.tree, None)
+        for fi in self.funcs.values():
+            self._scan(fi)
+
+    # -- construction --------------------------------------------------------
+    def _collect(self, node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._collect(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(node=child, name=child.name, cls=cls,
+                              is_async=isinstance(child,
+                                                  ast.AsyncFunctionDef))
+                self.funcs[child] = fi
+                self.by_name.setdefault(child.name, []).append(fi)
+                if cls is not None:
+                    self._methods.setdefault(child.name, []).append(fi)
+                # nested defs: methods of a nested class keep their class
+                self._collect(child, cls if cls is not None else None)
+            elif isinstance(child, ast.Assign) and \
+                    len(child.targets) == 1 and \
+                    isinstance(child.targets[0], ast.Name):
+                self.assigns.setdefault(
+                    child.targets[0].id, []).append(child.value)
+            else:
+                self._collect(child, cls)
+
+    def _aliases(self, fi: FuncInfo) -> dict[str, str]:
+        """Locals assigned exactly once from a plain name/attribute —
+        resolved so ``turn = _ENCODE_TURN; with turn:`` keys on the
+        module lock, not the alias."""
+        counts: dict[str, int] = {}
+        exprs: dict[str, str] = {}
+        for sub in ast.walk(fi.node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                    isinstance(sub.targets[0], ast.Name):
+                n = sub.targets[0].id
+                counts[n] = counts.get(n, 0) + 1
+                text = _name_or_attr_text(sub.value)
+                if text is not None:
+                    exprs[n] = text
+        return {n: t for n, t in exprs.items() if counts.get(n) == 1}
+
+    def _lock_key(self, fi: FuncInfo, expr: ast.AST,
+                  aliases: dict[str, str]) -> Optional[str]:
+        text = _name_or_attr_text(expr)
+        if text is None:
+            return None
+        root = text.split(".", 1)[0]
+        if root in aliases:
+            text = aliases[root] + text[len(root):]
+        if text.startswith("self.") and fi.cls:
+            return f"{fi.cls}.{text}"
+        return text
+
+    def _scan(self, fi: FuncInfo) -> None:
+        """One pass over the body recording call/lock/mutation sites with
+        the lexically held lockset.  Nested defs and lambdas are skipped —
+        they are separate FuncInfos entered lock-free."""
+        aliases = self._aliases(fi)
+        held: list[str] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                n_acquired = 0
+                for item in node.items:
+                    key = self._lock_key(fi, item.context_expr, aliases)
+                    if key is not None:
+                        # record BEFORE extending held, extend BEFORE the
+                        # next item: `with A, B:` acquires sequentially,
+                        # so B's site must see A held (the idiomatic
+                        # multi-item ABBA form)
+                        fi.locks.append(LockSite(
+                            node=node, key=key, held=frozenset(held)))
+                        held.append(key)
+                        n_acquired += 1
+                    else:
+                        visit(item.context_expr)
+                for stmt in node.body:
+                    visit(stmt)
+                if n_acquired:
+                    del held[-n_acquired:]
+                return
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name):
+                    fi.calls.append(CallSite(node, frozenset(held),
+                                             "name", f.id))
+                elif isinstance(f, ast.Attribute):
+                    kind = "self" if (isinstance(f.value, ast.Name) and
+                                      f.value.id in ("self", "cls")) \
+                        else "attr"
+                    fi.calls.append(CallSite(node, frozenset(held),
+                                             kind, f.attr))
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else (node.targets if isinstance(node, ast.Delete)
+                          else [node.target])
+                for t in targets:
+                    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                    for e in elts:
+                        if isinstance(e, ast.Attribute) and \
+                                isinstance(e.value, ast.Name) and \
+                                e.value.id == "self":
+                            fi.mutations.append(MutationSite(
+                                node=node, attr=e.attr,
+                                held=frozenset(held)))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fi.node.body:
+            visit(stmt)
+
+    # -- resolution ----------------------------------------------------------
+    def resolve_call(self, fi: FuncInfo, site: CallSite) -> list[FuncInfo]:
+        """Module-local callee candidates for a call site."""
+        if site.kind == "name":
+            return self.by_name.get(site.callee, [])
+        if site.kind == "self":
+            same = [m for m in self._methods.get(site.callee, [])
+                    if m.cls == fi.cls]
+            return same or self.by_name.get(site.callee, [])
+        # obj.m(): only when unambiguous — one method in the module
+        cands = self._methods.get(site.callee, [])
+        return cands if len(cands) == 1 else []
+
+    def resolve_name_to_funcs(self, name: str,
+                              _seen: Optional[set] = None) -> list[FuncInfo]:
+        """Defs a bare name may refer to: direct defs, plus defs RETURNED
+        by a local factory when the name is assigned from a factory call
+        (``compiled = build_step(...)`` resolves to the closures
+        ``build_step`` returns) — the engine's step-factory idiom."""
+        if _seen is None:
+            _seen = set()
+        if name in _seen:
+            return []
+        _seen.add(name)
+        out = list(self.by_name.get(name, []))
+        for rhs in self.assigns.get(name, []):
+            if isinstance(rhs, ast.Call) and isinstance(rhs.func, ast.Name):
+                for factory in self.by_name.get(rhs.func.id, []):
+                    out.extend(self.returned_funcs(factory, _seen))
+        return out
+
+    def returned_funcs(self, fi: FuncInfo,
+                       _seen: Optional[set] = None) -> list[FuncInfo]:
+        """Local defs ``fi`` can return (directly by name)."""
+        out: list[FuncInfo] = []
+        for sub in ast.walk(fi.node):
+            if isinstance(sub, ast.Return) and \
+                    isinstance(sub.value, ast.Name):
+                for cand in self.resolve_name_to_funcs(
+                        sub.value.id, _seen if _seen is not None else None):
+                    if cand is not fi:
+                        out.append(cand)
+        return out
+
+    # -- entry locksets ------------------------------------------------------
+    def entry_locksets(self) -> dict[ast.AST, frozenset]:
+        """Locks guaranteed held on entry: the intersection, over every
+        module-local call site, of (caller's entry set | locks held at
+        the site).  Functions with no resolved caller are entered
+        lock-free — public API methods are called from other modules
+        with nothing held, which is the conservative (reporting)
+        direction."""
+        if self._entry is not None:
+            return self._entry
+        TOP = None  # unknown: no call path seen yet
+        entry: dict[ast.AST, object] = {n: TOP for n in self.funcs}
+        # callers map: callee -> [(caller, held-at-site)]
+        callers: dict[ast.AST, list[tuple[ast.AST, frozenset]]] = {}
+        called: set[ast.AST] = set()
+        for fi in self.funcs.values():
+            for site in fi.calls:
+                for callee in self.resolve_call(fi, site):
+                    callers.setdefault(callee.node, []).append(
+                        (fi.node, site.held))
+                    called.add(callee.node)
+        for n in self.funcs:
+            if n not in called:
+                entry[n] = frozenset()
+        for _ in range(len(self.funcs) + 1):
+            changed = False
+            for n in self.funcs:
+                sets = []
+                if n not in called:
+                    sets.append(frozenset())
+                for caller, held in callers.get(n, []):
+                    e = entry.get(caller)
+                    if e is TOP:
+                        continue
+                    sets.append(frozenset(e) | held)
+                if not sets:
+                    continue
+                new = frozenset.intersection(*sets)
+                if entry[n] is TOP or new != entry[n]:
+                    entry[n] = new
+                    changed = True
+            if not changed:
+                break
+        self._entry = {n: (frozenset() if e is TOP else e)
+                       for n, e in entry.items()}
+        return self._entry
+
+
+def graph_of(module: ModuleInfo) -> ModuleGraph:
+    """Memoized per-ModuleInfo graph — every interprocedural rule shares
+    one walk (the collect_hot_functions pattern)."""
+    cached = getattr(module, "_callgraph", None)
+    if cached is None:
+        cached = ModuleGraph(module)
+        module._callgraph = cached
+    return cached

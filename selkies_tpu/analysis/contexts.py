@@ -1,0 +1,200 @@
+"""Thread-context inference: WHICH execution context runs each function.
+
+The engine plane is a fixed set of context kinds, all of them visible
+module-locally at their spawn/wiring sites:
+
+- ``thread:<target>`` — a ``threading.Thread(target=...)`` body and
+  everything it calls; also executor thunks
+  (``run_in_executor(None, fn)``) and capture-callback wiring (the
+  engine invokes ``start_capture``'s callback and ``on_death``/
+  ``set_cursor_callback`` hooks on the capture thread).
+- ``finalizer`` — a ``PipelineRing(fn)`` / ``retarget(.., fn, ..)``
+  finalize function: the ring's single finalizer thread.
+- ``loop`` — ``async def`` bodies; functions hopped onto the loop via
+  ``call_soon_threadsafe`` / ``run_coroutine_threadsafe`` / ``call_soon``
+  / ``call_later`` / ``call_at`` / ``add_done_callback``; and
+  supervisor-adopted restart callables (the default supervisor
+  scheduler is the running loop's ``call_later``).
+- ``caller`` (implicit, the empty set) — public API: no module-local
+  evidence of who calls it.  The server plane calls these from the loop
+  or an executor; the rules treat ``caller`` as potentially-concurrent
+  with any real thread context.
+
+Contexts propagate along the module-local call graph (a helper only
+reached from the capture loop is capture-thread code), with one cut:
+thread-ish contexts never propagate INTO ``async def`` bodies — a
+thread cannot execute a coroutine body by calling it, only schedule it.
+
+Known false-negative classes (README §static-analysis): two live
+instances of the same thread target count as one context; callbacks
+wired through lambdas are opaque; cross-module wiring is invisible.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .callgraph import FuncInfo, ModuleGraph, graph_of
+from .core import ModuleInfo
+
+__all__ = ["CALLER", "FINALIZER", "LOOP", "contexts_of", "is_threadish",
+           "racing_pair"]
+
+CALLER = "caller"
+FINALIZER = "finalizer"
+LOOP = "loop"
+
+#: attribute/bare call names whose Nth positional argument runs on the
+#: asyncio event loop
+_LOOP_HOPS = {
+    "call_soon_threadsafe": 0, "call_soon": 0, "call_later": 1,
+    "call_at": 1, "add_done_callback": 0, "run_coroutine_threadsafe": 0,
+    # supervisor wiring: restart callables fire from the loop's
+    # call_later (resilience/supervisor.py _default_schedule)
+    "adopt": 1,
+}
+#: call names whose Nth positional argument runs on a worker thread
+_THREAD_HOPS = {
+    "run_in_executor": 1,           # loop.run_in_executor(None, fn)
+    "start_capture": 0,             # engine capture-thread callback
+    "set_cursor_callback": 0,
+}
+#: attribute assignments that wire a capture-thread hook
+_THREAD_ATTR_HOOKS = {"on_death"}
+#: PipelineRing finalize-fn positions (engine/pipeline.py)
+_FINALIZER_HOPS = {"PipelineRing": 0, "retarget": 2}
+
+
+def is_threadish(ctx: str) -> bool:
+    """True for contexts that are real OS threads distinct from the
+    event loop (the racing side of every rule)."""
+    return ctx.startswith("thread:") or ctx == FINALIZER
+
+
+def racing_pair(a: set, b: set) -> Optional[tuple[str, str]]:
+    """A pair of distinct context labels, one from each set, that can
+    run concurrently — requiring at least one side to be a real thread
+    (caller-vs-loop is NOT racing: 'caller' in the server plane usually
+    IS the loop thread).  Same-label pairs don't race (two instances of
+    one thread target are indistinguishable here — documented FN)."""
+    for ca in sorted(a) or [CALLER]:
+        for cb in sorted(b) or [CALLER]:
+            if ca != cb and (is_threadish(ca) or is_threadish(cb)):
+                return (ca, cb)
+    return None
+
+
+def _callable_ref(node: ast.AST) -> Optional[tuple[str, str]]:
+    """('name', f) for a bare name, ('self', m) for self.m / cls.m —
+    the two forms context seeding resolves.  Lambdas and arbitrary
+    attribute chains are opaque."""
+    if isinstance(node, ast.Name):
+        return ("name", node.id)
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id in ("self", "cls"):
+        return ("self", node.attr)
+    return None
+
+
+def _resolve_ref(graph: ModuleGraph, expr: ast.AST) -> list[FuncInfo]:
+    """Module-local defs a callable-valued expression may denote —
+    shared by call-argument seeding and attribute-hook seeding."""
+    ref = _callable_ref(expr)
+    if ref is None:
+        return []
+    kind, name = ref
+    if kind == "self":
+        return [m for m in graph.by_name.get(name, []) if m.cls] or \
+            graph.by_name.get(name, [])
+    return graph.resolve_name_to_funcs(name)
+
+
+def _seed_targets(graph: ModuleGraph, node: ast.Call,
+                  arg_idx: int, kwarg: Optional[str] = None
+                  ) -> list[FuncInfo]:
+    """Resolve the function-valued argument at ``arg_idx`` (or keyword
+    ``kwarg``) of a spawn/hop call to module-local defs."""
+    cand: Optional[ast.AST] = None
+    if kwarg is not None:
+        for kw in node.keywords:
+            if kw.arg == kwarg:
+                cand = kw.value
+                break
+    if cand is None and len(node.args) > arg_idx:
+        cand = node.args[arg_idx]
+    if cand is None:
+        return []
+    # run_coroutine_threadsafe(coro_fn(...), loop): the coroutine call
+    if isinstance(cand, ast.Call):
+        cand = cand.func
+    return _resolve_ref(graph, cand)
+
+
+def contexts_of(module: ModuleInfo) -> dict[ast.AST, set[str]]:
+    """def-node -> set of context labels (empty set = caller-only).
+    Memoized on the ModuleInfo."""
+    cached = getattr(module, "_thread_contexts", None)
+    if cached is not None:
+        return cached
+    graph = graph_of(module)
+    ctxs: dict[ast.AST, set[str]] = {n: set() for n in graph.funcs}
+
+    def add(fis: list[FuncInfo], label: str) -> None:
+        for fi in fis:
+            ctxs.setdefault(fi.node, set()).add(label)
+
+    for fi in graph.funcs.values():
+        if fi.is_async:
+            ctxs[fi.node].add(LOOP)
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign):
+            # cap.on_death = self._handler  (capture-thread hook)
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        t.attr in _THREAD_ATTR_HOOKS:
+                    add(_resolve_ref(graph, node.value),
+                        f"thread:{t.attr}")
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        callee = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if callee is None:
+            continue
+        if callee == "Thread":
+            # Thread(group=None, target=None, ...): positional slot 1
+            for fi in _seed_targets(graph, node, 1, kwarg="target"):
+                ctxs[fi.node].add(f"thread:{fi.name}")
+        elif callee in _FINALIZER_HOPS:
+            add(_seed_targets(graph, node, _FINALIZER_HOPS[callee]),
+                FINALIZER)
+        elif callee in _LOOP_HOPS:
+            add(_seed_targets(graph, node, _LOOP_HOPS[callee]), LOOP)
+        elif callee in _THREAD_HOPS:
+            label = "thread:executor" if callee == "run_in_executor" \
+                else "thread:capture"
+            add(_seed_targets(graph, node, _THREAD_HOPS[callee]), label)
+
+    # propagate along call edges; thread-ish contexts stop at async defs
+    changed = True
+    rounds = 0
+    while changed and rounds <= len(graph.funcs) + 1:
+        changed = False
+        rounds += 1
+        for fi in graph.funcs.values():
+            src = ctxs[fi.node]
+            if not src:
+                continue
+            for site in fi.calls:
+                for callee in graph.resolve_call(fi, site):
+                    dst = ctxs[callee.node]
+                    for c in src:
+                        if callee.is_async and c != LOOP:
+                            continue
+                        if c not in dst:
+                            dst.add(c)
+                            changed = True
+    module._thread_contexts = ctxs
+    return ctxs

@@ -142,6 +142,13 @@ class Analyzer:
             else default_rules()
         self.severity_overrides = dict(severity_overrides or {})
         self.parse_errors: list[str] = []
+        #: a rule crashed — the CLI exits 2 (internal error), never 1:
+        #: a crash must be distinguishable from "findings present"
+        self.internal_errors: list[str] = []
+        #: ``disable=`` pragmas naming unknown rule ids — warned, never
+        #: silently no-op'd (a typo'd pragma that suppresses nothing is
+        #: a gate the author believes exists)
+        self.pragma_warnings: list[str] = []
 
     # file discovery ---------------------------------------------------------
     def iter_files(self, paths: Iterable[str | Path]) -> Iterator[Path]:
@@ -195,7 +202,14 @@ class Analyzer:
         for rule in self.rules:
             if rule.path_filter and not re.search(rule.path_filter, path):
                 continue
-            for finding in rule.check(module):
+            try:
+                findings = list(rule.check(module))
+            except Exception as e:  # a crashing rule is OUR bug, exit 2
+                self.internal_errors.append(
+                    f"{path}: rule {rule.rule_id} crashed: "
+                    f"{type(e).__name__}: {e}")
+                continue
+            for finding in findings:
                 # a nested def reachable two ways (lexically inside a
                 # hot body AND via the call-graph closure) must report
                 # once
@@ -209,7 +223,31 @@ class Analyzer:
                 if sev and sev != finding.severity:
                     finding = replace(finding, severity=sev)
                 out.append(finding)
+        self._check_pragmas(module)
         return out
+
+    def _check_pragmas(self, module: ModuleInfo) -> None:
+        """Warn on ``disable=`` pragma ids that name no known rule — a
+        typo'd id would otherwise silently suppress nothing while its
+        author believes the line is covered.  Real COMMENT tokens only
+        (docstrings quoting pragma syntax must not warn)."""
+        import io
+        import tokenize
+        known = {r.rule_id.upper() for r in self.rules} | {"ALL"}
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(module.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                for rid in _pragma_ids(tok.string):
+                    if rid not in known:
+                        self.pragma_warnings.append(
+                            f"{module.path}:{tok.start[0]}: unknown rule "
+                            f"id '{rid}' in graftlint pragma (known: "
+                            "see --list-rules)")
+        except (tokenize.TokenizeError, IndentationError, SyntaxError):
+            pass  # the ast parse succeeded; a tokenize hiccup is cosmetic
 
 
 # -- baseline ratchet --------------------------------------------------------
@@ -272,5 +310,46 @@ def gating(findings: Iterable[Finding]) -> list[Finding]:
 
 
 def default_rules() -> list[Rule]:
-    from . import rules_asyncio, rules_jax
-    return [*rules_jax.RULES, *rules_asyncio.RULES]
+    from . import rules_asyncio, rules_jax, rules_threads
+    return [*rules_jax.RULES, *rules_asyncio.RULES, *rules_threads.RULES]
+
+
+# -- SARIF export ------------------------------------------------------------
+
+_SARIF_LEVEL = {Severity.ERROR: "error", Severity.WARNING: "warning",
+                Severity.INFO: "note"}
+
+
+def to_sarif(findings: Iterable[Finding], rules: Iterable[Rule]) -> dict:
+    """SARIF 2.1.0 document for CI annotation upload.  Carries the NEW
+    (non-baselined) findings — the set a reviewer must act on — plus the
+    full rule catalog so viewers render descriptions."""
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "https://github.com/selkies-project/selkies",
+                "rules": [
+                    {"id": r.rule_id,
+                     "shortDescription": {"text": r.description},
+                     "defaultConfiguration": {
+                         "level": _SARIF_LEVEL.get(r.default_severity,
+                                                   "warning")}}
+                    for r in rules],
+            }},
+            "results": [
+                {"ruleId": f.rule_id,
+                 "level": _SARIF_LEVEL.get(f.severity, "warning"),
+                 "message": {"text": f.message},
+                 "locations": [{"physicalLocation": {
+                     "artifactLocation": {"uri": f.path},
+                     "region": {"startLine": f.line,
+                                "startColumn": f.col + 1},
+                 }}]}
+                for f in findings],
+        }],
+    }

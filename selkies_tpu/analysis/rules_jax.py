@@ -526,7 +526,393 @@ class JaxDonateHintRule(Rule):
                     "the device buffer")
 
 
+# ---------------------------------------------------------------------------
+# donation discipline (v2): use-after-donate
+# ---------------------------------------------------------------------------
+
+def _donate_nums(kwargs: dict[str | None, ast.AST]) -> set[int]:
+    """Donated positional indices from jit keyword args.  Handles the
+    repo helper form ``donate_argnums=donate_argnums_for_backend((1,2))``
+    — analysis assumes donation is ACTIVE (the helper disables it on
+    unaliasable backends; the bug only exists where it is active, which
+    is exactly where no test runs)."""
+    nums: set[int] = set()
+    for k, v in kwargs.items():
+        if not k or not k.startswith("donate"):
+            continue
+        got = _literal_ints(v)
+        if not got and isinstance(v, ast.Call) and v.args:
+            got = _literal_ints(v.args[0])
+        nums |= set(got)
+    return nums
+
+
+def _donated_call_value(call: ast.Call,
+                        factories: dict[str, set[int]]) -> set[int]:
+    """Donated argnums when ``call`` evaluates to a donated jitted
+    callable: ``jax.jit(f, donate_argnums=...)``, ``wrap_step(name,
+    <donated>)`` (obs.perf AOT wrapper preserves donation), or a call to
+    a local factory whose return is donated."""
+    f = call.func
+    if _is_jit_name(f):
+        return _donate_nums({kw.arg: kw.value for kw in call.keywords})
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None)
+    if name == "wrap_step":
+        for a in call.args:
+            if isinstance(a, ast.Call):
+                nums = _donated_call_value(a, factories)
+                if nums:
+                    return nums
+        return set()
+    if name is not None and name in factories:
+        return factories[name]
+    return set()
+
+
+def _donated_bindings(module: ModuleInfo):
+    """-> (factories, attrs, names): simple-name -> donated argnums for
+    (a) defs returning a donated jit (step factories), (b) ``self.X``
+    attributes assigned from one, (c) module/local names assigned from
+    one (including ``@partial(jax.jit, donate_argnums=...)`` defs).
+    Memoized on the ModuleInfo."""
+    cached = getattr(module, "_donated", None)
+    if cached is not None:
+        return cached
+    factories: dict[str, set[int]] = {}
+    # fixpoint: a factory may return another factory's call
+    for _ in range(8):
+        changed = False
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name in factories:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and \
+                        isinstance(sub.value, ast.Call):
+                    nums = _donated_call_value(sub.value, factories)
+                    if nums:
+                        factories[node.name] = nums
+                        changed = True
+                        break
+        if not changed:
+            break
+    attrs: dict[str, set[int]] = {}
+    names: dict[str, set[int]] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                is_jit, kwargs = _jit_decorator(dec)
+                if is_jit:
+                    nums = _donate_nums(kwargs)
+                    if nums:
+                        names.setdefault(node.name, set()).update(nums)
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        nums = _donated_call_value(node.value, factories)
+        if not nums:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                names.setdefault(t.id, set()).update(nums)
+            elif isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                attrs.setdefault(t.attr, set()).update(nums)
+    module._donated = (factories, attrs, names)
+    return module._donated
+
+
+def _donated_expr(expr: ast.AST, factories, attrs, names) -> set[int]:
+    if isinstance(expr, ast.Call):
+        return _donated_call_value(expr, factories)
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return attrs.get(expr.attr, set())
+    if isinstance(expr, ast.Name):
+        return names.get(expr.id, set())
+    if isinstance(expr, ast.IfExp):
+        return _donated_expr(expr.body, factories, attrs, names) | \
+            _donated_expr(expr.orelse, factories, attrs, names)
+    return set()
+
+
+def _binding_of(arg: ast.AST) -> str | None:
+    """'x' or 'self.x' for trackable donated-argument bindings."""
+    if isinstance(arg, ast.Name):
+        return arg.id
+    if isinstance(arg, ast.Attribute) and \
+            isinstance(arg.value, ast.Name) and arg.value.id == "self":
+        return f"self.{arg.attr}"
+    return None
+
+
+def _matches_binding(node: ast.AST, binding: str) -> bool:
+    if binding.startswith("self."):
+        return isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id == "self" and node.attr == binding[5:]
+    return isinstance(node, ast.Name) and node.id == binding
+
+
+class JaxUseAfterDonateRule(Rule):
+    rule_id = "JAX-USE-AFTER-DONATE"
+    description = ("a binding passed at a donate_argnums position is "
+                   "read again later in the function — the donated "
+                   "device buffer is deleted/aliased by XLA, so the "
+                   "read returns garbage or raises on HBM backends")
+    default_severity = Severity.ERROR
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        factories, attrs, names = _donated_bindings(module)
+        if not (factories or attrs or names):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            yield from self._check_fn(module, node, factories, attrs,
+                                      names)
+
+    def _check_fn(self, module: ModuleInfo, fn, factories, attrs,
+                  names) -> Iterator[Finding]:
+        # function-local donated names: x = self._step / x = a if c else b
+        local = dict(names)
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                    isinstance(sub.targets[0], ast.Name):
+                nums = _donated_expr(sub.value, factories, attrs, local)
+                if nums:
+                    local[sub.targets[0].id] = nums
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            nums = _donated_expr(call.func, factories, attrs, local)
+            # calling a donated FACTORY builds the callable — only calls
+            # of the jitted result donate
+            if isinstance(call.func, ast.Name) and \
+                    call.func.id in factories:
+                nums = set()
+            if not nums:
+                continue
+            for i in sorted(nums):
+                if i >= len(call.args):
+                    continue
+                binding = _binding_of(call.args[i])
+                if binding is None:
+                    continue
+                hit = self._read_after(fn, call, binding)
+                if hit is not None:
+                    yield self.finding(
+                        module, hit,
+                        f"'{binding}' was donated to the jitted call at "
+                        f"line {call.lineno} (donate_argnums position "
+                        f"{i}) and is read again here — rebind it from "
+                        "the step's output (the prev_out discipline) "
+                        "or drop the read")
+
+    @staticmethod
+    def _read_after(fn, call: ast.Call, binding: str):
+        """First Load of ``binding`` after the donating call and before
+        any rebinding Store.  Reads textually before the call (loop
+        wrap-around) are a documented false-negative class."""
+        call_end = (call.end_lineno or call.lineno,
+                    call.end_col_offset or 0)
+        stores: list[tuple[int, int]] = []
+        loads: list[tuple[tuple[int, int], ast.AST]] = []
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                for t in targets:
+                    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                    for e in elts:
+                        if _matches_binding(e, binding):
+                            # the store lands AFTER the RHS evaluates
+                            stores.append((sub.end_lineno or sub.lineno,
+                                           sub.end_col_offset or 0))
+            elif isinstance(sub, ast.AugAssign) and \
+                    _matches_binding(sub.target, binding):
+                # x += 1 both reads and writes: the read fires first
+                loads.append(((sub.lineno, sub.col_offset), sub))
+            elif _matches_binding(sub, binding) and \
+                    isinstance(getattr(sub, "ctx", None), ast.Load):
+                loads.append(((sub.lineno, sub.col_offset), sub))
+        # >= : `state = step(state, d)` rebinds at the call's own end
+        limit = min((s for s in stores if s >= call_end), default=None)
+        for pos, node in sorted(loads):
+            if pos <= call_end:
+                continue
+            if limit is not None and pos > limit:
+                break
+            return node
+        return None
+
+
+# ---------------------------------------------------------------------------
+# shard_map discipline (v2)
+# ---------------------------------------------------------------------------
+
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
+                "all_to_all", "pshuffle", "axis_size", "pswapaxes",
+                "psum_scatter"}
+
+
+def _shard_rooted(module: ModuleInfo):
+    """-> (direct, indirect): defs passed to shard_map (their params are
+    per-shard array refs) and defs reachable from those through
+    module-local calls.  Memoized on the ModuleInfo."""
+    cached = getattr(module, "_shard_fns", None)
+    if cached is not None:
+        return cached
+    from .callgraph import graph_of
+    graph = graph_of(module)
+    direct: dict[ast.AST, object] = {}
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if name != "shard_map" or not node.args:
+            continue
+        a0 = node.args[0]
+        if isinstance(a0, ast.Name):
+            for fi in graph.resolve_name_to_funcs(a0.id):
+                direct[fi.node] = fi
+    indirect: dict[ast.AST, object] = {}
+    frontier = list(direct.values())
+    while frontier:
+        fi = frontier.pop()
+        for site in fi.calls:
+            for callee in graph.resolve_call(fi, site):
+                if callee.node not in direct and \
+                        callee.node not in indirect:
+                    indirect[callee.node] = callee
+                    frontier.append(callee)
+    module._shard_fns = (direct, indirect)
+    return module._shard_fns
+
+
+def _mesh_axes(module: ModuleInfo) -> set[str]:
+    """Axis names bound by Mesh(...) constructions in this module; empty
+    means no module-local mesh (axis-name check is skipped — the mesh
+    was built elsewhere)."""
+    axes: set[str] = set()
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if name != "Mesh":
+            continue
+        if len(node.args) > 1:
+            axes |= set(_literal_strs(node.args[1]))
+        for kw in node.keywords:
+            if kw.arg == "axis_names":
+                axes |= set(_literal_strs(kw.value))
+    return axes
+
+
+class JaxShardConsistencyRule(Rule):
+    rule_id = "JAX-SHARD-CONSISTENCY"
+    description = ("host sync (.item()/np.asarray), Python branch on a "
+                   "per-shard value, or unbound mesh axis name inside a "
+                   "function reachable from shard_map — per-shard "
+                   "programs must stay device-pure and collective-"
+                   "consistent")
+    default_severity = Severity.ERROR
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        direct, indirect = _shard_rooted(module)
+        if not direct and not indirect:
+            return
+        axes = _mesh_axes(module)
+        for fi in direct.values():
+            yield from self._check_direct(module, fi.node)
+            yield from self._check_axes(module, fi.node, axes)
+        for fi in indirect.values():
+            # helper params are often trace-time constants (candidate
+            # tuples, window sizes): only the axis-name check applies —
+            # a documented false-negative class
+            yield from self._check_axes(module, fi.node, axes)
+
+    def _check_direct(self, module: ModuleInfo, fn) -> Iterator[Finding]:
+        tracers = set(_param_names(fn)) - {"self", "cls"}
+        for node in _walk_body(fn):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                hits = _dynamic_uses(node.test, tracers)
+                if hits:
+                    yield self.finding(
+                        module, node,
+                        f"Python branch on per-shard value(s) "
+                        f"{', '.join(sorted(hits))} inside shard_mapped "
+                        f"'{fn.name}' — each shard would trace its own "
+                        "program; use lax.cond/lax.select")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in _NP_MODULES and \
+                    f.attr in ("asarray", "array") and \
+                    any(_dynamic_uses(a, tracers) for a in node.args):
+                yield self.finding(
+                    module, node,
+                    f"{f.value.id}.{f.attr}() on a per-shard value "
+                    f"inside shard_mapped '{fn.name}' forces a "
+                    "device->host sync per shard")
+            elif isinstance(f, ast.Attribute) and f.attr == "item" \
+                    and not node.args and \
+                    _dynamic_uses(f.value, tracers):
+                yield self.finding(
+                    module, node,
+                    f".item() on a per-shard value inside shard_mapped "
+                    f"'{fn.name}' forces a device->host sync per shard")
+            elif isinstance(f, ast.Name) and \
+                    f.id in ("float", "int", "bool") and \
+                    len(node.args) == 1 and \
+                    _dynamic_uses(node.args[0], tracers):
+                yield self.finding(
+                    module, node,
+                    f"{f.id}() concretizes a per-shard value inside "
+                    f"shard_mapped '{fn.name}' (host sync or trace "
+                    "error)")
+
+    def _check_axes(self, module: ModuleInfo, fn,
+                    axes: set[str]) -> Iterator[Finding]:
+        if not axes:
+            return
+        for node in _walk_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            used: list[str] = []
+            if name == "axis_index" and node.args:
+                used = _literal_strs(node.args[0])
+            elif name in _COLLECTIVES:
+                if len(node.args) > 1:
+                    used = _literal_strs(node.args[1])
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        used = _literal_strs(kw.value)
+            for ax in used:
+                if ax not in axes:
+                    yield self.finding(
+                        module, node,
+                        f"axis name '{ax}' in {name}() is not bound by "
+                        f"any enclosing Mesh (module binds: "
+                        f"{', '.join(sorted(axes))})")
+
+
 RULES: list[Rule] = [
     JaxHostSyncRule(), JaxTracerBranchRule(),
     JaxStaticArgRule(), JaxDonateHintRule(),
+    JaxUseAfterDonateRule(), JaxShardConsistencyRule(),
 ]

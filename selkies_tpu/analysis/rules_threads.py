@@ -1,0 +1,215 @@
+"""Thread-context race rules for the engine plane.
+
+The hot path is genuinely concurrent: capture threads dispatching into
+a depth-N PipelineRing, a per-capture finalizer thread, supervisor /
+prewarm / device-monitor background threads, and the asyncio serving
+loop all share encoder sessions, rate-control state, metrics, and the
+trace ring.  A single cross-lane ordering bug silently corrupts output
+or stalls the pipeline (the multi-lane encoder discipline of the
+split-frame V-PCC and NVENC pipeline literature, PAPERS.md).  These
+rules run the thread-context inference of :mod:`.contexts` over the
+module-local call graph of :mod:`.callgraph` and flag the three defect
+shapes that have actually bitten this stack:
+
+- ``THREAD-SHARED-MUTATION`` — the same ``self.<attr>`` is written from
+  two different execution contexts whose locksets share no lock.
+- ``THREAD-LOOP-ONLY-CALL`` — a loop-only asyncio API
+  (``create_task``/``ensure_future``/``call_soon``/``call_later``/
+  ``call_at``) reachable from a thread context without a threadsafe hop
+  (``call_soon_threadsafe`` / ``run_coroutine_threadsafe``).
+- ``THREAD-LOCK-ORDER`` — a cycle in the pairwise nested-acquisition
+  graph (lock A held while taking B somewhere, B held while taking A
+  elsewhere — the classic ABBA deadlock), including acquisitions
+  reached through module-local calls.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .callgraph import FuncInfo, graph_of
+from .contexts import CALLER, contexts_of, is_threadish, racing_pair
+from .core import Finding, ModuleInfo, Rule, Severity
+
+#: asyncio APIs that must run on the loop thread -> the threadsafe
+#: alternative named in the message
+_LOOP_ONLY = {
+    "create_task": "run_coroutine_threadsafe",
+    "ensure_future": "run_coroutine_threadsafe",
+    "call_soon": "call_soon_threadsafe",
+    "call_later": "call_soon_threadsafe (schedule from the loop)",
+    "call_at": "call_soon_threadsafe (schedule from the loop)",
+}
+
+
+def _ctx_names(ctxs: set) -> str:
+    return "/".join(sorted(ctxs)) if ctxs else CALLER
+
+
+class ThreadSharedMutationRule(Rule):
+    rule_id = "THREAD-SHARED-MUTATION"
+    description = ("the same self.<attr> is mutated from two execution "
+                   "contexts (thread/finalizer/loop/caller) whose "
+                   "locksets are disjoint — an unlocked cross-thread "
+                   "write")
+    default_severity = Severity.WARNING
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        graph = graph_of(module)
+        ctxs = contexts_of(module)
+        entry = graph.entry_locksets()
+        # (cls, attr) -> [(fn, mutation, full lockset, contexts)]
+        sites: dict[tuple, list] = {}
+        for fi in graph.funcs.values():
+            if fi.cls is None or fi.name in ("__init__", "__new__",
+                                             "__post_init__"):
+                # __init__ runs before the instance is published to any
+                # other thread; module functions have no self
+                continue
+            locks_in = entry.get(fi.node, frozenset())
+            for m in fi.mutations:
+                sites.setdefault((fi.cls, m.attr), []).append(
+                    (fi, m, m.held | locks_in, ctxs.get(fi.node, set())))
+        for (cls, attr), rows in sorted(
+                sites.items(), key=lambda kv: kv[0]):
+            reported = False
+            for i, (fi_a, m_a, locks_a, ctx_a) in enumerate(rows):
+                if reported:
+                    break
+                for fi_b, m_b, locks_b, ctx_b in rows[i + 1:]:
+                    if m_a.node is m_b.node:
+                        continue
+                    pair = racing_pair(ctx_a, ctx_b)
+                    if pair is None or locks_a & locks_b:
+                        continue
+                    # anchor on the thread-side write (the racing one)
+                    anchor_m = m_b if is_threadish(pair[1]) else m_a
+                    yield self.finding(
+                        module, anchor_m.node,
+                        f"self.{attr} is mutated from context "
+                        f"'{_ctx_names(ctx_a)}' ({fi_a.qualname}, line "
+                        f"{m_a.node.lineno}) and context "
+                        f"'{_ctx_names(ctx_b)}' ({fi_b.qualname}, line "
+                        f"{m_b.node.lineno}) with no common lock")
+                    reported = True   # one finding per attr per class
+                    break
+
+
+class ThreadLoopOnlyCallRule(Rule):
+    rule_id = "THREAD-LOOP-ONLY-CALL"
+    description = ("a loop-only asyncio API (create_task/ensure_future/"
+                   "call_soon/call_later) is invoked from a thread "
+                   "context — hop through call_soon_threadsafe or "
+                   "run_coroutine_threadsafe")
+    default_severity = Severity.ERROR
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        ctxs = contexts_of(module)
+        graph = graph_of(module)
+        for fi in graph.funcs.values():
+            threadish = sorted(c for c in ctxs.get(fi.node, set())
+                               if is_threadish(c))
+            if not threadish:
+                continue
+            for site in fi.calls:
+                alt = _LOOP_ONLY.get(site.callee)
+                if alt is None:
+                    continue
+                yield self.finding(
+                    module, site.node,
+                    f"{site.callee}() runs only on the event loop but "
+                    f"'{fi.qualname}' executes in context "
+                    f"'{threadish[0]}' — use {alt}")
+
+
+class ThreadLockOrderRule(Rule):
+    rule_id = "THREAD-LOCK-ORDER"
+    description = ("cycle in the nested lock-acquisition graph (lock A "
+                   "held while acquiring B, and B held while acquiring "
+                   "A elsewhere) — an ABBA deadlock waiting for the "
+                   "right interleaving")
+    default_severity = Severity.WARNING
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        graph = graph_of(module)
+        entry = graph.entry_locksets()
+
+        # transitive closure of locks a function may acquire, following
+        # module-local calls (cycle-safe memoized DFS)
+        acq_cache: dict[ast.AST, frozenset] = {}
+
+        def acq_closure(fi: FuncInfo, stack: frozenset) -> frozenset:
+            if fi.node in acq_cache:
+                return acq_cache[fi.node]
+            if fi.node in stack:
+                return frozenset()
+            stack = stack | {fi.node}
+            out = {ls.key for ls in fi.locks}
+            for site in fi.calls:
+                for callee in graph.resolve_call(graph.funcs[fi.node],
+                                                 site):
+                    out |= acq_closure(callee, stack)
+            acq_cache[fi.node] = frozenset(out)
+            return acq_cache[fi.node]
+
+        # edges held-lock -> acquired-lock, each with a witness site
+        edges: dict[tuple[str, str], ast.AST] = {}
+        for fi in graph.funcs.values():
+            base = entry.get(fi.node, frozenset())
+            for ls in fi.locks:
+                for held in base | ls.held:
+                    if held != ls.key:
+                        edges.setdefault((held, ls.key), ls.node)
+            for site in fi.calls:
+                held_here = base | site.held
+                if not held_here:
+                    continue
+                for callee in graph.resolve_call(fi, site):
+                    for acquired in acq_closure(callee, frozenset()):
+                        for held in held_here:
+                            if held != acquired:
+                                edges.setdefault((held, acquired),
+                                                 site.node)
+        # cycle detection over the lock digraph; report each cycle once
+        adj: dict[str, set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+        seen_cycles: set[frozenset] = set()
+
+        def find_cycle(start: str) -> list[str] | None:
+            stack = [(start, [start])]
+            visited = set()
+            while stack:
+                node, path = stack.pop()
+                for nxt in adj.get(node, ()):
+                    if nxt == start:
+                        return path
+                    if nxt not in visited:
+                        visited.add(nxt)
+                        stack.append((nxt, path + [nxt]))
+            return None
+
+        for start in sorted(adj):
+            cyc = find_cycle(start)
+            if cyc is None:
+                continue
+            key = frozenset(cyc)
+            if key in seen_cycles:
+                continue
+            seen_cycles.add(key)
+            order = " -> ".join(cyc + [cyc[0]])
+            witness = edges.get((cyc[0], cyc[1] if len(cyc) > 1
+                                 else cyc[0]))
+            if witness is None:
+                witness = next(iter(edges.values()))
+            yield self.finding(
+                module, witness,
+                f"lock acquisition cycle {order}: these locks are "
+                "taken in both nesting orders — impose one global "
+                "order or merge the critical sections")
+
+
+RULES: list[Rule] = [
+    ThreadSharedMutationRule(), ThreadLoopOnlyCallRule(),
+    ThreadLockOrderRule(),
+]

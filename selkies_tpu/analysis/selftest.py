@@ -1,0 +1,240 @@
+"""graftlint selftest: embedded per-rule fixtures (stdlib-only).
+
+Mirrors the other planes' ``python -m selkies_tpu.<plane> selftest``
+smoke: the CI lint image (no jax, no aiohttp) drives every rule's
+positive AND negative fixture through the real Analyzer, plus a
+context-propagation sanity check, so a refactor that silently lobotomizes
+a rule fails the lint job even before the pytest suite runs.
+"""
+from __future__ import annotations
+
+import json
+import textwrap
+
+from .core import Analyzer
+
+#: rule id -> (positive fixture, negative fixture).  Each positive must
+#: fire EXACTLY that rule at least once; each negative must fire nothing.
+FIXTURES: dict[str, tuple[str, str]] = {
+    "THREAD-SHARED-MUTATION": (
+        """
+        import threading
+        class Cap:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.qp = 0
+            def reconfigure(self, qp):     # caller context
+                with self._lock:
+                    self.qp = qp
+            def _run(self):                # capture-thread context
+                self.qp = self.qp + 1     # unlocked: races reconfigure
+            def start(self):
+                threading.Thread(target=self._run).start()
+        """,
+        """
+        import threading
+        class Cap:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.qp = 0
+            def reconfigure(self, qp):
+                with self._lock:
+                    self.qp = qp
+            def _run(self):
+                with self._lock:
+                    self.qp = self.qp + 1
+            def start(self):
+                threading.Thread(target=self._run).start()
+        """),
+    "THREAD-LOOP-ONLY-CALL": (
+        """
+        import asyncio, threading
+        class Svc:
+            def _worker(self):
+                t = self.loop.create_task(self._notify())
+                return t
+            def start(self):
+                threading.Thread(target=self._worker).start()
+        """,
+        """
+        import asyncio, threading
+        class Svc:
+            def _worker(self):
+                self.loop.call_soon_threadsafe(self._notify)
+                asyncio.run_coroutine_threadsafe(self.coro(), self.loop)
+            def start(self):
+                threading.Thread(target=self._worker).start()
+        """),
+    "THREAD-LOCK-ORDER": (
+        """
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def submit():
+            with A:
+                with B:
+                    pass
+        def drain():
+            with B:
+                with A:
+                    pass
+        """,
+        """
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def submit():
+            with A:
+                with B:
+                    pass
+        def drain():
+            with A:
+                with B:
+                    pass
+        """),
+    "JAX-USE-AFTER-DONATE": (
+        """
+        import functools, jax
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, delta):
+            return state + delta
+        def loop(state, d):
+            new = step(state, d)
+            return state + new
+        """,
+        """
+        import functools, jax
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, delta):
+            return state + delta
+        def loop(state, d):
+            state = step(state, d)
+            return state
+        """),
+    "JAX-SHARD-CONSISTENCY": (
+        """
+        import numpy as np
+        from jax.sharding import Mesh
+        from jax import shard_map
+        mesh = Mesh(np.array([0]), ("stripe",))
+        def build(local_fn=None):
+            def local(y):
+                return np.asarray(y)
+            return shard_map(local, mesh=mesh, in_specs=None,
+                             out_specs=None)
+        """,
+        """
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh
+        from jax import shard_map, lax
+        mesh = Mesh(np.array([0]), ("stripe",))
+        def build():
+            def local(y):
+                row0 = lax.axis_index("stripe")
+                return y + row0
+            return shard_map(local, mesh=mesh, in_specs=None,
+                             out_specs=None)
+        """),
+    # one fixture pair per v1 family keeps the old planes covered too
+    "JAX-HOST-SYNC": (
+        """
+        import jax, numpy as np
+        @jax.jit
+        def step(frame):
+            return np.asarray(frame)
+        """,
+        """
+        import jax, numpy as np
+        @jax.jit
+        def step(frame):
+            return frame * np.array([[1, 2]])
+        """),
+    "ASYNC-ORPHAN-TASK": (
+        """
+        import asyncio
+        def kick(coro):
+            asyncio.ensure_future(coro)
+        """,
+        """
+        import asyncio
+        def kick(tasks, coro):
+            t = asyncio.create_task(coro)
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
+        """),
+}
+
+
+def _context_sanity() -> list[str]:
+    """The propagation chain the thread rules stand on: a Thread target
+    and its helpers are thread-context; an async def stays loop."""
+    from .contexts import LOOP, contexts_of
+    failures: list[str] = []
+    analyzer = Analyzer()
+    src = textwrap.dedent("""
+        import threading
+        class C:
+            def _helper(self):
+                pass
+            def _run(self):
+                self._helper()
+            def start(self):
+                threading.Thread(target=self._run).start()
+            async def handler(self):
+                pass
+        """)
+    analyzer.run_source(src, "ctx.py")
+    import ast
+    tree = ast.parse(src)
+    from .core import ModuleInfo
+    module = ModuleInfo(path="ctx.py", source=src, tree=tree,
+                        lines=src.splitlines())
+    ctxs = contexts_of(module)
+    by_name = {n.name: ctxs[n] for n in ctxs}
+    if "thread:_run" not in by_name.get("_run", set()):
+        failures.append("contexts: Thread target '_run' not thread-ctx")
+    if "thread:_run" not in by_name.get("_helper", set()):
+        failures.append("contexts: '_helper' did not inherit thread ctx")
+    if by_name.get("start"):
+        failures.append("contexts: 'start' should be caller-only")
+    if LOOP not in by_name.get("handler", set()):
+        failures.append("contexts: async 'handler' not loop-ctx")
+    return failures
+
+
+def run_selftest(argv: list[str] | None = None) -> int:
+    as_json = bool(argv) and "--json" in argv
+    failures: list[str] = []
+    checks = 0
+    for rule_id, (pos, neg) in sorted(FIXTURES.items()):
+        analyzer = Analyzer()
+        fired = {f.rule_id
+                 for f in analyzer.run_source(textwrap.dedent(pos),
+                                              "fixture_pos.py")}
+        checks += 1
+        if rule_id not in fired:
+            failures.append(
+                f"{rule_id}: positive fixture did not fire "
+                f"(got: {sorted(fired) or 'nothing'})")
+        analyzer = Analyzer()
+        fired_neg = {f.rule_id
+                     for f in analyzer.run_source(textwrap.dedent(neg),
+                                                  "fixture_neg.py")}
+        checks += 1
+        if rule_id in fired_neg:
+            failures.append(f"{rule_id}: negative fixture fired")
+        if analyzer.internal_errors:
+            failures.extend(analyzer.internal_errors)
+    ctx_failures = _context_sanity()
+    checks += 4
+    failures.extend(ctx_failures)
+    if as_json:
+        print(json.dumps({"checks": checks, "failures": failures,
+                          "ok": not failures}, indent=1))
+    else:
+        for f in failures:
+            print(f"selftest FAIL: {f}")
+        print(f"graftlint selftest: {checks} checks, "
+              f"{len(failures)} failure(s)")
+    return 1 if failures else 0

@@ -133,10 +133,18 @@ class ScreenCapture:
                     self._session = H264EncoderSession(settings)
             else:
                 self._session = JpegEncoderSession(settings)
-            # per-frame CBR state: empty bucket, base = the session's crf
-            self._rc_fullness = 0.0
-            self._rc_qp0 = getattr(self._session, "qp",
-                                   settings.video_crf)
+            # per-frame CBR state: empty bucket, base = the session's
+            # crf. Under self._lock: an ABANDONED capture thread (timed
+            # -out join) may still be inside _rate_control_frame when
+            # the replacement run resets the bucket — unlocked, the
+            # stale thread's read-modify-write could resurrect the old
+            # fullness and steer the NEW session's qp off a stale bucket
+            # (graftlint THREAD-SHARED-MUTATION, regression-tested in
+            # tests/test_engine.py::test_rate_control_state_is_locked)
+            with self._lock:
+                self._rc_fullness = 0.0
+                self._rc_qp0 = getattr(self._session, "qp",
+                                       settings.video_crf)
             self._source = make_source(self._source_kind,
                                        settings.capture_width,
                                        settings.capture_height,
@@ -207,14 +215,19 @@ class ScreenCapture:
         """Clamp frames in flight (relay backpressure window / ladder):
         the effective depth becomes ``min(settings.pipeline_depth,
         depth)``. ``None`` lifts the clamp. Takes effect within one
-        frame turn; no session rebuild."""
-        self._pipeline_clamp = None if depth is None else max(1, int(depth))
+        frame turn; no session rebuild. Lock-guarded like the other
+        cross-thread tunables: the relay writes it from the loop while
+        the capture thread reads it every tick."""
+        with self._lock:
+            self._pipeline_clamp = None if depth is None \
+                else max(1, int(depth))
 
     def effective_pipeline_depth(self) -> int:
         """The depth the loop is currently allowed to run at."""
         from .pipeline import effective_depth
-        return effective_depth(self._settings, self._pipeline_clamp,
-                               PIPELINE_DEPTH)
+        with self._lock:
+            clamp = self._pipeline_clamp
+        return effective_depth(self._settings, clamp, PIPELINE_DEPTH)
 
     def update_capture_region(self, x: int, y: int, w: int, h: int) -> None:
         # live region retarget (reference pixelflux x11 path); requires a
@@ -297,10 +310,15 @@ class ScreenCapture:
             return
         fps = max(s.target_fps, 1.0)
         rate_bps8 = s.video_bitrate_kbps * 125.0      # bytes per second
-        self._rc_fullness = max(-rate_bps8, min(
-            rate_bps8, self._rc_fullness + frame_bytes - rate_bps8 / fps))
+        # rc state under self._lock: races start_capture's reset when an
+        # abandoned thread outlives its run (see start_capture)
+        with self._lock:
+            self._rc_fullness = max(-rate_bps8, min(
+                rate_bps8,
+                self._rc_fullness + frame_bytes - rate_bps8 / fps))
+            fullness, qp0 = self._rc_fullness, self._rc_qp0
         # bucket at +-1 s of rate maps to +-8 qp around the base
-        qp = int(round(self._rc_qp0 + self._rc_fullness / rate_bps8 * 8.0))
+        qp = int(round(qp0 + fullness / rate_bps8 * 8.0))
         qp = max(s.video_min_qp, min(s.video_max_qp, qp))
         if qp != sess.qp:
             sess.set_qp(qp)
@@ -315,16 +333,22 @@ class ScreenCapture:
         actual_kbps = window_bytes * 8 / 1000 / window_s
         if s.output_mode == "h264":
             rate_bps8 = s.video_bitrate_kbps * 125.0
-            pinned = abs(self._rc_fullness) >= rate_bps8 * 0.95
-            if pinned and self._rc_fullness > 0 \
-                    and self._rc_qp0 < s.video_max_qp:
-                # adapt faster the further off target the content sits
-                step = 2 if actual_kbps > s.video_bitrate_kbps * 2 else 1
-                self._rc_qp0 = min(self._rc_qp0 + step, s.video_max_qp)
-            elif pinned and self._rc_fullness < 0 \
-                    and actual_kbps < s.video_bitrate_kbps * 0.7 \
-                    and self._rc_qp0 > s.video_min_qp:
-                self._rc_qp0 -= 1
+            # same lock discipline as _rate_control_frame: the base-qp
+            # re-centre must not interleave with a reconfigure's reset
+            with self._lock:
+                pinned = abs(self._rc_fullness) >= rate_bps8 * 0.95
+                if pinned and self._rc_fullness > 0 \
+                        and self._rc_qp0 < s.video_max_qp:
+                    # adapt faster the further off target the content
+                    # sits
+                    step = 2 if actual_kbps > s.video_bitrate_kbps * 2 \
+                        else 1
+                    self._rc_qp0 = min(self._rc_qp0 + step,
+                                       s.video_max_qp)
+                elif pinned and self._rc_fullness < 0 \
+                        and actual_kbps < s.video_bitrate_kbps * 0.7 \
+                        and self._rc_qp0 > s.video_min_qp:
+                    self._rc_qp0 -= 1
             return
         q = s.jpeg_quality
         if actual_kbps > s.video_bitrate_kbps * 1.15 and q > 10:

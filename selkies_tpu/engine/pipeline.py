@@ -122,11 +122,17 @@ class PipelineRing:
     # ------------------------------------------------------------- producers
     @property
     def depth(self) -> int:
-        return self._depth
+        # under the cond like every other _depth access: retarget()'s
+        # compare-then-resize on the capture thread must see a value
+        # coherent with a concurrent set_depth (backpressure clamp /
+        # ladder rung-0 fire from the loop)
+        with self._cond:
+            return self._depth
 
     @property
     def in_flight(self) -> int:
-        return self._in_flight
+        with self._cond:
+            return self._in_flight
 
     @property
     def failed(self) -> bool:

@@ -290,7 +290,13 @@ class WaylandBackend:
         self._km = DynamicKeymap()
         self._lock = threading.Lock()
         self._extent = screen_size or self._wl.output_size() or (1920, 1080)
+        # clipboard cache + generation, shared between the loop thread
+        # (set_clipboard) and the wl-paste puller threads: the gen check
+        # and the cache write must be ONE atomic step or a stale pull
+        # lands over a newer set (graftlint THREAD-SHARED-MUTATION)
+        self._clip_lock = threading.Lock()
         self._clip: tuple[bytes, str] = (b"", "text/plain")
+        self._clip_gen = 0
         self._display = display            # wl-copy/wl-paste must hit the
         #                                    SAME compositor as the protocol
 
@@ -339,9 +345,12 @@ class WaylandBackend:
     # only refresh the in-process cache
     def set_clipboard(self, data, mime):
         # generation guard: a wl-paste pull that started BEFORE this set
-        # must not land its (now stale) selection over the new value
-        self._clip_gen = getattr(self, "_clip_gen", 0) + 1
-        self._clip = (data, mime)
+        # must not land its (now stale) selection over the new value —
+        # bump + write atomically, so the pull's gen check can't pass
+        # between them
+        with self._clip_lock:
+            self._clip_gen += 1
+            self._clip = (data, mime)
         if not mime.startswith("text"):
             return
 
@@ -356,7 +365,8 @@ class WaylandBackend:
                          name="wl-copy").start()
 
     def get_clipboard(self):
-        gen = getattr(self, "_clip_gen", 0)
+        with self._clip_lock:
+            gen, cached = self._clip_gen, self._clip
 
         def _pull():
             try:
@@ -364,14 +374,18 @@ class WaylandBackend:
                 r = subprocess.run(["wl-paste", "--no-newline"],
                                    capture_output=True, timeout=2,
                                    env=self._wl_env())
-                if r.returncode == 0 and r.stdout \
-                        and getattr(self, "_clip_gen", 0) == gen:
-                    self._clip = (r.stdout, "text/plain")
+                if r.returncode == 0 and r.stdout:
+                    # check-and-write under the lock: a set_clipboard
+                    # racing this pull either bumps the gen first (pull
+                    # discards) or sees the pulled value superseded
+                    with self._clip_lock:
+                        if self._clip_gen == gen:
+                            self._clip = (r.stdout, "text/plain")
             except (OSError, subprocess.TimeoutExpired):
                 pass
         threading.Thread(target=_pull, daemon=True,
                          name="wl-paste").start()
-        return self._clip         # current cache; the pull lands next read
+        return cached             # current cache; the pull lands next read
 
     def close(self):
         self._wl.close()

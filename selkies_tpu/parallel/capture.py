@@ -50,7 +50,10 @@ class MultiSeatCapture:
         #: called with the exception when the loop DIES, never on stop
         self.on_death: Optional[Callable[[BaseException], None]] = None
         #: runtime frames-in-flight clamp (same contract as
-        #: ScreenCapture.set_pipeline_clamp)
+        #: ScreenCapture.set_pipeline_clamp) — written from the loop,
+        #: read per tick by the capture thread, so lock-guarded like
+        #: ScreenCapture's
+        self._lock = threading.Lock()
         self._pipeline_clamp: Optional[int] = None
 
     # ----------------------------------------------------- reference surface
@@ -130,12 +133,15 @@ class MultiSeatCapture:
         self._cursor_callback = cb
 
     def set_pipeline_clamp(self, depth: Optional[int]) -> None:
-        self._pipeline_clamp = None if depth is None else max(1, int(depth))
+        with self._lock:
+            self._pipeline_clamp = None if depth is None \
+                else max(1, int(depth))
 
     def effective_pipeline_depth(self) -> int:
         from ..engine.pipeline import effective_depth
-        return effective_depth(self._settings, self._pipeline_clamp,
-                               PIPELINE_DEPTH)
+        with self._lock:
+            clamp = self._pipeline_clamp
+        return effective_depth(self._settings, clamp, PIPELINE_DEPTH)
 
     def restart(self, settings: Optional[CaptureSettings] = None) -> None:
         with self._api_lock:

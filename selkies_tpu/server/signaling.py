@@ -154,8 +154,10 @@ class SignalingServer:
             async def _close_old(p=evicted):
                 try:
                     await p.ws.close(code=4001, message=b"superseded")
-                except Exception:
-                    pass
+                except (OSError, RuntimeError, ConnectionError,
+                        asyncio.TimeoutError):
+                    logger.debug("superseded peer %s close failed",
+                                 p.uid, exc_info=True)
             task = asyncio.get_running_loop().create_task(_close_old())
             self._bg_tasks.add(task)        # strong ref: loop weak-refs tasks
             task.add_done_callback(self._bg_tasks.discard)

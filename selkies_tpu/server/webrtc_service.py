@@ -162,13 +162,19 @@ class WebRTCService(BaseStreamingService):
             try:
                 self.audio.on_raw_frame = None
                 await self.audio.stop()
-            except Exception:
-                pass
+            except (OSError, RuntimeError, asyncio.TimeoutError):
+                # audio teardown failing must not block the service
+                # switch, but it must be visible (graftlint
+                # ASYNC-SWALLOWED-EXC: narrowed from a silent
+                # except-Exception)
+                logger.debug("audio pipeline stop failed", exc_info=True)
         for peer in list(self.signaling.peers.values()):
             try:
                 await peer.ws.close()
-            except Exception:
-                pass
+            except (OSError, RuntimeError, ConnectionError,
+                    asyncio.TimeoutError):
+                logger.debug("signaling peer close failed (%s)",
+                             peer.uid, exc_info=True)
         if self.input_handler is not None:
             await self.input_handler.stop()
 
@@ -378,8 +384,9 @@ class WebRTCService(BaseStreamingService):
             if cap is not None:
                 try:
                     cap.stop_capture()
-                except Exception:
-                    pass
+                except (OSError, RuntimeError, ValueError):
+                    logger.debug("cleanup of failed capture also failed",
+                                 exc_info=True)
             return
         self._captures[display_id] = cap
         logger.info("webrtc capture started (single-stream h264, %s)",
@@ -395,8 +402,10 @@ class WebRTCService(BaseStreamingService):
         def _stop():
             try:
                 cap.stop_capture()
-            except Exception:
-                pass
+            except (OSError, RuntimeError, ValueError):
+                # off-loop stopper thread: nothing above us to catch it
+                logger.warning("webrtc capture stop failed (%s)",
+                               display_id, exc_info=True)
 
         t = threading.Thread(target=_stop, name="webrtc-capture-stop",
                              daemon=True)
@@ -434,8 +443,9 @@ class WebRTCService(BaseStreamingService):
         if cap is not None:
             try:
                 cap.request_idr_frame()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                logger.debug("IDR request failed (%s)", display_id,
+                             exc_info=True)
 
     def _on_remb(self, bps: int, display_id: str = "primary") -> None:
         """Receiver bitrate estimate -> CBR target, user setting as the
@@ -450,8 +460,9 @@ class WebRTCService(BaseStreamingService):
         kbps = min(ceiling, max(250, bps // 1000))
         try:
             cap.update_video_bitrate(kbps)
-        except Exception:
-            pass
+        except (OSError, RuntimeError, ValueError):
+            logger.debug("REMB bitrate update failed (%s)", display_id,
+                         exc_info=True)
 
     def _make_mic_decoder(self):
         """Decoder matching what the m-line negotiated: plain mono Opus,
@@ -502,8 +513,12 @@ class WebRTCService(BaseStreamingService):
         for sess in self._sessions.values():
             try:
                 sess.peer.send_audio_frame(opus_packet, ts48)
-            except Exception:
-                pass
+            except (OSError, RuntimeError, ValueError):
+                # per-packet path: one peer's dead transport must not
+                # mute the others, but a persistently failing send is
+                # debuggable only if it logs
+                logger.debug("audio send failed (%s)", sess.caller_uid,
+                             exc_info=True)
 
     def _on_input_verb(self, label: str, text,
                        display_id: str = "primary") -> None:

@@ -25,6 +25,7 @@ import collections
 import json
 import logging
 import os
+import threading
 import time
 from typing import Optional
 
@@ -175,7 +176,16 @@ class WebSocketsService(BaseStreamingService):
         self._running = False
         self._bg_tasks: set[asyncio.Task] = set()
         self._starting_captures: set[str] = set()
+        # recording tap: _rec_buf is loop-affine (swapped on the loop
+        # before dispatch), but _rec_file is opened/written on executor
+        # threads and closed by stop() on the loop — the lock makes
+        # close-vs-inflight-write an ordering, not a ValueError
+        # (graftlint THREAD-SHARED-MUTATION)
+        self._rec_lock = threading.Lock()
         self._rec_file = None
+        self._rec_closed = False     # stop() ran: a late executor flush
+        #                              must NOT reopen the file (fd leak
+        #                              + write-after-teardown)
         self._rec_buf = bytearray()
         self._last_conn_by_ip: dict[str, float] = {}
         self._grace_task: Optional[asyncio.Task] = None
@@ -270,6 +280,8 @@ class WebSocketsService(BaseStreamingService):
     async def start(self) -> None:
         self._loop = asyncio.get_event_loop()
         self._running = True
+        with self._rec_lock:
+            self._rec_closed = False    # a restart records again
         if self.input_handler is not None \
                 and self.input_handler.send_clipboard is None:
             async def _push_clipboard(data: bytes, mime: str) -> None:
@@ -641,29 +653,51 @@ class WebSocketsService(BaseStreamingService):
             await self.input_handler.stop()
         if self._rec_buf:
             buf, self._rec_buf = self._rec_buf, bytearray()
-            try:
-                self._flush_recording(buf)
-            except (OSError, ValueError):
-                # final flush on teardown: losing the recording tail is
-                # acceptable, losing the stop path is not — but say so.
-                # ValueError is the live class here: a write against a
-                # file another teardown path already closed.
-                logger.warning("final recording flush failed",
-                               exc_info=True)
-        if self._rec_file is not None:
-            try:
-                self._rec_file.close()
-            except OSError:
-                pass
-            self._rec_file = None
+        else:
+            buf = b""
+
+        def _close_recording() -> None:
+            # final flush + close run OFF-LOOP: _rec_lock is held across
+            # disk writes by executor flushes, so acquiring it on the
+            # loop could stall every session behind a slow filesystem
+            if buf:
+                try:
+                    self._flush_recording(buf)
+                except OSError:
+                    # losing the recording tail on teardown is
+                    # acceptable; losing the stop path is not
+                    logger.warning("final recording flush failed",
+                                   exc_info=True)
+            with self._rec_lock:
+                self._rec_closed = True
+                if self._rec_file is not None:
+                    try:
+                        self._rec_file.close()
+                    except OSError:
+                        pass
+                    self._rec_file = None
+
+        await asyncio.get_running_loop().run_in_executor(
+            None, _close_recording)
 
     def _flush_recording(self, buf: bytes) -> None:
-        """Executor-side disk append for the recording tap."""
+        """Executor-side disk append for the recording tap. The lock
+        orders this against stop()'s close: an in-flight flush completes
+        before the file handle dies (previously a write-after-close
+        ValueError when teardown raced the stats-loop flush), and a
+        flush that arrives AFTER the close drops its tail instead of
+        reopening the file (an fd nothing would ever close again)."""
         try:
-            if self._rec_file is None:
-                self._rec_file = open(self.settings.recording_path, "ab")
-            self._rec_file.write(buf)
-            self._rec_file.flush()
+            with self._rec_lock:
+                if self._rec_closed:
+                    logger.debug("recording flush after stop: %d bytes "
+                                 "dropped", len(buf))
+                    return
+                if self._rec_file is None:
+                    self._rec_file = open(self.settings.recording_path,
+                                          "ab")
+                self._rec_file.write(buf)
+                self._rec_file.flush()
         except OSError as e:
             logger.warning("recording tap failed: %s; disabling", e)
             self.settings.set_server("recording_path", "")

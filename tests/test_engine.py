@@ -212,3 +212,101 @@ def test_watermark_burned_into_stream(tmp_path):
     rs = np.asarray(img_s)[16:32, 16:32]
     assert not np.array_equal(rp, rs)
     assert rs[..., 0].mean() > 200 and rs[..., 1].mean() < 80
+
+
+# -- cross-thread state discipline (graftlint THREAD-SHARED-MUTATION) --------
+
+class _RecordingLock:
+    """threading.Lock stand-in that counts acquisitions — the regression
+    contract for the rate-control/clamp lock fixes is 'these paths hold
+    the tunables lock', not a timing-dependent race reproduction."""
+
+    def __init__(self):
+        self.entered = 0
+        self.held = False
+
+    def __enter__(self):
+        self.entered += 1
+        self.held = True
+        return self
+
+    def __exit__(self, *exc):
+        self.held = False
+        return False
+
+
+class _QpSession:
+    def __init__(self):
+        self.qp = 30
+        self.qp_sets = []
+
+    def set_qp(self, qp):
+        self.qp_sets.append(qp)
+        self.qp = qp
+
+
+def _rc_capture():
+    cap = ScreenCapture()
+    cap._lock = _RecordingLock()
+    s = CaptureSettings(**SMALL)
+    s.output_mode = "h264"
+    s.use_cbr = True
+    s.video_bitrate_kbps = 1000
+    cap._settings = s
+    cap._session = _QpSession()
+    cap._rc_fullness = 0.0
+    cap._rc_qp0 = 30
+    return cap
+
+
+def test_rate_control_state_is_locked():
+    """An ABANDONED capture thread (timed-out join) can still be inside
+    the rate controller when start_capture resets the bucket for the
+    replacement run — every _rc_* mutation must hold the tunables lock
+    (the race graftlint's THREAD-SHARED-MUTATION rule flagged)."""
+    cap = _rc_capture()
+    cap._rate_control_frame(50_000)
+    assert cap._lock.entered == 1
+    assert not cap._lock.held            # released before sess.set_qp
+    cap._rate_control(5_000_000, 1.0)    # way over rate: re-centres qp0
+    assert cap._lock.entered == 2
+
+
+def test_rate_control_still_steers_qp_under_lock():
+    """The lock fix must not change controller behaviour: a flood of
+    bytes fills the bucket and pushes qp up; idle frames drain it."""
+    cap = _rc_capture()
+    for _ in range(10):
+        cap._rate_control_frame(200_000)
+    assert cap._session.qp > 30
+    for _ in range(300):                 # bucket drains ~rate/fps per
+        cap._rate_control_frame(0)       # tick: give it room to empty
+    assert cap._session.qp < 30
+
+
+def test_pipeline_clamp_is_locked():
+    """set_pipeline_clamp (loop side) and effective_pipeline_depth
+    (capture-thread side) both take the lock around the shared clamp."""
+    cap = ScreenCapture()
+    cap._lock = _RecordingLock()
+    s = CaptureSettings(**SMALL)
+    s.pipeline_depth = 4
+    cap._settings = s
+    cap.set_pipeline_clamp(2)
+    assert cap._lock.entered == 1
+    assert cap.effective_pipeline_depth() == 2
+    assert cap._lock.entered == 2
+    cap.set_pipeline_clamp(None)
+    assert cap.effective_pipeline_depth() == 4
+
+
+def test_multiseat_pipeline_clamp_is_locked():
+    from selkies_tpu.parallel.capture import MultiSeatCapture
+    cap = MultiSeatCapture(2)
+    cap._lock = _RecordingLock()
+    s = CaptureSettings(**SMALL)
+    s.pipeline_depth = 3
+    cap._settings = s
+    cap.set_pipeline_clamp(1)
+    assert cap.effective_pipeline_depth() == 1
+    assert cap._lock.entered == 2

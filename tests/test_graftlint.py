@@ -607,3 +607,610 @@ def test_repo_findings_subset_of_baseline():
     fresh = new_findings(findings, baseline)
     assert fresh == [], "new graftlint findings:\n" + "\n".join(
         f.render() for f in fresh)
+
+
+# -- THREAD-SHARED-MUTATION --------------------------------------------------
+
+RACE_SRC = """
+    import threading
+    class Cap:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.qp = 0
+        def reconfigure(self, qp):
+            with self._lock:
+                self.qp = qp
+        def _run(self):
+            self.qp = self.qp + 1
+        def start(self):
+            threading.Thread(target=self._run).start()
+    """
+
+
+def test_shared_mutation_fires_on_seeded_race():
+    f = run(RACE_SRC)
+    assert rule_ids(f) == ["THREAD-SHARED-MUTATION"]
+    assert "self.qp" in f[0].message and "thread:_run" in f[0].message
+
+
+def test_shared_mutation_silent_with_common_lock():
+    assert run("""
+        import threading
+        class Cap:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.qp = 0
+            def reconfigure(self, qp):
+                with self._lock:
+                    self.qp = qp
+            def _run(self):
+                with self._lock:
+                    self.qp = self.qp + 1
+            def start(self):
+                threading.Thread(target=self._run).start()
+        """) == []
+
+
+def test_shared_mutation_lock_carries_through_calls():
+    """Interprocedural locksets: a mutation inside a helper only ever
+    called under the lock carries the lock (entry-lockset fixpoint)."""
+    assert run("""
+        import threading
+        class Cap:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.qp = 0
+            def _set(self, qp):
+                self.qp = qp
+            def reconfigure(self, qp):
+                with self._lock:
+                    self._set(qp)
+            def _run(self):
+                with self._lock:
+                    self._set(1)
+            def start(self):
+                threading.Thread(target=self._run).start()
+        """) == []
+
+
+def test_shared_mutation_init_does_not_count():
+    """__init__ runs before the instance is shared — seeding state there
+    races nothing."""
+    assert run("""
+        import threading
+        class Cap:
+            def __init__(self):
+                self.qp = 0
+            def _run(self):
+                self.qp = 1
+            def start(self):
+                threading.Thread(target=self._run).start()
+        """) == []
+
+
+def test_shared_mutation_finalizer_vs_thread():
+    """PipelineRing finalize-fn context races the capture thread — but a
+    shared lock (via a local alias) makes it safe."""
+    f = run("""
+        import threading
+        from .pipeline import PipelineRing
+        class Cap:
+            def _deliver(self, out):
+                self.nbytes = len(out)
+            def _run(self):
+                ring = PipelineRing(self._deliver, depth=2)
+                self.nbytes = 0
+                ring.submit({})
+            def start(self):
+                threading.Thread(target=self._run).start()
+        """)
+    assert rule_ids(f) == ["THREAD-SHARED-MUTATION"]
+    assert "finalizer" in f[0].message
+
+
+# -- THREAD-LOOP-ONLY-CALL ---------------------------------------------------
+
+def test_loop_only_call_fires_from_thread_context():
+    f = run("""
+        import asyncio, threading
+        class Svc:
+            def _worker(self):
+                t = self.loop.create_task(self._notify())
+                return t
+            def start(self):
+                threading.Thread(target=self._worker).start()
+        """)
+    assert rule_ids(f) == ["THREAD-LOOP-ONLY-CALL"]
+    assert "call_soon_threadsafe" in f[0].message \
+        or "run_coroutine_threadsafe" in f[0].message
+
+
+def test_threadsafe_hop_is_fine():
+    """The sanctioned thread->loop hops never fire; neither do loop-only
+    APIs used from loop context."""
+    assert run("""
+        import asyncio, threading
+        class Svc:
+            def _worker(self):
+                self.loop.call_soon_threadsafe(self._notify)
+                asyncio.run_coroutine_threadsafe(self.coro(), self.loop)
+            def start(self):
+                threading.Thread(target=self._worker).start()
+            async def handler(self):
+                t = asyncio.create_task(self.coro())
+                await t
+        """) == []
+
+
+def test_loop_only_call_reaches_thread_helpers():
+    """Context propagates through module-local calls: a helper reached
+    only from a Thread target is thread code."""
+    f = run("""
+        import asyncio, threading
+        class Svc:
+            def _kick(self):
+                t = asyncio.ensure_future(self.coro())
+                return t
+            def _worker(self):
+                self._kick()
+            def start(self):
+                threading.Thread(target=self._worker).start()
+        """)
+    assert rule_ids(f) == ["THREAD-LOOP-ONLY-CALL"]
+
+
+# -- THREAD-LOCK-ORDER -------------------------------------------------------
+
+def test_lock_order_cycle_fires():
+    f = run("""
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def submit():
+            with A:
+                with B:
+                    pass
+        def drain():
+            with B:
+                with A:
+                    pass
+        """)
+    assert rule_ids(f) == ["THREAD-LOCK-ORDER"]
+    assert "A" in f[0].message and "B" in f[0].message
+
+
+def test_lock_order_consistent_nesting_is_fine():
+    assert run("""
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def submit():
+            with A:
+                with B:
+                    pass
+        def drain():
+            with A:
+                with B:
+                    pass
+        """) == []
+
+
+def test_lock_order_cycle_through_call():
+    """The acquisition graph follows module-local calls: holding A while
+    calling a function that takes B closes the cycle."""
+    f = run("""
+        import threading
+        class S:
+            def _take_b(self):
+                with self._b:
+                    pass
+            def fwd(self):
+                with self._a:
+                    self._take_b()
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    assert rule_ids(f) == ["THREAD-LOCK-ORDER"]
+
+
+def test_lock_order_alias_resolves():
+    """`turn = GLOBAL_LOCK; with turn:` keys on the module lock (the
+    engine capture-loop idiom), so aliased nesting still makes edges."""
+    f = run("""
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def submit():
+            turn = A
+            with turn:
+                with B:
+                    pass
+        def drain():
+            with B:
+                with A:
+                    pass
+        """)
+    assert rule_ids(f) == ["THREAD-LOCK-ORDER"]
+
+
+# -- JAX-USE-AFTER-DONATE ----------------------------------------------------
+
+def test_use_after_donate_fires():
+    f = run("""
+        import functools, jax
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, delta):
+            return state + delta
+        def loop(state, d):
+            new = step(state, d)
+            return state + new
+        """)
+    assert rule_ids(f) == ["JAX-USE-AFTER-DONATE"]
+    assert "'state'" in f[0].message
+
+
+def test_use_after_donate_rebind_is_fine():
+    """state = step(state, d): the donated binding is rebound from the
+    output — the prev_out discipline."""
+    assert run("""
+        import functools, jax
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, delta):
+            return state + delta
+        def loop(state, d):
+            state = step(state, d)
+            return state
+        """) == []
+
+
+def test_use_after_donate_tracks_wrap_step_factories():
+    """The engine idiom: a factory returns perf.wrap_step(jax.jit(f,
+    donate_argnums=donate_argnums_for_backend(...))), the session binds
+    it to self._step, encode() donates self._prev — reading the attr
+    after the call without rebinding fires; rebinding from the output
+    does not."""
+    bad = run("""
+        import jax
+        from ..obs import perf as _perf
+        def donate_argnums_for_backend(nums):
+            return nums
+        def _jitted(mode):
+            def step(frame, prev):
+                return frame, prev
+            return _perf.wrap_step(
+                "s", jax.jit(step,
+                             donate_argnums=donate_argnums_for_backend(
+                                 (1,))))
+        class Sess:
+            def _build(self):
+                return _jitted("i")
+            def setup(self):
+                self._step = self._build()
+            def encode(self, frame):
+                out, prev = self._step(frame, self._prev)
+                return self._prev.sum() + out
+        """)
+    assert rule_ids(bad) == ["JAX-USE-AFTER-DONATE"]
+    good = """
+        import jax
+        from ..obs import perf as _perf
+        def _jitted(mode):
+            def step(frame, prev):
+                return frame, prev
+            return _perf.wrap_step(
+                "s", jax.jit(step, donate_argnums=(1,)))
+        class Sess:
+            def setup(self):
+                self._step = _jitted("i")
+            def encode(self, frame):
+                out, prev_out = self._step(frame, self._prev)
+                self._prev = prev_out
+                return self._prev.sum() + out
+        """
+    assert run(good) == []
+
+
+def test_use_after_donate_same_call_args_do_not_count():
+    """The donating call's own argument list is not a 'later read'."""
+    assert run("""
+        import functools, jax
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(a, b):
+            return a + b
+        def loop(a, b):
+            a, b = step(a, b)
+            return a, b
+        """) == []
+
+
+# -- JAX-SHARD-CONSISTENCY ---------------------------------------------------
+
+SHARD_PRELUDE = """
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import shard_map, lax
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array([0]), ("stripe",))
+    """
+
+
+def test_shard_host_sync_fires():
+    f = run(SHARD_PRELUDE + """
+    def build():
+        def local(y):
+            return np.asarray(y)
+        return shard_map(local, mesh=mesh, in_specs=None, out_specs=None)
+    """)
+    assert rule_ids(f) == ["JAX-SHARD-CONSISTENCY"]
+    assert "host" in f[0].message
+
+
+def test_shard_item_and_branch_fire():
+    f = run(SHARD_PRELUDE + """
+    def build():
+        def local(y):
+            if y.sum() > 0:
+                return y
+            return y * y.max().item()
+        return shard_map(local, mesh=mesh, in_specs=None, out_specs=None)
+    """)
+    assert sorted(rule_ids(f)) == ["JAX-SHARD-CONSISTENCY"] * 2
+
+
+def test_shard_unbound_axis_name_fires():
+    f = run(SHARD_PRELUDE + """
+    def build():
+        def local(y):
+            row0 = lax.axis_index("stripes")    # typo: mesh binds 'stripe'
+            return y + row0
+        return shard_map(local, mesh=mesh, in_specs=None, out_specs=None)
+    """)
+    assert rule_ids(f) == ["JAX-SHARD-CONSISTENCY"]
+    assert "'stripes'" in f[0].message and "stripe" in f[0].message
+
+
+def test_shard_clean_program_is_fine():
+    """Bound axis names, branches on closure statics, helper calls with
+    static params (the stripes.py candidate-tuple idiom): no findings."""
+    assert run(SHARD_PRELUDE + """
+    def helper(y, candidates):
+        sel = np.asarray(candidates)        # static tuple: NOT per-shard
+        return y + sel.shape[0]
+    def build(want_recon=False):
+        def local(y):
+            row0 = lax.axis_index("stripe")
+            if want_recon:                   # closure var, not per-shard
+                return helper(y, ((0, 0),))
+            return y + row0
+        return shard_map(local, mesh=mesh, in_specs=None, out_specs=None)
+    """) == []
+
+
+# -- context propagation (contexts.py unit surface) --------------------------
+
+def _contexts(src: str):
+    import ast as _ast
+    from selkies_tpu.analysis.contexts import contexts_of
+    from selkies_tpu.analysis.core import ModuleInfo
+    src = textwrap.dedent(src)
+    tree = _ast.parse(src)
+    m = ModuleInfo(path="m.py", source=src, tree=tree,
+                   lines=src.splitlines())
+    return {n.name: c for n, c in contexts_of(m).items()}
+
+
+def test_context_thread_target_and_helpers():
+    ctx = _contexts("""
+        import threading
+        class C:
+            def _helper(self):
+                pass
+            def _run(self):
+                self._helper()
+            def start(self):
+                threading.Thread(target=self._run).start()
+        """)
+    assert ctx["_run"] == {"thread:_run"}
+    assert ctx["_helper"] == {"thread:_run"}
+    assert ctx["start"] == set()                 # caller-only
+
+
+def test_context_finalizer_and_loop_seeds():
+    ctx = _contexts("""
+        import asyncio
+        from .pipeline import PipelineRing, retarget
+        class C:
+            def _deliver(self, out):
+                pass
+            def _on_loop(self):
+                pass
+            def wire(self, loop):
+                ring = PipelineRing(self._deliver, depth=2)
+                ring2 = retarget(None, 2, self._deliver, "x")
+                loop.call_soon_threadsafe(self._on_loop)
+            async def handler(self):
+                pass
+        """)
+    assert ctx["_deliver"] == {"finalizer"}
+    assert ctx["_on_loop"] == {"loop"}
+    assert ctx["handler"] == {"loop"}
+
+
+def test_context_thread_does_not_enter_async_defs():
+    """A thread fn calling an async def cannot run its body — loop
+    context stays loop."""
+    ctx = _contexts("""
+        import threading
+        class C:
+            async def handler(self):
+                pass
+            def _run(self):
+                c = self.handler()
+                return c
+            def start(self):
+                threading.Thread(target=self._run).start()
+        """)
+    assert ctx["handler"] == {"loop"}
+
+
+def test_context_supervisor_adopt_is_loop():
+    """Supervisor-adopted restart callables fire from the loop's
+    call_later (the default schedule seam)."""
+    ctx = _contexts("""
+        class C:
+            def _restart(self):
+                pass
+            def wire(self, sup):
+                sup.adopt("capture", self._restart)
+        """)
+    assert ctx["_restart"] == {"loop"}
+
+
+# -- CLI contract v2: sarif, internal errors, pragma warnings, selftest ------
+
+def test_cli_sarif_output(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    pkg = _write_pkg(tmp_path, """
+        import asyncio
+        def kick(a):
+            asyncio.ensure_future(a)
+        """)
+    assert graftlint_main([str(pkg), "--format=sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    (res,) = doc["runs"][0]["results"]
+    assert res["ruleId"] == "ASYNC-ORPHAN-TASK" and res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "pkg/m.py"
+    assert loc["region"]["startLine"] == 4
+    rule_catalog = {r["id"]
+                    for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"THREAD-SHARED-MUTATION", "THREAD-LOOP-ONLY-CALL",
+            "THREAD-LOCK-ORDER", "JAX-USE-AFTER-DONATE",
+            "JAX-SHARD-CONSISTENCY"} <= rule_catalog
+    # baselined findings do not reappear as sarif results
+    base = tmp_path / "base.json"
+    assert graftlint_main([str(pkg), "--write-baseline", str(base)]) == 0
+    capsys.readouterr()
+    assert graftlint_main([str(pkg), "--format=sarif",
+                           "--baseline", str(base)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_internal_error_exits_2(tmp_path, capsys, monkeypatch):
+    """A crashing rule is an INTERNAL error (exit 2), never a lint
+    failure (exit 1) — CI must distinguish 'gate found something' from
+    'gate broke'."""
+    from selkies_tpu.analysis import core as _core
+
+    class _Broken(_core.Rule):
+        rule_id = "BROKEN-RULE"
+        description = "always crashes"
+
+        def check(self, module):
+            raise RuntimeError("boom")
+            yield  # pragma: no cover
+
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    a = Analyzer(rules=[_Broken()])
+    assert a.run_source("x = 1\n", "ok.py") == []
+    assert a.internal_errors and "BROKEN-RULE" in a.internal_errors[0]
+
+    import selkies_tpu.analysis.__main__ as _main
+    real = _main.Analyzer
+    monkeypatch.setattr(_main, "Analyzer",
+                        lambda **kw: real(rules=[_Broken()], **kw))
+    assert graftlint_main([str(ok)]) == 2
+    assert "internal error" in capsys.readouterr().err
+
+
+def test_unknown_pragma_id_warns():
+    a = Analyzer()
+    a.run_source(textwrap.dedent("""
+        import asyncio
+        def kick(a):
+            asyncio.ensure_future(a)  # graftlint: disable=ASYNC-ORPHAN-TASKS
+        """), "m.py")
+    assert a.pragma_warnings and "ASYNC-ORPHAN-TASKS" in a.pragma_warnings[0]
+    assert "m.py:4" in a.pragma_warnings[0]
+
+
+def test_known_pragma_and_docstring_mentions_do_not_warn():
+    a = Analyzer()
+    a.run_source(textwrap.dedent('''
+        """Docs may quote ``# graftlint: disable=NOT-A-RULE`` freely."""
+        import asyncio
+        def kick(a):
+            asyncio.ensure_future(a)  # graftlint: disable=all
+        '''), "m.py")
+    assert a.pragma_warnings == []
+
+
+def test_cli_selftest_subcommand(capsys):
+    assert graftlint_main(["selftest"]) == 0
+    out = capsys.readouterr().out
+    assert "0 failure(s)" in out
+    assert graftlint_main(["selftest", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is True and doc["checks"] >= 18
+
+
+def test_list_rules_covers_v2():
+    assert graftlint_main(["--list-rules"]) == 0
+    import io
+    import contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        graftlint_main(["--list-rules"])
+    out = buf.getvalue()
+    for rid in ("THREAD-SHARED-MUTATION", "THREAD-LOOP-ONLY-CALL",
+                "THREAD-LOCK-ORDER", "JAX-USE-AFTER-DONATE",
+                "JAX-SHARD-CONSISTENCY", "JAX-HOST-SYNC",
+                "ASYNC-ORPHAN-TASK"):
+        assert rid in out, rid
+
+
+def test_repo_invariant_covers_new_rule_ids():
+    """The ⊆-baseline invariant gates the NEW rules too: they are in the
+    default rule set the repo scan runs."""
+    from selkies_tpu.analysis import default_rules
+    ids = {r.rule_id for r in default_rules()}
+    assert {"THREAD-SHARED-MUTATION", "THREAD-LOOP-ONLY-CALL",
+            "THREAD-LOCK-ORDER", "JAX-USE-AFTER-DONATE",
+            "JAX-SHARD-CONSISTENCY"} <= ids
+
+
+def test_lock_order_multi_item_with_fires():
+    """`with A, B:` acquires sequentially — the idiomatic multi-item
+    form must build the same A->B edge as nested withs (regression:
+    the scanner once recorded B's acquisition without A held)."""
+    f = run("""
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def submit():
+            with A, B:
+                pass
+        def drain():
+            with B, A:
+                pass
+        """)
+    assert rule_ids(f) == ["THREAD-LOCK-ORDER"]
+    # mixed nested-vs-multi-item ABBA is the same cycle
+    f = run("""
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def submit():
+            with A:
+                with B:
+                    pass
+        def drain():
+            with B, A:
+                pass
+        """)
+    assert rule_ids(f) == ["THREAD-LOCK-ORDER"]
