@@ -78,6 +78,16 @@ JSON line carries a ``fleet`` block with each contract's verdict.
 Knobs: BENCH_FLEET_HOSTS (default 3), BENCH_FLEET_SESSIONS (default
 8), BENCH_FLEET_SEED.
 
+Energy observability (selkies_tpu/obs/energy, ISSUE 14): the JSON
+line carries an ``energy`` block — ``joules_frame``, ``watts_mean``
+over the throughput loop, ``fps_per_w`` (== fps / watts_mean by
+construction) and an honest ``source`` label (``proxy`` from the PR-6
+cost analysis at per-backend pJ coefficients with an idle floor;
+``rapl``/``device`` when the host exposes measured power). The ledger
+carries ``joules_frame``/``fps_per_w`` columns and
+``tools/perf_ledger.py pareto`` renders the quality x latency x
+energy operating-point front.
+
 Perf observability (selkies_tpu/obs/perf, ISSUE 6): the JSON line
 carries a ``perf`` block (per compiled step: flops, HBM bytes accessed,
 roofline-ms at ~800 GB/s, recorded at compile time — plus the parsed
@@ -487,6 +497,14 @@ def main(force_cpu: bool = False) -> None:
     # for the P path) --------------------------------------------------------
     from selkies_tpu.engine.capture import PIPELINE_DEPTH
     import collections
+
+    # energy plane (ISSUE 14): open the measured-power window around
+    # the throughput loop — on hosts exposing RAPL/device counters the
+    # delta over the timed loop is the measured watts_mean; everywhere
+    # else the block stays an honestly-labelled proxy
+    from selkies_tpu.obs import energy as _energy
+    _energy.meter.platform = backend
+    _energy.meter.sample_power()
     inflight = collections.deque()
     tp_budget = float(os.environ.get("BENCH_TP_BUDGET_S", "60"))
     profile_dir = None
@@ -518,6 +536,17 @@ def main(force_cpu: bool = False) -> None:
         f"({p_bytes // max(done, 1)} B/frame delta)")
     if want_profile:
         log(f"jax profiler capture stopped: {_prof.stop()}")
+
+    # energy block (ISSUE 14): joules/frame, watts_mean and fps/W at
+    # the measured throughput, source-labelled (proxy|rapl|device).
+    # Contract (tests/test_bench_contract.py): fps_per_w == fps /
+    # watts_mean by construction.
+    _energy.meter.sample_power()
+    energy_doc = _energy.meter.bench_block(round(fps, 2), backend)
+    log(f"energy: {energy_doc['watts_mean']}W "
+        f"({energy_doc['source']}), "
+        f"{energy_doc['joules_frame']} J/frame, "
+        f"{energy_doc['fps_per_w']} fps/W")
 
     # perf block (ISSUE 6): static cost attribution recorded when the
     # steps compiled (wrap_step in the engine) — flops, HBM bytes,
@@ -637,6 +666,7 @@ def main(force_cpu: bool = False) -> None:
         "compile_cache_hits": compile_stats["cache_hits"],
         "compile_cache_misses": compile_stats["cache_misses"],
         "qoe": qoe_doc,
+        "energy": energy_doc,
         "glass_to_glass": g2g_doc,
         "pipeline_depth": pipe_depth,
         "pipeline": pipeline_doc,

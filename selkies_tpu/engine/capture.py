@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from ..obs import health as _health
+from ..obs.energy import meter as _energy_meter
 from ..resilience import faults as _faults
 from ..trace import tracer as _tracer
 from .encoder import JpegEncoderSession
@@ -519,6 +520,9 @@ class ScreenCapture:
         self.last_frame_bytes = nbytes
         with self._delivered_lock:
             self._delivered_pending.append(nbytes)
+        # energy plane (ISSUE 14): delivered-frame stamp feeding the
+        # live fps->watts estimate (one deque append under a lock)
+        _energy_meter.note_frame()
         if s is not None:
             # chunks are now queued toward the loop; ws send/ACK spans
             # attach later by frame id while the timeline sits in the ring

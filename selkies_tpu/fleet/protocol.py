@@ -28,7 +28,8 @@ from typing import Optional
 
 __all__ = ["PROTOCOL_VERSION", "FleetProtocolError", "DeviceCapacity",
            "SeatSession", "Heartbeat", "SessionSpec", "parse_heartbeat",
-           "parse_session_spec", "estimate_hbm_mb", "migrate_command",
+           "parse_session_spec", "estimate_hbm_mb",
+           "estimate_session_watts", "migrate_command",
            "heartbeat_from_core"]
 
 PROTOCOL_VERSION = 1
@@ -40,6 +41,7 @@ _MAX_SEATS = 4096
 _MAX_DIM_PX = 16_384
 _MAX_HBM_MB = 16 * 1024 * 1024    # 16 TiB, in MB
 _MAX_SESSIONS = 65_536
+_MAX_WATTS = 1_000_000.0          # 1 MW: see parse_heartbeat
 
 _HEALTH_STATES = ("ok", "degraded", "failed")
 
@@ -127,6 +129,12 @@ class Heartbeat:
     health: str = "ok"
     slo_status: str = "ok"
     slo_fast_burn: Optional[float] = None
+    #: estimated host power draw in watts (ISSUE 14: obs/energy —
+    #: measured RAPL/device power when the platform exposes it, the
+    #: idle-floored proxy otherwise). The scheduler packs against a
+    #: fleet-wide power budget with it; range-checked like every
+    #: capacity field because it steers placement.
+    watts_est: Optional[float] = None
     devices: list = dataclasses.field(default_factory=list)
     sessions: list = dataclasses.field(default_factory=list)
     warm_geometries: list = dataclasses.field(default_factory=list)
@@ -139,6 +147,7 @@ class Heartbeat:
             "ts": self.ts, "started_at": self.started_at,
             "ready": self.ready,
             "draining": self.draining, "health": self.health,
+            "watts_est": self.watts_est,
             "slo": {"status": self.slo_status,
                     "fast_burn": self.slo_fast_burn},
             "devices": [d.to_dict() for d in self.devices],
@@ -169,11 +178,32 @@ class SessionSpec:
         return self.hbm_mb or estimate_hbm_mb(self.width, self.height,
                                               self.codec)
 
+    def budget_w(self) -> float:
+        """The power axis of the placement budget (ISSUE 14)."""
+        return estimate_session_watts(self.width, self.height,
+                                      self.codec)
+
     def to_dict(self) -> dict:
         return {"v": PROTOCOL_VERSION, "kind": "place",
                 "sid": self.sid, "width": self.width,
                 "height": self.height, "codec": self.codec,
                 "hbm_mb": self.hbm_mb}
+
+
+def estimate_session_watts(width: int, height: int,
+                           codec: str = "h264",
+                           fps: float = 60.0) -> float:
+    """Per-session incremental power estimate for fleet power-budget
+    packing (ISSUE 14), the watts twin of :func:`estimate_hbm_mb`:
+    dynamic encode energy scales with pixels x fps (the per-pixel
+    nJ figures mirror obs/energy's coefficient scale; H.264 motion
+    search + transform outweighs JPEG), floored so a tiny session
+    still charges something. Deliberately a planning proxy — the
+    heartbeat's ``watts_est`` (measured where possible) corrects the
+    fleet total once the session is real."""
+    px = max(1, int(width)) * max(1, int(height))
+    per_px_nj = 12.0 if codec == "h264" else 8.0
+    return round(max(0.5, px * float(fps) * per_px_nj * 1e-9), 2)
 
 
 def estimate_hbm_mb(width: int, height: int, codec: str = "h264") -> float:
@@ -234,6 +264,12 @@ def parse_heartbeat(doc) -> Heartbeat:
     fast = slo.get("fast_burn")
     hb.slo_fast_burn = None if fast is None else \
         _num(fast, "slo.fast_burn", 0, 1e9)
+    watts = doc.get("watts_est")
+    # 1 MW ceiling: far above any real host, low enough that an absurd
+    # document cannot poison the fleet power-budget math (NaN and
+    # negatives fail _num's range check like every capacity field)
+    hb.watts_est = None if watts is None else \
+        _num(watts, "watts_est", 0, _MAX_WATTS)
 
     devices = doc.get("devices", [])
     if not isinstance(devices, list) or len(devices) > _MAX_DEVICES:
@@ -377,6 +413,15 @@ def heartbeat_from_core(core, url: str = "", seq: int = 0) -> Heartbeat:
     hb.draining = bool(getattr(core, "draining", False))
     if hb.draining:
         hb.ready = False
+
+    # host power estimate (ISSUE 14): measured where the platform
+    # exposes it (the devmon thread samples RAPL / device counters),
+    # idle-floored proxy otherwise — the scheduler's fleet power axis
+    try:
+        from ..obs import energy as _energy
+        hb.watts_est = round(float(_energy.meter.watts_estimate()), 2)
+    except Exception:
+        pass
 
     # SLO burn snapshot (PR 7): the scheduler's evict signal
     try:

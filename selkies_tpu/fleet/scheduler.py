@@ -4,7 +4,10 @@ The placement layer ROADMAP item 3 names. Capacity is NOT uniform
 slots: each device carries two budget axes — HBM megabytes (fed by the
 PR-3 DeviceMonitor via heartbeats) and a pixel budget (the resolution
 axis; a device that can hold eight 480p seats cannot hold eight 4K
-ones) — and a session consumes both. The scheduler bin-packs against
+ones) — and a session consumes both. A third, FLEET-wide axis is
+optional: with ``power_budget_w`` set, heartbeat ``watts_est`` (ISSUE
+14, obs/energy) caps the projected fleet draw the same way — at fleet
+scale watts are the real capacity unit. The scheduler bin-packs against
 the budgets, scores feasible targets, and owns three behaviours the
 fleet contract tests pin:
 
@@ -127,6 +130,7 @@ class HostState:
                 "draining": self.draining,
                 "health": self.heartbeat.health,
                 "slo_status": self.heartbeat.slo_status,
+                "watts_est": self.heartbeat.watts_est,
                 "burn_streak": self.burn_streak,
                 "warm_geometries": list(self.heartbeat.warm_geometries),
                 "devices": [d.to_dict()
@@ -146,7 +150,8 @@ class SeatScheduler:
                  warm_bonus: float = 1.0,
                  pack_weight: float = 0.5,
                  burn_penalty: float = 2.0,
-                 pending_cap: int = 1024):
+                 pending_cap: int = 1024,
+                 power_budget_w: Optional[float] = None):
         self._clock = clock
         self.recorder = recorder
         self.host_timeout_s = float(host_timeout_s)
@@ -157,6 +162,14 @@ class SeatScheduler:
         self.pack_weight = float(pack_weight)
         self.burn_penalty = float(burn_penalty)
         self.pending_cap = int(pending_cap)
+        #: fleet-wide power budget in watts (ISSUE 14): with a budget
+        #: set, a placement that would push the projected fleet draw
+        #: (per-host max of heartbeat ``watts_est`` and the
+        #: scheduler-charged session estimates — the same
+        #: scheduler-authoritative floor seats/HBM/pixels use) past it
+        #: queues like any other capacity refusal. None = axis off.
+        self.power_budget_w = None if power_budget_w is None \
+            else float(power_budget_w)
         self._lock = threading.Lock()
         self.hosts: dict[str, HostState] = {}
         self.placements: dict[str, Placement] = {}
@@ -231,6 +244,38 @@ class SeatScheduler:
             entry[2] += p.spec.pixels
         return loads
 
+    def _fleet_watts_locked(self) -> float:
+        """Projected fleet power draw (lock held): per host, the max of
+        its reported ``watts_est`` and the scheduler-charged session
+        estimates — a heartbeat lags a placement by up to one period,
+        and the reported number floors sessions the scheduler never
+        placed."""
+        charged: dict = {}
+        for p in self.placements.values():
+            charged[p.host_id] = charged.get(p.host_id, 0.0) \
+                + p.spec.budget_w()
+        total = 0.0
+        for hid, host in self.hosts.items():
+            if host.lost:
+                continue
+            total += max(host.heartbeat.watts_est or 0.0,
+                         charged.get(hid, 0.0))
+        return total
+
+    def _power_ok_locked(self, spec: SessionSpec) -> bool:
+        if self.power_budget_w is None:
+            return True
+        # a spec that is ALREADY placed is the migration/evict probe
+        # (feasible() runs before the source seat releases): its watts
+        # are in the fleet projection already and a move is
+        # power-neutral, so the power axis never refuses it — even
+        # with the fleet OVER budget, which is exactly when rebalance
+        # off a burning host must still be possible
+        if spec.sid in self.placements:
+            return True
+        return self._fleet_watts_locked() + spec.budget_w() \
+            <= self.power_budget_w
+
     def _fits(self, host: HostState, dev: DeviceCapacity,
               spec: SessionSpec, loads: dict) -> Optional[float]:
         """None when infeasible; else the post-placement fill fraction
@@ -300,6 +345,12 @@ class SeatScheduler:
         with self._lock:
             if spec.sid in self.placements:
                 return self.placements[spec.sid]
+            if not self._power_ok_locked(spec):
+                # the fleet power budget refuses like any capacity
+                # axis: queueing, never dropping
+                if queue_on_fail:
+                    self._queue(spec)
+                return None
             best = None       # (score, host, dev, fill)
             loads = self._load_map()
             for host in self.hosts.values():
@@ -353,6 +404,8 @@ class SeatScheduler:
         seat for no seat (and an IDR storm of failed re-offers)."""
         exclude = set(exclude_hosts)
         with self._lock:
+            if not self._power_ok_locked(spec):
+                return False
             loads = self._load_map()
             for host in self.hosts.values():
                 if host.host_id in exclude or not host.ready:
@@ -520,6 +573,9 @@ class SeatScheduler:
                 "totals": {"placements": self.total_placements,
                            "queued": self.total_queued,
                            "evictions": self.total_evictions},
+                "power": {"budget_w": self.power_budget_w,
+                          "fleet_watts_est":
+                          round(self._fleet_watts_locked(), 2)},
             }
 
     def _record(self, kind: str, **fields) -> None:
@@ -542,6 +598,11 @@ class SeatScheduler:
             n_hosts = len(self.hosts)
             n_place = len(self.placements)
             n_pend = len(self.pending)
+            fleet_w = self._fleet_watts_locked()
+        metrics.describe("selkies_fleet_watts_est",
+                         "Projected fleet power draw (heartbeat "
+                         "watts_est floored by scheduler charges)")
+        metrics.set_gauge("selkies_fleet_watts_est", round(fleet_w, 2))
         metrics.describe("selkies_fleet_hosts",
                          "Known fleet hosts by state")
         metrics.describe("selkies_fleet_placements",

@@ -24,6 +24,12 @@ that class of failure self-diagnosing:
   profiler-capture parser that turns one ``bench.py --profile`` run
   into a per-step device-time table, behind ``GET /api/perf`` and the
   bench ``perf`` block;
+- :mod:`.energy` — joules/frame and fps-per-watt from the PR-6 cost
+  analysis (per-backend pJ/flop + pJ/HBM-byte proxy with an idle-power
+  floor) plus measured host power where the platform exposes it (Linux
+  RAPL, device counters), source-labelled in every export; per-frame /
+  per-session attribution through the trace summarizer, the ladder's
+  energy-budget policy, and the heartbeat ``watts_est`` feed;
 - :mod:`.qoe` — per-session wire QoE: ACK-RTT estimation, client fps,
   backpressure windows, relay/congestion-controller counters, the
   composite QoE score behind ``GET /api/sessions``, the ``qoe`` health
@@ -45,6 +51,9 @@ are lazy and guarded (the same contract :mod:`..trace` keeps).
 
 from .clocksync import ClockSyncEstimator  # noqa: F401
 from .device_monitor import DeviceMonitor, monitor  # noqa: F401
+from .energy import (EnergyBudgetPolicy, EnergyMeter,  # noqa: F401
+                     RaplReader, step_energy_j)
+from .energy import meter as energy_meter  # noqa: F401
 from .health import (DEGRADED, FAILED, OK, FlightRecorder,  # noqa: F401
                      HealthEngine, Verdict, degraded, engine, failed, ok)
 from .perf import (PerfRegistry, parse_profile_dir,  # noqa: F401

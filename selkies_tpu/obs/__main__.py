@@ -296,9 +296,91 @@ def _cmd_selftest(args: argparse.Namespace) -> int:
         return _fail("events against undeclared objectives must drop")
     json.loads(json.dumps(slo_eng.report(now=now0 + 8002.0)))
 
+    # energy plane (ISSUE 14): coefficient math, RAPL-absent fallback
+    # to proxy, synthetic-RAPL measured watts, idle floor on a stalled
+    # pipeline, and the per-frame/per-session attribution round-trip —
+    # all stdlib-only (injected clock + RAPL root, synthetic registry)
+    from . import energy as energy_mod
+    c = energy_mod.coeffs_for("cpu")
+    e_j = energy_mod.step_energy_j(1e9, 8e8, "cpu")
+    want_j = (1e9 * c.pj_per_flop + 8e8 * c.pj_per_byte) * 1e-12
+    if abs(e_j - want_j) > 1e-15:
+        return _fail(f"energy coefficient math broken: {e_j} vs {want_j}")
+    if energy_mod.coeffs_for("cpu-fallback-relay-dead") is not c:
+        return _fail("backend-class normalisation broken")
+    perf_entry_j = preg.report()["steps"][0].get("energy_j")
+    if perf_entry_j is None or abs(perf_entry_j - round(e_j, 6)) > 1e-12:
+        return _fail(f"perf registry energy_j broken: {perf_entry_j}")
+
+    d_empty = tempfile.mkdtemp(prefix="selkies-energy-norapl-")
+    clock_box = [100.0]
+    m = energy_mod.EnergyMeter(
+        perf_registry=preg,
+        rapl=energy_mod.RaplReader(root=d_empty),
+        clock=lambda: clock_box[0])
+    if m.sample_power() is not None:
+        return _fail("RAPL-absent host must yield no measured sample")
+    est = m.estimate(30.0, backend="cpu")
+    if est["source"] != "proxy":
+        return _fail(f"RAPL-absent estimate must label proxy: {est}")
+    if abs(est["watts"] - round(c.idle_w + e_j * 30.0, 3)) > 1e-9:
+        return _fail(f"proxy watts math broken: {est}")
+    if abs(est["fps_per_w"] - round(30.0 / est["watts"], 4)) > 1e-9:
+        return _fail(f"fps_per_w identity broken: {est}")
+    if abs(est["joules_frame"] * 30.0 - est["watts"]) > 1e-3:
+        return _fail(f"joules_frame identity broken: {est}")
+    stalled = m.estimate(0.0, backend="cpu")
+    if stalled["watts"] < c.idle_w or stalled["joules_frame"] is not None:
+        return _fail(f"idle floor broken on a stalled pipeline: {stalled}")
+
+    d_rapl = tempfile.mkdtemp(prefix="selkies-energy-rapl-")
+    dom = os.path.join(d_rapl, "intel-rapl:0")
+    os.makedirs(dom)
+    with open(os.path.join(dom, "name"), "w") as f:
+        f.write("package-0\n")
+    with open(os.path.join(dom, "max_energy_range_uj"), "w") as f:
+        f.write(str(2 ** 32) + "\n")
+    with open(os.path.join(dom, "energy_uj"), "w") as f:
+        f.write("1000000\n")
+    m2 = energy_mod.EnergyMeter(
+        perf_registry=preg,
+        rapl=energy_mod.RaplReader(root=d_rapl),
+        clock=lambda: clock_box[0])
+    m2.sample_power()                      # baseline read
+    with open(os.path.join(dom, "energy_uj"), "w") as f:
+        f.write("5000000\n")               # +4 J over...
+    clock_box[0] += 2.0                    # ...2 s = 2 W
+    s2 = m2.sample_power()
+    if s2 is None or s2["source"] != "rapl" \
+            or abs(s2["watts"] - 2.0) > 1e-9:
+        return _fail(f"RAPL delta watts broken: {s2}")
+    est2 = m2.estimate(10.0)
+    if est2["source"] != "rapl" or abs(est2["watts"] - 2.0) > 1e-9:
+        return _fail(f"measured watts must win over proxy: {est2}")
+
+    att_tl = {
+        "display_id": "s0", "frame_id": 1, "t0_ns": 0,
+        "t1_ns": 12_000_000,
+        "spans": [
+            {"name": "a", "lane": "l1", "t0_ns": 0,
+             "dur_ns": 10_000_000},
+            {"name": "b", "lane": "l2", "t0_ns": 2_000_000,
+             "dur_ns": 10_000_000},
+        ]}
+    att = energy_mod.attribute_timelines([att_tl], watts=10.0)
+    if att["frames"] != 1 or abs(att["joules"] - 0.12) > 1e-9:
+        return _fail(f"attribution totals broken: {att}")
+    if abs(sum(att["per_stage_j"].values()) - att["joules"]) > 1e-9:
+        return _fail(f"per-stage round-trip broken: {att}")
+    if abs(sum(s["joules"] for s in att["per_session"].values())
+           - att["joules"]) > 1e-9:
+        return _fail(f"per-session round-trip broken: {att}")
+    json.loads(json.dumps(m2.report(fps=10.0, timelines=[att_tl])))
+
     doc = {"health": eng.report(verbose=True), "monitor": mon.snapshot(),
            "qoe": doc0, "perf": prep, "device_time": table,
            "clock": cs.quality(),
+           "energy": m2.report(fps=10.0),
            "slo": slo_eng.report(now=now0 + 8002.0)}
     text = json.dumps(doc)
     json.loads(text)                       # the payload must round-trip

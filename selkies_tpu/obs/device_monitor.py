@@ -327,6 +327,17 @@ class DeviceMonitor:
             self.devices = out
             self.hbm_peak_bytes = max(self.hbm_peak_bytes, peak_seen)
             self._sampled_once = True
+        # host power telemetry (ISSUE 14) rides the SAME off-hot-path
+        # cadence: RAPL / device power counters are blocking reads with
+        # exactly the contention profile memory_stats() has, so the
+        # energy meter never owns a thread of its own
+        try:
+            from . import energy as _energy
+            if _energy.meter.platform is None:
+                _energy.meter.platform = self.platform
+            _energy.meter.sample_power()
+        except Exception:
+            logger.debug("power sample failed", exc_info=True)
         return out
 
     @property

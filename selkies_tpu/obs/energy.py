@@ -1,0 +1,648 @@
+"""Energy observability: joules/frame and fps-per-watt as first-class
+axes of the perf plane (ROADMAP 5 / ISSUE 14).
+
+Both encoder-efficiency papers in PAPERS.md — the NVENC HQ/UHQ
+longitudinal study and the sustainable 8K60 vehicular-edge study — frame
+production encoding as a quality x latency x **energy** Pareto surface.
+PRs 2-7 built the first two axes end to end; this module supplies the
+third, with the same honesty discipline the perf plane keeps (every
+number labelled with how it was obtained, never a silent fallback):
+
+- **Proxy model** (:func:`step_energy_j` + :class:`EnergyMeter`): the
+  PR-6 AOT cost analysis already records the two inputs an energy model
+  needs — flops and HBM bytes accessed per compiled step — so a
+  per-backend (pJ/flop, pJ/HBM-byte) coefficient pair turns the static
+  cost table into a dynamic joules-per-frame estimate, the same pattern
+  as ``roofline_ms`` at :func:`..perf.roofline_ms`. An **idle-power
+  floor** keeps watts from ever reading zero on a stalled pipeline (a
+  chip burning 50 W while encoding nothing is the worst fps/W there is,
+  and the estimate must say so).
+
+- **Measured power** where the platform exposes it: Linux RAPL via
+  ``/sys/class/powercap`` on CPU hosts (:class:`RaplReader` — cumulative
+  µJ counters, wraparound-corrected), and backend device power counters
+  when present. Sampling is OFF the hot path — the PR-3
+  :class:`~.device_monitor.DeviceMonitor` thread drives it on its
+  existing cadence — and every export carries a ``source`` label
+  (``proxy`` | ``rapl`` | ``device``) so a proxy number can never
+  masquerade as telemetry.
+
+- **Attribution** through the PR-2/PR-6 trace summarizer
+  (:func:`attribute_timelines`): watts x the per-frame critical-path
+  account charges joules to frames, stages and sessions with the exact
+  identity ``sum(stage_j) + bubble_j == frame_j`` the occupancy
+  analyzer guarantees for time.
+
+- **Control**: :class:`EnergyBudgetPolicy` gives the PR-5 degradation
+  ladder an energy-aware mode — under a configured power budget the
+  ladder downshifts to the *highest-efficiency* warm rung that still
+  meets the SLO rather than the nearest rung (see
+  ``resilience/ladder.py``); fleet heartbeats carry ``watts_est`` so
+  the seat scheduler can pack against a fleet-wide power budget
+  alongside HBM and pixels (``fleet/protocol.py`` / ``scheduler.py``).
+
+Import contract: stdlib-only at import time (the lint CI image has no
+jax); jax/metrics touch points are lazy and guarded.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import os
+import re
+import threading
+import time
+from typing import Callable, Iterable, Optional, Sequence
+
+logger = logging.getLogger("selkies_tpu.obs.energy")
+
+__all__ = ["EnergyCoeffs", "COEFFS", "coeffs_for", "step_energy_j",
+           "RaplReader", "EnergyMeter", "meter", "attribute_timelines",
+           "EnergyBudgetPolicy", "DEFAULT_RUNG_EFFICIENCY",
+           "ladder_policy_from_settings", "SOURCES"]
+
+#: the honest provenance labels every export carries
+SOURCES = ("proxy", "rapl", "device")
+
+
+@dataclasses.dataclass(frozen=True)
+class EnergyCoeffs:
+    """Per-backend-class energy coefficients. ``pj_per_flop`` /
+    ``pj_per_byte`` price the dynamic work the PR-6 cost analysis
+    counts; ``idle_w`` is the floor a powered-on part burns doing
+    nothing (the stalled-pipeline case watts must never hide)."""
+
+    pj_per_flop: float
+    pj_per_byte: float
+    idle_w: float
+
+
+#: proxy coefficients per backend CLASS (the same normalisation the
+#: perf ledger keys baselines on). Literature-scale figures, not
+#: calibration: TPU-class parts land near ~1 pJ/flop at the ALU with
+#: HBM2-class interfaces around ~30 pJ/byte; commodity CPU hosts pay
+#: far more per flop and DDR-class DRAM ~100+ pJ/byte. They exist to
+#: rank operating points against each other — absolute joules stay
+#: labelled ``proxy`` until a measured source replaces them.
+COEFFS: dict = {
+    "tpu": EnergyCoeffs(pj_per_flop=1.2, pj_per_byte=30.0, idle_w=55.0),
+    "axon": EnergyCoeffs(pj_per_flop=1.2, pj_per_byte=30.0, idle_w=55.0),
+    "gpu": EnergyCoeffs(pj_per_flop=2.0, pj_per_byte=40.0, idle_w=30.0),
+    "cuda": EnergyCoeffs(pj_per_flop=2.0, pj_per_byte=40.0, idle_w=30.0),
+    "cpu": EnergyCoeffs(pj_per_flop=300.0, pj_per_byte=120.0, idle_w=10.0),
+}
+
+
+def coeffs_for(backend: Optional[str]) -> EnergyCoeffs:
+    """Coefficients for a backend label ('cpu-fallback-relay-dead' ->
+    the cpu class, like tools/perf_ledger.backend_class)."""
+    b = (backend or "cpu").lower()
+    if b.startswith("cpu"):
+        b = "cpu"
+    else:
+        b = b.split("-", 1)[0]
+    return COEFFS.get(b, COEFFS["cpu"])
+
+
+def step_energy_j(flops: float, bytes_accessed: float,
+                  backend: Optional[str] = None) -> float:
+    """Dynamic joules for ONE execution of a compiled step — the energy
+    twin of :func:`..perf.roofline_ms`, priced from the same
+    cost-analysis inputs (flops, HBM bytes accessed)."""
+    c = coeffs_for(backend)
+    return (max(0.0, float(flops)) * c.pj_per_flop
+            + max(0.0, float(bytes_accessed)) * c.pj_per_byte) * 1e-12
+
+
+# ------------------------------------------------------------------- RAPL
+_RAPL_DOMAIN_RE = re.compile(r"^intel-rapl:\d+$")
+
+
+class RaplReader:
+    """Linux RAPL package-energy reader (``/sys/class/powercap``).
+
+    Top-level package domains only (``intel-rapl:N``) — subdomains
+    (``intel-rapl:N:M``, core/uncore/dram) are slices of the package
+    counter and summing them would double-count. Counters are
+    cumulative µJ with a documented wrap range
+    (``max_energy_range_uj``); the meter corrects wraps. Everything is
+    best-effort: an absent tree, an unreadable node (non-root
+    containers), or a parse error all degrade to "unavailable" and the
+    caller falls back to the proxy model."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root if root is not None else os.environ.get(
+            "SELKIES_RAPL_ROOT", "/sys/class/powercap")
+
+    def _domains(self) -> list:
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return []
+        return [os.path.join(self.root, n) for n in names
+                if _RAPL_DOMAIN_RE.match(n)]
+
+    @staticmethod
+    def _read_int(path: str) -> Optional[int]:
+        try:
+            with open(path, encoding="ascii") as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def read_domains(self) -> dict:
+        """{domain_path: energy_uj} for every readable package. Kept
+        PER DOMAIN because wraparound is a per-counter event: on a
+        multi-socket host one package wrapping must be corrected by
+        ITS range, not the sum of every package's (a summed correction
+        over-adds a whole counter range per extra socket — a phantom
+        hundreds-of-watts spike)."""
+        out: dict = {}
+        for d in self._domains():
+            v = self._read_int(os.path.join(d, "energy_uj"))
+            if v is not None:
+                out[d] = float(v)
+        return out
+
+    def domain_range_uj(self, domain: str) -> Optional[float]:
+        v = self._read_int(os.path.join(domain, "max_energy_range_uj"))
+        return None if v is None else float(v)
+
+    def read_uj(self) -> Optional[float]:
+        """Sum of package energy counters in µJ, or None when RAPL is
+        unavailable/unreadable (availability probe only — watts deltas
+        go through :meth:`read_domains`)."""
+        doms = self.read_domains()
+        return sum(doms.values()) if doms else None
+
+    def available(self) -> bool:
+        return self.read_uj() is not None
+
+
+# ------------------------------------------------------------------ meter
+#: a measured power sample older than this is stale — better the honest
+#: proxy than a reading from before the workload changed
+MEASURED_TTL_S = 60.0
+
+#: delivered-frame stamps kept for the live fps estimate
+_FRAME_RING = 1024
+
+
+class EnergyMeter:
+    """Process-wide energy estimator. One instance (:data:`meter`)
+    serves the engine, ``/api/perf``, bench, heartbeats and metrics;
+    tests build their own with an injected clock / RAPL root / perf
+    registry.
+
+    Estimation order per :meth:`estimate` call: a fresh measured sample
+    (device counters > RAPL, recorded by :meth:`sample_power` on the
+    DeviceMonitor's off-hot-path cadence) wins and is labelled with its
+    source; otherwise the proxy model prices the heaviest registered
+    step's cost analysis at the backend coefficients, plus the idle
+    floor. Watts never read below the idle floor in proxy mode — a
+    stalled pipeline (fps 0) is ``idle_w`` burning for nothing, the
+    worst fps/W there is, not zero."""
+
+    def __init__(self, perf_registry=None, rapl: Optional[RaplReader] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._perf = perf_registry
+        self.rapl = rapl if rapl is not None else RaplReader()
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: backend label fallback when estimate() gets none (bench and
+        #: the devmon sampler set it; None -> cpu coefficients)
+        self.platform: Optional[str] = None
+        self._rapl_last: Optional[tuple] = None   # (t, {domain: uj})
+        self._measured_w: Optional[float] = None
+        self._measured_src: Optional[str] = None
+        self._measured_at: Optional[float] = None
+        self._frames: collections.deque = collections.deque(
+            maxlen=_FRAME_RING)
+
+    # -- inputs --------------------------------------------------------------
+    def _registry(self):
+        if self._perf is not None:
+            return self._perf
+        from . import perf as _perf
+        return _perf.registry
+
+    def note_frame(self, n: int = 1) -> None:
+        """One delivered frame (engine capture loops call this on the
+        finalizer side): feeds the live fps estimate heartbeats and
+        metrics use. Cheap by design — a timestamp append under a
+        lock."""
+        now = self._clock()
+        with self._lock:
+            for _ in range(max(1, int(n))):
+                self._frames.append(now)
+
+    def fps_estimate(self, window_s: float = 5.0) -> float:
+        now = self._clock()
+        with self._lock:
+            recent = [t for t in self._frames if now - t <= window_s]
+            saturated = (len(recent) == len(self._frames)
+                         == self._frames.maxlen)
+        if not recent or window_s <= 0:
+            return 0.0
+        if saturated:
+            # the ring evicted stamps still inside the window (a busy
+            # multi-seat host outruns it): rate over the span actually
+            # observed, or the estimate silently caps at maxlen/window
+            # and the fleet under-reports its hottest hosts
+            span = now - recent[0]
+            if span > 0:
+                return len(recent) / span
+        return len(recent) / window_s
+
+    # -- measured power (off-hot-path: the DeviceMonitor thread) -------------
+    def _device_power_w(self) -> Optional[float]:
+        """Backend device power counters, when the runtime exposes any
+        (duck-typed — no current PJRT CPU/TPU build does, but the hook
+        is where a power_stats()-bearing runtime lands). Only probes a
+        jax that is ALREADY imported: the meter must never be the
+        thing that initialises a backend (on a hung TPU relay
+        ``local_devices()`` blocks forever — the devmon lesson)."""
+        try:
+            import sys
+            jax = sys.modules.get("jax")
+            if jax is None:
+                return None
+            total = None
+            for d in jax.local_devices():
+                stats = None
+                for attr in ("power_stats", "power_usage"):
+                    fn = getattr(d, attr, None)
+                    if callable(fn):
+                        stats = fn()
+                        break
+                if isinstance(stats, dict):
+                    w = stats.get("power_w")
+                    if w is None:       # explicit: 0.0 is a real reading
+                        w = stats.get("watts")
+                    if isinstance(w, (int, float)) and w >= 0:
+                        total = (total or 0.0) + float(w)
+                elif isinstance(stats, (int, float)) and stats >= 0:
+                    total = (total or 0.0) + float(stats)
+            # an all-parked 0.0 W total is degenerate for the fps/W
+            # axes (division by a floor, absurd fps_per_w): degrade to
+            # the next source rather than record it as measured
+            return total if total else None
+        except Exception:
+            return None
+
+    def sample_power(self) -> Optional[dict]:
+        """One power sample — BLOCKING file/RPC reads, so only the
+        DeviceMonitor thread, bench code, or tests call it (the same
+        policy memory_stats() sampling follows). Device counters win
+        over RAPL; RAPL watts come from the µJ delta between successive
+        samples (wrap-corrected). Returns {"watts", "source"} or None
+        when no measured source exists — the estimate then stays an
+        honestly-labelled proxy."""
+        watts: Optional[float] = None
+        source: Optional[str] = None
+        w = self._device_power_w()
+        if w is not None:
+            watts, source = w, "device"
+        else:
+            try:
+                doms = self.rapl.read_domains()
+            except Exception:
+                doms = {}
+            if doms:
+                now = self._clock()
+                with self._lock:
+                    last = self._rapl_last
+                    self._rapl_last = (now, doms)
+                if last is not None and now > last[0]:
+                    # per-domain deltas, wrap-corrected per counter
+                    d_uj: Optional[float] = 0.0
+                    for dom, uj in doms.items():
+                        prev = last[1].get(dom)
+                        if prev is None:
+                            continue        # new domain: no delta yet
+                        d = uj - prev
+                        if d < 0:           # THIS counter wrapped
+                            rng = self.rapl.domain_range_uj(dom)
+                            if rng is None:
+                                d_uj = None     # unknown range: rebase
+                                break
+                            d += rng
+                        d_uj += d
+                    # strictly positive only: a frozen counter (stub
+                    # powercap trees on VMs) or a sample with no
+                    # overlapping domains yields delta 0 — that is
+                    # "unavailable", not a measured 0 W that would
+                    # beat the honest proxy and report absurd fps/W
+                    if d_uj is not None and d_uj > 0:
+                        watts = d_uj / 1e6 / (now - last[0])
+                        source = "rapl"
+        if watts is None:
+            return None
+        with self._lock:
+            self._measured_w = float(watts)
+            self._measured_src = source
+            self._measured_at = self._clock()
+        return {"watts": float(watts), "source": source}
+
+    def _fresh_measured(self) -> Optional[tuple]:
+        with self._lock:
+            if self._measured_w is None or self._measured_at is None:
+                return None
+            if self._clock() - self._measured_at > MEASURED_TTL_S:
+                return None
+            return (self._measured_w, self._measured_src)
+
+    # -- proxy model ---------------------------------------------------------
+    def dynamic_j_frame(self, backend: Optional[str] = None) -> tuple:
+        """(joules, step_name) — the proxy dynamic energy of one frame:
+        the HEAVIEST registered step's cost priced at the backend
+        coefficients. Max, not sum: a steady-state frame executes one
+        engine step (the h264 i/p pair and stale ladder geometries
+        coexist in the registry but never run in the same frame), so
+        summing the table would overcount a flapping session's history.
+        """
+        best_j, best_name = 0.0, None
+        try:
+            steps = self._registry().report()["steps"]
+        except Exception:
+            return 0.0, None
+        for s in steps:
+            if s.get("error"):
+                continue
+            j = step_energy_j(s.get("flops", 0.0),
+                              s.get("bytes_accessed", 0.0),
+                              backend or s.get("backend"))
+            if j > best_j:
+                best_j, best_name = j, s.get("name")
+        return best_j, best_name
+
+    def estimate(self, fps: float, backend: Optional[str] = None) -> dict:
+        """The energy block: watts, joules/frame, fps/W, source label.
+        ``joules_frame`` is None (not 0, not infinity) when fps is 0 —
+        a stalled pipeline has no per-frame number, only a watts floor.
+        """
+        backend = backend or self.platform
+        c = coeffs_for(backend)
+        fps = max(0.0, float(fps or 0.0))
+        dyn_j, dyn_step = self.dynamic_j_frame(backend)
+        measured = self._fresh_measured()
+        if measured is not None:
+            watts, source = max(float(measured[0]), 0.001), measured[1]
+        else:
+            # idle floor: proxy watts never read zero on a stall
+            watts, source = c.idle_w + dyn_j * fps, "proxy"
+            watts = max(watts, c.idle_w)
+        watts = round(watts, 3)
+        return {
+            "fps": round(fps, 2),
+            "watts": watts,
+            "joules_frame": round(watts / fps, 5) if fps > 0 else None,
+            "fps_per_w": round(fps / watts, 4) if watts > 0 else 0.0,
+            "source": source,
+            "idle_floor_w": c.idle_w,
+            "dynamic_j_frame": round(dyn_j, 6),
+            "dynamic_step": dyn_step,
+            "backend": backend,
+        }
+
+    def watts_estimate(self) -> float:
+        """Current watts for the fleet heartbeat's ``watts_est`` field:
+        measured when fresh, else proxy at the live fps estimate."""
+        return float(self.estimate(self.fps_estimate())["watts"])
+
+    # -- reporting -----------------------------------------------------------
+    def report(self, fps: Optional[float] = None,
+               backend: Optional[str] = None,
+               timelines: Optional[Iterable] = None) -> dict:
+        """The ``energy`` block for ``GET /api/perf`` and bench: the
+        estimate plus (when frame timelines are supplied) the per-frame
+        / per-stage / per-session attribution through the PR-2/PR-6
+        trace summarizer."""
+        dicts = None
+        if timelines is not None:
+            dicts = [t if isinstance(t, dict) else t.to_dict()
+                     for t in timelines]
+        if fps is None:
+            fps = _fps_from_dicts(dicts) if dicts else self.fps_estimate()
+        est = self.estimate(fps, backend)
+        if dicts:
+            est["attribution"] = attribute_timelines(dicts, est["watts"])
+        self._export_metrics(est)
+        return est
+
+    def bench_block(self, fps: float,
+                    backend: Optional[str] = None) -> dict:
+        """bench.py's ``energy`` block: the estimate keyed the way the
+        ledger and the contract test read it (``watts_mean`` is the
+        run-window mean — the RAPL delta over the timed loop when
+        measured, the proxy at the measured fps otherwise). Contract:
+        ``fps_per_w == fps / watts_mean`` by construction."""
+        est = self.estimate(fps, backend)
+        return {
+            "joules_frame": est["joules_frame"],
+            "watts_mean": est["watts"],
+            "fps_per_w": est["fps_per_w"],
+            "source": est["source"],
+            "idle_floor_w": est["idle_floor_w"],
+            "dynamic_j_frame": est["dynamic_j_frame"],
+        }
+
+    def _export_metrics(self, est: dict) -> None:
+        try:
+            from ..server import metrics
+        except Exception:
+            return
+        metrics.describe("selkies_energy_watts",
+                         "Estimated host power draw (source-labelled)")
+        metrics.describe("selkies_energy_joules_per_frame",
+                         "Estimated energy per delivered frame")
+        metrics.describe("selkies_energy_fps_per_watt",
+                         "Delivered frames per second per watt")
+        # one series per metric: re-label on source flips (proxy ->
+        # rapl) instead of stranding the old series at its last value
+        for name in ("selkies_energy_watts",
+                     "selkies_energy_joules_per_frame",
+                     "selkies_energy_fps_per_watt"):
+            metrics.clear_metric(name)
+        labels = {"source": est["source"]}
+        metrics.set_gauge("selkies_energy_watts", est["watts"], labels)
+        if est["joules_frame"] is not None:
+            metrics.set_gauge("selkies_energy_joules_per_frame",
+                              est["joules_frame"], labels)
+        metrics.set_gauge("selkies_energy_fps_per_watt",
+                          est["fps_per_w"], labels)
+
+
+#: the process-wide meter (inert until something samples/notes frames)
+meter = EnergyMeter()
+
+
+# ------------------------------------------------------------ attribution
+def _fps_from_dicts(dicts: Sequence[dict]) -> float:
+    t0 = t1 = None
+    n = 0
+    for d in dicts:
+        if d.get("t1_ns") is None:
+            continue
+        n += 1
+        t0 = d["t0_ns"] if t0 is None else min(t0, d["t0_ns"])
+        t1 = d["t1_ns"] if t1 is None else max(t1, d["t1_ns"])
+    if not n or t0 is None or t1 is None or t1 <= t0:
+        return 0.0
+    return n / ((t1 - t0) / 1e9)
+
+
+def attribute_timelines(timelines: Iterable, watts: float) -> dict:
+    """Charge ``watts`` across completed frames through the PR-6
+    critical-path account (:func:`..trace.summary.frame_accounts`):
+    each frame's joules = watts x its wall window, split over stages by
+    the critical-path attribution (plus ``bubble``), and rolled up per
+    session (display). The time identity ``stages + bubble == e2e``
+    carries over exactly: ``sum(per_stage_j) == total_j`` and
+    ``sum(per_session joules) == total_j``."""
+    from ..trace.summary import frame_accounts
+    accounts = frame_accounts(timelines)
+    watts = max(0.0, float(watts))
+    per_stage: dict = {}
+    per_session: dict = {}
+    total_j = 0.0
+    for a in accounts:
+        frame_j = watts * a["e2e_ms"] / 1e3
+        total_j += frame_j
+        for name, ms in a["stages"].items():
+            per_stage[name] = per_stage.get(name, 0.0) + watts * ms / 1e3
+        if a["bubble_ms"] > 0:
+            per_stage["bubble"] = per_stage.get("bubble", 0.0) \
+                + watts * a["bubble_ms"] / 1e3
+        sess = per_session.setdefault(
+            str(a.get("display_id", "?")), {"frames": 0, "joules": 0.0})
+        sess["frames"] += 1
+        sess["joules"] += frame_j
+    n = len(accounts)
+    for sess in per_session.values():
+        sess["joules_per_frame"] = round(sess["joules"] / sess["frames"],
+                                         6) if sess["frames"] else None
+        sess["joules"] = round(sess["joules"], 6)
+    return {
+        "frames": n,
+        "watts": watts,
+        "joules": round(total_j, 6),
+        "joules_per_frame": round(total_j / n, 6) if n else None,
+        "per_stage_j": {k: round(v, 6) for k, v in
+                        sorted(per_stage.items(), key=lambda kv: -kv[1])},
+        "per_session": per_session,
+    }
+
+
+# ---------------------------------------------------- ladder energy mode
+#: stock per-rung efficiency priors for the default ladder: relative
+#: fps/W GAIN of landing the rung (downscale quarters the pixels moved
+#: per frame — by far the biggest joules/frame lever; quality cuts
+#: bitrate, not device work; fps halves both axes; dropping the
+#: pipeline to depth 1 saves in-flight HBM, not much power). Absolute
+#: scale is irrelevant — the policy only ranks.
+DEFAULT_RUNG_EFFICIENCY: dict = {
+    "pipeline": {"fps_per_w": 0.2},
+    "fps": {"fps_per_w": 1.0},
+    "quality": {"fps_per_w": 0.5},
+    "downscale": {"fps_per_w": 3.0},
+}
+
+
+class EnergyBudgetPolicy:
+    """The ladder's energy-aware mode (ROADMAP 5): under a configured
+    power budget, pick the highest-efficiency warm rung that still
+    meets the SLO instead of the nearest rung.
+
+    Duck-typed against ``resilience.ladder.DegradationLadder``'s
+    ``energy_policy`` seam:
+
+    - :meth:`over_budget` — True while the watts feed exceeds
+      ``budget_w``; the ladder folds this into its trigger reasons, so
+      the SAME two-sided hysteresis (down_after_s / hold_s /
+      ok_window_s) governs power-driven shifts;
+    - :meth:`select_rung` — the target rung index, chosen as the
+      highest ``fps_per_w`` entry in ``rung_table`` at or below the
+      current level whose ``meets_slo`` predicate holds AND whose
+      program is warm (``is_warm`` comes from the ladder's prewarm
+      gate). A cheaper-but-SLO-violating rung is skipped by
+      construction; None (no warm SLO-meeting candidate) falls back to
+      the ladder's stock nearest-rung walk.
+
+    ``rung_table``: {step: {"fps_per_w": float,
+    "meets_slo": bool | callable}} — ``meets_slo`` defaults True;
+    callables are evaluated per selection so a live SLO predictor can
+    plug in.
+    """
+
+    def __init__(self, budget_w: float,
+                 watts_fn: Callable[[], float],
+                 rung_table: Optional[dict] = None):
+        self.budget_w = float(budget_w)
+        self.watts_fn = watts_fn
+        self.rung_table = dict(rung_table if rung_table is not None
+                               else DEFAULT_RUNG_EFFICIENCY)
+        #: last watts reading (snapshot/debug surface)
+        self.last_watts: Optional[float] = None
+
+    def over_budget(self) -> bool:
+        try:
+            w = self.watts_fn()
+        except Exception:
+            logger.exception("energy policy watts feed failed")
+            return False
+        if not isinstance(w, (int, float)) or w != w:    # NaN-safe
+            return False
+        self.last_watts = float(w)
+        return float(w) > self.budget_w
+
+    @staticmethod
+    def _slo_ok(info: dict) -> bool:
+        v = info.get("meets_slo", True)
+        try:
+            return bool(v() if callable(v) else v)
+        except Exception:
+            return False
+
+    def select_rung(self, steps: Sequence[str], level: int,
+                    is_warm: Callable[[str], bool]) -> Optional[int]:
+        best: Optional[tuple] = None
+        for j in range(max(0, int(level)), len(steps)):
+            step = steps[j]
+            info = self.rung_table.get(step)
+            if not isinstance(info, dict):
+                continue                 # unpriced rung: not a candidate
+            if not self._slo_ok(info):
+                continue                 # cheaper but SLO-violating: skip
+            try:
+                if not is_warm(step):
+                    continue             # cold: the worker warms it, the
+                                         # stock walk defers — never here
+            except Exception:
+                continue
+            eff = info.get("fps_per_w")
+            eff = float(eff) if isinstance(eff, (int, float)) else 0.0
+            if best is None or eff > best[0]:
+                best = (eff, j)
+        return best[1] if best is not None else None
+
+    def snapshot(self) -> dict:
+        return {"budget_w": self.budget_w,
+                "last_watts": self.last_watts,
+                "rungs": sorted(self.rung_table)}
+
+
+def ladder_policy_from_settings(settings) -> Optional[EnergyBudgetPolicy]:
+    """The server-core wiring: a positive ``power_budget_w`` setting
+    arms the energy-aware mode against the process-wide meter; 0 (the
+    default) leaves the ladder's stock behaviour byte-for-byte
+    untouched."""
+    try:
+        budget = float(getattr(settings, "power_budget_w", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        return None
+    if budget <= 0:
+        return None
+    return EnergyBudgetPolicy(budget, meter.watts_estimate)

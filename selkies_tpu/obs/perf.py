@@ -126,12 +126,25 @@ class PerfRegistry:
                       + mem.get("output_size_in_bytes", 0)
                       + mem.get("temp_size_in_bytes", 0)
                       + mem.get("alias_size_in_bytes", 0))
+        # the energy twin of roofline_ms (ISSUE 14): one execution's
+        # dynamic joules at the backend's pJ/flop + pJ/HBM-byte
+        # coefficients — the per-step lever-ranking number the energy
+        # plane's frame estimate builds on. Lazy + guarded: analysis
+        # must never be able to break encode
+        energy_j = None
+        try:
+            from .energy import step_energy_j
+            energy_j = round(step_energy_j(flops, bytes_accessed,
+                                           backend), 6)
+        except Exception:
+            pass
         entry = {
             "name": name,
             "backend": backend,
             "flops": flops,
             "bytes_accessed": bytes_accessed,
             "roofline_ms": round(roofline_ms(bytes_accessed), 4),
+            "energy_j": energy_j,
             "arg_bytes": mem.get("argument_size_in_bytes", 0),
             "out_bytes": mem.get("output_size_in_bytes", 0),
             "temp_bytes": mem.get("temp_size_in_bytes", 0),

@@ -23,6 +23,7 @@ from ..engine.capture import _ENCODE_TURN, PIPELINE_DEPTH
 from ..engine.pipeline import PipelineRing, cause_of, retarget
 from ..engine.types import CaptureSettings, EncodedChunk
 from ..obs import health as _health
+from ..obs.energy import meter as _energy_meter
 from ..resilience import faults as _faults
 from ..trace import tracer as _tracer
 from .h264_seats import MultiSeatH264Encoder
@@ -169,6 +170,9 @@ class MultiSeatCapture:
                 if cb is not None:
                     cb(c)
         self.last_frame_bytes = nbytes
+        # energy plane (ISSUE 14): one delivered tick = one frame stamp
+        # for the live fps->watts estimate
+        _energy_meter.note_frame()
         if self._settings is not None:
             _tracer.frame_end(self._settings.display_id, out["frame_id"])
 

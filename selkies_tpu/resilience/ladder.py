@@ -39,6 +39,17 @@ in the incident); with nothing warm it keeps holding, renewing the
 deadline. No gate (or a crashing gate) fails OPEN — shedding fidelity
 must never be blocked by the machinery meant to make it cheap.
 
+**Energy-aware mode** (ISSUE 14): with an ``energy_policy`` injected
+(``obs/energy.EnergyBudgetPolicy`` — a watts feed plus a per-rung
+efficiency/SLO table), an exceeded power budget becomes a trigger
+reason under the SAME two-sided hysteresis, and the downshift target
+becomes the **highest-efficiency warm rung that still meets the SLO**
+instead of the nearest rung (skipped rungs named in the incident, like
+the deadline-force path). A cheaper-but-SLO-violating rung is never
+picked; with no warm SLO-meeting candidate the stock nearest-rung walk
+(including its deferral machinery) takes over. No policy (the default)
+leaves every stock code path byte-for-byte untouched.
+
 The ladder itself is pure state machine (injected clock, no asyncio, no
 deps; the gate is duck-typed ``query(step, direction) -> "warm"|"cold"``
 / ``request(step, direction)``): transports bind concrete ``down``/
@@ -89,6 +100,7 @@ class DegradationLadder:
                  ok_window_s: float = 30.0,
                  gate=None,
                  defer_deadline_s: float = 30.0,
+                 energy_policy=None,
                  clock: Callable[[], float] = time.monotonic,
                  recorder: Optional[_health.FlightRecorder] = None):
         self.steps = tuple(steps)
@@ -100,6 +112,11 @@ class DegradationLadder:
         #: transition gate (prewarm plane); None = every rung is warm
         self.gate = gate
         self.defer_deadline_s = float(defer_deadline_s)
+        #: energy-aware mode (ISSUE 14, obs/energy.EnergyBudgetPolicy
+        #: duck type: over_budget() + select_rung(steps, level,
+        #: is_warm)). None (the default) leaves every code path of the
+        #: stock walk untouched.
+        self.energy_policy = energy_policy
         self.deferred_transitions = 0
         #: the in-flight deferral: {step, direction, since, deadline}
         self._deferral: Optional[dict] = None
@@ -147,6 +164,13 @@ class DegradationLadder:
         if now is None:
             now = self._clock()
         reasons = self._trigger_reasons(verdicts)
+        # energy-aware mode (ISSUE 14): an exceeded power budget is a
+        # trigger like any verdict — folding it into the SAME reason
+        # set means the two-sided hysteresis (down_after_s / hold_s /
+        # ok_window_s) governs power-driven shifts identically, and a
+        # still-over-budget ladder can never step up
+        if self.energy_policy is not None and self._power_over_budget():
+            reasons = sorted(reasons + ["power=over_budget"])
         if reasons:
             self._ok_since = None
             # the trigger is back: a pending step-UP deferral is moot
@@ -205,15 +229,65 @@ class DegradationLadder:
         except Exception:
             logger.exception("transition gate request failed")
 
+    def _power_over_budget(self) -> bool:
+        try:
+            return bool(self.energy_policy.over_budget())
+        except Exception:
+            # fail CLOSED on the trigger side (a broken watts feed must
+            # not shed fidelity), unlike the gate's fail-open
+            logger.exception("energy policy over_budget failed")
+            return False
+
+    def _energy_pick(self) -> Optional[int]:
+        """Energy-aware target selection (ISSUE 14): while the power
+        budget is exceeded, the downshift target is the
+        highest-efficiency WARM rung that still meets the SLO — not the
+        nearest rung. None (policy absent, under budget, no warm
+        SLO-meeting candidate, or any policy failure) falls back to
+        the stock nearest-rung walk."""
+        pol = self.energy_policy
+        if pol is None:
+            return None
+        try:
+            if not pol.over_budget():
+                return None
+            j = pol.select_rung(
+                self.steps, self.level,
+                lambda s: self._gate_query(s, +1) != "cold")
+        except Exception:
+            logger.exception("energy policy selection failed; "
+                             "using the nearest rung")
+            return None
+        if j is None:
+            return None
+        j = int(j)
+        if not (self.level <= j < len(self.steps)):
+            return None
+        return j
+
     def _attempt_shift(self, now: float, direction: int,
                        reasons: list[str]) -> bool:
         """Gate-checked shift. True when a transition actually happened
         (warm target, or a deadline-forced warm alternative)."""
-        step = self.steps[self.level] if direction > 0 \
-            else self.steps[self.level - 1]
+        to_level: Optional[int] = None
+        skipped: Optional[list] = None
+        if direction > 0:
+            step = self.steps[self.level]
+            pick = self._energy_pick()
+            if pick is not None and pick != self.level:
+                step = self.steps[pick]
+                to_level = pick + 1
+                skipped = list(self.steps[self.level:pick])
+                reasons = reasons + [f"energy-efficient:{step}"]
+        else:
+            step = self.steps[self.level - 1]
         if self._gate_query(step, direction) != "cold":
             self._deferral = None
-            self._shift(now, direction, reasons)
+            if to_level is not None:
+                self._shift(now, direction, reasons, step=step,
+                            to_level=to_level, skipped=skipped)
+            else:
+                self._shift(now, direction, reasons)
             return True
         d = self._deferral
         if d is None or d["step"] != step \
@@ -309,6 +383,11 @@ class DegradationLadder:
             if self._bad_since is not None else [],
             "controls_bound": sorted(self._controls),
             "gated": self.gate is not None,
+            "energy_mode": self.energy_policy is not None,
+            "energy": (self.energy_policy.snapshot()
+                       if self.energy_policy is not None
+                       and hasattr(self.energy_policy, "snapshot")
+                       else None),
             "deferred_transitions": self.deferred_transitions,
             "deferred": ({"step": d["step"],
                           "direction": "down" if d["direction"] > 0

@@ -44,6 +44,7 @@ from urllib.parse import urlparse
 
 from aiohttp import web
 
+from ..obs import energy as _energy
 from ..obs import health as _health
 from ..obs import qoe as _qoe
 from ..obs import slo as _slo
@@ -147,6 +148,11 @@ class CentralizedStreamServer:
                     settings, "ladder_ok_window_s", 30.0)),
                 defer_deadline_s=float(getattr(
                     settings, "prewarm_defer_deadline_s", 30.0)),
+                # energy-aware mode (ISSUE 14): armed only by a
+                # positive power_budget_w — None leaves the stock walk
+                # byte-for-byte untouched
+                energy_policy=_energy.ladder_policy_from_settings(
+                    settings),
                 recorder=self.health.recorder)
         self._ladder_task: Optional[asyncio.Task] = None
         # compile plane (selkies_tpu/prewarm, ISSUE 8): enumerate the
@@ -416,10 +422,14 @@ class CentralizedStreamServer:
         from ..obs import profiler
         from ..trace import tracer
         from ..trace.summary import occupancy_report
+        done = [t for t in tracer.snapshot() if t.done]
         doc = {
             "perf": _perf.registry.report(),
-            "occupancy": occupancy_report(
-                t for t in tracer.snapshot() if t.done),
+            "occupancy": occupancy_report(done),
+            # energy plane (ISSUE 14): watts / joules-per-frame /
+            # fps-per-W (source-labelled proxy|rapl|device) plus the
+            # per-frame/per-session attribution over the live ring
+            "energy": _energy.meter.report(timelines=done),
             "tracing": tracer.enabled,
         }
         if request.query.get("profile") in ("1", "true"):

@@ -410,6 +410,12 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
        "up.", vmin=1, vmax=86400),
     _s("ladder_min_fps", SType.FLOAT, 15.0,
        "Floor for the ladder's fps rung.", vmin=1, vmax=240),
+    _s("power_budget_w", SType.FLOAT, 0.0,
+       "Host power budget in watts for the ladder's energy-aware mode "
+       "(obs/energy): while the estimated draw exceeds it, downshifts "
+       "target the highest-efficiency warm rung that still meets the "
+       "SLO instead of the nearest rung. 0 disables (stock ladder "
+       "behaviour).", vmin=0, vmax=1_000_000),
 
     # --- compile plane (selkies_tpu/prewarm) --------------------------------
     _s("enable_prewarm", SType.BOOL, True,

@@ -152,6 +152,34 @@ def frame_critical_path(tl: Union[FrameTimeline, dict]) -> Optional[dict]:
     }
 
 
+def frame_accounts(timelines: Iterable[Union[FrameTimeline, dict]]
+                   ) -> list[dict]:
+    """Per-frame critical-path accounts with their frame/session
+    identity attached: one dict per COMPLETED frame carrying
+    ``display_id`` / ``frame_id`` / the wall window plus the
+    :func:`frame_critical_path` attribution (``stages + bubble == e2e``
+    exactly). This is the join surface the energy plane charges watts
+    against (obs/energy.attribute_timelines): any account that sums to
+    the frame window in milliseconds sums to the frame's joules at a
+    fixed power draw."""
+    out: list[dict] = []
+    for tl in timelines:
+        d = tl if isinstance(tl, dict) else tl.to_dict()
+        cp = frame_critical_path(d)
+        if cp is None:
+            continue
+        out.append({
+            "display_id": d.get("display_id"),
+            "frame_id": d.get("frame_id"),
+            "t0_ns": d["t0_ns"],
+            "t1_ns": d["t1_ns"],
+            "e2e_ms": cp["e2e_ms"],
+            "bubble_ms": cp["bubble_ms"],
+            "stages": cp["stages"],
+        })
+    return out
+
+
 def _merge_intervals(ivs: list[tuple[int, int]]) -> list[tuple[int, int]]:
     merged: list[list[int]] = []
     for a, b in sorted(ivs):

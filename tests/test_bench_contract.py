@@ -175,6 +175,32 @@ def test_bench_ledger_autorecord():
         ["--ledger", _LEDGER, "check", "--warn-only"]) == 0
 
 
+def test_bench_energy_block():
+    """ISSUE 14: an energy block (joules/frame, watts over the
+    throughput loop, fps/W, honest source label) rides the JSON line,
+    with fps_per_w == fps / watts_mean by construction, and the ledger
+    entry carries both energy columns non-null."""
+    doc = _bench_doc()
+    e = doc["energy"]
+    assert e["source"] in ("proxy", "rapl", "device")
+    assert e["watts_mean"] > 0
+    # the idle floor: watts never read zero, whatever the fps
+    assert e["watts_mean"] >= e["idle_floor_w"] > 0 \
+        or e["source"] != "proxy"
+    assert e["joules_frame"] is not None and e["joules_frame"] > 0
+    # the pinned identity (fps_per_w is rounded to 4 places)
+    assert abs(e["fps_per_w"] - doc["value"] / e["watts_mean"]) < 1e-4
+    assert abs(e["joules_frame"] * doc["value"] - e["watts_mean"]) \
+        < 0.01 * e["watts_mean"]
+    # ledger columns (the pareto subcommand's feed)
+    sys.path.insert(0, str(ROOT))
+    from tools import perf_ledger
+    entry = perf_ledger.read_ledger(_LEDGER)[0]
+    assert entry["joules_frame"] == e["joules_frame"]
+    assert entry["fps_per_w"] == e["fps_per_w"]
+    assert entry["energy_source"] == e["source"]
+
+
 def test_bench_glass_to_glass_block():
     """ISSUE 7 acceptance: a glass_to_glass block (p50/p99, clock-sync
     quality) rides the JSON line, and g2g >= server-side e2e for EVERY

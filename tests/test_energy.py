@@ -1,0 +1,434 @@
+"""Energy observability plane (ISSUE 14): coefficient math, the RAPL
+reader, meter estimation (proxy / measured, idle floor, fps/W
+identity), per-frame/per-session attribution through the trace
+summarizer, the ladder's EnergyBudgetPolicy selection rules, and the
+perf-ledger energy columns + pareto front. Stdlib-only by design —
+injected clocks, synthetic RAPL sysfs trees, synthetic perf
+registries; no jax."""
+
+import json
+import sys
+from pathlib import Path
+
+from selkies_tpu.obs import energy as E
+from selkies_tpu.obs.perf import PerfRegistry
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+from tools import perf_ledger  # noqa: E402
+
+
+def make_registry(flops=1e9, nbytes=8e8, name="h264.i_step[t]",
+                  backend="cpu"):
+    reg = PerfRegistry()
+    reg.record_analysis(name,
+                        cost=[{"flops": flops, "bytes accessed": nbytes}],
+                        backend=backend)
+    return reg
+
+
+def make_rapl_tree(tmp_path, uj=1_000_000, rng=2 ** 32):
+    dom = tmp_path / "intel-rapl:0"
+    dom.mkdir()
+    (dom / "name").write_text("package-0\n")
+    (dom / "energy_uj").write_text(f"{uj}\n")
+    (dom / "max_energy_range_uj").write_text(f"{rng}\n")
+    return dom
+
+
+# ------------------------------------------------------------ coefficients
+
+def test_step_energy_matches_coefficients():
+    c = E.coeffs_for("cpu")
+    want = (1e9 * c.pj_per_flop + 8e8 * c.pj_per_byte) * 1e-12
+    assert abs(E.step_energy_j(1e9, 8e8, "cpu") - want) < 1e-15
+    # negative/garbage inputs clamp instead of going negative
+    assert E.step_energy_j(-1, -1, "cpu") == 0.0
+
+
+def test_coeffs_backend_class_normalisation():
+    assert E.coeffs_for("cpu-fallback-relay-dead") is E.coeffs_for("cpu")
+    assert E.coeffs_for("tpu") is E.COEFFS["tpu"]
+    assert E.coeffs_for(None) is E.COEFFS["cpu"]
+    assert E.coeffs_for("riscv-weird") is E.COEFFS["cpu"]  # unknown class
+    # accelerator work is cheaper per unit than host work, idle dearer
+    assert E.COEFFS["tpu"].pj_per_flop < E.COEFFS["cpu"].pj_per_flop
+    assert E.COEFFS["tpu"].idle_w > E.COEFFS["cpu"].idle_w
+
+
+def test_perf_registry_records_energy_j():
+    reg = make_registry()
+    entry = reg.report()["steps"][0]
+    assert entry["energy_j"] == round(E.step_energy_j(1e9, 8e8, "cpu"), 6)
+
+
+# ----------------------------------------------------------------- meter
+
+def test_proxy_estimate_identities(tmp_path):
+    m = E.EnergyMeter(perf_registry=make_registry(),
+                      rapl=E.RaplReader(root=str(tmp_path)))
+    est = m.estimate(30.0, backend="cpu")
+    c = E.coeffs_for("cpu")
+    dyn = E.step_energy_j(1e9, 8e8, "cpu")
+    assert est["source"] == "proxy"
+    assert est["watts"] == round(c.idle_w + dyn * 30.0, 3)
+    assert est["fps_per_w"] == round(30.0 / est["watts"], 4)
+    assert abs(est["joules_frame"] * 30.0 - est["watts"]) < 1e-3
+    assert est["dynamic_step"] == "h264.i_step[t]"
+
+
+def test_dynamic_uses_heaviest_step_not_sum(tmp_path):
+    """A frame executes ONE engine step: the i/p pair (and stale ladder
+    geometries) coexist in the registry but must not sum."""
+    reg = make_registry(flops=1e9, nbytes=8e8, name="h264.i_step[t]")
+    reg.record_analysis("h264.p_step[t]",
+                        cost=[{"flops": 4e8, "bytes accessed": 2e8}],
+                        backend="cpu")
+    m = E.EnergyMeter(perf_registry=reg,
+                      rapl=E.RaplReader(root=str(tmp_path)))
+    dyn, step = m.dynamic_j_frame("cpu")
+    assert step == "h264.i_step[t]"
+    assert abs(dyn - E.step_energy_j(1e9, 8e8, "cpu")) < 1e-15
+
+
+def test_idle_floor_on_stalled_pipeline(tmp_path):
+    m = E.EnergyMeter(perf_registry=make_registry(),
+                      rapl=E.RaplReader(root=str(tmp_path)))
+    est = m.estimate(0.0, backend="cpu")
+    assert est["watts"] == E.coeffs_for("cpu").idle_w   # never zero
+    assert est["joules_frame"] is None                  # no frames: no j/f
+    assert est["fps_per_w"] == 0.0
+
+
+def test_rapl_reader_measures_and_wraps(tmp_path):
+    dom = make_rapl_tree(tmp_path, uj=1_000_000)
+    # a SUBdomain must not double-count the package counter
+    sub = tmp_path / "intel-rapl:0:0"
+    sub.mkdir()
+    (sub / "energy_uj").write_text("999999999\n")
+    clock = [100.0]
+    m = E.EnergyMeter(perf_registry=make_registry(),
+                      rapl=E.RaplReader(root=str(tmp_path)),
+                      clock=lambda: clock[0])
+    assert m.sample_power() is None            # first read: baseline only
+    (dom / "energy_uj").write_text("5000000\n")
+    clock[0] += 2.0
+    s = m.sample_power()
+    assert s == {"watts": 2.0, "source": "rapl"}
+    est = m.estimate(10.0, backend="cpu")
+    assert est["source"] == "rapl" and est["watts"] == 2.0
+    assert est["fps_per_w"] == round(10.0 / 2.0, 4)
+    # wraparound: the counter resets below the last read
+    (dom / "energy_uj").write_text("1000000\n")
+    clock[0] += 2.0
+    s = m.sample_power()
+    # delta = 1e6 - 5e6 + 2^32 uJ over 2 s
+    assert s["source"] == "rapl"
+    assert abs(s["watts"] - ((2 ** 32 - 4_000_000) / 1e6 / 2.0)) < 1e-6
+
+
+def test_rapl_multi_package_wrap_corrects_per_domain(tmp_path):
+    """One socket's counter wrapping must be corrected by ITS range,
+    not the sum of every package's — the summed correction over-adds a
+    whole counter range per extra socket (a phantom ~430 W spike)."""
+    dom0 = make_rapl_tree(tmp_path, uj=4_000_000)
+    dom1 = tmp_path / "intel-rapl:1"
+    dom1.mkdir()
+    (dom1 / "energy_uj").write_text("1000000\n")
+    (dom1 / "max_energy_range_uj").write_text(f"{2 ** 32}\n")
+    clock = [0.0]
+    m = E.EnergyMeter(perf_registry=make_registry(),
+                      rapl=E.RaplReader(root=str(tmp_path)),
+                      clock=lambda: clock[0])
+    m.sample_power()
+    # dom0 wraps (4e6 -> 1e6); dom1 advances by 2e6 uJ
+    (dom0 / "energy_uj").write_text("1000000\n")
+    (dom1 / "energy_uj").write_text("3000000\n")
+    clock[0] += 2.0
+    s = m.sample_power()
+    want = ((2 ** 32 - 3_000_000) + 2_000_000) / 1e6 / 2.0
+    assert s["source"] == "rapl" and abs(s["watts"] - want) < 1e-6
+
+
+def test_rapl_frozen_counter_is_not_a_measured_zero(tmp_path):
+    """A powercap tree whose counters never advance (VM stubs) must
+    degrade to 'unavailable' — a 'measured' 0 W would beat the honest
+    proxy and report absurd fps/W to the ledger and the heartbeat."""
+    make_rapl_tree(tmp_path, uj=1_000_000)
+    clock = [0.0]
+    m = E.EnergyMeter(perf_registry=make_registry(),
+                      rapl=E.RaplReader(root=str(tmp_path)),
+                      clock=lambda: clock[0])
+    m.sample_power()                           # baseline
+    clock[0] += 5.0
+    assert m.sample_power() is None            # 0 delta: unavailable
+    est = m.estimate(10.0, backend="cpu")
+    assert est["source"] == "proxy" and est["watts"] >= 10.0
+
+
+def test_device_power_explicit_none_checks(monkeypatch):
+    """A 0.0 W reading on one device is a real number, not 'absent'
+    (the falsy-or trap); an ALL-zero total is degenerate for fps/W
+    and degrades to the next source."""
+    import types
+
+    class Dev:
+        def __init__(self, w):
+            self._w = w
+
+        def power_stats(self):
+            return {"power_w": self._w}
+
+    m = E.EnergyMeter(perf_registry=make_registry(),
+                      rapl=E.RaplReader(root="/nonexistent"))
+    monkeypatch.setitem(sys.modules, "jax", types.SimpleNamespace(
+        local_devices=lambda: [Dev(0.0), Dev(7.5)]))
+    s = m.sample_power()
+    assert s == {"watts": 7.5, "source": "device"}
+    monkeypatch.setitem(sys.modules, "jax", types.SimpleNamespace(
+        local_devices=lambda: [Dev(0.0), Dev(0.0)]))
+    assert m._device_power_w() is None
+
+
+def test_rapl_absent_falls_back_to_proxy(tmp_path):
+    m = E.EnergyMeter(perf_registry=make_registry(),
+                      rapl=E.RaplReader(root=str(tmp_path / "nope")))
+    assert m.rapl.available() is False
+    assert m.sample_power() is None
+    assert m.estimate(5.0, backend="cpu")["source"] == "proxy"
+
+
+def test_measured_sample_goes_stale(tmp_path):
+    dom = make_rapl_tree(tmp_path)
+    clock = [0.0]
+    m = E.EnergyMeter(perf_registry=make_registry(),
+                      rapl=E.RaplReader(root=str(tmp_path)),
+                      clock=lambda: clock[0])
+    m.sample_power()
+    (dom / "energy_uj").write_text("3000000\n")
+    clock[0] += 1.0
+    assert m.sample_power()["source"] == "rapl"
+    clock[0] += E.MEASURED_TTL_S + 1.0
+    # a reading from before the workload changed must not linger
+    assert m.estimate(5.0, backend="cpu")["source"] == "proxy"
+
+
+def test_live_fps_estimate_from_frame_notes():
+    clock = [0.0]
+    m = E.EnergyMeter(perf_registry=PerfRegistry(),
+                      rapl=E.RaplReader(root="/nonexistent"),
+                      clock=lambda: clock[0])
+    assert m.fps_estimate() == 0.0
+    for _ in range(10):
+        clock[0] += 0.1
+        m.note_frame()
+    assert abs(m.fps_estimate(window_s=1.0) - 10.0) < 1e-9
+    assert m.watts_estimate() > 0.0            # idle floor at least
+
+
+def test_fps_estimate_survives_ring_saturation():
+    """A busy multi-seat host delivering more frames than the stamp
+    ring holds inside the window must not cap at maxlen/window: the
+    fleet would under-report exactly its hottest hosts."""
+    clock = [0.0]
+    m = E.EnergyMeter(perf_registry=PerfRegistry(),
+                      rapl=E.RaplReader(root="/nonexistent"),
+                      clock=lambda: clock[0])
+    for _ in range(3 * E._FRAME_RING):         # 1000 fps offered
+        clock[0] += 0.001
+        m.note_frame()
+    est = m.fps_estimate(window_s=5.0)
+    assert est > 900.0, est                    # not maxlen/5 ≈ 205
+
+
+# ------------------------------------------------------------ attribution
+
+def _tl(display, fid, t0_ms, spans):
+    return {"display_id": display, "frame_id": fid,
+            "t0_ns": int(t0_ms * 1e6),
+            "t1_ns": int((t0_ms + 12.0) * 1e6),
+            "spans": [{"name": n, "lane": "l", "t0_ns": int(a * 1e6),
+                       "dur_ns": int(d * 1e6)} for n, a, d in spans]}
+
+
+def test_attribution_round_trips_per_frame_and_session():
+    tls = [
+        _tl("s0", 1, 0.0, [("enc", 0.0, 10.0), ("pack", 2.0, 10.0)]),
+        _tl("s0", 2, 20.0, [("enc", 20.0, 8.0)]),     # 4 ms bubble
+        _tl("s1", 1, 40.0, [("enc", 40.0, 12.0)]),
+    ]
+    att = E.attribute_timelines(tls, watts=10.0)
+    assert att["frames"] == 3
+    # 3 frames x 12 ms x 10 W = 0.36 J total
+    assert abs(att["joules"] - 0.36) < 1e-9
+    assert abs(sum(att["per_stage_j"].values()) - att["joules"]) < 1e-9
+    assert abs(att["per_stage_j"]["bubble"] - 10.0 * 0.004) < 1e-9
+    per = att["per_session"]
+    assert set(per) == {"s0", "s1"}
+    assert per["s0"]["frames"] == 2 and per["s1"]["frames"] == 1
+    assert abs(per["s0"]["joules"] + per["s1"]["joules"]
+               - att["joules"]) < 1e-9
+    assert per["s1"]["joules_per_frame"] == 0.12
+
+
+def test_report_derives_fps_from_timeline_window(tmp_path):
+    m = E.EnergyMeter(perf_registry=make_registry(),
+                      rapl=E.RaplReader(root=str(tmp_path)))
+    tls = [_tl("s0", i, i * 100.0, [("enc", i * 100.0, 10.0)])
+           for i in range(5)]
+    rep = m.report(timelines=tls, backend="cpu")
+    # 5 frames over the 412 ms window
+    assert abs(rep["fps"] - round(5 / 0.412, 2)) < 0.02
+    assert rep["attribution"]["frames"] == 5
+    json.loads(json.dumps(rep))
+
+
+# ---------------------------------------------------------- ladder policy
+
+def test_policy_over_budget_is_nan_and_failure_safe():
+    pol = E.EnergyBudgetPolicy(100.0, lambda: 120.0)
+    assert pol.over_budget() is True and pol.last_watts == 120.0
+    assert E.EnergyBudgetPolicy(100.0, lambda: 90.0).over_budget() is False
+    assert E.EnergyBudgetPolicy(
+        100.0, lambda: float("nan")).over_budget() is False
+
+    def boom():
+        raise RuntimeError("watts feed died")
+    assert E.EnergyBudgetPolicy(100.0, boom).over_budget() is False
+
+
+def test_policy_selection_rules():
+    pol = E.EnergyBudgetPolicy(100.0, lambda: 120.0, rung_table={
+        "fps": {"fps_per_w": 1.0},
+        "quality": {"fps_per_w": 5.0, "meets_slo": False},
+        "downscale": {"fps_per_w": 3.0},
+    })
+    steps = ("pipeline", "fps", "quality", "downscale")
+    # everything warm: downscale (3.0) wins; quality (5.0) is skipped
+    # for violating the SLO, pipeline for being unpriced
+    assert pol.select_rung(steps, 0, lambda s: True) == 3
+    # downscale cold: fps is the best warm SLO-meeting rung
+    assert pol.select_rung(steps, 0, lambda s: s != "downscale") == 1
+    # only rungs at/below the current level are candidates
+    assert pol.select_rung(steps, 2, lambda s: s != "downscale") is None
+    # callable SLO predicate is honoured (and a crashing one rejects)
+    pol2 = E.EnergyBudgetPolicy(100.0, lambda: 120.0, rung_table={
+        "fps": {"fps_per_w": 1.0, "meets_slo": lambda: True},
+        "downscale": {"fps_per_w": 3.0,
+                      "meets_slo": lambda: 1 / 0},
+    })
+    assert pol2.select_rung(steps, 0, lambda s: True) == 1
+
+
+def test_ladder_policy_from_settings():
+    import types
+    assert E.ladder_policy_from_settings(
+        types.SimpleNamespace(power_budget_w=0.0)) is None
+    assert E.ladder_policy_from_settings(types.SimpleNamespace()) is None
+    pol = E.ladder_policy_from_settings(
+        types.SimpleNamespace(power_budget_w=250.0))
+    assert pol is not None and pol.budget_w == 250.0
+
+
+# ------------------------------------------------------------ perf ledger
+
+def _ledger_entry(**over):
+    e = {
+        "v": 1, "ts": "2026-08-04T00:00:00+00:00", "git_rev": "a" * 40,
+        "host": "h", "host_id": "h-1", "metric":
+        "encode_fps_256x128_jpeg_tpu", "backend": "cpu",
+        "backend_class": "cpu", "resolution": "256x128", "codec": "jpeg",
+        "backend_health": "ok", "baseline_eligible": True, "fps": 10.0,
+        "latency_p50_ms": 50.0, "latency_p99_ms": 60.0,
+        "g2g_p99_ms": 80.0, "qoe_score": 90.0, "pipeline_depth": 2,
+        "stripe_devices": 1, "joules_frame": 1.0, "fps_per_w": 0.9,
+        "watts_mean": 11.1, "energy_source": "proxy",
+    }
+    e.update(over)
+    return e
+
+
+def test_entry_from_bench_carries_energy_columns():
+    doc = {"metric": "encode_fps_256x128_jpeg_tpu", "value": 10.0,
+           "backend": "cpu", "backend_health": {"status": "ok"},
+           "energy": {"joules_frame": 1.25, "watts_mean": 12.5,
+                      "fps_per_w": 0.8, "source": "rapl"}}
+    e = perf_ledger.entry_from_bench(doc)
+    assert e["joules_frame"] == 1.25
+    assert e["fps_per_w"] == 0.8
+    assert e["watts_mean"] == 12.5
+    assert e["energy_source"] == "rapl"
+    # energy-less docs stay None, never 0 (the columns are honest)
+    e2 = perf_ledger.entry_from_bench(
+        {"metric": "encode_fps_256x128_jpeg_tpu", "value": 10.0,
+         "backend": "cpu", "backend_health": {"status": "ok"}})
+    assert e2["joules_frame"] is None and e2["fps_per_w"] is None
+
+
+def test_wild_joules_swing_cannot_fail_the_gate(tmp_path, capsys):
+    """ISSUE 14 satellite: energy columns are informational-only in
+    check until a real-TPU baseline exists — a 100x joules swing with
+    healthy fps/p99 must exit 0."""
+    ledger = tmp_path / "ledger.jsonl"
+    perf_ledger.append_entry(str(ledger), _ledger_entry())
+    cand = _ledger_entry(git_rev="b" * 40, joules_frame=100.0,
+                         fps_per_w=0.009, watts_mean=1000.0)
+    cand_file = tmp_path / "cand.json"
+    cand_file.write_text(json.dumps(cand))
+    rc = perf_ledger.main(["--ledger", str(ledger), "check",
+                           "--candidate", str(cand_file)])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "informational only" in err and "never gated" in err
+    # sanity: the SAME candidate with an fps regression still fails
+    cand2 = _ledger_entry(git_rev="c" * 40, fps=5.0)
+    cand_file.write_text(json.dumps(cand2))
+    assert perf_ledger.main(["--ledger", str(ledger), "check",
+                             "--candidate", str(cand_file)]) == 1
+
+
+def test_report_renders_energy_columns(tmp_path, capsys):
+    ledger = tmp_path / "ledger.jsonl"
+    perf_ledger.append_entry(str(ledger), _ledger_entry())
+    assert perf_ledger.main(["--ledger", str(ledger), "report"]) == 0
+    out = capsys.readouterr().out
+    assert "j/f" in out and "fps/W" in out
+    assert "1.000" in out and "0.900" in out
+
+
+def test_pareto_front_over_operating_points(tmp_path, capsys):
+    ledger = tmp_path / "ledger.jsonl"
+    # A: best quality (slow, hungry) — on the front
+    perf_ledger.append_entry(str(ledger), _ledger_entry(
+        metric="encode_fps_1920x1080_h264_tpu", resolution="1920x1080",
+        codec="h264", qoe_score=99.0, g2g_p99_ms=100.0,
+        joules_frame=3.0, fps_per_w=0.3))
+    # B: efficient and fast — on the front
+    perf_ledger.append_entry(str(ledger), _ledger_entry(
+        metric="encode_fps_1280x720_h264_tpu", resolution="1280x720",
+        codec="h264", qoe_score=95.0, g2g_p99_ms=40.0,
+        joules_frame=0.5, fps_per_w=2.0))
+    # C: dominated by B on all three axes
+    perf_ledger.append_entry(str(ledger), _ledger_entry(
+        metric="encode_fps_256x128_jpeg_tpu", resolution="256x128",
+        codec="jpeg", qoe_score=90.0, g2g_p99_ms=60.0,
+        joules_frame=2.0, fps_per_w=0.45))
+    assert perf_ledger.main(["--ledger", str(ledger), "pareto",
+                             "--json"]) == 0
+    out = capsys.readouterr().out
+    assert "2 on the" in out
+    doc = json.loads(out.strip().splitlines()[-1])
+    assert sorted(doc["front"]) == sorted([
+        "cpu/1920x1080/h264/1/2", "cpu/1280x720/h264/1/2"])
+    assert "dominated" in out and "256x128" in out
+
+
+def test_pareto_latest_entry_per_point_wins(tmp_path, capsys):
+    ledger = tmp_path / "ledger.jsonl"
+    perf_ledger.append_entry(str(ledger), _ledger_entry(
+        joules_frame=9.0, fps_per_w=0.1))
+    perf_ledger.append_entry(str(ledger), _ledger_entry(
+        git_rev="b" * 40, joules_frame=1.5, fps_per_w=0.7))
+    assert perf_ledger.main(["--ledger", str(ledger), "pareto"]) == 0
+    out = capsys.readouterr().out
+    assert "1 operating point(s)" in out and "1.5000" in out

@@ -93,10 +93,26 @@ def test_heartbeat_round_trips_through_wire_parser():
      "warm_geometries": ["640by360"]},
     {"v": 1, "kind": "heartbeat", "host_id": "x",
      "slo": {"status": "ok", "fast_burn": float("inf")}},
+    # watts_est (ISSUE 14) is a capacity field — it steers the fleet
+    # power budget, so NaN / negative / absurd values reject+count
+    # like every other axis
+    {"v": 1, "kind": "heartbeat", "host_id": "x",
+     "watts_est": float("nan")},
+    {"v": 1, "kind": "heartbeat", "host_id": "x", "watts_est": -3},
+    {"v": 1, "kind": "heartbeat", "host_id": "x", "watts_est": 1e9},
 ])
 def test_malformed_heartbeats_rejected(doc):
     with pytest.raises(FleetProtocolError):
         parse_heartbeat(doc)
+
+
+def test_heartbeat_watts_est_round_trips():
+    hb = Heartbeat(host_id="h0", watts_est=41.5)
+    back = parse_heartbeat(hb.to_json())
+    assert back.watts_est == 41.5
+    # absent stays absent (older hosts): never defaulted to a number
+    assert parse_heartbeat(Heartbeat(host_id="h0").to_json()) \
+        .watts_est is None
 
 
 def test_session_spec_and_estimate():
@@ -123,6 +139,97 @@ def test_migrate_command_shape():
 
 
 # --------------------------------------------------------------- scheduler
+
+def _power_hb(host_id="h0", watts=None):
+    """A ready single-device host with effectively-infinite seat/HBM/
+    pixel headroom, so only the power axis can refuse."""
+    return Heartbeat(host_id=host_id, ready=True, watts_est=watts,
+                     devices=[DeviceCapacity(
+                         id=0, hbm_limit_mb=1e6, seat_slots=64,
+                         pixel_budget=10 ** 12)])
+
+
+def test_power_budget_refusal_queues_and_frees():
+    """ISSUE 14: with a fleet power budget set, a placement that would
+    push the projected draw past it refuses-into-the-queue like any
+    capacity axis, and releasing a seat frees its watts."""
+    rec = FlightRecorder()
+    spec_w = SessionSpec("s1", 1920, 1080, "h264").budget_w()
+    sched = SeatScheduler(clock=Clock(), recorder=rec,
+                          power_budget_w=1.5 * spec_w)
+    sched.observe(_power_hb())
+    assert sched.place(SessionSpec("s1", 1920, 1080, "h264")) is not None
+    spec2 = SessionSpec("s2", 1920, 1080, "h264")
+    assert sched.feasible(spec2) is False        # power, not HBM/pixels
+    assert sched.place(spec2) is None
+    assert "placement_pending" in incident_kinds(rec)
+    assert len(sched.pending) == 1
+    snap = sched.snapshot()
+    assert snap["power"]["budget_w"] == 1.5 * spec_w
+    assert snap["power"]["fleet_watts_est"] >= spec_w
+    sched.release("s1")                          # watts free with the seat
+    assert sched.get("s2") is not None
+
+
+def test_power_budget_heartbeat_watts_floor():
+    """The REPORTED draw (measured RAPL/device watts in the heartbeat)
+    floors the projection: a fleet already burning its budget takes
+    nothing, whatever the scheduler itself placed."""
+    sched = SeatScheduler(clock=Clock(), recorder=FlightRecorder(),
+                          power_budget_w=50.0)
+    sched.observe(_power_hb(watts=49.9))
+    assert sched.place(SessionSpec("s1", 640, 360, "jpeg")) is None
+    assert len(sched.pending) == 1
+    # draw falls on the next heartbeat: the queued session lands on
+    # the observe-triggered retry
+    sched.observe(_power_hb(watts=10.0))
+    assert sched.get("s1") is not None
+    assert not sched.pending
+
+
+def test_power_budget_migration_probe_is_power_neutral():
+    """The evict/migrate path probes feasible() BEFORE releasing the
+    source seat: an already-placed session's watts are in the fleet
+    projection already, so the probe must not double-charge them — or
+    rebalance wedges the moment the fleet runs near its budget."""
+    spec = SessionSpec("s1", 1920, 1080, "h264")
+    sched = SeatScheduler(clock=Clock(), recorder=FlightRecorder(),
+                          power_budget_w=spec.budget_w() + 0.1)
+    sched.observe(_power_hb("h0"))
+    sched.observe(_power_hb("h1"))
+    p = sched.place(spec)
+    assert p is not None
+    # power-neutral move probe: still feasible on the other host
+    assert sched.feasible(spec, exclude_hosts={p.host_id}) is True
+    # a genuinely NEW session is honestly refused
+    assert sched.feasible(SessionSpec("s2", 1920, 1080, "h264")) is False
+
+
+def test_power_probe_of_placed_session_survives_over_budget_fleet():
+    """With the fleet already OVER its power budget (burning hosts —
+    exactly when rebalance matters) a power-neutral move of a placed
+    session must still probe feasible; only NEW sessions refuse."""
+    spec = SessionSpec("s1", 1920, 1080, "h264")
+    sched = SeatScheduler(clock=Clock(), recorder=FlightRecorder(),
+                          power_budget_w=50.0)
+    sched.observe(_power_hb("h0"))
+    sched.observe(_power_hb("h1"))
+    p = sched.place(spec)
+    assert p is not None
+    # heartbeats now report 30 W each: fleet 60 W > 50 W budget
+    sched.observe(_power_hb("h0", watts=30.0))
+    sched.observe(_power_hb("h1", watts=30.0))
+    assert sched.feasible(spec, exclude_hosts={p.host_id}) is True
+    assert sched.feasible(SessionSpec("s2", 640, 360, "jpeg")) is False
+
+
+def test_no_power_budget_means_axis_off():
+    """Default (power_budget_w None): watts never refuse, whatever the
+    heartbeats report — byte-for-byte the pre-ISSUE-14 scheduler."""
+    sched = SeatScheduler(clock=Clock(), recorder=FlightRecorder())
+    sched.observe(_power_hb(watts=999_999.0))
+    assert sched.place(SessionSpec("s1", 1920, 1080, "h264")) is not None
+
 
 def test_hbm_refusal_queues_with_incident_not_dropped():
     fleet, sched, coord, rec = make_rig()

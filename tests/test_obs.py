@@ -7,6 +7,8 @@ device-lane trace overlay)."""
 import asyncio
 import json
 
+import pytest
+
 from selkies_tpu.obs import (DEGRADED, FAILED, OK, DeviceMonitor,
                              FlightRecorder, HealthEngine, degraded,
                              failed, ok)
@@ -372,8 +374,15 @@ async def test_profile_endpoint_role_gated_and_status(client_factory):
     assert r.status == 409 and "no capture" in (await r.json())["error"]
 
 
+@pytest.mark.slow
 async def test_profile_capture_roundtrip(client_factory, tmp_path):
-    """Full start->stop cycle writes a jax.profiler trace dir."""
+    """Full start->stop cycle writes a jax.profiler trace dir.
+
+    Slow-marked (ISSUE 14 budget pass): the CPU jax.profiler capture
+    costs ~49 s of the 870 s tier-1 budget; the endpoint's
+    control-flow contracts (role gate, double-start 409, stop-without-
+    start 409) stay tier-1 in the tests above, and bench --profile
+    exercises the capture end-to-end on the perf rounds."""
     server, *_ = make_app()
     c = await client_factory(server)
     target = str(tmp_path / "cap")

@@ -84,7 +84,12 @@ def test_multiseat_two_seats_per_device():
     assert all(len(c) == enc.grid.n_stripes for c in per_seat)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_entrypoint():
+    # slow-marked (ISSUE 14 budget pass, the PR-12 precedent): the
+    # 8-device XLA build costs ~86 s of the 870 s tier-1 budget, and
+    # the driver invokes __graft_entry__ itself every round
+    # (MULTICHIP_r*.json), so tier-1 is not the only proof
     import importlib.util
     import pathlib
     path = pathlib.Path(__file__).resolve().parent.parent / "__graft_entry__.py"
@@ -172,7 +177,11 @@ def test_multiseat_h264_bitexact_vs_single_seat():
     assert len({tuple(p for _, _, p in chunks) for chunks in got0}) == n
 
 
+@pytest.mark.slow
 def test_multiseat_capture_h264_mode():
+    # slow-marked (ISSUE 14 budget pass): ~44 s of XLA build; h264
+    # multiseat correctness stays tier-1 via the bitexact test and the
+    # capture facade via the jpeg-mode thread test
     """The server-facing facade honors output_mode=h264 end-to-end."""
     import time
 

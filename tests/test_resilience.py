@@ -359,6 +359,100 @@ def test_ladder_full_walk_with_injected_clock():
     assert len(ev) == 1 + lad.transitions
 
 
+def test_ladder_energy_mode_picks_efficient_warm_slo_rung():
+    """ISSUE 14 contract: under an injected power budget (injected
+    watts feed + clocks), the downshift lands on the HIGHEST-EFFICIENCY
+    warm rung that meets the SLO — skipping both a cheaper-but-
+    SLO-violating rung and a cold one — and the two-sided hysteresis
+    (down_after_s / hold_s / ok_window_s) governs power-driven shifts
+    exactly like verdict-driven ones."""
+    from selkies_tpu.obs.energy import EnergyBudgetPolicy
+    eng = _health.HealthEngine()
+    watts_box = [120.0]
+    policy = EnergyBudgetPolicy(100.0, lambda: watts_box[0], rung_table={
+        "pipeline": {"fps_per_w": 0.2},
+        "fps": {"fps_per_w": 1.0},
+        # the CHEAPEST rung — but its SLO predicate says no: must skip
+        "quality": {"fps_per_w": 5.0, "meets_slo": False},
+        # more efficient than fps, but cold: the gate excludes it
+        "downscale": {"fps_per_w": 3.0},
+    })
+
+    class Gate:
+        queried = []
+
+        def query(self, step, direction):
+            self.queried.append((step, direction))
+            return "cold" if step == "downscale" else "warm"
+
+        def request(self, step, direction):
+            pass
+
+    lad = DegradationLadder(down_after_s=4.0, hold_s=10.0,
+                            ok_window_s=30.0, gate=Gate(),
+                            energy_policy=policy,
+                            recorder=eng.recorder)
+    calls = []
+    lad.bind_controls({
+        "pipeline": (lambda: calls.append("p-"),
+                     lambda: calls.append("p+")),
+        "fps": (lambda: calls.append("fps-"),
+                lambda: calls.append("fps+")),
+        "quality": (lambda: calls.append("q-"),
+                    lambda: calls.append("q+")),
+        "downscale": (lambda: calls.append("s-"),
+                      lambda: calls.append("s+")),
+    })
+    ok = {"qoe": _health.ok()}
+    lad.observe(ok, now=0.0)
+    assert lad.level == 0                  # hysteresis: not yet
+    lad.observe(ok, now=4.0)
+    # the pick: quality (eff 5.0) violates SLO, downscale (3.0) is
+    # cold, fps (1.0) beats pipeline (0.2) -> land on fps, skipping
+    # the pipeline rung
+    assert lad.level == 2 and calls == ["fps-"]
+    steps = [e for e in eng.recorder.snapshot()
+             if e["kind"] == "degradation_step"]
+    assert steps[-1]["step"] == "fps"
+    assert steps[-1]["skipped"] == ["pipeline"]
+    assert "power=over_budget" in steps[-1]["reasons"]
+    assert any(r.startswith("energy-efficient:fps")
+               for r in steps[-1]["reasons"])
+    lad.observe(ok, now=5.0)
+    assert lad.level == 2                  # hold_s blocks further shed
+    # budget clears: the sustained-ok window governs the walk back up,
+    # one rung per hold — unchanged two-sided hysteresis
+    watts_box[0] = 50.0
+    lad.observe(ok, now=20.0)
+    lad.observe(ok, now=49.0)
+    assert lad.level == 2                  # 29 s ok < 30 s window
+    lad.observe(ok, now=51.0)
+    assert lad.level == 1 and calls[-1] == "fps+"
+    lad.observe(ok, now=62.0)
+    assert lad.level == 0 and calls[-1] == "p+"
+    assert lad.snapshot()["energy_mode"] is True
+    assert lad.snapshot()["energy"]["budget_w"] == 100.0
+
+
+def test_ladder_energy_mode_inert_without_policy_or_under_budget():
+    """Default ladder (no policy) and an under-budget policy both keep
+    the stock nearest-rung walk — the energy seam adds no behaviour
+    until the budget is actually exceeded."""
+    from selkies_tpu.obs.energy import EnergyBudgetPolicy
+    policy = EnergyBudgetPolicy(100.0, lambda: 10.0, rung_table={
+        "downscale": {"fps_per_w": 99.0},
+    })
+    lad = DegradationLadder(down_after_s=0.0, hold_s=0.0,
+                            energy_policy=policy,
+                            recorder=_health.HealthEngine().recorder)
+    bad = {"qoe": _health.failed("stall")}
+    lad.observe(bad, now=0.0)
+    # a verdict-driven shift with the budget NOT exceeded: nearest
+    # rung (pipeline), never the policy's favourite
+    assert lad.level == 1
+    assert lad.snapshot()["step"] == "pipeline"
+
+
 def test_ladder_ignores_qoe_degraded():
     # degraded qoe is what shedding CAUSES; only failed triggers
     lad = DegradationLadder(down_after_s=0.0, hold_s=0.0,

@@ -13,6 +13,15 @@ backend_health), and turns it into a regression gate:
            for the same key within a noise band; exits non-zero on a
            regression beyond the band (unless --warn-only)
   report   render the fps / p99 / top-stage trajectory per key
+  pareto   render the quality x latency x energy operating-point front
+           (ISSUE 14) over the energy-bearing entries
+
+Energy columns (``joules_frame`` / ``fps_per_w`` / ``watts_mean`` /
+``energy_source``, ISSUE 14) are carried on every entry but are
+**informational-only in check** — never gated — until a real-TPU
+baseline entry exists: the CPU proxy coefficients rank operating points
+against each other, they are not absolute joules, and a coefficient
+retune must never fail the CPU perf-gate.
 
 Baseline rules (the r4/r5 lesson — a silent CPU fallback must never
 become the number to beat):
@@ -153,6 +162,13 @@ def entry_from_bench(doc: dict, *, git_rev: Optional[str] = None,
         } or None,
         "hbm_peak_mb": doc.get("hbm_peak_mb"),
         "compile_total_s": doc.get("compile_total_s"),
+        # energy axis (ISSUE 14): joules/frame + fps/W with the honest
+        # provenance label (proxy|rapl|device) — informational in
+        # check until a real-TPU baseline pins the absolute scale
+        "joules_frame": (doc.get("energy") or {}).get("joules_frame"),
+        "fps_per_w": (doc.get("energy") or {}).get("fps_per_w"),
+        "watts_mean": (doc.get("energy") or {}).get("watts_mean"),
+        "energy_source": (doc.get("energy") or {}).get("source"),
     }
 
 
@@ -303,6 +319,19 @@ def cmd_check(args: argparse.Namespace) -> int:
             f"compare (this run becomes the baseline once recorded)")
         return 0
     problems = compare(candidate, baseline, band=args.band)
+    # energy columns are INFORMATIONAL-ONLY (ISSUE 14): logged, never
+    # appended to problems — a wild joules swing (coefficient retune,
+    # RAPL appearing on one runner) must not fail the CPU perf-gate
+    # until a real-TPU baseline entry pins the absolute scale
+    jf_new = candidate.get("joules_frame")
+    jf_old = baseline.get("joules_frame")
+    if isinstance(jf_new, (int, float)) and \
+            isinstance(jf_old, (int, float)) and jf_old > 0:
+        log(f"check: energy joules_frame {jf_new} vs baseline {jf_old} "
+            f"({jf_new / jf_old - 1.0:+.1%}, "
+            f"source {candidate.get('energy_source')!r} vs "
+            f"{baseline.get('energy_source')!r}) — informational only, "
+            f"never gated")
     log(f"check: candidate {candidate.get('git_rev', '?')[:7]} "
         f"fps={candidate.get('fps')} p99={candidate.get('latency_p99_ms')} "
         f"vs baseline {baseline.get('git_rev', '?')[:7]} "
@@ -340,9 +369,12 @@ def cmd_report(args: argparse.Namespace) -> int:
         print(f"== {' / '.join(str(k) for k in key)} ({len(runs)} runs)")
         print(f"   {'date':<20} {'rev':<8} {'backend':<24} {'fps':>7} "
               f"{'p50_ms':>9} {'p99_ms':>9} {'g2g_p99':>9} {'pd':>3} "
-              f"{'sd':>3} {'overlap':>8} {'ok':>3}  top stage")
+              f"{'sd':>3} {'overlap':>8} {'j/f':>8} {'fps/W':>7} "
+              f"{'ok':>3}  top stage")
         for e in runs:
             ov = e.get("overlap_fraction")
+            jf = e.get("joules_frame")
+            fpw = e.get("fps_per_w")
             print(f"   {str(e.get('ts', ''))[:19]:<20} "
                   f"{str(e.get('git_rev', ''))[:7]:<8} "
                   f"{str(e.get('backend', ''))[:24]:<24} "
@@ -353,6 +385,8 @@ def cmd_report(args: argparse.Namespace) -> int:
                   f"{e.get('pipeline_depth') or '-':>3} "
                   f"{e.get('stripe_devices') or 1:>3} "
                   f"{(format(ov, '.1%') if isinstance(ov, (int, float)) else '-'):>8} "
+                  f"{(format(jf, '.3f') if isinstance(jf, (int, float)) else '-'):>8} "
+                  f"{(format(fpw, '.3f') if isinstance(fpw, (int, float)) else '-'):>7} "
                   f"{'y' if e.get('baseline_eligible') else 'n':>3}  "
                   f"{_top_stage(e)}")
         out_doc["keys"].append({
@@ -361,11 +395,95 @@ def cmd_report(args: argparse.Namespace) -> int:
                       ("ts", "git_rev", "backend", "fps",
                        "latency_p50_ms", "latency_p99_ms", "g2g_p99_ms",
                        "pipeline_depth", "stripe_devices",
-                       "overlap_fraction",
+                       "overlap_fraction", "joules_frame", "fps_per_w",
+                       "energy_source",
                        "baseline_eligible", "stages_ms")}
                      for e in runs]})
     if args.json:
         print(json.dumps(out_doc, sort_keys=True))
+    return 0
+
+
+def _pareto_points(entries: list[dict]) -> list[dict]:
+    """Latest energy-bearing entry per operating point. An operating
+    point is a prewarm-lattice-shaped key — (backend class, resolution,
+    codec, stripe devices, pipeline depth): the axes the ladder and the
+    lattice actually move between."""
+    latest: dict = {}
+    for e in entries:
+        if not str(e.get("metric", "")).startswith("encode_fps"):
+            continue
+        if not isinstance(e.get("joules_frame"), (int, float)):
+            continue
+        lat = e.get("g2g_p99_ms")
+        lat = lat if isinstance(lat, (int, float)) else \
+            e.get("latency_p99_ms")
+        if not isinstance(lat, (int, float)):
+            continue
+        q = e.get("qoe_score")
+        quality = q if isinstance(q, (int, float)) else e.get("fps")
+        if not isinstance(quality, (int, float)):
+            continue
+        key = (e.get("backend_class"), e.get("resolution"),
+               e.get("codec"), e.get("stripe_devices") or 1,
+               e.get("pipeline_depth") or 1)
+        latest[key] = {            # later entries override: latest wins
+            "point": "/".join(str(k) for k in key),
+            "quality": quality,
+            "quality_axis": "qoe_score"
+            if isinstance(q, (int, float)) else "fps",
+            "latency_ms": lat,
+            "joules_frame": e["joules_frame"],
+            "fps_per_w": e.get("fps_per_w"),
+            "source": e.get("energy_source"),
+            "ts": e.get("ts"), "git_rev": str(e.get("git_rev", ""))[:7],
+        }
+    return list(latest.values())
+
+
+def _dominates(a: dict, b: dict) -> bool:
+    """a dominates b on the quality x latency x energy surface: no
+    worse on every axis, strictly better on at least one."""
+    ge = (a["quality"] >= b["quality"]
+          and a["latency_ms"] <= b["latency_ms"]
+          and a["joules_frame"] <= b["joules_frame"])
+    strict = (a["quality"] > b["quality"]
+              or a["latency_ms"] < b["latency_ms"]
+              or a["joules_frame"] < b["joules_frame"])
+    return ge and strict
+
+
+def cmd_pareto(args: argparse.Namespace) -> int:
+    entries = read_ledger(args.ledger)
+    points = _pareto_points(entries)
+    if not points:
+        log("pareto: no energy-bearing encode_fps entries in the "
+            "ledger yet (run bench.py)")
+        return 0
+    for p in points:
+        p["front"] = not any(_dominates(q, p) for q in points
+                             if q is not p)
+    points.sort(key=lambda p: (not p["front"], p["joules_frame"]))
+    n_front = sum(p["front"] for p in points)
+    print(f"pareto: {len(points)} operating point(s), {n_front} on the "
+          f"quality x latency x energy front")
+    print(f"{'':2}{'operating point':<36} {'quality':>9} {'p99_ms':>9} "
+          f"{'j/frame':>9} {'fps/W':>8} {'src':>6}  rev")
+    for p in points:
+        print(f"{'* ' if p['front'] else '  '}"
+              f"{p['point']:<36} "
+              f"{p['quality']:>9.2f} "
+              f"{p['latency_ms']:>9.2f} "
+              f"{p['joules_frame']:>9.4f} "
+              f"{(format(p['fps_per_w'], '.3f') if isinstance(p['fps_per_w'], (int, float)) else '-'):>8} "
+              f"{str(p['source'] or '-'):>6}  {p['git_rev']}")
+    if n_front < len(points):
+        dominated = [p["point"] for p in points if not p["front"]]
+        print(f"  dominated: {', '.join(dominated)}")
+    if args.json:
+        print(json.dumps({"points": points,
+                          "front": [p["point"] for p in points
+                                    if p["front"]]}, sort_keys=True))
     return 0
 
 
@@ -408,6 +526,14 @@ def main(argv=None) -> int:
     pp.add_argument("--ignore-host", action="store_true",
                     help="group across host fingerprints")
     pp.set_defaults(fn=cmd_report)
+
+    pf = sub.add_parser(
+        "pareto",
+        help="quality x latency x energy operating-point front "
+             "(latest energy-bearing entry per operating point)")
+    pf.add_argument("--json", action="store_true",
+                    help="machine-readable output after the table")
+    pf.set_defaults(fn=cmd_pareto)
 
     args = p.parse_args(argv)
     return args.fn(args)
