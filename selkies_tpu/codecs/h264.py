@@ -19,6 +19,8 @@ inside its Rust H.264 encoders (SURVEY.md §2.2); the wire contract is the
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from . import h264_tables as T
@@ -767,6 +769,30 @@ class PFrameEncoder:
                     plane[row * 8 + br * 4:row * 8 + br * 4 + 4,
                           k * 8 + bc * 4:k * 8 + bc * 4 + 4] = blk
         return 0
+
+
+def p_skip_slice_rbsp(first_mb: int, n_mbs: int, qp: int,
+                      frame_num: int) -> bytes:
+    """RBSP of an all-skip P slice: header + ``ue(mb_skip_run == n_mbs)``
+    + stop bit. Byte-identical to what the device P step emits for a row
+    with zero coded macroblocks (same header fields, same trailing-run
+    gate, same zero pad) — pinned by tests/test_h264_bands.py, which is
+    what lets the dirty-band partial encode stitch these host-built
+    segments against freshly device-encoded band rows into one
+    decode-valid frame. Cached on the 16-value frame_num the header
+    actually encodes (u(4) — log2_max_frame_num=4), so a clean band's
+    bytes genuinely recycle every 16 frames at fixed qp."""
+    return _p_skip_slice_cached(first_mb, n_mbs, qp, frame_num & 0xF)
+
+
+@functools.lru_cache(maxsize=4096)
+def _p_skip_slice_cached(first_mb: int, n_mbs: int, qp: int,
+                         frame_num: int) -> bytes:
+    w = BitWriter()
+    p_slice_header_bits(w, first_mb, qp, frame_num)
+    w.ue(n_mbs)
+    w.rbsp_trailing()
+    return w.to_bytes()
 
 
 def p_slice_header_events(mb_w: int, n_rows: int):

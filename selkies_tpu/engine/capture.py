@@ -110,6 +110,18 @@ class ScreenCapture:
         #: control always runs on the capture thread)
         self._delivered_pending: list = []
         self._delivered_lock = threading.Lock()
+        #: content classifier (ROADMAP 4, engine/content.py): fed the
+        #: per-frame dirty fraction by the capture thread; rebuilt per
+        #: run. Written by start_capture under _api_lock, read by the
+        #: capture thread and the stats/metrics pollers.
+        self._content = None
+        #: content-profile qp bias currently applied to the session
+        #: (so class changes shift qp RELATIVELY and never stomp a
+        #: client-chosen quality level), plus the qp value WE last
+        #: wrote — an external write (client tunable) in between means
+        #: the embedded bias was overwritten and must rebase to 0
+        self._content_qp_bias = 0
+        self._content_qp_seen = None
 
     # -- reference API surface ----------------------------------------------
     def start_capture(self, callback: Callable[[EncodedChunk], None],
@@ -146,6 +158,26 @@ class ScreenCapture:
                 self._rc_fullness = 0.0
                 self._rc_qp0 = getattr(self._session, "qp",
                                        settings.video_crf)
+            # content classifier (ROADMAP 4): h264 sessions with the
+            # partial path carry a live dirty-fraction signal; the
+            # classifier maps it to a rate-control profile per class.
+            # The bias reset shares the rc-state lock: an abandoned
+            # capture thread may still be inside _content_tick when the
+            # replacement run resets — unlocked, its stale bias could
+            # land on the NEW session's qp accounting.
+            self._content = None
+            with self._lock:
+                self._content_qp_bias = 0
+                self._content_qp_seen = None
+            # same gate as the session's partial path: without damage
+            # gating there is no dirty-fraction signal and the EWMAs
+            # would converge on a constant 1.0 ("video") for any content
+            if settings.output_mode == "h264" \
+                    and settings.use_damage_gating and getattr(
+                    settings, "h264_content_adaptive", True) and getattr(
+                    settings, "h264_partial_encode", False):
+                from .content import ContentClassifier
+                self._content = ContentClassifier()
             self._source = make_source(self._source_kind,
                                        settings.capture_width,
                                        settings.capture_height,
@@ -404,10 +436,14 @@ class ScreenCapture:
                 # periodic full refresh (keyframe_interval_s) on top of
                 # client-requested IDRs; <=0 disables the cadence. Decided
                 # BEFORE encode: the h264 session's on-device idr parity
-                # must count forced sends.
+                # must count forced sends. The content profile may
+                # override the cadence (gaming wants fast recovery).
                 force = self._force_idr.is_set()
-                if s.keyframe_interval_s > 0 \
-                        and t0 - last_full >= s.keyframe_interval_s:
+                kf_s = s.keyframe_interval_s
+                ctl = self._content
+                if ctl is not None and ctl.profile.idr_cadence_s:
+                    kf_s = ctl.profile.idr_cadence_s
+                if kf_s > 0 and t0 - last_full >= kf_s:
                     force = True
                 if force:
                     last_full = t0
@@ -431,6 +467,12 @@ class ScreenCapture:
                 else:
                     out["slot"] = 0
                     self._deliver(out)
+                # content classification (ROADMAP 4): the partial
+                # dispatch left this frame's dirty fraction on the
+                # session; a class change applies the profile here on
+                # the capture thread (it owns rate control)
+                if ctl is not None:
+                    self._content_tick(ctl, sess, s)
                 # rate control runs HERE (capture thread) on delivery
                 # accounting the finalizer queued — session quant/qp
                 # mutations must never race the dispatch path
@@ -487,6 +529,66 @@ class ScreenCapture:
                 # rebuilds the session and forces an IDR) — the ring
                 # must never wedge the restart
                 ring.close(drain=False)
+
+    def _content_tick(self, ctl, sess, s: CaptureSettings) -> None:
+        """One classifier update from the frame just dispatched; on a
+        class change (or the very first frame — the initial class's
+        profile must apply too, not only transitions away from it),
+        apply the profile (band floor + qp bias) and record the
+        transition as a flight-recorder incident."""
+        df = float(getattr(sess, "dirty_fraction", 1.0))
+        prev_cls = ctl.current
+        cur = ctl.update(df)
+        if cur == prev_cls and ctl.frames > 1:
+            return
+        profile = ctl.profile
+        if hasattr(sess, "set_content_profile"):
+            sess.set_content_profile(profile)
+        # qp bias only without CBR — the leaky-bucket controller owns
+        # qp there and a static bias would fight it every frame. The
+        # bias moves qp RELATIVE to its current value (swapping out the
+        # previous class's bias first): the base may be a client-chosen
+        # quality level, not video_crf, and must survive class changes.
+        # Bookkeeping records the delta ACTUALLY applied after the 8..48
+        # clamp, so a truncated step near the bounds unwinds exactly and
+        # qp can never drift away from base+bias across transitions.
+        if not s.use_cbr and hasattr(sess, "set_qp"):
+            qp0 = int(sess.qp)
+            with self._lock:
+                if self._content is not ctl:
+                    # a replacement run reset the accounting while this
+                    # (abandoned) thread was mid-tick: its stale bias
+                    # must not land on the NEW run's books
+                    return
+                if self._content_qp_seen not in (None, qp0):
+                    # external qp write (client tunable) overwrote the
+                    # embedded bias — the new value is the client's
+                    # chosen base, carrying no bias
+                    self._content_qp_bias = 0
+                target = qp0 + profile.qp_bias - self._content_qp_bias
+                new_qp = max(8, min(48, target))
+                self._content_qp_bias += new_qp - qp0
+                self._content_qp_seen = new_qp
+            if new_qp != qp0:
+                sess.set_qp(new_qp)
+        if cur != prev_cls:
+            _health.engine.recorder.record(
+                "content_class_change", display=s.display_id,
+                from_class=prev_cls, to_class=cur,
+                dirty_fraction=round(df, 4))
+
+    def content_state(self) -> dict:
+        """Classifier + dirty-fraction block for /api/sessions and the
+        bounded-cardinality session gauges (obs/qoe)."""
+        sess = self._session
+        df = getattr(sess, "dirty_fraction", None) if sess is not None \
+            else None
+        ctl = self._content
+        if ctl is None:
+            return {"dirty_fraction": df}
+        doc = ctl.snapshot()
+        doc["dirty_fraction"] = df
+        return doc
 
     def _drain_delivered(self) -> list:
         with self._delivered_lock:

@@ -28,7 +28,7 @@ import functools
 import logging
 import threading
 import time
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +36,8 @@ import numpy as np
 
 from ..codecs import h264 as hcodec
 from ..obs import perf as _perf
+from ..ops.bands import dirty_fraction as _dirty_fraction
+from ..ops.bands import plan_band
 from ..ops.h264_encode import P_SLOTS_MB, SLOTS_MB, scroll_candidates
 from ..ops.h264_planes import (h264_encode_p_yuv, h264_encode_yuv,
                                rgb_to_yuv420)
@@ -230,6 +232,154 @@ def _jitted_h264_step(mode: str, width: int, stripe_h: int, n_stripes: int,
             (1, 2, 3, 4, 5, 6, 7))))
 
 
+# ---------------------------------------------------------------------------
+# damage-proportional encoding (ROADMAP 4): dirty-band partial P encode.
+# The per-frame device work scales with the dirty fraction: a tiny probe
+# moves damage/age/paint decisions to the host, P frames dispatch a
+# bucketed band step over just the rows that changed, clean rows of
+# delivered stripes ship as host-precomputed all-skip slices
+# (codecs.h264.p_skip_slice_rbsp), and idle frames skip the device
+# entirely.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_row_damage_probe(width: int, height: int):
+    """(R,) per-MB-row dirty flags — the one pre-dispatch sync the
+    partial path pays. A single memory-bound pass over the frame (the
+    same compare the stock step runs internally); its host-visible
+    result is what lets band geometry, paint-over and the content
+    classifier run before dispatch instead of on device."""
+    R = height // 16
+
+    def probe(frame, prev):
+        return jnp.any((frame != prev).reshape(R, -1), axis=1)
+
+    probe.__name__ = "h264_row_damage_probe"
+    return _perf.wrap_step(f"h264.row_probe[{width}x{height}]",
+                           jax.jit(probe))
+
+
+def build_h264_band_step_fn(width: int, stripe_h: int, n_stripes: int,
+                            band_rows: int, e_cap: int, w_cap: int,
+                            out_cap: int, candidates: tuple = ((0, 0),),
+                            fullcolor: bool = False, roi_qp: int = 0):
+    """Pure band-partial P step: ``dynamic_slice`` a ``band_rows``-row
+    band (start row is TRACED — one compiled program per bucket serves
+    every band position) out of the frame and reference planes, run the
+    stock plane-layout P encode over just those rows, and scatter the
+    send-gated reconstruction back. Every per-row input (slice-header
+    events, frame_num, qp) is sliced from the same full-frame arrays
+    the stock step consumes, so a full-frame band is byte-identical to
+    the stock P step by construction (tests/test_h264_bands.py).
+
+    Motion candidates require ``band_rows`` to cover whole stripes: the
+    encoder's search-window clamp must equal the decoder's picture-edge
+    clamp, and the picture of a stripe stream is the stripe
+    (ops/bands.py module docstring).
+
+    signature: step(frame, prev, sent, fnum, ref_y, ref_u, ref_v,
+                    qp_rows_band, send, row0, hdr_pay, hdr_nb)
+    -> (data u8 (out_cap,), row_lens i32 (band_rows,), fnum_used (S,),
+        sent (S,), fnum (S,), ref_y, ref_u, ref_v, prev_out, overflow)
+    """
+    rows_per_stripe = stripe_h // 16
+    cdiv = 1 if fullcolor else 2
+    use_motion = len(candidates) > 1
+    if use_motion and band_rows % rows_per_stripe:
+        raise ValueError("motion bands must cover whole stripes "
+                         f"({band_rows} rows vs {rows_per_stripe}/stripe)")
+
+    def step(frame, prev, sent, fnum, ref_y, ref_u, ref_v,
+             qp_rows, send, row0, hdr_pay, hdr_nb):
+        y0 = row0 * 16
+        c0 = y0 // cdiv
+        bh = band_rows * 16
+        ch = bh // cdiv
+        band = jax.lax.dynamic_slice(frame, (y0, 0, 0),
+                                     (bh, width, 3))
+        if fullcolor:
+            from ..ops.h264_planes444 import (h264_encode_p_yuv444,
+                                              rgb_to_yuv444)
+            yf, uf, vf = rgb_to_yuv444(band)
+            enc_p = h264_encode_p_yuv444
+        else:
+            yf, uf, vf = rgb_to_yuv420(band)
+            enc_p = h264_encode_p_yuv
+        rb_y = jax.lax.dynamic_slice(ref_y, (y0, 0), (bh, width))
+        rb_u = jax.lax.dynamic_slice(ref_u, (c0, 0), (ch, width // cdiv))
+        rb_v = jax.lax.dynamic_slice(ref_v, (c0, 0), (ch, width // cdiv))
+        fn_band = jax.lax.dynamic_slice_in_dim(
+            jnp.repeat(fnum, rows_per_stripe), row0, band_rows)
+        hp = jax.lax.dynamic_slice_in_dim(hdr_pay, row0, band_rows)
+        hn = jax.lax.dynamic_slice_in_dim(hdr_nb, row0, band_rows)
+        kw = {}
+        if roi_qp and not fullcolor:
+            # ROI QP (ROADMAP 4/6 seam): freshly-damaged macroblocks
+            # sharpen by ``roi_qp`` below the row base; settled ones keep
+            # it (they mostly skip). Derived from the same frame/prev
+            # planes — no extra state crosses frames.
+            prev_band = jax.lax.dynamic_slice(prev, (y0, 0, 0),
+                                              (bh, width, 3))
+            mb_dirty = jnp.any(
+                (band != prev_band).reshape(
+                    band_rows, 16, width // 16, 48), axis=(1, 3))
+            kw["qp_mb"] = jnp.clip(
+                jnp.where(mb_dirty, qp_rows[:, None] - roi_qp,
+                          qp_rows[:, None]), 8, 48)
+        out, recon = enc_p(
+            yf, uf, vf, rb_y, rb_u, rb_v, qp_rows, hp, hn, fn_band,
+            e_cap, w_cap, candidates=candidates,
+            stripe_rows=rows_per_stripe if use_motion else None, **kw)
+
+        # reference advance, gated per DELIVERED stripe like the stock
+        # step, scattered back over just the band rows
+        sb = jax.lax.dynamic_slice_in_dim(
+            jnp.repeat(send, rows_per_stripe), row0, band_rows)
+
+        def scatter(ref, new, top, px_rows):
+            old = jax.lax.dynamic_slice(ref, (top, 0), new.shape)
+            gate = jnp.repeat(sb, px_rows)[:, None]
+            return jax.lax.dynamic_update_slice(
+                ref, jnp.where(gate, new, old), (top, 0))
+
+        new_ry = scatter(ref_y, recon[0], y0, 16)
+        new_ru = scatter(ref_u, recon[1], c0, 16 // cdiv)
+        new_rv = scatter(ref_v, recon[2], c0, 16 // cdiv)
+        fnum_used = jnp.bitwise_or(fnum, jnp.int32(0))   # pre-increment
+        sent = sent + send.astype(jnp.int32)
+        fnum = jnp.where(send, fnum + 1, fnum)
+
+        sbytes, row_lens = words_to_bytes_device(out.words, out.total_bits,
+                                                 pad_ones=False)
+        buf = concat_stripe_bytes(sbytes, row_lens, out_cap)
+        overflow = out.overflow | buf.overflow
+        prev_out = jnp.bitwise_or(frame, jnp.uint8(0))
+        return (buf.data, buf.byte_lens, fnum_used, sent, fnum,
+                new_ry, new_ru, new_rv, prev_out, overflow)
+
+    step.__name__ = f"h264_band{band_rows}_p_step"
+    return step
+
+
+# bounded LRU like _jitted_h264_step; one entry per band bucket
+@functools.lru_cache(maxsize=64)
+def _jitted_h264_band_step(width: int, stripe_h: int, n_stripes: int,
+                           band_rows: int, e_cap: int, w_cap: int,
+                           out_cap: int, candidates: tuple = ((0, 0),),
+                           fullcolor: bool = False, roi_qp: int = 0):
+    step = build_h264_band_step_fn(width, stripe_h, n_stripes, band_rows,
+                                   e_cap, w_cap, out_cap, candidates,
+                                   fullcolor=fullcolor, roi_qp=roi_qp)
+    from .encoder import donate_argnums_for_backend
+    return _perf.wrap_step(
+        f"h264.band{band_rows}.p_step[{width}x{stripe_h * n_stripes}"
+        f"{'@444' if fullcolor else ''}"
+        f"{f'+roi{roi_qp}' if roi_qp else ''}]",
+        jax.jit(step, donate_argnums=donate_argnums_for_backend(
+            (1, 2, 3, 4, 5, 6))))
+
+
 class H264EncoderSession:
     """Per-display H.264 encoder session (same lifecycle contract as
     JpegEncoderSession)."""
@@ -280,6 +430,36 @@ class H264EncoderSession:
         self.qp = int(np.clip(settings.video_crf, 8, 48))
         self.paint_qp = int(np.clip(
             settings.video_min_qp, 8, self.qp))
+        # damage-proportional encoding (ROADMAP 4): P frames dispatch
+        # over the dirty band only; damage/age/paint state moves to the
+        # host (fed by the row probe), so the device age array is only
+        # re-seeded before stock I dispatches. Requires damage gating —
+        # without the tracker there is no damage signal to scale by.
+        # Sharded sessions (split-frame parallelism) keep the stock
+        # device-parallel steps: a single-device band step would forfeit
+        # the N-way scaling under full-motion content, and the probe
+        # would dispatch against sharded state the prewarmed program was
+        # not built for — on-device damage gating already skips clean
+        # stripes there (bands x stripes composition is future work).
+        self._partial = bool(getattr(settings, "h264_partial_encode",
+                                     False)) and settings.use_damage_gating \
+            and int(getattr(self, "stripe_devices", 1)) <= 1
+        self._host_age = np.zeros((g.n_stripes,), np.int64)
+        vr = max(0, int(getattr(settings, "h264_motion_vrange", 0)))
+        hr = max(0, int(getattr(settings, "h264_motion_hrange", 0)))
+        self._band_candidates = scroll_candidates(vr, hr) if vr \
+            else ((0, 0),)
+        #: band quantum: whole stripes under motion search (window ==
+        #: picture — ops/bands.py), MB rows for zero-MV replenishment
+        self._band_granularity = g.rows_per_stripe \
+            if len(self._band_candidates) > 1 else 1
+        #: content-profile floor on the band bucket (set_content_profile)
+        self._band_floor = 1
+        self._roi_qp_bias = int(getattr(settings, "h264_roi_qp_bias", 4)) \
+            if getattr(settings, "h264_roi_qp", False) else 0
+        #: last-frame observability (obs/qoe pulls these per session)
+        self.dirty_fraction = 1.0
+        self.last_band_rows = self.n_rows
 
     def _build_step(self, mode: str):
         g, s = self.grid, self.settings
@@ -337,41 +517,150 @@ class H264EncoderSession:
         intra = bool(force)
         if self._watermark is not None:
             frame = self._watermark.apply(frame)
-        step = self._i_step if intra else self._p_step
-        hdr_pay = self._hdr_pay if intra else self._p_hdr_pay
-        hdr_nb = self._hdr_nb if intra else self._p_hdr_nb
+        if self._partial:
+            # damage-proportional path: probe -> host gating -> band
+            # dispatch (or no dispatch at all on an idle frame)
+            with _tracer.span("encode.dispatch"):
+                return self._dispatch_partial(frame, intra, cap_gen)
         # the dispatch span covers the step call AND the async-copy kicks:
         # on TPU both are enqueue-cost only and the device compute lands
         # in finalize's encode.readback stall, while backends whose copy
         # kick synchronizes (CPU) show the compute here — either way the
         # host-visible wait is attributed, never lost between spans
         with _tracer.span("encode.dispatch"):
-            (data, row_lens, send, is_paint, age, sent, fnum,
-             ry, ru, rv, prev_out, overflow) = step(
-                frame, self._prev, self._age, self._sent, self._fnum,
-                self._ref_y, self._ref_u, self._ref_v,
-                jnp.int32(self.qp), jnp.int32(self.paint_qp),
-                jnp.asarray(bool(force)), hdr_pay, hdr_nb)
-            # prev (and the rest of the state) was DONATED: the session's
-            # reference is the step's output, never the caller's array
-            self._prev = prev_out
-            self._age = age
-            self._sent = sent
-            self._fnum = fnum
-            self._ref_y, self._ref_u, self._ref_v = ry, ru, rv
-            fid = self.frame_id
-            self.frame_id = (self.frame_id + 1) & 0xFFFF
-            # async-copy only the SMALL control arrays; the stream buffer
-            # is fetched minimally at finalize (engine/readback.py) once
-            # the row lengths are known
-            for arr in (row_lens, send, is_paint, overflow):
-                try:
-                    arr.copy_to_host_async()
-                except Exception:
-                    pass
+            return self._dispatch_stock(frame, intra, cap_gen)
+
+    def _dispatch_stock(self, frame, intra: bool, cap_gen: int
+                        ) -> dict[str, Any]:
+        """The full-frame device step (always for I frames; for P frames
+        only when the partial path is off)."""
+        step = self._i_step if intra else self._p_step
+        hdr_pay = self._hdr_pay if intra else self._p_hdr_pay
+        hdr_nb = self._hdr_nb if intra else self._p_hdr_nb
+        (data, row_lens, send, is_paint, age, sent, fnum,
+         ry, ru, rv, prev_out, overflow) = step(
+            frame, self._prev, self._age, self._sent, self._fnum,
+            self._ref_y, self._ref_u, self._ref_v,
+            jnp.int32(self.qp), jnp.int32(self.paint_qp),
+            jnp.asarray(bool(intra)), hdr_pay, hdr_nb)
+        # prev (and the rest of the state) was DONATED: the session's
+        # reference is the step's output, never the caller's array
+        self._prev = prev_out
+        self._age = age
+        self._sent = sent
+        self._fnum = fnum
+        self._ref_y, self._ref_u, self._ref_v = ry, ru, rv
+        fid = self.frame_id
+        self.frame_id = (self.frame_id + 1) & 0xFFFF
+        # async-copy only the SMALL control arrays; the stream buffer
+        # is fetched minimally at finalize (engine/readback.py) once
+        # the row lengths are known
+        for arr in (row_lens, send, is_paint, overflow):
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass
         return {"data": data, "lens": row_lens, "send": send,
                 "is_paint": is_paint, "overflow": overflow, "frame_id": fid,
                 "intra": intra, "cap_gen": cap_gen}
+
+    def _dispatch_partial(self, frame, intra: bool, cap_gen: int
+                          ) -> dict[str, Any]:
+        """Damage-proportional dispatch (ROADMAP 4): the row probe's
+        host-visible damage decides everything the stock step decided on
+        device. Idle frames never touch the device; P frames run the
+        band step over the smallest bucketed band covering the damage
+        (paint-over stripes join the band at ``paint_qp``); I frames
+        fall through to the stock I step with the device age re-seeded
+        from the host mirror."""
+        g, s = self.grid, self.settings
+        rps = g.rows_per_stripe
+        probe = _jitted_row_damage_probe(g.width, g.height)
+        # the one host sync of the partial path — (R,) bools. It also
+        # closes the dispatch-overlap window a full-frame pipeline would
+        # have had; PERF.md lever 5 documents why the trade wins for
+        # desktop content (most frames become cheap or free).
+        dirty_rows = np.asarray(probe(frame, self._prev))
+        stripe_dirty = dirty_rows.reshape(g.n_stripes, rps).any(axis=1)
+        self.dirty_fraction = _dirty_fraction(dirty_rows)
+        age_pre = self._host_age
+        self._host_age = np.where(stripe_dirty, 0, age_pre + 1)
+        if intra:
+            # stock I step applies the same where(damage, 0, age+1)
+            # update to the age it is handed, so seeding the PRE-update
+            # host age keeps both mirrors identical
+            self._age = jnp.asarray(
+                np.minimum(age_pre, 2**31 - 1).astype(np.int32))
+            return self._dispatch_stock(frame, True, cap_gen)
+        paint = np.zeros_like(stripe_dirty)
+        if s.use_paint_over and s.paint_over_delay_frames > 0:
+            paint = self._host_age == s.paint_over_delay_frames
+        send = stripe_dirty | paint
+        fid = self.frame_id
+        self.frame_id = (self.frame_id + 1) & 0xFFFF
+        if not send.any():
+            # idle frame: zero device work, zero readback. prev is
+            # content-equal to this frame (no row changed), so the
+            # damage reference stays valid without a copy.
+            self.last_band_rows = 0
+            return {"idle": True, "frame_id": fid, "intra": False,
+                    "cap_gen": cap_gen, "send": send,
+                    "overflow": np.asarray(False)}
+        rows_needed = dirty_rows.copy()
+        for i in np.nonzero(paint)[0]:
+            # paint-over redelivers the WHOLE settled stripe at paint_qp
+            rows_needed[i * rps:(i + 1) * rps] = True
+        row0, band_rows = plan_band(
+            rows_needed, granularity=self._band_granularity,
+            floor_rows=self._band_floor)
+        self.last_band_rows = band_rows
+        qp_rows = np.full((self.n_rows,), self.qp, np.int32)
+        for i in np.nonzero(paint)[0]:
+            qp_rows[i * rps:(i + 1) * rps] = self.paint_qp
+        step = self._band_step(band_rows)
+        (data, row_lens, fnum_used, sent, fnum, ry, ru, rv, prev_out,
+         overflow) = step(
+            frame, self._prev, self._sent, self._fnum,
+            self._ref_y, self._ref_u, self._ref_v,
+            jnp.asarray(qp_rows[row0:row0 + band_rows]),
+            jnp.asarray(send), jnp.int32(row0),
+            self._p_hdr_pay, self._p_hdr_nb)
+        self._prev = prev_out
+        self._sent = sent
+        self._fnum = fnum
+        self._ref_y, self._ref_u, self._ref_v = ry, ru, rv
+        for arr in (row_lens, fnum_used, overflow):
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass
+        return {"data": data, "lens": row_lens, "send": send,
+                "is_paint": paint, "overflow": overflow, "frame_id": fid,
+                "intra": False, "cap_gen": cap_gen,
+                "band": (int(row0), int(band_rows)),
+                "fnum_used": fnum_used, "qp": int(self.qp),
+                "dirty_fraction": self.dirty_fraction}
+
+    def _band_step(self, band_rows: int):
+        g = self.grid
+        return _jitted_h264_band_step(
+            g.width, g.stripe_h, g.n_stripes, band_rows, self._e_cap,
+            self._w_cap, self._out_cap, self._band_candidates,
+            fullcolor=self.fullcolor, roi_qp=self._roi_qp_bias)
+
+    def set_content_profile(self, profile) -> None:
+        """Apply a content profile (engine/content.py) to the band
+        planner. A ``partial_encode=False`` profile (video/gaming)
+        floors the band at the full frame instead of switching back to
+        the stock step: the path stays uniform, the damage probe keeps
+        the dirty-fraction signal live (so the classifier can switch
+        back), and a full-frame band is byte-identical to the stock
+        step anyway. qp bias via the usual set_qp path is the caller's
+        job (the capture loop owns rate control)."""
+        floor = max(1, int(getattr(profile, "band_floor_rows", 1)))
+        if not getattr(profile, "partial_encode", True):
+            floor = self.n_rows
+        self._band_floor = floor
 
     # -- host tail ----------------------------------------------------------
     def finalize(self, out: dict[str, Any], force_all: bool = False
@@ -390,17 +679,24 @@ class H264EncoderSession:
         # readback epoch: a pipelined slot's in-flight time IS readback
         rb_t0 = out.get("submitted_ns") or time.perf_counter_ns()
         overflowed, idle, lens, send, intra = self._sync_control(out)
-        data = None
+        band = out.get("band")
+        data = starts = None
         if not overflowed and not idle:
             starts = self._row_starts(out, lens)
             rps = g.rows_per_stripe
             # minimal readback (engine/readback.py): fetch through
             # the last DELIVERED stripe's rows — capacity padding
-            # and trailing unsent stripes never cross the host link
+            # and trailing unsent stripes never cross the host link.
+            # Band frames fetch through the last band row belonging to
+            # a sent stripe: clean rows never existed on device at all.
             from .readback import fetch_stream_bytes
-            last_row = (int(np.nonzero(send)[0][-1]) + 1) * rps - 1
-            data = fetch_stream_bytes(
-                out["data"], int(starts[last_row] + lens[last_row]))
+            if band is None:
+                last_row = (int(np.nonzero(send)[0][-1]) + 1) * rps - 1
+            else:
+                last_row = self._band_last_row(send, band)
+            if last_row is not None:
+                data = fetch_stream_bytes(
+                    out["data"], int(starts[last_row] + lens[last_row]))
         _tracer.record_span(tl, "encode.readback", rb_t0, lane=lane)
         if overflowed:
             self._handle_overflow(out)
@@ -412,10 +708,45 @@ class H264EncoderSession:
             for i in range(g.n_stripes):
                 if not send[i]:
                     continue
-                rows = [bytes(data[starts[r]:starts[r] + lens[r]])
-                        for r in range(i * rps, (i + 1) * rps)]
+                rows = self._stripe_row_bytes(out, i, data, starts,
+                                              lens, band)
                 chunks.append(self._chunk(out, i, rows, intra))
         return chunks
+
+    def _band_last_row(self, send, band) -> Optional[int]:
+        """Band-frame fetch bound shared by finalize/finalize_stream:
+        the last BAND-LOCAL row belonging to a delivered stripe (None
+        when no band row is — clean rows never existed on device)."""
+        row0, brows = band
+        rps = self.grid.rows_per_stripe
+        in_band = np.nonzero(np.repeat(send, rps)[row0:row0 + brows])[0]
+        return int(in_band[-1]) if in_band.size else None
+
+    def _stripe_row_bytes(self, out: dict[str, Any], i: int, data,
+                          starts, lens, band) -> list:
+        """Stripe ``i``'s per-row slice RBSPs. Stock frames slice the
+        device buffer; band frames stitch device-encoded band rows
+        against host-built all-skip slices at the (byte-aligned) slice
+        seams — the partial-encode assembly."""
+        g = self.grid
+        rps = g.rows_per_stripe
+        if band is None:
+            return [bytes(data[starts[r]:starts[r] + lens[r]])
+                    for r in range(i * rps, (i + 1) * rps)]
+        row0, brows = band
+        fnum_used = np.asarray(out["fnum_used"])
+        qp = int(out["qp"])
+        rows = []
+        for r in range(i * rps, (i + 1) * rps):
+            if row0 <= r < row0 + brows:
+                b = r - row0
+                rows.append(bytes(data[starts[b]:starts[b] + lens[b]]))
+            else:
+                # clean row of a delivered stripe: all-skip slice, same
+                # frame_num/qp the device wrote into the band rows
+                rows.append(hcodec.p_skip_slice_rbsp(
+                    (r % rps) * g.mb_w, g.mb_w, qp, int(fnum_used[i])))
+        return rows
 
     def finalize_stream(self, out: dict[str, Any], force_all: bool = False):
         """Stripe-granular finalize (deep pipeline, ROADMAP 2): yields
@@ -436,9 +767,30 @@ class H264EncoderSession:
             return
         if idle:
             return
-        from .readback import fetch_stripe_bytes
         starts = self._row_starts(out, lens)
         rps = g.rows_per_stripe
+        band = out.get("band")
+        if band is not None:
+            # band frames: the whole band is one small prefix fetch
+            # (clean rows never existed on device), then per-stripe
+            # stitching — stripe streaming degrades to a single fetch
+            lb = self._band_last_row(send, band)
+            data = None
+            if lb is not None:
+                from .readback import fetch_stream_bytes
+                with _tracer.span("encode.readback", tl, lane=lane):
+                    data = fetch_stream_bytes(
+                        out["data"], int(starts[lb] + lens[lb]))
+            for i in range(g.n_stripes):
+                if not send[i]:
+                    continue
+                with _tracer.span("packetize", tl, lane=lane):
+                    rows = self._stripe_row_bytes(out, i, data, starts,
+                                                  lens, band)
+                    chunk = self._chunk(out, i, rows, intra)
+                yield chunk
+            return
+        from .readback import fetch_stripe_bytes
         for i in range(g.n_stripes):
             if not send[i]:
                 continue
@@ -467,6 +819,9 @@ class H264EncoderSession:
         """Control-array sync shared by finalize and finalize_stream —
         the one device-sync point. -> (overflowed, idle, lens, send,
         intra)."""
+        if out.get("idle"):
+            # partial-path idle frame: nothing was dispatched at all
+            return False, True, None, None, False
         if bool(np.asarray(out["overflow"])):
             return True, True, None, None, True
         lens = np.asarray(out["lens"])    # (R,) per MB row
@@ -629,6 +984,8 @@ class StripeShardedH264Session(H264EncoderSession):
     def _row_starts(self, out, lens: np.ndarray) -> np.ndarray:
         n = self.stripe_devices
         if n <= 1:
+            # (band outs can't reach here: __init__ gates the partial
+            # path off for sharded sessions)
             return super()._row_starts(out, lens)
         # data is the stacked per-shard buffers; derive the local cap
         # from the ARRAY (pipelined frames may predate a growth episode)

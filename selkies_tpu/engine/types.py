@@ -63,6 +63,22 @@ class CaptureSettings:
     # the TPU equivalent tuned for desktop content.
     h264_motion_vrange: int = 24
     h264_motion_hrange: int = 8
+    # damage-proportional encoding (ROADMAP 4): P frames dispatch the
+    # device step only over the MB-row band intersecting the damage
+    # map; clean rows of delivered stripes ship as host-precomputed
+    # all-skip slices and idle frames skip the device entirely.
+    # Requires use_damage_gating; a 100%-dirty frame is byte-identical
+    # to the stock P step (tests/test_h264_bands.py).
+    h264_partial_encode: bool = True
+    # content classifier (engine/content.py): damage-signal EWMAs map
+    # each session to static/scroll/video/gaming and apply the class
+    # profile (qp bias, band bucket floor, IDR cadence)
+    h264_content_adaptive: bool = True
+    # ROI QP: per-macroblock QP plane derived from the damage map
+    # (freshly-damaged MBs sharpen by h264_roi_qp_bias below the row
+    # base, coded as real mb_qp_delta syntax). 4:2:0 P frames only.
+    h264_roi_qp: bool = False
+    h264_roi_qp_bias: int = 4
     # h264-tpu (non-striped): one stream spanning the whole display;
     # the grid planner derives stripe_height from the CURRENT height so
     # live resizes keep the one-stream contract

@@ -75,6 +75,11 @@ FAILED_SCORE = 15.0
 #: rtt_term halves every this many ms of round-trip
 _RTT_HALF_MS = 250.0
 
+#: gauge encoding of engine/content.CONTENT_CLASSES (kept literal here:
+#: the obs package is stdlib-only by contract and the engine package
+#: imports jax; drift is pinned by tests/test_content.py)
+_CONTENT_CLASSES = ("static", "scroll", "video", "gaming")
+
 #: per-session Prometheus series cap (``qoe_seat_label_cap`` setting);
 #: sessions beyond it roll up into the ``seat="_overflow"`` aggregate
 DEFAULT_SEAT_LABEL_CAP = 8
@@ -208,6 +213,10 @@ class SessionStats:
         self.relay_provider: Optional[Callable[[], dict]] = None
         #: -> SendSideCongestionController.stats() for WebRTC peers
         self.cc_provider: Optional[Callable[[], dict]] = None
+        #: -> the display capture's content/damage block (ROADMAP 4,
+        #: engine/capture.content_state: content class, EWMAs, dirty
+        #: fraction) — pulled at snapshot/export time like relay stats
+        self.content_provider: Optional[Callable[[], dict]] = None
         #: -> target fps for the score's fps_term
         self.target_fps: Optional[Callable[[], float]] = None
         # backpressure-window accounting
@@ -432,6 +441,14 @@ class SessionStats:
             "drop_rate": round(self.drop_rate(relay=relay, cc=cc), 4),
             "qoe_score": self.score(now),
         }
+        content = self._pull(self.content_provider)
+        if content:
+            # content-adaptive encoding (ROADMAP 4): class + dirty
+            # fraction ride the summary; the EWMA detail is verbose-only
+            doc["content_class"] = content.get("class")
+            doc["dirty_fraction"] = content.get("dirty_fraction")
+            if verbose:
+                doc["content"] = content
         g2g = self.g2g_percentiles()
         doc["g2g_p99_ms"] = g2g["p99_ms"]
         if verbose:
@@ -631,6 +648,12 @@ class QoERegistry:
                          "Live streaming sessions by transport kind")
         metrics.describe("selkies_qoe_worst_score",
                          "Worst live session QoE score")
+        metrics.describe("selkies_session_dirty_fraction",
+                         "Per-session fraction of MB rows damaged in "
+                         "the latest encoded frame (ROADMAP 4)")
+        metrics.describe("selkies_session_content_class",
+                         "Per-session content class (0=static 1=scroll "
+                         "2=video 3=gaming — engine/content.py)")
         metrics.register_collector(self._export_metrics)
 
     def _export_metrics(self) -> None:
@@ -654,7 +677,9 @@ class QoERegistry:
                       "selkies_session_backpressure_seconds_total",
                       "selkies_session_clock_offset_ms",
                       "selkies_session_clock_drift_ppm",
-                      "selkies_session_clock_rtt_min_ms")
+                      "selkies_session_clock_rtt_min_ms",
+                      "selkies_session_dirty_fraction",
+                      "selkies_session_content_class")
         for name in per_metric:
             metrics.clear_metric(name)
         by_kind: dict[str, int] = {}
@@ -699,6 +724,17 @@ class QoERegistry:
                 if q["rtt_min_ms"] is not None:
                     metrics.set_gauge("selkies_session_clock_rtt_min_ms",
                                       q["rtt_min_ms"], labels)
+                # content-adaptive encoding (ROADMAP 4) — same
+                # cardinality cap as every selkies_session_* series
+                content = st._pull(st.content_provider)
+                df = content.get("dirty_fraction")
+                if isinstance(df, (int, float)):
+                    metrics.set_gauge("selkies_session_dirty_fraction",
+                                      round(float(df), 4), labels)
+                cls = content.get("class")
+                if cls in _CONTENT_CLASSES:
+                    metrics.set_gauge("selkies_session_content_class",
+                                      _CONTENT_CLASSES.index(cls), labels)
             else:
                 overflow["count"] += 1
                 overflow["sent_bytes"] += float(

@@ -1,0 +1,105 @@
+"""Dirty-band geometry: make per-frame encode cost scale with damage.
+
+The damage tracker has always known that a typing frame touches three MB
+rows; the P-frame device step still paid full-raster work. Because every
+MB row is an independent slice (h264_planes codes no cross-row CAVLC or
+MV context — cross-MB-row neighbours are cross-slice, hence unavailable),
+a frame's bitstream decomposes into per-row segments that can be built by
+DIFFERENT producers and stitched at byte-aligned slice seams:
+
+- rows intersecting the damage map are encoded by a *band step* that
+  ``dynamic_slice``s the band out of the frame/reference planes and runs
+  the stock plane-layout P encode over just those rows;
+- clean rows of delivered stripes become all-skip P slices whose bytes
+  are precomputed ON HOST (a handful of ue() codes — see
+  codecs.h264.p_skip_slice_rbsp), keyed by (row, frame_num, qp);
+- stripes with no damage at all are simply not sent (the stock
+  damage-gating contract).
+
+Band geometry is **bucketed** to power-of-two row counts (like the
+readback buckets, engine/readback.py) so the jit/prewarm lattice stays
+finite: one compiled band program per bucket serves every band position
+(the start row is a traced scalar). With motion search enabled, bands are
+bucketed in whole *stripes* instead of MB rows: motion windows must equal
+the decoder's picture (the stripe), so a band must cover whole stripe
+streams for the encoder's window clamp to stay bit-exact with the
+decoder's picture-edge clamp. Zero-MV replenishment has no windows, so
+motion-off profiles get MB-row-granular bands (the typing/cursor case
+this lever exists for).
+
+Stdlib + numpy only — the planning runs on the host per frame.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["band_buckets", "plan_band", "dirty_fraction"]
+
+
+def band_buckets(n_rows: int, granularity: int = 1) -> tuple:
+    """Reachable band sizes for a frame of ``n_rows`` MB rows: power-of-
+    two multiples of ``granularity`` (1 for zero-MV bands, rows-per-
+    stripe for motion bands), plus the full frame. Ascending, deduped.
+
+    >>> band_buckets(9)
+    (1, 2, 4, 8, 9)
+    >>> band_buckets(8, granularity=2)
+    (2, 4, 8)
+    """
+    if n_rows <= 0:
+        raise ValueError("n_rows must be positive")
+    g = max(1, int(granularity))
+    out = []
+    b = g
+    while b < n_rows:
+        out.append(b)
+        b *= 2
+    out.append(n_rows)
+    return tuple(out)
+
+
+def plan_band(rows_needed: np.ndarray, *, granularity: int = 1,
+              floor_rows: int = 1) -> Optional[tuple]:
+    """Smallest bucketed band covering every needed MB row.
+
+    ``rows_needed``: (R,) bool — rows that must be device-encoded this
+    frame (dirty rows plus every row of a paint-over stripe).
+    ``granularity``: band alignment/quantum in MB rows (rows-per-stripe
+    when motion search is on — see module docstring).
+    ``floor_rows``: content-profile floor on the bucket (a flapping
+    1-row band under a blinking cursor would churn jit programs; the
+    static profile floors it instead).
+
+    -> ``(row0, band_rows)`` with ``row0 % granularity == 0`` and
+    ``band_rows`` from :func:`band_buckets`, or None when no row needs
+    encoding (the idle frame: the caller skips the device step
+    entirely).
+    """
+    rows_needed = np.asarray(rows_needed, bool)
+    R = int(rows_needed.shape[0])
+    nz = np.nonzero(rows_needed)[0]
+    if nz.size == 0:
+        return None
+    g = max(1, int(granularity))
+    lo = (int(nz[0]) // g) * g
+    hi = -(-(int(nz[-1]) + 1) // g) * g          # exclusive, g-aligned
+    span = hi - lo
+    want = max(span, min(max(1, int(floor_rows)), R))
+    for b in band_buckets(R, g):
+        if b >= want:
+            band_rows = b
+            break
+    # place the bucket over the span, clipped so it stays in-frame and
+    # g-aligned (band_rows is a multiple of g or the full frame)
+    row0 = min(lo, R - band_rows)
+    row0 = max(0, (row0 // g) * g)
+    return row0, band_rows
+
+
+def dirty_fraction(dirty_rows: np.ndarray) -> float:
+    """Fraction of MB rows dirty this frame (the ledger/obs column)."""
+    d = np.asarray(dirty_rows, bool)
+    return float(d.sum()) / float(max(1, d.shape[0]))

@@ -877,22 +877,36 @@ def h264_encode_p_yuv(yf, uf, vf, ref_y, ref_u, ref_v, qp,
                       e_cap: int, w_cap: int,
                       candidates: tuple = ((0, 0),),
                       stripe_rows: int | None = None,
-                      precomputed_motion=None):
+                      precomputed_motion=None, qp_mb=None):
     """Plane-layout twin of ops/h264_encode.h264_encode_p_yuv — same
     signature, bit-identical output (P_Skip / P_L0_16x16 with motion,
     one slice per MB row). ``precomputed_motion`` =
     (pred_y, pred_u, pred_v, mv) skips the in-function motion search —
     the split-frame sharded path selects motion against HALO rows first
-    (parallel/stripes) and feeds the residual coder here."""
+    (parallel/stripes) and feeds the residual coder here.
+
+    ``qp_mb`` (ROI QP): an optional (R, M) int32 per-macroblock QP
+    plane. The slice header still carries the per-row base ``qp``;
+    per-MB targets are reached through real ``mb_qp_delta`` syntax (se
+    against the previous residual-carrying MB's QP — §7.4.5's carry
+    chain, which per-row slices reset), and quant/dequant/recon all run
+    at the per-MB value. None leaves every stock code path untouched
+    (the always-ue(0) delta)."""
     H, W = yf.shape[0], yf.shape[1]
     R, M = H // 16, W // 16
     nby, nbx = H // 4, W // 4
     qp = jnp.broadcast_to(jnp.asarray(qp, jnp.int32), (R,))
     qpc = _QPC_J[jnp.clip(qp, 0, 51)]
     fn = jnp.broadcast_to(jnp.asarray(frame_num, jnp.int32), (R,))
-    qp_by = jnp.repeat(qp, 4)[:, None]
-    qpc_by = jnp.repeat(qpc, 2)[:, None]
-    qpc_rm = qpc[:, None]                            # (R, 1) for (R, M)
+    if qp_mb is None:
+        qp_by = jnp.repeat(qp, 4)[:, None]
+        qpc_by = jnp.repeat(qpc, 2)[:, None]
+        qpc_rm = qpc[:, None]                        # (R, 1) for (R, M)
+    else:
+        qp_mb = jnp.asarray(qp_mb, jnp.int32)        # (R, M)
+        qp_by = _expand(qp_mb, 4, 4)                 # (nby, nbx)
+        qpc_rm = _QPC_J[jnp.clip(qp_mb, 0, 51)]      # (R, M)
+        qpc_by = _expand(qpc_rm, 2, 2)               # (H/8, W/8)
 
     cur_y = yf.astype(jnp.int32)
     cur_u = uf.astype(jnp.int32)
@@ -1035,7 +1049,7 @@ def h264_encode_p_yuv(yf, uf, vf, ref_y, ref_u, ref_v, qp,
     out = _assemble_p_frame(
         R, M, w_cap, e_cap, qp, fn, header_pay, header_nb,
         cbp, coded, mvd, ypay, ynb, upay_dc, unb_dc, vpay_dc, vnb_dc,
-        upay, unb, vpay, vnb)
+        upay, unb, vpay, vnb, qp_mb=qp_mb)
     return out, (recon_y.astype(jnp.uint8), recon_u.astype(jnp.uint8),
                  recon_v.astype(jnp.uint8))
 
@@ -1043,7 +1057,7 @@ def h264_encode_p_yuv(yf, uf, vf, ref_y, ref_u, ref_v, qp,
 def _assemble_p_frame(R, M, w_cap, e_cap, qp, fn, header_pay, header_nb,
                       cbp, coded, mvd, ypay, ynb,
                       upay_dc, unb_dc, vpay_dc, vnb_dc,
-                      upay, unb, vpay, vnb):
+                      upay, unb, vpay, vnb, qp_mb=None):
     """P slot order: row prefix [hdr(2), frame_num u(4), '000' flags,
     qp, deblock] | per MB [skip_run, mb_type, mvd_x, mvd_y, cbp,
     mb_qp_delta] + residual blocks | trailing skip run | stop bit."""
@@ -1071,8 +1085,27 @@ def _assemble_p_frame(R, M, w_cap, e_cap, qp, fn, header_pay, header_nb,
     mvdy_nb = jnp.where(coded, mvdy_nb, 0)
     cbp_pay, cbp_nb = _ue_event(_CBP2CODE_J[cbp])
     cbp_nb = jnp.where(coded, cbp_nb, 0)
-    dqp_pay = jnp.ones((R, M), jnp.uint32)
-    dqp_nb = jnp.where(coded & (cbp != 0), 1, 0)     # §7.3.5 gate
+    dqp_gate = coded & (cbp != 0)                    # §7.3.5 gate
+    if qp_mb is None:
+        dqp_pay = jnp.ones((R, M), jnp.uint32)
+        dqp_nb = jnp.where(dqp_gate, 1, 0)
+    else:
+        # ROI QP: the decoder's QP carry chain is slice QP updated at
+        # every residual-carrying MB, so the delta reaching MB m's
+        # target is against the PREVIOUS delta-carrying MB's target
+        # (or the row base for the first one). Previous carrier index
+        # via the same running-max trick as the skip runs.
+        idxq = jax.lax.broadcasted_iota(jnp.int32, (R, M), 1)
+        markedq = jnp.where(dqp_gate, idxq, -1)
+        inclq = jax.lax.associative_scan(jnp.maximum, markedq, axis=1)
+        prevq = jnp.concatenate(
+            [jnp.full((R, 1), -1, jnp.int32), inclq[:, :-1]], axis=1)
+        qp_prev = jnp.where(
+            prevq >= 0,
+            jnp.take_along_axis(qp_mb, jnp.clip(prevq, 0, M - 1), axis=1),
+            qp[:, None])
+        dqp_pay, dqp_nb = _se_event(qp_mb - qp_prev)
+        dqp_nb = jnp.where(dqp_gate, dqp_nb, 0)
     hdr_pays = jnp.stack([sr_pay, mbt_pay, mvdx_pay, mvdy_pay, cbp_pay,
                           dqp_pay])
     hdr_nbs = jnp.stack([sr_nb, mbt_nb, mvdx_nb, mvdy_nb, cbp_nb,

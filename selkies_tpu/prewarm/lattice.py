@@ -61,6 +61,17 @@ class Signature:
     paint_over_delay_frames: int = 15
     h264_motion_vrange: int = 24
     h264_motion_hrange: int = 8
+    #: damage-proportional encoding (ROADMAP 4): the partial path adds
+    #: the band-bucket program family (one per power-of-two row count)
+    #: plus the row probe — a distinct compile surface
+    partial_encode: bool = False
+    #: ROI QP changes the band programs' trace (per-MB qp plane +
+    #: mb_qp_delta events) — compile identity, runtime-off by default.
+    #: The bias value is part of the identity too: it is baked into the
+    #: compiled program (a traced constant), so bias=4 and bias=6 band
+    #: steps are different XLA builds
+    roi_qp: bool = False
+    roi_qp_bias: int = 4
 
     @property
     def program_key(self) -> str:
@@ -70,6 +81,10 @@ class Signature:
                  f"stripe{s.stripe_height}"]
         if s.stripe_devices > 1:
             parts.append(f"stripes{s.stripe_devices}")
+        if s.partial_encode and s.codec == "h264":
+            parts.append("bands")
+            if s.roi_qp:
+                parts.append(f"roi{s.roi_qp_bias}")
         if s.fullcolor:
             parts.append("444")
         if s.single_stream:
@@ -214,6 +229,11 @@ def lattice_from_settings(settings,
         paint_over_delay_frames=int(g("paint_over_delay_frames", 15)),
         h264_motion_vrange=int(g("h264_motion_vrange", 24)),
         h264_motion_hrange=int(g("h264_motion_hrange", 8)),
+        partial_encode=bool(g("h264_partial_encode", True))
+        and bool(g("use_damage_gating", True))
+        and not encoder.startswith("jpeg"),
+        roi_qp=bool(g("h264_roi_qp", False)),
+        roi_qp_bias=int(g("h264_roi_qp_bias", 4)),
     )
     return enumerate_lattice(base, steps)
 

@@ -55,7 +55,10 @@ def capture_settings_for(sig: Signature):
         use_paint_over=sig.use_paint_over,
         paint_over_delay_frames=sig.paint_over_delay_frames,
         h264_motion_vrange=sig.h264_motion_vrange,
-        h264_motion_hrange=sig.h264_motion_hrange)
+        h264_motion_hrange=sig.h264_motion_hrange,
+        h264_partial_encode=bool(getattr(sig, "partial_encode", False)),
+        h264_roi_qp=bool(getattr(sig, "roi_qp", False)),
+        h264_roi_qp_bias=int(getattr(sig, "roi_qp_bias", 4)))
 
 
 def program_names(sig: Signature) -> list:
@@ -82,11 +85,44 @@ def program_names(sig: Signature) -> list:
         from ..parallel.stripes import resolved_stripe_devices
         n = resolved_stripe_devices(g.n_stripes, sig.stripe_devices)
         if n > 1:
+            # sharded sessions keep the stock device-parallel steps —
+            # the partial path gates itself off (engine/h264_encoder),
+            # so no band programs belong to this signature
             return [f"h264.stripes{n}.{m}_step"
                     f"[{g.width}x{g.stripe_h * g.n_stripes}{tag}]"
                     for m in ("i", "p")]
-    return [f"h264.{m}_step[{g.width}x{g.stripe_h * g.n_stripes}{tag}]"
-            for m in ("i", "p")]
+    names = [f"h264.{m}_step[{g.width}x{g.stripe_h * g.n_stripes}{tag}]"
+             for m in ("i", "p")]
+    names += _band_program_names(sig, g, tag)
+    return names
+
+
+def _band_buckets_for(sig: Signature, g) -> list:
+    """The band-bucket row counts this signature's partial path can
+    dispatch (ops/bands.band_buckets at the signature's granularity)."""
+    if sig.codec == "jpeg" or not getattr(sig, "partial_encode", False) \
+            or sig.seats > 1:
+        return []
+    from ..ops.bands import band_buckets
+    n_rows = g.n_stripes * g.rows_per_stripe
+    gran = g.rows_per_stripe if sig.h264_motion_vrange > 0 else 1
+    return list(band_buckets(n_rows, gran))
+
+
+def _band_program_names(sig: Signature, g, tag: str) -> list:
+    buckets = _band_buckets_for(sig, g)
+    if not buckets:
+        return []
+    # roi band steps carry the bias in the program name (it is baked
+    # into the trace): a bias=4 warm must never satisfy a bias=6 gate
+    roi = int(getattr(sig, "roi_qp_bias", 4)) \
+        if getattr(sig, "roi_qp", False) else 0
+    band_tag = f"{tag}+roi{roi}" if roi else tag
+    names = [f"h264.row_probe[{g.width}x{g.stripe_h * g.n_stripes}]"]
+    names += [f"h264.band{b}.p_step"
+              f"[{g.width}x{g.stripe_h * g.n_stripes}{band_tag}]"
+              for b in buckets]
+    return names
 
 
 def _aval(shape, dtype):
@@ -164,6 +200,50 @@ def _warm_h264(sig: Signature) -> list:
         if not step.warm((frame, frame, svec, svec, svec,
                           ref_y, ref_c, ref_c, qp, qp, force, pay, nb)):
             raise RuntimeError(f"h264 {mode} step warm failed "
+                               "(see obs.perf log)")
+        names.append(step.name)
+    names += _warm_h264_bands(sig, g, e_cap, w_cap, out_cap,
+                              p_hdr_pay, p_hdr_nb)
+    return names
+
+
+def _warm_h264_bands(sig: Signature, g, e_cap: int, w_cap: int,
+                     out_cap: int, p_hdr_pay, p_hdr_nb) -> list:
+    """AOT-compile the partial path's band-bucket family + row probe
+    (ROADMAP 4) — the programs a partial-encode session can dispatch at
+    runtime as the damage geometry moves between buckets."""
+    buckets = _band_buckets_for(sig, g)
+    if not buckets:
+        return []
+    import jax.numpy as jnp
+
+    from ..engine import h264_encoder as _h
+    from ..ops.h264_encode import scroll_candidates
+    vr, hr = max(0, sig.h264_motion_vrange), max(0, sig.h264_motion_hrange)
+    cands = scroll_candidates(vr, hr) if vr else ((0, 0),)
+    cdiv = 1 if sig.fullcolor else 2
+    # the SAME bias the runtime session will dispatch with — a traced
+    # constant, so a different bias is a different program
+    roi = int(getattr(sig, "roi_qp_bias", 4)) \
+        if getattr(sig, "roi_qp", False) else 0
+    frame = _aval((g.height, g.width, 3), jnp.uint8)
+    svec = _aval((g.n_stripes,), jnp.int32)
+    sbool = _aval((g.n_stripes,), jnp.bool_)
+    ref_y = _aval((g.height, g.width), jnp.uint8)
+    ref_c = _aval((g.height // cdiv, g.width // cdiv), jnp.uint8)
+    row0 = _aval((), jnp.int32)
+    probe = _h._jitted_row_damage_probe(g.width, g.height)
+    if not probe.warm((frame, frame)):
+        raise RuntimeError("h264 row probe warm failed (see obs.perf log)")
+    names = [probe.name]
+    for b in buckets:
+        qp_rows = _aval((b,), jnp.int32)
+        step = _h._jitted_h264_band_step(
+            g.width, g.stripe_h, g.n_stripes, b, e_cap, w_cap, out_cap,
+            cands, fullcolor=sig.fullcolor, roi_qp=roi)
+        if not step.warm((frame, frame, svec, svec, ref_y, ref_c, ref_c,
+                          qp_rows, sbool, row0, p_hdr_pay, p_hdr_nb)):
+            raise RuntimeError(f"h264 band{b} step warm failed "
                                "(see obs.perf log)")
         names.append(step.name)
     return names

@@ -132,6 +132,12 @@ class DegradationLadder:
         self._last_reasons: list[str] = []
         #: step name -> (down_fn, up_fn); bound by the active transport
         self._controls: dict[str, tuple[Callable, Callable]] = {}
+        #: content-aware rung table (ROADMAP 4): rungs the current
+        #: content class makes pointless are skipped on the way down
+        #: (e.g. halving fps of a static desktop sheds nothing — its
+        #: frames are already idle-skipped by the partial encoder)
+        self._content_class: Optional[str] = None
+        self._content_skips: frozenset = frozenset()
         #: (name, perf_ns, level, reasons) ring for the trace overlay
         self._events: collections.deque = collections.deque(
             maxlen=_EVENT_CAP)
@@ -147,6 +153,25 @@ class DegradationLadder:
     def unbind_controls(self) -> None:
         with self._lock:
             self._controls.clear()
+
+    def set_content_profile(self, name: Optional[str],
+                            skip_steps=()) -> None:
+        """Content-profile-aware rungs (ROADMAP 4, engine/content.py):
+        record the session's content class and the downshift rungs it
+        makes pointless. Skipped rungs are passed over on the way down
+        (named in the incident, like the deadline-force path); the walk
+        back up is untouched — a rung that actuated before the class
+        changed must still be restored. ``None`` clears."""
+        skips = frozenset(skip_steps)
+        with self._lock:
+            changed = (name != self._content_class
+                       or skips != self._content_skips)
+            self._content_class = name
+            self._content_skips = skips
+        if changed and name is not None:
+            self.recorder.record("ladder_content_profile",
+                                 content_class=name,
+                                 skipped_rungs=sorted(skips))
 
     # -- state machine -------------------------------------------------------
     def _trigger_reasons(self, verdicts: Mapping) -> list[str]:
@@ -279,6 +304,20 @@ class DegradationLadder:
                 to_level = pick + 1
                 skipped = list(self.steps[self.level:pick])
                 reasons = reasons + [f"energy-efficient:{step}"]
+            elif pick is None and step in self._content_skips:
+                # content-profile skip: walk to the first rung the
+                # current content class doesn't make pointless
+                for j in range(self.level, len(self.steps)):
+                    if self.steps[j] not in self._content_skips:
+                        step = self.steps[j]
+                        to_level = j + 1
+                        skipped = list(self.steps[self.level:j])
+                        reasons = reasons + [
+                            f"content-skip:{self._content_class}"]
+                        break
+                else:
+                    # every remaining rung skipped: nothing to shed
+                    return False
         else:
             step = self.steps[self.level - 1]
         if self._gate_query(step, direction) != "cold":
@@ -382,6 +421,8 @@ class DegradationLadder:
             "active_triggers": list(self._last_reasons)
             if self._bad_since is not None else [],
             "controls_bound": sorted(self._controls),
+            "content_class": self._content_class,
+            "content_skips": sorted(self._content_skips),
             "gated": self.gate is not None,
             "energy_mode": self.energy_policy is not None,
             "energy": (self.energy_policy.snapshot()

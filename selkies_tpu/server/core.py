@@ -986,9 +986,33 @@ class CentralizedStreamServer:
         while True:
             await asyncio.sleep(interval)
             try:
+                self._feed_content_profile()
                 self.ladder.observe(self.health.run())
             except Exception:
                 logger.exception("degradation ladder tick failed")
+
+    def _feed_content_profile(self) -> None:
+        """Content-profile-aware rungs (ROADMAP 4): tell the ladder the
+        primary session's content class so downshifts skip rungs the
+        class makes pointless (engine/content.CONTENT_LADDER_SKIPS)."""
+        assert self.ladder is not None
+        svc = self.services.get(self.active_mode or "")
+        getter = getattr(svc, "primary_content_class", None)
+        if getter is None:
+            # mode switched to a service without a classifier: a stale
+            # profile must not keep steering the rung walk
+            self.ladder.set_content_profile(None)
+            return
+        try:
+            cls = getter()
+        except Exception:
+            cls = None
+        if cls is None:
+            self.ladder.set_content_profile(None)
+            return
+        from ..engine.content import CONTENT_LADDER_SKIPS
+        self.ladder.set_content_profile(
+            cls, CONTENT_LADDER_SKIPS.get(cls, ()))
 
     async def shutdown(self) -> None:
         # owner-matched: a newer in-process server may have replaced

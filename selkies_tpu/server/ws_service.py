@@ -745,6 +745,25 @@ class WebSocketsService(BaseStreamingService):
         return self.display_geometry.get(
             display_id, (s.initial_width, s.initial_height))
 
+    def _content_state_for(self, display_id: str) -> dict:
+        """Content/damage block of a display's capture (ROADMAP 4) —
+        {} when the capture is absent or pre-classifier."""
+        cap = self.captures.get(display_id) \
+            or self.captures.get("__seats__")
+        state = getattr(cap, "content_state", None)
+        if state is None:
+            return {}
+        try:
+            return state() or {}
+        except Exception:
+            return {}
+
+    def primary_content_class(self):
+        """The default display's content class (the core's ladder feed);
+        None before classification."""
+        return self._content_state_for(self._default_display()).get(
+            "class")
+
     def _capture_settings(self, display_id: str) -> CaptureSettings:
         s = self.settings
         w, h = self._capture_geometry(display_id)
@@ -769,6 +788,12 @@ class WebSocketsService(BaseStreamingService):
             stripe_streaming=bool(getattr(s, "stripe_streaming", True)),
             h264_motion_vrange=s.h264_motion_vrange,
             h264_motion_hrange=s.h264_motion_hrange,
+            h264_partial_encode=bool(getattr(s, "h264_partial_encode",
+                                             True)),
+            h264_content_adaptive=bool(getattr(s, "h264_content_adaptive",
+                                               True)),
+            h264_roi_qp=bool(getattr(s, "h264_roi_qp", False)),
+            h264_roi_qp_bias=int(getattr(s, "h264_roi_qp_bias", 4)),
             capture_x=self.display_offsets.get(display_id, (0, 0))[0],
             capture_y=self.display_offsets.get(display_id, (0, 0))[1],
             display_id=display_id,
@@ -1111,6 +1136,10 @@ class WebSocketsService(BaseStreamingService):
         client.qoe.target_fps = lambda: float(self.settings.framerate)
         client.qoe.relay_provider = \
             lambda c=client: _relay_counters(c.relays)
+        # content-adaptive encoding (ROADMAP 4): class + dirty fraction
+        # from the display's capture, pulled at snapshot/export time
+        client.qoe.content_provider = \
+            lambda c=client: self._content_state_for(c.display)
         # log correlation: selkies_tpu.* records emitted while handling
         # this connection carry its session/seat id (obs.logctx filter)
         _logctx.bind(client.id, client.display)

@@ -159,6 +159,25 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
        vmin=1, vmax=100, client=True),
     _s("use_damage_gating", SType.BOOL, True,
        "Only encode stripes whose content changed (device-side diff)."),
+    _s("h264_partial_encode", SType.BOOL, True,
+       "Damage-proportional P encode (ROADMAP 4): dispatch the device "
+       "step only over the MB-row band intersecting the damage map; "
+       "clean rows of delivered stripes ship as host-precomputed "
+       "all-skip slices and idle frames skip the device entirely. "
+       "Requires use_damage_gating."),
+    _s("h264_content_adaptive", SType.BOOL, True,
+       "Classify each session's content (static/scroll/video/gaming) "
+       "from damage-plane signals and apply the matching rate-control "
+       "profile (qp bias, band bucket floor, IDR cadence) — "
+       "engine/content.py; class + dirty fraction surface in "
+       "/api/sessions and the selkies_session_* gauges."),
+    _s("h264_roi_qp", SType.BOOL, False,
+       "ROI QP: per-macroblock QP plane derived from the damage map — "
+       "freshly-damaged regions sharpen by h264_roi_qp_bias below the "
+       "frame qp, coded as real mb_qp_delta syntax (4:2:0 P frames)."),
+    _s("h264_roi_qp_bias", SType.INT, 4,
+       "QP sharpening applied to freshly-damaged macroblocks when "
+       "h264_roi_qp is on.", vmin=0, vmax=12),
     _s("watermark_path", SType.STR, "", "PNG burned into the framebuffer on device."),
     _s("watermark_location", SType.INT, 6, "0-6 anchor enum (reference parity).",
        vmin=0, vmax=6),

@@ -108,7 +108,12 @@ class SelkiesClient {
 
   connect() {
     const proto = location.protocol === "https:" ? "wss:" : "ws:";
-    const url = `${proto}//${location.host}/api/websockets`;
+    // a fleet migration (the migrate,{json} control verb) overrides the
+    // target: reconnect to the NEW gateway carrying the fleet_sid
+    // affinity key so the session-affine proxy routes us to the
+    // re-placed seat
+    const url = this._migrateUrl ||
+      `${proto}//${location.host}/api/websockets`;
     this.status(`connecting to ${url}`);
     const ws = new WebSocket(url);
     ws.binaryType = "arraybuffer";
@@ -121,6 +126,13 @@ class SelkiesClient {
       if (this._pendingLayout) {
         this._pendingLayout();
         this._pendingLayout = null;
+      }
+      if (this._migrateResync) {
+        // the target host answers the fresh START_VIDEO with an IDR
+        // anyway; the explicit request covers resync-after-reconnect
+        // races (a stripe already in flight from the old GOP)
+        this._migrateResync = false;
+        this.send("REQUEST_KEYFRAME");
       }
     };
     ws.onmessage = (ev) => {
@@ -461,6 +473,7 @@ class SelkiesClient {
         // over /api/turn on the next RTC (re)negotiation
         try { this.rtcConfig = JSON.parse(rest); } catch { /* ignore */ }
         break;
+      case "migrate": this._onMigrate(rest); break;
       case "KILL":
         this.killed = true;
         this.status("session terminated by server", true);
@@ -469,6 +482,33 @@ class SelkiesClient {
       default: break;
     }
     this._postToDashboard({ type: "serverMessage", verb, payload: rest });
+  }
+
+  /* Fleet migration (fleet/protocol.migrate_command): the draining
+   * host tells us to reconnect elsewhere. Payload {url, sid, resync}:
+   * rebuild the WS URL against the new gateway with ?fleet_sid=<sid>
+   * (the affinity key its session-affine proxy routes on), close the
+   * socket, and let the normal reconnect loop carry us over — the
+   * capture stays warm inside the reconnect grace, and resync asks for
+   * an IDR so the decoder never sees a mid-GOP seam. */
+  _onMigrate(json) {
+    let m;
+    try { m = JSON.parse(json); } catch { return; }
+    if (!m || typeof m.url !== "string") return;
+    let u;
+    try {
+      u = new URL("/api/websockets", new URL(m.url, location.href));
+    } catch { return; }
+    u.protocol = (u.protocol === "https:" || u.protocol === "wss:")
+      ? "wss:" : "ws:";
+    if (m.sid) u.searchParams.set("fleet_sid", String(m.sid));
+    this._migrateUrl = u.toString();
+    this._migrateResync = m.resync !== false;
+    this.status(`migrating to ${u.host}…`, true);
+    this.reconnectDelay = 500;
+    if (this.ws) {
+      try { this.ws.close(); } catch (_e) { /* already closing */ }
+    }
   }
 
   _applyServerSettings(json) {

@@ -19,6 +19,8 @@ import sys
 import tempfile
 from pathlib import Path
 
+import pytest
+
 ROOT = Path(__file__).resolve().parent.parent
 
 _cache = {}
@@ -341,3 +343,46 @@ def test_bench_fleet_contract_block():
     # every simulated heartbeat crossed the strict wire parser
     assert f["heartbeats"]["rejected"] == 0
     assert f["heartbeats"]["sent"] > 0
+
+
+@pytest.mark.slow
+def test_bench_adaptive_contract_block():
+    """ISSUE 15 acceptance shape: bench --adaptive emits an ``adaptive``
+    block whose own clauses already gated the exit code (the run exits 1
+    on any break), plus the top-level dirty_fraction/content_class
+    ledger columns. Slow-marked like the stripe session contract — the
+    ``adaptive-bench`` CI job re-proves the full clauses every push;
+    this pins the JSON surface the driver and the ledger consume."""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu", BENCH_PROBE_BUDGET_S="1",
+               BENCH_ADAPT_WIDTH="128", BENCH_ADAPT_HEIGHT="128",
+               BENCH_ADAPT_FRAMES="3", BENCH_ADAPT_REPS="1",
+               PERF_LEDGER_PATH=_LEDGER)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([sys.executable, str(ROOT / "bench.py"),
+                        "--adaptive"],
+                       capture_output=True, text=True, timeout=900,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE json line: {lines}"
+    doc = json.loads(lines[0])
+    assert doc["unit"] == "speedup_10pct_vs_full"
+    assert "dirty_fraction" in doc and "content_class" in doc
+    a = doc["adaptive"]
+    assert a["monotonic"] is True
+    assert a["byte_identical_full"] is True
+    assert a["decode_valid"] is True
+    assert a["content_classes_ok"] is True
+    points = a["points"]
+    assert [p["dirty_fraction"] for p in points] == \
+        sorted(p["dirty_fraction"] for p in points)
+    for p in points:
+        assert p["encode_ms"] > 0 and p["band_rows"] >= 1
+    # the ledger row carries the new columns (entry_from_bench)
+    rows = [json.loads(ln) for ln in
+            Path(_LEDGER).read_text().splitlines()]
+    row = [e for e in rows
+           if e["metric"].startswith("adaptive_encode_")][-1]
+    assert row["dirty_fraction"] == points[0]["dirty_fraction"]
+    assert row["adaptive"]["speedup_10pct"] == a["speedup_10pct"]

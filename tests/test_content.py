@@ -1,0 +1,217 @@
+"""Content classification (ROADMAP 4, engine/content.py): damage-signal
+EWMAs -> class -> rate-control profile, plus the ladder and /api/sessions
+integrations. Stdlib-only (no jax) like the other control-plane suites."""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.engine.content import (CONTENT_CLASSES,
+                                        CONTENT_LADDER_SKIPS,
+                                        CONTENT_PROFILES,
+                                        ContentClassifier)
+
+
+def drive(ctl, fractions):
+    last = None
+    for f in fractions:
+        last = ctl.update(f)
+    return last
+
+
+# ------------------------------------------------------------- classifier
+def test_classifier_idle_and_typing_stay_static():
+    assert drive(ContentClassifier(), [0.0] * 200) == "static"
+    typing = [1 / 16 if t % 6 == 0 else 0.0 for t in range(200)]
+    assert drive(ContentClassifier(), typing) == "static"
+
+
+def test_classifier_scroll_video_gaming():
+    assert drive(ContentClassifier(), [0.4] * 200) == "scroll"
+    assert drive(ContentClassifier(), [1.0] * 200) == "video"
+    # volatile full-raster damage reads as gaming
+    rng = np.random.default_rng(3)
+    chaotic = [float(rng.choice([0.4, 1.0]))
+               for _ in range(400)]
+    assert drive(ContentClassifier(), chaotic) == "gaming"
+
+
+def test_classifier_dwell_hysteresis():
+    ctl = ContentClassifier(dwell=30)
+    drive(ctl, [0.4] * 200)
+    assert ctl.current == "scroll"
+    # a brief burst must NOT flip the class before the dwell
+    for _ in range(29):
+        ctl.update(1.0)
+    assert ctl.current == "scroll"
+    drive(ctl, [1.0] * 200)
+    assert ctl.current == "video"
+    assert ctl.transitions >= 2
+
+
+def test_classifier_recovers_to_static():
+    ctl = ContentClassifier()
+    drive(ctl, [1.0] * 200)
+    assert ctl.current == "video"
+    drive(ctl, [0.0] * 400)
+    assert ctl.current == "static"
+
+
+def test_profiles_and_snapshot():
+    assert set(CONTENT_PROFILES) == set(CONTENT_CLASSES)
+    assert CONTENT_PROFILES["static"].qp_bias < 0       # text sharpens
+    assert CONTENT_PROFILES["gaming"].qp_bias > 0
+    assert not CONTENT_PROFILES["video"].partial_encode
+    assert CONTENT_PROFILES["scroll"].band_floor_rows > 1
+    ctl = ContentClassifier()
+    drive(ctl, [0.4] * 200)
+    snap = ctl.snapshot()
+    assert snap["class"] == "scroll"
+    assert snap["profile"]["band_floor_rows"] == \
+        CONTENT_PROFILES["scroll"].band_floor_rows
+    assert 0.3 < snap["area_ewma"] < 0.5
+    assert ctl.class_index == CONTENT_CLASSES.index("scroll")
+
+
+def test_gauge_class_mapping_pinned_against_qoe():
+    # obs/qoe keeps a literal copy (the obs package is stdlib-only by
+    # contract); drift between the two would silently re-number the
+    # selkies_session_content_class gauge
+    from selkies_tpu.obs.qoe import _CONTENT_CLASSES
+    assert _CONTENT_CLASSES == CONTENT_CLASSES
+
+
+# ------------------------------------------------------------------ ladder
+def _mk_ladder(**kw):
+    from selkies_tpu.obs import health as _health
+    from selkies_tpu.resilience.ladder import DegradationLadder
+    return DegradationLadder(recorder=_health.FlightRecorder(16),
+                             down_after_s=1.0, hold_s=0.0,
+                             ok_window_s=5.0, clock=lambda: 0.0, **kw)
+
+
+def test_ladder_content_profile_skips_pointless_rungs():
+    lad = _mk_ladder()
+    lad.set_content_profile("static", CONTENT_LADDER_SKIPS["static"])
+    bad = {"qoe": "failed"}
+    lad.observe(bad, now=0.0)
+    lad.observe(bad, now=2.0)          # past down_after: -> pipeline
+    assert lad.level == 1
+    lad.observe(bad, now=4.0)          # next rung is fps -> SKIPPED
+    assert lad.level == 3              # lands on quality
+    snap = lad.snapshot()
+    assert snap["content_class"] == "static"
+    assert snap["content_skips"] == ["fps"]
+    # recorded with the skipped rung named
+    kinds = [e["kind"] for e in lad.recorder.snapshot()]
+    assert "ladder_content_profile" in kinds
+    steps = [e for e in lad.recorder.snapshot()
+             if e["kind"] == "degradation_step"]
+    assert steps[-1]["step"] == "quality"
+    assert steps[-1].get("skipped") == ["fps"]
+    assert any("content-skip:static" in r for r in steps[-1]["reasons"])
+
+
+def test_ladder_content_profile_clear_restores_stock_walk():
+    lad = _mk_ladder()
+    lad.set_content_profile("static", ("fps",))
+    lad.set_content_profile(None)
+    bad = {"qoe": "failed"}
+    lad.observe(bad, now=0.0)
+    lad.observe(bad, now=2.0)
+    lad.observe(bad, now=4.0)
+    assert lad.level == 2              # stock: pipeline then fps
+    assert lad.snapshot()["content_class"] is None
+
+
+def test_ladder_all_remaining_rungs_skipped_holds():
+    lad = _mk_ladder()
+    lad.set_content_profile(
+        "weird", ("pipeline", "fps", "quality", "downscale"))
+    bad = {"qoe": "failed"}
+    lad.observe(bad, now=0.0)
+    lad.observe(bad, now=2.0)
+    assert lad.level == 0              # nothing sheddable: hold, no crash
+
+
+# ----------------------------------------------------------- qoe snapshot
+def test_session_snapshot_carries_content_block():
+    from selkies_tpu.obs.qoe import QoERegistry
+    reg = QoERegistry()
+    st = reg.register("ws", "primary", 1)
+    st.content_provider = lambda: {
+        "class": "scroll", "dirty_fraction": 0.31,
+        "area_ewma": 0.3}
+    doc = st.snapshot()
+    assert doc["content_class"] == "scroll"
+    assert doc["dirty_fraction"] == 0.31
+    assert "content" not in doc                    # verbose-only detail
+    vdoc = st.snapshot(verbose=True)
+    assert vdoc["content"]["area_ewma"] == 0.3
+    # absent/broken provider: no content keys, no crash
+    st2 = reg.register("ws", "primary", 2)
+    assert "content_class" not in st2.snapshot()
+    st2.content_provider = lambda: (_ for _ in ()).throw(RuntimeError())
+    assert "content_class" not in st2.snapshot()
+
+
+# --------------------------------------------------- capture-loop wiring
+def test_capture_content_tick_applies_profile():
+    from selkies_tpu.engine.capture import ScreenCapture
+    from selkies_tpu.engine.types import CaptureSettings
+
+    class FakeSession:
+        def __init__(self):
+            self.dirty_fraction = 1.0
+            self.qp = 28
+            self.profiles = []
+            self.n_rows = 16
+
+        def set_content_profile(self, p):
+            self.profiles.append(p)
+
+        def set_qp(self, qp, paint=None):
+            self.qp = qp
+
+    cap = ScreenCapture(source_kind="synthetic")
+    ctl = ContentClassifier(dwell=5)
+    cap._content = ctl          # as the capture loop: ctl IS _content
+    sess = FakeSession()
+    s = CaptureSettings(output_mode="h264", video_crf=28, use_cbr=False)
+    for _ in range(60):
+        cap._content_tick(ctl, sess, s)
+    assert ctl.current == "video"
+    assert sess.profiles and sess.profiles[-1].name == "video"
+    assert sess.qp == 28 + CONTENT_PROFILES["video"].qp_bias
+    # the bias is RELATIVE and rebases on external writes: a
+    # client-chosen quality level set between class changes becomes the
+    # new bias-free base (the write overwrote the embedded bias), so
+    # the next transition applies the new class's bias against IT —
+    # never a reset to video_crf, never a stale-bias double-count
+    sess.qp = 20                       # client raised quality meanwhile
+    sess.dirty_fraction = 0.0
+    for _ in range(120):
+        cap._content_tick(ctl, sess, s)
+    assert ctl.current == "static"
+    assert sess.qp == 20 + CONTENT_PROFILES["static"].qp_bias
+    # content_state surfaces the classifier + live dirty fraction
+    cap._content = ctl
+    cap._session = sess
+    state = cap.content_state()
+    assert state["class"] == "static"
+    assert state["dirty_fraction"] == 0.0
+
+
+def test_set_content_profile_floors_band_bucket():
+    pytest.importorskip("jax")
+    from selkies_tpu.engine.h264_encoder import H264EncoderSession
+    from selkies_tpu.engine.types import CaptureSettings
+    sess = H264EncoderSession(CaptureSettings(
+        capture_width=64, capture_height=64, stripe_height=32,
+        output_mode="h264", h264_partial_encode=True,
+        h264_motion_vrange=0))
+    sess.set_content_profile(CONTENT_PROFILES["scroll"])
+    assert sess._band_floor == CONTENT_PROFILES["scroll"].band_floor_rows
+    # "full-frame" profiles floor at the whole frame, keeping the probe
+    # (and the dirty signal) alive instead of leaving the partial path
+    sess.set_content_profile(CONTENT_PROFILES["video"])
+    assert sess._band_floor == sess.n_rows

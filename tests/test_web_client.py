@@ -149,6 +149,38 @@ def test_client_speaks_the_glass_to_glass_protocol():
     assert video.count("clientStats()") >= 2   # worker sink + fallback
 
 
+def test_migrate_command_contract():
+    """ISSUE 11 remaining item: the client handles ``migrate,{json}``.
+    Built here exactly as ws_service.announce_migration builds it
+    (fleet/protocol.migrate_command), then statically checked against
+    the JS handler — a drift on either side of the verb breaks this
+    test, like the timing-batch contract above."""
+    import json as _json
+
+    from selkies_tpu.fleet.protocol import migrate_command
+
+    cmd = migrate_command("https://host2.example:8443", "sid-42",
+                          resync=True)
+    verb, payload = cmd.split(",", 1)
+    assert verb == "migrate"
+    body = _json.loads(payload)
+    assert set(body) == {"url", "sid", "resync"}
+    assert body["url"] == "https://host2.example:8443"
+    assert body["sid"] == "sid-42" and body["resync"] is True
+
+    js = (WEB / "selkies-client.js").read_text()
+    # verb dispatch + handler consume every field the server sends
+    assert 'case "migrate": this._onMigrate(rest); break;' in js
+    for field in ("m.url", "m.sid", "m.resync"):
+        assert field in js, f"migrate handler ignores {field}"
+    # the reconnect carries the gateway's affinity key on the WS path
+    assert 'u.searchParams.set("fleet_sid", String(m.sid))' in js
+    assert '"/api/websockets"' in js
+    assert "this._migrateUrl" in js
+    # resync requests a keyframe once reconnected
+    assert "_migrateResync" in js and "REQUEST_KEYFRAME" in js
+
+
 async def test_server_serves_module_assets(client_factory):
     s = AppSettings.parse([], {})
     svc = WebSocketsService(s, input_handler=InputHandler(

@@ -169,6 +169,14 @@ def entry_from_bench(doc: dict, *, git_rev: Optional[str] = None,
         "fps_per_w": (doc.get("energy") or {}).get("fps_per_w"),
         "watts_mean": (doc.get("energy") or {}).get("watts_mean"),
         "energy_source": (doc.get("energy") or {}).get("source"),
+        # damage-proportional encoding (ROADMAP 4): the run's steady-
+        # state dirty fraction and classified content — without them
+        # two rows at different damage loads would read as a perf swing
+        "dirty_fraction": doc.get("dirty_fraction"),
+        "content_class": doc.get("content_class"),
+        # the --adaptive acceptance block (encode ms vs dirty fraction,
+        # content-class timeline) when that phase ran
+        "adaptive": doc.get("adaptive"),
     }
 
 
@@ -370,11 +378,12 @@ def cmd_report(args: argparse.Namespace) -> int:
         print(f"   {'date':<20} {'rev':<8} {'backend':<24} {'fps':>7} "
               f"{'p50_ms':>9} {'p99_ms':>9} {'g2g_p99':>9} {'pd':>3} "
               f"{'sd':>3} {'overlap':>8} {'j/f':>8} {'fps/W':>7} "
-              f"{'ok':>3}  top stage")
+              f"{'df':>5} {'class':>7} {'ok':>3}  top stage")
         for e in runs:
             ov = e.get("overlap_fraction")
             jf = e.get("joules_frame")
             fpw = e.get("fps_per_w")
+            df = e.get("dirty_fraction")
             print(f"   {str(e.get('ts', ''))[:19]:<20} "
                   f"{str(e.get('git_rev', ''))[:7]:<8} "
                   f"{str(e.get('backend', ''))[:24]:<24} "
@@ -387,6 +396,8 @@ def cmd_report(args: argparse.Namespace) -> int:
                   f"{(format(ov, '.1%') if isinstance(ov, (int, float)) else '-'):>8} "
                   f"{(format(jf, '.3f') if isinstance(jf, (int, float)) else '-'):>8} "
                   f"{(format(fpw, '.3f') if isinstance(fpw, (int, float)) else '-'):>7} "
+                  f"{(format(df, '.2f') if isinstance(df, (int, float)) else '-'):>5} "
+                  f"{str(e.get('content_class') or '-')[:7]:>7} "
                   f"{'y' if e.get('baseline_eligible') else 'n':>3}  "
                   f"{_top_stage(e)}")
         out_doc["keys"].append({
@@ -396,7 +407,8 @@ def cmd_report(args: argparse.Namespace) -> int:
                        "latency_p50_ms", "latency_p99_ms", "g2g_p99_ms",
                        "pipeline_depth", "stripe_devices",
                        "overlap_fraction", "joules_frame", "fps_per_w",
-                       "energy_source",
+                       "energy_source", "dirty_fraction",
+                       "content_class",
                        "baseline_eligible", "stages_ms")}
                      for e in runs]})
     if args.json:
@@ -424,9 +436,14 @@ def _pareto_points(entries: list[dict]) -> list[dict]:
         quality = q if isinstance(q, (int, float)) else e.get("fps")
         if not isinstance(quality, (int, float)):
             continue
+        # content_class joins the operating-point key (ROADMAP 4): a
+        # static-desktop row and a full-motion row are different
+        # operating points on the quality x latency x energy surface,
+        # not noise around one point
         key = (e.get("backend_class"), e.get("resolution"),
                e.get("codec"), e.get("stripe_devices") or 1,
-               e.get("pipeline_depth") or 1)
+               e.get("pipeline_depth") or 1,
+               e.get("content_class") or "any")
         latest[key] = {            # later entries override: latest wins
             "point": "/".join(str(k) for k in key),
             "quality": quality,
