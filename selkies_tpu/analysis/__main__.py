@@ -2,7 +2,9 @@
 
 Usage:
     python -m selkies_tpu.analysis [options] PATH [PATH ...]
+    python -m selkies_tpu.analysis --jaxpr [options]
     python -m selkies_tpu.analysis selftest [--json]
+    python -m selkies_tpu.analysis jaxpr-selftest [--json] [--fast]
 
     --baseline FILE        ratchet: tolerate findings recorded in FILE,
                            fail only on new ones
@@ -15,10 +17,21 @@ Usage:
                            in README.md §graftlint)
     --severity RULE=LEVEL  per-rule severity override (info|warning|
                            error); info findings never gate
+    --jaxpr                run the v3 trace-time pass instead of the
+                           AST pass: abstract-eval every registered
+                           step factory and lint jaxprs + compiled
+                           artifacts (requires jax; PATH args unused;
+                           baseline lives in tools/jaxpr_baseline.json)
+    --jaxpr-disable RULE   disable one jaxpr rule for this run (trace
+                           findings have no source line to carry an
+                           inline pragma)
     --list-rules           print the rule catalog and exit
 
 ``selftest`` runs the embedded per-rule fixtures (stdlib-only, no repo
 checkout needed) — the lint-image smoke the other planes also ship.
+``jaxpr-selftest`` does the same for the v3 trace rules (needs jax;
+CPU backend is enough) and additionally asserts the real surface's
+coverage: every registered step factory traced, donation verified.
 
 Exit codes: 0 clean (or everything baselined), 1 new gating findings,
 2 usage/parse/INTERNAL error.  A crashing rule is an internal error
@@ -54,6 +67,13 @@ def main(argv: list[str] | None = None) -> int:
     if argv and argv[0] == "selftest":
         from .selftest import run_selftest
         return run_selftest(argv[1:])
+    if argv and argv[0] == "jaxpr-selftest":
+        # env knobs (forced donation, host device count) must land
+        # before jax initialises its backend — first thing, here
+        from .surface import ensure_analysis_env
+        ensure_analysis_env()
+        from .jaxpr_selftest import run_jaxpr_selftest
+        return run_jaxpr_selftest(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="python -m selkies_tpu.analysis",
@@ -68,6 +88,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="alias for --format=json")
     ap.add_argument("--severity", action="append", default=[],
                     metavar="RULE=LEVEL")
+    ap.add_argument("--jaxpr", action="store_true",
+                    help="run the v3 trace-time pass (requires jax)")
+    ap.add_argument("--jaxpr-disable", action="append", default=[],
+                    metavar="RULE")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
     if args.as_json:
@@ -77,15 +101,24 @@ def main(argv: list[str] | None = None) -> int:
         for rule in default_rules():
             print(f"{rule.rule_id:24s} [{rule.default_severity:7s}] "
                   f"{rule.description}")
+        from .jaxpr_lint import JAXPR_RULES
+        for rule in JAXPR_RULES:
+            print(f"{rule.rule_id:24s} [{rule.default_severity:7s}] "
+                  f"{rule.description}  (--jaxpr pass)")
         return 0
-    if not args.paths:
-        ap.print_usage(sys.stderr)
-        return 2
 
     try:
         overrides = _parse_severities(args.severity)
     except ValueError as e:
         print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.jaxpr:
+        from .jaxpr_lint import run_cli
+        args.severity_map = overrides
+        return run_cli(args)
+    if not args.paths:
+        ap.print_usage(sys.stderr)
         return 2
 
     analyzer = Analyzer(severity_overrides=overrides)

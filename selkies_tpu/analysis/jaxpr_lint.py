@@ -1,0 +1,349 @@
+"""graftlint v3 rules: lint the traced compile surface.
+
+Consumes :class:`..analysis.surface.SurfaceReport` records and emits
+the same :class:`..analysis.core.Finding` objects the AST pass uses, so
+the baseline ratchet, severity overrides, JSON/SARIF output and exit
+codes are shared.  Finding paths are virtual (``jaxpr://<step-name>``,
+``lattice://<program-key>``) and the ``source`` payload is a stable
+description, so the (path, rule, source) baseline identity survives
+recompiles that shuffle byte counts.
+
+Rules
+-----
+JAXPR-DONATION-ALIAS   donated args must appear in the compiled
+                       executable's input-output alias map; a donated
+                       invar forwarded verbatim to an output (the PR-10
+                       ``prev_out`` class) is called out specifically.
+JAXPR-HOST-CALLBACK    no pure_callback/io_callback/debug_* primitives
+                       in hot steps.
+JAXPR-DTYPE-DRIFT      f64 anywhere, or an f32 intermediate blown up
+                       past ``DTYPE_DRIFT_FACTOR`` x the largest input
+                       plane on an integer-plane pipeline (an
+                       accidental upcast+broadcast, not the legitimate
+                       float CSC path).
+JAXPR-TEMP-BYTES       ratcheted per-step ``temp_size_in_bytes`` budget
+                       from the committed baseline (budget x
+                       ``TEMP_HEADROOM`` is the gate); a step missing
+                       from the budget table must be budgeted via
+                       ``--write-baseline``.
+LATTICE-COMPLETENESS   plan-predicted program names must equal the
+                       factory-stamped names actually built, and the
+                       signature's knobs must round-trip through
+                       ``lattice_from_settings`` onto the same
+                       program_key (the PR-15 bug class).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Iterable, Optional
+
+from .core import (BASELINE_VERSION, Finding, Severity, make_baseline)
+
+__all__ = ["JAXPR_RULES", "DTYPE_DRIFT_FACTOR", "TEMP_HEADROOM",
+           "lint_report", "make_jaxpr_baseline", "load_budgets",
+           "run_cli"]
+
+#: an f32 intermediate larger than this multiple of the largest input
+#: plane on an integer pipeline is drift, not the expected CSC float
+#: path (which peaks at ~4x: u8 plane -> f32 plane)
+DTYPE_DRIFT_FACTOR = 8.0
+
+#: tolerated growth over the committed per-step temp-bytes budget
+TEMP_HEADROOM = 1.10
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxprRule:
+    """Catalog entry (SARIF / --list-rules); checks live in
+    :func:`lint_report` because they see whole-surface records, not one
+    module at a time."""
+    rule_id: str
+    description: str
+    default_severity: str = Severity.ERROR
+
+
+JAXPR_RULES = [
+    JaxprRule(
+        "JAXPR-DONATION-ALIAS",
+        "donated argument missing from the compiled executable's "
+        "input-output alias map — the donation buys nothing (check "
+        "materialized-prev_out discipline / shape match)"),
+    JaxprRule(
+        "JAXPR-HOST-CALLBACK",
+        "host callback primitive (pure_callback/io_callback/debug_*) "
+        "inside a hot step — every frame would round-trip through the "
+        "python interpreter"),
+    JaxprRule(
+        "JAXPR-DTYPE-DRIFT",
+        "oversized float intermediate on an integer-plane pipeline "
+        "(accidental upcast/broadcast); f64 is always a finding",
+        Severity.WARNING),
+    JaxprRule(
+        "JAXPR-TEMP-BYTES",
+        "compiled step's temp_size_in_bytes exceeds its ratcheted "
+        "budget (committed baseline) — an accidental broadcast or "
+        "transpose grew HBM temp"),
+    JaxprRule(
+        "LATTICE-COMPLETENESS",
+        "a dispatchable step program the lattice/plan cannot predict, "
+        "or a plan-predicted program no factory builds — warm and "
+        "runtime gate would miss each other"),
+]
+
+_BY_ID = {r.rule_id: r for r in JAXPR_RULES}
+
+
+def _finding(rule_id: str, path: str, message: str, source: str,
+             severity: Optional[str] = None) -> Finding:
+    return Finding(
+        rule_id=rule_id, path=path, line=1, col=0, message=message,
+        severity=severity or _BY_ID[rule_id].default_severity,
+        source=source, end_line=1)
+
+
+# -- per-step rules ----------------------------------------------------------
+
+def _lint_step(st, budgets: dict) -> Iterable[Finding]:
+    path = f"jaxpr://{st.name}"
+
+    # JAXPR-DONATION-ALIAS
+    donated_idx = [i for i, d in enumerate(st.donated) if d]
+    aliased = set(st.aliased)
+    forwarded = set(st.forwarded)
+    dropped = set(getattr(st, "dropped", ()))
+    for i in donated_idx:
+        if i in dropped:
+            # jit pruned the arg (keep_unused=False): the program never
+            # reads it, so the donation frees a buffer but reuses
+            # nothing — stop donating it (the band-step prev/roi case)
+            yield _finding(
+                "JAXPR-DONATION-ALIAS", path,
+                f"donated arg {i} is unused and pruned at lowering — "
+                "the donation invalidates the caller's buffer without "
+                "reusing it; drop it from donate_argnums",
+                f"arg{i} donated but unused")
+        elif i in forwarded:
+            # the alias map may still list a forwarded param (XLA
+            # forwards the buffer), but jaxpr-level forwarding of a
+            # DONATED arg is the PR-10 hazard: the runtime returns the
+            # very buffer it marked consumed
+            yield _finding(
+                "JAXPR-DONATION-ALIAS", path,
+                f"donated arg {i} is forwarded verbatim to an output — "
+                "jaxpr input forwarding defeats donation (materialize "
+                "it, e.g. bitwise_or(x, 0), before returning)",
+                f"arg{i} donated but forwarded")
+        elif i not in aliased:
+            yield _finding(
+                "JAXPR-DONATION-ALIAS", path,
+                f"donated arg {i} absent from the compiled alias map — "
+                "XLA could not reuse the buffer (shape/dtype mismatch "
+                "with every output?)",
+                f"arg{i} donated but not aliased")
+
+    # JAXPR-HOST-CALLBACK
+    for prim in st.callbacks:
+        yield _finding(
+            "JAXPR-HOST-CALLBACK", path,
+            f"host callback primitive '{prim}' in hot step",
+            f"callback {prim}")
+
+    # JAXPR-DTYPE-DRIFT
+    if st.has_f64:
+        worst = next((t for t in st.float_temps if t[1] == "float64"),
+                     None)
+        detail = f" (largest: f64[{worst[2]}] from {worst[3]})" \
+            if worst else ""
+        yield _finding(
+            "JAXPR-DTYPE-DRIFT", path,
+            f"f64 intermediate in a plane pipeline{detail} — double "
+            "precision is never intended here",
+            "f64 intermediate", Severity.ERROR)
+    if st.int_plane and st.max_input_bytes > 0:
+        limit = DTYPE_DRIFT_FACTOR * st.max_input_bytes
+        for nbytes, dtype, shape, prim in st.float_temps:
+            if dtype == "float64" or nbytes <= limit:
+                continue
+            yield _finding(
+                "JAXPR-DTYPE-DRIFT", path,
+                f"{dtype}[{shape}] intermediate from '{prim}' is "
+                f"{nbytes} B — {nbytes / st.max_input_bytes:.1f}x the "
+                f"largest input plane (threshold "
+                f"{DTYPE_DRIFT_FACTOR:g}x): likely upcast+broadcast",
+                f"{dtype}[{shape}] {prim}")
+            break   # one finding per step: the top offender
+
+    # JAXPR-TEMP-BYTES
+    budget = budgets.get(st.name)
+    if budget is None:
+        yield _finding(
+            "JAXPR-TEMP-BYTES", path,
+            f"step has no temp-bytes budget (current: {st.temp_bytes} "
+            "B) — record one with --jaxpr --write-baseline",
+            "unbudgeted step")
+    elif st.temp_bytes > budget * TEMP_HEADROOM:
+        yield _finding(
+            "JAXPR-TEMP-BYTES", path,
+            f"temp_size_in_bytes {st.temp_bytes} exceeds budget "
+            f"{budget} (+{TEMP_HEADROOM - 1:.0%} headroom) — re-budget "
+            "deliberately or find the regression",
+            "temp bytes over budget")
+
+
+# -- per-signature rules -----------------------------------------------------
+
+def _lint_signature(sig_trace) -> Iterable[Finding]:
+    path = f"lattice://{sig_trace.program_key}"
+    predicted = set(sig_trace.predicted)
+    built = set(sig_trace.built)
+    for name in sorted(built - predicted):
+        yield _finding(
+            "LATTICE-COMPLETENESS", path,
+            f"factory builds '{name}' but plan.program_names never "
+            "predicts it — prewarm would warm past it and the runtime "
+            "gate would read it cold",
+            f"unpredicted program {name}")
+    for name in sorted(predicted - built):
+        yield _finding(
+            "LATTICE-COMPLETENESS", path,
+            f"plan.program_names predicts '{name}' but no factory "
+            "builds it — the warm would compile a ghost program",
+            f"ghost program {name}")
+    if sig_trace.lattice_key is not None \
+            and sig_trace.lattice_key != sig_trace.program_key:
+        yield _finding(
+            "LATTICE-COMPLETENESS", path,
+            "signature does not round-trip through "
+            f"lattice_from_settings (got '{sig_trace.lattice_key}') — "
+            "a dispatchable axis is dropped by the enumeration",
+            "lattice round-trip mismatch")
+
+
+def lint_report(report, budgets: Optional[dict] = None, *,
+                severity_overrides: Optional[dict] = None,
+                disabled: Iterable[str] = ()) -> list:
+    """All findings for a traced surface.  ``budgets`` is the
+    ``{step name: temp bytes}`` table from the committed baseline."""
+    budgets = budgets or {}
+    disabled = {d.upper() for d in disabled}
+    overrides = {k.upper(): v for k, v in (severity_overrides or {}).items()}
+    findings: list = []
+    for st in report.steps:
+        findings.extend(_lint_step(st, budgets))
+    for sig_trace in report.signatures:
+        findings.extend(_lint_signature(sig_trace))
+    out = []
+    for f in findings:
+        if f.rule_id in disabled:
+            continue
+        sev = overrides.get(f.rule_id)
+        if sev and sev != f.severity:
+            f = dataclasses.replace(f, severity=sev)
+        out.append(f)
+    return sorted(out, key=lambda x: (x.path, x.rule_id, x.source))
+
+
+# -- baseline (entries + budgets) --------------------------------------------
+
+def make_jaxpr_baseline(findings, report) -> dict:
+    """The jaxpr ratchet document: tolerated findings (same identity as
+    the AST baseline) PLUS the per-step temp-bytes budget table pinned
+    at current values."""
+    doc = make_baseline(findings)
+    doc["budgets"] = {st.name: int(st.temp_bytes)
+                     for st in sorted(report.steps,
+                                      key=lambda s: s.name)}
+    return doc
+
+
+def load_budgets(baseline: Optional[dict]) -> dict:
+    budgets = (baseline or {}).get("budgets", {})
+    return {str(k): int(v) for k, v in budgets.items()} \
+        if isinstance(budgets, dict) else {}
+
+
+# -- CLI (driven from analysis/__main__.py) ----------------------------------
+
+def run_cli(args) -> int:
+    """The ``--jaxpr`` pass behind the graftlint CLI.  Mirrors the AST
+    pass's contract: exit 0 clean/baselined, 1 new gating findings, 2
+    internal errors (a trace crash must never masquerade as clean OR as
+    a finding)."""
+    import sys
+
+    from .core import gating, load_baseline, new_findings, to_sarif
+    from . import surface
+
+    surface.ensure_analysis_env()
+
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"graftlint: cannot load baseline: {e}",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        report = surface.trace_surface()
+    except Exception as e:
+        print(f"graftlint: internal error tracing surface: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    if report.errors:
+        for err in report.errors:
+            print(f"graftlint: internal error: {err}", file=sys.stderr)
+        return 2
+
+    overrides = getattr(args, "severity_map", None) or {}
+    disabled = getattr(args, "jaxpr_disable", None) or []
+    budgets = load_budgets(baseline)
+    if args.write_baseline:
+        # budgets pin at current values, so findings are computed with
+        # the NEW budgets (a freshly written baseline is always clean)
+        budgets = {st.name: int(st.temp_bytes) for st in report.steps}
+    findings = lint_report(report, budgets,
+                           severity_overrides=overrides,
+                           disabled=disabled)
+
+    if args.write_baseline:
+        doc = make_jaxpr_baseline(findings, report)
+        Path(args.write_baseline).write_text(
+            json.dumps(doc, indent=1) + "\n", encoding="utf-8")
+        print(f"graftlint: wrote {len(findings)} entries and "
+              f"{len(doc['budgets'])} budgets to {args.write_baseline}")
+        return 0
+
+    fresh = new_findings(findings, baseline)
+    gate = gating(fresh)
+
+    if args.fmt == "sarif":
+        print(json.dumps(to_sarif(fresh, JAXPR_RULES), indent=1))
+    elif args.fmt == "json":
+        print(json.dumps({
+            "version": 1,
+            "traced_steps": report.step_names(),
+            "signatures": [s.program_key for s in report.signatures],
+            "findings": [f.to_json() for f in findings],
+            "new": [f.to_json() for f in fresh],
+            "summary": {
+                "steps": len(report.steps),
+                "total": len(findings),
+                "baselined": len(findings) - len(fresh),
+                "new": len(fresh),
+                "gating": len(gate),
+            },
+        }, indent=1))
+    else:
+        for f in fresh:
+            tag = "" if f.severity != Severity.INFO else " (non-gating)"
+            print(f.render() + tag)
+        known = len(findings) - len(fresh)
+        print(f"graftlint --jaxpr: {len(report.steps)} steps traced, "
+              f"{len(findings)} finding(s), {known} baselined, "
+              f"{len(fresh)} new, {len(gate)} gating")
+    return 1 if gate else 0

@@ -1,0 +1,254 @@
+"""graftlint v3 selftest: trace-rule fixtures + real-surface coverage.
+
+Mirrors :mod:`.selftest` for the jaxpr pass, in two stages:
+
+1. **Synthetic fixtures** (seconds): tiny jit functions seeded with
+   each defect class — donation defeated by input forwarding, an
+   injected debug callback, an f32 upcast+broadcast on a u8 plane, a
+   temp-bytes budget overrun, a plan-vs-factory name mismatch — driven
+   through the REAL :func:`..analysis.surface.trace_step` +
+   :func:`..analysis.jaxpr_lint.lint_report`.  Positive must fire
+   exactly its rule; negative must stay clean.
+
+2. **Surface coverage** (CI minutes, skipped by ``--fast``): trace the
+   full analysis lattice and assert every registered step factory was
+   actually reached — stripes{N}, band/roi variants, multi-seat, 444 —
+   that plan-predicted names equal factory-built names, and that every
+   donating step's donated args all alias in the compiled executable.
+   This is the "the gate itself covers the surface" check: a refactor
+   that silently drops a factory from the enumeration fails HERE, not
+   on relay day.
+
+Needs jax (CPU backend is enough); the CLI entry point sets the env
+knobs (forced donation, 8 host devices) before jax initialises.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable
+
+__all__ = ["run_jaxpr_selftest"]
+
+#: substrings that must appear in the traced-step name set — one per
+#: variant axis the analyzer exists to cover
+_COVERAGE_MARKS = ("jpeg.step[", "@444", "h264.i_step", "h264.p_step",
+                   "h264.row_probe", "h264.band", "+roi6",
+                   "h264.stripes2.", "seats2_")
+
+#: floor for distinct traced programs (the pinned lattice yields 16;
+#: a floor, not an equality, so adding variants never breaks selftest)
+_MIN_STEPS = 15
+#: floor for steps that donate at least one argument
+_MIN_DONATING = 8
+
+
+def _rules_fired(findings) -> set:
+    return {f.rule_id for f in findings}
+
+
+def _fixture_checks(failures: list) -> int:
+    """Stage 1: synthetic per-rule fixtures. -> number of checks run."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from . import surface
+    from .jaxpr_lint import lint_report
+    from .surface import SignatureTrace, SurfaceReport
+
+    aval = jax.ShapeDtypeStruct((64, 64), jnp.uint8)
+    checks = 0
+
+    def trace_one(fn: Callable, *avals, name: str):
+        return surface.trace_step(fn, avals, name=name)
+
+    def expect(tag: str, findings, rule: str, should_fire: bool):
+        nonlocal checks
+        checks += 1
+        fired = _rules_fired(findings)
+        if should_fire and rule not in fired:
+            failures.append(f"{tag}: {rule} did not fire "
+                            f"(got: {sorted(fired) or 'nothing'})")
+        if not should_fire and rule in fired:
+            failures.append(f"{tag}: {rule} fired on the negative "
+                            "fixture")
+
+    # -- JAXPR-DONATION-ALIAS: forwarding defeats donation ------------------
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def fwd_step(state, delta):
+        # state forwarded verbatim: the PR-10 class
+        return state, jnp.bitwise_xor(delta, jnp.uint8(1))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def materialized_step(state, delta):
+        return jnp.bitwise_xor(state, delta), delta
+
+    st = trace_one(fwd_step, aval, aval, name="fixture.fwd")
+    expect("donation/forwarded", lint_report(
+        _wrap(st), {"fixture.fwd": st.temp_bytes}),
+        "JAXPR-DONATION-ALIAS", True)
+    st = trace_one(materialized_step, aval, aval, name="fixture.mat")
+    expect("donation/materialized", lint_report(
+        _wrap(st), {"fixture.mat": st.temp_bytes}),
+        "JAXPR-DONATION-ALIAS", False)
+
+    # donated arg the program never reads: jit prunes it at lowering,
+    # so the donation invalidates a buffer while reusing nothing (the
+    # band-step prev/roi regression class)
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def unused_donation_step(state, delta):
+        return jnp.bitwise_xor(delta, jnp.uint8(1)), delta
+
+    st = trace_one(unused_donation_step, aval, aval, name="fixture.unused")
+    expect("donation/unused-pruned", lint_report(
+        _wrap(st), {"fixture.unused": st.temp_bytes}),
+        "JAXPR-DONATION-ALIAS", True)
+    checks += 1
+    if 0 not in st.dropped:
+        failures.append("donation/unused-pruned: arg 0 not reported "
+                        f"as dropped (dropped={st.dropped})")
+
+    # -- JAXPR-HOST-CALLBACK -------------------------------------------------
+    @jax.jit
+    def cb_step(x):
+        jax.debug.print("sum={s}", s=x.sum())
+        return x + jnp.uint8(1)
+
+    @jax.jit
+    def pure_step(x):
+        return x + jnp.uint8(1)
+
+    st = trace_one(cb_step, aval, name="fixture.cb")
+    expect("callback/injected", lint_report(
+        _wrap(st), {"fixture.cb": st.temp_bytes}),
+        "JAXPR-HOST-CALLBACK", True)
+    st = trace_one(pure_step, aval, name="fixture.pure")
+    expect("callback/clean", lint_report(
+        _wrap(st), {"fixture.pure": st.temp_bytes}),
+        "JAXPR-HOST-CALLBACK", False)
+
+    # -- JAXPR-DTYPE-DRIFT: f32 upcast+broadcast on a u8 plane ---------------
+    @jax.jit
+    def drift_step(x):
+        f = x.astype(jnp.float32)[:, :, None] * jnp.ones(
+            (1, 1, 32), jnp.float32)
+        return f.sum(axis=-1).astype(jnp.uint8)
+
+    st = trace_one(drift_step, aval, name="fixture.drift")
+    expect("drift/upcast", lint_report(
+        _wrap(st), {"fixture.drift": st.temp_bytes}),
+        "JAXPR-DTYPE-DRIFT", True)
+    st_pure = trace_one(pure_step, aval, name="fixture.pure")
+    expect("drift/clean", lint_report(
+        _wrap(st_pure), {"fixture.pure": st_pure.temp_bytes}),
+        "JAXPR-DTYPE-DRIFT", False)
+
+    # -- JAXPR-TEMP-BYTES ----------------------------------------------------
+    expect("temp/over-budget", lint_report(_wrap(st), {"fixture.drift": 1}),
+           "JAXPR-TEMP-BYTES", st.temp_bytes > 1.1)
+    expect("temp/at-budget", lint_report(
+        _wrap(st), {"fixture.drift": st.temp_bytes}),
+        "JAXPR-TEMP-BYTES", False)
+    expect("temp/unbudgeted", lint_report(_wrap(st), {}),
+           "JAXPR-TEMP-BYTES", True)
+
+    # -- LATTICE-COMPLETENESS ------------------------------------------------
+    bad = SurfaceReport(signatures=[SignatureTrace(
+        program_key="256x128/h264/k1",
+        predicted=("h264.i_step[256x128]", "h264.band4.p_step[256x128]"),
+        built=("h264.i_step[256x128]",
+               "h264.band4.p_step[256x128+roi6]"),
+        lattice_key="256x128/h264/other", unreachable=None)])
+    expect("lattice/mismatch", lint_report(bad), "LATTICE-COMPLETENESS",
+           True)
+    good = SurfaceReport(signatures=[SignatureTrace(
+        program_key="256x128/h264/k1",
+        predicted=("h264.i_step[256x128]",),
+        built=("h264.i_step[256x128]",),
+        lattice_key="256x128/h264/k1", unreachable=None)])
+    expect("lattice/clean", lint_report(good), "LATTICE-COMPLETENESS",
+           False)
+    return checks
+
+
+def _wrap(traced_step):
+    """A one-step SurfaceReport for fixture linting."""
+    from .surface import SurfaceReport
+    return SurfaceReport(steps=[traced_step])
+
+
+def _coverage_checks(failures: list) -> int:
+    """Stage 2: the real surface.  Coverage, name agreement, donation
+    aliasing — the acceptance invariants the CI job stands on."""
+    from . import surface
+
+    checks = 0
+    report = surface.trace_surface()
+
+    checks += 1
+    for err in report.errors:
+        failures.append(f"surface: {err}")
+
+    names = set(report.step_names())
+    checks += 1
+    if len(names) < _MIN_STEPS:
+        failures.append(f"coverage: only {len(names)} steps traced "
+                        f"(want >= {_MIN_STEPS}): {sorted(names)}")
+    for mark in _COVERAGE_MARKS:
+        checks += 1
+        if not any(mark in n for n in names):
+            failures.append(f"coverage: no traced step matches "
+                            f"'{mark}'")
+
+    for sig_trace in report.signatures:
+        checks += 1
+        if set(sig_trace.predicted) != set(sig_trace.built):
+            failures.append(
+                f"{sig_trace.program_key}: plan predicts "
+                f"{sorted(set(sig_trace.predicted) - set(sig_trace.built))} "
+                f"unbuilt / factories build "
+                f"{sorted(set(sig_trace.built) - set(sig_trace.predicted))} "
+                "unpredicted")
+        checks += 1
+        if sig_trace.lattice_key is not None and \
+                sig_trace.lattice_key != sig_trace.program_key:
+            failures.append(
+                f"{sig_trace.program_key}: lattice round-trip gave "
+                f"{sig_trace.lattice_key}")
+
+    donating = [st for st in report.steps if any(st.donated)]
+    checks += 1
+    if len(donating) < _MIN_DONATING:
+        failures.append(f"coverage: only {len(donating)} donating "
+                        f"steps traced (want >= {_MIN_DONATING})")
+    for st in donating:
+        checks += 1
+        missing = [i for i, d in enumerate(st.donated)
+                   if d and i not in set(st.aliased)]
+        if missing:
+            failures.append(
+                f"{st.name}: donated args {missing} not in the "
+                "compiled alias map")
+    return checks
+
+
+def run_jaxpr_selftest(argv=None) -> int:
+    argv = list(argv or [])
+    as_json = "--json" in argv
+    fast = "--fast" in argv
+    failures: list = []
+    checks = _fixture_checks(failures)
+    if not fast:
+        checks += _coverage_checks(failures)
+    if as_json:
+        print(json.dumps({"checks": checks, "failures": failures,
+                          "fast": fast, "ok": not failures}, indent=1))
+    else:
+        for f in failures:
+            print(f"jaxpr-selftest FAIL: {f}")
+        print(f"graftlint jaxpr-selftest: {checks} checks, "
+              f"{len(failures)} failure(s)"
+              + (" (--fast: surface skipped)" if fast else ""))
+    return 1 if failures else 0

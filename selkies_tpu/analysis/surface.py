@@ -1,0 +1,344 @@
+"""graftlint v3 trace surface: abstract-eval every registered step
+factory and record what XLA actually built.
+
+The AST pass (v1/v2) sees source conventions; the costliest recent
+defects were invisible to it because they live in the *compile surface*:
+a warm that lands on a program no runtime gate ever asks for (PR 15
+round 2), an unsharded probe poisoning a sharded step into permanent
+jit fallback (PR 15 round 3), jaxpr input forwarding silently defeating
+donation (PR 10).  This module enumerates a pinned analysis lattice of
+signatures covering every variant axis (codec, subsampling, seats,
+stripes, bands, roi bias), builds each signature's steps through
+``prewarm.plan.step_specs`` — the SAME ``functools``-cached factories
+live sessions and prewarm use — and AOT-lowers/compiles them over
+``ShapeDtypeStruct`` avals.  Nothing executes; the products are plain
+records (:class:`TracedStep`, :class:`SignatureTrace`) that
+:mod:`.jaxpr_lint` turns into findings.
+
+Backend notes: the pass is designed to run on the CPU backend in CI.
+Donation is backend-gated off on cpu (``donate_argnums_for_backend``),
+so :func:`ensure_analysis_env` sets ``SELKIES_FORCE_DONATION=1`` to
+trace the TPU-shaped donation surface, and forces an 8-device host
+platform so the seats/stripes meshes build.  Empirically (jax 0.4.37)
+the CPU ``Compiled.as_text()`` header carries the same
+``input_output_alias`` map a TPU build would, which is what makes
+JAXPR-DONATION-ALIAS checkable without a chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+import types
+from typing import Iterable, Optional
+
+logger = logging.getLogger("selkies_tpu.analysis.surface")
+
+__all__ = ["ANALYSIS_GEOMETRY", "TracedStep", "SignatureTrace",
+           "SurfaceReport", "analysis_signatures", "ensure_analysis_env",
+           "trace_step", "trace_surface"]
+
+#: pinned analysis geometry: small enough to compile the whole surface
+#: in CI minutes, large enough to be non-degenerate on every axis
+#: (2 stripes -> a viable stripes2 mesh and 2 band buckets)
+ANALYSIS_GEOMETRY = (256, 128)
+
+#: host callbacks that stall a hot step on the python interpreter
+CALLBACK_PRIMITIVES = {"pure_callback", "io_callback", "debug_callback",
+                       "callback", "debug_print"}
+
+#: how many float intermediates to keep per step (largest first)
+_TOP_FLOAT_TEMPS = 5
+
+
+def ensure_analysis_env() -> None:
+    """Environment the jaxpr pass needs, set BEFORE jax initialises its
+    backend: force donation through the backend gate (cpu would trace a
+    donation-free surface and DONATION-ALIAS would vacuously pass) and
+    force enough host-platform devices for the seats/stripes meshes.
+    Harmless on a TPU host: the flag only shapes the cpu *host*
+    platform, and donation is already on for tpu."""
+    os.environ["SELKIES_FORCE_DONATION"] = "1"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+@dataclasses.dataclass(frozen=True)
+class TracedStep:
+    """One compiled step program, reduced to the facts the rules need."""
+    name: str                   # obs.perf registry name (wrap_step stamp)
+    program_key: str            # owning signature's compile identity
+    n_eqns: int
+    donated: tuple              # bool per flat argument
+    aliased: tuple              # flat-arg indices in the compiled alias map
+    forwarded: tuple            # flat-arg indices forwarded verbatim out
+    dropped: tuple              # flat-arg indices pruned at lowering
+    callbacks: tuple            # host-callback primitive names present
+    float_temps: tuple          # (bytes, dtype, shape, primitive) desc
+    has_f64: bool
+    int_plane: bool             # largest input is an integer plane
+    max_input_bytes: int
+    arg_bytes: int
+    temp_bytes: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SignatureTrace:
+    """Per-signature cross-check record for LATTICE-COMPLETENESS."""
+    program_key: str
+    predicted: tuple            # plan.program_names(sig)
+    built: tuple                # factory-stamped names actually built
+    lattice_key: Optional[str]  # program_key after a settings round-trip
+    unreachable: Optional[str]  # host cannot realise the parallelism
+
+
+@dataclasses.dataclass
+class SurfaceReport:
+    steps: list = dataclasses.field(default_factory=list)
+    signatures: list = dataclasses.field(default_factory=list)
+    errors: list = dataclasses.field(default_factory=list)
+
+    def step_names(self) -> list:
+        return [s.name for s in self.steps]
+
+
+def analysis_signatures() -> list:
+    """The pinned analysis lattice: one signature per variant axis the
+    engine can dispatch (single-seat jpeg/h264, 444, partial bands, roi
+    bias, sharded stripes, multi-seat).  roi_qp_bias deliberately
+    differs from the default (6 vs 4) so a bias that fails to propagate
+    into the program name — the PR-15 round-2 bug — cannot hide."""
+    from ..prewarm.lattice import Signature
+    w, h = ANALYSIS_GEOMETRY
+    return [
+        Signature(w, h, "jpeg"),
+        Signature(w, h, "jpeg", fullcolor=True),
+        Signature(w, h, "jpeg", seats=2),
+        Signature(w, h, "h264"),
+        Signature(w, h, "h264", partial_encode=True),
+        Signature(w, h, "h264", partial_encode=True,
+                  roi_qp=True, roi_qp_bias=6),
+        Signature(w, h, "h264", fullcolor=True),
+        Signature(w, h, "h264", stripe_devices=2),
+        Signature(w, h, "h264", seats=2),
+    ]
+
+
+# -- compiled-artifact inspection --------------------------------------------
+
+#: one alias-map entry: ``{out_idx}: (param, {}, may-alias)`` — findall
+#: because entries nest braces, so a lazy ``\{(.*?)\}`` truncates
+_ALIAS_ENTRY = re.compile(
+    r"\{[0-9, ]*\}:\s*\((\d+),\s*\{\s*\},\s*(?:may|must)-alias\)")
+
+
+def _aliased_params(hlo_text: str) -> tuple:
+    """Param indices present in the HloModule header's
+    ``input_output_alias`` map (empty when the header has none)."""
+    for line in hlo_text.splitlines():
+        if "input_output_alias=" in line:
+            seg = line.split("input_output_alias=", 1)[1]
+            return tuple(sorted({int(m.group(1))
+                                 for m in _ALIAS_ENTRY.finditer(seg)}))
+    return ()
+
+
+def _collect_arg_infos(obj, out: list) -> None:
+    """Flatten ``Lowered.args_info`` (nested tuples of ArgInfo)."""
+    if hasattr(obj, "donated"):
+        out.append(obj)
+    elif isinstance(obj, (list, tuple)):
+        for item in obj:
+            _collect_arg_infos(item, out)
+    elif isinstance(obj, dict):
+        for item in obj.values():
+            _collect_arg_infos(item, out)
+
+
+def _iter_eqns(jaxpr):
+    """Every equation, recursing into sub-jaxprs (cond branches, scan
+    bodies, pjit calls) — a callback hidden inside a scan is still a
+    callback on the hot path."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            yield from _iter_sub(val)
+
+
+def _iter_sub(val):
+    inner = getattr(val, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        yield from _iter_eqns(inner)
+    elif hasattr(val, "eqns"):
+        yield from _iter_eqns(val)
+    elif isinstance(val, (list, tuple)):
+        for item in val:
+            yield from _iter_sub(item)
+
+
+def _aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * int(getattr(dtype, "itemsize", 1))
+
+
+def trace_step(step, args, *, name: Optional[str] = None,
+               program_key: str = "") -> TracedStep:
+    """Lower + AOT-compile + trace one step over avals (nothing
+    executes) and reduce the artifacts to a :class:`TracedStep`.
+    ``step`` may be an ``obs.perf._WrappedStep`` (unwrapped to its jit
+    product) or a plain ``jax.jit`` callable (selftest fixtures)."""
+    jitted = getattr(step, "_jitted", step)
+    if name is None:
+        name = getattr(step, "name", None) or getattr(
+            jitted, "__name__", "step")
+
+    lowered = jitted.lower(*args)
+    infos: list = []
+    _collect_arg_infos(lowered.args_info, infos)
+    donated = tuple(bool(getattr(i, "donated", False)) for i in infos)
+
+    # jit prunes unused args at lowering (keep_unused=False), so the
+    # compiled module's param numbering is the KEPT subset — alias-map
+    # indices must be mapped back through kept_var_idx or every index
+    # after a pruned arg points at the wrong argument
+    compile_args = getattr(lowered._lowering, "compile_args", None) or {}
+    kept = sorted(compile_args.get("kept_var_idx", range(len(infos))))
+    dropped = tuple(i for i in range(len(infos)) if i not in set(kept))
+
+    compiled = lowered.compile()
+    aliased = tuple(sorted(kept[p] for p in _aliased_params(
+        compiled.as_text()) if p < len(kept)))
+    mem = None
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        pass
+    temp_bytes = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
+    arg_bytes = int(getattr(mem, "argument_size_in_bytes", 0) or 0)
+
+    closed = jitted.trace(*args).jaxpr
+    jxp = closed.jaxpr
+    out_ids = {id(v) for v in jxp.outvars}
+    forwarded = tuple(i for i, v in enumerate(jxp.invars)
+                      if id(v) in out_ids)
+
+    callbacks: list = []
+    float_temps: list = []
+    has_f64 = False
+    for eqn in _iter_eqns(jxp):
+        prim = getattr(eqn.primitive, "name", str(eqn.primitive))
+        if prim in CALLBACK_PRIMITIVES:
+            callbacks.append(prim)
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            dtype = getattr(aval, "dtype", None)
+            kind = getattr(dtype, "kind", "")
+            if kind != "f":
+                continue
+            nbytes = _aval_bytes(aval)
+            if getattr(dtype, "itemsize", 0) >= 8:
+                has_f64 = True
+            float_temps.append((nbytes, str(dtype),
+                                "x".join(map(str, aval.shape)), prim))
+    float_temps.sort(reverse=True)
+
+    input_bytes = [_aval_bytes(getattr(v, "aval", None))
+                   for v in jxp.invars]
+    max_input = max(input_bytes) if input_bytes else 0
+    int_plane = True
+    if input_bytes:
+        top = jxp.invars[input_bytes.index(max_input)]
+        kind = getattr(getattr(top.aval, "dtype", None), "kind", "")
+        int_plane = kind in ("u", "i", "b")
+
+    return TracedStep(
+        name=name, program_key=program_key, n_eqns=len(jxp.eqns),
+        donated=donated, aliased=aliased, forwarded=forwarded,
+        dropped=dropped,
+        callbacks=tuple(sorted(set(callbacks))),
+        float_temps=tuple(float_temps[:_TOP_FLOAT_TEMPS]),
+        has_f64=has_f64, int_plane=int_plane,
+        max_input_bytes=max_input, arg_bytes=arg_bytes,
+        temp_bytes=temp_bytes)
+
+
+# -- lattice round-trip ------------------------------------------------------
+
+def _lattice_roundtrip_key(sig) -> Optional[str]:
+    """Feed the signature's knobs back through the runtime enumeration
+    entry point (``lattice_from_settings``) and return the base
+    program_key it produces.  A mismatch means a dispatchable axis the
+    enumeration drops or mangles — the exact PR-15 bug class."""
+    from ..prewarm.lattice import lattice_from_settings
+    ns = types.SimpleNamespace(
+        initial_width=sig.width, initial_height=sig.height,
+        encoder=("jpeg-tpu" if sig.codec == "jpeg" else
+                 ("h264-tpu" if sig.single_stream else "h264-tpu-ws")),
+        tpu_seats=sig.seats, tpu_stripe_devices=sig.stripe_devices,
+        fullcolor=sig.fullcolor, stripe_height=sig.stripe_height,
+        use_damage_gating=sig.use_damage_gating,
+        use_paint_over=sig.use_paint_over,
+        paint_over_delay_frames=sig.paint_over_delay_frames,
+        h264_motion_vrange=sig.h264_motion_vrange,
+        h264_motion_hrange=sig.h264_motion_hrange,
+        h264_partial_encode=sig.partial_encode,
+        h264_roi_qp=sig.roi_qp, h264_roi_qp_bias=sig.roi_qp_bias)
+    try:
+        return lattice_from_settings(ns).base.program_key
+    except Exception as e:
+        logger.warning("lattice round-trip failed for %s: %s",
+                       sig.program_key, e)
+        return None
+
+
+# -- the full surface --------------------------------------------------------
+
+def trace_surface(sigs: Optional[Iterable] = None) -> SurfaceReport:
+    """Trace every step program behind the analysis lattice.  Steps are
+    deduped by registry name (the factories are ``functools``-cached, so
+    a name seen twice IS the same program).  Per-step failures are
+    collected into ``report.errors`` — the CLI reports them as internal
+    errors (exit 2), distinct from findings."""
+    from ..prewarm import plan
+    report = SurfaceReport()
+    seen: set = set()
+    if sigs is None:
+        sigs = analysis_signatures()
+    for sig in sigs:
+        key = sig.program_key
+        try:
+            specs, meta = plan._step_specs(sig)
+            predicted = tuple(plan.program_names(sig))
+        except Exception as e:
+            report.errors.append(
+                f"{key}: step enumeration failed: "
+                f"{type(e).__name__}: {e}")
+            continue
+        built = tuple(s.name for s, _ in specs)
+        report.signatures.append(SignatureTrace(
+            program_key=key, predicted=predicted, built=built,
+            lattice_key=_lattice_roundtrip_key(sig),
+            unreachable=meta.get("unreachable")))
+        for step, args in specs:
+            sname = getattr(step, "name", "?")
+            if sname in seen:
+                continue
+            seen.add(sname)
+            try:
+                report.steps.append(
+                    trace_step(step, args, program_key=key))
+            except Exception as e:
+                report.errors.append(
+                    f"{key}: trace of {sname} failed: "
+                    f"{type(e).__name__}: {e}")
+    return report

@@ -55,7 +55,16 @@ def donate_argnums_for_backend(nums: tuple) -> tuple:
     measured: a donated step call blocks for the full compute while the
     undonated call returns in ~0.1 ms — which would serialize the deep
     pipeline the donation is meant to serve. Donate only where HBM
-    exists."""
+    exists.
+
+    ``SELKIES_FORCE_DONATION=1`` overrides the backend gate: the jaxpr
+    analyzer (selkies_tpu/analysis/surface.py) traces the TPU-shaped
+    donation surface on a CPU CI box to verify the declared donations
+    actually alias in the compiled executable. Analysis-only — a CPU
+    server must never set it (synchronous dispatch, see above)."""
+    import os
+    if os.environ.get("SELKIES_FORCE_DONATION") == "1":
+        return nums
     import jax
     return nums if jax.default_backend() != "cpu" else ()
 

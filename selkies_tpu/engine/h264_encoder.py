@@ -372,12 +372,16 @@ def _jitted_h264_band_step(width: int, stripe_h: int, n_stripes: int,
                                    e_cap, w_cap, out_cap, candidates,
                                    fullcolor=fullcolor, roi_qp=roi_qp)
     from .encoder import donate_argnums_for_backend
+    # prev (arg 1) is only read by the ROI-QP dirty-mask path; without
+    # roi the program prunes it, so donating it would invalidate the
+    # session's buffer while reusing nothing (JAXPR-DONATION-ALIAS)
+    donate = (1, 2, 3, 4, 5, 6) if (roi_qp and not fullcolor) \
+        else (2, 3, 4, 5, 6)
     return _perf.wrap_step(
         f"h264.band{band_rows}.p_step[{width}x{stripe_h * n_stripes}"
         f"{'@444' if fullcolor else ''}"
         f"{f'+roi{roi_qp}' if roi_qp else ''}]",
-        jax.jit(step, donate_argnums=donate_argnums_for_backend(
-            (1, 2, 3, 4, 5, 6))))
+        jax.jit(step, donate_argnums=donate_argnums_for_backend(donate)))
 
 
 class H264EncoderSession:

@@ -107,8 +107,50 @@ class PerfRegistry:
     def __init__(self, max_steps: Optional[int] = None):
         self._lock = threading.Lock()
         self._steps: dict[str, dict] = {}
+        self._fallbacks: dict[str, dict] = {}
         if max_steps is not None:
             self.max_steps = int(max_steps)
+
+    def note_fallback(self, name: str, reason: str,
+                      signature: Optional[str] = None) -> dict:
+        """A wrapped step permanently fell back to plain jit dispatch
+        for one signature — the PR-15 round-3 poisoning class.  Beyond
+        the log line, surface it where operators look: a
+        ``wrapped_step_fallback`` flight-recorder incident (visible in
+        ``/api/health``) and the ``selkies_perf_step_fallbacks_total``
+        counter.  Lazy + guarded: observability of the fallback must
+        never be able to break the fallback."""
+        with self._lock:
+            e = self._fallbacks.setdefault(
+                name, {"step": name, "count": 0})
+            e["count"] += 1
+            e["reason"] = reason
+            e["signature"] = signature
+            e["last_at"] = time.time()
+            while len(self._fallbacks) > self.max_steps:
+                oldest = min(self._fallbacks,
+                             key=lambda k: self._fallbacks[k]["last_at"])
+                if oldest == name:
+                    break
+                del self._fallbacks[oldest]
+        try:
+            from ..server import metrics
+            metrics.describe(
+                "selkies_perf_step_fallbacks_total",
+                "Wrapped-step permanent fallbacks to plain jit "
+                "dispatch (per occurrence)")
+            metrics.inc_counter("selkies_perf_step_fallbacks_total")
+        except Exception:
+            pass
+        try:
+            from .health import engine as _engine
+            _engine.recorder.record(
+                "wrapped_step_fallback", step=name, reason=reason,
+                signature=signature)
+        except Exception:
+            logger.debug("fallback incident record failed",
+                         exc_info=True)
+        return e
 
     def record_analysis(self, name: str, cost: Any = None,
                         memory: Any = None, *,
@@ -170,18 +212,24 @@ class PerfRegistry:
     def clear(self) -> None:
         with self._lock:
             self._steps.clear()
+            self._fallbacks.clear()
 
     def report(self) -> dict:
         """``/api/perf`` / bench ``perf`` block payload: every recorded
         step, bandwidth-heaviest first, plus the roofline assumptions so
-        a reader can re-derive the numbers."""
+        a reader can re-derive the numbers — and any permanent
+        jit-dispatch fallbacks (a step listed there is running without
+        its AOT executable: investigate before trusting its numbers)."""
         with self._lock:
             steps = sorted(self._steps.values(),
                            key=lambda e: -e["bytes_accessed"])
+            fallbacks = sorted(self._fallbacks.values(),
+                               key=lambda e: -e["count"])
         return {
             "hbm_gbps": HBM_GBPS,
             "steps": steps,
             "count": len(steps),
+            "fallbacks": fallbacks,
         }
 
 
@@ -283,6 +331,8 @@ class _WrappedStep:
             logger.exception("perf-instrumented step %s failed; "
                              "falling back to jit dispatch", self.name)
             self._cache_put(key, self._FALLBACK)
+            self._registry.note_fallback(self.name, "execute_failed",
+                                         _sig_str(key))
             for a in args:
                 deleted = getattr(a, "is_deleted", None)
                 if callable(deleted) and deleted():
@@ -359,6 +409,8 @@ class _WrappedStep:
                     self.name, signature=_sig_str(key),
                     error=f"{type(e).__name__}: {e}"[:200])
                 self._cache_set_locked(key, self._FALLBACK)
+                self._registry.note_fallback(
+                    self.name, "compile_failed", _sig_str(key))
                 return self._FALLBACK
 
 

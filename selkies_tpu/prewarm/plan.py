@@ -32,7 +32,8 @@ from .lattice import Signature
 
 logger = logging.getLogger("selkies_tpu.prewarm.plan")
 
-__all__ = ["capture_settings_for", "program_names", "warm_signature"]
+__all__ = ["capture_settings_for", "program_names", "step_specs",
+           "warm_signature"]
 
 #: seat-program keys already AOT-compiled this process (their wrapped
 #: steps are per-encoder-instance, so without this a re-warm would
@@ -130,7 +131,7 @@ def _aval(shape, dtype):
     return jax.ShapeDtypeStruct(tuple(shape), dtype)
 
 
-def _warm_jpeg(sig: Signature) -> list:
+def _specs_jpeg(sig: Signature) -> list:
     import jax.numpy as jnp
 
     from ..engine import encoder as _enc
@@ -145,9 +146,7 @@ def _warm_jpeg(sig: Signature) -> list:
     frame = _aval((g.height, g.width, 3), jnp.uint8)
     age = _aval((g.n_stripes,), jnp.int32)
     qt = _aval((64,), jnp.float32)
-    if not step.warm((frame, frame, age, qt, qt, qt, qt)):
-        raise RuntimeError("jpeg step warm failed (see obs.perf log)")
-    return [step.name]
+    return [(step, (frame, frame, age, qt, qt, qt, qt))]
 
 
 def _h264_headers(g, n_stripes: int):
@@ -166,7 +165,7 @@ def _h264_headers(g, n_stripes: int):
             jnp.asarray(np.tile(pnb, (n_stripes, 1))))
 
 
-def _warm_h264(sig: Signature) -> list:
+def _specs_h264(sig: Signature) -> list:
     import jax.numpy as jnp
 
     from ..engine import h264_encoder as _h
@@ -186,7 +185,7 @@ def _warm_h264(sig: Signature) -> list:
             g, g.n_stripes)
         qp = jnp.int32(0)
         force = jnp.asarray(True)
-    names = []
+    specs = []
     for mode in ("i", "p"):
         cands = scroll_candidates(vr, hr) if (mode == "p" and vr) \
             else ((0, 0),)
@@ -197,21 +196,18 @@ def _warm_h264(sig: Signature) -> list:
             fullcolor=sig.fullcolor)
         pay, nb = (hdr_pay, hdr_nb) if mode == "i" \
             else (p_hdr_pay, p_hdr_nb)
-        if not step.warm((frame, frame, svec, svec, svec,
-                          ref_y, ref_c, ref_c, qp, qp, force, pay, nb)):
-            raise RuntimeError(f"h264 {mode} step warm failed "
-                               "(see obs.perf log)")
-        names.append(step.name)
-    names += _warm_h264_bands(sig, g, e_cap, w_cap, out_cap,
-                              p_hdr_pay, p_hdr_nb)
-    return names
+        specs.append((step, (frame, frame, svec, svec, svec,
+                             ref_y, ref_c, ref_c, qp, qp, force, pay, nb)))
+    specs += _specs_h264_bands(sig, g, e_cap, w_cap, out_cap,
+                               p_hdr_pay, p_hdr_nb)
+    return specs
 
 
-def _warm_h264_bands(sig: Signature, g, e_cap: int, w_cap: int,
-                     out_cap: int, p_hdr_pay, p_hdr_nb) -> list:
-    """AOT-compile the partial path's band-bucket family + row probe
-    (ROADMAP 4) — the programs a partial-encode session can dispatch at
-    runtime as the damage geometry moves between buckets."""
+def _specs_h264_bands(sig: Signature, g, e_cap: int, w_cap: int,
+                      out_cap: int, p_hdr_pay, p_hdr_nb) -> list:
+    """The partial path's band-bucket family + row probe (ROADMAP 4) —
+    the programs a partial-encode session can dispatch at runtime as
+    the damage geometry moves between buckets."""
     buckets = _band_buckets_for(sig, g)
     if not buckets:
         return []
@@ -233,25 +229,21 @@ def _warm_h264_bands(sig: Signature, g, e_cap: int, w_cap: int,
     ref_c = _aval((g.height // cdiv, g.width // cdiv), jnp.uint8)
     row0 = _aval((), jnp.int32)
     probe = _h._jitted_row_damage_probe(g.width, g.height)
-    if not probe.warm((frame, frame)):
-        raise RuntimeError("h264 row probe warm failed (see obs.perf log)")
-    names = [probe.name]
+    specs = [(probe, (frame, frame))]
     for b in buckets:
         qp_rows = _aval((b,), jnp.int32)
         step = _h._jitted_h264_band_step(
             g.width, g.stripe_h, g.n_stripes, b, e_cap, w_cap, out_cap,
             cands, fullcolor=sig.fullcolor, roi_qp=roi)
-        if not step.warm((frame, frame, svec, svec, ref_y, ref_c, ref_c,
-                          qp_rows, sbool, row0, p_hdr_pay, p_hdr_nb)):
-            raise RuntimeError(f"h264 band{b} step warm failed "
-                               "(see obs.perf log)")
-        names.append(step.name)
-    return names
+        specs.append((step, (frame, frame, svec, svec, ref_y, ref_c,
+                             ref_c, qp_rows, sbool, row0,
+                             p_hdr_pay, p_hdr_nb)))
+    return specs
 
 
-def _warm_h264_stripes(sig: Signature, n_dev: int) -> list:
-    """AOT-compile the split-frame sharded i/p steps (ROADMAP 2): same
-    aval surface as the single-device warm, through the SAME
+def _specs_h264_stripes(sig: Signature, n_dev: int) -> list:
+    """The split-frame sharded i/p steps (ROADMAP 2): same aval surface
+    as the single-device warm, through the SAME
     ``_jitted_h264_sharded_step`` factory the live session uses."""
     import jax.numpy as jnp
 
@@ -273,7 +265,7 @@ def _warm_h264_stripes(sig: Signature, n_dev: int) -> list:
             g, g.n_stripes)
         qp = jnp.int32(0)
         force = jnp.asarray(True)
-    names = []
+    specs = []
     for mode in ("i", "p"):
         cands = scroll_candidates(vr, hr) if (mode == "p" and vr) \
             else ((0, 0),)
@@ -284,15 +276,14 @@ def _warm_h264_stripes(sig: Signature, n_dev: int) -> list:
             fullcolor=sig.fullcolor, n_dev=n_dev)
         pay, nb = (hdr_pay, hdr_nb) if mode == "i" \
             else (p_hdr_pay, p_hdr_nb)
-        if not step.warm((frame, frame, svec, svec, svec,
-                          ref_y, ref_c, ref_c, qp, qp, force, pay, nb)):
-            raise RuntimeError(f"h264 sharded {mode} step warm failed "
-                               "(see obs.perf log)")
-        names.append(step.name)
-    return names
+        specs.append((step, (frame, frame, svec, svec, svec,
+                             ref_y, ref_c, ref_c, qp, qp, force,
+                             pay, nb)))
+    return specs
 
 
-def _warm_jpeg_seats(sig: Signature) -> list:
+def _specs_jpeg_seats(sig: Signature) -> list:
+    import jax
     import jax.numpy as jnp
 
     from ..engine.capture import _ENCODE_TURN
@@ -301,15 +292,13 @@ def _warm_jpeg_seats(sig: Signature) -> list:
     with _ENCODE_TURN:      # constructor device_puts: serialize
         enc = MultiSeatEncoder(cs, sig.seats)
     g = enc.grid
-    frames = jnp.ShapeDtypeStruct(
+    frames = jax.ShapeDtypeStruct(
         (sig.seats, g.height, g.width, 3), jnp.uint8,
         sharding=enc.input_sharding)
-    if not enc._step.warm((frames, frames, enc._age, *enc._qt_dev)):
-        raise RuntimeError("multi-seat jpeg step warm failed")
-    return [enc._step.name]
+    return [(enc._step, (frames, frames, enc._age, *enc._qt_dev))]
 
 
-def _warm_h264_seats(sig: Signature) -> list:
+def _specs_h264_seats(sig: Signature) -> list:
     import jax.numpy as jnp
     import numpy as np
     import jax
@@ -323,19 +312,57 @@ def _warm_h264_seats(sig: Signature) -> list:
         qp = jax.device_put(np.zeros((n,), np.int32), enc.input_sharding)
         forces = jax.device_put(np.ones((n,), bool), enc.input_sharding)
     g = enc.grid
-    frames = jnp.ShapeDtypeStruct(
+    frames = jax.ShapeDtypeStruct(
         (n, g.height, g.width, 3), jnp.uint8, sharding=enc.input_sharding)
-    names = []
-    for mode, step, pay, nb in (("i", enc._i_step, enc._hdr_pay,
-                                 enc._hdr_nb),
-                                ("p", enc._p_step, enc._p_hdr_pay,
-                                 enc._p_hdr_nb)):
-        if not step.warm((frames, frames, enc._age, enc._sent, enc._fnum,
-                          enc._ref_y, enc._ref_u, enc._ref_v,
-                          qp, qp, forces, pay, nb)):
-            raise RuntimeError(f"multi-seat h264 {mode} step warm failed")
-        names.append(step.name)
-    return names
+    specs = []
+    for step, pay, nb in ((enc._i_step, enc._hdr_pay, enc._hdr_nb),
+                          (enc._p_step, enc._p_hdr_pay, enc._p_hdr_nb)):
+        specs.append((step, (frames, frames, enc._age, enc._sent,
+                             enc._fnum, enc._ref_y, enc._ref_u,
+                             enc._ref_v, qp, qp, forces, pay, nb)))
+    return specs
+
+
+def _step_specs(sig: Signature) -> tuple:
+    """-> ``(specs, meta)``: every ``(wrapped_step, trace_args)`` pair
+    behind ``sig``, built through the SAME factories the live sessions
+    use. ``meta`` carries an ``unreachable`` note when the signature's
+    requested device parallelism cannot be realised on this host (the
+    worker reports those points distinctly from failures)."""
+    meta: dict = {}
+    if sig.seats > 1:
+        specs = _specs_jpeg_seats(sig) if sig.codec == "jpeg" \
+            else _specs_h264_seats(sig)
+        return specs, meta
+    if sig.codec != "jpeg" and getattr(sig, "stripe_devices", 1) > 1:
+        from ..engine.h264_encoder import plan_h264_grid
+        from ..parallel.stripes import resolved_stripe_devices
+        g = plan_h264_grid(capture_settings_for(sig))
+        n = resolved_stripe_devices(g.n_stripes, sig.stripe_devices)
+        if n > 1:
+            if n != sig.stripe_devices:
+                meta["unreachable"] = (
+                    f"stripe_devices={sig.stripe_devices} resolves to "
+                    f"{n} on this host; stripes{n} programs warm "
+                    "instead")
+            return _specs_h264_stripes(sig, n), meta
+        # degraded all the way to one device: the plain program IS the
+        # operating point — fall through to the single-device specs
+        meta["unreachable"] = (
+            f"stripe_devices={sig.stripe_devices} resolves to 1 on "
+            "this host; single-device programs warm instead")
+    specs = _specs_jpeg(sig) if sig.codec == "jpeg" else _specs_h264(sig)
+    return specs, meta
+
+
+def step_specs(sig: Signature) -> list:
+    """The analyzer surface (graftlint v3, analysis/surface.py): the
+    exact ``(wrapped_step, trace_args)`` pairs :func:`warm_signature`
+    would AOT-compile for ``sig`` — same factories, same avals, nothing
+    executed. Keeping one enumeration for warm AND lint is the point:
+    a program the analyzer traces is BY CONSTRUCTION a program prewarm
+    warms and a session dispatches."""
+    return _step_specs(sig)[0]
 
 
 def warm_signature(sig: Signature) -> dict:
@@ -348,7 +375,14 @@ def warm_signature(sig: Signature) -> dict:
     so there is nothing to pre-warm: report ``disabled`` (the worker
     marks the entry skipped and the ladder gate FAILS OPEN, restoring
     the pre-compile-plane behaviour) instead of reading the fallback as
-    a compile failure that would flip /api/health to failed."""
+    a compile failure that would flip /api/health to failed.
+
+    A signature whose device parallelism degrades on this host (e.g.
+    ``stripe_devices=4`` on a 1-device box) warms the program the
+    runtime would actually dispatch and additionally reports
+    ``unreachable`` — the worker surfaces those lattice points
+    distinctly so LATTICE-COMPLETENESS findings and runtime deferrals
+    can be cross-referenced."""
     import os
     if os.environ.get("SELKIES_PERF_ANALYSIS") == "0":
         return {"programs": [], "disabled": "SELKIES_PERF_ANALYSIS=0"}
@@ -357,19 +391,16 @@ def warm_signature(sig: Signature) -> dict:
         with _seat_lock:
             if key in _seat_warmed:
                 return {"programs": program_names(sig), "cached": True}
-        names = _warm_jpeg_seats(sig) if sig.codec == "jpeg" \
-            else _warm_h264_seats(sig)
+    specs, meta = _step_specs(sig)
+    names = []
+    for step, args in specs:
+        if not step.warm(args):
+            raise RuntimeError(f"{step.name} warm failed "
+                               "(see obs.perf log)")
+        names.append(step.name)
+    if sig.seats > 1:
         with _seat_lock:
-            _seat_warmed.add(key)
-        return {"programs": names}
-    if sig.codec != "jpeg" and getattr(sig, "stripe_devices", 1) > 1:
-        from ..engine.h264_encoder import plan_h264_grid
-        from ..parallel.stripes import resolved_stripe_devices
-        g = plan_h264_grid(capture_settings_for(sig))
-        n = resolved_stripe_devices(g.n_stripes, sig.stripe_devices)
-        if n > 1:
-            return {"programs": _warm_h264_stripes(sig, n)}
-        # degraded all the way to one device: the plain program IS the
-        # operating point — fall through to the single-device warm
-    names = _warm_jpeg(sig) if sig.codec == "jpeg" else _warm_h264(sig)
-    return {"programs": names}
+            _seat_warmed.add(sig.program_key)
+    result = {"programs": names}
+    result.update(meta)
+    return result

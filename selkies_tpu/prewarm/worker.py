@@ -44,7 +44,8 @@ from .lattice import LatticePlan, Signature
 logger = logging.getLogger("selkies_tpu.prewarm.worker")
 
 __all__ = ["PrewarmWorker", "PrewarmGate",
-           "PENDING", "COMPILING", "WARM", "FAILED", "SKIPPED"]
+           "PENDING", "COMPILING", "WARM", "FAILED", "SKIPPED",
+           "UNREACHABLE"]
 
 PENDING = "pending"
 COMPILING = "compiling"
@@ -53,6 +54,13 @@ FAILED = "failed"
 #: pre-warm is disabled for this program (perf-analysis kill switch):
 #: not warm, not failed — the gate fails OPEN for skipped programs
 SKIPPED = "skipped"
+#: the lattice point's requested device parallelism cannot be realised
+#: on this host (e.g. stripe_devices=4 on a 1-device box): the DEGRADED
+#: program the runtime would actually dispatch was warmed instead.
+#: Distinct from FAILED (nothing broke) and from SKIPPED (nothing was
+#: disabled) so /api/prewarm and the health check can be cross-
+#: referenced against LATTICE-COMPLETENESS findings (graftlint v3)
+UNREACHABLE = "unreachable"
 
 #: how often the paused/idle loop re-checks for work or storm clearance
 _POLL_S = 1.0
@@ -151,7 +159,8 @@ class PrewarmWorker:
         with self._lock:
             for key in keys:
                 e = self._entries.get(key)
-                if e is None or e["state"] not in (WARM, SKIPPED):
+                if e is None or e["state"] not in (WARM, SKIPPED,
+                                                   UNREACHABLE):
                     return "cold"
         return "warm"
 
@@ -316,15 +325,26 @@ class PrewarmWorker:
             result = self.compiler(sig) or {}
             seconds = round(self._clock() - t0, 3)
             disabled = result.get("disabled")
+            unreachable = result.get("unreachable")
             with self._lock:
-                e["state"] = SKIPPED if disabled else WARM
+                e["state"] = SKIPPED if disabled \
+                    else (UNREACHABLE if unreachable else WARM)
                 e["seconds"] = seconds
                 e["programs"] = list(result.get("programs", []))
                 if disabled:
                     e["error"] = f"prewarm disabled: {disabled}"
+                elif unreachable:
+                    e["error"] = f"unreachable: {unreachable}"
                 self.compile_seconds_total += seconds
             if disabled:
                 logger.info("prewarm: %s skipped (%s)", key, disabled)
+            elif unreachable:
+                # the degraded programs (if any) DID warm; the lattice
+                # point as enumerated cannot exist on this host
+                logger.info("prewarm: %s unreachable (%s)", key,
+                            unreachable)
+                self._record("prewarm_unreachable", key=key,
+                             reason=str(unreachable))
             else:
                 logger.info("prewarm: %s warm in %.1fs", key, seconds)
                 self._record("prewarm_compiled", key=key,
@@ -358,7 +378,8 @@ class PrewarmWorker:
                                     for e in self._entries.values())
         return {"lattice_size": sum(c.values()), "warmed": c[WARM],
                 "pending": c[PENDING], "compiling": c[COMPILING],
-                "failed": c[FAILED], "skipped": c[SKIPPED]}
+                "failed": c[FAILED], "skipped": c[SKIPPED],
+                "unreachable": c[UNREACHABLE]}
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -410,6 +431,15 @@ class PrewarmWorker:
             return _health.ok(
                 f"prewarm disabled for {c['skipped']} programs "
                 "(perf-analysis kill switch); gate fails open", **c)
+        if c["unreachable"]:
+            # not a degradation: the host simply cannot realise those
+            # lattice points (the runtime would degrade identically);
+            # named distinctly so operators can cross-reference against
+            # LATTICE-COMPLETENESS findings
+            return _health.ok(
+                f"lattice warm ({c['warmed']} programs; "
+                f"{c['unreachable']} points unreachable on this host)",
+                **c)
         return _health.ok(
             f"lattice warm ({c['warmed']} programs)", **c)
 
@@ -427,6 +457,12 @@ class PrewarmWorker:
         with self._lock:
             for e in self._entries.values():
                 sig = e["sig"]
+                if e["state"] == UNREACHABLE:
+                    # never advertise capacity the host cannot realise
+                    # (an @sN entry for a mesh that degraded away would
+                    # tell the scheduler this host shards when it
+                    # cannot) — and never block the geometry either
+                    continue
                 geo = (sig.width, sig.height,
                        max(1, int(getattr(sig, "stripe_devices", 1))))
                 ok_ = e["state"] in (WARM, SKIPPED)
@@ -461,7 +497,7 @@ class PrewarmWorker:
                     f"operating point {op[0]}x{op[1]} outside the "
                     "lattice; gate fails open")
             cold = [e["sig"].program_key for e in entries
-                    if e["state"] not in (WARM, SKIPPED)]
+                    if e["state"] not in (WARM, SKIPPED, UNREACHABLE)]
             bad = [e["sig"].program_key for e in entries
                    if e["state"] == FAILED]
         if bad:
@@ -492,12 +528,17 @@ class PrewarmWorker:
         metrics.describe("selkies_prewarm_paused",
                          "1 while the worker is holding for a compile "
                          "storm")
+        metrics.describe("selkies_prewarm_unreachable",
+                         "Lattice points whose requested device "
+                         "parallelism this host cannot realise")
         metrics.set_gauge("selkies_prewarm_lattice_size",
                           c["lattice_size"])
         metrics.set_gauge("selkies_prewarm_warmed", c["warmed"])
         metrics.set_gauge("selkies_prewarm_pending",
                           c["pending"] + c["compiling"])
         metrics.set_gauge("selkies_prewarm_failed", c["failed"])
+        metrics.set_gauge("selkies_prewarm_unreachable",
+                          c["unreachable"])
         metrics.set_gauge("selkies_prewarm_paused",
                           1 if self.paused else 0)
 

@@ -792,14 +792,24 @@ class CentralizedStreamServer:
         # O_NOFOLLOW equivalent: refuse to write through symlinks
         if part.is_symlink():
             return web.Response(status=400, text="refusing symlink part")
-        with open(part, mode) as f:
-            f.seek(offset)
-            async for chunk in request.content.iter_chunked(1 << 20):
-                written += len(chunk)
-                if written > max_slice:
-                    return web.Response(status=413, text="slice too large")
-                f.write(chunk)
-        size = part.stat().st_size
+        chunks: list[bytes] = []
+        async for chunk in request.content.iter_chunked(1 << 20):
+            written += len(chunk)
+            if written > max_slice:
+                return web.Response(status=413, text="slice too large")
+            chunks.append(chunk)
+
+        def _write() -> int:
+            # blocking disk I/O off the event loop; a slow disk must not
+            # stall frame pacing (buffer is bounded by max_slice above)
+            with open(part, mode) as f:
+                f.seek(offset)
+                for c in chunks:
+                    f.write(c)
+            return part.stat().st_size
+
+        loop = asyncio.get_running_loop()
+        size = await loop.run_in_executor(None, _write)
         if total >= 0 and size >= total:
             part.replace(target)
             return web.json_response({"complete": True, "size": size})

@@ -74,7 +74,16 @@ for _fn, _res, _args in [
      [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]),
     ("BIO_ctrl_pending", ctypes.c_size_t, [ctypes.c_void_p]),
 ]:
-    f = getattr(_ssl, _fn, None) or getattr(_crypto, _fn)
+    # a missing symbol (old libcrypto without e.g.
+    # SSL_get1_peer_certificate) must be an ImportError, not the
+    # AttributeError ctypes raises: importers — including pytest's
+    # module-level importorskip in the webrtc tests — treat "this
+    # OpenSSL cannot back the module" as an import failure
+    f = getattr(_ssl, _fn, None) or getattr(_crypto, _fn, None)
+    if f is None:
+        raise ImportError(
+            f"OpenSSL symbol {_fn} unavailable — a libssl/libcrypto "
+            "with the DTLS-SRTP surface (>= 1.1.1/3.x) is required")
     f.restype = _res
     f.argtypes = _args
     globals()["_" + _fn] = f

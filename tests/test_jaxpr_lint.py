@@ -1,0 +1,360 @@
+"""graftlint v3 (--jaxpr): per-rule firing + non-firing fixtures over
+hand-built TracedStep/SignatureTrace records (pure logic, no jax),
+trace_step fidelity on tiny real jits, the budgets table round-trip,
+and the slow full-surface ratchet: current findings ⊆ the checked-in
+tools/jaxpr_baseline.json with every registered step actually traced."""
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from selkies_tpu.analysis.core import Severity, load_baseline, new_findings
+from selkies_tpu.analysis.jaxpr_lint import (DTYPE_DRIFT_FACTOR,
+                                             TEMP_HEADROOM, JAXPR_RULES,
+                                             lint_report, load_budgets,
+                                             make_jaxpr_baseline)
+from selkies_tpu.analysis.surface import (SignatureTrace, SurfaceReport,
+                                          TracedStep)
+
+REPO = Path(__file__).resolve().parent.parent
+BASELINE = REPO / "tools" / "jaxpr_baseline.json"
+
+
+def _step(**kw) -> TracedStep:
+    base = dict(name="fix.step", program_key="pk", n_eqns=3,
+                donated=(), aliased=(), forwarded=(), dropped=(),
+                callbacks=(), float_temps=(), has_f64=False,
+                int_plane=True, max_input_bytes=1024, arg_bytes=4096,
+                temp_bytes=100)
+    base.update(kw)
+    return TracedStep(**base)
+
+
+def _sig(**kw) -> SignatureTrace:
+    base = dict(program_key="pk", predicted=("a", "b"), built=("a", "b"),
+                lattice_key="pk", unreachable=None)
+    base.update(kw)
+    return SignatureTrace(**base)
+
+
+def _report(*steps, signatures=(), errors=()) -> SurfaceReport:
+    return SurfaceReport(steps=list(steps), signatures=list(signatures),
+                         errors=list(errors))
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule_id == rule]
+
+
+BUDGET = {"fix.step": 100}
+
+
+# -- JAXPR-DONATION-ALIAS ----------------------------------------------------
+
+def test_donated_not_aliased_fires():
+    fs = lint_report(_report(_step(donated=(False, True), aliased=())),
+                     BUDGET)
+    f, = _by_rule(fs, "JAXPR-DONATION-ALIAS")
+    assert f.source == "arg1 donated but not aliased"
+    assert f.severity == Severity.ERROR
+    assert f.path == "jaxpr://fix.step"
+
+
+def test_donated_and_aliased_is_clean():
+    fs = lint_report(_report(_step(donated=(False, True), aliased=(1,))),
+                     BUDGET)
+    assert not _by_rule(fs, "JAXPR-DONATION-ALIAS")
+
+
+def test_forwarded_donation_fires_even_when_aliased():
+    # XLA lists a forwarded param in the alias map, but returning the
+    # very buffer the runtime marked consumed is the PR-10 hazard
+    fs = lint_report(_report(_step(donated=(True,), aliased=(0,),
+                                   forwarded=(0,))), BUDGET)
+    f, = _by_rule(fs, "JAXPR-DONATION-ALIAS")
+    assert f.source == "arg0 donated but forwarded"
+
+
+def test_dropped_donation_fires():
+    fs = lint_report(_report(_step(donated=(True, False), dropped=(0,))),
+                     BUDGET)
+    f, = _by_rule(fs, "JAXPR-DONATION-ALIAS")
+    assert f.source == "arg0 donated but unused"
+
+
+def test_dropped_non_donated_arg_is_clean():
+    fs = lint_report(_report(_step(donated=(False, True), aliased=(1,),
+                                   dropped=(0,))), BUDGET)
+    assert not _by_rule(fs, "JAXPR-DONATION-ALIAS")
+
+
+# -- JAXPR-HOST-CALLBACK -----------------------------------------------------
+
+def test_callback_fires_per_primitive():
+    fs = lint_report(_report(_step(callbacks=("debug_print",
+                                              "pure_callback"))), BUDGET)
+    srcs = {f.source for f in _by_rule(fs, "JAXPR-HOST-CALLBACK")}
+    assert srcs == {"callback debug_print", "callback pure_callback"}
+
+
+def test_no_callbacks_is_clean():
+    assert not _by_rule(lint_report(_report(_step()), BUDGET),
+                        "JAXPR-HOST-CALLBACK")
+
+
+# -- JAXPR-DTYPE-DRIFT -------------------------------------------------------
+
+def test_f64_always_fires_as_error():
+    fs = lint_report(_report(_step(
+        has_f64=True,
+        float_temps=((8192, "float64", "32x32", "convert_element_type"),)
+    )), BUDGET)
+    f, = _by_rule(fs, "JAXPR-DTYPE-DRIFT")
+    assert f.source == "f64 intermediate"
+    assert f.severity == Severity.ERROR
+
+
+def test_f32_blowup_fires_only_past_factor():
+    big = int(DTYPE_DRIFT_FACTOR * 1024) + 4
+    fs = lint_report(_report(_step(
+        float_temps=((big, "float32", "64x64x32", "mul"),))), BUDGET)
+    f, = _by_rule(fs, "JAXPR-DTYPE-DRIFT")
+    assert f.source == "float32[64x64x32] mul"
+    assert f.severity == Severity.WARNING
+    # the legitimate CSC path (~4x the input plane) stays silent
+    fs = lint_report(_report(_step(
+        float_temps=((4 * 1024, "float32", "32x32x4", "mul"),))), BUDGET)
+    assert not _by_rule(fs, "JAXPR-DTYPE-DRIFT")
+
+
+def test_f32_blowup_silent_on_float_pipeline():
+    big = int(DTYPE_DRIFT_FACTOR * 1024) + 4
+    fs = lint_report(_report(_step(
+        int_plane=False,
+        float_temps=((big, "float32", "64x64x32", "mul"),))), BUDGET)
+    assert not _by_rule(fs, "JAXPR-DTYPE-DRIFT")
+
+
+def test_f32_blowup_one_finding_per_step():
+    big = int(DTYPE_DRIFT_FACTOR * 1024)
+    fs = lint_report(_report(_step(
+        float_temps=((big + 8, "float32", "a", "mul"),
+                     (big + 4, "float32", "b", "add")))), BUDGET)
+    assert len(_by_rule(fs, "JAXPR-DTYPE-DRIFT")) == 1
+
+
+# -- JAXPR-TEMP-BYTES --------------------------------------------------------
+
+def test_unbudgeted_step_fires():
+    fs = lint_report(_report(_step()), {})
+    f, = _by_rule(fs, "JAXPR-TEMP-BYTES")
+    assert f.source == "unbudgeted step"
+
+
+def test_over_budget_fires_within_headroom_is_clean():
+    at_headroom = int(100 * TEMP_HEADROOM)
+    fs = lint_report(_report(_step(temp_bytes=at_headroom)), BUDGET)
+    assert not _by_rule(fs, "JAXPR-TEMP-BYTES")
+    fs = lint_report(_report(_step(temp_bytes=at_headroom + 1)), BUDGET)
+    f, = _by_rule(fs, "JAXPR-TEMP-BYTES")
+    assert f.source == "temp bytes over budget"
+
+
+# -- LATTICE-COMPLETENESS ----------------------------------------------------
+
+def test_unpredicted_and_ghost_programs_fire():
+    fs = lint_report(_report(signatures=[
+        _sig(predicted=("a", "ghost"), built=("a", "surprise"))]), {})
+    srcs = {f.source for f in _by_rule(fs, "LATTICE-COMPLETENESS")}
+    assert srcs == {"unpredicted program surprise",
+                    "ghost program ghost"}
+    assert all(f.path == "lattice://pk"
+               for f in _by_rule(fs, "LATTICE-COMPLETENESS"))
+
+
+def test_lattice_roundtrip_mismatch_fires():
+    fs = lint_report(_report(signatures=[_sig(lattice_key="other")]), {})
+    f, = _by_rule(fs, "LATTICE-COMPLETENESS")
+    assert f.source == "lattice round-trip mismatch"
+
+
+def test_matching_signature_is_clean():
+    assert not lint_report(_report(signatures=[_sig()]), {})
+
+
+def test_unknown_roundtrip_key_does_not_fire():
+    # lattice_from_settings failing is reported as a trace error by the
+    # CLI, not double-counted as a completeness finding
+    assert not lint_report(_report(signatures=[_sig(lattice_key=None)]),
+                           {})
+
+
+# -- report-level contract ---------------------------------------------------
+
+def test_disabled_rule_and_severity_override():
+    rep = _report(_step(donated=(True,), dropped=(0,)))
+    assert not lint_report(rep, BUDGET,
+                           disabled=["jaxpr-donation-alias"])
+    fs = lint_report(
+        rep, BUDGET,
+        severity_overrides={"JAXPR-DONATION-ALIAS": Severity.INFO})
+    f, = _by_rule(fs, "JAXPR-DONATION-ALIAS")
+    assert f.severity == Severity.INFO
+
+
+def test_findings_sorted_and_stable():
+    rep = _report(_step(name="z.step", callbacks=("debug_print",)),
+                  _step(name="a.step", callbacks=("debug_print",)))
+    fs = lint_report(rep, {"z.step": 100, "a.step": 100})
+    assert [f.path for f in fs] == ["jaxpr://a.step", "jaxpr://z.step"]
+
+
+def test_baseline_budgets_roundtrip():
+    rep = _report(_step(name="s1", temp_bytes=123),
+                  _step(name="s2", temp_bytes=456,
+                        callbacks=("debug_print",)))
+    fs = lint_report(rep, {"s1": 123, "s2": 456})
+    doc = make_jaxpr_baseline(fs, rep)
+    assert doc["budgets"] == {"s1": 123, "s2": 456}
+    assert load_budgets(doc) == {"s1": 123, "s2": 456}
+    assert load_budgets(None) == {}
+    assert load_budgets({"budgets": "garbage"}) == {}
+    # baseline identity is (path, rule, source): the same finding is
+    # recognised across recompiles that shuffle byte counts
+    again = lint_report(rep, {"s1": 123, "s2": 456})
+    assert not new_findings(again, doc)
+
+
+# -- trace_step fidelity on real (tiny) jits ---------------------------------
+
+def test_trace_step_maps_alias_params_through_pruned_args():
+    """jit prunes unused args, shifting compiled param numbering; the
+    analyzer must report flat-arg indices, not compiled-param ones."""
+    jax = pytest.importorskip("jax")
+    import functools
+
+    import jax.numpy as jnp
+
+    from selkies_tpu.analysis.surface import trace_step
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def f(a, b, c):     # b pruned: donated+dropped; c aliases
+        return a + c, jnp.bitwise_xor(c, jnp.uint8(1))
+
+    aval = jax.ShapeDtypeStruct((32,), jnp.uint8)
+    st = trace_step(f, (aval, aval, aval), name="fix.pruned")
+    assert st.dropped == (1,)
+    assert st.donated == (False, True, True)
+    assert 2 in st.aliased          # arg index, not shifted param index
+    fs = lint_report(_report(st), {"fix.pruned": st.temp_bytes})
+    f, = _by_rule(fs, "JAXPR-DONATION-ALIAS")
+    assert f.source == "arg1 donated but unused"
+
+
+def test_trace_step_flags_forwarded_donation():
+    jax = pytest.importorskip("jax")
+    import functools
+
+    import jax.numpy as jnp
+
+    from selkies_tpu.analysis.surface import trace_step
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def f(state, delta):
+        return state, jnp.bitwise_xor(delta, jnp.uint8(1))
+
+    aval = jax.ShapeDtypeStruct((32,), jnp.uint8)
+    st = trace_step(f, (aval, aval), name="fix.fwd")
+    assert st.forwarded == (0,)
+
+
+# -- CLI contract (faked surface: no tracing) --------------------------------
+
+class _Args:
+    baseline = None
+    write_baseline = None
+    severity_map = None
+    jaxpr_disable = None
+    fmt = "text"
+
+
+def _fake_cli(monkeypatch, report, **kw):
+    """run_cli against a canned SurfaceReport. ensure_analysis_env
+    mutates os.environ; registering the keys with monkeypatch FIRST
+    makes teardown restore them (donation forced on cpu must not leak
+    into later engine tests)."""
+    import selkies_tpu.analysis.surface as surface
+    from selkies_tpu.analysis.jaxpr_lint import run_cli
+
+    monkeypatch.setenv("SELKIES_FORCE_DONATION", "1")
+    monkeypatch.setenv("XLA_FLAGS", os.environ.get("XLA_FLAGS", ""))
+    monkeypatch.setattr(surface, "trace_surface", lambda: report)
+    args = _Args()
+    for k, v in kw.items():
+        setattr(args, k, v)
+    return run_cli(args)
+
+
+def test_cli_exit_codes(monkeypatch, tmp_path, capsys):
+    clean = _report(_step(), signatures=[_sig()])
+    # unbudgeted step with no baseline -> gating finding -> exit 1
+    assert _fake_cli(monkeypatch, clean) == 1
+    # write-baseline pins budgets -> always clean -> exit 0
+    bl = tmp_path / "jaxpr_baseline.json"
+    assert _fake_cli(monkeypatch, clean, write_baseline=str(bl)) == 0
+    doc = json.loads(bl.read_text())
+    assert doc["budgets"] == {"fix.step": 100}
+    # gated against the fresh baseline -> exit 0
+    capsys.readouterr()
+    assert _fake_cli(monkeypatch, clean, baseline=str(bl)) == 0
+    assert "0 new, 0 gating" in capsys.readouterr().out
+    # trace errors -> internal error -> exit 2, never 0 or 1
+    broken = _report(errors=["boom"])
+    assert _fake_cli(monkeypatch, broken, baseline=str(bl)) == 2
+
+
+def test_cli_sarif_and_json_output(monkeypatch, capsys):
+    rep = _report(_step(callbacks=("debug_print",)), signatures=[_sig()])
+    assert _fake_cli(monkeypatch, rep, fmt="sarif") == 1
+    sarif = json.loads(capsys.readouterr().out)
+    results = sarif["runs"][0]["results"]
+    rules = {r["ruleId"] for r in results}
+    assert "JAXPR-HOST-CALLBACK" in rules
+    assert _fake_cli(monkeypatch, rep, fmt="json") == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["traced_steps"] == ["fix.step"]
+    assert doc["summary"]["gating"] >= 1
+
+
+# -- the ratchet: repo surface ⊆ committed baseline --------------------------
+
+def test_committed_baseline_shape():
+    doc = load_baseline(BASELINE)
+    budgets = load_budgets(doc)
+    assert budgets, "tools/jaxpr_baseline.json must carry budgets"
+    assert all(isinstance(v, int) and v >= 0 for v in budgets.values())
+    # every registered rule referenced by an entry must exist
+    known = {r.rule_id for r in JAXPR_RULES}
+    for e in doc["entries"]:
+        assert e["rule"] in known
+
+
+@pytest.mark.slow
+def test_full_surface_within_ratchet(monkeypatch):
+    """Trace every registered step factory and require findings ⊆ the
+    committed baseline — the same gate CI's jaxpr-lint job applies.
+    (Needs a jax backend that has not initialised yet: the analysis env
+    forces an 8-device host platform for the seats/stripes meshes.)"""
+    from selkies_tpu.analysis import surface
+    monkeypatch.setenv("SELKIES_FORCE_DONATION", "1")
+    monkeypatch.setenv("XLA_FLAGS", os.environ.get("XLA_FLAGS", ""))
+    surface.ensure_analysis_env()
+    report = surface.trace_surface()
+    assert not report.errors, report.errors
+    doc = load_baseline(BASELINE)
+    findings = lint_report(report, load_budgets(doc))
+    fresh = new_findings(findings, doc)
+    assert not fresh, [f.render() for f in fresh]
+    # the budgets table must cover exactly the traced surface
+    assert set(load_budgets(doc)) == set(report.step_names())

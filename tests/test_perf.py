@@ -510,6 +510,92 @@ def test_wrap_step_falls_back_when_analysis_breaks():
     assert fake.lowers == 1 and fake.calls == 2
 
 
+def test_note_fallback_counts_and_surfaces_in_report():
+    reg = perf_mod.PerfRegistry()
+    e = reg.note_fallback("enc.step", "execute_failed", "(uint8[4])")
+    assert e["count"] == 1
+    reg.note_fallback("enc.step", "execute_failed", "(uint8[4])")
+    reg.note_fallback("other.step", "compile_failed")
+    rep = reg.report()
+    assert [x["step"] for x in rep["fallbacks"]] == \
+        ["enc.step", "other.step"]          # most occurrences first
+    assert rep["fallbacks"][0]["count"] == 2
+    assert rep["fallbacks"][1]["reason"] == "compile_failed"
+    reg.clear()
+    assert reg.report()["fallbacks"] == []
+
+
+def test_note_fallback_table_is_bounded():
+    reg = perf_mod.PerfRegistry(max_steps=4)
+    for i in range(10):
+        reg.note_fallback(f"s{i}", "execute_failed")
+    assert len(reg.report()["fallbacks"]) == 4
+
+
+def test_wrap_step_compile_failure_notes_fallback():
+    import numpy as np
+    reg = perf_mod.PerfRegistry()
+    wrapped = perf_mod._WrappedStep("broken.step", _FakeJit(), reg)
+    wrapped(np.arange(8))
+    fb, = reg.report()["fallbacks"]
+    assert fb["step"] == "broken.step"
+    assert fb["reason"] == "compile_failed"
+
+
+def test_wrap_step_execute_failure_notes_fallback_and_incident():
+    import numpy as np
+
+    from selkies_tpu.obs.health import engine as health_engine
+
+    class _Compiled:
+        def cost_analysis(self):
+            return {"flops": 1.0}
+
+        def memory_analysis(self):
+            return None
+
+        def __call__(self, x):
+            raise RuntimeError("exec boom")
+
+    class _Lowered:
+        def cost_analysis(self):
+            return {"flops": 1.0}
+
+        def compile(self):
+            return _Compiled()
+
+    class _Jit:
+        def __call__(self, x):
+            return "jit-result"
+
+        def lower(self, *a):
+            return _Lowered()
+
+    reg = perf_mod.PerfRegistry()
+    wrapped = perf_mod._WrappedStep("exec.step", _Jit(), reg)
+    assert wrapped(np.arange(4, dtype=np.int32)) == "jit-result"
+    fb, = reg.report()["fallbacks"]
+    assert fb["reason"] == "execute_failed"
+    assert "int32" in fb["signature"]
+    # the permanent fallback is an operator-visible incident
+    kinds = [e for e in health_engine.recorder.snapshot()
+             if e["kind"] == "wrapped_step_fallback"]
+    assert kinds and kinds[-1]["step"] == "exec.step"
+
+
+def test_kill_switch_fallback_is_not_counted(monkeypatch):
+    """SELKIES_PERF_ANALYSIS=0 is a deliberate operator choice, not a
+    defect — it must not pollute the fallback incident surface."""
+    import numpy as np
+    monkeypatch.setenv("SELKIES_PERF_ANALYSIS", "0")
+    reg = perf_mod.PerfRegistry()
+    fake = _FakeJit()
+    wrapped = perf_mod._WrappedStep("ks.step", fake, reg)
+    assert list(wrapped(np.arange(8))) == list(np.arange(8) + 1)
+    assert reg.report()["fallbacks"] == []
+    assert fake.lowers == 0
+
+
 def test_wrap_step_no_retry_after_donated_input_consumed():
     """A Compiled that dies mid-execution AFTER consuming a donated
     input (reference planes, age counters) must re-raise the real

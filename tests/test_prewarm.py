@@ -159,6 +159,50 @@ def test_worker_failure_fails_health_and_records_incident():
     assert "prewarm_compiled" in kinds and "prewarm_failed" in kinds
 
 
+def test_worker_unreachable_is_distinct_from_failure():
+    """A lattice point the host cannot realise (stripe mesh degraded
+    to one device) is neither warm-as-requested nor failed: it reports
+    its own state, stays green, and answers the gate warm (the runtime
+    would dispatch the same degraded program)."""
+    eng = HealthEngine()
+
+    def compiler(sig):
+        if sig.width == 999:
+            return {"programs": ["fake[999x480]"],
+                    "unreachable": "stripe_devices=2 resolves to 1 "
+                                   "on this host"}
+        return {"programs": [f"fake[{sig.width}x{sig.height}]"]}
+
+    w = PrewarmWorker(compiler=compiler, recorder=eng.recorder)
+    good = w.ensure(Signature(640, 480, "jpeg"))
+    unr = w.ensure(Signature(999, 480, "jpeg"))
+    w.run_pending_sync()
+    assert w.states() == {good: "warm", unr: "unreachable"}
+    c = w.counts()
+    assert c["unreachable"] == 1 and c["failed"] == 0
+    assert w.query([unr]) == "warm"
+    v = w.health_check()
+    assert v.status == OK and "unreachable" in v.reason
+    kinds = [e["kind"] for e in eng.recorder.snapshot()]
+    assert "prewarm_unreachable" in kinds
+    assert "prewarm_failed" not in kinds
+
+
+def test_unreachable_point_not_advertised_in_warm_geometries():
+    """An @sN entry for a mesh that degraded away must neither appear
+    as schedulable capacity nor block the single-device geometry."""
+    def compiler(sig):
+        if getattr(sig, "stripe_devices", 1) > 1:
+            return {"programs": [], "unreachable": "1 device host"}
+        return {"programs": [f"fake[{sig.width}x{sig.height}]"]}
+
+    w = PrewarmWorker(compiler=compiler)
+    w.ensure(Signature(640, 480, "h264"))
+    w.ensure(Signature(640, 480, "h264", stripe_devices=2))
+    w.run_pending_sync()
+    assert w.warm_geometries() == ["640x480"]
+
+
 def test_worker_thread_pauses_on_storm_and_resumes():
     import threading
     storm = {"on": True}

@@ -4,6 +4,15 @@ policy (x0.7 backoff / x1.15 recovery, webrtc_mode.py:1652-1716)."""
 
 import struct
 
+import pytest
+
+# the webrtc package binds OpenSSL at import time; boxes whose
+# libssl/libcrypto lack the DTLS-SRTP surface must SKIP these tests,
+# not error collection (dtls converts missing symbols to ImportError)
+pytest.importorskip("selkies_tpu.webrtc.dtls",
+                    reason="usable OpenSSL (DTLS-SRTP surface) required",
+                    exc_type=ImportError)
+
 from selkies_tpu.webrtc.cc import (AckedBitrate, AimdRateControl,
                                    LossController,
                                    SendSideCongestionController,

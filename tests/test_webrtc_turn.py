@@ -10,6 +10,12 @@ import struct
 import numpy as np
 import pytest
 
+# the loopback tests drive RTCPeer, whose DTLS layer binds OpenSSL at
+# import time; skip cleanly where the DTLS-SRTP surface is missing
+pytest.importorskip("selkies_tpu.webrtc.dtls",
+                    reason="usable OpenSSL (DTLS-SRTP surface) required",
+                    exc_type=ImportError)
+
 from selkies_tpu.webrtc import turn as T
 from selkies_tpu.webrtc.stun import StunMessage, make_ice_credentials
 
