@@ -1542,6 +1542,237 @@ def fleet_main() -> None:
         sys.exit(1)
 
 
+def broadcast_main() -> None:
+    """``--broadcast``: contract-prove the broadcast plane (ISSUE 17) —
+    one simulated desktop fanned out to N viewers over a rendition
+    ladder. No jax, no sleeps: encode dispatches are counted per frame,
+    fan-out and rung routing run on an injected clock, and the contract
+    pins the headline invariant — per-frame device work scales with the
+    RENDITION count, never the viewer count. Prints ONE JSON line (same
+    contract as the headline bench). This is the acceptance instrument
+    for ROADMAP item 3's broadcast milestone."""
+    import random
+
+    from selkies_tpu.broadcast import (RenditionHub, RenditionLadder,
+                                       ViewerRegistry)
+    from selkies_tpu.fleet import (MigrationCoordinator, SeatScheduler,
+                                   SimFleet, SimHost, parse_session_spec)
+    from selkies_tpu.obs.health import FlightRecorder
+    from selkies_tpu.prewarm.lattice import Signature
+    from selkies_tpu.server import metrics
+
+    seed = int(os.environ.get("BENCH_BROADCAST_SEED", "1234"))
+    n_viewers = max(2, int(os.environ.get("BENCH_BROADCAST_VIEWERS",
+                                          "100")))
+    n_renditions = max(1, min(3, int(os.environ.get(
+        "BENCH_BROADCAST_RENDITIONS", "3"))))
+    n_frames = max(50, int(os.environ.get("BENCH_BROADCAST_FRAMES",
+                                          "300")))
+    label_cap = 8
+    rng = random.Random(seed)
+    t0 = time.monotonic()
+
+    clock_box = [0.0]
+    clock = lambda: clock_box[0]  # noqa: E731
+    recorder = FlightRecorder(capacity=4096)
+
+    # -- phase 1: the rendition ladder + content pruning --------------------
+    base = Signature(width=1920, height=1080, codec="h264")
+    ladder = RenditionLadder(base, max_rungs=n_renditions)
+    prune = {cc: ladder.device_dispatches_per_frame(cc)
+             for cc in ("static", "scroll", "video", "gaming")}
+    ladder_doc = {
+        "rungs": ladder.names(),
+        "kbps_est": {r.name: round(r.kbps_est, 1) for r in ladder.rungs},
+        "dispatches_by_class": prune,
+    }
+    log(f"broadcast ladder: {ladder_doc}")
+
+    # -- phase 2: relay-only viewer seats on the scheduler ------------------
+    sched = SeatScheduler(clock=clock, recorder=recorder,
+                          host_timeout_s=2.0,
+                          gateway_mbps_budget=float(n_viewers) * 4.0)
+    coord = MigrationCoordinator(sched, clock=clock, recorder=recorder,
+                                 grace_s=3.0)
+    fleet = SimFleet(sched, coord, clock_box=clock_box)
+    fleet.add_host(SimHost("host-0", clock=clock, devices=1, seat_slots=4,
+                           hbm_limit_mb=4096.0,
+                           pixel_budget=3 * 1920 * 1080,
+                           warm_after_s=0.0, grace_s=3.0,
+                           recorder=recorder))
+    fleet.tick(0.5)
+    desk = parse_session_spec({"sid": "desk", "width": 1920,
+                               "height": 1080, "codec": "h264"})
+    desk_placed = sched.place(desk) is not None
+    low = ladder.rung(len(ladder) - 1)
+    viewers_placed = 0
+    relay_budget_violations = 0
+    for i in range(n_viewers):
+        rspec = parse_session_spec({
+            "sid": f"v{i}", "width": low.width, "height": low.height,
+            "codec": "h264", "seat_class": "relay",
+            "source_sid": "desk", "rung": low.name})
+        if rspec.budget_mb() != 0.0 or rspec.pixels != 0:
+            relay_budget_violations += 1
+        if sched.place(rspec) is not None:
+            viewers_placed += 1
+    fleet.tick(1.0)     # heartbeats round-trip the new egress field
+    bw = sched.snapshot().get("bandwidth", {})
+    sched_doc = {
+        "desk_placed": desk_placed,
+        "viewers_placed": viewers_placed,
+        "host_encode_sessions": len(fleet.hosts["host-0"].sessions),
+        "relay_budget_violations": relay_budget_violations,
+        "fleet_mbps_est": bw.get("fleet_mbps_est"),
+        "budget_mbps": bw.get("budget_mbps"),
+        "relay_viewers": bw.get("relay_viewers"),
+    }
+    log(f"broadcast scheduler: {sched_doc}")
+
+    # -- phase 3: the fan-out frame loop ------------------------------------
+    def frame_loop(viewers: int, frames: int, degrade_after: int = -1,
+                   degrade_count: int = 0) -> dict:
+        """Drive one broadcast: every frame dispatches one encode step
+        per ACTIVE rung (never per viewer), publishes through the hub,
+        and feeds each viewer's QoE verdict into the registry."""
+        hub = RenditionHub(clock=clock, recorder=recorder)
+        reg = ViewerRegistry(
+            ladder, source="desk", clock=clock, switch_dwell=3,
+            label_cap=label_cap, recorder=recorder,
+            on_switch=lambda st, old, new: hub.move(
+                "desk", ladder.rung(old).name, ladder.rung(new).name,
+                st.sid, None))
+        sids = [f"v{i}" for i in range(viewers)]
+        for sid in sids:
+            reg.attach(sid, rung=0)
+            hub.subscribe("desk", ladder.rung(0).name, sid, None)
+        degraded = set(sids[:degrade_count]) if degrade_after >= 0 else set()
+        content = "video"
+        max_dispatch = 0
+        total_dispatch = 0
+        for f in range(frames):
+            clock_box[0] += 1.0 / 60.0
+            emitting = [r for r in ladder.active(content)
+                        if f % r.fps_divisor == 0]
+            max_dispatch = max(max_dispatch, len(emitting))
+            total_dispatch += len(emitting)
+            for rend in emitting:
+                size = max(200, int(rend.kbps_est * 125 / 60.0))
+                hub.publish("desk", rend.name, size)
+                ri = ladder.index_of(rend.name)
+                for sid in sids:
+                    st = reg.get(sid)
+                    if st is not None and st.rung == ri:
+                        reg.note_frame(
+                            sid, size_bytes=size,
+                            g2g_ms=40.0 + 8.0 * ri + rng.random() * 6.0)
+            for sid in sids:
+                score = 30.0 if (sid in degraded and f >= degrade_after) \
+                    else 90.0
+                reg.route(sid, score=score, content_class=content)
+        snap = reg.snapshot()
+        g2g_ok = all("g2g_p99_ms" in v for v in snap["sessions"])
+        # last-viewer-close frees the rendition subscriptions
+        for sid in sids:
+            hub.unsubscribe("desk", ladder.rung(reg.get(sid).rung).name,
+                            sid)
+            reg.detach(sid)
+        return {"viewers": viewers, "frames": frames,
+                "max_dispatches_per_frame": max_dispatch,
+                "mean_dispatches_per_frame": round(
+                    total_dispatch / frames, 2),
+                "rung_switches": snap["rung_switches"],
+                "idr_resyncs": snap["idr_resyncs"],
+                "frames_relayed": hub.frames_relayed,
+                "upstream_opens": hub.upstream_opens,
+                "upstream_closes": hub.upstream_closes,
+                "open_rungs_after_close": len(hub.open_rungs()),
+                "g2g_ok": g2g_ok, "registry": reg}
+
+    small = frame_loop(10, 60)
+    small.pop("registry")
+    main_run = frame_loop(n_viewers, n_frames,
+                          degrade_after=n_frames // 3, degrade_count=20)
+    main_reg = main_run.pop("registry")
+    log(f"broadcast fanout small={small}")
+    log(f"broadcast fanout main={main_run}")
+
+    # -- phase 4: bounded viewer metric cardinality -------------------------
+    metrics.clear()
+    for i in range(n_viewers):
+        main_reg.attach(f"v{i}", rung=0)
+        main_reg.note_frame(f"v{i}", size_bytes=1000, g2g_ms=50.0)
+    main_reg.export_metrics()
+    text = metrics.render_prometheus()
+    seats = set()
+    for line in text.splitlines():
+        if line.startswith("selkies_broadcast_viewer_bytes{"):
+            for part in line[line.index("{") + 1:line.index("}")].split(","):
+                if part.startswith("seat="):
+                    seats.add(part.split("=", 1)[1].strip('"'))
+    metrics_doc = {"viewer_series_seats": len(seats),
+                   "overflow_present": "_overflow" in seats,
+                   "label_cap": label_cap}
+    log(f"broadcast metrics: {metrics_doc}")
+
+    contract_ok = (
+        len(ladder) == n_renditions
+        and prune["static"] == 1
+        and prune["video"] == n_renditions
+        and sched_doc["desk_placed"]
+        and sched_doc["viewers_placed"] == n_viewers
+        and sched_doc["host_encode_sessions"] == 1
+        and sched_doc["relay_budget_violations"] == 0
+        and (sched_doc["fleet_mbps_est"] or 0.0) > 0.0
+        # the headline invariant: device work tracks renditions, not
+        # viewers — 10 viewers and 100 viewers dispatch identically
+        and small["max_dispatches_per_frame"] == n_renditions
+        and main_run["max_dispatches_per_frame"]
+        == small["max_dispatches_per_frame"]
+        and main_run["rung_switches"] == 20
+        and main_run["idr_resyncs"] == main_run["rung_switches"]
+        and main_run["g2g_ok"]
+        and main_run["upstream_closes"] == main_run["upstream_opens"]
+        and main_run["open_rungs_after_close"] == 0
+        and metrics_doc["viewer_series_seats"] <= label_cap + 1
+        and metrics_doc["overflow_present"]
+        and fleet.heartbeats_rejected == 0)
+
+    dt = time.monotonic() - t0
+    doc = {
+        "metric": "broadcast_contract",
+        "value": 1.0 if contract_ok else 0.0,
+        "unit": "contract_ok",
+        "vs_baseline": 1.0 if contract_ok else 0.0,
+        "backend": "sim",
+        "backend_health": {"status": "ok" if contract_ok else "failed",
+                           "reason": "broadcast contract "
+                           + ("held" if contract_ok else "BROKEN")},
+        "duration_s": round(dt, 3),
+        "viewers": n_viewers,
+        "renditions": n_renditions,
+        "broadcast": {
+            "seed": seed,
+            "frames": n_frames,
+            "ladder": ladder_doc,
+            "scheduler": sched_doc,
+            "fanout_small": small,
+            "fanout": main_run,
+            "metrics": metrics_doc,
+            "heartbeats": {"sent": fleet.heartbeats_sent,
+                           "rejected": fleet.heartbeats_rejected},
+            "contract_ok": contract_ok,
+        },
+    }
+    log(f"broadcast done in {dt:.2f}s: contract_ok={contract_ok} "
+        f"dispatches/frame={main_run['max_dispatches_per_frame']} "
+        f"viewers={n_viewers} switches={main_run['rung_switches']}")
+    print(json.dumps(doc))
+    ledger_append(doc)
+    if not contract_ok:
+        sys.exit(1)
+
+
 def chaos_main(force_cpu: bool = False) -> None:
     """``--chaos``: prove the resilience plane recovers every injected
     fault. Prints ONE JSON line (same contract as the headline bench)."""
@@ -1651,6 +1882,29 @@ if __name__ == "__main__":
                 "metric": "stripe_scaling_unavailable", "value": 0.0,
                 "unit": "speedup", "vs_baseline": 0.0,
                 "backend": "none",
+                "backend_health": {
+                    "status": "failed",
+                    "reason": f"{type(e).__name__}: {e}"[:200]},
+                "error": f"{type(e).__name__}: {e}"[:300],
+            }))
+            sys.exit(1)
+        sys.exit(0)
+    if "--broadcast" in sys.argv[1:]:
+        # broadcast mode never touches jax (simulated desktop, counted
+        # dispatches, injected clock) — no backend probe needed
+        try:
+            broadcast_main()
+        except SystemExit:
+            raise
+        except BaseException as e:   # noqa: BLE001 — JSON line contract
+            if isinstance(e, KeyboardInterrupt):
+                raise
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "broadcast_contract", "value": 0.0,
+                "unit": "contract_ok", "vs_baseline": 0.0,
+                "backend": "sim",
                 "backend_health": {
                     "status": "failed",
                     "reason": f"{type(e).__name__}: {e}"[:200]},

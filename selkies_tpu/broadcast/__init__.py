@@ -1,0 +1,34 @@
+"""Broadcast plane: one desktop -> N viewers (ROADMAP item 3).
+
+The fleet scales *sessions*; this package scales *audiences*. One
+captured desktop is encoded at a small **rendition ladder** (2-3 rungs
+enumerated from the prewarm lattice via :class:`ladder.RenditionLadder`,
+pruned per content class by the PR-15 classifier tables), each viewer is
+routed to a rung by its congestion-controller / QoE verdict
+(:class:`registry.ViewerRegistry`, with dwell hysteresis and an IDR
+resync on every switch), and the gateway fans each encoded rendition
+out to arbitrarily many **relay-only** viewer seats
+(:class:`fanout.RenditionHub`) — device work is bounded by the rendition
+count, never the viewer count.
+
+Import discipline: like ``selkies_tpu.fleet``, everything here is
+stdlib-only importable (``bench.py --broadcast`` runs the contract on a
+bare CPU container with no jax). The content-class tables live in
+``engine/content.py`` whose *package* drags jax, so :mod:`ladder` loads
+that single file by location when the package import is unavailable.
+"""
+
+from .fanout import RenditionHub  # noqa: F401
+from .ladder import (BROADCAST_RUNG_SKIPS, Rendition,  # noqa: F401
+                     RenditionLadder, ladder_from_settings)
+from .registry import ViewerRegistry, ViewerState  # noqa: F401
+
+__all__ = [
+    "BROADCAST_RUNG_SKIPS",
+    "Rendition",
+    "RenditionLadder",
+    "RenditionHub",
+    "ViewerRegistry",
+    "ViewerState",
+    "ladder_from_settings",
+]

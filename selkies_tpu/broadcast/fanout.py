@@ -1,0 +1,226 @@
+"""Rendition fan-out hub: one encoded rung stream -> N viewer sinks.
+
+Transport-agnostic (the gateway wires it to websockets; tests and the
+bench wire it to plain callables): each ``(source, rung)`` key holds a
+refcounted subscription. The FIRST viewer on a rung opens the upstream
+(``on_open`` — the gateway dials the engine host's rendition stream);
+the LAST viewer leaving arms a grace timer (``schedule`` seam, same
+shape as the gateway's PR-11 reconnect-grace ``_release_timers``) and
+only if nobody re-subscribes before it fires does ``on_close`` release
+the upstream. ``publish`` is the 1-to-N moment: one frame in, every
+sink gets it — the device encoded once, the fan-out is pure bandwidth.
+
+Stdlib-only importable; no asyncio dependency (the ``schedule``
+injection point accepts ``loop.call_later`` or a manual test clock).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger("selkies_tpu.broadcast.fanout")
+
+__all__ = ["RenditionHub"]
+
+Key = Tuple[str, str]   # (source sid, rung name)
+
+
+class RenditionHub:
+    """Refcounted per-(source, rung) subscriptions with grace release."""
+
+    def __init__(self, *, clock: Callable[[], float] = time.monotonic,
+                 schedule: Optional[Callable] = None,
+                 grace_s: float = 3.0,
+                 on_open: Optional[Callable[[str, str], None]] = None,
+                 on_close: Optional[Callable[[str, str], None]] = None,
+                 recorder=None):
+        self._clock = clock
+        #: schedule(delay_s, cb) -> handle with .cancel(); None means
+        #: release immediately on last unsubscribe (no grace)
+        self._schedule = schedule
+        self.grace_s = float(grace_s)
+        self.on_open = on_open
+        self.on_close = on_close
+        self._recorder = recorder
+        self._lock = threading.Lock()
+        #: key -> {sid: sink or None}
+        self._subs: Dict[Key, Dict[str, Optional[Callable]]] = {}
+        #: key -> pending grace-release timer handle
+        self._release_timers: Dict[Key, object] = {}
+        self._open: set = set()
+        self.frames_relayed = 0
+        self.upstream_opens = 0
+        self.upstream_closes = 0
+        self._shutdown = False
+
+    # -- subscriptions -------------------------------------------------------
+    def subscribe(self, source: str, rung: str, sid: str,
+                  sink: Optional[Callable] = None) -> int:
+        """Attach viewer ``sid`` to a rung; returns the new refcount.
+
+        Re-subscribing inside the grace window cancels the pending
+        release — the upstream never flaps on a quick reconnect.
+        """
+        key = (source, rung)
+        with self._lock:
+            if self._shutdown:
+                return 0
+            timer = self._release_timers.pop(key, None)
+            subs = self._subs.setdefault(key, {})
+            subs[sid] = sink
+            first = key not in self._open
+            if first:
+                self._open.add(key)
+                self.upstream_opens += 1
+            n = len(subs)
+        if timer is not None:
+            try:
+                timer.cancel()
+            except Exception:
+                pass
+        if first and self.on_open is not None:
+            try:
+                self.on_open(source, rung)
+            except Exception:
+                logger.exception("broadcast on_open failed for %s", key)
+        return n
+
+    def unsubscribe(self, source: str, rung: str, sid: str) -> int:
+        """Detach a viewer; on last-out, arm the grace release timer."""
+        key = (source, rung)
+        with self._lock:
+            subs = self._subs.get(key)
+            if subs is None or sid not in subs:
+                return len(subs) if subs else 0
+            subs.pop(sid, None)
+            n = len(subs)
+            if n > 0 or key not in self._open:
+                return n
+            if self._schedule is None:
+                return self._finish_release_locked(key)
+            if key not in self._release_timers:
+                self._release_timers[key] = self._schedule(
+                    self.grace_s, lambda k=key: self._release_if_idle(k))
+        return 0
+
+    def move(self, source: str, old_rung: str, new_rung: str, sid: str,
+             sink: Optional[Callable] = None) -> None:
+        """Rung switch: subscribe the new rung FIRST, then leave the
+        old one — the upstream set never dips to zero mid-switch."""
+        if old_rung == new_rung:
+            return
+        self.subscribe(source, new_rung, sid, sink)
+        self.unsubscribe(source, old_rung, sid)
+
+    def _release_if_idle(self, key: Key) -> None:
+        with self._lock:
+            self._release_timers.pop(key, None)
+            subs = self._subs.get(key)
+            if subs:                      # someone came back in time
+                return
+            self._finish_release_locked(key)
+
+    def _finish_release_locked(self, key: Key) -> int:
+        """Caller holds the lock (or is single-threaded sync path)."""
+        self._subs.pop(key, None)
+        if key in self._open:
+            self._open.discard(key)
+            self.upstream_closes += 1
+            hook = self.on_close
+            if hook is not None:
+                try:
+                    hook(key[0], key[1])
+                except Exception:
+                    logger.exception(
+                        "broadcast on_close failed for %s", key)
+        if self._recorder is not None:
+            try:
+                self._recorder.record(
+                    "rendition_released",
+                    {"source": key[0], "rung": key[1]})
+            except Exception:
+                pass
+        return 0
+
+    # -- fan-out -------------------------------------------------------------
+    def publish(self, source: str, rung: str, frame) -> int:
+        """One encoded frame in, every subscribed sink out. Returns
+        the number of sinks reached. A failing sink never starves its
+        rung-mates."""
+        with self._lock:
+            sinks = list((self._subs.get((source, rung)) or {}).items())
+        delivered = 0
+        for sid, sink in sinks:
+            if sink is None:
+                delivered += 1       # counted-only viewer (sim/bench)
+                continue
+            try:
+                sink(frame)
+                delivered += 1
+            except Exception:
+                logger.debug("broadcast sink %s failed", sid,
+                             exc_info=True)
+        self.frames_relayed += delivered
+        return delivered
+
+    # -- introspection -------------------------------------------------------
+    def viewer_count(self, source: str, rung: Optional[str] = None) -> int:
+        with self._lock:
+            if rung is not None:
+                return len(self._subs.get((source, rung)) or {})
+            return sum(len(s) for k, s in self._subs.items()
+                       if k[0] == source)
+
+    def open_rungs(self, source: Optional[str] = None) -> list:
+        with self._lock:
+            keys = sorted(self._open)
+        if source is None:
+            return keys
+        return [k for k in keys if k[0] == source]
+
+    def pending_releases(self) -> int:
+        with self._lock:
+            return len(self._release_timers)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "open_rungs": [list(k) for k in sorted(self._open)],
+                "viewers": sum(len(s) for s in self._subs.values()),
+                "pending_releases": len(self._release_timers),
+                "frames_relayed": self.frames_relayed,
+                "upstream_opens": self.upstream_opens,
+                "upstream_closes": self.upstream_closes,
+            }
+
+    # -- shutdown ------------------------------------------------------------
+    def shutdown(self) -> None:
+        """Cancel every pending grace timer and close every upstream
+        (gateway shutdown must not leak timers or streams)."""
+        with self._lock:
+            self._shutdown = True
+            timers = list(self._release_timers.values())
+            self._release_timers.clear()
+            keys = list(self._open)
+        for t in timers:
+            try:
+                t.cancel()
+            except Exception:
+                pass
+        for key in keys:
+            with self._lock:
+                self._subs.pop(key, None)
+                if key not in self._open:
+                    continue
+                self._open.discard(key)
+                self.upstream_closes += 1
+                hook = self.on_close
+            if hook is not None:
+                try:
+                    hook(key[0], key[1])
+                except Exception:
+                    logger.exception(
+                        "broadcast on_close failed for %s", key)

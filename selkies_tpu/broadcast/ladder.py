@@ -1,0 +1,229 @@
+"""Rendition ladder: 2-3 encode rungs per broadcast desktop.
+
+Rungs are enumerated from the prewarm lattice's :class:`Signature`
+(``scaled()`` — the same frozen compile identities the prewarm worker
+warms and the multi-seat step factories batch), so a broadcast desktop
+never mints a compile surface the lattice doesn't already know. The
+PR-15 content classifier prunes rungs that are pointless for the
+current content class (a static text screen needs no half-rate low
+rung; paint-over already sharpens it), which is exactly how device
+work stays pinned to *useful* renditions.
+
+Stdlib-only importable; jax never enters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+from ..fleet.protocol import estimate_relay_mbps
+from ..prewarm.lattice import Signature
+
+__all__ = [
+    "BROADCAST_RUNG_SKIPS",
+    "Rendition",
+    "RenditionLadder",
+    "content_classes",
+    "ladder_from_settings",
+]
+
+#: rung "step kind" -> content classes for which the rung is pointless.
+#: Mirrors ``engine.content.CONTENT_LADDER_SKIPS`` (the per-class
+#: ladder-step skip table): a *static* screen gains nothing from either
+#: a downscaled or a half-rate rendition (damage gating already makes
+#: its encode nearly free, and paint-over restores fidelity), while a
+#: *scroll* screen keeps the downscale rung but skips the fps-halved
+#: one (scroll motion at half rate reads as judder).
+BROADCAST_RUNG_SKIPS = {
+    "static": ("downscale", "fps"),
+    "scroll": ("fps",),
+    "video": (),
+    "gaming": (),
+}
+
+#: (name, step kind, spatial downscale factor, fps divisor) per rung,
+#: top rung first. The top rung is never pruned.
+_RUNG_PLAN = (
+    ("src", "base", 1, 1),
+    ("mid", "downscale", 2, 1),
+    ("low", "fps", 4, 2),
+)
+
+
+def _load_content_module():
+    """Return ``engine.content`` (classifier tables) or None.
+
+    The module file is stdlib-only but ``engine/__init__`` imports jax;
+    in jax-less contexts (bench sim, fleet containers) load the single
+    file by location instead.
+    """
+    try:
+        from ..engine import content  # type: ignore
+        return content
+    except Exception:
+        pass
+    try:
+        import importlib.util
+        import os
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "engine", "content.py")
+        spec = importlib.util.spec_from_file_location(
+            "selkies_tpu_broadcast_content", path)
+        if spec is None or spec.loader is None:
+            return None
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None
+
+
+def content_classes() -> Sequence[str]:
+    """The classifier's class names (fallback table if unloadable)."""
+    mod = _load_content_module()
+    if mod is not None and hasattr(mod, "CONTENT_CLASSES"):
+        return tuple(mod.CONTENT_CLASSES)
+    return ("static", "scroll", "video", "gaming")
+
+
+@dataclasses.dataclass(frozen=True)
+class Rendition:
+    """One encode rung: a lattice signature plus its relay economics."""
+
+    name: str                 # "src" | "mid" | "low"
+    step: str                 # "base" | "downscale" | "fps"
+    width: int
+    height: int
+    codec: str
+    downscale: int = 1        # spatial factor vs the source
+    fps_divisor: int = 1      # temporal factor vs the source
+    signature: Optional[Signature] = None
+    kbps_est: float = 0.0     # per-viewer relay cost at this rung
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "step": self.step,
+            "width": self.width, "height": self.height,
+            "codec": self.codec, "downscale": self.downscale,
+            "fps_divisor": self.fps_divisor,
+            "kbps_est": round(self.kbps_est, 1),
+            "program_key": (self.signature.program_key
+                            if self.signature is not None else ""),
+        }
+
+
+class RenditionLadder:
+    """Enumerate and prune the rendition rungs for one desktop.
+
+    ``base`` is the desktop's own lattice signature; rungs are its
+    ``scaled()`` derivatives, deduped on ``program_key`` (a tiny
+    desktop collapses the ladder — a 320x200 source has no useful
+    "low" rung once the geometry floor bites).
+    """
+
+    def __init__(self, base: Signature, *, max_rungs: int = 3,
+                 target_fps: float = 60.0):
+        self.base = base
+        self.target_fps = float(target_fps)
+        self.rungs: List[Rendition] = []
+        seen = set()
+        for name, step, factor, fps_div in _RUNG_PLAN[:max(1, max_rungs)]:
+            sig = base if factor == 1 else base.scaled(factor)
+            if sig.program_key in seen:
+                continue
+            seen.add(sig.program_key)
+            fps = self.target_fps / fps_div
+            self.rungs.append(Rendition(
+                name=name, step=step,
+                width=sig.width, height=sig.height, codec=sig.codec,
+                downscale=factor, fps_divisor=fps_div, signature=sig,
+                kbps_est=estimate_relay_mbps(
+                    sig.width, sig.height, sig.codec, fps=fps) * 1000.0))
+
+    def __len__(self) -> int:
+        return len(self.rungs)
+
+    def names(self) -> List[str]:
+        return [r.name for r in self.rungs]
+
+    def rung(self, index: int) -> Rendition:
+        return self.rungs[max(0, min(index, len(self.rungs) - 1))]
+
+    def index_of(self, name: str) -> int:
+        for i, r in enumerate(self.rungs):
+            if r.name == name:
+                return i
+        return 0
+
+    # -- content pruning -----------------------------------------------------
+    def active(self, content_class: Optional[str] = None) -> List[Rendition]:
+        """The rungs actually worth encoding for this content class.
+
+        The top rung always survives (someone must get the source);
+        the device dispatches exactly ``len(active())`` encode steps
+        per frame regardless of the viewer count — the broadcast
+        invariant ``bench.py --broadcast`` pins.
+        """
+        skips = BROADCAST_RUNG_SKIPS.get(content_class or "", ())
+        out = [r for i, r in enumerate(self.rungs)
+               if i == 0 or r.step not in skips]
+        return out
+
+    def device_dispatches_per_frame(
+            self, content_class: Optional[str] = None) -> int:
+        return len(self.active(content_class))
+
+    def signatures(self) -> List[Signature]:
+        """Every rung's lattice signature (the prewarm worker warms
+        these through the same step factories as any seat)."""
+        return [r.signature for r in self.rungs if r.signature is not None]
+
+    # -- rung selection ------------------------------------------------------
+    def rung_for_score(self, score: float) -> int:
+        """Ladder-per-session (WS) verdict: QoE score 0-100 -> rung.
+
+        >=70 healthy -> source; >=40 strained -> mid; else low.
+        """
+        if score >= 70.0:
+            want = 0
+        elif score >= 40.0:
+            want = 1
+        else:
+            want = len(self.rungs) - 1
+        return max(0, min(want, len(self.rungs) - 1))
+
+    def rung_for_bitrate(self, kbps: float) -> int:
+        """Simulcast selection (WebRTC): the congestion controller's
+        target bitrate picks the best rung that fits under it."""
+        for i, r in enumerate(self.rungs):
+            if r.kbps_est <= kbps:
+                return i
+        return len(self.rungs) - 1
+
+    def to_dict(self) -> dict:
+        return {"target_fps": self.target_fps,
+                "rungs": [r.to_dict() for r in self.rungs]}
+
+
+def ladder_from_settings(settings, *, width: Optional[int] = None,
+                         height: Optional[int] = None) -> RenditionLadder:
+    """Build the desktop's ladder from live settings (mirrors
+    ``prewarm.lattice.lattice_from_settings``'s duck-typed reads)."""
+
+    def g(name, default):
+        return getattr(settings, name, default)
+
+    encoder = str(g("encoder", g("codec", "h264")))
+    base = Signature(
+        width=int(width if width is not None else g("initial_width", 1280)),
+        height=int(height if height is not None
+                   else g("initial_height", 720)),
+        codec="jpeg" if encoder.startswith("jpeg") else "h264",
+        use_damage_gating=bool(g("use_damage_gating", True)),
+        use_paint_over=bool(g("use_paint_over", True)),
+    )
+    return RenditionLadder(
+        base,
+        max_rungs=int(g("broadcast_renditions", 3)),
+        target_fps=float(g("framerate", g("target_fps", 60.0))))

@@ -1,0 +1,286 @@
+"""Viewer registry: route each viewer session onto a ladder rung.
+
+Each viewer is a relay-only seat; its *rung* is chosen by whatever
+verdict its transport produces — a QoE score (ladder-per-session for
+WS; see ``obs/qoe.py``) or a congestion-controller target bitrate
+(simulcast selection for WebRTC; see ``webrtc/cc.py``). Switches are
+dwell-hysteresed (a single bad sample never flaps the rung) and every
+switch fires the ``on_switch`` hook so the transport can request an
+IDR resync on the new rung — a viewer never joins a rung mid-GOP.
+
+Metrics cardinality is bounded exactly like ``qoe_seat_label_cap``
+(PR-9): the first ``label_cap`` viewers get their own
+``selkies_broadcast_viewer_*`` series; every viewer past the cap rolls
+into ``seat="_overflow"`` so a 10k-viewer webinar cannot mint 10k
+Prometheus series.
+
+Stdlib-only importable.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .ladder import RenditionLadder
+
+__all__ = ["ViewerRegistry", "ViewerState"]
+
+#: mirrors obs.qoe.DEFAULT_SEAT_LABEL_CAP (kept literal: this module
+#: must not import the obs package's jax-adjacent surface)
+DEFAULT_VIEWER_LABEL_CAP = 8
+
+
+def _p99(values: List[float]) -> Optional[float]:
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = min(len(vs) - 1, int(round(0.99 * (len(vs) - 1))))
+    return vs[idx]
+
+
+class ViewerState:
+    """One viewer seat's routing + QoE ledger."""
+
+    def __init__(self, sid: str, source: str, rung: int, rung_name: str,
+                 joined_at: float):
+        self.sid = sid
+        self.source = source
+        self.rung = rung
+        self.rung_name = rung_name
+        self.joined_at = joined_at
+        self.rung_switches = 0
+        self.idr_resyncs = 0
+        self.frames = 0
+        self.bytes = 0
+        self.last_score: Optional[float] = None
+        self.last_bitrate_kbps: Optional[float] = None
+        self.g2g_ms: collections.deque = collections.deque(maxlen=256)
+        # hysteresis: the rung we'd rather be on, and for how many
+        # consecutive route() verdicts it has held
+        self._want = rung
+        self._want_streak = 0
+
+    def g2g_p99_ms(self) -> Optional[float]:
+        return _p99(list(self.g2g_ms))
+
+    def snapshot(self, now: float) -> dict:
+        doc = {
+            "sid": self.sid, "source": self.source,
+            "rung": self.rung, "rung_name": self.rung_name,
+            "age_s": round(max(0.0, now - self.joined_at), 3),
+            "rung_switches": self.rung_switches,
+            "idr_resyncs": self.idr_resyncs,
+            "frames": self.frames, "bytes": self.bytes,
+        }
+        p99 = self.g2g_p99_ms()
+        if p99 is not None:
+            doc["g2g_p99_ms"] = round(p99, 3)
+        if self.last_score is not None:
+            doc["score"] = round(self.last_score, 1)
+        if self.last_bitrate_kbps is not None:
+            doc["bitrate_kbps"] = round(self.last_bitrate_kbps, 1)
+        return doc
+
+
+class ViewerRegistry:
+    """All viewers of one broadcast source, routed onto its ladder."""
+
+    def __init__(self, ladder: RenditionLadder, *,
+                 source: str = "",
+                 clock: Callable[[], float] = time.monotonic,
+                 switch_dwell: int = 3,
+                 label_cap: int = DEFAULT_VIEWER_LABEL_CAP,
+                 on_switch: Optional[Callable] = None,
+                 recorder=None):
+        self.ladder = ladder
+        self.source = source
+        self._clock = clock
+        self.switch_dwell = max(1, int(switch_dwell))
+        self.label_cap = max(0, int(label_cap))
+        #: on_switch(state, old_rung, new_rung) — the IDR-resync hook
+        self.on_switch = on_switch
+        self._recorder = recorder
+        self._lock = threading.Lock()
+        self._viewers: Dict[str, ViewerState] = {}
+        self._label_order: List[str] = []   # first-come label owners
+        self.total_switches = 0
+        self.total_resyncs = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def attach(self, sid: str, *, rung: Optional[int] = None) -> ViewerState:
+        with self._lock:
+            st = self._viewers.get(sid)
+            if st is not None:
+                return st
+            idx = 0 if rung is None else max(
+                0, min(int(rung), len(self.ladder) - 1))
+            st = ViewerState(sid, self.source, idx,
+                             self.ladder.rung(idx).name, self._clock())
+            self._viewers[sid] = st
+            if len(self._label_order) < self.label_cap:
+                self._label_order.append(sid)
+            return st
+
+    def detach(self, sid: str) -> Optional[ViewerState]:
+        with self._lock:
+            st = self._viewers.pop(sid, None)
+            if sid in self._label_order:
+                self._label_order.remove(sid)
+            return st
+
+    def get(self, sid: str) -> Optional[ViewerState]:
+        return self._viewers.get(sid)
+
+    def __len__(self) -> int:
+        return len(self._viewers)
+
+    # -- routing -------------------------------------------------------------
+    def route(self, sid: str, *, score: Optional[float] = None,
+              bitrate_kbps: Optional[float] = None,
+              content_class: Optional[str] = None) -> int:
+        """Feed one verdict; returns the viewer's (possibly new) rung.
+
+        The desired rung must hold for ``switch_dwell`` consecutive
+        verdicts before the switch lands (hysteresis — transient dips
+        don't flap), and every landed switch calls ``on_switch`` so
+        the transport IDR-resyncs the viewer onto the new rung.
+        """
+        with self._lock:
+            st = self._viewers.get(sid)
+            if st is None:
+                return 0
+            if bitrate_kbps is not None:
+                st.last_bitrate_kbps = float(bitrate_kbps)
+                want = self.ladder.rung_for_bitrate(float(bitrate_kbps))
+            elif score is not None:
+                st.last_score = float(score)
+                want = self.ladder.rung_for_score(float(score))
+            else:
+                return st.rung
+            # a pruned rung is never routable: clamp the desire into
+            # the active set for the current content class
+            active = {self.ladder.rungs.index(r)
+                      for r in self.ladder.active(content_class)}
+            while want not in active and want > 0:
+                want -= 1
+            if want == st.rung:
+                st._want, st._want_streak = st.rung, 0
+                return st.rung
+            if want == st._want:
+                st._want_streak += 1
+            else:
+                st._want, st._want_streak = want, 1
+            if st._want_streak < self.switch_dwell:
+                return st.rung
+            old = st.rung
+            st.rung = want
+            st.rung_name = self.ladder.rung(want).name
+            st.rung_switches += 1
+            st.idr_resyncs += 1
+            st._want_streak = 0
+            self.total_switches += 1
+            self.total_resyncs += 1
+            hook = self.on_switch
+        if self._recorder is not None:
+            try:
+                self._recorder.record(
+                    "viewer_rung_switch",
+                    {"sid": sid, "from": old, "to": want})
+            except Exception:
+                pass
+        if hook is not None:
+            hook(st, old, want)
+        return want
+
+    # -- QoE attribution -----------------------------------------------------
+    def note_frame(self, sid: str, *, g2g_ms: Optional[float] = None,
+                   size_bytes: int = 0) -> None:
+        st = self._viewers.get(sid)
+        if st is None:
+            return
+        st.frames += 1
+        st.bytes += int(size_bytes)
+        if g2g_ms is not None:
+            st.g2g_ms.append(float(g2g_ms))
+
+    def counts(self) -> Dict[str, int]:
+        """viewers per rung name."""
+        out: Dict[str, int] = {r.name: 0 for r in self.ladder.rungs}
+        with self._lock:
+            for st in self._viewers.values():
+                out[st.rung_name] = out.get(st.rung_name, 0) + 1
+        return out
+
+    def snapshot(self) -> dict:
+        now = self._clock()
+        with self._lock:
+            viewers = [st.snapshot(now) for st in self._viewers.values()]
+        return {
+            "source": self.source,
+            "viewers": len(viewers),
+            "per_rung": self.counts(),
+            "rung_switches": self.total_switches,
+            "idr_resyncs": self.total_resyncs,
+            "sessions": viewers,
+        }
+
+    # -- metrics (cardinality-capped) ---------------------------------------
+    def export_metrics(self) -> None:
+        """Publish ``selkies_broadcast_*`` gauges.
+
+        Per-viewer series are capped at ``label_cap`` (first come,
+        first labelled); the rest aggregate under ``seat="_overflow"``
+        — the same bound `qoe_seat_label_cap` puts on session series.
+        """
+        try:
+            from ..server import metrics
+        except Exception:
+            return
+        metrics.describe("selkies_broadcast_viewers",
+                         "Broadcast viewers per rendition rung")
+        metrics.describe("selkies_broadcast_rung_switches_total",
+                         "Total viewer rung switches (each IDR-resyncs)")
+        metrics.describe("selkies_broadcast_viewer_g2g_p99_ms",
+                         "Per-viewer glass-to-glass p99 (capped labels)")
+        metrics.describe("selkies_broadcast_viewer_bytes",
+                         "Per-viewer relayed bytes (capped labels)")
+        with self._lock:
+            per_rung = {r.name: 0 for r in self.ladder.rungs}
+            for st in self._viewers.values():
+                per_rung[st.rung_name] = per_rung.get(st.rung_name, 0) + 1
+            labelled = [s for s in self._label_order if s in self._viewers]
+            overflow = [s for s in self._viewers if s not in set(labelled)]
+            for rung, n in per_rung.items():
+                metrics.set_gauge(
+                    "selkies_broadcast_viewers", float(n),
+                    labels={"source": self.source or "_", "rung": rung})
+            metrics.set_gauge(
+                "selkies_broadcast_rung_switches_total",
+                float(self.total_switches),
+                labels={"source": self.source or "_"})
+            for sid in labelled:
+                st = self._viewers[sid]
+                p99 = st.g2g_p99_ms()
+                if p99 is not None:
+                    metrics.set_gauge(
+                        "selkies_broadcast_viewer_g2g_p99_ms", p99,
+                        labels={"seat": sid, "rung": st.rung_name})
+                metrics.set_gauge(
+                    "selkies_broadcast_viewer_bytes", float(st.bytes),
+                    labels={"seat": sid, "rung": st.rung_name})
+            if overflow:
+                g2gs = [v for v in (
+                    self._viewers[s].g2g_p99_ms() for s in overflow)
+                    if v is not None]
+                if g2gs:
+                    metrics.set_gauge(
+                        "selkies_broadcast_viewer_g2g_p99_ms",
+                        max(g2gs),
+                        labels={"seat": "_overflow", "rung": "_"})
+                metrics.set_gauge(
+                    "selkies_broadcast_viewer_bytes",
+                    float(sum(self._viewers[s].bytes for s in overflow)),
+                    labels={"seat": "_overflow", "rung": "_"})

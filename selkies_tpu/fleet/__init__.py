@@ -20,7 +20,9 @@ fleet shaped for the millions-of-users traffic profile.
   rig ``bench.py --fleet`` and ``tests/test_fleet.py`` chaos-test the
   contracts on (CPU, no sleeps);
 - :mod:`.gateway` — the one aiohttp module (NOT imported here): the
-  stateless auth + WS-affinity tier in front of the engine hosts;
+  stateless auth + WS-affinity tier in front of the engine hosts,
+  plus the broadcast fan-out endpoint (ISSUE 17) where relay-only
+  viewer seats subscribe to per-source rendition rungs;
 - :mod:`.__main__` — ``python -m selkies_tpu.fleet selftest``: the CI
   lint smoke, stdlib-only like the rest of the offline CLIs.
 
@@ -29,8 +31,9 @@ installed (same contract as :mod:`..obs` / :mod:`..resilience`).
 """
 
 from .migrate import MigrationCoordinator  # noqa: F401
-from .protocol import (FleetProtocolError, Heartbeat,  # noqa: F401
-                       SessionSpec, estimate_hbm_mb, heartbeat_from_core,
+from .protocol import (SEAT_CLASSES, FleetProtocolError,  # noqa: F401
+                       Heartbeat, SessionSpec, estimate_hbm_mb,
+                       estimate_relay_mbps, heartbeat_from_core,
                        migrate_command, parse_heartbeat,
                        parse_session_spec)
 from .scheduler import Placement, SeatScheduler  # noqa: F401

@@ -42,6 +42,11 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from ..broadcast.fanout import RenditionHub
+from ..broadcast.ladder import RenditionLadder
+from ..broadcast.registry import ViewerRegistry
+from ..prewarm.lattice import Signature
+from ..protocol import OP_H264, OP_JPEG
 from .migrate import MigrationCoordinator
 from .protocol import (FleetProtocolError, parse_heartbeat,
                        parse_session_spec)
@@ -50,6 +55,17 @@ from .scheduler import SeatScheduler
 logger = logging.getLogger("selkies_tpu.fleet.gateway")
 
 __all__ = ["FleetGateway"]
+
+
+def _frame_id_of(buf: bytes) -> Optional[int]:
+    """Peek the uint16 frame id of a 0x03/0x04 stripe (both wire
+    headers carry it big-endian at bytes 2:4). The pump ACKs on
+    behalf of the whole fan-out — viewers never talk to the engine,
+    so without this the engine's ack-desync window would rightly
+    pause the rendition after ~30 frames and stall every viewer."""
+    if len(buf) >= 4 and buf[0] in (OP_JPEG, OP_H264):
+        return int.from_bytes(buf[2:4], "big")
+    return None
 
 
 class FleetGateway:
@@ -87,6 +103,33 @@ class FleetGateway:
         #: non-overlapping migrate reconnect), and an instant release
         #: here would tear the placement down under it
         self.release_grace_s = 3.0
+        # ---- broadcast plane (ISSUE 17) --------------------------------
+        #: rendition rungs per broadcast source
+        self.broadcast_renditions = 3
+        #: grace before a rung with zero viewers closes its upstream —
+        #: the 1-to-N twin of release_grace_s (last-viewer blip must
+        #: not cold-restart the rendition stream)
+        self.broadcast_grace_s = 3.0
+        #: per-(source, rung) refcounted subscriptions; first viewer
+        #: opens the upstream rendition stream, last-out (after grace)
+        #: closes it
+        self.hub = RenditionHub(
+            clock=clock,
+            schedule=lambda d, cb:
+            asyncio.get_running_loop().call_later(d, cb),
+            grace_s=self.broadcast_grace_s,
+            on_open=self._open_upstream,
+            on_close=self._close_upstream,
+            recorder=self.recorder)
+        #: source sid -> ViewerRegistry (rung routing + hysteresis)
+        self._registries: dict[str, ViewerRegistry] = {}
+        #: viewer sid -> frame sink (for rung moves)
+        self._viewer_sinks: dict = {}
+        #: (source, rung) -> upstream pump task / live upstream WS
+        self._upstream_tasks: dict = {}
+        self._upstream_ws: dict = {}
+        #: short-lived IDR-request tasks, retained until done
+        self._idr_tasks: set = set()
 
     # ------------------------------------------------------------------ auth
     def _authed(self, request: web.Request) -> bool:
@@ -107,6 +150,8 @@ class FleetGateway:
         r.add_get("/fleet/hosts", self.handle_hosts)
         r.add_post("/fleet/drain/{host_id}", self.handle_drain)
         r.add_get("/fleet/ws", self.handle_ws)
+        r.add_get("/fleet/broadcast/ws", self.handle_broadcast_ws)
+        r.add_get("/fleet/broadcast/{source}", self.handle_broadcast_info)
         app.on_startup.append(self._start_sweep)
         app.on_cleanup.append(self._stop_sweep)
         return app
@@ -119,6 +164,19 @@ class FleetGateway:
         for t in self._release_timers.values():
             t.cancel()
         self._release_timers.clear()
+        # broadcast teardown: every grace timer cancelled, every
+        # upstream rendition stream closed — shutdown leaks nothing
+        self.hub.shutdown()
+        for task in list(self._upstream_tasks.values()):
+            task.cancel()
+        for task in list(self._upstream_tasks.values()):
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._upstream_tasks.clear()
+        self._registries.clear()
+        self._viewer_sinks.clear()
         if self._sweep_task is not None:
             self._sweep_task.cancel()
             try:
@@ -343,6 +401,245 @@ class FleetGateway:
         self._release_timers.pop(sid, None)
         if self._ws_conns.get(sid, 0) == 0:
             self.scheduler.release(sid)
+
+    # ------------------------------------------------- broadcast fan-out
+    def _broadcast_registry(self, source: str) -> Optional[ViewerRegistry]:
+        """The per-source viewer registry (rung routing + hysteresis),
+        its ladder enumerated from the SOURCE placement's geometry —
+        the same signatures the prewarm lattice scales."""
+        reg = self._registries.get(source)
+        if reg is not None:
+            return reg
+        p = self.scheduler.get(source)
+        if p is None or p.spec.is_relay:
+            return None
+        ladder = RenditionLadder(
+            Signature(width=p.spec.width, height=p.spec.height,
+                      codec=p.spec.codec),
+            max_rungs=self.broadcast_renditions)
+
+        def on_switch(state, old: int, new: int, _src=source,
+                      _lad=ladder) -> None:
+            # rung switch: re-subscribe the viewer (new rung FIRST so
+            # the upstream never dips), then ask the new rung's
+            # upstream for an IDR — the viewer must join on a clean
+            # decoder entry point, never mid-GOP
+            sink = self._viewer_sinks.get(state.sid)
+            self.hub.move(_src, _lad.rung(old).name,
+                          _lad.rung(new).name, state.sid, sink)
+            try:
+                task = asyncio.get_running_loop().create_task(
+                    self._request_upstream_idr(_src,
+                                               _lad.rung(new).name))
+            except RuntimeError:
+                return  # no loop (sync test rig): hub state moved
+            self._idr_tasks.add(task)
+            task.add_done_callback(self._idr_tasks.discard)
+
+        reg = ViewerRegistry(ladder, source=source,
+                             on_switch=on_switch,
+                             recorder=self.recorder)
+        self._registries[source] = reg
+        return reg
+
+    def _open_upstream(self, source: str, rung: str) -> None:
+        """Hub on_open: first viewer on a rung — dial the rendition
+        stream on the source's engine host (one upstream per rung,
+        however many viewers fan off it)."""
+        key = (source, rung)
+        if key in self._upstream_tasks:
+            return
+        try:
+            self._upstream_tasks[key] = \
+                asyncio.get_running_loop().create_task(
+                    self._upstream_pump(source, rung))
+        except RuntimeError:
+            pass        # no loop (sync test rig drives the hub alone)
+
+    def _close_upstream(self, source: str, rung: str) -> None:
+        """Hub on_close: grace expired with zero viewers — the
+        rendition subscription frees."""
+        task = self._upstream_tasks.pop((source, rung), None)
+        if task is not None:
+            task.cancel()
+
+    async def _upstream_pump(self, source: str, rung: str) -> None:
+        """One rendition's upstream: engine-host WS -> hub.publish.
+        Every frame arrives ONCE here and fans out to every subscribed
+        viewer sink — the 1-to-N moment."""
+        p = self.scheduler.get(source)
+        host = self.scheduler.hosts.get(p.host_id) if p else None
+        if host is None or not host.url.startswith(
+                ("http://", "https://", "ws://", "wss://")):
+            return
+        target = host.url.replace("http://", "ws://") \
+            .replace("https://", "wss://").rstrip("/") \
+            + "/api/websockets?fleet_sid=" \
+            + urllib.parse.quote(source) \
+            + "&rung=" + urllib.parse.quote(rung)
+        key = (source, rung)
+        try:
+            async with self._http().ws_connect(target) as ws:
+                self._upstream_ws[key] = ws
+                await ws.send_str("START_VIDEO")
+                last_ack = None
+                async for msg in ws:
+                    if msg.type == aiohttp.WSMsgType.BINARY:
+                        self.hub.publish(source, rung, msg.data)
+                        fid = _frame_id_of(msg.data)
+                        if fid is not None and fid != last_ack:
+                            last_ack = fid
+                            await ws.send_str(f"CLIENT_FRAME_ACK,{fid}")
+                    elif msg.type != aiohttp.WSMsgType.TEXT:
+                        break
+        except aiohttp.ClientError as e:
+            logger.warning("broadcast upstream %s/%s failed: %s",
+                           source, rung, e)
+        finally:
+            self._upstream_ws.pop(key, None)
+
+    async def _request_upstream_idr(self, source: str,
+                                    rung: str) -> None:
+        """IDR resync on the rung a viewer just switched onto."""
+        ws = self._upstream_ws.get((source, rung))
+        if ws is None:
+            return
+        try:
+            await ws.send_str("START_VIDEO")
+        except Exception:
+            logger.debug("broadcast IDR request failed",
+                         exc_info=True)
+
+    async def handle_broadcast_info(self, request: web.Request
+                                    ) -> web.Response:
+        """Operator view of one source's broadcast: ladder, per-rung
+        viewer counts, switch totals, hub state."""
+        if not self._authed(request):
+            return web.Response(status=401, text="bad fleet token")
+        source = request.match_info["source"]
+        reg = self._registries.get(source)
+        if reg is None:
+            return web.json_response({"found": False}, status=404)
+        doc = reg.snapshot()
+        doc["found"] = True
+        doc["ladder"] = reg.ladder.to_dict()
+        doc["hub"] = self.hub.snapshot()
+        return web.json_response(doc)
+
+    async def handle_broadcast_ws(self, request: web.Request
+                                  ) -> web.StreamResponse:
+        """Viewer seat: relay-only WS fan-out of one source's
+        rendition ladder. ``?source=`` names the broadcast desktop
+        (must be placed); ``?vid=`` keeps viewer affinity across
+        reconnects; ``?rung=`` picks the starting rung. The viewer
+        sends ``qoe,<score>`` / ``cc,<kbps>`` verdicts; rung switches
+        are hysteresed and IDR-resynced."""
+        if not self._authed(request):
+            return web.Response(status=401, text="bad fleet token")
+        q = request.query
+        source = q.get("source", "")
+        src_p = self.scheduler.get(source) if source else None
+        if src_p is None or src_p.spec.is_relay:
+            return web.Response(status=404,
+                                text="broadcast source not placed")
+        reg = self._broadcast_registry(source)
+        if reg is None:
+            return web.Response(status=404,
+                                text="broadcast source not placed")
+        import secrets
+        vid = q.get("vid") or f"view-{secrets.token_urlsafe(9)}"
+        rung_idx = reg.ladder.index_of(q.get("rung", "")) \
+            if q.get("rung") else 0
+        rend = reg.ladder.rung(rung_idx)
+        if self.scheduler.get(vid) is None:
+            try:
+                spec = parse_session_spec({
+                    "v": 1, "kind": "place", "sid": vid,
+                    "seat_class": "relay", "source_sid": source,
+                    "rung": rend.name, "width": rend.width,
+                    "height": rend.height, "codec": rend.codec})
+            except FleetProtocolError as e:
+                return web.Response(status=400, text=f"bad spec: {e}")
+            placed = self.scheduler.place(spec)
+            if placed is None:
+                # gateway bandwidth budget refused: withdraw the
+                # queued spec — this viewer is about to go away
+                self.scheduler.cancel_pending(vid)
+                return web.Response(
+                    status=503, text="gateway egress budget exhausted")
+        ws_client = web.WebSocketResponse()
+        await ws_client.prepare(request)
+        loop = asyncio.get_running_loop()
+        out: asyncio.Queue = asyncio.Queue(maxsize=64)
+
+        def sink(frame, _q=out):
+            # called from the upstream pump (same loop): drop-oldest
+            # under backpressure — a slow viewer must never stall the
+            # rung it shares with everyone else
+            try:
+                _q.put_nowait(frame)
+            except asyncio.QueueFull:
+                try:
+                    _q.get_nowait()
+                    _q.put_nowait(frame)
+                except (asyncio.QueueEmpty, asyncio.QueueFull):
+                    pass
+
+        st = reg.attach(vid, rung=rung_idx)
+        self._viewer_sinks[vid] = sink
+        self._ws_conns[vid] = self._ws_conns.get(vid, 0) + 1
+        timer = self._release_timers.pop(vid, None)
+        if timer is not None:
+            timer.cancel()    # reconnect inside the grace: keep seat
+        self.hub.subscribe(source, reg.ladder.rung(st.rung).name,
+                           vid, sink)
+
+        async def writer():
+            while True:
+                frame = await out.get()
+                if frame is None:
+                    return
+                await ws_client.send_bytes(frame)
+                reg.note_frame(vid, size_bytes=len(frame))
+
+        wtask = loop.create_task(writer())
+        try:
+            async for msg in ws_client:
+                if msg.type != aiohttp.WSMsgType.TEXT:
+                    if msg.type == aiohttp.WSMsgType.BINARY:
+                        continue
+                    break
+                verb, _, arg = msg.data.partition(",")
+                try:
+                    if verb == "qoe":
+                        reg.route(vid, score=float(arg))
+                    elif verb == "cc":
+                        reg.route(vid, bitrate_kbps=float(arg))
+                    elif verb == "g2g":
+                        reg.note_frame(vid, g2g_ms=float(arg))
+                except ValueError:
+                    pass
+        finally:
+            wtask.cancel()
+            st2 = reg.get(vid)
+            cur = reg.ladder.rung(st2.rung).name if st2 else rend.name
+            # last-viewer-close starts the rung's grace clock in the
+            # hub; the relay SEAT rides the same deferred-release
+            # pattern as a proxied session (reconnect keeps it)
+            self.hub.unsubscribe(source, cur, vid)
+            reg.detach(vid)
+            reg.export_metrics()
+            self._viewer_sinks.pop(vid, None)
+            if len(reg) == 0:
+                self._registries.pop(source, None)
+            left = self._ws_conns.get(vid, 1) - 1
+            if left <= 0:
+                self._ws_conns.pop(vid, None)
+                self._release_timers[vid] = loop.call_later(
+                    self.release_grace_s, self._release_if_idle, vid)
+            else:
+                self._ws_conns[vid] = left
+        return ws_client
 
 
 async def _await_handle(handle) -> None:

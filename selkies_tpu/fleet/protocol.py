@@ -26,13 +26,20 @@ import json
 import time
 from typing import Optional
 
-__all__ = ["PROTOCOL_VERSION", "FleetProtocolError", "DeviceCapacity",
-           "SeatSession", "Heartbeat", "SessionSpec", "parse_heartbeat",
-           "parse_session_spec", "estimate_hbm_mb",
-           "estimate_session_watts", "migrate_command",
-           "heartbeat_from_core"]
+__all__ = ["PROTOCOL_VERSION", "SEAT_CLASSES", "FleetProtocolError",
+           "DeviceCapacity", "SeatSession", "Heartbeat", "SessionSpec",
+           "parse_heartbeat", "parse_session_spec", "estimate_hbm_mb",
+           "estimate_session_watts", "estimate_relay_mbps",
+           "migrate_command", "heartbeat_from_core"]
 
 PROTOCOL_VERSION = 1
+
+#: seat classes (ISSUE 17, broadcast plane). An ``encode`` seat owns
+#: device work (HBM / pixels / watts budget axes); a ``relay`` seat is
+#: a broadcast viewer — zero device cost, it only subscribes to an
+#: encode seat's rendition stream, so its budget axis is gateway
+#: egress bandwidth.
+SEAT_CLASSES = ("encode", "relay")
 
 #: sanity ceilings for range checks — far above anything real, low
 #: enough that an absurd document cannot poison capacity math
@@ -42,6 +49,7 @@ _MAX_DIM_PX = 16_384
 _MAX_HBM_MB = 16 * 1024 * 1024    # 16 TiB, in MB
 _MAX_SESSIONS = 65_536
 _MAX_WATTS = 1_000_000.0          # 1 MW: see parse_heartbeat
+_MAX_MBPS = 1_000_000.0           # 1 Tbps: egress sanity ceiling
 
 _HEALTH_STATES = ("ok", "degraded", "failed")
 
@@ -106,6 +114,10 @@ class SeatSession:
     codec: str = "h264"
     hbm_mb: float = 0.0
     g2g_p99_ms: Optional[float] = None
+    #: "encode" (device work) or "relay" (broadcast viewer; ISSUE 17)
+    seat_class: str = "encode"
+    #: rendition rung name for relay seats ("" for encode seats)
+    rung: str = ""
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -135,6 +147,11 @@ class Heartbeat:
     #: fleet-wide power budget with it; range-checked like every
     #: capacity field because it steers placement.
     watts_est: Optional[float] = None
+    #: estimated host egress in Mbit/s (ISSUE 17): what this host's
+    #: encode seats emit toward the gateway — the broadcast fan-out's
+    #: upstream side of the bandwidth budget. Range-checked like
+    #: watts_est because the scheduler packs relay seats against it.
+    egress_mbps_est: Optional[float] = None
     devices: list = dataclasses.field(default_factory=list)
     sessions: list = dataclasses.field(default_factory=list)
     warm_geometries: list = dataclasses.field(default_factory=list)
@@ -148,6 +165,7 @@ class Heartbeat:
             "ready": self.ready,
             "draining": self.draining, "health": self.health,
             "watts_est": self.watts_est,
+            "egress_mbps_est": self.egress_mbps_est,
             "slo": {"status": self.slo_status,
                     "fast_burn": self.slo_fast_burn},
             "devices": [d.to_dict() for d in self.devices],
@@ -169,25 +187,53 @@ class SessionSpec:
     height: int = 720
     codec: str = "h264"
     hbm_mb: float = 0.0          # 0 => estimate_hbm_mb(w, h, codec)
+    #: "encode" seats charge HBM/pixels/watts; "relay" seats (broadcast
+    #: viewers, ISSUE 17) charge ONLY gateway bandwidth — the fix for
+    #: estimate_hbm_mb/estimate_session_watts billing a full device
+    #: budget to a seat that never touches the device.
+    seat_class: str = "encode"
+    #: the encode session this relay viewer watches (relay only)
+    source_sid: str = ""
+    #: the rendition rung the viewer starts on (relay only)
+    rung: str = ""
+
+    @property
+    def is_relay(self) -> bool:
+        return self.seat_class == "relay"
 
     @property
     def pixels(self) -> int:
-        return self.width * self.height
+        return 0 if self.is_relay else self.width * self.height
 
     def budget_mb(self) -> float:
+        if self.is_relay:
+            return 0.0
         return self.hbm_mb or estimate_hbm_mb(self.width, self.height,
                                               self.codec)
 
     def budget_w(self) -> float:
         """The power axis of the placement budget (ISSUE 14)."""
+        if self.is_relay:
+            return 0.0
         return estimate_session_watts(self.width, self.height,
                                       self.codec)
+
+    def budget_mbps(self) -> float:
+        """The bandwidth axis (ISSUE 17): a relay viewer's gateway
+        egress at its rendition geometry. Encode seats charge zero
+        here — their emission is priced once by the heartbeat's
+        ``egress_mbps_est``, not per subscribed viewer."""
+        if not self.is_relay:
+            return 0.0
+        return estimate_relay_mbps(self.width, self.height, self.codec)
 
     def to_dict(self) -> dict:
         return {"v": PROTOCOL_VERSION, "kind": "place",
                 "sid": self.sid, "width": self.width,
                 "height": self.height, "codec": self.codec,
-                "hbm_mb": self.hbm_mb}
+                "hbm_mb": self.hbm_mb,
+                "seat_class": self.seat_class,
+                "source_sid": self.source_sid, "rung": self.rung}
 
 
 def estimate_session_watts(width: int, height: int,
@@ -204,6 +250,19 @@ def estimate_session_watts(width: int, height: int,
     px = max(1, int(width)) * max(1, int(height))
     per_px_nj = 12.0 if codec == "h264" else 8.0
     return round(max(0.5, px * float(fps) * per_px_nj * 1e-9), 2)
+
+
+def estimate_relay_mbps(width: int, height: int, codec: str = "h264",
+                        fps: float = 60.0) -> float:
+    """Per-viewer gateway egress estimate in Mbit/s — the bandwidth
+    twin of :func:`estimate_hbm_mb` for relay seats (ISSUE 17). Priced
+    from the codec's steady-state bits/pixel (H.264 inter coding is an
+    order cheaper than JPEG's intra-only stream), floored so a tiny
+    rendition still charges something, and corrected by the measured
+    heartbeat ``egress_mbps_est`` once traffic is real."""
+    px = max(1, int(width)) * max(1, int(height))
+    bits_per_px = 0.06 if codec == "h264" else 0.25
+    return round(max(0.5, px * float(fps) * bits_per_px * 1e-6), 2)
 
 
 def estimate_hbm_mb(width: int, height: int, codec: str = "h264") -> float:
@@ -270,6 +329,11 @@ def parse_heartbeat(doc) -> Heartbeat:
     # negatives fail _num's range check like every capacity field)
     hb.watts_est = None if watts is None else \
         _num(watts, "watts_est", 0, _MAX_WATTS)
+    egress = doc.get("egress_mbps_est")
+    # same treatment as watts_est: the bandwidth axis steers relay
+    # placement, so NaN/negative/absurd egress claims are rejected
+    hb.egress_mbps_est = None if egress is None else \
+        _num(egress, "egress_mbps_est", 0, _MAX_MBPS)
 
     devices = doc.get("devices", [])
     if not isinstance(devices, list) or len(devices) > _MAX_DEVICES:
@@ -311,6 +375,15 @@ def parse_heartbeat(doc) -> Heartbeat:
         if not isinstance(s, dict):
             raise FleetProtocolError(f"sessions[{i}] must be an object")
         g2g = s.get("g2g_p99_ms")
+        seat_class = s.get("seat_class", "encode")
+        if seat_class not in SEAT_CLASSES:
+            raise FleetProtocolError(
+                f"sessions[{i}].seat_class={seat_class!r} not in "
+                f"{SEAT_CLASSES}")
+        rung = s.get("rung", "")
+        if not isinstance(rung, str) or len(rung) > 32:
+            raise FleetProtocolError(
+                f"sessions[{i}].rung must be a string <= 32 chars")
         hb.sessions.append(SeatSession(
             sid=_ident(_need(s, "sid"), f"sessions[{i}].sid"),
             device=int(_num(s.get("device", 0),
@@ -326,6 +399,8 @@ def parse_heartbeat(doc) -> Heartbeat:
                         f"sessions[{i}].hbm_mb", 0, _MAX_HBM_MB),
             g2g_p99_ms=None if g2g is None else
             _num(g2g, f"sessions[{i}].g2g_p99_ms", 0, 1e9),
+            seat_class=seat_class,
+            rung=rung,
         ))
 
     warm = doc.get("warm_geometries", [])
@@ -358,6 +433,20 @@ def parse_session_spec(doc) -> SessionSpec:
             raise FleetProtocolError(f"unparseable spec: {e}") from e
     if not isinstance(doc, dict):
         raise FleetProtocolError("session spec must be a JSON object")
+    seat_class = doc.get("seat_class", "encode")
+    if seat_class not in SEAT_CLASSES:
+        raise FleetProtocolError(
+            f"seat_class={seat_class!r} not in {SEAT_CLASSES}")
+    source_sid = doc.get("source_sid", "")
+    if seat_class == "relay":
+        # a relay viewer is meaningless without the encode session it
+        # watches — strict parse, not a default
+        source_sid = _ident(_need(doc, "source_sid"), "source_sid")
+    elif source_sid:
+        source_sid = _ident(source_sid, "source_sid")
+    rung = doc.get("rung", "")
+    if not isinstance(rung, str) or len(rung) > 32:
+        raise FleetProtocolError("rung must be a string <= 32 chars")
     return SessionSpec(
         sid=_ident(_need(doc, "sid"), "sid"),
         width=int(_num(doc.get("width", 1280), "width", 1, _MAX_DIM_PX)),
@@ -365,6 +454,9 @@ def parse_session_spec(doc) -> SessionSpec:
                         _MAX_DIM_PX)),
         codec=str(doc.get("codec", "h264"))[:16],
         hbm_mb=_num(doc.get("hbm_mb", 0.0), "hbm_mb", 0, _MAX_HBM_MB),
+        seat_class=seat_class,
+        source_sid=source_sid,
+        rung=rung,
     )
 
 
@@ -485,6 +577,15 @@ def heartbeat_from_core(core, url: str = "", seq: int = 0) -> Heartbeat:
             hb.devices[0].pixels_used = max(
                 hb.devices[0].pixels_used,
                 sum(s.width * s.height for s in hb.sessions))
+    except Exception:
+        pass
+    # upstream egress estimate (ISSUE 17): what this host's encode
+    # seats emit toward the gateway's broadcast fan-out
+    try:
+        hb.egress_mbps_est = round(sum(
+            estimate_relay_mbps(s.width, s.height, s.codec)
+            for s in hb.sessions
+            if getattr(s, "seat_class", "encode") == "encode"), 2)
     except Exception:
         pass
     return hb

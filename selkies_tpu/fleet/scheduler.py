@@ -151,7 +151,8 @@ class SeatScheduler:
                  pack_weight: float = 0.5,
                  burn_penalty: float = 2.0,
                  pending_cap: int = 1024,
-                 power_budget_w: Optional[float] = None):
+                 power_budget_w: Optional[float] = None,
+                 gateway_mbps_budget: Optional[float] = None):
         self._clock = clock
         self.recorder = recorder
         self.host_timeout_s = float(host_timeout_s)
@@ -170,6 +171,15 @@ class SeatScheduler:
         #: queues like any other capacity refusal. None = axis off.
         self.power_budget_w = None if power_budget_w is None \
             else float(power_budget_w)
+        #: gateway egress budget in Mbit/s (ISSUE 17): the broadcast
+        #: plane's capacity axis. Relay viewer seats cost no
+        #: HBM/pixels/watts — their bill is bandwidth, and with a
+        #: budget set a viewer that would push projected egress
+        #: (upstream heartbeat ``egress_mbps_est`` + per-viewer relay
+        #: estimates) past it queues like any capacity refusal.
+        #: None = axis off (viewers only need a placed source).
+        self.gateway_mbps_budget = None if gateway_mbps_budget is None \
+            else float(gateway_mbps_budget)
         self._lock = threading.Lock()
         self.hosts: dict[str, HostState] = {}
         self.placements: dict[str, Placement] = {}
@@ -237,6 +247,10 @@ class SeatScheduler:
         a heartbeat round O(hosts x devices x placements))."""
         loads: dict = {}
         for p in self.placements.values():
+            if p.spec.is_relay:
+                # relay viewers take no device capacity (ISSUE 17):
+                # their axis is gateway bandwidth, not seats/HBM/pixels
+                continue
             entry = loads.setdefault((p.host_id, p.device),
                                      [0, 0.0, 0])
             entry[0] += 1
@@ -275,6 +289,44 @@ class SeatScheduler:
             return True
         return self._fleet_watts_locked() + spec.budget_w() \
             <= self.power_budget_w
+
+    def _fleet_mbps_locked(self) -> float:
+        """Projected gateway egress (lock held): the per-viewer relay
+        charges plus, per host, the max of its reported
+        ``egress_mbps_est`` and zero — same scheduler-authoritative
+        shape as the watts axis (heartbeats lag placements)."""
+        total = sum(p.spec.budget_mbps()
+                    for p in self.placements.values()
+                    if p.spec.is_relay)
+        for host in self.hosts.values():
+            if host.lost:
+                continue
+            total += host.heartbeat.egress_mbps_est or 0.0
+        return total
+
+    def _bandwidth_ok_locked(self, spec: SessionSpec) -> bool:
+        if self.gateway_mbps_budget is None or not spec.is_relay:
+            return True
+        # placed-sid exemption mirrors _power_ok_locked: re-probing an
+        # existing viewer is bandwidth-neutral
+        if spec.sid in self.placements:
+            return True
+        return self._fleet_mbps_locked() + spec.budget_mbps() \
+            <= self.gateway_mbps_budget
+
+    def _relay_target_locked(self, spec: SessionSpec
+                             ) -> Optional["Placement"]:
+        """Where a relay viewer lands: ON its source's placement (the
+        rendition stream it subscribes to lives there). None when the
+        source is unplaced or its host is not ready — the viewer
+        queues and retries once the source (re)lands."""
+        src = self.placements.get(spec.source_sid)
+        if src is None or src.spec.is_relay:
+            return None
+        host = self.hosts.get(src.host_id)
+        if host is None or not host.ready:
+            return None
+        return src
 
     def _fits(self, host: HostState, dev: DeviceCapacity,
               spec: SessionSpec, loads: dict) -> Optional[float]:
@@ -342,6 +394,8 @@ class SeatScheduler:
         entry and re-fronts it itself (re-queueing here would rotate
         the head to the tail and break FIFO fairness)."""
         exclude = set(exclude_hosts)
+        if spec.is_relay:
+            return self._place_relay(spec, queue_on_fail=queue_on_fail)
         with self._lock:
             if spec.sid in self.placements:
                 return self.placements[spec.sid]
@@ -397,6 +451,40 @@ class SeatScheduler:
         self._update_metrics()
         return p
 
+    def _place_relay(self, spec: SessionSpec,
+                     queue_on_fail: bool = True) -> Optional[Placement]:
+        """Place one broadcast viewer (ISSUE 17). Relay seats pin to
+        their SOURCE's placement (host/device/seat attribution without
+        consuming any of them), charge only the bandwidth axis, and are
+        delivered by the gateway's fan-out hub — the host-handle
+        ``on_place`` offer is deliberately skipped (an engine host
+        never runs a viewer seat)."""
+        with self._lock:
+            if spec.sid in self.placements:
+                return self.placements[spec.sid]
+            if not self._bandwidth_ok_locked(spec):
+                if queue_on_fail:
+                    self._queue(spec)
+                return None
+            src = self._relay_target_locked(spec)
+            if src is None:
+                # source unplaced (still pending, migrating, or host
+                # cold): the viewer queues and follows it in
+                if queue_on_fail:
+                    self._queue(spec)
+                return None
+            p = Placement(sid=spec.sid, host_id=src.host_id,
+                          device=src.device, seat=src.seat, spec=spec,
+                          placed_at=self._clock())
+            self.placements[spec.sid] = p
+            self.total_placements += 1
+        self._record("viewer_attached", sid=spec.sid,
+                     source_sid=spec.source_sid, rung=spec.rung,
+                     host_id=p.host_id,
+                     mbps=round(spec.budget_mbps(), 2))
+        self._update_metrics()
+        return p
+
     def feasible(self, spec: SessionSpec, exclude_hosts=()) -> bool:
         """Read-only probe: would ``place`` land this spec right now?
         The evict path asks BEFORE releasing a seat — tearing a session
@@ -404,6 +492,11 @@ class SeatScheduler:
         seat for no seat (and an IDR storm of failed re-offers)."""
         exclude = set(exclude_hosts)
         with self._lock:
+            if spec.is_relay:
+                return (self._bandwidth_ok_locked(spec)
+                        and self._relay_target_locked(spec) is not None
+                        and self.placements[spec.source_sid].host_id
+                        not in exclude)
             if not self._power_ok_locked(spec):
                 return False
             loads = self._load_map()
@@ -477,8 +570,34 @@ class SeatScheduler:
         itself (keep-warm semantics differ from a plain session end)."""
         with self._lock:
             p = self.placements.pop(sid, None)
+            followers = []
+            if p is not None and not p.spec.is_relay:
+                followers = [f for f in self.placements.values()
+                             if f.spec.is_relay
+                             and f.spec.source_sid == sid]
+                for f in followers:
+                    self.placements.pop(f.sid, None)
+                if not notify:
+                    # migration in flight: the viewers follow their
+                    # source — re-queue them so they re-pin once it
+                    # lands on the new host
+                    for f in followers:
+                        self._queue(f.spec)
         if p is not None:
-            if notify and self.on_release is not None:
+            if notify and followers:
+                # final session end: the broadcast is over, every
+                # viewer seat frees with it (the gateway tears the
+                # sockets down on its side)
+                for f in followers:
+                    self._record("viewer_released", sid=f.sid,
+                                 source_sid=sid,
+                                 reason="source released")
+            if p.spec.is_relay:
+                self._record("viewer_released", sid=sid,
+                             source_sid=p.spec.source_sid,
+                             reason="viewer detached")
+            if notify and self.on_release is not None \
+                    and not p.spec.is_relay:
                 try:
                     self.on_release(p)
                 except Exception:
@@ -492,9 +611,12 @@ class SeatScheduler:
             return self.placements.get(sid)
 
     def placements_on(self, host_id: str) -> list[Placement]:
+        """A host's seat work list: encode seats only — relay viewers
+        are gateway-side subscriptions (they follow their source via
+        the release cascade, never migrate on their own)."""
         with self._lock:
             return [p for p in self.placements.values()
-                    if p.host_id == host_id]
+                    if p.host_id == host_id and not p.spec.is_relay]
 
     # -- drain / evict -------------------------------------------------------
     def mark_draining(self, host_id: str) -> list[Placement]:
@@ -540,7 +662,8 @@ class SeatScheduler:
                         < self.evict_hold_s:
                     continue
                 victims = [p for p in self.placements.values()
-                           if p.host_id == host.host_id]
+                           if p.host_id == host.host_id
+                           and not p.spec.is_relay]
                 if not victims:
                     continue
                 by_sid = {s.sid: s.g2g_p99_ms
@@ -576,6 +699,13 @@ class SeatScheduler:
                 "power": {"budget_w": self.power_budget_w,
                           "fleet_watts_est":
                           round(self._fleet_watts_locked(), 2)},
+                "bandwidth": {
+                    "budget_mbps": self.gateway_mbps_budget,
+                    "fleet_mbps_est":
+                    round(self._fleet_mbps_locked(), 2),
+                    "relay_viewers": sum(
+                        1 for p in self.placements.values()
+                        if p.spec.is_relay)},
             }
 
     def _record(self, kind: str, **fields) -> None:
@@ -596,9 +726,13 @@ class SeatScheduler:
             ready = sum(1 for h in self.hosts.values() if h.ready)
             lost = sum(1 for h in self.hosts.values() if h.lost)
             n_hosts = len(self.hosts)
-            n_place = len(self.placements)
+            n_place = sum(1 for p in self.placements.values()
+                          if not p.spec.is_relay)
+            n_relay = sum(1 for p in self.placements.values()
+                          if p.spec.is_relay)
             n_pend = len(self.pending)
             fleet_w = self._fleet_watts_locked()
+            fleet_mbps = self._fleet_mbps_locked()
         metrics.describe("selkies_fleet_watts_est",
                          "Projected fleet power draw (heartbeat "
                          "watts_est floored by scheduler charges)")
@@ -617,3 +751,11 @@ class SeatScheduler:
                           {"state": "lost"})
         metrics.set_gauge("selkies_fleet_placements", n_place)
         metrics.set_gauge("selkies_fleet_pending", n_pend)
+        metrics.describe("selkies_fleet_relay_viewers",
+                         "Relay-only broadcast viewer seats placed")
+        metrics.set_gauge("selkies_fleet_relay_viewers", n_relay)
+        metrics.describe("selkies_fleet_mbps_est",
+                         "Projected gateway egress (heartbeat "
+                         "egress_mbps_est + relay viewer charges)")
+        metrics.set_gauge("selkies_fleet_mbps_est",
+                          round(fleet_mbps, 2))

@@ -28,7 +28,8 @@ import logging
 from typing import Callable, Optional
 
 from ..resilience.supervisor import RestartPolicy, Supervisor
-from .protocol import DeviceCapacity, Heartbeat, SeatSession
+from .protocol import (DeviceCapacity, Heartbeat, SeatSession,
+                       estimate_relay_mbps)
 
 logger = logging.getLogger("selkies_tpu.fleet.sim")
 
@@ -247,12 +248,18 @@ class SimHost:
             if self.slo_fast_burn is not None
             else (20.0 if self.slo_burning else 0.0),
             devices=devices,
+            egress_mbps_est=round(sum(
+                estimate_relay_mbps(s["spec"].width, s["spec"].height,
+                                    s["spec"].codec)
+                for s in self.sessions.values()), 2),
             sessions=[SeatSession(
                 sid=sid, device=s["placement"].device,
                 seat=s["placement"].seat, width=s["spec"].width,
                 height=s["spec"].height, codec=s["spec"].codec,
                 hbm_mb=s["spec"].budget_mb(),
-                g2g_p99_ms=250.0 if self.slo_burning else 40.0)
+                g2g_p99_ms=250.0 if self.slo_burning else 40.0,
+                seat_class=getattr(s["spec"], "seat_class", "encode"),
+                rung=getattr(s["spec"], "rung", ""))
                 for sid, s in self.sessions.items()],
             warm_geometries=self.warm_geometries(),
         )

@@ -248,6 +248,10 @@ class SessionStats:
         #: idle session legitimately delivers nothing, and an fps/qoe
         #: "bad" event for it would burn the error budget while healthy.
         self.last_send_mono: Optional[float] = None
+        #: broadcast rendition rung this session watches (ISSUE 17):
+        #: "" for ordinary seats, the rung name ("src"/"mid"/"low")
+        #: for relay viewers — per-rung QoE/g2g attribution
+        self.rung: str = ""
 
     # -- hot-path writers ---------------------------------------------------
     def note_sent(self, frame_id: int, now: float) -> None:
@@ -441,6 +445,8 @@ class SessionStats:
             "drop_rate": round(self.drop_rate(relay=relay, cc=cc), 4),
             "qoe_score": self.score(now),
         }
+        if self.rung:
+            doc["rung"] = self.rung
         content = self._pull(self.content_provider)
         if content:
             # content-adaptive encoding (ROADMAP 4): class + dirty

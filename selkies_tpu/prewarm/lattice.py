@@ -30,7 +30,8 @@ from typing import Mapping, Optional, Sequence
 
 __all__ = ["Signature", "LatticePlan", "enumerate_lattice",
            "lattice_from_settings", "downscale_factor",
-           "GEOMETRY_FLOOR_PX"]
+           "broadcast_rung_signatures", "GEOMETRY_FLOOR_PX",
+           "BROADCAST_RUNG_FACTORS"]
 
 #: the ladder's capture-downscale floor (mirrors
 #: ``ws_service._apply_ladder_scale``: ``max(64, dim // factor)``)
@@ -235,7 +236,44 @@ def lattice_from_settings(settings,
         roi_qp=bool(g("h264_roi_qp", False)),
         roi_qp_bias=int(g("h264_roi_qp_bias", 4)),
     )
-    return enumerate_lattice(base, steps)
+    plan = enumerate_lattice(base, steps)
+    if bool(g("enable_broadcast", False)):
+        # broadcast rendition rungs (ISSUE 17) warm alongside the
+        # ladder's own points: every rung a viewer can be routed to is
+        # compiled before the first viewer arrives
+        have = set(plan.program_keys)
+        for sig in broadcast_rung_signatures(
+                base, max_rungs=int(g("broadcast_renditions", 3))):
+            if sig.program_key not in have:
+                have.add(sig.program_key)
+                plan.signatures.append(sig)
+    return plan
+
+
+#: the broadcast rendition ladder's spatial factors (ISSUE 17):
+#: src /1, mid /2, low /4 — the same ``scaled()`` derivation as the
+#: degradation ladder's downscale rung, so broadcast rungs warm
+#: through the identical step factories and never mint a compile
+#: surface the lattice doesn't know
+BROADCAST_RUNG_FACTORS = (1, 2, 4)
+
+
+def broadcast_rung_signatures(base: Signature,
+                              max_rungs: int = 3) -> list:
+    """The rendition-ladder signatures for one broadcast desktop,
+    program-deduped (a tiny desktop collapses the ladder at the
+    geometry floor). The prewarm worker compiles these like any other
+    lattice point; ``broadcast/ladder.py`` enumerates its rungs from
+    the same derivation."""
+    out: list = []
+    seen: set = set()
+    for factor in BROADCAST_RUNG_FACTORS[:max(1, int(max_rungs))]:
+        sig = base if factor == 1 else base.scaled(factor)
+        if sig.program_key in seen:
+            continue
+        seen.add(sig.program_key)
+        out.append(sig)
+    return out
 
 
 def rung_targets_from(plan_or_mapping) -> Mapping:

@@ -176,6 +176,9 @@ VIEWER_ALLOWED_PREFIXES = (
     "CLIENT_CLOCK", "CLIENT_STATS", "START_VIDEO", "STOP_VIDEO",
     "REQUEST_KEYFRAME", "START_AUDIO", "STOP_AUDIO", "pong", "_f", "_l",
     "_stats_video", "_stats_audio", "p",
+    # broadcast plane (ISSUE 17): viewer seats are view-only by
+    # construction, yet must pick a rendition rung and report QoE
+    "BROADCAST_VIEW", "BROADCAST_QOE",
 )
 
 #: verbs that mutate the session and need input authority

@@ -1121,6 +1121,12 @@ class WebSocketsService(BaseStreamingService):
         fleet_sid = request.query.get("fleet_sid", "")[:128]
         client.fleet_sid = "".join(
             c for c in fleet_sid if c.isalnum() or c in "._:-")
+        # broadcast rung pin (ISSUE 17): the gateway's rendition
+        # upstream dials ?rung=<name>; attach as a broadcast viewer on
+        # that rung before the first START_VIDEO so the relay is keyed
+        # to the rung's capture from frame one
+        rung_q = request.query.get("rung", "")[:32]
+        rung_q = "".join(c for c in rung_q if c.isalnum() or c in "._-")
         # only the first full client gets input authority unless collab
         if role == "full" and not self.settings.enable_collab:
             if any(c.role == "full" for c in self.clients.values()):
@@ -1154,6 +1160,9 @@ class WebSocketsService(BaseStreamingService):
             # late joiners get the current cursor immediately
             if getattr(self, "_last_cursor_msg", None):
                 await ws.send_str(self._last_cursor_msg)
+            if rung_q and bool(getattr(self.settings,
+                                       "enable_broadcast", False)):
+                await self._h_broadcast_view(client, rung_q)
             async for msg in ws:
                 if msg.type == WSMsgType.TEXT:
                     await self._on_text(client, msg.data)
@@ -1171,6 +1180,7 @@ class WebSocketsService(BaseStreamingService):
         if client.paused:
             self._apply_pipeline_clamp()
         _qoe.registry.unregister(client.qoe)
+        self._broadcast_detach(client)
         self._drop_relay_supervision(client)
         for relay in client.relays.values():
             await relay.close()
@@ -1246,6 +1256,8 @@ class WebSocketsService(BaseStreamingService):
             "pong": self._h_pong, "_f": self._h_client_fps,
             "_l": self._h_client_latency,
             "SET_NATIVE_CURSOR_RENDERING": self._h_cursor_mode,
+            "BROADCAST_VIEW": self._h_broadcast_view,
+            "BROADCAST_QOE": self._h_broadcast_qoe,
         }.get(name)
         if handler is not None:
             await handler(client, verb.args)
@@ -1620,6 +1632,123 @@ class WebSocketsService(BaseStreamingService):
         # only the requesting client's display: REQUEST_KEYFRAME from one
         # viewer must not IDR-storm every capture (VERDICT r3 weak 7)
         self._request_idr(client.display)
+
+    # ---------------------------------------------- broadcast plane (ISSUE 17)
+    def _broadcast_state(self) -> dict:
+        """Lazy broadcast-plane state: the desktop's rendition ladder
+        plus the viewer registry routing clients onto its rungs."""
+        st = getattr(self, "_bcast_state", None)
+        if st is None:
+            from ..broadcast.ladder import ladder_from_settings
+            from ..broadcast.registry import ViewerRegistry
+            ladder = ladder_from_settings(self.settings)
+            reg = ViewerRegistry(
+                ladder, source=self._default_display(),
+                label_cap=int(getattr(self.settings,
+                                      "qoe_seat_label_cap", 8)),
+                on_switch=self._on_broadcast_switch)
+            st = {"ladder": ladder, "registry": reg, "clients": {}}
+            self._bcast_state = st
+        return st
+
+    def _rung_display(self, rend) -> str:
+        """Display id carrying a rung's capture. The source rung rides
+        the desktop's own capture; downscaled rungs get derived display
+        ids (``:0@mid``) so ``_ensure_capture`` builds them through the
+        exact same capture/step factories as any seat — the rendition
+        encode surface is the lattice's, not a new one."""
+        base = self._default_display()
+        if rend.downscale <= 1:
+            return base
+        did = f"{base}@{rend.name}"
+        self.display_geometry.setdefault(did, (rend.width, rend.height))
+        return did
+
+    def _on_broadcast_switch(self, state, old: int, new: int) -> None:
+        """ViewerRegistry on_switch hook (sync, called outside its
+        lock): re-key the viewer's relay onto the new rung, IDR first
+        frame. Registry already counted the idr_resync."""
+        st = self._broadcast_state()
+        client = st["clients"].get(state.sid)
+        if client is None:
+            return
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return                    # no loop: sync test rigs route only
+        self._spawn_retained(
+            self._apply_broadcast_rung(client, st["ladder"].rung(new)),
+            "broadcast_switch")
+
+    async def _apply_broadcast_rung(self, client: ClientConnection,
+                                    rend) -> None:
+        """Move a viewer's relay onto a rung's capture. Every switch is
+        IDR-resynced: the new rung's chain gates start shut and only a
+        keyframe reopens them, so the first delivered frame is a clean
+        decoder entry point."""
+        did = self._rung_display(rend)
+        old_did = client.display
+        if client.qoe is not None:
+            client.qoe.rung = rend.name
+        if did == old_did and did in client.relays:
+            self._request_idr(did)
+            return
+        client.display = did
+        old = client.relays.pop(old_did, None)
+        if old is not None:
+            sup = self._supervisor()
+            if sup is not None:
+                sup.drop(f"relay:{client.id}:{old_did}")
+            await old.close()
+        if did not in client.relays:
+            self._make_relay(client, did)
+        if client.video_active:
+            self._ensure_capture(did)
+            self._request_idr(did)
+        self._maybe_stop_captures()
+
+    async def _h_broadcast_view(self, client: ClientConnection,
+                                args: str) -> None:
+        """``BROADCAST_VIEW[,rung]``: attach this client as a broadcast
+        viewer on a ladder rung (default: the source rung)."""
+        if not bool(getattr(self.settings, "enable_broadcast", False)):
+            await client.ws.send_str("BROADCAST_DISABLED")
+            return
+        st = self._broadcast_state()
+        ladder = st["ladder"]
+        name = (args or "").strip().partition(",")[0]
+        idx = ladder.index_of(name) if name else 0
+        st["clients"][str(client.id)] = client
+        state = st["registry"].attach(str(client.id), rung=idx)
+        rend = ladder.rung(state.rung)
+        await self._apply_broadcast_rung(client, rend)
+        st["registry"].export_metrics()
+        await client.ws.send_str(f"BROADCAST_RUNG,{rend.name}")
+
+    async def _h_broadcast_qoe(self, client: ClientConnection,
+                               args: str) -> None:
+        """``BROADCAST_QOE,<score 0-100>``: the viewer's QoE verdict.
+        Ladder-per-session routing with dwell hysteresis; a landed
+        switch re-keys the relay and IDR-resyncs (on_switch hook)."""
+        st = getattr(self, "_bcast_state", None)
+        if st is None or str(client.id) not in st["clients"]:
+            return
+        try:
+            score = float((args or "").partition(",")[0])
+        except ValueError:
+            return
+        content = self._content_state_for(
+            self._default_display()).get("class")
+        st["registry"].route(str(client.id), score=score,
+                             content_class=content)
+        st["registry"].export_metrics()
+
+    def _broadcast_detach(self, client: ClientConnection) -> None:
+        st = getattr(self, "_bcast_state", None)
+        if st is not None \
+                and st["clients"].pop(str(client.id), None) is not None:
+            st["registry"].detach(str(client.id))
+            st["registry"].export_metrics()
 
     async def _h_start_audio(self, client: ClientConnection, args: str) -> None:
         if self.audio is None or not self.settings.enable_audio:

@@ -178,6 +178,14 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
     _s("h264_roi_qp_bias", SType.INT, 4,
        "QP sharpening applied to freshly-damaged macroblocks when "
        "h264_roi_qp is on.", vmin=0, vmax=12),
+    _s("enable_broadcast", SType.BOOL, False,
+       "Broadcast plane (ROADMAP 3): encode this desktop at a rendition "
+       "ladder and let the fleet gateway fan each rung out to relay-only "
+       "viewers; rung signatures prewarm through the standard lattice."),
+    _s("broadcast_renditions", SType.INT, 3,
+       "Rendition ladder rungs per broadcast desktop (src/mid/low); "
+       "device work per frame is bounded by this count, never by the "
+       "viewer count.", vmin=1, vmax=3),
     _s("watermark_path", SType.STR, "", "PNG burned into the framebuffer on device."),
     _s("watermark_location", SType.INT, 6, "0-6 anchor enum (reference parity).",
        vmin=0, vmax=6),

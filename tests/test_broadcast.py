@@ -1,0 +1,556 @@
+"""Broadcast plane contracts (ISSUE 17): rendition ladder enumeration
+and content pruning, viewer-registry rung routing with dwell hysteresis
+and IDR resync, bounded viewer metric cardinality, the fan-out hub's
+refcounted grace release (reconnect cancels, shutdown leaks nothing),
+relay-only seats on the scheduler's bandwidth axis, and the gateway's
+1-to-N viewer endpoint — all on injected clocks and fake timers."""
+
+import asyncio
+
+import pytest
+
+from selkies_tpu.broadcast.fanout import RenditionHub
+from selkies_tpu.broadcast.ladder import RenditionLadder
+from selkies_tpu.broadcast.registry import ViewerRegistry
+from selkies_tpu.fleet.migrate import MigrationCoordinator
+from selkies_tpu.fleet.protocol import (DeviceCapacity,
+                                        FleetProtocolError, Heartbeat,
+                                        estimate_relay_mbps,
+                                        parse_session_spec)
+from selkies_tpu.fleet.scheduler import SeatScheduler
+from selkies_tpu.fleet.sim import SimFleet, SimHost
+from selkies_tpu.obs.health import FlightRecorder
+from selkies_tpu.prewarm.lattice import (Signature,
+                                         broadcast_rung_signatures,
+                                         lattice_from_settings)
+
+
+def _ladder(width=1920, height=1080, codec="h264", **kw):
+    return RenditionLadder(Signature(width=width, height=height,
+                                     codec=codec), **kw)
+
+
+# ----------------------------------------------------------------- ladder
+
+def test_ladder_enumeration_and_content_pruning():
+    ladder = _ladder()
+    assert ladder.names() == ["src", "mid", "low"]
+    assert [r.width for r in ladder.rungs] == [1920, 960, 480]
+    assert ladder.rungs[2].fps_divisor == 2
+    # cheaper down the ladder: the relay economics must be monotone
+    ks = [r.kbps_est for r in ladder.rungs]
+    assert ks[0] > ks[1] > ks[2] > 0
+    # PR-15 content classes prune pointless rungs; the top rung and
+    # therefore at least ONE rung always survives
+    assert [r.name for r in ladder.active("static")] == ["src"]
+    assert ladder.device_dispatches_per_frame("static") == 1
+    assert ladder.device_dispatches_per_frame("scroll") == 2
+    assert ladder.device_dispatches_per_frame("video") == 3
+    assert ladder.device_dispatches_per_frame(None) == 3
+
+
+def test_ladder_rung_selection():
+    ladder = _ladder()
+    # ladder-per-session (WS): QoE score verdict
+    assert ladder.rung_for_score(90.0) == 0
+    assert ladder.rung_for_score(55.0) == 1
+    assert ladder.rung_for_score(10.0) == 2
+    # simulcast (WebRTC): congestion-controller target bitrate picks
+    # the best rung that fits under it
+    assert ladder.rung_for_bitrate(10_000.0) == 0
+    assert ladder.rung_for_bitrate(2_000.0) == 1
+    assert ladder.rung_for_bitrate(100.0) == 2
+
+
+def test_ladder_dedups_at_geometry_floor():
+    # a tiny desktop collapses the ladder: /2 and /4 floor to the same
+    # program, so only one downscaled rung is enumerated
+    ladder = _ladder(width=128, height=96, codec="jpeg")
+    assert len(ladder) == 2
+    assert ladder.rungs[1].width == 64
+
+
+def test_broadcast_rungs_ride_the_prewarm_lattice():
+    # the ladder's signatures ARE lattice points: the prewarm worker
+    # warms them through the same step factories as any seat
+    base = Signature(width=1920, height=1080, codec="h264")
+    sigs = broadcast_rung_signatures(base)
+    assert [s.width for s in sigs] == [1920, 960, 480]
+    assert [r.signature.program_key for r in _ladder().rungs] == \
+        [s.program_key for s in sigs]
+
+    class NS:
+        pass
+
+    ns = NS()
+    ns.enable_broadcast = True
+    plan = lattice_from_settings(ns)
+    assert any(s.width == 480 for s in plan.signatures)
+    off = lattice_from_settings(NS())   # gated: default stays put
+    assert not any(s.width == 480 for s in off.signatures)
+
+
+# --------------------------------------------------------------- registry
+
+def test_registry_hysteresis_switch_and_idr_hook():
+    switches = []
+    reg = ViewerRegistry(_ladder(), source="d", clock=lambda: 0.0,
+                         switch_dwell=3,
+                         on_switch=lambda st, old, new:
+                         switches.append((st.sid, old, new)))
+    reg.attach("v1", rung=0)
+    # two bad verdicts hold; the third lands the switch
+    assert reg.route("v1", score=20.0) == 0
+    assert reg.route("v1", score=20.0) == 0
+    assert reg.route("v1", score=20.0) == 2
+    assert switches == [("v1", 0, 2)]
+    st = reg.get("v1")
+    assert st.rung_switches == 1 and st.idr_resyncs == 1
+    # one healthy blip doesn't flap back up
+    reg.route("v1", score=90.0)
+    assert reg.get("v1").rung == 2
+    # a changed desire resets the dwell streak
+    reg.route("v1", score=55.0)
+    reg.route("v1", score=90.0)
+    reg.route("v1", score=90.0)
+    assert reg.get("v1").rung == 2
+    reg.route("v1", score=90.0)
+    assert reg.get("v1").rung == 0
+    assert reg.total_switches == 2
+
+
+def test_registry_clamps_routing_to_active_rungs():
+    # static content prunes every rung but the source: a terrible
+    # score must never route a viewer onto a pruned rung
+    reg = ViewerRegistry(_ladder(), source="d", switch_dwell=1)
+    reg.attach("v1")
+    assert reg.route("v1", score=5.0, content_class="static") == 0
+    assert reg.get("v1").rung_switches == 0
+    # scroll keeps the downscale rung: the same score lands there
+    assert reg.route("v1", score=5.0, content_class="scroll") == 1
+
+
+def test_registry_snapshot_and_g2g():
+    reg = ViewerRegistry(_ladder(), source="d", clock=lambda: 7.0)
+    reg.attach("v1")
+    for ms in (40.0, 42.0, 55.0):
+        reg.note_frame("v1", g2g_ms=ms, size_bytes=1000)
+    snap = reg.snapshot()
+    assert snap["viewers"] == 1 and snap["per_rung"]["src"] == 1
+    sess = snap["sessions"][0]
+    assert sess["frames"] == 3 and sess["bytes"] == 3000
+    assert sess["g2g_p99_ms"] == 55.0
+    reg.detach("v1")
+    assert len(reg) == 0
+
+
+def test_registry_metric_cardinality_capped():
+    # satellite: viewer series bounded like qoe_seat_label_cap — the
+    # first label_cap viewers get series, everyone else rolls into
+    # seat="_overflow"; a 10k-viewer webinar cannot mint 10k series
+    from selkies_tpu.server import metrics
+    metrics.clear()
+    reg = ViewerRegistry(_ladder(), source="d", label_cap=4)
+    for i in range(10):
+        reg.attach(f"v{i}")
+        reg.note_frame(f"v{i}", g2g_ms=50.0, size_bytes=100)
+    reg.export_metrics()
+    seats = set()
+    for line in metrics.render_prometheus().splitlines():
+        if line.startswith("selkies_broadcast_viewer_bytes{"):
+            for part in line[line.index("{") + 1:
+                             line.index("}")].split(","):
+                if part.startswith("seat="):
+                    seats.add(part.split("=", 1)[1].strip('"'))
+    assert len(seats) == 5 and "_overflow" in seats
+    assert sum(1 for s in seats if s != "_overflow") == 4
+
+
+# -------------------------------------------------------------------- hub
+
+class FakeSchedule:
+    """Manual grace-timer seam: fire_all() is 'the grace elapsed'."""
+
+    def __init__(self):
+        self.timers = []
+        self.cancelled = 0
+
+    def __call__(self, delay, cb):
+        outer = self
+
+        class T:
+            def cancel(self):
+                outer.cancelled += 1
+                if self in outer.timers:
+                    outer.timers.remove(self)
+
+            def fire(self):
+                if self in outer.timers:
+                    outer.timers.remove(self)
+                    cb()
+
+        t = T()
+        self.timers.append(t)
+        return t
+
+    def fire_all(self):
+        for t in list(self.timers):
+            t.fire()
+
+
+def test_hub_refcount_grace_and_reconnect_cancel():
+    sched = FakeSchedule()
+    opens, closes = [], []
+    hub = RenditionHub(schedule=sched, grace_s=1.0,
+                       on_open=lambda s, r: opens.append((s, r)),
+                       on_close=lambda s, r: closes.append((s, r)))
+    assert hub.subscribe("d", "src", "v1") == 1
+    assert hub.subscribe("d", "src", "v2") == 2
+    assert opens == [("d", "src")]        # refcounted: opened ONCE
+    assert hub.publish("d", "src", b"f") == 2
+    hub.unsubscribe("d", "src", "v1")
+    assert not sched.timers               # not last-out: no timer
+    hub.unsubscribe("d", "src", "v2")
+    assert len(sched.timers) == 1         # last-out arms the grace
+    # reconnect inside the grace cancels the release: never flaps
+    hub.subscribe("d", "src", "v2")
+    assert not sched.timers and closes == [] and sched.cancelled == 1
+    hub.unsubscribe("d", "src", "v2")
+    sched.fire_all()
+    assert closes == [("d", "src")]
+    assert hub.open_rungs() == [] and hub.upstream_closes == 1
+
+
+def test_hub_move_never_dips_and_shutdown_cancels():
+    sched = FakeSchedule()
+    closes = []
+    hub = RenditionHub(schedule=sched, grace_s=1.0,
+                       on_close=lambda s, r: closes.append((s, r)))
+    hub.subscribe("d", "src", "v1")
+    hub.move("d", "src", "low", "v1")
+    # new rung opened BEFORE the old one's grace even starts
+    assert ("d", "low") in hub.open_rungs()
+    assert len(sched.timers) == 1         # old rung pending release
+    # gateway shutdown: every pending timer cancelled, every open
+    # upstream closed, later subscribes refused
+    hub.shutdown()
+    assert sched.cancelled == 1 and not sched.timers
+    assert hub.pending_releases() == 0 and hub.open_rungs() == []
+    assert ("d", "low") in closes
+    assert hub.subscribe("d", "src", "v9") == 0
+
+
+def test_hub_failing_sink_is_isolated():
+    hub = RenditionHub()
+    got = []
+    hub.subscribe("d", "src", "bad", lambda f: 1 / 0)
+    hub.subscribe("d", "src", "good", got.append)
+    assert hub.publish("d", "src", b"x") == 1
+    assert got == [b"x"]
+    assert hub.publish("d", "nope", b"x") == 0
+
+
+# -------------------------------------------- scheduler: relay-only seats
+
+def _rig(**sched_kw):
+    clock_box = [0.0]
+    rec = FlightRecorder()
+    sched = SeatScheduler(clock=lambda: clock_box[0], recorder=rec,
+                          host_timeout_s=3.0, **sched_kw)
+    coord = MigrationCoordinator(sched, clock=lambda: clock_box[0],
+                                 recorder=rec, grace_s=3.0)
+    fleet = SimFleet(sched, coord, clock_box=clock_box)
+    fleet.add_host(SimHost("h0", clock=fleet.clock, devices=1,
+                           seat_slots=4, hbm_limit_mb=4096.0,
+                           pixel_budget=3 * 1920 * 1080,
+                           warm_after_s=0.0, grace_s=3.0, recorder=rec))
+    fleet.tick(0.5)
+    return fleet, sched, coord, rec
+
+
+def _relay_doc(sid, source="desk", w=480, h=270, rung="low"):
+    return {"v": 1, "kind": "place", "sid": sid, "seat_class": "relay",
+            "source_sid": source, "rung": rung, "width": w, "height": h,
+            "codec": "h264"}
+
+
+def test_relay_spec_budgets_bandwidth_not_hbm():
+    spec = parse_session_spec(_relay_doc("v1"))
+    assert spec.is_relay and spec.source_sid == "desk"
+    # the relay-only fix: zero HBM, zero pixels, zero watts — the seat
+    # is billed on the gateway's bandwidth axis instead
+    assert spec.budget_mb() == 0.0 and spec.pixels == 0
+    assert spec.budget_w() == 0.0
+    assert spec.budget_mbps() == estimate_relay_mbps(480, 270, "h264")
+    assert spec.budget_mbps() > 0.0
+    # a relay without its source is meaningless: strict-parse rejects
+    with pytest.raises(FleetProtocolError):
+        parse_session_spec({"v": 1, "kind": "place", "sid": "v1",
+                            "seat_class": "relay", "width": 640,
+                            "height": 360})
+    with pytest.raises(FleetProtocolError):
+        parse_session_spec({"v": 1, "kind": "place", "sid": "v1",
+                            "seat_class": "weird", "width": 640,
+                            "height": 360})
+
+
+def test_relay_placement_pinned_and_bandwidth_refused():
+    fleet, sched, coord, rec = _rig(gateway_mbps_budget=2.0)
+    desk = parse_session_spec({"v": 1, "kind": "place", "sid": "desk",
+                               "width": 1920, "height": 1080,
+                               "codec": "h264"})
+    assert sched.place(desk) is not None
+    # each low rung viewer is ~0.5 Mbps: budget 2.0 admits four
+    placed = []
+    for i in range(6):
+        p = sched.place(parse_session_spec(_relay_doc(f"v{i}")))
+        if p is not None:
+            placed.append(p)
+    assert len(placed) == 4
+    assert all(p.host_id == "h0" for p in placed)     # pinned to source
+    assert len(sched.pending) == 2                    # refusal queues
+    # relays never appear in host seat work: one encode session only
+    assert len(fleet.hosts["h0"].sessions) == 1
+    assert len(sched.placements_on("h0")) == 1
+    bw = sched.snapshot()["bandwidth"]
+    assert bw["relay_viewers"] == 4 and bw["budget_mbps"] == 2.0
+    assert bw["fleet_mbps_est"] >= 4 * 0.5
+
+
+def test_relay_released_with_its_source():
+    fleet, sched, coord, rec = _rig(gateway_mbps_budget=100.0)
+    desk = parse_session_spec({"v": 1, "kind": "place", "sid": "desk",
+                               "width": 640, "height": 360,
+                               "codec": "h264"})
+    assert sched.place(desk) is not None
+    for i in range(3):
+        assert sched.place(
+            parse_session_spec(_relay_doc(f"v{i}"))) is not None
+    sched.release("desk")
+    # the cascade: a released source takes its viewers with it
+    assert all(sched.get(f"v{i}") is None for i in range(3))
+    kinds = [e["kind"] for e in rec.snapshot()]
+    assert kinds.count("viewer_released") >= 3
+
+
+def test_relay_viewer_in_sim_heartbeat_round_trip():
+    fleet, sched, coord, rec = _rig(gateway_mbps_budget=100.0)
+    desk = parse_session_spec({"v": 1, "kind": "place", "sid": "desk",
+                               "width": 1920, "height": 1080,
+                               "codec": "h264"})
+    sched.place(desk)
+    sched.place(parse_session_spec(_relay_doc("v0")))
+    fleet.tick(1.0)
+    # the new heartbeat fields (egress estimate, seat class, rung)
+    # round-trip the strict wire parser with zero rejections
+    assert fleet.heartbeats_sent > 0
+    assert fleet.heartbeats_rejected == 0
+    host = sched.hosts.get("h0")
+    assert host is not None
+    assert (host.heartbeat.egress_mbps_est or 0.0) > 0.0
+
+
+# ----------------------------------------------------- gateway fan-out WS
+
+async def _gw_client(gw):
+    from aiohttp.test_utils import TestClient, TestServer
+    client = TestClient(TestServer(gw.make_app()))
+    await client.start_server()
+    return client
+
+
+async def _gw_with_source():
+    from selkies_tpu.fleet.gateway import FleetGateway
+    gw = FleetGateway(sweep_interval_s=3600.0)
+    c = await _gw_client(gw)
+    hb = Heartbeat(host_id="h0", url="http://127.0.0.1:9", ready=True)
+    hb.devices.append(DeviceCapacity(id=0, hbm_limit_mb=8192.0,
+                                     seat_slots=4))
+    r = await c.post("/fleet/heartbeat", data=hb.to_json())
+    assert r.status == 200
+    r = await c.post("/fleet/place", json={
+        "v": 1, "kind": "place", "sid": "desk",
+        "width": 1920, "height": 1080, "codec": "h264"})
+    assert r.status == 200
+    return gw, c
+
+
+async def test_gateway_broadcast_viewer_lifecycle_and_grace():
+    """Satellite: reconnect-grace under broadcast fan-out — reconnect
+    cancels the seat timer, last-viewer-close frees the rendition
+    subscription after the grace."""
+    gw, c = await _gw_with_source()
+    gw.release_grace_s = 0.05
+    gw.hub.grace_s = 0.05
+    try:
+        r = await c.get("/fleet/broadcast/ws?source=ghost")
+        assert r.status == 404
+        ws = await c.ws_connect("/fleet/broadcast/ws?source=desk&vid=v1")
+        await asyncio.sleep(0.05)
+        p = gw.scheduler.get("v1")
+        assert p is not None and p.spec.is_relay and p.host_id == "h0"
+        assert p.spec.budget_mb() == 0.0 and p.spec.pixels == 0
+        reg = gw._registries["desk"]
+        assert gw.hub.viewer_count("desk") == 1
+        # three bad QoE verdicts: dwell-hysteresed switch, IDR resync
+        for _ in range(3):
+            await ws.send_str("qoe,10")
+        await ws.send_str("g2g,48.5")
+        await asyncio.sleep(0.1)
+        st = reg.get("v1")
+        assert st.rung == len(reg.ladder) - 1
+        assert st.rung_switches == 1 and st.idr_resyncs == 1
+        low = reg.ladder.rung(st.rung).name
+        assert ("desk", low) in gw.hub.open_rungs()
+        assert st.g2g_p99_ms() == 48.5
+        info = await c.get("/fleet/broadcast/desk")
+        body = await info.json()
+        assert body["found"] and body["rung_switches"] == 1
+        await ws.close()
+        await asyncio.sleep(0.02)
+        # inside the grace: seat survives; reconnect cancels the timer
+        assert gw.scheduler.get("v1") is not None
+        ws = await c.ws_connect("/fleet/broadcast/ws?source=desk&vid=v1")
+        await asyncio.sleep(0.02)
+        assert "v1" not in gw._release_timers
+        assert gw.scheduler.get("v1") is not None
+        await ws.close()
+        await asyncio.sleep(0.2)
+        # grace expired with nobody back: seat released, rendition
+        # subscriptions freed, upstreams balanced
+        assert gw.scheduler.get("v1") is None
+        assert gw.hub.open_rungs() == []
+        assert gw.hub.upstream_closes == gw.hub.upstream_opens
+    finally:
+        await c.close()
+
+
+async def test_gateway_shutdown_cancels_broadcast_timers():
+    """Satellite: gateway shutdown cancels pending grace timers and
+    upstream pumps — nothing leaks past cleanup."""
+    gw, c = await _gw_with_source()
+    gw.release_grace_s = 30.0
+    gw.hub.grace_s = 30.0
+    closed = False
+    try:
+        ws = await c.ws_connect("/fleet/broadcast/ws?source=desk&vid=v1")
+        await asyncio.sleep(0.05)
+        await ws.close()
+        await asyncio.sleep(0.02)
+        assert gw.hub.pending_releases() == 1
+        assert "v1" in gw._release_timers
+        await c.close()       # app cleanup runs _stop_sweep
+        closed = True
+        assert gw.hub.pending_releases() == 0
+        assert gw._release_timers == {}
+        assert gw._upstream_tasks == {}
+        assert gw._registries == {} and gw._viewer_sinks == {}
+    finally:
+        if not closed:
+            await c.close()
+
+
+async def test_gateway_broadcast_egress_budget_refusal():
+    gw, c = await _gw_with_source()
+    gw.scheduler.gateway_mbps_budget = 0.25   # below one viewer's cost
+    try:
+        r = await c.get("/fleet/broadcast/ws?source=desk&vid=v9",
+                        headers={"Connection": "Upgrade",
+                                 "Upgrade": "websocket",
+                                 "Sec-WebSocket-Version": "13",
+                                 "Sec-WebSocket-Key": "x3JJHMbDL1EzLkh9GBhXDw=="})
+        assert r.status == 503
+        # the refused spec must not linger in the queue
+        assert all(s.sid != "v9" for s, _ in gw.scheduler.pending)
+    finally:
+        await c.close()
+
+
+# ------------------------------------------------- ws_service viewer verbs
+
+class _NullCapture:
+    def is_capturing(self):
+        return False
+
+    def request_idr_frame(self):
+        pass
+
+    def stop_capture(self):
+        pass
+
+    def set_cursor_callback(self, cb):
+        pass
+
+
+def _make_ws_server(**fields):
+    from selkies_tpu.input.backends import NullBackend
+    from selkies_tpu.input.handler import InputHandler
+    from selkies_tpu.server.core import CentralizedStreamServer
+    from selkies_tpu.server.ws_service import WebSocketsService
+    from selkies_tpu.settings import AppSettings
+    s = AppSettings.parse([], {})
+    for k, v in fields.items():
+        s.set_server(k, v)
+    svc = WebSocketsService(s, input_handler=InputHandler(
+        backend=NullBackend()), capture_factory=lambda: _NullCapture())
+    server = CentralizedStreamServer(s)
+    server.register_service("websockets", svc)
+    return server, svc
+
+
+async def test_ws_broadcast_disabled_by_default(client_factory):
+    server, svc = _make_ws_server()
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str()
+    await ws.receive_str()
+    await ws.send_str("BROADCAST_VIEW")
+    assert (await ws.receive_str()) == "BROADCAST_DISABLED"
+    await ws.close()
+
+
+async def test_ws_broadcast_view_and_qoe_routing(client_factory):
+    server, svc = _make_ws_server(enable_broadcast=True)
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets")
+    await ws.receive_str()
+    await ws.receive_str()
+    await ws.send_str("BROADCAST_VIEW")
+    assert (await ws.receive_str()) == "BROADCAST_RUNG,src"
+    st = svc._bcast_state
+    assert len(st["registry"]) == 1
+    (sid, client), = st["clients"].items()
+    assert client.qoe.rung == "src"
+    # three bad verdicts land the hysteresed switch; the relay re-keys
+    # onto the low rung's derived display and QoE carries the rung
+    for _ in range(3):
+        await ws.send_str("BROADCAST_QOE,15")
+    await asyncio.sleep(0.2)
+    vs = st["registry"].get(sid)
+    low = st["ladder"].rung(len(st["ladder"]) - 1)
+    assert vs.rung == len(st["ladder"]) - 1
+    assert vs.idr_resyncs == 1
+    assert client.display.endswith(f"@{low.name}")
+    assert client.qoe.rung == low.name
+    assert client.display in client.relays
+    # rung attribution reaches the QoE snapshot (obs satellite)
+    assert client.qoe.snapshot()["rung"] == low.name
+    await ws.close()
+    await asyncio.sleep(0.05)
+    assert len(st["registry"]) == 0      # disconnect detaches
+
+
+async def test_ws_broadcast_rung_query_pin(client_factory):
+    # the gateway's rendition upstream dials ?rung=<name>: the client
+    # is attached on that rung before its first START_VIDEO
+    server, svc = _make_ws_server(enable_broadcast=True)
+    c = await client_factory(server)
+    ws = await c.ws_connect("/api/websockets?rung=mid")
+    await ws.receive_str()
+    await ws.receive_str()
+    assert (await ws.receive_str()) == "BROADCAST_RUNG,mid"
+    st = svc._bcast_state
+    (sid, client), = st["clients"].items()
+    assert st["registry"].get(sid).rung == st["ladder"].index_of("mid")
+    assert client.display.endswith("@mid")
+    await ws.close()

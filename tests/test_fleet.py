@@ -100,6 +100,22 @@ def test_heartbeat_round_trips_through_wire_parser():
      "watts_est": float("nan")},
     {"v": 1, "kind": "heartbeat", "host_id": "x", "watts_est": -3},
     {"v": 1, "kind": "heartbeat", "host_id": "x", "watts_est": 1e9},
+    # egress_mbps_est (ISSUE 17) budgets the gateway fan-out: a NaN /
+    # negative / absurd estimate would corrupt relay admission, so the
+    # field rejects+counts like every other capacity axis
+    {"v": 1, "kind": "heartbeat", "host_id": "x",
+     "egress_mbps_est": float("nan")},
+    {"v": 1, "kind": "heartbeat", "host_id": "x",
+     "egress_mbps_est": -1},
+    {"v": 1, "kind": "heartbeat", "host_id": "x",
+     "egress_mbps_est": 1e12},
+    # seat_class is an enum (encode|relay); rung is a bounded ident
+    {"v": 1, "kind": "heartbeat", "host_id": "x",
+     "sessions": [{"sid": "s1", "width": 640, "height": 360,
+                   "seat_class": "mystery"}]},
+    {"v": 1, "kind": "heartbeat", "host_id": "x",
+     "sessions": [{"sid": "s1", "width": 640, "height": 360,
+                   "rung": "r" * 64}]},
 ])
 def test_malformed_heartbeats_rejected(doc):
     with pytest.raises(FleetProtocolError):
@@ -113,6 +129,22 @@ def test_heartbeat_watts_est_round_trips():
     # absent stays absent (older hosts): never defaulted to a number
     assert parse_heartbeat(Heartbeat(host_id="h0").to_json()) \
         .watts_est is None
+
+
+def test_heartbeat_egress_and_seat_class_round_trip():
+    # ISSUE 17: the egress estimate and relay seat annotations survive
+    # the wire parser; absent egress stays absent (older hosts)
+    hb = Heartbeat(host_id="h0", egress_mbps_est=7.46)
+    back = parse_heartbeat(hb.to_json())
+    assert back.egress_mbps_est == 7.46
+    assert parse_heartbeat(Heartbeat(host_id="h0").to_json()) \
+        .egress_mbps_est is None
+    doc = {"v": 1, "kind": "heartbeat", "host_id": "h0",
+           "sessions": [{"sid": "v1", "width": 640, "height": 360,
+                         "seat_class": "relay", "rung": "low"}]}
+    back = parse_heartbeat(doc)
+    assert back.sessions[0].seat_class == "relay"
+    assert back.sessions[0].rung == "low"
 
 
 def test_session_spec_and_estimate():

@@ -177,6 +177,11 @@ def entry_from_bench(doc: dict, *, git_rev: Optional[str] = None,
         # the --adaptive acceptance block (encode ms vs dirty fraction,
         # content-class timeline) when that phase ran
         "adaptive": doc.get("adaptive"),
+        # broadcast plane (ISSUE 17): fan-out scale of a --broadcast
+        # row — device work must track renditions, never viewers, so
+        # both axes belong in the trajectory
+        "viewers": doc.get("viewers"),
+        "renditions": doc.get("renditions"),
     }
 
 
