@@ -1369,8 +1369,9 @@ def fleet_main() -> None:
     headline bench)."""
     import random
 
-    from selkies_tpu.fleet import (MigrationCoordinator, SeatScheduler,
-                                   SessionSpec, SimFleet, SimHost)
+    from selkies_tpu.fleet import (FleetObserver, MigrationCoordinator,
+                                   SeatScheduler, SessionSpec, SimFleet,
+                                   SimHost)
     from selkies_tpu.obs.health import FlightRecorder
 
     seed = int(os.environ.get("BENCH_FLEET_SEED", "1234"))
@@ -1390,6 +1391,12 @@ def fleet_main() -> None:
     coord = MigrationCoordinator(sched, clock=clock, recorder=recorder,
                                  grace_s=3.0)
     fleet = SimFleet(sched, coord, clock_box=clock_box)
+    # the fleet observability plane (ISSUE 18): label cap BELOW the
+    # host count so the _overflow rollup contract is exercised, and a
+    # 2-host failed threshold so the verdict flip is provable
+    obs = FleetObserver(sched, coord, clock=clock, recorder=recorder,
+                        host_label_cap=2, failed_hosts=2)
+    fleet.observer = obs
 
     # host-0/1 boot warm-ish; the LAST host stays cold for 3 s — the
     # readiness-gate proof rides on nothing landing there before then
@@ -1450,15 +1457,28 @@ def fleet_main() -> None:
     }
     log(f"fleet placement: {placement_doc}")
 
+    # an injected host-local incident: its bounded heartbeat digest
+    # must surface fleet-wide exactly ONCE however many beats repeat it
+    fleet.hosts[cold_host].incident("qoe_collapse")
+    fleet.tick(0.5)
+    fleet.tick(0.5)
+
     # -- phase 2: planned drain of host-0 -----------------------------------
     drain_seats = len(sched.placements_on("host-0"))
     resyncs_before = sum(h.idr_resyncs for h in fleet.hosts.values())
     report = coord.evacuate("host-0")
+    drain_corr = report["correlation_id"]
     fleet.tick(0.5)
     resyncs_after = sum(h.idr_resyncs for h in fleet.hosts.values())
     wedged = sum(1 for sid in placements
                  if sched.get(sid) is None
                  and not any(sid == s2.sid for s2, _ in sched.pending))
+    # the simulated clients play their side (reconnect -> IDR resync ->
+    # first frame) over the next ticks; the correlated drain timeline
+    # must COMPLETE before the kill phase re-traces any of these seats
+    fleet.run_until(lambda: obs.migration_report(drain_corr)["complete"],
+                    dt=0.5, budget_s=10.0)
+    drain_trace = obs.migration_report(drain_corr)
     drain_doc = {
         "host": "host-0",
         "seats": drain_seats,
@@ -1483,13 +1503,137 @@ def fleet_main() -> None:
         lambda: not any(p.host_id == victim
                         for p in sched.placements.values())
         and not sched.pending, dt=0.5, budget_s=10.0)
+    failover_corr = None
     for e in recorder.snapshot():
         if e["kind"] == "host_failover" and e.get("host_id") == victim:
             failover_doc["replaced"] = e["replaced"]
             failover_doc["within_grace"] = e["within_grace"]
+            failover_corr = e.get("correlation_id")
     failover_doc["queued"] = len(sched.pending)
     failover_doc["final_pending"] = len(sched.pending)
     log(f"fleet failover: {failover_doc}")
+    if failover_corr is not None:
+        fleet.run_until(
+            lambda: obs.migration_report(failover_corr)["complete"],
+            dt=0.5, budget_s=10.0)
+    failover_trace = obs.migration_report(failover_corr) \
+        if failover_corr else {"complete": False, "ordered": False,
+                               "seats": []}
+
+    # -- phase 4: the observability plane's own contracts -------------------
+    # 4a. rollup exact-sum identities, re-derived from the emitted doc
+    identities = obs.check_identities(obs.rollup())
+
+    # 4b. fleet SLO verdict flips under injected per-host burn: one
+    # burning host degrades the fleet, failed_hosts=2 fail it, a clean
+    # round recovers (host-0 drained but still beating; host-1 is DEAD
+    # and must not count toward the burning set)
+    fleet.hosts[cold_host].slo_burning = True
+    fleet.tick(0.5)
+    verdict_one = obs.rollup()["fleet"]["slo"]["verdict"]
+    fleet.hosts["host-0"].slo_burning = True
+    fleet.tick(0.5)
+    verdict_two = obs.rollup()["fleet"]["slo"]["verdict"]
+    fleet.hosts[cold_host].slo_burning = False
+    fleet.hosts["host-0"].slo_burning = False
+    fleet.tick(0.5)
+    verdict_clear = obs.rollup()["fleet"]["slo"]["verdict"]
+    slo_doc = {"degraded_on_one_burning": verdict_one,
+               "failed_on_two_burning": verdict_two,
+               "recovered": verdict_clear}
+
+    # 4c. edge-triggered flood control: an impossible spec stays stuck
+    # in the queue across many sweeps and records exactly ONE
+    # placement_pending incident (then withdraws cleanly)
+    sched.place(SessionSpec("stuck-spec", 3840, 2160, "h264",
+                            hbm_mb=1e6))
+    for _ in range(5):
+        fleet.tick(0.5)
+    stuck_records = sum(1 for e in recorder.snapshot()
+                        if e["kind"] == "placement_pending"
+                        and e.get("sid") == "stuck-spec")
+    sched.cancel_pending("stuck-spec")
+
+    # 4d. incident digest merge: the injected qoe_collapse surfaced
+    # exactly once despite every subsequent beat re-carrying it
+    digest_merges = sum(1 for e in recorder.snapshot()
+                        if e["kind"] == "host_incident"
+                        and e.get("incident") == "qoe_collapse")
+
+    # 4e. series rings: the autoscaler bus holds real samples
+    series_doc = {name: len(obs.series(name))
+                  for name in ("seat_occupancy", "watts_est",
+                               "queue_depth", "burn_fast_max")}
+
+    # 4f. Prometheus cardinality: per-host series bounded by the label
+    # cap with an _overflow rollup (needs the server metrics registry —
+    # aiohttp-dependent, present wherever bench runs)
+    metrics_doc = {"available": False, "host_series": 0,
+                   "label_cap": obs.host_label_cap,
+                   "overflow_present": False}
+    try:
+        from selkies_tpu.server import metrics as _metrics
+    except Exception:
+        _metrics = None
+    if _metrics is not None:
+        obs.export_metrics()
+        host_lines = [
+            ln for ln in _metrics.render_prometheus().splitlines()
+            if ln.startswith("selkies_fleet_host_seats_used{")]
+        metrics_doc = {
+            "available": True,
+            "host_series": len(host_lines),
+            "label_cap": obs.host_label_cap,
+            "overflow_present": any('host="_overflow"' in ln
+                                    for ln in host_lines)}
+
+    obs_ok = (
+        identities["ok"]
+        and drain_trace["complete"] and drain_trace["ordered"]
+        and bool(drain_trace["seats"])
+        and failover_trace["complete"] and failover_trace["ordered"]
+        and bool(failover_trace["seats"])
+        and all(s["within_grace"] is True
+                for s in failover_trace["seats"])
+        and slo_doc["degraded_on_one_burning"] == "degraded"
+        and slo_doc["failed_on_two_burning"] == "failed"
+        and slo_doc["recovered"] == "ok"
+        and stuck_records == 1
+        and digest_merges == 1
+        and all(n > 0 for n in series_doc.values())
+        and (not metrics_doc["available"]
+             or (metrics_doc["overflow_present"]
+                 and metrics_doc["host_series"]
+                 <= metrics_doc["label_cap"] + 1)))
+    fleet_obs_doc = {
+        "rollup_identities": identities,
+        "migration_trace": {
+            "drain": {"corr_id": drain_corr,
+                      "seats": len(drain_trace["seats"]),
+                      "complete": drain_trace["complete"],
+                      "ordered": drain_trace["ordered"]},
+            "failover": {"corr_id": failover_corr,
+                         "seats": len(failover_trace["seats"]),
+                         "complete": failover_trace["complete"],
+                         "ordered": failover_trace["ordered"],
+                         "within_grace": sum(
+                             1 for s in failover_trace["seats"]
+                             if s["within_grace"])},
+        },
+        "slo_verdict": slo_doc,
+        "series_samples": series_doc,
+        "incident_digest": {"merged": digest_merges, "expected": 1},
+        "dedup": {"stuck_placement_pending": stuck_records,
+                  "expected": 1},
+        "metrics": metrics_doc,
+        "trace_events": len(obs.trace_document()
+                            .get("traceEvents", [])),
+        "contract_ok": obs_ok,
+    }
+    log(f"fleet obs: identities={identities['ok']} "
+        f"drain_trace={drain_trace['complete']} "
+        f"failover_trace={failover_trace['complete']} "
+        f"slo={slo_doc} obs_ok={obs_ok}")
 
     contract_ok = (
         placement_doc["cold_host_placements_before_ready"] == 0
@@ -1503,7 +1647,8 @@ def fleet_main() -> None:
         and drain_doc["idr_resyncs"] >= drain_doc["migrated"]
         and failover_doc["replaced"] == victim_seats
         and failover_doc["within_grace"] == victim_seats
-        and fleet.heartbeats_rejected == 0)
+        and fleet.heartbeats_rejected == 0
+        and obs_ok)
 
     kinds: dict = {}
     for e in recorder.snapshot():
@@ -1530,6 +1675,7 @@ def fleet_main() -> None:
             "heartbeats": {"sent": fleet.heartbeats_sent,
                            "rejected": fleet.heartbeats_rejected},
             "incidents": kinds,
+            "fleet_obs": fleet_obs_doc,
             "contract_ok": contract_ok,
         },
     }

@@ -16,25 +16,32 @@ fleet shaped for the millions-of-users traffic profile.
 - :mod:`.migrate` — drain/failover/cross-host relay re-offer: the PR-5
   dead-relay re-offer + supervisor drain generalised across hosts,
   with IDR resync on every handoff and reconnect-grace warm capture;
+- :mod:`.obs` — the fleet observability plane (ISSUE 18): cross-host
+  rollup with exact-sum identities, bounded per-signal series rings
+  (the autoscaler input bus), incident-digest merge, and correlated
+  cross-host migration tracing exported in Chrome-trace format;
 - :mod:`.sim` — in-process simulated hosts on an injected clock: the
   rig ``bench.py --fleet`` and ``tests/test_fleet.py`` chaos-test the
   contracts on (CPU, no sleeps);
 - :mod:`.gateway` — the one aiohttp module (NOT imported here): the
   stateless auth + WS-affinity tier in front of the engine hosts,
   plus the broadcast fan-out endpoint (ISSUE 17) where relay-only
-  viewer seats subscribe to per-source rendition rungs;
-- :mod:`.__main__` — ``python -m selkies_tpu.fleet selftest``: the CI
-  lint smoke, stdlib-only like the rest of the offline CLIs.
+  viewer seats subscribe to per-source rendition rungs, and the
+  observability surfaces ``GET /fleet/{obs,metrics,trace}``;
+- :mod:`.__main__` — ``python -m selkies_tpu.fleet selftest`` /
+  ``obs-selftest``: the CI lint smokes, stdlib-only like the rest of
+  the offline CLIs.
 
 Everything except :mod:`.gateway` imports with neither jax nor aiohttp
 installed (same contract as :mod:`..obs` / :mod:`..resilience`).
 """
 
 from .migrate import MigrationCoordinator  # noqa: F401
+from .obs import FleetObserver  # noqa: F401
 from .protocol import (SEAT_CLASSES, FleetProtocolError,  # noqa: F401
                        Heartbeat, SessionSpec, estimate_hbm_mb,
                        estimate_relay_mbps, heartbeat_from_core,
                        migrate_command, parse_heartbeat,
-                       parse_session_spec)
+                       parse_session_spec, rejection_kind)
 from .scheduler import Placement, SeatScheduler  # noqa: F401
 from .sim import SimFleet, SimHost  # noqa: F401

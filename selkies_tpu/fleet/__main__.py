@@ -6,11 +6,19 @@ an injected clock and verify the fleet contracts (the CI lint smoke,
 mirroring the trace/obs/resilience/prewarm selftests). Exits non-zero
 on any contract break.
 
+``python -m selkies_tpu.fleet obs-selftest`` — the ISSUE-18 twin:
+drive the FleetObserver contracts (rollup exact-sum identities, series
+rings, incident-digest dedup, correlated migration timelines, fleet
+SLO verdict, edge-triggered flood control) on the same injected-clock
+rig.
+
 ``python -m selkies_tpu.fleet gateway`` — run the aiohttp gateway tier
 (lazily imported; requires aiohttp).
 
-Stdlib-only for ``selftest``: runs in the lint CI image with no
-jax/aiohttp installed.
+Stdlib-only for ``selftest``/``obs-selftest``: both run in the lint CI
+image with no jax/aiohttp installed (metrics-registry clauses are
+skipped there — the tests job and bench --fleet cover them where
+aiohttp exists).
 """
 
 from __future__ import annotations
@@ -142,6 +150,156 @@ def _cmd_selftest(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_obs_selftest(args: argparse.Namespace) -> int:
+    """FleetObserver contract drive (ISSUE 18), stdlib-only."""
+    from .obs import FleetObserver
+    from .protocol import rejection_kind
+
+    clock_box = [0.0]
+
+    def clock() -> float:
+        return clock_box[0]
+
+    recorder = FlightRecorder()
+    sched = SeatScheduler(clock=clock, recorder=recorder,
+                          host_timeout_s=3.0)
+    coord = MigrationCoordinator(sched, clock=clock, recorder=recorder,
+                                 grace_s=6.0)
+    fleet = SimFleet(sched, coord, clock_box=clock_box)
+    obs = FleetObserver(sched, coord, clock=clock, recorder=recorder,
+                        host_label_cap=2, failed_hosts=2)
+    fleet.observer = obs
+    for i, warm in enumerate((0.0, 0.0, 2.0)):
+        fleet.add_host(SimHost(f"host-{i}", clock=clock, devices=2,
+                               seat_slots=2, warm_after_s=warm,
+                               warm_geometries=("1280x720",),
+                               grace_s=6.0, recorder=recorder))
+    fleet.tick(0.5)
+    for i in range(4):
+        if sched.place(SessionSpec(f"s{i}")) is None:
+            return _fail(f"warm hosts refused s{i}")
+    fleet.tick(0.5)
+
+    # 1. rollup exact-sum identities, re-derived from the emitted doc
+    ids = FleetObserver.check_identities(obs.rollup())
+    if not ids["ok"]:
+        return _fail(f"rollup identities broken: {ids['clauses']}")
+
+    # 2. series rings: non-empty, windowed, bounded
+    fleet.tick(0.5)
+    for name in ("seat_occupancy", "watts_est", "queue_depth"):
+        if not obs.series(name):
+            return _fail(f"series ring {name!r} is empty")
+    if len(obs.series("seat_occupancy", window_s=0.6)) >= \
+            len(obs.series("seat_occupancy")):
+        return _fail("series window did not trim")
+
+    # 3. incident digest: delta-triggered merge, no re-beat flood
+    fleet.hosts["host-1"].incident("qoe_collapse", 2)
+    fleet.tick(0.5)
+    fleet.tick(0.5)
+    merged = [e for e in recorder.snapshot()
+              if e["kind"] == "host_incident"]
+    if len(merged) != 1 or merged[0]["incident"] != "qoe_collapse":
+        return _fail(f"incident digest merge wrong: {merged}")
+
+    # 4. drain: correlation id survives the full timeline
+    rep = coord.evacuate("host-0")
+    corr = rep["correlation_id"]
+    if not corr:
+        return _fail("drain stamped no correlation id")
+    for _ in range(6):
+        fleet.tick(0.5)
+    mrep = obs.migration_report(corr)
+    if not (mrep["complete"] and mrep["ordered"]):
+        return _fail(f"drain timeline incomplete/unordered: {mrep}")
+
+    # 5. host-kill failover: timeline completes, within_grace honest
+    fleet.hosts["host-1"].kill()
+    for _ in range(20):
+        fleet.tick(0.5)
+    fo = [e for e in recorder.snapshot() if e["kind"] == "host_failover"]
+    if not fo or not fo[-1].get("correlation_id"):
+        return _fail("failover stamped no correlation id")
+    frep = obs.migration_report(fo[-1]["correlation_id"])
+    if not (frep["complete"] and frep["ordered"]):
+        return _fail(f"failover timeline incomplete: {frep}")
+    if not all(s["within_grace"] is True for s in frep["seats"]):
+        return _fail(f"failover within_grace dishonest: {frep}")
+
+    # 6. Chrome trace export carries the fleet lane
+    doc = obs.trace_document(corr)
+    spans = [e for e in doc.get("traceEvents", [])
+             if e.get("ph") == "X" and e.get("name") == "replaced"]
+    if not spans:
+        return _fail("trace export lost the replaced span")
+
+    # 7. fleet SLO verdict: one burning host degrades, two fail, a
+    # clean round recovers
+    fleet.hosts["host-2"].slo_burning = True
+    fleet.tick(0.5)
+    if obs.rollup()["fleet"]["slo"]["verdict"] != "degraded":
+        return _fail("one burning host did not degrade the fleet")
+    fleet.hosts["host-0"].slo_burning = True
+    fleet.tick(0.5)
+    if obs.rollup()["fleet"]["slo"]["verdict"] != "failed":
+        return _fail("two burning hosts did not fail the fleet")
+    fleet.hosts["host-0"].slo_burning = False
+    fleet.hosts["host-2"].slo_burning = False
+    fleet.tick(0.5)
+    if obs.rollup()["fleet"]["slo"]["verdict"] != "ok":
+        return _fail("fleet verdict did not recover")
+
+    # 8. gateway-intake rejection classification is bounded
+    try:
+        parse_heartbeat({"kind": "heartbeat"})
+        return _fail("bad heartbeat parsed")
+    except FleetProtocolError as e:
+        if rejection_kind(e) != "missing_field":
+            return _fail(f"rejection kind wrong: {rejection_kind(e)}")
+        obs.note_heartbeat_reject(rejection_kind(e), str(e), "x")
+    if obs.heartbeat_rejects.get("missing_field") != 1:
+        return _fail("reject counter did not count")
+
+    # 9. edge-triggered placement_pending: a stuck spec records ONCE
+    big = SessionSpec("stuck", 3840, 2160, "h264", hbm_mb=1e6)
+    sched.place(big)
+    for _ in range(5):
+        fleet.tick(0.5)
+    stuck = [e for e in recorder.snapshot()
+             if e["kind"] == "placement_pending"
+             and e.get("sid") == "stuck"]
+    if len(stuck) != 1:
+        return _fail(f"stuck spec recorded {len(stuck)} "
+                     "placement_pending incidents (want 1)")
+
+    # 10. metrics cardinality cap (only where the registry exists —
+    # the lint image has no aiohttp, so the server plane is absent)
+    try:
+        from ..server import metrics
+    except Exception:
+        metrics = None
+    if metrics is not None:
+        obs.export_metrics()
+        lines = [ln for ln in metrics.render_prometheus().splitlines()
+                 if ln.startswith("selkies_fleet_host_seats_used{")]
+        if len(lines) > obs.host_label_cap + 1:
+            return _fail(f"host label cardinality exceeded: {lines}")
+        if not any('host="_overflow"' in ln for ln in lines):
+            return _fail("no _overflow rollup series")
+
+    state = {
+        "rollup": obs.rollup(),
+        "series": obs.series(),
+        "migrations_traced": obs.migrations_traced,
+        "metrics_checked": metrics is not None,
+    }
+    text = json.dumps(state, sort_keys=True)
+    print(text if args.json
+          else f"obs-selftest OK ({len(text)} bytes of fleet state)")
+    return 0
+
+
 def _cmd_gateway(args: argparse.Namespace) -> int:
     from aiohttp import web
 
@@ -162,6 +320,13 @@ def main(argv=None) -> int:
     ps.add_argument("--json", action="store_true",
                     help="print the selftest state payload")
     ps.set_defaults(fn=_cmd_selftest)
+    po = sub.add_parser("obs-selftest",
+                        help="drive the FleetObserver contracts "
+                             "(rollup identities, series, traces, "
+                             "verdicts) with an injected clock")
+    po.add_argument("--json", action="store_true",
+                    help="print the obs-selftest state payload")
+    po.set_defaults(fn=_cmd_obs_selftest)
     pg = sub.add_parser("gateway", help="run the aiohttp gateway tier")
     pg.add_argument("--addr", default="0.0.0.0")
     pg.add_argument("--port", type=int, default=8100)

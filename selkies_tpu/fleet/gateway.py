@@ -47,9 +47,11 @@ from ..broadcast.ladder import RenditionLadder
 from ..broadcast.registry import ViewerRegistry
 from ..prewarm.lattice import Signature
 from ..protocol import OP_H264, OP_JPEG
+from ..server import metrics
 from .migrate import MigrationCoordinator
+from .obs import FleetObserver
 from .protocol import (FleetProtocolError, parse_heartbeat,
-                       parse_session_spec)
+                       parse_session_spec, rejection_kind)
 from .scheduler import SeatScheduler
 
 logger = logging.getLogger("selkies_tpu.fleet.gateway")
@@ -85,6 +87,14 @@ class FleetGateway:
         self.sweep_interval_s = float(sweep_interval_s)
         self.heartbeats_ok = 0
         self.heartbeats_rejected = 0
+        #: fleet observability plane (ISSUE 18): rollup + series +
+        #: migration traces over the scheduler's validated heartbeat
+        #: stream — the GET /fleet/{obs,metrics,trace} surfaces
+        self.observer = FleetObserver(self.scheduler, self.coordinator,
+                                      clock=clock,
+                                      recorder=self.recorder)
+        self.upstream_pump_restarts = 0
+        self._describe_self_metrics()
         self._sweep_task: Optional[asyncio.Task] = None
         #: one gateway-lifetime HTTP/WS client session: per-connection
         #: sessions would pay connector setup per viewer and never
@@ -131,6 +141,42 @@ class FleetGateway:
         #: short-lived IDR-request tasks, retained until done
         self._idr_tasks: set = set()
 
+    # ------------------------------------------------- gateway self-metrics
+    # ISSUE 18 satellite: the WS proxy and broadcast fan-out export
+    # facts about THEMSELVES — byte throughput, live sockets, refusals
+    # by reason, grace-window saves, upstream pump redials.
+    def _describe_self_metrics(self) -> None:
+        metrics.describe("selkies_gateway_proxied_bytes_total",
+                         "Bytes proxied through /fleet/ws by "
+                         "direction (client/host)")
+        metrics.describe("selkies_gateway_active_ws",
+                         "Live proxied WS connections (sessions + "
+                         "broadcast viewers)")
+        metrics.describe("selkies_gateway_refusals_total",
+                         "WS connections refused, by reason")
+        metrics.describe("selkies_gateway_reconnect_grace_saves_total",
+                         "Reconnects that landed inside the release "
+                         "grace and kept their seat")
+        metrics.describe("selkies_gateway_upstream_pump_restarts_total",
+                         "Broadcast upstream pump redials after a "
+                         "non-cancelled exit")
+        metrics.register_collector(self._collect_active_ws)
+
+    def _collect_active_ws(self) -> None:
+        metrics.set_gauge("selkies_gateway_active_ws",
+                          sum(self._ws_conns.values()))
+
+    def _refuse(self, reason: str) -> None:
+        metrics.inc_counter("selkies_gateway_refusals_total",
+                            labels={"reason": reason})
+
+    def _grace_save(self, sid: str) -> None:
+        metrics.inc_counter(
+            "selkies_gateway_reconnect_grace_saves_total")
+        # a migrating session's reconnect IS the grace save: the
+        # ``migrate,`` command told the client to come back here
+        self.observer.note_reconnect(sid)
+
     # ------------------------------------------------------------------ auth
     def _authed(self, request: web.Request) -> bool:
         if not self.token:
@@ -148,6 +194,9 @@ class FleetGateway:
         r.add_post("/fleet/release", self.handle_release)
         r.add_get("/fleet/route/{sid}", self.handle_route)
         r.add_get("/fleet/hosts", self.handle_hosts)
+        r.add_get("/fleet/obs", self.handle_obs)
+        r.add_get("/fleet/metrics", self.handle_metrics)
+        r.add_get("/fleet/trace", self.handle_trace)
         r.add_post("/fleet/drain/{host_id}", self.handle_drain)
         r.add_get("/fleet/ws", self.handle_ws)
         r.add_get("/fleet/broadcast/ws", self.handle_broadcast_ws)
@@ -212,7 +261,23 @@ class FleetGateway:
             hb = parse_heartbeat(raw)
         except FleetProtocolError as e:
             self.heartbeats_rejected += 1
+            # classify onto the bounded label vocabulary and keep the
+            # last reject's reason/host — a misbehaving host must be
+            # DIAGNOSABLE at the fleet edge, not silently uncounted.
+            # host_id comes best-effort from the raw json: the strict
+            # parse refused the document, but the claimed sender is
+            # still the operator's best lead.
+            host_id = ""
+            try:
+                claimed = json.loads(raw)
+                if isinstance(claimed, dict):
+                    host_id = str(claimed.get("host_id", ""))[:128]
+            except Exception:
+                pass
+            self.observer.note_heartbeat_reject(
+                rejection_kind(e), reason=str(e), host_id=host_id)
             return web.Response(status=400, text=f"bad heartbeat: {e}")
+        self.observer.note_heartbeat_ok(hb.host_id)
         self.scheduler.observe(hb)
         self.heartbeats_ok += 1
         return web.json_response({"ok": True, "seq": hb.seq})
@@ -265,7 +330,43 @@ class FleetGateway:
         doc = self.scheduler.snapshot()
         doc["heartbeats_ok"] = self.heartbeats_ok
         doc["heartbeats_rejected"] = self.heartbeats_rejected
+        doc["heartbeat_rejects"] = {
+            "by_kind": dict(self.observer.heartbeat_rejects),
+            "last": self.observer.last_reject}
         return web.json_response(doc)
+
+    # ------------------------------------------- observability surfaces
+    async def handle_obs(self, request: web.Request) -> web.Response:
+        """GET /fleet/obs: the full JSON rollup + series rings (the
+        autoscaler signal bus). ``?window=`` trims the series to the
+        trailing N seconds."""
+        if not self._authed(request):
+            return web.Response(status=401, text="bad fleet token")
+        window = None
+        try:
+            if request.query.get("window"):
+                window = float(request.query["window"])
+        except ValueError:
+            return web.Response(status=400, text="bad window")
+        return web.json_response(self.observer.obs_doc(window_s=window))
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        """GET /fleet/metrics: Prometheus text, per-host cardinality
+        bounded by the observer's host label cap (``_overflow``
+        aggregates the tail)."""
+        if not self._authed(request):
+            return web.Response(status=401, text="bad fleet token")
+        self.observer.export_metrics()
+        return web.Response(text=metrics.render_prometheus(),
+                            content_type="text/plain")
+
+    async def handle_trace(self, request: web.Request) -> web.Response:
+        """GET /fleet/trace: the correlated migration timelines as a
+        Chrome trace-event document (``?corr=`` filters one id)."""
+        if not self._authed(request):
+            return web.Response(status=401, text="bad fleet token")
+        corr = request.query.get("corr") or None
+        return web.json_response(self.observer.trace_document(corr))
 
     async def handle_drain(self, request: web.Request) -> web.Response:
         """Operator evacuation. For REMOTE hosts (no in-process handle)
@@ -325,6 +426,7 @@ class FleetGateway:
         reconnect after migration reuses it and lands on the new host);
         ``?w=&h=&codec=`` size a fresh placement."""
         if not self._authed(request):
+            self._refuse("auth")
             return web.Response(status=401, text="bad fleet token")
         q = request.query
         # anonymous sids must be collision-proof: a truncated id()
@@ -341,6 +443,7 @@ class FleetGateway:
                     "height": int(q.get("h", 720)),
                     "codec": q.get("codec", "h264")})
             except (FleetProtocolError, ValueError) as e:
+                self._refuse("bad_spec")
                 return web.Response(status=400, text=f"bad spec: {e}")
             p = self.scheduler.place(spec)
             if p is None:
@@ -348,12 +451,14 @@ class FleetGateway:
                 # connection is about to go away, and a later retry
                 # would otherwise place a ghost seat nothing releases
                 self.scheduler.cancel_pending(sid)
+                self._refuse("capacity")
                 return web.Response(status=503,
                                     text="no host has capacity; retry")
         host = self.scheduler.hosts.get(p.host_id)
         if host is None or not host.url.startswith(("http://",
                                                     "https://",
                                                     "ws://", "wss://")):
+            self._refuse("unroutable")
             return web.Response(status=502,
                                 text="placed host has no routable url")
         # the engine host learns the GATEWAY's session id (?fleet_sid=)
@@ -372,10 +477,34 @@ class FleetGateway:
         timer = self._release_timers.pop(sid, None)
         if timer is not None:
             timer.cancel()        # reconnect inside the grace: keep it
+            self._grace_save(sid)
+        elif sid in self.observer.open_migration_sids():
+            # fresh connection carrying a migrating sid: the client
+            # followed its ``migrate,`` command here
+            self.observer.note_reconnect(sid)
+        first_binary = [True]
+
+        def on_host_bytes(binary: bool, n: int,
+                          _sid=sid, _fb=first_binary) -> None:
+            metrics.inc_counter("selkies_gateway_proxied_bytes_total",
+                                n, labels={"dir": "host"})
+            if binary and _fb[0]:
+                # first media frame through THIS connection: for a
+                # migrating session, the timeline's closing span
+                _fb[0] = False
+                self.observer.note_idr_resync(_sid)
+                self.observer.note_first_frame(_sid)
+
+        def on_client_bytes(binary: bool, n: int) -> None:
+            metrics.inc_counter("selkies_gateway_proxied_bytes_total",
+                                n, labels={"dir": "client"})
+
         try:
             async with self._http().ws_connect(
                     target, headers=headers) as ws_host:
-                await _pipe(ws_client, ws_host)
+                await _pipe(ws_client, ws_host,
+                            on_client_bytes=on_client_bytes,
+                            on_host_bytes=on_host_bytes)
         except aiohttp.ClientError as e:
             logger.warning("fleet ws proxy to %s failed: %s", target, e)
             await ws_client.close(code=1013, message=b"host unreachable")
@@ -464,9 +593,31 @@ class FleetGateway:
             task.cancel()
 
     async def _upstream_pump(self, source: str, rung: str) -> None:
-        """One rendition's upstream: engine-host WS -> hub.publish.
-        Every frame arrives ONCE here and fans out to every subscribed
-        viewer sink — the 1-to-N moment."""
+        """One rendition's upstream, restarted for as long as viewers
+        hold the rung open: a host-side hiccup (engine restart, seat
+        migration settling) must redial, not silently starve every
+        viewer on the rung until last-out. Cancellation (grace expiry,
+        shutdown) still ends it immediately; each redial is counted."""
+        first = True
+        while (source, rung) in set(self.hub.open_rungs(source)):
+            if not first:
+                self.upstream_pump_restarts += 1
+                metrics.inc_counter(
+                    "selkies_gateway_upstream_pump_restarts_total")
+                # small real delay so a dead engine host is a slow
+                # retry loop, not a hot one (cancellation during the
+                # sleep still exits promptly)
+                await asyncio.sleep(0.5)
+                if (source, rung) not in set(self.hub.open_rungs(source)):
+                    break
+            first = False
+            await self._upstream_pump_once(source, rung)
+
+    async def _upstream_pump_once(self, source: str,
+                                  rung: str) -> None:
+        """One dial of a rendition's upstream: engine-host WS ->
+        hub.publish. Every frame arrives ONCE here and fans out to
+        every subscribed viewer sink — the 1-to-N moment."""
         p = self.scheduler.get(source)
         host = self.scheduler.hosts.get(p.host_id) if p else None
         if host is None or not host.url.startswith(
@@ -535,15 +686,18 @@ class FleetGateway:
         sends ``qoe,<score>`` / ``cc,<kbps>`` verdicts; rung switches
         are hysteresed and IDR-resynced."""
         if not self._authed(request):
+            self._refuse("auth")
             return web.Response(status=401, text="bad fleet token")
         q = request.query
         source = q.get("source", "")
         src_p = self.scheduler.get(source) if source else None
         if src_p is None or src_p.spec.is_relay:
+            self._refuse("no_source")
             return web.Response(status=404,
                                 text="broadcast source not placed")
         reg = self._broadcast_registry(source)
         if reg is None:
+            self._refuse("no_source")
             return web.Response(status=404,
                                 text="broadcast source not placed")
         import secrets
@@ -559,12 +713,14 @@ class FleetGateway:
                     "rung": rend.name, "width": rend.width,
                     "height": rend.height, "codec": rend.codec})
             except FleetProtocolError as e:
+                self._refuse("bad_spec")
                 return web.Response(status=400, text=f"bad spec: {e}")
             placed = self.scheduler.place(spec)
             if placed is None:
                 # gateway bandwidth budget refused: withdraw the
                 # queued spec — this viewer is about to go away
                 self.scheduler.cancel_pending(vid)
+                self._refuse("egress_budget")
                 return web.Response(
                     status=503, text="gateway egress budget exhausted")
         ws_client = web.WebSocketResponse()
@@ -591,6 +747,7 @@ class FleetGateway:
         timer = self._release_timers.pop(vid, None)
         if timer is not None:
             timer.cancel()    # reconnect inside the grace: keep seat
+            self._grace_save(vid)
         self.hub.subscribe(source, reg.ladder.rung(st.rung).name,
                            vid, sink)
 
@@ -646,15 +803,23 @@ async def _await_handle(handle) -> None:
     await handle
 
 
-async def _pipe(a: web.WebSocketResponse, b) -> None:
-    """Bidirectional byte pump until either side closes."""
+async def _pipe(a: web.WebSocketResponse, b, *,
+                on_client_bytes=None, on_host_bytes=None) -> None:
+    """Bidirectional byte pump until either side closes. The optional
+    taps receive ``(binary, nbytes)`` per message — ``on_client_bytes``
+    for client->host traffic, ``on_host_bytes`` for host->client (the
+    gateway's throughput self-metrics and first-frame trace marks)."""
 
-    async def one_way(src, dst):
+    async def one_way(src, dst, tap):
         async for msg in src:
             if msg.type == aiohttp.WSMsgType.TEXT:
                 await dst.send_str(msg.data)
+                if tap is not None:
+                    tap(False, len(msg.data))
             elif msg.type == aiohttp.WSMsgType.BINARY:
                 await dst.send_bytes(msg.data)
+                if tap is not None:
+                    tap(True, len(msg.data))
             else:
                 break
         try:
@@ -662,5 +827,6 @@ async def _pipe(a: web.WebSocketResponse, b) -> None:
         except Exception:
             pass
 
-    await asyncio.gather(one_way(a, b), one_way(b, a),
+    await asyncio.gather(one_way(a, b, on_client_bytes),
+                         one_way(b, a, on_host_bytes),
                          return_exceptions=True)

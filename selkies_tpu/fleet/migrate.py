@@ -62,6 +62,15 @@ class MigrationCoordinator:
         self.handles: dict[str, object] = {}
         self.total_migrations = 0
         self.total_failovers = 0
+        #: fleet observer (ISSUE 18), wired by FleetObserver itself:
+        #: drain/failover stamp a correlation id and every move marks
+        #: its timeline. Optional — the coordinator works without one.
+        self.observer = None
+        #: hosts whose CURRENT burn episode already recorded an
+        #: evict_blocked incident — the edge-trigger set (ISSUE 18: a
+        #: host burning with nowhere to evict is ONE incident, not one
+        #: per rebalance sweep; same discipline as slo_burn)
+        self._evict_blocked: set = set()
         # the coordinator owns seat DELIVERY: every successful
         # scheduler placement (first placement, queue retry, migration)
         # is offered to the target host's handle with an IDR resync;
@@ -94,6 +103,29 @@ class MigrationCoordinator:
             logger.exception("fleet: host %s refused seat %s",
                              placement.host_id, placement.sid)
             return False
+
+    # -- migration tracing (ISSUE 18) ---------------------------------------
+    def _trace_start(self, kind: str, host_id: str,
+                     sids) -> Optional[str]:
+        """Stamp a correlation id at drain/failover start (guarded —
+        tracing never blocks a migration)."""
+        if self.observer is None:
+            return None
+        try:
+            return self.observer.migration_start(kind, host_id, sids)
+        except Exception:
+            logger.debug("fleet: migration trace start failed",
+                         exc_info=True)
+            return None
+
+    def _trace_mark(self, sid: str, event: str, **fields) -> None:
+        if self.observer is None:
+            return
+        try:
+            self.observer.migration_mark(sid, event, **fields)
+        except Exception:
+            logger.debug("fleet: migration trace mark failed",
+                         exc_info=True)
 
     def register_host(self, host_id: str, handle) -> None:
         self.handles[host_id] = handle
@@ -145,6 +177,7 @@ class MigrationCoordinator:
             # (when it later lands, delivery goes to the new host; two
             # live seats for one sid must never exist)
             self._release_source(source, sid, source_alive)
+            self._trace_mark(sid, "queued")
             return {"sid": sid, "moved": False, "queued": True,
                     "from": source, "to": None}
         new.migrations = placement.migrations + 1
@@ -156,6 +189,8 @@ class MigrationCoordinator:
                      from_host=source, to_host=new.host_id,
                      device=new.device, seat=new.seat, idr_resync=True)
         self._metrics_migration(kind)
+        self._trace_mark(sid, "replaced", to_host=new.host_id,
+                         idr_resync=True)
         return {"sid": sid, "moved": True, "queued": False,
                 "from": source, "to": new.host_id,
                 "idr_resync": True}
@@ -184,8 +219,10 @@ class MigrationCoordinator:
         await actual stop; in-process hosts complete it synchronously."""
         t0 = self._clock()
         placements = self.scheduler.mark_draining(host_id)
+        corr_id = self._trace_start("drain", host_id,
+                                    [p.sid for p in placements])
         self._record("migration_start", host_id=host_id,
-                     seats=len(placements))
+                     seats=len(placements), correlation_id=corr_id)
         results = [self._move(p, kind="drain") for p in placements]
         moved = sum(1 for r in results if r["moved"])
         queued = sum(1 for r in results if r["queued"])
@@ -198,6 +235,7 @@ class MigrationCoordinator:
                 logger.exception("fleet: drain of %s failed", host_id)
         report = {
             "host_id": host_id,
+            "correlation_id": corr_id,
             "seats": len(placements),
             "migrated": moved,
             "queued": queued,
@@ -210,7 +248,8 @@ class MigrationCoordinator:
         report["drain_handle"] = drain_handle
         self._record("migration_complete", host_id=host_id,
                      migrated=moved, queued=queued,
-                     drained=report["drained"])
+                     drained=report["drained"],
+                     correlation_id=corr_id)
         logger.info("fleet: evacuated %s: %d migrated, %d queued",
                     host_id, moved, queued)
         return report
@@ -224,17 +263,28 @@ class MigrationCoordinator:
         host = self.scheduler.hosts.get(host_id)
         last_seen = host.last_seen if host is not None else None
         placements = self.scheduler.placements_on(host_id)
+        corr_id = self._trace_start("failover", host_id,
+                                    [p.sid for p in placements])
         results = []
         for p in placements:
             r = self._move(p, kind="failover", source_alive=False)
             now = self._clock()
             r["within_grace"] = (last_seen is not None
                                  and now - last_seen <= self.grace_s)
+            if self.observer is not None:
+                try:
+                    # the honesty mark: the trace carries whether the
+                    # client's reconnect grace actually held
+                    self.observer.migration_annotate(
+                        p.sid, within_grace=r["within_grace"])
+                except Exception:
+                    pass
             results.append(r)
         moved = sum(1 for r in results if r["moved"])
         self.total_failovers += 1
         report = {
             "host_id": host_id,
+            "correlation_id": corr_id,
             "seats": len(placements),
             "replaced": moved,
             "queued": sum(1 for r in results if r["queued"]),
@@ -244,7 +294,8 @@ class MigrationCoordinator:
         }
         self._record("host_failover", host_id=host_id,
                      replaced=moved, seats=len(placements),
-                     within_grace=report["within_grace"])
+                     within_grace=report["within_grace"],
+                     correlation_id=corr_id)
         logger.warning("fleet: host %s failover: %d/%d seats re-placed",
                        host_id, moved, len(placements))
         return report
@@ -273,10 +324,27 @@ class MigrationCoordinator:
         per call."""
         out = []
         for p in self.scheduler.evictions():
+            source = p.host_id
             r = self._move(p, kind="evict", keep_on_failure=True)
             if r["moved"]:
                 self.scheduler.note_evicted(p)
+                self._evict_blocked.discard(source)
+            elif not r["queued"]:
+                # burning host with nowhere to evict: edge-triggered —
+                # ONE evict_blocked incident per burn episode, not one
+                # per sweep (the hysteresis keeps re-selecting the same
+                # seat every call while nothing can take it)
+                if source not in self._evict_blocked:
+                    self._evict_blocked.add(source)
+                    self._record("evict_blocked", host_id=source,
+                                 sid=r["sid"])
             out.append(r)
+        # re-arm hosts whose burn episode ended (streak reset to 0 by a
+        # healthy heartbeat or a completed migration hold)
+        for hid in list(self._evict_blocked):
+            host = self.scheduler.hosts.get(hid)
+            if host is None or host.burn_streak == 0:
+                self._evict_blocked.discard(hid)
         return out
 
     # -- plumbing ------------------------------------------------------------

@@ -1,0 +1,729 @@
+"""Fleet observability plane: cross-host rollup, series rings, tracing.
+
+Every observability instrument before this module ends at one host's
+process boundary (trace lanes, SLO burn, energy, QoE, the flight
+recorder), while PRs 11/17 made the *fleet* the serving architecture.
+This is the aggregation layer ROADMAP item 5 builds on — the
+autoscaler's signal bus. :class:`FleetObserver` consumes the SAME
+strict-parsed heartbeat stream the scheduler already trusts (it hooks
+``scheduler.on_heartbeat``; nothing is parsed twice, nothing unparsed
+folds in) and keeps four instruments:
+
+- **rollup** — per-host and fleet-wide state: seats/pixels/HBM/watts/
+  egress occupancy vs budgets, warm-vs-unreachable capacity, per-host
+  SLO burn and a fleet-level verdict (any host fast-burning =>
+  ``degraded``; ``failed_hosts`` burning at once, or the gateway's OWN
+  heartbeat-intake budget burning, => ``failed``). The fleet numbers
+  are sums of the per-host numbers *by construction*, and
+  :meth:`FleetObserver.check_identities` re-derives every sum from the
+  emitted document so the exact-sum identities stay contract-tested;
+- **series rings** — bounded per-signal time series (occupancy, burn,
+  watts, egress, placement-queue depth …) sampled once per injected-
+  clock step, queried via :meth:`FleetObserver.series`: the windowed
+  inputs ROADMAP 5(b)'s autoscaler will read;
+- **fleet flight recorder** — the scheduler, coordinator and gateway
+  already share one bounded :class:`..obs.health.FlightRecorder`;
+  the observer merges in the per-host **incident digests** heartbeats
+  now carry (bounded, strict-parsed cumulative counters), recording a
+  ``host_incident`` entry only on a count INCREASE — host-side
+  incidents (qoe_collapse, crash_loop, relay_death) surface fleet-wide
+  without a flood;
+- **migration tracing** — a correlation id stamped at drain/failover
+  start; every seat's timeline (drain/lost -> re-placed -> client
+  reconnect via ``migrate,`` -> IDR resync -> first frame on the new
+  host) recorded as spans on a ``fleet`` lane and exported in the
+  existing Chrome-trace format via :mod:`..trace.export`.
+
+Prometheus export reuses :mod:`..server.metrics` formatting with
+per-host cardinality bounded by ``host_label_cap``: the first N hosts
+(first-come, like the broadcast viewer registry) get their own
+``host`` label; everything past the cap aggregates under
+``host="_overflow"`` — a 500-host fleet scrape stays O(cap), not
+O(hosts).
+
+Stdlib-only by the fleet contract (``python -m selkies_tpu.fleet
+obs-selftest`` runs in the lint image with neither jax nor aiohttp);
+the metrics bridge is lazy + guarded like every obs exporter.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from ..obs.health import FlightRecorder
+from ..obs.slo import Slo
+
+logger = logging.getLogger("selkies_tpu.fleet.obs")
+
+__all__ = ["FleetObserver", "MIGRATION_EVENTS",
+           "DEFAULT_HOST_LABEL_CAP"]
+
+#: per-host label cardinality cap for /fleet/metrics — hosts past it
+#: aggregate under host="_overflow" (same first-come discipline as the
+#: broadcast viewer registry's seat label cap)
+DEFAULT_HOST_LABEL_CAP = 8
+
+#: the canonical migration timeline, in order. ``drain`` opens a
+#: planned evacuation seat, ``lost`` an unplanned failover seat;
+#: ``queued`` is the no-capacity detour (the seat re-places later when
+#: headroom appears). Everything after ``replaced`` is client-visible:
+#: the reconnect rides the ``migrate,`` command, the target answers the
+#: fresh START_VIDEO with an IDR, then the first frame lands.
+MIGRATION_EVENTS = ("drain", "lost", "queued", "replaced",
+                    "reconnect", "idr_resync", "first_frame")
+_EVENT_RANK = {name: i for i, name in enumerate(MIGRATION_EVENTS)}
+
+#: fleet SLO verdict levels, ranked for the metrics gauge
+_VERDICT_RANK = {"ok": 0, "degraded": 1, "failed": 2}
+
+_NS = 1_000_000_000
+
+
+class _SeatTrace:
+    """One seat's migration timeline under a correlation id."""
+
+    __slots__ = ("corr_id", "sid", "kind", "from_host", "to_host",
+                 "seq", "events", "done", "within_grace")
+
+    def __init__(self, corr_id: str, sid: str, kind: str,
+                 from_host: str, seq: int):
+        self.corr_id = corr_id
+        self.sid = sid
+        self.kind = kind
+        self.from_host = from_host
+        self.to_host: Optional[str] = None
+        self.seq = seq
+        #: [(event, ts, fields), ...] in arrival order
+        self.events: list = []
+        self.done = False
+        self.within_grace: Optional[bool] = None
+
+    def event_names(self) -> list:
+        return [e[0] for e in self.events]
+
+    def ordered(self) -> bool:
+        """Events must follow the canonical sequence with a
+        nondecreasing clock — the 'spans complete and ordered'
+        contract clause."""
+        ranks = [_EVENT_RANK.get(e[0], -1) for e in self.events]
+        stamps = [e[1] for e in self.events]
+        return (all(r >= 0 for r in ranks)
+                and all(a <= b for a, b in zip(ranks, ranks[1:]))
+                and all(a <= b for a, b in zip(stamps, stamps[1:])))
+
+    def to_timeline(self) -> dict:
+        """The Chrome-trace timeline dict :func:`..trace.export.
+        to_trace_events` consumes: one 'frame' per seat move, spans on
+        the ``fleet`` lane between consecutive events (the final event
+        exports as an instant)."""
+        spans = []
+        for i, (name, ts, _fields) in enumerate(self.events):
+            dur = (self.events[i + 1][1] - ts
+                   if i + 1 < len(self.events) else 0.0)
+            spans.append({"name": name, "lane": "fleet",
+                          "t0_ns": int(ts * _NS),
+                          "dur_ns": int(dur * _NS)})
+        t0 = self.events[0][1] if self.events else 0.0
+        t1 = self.events[-1][1] if self.events else 0.0
+        return {"display_id": self.corr_id, "frame_id": self.seq,
+                "sid": self.sid, "kind": self.kind,
+                "from_host": self.from_host, "to_host": self.to_host,
+                "complete": self.done,
+                "within_grace": self.within_grace,
+                "t0_ns": int(t0 * _NS), "t1_ns": int(t1 * _NS),
+                "spans": spans}
+
+    def to_report(self) -> dict:
+        return {"sid": self.sid, "kind": self.kind,
+                "from": self.from_host, "to": self.to_host,
+                "events": self.event_names(),
+                "ordered": self.ordered(), "complete": self.done,
+                "within_grace": self.within_grace}
+
+
+class FleetObserver:
+    """Fleet-wide rollup + series + incident merge + migration traces
+    over one scheduler's strict-parsed heartbeat stream."""
+
+    def __init__(self, scheduler, coordinator=None, *,
+                 clock: Optional[Callable[[], float]] = None,
+                 recorder: Optional[FlightRecorder] = None,
+                 host_label_cap: int = DEFAULT_HOST_LABEL_CAP,
+                 series_capacity: int = 512,
+                 fleet_burn_threshold: float = 14.4,
+                 failed_hosts: int = 2,
+                 trace_capacity: int = 256):
+        self.scheduler = scheduler
+        self._clock = clock if clock is not None \
+            else getattr(scheduler, "_clock", time.monotonic)
+        rec = recorder if recorder is not None \
+            else getattr(scheduler, "recorder", None)
+        self.recorder = rec if rec is not None else FlightRecorder()
+        self.host_label_cap = int(host_label_cap)
+        self.series_capacity = int(series_capacity)
+        self.fleet_burn_threshold = float(fleet_burn_threshold)
+        self.failed_hosts = int(failed_hosts)
+        self.trace_capacity = int(trace_capacity)
+        self._lock = threading.Lock()
+        #: signal -> deque[(ts, value)] — the autoscaler input bus
+        self._series: dict[str, collections.deque] = {}
+        self._series_last: Optional[float] = None
+        #: host_id -> last-seen cumulative incident digest counts
+        self._digest: dict[str, dict] = {}
+        self.host_incidents_total = 0
+        #: migration traces: open by sid; every trace by corr id
+        self._open: dict[str, _SeatTrace] = {}
+        self._by_corr: "collections.OrderedDict[str, list]" = \
+            collections.OrderedDict()
+        self._corr_seq = 0
+        self._trace_seq = 0
+        self.migrations_traced = 0
+        #: heartbeat-intake rejections (the gateway's own budget):
+        #: kind -> count, plus the last reject for /fleet/hosts
+        self.heartbeat_rejects: dict[str, int] = {}
+        self.last_reject: Optional[dict] = None
+        #: the gateway's OWN error budget: good = accepted heartbeat,
+        #: bad = rejected one. Short windows — the intake stream beats
+        #: every few seconds, an hour-wide window would answer late.
+        self._gw_slo = Slo(
+            "fleet_gateway_intake",
+            "gateway heartbeat intake accepted (strict parse)",
+            objective=0.99, fast_window_s=60.0, slow_window_s=600.0,
+            burn_threshold=self.fleet_burn_threshold, bucket_s=1.0)
+        #: first-come host label owners for the cardinality cap
+        self._label_order: list[str] = []
+        # hook the trusted heartbeat stream (set AFTER state exists:
+        # a heartbeat may arrive from another thread immediately)
+        if scheduler is not None:
+            scheduler.on_heartbeat = self._on_heartbeat
+        if coordinator is not None:
+            coordinator.observer = self
+
+    # -- heartbeat intake ----------------------------------------------------
+    def _on_heartbeat(self, hb, host) -> None:
+        """Scheduler hook: one validated heartbeat just folded into
+        host state. Merge the incident digest, advance queued traces,
+        sample the series rings."""
+        self._ingest_digest(hb)
+        self._advance_queued_traces()
+        now = self._clock()
+        if self._series_last is None or now > self._series_last:
+            # one sample per clock step, however many hosts beat in it
+            self._series_last = now
+            self._sample(now)
+
+    def note_heartbeat_ok(self, host_id: str = "") -> None:
+        """Gateway intake accepted a heartbeat (its own SLO's good
+        event)."""
+        self._gw_slo.record(True, now=self._clock())
+
+    def note_heartbeat_reject(self, kind: str, reason: str = "",
+                              host_id: str = "") -> None:
+        """Gateway intake rejected a heartbeat: count by rejection
+        kind, remember the last one (the /fleet/hosts diagnosis
+        surface), burn the gateway's own budget."""
+        now = self._clock()
+        with self._lock:
+            self.heartbeat_rejects[kind] = \
+                self.heartbeat_rejects.get(kind, 0) + 1
+            self.last_reject = {"kind": kind,
+                                "reason": str(reason)[:256],
+                                "host_id": str(host_id)[:128],
+                                "ts": round(now, 3)}
+        self._gw_slo.record(False, now=now)
+        try:
+            from ..server import metrics
+            metrics.describe("selkies_fleet_heartbeat_rejects_total",
+                             "Heartbeats refused at the gateway's "
+                             "strict parse, by rejection kind")
+            metrics.inc_counter("selkies_fleet_heartbeat_rejects_total",
+                                labels={"kind": kind})
+        except Exception:
+            pass
+
+    def _ingest_digest(self, hb) -> None:
+        """Fold one host's bounded incident digest (cumulative counts).
+        Only an INCREASE records a fleet ``host_incident`` — re-beating
+        the same digest is silent, so a stuck host cannot flood the
+        bounded recorder."""
+        incidents = getattr(hb, "incidents", None)
+        if not incidents:
+            return
+        with self._lock:
+            prev = self._digest.get(hb.host_id, {})
+            cur = dict(prev)
+            deltas = []
+            for item in incidents:
+                kind = item.get("kind")
+                count = int(item.get("count", 0))
+                if not kind:
+                    continue
+                delta = count - int(prev.get(kind, 0))
+                cur[kind] = count
+                if delta > 0:
+                    deltas.append((kind, delta, count))
+            self._digest[hb.host_id] = cur
+            self.host_incidents_total += sum(d for _, d, _ in deltas)
+        for kind, delta, count in deltas:
+            self._record("host_incident", host_id=hb.host_id,
+                         incident=kind, delta=delta, count=count)
+
+    # -- series rings (the autoscaler signal bus) ----------------------------
+    def _ring(self, name: str) -> collections.deque:
+        ring = self._series.get(name)
+        if ring is None:
+            ring = self._series[name] = collections.deque(
+                maxlen=self.series_capacity)
+        return ring
+
+    def _sample(self, now: float) -> None:
+        roll = self.rollup(now=now)
+        fleet = roll["fleet"]
+
+        def occ(block) -> float:
+            denom = block.get("slots") or block.get("budget") \
+                or block.get("limit") or 0
+            return round(block["used"] / denom, 4) if denom else 0.0
+
+        burn_max = max((h["burn_fast"] or 0.0
+                        for h in roll["hosts"].values()), default=0.0)
+        with self._lock:
+            for name, value in (
+                    ("seat_occupancy", occ(fleet["seats"])),
+                    ("pixel_occupancy", occ(fleet["pixels"])),
+                    ("hbm_occupancy", occ(fleet["hbm_mb"])),
+                    ("watts_est", fleet["watts_est"]),
+                    ("egress_mbps_est", fleet["egress_mbps_est"]),
+                    ("queue_depth",
+                     fleet["placements"]["pending"]),
+                    ("burn_fast_max", round(burn_max, 3)),
+                    ("hosts_ready", fleet["hosts"]["warm"]),
+                    ("slo_verdict",
+                     _VERDICT_RANK.get(fleet["slo"]["verdict"], 2))):
+                self._ring(name).append((round(now, 3), value))
+
+    def series(self, name: Optional[str] = None,
+               window_s: Optional[float] = None,
+               now: Optional[float] = None):
+        """The query surface: ``series()`` lists signal names;
+        ``series(name)`` returns ``[[ts, value], ...]`` (oldest first),
+        optionally windowed to the trailing ``window_s`` seconds."""
+        with self._lock:
+            if name is None:
+                return sorted(self._series)
+            ring = list(self._series.get(name, ()))
+        if window_s is not None:
+            now = self._clock() if now is None else now
+            lo = now - float(window_s)
+            ring = [p for p in ring if p[0] >= lo]
+        return [[ts, v] for ts, v in ring]
+
+    def series_doc(self, window_s: Optional[float] = None) -> dict:
+        return {name: self.series(name, window_s=window_s)
+                for name in self.series()}
+
+    # -- rollup --------------------------------------------------------------
+    def rollup(self, now: Optional[float] = None) -> dict:
+        """Per-host and fleet-wide state. The fleet block is the SUM of
+        the host blocks by construction; :meth:`check_identities`
+        re-derives every sum independently."""
+        now = self._clock() if now is None else now
+        sched = self.scheduler
+        hosts_doc: dict[str, dict] = {}
+        sums = {"seats_used": 0, "seat_slots": 0, "pixels_used": 0,
+                "pixel_budget": 0, "hbm_used": 0.0, "hbm_limit": 0.0,
+                "watts": 0.0, "egress": 0.0, "sessions": 0}
+        counts = {"known": 0, "warm": 0, "cold": 0, "draining": 0,
+                  "lost": 0}
+        capacity = {"warm_seat_slots": 0, "cold_seat_slots": 0,
+                    "draining_seat_slots": 0,
+                    "unreachable_seat_slots": 0}
+        burning_hosts: list[str] = []
+        with self._lock:
+            digests = {h: dict(d) for h, d in self._digest.items()}
+        for host in list(sched.hosts.values()):
+            hb = host.heartbeat
+            seats_used = sum(d.seats_used for d in hb.devices)
+            seat_slots = sum(d.seat_slots for d in hb.devices)
+            px_used = sum(d.pixels_used for d in hb.devices)
+            px_budget = sum(d.pixel_budget for d in hb.devices)
+            hbm_used = sum(d.hbm_used_mb for d in hb.devices)
+            hbm_limit = sum(d.hbm_limit_mb for d in hb.devices)
+            watts = hb.watts_est or 0.0
+            egress = hb.egress_mbps_est or 0.0
+            if host.lost:
+                state = "lost"
+            elif host.draining:
+                state = "draining"
+            elif host.ready:
+                state = "warm"
+            else:
+                state = "cold"
+            burn = hb.slo_fast_burn
+            burning = (not host.lost
+                       and (hb.slo_status == "failed"
+                            or (burn is not None
+                                and burn >= self.fleet_burn_threshold)))
+            if burning:
+                burning_hosts.append(host.host_id)
+            hosts_doc[host.host_id] = {
+                "url": host.url, "state": state,
+                "health": hb.health, "slo_status": hb.slo_status,
+                "burn_fast": burn, "burning": burning,
+                "burn_streak": host.burn_streak,
+                "seats": {"used": seats_used, "slots": seat_slots},
+                "pixels": {"used": px_used, "budget": px_budget},
+                "hbm_mb": {"used": round(hbm_used, 1),
+                           "limit": round(hbm_limit, 1)},
+                "watts_est": round(watts, 2),
+                "egress_mbps_est": round(egress, 2),
+                "sessions": len(hb.sessions),
+                "last_seen_s": round(now - host.last_seen, 3),
+                "incidents": digests.get(host.host_id, {}),
+            }
+            counts["known"] += 1
+            counts[state] += 1
+            key = {"warm": "warm_seat_slots",
+                   "cold": "cold_seat_slots",
+                   "draining": "draining_seat_slots",
+                   "lost": "unreachable_seat_slots"}[state]
+            capacity[key] += seat_slots
+            sums["seats_used"] += seats_used
+            sums["seat_slots"] += seat_slots
+            sums["pixels_used"] += px_used
+            sums["pixel_budget"] += px_budget
+            sums["hbm_used"] += hbm_used
+            sums["hbm_limit"] += hbm_limit
+            sums["watts"] += watts
+            sums["egress"] += egress
+            sums["sessions"] += len(hb.sessions)
+        placements = list(sched.placements.values())
+        n_relay = sum(1 for p in placements if p.spec.is_relay)
+        gw = self._gw_slo.evaluate(now=now)
+        if len(burning_hosts) >= self.failed_hosts \
+                or gw["status"] == "failed":
+            verdict = "failed"
+        elif burning_hosts or gw["status"] == "degraded":
+            verdict = "degraded"
+        else:
+            verdict = "ok"
+        with self._lock:
+            rejects = dict(self.heartbeat_rejects)
+            last_reject = dict(self.last_reject) \
+                if self.last_reject else None
+            open_traces = len(self._open)
+        fleet = {
+            "hosts": counts,
+            "capacity": capacity,
+            "seats": {"used": sums["seats_used"],
+                      "slots": sums["seat_slots"]},
+            "pixels": {"used": sums["pixels_used"],
+                       "budget": sums["pixel_budget"]},
+            "hbm_mb": {"used": round(sums["hbm_used"], 1),
+                       "limit": round(sums["hbm_limit"], 1)},
+            "watts_est": round(sums["watts"], 2),
+            "egress_mbps_est": round(sums["egress"], 2),
+            "sessions": sums["sessions"],
+            "placements": {"encode": len(placements) - n_relay,
+                           "relay": n_relay,
+                           "pending": len(sched.pending)},
+            "power_budget_w": sched.power_budget_w,
+            "gateway_mbps_budget": sched.gateway_mbps_budget,
+            "slo": {
+                "verdict": verdict,
+                "burning_hosts": burning_hosts,
+                "burn_threshold": self.fleet_burn_threshold,
+                "failed_hosts_threshold": self.failed_hosts,
+                "gateway": {"status": gw["status"],
+                            "burn_fast": gw["burn_fast"],
+                            "rejects": rejects,
+                            "last_reject": last_reject},
+            },
+            "incidents": {"recorded": self.recorder.total,
+                          "dropped": self.recorder.dropped,
+                          "host_incidents":
+                          self.host_incidents_total},
+            "migrations": {"open": open_traces,
+                           "traced": self.migrations_traced},
+        }
+        return {"ts": round(now, 3), "hosts": hosts_doc,
+                "fleet": fleet}
+
+    @staticmethod
+    def check_identities(roll: dict) -> dict:
+        """Re-derive every fleet sum from the per-host blocks of an
+        emitted rollup — the exact-sum identities the contract pins
+        (fleet seats == Σ host seats, and friends)."""
+        hosts = roll["hosts"].values()
+        fleet = roll["fleet"]
+
+        def s(fn) -> float:
+            return sum(fn(h) for h in hosts)
+
+        clauses = {
+            "seats_used": fleet["seats"]["used"]
+            == s(lambda h: h["seats"]["used"]),
+            "seat_slots": fleet["seats"]["slots"]
+            == s(lambda h: h["seats"]["slots"]),
+            "pixels_used": fleet["pixels"]["used"]
+            == s(lambda h: h["pixels"]["used"]),
+            "hbm_used": abs(fleet["hbm_mb"]["used"]
+                            - s(lambda h: h["hbm_mb"]["used"])) < 0.5,
+            "watts": abs(fleet["watts_est"]
+                         - s(lambda h: h["watts_est"])) < 0.1,
+            "egress": abs(fleet["egress_mbps_est"]
+                          - s(lambda h: h["egress_mbps_est"])) < 0.1,
+            "sessions": fleet["sessions"]
+            == s(lambda h: h["sessions"]),
+            "host_count": fleet["hosts"]["known"]
+            == len(roll["hosts"]),
+            "state_partition": fleet["hosts"]["known"]
+            == sum(fleet["hosts"][k]
+                   for k in ("warm", "cold", "draining", "lost")),
+            "capacity_partition": fleet["seats"]["slots"]
+            == sum(fleet["capacity"].values()),
+        }
+        return {"ok": all(clauses.values()), "clauses": clauses}
+
+    # -- migration tracing ---------------------------------------------------
+    def migration_start(self, kind: str, host_id: str,
+                        sids: Iterable[str]) -> str:
+        """Stamp a correlation id at drain/failover start and open one
+        seat trace per sid (first event: ``drain`` or ``lost``)."""
+        now = self._clock()
+        first_event = "drain" if kind == "drain" else "lost"
+        with self._lock:
+            self._corr_seq += 1
+            corr = f"mig-{self._corr_seq:04d}-{kind}"
+            traces = []
+            for sid in sids:
+                stale = self._open.pop(sid, None)
+                if stale is not None:
+                    stale.done = False   # superseded mid-flight
+                self._trace_seq += 1
+                tr = _SeatTrace(corr, sid, kind, host_id,
+                                self._trace_seq)
+                tr.events.append((first_event, now, {}))
+                self._open[sid] = tr
+                traces.append(tr)
+            self._by_corr[corr] = traces
+            while len(self._by_corr) > self.trace_capacity:
+                _, dropped = self._by_corr.popitem(last=False)
+                for tr in dropped:
+                    self._open.pop(tr.sid, None)
+        return corr
+
+    def migration_mark(self, sid: str, event: str, **fields) -> bool:
+        """Append one timeline event to an open seat trace (idempotent
+        per event name). ``first_frame`` completes the trace."""
+        now = self._clock()
+        with self._lock:
+            tr = self._open.get(sid)
+            if tr is None or event in tr.event_names():
+                return False
+            tr.events.append((event, now, fields))
+            if event == "replaced":
+                tr.to_host = fields.get("to_host")
+                if "within_grace" in fields:
+                    tr.within_grace = bool(fields["within_grace"])
+            if event == "first_frame":
+                tr.done = True
+                self._open.pop(sid, None)
+                self.migrations_traced += 1
+        return True
+
+    def migration_annotate(self, sid: str, **fields) -> None:
+        """Late honesty marks on an open trace (e.g. ``within_grace``
+        computed after the re-place)."""
+        with self._lock:
+            tr = self._open.get(sid)
+            if tr is None:
+                return
+            if "within_grace" in fields:
+                tr.within_grace = bool(fields["within_grace"])
+
+    # idempotent client-side marks (gateway WS path / sim client)
+    def note_reconnect(self, sid: str, **fields) -> bool:
+        return self.migration_mark(sid, "reconnect", via="migrate",
+                                   **fields)
+
+    def note_idr_resync(self, sid: str, **fields) -> bool:
+        return self.migration_mark(sid, "idr_resync", **fields)
+
+    def note_first_frame(self, sid: str, **fields) -> bool:
+        return self.migration_mark(sid, "first_frame", **fields)
+
+    def open_migration_sids(self) -> list:
+        with self._lock:
+            return list(self._open)
+
+    def migration_events_for(self, sid: str) -> list:
+        with self._lock:
+            tr = self._open.get(sid)
+            return tr.event_names() if tr is not None else []
+
+    def _advance_queued_traces(self) -> None:
+        """A queued seat re-places whenever capacity appears — the
+        scheduler path doesn't know about traces, so the heartbeat hook
+        watches: last event ``queued`` + sid now placed => mark
+        ``replaced``."""
+        with self._lock:
+            waiting = [tr.sid for tr in self._open.values()
+                       if tr.events and tr.events[-1][0] == "queued"]
+        for sid in waiting:
+            p = self.scheduler.get(sid)
+            if p is not None:
+                self.migration_mark(sid, "replaced",
+                                    to_host=p.host_id, idr_resync=True)
+
+    def migration_report(self, corr_id: str) -> dict:
+        """Per-correlation contract view: every seat's event list with
+        ordered/complete verdicts — what the bench asserts."""
+        with self._lock:
+            traces = list(self._by_corr.get(corr_id, ()))
+        seats = [tr.to_report() for tr in traces]
+        return {"corr_id": corr_id, "seats": seats,
+                "complete": bool(seats) and all(s["complete"]
+                                                for s in seats),
+                "ordered": bool(seats) and all(s["ordered"]
+                                               for s in seats)}
+
+    def migration_timelines(self,
+                            corr_id: Optional[str] = None) -> list:
+        with self._lock:
+            out = []
+            for corr, traces in self._by_corr.items():
+                if corr_id is not None and corr != corr_id:
+                    continue
+                out.extend(tr.to_timeline() for tr in traces
+                           if tr.events)
+        return out
+
+    def trace_document(self, corr_id: Optional[str] = None) -> dict:
+        """The migration timelines as a Chrome trace-event document
+        (``fleet`` lane), via the existing exporter."""
+        from ..trace.export import to_trace_events
+        return to_trace_events(self.migration_timelines(corr_id),
+                               process_name="selkies-fleet")
+
+    # -- full JSON surface (GET /fleet/obs) ----------------------------------
+    def obs_doc(self, window_s: Optional[float] = None) -> dict:
+        return {"rollup": self.rollup(),
+                "series": self.series_doc(window_s=window_s),
+                "incidents": self.recorder.snapshot()[-50:]}
+
+    # -- Prometheus export (GET /fleet/metrics) ------------------------------
+    _HOST_FAMILIES = (
+        "selkies_fleet_host_seats_used",
+        "selkies_fleet_host_seat_slots",
+        "selkies_fleet_host_hbm_used_mb",
+        "selkies_fleet_host_watts_est",
+        "selkies_fleet_host_egress_mbps_est",
+        "selkies_fleet_host_burn_fast",
+        "selkies_fleet_host_up",
+    )
+
+    def _host_label(self, host_id: str) -> str:
+        """First-come label ownership under the cardinality cap; every
+        late host shares the ``_overflow`` aggregate."""
+        if host_id in self._label_order:
+            return host_id
+        if len(self._label_order) < self.host_label_cap:
+            self._label_order.append(host_id)
+            return host_id
+        return "_overflow"
+
+    def export_metrics(self) -> None:
+        """Push the rollup into the process metrics registry (lazy +
+        guarded: the lint image has no server plane). Per-host series
+        are cleared and re-set each export so departed hosts vanish
+        instead of flat-lining."""
+        try:
+            from ..server import metrics
+        except Exception:
+            return
+        roll = self.rollup()
+        metrics.describe("selkies_fleet_host_seats_used",
+                         "Seats in use per host (heartbeat-reported)")
+        metrics.describe("selkies_fleet_host_seat_slots",
+                         "Seat slots per host")
+        metrics.describe("selkies_fleet_host_hbm_used_mb",
+                         "HBM in use per host, MB")
+        metrics.describe("selkies_fleet_host_watts_est",
+                         "Estimated power draw per host")
+        metrics.describe("selkies_fleet_host_egress_mbps_est",
+                         "Estimated upstream egress per host, Mbit/s")
+        metrics.describe("selkies_fleet_host_burn_fast",
+                         "Fast-window SLO burn per host")
+        metrics.describe("selkies_fleet_host_up",
+                         "1 = host warm and placeable")
+        metrics.describe("selkies_fleet_slo_verdict",
+                         "Fleet SLO verdict (0=ok 1=degraded "
+                         "2=failed)")
+        metrics.describe("selkies_fleet_queue_depth",
+                         "Placement queue depth")
+        metrics.describe("selkies_fleet_seats_used",
+                         "Fleet-wide seats in use")
+        metrics.describe("selkies_fleet_seat_slots",
+                         "Fleet-wide seat slots")
+        for family in self._HOST_FAMILIES:
+            metrics.clear_metric(family)
+        agg = {f: 0.0 for f in self._HOST_FAMILIES}
+        overflow = False
+        with self._lock:
+            for host_id, h in roll["hosts"].items():
+                label = self._host_label(host_id)
+                values = {
+                    "selkies_fleet_host_seats_used":
+                    h["seats"]["used"],
+                    "selkies_fleet_host_seat_slots":
+                    h["seats"]["slots"],
+                    "selkies_fleet_host_hbm_used_mb":
+                    h["hbm_mb"]["used"],
+                    "selkies_fleet_host_watts_est": h["watts_est"],
+                    "selkies_fleet_host_egress_mbps_est":
+                    h["egress_mbps_est"],
+                    "selkies_fleet_host_burn_fast":
+                    h["burn_fast"] or 0.0,
+                    "selkies_fleet_host_up":
+                    1.0 if h["state"] == "warm" else 0.0,
+                }
+                if label == "_overflow":
+                    overflow = True
+                    for fam, v in values.items():
+                        # burn aggregates as MAX (a single burning
+                        # overflow host must stay visible), the
+                        # capacity axes as sums
+                        if fam == "selkies_fleet_host_burn_fast":
+                            agg[fam] = max(agg[fam], v)
+                        else:
+                            agg[fam] += v
+                    continue
+                for fam, v in values.items():
+                    metrics.set_gauge(fam, v, {"host": label})
+        if overflow:
+            for fam, v in agg.items():
+                metrics.set_gauge(fam, round(v, 2),
+                                  {"host": "_overflow"})
+        fleet = roll["fleet"]
+        metrics.set_gauge("selkies_fleet_slo_verdict",
+                          _VERDICT_RANK.get(fleet["slo"]["verdict"],
+                                            2))
+        metrics.set_gauge("selkies_fleet_queue_depth",
+                          fleet["placements"]["pending"])
+        metrics.set_gauge("selkies_fleet_seats_used",
+                          fleet["seats"]["used"])
+        metrics.set_gauge("selkies_fleet_seat_slots",
+                          fleet["seats"]["slots"])
+
+    # -- plumbing ------------------------------------------------------------
+    def _record(self, kind: str, **fields) -> None:
+        try:
+            self.recorder.record(kind, **fields)
+        except Exception:
+            logger.debug("fleet obs incident record failed",
+                         exc_info=True)

@@ -30,7 +30,7 @@ __all__ = ["PROTOCOL_VERSION", "SEAT_CLASSES", "FleetProtocolError",
            "DeviceCapacity", "SeatSession", "Heartbeat", "SessionSpec",
            "parse_heartbeat", "parse_session_spec", "estimate_hbm_mb",
            "estimate_session_watts", "estimate_relay_mbps",
-           "migrate_command", "heartbeat_from_core"]
+           "migrate_command", "heartbeat_from_core", "rejection_kind"]
 
 PROTOCOL_VERSION = 1
 
@@ -50,6 +50,7 @@ _MAX_HBM_MB = 16 * 1024 * 1024    # 16 TiB, in MB
 _MAX_SESSIONS = 65_536
 _MAX_WATTS = 1_000_000.0          # 1 MW: see parse_heartbeat
 _MAX_MBPS = 1_000_000.0           # 1 Tbps: egress sanity ceiling
+_MAX_INCIDENT_KINDS = 32          # incident-digest bound (ISSUE 18)
 
 _HEALTH_STATES = ("ok", "degraded", "failed")
 
@@ -155,6 +156,12 @@ class Heartbeat:
     devices: list = dataclasses.field(default_factory=list)
     sessions: list = dataclasses.field(default_factory=list)
     warm_geometries: list = dataclasses.field(default_factory=list)
+    #: bounded per-host incident digest (ISSUE 18): cumulative counts
+    #: of this host's flight-recorder incident kinds, e.g.
+    #: ``[{"kind": "qoe_collapse", "count": 3}]`` — how host-side
+    #: incidents (crash_loop, relay_death …) surface fleet-wide. The
+    #: fleet observer records a merge entry only when a count RISES.
+    incidents: list = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -171,6 +178,7 @@ class Heartbeat:
             "devices": [d.to_dict() for d in self.devices],
             "sessions": [s.to_dict() for s in self.sessions],
             "warm_geometries": list(self.warm_geometries),
+            "incidents": [dict(i) for i in self.incidents],
         }
 
     def to_json(self) -> str:
@@ -421,7 +429,58 @@ def parse_heartbeat(doc) -> Heartbeat:
             raise FleetProtocolError(
                 f"warm geometry {w!r} has a malformed stripe suffix")
         hb.warm_geometries.append(w)
+
+    # incident digest (ISSUE 18): strictly bounded and range-checked —
+    # it feeds the fleet flight recorder, and an absurd digest must not
+    # become an incident flood on the gateway side
+    incidents = doc.get("incidents", [])
+    if not isinstance(incidents, list) \
+            or len(incidents) > _MAX_INCIDENT_KINDS:
+        raise FleetProtocolError("incidents must be a list "
+                                 f"(<= {_MAX_INCIDENT_KINDS})")
+    seen_kinds = set()
+    for i, item in enumerate(incidents):
+        if not isinstance(item, dict):
+            raise FleetProtocolError(f"incidents[{i}] must be an object")
+        kind = _ident(_need(item, "kind"), f"incidents[{i}].kind",
+                      maxlen=64)
+        if kind in seen_kinds:
+            raise FleetProtocolError(
+                f"incidents[{i}].kind={kind!r} repeated")
+        seen_kinds.add(kind)
+        count = int(_num(_need(item, "count"),
+                         f"incidents[{i}].count", 0, 2**53))
+        hb.incidents.append({"kind": kind, "count": count})
     return hb
+
+
+#: rejection-kind classification for gateway intake counters: map the
+#: strict parser's error text onto a small, bounded label vocabulary
+#: (metric labels must not be attacker-controlled free text)
+_REJECTION_KINDS = (
+    ("unparseable heartbeat:", "bad_json"),
+    ("unparseable spec:", "bad_json"),
+    ("must be a JSON object", "bad_json"),
+    ("is not 'heartbeat'", "bad_kind"),
+    ("newer than mine", "bad_version"),
+    ("missing required field", "missing_field"),
+    ("must be a number", "bad_number"),
+    ("outside [", "out_of_range"),
+    ("not in", "bad_enum"),
+    ("must be a non-empty string", "bad_ident"),
+    ("must be a list", "bad_shape"),
+    ("must be an object", "bad_shape"),
+)
+
+
+def rejection_kind(exc: Exception) -> str:
+    """Classify a :class:`FleetProtocolError` into a bounded label for
+    the gateway's per-kind rejection counter."""
+    msg = str(exc)
+    for needle, kind in _REJECTION_KINDS:
+        if needle in msg:
+            return kind
+    return "other"
 
 
 def parse_session_spec(doc) -> SessionSpec:
@@ -586,6 +645,18 @@ def heartbeat_from_core(core, url: str = "", seq: int = 0) -> Heartbeat:
             estimate_relay_mbps(s.width, s.height, s.codec)
             for s in hb.sessions
             if getattr(s, "seat_class", "encode") == "encode"), 2)
+    except Exception:
+        pass
+    # incident digest (ISSUE 18): cumulative count-by-kind of this
+    # host's flight-recorder ring, bounded to the busiest 16 kinds so
+    # the heartbeat stays small whatever the local incident history
+    try:
+        from ..obs.health import engine as _health_engine
+        counts = _health_engine.recorder.counts()
+        hb.incidents = [
+            {"kind": k, "count": c}
+            for k, c in sorted(counts.items(),
+                               key=lambda kv: (-kv[1], kv[0]))[:16]]
     except Exception:
         pass
     return hb

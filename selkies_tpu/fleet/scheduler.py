@@ -196,6 +196,17 @@ class SeatScheduler:
         #: END on its host, or the host's next heartbeat keeps charging
         #: the seat and the freed capacity never really frees
         self.on_release: Optional[Callable[[Placement], None]] = None
+        #: observation hook (ISSUE 18): called with every VALIDATED
+        #: heartbeat after it folds into host state — the fleet
+        #: observer's intake. Strictly post-parse: the observer sees
+        #: exactly the stream the scheduler trusts, nothing rawer.
+        self.on_heartbeat: Optional[
+            Callable[[Heartbeat, "HostState"], None]] = None
+        #: sids whose CURRENT queue episode already recorded a
+        #: placement_pending incident — the edge-trigger set (ISSUE 18:
+        #: a spec stuck in the queue is ONE incident, not one per
+        #: sweep/migration retry; same discipline as slo_burn alerts)
+        self._pending_alerted: set = set()
 
     # -- heartbeat intake ----------------------------------------------------
     def observe(self, hb: Heartbeat) -> HostState:
@@ -215,6 +226,12 @@ class SeatScheduler:
                 host.update(hb, now, self.evict_burn_threshold)
         self.retry_pending()
         self._update_metrics()
+        if self.on_heartbeat is not None:
+            try:
+                self.on_heartbeat(hb, host)
+            except Exception:
+                logger.debug("fleet: on_heartbeat hook failed",
+                             exc_info=True)
         return host
 
     def expire(self) -> list[str]:
@@ -445,6 +462,8 @@ class SeatScheduler:
                 self._record("placement_refused", sid=spec.sid,
                              host_id=p.host_id)
                 return None
+        with self._lock:
+            self._pending_alerted.discard(spec.sid)   # re-arm the edge
         self._record("seat_placed", sid=spec.sid, host_id=p.host_id,
                      device=p.device, seat=p.seat,
                      geometry=f"{spec.width}x{spec.height}")
@@ -478,6 +497,7 @@ class SeatScheduler:
                           placed_at=self._clock())
             self.placements[spec.sid] = p
             self.total_placements += 1
+            self._pending_alerted.discard(spec.sid)   # re-arm the edge
         self._record("viewer_attached", sid=spec.sid,
                      source_sid=spec.source_sid, rung=spec.rung,
                      host_id=p.host_id,
@@ -516,14 +536,22 @@ class SeatScheduler:
             return
         if len(self.pending) >= self.pending_cap:
             old_spec, _ = self.pending.popleft()
+            self._pending_alerted.discard(old_spec.sid)
             self._record("placement_dropped", sid=old_spec.sid,
                          reason="pending queue full")
         self.pending.append((spec, self._clock()))
         self.total_queued += 1
-        self._record("placement_pending", sid=spec.sid,
-                     geometry=f"{spec.width}x{spec.height}",
-                     hbm_mb=spec.budget_mb(),
-                     queue_depth=len(self.pending))
+        # edge-triggered (ISSUE 18): a sid records ONE
+        # placement_pending per queue episode, however many sweeps or
+        # migration retries re-queue it — re-armed when it places,
+        # cancels, or releases. The bounded flight recorder must not
+        # fill with one copy of the same stuck spec per sweep.
+        if spec.sid not in self._pending_alerted:
+            self._pending_alerted.add(spec.sid)
+            self._record("placement_pending", sid=spec.sid,
+                         geometry=f"{spec.width}x{spec.height}",
+                         hbm_mb=spec.budget_mb(),
+                         queue_depth=len(self.pending))
         logger.warning("fleet: no host has headroom for %s "
                        "(%dx%d, %.0f MB); queued at depth %d",
                        spec.sid, spec.width, spec.height,
@@ -559,6 +587,7 @@ class SeatScheduler:
             for i, (s, _) in enumerate(self.pending):
                 if s.sid == sid:
                     del self.pending[i]
+                    self._pending_alerted.discard(sid)
                     return True
         return False
 
@@ -570,6 +599,7 @@ class SeatScheduler:
         itself (keep-warm semantics differ from a plain session end)."""
         with self._lock:
             p = self.placements.pop(sid, None)
+            self._pending_alerted.discard(sid)
             followers = []
             if p is not None and not p.spec.is_relay:
                 followers = [f for f in self.placements.values()

@@ -101,6 +101,10 @@ class SimHost:
         self.seq = 0
         self.slo_burning = False
         self.slo_fast_burn: Optional[float] = None
+        #: cumulative local incident counts (ISSUE 18): what a real
+        #: host's FlightRecorder.counts() holds — heartbeats carry the
+        #: busiest kinds as the bounded incident digest
+        self.local_incidents: dict = {}
         self.on_relay_unrecoverable: Optional[Callable[[str], None]] = None
         self.sched = ManualScheduler(clock)
         self.supervisor = Supervisor(
@@ -212,6 +216,12 @@ class SimHost:
             if not self.sched.pump():
                 break
 
+    def incident(self, kind: str, n: int = 1) -> None:
+        """Inject a host-local incident (qoe_collapse, crash_loop …):
+        bumps the cumulative digest the next heartbeat carries."""
+        self.local_incidents[kind] = \
+            self.local_incidents.get(kind, 0) + int(n)
+
     def kill(self) -> None:
         """Unplanned death: heartbeats stop mid-flight; nothing is
         released cleanly."""
@@ -262,6 +272,10 @@ class SimHost:
                 rung=getattr(s["spec"], "rung", ""))
                 for sid, s in self.sessions.items()],
             warm_geometries=self.warm_geometries(),
+            incidents=[
+                {"kind": k, "count": c}
+                for k, c in sorted(self.local_incidents.items(),
+                                   key=lambda kv: (-kv[1], kv[0]))[:16]],
         )
         return hb
 
@@ -282,6 +296,11 @@ class SimFleet:
         self.clock_box = clock_box if clock_box is not None else [0.0]
         self.heartbeats_sent = 0
         self.heartbeats_rejected = 0
+        #: fleet observer (ISSUE 18): when set, tick() also plays the
+        #: CLIENT side of each migration — reconnect via ``migrate,``
+        #: on one tick, IDR resync + first frame on the next — so
+        #: timelines complete with real (injected-clock) span durations
+        self.observer = None
 
     def clock(self) -> float:
         return self.clock_box[0]
@@ -311,6 +330,33 @@ class SimFleet:
                 self.heartbeats_rejected += 1
                 logger.exception("sim heartbeat rejected")
         self.coordinator.check_lost_hosts()
+        self._advance_clients()
+
+    def _advance_clients(self) -> None:
+        """The simulated web clients' migration steps: a seat that was
+        re-placed on a live host reconnects (the ``migrate,`` command)
+        on one tick, then sees the IDR resync and its first frame on
+        the NEXT — two clock steps, so every span in the timeline has a
+        real nonzero duration."""
+        obs = self.observer
+        if obs is None:
+            return
+        for sid in obs.open_migration_sids():
+            events = obs.migration_events_for(sid)
+            if "replaced" not in events:
+                continue
+            p = self.scheduler.get(sid)
+            if p is None:
+                continue
+            host = self.hosts.get(p.host_id)
+            if host is None or not host.alive:
+                continue
+            if "idr_resync" in events:
+                obs.note_first_frame(sid)
+            elif "reconnect" in events:
+                obs.note_idr_resync(sid)
+            else:
+                obs.note_reconnect(sid, url=host.url)
 
     def run_until(self, pred: Callable[[], bool], *, dt: float = 0.5,
                   budget_s: float = 60.0) -> bool:

@@ -116,6 +116,10 @@ class FlightRecorder:
         self._ring: collections.deque = collections.deque(maxlen=self.capacity)
         self.dropped = 0
         self.total = 0
+        # cumulative count-by-kind, surviving ring rollover — the
+        # heartbeat incident digest (ISSUE 18) needs monotone counts so
+        # the fleet observer can delta-trigger on increases
+        self._counts: dict = {}
 
     def record(self, kind: str, **fields) -> dict:
         entry = {"ts": round(time.time(), 3), "kind": str(kind),
@@ -125,6 +129,8 @@ class FlightRecorder:
                 self.dropped += 1
             self._ring.append(entry)
             self.total += 1
+            self._counts[entry["kind"]] = \
+                self._counts.get(entry["kind"], 0) + 1
         _metrics_incident(kind)
         return entry
 
@@ -132,11 +138,18 @@ class FlightRecorder:
         with self._lock:
             return list(self._ring)
 
+    def counts(self) -> dict:
+        """Cumulative incidents by kind (monotone across ring
+        rollover) — the source of the heartbeat incident digest."""
+        with self._lock:
+            return dict(self._counts)
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
             self.dropped = 0
             self.total = 0
+            self._counts.clear()
 
     def dump_text(self) -> str:
         """One JSON line per incident (journald/stderr friendly)."""

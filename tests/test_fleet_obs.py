@@ -1,0 +1,490 @@
+"""Fleet observability plane contracts (ISSUE 18): rollup exact-sum
+identities, bounded series rings (the autoscaler signal bus),
+incident-digest merging, correlated cross-host migration timelines on
+injected clocks, Chrome-trace export round-trips, edge-triggered flood
+control, heartbeat-rejection classification, and the Prometheus
+host-label cardinality cap — no sleeps anywhere."""
+
+import pytest
+
+from selkies_tpu.fleet.migrate import MigrationCoordinator
+from selkies_tpu.fleet.obs import MIGRATION_EVENTS, FleetObserver
+from selkies_tpu.fleet.protocol import (FleetProtocolError, SessionSpec,
+                                        parse_heartbeat, rejection_kind)
+from selkies_tpu.fleet.scheduler import SeatScheduler
+from selkies_tpu.fleet.sim import SimFleet, SimHost
+from selkies_tpu.obs.health import FlightRecorder
+from selkies_tpu.trace.export import timelines_from_events
+
+
+def make_rig(*, host_timeout_s=3.0, grace_s=6.0, host_label_cap=2,
+             failed_hosts=2):
+    clock_box = [0.0]
+    rec = FlightRecorder()
+    sched = SeatScheduler(clock=lambda: clock_box[0], recorder=rec,
+                          host_timeout_s=host_timeout_s)
+    coord = MigrationCoordinator(sched, clock=lambda: clock_box[0],
+                                 recorder=rec, grace_s=grace_s)
+    fleet = SimFleet(sched, coord, clock_box=clock_box)
+    obs = FleetObserver(sched, coord, clock=lambda: clock_box[0],
+                        recorder=rec, host_label_cap=host_label_cap,
+                        failed_hosts=failed_hosts)
+    fleet.observer = obs
+    return fleet, sched, coord, rec, obs
+
+
+def add_host(fleet, name, *, seat_slots=2, devices=2,
+             warm_after_s=0.0, hbm_limit_mb=8192.0):
+    return fleet.add_host(SimHost(
+        name, clock=fleet.clock, devices=devices, seat_slots=seat_slots,
+        hbm_limit_mb=hbm_limit_mb, warm_after_s=warm_after_s,
+        warm_geometries=("1280x720",), grace_s=6.0,
+        recorder=fleet.scheduler.recorder))
+
+
+def place_n(sched, n, prefix="s"):
+    placed = []
+    for i in range(n):
+        p = sched.place(SessionSpec(f"{prefix}{i}"))
+        assert p is not None
+        placed.append(p)
+    return placed
+
+
+# ---------------------------------------------------------------- rollup
+
+class TestRollupIdentities:
+    def test_fleet_sums_equal_host_sums(self):
+        fleet, sched, _, _, obs = make_rig()
+        add_host(fleet, "h0")
+        add_host(fleet, "h1")
+        add_host(fleet, "h2", warm_after_s=5.0)   # cold
+        fleet.tick(0.5)
+        place_n(sched, 3)
+        fleet.tick(0.5)
+        roll = obs.rollup()
+        verdict = FleetObserver.check_identities(roll)
+        assert verdict["ok"], verdict["clauses"]
+        # and the identity check is not vacuous: breaking one host's
+        # numbers breaks the re-derivation
+        roll["hosts"]["h0"]["seats"]["used"] += 1
+        assert not FleetObserver.check_identities(roll)["ok"]
+
+    def test_state_partition_counts_lost_and_draining(self):
+        fleet, sched, coord, _, obs = make_rig()
+        add_host(fleet, "h0")
+        add_host(fleet, "h1")
+        add_host(fleet, "h2")
+        fleet.tick(0.5)
+        coord.evacuate("h0")
+        fleet.hosts["h1"].kill()
+        fleet.tick(4.0)       # h1 expires
+        roll = obs.rollup()
+        counts = roll["fleet"]["hosts"]
+        assert counts["known"] == 3
+        assert counts["lost"] == 1
+        assert counts["draining"] == 1
+        assert FleetObserver.check_identities(roll)["ok"]
+        # unreachable capacity is carved out of the fleet seat slots
+        assert roll["fleet"]["capacity"]["unreachable_seat_slots"] > 0
+
+
+# ---------------------------------------------------------------- series
+
+class TestSeriesRings:
+    def test_rings_fill_one_sample_per_tick(self):
+        fleet, sched, _, _, obs = make_rig()
+        add_host(fleet, "h0")
+        add_host(fleet, "h1")
+        fleet.tick(0.5)
+        place_n(sched, 2)
+        for _ in range(4):
+            fleet.tick(0.5)
+        ring = obs.series("seat_occupancy")
+        assert len(ring) == 5          # one per tick, not one per host
+        ts = [p[0] for p in ring]
+        assert ts == sorted(ts)
+        assert "watts_est" in obs.series()
+        assert "queue_depth" in obs.series()
+
+    def test_window_trims_to_trailing_seconds(self):
+        fleet, sched, _, _, obs = make_rig()
+        add_host(fleet, "h0")
+        for _ in range(10):
+            fleet.tick(1.0)
+        full = obs.series("hosts_ready")
+        recent = obs.series("hosts_ready", window_s=3.0)
+        assert len(full) == 10
+        assert len(recent) == 4          # inclusive at now - window
+        assert all(ts >= fleet.clock() - 3.0 for ts, _ in recent)
+
+    def test_rings_are_bounded(self):
+        fleet, sched, _, _, obs = make_rig()
+        obs.series_capacity = 8
+        obs._series.clear()
+        add_host(fleet, "h0")
+        for _ in range(20):
+            fleet.tick(0.5)
+        assert len(obs.series("seat_occupancy")) == 8
+
+
+# ------------------------------------------------------- incident digest
+
+class TestIncidentDigest:
+    def test_digest_round_trips_the_wire(self):
+        fleet, _, _, _, _ = make_rig()
+        h = add_host(fleet, "h0")
+        h.incident("qoe_collapse", 3)
+        h.incident("crash_loop")
+        hb = parse_heartbeat(h.heartbeat().to_dict())
+        assert {"kind": "qoe_collapse", "count": 3} in hb.incidents
+        assert {"kind": "crash_loop", "count": 1} in hb.incidents
+
+    def test_digest_is_strictly_parsed(self):
+        fleet, _, _, _, _ = make_rig()
+        h = add_host(fleet, "h0")
+        doc = h.heartbeat().to_dict()
+        doc["incidents"] = [{"kind": "x", "count": -1}]
+        with pytest.raises(FleetProtocolError):
+            parse_heartbeat(doc)
+        doc["incidents"] = [{"kind": "x"}]
+        with pytest.raises(FleetProtocolError):
+            parse_heartbeat(doc)
+        doc["incidents"] = [{"kind": "x", "count": 1}] * 2
+        with pytest.raises(FleetProtocolError):
+            parse_heartbeat(doc)
+        doc["incidents"] = [{"kind": f"k{i}", "count": 1}
+                            for i in range(64)]
+        with pytest.raises(FleetProtocolError):
+            parse_heartbeat(doc)
+
+    def test_merge_is_delta_triggered(self):
+        fleet, _, _, rec, _ = make_rig()
+        h = add_host(fleet, "h0")
+        h.incident("relay_death", 2)
+        fleet.tick(0.5)
+        fleet.tick(0.5)     # same digest re-beats: silent
+        merged = [e for e in rec.snapshot()
+                  if e["kind"] == "host_incident"]
+        assert len(merged) == 1
+        assert merged[0]["incident"] == "relay_death"
+        assert merged[0]["delta"] == 2
+        h.incident("relay_death")       # count rises -> one more merge
+        fleet.tick(0.5)
+        merged = [e for e in rec.snapshot()
+                  if e["kind"] == "host_incident"]
+        assert len(merged) == 2
+        assert merged[1]["delta"] == 1
+
+
+# ----------------------------------------------------- migration tracing
+
+class TestMigrationTimeline:
+    def _complete(self, fleet, obs, corr, budget_s=20.0):
+        assert fleet.run_until(
+            lambda: obs.migration_report(corr)["complete"],
+            dt=0.5, budget_s=budget_s)
+        return obs.migration_report(corr)
+
+    def test_drain_timeline_round_trip(self):
+        fleet, sched, coord, _, obs = make_rig()
+        add_host(fleet, "h0")
+        add_host(fleet, "h1")
+        fleet.tick(0.5)
+        place_n(sched, 3)
+        fleet.tick(0.5)
+        report = coord.evacuate("h0")
+        corr = report["correlation_id"]
+        assert corr and corr.endswith("-drain")
+        mrep = self._complete(fleet, obs, corr)
+        assert mrep["ordered"]
+        assert len(mrep["seats"]) == 3
+        for seat in mrep["seats"]:
+            assert seat["events"] == ["drain", "replaced", "reconnect",
+                                      "idr_resync", "first_frame"]
+            assert seat["to"] == "h1"
+        # the Chrome-trace export survives a round trip: the X spans
+        # come back on the fleet lane with the correlation id intact
+        doc = obs.trace_document(corr)
+        rebuilt = timelines_from_events(doc["traceEvents"])
+        assert len(rebuilt) == 3
+        for tl in rebuilt:
+            assert tl["display_id"] == corr
+            names = [s["name"] for s in tl["spans"]]
+            order = [MIGRATION_EVENTS.index(n) for n in names]
+            assert order == sorted(order)
+            assert all(s["lane"] == "fleet" for s in tl["spans"])
+            assert all(s["dur_ns"] > 0 for s in tl["spans"])
+
+    def test_failover_within_grace_honest_inside_window(self):
+        fleet, sched, coord, rec, obs = make_rig(host_timeout_s=2.0,
+                                                 grace_s=6.0)
+        add_host(fleet, "h0")
+        add_host(fleet, "h1")
+        fleet.tick(0.5)
+        place_n(sched, 2)
+        fleet.tick(0.5)
+        fleet.hosts["h0"].kill()
+        fleet.tick(2.5)       # past timeout, inside grace
+        fo = [e for e in rec.snapshot() if e["kind"] == "host_failover"]
+        assert fo and fo[-1]["correlation_id"].endswith("-failover")
+        mrep = self._complete(fleet, obs, fo[-1]["correlation_id"])
+        assert mrep["ordered"]
+        for seat in mrep["seats"]:
+            assert seat["events"][0] == "lost"
+            assert seat["within_grace"] is True
+
+    def test_failover_past_grace_reports_honestly(self):
+        # grace BELOW the heartbeat timeout: by the time silence is
+        # recognised, the client already saw a teardown — the timeline
+        # must say so instead of flattering the fleet
+        fleet, sched, coord, rec, obs = make_rig(host_timeout_s=4.0,
+                                                 grace_s=1.0)
+        add_host(fleet, "h0")
+        add_host(fleet, "h1")
+        fleet.tick(0.5)
+        place_n(sched, 2)
+        fleet.tick(0.5)
+        fleet.hosts["h0"].kill()
+        fleet.tick(5.0)
+        fo = [e for e in rec.snapshot() if e["kind"] == "host_failover"]
+        assert fo
+        mrep = self._complete(fleet, obs, fo[-1]["correlation_id"])
+        for seat in mrep["seats"]:
+            assert seat["within_grace"] is False
+
+    def test_queued_seat_timeline_advances_on_replacement(self):
+        # h1 can't take h0's seats until it warms: the drain queues
+        # them, the timeline records the detour, and once capacity
+        # appears the heartbeat hook advances queued -> replaced
+        fleet, sched, coord, _, obs = make_rig()
+        add_host(fleet, "h0")
+        add_host(fleet, "h1", warm_after_s=5.0)
+        fleet.tick(0.5)
+        place_n(sched, 2)
+        fleet.tick(0.5)
+        report = coord.evacuate("h0")
+        assert report["queued"] == 2
+        corr = report["correlation_id"]
+        events = obs.migration_events_for(report["results"][0]["sid"])
+        assert events == ["drain", "queued"]
+        mrep = self._complete(fleet, obs, corr)
+        for seat in mrep["seats"]:
+            assert seat["events"] == ["drain", "queued", "replaced",
+                                      "reconnect", "idr_resync",
+                                      "first_frame"]
+            assert seat["ordered"]
+
+    def test_marks_are_idempotent_and_unknown_sids_ignored(self):
+        fleet, sched, coord, _, obs = make_rig()
+        add_host(fleet, "h0")
+        add_host(fleet, "h1")
+        fleet.tick(0.5)
+        place_n(sched, 1)
+        corr = obs.migration_start("drain", "h0", ["s0"])
+        assert obs.migration_mark("s0", "replaced", to_host="h1")
+        assert not obs.migration_mark("s0", "replaced", to_host="h1")
+        assert not obs.note_reconnect("nobody")
+        assert obs.note_reconnect("s0")
+        assert obs.note_first_frame("s0")
+        assert obs.migration_report(corr)["complete"]
+        # completed traces leave the open set
+        assert "s0" not in obs.open_migration_sids()
+
+    def test_trace_capacity_bounds_retained_correlations(self):
+        fleet, sched, _, _, obs = make_rig()
+        obs.trace_capacity = 4
+        for i in range(10):
+            obs.migration_start("drain", "h0", [f"x{i}"])
+        assert len(obs._by_corr) == 4
+        assert len(obs.open_migration_sids()) == 4
+
+
+# -------------------------------------------------------- fleet verdict
+
+class TestFleetSloVerdict:
+    def test_verdict_flips_degraded_failed_ok(self):
+        fleet, sched, _, _, obs = make_rig(failed_hosts=2)
+        add_host(fleet, "h0")
+        add_host(fleet, "h1")
+        add_host(fleet, "h2")
+        fleet.tick(0.5)
+        assert obs.rollup()["fleet"]["slo"]["verdict"] == "ok"
+        fleet.hosts["h1"].slo_burning = True
+        fleet.tick(0.5)
+        roll = obs.rollup()
+        assert roll["fleet"]["slo"]["verdict"] == "degraded"
+        assert roll["fleet"]["slo"]["burning_hosts"] == ["h1"]
+        fleet.hosts["h2"].slo_burning = True
+        fleet.tick(0.5)
+        assert obs.rollup()["fleet"]["slo"]["verdict"] == "failed"
+        fleet.hosts["h1"].slo_burning = False
+        fleet.hosts["h2"].slo_burning = False
+        fleet.tick(0.5)
+        assert obs.rollup()["fleet"]["slo"]["verdict"] == "ok"
+
+    def test_lost_hosts_do_not_count_as_burning(self):
+        fleet, sched, _, _, obs = make_rig(host_timeout_s=2.0)
+        add_host(fleet, "h0")
+        h1 = add_host(fleet, "h1")
+        fleet.tick(0.5)
+        h1.slo_burning = True
+        fleet.tick(0.5)
+        assert obs.rollup()["fleet"]["slo"]["verdict"] == "degraded"
+        h1.kill()
+        fleet.tick(3.0)       # h1 expires; its last beat said burning
+        roll = obs.rollup()
+        assert roll["fleet"]["slo"]["burning_hosts"] == []
+        assert roll["fleet"]["slo"]["verdict"] == "ok"
+
+    def test_gateway_own_budget_burns_the_verdict(self):
+        fleet, sched, _, _, obs = make_rig()
+        add_host(fleet, "h0")
+        fleet.tick(0.5)
+        assert obs.rollup()["fleet"]["slo"]["verdict"] == "ok"
+        # a reject storm at the gateway's intake: ITS budget fails the
+        # fleet even with every engine host healthy
+        for _ in range(50):
+            obs.note_heartbeat_reject("bad_json", "junk", "evil")
+            fleet.tick(0.1)
+        roll = obs.rollup()
+        assert roll["fleet"]["slo"]["gateway"]["status"] == "failed"
+        assert roll["fleet"]["slo"]["verdict"] == "failed"
+        assert roll["fleet"]["slo"]["gateway"]["rejects"][
+            "bad_json"] == 50
+        assert roll["fleet"]["slo"]["gateway"]["last_reject"][
+            "host_id"] == "evil"
+
+
+# ------------------------------------------------- rejection classifier
+
+class TestRejectionKind:
+    @pytest.mark.parametrize("doc,kind", [
+        ("not json at all", "bad_json"),
+        ({"kind": "nope"}, "bad_kind"),
+        ({"v": 99, "kind": "heartbeat", "host_id": "h"}, "bad_version"),
+        ({"v": 1, "kind": "heartbeat"}, "missing_field"),
+        ({"v": 1, "kind": "heartbeat", "host_id": "h",
+          "watts_est": "hot"}, "bad_number"),
+        ({"v": 1, "kind": "heartbeat", "host_id": "h",
+          "watts_est": -1}, "out_of_range"),
+        ({"v": 1, "kind": "heartbeat", "host_id": "h",
+          "health": "meh"}, "bad_enum"),
+        ({"v": 1, "kind": "heartbeat", "host_id": ""}, "bad_ident"),
+        ({"v": 1, "kind": "heartbeat", "host_id": "h",
+          "devices": "x"}, "bad_shape"),
+    ])
+    def test_bounded_vocabulary(self, doc, kind):
+        with pytest.raises(FleetProtocolError) as ei:
+            parse_heartbeat(doc)
+        assert rejection_kind(ei.value) == kind
+
+
+# ------------------------------------------------ edge-triggered floods
+
+class TestFloodControl:
+    def test_stuck_pending_records_once(self):
+        fleet, sched, _, rec, _ = make_rig()
+        add_host(fleet, "h0")
+        fleet.tick(0.5)
+        sched.place(SessionSpec("stuck", 3840, 2160, hbm_mb=1e6))
+        for _ in range(6):
+            fleet.tick(0.5)   # every heartbeat retries the queue
+        records = [e for e in rec.snapshot()
+                   if e["kind"] == "placement_pending"
+                   and e["sid"] == "stuck"]
+        assert len(records) == 1
+        # cancel re-arms: a NEW queue episode records again
+        assert sched.cancel_pending("stuck")
+        sched.place(SessionSpec("stuck", 3840, 2160, hbm_mb=1e6))
+        records = [e for e in rec.snapshot()
+                   if e["kind"] == "placement_pending"
+                   and e["sid"] == "stuck"]
+        assert len(records) == 2
+
+    def test_evict_blocked_records_once_per_episode(self):
+        # one burning host, nowhere to move: the hysteresis keeps
+        # re-selecting the seat every sweep, the incident records once
+        fleet, sched, coord, rec, _ = make_rig()
+        sched.evict_confirm = 2
+        sched.evict_hold_s = 0.0
+        add_host(fleet, "h0", seat_slots=1, devices=1)
+        fleet.tick(0.5)
+        assert sched.place(SessionSpec("s0")) is not None
+        fleet.hosts["h0"].slo_burning = True
+        for _ in range(6):
+            fleet.tick(0.5)
+            coord.rebalance()
+        blocked = [e for e in rec.snapshot()
+                   if e["kind"] == "evict_blocked"]
+        assert len(blocked) == 1
+        assert blocked[0]["host_id"] == "h0"
+        # burn clears -> re-armed -> a fresh episode records again
+        fleet.hosts["h0"].slo_burning = False
+        fleet.tick(0.5)
+        coord.rebalance()
+        fleet.hosts["h0"].slo_burning = True
+        for _ in range(6):
+            fleet.tick(0.5)
+            coord.rebalance()
+        blocked = [e for e in rec.snapshot()
+                   if e["kind"] == "evict_blocked"]
+        assert len(blocked) == 2
+
+
+# ------------------------------------------------- Prometheus export
+
+class TestMetricsCardinality:
+    def setup_method(self):
+        pytest.importorskip("aiohttp")
+        from selkies_tpu.server import metrics
+        metrics.clear()
+        self.metrics = metrics
+
+    def test_host_labels_capped_with_overflow_rollup(self):
+        fleet, sched, _, _, obs = make_rig(host_label_cap=2)
+        for i in range(5):
+            add_host(fleet, f"h{i}")
+        fleet.tick(0.5)
+        place_n(sched, 6)
+        fleet.tick(0.5)
+        obs.export_metrics()
+        text = self.metrics.render_prometheus()
+        for family in FleetObserver._HOST_FAMILIES:
+            lines = [ln for ln in text.splitlines()
+                     if ln.startswith(family + "{")]
+            labels = {ln.split('host="')[1].split('"')[0]
+                      for ln in lines}
+            assert len(labels) <= 3, (family, labels)
+            assert "_overflow" in labels, (family, labels)
+        # the overflow rollup keeps the capacity sums honest: capped
+        # series + overflow == the fleet total
+        roll = obs.rollup()
+        total = 0.0
+        for ln in text.splitlines():
+            if ln.startswith("selkies_fleet_host_seats_used{"):
+                total += float(ln.rsplit(" ", 1)[1])
+        assert total == roll["fleet"]["seats"]["used"]
+
+    def test_departed_hosts_do_not_flatline(self):
+        fleet, sched, _, _, obs = make_rig(host_label_cap=8)
+        add_host(fleet, "h0")
+        add_host(fleet, "h1")
+        fleet.tick(0.5)
+        obs.export_metrics()
+        del sched.hosts["h1"]
+        obs.export_metrics()
+        text = self.metrics.render_prometheus()
+        assert 'selkies_fleet_host_up{host="h1"}' not in text
+
+    def test_reject_counter_by_kind(self):
+        fleet, _, _, _, obs = make_rig()
+        obs.note_heartbeat_reject("bad_json", "junk", "evil")
+        obs.note_heartbeat_reject("bad_json", "junk", "evil")
+        obs.note_heartbeat_reject("missing_field", "no host_id", "")
+        assert self.metrics.counter_value(
+            "selkies_fleet_heartbeat_rejects_total",
+            {"kind": "bad_json"}) == 2
+        assert obs.heartbeat_rejects == {"bad_json": 2,
+                                         "missing_field": 1}
+        assert obs.last_reject["kind"] == "missing_field"
