@@ -146,6 +146,18 @@ async def run(argv=None) -> None:
         logging.getLogger("selkies_tpu.obs").warning(
             "flight recorder at shutdown (%d incidents, %d dropped):\n%s",
             incidents.total, incidents.dropped, incidents.dump_text())
+    # stable-path post-mortem dump (ISSUE 19): host_id-keyed, atomic
+    # (tmp+rename) so the fleet soak harness / operators collect
+    # incident rings from killed hosts without parsing logs
+    dump_dir = os.environ.get("SELKIES_INCIDENT_DUMP_DIR", "")
+    if dump_dir:
+        try:
+            path = incidents.dump_file(dump_dir)
+            logging.getLogger("selkies_tpu.obs").info(
+                "incident ring dumped to %s", path)
+        except OSError:
+            logging.getLogger("selkies_tpu.obs").exception(
+                "incident dump to %s failed", dump_dir)
     _devmon.stop()
     await server.shutdown()
     if owned_compositor is not None:

@@ -1,0 +1,295 @@
+"""Scaling advisor: the observe-side of the autoscaler loop (ROADMAP 5b).
+
+Reads the bounded signal bus ISSUE 18 built — ``FleetObserver.series()``
+rings for SLO burn, seat/pixel/HBM occupancy, ``watts_est`` and
+placement-queue depth — and emits ``desired_hosts``: the host count the
+fleet SHOULD be running to serve the observed load at the lowest
+fleet-wide power that still meets the SLO (the fps/W-vs-latency trade
+the NVENC efficiency-longitudinal paper frames, PAPERS.md). This PR is
+**observe-only**: the advisor publishes a signal (gauge + ``/fleet/obs``
+``advisor`` block + ``advisor_flip`` incidents); actuation (real
+scale-up / drain-based descheduling) is a follow-up PR that consumes
+exactly this contract.
+
+Design constraints, mirroring the degradation ladder and the
+scheduler's SLO evictions:
+
+- **Pure decision core.** :func:`decide` is a pure function
+  ``(signals, state, params) -> (decision, state)`` on injected time —
+  no clocks, no I/O — so the hysteresis walk is exhaustively testable
+  the way the ladder's is. :class:`ScalingAdvisor` is the thin stateful
+  wrapper the gateway sweeps.
+- **Two-sided hysteresis.** Scale-up needs ``up_confirm`` consecutive
+  pressured evaluations; scale-down needs ``down_confirm`` calm ones
+  AND ``hold_s`` of dwell since the last flip — up is eager (an SLO
+  burn is user-visible NOW), down is lazy (killing a host is cheap to
+  regret). One evaluation of mixed pressure resets both streaks.
+- **Named reasons.** Every decision carries the reason that drove it
+  (``slo_burn``, ``occupancy_high``, ``queue_depth``, ``occupancy_low``,
+  ``stale_input``, ``confirming``, ``holding``, ``steady``) — an
+  autoscaler that can't say WHY it flipped is undebuggable at 3am.
+- **Stale fail-safe.** When the observer's input is stale (no heartbeat
+  within 2x the expected interval — the wedged-observer flag the
+  rollup now carries), the advisor HOLDS and never scales down: absent
+  data means absent evidence, and shrinking a fleet on absent evidence
+  is how outages compound.
+
+Stdlib-only (the lint image runs ``python -m selkies_tpu.fleet
+obs-selftest`` with neither jax nor aiohttp); the metrics bridge is
+lazy + guarded like every fleet exporter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+logger = logging.getLogger("selkies_tpu.fleet.autoscale")
+
+__all__ = ["AdvisorParams", "AdvisorState", "signals_from_observer",
+           "decide", "ScalingAdvisor"]
+
+#: reasons a decision can carry — bounded vocabulary (these become
+#: incident fields and dashboard labels, never free text)
+REASONS = ("slo_burn", "occupancy_high", "queue_depth",
+           "occupancy_low", "stale_input", "confirming", "holding",
+           "steady", "no_input")
+
+
+@dataclasses.dataclass(frozen=True)
+class AdvisorParams:
+    """The advisor's knobs. Defaults target the bench/CI rig; a real
+    deployment tunes them like the ladder's."""
+
+    min_hosts: int = 1
+    max_hosts: int = 64
+    #: max(seat, pixel, hbm) occupancy above which the fleet is
+    #: pressured (scale up) / below which it is slack (scale down) —
+    #: the two sides deliberately far apart (no flapping band)
+    occupancy_high: float = 0.85
+    occupancy_low: float = 0.35
+    #: fast-window burn multiple that counts as an SLO episode (the
+    #: same 14.4 the SRE-workbook threshold the fleet verdict uses)
+    burn_threshold: float = 14.4
+    #: consecutive pressured evaluations before desired_hosts steps up
+    up_confirm: int = 2
+    #: consecutive slack evaluations before desired_hosts steps down
+    down_confirm: int = 5
+    #: minimum dwell between two flips (either direction), seconds
+    hold_s: float = 30.0
+    #: series window the signals are summarised over, seconds
+    window_s: float = 30.0
+
+
+@dataclasses.dataclass
+class AdvisorState:
+    """Carried between evaluations (the hysteresis memory)."""
+
+    desired: Optional[int] = None
+    up_streak: int = 0
+    down_streak: int = 0
+    last_flip_ts: Optional[float] = None
+    flips: int = 0
+
+
+def signals_from_observer(obs, window_s: float = 30.0,
+                          now: Optional[float] = None) -> dict:
+    """Summarise the observer's series rings into the advisor's input
+    block. Windowed means for the occupancy axes (a single-sample
+    spike must not flip a fleet), max for burn and queue depth (a
+    single burning window IS the episode)."""
+    now = obs._clock() if now is None else now
+
+    def ring(name):
+        return [v for _, v in obs.series(name, window_s=window_s,
+                                         now=now)]
+
+    def mean(vals):
+        return sum(vals) / len(vals) if vals else 0.0
+
+    seat = ring("seat_occupancy")
+    pixel = ring("pixel_occupancy")
+    hbm = ring("hbm_occupancy")
+    verdicts = ring("slo_verdict")
+    hosts_ready = ring("hosts_ready")
+    age = obs.series_age(now=now)
+    stale = obs.is_stale(now=now)
+    return {
+        "ts": round(now, 3),
+        "hosts_ready": int(hosts_ready[-1]) if hosts_ready else 0,
+        "occupancy": round(max(mean(seat), mean(pixel), mean(hbm)), 4),
+        "seat_occupancy": round(mean(seat), 4),
+        "pixel_occupancy": round(mean(pixel), 4),
+        "hbm_occupancy": round(mean(hbm), 4),
+        "watts_est": round(mean(ring("watts_est")), 2),
+        "queue_depth": max(ring("queue_depth"), default=0),
+        "burn_fast_max": max(ring("burn_fast_max"), default=0.0),
+        "slo_failed": bool(verdicts and verdicts[-1] >= 2),
+        "input_age_s": age,
+        "stale": stale,
+    }
+
+
+def decide(signals: dict, state: AdvisorState,
+           params: AdvisorParams = AdvisorParams(),
+           now: Optional[float] = None) -> tuple[dict, AdvisorState]:
+    """The pure decision core: one evaluation of the signal block
+    against the hysteresis state. Returns ``(decision, new_state)``;
+    the caller owns persistence and side effects (incidents, gauge)."""
+    now = float(signals.get("ts", 0.0)) if now is None else float(now)
+    st = dataclasses.replace(state)
+    current = int(signals.get("hosts_ready", 0))
+    if st.desired is None:
+        # first evaluation anchors on what exists (never advise a
+        # cold-start fleet down to min before any evidence arrives)
+        st.desired = max(params.min_hosts, current) if current \
+            else params.min_hosts
+
+    stale = bool(signals.get("stale", False))
+    burn = float(signals.get("burn_fast_max", 0.0) or 0.0)
+    occ = float(signals.get("occupancy", 0.0) or 0.0)
+    queue = float(signals.get("queue_depth", 0) or 0)
+    slo_failed = bool(signals.get("slo_failed", False))
+
+    # pressure classification, in severity order — the FIRST matching
+    # reason names the decision
+    up_reason = None
+    if slo_failed or burn >= params.burn_threshold:
+        up_reason = "slo_burn"
+    elif queue > 0:
+        up_reason = "queue_depth"
+    elif occ > params.occupancy_high:
+        up_reason = "occupancy_high"
+    down = (up_reason is None and occ < params.occupancy_low
+            and queue == 0 and not slo_failed)
+
+    action, reason = "hold", "steady"
+    if not signals.get("hosts_ready") and not st.desired:
+        reason = "no_input"
+    if stale:
+        # fail-safe: stale input holds — and specifically NEVER scales
+        # down (absent heartbeats are absent evidence, not slack)
+        st.down_streak = 0
+        reason = "stale_input"
+    elif up_reason is not None:
+        st.up_streak += 1
+        st.down_streak = 0
+        if st.up_streak >= params.up_confirm:
+            if st.desired < params.max_hosts:
+                action, reason = "up", up_reason
+            else:
+                reason = up_reason      # pinned at max: still say why
+        else:
+            reason = "confirming"
+    elif down:
+        st.down_streak += 1
+        st.up_streak = 0
+        held = (st.last_flip_ts is not None
+                and now - st.last_flip_ts < params.hold_s)
+        if st.down_streak < params.down_confirm:
+            reason = "confirming"
+        elif held:
+            reason = "holding"
+        elif st.desired > params.min_hosts:
+            action, reason = "down", "occupancy_low"
+        else:
+            reason = "occupancy_low"    # pinned at min
+    else:
+        st.up_streak = 0
+        st.down_streak = 0
+
+    flipped = False
+    if action == "up":
+        st.desired += 1
+        st.up_streak = 0
+        st.last_flip_ts = now
+        st.flips += 1
+        flipped = True
+    elif action == "down":
+        st.desired -= 1
+        st.down_streak = 0
+        st.last_flip_ts = now
+        st.flips += 1
+        flipped = True
+
+    decision = {
+        "ts": round(now, 3),
+        "desired_hosts": st.desired,
+        "current_hosts": current,
+        "action": action,
+        "reason": reason,
+        "flipped": flipped,
+        "stale": stale,
+        "streaks": {"up": st.up_streak, "down": st.down_streak},
+        "flips": st.flips,
+        "signals": dict(signals),
+    }
+    return decision, st
+
+
+class ScalingAdvisor:
+    """Stateful wrapper the gateway sweeps: summarise the observer,
+    run the pure core, record ``advisor_flip`` incidents, export the
+    ``selkies_fleet_desired_hosts`` gauge, keep the last decision for
+    the ``/fleet/obs`` ``advisor`` block."""
+
+    def __init__(self, observer, *,
+                 params: Optional[AdvisorParams] = None,
+                 recorder=None):
+        self.observer = observer
+        self.params = params if params is not None else AdvisorParams()
+        self.recorder = recorder if recorder is not None \
+            else getattr(observer, "recorder", None)
+        self.state = AdvisorState()
+        self.last_decision: Optional[dict] = None
+        self.evaluations = 0
+
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        signals = signals_from_observer(
+            self.observer, window_s=self.params.window_s, now=now)
+        decision, self.state = decide(signals, self.state,
+                                      self.params,
+                                      now=signals["ts"])
+        self.last_decision = decision
+        self.evaluations += 1
+        if decision["flipped"] and self.recorder is not None:
+            try:
+                self.recorder.record(
+                    "advisor_flip",
+                    desired_hosts=decision["desired_hosts"],
+                    action=decision["action"],
+                    reason=decision["reason"],
+                    occupancy=signals["occupancy"],
+                    burn_fast_max=signals["burn_fast_max"],
+                    queue_depth=signals["queue_depth"])
+            except Exception:
+                logger.debug("advisor_flip record failed",
+                             exc_info=True)
+        self._export_metrics(decision)
+        return decision
+
+    def snapshot(self) -> dict:
+        """The ``/fleet/obs`` ``advisor`` block."""
+        return {
+            "enabled": True,
+            "evaluations": self.evaluations,
+            "flips": self.state.flips,
+            "params": dataclasses.asdict(self.params),
+            "decision": self.last_decision,
+        }
+
+    def _export_metrics(self, decision: dict) -> None:
+        try:
+            from ..server import metrics
+        except Exception:
+            return
+        metrics.describe("selkies_fleet_desired_hosts",
+                         "Scaling advisor's recommended host count "
+                         "(observe-only; actuation is a follow-up)")
+        metrics.set_gauge("selkies_fleet_desired_hosts",
+                          decision["desired_hosts"])
+        metrics.describe("selkies_fleet_advisor_flips_total",
+                         "Advisor desired_hosts changes")
+        metrics.set_gauge("selkies_fleet_advisor_flips_total",
+                          decision["flips"])

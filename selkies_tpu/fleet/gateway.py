@@ -45,9 +45,11 @@ from aiohttp import web
 from ..broadcast.fanout import RenditionHub
 from ..broadcast.ladder import RenditionLadder
 from ..broadcast.registry import ViewerRegistry
+from ..obs.clocksync import ClockSyncEstimator
 from ..prewarm.lattice import Signature
 from ..protocol import OP_H264, OP_JPEG
 from ..server import metrics
+from .autoscale import ScalingAdvisor
 from .migrate import MigrationCoordinator
 from .obs import FleetObserver
 from .protocol import (FleetProtocolError, parse_heartbeat,
@@ -93,6 +95,20 @@ class FleetGateway:
         self.observer = FleetObserver(self.scheduler, self.coordinator,
                                       clock=clock,
                                       recorder=self.recorder)
+        self._clock = clock
+        #: scaling advisor (ISSUE 19, observe-only): evaluated once per
+        #: sweep over the observer's series rings; its last decision is
+        #: the /fleet/obs ``advisor`` block and the desired_hosts gauge
+        self.advisor = ScalingAdvisor(self.observer,
+                                      recorder=self.recorder)
+        #: per-host clock mapping (ISSUE 19): one PR-7 clocksync
+        #: estimator per PUSH-loop host, fed by the NTP-style samples
+        #: heartbeats echo (host perf clock = "client", this gateway's
+        #: observer clock = "server"). The offset maps each host's
+        #: /api/trace timebase onto the gateway's for /fleet/trace
+        #: federation; error_bound_ms is the honesty bar the bench
+        #: asserts against.
+        self._clocksync: dict[str, ClockSyncEstimator] = {}
         self.upstream_pump_restarts = 0
         self._describe_self_metrics()
         self._sweep_task: Optional[asyncio.Task] = None
@@ -199,6 +215,7 @@ class FleetGateway:
         r.add_get("/fleet/trace", self.handle_trace)
         r.add_post("/fleet/drain/{host_id}", self.handle_drain)
         r.add_get("/fleet/ws", self.handle_ws)
+        r.add_get("/fleet/signaling", self.handle_signaling)
         r.add_get("/fleet/broadcast/ws", self.handle_broadcast_ws)
         r.add_get("/fleet/broadcast/{source}", self.handle_broadcast_info)
         app.on_startup.append(self._start_sweep)
@@ -250,12 +267,22 @@ class FleetGateway:
             try:
                 self.coordinator.check_lost_hosts()
                 self.coordinator.rebalance()
+                self.advisor.evaluate()
             except Exception:
                 logger.exception("fleet sweep failed")
+
+    def _clock_ms(self) -> float:
+        """The gateway's timebase in ms — the ``server`` side of every
+        per-host clocksync sample. Deliberately the OBSERVER's clock
+        (seconds, same epoch as the migration-timeline t0_ns stamps) so
+        a mapped host timestamp lands directly on the federated trace's
+        axis."""
+        return self._clock() * 1000.0
 
     async def handle_heartbeat(self, request: web.Request) -> web.Response:
         if not self._authed(request):
             return web.Response(status=401, text="bad fleet token")
+        t1 = self._clock_ms()      # gateway receive stamp
         try:
             raw = await request.read()
             hb = parse_heartbeat(raw)
@@ -280,7 +307,20 @@ class FleetGateway:
         self.observer.note_heartbeat_ok(hb.host_id)
         self.scheduler.observe(hb)
         self.heartbeats_ok += 1
-        return web.json_response({"ok": True, "seq": hb.seq})
+        # clock federation (ISSUE 19): a completed [t0,t1,t2,t3]
+        # sample from the PREVIOUS round trip feeds this host's offset
+        # estimator; the response carries OUR receive/send stamps so
+        # the host can complete the next one
+        if hb.clock is not None:
+            est = self._clocksync.get(hb.host_id)
+            if est is None:
+                est = self._clocksync[hb.host_id] = \
+                    ClockSyncEstimator()
+            est.add_sample(*hb.clock)
+        return web.json_response({
+            "ok": True, "seq": hb.seq,
+            "clock": {"t1": round(t1, 3),
+                      "t2": round(self._clock_ms(), 3)}})
 
     async def handle_place(self, request: web.Request) -> web.Response:
         if not self._authed(request):
@@ -333,13 +373,21 @@ class FleetGateway:
         doc["heartbeat_rejects"] = {
             "by_kind": dict(self.observer.heartbeat_rejects),
             "last": self.observer.last_reject}
+        # per-host clock mapping quality (ISSUE 19): offset, drift and
+        # error bound of each push-loop host's timebase mapping — the
+        # operator's answer to "can I trust the federated trace?"
+        doc["clock"] = {hid: est.quality()
+                        for hid, est in self._clocksync.items()}
         return web.json_response(doc)
 
     # ------------------------------------------- observability surfaces
     async def handle_obs(self, request: web.Request) -> web.Response:
         """GET /fleet/obs: the full JSON rollup + series rings (the
         autoscaler signal bus). ``?window=`` trims the series to the
-        trailing N seconds."""
+        trailing N seconds; ``?migration=<corr>`` attaches that
+        migration's per-seat timeline report (complete/ordered/
+        within_grace verdicts) — the cross-process contract view the
+        live soak harness asserts without gateway-process access."""
         if not self._authed(request):
             return web.Response(status=401, text="bad fleet token")
         window = None
@@ -348,25 +396,122 @@ class FleetGateway:
                 window = float(request.query["window"])
         except ValueError:
             return web.Response(status=400, text="bad window")
-        return web.json_response(self.observer.obs_doc(window_s=window))
+        doc = self.observer.obs_doc(window_s=window)
+        doc["advisor"] = self.advisor.snapshot()
+        corr = request.query.get("migration")
+        if corr:
+            doc["migration"] = self.observer.migration_report(corr)
+        return web.json_response(doc)
+
+    def _federable_hosts(self) -> list:
+        """Hosts whose observability this gateway federates: the
+        push-loop hosts that completed at least one clock sample (so
+        their timebase is mapped) and advertise a routable http(s)
+        url. Pull-only and lost hosts stay visible in the rollup but
+        are not fetched — the sim fleet's fake urls must not turn a
+        /fleet/trace GET into a pile of dead dials."""
+        out = []
+        for host in list(self.scheduler.hosts.values()):
+            est = self._clocksync.get(host.host_id)
+            if est is None or not est.synced or host.lost:
+                continue
+            if host.url.startswith(("http://", "https://")):
+                out.append((host, est))
+        return out
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         """GET /fleet/metrics: Prometheus text, per-host cardinality
         bounded by the observer's host label cap (``_overflow``
-        aggregates the tail)."""
+        aggregates the tail). Push-loop hosts' own /api/metrics
+        scrapes are federated below the gateway's: every host sample
+        gains a ``fleet_host`` label, and only the first
+        ``host_label_cap`` hosts are fetched (``?federate=0``
+        disables)."""
         if not self._authed(request):
             return web.Response(status=401, text="bad fleet token")
         self.observer.export_metrics()
-        return web.Response(text=metrics.render_prometheus(),
+        parts = [metrics.render_prometheus()]
+        if request.query.get("federate", "1") not in ("0", "false"):
+            parts.extend(await self._federated_scrapes())
+        return web.Response(text="".join(parts),
                             content_type="text/plain")
+
+    async def _federated_scrapes(self) -> list:
+        skipped = 0
+        texts = []
+        seen_meta: set = set()
+        for host, _est in self._federable_hosts():
+            label = self.observer._host_label(host.host_id)
+            if label == "_overflow":
+                skipped += 1
+                continue
+            try:
+                async with self._http().get(
+                        host.url.rstrip("/") + "/api/metrics",
+                        timeout=aiohttp.ClientTimeout(total=3)) as r:
+                    if r.status != 200:
+                        skipped += 1
+                        continue
+                    body = await r.text()
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                skipped += 1
+                continue
+            texts.append(_relabel_scrape(body, label, seen_meta))
+        if skipped:
+            texts.append(
+                "# HELP selkies_fleet_federation_skipped_hosts Hosts "
+                "not federated this scrape (cap/unreachable)\n"
+                "# TYPE selkies_fleet_federation_skipped_hosts gauge\n"
+                f"selkies_fleet_federation_skipped_hosts {skipped}\n")
+        return texts
 
     async def handle_trace(self, request: web.Request) -> web.Response:
         """GET /fleet/trace: the correlated migration timelines as a
-        Chrome trace-event document (``?corr=`` filters one id)."""
+        Chrome trace-event document (``?corr=`` filters one id),
+        FEDERATED across the push-loop hosts: each live host's
+        /api/trace snapshot is fetched, its timestamps mapped through
+        that host's clocksync offset onto the gateway timebase, and
+        merged under a distinct pid — one Perfetto view shows a
+        ``mig-*`` migration spanning the gateway and both engine
+        processes on one clock. ``?federate=0`` returns the gateway
+        lanes alone."""
         if not self._authed(request):
             return web.Response(status=401, text="bad fleet token")
         corr = request.query.get("corr") or None
-        return web.json_response(self.observer.trace_document(corr))
+        doc = self.observer.trace_document(corr)
+        if request.query.get("federate", "1") in ("0", "false"):
+            return web.json_response(doc)
+        hosts_report = {}
+        pid = 1      # the gateway's own fleet lane owns pid 1
+        for host, est in self._federable_hosts():
+            pid += 1
+            report = {"pid": pid, "url": host.url,
+                      "clock": est.quality(), "events": 0,
+                      "fetched": False}
+            hosts_report[host.host_id] = report
+            try:
+                async with self._http().get(
+                        host.url.rstrip("/") + "/api/trace",
+                        timeout=aiohttp.ClientTimeout(total=3)) as r:
+                    if r.status != 200:
+                        report["error"] = f"HTTP {r.status}"
+                        continue
+                    host_doc = await r.json(content_type=None)
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    ValueError) as e:
+                report["error"] = f"{type(e).__name__}: {e}"[:120]
+                continue
+            events = _remap_host_events(host_doc, est, pid,
+                                        host.host_id)
+            doc["traceEvents"].extend(events)
+            report["fetched"] = True
+            report["events"] = len(events)
+        doc["otherData"] = dict(doc.get("otherData") or {})
+        doc["otherData"]["federation"] = {
+            "hosts": hosts_report,
+            "federated": sum(1 for r in hosts_report.values()
+                             if r["fetched"])}
+        return web.json_response(doc)
 
     async def handle_drain(self, request: web.Request) -> web.Response:
         """Operator evacuation. For REMOTE hosts (no in-process handle)
@@ -530,6 +675,85 @@ class FleetGateway:
         self._release_timers.pop(sid, None)
         if self._ws_conns.get(sid, 0) == 0:
             self.scheduler.release(sid)
+
+    async def handle_signaling(self, request: web.Request
+                               ) -> web.StreamResponse:
+        """Session-affine WebRTC signaling proxy (ISSUE 19): the same
+        affinity contract as /fleet/ws, pointed at the engine's
+        /api/signaling. ``?sid=`` names the gateway session — a
+        signaling reconnect after migration reuses it and lands on the
+        re-placed host, and /fleet/route/{sid} answers for it exactly
+        as for a WS media session. Signaling shares the media sid's
+        seat when both ride one sid; a signaling-only sid places a
+        seat of its own (the SDP exchange is ABOUT a media session the
+        host must have capacity for)."""
+        if not self._authed(request):
+            self._refuse("auth")
+            return web.Response(status=401, text="bad fleet token")
+        q = request.query
+        import secrets
+        sid = q.get("sid") or f"sig-{secrets.token_urlsafe(9)}"
+        p = self.scheduler.get(sid)
+        if p is None:
+            try:
+                spec = parse_session_spec({
+                    "v": 1, "kind": "place", "sid": sid,
+                    "width": int(q.get("w", 1280)),
+                    "height": int(q.get("h", 720)),
+                    "codec": q.get("codec", "h264")})
+            except (FleetProtocolError, ValueError) as e:
+                self._refuse("bad_spec")
+                return web.Response(status=400, text=f"bad spec: {e}")
+            p = self.scheduler.place(spec)
+            if p is None:
+                self.scheduler.cancel_pending(sid)
+                self._refuse("capacity")
+                return web.Response(status=503,
+                                    text="no host has capacity; retry")
+        host = self.scheduler.hosts.get(p.host_id)
+        if host is None or not host.url.startswith(("http://",
+                                                    "https://",
+                                                    "ws://", "wss://")):
+            self._refuse("unroutable")
+            return web.Response(status=502,
+                                text="placed host has no routable url")
+        target = host.url.replace("http://", "ws://") \
+            .replace("https://", "wss://").rstrip("/") \
+            + "/api/signaling?fleet_sid=" + urllib.parse.quote(sid)
+        ws_client = web.WebSocketResponse()
+        await ws_client.prepare(request)
+        headers = {}
+        if "Authorization" in request.headers:
+            headers["Authorization"] = request.headers["Authorization"]
+        self._ws_conns[sid] = self._ws_conns.get(sid, 0) + 1
+        timer = self._release_timers.pop(sid, None)
+        if timer is not None:
+            timer.cancel()
+            self._grace_save(sid)
+        elif sid in self.observer.open_migration_sids():
+            self.observer.note_reconnect(sid)
+        try:
+            async with self._http().ws_connect(
+                    target, headers=headers) as ws_host:
+                await _pipe(ws_client, ws_host)
+        except aiohttp.ClientError as e:
+            logger.warning("fleet signaling proxy to %s failed: %s",
+                           target, e)
+            await ws_client.close(code=1013,
+                                  message=b"host unreachable")
+        finally:
+            # same deferred-release refcount as the media proxy: a
+            # signaling socket holds the seat exactly like a media one
+            left = self._ws_conns.get(sid, 1) - 1
+            if left <= 0:
+                self._ws_conns.pop(sid, None)
+                self._release_timers[sid] = \
+                    asyncio.get_running_loop().call_later(
+                        self.release_grace_s,
+                        self._release_if_idle, sid)
+            else:
+                self._ws_conns[sid] = left
+        return ws_client
 
     # ------------------------------------------------- broadcast fan-out
     def _broadcast_registry(self, source: str) -> Optional[ViewerRegistry]:
@@ -801,6 +1025,68 @@ class FleetGateway:
 
 async def _await_handle(handle) -> None:
     await handle
+
+
+def _remap_host_events(host_doc, est, pid: int,
+                       host_id: str) -> list:
+    """One host's /api/trace snapshot -> federated trace events: every
+    timestamp mapped through the host's clocksync estimator onto the
+    gateway timebase (drift-aware: ``to_server_ms`` evaluates the fit
+    AT the event's time, not a single frozen offset), everything
+    re-homed under the host's pid with a process_name metadata row so
+    Perfetto shows one process lane per engine host."""
+    if isinstance(host_doc, dict):
+        events = host_doc.get("traceEvents", [])
+    elif isinstance(host_doc, list):
+        events = host_doc
+    else:
+        events = []
+    out = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"selkies-host:{host_id}"}}]
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        ev = dict(ev)
+        ev["pid"] = pid
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)) and ev.get("ph") != "M":
+            # host trace ts is µs on the host perf clock
+            ev["ts"] = round(est.to_server_ms(ts / 1000.0) * 1000.0, 1)
+        out.append(ev)
+    return out
+
+
+def _relabel_scrape(body: str, host_label: str, seen_meta: set) -> str:
+    """Inject ``fleet_host="<id>"`` into every sample of one host's
+    Prometheus scrape so N hosts' identically-named families stay
+    distinguishable in the federated text; HELP/TYPE metadata passes
+    through once per family (duplicate metadata is a scrape error for
+    strict parsers)."""
+    out = []
+    for line in body.splitlines():
+        if not line or line.isspace():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                key = (parts[1], parts[2])
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+            out.append(line)
+            continue
+        brace = line.find("{")
+        space = line.find(" ")
+        label = f'fleet_host="{host_label}"'
+        if 0 <= brace < (space if space >= 0 else len(line)):
+            out.append(line[:brace + 1] + label
+                       + ("," if line[brace + 1] != "}" else "")
+                       + line[brace + 1:])
+        elif space > 0:
+            out.append(f"{line[:space]}{{{label}}}{line[space:]}")
+        else:
+            out.append(line)
+    return "\n".join(out) + "\n"
 
 
 async def _pipe(a: web.WebSocketResponse, b, *,

@@ -80,6 +80,11 @@ _EVENT_RANK = {name: i for i, name in enumerate(MIGRATION_EVENTS)}
 #: fleet SLO verdict levels, ranked for the metrics gauge
 _VERDICT_RANK = {"ok": 0, "degraded": 1, "failed": 2}
 
+#: staleness multiple: no heartbeat within this many expected
+#: intervals => the rollup flags itself stale (a wedged observer must
+#: not report stale-green, and the advisor must not scale down on it)
+STALE_INTERVALS = 2.0
+
 _NS = 1_000_000_000
 
 
@@ -156,7 +161,8 @@ class FleetObserver:
                  series_capacity: int = 512,
                  fleet_burn_threshold: float = 14.4,
                  failed_hosts: int = 2,
-                 trace_capacity: int = 256):
+                 trace_capacity: int = 256,
+                 expected_interval_s: float = 2.0):
         self.scheduler = scheduler
         self._clock = clock if clock is not None \
             else getattr(scheduler, "_clock", time.monotonic)
@@ -168,10 +174,14 @@ class FleetObserver:
         self.fleet_burn_threshold = float(fleet_burn_threshold)
         self.failed_hosts = int(failed_hosts)
         self.trace_capacity = int(trace_capacity)
+        self.expected_interval_s = float(expected_interval_s)
         self._lock = threading.Lock()
         #: signal -> deque[(ts, value)] — the autoscaler input bus
         self._series: dict[str, collections.deque] = {}
         self._series_last: Optional[float] = None
+        #: last heartbeat ARRIVAL (any host) — the staleness anchor;
+        #: distinct from _series_last, which only moves on clock steps
+        self._last_heartbeat: Optional[float] = None
         #: host_id -> last-seen cumulative incident digest counts
         self._digest: dict[str, dict] = {}
         self.host_incidents_total = 0
@@ -211,6 +221,7 @@ class FleetObserver:
         self._ingest_digest(hb)
         self._advance_queued_traces()
         now = self._clock()
+        self._last_heartbeat = now
         if self._series_last is None or now > self._series_last:
             # one sample per clock step, however many hosts beat in it
             self._series_last = now
@@ -323,8 +334,38 @@ class FleetObserver:
         return [[ts, v] for ts, v in ring]
 
     def series_doc(self, window_s: Optional[float] = None) -> dict:
-        return {name: self.series(name, window_s=window_s)
-                for name in self.series()}
+        doc = {name: self.series(name, window_s=window_s)
+               for name in self.series()}
+        doc["_age_s"] = self.series_age()
+        return doc
+
+    def series_age(self, now: Optional[float] = None):
+        """Age of the newest series sample, seconds — ``None`` before
+        the first sample lands. The rings' 'how old is what you're
+        reading' answer, so a consumer (the advisor) can refuse to act
+        on fossil data."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            last = self._series_last
+        return None if last is None else round(max(0.0, now - last), 3)
+
+    def input_age(self, now: Optional[float] = None):
+        """Seconds since ANY heartbeat arrived — ``None`` before the
+        first one. The staleness anchor: series sampling rides the
+        heartbeat hook, so no heartbeats means frozen rings."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            last = self._last_heartbeat
+        return None if last is None else round(max(0.0, now - last), 3)
+
+    def is_stale(self, now: Optional[float] = None) -> bool:
+        """True when no heartbeat landed within ``STALE_INTERVALS`` x
+        the expected interval. A fleet that has NEVER beaten is stale
+        too — pre-first-heartbeat green would be the exact wedged-
+        observer lie this flag exists to kill."""
+        age = self.input_age(now=now)
+        return age is None \
+            or age > STALE_INTERVALS * self.expected_interval_s
 
     # -- rollup --------------------------------------------------------------
     def rollup(self, now: Optional[float] = None) -> dict:
@@ -449,6 +490,9 @@ class FleetObserver:
                           self.host_incidents_total},
             "migrations": {"open": open_traces,
                            "traced": self.migrations_traced},
+            "stale": self.is_stale(now=now),
+            "input_age_s": self.input_age(now=now),
+            "expected_interval_s": self.expected_interval_s,
         }
         return {"ts": round(now, 3), "hosts": hosts_doc,
                 "fleet": fleet}

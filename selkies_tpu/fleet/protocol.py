@@ -162,9 +162,17 @@ class Heartbeat:
     #: incidents (crash_loop, relay_death …) surface fleet-wide. The
     #: fleet observer records a merge entry only when a count RISES.
     incidents: list = dataclasses.field(default_factory=list)
+    #: one completed NTP-style clock sample ``[t0, t1, t2, t3]`` in
+    #: milliseconds (ISSUE 19): t0/t3 stamped on the HOST's perf clock
+    #: around the PREVIOUS heartbeat POST, t1/t2 echoed back from the
+    #: gateway's response. The gateway feeds it to a per-host clocksync
+    #: estimator (PR 7's ClockSyncEstimator, host=client) so federated
+    #: traces land on one timebase. Optional — the first heartbeat of a
+    #: push loop has no completed sample yet.
+    clock: Optional[list] = None
 
     def to_dict(self) -> dict:
-        return {
+        doc = {
             "v": PROTOCOL_VERSION, "kind": "heartbeat",
             "host_id": self.host_id, "url": self.url,
             "fingerprint": self.fingerprint, "seq": self.seq,
@@ -180,6 +188,9 @@ class Heartbeat:
             "warm_geometries": list(self.warm_geometries),
             "incidents": [dict(i) for i in self.incidents],
         }
+        if self.clock is not None:
+            doc["clock"] = list(self.clock)
+        return doc
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), sort_keys=True)
@@ -451,6 +462,18 @@ def parse_heartbeat(doc) -> Heartbeat:
         count = int(_num(_need(item, "count"),
                          f"incidents[{i}].count", 0, 2**53))
         hb.incidents.append({"kind": kind, "count": count})
+
+    # clock sample (ISSUE 19): optional, but when present it is a
+    # strictly-shaped 4-list of ms stamps — it feeds a per-host offset
+    # estimator, and a poisoned sample would skew every federated
+    # trace timestamp for that host
+    clock = doc.get("clock")
+    if clock is not None:
+        if not isinstance(clock, list) or len(clock) != 4:
+            raise FleetProtocolError(
+                "clock must be a list of 4 numbers [t0,t1,t2,t3]")
+        hb.clock = [_num(t, f"clock[{i}]", 0, 2**53)
+                    for i, t in enumerate(clock)]
     return hb
 
 

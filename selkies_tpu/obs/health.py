@@ -155,6 +155,39 @@ class FlightRecorder:
         """One JSON line per incident (journald/stderr friendly)."""
         return "\n".join(json.dumps(e) for e in self.snapshot())
 
+    def dump_file(self, dump_dir: str) -> str:
+        """Write the post-mortem incident ring to a STABLE path —
+        ``<dump_dir>/incidents-<host_id>.json`` — so a fleet harness
+        or operator can collect it from a killed process without
+        grepping logs. Atomic (tmp + rename on the same filesystem): a
+        SIGKILL landing mid-dump leaves either the previous complete
+        file or none, never truncated JSON. Returns the final path."""
+        import os
+        import tempfile
+        host = _host_id()
+        os.makedirs(dump_dir, exist_ok=True)
+        path = os.path.join(dump_dir, f"incidents-{host}.json")
+        with self._lock:
+            doc = {"host": host, "ts": round(time.time(), 3),
+                   "total": self.total, "dropped": self.dropped,
+                   "counts": dict(self._counts),
+                   "incidents": list(self._ring)}
+        fd, tmp = tempfile.mkstemp(prefix=f".incidents-{host}.",
+                                   suffix=".tmp", dir=dump_dir)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
 
 class _Check:
     __slots__ = ("name", "fn", "liveness", "gate")

@@ -231,6 +231,7 @@ class Supervisor:
         self._closed = False
         self._draining = False
         self._drain_handle: Optional[DrainHandle] = None
+        self._drain_scope = None
 
     # -- registry ------------------------------------------------------------
     def adopt(self, name: str, restart_fn: Callable,
@@ -304,7 +305,7 @@ class Supervisor:
         self._check_drained()
 
     # -- drain ---------------------------------------------------------------
-    def drain(self) -> DrainHandle:
+    def drain(self, scope=None) -> DrainHandle:
         """Stop restarting and answer WHEN everything has stopped.
 
         From this call on, the supervisor's job inverts: a component
@@ -317,9 +318,20 @@ class Supervisor:
         know when a host is evacuated (the old migration shape) race
         the restart engine; awaiting the handle cannot.
 
-        Idempotent: repeat calls return the same handle. ``drop()`` of
-        still-running components (the services' deliberate-teardown
-        path) advances the same completion check."""
+        ``scope`` (optional ``name -> bool`` predicate) narrows the
+        drain to a subset of components: only in-scope components are
+        tracked by the handle and stop-on-death; out-of-scope ones keep
+        full supervision (deaths restart). A host evacuation needs
+        exactly this split — the seat-serving components must stop, but
+        the control plane (the service itself, the prewarm worker, the
+        fleet heartbeat push) must OUTLIVE the drain so the gateway can
+        watch it finish. ``scope=None`` drains everything (process
+        shutdown).
+
+        Idempotent: repeat calls return the same handle (the FIRST
+        call's scope wins). ``drop()`` of still-running components (the
+        services' deliberate-teardown path) advances the same
+        completion check."""
         first = False
         with self._lock:
             if self._drain_handle is not None:
@@ -328,11 +340,14 @@ class Supervisor:
             else:
                 first = True
                 self._draining = True
+                self._drain_scope = scope
                 handle = self._drain_handle = DrainHandle()
-                comps = list(self._components.values())
+                comps = [c for c in self._components.values()
+                         if scope is None or scope(c.name)]
         if first:
             self.recorder.record("supervisor_drain",
-                                 components=len(comps))
+                                 components=len(comps),
+                                 scoped=scope is not None)
         for c in comps:
             if c.state == BACKING_OFF:
                 # the component is already dead; cancelling the pending
@@ -351,13 +366,18 @@ class Supervisor:
     def draining(self) -> bool:
         return self._draining
 
+    def _in_drain_scope(self, name: str) -> bool:
+        scope = self._drain_scope
+        return scope is None or bool(scope(name))
+
     def _check_drained(self) -> None:
         handle = self._drain_handle
         if handle is None or handle.done:
             return
         with self._lock:
             pending = [c.name for c in self._components.values()
-                       if c.state not in (STOPPED, FAILED)]
+                       if c.state not in (STOPPED, FAILED)
+                       and self._in_drain_scope(c.name)]
         if not pending:
             handle._fire()
 
@@ -370,9 +390,11 @@ class Supervisor:
         comp = self.get(name)
         if comp is None or comp.state in (FAILED, STOPPED):
             return
-        if self._draining:
+        if self._draining and self._in_drain_scope(name):
             # the drain inversion: a death while draining is the
-            # component stopping, not a fault to recover
+            # component stopping, not a fault to recover — but only for
+            # in-scope components; out-of-scope ones (the control plane
+            # of a scoped host evacuation) keep restarting
             comp.last_error = str(reason)[:200]
             comp.state = STOPPED
             self._check_drained()
@@ -422,7 +444,8 @@ class Supervisor:
         that raises (or an awaitable that fails) counts as another
         death, feeding the policy again."""
         comp = self.get(name)
-        if comp is None or self._closed or self._draining:
+        if comp is None or self._closed \
+                or (self._draining and self._in_drain_scope(name)):
             return
         comp._handle = None
         comp.state = RUNNING

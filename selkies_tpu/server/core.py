@@ -97,6 +97,16 @@ class CentralizedStreamServer:
         self.draining = False
         self._drain_handle = None
         self._fleet_seq = 0
+        #: supervised heartbeat push loop (ISSUE 19): state for the
+        #: fleet_push health check + /api/fleet push diagnostics. The
+        #: clock sample is the NTP-style [t0,t1,t2,t3] completed by the
+        #: previous push's response, echoed in the next heartbeat so
+        #: the gateway's per-host clocksync estimator converges.
+        self._fleet_push_task: Optional[asyncio.Task] = None
+        self._fleet_push_stats = {"sent": 0, "errors": 0,
+                                  "rejected": 0, "last_ok": None,
+                                  "last_error": "", "backoff_s": 0.0}
+        self._fleet_clock_sample: Optional[list] = None
         #: the process-wide health engine; services register their
         #: checks against it in start() (tests may swap it out)
         self.health = _health.engine
@@ -194,6 +204,12 @@ class CentralizedStreamServer:
             _health.failed("host draining (evacuation in progress)")
             if self.draining else _health.ok("not draining"))
         self.health.register("draining", self._check_draining, gate=True)
+        # fleet push health: only meaningful when a gateway is
+        # configured — an unconfigured host must not carry a forever-
+        # degraded check
+        self._check_fleet_push = self._fleet_push_check
+        if getattr(settings, "fleet_gateway", ""):
+            self.health.register("fleet_push", self._check_fleet_push)
         #: serialises switch_to_mode: two overlapping switches must not
         #: interleave stop/start and strand a service
         self._switch_lock = asyncio.Lock()
@@ -530,11 +546,15 @@ class CentralizedStreamServer:
         deployment lets the gateway poll here."""
         if request["role"] != "full":
             return web.Response(status=403, text="view-only")
-        from ..fleet.protocol import heartbeat_from_core
-        self._fleet_seq += 1
+        doc = self._fleet_heartbeat_doc()
+        doc["push"] = dict(self._fleet_push_stats)
+        return web.json_response(doc)
+
+    def _fleet_advertise_url(self) -> str:
+        """A ROUTABLE base url for heartbeats: the bind address is
+        0.0.0.0 by default, which the gateway would dutifully proxy to
+        itself."""
         s = self.settings
-        # advertise a ROUTABLE url: the bind address is 0.0.0.0 by
-        # default, which the gateway would dutifully proxy to itself
         url = str(getattr(s, "fleet_url", "") or "")
         if not url:
             import socket as _socket
@@ -542,11 +562,138 @@ class CentralizedStreamServer:
                 else _socket.gethostname()
             scheme = "https" if s.enable_https else "http"
             url = f"{scheme}://{host}:{s.port}"
-        hb = heartbeat_from_core(self, url=url, seq=self._fleet_seq)
+        return url
+
+    def _fleet_heartbeat_doc(self) -> dict:
+        from ..fleet.protocol import heartbeat_from_core
+        self._fleet_seq += 1
+        hb = heartbeat_from_core(self, url=self._fleet_advertise_url(),
+                                 seq=self._fleet_seq)
         doc = hb.to_dict()
         if self._drain_handle is not None:
             doc["drain"] = {"done": self._drain_handle.done}
-        return web.json_response(doc)
+        return doc
+
+    # -------------------------------------------------- fleet push loop
+    def _fleet_push_check(self):
+        """The ``fleet_push`` health verdict: a host whose pushes are
+        failing is invisible to the gateway — past the gateway's
+        host-timeout horizon that IS host death, so the verdict
+        escalates with silence age."""
+        st = self._fleet_push_stats
+        interval = float(getattr(self.settings,
+                                 "fleet_push_interval_s", 2.0))
+        if st["last_ok"] is None:
+            if st["errors"] or st["rejected"]:
+                return _health.degraded(
+                    "no successful push yet: " + st["last_error"],
+                    **{k: v for k, v in st.items() if k != "last_error"})
+            return _health.ok("push loop starting")
+        age = time.monotonic() - st["last_ok"]
+        if age > 10 * interval:
+            return _health.failed(
+                f"no successful push for {age:.1f}s "
+                f"(gateway sees this host as dead)",
+                age_s=round(age, 1), **{"errors": st["errors"]})
+        if age > 3 * interval or st["backoff_s"]:
+            return _health.degraded(
+                f"push degraded (last ok {age:.1f}s ago): "
+                + st["last_error"],
+                age_s=round(age, 1), backoff_s=st["backoff_s"])
+        return _health.ok(f"pushing every {interval}s",
+                          sent=st["sent"])
+
+    def _start_fleet_push(self) -> None:
+        self._fleet_push_task = asyncio.create_task(
+            self._fleet_push_guarded())
+
+    async def _fleet_push_guarded(self) -> None:
+        try:
+            await self._fleet_push_loop()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # supervised like the prewarm worker: the supervisor
+            # restarts the loop with backoff; budget exhaustion parks
+            # it and the fleet_push check goes failed on silence age
+            self.supervisor.report_death(
+                "fleet_push", f"{type(e).__name__}: {e}")
+
+    async def _fleet_push_loop(self) -> None:
+        """POST heartbeats to the gateway on a cadence, with
+        exponential backoff on gateway loss, completing one NTP-style
+        clock sample per round trip (t0/t3 here, t1/t2 from the
+        gateway's response) and echoing it in the NEXT heartbeat — the
+        gateway side runs the PR-7 clocksync estimator over these to
+        map this host's trace timebase onto its own."""
+        import aiohttp
+        s = self.settings
+        gw = str(getattr(s, "fleet_gateway", "")).rstrip("/")
+        interval = float(getattr(s, "fleet_push_interval_s", 2.0))
+        max_backoff = max(30.0, 4 * interval)
+        token = str(getattr(s, "fleet_token", ""))
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        st = self._fleet_push_stats
+        timeout = aiohttp.ClientTimeout(total=max(2.0, 2 * interval))
+        async with aiohttp.ClientSession(timeout=timeout) as http:
+            while True:
+                await asyncio.sleep(st["backoff_s"] or interval)
+                try:
+                    doc = self._fleet_heartbeat_doc()
+                    if self._fleet_clock_sample is not None:
+                        doc["clock"] = self._fleet_clock_sample
+                    t0 = time.perf_counter() * 1000.0
+                    async with http.post(gw + "/fleet/heartbeat",
+                                         json=doc,
+                                         headers=headers) as resp:
+                        body = await resp.json(content_type=None)
+                        t3 = time.perf_counter() * 1000.0
+                        if resp.status == 200:
+                            st["sent"] += 1
+                            st["last_ok"] = time.monotonic()
+                            st["last_error"] = ""
+                            st["backoff_s"] = 0.0
+                            self._fleet_push_metric("ok")
+                            clk = (body or {}).get("clock") or {}
+                            t1, t2 = clk.get("t1"), clk.get("t2")
+                            self._fleet_clock_sample = (
+                                [t0, float(t1), float(t2), t3]
+                                if isinstance(t1, (int, float))
+                                and isinstance(t2, (int, float))
+                                else None)
+                        else:
+                            # the gateway answered: not gateway loss.
+                            # A 4xx means OUR document (or token) is
+                            # bad — retrying faster cannot help, so
+                            # keep the normal cadence, count it, and
+                            # let the health check surface it.
+                            st["rejected"] += 1
+                            st["last_error"] = \
+                                f"HTTP {resp.status}: " \
+                                f"{str(body)[:120]}"
+                            self._fleet_push_metric("rejected")
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    st["errors"] += 1
+                    st["last_error"] = f"{type(e).__name__}: {e}"[:200]
+                    st["backoff_s"] = round(min(
+                        max_backoff,
+                        (st["backoff_s"] or interval) * 2), 2)
+                    self._fleet_clock_sample = None
+                    self._fleet_push_metric("error")
+
+    def _fleet_push_metric(self, outcome: str) -> None:
+        try:
+            from . import metrics
+            metrics.describe("selkies_fleet_push_total",
+                             "Heartbeat pushes to the gateway, by "
+                             "outcome (ok/rejected/error)")
+            metrics.inc_counter("selkies_fleet_push_total",
+                                labels={"outcome": outcome})
+        except Exception:
+            logger.debug("fleet push metric update failed",
+                         exc_info=True)
 
     async def handle_drain(self, request: web.Request) -> web.Response:
         """POST {"target_url": optional} — start evacuating this host:
@@ -570,7 +717,14 @@ class CentralizedStreamServer:
         if first:
             self.health.recorder.record(
                 "host_drain_requested", target_url=target_url)
-        self._drain_handle = self.supervisor.drain()
+        # scoped drain (ISSUE 19): done == every SEAT-SERVING component
+        # (captures, per-client relays) stopped. The control plane —
+        # the service itself, the prewarm worker and above all the
+        # fleet heartbeat push — must outlive the evacuation, or the
+        # gateway loses sight of the drain it is watching; an unscoped
+        # drain can therefore never report done on a live host.
+        self._drain_handle = self.supervisor.drain(
+            scope=lambda name: name.startswith(("capture:", "relay:")))
         svc = self.services.get(self.active_mode or "")
         notified = 0
         if svc is not None and hasattr(svc, "announce_migration"):
@@ -983,6 +1137,9 @@ class CentralizedStreamServer:
                 self._watch_and_reload_certs())
         if self.ladder is not None:
             self._ladder_task = asyncio.create_task(self._ladder_loop())
+        if getattr(self.settings, "fleet_gateway", ""):
+            self.supervisor.adopt("fleet_push", self._start_fleet_push)
+            self._start_fleet_push()
         logger.info("listening on %s:%d (%s)", self.settings.addr,
                     self.settings.port,
                     "https" if self._ssl_ctx else "http")
@@ -1033,6 +1190,7 @@ class CentralizedStreamServer:
         self.health.unregister("slo", self._check_slo)
         self.health.unregister("supervision", self._check_supervision)
         self.health.unregister("draining", self._check_draining)
+        self.health.unregister("fleet_push", self._check_fleet_push)
         if self.prewarm is not None:
             self.health.unregister("prewarm", self._check_prewarm)
             self.health.unregister("prewarm_ready",
@@ -1041,6 +1199,8 @@ class CentralizedStreamServer:
         self.supervisor.close()
         if self._ladder_task:
             self._ladder_task.cancel()
+        if self._fleet_push_task:
+            self._fleet_push_task.cancel()
         if self._cert_watch_task:
             self._cert_watch_task.cancel()
         if self.active_mode and self.active_mode in self.services:

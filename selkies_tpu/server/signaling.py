@@ -40,6 +40,12 @@ class Peer:
     meta: dict = field(default_factory=dict)
     status: Optional[str] = None         # None | 'session'
     partner: Optional[str] = None
+    #: gateway session id (ISSUE 19): carried on the signaling upgrade
+    #: (?fleet_sid=) the same way the WS transport carries it, so fleet
+    #: affinity covers WebRTC signaling — the gateway's /fleet/route
+    #: answer and a drain's migrate command address THIS id, not the
+    #: engine-local uid
+    fleet_sid: str = ""
 
 
 class LocalServerPeer:
@@ -98,6 +104,13 @@ class SignalingServer:
         peer = await self._hello(ws, request)
         if peer is None:
             return ws
+        # fleet affinity (ISSUE 19): the gateway's signaling proxy
+        # forwards the session id it placed under, exactly as the WS
+        # transport does — sanitised the same way (it goes back out on
+        # the wire in migrate commands)
+        fleet_sid = request.query.get("fleet_sid", "")[:128]
+        peer.fleet_sid = "".join(
+            c for c in fleet_sid if c.isalnum() or c in "._:-")
         try:
             async for msg in ws:
                 if msg.type != WSMsgType.TEXT:

@@ -933,8 +933,15 @@ class WebSocketsService(BaseStreamingService):
         async def _grace():
             await asyncio.sleep(self.settings.reconnect_grace_s)
             if not any(c.video_active for c in self.clients.values()):
+                sup = self._supervisor()
                 for did, cap in self.captures.items():
                     cap.stop_capture()
+                    # deliberate stop, same discipline as stop(): the
+                    # restart engine must forget the capture (a drain
+                    # handle waits on exactly this; _ensure_capture
+                    # re-adopts on the next viewer)
+                    if sup is not None:
+                        sup.drop(f"capture:{did}")
                     logger.info("capture stopped for display %s", did)
 
         if self._grace_task is None or self._grace_task.done():

@@ -80,6 +80,18 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
        "(/api/fleet). Empty: derived from addr:port, falling back to "
        "the hostname when bound to 0.0.0.0 — set explicitly behind "
        "NAT or when the gateway reaches hosts on another network."),
+    _s("fleet_gateway", SType.STR, "",
+       "Fleet gateway base URL (e.g. http://gw:8100). Non-empty: the "
+       "server core runs a supervised push loop POSTing heartbeats to "
+       "<gateway>/fleet/heartbeat with exponential backoff on gateway "
+       "loss. Empty: push loop disabled (pull-only /api/fleet stays)."),
+    _s("fleet_token", SType.STR, "",
+       "Bearer token presented on fleet heartbeat pushes (must match "
+       "the gateway's --token).", sensitive=True),
+    _s("fleet_push_interval_s", SType.FLOAT, 2.0,
+       "Heartbeat push period in seconds (the gateway treats silence "
+       "past its host_timeout as host death; keep this well under it).",
+       vmin=0.05, vmax=300.0),
     _s("debug", SType.BOOL, False, "Verbose logging."),
     _s("app_name", SType.STR, "selkies-tpu", "Display name for the client UI."),
     _s("app_ready_file", SType.STR, "",

@@ -1,0 +1,230 @@
+"""Scaling advisor contracts (ISSUE 19): the pure ``decide`` hysteresis
+walk on injected time, the stale-input fail-safe (absent heartbeats
+never shrink a fleet), bound pinning, windowed signal summarisation,
+and ``advisor_flip`` incidents through the real recorder — no sleeps,
+no sockets."""
+
+import dataclasses
+
+import pytest
+
+from selkies_tpu.fleet.autoscale import (REASONS, AdvisorParams,
+                                         AdvisorState, ScalingAdvisor,
+                                         decide, signals_from_observer)
+from selkies_tpu.obs.health import FlightRecorder
+
+PARAMS = AdvisorParams(min_hosts=1, max_hosts=5, up_confirm=2,
+                       down_confirm=3, hold_s=30.0, window_s=30.0)
+
+
+def sig(ts, *, hosts=3, occ=0.5, burn=0.0, queue=0, slo_failed=False,
+        stale=False):
+    return {"ts": ts, "hosts_ready": hosts, "occupancy": occ,
+            "queue_depth": queue, "burn_fast_max": burn,
+            "slo_failed": slo_failed, "stale": stale,
+            "input_age_s": 0.5}
+
+
+def walk(signals, params=PARAMS, state=None):
+    """Run decide() over a signal sequence; return every decision."""
+    st = state if state is not None else AdvisorState()
+    out = []
+    for s in signals:
+        d, st = decide(s, st, params)
+        out.append(d)
+    return out, st
+
+
+# ------------------------------------------------------------- decide()
+
+class TestDecideCore:
+    def test_first_evaluation_anchors_on_current_fleet(self):
+        d, st = decide(sig(0.0, hosts=3), AdvisorState(), PARAMS)
+        assert st.desired == 3
+        assert d["desired_hosts"] == 3
+        assert d["action"] == "hold"
+        # with no hosts at all the anchor is min_hosts, never zero
+        d, st = decide(sig(0.0, hosts=0), AdvisorState(), PARAMS)
+        assert st.desired == PARAMS.min_hosts
+
+    def test_up_needs_confirm_streak_then_flips_on_burn(self):
+        ds, st = walk([sig(0.0, burn=20.0), sig(1.0, burn=20.0)])
+        assert [d["action"] for d in ds] == ["hold", "up"]
+        assert ds[0]["reason"] == "confirming"
+        assert ds[1]["reason"] == "slo_burn"
+        assert ds[1]["flipped"] and st.desired == 4 and st.flips == 1
+
+    def test_pressure_reason_severity_order(self):
+        # burn outranks queue outranks occupancy — the FIRST matching
+        # reason names the flip
+        ds, _ = walk([sig(0.0, burn=20.0, queue=2, occ=0.99)] * 2)
+        assert ds[1]["reason"] == "slo_burn"
+        ds, _ = walk([sig(0.0, queue=2, occ=0.99)] * 2)
+        assert ds[1]["reason"] == "queue_depth"
+        ds, _ = walk([sig(0.0, occ=0.99)] * 2)
+        assert ds[1]["reason"] == "occupancy_high"
+
+    def test_mixed_pressure_resets_the_streak(self):
+        ds, st = walk([sig(0.0, burn=20.0),          # confirming (1/2)
+                       sig(1.0),                     # steady: resets
+                       sig(2.0, burn=20.0)])         # confirming again
+        assert [d["action"] for d in ds] == ["hold"] * 3
+        assert ds[2]["reason"] == "confirming"
+        assert st.flips == 0
+
+    def test_down_needs_streak_and_dwell(self):
+        # flip up at t=1 (hold_s dwell starts), then go slack: the
+        # down-confirm streak completes INSIDE the dwell (holding) and
+        # only flips once the dwell expires
+        seq = [sig(0.0, burn=20.0), sig(1.0, burn=20.0)]
+        seq += [sig(2.0 + i, occ=0.1) for i in range(3)]   # confirming x2, holding
+        seq += [sig(40.0, occ=0.1)]                        # dwell expired
+        ds, st = walk(seq)
+        assert [d["reason"] for d in ds[2:]] == \
+            ["confirming", "confirming", "holding", "occupancy_low"]
+        assert ds[-1]["action"] == "down" and ds[-1]["flipped"]
+        assert st.desired == 3 and st.flips == 2
+
+    def test_pinned_at_max_still_names_the_pressure(self):
+        st = AdvisorState(desired=PARAMS.max_hosts)
+        ds, st = walk([sig(0.0, burn=20.0)] * 3, state=st)
+        assert all(d["action"] == "hold" for d in ds)
+        assert ds[-1]["reason"] == "slo_burn"
+        assert st.desired == PARAMS.max_hosts and st.flips == 0
+
+    def test_pinned_at_min_never_goes_below(self):
+        st = AdvisorState(desired=PARAMS.min_hosts)
+        ds, st = walk([sig(float(i), hosts=1, occ=0.05)
+                       for i in range(10)], state=st)
+        assert st.desired == PARAMS.min_hosts and st.flips == 0
+        assert ds[-1]["reason"] == "occupancy_low"
+
+    def test_reasons_stay_in_the_bounded_vocabulary(self):
+        seq = [sig(0.0, burn=20.0), sig(1.0, burn=20.0),
+               sig(2.0, stale=True), sig(3.0, occ=0.1),
+               sig(4.0, queue=1), sig(5.0)]
+        ds, _ = walk(seq)
+        assert all(d["reason"] in REASONS for d in ds)
+
+
+class TestStaleFailSafe:
+    def test_stale_holds_and_names_it(self):
+        ds, st = walk([sig(0.0, stale=True, occ=0.05)] * 6)
+        assert all(d["action"] == "hold" for d in ds)
+        assert all(d["reason"] == "stale_input" for d in ds)
+        assert st.flips == 0
+
+    def test_stale_resets_a_down_streak_mid_confirm(self):
+        # 2 calm evaluations, then the observer goes stale, then calm
+        # again: the streak must restart from zero — stale gaps never
+        # count toward shrinking the fleet
+        st = AdvisorState(desired=3)
+        seq = [sig(0.0, occ=0.1), sig(1.0, occ=0.1),
+               sig(2.0, occ=0.1, stale=True),
+               sig(3.0, occ=0.1), sig(4.0, occ=0.1)]
+        ds, st = walk(seq, state=st)
+        assert st.flips == 0
+        assert ds[-1]["reason"] == "confirming"     # 2/3, not done
+
+    def test_stale_does_not_block_later_scale_up(self):
+        # recovery from staleness with real pressure still scales up
+        seq = [sig(0.0, stale=True), sig(1.0, burn=20.0),
+               sig(2.0, burn=20.0)]
+        ds, _ = walk(seq)
+        assert ds[-1]["action"] == "up"
+
+
+# ------------------------------------------- signals + stateful wrapper
+
+class FakeObserver:
+    """Duck-typed observer: bounded rings + staleness, injected clock."""
+
+    def __init__(self, now=100.0, *, stale=False, age=0.5):
+        self.now = now
+        self.stale = stale
+        self.age = age
+        self.rings = {}
+        self.recorder = FlightRecorder()
+
+    def _clock(self):
+        return self.now
+
+    def series(self, name, window_s=30.0, now=None):
+        now = self.now if now is None else now
+        return [(ts, v) for ts, v in self.rings.get(name, [])
+                if now - ts <= window_s]
+
+    def series_age(self, now=None):
+        return self.age
+
+    def is_stale(self, now=None):
+        return self.stale
+
+
+class TestSignalsFromObserver:
+    def test_windowed_mean_for_occupancy_max_for_burn(self):
+        obs = FakeObserver(now=100.0)
+        obs.rings["seat_occupancy"] = [(98.0, 0.4), (99.0, 0.6)]
+        obs.rings["burn_fast_max"] = [(98.0, 2.0), (99.0, 16.0)]
+        obs.rings["queue_depth"] = [(98.0, 0), (99.0, 3)]
+        obs.rings["slo_verdict"] = [(99.0, 2)]
+        obs.rings["hosts_ready"] = [(99.0, 4)]
+        s = signals_from_observer(obs, window_s=30.0)
+        assert s["seat_occupancy"] == pytest.approx(0.5)
+        assert s["occupancy"] == pytest.approx(0.5)   # max of axis means
+        assert s["burn_fast_max"] == 16.0             # max, not mean
+        assert s["queue_depth"] == 3
+        assert s["slo_failed"] is True
+        assert s["hosts_ready"] == 4
+
+    def test_samples_outside_the_window_are_dropped(self):
+        obs = FakeObserver(now=100.0)
+        obs.rings["seat_occupancy"] = [(10.0, 1.0), (99.0, 0.2)]
+        s = signals_from_observer(obs, window_s=30.0)
+        assert s["seat_occupancy"] == pytest.approx(0.2)
+
+    def test_empty_rings_mean_zero_not_crash(self):
+        s = signals_from_observer(FakeObserver())
+        assert s["occupancy"] == 0.0
+        assert s["burn_fast_max"] == 0.0
+        assert s["hosts_ready"] == 0
+
+
+class TestScalingAdvisor:
+    def burn_obs(self, now=100.0):
+        obs = FakeObserver(now=now)
+        obs.rings["hosts_ready"] = [(now - 1, 2)]
+        obs.rings["burn_fast_max"] = [(now - 1, 20.0)]
+        return obs
+
+    def test_flip_records_incident_and_snapshot_carries_decision(self):
+        obs = self.burn_obs()
+        adv = ScalingAdvisor(obs, params=PARAMS)
+        adv.evaluate(now=100.0)
+        obs.now = 101.0
+        obs.rings["burn_fast_max"].append((100.5, 20.0))
+        d = adv.evaluate(now=101.0)
+        assert d["flipped"] and d["reason"] == "slo_burn"
+        kinds = [i["kind"] for i in obs.recorder.snapshot()]
+        assert kinds.count("advisor_flip") == 1
+        snap = adv.snapshot()
+        assert snap["flips"] == 1
+        assert snap["decision"]["desired_hosts"] == 3
+        assert snap["params"]["up_confirm"] == PARAMS.up_confirm
+
+    def test_stale_observer_never_flips(self):
+        obs = FakeObserver(stale=True, age=60.0)
+        obs.rings["hosts_ready"] = [(99.0, 3)]
+        obs.rings["seat_occupancy"] = [(99.0, 0.05)]
+        adv = ScalingAdvisor(obs, params=PARAMS)
+        for i in range(8):
+            d = adv.evaluate(now=100.0 + i)
+        assert d["action"] == "hold"
+        assert d["reason"] == "stale_input"
+        assert adv.state.flips == 0
+        assert not [i for i in obs.recorder.snapshot()
+                    if i["kind"] == "advisor_flip"]
+
+    def test_params_are_frozen(self):
+        with pytest.raises(dataclasses.FrozenInstanceError):
+            PARAMS.max_hosts = 10

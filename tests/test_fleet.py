@@ -595,6 +595,65 @@ def test_supervisor_drain_stops_restarting_and_counts_deaths():
     assert sup.get("running").state == "stopped"
 
 
+def test_supervisor_scoped_drain_ignores_control_plane():
+    """ISSUE 19: a host evacuation drains the SEAT-SERVING components
+    only — the control plane (service, prewarm, fleet heartbeat push)
+    must outlive the drain so the gateway can watch it finish."""
+    clock = Clock()
+    pending = []
+
+    class H:
+        def __init__(self, entry):
+            self.entry = entry
+
+        def cancel(self):
+            if self.entry in pending:
+                pending.remove(self.entry)
+
+    def schedule(delay, cb):
+        entry = (delay, cb)
+        pending.append(entry)
+        return H(entry)
+
+    sup = Supervisor(recorder=FlightRecorder(),
+                     policy_factory=lambda: RestartPolicy(clock=clock),
+                     schedule=schedule)
+    sup.adopt("capture:__seats__", lambda: None)
+    sup.adopt("relay:1:seat0", lambda: None)
+    sup.adopt("fleet_push", lambda: None)
+    sup.adopt("prewarm", lambda: None)
+    handle = sup.drain(
+        scope=lambda n: n.startswith(("capture:", "relay:")))
+    # control-plane components still running do NOT hold the handle
+    assert not handle.done
+    sup.drop("relay:1:seat0")
+    sup.drop("capture:__seats__")
+    assert handle.done and handle.wait(0)
+    # an out-of-scope death DURING the scoped drain still restarts —
+    # a heartbeat-push crash mid-evacuation must not silence the host
+    sup.report_death("fleet_push", "push loop died")
+    assert len(pending) == 1
+    assert sup.get("fleet_push").state == "backing_off"
+    # ... and firing the restart is not suppressed by draining
+    pending[0][1]()
+    assert sup.get("fleet_push").state == "running"
+
+
+def test_supervisor_scoped_drain_counts_in_scope_deaths_as_stops():
+    clock = Clock()
+    sup = Supervisor(recorder=FlightRecorder(),
+                     policy_factory=lambda: RestartPolicy(clock=clock),
+                     schedule=lambda d, cb: _Handle(None))
+    sup.adopt("capture:seat0", lambda: None)
+    sup.adopt("fleet_push", lambda: None)
+    handle = sup.drain(scope=lambda n: n.startswith("capture:"))
+    assert not handle.done
+    sup.report_death("capture:seat0", "stopped by grace window")
+    assert handle.done
+    assert sup.get("capture:seat0").state == "stopped"
+    assert sup.get("fleet_push").state == "running"
+
+
 async def test_supervisor_drain_handle_is_awaitable():
     sup = Supervisor(recorder=FlightRecorder(),
                      schedule=lambda d, cb: _Handle(None))

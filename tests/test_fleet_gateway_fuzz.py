@@ -1,0 +1,196 @@
+"""Heartbeat intake fuzz through the REAL gateway HTTP handler
+(ISSUE 19 satellite): seeded random poison on every heartbeat axis —
+incident digests, ``egress_mbps_est``/``watts_est``, the clocksync
+echo — POSTed over the aiohttp test transport. The contract: the edge
+answers 200 or 400 (never a 5xx), every rejection lands in the bounded
+``rejection_kind`` vocabulary, and no poisoned value ever reaches the
+scheduler, the observer's series rings, or a clocksync estimator."""
+
+import json
+import math
+import random
+
+from selkies_tpu.fleet.gateway import FleetGateway
+
+TOKEN = "fuzz-token"
+HDR = {"Authorization": f"Bearer {TOKEN}"}
+
+#: every label note_heartbeat_reject may be fed (protocol.py
+#: _REJECTION_KINDS + the fallback)
+REJECTION_VOCAB = {"bad_json", "bad_kind", "bad_version",
+                   "missing_field", "bad_number", "out_of_range",
+                   "bad_enum", "bad_ident", "bad_shape", "other"}
+
+
+class Clock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def base_doc(host="fuzz-h0", seq=1):
+    return {
+        "v": 1, "kind": "heartbeat", "host_id": host, "seq": seq,
+        "ts": 1000.0 + seq, "url": f"http://{host}:8080",
+        "ready": True, "health": "ok",
+        "slo": {"status": "ok", "fast_burn": 0.5},
+        "watts_est": 41.5, "egress_mbps_est": 120.0,
+        "devices": [{"id": 0, "hbm_limit_mb": 8192.0,
+                     "hbm_used_mb": 512.0, "seat_slots": 4,
+                     "seats_used": 1}],
+        "incidents": [{"kind": "relay_death", "count": 2}],
+        "clock": [999.0, 1000.1, 1000.2, 999.4],
+    }
+
+
+#: poison values thrown at each fuzzed field — type confusion, range
+#: escapes, IEEE specials (json.loads accepts NaN/Infinity, so they DO
+#: reach the validator), oversize payloads, nested junk
+POISONS = [
+    None, True, -1, -1e18, 1e18, float("nan"), float("inf"),
+    -float("inf"), "", "x" * 4096, "1; DROP TABLE hosts", [],
+    [[[[[]]]]], {}, {"k": {"k": {"k": {}}}}, [None] * 64, 3.5j.__repr__(),
+]
+
+#: fields fuzzed one at a time on top of a valid document
+FUZZ_FIELDS = [
+    "v", "kind", "host_id", "seq", "ts", "url", "ready", "health",
+    "slo", "watts_est", "egress_mbps_est", "devices", "incidents",
+    "clock",
+]
+
+
+async def _client(gw):
+    from aiohttp.test_utils import TestClient, TestServer
+    c = TestClient(TestServer(gw.make_app()))
+    await c.start_server()
+    return c
+
+
+def _poisoned_payloads(rng):
+    """One valid doc per (field, poison) pair plus structured near-miss
+    mutants for the nested axes (the single-field swaps above cannot
+    reach e.g. clock arity or duplicate incident kinds)."""
+    out = []
+    for field in FUZZ_FIELDS:
+        for poison in rng.sample(POISONS, 8):
+            doc = base_doc(seq=len(out) + 10)
+            doc[field] = poison
+            out.append(json.dumps(doc))
+    nested = [
+        {"slo": {"status": "sideways"}},
+        {"slo": {"status": "ok", "fast_burn": float("nan")}},
+        {"slo": {"status": "ok", "fast_burn": -3.0}},
+        {"watts_est": 2e6},                      # above the 1 MW ceiling
+        {"egress_mbps_est": float("inf")},
+        {"clock": [1.0, 2.0, 3.0]},              # wrong arity
+        {"clock": [1.0, 2.0, 3.0, "four"]},
+        {"clock": [1.0, 2.0, 3.0, float("nan")]},
+        {"clock": [-5.0, 2.0, 3.0, 4.0]},
+        {"incidents": [{"kind": "x", "count": 1},
+                       {"kind": "x", "count": 2}]},   # duplicate kind
+        {"incidents": [{"kind": "", "count": 1}]},
+        {"incidents": [{"kind": "x", "count": -2}]},
+        {"incidents": [{"kind": "x", "count": float("nan")}]},
+        {"incidents": [{"count": 1}]},           # kind missing
+        {"devices": [{"hbm_limit_mb": float("nan")}]},
+        {"devices": [{"hbm_limit_mb": -1.0}]},
+        {"devices": ["not-an-object"]},
+        {"host_id": "", "url": "http://x"},
+        {"v": 99},                               # future protocol
+    ]
+    for i, patch in enumerate(nested):
+        doc = base_doc(seq=1000 + i)
+        doc.update(patch)
+        out.append(json.dumps(doc))
+    # frame-level garbage: not even JSON objects
+    out += ["", "not json {", "[1,2,3]", '"string"', "null",
+            "{" * 2000, json.dumps([base_doc()])]
+    return out
+
+
+async def test_fuzzed_heartbeats_never_crash_or_poison_the_gateway():
+    rng = random.Random(0xF1EE7)
+    clock = Clock()
+    gw = FleetGateway(token=TOKEN, clock=clock,
+                      sweep_interval_s=3600.0)
+    c = await _client(gw)
+    try:
+        # a healthy baseline host first, so "poison reached the
+        # scheduler" is distinguishable from "scheduler is empty"
+        r = await c.post("/fleet/heartbeat",
+                         data=json.dumps(base_doc(host="good-h", seq=1)),
+                         headers=HDR)
+        assert r.status == 200
+        accepted, rejected = 1, 0
+        for payload in _poisoned_payloads(rng):
+            clock.now += 0.1
+            r = await c.post("/fleet/heartbeat", data=payload,
+                             headers=HDR)
+            assert r.status in (200, 400), \
+                f"edge must answer 200/400, got {r.status} for " \
+                f"{payload[:120]!r}"
+            if r.status == 200:
+                accepted += 1
+            else:
+                rejected += 1
+        assert gw.heartbeats_rejected == rejected and rejected > 50
+        assert gw.heartbeats_ok == accepted
+
+        # every rejection classified onto the bounded vocabulary
+        roll = gw.observer.rollup()
+        rejects = roll["fleet"]["slo"]["gateway"]["rejects"]
+        assert rejects and set(rejects) <= REJECTION_VOCAB
+        assert sum(rejects.values()) == rejected
+
+        # nothing poisoned crossed the parse: every scheduler-held
+        # host carries finite numbers only
+        for hid, host in gw.scheduler.hosts.items():
+            hb = host.heartbeat
+            for val in (hb.watts_est, hb.egress_mbps_est,
+                        hb.slo_fast_burn):
+                assert val is None or math.isfinite(val), (hid, val)
+            for d in hb.devices:
+                assert math.isfinite(d.hbm_limit_mb)
+                assert d.hbm_limit_mb >= 0
+        # ... and the series rings stay finite (the autoscaler reads
+        # these blind; every accepted heartbeat sampled them)
+        for name in ("seat_occupancy", "watts_est", "burn_fast_max",
+                     "queue_depth"):
+            for _, v in gw.observer.series(name, window_s=3600.0):
+                assert math.isfinite(v), (name, v)
+        # clocksync estimators only exist for hosts whose clock echo
+        # validated — and hold finite mappings
+        for hid, est in gw._clocksync.items():
+            q = est.quality()
+            for k in ("offset_ms", "error_bound_ms"):
+                if q.get(k) is not None:
+                    assert math.isfinite(q[k]), (hid, q)
+
+        # the surfaces behind the intake still answer
+        for path in ("/fleet/hosts", "/fleet/obs", "/fleet/metrics"):
+            r = await c.get(path, headers=HDR)
+            assert r.status == 200, path
+    finally:
+        await c.close()
+
+
+async def test_rejected_heartbeat_keeps_the_claimed_host_as_a_lead():
+    """A refused document still names its claimed sender in the reject
+    note — the operator's first lead on a misbehaving host."""
+    gw = FleetGateway(token=TOKEN, sweep_interval_s=3600.0)
+    c = await _client(gw)
+    try:
+        doc = base_doc(host="suspect-h")
+        doc["watts_est"] = float("nan")
+        r = await c.post("/fleet/heartbeat", data=json.dumps(doc),
+                         headers=HDR)
+        assert r.status == 400
+        roll = gw.observer.rollup()
+        last = roll["fleet"]["slo"]["gateway"]["last_reject"]
+        assert last["host_id"] == "suspect-h"
+        assert last["kind"] in REJECTION_VOCAB
+    finally:
+        await c.close()

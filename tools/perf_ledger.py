@@ -182,6 +182,12 @@ def entry_from_bench(doc: dict, *, git_rev: Optional[str] = None,
         # both axes belong in the trajectory
         "viewers": doc.get("viewers"),
         "renditions": doc.get("renditions"),
+        # live fleet soak (ISSUE 19): the scale of a --fleet-live row —
+        # how many REAL engine-host processes the contract ran over and
+        # how many seats actually moved (drain + failover); a contract
+        # pass at 2 hosts and at 10 are different claims
+        "fleet_hosts": doc.get("fleet_hosts"),
+        "migrations": doc.get("migrations"),
     }
 
 
