@@ -42,6 +42,13 @@ async def run(argv=None) -> None:
     from .obs import logctx as _logctx
     _logctx.install(json_format=settings.log_format == "json")
 
+    # SELKIES_FAULT_INJECT env seam (ISSUE 20): arm fault points before
+    # anything else runs so the chaos bench can inject into engine-host
+    # subprocesses the fleet actuator spawns (which get no CLI flags of
+    # their own). Idempotent with the server core's arm_from_env call.
+    from .resilience import faults as _fault_env
+    _fault_env.arm_from_env()
+
     # persistent XLA compile cache: the server must READ the cache the
     # image build / entrypoint warm step (tools/warm_cache.py) wrote, or
     # every boot re-pays the minutes-long first compile

@@ -23,6 +23,13 @@ fleet shaped for the millions-of-users traffic profile.
 - :mod:`.sim` — in-process simulated hosts on an injected clock: the
   rig ``bench.py --fleet`` and ``tests/test_fleet.py`` chaos-test the
   contracts on (CPU, no sleeps);
+- :mod:`.autoscale` — the scaling advisor (ISSUE 19): hysteresis-
+  gated ``desired_hosts`` over the observer's signal rings;
+- :mod:`.actuator` — the closed scaling loop (ISSUE 20): a guarded
+  reconcile state machine spawning hosts through a pluggable
+  :class:`~.actuator.HostProvider` and descheduling them drain-first,
+  with panic brakes, cooldowns, backoff/park on spawn failure and a
+  deadline-bounded force path for wedged drains;
 - :mod:`.gateway` — the one aiohttp module (NOT imported here): the
   stateless auth + WS-affinity tier in front of the engine hosts,
   plus the broadcast fan-out endpoint (ISSUE 17) where relay-only
@@ -36,6 +43,9 @@ Everything except :mod:`.gateway` imports with neither jax nor aiohttp
 installed (same contract as :mod:`..obs` / :mod:`..resilience`).
 """
 
+from .actuator import (ActuatorParams, HostPoolActuator,  # noqa: F401
+                       HostProvider, SubprocessHostProvider)
+from .autoscale import AdvisorParams, ScalingAdvisor  # noqa: F401
 from .migrate import MigrationCoordinator  # noqa: F401
 from .obs import FleetObserver  # noqa: F401
 from .protocol import (SEAT_CLASSES, FleetProtocolError,  # noqa: F401

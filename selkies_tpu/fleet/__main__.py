@@ -303,8 +303,29 @@ def _cmd_obs_selftest(args: argparse.Namespace) -> int:
 def _cmd_gateway(args: argparse.Namespace) -> int:
     from aiohttp import web
 
+    from ..resilience import faults as _faults
     from .gateway import FleetGateway
-    gw = FleetGateway(token=args.token)
+    # gateway-process fault points (fleet.spawn) arm from the same env
+    # seam engine subprocesses use — the chaos bench stages spawn
+    # failures before the gateway serves its first sweep
+    _faults.arm_from_env()
+    gw = FleetGateway(token=args.token,
+                      sweep_interval_s=args.sweep_interval_s,
+                      fleet_burn_threshold=args.fleet_burn_threshold)
+    if args.advisor:
+        from .autoscale import AdvisorParams
+        gw.advisor.params = AdvisorParams(**json.loads(args.advisor))
+    if args.actuator:
+        from .actuator import (ActuatorParams, HostPoolActuator,
+                               SubprocessHostProvider)
+        cfg = json.loads(args.actuator)
+        provider = SubprocessHostProvider(
+            cfg["argv"], env=cfg.get("env") or {},
+            logdir=cfg.get("logdir"))
+        gw.attach_actuator(HostPoolActuator(
+            gw.advisor, gw.scheduler, provider,
+            params=ActuatorParams(**(cfg.get("params") or {})),
+            coordinator=gw.coordinator, recorder=gw.recorder))
     app = gw.make_app()
     web.run_app(app, host=args.addr, port=args.port)
     return 0
@@ -332,6 +353,24 @@ def main(argv=None) -> int:
     pg.add_argument("--port", type=int, default=8100)
     pg.add_argument("--token", default="",
                     help="fleet bearer token (empty: open)")
+    pg.add_argument("--sweep_interval_s", type=float, default=2.0,
+                    help="lost-host/rebalance/advisor/actuator sweep "
+                         "cadence")
+    pg.add_argument("--fleet_burn_threshold", type=float, default=None,
+                    help="per-host fast-burn multiple that counts as "
+                         "burning — feeds the fleet rollup verdict, "
+                         "evict selection and the actuator's "
+                         "scale-down brake (default 14.4; raise "
+                         "where fidelity SLOs must not steer the "
+                         "fleet)")
+    pg.add_argument("--advisor", default="",
+                    help="JSON AdvisorParams overrides (chaos bench "
+                         "shrinks confirm streaks and hold windows)")
+    pg.add_argument("--actuator", default="",
+                    help='close the scaling loop: JSON {"argv": '
+                         '[engine argv template with {host_id}/'
+                         '{port}], "env": {...}, "logdir": path, '
+                         '"params": ActuatorParams overrides}')
     pg.set_defaults(fn=_cmd_gateway)
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,729 @@
+"""Autoscaler actuation (ISSUE 20): close the loop from the scaling
+advisor's ``desired_hosts`` to real host spawn/teardown.
+
+The :class:`HostPoolActuator` is a synchronous reconcile state machine
+the gateway drives once per sweep, right after the advisor evaluates.
+It compares the advisor's clamped ``desired_hosts`` against the number
+of live, once-ready hosts and converges with exactly one actuation in
+flight at a time:
+
+* **Scale-up** asks the pluggable :class:`HostProvider` to spawn a
+  host, then counts it only once its heartbeat reports the prewarm
+  ``ready`` gate green.  A boot-deadline miss tears the host down and
+  charges the PR-5 restart-policy engine: exponential backoff between
+  attempts, and a crash-loop **park** (with an ``actuator_parked``
+  incident) once the failure budget for the window is spent.  A parked
+  actuator holds until an operator calls :meth:`unpark` (or the
+  gateway's ``POST /fleet/actuator`` does).
+
+* **Scale-down** is drain-based descheduling, never a kill: pick a
+  victim (fewest seats, then coldest warm-geometry cache, never a
+  broadcast source host with live relay seats pinned to it, never a
+  host the provider does not own), start a drain through the injected
+  ``drain_starter``, and tear the host down only after the drain
+  reports done.  The await is deadline-bounded: a hung drain emits a
+  single ``drain_wedged`` incident (mirroring the supervisor's
+  wedged-join escalation) and the actuator force-tears the host down
+  only once the scheduler books show zero non-relay seats left on it —
+  i.e. only after every seat evacuated through the failover path.  If
+  seats never evacuate, the actuation aborts at a hard multiple of the
+  drain deadline rather than wedging the one in-flight slot forever.
+
+Guard rails, all of which refuse (and count the refusal) rather than
+actuate: ``min_hosts``/``max_hosts`` clamps, per-direction cooldowns,
+settle hysteresis (desired must disagree with actual for several
+consecutive reconciles), and a panic brake that refuses scale-down
+while the placement queue is non-empty, any host is fast-burning, or
+the advisor input is stale.  Stale input holds *both* directions,
+matching the advisor's own fail-safe: no heartbeats is an emergency,
+not a signal to resize anything.
+
+Everything here is injected-clock, stdlib-only and unit-testable
+without sockets; the gateway supplies the async-backed drain starter
+and the provider supplies real subprocesses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ..resilience import faults as _faults
+from ..resilience.supervisor import RestartPolicy
+
+logger = logging.getLogger(__name__)
+
+#: every reason a reconcile can decline to actuate; ``snapshot()``
+#: reports per-reason refusal counts keyed from this vocabulary.
+HOLD_REASONS = ("disabled", "no_decision", "stale_input", "steady",
+                "settling", "cooldown", "parked", "backing_off",
+                "queue_pending", "host_burning", "no_victim",
+                "in_flight", "spawn_failed")
+
+#: terminal outcomes an actuation can finish with.
+OUTCOMES = ("ok", "boot_timeout", "spawn_failed", "forced", "aborted",
+            "drain_failed")
+
+#: a wedged drain aborts (host left draining, slot freed) once it has
+#: lived this many drain deadlines without the books emptying.
+DRAIN_ABORT_FACTOR = 4.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ActuatorParams:
+    """Guard-rail knobs.  Defaults are deliberately conservative; the
+    chaos bench overrides them for speed."""
+    min_hosts: int = 1
+    max_hosts: int = 4
+    #: spawn → prewarm-ready budget; a miss is a teardown + backoff.
+    boot_deadline_s: float = 300.0
+    #: drain start → ``drain.done`` budget; a miss is ``drain_wedged``.
+    drain_deadline_s: float = 30.0
+    up_cooldown_s: float = 10.0
+    down_cooldown_s: float = 60.0
+    #: consecutive reconciles desired must exceed actual before a
+    #: spawn (absorbs transient host-lost blips without flapping).
+    up_settle: int = 3
+    down_settle: int = 3
+    host_prefix: str = "act-"
+    #: restart-policy budget for spawn/boot failures.
+    spawn_max_restarts: int = 3
+    spawn_window_s: float = 300.0
+    spawn_base_backoff_s: float = 0.5
+    spawn_max_backoff_s: float = 15.0
+
+
+class HostProvider:
+    """Seam real deployments implement (cloud API, k8s, systemd...).
+    The actuator only ever tears down hosts it asked the provider to
+    spawn — ``owns`` is the safety boundary."""
+
+    def spawn(self, host_id: str) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def teardown(self, host_id: str, *, force: bool = False) -> None:
+        raise NotImplementedError  # pragma: no cover
+
+    def owns(self, host_id: str) -> bool:  # pragma: no cover
+        raise NotImplementedError
+
+    def hosts(self) -> list:  # pragma: no cover
+        return []
+
+    def describe(self) -> dict:  # pragma: no cover
+        return {"kind": type(self).__name__}
+
+    def teardown_all(self, *, force: bool = True) -> None:
+        for hid in list(self.hosts()):
+            try:
+                self.teardown(hid, force=force)
+            except Exception:
+                logger.debug("teardown_all: %s failed", hid,
+                             exc_info=True)
+
+
+class SubprocessHostProvider(HostProvider):
+    """Spawn engine hosts as real subprocesses (bench/CI).  The argv
+    template may reference ``{host_id}`` and ``{port}``; a free port is
+    allocated per spawn and ``SELKIES_HOST_ID`` is set so the engine
+    registers under the actuator's name."""
+
+    def __init__(self, argv_template, *, env: Optional[dict] = None,
+                 logdir: Optional[str] = None):
+        self.argv_template = [str(a) for a in argv_template]
+        self.env = dict(env or {})
+        self.logdir = logdir
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.ports: dict[str, int] = {}
+        self._logs: list = []
+
+    @staticmethod
+    def _free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def spawn(self, host_id: str) -> None:
+        if host_id in self.procs:
+            raise RuntimeError(f"host {host_id} already spawned")
+        port = self._free_port()
+        argv = [a.format(host_id=host_id, port=port)
+                for a in self.argv_template]
+        env = dict(os.environ)
+        env.update(self.env)
+        env["SELKIES_HOST_ID"] = host_id
+        log = subprocess.DEVNULL
+        if self.logdir:
+            log = open(os.path.join(self.logdir, f"{host_id}.log"),
+                       "ab")
+            self._logs.append(log)
+        proc = subprocess.Popen(argv, stdout=log, stderr=log, env=env)
+        self.procs[host_id] = proc
+        self.ports[host_id] = port
+        logger.info("provider spawned %s pid=%d port=%d", host_id,
+                    proc.pid, port)
+
+    def teardown(self, host_id: str, *, force: bool = False) -> None:
+        proc = self.procs.pop(host_id, None)
+        self.ports.pop(host_id, None)
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            if force:
+                proc.kill()
+            else:
+                proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=10.0)
+        except Exception:
+            try:
+                proc.kill()
+                proc.wait(timeout=5.0)
+            except Exception:
+                logger.warning("provider: could not reap %s", host_id)
+        logger.info("provider tore down %s (force=%s)", host_id, force)
+
+    def owns(self, host_id: str) -> bool:
+        return host_id in self.procs
+
+    def hosts(self) -> list:
+        return list(self.procs)
+
+    def describe(self) -> dict:
+        return {"kind": "subprocess",
+                "hosts": {hid: {"pid": p.pid, "alive": p.poll() is None,
+                                "port": self.ports.get(hid)}
+                          for hid, p in self.procs.items()}}
+
+
+class HostPoolActuator:
+    """Reconcile ``advisor.desired_hosts`` against live ready hosts.
+
+    ``drain_starter(host_id, host_url)`` must return a control object
+    with ``done() -> bool`` and ``stop()``; the gateway's starter posts
+    ``/api/drain`` to the engine, evacuates the scheduler books and
+    polls the engine's ``drain.done``.  When only a coordinator is
+    supplied (tests, sim) the in-process evacuate handle is used.
+    """
+
+    def __init__(self, advisor, scheduler, provider, *,
+                 params: Optional[ActuatorParams] = None,
+                 drain_starter: Optional[Callable] = None,
+                 coordinator=None,
+                 recorder=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.advisor = advisor
+        self.scheduler = scheduler
+        self.provider = provider
+        self.params = params if params is not None else ActuatorParams()
+        self.drain_starter = drain_starter
+        self.coordinator = coordinator
+        self.recorder = recorder
+        self._clock = clock
+
+        self.parked = False
+        self.park_reason = ""
+        self.park_ts: Optional[float] = None
+        self.reconciles = 0
+        self.last_report: Optional[dict] = None
+        self.counts: dict[str, int] = {}
+        self.refusals: dict[str, int] = {}
+        self.history: deque = deque(maxlen=64)
+        self._inflight: Optional[dict] = None
+        self._ever_ready: set = set()
+        self._pressure_up = 0
+        self._pressure_down = 0
+        self._last_up_done: Optional[float] = None
+        self._last_down_done: Optional[float] = None
+        self._backoff_until = 0.0
+        self._spawn_seq = 0
+        self._policy = self._fresh_policy()
+
+    # ------------------------------------------------------ plumbing
+
+    def _fresh_policy(self) -> RestartPolicy:
+        p = self.params
+        # min_uptime_s = boot deadline: a spawn only counts as healthy
+        # once it reached ready (the policy is recreated then anyway),
+        # so consecutive failures ramp the backoff exponentially.
+        return RestartPolicy(max_restarts=p.spawn_max_restarts,
+                             window_s=p.spawn_window_s,
+                             base_backoff_s=p.spawn_base_backoff_s,
+                             max_backoff_s=p.spawn_max_backoff_s,
+                             jitter=0.0,
+                             min_uptime_s=p.boot_deadline_s,
+                             clock=self._clock)
+
+    def _record(self, kind: str, **fields) -> None:
+        if self.recorder is None:
+            return
+        try:
+            self.recorder.record(kind, **fields)
+        except Exception:
+            logger.debug("%s record failed", kind, exc_info=True)
+
+    def _count(self, direction: str, outcome: str) -> None:
+        key = f"{direction}_{outcome}"
+        self.counts[key] = self.counts.get(key, 0) + 1
+        try:
+            from ..server import metrics
+            metrics.describe("selkies_fleet_actuations_total",
+                             "Completed actuator transitions by "
+                             "direction and outcome")
+            metrics.inc_counter("selkies_fleet_actuations_total",
+                                labels={"direction": direction,
+                                        "outcome": outcome})
+        except Exception:
+            pass
+
+    def _export_gauges(self, desired, actual) -> None:
+        try:
+            from ..server import metrics
+        except Exception:
+            return
+        metrics.describe("selkies_fleet_hosts_desired",
+                         "Actuator's clamped desired host count")
+        metrics.describe("selkies_fleet_hosts_actual",
+                         "Live once-ready hosts the actuator counts")
+        if desired is not None:
+            metrics.set_gauge("selkies_fleet_hosts_desired", desired)
+        if actual is not None:
+            metrics.set_gauge("selkies_fleet_hosts_actual", actual)
+
+    # ----------------------------------------------------- reconcile
+
+    def reconcile(self, now: Optional[float] = None) -> dict:
+        now = self._clock() if now is None else float(now)
+        self.reconciles += 1
+        try:
+            report = self._step(now)
+        except Exception:
+            logger.exception("actuator reconcile failed")
+            report = self._report(now, "hold", "error", None, None)
+        self.last_report = report
+        self._export_gauges(report.get("desired"),
+                            report.get("actual"))
+        return report
+
+    def _report(self, now: float, action: str, reason: str,
+                desired, actual, **extra) -> dict:
+        doc = {"ts": round(now, 3), "action": action,
+               "reason": reason, "desired": desired, "actual": actual}
+        doc.update(extra)
+        return doc
+
+    def _hold(self, now: float, reason: str, desired, actual,
+              **extra) -> dict:
+        self.refusals[reason] = self.refusals.get(reason, 0) + 1
+        return self._report(now, "hold", reason, desired, actual,
+                            **extra)
+
+    def _step(self, now: float) -> dict:
+        actual, hosts = self._count_hosts()
+        if self._inflight is not None:
+            return self._poll_inflight(now, actual)
+        decision = getattr(self.advisor, "last_decision", None)
+        if not decision:
+            return self._hold(now, "no_decision", None, actual)
+        p = self.params
+        desired = max(p.min_hosts,
+                      min(p.max_hosts,
+                          int(decision.get("desired_hosts") or 0)))
+        if decision.get("stale"):
+            self._pressure_up = self._pressure_down = 0
+            return self._hold(now, "stale_input", desired, actual)
+        if desired > actual:
+            self._pressure_up += 1
+            self._pressure_down = 0
+            return self._try_up(now, desired, actual)
+        if desired < actual:
+            self._pressure_down += 1
+            self._pressure_up = 0
+            return self._try_down(now, desired, actual, hosts)
+        self._pressure_up = self._pressure_down = 0
+        return self._hold(now, "steady", desired, actual)
+
+    def _count_hosts(self):
+        """Hosts that count toward ``actual``: provider- or operator-
+        run, seen ready at least once, currently neither lost nor
+        draining.  Never-ready hosts (synthetic heartbeats, hosts mid
+        boot) don't count — a boot in flight is tracked separately."""
+        countable = []
+        for host in list(getattr(self.scheduler, "hosts", {}).values()):
+            if getattr(host, "ready", False):
+                self._ever_ready.add(host.host_id)
+            if host.host_id not in self._ever_ready:
+                continue
+            if getattr(host, "lost", False) \
+                    or getattr(host, "draining", False):
+                continue
+            countable.append(host)
+        return len(countable), countable
+
+    # ------------------------------------------------------ scale-up
+
+    def _try_up(self, now: float, desired: int, actual: int) -> dict:
+        p = self.params
+        if self.parked:
+            return self._hold(now, "parked", desired, actual,
+                              park_reason=self.park_reason)
+        if now < self._backoff_until:
+            return self._hold(now, "backing_off", desired, actual,
+                              retry_in_s=round(
+                                  self._backoff_until - now, 2))
+        if self._pressure_up < p.up_settle:
+            return self._hold(now, "settling", desired, actual,
+                              pressure=self._pressure_up)
+        if self._last_up_done is not None \
+                and now - self._last_up_done < p.up_cooldown_s:
+            return self._hold(now, "cooldown", desired, actual)
+        self._spawn_seq += 1
+        host_id = f"{p.host_prefix}{self._spawn_seq}"
+        try:
+            _faults.registry.perturb("fleet.spawn")
+            self.provider.spawn(host_id)
+        except Exception as exc:
+            return self._spawn_failed(now, host_id, exc, desired,
+                                      actual)
+        self._policy.record_started()
+        self._inflight = {"direction": "up", "host_id": host_id,
+                          "started": now,
+                          "deadline": now + p.boot_deadline_s}
+        self._record("actuation_started", direction="up",
+                     host_id=host_id, desired=desired, actual=actual)
+        logger.info("actuator: scale-up spawned %s (desired=%d "
+                    "actual=%d)", host_id, desired, actual)
+        return self._report(now, "up", "spawn", desired, actual,
+                            host_id=host_id)
+
+    def _spawn_failed(self, now: float, host_id: str, exc: Exception,
+                      desired: int, actual: int) -> dict:
+        self._count("up", "spawn_failed")
+        self._record("actuation_failed", direction="up",
+                     host_id=host_id, error=str(exc))
+        logger.warning("actuator: spawn %s failed: %s", host_id, exc)
+        self._policy.record_started()
+        return self._charge_policy(now, "spawn_failed", desired,
+                                   actual)
+
+    def _charge_policy(self, now: float, reason: str, desired,
+                       actual) -> dict:
+        backoff = self._policy.next_backoff()
+        if backoff is None:
+            self._park(now, "spawn_budget_exhausted")
+            return self._hold(now, "parked", desired, actual,
+                              park_reason=self.park_reason)
+        self._backoff_until = now + backoff
+        return self._hold(now, reason, desired, actual,
+                          backoff_s=round(backoff, 2))
+
+    def _park(self, now: float, reason: str) -> None:
+        self.parked = True
+        self.park_reason = reason
+        self.park_ts = now
+        self._record("actuator_parked", reason=reason,
+                     restarts_in_window=self._policy
+                     .restarts_in_window())
+        logger.error("actuator PARKED: %s (operator unpark required)",
+                     reason)
+
+    def unpark(self) -> None:
+        """Operator override: clear park state, reset the failure
+        budget and backoff so the next pressure can actuate."""
+        self.parked = False
+        self.park_reason = ""
+        self.park_ts = None
+        self._backoff_until = 0.0
+        self._policy = self._fresh_policy()
+        self._record("actuator_unparked")
+        logger.info("actuator unparked")
+
+    # ---------------------------------------------------- scale-down
+
+    def _try_down(self, now: float, desired: int, actual: int,
+                  hosts) -> dict:
+        p = self.params
+        if self._pressure_down < p.down_settle:
+            return self._hold(now, "settling", desired, actual,
+                              pressure=self._pressure_down)
+        if self._last_down_done is not None \
+                and now - self._last_down_done < p.down_cooldown_s:
+            return self._hold(now, "cooldown", desired, actual)
+        # panic brake: never shrink a fleet that is struggling.
+        queue = len(getattr(self.scheduler, "pending", ()) or ())
+        if queue:
+            return self._hold(now, "queue_pending", desired, actual,
+                              queue_depth=queue)
+        burning = [h.host_id for h in hosts
+                   if getattr(h, "burn_streak", 0) > 0]
+        if burning:
+            return self._hold(now, "host_burning", desired, actual,
+                              burning=burning)
+        victim = self._select_victim(hosts)
+        if victim is None:
+            return self._hold(now, "no_victim", desired, actual)
+        try:
+            control = self._start_drain(victim)
+        except Exception as exc:
+            self._count("down", "drain_failed")
+            self._record("actuation_failed", direction="down",
+                         host_id=victim.host_id, error=str(exc))
+            logger.warning("actuator: drain start for %s failed: %s",
+                           victim.host_id, exc)
+            return self._hold(now, "no_victim", desired, actual,
+                              error=str(exc))
+        self._inflight = {"direction": "down",
+                          "host_id": victim.host_id,
+                          "started": now,
+                          "deadline": now + p.drain_deadline_s,
+                          "control": control, "wedged": False}
+        self._record("actuation_started", direction="down",
+                     host_id=victim.host_id, desired=desired,
+                     actual=actual)
+        logger.info("actuator: scale-down draining %s (desired=%d "
+                    "actual=%d)", victim.host_id, desired, actual)
+        return self._report(now, "down", "drain", desired, actual,
+                            host_id=victim.host_id)
+
+    def _seats_on(self, host_id: str) -> int:
+        return sum(1 for p in
+                   list(getattr(self.scheduler, "placements",
+                                {}).values())
+                   if p.host_id == host_id and not p.spec.is_relay)
+
+    def _is_broadcast_source(self, host_id: str) -> bool:
+        """A host serving the source leg of a broadcast: relay seats
+        are pinned to their source host, so draining it would drop
+        every viewer.  Excluded from victim selection outright."""
+        return any(p.host_id == host_id and p.spec.is_relay
+                   for p in list(getattr(self.scheduler, "placements",
+                                         {}).values()))
+
+    def _select_victim(self, hosts):
+        candidates = []
+        for host in hosts:
+            if not self.provider.owns(host.host_id):
+                continue
+            if self._is_broadcast_source(host.host_id):
+                continue
+            warm = len(getattr(host.heartbeat, "warm_geometries",
+                               ()) or ())
+            candidates.append((self._seats_on(host.host_id), warm,
+                               host.host_id, host))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: c[:3])
+        return candidates[0][3]
+
+    def _start_drain(self, victim):
+        if self.drain_starter is not None:
+            return self.drain_starter(victim.host_id,
+                                      getattr(victim, "url", ""))
+        if self.coordinator is not None:
+            report = self.coordinator.evacuate(victim.host_id)
+            handle = report.pop("drain_handle", None)
+            return _EvacuateControl(handle)
+        raise RuntimeError("no drain_starter or coordinator wired")
+
+    # ------------------------------------------------- in-flight poll
+
+    def _poll_inflight(self, now: float, actual: int) -> dict:
+        fl = self._inflight
+        if fl["direction"] == "up":
+            return self._poll_boot(now, fl, actual)
+        return self._poll_drain(now, fl, actual)
+
+    def _poll_boot(self, now: float, fl: dict, actual: int) -> dict:
+        host = getattr(self.scheduler, "hosts", {}).get(fl["host_id"])
+        if host is not None and getattr(host, "ready", False):
+            self._ever_ready.add(fl["host_id"])
+            self._finish(now, fl, "ok")
+            self._policy = self._fresh_policy()
+            self._last_up_done = now
+            return self._report(now, "up", "ready", None, actual + 1,
+                                host_id=fl["host_id"],
+                                boot_s=round(now - fl["started"], 2))
+        if now >= fl["deadline"]:
+            logger.warning("actuator: %s missed boot deadline "
+                           "(%.0fs), tearing down", fl["host_id"],
+                           self.params.boot_deadline_s)
+            try:
+                self.provider.teardown(fl["host_id"], force=True)
+            except Exception:
+                logger.debug("boot-timeout teardown failed",
+                             exc_info=True)
+            self._finish(now, fl, "boot_timeout")
+            return self._charge_policy(now, "spawn_failed", None,
+                                       actual)
+        return self._hold(now, "in_flight", None, actual,
+                          inflight=fl["host_id"], direction="up")
+
+    def _poll_drain(self, now: float, fl: dict, actual: int) -> dict:
+        host_id = fl["host_id"]
+        control = fl["control"]
+        done = False
+        try:
+            done = bool(control.done())
+        except Exception:
+            logger.debug("drain control poll failed", exc_info=True)
+        if done:
+            try:
+                self.provider.teardown(host_id)
+            except Exception:
+                logger.debug("drain teardown failed", exc_info=True)
+            self._finish(now, fl, "ok")
+            self._last_down_done = now
+            return self._report(now, "down", "drained", None, actual,
+                                host_id=host_id,
+                                drain_s=round(now - fl["started"], 2))
+        if now < fl["deadline"]:
+            return self._hold(now, "in_flight", None, actual,
+                              inflight=host_id, direction="down")
+        # Deadline blown.  Escalate once (drain_wedged), then force
+        # the teardown ONLY after every seat evacuated through the
+        # failover path; give up entirely at the abort horizon.
+        if not fl["wedged"]:
+            fl["wedged"] = True
+            self._record("drain_wedged", host_id=host_id,
+                         waited_s=round(now - fl["started"], 2))
+            logger.warning("actuator: drain of %s wedged after %.0fs",
+                           host_id, now - fl["started"])
+        seats_left = self._seats_on(host_id)
+        if seats_left == 0:
+            try:
+                self.provider.teardown(host_id, force=True)
+            except Exception:
+                logger.debug("forced teardown failed", exc_info=True)
+            self._finish(now, fl, "forced", seats_left=0)
+            self._last_down_done = now
+            return self._report(now, "down", "forced", None, actual,
+                                host_id=host_id)
+        abort_at = fl["started"] \
+            + DRAIN_ABORT_FACTOR * self.params.drain_deadline_s
+        if now >= abort_at:
+            self._finish(now, fl, "aborted", seats_left=seats_left)
+            logger.error("actuator: drain of %s aborted with %d "
+                         "seats still placed; host left draining",
+                         host_id, seats_left)
+            return self._report(now, "down", "aborted", None, actual,
+                                host_id=host_id,
+                                seats_left=seats_left)
+        return self._hold(now, "in_flight", None, actual,
+                          inflight=host_id, direction="down",
+                          wedged=True, seats_left=seats_left)
+
+    def _finish(self, now: float, fl: dict, outcome: str,
+                **extra) -> None:
+        self._inflight = None
+        control = fl.get("control")
+        if control is not None:
+            try:
+                control.stop()
+            except Exception:
+                logger.debug("drain control stop failed",
+                             exc_info=True)
+        self._count(fl["direction"], outcome)
+        entry = {"direction": fl["direction"],
+                 "host_id": fl["host_id"], "outcome": outcome,
+                 "started": round(fl["started"], 3),
+                 "finished": round(now, 3),
+                 "duration_s": round(now - fl["started"], 3)}
+        report = getattr(control, "report", None)
+        if isinstance(report, dict):
+            for key in ("migrated", "dropped", "correlation_id"):
+                if key in report:
+                    entry[key] = report[key]
+        entry.update(extra)
+        self.history.append(entry)
+        self._record("actuation_done", **entry)
+        # A torn-down host never beats again: drop it from the
+        # scheduler's capacity books so dead slots stop inflating the
+        # advisor's occupancy denominator. "aborted" keeps the entry
+        # (the host is still up, still draining); an "ok" boot keeps
+        # it for the obvious reason.
+        torn_down = (fl["direction"] == "down"
+                     and outcome in ("ok", "forced")) \
+            or (fl["direction"] == "up" and outcome == "boot_timeout")
+        if torn_down:
+            self._ever_ready.discard(fl["host_id"])
+            forget = getattr(self.scheduler, "forget", None)
+            if forget is not None:
+                try:
+                    forget(fl["host_id"])
+                except Exception:
+                    logger.debug("scheduler forget failed",
+                                 exc_info=True)
+
+    # -------------------------------------------------------- report
+
+    def snapshot(self) -> dict:
+        """The ``actuator`` block for ``/fleet/obs`` and
+        ``/fleet/hosts``."""
+        inflight = None
+        if self._inflight is not None:
+            inflight = {k: v for k, v in self._inflight.items()
+                        if k != "control"}
+        doc = {
+            "enabled": True,
+            "parked": self.parked,
+            "park_reason": self.park_reason,
+            "reconciles": self.reconciles,
+            "counts": dict(self.counts),
+            "refusals": dict(self.refusals),
+            "pressure": {"up": self._pressure_up,
+                         "down": self._pressure_down},
+            "backoff_until": round(self._backoff_until, 3),
+            "inflight": inflight,
+            "last": self.last_report,
+            "params": dataclasses.asdict(self.params),
+            "history": list(self.history)[-10:],
+        }
+        try:
+            doc["provider"] = self.provider.describe()
+        except Exception:
+            doc["provider"] = {"kind": type(self.provider).__name__}
+        return doc
+
+    def shutdown(self) -> None:
+        """Gateway teardown: stop any in-flight drain control and
+        reap every provider-owned subprocess so bench/CI never leaks
+        engine hosts past the gateway's lifetime."""
+        if self._inflight is not None:
+            control = self._inflight.get("control")
+            if control is not None:
+                try:
+                    control.stop()
+                except Exception:
+                    pass
+            self._inflight = None
+        try:
+            self.provider.teardown_all(force=True)
+        except Exception:
+            logger.debug("provider teardown_all failed",
+                         exc_info=True)
+
+
+class _EvacuateControl:
+    """Drain control for in-process hosts: the coordinator's
+    ``DrainHandle`` (when the evacuated host had one) is the done
+    signal; books-only evacuations are immediately done."""
+
+    def __init__(self, handle):
+        self._handle = handle
+
+    def done(self) -> bool:
+        if self._handle is None:
+            return True
+        return bool(getattr(self._handle, "done", True))
+
+    def stop(self) -> None:
+        pass

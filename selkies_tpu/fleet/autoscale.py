@@ -286,7 +286,8 @@ class ScalingAdvisor:
             return
         metrics.describe("selkies_fleet_desired_hosts",
                          "Scaling advisor's recommended host count "
-                         "(observe-only; actuation is a follow-up)")
+                         "(the HostPoolActuator reconciles toward "
+                         "this when attached)")
         metrics.set_gauge("selkies_fleet_desired_hosts",
                           decision["desired_hosts"])
         metrics.describe("selkies_fleet_advisor_flips_total",

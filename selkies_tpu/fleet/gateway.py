@@ -52,8 +52,9 @@ from ..server import metrics
 from .autoscale import ScalingAdvisor
 from .migrate import MigrationCoordinator
 from .obs import FleetObserver
-from .protocol import (FleetProtocolError, parse_heartbeat,
-                       parse_session_spec, rejection_kind)
+from .protocol import (FleetProtocolError, migrate_command,
+                       parse_heartbeat, parse_session_spec,
+                       rejection_kind)
 from .scheduler import SeatScheduler
 
 logger = logging.getLogger("selkies_tpu.fleet.gateway")
@@ -77,12 +78,23 @@ class FleetGateway:
                  scheduler: Optional[SeatScheduler] = None,
                  coordinator: Optional[MigrationCoordinator] = None,
                  clock=time.monotonic,
-                 sweep_interval_s: float = 2.0):
+                 sweep_interval_s: float = 2.0,
+                 fleet_burn_threshold: Optional[float] = None):
         from ..obs import health as _health
         self.token = str(token or "")
         self.recorder = _health.engine.recorder
+        sched_kwargs = {}
+        if fleet_burn_threshold is not None and scheduler is None:
+            # one operator concept, three consumers: a host "burning"
+            # feeds the rollup verdict, evict selection AND the
+            # actuator's scale-down brake (burn_streak). Where
+            # fidelity burn must not steer the fleet (starved CI
+            # soaks, canary rigs) all three move together.
+            sched_kwargs["evict_burn_threshold"] = \
+                float(fleet_burn_threshold)
         self.scheduler = scheduler if scheduler is not None else \
-            SeatScheduler(clock=clock, recorder=self.recorder)
+            SeatScheduler(clock=clock, recorder=self.recorder,
+                          **sched_kwargs)
         self.coordinator = coordinator if coordinator is not None else \
             MigrationCoordinator(self.scheduler, clock=clock,
                                  recorder=self.recorder)
@@ -92,9 +104,17 @@ class FleetGateway:
         #: fleet observability plane (ISSUE 18): rollup + series +
         #: migration traces over the scheduler's validated heartbeat
         #: stream — the GET /fleet/{obs,metrics,trace} surfaces
+        obs_kwargs = {}
+        if fleet_burn_threshold is not None:
+            # deployments where fidelity burn must not steer the fleet
+            # verdict (starved CI soaks, canary rigs) raise it; the
+            # advisor's own burn_threshold is tuned separately
+            obs_kwargs["fleet_burn_threshold"] = \
+                float(fleet_burn_threshold)
         self.observer = FleetObserver(self.scheduler, self.coordinator,
                                       clock=clock,
-                                      recorder=self.recorder)
+                                      recorder=self.recorder,
+                                      **obs_kwargs)
         self._clock = clock
         #: scaling advisor (ISSUE 19, observe-only): evaluated once per
         #: sweep over the observer's series rings; its last decision is
@@ -121,6 +141,19 @@ class FleetGateway:
         #: the old and new connection on one sid — the old one closing
         #: must not tear down the seat the new one is using)
         self._ws_conns: dict[str, int] = {}
+        #: sid -> the live client-side WebSocketResponse objects behind
+        #: the counts above. The coordinator needs them when a seat
+        #: MOVES off a handle-less (HTTP-only) host: nothing in-process
+        #: can tell the engine to kick the client, so the gateway sends
+        #: the ``migrate,`` command down its own proxied socket and
+        #: closes it — the client reconnects and routes to the new
+        #: placement. Without this, an evict leaves the client
+        #: streaming from the old host forever (ghost placement on the
+        #: target, stale session floor blocking the source's slots).
+        self._ws_socks: dict[str, set] = {}
+        #: in-flight seat-kick sends (strong refs until done)
+        self._kick_tasks: set = set()
+        self.coordinator.on_source_release = self._seat_moved_notify
         #: sid -> pending deferred-release timer (reconnect grace)
         self._release_timers: dict = {}
         #: how long a seat survives its last WS closing — mirrors the
@@ -156,6 +189,126 @@ class FleetGateway:
         self._upstream_ws: dict = {}
         #: short-lived IDR-request tasks, retained until done
         self._idr_tasks: set = set()
+        #: autoscaler actuation (ISSUE 20): attached via
+        #: attach_actuator — None keeps the advisor observe-only
+        self.actuator = None
+
+    # -------------------------------------------------------- actuation
+    def attach_actuator(self, actuator) -> None:
+        """Close the scaling loop (ISSUE 20): the actuator reconciles
+        once per sweep right after the advisor evaluates, and its
+        drains run through this gateway's live drain orchestration
+        (engine /api/drain POST + books evacuation + drain.done
+        polling) instead of the in-process fallback."""
+        self.actuator = actuator
+        if actuator.drain_starter is None:
+            actuator.drain_starter = self._actuator_drain_starter
+
+    def _actuator_drain_starter(self, host_id: str, host_url: str):
+        """Start a live drain; return the sync control the actuator
+        polls. Mirrors handle_drain: notify the ENGINE first (its
+        clients get the ``migrate`` command and reconnect through the
+        gateway), then evacuate the scheduler books, then watch the
+        engine's /api/fleet ``drain.done`` until every seat-serving
+        component actually stopped."""
+        control = _LiveDrainControl()
+        host = self.scheduler.hosts.get(host_id)
+        url = str(host_url or (host.url if host else "")).rstrip("/")
+        remote = host_id not in self.coordinator.handles \
+            and url.startswith(("http://", "https://"))
+
+        async def run() -> None:
+            if remote:
+                try:
+                    async with self._http().post(
+                            url + "/api/drain",
+                            json={"target_url": ""},
+                            timeout=aiohttp.ClientTimeout(
+                                total=10)) as r:
+                        control.engine_notified = r.status == 200
+                except (aiohttp.ClientError,
+                        asyncio.TimeoutError) as e:
+                    logger.warning("actuator drain: engine %s "
+                                   "unreachable: %s", host_id, e)
+                    control.engine_notified = False
+            report = self.coordinator.evacuate(host_id)
+            handle = report.pop("drain_handle", None)
+            control.report = report
+            control.evacuated = True
+            if handle is not None:
+                await _await_handle(handle)
+                control.engine_done = True
+                return
+            if not remote:
+                # books-only host (sim/synthetic): nothing to stop
+                control.engine_done = True
+                return
+            while not control.engine_done:
+                await asyncio.sleep(1.0)
+                try:
+                    async with self._http().get(
+                            url + "/api/fleet",
+                            timeout=aiohttp.ClientTimeout(
+                                total=5)) as r:
+                        doc = await r.json(content_type=None)
+                    control.engine_done = bool(
+                        (doc.get("drain") or {}).get("done"))
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        ValueError):
+                    pass     # unreachable engine: keep polling until
+                             # the actuator's deadline escalates
+
+        control.task = asyncio.get_running_loop().create_task(run())
+        return control
+
+    def _actuator_doc(self) -> dict:
+        if self.actuator is None:
+            return {"enabled": False}
+        try:
+            return self.actuator.snapshot()
+        except Exception:
+            logger.exception("actuator snapshot failed")
+            return {"enabled": True, "error": "snapshot failed"}
+
+    async def handle_actuator_control(
+            self, request: web.Request) -> web.Response:
+        """POST /fleet/actuator — operator overrides for the closed
+        loop: {"unpark": true} clears a crash-loop park; {"arm": spec}
+        / {"disarm": point|null} drive THIS gateway process's fault
+        registry (the engine-side twin is POST /api/faults), so a
+        chaos run can stage fleet.spawn faults without restarting the
+        gateway."""
+        if not self._authed(request):
+            return web.Response(status=401, text="bad fleet token")
+        try:
+            body = json.loads(await request.read() or b"{}")
+        except json.JSONDecodeError:
+            return web.Response(status=400, text="bad json")
+        if not isinstance(body, dict):
+            return web.Response(status=400, text="JSON object body "
+                                                 "required")
+        from ..resilience import faults as _faults
+        did: dict = {}
+        if body.get("unpark"):
+            if self.actuator is None:
+                return web.Response(status=409, text="no actuator")
+            self.actuator.unpark()
+            did["unparked"] = True
+        if body.get("arm"):
+            try:
+                specs = _faults.registry.arm(str(body["arm"]))
+            except ValueError as e:
+                return web.Response(status=400,
+                                    text=f"bad fault spec: {e}")
+            did["armed"] = [s.to_spec() for s in specs]
+        if "disarm" in body:
+            point = body["disarm"]
+            did["disarmed"] = _faults.registry.disarm(
+                None if point in (None, "", "*") else str(point))
+        return web.json_response({
+            "ok": True, "did": did,
+            "actuator": self._actuator_doc(),
+            "faults": _faults.registry.active()})
 
     # ------------------------------------------------- gateway self-metrics
     # ISSUE 18 satellite: the WS proxy and broadcast fan-out export
@@ -193,6 +346,46 @@ class FleetGateway:
         # ``migrate,`` command told the client to come back here
         self.observer.note_reconnect(sid)
 
+    def _seat_moved_notify(self, source: str, sid: str) -> None:
+        """Coordinator source-release fallback for HTTP-only hosts: a
+        seat moved off ``source`` but no in-process handle can tell
+        the engine to kick its client, so WE push the ``migrate,``
+        command down our own proxied socket(s) for the sid and close
+        them. The client's reconnect routes to the new placement; the
+        source engine sees a normal disconnect and its reconnect-grace
+        machinery clears the stale session (unblocking the slots its
+        heartbeat floor was charging)."""
+        socks = list(self._ws_socks.get(sid, ()))
+        if not socks:
+            return
+        cmd = migrate_command("", sid)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+
+        async def _kick(ws) -> None:
+            try:
+                await asyncio.wait_for(ws.send_str(cmd), 2.0)
+            except Exception:
+                pass
+            try:
+                await ws.close(code=1012, message=b"seat moved")
+            except Exception:
+                pass
+
+        for ws in socks:
+            t = loop.create_task(_kick(ws))
+            self._kick_tasks.add(t)
+            t.add_done_callback(self._kick_tasks.discard)
+        try:
+            self.recorder.record("seat_kicked", sid=sid,
+                                 host_id=source)
+        except Exception:
+            pass
+        logger.info("fleet: kicked %d client socket(s) for moved "
+                    "seat %s (source %s)", len(socks), sid, source)
+
     # ------------------------------------------------------------------ auth
     def _authed(self, request: web.Request) -> bool:
         if not self.token:
@@ -214,6 +407,7 @@ class FleetGateway:
         r.add_get("/fleet/metrics", self.handle_metrics)
         r.add_get("/fleet/trace", self.handle_trace)
         r.add_post("/fleet/drain/{host_id}", self.handle_drain)
+        r.add_post("/fleet/actuator", self.handle_actuator_control)
         r.add_get("/fleet/ws", self.handle_ws)
         r.add_get("/fleet/signaling", self.handle_signaling)
         r.add_get("/fleet/broadcast/ws", self.handle_broadcast_ws)
@@ -227,6 +421,13 @@ class FleetGateway:
         self._sweep_task = asyncio.create_task(self._sweep_loop())
 
     async def _stop_sweep(self, app) -> None:
+        # actuator first: reap every provider-owned engine subprocess
+        # before the HTTP client they are drained through goes away
+        if self.actuator is not None:
+            try:
+                self.actuator.shutdown()
+            except Exception:
+                logger.exception("actuator shutdown failed")
         for t in self._release_timers.values():
             t.cancel()
         self._release_timers.clear()
@@ -268,6 +469,8 @@ class FleetGateway:
                 self.coordinator.check_lost_hosts()
                 self.coordinator.rebalance()
                 self.advisor.evaluate()
+                if self.actuator is not None:
+                    self.actuator.reconcile()
             except Exception:
                 logger.exception("fleet sweep failed")
 
@@ -378,6 +581,7 @@ class FleetGateway:
         # operator's answer to "can I trust the federated trace?"
         doc["clock"] = {hid: est.quality()
                         for hid, est in self._clocksync.items()}
+        doc["actuator"] = self._actuator_doc()
         return web.json_response(doc)
 
     # ------------------------------------------- observability surfaces
@@ -398,6 +602,7 @@ class FleetGateway:
             return web.Response(status=400, text="bad window")
         doc = self.observer.obs_doc(window_s=window)
         doc["advisor"] = self.advisor.snapshot()
+        doc["actuator"] = self._actuator_doc()
         corr = request.query.get("migration")
         if corr:
             doc["migration"] = self.observer.migration_report(corr)
@@ -619,6 +824,9 @@ class FleetGateway:
         if "Authorization" in request.headers:
             headers["Authorization"] = request.headers["Authorization"]
         self._ws_conns[sid] = self._ws_conns.get(sid, 0) + 1
+        # media sockets only: the ``migrate,`` kick rides the media
+        # channel, so signaling/broadcast sockets never register here
+        self._ws_socks.setdefault(sid, set()).add(ws_client)
         timer = self._release_timers.pop(sid, None)
         if timer is not None:
             timer.cancel()        # reconnect inside the grace: keep it
@@ -660,6 +868,11 @@ class FleetGateway:
             # the seat under the normal close-then-reconnect pattern
             # (migrate command, tab reload, network blip) the engine
             # holds its capture warm for.
+            socks = self._ws_socks.get(sid)
+            if socks is not None:
+                socks.discard(ws_client)
+                if not socks:
+                    self._ws_socks.pop(sid, None)
             left = self._ws_conns.get(sid, 1) - 1
             if left <= 0:
                 self._ws_conns.pop(sid, None)
@@ -1025,6 +1238,33 @@ class FleetGateway:
 
 async def _await_handle(handle) -> None:
     await handle
+
+
+class _LiveDrainControl:
+    """Sync facade over the gateway's async drain orchestration; the
+    actuator polls ``done()`` from its reconcile loop and ``stop()``
+    cancels the watcher task (force-teardown, abort, shutdown). Done
+    means BOTH the scheduler books evacuated AND the engine reported
+    every seat-serving component stopped — a wedged engine therefore
+    never reports done and the actuator's deadline path takes over."""
+
+    __slots__ = ("task", "evacuated", "engine_done",
+                 "engine_notified", "report")
+
+    def __init__(self):
+        self.task = None
+        self.evacuated = False
+        self.engine_done = False
+        self.engine_notified = None
+        self.report = None
+
+    def done(self) -> bool:
+        return self.evacuated and self.engine_done
+
+    def stop(self) -> None:
+        task = self.task
+        if task is not None and not task.done():
+            task.cancel()
 
 
 def _remap_host_events(host_doc, est, pid: int,

@@ -60,6 +60,15 @@ class MigrationCoordinator:
             else scheduler.recorder
         self.grace_s = float(grace_s)
         self.handles: dict[str, object] = {}
+        #: live-plane source release (gateway-wired): hosts reached
+        #: only over HTTP have no in-process handle, so when a seat
+        #: MOVES off one (evict/rebalance) nothing would ever tell the
+        #: still-connected client — the placement ghosts on the target
+        #: while the session keeps streaming from the source, and the
+        #: stale session floor blocks the source's slots forever. The
+        #: gateway owns the client's proxied WS, so it registers this
+        #: callback to push the ``migrate,`` command itself.
+        self.on_source_release = None
         self.total_migrations = 0
         self.total_failovers = 0
         #: fleet observer (ISSUE 18), wired by FleetObserver itself:
@@ -204,6 +213,12 @@ class MigrationCoordinator:
             return
         src_handle = self.handles.get(source)
         if src_handle is None:
+            if self.on_source_release is not None:
+                try:
+                    self.on_source_release(source, sid)
+                except Exception:
+                    logger.exception(
+                        "fleet: live source release of %s failed", sid)
             return
         try:
             src_handle.release_session(sid, keep_warm=True)

@@ -256,6 +256,30 @@ class SeatScheduler:
             self._update_metrics()
         return lost
 
+    def forget(self, host_id: str) -> bool:
+        """Drop a descheduled host from the capacity books entirely.
+
+        ``expire()`` only marks silence as ``lost`` — the entry stays so
+        a late heartbeat can resurrect the host. A host the actuator
+        TORE DOWN is different: it will never beat again, and leaving it
+        in ``hosts`` inflates every fleet-wide denominator (seat slots,
+        pixel/HBM budgets) forever, skewing the advisor's occupancy
+        input. Refuses while any placement still references the host —
+        teardown-after-evacuation is the actuator's invariant and this
+        is its backstop. A genuinely returning host simply re-registers
+        on its next heartbeat."""
+        with self._lock:
+            if any(p.host_id == host_id
+                   for p in self.placements.values()):
+                return False
+            host = self.hosts.pop(host_id, None)
+        if host is None:
+            return False
+        self._record("host_forgotten", host_id=host_id)
+        logger.info("fleet: host %s forgotten (descheduled)", host_id)
+        self._update_metrics()
+        return True
+
     # -- capacity math -------------------------------------------------------
     def _load_map(self) -> dict:
         """(host_id, device) -> [seats, hbm_mb, pixels] charged by

@@ -43,7 +43,25 @@ Injection points and their modes:
                           (mid-pipeline readback death: the ring must
                           drain, never wedge — bench --chaos proves it)
 ``ws.accept``             ``close`` / ``error`` (upgrade rejected)
+``fleet.spawn``           ``fail`` (actuator host spawn raises),
+                          ``slow`` (spawn stalls ``delay_s``)
+``fleet.drain``           ``hang`` (engine accepts the drain request
+                          but never starts it — ``drain.done`` never
+                          fires, forcing the actuator's bounded-await
+                          escalation path)
+``fleet.heartbeat``       ``drop`` (the next ``count`` gateway pushes
+                          are silently skipped — a control-plane
+                          partition), ``delay`` (push stalls
+                          ``delay_s`` first)
 ========================  =======================================
+
+Fleet-plane points (ISSUE 20) are also armable through the
+``SELKIES_FAULT_INJECT`` environment variable (same grammar), so the
+chaos bench can arm faults inside engine-host subprocesses the
+actuator spawns — no CLI flag or control-plane round-trip needed
+before the process is even serving. :func:`arm_from_env` is idempotent
+per process: the engine entrypoint and the server core both call it,
+whichever runs first wins.
 
 The disarmed fast path is one attribute read (``self._armed``) — the
 capture/encode loops pay nothing when no fault is armed. Stdlib-only:
@@ -56,6 +74,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import logging
+import os
 import random
 import threading
 import time
@@ -64,7 +83,7 @@ from typing import Optional
 logger = logging.getLogger("selkies_tpu.resilience.faults")
 
 __all__ = ["FaultError", "FaultSpec", "FaultRegistry", "parse_spec",
-           "registry", "POINTS"]
+           "arm_from_env", "registry", "POINTS"]
 
 #: injection points -> their valid modes. Parsing validates against this
 #: so a typo'd spec fails at arm time, never silently no-ops in a run.
@@ -76,10 +95,17 @@ POINTS: dict[str, tuple[str, ...]] = {
     "encoder.compile": ("slow",),
     "readback.fetch": ("slow", "error"),
     "ws.accept": ("close", "error"),
+    "fleet.spawn": ("fail", "slow"),
+    "fleet.drain": ("hang",),
+    "fleet.heartbeat": ("drop", "delay"),
 }
 
-#: modes that raise at the injection site (the rest sleep/stall)
-_RAISING_MODES = frozenset({"error", "raise", "device_error", "close"})
+#: modes that raise at the injection site. ``hang`` and ``drop`` are
+#: marker modes their sites interpret via ``pull()`` directly (skip the
+#: heartbeat POST, skip starting the drain) — ``perturb()`` never sees
+#: them; the rest of the non-raising modes sleep/stall.
+_RAISING_MODES = frozenset({"error", "raise", "device_error", "close",
+                            "fail"})
 
 #: bounded history of fired faults (chaos-run forensics)
 _FIRED_CAP = 256
@@ -306,3 +332,24 @@ class FaultRegistry:
 #: the process-wide registry every injection site reads (tests and the
 #: bench chaos harness build their own instances)
 registry = FaultRegistry()
+
+#: latched by :func:`arm_from_env` so the env spec arms exactly once
+#: per process no matter how many entrypoints call it (``arm`` extends
+#: the clause list — double-arming would double every schedule).
+_env_armed = False
+
+
+def arm_from_env(environ: Optional[dict] = None) -> list[FaultSpec]:
+    """Arm the process-wide registry from ``SELKIES_FAULT_INJECT``
+    (optional ``SELKIES_FAULT_SEED`` pins the RNG).  Idempotent: only
+    the first call in a process arms; later calls return ``[]``.  A
+    malformed spec raises ``ValueError`` — an env-armed chaos run must
+    fail loudly at boot, never silently run fault-free."""
+    global _env_armed
+    env = os.environ if environ is None else environ
+    text = (env.get("SELKIES_FAULT_INJECT") or "").strip()
+    if not text or _env_armed:
+        return []
+    _env_armed = True
+    seed = (env.get("SELKIES_FAULT_SEED") or "").strip()
+    return registry.arm(text, seed=int(seed) if seed else None)

@@ -215,6 +215,11 @@ class CentralizedStreamServer:
         self._switch_lock = asyncio.Lock()
         if getattr(settings, "fault_inject", ""):
             _faults.registry.arm(settings.fault_inject)
+        # env seam (ISSUE 20): the chaos bench arms fault points inside
+        # engine-host subprocesses the actuator spawns, before any
+        # control-plane endpoint is reachable. Idempotent with the
+        # entrypoint's own arm_from_env call.
+        _faults.arm_from_env()
         self._setup_routes()
 
     # ------------------------------------------------------------------ auth
@@ -638,6 +643,16 @@ class CentralizedStreamServer:
         async with aiohttp.ClientSession(timeout=timeout) as http:
             while True:
                 await asyncio.sleep(st["backoff_s"] or interval)
+                # fleet.heartbeat fault point (ISSUE 20): "drop" skips
+                # this push entirely (control-plane partition — the
+                # gateway must declare the host lost and fail seats
+                # over while the data plane keeps streaming); "delay"
+                # stalls the push to exercise staleness windows.
+                flt = _faults.registry.pull("fleet.heartbeat")
+                if flt is not None:
+                    if flt.mode == "drop":
+                        continue
+                    await _faults.registry.sleep_async(flt.delay_s)
                 try:
                     doc = self._fleet_heartbeat_doc()
                     if self._fleet_clock_sample is not None:
@@ -712,6 +727,19 @@ class CentralizedStreamServer:
         if not isinstance(body, dict):
             return web.Response(status=400, text="JSON object body required")
         target_url = str(body.get("target_url", ""))
+        # fleet.drain:hang fault point (ISSUE 20): a wedged engine —
+        # the request is accepted and readiness drops, but the drain
+        # never starts, clients are never told to migrate and
+        # ``drain.done`` never fires. The actuator's bounded await
+        # must escalate (drain_wedged) and force-tear the host down
+        # only after the gateway's failover path evacuated the seats.
+        flt = _faults.registry.pull("fleet.drain")
+        if flt is not None and flt.mode == "hang":
+            self.draining = True
+            return web.json_response({"draining": True,
+                                      "wedged": True,
+                                      "clients_notified": 0,
+                                      "drain_done": False})
         first = not self.draining
         self.draining = True
         if first:

@@ -417,8 +417,11 @@ SETTING_DEFINITIONS: tuple[Setting, ...] = (
     _s("fault_inject", SType.STR, "",
        "Arm deterministic fault injection at boot: "
        "'point:mode[:k=v,...];...' clauses (points: relay.send, "
-       "capture.source, encoder.dispatch, ws.accept; see resilience/"
-       "faults.py). Also armable live via POST /api/faults."),
+       "capture.source, encoder.dispatch, ws.accept, fleet.spawn, "
+       "fleet.drain, fleet.heartbeat; see resilience/faults.py). "
+       "Also armable live via POST /api/faults, or via the "
+       "SELKIES_FAULT_INJECT env var for subprocesses spawned "
+       "without CLI flags (the fleet actuator's engine hosts)."),
     _s("supervisor_max_restarts", SType.INT, 5,
        "Restart budget per supervised component inside "
        "supervisor_window_s; the component parks as failed (and the "

@@ -1,0 +1,533 @@
+"""HostPoolActuator contracts (ISSUE 20): the reconcile state machine
+on injected clocks — settle hysteresis and per-direction cooldowns,
+min/max clamps, the panic-brake matrix (queue non-empty / burning host
+/ stale input), spawn-fail backoff→park→unpark, boot-deadline miss,
+the drain-deadline force path (teardown only after seats evacuate,
+abort at the horizon), broadcast-source victim exclusion and the
+single-inflight invariant.  No sleeps, no sockets, no subprocesses:
+the provider, scheduler and advisor are all fakes."""
+
+import pytest
+
+from selkies_tpu.fleet.actuator import (DRAIN_ABORT_FACTOR,
+                                        ActuatorParams,
+                                        HostPoolActuator,
+                                        SubprocessHostProvider)
+from selkies_tpu.obs.health import FlightRecorder
+from selkies_tpu.resilience import faults as _faults
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeHeartbeat:
+    def __init__(self, warm=0):
+        self.warm_geometries = [(640, 360)] * warm
+
+
+class FakeHost:
+    def __init__(self, host_id, *, ready=True, lost=False,
+                 draining=False, burn_streak=0, warm=0,
+                 url="http://x"):
+        self.host_id = host_id
+        self.ready = ready
+        self.lost = lost
+        self.draining = draining
+        self.burn_streak = burn_streak
+        self.heartbeat = FakeHeartbeat(warm)
+        self.url = url
+
+
+class FakeSpec:
+    def __init__(self, is_relay=False):
+        self.is_relay = is_relay
+
+
+class FakePlacement:
+    def __init__(self, host_id, is_relay=False):
+        self.host_id = host_id
+        self.spec = FakeSpec(is_relay)
+
+
+class FakeScheduler:
+    def __init__(self):
+        self.hosts = {}
+        self.placements = {}
+        self.pending = []
+        self.forgotten = []
+
+    def forget(self, host_id):
+        if any(p.host_id == host_id
+               for p in self.placements.values()):
+            return False
+        self.forgotten.append(host_id)
+        return self.hosts.pop(host_id, None) is not None
+
+
+class FakeAdvisor:
+    def __init__(self):
+        self.last_decision = None
+
+    def want(self, desired, *, stale=False):
+        self.last_decision = {"desired_hosts": desired,
+                              "stale": stale}
+
+
+class FakeProvider:
+    """Spawned hosts appear in the scheduler as ready after
+    ``boot_after`` ticks of the shared clock (0 = next reconcile)."""
+
+    def __init__(self, sched, *, fail=0, boot=True):
+        self.sched = sched
+        self.fail = fail            # next N spawns raise
+        self.boot = boot            # register the host as ready
+        self.spawned = []
+        self.torn_down = []         # (host_id, force)
+        self._owned = set()
+
+    def spawn(self, host_id):
+        if self.fail > 0:
+            self.fail -= 1
+            raise RuntimeError("cloud says no")
+        self.spawned.append(host_id)
+        self._owned.add(host_id)
+        if self.boot:
+            self.sched.hosts[host_id] = FakeHost(host_id)
+
+    def teardown(self, host_id, *, force=False):
+        self.torn_down.append((host_id, force))
+        self._owned.discard(host_id)
+        self.sched.hosts.pop(host_id, None)
+
+    def owns(self, host_id):
+        return host_id in self._owned
+
+    def hosts(self):
+        return list(self._owned)
+
+    def describe(self):
+        return {"kind": "fake"}
+
+    def teardown_all(self, *, force=True):
+        for hid in list(self._owned):
+            self.teardown(hid, force=force)
+
+
+class FakeControl:
+    def __init__(self, done=False):
+        self._done = done
+        self.stopped = 0
+
+    def done(self):
+        return self._done
+
+    def stop(self):
+        self.stopped += 1
+
+
+PARAMS = ActuatorParams(min_hosts=1, max_hosts=3, boot_deadline_s=60.0,
+                        drain_deadline_s=20.0, up_cooldown_s=10.0,
+                        down_cooldown_s=30.0, up_settle=2,
+                        down_settle=2, spawn_max_restarts=1,
+                        spawn_window_s=300.0, spawn_base_backoff_s=1.0,
+                        spawn_max_backoff_s=8.0)
+
+
+def rig(*, params=PARAMS, hosts=(), fail=0, boot=True,
+        drain_starter=None):
+    clock = Clock()
+    sched = FakeScheduler()
+    for h in hosts:
+        sched.hosts[h.host_id] = h
+    advisor = FakeAdvisor()
+    provider = FakeProvider(sched, fail=fail, boot=boot)
+    recorder = FlightRecorder()
+    act = HostPoolActuator(advisor, sched, provider, params=params,
+                           drain_starter=drain_starter,
+                           recorder=recorder, clock=clock)
+    return act, advisor, sched, provider, clock, recorder
+
+
+def kinds(recorder):
+    return [i["kind"] for i in recorder.snapshot()]
+
+
+def settle_up(act, clock, n):
+    """Burn the settle hysteresis; returns the last report."""
+    rep = None
+    for _ in range(n):
+        rep = act.reconcile()
+        clock.advance(1.0)
+    return rep
+
+
+# ----------------------------------------------------------- holds
+
+class TestHolds:
+    def test_no_decision_holds(self):
+        act, *_ = rig()
+        rep = act.reconcile()
+        assert rep["action"] == "hold"
+        assert rep["reason"] == "no_decision"
+
+    def test_steady_holds(self):
+        act, advisor, *_ = rig(hosts=[FakeHost("h1")])
+        advisor.want(1)
+        rep = act.reconcile()
+        assert rep["reason"] == "steady"
+
+    def test_stale_input_holds_both_directions(self):
+        # desired > actual AND desired < actual both refuse on stale —
+        # no heartbeats is an emergency, not a resize signal
+        act, advisor, sched, provider, clock, _ = rig(
+            hosts=[FakeHost("h1"), FakeHost("h2")])
+        provider._owned.update(("h1", "h2"))
+        for desired in (5, 1):
+            advisor.want(desired, stale=True)
+            for _ in range(10):
+                rep = act.reconcile()
+                clock.advance(1.0)
+                assert rep["action"] == "hold"
+                assert rep["reason"] == "stale_input"
+        assert provider.spawned == [] and provider.torn_down == []
+        # staleness also resets the settle pressure: one fresh
+        # reconcile after a long stale stretch must NOT actuate
+        advisor.want(5)
+        assert act.reconcile()["reason"] == "settling"
+
+    def test_never_ready_hosts_do_not_count(self):
+        # a synthetic-heartbeat host that was never ready must not
+        # inflate actual (it can't serve, only mislead the books)
+        act, advisor, sched, *_ = rig(
+            hosts=[FakeHost("h1"),
+                   FakeHost("ghost", ready=False)])
+        advisor.want(1)
+        assert act.reconcile()["actual"] == 1
+
+
+# -------------------------------------------------------- scale-up
+
+class TestScaleUp:
+    def test_settle_then_spawn_then_ready_counts(self):
+        act, advisor, sched, provider, clock, rec = rig()
+        sched.hosts["h1"] = FakeHost("h1")
+        advisor.want(2)
+        assert act.reconcile()["reason"] == "settling"
+        clock.advance(1.0)
+        rep = act.reconcile()
+        assert rep["action"] == "up" and rep["reason"] == "spawn"
+        assert provider.spawned == ["act-1"]
+        clock.advance(1.0)
+        rep = act.reconcile()          # booted host seen ready
+        assert rep["reason"] == "ready"
+        assert act.counts == {"up_ok": 1}
+        assert "actuation_started" in kinds(rec)
+        assert "actuation_done" in kinds(rec)
+
+    def test_single_inflight_no_second_spawn(self):
+        act, advisor, sched, provider, clock, _ = rig(boot=False)
+        sched.hosts["h1"] = FakeHost("h1")
+        advisor.want(3)
+        settle_up(act, clock, 2)
+        assert provider.spawned == ["act-1"]
+        for _ in range(5):             # still booting: hold, no spawn
+            rep = act.reconcile()
+            clock.advance(1.0)
+            assert rep["reason"] == "in_flight"
+        assert provider.spawned == ["act-1"]
+
+    def test_max_hosts_clamp(self):
+        hosts = [FakeHost(f"h{i}") for i in range(3)]
+        act, advisor, sched, provider, clock, _ = rig(hosts=hosts)
+        advisor.want(99)
+        rep = settle_up(act, clock, 5)
+        assert rep["desired"] == PARAMS.max_hosts == rep["actual"]
+        assert provider.spawned == []
+
+    def test_min_hosts_clamp(self):
+        act, advisor, sched, provider, clock, _ = rig(
+            hosts=[FakeHost("h1")])
+        provider._owned.add("h1")
+        advisor.want(0)
+        rep = settle_up(act, clock, 5)
+        assert rep["desired"] == PARAMS.min_hosts
+        assert rep["reason"] == "steady"
+        assert provider.torn_down == []
+
+    def test_up_cooldown_between_spawns(self):
+        act, advisor, sched, provider, clock, _ = rig()
+        sched.hosts["h1"] = FakeHost("h1")
+        advisor.want(3)
+        settle_up(act, clock, 3)       # settle + spawn + ready
+        assert act.counts == {"up_ok": 1}
+        rep = settle_up(act, clock, 2)  # settle burned again, but...
+        assert rep["reason"] == "cooldown"
+        clock.advance(PARAMS.up_cooldown_s)
+        assert act.reconcile()["action"] == "up"
+
+    def test_boot_deadline_miss_tears_down_and_backs_off(self):
+        act, advisor, sched, provider, clock, rec = rig(boot=False)
+        sched.hosts["h1"] = FakeHost("h1")
+        advisor.want(2)
+        settle_up(act, clock, 2)
+        assert provider.spawned == ["act-1"]
+        clock.advance(PARAMS.boot_deadline_s + 1)
+        rep = act.reconcile()
+        assert ("act-1", True) in provider.torn_down
+        assert rep["reason"] == "spawn_failed"
+        assert rep["backoff_s"] > 0
+        assert act.counts == {"up_boot_timeout": 1}
+
+    def test_spawn_fail_backoff_then_park_then_unpark(self):
+        act, advisor, sched, provider, clock, rec = rig(fail=99)
+        sched.hosts["h1"] = FakeHost("h1")
+        advisor.want(2)
+        clock.advance(1.0)
+        act.reconcile()
+        rep = act.reconcile()          # first spawn attempt fails
+        assert rep["reason"] == "spawn_failed"
+        backoff = rep["backoff_s"]
+        assert backoff == PARAMS.spawn_base_backoff_s
+        rep = act.reconcile()
+        assert rep["reason"] == "backing_off"
+        clock.advance(backoff + 0.1)
+        rep = act.reconcile()          # second failure: budget spent
+        assert rep["reason"] == "parked"
+        assert act.parked
+        assert "actuator_parked" in kinds(rec)
+        for _ in range(5):             # parked is sticky
+            clock.advance(60.0)
+            assert act.reconcile()["reason"] == "parked"
+        provider.fail = 0
+        act.unpark()
+        assert "actuator_unparked" in kinds(rec)
+        rep = act.reconcile()
+        assert rep["action"] == "up"
+        assert act.counts["up_spawn_failed"] == 2
+
+
+# ------------------------------------------------------ scale-down
+
+def down_rig(*, control=None, seats=None, extra_hosts=(),
+             params=PARAMS):
+    """Two owned hosts + optional seats; desired 1 => drain pressure."""
+    control = control if control is not None else FakeControl()
+    starter_calls = []
+
+    def starter(host_id, url):
+        starter_calls.append(host_id)
+        return control
+
+    act, advisor, sched, provider, clock, rec = rig(
+        params=params, drain_starter=starter,
+        hosts=[FakeHost("h1"), FakeHost("h2", warm=2)]
+        + list(extra_hosts))
+    provider._owned.update(("h1", "h2"))
+    for sid, (host_id, is_relay) in (seats or {}).items():
+        sched.placements[sid] = FakePlacement(host_id, is_relay)
+    advisor.want(1)
+    return (act, advisor, sched, provider, clock, rec, control,
+            starter_calls)
+
+
+class TestScaleDown:
+    def test_settle_then_drain_then_teardown(self):
+        act, advisor, sched, provider, clock, rec, control, calls = \
+            down_rig()
+        assert act.reconcile()["reason"] == "settling"
+        clock.advance(1.0)
+        rep = act.reconcile()
+        assert rep["action"] == "down" and rep["reason"] == "drain"
+        assert calls == ["h1"]         # fewest warm geometries wins
+        control._done = True
+        clock.advance(1.0)
+        rep = act.reconcile()
+        assert rep["reason"] == "drained"
+        assert provider.torn_down == [("h1", False)]
+        assert act.counts == {"down_ok": 1}
+        assert control.stopped == 1
+        # torn-down host is dropped from the capacity books so its
+        # dead slots stop inflating the occupancy denominator
+        assert sched.forgotten == ["h1"]
+        assert "h1" not in sched.hosts
+
+    def test_drain_report_merged_into_history(self):
+        control = FakeControl()
+        control.report = {"migrated": 2, "dropped": 0,
+                          "correlation_id": "mig-7", "ignored": "x"}
+        act, advisor, sched, provider, clock, rec, control, calls = \
+            down_rig(control=control)
+        act.reconcile()
+        clock.advance(1.0)
+        act.reconcile()
+        control._done = True
+        clock.advance(1.0)
+        act.reconcile()
+        entry = act.history[-1]
+        assert entry["outcome"] == "ok"
+        assert entry["migrated"] == 2 and entry["dropped"] == 0
+        assert entry["correlation_id"] == "mig-7"
+        assert "ignored" not in entry
+
+    def test_panic_brake_queue_pending(self):
+        act, advisor, sched, *_ = down_rig()
+        sched.pending.append(object())
+        act.reconcile()
+        rep = act.reconcile()
+        assert rep["reason"] == "queue_pending"
+
+    def test_panic_brake_burning_host(self):
+        act, advisor, sched, *_ = down_rig()
+        sched.hosts["h2"].burn_streak = 3
+        act.reconcile()
+        rep = act.reconcile()
+        assert rep["reason"] == "host_burning"
+        assert rep["burning"] == ["h2"]
+
+    def test_victim_fewest_seats_first(self):
+        act, advisor, sched, provider, clock, rec, control, calls = \
+            down_rig(seats={"s1": ("h1", False), "s2": ("h1", False),
+                            "s3": ("h2", False)})
+        act.reconcile()
+        clock.advance(1.0)
+        act.reconcile()
+        assert calls == ["h2"]
+
+    def test_broadcast_source_never_victim(self):
+        # h2 has fewer seats but carries a relay (broadcast source):
+        # draining it would drop every viewer — h1 must be picked
+        act, advisor, sched, provider, clock, rec, control, calls = \
+            down_rig(seats={"s1": ("h1", False),
+                            "src": ("h2", False),
+                            "viewer": ("h2", True)})
+        act.reconcile()
+        clock.advance(1.0)
+        act.reconcile()
+        assert calls == ["h1"]
+
+    def test_unowned_hosts_never_victims(self):
+        act, advisor, sched, provider, clock, rec, control, calls = \
+            down_rig()
+        provider._owned.clear()        # actuator created neither host
+        act.reconcile()
+        clock.advance(1.0)
+        rep = act.reconcile()
+        assert rep["reason"] == "no_victim"
+        assert calls == []
+
+    def test_drain_wedged_forces_only_after_evacuation(self):
+        act, advisor, sched, provider, clock, rec, control, calls = \
+            down_rig(seats={"s1": ("h1", False), "s2": ("h2", False),
+                            "s3": ("h2", False)})
+        act.reconcile()
+        clock.advance(1.0)
+        act.reconcile()                # drain h1 started (never done)
+        clock.advance(PARAMS.drain_deadline_s + 1)
+        rep = act.reconcile()
+        assert rep["reason"] == "in_flight" and rep["wedged"]
+        assert kinds(rec).count("drain_wedged") == 1
+        assert provider.torn_down == []     # seat still placed!
+        clock.advance(1.0)
+        rep = act.reconcile()               # wedged incident is one-shot
+        assert kinds(rec).count("drain_wedged") == 1
+        del sched.placements["s1"]          # failover evacuated it
+        clock.advance(1.0)
+        rep = act.reconcile()
+        assert rep["reason"] == "forced"
+        assert provider.torn_down == [("h1", True)]
+        assert act.counts == {"down_forced": 1}
+
+    def test_drain_abort_horizon_when_seats_never_evacuate(self):
+        act, advisor, sched, provider, clock, rec, control, calls = \
+            down_rig(seats={"s1": ("h1", False), "s2": ("h2", False),
+                            "s3": ("h2", False)})
+        act.reconcile()
+        clock.advance(1.0)
+        act.reconcile()
+        clock.advance(DRAIN_ABORT_FACTOR * PARAMS.drain_deadline_s + 1)
+        rep = act.reconcile()
+        assert rep["reason"] == "aborted"
+        assert provider.torn_down == []     # never tear a seated host
+        assert act.counts == {"down_aborted": 1}
+        assert control.stopped == 1
+        assert act._inflight is None        # slot freed for later work
+
+    def test_down_cooldown(self):
+        act, advisor, sched, provider, clock, rec, control, calls = \
+            down_rig(extra_hosts=[FakeHost("h3")])
+        act.provider._owned.add("h3")
+        control._done = True
+        act.reconcile()
+        clock.advance(1.0)
+        act.reconcile()
+        clock.advance(1.0)
+        act.reconcile()                # h1 drained+down
+        assert act.counts == {"down_ok": 1}
+        rep = settle_up(act, clock, 3)
+        assert rep["reason"] == "cooldown"
+        clock.advance(PARAMS.down_cooldown_s)
+        assert act.reconcile()["action"] == "down"
+
+
+# ------------------------------------------------- faults & surface
+
+class TestFaultPointAndSnapshot:
+    def test_fleet_spawn_fault_point_fails_spawn(self):
+        act, advisor, sched, provider, clock, rec = rig()
+        sched.hosts["h1"] = FakeHost("h1")
+        _faults.registry.arm("fleet.spawn:fail:count=1")
+        try:
+            advisor.want(2)
+            clock.advance(1.0)
+            act.reconcile()
+            rep = act.reconcile()
+            assert rep["reason"] == "spawn_failed"
+            assert provider.spawned == []
+        finally:
+            _faults.registry.disarm("fleet.spawn")
+
+    def test_snapshot_shape(self):
+        act, advisor, sched, provider, clock, rec = rig()
+        sched.hosts["h1"] = FakeHost("h1")
+        advisor.want(2)
+        settle_up(act, clock, 3)
+        doc = act.snapshot()
+        assert doc["enabled"] and not doc["parked"]
+        assert doc["counts"] == {"up_ok": 1}
+        assert doc["reconciles"] == 3
+        assert doc["history"][-1]["outcome"] == "ok"
+        assert doc["params"]["max_hosts"] == PARAMS.max_hosts
+        assert doc["provider"] == {"kind": "fake"}
+
+    def test_shutdown_reaps_everything(self):
+        control = FakeControl()
+        act, advisor, sched, provider, clock, rec, control, calls = \
+            down_rig(control=control)
+        act.reconcile()
+        clock.advance(1.0)
+        act.reconcile()                # drain in flight
+        act.shutdown()
+        assert control.stopped == 1
+        assert provider._owned == set()
+
+
+class TestSubprocessProviderShape:
+    def test_argv_template_formatting(self):
+        p = SubprocessHostProvider(["engine", "--port", "{port}",
+                                    "--id", "{host_id}"])
+        assert p.owns("nope") is False
+        assert p.hosts() == []
+        port = p._free_port()
+        assert 0 < port < 65536
+        argv = [a.format(host_id="act-1", port=port)
+                for a in p.argv_template]
+        assert argv == ["engine", "--port", str(port),
+                        "--id", "act-1"]

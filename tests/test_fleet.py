@@ -432,6 +432,33 @@ def test_rebalance_moves_burning_hosts_session():
     assert fleet.hosts["b"].idr_resyncs >= 1
 
 
+def test_evict_off_handleless_host_fires_source_release_callback():
+    """An HTTP-only host has no in-process handle, so an evict move
+    cannot tell the source engine to end the seat — the coordinator
+    must fire ``on_source_release`` so the gateway can kick its own
+    proxied client socket with the migrate command. Without it the
+    client keeps streaming from the old host forever: the placement
+    sits as a ghost on the target while the source's session floor
+    blocks its slots (ISSUE 20 chaos soak deadlock)."""
+    fleet, sched, coord, rec = make_rig(evict_confirm=2)
+    a = add_host(fleet, "a", warm_geometries=("640x360",))
+    add_host(fleet, "b")
+    coord.handles.pop("a")        # "a" is reachable over HTTP only
+    kicked = []
+    coord.on_source_release = \
+        lambda host, sid: kicked.append((host, sid))
+    fleet.tick(0.5)
+    p = sched.place(SessionSpec("s1", 640, 360))
+    assert p.host_id == "a"
+    a.slo_burning = True
+    fleet.tick(0.5)
+    fleet.tick(0.5)
+    moves = coord.rebalance()
+    assert len(moves) == 1 and moves[0]["moved"]
+    assert sched.get("s1").host_id == "b"
+    assert kicked == [("a", "s1")]
+
+
 def test_host_expiry_marks_lost():
     fleet, sched, coord, rec = make_rig(host_timeout_s=2.0)
     h = add_host(fleet, "h0")
@@ -440,6 +467,24 @@ def test_host_expiry_marks_lost():
     fleet.tick(3.0)
     assert sched.hosts["h0"].lost
     assert "host_lost" in incident_kinds(rec)
+
+
+def test_forget_drops_host_but_refuses_while_placed():
+    fleet, sched, coord, rec = make_rig()
+    add_host(fleet, "h0")
+    add_host(fleet, "h1")
+    fleet.tick(0.5)
+    p = sched.place(SessionSpec("s1", 640, 360))
+    # seated host refuses to be forgotten (actuator backstop)
+    assert sched.forget(p.host_id) is False
+    assert p.host_id in sched.hosts
+    coord.evacuate(p.host_id)
+    assert sched.forget(p.host_id) is True
+    assert p.host_id not in sched.hosts
+    assert "host_forgotten" in incident_kinds(rec)
+    # the other host's capacity keeps serving; a forgotten id could
+    # even re-register on a fresh heartbeat — books simply restart
+    assert sched.place(SessionSpec("s2", 640, 360)) is not None
 
 
 # --------------------------------------------------------------- migration
